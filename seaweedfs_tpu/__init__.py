@@ -1,0 +1,11 @@
+"""seaweedfs_tpu — a TPU-native distributed object store framework.
+
+A ground-up rebuild of SeaweedFS's capability surface (master / volume /
+filer / shell / worker roles, needle volume storage, replication, and
+Reed-Solomon erasure coding) designed TPU-first: the compute-heavy path
+(GF(2^8) erasure coding) runs as batched JAX/XLA kernels sharded over a
+`jax.sharding.Mesh`, while the control plane and storage engine are
+idiomatic Python/C++ rather than a port of the reference's Go.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+"""Compute kernels: GF(2^8) field math and Reed-Solomon codecs.
+
+`gf256` / `rs_matrix` are the exact-math foundation (numpy, tiny);
+`rs_cpu` is the CPU twin used for golden tests and latency-path reads.
+"""

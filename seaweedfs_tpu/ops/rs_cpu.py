@@ -1,0 +1,94 @@
+"""CPU (numpy) Reed-Solomon twin of the TPU kernels.
+
+Serves three roles, mirroring how the reference keeps a CPU path everywhere
+(klauspost/reedsolomon in Go, reed-solomon-erasure in Rust):
+  * golden reference for bit-identity tests of the JAX/TPU kernels,
+  * the latency path for small degraded reads (weed/storage/store_ec.go:366
+    reconstructs single needles on the fly — batch TPU economics don't fit),
+  * fallback when no accelerator is present.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import gf256, rs_matrix
+
+
+class ReedSolomonCPU:
+    """Encoder/decoder for RS(data, parity) over GF(2^8), numpy-based.
+
+    API mirrors the reference encoder surface used by the EC pipeline
+    (ec_encoder.go:265 Encode, :360 Reconstruct, store_ec.go:435
+    ReconstructData) with shards as uint8 arrays of equal length.
+    """
+
+    def __init__(self, data_shards: int, parity_shards: int):
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        self.matrix = rs_matrix.build_matrix(data_shards, self.total_shards)
+        self.parity_rows = self.matrix[data_shards:].copy()
+
+    # -- encode ------------------------------------------------------------
+
+    def _check_shards(self, shards: np.ndarray, rows: int) -> np.ndarray:
+        shards = np.asarray(shards)
+        if shards.dtype != np.uint8:
+            raise TypeError(f"shards must be uint8, got {shards.dtype}")
+        if shards.ndim != 2 or shards.shape[0] != rows:
+            raise ValueError(
+                f"expected [{rows}, B] shard array, got {shards.shape}")
+        return shards
+
+    def encode(self, shards: np.ndarray) -> np.ndarray:
+        """shards: [total, B] uint8 with data in rows [:data]; returns a new
+        array with parity rows filled in."""
+        shards = self._check_shards(shards, self.total_shards)
+        out = shards.copy()
+        out[self.data_shards:] = gf256.gf_apply_matrix(
+            self.parity_rows, shards[: self.data_shards])
+        return out
+
+    def parity(self, data: np.ndarray) -> np.ndarray:
+        """data: [data, B] -> parity [parity, B]."""
+        data = self._check_shards(data, self.data_shards)
+        return gf256.gf_apply_matrix(self.parity_rows, data)
+
+    # -- verify ------------------------------------------------------------
+
+    def verify(self, shards: np.ndarray) -> bool:
+        shards = self._check_shards(shards, self.total_shards)
+        expected = self.parity(shards[: self.data_shards])
+        return bool(np.array_equal(expected, shards[self.data_shards:]))
+
+    # -- reconstruct -------------------------------------------------------
+
+    def reconstruct(self, shards: np.ndarray, present, data_only: bool = False
+                    ) -> np.ndarray:
+        """Fill missing rows of `shards` given presence mask `present`.
+
+        shards: [total, B]; rows where present[i] is False are ignored on
+        input and overwritten on output.  data_only mirrors the reference's
+        ReconstructData (store_ec.go:435): parity rows are left untouched.
+        """
+        shards = self._check_shards(shards, self.total_shards)
+        present = list(present)
+        if len(present) != self.total_shards:
+            raise ValueError("presence mask length must equal total shards")
+        missing_data = [i for i in range(self.data_shards) if not present[i]]
+        missing_parity = [i for i in range(self.data_shards, self.total_shards)
+                          if not present[i]]
+        out = shards.copy()
+        if missing_data:
+            m, rows = rs_matrix.cached_reconstruction_matrix(
+                self.data_shards, self.parity_shards, tuple(present),
+                tuple(missing_data))
+            survivors = shards[list(rows)]
+            out[missing_data] = gf256.gf_apply_matrix(m, survivors)
+        if missing_parity and not data_only:
+            rows_needed = self.parity_rows[
+                [i - self.data_shards for i in missing_parity]]
+            out[missing_parity] = gf256.gf_apply_matrix(
+                rows_needed, out[: self.data_shards])
+        return out

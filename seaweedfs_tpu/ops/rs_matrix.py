@@ -1,0 +1,141 @@
+"""Reed-Solomon coding matrices, bit-identical to the reference stack.
+
+Matrix construction mirrors `reed-solomon-erasure`'s `build_matrix`
+(reference: seaweed-volume/vendor/reed-solomon-erasure/src/core.rs:430-436)
+which is itself wire-compatible with `klauspost/reedsolomon` used by the Go
+EC paths (weed/storage/erasure_coding/ec_context.go:35):
+
+    V = vandermonde(total, data) with V[r][c] = exp(r, c)
+    G = V @ inv(V[:data, :data])
+
+The top `data` rows of G are the identity, so "encoding" all `total` shards
+equals copying the data shards and computing the parity rows; decoding picks
+any `data` surviving rows of G and inverts that submatrix.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from . import gf256
+
+
+def vandermonde(rows: int, cols: int) -> np.ndarray:
+    """V[r][c] = exp(r, c) (reference matrix.rs:263-276)."""
+    out = np.zeros((rows, cols), dtype=np.uint8)
+    for r in range(rows):
+        for c in range(cols):
+            out[r, c] = gf256.gf_exp(r, c)
+    return out
+
+
+def identity(n: int) -> np.ndarray:
+    return np.eye(n, dtype=np.uint8)
+
+
+def gf_invert_matrix(m: np.ndarray) -> np.ndarray:
+    """Invert a square GF(2^8) matrix by Gauss-Jordan elimination
+    (reference matrix.rs gaussian_elim).  Raises ValueError if singular."""
+    n, n2 = m.shape
+    assert n == n2
+    work = np.concatenate([m.copy(), identity(n)], axis=1)
+    for r in range(n):
+        if work[r, r] == 0:
+            # find a row below with a non-zero in this column and swap
+            for r_below in range(r + 1, n):
+                if work[r_below, r] != 0:
+                    work[[r, r_below]] = work[[r_below, r]]
+                    break
+        if work[r, r] == 0:
+            raise ValueError("singular matrix")
+        # scale row to make pivot 1
+        if work[r, r] != 1:
+            scale = gf256.gf_inv(int(work[r, r]))
+            work[r] = gf256.gf_mul_vec(scale, work[r])
+        # eliminate column r from all other rows
+        for r_other in range(n):
+            if r_other != r and work[r_other, r] != 0:
+                scale = int(work[r_other, r])
+                work[r_other] ^= gf256.gf_mul_vec(scale, work[r])
+    return work[:, n:].copy()
+
+
+@functools.lru_cache(maxsize=64)
+def _build_matrix_cached(data_shards: int, total_shards: int) -> bytes:
+    v = vandermonde(total_shards, data_shards)
+    top = v[:data_shards, :data_shards]
+    g = gf256.gf_matmul(v, gf_invert_matrix(top))
+    return g.tobytes()
+
+
+def build_matrix(data_shards: int, total_shards: int) -> np.ndarray:
+    """Full [total, data] coding matrix; rows [:data] are identity."""
+    if data_shards <= 0 or total_shards <= data_shards:
+        raise ValueError("need 0 < data_shards < total_shards")
+    if total_shards > 256:
+        raise ValueError("too many shards for GF(2^8)")
+    g = np.frombuffer(
+        _build_matrix_cached(data_shards, total_shards), dtype=np.uint8
+    ).reshape(total_shards, data_shards)
+    return g.copy()
+
+
+def parity_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
+    """[parity, data] generator rows used by encode."""
+    g = build_matrix(data_shards, data_shards + parity_shards)
+    return g[data_shards:].copy()
+
+
+def decode_matrix(data_shards: int, parity_shards: int,
+                  present: "list[bool] | np.ndarray"
+                  ) -> "tuple[np.ndarray, list[int]]":
+    """Matrix reconstructing ALL data shards from the first `data_shards`
+    present shards.
+
+    `present` is a total_shards-length presence mask.  Returns
+    (M [data, data], survivor_row_indices) with data = M @ survivors,
+    where survivors are the first `data` present shards in index order
+    (the reference's reconstruct_internal picks survivors in index order).
+    Raises ValueError if fewer than data_shards shards are present.
+    """
+    present = list(present)
+    total = data_shards + parity_shards
+    assert len(present) == total
+    g = build_matrix(data_shards, total)
+    rows = [i for i in range(total) if present[i]][:data_shards]
+    if len(rows) < data_shards:
+        raise ValueError("too few shards present to reconstruct")
+    sub = g[rows, :]                      # [data, data]
+    return gf_invert_matrix(sub), rows
+
+
+def reconstruction_matrix(data_shards: int, parity_shards: int,
+                          present: "list[bool] | np.ndarray",
+                          targets: "list[int]") -> "tuple[np.ndarray, list[int]]":
+    """Matrix producing the `targets` shard rows (any indices, data or
+    parity) from the first `data_shards` surviving shards.
+
+    Returns (M [len(targets), data], survivor_row_indices)."""
+    inv, rows = decode_matrix(data_shards, parity_shards, present)
+    total = data_shards + parity_shards
+    g = build_matrix(data_shards, total)
+    m = gf256.gf_matmul(g[list(targets), :], inv)
+    return m, rows
+
+
+@functools.lru_cache(maxsize=256)
+def cached_reconstruction_matrix(data_shards: int, parity_shards: int,
+                                 present: "tuple[bool, ...]",
+                                 targets: "tuple[int, ...]"
+                                 ) -> "tuple[np.ndarray, tuple[int, ...]]":
+    """LRU-cached reconstruction matrix keyed on the presence pattern.
+
+    Degraded reads repeat the same loss pattern for every needle on a
+    volume; the reference caches the decode matrix for the same reason
+    (reed-solomon-erasure core.rs data_decode_matrix_cache)."""
+    m, rows = reconstruction_matrix(
+        data_shards, parity_shards, list(present), list(targets))
+    m.setflags(write=False)
+    return m, tuple(rows)

@@ -1,0 +1,145 @@
+"""GF(2^8) field math tests.
+
+Golden values are derived from the reference's table generator
+(seaweed-volume/vendor/reed-solomon-erasure/build.rs) recomputed by hand:
+poly 0x11D log/exp tables are standard and checkable against known values.
+"""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf256, rs_matrix
+
+
+def test_log_exp_tables_roundtrip():
+    for i in range(1, 256):
+        assert gf256.EXP_TABLE[gf256.LOG_TABLE[i]] == i
+    # exp table duplicated upper half
+    for log in range(255):
+        assert gf256.EXP_TABLE[log] == gf256.EXP_TABLE[log + 255]
+
+
+def test_known_table_values():
+    # alpha = 2 is the generator: log(2) == 1, exp(1) == 2.
+    assert gf256.LOG_TABLE[1] == 0
+    assert gf256.LOG_TABLE[2] == 1
+    assert gf256.EXP_TABLE[0] == 1
+    assert gf256.EXP_TABLE[1] == 2
+    # 2^8 reduces by 0x11D: exp(8) = 0x1D = 29
+    assert gf256.EXP_TABLE[8] == 29
+
+
+def test_mul_matches_russian_peasant():
+    def slow_mul(a, b):
+        r = 0
+        for _ in range(8):
+            if b & 1:
+                r ^= a
+            b >>= 1
+            carry = a & 0x80
+            a = (a << 1) & 0xFF
+            if carry:
+                a ^= 0x1D
+        return r
+
+    rng = np.random.default_rng(0)
+    for a, b in rng.integers(0, 256, size=(200, 2)):
+        assert gf256.gf_mul(int(a), int(b)) == slow_mul(int(a), int(b))
+
+
+def test_field_axioms():
+    rng = np.random.default_rng(1)
+    xs = rng.integers(0, 256, size=50)
+    for a in xs:
+        a = int(a)
+        assert gf256.gf_mul(a, 1) == a
+        assert gf256.gf_mul(a, 0) == 0
+        if a != 0:
+            assert gf256.gf_mul(a, gf256.gf_inv(a)) == 1
+    for a, b, c in rng.integers(0, 256, size=(50, 3)):
+        a, b, c = int(a), int(b), int(c)
+        assert gf256.gf_mul(a, b) == gf256.gf_mul(b, a)
+        assert gf256.gf_mul(a, b ^ c) == gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+
+
+def test_exp_edge_cases():
+    # reference galois_8.rs:90-102 semantics
+    assert gf256.gf_exp(0, 0) == 1
+    assert gf256.gf_exp(0, 5) == 0
+    assert gf256.gf_exp(7, 0) == 1
+    assert gf256.gf_exp(2, 1) == 2
+    assert gf256.gf_exp(2, 8) == 29
+
+
+def test_mul_by_pow2_decomposition():
+    rng = np.random.default_rng(2)
+    for c, x in rng.integers(0, 256, size=(100, 2)):
+        c, x = int(c), int(x)
+        acc = 0
+        for b in range(8):
+            if (x >> b) & 1:
+                acc ^= int(gf256.MUL_BY_POW2[c, b])
+        assert acc == gf256.gf_mul(c, x)
+
+
+def test_vandermonde_values():
+    v = rs_matrix.vandermonde(4, 3)
+    # row r, col c = r^c
+    assert v[0].tolist() == [1, 0, 0]      # exp(0,0)=1, exp(0,c>0)=0
+    assert v[1].tolist() == [1, 1, 1]
+    assert v[2].tolist() == [1, 2, 4]
+    assert v[3].tolist() == [1, 3, 5]      # 3^2 = 5 in GF(2^8)
+
+
+def test_matrix_inverse():
+    rng = np.random.default_rng(3)
+    for n in (1, 2, 5, 10):
+        # Vandermonde-derived matrices are invertible
+        m = rs_matrix.build_matrix(n, n + 3)[: n]
+        assert np.array_equal(m, np.eye(n, dtype=np.uint8))
+        sub = rs_matrix.build_matrix(n, n + 3)[3: 3 + n]
+        inv = rs_matrix.gf_invert_matrix(sub)
+        assert np.array_equal(
+            gf256.gf_matmul(sub, inv), np.eye(n, dtype=np.uint8))
+
+
+def test_singular_matrix_raises():
+    m = np.zeros((2, 2), dtype=np.uint8)
+    with pytest.raises(ValueError):
+        rs_matrix.gf_invert_matrix(m)
+
+
+def test_build_matrix_identity_top():
+    for d, p in ((10, 4), (6, 3), (3, 2), (1, 1)):
+        g = rs_matrix.build_matrix(d, d + p)
+        assert g.shape == (d + p, d)
+        assert np.array_equal(g[:d], np.eye(d, dtype=np.uint8))
+
+
+def test_build_matrix_known_rs_3_2():
+    # Independently computed klauspost-style matrix for RS(3,2):
+    # V = vandermonde(5,3); G = V @ inv(V[:3,:3]).  Parity rows must be
+    # deterministic; spot-check via explicit gf math.
+    g = rs_matrix.build_matrix(3, 5)
+    v = rs_matrix.vandermonde(5, 3)
+    top_inv = rs_matrix.gf_invert_matrix(v[:3, :3])
+    expect = gf256.gf_matmul(v, top_inv)
+    assert np.array_equal(g, expect)
+    # and G restricted to any 3 rows is invertible (MDS property)
+    import itertools
+    for rows in itertools.combinations(range(5), 3):
+        sub = g[list(rows)]
+        rs_matrix.gf_invert_matrix(sub)  # must not raise
+
+
+def test_gf_apply_matrix_matches_scalar():
+    rng = np.random.default_rng(4)
+    mat = rng.integers(0, 256, size=(4, 10)).astype(np.uint8)
+    data = rng.integers(0, 256, size=(10, 33)).astype(np.uint8)
+    out = gf256.gf_apply_matrix(mat, data)
+    for j in range(4):
+        for col in range(33):
+            acc = 0
+            for i in range(10):
+                acc ^= gf256.gf_mul(int(mat[j, i]), int(data[i, col]))
+            assert out[j, col] == acc
