@@ -102,7 +102,9 @@ def decode_matrix(data_shards: int, parity_shards: int,
     """
     present = list(present)
     total = data_shards + parity_shards
-    assert len(present) == total
+    if len(present) != total:
+        raise ValueError(
+            f"presence mask length {len(present)} != total shards {total}")
     g = build_matrix(data_shards, total)
     rows = [i for i in range(total) if present[i]][:data_shards]
     if len(rows) < data_shards:
