@@ -1,0 +1,91 @@
+"""North-star benchmark: RS(10,4) erasure-coding encode throughput per chip.
+
+Measures the TPU GF(2^8) constant-matrix-apply kernel (the re-expression
+of the reference's hot loop, weed/storage/erasure_coding/ec_encoder.go:265
+enc.Encode via klauspost/reedsolomon SIMD) on whatever accelerator the
+session exposes, and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+
+Throughput accounting matches how `weed shell ec.encode` would be judged:
+volume data bytes consumed per second (input bytes, not input+parity).
+`vs_baseline` is the ratio to the reference CPU engine's typical RS(10,4)
+single-core SIMD throughput (BASELINE.md records no published EC numbers;
+klauspost/reedsolomon's own amd64 benchmarks put 10+4 encode at roughly
+6 GB/s/core, which we use as the stand-in until the driver measures the
+Go path on the eval machine).
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_CPU_GBPS = 6.0
+
+# Per-shard bytes per timed step. 64 MiB x 10 data shards = 640 MiB of
+# volume data per step — large enough to hide dispatch overheads, small
+# enough to triple-buffer in 16 GiB HBM.
+SHARD_BYTES = 64 * 1024 * 1024
+DATA_SHARDS = 10
+PARITY_SHARDS = 4
+CHAIN = 16  # kernel steps chained per timed launch (amortizes latency)
+ITERS = 3
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ops import rs_matrix
+    from seaweedfs_tpu.ops import rs_pallas
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    shard_bytes = SHARD_BYTES if on_tpu else 1024 * 1024
+
+    words = shard_bytes // 4
+    rng = np.random.default_rng(0)
+    data32 = rng.integers(0, 2**32, size=(DATA_SHARDS, words),
+                          dtype=np.uint32)
+    mat = rs_matrix.parity_matrix(DATA_SHARDS, PARITY_SHARDS)
+    tables = jnp.asarray(rs_pallas.expand_tables(mat))
+    d0 = jax.device_put(jnp.asarray(data32))
+
+    interpret = not on_tpu
+
+    # Chain CHAIN dependent kernel steps inside one jit and fetch a scalar
+    # checksum: the session TPU is reached over a tunnel where
+    # block_until_ready does not truly synchronize, so a device->host
+    # scalar fetch is the only honest fence, and chaining amortizes the
+    # tunnel round-trip out of the per-step time.
+    @jax.jit
+    def chain(tables, d):
+        def body(_, d):
+            out = rs_pallas.gf_apply_matrix_pallas_words(
+                tables, d, interpret=interpret)
+            return d.at[:PARITY_SHARDS].set(d[:PARITY_SHARDS] ^ out)
+        d = jax.lax.fori_loop(0, CHAIN, body, d)
+        return jnp.sum(d[0, :: max(words // 1024, 1)], dtype=jnp.uint32)
+
+    int(chain(tables, d0))  # warmup / compile
+    best_dt = float("inf")
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        int(chain(tables, d0))
+        best_dt = min(best_dt, (time.perf_counter() - t0) / CHAIN)
+
+    gbps = (DATA_SHARDS * shard_bytes) / best_dt / 1e9
+    print(json.dumps({
+        "metric": "ec_encode_rs10+4_GBps_per_chip",
+        "value": round(gbps, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / BASELINE_CPU_GBPS, 2),
+        "backend": backend,
+        "shard_bytes": shard_bytes,
+        "baseline_cpu_gbps": BASELINE_CPU_GBPS,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,13 @@ def _expand_tables(mat: jax.Array) -> jax.Array:
     return _MUL_BY_POW2[mat]
 
 
+def expand_tables_u32(mat: jax.Array) -> jax.Array:
+    """[R, K] constant matrix -> [R, K, 8] uint32 per-bit multiply tables
+    (the form `_packed_xor_network` consumes); shared by every caller so
+    the table layout has a single definition."""
+    return _expand_tables(mat).astype(jnp.uint32)
+
+
 def _packed_xor_network(tables: jax.Array, data32: jax.Array) -> jax.Array:
     """Packed-word GF constant-matrix apply.
 
@@ -73,7 +80,7 @@ def gf_apply_matrix_words(mat: jax.Array, data32: jax.Array) -> jax.Array:
     multi-GB arrays were observed to pad 12.8x on TPU (layout {0,1}
     T(8,128)(4,1)) and OOM — words in, words out avoids the entire issue.
     """
-    tables = _expand_tables(mat).astype(jnp.uint32)
+    tables = expand_tables_u32(mat)
     return _packed_xor_network(tables, data32)
 
 
@@ -99,7 +106,8 @@ def gf_apply_matrix(mat, data) -> jax.Array:
 
     mat: [R, K] uint8 (traced; any coding/decoding matrix)
     data: [K, B] uint8 (B is padded to a word multiple internally)
-    returns [R, B] uint8.
+    returns [R, B]: numpy uint8 for numpy input (host word-packing fast
+    path, no device relayout or re-upload), device uint8 otherwise.
 
     Convenience byte-in/byte-out wrapper; for multi-GB streams prefer
     gf_apply_matrix_words with host-packed uint32 buffers.
@@ -112,7 +120,7 @@ def gf_apply_matrix(mat, data) -> jax.Array:
         b = int(np.prod(batch_shape))
         out32 = gf_apply_matrix_words(mat, jnp.asarray(flat))
         out = unpack_words(np.asarray(out32), b)
-        return jnp.asarray(out).reshape((mat.shape[0],) + batch_shape)
+        return out.reshape((mat.shape[0],) + batch_shape)
     data = jnp.asarray(data, dtype=jnp.uint8)
     flat = data.reshape(k, -1)
     b = flat.shape[1]
@@ -141,9 +149,13 @@ class ReedSolomonJax:
         self._parity_rows = jnp.asarray(self.matrix[data_shards:])
 
     def _check(self, arr, rows: int):
-        if hasattr(arr, "dtype") and arr.dtype != np.uint8:
+        """Validate without converting: numpy stays numpy so the host
+        word-packing fast path in gf_apply_matrix is taken (device-side
+        eager uint8 relayout of huge arrays pads 12.8x and OOMs)."""
+        if not hasattr(arr, "dtype"):
+            arr = np.asarray(arr, dtype=np.uint8)
+        if arr.dtype != np.uint8:
             raise TypeError(f"shards must be uint8, got {arr.dtype}")
-        arr = jnp.asarray(arr, dtype=jnp.uint8)
         if arr.ndim != 2 or arr.shape[0] != rows:
             raise ValueError(
                 f"expected [{rows}, B] shard array, got {arr.shape}")

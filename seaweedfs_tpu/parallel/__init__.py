@@ -1,0 +1,13 @@
+"""Distributed execution: device meshes and sharded erasure-coding.
+
+The reference scales by *processes* (volume servers spread shards across
+machines, gRPC fan-out for replication/rebuild — weed/topology/
+store_replicate.go:27, weed/storage/store_ec.go:366).  The TPU-native
+equivalent inside one pod-slice is a `jax.sharding.Mesh` with XLA
+collectives over ICI: stripes are the batch ("data-parallel") axis and
+shard rows are the "tensor-parallel" axis; cross-shard reconstruction is
+a ring XOR-reduce (`ppermute`) — the storage analog of ring attention.
+"""
+
+from .mesh import make_mesh  # noqa: F401
+from . import ec_sharded  # noqa: F401

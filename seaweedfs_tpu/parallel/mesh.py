@@ -1,0 +1,42 @@
+"""Device-mesh construction helpers.
+
+Axes:
+  * "stripe" — data-parallel axis over stripe rows / byte columns of the
+    volume stream (the reference's analog: independent 1GB/1MB stripe rows,
+    weed/storage/erasure_coding/ec_encoder.go:280-319).
+  * "shard"  — model/tensor-parallel axis over shard rows (the reference's
+    analog: the 14 shard files spread across servers,
+    weed/storage/erasure_coding/shard_distribution.go:101).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+STRIPE_AXIS = "stripe"
+SHARD_AXIS = "shard"
+
+
+def make_mesh(devices=None, shard_axis_size: int | None = None) -> Mesh:
+    """Build a 2D ("stripe", "shard") mesh over `devices`.
+
+    shard_axis_size defaults to the largest divisor of len(devices) that
+    is <= 4 (RS(10,4) has 4 parity rows to split tensor-parallel); the
+    remaining factor becomes the stripe (data-parallel) axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if shard_axis_size is None:
+        shard_axis_size = 1
+        for cand in (4, 3, 2):
+            if n % cand == 0:
+                shard_axis_size = cand
+                break
+    if n % shard_axis_size:
+        raise ValueError(f"{n} devices not divisible by shard axis "
+                         f"{shard_axis_size}")
+    arr = np.asarray(devices).reshape(n // shard_axis_size, shard_axis_size)
+    return Mesh(arr, (STRIPE_AXIS, SHARD_AXIS))

@@ -1,15 +1,23 @@
 """Test config: run JAX on a virtual 8-device CPU mesh.
 
-Tests never require the real TPU; multi-chip sharding logic is exercised on
-8 virtual CPU devices (the driver separately dry-runs the multichip path).
-Must set XLA flags before jax is imported anywhere.
+Tests never require the real TPU; multi-chip sharding logic is exercised
+on 8 virtual CPU devices (the driver separately dry-runs the multichip
+path).  The session environment force-registers the real-TPU "axon"
+platform via sitecustomize and pins jax_platforms to "axon,cpu", so we
+must both set the env vars BEFORE jax initializes and override the config
+AFTER import.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu"
