@@ -1,0 +1,13 @@
+"""Storage engine: needle/volume file formats, indexes, and volumes.
+
+Byte-compatible with the reference's on-disk contracts
+(weed/storage/needle, weed/storage/types, weed/storage/super_block,
+weed/storage/idx) so volumes written by either implementation are
+readable by the other.  Internals are idiomatic Python/numpy — bulk
+index parsing is vectorized instead of looped, and the hot data paths
+hand off to the JAX/TPU kernels in ops/.
+"""
+
+from . import types  # noqa: F401
+from .needle import Needle  # noqa: F401
+from .super_block import SuperBlock  # noqa: F401

@@ -1,0 +1,37 @@
+"""CRC32-Castagnoli needle checksums (weed/storage/needle/crc.go).
+
+Uses the C-accelerated google_crc32c when present; falls back to a
+table-driven pure-Python implementation (only hit in stripped-down
+environments — the fallback is correct but slow).
+"""
+
+from __future__ import annotations
+
+try:
+    import google_crc32c
+
+    def crc32c(data: bytes, value: int = 0) -> int:
+        return google_crc32c.extend(value, bytes(data))
+
+except ImportError:  # pragma: no cover
+    _POLY = 0x82F63B78  # reflected Castagnoli
+
+    _TABLE = []
+    for _i in range(256):
+        _c = _i
+        for _ in range(8):
+            _c = (_c >> 1) ^ _POLY if _c & 1 else _c >> 1
+        _TABLE.append(_c)
+
+    def crc32c(data: bytes, value: int = 0) -> int:
+        c = value ^ 0xFFFFFFFF
+        for b in data:
+            c = _TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+        return c ^ 0xFFFFFFFF
+
+
+def crc_value(c: int) -> int:
+    """Deprecated legacy .Value() transform kept for pre-3.09 volumes
+    (crc.go:25-27): rotl17(c) + 0xa282ead8 mod 2^32."""
+    rot = ((c >> 15) | (c << 17)) & 0xFFFFFFFF
+    return (rot + 0xA282EAD8) & 0xFFFFFFFF

@@ -1,0 +1,292 @@
+"""Needle: a single stored blob record, byte-compatible with the
+reference's v2/v3 on-disk format.
+
+Layout (weed/storage/needle/needle_write_v2.go:11-80 writeNeedleCommon,
+needle_write_v3.go:10-16, needle_read.go):
+
+    header:  Cookie(4) NeedleId(8) Size(4)              [16B]
+    if Size > 0:
+      DataSize(4) Data Flags(1)
+      [NameSize(1) Name]       if FlagHasName
+      [MimeSize(1) Mime]       if FlagHasMime
+      [LastModified(5)]        if FlagHasLastModifiedDate
+      [TTL(2)]                 if FlagHasTtl
+      [PairsSize(2) Pairs]     if FlagHasPairs
+    footer:  CRC32C(4) [AppendAtNs(8) in v3] padding to 8B
+
+Size counts everything between the header and the footer; v1 stored raw
+data only and is read- but not write-supported.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from . import types
+from .crc import crc32c, crc_value
+from .ttl import EMPTY_TTL, TTL, load_ttl_from_bytes
+
+# flags (needle_read.go:15-25)
+FLAG_IS_COMPRESSED = 0x01
+FLAG_HAS_NAME = 0x02
+FLAG_HAS_MIME = 0x04
+FLAG_HAS_LAST_MODIFIED_DATE = 0x08
+FLAG_HAS_TTL = 0x10
+FLAG_HAS_PAIRS = 0x20
+FLAG_IS_CHUNK_MANIFEST = 0x80
+
+LAST_MODIFIED_BYTES_LENGTH = 5
+TTL_BYTES_LENGTH = 2
+
+
+class SizeMismatchError(ValueError):
+    pass
+
+
+class CrcError(ValueError):
+    pass
+
+
+def padding_length(needle_size: int, version: int) -> int:
+    """needle_read_tail.go:36 — NOTE the reference pads 8 bytes (not 0)
+    when already aligned; reproduce exactly."""
+    footer = types.NEEDLE_CHECKSUM_SIZE
+    if version == types.VERSION3:
+        footer += types.TIMESTAMP_SIZE
+    return types.NEEDLE_PADDING_SIZE - (
+        (types.NEEDLE_HEADER_SIZE + needle_size + footer)
+        % types.NEEDLE_PADDING_SIZE)
+
+
+def needle_body_length(needle_size: int, version: int) -> int:
+    footer = types.NEEDLE_CHECKSUM_SIZE
+    if version == types.VERSION3:
+        footer += types.TIMESTAMP_SIZE
+    return needle_size + footer + padding_length(needle_size, version)
+
+
+def get_actual_size(size: int, version: int) -> int:
+    """Total on-disk record size (needle_read.go:286)."""
+    return types.NEEDLE_HEADER_SIZE + needle_body_length(size, version)
+
+
+@dataclass
+class Needle:
+    """In-memory needle (weed/storage/needle/needle.go:25-45)."""
+
+    cookie: int = 0
+    id: int = 0
+    size: int = 0            # on-disk Size field (set by serialize/parse)
+    data: bytes = b""
+    flags: int = 0
+    name: bytes = b""
+    mime: bytes = b""
+    pairs: bytes = b""       # opaque marshaled name/value pairs
+    last_modified: int = 0   # unix seconds, 5 bytes on disk
+    ttl: TTL = EMPTY_TTL
+    checksum: int = 0        # CRC32C of data
+    append_at_ns: int = 0    # v3 only
+    crc_legacy: bool = False  # parsed from a pre-3.09 volume (crc.Value())
+
+    # -- flag helpers ----------------------------------------------------
+
+    def has_name(self) -> bool:
+        return bool(self.flags & FLAG_HAS_NAME)
+
+    def has_mime(self) -> bool:
+        return bool(self.flags & FLAG_HAS_MIME)
+
+    def has_last_modified_date(self) -> bool:
+        return bool(self.flags & FLAG_HAS_LAST_MODIFIED_DATE)
+
+    def has_ttl(self) -> bool:
+        return bool(self.flags & FLAG_HAS_TTL)
+
+    def has_pairs(self) -> bool:
+        return bool(self.flags & FLAG_HAS_PAIRS)
+
+    def is_compressed(self) -> bool:
+        return bool(self.flags & FLAG_IS_COMPRESSED)
+
+    def is_chunked_manifest(self) -> bool:
+        return bool(self.flags & FLAG_IS_CHUNK_MANIFEST)
+
+    def set_name(self, name: bytes) -> None:
+        self.name = name[:255]
+        self.flags |= FLAG_HAS_NAME
+
+    def set_mime(self, mime: bytes) -> None:
+        self.mime = mime
+        self.flags |= FLAG_HAS_MIME
+
+    def set_last_modified(self, ts: int) -> None:
+        self.last_modified = ts
+        self.flags |= FLAG_HAS_LAST_MODIFIED_DATE
+
+    def set_ttl(self, ttl: TTL) -> None:
+        self.ttl = ttl
+        if ttl:
+            self.flags |= FLAG_HAS_TTL
+
+    def set_pairs(self, pairs: bytes) -> None:
+        self.pairs = pairs
+        self.flags |= FLAG_HAS_PAIRS
+
+    def etag(self) -> str:
+        return struct.pack(">I", self.checksum).hex()
+
+    # -- serialization ---------------------------------------------------
+
+    def _body_size(self) -> int:
+        """The on-disk Size value (writeNeedleCommon:29-48)."""
+        if not self.data:
+            return 0
+        size = 4 + len(self.data) + 1
+        if self.has_name():
+            size += 1 + min(len(self.name), 255)
+        if self.has_mime():
+            size += 1 + len(self.mime)
+        if self.has_last_modified_date():
+            size += LAST_MODIFIED_BYTES_LENGTH
+        if self.has_ttl():
+            size += TTL_BYTES_LENGTH
+        if self.has_pairs():
+            size += 2 + len(self.pairs)
+        return size
+
+    def to_bytes(self, version: int = types.CURRENT_VERSION) -> bytes:
+        """Serialize the full on-disk record (header..padding)."""
+        if version not in (types.VERSION2, types.VERSION3):
+            raise ValueError(f"cannot write needle version {version}")
+        self.size = self._body_size()
+        self.checksum = crc32c(self.data)
+        parts = [struct.pack(">IQI", self.cookie, self.id,
+                             types.size_to_u32(self.size))]
+        if self.data:
+            parts.append(struct.pack(">I", len(self.data)))
+            parts.append(self.data)
+            parts.append(bytes([self.flags]))
+            if self.has_name():
+                name = self.name[:255]
+                parts.append(bytes([len(name)]))
+                parts.append(name)
+            if self.has_mime():
+                parts.append(bytes([len(self.mime)]))
+                parts.append(self.mime)
+            if self.has_last_modified_date():
+                parts.append(struct.pack(">Q", self.last_modified)[
+                    8 - LAST_MODIFIED_BYTES_LENGTH:])
+            if self.has_ttl():
+                parts.append(self.ttl.to_bytes())
+            if self.has_pairs():
+                parts.append(struct.pack(">H", len(self.pairs)))
+                parts.append(self.pairs)
+        crc_field = crc_value(self.checksum) if self.crc_legacy \
+            else self.checksum
+        parts.append(struct.pack(">I", crc_field))
+        if version == types.VERSION3:
+            parts.append(struct.pack(">Q", self.append_at_ns))
+        # Bit-identity quirk: the reference pads from a stale 24-byte
+        # scratch buffer (needle_write_v2.go writeNeedleCommon), not with
+        # zeros — v3 padding re-exposes header[12:16] (the big-endian
+        # Size field) then zeros; v2 re-exposes header[4:12] (the
+        # big-endian needle id).
+        pad = padding_length(self.size, version)
+        if version == types.VERSION3:
+            stale = struct.pack(">I", types.size_to_u32(self.size)) + \
+                b"\x00" * 4
+        else:
+            stale = struct.pack(">Q", self.id)
+        parts.append(stale[:pad])
+        return b"".join(parts)
+
+    # -- parsing ---------------------------------------------------------
+
+    @classmethod
+    def parse_header(cls, buf: bytes) -> "Needle":
+        cookie, nid, size_u32 = struct.unpack_from(">IQI", buf, 0)
+        n = cls(cookie=cookie, id=nid)
+        n.size = types.u32_to_size(size_u32)
+        return n
+
+    def parse_body(self, body: bytes, version: int,
+                   check_crc: bool = True) -> None:
+        """Parse bytes after the 16B header (body includes footer+padding);
+        mirrors ReadBytes (needle_read.go:54) for v2/v3."""
+        size = self.size
+        if version == types.VERSION1:
+            self.data = bytes(body[:size])
+        else:
+            self._parse_body_v2(body[:size])
+        tail = body[size:]
+        expected = struct.unpack(">I", tail[:4])[0]
+        if self.data:
+            actual = crc32c(self.data)
+            # pre-3.09 volumes stored crc.Value() (needle_read_tail.go:14)
+            if check_crc and expected not in (actual, crc_value(actual)):
+                raise CrcError(
+                    f"needle {self.id:x} CRC mismatch: "
+                    f"got {actual:08x}, want {expected:08x}")
+            self.crc_legacy = (expected != actual and
+                               expected == crc_value(actual))
+            self.checksum = actual
+        else:
+            self.checksum = expected
+        if version == types.VERSION3:
+            self.append_at_ns = struct.unpack(">Q", tail[4:12])[0]
+
+    def _parse_body_v2(self, b: bytes) -> None:
+        idx = 0
+        if idx < len(b):
+            (data_size,) = struct.unpack_from(">I", b, idx)
+            idx += 4
+            if data_size + idx > len(b):
+                raise ValueError("needle data out of range")
+            self.data = bytes(b[idx:idx + data_size])
+            idx += data_size
+        if idx < len(b):
+            self.flags = b[idx]
+            idx += 1
+        if idx < len(b) and self.has_name():
+            name_size = b[idx]
+            idx += 1
+            self.name = bytes(b[idx:idx + name_size])
+            idx += name_size
+        if idx < len(b) and self.has_mime():
+            mime_size = b[idx]
+            idx += 1
+            self.mime = bytes(b[idx:idx + mime_size])
+            idx += mime_size
+        if idx < len(b) and self.has_last_modified_date():
+            raw = b"\x00" * 3 + bytes(
+                b[idx:idx + LAST_MODIFIED_BYTES_LENGTH])
+            self.last_modified = struct.unpack(">Q", raw)[0]
+            idx += LAST_MODIFIED_BYTES_LENGTH
+        if idx < len(b) and self.has_ttl():
+            self.ttl = load_ttl_from_bytes(b[idx:idx + TTL_BYTES_LENGTH])
+            idx += TTL_BYTES_LENGTH
+        if idx < len(b) and self.has_pairs():
+            (pairs_size,) = struct.unpack_from(">H", b, idx)
+            idx += 2
+            self.pairs = bytes(b[idx:idx + pairs_size])
+            idx += pairs_size
+
+    @classmethod
+    def from_bytes(cls, buf: bytes, version: int,
+                   expected_size: int | None = None,
+                   check_crc: bool = True) -> "Needle":
+        """Parse one full on-disk record."""
+        n = cls.parse_header(buf)
+        if expected_size is not None and n.size != expected_size:
+            raise SizeMismatchError(
+                f"needle {n.id:x}: size {n.size} != expected "
+                f"{expected_size}")
+        n.parse_body(buf[types.NEEDLE_HEADER_SIZE:
+                         types.NEEDLE_HEADER_SIZE +
+                         needle_body_length(n.size, version)],
+                     version, check_crc=check_crc)
+        return n
+
+    def disk_size(self, version: int) -> int:
+        return get_actual_size(self.size, version)

@@ -1,0 +1,45 @@
+"""Replica placement "xyz" code (weed/storage/super_block/replica_placement.go).
+
+Encoded as one byte = dc*100 + rack*10 + node: copies on other DCs /
+other racks (same DC) / other servers (same rack).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ReplicaPlacement:
+    diff_data_center_count: int = 0
+    diff_rack_count: int = 0
+    same_rack_count: int = 0
+
+    @classmethod
+    def from_string(cls, t: str) -> "ReplicaPlacement":
+        t = (t or "").rjust(3, "0")
+        if len(t) != 3 or not t.isdigit():
+            raise ValueError(f"unknown replication type: {t!r}")
+        rp = cls(int(t[0]), int(t[1]), int(t[2]))
+        if rp.byte() > 255:
+            raise ValueError(f"unexpected replication type: {t!r}")
+        return rp
+
+    @classmethod
+    def from_byte(cls, b: int) -> "ReplicaPlacement":
+        return cls.from_string(f"{b:03d}")
+
+    def byte(self) -> int:
+        return (self.diff_data_center_count * 100 +
+                self.diff_rack_count * 10 + self.same_rack_count)
+
+    def has_replication(self) -> bool:
+        return self.byte() != 0
+
+    def copy_count(self) -> int:
+        return (self.diff_data_center_count + self.diff_rack_count +
+                self.same_rack_count + 1)
+
+    def __str__(self) -> str:
+        return (f"{self.diff_data_center_count}"
+                f"{self.diff_rack_count}{self.same_rack_count}")

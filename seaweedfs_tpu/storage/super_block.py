@@ -1,0 +1,62 @@
+"""8-byte volume superblock (weed/storage/super_block/super_block.go).
+
+Byte 0: version; byte 1: replica placement; bytes 2-3: TTL;
+bytes 4-5: compaction revision; bytes 6-7: extra-pb size (optional
+protobuf blob follows).  The extra blob is preserved opaquely.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from . import types
+from .replica_placement import ReplicaPlacement
+from .ttl import EMPTY_TTL, TTL, load_ttl_from_bytes
+
+SUPER_BLOCK_SIZE = 8
+
+
+@dataclass
+class SuperBlock:
+    version: int = types.CURRENT_VERSION
+    replica_placement: ReplicaPlacement = field(
+        default_factory=ReplicaPlacement)
+    ttl: TTL = EMPTY_TTL
+    compaction_revision: int = 0
+    extra: bytes = b""
+
+    def block_size(self) -> int:
+        return SUPER_BLOCK_SIZE + len(self.extra)
+
+    def to_bytes(self) -> bytes:
+        if len(self.extra) > 256 * 256 - 2:
+            raise ValueError("super block extra too large")
+        header = struct.pack(
+            ">BB2sHH", self.version, self.replica_placement.byte(),
+            self.ttl.to_bytes(), self.compaction_revision,
+            len(self.extra))
+        return header + self.extra
+
+    @classmethod
+    def parse(cls, data: bytes) -> "SuperBlock":
+        if len(data) < SUPER_BLOCK_SIZE:
+            raise ValueError("superblock truncated")
+        version, rp_byte = data[0], data[1]
+        ttl = load_ttl_from_bytes(data[2:4])
+        compaction_revision, extra_size = struct.unpack(">HH", data[4:8])
+        extra = bytes(data[8:8 + extra_size]) if extra_size else b""
+        if extra_size and len(extra) < extra_size:
+            raise ValueError("superblock extra truncated")
+        return cls(version, ReplicaPlacement.from_byte(rp_byte), ttl,
+                   compaction_revision, extra)
+
+    @classmethod
+    def read_from(cls, f) -> "SuperBlock":
+        f.seek(0)
+        head = f.read(SUPER_BLOCK_SIZE)
+        sb = cls.parse(head)
+        extra_size = struct.unpack(">H", head[6:8])[0]
+        if extra_size:
+            sb.extra = f.read(extra_size)
+        return sb

@@ -1,0 +1,100 @@
+"""2-byte TTL encoding (weed/storage/needle/volume_ttl.go).
+
+Stored as (count, unit) where unit escalates minute→year; ReadTTL parses
+"3m"/"4h"/"5d"/"6w"/"7M"/"8y" (bare numbers mean minutes) and
+fit_ttl_count re-normalizes seconds into the largest exact unit < 256.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+UNIT_EMPTY = 0
+UNIT_MINUTE = 1
+UNIT_HOUR = 2
+UNIT_DAY = 3
+UNIT_WEEK = 4
+UNIT_MONTH = 5
+UNIT_YEAR = 6
+
+_UNIT_SECONDS = {
+    UNIT_EMPTY: 0,
+    UNIT_MINUTE: 60,
+    UNIT_HOUR: 3600,
+    UNIT_DAY: 24 * 3600,
+    UNIT_WEEK: 7 * 24 * 3600,
+    UNIT_MONTH: 30 * 24 * 3600,
+    UNIT_YEAR: 365 * 24 * 3600,
+}
+
+_CHAR_UNIT = {"m": UNIT_MINUTE, "h": UNIT_HOUR, "d": UNIT_DAY,
+              "w": UNIT_WEEK, "M": UNIT_MONTH, "y": UNIT_YEAR}
+_UNIT_CHAR = {v: k for k, v in _CHAR_UNIT.items()}
+
+
+@dataclass(frozen=True)
+class TTL:
+    count: int = 0
+    unit: int = UNIT_EMPTY
+
+    def to_seconds(self) -> int:
+        return self.count * _UNIT_SECONDS[self.unit]
+
+    def to_bytes(self) -> bytes:
+        return bytes([self.count & 0xFF, self.unit & 0xFF])
+
+    def to_u32(self) -> int:
+        if self.count == 0:
+            return 0
+        return (self.count << 8) | self.unit
+
+    def __str__(self) -> str:
+        if self.count == 0:
+            return ""
+        return f"{self.count}{_UNIT_CHAR.get(self.unit, '')}"
+
+    def __bool__(self) -> bool:
+        return self.count != 0 and self.unit != UNIT_EMPTY
+
+
+EMPTY_TTL = TTL()
+
+
+def load_ttl_from_bytes(b: bytes) -> TTL:
+    if b[0] == 0 and b[1] == 0:
+        return EMPTY_TTL
+    return TTL(b[0], b[1])
+
+
+def load_ttl_from_u32(v: int) -> TTL:
+    return load_ttl_from_bytes(bytes([(v >> 8) & 0xFF, v & 0xFF]))
+
+
+def read_ttl(s: str) -> TTL:
+    """Parse a human TTL string (volume_ttl.go:33 ReadTTL)."""
+    if not s:
+        return EMPTY_TTL
+    unit_char = s[-1]
+    if unit_char.isdigit():
+        count, unit = int(s), UNIT_MINUTE
+    else:
+        count, unit = int(s[:-1]), _CHAR_UNIT.get(unit_char, UNIT_EMPTY)
+    return fit_ttl_count(count, unit)
+
+
+def fit_ttl_count(count: int, unit: int) -> TTL:
+    """Re-fit seconds into the largest exactly-dividing unit with
+    count < 256, else the largest unit that fits (volume_ttl.go:49)."""
+    seconds = count * _UNIT_SECONDS[unit]
+    if seconds == 0:
+        return EMPTY_TTL
+    for u in (UNIT_YEAR, UNIT_MONTH, UNIT_WEEK, UNIT_DAY, UNIT_HOUR):
+        us = _UNIT_SECONDS[u]
+        if seconds % us == 0 and seconds // us < 256:
+            return TTL(seconds // us, u)
+    if seconds // 60 < 256:
+        return TTL(seconds // 60, UNIT_MINUTE)
+    for u in (UNIT_HOUR, UNIT_DAY, UNIT_WEEK, UNIT_MONTH, UNIT_YEAR):
+        if seconds // _UNIT_SECONDS[u] < 256:
+            return TTL(seconds // _UNIT_SECONDS[u], u)
+    return EMPTY_TTL
