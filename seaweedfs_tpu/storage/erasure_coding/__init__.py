@@ -1,0 +1,16 @@
+"""Erasure coding subsystem (north star; SURVEY §2.2).
+
+File-format compatible with the reference's `.ec00..ecNN` + `.ecx` +
+`.ecj` + `.vif` contract (weed/storage/erasure_coding), with the RS math
+running on the TPU kernels in ops/ (or their CPU twin).
+"""
+
+from .ec_context import ECContext, DATA_SHARDS_COUNT, PARITY_SHARDS_COUNT, \
+    TOTAL_SHARDS_COUNT, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE  # noqa: F401
+from .ec_locate import Interval, locate_data  # noqa: F401
+from .ec_encoder import (  # noqa: F401
+    write_ec_files, write_sorted_file_from_idx, rebuild_ec_files, to_ext)
+from .ec_decoder import (  # noqa: F401
+    write_dat_file, write_idx_file_from_ec_index, find_dat_file_size,
+    has_live_needles)
+from .ec_volume import EcVolume  # noqa: F401

@@ -1,0 +1,79 @@
+"""EC scheme context and codec backend selection.
+
+Mirrors weed/storage/erasure_coding/ec_encoder.go:19-27 constants and
+ec_context.go:11-46 ECContext.  The codec backend is chosen once per
+context: "cpu" (numpy twin) or "jax" (TPU kernels) — both bit-identical
+to klauspost/reedsolomon.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+DATA_SHARDS_COUNT = 10
+PARITY_SHARDS_COUNT = 4
+TOTAL_SHARDS_COUNT = DATA_SHARDS_COUNT + PARITY_SHARDS_COUNT
+MAX_SHARD_COUNT = 32          # ShardBits is uint32
+MIN_TOTAL_DISKS = TOTAL_SHARDS_COUNT // PARITY_SHARDS_COUNT + 1
+LARGE_BLOCK_SIZE = 1024 * 1024 * 1024   # 1GB
+SMALL_BLOCK_SIZE = 1024 * 1024          # 1MB
+
+# Batch bytes per encode step (the Go path uses 256KB,
+# ec_encoder.go:61-67; any batch that divides the block size yields
+# byte-identical shard files, so the TPU path uses far larger batches
+# to amortize dispatch: geometry is preserved either way).
+CPU_BATCH_SIZE = 1024 * 1024
+TPU_BATCH_SIZE = 64 * 1024 * 1024
+
+
+def to_ext(shard_id: int) -> str:
+    """Shard file extension ".ecNN" (ec_encoder.go:107 ToExt) — single
+    definition; ECContext.to_ext delegates here."""
+    return f".ec{shard_id:02d}"
+
+
+def default_backend() -> str:
+    try:
+        import jax
+        return "jax" if jax.default_backend() == "tpu" else "cpu"
+    except Exception:  # pragma: no cover
+        return "cpu"
+
+
+@dataclass
+class ECContext:
+    """Carries the RS scheme for one volume's EC operations."""
+
+    data_shards: int = DATA_SHARDS_COUNT
+    parity_shards: int = PARITY_SHARDS_COUNT
+    collection: str = ""
+    volume_id: int = 0
+    backend: str = field(default_factory=default_backend)
+
+    @property
+    def total(self) -> int:
+        return self.data_shards + self.parity_shards
+
+    def __post_init__(self):
+        if not (0 < self.data_shards and
+                0 < self.parity_shards and
+                self.total <= MAX_SHARD_COUNT):
+            raise ValueError(
+                f"bad EC scheme {self.data_shards}+{self.parity_shards}")
+
+    def to_ext(self, shard_id: int) -> str:
+        return to_ext(shard_id)
+
+    def create_codec(self):
+        if self.backend == "jax":
+            from ...ops.rs_jax import ReedSolomonJax
+            return ReedSolomonJax(self.data_shards, self.parity_shards)
+        from ...ops.rs_cpu import ReedSolomonCPU
+        return ReedSolomonCPU(self.data_shards, self.parity_shards)
+
+    def batch_size(self, block_size: int) -> int:
+        pref = TPU_BATCH_SIZE if self.backend == "jax" else CPU_BATCH_SIZE
+        return min(pref, block_size)
+
+    def __str__(self) -> str:
+        return f"{self.data_shards}+{self.parity_shards}"

@@ -1,0 +1,203 @@
+"""EC encode/rebuild file pipeline
+(weed/storage/erasure_coding/ec_encoder.go).
+
+`.dat` -> `.ec00..ecNN`: the volume stream is striped into rows of
+data_shards blocks (1GB rows first, then 1MB rows for the tail, zero-
+padded past EOF), parity blocks are computed per row, and each block is
+appended to its shard file.  The file geometry is identical to the
+reference for ANY batch size that divides the block size — the Go path
+encodes in 256KB batches (ec_encoder.go:61), the TPU path uses 64MB
+batches to amortize device dispatch; outputs are byte-identical.
+
+Rebuild regenerates missing shards from >= data_shards survivors in
+1MB steps (ec_encoder.go:323 rebuildEcFiles).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .. import idx as idxmod
+from ..needle_map import NeedleMap
+from ..volume_info import (EcShardConfig, VolumeInfo,
+                           maybe_load_volume_info, save_volume_info)
+from .ec_context import (ECContext, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
+                         to_ext)  # noqa: F401  (re-exported)
+
+
+# --- .ecx generation ----------------------------------------------------
+
+def write_sorted_file_from_idx(base_file_name: str, ext: str = ".ecx"
+                               ) -> None:
+    """Generate the sorted needle index (ec_encoder.go:31
+    WriteSortedFileFromIdx): replay .idx through a needle map (so
+    deletes/overwrites collapse, tombstones keep TombstoneFileSize),
+    then write entries ascending by key."""
+    nm = NeedleMap()
+    with open(base_file_name + ".idx", "rb") as f:
+        for key, off, size in idxmod.walk_index(f.read()):
+            nm.put(key, off, size)
+    entries = []
+    for key, (off, size) in sorted(nm._m.items()):
+        entries.append((key, off, size))
+    with open(base_file_name + ext, "wb") as out:
+        if entries:
+            keys, offs, sizes = zip(*entries)
+            out.write(idxmod.pack_index(keys, offs, sizes))
+
+
+# --- encode -------------------------------------------------------------
+
+def write_ec_files(base_file_name: str, ctx: ECContext | None = None
+                   ) -> None:
+    """ec_encoder.go:61 WriteEcFiles / :67 WriteEcFilesWithContext."""
+    ctx = ctx or ECContext()
+    _generate_ec_files(base_file_name, ctx)
+
+
+def _generate_ec_files(base_file_name: str, ctx: ECContext) -> None:
+    dat_path = base_file_name + ".dat"
+    dat_size = os.path.getsize(dat_path)
+    codec = ctx.create_codec()
+    outputs = [open(base_file_name + ctx.to_ext(i), "wb")
+               for i in range(ctx.total)]
+    try:
+        with open(dat_path, "rb") as dat:
+            _encode_dat_file(dat, dat_size, codec, outputs, ctx)
+    finally:
+        for f in outputs:
+            f.close()
+
+
+def _encode_dat_file(dat, dat_size: int, codec, outputs, ctx: ECContext
+                     ) -> None:
+    """ec_encoder.go:280 encodeDatFile: large rows then small rows."""
+    large_row = LARGE_BLOCK_SIZE * ctx.data_shards
+    small_row = SMALL_BLOCK_SIZE * ctx.data_shards
+    remaining = dat_size
+    processed = 0
+    while remaining >= large_row:
+        _encode_rows(dat, processed, LARGE_BLOCK_SIZE, codec, outputs, ctx)
+        remaining -= large_row
+        processed += large_row
+    while remaining > 0:
+        _encode_rows(dat, processed, SMALL_BLOCK_SIZE, codec, outputs, ctx)
+        remaining -= small_row
+        processed += small_row
+
+
+def _encode_rows(dat, row_start: int, block_size: int, codec, outputs,
+                 ctx: ECContext) -> None:
+    """Encode one row (data_shards x block_size) in batches
+    (ec_encoder.go:202 encodeData / :248 encodeDataOneBatch).  Reads past
+    EOF zero-pad (ec_encoder.go:258-262)."""
+    batch = ctx.batch_size(block_size)
+    d = ctx.data_shards
+    buf = np.zeros((ctx.total, batch), dtype=np.uint8)
+    for b0 in range(0, block_size, batch):
+        buf[:] = 0
+        for i in range(d):
+            dat.seek(row_start + i * block_size + b0)
+            chunk = dat.read(batch)
+            if chunk:
+                buf[i, :len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+        parity = codec.parity(buf[:d])
+        buf[d:] = np.asarray(parity)
+        for i in range(ctx.total):
+            outputs[i].write(buf[i].tobytes())
+
+
+# --- rebuild ------------------------------------------------------------
+
+def rebuild_ec_files(base_file_name: str, ctx: ECContext | None = None,
+                     additional_dirs: list[str] | None = None
+                     ) -> list[int]:
+    """ec_encoder.go:74 RebuildEcFiles: recover the scheme from .vif,
+    then regenerate missing shard files from survivors.  Returns the
+    generated shard ids."""
+    if ctx is None:
+        vi = maybe_load_volume_info(base_file_name + ".vif")
+        if vi is not None and vi.ec_shard_config is not None and \
+                vi.ec_shard_config.data_shards:
+            ctx = ECContext(vi.ec_shard_config.data_shards,
+                            vi.ec_shard_config.parity_shards)
+        else:
+            ctx = ECContext()
+    return _generate_missing_ec_files(
+        base_file_name, ctx, additional_dirs or [])
+
+
+def _find_shard_file(base_file_name: str, ext: str,
+                     additional_dirs: list[str]) -> str | None:
+    """ec_encoder.go:131 findShardFile: primary path, then extra dirs."""
+    primary = base_file_name + ext
+    if os.path.exists(primary):
+        return primary
+    base = os.path.basename(base_file_name)
+    for d in additional_dirs:
+        cand = os.path.join(d, base + ext)
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def _generate_missing_ec_files(base_file_name: str, ctx: ECContext,
+                               additional_dirs: list[str]) -> list[int]:
+    """Two-pass discover-then-create (ec_encoder.go:146)."""
+    present_paths: dict[int, str] = {}
+    missing: list[int] = []
+    for sid in range(ctx.total):
+        p = _find_shard_file(base_file_name, ctx.to_ext(sid),
+                             additional_dirs)
+        if p is not None:
+            present_paths[sid] = p
+        else:
+            missing.append(sid)
+    if len(present_paths) < ctx.data_shards:
+        raise ValueError(
+            f"not enough shards to rebuild {base_file_name}: found "
+            f"{len(present_paths)}, need {ctx.data_shards}, "
+            f"missing {missing}")
+    if not missing:
+        return []
+    codec = ctx.create_codec()
+    shard_size = max(os.path.getsize(p) for p in present_paths.values())
+    inputs = {sid: open(p, "rb") for sid, p in present_paths.items()}
+    outputs = {sid: open(base_file_name + ctx.to_ext(sid), "wb")
+               for sid in missing}
+    present_mask = [sid in present_paths for sid in range(ctx.total)]
+    try:
+        step = ctx.batch_size(LARGE_BLOCK_SIZE)
+        pos = 0
+        while pos < shard_size:
+            n = min(step, shard_size - pos)
+            shards = np.zeros((ctx.total, n), dtype=np.uint8)
+            for sid, f in inputs.items():
+                f.seek(pos)
+                chunk = f.read(n)
+                if chunk:
+                    shards[sid, :len(chunk)] = np.frombuffer(
+                        chunk, dtype=np.uint8)
+            rec = codec.reconstruct(shards, present_mask)
+            for sid in missing:
+                outputs[sid].write(np.asarray(rec[sid]).tobytes())
+            pos += n
+    finally:
+        for f in inputs.values():
+            f.close()
+        for f in outputs.values():
+            f.close()
+    return missing
+
+
+def save_ec_volume_info(base_file_name: str, ctx: ECContext,
+                        dat_file_size: int, version: int) -> None:
+    """Persist the EC scheme to .vif so rebuild/decode can recover it
+    (server/volume_grpc_erasure_coding.go:132)."""
+    vi = maybe_load_volume_info(base_file_name + ".vif") or VolumeInfo()
+    vi.version = version
+    vi.dat_file_size = dat_file_size
+    vi.ec_shard_config = EcShardConfig(ctx.data_shards, ctx.parity_shards)
+    save_volume_info(base_file_name + ".vif", vi)

@@ -1,0 +1,243 @@
+"""Mounted EC volume (weed/storage/erasure_coding/ec_volume.go,
+ec_shard.go, ec_volume_delete.go).
+
+Holds locally-present shard files, serves needle locate via binary
+search over the sorted `.ecx`, records deletes by tombstoning `.ecx`
+in place and journaling the needle id to `.ecj`, and reads needle data
+through the striping interval math.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+from .. import idx as idxmod
+from .. import types
+from ..needle import Needle, get_actual_size, needle_body_length
+from ..super_block import SuperBlock
+from ..volume_info import maybe_load_volume_info
+from .ec_context import (DATA_SHARDS_COUNT, ECContext, LARGE_BLOCK_SIZE,
+                         PARITY_SHARDS_COUNT, SMALL_BLOCK_SIZE)
+from .ec_locate import Interval, locate_data
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class EcVolumeShard:
+    """One local .ecNN shard file (ec_shard.go)."""
+
+    def __init__(self, base_file_name: str, shard_id: int, path: str):
+        self.shard_id = shard_id
+        self.path = path
+        self._f = open(path, "rb")
+        self.size = os.path.getsize(path)
+
+    def read_at(self, offset: int, size: int) -> bytes:
+        self._f.seek(offset)
+        return self._f.read(size)
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class EcVolume:
+    """ec_volume.go:26 EcVolume: a volume mounted as EC shards."""
+
+    def __init__(self, directory: str, volume_id: int, collection: str = "",
+                 ctx: ECContext | None = None,
+                 index_directory: str | None = None):
+        self.dir = directory
+        self.index_dir = index_directory or directory
+        self.id = volume_id
+        self.collection = collection
+        self.shards: dict[int, EcVolumeShard] = {}
+        self.lock = threading.RLock()
+        base = self.base_file_name()
+        vi = maybe_load_volume_info(self.index_base_file_name() + ".vif") \
+            or maybe_load_volume_info(base + ".vif")
+        if ctx is None:
+            if vi is not None and vi.ec_shard_config is not None and \
+                    vi.ec_shard_config.data_shards:
+                ctx = ECContext(vi.ec_shard_config.data_shards,
+                                vi.ec_shard_config.parity_shards,
+                                collection, volume_id)
+            else:
+                ctx = ECContext(DATA_SHARDS_COUNT, PARITY_SHARDS_COUNT,
+                                collection, volume_id)
+        self.ctx = ctx
+        self.dat_file_size = vi.dat_file_size if vi else 0
+        self.expire_at_sec = vi.expire_at_sec if vi else 0
+        for sid in range(ctx.total):
+            p = base + ctx.to_ext(sid)
+            if os.path.exists(p):
+                self.shards[sid] = EcVolumeShard(base, sid, p)
+        ecx = self.index_base_file_name() + ".ecx"
+        self._ecx = open(ecx, "r+b") if os.path.exists(ecx) else None
+        self._ecj_path = self.index_base_file_name() + ".ecj"
+        self.version = self._read_version()
+
+    # -- naming ----------------------------------------------------------
+
+    def _name(self, d: str) -> str:
+        name = f"{self.id}"
+        if self.collection:
+            name = f"{self.collection}_{name}"
+        return os.path.join(d, name)
+
+    def base_file_name(self) -> str:
+        return self._name(self.dir)
+
+    def index_base_file_name(self) -> str:
+        return self._name(self.index_dir)
+
+    def _read_version(self) -> int:
+        shard0 = self.shards.get(0)
+        if shard0 is not None:
+            return SuperBlock.parse(shard0.read_at(0, 8)).version
+        return types.CURRENT_VERSION
+
+    @property
+    def shard_ids(self) -> list[int]:
+        return sorted(self.shards)
+
+    def shard_size(self) -> int:
+        for s in self.shards.values():
+            return s.size
+        return 0
+
+    # -- .ecx search (ec_volume.go:283-346) -------------------------------
+
+    def locate_needle(self, needle_id: int) -> tuple[int, int, list[Interval]]:
+        """LocateEcShardNeedle: returns (actual_offset, size, intervals).
+        Raises NotFoundError when absent; a tombstoned entry returns
+        size = TOMBSTONE_FILE_SIZE with no intervals."""
+        offset, size = self.search_sorted_index(needle_id)
+        if types.size_is_deleted(size):
+            return types.to_actual_offset(offset), size, []
+        shard_size = self.shard_dat_size()
+        intervals = locate_data(
+            LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, shard_size,
+            types.to_actual_offset(offset),
+            get_actual_size(size, self.version),
+            self.ctx.data_shards)
+        return types.to_actual_offset(offset), size, intervals
+
+    def shard_dat_size(self) -> int:
+        """Per-shard logical size used by the locate math — derived from
+        the shard file size (all shards are equal by construction)."""
+        return self.shard_size()
+
+    def search_sorted_index(self, needle_id: int,
+                            mark_deleted: bool = False
+                            ) -> tuple[int, int]:
+        """Binary search .ecx (ec_volume.go:319
+        SearchNeedleFromSortedIndex).  Returns (stored_offset, size)."""
+        if self._ecx is None:
+            raise NotFoundError(f"no .ecx for volume {self.id}")
+        self._ecx.seek(0, os.SEEK_END)
+        n_entries = self._ecx.tell() // types.NEEDLE_MAP_ENTRY_SIZE
+        lo, hi = 0, n_entries
+        while lo < hi:
+            mid = (lo + hi) // 2
+            self._ecx.seek(mid * types.NEEDLE_MAP_ENTRY_SIZE)
+            buf = self._ecx.read(types.NEEDLE_MAP_ENTRY_SIZE)
+            key, offset, size = struct.unpack(">QIi", buf)
+            if key == needle_id:
+                if mark_deleted:
+                    self._ecx.seek(mid * types.NEEDLE_MAP_ENTRY_SIZE +
+                                   types.NEEDLE_ID_SIZE + types.OFFSET_SIZE)
+                    self._ecx.write(struct.pack(
+                        ">i", types.TOMBSTONE_FILE_SIZE))
+                    self._ecx.flush()
+                return offset, size
+            if key < needle_id:
+                lo = mid + 1
+            else:
+                hi = mid
+        raise NotFoundError(f"needle {needle_id:x} not in ecx")
+
+    # -- delete (ec_volume_delete.go) -------------------------------------
+
+    def delete_needle(self, needle_id: int) -> None:
+        """Tombstone in .ecx + append id to .ecj journal
+        (ec_volume_delete.go:27 DeleteNeedleFromEcx)."""
+        with self.lock:
+            try:
+                self.search_sorted_index(needle_id, mark_deleted=True)
+            except NotFoundError:
+                return
+            with open(self._ecj_path, "ab") as ecj:
+                ecj.write(struct.pack(">Q", needle_id))
+
+    def rebuild_ecx_file(self) -> None:
+        """Replay .ecj tombstones into .ecx (ec_volume_delete.go:51)."""
+        if not os.path.exists(self._ecj_path):
+            return
+        with self.lock:
+            with open(self._ecj_path, "rb") as ecj:
+                while True:
+                    b = ecj.read(types.NEEDLE_ID_SIZE)
+                    if len(b) != types.NEEDLE_ID_SIZE:
+                        break
+                    try:
+                        self.search_sorted_index(
+                            int.from_bytes(b, "big"), mark_deleted=True)
+                    except NotFoundError:
+                        pass
+
+    # -- reads (local shards only; cross-server reads live in the store
+    #    layer, weed/storage/store_ec.go) --------------------------------
+
+    def read_needle_local(self, needle_id: int, cookie: int | None = None
+                          ) -> Needle:
+        """Read + decode a needle when ALL its intervals are locally
+        present (store_ec.go:141 ReadEcShardNeedle, local-only path)."""
+        _, size, intervals = self.locate_needle(needle_id)
+        if types.size_is_deleted(size):
+            raise NotFoundError(f"needle {needle_id:x} deleted")
+        data = b"".join(self.read_interval(iv) for iv in intervals)
+        n = Needle.from_bytes(data, self.version, expected_size=size)
+        if cookie is not None and n.cookie != cookie:
+            raise ValueError(f"cookie mismatch on needle {needle_id:x}")
+        return n
+
+    def read_interval(self, iv: Interval) -> bytes:
+        sid, off = iv.to_shard_id_and_offset(
+            LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, self.ctx.data_shards)
+        shard = self.shards.get(sid)
+        if shard is None:
+            raise NotFoundError(
+                f"shard {sid} of volume {self.id} not local")
+        return shard.read_at(off, iv.size)
+
+    # -- info ------------------------------------------------------------
+
+    def walk_index(self):
+        if self._ecx is None:
+            return
+        self._ecx.seek(0)
+        yield from idxmod.walk_index(self._ecx.read())
+
+    def close(self) -> None:
+        for s in self.shards.values():
+            s.close()
+        if self._ecx is not None:
+            self._ecx.close()
+
+    def destroy(self) -> None:
+        self.close()
+        base = self.base_file_name()
+        for sid in range(self.ctx.total):
+            try:
+                os.remove(base + self.ctx.to_ext(sid))
+            except FileNotFoundError:
+                pass
+        for ext in (".ecx", ".ecj", ".vif"):
+            try:
+                os.remove(self.index_base_file_name() + ext)
+            except FileNotFoundError:
+                pass

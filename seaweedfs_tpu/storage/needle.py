@@ -189,16 +189,20 @@ class Needle:
             parts.append(struct.pack(">Q", self.append_at_ns))
         # Bit-identity quirk: the reference pads from a stale 24-byte
         # scratch buffer (needle_write_v2.go writeNeedleCommon), not with
-        # zeros — v3 padding re-exposes header[12:16] (the big-endian
-        # Size field) then zeros; v2 re-exposes header[4:12] (the
-        # big-endian needle id).
+        # zeros.  v3 padding re-exposes header[12:16] (the big-endian
+        # Size field) then zeros.  v2 padding re-exposes header[4:12]:
+        # normally the big-endian needle id, but when LastModified was
+        # written the Uint64toBytes(header[0:8], ...) scratch write
+        # leaves LastModified's low-half in header[4:8].
         pad = padding_length(self.size, version)
         if version == types.VERSION3:
             stale = struct.pack(">I", types.size_to_u32(self.size)) + \
                 b"\x00" * 4
         else:
-            stale = struct.pack(">Q", self.id)
-        parts.append(stale[:pad])
+            stale = bytearray(struct.pack(">Q", self.id))
+            if self.data and self.has_last_modified_date():
+                stale[0:4] = struct.pack(">Q", self.last_modified)[4:8]
+        parts.append(bytes(stale[:pad]))
         return b"".join(parts)
 
     # -- parsing ---------------------------------------------------------

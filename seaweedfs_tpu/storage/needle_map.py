@@ -14,8 +14,6 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass
 
-import numpy as np
-
 from . import idx, types
 
 
@@ -60,11 +58,12 @@ class NeedleMap:
         m = self.metrics
         if not types.size_is_deleted(size):
             old = self._m.get(key)
+            # every put counts a file; an overwrite additionally counts
+            # the replaced record as deleted (needle_map_metric.go logPut)
+            m.file_count += 1
             if old is not None and types.size_is_valid(old[1]):
                 m.deleted_count += 1
                 m.deleted_bytes += old[1]
-            else:
-                m.file_count += 1
             self._m[key] = (offset, size)
         else:
             old = self._m.get(key)
@@ -130,10 +129,3 @@ class NeedleMap:
             self._idx_file.close()
             self._idx_file = None
 
-    def sorted_entries(self) -> np.ndarray:
-        """Live entries sorted by key (for .ecx generation,
-        ec_encoder.go:31 WriteSortedFileFromIdx)."""
-        live = [(k, o, s) for k, o, s in self.items()]
-        arr = np.array(live or np.zeros((0, 3)),
-                       dtype=np.int64).reshape(-1, 3)
-        return arr[np.argsort(arr[:, 0], kind="stable")]

@@ -39,7 +39,10 @@ class SuperBlock:
         return header + self.extra
 
     @classmethod
-    def parse(cls, data: bytes) -> "SuperBlock":
+    def parse(cls, data: bytes, require_extra: bool = True) -> "SuperBlock":
+        """Parse a superblock from `data`.  With require_extra=False a
+        buffer holding only the 8-byte header is accepted even when it
+        advertises an extra blob (callers that only need version/ttl)."""
         if len(data) < SUPER_BLOCK_SIZE:
             raise ValueError("superblock truncated")
         version, rp_byte = data[0], data[1]
@@ -47,7 +50,9 @@ class SuperBlock:
         compaction_revision, extra_size = struct.unpack(">HH", data[4:8])
         extra = bytes(data[8:8 + extra_size]) if extra_size else b""
         if extra_size and len(extra) < extra_size:
-            raise ValueError("superblock extra truncated")
+            if require_extra:
+                raise ValueError("superblock extra truncated")
+            extra = b""
         return cls(version, ReplicaPlacement.from_byte(rp_byte), ttl,
                    compaction_revision, extra)
 
@@ -55,8 +60,6 @@ class SuperBlock:
     def read_from(cls, f) -> "SuperBlock":
         f.seek(0)
         head = f.read(SUPER_BLOCK_SIZE)
-        sb = cls.parse(head)
-        extra_size = struct.unpack(">H", head[6:8])[0]
-        if extra_size:
-            sb.extra = f.read(extra_size)
-        return sb
+        extra_size = struct.unpack(">H", head[6:8])[0] \
+            if len(head) >= SUPER_BLOCK_SIZE else 0
+        return cls.parse(head + (f.read(extra_size) if extra_size else b""))

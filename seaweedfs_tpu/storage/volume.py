@@ -1,0 +1,278 @@
+"""Append-only needle volume: `.dat` + `.idx` pair.
+
+Mirrors weed/storage/volume.go / volume_write.go / volume_read.go /
+volume_vacuum.go semantics: superblock header, cookie-checked writes,
+delete-as-appended-tombstone, monotonic AppendAtNs, vacuum via shadow
+`.cpd`/`.cpx` + commit rename with compaction-revision bump.  The
+file-access locking of the Go implementation collapses to a simple
+threading.Lock here (one process, one writer).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from . import types
+from .needle import Needle, get_actual_size
+from .needle_map import NeedleMap
+from .replica_placement import ReplicaPlacement
+from .super_block import SUPER_BLOCK_SIZE, SuperBlock
+from .ttl import EMPTY_TTL, TTL
+from .volume_info import VolumeInfo, maybe_load_volume_info, save_volume_info
+
+
+class NeedleNotFound(KeyError):
+    pass
+
+
+class NeedleDeleted(KeyError):
+    pass
+
+
+class CookieMismatch(ValueError):
+    pass
+
+
+class Volume:
+    """One volume on disk: <dir>/<collection_prefix><vid>.{dat,idx,vif}."""
+
+    def __init__(self, directory: str, volume_id: int, collection: str = "",
+                 replica_placement: ReplicaPlacement | None = None,
+                 ttl: TTL = EMPTY_TTL,
+                 version: int = types.CURRENT_VERSION):
+        self.dir = directory
+        self.id = volume_id
+        self.collection = collection
+        self.lock = threading.RLock()
+        self.last_append_at_ns = 0
+        self.read_only = False
+        base = self.file_name("")
+        dat_path = base + ".dat"
+        if os.path.exists(dat_path):
+            self._dat = open(dat_path, "r+b")
+            self.super_block = SuperBlock.read_from(self._dat)
+            self._dat.seek(0, os.SEEK_END)
+        else:
+            self.super_block = SuperBlock(
+                version=version,
+                replica_placement=replica_placement or ReplicaPlacement(),
+                ttl=ttl)
+            self._dat = open(dat_path, "w+b")
+            self._dat.write(self.super_block.to_bytes())
+            self._dat.flush()
+        self.nm = NeedleMap(base + ".idx")
+        vi = maybe_load_volume_info(base + ".vif")
+        self.volume_info = vi or VolumeInfo(
+            version=self.super_block.version,
+            replication=str(self.super_block.replica_placement))
+
+    # -- naming (volume.go FileName) -------------------------------------
+
+    def file_name(self, ext: str) -> str:
+        name = f"{self.id}{ext}"
+        if self.collection:
+            name = f"{self.collection}_{name}"
+        return os.path.join(self.dir, name)
+
+    @property
+    def version(self) -> int:
+        return self.super_block.version
+
+    # -- stats -----------------------------------------------------------
+
+    def dat_size(self) -> int:
+        with self.lock:
+            self._dat.seek(0, os.SEEK_END)
+            return self._dat.tell()
+
+    def content_size(self) -> int:
+        return self.nm.content_size()
+
+    def file_count(self) -> int:
+        return self.nm.metrics.file_count
+
+    def deleted_count(self) -> int:
+        return self.nm.metrics.deleted_count
+
+    def deleted_bytes(self) -> int:
+        return self.nm.metrics.deleted_bytes
+
+    def garbage_level(self) -> float:
+        """volume_vacuum.go:22 garbageLevel."""
+        content = self.content_size()
+        if content == 0:
+            return 0.0
+        return self.deleted_bytes() / content
+
+    # -- write path (volume_write.go:112-218) ----------------------------
+
+    def _next_append_at_ns(self) -> int:
+        self.last_append_at_ns = max(time.time_ns(),
+                                     self.last_append_at_ns + 1)
+        return self.last_append_at_ns
+
+    def write_needle(self, n: Needle, check_cookie: bool = True
+                     ) -> tuple[int, int, bool]:
+        """Returns (actual_offset, size, is_unchanged).
+
+        Cookie semantics follow doWriteRequest (volume_write.go:141): an
+        overwrite must present the existing needle's cookie unless
+        check_cookie is False (replication/tail replay), which adopts it.
+        """
+        with self.lock:
+            if self.read_only:
+                raise PermissionError(f"volume {self.id} is read-only")
+            if not n.has_ttl() and self.super_block.ttl:
+                n.set_ttl(self.super_block.ttl)
+            existing = self.nm.get(n.id)
+            if existing is not None:
+                old = self._read_at(existing[0], existing[1])
+                if old.data == n.data and old.cookie == n.cookie:
+                    return types.to_actual_offset(existing[0]), \
+                        len(n.data), True
+                if n.cookie == 0 and not check_cookie:
+                    n.cookie = old.cookie
+                if old.cookie != n.cookie:
+                    raise CookieMismatch(
+                        f"mismatching cookie {n.cookie:x}")
+            n.append_at_ns = self._next_append_at_ns()
+            offset = self._append(n)
+            if types.size_is_valid(n.size):
+                self.nm.put(n.id, types.to_stored_offset(offset), n.size)
+            return offset, len(n.data), False
+
+    def _append(self, n: Needle) -> int:
+        self._dat.seek(0, os.SEEK_END)
+        offset = self._dat.tell()
+        if offset % types.NEEDLE_PADDING_SIZE != 0:
+            # realign like needle_write.go Append does on corrupt tails
+            pad = types.NEEDLE_PADDING_SIZE - (
+                offset % types.NEEDLE_PADDING_SIZE)
+            self._dat.write(b"\x00" * pad)
+            offset += pad
+        self._dat.write(n.to_bytes(self.version))
+        return offset
+
+    def delete_needle(self, n: Needle) -> int:
+        """Appends a zero-data tombstone record then tombstones the map
+        (volume_write.go:222 doDeleteRequest).  Returns freed size."""
+        with self.lock:
+            if self.read_only:
+                raise PermissionError(f"volume {self.id} is read-only")
+            existing = self.nm.get(n.id)
+            if existing is None:
+                return 0
+            size = existing[1]
+            tomb = Needle(cookie=n.cookie, id=n.id)
+            tomb.append_at_ns = self._next_append_at_ns()
+            self._append(tomb)
+            self.nm.delete(n.id)
+            return size
+
+    # -- read path (volume_read.go:21 readNeedle) ------------------------
+
+    def _read_at(self, stored_offset: int, size: int,
+                 check_crc: bool = True) -> Needle:
+        offset = types.to_actual_offset(stored_offset)
+        length = get_actual_size(size, self.version)
+        self._dat.seek(offset)
+        buf = self._dat.read(length)
+        return Needle.from_bytes(buf, self.version, expected_size=size,
+                                 check_crc=check_crc)
+
+    def read_needle(self, needle_id: int, cookie: int | None = None
+                    ) -> Needle:
+        with self.lock:
+            got = self.nm.get(needle_id)
+            if got is None:
+                raw = self.nm._m.get(needle_id)
+                if raw is not None and types.size_is_deleted(raw[1]):
+                    raise NeedleDeleted(f"needle {needle_id:x} deleted")
+                raise NeedleNotFound(f"needle {needle_id:x} not found")
+            n = self._read_at(got[0], got[1])
+            if cookie is not None and n.cookie != cookie:
+                raise CookieMismatch(
+                    f"cookie mismatch for needle {needle_id:x}")
+            if n.has_ttl() and n.has_last_modified_date():
+                ttl_sec = n.ttl.to_seconds()
+                if ttl_sec and n.last_modified + ttl_sec < time.time():
+                    raise NeedleNotFound(f"needle {needle_id:x} expired")
+            return n
+
+    # -- vacuum (volume_vacuum.go) ---------------------------------------
+
+    def compact(self) -> None:
+        """Copy live needles to shadow .cpd/.cpx
+        (volume_vacuum.go:53 CompactByVolumeData)."""
+        with self.lock:
+            cpd = self.file_name(".cpd")
+            cpx = self.file_name(".cpx")
+            # drop shadows left by a crashed previous compaction —
+            # NeedleMap would otherwise replay + append after stale entries
+            for stale in (cpd, cpx):
+                if os.path.exists(stale):
+                    os.remove(stale)
+            dst_sb = SuperBlock(
+                version=self.super_block.version,
+                replica_placement=self.super_block.replica_placement,
+                ttl=self.super_block.ttl,
+                compaction_revision=(
+                    self.super_block.compaction_revision + 1) & 0xFFFF,
+                extra=self.super_block.extra)
+            dst_nm = NeedleMap(cpx)
+            with open(cpd, "wb") as dst:
+                dst.write(dst_sb.to_bytes())
+                for key, stored_off, size in sorted(
+                        self.nm.items(), key=lambda t: t[1]):
+                    n = self._read_at(stored_off, size)
+                    new_off = dst.tell()
+                    dst.write(n.to_bytes(self.version))
+                    dst_nm.put(key, types.to_stored_offset(new_off), size)
+            dst_nm.close()
+
+    def commit_compact(self) -> None:
+        """Rename shadows over the live files and reload
+        (volume_vacuum.go:141 CommitCompact; single-writer process, so
+        the concurrent-write makeupDiff replay never has a diff)."""
+        with self.lock:
+            self.nm.close()
+            self._dat.close()
+            os.replace(self.file_name(".cpd"), self.file_name(".dat"))
+            os.replace(self.file_name(".cpx"), self.file_name(".idx"))
+            self._dat = open(self.file_name(".dat"), "r+b")
+            self.super_block = SuperBlock.read_from(self._dat)
+            self._dat.seek(0, os.SEEK_END)
+            self.nm = NeedleMap(self.file_name(".idx"))
+
+    def vacuum(self) -> None:
+        self.compact()
+        self.commit_compact()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def sync(self) -> None:
+        with self.lock:
+            self._dat.flush()
+            os.fsync(self._dat.fileno())
+            self.nm.flush()
+
+    def save_volume_info(self) -> None:
+        self.volume_info.version = self.version
+        self.volume_info.dat_file_size = self.dat_size()
+        save_volume_info(self.file_name(".vif"), self.volume_info)
+
+    def close(self) -> None:
+        with self.lock:
+            self._dat.flush()
+            self._dat.close()
+            self.nm.close()
+
+    def destroy(self) -> None:
+        self.close()
+        for ext in (".dat", ".idx", ".vif", ".cpd", ".cpx"):
+            try:
+                os.remove(self.file_name(ext))
+            except FileNotFoundError:
+                pass

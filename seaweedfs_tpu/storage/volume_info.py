@@ -1,0 +1,99 @@
+""".vif volume-info file (weed/storage/volume_info/volume_info.go).
+
+protojson-encoded VolumeInfo (pb/volume_server.proto:560-575): version,
+replication, bytesOffset, datFileSize, expireAtSec, readOnly, and the
+optional ecShardConfig that ec.rebuild uses to recover the RS scheme
+(ec_encoder.go:77-95).  Implemented as plain JSON with protojson's
+camelCase field names and default-omission so files interop with the Go
+reader — no protobuf runtime needed for this contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from . import types
+
+
+@dataclass
+class EcShardConfig:
+    data_shards: int = 0
+    parity_shards: int = 0
+
+
+@dataclass
+class VolumeInfo:
+    version: int = types.CURRENT_VERSION
+    replication: str = ""
+    bytes_offset: int = types.OFFSET_SIZE
+    dat_file_size: int = 0
+    expire_at_sec: int = 0
+    read_only: bool = False
+    ec_shard_config: EcShardConfig | None = None
+    files: list = field(default_factory=list)  # remote-tier files, opaque
+
+    def to_json(self) -> str:
+        # protojson omits default-valued fields; int64 serializes as string
+        out: dict = {}
+        if self.files:
+            out["files"] = self.files
+        if self.version:
+            out["version"] = self.version
+        if self.replication:
+            out["replication"] = self.replication
+        if self.bytes_offset:
+            out["bytesOffset"] = self.bytes_offset
+        if self.dat_file_size:
+            out["datFileSize"] = str(self.dat_file_size)
+        if self.expire_at_sec:
+            out["expireAtSec"] = str(self.expire_at_sec)
+        if self.read_only:
+            out["readOnly"] = True
+        if self.ec_shard_config is not None:
+            ec = {}
+            if self.ec_shard_config.data_shards:
+                ec["dataShards"] = self.ec_shard_config.data_shards
+            if self.ec_shard_config.parity_shards:
+                ec["parityShards"] = self.ec_shard_config.parity_shards
+            out["ecShardConfig"] = ec
+        return json.dumps(out, indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "VolumeInfo":
+        d = json.loads(text) if text.strip() else {}
+        ec = None
+        if "ecShardConfig" in d:
+            ecd = d["ecShardConfig"]
+            ec = EcShardConfig(int(ecd.get("dataShards", 0)),
+                              int(ecd.get("parityShards", 0)))
+        return cls(
+            version=int(d.get("version", 0)),
+            replication=d.get("replication", ""),
+            bytes_offset=int(d.get("bytesOffset", 0)),
+            dat_file_size=int(d.get("datFileSize", 0)),
+            expire_at_sec=int(d.get("expireAtSec", 0)),
+            read_only=bool(d.get("readOnly", False)),
+            ec_shard_config=ec,
+            files=d.get("files", []),
+        )
+
+
+def maybe_load_volume_info(path: str) -> "VolumeInfo | None":
+    """Returns None when absent or empty (volume_info.go:16
+    MaybeLoadVolumeInfo treats empty files as non-existent)."""
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        text = f.read()
+    if not text.strip():
+        return None
+    return VolumeInfo.from_json(text)
+
+
+def save_volume_info(path: str, vi: VolumeInfo) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(vi.to_json())
+    os.replace(tmp, path)

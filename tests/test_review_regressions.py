@@ -1,0 +1,62 @@
+"""Regression tests for code-review findings (round 1)."""
+
+import io
+import struct
+
+from seaweedfs_tpu.storage import types
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.needle_map import NeedleMap
+from seaweedfs_tpu.storage.super_block import SuperBlock
+from seaweedfs_tpu.storage.volume import Volume
+
+
+def test_super_block_with_extra_read_from():
+    sb = SuperBlock(extra=b"hello-extra")
+    f = io.BytesIO(sb.to_bytes())
+    back = SuperBlock.read_from(f)
+    assert back.extra == b"hello-extra"
+    assert back == sb
+    # header-only parse is allowed when extra isn't required
+    head = sb.to_bytes()[:8]
+    assert SuperBlock.parse(head, require_extra=False).version == sb.version
+
+
+def test_needle_map_overwrite_metrics():
+    nm = NeedleMap()
+    nm.put(1, 0, 100)
+    nm.put(1, 10, 200)  # overwrite
+    assert nm.metrics.file_count == 2
+    assert nm.metrics.deleted_count == 1
+    assert nm.metrics.deleted_bytes == 100
+    assert len(nm) == 1
+    assert nm.get(1) == (10, 200)
+
+
+def test_v2_padding_stale_last_modified():
+    """v2 padding re-exposes LastModified's low half when the flag is set
+    (the Go scratch buffer quirk, needle_write_v2.go)."""
+    n = Needle(cookie=1, id=0x1122334455667788, data=b"abc")
+    n.set_last_modified(0xAABBCCDD)
+    buf = n.to_bytes(types.VERSION2)
+    pad = len(buf) - (types.NEEDLE_HEADER_SIZE + n.size + 4)
+    padding = buf[-pad:]
+    want = (struct.pack(">Q", 0xAABBCCDD)[4:8] +
+            struct.pack(">Q", n.id)[4:8])[:pad]
+    assert padding == want
+    # without the flag, padding is the needle id bytes
+    m = Needle(cookie=1, id=0x1122334455667788, data=b"abc")
+    buf2 = m.to_bytes(types.VERSION2)
+    pad2 = len(buf2) - (types.NEEDLE_HEADER_SIZE + m.size + 4)
+    assert buf2[-pad2:] == struct.pack(">Q", m.id)[:pad2]
+
+
+def test_compact_discards_stale_shadow(tmp_path):
+    v = Volume(str(tmp_path), 20)
+    v.write_needle(Needle(cookie=1, id=1, data=b"live"))
+    # leave stale shadow files from a "crashed" earlier compaction
+    open(v.file_name(".cpx"), "wb").write(b"\x00" * 32)
+    open(v.file_name(".cpd"), "wb").write(b"garbage")
+    v.vacuum()
+    assert v.read_needle(1).data == b"live"
+    assert v.nm.metrics.file_count == 1
+    v.close()

@@ -1,0 +1,131 @@
+"""Volume engine tests: write/read/delete/overwrite/vacuum round-trips
+(the unit-level analog of the reference's storage tests, SURVEY §4.1)."""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.storage import types
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
+from seaweedfs_tpu.storage.ttl import read_ttl
+from seaweedfs_tpu.storage.volume import (
+    CookieMismatch, NeedleDeleted, NeedleNotFound, Volume)
+from seaweedfs_tpu.storage.volume_info import (
+    EcShardConfig, VolumeInfo, maybe_load_volume_info, save_volume_info)
+
+
+@pytest.fixture
+def vol(tmp_path):
+    v = Volume(str(tmp_path), 7, collection="col",
+               replica_placement=ReplicaPlacement.from_string("000"))
+    yield v
+    v.close()
+
+
+def test_write_read_roundtrip(vol):
+    n = Needle(cookie=0xABCD, id=1, data=b"x" * 1000)
+    off, size, unchanged = vol.write_needle(n)
+    assert not unchanged and size == 1000
+    m = vol.read_needle(1, cookie=0xABCD)
+    assert m.data == b"x" * 1000
+
+
+def test_write_same_content_is_unchanged(vol):
+    n = Needle(cookie=5, id=2, data=b"dup")
+    vol.write_needle(n)
+    _, _, unchanged = vol.write_needle(Needle(cookie=5, id=2, data=b"dup"))
+    assert unchanged
+
+
+def test_overwrite_requires_cookie(vol):
+    vol.write_needle(Needle(cookie=5, id=3, data=b"v1"))
+    with pytest.raises(CookieMismatch):
+        vol.write_needle(Needle(cookie=6, id=3, data=b"v2"))
+    vol.write_needle(Needle(cookie=5, id=3, data=b"v2"))
+    assert vol.read_needle(3).data == b"v2"
+
+
+def test_delete_and_tombstone(vol):
+    vol.write_needle(Needle(cookie=1, id=4, data=b"gone"))
+    freed = vol.delete_needle(Needle(cookie=1, id=4))
+    assert freed > 0
+    with pytest.raises(NeedleDeleted):
+        vol.read_needle(4)
+    # reopen: tombstone replays from .idx
+    vol.close()
+    v2 = Volume(vol.dir, vol.id, collection=vol.collection)
+    with pytest.raises((NeedleDeleted, NeedleNotFound)):
+        v2.read_needle(4)
+    v2.close()
+    vol._dat = open(vol.file_name(".dat"), "r+b")  # let fixture close()
+    vol.nm._idx_file = open(vol.file_name(".idx"), "r+b")
+
+
+def test_reopen_preserves_data(tmp_path):
+    v = Volume(str(tmp_path), 9)
+    v.write_needle(Needle(cookie=3, id=10, data=b"persist"))
+    v.close()
+    v2 = Volume(str(tmp_path), 9)
+    assert v2.read_needle(10).data == b"persist"
+    assert v2.version == types.CURRENT_VERSION
+    v2.close()
+
+
+def test_ttl_volume_applies_to_needles(tmp_path):
+    v = Volume(str(tmp_path), 11, ttl=read_ttl("5d"))
+    v.write_needle(Needle(cookie=1, id=1, data=b"ttl"))
+    n = v.read_needle(1)
+    assert str(n.ttl) == "5d"
+    v.close()
+
+
+def test_vacuum_reclaims_garbage(tmp_path):
+    v = Volume(str(tmp_path), 12)
+    for i in range(10):
+        v.write_needle(Needle(cookie=i, id=i + 1, data=bytes(200)))
+    for i in range(5):
+        v.delete_needle(Needle(cookie=i, id=i + 1))
+    assert v.garbage_level() > 0
+    size_before = v.dat_size()
+    rev_before = v.super_block.compaction_revision
+    v.vacuum()
+    assert v.dat_size() < size_before
+    assert v.super_block.compaction_revision == rev_before + 1
+    assert v.garbage_level() == 0
+    for i in range(5, 10):
+        assert v.read_needle(i + 1).data == bytes(200)
+    for i in range(5):
+        with pytest.raises((NeedleDeleted, NeedleNotFound)):
+            v.read_needle(i + 1)
+    v.close()
+
+
+def test_append_at_ns_monotonic(vol):
+    ids = []
+    for i in range(3):
+        vol.write_needle(Needle(cookie=1, id=100 + i, data=b"t"))
+        ids.append(vol.last_append_at_ns)
+    assert ids == sorted(ids) and len(set(ids)) == 3
+
+
+def test_volume_info_roundtrip(tmp_path):
+    p = str(tmp_path / "1.vif")
+    vi = VolumeInfo(version=3, replication="010", dat_file_size=12345,
+                    ec_shard_config=EcShardConfig(10, 4))
+    save_volume_info(p, vi)
+    back = maybe_load_volume_info(p)
+    assert back.version == 3
+    assert back.replication == "010"
+    assert back.dat_file_size == 12345
+    assert back.ec_shard_config.data_shards == 10
+    assert back.ec_shard_config.parity_shards == 4
+    # empty file behaves as absent (volume_info.go:46)
+    open(p, "w").close()
+    assert maybe_load_volume_info(p) is None
+
+
+def test_read_only_volume_rejects_writes(vol):
+    vol.read_only = True
+    with pytest.raises(PermissionError):
+        vol.write_needle(Needle(cookie=1, id=50, data=b"no"))
