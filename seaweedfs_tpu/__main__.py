@@ -1,0 +1,127 @@
+"""CLI entry: `python -m seaweedfs_tpu <command>` — the analog of the
+reference's single multi-command `weed` binary (weed/weed.go:50,
+weed/command/command.go:11-51).
+
+Commands: master, volume, server (all-in-one), shell, upload, download,
+bench.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="seaweedfs-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    m = sub.add_parser("master", help="start a master server")
+    m.add_argument("-ip", default="127.0.0.1")
+    m.add_argument("-port", type=int, default=9333)
+    m.add_argument("-volumeSizeLimitMB", type=int, default=1024)
+    m.add_argument("-defaultReplication", default="000")
+
+    v = sub.add_parser("volume", help="start a volume server")
+    v.add_argument("-ip", default="127.0.0.1")
+    v.add_argument("-port", type=int, default=8080)
+    v.add_argument("-dir", default=".", help="comma-separated data dirs")
+    v.add_argument("-mserver", default="127.0.0.1:9333")
+    v.add_argument("-max", type=int, default=8)
+    v.add_argument("-dataCenter", default="")
+    v.add_argument("-rack", default="")
+
+    s = sub.add_parser("server", help="master + volume in one process")
+    s.add_argument("-ip", default="127.0.0.1")
+    s.add_argument("-master.port", dest="master_port", type=int,
+                   default=9333)
+    s.add_argument("-volume.port", dest="volume_port", type=int,
+                   default=8080)
+    s.add_argument("-dir", default=".")
+
+    sh = sub.add_parser("shell", help="interactive admin shell")
+    sh.add_argument("-master", default="127.0.0.1:9333")
+    sh.add_argument("command", nargs="*",
+                    help="run one command and exit")
+
+    up = sub.add_parser("upload", help="upload a file")
+    up.add_argument("-master", default="127.0.0.1:9333")
+    up.add_argument("file")
+
+    down = sub.add_parser("download", help="download a fid")
+    down.add_argument("-master", default="127.0.0.1:9333")
+    down.add_argument("fid")
+
+    args = p.parse_args(argv)
+
+    if args.cmd == "master":
+        from .server.master_server import MasterServer
+        ms = MasterServer(args.ip, args.port,
+                          volume_size_limit_mb=args.volumeSizeLimitMB,
+                          default_replication=args.defaultReplication)
+        ms.start()
+        print(f"master listening on {ms.url}")
+        _wait()
+    elif args.cmd == "volume":
+        from .server.volume_server import VolumeServer
+        vs = VolumeServer(args.dir.split(","), args.mserver,
+                          host=args.ip, port=args.port,
+                          max_volume_count=args.max,
+                          data_center=args.dataCenter, rack=args.rack)
+        vs.start()
+        print(f"volume server listening on {vs.url}")
+        _wait()
+    elif args.cmd == "server":
+        from .server.master_server import MasterServer
+        from .server.volume_server import VolumeServer
+        ms = MasterServer(args.ip, args.master_port).start()
+        vs = VolumeServer([args.dir], ms.url, host=args.ip,
+                          port=args.volume_port).start()
+        print(f"master on {ms.url}, volume on {vs.url}")
+        _wait()
+    elif args.cmd == "shell":
+        from .shell import CommandEnv, run_command
+        env = CommandEnv(args.master)
+        if args.command:
+            print(run_command(env, " ".join(args.command)))
+            return 0
+        _repl(env)
+    elif args.cmd == "upload":
+        from . import operation
+        data = open(args.file, "rb").read()
+        fid = operation.submit(args.master, data, name=args.file)
+        print(fid)
+    elif args.cmd == "download":
+        from . import operation
+        sys.stdout.buffer.write(operation.read(args.master, args.fid))
+    return 0
+
+
+def _repl(env) -> None:
+    from .shell import run_command
+    while True:
+        try:
+            line = input("> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            break
+        if line in ("exit", "quit"):
+            break
+        if not line:
+            continue
+        try:
+            print(run_command(env, line))
+        except Exception as e:  # noqa: BLE001 — REPL must survive
+            print(f"error: {e}")
+
+
+def _wait() -> None:
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

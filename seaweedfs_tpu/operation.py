@@ -1,0 +1,81 @@
+"""Client SDK verbs (weed/operation/): assign, upload, submit, lookup,
+delete — the operations every gateway and tool builds on."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .server.httpd import http_bytes, http_json
+
+
+@dataclass
+class Assignment:
+    fid: str
+    url: str
+    public_url: str
+    count: int
+
+
+def assign(master: str, count: int = 1, collection: str = "",
+           replication: str = "", ttl: str = "") -> Assignment:
+    """operation/assign_file_id.go Assign."""
+    qs = f"count={count}"
+    if collection:
+        qs += f"&collection={collection}"
+    if replication:
+        qs += f"&replication={replication}"
+    if ttl:
+        qs += f"&ttl={ttl}"
+    r = http_json("GET", f"{master}/dir/assign?{qs}")
+    if "error" in r:
+        raise RuntimeError(f"assign: {r['error']}")
+    return Assignment(r["fid"], r["url"], r.get("publicUrl", r["url"]),
+                      r.get("count", count))
+
+
+def upload(url: str, fid: str, data: bytes, name: str = "",
+           mime: str = "") -> dict:
+    """operation/upload_content.go Upload."""
+    qs = f"?name={name}" if name else ""
+    headers = {"Content-Type": mime} if mime else {}
+    status, body, _ = http_bytes("POST", f"{url}/{fid}{qs}", data, headers)
+    if status >= 300:
+        raise RuntimeError(f"upload {fid} -> {status}: {body[:200]!r}")
+    import json
+    return json.loads(body)
+
+
+def submit(master: str, data: bytes, name: str = "", mime: str = "",
+           collection: str = "", replication: str = "",
+           ttl: str = "") -> str:
+    """operation/submit.go: assign + upload; returns the fid."""
+    a = assign(master, collection=collection, replication=replication,
+               ttl=ttl)
+    upload(a.url, a.fid, data, name=name, mime=mime)
+    return a.fid
+
+
+def lookup(master: str, vid: int) -> list[dict]:
+    """operation/lookup.go Lookup -> [{url, publicUrl}]."""
+    r = http_json("GET", f"{master}/dir/lookup?volumeId={vid}")
+    if "error" in r:
+        raise LookupError(r["error"])
+    return r["locations"]
+
+
+def read(master: str, fid: str) -> bytes:
+    vid = int(fid.split(",", 1)[0])
+    locs = lookup(master, vid)
+    last_err = None
+    for loc in locs:
+        status, body, _ = http_bytes("GET", f"{loc['url']}/{fid}")
+        if status == 200:
+            return body
+        last_err = f"{loc['url']} -> {status}"
+    raise RuntimeError(f"read {fid}: {last_err}")
+
+
+def delete(master: str, fid: str) -> None:
+    vid = int(fid.split(",", 1)[0])
+    for loc in lookup(master, vid):
+        http_bytes("DELETE", f"{loc['url']}/{fid}")

@@ -1,0 +1,63 @@
+"""File-id sequencers (weed/sequence/memory_sequencer.go,
+snowflake_sequencer.go): monotonically increasing needle keys."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class MemorySequencer:
+    """In-memory counter; the master checkpoints/raft-replicates it in
+    the reference — here the master persists it with its state."""
+
+    def __init__(self, start: int = 1):
+        self._counter = start
+        self._lock = threading.Lock()
+
+    def next_file_id(self, count: int = 1) -> int:
+        with self._lock:
+            start = self._counter
+            self._counter += count
+            return start
+
+    def set_max(self, seen: int) -> None:
+        with self._lock:
+            if seen > self._counter:
+                self._counter = seen + 1
+
+    def peek(self) -> int:
+        return self._counter
+
+
+class SnowflakeSequencer:
+    """41-bit ms timestamp | 10-bit machine id | 12-bit sequence
+    (weed/sequence/snowflake_sequencer.go via sony/sonyflake layout)."""
+
+    EPOCH_MS = 1_577_836_800_000  # 2020-01-01
+
+    def __init__(self, machine_id: int = 1):
+        if not 0 <= machine_id < 1024:
+            raise ValueError("machine id must fit in 10 bits")
+        self.machine_id = machine_id
+        self._lock = threading.Lock()
+        self._last_ms = 0
+        self._seq = 0
+
+    def next_file_id(self, count: int = 1) -> int:
+        with self._lock:
+            now = int(time.time() * 1000)
+            if now == self._last_ms:
+                self._seq += 1
+                if self._seq >= 4096:
+                    while now <= self._last_ms:
+                        now = int(time.time() * 1000)
+                    self._seq = 0
+            else:
+                self._seq = 0
+            self._last_ms = now
+            return (((now - self.EPOCH_MS) & ((1 << 41) - 1)) << 22) | \
+                (self.machine_id << 12) | self._seq
+
+    def set_max(self, seen: int) -> None:
+        pass  # time-ordered by construction

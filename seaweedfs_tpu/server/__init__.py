@@ -1,0 +1,12 @@
+"""Server roles: master + volume server over HTTP/JSON.
+
+The reference speaks gRPC for control and HTTP for data
+(pb/grpc_client_server.go); this image has no Python gRPC runtime, so
+the control-plane RPCs are mirrored 1:1 as JSON-over-HTTP endpoints
+carrying the same message shapes as the .proto definitions (each
+handler cites its proto counterpart).  The public data path (assign /
+upload / read) keeps the reference's HTTP API exactly.
+"""
+
+from .master_server import MasterServer  # noqa: F401
+from .volume_server import VolumeServer  # noqa: F401

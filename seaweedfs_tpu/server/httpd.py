@@ -1,0 +1,149 @@
+"""Minimal threaded HTTP server + JSON routing used by all roles.
+
+Python-idiomatic stand-in for the reference's mux+gRPC server plumbing
+(weed/server/*): handlers are (method, path-prefix) routes returning
+(status, payload).  Bodies are JSON for control endpoints and raw bytes
+for the data path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+
+class Request:
+    def __init__(self, handler: BaseHTTPRequestHandler):
+        parsed = urllib.parse.urlparse(handler.path)
+        self.method = handler.command
+        self.path = parsed.path
+        self.query = {k: v[0] for k, v in
+                      urllib.parse.parse_qs(parsed.query).items()}
+        self.headers = handler.headers
+        self._handler = handler
+        self._body: bytes | None = None
+
+    @property
+    def body(self) -> bytes:
+        if self._body is None:
+            length = int(self.headers.get("Content-Length") or 0)
+            self._body = self._handler.rfile.read(length) if length else b""
+        return self._body
+
+    def json(self) -> dict:
+        return json.loads(self.body or b"{}")
+
+
+Route = Callable[[Request], "tuple[int, object]"]
+
+
+class HttpServer:
+    """Routes: exact-path dict + prefix handlers + fallback."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.routes: dict[tuple[str, str], Route] = {}
+        self.fallback: Route | None = None
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _dispatch(self):
+                req = Request(self)
+                route = outer.routes.get((req.method, req.path))
+                try:
+                    if route is not None:
+                        status, payload = route(req)
+                    elif outer.fallback is not None:
+                        status, payload = outer.fallback(req)
+                    else:
+                        status, payload = 404, {"error": "not found"}
+                except Exception as e:  # noqa: BLE001 — server must answer
+                    status, payload = 500, {"error": str(e)}
+                if isinstance(payload, (dict, list)):
+                    body = json.dumps(payload).encode()
+                    ctype = "application/json"
+                elif isinstance(payload, tuple):
+                    body, ctype = payload
+                else:
+                    body = payload if isinstance(payload, bytes) \
+                        else str(payload).encode()
+                    ctype = "application/octet-stream"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if req.method != "HEAD":
+                    self.wfile.write(body)
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _dispatch
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        class Server(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._httpd = Server((host, port), Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def route(self, method: str, path: str, fn: Route) -> None:
+        self.routes[(method, path)] = fn
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+# --- tiny client helpers -------------------------------------------------
+
+def http_json(method: str, url: str, payload: dict | None = None,
+              timeout: float = 30.0) -> dict:
+    """JSON request; non-2xx responses return their parsed error body
+    (callers check for an "error" key, mirroring gRPC status handling)."""
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        ("http://" + url) if not url.startswith("http") else url,
+        data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        body = e.read() or b"{}"
+        try:
+            parsed = json.loads(body)
+        except ValueError:
+            parsed = {"error": body.decode(errors="replace")}
+        parsed.setdefault("error", f"HTTP {e.code}")
+        return parsed
+
+
+def http_bytes(method: str, url: str, body: bytes | None = None,
+               headers: dict | None = None, timeout: float = 60.0
+               ) -> tuple[int, bytes, dict]:
+    req = urllib.request.Request(
+        ("http://" + url) if not url.startswith("http") else url,
+        data=body, method=method, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)

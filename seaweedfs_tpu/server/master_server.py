@@ -1,0 +1,215 @@
+"""Master server: topology registry, file-id assignment, lookups, admin
+lock (weed/server/master_server.go, master_grpc_server_assign.go:49,
+master_grpc_server_volume.go; proto contract pb/master.proto:12-58).
+
+gRPC methods are mirrored as JSON-over-HTTP endpoints carrying the same
+message fields (see server/__init__.py for the transport rationale):
+
+    POST /heartbeat        <- master.proto:12 SendHeartbeat
+    GET  /dir/assign       <- master.proto:16 Assign (+ public HTTP API)
+    GET  /dir/lookup       <- master.proto:15 LookupVolume
+    GET  /dir/ec_lookup    <- master.proto:30 LookupEcVolume
+    GET  /vol/list         <- master.proto:28 VolumeList
+    POST /vol/grow         <- VolumeGrow
+    POST /cluster/lease_admin_token    <- master.proto:44 LeaseAdminToken
+    POST /cluster/release_admin_token  <- master.proto:46 ReleaseAdminToken
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+from ..sequence import MemorySequencer, SnowflakeSequencer
+from ..storage.types import FileId, format_needle_id_cookie
+from ..topology import Topology
+from .httpd import HttpServer, Request, http_json
+
+
+class MasterServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 volume_size_limit_mb: int = 1024,
+                 default_replication: str = "000",
+                 sequencer: str = "memory", pulse_seconds: float = 1.0):
+        self.topology = Topology(
+            volume_size_limit=volume_size_limit_mb * 1024 * 1024,
+            pulse_seconds=pulse_seconds)
+        self.sequencer = (SnowflakeSequencer()
+                          if sequencer == "snowflake"
+                          else MemorySequencer())
+        self.default_replication = default_replication
+        self._grow_lock = threading.Lock()
+        self._admin_token: str | None = None
+        self._admin_token_ts = 0.0
+        self._admin_lock_name = ""
+        self.http = HttpServer(host, port)
+        r = self.http.route
+        r("POST", "/heartbeat", self._heartbeat)
+        r("GET", "/dir/assign", self._assign)
+        r("POST", "/dir/assign", self._assign)
+        r("GET", "/dir/lookup", self._lookup)
+        r("GET", "/dir/ec_lookup", self._ec_lookup)
+        r("GET", "/dir/status", self._dir_status)
+        r("GET", "/vol/list", self._vol_list)
+        r("POST", "/vol/grow", self._vol_grow)
+        r("GET", "/cluster/status", self._cluster_status)
+        r("POST", "/cluster/lease_admin_token", self._lease_admin)
+        r("POST", "/cluster/release_admin_token", self._release_admin)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self):
+        self.http.start()
+        return self
+
+    def stop(self):
+        self.http.stop()
+
+    @property
+    def url(self) -> str:
+        return self.http.url
+
+    # -- handlers ---------------------------------------------------------
+
+    def _heartbeat(self, req: Request):
+        hb = req.json()
+        self.topology.register_heartbeat(hb)
+        return 200, {"volumeSizeLimit": self.topology.volume_size_limit}
+
+    def _assign(self, req: Request):
+        """master_grpc_server_assign.go:49 Assign +
+        topology.go:322 PickForWrite."""
+        count = int(req.query.get("count", 1))
+        collection = req.query.get("collection", "")
+        replication = req.query.get("replication",
+                                    self.default_replication)
+        ttl = req.query.get("ttl", "")
+        ttl_u32 = _ttl_u32(ttl)
+        try:
+            vid, nodes = self.topology.pick_for_write(
+                collection, replication, ttl_u32)
+        except LookupError:
+            try:
+                self._grow_volume(collection, replication, ttl)
+            except LookupError as e:
+                return 500, {"error": f"cannot grow volume: {e}"}
+            vid, nodes = self.topology.pick_for_write(
+                collection, replication, ttl_u32)
+        key = self.sequencer.next_file_id(count)
+        cookie = uuid.uuid4().int & 0xFFFFFFFF
+        fid = str(FileId(vid, key, cookie))
+        node = nodes[0]
+        return 200, {
+            "fid": fid,
+            "url": node.url,
+            "publicUrl": node.public_url,
+            "count": count,
+            "replicas": [{"url": n.url, "publicUrl": n.public_url}
+                         for n in nodes[1:]],
+        }
+
+    def _grow_volume(self, collection: str, replication: str, ttl: str,
+                     count: int = 1) -> list[int]:
+        """volume_growth.go: pick targets, allocate on each
+        (AllocateVolume RPC -> /admin/allocate_volume)."""
+        with self._grow_lock:
+            grown = []
+            for _ in range(count):
+                targets = self.topology.plan_growth(replication)
+                vid = self.topology.next_volume_id()
+                for node in targets:
+                    http_json("POST", f"{node.url}/admin/allocate_volume", {
+                        "volumeId": vid,
+                        "collection": collection,
+                        "replication": replication,
+                        "ttl": ttl,
+                    })
+                    # optimistic registration; heartbeat confirms
+                    from ..topology.topology import VolumeInfo
+                    from ..storage.replica_placement import ReplicaPlacement
+                    node.volumes[vid] = VolumeInfo(
+                        id=vid, collection=collection,
+                        replica_placement=ReplicaPlacement.from_string(
+                            replication or "000").byte(),
+                        ttl=_ttl_u32(ttl))
+                grown.append(vid)
+            return grown
+
+    def _lookup(self, req: Request):
+        vid_str = req.query.get("volumeId", "")
+        if "," in vid_str:  # allow full fid
+            vid_str = vid_str.split(",", 1)[0]
+        vid = int(vid_str)
+        locations = self.topology.lookup(vid)
+        if not locations:
+            return 404, {"volumeId": vid_str, "error": "volume not found"}
+        return 200, {"volumeId": vid_str, "locations": locations}
+
+    def _ec_lookup(self, req: Request):
+        """master.proto:30 LookupEcVolume."""
+        vid = int(req.query.get("volumeId", "0"))
+        shards = self.topology.lookup_ec_shards(vid)
+        if not shards:
+            return 404, {"error": f"ec volume {vid} not found"}
+        return 200, {
+            "volumeId": vid,
+            "shardIdLocations": [
+                {"url": url, "shardIds": sids}
+                for url, sids in shards.items()],
+        }
+
+    def _dir_status(self, req: Request):
+        return 200, self.topology.to_volume_list()
+
+    def _vol_list(self, req: Request):
+        """master.proto:28 VolumeList."""
+        return 200, self.topology.to_volume_list()
+
+    def _vol_grow(self, req: Request):
+        body = req.json()
+        vids = self._grow_volume(
+            body.get("collection", ""),
+            body.get("replication", self.default_replication),
+            body.get("ttl", ""), count=int(body.get("count", 1)))
+        return 200, {"volumeIds": vids}
+
+    def _cluster_status(self, req: Request):
+        nodes = self.topology.alive_nodes()
+        return 200, {
+            "isLeader": True,
+            "leader": self.url,
+            "dataNodes": [n.url for n in nodes],
+        }
+
+    # -- admin lock (master.proto:44, shell/command_lock_unlock.go) -------
+
+    ADMIN_TOKEN_TTL = 60.0
+
+    def _lease_admin(self, req: Request):
+        body = req.json()
+        now = time.time()
+        prev = int(body.get("previousToken", 0) or 0)
+        with self._grow_lock:
+            expired = now - self._admin_token_ts > self.ADMIN_TOKEN_TTL
+            renewing = self._admin_token is not None and \
+                prev == self._admin_token
+            if self._admin_token is None or expired or renewing:
+                self._admin_token = uuid.uuid4().int & 0x7FFFFFFF
+                self._admin_token_ts = now
+                self._admin_lock_name = body.get("lockName", "")
+                return 200, {"token": self._admin_token,
+                             "lockTsNs": int(now * 1e9)}
+            return 409, {"error": "already locked",
+                         "lockHolder": self._admin_lock_name}
+
+    def _release_admin(self, req: Request):
+        with self._grow_lock:
+            self._admin_token = None
+            self._admin_token_ts = 0
+        return 200, {}
+
+
+def _ttl_u32(ttl: str) -> int:
+    from ..storage.ttl import read_ttl
+    return read_ttl(ttl).to_u32() if ttl else 0

@@ -1,0 +1,422 @@
+"""Volume server: public HTTP data path + admin endpoints + heartbeat.
+
+Data path mirrors the reference's public API exactly
+(server/volume_server_handlers_write.go:19 PostHandler,
+volume_server_handlers_read.go:138 GetOrHeadHandler):
+GET/POST/DELETE on /<vid>,<fid>.
+
+Admin gRPC surface (pb/volume_server.proto) is mirrored as JSON/HTTP
+(see server/__init__.py): each handler cites its RPC.  The EC generate
+handler preserves the reference's race invariant — the .ecx is written
+BEFORE the shard files (volume_grpc_erasure_coding.go:89-98).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..storage import types
+from ..storage.erasure_coding import ECContext
+from ..storage.erasure_coding import ec_decoder, ec_encoder
+from ..storage.erasure_coding.ec_context import to_ext
+from ..storage.needle import Needle
+from ..storage.store import Store
+from .httpd import HttpServer, Request, http_bytes, http_json
+
+
+class VolumeServer:
+    def __init__(self, directories: list[str], master: str,
+                 host: str = "127.0.0.1", port: int = 0,
+                 public_url: str = "", pulse_seconds: float = 1.0,
+                 data_center: str = "", rack: str = "",
+                 max_volume_count: int = 8):
+        self.master = master
+        self.pulse_seconds = pulse_seconds
+        self.data_center = data_center
+        self.rack = rack
+        self.http = HttpServer(host, port)
+        self.store = Store(directories, ip=host, port=self.http.port,
+                           public_url=public_url or self.http.url)
+        for loc in self.store.locations:
+            loc.max_volume_count = max_volume_count
+        r = self.http.route
+        r("GET", "/status", self._status)
+        # volume admin <- volume_server.proto AllocateVolume etc.
+        r("POST", "/admin/allocate_volume", self._allocate_volume)
+        r("POST", "/admin/delete_volume", self._delete_volume)
+        r("POST", "/admin/mount_volume", self._mount_volume)
+        r("POST", "/admin/unmount_volume", self._unmount_volume)
+        r("POST", "/admin/set_readonly", self._set_readonly)
+        r("POST", "/admin/vacuum", self._vacuum)
+        r("GET", "/admin/volume_file", self._read_volume_file)
+        # EC admin <- volume_server.proto:89-108
+        r("POST", "/admin/ec/generate", self._ec_generate)
+        r("POST", "/admin/ec/mount", self._ec_mount)
+        r("POST", "/admin/ec/unmount", self._ec_unmount)
+        r("POST", "/admin/ec/copy", self._ec_copy)
+        r("POST", "/admin/ec/delete_shards", self._ec_delete_shards)
+        r("POST", "/admin/ec/rebuild", self._ec_rebuild)
+        r("POST", "/admin/ec/to_volume", self._ec_to_volume)
+        r("GET", "/admin/ec/shard_read", self._ec_shard_read)
+        r("GET", "/admin/ec/info", self._ec_info)
+        self.http.fallback = self._data_path
+        self._hb_stop = threading.Event()
+        self._hb_thread: threading.Thread | None = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self):
+        self.http.start()
+        self._heartbeat_once()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True)
+        self._hb_thread.start()
+        return self
+
+    def stop(self):
+        self._hb_stop.set()
+        self.http.stop()
+        self.store.close()
+
+    @property
+    def url(self) -> str:
+        return self.http.url
+
+    # -- heartbeat (volume_grpc_client_to_master.go:51) -------------------
+
+    def _heartbeat_once(self) -> None:
+        hb = self.store.collect_heartbeat()
+        if self.data_center:
+            hb["dataCenter"] = self.data_center
+        if self.rack:
+            hb["rack"] = self.rack
+        try:
+            http_json("POST", f"{self.master}/heartbeat", hb, timeout=5)
+        except OSError:
+            pass  # master down; retry next pulse
+
+    def _heartbeat_loop(self) -> None:
+        while not self._hb_stop.wait(self.pulse_seconds):
+            self._heartbeat_once()
+
+    # -- public data path -------------------------------------------------
+
+    def _data_path(self, req: Request):
+        fid_str = req.path.lstrip("/")
+        try:
+            fid = types.parse_file_id(fid_str)
+        except ValueError:
+            return 404, {"error": f"bad file id {fid_str!r}"}
+        if req.method in ("GET", "HEAD"):
+            return self._get_needle(fid)
+        if req.method in ("POST", "PUT"):
+            return self._put_needle(fid, req)
+        if req.method == "DELETE":
+            return self._delete_needle(fid)
+        return 405, {"error": "method not allowed"}
+
+    def _get_needle(self, fid: types.FileId):
+        try:
+            n = self.store.read_needle(fid.volume_id, fid.key,
+                                       cookie=fid.cookie)
+        except KeyError:
+            return 404, {"error": "not found"}
+        except ValueError as e:
+            return 404, {"error": str(e)}
+        mime = n.mime.decode() if n.mime else "application/octet-stream"
+        return 200, (n.data, mime)
+
+    def _put_needle(self, fid: types.FileId, req: Request):
+        n = Needle(cookie=fid.cookie, id=fid.key, data=req.body)
+        name = req.query.get("name", "")
+        if name:
+            n.set_name(name.encode())
+        mime = req.headers.get("Content-Type", "")
+        if mime and mime not in ("application/octet-stream",
+                                 "multipart/form-data"):
+            n.set_mime(mime.encode())
+        ts = req.query.get("ts")
+        n.set_last_modified(int(ts) if ts else int(time.time()))
+        try:
+            size, unchanged = self.store.write_needle(fid.volume_id, n)
+        except KeyError:
+            return 404, {"error": f"volume {fid.volume_id} not found"}
+        except PermissionError as e:
+            return 409, {"error": str(e)}
+        # synchronous replication fan-out
+        # (topology/store_replicate.go:27 ReplicatedWrite)
+        if req.query.get("type") != "replicate":
+            err = self._replicate(fid, req, "POST", req.body)
+            if err:
+                return 500, {"error": f"replication: {err}"}
+        return 201, {"name": name, "size": size, "eTag": n.etag(),
+                     "unchanged": unchanged}
+
+    def _delete_needle(self, fid: types.FileId):
+        try:
+            freed = self.store.delete_needle(
+                fid.volume_id, Needle(cookie=fid.cookie, id=fid.key))
+        except KeyError:
+            return 404, {"error": "not found"}
+        return 202, {"size": freed}
+
+    def _replicate(self, fid: types.FileId, req: Request, method: str,
+                   body: bytes | None) -> str | None:
+        """Fan out to sibling replicas, excluding self
+        (store_replicate.go:192 DistributedOperation)."""
+        v = self.store.find_volume(fid.volume_id)
+        if v is None or not v.super_block.replica_placement.byte():
+            return None
+        try:
+            locs = http_json(
+                "GET",
+                f"{self.master}/dir/lookup?volumeId={fid.volume_id}",
+                timeout=5).get("locations", [])
+        except OSError as e:
+            return str(e)
+        qs = "&".join(f"{k}={v}" for k, v in req.query.items()
+                      if k != "type")
+        for loc in locs:
+            if loc["url"] in (self.url, self.store.public_url):
+                continue
+            status, data, _ = http_bytes(
+                method,
+                f"{loc['url']}/{fid}?type=replicate" +
+                (f"&{qs}" if qs else ""),
+                body)
+            if status >= 300:
+                return f"{loc['url']} -> {status}: {data[:200]!r}"
+        return None
+
+    # -- status -----------------------------------------------------------
+
+    def _status(self, req: Request):
+        return 200, {"version": "seaweedfs-tpu/0.1",
+                     **self.store.collect_heartbeat()}
+
+    # -- volume admin -----------------------------------------------------
+
+    def _allocate_volume(self, req: Request):
+        """volume_server.proto AllocateVolume."""
+        b = req.json()
+        self.store.add_volume(
+            int(b["volumeId"]), b.get("collection", ""),
+            b.get("replication", ""), b.get("ttl", ""))
+        self._heartbeat_once()  # instant topology notify
+        return 200, {}
+
+    def _delete_volume(self, req: Request):
+        self.store.delete_volume(int(req.json()["volumeId"]))
+        self._heartbeat_once()
+        return 200, {}
+
+    def _mount_volume(self, req: Request):
+        b = req.json()
+        self.store.mount_volume(int(b["volumeId"]),
+                                b.get("collection", ""))
+        return 200, {}
+
+    def _unmount_volume(self, req: Request):
+        self.store.unmount_volume(int(req.json()["volumeId"]))
+        return 200, {}
+
+    def _set_readonly(self, req: Request):
+        b = req.json()
+        self.store.set_volume_read_only(int(b["volumeId"]),
+                                        bool(b.get("readOnly", True)))
+        return 200, {}
+
+    def _vacuum(self, req: Request):
+        """volume_server.proto VacuumVolume{Check,Compact,Commit}."""
+        v = self.store.find_volume(int(req.json()["volumeId"]))
+        if v is None:
+            return 404, {"error": "volume not found"}
+        garbage = v.garbage_level()
+        v.vacuum()
+        return 200, {"garbageRatio": garbage}
+
+    def _read_volume_file(self, req: Request):
+        """volume_server.proto:69 CopyFile equivalent: stream a byte
+        range of a volume/EC file (.dat/.idx/.ecx/.ecj/.vif/.ecNN)."""
+        vid = int(req.query["volumeId"])
+        ext = req.query["ext"]
+        collection = req.query.get("collection", "")
+        offset = int(req.query.get("offset", 0))
+        size = int(req.query.get("size", -1))
+        path = self._file_path(vid, collection, ext)
+        if path is None:
+            return 404, {"error": f"no {ext} file for volume {vid}"}
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read() if size < 0 else f.read(size)
+        return 200, data
+
+    def _file_path(self, vid: int, collection: str, ext: str
+                   ) -> str | None:
+        name = (f"{collection}_" if collection else "") + f"{vid}{ext}"
+        for loc in self.store.locations:
+            p = os.path.join(loc.directory, name)
+            if os.path.exists(p):
+                return p
+        return None
+
+    def _base_path(self, vid: int, collection: str) -> str:
+        """Base file path for volume vid on the disk holding it (or the
+        first location for new files)."""
+        for ext in (".dat", ".ecx", ".ec00"):
+            p = self._file_path(vid, collection, ext)
+            if p is not None:
+                return p[: -len(ext)]
+        name = (f"{collection}_" if collection else "") + str(vid)
+        return os.path.join(self.store.locations[0].directory, name)
+
+    # -- EC admin (volume_grpc_erasure_coding.go) -------------------------
+
+    def _ec_generate(self, req: Request):
+        """:43 VolumeEcShardsGenerate.  Invariant: write .ecx BEFORE the
+        shard files and snapshot datSize first (race rationale :89-98),
+        then persist the scheme to .vif (:132)."""
+        b = req.json()
+        vid = int(b["volumeId"])
+        collection = b.get("collection", "")
+        ctx = ECContext(
+            int(b.get("dataShards") or 10),
+            int(b.get("parityShards") or 4),
+            collection, vid)
+        v = self.store.find_volume(vid)
+        if v is None:
+            return 404, {"error": f"volume {vid} not found"}
+        if not v.read_only:
+            return 409, {"error": "volume must be readonly before encode"}
+        v.sync()
+        base = v.file_name("")
+        dat_size = v.dat_size()
+        ec_encoder.write_sorted_file_from_idx(base)      # .ecx first!
+        ec_encoder.write_ec_files(base, ctx)
+        ec_encoder.save_ec_volume_info(base, ctx, dat_size, v.version)
+        return 200, {"shardIds": list(range(ctx.total))}
+
+    def _ec_mount(self, req: Request):
+        """:443 VolumeEcShardsMount."""
+        b = req.json()
+        ev = self.store.mount_ec_shards(
+            int(b["volumeId"]), b.get("collection", ""),
+            [int(s) for s in b.get("shardIds", [])])
+        self._heartbeat_once()
+        return 200, {"shardIds": ev.shard_ids}
+
+    def _ec_unmount(self, req: Request):
+        self.store.unmount_ec_shards(int(req.json()["volumeId"]))
+        self._heartbeat_once()
+        return 200, {}
+
+    def _ec_copy(self, req: Request):
+        """:228 VolumeEcShardsCopy: pull shard/index files from the
+        source server's CopyFile endpoint."""
+        b = req.json()
+        vid = int(b["volumeId"])
+        collection = b.get("collection", "")
+        source = b["sourceDataNode"]
+        base = self._base_path(vid, collection)
+        exts = [to_ext(int(s)) for s in b.get("shardIds", [])]
+        if b.get("copyEcxFile", False):
+            exts.append(".ecx")
+        if b.get("copyEcjFile", False) :
+            exts.append(".ecj")
+        if b.get("copyVifFile", False):
+            exts.append(".vif")
+        for ext in exts:
+            status, data, _ = http_bytes(
+                "GET",
+                f"{source}/admin/volume_file?volumeId={vid}"
+                f"&collection={collection}&ext={ext}")
+            if status != 200:
+                if ext == ".ecj":  # journal may legitimately not exist
+                    continue
+                return 500, {"error":
+                             f"copy {ext} from {source}: {status}"}
+            with open(base + ext, "wb") as f:
+                f.write(data)
+        return 200, {}
+
+    def _ec_delete_shards(self, req: Request):
+        """:327 VolumeEcShardsDelete: remove local shard files; clean up
+        index files when no shards remain."""
+        b = req.json()
+        vid = int(b["volumeId"])
+        collection = b.get("collection", "")
+        base = self._base_path(vid, collection)
+        for s in b.get("shardIds", []):
+            try:
+                os.remove(base + to_ext(int(s)))
+            except FileNotFoundError:
+                pass
+        vid_has_shards = any(
+            os.path.exists(base + to_ext(s)) for s in range(32))
+        if not vid_has_shards:
+            for ext in (".ecx", ".ecj", ".vif"):
+                try:
+                    os.remove(base + ext)
+                except FileNotFoundError:
+                    pass
+            self.store.unmount_ec_shards(vid)
+        else:
+            # refresh the mounted shard set
+            self.store.mount_ec_shards(vid, collection, [])
+        self._heartbeat_once()
+        return 200, {}
+
+    def _ec_rebuild(self, req: Request):
+        """:149 VolumeEcShardsRebuild (multi-disk shard search)."""
+        b = req.json()
+        vid = int(b["volumeId"])
+        collection = b.get("collection", "")
+        base = self._base_path(vid, collection)
+        extra_dirs = [loc.directory for loc in self.store.locations]
+        generated = ec_encoder.rebuild_ec_files(
+            base, additional_dirs=extra_dirs)
+        return 200, {"rebuiltShardIds": generated}
+
+    def _ec_to_volume(self, req: Request):
+        """:586 VolumeEcShardsToVolume (decode EC -> normal volume)."""
+        b = req.json()
+        vid = int(b["volumeId"])
+        collection = b.get("collection", "")
+        base = self._base_path(vid, collection)
+        if not ec_decoder.has_live_needles(base):
+            return 400, {"error": f"volume {vid} has no live entries"}
+        dat_size = ec_decoder.find_dat_file_size(base, base)
+        shard_files = [base + to_ext(i) for i in range(10)]
+        ec_decoder.write_dat_file(base, dat_size, shard_files)
+        ec_decoder.write_idx_file_from_ec_index(base)
+        self.store.unmount_ec_shards(vid)
+        self.store.mount_volume(vid, collection)
+        self._heartbeat_once()
+        return 200, {}
+
+    def _ec_shard_read(self, req: Request):
+        """:101 VolumeEcShardRead: raw range read of one local shard."""
+        vid = int(req.query["volumeId"])
+        shard_id = int(req.query["shardId"])
+        offset = int(req.query.get("offset", 0))
+        size = int(req.query.get("size", 0))
+        ev = self.store.find_ec_volume(vid)
+        if ev is None or shard_id not in ev.shards:
+            return 404, {"error": f"shard {vid}.{shard_id} not found"}
+        return 200, ev.shards[shard_id].read_at(offset, size)
+
+    def _ec_info(self, req: Request):
+        """:688 VolumeEcShardsInfo."""
+        vid = int(req.query["volumeId"])
+        ev = self.store.find_ec_volume(vid)
+        if ev is None:
+            return 404, {"error": f"ec volume {vid} not mounted"}
+        return 200, {
+            "volumeId": vid,
+            "shardIds": ev.shard_ids,
+            "shardSize": ev.shard_size(),
+            "dataShards": ev.ctx.data_shards,
+            "parityShards": ev.ctx.parity_shards,
+        }

@@ -1,0 +1,4 @@
+"""Interactive shell / command layer (weed/shell): cluster-lock-gated
+maintenance commands driving master + volume servers."""
+
+from .commands import CommandEnv, COMMANDS, run_command  # noqa: F401

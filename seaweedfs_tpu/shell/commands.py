@@ -1,0 +1,422 @@
+"""Shell commands (weed/shell/command_*.go).
+
+Implemented commands (north-star set, SURVEY §3.3):
+  volume.list, volume.vacuum, volume.delete, volume.mount, volume.unmount
+  ec.encode, ec.decode, ec.rebuild, ec.balance
+  lock, unlock, cluster.check
+
+Commands run against a CommandEnv holding the master address and the
+cluster admin lock token (shell/command_lock_unlock.go semantics:
+mutating commands require the lock).
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent.futures import ThreadPoolExecutor
+
+from ..server.httpd import http_bytes, http_json
+from ..storage.erasure_coding.ec_context import to_ext
+
+COMMANDS: dict[str, "callable"] = {}
+
+
+def command(name):
+    def reg(fn):
+        COMMANDS[name] = fn
+        fn.command_name = name
+        return fn
+    return reg
+
+
+class CommandEnv:
+    def __init__(self, master: str):
+        self.master = master
+        self.admin_token: int | None = None
+
+    # -- admin lock (command_lock_unlock.go) ------------------------------
+
+    def lock(self) -> None:
+        r = http_json("POST", f"{self.master}/cluster/lease_admin_token",
+                      {"previousToken": self.admin_token or 0,
+                       "lockName": "admin"})
+        if "token" not in r:
+            raise RuntimeError(f"cannot acquire cluster lock: {r}")
+        self.admin_token = r["token"]
+
+    def unlock(self) -> None:
+        http_json("POST", f"{self.master}/cluster/release_admin_token",
+                  {"previousToken": self.admin_token or 0})
+        self.admin_token = None
+
+    def confirm_is_locked(self) -> None:
+        """command_ec_encode.go:104 confirmIsLocked equivalent."""
+        if self.admin_token is None:
+            raise RuntimeError(
+                "lock is lost, or it is not locked; run `lock` first")
+
+    def volume_list(self) -> dict:
+        return http_json("GET", f"{self.master}/vol/list")
+
+    def volume_locations(self, vid: int) -> list[dict]:
+        r = http_json("GET", f"{self.master}/dir/lookup?volumeId={vid}")
+        return r.get("locations", [])
+
+
+# --- basic commands ------------------------------------------------------
+
+@command("lock")
+def cmd_lock(env: CommandEnv, args: list[str]) -> str:
+    env.lock()
+    return "locked"
+
+
+@command("unlock")
+def cmd_unlock(env: CommandEnv, args: list[str]) -> str:
+    env.unlock()
+    return "unlocked"
+
+
+@command("volume.list")
+def cmd_volume_list(env: CommandEnv, args: list[str]) -> str:
+    """shell/command_volume_list.go."""
+    return json.dumps(env.volume_list(), indent=2)
+
+
+@command("cluster.check")
+def cmd_cluster_check(env: CommandEnv, args: list[str]) -> str:
+    r = http_json("GET", f"{env.master}/cluster/status")
+    return json.dumps(r, indent=2)
+
+
+@command("volume.vacuum")
+def cmd_volume_vacuum(env: CommandEnv, args: list[str]) -> str:
+    """shell/command_volume_vacuum.go: compact all (or one) volume."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    target_vid = int(opts["volumeId"]) if "volumeId" in opts else None
+    done = []
+    for vid, urls in _volumes_by_id(env).items():
+        if target_vid is not None and vid != target_vid:
+            continue
+        for url in urls:
+            http_json("POST", f"{url}/admin/vacuum", {"volumeId": vid})
+        done.append(vid)
+    return f"vacuumed volumes: {sorted(done)}"
+
+
+# --- EC commands (the north-star pipeline, command_ec_encode.go:86) ------
+
+@command("ec.encode")
+def cmd_ec_encode(env: CommandEnv, args: list[str]) -> str:
+    """shell/command_ec_encode.go:86 Do:
+    select volumes -> mark readonly -> generate shards on the source
+    server (ecx first) -> mount -> balance across servers -> delete
+    originals."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    data_shards = int(opts.get("dataShards", 10))
+    parity_shards = int(opts.get("parityShards", 4))
+    vids = _select_volumes(env, opts)
+    if not vids:
+        return "no volumes qualify for ec encoding"
+    out = []
+    for vid in vids:
+        out.append(_do_ec_encode(env, vid, data_shards, parity_shards,
+                                 opts))
+    return "\n".join(out)
+
+
+def _do_ec_encode(env: CommandEnv, vid: int, data_shards: int,
+                  parity_shards: int, opts: dict) -> str:
+    # pre-collect locations before mutating (race fix,
+    # command_ec_encode.go:160-166)
+    locations = env.volume_locations(vid)
+    if not locations:
+        raise RuntimeError(f"volume {vid} has no locations")
+    collection = opts.get("collection", "")
+    # 1. mark all replicas readonly (:250)
+    for loc in locations:
+        http_json("POST", f"{loc['url']}/admin/set_readonly",
+                  {"volumeId": vid, "readOnly": True})
+    # 2. generate EC shards on the first replica (:359)
+    source = locations[0]["url"]
+    r = http_json("POST", f"{source}/admin/ec/generate", {
+        "volumeId": vid, "collection": collection,
+        "dataShards": data_shards, "parityShards": parity_shards})
+    if "error" in r:
+        raise RuntimeError(f"generate on {source}: {r['error']}")
+    total = data_shards + parity_shards
+    # 3. mount all shards on source (:314)
+    http_json("POST", f"{source}/admin/ec/mount", {
+        "volumeId": vid, "collection": collection,
+        "shardIds": list(range(total))})
+    # 4. spread shards across servers (EcBalance, :199)
+    moved = _balance_ec_volume(env, vid, collection, total)
+    # 5. delete original volume replicas (:329)
+    for loc in locations:
+        http_json("POST", f"{loc['url']}/admin/delete_volume",
+                  {"volumeId": vid})
+    return (f"volume {vid}: encoded {total} shards on {source}, "
+            f"moved {moved} shards, deleted originals")
+
+
+def _balance_ec_volume(env: CommandEnv, vid: int, collection: str,
+                       total: int) -> int:
+    """Spread one volume's shards across servers: dedupe, then even out
+    per-node shard counts (the core of command_ec_common.go:59-124's
+    balance pseudocode; rack-awareness lands with the full balancer)."""
+    shard_locs = _ec_shard_locations(env, vid)
+    nodes = _all_node_urls(env)
+    if not nodes:
+        return 0
+    moved = 0
+    # dedupe: keep first copy of each shard
+    seen: dict[int, str] = {}
+    for url, sids in sorted(shard_locs.items()):
+        for sid in sids:
+            if sid in seen:
+                _delete_shards(url, vid, collection, [sid])
+                moved += 1
+            else:
+                seen[sid] = url
+    # even out: move shards from over-loaded to under-loaded nodes
+    target_per_node = max(1, -(-total // len(nodes)))  # ceil
+    load: dict[str, list[int]] = {n: [] for n in nodes}
+    for sid, url in seen.items():
+        load.setdefault(url, []).append(sid)
+    donors = sorted(((u, s) for u, s in load.items()
+                     if len(s) > target_per_node),
+                    key=lambda t: -len(t[1]))
+    for donor_url, sids in donors:
+        while len(sids) > target_per_node:
+            receivers = sorted(load.items(), key=lambda t: len(t[1]))
+            recv_url, recv_sids = receivers[0]
+            if recv_url == donor_url or \
+                    len(recv_sids) >= target_per_node:
+                break
+            sid = sids.pop()
+            _move_shard(env, vid, collection, sid, donor_url, recv_url)
+            recv_sids.append(sid)
+            moved += 1
+    return moved
+
+
+def _move_shard(env: CommandEnv, vid: int, collection: str, sid: int,
+                source: str, dest: str) -> None:
+    """command_ec_common.go:336 oneServerCopyAndMountEcShardsFromSource:
+    copy (+ecx/ecj/vif), mount on dest, delete+unmount on source."""
+    http_json("POST", f"{dest}/admin/ec/copy", {
+        "volumeId": vid, "collection": collection, "shardIds": [sid],
+        "sourceDataNode": source, "copyEcxFile": True,
+        "copyEcjFile": True, "copyVifFile": True})
+    http_json("POST", f"{dest}/admin/ec/mount",
+              {"volumeId": vid, "collection": collection,
+               "shardIds": [sid]})
+    _delete_shards(source, vid, collection, [sid])
+
+
+def _delete_shards(url: str, vid: int, collection: str,
+                   sids: list[int]) -> None:
+    """The server refreshes its mounted shard set + heartbeat itself."""
+    http_json("POST", f"{url}/admin/ec/delete_shards",
+              {"volumeId": vid, "collection": collection,
+               "shardIds": sids})
+
+
+@command("ec.decode")
+def cmd_ec_decode(env: CommandEnv, args: list[str]) -> str:
+    """shell/command_ec_decode.go:64: collect all shards onto one server,
+    decode back to a normal volume, drop shards elsewhere."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    vid = int(opts["volumeId"])
+    collection = opts.get("collection", "")
+    shard_locs = _ec_shard_locations(env, vid)
+    if not shard_locs:
+        return f"volume {vid} has no ec shards"
+    # choose the server with the most shards as decode target
+    target = max(shard_locs, key=lambda u: len(shard_locs[u]))
+    have = set(shard_locs[target])
+    for url, sids in shard_locs.items():
+        if url == target:
+            continue
+        need = [s for s in sids if s not in have]
+        if need:
+            http_json("POST", f"{target}/admin/ec/copy", {
+                "volumeId": vid, "collection": collection,
+                "shardIds": need, "sourceDataNode": url,
+                "copyEcxFile": False, "copyEcjFile": True,
+                "copyVifFile": False})
+            have.update(need)
+    r = http_json("POST", f"{target}/admin/ec/to_volume",
+                  {"volumeId": vid, "collection": collection})
+    if "error" in r:
+        raise RuntimeError(f"decode: {r['error']}")
+    # remove shards from all other servers
+    for url, sids in shard_locs.items():
+        if url != target:
+            _delete_shards(url, vid, collection, sids)
+    return f"volume {vid}: decoded to normal volume on {target}"
+
+
+@command("ec.rebuild")
+def cmd_ec_rebuild(env: CommandEnv, args: list[str]) -> str:
+    """shell/command_ec_rebuild.go:83: for each ec volume missing shards,
+    collect survivors onto one rebuilder node, rebuild, re-spread."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    vids = ([int(opts["volumeId"])] if "volumeId" in opts
+            else list(_ec_volumes(env)))
+    out = []
+    for vid in vids:
+        out.append(_rebuild_one(env, vid, opts.get("collection", "")))
+    return "\n".join(out) if out else "no ec volumes"
+
+
+def _rebuild_one(env: CommandEnv, vid: int, collection: str) -> str:
+    shard_locs = _ec_shard_locations(env, vid)
+    present = sorted({s for sids in shard_locs.values() for s in sids})
+    info = None
+    for url in shard_locs:
+        r = http_json("GET", f"{url}/admin/ec/info?volumeId={vid}")
+        if "error" not in r:
+            info = r
+            break
+    if info is None:
+        return f"volume {vid}: no reachable shards"
+    total = info["dataShards"] + info["parityShards"]
+    missing = [s for s in range(total) if s not in present]
+    if not missing:
+        return f"volume {vid}: all {total} shards present"
+    # rebuilder = node with most shards; pull survivors it lacks
+    rebuilder = max(shard_locs, key=lambda u: len(shard_locs[u]))
+    have = set(shard_locs[rebuilder])
+    for url, sids in shard_locs.items():
+        if url == rebuilder:
+            continue
+        need = [s for s in sids if s not in have]
+        if need:
+            http_json("POST", f"{rebuilder}/admin/ec/copy", {
+                "volumeId": vid, "collection": collection,
+                "shardIds": need, "sourceDataNode": url,
+                "copyEcxFile": True, "copyEcjFile": True,
+                "copyVifFile": True})
+            have.update(need)
+    r = http_json("POST", f"{rebuilder}/admin/ec/rebuild",
+                  {"volumeId": vid, "collection": collection})
+    if "error" in r:
+        raise RuntimeError(f"rebuild: {r['error']}")
+    http_json("POST", f"{rebuilder}/admin/ec/mount",
+              {"volumeId": vid, "collection": collection,
+               "shardIds": r["rebuiltShardIds"]})
+    moved = _balance_ec_volume(env, vid, collection, total)
+    return (f"volume {vid}: rebuilt shards {r['rebuiltShardIds']} on "
+            f"{rebuilder}, rebalanced {moved}")
+
+
+@command("ec.balance")
+def cmd_ec_balance(env: CommandEnv, args: list[str]) -> str:
+    """shell/command_ec_balance.go."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    collection = opts.get("collection", "")
+    out = []
+    for vid in _ec_volumes(env):
+        info = None
+        for url in _ec_shard_locations(env, vid):
+            r = http_json("GET", f"{url}/admin/ec/info?volumeId={vid}")
+            if "error" not in r:
+                info = r
+                break
+        total = (info["dataShards"] + info["parityShards"]) if info else 14
+        moved = _balance_ec_volume(env, vid, collection, total)
+        out.append(f"volume {vid}: moved {moved} shards")
+    return "\n".join(out) if out else "no ec volumes"
+
+
+# --- helpers -------------------------------------------------------------
+
+def _must(r: dict, what: str) -> dict:
+    if isinstance(r, dict) and r.get("error"):
+        raise RuntimeError(f"{what}: {r['error']}")
+    return r
+
+
+def _parse_flags(args: list[str]) -> dict:
+    """-volumeId=3 -collection=x style flags."""
+    out = {}
+    for a in args:
+        if a.startswith("-") and "=" in a:
+            k, v = a[1:].split("=", 1)
+            out[k] = v
+        elif a.startswith("-"):
+            out[a[1:]] = "true"
+    return out
+
+
+def _volumes_by_id(env: CommandEnv) -> dict[int, list[str]]:
+    vl = env.volume_list()
+    out: dict[int, list[str]] = {}
+    for dc in vl.get("dataCenters", {}).values():
+        for rack in dc.get("racks", {}).values():
+            for node in rack.get("nodes", []):
+                for v in node.get("volumes", []):
+                    out.setdefault(v["id"], []).append(node["url"])
+    return out
+
+
+def _ec_volumes(env: CommandEnv) -> dict[int, None]:
+    vl = env.volume_list()
+    out: dict[int, None] = {}
+    for dc in vl.get("dataCenters", {}).values():
+        for rack in dc.get("racks", {}).values():
+            for node in rack.get("nodes", []):
+                for e in node.get("ecShards", []):
+                    out[e["volumeId"]] = None
+    return out
+
+
+def _ec_shard_locations(env: CommandEnv, vid: int) -> dict[str, list[int]]:
+    r = http_json("GET", f"{env.master}/dir/ec_lookup?volumeId={vid}")
+    if "error" in r:
+        return {}
+    return {loc["url"]: loc["shardIds"]
+            for loc in r.get("shardIdLocations", [])}
+
+
+def _all_node_urls(env: CommandEnv) -> list[str]:
+    r = http_json("GET", f"{env.master}/cluster/status")
+    return r.get("dataNodes", [])
+
+
+def _select_volumes(env: CommandEnv, opts: dict) -> list[int]:
+    """command_ec_encode.go:375 collectVolumeIdsForEcEncode (simplified:
+    explicit -volumeId, or all volumes of -collection)."""
+    if "volumeId" in opts:
+        return [int(opts["volumeId"])]
+    collection = opts.get("collection")
+    if collection is None:
+        return []
+    vl = env.volume_list()
+    vids = []
+    for dc in vl.get("dataCenters", {}).values():
+        for rack in dc.get("racks", {}).values():
+            for node in rack.get("nodes", []):
+                for v in node.get("volumes", []):
+                    if v.get("collection", "") == (
+                            "" if collection == "ALL" else collection):
+                        vids.append(v["id"])
+    return sorted(set(vids))
+
+
+def run_command(env: CommandEnv, line: str) -> str:
+    parts = line.split()
+    if not parts:
+        return ""
+    name, args = parts[0], parts[1:]
+    fn = COMMANDS.get(name)
+    if fn is None:
+        raise ValueError(
+            f"unknown command {name!r}; known: {sorted(COMMANDS)}")
+    return fn(env, args)

@@ -20,7 +20,7 @@ import os
 import numpy as np
 
 from .. import idx as idxmod
-from ..needle_map import NeedleMap
+from .. import types
 from ..volume_info import (EcShardConfig, VolumeInfo,
                            maybe_load_volume_info, save_volume_info)
 from .ec_context import (ECContext, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
@@ -32,19 +32,23 @@ from .ec_context import (ECContext, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
 def write_sorted_file_from_idx(base_file_name: str, ext: str = ".ecx"
                                ) -> None:
     """Generate the sorted needle index (ec_encoder.go:31
-    WriteSortedFileFromIdx): replay .idx through a needle map (so
-    deletes/overwrites collapse, tombstones keep TombstoneFileSize),
-    then write entries ascending by key."""
-    nm = NeedleMap()
+    WriteSortedFileFromIdx): replay .idx with memdb semantics — a delete
+    REMOVES the key entirely (readNeedleMap ec_encoder.go:387-393 routes
+    tombstones through MemDb.Delete), so pre-encode deletes never appear
+    in .ecx — then write live entries ascending by key."""
+    live: dict[int, tuple[int, int]] = {}
     with open(base_file_name + ".idx", "rb") as f:
         for key, off, size in idxmod.walk_index(f.read()):
-            nm.put(key, off, size)
-    entries = []
-    for key, (off, size) in sorted(nm._m.items()):
-        entries.append((key, off, size))
+            if off != 0 and not types.size_is_deleted(size):
+                live[key] = (off, size)
+            else:
+                live.pop(key, None)
+    entries = sorted(live.items())
     with open(base_file_name + ext, "wb") as out:
         if entries:
-            keys, offs, sizes = zip(*entries)
+            keys = [k for k, _ in entries]
+            offs = [o for _, (o, _) in entries]
+            sizes = [s for _, (_, s) in entries]
             out.write(idxmod.pack_index(keys, offs, sizes))
 
 

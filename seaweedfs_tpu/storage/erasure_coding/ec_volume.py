@@ -78,7 +78,7 @@ class EcVolume:
         ecx = self.index_base_file_name() + ".ecx"
         self._ecx = open(ecx, "r+b") if os.path.exists(ecx) else None
         self._ecj_path = self.index_base_file_name() + ".ecj"
-        self.version = self._read_version()
+        self.version = self._read_version(vi)
 
     # -- naming ----------------------------------------------------------
 
@@ -94,10 +94,16 @@ class EcVolume:
     def index_base_file_name(self) -> str:
         return self._name(self.index_dir)
 
-    def _read_version(self) -> int:
+    def _read_version(self, vi) -> int:
+        """Version from .vif when recorded (the authoritative source,
+        ec_volume.go:84-87), else the superblock at the head of a local
+        shard 0, else the current default."""
+        if vi is not None and vi.version:
+            return vi.version
         shard0 = self.shards.get(0)
         if shard0 is not None:
-            return SuperBlock.parse(shard0.read_at(0, 8)).version
+            return SuperBlock.parse(shard0.read_at(0, 8),
+                                    require_extra=False).version
         return types.CURRENT_VERSION
 
     @property
@@ -127,17 +133,29 @@ class EcVolume:
         return types.to_actual_offset(offset), size, intervals
 
     def shard_dat_size(self) -> int:
-        """Per-shard logical size used by the locate math — derived from
-        the shard file size (all shards are equal by construction)."""
-        return self.shard_size()
+        """Per-shard logical size for the locate math
+        (ec_volume.go:295-308 LocateEcShardNeedleInterval): datFileSize
+        from .vif is authoritative; the fallback subtracts 1 from the
+        shard file size to disambiguate an exact large-block multiple
+        that actually holds small blocks."""
+        if self.dat_file_size > 0:
+            return self.dat_file_size // self.ctx.data_shards
+        return self.shard_size() - 1
 
     def search_sorted_index(self, needle_id: int,
                             mark_deleted: bool = False
                             ) -> tuple[int, int]:
         """Binary search .ecx (ec_volume.go:319
-        SearchNeedleFromSortedIndex).  Returns (stored_offset, size)."""
+        SearchNeedleFromSortedIndex).  Returns (stored_offset, size).
+        Holds the volume lock: the shared file handle's seek/read pairs
+        must not interleave across threads."""
         if self._ecx is None:
             raise NotFoundError(f"no .ecx for volume {self.id}")
+        with self.lock:
+            return self._search_locked(needle_id, mark_deleted)
+
+    def _search_locked(self, needle_id: int, mark_deleted: bool
+                       ) -> tuple[int, int]:
         self._ecx.seek(0, os.SEEK_END)
         n_entries = self._ecx.tell() // types.NEEDLE_MAP_ENTRY_SIZE
         lo, hi = 0, n_entries
@@ -212,15 +230,18 @@ class EcVolume:
         if shard is None:
             raise NotFoundError(
                 f"shard {sid} of volume {self.id} not local")
-        return shard.read_at(off, iv.size)
+        with self.lock:  # shared handle: seek/read must not interleave
+            return shard.read_at(off, iv.size)
 
     # -- info ------------------------------------------------------------
 
     def walk_index(self):
         if self._ecx is None:
             return
-        self._ecx.seek(0)
-        yield from idxmod.walk_index(self._ecx.read())
+        with self.lock:
+            self._ecx.seek(0)
+            buf = self._ecx.read()
+        yield from idxmod.walk_index(buf)
 
     def close(self) -> None:
         for s in self.shards.values():

@@ -1,0 +1,250 @@
+"""Store: the per-volume-server storage manager
+(weed/storage/store.go, disk_location.go).
+
+Owns one or more disk locations (one per -dir), loads/creates volumes
+and mounted EC shards, routes needle reads/writes by volume id, and
+assembles the heartbeat snapshot the master consumes.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import threading
+
+from . import types
+from .erasure_coding import ECContext, EcVolume
+from .erasure_coding.ec_context import to_ext
+from .needle import Needle
+from .replica_placement import ReplicaPlacement
+from .ttl import EMPTY_TTL, read_ttl
+from .volume import Volume
+
+_VOL_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.dat$")
+_EC_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.ec00$")
+
+
+class DiskLocation:
+    """One storage directory (weed/storage/disk_location.go)."""
+
+    def __init__(self, directory: str, max_volume_count: int = 8,
+                 index_directory: str | None = None):
+        self.directory = os.path.abspath(directory)
+        self.index_directory = index_directory or self.directory
+        self.max_volume_count = max_volume_count
+        self.volumes: dict[int, Volume] = {}
+        self.ec_volumes: dict[int, EcVolume] = {}
+        os.makedirs(self.directory, exist_ok=True)
+
+    def load_existing(self) -> None:
+        for path in glob.glob(os.path.join(self.directory, "*.dat")):
+            m = _VOL_RE.match(os.path.basename(path))
+            if not m:
+                continue
+            vid = int(m.group("vid"))
+            self.volumes[vid] = Volume(
+                self.directory, vid, collection=m.group("col") or "")
+        for path in glob.glob(os.path.join(self.directory, "*.ec00")):
+            m = _EC_RE.match(os.path.basename(path))
+            if not m:
+                continue
+            vid = int(m.group("vid"))
+            self.ec_volumes[vid] = EcVolume(
+                self.directory, vid, collection=m.group("col") or "")
+
+
+class Store:
+    """storage/store.go:88 NewStore."""
+
+    def __init__(self, directories: list[str], ip: str = "localhost",
+                 port: int = 0, public_url: str = ""):
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url or f"{ip}:{port}"
+        self.locations = [DiskLocation(d) for d in directories]
+        self.lock = threading.RLock()
+        for loc in self.locations:
+            loc.load_existing()
+
+    # -- volume lookup ----------------------------------------------------
+
+    def find_volume(self, vid: int) -> Volume | None:
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                return v
+        return None
+
+    def find_ec_volume(self, vid: int) -> EcVolume | None:
+        for loc in self.locations:
+            ev = loc.ec_volumes.get(vid)
+            if ev is not None:
+                return ev
+        return None
+
+    def _location_for_new_volume(self) -> DiskLocation:
+        best, slack = None, -1
+        for loc in self.locations:
+            s = loc.max_volume_count - len(loc.volumes)
+            if s > slack:
+                best, slack = loc, s
+        if best is None:
+            raise RuntimeError("no disk locations")
+        return best
+
+    # -- volume admin -----------------------------------------------------
+
+    def add_volume(self, vid: int, collection: str = "",
+                   replication: str = "", ttl: str = "") -> Volume:
+        with self.lock:
+            if self.find_volume(vid) is not None:
+                raise ValueError(f"volume {vid} already exists")
+            loc = self._location_for_new_volume()
+            v = Volume(
+                loc.directory, vid, collection=collection,
+                replica_placement=ReplicaPlacement.from_string(replication),
+                ttl=read_ttl(ttl) if ttl else EMPTY_TTL)
+            loc.volumes[vid] = v
+            return v
+
+    def delete_volume(self, vid: int) -> None:
+        with self.lock:
+            for loc in self.locations:
+                v = loc.volumes.pop(vid, None)
+                if v is not None:
+                    v.destroy()
+                    return
+            raise KeyError(f"volume {vid} not found")
+
+    def unmount_volume(self, vid: int) -> None:
+        with self.lock:
+            for loc in self.locations:
+                v = loc.volumes.pop(vid, None)
+                if v is not None:
+                    v.close()
+                    return
+            raise KeyError(f"volume {vid} not found")
+
+    def mount_volume(self, vid: int, collection: str = "") -> Volume:
+        with self.lock:
+            for loc in self.locations:
+                base = os.path.join(
+                    loc.directory,
+                    (f"{collection}_" if collection else "") + f"{vid}.dat")
+                if os.path.exists(base):
+                    v = Volume(loc.directory, vid, collection=collection)
+                    loc.volumes[vid] = v
+                    return v
+            raise KeyError(f"volume {vid} files not found")
+
+    def set_volume_read_only(self, vid: int, read_only: bool) -> None:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        v.read_only = read_only
+
+    # -- needle IO (store.go:580/:604) ------------------------------------
+
+    def write_needle(self, vid: int, n: Needle,
+                     check_cookie: bool = True) -> tuple[int, bool]:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        _, size, unchanged = v.write_needle(n, check_cookie=check_cookie)
+        return size, unchanged
+
+    def read_needle(self, vid: int, needle_id: int,
+                    cookie: int | None = None) -> Needle:
+        v = self.find_volume(vid)
+        if v is not None:
+            return v.read_needle(needle_id, cookie=cookie)
+        ev = self.find_ec_volume(vid)
+        if ev is not None:
+            return ev.read_needle_local(needle_id, cookie=cookie)
+        raise KeyError(f"volume {vid} not found")
+
+    def delete_needle(self, vid: int, n: Needle) -> int:
+        v = self.find_volume(vid)
+        if v is not None:
+            return v.delete_needle(n)
+        ev = self.find_ec_volume(vid)
+        if ev is not None:
+            ev.delete_needle(n.id)
+            return 0
+        raise KeyError(f"volume {vid} not found")
+
+    # -- EC shard admin (store_ec.go) -------------------------------------
+
+    def mount_ec_shards(self, vid: int, collection: str,
+                        shard_ids: list[int]) -> EcVolume:
+        """Open an EcVolume over locally-present shard files
+        (store_ec.go MountEcShards equivalent)."""
+        with self.lock:
+            ev = self.find_ec_volume(vid)
+            if ev is not None:
+                ev.close()
+            for loc in self.locations:
+                base = os.path.join(
+                    loc.directory,
+                    (f"{collection}_" if collection else "") + str(vid))
+                if any(os.path.exists(base + to_ext(s))
+                       for s in (shard_ids or range(32))):
+                    ev = EcVolume(loc.directory, vid, collection=collection)
+                    loc.ec_volumes[vid] = ev
+                    return ev
+            raise KeyError(f"no local shards for volume {vid}")
+
+    def unmount_ec_shards(self, vid: int) -> None:
+        with self.lock:
+            for loc in self.locations:
+                ev = loc.ec_volumes.pop(vid, None)
+                if ev is not None:
+                    ev.close()
+                    return
+
+    # -- heartbeat (store.go:371 CollectHeartbeat) ------------------------
+
+    def collect_heartbeat(self) -> dict:
+        volumes = []
+        ec_shards = []
+        max_volume_count = 0
+        for loc in self.locations:
+            max_volume_count += loc.max_volume_count
+            for vid, v in loc.volumes.items():
+                volumes.append({
+                    "id": vid,
+                    "collection": v.collection,
+                    "size": v.dat_size(),
+                    "fileCount": v.file_count(),
+                    "deleteCount": v.deleted_count(),
+                    "deletedByteCount": v.deleted_bytes(),
+                    "readOnly": v.read_only,
+                    "replicaPlacement":
+                        v.super_block.replica_placement.byte(),
+                    "ttl": v.super_block.ttl.to_u32(),
+                    "version": v.version,
+                })
+            for vid, ev in loc.ec_volumes.items():
+                ec_shards.append({
+                    "id": vid,
+                    "collection": ev.collection,
+                    "ecIndexBits": sum(1 << s for s in ev.shard_ids),
+                    "dataShards": ev.ctx.data_shards,
+                    "parityShards": ev.ctx.parity_shards,
+                })
+        return {
+            "ip": self.ip,
+            "port": self.port,
+            "publicUrl": self.public_url,
+            "maxVolumeCount": max_volume_count,
+            "volumes": volumes,
+            "ecShards": ec_shards,
+        }
+
+    def close(self) -> None:
+        for loc in self.locations:
+            for v in loc.volumes.values():
+                v.close()
+            for ev in loc.ec_volumes.values():
+                ev.close()

@@ -1,0 +1,4 @@
+"""Cluster metadata: DC -> rack -> data-node tree, volume layouts,
+placement, and the EC shard registry (weed/topology)."""
+
+from .topology import Topology, DataNodeInfo  # noqa: F401

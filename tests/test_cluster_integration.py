@@ -1,0 +1,152 @@
+"""Cluster integration tests: in-process master + volume servers over
+real HTTP loopback (the analog of test/erasure_coding/
+ec_integration_test.go and test/plugin_workers/framework.go:43).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.server.httpd import http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell import CommandEnv, run_command
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64).start()
+    servers = []
+    for i in range(6):
+        d = tmp_path / f"vol{i}"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.url, pulse_seconds=0.3,
+                          rack=f"rack{i % 3}").start()
+        servers.append(vs)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if len(http_json("GET", f"{master.url}/cluster/status")
+               ["dataNodes"]) == 6:
+            break
+        time.sleep(0.05)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _upload_corpus(master_url, n=20, seed=0, collection=""):
+    rng = np.random.default_rng(seed)
+    blobs = {}
+    for i in range(n):
+        data = rng.integers(0, 256, int(rng.integers(500, 20000)),
+                            dtype=np.uint8).tobytes()
+        fid = operation.submit(master_url, data, name=f"f{i}.bin",
+                               collection=collection)
+        blobs[fid] = data
+    return blobs
+
+
+def test_write_read_delete_cycle(cluster):
+    master, servers = cluster
+    blobs = _upload_corpus(master.url, n=10)
+    for fid, want in blobs.items():
+        assert operation.read(master.url, fid) == want
+    victim = next(iter(blobs))
+    operation.delete(master.url, victim)
+    with pytest.raises(RuntimeError):
+        operation.read(master.url, victim)
+    for fid, want in blobs.items():
+        if fid != victim:
+            assert operation.read(master.url, fid) == want
+
+
+def test_replicated_write_fan_out(cluster):
+    master, servers = cluster
+    a = operation.assign(master.url, replication="001")
+    operation.upload(a.url, a.fid, b"replicated-bytes")
+    time.sleep(0.5)  # let heartbeats refresh volume lists
+    locs = operation.lookup(master.url, int(a.fid.split(",")[0]))
+    assert len(locs) == 2, locs
+    # read from EACH replica directly
+    from seaweedfs_tpu.server.httpd import http_bytes
+    for loc in locs:
+        status, body, _ = http_bytes("GET", f"{loc['url']}/{a.fid}")
+        assert status == 200 and body == b"replicated-bytes"
+
+
+def test_ec_encode_balance_read_rebuild_decode(cluster):
+    """The full north-star pipeline (SURVEY §3.3) end to end."""
+    master, servers = cluster
+    blobs = _upload_corpus(master.url, n=15, seed=1)
+    vids = {int(fid.split(",")[0]) for fid in blobs}
+    assert len(vids) == 1
+    vid = vids.pop()
+
+    env = CommandEnv(master.url)
+    # lock required
+    with pytest.raises(RuntimeError, match="not locked"):
+        run_command(env, f"ec.encode -volumeId={vid}")
+    run_command(env, "lock")
+    out = run_command(env, f"ec.encode -volumeId={vid}")
+    assert f"volume {vid}" in out
+    time.sleep(0.5)
+
+    # shards spread across servers; originals deleted
+    shard_locs = http_json(
+        "GET", f"{master.url}/dir/ec_lookup?volumeId={vid}")
+    by_url = {l["url"]: l["shardIds"]
+              for l in shard_locs["shardIdLocations"]}
+    assert sum(len(s) for s in by_url.values()) == 14
+    assert len(by_url) >= 5, f"shards not spread: {by_url}"
+
+    # every blob still readable through the EC path... only blobs whose
+    # intervals are on one server are locally readable; full scatter
+    # reads come with the store_ec degraded-read path (next milestone).
+    # Here we verify via ec.rebuild + ec.decode instead.
+
+    # kill two shard-holding servers' shards (the two lightest-loaded:
+    # their combined shards stay within RS(10,4)'s 4-loss tolerance)
+    twos = sorted(by_url, key=lambda u: len(by_url[u]))[:2]
+    assert sum(len(by_url[u]) for u in twos) <= 4
+    for url in twos:
+        http_json("POST", f"{url}/admin/ec/delete_shards", {
+            "volumeId": vid, "shardIds": by_url[url]})
+    time.sleep(0.5)
+    out = run_command(env, f"ec.rebuild -volumeId={vid}")
+    assert "rebuilt" in out
+    time.sleep(0.5)
+    shard_locs = http_json(
+        "GET", f"{master.url}/dir/ec_lookup?volumeId={vid}")
+    assert sum(len(l["shardIds"])
+               for l in shard_locs["shardIdLocations"]) == 14
+
+    # decode back to a normal volume and verify every byte
+    out = run_command(env, f"ec.decode -volumeId={vid}")
+    assert "decoded" in out
+    time.sleep(0.5)
+    for fid, want in blobs.items():
+        assert operation.read(master.url, fid) == want, fid
+
+
+def test_vacuum_via_shell(cluster):
+    master, servers = cluster
+    blobs = _upload_corpus(master.url, n=8, seed=2)
+    fids = list(blobs)
+    for fid in fids[:4]:
+        operation.delete(master.url, fid)
+    env = CommandEnv(master.url)
+    run_command(env, "lock")
+    out = run_command(env, "volume.vacuum")
+    assert "vacuumed" in out
+    for fid in fids[4:]:
+        assert operation.read(master.url, fid) == blobs[fid]
+
+
+def test_volume_growth_on_demand(cluster):
+    master, servers = cluster
+    # force growth by uploading to a fresh collection
+    fid = operation.submit(master.url, b"grow!", collection="newcol")
+    assert operation.read(master.url, fid) == b"grow!"

@@ -60,3 +60,32 @@ def test_compact_discards_stale_shadow(tmp_path):
     assert v.read_needle(1).data == b"live"
     assert v.nm.metrics.file_count == 1
     v.close()
+
+
+def test_ecx_omits_predelete_tombstones(tmp_path):
+    """Pre-encode deletes are dropped from .ecx entirely (Go memdb
+    semantics, ec_encoder.go:387-393)."""
+    from seaweedfs_tpu.storage import idx as idxmod
+    from seaweedfs_tpu.storage.erasure_coding.ec_encoder import (
+        write_sorted_file_from_idx)
+    v = Volume(str(tmp_path), 30)
+    v.write_needle(Needle(cookie=1, id=1, data=b"keep"))
+    v.write_needle(Needle(cookie=2, id=2, data=b"drop"))
+    v.delete_needle(Needle(cookie=2, id=2))
+    v.close()
+    base = str(tmp_path / "30")
+    write_sorted_file_from_idx(base)
+    entries = list(idxmod.walk_index(open(base + ".ecx", "rb").read()))
+    assert [e[0] for e in entries] == [1]
+
+
+def test_shard_dat_size_ambiguity():
+    """Exact large-block-multiple shard sizes must not be misread as
+    large-block layouts (ec_volume.go:295-308)."""
+    from seaweedfs_tpu.storage.erasure_coding.ec_locate import locate_data
+    large, small, d = 1 << 30, 1 << 20, 10
+    # dat just under 10GB -> all small blocks, shard files exactly 1GB
+    shard_file_size = 1 << 30
+    # with the -1 fallback, n_large_rows = 0 -> small-block layout
+    ivs = locate_data(large, small, shard_file_size - 1, 8, 100, d)
+    assert not ivs[0].is_large_block
