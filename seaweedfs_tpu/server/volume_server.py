@@ -64,6 +64,8 @@ class VolumeServer:
         self.http.fallback = self._data_path
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
+        from .store_ec import EcReader
+        self.ec_reader = EcReader(master, self.http.url)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -78,6 +80,7 @@ class VolumeServer:
     def stop(self):
         self._hb_stop.set()
         self.http.stop()
+        self.ec_reader.close()
         self.store.close()
 
     @property
@@ -120,7 +123,8 @@ class VolumeServer:
     def _get_needle(self, fid: types.FileId):
         try:
             n = self.store.read_needle(fid.volume_id, fid.key,
-                                       cookie=fid.cookie)
+                                       cookie=fid.cookie,
+                                       ec_reader=self.ec_reader)
         except KeyError:
             return 404, {"error": "not found"}
         except ValueError as e:

@@ -210,18 +210,26 @@ class EcVolume:
     # -- reads (local shards only; cross-server reads live in the store
     #    layer, weed/storage/store_ec.go) --------------------------------
 
-    def read_needle_local(self, needle_id: int, cookie: int | None = None
-                          ) -> Needle:
-        """Read + decode a needle when ALL its intervals are locally
-        present (store_ec.go:141 ReadEcShardNeedle, local-only path)."""
+    def read_needle_with(self, interval_reader, needle_id: int,
+                         cookie: int | None = None) -> Needle:
+        """Read + decode a needle, fetching each interval through
+        `interval_reader` (local shard files here; the server-side
+        EcReader passes its scatter/reconstruct resolver)."""
         _, size, intervals = self.locate_needle(needle_id)
         if types.size_is_deleted(size):
             raise NotFoundError(f"needle {needle_id:x} deleted")
-        data = b"".join(self.read_interval(iv) for iv in intervals)
+        data = b"".join(interval_reader(iv) for iv in intervals)
         n = Needle.from_bytes(data, self.version, expected_size=size)
         if cookie is not None and n.cookie != cookie:
             raise ValueError(f"cookie mismatch on needle {needle_id:x}")
         return n
+
+    def read_needle_local(self, needle_id: int, cookie: int | None = None
+                          ) -> Needle:
+        """Read a needle when ALL its intervals are locally present
+        (store_ec.go:141 ReadEcShardNeedle, local-only path)."""
+        return self.read_needle_with(self.read_interval, needle_id,
+                                     cookie=cookie)
 
     def read_interval(self, iv: Interval) -> bytes:
         sid, off = iv.to_shard_id_and_offset(

@@ -155,12 +155,17 @@ class Store:
         return size, unchanged
 
     def read_needle(self, vid: int, needle_id: int,
-                    cookie: int | None = None) -> Needle:
+                    cookie: int | None = None, ec_reader=None) -> Needle:
+        """store.go:604 ReadVolumeNeedle.  For EC volumes, `ec_reader`
+        (server/store_ec.EcReader) enables scatter/degraded resolution;
+        without it only locally-complete needles are readable."""
         v = self.find_volume(vid)
         if v is not None:
             return v.read_needle(needle_id, cookie=cookie)
         ev = self.find_ec_volume(vid)
         if ev is not None:
+            if ec_reader is not None:
+                return ec_reader.read_needle(ev, needle_id, cookie=cookie)
             return ev.read_needle_local(needle_id, cookie=cookie)
         raise KeyError(f"volume {vid} not found")
 

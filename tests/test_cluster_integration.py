@@ -102,10 +102,10 @@ def test_ec_encode_balance_read_rebuild_decode(cluster):
     assert sum(len(s) for s in by_url.values()) == 14
     assert len(by_url) >= 5, f"shards not spread: {by_url}"
 
-    # every blob still readable through the EC path... only blobs whose
-    # intervals are on one server are locally readable; full scatter
-    # reads come with the store_ec degraded-read path (next milestone).
-    # Here we verify via ec.rebuild + ec.decode instead.
+    # every blob readable through the scatter-read EC path
+    # (store_ec.go:141: local -> remote shard -> reconstruct)
+    for fid, want in blobs.items():
+        assert operation.read(master.url, fid) == want, fid
 
     # kill two shard-holding servers' shards (the two lightest-loaded:
     # their combined shards stay within RS(10,4)'s 4-loss tolerance)
@@ -115,6 +115,12 @@ def test_ec_encode_balance_read_rebuild_decode(cluster):
         http_json("POST", f"{url}/admin/ec/delete_shards", {
             "volumeId": vid, "shardIds": by_url[url]})
     time.sleep(0.5)
+
+    # DEGRADED reads: 4 shards lost, data still served via on-the-fly
+    # reconstruction (store_ec.go:366)
+    for fid, want in list(blobs.items())[:5]:
+        assert operation.read(master.url, fid) == want, f"degraded {fid}"
+
     out = run_command(env, f"ec.rebuild -volumeId={vid}")
     assert "rebuilt" in out
     time.sleep(0.5)
