@@ -40,6 +40,22 @@ def main(argv: list[str] | None = None) -> int:
                    default=8080)
     s.add_argument("-dir", default=".")
 
+    ad = sub.add_parser("admin", help="start the maintenance admin server")
+    ad.add_argument("-ip", default="127.0.0.1")
+    ad.add_argument("-port", type=int, default=23646)
+    ad.add_argument("-master", default="127.0.0.1:9333")
+    ad.add_argument("-detectionInterval", type=float, default=30.0)
+
+    wk = sub.add_parser(
+        "worker", help="start a maintenance worker (tpu_ec sidecar: owns "
+        "the accelerator and executes erasure-coding jobs)")
+    wk.add_argument("-admin", default="127.0.0.1:23646")
+    wk.add_argument("-master", default="127.0.0.1:9333")
+    wk.add_argument("-dir", default="/tmp/seaweedfs_tpu_worker")
+    wk.add_argument("-capabilities", default="erasure_coding,vacuum")
+    wk.add_argument("-backend", default="",
+                    help="EC codec backend: jax|cpu (default: auto)")
+
     sh = sub.add_parser("shell", help="interactive admin shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
     sh.add_argument("command", nargs="*",
@@ -79,6 +95,27 @@ def main(argv: list[str] | None = None) -> int:
         vs = VolumeServer([args.dir], ms.url, host=args.ip,
                           port=args.volume_port).start()
         print(f"master on {ms.url}, volume on {vs.url}")
+        _wait()
+    elif args.cmd == "admin":
+        from .plugin.admin import AdminServer
+        ad = AdminServer(args.master, args.ip, args.port,
+                         detection_interval=args.detectionInterval)
+        ad.start()
+        print(f"admin listening on {ad.url}")
+        _wait()
+    elif args.cmd == "worker":
+        from .plugin.handlers import EcEncodeHandler, VacuumHandler
+        from .plugin.worker import PluginWorker
+        handlers = []
+        caps = args.capabilities.split(",")
+        if "erasure_coding" in caps or "ec" in caps:
+            handlers.append(EcEncodeHandler(
+                backend=args.backend or None))
+        if "vacuum" in caps:
+            handlers.append(VacuumHandler())
+        w = PluginWorker(args.admin, args.master, args.dir, handlers)
+        w.start()
+        print(f"worker {w.worker_id} polling {args.admin}")
         _wait()
     elif args.cmd == "shell":
         from .shell import CommandEnv, run_command

@@ -1,0 +1,13 @@
+"""Plugin/worker plane: language-agnostic maintenance workers
+(weed/plugin + weed/worker + pb/plugin.proto; design doc
+admin/plugin/DESIGN.md).
+
+The TPU enters the system here: a `tpu_ec` worker process owns the
+accelerator and executes erasure-coding jobs dispatched by the admin —
+exactly where the reference already runs EC off the volume server
+(worker/tasks/erasure_coding/ec_task.go copies volume files to the
+worker and encodes locally).
+"""
+
+from .admin import AdminServer  # noqa: F401
+from .worker import PluginWorker  # noqa: F401

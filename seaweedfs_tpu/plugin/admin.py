@@ -1,0 +1,231 @@
+"""Admin server: worker registry + detection scheduling + job dispatch
+(weed/admin/maintenance/maintenance_manager.go + admin/plugin/:
+PluginRegistry, DetectorScheduler, JobDispatcher per DESIGN.md).
+
+The reference uses a worker-initiated bidi gRPC stream
+(pb/plugin.proto:12 PluginControlService.WorkerStream).  Over plain
+HTTP the same conversation becomes: worker registers (WorkerHello with
+capabilities), then long-polls /worker/poll for admin->worker messages
+(RunDetectionRequest / ExecuteJobRequest) and POSTs worker->admin
+messages (DetectionResult / JobProgressUpdate / JobCompleted).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from ..server.httpd import HttpServer, Request, http_json
+
+
+@dataclass
+class WorkerInfo:
+    worker_id: str
+    capabilities: list[dict] = field(default_factory=list)
+    last_seen: float = 0.0
+    inflight: int = 0
+    max_concurrent: int = 1
+
+    def can(self, job_type: str) -> bool:
+        return any(c.get("jobType") == job_type
+                   for c in self.capabilities)
+
+
+@dataclass
+class Job:
+    job_id: str
+    job_type: str
+    params: dict
+    dedupe_key: str
+    status: str = "pending"   # pending -> assigned -> done/failed
+    worker_id: str = ""
+    progress: float = 0.0
+    message: str = ""
+    created: float = field(default_factory=time.time)
+
+
+class AdminServer:
+    """Maintenance plane controller."""
+
+    def __init__(self, master: str, host: str = "127.0.0.1", port: int = 0,
+                 detection_interval: float = 30.0):
+        self.master = master
+        self.detection_interval = detection_interval
+        self.workers: dict[str, WorkerInfo] = {}
+        self.jobs: dict[str, Job] = {}
+        self._dedupe: dict[str, str] = {}  # dedupe_key -> job_id
+        self.lock = threading.RLock()
+        self._stop = threading.Event()
+        self.http = HttpServer(host, port)
+        r = self.http.route
+        r("POST", "/worker/register", self._register)     # WorkerHello
+        r("POST", "/worker/poll", self._poll)             # admin->worker
+        r("POST", "/worker/detection_result", self._detection_result)
+        r("POST", "/worker/progress", self._progress)     # JobProgressUpdate
+        r("POST", "/worker/complete", self._complete)     # JobCompleted
+        r("GET", "/maintenance/queue", self._queue)
+        r("POST", "/maintenance/trigger_detection", self._trigger)
+        self._detect_thread: threading.Thread | None = None
+        self._pending_detection: list[str] = []  # worker ids to ask
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self):
+        self.http.start()
+        self._detect_thread = threading.Thread(
+            target=self._detection_loop, daemon=True)
+        self._detect_thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.http.stop()
+
+    @property
+    def url(self) -> str:
+        return self.http.url
+
+    # -- worker protocol handlers -----------------------------------------
+
+    def _register(self, req: Request):
+        b = req.json()
+        wid = b.get("workerId") or uuid.uuid4().hex[:12]
+        with self.lock:
+            self.workers[wid] = WorkerInfo(
+                worker_id=wid,
+                capabilities=b.get("capabilities", []),
+                last_seen=time.time(),
+                max_concurrent=int(b.get("maxConcurrent", 1)))
+        return 200, {"workerId": wid}
+
+    def _poll(self, req: Request):
+        """Long-poll: return the next admin->worker message for this
+        worker (detection request or job assignment)."""
+        b = req.json()
+        wid = b["workerId"]
+        deadline = time.time() + float(b.get("waitSeconds", 10.0))
+        while time.time() < deadline and not self._stop.is_set():
+            with self.lock:
+                w = self.workers.get(wid)
+                if w is None:
+                    return 404, {"error": "unregistered worker"}
+                w.last_seen = time.time()
+                if wid in self._pending_detection:
+                    self._pending_detection.remove(wid)
+                    return 200, {"type": "runDetection"}
+                job = self._next_job_for(w)
+                if job is not None:
+                    job.status = "assigned"
+                    job.worker_id = wid
+                    w.inflight += 1
+                    return 200, {"type": "executeJob",
+                                 "jobId": job.job_id,
+                                 "jobType": job.job_type,
+                                 "params": job.params}
+            time.sleep(0.05)
+        return 200, {"type": "none"}
+
+    def _next_job_for(self, w: WorkerInfo) -> Job | None:
+        if w.inflight >= w.max_concurrent:
+            return None
+        for job in sorted(self.jobs.values(), key=lambda j: j.created):
+            if job.status == "pending" and w.can(job.job_type):
+                return job
+        return None
+
+    def _detection_result(self, req: Request):
+        """Worker Detect() proposals -> deduped job queue
+        (DetectorScheduler + JobDispatcher)."""
+        b = req.json()
+        accepted = []
+        with self.lock:
+            for prop in b.get("proposals", []):
+                key = prop.get("dedupeKey") or \
+                    f"{prop['jobType']}:{prop['params'].get('volumeId')}"
+                existing = self._dedupe.get(key)
+                if existing and \
+                        self.jobs[existing].status in ("pending",
+                                                       "assigned"):
+                    continue
+                job = Job(job_id=uuid.uuid4().hex[:12],
+                          job_type=prop["jobType"],
+                          params=prop["params"], dedupe_key=key)
+                self.jobs[job.job_id] = job
+                self._dedupe[key] = job.job_id
+                accepted.append(job.job_id)
+        return 200, {"accepted": accepted}
+
+    def _progress(self, req: Request):
+        b = req.json()
+        with self.lock:
+            job = self.jobs.get(b["jobId"])
+            if job is not None:
+                job.progress = float(b.get("progress", 0.0))
+                job.message = b.get("message", "")
+        return 200, {}
+
+    def _complete(self, req: Request):
+        b = req.json()
+        with self.lock:
+            job = self.jobs.get(b["jobId"])
+            if job is not None:
+                job.status = "done" if b.get("success") else "failed"
+                job.message = b.get("message", "")
+                job.progress = 1.0
+                w = self.workers.get(job.worker_id)
+                if w is not None:
+                    w.inflight = max(0, w.inflight - 1)
+        return 200, {}
+
+    # -- ops API ----------------------------------------------------------
+
+    def _queue(self, req: Request):
+        with self.lock:
+            return 200, {"jobs": [{
+                "jobId": j.job_id, "jobType": j.job_type,
+                "status": j.status, "progress": j.progress,
+                "message": j.message, "params": j.params,
+            } for j in sorted(self.jobs.values(),
+                              key=lambda j: j.created)]}
+
+    def _trigger(self, req: Request):
+        with self.lock:
+            self._pending_detection = [
+                wid for wid, w in self.workers.items()
+                if any(c.get("canDetect") for c in w.capabilities)]
+            asked = list(self._pending_detection)
+        return 200, {"asked": asked}
+
+    # a worker silent for this long is presumed dead; its assigned jobs
+    # requeue so the dedupe key stops blocking re-detection
+    WORKER_DEAD_AFTER = 60.0
+
+    def _detection_loop(self) -> None:
+        tick = min(self.detection_interval, 5.0)
+        next_detection = time.time() + self.detection_interval
+        while not self._stop.wait(tick):
+            self._reap_dead_workers()
+            if time.time() >= next_detection:
+                next_detection = time.time() + self.detection_interval
+                with self.lock:
+                    self._pending_detection = [
+                        wid for wid, w in self.workers.items()
+                        if any(c.get("canDetect")
+                               for c in w.capabilities)]
+
+    def _reap_dead_workers(self) -> None:
+        now = time.time()
+        with self.lock:
+            dead = [wid for wid, w in self.workers.items()
+                    if w.inflight > 0 and
+                    now - w.last_seen > self.WORKER_DEAD_AFTER]
+            for wid in dead:
+                for job in self.jobs.values():
+                    if job.status == "assigned" and \
+                            job.worker_id == wid:
+                        job.status = "pending"
+                        job.worker_id = ""
+                        job.message = "requeued: worker lost"
+                self.workers[wid].inflight = 0

@@ -1,0 +1,4 @@
+"""JobHandlers (weed/plugin/worker/*_handler.go)."""
+
+from .erasure_coding import EcEncodeHandler  # noqa: F401
+from .vacuum import VacuumHandler  # noqa: F401

@@ -1,0 +1,238 @@
+"""The `tpu_ec` worker handler — the north-star TPU entry point.
+
+Mirrors the reference's canonical JobHandler
+(plugin/worker/erasure_coding_handler.go: Capability :48, Descriptor
+:61, Detect :187, Execute :445 delegating to
+worker/tasks/erasure_coding/ec_task.go:59):
+
+    markVolumeReadonly        (:261)
+    copyVolumeFilesToWorker   (:300)  <- bulk .dat/.idx pull
+    generateEcShardsLocally   (:426)  <- THE TPU HOT PATH: the worker
+                                         owns the accelerator; encode
+                                         runs on the JAX kernels when a
+                                         TPU is present
+    distributeEcShards        (:532)  -> ReceiveFile pushes to targets
+    mountEcShards             (shard_distribution.go:209)
+    deleteOriginalVolume      (:547)
+"""
+
+from __future__ import annotations
+
+import os
+
+from ...server.httpd import http_bytes, http_json
+from ...storage.erasure_coding import ECContext
+from ...storage.erasure_coding import ec_decoder, ec_encoder
+from ...storage.erasure_coding.ec_context import to_ext
+from ...topology import iter_volume_list_volumes
+from ..worker import JobHandler
+
+
+def _must(r: dict, what: str) -> dict:
+    """RPC error dicts must abort the job BEFORE the destructive delete
+    step — never silently continue past a failed mutation."""
+    if isinstance(r, dict) and r.get("error"):
+        raise RuntimeError(f"{what}: {r['error']}")
+    return r
+
+
+class EcEncodeHandler(JobHandler):
+    job_type = "erasure_coding"
+    aliases = ["ec", "erasure-coding"]
+
+    def __init__(self, fullness_ratio: float = 0.9,
+                 collection_filter: str | None = None,
+                 data_shards: int = 10, parity_shards: int = 4,
+                 backend: str | None = None):
+        self.fullness_ratio = fullness_ratio
+        self.collection_filter = collection_filter
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.backend = backend  # None -> auto (jax on TPU)
+
+    def capability(self) -> dict:
+        # weight 80 per erasure_coding_handler.go:48
+        return {"jobType": self.job_type, "canDetect": True,
+                "canExecute": True, "weight": 80}
+
+    def descriptor(self) -> dict:
+        """Declarative admin/worker config forms (handler :61)."""
+        return {"jobType": self.job_type, "fields": [
+            {"name": "fullnessRatio", "type": "float",
+             "default": self.fullness_ratio,
+             "help": "encode volumes fuller than this fraction"},
+            {"name": "collectionFilter", "type": "string",
+             "default": self.collection_filter or "",
+             "help": "only encode volumes of this collection"},
+            {"name": "dataShards", "type": "int",
+             "default": self.data_shards},
+            {"name": "parityShards", "type": "int",
+             "default": self.parity_shards},
+        ]}
+
+    # -- Detect (:187) ------------------------------------------------
+
+    def detect(self, worker) -> list[dict]:
+        vl = http_json("GET", f"{worker.master}/vol/list")
+        size_limit = self._volume_size_limit(worker)
+        proposals = []
+        seen = set()
+        for _node, v in iter_volume_list_volumes(vl):
+            vid = v["id"]
+            if vid in seen:
+                continue
+            seen.add(vid)
+            if self.collection_filter is not None and \
+                    v.get("collection", "") != self.collection_filter:
+                continue
+            if v.get("size", 0) < self.fullness_ratio * size_limit:
+                continue
+            proposals.append({
+                "jobType": self.job_type,
+                "dedupeKey": f"ec:{vid}",
+                "params": {
+                    "volumeId": vid,
+                    "collection": v.get("collection", ""),
+                    "dataShards": self.data_shards,
+                    "parityShards": self.parity_shards,
+                },
+            })
+        return proposals
+
+    def _volume_size_limit(self, worker) -> int:
+        r = http_json("GET", f"{worker.master}/cluster/status")
+        return int(r.get("volumeSizeLimit", 1 << 30))
+
+    # -- Execute (ec_task.go:59) ---------------------------------------
+
+    def execute(self, worker, job_id: str, params: dict) -> str:
+        vid = int(params["volumeId"])
+        collection = params.get("collection", "")
+        ctx_kw = {}
+        if self.backend:
+            ctx_kw["backend"] = self.backend
+        ctx = ECContext(int(params.get("dataShards", self.data_shards)),
+                        int(params.get("parityShards",
+                                       self.parity_shards)),
+                        collection, vid, **ctx_kw)
+        locations = http_json(
+            "GET", f"{worker.master}/dir/lookup?volumeId={vid}"
+        ).get("locations", [])
+        if not locations:
+            raise RuntimeError(f"volume {vid} has no locations")
+        urls = [l["url"] for l in locations]
+        source = urls[0]
+        base = os.path.join(worker.work_dir, f"{vid}")
+        try:
+            placement = self._encode_and_distribute(
+                worker, job_id, vid, collection, ctx, urls, source, base)
+        except Exception:
+            # unwind: restore writability so the volume is not stranded
+            # readonly by a failed job (detection would otherwise never
+            # get another chance at it)
+            for url in urls:
+                try:
+                    http_json("POST", f"{url}/admin/set_readonly",
+                              {"volumeId": vid, "readOnly": False})
+                except OSError:
+                    pass
+            raise
+        finally:
+            for ext in [".dat", ".idx", ".ecx", ".ecj", ".vif"] + \
+                    [to_ext(i) for i in range(ctx.total)]:
+                try:
+                    os.remove(base + ext)
+                except FileNotFoundError:
+                    pass
+        # 6. all shards safely mounted -> delete the originals (:547)
+        for url in urls:
+            _must(http_json("POST", f"{url}/admin/delete_volume",
+                            {"volumeId": vid}),
+                  f"delete original on {url}")
+        return (f"volume {vid}: {ctx} shards encoded on worker "
+                f"({ctx.backend}) and distributed to "
+                f"{sum(1 for s in placement.values() if s)} servers")
+
+    def _encode_and_distribute(self, worker, job_id: str, vid: int,
+                               collection: str, ctx: ECContext,
+                               urls: list[str], source: str,
+                               base: str) -> dict:
+        # 1. mark readonly everywhere (:261)
+        for url in urls:
+            _must(http_json("POST", f"{url}/admin/set_readonly",
+                            {"volumeId": vid, "readOnly": True}),
+                  f"set readonly on {url}")
+        worker.report_progress(job_id, 0.1, "marked readonly")
+
+        # 2. copy .dat/.idx to the worker (:300) — the bulk pull the
+        # plugin boundary is designed to carry
+        os.makedirs(worker.work_dir, exist_ok=True)
+        for ext in (".dat", ".idx"):
+            status, data, _ = http_bytes(
+                "GET", f"{source}/admin/volume_file?volumeId={vid}"
+                f"&collection={collection}&ext={ext}")
+            if status != 200:
+                raise RuntimeError(f"copy {ext} from {source}: {status}")
+            with open(base + ext, "wb") as f:
+                f.write(data)
+        worker.report_progress(job_id, 0.3, "copied volume files")
+
+        # 3. encode locally (:426) — TPU kernels when present
+        dat_size = os.path.getsize(base + ".dat")
+        version = _read_dat_version(base)
+        ec_encoder.write_sorted_file_from_idx(base)
+        ec_encoder.write_ec_files(base, ctx)
+        ec_encoder.save_ec_volume_info(base, ctx, dat_size, version)
+        worker.report_progress(
+            job_id, 0.6, f"encoded {ctx.total} shards ({ctx.backend})")
+
+        # consistency check (:638 verifyDatIdxConsistency analog):
+        # decode geometry must reproduce the source size
+        if ec_decoder.find_dat_file_size(base, base) > dat_size:
+            raise RuntimeError("ecx entries exceed dat size")
+
+        # 4. distribute shards round-robin over alive servers (:532)
+        targets = http_json(
+            "GET", f"{worker.master}/cluster/status")["dataNodes"]
+        if not targets:
+            raise RuntimeError("no alive volume servers")
+        placement: dict[str, list[int]] = {t: [] for t in targets}
+        for sid in range(ctx.total):
+            placement[targets[sid % len(targets)]].append(sid)
+        for target, sids in placement.items():
+            if not sids:
+                continue
+            for sid in sids:
+                _push_file(target, vid, collection, to_ext(sid),
+                           base + to_ext(sid))
+            for ext in (".ecx", ".vif"):
+                _push_file(target, vid, collection, ext, base + ext)
+        worker.report_progress(job_id, 0.8, "distributed shards")
+
+        # 5. mount on targets (shard_distribution.go:209)
+        for target, sids in placement.items():
+            if sids:
+                _must(http_json("POST", f"{target}/admin/ec/mount",
+                                {"volumeId": vid,
+                                 "collection": collection,
+                                 "shardIds": sids}),
+                      f"mount shards on {target}")
+        return placement
+
+
+def _read_dat_version(base: str) -> int:
+    from ...storage.super_block import SuperBlock
+    with open(base + ".dat", "rb") as f:
+        return SuperBlock.parse(f.read(8), require_extra=False).version
+
+
+def _push_file(target: str, vid: int, collection: str, ext: str,
+               path: str) -> None:
+    with open(path, "rb") as f:
+        data = f.read()
+    status, body, _ = http_bytes(
+        "POST", f"{target}/admin/receive_file?volumeId={vid}"
+        f"&collection={collection}&ext={ext}", data)
+    if status != 200:
+        raise RuntimeError(f"push {ext} to {target}: {status} "
+                           f"{body[:200]!r}")

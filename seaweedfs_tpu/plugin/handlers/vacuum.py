@@ -1,0 +1,58 @@
+"""Vacuum JobHandler (plugin/worker vacuum handler +
+worker/tasks/vacuum): detect garbage-heavy volumes, compact them."""
+
+from __future__ import annotations
+
+from ...server.httpd import http_json
+from ..worker import JobHandler
+
+
+class VacuumHandler(JobHandler):
+    job_type = "vacuum"
+
+    def __init__(self, garbage_threshold: float = 0.3):
+        self.garbage_threshold = garbage_threshold
+
+    def capability(self) -> dict:
+        return {"jobType": self.job_type, "canDetect": True,
+                "canExecute": True, "weight": 50}
+
+    def descriptor(self) -> dict:
+        return {"jobType": self.job_type, "fields": [
+            {"name": "garbageThreshold", "type": "float",
+             "default": self.garbage_threshold,
+             "help": "compact volumes whose garbage ratio exceeds this"},
+        ]}
+
+    def detect(self, worker) -> list[dict]:
+        from ...topology import iter_volume_list_volumes
+        vl = http_json("GET", f"{worker.master}/vol/list")
+        proposals = []
+        seen = set()
+        for _node, v in iter_volume_list_volumes(vl):
+            vid = v["id"]
+            if vid in seen or v.get("readOnly"):
+                continue
+            seen.add(vid)
+            live = max(v.get("size", 0) -
+                       v.get("deletedByteCount", 0), 1)
+            ratio = v.get("deletedByteCount", 0) / live
+            if ratio > self.garbage_threshold:
+                proposals.append({
+                    "jobType": self.job_type,
+                    "dedupeKey": f"vacuum:{vid}",
+                    "params": {"volumeId": vid},
+                })
+        return proposals
+
+    def execute(self, worker, job_id: str, params: dict) -> str:
+        vid = int(params["volumeId"])
+        locs = http_json(
+            "GET", f"{worker.master}/dir/lookup?volumeId={vid}"
+        ).get("locations", [])
+        for loc in locs:
+            r = http_json("POST", f"{loc['url']}/admin/vacuum",
+                          {"volumeId": vid})
+            if r.get("error"):
+                raise RuntimeError(f"vacuum on {loc['url']}: {r['error']}")
+        return f"volume {vid}: vacuumed on {len(locs)} servers"

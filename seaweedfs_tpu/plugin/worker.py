@@ -1,0 +1,146 @@
+"""Plugin worker runtime (weed/plugin/worker/worker.go +
+handler_registry.go): hosts JobHandlers, speaks the worker protocol
+with the admin (register -> poll -> detect/execute -> report)."""
+
+from __future__ import annotations
+
+import threading
+import traceback
+
+from ..server.httpd import http_json
+
+
+class JobHandler:
+    """Contract mirrored from plugin/worker JobHandler
+    (erasure_coding_handler.go:48 Capability, :61 Descriptor,
+    :187 Detect, :445 Execute)."""
+
+    job_type = "base"
+    aliases: list[str] = []
+
+    def capability(self) -> dict:
+        return {"jobType": self.job_type, "canDetect": True,
+                "canExecute": True, "weight": 50}
+
+    def descriptor(self) -> dict:
+        """Declarative config schema (plugin.proto descriptor forms)."""
+        return {"jobType": self.job_type, "fields": []}
+
+    def detect(self, worker: "PluginWorker") -> list[dict]:
+        """Return job proposals: {jobType, params, dedupeKey}."""
+        return []
+
+    def execute(self, worker: "PluginWorker", job_id: str,
+                params: dict) -> str:
+        raise NotImplementedError
+
+
+class PluginWorker:
+    """A maintenance worker process (weed worker / tpu_ec sidecar)."""
+
+    def __init__(self, admin: str, master: str, work_dir: str,
+                 handlers: list[JobHandler],
+                 max_concurrent: int = 1,
+                 poll_wait: float = 5.0):
+        self.admin = admin
+        self.master = master
+        self.work_dir = work_dir
+        self.handlers = {h.job_type: h for h in handlers}
+        self.max_concurrent = max_concurrent
+        self.poll_wait = poll_wait
+        self.worker_id = ""
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.executed: list[str] = []  # job ids, newest last
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self):
+        r = http_json("POST", f"{self.admin}/worker/register", {
+            "capabilities": [h.capability() for h in
+                             self.handlers.values()],
+            "descriptors": [h.descriptor() for h in
+                            self.handlers.values()],
+            "maxConcurrent": self.max_concurrent,
+        })
+        self.worker_id = r["workerId"]
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- protocol loop ----------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                msg = http_json("POST", f"{self.admin}/worker/poll", {
+                    "workerId": self.worker_id,
+                    "waitSeconds": self.poll_wait,
+                }, timeout=self.poll_wait + 10)
+            except OSError:
+                if self._stop.wait(1.0):
+                    return
+                continue
+            if msg.get("error"):
+                # e.g. the admin restarted and lost its registry —
+                # re-register with backoff instead of hot-spinning
+                if self._stop.wait(1.0):
+                    return
+                try:
+                    r = http_json(
+                        "POST", f"{self.admin}/worker/register", {
+                            "workerId": self.worker_id,
+                            "capabilities": [h.capability() for h in
+                                             self.handlers.values()],
+                            "maxConcurrent": self.max_concurrent})
+                    self.worker_id = r.get("workerId", self.worker_id)
+                except OSError:
+                    pass
+                continue
+            mtype = msg.get("type")
+            if mtype == "runDetection":
+                self._run_detection()
+            elif mtype == "executeJob":
+                self._execute(msg["jobId"], msg["jobType"],
+                              msg.get("params", {}))
+
+    def _run_detection(self) -> None:
+        proposals = []
+        for h in self.handlers.values():
+            try:
+                proposals.extend(h.detect(self))
+            except Exception:  # noqa: BLE001 — detection must not kill loop
+                traceback.print_exc()
+        if proposals:
+            http_json("POST", f"{self.admin}/worker/detection_result",
+                      {"workerId": self.worker_id,
+                       "proposals": proposals})
+
+    def _execute(self, job_id: str, job_type: str, params: dict) -> None:
+        h = self.handlers.get(job_type)
+        try:
+            if h is None:
+                raise ValueError(f"no handler for {job_type!r}")
+            message = h.execute(self, job_id, params)
+            success = True
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            traceback.print_exc()
+            message, success = f"{type(e).__name__}: {e}", False
+        self.executed.append(job_id)
+        http_json("POST", f"{self.admin}/worker/complete", {
+            "workerId": self.worker_id, "jobId": job_id,
+            "success": success, "message": message})
+
+    def report_progress(self, job_id: str, progress: float,
+                        message: str = "") -> None:
+        try:
+            http_json("POST", f"{self.admin}/worker/progress", {
+                "workerId": self.worker_id, "jobId": job_id,
+                "progress": progress, "message": message})
+        except OSError:
+            pass

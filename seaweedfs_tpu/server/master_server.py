@@ -180,6 +180,7 @@ class MasterServer:
             "isLeader": True,
             "leader": self.url,
             "dataNodes": [n.url for n in nodes],
+            "volumeSizeLimit": self.topology.volume_size_limit,
         }
 
     # -- admin lock (master.proto:44, shell/command_lock_unlock.go) -------

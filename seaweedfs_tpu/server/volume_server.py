@@ -14,8 +14,12 @@ BEFORE the shard files (volume_grpc_erasure_coding.go:89-98).
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
+
+_SAFE_EXT = re.compile(r"^\.(dat|idx|vif|ecx|ecj|ec\d{2})$")
+_SAFE_COLLECTION = re.compile(r"^[A-Za-z0-9_.-]*$")
 
 from ..storage import types
 from ..storage.erasure_coding import ECContext
@@ -51,6 +55,7 @@ class VolumeServer:
         r("POST", "/admin/set_readonly", self._set_readonly)
         r("POST", "/admin/vacuum", self._vacuum)
         r("GET", "/admin/volume_file", self._read_volume_file)
+        r("POST", "/admin/receive_file", self._receive_file)
         # EC admin <- volume_server.proto:89-108
         r("POST", "/admin/ec/generate", self._ec_generate)
         r("POST", "/admin/ec/mount", self._ec_mount)
@@ -228,8 +233,11 @@ class VolumeServer:
 
     def _set_readonly(self, req: Request):
         b = req.json()
-        self.store.set_volume_read_only(int(b["volumeId"]),
-                                        bool(b.get("readOnly", True)))
+        vid = int(b["volumeId"])
+        self.store.set_volume_read_only(vid, bool(b.get("readOnly", True)))
+        v = self.store.find_volume(vid)
+        if v is not None and v.read_only:
+            v.sync()  # commit buffered .dat/.idx before anyone copies them
         return 200, {}
 
     def _vacuum(self, req: Request):
@@ -249,6 +257,10 @@ class VolumeServer:
         collection = req.query.get("collection", "")
         offset = int(req.query.get("offset", 0))
         size = int(req.query.get("size", -1))
+        if ext in (".dat", ".idx"):
+            v = self.store.find_volume(vid)
+            if v is not None:
+                v.sync()  # serve committed bytes, not a buffered tail
         path = self._file_path(vid, collection, ext)
         if path is None:
             return 404, {"error": f"no {ext} file for volume {vid}"}
@@ -256,6 +268,24 @@ class VolumeServer:
             f.seek(offset)
             data = f.read() if size < 0 else f.read(size)
         return 200, data
+
+    def _receive_file(self, req: Request):
+        """volume_server.proto ReceiveFile: accept a shard/index file
+        pushed by a worker (erasure_coding/shard_distribution.go:101
+        DistributeEcShards target side)."""
+        vid = int(req.query["volumeId"])
+        collection = req.query.get("collection", "")
+        ext = req.query["ext"]
+        if not _SAFE_EXT.match(ext):
+            return 400, {"error": f"unacceptable ext {ext!r}"}
+        if not _SAFE_COLLECTION.match(collection):
+            # the collection lands in a filesystem path — no traversal
+            return 400, {"error": f"unacceptable collection "
+                         f"{collection!r}"}
+        base = self._base_path(vid, collection)
+        with open(base + ext, "wb") as f:
+            f.write(req.body)
+        return 200, {"bytes": len(req.body)}
 
     def _file_path(self, vid: int, collection: str, ext: str
                    ) -> str | None:

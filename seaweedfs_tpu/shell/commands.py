@@ -356,24 +356,18 @@ def _parse_flags(args: list[str]) -> dict:
 
 
 def _volumes_by_id(env: CommandEnv) -> dict[int, list[str]]:
-    vl = env.volume_list()
+    from ..topology import iter_volume_list_volumes
     out: dict[int, list[str]] = {}
-    for dc in vl.get("dataCenters", {}).values():
-        for rack in dc.get("racks", {}).values():
-            for node in rack.get("nodes", []):
-                for v in node.get("volumes", []):
-                    out.setdefault(v["id"], []).append(node["url"])
+    for node, v in iter_volume_list_volumes(env.volume_list()):
+        out.setdefault(v["id"], []).append(node["url"])
     return out
 
 
 def _ec_volumes(env: CommandEnv) -> dict[int, None]:
-    vl = env.volume_list()
+    from ..topology import iter_volume_list_ec_shards
     out: dict[int, None] = {}
-    for dc in vl.get("dataCenters", {}).values():
-        for rack in dc.get("racks", {}).values():
-            for node in rack.get("nodes", []):
-                for e in node.get("ecShards", []):
-                    out[e["volumeId"]] = None
+    for _node, e in iter_volume_list_ec_shards(env.volume_list()):
+        out[e["volumeId"]] = None
     return out
 
 
@@ -398,15 +392,12 @@ def _select_volumes(env: CommandEnv, opts: dict) -> list[int]:
     collection = opts.get("collection")
     if collection is None:
         return []
-    vl = env.volume_list()
+    from ..topology import iter_volume_list_volumes
     vids = []
-    for dc in vl.get("dataCenters", {}).values():
-        for rack in dc.get("racks", {}).values():
-            for node in rack.get("nodes", []):
-                for v in node.get("volumes", []):
-                    if v.get("collection", "") == (
-                            "" if collection == "ALL" else collection):
-                        vids.append(v["id"])
+    for _node, v in iter_volume_list_volumes(env.volume_list()):
+        if v.get("collection", "") == (
+                "" if collection == "ALL" else collection):
+            vids.append(v["id"])
     return sorted(set(vids))
 
 

@@ -2,3 +2,24 @@
 placement, and the EC shard registry (weed/topology)."""
 
 from .topology import Topology, DataNodeInfo  # noqa: F401
+
+
+def iter_volume_list_nodes(volume_list: dict):
+    """Yield node dicts from a /vol/list JSON tree."""
+    for dc in volume_list.get("dataCenters", {}).values():
+        for rack in dc.get("racks", {}).values():
+            yield from rack.get("nodes", [])
+
+
+def iter_volume_list_volumes(volume_list: dict):
+    """Yield (node, volume) pairs — the canonical walk shared by the
+    shell and every detection handler."""
+    for node in iter_volume_list_nodes(volume_list):
+        for v in node.get("volumes", []):
+            yield node, v
+
+
+def iter_volume_list_ec_shards(volume_list: dict):
+    for node in iter_volume_list_nodes(volume_list):
+        for e in node.get("ecShards", []):
+            yield node, e

@@ -243,8 +243,20 @@ class Topology:
                     "url": node.url,
                     "publicUrl": node.public_url,
                     "maxVolumeCount": node.max_volume_count,
-                    "volumes": [vars(v).copy()
-                                for v in node.volumes.values()],
+                    # camelCase field names: same wire contract as the
+                    # heartbeat messages (VolumeInformationMessage)
+                    "volumes": [{
+                        "id": v.id,
+                        "collection": v.collection,
+                        "size": v.size,
+                        "fileCount": v.file_count,
+                        "deleteCount": v.delete_count,
+                        "deletedByteCount": v.deleted_byte_count,
+                        "readOnly": v.read_only,
+                        "replicaPlacement": v.replica_placement,
+                        "ttl": v.ttl,
+                        "version": v.version,
+                    } for v in node.volumes.values()],
                     "ecShards": [{
                         "volumeId": e.volume_id,
                         "collection": e.collection,
