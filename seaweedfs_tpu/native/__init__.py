@@ -1,0 +1,76 @@
+"""Native C++ components, built on demand.
+
+The reference's native layer is Go-calling-SIMD-assembly + Rust
+(SURVEY §2.6); ours is C++ compiled at first use (g++ is in the image;
+pybind11 is not, so bindings go through ctypes).  The build artifact is
+cached next to the sources keyed on source mtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "gf_rs.cc")
+_SO = os.path.join(_DIR, "_build", "libgf_rs.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> str | None:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    if os.path.exists(_SO) and \
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    # per-pid scratch name: concurrent builders (several servers in one
+    # box) must not publish each other's half-written output
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    for flags in (["-march=native"], []):
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                 *flags, _SRC, "-o", tmp],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _SO)
+            return _SO
+        except (OSError, subprocess.SubprocessError):
+            continue
+    return None
+
+
+def load() -> "ctypes.CDLL | None":
+    """Build (if needed) + load the native library; None when no
+    toolchain / no writable build dir / broken artifact — callers fall
+    back to numpy/JAX and must never see an exception from here."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            so = _build()
+            if so is None:
+                return None
+            lib = ctypes.CDLL(so)
+            lib.gf_matrix_apply.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.c_size_t, ctypes.c_int]
+            lib.gf_mul_slice_acc.argtypes = [
+                ctypes.c_uint8, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_size_t]
+            lib.gf_native_simd.restype = ctypes.c_int
+        except OSError:
+            return None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None

@@ -1,0 +1,98 @@
+"""Native (C++/AVX2) Reed-Solomon codec — the latency-path engine.
+
+Same API as ReedSolomonCPU/ReedSolomonJax; the GF math runs in
+seaweedfs_tpu/native/gf_rs.cc (our klauspost-equivalent).  Use
+`available()` before constructing; callers fall back to the numpy twin.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .. import native
+from . import rs_matrix
+
+
+def available() -> bool:
+    return native.available()
+
+
+def _row_ptrs(arr2d: np.ndarray) -> "ctypes.Array":
+    n = arr2d.shape[0]
+    ptrs = (ctypes.c_void_p * n)()
+    base = arr2d.ctypes.data
+    stride = arr2d.strides[0]
+    for i in range(n):
+        ptrs[i] = base + i * stride
+    return ptrs
+
+
+class ReedSolomonNative:
+    def __init__(self, data_shards: int, parity_shards: int):
+        self._lib = native.load()
+        if self._lib is None:
+            raise RuntimeError("native GF library unavailable")
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        self.matrix = rs_matrix.build_matrix(data_shards,
+                                             self.total_shards)
+        self.parity_rows = np.ascontiguousarray(
+            self.matrix[data_shards:])
+
+    def _apply(self, mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+        mat = np.ascontiguousarray(mat, dtype=np.uint8)
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        r, k = mat.shape
+        assert data.shape[0] == k
+        out = np.zeros((r, data.shape[1]), dtype=np.uint8)
+        self._lib.gf_matrix_apply(
+            mat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            r, k, _row_ptrs(data), _row_ptrs(out), data.shape[1], 1)
+        return out
+
+    # -- API-compatible surface (see rs_cpu.ReedSolomonCPU) --------------
+
+    def parity(self, data: np.ndarray) -> np.ndarray:
+        data = np.asarray(data, dtype=np.uint8)
+        if data.ndim != 2 or data.shape[0] != self.data_shards:
+            raise ValueError(f"expected [{self.data_shards}, B], "
+                             f"got {data.shape}")
+        return self._apply(self.parity_rows, data)
+
+    def encode(self, shards: np.ndarray) -> np.ndarray:
+        shards = np.asarray(shards, dtype=np.uint8)
+        out = shards.copy()
+        out[self.data_shards:] = self.parity(
+            shards[: self.data_shards])
+        return out
+
+    def verify(self, shards: np.ndarray) -> bool:
+        shards = np.asarray(shards, dtype=np.uint8)
+        return bool(np.array_equal(
+            self.parity(shards[: self.data_shards]),
+            shards[self.data_shards:]))
+
+    def reconstruct(self, shards: np.ndarray, present,
+                    data_only: bool = False) -> np.ndarray:
+        shards = np.asarray(shards, dtype=np.uint8)
+        present = list(present)
+        missing_data = [i for i in range(self.data_shards)
+                        if not present[i]]
+        missing_parity = [i for i in
+                          range(self.data_shards, self.total_shards)
+                          if not present[i]]
+        out = shards.copy()
+        if missing_data:
+            m, rows = rs_matrix.cached_reconstruction_matrix(
+                self.data_shards, self.parity_shards, tuple(present),
+                tuple(missing_data))
+            out[missing_data] = self._apply(m, shards[list(rows)])
+        if missing_parity and not data_only:
+            sel = self.parity_rows[
+                [i - self.data_shards for i in missing_parity]]
+            out[missing_parity] = self._apply(
+                sel, out[: self.data_shards])
+        return out

@@ -157,9 +157,17 @@ class AdminServer:
                 accepted.append(job.job_id)
         return 200, {"accepted": accepted}
 
+    def _touch(self, worker_id: str) -> None:
+        w = self.workers.get(worker_id)
+        if w is not None:
+            w.last_seen = time.time()
+
     def _progress(self, req: Request):
         b = req.json()
         with self.lock:
+            # progress is a liveness signal: a single-threaded worker
+            # cannot poll mid-job, so the reaper must count this
+            self._touch(b.get("workerId", ""))
             job = self.jobs.get(b["jobId"])
             if job is not None:
                 job.progress = float(b.get("progress", 0.0))
@@ -169,8 +177,14 @@ class AdminServer:
     def _complete(self, req: Request):
         b = req.json()
         with self.lock:
+            self._touch(b.get("workerId", ""))
             job = self.jobs.get(b["jobId"])
             if job is not None:
+                if job.status == "assigned" and \
+                        job.worker_id != b.get("workerId", ""):
+                    # late report from a reaped worker whose job was
+                    # reassigned — the current owner's report decides
+                    return 200, {"ignored": True}
                 job.status = "done" if b.get("success") else "failed"
                 job.message = b.get("message", "")
                 job.progress = 1.0

@@ -28,12 +28,7 @@ from ...topology import iter_volume_list_volumes
 from ..worker import JobHandler
 
 
-def _must(r: dict, what: str) -> dict:
-    """RPC error dicts must abort the job BEFORE the destructive delete
-    step — never silently continue past a failed mutation."""
-    if isinstance(r, dict) and r.get("error"):
-        raise RuntimeError(f"{what}: {r['error']}")
-    return r
+from ..worker import must as _must
 
 
 class EcEncodeHandler(JobHandler):
@@ -127,9 +122,25 @@ class EcEncodeHandler(JobHandler):
             placement = self._encode_and_distribute(
                 worker, job_id, vid, collection, ctx, urls, source, base)
         except Exception:
-            # unwind: restore writability so the volume is not stranded
-            # readonly by a failed job (detection would otherwise never
-            # get another chance at it)
+            # unwind, in order: (1) tear down any distributed/mounted
+            # shards so the master never serves stale EC state alongside
+            # the still-live volume, then (2) restore writability so the
+            # volume is not stranded readonly by a failed job
+            try:
+                targets = http_json(
+                    "GET",
+                    f"{worker.master}/cluster/status")["dataNodes"]
+            except (OSError, KeyError):
+                targets = []
+            for target in targets:
+                try:
+                    http_json("POST",
+                              f"{target}/admin/ec/delete_shards",
+                              {"volumeId": vid,
+                               "collection": collection,
+                               "shardIds": list(range(ctx.total))})
+                except OSError:
+                    pass
             for url in urls:
                 try:
                     http_json("POST", f"{url}/admin/set_readonly",

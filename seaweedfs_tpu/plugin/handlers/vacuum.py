@@ -50,9 +50,9 @@ class VacuumHandler(JobHandler):
         locs = http_json(
             "GET", f"{worker.master}/dir/lookup?volumeId={vid}"
         ).get("locations", [])
+        from ..worker import must
         for loc in locs:
-            r = http_json("POST", f"{loc['url']}/admin/vacuum",
-                          {"volumeId": vid})
-            if r.get("error"):
-                raise RuntimeError(f"vacuum on {loc['url']}: {r['error']}")
+            must(http_json("POST", f"{loc['url']}/admin/vacuum",
+                           {"volumeId": vid}),
+                 f"vacuum on {loc['url']}")
         return f"volume {vid}: vacuumed on {len(locs)} servers"

@@ -10,6 +10,25 @@ import traceback
 from ..server.httpd import http_json
 
 
+def must(r: dict, what: str) -> dict:
+    """RPC error dicts abort the operation — shared by all handlers."""
+    if isinstance(r, dict) and r.get("error"):
+        raise RuntimeError(f"{what}: {r['error']}")
+    return r
+
+
+def _post_with_retry(url: str, payload: dict, attempts: int = 5) -> None:
+    """Report-back POSTs must survive transient admin outages — a lost
+    completion report would otherwise kill the worker loop thread."""
+    import time
+    for i in range(attempts):
+        try:
+            http_json("POST", url, payload)
+            return
+        except OSError:
+            time.sleep(min(2.0 ** i, 10.0))
+
+
 class JobHandler:
     """Contract mirrored from plugin/worker JobHandler
     (erasure_coding_handler.go:48 Capability, :61 Descriptor,
@@ -46,6 +65,9 @@ class PluginWorker:
         self.master = master
         self.work_dir = work_dir
         self.handlers = {h.job_type: h for h in handlers}
+        for h in handlers:  # aliases resolve to the same handler
+            for alias in h.aliases:
+                self.handlers.setdefault(alias, h)
         self.max_concurrent = max_concurrent
         self.poll_wait = poll_wait
         self.worker_id = ""
@@ -117,9 +139,9 @@ class PluginWorker:
             except Exception:  # noqa: BLE001 — detection must not kill loop
                 traceback.print_exc()
         if proposals:
-            http_json("POST", f"{self.admin}/worker/detection_result",
-                      {"workerId": self.worker_id,
-                       "proposals": proposals})
+            _post_with_retry(f"{self.admin}/worker/detection_result",
+                             {"workerId": self.worker_id,
+                              "proposals": proposals})
 
     def _execute(self, job_id: str, job_type: str, params: dict) -> None:
         h = self.handlers.get(job_type)
@@ -132,7 +154,7 @@ class PluginWorker:
             traceback.print_exc()
             message, success = f"{type(e).__name__}: {e}", False
         self.executed.append(job_id)
-        http_json("POST", f"{self.admin}/worker/complete", {
+        _post_with_retry(f"{self.admin}/worker/complete", {
             "workerId": self.worker_id, "jobId": job_id,
             "success": success, "message": message})
 

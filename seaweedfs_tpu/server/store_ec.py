@@ -18,7 +18,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
-from ..ops.rs_cpu import ReedSolomonCPU
+from ..ops import rs_cpu, rs_native
 from ..storage import types
 from ..storage.erasure_coding import EcVolume
 from ..storage.erasure_coding.ec_context import (LARGE_BLOCK_SIZE,
@@ -49,7 +49,7 @@ class EcReader:
         self.master = master
         self.self_url = self_url
         self._caches: dict[int, _ShardLocationCache] = {}
-        self._codecs: dict[tuple[int, int], ReedSolomonCPU] = {}
+        self._codecs: dict[tuple[int, int], object] = {}
         self._pool = ThreadPoolExecutor(max_workers=14)
 
     # -- public -----------------------------------------------------------
@@ -168,10 +168,15 @@ class EcReader:
                     cache.refreshed = time.time()
             return dict(cache.locations)
 
-    def _codec(self, d: int, p: int) -> ReedSolomonCPU:
+    def _codec(self, d: int, p: int):
+        """Native C++ engine when built (the latency path deserves it);
+        numpy twin otherwise."""
         key = (d, p)
         if key not in self._codecs:
-            self._codecs[key] = ReedSolomonCPU(d, p)
+            if rs_native.available():
+                self._codecs[key] = rs_native.ReedSolomonNative(d, p)
+            else:
+                self._codecs[key] = rs_cpu.ReedSolomonCPU(d, p)
         return self._codecs[key]
 
     def forget(self, vid: int) -> None:

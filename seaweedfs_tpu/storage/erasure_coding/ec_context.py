@@ -33,11 +33,21 @@ def to_ext(shard_id: int) -> str:
 
 
 def default_backend() -> str:
+    """TPU kernels when a TPU is attached; else the native C++ engine;
+    numpy as the last resort."""
     try:
         import jax
-        return "jax" if jax.default_backend() == "tpu" else "cpu"
+        if jax.default_backend() == "tpu":
+            return "jax"
     except Exception:  # pragma: no cover
-        return "cpu"
+        pass
+    try:
+        from ...ops import rs_native
+        if rs_native.available():
+            return "native"
+    except Exception:  # pragma: no cover
+        pass
+    return "cpu"
 
 
 @dataclass
@@ -68,6 +78,10 @@ class ECContext:
         if self.backend == "jax":
             from ...ops.rs_jax import ReedSolomonJax
             return ReedSolomonJax(self.data_shards, self.parity_shards)
+        if self.backend == "native":
+            from ...ops.rs_native import ReedSolomonNative
+            return ReedSolomonNative(self.data_shards,
+                                     self.parity_shards)
         from ...ops.rs_cpu import ReedSolomonCPU
         return ReedSolomonCPU(self.data_shards, self.parity_shards)
 

@@ -1,0 +1,65 @@
+"""Native C++ codec: bit-identity vs the numpy twin + throughput sanity
+(cross-implementation parity, SURVEY §4.3)."""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import rs_cpu
+
+rs_native = pytest.importorskip("seaweedfs_tpu.ops.rs_native")
+
+needs_native = pytest.mark.skipif(
+    not rs_native.available(), reason="no native toolchain")
+
+
+@needs_native
+def test_native_parity_matches_cpu():
+    rng = np.random.default_rng(0)
+    for d, p in [(10, 4), (6, 3), (3, 2)]:
+        data = rng.integers(0, 256, size=(d, 10_000), dtype=np.uint8)
+        a = rs_cpu.ReedSolomonCPU(d, p).parity(data)
+        b = rs_native.ReedSolomonNative(d, p).parity(data)
+        np.testing.assert_array_equal(a, b)
+
+
+@needs_native
+def test_native_reconstruct_matches_cpu():
+    rng = np.random.default_rng(1)
+    d, p, n = 10, 4, 8_192
+    cpu = rs_cpu.ReedSolomonCPU(d, p)
+    nat = rs_native.ReedSolomonNative(d, p)
+    data = rng.integers(0, 256, size=(d, n), dtype=np.uint8)
+    full = cpu.encode(np.concatenate(
+        [data, np.zeros((p, n), np.uint8)]))
+    for lost in [(0,), (0, 5), (0, 5, 11), (1, 2, 12, 13)]:
+        present = [i not in lost for i in range(d + p)]
+        damaged = full.copy()
+        damaged[list(lost)] = 0
+        a = cpu.reconstruct(damaged, present)
+        b = nat.reconstruct(damaged, present)
+        np.testing.assert_array_equal(a, full)
+        np.testing.assert_array_equal(b, full)
+
+
+@needs_native
+def test_native_verify():
+    rng = np.random.default_rng(2)
+    nat = rs_native.ReedSolomonNative(10, 4)
+    data = rng.integers(0, 256, size=(10, 4096), dtype=np.uint8)
+    full = nat.encode(np.concatenate(
+        [data, np.zeros((4, 4096), np.uint8)]))
+    assert nat.verify(full)
+    full[3, 100] ^= 1
+    assert not nat.verify(full)
+
+
+@needs_native
+def test_native_odd_sizes():
+    """Tail handling: sizes not multiples of the 32B vector width."""
+    rng = np.random.default_rng(3)
+    cpu = rs_cpu.ReedSolomonCPU(4, 2)
+    nat = rs_native.ReedSolomonNative(4, 2)
+    for n in (1, 31, 32, 33, 63, 65, 1000):
+        data = rng.integers(0, 256, size=(4, n), dtype=np.uint8)
+        np.testing.assert_array_equal(cpu.parity(data),
+                                      nat.parity(data))
