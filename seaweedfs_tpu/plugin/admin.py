@@ -44,6 +44,7 @@ class Job:
     progress: float = 0.0
     message: str = ""
     created: float = field(default_factory=time.time)
+    updated: float = field(default_factory=time.time)
 
 
 class AdminServer:
@@ -172,6 +173,7 @@ class AdminServer:
             if job is not None:
                 job.progress = float(b.get("progress", 0.0))
                 job.message = b.get("message", "")
+                job.updated = time.time()
         return 200, {}
 
     def _complete(self, req: Request):
@@ -180,15 +182,19 @@ class AdminServer:
             self._touch(b.get("workerId", ""))
             job = self.jobs.get(b["jobId"])
             if job is not None:
-                if job.status == "assigned" and \
-                        job.worker_id != b.get("workerId", ""):
-                    # late report from a reaped worker whose job was
-                    # reassigned — the current owner's report decides
+                reporter = b.get("workerId", "")
+                if job.status in ("done", "failed") or (
+                        job.status == "assigned" and
+                        job.worker_id != reporter):
+                    # finished already, or a late report from a reaped
+                    # worker whose job was reassigned — the owner's
+                    # report decided; never double-account inflight
                     return 200, {"ignored": True}
                 job.status = "done" if b.get("success") else "failed"
                 job.message = b.get("message", "")
                 job.progress = 1.0
-                w = self.workers.get(job.worker_id)
+                job.updated = time.time()
+                w = self.workers.get(reporter)
                 if w is not None:
                     w.inflight = max(0, w.inflight - 1)
         return 200, {}
@@ -215,6 +221,9 @@ class AdminServer:
     # a worker silent for this long is presumed dead; its assigned jobs
     # requeue so the dedupe key stops blocking re-detection
     WORKER_DEAD_AFTER = 60.0
+    # an assigned job with no progress for this long requeues even if
+    # its worker still polls (covers a lost completion report)
+    JOB_STALL_AFTER = 300.0
 
     def _detection_loop(self) -> None:
         tick = min(self.detection_interval, 5.0)
@@ -232,14 +241,20 @@ class AdminServer:
     def _reap_dead_workers(self) -> None:
         now = time.time()
         with self.lock:
-            dead = [wid for wid, w in self.workers.items()
+            dead = {wid for wid, w in self.workers.items()
                     if w.inflight > 0 and
-                    now - w.last_seen > self.WORKER_DEAD_AFTER]
+                    now - w.last_seen > self.WORKER_DEAD_AFTER}
+            for job in self.jobs.values():
+                if job.status != "assigned":
+                    continue
+                stalled = now - job.updated > self.JOB_STALL_AFTER
+                if job.worker_id in dead or stalled:
+                    w = self.workers.get(job.worker_id)
+                    if w is not None and job.worker_id not in dead:
+                        w.inflight = max(0, w.inflight - 1)
+                    job.status = "pending"
+                    job.worker_id = ""
+                    job.updated = now
+                    job.message = "requeued: worker lost or stalled"
             for wid in dead:
-                for job in self.jobs.values():
-                    if job.status == "assigned" and \
-                            job.worker_id == wid:
-                        job.status = "pending"
-                        job.worker_id = ""
-                        job.message = "requeued: worker lost"
                 self.workers[wid].inflight = 0

@@ -17,9 +17,11 @@ def must(r: dict, what: str) -> dict:
     return r
 
 
-def _post_with_retry(url: str, payload: dict, attempts: int = 5) -> None:
+def _post_with_retry(url: str, payload: dict, attempts: int = 30) -> None:
     """Report-back POSTs must survive transient admin outages — a lost
-    completion report would otherwise kill the worker loop thread."""
+    completion report would otherwise kill the worker loop thread.
+    ~5 minutes of capped backoff; a still-lost report is backstopped by
+    the admin's job-stall requeue (admin.py JOB_STALL_AFTER)."""
     import time
     for i in range(attempts):
         try:

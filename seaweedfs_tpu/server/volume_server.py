@@ -66,6 +66,8 @@ class VolumeServer:
         r("POST", "/admin/ec/to_volume", self._ec_to_volume)
         r("GET", "/admin/ec/shard_read", self._ec_shard_read)
         r("GET", "/admin/ec/info", self._ec_info)
+        r("POST", "/admin/scrub", self._scrub)
+        r("POST", "/admin/ec/scrub", self._ec_scrub)
         self.http.fallback = self._data_path
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
@@ -440,6 +442,32 @@ class VolumeServer:
         if ev is None or shard_id not in ev.shards:
             return 404, {"error": f"shard {vid}.{shard_id} not found"}
         return 200, ev.shards[shard_id].read_at(offset, size)
+
+    def _scrub(self, req: Request):
+        """server/volume_grpc_scrub.go ScrubVolume."""
+        vid = int(req.json()["volumeId"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return 404, {"error": f"volume {vid} not found"}
+        count, errors = v.scrub()
+        return 200, {"checked": count, "errors": errors}
+
+    def _ec_scrub(self, req: Request):
+        """server/volume_grpc_scrub.go ScrubEcVolume; modes index/local
+        (shell/command_ec_scrub.go:52)."""
+        b = req.json()
+        vid = int(b["volumeId"])
+        mode = b.get("mode", "local")
+        ev = self.store.find_ec_volume(vid)
+        if ev is None:
+            return 404, {"error": f"ec volume {vid} not mounted"}
+        if mode == "index":
+            count, errors = ev.scrub_index()
+            return 200, {"checked": count, "errors": errors,
+                         "brokenShards": []}
+        count, broken, errors = ev.scrub_local()
+        return 200, {"checked": count, "errors": errors,
+                     "brokenShards": broken}
 
     def _ec_info(self, req: Request):
         """:688 VolumeEcShardsInfo."""

@@ -161,44 +161,98 @@ def _do_ec_encode(env: CommandEnv, vid: int, data_shards: int,
             f"moved {moved} shards, deleted originals")
 
 
+def _rack_of_nodes(env: CommandEnv) -> dict[str, str]:
+    """url -> "dc/rack" from the topology tree."""
+    vl = env.volume_list()
+    out: dict[str, str] = {}
+    for dc_name, dc in vl.get("dataCenters", {}).items():
+        for rack_name, rack in dc.get("racks", {}).items():
+            for node in rack.get("nodes", []):
+                out[node["url"]] = f"{dc_name}/{rack_name}"
+    return out
+
+
 def _balance_ec_volume(env: CommandEnv, vid: int, collection: str,
                        total: int) -> int:
-    """Spread one volume's shards across servers: dedupe, then even out
-    per-node shard counts (the core of command_ec_common.go:59-124's
-    balance pseudocode; rack-awareness lands with the full balancer)."""
+    """The balance algorithm of command_ec_common.go:59-124:
+    (1) dedupe shard copies, (2) spread shards across racks toward
+    total/numRacks per rack, (3) even out per-server counts within each
+    rack."""
     shard_locs = _ec_shard_locations(env, vid)
     nodes = _all_node_urls(env)
     if not nodes:
         return 0
+    rack_of = _rack_of_nodes(env)
     moved = 0
-    # dedupe: keep first copy of each shard
-    seen: dict[int, str] = {}
+
+    # (1) dedupe: keep first copy of each shard
+    owner: dict[int, str] = {}
     for url, sids in sorted(shard_locs.items()):
         for sid in sids:
-            if sid in seen:
+            if sid in owner:
                 _delete_shards(url, vid, collection, [sid])
                 moved += 1
             else:
-                seen[sid] = url
-    # even out: move shards from over-loaded to under-loaded nodes
-    target_per_node = max(1, -(-total // len(nodes)))  # ceil
-    load: dict[str, list[int]] = {n: [] for n in nodes}
-    for sid, url in seen.items():
-        load.setdefault(url, []).append(sid)
-    donors = sorted(((u, s) for u, s in load.items()
-                     if len(s) > target_per_node),
-                    key=lambda t: -len(t[1]))
-    for donor_url, sids in donors:
-        while len(sids) > target_per_node:
-            receivers = sorted(load.items(), key=lambda t: len(t[1]))
-            recv_url, recv_sids = receivers[0]
-            if recv_url == donor_url or \
-                    len(recv_sids) >= target_per_node:
+                owner[sid] = url
+
+    def load_by_url() -> dict[str, list[int]]:
+        load = {n: [] for n in nodes}
+        for sid, url in owner.items():
+            load.setdefault(url, []).append(sid)
+        return load
+
+    def move(sid: int, src: str, dst: str):
+        nonlocal moved
+        _move_shard(env, vid, collection, sid, src, dst)
+        owner[sid] = dst
+        moved += 1
+
+    # (2) across racks: doBalanceEcShardsAcrossRacks.  Only racks with
+    # an alive member can receive (shards may sit on dead nodes whose
+    # rack has no live servers).
+    racks = sorted({rack_of.get(n, "?") for n in nodes})
+    avg_per_rack = max(1, -(-total // max(len(racks), 1)))  # ceil
+    def rack_load() -> dict[str, list[int]]:
+        rl: dict[str, list[int]] = {r: [] for r in racks}
+        for sid, url in owner.items():
+            rl.setdefault(rack_of.get(url, "?"), []).append(sid)
+        return rl
+    rl = rack_load()
+    for rack in sorted(rl, key=lambda r: -len(rl[r])):
+        while len(rl[rack]) > avg_per_rack:
+            receivable = [r for r in rl if r != rack and
+                          any(rack_of.get(n, "?") == r for n in nodes)]
+            if not receivable:
                 break
-            sid = sids.pop()
-            _move_shard(env, vid, collection, sid, donor_url, recv_url)
-            recv_sids.append(sid)
-            moved += 1
+            dest_rack = min(receivable, key=lambda r: len(rl[r]))
+            if len(rl[dest_rack]) + 1 > avg_per_rack:
+                break
+            load = load_by_url()
+            dest_candidates = [n for n in nodes
+                               if rack_of.get(n, "?") == dest_rack]
+            dst = min(dest_candidates, key=lambda n: len(load[n]))
+            sid = rl[rack][-1]
+            move(sid, owner[sid], dst)
+            rl = rack_load()
+
+    # (3) within racks: doBalanceEcShardsWithinOneRack
+    for rack in racks:
+        members = [n for n in nodes if rack_of.get(n, "?") == rack]
+        if not members:
+            continue
+        load = load_by_url()
+        rack_shards = [sid for sid, url in owner.items()
+                       if url in members]
+        avg = max(1, -(-len(rack_shards) // len(members)))
+        for donor in sorted(members, key=lambda n: -len(load[n])):
+            while len(load[donor]) > avg:
+                recv = min(members, key=lambda n: len(load[n]))
+                if recv == donor or len(load[recv]) + 1 > avg:
+                    break
+                sid = load[donor][-1]
+                move(sid, donor, recv)
+                load[donor].remove(sid)
+                load[recv].append(sid)
     return moved
 
 
@@ -333,6 +387,49 @@ def cmd_ec_balance(env: CommandEnv, args: list[str]) -> str:
         moved = _balance_ec_volume(env, vid, collection, total)
         out.append(f"volume {vid}: moved {moved} shards")
     return "\n".join(out) if out else "no ec volumes"
+
+
+@command("ec.scrub")
+def cmd_ec_scrub(env: CommandEnv, args: list[str]) -> str:
+    """shell/command_ec_scrub.go:31 — modes index/local (:52)."""
+    opts = _parse_flags(args)
+    mode = opts.get("mode", "local")
+    out = []
+    for vid in _ec_volumes(env):
+        for url in _ec_shard_locations(env, vid):
+            r = http_json("POST", f"{url}/admin/ec/scrub",
+                          {"volumeId": vid, "mode": mode})
+            if r.get("error"):
+                out.append(f"volume {vid} @ {url}: ERROR {r['error']}")
+            else:
+                status = "ok" if not r["errors"] else \
+                    f"{len(r['errors'])} errors, broken shards " \
+                    f"{r['brokenShards']}"
+                out.append(f"volume {vid} @ {url}: checked "
+                           f"{r['checked']} entries, {status}")
+    return "\n".join(out) if out else "no ec volumes"
+
+
+@command("volume.scrub")
+def cmd_volume_scrub(env: CommandEnv, args: list[str]) -> str:
+    """CRC-verify every needle of every (or one) volume
+    (volume.fsck-style integrity pass)."""
+    opts = _parse_flags(args)
+    target = int(opts["volumeId"]) if "volumeId" in opts else None
+    out = []
+    for vid, urls in sorted(_volumes_by_id(env).items()):
+        if target is not None and vid != target:
+            continue
+        for url in urls:
+            r = http_json("POST", f"{url}/admin/scrub",
+                          {"volumeId": vid})
+            if r.get("error"):
+                out.append(f"volume {vid} @ {url}: ERROR {r['error']}")
+            else:
+                status = "ok" if not r["errors"] else r["errors"][:3]
+                out.append(f"volume {vid} @ {url}: checked "
+                           f"{r['checked']}, {status}")
+    return "\n".join(out) if out else "no volumes"
 
 
 # --- helpers -------------------------------------------------------------

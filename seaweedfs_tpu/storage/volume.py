@@ -250,6 +250,24 @@ class Volume:
         self.compact()
         self.commit_compact()
 
+    # -- scrub (server/volume_grpc_scrub.go analog) -----------------------
+
+    def scrub(self) -> tuple[int, list[str]]:
+        """Read + CRC-verify every live needle.  Returns
+        (checked_count, errors)."""
+        errors: list[str] = []
+        count = 0
+        with self.lock:  # snapshot only; don't hold across the I/O sweep
+            entries = list(self.nm.items())
+        for key, stored_off, size in entries:
+            count += 1
+            try:
+                with self.lock:
+                    self._read_at(stored_off, size)
+            except Exception as e:  # noqa: BLE001 — collect all
+                errors.append(f"needle {key:x}: {e}")
+        return count, errors
+
     # -- lifecycle -------------------------------------------------------
 
     def sync(self) -> None:

@@ -156,3 +156,41 @@ def test_volume_growth_on_demand(cluster):
     # force growth by uploading to a fresh collection
     fid = operation.submit(master.url, b"grow!", collection="newcol")
     assert operation.read(master.url, fid) == b"grow!"
+
+
+def test_scrub_commands(cluster):
+    master, servers = cluster
+    blobs = _upload_corpus(master.url, n=10, seed=7)
+    vid = int(next(iter(blobs)).split(",")[0])
+    env = CommandEnv(master.url)
+    out = run_command(env, "volume.scrub")
+    assert "checked" in out and "ERROR" not in out
+    run_command(env, "lock")
+    run_command(env, f"ec.encode -volumeId={vid}")
+    time.sleep(0.5)
+    out = run_command(env, "ec.scrub -mode=index")
+    assert "checked" in out and "ERROR" not in out
+    out = run_command(env, "ec.scrub -mode=local")
+    assert "checked" in out and "ERROR" not in out
+
+
+def test_ec_balance_rack_aware(cluster):
+    """Shards spread across the 3 racks (servers carry rack0/1/2)."""
+    master, servers = cluster
+    blobs = _upload_corpus(master.url, n=12, seed=8)
+    vid = int(next(iter(blobs)).split(",")[0])
+    env = CommandEnv(master.url)
+    run_command(env, "lock")
+    run_command(env, f"ec.encode -volumeId={vid}")
+    time.sleep(0.5)
+    # map shards to racks
+    from seaweedfs_tpu.shell.commands import (_ec_shard_locations,
+                                              _rack_of_nodes)
+    locs = _ec_shard_locations(env, vid)
+    rack_of = _rack_of_nodes(env)
+    per_rack = {}
+    for url, sids in locs.items():
+        per_rack.setdefault(rack_of[url], []).extend(sids)
+    assert len(per_rack) == 3, per_rack
+    counts = sorted(len(s) for s in per_rack.values())
+    assert counts[-1] - counts[0] <= 2, per_rack
