@@ -40,6 +40,23 @@ def main(argv: list[str] | None = None) -> int:
                    default=8080)
     s.add_argument("-dir", default=".")
 
+    fl = sub.add_parser("filer", help="start a filer server")
+    fl.add_argument("-ip", default="127.0.0.1")
+    fl.add_argument("-port", type=int, default=8888)
+    fl.add_argument("-master", default="127.0.0.1:9333")
+    fl.add_argument("-store", default="filer.db",
+                    help="sqlite path, or :memory:")
+    fl.add_argument("-collection", default="")
+    fl.add_argument("-replication", default="")
+
+    s3p = sub.add_parser("s3", help="start the S3 gateway (on a filer)")
+    s3p.add_argument("-ip", default="127.0.0.1")
+    s3p.add_argument("-port", type=int, default=8333)
+    s3p.add_argument("-master", default="127.0.0.1:9333")
+    s3p.add_argument("-store", default="filer.db")
+    s3p.add_argument("-accessKey", default="")
+    s3p.add_argument("-secretKey", default="")
+
     ad = sub.add_parser("admin", help="start the maintenance admin server")
     ad.add_argument("-ip", default="127.0.0.1")
     ad.add_argument("-port", type=int, default=23646)
@@ -95,6 +112,26 @@ def main(argv: list[str] | None = None) -> int:
         vs = VolumeServer([args.dir], ms.url, host=args.ip,
                           port=args.volume_port).start()
         print(f"master on {ms.url}, volume on {vs.url}")
+        _wait()
+    elif args.cmd == "filer":
+        from .server.filer_server import FilerServer
+        fs = FilerServer(args.master, args.ip, args.port,
+                         store_path=args.store,
+                         collection=args.collection,
+                         replication=args.replication)
+        fs.start()
+        print(f"filer listening on {fs.url}")
+        _wait()
+    elif args.cmd == "s3":
+        from .s3 import S3ApiServer
+        from .filer import Filer
+        from .filer.filer_store import SqliteStore
+        creds = {args.accessKey: args.secretKey} if args.accessKey \
+            else None
+        filer = Filer(args.master, SqliteStore(args.store))
+        gw = S3ApiServer(filer, args.ip, args.port, credentials=creds)
+        gw.start()
+        print(f"s3 gateway listening on {gw.url}")
         _wait()
     elif args.cmd == "admin":
         from .plugin.admin import AdminServer

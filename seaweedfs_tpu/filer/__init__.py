@@ -1,0 +1,11 @@
+"""Filer: POSIX-ish namespace over the volume store (weed/filer).
+
+Entries (directories + files) live in a pluggable FilerStore; file
+content is a list of chunks, each a needle in some volume
+(filer/filechunks.go ChunkView model).  The S3 / WebDAV gateways sit on
+top of this layer.
+"""
+
+from .entry import Attributes, Entry, FileChunk  # noqa: F401
+from .filer import Filer  # noqa: F401
+from .filer_store import FilerStore, MemoryStore, SqliteStore  # noqa: F401

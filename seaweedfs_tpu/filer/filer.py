@@ -1,0 +1,199 @@
+"""The Filer: namespace operations over a FilerStore + chunked content
+on the volume cluster (weed/filer/filer.go).
+
+Mutations emit metadata events to an in-process log consumed by
+subscription streams (filer/filer_notify.go) — the backbone for
+filer.sync / mount cache invalidation / S3 events.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+from .. import operation
+from .entry import Attributes, Entry, FileChunk, normalize_path
+from .filechunks import total_size, view_from_chunks
+from .filer_store import FilerStore, MemoryStore
+
+CHUNK_SIZE = 4 * 1024 * 1024  # filer auto-chunk default (8MB in ref CLI)
+
+
+class Filer:
+    def __init__(self, master: str, store: FilerStore | None = None,
+                 collection: str = "", replication: str = ""):
+        self.master = master
+        self.store = store or MemoryStore()
+        self.collection = collection
+        self.replication = replication
+        self._log_lock = threading.Lock()
+        # bounded in-memory event ring (the reference persists its log
+        # to /topics/... files; pollers that fall behind the ring must
+        # resync with a full listing)
+        self._meta_log: deque[dict] = deque(maxlen=10_000)
+        self._listeners: list[Callable[[dict], None]] = []
+
+    # -- namespace ops ----------------------------------------------------
+
+    def create_entry(self, entry: Entry,
+                     create_parents: bool = True) -> None:
+        entry.full_path = normalize_path(entry.full_path)
+        if create_parents:
+            self._ensure_parents(entry.full_path)
+        old = self.store.find_entry(entry.full_path)
+        self.store.insert_entry(entry)
+        self._notify("update" if old else "create", entry, old)
+
+    def _ensure_parents(self, path: str) -> None:
+        parent = path.rsplit("/", 1)[0]
+        if not parent or parent == "/":
+            return
+        if self.store.find_entry(parent) is None:
+            e = Entry(parent, is_directory=True,
+                      attributes=Attributes(mode=0o770))
+            self._ensure_parents(parent)
+            self.store.insert_entry(e)
+            self._notify("create", e, None)
+
+    def find_entry(self, path: str) -> Entry | None:
+        return self.store.find_entry(normalize_path(path))
+
+    def delete_entry(self, path: str, recursive: bool = False,
+                     delete_chunks: bool = True) -> None:
+        path = normalize_path(path)
+        entry = self.store.find_entry(path)
+        if entry is None:
+            return
+        if entry.is_directory:
+            children = self.store.list_directory_entries(path, limit=2)
+            if children and not recursive:
+                raise IsADirectoryError(f"{path} not empty")
+            self._delete_tree(path, delete_chunks)
+        elif delete_chunks:
+            self._delete_chunks(entry)
+        self.store.delete_entry(path)
+        self._notify("delete", None, entry)
+
+    def _delete_tree(self, path: str, delete_chunks: bool) -> None:
+        while True:
+            children = self.store.list_directory_entries(path,
+                                                         limit=1000)
+            if not children:
+                break
+            for child in children:
+                if child.is_directory:
+                    self._delete_tree(child.full_path, delete_chunks)
+                elif delete_chunks:
+                    self._delete_chunks(child)
+                self.store.delete_entry(child.full_path)
+                self._notify("delete", None, child)
+
+    def _delete_chunks(self, entry: Entry) -> None:
+        for c in entry.chunks:
+            try:
+                operation.delete(self.master, c.file_id)
+            except (OSError, LookupError, RuntimeError):
+                pass  # orphan cleanup is a maintenance job
+
+    def list_directory(self, path: str, start_file: str = "",
+                       include_start: bool = False, limit: int = 1000,
+                       prefix: str = "") -> list[Entry]:
+        return self.store.list_directory_entries(
+            normalize_path(path), start_file, include_start, limit,
+            prefix)
+
+    def rename(self, old_path: str, new_path: str) -> None:
+        """Atomic within the store (filer.proto AtomicRenameEntry);
+        directories move their whole subtree."""
+        old_path = normalize_path(old_path)
+        new_path = normalize_path(new_path)
+        entry = self.store.find_entry(old_path)
+        if entry is None:
+            raise FileNotFoundError(old_path)
+        self._ensure_parents(new_path)
+        if entry.is_directory:
+            for child in self.store.list_directory_entries(
+                    old_path, limit=1_000_000):
+                self.rename(child.full_path,
+                            new_path + "/" + child.name)
+        old_entry = copy.copy(entry)  # event must carry the OLD path
+        entry.full_path = new_path
+        self.store.insert_entry(entry)
+        self.store.delete_entry(old_path)
+        self._notify("rename", entry, old_entry)
+
+    # -- content IO -------------------------------------------------------
+
+    def write_file(self, path: str, data: bytes, mime: str = "",
+                   mode: int = 0o660) -> Entry:
+        """Auto-chunking upload
+        (server/filer_server_handlers_write_autochunk.go:25)."""
+        chunks = []
+        for off in range(0, len(data), CHUNK_SIZE):
+            piece = data[off:off + CHUNK_SIZE]
+            a = operation.assign(self.master,
+                                 collection=self.collection,
+                                 replication=self.replication)
+            r = operation.upload(a.url, a.fid, piece)
+            chunks.append(FileChunk(a.fid, off, len(piece),
+                                    r.get("eTag", ""),
+                                    time.time_ns()))
+        entry = Entry(normalize_path(path), is_directory=False,
+                      attributes=Attributes(mime=mime, mode=mode),
+                      chunks=chunks)
+        old = self.find_entry(path)
+        self.create_entry(entry)
+        if old is not None and not old.is_directory:
+            self._delete_chunks(old)
+        return entry
+
+    def read_file(self, path: str, offset: int = 0,
+                  size: int | None = None) -> bytes:
+        """Chunk-resolved ranged read (filer/stream.go:99)."""
+        entry = self.find_entry(path)
+        if entry is None or entry.is_directory:
+            raise FileNotFoundError(path)
+        file_size = total_size(entry.chunks)
+        if size is None:
+            size = file_size - offset
+        size = max(0, min(size, file_size - offset))
+        if size == 0:
+            return b""
+        out = bytearray(size)
+        for view in view_from_chunks(entry.chunks, offset, size):
+            blob = operation.read(self.master, view.file_id)
+            piece = blob[view.chunk_offset:
+                         view.chunk_offset + view.size]
+            lo = view.logical_offset - offset
+            out[lo:lo + len(piece)] = piece
+        return bytes(out)
+
+    # -- metadata subscription (filer/filer_notify.go) --------------------
+
+    def _notify(self, op: str, new_entry: Entry | None,
+                old_entry: Entry | None) -> None:
+        event = {
+            "op": op,
+            "tsNs": time.time_ns(),
+            "newEntry": new_entry.to_json() if new_entry else None,
+            "oldEntry": old_entry.to_json() if old_entry else None,
+        }
+        with self._log_lock:
+            self._meta_log.append(event)
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(event)
+            except Exception:  # noqa: BLE001 — listeners are isolated
+                pass
+
+    def subscribe(self, fn: Callable[[dict], None]) -> None:
+        with self._log_lock:
+            self._listeners.append(fn)
+
+    def events_since(self, ts_ns: int) -> list[dict]:
+        with self._log_lock:
+            return [e for e in self._meta_log if e["tsNs"] > ts_ns]

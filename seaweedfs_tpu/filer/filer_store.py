@@ -1,0 +1,183 @@
+"""Pluggable filer metadata stores (weed/filer/filerstore.go).
+
+The reference ships 24 backends; we ship the two archetypes the rest
+derive from: an in-memory dict store (tests / ephemeral) and a SQLite
+store (the abstract_sql family — one (dirhash, name)-keyed table, the
+same schema shape as filer/abstract_sql/abstract_sql_store.go) giving a
+durable single-node default with real prefix-scans.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+
+from .entry import Entry, normalize_path
+
+
+class FilerStore:
+    """Interface: insert/update/find/delete/list, per directory."""
+
+    def insert_entry(self, entry: Entry) -> None:
+        raise NotImplementedError
+
+    def update_entry(self, entry: Entry) -> None:
+        raise NotImplementedError
+
+    def find_entry(self, path: str) -> Entry | None:
+        raise NotImplementedError
+
+    def delete_entry(self, path: str) -> None:
+        raise NotImplementedError
+
+    def delete_folder_children(self, path: str) -> None:
+        raise NotImplementedError
+
+    def list_directory_entries(self, dir_path: str, start_file: str = "",
+                               include_start: bool = False,
+                               limit: int = 1000,
+                               prefix: str = "") -> list[Entry]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryStore(FilerStore):
+    def __init__(self):
+        self._by_dir: dict[str, dict[str, Entry]] = {}
+        self._lock = threading.RLock()
+
+    def insert_entry(self, entry: Entry) -> None:
+        with self._lock:
+            self._by_dir.setdefault(entry.parent, {})[entry.name] = entry
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Entry | None:
+        path = normalize_path(path)
+        if path == "/":
+            return Entry("/", is_directory=True)
+        parent, name = path.rsplit("/", 1)
+        with self._lock:
+            return self._by_dir.get(parent or "/", {}).get(name)
+
+    def delete_entry(self, path: str) -> None:
+        path = normalize_path(path)
+        parent, name = path.rsplit("/", 1)
+        with self._lock:
+            self._by_dir.get(parent or "/", {}).pop(name, None)
+
+    def delete_folder_children(self, path: str) -> None:
+        path = normalize_path(path)
+        with self._lock:
+            self._by_dir.pop(path, None)
+            for d in [d for d in self._by_dir
+                      if d.startswith(path + "/")]:
+                self._by_dir.pop(d, None)
+
+    def list_directory_entries(self, dir_path: str, start_file: str = "",
+                               include_start: bool = False,
+                               limit: int = 1000,
+                               prefix: str = "") -> list[Entry]:
+        dir_path = normalize_path(dir_path)
+        with self._lock:
+            names = sorted(self._by_dir.get(dir_path, {}))
+            out = []
+            for n in names:
+                if prefix and not n.startswith(prefix):
+                    continue
+                if start_file:
+                    if n < start_file or \
+                            (n == start_file and not include_start):
+                        continue
+                out.append(self._by_dir[dir_path][n])
+                if len(out) >= limit:
+                    break
+            return out
+
+
+class SqliteStore(FilerStore):
+    """abstract_sql-family store: one table keyed (directory, name)."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS filemeta ("
+            " directory TEXT NOT NULL,"
+            " name TEXT NOT NULL,"
+            " meta TEXT NOT NULL,"
+            " PRIMARY KEY (directory, name))")
+        self._db.execute(
+            "CREATE INDEX IF NOT EXISTS filemeta_dir "
+            "ON filemeta (directory, name)")
+        self._db.commit()
+
+    def insert_entry(self, entry: Entry) -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO filemeta "
+                "(directory, name, meta) VALUES (?, ?, ?)",
+                (entry.parent, entry.name,
+                 json.dumps(entry.to_json())))
+            self._db.commit()
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Entry | None:
+        path = normalize_path(path)
+        if path == "/":
+            return Entry("/", is_directory=True)
+        parent, name = path.rsplit("/", 1)
+        with self._lock:
+            row = self._db.execute(
+                "SELECT meta FROM filemeta WHERE directory=? AND name=?",
+                (parent or "/", name)).fetchone()
+        return Entry.from_json(json.loads(row[0])) if row else None
+
+    def delete_entry(self, path: str) -> None:
+        path = normalize_path(path)
+        parent, name = path.rsplit("/", 1)
+        with self._lock:
+            self._db.execute(
+                "DELETE FROM filemeta WHERE directory=? AND name=?",
+                (parent or "/", name))
+            self._db.commit()
+
+    @staticmethod
+    def _like_escape(s: str) -> str:
+        r"""Escape LIKE wildcards; every LIKE here uses ESCAPE '\'."""
+        return s.replace("\\", "\\\\").replace("%", r"\%") \
+                .replace("_", r"\_")
+
+    def delete_folder_children(self, path: str) -> None:
+        path = normalize_path(path)
+        with self._lock:
+            self._db.execute(
+                "DELETE FROM filemeta WHERE directory=? OR "
+                r"directory LIKE ? ESCAPE '\'",
+                (path, self._like_escape(path) + "/%"))
+            self._db.commit()
+
+    def list_directory_entries(self, dir_path: str, start_file: str = "",
+                               include_start: bool = False,
+                               limit: int = 1000,
+                               prefix: str = "") -> list[Entry]:
+        dir_path = normalize_path(dir_path)
+        op = ">=" if include_start else ">"
+        q = ("SELECT meta FROM filemeta WHERE directory=? AND "
+             f"name {op} ? ")
+        args: list = [dir_path, start_file]
+        if prefix:
+            q += r"AND name LIKE ? ESCAPE '\' "
+            args.append(self._like_escape(prefix) + "%")
+        q += "ORDER BY name LIMIT ?"
+        args.append(limit)
+        with self._lock:
+            rows = self._db.execute(q, args).fetchall()
+        return [Entry.from_json(json.loads(r[0])) for r in rows]
+
+    def close(self) -> None:
+        self._db.close()

@@ -183,12 +183,12 @@ class AdminServer:
             job = self.jobs.get(b["jobId"])
             if job is not None:
                 reporter = b.get("workerId", "")
-                if job.status in ("done", "failed") or (
-                        job.status == "assigned" and
-                        job.worker_id != reporter):
-                    # finished already, or a late report from a reaped
-                    # worker whose job was reassigned — the owner's
-                    # report decided; never double-account inflight
+                if job.status != "assigned" or \
+                        job.worker_id != reporter:
+                    # only the current owner of a live assignment may
+                    # complete it: finished jobs, stall-requeued jobs
+                    # (status pending — inflight already returned by the
+                    # reaper), and reassigned jobs all ignore the report
                     return 200, {"ignored": True}
                 job.status = "done" if b.get("success") else "failed"
                 job.message = b.get("message", "")

@@ -1,0 +1,3 @@
+"""S3 API gateway over the filer (weed/s3api)."""
+
+from .s3_server import S3ApiServer  # noqa: F401

@@ -1,0 +1,373 @@
+"""S3 REST gateway over the filer (weed/s3api/s3api_server.go and
+handler files; buckets live under /buckets/<name> as in the reference's
+filer layout).
+
+Implemented surface (the core the reference's s3tests exercise first):
+  ListBuckets, Create/Delete/Head bucket, Put/Get/Head/Delete object,
+  batch DeleteObjects, ListObjectsV2 (prefix/delimiter/continuation),
+  multipart (initiate/uploadPart/complete/abort/listParts), SigV4 auth.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+
+from ..filer import Entry, Filer
+from ..filer.filechunks import total_size
+from ..server.httpd import HttpServer, Request
+from .auth import SigV4Verifier
+
+BUCKETS_ROOT = "/buckets"
+UPLOADS_DIR = "/.uploads"
+S3_NS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+def _xml(root: ET.Element) -> bytes:
+    return b'<?xml version="1.0" encoding="UTF-8"?>' + \
+        ET.tostring(root)
+
+
+def _elem(parent, tag, text=None):
+    e = ET.SubElement(parent, tag)
+    if text is not None:
+        e.text = str(text)
+    return e
+
+
+def _error(status: int, code: str, message: str):
+    root = ET.Element("Error")
+    _elem(root, "Code", code)
+    _elem(root, "Message", message)
+    return status, (_xml(root), "application/xml")
+
+
+def _iso(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))
+
+
+class S3ApiServer:
+    def __init__(self, filer: Filer, host: str = "127.0.0.1",
+                 port: int = 0,
+                 credentials: dict[str, str] | None = None):
+        self.filer = filer
+        self.verifier = SigV4Verifier(credentials) if credentials else None
+        self.http = HttpServer(host, port)
+        self.http.fallback = self._dispatch
+
+    def start(self):
+        self.http.start()
+        return self
+
+    def stop(self):
+        self.http.stop()
+
+    @property
+    def url(self) -> str:
+        return self.http.url
+
+    # -- dispatch ---------------------------------------------------------
+
+    def _dispatch(self, req: Request):
+        if self.verifier is not None:
+            ok, who = self.verifier.verify(
+                req.method, req.path, req.query,
+                {k.lower(): v for k, v in req.headers.items()},
+                req.body)
+            if not ok:
+                return _error(403, "AccessDenied", who)
+        parts = req.path.lstrip("/").split("/", 1)
+        bucket = urllib.parse.unquote(parts[0]) if parts[0] else ""
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        if not bucket:
+            if req.method == "GET":
+                return self._list_buckets()
+            return _error(405, "MethodNotAllowed", req.method)
+        if not key:
+            return self._bucket_op(req, bucket)
+        return self._object_op(req, bucket, key)
+
+    # -- buckets ----------------------------------------------------------
+
+    def _bucket_path(self, bucket: str) -> str:
+        return f"{BUCKETS_ROOT}/{bucket}"
+
+    def _list_buckets(self):
+        root = ET.Element("ListAllMyBucketsResult", xmlns=S3_NS)
+        owner = _elem(root, "Owner")
+        _elem(owner, "ID", "seaweedfs-tpu")
+        buckets = _elem(root, "Buckets")
+        for e in self.filer.list_directory(BUCKETS_ROOT):
+            if e.is_directory and not e.name.startswith("."):
+                b = _elem(buckets, "Bucket")
+                _elem(b, "Name", e.name)
+                _elem(b, "CreationDate", _iso(e.attributes.crtime))
+        return 200, (_xml(root), "application/xml")
+
+    def _bucket_op(self, req: Request, bucket: str):
+        path = self._bucket_path(bucket)
+        if req.method == "PUT":
+            self.filer.create_entry(Entry(path, is_directory=True))
+            return 200, b""
+        if req.method == "HEAD":
+            if self.filer.find_entry(path) is None:
+                return _error(404, "NoSuchBucket", bucket)
+            return 200, b""
+        if req.method == "DELETE":
+            if self.filer.find_entry(path) is None:
+                return _error(404, "NoSuchBucket", bucket)
+            # dot-dirs (.uploads scratch) don't count as bucket content
+            children = self.filer.list_directory(path, limit=1000)
+            if any(not c.name.startswith(".") for c in children):
+                return _error(409, "BucketNotEmpty", bucket)
+            self.filer.delete_entry(path, recursive=True)
+            return 204, b""
+        if req.method == "GET":
+            if self.filer.find_entry(path) is None:
+                return _error(404, "NoSuchBucket", bucket)
+            return self._list_objects(req, bucket)
+        if req.method == "POST" and "delete" in req.query:
+            return self._delete_objects(req, bucket)
+        return _error(405, "MethodNotAllowed", req.method)
+
+    # -- objects ----------------------------------------------------------
+
+    def _object_op(self, req: Request, bucket: str, key: str):
+        if self.filer.find_entry(self._bucket_path(bucket)) is None:
+            return _error(404, "NoSuchBucket", bucket)
+        if "uploads" in req.query and req.method == "POST":
+            return self._initiate_multipart(bucket, key)
+        if "uploadId" in req.query:
+            return self._multipart_op(req, bucket, key)
+        path = f"{self._bucket_path(bucket)}/{key}"
+        if req.method == "PUT":
+            src = req.headers.get("x-amz-copy-source")
+            if src:
+                return self._copy_object(req, src, path)
+            etag = hashlib.md5(req.body).hexdigest()
+            entry = self.filer.write_file(
+                path, req.body,
+                mime=req.headers.get("Content-Type", ""))
+            entry.extended["etag"] = etag
+            amz = {k: v for k, v in req.headers.items()
+                   if k.lower().startswith("x-amz-meta-")}
+            entry.extended.update(amz)
+            self.filer.create_entry(entry)
+            return 200, (b"", {"ETag": f'"{etag}"'})
+        entry = self.filer.find_entry(path)
+        if req.method in ("GET", "HEAD"):
+            if entry is None or entry.is_directory:
+                return _error(404, "NoSuchKey", key)
+            data = b"" if req.method == "HEAD" else \
+                self.filer.read_file(path)
+            etag = entry.extended.get("etag", "")
+            mime = entry.attributes.mime or "application/octet-stream"
+            return 200, (data, {"Content-Type": mime,
+                                "ETag": f'"{etag}"',
+                                "Content-Length":
+                                    str(total_size(entry.chunks)),
+                                "Last-Modified": _iso(
+                                    entry.attributes.mtime)})
+        if req.method == "DELETE":
+            if entry is not None:
+                self.filer.delete_entry(path)
+            return 204, b""
+        return _error(405, "MethodNotAllowed", req.method)
+
+    def _copy_object(self, req: Request, src: str, dst_path: str):
+        src = urllib.parse.unquote(src.lstrip("/"))
+        src_path = f"{BUCKETS_ROOT}/{src}"
+        entry = self.filer.find_entry(src_path)
+        if entry is None:
+            return _error(404, "NoSuchKey", src)
+        data = self.filer.read_file(src_path)
+        etag = hashlib.md5(data).hexdigest()
+        new = self.filer.write_file(dst_path, data,
+                                    mime=entry.attributes.mime)
+        new.extended["etag"] = etag
+        self.filer.create_entry(new)
+        root = ET.Element("CopyObjectResult", xmlns=S3_NS)
+        _elem(root, "ETag", f'"{etag}"')
+        _elem(root, "LastModified", _iso(time.time()))
+        return 200, (_xml(root), "application/xml")
+
+    def _delete_objects(self, req: Request, bucket: str):
+        """POST /bucket?delete — batch delete."""
+        root = ET.fromstring(req.body)
+        result = ET.Element("DeleteResult", xmlns=S3_NS)
+        for obj in root.iter():
+            if obj.tag.endswith("Key"):
+                key = obj.text or ""
+                self.filer.delete_entry(
+                    f"{self._bucket_path(bucket)}/{key}")
+                d = _elem(result, "Deleted")
+                _elem(d, "Key", key)
+        return 200, (_xml(result), "application/xml")
+
+    # -- ListObjectsV2 (s3api_objects_list_handlers.go) -------------------
+
+    def _list_objects(self, req: Request, bucket: str):
+        prefix = req.query.get("prefix", "")
+        delimiter = req.query.get("delimiter", "")
+        max_keys = int(req.query.get("max-keys", 1000))
+        token = req.query.get("continuation-token", "")
+        start_after = req.query.get("start-after", "")
+        start = max(token, start_after)
+        base = self._bucket_path(bucket)
+
+        contents: list[tuple[str, Entry]] = []
+        common: set[str] = set()
+
+        def walk_sorted(dir_path: str, key_prefix: str):
+            """Yield (key, entry) in global lexicographic key order.
+
+            Children sort by their *effective* key start (name for
+            files, name + "/" for directories — "a!" must come before
+            "a/b"); each directory pages through the store so listings
+            beyond one page are never dropped.
+            """
+            # prune: subtree can't contain the prefix, or every key in
+            # it (all sharing key_prefix) sorts <= start
+            if prefix and not (key_prefix.startswith(prefix) or
+                               prefix.startswith(key_prefix)):
+                return
+            if start and key_prefix and key_prefix < start and \
+                    not start.startswith(key_prefix):
+                return
+            page: list = []
+            last = ""
+            while True:
+                batch = self.filer.list_directory(
+                    dir_path, start_file=last, limit=1000)
+                page.extend(batch)
+                if len(batch) < 1000:
+                    break
+                last = batch[-1].name
+            def eff(e):
+                return e.name + ("/" if e.is_directory else "")
+            for e in sorted(page, key=eff):
+                if e.is_directory:
+                    if not e.name.startswith("."):
+                        yield from walk_sorted(
+                            f"{dir_path}/{e.name}",
+                            key_prefix + e.name + "/")
+                    continue
+                yield key_prefix + e.name, e
+
+        truncated = False
+        for key, e in walk_sorted(base, ""):
+            if not key.startswith(prefix) or key <= start:
+                continue
+            if delimiter:
+                rest = key[len(prefix):]
+                if delimiter in rest:
+                    common.add(prefix + rest.split(delimiter, 1)[0] +
+                               delimiter)
+                    continue
+            if len(contents) >= max_keys:
+                truncated = True
+                break
+            contents.append((key, e))
+
+        root = ET.Element("ListBucketResult", xmlns=S3_NS)
+        _elem(root, "Name", bucket)
+        _elem(root, "Prefix", prefix)
+        _elem(root, "MaxKeys", max_keys)
+        _elem(root, "KeyCount", len(contents))
+        _elem(root, "IsTruncated", "true" if truncated else "false")
+        if truncated and contents:
+            _elem(root, "NextContinuationToken", contents[-1][0])
+        for key, e in contents:
+            c = _elem(root, "Contents")
+            _elem(c, "Key", key)
+            _elem(c, "LastModified", _iso(e.attributes.mtime))
+            _elem(c, "ETag", f'"{e.extended.get("etag", "")}"')
+            _elem(c, "Size", total_size(e.chunks))
+            _elem(c, "StorageClass", "STANDARD")
+        for p in sorted(common):
+            cp = _elem(root, "CommonPrefixes")
+            _elem(cp, "Prefix", p)
+        return 200, (_xml(root), "application/xml")
+
+    # -- multipart (filer_multipart.go) -----------------------------------
+
+    def _uploads_path(self, bucket: str, upload_id: str) -> str:
+        return f"{self._bucket_path(bucket)}{UPLOADS_DIR}/{upload_id}"
+
+    def _initiate_multipart(self, bucket: str, key: str):
+        upload_id = uuid.uuid4().hex
+        marker = Entry(self._uploads_path(bucket, upload_id),
+                       is_directory=True)
+        marker.extended["key"] = key
+        self.filer.create_entry(marker)
+        root = ET.Element("InitiateMultipartUploadResult", xmlns=S3_NS)
+        _elem(root, "Bucket", bucket)
+        _elem(root, "Key", key)
+        _elem(root, "UploadId", upload_id)
+        return 200, (_xml(root), "application/xml")
+
+    def _multipart_op(self, req: Request, bucket: str, key: str):
+        upload_id = req.query["uploadId"]
+        updir = self._uploads_path(bucket, upload_id)
+        marker = self.filer.find_entry(updir)
+        if marker is None:
+            return _error(404, "NoSuchUpload", upload_id)
+        if req.method == "PUT":
+            part = int(req.query["partNumber"])
+            etag = hashlib.md5(req.body).hexdigest()
+            e = self.filer.write_file(f"{updir}/{part:05d}.part",
+                                      req.body)
+            e.extended["etag"] = etag
+            self.filer.create_entry(e)
+            return 200, (b"", {"ETag": f'"{etag}"'})
+        if req.method == "GET":
+            root = ET.Element("ListPartsResult", xmlns=S3_NS)
+            _elem(root, "Bucket", bucket)
+            _elem(root, "Key", key)
+            _elem(root, "UploadId", upload_id)
+            for e in self.filer.list_directory(updir):
+                if e.name.endswith(".part"):
+                    p = _elem(root, "Part")
+                    _elem(p, "PartNumber", int(e.name.split(".")[0]))
+                    _elem(p, "ETag",
+                          f'"{e.extended.get("etag", "")}"')
+                    _elem(p, "Size", total_size(e.chunks))
+            return 200, (_xml(root), "application/xml")
+        if req.method == "DELETE":
+            self.filer.delete_entry(updir, recursive=True)
+            return 204, b""
+        if req.method == "POST":
+            # CompleteMultipartUpload: stitch part chunk lists into the
+            # final entry WITHOUT copying data (filer_multipart.go)
+            parts = sorted(
+                (e for e in self.filer.list_directory(updir)
+                 if e.name.endswith(".part")),
+                key=lambda e: int(e.name.split(".")[0]))
+            chunks = []
+            offset = 0
+            etags = b""
+            for p in parts:
+                for c in p.chunks:
+                    chunks.append(type(c)(c.file_id,
+                                          offset + c.offset, c.size,
+                                          c.e_tag, c.mtime_ns))
+                offset += total_size(p.chunks)
+                etags += bytes.fromhex(p.extended.get("etag", ""))
+            final = Entry(f"{self._bucket_path(bucket)}/{key}",
+                          chunks=chunks)
+            final_etag = (hashlib.md5(etags).hexdigest() +
+                          f"-{len(parts)}")
+            final.extended["etag"] = final_etag
+            self.filer.create_entry(final)
+            self.filer.delete_entry(updir, recursive=True,
+                                    delete_chunks=False)
+            root = ET.Element("CompleteMultipartUploadResult",
+                              xmlns=S3_NS)
+            _elem(root, "Bucket", bucket)
+            _elem(root, "Key", key)
+            _elem(root, "ETag", f'"{final_etag}"')
+            return 200, (_xml(root), "application/xml")
+        return _error(405, "MethodNotAllowed", req.method)

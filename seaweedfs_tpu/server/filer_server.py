@@ -1,0 +1,138 @@
+"""Filer HTTP server (weed/server/filer_server.go + handlers).
+
+Public API mirrors the reference's filer HTTP surface:
+  POST/PUT /path/to/file     upload (auto-chunked)
+  GET      /path/to/file     ranged read
+  GET      /path/to/dir/     JSON listing (?limit=&lastFileName=&prefix=)
+  DELETE   /path             (?recursive=true for directories)
+  HEAD     /path             existence/size probe
+plus JSON-over-HTTP mirrors of key filer.proto RPCs:
+  GET  /__meta__/lookup?path=         <- filer.proto LookupDirectoryEntry
+  POST /__meta__/rename               <- filer.proto AtomicRenameEntry
+  GET  /__meta__/events?sinceNs=      <- SubscribeMetadata (poll form)
+"""
+
+from __future__ import annotations
+
+from ..filer import Entry, Filer
+from ..filer.filer_store import SqliteStore
+from .httpd import HttpServer, Request
+
+
+class FilerServer:
+    def __init__(self, master: str, host: str = "127.0.0.1",
+                 port: int = 0, store_path: str = ":memory:",
+                 collection: str = "", replication: str = ""):
+        self.filer = Filer(master, SqliteStore(store_path),
+                           collection=collection,
+                           replication=replication)
+        self.http = HttpServer(host, port)
+        self.http.route("GET", "/__meta__/lookup", self._meta_lookup)
+        self.http.route("POST", "/__meta__/rename", self._meta_rename)
+        self.http.route("GET", "/__meta__/events", self._meta_events)
+        self.http.fallback = self._dispatch
+
+    def start(self):
+        self.http.start()
+        return self
+
+    def stop(self):
+        self.http.stop()
+        self.filer.store.close()
+
+    @property
+    def url(self) -> str:
+        return self.http.url
+
+    # -- dispatch ---------------------------------------------------------
+
+    def _dispatch(self, req: Request):
+        path = req.path
+        if req.method in ("POST", "PUT"):
+            return self._put(req, path)
+        if req.method in ("GET", "HEAD"):
+            return self._get(req, path)
+        if req.method == "DELETE":
+            return self._delete(req, path)
+        return 405, {"error": "method not allowed"}
+
+    def _put(self, req: Request, path: str):
+        if path.endswith("/"):
+            # mkdir (filer_server_handlers_write.go mkdir on trailing /)
+            e = Entry(path.rstrip("/") or "/", is_directory=True)
+            self.filer.create_entry(e)
+            return 201, {"name": e.name}
+        mime = req.headers.get("Content-Type", "")
+        if mime == "application/x-www-form-urlencoded":
+            mime = ""
+        entry = self.filer.write_file(path, req.body, mime=mime)
+        return 201, {"name": entry.name, "size": entry.total_size()}
+
+    def _get(self, req: Request, path: str):
+        if path.endswith("/") or path == "":
+            return self._list(req, path or "/")
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            return 404, {"error": f"{path} not found"}
+        if entry.is_directory:
+            return self._list(req, path)
+        rng = req.headers.get("Range", "")
+        offset, size = 0, None
+        if rng.startswith("bytes="):
+            lo, _, hi = rng[6:].partition("-")
+            if lo:
+                offset = int(lo)
+                if hi:
+                    size = int(hi) - offset + 1
+            elif hi:
+                # suffix range: last N bytes
+                file_size = entry.total_size()
+                size = min(int(hi), file_size)
+                offset = file_size - size
+        data = self.filer.read_file(path, offset, size)
+        mime = entry.attributes.mime or "application/octet-stream"
+        status = 206 if rng else 200
+        return status, (data, mime)
+
+    def _list(self, req: Request, path: str):
+        limit = int(req.query.get("limit", 1000))
+        last = req.query.get("lastFileName", "")
+        prefix = req.query.get("prefix", "")
+        entries = self.filer.list_directory(
+            path.rstrip("/") or "/", start_file=last, limit=limit,
+            prefix=prefix)
+        return 200, {
+            "path": path,
+            "entries": [e.to_json() for e in entries],
+            "lastFileName": entries[-1].name if entries else "",
+            "shouldDisplayLoadMore": len(entries) >= limit,
+        }
+
+    def _delete(self, req: Request, path: str):
+        recursive = req.query.get("recursive", "") == "true"
+        try:
+            self.filer.delete_entry(path.rstrip("/") or "/",
+                                    recursive=recursive)
+        except IsADirectoryError as e:
+            return 409, {"error": str(e)}
+        return 204, b""
+
+    # -- meta RPC mirrors -------------------------------------------------
+
+    def _meta_lookup(self, req: Request):
+        entry = self.filer.find_entry(req.query["path"])
+        if entry is None:
+            return 404, {"error": "not found"}
+        return 200, entry.to_json()
+
+    def _meta_rename(self, req: Request):
+        b = req.json()
+        try:
+            self.filer.rename(b["oldPath"], b["newPath"])
+        except FileNotFoundError as e:
+            return 404, {"error": str(e)}
+        return 200, {}
+
+    def _meta_events(self, req: Request):
+        since = int(req.query.get("sinceNs", 0))
+        return 200, {"events": self.filer.events_since(since)}

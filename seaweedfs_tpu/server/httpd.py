@@ -22,8 +22,11 @@ class Request:
         parsed = urllib.parse.urlparse(handler.path)
         self.method = handler.command
         self.path = parsed.path
+        # keep_blank_values: S3-style marker params (?uploads=, ?delete=)
+        # must survive parsing
         self.query = {k: v[0] for k, v in
-                      urllib.parse.parse_qs(parsed.query).items()}
+                      urllib.parse.parse_qs(
+                          parsed.query, keep_blank_values=True).items()}
         self.headers = handler.headers
         self._handler = handler
         self._body: bytes | None = None
@@ -65,18 +68,28 @@ class HttpServer:
                         status, payload = 404, {"error": "not found"}
                 except Exception as e:  # noqa: BLE001 — server must answer
                     status, payload = 500, {"error": str(e)}
+                extra_headers: dict = {}
                 if isinstance(payload, (dict, list)):
                     body = json.dumps(payload).encode()
                     ctype = "application/json"
                 elif isinstance(payload, tuple):
-                    body, ctype = payload
+                    body, second = payload
+                    if isinstance(second, dict):
+                        extra_headers = second
+                        ctype = extra_headers.pop(
+                            "Content-Type", "application/octet-stream")
+                    else:
+                        ctype = second
                 else:
                     body = payload if isinstance(payload, bytes) \
                         else str(payload).encode()
                     ctype = "application/octet-stream"
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
+                for hk, hv in extra_headers.items():
+                    self.send_header(hk, hv)
+                if "Content-Length" not in extra_headers:
+                    self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 if req.method != "HEAD":
                     self.wfile.write(body)

@@ -257,13 +257,19 @@ class Volume:
         (checked_count, errors)."""
         errors: list[str] = []
         count = 0
-        with self.lock:  # snapshot only; don't hold across the I/O sweep
-            entries = list(self.nm.items())
-        for key, stored_off, size in entries:
+        with self.lock:  # snapshot keys only; offsets re-resolved fresh
+            keys = [k for k, _, _ in self.nm.items()]
+        for key in keys:
             count += 1
             try:
                 with self.lock:
-                    self._read_at(stored_off, size)
+                    # re-fetch under the lock: a concurrent compaction
+                    # commit swaps .dat + needle map, so snapshotted
+                    # offsets would read garbage from the new layout
+                    got = self.nm.get(key)
+                    if got is None:
+                        continue  # deleted meanwhile
+                    self._read_at(got[0], got[1])
             except Exception as e:  # noqa: BLE001 — collect all
                 errors.append(f"needle {key:x}: {e}")
         return count, errors

@@ -1,0 +1,184 @@
+"""Filer tests: store backends, chunk visibility math, namespace ops,
+HTTP server over a live mini-cluster."""
+
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.filer import Entry, FileChunk, Filer
+from seaweedfs_tpu.filer.filechunks import (
+    non_overlapping_visible_intervals, view_from_chunks)
+from seaweedfs_tpu.filer.filer_store import MemoryStore, SqliteStore
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.httpd import http_bytes, http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+# --- stores --------------------------------------------------------------
+
+@pytest.mark.parametrize("make", [MemoryStore,
+                                  lambda: SqliteStore(":memory:")])
+def test_store_crud_and_listing(make):
+    s = make()
+    for name in ("b", "a", "c", "ab"):
+        s.insert_entry(Entry(f"/dir/{name}"))
+    assert s.find_entry("/dir/a") is not None
+    assert s.find_entry("/dir/zz") is None
+    names = [e.name for e in s.list_directory_entries("/dir")]
+    assert names == ["a", "ab", "b", "c"]
+    assert [e.name for e in
+            s.list_directory_entries("/dir", prefix="a")] == ["a", "ab"]
+    assert [e.name for e in
+            s.list_directory_entries("/dir", start_file="ab")] == \
+        ["b", "c"]
+    assert [e.name for e in
+            s.list_directory_entries("/dir", start_file="ab",
+                                     include_start=True)] == \
+        ["ab", "b", "c"]
+    s.delete_entry("/dir/a")
+    assert s.find_entry("/dir/a") is None
+    s.delete_folder_children("/dir")
+    assert s.list_directory_entries("/dir") == []
+
+
+# --- chunk visibility ----------------------------------------------------
+
+def test_chunk_overwrite_visibility():
+    chunks = [
+        FileChunk("1,a", 0, 100, mtime_ns=1),
+        FileChunk("1,b", 50, 100, mtime_ns=2),  # overwrites 50..150
+    ]
+    vis = non_overlapping_visible_intervals(chunks)
+    assert [(v.start, v.stop, v.file_id) for v in vis] == \
+        [(0, 50, "1,a"), (50, 150, "1,b")]
+    views = view_from_chunks(chunks, 40, 20)
+    assert [(v.file_id, v.chunk_offset, v.size, v.logical_offset)
+            for v in views] == [("1,a", 40, 10, 40), ("1,b", 0, 10, 50)]
+
+
+def test_chunk_full_cover():
+    chunks = [
+        FileChunk("1,a", 0, 100, mtime_ns=1),
+        FileChunk("1,b", 0, 100, mtime_ns=5),
+    ]
+    vis = non_overlapping_visible_intervals(chunks)
+    assert [(v.start, v.stop, v.file_id) for v in vis] == \
+        [(0, 100, "1,b")]
+
+
+# --- live cluster --------------------------------------------------------
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer().start()
+    servers = [VolumeServer([str(tmp_path / f"v{i}")], master.url,
+                            pulse_seconds=0.3).start() for i in range(2)]
+    time.sleep(0.5)
+    filer = FilerServer(master.url).start()
+    yield master, servers, filer
+    filer.stop()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def test_filer_write_read_roundtrip(cluster):
+    master, servers, fs = cluster
+    f = fs.filer
+    data = np.random.default_rng(0).integers(
+        0, 256, 10_000_000, dtype=np.uint8).tobytes()  # > 2 chunks
+    f.write_file("/docs/big.bin", data)
+    assert len(f.find_entry("/docs/big.bin").chunks) == 3
+    assert f.read_file("/docs/big.bin") == data
+    # ranged read across a chunk boundary
+    assert f.read_file("/docs/big.bin", 4 * 1024 * 1024 - 100, 200) == \
+        data[4 * 1024 * 1024 - 100: 4 * 1024 * 1024 + 100]
+    # parents auto-created
+    assert f.find_entry("/docs").is_directory
+
+
+def test_filer_http_surface(cluster):
+    master, servers, fs = cluster
+    body = b"hello filer http"
+    status, _, _ = http_bytes("POST", f"{fs.url}/a/b/hello.txt", body,
+                              {"Content-Type": "text/plain"})
+    assert status == 201
+    status, got, _ = http_bytes("GET", f"{fs.url}/a/b/hello.txt")
+    assert status == 200 and got == body
+    # ranged
+    status, got, _ = http_bytes("GET", f"{fs.url}/a/b/hello.txt", None,
+                                {"Range": "bytes=6-10"})
+    assert status == 206 and got == body[6:11]
+    # listing
+    r = http_json("GET", f"{fs.url}/a/b/")
+    assert [e["fullPath"] for e in r["entries"]] == ["/a/b/hello.txt"]
+    # rename
+    http_json("POST", f"{fs.url}/__meta__/rename",
+              {"oldPath": "/a/b/hello.txt", "newPath": "/a/hi.txt"})
+    status, got, _ = http_bytes("GET", f"{fs.url}/a/hi.txt")
+    assert status == 200 and got == body
+    # delete
+    status, _, _ = http_bytes("DELETE", f"{fs.url}/a/hi.txt")
+    assert status == 204
+    status, _, _ = http_bytes("GET", f"{fs.url}/a/hi.txt")
+    assert status == 404
+
+
+def test_filer_recursive_delete_and_events(cluster):
+    master, servers, fs = cluster
+    f = fs.filer
+    t0 = time.time_ns()
+    f.write_file("/tree/x/1.txt", b"1")
+    f.write_file("/tree/x/2.txt", b"2")
+    with pytest.raises(IsADirectoryError):
+        f.delete_entry("/tree")
+    f.delete_entry("/tree", recursive=True)
+    assert f.find_entry("/tree") is None
+    events = f.events_since(t0)
+    ops = [e["op"] for e in events]
+    assert "create" in ops and "delete" in ops
+
+
+def test_filer_overwrite_updates_and_cleans(cluster):
+    master, servers, fs = cluster
+    f = fs.filer
+    f.write_file("/o/file.bin", b"version-one")
+    f.write_file("/o/file.bin", b"v2")
+    assert f.read_file("/o/file.bin") == b"v2"
+    assert len(f.find_entry("/o/file.bin").chunks) == 1
+
+
+def test_suffix_range(cluster):
+    master, servers, fs = cluster
+    body = b"0123456789" * 100
+    http_bytes("POST", f"{fs.url}/r/f.bin", body)
+    status, got, _ = http_bytes("GET", f"{fs.url}/r/f.bin", None,
+                                {"Range": "bytes=-5"})
+    assert status == 206 and got == body[-5:]
+
+
+def test_sqlite_like_escaping():
+    s = SqliteStore(":memory:")
+    for name in ("my_file", "myxfile", "50%off", "50Xoff"):
+        s.insert_entry(Entry(f"/d/{name}"))
+    assert [e.name for e in
+            s.list_directory_entries("/d", prefix="my_")] == ["my_file"]
+    assert [e.name for e in
+            s.list_directory_entries("/d", prefix="50%")] == ["50%off"]
+    s.insert_entry(Entry("/buckets/my_b/f"))
+    s.insert_entry(Entry("/buckets/myxb/f"))
+    s.delete_folder_children("/buckets/my_b")
+    assert s.find_entry("/buckets/myxb/f") is not None
+
+
+def test_rename_event_carries_old_path(cluster):
+    master, servers, fs = cluster
+    f = fs.filer
+    f.write_file("/ev/a.txt", b"x")
+    t0 = time.time_ns()
+    f.rename("/ev/a.txt", "/ev/b.txt")
+    ev = [e for e in f.events_since(t0) if e["op"] == "rename"][0]
+    assert ev["oldEntry"]["fullPath"] == "/ev/a.txt"
+    assert ev["newEntry"]["fullPath"] == "/ev/b.txt"
