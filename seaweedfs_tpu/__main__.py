@@ -78,6 +78,13 @@ def main(argv: list[str] | None = None) -> int:
     sh.add_argument("command", nargs="*",
                     help="run one command and exit")
 
+    bm = sub.add_parser("benchmark",
+                        help="write/read load test (weed benchmark)")
+    bm.add_argument("-master", default="127.0.0.1:9333")
+    bm.add_argument("-n", type=int, default=1000)
+    bm.add_argument("-size", type=int, default=1024)
+    bm.add_argument("-c", type=int, default=16)
+
     up = sub.add_parser("upload", help="upload a file")
     up.add_argument("-master", default="127.0.0.1:9333")
     up.add_argument("file")
@@ -161,6 +168,11 @@ def main(argv: list[str] | None = None) -> int:
             print(run_command(env, " ".join(args.command)))
             return 0
         _repl(env)
+    elif args.cmd == "benchmark":
+        import json as _json
+        from .benchmark import run_benchmark
+        for r in run_benchmark(args.master, args.n, args.size, args.c):
+            print(_json.dumps(r))
     elif args.cmd == "upload":
         from . import operation
         data = open(args.file, "rb").read()

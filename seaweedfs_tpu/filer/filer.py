@@ -164,9 +164,9 @@ class Filer:
             return b""
         out = bytearray(size)
         for view in view_from_chunks(entry.chunks, offset, size):
-            blob = operation.read(self.master, view.file_id)
-            piece = blob[view.chunk_offset:
-                         view.chunk_offset + view.size]
+            # ranged read: fetch only the view's bytes, not the chunk
+            piece = operation.read(self.master, view.file_id,
+                                   view.chunk_offset, view.size)
             lo = view.logical_offset - offset
             out[lo:lo + len(piece)] = piece
         return bytes(out)

@@ -63,13 +63,21 @@ def lookup(master: str, vid: int) -> list[dict]:
     return r["locations"]
 
 
-def read(master: str, fid: str) -> bytes:
+def read(master: str, fid: str, offset: int = 0,
+         size: int | None = None) -> bytes:
+    """Full or ranged needle read (ranged avoids whole-chunk transfers
+    on the filer's chunk-view path)."""
     vid = int(fid.split(",", 1)[0])
     locs = lookup(master, vid)
+    headers = {}
+    if offset or size is not None:
+        end = f"{offset + size - 1}" if size is not None else ""
+        headers["Range"] = f"bytes={offset}-{end}"
     last_err = None
     for loc in locs:
-        status, body, _ = http_bytes("GET", f"{loc['url']}/{fid}")
-        if status == 200:
+        status, body, _ = http_bytes("GET", f"{loc['url']}/{fid}",
+                                     None, headers)
+        if status in (200, 206):
             return body
         last_err = f"{loc['url']} -> {status}"
     raise RuntimeError(f"read {fid}: {last_err}")

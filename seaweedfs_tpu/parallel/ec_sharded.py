@@ -128,6 +128,31 @@ def reconstruct_sharded(mesh, coeffs, survivors32):
     return _reconstruct_fn(mesh)(coeffs, survivors32)
 
 
+def _apply_tables_batch_local(mat_local: jax.Array, batch32: jax.Array
+                              ) -> jax.Array:
+    """[r_local, K] × [V_local, K, W] -> [V_local, r_local, W]."""
+    return jax.vmap(lambda d: _apply_tables_local(mat_local, d))(batch32)
+
+
+@functools.lru_cache(maxsize=32)
+def _encode_batch_fn(mesh):
+    return jax.jit(shard_map(
+        _apply_tables_batch_local, mesh=mesh,
+        in_specs=(P(SHARD_AXIS, None), P(STRIPE_AXIS, None, None)),
+        out_specs=P(STRIPE_AXIS, SHARD_AXIS, None)))
+
+
+def encode_volume_batch(mesh, mat, batch32):
+    """Batch-of-volumes encode (BASELINE.json config 3: 64 volumes
+    across the slice): volumes ride the data-parallel "stripe" axis,
+    parity rows the tensor-parallel "shard" axis.
+
+    mat: [R, K] uint8; batch32: [V, K, W] uint32 with V divisible by
+    the stripe axis.  Returns [V, R, W] uint32.
+    """
+    return _encode_batch_fn(mesh)(mat, batch32)
+
+
 def pad_survivors(coeffs: np.ndarray, survivors32: np.ndarray, multiple: int):
     """Pad the survivor dimension up to `multiple` with zero rows/columns
     (zero GF coefficients contribute nothing to the XOR sum)."""

@@ -31,9 +31,12 @@ def uri_encode(s: str, encode_slash: bool = True) -> str:
     return urllib.parse.quote(s, safe=safe)
 
 
-def canonical_request(method: str, path: str, query: dict,
+def canonical_request(method: str, canonical_uri: str, query: dict,
                       headers: dict, signed_headers: list[str],
                       payload_hash: str) -> str:
+    """canonical_uri must be the WIRE form of the path (already
+    percent-encoded once) — re-encoding here would double-encode keys
+    with spaces/unicode and break verification for real clients."""
     cq = "&".join(
         f"{uri_encode(k)}={uri_encode(str(v))}"
         for k, v in sorted(query.items()))
@@ -42,7 +45,7 @@ def canonical_request(method: str, path: str, query: dict,
         for h in signed_headers)
     return "\n".join([
         method,
-        uri_encode(path, encode_slash=False) or "/",
+        canonical_uri or "/",
         cq,
         ch,
         ";".join(signed_headers),
@@ -66,7 +69,9 @@ def sign_request(method: str, host: str, path: str, query: dict,
                  headers: dict, payload: bytes, access_key: str,
                  secret_key: str, region: str = "us-east-1",
                  amz_date: str | None = None) -> dict:
-    """Client-side signer: returns headers with Authorization added."""
+    """Client-side signer: returns headers with Authorization added.
+    `path` is the raw (unencoded) path; the request must be sent to
+    its once-encoded form (`uri_encode(path, False)`)."""
     if amz_date is None:
         amz_date = datetime.now(timezone.utc).strftime("%Y%m%dT%H%M%SZ")
     date = amz_date[:8]
@@ -78,8 +83,8 @@ def sign_request(method: str, host: str, path: str, query: dict,
     signed = sorted(h for h in headers
                     if h in ("host", "content-type") or
                     h.startswith("x-amz-"))
-    creq = canonical_request(method, path, query, headers, signed,
-                             payload_hash)
+    creq = canonical_request(method, uri_encode(path, False), query,
+                             headers, signed, payload_hash)
     scope = f"{date}/{region}/s3/aws4_request"
     sts = string_to_sign(amz_date, scope, creq)
     sig = hmac.new(signing_key(secret_key, date, region),
@@ -91,14 +96,18 @@ def sign_request(method: str, host: str, path: str, query: dict,
 
 
 class SigV4Verifier:
-    """Server-side verification (auth_signature_v4.go doesSignatureMatch)."""
+    """Server-side verification (auth_signature_v4.go doesSignatureMatch
+    + the reference's 15-minute request-time window)."""
+
+    MAX_SKEW_SECONDS = 15 * 60
 
     def __init__(self, credentials: dict[str, str]):
         self.credentials = credentials  # access_key -> secret_key
 
     def verify(self, method: str, path: str, query: dict,
                headers: dict, payload: bytes) -> "tuple[bool, str]":
-        """Returns (ok, identity-or-error)."""
+        """Returns (ok, identity-or-error).  `path` is the wire form
+        (still percent-encoded) — used verbatim as the canonical URI."""
         auth = headers.get("authorization", "")
         if not auth.startswith(ALGORITHM):
             return False, "unsupported authorization"
@@ -115,15 +124,16 @@ class SigV4Verifier:
         secret = self.credentials.get(access_key)
         if secret is None:
             return False, "unknown access key"
-        payload_hash = headers.get("x-amz-content-sha256",
-                                   UNSIGNED_PAYLOAD)
+        amz_date = headers.get("x-amz-date", "")
+        skew_err = self._check_date(amz_date, date)
+        if skew_err:
+            return False, skew_err
+        payload_hash = headers.get("x-amz-content-sha256") or \
+            UNSIGNED_PAYLOAD
         if payload_hash not in (UNSIGNED_PAYLOAD,
                                 "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"):
             if payload_hash != _sha256(payload):
                 return False, "payload checksum mismatch"
-        else:
-            payload_hash = headers.get("x-amz-content-sha256")
-        amz_date = headers.get("x-amz-date", "")
         creq = canonical_request(
             method, path, query,
             {k.lower(): v for k, v in headers.items()}, signed,
@@ -135,3 +145,18 @@ class SigV4Verifier:
         if not hmac.compare_digest(want, got_sig):
             return False, "signature mismatch"
         return True, access_key
+
+    def _check_date(self, amz_date: str, scope_date: str) -> str | None:
+        """Replay window: x-amz-date within 15 minutes of now and
+        consistent with the credential scope date."""
+        try:
+            req_time = datetime.strptime(
+                amz_date, "%Y%m%dT%H%M%SZ").replace(tzinfo=timezone.utc)
+        except ValueError:
+            return "malformed x-amz-date"
+        if amz_date[:8] != scope_date:
+            return "credential scope date mismatch"
+        now = datetime.now(timezone.utc)
+        if abs((now - req_time).total_seconds()) > self.MAX_SKEW_SECONDS:
+            return "request time too skewed"
+        return None

@@ -261,25 +261,29 @@ class S3ApiServer:
         for key, e in walk_sorted(base, ""):
             if not key.startswith(prefix) or key <= start:
                 continue
+            # AWS counts Keys + CommonPrefixes toward MaxKeys
+            if len(contents) + len(common) >= max_keys:
+                truncated = True
+                break
             if delimiter:
                 rest = key[len(prefix):]
                 if delimiter in rest:
                     common.add(prefix + rest.split(delimiter, 1)[0] +
                                delimiter)
                     continue
-            if len(contents) >= max_keys:
-                truncated = True
-                break
             contents.append((key, e))
 
         root = ET.Element("ListBucketResult", xmlns=S3_NS)
         _elem(root, "Name", bucket)
         _elem(root, "Prefix", prefix)
         _elem(root, "MaxKeys", max_keys)
-        _elem(root, "KeyCount", len(contents))
+        _elem(root, "KeyCount", len(contents) + len(common))
         _elem(root, "IsTruncated", "true" if truncated else "false")
-        if truncated and contents:
-            _elem(root, "NextContinuationToken", contents[-1][0])
+        if truncated:
+            token_key = contents[-1][0] if contents else \
+                (sorted(common)[-1] if common else "")
+            if token_key:
+                _elem(root, "NextContinuationToken", token_key)
         for key, e in contents:
             c = _elem(root, "Contents")
             _elem(c, "Key", key)
@@ -340,12 +344,21 @@ class S3ApiServer:
             self.filer.delete_entry(updir, recursive=True)
             return 204, b""
         if req.method == "POST":
-            # CompleteMultipartUpload: stitch part chunk lists into the
-            # final entry WITHOUT copying data (filer_multipart.go)
+            # CompleteMultipartUpload: stitch the parts the CLIENT's
+            # manifest commits (strays from retried attempts are
+            # dropped), without copying data (filer_multipart.go)
+            manifest: list[int] | None = None
+            if req.body.strip():
+                manifest = sorted(
+                    int(el.text) for el in ET.fromstring(req.body).iter()
+                    if el.tag.endswith("PartNumber"))
             parts = sorted(
                 (e for e in self.filer.list_directory(updir)
                  if e.name.endswith(".part")),
                 key=lambda e: int(e.name.split(".")[0]))
+            if manifest is not None:
+                parts = [p for p in parts
+                         if int(p.name.split(".")[0]) in manifest]
             chunks = []
             offset = 0
             etags = b""

@@ -78,21 +78,30 @@ class FilerServer:
             return self._list(req, path)
         rng = req.headers.get("Range", "")
         offset, size = 0, None
-        if rng.startswith("bytes="):
-            lo, _, hi = rng[6:].partition("-")
-            if lo:
-                offset = int(lo)
-                if hi:
-                    size = int(hi) - offset + 1
-            elif hi:
-                # suffix range: last N bytes
-                file_size = entry.total_size()
-                size = min(int(hi), file_size)
-                offset = file_size - size
+        file_size = entry.total_size()
+        try:
+            if rng.startswith("bytes="):
+                lo, _, hi = rng[6:].partition("-")
+                if lo:
+                    offset = int(lo)
+                    if hi:
+                        size = int(hi) - offset + 1
+                elif hi:
+                    size = min(int(hi), file_size)  # suffix: last N
+                    offset = file_size - size
+                else:
+                    raise ValueError(rng)
+        except ValueError:
+            rng = ""  # malformed Range: serve the full body (RFC 9110)
+            offset, size = 0, None
         data = self.filer.read_file(path, offset, size)
         mime = entry.attributes.mime or "application/octet-stream"
-        status = 206 if rng else 200
-        return status, (data, mime)
+        if rng:
+            end = offset + len(data) - 1
+            return 206, (data, {
+                "Content-Type": mime,
+                "Content-Range": f"bytes {offset}-{end}/{file_size}"})
+        return 200, (data, mime)
 
     def _list(self, req: Request, path: str):
         limit = int(req.query.get("limit", 1000))

@@ -56,6 +56,9 @@ class MasterServer:
         r("GET", "/cluster/status", self._cluster_status)
         r("POST", "/cluster/lease_admin_token", self._lease_admin)
         r("POST", "/cluster/release_admin_token", self._release_admin)
+        r("GET", "/metrics", self._metrics)
+        from ..stats import Metrics
+        self.metrics = Metrics("master")
 
     # -- lifecycle --------------------------------------------------------
 
@@ -75,6 +78,8 @@ class MasterServer:
     def _heartbeat(self, req: Request):
         hb = req.json()
         self.topology.register_heartbeat(hb)
+        self.metrics.counter_add("heartbeat_total",
+                                 help_text="heartbeats received")
         return 200, {"volumeSizeLimit": self.topology.volume_size_limit}
 
     def _assign(self, req: Request):
@@ -209,6 +214,18 @@ class MasterServer:
             self._admin_token = None
             self._admin_token_ts = 0
         return 200, {}
+
+    def _metrics(self, req: Request):
+        nodes = self.topology.alive_nodes()
+        self.metrics.gauge_set("data_nodes", len(nodes),
+                               help_text="alive volume servers")
+        self.metrics.gauge_set(
+            "volumes_total",
+            sum(len(n.volumes) for n in nodes))
+        self.metrics.gauge_set("sequence", self.sequencer.peek()
+                               if hasattr(self.sequencer, "peek") else 0)
+        return 200, (self.metrics.render().encode(),
+                     "text/plain; version=0.0.4")
 
 
 def _ttl_u32(ttl: str) -> int:

@@ -68,11 +68,14 @@ class VolumeServer:
         r("GET", "/admin/ec/info", self._ec_info)
         r("POST", "/admin/scrub", self._scrub)
         r("POST", "/admin/ec/scrub", self._ec_scrub)
+        r("GET", "/metrics", self._metrics)
         self.http.fallback = self._data_path
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
         from .store_ec import EcReader
         self.ec_reader = EcReader(master, self.http.url)
+        from ..stats import Metrics
+        self.metrics = Metrics("volume_server")
 
     # -- lifecycle --------------------------------------------------------
 
@@ -119,15 +122,30 @@ class VolumeServer:
             fid = types.parse_file_id(fid_str)
         except ValueError:
             return 404, {"error": f"bad file id {fid_str!r}"}
+        self.metrics.counter_add(
+            "request_total", 1.0,
+            help_text="data-path requests", method=req.method)
         if req.method in ("GET", "HEAD"):
-            return self._get_needle(fid)
+            return self._get_needle(fid, req.headers.get("Range", ""))
         if req.method in ("POST", "PUT"):
+            self.metrics.counter_add("received_bytes", len(req.body))
             return self._put_needle(fid, req)
         if req.method == "DELETE":
             return self._delete_needle(fid)
         return 405, {"error": "method not allowed"}
 
-    def _get_needle(self, fid: types.FileId):
+    def _metrics(self, req: Request):
+        """Prometheus text endpoint (stats/metrics.go:49-662 analog)."""
+        hb = self.store.collect_heartbeat()
+        self.metrics.gauge_set("volumes", len(hb["volumes"]),
+                               help_text="mounted volumes")
+        self.metrics.gauge_set("ec_volumes", len(hb["ecShards"]))
+        self.metrics.gauge_set(
+            "max_volume_count", hb["maxVolumeCount"])
+        return 200, (self.metrics.render().encode(),
+                     "text/plain; version=0.0.4")
+
+    def _get_needle(self, fid: types.FileId, rng: str = ""):
         try:
             n = self.store.read_needle(fid.volume_id, fid.key,
                                        cookie=fid.cookie,
@@ -137,7 +155,29 @@ class VolumeServer:
         except ValueError as e:
             return 404, {"error": str(e)}
         mime = n.mime.decode() if n.mime else "application/octet-stream"
-        return 200, (n.data, mime)
+        data = n.data
+        # ranged needle reads keep the filer's chunk-view reads from
+        # overfetching whole chunks (volume_server_handlers_read.go
+        # serves Range on the data path)
+        if rng.startswith("bytes="):
+            try:
+                lo, _, hi = rng[6:].partition("-")
+                total = len(data)
+                if lo:
+                    start = int(lo)
+                    stop = int(hi) + 1 if hi else total
+                else:
+                    start = total - min(int(hi), total)
+                    stop = total
+                part = data[start:stop]
+                return 206, (part, {
+                    "Content-Type": mime,
+                    "Content-Range":
+                        f"bytes {start}-{start + len(part) - 1}"
+                        f"/{total}"})
+            except ValueError:
+                pass
+        return 200, (data, mime)
 
     def _put_needle(self, fid: types.FileId, req: Request):
         n = Needle(cookie=fid.cookie, id=fid.key, data=req.body)

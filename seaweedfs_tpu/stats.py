@@ -1,0 +1,56 @@
+"""Prometheus-style metrics (weed/stats/metrics.go — the reference
+defines vectors per role and serves them on -metricsPort; ours is a
+minimal in-process registry rendered in the Prometheus text format on
+each server's /metrics endpoint)."""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+class Metrics:
+    def __init__(self, namespace: str):
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, tuple], float] = defaultdict(float)
+        self._gauges: dict[tuple[str, tuple], float] = {}
+        self._help: dict[str, str] = {}
+
+    def counter_add(self, name: str, value: float = 1.0,
+                    help_text: str = "", **labels) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._counters[key] += value
+            if help_text:
+                self._help.setdefault(name, help_text)
+
+    def gauge_set(self, name: str, value: float, help_text: str = "",
+                  **labels) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._gauges[key] = value
+            if help_text:
+                self._help.setdefault(name, help_text)
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        out = []
+        with self._lock:
+            seen_types: set[str] = set()
+            for store, mtype in ((self._counters, "counter"),
+                                 (self._gauges, "gauge")):
+                for (name, labels), value in sorted(store.items()):
+                    full = f"{self.namespace}_{name}"
+                    if full not in seen_types:
+                        if name in self._help:
+                            out.append(f"# HELP {full} "
+                                       f"{self._help[name]}")
+                        out.append(f"# TYPE {full} {mtype}")
+                        seen_types.add(full)
+                    if labels:
+                        lbl = ",".join(f'{k}="{v}"' for k, v in labels)
+                        out.append(f"{full}{{{lbl}}} {value}")
+                    else:
+                        out.append(f"{full} {value}")
+        return "\n".join(out) + "\n"

@@ -194,3 +194,23 @@ def test_ec_balance_rack_aware(cluster):
     assert len(per_rack) == 3, per_rack
     counts = sorted(len(s) for s in per_rack.values())
     assert counts[-1] - counts[0] <= 2, per_rack
+
+
+def test_metrics_endpoints(cluster):
+    master, servers = cluster
+    from seaweedfs_tpu.server.httpd import http_bytes
+    _upload_corpus(master.url, n=3, seed=9)
+    st, body, _ = http_bytes("GET", f"{master.url}/metrics")
+    assert st == 200 and b"master_data_nodes" in body
+    st, body, _ = http_bytes("GET", f"{servers[0].url}/metrics")
+    assert st == 200 and b"volume_server_" in body
+
+
+def test_benchmark_harness(cluster):
+    master, servers = cluster
+    from seaweedfs_tpu.benchmark import run_benchmark
+    results = run_benchmark(master.url, n_files=40, file_size=512,
+                            concurrency=4)
+    assert [r["op"] for r in results] == ["write", "read"]
+    assert all(r["requests"] == 40 for r in results)
+    assert all(r["req_per_sec"] > 0 for r in results)

@@ -82,3 +82,18 @@ def test_rs63_scheme(mesh):
     got32 = ec_sharded.encode_sharded(mesh, mat, pack_words(data))
     got = unpack_words(np.asarray(got32), nbytes)[:p]
     np.testing.assert_array_equal(got, want)
+
+
+def test_encode_volume_batch(mesh):
+    """BASELINE config 3: batch of volumes across the mesh."""
+    rng = np.random.default_rng(4)
+    v, d, p, nbytes = 4, 10, 4, 1024 * 4
+    batch = rng.integers(0, 256, size=(v, d, nbytes), dtype=np.uint8)
+    cpu = rs_cpu.ReedSolomonCPU(d, p)
+    mat = rs_matrix.parity_matrix(d, p)
+    batch32 = np.stack([pack_words(b) for b in batch])
+    got = np.asarray(ec_sharded.encode_volume_batch(mesh, mat, batch32))
+    for i in range(v):
+        np.testing.assert_array_equal(
+            unpack_words(got[i], nbytes), cpu.parity(batch[i]),
+            err_msg=f"volume {i}")

@@ -42,7 +42,9 @@ def s3req(gw, method, path, body=b"", query=None, headers=None,
         headers = sign_request(method, gw.url, path, query, headers,
                                body, "AKIDEXAMPLE", "secretkey123")
     qs = urllib.parse.urlencode(query)
-    url = f"{gw.url}{path}" + (f"?{qs}" if qs else "")
+    from seaweedfs_tpu.s3.auth import uri_encode
+    wire_path = uri_encode(path, encode_slash=False)
+    url = f"{gw.url}{wire_path}" + (f"?{qs}" if qs else "")
     return http_bytes(method, url, body if body else None, headers)
 
 
@@ -189,3 +191,38 @@ def test_list_objects_sorted_with_sibling_file(s3):
     root = ET.fromstring(body)
     keys = [c.find("{*}Key").text for c in root.findall("{*}Contents")]
     assert keys == ["a!", "a/b.txt", "a0"]
+
+
+def test_key_with_space_and_unicode(s3):
+    s3req(s3, "PUT", "/uni")
+    for key in ("my file.txt", "päth/tö/fïle"):
+        status, _, _ = s3req(s3, "PUT", f"/uni/{key}", b"data-" + key.encode())
+        assert status == 200, key
+        status, got, _ = s3req(s3, "GET", f"/uni/{key}")
+        assert status == 200 and got == b"data-" + key.encode(), key
+
+
+def test_multipart_manifest_drops_stray_parts(s3):
+    s3req(s3, "PUT", "/mf")
+    status, body, _ = s3req(s3, "POST", "/mf/obj", query={"uploads": ""})
+    upload_id = ET.fromstring(body).find("{*}UploadId").text
+    for i, pd in ((1, b"one"), (2, b"two"), (3, b"STRAY")):
+        s3req(s3, "PUT", "/mf/obj", pd,
+              query={"partNumber": str(i), "uploadId": upload_id})
+    manifest = (b'<CompleteMultipartUpload>'
+                b'<Part><PartNumber>1</PartNumber></Part>'
+                b'<Part><PartNumber>2</PartNumber></Part>'
+                b'</CompleteMultipartUpload>')
+    status, body, _ = s3req(s3, "POST", "/mf/obj", manifest,
+                            query={"uploadId": upload_id})
+    assert status == 200
+    status, got, _ = s3req(s3, "GET", "/mf/obj")
+    assert got == b"onetwo"
+
+
+def test_stale_date_rejected(s3):
+    headers = sign_request("GET", s3.url, "/", {}, {}, b"",
+                           "AKIDEXAMPLE", "secretkey123",
+                           amz_date="20200101T000000Z")
+    status, body, _ = http_bytes("GET", f"{s3.url}/", None, headers)
+    assert status == 403 and b"skewed" in body
