@@ -32,12 +32,22 @@ def main(argv: list[str] | None = None) -> int:
     v.add_argument("-dataCenter", default="")
     v.add_argument("-rack", default="")
 
-    s = sub.add_parser("server", help="master + volume in one process")
+    s = sub.add_parser(
+        "server", help="all-in-one: master + volume (+ filer + s3), the "
+        "weed server / weed mini analog (command/mini.go:894 "
+        "dependency-ordered startup)")
     s.add_argument("-ip", default="127.0.0.1")
     s.add_argument("-master.port", dest="master_port", type=int,
                    default=9333)
     s.add_argument("-volume.port", dest="volume_port", type=int,
                    default=8080)
+    s.add_argument("-filer", action="store_true")
+    s.add_argument("-filer.port", dest="filer_port", type=int,
+                   default=8888)
+    s.add_argument("-s3", action="store_true")
+    s.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
+    s.add_argument("-s3.accessKey", dest="s3_access", default="")
+    s.add_argument("-s3.secretKey", dest="s3_secret", default="")
     s.add_argument("-dir", default=".")
 
     fl = sub.add_parser("filer", help="start a filer server")
@@ -113,12 +123,27 @@ def main(argv: list[str] | None = None) -> int:
         print(f"volume server listening on {vs.url}")
         _wait()
     elif args.cmd == "server":
+        import os as _os
         from .server.master_server import MasterServer
         from .server.volume_server import VolumeServer
         ms = MasterServer(args.ip, args.master_port).start()
         vs = VolumeServer([args.dir], ms.url, host=args.ip,
                           port=args.volume_port).start()
         print(f"master on {ms.url}, volume on {vs.url}")
+        if args.filer or args.s3:
+            from .server.filer_server import FilerServer
+            fs = FilerServer(
+                ms.url, args.ip, args.filer_port,
+                store_path=_os.path.join(args.dir, "filer.db"))
+            fs.start()
+            print(f"filer on {fs.url}")
+            if args.s3:
+                from .s3 import S3ApiServer
+                creds = {args.s3_access: args.s3_secret} \
+                    if args.s3_access else None
+                gw = S3ApiServer(fs.filer, args.ip, args.s3_port,
+                                 credentials=creds).start()
+                print(f"s3 on {gw.url}")
         _wait()
     elif args.cmd == "filer":
         from .server.filer_server import FilerServer

@@ -1,11 +1,43 @@
 """Client SDK verbs (weed/operation/): assign, upload, submit, lookup,
-delete — the operations every gateway and tool builds on."""
+delete — the operations every gateway and tool builds on.  Lookups go
+through a TTL'd vid->locations cache (weed/wdclient/vid_map.go)."""
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass
 
 from .server.httpd import http_bytes, http_json
+
+
+class VidCache:
+    """wdclient/vid_map.go: volume-id -> locations with TTL + explicit
+    invalidation on read failure."""
+
+    TTL = 10.0
+
+    def __init__(self):
+        self._m: dict[tuple[str, int], tuple[float, list[dict]]] = {}
+        self._lock = threading.Lock()
+
+    def get(self, master: str, vid: int) -> "list[dict] | None":
+        with self._lock:
+            hit = self._m.get((master, vid))
+            if hit and time.time() - hit[0] < self.TTL:
+                return hit[1]
+        return None
+
+    def put(self, master: str, vid: int, locs: list[dict]) -> None:
+        with self._lock:
+            self._m[(master, vid)] = (time.time(), locs)
+
+    def invalidate(self, master: str, vid: int) -> None:
+        with self._lock:
+            self._m.pop((master, vid), None)
+
+
+_vid_cache = VidCache()
 
 
 @dataclass
@@ -55,11 +87,16 @@ def submit(master: str, data: bytes, name: str = "", mime: str = "",
     return a.fid
 
 
-def lookup(master: str, vid: int) -> list[dict]:
+def lookup(master: str, vid: int, use_cache: bool = True) -> list[dict]:
     """operation/lookup.go Lookup -> [{url, publicUrl}]."""
+    if use_cache:
+        cached = _vid_cache.get(master, vid)
+        if cached is not None:
+            return cached
     r = http_json("GET", f"{master}/dir/lookup?volumeId={vid}")
     if "error" in r:
         raise LookupError(r["error"])
+    _vid_cache.put(master, vid, r["locations"])
     return r["locations"]
 
 
@@ -74,12 +111,24 @@ def read(master: str, fid: str, offset: int = 0,
         end = f"{offset + size - 1}" if size is not None else ""
         headers["Range"] = f"bytes={offset}-{end}"
     last_err = None
-    for loc in locs:
-        status, body, _ = http_bytes("GET", f"{loc['url']}/{fid}",
-                                     None, headers)
-        if status in (200, 206):
-            return body
-        last_err = f"{loc['url']} -> {status}"
+    for attempt in range(2):
+        for loc in locs:
+            try:
+                status, body, _ = http_bytes(
+                    "GET", f"{loc['url']}/{fid}", None, headers)
+            except OSError as e:
+                last_err = f"{loc['url']} -> {e}"
+                continue
+            if status in (200, 206):
+                return body
+            last_err = f"{loc['url']} -> {status}"
+        # stale cache? refresh once and retry (vidmap invalidation)
+        _vid_cache.invalidate(master, vid)
+        if attempt == 0:
+            try:
+                locs = lookup(master, vid, use_cache=False)
+            except LookupError as e:
+                raise RuntimeError(f"read {fid}: {e}")
     raise RuntimeError(f"read {fid}: {last_err}")
 
 

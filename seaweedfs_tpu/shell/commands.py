@@ -389,6 +389,118 @@ def cmd_ec_balance(env: CommandEnv, args: list[str]) -> str:
     return "\n".join(out) if out else "no ec volumes"
 
 
+def _copy_volume_files(env: CommandEnv, vid: int, collection: str,
+                       src: str, dst: str) -> None:
+    """Pull .dat/.idx/.vif from src and push to dst (the CopyFile /
+    ReceiveFile pattern, volume_server.proto:69-101)."""
+    for ext in (".dat", ".idx", ".vif"):
+        status, data, _ = http_bytes(
+            "GET", f"{src}/admin/volume_file?volumeId={vid}"
+            f"&collection={collection}&ext={ext}")
+        if status != 200:
+            if ext == ".vif":
+                continue
+            raise RuntimeError(f"copy {ext} from {src}: {status}")
+        status, body, _ = http_bytes(
+            "POST", f"{dst}/admin/receive_file?volumeId={vid}"
+            f"&collection={collection}&ext={ext}", data)
+        if status != 200:
+            raise RuntimeError(f"push {ext} to {dst}: {status}")
+
+
+def _move_volume(env: CommandEnv, vid: int, collection: str,
+                 src: str, dst: str, delete_source: bool = True) -> None:
+    """shell/command_volume_move.go pipeline: freeze, copy, mount,
+    delete source."""
+    _must(http_json("POST", f"{src}/admin/set_readonly",
+                    {"volumeId": vid, "readOnly": True}),
+          f"set readonly on {src}")
+    _copy_volume_files(env, vid, collection, src, dst)
+    _must(http_json("POST", f"{dst}/admin/mount_volume",
+                    {"volumeId": vid, "collection": collection}),
+          f"mount on {dst}")
+    if delete_source:
+        _must(http_json("POST", f"{src}/admin/delete_volume",
+                        {"volumeId": vid}),
+              f"delete source on {src}")
+    else:
+        _must(http_json("POST", f"{src}/admin/set_readonly",
+                        {"volumeId": vid, "readOnly": False}),
+              f"clear readonly on {src}")
+
+
+@command("volume.balance")
+def cmd_volume_balance(env: CommandEnv, args: list[str]) -> str:
+    """shell/command_volume_balance.go: even out volume counts across
+    servers by moving volumes from the fullest to the emptiest."""
+    env.confirm_is_locked()
+    from ..topology import iter_volume_list_volumes
+    vl = env.volume_list()
+    per_node: dict[str, list[dict]] = {}
+    for node, v in iter_volume_list_volumes(vl):
+        per_node.setdefault(node["url"], []).append(v)
+    for url in _all_node_urls(env):
+        per_node.setdefault(url, [])
+    if not per_node:
+        return "no volume servers"
+    total = sum(len(v) for v in per_node.values())
+    avg = max(1, -(-total // len(per_node)))
+    moved = 0
+    for donor in sorted(per_node, key=lambda u: -len(per_node[u])):
+        while len(per_node[donor]) > avg:
+            recv = min(per_node, key=lambda u: len(per_node[u]))
+            if recv == donor or len(per_node[recv]) + 1 > avg:
+                break
+            donor_vids = {v["id"] for v in per_node[donor]}
+            recv_vids = {v["id"] for v in per_node[recv]}
+            movable = [v for v in per_node[donor]
+                       if v["id"] not in recv_vids]
+            if not movable:
+                break
+            v = movable[-1]
+            _move_volume(env, v["id"], v.get("collection", ""),
+                         donor, recv)
+            per_node[donor].remove(v)
+            per_node[recv].append(v)
+            moved += 1
+    return f"moved {moved} volumes"
+
+
+@command("volume.fix.replication")
+def cmd_volume_fix_replication(env: CommandEnv, args: list[str]) -> str:
+    """shell/command_volume_fix_replication.go: re-create missing
+    replicas for under-replicated volumes."""
+    env.confirm_is_locked()
+    from ..storage.replica_placement import ReplicaPlacement
+    from ..topology import iter_volume_list_volumes
+    vl = env.volume_list()
+    locations: dict[int, list[str]] = {}
+    meta: dict[int, dict] = {}
+    for node, v in iter_volume_list_volumes(vl):
+        locations.setdefault(v["id"], []).append(node["url"])
+        meta[v["id"]] = v
+    nodes = _all_node_urls(env)
+    fixed = []
+    for vid, locs in sorted(locations.items()):
+        v = meta[vid]
+        want = ReplicaPlacement.from_byte(
+            v.get("replicaPlacement", 0)).copy_count()
+        missing = want - len(locs)
+        if missing <= 0:
+            continue
+        candidates = [n for n in nodes if n not in locs]
+        for dst in candidates[:missing]:
+            _copy_volume_files(env, vid, v.get("collection", ""),
+                               locs[0], dst)
+            _must(http_json("POST", f"{dst}/admin/mount_volume",
+                            {"volumeId": vid,
+                             "collection": v.get("collection", "")}),
+                  f"mount on {dst}")
+            fixed.append(f"{vid}->{dst}")
+    return f"fixed replicas: {fixed}" if fixed else \
+        "all volumes sufficiently replicated"
+
+
 @command("ec.scrub")
 def cmd_ec_scrub(env: CommandEnv, args: list[str]) -> str:
     """shell/command_ec_scrub.go:31 — modes index/local (:52)."""
