@@ -2,8 +2,7 @@
 
 Measures the TPU GF(2^8) constant-matrix-apply kernel (the re-expression
 of the reference's hot loop, weed/storage/erasure_coding/ec_encoder.go:265
-enc.Encode via klauspost/reedsolomon SIMD) on whatever accelerator the
-session exposes, and prints ONE JSON line:
+enc.Encode via klauspost/reedsolomon SIMD) and prints ONE JSON line:
 
     {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
 
@@ -12,11 +11,24 @@ volume data bytes consumed per second (input bytes, not input+parity).
 `vs_baseline` is the ratio to the reference CPU engine's typical RS(10,4)
 single-core SIMD throughput (BASELINE.md records no published EC numbers;
 klauspost/reedsolomon's own amd64 benchmarks put 10+4 encode at roughly
-6 GB/s/core, which we use as the stand-in until the driver measures the
-Go path on the eval machine).
+6 GB/s/core); the measured on-machine native C++ engine number is also
+reported as `measured_native_cpu_gbps` so either denominator is available.
+
+Robustness contract (round-1 failure was rc=1 with no JSON emitted when
+the axon TPU backend raised during init, and the init can also HANG):
+this file is an orchestrator that never imports jax in the parent
+process.  The measurement runs in a child process (``--measure tpu``)
+under a timeout; on non-zero exit, missing JSON, or timeout it retries
+on the CPU platform (``--measure cpu`` with JAX_PLATFORMS=cpu), and as a
+last resort emits a JSON line measured with the numpy GF engine — so the
+one-line contract holds no matter what the accelerator does.
 """
 
 import json
+import os
+import signal
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -32,10 +44,62 @@ PARITY_SHARDS = 4
 CHAIN = 16  # kernel steps chained per timed launch (amortizes latency)
 ITERS = 3
 
+TPU_TIMEOUT_S = 360  # first compile can be slow over the tunnel
+CPU_TIMEOUT_S = 300
 
-def main() -> None:
+
+def _best_of_gbps(parity_fn, shard_bytes=1024 * 1024, seed=1, iters=3):
+    """Warmup + best-of-N wall-clock GB/s of a host parity(data) callable."""
+    nd = np.random.default_rng(seed).integers(
+        0, 256, size=(DATA_SHARDS, shard_bytes), dtype=np.uint8)
+    parity_fn(nd[:, :1024])  # warmup
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        parity_fn(nd)
+        best = min(best, time.perf_counter() - t0)
+    return DATA_SHARDS * shard_bytes / best / 1e9
+
+
+def _measure_native_cpu_gbps():
+    """Measured on-machine CPU engine (our C++/AVX-512 klauspost analog)."""
+    try:
+        from seaweedfs_tpu.ops import rs_native
+        if not rs_native.available():
+            return None
+        nat = rs_native.ReedSolomonNative(DATA_SHARDS, PARITY_SHARDS)
+        return round(_best_of_gbps(nat.parity), 2)
+    except Exception:
+        return None
+
+
+def _emit(gbps, backend, shard_bytes, note=None):
+    rec = {
+        "metric": "ec_encode_rs10+4_GBps_per_chip",
+        "value": round(gbps, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / BASELINE_CPU_GBPS, 2),
+        "backend": backend,
+        "shard_bytes": shard_bytes,
+        "baseline_cpu_gbps": BASELINE_CPU_GBPS,
+        "measured_native_cpu_gbps": _measure_native_cpu_gbps(),
+    }
+    if note:
+        rec["note"] = note
+    print(json.dumps(rec))
+
+
+def measure(platform: str) -> None:
+    """Child-process mode: run the device measurement and print the JSON."""
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     import jax.numpy as jnp
+    if platform == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
 
     from seaweedfs_tpu.ops import rs_matrix
     from seaweedfs_tpu.ops import rs_pallas
@@ -76,36 +140,82 @@ def main() -> None:
         best_dt = min(best_dt, (time.perf_counter() - t0) / CHAIN)
 
     gbps = (DATA_SHARDS * shard_bytes) / best_dt / 1e9
+    _emit(gbps, backend, shard_bytes)
 
-    # measured on-machine CPU engine (our C++/AVX-512 klauspost analog)
-    native_gbps = None
+
+def _run_child(platform: str, timeout_s: int):
+    """Run `bench.py --measure <platform>` and return its JSON line or None."""
+    env = dict(os.environ)
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    # start_new_session + killpg: a hung TPU-runtime grandchild inheriting
+    # the capture pipes would otherwise keep communicate() blocked after
+    # the direct child is killed — the exact parent hang this guards.
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--measure", platform],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        start_new_session=True)
     try:
-        from seaweedfs_tpu.ops import rs_native
-        if rs_native.available():
-            nat = rs_native.ReedSolomonNative(DATA_SHARDS, PARITY_SHARDS)
-            nd = np.random.default_rng(1).integers(
-                0, 256, size=(DATA_SHARDS, 1024 * 1024), dtype=np.uint8)
-            nat.parity(nd[:, :1024])
-            best = float("inf")
-            for _ in range(3):
-                t0 = time.perf_counter()
-                nat.parity(nd)
-                best = min(best, time.perf_counter() - t0)
-            native_gbps = round(DATA_SHARDS * nd.shape[1] / best / 1e9, 2)
-    except Exception:
-        pass
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            proc.kill()
+        try:
+            proc.communicate(timeout=10)
+        except Exception:
+            pass
+        print(f"bench: --measure {platform} timed out after {timeout_s}s",
+              file=sys.stderr)
+        return None
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                json.loads(line)
+                return line
+            except ValueError:
+                continue
+    print(f"bench: --measure {platform} rc={proc.returncode}, no JSON; "
+          f"stderr tail: {stderr[-2000:]}", file=sys.stderr)
+    return None
 
-    print(json.dumps({
-        "metric": "ec_encode_rs10+4_GBps_per_chip",
-        "value": round(gbps, 2),
-        "unit": "GB/s",
-        "vs_baseline": round(gbps / BASELINE_CPU_GBPS, 2),
-        "backend": backend,
-        "shard_bytes": shard_bytes,
-        "baseline_cpu_gbps": BASELINE_CPU_GBPS,
-        "measured_native_cpu_gbps": native_gbps,
-    }))
+
+def _numpy_fallback() -> None:
+    """Last resort: measure the pure-numpy GF engine so the JSON contract
+    holds even if JAX is completely unusable in this environment."""
+    from seaweedfs_tpu.ops import rs_cpu
+    shard_bytes = 1024 * 1024
+    enc = rs_cpu.ReedSolomonCPU(DATA_SHARDS, PARITY_SHARDS)
+    gbps = _best_of_gbps(enc.parity, shard_bytes, seed=2)
+    _emit(gbps, "numpy", shard_bytes,
+          note="jax unavailable on both tpu and cpu; numpy GF engine")
+
+
+def main() -> None:
+    line = _run_child("tpu", TPU_TIMEOUT_S)
+    if line is None:
+        line = _run_child("cpu", CPU_TIMEOUT_S)
+    if line is not None:
+        print(line)
+        return
+    try:
+        _numpy_fallback()
+    except Exception as exc:  # absolute last resort: still one JSON line
+        print(json.dumps({
+            "metric": "ec_encode_rs10+4_GBps_per_chip",
+            "value": 0.0,
+            "unit": "GB/s",
+            "vs_baseline": 0.0,
+            "backend": "none",
+            "error": repr(exc),
+        }))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--measure":
+        measure(sys.argv[2])
+    else:
+        main()
