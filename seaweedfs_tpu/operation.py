@@ -4,8 +4,10 @@ through a TTL'd vid->locations cache (weed/wdclient/vid_map.go)."""
 
 from __future__ import annotations
 
+import json
 import threading
 import time
+import urllib.parse
 from dataclasses import dataclass
 
 from .server.httpd import http_bytes, http_json
@@ -65,26 +67,46 @@ def assign(master: str, count: int = 1, collection: str = "",
                       r.get("count", count))
 
 
+class UploadError(RuntimeError):
+    def __init__(self, msg: str, status: int):
+        super().__init__(msg)
+        self.status = status
+
+
 def upload(url: str, fid: str, data: bytes, name: str = "",
            mime: str = "") -> dict:
     """operation/upload_content.go Upload."""
-    qs = f"?name={name}" if name else ""
+    qs = "?" + urllib.parse.urlencode({"name": name}) if name else ""
     headers = {"Content-Type": mime} if mime else {}
     status, body, _ = http_bytes("POST", f"{url}/{fid}{qs}", data, headers)
     if status >= 300:
-        raise RuntimeError(f"upload {fid} -> {status}: {body[:200]!r}")
-    import json
+        raise UploadError(f"upload {fid} -> {status}: {body[:200]!r}",
+                          status)
     return json.loads(body)
 
 
 def submit(master: str, data: bytes, name: str = "", mime: str = "",
            collection: str = "", replication: str = "",
-           ttl: str = "") -> str:
-    """operation/submit.go: assign + upload; returns the fid."""
-    a = assign(master, collection=collection, replication=replication,
-               ttl=ttl)
-    upload(a.url, a.fid, data, name=name, mime=mime)
-    return a.fid
+           ttl: str = "", retries: int = 3) -> str:
+    """operation/submit.go: assign + upload; returns the fid.
+
+    A failed upload retries with a FRESH assign (the reference's
+    assign-then-upload retry loop) so one replica hiccup or a dead
+    volume server doesn't fail the write."""
+    last: Exception | None = None
+    for _ in range(max(retries, 1)):
+        try:
+            a = assign(master, collection=collection,
+                       replication=replication, ttl=ttl)
+            upload(a.url, a.fid, data, name=name, mime=mime)
+            return a.fid
+        except UploadError as e:
+            if e.status < 500:
+                raise  # deterministic rejection — retrying can't help
+            last = e
+        except (RuntimeError, OSError) as e:
+            last = e
+    raise RuntimeError(f"submit failed after {retries} attempts: {last}")
 
 
 def lookup(master: str, vid: int, use_cache: bool = True) -> list[dict]:
@@ -133,6 +155,32 @@ def read(master: str, fid: str, offset: int = 0,
 
 
 def delete(master: str, fid: str) -> None:
+    """operation/delete_content.go: delete at one replica location — the
+    volume server fans the delete out to siblings (store_replicate.go:142
+    ReplicatedDelete / store_ec_delete.go:38), and fans out even when its
+    own copy is already gone.  A 2xx from any location therefore means
+    every holder was told.  A location that 404s without hosting the
+    volume can't fan out, so the loop continues past 404s; only when
+    EVERY location answered 404 is the needle treated as already gone.
+    Anything else raises — a lost delete is never silent."""
     vid = int(fid.split(",", 1)[0])
-    for loc in lookup(master, vid):
-        http_bytes("DELETE", f"{loc['url']}/{fid}")
+    last = "no locations"
+    # fresh lookup: the all-404-means-gone conclusion below is unsound
+    # over a stale TTL'd cache (moved volumes would 404 everywhere)
+    locs = lookup(master, vid, use_cache=False)
+    answered = 0
+    for loc in locs:
+        try:
+            status, body, _ = http_bytes("DELETE", f"{loc['url']}/{fid}")
+        except OSError as e:
+            last = f"{loc['url']}: {e}"
+            continue
+        if status < 300:
+            return
+        if status == 404:
+            answered += 1
+            continue
+        last = f"{loc['url']} -> {status}: {body[:200]!r}"
+    if locs and answered == len(locs):
+        return  # gone (or never existed) everywhere
+    raise RuntimeError(f"delete {fid}: {last}")

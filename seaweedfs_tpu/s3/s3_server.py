@@ -101,7 +101,7 @@ class S3ApiServer:
         _elem(owner, "ID", "seaweedfs-tpu")
         buckets = _elem(root, "Buckets")
         for e in self.filer.list_directory(BUCKETS_ROOT):
-            if e.is_directory and not e.name.startswith("."):
+            if e.is_directory:
                 b = _elem(buckets, "Bucket")
                 _elem(b, "Name", e.name)
                 _elem(b, "CreationDate", _iso(e.attributes.crtime))
@@ -119,9 +119,9 @@ class S3ApiServer:
         if req.method == "DELETE":
             if self.filer.find_entry(path) is None:
                 return _error(404, "NoSuchBucket", bucket)
-            # dot-dirs (.uploads scratch) don't count as bucket content
+            # only the reserved .uploads scratch dir is not bucket content
             children = self.filer.list_directory(path, limit=1000)
-            if any(not c.name.startswith(".") for c in children):
+            if any(c.name != UPLOADS_DIR[1:] for c in children):
                 return _error(409, "BucketNotEmpty", bucket)
             self.filer.delete_entry(path, recursive=True)
             return 204, b""
@@ -174,8 +174,24 @@ class S3ApiServer:
         if req.method == "DELETE":
             if entry is not None:
                 self.filer.delete_entry(path)
+                self._prune_empty_dirs(path, bucket)
             return 204, b""
         return _error(405, "MethodNotAllowed", req.method)
+
+    def _prune_empty_dirs(self, path: str, bucket: str) -> None:
+        """Remove now-empty parent directories up to the bucket root
+        (S3 has no directories — an emptied prefix must disappear;
+        s3api/s3api_object_handlers_delete.go doDeleteEmptyDirectories)."""
+        stop = self._bucket_path(bucket)
+        parent = path.rsplit("/", 1)[0]
+        while parent != stop and parent.startswith(stop + "/"):
+            if self.filer.list_directory(parent, limit=1):
+                break
+            try:
+                self.filer.delete_entry(parent)
+            except IsADirectoryError:
+                break  # concurrent PUT repopulated it — keep it
+            parent = parent.rsplit("/", 1)[0]
 
     def _copy_object(self, req: Request, src: str, dst_path: str):
         src = urllib.parse.unquote(src.lstrip("/"))
@@ -201,8 +217,9 @@ class S3ApiServer:
         for obj in root.iter():
             if obj.tag.endswith("Key"):
                 key = obj.text or ""
-                self.filer.delete_entry(
-                    f"{self._bucket_path(bucket)}/{key}")
+                path = f"{self._bucket_path(bucket)}/{key}"
+                self.filer.delete_entry(path)
+                self._prune_empty_dirs(path, bucket)
                 d = _elem(result, "Deleted")
                 _elem(d, "Key", key)
         return 200, (_xml(result), "application/xml")
@@ -250,7 +267,11 @@ class S3ApiServer:
                 return e.name + ("/" if e.is_directory else "")
             for e in sorted(page, key=eff):
                 if e.is_directory:
-                    if not e.name.startswith("."):
+                    # hide only the reserved multipart scratch dir at the
+                    # bucket root; dot-prefixed path segments are legal
+                    # S3 keys (e.g. ".well-known/acme")
+                    if not (key_prefix == "" and
+                            e.name == UPLOADS_DIR[1:]):
                         yield from walk_sorted(
                             f"{dir_path}/{e.name}",
                             key_prefix + e.name + "/")

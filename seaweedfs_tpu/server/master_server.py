@@ -27,6 +27,10 @@ from ..topology import Topology
 from .httpd import HttpServer, Request, http_json
 
 
+class _AllocateRefused(Exception):
+    """A reachable volume server answered an allocation with an error."""
+
+
 class MasterServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  volume_size_limit_mb: int = 1024,
@@ -118,27 +122,60 @@ class MasterServer:
                      count: int = 1) -> list[int]:
         """volume_growth.go: pick targets, allocate on each
         (AllocateVolume RPC -> /admin/allocate_volume)."""
+        from ..storage.replica_placement import ReplicaPlacement
+        from ..topology.topology import VolumeInfo
         with self._grow_lock:
             grown = []
             for _ in range(count):
-                targets = self.topology.plan_growth(replication)
-                vid = self.topology.next_volume_id()
-                for node in targets:
-                    http_json("POST", f"{node.url}/admin/allocate_volume", {
-                        "volumeId": vid,
-                        "collection": collection,
-                        "replication": replication,
-                        "ttl": ttl,
-                    })
-                    # optimistic registration; heartbeat confirms
-                    from ..topology.topology import VolumeInfo
-                    from ..storage.replica_placement import ReplicaPlacement
-                    node.volumes[vid] = VolumeInfo(
-                        id=vid, collection=collection,
-                        replica_placement=ReplicaPlacement.from_string(
-                            replication or "000").byte(),
-                        ttl=_ttl_u32(ttl))
-                grown.append(vid)
+                # an unreachable target is marked dead and planning
+                # retries over the remaining nodes (the reference drops a
+                # node whose heartbeat stream breaks; allocation failures
+                # surface the same fact earlier)
+                last_err: object = None
+                excluded: set[str] = set()
+                for _attempt in range(4):
+                    targets = self.topology.plan_growth(
+                        replication, exclude=excluded)
+                    vid = self.topology.next_volume_id()
+                    done = []
+                    try:
+                        for node in targets:
+                            r = http_json(
+                                "POST",
+                                f"{node.url}/admin/allocate_volume", {
+                                    "volumeId": vid,
+                                    "collection": collection,
+                                    "replication": replication,
+                                    "ttl": ttl,
+                                }, timeout=10)
+                            if "error" in r:
+                                # alive but refusing (disk full, perms):
+                                # exclude from re-planning, don't kill it
+                                excluded.add(node.url)
+                                raise _AllocateRefused(
+                                    f"{node.url}: {r['error']}")
+                            done.append(node)
+                            # optimistic registration; heartbeat confirms
+                            node.volumes[vid] = VolumeInfo(
+                                id=vid, collection=collection,
+                                replica_placement=ReplicaPlacement
+                                .from_string(replication or "000").byte(),
+                                ttl=_ttl_u32(ttl))
+                    except _AllocateRefused as e:
+                        for n in done:
+                            n.volumes.pop(vid, None)
+                        last_err = e
+                        continue
+                    except OSError as e:
+                        for n in done:
+                            n.volumes.pop(vid, None)
+                        self.topology.mark_dead(node.url)
+                        last_err = e
+                        continue
+                    grown.append(vid)
+                    break
+                else:
+                    raise LookupError(f"volume growth failed: {last_err}")
             return grown
 
     def _lookup(self, req: Request):

@@ -17,9 +17,7 @@ import os
 import re
 import threading
 import time
-
-_SAFE_EXT = re.compile(r"^\.(dat|idx|vif|ecx|ecj|ec\d{2})$")
-_SAFE_COLLECTION = re.compile(r"^[A-Za-z0-9_.-]*$")
+import urllib.parse
 
 from ..storage import types
 from ..storage.erasure_coding import ECContext
@@ -28,6 +26,20 @@ from ..storage.erasure_coding.ec_context import to_ext
 from ..storage.needle import Needle
 from ..storage.store import Store
 from .httpd import HttpServer, Request, http_bytes, http_json
+
+_SAFE_EXT = re.compile(r"^\.(dat|idx|vif|ecx|ecj|ec\d{2})$")
+_SAFE_COLLECTION = re.compile(r"^[A-Za-z0-9_.-]*$")
+
+
+def _check_path_fields(collection: str, ext: str | None = None) -> None:
+    """Both fields land in filesystem paths — reject traversal before any
+    path is built.  Centralized here so every handler that touches the
+    disk from request fields (volume_file, receive_file, ec/*) shares the
+    same invariant."""
+    if ext is not None and not _SAFE_EXT.match(ext):
+        raise ValueError(f"unacceptable ext {ext!r}")
+    if not _SAFE_COLLECTION.match(collection):
+        raise ValueError(f"unacceptable collection {collection!r}")
 
 
 class VolumeServer:
@@ -131,7 +143,7 @@ class VolumeServer:
             self.metrics.counter_add("received_bytes", len(req.body))
             return self._put_needle(fid, req)
         if req.method == "DELETE":
-            return self._delete_needle(fid)
+            return self._delete_needle(fid, req)
         return 405, {"error": "method not allowed"}
 
     def _metrics(self, req: Request):
@@ -189,7 +201,8 @@ class VolumeServer:
                                  "multipart/form-data"):
             n.set_mime(mime.encode())
         ts = req.query.get("ts")
-        n.set_last_modified(int(ts) if ts else int(time.time()))
+        ts_val = int(ts) if ts else int(time.time())
+        n.set_last_modified(ts_val)
         try:
             size, unchanged = self.store.write_needle(fid.volume_id, n)
         except KeyError:
@@ -197,24 +210,77 @@ class VolumeServer:
         except PermissionError as e:
             return 409, {"error": str(e)}
         # synchronous replication fan-out
-        # (topology/store_replicate.go:27 ReplicatedWrite)
+        # (topology/store_replicate.go:27 ReplicatedWrite); forward the
+        # original Content-Type and stamp ts so every replica writes a
+        # byte-identical needle record (store_replicate.go ReplicatedWrite
+        # forwards the request as-is)
         if req.query.get("type") != "replicate":
-            err = self._replicate(fid, req, "POST", req.body)
+            # always set Content-Type: with a body and no header urllib
+            # injects x-www-form-urlencoded, which the replica would store
+            # as the needle mime (octet-stream is in the excluded list)
+            err = self._replicate(
+                fid, req, "POST", req.body,
+                extra_query={"ts": str(ts_val)},
+                headers={"Content-Type":
+                         mime or "application/octet-stream"})
             if err:
                 return 500, {"error": f"replication: {err}"}
         return 201, {"name": name, "size": size, "eTag": n.etag(),
                      "unchanged": unchanged}
 
-    def _delete_needle(self, fid: types.FileId):
+    def _delete_needle(self, fid: types.FileId, req: Request):
         try:
             freed = self.store.delete_needle(
                 fid.volume_id, Needle(cookie=fid.cookie, id=fid.key))
         except KeyError:
+            freed = None
+        # deletes fan out like writes (store_replicate.go:142
+        # ReplicatedDelete; EC: store_ec_delete.go:38) — a delete lost on
+        # one holder would leave the object readable there via the read
+        # path's location fallback.  Fan out even when the local copy is
+        # already gone, and accept a sibling's 404, so concurrent/retried
+        # deletes stay idempotent.
+        if req.query.get("type") != "replicate":
+            if self.store.find_ec_volume(fid.volume_id) is not None:
+                err = self._ec_delete_fan_out(fid)
+            else:
+                err = self._replicate(fid, req, "DELETE", None,
+                                      ok_statuses=(404,))
+            if err:
+                return 500, {"error": f"replication: {err}"}
+        if freed is None:
             return 404, {"error": "not found"}
         return 202, {"size": freed}
 
+    def _ec_delete_fan_out(self, fid: types.FileId) -> str | None:
+        """Tombstone the needle in every other shard holder's .ecx/.ecj
+        (store_ec_delete.go:38 doDeleteNeedleFromAtLeastOneRemoteEcShards;
+        each holder keeps a full index copy)."""
+        try:
+            r = http_json(
+                "GET",
+                f"{self.master}/dir/ec_lookup?volumeId={fid.volume_id}",
+                timeout=5)
+        except OSError as e:
+            return str(e)
+        if "error" in r:
+            # master doesn't know the shard set (restart, re-registration
+            # in flight) — failing loudly beats a silent lost delete
+            return f"ec_lookup: {r['error']}"
+        for loc in {l["url"] for l in r.get("shardIdLocations", [])}:
+            if loc in (self.url, self.store.public_url):
+                continue
+            status, data, _ = http_bytes(
+                "DELETE", f"{loc}/{fid}?type=replicate")
+            if status >= 300 and status != 404:
+                return f"{loc} -> {status}: {data[:200]!r}"
+        return None
+
     def _replicate(self, fid: types.FileId, req: Request, method: str,
-                   body: bytes | None) -> str | None:
+                   body: bytes | None,
+                   extra_query: dict[str, str] | None = None,
+                   headers: dict[str, str] | None = None,
+                   ok_statuses: tuple[int, ...] = ()) -> str | None:
         """Fan out to sibling replicas, excluding self
         (store_replicate.go:192 DistributedOperation)."""
         v = self.store.find_volume(fid.volume_id)
@@ -227,8 +293,9 @@ class VolumeServer:
                 timeout=5).get("locations", [])
         except OSError as e:
             return str(e)
-        qs = "&".join(f"{k}={v}" for k, v in req.query.items()
-                      if k != "type")
+        query = {k: v for k, v in req.query.items() if k != "type"}
+        query.update(extra_query or {})
+        qs = urllib.parse.urlencode(query)
         for loc in locs:
             if loc["url"] in (self.url, self.store.public_url):
                 continue
@@ -236,8 +303,8 @@ class VolumeServer:
                 method,
                 f"{loc['url']}/{fid}?type=replicate" +
                 (f"&{qs}" if qs else ""),
-                body)
-            if status >= 300:
+                body, headers=headers)
+            if status >= 300 and status not in ok_statuses:
                 return f"{loc['url']} -> {status}: {data[:200]!r}"
         return None
 
@@ -297,6 +364,10 @@ class VolumeServer:
         vid = int(req.query["volumeId"])
         ext = req.query["ext"]
         collection = req.query.get("collection", "")
+        try:
+            _check_path_fields(collection, ext)
+        except ValueError as e:
+            return 400, {"error": str(e)}
         offset = int(req.query.get("offset", 0))
         size = int(req.query.get("size", -1))
         if ext in (".dat", ".idx"):
@@ -318,12 +389,10 @@ class VolumeServer:
         vid = int(req.query["volumeId"])
         collection = req.query.get("collection", "")
         ext = req.query["ext"]
-        if not _SAFE_EXT.match(ext):
-            return 400, {"error": f"unacceptable ext {ext!r}"}
-        if not _SAFE_COLLECTION.match(collection):
-            # the collection lands in a filesystem path — no traversal
-            return 400, {"error": f"unacceptable collection "
-                         f"{collection!r}"}
+        try:
+            _check_path_fields(collection, ext)
+        except ValueError as e:
+            return 400, {"error": str(e)}
         base = self._base_path(vid, collection)
         with open(base + ext, "wb") as f:
             f.write(req.body)
@@ -331,6 +400,7 @@ class VolumeServer:
 
     def _file_path(self, vid: int, collection: str, ext: str
                    ) -> str | None:
+        _check_path_fields(collection, ext)
         name = (f"{collection}_" if collection else "") + f"{vid}{ext}"
         for loc in self.store.locations:
             p = os.path.join(loc.directory, name)
@@ -341,6 +411,7 @@ class VolumeServer:
     def _base_path(self, vid: int, collection: str) -> str:
         """Base file path for volume vid on the disk holding it (or the
         first location for new files)."""
+        _check_path_fields(collection)
         for ext in (".dat", ".ecx", ".ec00"):
             p = self._file_path(vid, collection, ext)
             if p is not None:
@@ -481,7 +552,10 @@ class VolumeServer:
         ev = self.store.find_ec_volume(vid)
         if ev is None or shard_id not in ev.shards:
             return 404, {"error": f"shard {vid}.{shard_id} not found"}
-        return 200, ev.shards[shard_id].read_at(offset, size)
+        # the shard file handle's seek/read must not interleave across
+        # concurrent remote degraded reads (see ec_volume.read_interval)
+        with ev.lock:
+            return 200, ev.shards[shard_id].read_at(offset, size)
 
     def _scrub(self, req: Request):
         """server/volume_grpc_scrub.go ScrubVolume."""

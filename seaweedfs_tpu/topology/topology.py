@@ -116,11 +116,23 @@ class Topology:
             for vid in node.ec_shards:
                 self._max_volume_id = max(self._max_volume_id, vid)
 
+    def _liveness_deadline(self) -> float:
+        return time.time() - 3 * self.pulse_seconds
+
     def alive_nodes(self) -> list[DataNodeInfo]:
-        deadline = time.time() - 3 * self.pulse_seconds
+        deadline = self._liveness_deadline()
         with self.lock:
             return [n for n in self.nodes.values()
                     if n.last_seen >= deadline]
+
+    def mark_dead(self, url: str) -> None:
+        """Immediately expire a node observed unreachable (the analog of
+        topology_event_handling.go UnRegisterDataNode on a broken
+        heartbeat stream) — don't wait out the missed-pulse deadline."""
+        with self.lock:
+            n = self.nodes.get(url)
+            if n is not None:
+                n.last_seen = 0.0
 
     # -- volume id assignment ---------------------------------------------
 
@@ -171,8 +183,13 @@ class Topology:
         rp = ReplicaPlacement.from_string(replication or "000")
         want_copies = rp.copy_count()
         by_vid: dict[int, list[DataNodeInfo]] = {}
+        deadline = self._liveness_deadline()
         with self.lock:
             for node in self.nodes.values():
+                if node.last_seen < deadline:
+                    # a disconnected node's volumes leave the writable
+                    # set (volume_layout.go SetVolumeUnavailable)
+                    continue
                 for vid, v in node.volumes.items():
                     if v.collection != collection:
                         continue
@@ -195,12 +212,16 @@ class Topology:
 
     # -- growth (volume_growth.go) ----------------------------------------
 
-    def plan_growth(self, replication: str = "") -> list[DataNodeInfo]:
+    def plan_growth(self, replication: str = "",
+                    exclude: set[str] | None = None
+                    ) -> list[DataNodeInfo]:
         """Pick target nodes for a new volume's replica set honoring the
         xyz placement (volume_growth.go findEmptySlotsForOneVolume,
-        simplified: grouped by DC then rack with free-slot weighting)."""
+        simplified: grouped by DC then rack with free-slot weighting).
+        `exclude` drops nodes that just refused an allocation."""
         rp = ReplicaPlacement.from_string(replication or "000")
-        alive = [n for n in self.alive_nodes() if n.free_space > 0]
+        alive = [n for n in self.alive_nodes()
+                 if n.free_space > 0 and n.url not in (exclude or ())]
         if not alive:
             raise LookupError("no free volume slots in cluster")
         main = max(alive, key=lambda n: (n.free_space, random.random()))

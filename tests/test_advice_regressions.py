@@ -1,0 +1,162 @@
+"""Regression tests for advisor findings (round 1 ADVICE.md)."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.server.httpd import http_bytes, http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+@pytest.fixture
+def pair(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64).start()
+    servers = []
+    for i in range(2):
+        d = tmp_path / f"vol{i}"
+        d.mkdir()
+        servers.append(VolumeServer([str(d)], master.url,
+                                    pulse_seconds=0.2).start())
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if len(http_json("GET", f"{master.url}/cluster/status")
+               ["dataNodes"]) == 2:
+            break
+        time.sleep(0.05)
+    yield master, servers, tmp_path
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def test_volume_file_rejects_traversal(pair):
+    """ADVICE #1: ext/collection from the request must never escape the
+    storage directories."""
+    master, servers, _ = pair
+    vs = servers[0]
+    for q in ("volumeId=1&ext=/../../../etc/passwd",
+              "volumeId=1&ext=.dat&collection=../../etc",
+              "volumeId=1&ext=.dat%2F..%2Fx"):
+        status, body, _ = http_bytes(
+            "GET", f"{vs.url}/admin/volume_file?{q}")
+        assert status in (400, 500), (q, status)
+        assert b"unacceptable" in body or b"error" in body
+
+    # ec/copy and ec/delete_shards build paths from JSON fields
+    import json
+    for endpoint, payload in (
+            ("/admin/ec/copy",
+             {"volumeId": 1, "collection": "../../etc",
+              "sourceDataNode": servers[1].url, "shardIds": [0]}),
+            ("/admin/ec/delete_shards",
+             {"volumeId": 1, "collection": "../../etc",
+              "shardIds": [0]})):
+        status, body, _ = http_bytes(
+            "POST", f"{vs.url}{endpoint}",
+            json.dumps(payload).encode(),
+            {"Content-Type": "application/json"})
+        assert status in (400, 500), (endpoint, status)
+        assert b"unacceptable" in body
+
+
+def test_replicas_store_identical_needle_records(pair):
+    """ADVICE #3: replica .dat records must be byte-identical to the
+    primary's (Content-Type forwarded, ts stamped)."""
+    master, servers, _ = pair
+    a = operation.assign(master.url, replication="001")
+    operation.upload(a.url, a.fid, b"<html>hi</html>", name="x.html",
+                     mime="text/html")
+    time.sleep(0.5)
+    vid = int(a.fid.split(",")[0])
+    locs = operation.lookup(master.url, vid, use_cache=False)
+    assert len(locs) == 2, locs
+    from seaweedfs_tpu.storage import types as stypes
+    from seaweedfs_tpu.storage.needle import Needle
+    needles = []
+    for loc in locs:
+        status, data, _ = http_bytes(
+            "GET",
+            f"{loc['url']}/admin/volume_file?volumeId={vid}&ext=.dat")
+        assert status == 200
+        # superblock is 8 bytes; one needle record follows
+        needles.append(Needle.from_bytes(
+            data[8:], stypes.CURRENT_VERSION))
+    a_n, b_n = needles
+    # byte-identical up to AppendAtNs, which is legitimately the local
+    # append time on each server (the reference's replicas differ there
+    # too — each runs CreateNeedleFromRequest + append independently)
+    for field in ("cookie", "id", "data", "flags", "name", "mime",
+                  "last_modified", "checksum"):
+        assert getattr(a_n, field) == getattr(b_n, field), field
+    # served Content-Type identical from both replicas
+    mimes = set()
+    for loc in locs:
+        _, _, headers = http_bytes("GET", f"{loc['url']}/{a.fid}")
+        mimes.add(headers.get("Content-Type"))
+    assert mimes == {"text/html"}
+
+
+def test_delete_fans_out_to_replicas(pair):
+    """ADVICE #4: a delete must reach every replica, not just the one
+    the client happened to hit."""
+    master, servers, _ = pair
+    a = operation.assign(master.url, replication="001")
+    operation.upload(a.url, a.fid, b"doomed")
+    time.sleep(0.5)
+    vid = int(a.fid.split(",")[0])
+    locs = operation.lookup(master.url, vid, use_cache=False)
+    assert len(locs) == 2
+    operation.delete(master.url, a.fid)
+    for loc in locs:
+        status, _, _ = http_bytes("GET", f"{loc['url']}/{a.fid}")
+        assert status == 404, f"replica {loc['url']} still serves needle"
+
+
+def test_upload_retry_on_dead_server(pair):
+    """VERDICT weak #8: submit retries with a fresh assign when the
+    assigned volume server is unreachable."""
+    master, servers, _ = pair
+    # kill one server; assigns may point at it until heartbeat expires
+    servers[1].stop()
+    ok = 0
+    for i in range(5):
+        fid = operation.submit(master.url, b"retry-me-%d" % i)
+        assert operation.read(master.url, fid) == b"retry-me-%d" % i
+        ok += 1
+    assert ok == 5
+
+
+def test_replication_with_special_char_name(pair):
+    """Replica fan-out must percent-encode forwarded query values
+    (a name with spaces/&/= would otherwise corrupt the request line)."""
+    master, servers, _ = pair
+    a = operation.assign(master.url, replication="001")
+    operation.upload(a.url, a.fid, b"odd-name-bytes", name="a b&c=d.txt")
+    time.sleep(0.5)
+    vid = int(a.fid.split(",")[0])
+    locs = operation.lookup(master.url, vid, use_cache=False)
+    assert len(locs) == 2
+    for loc in locs:
+        status, body, _ = http_bytes("GET", f"{loc['url']}/{a.fid}")
+        assert status == 200 and body == b"odd-name-bytes", loc
+
+
+def test_delete_idempotent_on_retry(pair):
+    """A retried/concurrent delete must not 500: replicas answering 404
+    to a replicate-delete count as success, and a 404-ing primary still
+    fans out."""
+    master, servers, _ = pair
+    a = operation.assign(master.url, replication="001")
+    operation.upload(a.url, a.fid, b"gone")
+    time.sleep(0.5)
+    operation.delete(master.url, a.fid)
+    # second delete: every location is 404 now; must not raise
+    operation.delete(master.url, a.fid)
+    vid = int(a.fid.split(",")[0])
+    # re-deleting a tombstoned needle is idempotent on every replica:
+    # either 202 (size 0, tombstone already present) or 404 — never 500
+    for loc in operation.lookup(master.url, vid, use_cache=False):
+        status, body, _ = http_bytes("DELETE", f"{loc['url']}/{a.fid}")
+        assert status in (202, 404), (loc, status, body)

@@ -214,3 +214,26 @@ def test_benchmark_harness(cluster):
     assert [r["op"] for r in results] == ["write", "read"]
     assert all(r["requests"] == 40 for r in results)
     assert all(r["req_per_sec"] > 0 for r in results)
+
+
+def test_ec_delete_fans_out_to_all_holders(cluster):
+    """A delete on an EC volume must tombstone every holder's index copy
+    (store_ec_delete.go:38) — a read from any other holder must miss."""
+    master, servers = cluster
+    blobs = _upload_corpus(master.url, n=8, seed=7, collection="ecdel")
+    vid = int(next(iter(blobs)).split(",")[0])
+    env = CommandEnv(master.url)
+    run_command(env, "lock")
+    run_command(env, f"ec.encode -volumeId={vid} -collection=ecdel")
+    time.sleep(0.5)
+    victim, keep = list(blobs)[0], list(blobs)[1]
+    operation.delete(master.url, victim)
+    # every holder must refuse the deleted needle on direct reads
+    from seaweedfs_tpu.server.httpd import http_bytes
+    locs = http_json("GET", f"{master.url}/dir/ec_lookup?volumeId={vid}")
+    urls = {l["url"] for l in locs["shardIdLocations"]}
+    assert len(urls) >= 2
+    for url in urls:
+        status, _, _ = http_bytes("GET", f"{url}/{victim}")
+        assert status == 404, f"{url} still serves deleted EC needle"
+    assert operation.read(master.url, keep) == blobs[keep]

@@ -226,3 +226,25 @@ def test_stale_date_rejected(s3):
                            amz_date="20200101T000000Z")
     status, body, _ = http_bytes("GET", f"{s3.url}/", None, headers)
     assert status == 403 and b"skewed" in body
+
+
+def test_dot_prefixed_segments_listed(s3):
+    """ADVICE #5: '.well-known/acme' is a legal S3 key and must appear in
+    listings; only the reserved '.uploads' scratch dir is hidden."""
+    s3req(s3, "PUT", "/dots")
+    s3req(s3, "PUT", "/dots/.well-known/acme", b"challenge")
+    s3req(s3, "PUT", "/dots/normal.txt", b"n")
+    # an in-flight multipart upload creates the .uploads scratch dir
+    status, body, _ = s3req(s3, "POST", "/dots/big.bin",
+                            query={"uploads": ""})
+    assert status == 200, body
+    status, body, _ = s3req(s3, "GET", "/dots",
+                            query={"list-type": "2"})
+    root = ET.fromstring(body)
+    keys = [c.find("{*}Key").text for c in root.findall("{*}Contents")]
+    assert keys == [".well-known/acme", "normal.txt"], keys
+    # bucket delete still treats the scratch dir as "empty"
+    s3req(s3, "DELETE", "/dots/.well-known/acme")
+    s3req(s3, "DELETE", "/dots/normal.txt")
+    status, body, _ = s3req(s3, "DELETE", "/dots")
+    assert status == 204, body
