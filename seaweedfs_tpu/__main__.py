@@ -15,6 +15,11 @@ import time
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="seaweedfs-tpu")
+    # security.toml discovery (util/config.go:34
+    # LoadSecurityConfiguration; scaffold command/scaffold/security.toml)
+    p.add_argument("-securityToml", default="",
+                   help="path to security.toml (jwt signing keys, "
+                        "admin key, ip whitelist)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     m = sub.add_parser("master", help="start a master server")
@@ -104,6 +109,10 @@ def main(argv: list[str] | None = None) -> int:
     down.add_argument("fid")
 
     args = p.parse_args(argv)
+
+    if args.securityToml:
+        from . import security
+        security.configure(security.load_security_toml(args.securityToml))
 
     if args.cmd == "master":
         from .server.master_server import MasterServer

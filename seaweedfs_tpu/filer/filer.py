@@ -137,7 +137,7 @@ class Filer:
             a = operation.assign(self.master,
                                  collection=self.collection,
                                  replication=self.replication)
-            r = operation.upload(a.url, a.fid, piece)
+            r = operation.upload(a.url, a.fid, piece, auth=a.auth)
             chunks.append(FileChunk(a.fid, off, len(piece),
                                     r.get("eTag", ""),
                                     time.time_ns()))

@@ -10,6 +10,7 @@ import time
 import urllib.parse
 from dataclasses import dataclass
 
+from . import security
 from .server.httpd import http_bytes, http_json
 
 
@@ -48,6 +49,7 @@ class Assignment:
     url: str
     public_url: str
     count: int
+    auth: str = ""  # per-fid write jwt minted by the master
 
 
 def assign(master: str, count: int = 1, collection: str = "",
@@ -64,7 +66,7 @@ def assign(master: str, count: int = 1, collection: str = "",
     if "error" in r:
         raise RuntimeError(f"assign: {r['error']}")
     return Assignment(r["fid"], r["url"], r.get("publicUrl", r["url"]),
-                      r.get("count", count))
+                      r.get("count", count), r.get("auth", ""))
 
 
 class UploadError(RuntimeError):
@@ -74,10 +76,16 @@ class UploadError(RuntimeError):
 
 
 def upload(url: str, fid: str, data: bytes, name: str = "",
-           mime: str = "") -> dict:
-    """operation/upload_content.go Upload."""
+           mime: str = "", auth: str = "") -> dict:
+    """operation/upload_content.go Upload.  `auth` is the per-fid write
+    jwt from assign (falls back to signing locally when this process
+    holds the write key, e.g. in-process filer)."""
     qs = "?" + urllib.parse.urlencode({"name": name}) if name else ""
     headers = {"Content-Type": mime} if mime else {}
+    if not auth:
+        auth = security.current().write_jwt(fid)
+    if auth:
+        headers["Authorization"] = f"Bearer {auth}"
     status, body, _ = http_bytes("POST", f"{url}/{fid}{qs}", data, headers)
     if status >= 300:
         raise UploadError(f"upload {fid} -> {status}: {body[:200]!r}",
@@ -98,7 +106,7 @@ def submit(master: str, data: bytes, name: str = "", mime: str = "",
         try:
             a = assign(master, collection=collection,
                        replication=replication, ttl=ttl)
-            upload(a.url, a.fid, data, name=name, mime=mime)
+            upload(a.url, a.fid, data, name=name, mime=mime, auth=a.auth)
             return a.fid
         except UploadError as e:
             if e.status < 500:
@@ -132,6 +140,10 @@ def read(master: str, fid: str, offset: int = 0,
     if offset or size is not None:
         end = f"{offset + size - 1}" if size is not None else ""
         headers["Range"] = f"bytes={offset}-{end}"
+    # read gating (jwt.go readSigningKey): sign locally when configured
+    read_auth = security.current().read_jwt(fid)
+    if read_auth:
+        headers["Authorization"] = f"Bearer {read_auth}"
     last_err = None
     for attempt in range(2):
         for loc in locs:
@@ -169,9 +181,11 @@ def delete(master: str, fid: str) -> None:
     # over a stale TTL'd cache (moved volumes would 404 everywhere)
     locs = lookup(master, vid, use_cache=False)
     answered = 0
+    headers = security.current().write_headers(fid)
     for loc in locs:
         try:
-            status, body, _ = http_bytes("DELETE", f"{loc['url']}/{fid}")
+            status, body, _ = http_bytes("DELETE", f"{loc['url']}/{fid}",
+                                         headers=headers)
         except OSError as e:
             last = f"{loc['url']}: {e}"
             continue

@@ -22,6 +22,7 @@ class Request:
         parsed = urllib.parse.urlparse(handler.path)
         self.method = handler.command
         self.path = parsed.path
+        self.remote_ip = handler.client_address[0]
         # keep_blank_values: S3-style marker params (?uploads=, ?delete=)
         # must survive parsing
         self.query = {k: v[0] for k, v in
@@ -51,6 +52,10 @@ class HttpServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.routes: dict[tuple[str, str], Route] = {}
         self.fallback: Route | None = None
+        # optional auth hook (security/guard.go Guard): returns None to
+        # continue or a (status, payload) response to short-circuit
+        self.guard: "Callable[[Request], tuple[int, object] | None] | None" \
+            = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -60,7 +65,10 @@ class HttpServer:
                 req = Request(self)
                 route = outer.routes.get((req.method, req.path))
                 try:
-                    if route is not None:
+                    denied = outer.guard(req) if outer.guard else None
+                    if denied is not None:
+                        status, payload = denied
+                    elif route is not None:
                         status, payload = route(req)
                     elif outer.fallback is not None:
                         status, payload = outer.fallback(req)
@@ -127,15 +135,47 @@ class HttpServer:
 
 # --- tiny client helpers -------------------------------------------------
 
+def _auth_for(url: str, headers: dict | None) -> dict:
+    """Attach the process admin JWT to admin-plane requests — the analog
+    of the reference's gRPC client factory applying the global security
+    config to every dial (pb/grpc_client_server.go), so call sites don't
+    plumb credentials."""
+    from .. import security
+    sec = security.current()
+    if not sec.admin_key:
+        return headers or {}
+    path = urllib.parse.urlparse(
+        url if url.startswith("http") else "http://" + url).path
+    if not is_admin_path(path):
+        return headers or {}
+    headers = dict(headers or {})
+    headers.setdefault("Authorization", f"Bearer {sec.admin_jwt()}")
+    return headers
+
+
+def is_admin_path(path: str) -> bool:
+    """The admin/maintenance plane: volume+filer /admin/*, master grow /
+    lock endpoints, and heartbeats (all gRPC-only surfaces in the
+    reference, gated there by grpc credentials)."""
+    return path.startswith("/admin/") or path in (
+        "/vol/grow", "/cluster/lease_admin_token",
+        "/cluster/release_admin_token", "/heartbeat")
+
+
 def http_json(method: str, url: str, payload: dict | None = None,
-              timeout: float = 30.0) -> dict:
+              timeout: float = 30.0,
+              headers: dict | None = None) -> dict:
     """JSON request; non-2xx responses return their parsed error body
-    (callers check for an "error" key, mirroring gRPC status handling)."""
+    (callers check for an "error" key, mirroring gRPC status handling).
+    Explicit `headers` win over the global-config auto-attach (a server
+    with a per-instance security override passes its own tokens)."""
     data = json.dumps(payload).encode() if payload is not None else None
+    headers = dict(headers or {})
+    if data:
+        headers.setdefault("Content-Type", "application/json")
     req = urllib.request.Request(
         ("http://" + url) if not url.startswith("http") else url,
-        data=data, method=method,
-        headers={"Content-Type": "application/json"} if data else {})
+        data=data, method=method, headers=_auth_for(url, headers))
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return json.loads(resp.read() or b"{}")
@@ -154,7 +194,7 @@ def http_bytes(method: str, url: str, body: bytes | None = None,
                ) -> tuple[int, bytes, dict]:
     req = urllib.request.Request(
         ("http://" + url) if not url.startswith("http") else url,
-        data=body, method=method, headers=headers or {})
+        data=body, method=method, headers=_auth_for(url, headers))
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status, resp.read(), dict(resp.headers)

@@ -21,10 +21,12 @@ import threading
 import time
 import uuid
 
+from .. import security
 from ..sequence import MemorySequencer, SnowflakeSequencer
 from ..storage.types import FileId, format_needle_id_cookie
 from ..topology import Topology
-from .httpd import HttpServer, Request, http_json
+from .httpd import HttpServer, Request, http_json, is_admin_path
+from .volume_server import _check_path_fields
 
 
 class _AllocateRefused(Exception):
@@ -35,7 +37,9 @@ class MasterServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  volume_size_limit_mb: int = 1024,
                  default_replication: str = "000",
-                 sequencer: str = "memory", pulse_seconds: float = 1.0):
+                 sequencer: str = "memory", pulse_seconds: float = 1.0,
+                 security_config: "security.SecurityConfig | None" = None):
+        self._security_override = security_config
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -61,6 +65,7 @@ class MasterServer:
         r("POST", "/cluster/lease_admin_token", self._lease_admin)
         r("POST", "/cluster/release_admin_token", self._release_admin)
         r("GET", "/metrics", self._metrics)
+        self.http.guard = self._guard
         from ..stats import Metrics
         self.metrics = Metrics("master")
 
@@ -77,6 +82,23 @@ class MasterServer:
     def url(self) -> str:
         return self.http.url
 
+    # -- auth (security/guard.go) -----------------------------------------
+
+    @property
+    def security(self) -> "security.SecurityConfig":
+        return self._security_override or security.current()
+
+    def _guard(self, req: Request):
+        """Gate the grow/lock/heartbeat plane; assign and lookups stay
+        public like the reference's HTTP API (writes are instead gated
+        at the volume server by the per-fid jwt from assign)."""
+        if is_admin_path(req.path):
+            err = self.security.check_admin(req.query, req.headers,
+                                            req.remote_ip)
+            if err:
+                return 401, {"error": err}
+        return None
+
     # -- handlers ---------------------------------------------------------
 
     def _heartbeat(self, req: Request):
@@ -91,6 +113,13 @@ class MasterServer:
         topology.go:322 PickForWrite."""
         count = int(req.query.get("count", 1))
         collection = req.query.get("collection", "")
+        try:
+            # the collection names .dat/.idx files on every volume
+            # server this assign can grow onto — reject traversal at the
+            # public front door, not only at each disk
+            _check_path_fields(collection)
+        except ValueError as e:
+            return 400, {"error": str(e)}
         replication = req.query.get("replication",
                                     self.default_replication)
         ttl = req.query.get("ttl", "")
@@ -109,7 +138,7 @@ class MasterServer:
         cookie = uuid.uuid4().int & 0xFFFFFFFF
         fid = str(FileId(vid, key, cookie))
         node = nodes[0]
-        return 200, {
+        resp = {
             "fid": fid,
             "url": node.url,
             "publicUrl": node.public_url,
@@ -117,6 +146,13 @@ class MasterServer:
             "replicas": [{"url": n.url, "publicUrl": n.public_url}
                          for n in nodes[1:]],
         }
+        # per-fid write token the client presents to the volume server
+        # (master_grpc_server_assign.go: GenJwtForVolumeServer in the
+        # Assign response's auth field)
+        auth = self.security.write_jwt(fid)
+        if auth:
+            resp["auth"] = auth
+        return 200, resp
 
     def _grow_volume(self, collection: str, replication: str, ttl: str,
                      count: int = 1) -> list[int]:
@@ -162,13 +198,11 @@ class MasterServer:
                                 .from_string(replication or "000").byte(),
                                 ttl=_ttl_u32(ttl))
                     except _AllocateRefused as e:
-                        for n in done:
-                            n.volumes.pop(vid, None)
+                        self._rollback_allocations(vid, done)
                         last_err = e
                         continue
                     except OSError as e:
-                        for n in done:
-                            n.volumes.pop(vid, None)
+                        self._rollback_allocations(vid, done)
                         self.topology.mark_dead(node.url)
                         last_err = e
                         continue
@@ -177,6 +211,19 @@ class MasterServer:
                 else:
                     raise LookupError(f"volume growth failed: {last_err}")
             return grown
+
+    def _rollback_allocations(self, vid: int, done: list) -> None:
+        """Undo partial growth: the .dat/.idx already created on the
+        succeeded nodes would otherwise be re-registered by their next
+        heartbeat and leak a volume slot forever."""
+        for n in done:
+            n.volumes.pop(vid, None)
+            try:
+                http_json("POST", f"{n.url}/admin/delete_volume",
+                          {"volumeId": vid}, timeout=10)
+            except OSError:
+                pass  # node vanished mid-growth; heartbeat re-adds, and
+                # the orphan is volume.fsck territory, not a crash
 
     def _lookup(self, req: Request):
         vid_str = req.query.get("volumeId", "")

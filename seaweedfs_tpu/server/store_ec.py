@@ -45,9 +45,14 @@ class EcReader:
     """Serves needle reads over an EcVolume whose shards may live on
     other servers; owned by the volume server."""
 
-    def __init__(self, master: str, self_url: str):
+    def __init__(self, master: str, self_url: str,
+                 security_headers=None):
         self.master = master
         self.self_url = self_url
+        # callable -> admin headers for cross-server shard reads (the
+        # owning volume server's per-instance security config; the
+        # global-config auto-attach covers the default case)
+        self._security_headers = security_headers or (lambda: {})
         self._caches: dict[int, _ShardLocationCache] = {}
         self._codecs: dict[tuple[int, int], object] = {}
         self._pool = ThreadPoolExecutor(max_workers=14)
@@ -92,7 +97,8 @@ class EcReader:
             status, body, _ = http_bytes(
                 "GET",
                 f"{url}/admin/ec/shard_read?volumeId={vid}&shardId={sid}"
-                f"&offset={offset}&size={size}", timeout=10)
+                f"&offset={offset}&size={size}", timeout=10,
+                headers=self._security_headers())
         except OSError:
             return None
         return body if status == 200 and len(body) == size else None

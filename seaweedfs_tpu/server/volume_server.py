@@ -19,13 +19,15 @@ import threading
 import time
 import urllib.parse
 
+from .. import security
 from ..storage import types
 from ..storage.erasure_coding import ECContext
 from ..storage.erasure_coding import ec_decoder, ec_encoder
 from ..storage.erasure_coding.ec_context import to_ext
 from ..storage.needle import Needle
 from ..storage.store import Store
-from .httpd import HttpServer, Request, http_bytes, http_json
+from .httpd import HttpServer, Request, http_bytes, http_json, \
+    is_admin_path
 
 _SAFE_EXT = re.compile(r"^\.(dat|idx|vif|ecx|ecj|ec\d{2})$")
 _SAFE_COLLECTION = re.compile(r"^[A-Za-z0-9_.-]*$")
@@ -47,8 +49,10 @@ class VolumeServer:
                  host: str = "127.0.0.1", port: int = 0,
                  public_url: str = "", pulse_seconds: float = 1.0,
                  data_center: str = "", rack: str = "",
-                 max_volume_count: int = 8):
+                 max_volume_count: int = 8,
+                 security_config: "security.SecurityConfig | None" = None):
         self.master = master
+        self._security_override = security_config
         self.pulse_seconds = pulse_seconds
         self.data_center = data_center
         self.rack = rack
@@ -82,10 +86,13 @@ class VolumeServer:
         r("POST", "/admin/ec/scrub", self._ec_scrub)
         r("GET", "/metrics", self._metrics)
         self.http.fallback = self._data_path
+        self.http.guard = self._guard
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
         from .store_ec import EcReader
-        self.ec_reader = EcReader(master, self.http.url)
+        self.ec_reader = EcReader(
+            master, self.http.url,
+            security_headers=lambda: self.security.admin_headers())
         from ..stats import Metrics
         self.metrics = Metrics("volume_server")
 
@@ -109,6 +116,23 @@ class VolumeServer:
     def url(self) -> str:
         return self.http.url
 
+    # -- auth (security/guard.go Guard + jwt.go) --------------------------
+
+    @property
+    def security(self) -> "security.SecurityConfig":
+        # late-bound so security.configure() after construction applies
+        return self._security_override or security.current()
+
+    def _guard(self, req: Request):
+        """Admin-plane gate (guard.go WhiteList+Jwt: every admin RPC is
+        credential-gated in the reference)."""
+        if is_admin_path(req.path):
+            err = self.security.check_admin(req.query, req.headers,
+                                            req.remote_ip)
+            if err:
+                return 401, {"error": err}
+        return None
+
     # -- heartbeat (volume_grpc_client_to_master.go:51) -------------------
 
     def _heartbeat_once(self) -> None:
@@ -118,7 +142,8 @@ class VolumeServer:
         if self.rack:
             hb["rack"] = self.rack
         try:
-            http_json("POST", f"{self.master}/heartbeat", hb, timeout=5)
+            http_json("POST", f"{self.master}/heartbeat", hb, timeout=5,
+                      headers=self.security.admin_headers())
         except OSError:
             pass  # master down; retry next pulse
 
@@ -137,6 +162,15 @@ class VolumeServer:
         self.metrics.counter_add(
             "request_total", 1.0,
             help_text="data-path requests", method=req.method)
+        # per-fid JWT gate (volume_server_handlers_write.go
+        # maybeCheckJwtAuthorization): writes/deletes need a token signed
+        # with the write key, reads with the read key — when configured
+        sec = self.security
+        key = sec.volume_read_key if req.method in ("GET", "HEAD") \
+            else sec.volume_write_key
+        err = sec.check_fid_jwt(key, req.query, req.headers, str(fid))
+        if err:
+            return 401, {"error": err}
         if req.method in ("GET", "HEAD"):
             return self._get_needle(fid, req.headers.get("Range", ""))
         if req.method in ("POST", "PUT"):
@@ -267,11 +301,12 @@ class VolumeServer:
             # master doesn't know the shard set (restart, re-registration
             # in flight) — failing loudly beats a silent lost delete
             return f"ec_lookup: {r['error']}"
+        headers = self.security.write_headers(str(fid))
         for loc in {l["url"] for l in r.get("shardIdLocations", [])}:
             if loc in (self.url, self.store.public_url):
                 continue
             status, data, _ = http_bytes(
-                "DELETE", f"{loc}/{fid}?type=replicate")
+                "DELETE", f"{loc}/{fid}?type=replicate", headers=headers)
             if status >= 300 and status != 404:
                 return f"{loc} -> {status}: {data[:200]!r}"
         return None
@@ -293,9 +328,16 @@ class VolumeServer:
                 timeout=5).get("locations", [])
         except OSError as e:
             return str(e)
-        query = {k: v for k, v in req.query.items() if k != "type"}
+        query = {k: v for k, v in req.query.items()
+                 if k not in ("type", "jwt")}
         query.update(extra_query or {})
         qs = urllib.parse.urlencode(query)
+        # re-sign for the replicas: the reference forwards the request's
+        # jwt (store_replicate.go); holding the key, signing fresh avoids
+        # forwarding expired tokens on slow fan-outs
+        auth = self.security.write_headers(str(fid))
+        if auth:
+            headers = {**(headers or {}), **auth}
         for loc in locs:
             if loc["url"] in (self.url, self.store.public_url):
                 continue
@@ -319,8 +361,10 @@ class VolumeServer:
     def _allocate_volume(self, req: Request):
         """volume_server.proto AllocateVolume."""
         b = req.json()
+        collection = b.get("collection", "")
+        _check_path_fields(collection)  # lands in the .dat/.idx path
         self.store.add_volume(
-            int(b["volumeId"]), b.get("collection", ""),
+            int(b["volumeId"]), collection,
             b.get("replication", ""), b.get("ttl", ""))
         self._heartbeat_once()  # instant topology notify
         return 200, {}
@@ -332,8 +376,9 @@ class VolumeServer:
 
     def _mount_volume(self, req: Request):
         b = req.json()
-        self.store.mount_volume(int(b["volumeId"]),
-                                b.get("collection", ""))
+        collection = b.get("collection", "")
+        _check_path_fields(collection)
+        self.store.mount_volume(int(b["volumeId"]), collection)
         return 200, {}
 
     def _unmount_volume(self, req: Request):
@@ -448,8 +493,10 @@ class VolumeServer:
     def _ec_mount(self, req: Request):
         """:443 VolumeEcShardsMount."""
         b = req.json()
+        collection = b.get("collection", "")
+        _check_path_fields(collection)
         ev = self.store.mount_ec_shards(
-            int(b["volumeId"]), b.get("collection", ""),
+            int(b["volumeId"]), collection,
             [int(s) for s in b.get("shardIds", [])])
         self._heartbeat_once()
         return 200, {"shardIds": ev.shard_ids}
@@ -478,7 +525,8 @@ class VolumeServer:
             status, data, _ = http_bytes(
                 "GET",
                 f"{source}/admin/volume_file?volumeId={vid}"
-                f"&collection={collection}&ext={ext}")
+                f"&collection={collection}&ext={ext}",
+                headers=self.security.admin_headers())
             if status != 200:
                 if ext == ".ecj":  # journal may legitimately not exist
                     continue

@@ -1,0 +1,240 @@
+"""Security plane tests (weed/security/jwt.go, guard.go analog):
+JWT codec, per-fid write/read gating, admin-plane auth, whitelist,
+security.toml loading, and a fully locked-down cluster exercising the
+EC pipeline end to end."""
+
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu import operation, security
+from seaweedfs_tpu.security import (SecurityConfig, decode_jwt, gen_jwt,
+                                    JwtError)
+from seaweedfs_tpu.server.httpd import http_bytes, http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell import CommandEnv, run_command
+
+
+def raw_request(method, url, body=None, headers=None):
+    """http_bytes without the admin-jwt auto-attach — a real outsider."""
+    req = urllib.request.Request("http://" + url, data=body,
+                                 method=method, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# -- pure JWT codec -------------------------------------------------------
+
+def test_jwt_roundtrip_and_tamper():
+    tok = gen_jwt("k1", {"fid": "3,abc"}, expires_sec=60)
+    assert decode_jwt("k1", tok) == {
+        "fid": "3,abc", "exp": pytest.approx(time.time() + 60, abs=3)}
+    with pytest.raises(JwtError, match="bad signature"):
+        decode_jwt("other-key", tok)
+    head, payload, sig = tok.split(".")
+    with pytest.raises(JwtError):
+        decode_jwt("k1", f"{head}.{payload}x.{sig}")
+    assert gen_jwt("", {"fid": "x"}) == ""  # empty key -> no token
+
+
+def test_jwt_expiry():
+    tok = gen_jwt("k", {"fid": "1,0"}, expires_sec=1)
+    decode_jwt("k", tok)
+    import json as _json
+    from seaweedfs_tpu.security import _b64url, _b64url_decode, _HEADER
+    claims = _json.loads(_b64url_decode(tok.split(".")[1]))
+    claims["exp"] = int(time.time()) - 5
+    # re-signing an expired claim set with the right key still fails exp
+    expired = gen_jwt("k", {k: v for k, v in claims.items() if k != "exp"})
+    payload = _b64url(_json.dumps(
+        {**claims}, separators=(",", ":"), sort_keys=True).encode())
+    import hashlib, hmac as _hmac
+    sig = _b64url(_hmac.new(b"k", f"{_HEADER}.{payload}".encode(),
+                            hashlib.sha256).digest())
+    with pytest.raises(JwtError, match="expired"):
+        decode_jwt("k", f"{_HEADER}.{payload}.{sig}")
+    assert expired  # unexpired variant decodes fine
+    decode_jwt("k", expired)
+
+
+def test_whitelist_matching():
+    cfg = SecurityConfig(admin_key="a", white_list=["10.0.0.1",
+                                                    "192.168.0.0/16"])
+    assert cfg.ip_whitelisted("10.0.0.1")
+    assert cfg.ip_whitelisted("192.168.5.9")
+    assert not cfg.ip_whitelisted("10.0.0.2")
+    assert cfg.check_admin({}, {}, "10.0.0.1") is None
+    assert cfg.check_admin({}, {}, "1.2.3.4") == "missing admin jwt"
+
+
+def test_security_toml_load(tmp_path):
+    toml = tmp_path / "security.toml"
+    toml.write_text("""
+[jwt.signing]
+key = "wkey"
+expires_after_seconds = 11
+
+[jwt.signing.read]
+key = "rkey"
+
+[admin]
+key = "akey"
+
+[access]
+white_list = ["127.0.0.1/32"]
+""")
+    cfg = security.load_security_toml(str(toml))
+    assert cfg.volume_write_key == "wkey"
+    assert cfg.volume_write_expires_sec == 11
+    assert cfg.volume_read_key == "rkey"
+    assert cfg.admin_key == "akey"
+    assert cfg.white_list == ["127.0.0.1/32"]
+
+
+# -- locked-down cluster --------------------------------------------------
+
+SEC = SecurityConfig(volume_write_key="write-secret",
+                     volume_read_key="read-secret",
+                     admin_key="admin-secret")
+
+
+@pytest.fixture
+def secure_cluster(tmp_path):
+    security.configure(SEC)
+    master = MasterServer(volume_size_limit_mb=64).start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"vol{i}"
+        d.mkdir()
+        servers.append(VolumeServer([str(d)], master.url,
+                                    pulse_seconds=0.2).start())
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if len(http_json("GET", f"{master.url}/cluster/status")
+               ["dataNodes"]) == 3:
+            break
+        time.sleep(0.05)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+    security.configure(None)
+
+
+def test_unauthenticated_admin_rejected(secure_cluster):
+    """VERDICT item #4's done-criterion: an unauthenticated
+    delete_volume (and friends) must be rejected."""
+    master, servers = secure_cluster
+    vs = servers[0]
+    status, body = raw_request("POST", f"{vs.url}/admin/delete_volume",
+                               b'{"volumeId": 1}',
+                               {"Content-Type": "application/json"})
+    assert status == 401, (status, body)
+    status, body = raw_request(
+        "GET", f"{vs.url}/admin/volume_file?volumeId=1&ext=.dat")
+    assert status == 401
+    status, body = raw_request("POST", f"{master.url}/vol/grow",
+                               b'{}', {"Content-Type": "application/json"})
+    assert status == 401
+    # forged admin token (wrong key) also rejected
+    bad = gen_jwt("wrong-key", {"admin": True}, 60)
+    status, body = raw_request("POST", f"{vs.url}/admin/delete_volume",
+                               b'{"volumeId": 1}',
+                               {"Content-Type": "application/json",
+                                "Authorization": f"Bearer {bad}"})
+    assert status == 401
+
+
+def test_write_requires_fid_jwt(secure_cluster):
+    master, servers = secure_cluster
+    a = operation.assign(master.url)
+    assert a.auth, "master did not mint a write token"
+    # no token -> 401
+    status, body = raw_request("POST", f"{a.url}/{a.fid}", b"data")
+    assert status == 401 and b"missing jwt" in body
+    # token for a DIFFERENT fid -> 401
+    other = gen_jwt(SEC.volume_write_key, {"fid": "999,deadbeef"}, 10)
+    status, body = raw_request(
+        "POST", f"{a.url}/{a.fid}", b"data",
+        {"Authorization": f"Bearer {other}"})
+    assert status == 401
+    # the minted token -> accepted
+    status, body = raw_request(
+        "POST", f"{a.url}/{a.fid}", b"data",
+        {"Authorization": f"Bearer {a.auth}"})
+    assert status == 201, body
+
+
+def test_read_requires_read_jwt(secure_cluster):
+    master, servers = secure_cluster
+    fid = operation.submit(master.url, b"locked-read")
+    # SDK read signs with the process read key
+    assert operation.read(master.url, fid) == b"locked-read"
+    vid = int(fid.split(",")[0])
+    loc = operation.lookup(master.url, vid)[0]
+    status, body = raw_request("GET", f"{loc['url']}/{fid}")
+    assert status == 401
+    rtok = gen_jwt(SEC.volume_read_key, {"fid": fid}, 30)
+    status, body = raw_request("GET", f"{loc['url']}/{fid}",
+                               headers={"Authorization": f"Bearer {rtok}"})
+    assert status == 200 and body == b"locked-read"
+
+
+def test_secure_cluster_full_pipeline(secure_cluster):
+    """Replication, delete fan-out, and the EC shell pipeline all run
+    under full lockdown (every internal hop carries a token)."""
+    master, servers = secure_cluster
+    # replicated write + delete through the SDK
+    a = operation.assign(master.url, replication="001")
+    operation.upload(a.url, a.fid, b"sec-rep", auth=a.auth)
+    time.sleep(0.4)
+    assert operation.read(master.url, a.fid) == b"sec-rep"
+    operation.delete(master.url, a.fid)
+
+    # EC encode/read via shell (admin-locked plane)
+    fids = [operation.submit(master.url, b"ec-%03d" % i, collection="sec")
+            for i in range(8)]
+    vid = int(fids[0].split(",")[0])
+    env = CommandEnv(master.url)
+    run_command(env, "lock")
+    out = run_command(env, f"ec.encode -volumeId={vid} -collection=sec")
+    assert f"volume {vid}" in out
+    time.sleep(0.4)
+    for i, fid in enumerate(fids):
+        assert operation.read(master.url, fid) == b"ec-%03d" % i
+
+
+def test_assign_rejects_traversal_collection(secure_cluster):
+    """An anonymous assign must not smuggle a path-traversal collection
+    into volume allocation on the servers."""
+    master, servers = secure_cluster
+    status, body = raw_request(
+        "GET", f"{master.url}/dir/assign?collection=../../tmp/evil")
+    assert status == 400 and b"unacceptable" in body
+
+
+def test_whitelist_only_gates_admin(tmp_path):
+    """guard.go semantics: a whitelist with no key is a GATE — admin
+    requests from non-whitelisted IPs are rejected."""
+    cfg = SecurityConfig(white_list=["10.9.9.9"])
+    security.configure(cfg)
+    try:
+        master = MasterServer().start()
+        d = tmp_path / "v"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.url, pulse_seconds=0.2).start()
+        time.sleep(0.3)
+        # loopback is not whitelisted -> rejected even with no key
+        status, body = raw_request(
+            "POST", f"{vs.url}/admin/delete_volume", b'{"volumeId":1}',
+            {"Content-Type": "application/json"})
+        assert status == 401 and b"white list" in body
+        vs.stop()
+        master.stop()
+    finally:
+        security.configure(None)
