@@ -27,6 +27,9 @@ def main(argv: list[str] | None = None) -> int:
     m.add_argument("-port", type=int, default=9333)
     m.add_argument("-volumeSizeLimitMB", type=int, default=1024)
     m.add_argument("-defaultReplication", default="000")
+    m.add_argument("-peers", default="",
+                   help="comma-separated master peers for HA "
+                        "(raft-style leader election)")
 
     v = sub.add_parser("volume", help="start a volume server")
     v.add_argument("-ip", default="127.0.0.1")
@@ -118,7 +121,8 @@ def main(argv: list[str] | None = None) -> int:
         from .server.master_server import MasterServer
         ms = MasterServer(args.ip, args.port,
                           volume_size_limit_mb=args.volumeSizeLimitMB,
-                          default_replication=args.defaultReplication)
+                          default_replication=args.defaultReplication,
+                          peers=args.peers or None)
         ms.start()
         print(f"master listening on {ms.url}")
         _wait()

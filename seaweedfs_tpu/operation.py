@@ -43,6 +43,53 @@ class VidCache:
 _vid_cache = VidCache()
 
 
+# -- leader-following master access (wdclient/masterclient.go:471
+#    KeepConnectedToMaster + leader re-dial) ------------------------------
+
+_leader_cache: dict[str, str] = {}
+_leader_lock = threading.Lock()
+
+
+def master_json(master: str, method: str, path: str,
+                payload: dict | None = None, timeout: float = 30.0,
+                headers: dict | None = None) -> dict:
+    """Call a master endpoint against an HA seed list.
+
+    `master` may be one address or a comma-separated seed list; followers
+    answer leader-only paths with {"error": "not leader", "leader": url}
+    and this helper re-dials the hinted leader (the reference's
+    masterclient re-dial on leadership announcements).  The discovered
+    leader is cached per seed-spec for subsequent calls."""
+    seeds = [s.strip() for s in master.split(",") if s.strip()]
+    with _leader_lock:
+        cached = _leader_cache.get(master)
+    order = ([cached] if cached else []) + \
+        [s for s in seeds if s != cached]
+    last = "no masters configured"
+    tried: set[str] = set()
+    while order:
+        url = order.pop(0)
+        if url in tried:
+            continue
+        tried.add(url)
+        try:
+            r = http_json(method, f"{url}{path}", payload, timeout,
+                          headers=headers)
+        except OSError as e:
+            last = f"{url}: {e}"
+            continue
+        if r.get("error") == "not leader":
+            hint = r.get("leader", "")
+            last = f"{url}: not leader"
+            if hint and hint not in tried:
+                order.insert(0, hint)
+            continue
+        with _leader_lock:
+            _leader_cache[master] = url
+        return r
+    raise OSError(f"master_json {path}: {last}")
+
+
 @dataclass
 class Assignment:
     fid: str
@@ -62,7 +109,7 @@ def assign(master: str, count: int = 1, collection: str = "",
         qs += f"&replication={replication}"
     if ttl:
         qs += f"&ttl={ttl}"
-    r = http_json("GET", f"{master}/dir/assign?{qs}")
+    r = master_json(master, "GET", f"/dir/assign?{qs}")
     if "error" in r:
         raise RuntimeError(f"assign: {r['error']}")
     return Assignment(r["fid"], r["url"], r.get("publicUrl", r["url"]),
@@ -123,7 +170,7 @@ def lookup(master: str, vid: int, use_cache: bool = True) -> list[dict]:
         cached = _vid_cache.get(master, vid)
         if cached is not None:
             return cached
-    r = http_json("GET", f"{master}/dir/lookup?volumeId={vid}")
+    r = master_json(master, "GET", f"/dir/lookup?volumeId={vid}")
     if "error" in r:
         raise LookupError(r["error"])
     _vid_cache.put(master, vid, r["locations"])

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import os
 
+from ...operation import master_json
 from ...server.httpd import http_bytes, http_json
 from ...storage.erasure_coding import ECContext
 from ...storage.erasure_coding import ec_decoder, ec_encoder
@@ -68,7 +69,7 @@ class EcEncodeHandler(JobHandler):
     # -- Detect (:187) ------------------------------------------------
 
     def detect(self, worker) -> list[dict]:
-        vl = http_json("GET", f"{worker.master}/vol/list")
+        vl = master_json(worker.master, "GET", "/vol/list")
         size_limit = self._volume_size_limit(worker)
         proposals = []
         seen = set()
@@ -95,7 +96,7 @@ class EcEncodeHandler(JobHandler):
         return proposals
 
     def _volume_size_limit(self, worker) -> int:
-        r = http_json("GET", f"{worker.master}/cluster/status")
+        r = master_json(worker.master, "GET", "/cluster/status")
         return int(r.get("volumeSizeLimit", 1 << 30))
 
     # -- Execute (ec_task.go:59) ---------------------------------------
@@ -110,9 +111,9 @@ class EcEncodeHandler(JobHandler):
                         int(params.get("parityShards",
                                        self.parity_shards)),
                         collection, vid, **ctx_kw)
-        locations = http_json(
-            "GET", f"{worker.master}/dir/lookup?volumeId={vid}"
-        ).get("locations", [])
+        locations = master_json(worker.master, "GET",
+                               f"/dir/lookup?volumeId={vid}"
+                               ).get("locations", [])
         if not locations:
             raise RuntimeError(f"volume {vid} has no locations")
         urls = [l["url"] for l in locations]
@@ -127,9 +128,9 @@ class EcEncodeHandler(JobHandler):
             # the still-live volume, then (2) restore writability so the
             # volume is not stranded readonly by a failed job
             try:
-                targets = http_json(
-                    "GET",
-                    f"{worker.master}/cluster/status")["dataNodes"]
+                targets = master_json(
+                    worker.master, "GET",
+                    "/cluster/status")["dataNodes"]
             except (OSError, KeyError):
                 targets = []
             for target in targets:
@@ -203,8 +204,7 @@ class EcEncodeHandler(JobHandler):
             raise RuntimeError("ecx entries exceed dat size")
 
         # 4. distribute shards round-robin over alive servers (:532)
-        targets = http_json(
-            "GET", f"{worker.master}/cluster/status")["dataNodes"]
+        targets = master_json(worker.master, "GET", "/cluster/status")["dataNodes"]
         if not targets:
             raise RuntimeError("no alive volume servers")
         placement: dict[str, list[int]] = {t: [] for t in targets}

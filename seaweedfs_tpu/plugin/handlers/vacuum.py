@@ -3,6 +3,7 @@ worker/tasks/vacuum): detect garbage-heavy volumes, compact them."""
 
 from __future__ import annotations
 
+from ...operation import master_json
 from ...server.httpd import http_json
 from ..worker import JobHandler
 
@@ -26,7 +27,7 @@ class VacuumHandler(JobHandler):
 
     def detect(self, worker) -> list[dict]:
         from ...topology import iter_volume_list_volumes
-        vl = http_json("GET", f"{worker.master}/vol/list")
+        vl = master_json(worker.master, "GET", "/vol/list")
         proposals = []
         seen = set()
         for _node, v in iter_volume_list_volumes(vl):
@@ -47,9 +48,9 @@ class VacuumHandler(JobHandler):
 
     def execute(self, worker, job_id: str, params: dict) -> str:
         vid = int(params["volumeId"])
-        locs = http_json(
-            "GET", f"{worker.master}/dir/lookup?volumeId={vid}"
-        ).get("locations", [])
+        locs = master_json(worker.master, "GET",
+                               f"/dir/lookup?volumeId={vid}"
+                               ).get("locations", [])
         from ..worker import must
         for loc in locs:
             must(http_json("POST", f"{loc['url']}/admin/vacuum",

@@ -22,8 +22,24 @@ import hashlib
 import hmac
 import ipaddress
 import json
+import re
 import time
 from dataclasses import dataclass, field
+
+
+_SAFE_EXT = re.compile(r"^\.(dat|idx|vif|ecx|ecj|ec\d{2})$")
+_SAFE_COLLECTION = re.compile(r"^[A-Za-z0-9_.-]*$")
+
+
+def check_path_fields(collection: str, ext: str | None = None) -> None:
+    """Both fields land in filesystem paths on volume servers — reject
+    traversal before any path is built.  Shared by every server that
+    accepts these fields from requests (volume admin plane, master
+    assign/grow front door)."""
+    if ext is not None and not _SAFE_EXT.match(ext):
+        raise ValueError(f"unacceptable ext {ext!r}")
+    if not _SAFE_COLLECTION.match(collection):
+        raise ValueError(f"unacceptable collection {collection!r}")
 
 
 def _b64url(data: bytes) -> str:

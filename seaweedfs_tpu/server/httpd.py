@@ -155,9 +155,10 @@ def _auth_for(url: str, headers: dict | None) -> dict:
 
 def is_admin_path(path: str) -> bool:
     """The admin/maintenance plane: volume+filer /admin/*, master grow /
-    lock endpoints, and heartbeats (all gRPC-only surfaces in the
-    reference, gated there by grpc credentials)."""
-    return path.startswith("/admin/") or path in (
+    lock / raft endpoints, and heartbeats (all gRPC-only surfaces in the
+    reference, gated there by grpc credentials — an unauthenticated
+    raft RPC would let an outsider depose the leader)."""
+    return path.startswith(("/admin/", "/cluster/raft/")) or path in (
         "/vol/grow", "/cluster/lease_admin_token",
         "/cluster/release_admin_token", "/heartbeat")
 

@@ -25,8 +25,9 @@ from .. import security
 from ..sequence import MemorySequencer, SnowflakeSequencer
 from ..storage.types import FileId, format_needle_id_cookie
 from ..topology import Topology
+from ..security import check_path_fields as _check_path_fields
 from .httpd import HttpServer, Request, http_json, is_admin_path
-from .volume_server import _check_path_fields
+from .raft import RaftNode
 
 
 class _AllocateRefused(Exception):
@@ -38,7 +39,9 @@ class MasterServer:
                  volume_size_limit_mb: int = 1024,
                  default_replication: str = "000",
                  sequencer: str = "memory", pulse_seconds: float = 1.0,
-                 security_config: "security.SecurityConfig | None" = None):
+                 security_config: "security.SecurityConfig | None" = None,
+                 peers: "list[str] | str | None" = None,
+                 raft_pulse_seconds: float = 0.25):
         self._security_override = security_config
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
@@ -66,6 +69,13 @@ class MasterServer:
         r("POST", "/cluster/release_admin_token", self._release_admin)
         r("GET", "/metrics", self._metrics)
         self.http.guard = self._guard
+        if isinstance(peers, str):
+            peers = [s.strip() for s in peers.split(",") if s.strip()]
+        self.raft = RaftNode(
+            self.http, self.http.url, peers,
+            pulse_seconds=raft_pulse_seconds,
+            on_leadership=self._on_leadership,
+            auth_headers=lambda: self.security.admin_headers())
         from ..stats import Metrics
         self.metrics = Metrics("master")
 
@@ -73,10 +83,21 @@ class MasterServer:
 
     def start(self):
         self.http.start()
+        self.raft.start()
         return self
 
     def stop(self):
+        self.raft.stop()
         self.http.stop()
+
+    def _on_leadership(self, leading: bool) -> None:
+        if not leading:
+            return
+        # The reference raft-checkpoints the memory sequence; without log
+        # replication, re-seed from a time-derived floor (µs) so a new
+        # leader can never reissue a file id a previous leader handed out
+        # (needle-key collisions silently shadow existing needles).
+        self.sequencer.set_max(int(time.time() * 1e6))
 
     @property
     def url(self) -> str:
@@ -88,10 +109,24 @@ class MasterServer:
     def security(self) -> "security.SecurityConfig":
         return self._security_override or security.current()
 
+    # every master endpoint that reads or mutates topology/sequence state
+    # must run on the leader — followers hold no topology (volume servers
+    # heartbeat only the leader, as in the reference)
+    _LEADER_ONLY = frozenset((
+        "/heartbeat", "/dir/assign", "/dir/lookup", "/dir/ec_lookup",
+        "/dir/status", "/vol/list", "/vol/grow", "/cluster/status",
+        "/cluster/lease_admin_token", "/cluster/release_admin_token"))
+
     def _guard(self, req: Request):
         """Gate the grow/lock/heartbeat plane; assign and lookups stay
         public like the reference's HTTP API (writes are instead gated
-        at the volume server by the per-fid jwt from assign)."""
+        at the volume server by the per-fid jwt from assign).  Followers
+        answer leader-only paths with a re-dial hint, the HTTP analog of
+        the reference's raft leader redirect (masterclient.go re-dials on
+        the leader announced over KeepConnected)."""
+        if req.path in self._LEADER_ONLY and not self.raft.is_leader:
+            return 503, {"error": "not leader",
+                         "leader": self.raft.leader}
         if is_admin_path(req.path):
             err = self.security.check_admin(req.query, req.headers,
                                             req.remote_ip)
@@ -106,7 +141,13 @@ class MasterServer:
         self.topology.register_heartbeat(hb)
         self.metrics.counter_add("heartbeat_total",
                                  help_text="heartbeats received")
-        return 200, {"volumeSizeLimit": self.topology.volume_size_limit}
+        # leader + topology id ride the heartbeat reply so volume servers
+        # re-dial on leadership change and re-register on a new topology
+        # identity (master.proto SendHeartbeat response leader hint +
+        # master_server.go:256 topology-id fencing)
+        return 200, {"volumeSizeLimit": self.topology.volume_size_limit,
+                     "leader": self.raft.leader,
+                     "topologyId": self.raft.topology_id}
 
     def _assign(self, req: Request):
         """master_grpc_server_assign.go:49 Assign +
@@ -218,12 +259,17 @@ class MasterServer:
         heartbeat and leak a volume slot forever."""
         for n in done:
             n.volumes.pop(vid, None)
-            try:
-                http_json("POST", f"{n.url}/admin/delete_volume",
-                          {"volumeId": vid}, timeout=10)
-            except OSError:
-                pass  # node vanished mid-growth; heartbeat re-adds, and
-                # the orphan is volume.fsck territory, not a crash
+            for _attempt in range(2):
+                try:
+                    r = http_json("POST",
+                                  f"{n.url}/admin/delete_volume",
+                                  {"volumeId": vid}, timeout=10,
+                                  headers=self.security.admin_headers())
+                except OSError:
+                    break  # node vanished mid-growth; heartbeat re-adds,
+                    # and the orphan is volume.fsck territory, not a crash
+                if "error" not in r:
+                    break
 
     def _lookup(self, req: Request):
         vid_str = req.query.get("volumeId", "")
@@ -266,8 +312,11 @@ class MasterServer:
     def _cluster_status(self, req: Request):
         nodes = self.topology.alive_nodes()
         return 200, {
-            "isLeader": True,
-            "leader": self.url,
+            "isLeader": self.raft.is_leader,
+            "leader": self.raft.leader,
+            "peers": self.raft.peers,
+            "term": self.raft.term,
+            "topologyId": self.raft.topology_id,
             "dataNodes": [n.url for n in nodes],
             "volumeSizeLimit": self.topology.volume_size_limit,
         }

@@ -1,0 +1,218 @@
+"""Leader election for master HA — an election-only Raft over the
+JSON-HTTP control plane.
+
+The reference runs hashicorp/raft (weed/server/raft_hashicorp.go) to
+elect a leader among masters and replicate topology identity; volume
+servers re-dial the leader when their heartbeat stream tells them the
+leadership moved (weed/server/volume_grpc_client_to_master.go:109
+doHeartbeatWithRetry), and clients follow the leader via KeepConnected
+(weed/wdclient/masterclient.go:471 KeepConnectedToMaster).
+
+This build keeps Raft's election core — terms, votes, randomized
+timeouts, majority quorum, leader lease — but drops log replication:
+the only replicated state the reference keeps in the raft log that we
+need is *who leads* plus a cluster/topology identity for fencing
+(master_server.go:256 syncRaftForTopologyId).  Volume topology itself
+is soft state rebuilt from the next round of heartbeats, exactly as the
+reference's topology is rebuilt when a new leader takes over, and the
+file-id sequence is re-seeded monotonically on every leadership change
+instead of being checkpointed through the log.
+
+Wire protocol (JSON over the master's HTTP server):
+  POST /cluster/raft/vote   {term, candidate}        -> {granted, term}
+  POST /cluster/raft/append {term, leader, topologyId} -> {ok, term}
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+
+from .httpd import HttpServer, Request, http_json
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+class RaftNode:
+    def __init__(self, http: HttpServer, self_url: str,
+                 peers: list[str] | None = None,
+                 pulse_seconds: float = 0.25,
+                 on_leadership: "callable | None" = None,
+                 auth_headers: "callable | None" = None):
+        """`peers` includes every master in the cluster (self included,
+        in any order); empty/None means a single-master cluster, which
+        is immediately its own leader.  `auth_headers` supplies admin
+        credentials for peer RPCs (the inbound side is gated by the
+        master's admin guard)."""
+        self.self_url = self_url
+        self.peers = sorted(set(peers or []) | {self_url})
+        self.pulse = pulse_seconds
+        self.on_leadership = on_leadership
+        self._auth_headers = auth_headers or (lambda: {})
+        self.state = FOLLOWER
+        self.term = 0
+        self.voted_for: str | None = None
+        self.leader = ""
+        self.topology_id = ""
+        self._last_heard = time.time()
+        self._last_quorum = time.time()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._pool = ThreadPoolExecutor(max_workers=max(4, len(self.peers)))
+        self._thread: threading.Thread | None = None
+        http.route("POST", "/cluster/raft/vote", self._handle_vote)
+        http.route("POST", "/cluster/raft/append", self._handle_append)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "RaftNode":
+        if len(self.peers) == 1:
+            with self._lock:
+                self.state = CANDIDATE
+            self._try_become_leader(self.term)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._pool.shutdown(wait=False)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.state == LEADER
+
+    def majority(self) -> int:
+        return len(self.peers) // 2 + 1
+
+    # -- RPC handlers -----------------------------------------------------
+
+    def _handle_vote(self, req: Request):
+        b = req.json()
+        term, candidate = int(b["term"]), b["candidate"]
+        with self._lock:
+            if term > self.term:
+                self._step_down(term)
+            granted = (term == self.term and
+                       self.voted_for in (None, candidate))
+            if granted:
+                self.voted_for = candidate
+                self._last_heard = time.time()  # don't race the grantee
+            return 200, {"granted": granted, "term": self.term}
+
+    def _handle_append(self, req: Request):
+        b = req.json()
+        term = int(b["term"])
+        with self._lock:
+            if term < self.term:
+                return 200, {"ok": False, "term": self.term}
+            if term > self.term or self.state != FOLLOWER:
+                self._step_down(term)
+            self.leader = b.get("leader", "")
+            self.topology_id = b.get("topologyId", self.topology_id)
+            self._last_heard = time.time()
+            return 200, {"ok": True, "term": self.term}
+
+    # -- state machine ----------------------------------------------------
+
+    def _step_down(self, term: int) -> None:
+        """Caller holds the lock."""
+        was_leader = self.state == LEADER
+        self.term = term
+        self.state = FOLLOWER
+        self.voted_for = None
+        if was_leader and self.on_leadership:
+            self._pool.submit(self.on_leadership, False)
+
+    def _try_become_leader(self, term: int) -> bool:
+        """Promote ONLY if still the candidate of `term` — a higher-term
+        append racing the vote count must win (classic Raft TOCTOU)."""
+        with self._lock:
+            if self.state != CANDIDATE or self.term != term:
+                return False
+            self.state = LEADER
+            self.leader = self.self_url
+            # fresh topology identity per leadership change: volume
+            # servers seeing a new id re-register fully (the reference's
+            # topology-id fencing, master_server.go:256)
+            self.topology_id = f"{self.term}-{uuid.uuid4().hex[:8]}"
+            self._last_quorum = time.time()
+        if self.on_leadership:
+            self.on_leadership(True)
+        return True
+
+    def _election_timeout(self) -> float:
+        return random.uniform(4, 8) * self.pulse
+
+    def _loop(self) -> None:
+        timeout = self._election_timeout()
+        while not self._stop.wait(self.pulse):
+            if self.state == LEADER:
+                self._heartbeat_peers()
+            elif time.time() - self._last_heard > timeout:
+                timeout = self._election_timeout()
+                self._run_election()
+
+    def _run_election(self) -> None:
+        with self._lock:
+            self.state = CANDIDATE
+            self.term += 1
+            self.voted_for = self.self_url
+            term = self.term
+            # reset the backoff clock: a split vote must wait out a FRESH
+            # randomized timeout before retrying, or symmetric candidates
+            # livelock in lockstep
+            self._last_heard = time.time()
+        votes = 1
+        futs = [self._pool.submit(
+            http_json, "POST", f"{p}/cluster/raft/vote",
+            {"term": term, "candidate": self.self_url}, 2.0,
+            self._auth_headers())
+            for p in self.peers if p != self.self_url]
+        for f in futs:
+            try:
+                r = f.result(timeout=3)
+            except Exception:
+                continue
+            if int(r.get("term", 0)) > term:
+                with self._lock:
+                    self._step_down(int(r["term"]))
+                return
+            if r.get("granted"):
+                votes += 1
+        if votes >= self.majority() and self._try_become_leader(term):
+            self._heartbeat_peers()
+
+    def _heartbeat_peers(self) -> None:
+        term = self.term
+        acks = 1
+        futs = [self._pool.submit(
+            http_json, "POST", f"{p}/cluster/raft/append",
+            {"term": term, "leader": self.self_url,
+             "topologyId": self.topology_id}, 2.0,
+            self._auth_headers())
+            for p in self.peers if p != self.self_url]
+        for f in futs:
+            try:
+                r = f.result(timeout=3)
+            except Exception:
+                continue
+            if int(r.get("term", 0)) > term:
+                with self._lock:
+                    self._step_down(int(r["term"]))
+                return
+            if r.get("ok"):
+                acks += 1
+        now = time.time()
+        if acks >= self.majority():
+            self._last_quorum = now
+        elif now - self._last_quorum > 10 * self.pulse:
+            # leader lease expired: partitioned from the quorum — stop
+            # acting as leader so a split brain can't serve assigns
+            with self._lock:
+                self._step_down(self.term)

@@ -158,11 +158,11 @@ class EcReader:
                     force_if_missing not in cache.locations:
                 fresh = fresh and age < _TTL_INCOMPLETE
             if not fresh:
+                from ..operation import master_json
                 try:
-                    r = http_json(
-                        "GET",
-                        f"{self.master}/dir/ec_lookup?volumeId={ev.id}",
-                        timeout=5)
+                    r = master_json(
+                        self.master, "GET",
+                        f"/dir/ec_lookup?volumeId={ev.id}", timeout=5)
                 except OSError:
                     r = {}
                 locs: dict[int, list[str]] = {}

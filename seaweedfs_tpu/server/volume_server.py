@@ -29,19 +29,9 @@ from ..storage.store import Store
 from .httpd import HttpServer, Request, http_bytes, http_json, \
     is_admin_path
 
-_SAFE_EXT = re.compile(r"^\.(dat|idx|vif|ecx|ecj|ec\d{2})$")
-_SAFE_COLLECTION = re.compile(r"^[A-Za-z0-9_.-]*$")
-
-
-def _check_path_fields(collection: str, ext: str | None = None) -> None:
-    """Both fields land in filesystem paths — reject traversal before any
-    path is built.  Centralized here so every handler that touches the
-    disk from request fields (volume_file, receive_file, ec/*) shares the
-    same invariant."""
-    if ext is not None and not _SAFE_EXT.match(ext):
-        raise ValueError(f"unacceptable ext {ext!r}")
-    if not _SAFE_COLLECTION.match(collection):
-        raise ValueError(f"unacceptable collection {collection!r}")
+# shared request-field validator (also used by the master's assign
+# front door) lives in security.py
+_check_path_fields = security.check_path_fields
 
 
 class VolumeServer:
@@ -89,6 +79,8 @@ class VolumeServer:
         self.http.guard = self._guard
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
+        self._topology_id = ""
+        self._last_hb_error: str | None = None
         from .store_ec import EcReader
         self.ec_reader = EcReader(
             master, self.http.url,
@@ -142,10 +134,33 @@ class VolumeServer:
         if self.rack:
             hb["rack"] = self.rack
         try:
-            http_json("POST", f"{self.master}/heartbeat", hb, timeout=5,
-                      headers=self.security.admin_headers())
+            from ..operation import master_json
+            # master_json re-dials the raft leader on "not leader"
+            # replies (volume_grpc_client_to_master.go:109
+            # doHeartbeatWithRetry re-dials on leader change)
+            r = master_json(self.master, "POST", "/heartbeat", hb,
+                            timeout=5,
+                            headers=self.security.admin_headers())
         except OSError:
-            pass  # master down; retry next pulse
+            return  # no leader reachable; retry next pulse
+        err = r.get("error")
+        if err:
+            # a rejected heartbeat (bad admin key, whitelist miss) means
+            # this server is invisible to the master — say so, once per
+            # distinct error, instead of looping silently unregistered
+            if err != self._last_hb_error:
+                self._last_hb_error = err
+                import sys
+                print(f"volume server {self.url}: heartbeat rejected "
+                      f"by master: {err}", file=sys.stderr)
+            return
+        self._last_hb_error = None
+        tid = r.get("topologyId", "")
+        if tid and tid != self._topology_id:
+            # new leadership epoch: this heartbeat already re-registered
+            # the full volume/shard state (heartbeats are always full);
+            # remember the id so a changed epoch is observable
+            self._topology_id = tid
 
     def _heartbeat_loop(self) -> None:
         while not self._hb_stop.wait(self.pulse_seconds):
@@ -290,10 +305,11 @@ class VolumeServer:
         """Tombstone the needle in every other shard holder's .ecx/.ecj
         (store_ec_delete.go:38 doDeleteNeedleFromAtLeastOneRemoteEcShards;
         each holder keeps a full index copy)."""
+        from ..operation import master_json
         try:
-            r = http_json(
-                "GET",
-                f"{self.master}/dir/ec_lookup?volumeId={fid.volume_id}",
+            r = master_json(
+                self.master, "GET",
+                f"/dir/ec_lookup?volumeId={fid.volume_id}",
                 timeout=5)
         except OSError as e:
             return str(e)
@@ -321,10 +337,11 @@ class VolumeServer:
         v = self.store.find_volume(fid.volume_id)
         if v is None or not v.super_block.replica_placement.byte():
             return None
+        from ..operation import master_json
         try:
-            locs = http_json(
-                "GET",
-                f"{self.master}/dir/lookup?volumeId={fid.volume_id}",
+            locs = master_json(
+                self.master, "GET",
+                f"/dir/lookup?volumeId={fid.volume_id}",
                 timeout=5).get("locations", [])
         except OSError as e:
             return str(e)

@@ -15,6 +15,7 @@ from __future__ import annotations
 import json
 from concurrent.futures import ThreadPoolExecutor
 
+from ..operation import master_json
 from ..server.httpd import http_bytes, http_json
 from ..storage.erasure_coding.ec_context import to_ext
 
@@ -37,7 +38,7 @@ class CommandEnv:
     # -- admin lock (command_lock_unlock.go) ------------------------------
 
     def lock(self) -> None:
-        r = http_json("POST", f"{self.master}/cluster/lease_admin_token",
+        r = master_json(self.master, "POST", "/cluster/lease_admin_token",
                       {"previousToken": self.admin_token or 0,
                        "lockName": "admin"})
         if "token" not in r:
@@ -45,7 +46,7 @@ class CommandEnv:
         self.admin_token = r["token"]
 
     def unlock(self) -> None:
-        http_json("POST", f"{self.master}/cluster/release_admin_token",
+        master_json(self.master, "POST", "/cluster/release_admin_token",
                   {"previousToken": self.admin_token or 0})
         self.admin_token = None
 
@@ -56,10 +57,10 @@ class CommandEnv:
                 "lock is lost, or it is not locked; run `lock` first")
 
     def volume_list(self) -> dict:
-        return http_json("GET", f"{self.master}/vol/list")
+        return master_json(self.master, "GET", "/vol/list")
 
     def volume_locations(self, vid: int) -> list[dict]:
-        r = http_json("GET", f"{self.master}/dir/lookup?volumeId={vid}")
+        r = master_json(self.master, "GET", f"/dir/lookup?volumeId={vid}")
         return r.get("locations", [])
 
 
@@ -85,7 +86,7 @@ def cmd_volume_list(env: CommandEnv, args: list[str]) -> str:
 
 @command("cluster.check")
 def cmd_cluster_check(env: CommandEnv, args: list[str]) -> str:
-    r = http_json("GET", f"{env.master}/cluster/status")
+    r = master_json(env.master, "GET", "/cluster/status")
     return json.dumps(r, indent=2)
 
 
@@ -581,7 +582,7 @@ def _ec_volumes(env: CommandEnv) -> dict[int, None]:
 
 
 def _ec_shard_locations(env: CommandEnv, vid: int) -> dict[str, list[int]]:
-    r = http_json("GET", f"{env.master}/dir/ec_lookup?volumeId={vid}")
+    r = master_json(env.master, "GET", f"/dir/ec_lookup?volumeId={vid}")
     if "error" in r:
         return {}
     return {loc["url"]: loc["shardIds"]
@@ -589,7 +590,7 @@ def _ec_shard_locations(env: CommandEnv, vid: int) -> dict[str, list[int]]:
 
 
 def _all_node_urls(env: CommandEnv) -> list[str]:
-    r = http_json("GET", f"{env.master}/cluster/status")
+    r = master_json(env.master, "GET", "/cluster/status")
     return r.get("dataNodes", [])
 
 

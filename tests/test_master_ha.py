@@ -1,0 +1,179 @@
+"""Master HA tests: leader election, follower redirect, client re-dial,
+leader kill + failover, topology-id fencing (the analog of
+weed/server/raft_hashicorp.go + test/multi_master/)."""
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.server.httpd import http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+def _free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _wait_leader(masters, timeout=8.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        leaders = [m for m in masters if m.raft.is_leader]
+        if len(leaders) == 1:
+            # every live master agrees on who leads
+            agreed = all(m.raft.leader == leaders[0].url for m in masters)
+            if agreed:
+                return leaders[0]
+        time.sleep(0.05)
+    raise AssertionError(
+        f"no stable leader: {[(m.url, m.raft.state) for m in masters]}")
+
+
+@pytest.fixture
+def ha_cluster(tmp_path):
+    ports = _free_ports(3)
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    masters = [MasterServer(port=p, peers=peers,
+                            volume_size_limit_mb=64).start()
+               for p in ports]
+    leader = _wait_leader(masters)
+    seeds = ",".join(peers)
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"vol{i}"
+        d.mkdir()
+        servers.append(VolumeServer([str(d)], seeds,
+                                    pulse_seconds=0.2).start())
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if len(http_json("GET", f"{leader.url}/cluster/status")
+               ["dataNodes"]) == 3:
+            break
+        time.sleep(0.05)
+    yield masters, servers, seeds
+    for vs in servers:
+        vs.stop()
+    for m in masters:
+        try:
+            m.stop()
+        except Exception:
+            pass
+
+
+def test_single_leader_elected(ha_cluster):
+    masters, servers, seeds = ha_cluster
+    leaders = [m for m in masters if m.raft.is_leader]
+    assert len(leaders) == 1
+    st = http_json("GET", f"{leaders[0].url}/cluster/status")
+    assert st["isLeader"] and st["leader"] == leaders[0].url
+    assert st["term"] >= 1 and st["topologyId"]
+
+
+def test_follower_redirects_assign(ha_cluster):
+    masters, servers, seeds = ha_cluster
+    leader = next(m for m in masters if m.raft.is_leader)
+    follower = next(m for m in masters if not m.raft.is_leader)
+    r = http_json("GET", f"{follower.url}/dir/assign")
+    assert r.get("error") == "not leader" and r["leader"] == leader.url
+    # the SDK follows the hint transparently, even when pointed ONLY at
+    # the follower
+    a = operation.assign(follower.url)
+    assert a.fid and a.url
+
+
+def test_leader_kill_failover(ha_cluster):
+    """VERDICT #3 done-criterion: multi-master integration test with
+    leader kill — writes and reads keep working after failover, and
+    pre-failover data stays readable."""
+    masters, servers, seeds = ha_cluster
+    fid_before = operation.submit(seeds, b"before-failover")
+    assert operation.read(seeds, fid_before) == b"before-failover"
+    key_before = int(fid_before.split(",")[1][:-8], 16)
+
+    old_leader = next(m for m in masters if m.raft.is_leader)
+    old_tid = old_leader.raft.topology_id
+    old_leader.stop()
+    survivors = [m for m in masters if m is not old_leader]
+
+    new_leader = _wait_leader(survivors, timeout=10)
+    assert new_leader is not old_leader
+    # fencing: a fresh leadership epoch has a fresh topology identity
+    assert new_leader.raft.topology_id != old_tid
+
+    # volume servers re-dial + re-register; writes work again once the
+    # new leader hears heartbeats
+    deadline = time.time() + 5
+    fid_after = None
+    while time.time() < deadline:
+        try:
+            fid_after = operation.submit(seeds, b"after-failover")
+            break
+        except RuntimeError:
+            time.sleep(0.2)
+    assert fid_after, "no successful write after failover"
+    # sequence fencing: the new leader must not reissue old needle keys
+    key_after = int(fid_after.split(",")[1][:-8], 16)
+    assert key_after > key_before
+    assert operation.read(seeds, fid_after) == b"after-failover"
+    # pre-failover data still readable through the new topology
+    assert operation.read(seeds, fid_before) == b"before-failover"
+
+
+def test_stepped_down_leader_rejoins_as_follower(ha_cluster):
+    masters, servers, seeds = ha_cluster
+    leader = next(m for m in masters if m.raft.is_leader)
+    # force a higher term onto the leader: it must step down
+    http_json("POST", f"{leader.url}/cluster/raft/append",
+              {"term": leader.raft.term + 10,
+               "leader": "127.0.0.1:1",
+               "topologyId": "fake"})
+    assert not leader.raft.is_leader
+    # the cluster then re-elects (possibly the same node, higher term)
+    new_leader = _wait_leader(masters, timeout=10)
+    assert new_leader.raft.term > 0
+
+
+def test_single_master_still_immediate_leader(tmp_path):
+    m = MasterServer().start()
+    try:
+        assert m.raft.is_leader
+        st = http_json("GET", f"{m.url}/cluster/status")
+        assert st["isLeader"] and st["peers"] == [m.url]
+    finally:
+        m.stop()
+
+
+def test_raft_rpcs_rejected_without_admin_jwt(tmp_path):
+    """An outsider must not be able to depose the leader of a secured
+    cluster via unauthenticated raft RPCs."""
+    from seaweedfs_tpu import security as sec_mod
+    from seaweedfs_tpu.security import SecurityConfig
+    import urllib.request, urllib.error, json as _json
+    sec_mod.configure(SecurityConfig(admin_key="raft-admin"))
+    try:
+        m = MasterServer().start()
+        body = _json.dumps({"term": 10**9, "leader": "evil:80",
+                            "topologyId": "x"}).encode()
+        req = urllib.request.Request(
+            f"http://{m.url}/cluster/raft/append", data=body,
+            method="POST", headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 401
+        assert m.raft.is_leader and m.raft.leader == m.url
+        m.stop()
+    finally:
+        sec_mod.configure(None)
