@@ -61,56 +61,144 @@ def write_ec_files(base_file_name: str, ctx: ECContext | None = None
     _generate_ec_files(base_file_name, ctx)
 
 
-def _generate_ec_files(base_file_name: str, ctx: ECContext) -> None:
-    dat_path = base_file_name + ".dat"
-    dat_size = os.path.getsize(dat_path)
-    codec = ctx.create_codec()
-    outputs = [open(base_file_name + ctx.to_ext(i), "wb")
-               for i in range(ctx.total)]
-    try:
-        with open(dat_path, "rb") as dat:
-            _encode_dat_file(dat, dat_size, codec, outputs, ctx)
-    finally:
-        for f in outputs:
-            f.close()
-
-
-def _encode_dat_file(dat, dat_size: int, codec, outputs, ctx: ECContext
-                     ) -> None:
-    """ec_encoder.go:280 encodeDatFile: large rows then small rows."""
+def _encode_work_items(dat_size: int, ctx: ECContext
+                       ) -> "list[tuple[int, int, int, int]]":
+    """The exact batch schedule of ec_encoder.go:280 encodeDatFile
+    (1GB rows, then 1MB rows for the tail) as a flat work list of
+    (row_start, block_size, batch_offset, batch_bytes) — geometry is
+    byte-identical to the reference for any batch that divides the
+    block size."""
+    work = []
     large_row = LARGE_BLOCK_SIZE * ctx.data_shards
     small_row = SMALL_BLOCK_SIZE * ctx.data_shards
     remaining = dat_size
     processed = 0
     while remaining >= large_row:
-        _encode_rows(dat, processed, LARGE_BLOCK_SIZE, codec, outputs, ctx)
+        batch = ctx.batch_size(LARGE_BLOCK_SIZE)
+        for b0 in range(0, LARGE_BLOCK_SIZE, batch):
+            work.append((processed, LARGE_BLOCK_SIZE, b0, batch))
         remaining -= large_row
         processed += large_row
     while remaining > 0:
-        _encode_rows(dat, processed, SMALL_BLOCK_SIZE, codec, outputs, ctx)
+        batch = ctx.batch_size(SMALL_BLOCK_SIZE)
+        for b0 in range(0, SMALL_BLOCK_SIZE, batch):
+            work.append((processed, SMALL_BLOCK_SIZE, b0, batch))
         remaining -= small_row
         processed += small_row
+    return work
 
 
-def _encode_rows(dat, row_start: int, block_size: int, codec, outputs,
-                 ctx: ECContext) -> None:
-    """Encode one row (data_shards x block_size) in batches
-    (ec_encoder.go:202 encodeData / :248 encodeDataOneBatch).  Reads past
-    EOF zero-pad (ec_encoder.go:258-262)."""
-    batch = ctx.batch_size(block_size)
+def _generate_ec_files(base_file_name: str, ctx: ECContext) -> None:
+    """Triple-buffered staging pipeline (SURVEY §7 "hard parts" #2):
+    a reader thread stages disk batches into host buffers, the compute
+    thread runs the GF kernel (device round-trip on the TPU backend),
+    and a writer thread appends to the 14 shard files — so disk reads,
+    the accelerator, and disk writes overlap instead of serializing.
+
+    Host memory is bounded by a pool of 3 recycled data buffers (one per
+    stage — read/compute/write), so peak RSS stays ~3x one batch instead
+    of growing with queue depth.  A shared stop event unblocks every
+    stage on any error or interrupt: a parked producer can never
+    deadlock the join, and a writer failure (ENOSPC) aborts the read +
+    compute stages promptly rather than after the whole volume.
+    Shard-file append order is preserved because every stage is FIFO."""
+    import queue
+    import threading
+
+    dat_path = base_file_name + ".dat"
+    dat_size = os.path.getsize(dat_path)
+    codec = ctx.create_codec()
     d = ctx.data_shards
-    buf = np.zeros((ctx.total, batch), dtype=np.uint8)
-    for b0 in range(0, block_size, batch):
-        buf[:] = 0
-        for i in range(d):
-            dat.seek(row_start + i * block_size + b0)
-            chunk = dat.read(batch)
-            if chunk:
-                buf[i, :len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
-        parity = codec.parity(buf[:d])
-        buf[d:] = np.asarray(parity)
-        for i in range(ctx.total):
-            outputs[i].write(buf[i].tobytes())
+    work = _encode_work_items(dat_size, ctx)
+    outputs = [open(base_file_name + ctx.to_ext(i), "wb")
+               for i in range(ctx.total)]
+    q_read: "queue.Queue" = queue.Queue()
+    q_write: "queue.Queue" = queue.Queue()
+    pool: "queue.Queue" = queue.Queue()
+    for _ in range(3):
+        pool.put(None)  # lazy-allocated buffer slots
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def _blocking(q_op, *args):
+        """put/get that stays interruptible by the stop event; returns
+        the result or raises _Stopped."""
+        while True:
+            try:
+                return q_op(*args, timeout=0.2)
+            except (queue.Full, queue.Empty):
+                if stop.is_set():
+                    raise _Stopped() from None
+
+    def reader():
+        try:
+            with open(dat_path, "rb") as dat:
+                for row_start, block_size, b0, batch in work:
+                    buf = _blocking(pool.get)
+                    if buf is None or buf.shape != (d, batch):
+                        buf = np.empty((d, batch), dtype=np.uint8)
+                    buf.fill(0)
+                    for i in range(d):
+                        # reads past EOF zero-pad (ec_encoder.go:258-262)
+                        dat.seek(row_start + i * block_size + b0)
+                        chunk = dat.read(batch)
+                        if chunk:
+                            buf[i, :len(chunk)] = np.frombuffer(
+                                chunk, dtype=np.uint8)
+                    _blocking(q_read.put, buf)
+        except _Stopped:
+            pass
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+            stop.set()
+        finally:
+            q_read.put(None)
+
+    def writer():
+        try:
+            while True:
+                item = _blocking(q_write.get)
+                if item is None:
+                    return
+                data, parity = item
+                for i in range(d):
+                    outputs[i].write(data[i].data)
+                for j in range(ctx.total - d):
+                    outputs[d + j].write(parity[j].data)
+                pool.put(data)  # recycle the slot for the reader
+        except _Stopped:
+            pass
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+            stop.set()  # abort reader+compute promptly (don't encode
+            # the rest of a 30GB volume just to report ENOSPC)
+
+    rt = threading.Thread(target=reader, daemon=True)
+    wt = threading.Thread(target=writer, daemon=True)
+    rt.start()
+    wt.start()
+    try:
+        while not stop.is_set():
+            buf = q_read.get()
+            if buf is None:
+                break
+            parity = np.ascontiguousarray(np.asarray(codec.parity(buf)))
+            q_write.put((buf, parity))
+    except BaseException as e:  # noqa: BLE001 — incl. KeyboardInterrupt
+        errors.insert(0, e)
+    finally:
+        stop.set()  # unblocks any parked stage (timeouted puts/gets)
+        q_write.put(None)
+        rt.join()
+        wt.join()
+        for f in outputs:
+            f.close()
+    if errors:
+        raise errors[0]
+
+
+class _Stopped(Exception):
+    """Internal: a pipeline stage was asked to abort."""
 
 
 # --- rebuild ------------------------------------------------------------

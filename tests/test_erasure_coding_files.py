@@ -221,3 +221,49 @@ def test_golden_jax_backend_matches_cpu(tmp_path):
         a = open(tmp_path / "cpu" / f"1.ec{i:02d}", "rb").read()
         b = open(tmp_path / "jax" / f"1.ec{i:02d}", "rb").read()
         assert a == b, f"shard {i} differs between cpu and jax backends"
+
+
+def test_encode_pipeline_compute_error_no_deadlock(tmp_path, monkeypatch):
+    """A compute-stage failure must propagate promptly — not deadlock
+    the reader parked on a full staging queue (review regression)."""
+    import threading
+
+    import numpy as np
+
+    from seaweedfs_tpu.storage.erasure_coding import ec_encoder
+    from seaweedfs_tpu.storage.erasure_coding.ec_context import ECContext
+
+    base = str(tmp_path / "boom")
+    # 4 small rows -> 4 work items, so the 2nd parity call exists
+    data = np.random.default_rng(3).integers(
+        0, 256, 32 * 1024 * 1024, dtype=np.uint8)
+    with open(base + ".dat", "wb") as f:
+        f.write(data.tobytes())
+
+    class BoomCodec:
+        calls = 0
+
+        def parity(self, buf):
+            BoomCodec.calls += 1
+            if BoomCodec.calls >= 2:
+                raise RuntimeError("device exploded")
+            return np.zeros((4, buf.shape[1]), dtype=np.uint8)
+
+    ctx = ECContext(backend="cpu")
+    monkeypatch.setattr(ECContext, "create_codec",
+                        lambda self: BoomCodec())
+
+    result: list = []
+
+    def run():
+        try:
+            ec_encoder.write_ec_files(base, ctx)
+            result.append(None)
+        except RuntimeError as e:
+            result.append(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=20)
+    assert not t.is_alive(), "encode pipeline deadlocked on compute error"
+    assert result and isinstance(result[0], RuntimeError)
