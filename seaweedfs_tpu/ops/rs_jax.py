@@ -137,6 +137,18 @@ def gf_apply_matrix(mat, data) -> jax.Array:
     return out.reshape((mat.shape[0],) + batch_shape)
 
 
+class _PendingParity:
+    """An in-flight device parity launch (see ReedSolomonJax.parity_lazy)."""
+
+    def __init__(self, out32: jax.Array, nbytes: int):
+        self._out32 = out32
+        self._nbytes = nbytes
+
+    def materialize(self) -> np.ndarray:
+        """Block until the launch completes; returns uint8 [R, B]."""
+        return unpack_words(np.asarray(self._out32), self._nbytes)
+
+
 class ReedSolomonJax:
     """TPU encoder/decoder for RS(data, parity), API-compatible with the
     CPU twin (`rs_cpu.ReedSolomonCPU`)."""
@@ -167,6 +179,27 @@ class ReedSolomonJax:
         """data: [data_shards, B] uint8 -> parity [parity_shards, B]."""
         data = self._check(data, self.data_shards)
         return gf_apply_matrix(self._parity_rows, data)
+
+    def parity_lazy(self, data) -> "_PendingParity":
+        """Dispatch the parity launch WITHOUT waiting for the result.
+
+        Returns a handle whose .materialize() blocks on the device and
+        yields the [parity_shards, B] uint8 numpy array.  This lets a
+        pipeline overlap the D2H fetch of launch k with the H2D+kernel
+        of launch k+1 (the encode staging pipeline materializes in its
+        writer thread while the compute thread dispatches ahead).
+
+        Aliasing contract: `data` may be a recycled buffer, but only
+        AFTER materialize() returns — on backends where jnp.asarray
+        aliases host memory (CPU), the kernel has consumed the input by
+        the time the output is fetchable.
+        """
+        data = self._check(data, self.data_shards)
+        b = data.shape[1]
+        flat = pack_words(np.ascontiguousarray(data))
+        out32 = gf_apply_matrix_words(self._parity_rows,
+                                      jnp.asarray(flat))
+        return _PendingParity(out32, b)
 
     def encode(self, shards) -> jax.Array:
         """shards: [total, B] with data rows filled; returns full array with

@@ -61,13 +61,30 @@ def write_ec_files(base_file_name: str, ctx: ECContext | None = None
     _generate_ec_files(base_file_name, ctx)
 
 
+def _next_pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length()
+
+
 def _encode_work_items(dat_size: int, ctx: ECContext
-                       ) -> "list[tuple[int, int, int, int]]":
+                       ) -> "list[tuple[int, int, int, int, int]]":
     """The exact batch schedule of ec_encoder.go:280 encodeDatFile
     (1GB rows, then 1MB rows for the tail) as a flat work list of
-    (row_start, block_size, batch_offset, batch_bytes) — geometry is
-    byte-identical to the reference for any batch that divides the
-    block size."""
+    (row_start, block_size, batch_offset, batch_bytes, real_rows):
+
+    - large rows (1GB blocks) are chunked WITHIN a block: one item per
+      (row, batch-offset), real_rows == 1, and the reader gathers the
+      d strided block slices at batch_offset;
+    - small rows (1MB blocks) are AGGREGATED: one item covers
+      real_rows consecutive rows read contiguously and stacked on the
+      batch axis (batch_bytes = padded_rows * block_size per shard).
+      batch_bytes is padded up to a power-of-two row count so the
+      whole volume compiles to a handful of device kernel shapes; the
+      writer emits only real_rows * block_size bytes per shard.
+
+    Either way the shard files are byte-identical to the reference:
+    shard i's file is the in-order concatenation of row blocks i, and
+    both chunking-within-a-block and stacking-whole-rows preserve that
+    order."""
     work = []
     large_row = LARGE_BLOCK_SIZE * ctx.data_shards
     small_row = SMALL_BLOCK_SIZE * ctx.data_shards
@@ -76,15 +93,18 @@ def _encode_work_items(dat_size: int, ctx: ECContext
     while remaining >= large_row:
         batch = ctx.batch_size(LARGE_BLOCK_SIZE)
         for b0 in range(0, LARGE_BLOCK_SIZE, batch):
-            work.append((processed, LARGE_BLOCK_SIZE, b0, batch))
+            work.append((processed, LARGE_BLOCK_SIZE, b0, batch, 1))
         remaining -= large_row
         processed += large_row
-    while remaining > 0:
-        batch = ctx.batch_size(SMALL_BLOCK_SIZE)
-        for b0 in range(0, SMALL_BLOCK_SIZE, batch):
-            work.append((processed, SMALL_BLOCK_SIZE, b0, batch))
-        remaining -= small_row
-        processed += small_row
+    rows_left = (remaining + small_row - 1) // small_row
+    r_full = ctx.rows_per_launch(SMALL_BLOCK_SIZE)
+    while rows_left > 0:
+        g = min(r_full, rows_left)
+        padded = min(r_full, _next_pow2(g))
+        work.append((processed, SMALL_BLOCK_SIZE, 0,
+                     padded * SMALL_BLOCK_SIZE, g))
+        rows_left -= g
+        processed += g * small_row
     return work
 
 
@@ -133,19 +153,38 @@ def _generate_ec_files(base_file_name: str, ctx: ECContext) -> None:
     def reader():
         try:
             with open(dat_path, "rb") as dat:
-                for row_start, block_size, b0, batch in work:
+                for row_start, block_size, b0, batch, real_rows in work:
                     buf = _blocking(pool.get)
                     if buf is None or buf.shape != (d, batch):
                         buf = np.empty((d, batch), dtype=np.uint8)
                     buf.fill(0)
-                    for i in range(d):
-                        # reads past EOF zero-pad (ec_encoder.go:258-262)
-                        dat.seek(row_start + i * block_size + b0)
-                        chunk = dat.read(batch)
-                        if chunk:
-                            buf[i, :len(chunk)] = np.frombuffer(
-                                chunk, dtype=np.uint8)
-                    _blocking(q_read.put, buf)
+                    if batch <= block_size:
+                        # chunk WITHIN one (large) row: gather the d
+                        # strided block slices at batch offset b0
+                        for i in range(d):
+                            # short/EOF reads zero-pad
+                            # (ec_encoder.go:258-262)
+                            dat.seek(row_start + i * block_size + b0)
+                            chunk = dat.read(batch)
+                            if chunk:
+                                buf[i, :len(chunk)] = np.frombuffer(
+                                    chunk, dtype=np.uint8)
+                    else:
+                        # real_rows stacked small rows: one strictly
+                        # sequential pass over the contiguous region;
+                        # rows padded past real_rows stay zero and are
+                        # dropped by the writer
+                        dat.seek(row_start)
+                        for r in range(real_rows):
+                            base = r * block_size
+                            for i in range(d):
+                                chunk = dat.read(block_size)
+                                if chunk:
+                                    buf[i, base:base + len(chunk)] = \
+                                        np.frombuffer(chunk,
+                                                      dtype=np.uint8)
+                    real = min(batch, real_rows * block_size)
+                    _blocking(q_read.put, (buf, real))
         except _Stopped:
             pass
         except BaseException as e:  # noqa: BLE001 — surfaced below
@@ -160,11 +199,20 @@ def _generate_ec_files(base_file_name: str, ctx: ECContext) -> None:
                 item = _blocking(q_write.get)
                 if item is None:
                     return
-                data, parity = item
+                data, parity, real = item
+                if hasattr(parity, "materialize"):
+                    # block on the in-flight device launch HERE, so the
+                    # compute thread is already dispatching the next
+                    # batch (D2H of launch k overlaps H2D+kernel of
+                    # k+1).  Materializing before the pool.put below is
+                    # also the aliasing contract of parity_lazy: the
+                    # kernel has consumed `data` once its output is
+                    # fetchable, so only then may the buffer be reused.
+                    parity = parity.materialize()
                 for i in range(d):
-                    outputs[i].write(data[i].data)
+                    outputs[i].write(data[i, :real].data)
                 for j in range(ctx.total - d):
-                    outputs[d + j].write(parity[j].data)
+                    outputs[d + j].write(parity[j, :real].data)
                 pool.put(data)  # recycle the slot for the reader
         except _Stopped:
             pass
@@ -178,12 +226,18 @@ def _generate_ec_files(base_file_name: str, ctx: ECContext) -> None:
     rt.start()
     wt.start()
     try:
+        lazy = getattr(codec, "parity_lazy", None)
         while not stop.is_set():
-            buf = q_read.get()
-            if buf is None:
+            item = q_read.get()
+            if item is None:
                 break
-            parity = np.ascontiguousarray(np.asarray(codec.parity(buf)))
-            q_write.put((buf, parity))
+            buf, real = item
+            if lazy is not None:
+                parity = lazy(buf)  # async dispatch; writer materializes
+            else:
+                parity = np.ascontiguousarray(
+                    np.asarray(codec.parity(buf)))
+            q_write.put((buf, parity, real))
     except BaseException as e:  # noqa: BLE001 — incl. KeyboardInterrupt
         errors.insert(0, e)
     finally:

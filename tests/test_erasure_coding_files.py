@@ -267,3 +267,34 @@ def test_encode_pipeline_compute_error_no_deadlock(tmp_path, monkeypatch):
     t.join(timeout=20)
     assert not t.is_alive(), "encode pipeline deadlocked on compute error"
     assert result and isinstance(result[0], RuntimeError)
+
+
+def test_row_aggregated_encode_byte_identical(tmp_path, patched_blocks,
+                                              monkeypatch):
+    """Stacking many small-block rows into one codec launch
+    (ECContext.rows_per_launch > 1, the round-3 dispatch-amortization
+    fix) must produce byte-identical shard files to encoding one row
+    per launch — the shard-file layout is the in-order concatenation of
+    row blocks either way.  Covers: a large row, a run of aggregated
+    small rows, a non-power-of-two tail group, and zero-padding past
+    EOF inside the final row."""
+    d_agg = tmp_path / "agg"
+    d_one = tmp_path / "one"
+    d_agg.mkdir()
+    d_one.mkdir()
+    base_agg = _make_volume(d_agg, n_files=60, seed=9)
+    base_one = str(d_one / "5")
+    shutil.copy(base_agg + ".dat", base_one + ".dat")
+
+    ctx = ECContext(backend="cpu")
+    assert ctx.rows_per_launch(1024) > 1  # aggregation engages
+    write_ec_files(base_agg, ctx)
+
+    monkeypatch.setattr(ECContext, "rows_per_launch",
+                        lambda self, block_size: 1)
+    write_ec_files(base_one, ECContext(backend="cpu"))
+
+    for i in range(14):
+        a = open(base_agg + f".ec{i:02d}", "rb").read()
+        b = open(base_one + f".ec{i:02d}", "rb").read()
+        assert a == b, f"shard {i} differs: aggregated vs one-row"
