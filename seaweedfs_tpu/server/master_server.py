@@ -124,7 +124,12 @@ class MasterServer:
         answer leader-only paths with a re-dial hint, the HTTP analog of
         the reference's raft leader redirect (masterclient.go re-dials on
         the leader announced over KeepConnected)."""
-        if req.path in self._LEADER_ONLY and not self.raft.is_leader:
+        if req.path in self._LEADER_ONLY and not self.raft.lease_valid():
+            # lease_valid, not is_leader: a leader partitioned from the
+            # quorum must refuse the moment its lease lapses — before a
+            # majority-side successor can be elected — or a ~1s dual-
+            # leader window serves assigns from both sides (raft lease
+            # rule; weed/server/raft_hashicorp.go LeaderLeaseTimeout)
             return 503, {"error": "not leader",
                          "leader": self.raft.leader}
         if is_admin_path(req.path):

@@ -29,7 +29,7 @@ import random
 import threading
 import time
 import uuid
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import ThreadPoolExecutor, as_completed
 
 from .httpd import HttpServer, Request, http_json
 
@@ -59,8 +59,12 @@ class RaftNode:
         self.voted_for: str | None = None
         self.leader = ""
         self.topology_id = ""
-        self._last_heard = time.time()
-        self._last_quorum = time.time()
+        # monotonic clocks only: the lease fence and election timers
+        # must not move with NTP steps (a backward wall-clock step on a
+        # partitioned leader would otherwise extend its lease and serve
+        # split-brain assigns)
+        self._last_heard = time.monotonic()
+        self._last_quorum = time.monotonic()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._pool = ThreadPoolExecutor(max_workers=max(4, len(self.peers)))
@@ -83,9 +87,30 @@ class RaftNode:
         self._stop.set()
         self._pool.shutdown(wait=False)
 
+    # Leader lease in pulses.  MUST be strictly below the minimum
+    # election timeout (4 * pulse, _election_timeout): a partitioned
+    # minority leader then stops serving BEFORE any majority-side peer
+    # can even begin electing a successor — the standard raft lease
+    # rule (hashicorp/raft LeaderLeaseTimeout < ElectionTimeout,
+    # weed/server/raft_hashicorp.go).
+    LEASE_PULSES = 3
+
     @property
     def is_leader(self) -> bool:
         return self.state == LEADER
+
+    def lease_valid(self) -> bool:
+        """True iff this node may ACT as leader right now.  Serving
+        paths must consult this rather than `is_leader`: the background
+        loop only notices a lost quorum at heartbeat-round end (which a
+        partition delays by the full HTTP timeout), while the lease
+        clock expires in real time."""
+        if self.state != LEADER:
+            return False
+        if len(self.peers) == 1:
+            return True
+        return time.monotonic() - self._last_quorum <= \
+            self.LEASE_PULSES * self.pulse
 
     def majority(self) -> int:
         return len(self.peers) // 2 + 1
@@ -102,7 +127,7 @@ class RaftNode:
                        self.voted_for in (None, candidate))
             if granted:
                 self.voted_for = candidate
-                self._last_heard = time.time()  # don't race the grantee
+                self._last_heard = time.monotonic()  # don't race the grantee
             return 200, {"granted": granted, "term": self.term}
 
     def _handle_append(self, req: Request):
@@ -115,7 +140,7 @@ class RaftNode:
                 self._step_down(term)
             self.leader = b.get("leader", "")
             self.topology_id = b.get("topologyId", self.topology_id)
-            self._last_heard = time.time()
+            self._last_heard = time.monotonic()
             return 200, {"ok": True, "term": self.term}
 
     # -- state machine ----------------------------------------------------
@@ -141,7 +166,7 @@ class RaftNode:
             # servers seeing a new id re-register fully (the reference's
             # topology-id fencing, master_server.go:256)
             self.topology_id = f"{self.term}-{uuid.uuid4().hex[:8]}"
-            self._last_quorum = time.time()
+            self._last_quorum = time.monotonic()
         if self.on_leadership:
             self.on_leadership(True)
         return True
@@ -154,7 +179,7 @@ class RaftNode:
         while not self._stop.wait(self.pulse):
             if self.state == LEADER:
                 self._heartbeat_peers()
-            elif time.time() - self._last_heard > timeout:
+            elif time.monotonic() - self._last_heard > timeout:
                 timeout = self._election_timeout()
                 self._run_election()
 
@@ -167,52 +192,93 @@ class RaftNode:
             # reset the backoff clock: a split vote must wait out a FRESH
             # randomized timeout before retrying, or symmetric candidates
             # livelock in lockstep
-            self._last_heard = time.time()
+            self._last_heard = time.monotonic()
         votes = 1
         futs = [self._pool.submit(
             http_json, "POST", f"{p}/cluster/raft/vote",
-            {"term": term, "candidate": self.self_url}, 2.0,
-            self._auth_headers())
+            {"term": term, "candidate": self.self_url},
+            self._rpc_timeout(), self._auth_headers())
             for p in self.peers if p != self.self_url]
-        for f in futs:
-            try:
-                r = f.result(timeout=3)
-            except Exception:
-                continue
-            if int(r.get("term", 0)) > term:
-                with self._lock:
-                    self._step_down(int(r["term"]))
-                return
-            if r.get("granted"):
-                votes += 1
+        try:
+            for f in as_completed(futs, timeout=self._rpc_timeout() + 1):
+                try:
+                    r = f.result()
+                except Exception:
+                    continue
+                if int(r.get("term", 0)) > term:
+                    with self._lock:
+                        self._step_down(int(r["term"]))
+                    return
+                if r.get("granted"):
+                    votes += 1
+        except TimeoutError:
+            pass
         if votes >= self.majority() and self._try_become_leader(term):
             self._heartbeat_peers()
 
+    def _rpc_timeout(self) -> float:
+        """Peer RPC timeout.  Must stay well under the lease: a
+        blackholed peer then can't stretch a heartbeat round past the
+        lease window or pile hung futures onto the pool (rounds fire
+        every pulse)."""
+        return max(0.5, 2 * self.pulse)
+
     def _heartbeat_peers(self) -> None:
         term = self.term
+        # The lease clock anchors at round DISPATCH, not completion:
+        # followers restart their election timers at append RECEIPT
+        # (>= dispatch), so `dispatch + lease < receipt + min election
+        # timeout` is the invariant that closes the dual-leader window.
+        # Anchoring at completion would let a round stretched by a slow
+        # peer extend the lease past a majority-side election.
+        round_start = time.monotonic()
         acks = 1
+        got_quorum = acks >= self.majority()  # single-node cluster
+        if got_quorum:
+            self._last_quorum = round_start
         futs = [self._pool.submit(
             http_json, "POST", f"{p}/cluster/raft/append",
             {"term": term, "leader": self.self_url,
-             "topologyId": self.topology_id}, 2.0,
+             "topologyId": self.topology_id}, self._rpc_timeout(),
             self._auth_headers())
             for p in self.peers if p != self.self_url]
-        for f in futs:
-            try:
-                r = f.result(timeout=3)
-            except Exception:
-                continue
-            if int(r.get("term", 0)) > term:
-                with self._lock:
-                    self._step_down(int(r["term"]))
-                return
-            if r.get("ok"):
-                acks += 1
-        now = time.time()
-        if acks >= self.majority():
-            self._last_quorum = now
-        elif now - self._last_quorum > 10 * self.pulse:
+        try:
+            # as_completed, NOT in-order result(): the quorum must
+            # refresh the moment a majority acks — a healthy cluster
+            # with one blackholed peer would otherwise refresh only at
+            # round end (after the full RPC timeout) and spend most of
+            # each round with a lapsed lease, 503ing assigns despite
+            # holding quorum.
+            for f in as_completed(futs,
+                                  timeout=self._rpc_timeout() + 1):
+                try:
+                    r = f.result()
+                except Exception:
+                    continue
+                if int(r.get("term", 0)) > term:
+                    with self._lock:
+                        self._step_down(int(r["term"]))
+                    return
+                if r.get("ok"):
+                    acks += 1
+                    if not got_quorum and acks >= self.majority():
+                        got_quorum = True
+                        self._last_quorum = round_start
+                        # Stop waiting on stragglers: a blackholed peer
+                        # would stretch the round by its RPC timeout and
+                        # push the NEXT dispatch past the lease window.
+                        # A higher term in an unread straggler response
+                        # still surfaces — that peer rejects appends
+                        # without resetting its election timer, times
+                        # out, and its vote request deposes us.
+                        break
+        except TimeoutError:
+            pass
+        if not got_quorum and time.monotonic() - self._last_quorum > \
+                self.LEASE_PULSES * self.pulse:
             # leader lease expired: partitioned from the quorum — stop
-            # acting as leader so a split brain can't serve assigns
+            # acting as leader so a split brain can't serve assigns.
+            # (lease_valid() already refused serving paths the moment
+            # the lease lapsed; this retires the leader state itself)
             with self._lock:
                 self._step_down(self.term)
