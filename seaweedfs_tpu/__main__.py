@@ -91,6 +91,20 @@ def main(argv: list[str] | None = None) -> int:
     wk.add_argument("-backend", default="",
                     help="EC codec backend: jax|cpu (default: auto)")
 
+    fsync = sub.add_parser(
+        "filer.sync", help="continuously replicate one filer's "
+        "namespace+content to another, resuming from a persisted "
+        "offset (command/filer_sync.go)")
+    fsync.add_argument("-from", dest="sync_from", required=True,
+                       help="source filer host:port")
+    fsync.add_argument("-to", dest="sync_to", required=True,
+                       help="target filer host:port")
+    fsync.add_argument("-state", default="",
+                       help="offset checkpoint file (default: a "
+                            "per-direction name derived from -from/-to)")
+    fsync.add_argument("-interval", type=float, default=0.5,
+                       help="poll interval seconds when idle")
+
     sh = sub.add_parser("shell", help="interactive admin shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
     sh.add_argument("command", nargs="*",
@@ -199,6 +213,17 @@ def main(argv: list[str] | None = None) -> int:
         w.start()
         print(f"worker {w.worker_id} polling {args.admin}")
         _wait()
+    elif args.cmd == "filer.sync":
+        from .filer.filer_sync import FilerSync
+        syncer = FilerSync(args.sync_from, args.sync_to,
+                           args.state or None,
+                           poll_interval=args.interval)
+        print(f"filer.sync {args.sync_from} -> {args.sync_to} "
+              f"(offset state: {syncer.state_path})")
+        try:
+            syncer.run()
+        except KeyboardInterrupt:
+            pass
     elif args.cmd == "shell":
         from .shell import CommandEnv, run_command
         env = CommandEnv(args.master)

@@ -1,9 +1,10 @@
 """The Filer: namespace operations over a FilerStore + chunked content
 on the volume cluster (weed/filer/filer.go).
 
-Mutations emit metadata events to an in-process log consumed by
-subscription streams (filer/filer_notify.go) — the backbone for
-filer.sync / mount cache invalidation / S3 events.
+Mutations emit metadata events to a persistent, timestamp-replayable
+log (filer/filer_notify.go, meta_log.MetaLog) — the backbone for
+filer.sync / mount cache invalidation / S3 events.  Subscribers resume
+from their last-seen tsNs and never silently skip events.
 """
 
 from __future__ import annotations
@@ -11,29 +12,29 @@ from __future__ import annotations
 import copy
 import threading
 import time
-from collections import deque
 from typing import Callable
 
 from .. import operation
 from .entry import Attributes, Entry, FileChunk, normalize_path
 from .filechunks import total_size, view_from_chunks
 from .filer_store import FilerStore, MemoryStore
+from .meta_log import MetaLog
 
 CHUNK_SIZE = 4 * 1024 * 1024  # filer auto-chunk default (8MB in ref CLI)
 
 
 class Filer:
     def __init__(self, master: str, store: FilerStore | None = None,
-                 collection: str = "", replication: str = ""):
+                 collection: str = "", replication: str = "",
+                 meta_log_dir: str | None = None):
         self.master = master
         self.store = store or MemoryStore()
         self.collection = collection
         self.replication = replication
         self._log_lock = threading.Lock()
-        # bounded in-memory event ring (the reference persists its log
-        # to /topics/... files; pollers that fall behind the ring must
-        # resync with a full listing)
-        self._meta_log: deque[dict] = deque(maxlen=10_000)
+        # persisted when meta_log_dir is set (filer_notify_append.go);
+        # memory-tail-only otherwise (tests / ephemeral filers)
+        self.meta_log = MetaLog(meta_log_dir)
         self._listeners: list[Callable[[dict], None]] = []
 
     # -- namespace ops ----------------------------------------------------
@@ -181,8 +182,11 @@ class Filer:
             "newEntry": new_entry.to_json() if new_entry else None,
             "oldEntry": old_entry.to_json() if old_entry else None,
         }
+        # MetaLog stamps (strictly monotonic) and persists BEFORE live
+        # listeners see the event, so a listener's recorded tsNs is
+        # always replayable after a disconnect
+        event = self.meta_log.append(event)
         with self._log_lock:
-            self._meta_log.append(event)
             listeners = list(self._listeners)
         for fn in listeners:
             try:
@@ -194,6 +198,5 @@ class Filer:
         with self._log_lock:
             self._listeners.append(fn)
 
-    def events_since(self, ts_ns: int) -> list[dict]:
-        with self._log_lock:
-            return [e for e in self._meta_log if e["tsNs"] > ts_ns]
+    def events_since(self, ts_ns: int, limit: int = 0) -> list[dict]:
+        return self.meta_log.events_since(ts_ns, limit)

@@ -1,0 +1,159 @@
+"""Persistent filer metadata log with timestamp replay.
+
+The reference appends every namespace mutation to segment files under
+`/topics/.system/log/<yyyy-mm-dd>/<HH-MM>` through the filer's own chunk
+machinery (weed/filer/filer_notify_append.go appendToFile), and replays
+them by timestamp, pruning whole segments by their date/minute names
+(weed/filer/filer_notify_read.go CollectLogFileRefs).  Subscribers that
+reconnect resume from their last-seen tsNs and never silently skip
+events — the round-2 in-memory ring dropped history on overflow.
+
+This build keeps the same two-level `<yyyy-mm-dd>/<HH-MM>.log` naming so
+replay prunes segments exactly like the reference, but appends JSON
+lines to local files under the filer's data dir: the log IS the
+filer's durability domain here, while the reference's detour through
+volume-server chunks exists because its log doubles as an MQ topic.
+A bounded in-memory tail keeps the common `events_since(recent)` query
+off the disk.  Timestamps are made strictly monotonic at append time so
+`> sinceNs` resume can never skip a same-timestamp sibling.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+
+def _segment_name(ts_ns: int) -> "tuple[str, str]":
+    """(day, minute) segment names, UTC — filer_notify_read.go:33
+    startDate / :53 startHourMinute."""
+    t = time.gmtime(ts_ns / 1e9)
+    return (f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}",
+            f"{t.tm_hour:02d}-{t.tm_min:02d}")
+
+
+class MetaLog:
+    """Append-only metadata event log: strictly-monotonic stamps,
+    per-minute segment files (when `dir_path` is set), timestamp replay
+    across restart."""
+
+    def __init__(self, dir_path: str | None = None,
+                 max_memory_events: int = 10_000):
+        self.dir = dir_path
+        self._mem: deque[dict] = deque(maxlen=max_memory_events)
+        self._lock = threading.Lock()
+        self._last_ts = 0
+        self._open_name: "tuple[str, str] | None" = None
+        self._open_file = None
+        if self.dir:
+            os.makedirs(self.dir, exist_ok=True)
+            self._last_ts = self._scan_last_ts()
+
+    # -- append -----------------------------------------------------------
+
+    def append(self, event: dict) -> dict:
+        """Stamp and persist one event.  The event's tsNs is bumped if
+        needed so stamps are strictly increasing even across restarts
+        (replay uses `> sinceNs`; two events sharing a stamp would let
+        a resumer skip the second)."""
+        with self._lock:
+            ts = int(event.get("tsNs") or time.time_ns())
+            if ts <= self._last_ts:
+                ts = self._last_ts + 1
+            self._last_ts = ts
+            event["tsNs"] = ts
+            self._mem.append(event)
+            if self.dir:
+                name = _segment_name(ts)
+                if name != self._open_name:
+                    self._rotate(name)
+                self._open_file.write(
+                    json.dumps(event, separators=(",", ":")) + "\n")
+                # flush to the OS on every event: survives a process
+                # crash; the reference's log_buffer batches ~2min per
+                # chunk upload and accepts the same page-cache window
+                self._open_file.flush()
+        return event
+
+    def _rotate(self, name: "tuple[str, str]") -> None:
+        if self._open_file is not None:
+            self._open_file.close()
+        day_dir = os.path.join(self.dir, name[0])
+        os.makedirs(day_dir, exist_ok=True)
+        self._open_file = open(os.path.join(day_dir, name[1] + ".log"),
+                               "a", encoding="utf-8")
+        self._open_name = name
+
+    # -- replay -----------------------------------------------------------
+
+    def events_since(self, ts_ns: int, limit: int = 0) -> list[dict]:
+        """All events with tsNs > ts_ns, oldest first.  Served from the
+        in-memory tail when it still covers ts_ns; otherwise replayed
+        from the persisted segments (pruned by day/minute name like
+        CollectLogFileRefs)."""
+        with self._lock:
+            mem = list(self._mem)
+        if mem and (mem[0]["tsNs"] <= ts_ns or not self.dir):
+            out = [e for e in mem if e["tsNs"] > ts_ns]
+            return out[:limit] if limit else out
+        if not self.dir:
+            return []
+        out = []
+        start_day, start_min = _segment_name(ts_ns) if ts_ns else ("", "")
+        for day in sorted(os.listdir(self.dir)):
+            if day < start_day:
+                continue
+            day_dir = os.path.join(self.dir, day)
+            if not os.path.isdir(day_dir):
+                continue
+            for minute in sorted(os.listdir(day_dir)):
+                if day == start_day and minute[:-4] < start_min:
+                    continue
+                with open(os.path.join(day_dir, minute),
+                          encoding="utf-8") as f:
+                    for line in f:
+                        try:
+                            e = json.loads(line)
+                        except ValueError:
+                            continue  # torn tail write after a crash
+                        if e.get("tsNs", 0) > ts_ns:
+                            out.append(e)
+                            if limit and len(out) >= limit:
+                                return out
+        return out
+
+    def last_ts(self) -> int:
+        with self._lock:
+            return self._last_ts
+
+    def _scan_last_ts(self) -> int:
+        """Resume the monotonic stamp clock from the newest persisted
+        event (so a restarted filer can't stamp below history)."""
+        days = sorted((d for d in os.listdir(self.dir)
+                       if os.path.isdir(os.path.join(self.dir, d))),
+                      reverse=True)
+        for day in days:
+            day_dir = os.path.join(self.dir, day)
+            for minute in sorted(os.listdir(day_dir), reverse=True):
+                last = 0
+                with open(os.path.join(day_dir, minute),
+                          encoding="utf-8") as f:
+                    for line in f:
+                        try:
+                            last = max(last, json.loads(line)
+                                       .get("tsNs", 0))
+                        except ValueError:
+                            continue
+                if last:
+                    return last
+        return 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._open_file is not None:
+                self._open_file.close()
+                self._open_file = None
+                self._open_name = None

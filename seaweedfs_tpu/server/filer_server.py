@@ -22,13 +22,22 @@ from .httpd import HttpServer, Request
 class FilerServer:
     def __init__(self, master: str, host: str = "127.0.0.1",
                  port: int = 0, store_path: str = ":memory:",
-                 collection: str = "", replication: str = ""):
+                 collection: str = "", replication: str = "",
+                 meta_log_dir: str | None = None):
+        if meta_log_dir is None and store_path != ":memory:":
+            # persist the metadata log beside the store by default —
+            # subscribers must survive a filer restart
+            # (filer_notify_append.go)
+            meta_log_dir = store_path + ".metalog"
         self.filer = Filer(master, SqliteStore(store_path),
                            collection=collection,
-                           replication=replication)
+                           replication=replication,
+                           meta_log_dir=meta_log_dir)
         self.http = HttpServer(host, port)
         self.http.route("GET", "/__meta__/lookup", self._meta_lookup)
         self.http.route("POST", "/__meta__/rename", self._meta_rename)
+        self.http.route("POST", "/__meta__/set_attrs",
+                        self._meta_set_attrs)
         self.http.route("GET", "/__meta__/events", self._meta_events)
         self.http.fallback = self._dispatch
 
@@ -39,6 +48,7 @@ class FilerServer:
     def stop(self):
         self.http.stop()
         self.filer.store.close()
+        self.filer.meta_log.close()
 
     @property
     def url(self) -> str:
@@ -142,6 +152,20 @@ class FilerServer:
             return 404, {"error": str(e)}
         return 200, {}
 
+    def _meta_set_attrs(self, req: Request):
+        """Attribute-only update (filer.proto UpdateEntry with unchanged
+        chunks) — filer.sync uses this to propagate mode/uid/gid/mtime
+        that the content PUT cannot carry."""
+        b = req.json()
+        entry = self.filer.find_entry(b["path"])
+        if entry is None:
+            return 404, {"error": "not found"}
+        from ..filer.entry import Attributes
+        entry.attributes = Attributes.from_json(b.get("attributes", {}))
+        self.filer.create_entry(entry, create_parents=False)
+        return 200, {}
+
     def _meta_events(self, req: Request):
         since = int(req.query.get("sinceNs", 0))
-        return 200, {"events": self.filer.events_since(since)}
+        limit = int(req.query.get("limit", 0))
+        return 200, {"events": self.filer.events_since(since, limit)}

@@ -1,0 +1,323 @@
+"""Persistent metadata log + filer.sync tests (the analog of
+weed/filer/filer_notify_{append,read}.go + command/filer_sync.go,
+test/metadata_subscribe/).
+
+VERDICT r2 Next #3 done-criteria: two filers converge after one
+restarts mid-stream; subscribers never silently skip events."""
+
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer import Entry, Filer
+from seaweedfs_tpu.filer.filer_sync import FilerSync, default_state_path
+from seaweedfs_tpu.filer.meta_log import MetaLog
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.httpd import http_bytes, http_json
+
+
+def _http_raw(method, url, data=None, headers=None):
+    st, body, _ = http_bytes(method, url, data, headers)
+    return st, body
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+# --- MetaLog unit tests --------------------------------------------------
+
+def test_meta_log_persists_across_restart(tmp_path):
+    d = str(tmp_path / "log")
+    log = MetaLog(d)
+    for i in range(5):
+        log.append({"op": "create", "tsNs": 0, "n": i})
+    last = log.last_ts()
+    log.close()
+
+    log2 = MetaLog(d)
+    got = log2.events_since(0)
+    assert [e["n"] for e in got] == [0, 1, 2, 3, 4]
+    # stamp clock resumes ABOVE persisted history
+    e = log2.append({"op": "create", "tsNs": 0, "n": 5})
+    assert e["tsNs"] > last
+    log2.close()
+
+
+def test_meta_log_strictly_monotonic_stamps(tmp_path):
+    log = MetaLog(str(tmp_path / "log"))
+    same = time.time_ns()
+    stamps = [log.append({"op": "x", "tsNs": same})["tsNs"]
+              for _ in range(10)]
+    assert stamps == sorted(set(stamps)), "stamps must be unique+sorted"
+    # resume from the middle: sees EXACTLY the later events
+    mid = stamps[4]
+    assert [e["tsNs"] for e in log.events_since(mid)] == stamps[5:]
+    log.close()
+
+
+def test_meta_log_replays_beyond_memory_tail(tmp_path):
+    """The round-2 ring dropped history silently; the persistent log
+    must serve events older than the in-memory tail from disk."""
+    log = MetaLog(str(tmp_path / "log"), max_memory_events=3)
+    stamps = [log.append({"op": "x", "tsNs": 0, "n": i})["tsNs"]
+              for i in range(10)]
+    got = log.events_since(0)
+    assert [e["n"] for e in got] == list(range(10))
+    assert [e["n"] for e in log.events_since(stamps[6])] == [7, 8, 9]
+    log.close()
+
+
+def test_meta_log_memory_only_fallback():
+    log = MetaLog(None)
+    log.append({"op": "x", "tsNs": 0, "n": 1})
+    assert [e["n"] for e in log.events_since(0)] == [1]
+
+
+def test_meta_log_limit(tmp_path):
+    log = MetaLog(str(tmp_path / "log"), max_memory_events=2)
+    for i in range(6):
+        log.append({"op": "x", "tsNs": 0, "n": i})
+    assert [e["n"] for e in log.events_since(0, limit=3)] == [0, 1, 2]
+    log.close()
+
+
+def test_meta_log_tolerates_torn_tail(tmp_path):
+    d = str(tmp_path / "log")
+    log = MetaLog(d)
+    log.append({"op": "x", "tsNs": 0, "n": 1})
+    log.close()
+    # simulate a crash mid-write: torn trailing line
+    day = os.listdir(d)[0]
+    seg_dir = os.path.join(d, day)
+    seg = os.path.join(seg_dir, os.listdir(seg_dir)[0])
+    with open(seg, "a") as f:
+        f.write('{"op":"x","tsNs"')
+    log2 = MetaLog(d)
+    assert [e["n"] for e in log2.events_since(0)] == [1]
+    log2.close()
+
+
+# --- Filer integration ---------------------------------------------------
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer().start()
+    servers = [VolumeServer([str(tmp_path / f"v{i}")], master.url,
+                            pulse_seconds=0.3).start() for i in range(2)]
+    time.sleep(0.5)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def test_filer_events_survive_restart(cluster, tmp_path):
+    master, _ = cluster
+    store = str(tmp_path / "filer.db")
+    fs = FilerServer(master.url, store_path=store).start()
+    fs.filer.write_file("/a/x.txt", b"hello")
+    fs.filer.write_file("/a/y.txt", b"world")
+    n_events = len(fs.filer.events_since(0))
+    assert n_events >= 3  # dir + 2 files
+    fs.stop()
+
+    fs2 = FilerServer(master.url, store_path=store).start()
+    try:
+        got = fs2.filer.events_since(0)
+        assert len(got) == n_events, "restart lost metadata history"
+        assert fs2.filer.read_file("/a/x.txt") == b"hello"
+    finally:
+        fs2.stop()
+
+
+def _converged(src, dst, paths):
+    for p, want in paths.items():
+        st, body = _http_raw("GET", dst + p)
+        if st != 200 or body != want:
+            return False
+    return True
+
+
+def _wait(pred, timeout=10.0, tick=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(tick)
+    return False
+
+
+def test_filer_sync_converges_and_resumes(cluster, tmp_path):
+    """filer.sync end-to-end: initial convergence, rename + delete
+    propagation, then a SYNCER restart mid-stream resumes from the
+    persisted offset, and a TARGET filer restart mid-stream converges
+    too (the VERDICT done-criterion)."""
+    master, _ = cluster
+    src = FilerServer(master.url,
+                      store_path=str(tmp_path / "src.db")).start()
+    dst = FilerServer(master.url,
+                      store_path=str(tmp_path / "dst.db")).start()
+    state = str(tmp_path / "sync.offset")
+
+    src.filer.write_file("/docs/a.txt", b"alpha")
+    src.filer.write_file("/docs/b.txt", b"beta")
+
+    syncer = FilerSync(src.url, dst.url, state,
+                       poll_interval=0.05).start()
+    try:
+        assert _wait(lambda: _converged(
+            src.url, dst.url,
+            {"/docs/a.txt": b"alpha", "/docs/b.txt": b"beta"}))
+
+        # rename + delete propagate
+        src.filer.rename("/docs/a.txt", "/docs/a2.txt")
+        src.filer.delete_entry("/docs/b.txt")
+        assert _wait(lambda: _converged(
+            src.url, dst.url, {"/docs/a2.txt": b"alpha"}))
+        assert _wait(lambda: _http_raw(
+            "GET", dst.url + "/docs/b.txt")[0] == 404)
+
+        # --- syncer restart mid-stream: offset resumes, no replay gap
+        syncer.stop()
+        src.filer.write_file("/docs/c.txt", b"gamma")
+        syncer = FilerSync(src.url, dst.url, state,
+                           poll_interval=0.05).start()
+        assert _wait(lambda: _converged(
+            src.url, dst.url, {"/docs/c.txt": b"gamma"}))
+
+        # --- target restart mid-stream
+        syncer.stop()
+        dst_port = dst.http.port
+        dst.stop()
+        src.filer.write_file("/docs/d.txt", b"delta")
+        dst = FilerServer(master.url, port=dst_port,
+                          store_path=str(tmp_path / "dst.db")).start()
+        syncer = FilerSync(src.url, dst.url, state,
+                           poll_interval=0.05).start()
+        assert _wait(lambda: _converged(
+            src.url, dst.url, {"/docs/d.txt": b"delta",
+                               "/docs/a2.txt": b"alpha"}))
+    finally:
+        syncer.stop()
+        src.stop()
+        dst.stop()
+
+
+def test_filer_sync_source_restart_no_lost_events(cluster, tmp_path):
+    """A SOURCE filer restart mid-stream must not lose events for the
+    syncer: the persistent MetaLog replays from the offset."""
+    master, _ = cluster
+    src_store = str(tmp_path / "src.db")
+    src = FilerServer(master.url, store_path=src_store).start()
+    dst = FilerServer(master.url,
+                      store_path=str(tmp_path / "dst.db")).start()
+    state = str(tmp_path / "sync.offset")
+
+    src.filer.write_file("/x/one.txt", b"one")
+    # no syncer running yet: events accumulate in the persistent log
+    src_port = src.http.port
+    src.stop()
+    src = FilerServer(master.url, port=src_port,
+                      store_path=src_store).start()
+    src.filer.write_file("/x/two.txt", b"two")
+
+    syncer = FilerSync(src.url, dst.url, state,
+                       poll_interval=0.05).start()
+    try:
+        assert _wait(lambda: _converged(
+            src.url, dst.url,
+            {"/x/one.txt": b"one", "/x/two.txt": b"two"})), \
+            "events written before the source restart were lost"
+    finally:
+        syncer.stop()
+        src.stop()
+        dst.stop()
+
+
+def test_filer_sync_propagates_attributes(cluster, tmp_path):
+    """mode/uid/gid ride /__meta__/set_attrs, not the content PUT."""
+    master, _ = cluster
+    src = FilerServer(master.url,
+                      store_path=str(tmp_path / "src.db")).start()
+    dst = FilerServer(master.url,
+                      store_path=str(tmp_path / "dst.db")).start()
+    src.filer.write_file("/m/f.bin", b"payload", mode=0o600)
+    e = src.filer.find_entry("/m/f.bin")
+    e.attributes.uid, e.attributes.gid = 42, 43
+    src.filer.create_entry(e, create_parents=False)
+
+    syncer = FilerSync(src.url, dst.url,
+                       str(tmp_path / "s.offset"),
+                       poll_interval=0.05).start()
+    try:
+        assert _wait(lambda: _converged(src.url, dst.url,
+                                        {"/m/f.bin": b"payload"}))
+
+        def attrs_match():
+            got = dst.filer.find_entry("/m/f.bin")
+            return (got is not None and got.attributes.mode == 0o600
+                    and got.attributes.uid == 42
+                    and got.attributes.gid == 43)
+        assert _wait(attrs_match), "attributes were not propagated"
+    finally:
+        syncer.stop()
+        src.stop()
+        dst.stop()
+
+
+def test_filer_sync_state_file_direction_guard(tmp_path):
+    """A checkpoint written for one direction must not be readable as
+    another direction's offset (silent skip/mass-replay hazard)."""
+    state = str(tmp_path / "s.offset")
+    a_to_b = FilerSync("127.0.0.1:1", "127.0.0.1:2", state)
+    a_to_b._save_offset(12345)
+    assert a_to_b.offset() == 12345
+    b_to_a = FilerSync("127.0.0.1:2", "127.0.0.1:1", state)
+    with pytest.raises(RuntimeError, match="belongs to"):
+        b_to_a.offset()
+    # and the derived default names differ per direction
+    assert default_state_path("a:1", "b:2") != \
+        default_state_path("b:2", "a:1")
+
+
+def test_filer_sync_failed_apply_does_not_advance_offset(cluster,
+                                                         tmp_path):
+    """An application failure must abort the batch BEFORE the offset
+    checkpoint — a flaky target retries, never skips."""
+    master, _ = cluster
+    src = FilerServer(master.url,
+                      store_path=str(tmp_path / "src.db")).start()
+    dst = FilerServer(master.url,
+                      store_path=str(tmp_path / "dst.db")).start()
+    src.filer.write_file("/q/a.txt", b"data")
+    sync = FilerSync(src.url, dst.url, str(tmp_path / "s.offset"),
+                     poll_interval=0.05)
+    # break the target: point applications at a dead port
+    dead_port_sync = FilerSync(src.url, "127.0.0.1:1",
+                               str(tmp_path / "dead.offset"))
+    with pytest.raises(Exception):
+        dead_port_sync.sync_once()
+    assert dead_port_sync.offset() == 0, \
+        "offset advanced past an event that failed to apply"
+    # the healthy syncer applies the same events fine
+    assert sync.sync_once() > 0
+    assert sync.offset() > 0
+    src.stop()
+    dst.stop()
+
+
+def test_http_events_endpoint_serves_persisted_history(cluster,
+                                                       tmp_path):
+    master, _ = cluster
+    store = str(tmp_path / "filer.db")
+    fs = FilerServer(master.url, store_path=store).start()
+    fs.filer.write_file("/h/a.txt", b"1")
+    fs.stop()
+    fs = FilerServer(master.url, store_path=store).start()
+    try:
+        r = http_json("GET", f"{fs.url}/__meta__/events?sinceNs=0")
+        paths = [(e.get("newEntry") or {}).get("fullPath")
+                 for e in r["events"]]
+        assert "/h/a.txt" in paths
+    finally:
+        fs.stop()
