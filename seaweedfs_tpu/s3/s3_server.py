@@ -5,12 +5,19 @@ filer layout).
 Implemented surface (the core the reference's s3tests exercise first):
   ListBuckets, Create/Delete/Head bucket, Put/Get/Head/Delete object,
   batch DeleteObjects, ListObjectsV2 (prefix/delimiter/continuation),
-  multipart (initiate/uploadPart/complete/abort/listParts), SigV4 auth.
+  multipart (initiate/uploadPart/complete/abort/listParts), SigV4 auth
+  (header + presigned query), streaming-chunked uploads
+  (chunked_reader_v4.go), object versioning with delete markers
+  (s3api_object_versioning.go — versions archived under
+  `<key>.versions/`, newest-first by inverted-timestamp id), and
+  bucket CORS incl. preflight (s3api/cors/).
 """
 
 from __future__ import annotations
 
 import hashlib
+import os
+import threading
 import time
 import urllib.parse
 import uuid
@@ -20,10 +27,21 @@ from ..filer import Entry, Filer
 from ..filer.filechunks import total_size
 from ..server.httpd import HttpServer, Request
 from .auth import SigV4Verifier
+from .chunked import ChunkedDecodeError, decode_streaming_body
+from .cors import evaluate as cors_evaluate, parse_cors_config
 
 BUCKETS_ROOT = "/buckets"
 UPLOADS_DIR = "/.uploads"
+VERSIONS_EXT = ".versions"
 S3_NS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+def new_version_id() -> str:
+    """Inverted-timestamp version id: lexicographically ascending =
+    newest first, so a plain sorted listing of `<key>.versions/` yields
+    newest-first order (the reference's 'inverted format',
+    s3api_object_versioning.go generateVersionId)."""
+    return f"{(1 << 63) - time.time_ns():016x}{os.urandom(3).hex()}"
 
 
 def _xml(root: ET.Element) -> bytes:
@@ -45,6 +63,25 @@ def _error(status: int, code: str, message: str):
     return status, (_xml(root), "application/xml")
 
 
+def _with_headers(resp, extra: dict):
+    """Merge extra response headers into any handler return shape."""
+    status, payload = resp
+    if isinstance(payload, tuple):
+        body, second = payload
+        if isinstance(second, dict):
+            merged = dict(second)
+            merged.update(extra)
+            return status, (body, merged)
+        h = dict(extra)
+        h["Content-Type"] = second
+        return status, (body, h)
+    if isinstance(payload, (bytes, str)):
+        body = payload if isinstance(payload, bytes) \
+            else str(payload).encode()
+        return status, (body, dict(extra))
+    return resp  # JSON dict/list: headers not applicable
+
+
 def _iso(ts: float) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))
 
@@ -57,6 +94,15 @@ class S3ApiServer:
         self.verifier = SigV4Verifier(credentials) if credentials else None
         self.http = HttpServer(host, port)
         self.http.fallback = self._dispatch
+        # striped per-key locks: versioned mutations are
+        # archive-then-write sequences; two concurrent PUTs to one key
+        # must not interleave or the loser's acknowledged version is
+        # silently lost (bounded stripe count — no per-key leak)
+        self._stripes = [threading.Lock() for _ in range(64)]
+        self._cors_cache: dict[str, tuple[str, list]] = {}
+
+    def _path_lock(self, path: str) -> "threading.Lock":
+        return self._stripes[hash(path) % len(self._stripes)]
 
     def start(self):
         self.http.start()
@@ -72,16 +118,40 @@ class S3ApiServer:
     # -- dispatch ---------------------------------------------------------
 
     def _dispatch(self, req: Request):
+        parts = req.path.lstrip("/").split("/", 1)
+        bucket = urllib.parse.unquote(parts[0]) if parts[0] else ""
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        origin = req.headers.get("Origin", "")
+        if req.method == "OPTIONS":
+            # CORS preflight: unauthenticated by design (browsers send
+            # no credentials on preflights)
+            return self._preflight(req, bucket)
+        resp = self._handle(req, bucket, key)
+        if origin and bucket:
+            cors = cors_evaluate(self._cors_rules(bucket), origin,
+                                 req.method)
+            if cors:
+                resp = _with_headers(resp, cors)
+        return resp
+
+    def _handle(self, req: Request, bucket: str, key: str):
         if self.verifier is not None:
-            ok, who = self.verifier.verify(
+            ok, who, ctx = self.verifier.verify(
                 req.method, req.path, req.query,
                 {k.lower(): v for k, v in req.headers.items()},
                 req.body)
             if not ok:
                 return _error(403, "AccessDenied", who)
-        parts = req.path.lstrip("/").split("/", 1)
-        bucket = urllib.parse.unquote(parts[0]) if parts[0] else ""
-        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        else:
+            ctx = None
+        sha = req.headers.get("x-amz-content-sha256", "")
+        if sha.startswith("STREAMING-"):
+            # aws-chunked framing (chunked_reader_v4.go): verify chunk
+            # signatures when we hold credentials, then unwrap
+            try:
+                req._body = decode_streaming_body(req.body, ctx)
+            except ChunkedDecodeError as e:
+                return _error(403, "SignatureDoesNotMatch", str(e))
         if not bucket:
             if req.method == "GET":
                 return self._list_buckets()
@@ -89,6 +159,97 @@ class S3ApiServer:
         if not key:
             return self._bucket_op(req, bucket)
         return self._object_op(req, bucket, key)
+
+    # -- CORS (s3api/cors/) -----------------------------------------------
+
+    def _cors_rules(self, bucket: str):
+        e = self.filer.find_entry(self._bucket_path(bucket))
+        xml_text = (e.extended.get("cors") if e else None) or ""
+        if not xml_text:
+            return []
+        if isinstance(xml_text, bytes):
+            xml_text = xml_text.decode()
+        cached = self._cors_cache.get(bucket)
+        if cached is not None and cached[0] == xml_text:
+            return cached[1]  # skip the per-request XML re-parse
+        try:
+            rules = parse_cors_config(xml_text.encode())
+        except ValueError:
+            rules = []
+        self._cors_cache[bucket] = (xml_text, rules)
+        return rules
+
+    def _preflight(self, req: Request, bucket: str):
+        origin = req.headers.get("Origin", "")
+        want_method = req.headers.get("Access-Control-Request-Method",
+                                      "")
+        want_headers = req.headers.get("Access-Control-Request-Headers",
+                                       "")
+        if not origin or not want_method or not bucket:
+            return _error(400, "BadRequest", "not a CORS preflight")
+        headers = cors_evaluate(self._cors_rules(bucket), origin,
+                                want_method, want_headers)
+        if headers is None:
+            return _error(403, "AccessForbidden",
+                          "CORSResponse: no matching rule")
+        return 200, (b"", headers)
+
+    def _bucket_cors_op(self, req: Request, bucket: str):
+        path = self._bucket_path(bucket)
+        e = self.filer.find_entry(path)
+        if e is None:
+            return _error(404, "NoSuchBucket", bucket)
+        if req.method == "PUT":
+            try:
+                parse_cors_config(req.body)
+            except (ValueError, ET.ParseError) as err:
+                return _error(400, "MalformedXML", str(err))
+            e.extended["cors"] = req.body.decode()
+            self.filer.create_entry(e, create_parents=False)
+            return 200, b""
+        if req.method == "GET":
+            xml_text = e.extended.get("cors", "")
+            if not xml_text:
+                return _error(404, "NoSuchCORSConfiguration", bucket)
+            return 200, (xml_text.encode(), "application/xml")
+        if req.method == "DELETE":
+            e.extended.pop("cors", None)
+            self.filer.create_entry(e, create_parents=False)
+            return 204, b""
+        return _error(405, "MethodNotAllowed", req.method)
+
+    # -- versioning state (s3api_bucket_handlers.go) ----------------------
+
+    def _versioning_state(self, bucket: str) -> str:
+        e = self.filer.find_entry(self._bucket_path(bucket))
+        return (e.extended.get("versioning", "") if e else "") or ""
+
+    def _bucket_versioning_op(self, req: Request, bucket: str):
+        path = self._bucket_path(bucket)
+        e = self.filer.find_entry(path)
+        if e is None:
+            return _error(404, "NoSuchBucket", bucket)
+        if req.method == "PUT":
+            status = ""
+            try:
+                for el in ET.fromstring(req.body).iter():
+                    if el.tag.endswith("Status"):
+                        status = (el.text or "").strip()
+            except ET.ParseError as err:
+                return _error(400, "MalformedXML", str(err))
+            if status not in ("Enabled", "Suspended"):
+                return _error(400, "MalformedXML",
+                              f"bad versioning status {status!r}")
+            e.extended["versioning"] = status
+            self.filer.create_entry(e, create_parents=False)
+            return 200, b""
+        if req.method == "GET":
+            root = ET.Element("VersioningConfiguration", xmlns=S3_NS)
+            state = self._versioning_state(bucket)
+            if state:
+                _elem(root, "Status", state)
+            return 200, (_xml(root), "application/xml")
+        return _error(405, "MethodNotAllowed", req.method)
 
     # -- buckets ----------------------------------------------------------
 
@@ -109,6 +270,14 @@ class S3ApiServer:
 
     def _bucket_op(self, req: Request, bucket: str):
         path = self._bucket_path(bucket)
+        if "versioning" in req.query:
+            return self._bucket_versioning_op(req, bucket)
+        if "cors" in req.query:
+            return self._bucket_cors_op(req, bucket)
+        if "versions" in req.query and req.method == "GET":
+            if self.filer.find_entry(path) is None:
+                return _error(404, "NoSuchBucket", bucket)
+            return self._list_versions(req, bucket)
         if req.method == "PUT":
             self.filer.create_entry(Entry(path, is_directory=True))
             return 200, b""
@@ -138,45 +307,294 @@ class S3ApiServer:
     def _object_op(self, req: Request, bucket: str, key: str):
         if self.filer.find_entry(self._bucket_path(bucket)) is None:
             return _error(404, "NoSuchBucket", bucket)
+        if any(seg.endswith(VERSIONS_EXT)
+               for seg in key.split("/") if seg):
+            # the version-archive namespace is reserved
+            # (s3_constants.VersionsFolder)
+            return _error(400, "InvalidArgument",
+                          f"key may not contain a segment ending "
+                          f"{VERSIONS_EXT}")
         if "uploads" in req.query and req.method == "POST":
             return self._initiate_multipart(bucket, key)
         if "uploadId" in req.query:
             return self._multipart_op(req, bucket, key)
         path = f"{self._bucket_path(bucket)}/{key}"
+        state = self._versioning_state(bucket)
         if req.method == "PUT":
             src = req.headers.get("x-amz-copy-source")
             if src:
-                return self._copy_object(req, src, path)
-            etag = hashlib.md5(req.body).hexdigest()
-            entry = self.filer.write_file(
-                path, req.body,
-                mime=req.headers.get("Content-Type", ""))
-            entry.extended["etag"] = etag
-            amz = {k: v for k, v in req.headers.items()
-                   if k.lower().startswith("x-amz-meta-")}
-            entry.extended.update(amz)
-            self.filer.create_entry(entry)
-            return 200, (b"", {"ETag": f'"{etag}"'})
-        entry = self.filer.find_entry(path)
+                return self._copy_object(req, src, path, bucket)
+            with self._path_lock(path):
+                vid = self._pre_write_archive(path, state)
+                etag = hashlib.md5(req.body).hexdigest()
+                entry = self.filer.write_file(
+                    path, req.body,
+                    mime=req.headers.get("Content-Type", ""))
+                entry.extended["etag"] = etag
+                if vid is not None:
+                    entry.extended["versionId"] = vid
+                amz = {k: v for k, v in req.headers.items()
+                       if k.lower().startswith("x-amz-meta-")}
+                entry.extended.update(amz)
+                self.filer.create_entry(entry)
+            headers = {"ETag": f'"{etag}"'}
+            if vid:
+                headers["x-amz-version-id"] = vid
+            return 200, (b"", headers)
         if req.method in ("GET", "HEAD"):
-            if entry is None or entry.is_directory:
-                return _error(404, "NoSuchKey", key)
-            data = b"" if req.method == "HEAD" else \
-                self.filer.read_file(path)
-            etag = entry.extended.get("etag", "")
-            mime = entry.attributes.mime or "application/octet-stream"
-            return 200, (data, {"Content-Type": mime,
-                                "ETag": f'"{etag}"',
-                                "Content-Length":
-                                    str(total_size(entry.chunks)),
-                                "Last-Modified": _iso(
-                                    entry.attributes.mtime)})
+            return self._get_object(req, bucket, key, path)
         if req.method == "DELETE":
-            if entry is not None:
-                self.filer.delete_entry(path)
-                self._prune_empty_dirs(path, bucket)
-            return 204, b""
+            return self._delete_object(req, bucket, key, path, state)
         return _error(405, "MethodNotAllowed", req.method)
+
+    # -- versioning core (s3api_object_versioning.go) ---------------------
+
+    def _pre_write_archive(self, path: str, state: str) -> str | None:
+        """Before a plain-path write: archive the current entry into
+        `<key>.versions/` according to the bucket's versioning state.
+        Returns the new content's version id (None = unversioned).
+
+        Enabled: always archive the incumbent (its chunks move with the
+        rename — never deleted), new content gets a fresh id.
+        Suspended: a real-id incumbent is archived, a 'null' incumbent
+        is simply overwritten; new content is the 'null' version."""
+        if state == "Enabled":
+            self._archive_current(path)
+            return new_version_id()
+        if state == "Suspended":
+            cur = self.filer.find_entry(path)
+            if cur is not None and not cur.is_directory and \
+                    cur.extended.get("versionId", "null") != "null":
+                self._archive_current(path)
+            return "null"
+        return None
+
+    def _archive_current(self, path: str) -> None:
+        cur = self.filer.find_entry(path)
+        if cur is None or cur.is_directory:
+            return
+        vid = cur.extended.get("versionId", "null")
+        cur.extended["versionId"] = vid
+        self.filer.create_entry(cur, create_parents=False)
+        self.filer.rename(path, f"{path}{VERSIONS_EXT}/{vid}")
+
+    @staticmethod
+    def _recency_key(e: Entry):
+        """Version recency: newest first.  mtime is the truth — the
+        inverted-timestamp id gives lexical newest-first for Enabled-era
+        versions, but the suspended-era 'null' id sorts after every hex
+        id and would otherwise always rank oldest (letting a
+        null-marker-deleted object resurrect)."""
+        return (-e.attributes.mtime, e.name)
+
+    def _promote_latest(self, path: str) -> None:
+        """After a specific-version delete: if the plain path is gone
+        and the newest surviving archived version is REAL, it becomes
+        the plain entry again (AWS latest-version semantics)."""
+        if self.filer.find_entry(path) is not None:
+            return
+        vdir = path + VERSIONS_EXT
+        versions = [e for e in self.filer.list_directory(vdir)
+                    if not e.is_directory]
+        if not versions:
+            if self.filer.find_entry(vdir) is not None:
+                self.filer.delete_entry(vdir, recursive=True)
+            return
+        newest = min(versions, key=self._recency_key)
+        if newest.extended.get("deleteMarker") == "true":
+            return
+        self.filer.rename(f"{vdir}/{newest.name}", path)
+
+    def _serve_entry(self, req: Request, path: str, entry: Entry):
+        data = b"" if req.method == "HEAD" else \
+            self.filer.read_file(path)
+        etag = entry.extended.get("etag", "")
+        mime = entry.attributes.mime or "application/octet-stream"
+        headers = {"Content-Type": mime,
+                   "ETag": f'"{etag}"',
+                   "Content-Length": str(total_size(entry.chunks)),
+                   "Last-Modified": _iso(entry.attributes.mtime)}
+        vid = entry.extended.get("versionId")
+        if vid:
+            headers["x-amz-version-id"] = vid
+        return 200, (data, headers)
+
+    def _get_object(self, req: Request, bucket: str, key: str,
+                    path: str):
+        vid = req.query.get("versionId", "")
+        if vid:
+            entry = self.filer.find_entry(path)
+            if entry is not None and \
+                    entry.extended.get("versionId", "null") == vid:
+                return self._serve_entry(req, path, entry)
+            vpath = f"{path}{VERSIONS_EXT}/{vid}"
+            entry = self.filer.find_entry(vpath)
+            if entry is None:
+                return _error(404, "NoSuchVersion", vid)
+            if entry.extended.get("deleteMarker") == "true":
+                # GET on a delete marker: 405 (AWS behavior)
+                return 405, (b"", {"x-amz-delete-marker": "true",
+                                   "x-amz-version-id": vid,
+                                   "Allow": "DELETE"})
+            return self._serve_entry(req, vpath, entry)
+        entry = self.filer.find_entry(path)
+        if entry is None or entry.is_directory:
+            newest = self._newest_version(path)
+            if newest is not None and \
+                    newest.extended.get("deleteMarker") == "true":
+                return 404, (_error(404, "NoSuchKey", key)[1][0],
+                             {"x-amz-delete-marker": "true",
+                              "Content-Type": "application/xml"})
+            return _error(404, "NoSuchKey", key)
+        return self._serve_entry(req, path, entry)
+
+    def _newest_version(self, path: str) -> Entry | None:
+        versions = [e for e in
+                    self.filer.list_directory(path + VERSIONS_EXT)
+                    if not e.is_directory]
+        return min(versions, key=self._recency_key) if versions \
+            else None
+
+    def _delete_object(self, req: Request, bucket: str, key: str,
+                       path: str, state: str):
+        with self._path_lock(path):
+            return self._delete_object_locked(req, bucket, key, path,
+                                              state)
+
+    def _delete_object_locked(self, req: Request, bucket: str,
+                              key: str, path: str, state: str):
+        vid = req.query.get("versionId", "")
+        if vid:
+            return self._delete_specific_version(bucket, path, vid)
+        if state in ("Enabled", "Suspended"):
+            # archive the incumbent and leave a delete marker
+            # (createDeleteMarker, s3api_object_versioning.go:160)
+            cur = self.filer.find_entry(path)
+            if cur is not None and not cur.is_directory:
+                if state == "Suspended" and \
+                        cur.extended.get("versionId", "null") == "null":
+                    self.filer.delete_entry(path)
+                else:
+                    self._archive_current(path)
+            marker_vid = new_version_id() if state == "Enabled" \
+                else "null"
+            mpath = f"{path}{VERSIONS_EXT}/{marker_vid}"
+            if self.filer.find_entry(mpath) is not None:
+                self.filer.delete_entry(mpath)
+            marker = Entry(mpath)
+            marker.extended["deleteMarker"] = "true"
+            marker.extended["versionId"] = marker_vid
+            self.filer.create_entry(marker)
+            return 204, (b"", {"x-amz-delete-marker": "true",
+                               "x-amz-version-id": marker_vid})
+        entry = self.filer.find_entry(path)
+        if entry is not None:
+            self.filer.delete_entry(path)
+            self._prune_empty_dirs(path, bucket)
+        return 204, b""
+
+    def _delete_specific_version(self, bucket: str, path: str,
+                                 vid: str):
+        was_marker = False
+        cur = self.filer.find_entry(path)
+        if cur is not None and not cur.is_directory and \
+                cur.extended.get("versionId", "null") == vid:
+            self.filer.delete_entry(path)
+        else:
+            vpath = f"{path}{VERSIONS_EXT}/{vid}"
+            e = self.filer.find_entry(vpath)
+            if e is not None:
+                was_marker = e.extended.get("deleteMarker") == "true"
+                self.filer.delete_entry(vpath)
+        self._promote_latest(path)
+        self._prune_empty_dirs(path, bucket)
+        headers = {"x-amz-version-id": vid}
+        if was_marker:
+            headers["x-amz-delete-marker"] = "true"
+        return 204, (b"", headers)
+
+    # -- ListObjectVersions (GET /bucket?versions) ------------------------
+
+    def _list_versions(self, req: Request, bucket: str):
+        """s3api_object_versioning.go listObjectVersions.  Collected
+        per key (latest first), emitted in key order; supports prefix +
+        max-keys truncation with key/version markers."""
+        prefix = req.query.get("prefix", "")
+        max_keys = int(req.query.get("max-keys", 1000))
+        key_marker = req.query.get("key-marker", "")
+        vid_marker = req.query.get("version-id-marker", "")
+        base = self._bucket_path(bucket)
+        per_key: dict[str, list[Entry]] = {}
+
+        def walk(dir_path: str, key_prefix: str):
+            if prefix and not (key_prefix.startswith(prefix) or
+                               prefix.startswith(key_prefix)):
+                return
+            for e in self.filer.list_directory(dir_path,
+                                               limit=1_000_000):
+                if e.is_directory:
+                    if e.name.endswith(VERSIONS_EXT):
+                        obj_key = key_prefix + \
+                            e.name[:-len(VERSIONS_EXT)]
+                        if obj_key.startswith(prefix):
+                            vs = [v for v in self.filer.list_directory(
+                                f"{dir_path}/{e.name}")
+                                if not v.is_directory]
+                            per_key.setdefault(obj_key, []).extend(
+                                sorted(vs, key=self._recency_key))
+                    elif not (key_prefix == "" and
+                              e.name == UPLOADS_DIR[1:]):
+                        walk(f"{dir_path}/{e.name}",
+                             key_prefix + e.name + "/")
+                else:
+                    obj_key = key_prefix + e.name
+                    if obj_key.startswith(prefix):
+                        per_key.setdefault(obj_key, []).insert(0, e)
+
+        walk(base, "")
+        root = ET.Element("ListVersionsResult", xmlns=S3_NS)
+        _elem(root, "Name", bucket)
+        _elem(root, "Prefix", prefix)
+        _elem(root, "MaxKeys", max_keys)
+        count = 0
+        truncated = False
+        skipping = bool(key_marker)
+        for obj_key in sorted(per_key):
+            if key_marker and obj_key < key_marker:
+                continue
+            if key_marker and obj_key == key_marker and \
+                    not vid_marker:
+                # key-marker alone means "begin AFTER this key"
+                continue
+            for i, e in enumerate(per_key[obj_key]):
+                e_vid = e.extended.get("versionId", "null")
+                if skipping and obj_key == key_marker:
+                    if e_vid == vid_marker:
+                        skipping = False
+                    continue  # markers are exclusive
+                if count >= max_keys:
+                    truncated = True
+                    _elem(root, "NextKeyMarker", obj_key)
+                    _elem(root, "NextVersionIdMarker", e_vid)
+                    break
+                is_marker = e.extended.get("deleteMarker") == "true"
+                v = _elem(root,
+                          "DeleteMarker" if is_marker else "Version")
+                _elem(v, "Key", obj_key)
+                _elem(v, "VersionId", e_vid)
+                _elem(v, "IsLatest",
+                      "true" if i == 0 else "false")
+                _elem(v, "LastModified", _iso(e.attributes.mtime))
+                if not is_marker:
+                    _elem(v, "ETag",
+                          f'"{e.extended.get("etag", "")}"')
+                    _elem(v, "Size", total_size(e.chunks))
+                    _elem(v, "StorageClass", "STANDARD")
+                count += 1
+            if truncated:
+                break
+        _elem(root, "IsTruncated", "true" if truncated else "false")
+        return 200, (_xml(root), "application/xml")
 
     def _prune_empty_dirs(self, path: str, bucket: str) -> None:
         """Remove now-empty parent directories up to the bucket root
@@ -193,7 +611,8 @@ class S3ApiServer:
                 break  # concurrent PUT repopulated it — keep it
             parent = parent.rsplit("/", 1)[0]
 
-    def _copy_object(self, req: Request, src: str, dst_path: str):
+    def _copy_object(self, req: Request, src: str, dst_path: str,
+                     bucket: str):
         src = urllib.parse.unquote(src.lstrip("/"))
         src_path = f"{BUCKETS_ROOT}/{src}"
         entry = self.filer.find_entry(src_path)
@@ -201,27 +620,52 @@ class S3ApiServer:
             return _error(404, "NoSuchKey", src)
         data = self.filer.read_file(src_path)
         etag = hashlib.md5(data).hexdigest()
-        new = self.filer.write_file(dst_path, data,
-                                    mime=entry.attributes.mime)
-        new.extended["etag"] = etag
-        self.filer.create_entry(new)
+        with self._path_lock(dst_path):
+            vid = self._pre_write_archive(
+                dst_path, self._versioning_state(bucket))
+            new = self.filer.write_file(dst_path, data,
+                                        mime=entry.attributes.mime)
+            new.extended["etag"] = etag
+            if vid is not None:
+                new.extended["versionId"] = vid
+            self.filer.create_entry(new)
         root = ET.Element("CopyObjectResult", xmlns=S3_NS)
         _elem(root, "ETag", f'"{etag}"')
         _elem(root, "LastModified", _iso(time.time()))
-        return 200, (_xml(root), "application/xml")
+        resp = 200, (_xml(root), "application/xml")
+        return _with_headers(resp, {"x-amz-version-id": vid}) if vid \
+            else resp
 
     def _delete_objects(self, req: Request, bucket: str):
-        """POST /bucket?delete — batch delete."""
+        """POST /bucket?delete — batch delete (versioning-aware: each
+        key routes through the same delete path as single DELETE)."""
         root = ET.fromstring(req.body)
         result = ET.Element("DeleteResult", xmlns=S3_NS)
+        state = self._versioning_state(bucket)
         for obj in root.iter():
-            if obj.tag.endswith("Key"):
-                key = obj.text or ""
-                path = f"{self._bucket_path(bucket)}/{key}"
+            if not obj.tag.endswith("Object"):
+                continue
+            key = vid = ""
+            for child in obj:
+                if child.tag.endswith("Key"):
+                    key = child.text or ""
+                elif child.tag.endswith("VersionId"):
+                    vid = child.text or ""
+            if not key:
+                continue
+            path = f"{self._bucket_path(bucket)}/{key}"
+            if vid:
+                with self._path_lock(path):
+                    self._delete_specific_version(bucket, path, vid)
+            elif state in ("Enabled", "Suspended"):
+                self._delete_object(req, bucket, key, path, state)
+            else:
                 self.filer.delete_entry(path)
                 self._prune_empty_dirs(path, bucket)
-                d = _elem(result, "Deleted")
-                _elem(d, "Key", key)
+            d = _elem(result, "Deleted")
+            _elem(d, "Key", key)
+            if vid:
+                _elem(d, "VersionId", vid)
         return 200, (_xml(result), "application/xml")
 
     # -- ListObjectsV2 (s3api_objects_list_handlers.go) -------------------
@@ -267,9 +711,12 @@ class S3ApiServer:
                 return e.name + ("/" if e.is_directory else "")
             for e in sorted(page, key=eff):
                 if e.is_directory:
-                    # hide only the reserved multipart scratch dir at the
-                    # bucket root; dot-prefixed path segments are legal
-                    # S3 keys (e.g. ".well-known/acme")
+                    # hide the reserved multipart scratch dir at the
+                    # bucket root and version-archive dirs anywhere;
+                    # other dot-prefixed path segments are legal S3
+                    # keys (e.g. ".well-known/acme")
+                    if e.name.endswith(VERSIONS_EXT):
+                        continue
                     if not (key_prefix == "" and
                             e.name == UPLOADS_DIR[1:]):
                         yield from walk_sorted(
@@ -390,12 +837,17 @@ class S3ApiServer:
                                           c.e_tag, c.mtime_ns))
                 offset += total_size(p.chunks)
                 etags += bytes.fromhex(p.extended.get("etag", ""))
-            final = Entry(f"{self._bucket_path(bucket)}/{key}",
-                          chunks=chunks)
-            final_etag = (hashlib.md5(etags).hexdigest() +
-                          f"-{len(parts)}")
-            final.extended["etag"] = final_etag
-            self.filer.create_entry(final)
+            final_path = f"{self._bucket_path(bucket)}/{key}"
+            with self._path_lock(final_path):
+                vid = self._pre_write_archive(
+                    final_path, self._versioning_state(bucket))
+                final = Entry(final_path, chunks=chunks)
+                final_etag = (hashlib.md5(etags).hexdigest() +
+                              f"-{len(parts)}")
+                final.extended["etag"] = final_etag
+                if vid is not None:
+                    final.extended["versionId"] = vid
+                self.filer.create_entry(final)
             self.filer.delete_entry(updir, recursive=True,
                                     delete_chunks=False)
             root = ET.Element("CompleteMultipartUploadResult",
@@ -403,5 +855,7 @@ class S3ApiServer:
             _elem(root, "Bucket", bucket)
             _elem(root, "Key", key)
             _elem(root, "ETag", f'"{final_etag}"')
-            return 200, (_xml(root), "application/xml")
+            resp = 200, (_xml(root), "application/xml")
+            return _with_headers(resp, {"x-amz-version-id": vid}) \
+                if vid else resp
         return _error(405, "MethodNotAllowed", req.method)

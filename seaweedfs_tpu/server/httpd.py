@@ -103,6 +103,7 @@ class HttpServer:
                     self.wfile.write(body)
 
             do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _dispatch
+            do_OPTIONS = _dispatch  # CORS preflight (S3 gateway)
 
             def log_message(self, *args):  # quiet
                 pass

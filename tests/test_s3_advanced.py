@@ -1,0 +1,449 @@
+"""S3 depth tests: presigned URLs, streaming-chunked SigV4 uploads,
+object versioning, CORS — per-feature suites mirroring the reference's
+test/s3/{presigned,versioning,cors} scenarios (VERDICT r2 Next #2)."""
+
+import hashlib
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.s3 import S3ApiServer
+from seaweedfs_tpu.s3.auth import (AuthContext, presign_url,
+                                   sign_request, signing_key,
+                                   STREAMING_PAYLOAD)
+from seaweedfs_tpu.s3.chunked import (ChunkedDecodeError,
+                                      decode_streaming_body,
+                                      encode_streaming_body)
+from seaweedfs_tpu.s3.cors import evaluate, parse_cors_config
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.httpd import http_bytes
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+AK, SK = "AKIDEXAMPLE", "secretkey123"
+CREDS = {AK: SK}
+
+
+@pytest.fixture
+def s3(tmp_path):
+    master = MasterServer().start()
+    servers = [VolumeServer([str(tmp_path / f"v{i}")], master.url,
+                            pulse_seconds=0.3).start() for i in range(2)]
+    time.sleep(0.5)
+    filer = FilerServer(master.url).start()
+    gw = S3ApiServer(filer.filer, credentials=CREDS).start()
+    yield gw
+    gw.stop()
+    filer.stop()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def s3req(gw, method, path, body=b"", query=None, headers=None,
+          unsigned=False):
+    query = query or {}
+    headers = headers or {}
+    if not unsigned:
+        headers = sign_request(method, gw.url, path, query, headers,
+                               body, AK, SK)
+    qs = urllib.parse.urlencode(query)
+    from seaweedfs_tpu.s3.auth import uri_encode
+    wire = uri_encode(path, encode_slash=False)
+    url = f"{gw.url}{wire}" + (f"?{qs}" if qs else "")
+    return http_bytes(method, url, body if body else None, headers)
+
+
+# --- presigned URLs ------------------------------------------------------
+
+def test_presigned_get_and_put(s3):
+    s3req(s3, "PUT", "/pb")
+    s3req(s3, "PUT", "/pb/o.txt", b"presigned!")
+    url = presign_url("GET", s3.url, "/pb/o.txt", {}, AK, SK)
+    status, body, _ = http_bytes("GET", url)
+    assert status == 200 and body == b"presigned!"
+    # presigned PUT
+    url = presign_url("PUT", s3.url, "/pb/new.txt", {}, AK, SK)
+    status, _, _ = http_bytes("PUT", url, b"uploaded-via-url")
+    assert status == 200
+    status, body, _ = s3req(s3, "GET", "/pb/new.txt")
+    assert body == b"uploaded-via-url"
+
+
+def test_presigned_bad_signature_rejected(s3):
+    s3req(s3, "PUT", "/pb2")
+    s3req(s3, "PUT", "/pb2/o.txt", b"x")
+    url = presign_url("GET", s3.url, "/pb2/o.txt", {}, AK, SK)
+    tampered = url[:-4] + "0000"
+    status, body, _ = http_bytes("GET", tampered)
+    assert status == 403
+    # tampering the PATH invalidates too
+    url2 = presign_url("GET", s3.url, "/pb2/o.txt", {}, AK, SK)
+    other = url2.replace("/o.txt", "/other.txt")
+    assert http_bytes("GET", other)[0] == 403
+
+
+def test_presigned_expiry(s3):
+    s3req(s3, "PUT", "/pb3")
+    s3req(s3, "PUT", "/pb3/o.txt", b"x")
+    old = time.strftime("%Y%m%dT%H%M%SZ",
+                        time.gmtime(time.time() - 7200))
+    url = presign_url("GET", s3.url, "/pb3/o.txt", {}, AK, SK,
+                      expires=60, amz_date=old)
+    status, body, _ = http_bytes("GET", url)
+    assert status == 403 and b"expired" in body.lower()
+    assert http_bytes(
+        "GET", presign_url("GET", s3.url, "/pb3/o.txt", {}, AK, SK,
+                           expires=3600))[0] == 200
+
+
+def test_presigned_unknown_key_rejected(s3):
+    url = presign_url("GET", s3.url, "/x/y", {}, "NOSUCHKEY", "nope")
+    assert http_bytes("GET", url)[0] == 403
+
+
+# --- streaming-chunked sigv4 (chunked_reader_v4.go) ----------------------
+
+def _chunked_put(gw, path, payload, chunk_size=8192, corrupt=False):
+    """Sign a STREAMING-AWS4-HMAC-SHA256-PAYLOAD PUT like an SDK."""
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    date = amz_date[:8]
+    scope = f"{date}/us-east-1/s3/aws4_request"
+    headers = {
+        "x-amz-content-sha256": STREAMING_PAYLOAD,
+        "content-encoding": "aws-chunked",
+        "x-amz-decoded-content-length": str(len(payload)),
+    }
+    signed = sign_request("PUT", gw.url, path, {}, headers, b"",
+                          AK, SK, amz_date=amz_date)
+    # sign_request overwrote the payload hash header with sha256(b"");
+    # redo it the streaming way: hash constant goes into the canonical
+    # request, seed signature comes out of Authorization
+    headers["x-amz-date"] = amz_date
+    headers["x-amz-content-sha256"] = STREAMING_PAYLOAD
+    from seaweedfs_tpu.s3.auth import (canonical_request,
+                                       string_to_sign, uri_encode)
+    import hmac as hmac_mod
+    hl = {k.lower(): v for k, v in headers.items()}
+    hl["host"] = gw.url
+    signed_list = sorted(h for h in hl
+                         if h in ("host", "content-type") or
+                         h.startswith("x-amz-"))
+    creq = canonical_request("PUT", uri_encode(path, False), {}, hl,
+                             signed_list, STREAMING_PAYLOAD)
+    sts = string_to_sign(amz_date, scope, creq)
+    key = signing_key(SK, date, "us-east-1")
+    seed = hmac_mod.new(key, sts.encode(), "sha256").hexdigest()
+    hl["authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={AK}/{scope}, "
+        f"SignedHeaders={';'.join(signed_list)}, Signature={seed}")
+    ctx = AuthContext(AK, seed, key, amz_date, scope,
+                      STREAMING_PAYLOAD)
+    body = encode_streaming_body(payload, ctx, chunk_size)
+    if corrupt:
+        body = body.replace(payload[:4], b"EVIL", 1)
+    return http_bytes("PUT", f"{gw.url}{path}", body, hl)
+
+
+def test_streaming_chunked_upload_roundtrip(s3):
+    s3req(s3, "PUT", "/cb")
+    payload = bytes(range(256)) * 200  # 51200 bytes, several chunks
+    status, body, _ = _chunked_put(s3, "/cb/streamed.bin", payload)
+    assert status == 200, body
+    status, got, _ = s3req(s3, "GET", "/cb/streamed.bin")
+    assert got == payload, "aws-chunked framing leaked into content"
+
+
+def test_streaming_chunked_tampered_rejected(s3):
+    s3req(s3, "PUT", "/cb2")
+    payload = b"sensitive-data" * 1000
+    status, body, _ = _chunked_put(s3, "/cb2/x.bin", payload,
+                                   corrupt=True)
+    assert status == 403 and b"SignatureDoesNotMatch" in body
+    assert s3req(s3, "GET", "/cb2/x.bin")[0] == 404
+
+
+def test_chunked_codec_unit():
+    key = signing_key("secret", "20260729", "us-east-1")
+    ctx = AuthContext("id", "0" * 64, key, "20260729T000000Z",
+                      "20260729/us-east-1/s3/aws4_request",
+                      STREAMING_PAYLOAD)
+    payload = b"abc" * 10000
+    wire = encode_streaming_body(payload, ctx, chunk_size=1000)
+    assert decode_streaming_body(wire, ctx) == payload
+    with pytest.raises(ChunkedDecodeError):
+        decode_streaming_body(wire[:-10], ctx)  # truncated
+    bad = bytearray(wire)
+    bad[len(bad) // 2] ^= 1
+    with pytest.raises(ChunkedDecodeError):
+        decode_streaming_body(bytes(bad), ctx)
+
+
+# --- versioning ----------------------------------------------------------
+
+def _enable_versioning(gw, bucket, status="Enabled"):
+    xml = (f'<VersioningConfiguration><Status>{status}</Status>'
+           f'</VersioningConfiguration>').encode()
+    st, body, _ = s3req(gw, "PUT", f"/{bucket}", xml,
+                        query={"versioning": ""})
+    assert st == 200, body
+
+
+def test_versioning_state_roundtrip(s3):
+    s3req(s3, "PUT", "/vb0")
+    st, body, _ = s3req(s3, "GET", "/vb0", query={"versioning": ""})
+    assert st == 200 and b"Status" not in body  # unversioned: empty
+    _enable_versioning(s3, "vb0")
+    st, body, _ = s3req(s3, "GET", "/vb0", query={"versioning": ""})
+    assert b"<Status>Enabled</Status>" in body
+
+
+def test_versioned_put_get_overwrite(s3):
+    s3req(s3, "PUT", "/vb")
+    _enable_versioning(s3, "vb")
+    st, _, h1 = s3req(s3, "PUT", "/vb/k.txt", b"v1")
+    vid1 = h1["x-amz-version-id"]
+    st, _, h2 = s3req(s3, "PUT", "/vb/k.txt", b"v2")
+    vid2 = h2["x-amz-version-id"]
+    assert vid1 != vid2
+    # latest
+    st, body, h = s3req(s3, "GET", "/vb/k.txt")
+    assert body == b"v2" and h["x-amz-version-id"] == vid2
+    # specific versions both readable
+    st, body, _ = s3req(s3, "GET", "/vb/k.txt",
+                        query={"versionId": vid1})
+    assert st == 200 and body == b"v1"
+    st, body, _ = s3req(s3, "GET", "/vb/k.txt",
+                        query={"versionId": vid2})
+    assert body == b"v2"
+    assert s3req(s3, "GET", "/vb/k.txt",
+                 query={"versionId": "nonexistent"})[0] == 404
+
+
+def test_versioned_delete_marker_and_restore(s3):
+    s3req(s3, "PUT", "/vb2")
+    _enable_versioning(s3, "vb2")
+    _, _, h = s3req(s3, "PUT", "/vb2/k.txt", b"data")
+    vid = h["x-amz-version-id"]
+    # simple delete -> delete marker, object 404s but version survives
+    st, _, dh = s3req(s3, "DELETE", "/vb2/k.txt")
+    assert st == 204 and dh["x-amz-delete-marker"] == "true"
+    marker_vid = dh["x-amz-version-id"]
+    st, _, gh = s3req(s3, "GET", "/vb2/k.txt")
+    assert st == 404 and gh.get("x-amz-delete-marker") == "true"
+    st, body, _ = s3req(s3, "GET", "/vb2/k.txt",
+                        query={"versionId": vid})
+    assert st == 200 and body == b"data"
+    # deleting the marker restores the object (AWS 'undelete')
+    st, _, _ = s3req(s3, "DELETE", "/vb2/k.txt",
+                     query={"versionId": marker_vid})
+    assert st == 204
+    st, body, _ = s3req(s3, "GET", "/vb2/k.txt")
+    assert st == 200 and body == b"data"
+
+
+def test_delete_specific_version_promotes_previous(s3):
+    s3req(s3, "PUT", "/vb3")
+    _enable_versioning(s3, "vb3")
+    _, _, h1 = s3req(s3, "PUT", "/vb3/k", b"old")
+    _, _, h2 = s3req(s3, "PUT", "/vb3/k", b"new")
+    # delete the LATEST specific version -> previous becomes latest
+    st, _, _ = s3req(s3, "DELETE", "/vb3/k",
+                     query={"versionId": h2["x-amz-version-id"]})
+    assert st == 204
+    st, body, h = s3req(s3, "GET", "/vb3/k")
+    assert st == 200 and body == b"old"
+    assert h["x-amz-version-id"] == h1["x-amz-version-id"]
+
+
+def test_list_object_versions(s3):
+    s3req(s3, "PUT", "/vb4")
+    _enable_versioning(s3, "vb4")
+    s3req(s3, "PUT", "/vb4/a.txt", b"a1")
+    s3req(s3, "PUT", "/vb4/a.txt", b"a2")
+    s3req(s3, "PUT", "/vb4/b.txt", b"b1")
+    s3req(s3, "DELETE", "/vb4/b.txt")
+    st, body, _ = s3req(s3, "GET", "/vb4", query={"versions": ""})
+    assert st == 200
+    NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+    root = ET.fromstring(body)
+    versions = [el for el in root if el.tag == f"{NS}Version"]
+    markers = [el for el in root if el.tag == f"{NS}DeleteMarker"]
+    keys = [v.find(f"{NS}Key").text for v in versions]
+    assert keys.count("a.txt") == 2 and keys.count("b.txt") == 1
+    assert len(markers) == 1
+    # newest-first per key; IsLatest on the newest only
+    a_versions = [v for v in versions
+                  if v.find(f"{NS}Key").text == "a.txt"]
+    assert [v.find(f"{NS}IsLatest").text for v in a_versions] == \
+        ["true", "false"]
+
+
+def test_versioned_objects_hidden_from_normal_listing(s3):
+    s3req(s3, "PUT", "/vb5")
+    _enable_versioning(s3, "vb5")
+    s3req(s3, "PUT", "/vb5/k", b"1")
+    s3req(s3, "PUT", "/vb5/k", b"2")
+    st, body, _ = s3req(s3, "GET", "/vb5")
+    root = ET.fromstring(body)
+    keys = [c.find(f"{{{root.tag.split('}')[0][1:]}}}Key").text
+            for c in root
+            if c.tag.endswith("Contents")]
+    assert keys == ["k"], f"archived versions leaked: {keys}"
+
+
+def test_suspended_versioning_null_version(s3):
+    s3req(s3, "PUT", "/vb6")
+    _enable_versioning(s3, "vb6")
+    _, _, h1 = s3req(s3, "PUT", "/vb6/k", b"real")
+    _enable_versioning(s3, "vb6", "Suspended")
+    _, _, h2 = s3req(s3, "PUT", "/vb6/k", b"null-1")
+    assert h2["x-amz-version-id"] == "null"
+    # overwriting the null version archives nothing new
+    s3req(s3, "PUT", "/vb6/k", b"null-2")
+    st, body, _ = s3req(s3, "GET", "/vb6/k")
+    assert body == b"null-2"
+    # the real version from the Enabled era survives
+    st, body, _ = s3req(s3, "GET", "/vb6/k",
+                        query={"versionId": h1["x-amz-version-id"]})
+    assert st == 200 and body == b"real"
+
+
+def test_suspended_null_marker_not_resurrected(s3):
+    """Recency must rank the suspended-era 'null' delete marker newest
+    (it sorts lexically AFTER hex ids — ordering by id would let the
+    deleted object resurrect via _promote_latest)."""
+    s3req(s3, "PUT", "/vb8")
+    _enable_versioning(s3, "vb8")
+    _, _, h1 = s3req(s3, "PUT", "/vb8/k", b"v1")
+    _, _, h2 = s3req(s3, "PUT", "/vb8/k", b"v2")
+    _enable_versioning(s3, "vb8", "Suspended")
+    st, _, dh = s3req(s3, "DELETE", "/vb8/k")
+    assert dh["x-amz-delete-marker"] == "true"
+    assert s3req(s3, "GET", "/vb8/k")[0] == 404
+    # permanently delete v2: the null MARKER is still newest, so the
+    # object must stay deleted (not promote v1)
+    st, _, _ = s3req(s3, "DELETE", "/vb8/k",
+                     query={"versionId": h2["x-amz-version-id"]})
+    assert st == 204
+    st, _, gh = s3req(s3, "GET", "/vb8/k")
+    assert st == 404 and gh.get("x-amz-delete-marker") == "true"
+    # removing the marker then exposes v1
+    s3req(s3, "DELETE", "/vb8/k", query={"versionId": "null"})
+    st, body, _ = s3req(s3, "GET", "/vb8/k")
+    assert st == 200 and body == b"v1"
+
+
+def test_batch_delete_specific_versions(s3):
+    s3req(s3, "PUT", "/vb9")
+    _enable_versioning(s3, "vb9")
+    _, _, h1 = s3req(s3, "PUT", "/vb9/k", b"v1")
+    _, _, h2 = s3req(s3, "PUT", "/vb9/k", b"v2")
+    vid1 = h1["x-amz-version-id"]
+    xml = (f"<Delete><Object><Key>k</Key><VersionId>{vid1}"
+           f"</VersionId></Object></Delete>").encode()
+    st, body, _ = s3req(s3, "POST", "/vb9", xml,
+                        query={"delete": ""})
+    assert st == 200 and vid1.encode() in body
+    # v1 permanently gone; latest unaffected; NO delete marker created
+    assert s3req(s3, "GET", "/vb9/k",
+                 query={"versionId": vid1})[0] == 404
+    st, body, _ = s3req(s3, "GET", "/vb9/k")
+    assert st == 200 and body == b"v2"
+
+
+def test_version_namespace_key_rejected(s3):
+    s3req(s3, "PUT", "/vb7")
+    st, body, _ = s3req(s3, "PUT", "/vb7/evil.versions/x", b"d")
+    assert st == 400
+
+
+# --- CORS ----------------------------------------------------------------
+
+CORS_XML = b"""<CORSConfiguration>
+  <CORSRule>
+    <AllowedOrigin>https://app.example</AllowedOrigin>
+    <AllowedMethod>GET</AllowedMethod>
+    <AllowedMethod>PUT</AllowedMethod>
+    <AllowedHeader>*</AllowedHeader>
+    <ExposeHeader>ETag</ExposeHeader>
+    <MaxAgeSeconds>1200</MaxAgeSeconds>
+  </CORSRule>
+  <CORSRule>
+    <AllowedOrigin>*</AllowedOrigin>
+    <AllowedMethod>GET</AllowedMethod>
+  </CORSRule>
+</CORSConfiguration>"""
+
+
+def test_cors_config_roundtrip(s3):
+    s3req(s3, "PUT", "/cors1")
+    assert s3req(s3, "GET", "/cors1",
+                 query={"cors": ""})[0] == 404
+    st, body, _ = s3req(s3, "PUT", "/cors1", CORS_XML,
+                        query={"cors": ""})
+    assert st == 200, body
+    st, body, _ = s3req(s3, "GET", "/cors1", query={"cors": ""})
+    assert st == 200 and b"AllowedOrigin" in body
+    assert s3req(s3, "DELETE", "/cors1", query={"cors": ""})[0] == 204
+    assert s3req(s3, "GET", "/cors1", query={"cors": ""})[0] == 404
+
+
+def test_cors_preflight(s3):
+    s3req(s3, "PUT", "/cors2")
+    s3req(s3, "PUT", "/cors2", CORS_XML, query={"cors": ""})
+    st, _, h = http_bytes(
+        "OPTIONS", f"{s3.url}/cors2/some/key", None,
+        {"Origin": "https://app.example",
+         "Access-Control-Request-Method": "PUT",
+         "Access-Control-Request-Headers": "content-type"})
+    assert st == 200
+    assert h["Access-Control-Allow-Origin"] == "https://app.example"
+    assert "PUT" in h["Access-Control-Allow-Methods"]
+    assert h["Access-Control-Max-Age"] == "1200"
+    # disallowed method -> 403
+    st, _, _ = http_bytes(
+        "OPTIONS", f"{s3.url}/cors2/k", None,
+        {"Origin": "https://app.example",
+         "Access-Control-Request-Method": "DELETE"})
+    assert st == 403
+    # wildcard rule matches any origin for GET
+    st, _, h = http_bytes(
+        "OPTIONS", f"{s3.url}/cors2/k", None,
+        {"Origin": "https://elsewhere.example",
+         "Access-Control-Request-Method": "GET"})
+    assert st == 200
+    assert h["Access-Control-Allow-Origin"] == "*"
+
+
+def test_cors_actual_request_headers(s3):
+    s3req(s3, "PUT", "/cors3")
+    s3req(s3, "PUT", "/cors3", CORS_XML, query={"cors": ""})
+    s3req(s3, "PUT", "/cors3/o.txt", b"data")
+    headers = sign_request("GET", s3.url, "/cors3/o.txt", {},
+                           {"Origin": "https://app.example"}, b"",
+                           AK, SK)
+    # Origin is not a signed header class; add it raw
+    headers["Origin"] = "https://app.example"
+    st, body, h = http_bytes("GET", f"{s3.url}/cors3/o.txt", None,
+                             headers)
+    assert st == 200
+    assert h["Access-Control-Allow-Origin"] == "https://app.example"
+    assert h["Access-Control-Expose-Headers"] == "ETag"
+    # no CORS headers without a matching rule (DELETE not allowed for
+    # that origin beyond GET/PUT)
+    st, _, h = http_bytes("OPTIONS", f"{s3.url}/cors3/o.txt", None,
+                          {"Origin": "https://app.example",
+                           "Access-Control-Request-Method": "PATCH"})
+    assert st == 403
+
+
+def test_cors_unit_rule_matching():
+    rules = parse_cors_config(CORS_XML)
+    assert evaluate(rules, "https://app.example", "PUT") is not None
+    assert evaluate(rules, "https://other", "PUT") is None
+    assert evaluate(rules, "https://other", "GET") is not None
+    with pytest.raises(ValueError):
+        parse_cors_config(b"<CORSConfiguration></CORSConfiguration>")
