@@ -68,6 +68,7 @@ class AdminServer:
         r("POST", "/worker/complete", self._complete)     # JobCompleted
         r("GET", "/maintenance/queue", self._queue)
         r("POST", "/maintenance/trigger_detection", self._trigger)
+        r("POST", "/maintenance/submit_job", self._submit_job)
         self._detect_thread: threading.Thread | None = None
         self._pending_detection: list[str] = []  # worker ids to ask
 
@@ -157,6 +158,45 @@ class AdminServer:
                 self._dedupe[key] = job.job_id
                 accepted.append(job.job_id)
         return 200, {"accepted": accepted}
+
+    def _submit_job(self, req: Request):
+        """Operator-submitted job (the analog of dispatching work from
+        the admin UI / shell rather than detection) — e.g. a
+        multi-volume batch EC job for the mesh-batched worker path."""
+        b = req.json()
+        job_type = b.get("jobType")
+        if not job_type:
+            return 400, {"error": "jobType required"}
+        params = b.get("params", {})
+        with self.lock:
+            # a job nobody can run would sit pending forever and wedge
+            # its dedupe key — refuse it at submit time
+            if not any(w.can(job_type) for w in self.workers.values()):
+                return 400, {"error": f"no registered worker has the "
+                                      f"{job_type!r} capability"}
+            key = b.get("dedupeKey") or uuid.uuid4().hex
+            # a batch EC job claims every per-volume key too, so it can
+            # never run concurrently with a detection-queued single-
+            # volume job for one of its members (the loser's unwind
+            # would delete the winner's mounted shards AFTER the
+            # original volume is gone — permanent data loss)
+            keys = [key]
+            if job_type == "erasure_coding" and \
+                    isinstance(params.get("volumeIds"), list):
+                keys += [f"ec:{int(v)}" for v in params["volumeIds"]]
+            for k in keys:
+                existing = self._dedupe.get(k)
+                if existing and self.jobs[existing].status in (
+                        "pending", "assigned"):
+                    return 409, {"error": f"conflicts with live job "
+                                          f"{existing} ({k})",
+                                 "jobId": existing, "deduped": True}
+            job = Job(job_id=uuid.uuid4().hex[:12], job_type=job_type,
+                      params=params, dedupe_key=key)
+            self.jobs[job.job_id] = job
+            for k in keys:
+                self._dedupe[k] = job.job_id
+        return 200, {"jobId": job.job_id}
 
     def _touch(self, worker_id: str) -> None:
         w = self.workers.get(worker_id)

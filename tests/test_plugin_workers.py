@@ -28,7 +28,10 @@ def harness(tmp_path):
     workdir = tmp_path / "worker"
     worker = PluginWorker(
         admin.url, master.url, str(workdir),
-        handlers=[EcEncodeHandler(fullness_ratio=0.5, backend="cpu"),
+        # jax backend: single-volume encodes AND the mesh-batched
+        # multi-volume path both run the TPU kernels (on the virtual
+        # CPU mesh in tests)
+        handlers=[EcEncodeHandler(fullness_ratio=0.5, backend="jax"),
                   VacuumHandler(garbage_threshold=0.2)],
         poll_wait=0.5).start()
     time.sleep(0.6)
@@ -116,3 +119,42 @@ def test_vacuum_detection(harness):
     assert vac and vac[0]["status"] == "done", jobs
     for fid in fids[4:]:
         assert operation.read(master.url, fid)
+
+
+def test_batch_ec_job_multi_volume(harness):
+    """VERDICT r2 Next #9: a multi-volume batch job runs the
+    mesh-batched encode path (parallel/ec_batch via execute_batch) and
+    leaves every volume EC'd, with all data readable."""
+    master, servers, admin, worker = harness
+    # pre-grow a second volume so uploads spread over >= 2 volumes
+    http_json("POST", f"{master.url}/vol/grow",
+              {"count": 2, "replication": "000"})
+    rng = np.random.default_rng(17)
+    blobs = {}
+    for _ in range(24):
+        data = rng.integers(0, 256, 50_000, dtype=np.uint8).tobytes()
+        fid = operation.submit(master.url, data)
+        blobs[fid] = data
+    vids = sorted({int(fid.split(",")[0]) for fid in blobs})
+    assert len(vids) >= 2, f"need >=2 volumes, got {vids}"
+    time.sleep(0.5)  # heartbeat refresh
+
+    r = http_json("POST", f"{admin.url}/maintenance/submit_job",
+                  {"jobType": "erasure_coding",
+                   "dedupeKey": f"ec-batch:{vids}",
+                   "params": {"volumeIds": vids}})
+    job_id = r["jobId"]
+    jobs = _wait_jobs_done(admin, timeout=60)
+    job = next(j for j in jobs if j["jobId"] == job_id)
+    assert job["status"] == "done", job
+    assert "batch" in job["message"] and "mesh" in job["message"]
+
+    time.sleep(0.5)
+    for vid in vids:
+        shard_locs = http_json(
+            "GET", f"{master.url}/dir/ec_lookup?volumeId={vid}")
+        total = sum(len(l["shardIds"])
+                    for l in shard_locs["shardIdLocations"])
+        assert total == 14, f"volume {vid}: {total} shards"
+    for fid, want in blobs.items():
+        assert operation.read(master.url, fid) == want, fid
