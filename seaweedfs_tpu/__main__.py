@@ -107,6 +107,8 @@ def main(argv: list[str] | None = None) -> int:
 
     sh = sub.add_parser("shell", help="interactive admin shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
+    sh.add_argument("-filer", default="",
+                    help="filer host:port for the fs.* command family")
     sh.add_argument("command", nargs="*",
                     help="run one command and exit")
 
@@ -226,7 +228,7 @@ def main(argv: list[str] | None = None) -> int:
             pass
     elif args.cmd == "shell":
         from .shell import CommandEnv, run_command
-        env = CommandEnv(args.master)
+        env = CommandEnv(args.master, filer=args.filer)
         if args.command:
             print(run_command(env, " ".join(args.command)))
             return 0

@@ -271,7 +271,9 @@ class EcEncodeHandler(JobHandler):
     def execute_batch(self, worker, job_id: str, params: dict) -> str:
         from ...parallel.ec_batch import encode_volume_files_batch
 
-        vids = [int(v) for v in params["volumeIds"]]
+        # dedupe while preserving order: a repeated id would append the
+        # same volume's rows twice into one set of shard files
+        vids = list(dict.fromkeys(int(v) for v in params["volumeIds"]))
         collection = params.get("collection", "")
         ctx = self._make_ctx(params, collection, 0)
         os.makedirs(worker.work_dir, exist_ok=True)

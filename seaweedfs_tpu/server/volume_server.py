@@ -72,6 +72,10 @@ class VolumeServer:
         r("POST", "/admin/ec/to_volume", self._ec_to_volume)
         r("GET", "/admin/ec/shard_read", self._ec_shard_read)
         r("GET", "/admin/ec/info", self._ec_info)
+        r("GET", "/admin/volume_index", self._volume_index)
+        r("POST", "/admin/delete_needle", self._admin_delete_needle)
+        r("GET", "/admin/needle_raw", self._needle_raw)
+        r("POST", "/admin/write_needle_raw", self._write_needle_raw)
         r("POST", "/admin/scrub", self._scrub)
         r("POST", "/admin/ec/scrub", self._ec_scrub)
         r("GET", "/metrics", self._metrics)
@@ -420,6 +424,80 @@ class VolumeServer:
         v.vacuum()
         return 200, {"garbageRatio": garbage}
 
+    def _volume_index(self, req: Request):
+        """Live needle inventory of one volume: [key, size] pairs after
+        replaying .idx delete semantics.  The repair plane
+        (volume.check.disk / volume.fsck, shell/command_volume_fsck.go
+        + command_volume_check_disk.go) diffs these across replicas or
+        against filer references."""
+        from ..storage import idx as idxmod
+        vid = int(req.query["volumeId"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return 404, {"error": f"volume {vid} not found"}
+        v.sync()
+        with open(v.file_name(".idx"), "rb") as f:
+            live = idxmod.live_entries(f.read())
+        return 200, {"volumeId": vid,
+                     "entries": sorted((k, s)
+                                       for k, (_o, s) in live.items())}
+
+    def _admin_delete_needle(self, req: Request):
+        """Tombstone one needle by key (no cookie: admin plane) — the
+        purge arm of volume.fsck (-reallyDeleteFromVolume)."""
+        b = req.json()
+        vid = int(b["volumeId"])
+        key = int(b["key"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return 404, {"error": f"volume {vid} not found"}
+        try:
+            n = v.read_needle(key)
+        except KeyError:
+            return 200, {"freed": 0}
+        try:
+            freed = v.delete_needle(n)
+        except PermissionError as e:
+            return 409, {"error": str(e)}
+        return 200, {"freed": freed}
+
+    def _needle_raw(self, req: Request):
+        """Serve one needle's full on-disk record (header..padding) —
+        the replica-repair copy unit (the reference syncs raw needles
+        between replicas in command_volume_check_disk.go)."""
+        vid = int(req.query["volumeId"])
+        key = int(req.query["key"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return 404, {"error": f"volume {vid} not found"}
+        try:
+            n = v.read_needle(key)
+        except KeyError as e:
+            return 404, {"error": str(e)}
+        return 200, (n.to_bytes(v.version),
+                     {"Content-Type": "application/octet-stream",
+                      "X-Needle-Version": str(v.version)})
+
+    def _write_needle_raw(self, req: Request):
+        """Append a raw needle record pulled from a healthy replica
+        (the receiving side of replica repair)."""
+        vid = int(req.query["volumeId"])
+        version = int(req.query.get("version", types.CURRENT_VERSION))
+        v = self.store.find_volume(vid)
+        if v is None:
+            return 404, {"error": f"volume {vid} not found"}
+        import struct
+        if len(req.body) < 16:
+            return 400, {"error": "needle record shorter than header"}
+        try:
+            n = Needle.parse_header(req.body[:16])
+            n.parse_body(req.body[16:], version)
+        except (ValueError, struct.error) as e:
+            # struct.error: truncated body/CRC tail is not a ValueError
+            return 400, {"error": f"bad needle record: {e}"}
+        size, _ = self.store.write_needle(vid, n)
+        return 200, {"size": size}
+
     def _read_volume_file(self, req: Request):
         """volume_server.proto:69 CopyFile equivalent: stream a byte
         range of a volume/EC file (.dat/.idx/.ecx/.ecj/.vif/.ecNN)."""
@@ -497,6 +575,12 @@ class VolumeServer:
         v = self.store.find_volume(vid)
         if v is None:
             return 404, {"error": f"volume {vid} not found"}
+        if collection != v.collection:
+            # a mismatched collection would generate shards the mount
+            # step (addressing <collection>_<vid>) can never find
+            return 409, {"error": f"collection mismatch: volume {vid} "
+                                  f"is {v.collection!r}, "
+                                  f"not {collection!r}"}
         if not v.read_only:
             return 409, {"error": "volume must be readonly before encode"}
         v.sync()

@@ -31,9 +31,17 @@ def command(name):
 
 
 class CommandEnv:
-    def __init__(self, master: str):
+    def __init__(self, master: str, filer: str = ""):
         self.master = master
+        self.filer = filer  # host:port for the fs.* family
         self.admin_token: int | None = None
+
+    def require_filer(self) -> str:
+        if not self.filer:
+            raise RuntimeError(
+                "no filer configured; start the shell with -filer or "
+                "run `fs.configure -filer=host:port`")
+        return self.filer
 
     # -- admin lock (command_lock_unlock.go) ------------------------------
 
@@ -136,6 +144,11 @@ def _do_ec_encode(env: CommandEnv, vid: int, data_shards: int,
     if not locations:
         raise RuntimeError(f"volume {vid} has no locations")
     collection = opts.get("collection", "")
+    if collection == "ALL":
+        # "ALL" is a volume-SELECTION sentinel (the empty collection),
+        # never a real collection name — passing it through would make
+        # generate/mount address nonexistent "ALL_<vid>" files
+        collection = ""
     # 1. mark all replicas readonly (:250)
     for loc in locations:
         http_json("POST", f"{loc['url']}/admin/set_readonly",
@@ -148,10 +161,13 @@ def _do_ec_encode(env: CommandEnv, vid: int, data_shards: int,
     if "error" in r:
         raise RuntimeError(f"generate on {source}: {r['error']}")
     total = data_shards + parity_shards
-    # 3. mount all shards on source (:314)
-    http_json("POST", f"{source}/admin/ec/mount", {
+    # 3. mount all shards on source (:314) — a silent mount failure
+    # here would let step 5 delete the originals with the EC copy
+    # unregistered (data loss)
+    _must(http_json("POST", f"{source}/admin/ec/mount", {
         "volumeId": vid, "collection": collection,
-        "shardIds": list(range(total))})
+        "shardIds": list(range(total))}),
+        f"mount ec shards on {source}")
     # 4. spread shards across servers (EcBalance, :199)
     moved = _balance_ec_volume(env, vid, collection, total)
     # 5. delete original volume replicas (:329)

@@ -36,13 +36,8 @@ def write_sorted_file_from_idx(base_file_name: str, ext: str = ".ecx"
     REMOVES the key entirely (readNeedleMap ec_encoder.go:387-393 routes
     tombstones through MemDb.Delete), so pre-encode deletes never appear
     in .ecx — then write live entries ascending by key."""
-    live: dict[int, tuple[int, int]] = {}
     with open(base_file_name + ".idx", "rb") as f:
-        for key, off, size in idxmod.walk_index(f.read()):
-            if off != 0 and not types.size_is_deleted(size):
-                live[key] = (off, size)
-            else:
-                live.pop(key, None)
+        live = idxmod.live_entries(f.read())
     entries = sorted(live.items())
     with open(base_file_name + ext, "wb") as out:
         if entries:

@@ -49,3 +49,19 @@ def pack_index(keys, offsets, sizes) -> bytes:
     out["offset"] = offsets
     out["size"] = sizes
     return out.tobytes()
+
+
+def live_entries(buf: bytes) -> "dict[int, tuple[int, int]]":
+    """Replay an .idx stream into the LIVE needle map — a delete (zero
+    offset or tombstone size) REMOVES the key (memdb semantics,
+    ec_encoder.go:387-393 readNeedleMap routes tombstones through
+    MemDb.Delete).  Single definition shared by the EC .ecx writer and
+    the repair plane's volume inventory."""
+    from . import types
+    live: dict[int, tuple[int, int]] = {}
+    for key, off, size in walk_index(buf):
+        if off != 0 and not types.size_is_deleted(size):
+            live[key] = (off, size)
+        else:
+            live.pop(key, None)
+    return live

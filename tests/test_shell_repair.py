@@ -1,0 +1,194 @@
+"""fs.* shell family + repair-plane commands: volume.fsck,
+volume.check.disk, ec.rebalance.proportional (the analogs of
+weed/shell/command_fs_*.go, command_volume_fsck.go,
+command_volume_check_disk.go, ec_proportional_rebalance.go)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.httpd import http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell import CommandEnv, run_command
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(default_replication="001").start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"v{i}"
+        d.mkdir()
+        servers.append(VolumeServer([str(d)], master.url,
+                                    pulse_seconds=0.3).start())
+    time.sleep(0.5)
+    filer = FilerServer(master.url).start()
+    env = CommandEnv(master.url, filer=filer.url)
+    yield master, servers, filer, env
+    filer.stop()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+# --- fs.* ----------------------------------------------------------------
+
+def test_fs_family(cluster):
+    master, servers, filer, env = cluster
+    filer.filer.write_file("/docs/a.txt", b"alpha content")
+    filer.filer.write_file("/docs/sub/b.txt", b"beta")
+
+    assert run_command(env, "fs.mkdir /emptydir") == \
+        "created /emptydir"
+    ls = run_command(env, "fs.ls /docs")
+    assert "a.txt" in ls and "sub/" in ls
+    ls_l = run_command(env, "fs.ls -l /docs")
+    assert "13" in ls_l  # size column of a.txt
+    assert run_command(env, "fs.cat /docs/a.txt") == "alpha content"
+    meta = run_command(env, "fs.meta /docs/a.txt")
+    assert '"fullPath": "/docs/a.txt"' in meta and "fileId" in meta
+    du = run_command(env, "fs.du /docs")
+    assert du.startswith(f"{13 + 4} bytes, 2 files")
+    run_command(env, "fs.rm /docs/a.txt")
+    assert "a.txt" not in run_command(env, "fs.ls /docs")
+    with pytest.raises(RuntimeError):
+        run_command(env, "fs.rm /docs/sub")  # dir without -r
+    run_command(env, "fs.rm -r /docs/sub")
+    assert "sub" not in run_command(env, "fs.ls /docs")
+
+
+def test_fs_requires_filer(cluster):
+    master, servers, filer, env = cluster
+    bare = CommandEnv(master.url)
+    with pytest.raises(RuntimeError, match="no filer"):
+        run_command(bare, "fs.ls /")
+    run_command(bare, f"fs.configure -filer={filer.url}")
+    assert run_command(bare, "fs.ls /") is not None
+
+
+# --- volume.fsck ---------------------------------------------------------
+
+def test_volume_fsck_orphans_and_missing(cluster):
+    master, servers, filer, env = cluster
+    filer.filer.write_file("/data/keep.bin", b"x" * 5000)
+    # an orphan: uploaded directly, no filer entry references it
+    orphan_fid = operation.submit(master.url, b"orphan-data")
+    time.sleep(0.4)
+
+    out = run_command(env, "volume.fsck")
+    assert "orphan needles (no filer reference): 1" in out
+    assert "MISSING needles (filer references broken): 0" in out
+
+    # purge the orphan (lock-gated).  With the default 60s cutoff the
+    # fresh needle is protected (it could be an in-flight upload) —
+    # the reference's -cutoffTimeAgo guard
+    run_command(env, "lock")
+    out = run_command(env, "volume.fsck -reallyDeleteFromVolume")
+    assert "purged: 0 (skipped 1" in out
+    assert operation.read(master.url, orphan_fid) == b"orphan-data"
+    out = run_command(
+        env, "volume.fsck -reallyDeleteFromVolume -cutoffSeconds=0")
+    assert "purged: 1" in out
+    out = run_command(env, "volume.fsck")
+    assert "orphan needles (no filer reference): 0" in out
+    # the orphan is really gone, the referenced needle still reads
+    with pytest.raises((RuntimeError, LookupError, OSError)):
+        operation.read(master.url, orphan_fid)
+    assert filer.filer.read_file("/data/keep.bin") == b"x" * 5000
+
+    # break a filer reference: delete its chunk directly
+    chunk_fid = filer.filer.find_entry(
+        "/data/keep.bin").chunks[0].file_id
+    operation.delete(master.url, chunk_fid)
+    out = run_command(env, "volume.fsck")
+    assert "MISSING needles (filer references broken): 1" in out
+
+
+# --- volume.check.disk ---------------------------------------------------
+
+def test_volume_check_disk_syncs_replicas(cluster):
+    master, servers, filer, env = cluster
+    data = np.random.default_rng(3).integers(
+        0, 256, 4000, dtype=np.uint8).tobytes()
+    fid = operation.submit(master.url, data)  # replication 001: 2 copies
+    vid = int(fid.split(",")[0])
+    key = int(fid.split(",")[1][:-8], 16)
+    time.sleep(0.4)
+    locs = [l["url"] for l in http_json(
+        "GET", f"{master.url}/dir/lookup?volumeId={vid}")["locations"]]
+    assert len(locs) == 2, locs
+
+    # diverge one replica: tombstone the needle there directly
+    r = http_json("POST", f"{locs[1]}/admin/delete_needle",
+                  {"volumeId": vid, "key": key})
+    assert r.get("freed", 0) > 0
+    before = http_json(
+        "GET", f"{locs[1]}/admin/volume_index?volumeId={vid}")
+    assert key not in {k for k, _ in before["entries"]}
+
+    run_command(env, "lock")
+    out = run_command(env, f"volume.check.disk -volumeId={vid}")
+    assert "1 needles synced" in out, out
+    after = http_json(
+        "GET", f"{locs[1]}/admin/volume_index?volumeId={vid}")
+    assert key in {k for k, _ in after["entries"]}
+    assert operation.read(master.url, fid) == data
+
+    # a second run is a no-op
+    out = run_command(env, f"volume.check.disk -volumeId={vid}")
+    assert "0 needles synced" in out
+
+
+# --- ec.rebalance.proportional -------------------------------------------
+
+def test_ec_rebalance_proportional(cluster, tmp_path):
+    master, servers, filer, env = cluster
+    # add a 4th server with much larger capacity: it should end up
+    # carrying proportionally more shards
+    d = tmp_path / "big"
+    d.mkdir()
+    big = VolumeServer([str(d)], master.url, pulse_seconds=0.3,
+                       max_volume_count=64).start()
+    try:
+        rng = np.random.default_rng(9)
+        for _ in range(4):
+            operation.submit(
+                master.url,
+                rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes(),
+                replication="000")
+        time.sleep(0.5)
+        run_command(env, "lock")
+        out = run_command(env, "ec.encode -collection=ALL")
+        assert "encoded" in out
+        out = run_command(env, "ec.rebalance.proportional")
+        assert "proportionally rebalanced" in out
+        time.sleep(0.5)
+        # every shard still exists exactly once
+        counts: dict[str, int] = {}
+        for vid_r in _ec_vids(master.url):
+            locs = http_json(
+                "GET",
+                f"{master.url}/dir/ec_lookup?volumeId={vid_r}")
+            sids = [s for l in locs["shardIdLocations"]
+                    for s in l["shardIds"]]
+            assert sorted(sids) == list(range(14))
+            for l in locs["shardIdLocations"]:
+                counts[l["url"]] = counts.get(l["url"], 0) + \
+                    len(l["shardIds"])
+        # the big-capacity node carries the largest share
+        biggest = max(counts, key=counts.get)
+        assert counts[biggest] >= max(
+            v for k, v in counts.items() if k != biggest)
+    finally:
+        big.stop()
+
+
+def _ec_vids(master_url):
+    from seaweedfs_tpu.topology import iter_volume_list_ec_shards
+    vl = http_json("GET", f"{master_url}/vol/list")
+    return sorted({e["volumeId"]
+                   for _n, e in iter_volume_list_ec_shards(vl)})
