@@ -91,6 +91,13 @@ def main(argv: list[str] | None = None) -> int:
     wk.add_argument("-backend", default="",
                     help="EC codec backend: jax|cpu (default: auto)")
 
+    mqb = sub.add_parser(
+        "mq.broker", help="start a message-queue broker "
+        "(mq/broker/broker_server.go)")
+    mqb.add_argument("-ip", default="127.0.0.1")
+    mqb.add_argument("-port", type=int, default=17777)
+    mqb.add_argument("-filer", default="127.0.0.1:8888")
+
     fsync = sub.add_parser(
         "filer.sync", help="continuously replicate one filer's "
         "namespace+content to another, resuming from a persisted "
@@ -215,6 +222,18 @@ def main(argv: list[str] | None = None) -> int:
         w.start()
         print(f"worker {w.worker_id} polling {args.admin}")
         _wait()
+    elif args.cmd == "mq.broker":
+        import signal
+        from .mq import BrokerServer
+        br = BrokerServer(args.filer, args.ip, args.port).start()
+        # graceful SIGTERM: drain hot buffers to the filer before exit
+        signal.signal(signal.SIGTERM,
+                      lambda *_: (br.stop(), sys.exit(0)))
+        print(f"mq broker on {br.url} (filer {args.filer})")
+        try:
+            _wait()
+        finally:
+            br.stop()
     elif args.cmd == "filer.sync":
         from .filer.filer_sync import FilerSync
         syncer = FilerSync(args.sync_from, args.sync_to,

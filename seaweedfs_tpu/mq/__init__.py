@@ -1,0 +1,5 @@
+"""SeaweedMQ analog: topic/partition model, filer-backed log store,
+broker server (weed/mq/)."""
+
+from .topic import Partition, Topic, split_ring, partition_slot  # noqa: F401
+from .broker import BrokerServer  # noqa: F401

@@ -1,0 +1,297 @@
+"""MQ broker (weed/mq/broker/broker_server.go:51 MessageQueueBroker).
+
+JSON-HTTP mirror of the broker gRPC surface (pb/mq_broker.proto):
+
+  POST /topics/configure {namespace, topic, partitionCount}
+      <- ConfigureTopic: splits the hash ring into partitions and
+         persists the layout to the filer (topic.conf), so every broker
+         and a restarted broker agree on key->partition routing.
+  GET  /topics/lookup?namespace=&topic=
+      <- LookupTopicBrokers: partition layout + owning broker urls.
+  POST /topics/publish {namespace, topic, key, value(b64), tsNs?}
+      <- PublishMessage: routes by key hash to the partition, appends
+         to its filer-backed log, returns {partition, tsNs} (the
+         offset).
+  GET  /topics/subscribe?namespace=&topic=&partition=&sinceNs=&limit=
+      <- SubscribeMessage (poll form, like the filer's events stream):
+         replayable from any offset; offsets are strictly monotonic
+         per-partition timestamps.
+  POST /offsets/commit {group, namespace, topic, partition, tsNs}
+  GET  /offsets/fetch?group=&namespace=&topic=&partition=
+      <- consumer-group offset store (mq/kafka consumer_offset/),
+         persisted via the filer so committed positions survive broker
+         restarts.
+  POST /topics/flush {namespace, topic} — force segment flush (tests,
+         graceful shutdown).
+
+Single-broker ownership of all partitions for now; the DATA model
+(ring-range partitions + filer-persisted layout) is the multi-broker
+contract — assignment/balancing (pub_balancer/) is the next widening.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import urllib.parse
+
+from ..server.httpd import HttpServer, Request, http_bytes
+from .logstore import PartitionLog
+from .topic import Partition, Topic, partition_for_key, split_ring
+
+OFFSETS_DIR = "/topics/.offsets"
+
+
+class NameError_(ValueError):
+    pass
+
+
+def _check_name(kind: str, name: str) -> None:
+    """Topic/namespace/group names become filer path segments: a '/'
+    would add path levels, a leading '.' collides with reserved dirs
+    (.offsets), empty collapses segments."""
+    if not name or "/" in name or name.startswith("."):
+        raise NameError_(f"invalid {kind} name {name!r}")
+
+
+class BrokerServer:
+    def __init__(self, filer: str, host: str = "127.0.0.1",
+                 port: int = 0, flush_interval: float = 1.0):
+        self.filer = filer
+        self.http = HttpServer(host, port)
+        self._topics: dict[Topic, list[Partition]] = {}
+        self._logs: dict[tuple[Topic, Partition], PartitionLog] = {}
+        self._lock = threading.Lock()
+        # serializes configure's load-check-persist-cache sequence
+        # (check-then-act on topic.conf must be atomic or concurrent
+        # configures can leave the filer and the cache disagreeing on
+        # the partition layout)
+        self._conf_lock = threading.Lock()
+        # periodic flush bounds the acked-but-unflushed window to
+        # ~flush_interval on a crash (the reference's log_buffer also
+        # flushes on a timer, util/log_buffer)
+        self._flush_interval = flush_interval
+        self._stop_event = threading.Event()
+        self._flush_thread: threading.Thread | None = None
+        r = self.http.route
+        r("POST", "/topics/configure", self._configure)
+        r("GET", "/topics/lookup", self._lookup)
+        r("POST", "/topics/publish", self._publish)
+        r("GET", "/topics/subscribe", self._subscribe)
+        r("POST", "/topics/flush", self._flush)
+        r("POST", "/offsets/commit", self._commit_offset)
+        r("GET", "/offsets/fetch", self._fetch_offset)
+
+    def start(self) -> "BrokerServer":
+        self.http.start()
+        self._flush_thread = threading.Thread(target=self._flush_loop,
+                                              daemon=True)
+        self._flush_thread.start()
+        return self
+
+    def stop(self) -> None:
+        # stop accepting requests FIRST: a publish acked after the
+        # flush loop but before http shutdown would be lost
+        self.http.stop()
+        self._stop_event.set()
+        self._flush_all()
+
+    def _flush_loop(self) -> None:
+        while not self._stop_event.wait(self._flush_interval):
+            self._flush_all()
+
+    def _flush_all(self) -> None:
+        with self._lock:
+            logs = list(self._logs.values())
+        for log in logs:
+            try:
+                log.flush()
+            except Exception:  # noqa: BLE001 — best-effort; retried
+                pass           # on the next tick
+
+    @property
+    def url(self) -> str:
+        return self.http.url
+
+    # -- topic layout -----------------------------------------------------
+
+    def _conf_path(self, t: Topic) -> str:
+        return f"{t.dir}/topic.conf"
+
+    def _load_layout(self, t: Topic) -> "list[Partition] | None":
+        """None means CONFIRMED not-configured (filer 404).  A filer
+        error raises — conflating it with 'not configured' would let
+        _configure overwrite an existing layout during a filer blip,
+        silently re-routing every stored key."""
+        with self._lock:
+            if t in self._topics:
+                return self._topics[t]
+        st, body, _ = http_bytes(
+            "GET", self.filer + urllib.parse.quote(self._conf_path(t)))
+        if st == 404:
+            return None
+        if st != 200:
+            raise RuntimeError(f"filer {self.filer} topic.conf: {st}")
+        parts = [Partition.from_json(p)
+                 for p in json.loads(body)["partitions"]]
+        with self._lock:
+            self._topics[t] = parts
+        return parts
+
+    def _topic_from(self, ns: str, name: str) -> Topic:
+        _check_name("namespace", ns)
+        _check_name("topic", name)
+        return Topic(ns, name)
+
+    def _configure(self, req: Request):
+        b = req.json()
+        try:
+            t = self._topic_from(b["namespace"], b["topic"])
+        except NameError_ as e:
+            return 400, {"error": str(e)}
+        n = int(b.get("partitionCount", 4))
+        with self._conf_lock:
+            try:
+                existing = self._load_layout(t)
+            except RuntimeError as e:
+                return 503, {"error": str(e)}
+            if existing is not None:
+                if len(existing) != n:
+                    # repartitioning changes key->partition routing of
+                    # already-stored messages; refuse (the reference
+                    # reconciles via assignments — out of scope)
+                    return 409, {"error":
+                                 f"topic {t} already has "
+                                 f"{len(existing)} partitions"}
+                return 200, {"partitions":
+                             [p.to_json() for p in existing]}
+            parts = split_ring(n)
+            body = json.dumps({"partitions":
+                               [p.to_json() for p in parts]}).encode()
+            st, resp, _ = http_bytes(
+                "POST", self.filer +
+                urllib.parse.quote(self._conf_path(t)), body)
+            if st >= 300:
+                return 500, {"error": f"persist layout: {st}"}
+            with self._lock:
+                self._topics[t] = parts
+        return 200, {"partitions": [p.to_json() for p in parts]}
+
+    def _lookup(self, req: Request):
+        try:
+            t = self._topic_from(req.query["namespace"],
+                                 req.query["topic"])
+            parts = self._load_layout(t)
+        except NameError_ as e:
+            return 400, {"error": str(e)}
+        except RuntimeError as e:
+            return 503, {"error": str(e)}
+        if parts is None:
+            return 404, {"error": f"topic {t} not configured"}
+        return 200, {"topic": str(t), "assignments": [
+            {"partition": p.to_json(), "broker": self.url}
+            for p in parts]}
+
+    def _log_for(self, t: Topic, p: Partition) -> PartitionLog:
+        with self._lock:
+            log = self._logs.get((t, p))
+            if log is None:
+                log = PartitionLog(self.filer, t, p)
+                self._logs[(t, p)] = log
+            return log
+
+    # -- pub/sub ----------------------------------------------------------
+
+    def _publish(self, req: Request):
+        b = req.json()
+        try:
+            t = self._topic_from(b["namespace"], b["topic"])
+            parts = self._load_layout(t)
+        except NameError_ as e:
+            return 400, {"error": str(e)}
+        except RuntimeError as e:
+            return 503, {"error": str(e)}
+        if parts is None:
+            return 404, {"error": f"topic {t} not configured"}
+        key = base64.b64decode(b.get("key", "")) if b.get("key") \
+            else b""
+        p = partition_for_key(key, parts)
+        ts = self._log_for(t, p).append(
+            b.get("key", ""), b.get("value", ""),
+            int(b.get("tsNs", 0)))
+        return 200, {"partition": p.to_json(), "tsNs": ts}
+
+    def _subscribe(self, req: Request):
+        try:
+            t = self._topic_from(req.query["namespace"],
+                                 req.query["topic"])
+            parts = self._load_layout(t)
+        except NameError_ as e:
+            return 400, {"error": str(e)}
+        except RuntimeError as e:
+            return 503, {"error": str(e)}
+        if parts is None:
+            return 404, {"error": f"topic {t} not configured"}
+        idx = int(req.query.get("partition", -1))
+        since = int(req.query.get("sinceNs", 0))
+        limit = int(req.query.get("limit", 1000))
+        if not 0 <= idx < len(parts):
+            return 400, {"error": f"partition index {idx} out of "
+                                  f"range 0..{len(parts) - 1}"}
+        log = self._log_for(t, parts[idx])
+        msgs = log.read_since(since, limit)
+        return 200, {"partition": parts[idx].to_json(),
+                     "messages": msgs,
+                     "highWaterMarkNs": log.high_water_mark()}
+
+    def _flush(self, req: Request):
+        b = req.json()
+        t = Topic(b["namespace"], b["topic"])
+        flushed = 0
+        with self._lock:
+            logs = [log for (lt, _p), log in self._logs.items()
+                    if lt == t]
+        for log in logs:
+            log.flush()
+            flushed += 1
+        return 200, {"flushed": flushed}
+
+    # -- consumer-group offsets -------------------------------------------
+
+    def _offset_path(self, group: str, t: Topic, idx: int) -> str:
+        return f"{OFFSETS_DIR}/{group}/{t.namespace}.{t.name}/p{idx}"
+
+    def _commit_offset(self, req: Request):
+        b = req.json()
+        try:
+            t = self._topic_from(b["namespace"], b["topic"])
+            _check_name("group", b["group"])
+        except NameError_ as e:
+            return 400, {"error": str(e)}
+        path = self._offset_path(b["group"], t, int(b["partition"]))
+        st, resp, _ = http_bytes(
+            "POST", self.filer + urllib.parse.quote(path),
+            json.dumps({"tsNs": int(b["tsNs"])}).encode())
+        if st >= 300:
+            return 500, {"error": f"persist offset: {st}"}
+        return 200, {}
+
+    def _fetch_offset(self, req: Request):
+        try:
+            t = self._topic_from(req.query["namespace"],
+                                 req.query["topic"])
+            _check_name("group", req.query["group"])
+        except NameError_ as e:
+            return 400, {"error": str(e)}
+        path = self._offset_path(req.query["group"], t,
+                                 int(req.query["partition"]))
+        st, body, _ = http_bytes(
+            "GET", self.filer + urllib.parse.quote(path))
+        if st == 404:
+            return 200, {"tsNs": 0}  # no commit yet: start from 0
+        if st != 200:
+            # a filer blip must NOT read as "no commit": the consumer
+            # would restart from 0 and reprocess the whole partition
+            return 503, {"error": f"offset store: {st}"}
+        return 200, {"tsNs": int(json.loads(body)["tsNs"])}

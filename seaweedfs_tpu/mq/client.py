@@ -1,0 +1,91 @@
+"""MQ client SDK (the analog of weed/mq/client/ pub_client/sub_client):
+thin typed wrapper over the broker's JSON-HTTP surface."""
+
+from __future__ import annotations
+
+import base64
+import urllib.parse
+from dataclasses import dataclass
+
+from ..server.httpd import http_json
+
+
+def _q(**params) -> str:
+    return urllib.parse.urlencode(params)
+
+
+@dataclass
+class Message:
+    key: bytes
+    value: bytes
+    ts_ns: int
+
+
+class MQClient:
+    def __init__(self, broker: str):
+        self.broker = broker
+
+    def configure_topic(self, namespace: str, topic: str,
+                        partition_count: int = 4) -> int:
+        r = http_json("POST", f"{self.broker}/topics/configure",
+                      {"namespace": namespace, "topic": topic,
+                       "partitionCount": partition_count})
+        if "error" in r:
+            raise RuntimeError(f"configure {namespace}.{topic}: "
+                               f"{r['error']}")
+        return len(r["partitions"])
+
+    def lookup(self, namespace: str, topic: str) -> list[dict]:
+        r = http_json("GET", f"{self.broker}/topics/lookup?" +
+                      _q(namespace=namespace, topic=topic))
+        if "error" in r:
+            raise RuntimeError(r["error"])
+        return r["assignments"]
+
+    def publish(self, namespace: str, topic: str, key: bytes,
+                value: bytes) -> int:
+        """Returns the message offset (tsNs)."""
+        r = http_json("POST", f"{self.broker}/topics/publish", {
+            "namespace": namespace, "topic": topic,
+            "key": base64.b64encode(key).decode(),
+            "value": base64.b64encode(value).decode()})
+        if "error" in r:
+            raise RuntimeError(f"publish: {r['error']}")
+        return int(r["tsNs"])
+
+    def subscribe(self, namespace: str, topic: str, partition: int,
+                  since_ns: int = 0, limit: int = 1000
+                  ) -> "list[Message]":
+        r = http_json("GET", f"{self.broker}/topics/subscribe?" +
+                      _q(namespace=namespace, topic=topic,
+                         partition=partition, sinceNs=since_ns,
+                         limit=limit))
+        if "error" in r:
+            raise RuntimeError(f"subscribe: {r['error']}")
+        return [Message(base64.b64decode(m.get("key", "")),
+                        base64.b64decode(m.get("value", "")),
+                        int(m["tsNs"]))
+                for m in r["messages"]]
+
+    def flush(self, namespace: str, topic: str) -> None:
+        http_json("POST", f"{self.broker}/topics/flush",
+                  {"namespace": namespace, "topic": topic})
+
+    def commit_offset(self, group: str, namespace: str, topic: str,
+                      partition: int, ts_ns: int) -> None:
+        r = http_json("POST", f"{self.broker}/offsets/commit", {
+            "group": group, "namespace": namespace, "topic": topic,
+            "partition": partition, "tsNs": ts_ns})
+        if "error" in r:
+            raise RuntimeError(f"commit offset: {r['error']}")
+
+    def fetch_offset(self, group: str, namespace: str, topic: str,
+                     partition: int) -> int:
+        r = http_json("GET", f"{self.broker}/offsets/fetch?" +
+                      _q(group=group, namespace=namespace,
+                         topic=topic, partition=partition))
+        if "error" in r:
+            # an offset-store error must surface, not read as "start
+            # from 0" (that would reprocess the whole partition)
+            raise RuntimeError(f"fetch offset: {r['error']}")
+        return int(r.get("tsNs", 0))

@@ -1,0 +1,165 @@
+"""Filer-backed partition log segments (weed/mq/logstore/).
+
+Each partition's messages live as JSON-line segment files under the
+topic's filer directory:
+
+    /topics/<ns>/<topic>/<rangeStart>-<rangeStop>/<tsNs>.log
+
+— the reference's layout (logstore/log_to_parquet.go reads
+/topics/<ns>/<t>/<partition>/ date dirs; we keep one level, named by
+first-message timestamp so segments sort chronologically).  A hot
+in-memory tail buffer absorbs appends and flushes to the filer when it
+reaches FLUSH_BYTES or on demand — the shape of the reference's
+log_buffer (util/log_buffer/) whose pages also flush to filer chunks.
+
+Offsets ARE timestamps (strictly monotonic per partition, same rule as
+the filer MetaLog): a subscriber resumes with `> tsNs` and can never
+skip a same-stamp sibling.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+
+from ..server.httpd import http_bytes
+from .topic import Partition, Topic
+
+FLUSH_BYTES = 256 * 1024
+
+
+class PartitionLog:
+    def __init__(self, filer: str, topic: Topic, partition: Partition):
+        self.filer = filer
+        self.topic = topic
+        self.partition = partition
+        self.dir = f"{topic.dir}/{partition}"
+        self._buf: list[dict] = []
+        self._buf_bytes = 0
+        self._last_ts = 0
+        self._lock = threading.Lock()
+
+    # -- append -----------------------------------------------------------
+
+    def append(self, key_b64: str, value_b64: str,
+               ts_ns: int = 0) -> int:
+        """Returns the assigned (strictly monotonic) offset tsNs."""
+        with self._lock:
+            if self._last_ts == 0:
+                # resume the stamp clock above persisted history, so a
+                # restarted broker can never assign an offset below an
+                # already-served one
+                self._last_ts = self._persisted_hwm()
+            ts = int(ts_ns) or time.time_ns()
+            if ts <= self._last_ts:
+                ts = self._last_ts + 1
+            self._last_ts = ts
+            rec = {"tsNs": ts, "key": key_b64, "value": value_b64}
+            self._buf.append(rec)
+            self._buf_bytes += len(value_b64) + len(key_b64) + 32
+            if self._buf_bytes >= FLUSH_BYTES:
+                self._flush_locked()
+            return ts
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not self._buf:
+            return
+        body = "\n".join(json.dumps(r, separators=(",", ":"))
+                         for r in self._buf).encode() + b"\n"
+        name = f"{self._buf[0]['tsNs']:020d}.log"
+        st, resp, _ = http_bytes(
+            "POST", f"{self.filer}{urllib.parse.quote(self.dir)}/"
+            f"{name}", body)
+        if st >= 300:
+            raise RuntimeError(
+                f"mq: flush segment {self.dir}/{name}: {st} "
+                f"{resp[:200]!r}")
+        self._buf = []
+        self._buf_bytes = 0
+
+    # -- read -------------------------------------------------------------
+
+    def read_since(self, ts_ns: int, limit: int = 0) -> "list[dict]":
+        """Messages with tsNs > ts_ns, oldest first: persisted segments
+        (name-pruned — a segment named by its first stamp can be
+        skipped when the NEXT segment starts <= ts_ns) then the hot
+        buffer."""
+        out: list[dict] = []
+        segs = self._list_segments()
+        # prune: keep segments that may contain stamps > ts_ns
+        keep: list[str] = []
+        for i, name in enumerate(segs):
+            first_next = int(segs[i + 1].split(".")[0]) \
+                if i + 1 < len(segs) else None
+            if first_next is not None and first_next <= ts_ns:
+                continue
+            keep.append(name)
+        for name in keep:
+            st, body, _ = http_bytes(
+                "GET", f"{self.filer}{urllib.parse.quote(self.dir)}/"
+                f"{name}")
+            if st != 200:
+                continue
+            for line in body.splitlines():
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("tsNs", 0) > ts_ns:
+                    out.append(rec)
+                    if limit and len(out) >= limit:
+                        return out
+        with self._lock:
+            for rec in self._buf:
+                if rec["tsNs"] > ts_ns:
+                    out.append(rec)
+                    if limit and len(out) >= limit:
+                        break
+        return out
+
+    def _list_segments(self) -> "list[str]":
+        st, body, _ = http_bytes(
+            "GET", f"{self.filer}{urllib.parse.quote(self.dir)}/"
+            f"?limit=1000000")
+        if st != 200:
+            return []
+        names = [e["fullPath"].rsplit("/", 1)[-1]
+                 for e in json.loads(body).get("entries", [])
+                 if not e.get("isDirectory")]
+        return sorted(n for n in names if n.endswith(".log"))
+
+    def high_water_mark(self) -> int:
+        """Newest offset in this partition (0 if empty)."""
+        with self._lock:
+            if self._last_ts:
+                return self._last_ts
+        hwm = self._persisted_hwm()
+        with self._lock:
+            # cache: an idle partition polled after a restart must not
+            # re-list + re-download the newest segment on every poll
+            if self._last_ts == 0:
+                self._last_ts = hwm
+        return hwm
+
+    def _persisted_hwm(self) -> int:
+        """Newest stamp in the persisted segments (no lock taken)."""
+        segs = self._list_segments()
+        if not segs:
+            return 0
+        st, body, _ = http_bytes(
+            "GET", f"{self.filer}{urllib.parse.quote(self.dir)}/"
+            f"{segs[-1]}")
+        last = 0
+        if st == 200:
+            for line in body.splitlines():
+                try:
+                    last = max(last, json.loads(line).get("tsNs", 0))
+                except ValueError:
+                    continue
+        return last

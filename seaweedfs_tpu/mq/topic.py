@@ -1,0 +1,88 @@
+"""Topic/partition model (weed/mq/topic/topic.go, partition.go).
+
+A topic's keyspace is a hash ring of RING_SIZE slots (the reference's
+`PartitionCount = 4096`, partition.go:10); a partition owns the
+half-open slot range [range_start, range_stop).  A message's partition
+is found by hashing its key onto the ring — so the partition count can
+be chosen per topic while key→partition stays stable for a given
+layout.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+RING_SIZE = 4096  # mq/topic/partition.go:10 PartitionCount
+
+
+@dataclass(frozen=True)
+class Topic:
+    namespace: str
+    name: str
+
+    def __str__(self) -> str:
+        return f"{self.namespace}.{self.name}"
+
+    @property
+    def dir(self) -> str:
+        """Filer directory of this topic (mq/logstore layout:
+        /topics/<namespace>/<topic>)."""
+        return f"/topics/{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
+class Partition:
+    range_start: int
+    range_stop: int  # exclusive (partition.go:14)
+    ring_size: int = RING_SIZE
+
+    def __str__(self) -> str:
+        return f"{self.range_start:04d}-{self.range_stop:04d}"
+
+    def covers(self, slot: int) -> bool:
+        return self.range_start <= slot < self.range_stop
+
+    def to_json(self) -> dict:
+        return {"rangeStart": self.range_start,
+                "rangeStop": self.range_stop,
+                "ringSize": self.ring_size}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Partition":
+        return cls(int(d["rangeStart"]), int(d["rangeStop"]),
+                   int(d.get("ringSize", RING_SIZE)))
+
+
+def split_ring(partition_count: int,
+               ring_size: int = RING_SIZE) -> "list[Partition]":
+    """Evenly split the ring into partition_count ranges
+    (topic.go SplitPartitions)."""
+    if not 0 < partition_count <= ring_size:
+        raise ValueError(f"bad partition count {partition_count}")
+    step = ring_size / partition_count
+    out = []
+    for i in range(partition_count):
+        start = int(i * step)
+        stop = int((i + 1) * step) if i < partition_count - 1 \
+            else ring_size
+        out.append(Partition(start, stop, ring_size))
+    return out
+
+
+def partition_slot(key: bytes, ring_size: int = RING_SIZE) -> int:
+    """Stable key→slot hash.  The reference uses util.HashToInt32 %
+    ring; any stable hash preserves the contract (same key → same
+    partition for a fixed layout) — md5 avoids Python's per-process
+    hash randomization."""
+    return int.from_bytes(hashlib.md5(key).digest()[:4], "big") % \
+        ring_size
+
+
+def partition_for_key(key: bytes, partitions: "list[Partition]"
+                      ) -> Partition:
+    slot = partition_slot(key, partitions[0].ring_size)
+    for p in partitions:
+        if p.covers(slot):
+            return p
+    raise ValueError(f"slot {slot} uncovered by {partitions}")

@@ -1,0 +1,202 @@
+"""MQ broker tests over a live mini-cluster (the analog of test/mq/):
+topic configure, partition routing, pub/sub round trip, offset replay,
+broker restart durability, consumer-group offsets."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.mq import BrokerServer
+from seaweedfs_tpu.mq.client import MQClient
+from seaweedfs_tpu.mq.topic import (partition_for_key, partition_slot,
+                                    split_ring)
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+# --- partition math (unit) -----------------------------------------------
+
+def test_split_ring_covers_everything():
+    for n in (1, 3, 4, 7, 64):
+        parts = split_ring(n)
+        assert len(parts) == n
+        assert parts[0].range_start == 0
+        assert parts[-1].range_stop == 4096
+        for a, b in zip(parts, parts[1:]):
+            assert a.range_stop == b.range_start  # no gap, no overlap
+
+
+def test_partition_for_key_stable_and_covering():
+    parts = split_ring(4)
+    for key in (b"a", b"hello", b"key-%d" % 7, b""):
+        p1 = partition_for_key(key, parts)
+        p2 = partition_for_key(key, parts)
+        assert p1 == p2
+        assert p1.covers(partition_slot(key))
+    # keys spread over multiple partitions
+    hit = {partition_for_key(b"key-%d" % i, parts) for i in range(64)}
+    assert len(hit) >= 3
+
+
+def test_split_ring_rejects_bad_counts():
+    with pytest.raises(ValueError):
+        split_ring(0)
+    with pytest.raises(ValueError):
+        split_ring(5000)
+
+
+# --- broker over a live cluster ------------------------------------------
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer().start()
+    servers = [VolumeServer([str(tmp_path / f"v{i}")], master.url,
+                            pulse_seconds=0.3).start() for i in range(2)]
+    time.sleep(0.5)
+    filer = FilerServer(master.url,
+                        store_path=str(tmp_path / "filer.db")).start()
+    broker = BrokerServer(filer.url).start()
+    yield master, servers, filer, broker
+    broker.stop()
+    filer.stop()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def test_pub_sub_roundtrip(cluster):
+    _, _, filer, broker = cluster
+    c = MQClient(broker.url)
+    assert c.configure_topic("chat", "events", 4) == 4
+    assigns = c.lookup("chat", "events")
+    assert len(assigns) == 4
+    assert all(a["broker"] == broker.url for a in assigns)
+
+    sent = {}
+    for i in range(40):
+        key = f"user-{i % 10}".encode()
+        val = f"message {i}".encode()
+        ts = c.publish("chat", "events", key, val)
+        sent.setdefault(key, []).append((val, ts))
+
+    got = {}
+    for p in range(4):
+        for m in c.subscribe("chat", "events", p):
+            got.setdefault(m.key, []).append((m.value, m.ts_ns))
+    assert {k: [v for v, _ in vs] for k, vs in got.items()} == \
+        {k: [v for v, _ in vs] for k, vs in sent.items()}
+    # same key always lands in one partition, in publish order
+    for key, vals in got.items():
+        assert [v for v, _ in vals] == [v for v, _ in sent[key]]
+        assert [t for _, t in vals] == sorted(t for _, t in vals)
+
+
+def test_offset_replay_mid_stream(cluster):
+    _, _, filer, broker = cluster
+    c = MQClient(broker.url)
+    c.configure_topic("ns", "t", 1)
+    stamps = [c.publish("ns", "t", b"k", b"m%d" % i)
+              for i in range(10)]
+    # resume from the middle: exactly the later messages, in order
+    msgs = c.subscribe("ns", "t", 0, since_ns=stamps[4])
+    assert [m.value for m in msgs] == [b"m%d" % i for i in range(5, 10)]
+    # from the exact last offset: nothing
+    assert c.subscribe("ns", "t", 0, since_ns=stamps[-1]) == []
+
+
+def test_broker_restart_durability(cluster):
+    """Messages and topic layout survive a broker restart (segments +
+    topic.conf live on the filer); post-restart offsets stay above
+    pre-restart ones."""
+    _, _, filer, broker = cluster
+    c = MQClient(broker.url)
+    c.configure_topic("dur", "t", 2)
+    pre = [c.publish("dur", "t", b"k%d" % i, b"pre%d" % i)
+           for i in range(8)]
+    broker.stop()  # flushes buffers to the filer
+
+    broker2 = BrokerServer(filer.url).start()
+    try:
+        c2 = MQClient(broker2.url)
+        # layout recovered from topic.conf — publish routes identically
+        post_ts = c2.publish("dur", "t", b"k0", b"post")
+        assert post_ts > max(pre)
+        msgs = []
+        for p in range(2):
+            msgs += c2.subscribe("dur", "t", p)
+        values = {m.value for m in msgs}
+        assert values == {b"pre%d" % i for i in range(8)} | {b"post"}
+    finally:
+        broker2.stop()
+
+
+def test_consumer_group_offsets(cluster):
+    _, _, filer, broker = cluster
+    c = MQClient(broker.url)
+    c.configure_topic("g", "t", 1)
+    stamps = [c.publish("g", "t", b"k", b"v%d" % i) for i in range(6)]
+    assert c.fetch_offset("workers", "g", "t", 0) == 0
+    # consume 3, commit, resume from the committed offset
+    msgs = c.subscribe("g", "t", 0, since_ns=0, limit=3)
+    c.commit_offset("workers", "g", "t", 0, msgs[-1].ts_ns)
+    resumed = c.subscribe("g", "t", 0,
+                          since_ns=c.fetch_offset("workers", "g",
+                                                  "t", 0))
+    assert [m.value for m in resumed] == [b"v3", b"v4", b"v5"]
+    # committed offsets survive a broker restart (stored on the filer)
+    broker.stop()
+    broker2 = BrokerServer(filer.url).start()
+    try:
+        c2 = MQClient(broker2.url)
+        assert c2.fetch_offset("workers", "g", "t", 0) == \
+            msgs[-1].ts_ns
+        # an unknown group starts at 0
+        assert c2.fetch_offset("others", "g", "t", 0) == 0
+    finally:
+        broker2.stop()
+
+
+def test_repartition_refused(cluster):
+    _, _, filer, broker = cluster
+    c = MQClient(broker.url)
+    c.configure_topic("fix", "t", 4)
+    with pytest.raises(RuntimeError, match="already has"):
+        c.configure_topic("fix", "t", 8)
+    # same count is idempotent
+    assert c.configure_topic("fix", "t", 4) == 4
+
+
+def test_bad_names_rejected(cluster):
+    """Names become filer path segments: '/', leading '.', and empty
+    must be rejected at the broker boundary."""
+    _, _, filer, broker = cluster
+    c = MQClient(broker.url)
+    for ns, topic in (("a/b", "t"), (".offsets", "t"), ("ns", "a/b"),
+                      ("", "t"), ("ns", "")):
+        with pytest.raises(RuntimeError, match="invalid"):
+            c.configure_topic(ns, topic, 2)
+    c.configure_topic("ok", "t", 1)
+    c.publish("ok", "t", b"k", b"v")
+    with pytest.raises(RuntimeError, match="invalid"):
+        c.commit_offset("evil/group", "ok", "t", 0, 1)
+
+
+def test_segment_flush_and_read_from_filer(cluster):
+    """A flushed segment is a real filer file; subscribe reads it back
+    merged with the hot buffer."""
+    _, _, filer, broker = cluster
+    c = MQClient(broker.url)
+    c.configure_topic("seg", "t", 1)
+    for i in range(5):
+        c.publish("seg", "t", b"k", b"flushed%d" % i)
+    c.flush("seg", "t")
+    for i in range(3):
+        c.publish("seg", "t", b"k", b"hot%d" % i)
+    entries = filer.filer.list_directory(
+        "/topics/seg/t/0000-4096")
+    assert any(e.name.endswith(".log") for e in entries)
+    msgs = c.subscribe("seg", "t", 0)
+    assert [m.value for m in msgs] == \
+        [b"flushed%d" % i for i in range(5)] + \
+        [b"hot%d" % i for i in range(3)]
