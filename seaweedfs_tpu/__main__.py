@@ -39,6 +39,11 @@ def main(argv: list[str] | None = None) -> int:
     v.add_argument("-max", type=int, default=8)
     v.add_argument("-dataCenter", default="")
     v.add_argument("-rack", default="")
+    v.add_argument("-tierBackend", default="",
+                   help="S3 tier backend: endpoint,bucket[,accessKey,"
+                        "secretKey] — lets this server reopen tiered "
+                        "volumes after restart (master.toml "
+                        "[storage.backend.s3] analog)")
 
     s = sub.add_parser(
         "server", help="all-in-one: master + volume (+ filer + s3), the "
@@ -57,6 +62,9 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("-s3.accessKey", dest="s3_access", default="")
     s.add_argument("-s3.secretKey", dest="s3_secret", default="")
     s.add_argument("-dir", default=".")
+    s.add_argument("-tierBackend", default="",
+                   help="S3 tier backend: endpoint,bucket[,accessKey,"
+                        "secretKey]")
 
     fl = sub.add_parser("filer", help="start a filer server")
     fl.add_argument("-ip", default="127.0.0.1")
@@ -151,6 +159,13 @@ def main(argv: list[str] | None = None) -> int:
         _wait()
     elif args.cmd == "volume":
         from .server.volume_server import VolumeServer
+        if args.tierBackend:
+            from .storage.backend import configure_s3_backend
+            parts = args.tierBackend.split(",")
+            configure_s3_backend("default", parts[0],
+                                 parts[1] if len(parts) > 1 else "tier",
+                                 parts[2] if len(parts) > 2 else "",
+                                 parts[3] if len(parts) > 3 else "")
         vs = VolumeServer(args.dir.split(","), args.mserver,
                           host=args.ip, port=args.port,
                           max_volume_count=args.max,
@@ -162,6 +177,13 @@ def main(argv: list[str] | None = None) -> int:
         import os as _os
         from .server.master_server import MasterServer
         from .server.volume_server import VolumeServer
+        if args.tierBackend:
+            from .storage.backend import configure_s3_backend
+            parts = args.tierBackend.split(",")
+            configure_s3_backend("default", parts[0],
+                                 parts[1] if len(parts) > 1 else "tier",
+                                 parts[2] if len(parts) > 2 else "",
+                                 parts[3] if len(parts) > 3 else "")
         ms = MasterServer(args.ip, args.master_port).start()
         vs = VolumeServer([args.dir], ms.url, host=args.ip,
                           port=args.volume_port).start()

@@ -39,9 +39,16 @@ class PartitionLog:
         self._buf: list[dict] = []
         self._buf_bytes = 0
         self._last_ts = 0
+        self._last_flushed_ts = 0
         self._lock = threading.Lock()
 
     # -- append -----------------------------------------------------------
+
+    # a client-supplied stamp may lead the server clock by at most this
+    # much; beyond it the server stamps instead — one far-future tsNs
+    # would otherwise ratchet the partition's offset clock forever
+    # (persisted in segments, surviving restarts)
+    MAX_CLIENT_SKEW_NS = 5 * 60 * 1_000_000_000
 
     def append(self, key_b64: str, value_b64: str,
                ts_ns: int = 0) -> int:
@@ -50,9 +57,15 @@ class PartitionLog:
             if self._last_ts == 0:
                 # resume the stamp clock above persisted history, so a
                 # restarted broker can never assign an offset below an
-                # already-served one
+                # already-served one.  The persisted hwm is also the
+                # last FLUSHED stamp — seeding both keeps the
+                # buffer-only read short-circuit honest after restart
                 self._last_ts = self._persisted_hwm()
-            ts = int(ts_ns) or time.time_ns()
+                self._last_flushed_ts = self._last_ts
+            now = time.time_ns()
+            ts = int(ts_ns) or now
+            if ts > now + self.MAX_CLIENT_SKEW_NS:
+                ts = now
             if ts <= self._last_ts:
                 ts = self._last_ts + 1
             self._last_ts = ts
@@ -80,6 +93,7 @@ class PartitionLog:
             raise RuntimeError(
                 f"mq: flush segment {self.dir}/{name}: {st} "
                 f"{resp[:200]!r}")
+        self._last_flushed_ts = self._buf[-1]["tsNs"]
         self._buf = []
         self._buf_bytes = 0
 
@@ -91,6 +105,17 @@ class PartitionLog:
         skipped when the NEXT segment starts <= ts_ns) then the hot
         buffer."""
         out: list[dict] = []
+        with self._lock:
+            # hot-path short-circuit: a tailing consumer whose resume
+            # point is at/after the last FLUSHED stamp needs no filer
+            # I/O — everything newer is in the buffer
+            if self._last_ts and ts_ns >= self._last_flushed_ts:
+                for rec in self._buf:
+                    if rec["tsNs"] > ts_ns:
+                        out.append(rec)
+                        if limit and len(out) >= limit:
+                            break
+                return out
         segs = self._list_segments()
         # prune: keep segments that may contain stamps > ts_ns
         keep: list[str] = []

@@ -72,6 +72,8 @@ class VolumeServer:
         r("POST", "/admin/ec/to_volume", self._ec_to_volume)
         r("GET", "/admin/ec/shard_read", self._ec_shard_read)
         r("GET", "/admin/ec/info", self._ec_info)
+        r("POST", "/admin/tier_move", self._tier_move)
+        r("POST", "/admin/tier_fetch", self._tier_fetch)
         r("GET", "/admin/volume_index", self._volume_index)
         r("POST", "/admin/delete_needle", self._admin_delete_needle)
         r("GET", "/admin/needle_raw", self._needle_raw)
@@ -423,6 +425,97 @@ class VolumeServer:
         garbage = v.garbage_level()
         v.vacuum()
         return 200, {"garbageRatio": garbage}
+
+    def _tier_move(self, req: Request):
+        """volume_server.proto VolumeTierMoveDatToRemote
+        (storage/volume_tier.go + s3_backend): upload the `.dat` to an
+        S3-compatible backend, record it in .vif, drop the local copy,
+        and reopen the volume in remote-read mode."""
+        from ..storage.backend import configure_s3_backend, get_backend
+        b = req.json()
+        vid = int(b["volumeId"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return 404, {"error": f"volume {vid} not found"}
+        if v.is_remote:
+            return 200, {"alreadyRemote": True}
+        backend_id = b.get("backendId", "default")
+        if b.get("endpoint"):
+            storage = configure_s3_backend(
+                backend_id, b["endpoint"], b.get("bucket", "tier"),
+                b.get("accessKey", ""), b.get("secretKey", ""))
+        else:
+            try:
+                storage = get_backend(backend_id)
+            except KeyError as e:
+                return 400, {"error": str(e)}
+        collection = v.collection
+        # freeze + flush so the uploaded object is the complete volume;
+        # heartbeat IMMEDIATELY so the master drops this volume from
+        # its writable list — when the tier target is this very
+        # cluster (the reference's own test trick), the upload's chunk
+        # assigns must not route back into the frozen volume
+        was_read_only = v.read_only
+        self.store.set_volume_read_only(vid, True)
+        v.sync()
+        self._heartbeat_once()
+        # per-replica object key: each replica tiers its OWN copy
+        # (replicas can diverge; sharing one key would let the last
+        # upload overwrite the object other replicas' .vif describe)
+        replica_tag = f"{self.http.port}"
+        key = (f"{collection}_" if collection else "") + \
+            f"{vid}.{replica_tag}.dat"
+        dat_path = v.file_name(".dat")
+        try:
+            storage.ensure_bucket()
+            size = storage.upload(dat_path, key)
+        except Exception as e:  # noqa: BLE001 — roll back the freeze
+            if not was_read_only:
+                self.store.set_volume_read_only(vid, False)
+                self._heartbeat_once()
+            return 500, {"error": f"tier upload failed: {e}"}
+        v.volume_info.files = [{
+            "backendType": "s3", "backendId": backend_id, "key": key,
+            "fileSize": size, "extension": ".dat"}]
+        v.volume_info.read_only = True
+        v.save_volume_info()
+        # swap to remote mode: close, drop the local .dat, remount —
+        # Volume.__init__ sees the .vif files entry and opens the
+        # backend-backed reader
+        self.store.unmount_volume(vid)
+        os.remove(dat_path)
+        self.store.mount_volume(vid, collection)
+        self._heartbeat_once()
+        return 200, {"key": key, "fileSize": size,
+                     "backendId": backend_id}
+
+    def _tier_fetch(self, req: Request):
+        """The inverse: download the remote `.dat` back to local disk
+        (volume.tier.download / VolumeTierMoveDatFromRemote)."""
+        from ..storage.backend import get_backend
+        b = req.json()
+        vid = int(b["volumeId"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return 404, {"error": f"volume {vid} not found"}
+        if not v.is_remote:
+            return 200, {"alreadyLocal": True}
+        remote = v.volume_info.files[0]
+        storage = get_backend(remote.get("backendId", "default"))
+        collection = v.collection
+        dat_path = v.file_name(".dat")
+        size = storage.download(remote["key"], dat_path)
+        v.volume_info.files = []
+        # the volume is local and writable again; a stale readOnly in
+        # the .vif would make a Go reader treat it as frozen forever
+        v.volume_info.read_only = False
+        v.save_volume_info()
+        self.store.unmount_volume(vid)
+        self.store.mount_volume(vid, collection)
+        if bool(b.get("deleteRemote", True)):
+            storage.delete(remote["key"])
+        self._heartbeat_once()
+        return 200, {"fileSize": size}
 
     def _volume_index(self, req: Request):
         """Live needle inventory of one volume: [key, size] pairs after

@@ -317,6 +317,59 @@ def cmd_volume_check_disk(env: CommandEnv, args: list[str]) -> str:
     return "\n".join(out) if out else "no replicated volumes"
 
 
+# --- volume tiering (shell/command_volume_tier_move.go) ------------------
+
+@command("volume.tier.move")
+def cmd_volume_tier_move(env: CommandEnv, args: list[str]) -> str:
+    """Move a volume's .dat to an S3-compatible backend; needle reads
+    become ranged GETs against the backend (storage/volume_tier.go +
+    backend/s3_backend).  Every replica location is converted."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    vid = int(opts["volumeId"])
+    if "endpoint" not in opts:
+        raise RuntimeError("volume.tier.move needs -endpoint=host:port "
+                           "(an S3-compatible API, e.g. our own "
+                           "gateway)")
+    body = {"volumeId": vid,
+            "endpoint": opts["endpoint"],
+            "bucket": opts.get("bucket", "tier"),
+            "accessKey": opts.get("accessKey", ""),
+            "secretKey": opts.get("secretKey", ""),
+            "backendId": opts.get("backendId", "default")}
+    urls = [l["url"] for l in env.volume_locations(vid)]
+    if not urls:
+        raise RuntimeError(f"volume {vid} has no locations")
+    out = []
+    for url in urls:
+        r = http_json("POST", f"{url}/admin/tier_move", body)
+        if r.get("error"):
+            raise RuntimeError(f"tier_move on {url}: {r['error']}")
+        out.append(f"{url}: -> s3://{body['bucket']}/"
+                   f"{r.get('key', '?')} ({r.get('fileSize', '?')}B)")
+    return "\n".join(out)
+
+
+@command("volume.tier.fetch")
+def cmd_volume_tier_fetch(env: CommandEnv, args: list[str]) -> str:
+    """Bring a tiered volume's .dat back to local disk."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    vid = int(opts["volumeId"])
+    urls = [l["url"] for l in env.volume_locations(vid)]
+    out = []
+    for i, url in enumerate(urls):
+        # only the LAST replica may delete the remote object, or the
+        # remaining replicas have nothing left to download
+        r = http_json("POST", f"{url}/admin/tier_fetch",
+                      {"volumeId": vid,
+                       "deleteRemote": i == len(urls) - 1})
+        if r.get("error"):
+            raise RuntimeError(f"tier_fetch on {url}: {r['error']}")
+        out.append(f"{url}: fetched ({r.get('fileSize', '?')}B)")
+    return "\n".join(out)
+
+
 # --- ec proportional rebalance (ec_proportional_rebalance.go) ------------
 
 @command("ec.rebalance.proportional")

@@ -1,0 +1,225 @@
+"""Pluggable `.dat` storage backends (weed/storage/backend/backend.go
+BackendStorageFile + s3_backend/s3_backend.go).
+
+A tiered volume's `.dat` lives as ONE object in an S3-compatible store;
+local needle reads become ranged GETs.  The reference's own test trick
+is pointing the S3 backend at seaweedfs' own gateway — ours does the
+same (tests tier volumes onto the in-repo S3ApiServer).
+
+The active backends are a process-level registry configured like the
+reference's `[storage.backend.s3.default]` master.toml section
+(backend.go LoadConfiguration): `configure_s3_backend("default", ...)`
+then `.vif` files entries reference the backend by id.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.parse
+
+from ..server.httpd import http_bytes
+
+
+class S3BackendStorage:
+    """One named S3 target (s3_backend.go S3BackendStorage)."""
+
+    def __init__(self, backend_id: str, endpoint: str, bucket: str,
+                 access_key: str = "", secret_key: str = ""):
+        self.id = backend_id
+        self.endpoint = endpoint  # host:port of an S3-compatible API
+        self.bucket = bucket
+        self.access_key = access_key
+        self.secret_key = secret_key
+
+    # -- request plumbing -------------------------------------------------
+
+    def _request(self, method: str, key: str, body: bytes | None = None,
+                 extra_headers: dict | None = None,
+                 query: dict | None = None
+                 ) -> "tuple[int, bytes, dict]":
+        path = f"/{self.bucket}/{key}"
+        query = query or {}
+        headers: dict = {}
+        if self.access_key:
+            from ..s3.auth import sign_request
+            headers = sign_request(method, self.endpoint, path, query,
+                                   {}, body or b"", self.access_key,
+                                   self.secret_key)
+        # Range is not a signed-header class in SigV4 — attach after
+        headers.update(extra_headers or {})
+        qs = urllib.parse.urlencode(query)
+        url = self.endpoint + urllib.parse.quote(path) + \
+            (f"?{qs}" if qs else "")
+        return http_bytes(method, url, body, headers)
+
+    def ensure_bucket(self) -> None:
+        st, resp, _ = self._request("PUT", "")
+        if st >= 300 and st != 409:  # 409: already exists
+            raise RuntimeError(
+                f"s3 backend {self.id}: create bucket "
+                f"{self.bucket}: {st} {resp[:200]!r}")
+
+    def upload(self, local_path: str, key: str,
+               chunk_size: int = 64 * 1024 * 1024) -> int:
+        """Upload a file, streaming in chunks so a multi-GB volume
+        .dat never sits whole in RSS (s3_backend.go uses the SDK's
+        multipart uploader for the same reason)."""
+        import os
+        size = os.path.getsize(local_path)
+        if size <= chunk_size:
+            with open(local_path, "rb") as f:
+                data = f.read()
+            st, resp, _ = self._request("PUT", key, data)
+            if st >= 300:
+                raise RuntimeError(
+                    f"s3 backend {self.id}: upload {key}: "
+                    f"{st} {resp[:200]!r}")
+            return size
+        # S3 multipart: initiate -> per-chunk UploadPart -> complete
+        st, resp, _ = self._request("POST", key,
+                                    query={"uploads": ""})
+        if st >= 300:
+            raise RuntimeError(f"s3 backend {self.id}: initiate "
+                               f"multipart {key}: {st}")
+        import re
+        m = re.search(rb"<UploadId>([^<]+)</UploadId>", resp)
+        if not m:
+            raise RuntimeError("no UploadId in initiate response")
+        upload_id = m.group(1).decode()
+        part_xml = []
+        with open(local_path, "rb") as f:
+            part = 1
+            while True:
+                chunk = f.read(chunk_size)
+                if not chunk:
+                    break
+                st, resp, _ = self._request(
+                    "PUT", key, chunk,
+                    query={"partNumber": str(part),
+                           "uploadId": upload_id})
+                if st >= 300:
+                    raise RuntimeError(
+                        f"s3 backend {self.id}: part {part}: {st}")
+                part_xml.append(f"<Part><PartNumber>{part}"
+                                f"</PartNumber></Part>")
+                part += 1
+        body = ("<CompleteMultipartUpload>" + "".join(part_xml) +
+                "</CompleteMultipartUpload>").encode()
+        st, resp, _ = self._request("POST", key, body,
+                                    query={"uploadId": upload_id})
+        if st >= 300:
+            raise RuntimeError(f"s3 backend {self.id}: complete "
+                               f"multipart {key}: {st}")
+        return size
+
+    def download(self, key: str, local_path: str,
+                 chunk_size: int = 64 * 1024 * 1024) -> int:
+        """Ranged-GET the object in chunks straight to disk (constant
+        memory for multi-GB volumes)."""
+        import os
+        size = self.size_of(key)
+        tmp = local_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pos = 0
+            while pos < size:
+                n = min(chunk_size, size - pos)
+                f.write(self.read_range(key, pos, n))
+                pos += n
+        os.replace(tmp, local_path)
+        return size
+
+    def size_of(self, key: str) -> int:
+        st, _, hdrs = self._request("HEAD", key)
+        if st != 200:
+            raise RuntimeError(f"s3 backend {self.id}: head {key}: "
+                               f"{st}")
+        return int(hdrs.get("Content-Length", 0))
+
+    def delete(self, key: str) -> None:
+        self._request("DELETE", key)
+
+    def read_range(self, key: str, offset: int, size: int) -> bytes:
+        st, data, _ = self._request(
+            "GET", key, extra_headers={
+                "Range": f"bytes={offset}-{offset + size - 1}"})
+        if st not in (200, 206):
+            raise RuntimeError(f"s3 backend {self.id}: ranged read "
+                               f"{key}@{offset}+{size}: {st}")
+        if st == 200:  # server ignored Range: slice locally
+            data = data[offset:offset + size]
+        return data
+
+
+class RemoteDatFile:
+    """File-like adapter over a remote `.dat` object so the Volume read
+    path (seek/read/tell) works unchanged on a tiered volume
+    (backend.go BackendStorageFile ReadAt)."""
+
+    def __init__(self, storage: S3BackendStorage, key: str, size: int):
+        self._storage = storage
+        self._key = key
+        self._size = size
+        self._pos = 0
+        self._lock = threading.Lock()
+
+    def seek(self, offset: int, whence: int = 0) -> int:
+        with self._lock:
+            if whence == 0:
+                self._pos = offset
+            elif whence == 1:
+                self._pos += offset
+            else:
+                self._pos = self._size + offset
+            return self._pos
+
+    def tell(self) -> int:
+        with self._lock:
+            return self._pos
+
+    def read(self, n: int = -1) -> bytes:
+        with self._lock:
+            if n < 0:
+                n = self._size - self._pos
+            n = max(0, min(n, self._size - self._pos))
+            if n == 0:
+                return b""
+            data = self._storage.read_range(self._key, self._pos, n)
+            self._pos += len(data)
+            return data
+
+    def flush(self) -> None:  # read-only: nothing to flush
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def write(self, data: bytes) -> int:
+        raise PermissionError("tiered volume .dat is read-only "
+                              "(volume.tier.move'd to "
+                              f"{self._storage.id})")
+
+
+# -- registry (backend.go LoadConfiguration) ------------------------------
+
+_REGISTRY: dict[str, S3BackendStorage] = {}
+_REG_LOCK = threading.Lock()
+
+
+def configure_s3_backend(backend_id: str, endpoint: str, bucket: str,
+                         access_key: str = "", secret_key: str = ""
+                         ) -> S3BackendStorage:
+    s = S3BackendStorage(backend_id, endpoint, bucket, access_key,
+                         secret_key)
+    with _REG_LOCK:
+        _REGISTRY[backend_id] = s
+    return s
+
+
+def get_backend(backend_id: str) -> S3BackendStorage:
+    with _REG_LOCK:
+        s = _REGISTRY.get(backend_id)
+    if s is None:
+        raise KeyError(
+            f"s3 backend {backend_id!r} not configured on this server "
+            f"(configure_s3_backend / -tierBackend)")
+    return s

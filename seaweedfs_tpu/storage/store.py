@@ -22,7 +22,19 @@ from .ttl import EMPTY_TTL, read_ttl
 from .volume import Volume
 
 _VOL_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.dat$")
+_VIF_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.vif$")
 _EC_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.ec00$")
+
+
+def _vif_is_remote(vif_path: str) -> bool:
+    """True when the .vif records a remote-tiered .dat
+    (storage/volume_tier.go: files[] carries the backend copy)."""
+    from .volume_info import maybe_load_volume_info
+    try:
+        vi = maybe_load_volume_info(vif_path)
+    except ValueError:
+        return False
+    return bool(vi and vi.files)
 
 
 class DiskLocation:
@@ -45,6 +57,26 @@ class DiskLocation:
             vid = int(m.group("vid"))
             self.volumes[vid] = Volume(
                 self.directory, vid, collection=m.group("col") or "")
+        # tiered volumes have no local .dat; their .vif names the
+        # remote copy (volume_tier.go)
+        for path in glob.glob(os.path.join(self.directory, "*.vif")):
+            m = _VIF_RE.match(os.path.basename(path))
+            if not m:
+                continue
+            vid = int(m.group("vid"))
+            if vid in self.volumes or not _vif_is_remote(path):
+                continue
+            try:
+                self.volumes[vid] = Volume(
+                    self.directory, vid,
+                    collection=m.group("col") or "")
+            except KeyError as e:
+                # backend not configured on this server: the tiered
+                # volume is unavailable, but one bad .vif must not
+                # abort startup and take every healthy volume with it
+                import sys
+                print(f"volume {vid}: cannot open tiered volume: {e} "
+                      f"(start with -tierBackend)", file=sys.stderr)
         for path in glob.glob(os.path.join(self.directory, "*.ec00")):
             m = _EC_RE.match(os.path.basename(path))
             if not m:
@@ -129,10 +161,13 @@ class Store:
     def mount_volume(self, vid: int, collection: str = "") -> Volume:
         with self.lock:
             for loc in self.locations:
-                base = os.path.join(
-                    loc.directory,
-                    (f"{collection}_" if collection else "") + f"{vid}.dat")
-                if os.path.exists(base):
+                name = (f"{collection}_" if collection else "") + \
+                    f"{vid}"
+                base = os.path.join(loc.directory, name)
+                # a tiered volume has no local .dat — its .vif names
+                # the remote copy (storage/volume_tier.go)
+                if os.path.exists(base + ".dat") or \
+                        _vif_is_remote(base + ".vif"):
                     v = Volume(loc.directory, vid, collection=collection)
                     loc.volumes[vid] = v
                     return v

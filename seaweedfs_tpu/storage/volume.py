@@ -48,9 +48,25 @@ class Volume:
         self.lock = threading.RLock()
         self.last_append_at_ns = 0
         self.read_only = False
+        self.is_remote = False
         base = self.file_name("")
         dat_path = base + ".dat"
-        if os.path.exists(dat_path):
+        vi = maybe_load_volume_info(base + ".vif")
+        remote = next(
+            (f for f in (vi.files if vi else [])
+             if f.get("extension", ".dat") == ".dat"), None)
+        if remote is not None and not os.path.exists(dat_path):
+            # tiered volume: the .dat lives on a remote backend
+            # (volume_tier.go LoadRemoteFile); reads go through ranged
+            # backend requests, writes are refused
+            from .backend import RemoteDatFile, get_backend
+            storage = get_backend(remote.get("backendId", "default"))
+            self._dat = RemoteDatFile(storage, remote["key"],
+                                      int(remote["fileSize"]))
+            self.super_block = SuperBlock.read_from(self._dat)
+            self.read_only = True
+            self.is_remote = True
+        elif os.path.exists(dat_path):
             self._dat = open(dat_path, "r+b")
             self.super_block = SuperBlock.read_from(self._dat)
             self._dat.seek(0, os.SEEK_END)
@@ -63,7 +79,6 @@ class Volume:
             self._dat.write(self.super_block.to_bytes())
             self._dat.flush()
         self.nm = NeedleMap(base + ".idx")
-        vi = maybe_load_volume_info(base + ".vif")
         self.volume_info = vi or VolumeInfo(
             version=self.super_block.version,
             replication=str(self.super_block.replica_placement))
@@ -206,6 +221,10 @@ class Volume:
     def compact(self) -> None:
         """Copy live needles to shadow .cpd/.cpx
         (volume_vacuum.go:53 CompactByVolumeData)."""
+        if self.is_remote:
+            raise PermissionError(
+                f"volume {self.id} is tiered to a remote backend; "
+                f"fetch it back before compacting")
         with self.lock:
             cpd = self.file_name(".cpd")
             cpx = self.file_name(".cpx")
@@ -279,7 +298,8 @@ class Volume:
     def sync(self) -> None:
         with self.lock:
             self._dat.flush()
-            os.fsync(self._dat.fileno())
+            if not self.is_remote:
+                os.fsync(self._dat.fileno())
             self.nm.flush()
 
     def save_volume_info(self) -> None:
