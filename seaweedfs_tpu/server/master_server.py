@@ -175,7 +175,8 @@ class MasterServer:
                 collection, replication, ttl_u32)
         except LookupError:
             try:
-                self._grow_volume(collection, replication, ttl)
+                self._grow_volume(collection, replication, ttl,
+                                  only_if_unwritable=True)
             except LookupError as e:
                 return 500, {"error": f"cannot grow volume: {e}"}
             vid, nodes = self.topology.pick_for_write(
@@ -201,12 +202,23 @@ class MasterServer:
         return 200, resp
 
     def _grow_volume(self, collection: str, replication: str, ttl: str,
-                     count: int = 1) -> list[int]:
+                     count: int = 1,
+                     only_if_unwritable: bool = False) -> list[int]:
         """volume_growth.go: pick targets, allocate on each
         (AllocateVolume RPC -> /admin/allocate_volume)."""
         from ..storage.replica_placement import ReplicaPlacement
         from ..topology.topology import VolumeInfo
         with self._grow_lock:
+            if only_if_unwritable:
+                # double-check under the lock: N concurrent assigns
+                # hitting an empty layout must grow ONE volume between
+                # them, not N (which exhausts every volume slot)
+                try:
+                    self.topology.pick_for_write(
+                        collection, replication, _ttl_u32(ttl))
+                    return []
+                except LookupError:
+                    pass
             grown = []
             for _ in range(count):
                 # an unreachable target is marked dead and planning

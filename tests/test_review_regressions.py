@@ -89,3 +89,37 @@ def test_shard_dat_size_ambiguity():
     # with the -1 fallback, n_large_rows = 0 -> small-block layout
     ivs = locate_data(large, small, shard_file_size - 1, 8, 100, d)
     assert not ivs[0].is_large_block
+
+
+def test_concurrent_assigns_grow_one_volume_not_n(tmp_path):
+    """16 concurrent assigns against an empty layout must grow ONE
+    volume between them (double-checked under the grow lock) — one
+    grow per assign exhausted every volume slot and failed the whole
+    burst with 'no free volume slots' (HTTP bench regression)."""
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu import operation
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    master = MasterServer().start()
+    d = tmp_path / "v0"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.url, pulse_seconds=0.3).start()
+    try:
+        time.sleep(0.5)
+        with ThreadPoolExecutor(16) as pool:
+            fids = list(pool.map(
+                lambda i: operation.submit(master.url,
+                                           b"burst-%d" % i),
+                range(16)))
+        assert len(set(fids)) == 16
+        n_vols = len(vs.store.collect_heartbeat()["volumes"])
+        assert n_vols <= 2, (
+            f"concurrent assign burst grew {n_vols} volumes")
+        for i, fid in enumerate(fids):
+            assert operation.read(master.url, fid) == b"burst-%d" % i
+    finally:
+        vs.stop()
+        master.stop()
