@@ -99,6 +99,12 @@ def main(argv: list[str] | None = None) -> int:
     wk.add_argument("-backend", default="",
                     help="EC codec backend: jax|cpu (default: auto)")
 
+    mnt = sub.add_parser(
+        "mount", help="FUSE-mount a filer (read-only slice; "
+        "weed/mount analog — see seaweedfs_tpu/mount/DESIGN.md)")
+    mnt.add_argument("-filer", default="127.0.0.1:8888")
+    mnt.add_argument("-dir", required=True, help="mountpoint")
+
     mqb = sub.add_parser(
         "mq.broker", help="start a message-queue broker "
         "(mq/broker/broker_server.go)")
@@ -244,6 +250,10 @@ def main(argv: list[str] | None = None) -> int:
         w.start()
         print(f"worker {w.worker_id} polling {args.admin}")
         _wait()
+    elif args.cmd == "mount":
+        from .mount.fuse_ctypes import mount as fuse_mount
+        print(f"mounting filer {args.filer} at {args.dir} (read-only)")
+        return fuse_mount(args.filer, args.dir)
     elif args.cmd == "mq.broker":
         import signal
         from .mq import BrokerServer

@@ -1,0 +1,4 @@
+"""FUSE mount (weed/mount): filer-backed op table + ctypes libfuse
+bridge.  See DESIGN.md for the architecture and scope."""
+
+from .weedfs import FuseError, WeedFS  # noqa: F401

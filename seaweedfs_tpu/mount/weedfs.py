@@ -1,0 +1,209 @@
+"""The mount op table (weed/mount/weedfs.go + weedfs_*.go op files):
+filesystem operations answered from the filer's HTTP API, with a TTL'd
+metadata cache invalidated by the filer's metadata-event stream
+(mount/meta_cache + SubscribeMetadata in the reference).
+
+Pure Python and kernel-free: `fuse_ctypes.py` adapts this table to
+libfuse; tests drive it directly.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import stat as stat_mod
+import threading
+import time
+import urllib.parse
+
+from ..server.httpd import http_bytes, http_json
+
+
+class FuseError(OSError):
+    def __init__(self, err: int):
+        super().__init__(err, errno.errorcode.get(err, str(err)))
+        self.errno = err
+
+
+class WeedFS:
+    """Read-only slice: lookup/getattr, readdir, open/read, readlink
+    (weedfs_attr.go, weedfs_dir_read.go, weedfs_file_read.go)."""
+
+    MAX_CACHE_ENTRIES = 16384  # the reference's meta_cache is bounded
+
+    def __init__(self, filer: str, attr_ttl: float = 1.0,
+                 follow_events: bool = True):
+        self.filer = filer
+        self.attr_ttl = attr_ttl
+        self._cache: dict[str, tuple[float, dict | None]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._since_ns = time.time_ns()
+        self._event_thread: threading.Thread | None = None
+        if follow_events:
+            self._event_thread = threading.Thread(
+                target=self._follow_events, daemon=True)
+            self._event_thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+
+    # -- metadata cache (mount/meta_cache) --------------------------------
+
+    def _lookup(self, path: str) -> dict | None:
+        now = time.monotonic()
+        with self._lock:
+            hit = self._cache.get(path)
+            if hit is not None and now - hit[0] <= self.attr_ttl:
+                return hit[1]
+        if path == "/":
+            entry: dict | None = {"fullPath": "/",
+                                  "isDirectory": True,
+                                  "attributes": {"mode": 0o755}}
+        else:
+            st, body, _ = http_bytes(
+                "GET", f"{self.filer}/__meta__/lookup?path=" +
+                urllib.parse.quote(path))
+            if st == 404:
+                entry = None
+            elif st != 200:
+                raise FuseError(errno.EIO)
+            else:
+                entry = json.loads(body)
+        with self._lock:
+            if len(self._cache) >= self.MAX_CACHE_ENTRIES:
+                # evict expired first; a crawler stat-ing millions of
+                # distinct (incl. nonexistent) paths must not grow the
+                # mount's memory without bound
+                fresh = {p: v for p, v in self._cache.items()
+                         if now - v[0] <= self.attr_ttl}
+                if len(fresh) >= self.MAX_CACHE_ENTRIES:
+                    fresh = dict(sorted(
+                        fresh.items(), key=lambda kv: -kv[1][0]
+                    )[:self.MAX_CACHE_ENTRIES // 2])
+                self._cache = fresh
+            self._cache[path] = (now, entry)
+        return entry
+
+    def _invalidate(self, path: str) -> None:
+        with self._lock:
+            self._cache.pop(path, None)
+            parent = path.rsplit("/", 1)[0] or "/"
+            self._cache.pop(parent, None)
+
+    def _follow_events(self) -> None:
+        """Poll the filer's persistent metadata stream and invalidate
+        touched paths (the reference's mount cache invalidation via
+        SubscribeMetadata)."""
+        while not self._stop.wait(self.attr_ttl / 2):
+            try:
+                r = http_json(
+                    "GET", f"{self.filer}/__meta__/events"
+                           f"?sinceNs={self._since_ns}&limit=1000")
+            except OSError:
+                continue
+            for ev in r.get("events", []):
+                for side in ("newEntry", "oldEntry"):
+                    e = ev.get(side)
+                    if e:
+                        self._invalidate(e["fullPath"])
+                self._since_ns = max(self._since_ns,
+                                     int(ev.get("tsNs", 0)))
+
+    # -- ops (weedfs_attr.go GetAttr) -------------------------------------
+
+    @staticmethod
+    def _entry_stat(entry: dict) -> dict:
+        attrs = entry.get("attributes") or {}
+        if entry.get("isDirectory"):
+            mode = stat_mod.S_IFDIR | (attrs.get("mode", 0o755) & 0o7777)
+            size = 4096
+            nlink = 2
+        elif attrs.get("symlinkTarget"):
+            mode = stat_mod.S_IFLNK | 0o777
+            size = len(attrs["symlinkTarget"])
+            nlink = 1
+        else:
+            from ..filer.entry import FileChunk
+            from ..filer.filechunks import total_size
+            mode = stat_mod.S_IFREG | (attrs.get("mode", 0o644) & 0o7777)
+            # max-extent size, the SAME definition the filer serves
+            # bytes by — a summed size diverges on overlapping chunks
+            # and makes the kernel clamp reads short
+            size = total_size([FileChunk.from_json(c)
+                               for c in entry.get("chunks", [])])
+            nlink = 1
+        return {"st_mode": mode, "st_size": size, "st_nlink": nlink,
+                "st_uid": attrs.get("uid", 0),
+                "st_gid": attrs.get("gid", 0),
+                "st_mtime": float(attrs.get("mtime", 0) or 0),
+                "st_ctime": float(attrs.get("crtime", 0) or 0),
+                "st_atime": float(attrs.get("mtime", 0) or 0)}
+
+    def getattr(self, path: str) -> dict:
+        entry = self._lookup(path)
+        if entry is None:
+            raise FuseError(errno.ENOENT)
+        return self._entry_stat(entry)
+
+    def readdir(self, path: str) -> "list[str]":
+        entry = self._lookup(path)
+        if entry is None:
+            raise FuseError(errno.ENOENT)
+        if not entry.get("isDirectory"):
+            raise FuseError(errno.ENOTDIR)
+        names = [".", ".."]
+        last = ""
+        while True:
+            st, body, _ = http_bytes(
+                "GET", self.filer +
+                urllib.parse.quote(path.rstrip("/") + "/") +
+                "?limit=1000&lastFileName=" +
+                urllib.parse.quote(last))
+            if st != 200:
+                raise FuseError(errno.EIO)
+            batch = json.loads(body).get("entries", [])
+            names += [e["fullPath"].rsplit("/", 1)[-1] for e in batch]
+            if len(batch) < 1000:
+                return names
+            last = batch[-1]["fullPath"].rsplit("/", 1)[-1]
+
+    def open(self, path: str, flags: int = 0) -> int:
+        entry = self._lookup(path)
+        if entry is None:
+            raise FuseError(errno.ENOENT)
+        if entry.get("isDirectory"):
+            raise FuseError(errno.EISDIR)
+        import os
+        if flags & (os.O_WRONLY | os.O_RDWR):
+            raise FuseError(errno.EROFS)  # read-only slice
+        return 0
+
+    def read(self, path: str, size: int, offset: int) -> bytes:
+        """Ranged read through the filer (weedfs_file_read.go —
+        chunk-view resolution happens filer-side)."""
+        if size <= 0:
+            return b""
+        st, body, _ = http_bytes(
+            "GET", self.filer + urllib.parse.quote(path), None,
+            {"Range": f"bytes={offset}-{offset + size - 1}"})
+        if st in (200, 206):
+            return body if st == 206 else body[offset:offset + size]
+        if st == 404:
+            raise FuseError(errno.ENOENT)
+        raise FuseError(errno.EIO)
+
+    def readlink(self, path: str) -> str:
+        entry = self._lookup(path)
+        if entry is None:
+            raise FuseError(errno.ENOENT)
+        target = (entry.get("attributes") or {}).get("symlinkTarget")
+        if not target:
+            raise FuseError(errno.EINVAL)
+        return target
+
+    def statfs(self, path: str) -> dict:
+        return {"f_bsize": 4096, "f_frsize": 4096,
+                "f_blocks": 1 << 30, "f_bfree": 1 << 29,
+                "f_bavail": 1 << 29, "f_files": 1 << 20,
+                "f_ffree": 1 << 19, "f_namemax": 255}
