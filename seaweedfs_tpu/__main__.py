@@ -99,6 +99,13 @@ def main(argv: list[str] | None = None) -> int:
     wk.add_argument("-backend", default="",
                     help="EC codec backend: jax|cpu (default: auto)")
 
+    wd = sub.add_parser("webdav", help="WebDAV gateway attached to a "
+                        "running filer (server/webdav_server.go)")
+    wd.add_argument("-ip", default="127.0.0.1")
+    wd.add_argument("-port", type=int, default=7333)
+    wd.add_argument("-filer", default="127.0.0.1:8888",
+                    help="filer host:port whose namespace to serve")
+
     mnt = sub.add_parser(
         "mount", help="FUSE-mount a filer (read-only slice; "
         "weed/mount analog — see seaweedfs_tpu/mount/DESIGN.md)")
@@ -139,6 +146,12 @@ def main(argv: list[str] | None = None) -> int:
     bm.add_argument("-n", type=int, default=1000)
     bm.add_argument("-size", type=int, default=1024)
     bm.add_argument("-c", type=int, default=16)
+
+    sc = sub.add_parser("scaffold", help="print a commented template "
+                        "config (command/scaffold)")
+    sc.add_argument("-config", default="security",
+                    choices=["security"],
+                    help="which template to print")
 
     up = sub.add_parser("upload", help="upload a file")
     up.add_argument("-master", default="127.0.0.1:9333")
@@ -250,6 +263,15 @@ def main(argv: list[str] | None = None) -> int:
         w.start()
         print(f"worker {w.worker_id} polling {args.admin}")
         _wait()
+    elif args.cmd == "webdav":
+        # attach to the RUNNING filer's namespace (the reference's
+        # weed webdav -filer), not a private store
+        from .filer.client import FilerClient
+        from .server.webdav_server import WebDavServer
+        dav = WebDavServer("", FilerClient(args.filer), args.ip,
+                           args.port).start()
+        print(f"webdav on {dav.url} serving filer {args.filer}")
+        _wait()
     elif args.cmd == "mount":
         from .mount.fuse_ctypes import mount as fuse_mount
         print(f"mounting filer {args.filer} at {args.dir} (read-only)")
@@ -289,6 +311,34 @@ def main(argv: list[str] | None = None) -> int:
         from .benchmark import run_benchmark
         for r in run_benchmark(args.master, args.n, args.size, args.c):
             print(_json.dumps(r))
+    elif args.cmd == "scaffold":
+        # command/scaffold/security.toml layout (keys match
+        # util/config.go:34 LoadSecurityConfiguration)
+        print("""\
+# security.toml — place beside the binary or pass -securityToml
+# (command/scaffold/security.toml layout)
+
+[jwt.signing]
+# per-fid write tokens minted by the master on assign
+key = ""
+expires_after_seconds = 10
+
+[jwt.signing.read]
+# optional read-token gate on the volume data path
+key = ""
+expires_after_seconds = 10
+
+[access]
+# admin-plane key: guards /admin/*, raft, heartbeat, grow, lock
+admin_key = ""
+# CIDR whitelist for unauthenticated access (empty = no whitelist)
+white_list = []
+
+# NOTE: this build's control plane is plaintext HTTP — no TLS/mTLS
+# (the environment provides no certificate tooling); deploy inside a
+# trusted network or behind a TLS-terminating proxy.  The reference
+# additionally supports mTLS via [grpc] cert sections
+# (weed/security/tls.go).""")
     elif args.cmd == "upload":
         from . import operation
         data = open(args.file, "rb").read()

@@ -1,0 +1,116 @@
+"""Remote filer client SDK: the Filer-shaped API over a RUNNING filer
+server's HTTP surface (the analog of the reference's filer_pb client,
+used by `weed webdav -filer=...`, `weed mount`, filer.sync).
+
+Duck-typed to the in-process `Filer` for the read/write/namespace
+methods gateways consume, so WebDavServer (and future gateways) can be
+handed either — attaching to a shared namespace instead of spawning a
+private store.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+
+from ..server.httpd import http_bytes
+from .entry import Entry, normalize_path
+
+
+class FilerClient:
+    def __init__(self, filer: str):
+        self.filer = filer
+
+    def _url(self, path: str, suffix: str = "") -> str:
+        return self.filer + urllib.parse.quote(path) + suffix
+
+    # -- namespace --------------------------------------------------------
+
+    def find_entry(self, path: str) -> "Entry | None":
+        path = normalize_path(path)
+        if path == "/":
+            return Entry("/", is_directory=True)
+        st, body, _ = http_bytes(
+            "GET", f"{self.filer}/__meta__/lookup?path=" +
+            urllib.parse.quote(path))
+        if st == 404:
+            return None
+        if st != 200:
+            raise OSError(f"filer lookup {path}: {st}")
+        return Entry.from_json(json.loads(body))
+
+    def list_directory(self, path: str, start_file: str = "",
+                       include_start: bool = False, limit: int = 1000,
+                       prefix: str = "") -> "list[Entry]":
+        q = urllib.parse.urlencode({
+            "limit": limit, "lastFileName": start_file,
+            "prefix": prefix})
+        st, body, _ = http_bytes(
+            "GET", self._url(path.rstrip("/") + "/", "?" + q))
+        if st != 200:
+            raise OSError(f"filer list {path}: {st}")
+        return [Entry.from_json(e)
+                for e in json.loads(body).get("entries", [])]
+
+    def create_entry(self, entry: Entry,
+                     create_parents: bool = True) -> None:
+        if entry.is_directory:
+            st, _, _ = http_bytes(
+                "PUT", self._url(entry.full_path.rstrip("/") + "/"))
+            if st not in (200, 201):
+                raise OSError(f"filer mkdir {entry.full_path}: {st}")
+            return
+        raise NotImplementedError(
+            "create_entry for files: use write_file")
+
+    def delete_entry(self, path: str, recursive: bool = False,
+                     delete_chunks: bool = True) -> None:
+        st, body, _ = http_bytes(
+            "DELETE",
+            self._url(path, "?recursive=true" if recursive else ""))
+        if st == 409:
+            raise IsADirectoryError(body.decode(errors="replace"))
+        if st not in (204, 200, 404):
+            raise OSError(f"filer delete {path}: {st}")
+
+    def rename(self, old_path: str, new_path: str) -> None:
+        st, body, _ = http_bytes(
+            "POST", f"{self.filer}/__meta__/rename",
+            json.dumps({"oldPath": old_path,
+                        "newPath": new_path}).encode(),
+            {"Content-Type": "application/json"})
+        if st == 404:
+            raise FileNotFoundError(old_path)
+        if st != 200:
+            raise OSError(f"filer rename {old_path}: {st}")
+
+    # -- content ----------------------------------------------------------
+
+    def write_file(self, path: str, data: bytes, mime: str = "",
+                   mode: int = 0o660) -> Entry:
+        headers = {"Content-Type": mime} if mime else {}
+        st, body, _ = http_bytes("PUT", self._url(path), data, headers)
+        if st not in (200, 201):
+            raise OSError(f"filer write {path}: {st} "
+                          f"{body[:200]!r}")
+        entry = self.find_entry(path)
+        if entry is None:
+            raise OSError(f"filer write {path}: entry vanished")
+        return entry
+
+    def read_file(self, path: str, offset: int = 0,
+                  size: "int | None" = None) -> bytes:
+        headers = {}
+        if offset or size is not None:
+            end = "" if size is None else str(offset + size - 1)
+            headers["Range"] = f"bytes={offset}-{end}"
+        st, body, _ = http_bytes("GET", self._url(path), None, headers)
+        if st == 404:
+            raise FileNotFoundError(path)
+        if st == 416:
+            return b""
+        if st not in (200, 206):
+            raise OSError(f"filer read {path}: {st}")
+        if st == 200 and (offset or size is not None):
+            body = body[offset:offset + size if size else None]
+        return body

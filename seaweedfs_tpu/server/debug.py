@@ -1,0 +1,86 @@
+"""Debug/profiling plane (the analog of util/grace/pprof.go:16
+StartDebugServer — every reference role can expose a localhost pprof
+endpoint).
+
+Routes (admin-gated when the security plane is on, see
+httpd.is_admin_path):
+
+  GET /debug/stacks            — every thread's current stack
+  GET /debug/vars              — gc / thread / rss counters (expvar)
+  GET /debug/profile?seconds=N — statistical sampling profile:
+      samples sys._current_frames at ~10ms for N seconds and returns
+      collated (frames -> sample count), most-sampled first — the
+      Python stand-in for a CPU pprof.
+"""
+
+from __future__ import annotations
+
+import gc
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+
+from .httpd import HttpServer, Request
+
+
+def install_debug_routes(http: HttpServer) -> None:
+    http.route("GET", "/debug/stacks", _stacks)
+    http.route("GET", "/debug/vars", _vars)
+    http.route("GET", "/debug/profile", _profile)
+
+
+def _stacks(req: Request):
+    out = []
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(tid, '?')} ({tid}) ---")
+        out.extend(line.rstrip() for line in
+                   traceback.format_stack(frame))
+    return 200, ("\n".join(out).encode(), "text/plain")
+
+
+def _vars(req: Request):
+    rss_kb = 0
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    rss_kb = int(line.split()[1])
+    except OSError:
+        pass
+    counts = gc.get_count()
+    return 200, {
+        "threads": threading.active_count(),
+        "gcCounts": list(counts),
+        "gcObjects": len(gc.get_objects()),
+        "rssKb": rss_kb,
+        "uptimeHint": time.process_time(),
+    }
+
+
+def _profile(req: Request):
+    seconds = min(float(req.query.get("seconds", 2)), 30.0)
+    interval = 0.01
+    samples: Counter = Counter()
+    deadline = time.monotonic() + seconds
+    me = threading.get_ident()
+    n = 0
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = []
+            f = frame
+            while f is not None and len(stack) < 24:
+                stack.append(f"{f.f_code.co_filename.rsplit('/', 1)[-1]}"
+                             f":{f.f_lineno}:{f.f_code.co_name}")
+                f = f.f_back
+            samples[";".join(reversed(stack))] += 1
+        n += 1
+        time.sleep(interval)
+    lines = [f"samples: {n} over {seconds}s @ {interval * 1000:.0f}ms"]
+    for stack, count in samples.most_common(50):
+        lines.append(f"{count:6d}  {stack}")
+    return 200, ("\n".join(lines).encode(), "text/plain")

@@ -39,7 +39,22 @@ class FilerServer:
         self.http.route("POST", "/__meta__/set_attrs",
                         self._meta_set_attrs)
         self.http.route("GET", "/__meta__/events", self._meta_events)
+        from .debug import install_debug_routes
+        install_debug_routes(self.http)  # util/grace/pprof.go analog
+        self.http.guard = self._guard
         self.http.fallback = self._dispatch
+
+    def _guard(self, req: Request):
+        """Admin-plane gate (guard.go): the filer's /debug plane must
+        honor the same admin JWT as every other role."""
+        from .. import security
+        from .httpd import is_admin_path
+        if is_admin_path(req.path):
+            err = security.current().check_admin(
+                req.query, req.headers, req.remote_ip)
+            if err:
+                return 401, {"error": err}
+        return None
 
     def start(self):
         self.http.start()

@@ -35,9 +35,40 @@ class Request:
     @property
     def body(self) -> bytes:
         if self._body is None:
-            length = int(self.headers.get("Content-Length") or 0)
-            self._body = self._handler.rfile.read(length) if length else b""
+            te = (self.headers.get("Transfer-Encoding") or "").lower()
+            if "chunked" in te:
+                # RFC 9112 §7.1 — curl -T and many WebDAV clients
+                # stream uploads chunked with no Content-Length
+                self._body = self._read_chunked()
+            else:
+                length = int(self.headers.get("Content-Length") or 0)
+                self._body = self._handler.rfile.read(length) \
+                    if length else b""
         return self._body
+
+    def _read_chunked(self) -> bytes:
+        rfile = self._handler.rfile
+        out = bytearray()
+        while True:
+            size_line = rfile.readline(1024).strip()
+            try:
+                size = int(size_line.split(b";")[0], 16)
+            except ValueError:
+                # malformed framing: the stream position is unknown —
+                # poison-proof the connection by closing it after this
+                # response
+                self._handler.close_connection = True
+                break
+            if size == 0:
+                # drain optional trailers up to the blank line
+                while True:
+                    line = rfile.readline(1024)
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                break
+            out += rfile.read(size)
+            rfile.readline(8)  # CRLF after each chunk
+        return bytes(out)
 
     def json(self) -> dict:
         return json.loads(self.body or b"{}")
@@ -76,6 +107,14 @@ class HttpServer:
                         status, payload = 404, {"error": "not found"}
                 except Exception as e:  # noqa: BLE001 — server must answer
                     status, payload = 500, {"error": str(e)}
+                # drain any unread request body: a handler that ignores
+                # its body (e.g. PROPFIND's XML) would otherwise leave
+                # the bytes in the keep-alive stream to be parsed as
+                # the NEXT request line, poisoning the connection
+                try:
+                    _ = req.body
+                except Exception:  # noqa: BLE001 — close instead
+                    self.close_connection = True
                 extra_headers: dict = {}
                 if isinstance(payload, (dict, list)):
                     body = json.dumps(payload).encode()
@@ -104,6 +143,8 @@ class HttpServer:
 
             do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _dispatch
             do_OPTIONS = _dispatch  # CORS preflight (S3 gateway)
+            # WebDAV verbs (server/webdav_server.go)
+            do_PROPFIND = do_MKCOL = do_MOVE = do_COPY = _dispatch
 
             def log_message(self, *args):  # quiet
                 pass
@@ -159,7 +200,8 @@ def is_admin_path(path: str) -> bool:
     lock / raft endpoints, and heartbeats (all gRPC-only surfaces in the
     reference, gated there by grpc credentials — an unauthenticated
     raft RPC would let an outsider depose the leader)."""
-    return path.startswith(("/admin/", "/cluster/raft/")) or path in (
+    return path.startswith(("/admin/", "/cluster/raft/",
+                            "/debug/")) or path in (
         "/vol/grow", "/cluster/lease_admin_token",
         "/cluster/release_admin_token", "/heartbeat")
 

@@ -68,6 +68,8 @@ class MasterServer:
         r("POST", "/cluster/lease_admin_token", self._lease_admin)
         r("POST", "/cluster/release_admin_token", self._release_admin)
         r("GET", "/metrics", self._metrics)
+        from .debug import install_debug_routes
+        install_debug_routes(self.http)  # util/grace/pprof.go analog
         self.http.guard = self._guard
         if isinstance(peers, str):
             peers = [s.strip() for s in peers.split(",") if s.strip()]

@@ -81,6 +81,8 @@ class VolumeServer:
         r("POST", "/admin/scrub", self._scrub)
         r("POST", "/admin/ec/scrub", self._ec_scrub)
         r("GET", "/metrics", self._metrics)
+        from .debug import install_debug_routes
+        install_debug_routes(self.http)  # util/grace/pprof.go analog
         self.http.fallback = self._data_path
         self.http.guard = self._guard
         self._hb_stop = threading.Event()

@@ -220,19 +220,35 @@ class Volume:
 
     def compact(self) -> None:
         """Copy live needles to shadow .cpd/.cpx
-        (volume_vacuum.go:53 CompactByVolumeData)."""
+        (volume_vacuum.go:53 CompactByVolumeData).
+
+        The bulk copy runs WITHOUT the volume lock — writes keep
+        landing in the live .dat/.idx while a multi-GB compaction
+        streams — reading through a private handle over a snapshot of
+        the needle map.  commit_compact() replays everything appended
+        after the snapshot (the reference's makeupDiff,
+        volume_vacuum.go:241) before the rename."""
         if self.is_remote:
             raise PermissionError(
                 f"volume {self.id} is tiered to a remote backend; "
                 f"fetch it back before compacting")
+        cpd = self.file_name(".cpd")
+        cpx = self.file_name(".cpx")
         with self.lock:
-            cpd = self.file_name(".cpd")
-            cpx = self.file_name(".cpx")
+            if getattr(self, "_compacting", False):
+                raise RuntimeError(
+                    f"volume {self.id} is already compacting")
+            self._compacting = True
             # drop shadows left by a crashed previous compaction —
-            # NeedleMap would otherwise replay + append after stale entries
+            # NeedleMap would otherwise replay + append after stale
+            # entries
             for stale in (cpd, cpx):
                 if os.path.exists(stale):
                     os.remove(stale)
+            self._dat.flush()
+            self.nm.flush()
+            snapshot = sorted(self.nm.items(), key=lambda t: t[1])
+            idx_snapshot = os.path.getsize(self.file_name(".idx"))
             dst_sb = SuperBlock(
                 version=self.super_block.version,
                 replica_placement=self.super_block.replica_placement,
@@ -240,22 +256,60 @@ class Volume:
                 compaction_revision=(
                     self.super_block.compaction_revision + 1) & 0xFFFF,
                 extra=self.super_block.extra)
+        try:
             dst_nm = NeedleMap(cpx)
-            with open(cpd, "wb") as dst:
+            with open(self.file_name(".dat"), "rb") as src, \
+                    open(cpd, "wb") as dst:
                 dst.write(dst_sb.to_bytes())
-                for key, stored_off, size in sorted(
-                        self.nm.items(), key=lambda t: t[1]):
-                    n = self._read_at(stored_off, size)
+                for key, stored_off, size in snapshot:
+                    n = self._read_at_from(src, stored_off, size)
                     new_off = dst.tell()
                     dst.write(n.to_bytes(self.version))
-                    dst_nm.put(key, types.to_stored_offset(new_off), size)
+                    dst_nm.put(key, types.to_stored_offset(new_off),
+                               size)
             dst_nm.close()
+            self._idx_snapshot = idx_snapshot
+        except BaseException:
+            with self.lock:
+                self._compacting = False
+            raise
+
+    def _makeup_diff(self) -> None:
+        """Replay writes/deletes that landed AFTER the compaction
+        snapshot onto the shadow files (volume_vacuum.go:241
+        makeupDiff).  Caller holds the lock; the live .idx tail past
+        the snapshot byte offset is the authoritative diff."""
+        from . import idx as idxmod
+        idx_snapshot = getattr(self, "_idx_snapshot", None)
+        if idx_snapshot is None:
+            return
+        self._dat.flush()
+        self.nm.flush()
+        with open(self.file_name(".idx"), "rb") as f:
+            f.seek(idx_snapshot)
+            tail = f.read()
+        self._idx_snapshot = None
+        if not tail:
+            return
+        cpx_nm = NeedleMap(self.file_name(".cpx"))
+        with open(self.file_name(".cpd"), "r+b") as dst:
+            dst.seek(0, os.SEEK_END)
+            for key, off, size in idxmod.walk_index(tail):
+                if off == 0 or types.size_is_deleted(size):
+                    if cpx_nm.get(key) is not None:
+                        cpx_nm.delete(key)
+                    continue
+                n = self._read_at(off, size)
+                new_off = dst.tell()
+                dst.write(n.to_bytes(self.version))
+                cpx_nm.put(key, types.to_stored_offset(new_off), size)
+        cpx_nm.close()
 
     def commit_compact(self) -> None:
-        """Rename shadows over the live files and reload
-        (volume_vacuum.go:141 CommitCompact; single-writer process, so
-        the concurrent-write makeupDiff replay never has a diff)."""
+        """makeupDiff replay + rename shadows over the live files and
+        reload (volume_vacuum.go:141 CommitCompact)."""
         with self.lock:
+            self._makeup_diff()
             self.nm.close()
             self._dat.close()
             os.replace(self.file_name(".cpd"), self.file_name(".dat"))
@@ -264,6 +318,19 @@ class Volume:
             self.super_block = SuperBlock.read_from(self._dat)
             self._dat.seek(0, os.SEEK_END)
             self.nm = NeedleMap(self.file_name(".idx"))
+            self._compacting = False
+
+    def _read_at_from(self, src, stored_offset: int, size: int
+                      ) -> Needle:
+        """_read_at over a caller-supplied handle (the lock-free
+        compaction copy must not share the live handle's seek cursor
+        with concurrent writers)."""
+        offset = types.to_actual_offset(stored_offset)
+        length = get_actual_size(size, self.version)
+        src.seek(offset)
+        buf = src.read(length)
+        return Needle.from_bytes(buf, self.version,
+                                 expected_size=size, check_crc=True)
 
     def vacuum(self) -> None:
         self.compact()
