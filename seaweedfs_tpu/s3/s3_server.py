@@ -314,6 +314,15 @@ class S3ApiServer:
             return _error(400, "InvalidArgument",
                           f"key may not contain a segment ending "
                           f"{VERSIONS_EXT}")
+        if "uploads" in req.query or "uploadId" in req.query:
+            if any(k.lower().startswith(
+                    "x-amz-server-side-encryption")
+                    for k in req.headers):
+                # refusing beats a silent encryption downgrade: the
+                # multipart path does not encrypt parts yet
+                return _error(501, "NotImplemented",
+                              "SSE is not supported on multipart "
+                              "uploads")
         if "uploads" in req.query and req.method == "POST":
             return self._initiate_multipart(bucket, key)
         if "uploadId" in req.query:
@@ -324,13 +333,30 @@ class S3ApiServer:
             src = req.headers.get("x-amz-copy-source")
             if src:
                 return self._copy_object(req, src, path, bucket)
+            from .sse import (ALGO_HEADER, KEY_MD5_HEADER, SseError,
+                              encrypt, parse_sse_c_headers)
+            lower = {k.lower(): v for k, v in req.headers.items()}
+            try:
+                sse = parse_sse_c_headers(lower)
+            except SseError as e:
+                return _error(e.status, e.code, str(e))
+            body = req.body
+            sse_ext = {}
+            if sse is not None:
+                key_bytes, key_md5 = sse
+                body, iv_hex = encrypt(key_bytes, body)
+                sse_ext = {"sseKeyMd5": key_md5, "sseIv": iv_hex}
             with self._path_lock(path):
                 vid = self._pre_write_archive(path, state)
-                etag = hashlib.md5(req.body).hexdigest()
+                # SSE-C etag covers the CIPHERTEXT (a plaintext md5
+                # would leak content equality; AWS's SSE-C etag is
+                # likewise not the plaintext md5)
+                etag = hashlib.md5(body).hexdigest()
                 entry = self.filer.write_file(
-                    path, req.body,
+                    path, body,
                     mime=req.headers.get("Content-Type", ""))
                 entry.extended["etag"] = etag
+                entry.extended.update(sse_ext)
                 if vid is not None:
                     entry.extended["versionId"] = vid
                 amz = {k: v for k, v in req.headers.items()
@@ -338,6 +364,10 @@ class S3ApiServer:
                 entry.extended.update(amz)
                 self.filer.create_entry(entry)
             headers = {"ETag": f'"{etag}"'}
+            if sse is not None:
+                headers["x-amz-server-side-encryption-customer-"
+                        "algorithm"] = "AES256"
+                headers[KEY_MD5_HEADER] = sse[1]
             if vid:
                 headers["x-amz-version-id"] = vid
             return 200, (b"", headers)
@@ -406,14 +436,27 @@ class S3ApiServer:
         self.filer.rename(f"{vdir}/{newest.name}", path)
 
     def _serve_entry(self, req: Request, path: str, entry: Entry):
+        from .sse import KEY_MD5_HEADER, SseError, check_read_key, \
+            decrypt
+        lower = {k.lower(): v for k, v in req.headers.items()}
+        try:
+            sse_key = check_read_key(entry.extended, lower)
+        except SseError as e:
+            return _error(e.status, e.code, str(e))
         data = b"" if req.method == "HEAD" else \
             self.filer.read_file(path)
+        if sse_key is not None and data:
+            data = decrypt(sse_key, entry.extended["sseIv"], data)
         etag = entry.extended.get("etag", "")
         mime = entry.attributes.mime or "application/octet-stream"
         headers = {"Content-Type": mime,
                    "ETag": f'"{etag}"',
                    "Content-Length": str(total_size(entry.chunks)),
                    "Last-Modified": _iso(entry.attributes.mtime)}
+        if entry.extended.get("sseKeyMd5"):
+            headers["x-amz-server-side-encryption-customer-"
+                    "algorithm"] = "AES256"
+            headers[KEY_MD5_HEADER] = entry.extended["sseKeyMd5"]
         vid = entry.extended.get("versionId")
         if vid:
             headers["x-amz-version-id"] = vid
@@ -613,12 +656,35 @@ class S3ApiServer:
 
     def _copy_object(self, req: Request, src: str, dst_path: str,
                      bucket: str):
+        from .sse import (SseError, check_read_key, decrypt, encrypt,
+                          parse_sse_c_headers)
         src = urllib.parse.unquote(src.lstrip("/"))
         src_path = f"{BUCKETS_ROOT}/{src}"
         entry = self.filer.find_entry(src_path)
         if entry is None:
             return _error(404, "NoSuchKey", src)
+        lower = {k.lower(): v for k, v in req.headers.items()}
+        # SSE-C source: the copy-source key headers are REQUIRED to
+        # decrypt; copying raw ciphertext while dropping the SSE
+        # metadata would serve garbage as if it were plaintext
+        src_sse = {k.replace("x-amz-copy-source-server-side-"
+                             "encryption-customer-",
+                             "x-amz-server-side-encryption-customer-"):
+                   v for k, v in lower.items()
+                   if k.startswith("x-amz-copy-source-server-side-")}
+        try:
+            src_key = check_read_key(entry.extended, src_sse)
+            dst_sse = parse_sse_c_headers(lower)
+        except SseError as e:
+            return _error(e.status, e.code, str(e))
         data = self.filer.read_file(src_path)
+        if src_key is not None:
+            data = decrypt(src_key, entry.extended["sseIv"], data)
+        sse_ext = {}
+        if dst_sse is not None:
+            dst_key, dst_md5 = dst_sse
+            data, iv_hex = encrypt(dst_key, data)
+            sse_ext = {"sseKeyMd5": dst_md5, "sseIv": iv_hex}
         etag = hashlib.md5(data).hexdigest()
         with self._path_lock(dst_path):
             vid = self._pre_write_archive(
@@ -626,6 +692,7 @@ class S3ApiServer:
             new = self.filer.write_file(dst_path, data,
                                         mime=entry.attributes.mime)
             new.extended["etag"] = etag
+            new.extended.update(sse_ext)
             if vid is not None:
                 new.extended["versionId"] = vid
             self.filer.create_entry(new)

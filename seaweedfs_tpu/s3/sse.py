@@ -1,0 +1,97 @@
+"""SSE-C: server-side encryption with customer-provided keys
+(weed/s3api/s3_sse_c.go).
+
+The client supplies a 256-bit key per request; the server encrypts the
+object with AES-256-CTR under a random IV (stored in entry metadata,
+never the key), remembers only MD5(key) to verify later requests, and
+requires the SAME key headers on every GET/HEAD:
+
+  x-amz-server-side-encryption-customer-algorithm: AES256
+  x-amz-server-side-encryption-customer-key:      base64(32-byte key)
+  x-amz-server-side-encryption-customer-key-MD5:  base64(md5(key))
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+
+ALGO_HEADER = "x-amz-server-side-encryption-customer-algorithm"
+KEY_HEADER = "x-amz-server-side-encryption-customer-key"
+KEY_MD5_HEADER = "x-amz-server-side-encryption-customer-key-md5"
+
+
+class SseError(ValueError):
+    def __init__(self, status: int, code: str, message: str):
+        super().__init__(message)
+        self.status = status
+        self.code = code
+
+
+def parse_sse_c_headers(headers: dict) -> "tuple[bytes, str] | None":
+    """Returns (key, key_md5_b64) or None when no SSE-C headers.
+    Raises SseError on malformed/mismatched headers
+    (s3_sse_c.go validateSSECHeaders)."""
+    algo = headers.get(ALGO_HEADER, "")
+    key_b64 = headers.get(KEY_HEADER, "")
+    md5_b64 = headers.get(KEY_MD5_HEADER, "")
+    if not (algo or key_b64 or md5_b64):
+        return None
+    if algo != "AES256":
+        raise SseError(400, "InvalidArgument",
+                       f"unsupported SSE-C algorithm {algo!r}")
+    try:
+        key = base64.b64decode(key_b64)
+    except ValueError:
+        raise SseError(400, "InvalidArgument", "bad SSE-C key encoding")
+    if len(key) != 32:
+        raise SseError(400, "InvalidArgument",
+                       "SSE-C key must be 256 bits")
+    want_md5 = base64.b64encode(hashlib.md5(key).digest()).decode()
+    if md5_b64 != want_md5:
+        raise SseError(400, "InvalidArgument", "SSE-C key MD5 mismatch")
+    return key, md5_b64
+
+
+def encrypt(key: bytes, plaintext: bytes) -> "tuple[bytes, str]":
+    """AES-256-CTR under a fresh IV; returns (ciphertext, iv_hex)."""
+    from cryptography.hazmat.primitives.ciphers import (Cipher,
+                                                        algorithms,
+                                                        modes)
+    iv = os.urandom(16)
+    enc = Cipher(algorithms.AES(key), modes.CTR(iv)).encryptor()
+    return enc.update(plaintext) + enc.finalize(), iv.hex()
+
+
+def decrypt(key: bytes, iv_hex: str, ciphertext: bytes) -> bytes:
+    from cryptography.hazmat.primitives.ciphers import (Cipher,
+                                                        algorithms,
+                                                        modes)
+    dec = Cipher(algorithms.AES(key),
+                 modes.CTR(bytes.fromhex(iv_hex))).decryptor()
+    return dec.update(ciphertext) + dec.finalize()
+
+
+def check_read_key(entry_extended: dict, headers: dict
+                   ) -> "bytes | None":
+    """For a GET/HEAD of an object: returns the key to decrypt with,
+    None for unencrypted objects.  Raises SseError when the object is
+    encrypted and the request's key is absent or wrong
+    (s3_sse_c.go: 400 without key, 403 on mismatch)."""
+    stored_md5 = entry_extended.get("sseKeyMd5", "")
+    provided = parse_sse_c_headers(headers)
+    if not stored_md5:
+        if provided is not None:
+            raise SseError(400, "InvalidArgument",
+                           "object is not SSE-C encrypted")
+        return None
+    if provided is None:
+        raise SseError(
+            400, "InvalidRequest",
+            "object was stored with SSE-C; the key headers are "
+            "required to read it")
+    key, md5_b64 = provided
+    if md5_b64 != stored_md5:
+        raise SseError(403, "AccessDenied", "SSE-C key does not match")
+    return key

@@ -73,6 +73,8 @@ class FilerServer:
 
     def _dispatch(self, req: Request):
         path = req.path
+        if path.startswith("/__tus__/"):
+            return self._tus(req, path)
         if req.method in ("POST", "PUT"):
             return self._put(req, path)
         if req.method in ("GET", "HEAD"):
@@ -150,6 +152,114 @@ class FilerServer:
         except IsADirectoryError as e:
             return 409, {"error": str(e)}
         return 204, b""
+
+    # -- TUS resumable uploads (filer_server_tus_handlers.go) -------------
+
+    TUS_VERSION = "1.0.0"
+    _TUS_DIR = "/.tus"
+
+    def _tus(self, req: Request, path: str):
+        """tus.io core protocol: creation (POST), offset probe (HEAD),
+        append (PATCH), abort (DELETE).  Upload parts are staged as
+        filer files under /.tus/<id>/ — resumable across filer
+        restarts — and the completed upload materializes by STITCHING
+        the parts' chunk lists (no data copy, the multipart-complete
+        trick)."""
+        tus_headers = {"Tus-Resumable": self.TUS_VERSION,
+                       "Tus-Version": self.TUS_VERSION,
+                       "Tus-Extension": "creation,termination"}
+        if req.method == "OPTIONS":
+            return 204, (b"", tus_headers)
+        if req.method == "POST":
+            try:
+                length = int(req.headers.get("Upload-Length", -1))
+            except ValueError:
+                length = -1
+            target = req.query.get("path", "")
+            if length < 0 or not target:
+                return 400, {"error": "Upload-Length header and "
+                                      "?path= are required"}
+            import uuid as _uuid
+            uid = _uuid.uuid4().hex
+            marker = Entry(f"{self._TUS_DIR}/{uid}",
+                           is_directory=True)
+            marker.extended["tusTarget"] = target
+            marker.extended["tusLength"] = str(length)
+            self.filer.create_entry(marker)
+            h = dict(tus_headers)
+            h["Location"] = f"/__tus__/{uid}"
+            return 201, (b"", h)
+
+        uid = path[len("/__tus__/"):].strip("/")
+        if not uid or "/" in uid:
+            # an empty id would resolve to the /.tus staging ROOT —
+            # DELETE would then wipe every in-flight upload
+            return 404, {"error": "unknown upload"}
+        updir = f"{self._TUS_DIR}/{uid}"
+        marker = self.filer.find_entry(updir)
+        if marker is None or not marker.extended.get("tusTarget"):
+            return 404, {"error": "unknown upload"}
+        length = int(marker.extended.get("tusLength", 0))
+        parts = sorted(
+            (e for e in self.filer.list_directory(updir, limit=100000)
+             if e.name.endswith(".part")),
+            key=lambda e: int(e.name.split(".")[0]))
+        offset = sum(e.total_size() for e in parts)
+
+        if req.method == "HEAD":
+            h = dict(tus_headers)
+            h.update({"Upload-Offset": str(offset),
+                      "Upload-Length": str(length),
+                      "Cache-Control": "no-store"})
+            return 200, (b"", h)
+        if req.method == "DELETE":
+            self.filer.delete_entry(updir, recursive=True)
+            return 204, (b"", tus_headers)
+        if req.method == "PATCH":
+            try:
+                claimed = int(req.headers.get("Upload-Offset", -1))
+            except ValueError:
+                claimed = -1
+            if claimed != offset:
+                # 409: the client's view of the offset is stale
+                h = dict(tus_headers)
+                h["Upload-Offset"] = str(offset)
+                return 409, (b"", h)
+            data = req.body
+            if offset + len(data) > length:
+                return 413, {"error": "upload exceeds Upload-Length"}
+            self.filer.write_file(f"{updir}/{offset:020d}.part", data)
+            offset += len(data)
+            if offset == length:
+                # materialize: stitch part chunk lists, zero data copy
+                target = marker.extended["tusTarget"]
+                chunks = []
+                base = 0
+                parts = sorted(
+                    (e for e in self.filer.list_directory(
+                        updir, limit=100000)
+                     if e.name.endswith(".part")),
+                    key=lambda e: int(e.name.split(".")[0]))
+                for p in parts:
+                    for c in p.chunks:
+                        chunks.append(type(c)(
+                            c.file_id, base + c.offset, c.size,
+                            c.e_tag, c.mtime_ns))
+                    base += p.total_size()
+                old = self.filer.find_entry(target)
+                final = Entry(target, chunks=chunks)
+                self.filer.create_entry(final)
+                if old is not None and not old.is_directory:
+                    # reclaim the replaced file's chunks, matching
+                    # write_file's overwrite semantics — create_entry
+                    # alone would orphan them on the volume servers
+                    self.filer._delete_chunks(old)
+                self.filer.delete_entry(updir, recursive=True,
+                                        delete_chunks=False)
+            h = dict(tus_headers)
+            h["Upload-Offset"] = str(offset)
+            return 204, (b"", h)
+        return 405, {"error": f"method {req.method} not allowed"}
 
     # -- meta RPC mirrors -------------------------------------------------
 

@@ -145,6 +145,7 @@ class HttpServer:
             do_OPTIONS = _dispatch  # CORS preflight (S3 gateway)
             # WebDAV verbs (server/webdav_server.go)
             do_PROPFIND = do_MKCOL = do_MOVE = do_COPY = _dispatch
+            do_PATCH = _dispatch  # TUS resumable uploads
 
             def log_message(self, *args):  # quiet
                 pass

@@ -195,7 +195,8 @@ class VolumeServer:
         if err:
             return 401, {"error": err}
         if req.method in ("GET", "HEAD"):
-            return self._get_needle(fid, req.headers.get("Range", ""))
+            return self._get_needle(fid, req.headers.get("Range", ""),
+                                    req.query)
         if req.method in ("POST", "PUT"):
             self.metrics.counter_add("received_bytes", len(req.body))
             return self._put_needle(fid, req)
@@ -214,7 +215,8 @@ class VolumeServer:
         return 200, (self.metrics.render().encode(),
                      "text/plain; version=0.0.4")
 
-    def _get_needle(self, fid: types.FileId, rng: str = ""):
+    def _get_needle(self, fid: types.FileId, rng: str = "",
+                    query: "dict | None" = None):
         try:
             n = self.store.read_needle(fid.volume_id, fid.key,
                                        cookie=fid.cookie,
@@ -225,6 +227,17 @@ class VolumeServer:
             return 404, {"error": str(e)}
         mime = n.mime.decode() if n.mime else "application/octet-stream"
         data = n.data
+        if query and ("width" in query or "height" in query):
+            # resize-on-read (volume_server_handlers_read.go:353 ->
+            # images/resizing.go)
+            from .. import images
+            try:
+                w = int(query.get("width", 0))
+                h = int(query.get("height", 0))
+            except ValueError:
+                w = h = 0
+            data = images.resized(data, mime, w, h,
+                                  query.get("mode", ""))
         # ranged needle reads keep the filer's chunk-view reads from
         # overfetching whole chunks (volume_server_handlers_read.go
         # serves Range on the data path)

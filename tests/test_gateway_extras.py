@@ -1,0 +1,245 @@
+"""Images resize-on-read, S3 SSE-C, and TUS resumable uploads (the
+analogs of weed/images/, weed/s3api/s3_sse_c.go,
+weed/server/filer_server_tus_handlers.go)."""
+
+import base64
+import hashlib
+import io
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.s3 import S3ApiServer
+from seaweedfs_tpu.s3.auth import sign_request
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.httpd import http_bytes
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+AK, SK = "ssekey", "ssesecret"
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer().start()
+    servers = [VolumeServer([str(tmp_path / f"v{i}")], master.url,
+                            pulse_seconds=0.3).start() for i in range(2)]
+    time.sleep(0.5)
+    filer = FilerServer(master.url).start()
+    gw = S3ApiServer(filer.filer, credentials={AK: SK}).start()
+    yield master, servers, filer, gw
+    gw.stop()
+    filer.stop()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+# --- images (resize on read) ---------------------------------------------
+
+def _png(w, h, color=(200, 30, 30)):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.new("RGB", (w, h), color).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def test_volume_resize_on_read(cluster):
+    from PIL import Image
+    master, *_ = cluster
+    fid = operation.submit(master.url, _png(400, 200),
+                           name="pic.png", mime="image/png")
+    locs = operation.lookup(master.url, int(fid.split(",")[0]))
+    url = locs[0]["url"]
+    st, body, _ = http_bytes("GET", f"{url}/{fid}?width=100")
+    assert st == 200
+    img = Image.open(io.BytesIO(body))
+    assert img.size == (100, 50)  # aspect preserved
+    st, body, _ = http_bytes("GET",
+                             f"{url}/{fid}?width=50&height=50&mode=fit")
+    assert Image.open(io.BytesIO(body)).size == (50, 50)
+    # no params: byte-identical original
+    st, body, _ = http_bytes("GET", f"{url}/{fid}")
+    assert body == _png(400, 200)
+    # upscale request: original served (never upscale)
+    st, body, _ = http_bytes("GET", f"{url}/{fid}?width=4000")
+    assert Image.open(io.BytesIO(body)).size == (400, 200)
+
+
+def test_resized_unit_non_image_passthrough():
+    from seaweedfs_tpu.images import resized
+    blob = b"definitely not an image"
+    assert resized(blob, "application/octet-stream", 100, 0) == blob
+    assert resized(blob, "image/png", 100, 0) == blob  # malformed: as-is
+
+
+# --- S3 SSE-C ------------------------------------------------------------
+
+def _sse_headers(key: bytes) -> dict:
+    return {
+        "x-amz-server-side-encryption-customer-algorithm": "AES256",
+        "x-amz-server-side-encryption-customer-key":
+            base64.b64encode(key).decode(),
+        "x-amz-server-side-encryption-customer-key-MD5":
+            base64.b64encode(hashlib.md5(key).digest()).decode(),
+    }
+
+
+def s3req(gw, method, path, body=b"", headers=None):
+    headers = dict(headers or {})
+    signed = sign_request(method, gw.url, path, {}, headers, body,
+                          AK, SK)
+    return http_bytes(method, f"{gw.url}{path}", body or None, signed)
+
+
+def test_sse_c_roundtrip_and_key_enforcement(cluster):
+    *_, filer, gw = cluster
+    key = b"K" * 32
+    s3req(gw, "PUT", "/sec")
+    payload = b"top secret payload" * 100
+    st, _, h = s3req(gw, "PUT", "/sec/doc.bin", payload,
+                     _sse_headers(key))
+    assert st == 200, h
+    assert h["x-amz-server-side-encryption-customer-algorithm"] == \
+        "AES256"
+    # at rest: the filer-stored bytes are NOT the plaintext
+    stored = filer.filer.read_file("/buckets/sec/doc.bin")
+    assert stored != payload and len(stored) == len(payload)
+    # GET with the right key decrypts
+    st, body, _ = s3req(gw, "GET", "/sec/doc.bin",
+                        headers=_sse_headers(key))
+    assert st == 200 and body == payload
+    # no key -> 400; wrong key -> 403
+    st, body, _ = s3req(gw, "GET", "/sec/doc.bin")
+    assert st == 400
+    st, body, _ = s3req(gw, "GET", "/sec/doc.bin",
+                        headers=_sse_headers(b"W" * 32))
+    assert st == 403
+    # bad key md5 on PUT rejected
+    bad = _sse_headers(key)
+    bad["x-amz-server-side-encryption-customer-key-MD5"] = \
+        base64.b64encode(b"0" * 16).decode()
+    st, _, _ = s3req(gw, "PUT", "/sec/x.bin", b"x", bad)
+    assert st == 400
+    # unencrypted object + key headers -> 400
+    s3req(gw, "PUT", "/sec/plain.bin", b"plain")
+    st, _, _ = s3req(gw, "GET", "/sec/plain.bin",
+                     headers=_sse_headers(key))
+    assert st == 400
+
+
+# --- TUS -----------------------------------------------------------------
+
+def _raw(url, method, path, body=None, headers=None):
+    r = urllib.request.Request(f"http://{url}{path}", data=body,
+                               method=method,
+                               headers=dict(headers or {}))
+    try:
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def test_tus_resumable_upload(cluster):
+    _, _, filer, _ = cluster
+    payload = bytes(range(256)) * 64  # 16KB
+    st, _, h = _raw(filer.url, "POST",
+                    "/__tus__/?path=/up/big.bin",
+                    headers={"Tus-Resumable": "1.0.0",
+                             "Upload-Length": str(len(payload))})
+    assert st == 201 and h["Tus-Resumable"] == "1.0.0"
+    loc = h["Location"]
+
+    # chunked PATCHes with offset verification
+    mid = len(payload) // 2
+    st, _, h = _raw(filer.url, "PATCH", loc, payload[:mid],
+                    headers={"Tus-Resumable": "1.0.0",
+                             "Upload-Offset": "0",
+                             "Content-Type":
+                                 "application/offset+octet-stream"})
+    assert st == 204 and h["Upload-Offset"] == str(mid)
+    # stale offset -> 409 with the real offset
+    st, _, h = _raw(filer.url, "PATCH", loc, b"dup",
+                    headers={"Upload-Offset": "0"})
+    assert st == 409 and h["Upload-Offset"] == str(mid)
+    # HEAD probe (what a resuming client does after a crash)
+    st, _, h = _raw(filer.url, "HEAD", loc)
+    assert h["Upload-Offset"] == str(mid)
+    assert h["Upload-Length"] == str(len(payload))
+    # finish
+    st, _, h = _raw(filer.url, "PATCH", loc, payload[mid:],
+                    headers={"Upload-Offset": str(mid)})
+    assert st == 204 and h["Upload-Offset"] == str(len(payload))
+    # materialized, byte-identical, staging cleaned
+    assert filer.filer.read_file("/up/big.bin") == payload
+    assert _raw(filer.url, "HEAD", loc)[0] == 404
+
+
+def test_tus_overflow_and_abort(cluster):
+    _, _, filer, _ = cluster
+    st, _, h = _raw(filer.url, "POST", "/__tus__/?path=/up/x.bin",
+                    headers={"Upload-Length": "10"})
+    loc = h["Location"]
+    st, _, _ = _raw(filer.url, "PATCH", loc, b"0123456789AB",
+                    headers={"Upload-Offset": "0"})
+    assert st == 413  # exceeds declared length
+    st, _, _ = _raw(filer.url, "DELETE", loc)
+    assert st == 204
+    assert _raw(filer.url, "HEAD", loc)[0] == 404
+
+
+def test_resize_preserves_jpeg_format():
+    from PIL import Image
+    from seaweedfs_tpu.images import resized
+    buf = io.BytesIO()
+    Image.new("RGB", (300, 300), (9, 9, 9)).save(buf, format="JPEG")
+    out = resized(buf.getvalue(), "image/jpeg", 100, 0)
+    assert Image.open(io.BytesIO(out)).format == "JPEG", \
+        "resized JPEG must stay JPEG (not re-encode as PNG)"
+
+
+def test_sse_c_copy_object(cluster):
+    *_, filer, gw = cluster
+    key = b"C" * 32
+    s3req(gw, "PUT", "/cpb")
+    payload = b"copy-me-encrypted" * 50
+    s3req(gw, "PUT", "/cpb/enc.bin", payload, _sse_headers(key))
+    # copy WITHOUT the copy-source key headers: refused, never serves
+    # ciphertext-as-plaintext
+    st, _, _ = s3req(gw, "PUT", "/cpb/copy.bin",
+                     headers={"x-amz-copy-source": "/cpb/enc.bin"})
+    assert st == 400
+    # with the copy-source key: decrypted plaintext copy
+    src_hdrs = {"x-amz-copy-source": "/cpb/enc.bin"}
+    for k, v in _sse_headers(key).items():
+        src_hdrs[k.replace(
+            "x-amz-server-side-encryption-customer-",
+            "x-amz-copy-source-server-side-encryption-customer-")] = v
+    st, _, _ = s3req(gw, "PUT", "/cpb/copy.bin", headers=src_hdrs)
+    assert st == 200
+    st, body, _ = s3req(gw, "GET", "/cpb/copy.bin")
+    assert st == 200 and body == payload
+    # re-encrypt under a NEW key during copy
+    key2 = b"D" * 32
+    hdrs = dict(src_hdrs)
+    hdrs.update(_sse_headers(key2))
+    st, _, _ = s3req(gw, "PUT", "/cpb/copy2.bin", headers=hdrs)
+    assert st == 200
+    assert s3req(gw, "GET", "/cpb/copy2.bin")[0] == 400  # needs key2
+    st, body, _ = s3req(gw, "GET", "/cpb/copy2.bin",
+                        headers=_sse_headers(key2))
+    assert body == payload
+
+
+def test_multipart_refuses_sse(cluster):
+    *_, gw = cluster
+    s3req(gw, "PUT", "/mpb")
+    signed = sign_request("POST", gw.url, "/mpb/x",
+                          {"uploads": ""}, _sse_headers(b"E" * 32),
+                          b"", AK, SK)
+    st, body, _ = http_bytes("POST", f"{gw.url}/mpb/x?uploads=",
+                             None, signed)
+    assert st == 501 and b"NotImplemented" in body
