@@ -66,6 +66,7 @@ class AdminServer:
         r("POST", "/worker/detection_result", self._detection_result)
         r("POST", "/worker/progress", self._progress)     # JobProgressUpdate
         r("POST", "/worker/complete", self._complete)     # JobCompleted
+        r("GET", "/", self._ui)
         r("GET", "/maintenance/queue", self._queue)
         r("POST", "/maintenance/trigger_detection", self._trigger)
         r("POST", "/maintenance/submit_job", self._submit_job)
@@ -158,6 +159,65 @@ class AdminServer:
                 self._dedupe[key] = job.job_id
                 accepted.append(job.job_id)
         return 200, {"accepted": accepted}
+
+    def _ui(self, req: Request):
+        """Status page (the minimal analog of the reference's admin web
+        UI, weed/admin/view/ — live topology, workers, job queue)."""
+        import html as _html
+        try:
+            from ..operation import master_json
+            vl = master_json(self.master, "GET", "/vol/list")
+            status = master_json(self.master, "GET", "/cluster/status")
+        except OSError:
+            vl, status = {}, {}
+        rows = []
+        for dc_name, dc in vl.get("dataCenters", {}).items():
+            for rack_name, rack in dc.get("racks", {}).items():
+                for node in rack.get("nodes", []):
+                    rows.append(
+                        f"<tr><td>{_html.escape(dc_name)}/"
+                        f"{_html.escape(rack_name)}</td>"
+                        f"<td>{_html.escape(node['url'])}</td>"
+                        f"<td>{len(node.get('volumes', []))}/"
+                        f"{node.get('maxVolumeCount', '?')}</td>"
+                        f"<td>{len(node.get('ecShards', []))}</td>"
+                        f"</tr>")
+        with self.lock:
+            workers = [
+                f"<tr><td>{_html.escape(w.worker_id)}</td>"
+                f"<td>{_html.escape(', '.join(sorted(str(c.get('jobType', '?')) for c in w.capabilities)))}</td>"
+                f"<td>{w.inflight}/{w.max_concurrent}</td>"
+                f"<td>{time.time() - w.last_seen:.0f}s ago</td></tr>"
+                for w in self.workers.values()]
+            jobs = [
+                f"<tr><td>{j.job_id}</td>"
+                f"<td>{_html.escape(j.job_type)}</td>"
+                f"<td>{_html.escape(j.status)}</td>"
+                f"<td>{j.progress:.0%}</td>"
+                f"<td>{_html.escape(j.message or '')}</td></tr>"
+                for j in sorted(self.jobs.values(),
+                                key=lambda j: -j.created)[:50]]
+        body = f"""<!doctype html><html><head>
+<title>seaweedfs-tpu admin</title>
+<style>body{{font-family:sans-serif;margin:2em}}
+table{{border-collapse:collapse;margin:1em 0}}
+td,th{{border:1px solid #ccc;padding:4px 10px;text-align:left}}
+h2{{margin-top:1.5em}}</style></head><body>
+<h1>seaweedfs-tpu admin</h1>
+<p>master: {_html.escape(self.master)} &middot; leader:
+{_html.escape(str(status.get('leader', '?')))} &middot; topology:
+{_html.escape(str(status.get('topologyId', '?')))}</p>
+<h2>Data nodes</h2>
+<table><tr><th>dc/rack</th><th>url</th><th>volumes</th>
+<th>ec volumes</th></tr>{''.join(rows)}</table>
+<h2>Workers</h2>
+<table><tr><th>id</th><th>capabilities</th><th>inflight</th>
+<th>seen</th></tr>{''.join(workers)}</table>
+<h2>Jobs (latest 50)</h2>
+<table><tr><th>id</th><th>type</th><th>status</th><th>progress</th>
+<th>message</th></tr>{''.join(jobs)}</table>
+</body></html>"""
+        return 200, (body.encode(), "text/html; charset=utf-8")
 
     def _submit_job(self, req: Request):
         """Operator-submitted job (the analog of dispatching work from

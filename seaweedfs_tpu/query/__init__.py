@@ -1,0 +1,5 @@
+"""Query engine (weed/query/engine/): SQL-subset select over stored
+JSON/CSV objects, served by the volume Query RPC
+(volume_server.proto:132) and the S3 Select surface."""
+
+from .engine import QueryError, run_query  # noqa: F401

@@ -1,0 +1,192 @@
+"""SQL-subset evaluation over JSON-lines / CSV byte streams
+(weed/query/engine/ — the reference evaluates SELECTs over parquet and
+JSON files stored as needles, served by volume_server.proto:132 Query
+and s3 SelectObjectContent).
+
+Supported grammar (the core of AWS S3 Select / the reference's tests):
+
+    SELECT <* | col[, col...]> FROM s3object
+      [WHERE <col> <op> <literal> [AND ...]]
+      [LIMIT <n>]
+
+ops: = != <> < <= > >=      literals: 'str' | number | true | false
+Column access supports dotted paths into nested JSON (a.b.c).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+import re
+
+
+class QueryError(ValueError):
+    pass
+
+
+_SQL_RE = re.compile(
+    r"^\s*select\s+(?P<cols>.+?)\s+from\s+s3object\s*"
+    r"(?:\s+where\s+(?P<where>.+?))?"
+    r"(?:\s+limit\s+(?P<limit>\d+))?\s*;?\s*$",
+    re.IGNORECASE | re.DOTALL)
+
+_COND_RE = re.compile(
+    r"^\s*(?P<col>[\w.\"]+)\s*(?P<op>=|!=|<>|<=|>=|<|>)\s*"
+    r"(?P<val>'(?:[^']|'')*'|[-\w.]+)\s*$")
+
+_OPS = {
+    "=": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<>": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+}
+
+
+def _split_conjuncts(where: str) -> "list[str]":
+    """Split a WHERE clause on AND — but only OUTSIDE single-quoted
+    literals ('black and white' must stay one token; '' escapes a
+    quote)."""
+    parts: list[str] = []
+    buf: list[str] = []
+    i, n = 0, len(where)
+    in_quote = False
+    while i < n:
+        c = where[i]
+        if c == "'":
+            if in_quote and i + 1 < n and where[i + 1] == "'":
+                buf.append("''")
+                i += 2
+                continue
+            in_quote = not in_quote
+            buf.append(c)
+            i += 1
+            continue
+        if not in_quote and where[i:i + 3].lower() == "and" and \
+                (i == 0 or where[i - 1].isspace()) and \
+                (i + 3 >= n or where[i + 3].isspace()):
+            parts.append("".join(buf))
+            buf = []
+            i += 3
+            continue
+        buf.append(c)
+        i += 1
+    parts.append("".join(buf))
+    return [p for p in (s.strip() for s in parts) if p]
+
+
+def _parse_literal(tok: str):
+    if tok.startswith("'"):
+        return tok[1:-1].replace("''", "'")
+    low = tok.lower()
+    if low == "true":
+        return True
+    if low == "false":
+        return False
+    if low == "null":
+        return None
+    try:
+        return int(tok)
+    except ValueError:
+        try:
+            return float(tok)
+        except ValueError:
+            raise QueryError(f"bad literal {tok!r}")
+
+
+def parse_sql(sql: str) -> dict:
+    m = _SQL_RE.match(sql)
+    if not m:
+        raise QueryError(f"unsupported SQL: {sql!r}")
+    cols_raw = m.group("cols").strip()
+    cols = None if cols_raw == "*" else \
+        [c.strip().strip('"') for c in cols_raw.split(",")]
+    conds = []
+    if m.group("where"):
+        for part in _split_conjuncts(m.group("where")):
+            cm = _COND_RE.match(part)
+            if not cm:
+                raise QueryError(f"unsupported condition {part!r}")
+            conds.append((cm.group("col").strip('"'), cm.group("op"),
+                          _parse_literal(cm.group("val"))))
+    limit = int(m.group("limit")) if m.group("limit") else None
+    return {"cols": cols, "conds": conds, "limit": limit}
+
+
+def _get_path(row: dict, col: str):
+    cur = row
+    for part in col.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _matches(row: dict, conds) -> bool:
+    for col, op, want in conds:
+        got = _get_path(row, col)
+        if got is None and want is not None:
+            return False
+        # CSV fields arrive as strings; coerce toward the literal type
+        if isinstance(want, (int, float)) and isinstance(got, str):
+            try:
+                got = float(got) if isinstance(want, float) else \
+                    int(got)
+            except ValueError:
+                return False
+        try:
+            if not _OPS[op](got, want):
+                return False
+        except TypeError:
+            return False
+    return True
+
+
+def _rows_from(data: bytes, input_format: str,
+               csv_header: bool = True):
+    if input_format == "json":
+        for line in data.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except ValueError:
+                raise QueryError("malformed JSON record")
+    elif input_format == "csv":
+        text = data.decode("utf-8", errors="replace")
+        reader = csv.reader(io.StringIO(text))
+        rows = iter(reader)
+        if csv_header:
+            header = next(rows, None)
+            if header is None:
+                return
+            for r in rows:
+                yield dict(zip(header, r))
+        else:
+            for r in rows:
+                yield {f"_{i + 1}": v for i, v in enumerate(r)}
+    else:
+        raise QueryError(f"unsupported input format {input_format!r}")
+
+
+def run_query(sql: str, data: bytes, input_format: str = "json",
+              csv_header: bool = True) -> "list[dict]":
+    """Evaluate; returns the projected rows."""
+    q = parse_sql(sql)
+    if q["limit"] == 0:
+        return []
+    out = []
+    for row in _rows_from(data, input_format, csv_header):
+        if not _matches(row, q["conds"]):
+            continue
+        if q["cols"] is None:
+            out.append(row)
+        else:
+            out.append({c: _get_path(row, c) for c in q["cols"]})
+        if q["limit"] is not None and len(out) >= q["limit"]:
+            break
+    return out

@@ -314,6 +314,8 @@ class S3ApiServer:
             return _error(400, "InvalidArgument",
                           f"key may not contain a segment ending "
                           f"{VERSIONS_EXT}")
+        if "select" in req.query and req.method == "POST":
+            return self._select_object(req, bucket, key)
         if "uploads" in req.query or "uploadId" in req.query:
             if any(k.lower().startswith(
                     "x-amz-server-side-encryption")
@@ -376,6 +378,63 @@ class S3ApiServer:
         if req.method == "DELETE":
             return self._delete_object(req, bucket, key, path, state)
         return _error(405, "MethodNotAllowed", req.method)
+
+    def _select_object(self, req: Request, bucket: str, key: str):
+        """SelectObjectContent (POST /bucket/key?select&select-type=2):
+        SQL-subset over a JSON-lines/CSV object (weed/query/engine/).
+        Results return as newline-delimited JSON records — the
+        reference's own engine output shape; the AWS event-stream
+        framing is NOT implemented (documented divergence)."""
+        from ..query import QueryError, run_query
+        from .sse import SseError, check_read_key, decrypt
+        path = f"{self._bucket_path(bucket)}/{key}"
+        entry = self.filer.find_entry(path)
+        if entry is None or entry.is_directory:
+            return _error(404, "NoSuchKey", key)
+        # SSE-C: select is a READ — it must enforce and use the
+        # customer key exactly like GET (querying raw ciphertext would
+        # both leak it and never match)
+        lower = {k.lower(): v for k, v in req.headers.items()}
+        try:
+            sse_key = check_read_key(entry.extended, lower)
+        except SseError as e:
+            return _error(e.status, e.code, str(e))
+        try:
+            root = ET.fromstring(req.body)
+        except ET.ParseError as e:
+            return _error(400, "MalformedXML", str(e))
+        expression = ""
+        input_format = "json"
+        csv_header = True
+        for el in root.iter():
+            tag = el.tag.rsplit("}", 1)[-1]
+            if tag == "Expression":
+                expression = el.text or ""
+            elif tag == "InputSerialization":
+                # only the INPUT block decides the source format
+                # (OutputSerialization may also contain <CSV>)
+                for sub in el.iter():
+                    stag = sub.tag.rsplit("}", 1)[-1]
+                    if stag == "CSV":
+                        input_format = "csv"
+                    elif stag == "FileHeaderInfo":
+                        csv_header = \
+                            (sub.text or "").upper() != "NONE"
+        if not expression:
+            return _error(400, "MissingRequiredParameter",
+                          "Expression is required")
+        data = self.filer.read_file(path)
+        if sse_key is not None and data:
+            data = decrypt(sse_key, entry.extended["sseIv"], data)
+        try:
+            rows = run_query(expression, data, input_format,
+                             csv_header)
+        except QueryError as e:
+            return _error(400, "InvalidTextEncoding", str(e))
+        import json as _json
+        body = b"".join(_json.dumps(r, separators=(",", ":"))
+                        .encode() + b"\n" for r in rows)
+        return 200, (body, "application/x-ndjson")
 
     # -- versioning core (s3api_object_versioning.go) ---------------------
 

@@ -72,6 +72,7 @@ class VolumeServer:
         r("POST", "/admin/ec/to_volume", self._ec_to_volume)
         r("GET", "/admin/ec/shard_read", self._ec_shard_read)
         r("GET", "/admin/ec/info", self._ec_info)
+        r("POST", "/admin/query", self._query)
         r("POST", "/admin/tier_move", self._tier_move)
         r("POST", "/admin/tier_fetch", self._tier_fetch)
         r("GET", "/admin/volume_index", self._volume_index)
@@ -440,6 +441,30 @@ class VolumeServer:
         garbage = v.garbage_level()
         v.vacuum()
         return 200, {"garbageRatio": garbage}
+
+    def _query(self, req: Request):
+        """volume_server.proto:132 Query (server/volume_grpc_query.go):
+        evaluate a SQL-subset SELECT over one stored needle's JSON/CSV
+        content, returning matched rows — the compute-pushdown shape
+        (filtering happens where the bytes live)."""
+        from ..query import QueryError, run_query
+        b = req.json()
+        vid = int(b["volumeId"])
+        key = int(b["key"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return 404, {"error": f"volume {vid} not found"}
+        try:
+            n = v.read_needle(key)
+        except KeyError as e:
+            return 404, {"error": str(e)}
+        try:
+            rows = run_query(b["expression"], n.data,
+                             b.get("inputFormat", "json"),
+                             bool(b.get("csvHeader", True)))
+        except QueryError as e:
+            return 400, {"error": str(e)}
+        return 200, {"rows": rows, "count": len(rows)}
 
     def _tier_move(self, req: Request):
         """volume_server.proto VolumeTierMoveDatToRemote

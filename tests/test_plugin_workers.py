@@ -158,3 +158,19 @@ def test_batch_ec_job_multi_volume(harness):
         assert total == 14, f"volume {vid}: {total} shards"
     for fid, want in blobs.items():
         assert operation.read(master.url, fid) == want, fid
+
+
+def test_admin_ui_status_page(harness):
+    """The admin's minimal web UI (weed/admin view analog) renders
+    topology, workers, and the job queue."""
+    import urllib.request
+    master, servers, admin, worker = harness
+    with urllib.request.urlopen(f"http://{admin.url}/",
+                                timeout=10) as r:
+        html = r.read().decode()
+    assert "seaweedfs-tpu admin" in html
+    assert worker.worker_id in html
+    assert "erasure_coding" in html
+    # all four volume servers listed
+    for vs in servers:
+        assert vs.url in html

@@ -1,0 +1,201 @@
+"""Query engine tests (weed/query/engine/ analog): SQL-subset parse +
+evaluation, the volume Query RPC, and S3 SelectObjectContent."""
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.query import QueryError, run_query
+from seaweedfs_tpu.query.engine import parse_sql
+from seaweedfs_tpu.s3 import S3ApiServer
+from seaweedfs_tpu.s3.auth import sign_request
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.httpd import http_bytes, http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+ROWS = [
+    {"name": "alpha", "size": 10, "tags": {"tier": "hot"}},
+    {"name": "beta", "size": 250, "tags": {"tier": "cold"}},
+    {"name": "gamma", "size": 40, "tags": {"tier": "hot"}},
+]
+JSONL = b"".join(json.dumps(r).encode() + b"\n" for r in ROWS)
+CSV = b"name,size\nalpha,10\nbeta,250\ngamma,40\n"
+
+
+# --- engine unit ---------------------------------------------------------
+
+def test_parse_sql_shapes():
+    q = parse_sql("SELECT * FROM s3object")
+    assert q == {"cols": None, "conds": [], "limit": None}
+    q = parse_sql("select name, size from s3object "
+                  "where size > 20 and name != 'beta' limit 5")
+    assert q["cols"] == ["name", "size"]
+    assert q["conds"] == [("size", ">", 20), ("name", "!=", "beta")]
+    assert q["limit"] == 5
+    with pytest.raises(QueryError):
+        parse_sql("DROP TABLE s3object")
+    with pytest.raises(QueryError):
+        parse_sql("select * from s3object where name like 'a%'")
+
+
+def test_run_query_json():
+    assert run_query("select * from s3object", JSONL) == ROWS
+    assert run_query(
+        "select name from s3object where size >= 40", JSONL) == \
+        [{"name": "beta"}, {"name": "gamma"}]
+    # dotted paths into nested JSON
+    assert run_query(
+        "select name from s3object where tags.tier = 'hot'",
+        JSONL) == [{"name": "alpha"}, {"name": "gamma"}]
+    assert run_query("select * from s3object limit 1", JSONL) == \
+        [ROWS[0]]
+    # escaped quote literal
+    assert run_query(
+        "select * from s3object where name = 'it''s'", JSONL) == []
+
+
+def test_run_query_csv():
+    got = run_query("select name from s3object where size > 20",
+                    CSV, input_format="csv")
+    assert got == [{"name": "beta"}, {"name": "gamma"}]
+    # headerless CSV: positional columns _1, _2...
+    got = run_query("select _1 from s3object where _2 = '250'",
+                    b"beta,250\ngamma,40\n", input_format="csv",
+                    csv_header=False)
+    assert got == [{"_1": "beta"}]
+
+
+# --- volume Query RPC + S3 Select ----------------------------------------
+
+AK, SK = "qk", "qs"
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer().start()
+    servers = [VolumeServer([str(tmp_path / f"v{i}")], master.url,
+                            pulse_seconds=0.3).start() for i in range(2)]
+    time.sleep(0.5)
+    filer = FilerServer(master.url).start()
+    gw = S3ApiServer(filer.filer, credentials={AK: SK}).start()
+    yield master, servers, filer, gw
+    gw.stop()
+    filer.stop()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def test_volume_query_rpc(cluster):
+    master, *_ = cluster
+    fid = operation.submit(master.url, JSONL, name="rows.jsonl")
+    vid = int(fid.split(",")[0])
+    key = int(fid.split(",")[1][:-8], 16)
+    url = operation.lookup(master.url, vid)[0]["url"]
+    r = http_json("POST", f"{url}/admin/query", {
+        "volumeId": vid, "key": key,
+        "expression": "select name from s3object where size > 20"})
+    assert r["count"] == 2
+    assert [row["name"] for row in r["rows"]] == ["beta", "gamma"]
+    r = http_json("POST", f"{url}/admin/query", {
+        "volumeId": vid, "key": key, "expression": "garbage"})
+    assert "error" in r
+
+
+def test_s3_select(cluster):
+    *_, gw = cluster
+    def s3req(method, path, body=b"", query=None, headers=None):
+        query = query or {}
+        headers = sign_request(method, gw.url, path, query,
+                               dict(headers or {}), body, AK, SK)
+        qs = "&".join(f"{k}={v}" for k, v in query.items())
+        return http_bytes(method,
+                          f"{gw.url}{path}" + (f"?{qs}" if qs else ""),
+                          body or None, headers)
+
+    s3req("PUT", "/qb")
+    s3req("PUT", "/qb/rows.jsonl", JSONL)
+    req_xml = (b"<SelectObjectContentRequest>"
+               b"<Expression>select name from s3object where "
+               b"tags.tier = 'hot'</Expression>"
+               b"<ExpressionType>SQL</ExpressionType>"
+               b"<InputSerialization><JSON><Type>LINES</Type></JSON>"
+               b"</InputSerialization>"
+               b"<OutputSerialization><JSON/></OutputSerialization>"
+               b"</SelectObjectContentRequest>")
+    st, body, h = s3req("POST", "/qb/rows.jsonl", req_xml,
+                        query={"select": "", "select-type": "2"})
+    assert st == 200, body
+    rows = [json.loads(line) for line in body.splitlines()]
+    assert rows == [{"name": "alpha"}, {"name": "gamma"}]
+    # CSV input
+    s3req("PUT", "/qb/rows.csv", CSV)
+    req_xml = (b"<SelectObjectContentRequest>"
+               b"<Expression>select name from s3object where "
+               b"size >= 40</Expression>"
+               b"<InputSerialization><CSV><FileHeaderInfo>USE"
+               b"</FileHeaderInfo></CSV></InputSerialization>"
+               b"<OutputSerialization><CSV/></OutputSerialization>"
+               b"</SelectObjectContentRequest>")
+    st, body, _ = s3req("POST", "/qb/rows.csv", req_xml,
+                        query={"select": "", "select-type": "2"})
+    assert st == 200
+    rows = [json.loads(line) for line in body.splitlines()]
+    assert rows == [{"name": "beta"}, {"name": "gamma"}]
+
+
+def test_query_review_regressions():
+    """Quoted 'and' inside literals, LIMIT 0 semantics."""
+    data = (b'{"name": "black and white", "size": 1}\n'
+            b'{"name": "plain", "size": 2}\n')
+    got = run_query(
+        "select size from s3object where name = 'black and white'",
+        data)
+    assert got == [{"size": 1}]
+    got = run_query("select * from s3object where "
+                    "name = 'black and white' and size = 1", data)
+    assert len(got) == 1
+    assert run_query("select * from s3object limit 0", data) == []
+
+
+def test_s3_select_enforces_sse_c(cluster):
+    """?select is a READ: the SSE-C key is required and used, exactly
+    like GET — querying ciphertext would both leak and never match."""
+    import base64
+    import hashlib
+    *_, gw = cluster
+
+    def s3req(method, path, body=b"", query=None, headers=None):
+        query = query or {}
+        headers = sign_request(method, gw.url, path, query,
+                               dict(headers or {}), body, AK, SK)
+        qs = "&".join(f"{k}={v}" for k, v in query.items())
+        return http_bytes(method,
+                          f"{gw.url}{path}" + (f"?{qs}" if qs else ""),
+                          body or None, headers)
+
+    key = b"Q" * 32
+    sse = {
+        "x-amz-server-side-encryption-customer-algorithm": "AES256",
+        "x-amz-server-side-encryption-customer-key":
+            base64.b64encode(key).decode(),
+        "x-amz-server-side-encryption-customer-key-MD5":
+            base64.b64encode(hashlib.md5(key).digest()).decode(),
+    }
+    s3req("PUT", "/qsec")
+    s3req("PUT", "/qsec/rows.jsonl", JSONL, headers=sse)
+    xml = (b"<SelectObjectContentRequest><Expression>"
+           b"select name from s3object where size > 20"
+           b"</Expression></SelectObjectContentRequest>")
+    st, body, _ = s3req("POST", "/qsec/rows.jsonl", xml,
+                        query={"select": "", "select-type": "2"})
+    assert st == 400  # no key
+    st, body, _ = s3req("POST", "/qsec/rows.jsonl", xml,
+                        query={"select": "", "select-type": "2"},
+                        headers=sse)
+    assert st == 200
+    rows = [json.loads(line) for line in body.splitlines()]
+    assert rows == [{"name": "beta"}, {"name": "gamma"}]
