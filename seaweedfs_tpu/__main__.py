@@ -147,6 +147,12 @@ def main(argv: list[str] | None = None) -> int:
     bm.add_argument("-size", type=int, default=1024)
     bm.add_argument("-c", type=int, default=16)
 
+    crt = sub.add_parser("cert", help="mint a cluster PKI (CA + node "
+                         "cert) for the TLS plane (security/tls.go)")
+    crt.add_argument("-dir", default="certs")
+    crt.add_argument("-hosts", default="127.0.0.1,localhost",
+                     help="comma-separated SAN hosts/IPs")
+
     sc = sub.add_parser("scaffold", help="print a commented template "
                         "config (command/scaffold)")
     sc.add_argument("-config", default="security",
@@ -311,6 +317,15 @@ def main(argv: list[str] | None = None) -> int:
         from .benchmark import run_benchmark
         for r in run_benchmark(args.master, args.n, args.size, args.c):
             print(_json.dumps(r))
+    elif args.cmd == "cert":
+        from .tls import generate_cluster_certs
+        paths = generate_cluster_certs(
+            args.dir, [h.strip() for h in args.hosts.split(",")
+                       if h.strip()])
+        print(f"wrote {paths['ca']}, {paths['cert']}, {paths['key']}")
+        print("enable via security.toml:\n[tls]\n"
+              f'ca = "{paths["ca"]}"\ncert = "{paths["cert"]}"\n'
+              f'key = "{paths["key"]}"\nmtls = true')
     elif args.cmd == "scaffold":
         # command/scaffold/security.toml layout (keys match
         # util/config.go:34 LoadSecurityConfiguration)
@@ -334,11 +349,13 @@ admin_key = ""
 # CIDR whitelist for unauthenticated access (empty = no whitelist)
 white_list = []
 
-# NOTE: this build's control plane is plaintext HTTP — no TLS/mTLS
-# (the environment provides no certificate tooling); deploy inside a
-# trusted network or behind a TLS-terminating proxy.  The reference
-# additionally supports mTLS via [grpc] cert sections
-# (weed/security/tls.go).""")
+# [tls]
+# cluster-wide TLS/mTLS (security/tls.go; mint a PKI with
+# `python -m seaweedfs_tpu cert -dir certs`)
+# ca = "certs/ca.crt"
+# cert = "certs/node.crt"
+# key = "certs/node.key"
+# mtls = true""")
     elif args.cmd == "upload":
         from . import operation
         data = open(args.file, "rb").read()

@@ -126,6 +126,10 @@ class SecurityConfig:
     admin_key: str = ""
     admin_expires_sec: int = 60
     white_list: list[str] = field(default_factory=list)
+    # TLS/mTLS for the whole plane (weed/security/tls.go; [tls] in
+    # security.toml).  When set, every HttpServer wraps its socket and
+    # every client helper dials https with the cluster CA pinned.
+    tls: "object | None" = None  # tls.TlsConfig
 
     # -- data-path tokens (per-fid claims, jwt.go SeaweedFileIdClaims) --
 
@@ -244,7 +248,25 @@ def load_security_toml(path: str) -> SecurityConfig:
     read = signing.get("read", {})
     access = t.get("access", {})
     admin = t.get("admin", {})
+    tls_t = t.get("tls", {})
+    tls_cfg = None
+    if tls_t:
+        missing = [k for k in ("ca", "cert", "key")
+                   if not tls_t.get(k)]
+        if missing:
+            # failing HERE names the security.toml key; failing later
+            # would be an opaque OpenSSL error deep inside a request
+            raise ValueError(
+                f"security.toml [tls] requires ca/cert/key; "
+                f"missing: {', '.join(missing)}")
+        from .tls import TlsConfig
+        tls_cfg = TlsConfig(
+            ca_cert=tls_t["ca"],
+            cert=tls_t["cert"],
+            key=tls_t["key"],
+            require_client_cert=bool(tls_t.get("mtls", False)))
     return SecurityConfig(
+        tls=tls_cfg,
         volume_write_key=signing.get("key", ""),
         volume_write_expires_sec=int(
             signing.get("expires_after_seconds", 10)),

@@ -153,6 +153,27 @@ class HttpServer:
         class Server(ThreadingHTTPServer):
             daemon_threads = True
             allow_reuse_address = True
+            ssl_context = None  # set by start() when the TLS plane is on
+
+            def finish_request(self, request, client_address):
+                # TLS handshake PER CONNECTION in the handler thread —
+                # wrapping the listening socket would handshake inside
+                # the single accept loop, letting one silent client
+                # stall every role and wedge shutdown
+                if self.ssl_context is not None:
+                    import ssl as _ssl
+                    try:
+                        request.settimeout(10)
+                        request = self.ssl_context.wrap_socket(
+                            request, server_side=True)
+                        request.settimeout(None)
+                    except (_ssl.SSLError, OSError):
+                        try:
+                            request.close()
+                        except OSError:
+                            pass
+                        return
+                super().finish_request(request, client_address)
 
         self._httpd = Server((host, port), Handler)
         self.host = host
@@ -163,6 +184,12 @@ class HttpServer:
         self.routes[(method, path)] = fn
 
     def start(self) -> None:
+        tls = _tls_config()
+        if tls is not None:
+            # TLS plane (weed/security/tls.go); connections handshake
+            # in their handler threads (Server.finish_request), with
+            # mTLS only CA-signed peers get through
+            self._httpd.ssl_context = tls.server_context()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
@@ -177,6 +204,24 @@ class HttpServer:
 
 
 # --- tiny client helpers -------------------------------------------------
+
+def _tls_config():
+    from .. import security
+    return security.current().tls
+
+
+def _dial(url: str) -> "tuple[str, object | None]":
+    """(full url, ssl context) — https with the cluster CA pinned when
+    the TLS plane is on; plain http otherwise.  Single funnel: every
+    role's client traffic passes through http_bytes/http_json."""
+    tls = _tls_config()
+    if url.startswith("http"):
+        return url, (tls.client_context() if tls and
+                     url.startswith("https") else None)
+    if tls is not None:
+        return "https://" + url, tls.client_context()
+    return "http://" + url, None
+
 
 def _auth_for(url: str, headers: dict | None) -> dict:
     """Attach the process admin JWT to admin-plane requests — the analog
@@ -218,11 +263,13 @@ def http_json(method: str, url: str, payload: dict | None = None,
     headers = dict(headers or {})
     if data:
         headers.setdefault("Content-Type", "application/json")
+    full_url, ctx = _dial(url)
     req = urllib.request.Request(
-        ("http://" + url) if not url.startswith("http") else url,
-        data=data, method=method, headers=_auth_for(url, headers))
+        full_url, data=data, method=method,
+        headers=_auth_for(url, headers))
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
+        with urllib.request.urlopen(req, timeout=timeout,
+                                    context=ctx) as resp:
             return json.loads(resp.read() or b"{}")
     except urllib.error.HTTPError as e:
         body = e.read() or b"{}"
@@ -237,11 +284,13 @@ def http_json(method: str, url: str, payload: dict | None = None,
 def http_bytes(method: str, url: str, body: bytes | None = None,
                headers: dict | None = None, timeout: float = 60.0
                ) -> tuple[int, bytes, dict]:
+    full_url, ctx = _dial(url)
     req = urllib.request.Request(
-        ("http://" + url) if not url.startswith("http") else url,
-        data=body, method=method, headers=_auth_for(url, headers))
+        full_url, data=body, method=method,
+        headers=_auth_for(url, headers))
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
+        with urllib.request.urlopen(req, timeout=timeout,
+                                    context=ctx) as resp:
             return resp.status, resp.read(), dict(resp.headers)
     except urllib.error.HTTPError as e:
         return e.code, e.read(), dict(e.headers)
