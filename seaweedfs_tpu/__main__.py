@@ -133,6 +133,16 @@ def main(argv: list[str] | None = None) -> int:
     fsync.add_argument("-interval", type=float, default=0.5,
                        help="poll interval seconds when idle")
 
+    fbak = sub.add_parser(
+        "filer.backup", help="continuously mirror a filer into a "
+        "local directory (command/filer_backup.go)")
+    fbak.add_argument("-filer", required=True,
+                      help="source filer host:port")
+    fbak.add_argument("-dir", required=True, help="backup root")
+    fbak.add_argument("-state", default="",
+                      help="offset checkpoint file")
+    fbak.add_argument("-interval", type=float, default=0.5)
+
     sh = sub.add_parser("shell", help="interactive admin shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
     sh.add_argument("-filer", default="",
@@ -303,6 +313,16 @@ def main(argv: list[str] | None = None) -> int:
               f"(offset state: {syncer.state_path})")
         try:
             syncer.run()
+        except KeyboardInterrupt:
+            pass
+    elif args.cmd == "filer.backup":
+        from .filer.filer_backup import FilerBackup
+        bak = FilerBackup(args.filer, args.dir, args.state or None,
+                          poll_interval=args.interval)
+        print(f"filer.backup {args.filer} -> {args.dir} "
+              f"(offset state: {bak.state_path})")
+        try:
+            bak.run()
         except KeyboardInterrupt:
             pass
     elif args.cmd == "shell":

@@ -218,6 +218,141 @@ class S3ApiServer:
             return 204, b""
         return _error(405, "MethodNotAllowed", req.method)
 
+    # -- object lock (s3api_object_retention.go, object lock) -------------
+
+    LOCK_MODES = ("GOVERNANCE", "COMPLIANCE")
+
+    def _bucket_object_lock_op(self, req: Request, bucket: str):
+        path = self._bucket_path(bucket)
+        e = self.filer.find_entry(path)
+        if e is None:
+            return _error(404, "NoSuchBucket", bucket)
+        if req.method == "PUT":
+            if self._versioning_state(bucket) != "Enabled":
+                return _error(409, "InvalidBucketState",
+                              "object lock requires versioning")
+            try:
+                root = ET.fromstring(req.body)
+            except ET.ParseError as err:
+                return _error(400, "MalformedXML", str(err))
+            mode, days = "", 0
+            try:
+                for el in root.iter():
+                    tag = el.tag.rsplit("}", 1)[-1]
+                    if tag == "Mode":
+                        mode = (el.text or "").strip().upper()
+                    elif tag in ("Days", "Years"):
+                        days = int(el.text or 0) * \
+                            (365 if tag == "Years" else 1)
+            except ValueError as err:
+                return _error(400, "MalformedXML", str(err))
+            if mode and mode not in self.LOCK_MODES:
+                return _error(400, "MalformedXML",
+                              f"bad retention mode {mode!r}")
+            if mode and days <= 0:
+                return _error(400, "MalformedXML",
+                              "retention needs positive Days/Years")
+            e.extended["objectLock"] = "Enabled"
+            # PUT replaces the WHOLE configuration: a config without a
+            # Rule removes any previous default retention
+            if mode:
+                e.extended["lockDefaultMode"] = mode
+                e.extended["lockDefaultDays"] = str(days)
+            else:
+                e.extended.pop("lockDefaultMode", None)
+                e.extended.pop("lockDefaultDays", None)
+            self.filer.create_entry(e, create_parents=False)
+            return 200, b""
+        if req.method == "GET":
+            if e.extended.get("objectLock") != "Enabled":
+                return _error(404,
+                              "ObjectLockConfigurationNotFoundError",
+                              bucket)
+            root = ET.Element("ObjectLockConfiguration", xmlns=S3_NS)
+            _elem(root, "ObjectLockEnabled", "Enabled")
+            if e.extended.get("lockDefaultMode"):
+                rule = _elem(root, "Rule")
+                ret = _elem(rule, "DefaultRetention")
+                _elem(ret, "Mode", e.extended["lockDefaultMode"])
+                _elem(ret, "Days", e.extended.get("lockDefaultDays",
+                                                  "0"))
+            return 200, (_xml(root), "application/xml")
+        return _error(405, "MethodNotAllowed", req.method)
+
+    @staticmethod
+    def _parse_retain_until(text: str) -> float:
+        import calendar
+        # timegm, NOT mktime-timezone: the date is UTC; mktime reads
+        # the struct in LOCAL time and is an hour off under DST
+        return calendar.timegm(time.strptime(
+            text.split(".")[0].rstrip("Z"), "%Y-%m-%dT%H:%M:%S"))
+
+    def _lock_for_put(self, req: Request, bucket: str,
+                      state: str) -> "dict | tuple":
+        """Resolve the retention to stamp on a new object version:
+        explicit x-amz-object-lock-* headers, else the bucket default.
+        Returns extended-dict updates, or an error response tuple.
+        `state` is the caller's already-fetched versioning state (no
+        redundant bucket lookups on the hot write path)."""
+        lower = {k.lower(): v for k, v in req.headers.items()}
+        mode = lower.get("x-amz-object-lock-mode", "").upper()
+        until_raw = lower.get("x-amz-object-lock-retain-until-date",
+                              "")
+        if mode or until_raw:
+            if mode not in self.LOCK_MODES or not until_raw:
+                return _error(400, "InvalidArgument",
+                              "object-lock mode AND retain-until-date "
+                              "are both required")
+            if state != "Enabled":
+                return _error(400, "InvalidRequest",
+                              "object lock requires versioning")
+            try:
+                until = self._parse_retain_until(until_raw)
+            except ValueError:
+                return _error(400, "InvalidArgument",
+                              f"bad retain-until date {until_raw!r}")
+            return {"lockMode": mode, "lockRetainUntil": str(until)}
+        if state != "Enabled":
+            # defaults only stamp real versions; never 'null' ones a
+            # suspended bucket could silently destroy
+            return {}
+        b = self.filer.find_entry(self._bucket_path(bucket))
+        if b is not None and b.extended.get("lockDefaultMode"):
+            days = int(b.extended.get("lockDefaultDays", 0))
+            return {"lockMode": b.extended["lockDefaultMode"],
+                    "lockRetainUntil":
+                        str(time.time() + days * 86400)}
+        return {}
+
+    @classmethod
+    def _retention_active(cls, extended: dict) -> "str | None":
+        """The active lock mode, or None when unlocked/expired."""
+        mode = extended.get("lockMode", "")
+        try:
+            until = float(extended.get("lockRetainUntil", 0))
+        except ValueError:
+            until = 0
+        if mode in cls.LOCK_MODES and time.time() < until:
+            return mode
+        return None
+
+    def _check_version_deletable(self, req: Request, extended: dict):
+        """403 response tuple when retention forbids deleting this
+        version; None when allowed.  GOVERNANCE yields to the bypass
+        header (the AWS permission model's s3:BypassGovernanceRetention
+        reduced to the header check our auth model supports)."""
+        mode = self._retention_active(extended)
+        if mode is None:
+            return None
+        if mode == "GOVERNANCE":
+            lower = {k.lower(): v for k, v in req.headers.items()}
+            if lower.get("x-amz-bypass-governance-retention",
+                         "").lower() == "true":
+                return None
+        return _error(403, "AccessDenied",
+                      f"version is locked ({mode}) until "
+                      f"{extended.get('lockRetainUntil')}")
+
     # -- versioning state (s3api_bucket_handlers.go) ----------------------
 
     def _versioning_state(self, bucket: str) -> str:
@@ -240,6 +375,13 @@ class S3ApiServer:
             if status not in ("Enabled", "Suspended"):
                 return _error(400, "MalformedXML",
                               f"bad versioning status {status!r}")
+            if status == "Suspended" and \
+                    e.extended.get("objectLock") == "Enabled":
+                # AWS forbids this: suspension would let 'null'
+                # versions overwrite/delete locked data
+                return _error(409, "InvalidBucketState",
+                              "versioning cannot be suspended on an "
+                              "object-lock-enabled bucket")
             e.extended["versioning"] = status
             self.filer.create_entry(e, create_parents=False)
             return 200, b""
@@ -272,6 +414,8 @@ class S3ApiServer:
         path = self._bucket_path(bucket)
         if "versioning" in req.query:
             return self._bucket_versioning_op(req, bucket)
+        if "object-lock" in req.query:
+            return self._bucket_object_lock_op(req, bucket)
         if "cors" in req.query:
             return self._bucket_cors_op(req, bucket)
         if "versions" in req.query and req.method == "GET":
@@ -348,6 +492,9 @@ class S3ApiServer:
                 key_bytes, key_md5 = sse
                 body, iv_hex = encrypt(key_bytes, body)
                 sse_ext = {"sseKeyMd5": key_md5, "sseIv": iv_hex}
+            lock_ext = self._lock_for_put(req, bucket, state)
+            if not isinstance(lock_ext, dict):
+                return lock_ext  # error response
             with self._path_lock(path):
                 vid = self._pre_write_archive(path, state)
                 # SSE-C etag covers the CIPHERTEXT (a plaintext md5
@@ -359,6 +506,7 @@ class S3ApiServer:
                     mime=req.headers.get("Content-Type", ""))
                 entry.extended["etag"] = etag
                 entry.extended.update(sse_ext)
+                entry.extended.update(lock_ext)
                 if vid is not None:
                     entry.extended["versionId"] = vid
                 amz = {k: v for k, v in req.headers.items()
@@ -516,6 +664,13 @@ class S3ApiServer:
             headers["x-amz-server-side-encryption-customer-"
                     "algorithm"] = "AES256"
             headers[KEY_MD5_HEADER] = entry.extended["sseKeyMd5"]
+        if entry.extended.get("lockMode"):
+            headers["x-amz-object-lock-mode"] = \
+                entry.extended["lockMode"]
+            until = float(entry.extended.get("lockRetainUntil", 0))
+            headers["x-amz-object-lock-retain-until-date"] = \
+                time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                              time.gmtime(until))
         vid = entry.extended.get("versionId")
         if vid:
             headers["x-amz-version-id"] = vid
@@ -567,7 +722,8 @@ class S3ApiServer:
                               key: str, path: str, state: str):
         vid = req.query.get("versionId", "")
         if vid:
-            return self._delete_specific_version(bucket, path, vid)
+            return self._delete_specific_version(bucket, path, vid,
+                                                 req)
         if state in ("Enabled", "Suspended"):
             # archive the incumbent and leave a delete marker
             # (createDeleteMarker, s3api_object_versioning.go:160)
@@ -596,16 +752,26 @@ class S3ApiServer:
         return 204, b""
 
     def _delete_specific_version(self, bucket: str, path: str,
-                                 vid: str):
+                                 vid: str, req: "Request | None" = None):
         was_marker = False
         cur = self.filer.find_entry(path)
         if cur is not None and not cur.is_directory and \
                 cur.extended.get("versionId", "null") == vid:
+            if req is not None:
+                blocked = self._check_version_deletable(
+                    req, cur.extended)
+                if blocked is not None:
+                    return blocked
             self.filer.delete_entry(path)
         else:
             vpath = f"{path}{VERSIONS_EXT}/{vid}"
             e = self.filer.find_entry(vpath)
             if e is not None:
+                if req is not None:
+                    blocked = self._check_version_deletable(
+                        req, e.extended)
+                    if blocked is not None:
+                        return blocked
                 was_marker = e.extended.get("deleteMarker") == "true"
                 self.filer.delete_entry(vpath)
         self._promote_latest(path)
@@ -744,6 +910,13 @@ class S3ApiServer:
             dst_key, dst_md5 = dst_sse
             data, iv_hex = encrypt(dst_key, data)
             sse_ext = {"sseKeyMd5": dst_md5, "sseIv": iv_hex}
+        # the copy is a new version: retention headers / bucket default
+        # apply exactly like a plain PUT (silently skipping them would
+        # bypass the bucket's retention policy)
+        lock_ext = self._lock_for_put(
+            req, bucket, self._versioning_state(bucket))
+        if not isinstance(lock_ext, dict):
+            return lock_ext
         etag = hashlib.md5(data).hexdigest()
         with self._path_lock(dst_path):
             vid = self._pre_write_archive(
@@ -752,6 +925,7 @@ class S3ApiServer:
                                         mime=entry.attributes.mime)
             new.extended["etag"] = etag
             new.extended.update(sse_ext)
+            new.extended.update(lock_ext)
             if vid is not None:
                 new.extended["versionId"] = vid
             self.filer.create_entry(new)
@@ -780,14 +954,28 @@ class S3ApiServer:
             if not key:
                 continue
             path = f"{self._bucket_path(bucket)}/{key}"
+            failed = None
             if vid:
                 with self._path_lock(path):
-                    self._delete_specific_version(bucket, path, vid)
+                    r = self._delete_specific_version(bucket, path,
+                                                      vid, req)
+                if r[0] >= 300:
+                    failed = r
             elif state in ("Enabled", "Suspended"):
                 self._delete_object(req, bucket, key, path, state)
             else:
                 self.filer.delete_entry(path)
                 self._prune_empty_dirs(path, bucket)
+            if failed is not None:
+                # a locked version is NOT deleted — reporting
+                # <Deleted> would lie to lifecycle/cleanup clients
+                err = _elem(result, "Error")
+                _elem(err, "Key", key)
+                if vid:
+                    _elem(err, "VersionId", vid)
+                _elem(err, "Code", "AccessDenied")
+                _elem(err, "Message", "version is locked")
+                continue
             d = _elem(result, "Deleted")
             _elem(d, "Key", key)
             if vid:
@@ -964,13 +1152,20 @@ class S3ApiServer:
                 offset += total_size(p.chunks)
                 etags += bytes.fromhex(p.extended.get("etag", ""))
             final_path = f"{self._bucket_path(bucket)}/{key}"
+            mp_state = self._versioning_state(bucket)
+            # the assembled object is a new version: bucket-default
+            # retention applies here too, or multipart becomes a
+            # retention-policy bypass
+            lock_ext = self._lock_for_put(req, bucket, mp_state)
+            if not isinstance(lock_ext, dict):
+                return lock_ext
             with self._path_lock(final_path):
-                vid = self._pre_write_archive(
-                    final_path, self._versioning_state(bucket))
+                vid = self._pre_write_archive(final_path, mp_state)
                 final = Entry(final_path, chunks=chunks)
                 final_etag = (hashlib.md5(etags).hexdigest() +
                               f"-{len(parts)}")
                 final.extended["etag"] = final_etag
+                final.extended.update(lock_ext)
                 if vid is not None:
                     final.extended["versionId"] = vid
                 self.filer.create_entry(final)

@@ -321,3 +321,37 @@ def test_http_events_endpoint_serves_persisted_history(cluster,
         assert "/h/a.txt" in paths
     finally:
         fs.stop()
+
+
+def test_filer_backup_to_local_dir(cluster, tmp_path):
+    """filer.backup mirrors the namespace into a local directory and
+    follows live mutations (command/filer_backup.go / localsink)."""
+    import os
+    from seaweedfs_tpu.filer.filer_backup import FilerBackup
+
+    master, _ = cluster
+    src = FilerServer(master.url,
+                      store_path=str(tmp_path / "src.db")).start()
+    mirror = tmp_path / "mirror"
+    src.filer.write_file("/b/one.txt", b"first", mode=0o640)
+    bak = FilerBackup(src.url, str(mirror),
+                      str(tmp_path / "bak.offset"),
+                      poll_interval=0.05).start()
+    try:
+        assert _wait(lambda: (mirror / "b" / "one.txt").exists())
+        assert (mirror / "b" / "one.txt").read_bytes() == b"first"
+        assert os.stat(mirror / "b" / "one.txt").st_mode & 0o777 == \
+            0o640
+        src.filer.write_file("/b/two.txt", b"second")
+        src.filer.rename("/b/one.txt", "/b/moved.txt")
+        assert _wait(lambda: (mirror / "b" / "moved.txt").exists()
+                     and not (mirror / "b" / "one.txt").exists())
+        src.filer.delete_entry("/b/two.txt")
+        assert _wait(
+            lambda: not (mirror / "b" / "two.txt").exists())
+        # path traversal via crafted names cannot escape the root
+        with pytest.raises(RuntimeError, match="escapes root"):
+            bak._local("/../../etc/passwd")
+    finally:
+        bak.stop()
+        src.stop()
