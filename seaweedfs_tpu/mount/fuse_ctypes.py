@@ -76,6 +76,28 @@ _read_t = ctypes.CFUNCTYPE(
     ctypes.c_size_t, c_off_t, ctypes.c_void_p)
 _statfs_t = ctypes.CFUNCTYPE(
     ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(Statvfs))
+_mkdir_t = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, c_mode_t)
+_path_t = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p)
+_rename_t = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p)
+_chmod_t = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, c_mode_t)
+_chown_t = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_uint, ctypes.c_uint)
+_truncate_t = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, c_off_t)
+_write_t = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_char),
+    ctypes.c_size_t, c_off_t, ctypes.c_void_p)
+_fi_t = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p)
+_create_t = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, c_mode_t, ctypes.c_void_p)
+_ftruncate_t = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, c_off_t, ctypes.c_void_p)
+_utimens_t = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(Timespec))
 # int (*filler)(void *buf, const char *name, const struct stat *, off_t)
 _fill_dir_t = ctypes.CFUNCTYPE(
     ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p,
@@ -93,22 +115,22 @@ class FuseOperations(ctypes.Structure):
         ("readlink", _readlink_t),
         ("getdir", _voidp_t),
         ("mknod", _voidp_t),
-        ("mkdir", _voidp_t),
-        ("unlink", _voidp_t),
-        ("rmdir", _voidp_t),
+        ("mkdir", _mkdir_t),
+        ("unlink", _path_t),
+        ("rmdir", _path_t),
         ("symlink", _voidp_t),
-        ("rename", _voidp_t),
+        ("rename", _rename_t),
         ("link", _voidp_t),
-        ("chmod", _voidp_t),
-        ("chown", _voidp_t),
-        ("truncate", _voidp_t),
+        ("chmod", _chmod_t),
+        ("chown", _chown_t),
+        ("truncate", _truncate_t),
         ("utime", _voidp_t),
         ("open", _open_t),
         ("read", _read_t),
-        ("write", _voidp_t),
+        ("write", _write_t),
         ("statfs", _statfs_t),
-        ("flush", _voidp_t),
-        ("release", _voidp_t),
+        ("flush", _fi_t),
+        ("release", _fi_t),
         ("fsync", _voidp_t),
         ("setxattr", _voidp_t),
         ("getxattr", _voidp_t),
@@ -121,11 +143,11 @@ class FuseOperations(ctypes.Structure):
         ("init", _voidp_t),
         ("destroy", _voidp_t),
         ("access", _voidp_t),
-        ("create", _voidp_t),
-        ("ftruncate", _voidp_t),
+        ("create", _create_t),
+        ("ftruncate", _ftruncate_t),
         ("fgetattr", _voidp_t),
         ("lock", _voidp_t),
-        ("utimens", _voidp_t),
+        ("utimens", _utimens_t),
         ("bmap", _voidp_t),
         ("flags", ctypes.c_uint),  # flag_nullpath_ok etc. bitfield
         ("ioctl", _voidp_t),
@@ -168,6 +190,22 @@ class FuseMount:
             "read": _read_t(self._read),
             "statfs": _statfs_t(self._statfs),
             "readdir": _readdir_t(self._readdir),
+            # write path
+            "create": _create_t(self._create),
+            "write": _write_t(self._write),
+            "truncate": _truncate_t(self._truncate),
+            "ftruncate": _ftruncate_t(self._ftruncate),
+            "flush": _fi_t(self._flush),
+            "release": _fi_t(self._release),
+            "mkdir": _mkdir_t(self._mkdir),
+            "unlink": _path_t(self._unlink),
+            "rmdir": _path_t(self._rmdir),
+            "rename": _rename_t(self._rename),
+            # permission/time updates: accept (the filer keeps the
+            # authoritative attrs; tar/cp must not fail on chmod)
+            "chmod": _chmod_t(lambda p, m: 0),
+            "chown": _chown_t(lambda p, u, g: 0),
+            "utimens": _utimens_t(lambda p, ts: 0),
         }
         for name, cb in self._cbs.items():
             setattr(self.ops, name, cb)
@@ -198,8 +236,59 @@ class FuseMount:
             return 0
         return self._guard(run)
 
+    @staticmethod
+    def _fi_flags(fip) -> int:
+        """fuse_file_info.flags is the struct's FIRST field (an int)."""
+        if not fip:
+            return 0
+        return ctypes.cast(fip,
+                           ctypes.POINTER(ctypes.c_int)).contents.value
+
     def _open(self, path, fip):
-        return self._guard(lambda: self.fs.open(path.decode()) and 0)
+        return self._guard(
+            lambda: self.fs.open(path.decode(),
+                                 self._fi_flags(fip)) and 0)
+
+    def _create(self, path, mode, fip):
+        return self._guard(
+            lambda: self.fs.create(path.decode(), mode) and 0)
+
+    def _write(self, path, buf, size, offset, fip):
+        def run():
+            data = ctypes.string_at(buf, size)
+            return self.fs.write(path.decode(), data, offset)
+        return self._guard(run)
+
+    def _truncate(self, path, length):
+        return self._guard(
+            lambda: self.fs.truncate(path.decode(), length) or 0)
+
+    def _ftruncate(self, path, length, fip):
+        return self._truncate(path, length)
+
+    def _flush(self, path, fip):
+        return self._guard(
+            lambda: self.fs.flush(path.decode()) or 0)
+
+    def _release(self, path, fip):
+        return self._guard(
+            lambda: self.fs.release(path.decode()) or 0)
+
+    def _mkdir(self, path, mode):
+        return self._guard(
+            lambda: self.fs.mkdir(path.decode(), mode) or 0)
+
+    def _unlink(self, path):
+        return self._guard(
+            lambda: self.fs.unlink(path.decode()) or 0)
+
+    def _rmdir(self, path):
+        return self._guard(
+            lambda: self.fs.rmdir(path.decode()) or 0)
+
+    def _rename(self, old, new):
+        return self._guard(
+            lambda: self.fs.rename(old.decode(), new.decode()) or 0)
 
     def _read(self, path, buf, size, offset, fip):
         def run():
@@ -232,7 +321,7 @@ class FuseMount:
         """fuse_main_real: mounts and serves until unmounted
         (fusermount -u) or killed."""
         args = [b"seaweedfs-tpu", mountpoint.encode(), b"-s",
-                b"-o", b"ro,default_permissions"]
+                b"-o", b"default_permissions"]
         if foreground:
             args.insert(2, b"-f")
         argv = (ctypes.c_char_p * len(args))(*args)

@@ -11,12 +11,24 @@ from __future__ import annotations
 
 import errno
 import json
+import os
 import stat as stat_mod
 import threading
 import time
 import urllib.parse
+from dataclasses import dataclass, field
 
 from ..server.httpd import http_bytes, http_json
+
+
+@dataclass
+class _WriteState:
+    """One path's open-for-write state: shared buffer + handle
+    refcount + dirty flag (flush uploads only dirty buffers, release
+    drops the state only when the LAST handle closes)."""
+    buf: bytearray = field(default_factory=bytearray)
+    refs: int = 0
+    dirty: bool = False
 
 
 class FuseError(OSError):
@@ -26,8 +38,16 @@ class FuseError(OSError):
 
 
 class WeedFS:
-    """Read-only slice: lookup/getattr, readdir, open/read, readlink
-    (weedfs_attr.go, weedfs_dir_read.go, weedfs_file_read.go)."""
+    """Full op table: lookup/getattr, readdir, open/read, readlink
+    (weedfs_attr.go, weedfs_dir_read.go, weedfs_file_read.go) plus the
+    write path — create/write/truncate/flush, mkdir/unlink/rmdir,
+    rename (weedfs_file_write.go, weedfs_dir_mkrm.go).
+
+    Writes buffer whole-file per open path and upload on flush/release
+    — a simplification of the reference's chunked dirty-page writeback
+    (mount/dirty_pages_chunked.go streams interval pages; ours holds
+    the file in memory until close, fine for the mount's typical
+    editor/tool workloads, unbounded for huge streaming writes)."""
 
     MAX_CACHE_ENTRIES = 16384  # the reference's meta_cache is bounded
 
@@ -36,6 +56,7 @@ class WeedFS:
         self.filer = filer
         self.attr_ttl = attr_ttl
         self._cache: dict[str, tuple[float, dict | None]] = {}
+        self._writes: dict[str, _WriteState] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._since_ns = time.time_ns()
@@ -141,10 +162,19 @@ class WeedFS:
                 "st_atime": float(attrs.get("mtime", 0) or 0)}
 
     def getattr(self, path: str) -> dict:
+        with self._lock:
+            ws = self._writes.get(path)
+            buf_len = len(ws.buf) if ws is not None else None
         entry = self._lookup(path)
         if entry is None:
             raise FuseError(errno.ENOENT)
-        return self._entry_stat(entry)
+        st = self._entry_stat(entry)
+        if buf_len is not None:
+            # overlay ONLY the size from the open write buffer (the
+            # kernel stats after each write); mode/uid/gid/timestamps
+            # stay the filer entry's truth
+            st["st_size"] = buf_len
+        return st
 
     def readdir(self, path: str) -> "list[str]":
         entry = self._lookup(path)
@@ -174,9 +204,26 @@ class WeedFS:
             raise FuseError(errno.ENOENT)
         if entry.get("isDirectory"):
             raise FuseError(errno.EISDIR)
-        import os
         if flags & (os.O_WRONLY | os.O_RDWR):
-            raise FuseError(errno.EROFS)  # read-only slice
+            with self._lock:
+                ws = self._writes.get(path)
+                if ws is not None:
+                    ws.refs += 1
+                    if flags & os.O_TRUNC:
+                        del ws.buf[:]
+                        ws.dirty = True
+                    return 0
+            # seed OUTSIDE the lock (read() takes it too): a writable
+            # open of an existing file starts from the current content
+            # so non-O_TRUNC writes patch in place
+            seed = bytearray() if flags & os.O_TRUNC else \
+                bytearray(self.read(path, 1 << 62, 0))
+            with self._lock:
+                ws = self._writes.setdefault(path, _WriteState())
+                ws.refs += 1
+                if ws.refs == 1:
+                    ws.buf = seed
+                    ws.dirty = bool(flags & os.O_TRUNC)
         return 0
 
     def read(self, path: str, size: int, offset: int) -> bytes:
@@ -184,6 +231,10 @@ class WeedFS:
         chunk-view resolution happens filer-side)."""
         if size <= 0:
             return b""
+        with self._lock:
+            ws = self._writes.get(path)
+            if ws is not None:
+                return bytes(ws.buf[offset:offset + size])
         st, body, _ = http_bytes(
             "GET", self.filer + urllib.parse.quote(path), None,
             {"Range": f"bytes={offset}-{offset + size - 1}"})
@@ -201,6 +252,150 @@ class WeedFS:
         if not target:
             raise FuseError(errno.EINVAL)
         return target
+
+    # -- write path (weedfs_file_write.go, simplified dirty buffer) -------
+
+    def create(self, path: str, mode: int = 0o644) -> int:
+        # materialize the (empty) entry at the filer IMMEDIATELY: the
+        # write-fsync-rename save pattern and cross-client readdir must
+        # see the file while it is still open
+        self._put(path, b"")
+        with self._lock:
+            ws = self._writes.setdefault(path, _WriteState())
+            ws.refs += 1
+            if ws.refs == 1:
+                ws.buf = bytearray()
+                ws.dirty = False
+        return 0
+
+    def write(self, path: str, data: bytes, offset: int) -> int:
+        with self._lock:
+            ws = self._writes.get(path)
+            if ws is None:
+                raise FuseError(errno.EBADF)
+            buf = ws.buf
+            if offset > len(buf):
+                buf.extend(b"\x00" * (offset - len(buf)))
+            buf[offset:offset + len(data)] = data
+            ws.dirty = True
+        return len(data)
+
+    def truncate(self, path: str, length: int) -> None:
+        with self._lock:
+            ws = self._writes.get(path)
+            if ws is not None:
+                buf = ws.buf
+                if length < len(buf):
+                    del buf[length:]
+                else:
+                    buf.extend(b"\x00" * (length - len(buf)))
+                ws.dirty = True
+                return
+        # truncate without an open handle: rewrite through the filer
+        data = self.read(path, 1 << 62, 0) if length else b""
+        data = data[:length] + b"\x00" * (length - len(data))
+        self._put(path, data)
+
+    def flush(self, path: str) -> None:
+        """Upload the buffer iff dirty (the kernel flushes on every
+        close() of every dup'd fd — clean flushes must not re-upload
+        the whole file)."""
+        with self._lock:
+            ws = self._writes.get(path)
+            if ws is None or not ws.dirty:
+                return
+            data = bytes(ws.buf)
+            ws.dirty = False
+        try:
+            self._put(path, data)
+        except Exception:
+            with self._lock:
+                ws2 = self._writes.get(path)
+                if ws2 is not None:
+                    ws2.dirty = True  # retry on the next flush
+            raise
+
+    def release(self, path: str) -> None:
+        self.flush(path)
+        with self._lock:
+            ws = self._writes.get(path)
+            if ws is not None:
+                ws.refs -= 1
+                if ws.refs <= 0:
+                    # last handle gone: drop the buffer
+                    self._writes.pop(path, None)
+        self._invalidate(path)
+
+    def _drop_write_state(self, path: str) -> None:
+        """After unlink/rename: a stale buffer keyed by the old path
+        would resurrect the file on the next flush."""
+        with self._lock:
+            self._writes.pop(path, None)
+
+    def _put(self, path: str, data: bytes) -> None:
+        st, body, _ = http_bytes(
+            "PUT", self.filer + urllib.parse.quote(path), data)
+        if st not in (200, 201):
+            raise FuseError(errno.EIO)
+        self._invalidate(path)
+
+    def mkdir(self, path: str, mode: int = 0o755) -> None:
+        if self._lookup(path) is not None:
+            raise FuseError(errno.EEXIST)
+        st, _, _ = http_bytes(
+            "PUT", self.filer +
+            urllib.parse.quote(path.rstrip("/") + "/"))
+        if st not in (200, 201):
+            raise FuseError(errno.EIO)
+        self._invalidate(path)
+
+    def unlink(self, path: str) -> None:
+        entry = self._lookup(path)
+        if entry is None:
+            raise FuseError(errno.ENOENT)
+        if entry.get("isDirectory"):
+            raise FuseError(errno.EISDIR)
+        st, _, _ = http_bytes(
+            "DELETE", self.filer + urllib.parse.quote(path))
+        if st not in (200, 204):
+            raise FuseError(errno.EIO)
+        self._drop_write_state(path)
+        self._invalidate(path)
+
+    def rmdir(self, path: str) -> None:
+        entry = self._lookup(path)
+        if entry is None:
+            raise FuseError(errno.ENOENT)
+        if not entry.get("isDirectory"):
+            raise FuseError(errno.ENOTDIR)
+        # NON-recursive delete: the filer's own atomic 409 answers
+        # non-empty — a pre-check + recursive=true would let a racing
+        # create be silently destroyed
+        st, _, _ = http_bytes(
+            "DELETE", self.filer + urllib.parse.quote(path))
+        if st == 409:
+            raise FuseError(errno.ENOTEMPTY)
+        if st not in (200, 204):
+            raise FuseError(errno.EIO)
+        self._invalidate(path)
+
+    def rename(self, old: str, new: str) -> None:
+        st, _, _ = http_bytes(
+            "POST", f"{self.filer}/__meta__/rename",
+            json.dumps({"oldPath": old, "newPath": new}).encode(),
+            {"Content-Type": "application/json"})
+        if st == 404:
+            raise FuseError(errno.ENOENT)
+        if st != 200:
+            raise FuseError(errno.EIO)
+        with self._lock:
+            # the open write buffer follows the file to its new name;
+            # left behind it would resurrect the OLD path on flush
+            ws = self._writes.pop(old, None)
+            if ws is not None:
+                self._writes[new] = ws
+        self._invalidate(old)
+        self._invalidate(new)
 
     def statfs(self, path: str) -> dict:
         return {"f_bsize": 4096, "f_frsize": 4096,

@@ -69,18 +69,62 @@ def test_readdir_and_read(fs):
     assert e.value.errno == errno.ENOTDIR
 
 
-def test_open_readonly_and_symlink(fs):
+def test_open_and_symlink(fs):
     w, filer = fs
     assert w.open("/docs/a.txt", os.O_RDONLY) == 0
-    with pytest.raises(FuseError) as e:
-        w.open("/docs/a.txt", os.O_WRONLY)
-    assert e.value.errno == errno.EROFS
     link = Entry("/docs/link", attributes=Attributes(
         symlink_target="/docs/a.txt"))
     filer.filer.create_entry(link)
     assert w.readlink("/docs/link") == "/docs/a.txt"
     st = w.getattr("/docs/link")
     assert st["st_mode"] & 0o170000 == 0o120000  # symlink
+
+
+def test_write_path_op_table(fs):
+    """create/write/flush/release, partial overwrite via writable open,
+    truncate, mkdir/rename/unlink/rmdir (weedfs_file_write.go +
+    weedfs_dir_mkrm.go analog)."""
+    w, filer = fs
+    # create + write + release -> visible through the filer
+    w.create("/docs/new.txt")
+    assert w.write("/docs/new.txt", b"hello ", 0) == 6
+    assert w.write("/docs/new.txt", b"world", 6) == 5
+    assert w.getattr("/docs/new.txt")["st_size"] == 11
+    w.release("/docs/new.txt")
+    assert filer.filer.read_file("/docs/new.txt") == b"hello world"
+    # writable open WITHOUT O_TRUNC patches in place
+    w.open("/docs/new.txt", os.O_RDWR)
+    w.write("/docs/new.txt", b"HELLO", 0)
+    w.release("/docs/new.txt")
+    assert filer.filer.read_file("/docs/new.txt") == b"HELLO world"
+    # O_TRUNC starts empty
+    w.open("/docs/new.txt", os.O_WRONLY | os.O_TRUNC)
+    w.write("/docs/new.txt", b"fresh", 0)
+    w.release("/docs/new.txt")
+    assert filer.filer.read_file("/docs/new.txt") == b"fresh"
+    # truncate without a handle
+    w.truncate("/docs/new.txt", 2)
+    assert filer.filer.read_file("/docs/new.txt") == b"fr"
+    # sparse write extends with zeros
+    w.create("/docs/sparse.bin")
+    w.write("/docs/sparse.bin", b"x", 4)
+    w.release("/docs/sparse.bin")
+    assert filer.filer.read_file("/docs/sparse.bin") == \
+        b"\x00\x00\x00\x00x"
+    # mkdir / rename / unlink / rmdir
+    w.mkdir("/docs/newdir")
+    assert "newdir" in w.readdir("/docs")
+    with pytest.raises(FuseError):
+        w.mkdir("/docs/newdir")  # EEXIST
+    w.rename("/docs/new.txt", "/docs/newdir/moved.txt")
+    assert filer.filer.read_file("/docs/newdir/moved.txt") == b"fr"
+    with pytest.raises(FuseError) as e:
+        w.rmdir("/docs/newdir")
+    assert e.value.errno == errno.ENOTEMPTY
+    w.unlink("/docs/newdir/moved.txt")
+    w.rmdir("/docs/newdir")
+    with pytest.raises(FuseError):
+        w.getattr("/docs/newdir")
 
 
 def test_attr_cache_invalidation_via_events(fs):
@@ -107,6 +151,45 @@ def test_attr_cache_invalidation_via_events(fs):
         time.sleep(0.1)
     with pytest.raises(FuseError):
         w.getattr("/docs/sub/b.bin")
+
+
+def test_write_state_review_regressions(fs):
+    """Multi-handle refcounts, no resurrection after unlink/rename,
+    create materializes immediately, clean flush does not re-upload."""
+    w, filer = fs
+    # create is immediately visible to other clients (readdir/rename)
+    w.create("/docs/open.tmp")
+    assert filer.filer.find_entry("/docs/open.tmp") is not None
+    w.write("/docs/open.tmp", b"payload", 0)
+    # the save pattern: rename WHILE OPEN, then close — content lands
+    # at the NEW name, old name stays gone
+    w.rename("/docs/open.tmp", "/docs/saved.txt")
+    w.release("/docs/saved.txt")
+    assert filer.filer.read_file("/docs/saved.txt") == b"payload"
+    assert filer.filer.find_entry("/docs/open.tmp") is None
+
+    # two handles share the buffer; first close must not destroy it
+    w.open("/docs/saved.txt", os.O_RDWR)
+    w.open("/docs/saved.txt", os.O_RDWR)
+    w.write("/docs/saved.txt", b"PAY", 0)
+    w.release("/docs/saved.txt")  # handle 1
+    w.write("/docs/saved.txt", b"!", 7)  # handle 2 still valid
+    w.release("/docs/saved.txt")
+    assert filer.filer.read_file("/docs/saved.txt") == b"PAYload!"
+
+    # unlink while open: close must NOT resurrect the file
+    w.open("/docs/saved.txt", os.O_RDWR)
+    w.unlink("/docs/saved.txt")
+    w.release("/docs/saved.txt")
+    assert filer.filer.find_entry("/docs/saved.txt") is None
+
+    # getattr during write keeps the entry's real mode
+    filer.filer.write_file("/docs/script.sh", b"#!/bin/sh\n",
+                           mode=0o755)
+    w.open("/docs/script.sh", os.O_RDWR)
+    st = w.getattr("/docs/script.sh")
+    assert st["st_mode"] & 0o777 == 0o755
+    w.release("/docs/script.sh")
 
 
 # --- real kernel mount ----------------------------------------------------
@@ -154,9 +237,18 @@ def test_real_kernel_mount(cluster, tmp_path):
         assert (mnt / "m" / "deep" / "blob.bin").read_bytes() == blob
         st = os.stat(mnt / "m" / "deep" / "blob.bin")
         assert st.st_size == len(blob)
-        # read-only mount: writes are refused by the kernel
-        with pytest.raises(OSError):
-            (mnt / "m" / "new.txt").write_bytes(b"x")
+        # WRITE through the kernel: create, append-style rewrite,
+        # mkdir/rename/rm — then verify through the filer
+        (mnt / "m" / "new.txt").write_bytes(b"written via kernel")
+        assert filer.filer.read_file("/m/new.txt") == \
+            b"written via kernel"
+        os.mkdir(mnt / "m" / "kdir")
+        os.rename(mnt / "m" / "new.txt", mnt / "m" / "kdir" / "n.txt")
+        assert filer.filer.read_file("/m/kdir/n.txt") == \
+            b"written via kernel"
+        os.remove(mnt / "m" / "kdir" / "n.txt")
+        os.rmdir(mnt / "m" / "kdir")
+        assert filer.filer.find_entry("/m/kdir") is None
     finally:
         subprocess.run(["fusermount", "-u", str(mnt)],
                        capture_output=True)
