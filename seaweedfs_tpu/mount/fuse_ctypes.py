@@ -201,9 +201,9 @@ class FuseMount:
             "unlink": _path_t(self._unlink),
             "rmdir": _path_t(self._rmdir),
             "rename": _rename_t(self._rename),
-            # permission/time updates: accept (the filer keeps the
-            # authoritative attrs; tar/cp must not fail on chmod)
-            "chmod": _chmod_t(lambda p, m: 0),
+            "chmod": _chmod_t(self._chmod),
+            # owner/time updates: accepted without persistence (the
+            # filer keeps authoritative attrs; tar/cp must not fail)
             "chown": _chown_t(lambda p, u, g: 0),
             "utimens": _utimens_t(lambda p, ts: 0),
         }
@@ -271,8 +271,15 @@ class FuseMount:
             lambda: self.fs.flush(path.decode()) or 0)
 
     def _release(self, path, fip):
+        import os as _os
+        flags = self._fi_flags(fip)
+        writable = bool(flags & (_os.O_WRONLY | _os.O_RDWR))
         return self._guard(
-            lambda: self.fs.release(path.decode()) or 0)
+            lambda: self.fs.release(path.decode(), writable) or 0)
+
+    def _chmod(self, path, mode):
+        return self._guard(
+            lambda: self.fs.chmod(path.decode(), mode) or 0)
 
     def _mkdir(self, path, mode):
         return self._guard(

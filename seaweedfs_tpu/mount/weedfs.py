@@ -260,6 +260,8 @@ class WeedFS:
         # write-fsync-rename save pattern and cross-client readdir must
         # see the file while it is still open
         self._put(path, b"")
+        if mode:
+            self.chmod(path, mode)
         with self._lock:
             ws = self._writes.setdefault(path, _WriteState())
             ws.refs += 1
@@ -267,6 +269,26 @@ class WeedFS:
                 ws.buf = bytearray()
                 ws.dirty = False
         return 0
+
+    def chmod(self, path: str, mode: int) -> None:
+        """Persist the mode via the filer's UpdateEntry analog — a
+        silent-no-op chmod would claim success while exec bits never
+        stick."""
+        entry = self._lookup(path)
+        if entry is None:
+            raise FuseError(errno.ENOENT)
+        attrs = dict(entry.get("attributes") or {})
+        attrs["mode"] = mode & 0o7777
+        self._set_attrs(path, attrs)
+
+    def _set_attrs(self, path: str, attrs: dict) -> None:
+        st, _, _ = http_bytes(
+            "POST", f"{self.filer}/__meta__/set_attrs",
+            json.dumps({"path": path, "attributes": attrs}).encode(),
+            {"Content-Type": "application/json"})
+        if st != 200:
+            raise FuseError(errno.EIO)
+        self._invalidate(path)
 
     def write(self, path: str, data: bytes, offset: int) -> int:
         with self._lock:
@@ -306,8 +328,15 @@ class WeedFS:
                 return
             data = bytes(ws.buf)
             ws.dirty = False
+        # the content PUT re-creates the entry with default attrs;
+        # carry the real mode/owner across (chmod must survive saves)
+        entry = self._lookup(path)
+        attrs = dict((entry or {}).get("attributes") or {})
         try:
             self._put(path, data)
+            if attrs.get("mode"):
+                attrs["mtime"] = time.time()
+                self._set_attrs(path, attrs)
         except Exception:
             with self._lock:
                 ws2 = self._writes.get(path)
@@ -315,14 +344,20 @@ class WeedFS:
                     ws2.dirty = True  # retry on the next flush
             raise
 
-    def release(self, path: str) -> None:
+    def release(self, path: str, writable: bool = True) -> None:
+        """`writable` mirrors the closing HANDLE's open mode (from
+        fuse_file_info.flags): a read-only close must not decrement the
+        write-state refcount — it would destroy a still-open writer's
+        buffer."""
+        if not writable:
+            return
         self.flush(path)
         with self._lock:
             ws = self._writes.get(path)
             if ws is not None:
                 ws.refs -= 1
                 if ws.refs <= 0:
-                    # last handle gone: drop the buffer
+                    # last writable handle gone: drop the buffer
                     self._writes.pop(path, None)
         self._invalidate(path)
 
@@ -389,11 +424,17 @@ class WeedFS:
         if st != 200:
             raise FuseError(errno.EIO)
         with self._lock:
-            # the open write buffer follows the file to its new name;
-            # left behind it would resurrect the OLD path on flush
-            ws = self._writes.pop(old, None)
-            if ws is not None:
-                self._writes[new] = ws
+            # open write buffers follow the file (or the renamed
+            # DIRECTORY'S descendants) to their new names; left behind
+            # they would resurrect the old paths on flush
+            prefix = old.rstrip("/") + "/"
+            for p in list(self._writes):
+                if p == old:
+                    self._writes[new] = self._writes.pop(p)
+                elif p.startswith(prefix):
+                    self._writes[new.rstrip("/") + "/" +
+                                 p[len(prefix):]] = \
+                        self._writes.pop(p)
         self._invalidate(old)
         self._invalidate(new)
 

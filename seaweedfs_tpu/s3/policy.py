@@ -1,0 +1,146 @@
+"""Bucket policy engine (weed/s3api/policy_engine/): the IAM-style
+JSON policy document evaluated per request.
+
+Supported subset (the core of the reference's engine):
+
+    {"Version": "2012-10-17", "Statement": [{
+        "Effect": "Allow" | "Deny",
+        "Principal": "*" | {"AWS": "*" | [access-key, ...]},
+        "Action": "s3:GetObject" | ["s3:*", "s3:Get*"],
+        "Resource": "arn:aws:s3:::bucket/key-or-*" | [...]
+    }]}
+
+Evaluation order is AWS's: explicit Deny wins over Allow; otherwise a
+matching Allow grants (this is how anonymous/public access is opened);
+no match falls back to the gateway's signature-based default.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+
+
+class PolicyError(ValueError):
+    pass
+
+
+def parse_policy(doc: bytes) -> "list[dict]":
+    try:
+        p = json.loads(doc)
+    except ValueError as e:
+        raise PolicyError(f"malformed policy JSON: {e}")
+    stmts = p.get("Statement")
+    if not isinstance(stmts, list) or not stmts:
+        raise PolicyError("policy needs a Statement list")
+    out = []
+    for s in stmts:
+        effect = s.get("Effect")
+        if effect not in ("Allow", "Deny"):
+            raise PolicyError(f"bad Effect {effect!r}")
+        if "Condition" in s:
+            # an engine that cannot EVALUATE conditions must not
+            # silently grant unconditionally — that widens access
+            # beyond what the document states
+            raise PolicyError("Condition elements are not supported")
+        principal = s.get("Principal", "*")
+        if isinstance(principal, dict):
+            unsupported = set(principal) - {"AWS"}
+            if unsupported:
+                # collapsing e.g. {"Federated": ...} to "*" would turn
+                # an unsupported principal type into a wildcard grant
+                raise PolicyError(
+                    f"unsupported Principal types: "
+                    f"{sorted(unsupported)}")
+            principal = principal.get("AWS", "*")
+        principals = principal if isinstance(principal, list) \
+            else [principal]
+        actions = s.get("Action", [])
+        actions = actions if isinstance(actions, list) else [actions]
+        resources = s.get("Resource", [])
+        resources = resources if isinstance(resources, list) \
+            else [resources]
+        if not actions or not resources:
+            raise PolicyError("statement needs Action and Resource")
+        for a in actions:
+            if not str(a).startswith("s3:"):
+                raise PolicyError(f"unsupported action {a!r}")
+        out.append({"effect": effect, "principals": principals,
+                    "actions": [str(a) for a in actions],
+                    "resources": [str(r) for r in resources]})
+    return out
+
+
+def _match_any(patterns: "list[str]", value: str) -> bool:
+    return any(fnmatch.fnmatchcase(value, p) for p in patterns)
+
+
+def evaluate(statements: "list[dict]", principal: str, action: str,
+             resource: str) -> "str | None":
+    """'Deny' | 'Allow' | None (no statement matched).  `principal` is
+    the authenticated access key, or "*"/"anonymous" for unsigned
+    requests.  Explicit Deny wins."""
+    decision = None
+    for s in statements:
+        if not (_match_any(s["principals"], principal) or
+                "*" in s["principals"]):
+            continue
+        if not _match_any(s["actions"], action):
+            continue
+        if not _match_any(s["resources"], resource):
+            continue
+        if s["effect"] == "Deny":
+            return "Deny"
+        decision = "Allow"
+    return decision
+
+
+# bucket subresources get their OWN action names: an s3:ListBucket
+# grant must not expose the policy/CORS/versioning/lock configs
+_SUBRESOURCE_ACTIONS = {
+    "policy": "BucketPolicy",
+    "cors": "BucketCORS",
+    "versioning": "BucketVersioning",
+    "object-lock": "BucketObjectLockConfiguration",
+    "versions": None,  # ListBucketVersions, handled below
+}
+
+
+def action_for(method: str, bucket: str, key: str,
+               query: dict) -> str:
+    """Map an S3 request to its IAM action name (the subset the
+    reference's engine distinguishes first)."""
+    if not key:
+        for sub, name in _SUBRESOURCE_ACTIONS.items():
+            if sub in query:
+                if sub == "versions":
+                    return "s3:ListBucketVersions"
+                verb = {"GET": "Get", "HEAD": "Get", "PUT": "Put",
+                        "DELETE": "Delete"}.get(method, method.title())
+                return f"s3:{verb}{name}"
+    if key:
+        if method in ("GET", "HEAD"):
+            return "s3:GetObject" if "versionId" not in query else \
+                "s3:GetObjectVersion"
+        if method == "PUT":
+            return "s3:PutObject"
+        if method == "DELETE":
+            return "s3:DeleteObject" if "versionId" not in query \
+                else "s3:DeleteObjectVersion"
+        if method == "POST":
+            return "s3:PutObject"
+        return f"s3:{method.title()}Object"
+    if method in ("GET", "HEAD"):
+        return "s3:ListBucket"
+    if method == "PUT":
+        return "s3:CreateBucket"
+    if method == "DELETE":
+        return "s3:DeleteBucket"
+    if method == "POST":
+        return "s3:DeleteObject"  # batch delete
+    return f"s3:{method.title()}Bucket"
+
+
+def resource_arn(bucket: str, key: str) -> str:
+    return f"arn:aws:s3:::{bucket}/{key}" if key else \
+        f"arn:aws:s3:::{bucket}"

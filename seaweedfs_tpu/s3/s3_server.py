@@ -100,6 +100,7 @@ class S3ApiServer:
         # silently lost (bounded stripe count — no per-key leak)
         self._stripes = [threading.Lock() for _ in range(64)]
         self._cors_cache: dict[str, tuple[str, list]] = {}
+        self._policy_cache: dict[str, tuple[str, list]] = {}
 
     def _path_lock(self, path: str) -> "threading.Lock":
         return self._stripes[hash(path) % len(self._stripes)]
@@ -135,15 +136,38 @@ class S3ApiServer:
         return resp
 
     def _handle(self, req: Request, bucket: str, key: str):
+        from .policy import action_for, evaluate, resource_arn
+        identity = "*"
+        ctx = None
+        stmts = self._policy_rules(bucket) if bucket else []
+        decision = None
+        if stmts:
+            # one evaluation serves both the anonymous-allow check and
+            # the explicit-deny check (identity patched below)
+            action = action_for(req.method, bucket, key, req.query)
+            arn = resource_arn(bucket, key)
         if self.verifier is not None:
             ok, who, ctx = self.verifier.verify(
                 req.method, req.path, req.query,
                 {k.lower(): v for k, v in req.headers.items()},
                 req.body)
-            if not ok:
-                return _error(403, "AccessDenied", who)
-        else:
-            ctx = None
+            if ok:
+                identity = who
+                req.s3_identity = who
+            else:
+                # unsigned/invalid: the bucket policy may still open
+                # this resource to anonymous principals (public-read
+                # buckets, the engine's primary job)
+                decision = evaluate(stmts, "anonymous", action,
+                                    arn) if stmts else None
+                if decision != "Allow":
+                    return _error(403, "AccessDenied", who)
+                identity = "anonymous"
+        if stmts and decision is None:
+            if evaluate(stmts, identity, action, arn) == "Deny":
+                # explicit Deny beats a valid signature
+                return _error(403, "AccessDenied",
+                              "denied by bucket policy")
         sha = req.headers.get("x-amz-content-sha256", "")
         if sha.startswith("STREAMING-"):
             # aws-chunked framing (chunked_reader_v4.go): verify chunk
@@ -193,6 +217,57 @@ class S3ApiServer:
             return _error(403, "AccessForbidden",
                           "CORSResponse: no matching rule")
         return 200, (b"", headers)
+
+    def _policy_rules(self, bucket: str) -> list:
+        from .policy import PolicyError, parse_policy
+        e = self.filer.find_entry(self._bucket_path(bucket))
+        doc = (e.extended.get("policy") if e else None) or ""
+        if not doc:
+            return []
+        cached = self._policy_cache.get(bucket)
+        if cached is not None and cached[0] == doc:
+            return cached[1]
+        try:
+            stmts = parse_policy(doc.encode()
+                                 if isinstance(doc, str) else doc)
+        except PolicyError:
+            stmts = []
+        self._policy_cache[bucket] = (doc, stmts)
+        return stmts
+
+    def _bucket_policy_op(self, req: Request, bucket: str):
+        """Put/Get/DeleteBucketPolicy (s3api policy_engine).  Policy
+        mutation itself requires a SIGNED request — an anonymous
+        principal must never be able to rewrite the policy that grants
+        it access (checked here because _handle's anonymous path can
+        reach bucket ops when a policy allows)."""
+        from .policy import PolicyError, parse_policy
+        e = self.filer.find_entry(self._bucket_path(bucket))
+        if e is None:
+            return _error(404, "NoSuchBucket", bucket)
+        if req.method in ("PUT", "DELETE") and \
+                self.verifier is not None and \
+                not getattr(req, "s3_identity", None):
+            return _error(403, "AccessDenied",
+                          "policy mutation requires a signed request")
+        if req.method == "PUT":
+            try:
+                parse_policy(req.body)
+            except PolicyError as err:
+                return _error(400, "MalformedPolicy", str(err))
+            e.extended["policy"] = req.body.decode()
+            self.filer.create_entry(e, create_parents=False)
+            return 204, b""
+        if req.method == "GET":
+            doc = e.extended.get("policy", "")
+            if not doc:
+                return _error(404, "NoSuchBucketPolicy", bucket)
+            return 200, (doc.encode(), "application/json")
+        if req.method == "DELETE":
+            e.extended.pop("policy", None)
+            self.filer.create_entry(e, create_parents=False)
+            return 204, b""
+        return _error(405, "MethodNotAllowed", req.method)
 
     def _bucket_cors_op(self, req: Request, bucket: str):
         path = self._bucket_path(bucket)
@@ -416,6 +491,8 @@ class S3ApiServer:
             return self._bucket_versioning_op(req, bucket)
         if "object-lock" in req.query:
             return self._bucket_object_lock_op(req, bucket)
+        if "policy" in req.query:
+            return self._bucket_policy_op(req, bucket)
         if "cors" in req.query:
             return self._bucket_cors_op(req, bucket)
         if "versions" in req.query and req.method == "GET":

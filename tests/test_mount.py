@@ -257,3 +257,36 @@ def test_real_kernel_mount(cluster, tmp_path):
             proc.wait(timeout=5)
         except Exception:
             proc.kill()
+
+
+def test_readonly_release_and_chmod_and_dir_rename(fs):
+    """Review regressions: a read-only close must not destroy a
+    writer's buffer; chmod persists; dir rename re-keys descendant
+    write buffers."""
+    w, filer = fs
+    # writer holds /docs/a.txt open; a read-only open+close interleaves
+    w.open("/docs/a.txt", os.O_RDWR)
+    w.write("/docs/a.txt", b"EDIT", 0)
+    w.open("/docs/a.txt", os.O_RDONLY)
+    w.release("/docs/a.txt", writable=False)  # reader closes
+    w.write("/docs/a.txt", b"!", 4)           # writer still valid
+    w.release("/docs/a.txt")
+    assert filer.filer.read_file("/docs/a.txt").startswith(b"EDIT!")
+
+    # chmod persists through a subsequent save
+    w.chmod("/docs/a.txt", 0o754)
+    assert w.getattr("/docs/a.txt")["st_mode"] & 0o777 == 0o754
+    w.open("/docs/a.txt", os.O_RDWR | os.O_TRUNC)
+    w.write("/docs/a.txt", b"resaved", 0)
+    w.release("/docs/a.txt")
+    assert w.getattr("/docs/a.txt")["st_mode"] & 0o777 == 0o754
+    assert filer.filer.read_file("/docs/a.txt") == b"resaved"
+
+    # rename of a DIRECTORY moves open descendants' buffers
+    w.mkdir("/docs/dir1")
+    w.create("/docs/dir1/f.txt")
+    w.write("/docs/dir1/f.txt", b"inside", 0)
+    w.rename("/docs/dir1", "/docs/dir2")
+    w.release("/docs/dir2/f.txt")
+    assert filer.filer.read_file("/docs/dir2/f.txt") == b"inside"
+    assert filer.filer.find_entry("/docs/dir1") is None

@@ -535,3 +535,93 @@ def test_object_lock_bucket_default(s3):
     assert gh["x-amz-object-lock-mode"] == "GOVERNANCE"
     assert s3req(s3, "DELETE", "/lockd/auto.txt",
                  query={"versionId": vid})[0] == 403
+
+
+POLICY_PUBLIC_READ = b"""{
+  "Version": "2012-10-17",
+  "Statement": [{
+    "Effect": "Allow",
+    "Principal": "*",
+    "Action": ["s3:GetObject", "s3:ListBucket"],
+    "Resource": ["arn:aws:s3:::pubb", "arn:aws:s3:::pubb/*"]
+  }]
+}"""
+
+
+def test_bucket_policy_public_read(s3):
+    """The policy engine's primary job: open specific resources to
+    anonymous principals while everything else stays signed-only."""
+    s3req(s3, "PUT", "/pubb")
+    s3req(s3, "PUT", "/pubb/open.txt", b"world-readable")
+    st, _, _ = s3req(s3, "PUT", "/pubb", POLICY_PUBLIC_READ,
+                     query={"policy": ""})
+    assert st == 204
+    # anonymous GET allowed by policy
+    st, body, _ = s3req(s3, "GET", "/pubb/open.txt", unsigned=True)
+    assert st == 200 and body == b"world-readable"
+    # anonymous WRITE still refused (no s3:PutObject grant)
+    st, _, _ = s3req(s3, "PUT", "/pubb/evil.txt", b"x",
+                     unsigned=True)
+    assert st == 403
+    # other buckets stay closed to anonymous
+    s3req(s3, "PUT", "/privb")
+    s3req(s3, "PUT", "/privb/secret.txt", b"s")
+    assert s3req(s3, "GET", "/privb/secret.txt",
+                 unsigned=True)[0] == 403
+    # anonymous cannot rewrite the policy that admits it
+    st, _, _ = s3req(s3, "PUT", "/pubb",
+                     b'{"Statement":[{"Effect":"Allow","Principal":'
+                     b'"*","Action":"s3:*","Resource":'
+                     b'"arn:aws:s3:::pubb/*"}]}',
+                     query={"policy": ""}, unsigned=True)
+    assert st == 403
+    # GET/DELETE policy roundtrip (signed)
+    st, body, _ = s3req(s3, "GET", "/pubb", query={"policy": ""})
+    assert st == 200 and b"GetObject" in body
+    assert s3req(s3, "DELETE", "/pubb",
+                 query={"policy": ""})[0] == 204
+    assert s3req(s3, "GET", "/pubb/open.txt",
+                 unsigned=True)[0] == 403  # grant revoked
+
+
+def test_bucket_policy_explicit_deny(s3):
+    """Explicit Deny beats a valid signature (AWS evaluation order)."""
+    s3req(s3, "PUT", "/denyb")
+    s3req(s3, "PUT", "/denyb/keep.txt", b"precious")
+    policy = (b'{"Statement":[{"Effect":"Deny","Principal":'
+              b'{"AWS":["' + AK.encode() + b'"]},'
+              b'"Action":"s3:DeleteObject",'
+              b'"Resource":"arn:aws:s3:::denyb/*"}]}')
+    assert s3req(s3, "PUT", "/denyb", policy,
+                 query={"policy": ""})[0] == 204
+    st, body, _ = s3req(s3, "DELETE", "/denyb/keep.txt")
+    assert st == 403 and b"denied by bucket policy" in body
+    # reads still fine
+    assert s3req(s3, "GET", "/denyb/keep.txt")[1] == b"precious"
+    # malformed policy rejected
+    assert s3req(s3, "PUT", "/denyb", b"{not json",
+                 query={"policy": ""})[0] == 400
+
+
+def test_policy_engine_unit():
+    from seaweedfs_tpu.s3.policy import (PolicyError, action_for,
+                                         evaluate, parse_policy,
+                                         resource_arn)
+    stmts = parse_policy(POLICY_PUBLIC_READ)
+    assert evaluate(stmts, "anonymous", "s3:GetObject",
+                    "arn:aws:s3:::pubb/a/b.txt") == "Allow"
+    assert evaluate(stmts, "anonymous", "s3:PutObject",
+                    "arn:aws:s3:::pubb/a") is None
+    assert evaluate(stmts, "anonymous", "s3:GetObject",
+                    "arn:aws:s3:::other/x") is None
+    # wildcard actions
+    stmts = parse_policy(
+        b'{"Statement":[{"Effect":"Deny","Principal":"*",'
+        b'"Action":"s3:Delete*","Resource":"arn:aws:s3:::b/*"}]}')
+    assert evaluate(stmts, "k", "s3:DeleteObjectVersion",
+                    "arn:aws:s3:::b/k") == "Deny"
+    assert action_for("GET", "b", "k", {}) == "s3:GetObject"
+    assert action_for("GET", "b", "", {}) == "s3:ListBucket"
+    assert resource_arn("b", "k/x") == "arn:aws:s3:::b/k/x"
+    with pytest.raises(PolicyError):
+        parse_policy(b'{"Statement":[{"Effect":"Maybe"}]}')
