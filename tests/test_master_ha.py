@@ -124,9 +124,23 @@ def test_leader_kill_failover(ha_cluster):
     # sequence fencing: the new leader must not reissue old needle keys
     key_after = int(fid_after.split(",")[1][:-8], 16)
     assert key_after > key_before
-    assert operation.read(seeds, fid_after) == b"after-failover"
+
+    def read_retry(fid):
+        # a volume server may not have re-heartbeated its volume list to
+        # the new leader yet, so lookups can transiently miss — the same
+        # window the write loop above rides out
+        deadline = time.time() + 5
+        while True:
+            try:
+                return operation.read(seeds, fid)
+            except (RuntimeError, LookupError, OSError):
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.2)
+
+    assert read_retry(fid_after) == b"after-failover"
     # pre-failover data still readable through the new topology
-    assert operation.read(seeds, fid_before) == b"before-failover"
+    assert read_retry(fid_before) == b"before-failover"
 
 
 def test_stepped_down_leader_rejoins_as_follower(ha_cluster):
