@@ -143,6 +143,33 @@ def main(argv: list[str] | None = None) -> int:
                       help="offset checkpoint file")
     fbak.add_argument("-interval", type=float, default=0.5)
 
+    sf = sub.add_parser(
+        "sftp", help="SFTP gateway attached to a running filer "
+        "(weed/sftpd; from-scratch SSH transport — no SSH lib in env)")
+    sf.add_argument("-ip", default="127.0.0.1")
+    sf.add_argument("-port", type=int, default=2022)
+    sf.add_argument("-filer", default="127.0.0.1:8888")
+    sf.add_argument("-userStoreFile", dest="user_store", required=True,
+                    help="JSON user store (sftpd/user/filestore.go)")
+    sf.add_argument("-hostKeyFile", dest="host_key", default="",
+                    help="ed25519 host key PEM; generated+saved if "
+                         "missing")
+    sf.add_argument("-authMethods", dest="auth_methods",
+                    default="password,publickey")
+    sf.add_argument("-banner", default="")
+
+    sfu = sub.add_parser(
+        "sftp.user", help="manage an SFTP user-store file")
+    sfu.add_argument("-store", required=True)
+    sfu.add_argument("action", choices=["add", "delete", "list"])
+    sfu.add_argument("-name", default="")
+    sfu.add_argument("-password", default="")
+    sfu.add_argument("-home", default="")
+    sfu.add_argument("-pubkey", default="",
+                     help="authorized key line 'ssh-ed25519 <b64>'")
+    sfu.add_argument("-perm", action="append", default=[],
+                     help="path:perm1,perm2 (repeatable)")
+
     sh = sub.add_parser("shell", help="interactive admin shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
     sh.add_argument("-filer", default="",
@@ -325,6 +352,58 @@ def main(argv: list[str] | None = None) -> int:
             bak.run()
         except KeyboardInterrupt:
             pass
+    elif args.cmd == "sftp":
+        import os
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey)
+        from .filer.client import FilerClient
+        from .sftp import SftpService, UserStore
+        key = None
+        if args.host_key:
+            if os.path.exists(args.host_key):
+                with open(args.host_key, "rb") as f:
+                    key = serialization.load_pem_private_key(
+                        f.read(), password=None)
+            else:
+                key = Ed25519PrivateKey.generate()
+                with open(args.host_key, "wb") as f:
+                    f.write(key.private_bytes(
+                        serialization.Encoding.PEM,
+                        serialization.PrivateFormat.PKCS8,
+                        serialization.NoEncryption()))
+        svc = SftpService(
+            FilerClient(args.filer), UserStore(args.user_store),
+            host_key=key, port=args.port,
+            auth_methods=tuple(args.auth_methods.split(",")),
+            banner=args.banner).start()
+        print(f"sftp on {args.ip}:{svc.port} serving filer "
+              f"{args.filer}")
+        _wait()
+    elif args.cmd == "sftp.user":
+        from .sftp import User, UserStore
+        store = UserStore(args.store)
+        if args.action == "list":
+            for u in store:
+                print(f"{u.username} home={u.home_dir} "
+                      f"keys={len(u.public_keys)} "
+                      f"perms={u.permissions}")
+        elif args.action == "delete":
+            store.delete(args.name)
+            print(f"deleted {args.name}")
+        else:
+            u = store.get(args.name) or User(args.name, args.home)
+            if args.home:
+                u.home_dir = args.home
+            if args.password:
+                u.set_password(args.password)
+            if args.pubkey:
+                u.add_public_key(args.pubkey)
+            for spec in args.perm:
+                path, _, perms = spec.partition(":")
+                u.permissions[path] = perms.split(",")
+            store.put(u)
+            print(f"saved {u.username}")
     elif args.cmd == "shell":
         from .shell import CommandEnv, run_command
         env = CommandEnv(args.master, filer=args.filer)

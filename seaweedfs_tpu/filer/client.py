@@ -84,6 +84,23 @@ class FilerClient:
         if st != 200:
             raise OSError(f"filer rename {old_path}: {st}")
 
+    def update_attrs(self, path: str, **kw) -> None:
+        """Attribute-only update via /__meta__/set_attrs (the endpoint
+        replaces the whole attribute block, so read-modify-write)."""
+        entry = self.find_entry(path)
+        if entry is None:
+            raise FileNotFoundError(path)
+        for k, v in kw.items():
+            setattr(entry.attributes, k, v)
+        st, _, _ = http_bytes(
+            "POST", f"{self.filer}/__meta__/set_attrs",
+            json.dumps({"path": path,
+                        "attributes": entry.attributes.to_json()}
+                       ).encode(),
+            {"Content-Type": "application/json"})
+        if st != 200:
+            raise OSError(f"filer set_attrs {path}: {st}")
+
     # -- content ----------------------------------------------------------
 
     def write_file(self, path: str, data: bytes, mime: str = "",

@@ -106,6 +106,17 @@ class Filer:
             normalize_path(path), start_file, include_start, limit,
             prefix)
 
+    def update_attrs(self, path: str, **kw) -> None:
+        """Attribute-only UpdateEntry (filer.proto UpdateEntry with
+        unchanged chunks): mode/uid/gid/mtime patches from gateways
+        (SFTP setstat, mount chmod) that content writes can't carry."""
+        entry = self.find_entry(path)
+        if entry is None:
+            raise FileNotFoundError(path)
+        for k, v in kw.items():
+            setattr(entry.attributes, k, v)
+        self.create_entry(entry, create_parents=False)
+
     def rename(self, old_path: str, new_path: str) -> None:
         """Atomic within the store (filer.proto AtomicRenameEntry);
         directories move their whole subtree."""

@@ -1,0 +1,23 @@
+"""SFTP gateway (reference: weed/sftpd/).
+
+The reference wraps golang.org/x/crypto/ssh + github.com/pkg/sftp and
+adds the seaweed parts: a JSON user store with per-path permissions
+(sftpd/user/filestore.go), password/publickey auth (sftpd/auth/), and
+filer-backed file handlers (sftpd/sftp_filer.go).  This image has no
+SSH library at all (no paramiko/asyncssh), so the transport itself is
+implemented here from the RFCs:
+
+- ssh_wire:   RFC 4251 types + RFC 4253 binary packet protocol
+- transport:  version exchange, curve25519-sha256 kex (RFC 8731),
+              ssh-ed25519 host keys, aes128-ctr + hmac-sha2-256,
+              both server and client roles
+- users:      user store (sftpd/user/user.go, filestore.go)
+- handlers:   SFTP v3 op table over the filer (sftpd/sftp_filer.go)
+- server:     accept loop + userauth + session channels + subsystem
+- client:     minimal SSH/SFTP client (tests + `weed sftp.get/put`)
+"""
+
+from .server import SftpService
+from .users import User, UserStore
+
+__all__ = ["SftpService", "User", "UserStore"]

@@ -1,0 +1,256 @@
+"""SFTP gateway tests (weed/sftpd/sftp_server_test.go analog), driving
+the from-scratch SSH transport end to end: kex, both auth methods,
+file transfer, directory ops, permission enforcement.
+
+No OpenSSH/paramiko exists in the image, so the client side is our own
+sftp.client — but the transport is exercised for real: every byte
+crosses a TCP socket through AES-128-CTR + HMAC-SHA2-256 framing.
+"""
+
+import os
+import time
+
+import pytest
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey)
+
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.sftp import SftpService, User, UserStore
+from seaweedfs_tpu.sftp.client import SftpClient, SftpError, \
+    openssh_pubkey
+
+USER_KEY = Ed25519PrivateKey.generate()
+
+
+def _user_store(tmp_path):
+    store = UserStore(str(tmp_path / "users.json"))
+    alice = User("alice", "/home/alice")
+    alice.set_password("alicepw")
+    alice.add_public_key(openssh_pubkey(USER_KEY, "alice@test"))
+    store.put(alice)
+    bob = User("bob", "/home/bob")
+    bob.set_password("bobpw")
+    # bob may read alice's published dir but not write it
+    bob.permissions["/home/alice/pub"] = ["read", "list"]
+    store.put(bob)
+    return store
+
+
+@pytest.fixture(params=["inprocess", "remote"])
+def sftp(tmp_path, request):
+    from seaweedfs_tpu.filer.client import FilerClient
+    master = MasterServer().start()
+    vols = [VolumeServer([str(tmp_path / f"v{i}")], master.url,
+                         pulse_seconds=0.3).start() for i in range(2)]
+    time.sleep(0.5)
+    filer = FilerServer(master.url).start()
+    fs = filer.filer if request.param == "inprocess" \
+        else FilerClient(filer.url)
+    svc = SftpService(fs, _user_store(tmp_path)).start()
+    yield svc
+    svc.stop()
+    filer.stop()
+    for vs in vols:
+        vs.stop()
+    master.stop()
+
+
+def _connect(svc, username="alice", password="alicepw", **kw):
+    return SftpClient("127.0.0.1", svc.port, username,
+                      password=password,
+                      expected_host_key=svc.host_public_raw, **kw)
+
+
+def test_password_auth_and_roundtrip(sftp):
+    c = _connect(sftp)
+    c.write_file("/home/alice/hello.txt", b"over ssh")
+    assert c.read_file("/home/alice/hello.txt") == b"over ssh"
+    c.close()
+
+
+def test_bad_password_rejected(sftp):
+    with pytest.raises(PermissionError):
+        _connect(sftp, password="wrong")
+
+
+def test_unknown_user_rejected(sftp):
+    with pytest.raises(PermissionError):
+        _connect(sftp, username="mallory", password="x")
+
+
+def test_publickey_auth(sftp):
+    c = SftpClient("127.0.0.1", sftp.port, "alice", key=USER_KEY,
+                   expected_host_key=sftp.host_public_raw)
+    c.write_file("/home/alice/bykey.txt", b"ed25519")
+    assert c.read_file("/home/alice/bykey.txt") == b"ed25519"
+    c.close()
+
+
+def test_wrong_key_rejected(sftp):
+    with pytest.raises(PermissionError):
+        SftpClient("127.0.0.1", sftp.port, "alice",
+                   key=Ed25519PrivateKey.generate(),
+                   expected_host_key=sftp.host_public_raw)
+
+
+def test_host_key_pinning(sftp):
+    from seaweedfs_tpu.sftp.transport import SshError
+    with pytest.raises(SshError):
+        SftpClient("127.0.0.1", sftp.port, "alice",
+                   password="alicepw", expected_host_key=b"\x00" * 32)
+
+
+def test_large_file_multipacket(sftp):
+    """> window/packet sizes: exercises channel flow control and SFTP
+    packet reassembly across CHANNEL_DATA boundaries."""
+    c = _connect(sftp)
+    blob = os.urandom(3 * 1024 * 1024 + 17)
+    c.write_file("/home/alice/big.bin", blob)
+    assert c.read_file("/home/alice/big.bin") == blob
+    c.close()
+
+
+def test_mkdir_listdir_remove(sftp):
+    c = _connect(sftp)
+    c.mkdir("/home/alice/docs")
+    c.write_file("/home/alice/docs/a.txt", b"a")
+    c.write_file("/home/alice/docs/b.txt", b"bb")
+    names = dict(c.listdir("/home/alice/docs"))
+    assert set(names) == {"a.txt", "b.txt"}
+    assert names["b.txt"]["size"] == 2
+    c.remove("/home/alice/docs/a.txt")
+    assert dict(c.listdir("/home/alice/docs")).keys() == {"b.txt"}
+    c.remove("/home/alice/docs/b.txt")
+    c.rmdir("/home/alice/docs")
+    assert "docs" not in dict(c.listdir("/home/alice"))
+    c.close()
+
+
+def test_rename_and_stat(sftp):
+    c = _connect(sftp)
+    c.write_file("/home/alice/old.txt", b"move me")
+    c.rename("/home/alice/old.txt", "/home/alice/new.txt")
+    st = c.stat("/home/alice/new.txt")
+    assert st["size"] == 7
+    with pytest.raises(SftpError):
+        c.stat("/home/alice/old.txt")
+    c.close()
+
+
+def test_relative_paths_resolve_against_home(sftp):
+    c = _connect(sftp)
+    assert c.realpath(".") == "/home/alice"
+    c.write_file("rel.txt", b"relative")
+    assert c.read_file("/home/alice/rel.txt") == b"relative"
+    c.close()
+
+
+def test_random_access_write(sftp):
+    import seaweedfs_tpu.sftp.handlers as fx
+    c = _connect(sftp)
+    h = c.open("/home/alice/sparse.bin",
+               fx.FXF_WRITE | fx.FXF_CREAT | fx.FXF_TRUNC)
+    c.write_at(h, 10, b"tail")
+    c.write_at(h, 0, b"head")
+    c.close_handle(h)
+    assert c.read_file("/home/alice/sparse.bin") == \
+        b"head" + b"\x00" * 6 + b"tail"
+    c.close()
+
+
+def test_truncate_via_setstat(sftp):
+    c = _connect(sftp)
+    c.write_file("/home/alice/t.txt", b"0123456789")
+    c.setstat("/home/alice/t.txt", size=4)
+    assert c.read_file("/home/alice/t.txt") == b"0123"
+    c.close()
+
+
+def test_chmod_persists(sftp):
+    c = _connect(sftp)
+    c.write_file("/home/alice/x.sh", b"#!/bin/sh\n")
+    c.setstat("/home/alice/x.sh", mode=0o755)
+    assert c.stat("/home/alice/x.sh")["mode"] & 0o7777 == 0o755
+    c.close()
+
+
+def test_chmod_survives_rewrite(sftp):
+    """Code-review regression: a content write must not reset mode —
+    mount's flush() carries attrs for the same reason."""
+    c = _connect(sftp)
+    c.write_file("/home/alice/run.sh", b"v1")
+    c.setstat("/home/alice/run.sh", mode=0o755)
+    c.write_file("/home/alice/run.sh", b"v2 longer body")
+    assert c.stat("/home/alice/run.sh")["mode"] & 0o7777 == 0o755
+    assert c.read_file("/home/alice/run.sh") == b"v2 longer body"
+    c.close()
+
+
+def test_readdir_pages_large_directory(sftp):
+    """READDIR must batch (no single giant FXP_NAME, no 10k silent
+    cap): 250 entries > the 100-entry page size."""
+    c = _connect(sftp)
+    c.mkdir("/home/alice/many")
+    for i in range(250):
+        c.write_file(f"/home/alice/many/f{i:04d}", b"x")
+    names = dict(c.listdir("/home/alice/many"))
+    assert len(names) == 250
+    assert names["f0249"]["size"] == 1
+    c.close()
+
+
+def test_home_grant_beats_broad_rule(tmp_path):
+    """Permission order regression: a '/' read-only rule must not lock
+    a user out of their own home (home grant checked first)."""
+    u = User("dana", "/home/dana")
+    u.permissions["/"] = ["read"]
+    assert u.allowed("/home/dana/f.txt", "write")
+    assert u.allowed("/srv/pub/f.txt", "read")
+    assert not u.allowed("/srv/pub/f.txt", "write")
+
+
+def test_permission_outside_home_denied(sftp):
+    c = _connect(sftp)
+    with pytest.raises(SftpError) as e:
+        c.write_file("/etc/passwd", b"nope")
+    assert e.value.code == 3  # FX_PERMISSION_DENIED
+    c.close()
+
+
+def test_cross_user_explicit_grants(sftp):
+    alice = _connect(sftp)
+    alice.mkdir("/home/alice/pub")
+    alice.write_file("/home/alice/pub/share.txt", b"published")
+    bob = _connect(sftp, username="bob", password="bobpw")
+    # read grant works
+    assert bob.read_file("/home/alice/pub/share.txt") == b"published"
+    assert dict(bob.listdir("/home/alice/pub")).keys() == {"share.txt"}
+    # but writes are denied (grant is read+list only)
+    with pytest.raises(SftpError):
+        bob.write_file("/home/alice/pub/evil.txt", b"x")
+    # and alice's private files stay private
+    alice.write_file("/home/alice/secret.txt", b"private")
+    with pytest.raises(SftpError):
+        bob.read_file("/home/alice/secret.txt")
+    alice.close()
+    bob.close()
+
+
+def test_user_store_file_roundtrip(tmp_path):
+    path = str(tmp_path / "users.json")
+    store = UserStore(path)
+    u = User("carol")
+    u.set_password("pw")
+    u.permissions["/data"] = ["read"]
+    store.put(u)
+    again = UserStore(path)
+    loaded = again.get("carol")
+    assert loaded.check_password("pw")
+    assert not loaded.check_password("other")
+    assert loaded.permissions == {"/data": ["read"]}
+    # reference-compatible plaintext field also authenticates
+    loaded.password_hashed = ""
+    loaded.password_plain = "legacy"
+    assert loaded.check_password("legacy")
