@@ -82,6 +82,25 @@ def main(argv: list[str] | None = None) -> int:
     s3p.add_argument("-store", default="filer.db")
     s3p.add_argument("-accessKey", default="")
     s3p.add_argument("-secretKey", default="")
+    s3p.add_argument("-iamConfig", dest="iam_config", default="",
+                     help="identities JSON (auth_credentials.go "
+                          "s3.json shape); supersedes -accessKey")
+    s3p.add_argument("-stsKey", dest="sts_key", default="",
+                     help="STS signing key: accept temporary "
+                          "credentials minted by the iam server")
+    s3p.add_argument("-rolesFile", dest="roles_file", default="")
+    s3p.add_argument("-kmsFile", dest="kms_file", default="",
+                     help="local KMS keystore (enables SSE-KMS)")
+
+    iamp = sub.add_parser(
+        "iam", help="IAM management API + STS AssumeRole "
+        "(weed/iamapi, weed/iam/sts) sharing an identities JSON with "
+        "the s3 gateway")
+    iamp.add_argument("-ip", default="127.0.0.1")
+    iamp.add_argument("-port", type=int, default=8111)
+    iamp.add_argument("-iamConfig", dest="iam_config", required=True)
+    iamp.add_argument("-stsKey", dest="sts_key", default="")
+    iamp.add_argument("-rolesFile", dest="roles_file", default="")
 
     ad = sub.add_parser("admin", help="start the maintenance admin server")
     ad.add_argument("-ip", default="127.0.0.1")
@@ -280,10 +299,33 @@ def main(argv: list[str] | None = None) -> int:
         from .filer.filer_store import SqliteStore
         creds = {args.accessKey: args.secretKey} if args.accessKey \
             else None
+        iam_store = sts = kms = None
+        if args.iam_config:
+            from .iam import IdentityStore, StsService
+            from .iam.sts import RoleStore
+            iam_store = IdentityStore(args.iam_config)
+            if args.sts_key:
+                sts = StsService(args.sts_key,
+                                 RoleStore(args.roles_file or None))
+        if args.kms_file:
+            from .iam.kms import LocalKms
+            kms = LocalKms(args.kms_file)
         filer = Filer(args.master, SqliteStore(args.store))
-        gw = S3ApiServer(filer, args.ip, args.port, credentials=creds)
+        gw = S3ApiServer(filer, args.ip, args.port, credentials=creds,
+                         iam=iam_store, sts=sts, kms=kms)
         gw.start()
         print(f"s3 gateway listening on {gw.url}")
+        _wait()
+    elif args.cmd == "iam":
+        from .iam import IdentityStore, StsService
+        from .iam.iamapi import IamApiServer
+        from .iam.sts import RoleStore
+        store = IdentityStore(args.iam_config)
+        sts = StsService(args.sts_key,
+                         RoleStore(args.roles_file or None)) \
+            if args.sts_key else None
+        srv = IamApiServer(store, sts, args.ip, args.port).start()
+        print(f"iam api on {srv.url}")
         _wait()
     elif args.cmd == "admin":
         from .plugin.admin import AdminServer

@@ -1,0 +1,23 @@
+"""IAM subsystem (reference: weed/iam/, weed/iamapi/, weed/kms/,
+weed/credential/, plus the identity model of
+weed/s3api/auth_credentials.go).
+
+- identity:  Identity/Account/Credential model, coarse S3 actions,
+             JSON identity store (the reference's s3.json /
+             /etc/iam/identity.json config shape)
+- sts:       stateless temporary credentials — session-token JWTs the
+             S3 gateway verifies with no shared session state
+             (iam/sts/sts_service.go design)
+- iamapi:    AWS IAM-compatible REST API (Action=CreateUser... form
+             posts, XML responses) mutating the identity store
+             (iamapi/iamapi_management_handlers.go)
+- kms:       local KMS provider + envelope encryption for SSE-KMS
+             (kms/local/, kms/envelope.go)
+"""
+
+from .identity import (Account, Credential, Identity, IdentityStore,
+                       coarse_action)
+from .sts import StsService
+
+__all__ = ["Account", "Credential", "Identity", "IdentityStore",
+           "StsService", "coarse_action"]

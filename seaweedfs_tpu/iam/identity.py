@@ -1,0 +1,308 @@
+"""Identity model + store (reference: weed/s3api/auth_credentials.go
+Identity/Account/Credential and its s3.json config format, plus
+weed/credential/ store archetypes).
+
+Identities carry COARSE actions ("Admin", "Read:bucket/prefix",
+"Write:bucket", ...) — the reference's first authorization layer,
+evaluated before (and independently of) bucket-policy documents.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import threading
+
+# s3_constants/s3_actions.go
+ACTION_READ = "Read"
+ACTION_WRITE = "Write"
+ACTION_LIST = "List"
+ACTION_TAGGING = "Tagging"
+ACTION_ADMIN = "Admin"
+ACTION_DELETE_BUCKET = "DeleteBucket"
+ACTION_READ_ACP = "ReadAcp"
+ACTION_WRITE_ACP = "WriteAcp"
+ACTION_BYPASS_GOVERNANCE = "BypassGovernanceRetention"
+
+# auth_credentials.go:1534 CanDo consults these in order:
+#   exact action, then "<Action>:<bucket[/key]>" patterns with
+#   wildcards, then Admin-scoped equivalents.
+
+
+def coarse_action(s3_action: str, method: str = "",
+                  query: dict | None = None) -> str:
+    """Map the fine-grained s3:* action names (policy engine
+    vocabulary) onto the reference's coarse identity actions — the
+    mapping s3api_server.go encodes by wrapping each route in
+    iam.Auth(handler, ACTION_X)."""
+    q = query or {}
+    a = s3_action.removeprefix("s3:")
+    if a in ("GetObjectRetention", "GetObjectLegalHold"):
+        return ACTION_READ
+    if a in ("PutObjectRetention", "PutObjectLegalHold"):
+        return ACTION_WRITE
+    if "Tagging" in a:
+        return ACTION_TAGGING
+    if a.endswith("Acl"):
+        return ACTION_READ_ACP if a.startswith("Get") else \
+            ACTION_WRITE_ACP
+    if a == "DeleteBucket":
+        return ACTION_DELETE_BUCKET
+    if a.startswith("List"):
+        return ACTION_LIST
+    if a in ("GetObject", "GetObjectVersion", "HeadObject"):
+        return ACTION_READ
+    if a in ("PutObject", "DeleteObject", "DeleteObjectVersion",
+             "AbortMultipartUpload", "RestoreObject"):
+        return ACTION_WRITE
+    if a == "CreateBucket":
+        return ACTION_ADMIN
+    # bucket configuration subresources (policy/cors/versioning/
+    # object-lock/encryption/...) are admin-plane
+    return ACTION_ADMIN
+
+
+class Credential:
+    def __init__(self, access_key: str, secret_key: str,
+                 status: str = "Active"):
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.status = status
+
+    def to_json(self) -> dict:
+        return {"accessKey": self.access_key,
+                "secretKey": self.secret_key, "status": self.status}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Credential":
+        return cls(d["accessKey"], d["secretKey"],
+                   d.get("status", "Active"))
+
+
+class Account:
+    """auth_credentials.go Account: the ownership principal S3 ACLs
+    name.  The three canned accounts mirror the reference."""
+
+    def __init__(self, acc_id: str, display_name: str = "",
+                 email: str = ""):
+        self.id = acc_id
+        self.display_name = display_name or acc_id
+        self.email = email
+
+    def to_json(self) -> dict:
+        return {"id": self.id, "displayName": self.display_name,
+                "emailAddress": self.email}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Account":
+        return cls(d.get("id", ""), d.get("displayName", ""),
+                   d.get("emailAddress", ""))
+
+
+ACCOUNT_ADMIN = Account("admin", "admin")
+ACCOUNT_ANONYMOUS = Account("anonymous", "anonymous")
+
+
+class Identity:
+    def __init__(self, name: str,
+                 credentials: list[Credential] | None = None,
+                 actions: list[str] | None = None,
+                 account: Account | None = None,
+                 disabled: bool = False,
+                 principal_arn: str = ""):
+        self.name = name
+        self.credentials = credentials or []
+        self.actions = actions or []
+        self.account = account or ACCOUNT_ADMIN
+        self.disabled = disabled
+        self.principal_arn = principal_arn or \
+            f"arn:aws:iam:::user/{name}"
+        # inline IAM policy documents by name (iamapi PutUserPolicy);
+        # identity.actions holds their aggregated coarse translation
+        self.policies: dict[str, str] = {}
+
+    @property
+    def is_admin(self) -> bool:
+        return ACTION_ADMIN in self.actions
+
+    def can_do(self, action: str, bucket: str, key: str = "") -> bool:
+        """auth_credentials.go:1534 CanDo: exact action grants the
+        whole system; otherwise match "<Action>:<bucket[/key]>"
+        entries (wildcards allowed) with Admin:<scope> as superset."""
+        if self.disabled:
+            return False
+        if self.is_admin:
+            return True
+        if action in self.actions:
+            return True
+        if not bucket:
+            return False
+        full = bucket + ("/" + key.lstrip("/") if key else "")
+        targets = (f"{action}:{full}", f"{ACTION_ADMIN}:{full}")
+        for a in self.actions:
+            if ":" not in a:
+                continue
+            if "*" in a or "?" in a:
+                # wildcard entries match the fully-qualified target
+                # (auth_credentials.go MatchesWildcard branch)
+                if any(fnmatch.fnmatchcase(t, a) for t in targets):
+                    return True
+                continue
+            granted, _, scope = a.partition(":")
+            if granted not in (action, ACTION_ADMIN):
+                continue
+            # exact scope, bucket-limited scope, or path-prefix scope
+            if scope in (full, bucket) or \
+                    full.startswith(scope.rstrip("/") + "/"):
+                return True
+        return False
+
+    def to_json(self) -> dict:
+        return {"name": self.name,
+                "credentials": [c.to_json() for c in self.credentials],
+                "actions": list(self.actions),
+                "account": self.account.to_json(),
+                "disabled": self.disabled,
+                "principalArn": self.principal_arn,
+                "policies": dict(self.policies)}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Identity":
+        ident = cls(d["name"],
+                    [Credential.from_json(c)
+                     for c in d.get("credentials", [])],
+                    list(d.get("actions", [])),
+                    Account.from_json(d["account"])
+                    if d.get("account") else None,
+                    d.get("disabled", False),
+                    d.get("principalArn", ""))
+        ident.policies = dict(d.get("policies", {}))
+        return ident
+
+
+class IdentityStore:
+    """The s3.json identities config as a mutable, persistent store
+    (credential/credential_store.go role).  Backing file is optional —
+    gateways can run with a purely in-memory store for tests."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._lock = threading.RLock()
+        self._identities: dict[str, Identity] = {}
+        self._by_access_key: dict[str, Identity] = {}
+        self._mtime = 0.0
+        if path and os.path.exists(path):
+            self._reload()
+
+    def _reload(self) -> None:
+        with open(self.path) as f:
+            self.load_json(json.load(f))
+        self._mtime = os.stat(self.path).st_mtime
+
+    def _maybe_reload(self) -> None:
+        """An `iam` server process and an `s3` gateway process share
+        the store through its JSON file; the reference propagates
+        config through the filer (credential/propagating_store.go) —
+        here an mtime check on lookup keeps readers current."""
+        if not self.path:
+            return
+        try:
+            m = os.stat(self.path).st_mtime
+        except OSError:
+            return
+        if m != self._mtime:
+            with self._lock:
+                if m != self._mtime:
+                    self._reload()
+
+    # -- config IO ---------------------------------------------------------
+
+    def load_json(self, doc: dict) -> None:
+        with self._lock:
+            self._identities.clear()
+            self._by_access_key.clear()
+            for d in doc.get("identities", []):
+                self._index(Identity.from_json(d))
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {"identities": [i.to_json()
+                                   for i in self._identities.values()]}
+
+    def save(self) -> None:
+        if not self.path:
+            return
+        with self._lock:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.to_json(), f, indent=1)
+            os.replace(tmp, self.path)
+            self._mtime = os.stat(self.path).st_mtime
+
+    def _index(self, ident: Identity) -> None:
+        self._identities[ident.name] = ident
+        for c in ident.credentials:
+            self._by_access_key[c.access_key] = ident
+
+    # -- lookups -----------------------------------------------------------
+
+    def get(self, name: str) -> Identity | None:
+        self._maybe_reload()
+        return self._identities.get(name)
+
+    def by_access_key(self, access_key: str) -> Identity | None:
+        self._maybe_reload()
+        return self._by_access_key.get(access_key)
+
+    def secret_for(self, access_key: str) -> str | None:
+        ident = self.by_access_key(access_key)
+        if ident is None or ident.disabled:
+            return None
+        for c in ident.credentials:
+            if c.access_key == access_key and c.status == "Active":
+                return c.secret_key
+        return None
+
+    def anonymous(self) -> Identity | None:
+        """auth_credentials.go: an identity literally named
+        "anonymous" grants unauthenticated requests its actions."""
+        return self.get("anonymous")
+
+    def __iter__(self):
+        with self._lock:
+            return iter(list(self._identities.values()))
+
+    # -- mutation (iamapi writes through these) ---------------------------
+
+    def put(self, ident: Identity) -> None:
+        with self._lock:
+            old = self._identities.get(ident.name)
+            if old is not None:
+                for c in old.credentials:
+                    self._by_access_key.pop(c.access_key, None)
+            self._index(ident)
+            self.save()
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            old = self._identities.pop(name, None)
+            if old is not None:
+                for c in old.credentials:
+                    self._by_access_key.pop(c.access_key, None)
+                self.save()
+
+    # -- SigV4Verifier adapter --------------------------------------------
+
+    class _SecretsView:
+        def __init__(self, store: "IdentityStore"):
+            self.store = store
+
+        def get(self, access_key: str) -> str | None:
+            return self.store.secret_for(access_key)
+
+    def secrets_view(self):
+        """Mapping-shaped live view for SigV4Verifier (which only
+        calls .get) — mutations through the store are visible to the
+        verifier immediately, unlike a copied dict."""
+        return IdentityStore._SecretsView(self)

@@ -89,9 +89,20 @@ def _iso(ts: float) -> str:
 class S3ApiServer:
     def __init__(self, filer: Filer, host: str = "127.0.0.1",
                  port: int = 0,
-                 credentials: dict[str, str] | None = None):
+                 credentials: dict[str, str] | None = None,
+                 iam=None, sts=None, kms=None):
+        """`credentials` is the legacy flat access->secret dict (every
+        key acts as admin).  `iam` is an iam.IdentityStore: identities
+        then carry coarse actions enforced per request
+        (auth_credentials.go CanDo), `sts` an iam.StsService whose
+        temporary credentials the verifier honors, `kms` an
+        iam.kms.LocalKms enabling SSE-KMS."""
         self.filer = filer
-        self.verifier = SigV4Verifier(credentials) if credentials else None
+        self.iam = iam
+        self.kms = kms
+        creds = iam.secrets_view() if iam is not None else credentials
+        self.verifier = SigV4Verifier(creds, sts=sts) \
+            if creds is not None else None
         self.http = HttpServer(host, port)
         self.http.fallback = self._dispatch
         # striped per-key locks: versioned mutations are
@@ -141,11 +152,9 @@ class S3ApiServer:
         ctx = None
         stmts = self._policy_rules(bucket) if bucket else []
         decision = None
-        if stmts:
-            # one evaluation serves both the anonymous-allow check and
-            # the explicit-deny check (identity patched below)
-            action = action_for(req.method, bucket, key, req.query)
-            arn = resource_arn(bucket, key)
+        action = action_for(req.method, bucket, key, req.query)
+        arn = resource_arn(bucket, key)
+        ident_obj = None          # iam.Identity once resolved
         if self.verifier is not None:
             ok, who, ctx = self.verifier.verify(
                 req.method, req.path, req.query,
@@ -153,16 +162,49 @@ class S3ApiServer:
                 req.body)
             if ok:
                 identity = who
-                req.s3_identity = who
+                if ctx is not None and ctx.sts_identity is not None:
+                    ident_obj = ctx.sts_identity
+                elif self.iam is not None:
+                    ident_obj = self.iam.by_access_key(who)
+                if ident_obj is not None:
+                    identity = ident_obj.name
+                req.s3_identity = identity
             else:
-                # unsigned/invalid: the bucket policy may still open
-                # this resource to anonymous principals (public-read
-                # buckets, the engine's primary job)
+                # unsigned/invalid: an "anonymous" identity
+                # (auth_credentials.go) or the bucket policy may still
+                # open this resource (public-read buckets)
+                anon = self.iam.anonymous() if self.iam else None
                 decision = evaluate(stmts, "anonymous", action,
                                     arn) if stmts else None
-                if decision != "Allow":
+                if decision == "Deny":
+                    # explicit policy Deny binds the anonymous
+                    # identity too — it can widen access, never
+                    # override a Deny
+                    return _error(403, "AccessDenied",
+                                  "denied by bucket policy")
+                if decision != "Allow" and anon is None:
                     return _error(403, "AccessDenied", who)
                 identity = "anonymous"
+                ident_obj = anon
+        if self.iam is not None and self.verifier is not None and \
+                decision != "Allow":
+            # first authorization layer: coarse identity actions
+            # (auth_credentials.go CanDo) — bucket policy can still
+            # explicitly deny below, but cannot widen a missing grant
+            # except for the anonymous-Allow path above
+            from ..iam import coarse_action
+            if not bucket:
+                # ListAllMyBuckets: any authenticated identity may
+                # call it; _list_buckets filters to visible buckets
+                if ident_obj is None:
+                    return _error(403, "AccessDenied", identity)
+            elif ident_obj is None or not ident_obj.can_do(
+                    coarse_action(action, req.method, req.query),
+                    bucket, key):
+                return _error(403, "AccessDenied",
+                              f"{identity} may not "
+                              f"{coarse_action(action)} {bucket}")
+            req.s3_identity_obj = ident_obj
         if stmts and decision is None:
             if evaluate(stmts, identity, action, arn) == "Deny":
                 # explicit Deny beats a valid signature
@@ -171,14 +213,20 @@ class S3ApiServer:
         sha = req.headers.get("x-amz-content-sha256", "")
         if sha.startswith("STREAMING-"):
             # aws-chunked framing (chunked_reader_v4.go): verify chunk
-            # signatures when we hold credentials, then unwrap
+            # signatures when we hold credentials, then unwrap.  A
+            # presigned-URL context carries no signing key — strip the
+            # framing unverified, as before
             try:
-                req._body = decode_streaming_body(req.body, ctx)
+                req._body = decode_streaming_body(
+                    req.body,
+                    ctx if ctx is not None and ctx.signing_key
+                    else None)
             except ChunkedDecodeError as e:
                 return _error(403, "SignatureDoesNotMatch", str(e))
         if not bucket:
             if req.method == "GET":
-                return self._list_buckets()
+                return self._list_buckets(
+                    getattr(req, "s3_identity_obj", None))
             return _error(405, "MethodNotAllowed", req.method)
         if not key:
             return self._bucket_op(req, bucket)
@@ -473,16 +521,24 @@ class S3ApiServer:
     def _bucket_path(self, bucket: str) -> str:
         return f"{BUCKETS_ROOT}/{bucket}"
 
-    def _list_buckets(self):
+    def _list_buckets(self, ident=None):
+        """With an IAM identity, only buckets it can Read or List are
+        shown (s3api_bucket_handlers.go ListBucketsHandler filters the
+        same way)."""
         root = ET.Element("ListAllMyBucketsResult", xmlns=S3_NS)
         owner = _elem(root, "Owner")
         _elem(owner, "ID", "seaweedfs-tpu")
         buckets = _elem(root, "Buckets")
         for e in self.filer.list_directory(BUCKETS_ROOT):
-            if e.is_directory:
-                b = _elem(buckets, "Bucket")
-                _elem(b, "Name", e.name)
-                _elem(b, "CreationDate", _iso(e.attributes.crtime))
+            if not e.is_directory:
+                continue
+            if ident is not None and not (
+                    ident.can_do("Read", e.name) or
+                    ident.can_do("List", e.name)):
+                continue
+            b = _elem(buckets, "Bucket")
+            _elem(b, "Name", e.name)
+            _elem(b, "CreationDate", _iso(e.attributes.crtime))
         return 200, (_xml(root), "application/xml")
 
     def _bucket_op(self, req: Request, bucket: str):
@@ -556,11 +612,17 @@ class S3ApiServer:
             src = req.headers.get("x-amz-copy-source")
             if src:
                 return self._copy_object(req, src, path, bucket)
+            from .policy import resource_arn
             from .sse import (ALGO_HEADER, KEY_MD5_HEADER, SseError,
-                              encrypt, parse_sse_c_headers)
+                              encrypt, kms_encrypt,
+                              kms_response_headers,
+                              parse_sse_c_headers,
+                              parse_sse_kms_headers)
             lower = {k.lower(): v for k, v in req.headers.items()}
+            kms_headers = {}
             try:
                 sse = parse_sse_c_headers(lower)
+                kms_req = parse_sse_kms_headers(lower)
             except SseError as e:
                 return _error(e.status, e.code, str(e))
             body = req.body
@@ -569,6 +631,17 @@ class S3ApiServer:
                 key_bytes, key_md5 = sse
                 body, iv_hex = encrypt(key_bytes, body)
                 sse_ext = {"sseKeyMd5": key_md5, "sseIv": iv_hex}
+            elif kms_req is not None:
+                if self.kms is None:
+                    return _error(501, "NotImplemented",
+                                  "no KMS configured on this gateway")
+                try:
+                    body, sse_ext = kms_encrypt(
+                        self.kms, kms_req[0], kms_req[1],
+                        resource_arn(bucket, key), body)
+                except SseError as e:
+                    return _error(e.status, e.code, str(e))
+                kms_headers = kms_response_headers(sse_ext)
             lock_ext = self._lock_for_put(req, bucket, state)
             if not isinstance(lock_ext, dict):
                 return lock_ext  # error response
@@ -591,6 +664,7 @@ class S3ApiServer:
                 entry.extended.update(amz)
                 self.filer.create_entry(entry)
             headers = {"ETag": f'"{etag}"'}
+            headers.update(kms_headers)
             if sse is not None:
                 headers["x-amz-server-side-encryption-customer-"
                         "algorithm"] = "AES256"
@@ -651,6 +725,10 @@ class S3ApiServer:
         data = self.filer.read_file(path)
         if sse_key is not None and data:
             data = decrypt(sse_key, entry.extended["sseIv"], data)
+        elif entry.extended.get("sseKmsBlob") and data:
+            data, kms_err = self._kms_read(entry, path, data)
+            if kms_err is not None:
+                return kms_err
         try:
             rows = run_query(expression, data, input_format,
                              csv_header)
@@ -719,9 +797,34 @@ class S3ApiServer:
             return
         self.filer.rename(f"{vdir}/{newest.name}", path)
 
+    def _kms_read(self, entry: Entry, path: str, data: bytes):
+        """Decrypt an SSE-KMS body on a read path; (data, None) on
+        success, (None, error_response) otherwise — one place for the
+        no-KMS/ bad-seal handling every read path needs."""
+        from .sse import SseError, kms_decrypt
+        if self.kms is None:
+            return None, _error(501, "NotImplemented",
+                                "object is SSE-KMS encrypted but "
+                                "this gateway has no KMS")
+        try:
+            return kms_decrypt(self.kms, entry.extended,
+                               self._arn_for_path(path), data), None
+        except SseError as e:
+            return None, _error(e.status, e.code, str(e))
+
+    @staticmethod
+    def _arn_for_path(path: str) -> str:
+        """Object ARN from a filer path, versioned or not: all
+        versions of a key share the key's ARN (the KMS encryption
+        context must match what PUT bound)."""
+        rel = path.removeprefix(BUCKETS_ROOT + "/")
+        if VERSIONS_EXT + "/" in rel:
+            rel = rel.split(VERSIONS_EXT + "/", 1)[0].rstrip("/")
+        return f"arn:aws:s3:::{rel}"
+
     def _serve_entry(self, req: Request, path: str, entry: Entry):
-        from .sse import KEY_MD5_HEADER, SseError, check_read_key, \
-            decrypt
+        from .sse import (KEY_MD5_HEADER, SseError, check_read_key,
+                          decrypt, kms_response_headers)
         lower = {k.lower(): v for k, v in req.headers.items()}
         try:
             sse_key = check_read_key(entry.extended, lower)
@@ -731,6 +834,10 @@ class S3ApiServer:
             self.filer.read_file(path)
         if sse_key is not None and data:
             data = decrypt(sse_key, entry.extended["sseIv"], data)
+        elif entry.extended.get("sseKmsBlob") and data:
+            data, kms_err = self._kms_read(entry, path, data)
+            if kms_err is not None:
+                return kms_err
         etag = entry.extended.get("etag", "")
         mime = entry.attributes.mime or "application/octet-stream"
         headers = {"Content-Type": mime,
@@ -741,6 +848,7 @@ class S3ApiServer:
             headers["x-amz-server-side-encryption-customer-"
                     "algorithm"] = "AES256"
             headers[KEY_MD5_HEADER] = entry.extended["sseKeyMd5"]
+        headers.update(kms_response_headers(entry.extended))
         if entry.extended.get("lockMode"):
             headers["x-amz-object-lock-mode"] = \
                 entry.extended["lockMode"]
@@ -959,7 +1067,8 @@ class S3ApiServer:
     def _copy_object(self, req: Request, src: str, dst_path: str,
                      bucket: str):
         from .sse import (SseError, check_read_key, decrypt, encrypt,
-                          parse_sse_c_headers)
+                          kms_encrypt, parse_sse_c_headers,
+                          parse_sse_kms_headers)
         src = urllib.parse.unquote(src.lstrip("/"))
         src_path = f"{BUCKETS_ROOT}/{src}"
         entry = self.filer.find_entry(src_path)
@@ -977,16 +1086,34 @@ class S3ApiServer:
         try:
             src_key = check_read_key(entry.extended, src_sse)
             dst_sse = parse_sse_c_headers(lower)
+            dst_kms = parse_sse_kms_headers(lower)
         except SseError as e:
             return _error(e.status, e.code, str(e))
         data = self.filer.read_file(src_path)
         if src_key is not None:
             data = decrypt(src_key, entry.extended["sseIv"], data)
+        elif entry.extended.get("sseKmsBlob"):
+            data, kms_err = self._kms_read(entry, src_path, data)
+            if kms_err is not None:
+                return kms_err
         sse_ext = {}
         if dst_sse is not None:
             dst_key, dst_md5 = dst_sse
             data, iv_hex = encrypt(dst_key, data)
             sse_ext = {"sseKeyMd5": dst_md5, "sseIv": iv_hex}
+        elif dst_kms is not None:
+            if self.kms is None:
+                return _error(501, "NotImplemented",
+                              "no KMS configured on this gateway")
+            from .policy import resource_arn
+            dst_key_part = dst_path.removeprefix(
+                f"{self._bucket_path(bucket)}/")
+            try:
+                data, sse_ext = kms_encrypt(
+                    self.kms, dst_kms[0], dst_kms[1],
+                    resource_arn(bucket, dst_key_part), data)
+            except SseError as e:
+                return _error(e.status, e.code, str(e))
         # the copy is a new version: retention headers / bucket default
         # apply exactly like a plain PUT (silently skipping them would
         # bypass the bucket's retention policy)

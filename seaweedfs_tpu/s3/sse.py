@@ -73,6 +73,79 @@ def decrypt(key: bytes, iv_hex: str, ciphertext: bytes) -> bytes:
     return dec.update(ciphertext) + dec.finalize()
 
 
+SSE_HEADER = "x-amz-server-side-encryption"
+SSE_KMS_KEY_HEADER = "x-amz-server-side-encryption-aws-kms-key-id"
+DEFAULT_KMS_ALIAS = "aws/s3"   # SSE-S3 (AES256) rides a default key
+
+
+def parse_sse_kms_headers(headers: dict
+                          ) -> "tuple[str, str] | None":
+    """Returns (mode, key_identifier) for SSE-KMS / SSE-S3 requests:
+    mode is "aws:kms" or "AES256"; key id may be empty (default key).
+    Raises on SSE-C + SSE-KMS on one request (mutually exclusive,
+    s3_sse_kms.go validation)."""
+    mode = headers.get(SSE_HEADER, "")
+    if not mode:
+        return None
+    if mode not in ("aws:kms", "AES256"):
+        raise SseError(400, "InvalidArgument",
+                       f"unsupported SSE algorithm {mode!r}")
+    if headers.get(KEY_HEADER):
+        raise SseError(400, "InvalidArgument",
+                       "SSE-C and SSE-KMS are mutually exclusive")
+    return mode, headers.get(SSE_KMS_KEY_HEADER, "")
+
+
+def kms_encrypt(kms, mode: str, key_identifier: str, arn: str,
+                plaintext: bytes) -> "tuple[bytes, dict]":
+    """Envelope-encrypt an object body: fresh data key from the KMS,
+    AES-256-CTR over the body, sealed blob + IV into entry metadata
+    (kms/envelope.go + s3_sse_kms.go).  The object ARN binds the
+    encryption context."""
+    from ..iam.kms import KmsError
+    if not key_identifier:
+        key_identifier = DEFAULT_KMS_ALIAS
+        try:
+            kms.get_key_id(key_identifier)
+        except KmsError:
+            kms.create_key(alias=key_identifier,
+                           description="default S3 key")
+    try:
+        dk = kms.generate_data_key(key_identifier,
+                                   {"aws:s3:arn": arn})
+    except KmsError as e:
+        # bad/disabled key ids are client errors, not gateway crashes
+        raise SseError(400, "InvalidArgument", str(e))
+    ciphertext, iv_hex = encrypt(dk["Plaintext"], plaintext)
+    return ciphertext, {
+        "sseAlgorithm": mode,
+        "sseKmsKeyId": dk["KeyId"],
+        "sseKmsBlob": dk["CiphertextBlob"],
+        "sseIv": iv_hex,
+    }
+
+
+def kms_decrypt(kms, entry_extended: dict, arn: str,
+                ciphertext: bytes) -> bytes:
+    from ..iam.kms import KmsError
+    try:
+        dk = kms.decrypt(entry_extended["sseKmsBlob"],
+                         {"aws:s3:arn": arn})
+    except KmsError as e:
+        raise SseError(403, "AccessDenied", str(e))
+    return decrypt(dk["Plaintext"], entry_extended["sseIv"],
+                   ciphertext)
+
+
+def kms_response_headers(entry_extended: dict) -> dict:
+    if not entry_extended.get("sseKmsBlob"):
+        return {}
+    h = {SSE_HEADER: entry_extended.get("sseAlgorithm", "aws:kms")}
+    if h[SSE_HEADER] == "aws:kms":
+        h[SSE_KMS_KEY_HEADER] = entry_extended.get("sseKmsKeyId", "")
+    return h
+
+
 def check_read_key(entry_extended: dict, headers: dict
                    ) -> "bytes | None":
     """For a GET/HEAD of an object: returns the key to decrypt with,

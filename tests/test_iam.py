@@ -1,0 +1,411 @@
+"""IAM plane tests: identity actions (auth_credentials.go CanDo),
+IAM REST API (iamapi/), STS temporary credentials honored by the S3
+gateway (iam/sts/), and SSE-KMS envelope encryption (kms/)."""
+
+import json
+import time
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.iam import (Credential, Identity, IdentityStore,
+                               StsService, coarse_action)
+from seaweedfs_tpu.iam.iamapi import IamApiServer, policy_to_actions
+from seaweedfs_tpu.iam.kms import KmsError, LocalKms
+from seaweedfs_tpu.iam.sts import RoleStore, StsError
+from seaweedfs_tpu.s3 import S3ApiServer
+from seaweedfs_tpu.s3.auth import sign_request
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+STS_KEY = "sts-signing-key-for-tests"
+
+
+# -- unit: identity model --------------------------------------------------
+
+def test_can_do_admin_and_scoping():
+    admin = Identity("root", actions=["Admin"])
+    assert admin.can_do("Write", "any", "k")
+    ro = Identity("reader", actions=["Read:logs", "List:logs"])
+    assert ro.can_do("Read", "logs", "a/b.txt")
+    assert ro.can_do("List", "logs")
+    assert not ro.can_do("Write", "logs", "a")
+    assert not ro.can_do("Read", "other", "x")
+    # prefix scope: grant on a key prefix, not the whole bucket
+    scoped = Identity("s", actions=["Write:data/in"])
+    assert scoped.can_do("Write", "data", "in/f.bin")
+    assert not scoped.can_do("Write", "data", "out/f.bin")
+    # wildcard patterns
+    wild = Identity("w", actions=["Read:tenant-*"])
+    assert wild.can_do("Read", "tenant-7")
+    assert not wild.can_do("Read", "other")
+    # disabled identities can do nothing
+    off = Identity("off", actions=["Admin"], disabled=True)
+    assert not off.can_do("Read", "logs")
+
+
+def test_coarse_action_mapping():
+    assert coarse_action("s3:GetObject") == "Read"
+    assert coarse_action("s3:PutObject") == "Write"
+    assert coarse_action("s3:DeleteObject") == "Write"
+    assert coarse_action("s3:ListBucket") == "List"
+    assert coarse_action("s3:GetObjectTagging") == "Tagging"
+    assert coarse_action("s3:GetBucketPolicy") == "Admin"
+    assert coarse_action("s3:DeleteBucket") == "DeleteBucket"
+    assert coarse_action("s3:GetObjectAcl") == "ReadAcp"
+
+
+def test_policy_to_actions_translation():
+    doc = json.dumps({"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow",
+         "Action": ["s3:GetObject", "s3:ListBucket"],
+         "Resource": "arn:aws:s3:::reports/*"},
+        {"Effect": "Allow", "Action": "s3:PutObject",
+         "Resource": ["arn:aws:s3:::uploads"]},
+    ]})
+    assert policy_to_actions(doc) == \
+        ["List:reports", "Read:reports", "Write:uploads"]
+
+
+def test_identity_store_file_roundtrip_and_reload(tmp_path):
+    path = str(tmp_path / "identities.json")
+    store = IdentityStore(path)
+    ident = Identity("ops", [Credential("AK1", "SK1")],
+                     actions=["Admin"])
+    store.put(ident)
+    # a second process-view of the same file sees mutations (the
+    # mtime-reload that substitutes for config propagation)
+    view = IdentityStore(path)
+    assert view.secret_for("AK1") == "SK1"
+    ident2 = Identity("dev", [Credential("AK2", "SK2")],
+                      actions=["Read:pub"])
+    time.sleep(0.02)
+    store.put(ident2)
+    import os
+    os.utime(path)  # ensure mtime moves even on coarse clocks
+    assert view.secret_for("AK2") == "SK2"
+    assert view.get("dev").actions == ["Read:pub"]
+
+
+# -- unit: STS -------------------------------------------------------------
+
+def test_sts_roundtrip_and_trust():
+    roles = RoleStore()
+    roles.put("uploader", ["Write:inbox", "List:inbox"],
+              trust=["app-*"])
+    sts = StsService(STS_KEY, roles)
+    caller = Identity("app-1", actions=[])
+    creds = sts.assume_role(caller, "uploader", duration=900)
+    resolved = sts.resolve(creds["AccessKeyId"],
+                           creds["SessionToken"])
+    assert resolved is not None
+    secret, ident = resolved
+    assert secret == creds["SecretAccessKey"]
+    assert ident.can_do("Write", "inbox", "f")
+    assert not ident.can_do("Read", "private")
+    # untrusted caller
+    with pytest.raises(StsError):
+        sts.assume_role(Identity("intruder"), "uploader")
+    # tampered token
+    assert sts.resolve(creds["AccessKeyId"],
+                       creds["SessionToken"][:-2] + "xx") is None
+    # token bound to its own access key only
+    assert sts.resolve("STSother", creds["SessionToken"]) is None
+
+
+# -- unit: KMS -------------------------------------------------------------
+
+def test_kms_envelope_roundtrip(tmp_path):
+    kms = LocalKms(str(tmp_path / "kms.json"))
+    kid = kms.create_key(alias="primary")
+    assert kms.get_key_id("alias/primary") == kid
+    dk = kms.generate_data_key("primary", {"aws:s3:arn": "arn:x"})
+    out = kms.decrypt(dk["CiphertextBlob"], {"aws:s3:arn": "arn:x"})
+    assert out["Plaintext"] == dk["Plaintext"]
+    assert out["KeyId"] == kid
+    # wrong encryption context must fail (GCM AAD binding)
+    with pytest.raises(KmsError):
+        kms.decrypt(dk["CiphertextBlob"], {"aws:s3:arn": "arn:y"})
+    # disabled keys refuse new work
+    kms.disable_key(kid)
+    with pytest.raises(KmsError):
+        kms.generate_data_key("primary")
+    # persistence across reopen
+    kms2 = LocalKms(str(tmp_path / "kms.json"))
+    assert kms2.get_key_id("primary") == kid
+
+
+# -- integration: S3 gateway with IAM + STS + KMS --------------------------
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer().start()
+    vols = [VolumeServer([str(tmp_path / f"v{i}")], master.url,
+                         pulse_seconds=0.3).start() for i in range(2)]
+    time.sleep(0.5)
+    filer = FilerServer(master.url).start()
+
+    store = IdentityStore(str(tmp_path / "identities.json"))
+    store.put(Identity("root", [Credential("ADMINKEY", "adminsecret")],
+                       actions=["Admin"]))
+    store.put(Identity("reader",
+                       [Credential("READKEY", "readsecret")],
+                       actions=["Read:shared", "List:shared"]))
+    roles = RoleStore(str(tmp_path / "roles.json"))
+    roles.put("writer-role", ["Write:shared", "List:shared",
+                              "Read:shared"], trust=["root"])
+    sts = StsService(STS_KEY, roles)
+    kms = LocalKms(str(tmp_path / "kms.json"))
+    gw = S3ApiServer(filer.filer, iam=store, sts=sts, kms=kms).start()
+    iam_srv = IamApiServer(store, sts).start()
+    yield gw, iam_srv, store
+    iam_srv.stop()
+    gw.stop()
+    filer.stop()
+    for vs in vols:
+        vs.stop()
+    master.stop()
+
+
+def _s3(gw, method, path, body=b"", access="ADMINKEY",
+        secret="adminsecret", headers=None, query=None, token=None):
+    headers = dict(headers or {})
+    if token:
+        headers["x-amz-security-token"] = token
+    q = dict(query or {})
+    signed = sign_request(method, gw.url, path, q, headers, body,
+                          access, secret)
+    qs = ("?" + urllib.parse.urlencode(q)) if q else ""
+    req = urllib.request.Request(
+        f"http://{gw.url}{urllib.parse.quote(path)}{qs}",
+        data=body or None, method=method, headers=signed)
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _iam(iam_srv, form, access="ADMINKEY", secret="adminsecret",
+         token=None):
+    body = urllib.parse.urlencode(form).encode()
+    headers = {"content-type": "application/x-www-form-urlencoded"}
+    if token:
+        headers["x-amz-security-token"] = token
+    signed = sign_request("POST", iam_srv.url, "/", {}, headers, body,
+                          access, secret, region="us-east-1")
+    req = urllib.request.Request(f"http://{iam_srv.url}/", data=body,
+                                 method="POST", headers=signed)
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_identity_actions_enforced(cluster):
+    gw, _, _ = cluster
+    # admin creates buckets and writes
+    assert _s3(gw, "PUT", "/shared")[0] == 200
+    assert _s3(gw, "PUT", "/private")[0] == 200
+    assert _s3(gw, "PUT", "/shared/a.txt", b"hello")[0] == 200
+    assert _s3(gw, "PUT", "/private/p.txt", b"secret")[0] == 200
+    # reader: can read shared, cannot write, cannot touch private
+    st, body, _ = _s3(gw, "GET", "/shared/a.txt", access="READKEY",
+                      secret="readsecret")
+    assert (st, body) == (200, b"hello")
+    assert _s3(gw, "PUT", "/shared/w.txt", b"x", access="READKEY",
+               secret="readsecret")[0] == 403
+    assert _s3(gw, "GET", "/private/p.txt", access="READKEY",
+               secret="readsecret")[0] == 403
+    # bucket listing is filtered to visible buckets
+    st, body, _ = _s3(gw, "GET", "/", access="READKEY",
+                      secret="readsecret")
+    assert st == 200
+    names = [el.text for el in ET.fromstring(body).iter()
+             if el.tag.endswith("Name")]
+    assert names == ["shared"]
+    # unknown key is rejected
+    assert _s3(gw, "GET", "/shared/a.txt", access="NOKEY",
+               secret="nosecret")[0] == 403
+
+
+def test_iamapi_user_lifecycle(cluster):
+    gw, iam_srv, store = cluster
+    st, body = _iam(iam_srv, {"Action": "CreateUser",
+                              "UserName": "carol"})
+    assert st == 200 and b"<UserName>carol</UserName>" in body
+    st, body = _iam(iam_srv, {"Action": "CreateAccessKey",
+                              "UserName": "carol"})
+    assert st == 200
+    root = ET.fromstring(body)
+    access = next(e.text for e in root.iter()
+                  if e.tag.endswith("AccessKeyId"))
+    secret = next(e.text for e in root.iter()
+                  if e.tag.endswith("SecretAccessKey"))
+    # fresh user has no grants
+    assert _s3(gw, "PUT", "/shared", access=access,
+               secret=secret)[0] == 403
+    # attach an inline policy -> Write:carol-data
+    doc = json.dumps({"Statement": [
+        {"Effect": "Allow",
+         "Action": ["s3:PutObject", "s3:GetObject", "s3:ListBucket",
+                    "s3:CreateBucket"],
+         "Resource": "arn:aws:s3:::carol-data/*"}]})
+    st, _ = _iam(iam_srv, {"Action": "PutUserPolicy",
+                           "UserName": "carol",
+                           "PolicyName": "data",
+                           "PolicyDocument": doc})
+    assert st == 200
+    # bucket creation stays admin-plane (CreateBucket -> Admin), so
+    # the admin provisions the bucket; carol writes into it
+    assert _s3(gw, "PUT", "/carol-data")[0] == 200
+    assert _s3(gw, "PUT", "/carol-data/f.txt", b"mine",
+               access=access, secret=secret)[0] == 200
+    assert _s3(gw, "GET", "/carol-data/f.txt", access=access,
+               secret=secret)[1] == b"mine"
+    # still nothing outside the grant
+    assert _s3(gw, "PUT", "/shared/f.txt", b"x", access=access,
+               secret=secret)[0] == 403
+    # policy listing + teardown
+    st, body = _iam(iam_srv, {"Action": "ListUserPolicies",
+                              "UserName": "carol"})
+    assert b"<member>data</member>" in body
+    st, _ = _iam(iam_srv, {"Action": "DeleteAccessKey",
+                           "UserName": "carol",
+                           "AccessKeyId": access})
+    assert st == 200
+    assert _s3(gw, "GET", "/carol-data/f.txt", access=access,
+               secret=secret)[0] == 403
+    # non-admin cannot manage users
+    st, _ = _iam(iam_srv, {"Action": "CreateUser",
+                           "UserName": "mallory"},
+                 access="READKEY", secret="readsecret")
+    assert st == 403
+
+
+def test_sts_assume_role_end_to_end(cluster):
+    gw, iam_srv, _ = cluster
+    assert _s3(gw, "PUT", "/shared")[0] == 200
+    st, body = _iam(iam_srv, {"Action": "AssumeRole",
+                              "RoleArn":
+                              "arn:aws:iam:::role/writer-role",
+                              "RoleSessionName": "ci",
+                              "DurationSeconds": "900"})
+    assert st == 200
+    root = ET.fromstring(body)
+    creds = {e.tag.rsplit("}", 1)[-1]: e.text for e in root.iter()}
+    access, secret = creds["AccessKeyId"], creds["SecretAccessKey"]
+    token = creds["SessionToken"]
+    # temp credentials work within the role's grants
+    assert _s3(gw, "PUT", "/shared/from-sts.txt", b"via sts",
+               access=access, secret=secret, token=token)[0] == 200
+    st, body, _ = _s3(gw, "GET", "/shared/from-sts.txt",
+                      access=access, secret=secret, token=token)
+    assert (st, body) == (200, b"via sts")
+    # ...and not outside them
+    assert _s3(gw, "PUT", "/other", access=access, secret=secret,
+               token=token)[0] == 403
+    # without the session token the signature cannot resolve
+    assert _s3(gw, "GET", "/shared/from-sts.txt", access=access,
+               secret=secret)[0] == 403
+    # reader is not trusted by the role
+    st, _ = _iam(iam_srv, {"Action": "AssumeRole",
+                           "RoleName": "writer-role"},
+                 access="READKEY", secret="readsecret")
+    assert st == 403
+
+
+def test_anonymous_identity_cannot_override_policy_deny(cluster):
+    """Code-review regression: an 'anonymous' identity widens access
+    for unsigned requests, but an explicit bucket-policy Deny must
+    still win."""
+    gw, _, store = cluster
+    store.put(Identity("anonymous", actions=["Read:pub", "List:pub"]))
+    assert _s3(gw, "PUT", "/pub")[0] == 200
+    assert _s3(gw, "PUT", "/pub/open.txt", b"open")[0] == 200
+    assert _s3(gw, "PUT", "/pub/blocked.txt", b"no")[0] == 200
+    # unsigned read rides the anonymous identity
+    st, body, _ = _unsigned(gw, "GET", "/pub/open.txt")
+    assert (st, body) == (200, b"open")
+    # explicit Deny beats the anonymous grant
+    policy = json.dumps({"Statement": [
+        {"Effect": "Deny", "Principal": "*",
+         "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::pub/blocked.txt"}]})
+    st, _, _ = _s3(gw, "PUT", "/pub", policy.encode(),
+                   query={"policy": ""})
+    assert st in (200, 204)
+    assert _unsigned(gw, "GET", "/pub/blocked.txt")[0] == 403
+    assert _unsigned(gw, "GET", "/pub/open.txt")[0] == 200
+    store.delete("anonymous")
+
+
+def _unsigned(gw, method, path):
+    req = urllib.request.Request(
+        f"http://{gw.url}{urllib.parse.quote(path)}", method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def test_kms_bad_key_id_is_client_error(cluster):
+    gw, _, _ = cluster
+    assert _s3(gw, "PUT", "/enc2")[0] == 200
+    st, _, _ = _s3(gw, "PUT", "/enc2/x.bin", b"data",
+                   headers={"x-amz-server-side-encryption": "aws:kms",
+                            "x-amz-server-side-encryption-aws-kms-"
+                            "key-id": "no-such-key"})
+    assert st == 400  # not a 500
+
+
+def test_iamapi_input_validation(cluster):
+    _, iam_srv, _ = cluster
+    _iam(iam_srv, {"Action": "CreateUser", "UserName": "u1"})
+    _iam(iam_srv, {"Action": "CreateUser", "UserName": "u2"})
+    # rename onto an existing user must not clobber it
+    st, _ = _iam(iam_srv, {"Action": "UpdateUser", "UserName": "u1",
+                           "NewUserName": "u2"})
+    assert st == 409
+    # junk DurationSeconds is a 400, not a 500
+    st, _ = _iam(iam_srv, {"Action": "AssumeRole",
+                           "RoleName": "writer-role",
+                           "DurationSeconds": "abc"})
+    assert st == 400
+
+
+def test_sse_kms_roundtrip(cluster):
+    gw, _, _ = cluster
+    assert _s3(gw, "PUT", "/enc")[0] == 200
+    st, _, h = _s3(gw, "PUT", "/enc/secret.bin", b"kms payload",
+                   headers={"x-amz-server-side-encryption":
+                            "aws:kms"})
+    assert st == 200
+    assert h.get("x-amz-server-side-encryption") == "aws:kms"
+    key_id = h.get("x-amz-server-side-encryption-aws-kms-key-id")
+    assert key_id
+    # transparent decrypt on GET, with SSE headers echoed
+    st, body, h = _s3(gw, "GET", "/enc/secret.bin")
+    assert (st, body) == (200, b"kms payload")
+    assert h.get("x-amz-server-side-encryption") == "aws:kms"
+    # at rest the filer holds ciphertext, not the plaintext
+    raw = gw.filer.read_file("/buckets/enc/secret.bin")
+    assert raw != b"kms payload"
+    # SSE-S3 mode (AES256) rides the default key
+    st, _, h = _s3(gw, "PUT", "/enc/s3.bin", b"sse-s3",
+                   headers={"x-amz-server-side-encryption": "AES256"})
+    assert st == 200 and h.get("x-amz-server-side-encryption") == \
+        "AES256"
+    assert _s3(gw, "GET", "/enc/s3.bin")[1] == b"sse-s3"
+    # copy re-encrypts under a named key
+    st, _, _ = _s3(gw, "PUT", "/enc/copy.bin", b"",
+                   headers={"x-amz-copy-source": "/enc/secret.bin",
+                            "x-amz-server-side-encryption":
+                            "aws:kms"})
+    assert st == 200
+    assert _s3(gw, "GET", "/enc/copy.bin")[1] == b"kms payload"
