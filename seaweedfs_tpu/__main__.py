@@ -138,6 +138,13 @@ def main(argv: list[str] | None = None) -> int:
     mqb.add_argument("-port", type=int, default=17777)
     mqb.add_argument("-filer", default="127.0.0.1:8888")
 
+    kgw = sub.add_parser(
+        "mq.kafka", help="Kafka wire-protocol gateway over a running "
+        "MQ broker (mq/kafka/gateway)")
+    kgw.add_argument("-ip", default="127.0.0.1")
+    kgw.add_argument("-port", type=int, default=9092)
+    kgw.add_argument("-broker", default="127.0.0.1:17777")
+
     fsync = sub.add_parser(
         "filer.sync", help="continuously replicate one filer's "
         "namespace+content to another, resuming from a persisted "
@@ -301,12 +308,13 @@ def main(argv: list[str] | None = None) -> int:
             else None
         iam_store = sts = kms = None
         if args.iam_config:
-            from .iam import IdentityStore, StsService
-            from .iam.sts import RoleStore
+            from .iam import IdentityStore
             iam_store = IdentityStore(args.iam_config)
-            if args.sts_key:
-                sts = StsService(args.sts_key,
-                                 RoleStore(args.roles_file or None))
+        if args.sts_key:
+            from .iam import StsService
+            from .iam.sts import RoleStore
+            sts = StsService(args.sts_key,
+                             RoleStore(args.roles_file or None))
         if args.kms_file:
             from .iam.kms import LocalKms
             kms = LocalKms(args.kms_file)
@@ -373,6 +381,12 @@ def main(argv: list[str] | None = None) -> int:
             _wait()
         finally:
             br.stop()
+    elif args.cmd == "mq.kafka":
+        from .mq.kafka_gateway import KafkaGateway
+        gw = KafkaGateway(args.broker, args.ip, args.port).start()
+        print(f"kafka gateway on {args.ip}:{gw.port} over broker "
+              f"{args.broker}")
+        _wait()
     elif args.cmd == "filer.sync":
         from .filer.filer_sync import FilerSync
         syncer = FilerSync(args.sync_from, args.sync_to,

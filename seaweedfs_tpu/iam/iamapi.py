@@ -274,9 +274,10 @@ class IamApiServer:
     # -- inline policies ---------------------------------------------------
 
     def _recompute_actions(self, ident: Identity) -> None:
-        """Union of all inline policies
-        (computeAggregatedActionsForUser)."""
-        actions: set[str] = set()
+        """static provisioned actions ∪ all inline policies
+        (computeAggregatedActionsForUser) — never strips the static
+        set, so attaching a policy to an admin can't drop Admin."""
+        actions: set[str] = set(ident.static_actions)
         for doc in ident.policies.values():
             actions.update(policy_to_actions(doc))
         ident.actions = sorted(actions)

@@ -119,8 +119,12 @@ class Identity:
         self.principal_arn = principal_arn or \
             f"arn:aws:iam:::user/{name}"
         # inline IAM policy documents by name (iamapi PutUserPolicy);
-        # identity.actions holds their aggregated coarse translation
+        # identity.actions holds static_actions ∪ their translation
         self.policies: dict[str, str] = {}
+        # actions provisioned directly (identities JSON / operator) —
+        # policy recomputation must never strip these, or attaching a
+        # policy to the admin identity would drop Admin (lockout)
+        self.static_actions: list[str] = list(actions or [])
 
     @property
     def is_admin(self) -> bool:
@@ -162,6 +166,7 @@ class Identity:
         return {"name": self.name,
                 "credentials": [c.to_json() for c in self.credentials],
                 "actions": list(self.actions),
+                "staticActions": list(self.static_actions),
                 "account": self.account.to_json(),
                 "disabled": self.disabled,
                 "principalArn": self.principal_arn,
@@ -178,6 +183,10 @@ class Identity:
                     d.get("disabled", False),
                     d.get("principalArn", ""))
         ident.policies = dict(d.get("policies", {}))
+        if "staticActions" in d:
+            ident.static_actions = list(d["staticActions"])
+        # else: a hand-written identities JSON — its actions ARE the
+        # static provisioned set (the cls(...) call captured them)
         return ident
 
 
@@ -219,11 +228,19 @@ class IdentityStore:
     # -- config IO ---------------------------------------------------------
 
     def load_json(self, doc: dict) -> None:
+        """Build fresh maps, then swap the references atomically —
+        lock-free readers (every request thread) must never observe
+        the cleared-but-not-rebuilt intermediate state."""
+        identities: dict[str, Identity] = {}
+        by_key: dict[str, Identity] = {}
+        for d in doc.get("identities", []):
+            ident = Identity.from_json(d)
+            identities[ident.name] = ident
+            for c in ident.credentials:
+                by_key[c.access_key] = ident
         with self._lock:
-            self._identities.clear()
-            self._by_access_key.clear()
-            for d in doc.get("identities", []):
-                self._index(Identity.from_json(d))
+            self._identities = identities
+            self._by_access_key = by_key
 
     def to_json(self) -> dict:
         with self._lock:

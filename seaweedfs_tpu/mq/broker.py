@@ -77,7 +77,9 @@ class BrokerServer:
         r = self.http.route
         r("POST", "/topics/configure", self._configure)
         r("GET", "/topics/lookup", self._lookup)
+        r("GET", "/topics/list", self._list_topics)
         r("POST", "/topics/publish", self._publish)
+        r("POST", "/topics/publish_batch", self._publish_batch)
         r("GET", "/topics/subscribe", self._subscribe)
         r("POST", "/topics/flush", self._flush)
         r("POST", "/offsets/commit", self._commit_offset)
@@ -178,6 +180,27 @@ class BrokerServer:
                 self._topics[t] = parts
         return 200, {"partitions": [p.to_json() for p in parts]}
 
+    def _list_topics(self, req: Request):
+        """Configured topics of a namespace, from the filer tree
+        (broker.proto ListTopics): each topic dir under
+        /topics/<ns>/ holding a topic.conf."""
+        ns = req.query.get("namespace", "")
+        try:
+            _check_name("namespace", ns)
+        except NameError_ as e:
+            return 400, {"error": str(e)}
+        st, body, _ = http_bytes(
+            "GET", f"{self.filer}/topics/{urllib.parse.quote(ns)}/"
+                   f"?limit=10000")
+        if st == 404:
+            return 200, {"topics": []}
+        if st != 200:
+            return 503, {"error": f"filer list: {st}"}
+        names = [e["fullPath"].rsplit("/", 1)[-1] for e in
+                 json.loads(body).get("entries", [])
+                 if e.get("isDirectory")]
+        return 200, {"topics": sorted(names)}
+
     def _lookup(self, req: Request):
         try:
             t = self._topic_from(req.query["namespace"],
@@ -214,13 +237,48 @@ class BrokerServer:
             return 503, {"error": str(e)}
         if parts is None:
             return 404, {"error": f"topic {t} not configured"}
-        key = base64.b64decode(b.get("key", "")) if b.get("key") \
-            else b""
-        p = partition_for_key(key, parts)
+        if "partition" in b and b["partition"] is not None:
+            # explicit partition index (the Kafka gateway's client
+            # already partitioned; re-hashing would misroute)
+            idx = int(b["partition"])
+            if not 0 <= idx < len(parts):
+                return 400, {"error": f"partition index {idx} out of "
+                                      f"range 0..{len(parts) - 1}"}
+            p = parts[idx]
+        else:
+            key = base64.b64decode(b.get("key", "")) if b.get("key") \
+                else b""
+            p = partition_for_key(key, parts)
         ts = self._log_for(t, p).append(
             b.get("key", ""), b.get("value", ""),
             int(b.get("tsNs", 0)))
         return 200, {"partition": p.to_json(), "tsNs": ts}
+
+    def _publish_batch(self, req: Request):
+        """Atomic multi-message publish to one explicit partition —
+        the per-partition batch semantics Kafka producers assume
+        (broker.proto PublishMessage streams get this from the
+        single-writer partition loop)."""
+        b = req.json()
+        try:
+            t = self._topic_from(b["namespace"], b["topic"])
+            parts = self._load_layout(t)
+        except NameError_ as e:
+            return 400, {"error": str(e)}
+        except RuntimeError as e:
+            return 503, {"error": str(e)}
+        if parts is None:
+            return 404, {"error": f"topic {t} not configured"}
+        idx = int(b["partition"])
+        if not 0 <= idx < len(parts):
+            return 400, {"error": f"partition index {idx} out of "
+                                  f"range 0..{len(parts) - 1}"}
+        records = [(m.get("key", ""), m.get("value", ""),
+                    int(m.get("tsNs", 0)))
+                   for m in b.get("messages", [])]
+        stamps = self._log_for(t, parts[idx]).append_many(records)
+        return 200, {"partition": parts[idx].to_json(),
+                     "tsNs": stamps}
 
     def _subscribe(self, req: Request):
         try:

@@ -43,12 +43,16 @@ class MQClient:
         return r["assignments"]
 
     def publish(self, namespace: str, topic: str, key: bytes,
-                value: bytes) -> int:
-        """Returns the message offset (tsNs)."""
-        r = http_json("POST", f"{self.broker}/topics/publish", {
-            "namespace": namespace, "topic": topic,
-            "key": base64.b64encode(key).decode(),
-            "value": base64.b64encode(value).decode()})
+                value: bytes, partition: "int | None" = None) -> int:
+        """Returns the message offset (tsNs).  `partition` pins an
+        explicit partition index instead of key-hash routing (Kafka
+        gateway semantics)."""
+        body = {"namespace": namespace, "topic": topic,
+                "key": base64.b64encode(key).decode(),
+                "value": base64.b64encode(value).decode()}
+        if partition is not None:
+            body["partition"] = partition
+        r = http_json("POST", f"{self.broker}/topics/publish", body)
         if "error" in r:
             raise RuntimeError(f"publish: {r['error']}")
         return int(r["tsNs"])
@@ -66,6 +70,48 @@ class MQClient:
                         base64.b64decode(m.get("value", "")),
                         int(m["tsNs"]))
                 for m in r["messages"]]
+
+    def publish_batch(self, namespace: str, topic: str,
+                      partition: int,
+                      messages: "list[tuple[bytes, bytes]]"
+                      ) -> list[int]:
+        """Atomic multi-publish to one partition; returns the
+        assigned offsets in order."""
+        r = http_json("POST", f"{self.broker}/topics/publish_batch", {
+            "namespace": namespace, "topic": topic,
+            "partition": partition,
+            "messages": [{"key": base64.b64encode(k).decode(),
+                          "value": base64.b64encode(v).decode()}
+                         for k, v in messages]})
+        if "error" in r:
+            raise RuntimeError(f"publish_batch: {r['error']}")
+        return [int(t) for t in r["tsNs"]]
+
+    def subscribe_full(self, namespace: str, topic: str,
+                       partition: int, since_ns: int = 0,
+                       limit: int = 1000
+                       ) -> "tuple[list[Message], int]":
+        """Like subscribe, but also returns the partition's
+        high-water-mark tsNs (the Kafka gateway's fetch response
+        needs it)."""
+        r = http_json("GET", f"{self.broker}/topics/subscribe?" +
+                      _q(namespace=namespace, topic=topic,
+                         partition=partition, sinceNs=since_ns,
+                         limit=limit))
+        if "error" in r:
+            raise RuntimeError(f"subscribe: {r['error']}")
+        msgs = [Message(base64.b64decode(m.get("key", "")),
+                        base64.b64decode(m.get("value", "")),
+                        int(m["tsNs"]))
+                for m in r["messages"]]
+        return msgs, int(r.get("highWaterMarkNs", 0))
+
+    def list_topics(self, namespace: str) -> "list[str]":
+        r = http_json("GET", f"{self.broker}/topics/list?" +
+                      _q(namespace=namespace))
+        if "error" in r:
+            raise RuntimeError(f"list topics: {r['error']}")
+        return r["topics"]
 
     def flush(self, namespace: str, topic: str) -> None:
         http_json("POST", f"{self.broker}/topics/flush",

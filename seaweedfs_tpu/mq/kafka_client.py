@@ -1,0 +1,249 @@
+"""Minimal Kafka protocol client (no kafka library exists in the
+image), used by tests and tooling to drive the gateway the way the
+reference's gateway tests use a real client: every byte crosses a TCP
+socket in genuine Kafka framing, including CRC-checked v2 record
+batches on produce.
+
+Supports exactly the gateway's advertised API versions; consumers use
+manual partition assignment (see kafka_gateway module docstring)."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from .kafka_wire import (Reader, crc32c, decode_record_batches,
+                         enc_array, enc_bytes, enc_i8, enc_i16,
+                         enc_i32, enc_i64, enc_string, enc_u32,
+                         enc_varint)
+
+
+class KafkaError(RuntimeError):
+    def __init__(self, code: int, where: str):
+        super().__init__(f"kafka error {code} in {where}")
+        self.code = code
+
+
+def encode_produce_batch(records: "list[tuple[bytes | None, bytes]]",
+                         base_ts_ms: int = 0) -> bytes:
+    """A single v2 RecordBatch holding `records` — what a real
+    producer sends (deltas are small: sequential indexes)."""
+    recs = b""
+    for i, (key, value) in enumerate(records):
+        body = (enc_i8(0) + enc_varint(0) + enc_varint(i) +
+                (enc_varint(-1) if key is None else
+                 enc_varint(len(key)) + key) +
+                enc_varint(len(value)) + value +
+                enc_varint(0))
+        recs += enc_varint(len(body)) + body
+    after_crc = (enc_i16(0) + enc_i32(len(records) - 1) +
+                 enc_i64(base_ts_ms) + enc_i64(base_ts_ms) +
+                 enc_i64(-1) + enc_i16(-1) + enc_i32(-1) +
+                 enc_i32(len(records)) + recs)
+    body = enc_i32(0) + enc_i8(2) + enc_u32(crc32c(after_crc)) + \
+        after_crc
+    return enc_i64(0) + enc_i32(len(body)) + body
+
+
+class KafkaClient:
+    def __init__(self, host: str, port: int,
+                 client_id: str = "seaweedfs-tpu-test"):
+        self.sock = socket.create_connection((host, port), timeout=30)
+        self.client_id = client_id
+        self._corr = 0
+        self._lock = threading.Lock()
+
+    def close(self):
+        self.sock.close()
+
+    def _rpc(self, api_key: int, api_version: int,
+             body: bytes) -> Reader:
+        with self._lock:
+            self._corr += 1
+            corr = self._corr
+            frame = (enc_i16(api_key) + enc_i16(api_version) +
+                     enc_i32(corr) + enc_string(self.client_id) +
+                     body)
+            self.sock.sendall(struct.pack(">i", len(frame)) + frame)
+            buf = b""
+            while len(buf) < 4:
+                buf += self.sock.recv(65536)
+            size = struct.unpack(">i", buf[:4])[0]
+            while len(buf) < 4 + size:
+                buf += self.sock.recv(65536)
+        r = Reader(buf[4:4 + size])
+        got = r.i32()
+        if got != corr:
+            raise KafkaError(-1, f"correlation {got} != {corr}")
+        return r
+
+    # -- APIs --------------------------------------------------------------
+
+    def api_versions(self) -> dict[int, tuple[int, int]]:
+        r = self._rpc(18, 0, b"")
+        code = r.i16()
+        if code:
+            raise KafkaError(code, "ApiVersions")
+        return {key: (lo, hi) for key, lo, hi in
+                ((r.i16(), r.i16(), r.i16())
+                 for _ in range(r.i32()))}
+
+    def metadata(self, topics: "list[str] | None" = None) -> dict:
+        body = enc_i32(-1) if topics is None else \
+            enc_array([enc_string(t) for t in topics])
+        r = self._rpc(3, 1, body)
+        brokers = [(r.i32(), r.string(), r.i32(), r.string())
+                   for _ in range(r.i32())]
+        r.i32()                          # controller id
+        out = {"brokers": brokers, "topics": {}}
+        for _ in range(r.i32()):
+            code = r.i16()
+            name = r.string()
+            r.i8()                       # is_internal
+            parts = []
+            for _ in range(r.i32()):
+                pcode = r.i16()
+                pid = r.i32()
+                r.i32()                  # leader
+                for _ in range(r.i32()):
+                    r.i32()              # replicas
+                for _ in range(r.i32()):
+                    r.i32()              # isr
+                parts.append((pid, pcode))
+            out["topics"][name] = {"error": code, "partitions": parts}
+        return out
+
+    def create_topic(self, name: str, partitions: int = 4) -> int:
+        body = enc_array([
+            enc_string(name) + enc_i32(partitions) + enc_i16(1) +
+            enc_i32(0) + enc_i32(0)]) + enc_i32(10000)
+        r = self._rpc(19, 0, body)
+        r.i32()
+        r.string()
+        return r.i16()
+
+    def produce(self, topic: str, partition: int,
+                records: "list[tuple[bytes | None, bytes]]") -> int:
+        """Returns the base offset; raises on per-partition error."""
+        batch = encode_produce_batch(records)
+        body = (enc_string(None) + enc_i16(-1) + enc_i32(10000) +
+                enc_array([enc_string(topic) + enc_array([
+                    enc_i32(partition) + enc_bytes(batch)])]))
+        r = self._rpc(0, 3, body)
+        base = -1
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()
+                code = r.i16()
+                base = r.i64()
+                r.i64()
+                if code:
+                    raise KafkaError(code, "Produce")
+        return base
+
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_bytes: int = 1 << 20
+              ) -> "tuple[list[dict], int]":
+        """Returns ([{key, value, ts_ms, offset}...], high_watermark).
+        Record offsets are the batch base offsets (one record per
+        batch from this gateway)."""
+        body = (enc_i32(-1) + enc_i32(100) + enc_i32(1) +
+                enc_i32(max_bytes) + enc_i8(0) +
+                enc_array([enc_string(topic) + enc_array([
+                    enc_i32(partition) + enc_i64(offset) +
+                    enc_i32(max_bytes)])]))
+        r = self._rpc(1, 4, body)
+        r.i32()                          # throttle
+        msgs, hwm = [], 0
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()
+                code = r.i16()
+                hwm = r.i64()
+                r.i64()                  # last stable offset
+                for _ in range(r.i32()):
+                    r.i64()
+                    r.i64()              # aborted txns
+                record_set = r.bytes_() or b""
+                if code:
+                    raise KafkaError(code, "Fetch")
+                msgs.extend(self._parse_fetch_batches(record_set))
+        return msgs, hwm
+
+    @staticmethod
+    def _parse_fetch_batches(data: bytes) -> list[dict]:
+        out = []
+        rr = Reader(data)
+        while rr.remaining() > 0:
+            base_offset = rr.i64()
+            batch_len = rr.i32()
+            batch = rr._take(batch_len)
+            for rec in decode_record_batches(
+                    enc_i64(base_offset) + enc_i32(batch_len) +
+                    batch):
+                rec["offset"] = base_offset
+                out.append(rec)
+        return out
+
+    def list_offsets(self, topic: str, partition: int,
+                     ts: int = -1) -> int:
+        body = (enc_i32(-1) +
+                enc_array([enc_string(topic) + enc_array([
+                    enc_i32(partition) + enc_i64(ts)])]))
+        r = self._rpc(2, 1, body)
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()
+                code = r.i16()
+                r.i64()
+                off = r.i64()
+                if code:
+                    raise KafkaError(code, "ListOffsets")
+                return off
+        raise KafkaError(-1, "ListOffsets: empty response")
+
+    def find_coordinator(self, group: str) -> "tuple[str, int]":
+        r = self._rpc(10, 0, enc_string(group))
+        code = r.i16()
+        if code:
+            raise KafkaError(code, "FindCoordinator")
+        r.i32()
+        return r.string(), r.i32()
+
+    def offset_commit(self, group: str, topic: str, partition: int,
+                      offset: int) -> None:
+        body = (enc_string(group) + enc_i32(-1) + enc_string("") +
+                enc_i64(-1) +
+                enc_array([enc_string(topic) + enc_array([
+                    enc_i32(partition) + enc_i64(offset) +
+                    enc_string(None)])]))
+        r = self._rpc(8, 2, body)
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()
+                code = r.i16()
+                if code:
+                    raise KafkaError(code, "OffsetCommit")
+
+    def offset_fetch(self, group: str, topic: str,
+                     partition: int) -> int:
+        body = (enc_string(group) +
+                enc_array([enc_string(topic) + enc_array([
+                    enc_i32(partition)])]))
+        r = self._rpc(9, 1, body)
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()
+                off = r.i64()
+                r.string()
+                code = r.i16()
+                if code:
+                    raise KafkaError(code, "OffsetFetch")
+                return off
+        raise KafkaError(-1, "OffsetFetch: empty response")

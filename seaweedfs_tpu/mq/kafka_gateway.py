@@ -1,0 +1,405 @@
+"""Kafka wire-protocol gateway over the MQ broker (reference:
+weed/mq/kafka/gateway/server.go + protocol/handler.go).
+
+Speaks the public Kafka binary protocol on a TCP port and maps it
+onto the broker's topics/partitions:
+
+    ApiVersions(18) Metadata(3) CreateTopics(19) Produce(0) Fetch(1)
+    ListOffsets(2) FindCoordinator(10) OffsetCommit(8) OffsetFetch(9)
+
+Kafka topics live in the fixed namespace "kafka" (the reference
+gateway does the same); Kafka partition index i is the i-th ring
+partition of the topic's layout; Kafka offsets ARE our tsNs message
+offsets (monotonic int64 — exactly what the protocol requires; they
+are sparse, which clients don't mind: the next fetch offset is
+last_offset+1 and fetches return everything >= it).
+
+Divergence, documented: group REBALANCE (JoinGroup/SyncGroup/
+Heartbeat) is not implemented — consumers must use manual partition
+assignment (`assign()`-style); committed offsets work through
+FindCoordinator + OffsetCommit/OffsetFetch.  The reference implements
+the full rebalance dance (protocol/joingroup.go).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from .client import MQClient
+from .kafka_wire import (BatchError, Reader, decode_record_batches,
+                         enc_array, enc_bytes, enc_i8, enc_i16,
+                         enc_i32, enc_i64, enc_string,
+                         encode_single_record_batch)
+
+NAMESPACE = "kafka"
+
+# error codes (protocol/errors.go)
+NONE = 0
+UNKNOWN_SERVER_ERROR = -1
+OFFSET_OUT_OF_RANGE = 1
+CORRUPT_MESSAGE = 2
+UNKNOWN_TOPIC_OR_PARTITION = 3
+UNSUPPORTED_VERSION = 35
+TOPIC_ALREADY_EXISTS = 36
+INVALID_REQUEST = 42
+
+API_VERSIONS = {
+    0: (0, 3),    # Produce (v3: record batches v2)
+    1: (4, 4),    # Fetch
+    2: (1, 1),    # ListOffsets
+    3: (1, 1),    # Metadata
+    8: (2, 2),    # OffsetCommit
+    9: (1, 1),    # OffsetFetch
+    10: (0, 0),   # FindCoordinator
+    18: (0, 0),   # ApiVersions
+    19: (0, 0),   # CreateTopics
+}
+
+
+class KafkaGateway:
+    def __init__(self, broker: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.mq = MQClient(broker)
+        self.host = host
+        self.port = port
+        self._sock = None
+        self._stopping = False
+        # topic layouts cache: name -> partition count
+        self._layouts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def start(self) -> "KafkaGateway":
+        self._sock = socket.create_server((self.host, self.port))
+        self.port = self._sock.getsockname()[1]
+        threading.Thread(target=self._accept_loop,
+                         name="kafka-accept", daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    # -- framing -----------------------------------------------------------
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(120)
+            buf = b""
+            while True:
+                while len(buf) < 4:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                size = struct.unpack(">i", buf[:4])[0]
+                if not 0 < size <= 64 * 1024 * 1024:
+                    return
+                while len(buf) < 4 + size:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                frame, buf = buf[4:4 + size], buf[4 + size:]
+                resp = self._handle_frame(frame)
+                if resp is not None:
+                    conn.sendall(struct.pack(">i", len(resp)) + resp)
+        except (OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_frame(self, frame: bytes) -> "bytes | None":
+        r = Reader(frame)
+        api_key = r.i16()
+        api_version = r.i16()
+        correlation_id = r.i32()
+        r.string()                       # client_id
+        header = enc_i32(correlation_id)
+        lo_hi = API_VERSIONS.get(api_key)
+        if lo_hi is None or not lo_hi[0] <= api_version <= lo_hi[1]:
+            if api_key == 18:
+                # ApiVersions version negotiation: answer v0-shaped
+                # with UNSUPPORTED_VERSION so the client downgrades
+                return header + enc_i16(UNSUPPORTED_VERSION) + \
+                    enc_i32(0)
+            return header + enc_i16(UNSUPPORTED_VERSION)
+        fn = {0: self._produce, 1: self._fetch, 2: self._list_offsets,
+              3: self._metadata, 8: self._offset_commit,
+              9: self._offset_fetch, 10: self._find_coordinator,
+              18: self._api_versions, 19: self._create_topics}[api_key]
+        body = fn(r)
+        return None if body is None else header + body
+
+    # -- topic helpers -----------------------------------------------------
+
+    def _partition_count(self, topic: str) -> "int | None":
+        with self._lock:
+            n = self._layouts.get(topic)
+        if n is not None:
+            return n
+        try:
+            parts = self.mq.lookup(NAMESPACE, topic)
+        except (RuntimeError, OSError, LookupError):
+            return None
+        with self._lock:
+            self._layouts[topic] = len(parts)
+        return len(parts)
+
+    def _all_topics(self) -> list[str]:
+        try:
+            return self.mq.list_topics(NAMESPACE)
+        except (RuntimeError, OSError, AttributeError):
+            with self._lock:
+                return sorted(self._layouts)
+
+    # -- API handlers ------------------------------------------------------
+
+    def _api_versions(self, r: Reader) -> bytes:
+        entries = [enc_i16(k) + enc_i16(lo) + enc_i16(hi)
+                   for k, (lo, hi) in sorted(API_VERSIONS.items())]
+        return enc_i16(NONE) + enc_array(entries)
+
+    def _metadata(self, r: Reader) -> bytes:
+        n = r.i32()
+        # v1 semantics: null array (-1) = all topics, empty array =
+        # NO topics (broker-info-only refresh) — v0's empty-means-all
+        # does not apply here
+        wanted = None if n < 0 else [r.string() for _ in range(n)]
+        broker = (enc_i32(0) + enc_string(self.host) +
+                  enc_i32(self.port) + enc_string(None))
+        names = wanted if wanted is not None else self._all_topics()
+        topics = []
+        for name in names:
+            count = self._partition_count(name)
+            if count is None:
+                topics.append(enc_i16(UNKNOWN_TOPIC_OR_PARTITION) +
+                              enc_string(name) + enc_i8(0) +
+                              enc_array([]))
+                continue
+            parts = [enc_i16(NONE) + enc_i32(i) + enc_i32(0) +
+                     enc_array([enc_i32(0)]) +
+                     enc_array([enc_i32(0)])
+                     for i in range(count)]
+            topics.append(enc_i16(NONE) + enc_string(name) +
+                          enc_i8(0) + enc_array(parts))
+        return (enc_array([broker]) + enc_i32(0) +   # controller_id
+                enc_array(topics))
+
+    def _create_topics(self, r: Reader) -> bytes:
+        n = r.i32()
+        results = []
+        for _ in range(n):
+            name = r.string()
+            num_partitions = r.i32()
+            r.i16()                      # replication_factor
+            for _ in range(r.i32()):     # manual assignments
+                r.i32()
+                cnt = r.i32()
+                for _ in range(cnt):
+                    r.i32()
+            for _ in range(r.i32()):     # configs
+                r.string()
+                r.string()
+            code = NONE
+            if self._partition_count(name) is not None:
+                code = TOPIC_ALREADY_EXISTS
+            else:
+                try:
+                    self.mq.configure_topic(
+                        NAMESPACE, name,
+                        max(1, num_partitions))
+                    with self._lock:
+                        self._layouts[name] = max(1, num_partitions)
+                except (RuntimeError, OSError) as e:
+                    code = INVALID_REQUEST if "name" in str(e) \
+                        else UNKNOWN_SERVER_ERROR
+            results.append(enc_string(name) + enc_i16(code))
+        if r.remaining() >= 4:
+            r.i32()                      # timeout_ms
+        return enc_array(results)
+
+    def _produce(self, r: Reader) -> "bytes | None":
+        r.string()                       # transactional_id (v3)
+        acks = r.i16()
+        r.i32()                          # timeout_ms
+        topics_out = []
+        for _ in range(r.i32()):
+            name = r.string()
+            parts_out = []
+            for _ in range(r.i32()):
+                idx = r.i32()
+                record_set = r.bytes_() or b""
+                code, base_offset = NONE, -1
+                count = self._partition_count(name)
+                if count is None or not 0 <= idx < count:
+                    code = UNKNOWN_TOPIC_OR_PARTITION
+                else:
+                    try:
+                        records = decode_record_batches(record_set)
+                        # one atomic broker call per batch: a retried
+                        # batch must never duplicate a committed
+                        # prefix (Kafka per-partition batch guarantee)
+                        stamps = self.mq.publish_batch(
+                            NAMESPACE, name, idx,
+                            [(rec["key"] or b"", rec["value"] or b"")
+                             for rec in records])
+                        if stamps:
+                            base_offset = stamps[0]
+                    except BatchError:
+                        code = CORRUPT_MESSAGE
+                    except (RuntimeError, OSError):
+                        code = UNKNOWN_SERVER_ERROR
+                parts_out.append(enc_i32(idx) + enc_i16(code) +
+                                 enc_i64(base_offset) +
+                                 enc_i64(-1))    # log_append_time
+            topics_out.append(enc_string(name) + enc_array(parts_out))
+        if acks == 0:
+            # fire-and-forget: the protocol REQUIRES no response (a
+            # stray one would desynchronize the client's correlation)
+            return None
+        return enc_array(topics_out) + enc_i32(0)  # throttle_time
+
+    def _fetch(self, r: Reader) -> bytes:
+        r.i32()                          # replica_id
+        r.i32()                          # max_wait_ms (no long poll)
+        r.i32()                          # min_bytes
+        r.i32()                          # max_bytes
+        r.i8()                           # isolation_level
+        topics_out = []
+        for _ in range(r.i32()):
+            name = r.string()
+            parts_out = []
+            for _ in range(r.i32()):
+                idx = r.i32()
+                fetch_offset = r.i64()
+                max_part_bytes = r.i32()
+                code, hwm, batches = NONE, 0, b""
+                count = self._partition_count(name)
+                if count is None or not 0 <= idx < count:
+                    code = UNKNOWN_TOPIC_OR_PARTITION
+                else:
+                    try:
+                        msgs, hwm_ns = self.mq.subscribe_full(
+                            NAMESPACE, name, idx,
+                            since_ns=fetch_offset - 1, limit=500)
+                        # log-end-offset convention (0 when empty)
+                        hwm = hwm_ns + 1 if hwm_ns else 0
+                        total = 0
+                        out = []
+                        for m in msgs:
+                            b = encode_single_record_batch(
+                                m.ts_ns, m.ts_ns // 1_000_000,
+                                m.key or None, m.value)
+                            total += len(b)
+                            if out and total > max(1024,
+                                                   max_part_bytes):
+                                break
+                            out.append(b)
+                        batches = b"".join(out)
+                    except (RuntimeError, OSError):
+                        code = UNKNOWN_SERVER_ERROR
+                parts_out.append(
+                    enc_i32(idx) + enc_i16(code) + enc_i64(hwm) +
+                    enc_i64(hwm) +                 # last_stable_offset
+                    enc_i32(0) +                   # aborted txns: none
+                    enc_bytes(batches))
+            topics_out.append(enc_string(name) + enc_array(parts_out))
+        return enc_i32(0) + enc_array(topics_out)  # throttle_time
+
+    def _list_offsets(self, r: Reader) -> bytes:
+        r.i32()                          # replica_id
+        topics_out = []
+        for _ in range(r.i32()):
+            name = r.string()
+            parts_out = []
+            for _ in range(r.i32()):
+                idx = r.i32()
+                ts = r.i64()
+                code, offset = NONE, 0
+                count = self._partition_count(name)
+                if count is None or not 0 <= idx < count:
+                    code = UNKNOWN_TOPIC_OR_PARTITION
+                elif ts == -1:           # latest = log end offset
+                    try:
+                        _, hwm_ns = self.mq.subscribe_full(
+                            NAMESPACE, name, idx, since_ns=1 << 62,
+                            limit=1)
+                        offset = hwm_ns + 1 if hwm_ns else 0
+                    except (RuntimeError, OSError):
+                        code = UNKNOWN_SERVER_ERROR
+                # ts == -2 (earliest) or a timestamp: offset 0 serves
+                # both — our offsets are timestamps, so a fetch from
+                # the requested ts itself is also valid
+                elif ts >= 0:
+                    offset = ts * 1_000_000   # ms -> ns offset space
+                parts_out.append(enc_i32(idx) + enc_i16(code) +
+                                 enc_i64(-1) + enc_i64(offset))
+            topics_out.append(enc_string(name) + enc_array(parts_out))
+        return enc_array(topics_out)
+
+    def _find_coordinator(self, r: Reader) -> bytes:
+        r.string()                       # group id: we coordinate all
+        return (enc_i16(NONE) + enc_i32(0) + enc_string(self.host) +
+                enc_i32(self.port))
+
+    def _offset_commit(self, r: Reader) -> bytes:
+        group = r.string() or ""
+        r.i32()                          # generation_id
+        r.string()                       # member_id
+        r.i64()                          # retention_time
+        topics_out = []
+        for _ in range(r.i32()):
+            name = r.string()
+            parts_out = []
+            for _ in range(r.i32()):
+                idx = r.i32()
+                offset = r.i64()
+                r.string()               # metadata
+                code = NONE
+                try:
+                    # kafka commits "next offset to read"; our broker
+                    # stores "last consumed tsNs" — same resume point
+                    self.mq.commit_offset(group, NAMESPACE, name, idx,
+                                          offset - 1)
+                except (RuntimeError, OSError):
+                    code = UNKNOWN_SERVER_ERROR
+                parts_out.append(enc_i32(idx) + enc_i16(code))
+            topics_out.append(enc_string(name) + enc_array(parts_out))
+        return enc_array(topics_out)
+
+    def _offset_fetch(self, r: Reader) -> bytes:
+        group = r.string() or ""
+        topics_out = []
+        for _ in range(r.i32()):
+            name = r.string()
+            parts_out = []
+            for _ in range(r.i32()):
+                idx = r.i32()
+                code, offset = NONE, -1
+                try:
+                    ts = self.mq.fetch_offset(group, NAMESPACE, name,
+                                              idx)
+                    offset = ts + 1 if ts > 0 else -1
+                except (RuntimeError, OSError):
+                    code = UNKNOWN_SERVER_ERROR
+                parts_out.append(enc_i32(idx) + enc_i64(offset) +
+                                 enc_string("") + enc_i16(code))
+            topics_out.append(enc_string(name) + enc_array(parts_out))
+        return enc_array(topics_out)

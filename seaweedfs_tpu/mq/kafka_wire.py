@@ -1,0 +1,240 @@
+"""Kafka wire primitives (reference: weed/mq/kafka/protocol/record.go
++ produce.go record-batch handling).
+
+Implements the public Kafka protocol encodings this gateway speaks:
+big-endian primitives, (nullable) strings/bytes, zigzag varints, the
+CRC32C checksum, and the v2 RecordBatch on-disk/wire format — parsed
+on Produce, emitted on Fetch.
+
+One deliberate shape choice: Fetch responses emit ONE RecordBatch per
+message.  Our partition offsets are timestamps (nanoseconds — sparse
+and far apart), so in-batch offset deltas could overflow the int32
+delta field; single-record batches keep every delta zero and are
+fully legal Kafka framing (clients routinely see them from
+compacted/re-batched logs)."""
+
+from __future__ import annotations
+
+import struct
+
+
+# -- CRC32C (Castagnoli, reflected poly 0x82F63B78) ------------------------
+
+def _make_crc32c_table():
+    table = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_CRC32C_TABLE = _make_crc32c_table()
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+# -- primitives ------------------------------------------------------------
+
+def enc_i8(v):
+    return struct.pack(">b", v)
+
+
+def enc_i16(v):
+    return struct.pack(">h", v)
+
+
+def enc_i32(v):
+    return struct.pack(">i", v)
+
+
+def enc_i64(v):
+    return struct.pack(">q", v)
+
+
+def enc_u32(v):
+    return struct.pack(">I", v)
+
+
+def enc_string(s: "str | None") -> bytes:
+    if s is None:
+        return enc_i16(-1)
+    b = s.encode()
+    return enc_i16(len(b)) + b
+
+
+def enc_bytes(b: "bytes | None") -> bytes:
+    if b is None:
+        return enc_i32(-1)
+    return enc_i32(len(b)) + b
+
+
+def enc_array(items: list[bytes]) -> bytes:
+    return enc_i32(len(items)) + b"".join(items)
+
+
+def zigzag(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def unzigzag(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def enc_varint(n: int) -> bytes:
+    """Zigzag varint (the record-level integer encoding)."""
+    u = zigzag(n) & 0xFFFFFFFFFFFFFFFF
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+class Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise ValueError("kafka message truncated")
+        b = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def i8(self):
+        return struct.unpack(">b", self._take(1))[0]
+
+    def i16(self):
+        return struct.unpack(">h", self._take(2))[0]
+
+    def i32(self):
+        return struct.unpack(">i", self._take(4))[0]
+
+    def i64(self):
+        return struct.unpack(">q", self._take(8))[0]
+
+    def u32(self):
+        return struct.unpack(">I", self._take(4))[0]
+
+    def string(self) -> "str | None":
+        n = self.i16()
+        return None if n < 0 else self._take(n).decode()
+
+    def bytes_(self) -> "bytes | None":
+        n = self.i32()
+        return None if n < 0 else self._take(n)
+
+    def varint(self) -> int:
+        shift = u = 0
+        while True:
+            b = self._take(1)[0]
+            u |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return unzigzag(u)
+            shift += 7
+            if shift > 70:
+                raise ValueError("varint overflow")
+
+    def remaining(self) -> int:
+        return len(self.data) - self.pos
+
+
+# -- RecordBatch v2 --------------------------------------------------------
+
+class BatchError(ValueError):
+    pass
+
+
+def decode_record_batches(data: bytes) -> list[dict]:
+    """Parse a Produce record_set: one or more v2 RecordBatches.
+    Returns [{key: bytes|None, value: bytes|None, ts_ms: int}] in
+    order.  CRC and magic are verified — a corrupt batch must be
+    rejected, not half-applied (produce.go CORRUPT_MESSAGE path)."""
+    out = []
+    r = Reader(data)
+    while r.remaining() > 0:
+        if r.remaining() < 61:
+            raise BatchError("truncated record batch header")
+        r.i64()                          # baseOffset (client fills 0)
+        batch_len = r.i32()
+        batch_body = Reader(r._take(batch_len))
+        batch_body.i32()                 # partitionLeaderEpoch
+        magic = batch_body.i8()
+        if magic != 2:
+            raise BatchError(f"unsupported magic {magic} (only v2)")
+        crc = batch_body.u32()
+        crc_bytes = batch_body.data[batch_body.pos:]
+        if crc32c(crc_bytes) != crc:
+            raise BatchError("record batch CRC mismatch")
+        attributes = batch_body.i16()
+        if attributes & 0x07:
+            raise BatchError("compressed batches not supported")
+        batch_body.i32()                 # lastOffsetDelta
+        base_ts = batch_body.i64()
+        batch_body.i64()                 # maxTimestamp
+        batch_body.i64()                 # producerId
+        batch_body.i16()                 # producerEpoch
+        batch_body.i32()                 # baseSequence
+        count = batch_body.i32()
+        for _ in range(count):
+            rec_len = batch_body.varint()
+            rec = Reader(batch_body._take(rec_len))
+            rec.i8()                     # record attributes
+            ts_delta = rec.varint()
+            rec.varint()                 # offsetDelta
+            klen = rec.varint()
+            key = None if klen < 0 else rec._take(klen)
+            vlen = rec.varint()
+            value = None if vlen < 0 else rec._take(vlen)
+            # headers are parsed (framing must stay in sync) and
+            # dropped — our MQ records carry key/value only
+            for _ in range(rec.varint()):
+                hk = rec.varint()
+                rec._take(hk)
+                hv = rec.varint()
+                if hv > 0:
+                    rec._take(hv)
+            out.append({"key": key, "value": value,
+                        "ts_ms": base_ts + ts_delta})
+    return out
+
+
+def encode_single_record_batch(offset: int, ts_ms: int,
+                               key: "bytes | None",
+                               value: "bytes | None") -> bytes:
+    """One message as one v2 RecordBatch (see module docstring)."""
+    rec = (enc_i8(0) +                   # attributes
+           enc_varint(0) +               # timestampDelta
+           enc_varint(0) +               # offsetDelta
+           (enc_varint(-1) if key is None else
+            enc_varint(len(key)) + key) +
+           (enc_varint(-1) if value is None else
+            enc_varint(len(value)) + value) +
+           enc_varint(0))                # headers
+    record = enc_varint(len(rec)) + rec
+    after_crc = (enc_i16(0) +            # attributes
+                 enc_i32(0) +            # lastOffsetDelta
+                 enc_i64(ts_ms) +        # baseTimestamp
+                 enc_i64(ts_ms) +        # maxTimestamp
+                 enc_i64(-1) +           # producerId
+                 enc_i16(-1) +           # producerEpoch
+                 enc_i32(-1) +           # baseSequence
+                 enc_i32(1) +            # record count
+                 record)
+    body = (enc_i32(0) +                 # partitionLeaderEpoch
+            enc_i8(2) +                  # magic
+            enc_u32(crc32c(after_crc)) +
+            after_crc)
+    return enc_i64(offset) + enc_i32(len(body)) + body

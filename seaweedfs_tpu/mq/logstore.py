@@ -76,6 +76,33 @@ class PartitionLog:
                 self._flush_locked()
             return ts
 
+    def append_many(self, records: "list[tuple[str, str, int]]"
+                    ) -> list[int]:
+        """Atomic multi-append: all of [(key_b64, value_b64, ts_ns)]
+        land under one lock hold, or none do (the Kafka gateway's
+        per-partition batch guarantee — a retried batch must not
+        duplicate a committed prefix)."""
+        with self._lock:
+            if self._last_ts == 0:
+                self._last_ts = self._persisted_hwm()
+                self._last_flushed_ts = self._last_ts
+            out = []
+            now = time.time_ns()
+            for key_b64, value_b64, ts_ns in records:
+                ts = int(ts_ns) or now
+                if ts > now + self.MAX_CLIENT_SKEW_NS:
+                    ts = now
+                if ts <= self._last_ts:
+                    ts = self._last_ts + 1
+                self._last_ts = ts
+                self._buf.append({"tsNs": ts, "key": key_b64,
+                                  "value": value_b64})
+                self._buf_bytes += len(value_b64) + len(key_b64) + 32
+                out.append(ts)
+            if self._buf_bytes >= FLUSH_BYTES:
+                self._flush_locked()
+            return out
+
     def flush(self) -> None:
         with self._lock:
             self._flush_locked()
