@@ -36,6 +36,13 @@ class Filer:
         # memory-tail-only otherwise (tests / ephemeral filers)
         self.meta_log = MetaLog(meta_log_dir)
         self._listeners: list[Callable[[dict], None]] = []
+        # striped per-path locks for chunk-list read-modify-write
+        # cycles (append_chunks/truncate_file): two concurrent
+        # /__chunk__/ posts must not lose each other's chunks
+        self._chunk_stripes = [threading.Lock() for _ in range(64)]
+
+    def _chunk_lock(self, path: str) -> "threading.Lock":
+        return self._chunk_stripes[hash(path) % 64]
 
     # -- namespace ops ----------------------------------------------------
 
@@ -160,6 +167,77 @@ class Filer:
         self.create_entry(entry)
         if old is not None and not old.is_directory:
             self._delete_chunks(old)
+        return entry
+
+    def append_chunks(self, path: str, offset: int, data: bytes,
+                      truncate_to: int | None = None) -> Entry:
+        """Interval write: upload `data` as chunks at logical
+        `offset` and merge them into the entry's chunk list, relying
+        on later-wins overlap resolution (filechunks.py) — the
+        server half of the reference's chunked dirty-page writeback
+        (mount/dirty_pages_chunked.go + UpdateEntry).  Creates the
+        entry when absent.  `truncate_to` clips the visible length
+        afterwards (see truncate_file)."""
+        # upload blobs OUTSIDE the path lock (slow), merge under it:
+        # concurrent posts to one path must not lose each other's
+        # chunk-list updates (read-modify-write race)
+        new_chunks = []
+        for off in range(0, len(data), CHUNK_SIZE):
+            piece = data[off:off + CHUNK_SIZE]
+            a = operation.assign(self.master,
+                                 collection=self.collection,
+                                 replication=self.replication)
+            r = operation.upload(a.url, a.fid, piece, auth=a.auth)
+            new_chunks.append(
+                FileChunk(a.fid, offset + off, len(piece),
+                          r.get("eTag", ""), time.time_ns()))
+        with self._chunk_lock(path):
+            entry = self.find_entry(path)
+            if entry is None:
+                entry = Entry(normalize_path(path),
+                              is_directory=False,
+                              attributes=Attributes())
+            elif entry.is_directory:
+                raise IsADirectoryError(path)
+            entry.chunks.extend(new_chunks)
+            if truncate_to is not None:
+                self._clip_chunks(entry, truncate_to)
+            entry.attributes.mtime = time.time()
+            self.create_entry(entry)
+            return entry
+
+    @staticmethod
+    def _clip_chunks(entry: Entry, length: int) -> None:
+        """Drop/clip chunk extents beyond `length` (a FileChunk's
+        visible size can shrink without rewriting its blob)."""
+        kept = []
+        for c in entry.chunks:
+            if c.offset >= length:
+                continue
+            if c.offset + c.size > length:
+                c.size = length - c.offset
+            kept.append(c)
+        entry.chunks = kept
+
+    def truncate_file(self, path: str, length: int) -> Entry:
+        """Set the visible file length: clip beyond, zero-extend by a
+        one-byte sentinel chunk when growing (reads zero-fill gaps,
+        but total size is the max chunk extent)."""
+        with self._chunk_lock(path):
+            entry = self.find_entry(path)
+            if entry is None or entry.is_directory:
+                raise FileNotFoundError(path)
+            current = total_size(entry.chunks)
+            if length >= current:
+                grow = length > current
+            else:
+                self._clip_chunks(entry, length)
+                entry.attributes.mtime = time.time()
+                self.create_entry(entry)
+                return entry
+        if grow:
+            # append_chunks retakes the lock (upload happens outside)
+            return self.append_chunks(path, length - 1, b"\x00")
         return entry
 
     def read_file(self, path: str, offset: int = 0,

@@ -185,6 +185,20 @@ class Identity:
         ident.policies = dict(d.get("policies", {}))
         if "staticActions" in d:
             ident.static_actions = list(d["staticActions"])
+        elif ident.policies:
+            # migration: an older save serialized actions as
+            # static ∪ policy-derived; re-deriving and subtracting
+            # keeps policy grants revocable (DeleteUserPolicy must
+            # not leave them baked into the static set forever)
+            try:
+                from .iamapi import policy_to_actions
+                derived = set()
+                for doc in ident.policies.values():
+                    derived.update(policy_to_actions(doc))
+                ident.static_actions = [a for a in ident.actions
+                                        if a not in derived]
+            except Exception:    # undecodable legacy doc: keep all
+                pass
         # else: a hand-written identities JSON — its actions ARE the
         # static provisioned set (the cls(...) call captured them)
         return ident

@@ -18,17 +18,103 @@ import time
 import urllib.parse
 from dataclasses import dataclass, field
 
+from ..filer.entry import FileChunk
+from ..filer.filechunks import total_size
 from ..server.httpd import http_bytes, http_json
 
 
 @dataclass
 class _WriteState:
-    """One path's open-for-write state: shared buffer + handle
-    refcount + dirty flag (flush uploads only dirty buffers, release
-    drops the state only when the LAST handle closes)."""
-    buf: bytearray = field(default_factory=bytearray)
+    """One path's open-for-write state: INTERVAL dirty pages (the
+    analog of mount/dirty_pages_chunked.go), not a whole-file buffer.
+
+    `pages` is a sorted, non-overlapping list of (start, bytearray)
+    intervals; once the buffered total crosses FLUSH_THRESHOLD the
+    pages stream to the filer as overlapping chunks (later-wins
+    resolution, filer/filechunks.py) and are dropped — memory stays
+    bounded for arbitrarily large sequential writes.  `size` is the
+    authoritative visible length while open; `trunc_point` is the
+    low-water mark of any shrinking truncate since the last flush
+    (the server must clip there BEFORE new pages land, or stale
+    middle content would reappear)."""
+    pages: list = field(default_factory=list)
+    size: int = 0
     refs: int = 0
     dirty: bool = False
+    truncated: bool = False
+    trunc_point: "int | None" = None
+    # serializes the NETWORK phase of flushes for this path: a slow
+    # snapshot posted after a later flush would win the server-side
+    # mtime race and resurrect stale bytes
+    flush_lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def buffered(self) -> int:
+        return sum(len(b) for _, b in self.pages)
+
+    def covers(self, offset: int, size: int) -> bool:
+        """True when one buffered interval spans the whole range (the
+        common write-then-read-back pattern — no server round trip
+        needed)."""
+        for start, buf in self.pages:
+            if start <= offset and offset + size <= start + len(buf):
+                return True
+        return False
+
+    def read_overlay(self, offset: int, size: int) -> bytes:
+        out = bytearray(size)
+        for start, buf in self.pages:
+            lo = max(start, offset)
+            hi = min(start + len(buf), offset + size)
+            if lo < hi:
+                out[lo - offset:hi - offset] = buf[lo - start:hi - start]
+        return bytes(out)
+
+    def insert_missing(self, offset: int, data: bytes) -> None:
+        """Requeue-after-failed-flush variant of insert: existing
+        pages WIN (they hold newer writes made during the failed
+        flush) — only the uncovered subranges are reinserted."""
+        pos = offset
+        end = offset + len(data)
+        for start, buf in sorted(self.pages, key=lambda p: p[0]):
+            pend = start + len(buf)
+            if pend <= pos or start >= end:
+                continue
+            if pos < start:
+                self.insert(pos, data[pos - offset:start - offset])
+            pos = max(pos, pend)
+        if pos < end:
+            self.insert(pos, data[pos - offset:end - offset])
+
+    def insert(self, offset: int, data: bytes) -> None:
+        """Merge [offset, offset+len) into the interval list."""
+        new_start, new_end = offset, offset + len(data)
+        merged = bytearray(data)
+        keep = []
+        for start, buf in self.pages:
+            end = start + len(buf)
+            if end < new_start or start > new_end:
+                keep.append((start, buf))
+                continue
+            # overlap/adjacency: splice existing bytes around the new
+            if start < new_start:
+                merged[0:0] = buf[:new_start - start]
+                new_start = start
+            if end > new_end:
+                merged.extend(buf[new_end - start:])
+                new_end = end
+        keep.append((new_start, merged))
+        keep.sort(key=lambda p: p[0])
+        self.pages = keep
+
+    def clip(self, length: int) -> None:
+        kept = []
+        for start, buf in self.pages:
+            if start >= length:
+                continue
+            if start + len(buf) > length:
+                buf = buf[:length - start]
+            kept.append((start, buf))
+        self.pages = kept
 
 
 class FuseError(OSError):
@@ -43,11 +129,12 @@ class WeedFS:
     write path — create/write/truncate/flush, mkdir/unlink/rmdir,
     rename (weedfs_file_write.go, weedfs_dir_mkrm.go).
 
-    Writes buffer whole-file per open path and upload on flush/release
-    — a simplification of the reference's chunked dirty-page writeback
-    (mount/dirty_pages_chunked.go streams interval pages; ours holds
-    the file in memory until close, fine for the mount's typical
-    editor/tool workloads, unbounded for huge streaming writes)."""
+    Writes collect as INTERVAL dirty pages per open path
+    (mount/dirty_pages_chunked.go): once FLUSH_THRESHOLD bytes are
+    buffered they stream to the filer as overlapping chunks via
+    /__chunk__/ (later-wins resolution), so arbitrarily large
+    sequential writes run in bounded memory; flush/release drains the
+    rest and applies any pending truncation."""
 
     MAX_CACHE_ENTRIES = 16384  # the reference's meta_cache is bounded
 
@@ -145,8 +232,6 @@ class WeedFS:
             size = len(attrs["symlinkTarget"])
             nlink = 1
         else:
-            from ..filer.entry import FileChunk
-            from ..filer.filechunks import total_size
             mode = stat_mod.S_IFREG | (attrs.get("mode", 0o644) & 0o7777)
             # max-extent size, the SAME definition the filer serves
             # bytes by — a summed size diverges on overlapping chunks
@@ -164,16 +249,16 @@ class WeedFS:
     def getattr(self, path: str) -> dict:
         with self._lock:
             ws = self._writes.get(path)
-            buf_len = len(ws.buf) if ws is not None else None
+            open_size = ws.size if ws is not None else None
         entry = self._lookup(path)
         if entry is None:
             raise FuseError(errno.ENOENT)
         st = self._entry_stat(entry)
-        if buf_len is not None:
-            # overlay ONLY the size from the open write buffer (the
+        if open_size is not None:
+            # overlay ONLY the size from the open write state (the
             # kernel stats after each write); mode/uid/gid/timestamps
             # stay the filer entry's truth
-            st["st_size"] = buf_len
+            st["st_size"] = open_size
         return st
 
     def readdir(self, path: str) -> "list[str]":
@@ -205,41 +290,69 @@ class WeedFS:
         if entry.get("isDirectory"):
             raise FuseError(errno.EISDIR)
         if flags & (os.O_WRONLY | os.O_RDWR):
-            with self._lock:
-                ws = self._writes.get(path)
-                if ws is not None:
-                    ws.refs += 1
-                    if flags & os.O_TRUNC:
-                        del ws.buf[:]
-                        ws.dirty = True
-                    return 0
-            # seed OUTSIDE the lock (read() takes it too): a writable
-            # open of an existing file starts from the current content
-            # so non-O_TRUNC writes patch in place
-            seed = bytearray() if flags & os.O_TRUNC else \
-                bytearray(self.read(path, 1 << 62, 0))
+            # no whole-file seed read: non-TRUNC writes become
+            # interval pages overlaid on the server content
+            base_size = total_size([
+                FileChunk.from_json(c)
+                for c in entry.get("chunks", [])])
             with self._lock:
                 ws = self._writes.setdefault(path, _WriteState())
                 ws.refs += 1
                 if ws.refs == 1:
-                    ws.buf = seed
-                    ws.dirty = bool(flags & os.O_TRUNC)
+                    ws.size = base_size
+                if flags & os.O_TRUNC:
+                    ws.pages = []
+                    ws.size = 0
+                    ws.truncated = ws.dirty = True
+                    ws.trunc_point = 0
         return 0
 
     def read(self, path: str, size: int, offset: int) -> bytes:
         """Ranged read through the filer (weedfs_file_read.go —
-        chunk-view resolution happens filer-side)."""
+        chunk-view resolution happens filer-side), with any open
+        write-state's dirty pages overlaid on top (the kernel may
+        read back what it just wrote before anything flushed)."""
         if size <= 0:
             return b""
         with self._lock:
             ws = self._writes.get(path)
             if ws is not None:
-                return bytes(ws.buf[offset:offset + size])
+                size = max(0, min(size, ws.size - offset))
+                if size and ws.covers(offset, size):
+                    # fully in the dirty pages: no server round trip
+                    return ws.read_overlay(offset, size)
+                pages = [(s, bytes(b)) for s, b in ws.pages]
+                trunc = ws.trunc_point
+            else:
+                pages = None
+        if pages is not None and size == 0:
+            return b""
+        base = self._ranged_get(path, offset, size)
+        if pages is None:
+            return base
+        out = bytearray(size)            # gaps read as zeros
+        out[:len(base)] = base[:size]
+        if trunc is not None and trunc < offset + size:
+            # a pending shrink: stale server bytes beyond the
+            # truncation point must not show through the gaps
+            lo = max(0, trunc - offset)
+            out[lo:] = b"\x00" * (size - lo)
+        for start, buf in pages:
+            lo = max(start, offset)
+            hi = min(start + len(buf), offset + size)
+            if lo < hi:
+                out[lo - offset:hi - offset] = \
+                    buf[lo - start:hi - start]
+        return bytes(out)
+
+    def _ranged_get(self, path: str, offset: int, size: int) -> bytes:
         st, body, _ = http_bytes(
             "GET", self.filer + urllib.parse.quote(path), None,
             {"Range": f"bytes={offset}-{offset + size - 1}"})
         if st in (200, 206):
             return body if st == 206 else body[offset:offset + size]
+        if st == 416:
+            return b""                   # beyond EOF: overlay decides
         if st == 404:
             raise FuseError(errno.ENOENT)
         raise FuseError(errno.EIO)
@@ -266,7 +379,8 @@ class WeedFS:
             ws = self._writes.setdefault(path, _WriteState())
             ws.refs += 1
             if ws.refs == 1:
-                ws.buf = bytearray()
+                ws.pages = []
+                ws.size = 0
                 ws.dirty = False
         return 0
 
@@ -290,59 +404,113 @@ class WeedFS:
             raise FuseError(errno.EIO)
         self._invalidate(path)
 
+    # pages stream to the filer once this much is buffered — the
+    # bound that makes huge sequential writes O(threshold) memory
+    FLUSH_THRESHOLD = 8 * 1024 * 1024
+
     def write(self, path: str, data: bytes, offset: int) -> int:
         with self._lock:
             ws = self._writes.get(path)
             if ws is None:
                 raise FuseError(errno.EBADF)
-            buf = ws.buf
-            if offset > len(buf):
-                buf.extend(b"\x00" * (offset - len(buf)))
-            buf[offset:offset + len(data)] = data
+            ws.insert(offset, data)
+            ws.size = max(ws.size, offset + len(data))
             ws.dirty = True
+            over = ws.buffered() >= self.FLUSH_THRESHOLD
+        if over:
+            self._flush_pages(path)
         return len(data)
 
     def truncate(self, path: str, length: int) -> None:
         with self._lock:
             ws = self._writes.get(path)
             if ws is not None:
-                buf = ws.buf
-                if length < len(buf):
-                    del buf[length:]
-                else:
-                    buf.extend(b"\x00" * (length - len(buf)))
-                ws.dirty = True
+                if length < ws.size:
+                    ws.clip(length)
+                    ws.trunc_point = length if ws.trunc_point is None \
+                        else min(ws.trunc_point, length)
+                ws.size = length
+                ws.truncated = ws.dirty = True
                 return
-        # truncate without an open handle: rewrite through the filer
-        data = self.read(path, 1 << 62, 0) if length else b""
-        data = data[:length] + b"\x00" * (length - len(data))
-        self._put(path, data)
+        # truncate without an open handle: server-side clip/extend,
+        # no whole-file round trip
+        st, _, _ = http_bytes(
+            "POST", f"{self.filer}/__chunk__/" +
+            urllib.parse.quote(path).lstrip("/") +
+            f"?truncateTo={length}", b"")
+        if st == 404:
+            raise FuseError(errno.ENOENT)
+        if st != 200:
+            raise FuseError(errno.EIO)
+        self._invalidate(path)
+
+    def _chunk_post(self, path: str, offset: int, data: bytes,
+                    truncate_to: "int | None" = None) -> None:
+        q = f"?offset={offset}"
+        if truncate_to is not None:
+            q += f"&truncateTo={truncate_to}"
+        st, _, _ = http_bytes(
+            "POST", f"{self.filer}/__chunk__/" +
+            urllib.parse.quote(path).lstrip("/") + q, data)
+        if st != 200:
+            raise FuseError(errno.EIO)
+
+    def _flush_pages(self, path: str,
+                     finalize: bool = False) -> None:
+        """Stream buffered intervals to the filer as overlapping
+        chunks (the dirty_pages_chunked.go writeback): shrink-clip
+        first (stale middle content must not resurface), then the
+        pages oldest-offset-first, then — on finalize — grow the
+        visible size for pure zero-extensions."""
+        with self._lock:
+            ws = self._writes.get(path)
+        if ws is None:
+            return
+        # serialize flushes per path: snapshot AND post under the
+        # flush lock, so an earlier snapshot can never land after (and
+        # thus server-mtime-beat) a later one
+        with ws.flush_lock:
+            with self._lock:
+                pages, ws.pages = ws.pages, []
+                trunc, ws.trunc_point = ws.trunc_point, None
+                truncated = ws.truncated
+                size = ws.size
+                if finalize:
+                    ws.truncated = False
+            try:
+                if trunc is not None:
+                    self._chunk_post(path, 0, b"", truncate_to=trunc)
+                for start, buf in pages:
+                    self._chunk_post(path, start, bytes(buf))
+                if finalize and truncated:
+                    self._chunk_post(path, 0, b"", truncate_to=size)
+            except FuseError:
+                with self._lock:
+                    ws2 = self._writes.get(path)
+                    if ws2 is not None:
+                        # re-queue for the next attempt; pages written
+                        # meanwhile are NEWER and must win
+                        for start, buf in pages:
+                            ws2.insert_missing(start, bytes(buf))
+                        if trunc is not None:
+                            ws2.trunc_point = trunc if \
+                                ws2.trunc_point is None else \
+                                min(ws2.trunc_point, trunc)
+                        ws2.truncated = ws2.truncated or truncated
+                        ws2.dirty = True
+                raise
+        self._invalidate(path)
 
     def flush(self, path: str) -> None:
-        """Upload the buffer iff dirty (the kernel flushes on every
+        """Flush dirty pages iff dirty (the kernel flushes on every
         close() of every dup'd fd — clean flushes must not re-upload
-        the whole file)."""
+        anything)."""
         with self._lock:
             ws = self._writes.get(path)
             if ws is None or not ws.dirty:
                 return
-            data = bytes(ws.buf)
             ws.dirty = False
-        # the content PUT re-creates the entry with default attrs;
-        # carry the real mode/owner across (chmod must survive saves)
-        entry = self._lookup(path)
-        attrs = dict((entry or {}).get("attributes") or {})
-        try:
-            self._put(path, data)
-            if attrs.get("mode"):
-                attrs["mtime"] = time.time()
-                self._set_attrs(path, attrs)
-        except Exception:
-            with self._lock:
-                ws2 = self._writes.get(path)
-                if ws2 is not None:
-                    ws2.dirty = True  # retry on the next flush
-            raise
+        self._flush_pages(path, finalize=True)
 
     def release(self, path: str, writable: bool = True) -> None:
         """`writable` mirrors the closing HANDLE's open mode (from

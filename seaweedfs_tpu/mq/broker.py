@@ -347,9 +347,13 @@ class BrokerServer:
         st, body, _ = http_bytes(
             "GET", self.filer + urllib.parse.quote(path))
         if st == 404:
-            return 200, {"tsNs": 0}  # no commit yet: start from 0
+            # no commit yet — `committed` lets callers distinguish
+            # this from a real commit at position 0/-1 (the Kafka
+            # gateway must not misread those as "no offset")
+            return 200, {"tsNs": 0, "committed": False}
         if st != 200:
             # a filer blip must NOT read as "no commit": the consumer
             # would restart from 0 and reprocess the whole partition
             return 503, {"error": f"offset store: {st}"}
-        return 200, {"tsNs": int(json.loads(body)["tsNs"])}
+        return 200, {"tsNs": int(json.loads(body)["tsNs"]),
+                     "committed": True}

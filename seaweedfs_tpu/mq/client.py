@@ -60,16 +60,8 @@ class MQClient:
     def subscribe(self, namespace: str, topic: str, partition: int,
                   since_ns: int = 0, limit: int = 1000
                   ) -> "list[Message]":
-        r = http_json("GET", f"{self.broker}/topics/subscribe?" +
-                      _q(namespace=namespace, topic=topic,
-                         partition=partition, sinceNs=since_ns,
-                         limit=limit))
-        if "error" in r:
-            raise RuntimeError(f"subscribe: {r['error']}")
-        return [Message(base64.b64decode(m.get("key", "")),
-                        base64.b64decode(m.get("value", "")),
-                        int(m["tsNs"]))
-                for m in r["messages"]]
+        return self.subscribe_full(namespace, topic, partition,
+                                   since_ns, limit)[0]
 
     def publish_batch(self, namespace: str, topic: str,
                       partition: int,
@@ -127,6 +119,14 @@ class MQClient:
 
     def fetch_offset(self, group: str, namespace: str, topic: str,
                      partition: int) -> int:
+        return self.fetch_offset_full(group, namespace, topic,
+                                      partition)[0]
+
+    def fetch_offset_full(self, group: str, namespace: str,
+                          topic: str, partition: int
+                          ) -> "tuple[int, bool]":
+        """(tsNs, committed) — committed=False means no offset was
+        ever stored (distinct from a commit at 0/-1)."""
         r = http_json("GET", f"{self.broker}/offsets/fetch?" +
                       _q(group=group, namespace=namespace,
                          topic=topic, partition=partition))
@@ -134,4 +134,4 @@ class MQClient:
             # an offset-store error must surface, not read as "start
             # from 0" (that would reprocess the whole partition)
             raise RuntimeError(f"fetch offset: {r['error']}")
-        return int(r.get("tsNs", 0))
+        return int(r.get("tsNs", 0)), bool(r.get("committed", True))

@@ -26,6 +26,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 
 from .client import MQClient
 from .kafka_wire import (BatchError, Reader, decode_record_batches,
@@ -66,8 +67,11 @@ class KafkaGateway:
         self.port = port
         self._sock = None
         self._stopping = False
-        # topic layouts cache: name -> partition count
-        self._layouts: dict[str, int] = {}
+        # topic layouts cache: name -> (partition count, expires) —
+        # TTL'd so broker-side reconfiguration/deletion is noticed
+        # without a gateway restart
+        self._layouts: dict[str, tuple[int, float]] = {}
+        self._layout_ttl = 10.0
         self._lock = threading.Lock()
 
     def start(self) -> "KafkaGateway":
@@ -150,16 +154,18 @@ class KafkaGateway:
     # -- topic helpers -----------------------------------------------------
 
     def _partition_count(self, topic: str) -> "int | None":
+        now = time.monotonic()
         with self._lock:
-            n = self._layouts.get(topic)
-        if n is not None:
-            return n
+            hit = self._layouts.get(topic)
+            if hit is not None and now < hit[1]:
+                return hit[0]
         try:
             parts = self.mq.lookup(NAMESPACE, topic)
         except (RuntimeError, OSError, LookupError):
             return None
         with self._lock:
-            self._layouts[topic] = len(parts)
+            self._layouts[topic] = (len(parts),
+                                    now + self._layout_ttl)
         return len(parts)
 
     def _all_topics(self) -> list[str]:
@@ -226,7 +232,9 @@ class KafkaGateway:
                         NAMESPACE, name,
                         max(1, num_partitions))
                     with self._lock:
-                        self._layouts[name] = max(1, num_partitions)
+                        self._layouts[name] = (
+                            max(1, num_partitions),
+                            time.monotonic() + self._layout_ttl)
                 except (RuntimeError, OSError) as e:
                     code = INVALID_REQUEST if "name" in str(e) \
                         else UNKNOWN_SERVER_ERROR
@@ -394,9 +402,12 @@ class KafkaGateway:
                 idx = r.i32()
                 code, offset = NONE, -1
                 try:
-                    ts = self.mq.fetch_offset(group, NAMESPACE, name,
-                                              idx)
-                    offset = ts + 1 if ts > 0 else -1
+                    ts, committed = self.mq.fetch_offset_full(
+                        group, NAMESPACE, name, idx)
+                    # committed value is "next offset to read" - 1;
+                    # a commit at position 0 stores -1 and must NOT
+                    # read back as "no offset"
+                    offset = ts + 1 if committed else -1
                 except (RuntimeError, OSError):
                     code = UNKNOWN_SERVER_ERROR
                 parts_out.append(enc_i32(idx) + enc_i64(offset) +

@@ -75,6 +75,8 @@ class FilerServer:
         path = req.path
         if path.startswith("/__tus__/"):
             return self._tus(req, path)
+        if path.startswith("/__chunk__/"):
+            return self._chunk_write(req, path[len("/__chunk__"):])
         if req.method in ("POST", "PUT"):
             return self._put(req, path)
         if req.method in ("GET", "HEAD"):
@@ -82,6 +84,28 @@ class FilerServer:
         if req.method == "DELETE":
             return self._delete(req, path)
         return 405, {"error": "method not allowed"}
+
+    def _chunk_write(self, req: Request, path: str):
+        """Interval chunk write (mount dirty-page flush target):
+        POST /__chunk__/<path>?offset=N[&truncateTo=M] with raw bytes
+        — appends overlapping chunks / clips length without rewriting
+        the whole file (filer.proto UpdateEntry + AssignVolume)."""
+        if req.method != "POST":
+            return 405, {"error": "POST only"}
+        offset = int(req.query.get("offset", 0))
+        trunc = req.query.get("truncateTo")
+        trunc = int(trunc) if trunc is not None else None
+        try:
+            if req.body or trunc is None:
+                entry = self.filer.append_chunks(path, offset, req.body,
+                                                 truncate_to=trunc)
+            else:
+                entry = self.filer.truncate_file(path, trunc)
+        except IsADirectoryError:
+            return 409, {"error": "is a directory"}
+        except FileNotFoundError:
+            return 404, {"error": "not found"}
+        return 200, {"name": entry.name, "size": entry.total_size()}
 
     def _put(self, req: Request, path: str):
         if path.endswith("/"):

@@ -251,3 +251,14 @@ def test_gateway_survives_broker_restart(kafka, tmp_path):
         assert [m["value"] for m in msgs] == [b"persisted"]
     finally:
         broker2.stop()
+
+
+def test_commit_at_position_zero_roundtrips(kafka):
+    """Code-review regression: a committed offset of 0 must not read
+    back as 'no committed offset' (-1)."""
+    client, _, _ = kafka
+    client.create_topic("zero", partitions=1)
+    client.offset_commit("g0", "zero", 0, 0)
+    assert client.offset_fetch("g0", "zero", 0) == 0
+    # and a never-committed partition still reports -1
+    assert client.offset_fetch("g0-fresh", "zero", 0) == -1

@@ -14,6 +14,7 @@ import pytest
 
 from seaweedfs_tpu.filer.entry import Attributes, Entry
 from seaweedfs_tpu.mount import FuseError, WeedFS
+from seaweedfs_tpu.mount.weedfs import _WriteState
 from seaweedfs_tpu.server.filer_server import FilerServer
 from seaweedfs_tpu.server.master_server import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
@@ -290,3 +291,113 @@ def test_readonly_release_and_chmod_and_dir_rename(fs):
     w.release("/docs/dir2/f.txt")
     assert filer.filer.read_file("/docs/dir2/f.txt") == b"inside"
     assert filer.filer.find_entry("/docs/dir1") is None
+
+
+# -- interval dirty pages (mount/dirty_pages_chunked.go analog) ------------
+
+def test_streaming_write_bounded_memory(fs):
+    """A sequential write far over FLUSH_THRESHOLD must stream out
+    mid-write: buffered bytes stay bounded, content exact."""
+    w, filer = fs
+    old_threshold = WeedFS.FLUSH_THRESHOLD
+    WeedFS.FLUSH_THRESHOLD = 256 * 1024
+    try:
+        w.create("/docs/big.bin")
+        piece = bytes(range(256)) * 512          # 128 KiB
+        max_buffered = 0
+        for i in range(40):                      # 5 MiB total
+            w.write("/docs/big.bin", piece, i * len(piece))
+            ws = w._writes["/docs/big.bin"]
+            max_buffered = max(max_buffered, ws.buffered())
+        assert max_buffered <= WeedFS.FLUSH_THRESHOLD + len(piece)
+        w.release("/docs/big.bin")
+        assert filer.filer.read_file("/docs/big.bin") == piece * 40
+    finally:
+        WeedFS.FLUSH_THRESHOLD = old_threshold
+
+
+def test_random_access_write_no_seed_read(fs):
+    """Non-TRUNC writable open patches intervals in place WITHOUT
+    reading the whole file first; untouched ranges survive."""
+    w, filer = fs
+    base = bytes(range(256)) * 40                # 10240 bytes, exists
+    w.open("/docs/sub/b.bin", os.O_RDWR)
+    assert w._writes["/docs/sub/b.bin"].buffered() == 0  # no seed
+    w.write("/docs/sub/b.bin", b"PATCH", 100)
+    w.write("/docs/sub/b.bin", b"TAIL", 10236)
+    # dirty read-back overlays pages on server content
+    assert w.read("/docs/sub/b.bin", 10, 98) == \
+        base[98:100] + b"PATCH" + base[105:108]
+    w.release("/docs/sub/b.bin")
+    final = filer.filer.read_file("/docs/sub/b.bin")
+    assert final[:100] == base[:100]
+    assert final[100:105] == b"PATCH"
+    assert final[10236:] == b"TAIL"
+    assert len(final) == 10240
+
+
+def test_truncate_then_write_leaves_zero_gap(fs):
+    """Shrink below server content, then write beyond: the gap must
+    read zeros (stale middle bytes must not resurface), both while
+    dirty and after flush."""
+    w, filer = fs
+    w.open("/docs/a.txt", os.O_RDWR)             # "alpha file contents"
+    w.truncate("/docs/a.txt", 5)
+    w.write("/docs/a.txt", b"END", 10)
+    assert w.read("/docs/a.txt", 13, 0) == \
+        b"alpha" + b"\x00" * 5 + b"END"
+    w.release("/docs/a.txt")
+    assert filer.filer.read_file("/docs/a.txt") == \
+        b"alpha" + b"\x00" * 5 + b"END"
+
+
+def test_truncate_without_handle_server_side(fs):
+    w, filer = fs
+    w.truncate("/docs/a.txt", 5)
+    assert filer.filer.read_file("/docs/a.txt") == b"alpha"
+    # grow: zero-extended visible size
+    w.truncate("/docs/a.txt", 8)
+    assert filer.filer.read_file("/docs/a.txt") == b"alpha\x00\x00\x00"
+    assert w.getattr("/docs/a.txt")["st_size"] == 8
+
+
+def test_overlapping_interval_merge_unit():
+    ws = _WriteState()
+    ws.insert(10, b"bbbb")        # [10,14)
+    ws.insert(0, b"aaaa")         # [0,4)
+    ws.insert(3, b"XXXXXXX")      # [3,10) bridges both
+    assert len(ws.pages) == 1
+    start, buf = ws.pages[0]
+    assert start == 0
+    assert bytes(buf) == b"aaaXXXXXXXbbb" + b"b"
+    ws.clip(5)
+    assert bytes(ws.pages[0][1]) == b"aaaXX"
+
+
+def test_concurrent_chunk_posts_lose_nothing(fs):
+    """Code-review regression: concurrent /__chunk__/ posts to one
+    path are read-modify-write cycles that must not drop each
+    other's chunks (filer-side striped path locks)."""
+    import threading
+    w, filer = fs
+    filer.filer.write_file("/docs/conc.bin", b"")
+    errs = []
+
+    def post(i):
+        try:
+            filer.filer.append_chunks("/docs/conc.bin", i * 1000,
+                                      bytes([i]) * 1000)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=post, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    final = filer.filer.read_file("/docs/conc.bin")
+    assert len(final) == 8000
+    for i in range(8):
+        assert final[i * 1000:(i + 1) * 1000] == bytes([i]) * 1000
