@@ -189,7 +189,12 @@ class Identity:
             # migration: an older save serialized actions as
             # static ∪ policy-derived; re-deriving and subtracting
             # keeps policy grants revocable (DeleteUserPolicy must
-            # not leave them baked into the static set forever)
+            # not leave them baked into the static set forever).
+            # Ambiguity: a static grant that COINCIDES with a policy
+            # grant is also subtracted and will disappear when that
+            # policy is deleted — fail-closed (losing a grant) is
+            # preferred over fail-open (an irrevocable one); re-grant
+            # via UpdateUser if that static action was intended
             try:
                 from .iamapi import policy_to_actions
                 derived = set()

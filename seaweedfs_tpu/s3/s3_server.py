@@ -154,6 +154,7 @@ class S3ApiServer:
         decision = None
         action = action_for(req.method, bucket, key, req.query)
         arn = resource_arn(bucket, key)
+        pctx = self._policy_context(req)
         ident_obj = None          # iam.Identity once resolved
         if self.verifier is not None:
             ok, who, ctx = self.verifier.verify(
@@ -175,17 +176,22 @@ class S3ApiServer:
                 # open this resource (public-read buckets)
                 anon = self.iam.anonymous() if self.iam else None
                 decision = evaluate(stmts, "anonymous", action,
-                                    arn) if stmts else None
+                                    arn, pctx) if stmts else None
                 if decision == "Deny":
                     # explicit policy Deny binds the anonymous
                     # identity too — it can widen access, never
                     # override a Deny
                     return _error(403, "AccessDenied",
                                   "denied by bucket policy")
-                if decision != "Allow" and anon is None:
+                acl_open = decision != "Allow" and anon is None and \
+                    self._acl_allows(bucket, key, action, False)
+                if decision != "Allow" and anon is None and \
+                        not acl_open:
                     return _error(403, "AccessDenied", who)
                 identity = "anonymous"
                 ident_obj = anon
+                if acl_open:
+                    decision = "Allow"   # canned-ACL grant
         if self.iam is not None and self.verifier is not None and \
                 decision != "Allow":
             # first authorization layer: coarse identity actions
@@ -201,12 +207,20 @@ class S3ApiServer:
             elif ident_obj is None or not ident_obj.can_do(
                     coarse_action(action, req.method, req.query),
                     bucket, key):
-                return _error(403, "AccessDenied",
-                              f"{identity} may not "
-                              f"{coarse_action(action)} {bucket}")
+                # canned ACLs (authenticated-read / public-*) can
+                # still open reads to identities with no grant —
+                # "authenticated" means a real signed principal, NOT
+                # the anonymous fallback identity
+                if not self._acl_allows(bucket, key, action,
+                                        identity != "anonymous"):
+                    return _error(403, "AccessDenied",
+                                  f"{identity} may not "
+                                  f"{coarse_action(action)} {bucket}")
             req.s3_identity_obj = ident_obj
         if stmts and decision is None:
-            if evaluate(stmts, identity, action, arn) == "Deny":
+            pctx["aws:username"] = identity
+            if evaluate(stmts, identity, action, arn,
+                        pctx) == "Deny":
                 # explicit Deny beats a valid signature
                 return _error(403, "AccessDenied",
                               "denied by bucket policy")
@@ -265,6 +279,126 @@ class S3ApiServer:
             return _error(403, "AccessForbidden",
                           "CORSResponse: no matching rule")
         return 200, (b"", headers)
+
+    # -- ACLs (s3api_acp.go / s3acl; canned grants) -----------------------
+
+    CANNED_ACLS = ("private", "public-read", "public-read-write",
+                   "authenticated-read")
+    _READ_ACTIONS = {"s3:GetObject", "s3:GetObjectVersion",
+                     "s3:HeadObject", "s3:ListBucket",
+                     "s3:ListBucketVersions"}
+    _WRITE_ACTIONS = {"s3:PutObject", "s3:DeleteObject",
+                      "s3:DeleteObjectVersion"}
+
+    def _stored_acl(self, bucket: str, key: str = "") -> str:
+        """Effective canned ACL: the object's own, else the bucket's
+        (the reference consults both, object first)."""
+        if key:
+            e = self.filer.find_entry(
+                f"{self._bucket_path(bucket)}/{key}")
+            if e is not None and e.extended.get("acl"):
+                return e.extended["acl"]
+        e = self.filer.find_entry(self._bucket_path(bucket))
+        return (e.extended.get("acl") if e else "") or "private"
+
+    def _acl_allows(self, bucket: str, key: str, action: str,
+                    authenticated: bool) -> bool:
+        """Does the canned ACL open this request to a principal with
+        no other grant? (public-read / public-read-write /
+        authenticated-read semantics)."""
+        if not bucket:
+            return False
+        acl = self._stored_acl(bucket, key)
+        if acl == "public-read-write":
+            return action in self._READ_ACTIONS | self._WRITE_ACTIONS
+        if acl == "public-read":
+            return action in self._READ_ACTIONS
+        if acl == "authenticated-read":
+            return authenticated and action in self._READ_ACTIONS
+        return False
+
+    def _acl_op(self, req: Request, bucket: str, key: str):
+        """Get/Put{Bucket,Object}Acl (?acl): canned ACLs via the
+        x-amz-acl header; GET renders the grant set the canned value
+        implies (s3api_acp.go)."""
+        path = f"{self._bucket_path(bucket)}/{key}" if key else \
+            self._bucket_path(bucket)
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            return _error(404, "NoSuchKey" if key else "NoSuchBucket",
+                          key or bucket)
+        if req.method == "PUT":
+            canned = req.headers.get("x-amz-acl", "")
+            if not canned and req.body:
+                # grant-body form: accept only documents expressing a
+                # canned set; arbitrary grantees are out of scope
+                return _error(501, "NotImplemented",
+                              "only canned ACLs (x-amz-acl) are "
+                              "supported")
+            canned = canned or "private"
+            if canned not in self.CANNED_ACLS:
+                return _error(400, "InvalidArgument",
+                              f"unsupported ACL {canned!r}")
+            entry.extended["acl"] = canned
+            self.filer.create_entry(entry, create_parents=False)
+            return 200, (b"", {})
+        if req.method != "GET":
+            return _error(405, "MethodNotAllowed", req.method)
+        acl = entry.extended.get("acl", "") or "private"
+        root = ET.Element("AccessControlPolicy", xmlns=S3_NS)
+        owner = _elem(root, "Owner")
+        _elem(owner, "ID", "seaweedfs-tpu")
+        grants = _elem(root, "AccessControlList")
+
+        def grant(grantee_uri, permission):
+            g = _elem(grants, "Grant")
+            ge = _elem(g, "Grantee")
+            ge.set("{http://www.w3.org/2001/XMLSchema-instance}type",
+                   "Group" if grantee_uri else "CanonicalUser")
+            if grantee_uri:
+                _elem(ge, "URI", grantee_uri)
+            else:
+                _elem(ge, "ID", "seaweedfs-tpu")
+            _elem(g, "Permission", permission)
+
+        grant("", "FULL_CONTROL")
+        groups = "http://acs.amazonaws.com/groups/global/"
+        if acl in ("public-read", "public-read-write"):
+            grant(groups + "AllUsers", "READ")
+        if acl == "public-read-write":
+            grant(groups + "AllUsers", "WRITE")
+        if acl == "authenticated-read":
+            grant(groups + "AuthenticatedUsers", "READ")
+        return 200, (_xml(root), "application/xml")
+
+    @staticmethod
+    def _policy_context(req: Request) -> dict:
+        """Per-request condition context (policy_engine/engine.go
+        buildConditionContext): the keys Condition blocks evaluate
+        against."""
+        from .. import security
+        ctx = {
+            "aws:SourceIp": req.remote_ip,
+            "aws:SecureTransport":
+                "true" if security.current().tls else "false",
+            "aws:CurrentTime": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        ua = req.headers.get("User-Agent")
+        if ua:
+            ctx["aws:UserAgent"] = ua
+        referer = req.headers.get("Referer")
+        if referer:
+            ctx["aws:Referer"] = referer
+        for qk, ck in (("prefix", "s3:prefix"),
+                       ("delimiter", "s3:delimiter"),
+                       ("max-keys", "s3:max-keys")):
+            if qk in req.query:
+                ctx[ck] = req.query[qk]
+        acl = req.headers.get("x-amz-acl")
+        if acl:
+            ctx["s3:x-amz-acl"] = acl
+        return ctx
 
     def _policy_rules(self, bucket: str) -> list:
         from .policy import PolicyError, parse_policy
@@ -551,12 +685,21 @@ class S3ApiServer:
             return self._bucket_policy_op(req, bucket)
         if "cors" in req.query:
             return self._bucket_cors_op(req, bucket)
+        if "acl" in req.query:
+            return self._acl_op(req, bucket, "")
         if "versions" in req.query and req.method == "GET":
             if self.filer.find_entry(path) is None:
                 return _error(404, "NoSuchBucket", bucket)
             return self._list_versions(req, bucket)
         if req.method == "PUT":
-            self.filer.create_entry(Entry(path, is_directory=True))
+            # idempotent re-PUT must keep the existing entry: a fresh
+            # Entry would wipe extended (policy/cors/acl configs)
+            e = self.filer.find_entry(path) or \
+                Entry(path, is_directory=True)
+            canned = req.headers.get("x-amz-acl", "")
+            if canned in self.CANNED_ACLS:
+                e.extended["acl"] = canned
+            self.filer.create_entry(e)
             return 200, b""
         if req.method == "HEAD":
             if self.filer.find_entry(path) is None:
@@ -591,6 +734,8 @@ class S3ApiServer:
             return _error(400, "InvalidArgument",
                           f"key may not contain a segment ending "
                           f"{VERSIONS_EXT}")
+        if "acl" in req.query:
+            return self._acl_op(req, bucket, key)
         if "select" in req.query and req.method == "POST":
             return self._select_object(req, bucket, key)
         if "uploads" in req.query or "uploadId" in req.query:
@@ -662,6 +807,9 @@ class S3ApiServer:
                 amz = {k: v for k, v in req.headers.items()
                        if k.lower().startswith("x-amz-meta-")}
                 entry.extended.update(amz)
+                canned = req.headers.get("x-amz-acl", "")
+                if canned in self.CANNED_ACLS:
+                    entry.extended["acl"] = canned
                 self.filer.create_entry(entry)
             headers = {"ETag": f'"{etag}"'}
             headers.update(kms_headers)

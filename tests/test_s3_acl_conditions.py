@@ -1,0 +1,306 @@
+"""S3 policy Condition evaluation + canned ACLs (reference:
+s3api/policy_engine/conditions.go, s3api_acp.go)."""
+
+import json
+import time
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.iam import Credential, Identity, IdentityStore
+from seaweedfs_tpu.s3 import S3ApiServer
+from seaweedfs_tpu.s3.auth import sign_request
+from seaweedfs_tpu.s3.policy import (PolicyError, evaluate,
+                                     parse_policy)
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+# -- unit: condition operators ---------------------------------------------
+
+def _stmts(condition, effect="Allow", principal="*",
+           action="s3:GetObject", resource="arn:aws:s3:::b/*"):
+    return parse_policy(json.dumps({"Statement": [{
+        "Effect": effect, "Principal": principal, "Action": action,
+        "Resource": resource, "Condition": condition}]}).encode())
+
+
+def test_condition_ip_address():
+    stmts = _stmts({"IpAddress": {"aws:SourceIp": "10.0.0.0/8"}})
+    ctx = {"aws:SourceIp": "10.1.2.3"}
+    assert evaluate(stmts, "*", "s3:GetObject", "arn:aws:s3:::b/k",
+                    ctx) == "Allow"
+    ctx = {"aws:SourceIp": "192.168.1.1"}
+    assert evaluate(stmts, "*", "s3:GetObject", "arn:aws:s3:::b/k",
+                    ctx) is None
+    # NotIpAddress inverts
+    stmts = _stmts({"NotIpAddress": {"aws:SourceIp": "10.0.0.0/8"}},
+                   effect="Deny")
+    assert evaluate(stmts, "*", "s3:GetObject", "arn:aws:s3:::b/k",
+                    {"aws:SourceIp": "8.8.8.8"}) == "Deny"
+    assert evaluate(stmts, "*", "s3:GetObject", "arn:aws:s3:::b/k",
+                    {"aws:SourceIp": "10.0.0.1"}) is None
+
+
+def test_condition_string_and_like():
+    stmts = _stmts({"StringEquals": {"aws:username": ["alice",
+                                                     "bob"]}})
+    assert evaluate(stmts, "*", "s3:GetObject", "arn:aws:s3:::b/k",
+                    {"aws:username": "bob"}) == "Allow"
+    assert evaluate(stmts, "*", "s3:GetObject", "arn:aws:s3:::b/k",
+                    {"aws:username": "eve"}) is None
+    stmts = _stmts({"StringLike": {"aws:Referer":
+                                   "https://example.com/*"}})
+    assert evaluate(stmts, "*", "s3:GetObject", "arn:aws:s3:::b/k",
+                    {"aws:Referer": "https://example.com/p"}) == \
+        "Allow"
+    # absent key fails positive operators...
+    assert evaluate(stmts, "*", "s3:GetObject", "arn:aws:s3:::b/k",
+                    {}) is None
+    # ...but passes with IfExists
+    stmts = _stmts({"StringLikeIfExists": {"aws:Referer": "x*"}})
+    assert evaluate(stmts, "*", "s3:GetObject", "arn:aws:s3:::b/k",
+                    {}) == "Allow"
+
+
+def test_condition_numeric_date_bool_null():
+    stmts = _stmts({"NumericLessThanEquals": {"s3:max-keys": "100"}})
+    assert evaluate(stmts, "*", "s3:GetObject", "arn:aws:s3:::b/k",
+                    {"s3:max-keys": "50"}) == "Allow"
+    assert evaluate(stmts, "*", "s3:GetObject", "arn:aws:s3:::b/k",
+                    {"s3:max-keys": "500"}) is None
+    stmts = _stmts({"DateGreaterThan":
+                    {"aws:CurrentTime": "2020-01-01T00:00:00Z"}})
+    assert evaluate(stmts, "*", "s3:GetObject", "arn:aws:s3:::b/k",
+                    {"aws:CurrentTime": "2026-07-30T00:00:00Z"}) == \
+        "Allow"
+    stmts = _stmts({"Bool": {"aws:SecureTransport": "false"}})
+    assert evaluate(stmts, "*", "s3:GetObject", "arn:aws:s3:::b/k",
+                    {"aws:SecureTransport": "false"}) == "Allow"
+    stmts = _stmts({"Null": {"aws:Referer": "true"}})
+    assert evaluate(stmts, "*", "s3:GetObject", "arn:aws:s3:::b/k",
+                    {}) == "Allow"
+    assert evaluate(stmts, "*", "s3:GetObject", "arn:aws:s3:::b/k",
+                    {"aws:Referer": "x"}) is None
+
+
+def test_unknown_operator_rejected_at_parse():
+    with pytest.raises(PolicyError):
+        _stmts({"FancyNewOperator": {"k": "v"}})
+
+
+# -- integration -----------------------------------------------------------
+
+@pytest.fixture
+def gw(tmp_path):
+    master = MasterServer().start()
+    vols = [VolumeServer([str(tmp_path / f"v{i}")], master.url,
+                         pulse_seconds=0.3).start() for i in range(2)]
+    time.sleep(0.5)
+    filer = FilerServer(master.url).start()
+    store = IdentityStore()
+    store.put(Identity("root", [Credential("ADMINKEY",
+                                           "adminsecret")],
+                       actions=["Admin"]))
+    store.put(Identity("limited",
+                       [Credential("LIMKEY", "limsecret")],
+                       actions=["Read:own"]))
+    srv = S3ApiServer(filer.filer, iam=store).start()
+    yield srv
+    srv.stop()
+    filer.stop()
+    for vs in vols:
+        vs.stop()
+    master.stop()
+
+
+def _signed(gw, method, path, body=b"", access="ADMINKEY",
+            secret="adminsecret", headers=None, query=None):
+    headers = dict(headers or {})
+    q = dict(query or {})
+    signed = sign_request(method, gw.url, path, q, headers, body,
+                          access, secret)
+    qs = ("?" + urllib.parse.urlencode(q)) if q else ""
+    req = urllib.request.Request(
+        f"http://{gw.url}{urllib.parse.quote(path)}{qs}",
+        data=body or None, method=method, headers=signed)
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _anon(gw, method, path, headers=None):
+    req = urllib.request.Request(
+        f"http://{gw.url}{urllib.parse.quote(path)}",
+        method=method, headers=dict(headers or {}))
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def test_policy_condition_enforced_per_request(gw):
+    """A policy that opens anonymous reads only from 10.0.0.0/8 must
+    refuse our 127.0.0.1 requests; switching the CIDR to 127.0.0.0/8
+    opens them."""
+    assert _signed(gw, "PUT", "/cond")[0] == 200
+    assert _signed(gw, "PUT", "/cond/f.txt", b"guarded")[0] == 200
+
+    def set_policy(cidr):
+        doc = json.dumps({"Statement": [{
+            "Effect": "Allow", "Principal": "*",
+            "Action": "s3:GetObject",
+            "Resource": "arn:aws:s3:::cond/*",
+            "Condition": {"IpAddress": {"aws:SourceIp": cidr}}}]})
+        st, _, _ = _signed(gw, "PUT", "/cond", doc.encode(),
+                           query={"policy": ""})
+        assert st in (200, 204)
+
+    set_policy("10.0.0.0/8")
+    assert _anon(gw, "GET", "/cond/f.txt")[0] == 403
+    set_policy("127.0.0.0/8")
+    st, body, _ = _anon(gw, "GET", "/cond/f.txt")
+    assert (st, body) == (200, b"guarded")
+
+
+def test_referer_condition(gw):
+    assert _signed(gw, "PUT", "/ref")[0] == 200
+    assert _signed(gw, "PUT", "/ref/img.png", b"png")[0] == 200
+    doc = json.dumps({"Statement": [{
+        "Effect": "Allow", "Principal": "*",
+        "Action": "s3:GetObject", "Resource": "arn:aws:s3:::ref/*",
+        "Condition": {"StringLike":
+                      {"aws:Referer": "https://mysite.example/*"}}}]})
+    st, _, _ = _signed(gw, "PUT", "/ref", doc.encode(),
+                       query={"policy": ""})
+    assert st in (200, 204)
+    assert _anon(gw, "GET", "/ref/img.png")[0] == 403
+    st, body, _ = _anon(gw, "GET", "/ref/img.png",
+                        {"Referer": "https://mysite.example/page"})
+    assert (st, body) == (200, b"png")
+
+
+def test_canned_acl_public_read(gw):
+    assert _signed(gw, "PUT", "/pub",
+                   headers={"x-amz-acl": "public-read"})[0] == 200
+    assert _signed(gw, "PUT", "/pub/o.txt", b"open")[0] == 200
+    # anonymous read allowed, write still denied
+    assert _anon(gw, "GET", "/pub/o.txt")[1] == b"open"
+    req = urllib.request.Request(f"http://{gw.url}/pub/evil.txt",
+                                 data=b"x", method="PUT")
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            code = r.status
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code == 403
+    # GET ?acl renders the grant set
+    st, body, _ = _signed(gw, "GET", "/pub", query={"acl": ""})
+    assert st == 200
+    assert b"AllUsers" in body and b"READ" in body
+    # flipping back to private closes it
+    st, _, _ = _signed(gw, "PUT", "/pub", query={"acl": ""},
+                       headers={"x-amz-acl": "private"})
+    assert st == 200
+    assert _anon(gw, "GET", "/pub/o.txt")[0] == 403
+
+
+def test_object_level_acl_overrides_bucket(gw):
+    assert _signed(gw, "PUT", "/mixed")[0] == 200
+    assert _signed(gw, "PUT", "/mixed/private.txt", b"p")[0] == 200
+    assert _signed(gw, "PUT", "/mixed/shared.txt", b"s",
+                   headers={"x-amz-acl": "public-read"})[0] == 200
+    assert _anon(gw, "GET", "/mixed/shared.txt")[1] == b"s"
+    assert _anon(gw, "GET", "/mixed/private.txt")[0] == 403
+
+
+def test_authenticated_read_acl(gw):
+    assert _signed(gw, "PUT", "/authread",
+                   headers={"x-amz-acl":
+                            "authenticated-read"})[0] == 200
+    assert _signed(gw, "PUT", "/authread/f.txt", b"members")[0] == 200
+    # the limited identity has no grant on this bucket, but it IS
+    # authenticated — authenticated-read opens reads
+    st, body, _ = _signed(gw, "GET", "/authread/f.txt",
+                          access="LIMKEY", secret="limsecret")
+    assert (st, body) == (200, b"members")
+    # writes stay closed
+    assert _signed(gw, "PUT", "/authread/w.txt", b"x",
+                   access="LIMKEY", secret="limsecret")[0] == 403
+    # anonymous stays closed
+    assert _anon(gw, "GET", "/authread/f.txt")[0] == 403
+
+
+def test_multi_value_numeric_condition():
+    stmts = _stmts({"NumericEquals": {"s3:max-keys": ["100", "200"]}})
+    for v, want in (("100", "Allow"), ("200", "Allow"),
+                    ("150", None)):
+        assert evaluate(stmts, "*", "s3:GetObject",
+                        "arn:aws:s3:::b/k",
+                        {"s3:max-keys": v}) == want
+
+
+def test_acl_ops_are_not_plain_reads_or_writes(gw):
+    """Code-review regression: ?acl maps to Get/Put*Acl actions, so a
+    public-read-write ACL must NOT let anonymous clients rewrite
+    ACLs, and GET ?acl is not opened by plain read grants."""
+    assert _signed(gw, "PUT", "/wideopen",
+                   headers={"x-amz-acl":
+                            "public-read-write"})[0] == 200
+    assert _signed(gw, "PUT", "/wideopen/o.txt", b"x")[0] == 200
+    # anonymous content write IS open (that's what the ACL says)...
+    req = urllib.request.Request(f"http://{gw.url}/wideopen/anon.txt",
+                                 data=b"ok", method="PUT")
+    with urllib.request.urlopen(req, timeout=15) as r:
+        assert r.status == 200
+    # ...but anonymous ACL mutation is NOT
+    req = urllib.request.Request(
+        f"http://{gw.url}/wideopen/o.txt?acl", data=b"",
+        method="PUT", headers={"x-amz-acl": "private"})
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            code = r.status
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code == 403
+
+
+def test_authenticated_read_closed_to_anonymous_identity(gw, tmp_path):
+    """Code-review regression: an 'anonymous' IAM identity must not
+    satisfy authenticated-read."""
+    from seaweedfs_tpu.iam import Identity as I
+    gw.iam.put(I("anonymous", actions=[]))
+    try:
+        assert _signed(gw, "PUT", "/members",
+                       headers={"x-amz-acl":
+                                "authenticated-read"})[0] == 200
+        assert _signed(gw, "PUT", "/members/f.txt", b"m")[0] == 200
+        assert _anon(gw, "GET", "/members/f.txt")[0] == 403
+    finally:
+        gw.iam.delete("anonymous")
+
+
+def test_bucket_reput_preserves_configs(gw):
+    """Code-review regression: idempotent `PUT /bucket` must not wipe
+    policy/CORS/ACL stored on the bucket entry."""
+    assert _signed(gw, "PUT", "/keep",
+                   headers={"x-amz-acl": "public-read"})[0] == 200
+    doc = json.dumps({"Statement": [{
+        "Effect": "Deny", "Principal": "*",
+        "Action": "s3:DeleteObject",
+        "Resource": "arn:aws:s3:::keep/*"}]})
+    st, _, _ = _signed(gw, "PUT", "/keep", doc.encode(),
+                       query={"policy": ""})
+    assert st in (200, 204)
+    # re-PUT the bucket (ensure-exists pattern)
+    assert _signed(gw, "PUT", "/keep")[0] == 200
+    st, body, _ = _signed(gw, "GET", "/keep", query={"policy": ""})
+    assert st == 200 and b"DeleteObject" in body
+    st, body, _ = _signed(gw, "GET", "/keep", query={"acl": ""})
+    assert b"AllUsers" in body
