@@ -24,9 +24,16 @@ JSON-HTTP mirror of the broker gRPC surface (pb/mq_broker.proto):
   POST /topics/flush {namespace, topic} — force segment flush (tests,
          graceful shutdown).
 
-Single-broker ownership of all partitions for now; the DATA model
-(ring-range partitions + filer-persisted layout) is the multi-broker
-contract — assignment/balancing (pub_balancer/) is the next widening.
+Multi-broker (mq/pub_balancer/ analog over our shared-filer plane):
+brokers register heartbeat files under /topics/.brokers/; configure
+allocates partitions round-robin across LIVE brokers and persists the
+assignment in topic.conf; publish/subscribe for a partition another
+broker owns answer 409 {"owner": addr} and the client re-dials; when
+an owner's heartbeat goes stale, the broker asked next TAKES OVER the
+partition (rewrites the assignment) — safe because partition logs
+live in the filer, so ownership is coordination, not data placement.
+The acked-but-unflushed tail of a crashed owner (≤ flush_interval) is
+lost, the same crash semantics as single-broker.
 """
 
 from __future__ import annotations
@@ -34,6 +41,7 @@ from __future__ import annotations
 import base64
 import json
 import threading
+import time
 import urllib.parse
 
 from ..server.httpd import HttpServer, Request, http_bytes
@@ -41,6 +49,7 @@ from .logstore import PartitionLog
 from .topic import Partition, Topic, partition_for_key, split_ring
 
 OFFSETS_DIR = "/topics/.offsets"
+BROKERS_DIR = "/topics/.brokers"
 
 
 class NameError_(ValueError):
@@ -56,11 +65,19 @@ def _check_name(kind: str, name: str) -> None:
 
 
 class BrokerServer:
+    # a broker whose heartbeat is older than this is dead for
+    # assignment/takeover purposes (pub_balancer liveness analog)
+    BROKER_TTL = 5.0
+
     def __init__(self, filer: str, host: str = "127.0.0.1",
                  port: int = 0, flush_interval: float = 1.0):
         self.filer = filer
         self.http = HttpServer(host, port)
         self._topics: dict[Topic, list[Partition]] = {}
+        # parallel to _topics: owning broker address per partition
+        self._owners: dict[Topic, list[str]] = {}
+        self._conf_loaded: dict[Topic, float] = {}
+        self._live_cache: tuple[float, list[str]] = (0.0, [])
         self._logs: dict[tuple[Topic, Partition], PartitionLog] = {}
         self._lock = threading.Lock()
         # serializes configure's load-check-persist-cache sequence
@@ -87,21 +104,70 @@ class BrokerServer:
 
     def start(self) -> "BrokerServer":
         self.http.start()
+        self._heartbeat()
         self._flush_thread = threading.Thread(target=self._flush_loop,
                                               daemon=True)
         self._flush_thread.start()
         return self
+
+    # -- broker registry (pub_balancer AddBroker/RemoveBroker) ------------
+
+    def _heartbeat(self) -> None:
+        try:
+            http_bytes("POST",
+                       f"{self.filer}{BROKERS_DIR}/{self.url}",
+                       json.dumps({"ts": time.time()}).encode())
+        except OSError:
+            pass  # next tick
+
+    def _live_brokers(self) -> list[str]:
+        """Registry entries with fresh heartbeats, briefly cached
+        (publish-path takeover checks must not hammer the filer)."""
+        now = time.monotonic()
+        ts, cached = self._live_cache
+        if now - ts < 1.0:
+            return cached
+        live = []
+        try:
+            st, body, _ = http_bytes(
+                "GET", f"{self.filer}{BROKERS_DIR}/?limit=1000")
+            if st == 200:
+                cutoff = time.time() - self.BROKER_TTL
+                for e in json.loads(body).get("entries", []):
+                    if e.get("isDirectory"):
+                        continue
+                    addr = e["fullPath"].rsplit("/", 1)[-1]
+                    if e.get("attributes", {}).get("mtime",
+                                                   0) >= cutoff:
+                        live.append(addr)
+        except (OSError, ValueError):
+            pass
+        if self.url not in live:
+            live.append(self.url)   # we are definitionally alive
+        live.sort()
+        self._live_cache = (now, live)
+        return live
 
     def stop(self) -> None:
         # stop accepting requests FIRST: a publish acked after the
         # flush loop but before http shutdown would be lost
         self.http.stop()
         self._stop_event.set()
+        # join BEFORE deregistering: a heartbeat racing past the
+        # event check would re-register us after the DELETE
+        if self._flush_thread is not None:
+            self._flush_thread.join(timeout=10)
         self._flush_all()
+        try:    # deregister so peers take over without waiting TTL
+            http_bytes("DELETE",
+                       f"{self.filer}{BROKERS_DIR}/{self.url}")
+        except OSError:
+            pass
 
     def _flush_loop(self) -> None:
         while not self._stop_event.wait(self._flush_interval):
             self._flush_all()
+            self._heartbeat()
 
     def _flush_all(self) -> None:
         with self._lock:
@@ -121,13 +187,22 @@ class BrokerServer:
     def _conf_path(self, t: Topic) -> str:
         return f"{t.dir}/topic.conf"
 
-    def _load_layout(self, t: Topic) -> "list[Partition] | None":
+    # how long a cached topic.conf (and its ownership column) stays
+    # authoritative; peers\' takeovers become visible within this —
+    # the split-brain window of the registry-based coordination (a
+    # cluster lock would close it; documented divergence)
+    CONF_TTL = 2.0
+
+    def _load_layout(self, t: Topic, fresh: bool = False
+                     ) -> "list[Partition] | None":
         """None means CONFIRMED not-configured (filer 404).  A filer
         error raises — conflating it with 'not configured' would let
         _configure overwrite an existing layout during a filer blip,
         silently re-routing every stored key."""
         with self._lock:
-            if t in self._topics:
+            if not fresh and t in self._topics and \
+                    time.monotonic() - self._conf_loaded.get(t, 0) \
+                    < self.CONF_TTL:
                 return self._topics[t]
         st, body, _ = http_bytes(
             "GET", self.filer + urllib.parse.quote(self._conf_path(t)))
@@ -135,11 +210,65 @@ class BrokerServer:
             return None
         if st != 200:
             raise RuntimeError(f"filer {self.filer} topic.conf: {st}")
-        parts = [Partition.from_json(p)
-                 for p in json.loads(body)["partitions"]]
+        raw = json.loads(body)["partitions"]
+        parts = [Partition.from_json(p) for p in raw]
+        # pre-assignment confs carry no broker field: self-owned
+        owners = [p.get("broker") or self.url for p in raw]
         with self._lock:
             self._topics[t] = parts
+            self._owners[t] = owners
+            self._conf_loaded[t] = time.monotonic()
         return parts
+
+    def _persist_layout(self, t: Topic, parts: "list[Partition]",
+                        owners: "list[str]") -> "str | None":
+        doc = [dict(p.to_json(), broker=o)
+               for p, o in zip(parts, owners)]
+        st, _, _ = http_bytes(
+            "POST", self.filer + urllib.parse.quote(self._conf_path(t)),
+            json.dumps({"partitions": doc}).encode())
+        if st >= 300:
+            return f"persist layout: {st}"
+        with self._lock:
+            self._topics[t] = parts
+            self._owners[t] = list(owners)
+            self._conf_loaded[t] = time.monotonic()
+        return None
+
+    def _owner_gate(self, t: Topic, parts: "list[Partition]",
+                    idx: int) -> "tuple[int, dict] | None":
+        """None when this broker may serve partition idx (it owns it,
+        or it just took over from a dead owner); otherwise the
+        redirect response.  Takeover rule (pub_balancer repair.go
+        shape): the owner must be absent from the live registry."""
+        with self._lock:
+            owners = self._owners.get(t) or [self.url] * len(parts)
+            owner = owners[idx] if idx < len(owners) else self.url
+        if owner == self.url:
+            return None
+        if owner in self._live_brokers():
+            return 409, {"error": "not owner", "owner": owner,
+                         "partition": idx}
+        # owner is dead: take the partition over.  Re-read the conf
+        # FRESH first — a peer may have already claimed it, and
+        # rewriting from a stale cache would clobber their claim
+        with self._conf_lock:
+            try:
+                self._load_layout(t, fresh=True)
+            except RuntimeError as e:
+                return 503, {"error": str(e)}
+            with self._lock:
+                owners = list(self._owners.get(t) or
+                              [self.url] * len(parts))
+            if owners[idx] == owner:     # still the dead one
+                owners[idx] = self.url
+                err = self._persist_layout(t, parts, owners)
+                if err:
+                    return 503, {"error": err}
+            elif owners[idx] != self.url:
+                return 409, {"error": "not owner",
+                             "owner": owners[idx], "partition": idx}
+        return None
 
     def _topic_from(self, ns: str, name: str) -> Topic:
         _check_name("namespace", ns)
@@ -169,15 +298,13 @@ class BrokerServer:
                 return 200, {"partitions":
                              [p.to_json() for p in existing]}
             parts = split_ring(n)
-            body = json.dumps({"partitions":
-                               [p.to_json() for p in parts]}).encode()
-            st, resp, _ = http_bytes(
-                "POST", self.filer +
-                urllib.parse.quote(self._conf_path(t)), body)
-            if st >= 300:
-                return 500, {"error": f"persist layout: {st}"}
-            with self._lock:
-                self._topics[t] = parts
+            # round-robin allocation across live brokers
+            # (pub_balancer/allocate.go AllocateTopicPartitions)
+            live = self._live_brokers()
+            owners = [live[i % len(live)] for i in range(n)]
+            err = self._persist_layout(t, parts, owners)
+            if err:
+                return 500, {"error": err}
         return 200, {"partitions": [p.to_json() for p in parts]}
 
     def _list_topics(self, req: Request):
@@ -212,9 +339,11 @@ class BrokerServer:
             return 503, {"error": str(e)}
         if parts is None:
             return 404, {"error": f"topic {t} not configured"}
+        with self._lock:
+            owners = self._owners.get(t) or [self.url] * len(parts)
         return 200, {"topic": str(t), "assignments": [
-            {"partition": p.to_json(), "broker": self.url}
-            for p in parts]}
+            {"partition": p.to_json(), "broker": o}
+            for p, o in zip(parts, owners)]}
 
     def _log_for(self, t: Topic, p: Partition) -> PartitionLog:
         with self._lock:
@@ -249,6 +378,9 @@ class BrokerServer:
             key = base64.b64decode(b.get("key", "")) if b.get("key") \
                 else b""
             p = partition_for_key(key, parts)
+        redirect = self._owner_gate(t, parts, parts.index(p))
+        if redirect is not None:
+            return redirect
         ts = self._log_for(t, p).append(
             b.get("key", ""), b.get("value", ""),
             int(b.get("tsNs", 0)))
@@ -273,6 +405,9 @@ class BrokerServer:
         if not 0 <= idx < len(parts):
             return 400, {"error": f"partition index {idx} out of "
                                   f"range 0..{len(parts) - 1}"}
+        redirect = self._owner_gate(t, parts, idx)
+        if redirect is not None:
+            return redirect
         records = [(m.get("key", ""), m.get("value", ""),
                     int(m.get("tsNs", 0)))
                    for m in b.get("messages", [])]
@@ -297,6 +432,9 @@ class BrokerServer:
         if not 0 <= idx < len(parts):
             return 400, {"error": f"partition index {idx} out of "
                                   f"range 0..{len(parts) - 1}"}
+        redirect = self._owner_gate(t, parts, idx)
+        if redirect is not None:
+            return redirect
         log = self._log_for(t, parts[idx])
         msgs = log.read_since(since, limit)
         return 200, {"partition": parts[idx].to_json(),

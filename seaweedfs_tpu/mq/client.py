@@ -22,8 +22,45 @@ class Message:
 
 
 class MQClient:
+    """Follows multi-broker partition ownership transparently: a
+    broker answering {"error": "not owner", "owner": addr} gets the
+    request re-dialed to the owner (pub_client's
+    LookupTopicBrokers-then-connect, collapsed into redirects)."""
+
+    MAX_HOPS = 8
+
     def __init__(self, broker: str):
         self.broker = broker
+
+    def _call(self, method: str, path_qs: str,
+              body: "dict | None" = None) -> dict:
+        """Request against the seed broker, following ownership
+        redirects.  A redirect target that turns out dead (crashed
+        between the seed's liveness snapshot and our dial) falls back
+        to the seed, which will take the partition over once its
+        1s-TTL registry cache expires."""
+        import time as _time
+        target = self.broker
+        deadline = _time.monotonic() + 8.0
+        hops = 0
+        r = {"error": "unreachable"}
+        while _time.monotonic() < deadline:
+            try:
+                r = http_json(method, f"{target}{path_qs}", body)
+            except OSError:
+                if target == self.broker:
+                    raise          # seed itself is down: surface it
+                target = self.broker
+                _time.sleep(0.4)   # let the seed notice the death
+                continue
+            if r.get("error") == "not owner" and r.get("owner"):
+                hops += 1
+                if r["owner"] == target or hops > self.MAX_HOPS:
+                    return r       # ping-pong: give up with the error
+                target = r["owner"]
+                continue
+            return r
+        return r
 
     def configure_topic(self, namespace: str, topic: str,
                         partition_count: int = 4) -> int:
@@ -52,7 +89,7 @@ class MQClient:
                 "value": base64.b64encode(value).decode()}
         if partition is not None:
             body["partition"] = partition
-        r = http_json("POST", f"{self.broker}/topics/publish", body)
+        r = self._call("POST", "/topics/publish", body)
         if "error" in r:
             raise RuntimeError(f"publish: {r['error']}")
         return int(r["tsNs"])
@@ -69,7 +106,7 @@ class MQClient:
                       ) -> list[int]:
         """Atomic multi-publish to one partition; returns the
         assigned offsets in order."""
-        r = http_json("POST", f"{self.broker}/topics/publish_batch", {
+        r = self._call("POST", "/topics/publish_batch", {
             "namespace": namespace, "topic": topic,
             "partition": partition,
             "messages": [{"key": base64.b64encode(k).decode(),
@@ -86,10 +123,10 @@ class MQClient:
         """Like subscribe, but also returns the partition's
         high-water-mark tsNs (the Kafka gateway's fetch response
         needs it)."""
-        r = http_json("GET", f"{self.broker}/topics/subscribe?" +
-                      _q(namespace=namespace, topic=topic,
-                         partition=partition, sinceNs=since_ns,
-                         limit=limit))
+        r = self._call("GET", "/topics/subscribe?" +
+                       _q(namespace=namespace, topic=topic,
+                          partition=partition, sinceNs=since_ns,
+                          limit=limit))
         if "error" in r:
             raise RuntimeError(f"subscribe: {r['error']}")
         msgs = [Message(base64.b64decode(m.get("key", "")),

@@ -119,6 +119,8 @@ def _condition_matches(conditions: dict, context: dict) -> bool:
         for key, wanted in block.items():
             wanted = [str(w) for w in (
                 wanted if isinstance(wanted, list) else [wanted])]
+            if not wanted:
+                return False     # defensive: parse rejects this
             got = context.get(key)
             if op == "Null":
                 want_null = wanted[0].lower() == "true"
@@ -179,6 +181,12 @@ def parse_policy(doc: bytes) -> "list[dict]":
                     f"unsupported condition operator {op!r}")
             if not isinstance(conditions[op], dict):
                 raise PolicyError(f"Condition {op} must map keys")
+            for ck, cv in conditions[op].items():
+                if isinstance(cv, list) and not cv:
+                    # an empty value list would crash evaluation
+                    raise PolicyError(
+                        f"Condition {op}/{ck} needs at least one "
+                        f"value")
         principal = s.get("Principal", "*")
         if isinstance(principal, dict):
             unsupported = set(principal) - {"AWS"}

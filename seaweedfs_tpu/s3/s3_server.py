@@ -329,13 +329,18 @@ class S3ApiServer:
                           key or bucket)
         if req.method == "PUT":
             canned = req.headers.get("x-amz-acl", "")
-            if not canned and req.body:
+            if not canned:
                 # grant-body form: accept only documents expressing a
-                # canned set; arbitrary grantees are out of scope
-                return _error(501, "NotImplemented",
-                              "only canned ACLs (x-amz-acl) are "
-                              "supported")
-            canned = canned or "private"
+                # canned set; arbitrary grantees are out of scope.
+                # Neither header nor body is AWS's MissingSecurityHeader
+                # — NOT a silent reset to private
+                return _error(
+                    501 if req.body else 400,
+                    "NotImplemented" if req.body
+                    else "MissingSecurityHeader",
+                    "only canned ACLs (x-amz-acl) are supported"
+                    if req.body else
+                    "PUT ?acl needs an x-amz-acl header")
             if canned not in self.CANNED_ACLS:
                 return _error(400, "InvalidArgument",
                               f"unsupported ACL {canned!r}")
@@ -697,7 +702,12 @@ class S3ApiServer:
             e = self.filer.find_entry(path) or \
                 Entry(path, is_directory=True)
             canned = req.headers.get("x-amz-acl", "")
-            if canned in self.CANNED_ACLS:
+            if canned and canned not in self.CANNED_ACLS:
+                # silently ignoring would store a different ACL than
+                # the client believes it set
+                return _error(400, "InvalidArgument",
+                              f"unsupported ACL {canned!r}")
+            if canned:
                 e.extended["acl"] = canned
             self.filer.create_entry(e)
             return 200, b""
@@ -764,6 +774,12 @@ class S3ApiServer:
                               parse_sse_c_headers,
                               parse_sse_kms_headers)
             lower = {k.lower(): v for k, v in req.headers.items()}
+            canned_acl = req.headers.get("x-amz-acl", "")
+            if canned_acl and canned_acl not in self.CANNED_ACLS:
+                # rejecting beats storing a different ACL than the
+                # client believes it set
+                return _error(400, "InvalidArgument",
+                              f"unsupported ACL {canned_acl!r}")
             kms_headers = {}
             try:
                 sse = parse_sse_c_headers(lower)
@@ -807,9 +823,8 @@ class S3ApiServer:
                 amz = {k: v for k, v in req.headers.items()
                        if k.lower().startswith("x-amz-meta-")}
                 entry.extended.update(amz)
-                canned = req.headers.get("x-amz-acl", "")
-                if canned in self.CANNED_ACLS:
-                    entry.extended["acl"] = canned
+                if canned_acl:
+                    entry.extended["acl"] = canned_acl
                 self.filer.create_entry(entry)
             headers = {"ETag": f'"{etag}"'}
             headers.update(kms_headers)

@@ -200,3 +200,64 @@ def test_segment_flush_and_read_from_filer(cluster):
     assert [m.value for m in msgs] == \
         [b"flushed%d" % i for i in range(5)] + \
         [b"hot%d" % i for i in range(3)]
+
+
+# -- multi-broker (mq/pub_balancer/ analog) --------------------------------
+
+def test_multibroker_assignment_spread(cluster, tmp_path):
+    """Two live brokers: configure spreads partition ownership across
+    both; lookup reports real owners."""
+    _, _, filer, broker_a = cluster
+    broker_b = BrokerServer(filer.url).start()
+    try:
+        c = MQClient(broker_a.url)
+        assert c.configure_topic("chat", "rooms", 4) == 4
+        owners = {a["broker"] for a in c.lookup("chat", "rooms")}
+        assert owners == {broker_a.url, broker_b.url}
+    finally:
+        broker_b.stop()
+
+
+def test_multibroker_redirect_routing(cluster):
+    """Publishing through EITHER broker lands on the owner (client
+    follows 409 ownership redirects); subscribe too."""
+    _, _, filer, broker_a = cluster
+    broker_b = BrokerServer(filer.url).start()
+    try:
+        ca = MQClient(broker_a.url)
+        cb = MQClient(broker_b.url)
+        ca.configure_topic("chat", "redir", 2)
+        # drive both partitions through both entry points
+        for i in range(8):
+            (ca if i % 2 else cb).publish(
+                "chat", "redir", b"", b"m%d" % i, partition=i % 2)
+        got = []
+        for p in range(2):
+            got += [m.value for m in cb.subscribe("chat", "redir", p)]
+        assert sorted(got) == [b"m%d" % i for i in range(8)]
+    finally:
+        broker_b.stop()
+
+
+def test_multibroker_failover_takeover(cluster):
+    """Kill an owner: the surviving broker takes its partitions over
+    (dead owner absent from the registry) and serves publish+read;
+    pre-failover flushed messages survive."""
+    _, _, filer, broker_a = cluster
+    broker_b = BrokerServer(filer.url).start()
+    c = MQClient(broker_a.url)
+    c.configure_topic("chat", "ha", 2)
+    owners = {a["broker"]: i
+              for i, a in enumerate(c.lookup("chat", "ha"))}
+    b_part = owners[broker_b.url]
+    c.publish("chat", "ha", b"", b"before", partition=b_part)
+    c.flush("chat", "ha")      # broker_a flushes ITS logs only
+    MQClient(broker_b.url).publish(
+        "chat", "ha", b"", b"before2", partition=b_part)
+    broker_b.stop()            # graceful: flushes + deregisters
+    # broker_a takes over on the next touch
+    c.publish("chat", "ha", b"", b"after", partition=b_part)
+    vals = [m.value for m in c.subscribe("chat", "ha", b_part)]
+    assert vals == [b"before", b"before2", b"after"]
+    owners2 = {a["broker"] for a in c.lookup("chat", "ha")}
+    assert owners2 == {broker_a.url}
