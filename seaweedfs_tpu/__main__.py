@@ -196,6 +196,17 @@ def main(argv: list[str] | None = None) -> int:
     sfu.add_argument("-perm", action="append", default=[],
                      help="path:perm1,perm2 (repeatable)")
 
+    rsync = sub.add_parser(
+        "filer.remote.sync", help="push local changes under a "
+        "remote-mounted directory back to the foreign object store "
+        "(command/filer_remote_sync.go)")
+    rsync.add_argument("-filer", required=True)
+    rsync.add_argument("-dir", required=True,
+                       help="remote-mounted filer directory")
+    rsync.add_argument("-state", default="",
+                       help="offset checkpoint file")
+    rsync.add_argument("-interval", type=float, default=0.5)
+
     sh = sub.add_parser("shell", help="interactive admin shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
     sh.add_argument("-filer", default="",
@@ -408,6 +419,16 @@ def main(argv: list[str] | None = None) -> int:
             bak.run()
         except KeyboardInterrupt:
             pass
+    elif args.cmd == "filer.remote.sync":
+        from .remote import RemoteSyncer
+        syncer = RemoteSyncer(args.filer, args.dir,
+                              args.state or None,
+                              args.interval).start()
+        print(f"remote-syncing {args.dir} on {args.filer}")
+        try:
+            _wait()
+        finally:
+            syncer.stop()
     elif args.cmd == "sftp":
         import os
         from cryptography.hazmat.primitives import serialization

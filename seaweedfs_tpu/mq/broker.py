@@ -127,21 +127,28 @@ class BrokerServer:
         ts, cached = self._live_cache
         if now - ts < 1.0:
             return cached
-        live = []
         try:
             st, body, _ = http_bytes(
                 "GET", f"{self.filer}{BROKERS_DIR}/?limit=1000")
-            if st == 200:
-                cutoff = time.time() - self.BROKER_TTL
-                for e in json.loads(body).get("entries", []):
-                    if e.get("isDirectory"):
-                        continue
-                    addr = e["fullPath"].rsplit("/", 1)[-1]
-                    if e.get("attributes", {}).get("mtime",
-                                                   0) >= cutoff:
-                        live.append(addr)
-        except (OSError, ValueError):
-            pass
+        except OSError as e:
+            raise RuntimeError(f"broker registry unreachable: {e}")
+        if st == 404:
+            entries = []        # registry dir not created yet
+        elif st != 200:
+            # fail CLOSED: an unreadable registry must not read as
+            # "every peer is dead" — that would green-light takeovers
+            # of healthy brokers' partitions
+            raise RuntimeError(f"broker registry: {st}")
+        else:
+            entries = json.loads(body).get("entries", [])
+        live = []
+        cutoff = time.time() - self.BROKER_TTL
+        for e in entries:
+            if e.get("isDirectory"):
+                continue
+            addr = e["fullPath"].rsplit("/", 1)[-1]
+            if e.get("attributes", {}).get("mtime", 0) >= cutoff:
+                live.append(addr)
         if self.url not in live:
             live.append(self.url)   # we are definitionally alive
         live.sort()
@@ -246,7 +253,11 @@ class BrokerServer:
             owner = owners[idx] if idx < len(owners) else self.url
         if owner == self.url:
             return None
-        if owner in self._live_brokers():
+        try:
+            live = self._live_brokers()
+        except RuntimeError as e:
+            return 503, {"error": str(e)}
+        if owner in live:
             return 409, {"error": "not owner", "owner": owner,
                          "partition": idx}
         # owner is dead: take the partition over.  Re-read the conf
@@ -300,7 +311,10 @@ class BrokerServer:
             parts = split_ring(n)
             # round-robin allocation across live brokers
             # (pub_balancer/allocate.go AllocateTopicPartitions)
-            live = self._live_brokers()
+            try:
+                live = self._live_brokers()
+            except RuntimeError:
+                live = [self.url]   # solo fallback: configure works
             owners = [live[i % len(live)] for i in range(n)]
             err = self._persist_layout(t, parts, owners)
             if err:

@@ -1,0 +1,273 @@
+"""S3-compatible remote storage client + mount bookkeeping
+(weed/remote_storage/s3/s3_storage_client.go,
+weed/command/filer_remote_mount.go).
+
+The client signs with our own SigV4 signer, so it talks to ANY
+S3-compatible endpoint — including our own gateway, which is what the
+tests (and the reference's) point it at.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+from ..s3.auth import sign_request
+from ..server.httpd import http_bytes
+
+CONF_DIR = "/etc/remote"
+MOUNTS_PATH = "/etc/remote/mounts.json"
+
+
+class RemoteError(OSError):
+    pass
+
+
+class S3RemoteStorage:
+    """remote_storage.RemoteStorageClient, S3 flavor."""
+
+    def __init__(self, endpoint: str, access_key: str,
+                 secret_key: str, bucket: str):
+        self.endpoint = endpoint.removeprefix("http://")
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.bucket = bucket
+
+    @classmethod
+    def from_conf(cls, conf: dict, bucket: str = "") -> "S3RemoteStorage":
+        return cls(conf["endpoint"], conf.get("accessKey", ""),
+                   conf.get("secretKey", ""),
+                   bucket or conf.get("bucket", ""))
+
+    def _call(self, method: str, key: str, body: bytes = b"",
+              query: dict | None = None, headers: dict | None = None
+              ) -> "tuple[int, bytes, dict]":
+        path = f"/{self.bucket}/{key}" if key else f"/{self.bucket}"
+        q = dict(query or {})
+        signed = sign_request(method, self.endpoint, path, q,
+                              dict(headers or {}), body,
+                              self.access_key, self.secret_key)
+        qs = ("?" + urllib.parse.urlencode(q)) if q else ""
+        return http_bytes(method, f"{self.endpoint}" +
+                          urllib.parse.quote(path) + qs,
+                          body or None, signed)
+
+    # -- objects -----------------------------------------------------------
+
+    def traverse(self, prefix: str = ""):
+        """Yield (key, size, mtime_iso, etag) under prefix
+        (ListObjectsV2 pagination)."""
+        token = ""
+        while True:
+            q = {"list-type": "2", "prefix": prefix,
+                 "max-keys": "1000"}
+            if token:
+                q["continuation-token"] = token
+            st, body, _ = self._call("GET", "", query=q)
+            if st != 200:
+                raise RemoteError(f"list {self.bucket}/{prefix}: {st}")
+            root = ET.fromstring(body)
+            ns = root.tag.split("}")[0] + "}" if "}" in root.tag else ""
+            for c in root.iter(f"{ns}Contents"):
+                fields = {el.tag.rsplit("}", 1)[-1]: (el.text or "")
+                          for el in c}
+                yield (fields["Key"], int(fields.get("Size", 0)),
+                       fields.get("LastModified", ""),
+                       fields.get("ETag", "").strip('"'))
+            token = ""
+            for el in root.iter(f"{ns}NextContinuationToken"):
+                token = el.text or ""
+            if not token:
+                return
+
+    def read(self, key: str, offset: int = 0,
+             size: "int | None" = None) -> bytes:
+        headers = {}
+        if offset or size is not None:
+            end = "" if size is None else str(offset + size - 1)
+            headers["range"] = f"bytes={offset}-{end}"
+        st, body, _ = self._call("GET", key, headers=headers)
+        if st == 404:
+            raise FileNotFoundError(f"{self.bucket}/{key}")
+        if st not in (200, 206):
+            raise RemoteError(f"read {self.bucket}/{key}: {st}")
+        if st == 200 and (offset or size is not None):
+            # endpoint ignored Range: slice locally
+            body = body[offset:offset + size if size else None]
+        return body
+
+    def write(self, key: str, data: bytes) -> None:
+        st, body, _ = self._call("PUT", key, data)
+        if st != 200:
+            raise RemoteError(f"write {self.bucket}/{key}: {st} "
+                              f"{body[:200]!r}")
+
+    def delete(self, key: str) -> None:
+        st, _, _ = self._call("DELETE", key)
+        if st not in (200, 204, 404):
+            raise RemoteError(f"delete {self.bucket}/{key}: {st}")
+
+    def stat(self, key: str) -> "dict | None":
+        st, _, h = self._call("HEAD", key)
+        if st == 404:
+            return None
+        if st != 200:
+            raise RemoteError(f"stat {self.bucket}/{key}: {st}")
+        return {"size": int(h.get("Content-Length", 0)),
+                "etag": h.get("ETag", "").strip('"')}
+
+    def create_bucket(self) -> None:
+        st, _, _ = self._call("PUT", "")
+        if st not in (200, 409):
+            raise RemoteError(f"create bucket {self.bucket}: {st}")
+
+
+# -- conf + mount bookkeeping (stored IN the filer) ------------------------
+
+def save_conf(filer: str, name: str, conf: dict) -> None:
+    st, _, _ = http_bytes(
+        "PUT", f"{filer}{CONF_DIR}/{name}.conf",
+        json.dumps(conf).encode())
+    if st not in (200, 201):
+        raise RemoteError(f"save remote conf {name}: {st}")
+
+
+def load_conf(filer: str, name: str) -> dict:
+    st, body, _ = http_bytes("GET", f"{filer}{CONF_DIR}/{name}.conf")
+    if st != 200:
+        raise RemoteError(f"no remote conf {name!r} ({st})")
+    return json.loads(body)
+
+
+def load_mounts(filer: str) -> dict:
+    st, body, _ = http_bytes("GET", f"{filer}{MOUNTS_PATH}")
+    if st != 200:
+        return {}
+    return json.loads(body)
+
+
+def save_mounts(filer: str, mounts: dict) -> None:
+    st, _, _ = http_bytes("PUT", f"{filer}{MOUNTS_PATH}",
+                          json.dumps(mounts, indent=1).encode())
+    if st not in (200, 201):
+        raise RemoteError(f"save mounts: {st}")
+
+
+def remote_for_path(filer: str, path: str
+                    ) -> "tuple[S3RemoteStorage, str] | None":
+    """(client, remote_key) for a filer path under a mount, else
+    None.  Longest mount prefix wins."""
+    mounts = load_mounts(filer)
+    best = None
+    for d in mounts:
+        cd = d.rstrip("/")
+        if (path == cd or path.startswith(cd + "/")) and \
+                (best is None or len(cd) > len(best)):
+            best = cd
+    if best is None:
+        return None
+    m = mounts[best]
+    conf = load_conf(filer, m["conf"])
+    client = S3RemoteStorage.from_conf(conf, m.get("bucket", ""))
+    rel = path[len(best):].lstrip("/")
+    prefix = m.get("keyPrefix", "")
+    key = (prefix.rstrip("/") + "/" + rel).lstrip("/") if prefix \
+        else rel
+    return client, key
+
+
+def _remote_marker(size: int, etag: str = "") -> str:
+    return json.dumps({"size": size, "etag": etag})
+
+
+def mount_remote(filer: str, directory: str, conf_name: str,
+                 bucket: str, key_prefix: str = "") -> int:
+    """Record the mount and pull remote metadata into filer entries
+    (filer_remote_mount.go syncMetadata): each object becomes an
+    entry with a remote pointer and NO chunks.  Returns entry count."""
+    conf = load_conf(filer, conf_name)
+    client = S3RemoteStorage.from_conf(conf, bucket)
+    mounts = load_mounts(filer)
+    mounts[directory.rstrip("/")] = {"conf": conf_name,
+                                     "bucket": bucket,
+                                     "keyPrefix": key_prefix}
+    save_mounts(filer, mounts)
+    n = 0
+    for key, size, _mtime, etag in client.traverse(key_prefix):
+        rel = key[len(key_prefix):].lstrip("/") if key_prefix else key
+        if not rel or rel.endswith("/"):
+            continue
+        path = f"{directory.rstrip('/')}/{rel}"
+        marker = _remote_marker(size, etag)
+        # only touch entries whose remote pointer CHANGED: replacing
+        # an unchanged entry would drop cached chunks and clobber
+        # local not-yet-synced edits (syncMetadata semantics)
+        existing = _meta_lookup(filer, path)
+        if existing is not None and \
+                existing.get("extended", {}).get("remote") == marker:
+            n += 1
+            continue
+        _meta_create(filer, path, {"remote": marker})
+        n += 1
+    return n
+
+
+def _meta_lookup(filer: str, path: str) -> "dict | None":
+    st, body, _ = http_bytes(
+        "GET", f"{filer}/__meta__/lookup?path=" +
+        urllib.parse.quote(path))
+    return json.loads(body) if st == 200 else None
+
+
+def _meta_create(filer: str, path: str, extended: dict) -> None:
+    st, _, _ = http_bytes(
+        "POST", f"{filer}/__meta__/create",
+        json.dumps({"path": path, "extended": extended}).encode(),
+        {"Content-Type": "application/json"})
+    if st != 200:
+        raise RemoteError(f"meta create {path}: {st}")
+
+
+def cache_path(filer: str, path: str) -> int:
+    """Materialize remote content into local chunks (remote.cache):
+    returns bytes cached.  The remote marker stays — the entry is
+    both cached AND remote-backed (uncache drops the chunks again)."""
+    located = remote_for_path(filer, path)
+    if located is None:
+        raise RemoteError(f"{path} is not under a remote mount")
+    client, key = located
+    data = client.read(key)
+    st, _, _ = http_bytes("PUT", filer + urllib.parse.quote(path),
+                          data)
+    if st not in (200, 201):
+        raise RemoteError(f"cache write {path}: {st}")
+    # content PUT rebuilt the entry: re-attach the remote marker
+    _meta_patch_extended(filer, path,
+                         {"remote": _remote_marker(len(data))})
+    return len(data)
+
+
+def uncache_path(filer: str, path: str) -> None:
+    """Drop local chunks, keep the remote-backed entry
+    (remote.uncache)."""
+    st, body, _ = http_bytes(
+        "GET", f"{filer}/__meta__/lookup?path=" +
+        urllib.parse.quote(path))
+    if st != 200:
+        raise RemoteError(f"lookup {path}: {st}")
+    entry = json.loads(body)
+    marker = entry.get("extended", {}).get("remote")
+    if not marker:
+        raise RemoteError(f"{path} is not remote-backed")
+    _meta_create(filer, path, {"remote": marker})   # replaces chunks
+
+
+def _meta_patch_extended(filer: str, path: str,
+                         extended: dict) -> None:
+    st, _, _ = http_bytes(
+        "POST", f"{filer}/__meta__/patch_extended",
+        json.dumps({"path": path, "extended": extended}).encode(),
+        {"Content-Type": "application/json"})
+    if st != 200:
+        raise RemoteError(f"meta patch {path}: {st}")

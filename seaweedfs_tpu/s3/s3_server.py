@@ -1022,6 +1022,23 @@ class S3ApiServer:
         vid = entry.extended.get("versionId")
         if vid:
             headers["x-amz-version-id"] = vid
+        if req.method == "GET":
+            # ranged GetObject (applied AFTER decryption — CTR mode
+            # could seek, but correctness first); shared parser keeps
+            # semantics identical with the filer paths
+            from ..server.httpd import parse_range
+            total = len(data)
+            parsed = parse_range(req.headers.get("Range", ""), total)
+            if parsed == "unsatisfiable":
+                return 416, (b"", {"Content-Range":
+                                   f"bytes */{total}"})
+            if parsed is not None:
+                start, size = parsed
+                data = data[start:start + size]
+                headers["Content-Range"] = \
+                    f"bytes {start}-{start + len(data) - 1}/{total}"
+                headers["Content-Length"] = str(len(data))
+                return 206, (data, headers)
         return 200, (data, headers)
 
     def _get_object(self, req: Request, bucket: str, key: str,

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 from ..filer import Entry, Filer
 from ..filer.filer_store import SqliteStore
-from .httpd import HttpServer, Request
+from .httpd import HttpServer, Request, parse_range
 
 
 class FilerServer:
@@ -38,6 +38,10 @@ class FilerServer:
         self.http.route("POST", "/__meta__/rename", self._meta_rename)
         self.http.route("POST", "/__meta__/set_attrs",
                         self._meta_set_attrs)
+        self.http.route("POST", "/__meta__/create",
+                        self._meta_create)
+        self.http.route("POST", "/__meta__/patch_extended",
+                        self._meta_patch_extended)
         self.http.route("GET", "/__meta__/events", self._meta_events)
         from .debug import install_debug_routes
         install_debug_routes(self.http)  # util/grace/pprof.go analog
@@ -127,24 +131,18 @@ class FilerServer:
             return 404, {"error": f"{path} not found"}
         if entry.is_directory:
             return self._list(req, path)
+        if not entry.chunks and entry.extended.get("remote"):
+            return self._get_remote(req, path, entry)
         rng = req.headers.get("Range", "")
-        offset, size = 0, None
         file_size = entry.total_size()
-        try:
-            if rng.startswith("bytes="):
-                lo, _, hi = rng[6:].partition("-")
-                if lo:
-                    offset = int(lo)
-                    if hi:
-                        size = int(hi) - offset + 1
-                elif hi:
-                    size = min(int(hi), file_size)  # suffix: last N
-                    offset = file_size - size
-                else:
-                    raise ValueError(rng)
-        except ValueError:
-            rng = ""  # malformed Range: serve the full body (RFC 9110)
+        parsed = parse_range(rng, file_size)
+        if parsed == "unsatisfiable":
+            return 416, (b"", {"Content-Range": f"bytes */{file_size}"})
+        if parsed is None:
+            rng = ""  # absent/malformed: full body (RFC 9110)
             offset, size = 0, None
+        else:
+            offset, size = parsed
         data = self.filer.read_file(path, offset, size)
         mime = entry.attributes.mime or "application/octet-stream"
         if rng:
@@ -153,6 +151,37 @@ class FilerServer:
                 "Content-Type": mime,
                 "Content-Range": f"bytes {offset}-{end}/{file_size}"})
         return 200, (data, mime)
+
+    def _get_remote(self, req: Request, path: str, entry):
+        """Read-through for uncached remote-mounted entries
+        (filer_remote_read: fetch from the foreign store on demand;
+        remote.cache materializes local chunks so this path stops
+        being hit)."""
+        import json as _json
+        from ..remote import RemoteError, remote_for_path
+        try:
+            located = remote_for_path(self.url, path)
+            if located is None:
+                return 404, {"error": f"{path}: remote mount gone"}
+            client, key = located
+            marker = _json.loads(entry.extended["remote"])
+            total = int(marker.get("size", 0))
+            parsed = parse_range(req.headers.get("Range", ""), total)
+            if parsed == "unsatisfiable":
+                return 416, (b"", {"Content-Range": f"bytes */{total}"})
+            if parsed is not None:
+                offset, size = parsed
+                data = client.read(key, offset, size)
+                end = offset + len(data) - 1
+                return 206, (data, {
+                    "Content-Type": "application/octet-stream",
+                    "Content-Range": f"bytes {offset}-{end}/{total}"})
+            return 200, (client.read(key),
+                         "application/octet-stream")
+        except FileNotFoundError:
+            return 404, {"error": f"{path}: gone on remote"}
+        except (RemoteError, OSError, ValueError) as e:
+            return 502, {"error": f"remote read {path}: {e}"}
 
     def _list(self, req: Request, path: str):
         limit = int(req.query.get("limit", 1000))
@@ -311,6 +340,29 @@ class FilerServer:
             return 404, {"error": "not found"}
         from ..filer.entry import Attributes
         entry.attributes = Attributes.from_json(b.get("attributes", {}))
+        self.filer.create_entry(entry, create_parents=False)
+        return 200, {}
+
+    def _meta_create(self, req: Request):
+        """Create/replace a chunkless entry with extended metadata —
+        the remote-mount pointer entries (filer_pb.RemoteEntry shape)
+        and remote.uncache both need an entry with metadata but no
+        content."""
+        from ..filer.entry import Entry
+        b = req.json()
+        entry = Entry(b["path"],
+                      is_directory=bool(b.get("isDirectory")))
+        entry.extended = dict(b.get("extended", {}))
+        self.filer.create_entry(entry)
+        return 200, {}
+
+    def _meta_patch_extended(self, req: Request):
+        """Merge extended keys into an entry, keeping chunks/attrs."""
+        b = req.json()
+        entry = self.filer.find_entry(b["path"])
+        if entry is None:
+            return 404, {"error": "not found"}
+        entry.extended.update(b.get("extended", {}))
         self.filer.create_entry(entry, create_parents=False)
         return 200, {}
 

@@ -281,6 +281,37 @@ def http_json(method: str, url: str, payload: dict | None = None,
         return parsed
 
 
+def parse_range(header: str, total: int
+                ) -> "tuple[int, int] | None | str":
+    """One shared parser for `Range: bytes=...` (RFC 9110 §14):
+    returns (offset, size), None for absent/malformed (serve the full
+    body), or "unsatisfiable" for a well-formed range beyond EOF.
+    Handles the suffix form bytes=-N (last N bytes)."""
+    if not header.startswith("bytes="):
+        return None
+    spec = header[6:]
+    if "," in spec:
+        return None            # multipart ranges: serve full body
+    lo, dash, hi = spec.partition("-")
+    if not dash:
+        return None
+    try:
+        if lo:
+            offset = int(lo)
+            if offset >= total > 0 or offset < 0:
+                return "unsatisfiable"
+            stop = min(int(hi) + 1, total) if hi else total
+            if stop <= offset:
+                return None
+            return offset, stop - offset
+        if hi:                 # suffix: last N bytes
+            size = min(int(hi), total)
+            return total - size, size
+    except ValueError:
+        return None
+    return None
+
+
 def http_bytes(method: str, url: str, body: bytes | None = None,
                headers: dict | None = None, timeout: float = 60.0
                ) -> tuple[int, bytes, dict]:

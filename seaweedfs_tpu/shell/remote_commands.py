@@ -1,0 +1,152 @@
+"""remote.* shell family (weed/shell/command_remote_*.go): configure
+foreign object stores, mount them into the filer namespace, cache /
+uncache content, re-pull metadata.
+
+    remote.configure -name=cloud1 -type=s3 -endpoint=host:port \\
+                     -accessKey=... -secretKey=...
+    remote.mount     -dir=/buckets/b -remote=cloud1/bucket[/prefix]
+    remote.meta.sync -dir=/buckets/b
+    remote.cache     -dir=/buckets/b [-include=path]
+    remote.uncache   -dir=/buckets/b [-include=path]
+    remote.unmount   -dir=/buckets/b
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+
+from ..remote import (cache_path, load_conf, load_mounts,
+                      mount_remote, save_conf, save_mounts,
+                      uncache_path)
+from ..server.httpd import http_bytes
+from .commands import CommandEnv, _parse_flags, command
+
+
+def _filer(env: CommandEnv) -> str:
+    return env.require_filer()
+
+
+@command("remote.configure")
+def remote_configure(env: CommandEnv, args: list[str]) -> str:
+    flags = _parse_flags(args)
+    name = flags.get("name", "")
+    if not name:
+        # list configured remotes
+        st, body, _ = http_bytes(
+            "GET", f"{_filer(env)}/etc/remote/?limit=1000")
+        if st != 200:
+            return "no remotes configured"
+        names = [e["fullPath"].rsplit("/", 1)[-1]
+                 for e in json.loads(body).get("entries", [])
+                 if e["fullPath"].endswith(".conf")]
+        return "\n".join(names) or "no remotes configured"
+    if flags.get("type", "s3") != "s3":
+        return f"unsupported remote type {flags.get('type')!r}"
+    save_conf(_filer(env), name, {
+        "type": "s3",
+        "endpoint": flags.get("endpoint", ""),
+        "accessKey": flags.get("accessKey", ""),
+        "secretKey": flags.get("secretKey", ""),
+    })
+    return f"saved remote {name}"
+
+
+def _split_remote(spec: str) -> "tuple[str, str, str]":
+    """cloud1/bucket[/prefix...] -> (conf, bucket, prefix)."""
+    parts = spec.strip("/").split("/", 2)
+    if len(parts) < 2:
+        raise ValueError(
+            "remote must be <name>/<bucket>[/<prefix>]")
+    return parts[0], parts[1], parts[2] if len(parts) > 2 else ""
+
+
+@command("remote.mount")
+def remote_mount(env: CommandEnv, args: list[str]) -> str:
+    flags = _parse_flags(args)
+    directory = flags.get("dir", "")
+    spec = flags.get("remote", "")
+    if not directory or not spec:
+        mounts = load_mounts(_filer(env))
+        return "\n".join(
+            f"{d} -> {m['conf']}/{m['bucket']}/{m.get('keyPrefix', '')}"
+            for d, m in sorted(mounts.items())) or "no mounts"
+    conf, bucket, prefix = _split_remote(spec)
+    n = mount_remote(_filer(env), directory, conf, bucket, prefix)
+    return f"mounted {spec} at {directory} ({n} entries)"
+
+
+@command("remote.meta.sync")
+def remote_meta_sync(env: CommandEnv, args: list[str]) -> str:
+    flags = _parse_flags(args)
+    directory = flags.get("dir", "").rstrip("/")
+    mounts = load_mounts(_filer(env))
+    if directory not in mounts:
+        return f"{directory} is not remote-mounted"
+    m = mounts[directory]
+    n = mount_remote(_filer(env), directory, m["conf"], m["bucket"],
+                     m.get("keyPrefix", ""))
+    return f"meta re-synced: {n} entries"
+
+
+def _walk(filer: str, directory: str):
+    last = ""
+    while True:
+        st, body, _ = http_bytes(
+            "GET", filer + urllib.parse.quote(
+                directory.rstrip("/") + "/") +
+            f"?limit=500&lastFileName={urllib.parse.quote(last)}")
+        if st != 200:
+            return
+        batch = json.loads(body).get("entries", [])
+        for e in batch:
+            if e.get("isDirectory"):
+                yield from _walk(filer, e["fullPath"])
+            else:
+                yield e
+        if len(batch) < 500:
+            return
+        last = batch[-1]["fullPath"].rsplit("/", 1)[-1]
+
+
+@command("remote.cache")
+def remote_cache(env: CommandEnv, args: list[str]) -> str:
+    flags = _parse_flags(args)
+    directory = flags.get("dir", "")
+    include = flags.get("include", "")
+    total = files = 0
+    for e in _walk(_filer(env), directory):
+        if include and include not in e["fullPath"]:
+            continue
+        if e.get("extended", {}).get("remote") and not e.get("chunks"):
+            total += cache_path(_filer(env), e["fullPath"])
+            files += 1
+    return f"cached {files} files, {total} bytes"
+
+
+@command("remote.uncache")
+def remote_uncache(env: CommandEnv, args: list[str]) -> str:
+    flags = _parse_flags(args)
+    directory = flags.get("dir", "")
+    include = flags.get("include", "")
+    files = 0
+    for e in _walk(_filer(env), directory):
+        if include and include not in e["fullPath"]:
+            continue
+        if e.get("extended", {}).get("remote") and e.get("chunks"):
+            uncache_path(_filer(env), e["fullPath"])
+            files += 1
+    return f"uncached {files} files"
+
+
+@command("remote.unmount")
+def remote_unmount(env: CommandEnv, args: list[str]) -> str:
+    flags = _parse_flags(args)
+    directory = flags.get("dir", "").rstrip("/")
+    mounts = load_mounts(_filer(env))
+    if directory not in mounts:
+        return f"{directory} is not remote-mounted"
+    del mounts[directory]
+    save_mounts(_filer(env), mounts)
+    return (f"unmounted {directory} (entries left in place; "
+            f"remove with fs.rm if unwanted)")
