@@ -30,6 +30,16 @@ def main(argv: list[str] | None = None) -> int:
     m.add_argument("-peers", default="",
                    help="comma-separated master peers for HA "
                         "(raft-style leader election)")
+    m.add_argument("-metricsAddress", dest="metrics_address",
+                   default="", help="Prometheus pushgateway "
+                   "host:port (stats/metrics.go LoopPushingMetric)")
+    m.add_argument("-metricsIntervalSec", dest="metrics_interval",
+                   type=int, default=15)
+    m.add_argument("-telemetry", action="store_true",
+                   help="OPT-IN anonymous usage reports "
+                        "(weed/telemetry; default off)")
+    m.add_argument("-telemetryUrl", dest="telemetry_url",
+                   default="", help="collector URL for -telemetry")
 
     v = sub.add_parser("volume", help="start a volume server")
     v.add_argument("-ip", default="127.0.0.1")
@@ -254,6 +264,18 @@ def main(argv: list[str] | None = None) -> int:
                           default_replication=args.defaultReplication,
                           peers=args.peers or None)
         ms.start()
+        if args.metrics_address:
+            from .stats import MetricsPusher
+            MetricsPusher(ms.metrics, "master", ms.url,
+                          args.metrics_address,
+                          args.metrics_interval).start()
+            print(f"pushing metrics to {args.metrics_address} "
+                  f"every {args.metrics_interval}s")
+        if args.telemetry and args.telemetry_url:
+            from .telemetry import TelemetryClient
+            TelemetryClient(args.telemetry_url,
+                            enabled=True).start(ms.url)
+            print(f"telemetry enabled -> {args.telemetry_url}")
         print(f"master listening on {ms.url}")
         _wait()
     elif args.cmd == "volume":

@@ -140,11 +140,14 @@ class BrokerServer:
             # of healthy brokers' partitions
             raise RuntimeError(f"broker registry: {st}")
         else:
-            entries = json.loads(body).get("entries", [])
+            try:
+                entries = json.loads(body).get("entries", [])
+            except ValueError as e:
+                raise RuntimeError(f"broker registry undecodable: {e}")
         live = []
         cutoff = time.time() - self.BROKER_TTL
         for e in entries:
-            if e.get("isDirectory"):
+            if e.get("isDirectory") or "fullPath" not in e:
                 continue
             addr = e["fullPath"].rsplit("/", 1)[-1]
             if e.get("attributes", {}).get("mtime", 0) >= cutoff:

@@ -200,14 +200,19 @@ def mount_remote(filer: str, directory: str, conf_name: str,
             continue
         path = f"{directory.rstrip('/')}/{rel}"
         marker = _remote_marker(size, etag)
-        # only touch entries whose remote pointer CHANGED: replacing
-        # an unchanged entry would drop cached chunks and clobber
-        # local not-yet-synced edits (syncMetadata semantics)
+        # syncMetadata semantics: only touch entries whose remote
+        # pointer CHANGED, and never replace a purely-local file —
+        # an entry with chunks but NO remote marker is a local edit
+        # not yet pushed; clobbering it would lose data
         existing = _meta_lookup(filer, path)
-        if existing is not None and \
-                existing.get("extended", {}).get("remote") == marker:
-            n += 1
-            continue
+        if existing is not None:
+            ext_marker = existing.get("extended", {}).get("remote")
+            if ext_marker == marker:
+                n += 1
+                continue
+            if ext_marker is None and existing.get("chunks"):
+                n += 1     # local file shadows the remote one
+                continue
         _meta_create(filer, path, {"remote": marker})
         n += 1
     return n
@@ -229,22 +234,30 @@ def _meta_create(filer: str, path: str, extended: dict) -> None:
         raise RemoteError(f"meta create {path}: {st}")
 
 
-def cache_path(filer: str, path: str) -> int:
+def cache_path(filer: str, path: str,
+               located: "tuple[S3RemoteStorage, str] | None" = None
+               ) -> int:
     """Materialize remote content into local chunks (remote.cache):
-    returns bytes cached.  The remote marker stays — the entry is
-    both cached AND remote-backed (uncache drops the chunks again)."""
-    located = remote_for_path(filer, path)
+    returns bytes cached.  The ORIGINAL remote marker is re-attached
+    verbatim — inventing a new one (e.g. without the etag) would make
+    the next meta.sync see a "changed" pointer and evict the cache.
+    `located` lets bulk callers resolve the mount once."""
+    entry = _meta_lookup(filer, path)
+    marker = (entry or {}).get("extended", {}).get("remote")
+    if marker is None:
+        raise RemoteError(f"{path} is not remote-backed")
     if located is None:
-        raise RemoteError(f"{path} is not under a remote mount")
+        located = remote_for_path(filer, path)
+        if located is None:
+            raise RemoteError(f"{path} is not under a remote mount")
     client, key = located
     data = client.read(key)
     st, _, _ = http_bytes("PUT", filer + urllib.parse.quote(path),
                           data)
     if st not in (200, 201):
         raise RemoteError(f"cache write {path}: {st}")
-    # content PUT rebuilt the entry: re-attach the remote marker
-    _meta_patch_extended(filer, path,
-                         {"remote": _remote_marker(len(data))})
+    # content PUT rebuilt the entry: re-attach the SAME marker
+    _meta_patch_extended(filer, path, {"remote": marker})
     return len(data)
 
 

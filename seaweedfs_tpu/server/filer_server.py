@@ -353,7 +353,13 @@ class FilerServer:
         entry = Entry(b["path"],
                       is_directory=bool(b.get("isDirectory")))
         entry.extended = dict(b.get("extended", {}))
+        old_entry = self.filer.find_entry(b["path"])
         self.filer.create_entry(entry)
+        if old_entry is not None and old_entry.chunks:
+            # replacing a file with a chunkless entry (uncache /
+            # remote-pointer refresh) must reclaim the old content —
+            # write_file does the same for content overwrites
+            self.filer._delete_chunks(old_entry)
         return 200, {}
 
     def _meta_patch_extended(self, req: Request):

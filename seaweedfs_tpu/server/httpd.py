@@ -306,6 +306,8 @@ def parse_range(header: str, total: int
             return offset, stop - offset
         if hi:                 # suffix: last N bytes
             size = min(int(hi), total)
+            if size <= 0:
+                return None    # bytes=-0 / bytes=--5: not a range
             return total - size, size
     except ValueError:
         return None

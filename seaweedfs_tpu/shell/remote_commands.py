@@ -38,6 +38,7 @@ def remote_configure(env: CommandEnv, args: list[str]) -> str:
         if st != 200:
             return "no remotes configured"
         names = [e["fullPath"].rsplit("/", 1)[-1]
+                 .removesuffix(".conf")
                  for e in json.loads(body).get("entries", [])
                  if e["fullPath"].endswith(".conf")]
         return "\n".join(names) or "no remotes configured"
@@ -111,15 +112,26 @@ def _walk(filer: str, directory: str):
 
 @command("remote.cache")
 def remote_cache(env: CommandEnv, args: list[str]) -> str:
+    from ..remote import remote_for_path
     flags = _parse_flags(args)
-    directory = flags.get("dir", "")
+    directory = flags.get("dir", "").rstrip("/")
     include = flags.get("include", "")
+    # resolve the mount ONCE: per-file resolution would re-fetch the
+    # mount table + conf for every entry
+    located = remote_for_path(_filer(env), directory)
+    if located is None:
+        return f"{directory} is not under a remote mount"
+    client, base_key = located
     total = files = 0
     for e in _walk(_filer(env), directory):
         if include and include not in e["fullPath"]:
             continue
         if e.get("extended", {}).get("remote") and not e.get("chunks"):
-            total += cache_path(_filer(env), e["fullPath"])
+            rel = e["fullPath"][len(directory):].lstrip("/")
+            key = (base_key.rstrip("/") + "/" + rel).lstrip("/") \
+                if base_key else rel
+            total += cache_path(_filer(env), e["fullPath"],
+                                located=(client, key))
             files += 1
     return f"cached {files} files, {total} bytes"
 

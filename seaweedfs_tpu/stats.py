@@ -1,11 +1,13 @@
 """Prometheus-style metrics (weed/stats/metrics.go — the reference
 defines vectors per role and serves them on -metricsPort; ours is a
 minimal in-process registry rendered in the Prometheus text format on
-each server's /metrics endpoint)."""
+each server's /metrics endpoint), plus the push-gateway loop
+(metrics.go:534 LoopPushingMetric)."""
 
 from __future__ import annotations
 
 import threading
+import urllib.parse
 from collections import defaultdict
 
 
@@ -54,3 +56,45 @@ class Metrics:
                     else:
                         out.append(f"{full} {value}")
         return "\n".join(out) + "\n"
+
+
+class MetricsPusher:
+    """LoopPushingMetric (metrics.go:534): periodically PUT the
+    rendered registry to a Prometheus pushgateway at
+    /metrics/job/<job>/instance/<instance>.  Push failures are
+    logged-and-retried, never fatal — metrics delivery must not take
+    a data server down."""
+
+    def __init__(self, metrics: "Metrics", job: str, instance: str,
+                 gateway: str, interval: float = 15.0):
+        from .server.httpd import http_bytes
+        self._http = http_bytes
+        self.metrics = metrics
+        self.gateway = gateway
+        self.interval = interval
+        self.path = (f"/metrics/job/{urllib.parse.quote(job)}"
+                     f"/instance/{urllib.parse.quote(instance)}")
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+
+    def push_once(self) -> bool:
+        try:
+            st, _, _ = self._http(
+                "PUT", f"{self.gateway}{self.path}",
+                self.metrics.render().encode(),
+                {"Content-Type": "text/plain; version=0.0.4"})
+            return st < 300
+        except OSError:
+            return False
+
+    def start(self) -> "MetricsPusher":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.push_once()

@@ -1,0 +1,86 @@
+"""Opt-in cluster telemetry (reference: weed/telemetry/client.go +
+collector.go, telemetry/proto).
+
+STRICTLY opt-in (the reference ships -telemetry=false by default; so
+do we): when enabled on the master CLI, a background reporter
+periodically collects anonymous cluster shape — version, os, server/
+volume counts, total size — and POSTs it as JSON to the collector
+URL.  The instance id is a random UUID generated in memory only
+(never persisted), exactly the reference's privacy posture."""
+
+from __future__ import annotations
+
+import json
+import platform
+import threading
+import uuid
+
+from .server.httpd import http_bytes, http_json
+
+VERSION = "seaweedfs-tpu/3.0"
+
+
+class TelemetryClient:
+    def __init__(self, url: str, enabled: bool = False,
+                 interval: float = 24 * 3600.0):
+        self.url = url
+        self.enabled = enabled and bool(url)
+        self.interval = interval
+        self.instance_id = str(uuid.uuid4())   # memory-only
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+
+    # -- collection (telemetry/collector.go shape) ------------------------
+
+    def collect(self, master: str) -> dict:
+        data = {
+            "version": VERSION,
+            "os": f"{platform.system()}/{platform.machine()}",
+            "instanceId": self.instance_id,
+        }
+        try:
+            status = http_json("GET", f"{master}/cluster/status")
+            vols = http_json("GET", f"{master}/vol/list")
+            data["clusterId"] = status.get("topologyId", "")
+            data["masterCount"] = len(status.get("peers") or [1])
+            data["serverCount"] = len(status.get("dataNodes", []))
+            count = size = 0
+            for dc in vols.get("dataCenters", {}).values():
+                for rack in dc.get("racks", {}).values():
+                    for node in rack.get("nodes", []):
+                        for v in node.get("volumes", []):
+                            count += 1
+                            size += int(v.get("size", 0))
+            data["volumeCount"] = count
+            data["totalSizeBytes"] = size
+        except (OSError, ValueError):
+            pass   # partial reports are fine; the shape matters
+        return data
+
+    def send(self, master: str) -> bool:
+        if not self.enabled:
+            return False
+        try:
+            st, _, _ = http_bytes(
+                "POST", self.url, json.dumps(
+                    self.collect(master)).encode(),
+                {"Content-Type": "application/json"})
+            return st < 300
+        except OSError:
+            return False
+
+    # -- reporter loop (client.go StartReporting) -------------------------
+
+    def start(self, master: str) -> "TelemetryClient":
+        if not self.enabled:
+            return self
+        def loop():
+            self.send(master)            # first report at startup
+            while not self._stop.wait(self.interval):
+                self.send(master)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -144,3 +144,32 @@ def test_remote_sync_pushes_local_changes(rig, tmp_path):
     local.filer.write_file("/elsewhere/x.txt", b"not synced")
     syncer2.run_once()
     assert remote.stat("elsewhere/x.txt") is None
+
+
+def test_meta_sync_preserves_cache_and_local_edits(rig):
+    """Code-review regressions (repro'd): meta.sync must NOT evict a
+    cached entry whose remote object is unchanged, and must NOT
+    clobber a purely-local edit (entry with chunks, no marker)."""
+    local, remote, _ = rig
+    mount_remote(local.url, "/mnt/ms", "cloud1", "clouddata",
+                 "archive")
+    # cache a file, then re-sync metadata: the cache must survive
+    cache_path(local.url, "/mnt/ms/a.txt")
+    assert local.filer.find_entry("/mnt/ms/a.txt").chunks
+    mount_remote(local.url, "/mnt/ms", "cloud1", "clouddata",
+                 "archive")
+    assert local.filer.find_entry("/mnt/ms/a.txt").chunks, \
+        "meta.sync evicted an unchanged cached entry"
+    # a local not-yet-synced edit must survive a meta re-sync
+    local.filer.write_file("/mnt/ms/sub/b.bin", b"LOCAL EDIT")
+    mount_remote(local.url, "/mnt/ms", "cloud1", "clouddata",
+                 "archive")
+    assert local.filer.read_file("/mnt/ms/sub/b.bin") == \
+        b"LOCAL EDIT", "meta.sync clobbered a local edit"
+    # but a genuinely CHANGED remote object does refresh the pointer
+    remote.write("archive/a.txt", b"remote v2 content!")
+    mount_remote(local.url, "/mnt/ms", "cloud1", "clouddata",
+                 "archive")
+    e = local.filer.find_entry("/mnt/ms/a.txt")
+    assert not e.chunks, "stale cache kept after remote change"
+    assert _get(local, "/mnt/ms/a.txt")[1] == b"remote v2 content!"

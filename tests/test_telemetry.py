@@ -1,0 +1,103 @@
+"""Telemetry + push-gateway metrics (weed/telemetry/,
+stats/metrics.go LoopPushingMetric analog): reports land at a capture
+server, opt-in is respected, pushes carry Prometheus text."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.server.httpd import HttpServer, Request
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.stats import Metrics, MetricsPusher
+from seaweedfs_tpu.telemetry import TelemetryClient
+
+
+class Capture:
+    """Tiny HTTP sink recording every request body+path."""
+
+    def __init__(self):
+        self.hits = []
+        self.http = HttpServer("127.0.0.1", 0)
+        self.http.fallback = self._take
+        self.http.start()
+
+    def _take(self, req: Request):
+        self.hits.append((req.method, req.path, req.body))
+        return 200, {}
+
+    @property
+    def url(self):
+        return self.http.url
+
+    def stop(self):
+        self.http.stop()
+
+
+@pytest.fixture
+def sink():
+    c = Capture()
+    yield c
+    c.stop()
+
+
+def test_metrics_pusher_format(sink):
+    m = Metrics("testrole")
+    m.counter_add("requests_total", 3, method="GET")
+    m.gauge_set("depth", 7)
+    p = MetricsPusher(m, "testrole", "host-1:8080", sink.url,
+                      interval=0.05)
+    assert p.push_once()
+    method, path, body = sink.hits[0]
+    assert method == "PUT"
+    assert path == "/metrics/job/testrole/instance/host-1%3A8080"
+    text = body.decode()
+    assert 'testrole_requests_total{method="GET"} 3' in text
+    assert "testrole_depth 7" in text
+    # the loop keeps pushing
+    p.start()
+    deadline = time.time() + 5
+    while len(sink.hits) < 3 and time.time() < deadline:
+        time.sleep(0.05)
+    p.stop()
+    assert len(sink.hits) >= 3
+    # gateway down: push_once reports failure but never raises
+    sink.stop()
+    assert p.push_once() is False
+
+
+def test_telemetry_opt_in_and_payload(sink, tmp_path):
+    master = MasterServer().start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url,
+                      pulse_seconds=0.3).start()
+    time.sleep(0.8)
+    try:
+        # disabled: nothing is ever sent
+        off = TelemetryClient(sink.url + "/collect", enabled=False)
+        assert off.send(master.url) is False
+        assert sink.hits == []
+        # enabled: a JSON report with the cluster shape
+        on = TelemetryClient(sink.url + "/collect", enabled=True)
+        assert on.send(master.url) is True
+        _, path, body = sink.hits[0]
+        assert path == "/collect"
+        report = json.loads(body)
+        assert report["version"].startswith("seaweedfs-tpu/")
+        assert report["serverCount"] == 1
+        assert "volumeCount" in report and "os" in report
+        # instance id is a memory-only uuid, stable per client
+        assert on.send(master.url)
+        assert json.loads(sink.hits[1][2])["instanceId"] == \
+            report["instanceId"]
+        assert TelemetryClient(sink.url, True).instance_id != \
+            on.instance_id
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_telemetry_survives_unreachable_collector():
+    t = TelemetryClient("127.0.0.1:1", enabled=True)
+    assert t.send("127.0.0.1:1") is False   # no raise
