@@ -4,8 +4,10 @@ reference's gateway tests use a real client: every byte crosses a TCP
 socket in genuine Kafka framing, including CRC-checked v2 record
 batches on produce.
 
-Supports exactly the gateway's advertised API versions; consumers use
-manual partition assignment (see kafka_gateway module docstring)."""
+Supports exactly the gateway's advertised API versions; consumers can
+use manual partition assignment or the full group rebalance dance
+(GroupConsumer below: client-side range assignor, heartbeats,
+rejoin-on-rebalance)."""
 
 from __future__ import annotations
 
@@ -247,3 +249,145 @@ class KafkaClient:
                     raise KafkaError(code, "OffsetFetch")
                 return off
         raise KafkaError(-1, "OffsetFetch: empty response")
+
+
+# -- consumer groups (client side of the rebalance dance) ------------------
+
+def encode_subscription(topics: "list[str]") -> bytes:
+    """Consumer protocol subscription v0 (the bytes inside JoinGroup
+    protocol metadata)."""
+    return (enc_i16(0) +
+            enc_array([enc_string(t) for t in topics]) +
+            enc_bytes(b""))
+
+
+def decode_subscription(blob: bytes) -> "list[str]":
+    r = Reader(blob)
+    r.i16()
+    return [r.string() or "" for _ in range(r.i32())]
+
+
+def encode_assignment(parts: "dict[str, list[int]]") -> bytes:
+    """Consumer protocol assignment v0."""
+    return (enc_i16(0) +
+            enc_array([enc_string(t) +
+                       enc_array([enc_i32(p) for p in ps])
+                       for t, ps in sorted(parts.items())]) +
+            enc_bytes(b""))
+
+
+def decode_assignment(blob: bytes) -> "dict[str, list[int]]":
+    if not blob:
+        return {}
+    r = Reader(blob)
+    r.i16()
+    out = {}
+    for _ in range(r.i32()):
+        t = r.string() or ""
+        out[t] = [r.i32() for _ in range(r.i32())]
+    return out
+
+
+class GroupConsumer:
+    """subscribe()-style consumer: joins the group, runs the range
+    assignor when elected leader, heartbeats, and rejoins on
+    rebalance signals — the client half of protocol/joingroup.go."""
+
+    def __init__(self, client: KafkaClient, group: str,
+                 topics: "list[str]",
+                 session_timeout_ms: int = 10000):
+        self.client = client
+        self.group = group
+        self.topics = list(topics)
+        self.session_timeout_ms = session_timeout_ms
+        self.member_id = ""
+        self.generation = -1
+        self.assignment: dict[str, list[int]] = {}
+
+    def join(self) -> "dict[str, list[int]]":
+        """(Re)join until a stable assignment lands."""
+        for _ in range(20):
+            body = (enc_string(self.group) +
+                    enc_i32(self.session_timeout_ms) +
+                    enc_string(self.member_id) +
+                    enc_string("consumer") +
+                    enc_array([enc_string("range") + enc_bytes(
+                        encode_subscription(self.topics))]))
+            r = self.client._rpc(11, 0, body)
+            code = r.i16()
+            generation = r.i32()
+            r.string()                    # protocol
+            leader = r.string() or ""
+            member_id = r.string() or ""
+            members = [(r.string() or "", r.bytes_() or b"")
+                       for _ in range(r.i32())]
+            if code == 25:               # UNKNOWN_MEMBER_ID: reset
+                self.member_id = ""
+                continue
+            if code == 27:               # rebalance superseded us
+                continue
+            if code:
+                raise KafkaError(code, "JoinGroup")
+            self.member_id = member_id
+            self.generation = generation
+            assignments = {}
+            if member_id == leader:
+                assignments = self._range_assign(members)
+            sync = (enc_string(self.group) +
+                    enc_i32(self.generation) +
+                    enc_string(self.member_id) +
+                    enc_array([enc_string(mid) + enc_bytes(blob)
+                               for mid, blob in
+                               sorted(assignments.items())]))
+            r = self.client._rpc(14, 0, sync)
+            code = r.i16()
+            mine = r.bytes_() or b""
+            if code in (22, 27):         # stale generation/rebalance
+                continue
+            if code:
+                raise KafkaError(code, "SyncGroup")
+            self.assignment = decode_assignment(mine)
+            return self.assignment
+        raise KafkaError(-1, "JoinGroup: never stabilized")
+
+    def _range_assign(self, members) -> "dict[str, bytes]":
+        """The classic range assignor over every member's
+        subscription."""
+        subs = {mid: decode_subscription(meta)
+                for mid, meta in members}
+        per_member: dict[str, dict[str, list[int]]] = \
+            {mid: {} for mid in subs}
+        topics = sorted({t for ts in subs.values() for t in ts})
+        md = self.client.metadata(topics) if topics else \
+            {"topics": {}}
+        for topic in topics:
+            info = md["topics"].get(topic, {})
+            count = len(info.get("partitions", []))
+            wanting = sorted(m for m, ts in subs.items()
+                             if topic in ts)
+            if not wanting or not count:
+                continue
+            per = count // len(wanting)
+            extra = count % len(wanting)
+            start = 0
+            for i, mid in enumerate(wanting):
+                n = per + (1 if i < extra else 0)
+                per_member[mid][topic] = list(range(start, start + n))
+                start += n
+        return {mid: encode_assignment(parts)
+                for mid, parts in per_member.items()}
+
+    def heartbeat(self) -> int:
+        """0 = stable; ANY nonzero code means the caller must
+        join() again (27 rebalance, 22 stale generation, 25 expelled
+        — on 25 the member id is reset so the rejoin starts fresh)."""
+        body = (enc_string(self.group) + enc_i32(self.generation) +
+                enc_string(self.member_id))
+        code = self.client._rpc(12, 0, body).i16()
+        if code == 25:               # UNKNOWN_MEMBER_ID: expelled
+            self.member_id = ""
+        return code
+
+    def leave(self) -> None:
+        body = enc_string(self.group) + enc_string(self.member_id)
+        self.client._rpc(13, 0, body).i16()

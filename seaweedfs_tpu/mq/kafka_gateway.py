@@ -6,6 +6,7 @@ onto the broker's topics/partitions:
 
     ApiVersions(18) Metadata(3) CreateTopics(19) Produce(0) Fetch(1)
     ListOffsets(2) FindCoordinator(10) OffsetCommit(8) OffsetFetch(9)
+    JoinGroup(11) Heartbeat(12) LeaveGroup(13) SyncGroup(14)
 
 Kafka topics live in the fixed namespace "kafka" (the reference
 gateway does the same); Kafka partition index i is the i-th ring
@@ -14,11 +15,9 @@ offsets (monotonic int64 — exactly what the protocol requires; they
 are sparse, which clients don't mind: the next fetch offset is
 last_offset+1 and fetches return everything >= it).
 
-Divergence, documented: group REBALANCE (JoinGroup/SyncGroup/
-Heartbeat) is not implemented — consumers must use manual partition
-assignment (`assign()`-style); committed offsets work through
-FindCoordinator + OffsetCommit/OffsetFetch.  The reference implements
-the full rebalance dance (protocol/joingroup.go).
+Consumer groups support the FULL rebalance dance (kafka_groups.py
+coordinator: join rounds, leader-side assignors, heartbeat-driven
+rebalance signals) in addition to manual assignment.
 """
 
 from __future__ import annotations
@@ -29,6 +28,7 @@ import threading
 import time
 
 from .client import MQClient
+from .kafka_groups import GroupCoordinator
 from .kafka_wire import (BatchError, Reader, decode_record_batches,
                          enc_array, enc_bytes, enc_i8, enc_i16,
                          enc_i32, enc_i64, enc_string,
@@ -54,6 +54,10 @@ API_VERSIONS = {
     8: (2, 2),    # OffsetCommit
     9: (1, 1),    # OffsetFetch
     10: (0, 0),   # FindCoordinator
+    11: (0, 0),   # JoinGroup
+    12: (0, 0),   # Heartbeat
+    13: (0, 0),   # LeaveGroup
+    14: (0, 0),   # SyncGroup
     18: (0, 0),   # ApiVersions
     19: (0, 0),   # CreateTopics
 }
@@ -73,6 +77,7 @@ class KafkaGateway:
         self._layouts: dict[str, tuple[int, float]] = {}
         self._layout_ttl = 10.0
         self._lock = threading.Lock()
+        self.groups = GroupCoordinator()
 
     def start(self) -> "KafkaGateway":
         self._sock = socket.create_server((self.host, self.port))
@@ -147,6 +152,8 @@ class KafkaGateway:
         fn = {0: self._produce, 1: self._fetch, 2: self._list_offsets,
               3: self._metadata, 8: self._offset_commit,
               9: self._offset_fetch, 10: self._find_coordinator,
+              11: self._join_group, 12: self._heartbeat,
+              13: self._leave_group, 14: self._sync_group,
               18: self._api_versions, 19: self._create_topics}[api_key]
         body = fn(r)
         return None if body is None else header + body
@@ -414,3 +421,51 @@ class KafkaGateway:
                                  enc_string("") + enc_i16(code))
             topics_out.append(enc_string(name) + enc_array(parts_out))
         return enc_array(topics_out)
+
+    # -- consumer groups (protocol/joingroup.go; kafka_groups.py) ----------
+
+    def _join_group(self, r: Reader) -> bytes:
+        group = r.string() or ""
+        session_timeout = r.i32() / 1000.0
+        member_id = r.string() or ""
+        r.string()                       # protocol_type ("consumer")
+        protocols = []
+        for _ in range(r.i32()):
+            name = r.string() or ""
+            protocols.append((name, r.bytes_() or b""))
+        code, resp = self.groups.join(group, member_id,
+                                      session_timeout, protocols)
+        if code:
+            return (enc_i16(code) + enc_i32(0) + enc_string("") +
+                    enc_string("") + enc_string(member_id) +
+                    enc_array([]))
+        return (enc_i16(0) + enc_i32(resp["generation"]) +
+                enc_string(resp["protocol"]) +
+                enc_string(resp["leader"]) +
+                enc_string(resp["member_id"]) +
+                enc_array([enc_string(mid) + enc_bytes(meta)
+                           for mid, meta in resp["members"]]))
+
+    def _sync_group(self, r: Reader) -> bytes:
+        group = r.string() or ""
+        generation = r.i32()
+        member_id = r.string() or ""
+        assignments = {}
+        for _ in range(r.i32()):
+            mid = r.string() or ""
+            assignments[mid] = r.bytes_() or b""
+        code, assignment = self.groups.sync(group, member_id,
+                                            generation, assignments)
+        return enc_i16(code) + enc_bytes(assignment)
+
+    def _heartbeat(self, r: Reader) -> bytes:
+        group = r.string() or ""
+        generation = r.i32()
+        member_id = r.string() or ""
+        return enc_i16(self.groups.heartbeat(group, member_id,
+                                             generation))
+
+    def _leave_group(self, r: Reader) -> bytes:
+        group = r.string() or ""
+        member_id = r.string() or ""
+        return enc_i16(self.groups.leave(group, member_id))

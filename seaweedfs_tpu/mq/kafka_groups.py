@@ -1,0 +1,233 @@
+"""Kafka group coordinator (reference:
+weed/mq/kafka/protocol/joingroup.go + gateway/coordinator_registry.go).
+
+Implements the classic consumer-group rebalance dance:
+
+  JoinGroup(11): members enter a join round (the first joiner opens a
+      short window; the round closes when every known member rejoined
+      or the window expires).  The FIRST member becomes leader and
+      receives everyone's subscription metadata.
+  SyncGroup(14): the leader submits per-member assignments (the
+      broker treats them as opaque bytes — client-side assignors,
+      exactly Kafka's model); followers block until they arrive.
+  Heartbeat(12): liveness + the rebalance-needed signal
+      (REBALANCE_IN_PROGRESS tells members to rejoin).
+  LeaveGroup(13): immediate rebalance trigger.
+
+Members that stop heartbeating past their session timeout are expired
+lazily, triggering a rebalance for the survivors."""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+# error codes (protocol/errors.go)
+NONE = 0
+UNKNOWN_MEMBER_ID = 25
+ILLEGAL_GENERATION = 22
+REBALANCE_IN_PROGRESS = 27
+INCONSISTENT_GROUP_PROTOCOL = 23
+
+JOIN_WINDOW = 1.0          # seconds the first joiner holds the door
+SYNC_TIMEOUT = 10.0
+
+
+class _Member:
+    def __init__(self, member_id: str, session_timeout: float):
+        self.id = member_id
+        self.session_timeout = session_timeout
+        self.last_seen = time.monotonic()
+        self.metadata = b""
+        self.protocols: list[tuple[str, bytes]] = []
+        self.joined_round = -1
+
+    @property
+    def expired(self) -> bool:
+        return time.monotonic() - self.last_seen > self.session_timeout
+
+
+class _Group:
+    def __init__(self, group_id: str):
+        self.id = group_id
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.generation = 0
+        self.members: dict[str, _Member] = {}
+        self.leader = ""
+        self.protocol = ""
+        self.state = "Empty"      # Empty|Joining|AwaitSync|Stable
+        self.round = 0            # join-round sequence
+        self.round_opened = 0.0
+        self.assignments: dict[str, bytes] = {}
+
+
+class GroupCoordinator:
+    def __init__(self):
+        self._groups: dict[str, _Group] = {}
+        self._lock = threading.Lock()
+
+    def _group(self, group_id: str) -> _Group:
+        with self._lock:
+            g = self._groups.get(group_id)
+            if g is None:
+                g = self._groups[group_id] = _Group(group_id)
+            return g
+
+    @staticmethod
+    def _expire_locked(g: _Group) -> None:
+        dead = [m for m in g.members.values() if m.expired]
+        for m in dead:
+            del g.members[m.id]
+        if dead and g.state == "Stable":
+            # open a GENUINE new round — reusing the old round number
+            # would let the first rejoiner close it instantly and
+            # elect a leader that never rejoined
+            g.state = "Joining"
+            g.round += 1
+            g.round_opened = time.monotonic()
+            g.assignments = {}
+
+    # -- JoinGroup ---------------------------------------------------------
+
+    def join(self, group_id: str, member_id: str,
+             session_timeout: float,
+             protocols: "list[tuple[str, bytes]]"
+             ) -> "tuple[int, dict]":
+        g = self._group(group_id)
+        with g.cond:
+            self._expire_locked(g)
+            if member_id and member_id not in g.members:
+                return UNKNOWN_MEMBER_ID, {}
+            if not member_id:
+                member_id = f"member-{uuid.uuid4().hex[:12]}"
+                g.members[member_id] = _Member(member_id,
+                                               session_timeout)
+            m = g.members[member_id]
+            m.last_seen = time.monotonic()
+            m.protocols = protocols
+            m.metadata = protocols[0][1] if protocols else b""
+            if g.state in ("Empty", "Stable", "AwaitSync"):
+                # open a new join round
+                g.state = "Joining"
+                g.round += 1
+                g.round_opened = time.monotonic()
+                g.assignments = {}
+                g.cond.notify_all()
+            m.joined_round = g.round
+            this_round = g.round
+            # the round closes when every live member has rejoined it,
+            # or the join window expires
+            deadline = g.round_opened + JOIN_WINDOW
+            while g.state == "Joining" and g.round == this_round:
+                missing = [x for x in g.members.values()
+                           if x.joined_round != this_round and
+                           not x.expired]
+                if not missing or time.monotonic() >= deadline:
+                    break
+                g.cond.wait(timeout=0.05)
+            if g.round != this_round:
+                # a newer round superseded us mid-wait: caller rejoins
+                return REBALANCE_IN_PROGRESS, {}
+            if g.state == "Joining":
+                # first thread out closes the round
+                for stale in [x.id for x in g.members.values()
+                              if x.joined_round != this_round]:
+                    del g.members[stale]
+                g.generation += 1
+                ordered = sorted(g.members)
+                g.leader = ordered[0]
+                g.protocol = self._pick_protocol(g)
+                if g.protocol is None:
+                    g.state = "Empty"
+                    g.cond.notify_all()
+                    return INCONSISTENT_GROUP_PROTOCOL, {}
+                g.state = "AwaitSync"
+                g.cond.notify_all()
+            resp = {
+                "generation": g.generation,
+                "protocol": g.protocol,
+                "leader": g.leader,
+                "member_id": member_id,
+                "members": [(x.id, x.metadata)
+                            for x in g.members.values()]
+                if member_id == g.leader else [],
+            }
+            return NONE, resp
+
+    @staticmethod
+    def _pick_protocol(g: _Group) -> "str | None":
+        """First protocol supported by every member."""
+        if not g.members:
+            return None
+        first = next(iter(g.members.values()))
+        for name, _ in first.protocols:
+            if all(any(n == name for n, _ in m.protocols)
+                   for m in g.members.values()):
+                return name
+        return None
+
+    # -- SyncGroup ---------------------------------------------------------
+
+    def sync(self, group_id: str, member_id: str, generation: int,
+             assignments: "dict[str, bytes]"
+             ) -> "tuple[int, bytes]":
+        g = self._group(group_id)
+        with g.cond:
+            if member_id not in g.members:
+                return UNKNOWN_MEMBER_ID, b""
+            if generation != g.generation:
+                return ILLEGAL_GENERATION, b""
+            if g.state == "Joining":
+                return REBALANCE_IN_PROGRESS, b""
+            g.members[member_id].last_seen = time.monotonic()
+            if member_id == g.leader and assignments:
+                g.assignments = dict(assignments)
+                g.state = "Stable"
+                g.cond.notify_all()
+            deadline = time.monotonic() + SYNC_TIMEOUT
+            while g.state == "AwaitSync" and \
+                    generation == g.generation:
+                if time.monotonic() >= deadline:
+                    return REBALANCE_IN_PROGRESS, b""
+                g.cond.wait(timeout=0.05)
+            if generation != g.generation or g.state != "Stable":
+                # a new join round opened while we waited (join() or
+                # leave() during AwaitSync): an empty assignment with
+                # code 0 would read as "stable, own nothing"
+                return REBALANCE_IN_PROGRESS, b""
+            return NONE, g.assignments.get(member_id, b"")
+
+    # -- Heartbeat / LeaveGroup -------------------------------------------
+
+    def heartbeat(self, group_id: str, member_id: str,
+                  generation: int) -> int:
+        g = self._group(group_id)
+        with g.cond:
+            self._expire_locked(g)
+            if member_id not in g.members:
+                return UNKNOWN_MEMBER_ID
+            g.members[member_id].last_seen = time.monotonic()
+            if generation != g.generation:
+                return ILLEGAL_GENERATION
+            if g.state in ("Joining", "AwaitSync"):
+                return REBALANCE_IN_PROGRESS
+            return NONE
+
+    def leave(self, group_id: str, member_id: str) -> int:
+        g = self._group(group_id)
+        with g.cond:
+            if member_id not in g.members:
+                return UNKNOWN_MEMBER_ID
+            del g.members[member_id]
+            if g.members:
+                g.state = "Joining"
+                g.round += 1
+                g.round_opened = time.monotonic()
+                g.assignments = {}
+            else:
+                g.state = "Empty"
+                g.generation += 1
+            g.cond.notify_all()
+            return NONE

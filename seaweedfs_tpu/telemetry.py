@@ -17,7 +17,9 @@ import uuid
 
 from .server.httpd import http_bytes, http_json
 
-VERSION = "seaweedfs-tpu/3.0"
+from . import __version__
+
+VERSION = f"seaweedfs-tpu/{__version__}"
 
 
 class TelemetryClient:

@@ -262,3 +262,124 @@ def test_commit_at_position_zero_roundtrips(kafka):
     assert client.offset_fetch("g0", "zero", 0) == 0
     # and a never-committed partition still reports -1
     assert client.offset_fetch("g0-fresh", "zero", 0) == -1
+
+
+# -- consumer-group rebalance (protocol/joingroup.go analog) ---------------
+
+def _new_client(gw):
+    return KafkaClient("127.0.0.1", gw.port)
+
+
+def test_group_single_member_gets_everything(kafka):
+    from seaweedfs_tpu.mq.kafka_client import GroupConsumer
+    client, _, _ = kafka
+    client.create_topic("solo", partitions=3)
+    gc = GroupConsumer(client, "g-solo", ["solo"])
+    assignment = gc.join()
+    assert assignment == {"solo": [0, 1, 2]}
+    assert gc.heartbeat() == 0
+    gc.leave()
+
+
+def test_group_two_members_split_partitions(kafka):
+    """Two consumers joining concurrently split the topic; after one
+    leaves, the survivor rebalances to take everything."""
+    import threading
+    from seaweedfs_tpu.mq.kafka_client import GroupConsumer
+    client, gw, _ = kafka
+    client.create_topic("shared", partitions=4)
+    c2 = _new_client(gw)
+    gc1 = GroupConsumer(client, "g2", ["shared"])
+    gc2 = GroupConsumer(c2, "g2", ["shared"])
+    results = {}
+
+    def join(name, gc):
+        results[name] = gc.join()
+
+    t1 = threading.Thread(target=join, args=("a", gc1))
+    t2 = threading.Thread(target=join, args=("b", gc2))
+    t1.start(); t2.start()
+    t1.join(timeout=30); t2.join(timeout=30)
+    a = results["a"].get("shared", [])
+    b = results["b"].get("shared", [])
+    assert sorted(a + b) == [0, 1, 2, 3], (a, b)
+    assert a and b, "both members must get a share"
+    assert not set(a) & set(b), "no partition served twice"
+    # heartbeats are stable for both
+    assert gc1.heartbeat() == 0 and gc2.heartbeat() == 0
+    # one leaves: the other's next heartbeat signals rebalance,
+    # and a rejoin hands it the whole topic
+    gc2.leave()
+    deadline = time.time() + 10
+    while gc1.heartbeat() == 0 and time.time() < deadline:
+        time.sleep(0.1)
+    assert gc1.heartbeat() == 27     # REBALANCE_IN_PROGRESS
+    assert gc1.join() == {"shared": [0, 1, 2, 3]}
+    gc1.leave()
+    c2.close()
+
+
+def test_group_end_to_end_consumption(kafka):
+    """The full loop: group assignment -> fetch from assigned
+    partitions -> commit -> a second-generation member resumes."""
+    from seaweedfs_tpu.mq.kafka_client import GroupConsumer
+    client, _, _ = kafka
+    client.create_topic("stream", partitions=2)
+    for p in range(2):
+        client.produce("stream", p, [(b"k", b"p%d-%d" % (p, i))
+                                     for i in range(3)])
+    gc = GroupConsumer(client, "workers2", ["stream"])
+    assignment = gc.join()
+    got = []
+    for p in assignment["stream"]:
+        start = client.offset_fetch("workers2", "stream", p)
+        msgs, _ = client.fetch("stream", p, max(0, start))
+        got += [m["value"] for m in msgs]
+        if msgs:
+            client.offset_commit("workers2", "stream", p,
+                                 msgs[-1]["offset"] + 1)
+    assert sorted(got) == sorted(
+        [b"p%d-%d" % (p, i) for p in range(2) for i in range(3)])
+    gc.leave()
+    # a fresh member in a new generation resumes AFTER the commits
+    gc2 = GroupConsumer(client, "workers2", ["stream"])
+    assignment = gc2.join()
+    for p in assignment["stream"]:
+        start = client.offset_fetch("workers2", "stream", p)
+        msgs, _ = client.fetch("stream", p, start)
+        assert msgs == [], "committed messages must not replay"
+    gc2.leave()
+
+
+def test_group_session_timeout_expels_dead_member(kafka):
+    """A member that stops heartbeating past its session timeout is
+    expelled; survivors rebalance to absorb its partitions."""
+    from seaweedfs_tpu.mq.kafka_client import GroupConsumer
+    import threading
+    client, gw, _ = kafka
+    client.create_topic("mortal", partitions=2)
+    c2 = _new_client(gw)
+    gc1 = GroupConsumer(client, "g-dead", ["mortal"],
+                        session_timeout_ms=1500)
+    gc2 = GroupConsumer(c2, "g-dead", ["mortal"],
+                        session_timeout_ms=1500)
+    results = {}
+    t1 = threading.Thread(
+        target=lambda: results.update(a=gc1.join()))
+    t2 = threading.Thread(
+        target=lambda: results.update(b=gc2.join()))
+    t1.start(); t2.start()
+    t1.join(timeout=30); t2.join(timeout=30)
+    assert results["a"] and results["b"]
+    # gc2 goes silent (no leave, no heartbeat); gc1 keeps beating
+    deadline = time.time() + 15
+    code = 0
+    while time.time() < deadline:
+        code = gc1.heartbeat()
+        if code == 27:
+            break
+        time.sleep(0.3)
+    assert code == 27, "dead member never expired"
+    assert gc1.join() == {"mortal": [0, 1]}
+    gc1.leave()
+    c2.close()
