@@ -3,10 +3,10 @@ weed/mq/kafka/protocol/joingroup.go + gateway/coordinator_registry.go).
 
 Implements the classic consumer-group rebalance dance:
 
-  JoinGroup(11): members enter a join round (the first joiner opens a
-      short window; the round closes when every known member rejoined
-      or the window expires).  The FIRST member becomes leader and
-      receives everyone's subscription metadata.
+  JoinGroup(11): members enter a join round; it closes when every
+      known live member has rejoined (stragglers get up to the
+      rebalance timeout).  The FIRST member id in sort order becomes
+      leader and receives everyone's subscription metadata.
   SyncGroup(14): the leader submits per-member assignments (the
       broker treats them as opaque bytes — client-side assignors,
       exactly Kafka's model); followers block until they arrive.
@@ -30,7 +30,7 @@ ILLEGAL_GENERATION = 22
 REBALANCE_IN_PROGRESS = 27
 INCONSISTENT_GROUP_PROTOCOL = 23
 
-JOIN_WINDOW = 1.0          # seconds the first joiner holds the door
+REBALANCE_TIMEOUT = 30.0   # how long known live members get to rejoin
 SYNC_TIMEOUT = 10.0
 
 
@@ -117,14 +117,18 @@ class GroupCoordinator:
                 g.cond.notify_all()
             m.joined_round = g.round
             this_round = g.round
-            # the round closes when every live member has rejoined it,
-            # or the join window expires
-            deadline = g.round_opened + JOIN_WINDOW
+            # the round closes as soon as every live member has
+            # rejoined it; known LIVE members get up to
+            # REBALANCE_TIMEOUT to show up (a short door would expel
+            # members whose heartbeat cadence is slower than it —
+            # spurious rebalances).  A joiner arriving just after a
+            # close simply opens the next round
+            hard_deadline = g.round_opened + REBALANCE_TIMEOUT
             while g.state == "Joining" and g.round == this_round:
                 missing = [x for x in g.members.values()
                            if x.joined_round != this_round and
                            not x.expired]
-                if not missing or time.monotonic() >= deadline:
+                if not missing or time.monotonic() >= hard_deadline:
                     break
                 g.cond.wait(timeout=0.05)
             if g.round != this_round:

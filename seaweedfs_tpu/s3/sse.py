@@ -73,6 +73,26 @@ def decrypt(key: bytes, iv_hex: str, ciphertext: bytes) -> bytes:
     return dec.update(ciphertext) + dec.finalize()
 
 
+def decrypt_entry(key: bytes, entry_extended: dict,
+                  data: bytes) -> bytes:
+    """Decrypt an object body with entry-level SSE metadata: either a
+    single IV (plain PUT) or the per-part IV table a multipart
+    completion records (each part was encrypted separately, CTR keeps
+    lengths so ciphertext offsets == plaintext offsets)."""
+    import json as _json
+    parts = entry_extended.get("sseParts")
+    if not parts:
+        return decrypt(key, entry_extended["sseIv"], data)
+    table = _json.loads(parts)
+    out = bytearray(len(data))
+    for i, p in enumerate(table):
+        start = int(p["offset"])
+        stop = int(table[i + 1]["offset"]) if i + 1 < len(table) \
+            else len(data)
+        out[start:stop] = decrypt(key, p["iv"], data[start:stop])
+    return bytes(out)
+
+
 SSE_HEADER = "x-amz-server-side-encryption"
 SSE_KMS_KEY_HEADER = "x-amz-server-side-encryption-aws-kms-key-id"
 DEFAULT_KMS_ALIAS = "aws/s3"   # SSE-S3 (AES256) rides a default key
@@ -133,8 +153,7 @@ def kms_decrypt(kms, entry_extended: dict, arn: str,
                          {"aws:s3:arn": arn})
     except KmsError as e:
         raise SseError(403, "AccessDenied", str(e))
-    return decrypt(dk["Plaintext"], entry_extended["sseIv"],
-                   ciphertext)
+    return decrypt_entry(dk["Plaintext"], entry_extended, ciphertext)
 
 
 def kms_response_headers(entry_extended: dict) -> dict:

@@ -27,6 +27,18 @@ CSV = b"name,size\nalpha,10\nbeta,250\ngamma,40\n"
 
 # --- engine unit ---------------------------------------------------------
 
+def _select_rows(body: bytes):
+    """Decode an AWS event-stream Select response into JSON rows,
+    verifying CRCs (s3/eventstream.py)."""
+    from seaweedfs_tpu.s3.eventstream import decode_messages
+    msgs = decode_messages(body)
+    assert msgs[-1][0][":event-type"] == "End"
+    assert any(h[":event-type"] == "Stats" for h, _ in msgs)
+    payload = b"".join(p for h, p in msgs
+                       if h[":event-type"] == "Records")
+    return [json.loads(line) for line in payload.splitlines()]
+
+
 def test_parse_sql_shapes():
     q = parse_sql("SELECT * FROM s3object")
     assert q == {"cols": None, "conds": [], "limit": None}
@@ -129,7 +141,8 @@ def test_s3_select(cluster):
     st, body, h = s3req("POST", "/qb/rows.jsonl", req_xml,
                         query={"select": "", "select-type": "2"})
     assert st == 200, body
-    rows = [json.loads(line) for line in body.splitlines()]
+    assert h.get("Content-Type") == "application/vnd.amazon.eventstream"
+    rows = _select_rows(body)
     assert rows == [{"name": "alpha"}, {"name": "gamma"}]
     # CSV input
     s3req("PUT", "/qb/rows.csv", CSV)
@@ -143,7 +156,7 @@ def test_s3_select(cluster):
     st, body, _ = s3req("POST", "/qb/rows.csv", req_xml,
                         query={"select": "", "select-type": "2"})
     assert st == 200
-    rows = [json.loads(line) for line in body.splitlines()]
+    rows = _select_rows(body)
     assert rows == [{"name": "beta"}, {"name": "gamma"}]
 
 
@@ -197,5 +210,5 @@ def test_s3_select_enforces_sse_c(cluster):
                         query={"select": "", "select-type": "2"},
                         headers=sse)
     assert st == 200
-    rows = [json.loads(line) for line in body.splitlines()]
+    rows = _select_rows(body)
     assert rows == [{"name": "beta"}, {"name": "gamma"}]

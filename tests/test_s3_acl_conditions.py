@@ -304,3 +304,121 @@ def test_bucket_reput_preserves_configs(gw):
     assert st == 200 and b"DeleteObject" in body
     st, body, _ = _signed(gw, "GET", "/keep", query={"acl": ""})
     assert b"AllUsers" in body
+
+
+# -- multipart SSE (closes the 501 gap) ------------------------------------
+
+def _sse_c_headers():
+    import base64
+    import hashlib
+    key = b"K" * 32
+    return key, {
+        "x-amz-server-side-encryption-customer-algorithm": "AES256",
+        "x-amz-server-side-encryption-customer-key":
+            base64.b64encode(key).decode(),
+        "x-amz-server-side-encryption-customer-key-MD5":
+            base64.b64encode(hashlib.md5(key).digest()).decode(),
+    }
+
+
+def _xml_tag(body, tag):
+    root = ET.fromstring(body)
+    for el in root.iter():
+        if el.tag.endswith(tag):
+            return el.text
+    return None
+
+
+def test_multipart_sse_c_roundtrip(gw):
+    key, sse = _sse_c_headers()
+    assert _signed(gw, "PUT", "/mpsse")[0] == 200
+    st, body, _ = _signed(gw, "POST", "/mpsse/big.bin",
+                          query={"uploads": ""}, headers=sse)
+    assert st == 200, body
+    upload_id = _xml_tag(body, "UploadId")
+    parts = [b"A" * 70000, b"B" * 50000, b"C" * 123]
+    # a part WITHOUT the key must be refused
+    st, _, _ = _signed(gw, "PUT", "/mpsse/big.bin", parts[0],
+                       query={"uploadId": upload_id,
+                              "partNumber": "1"})
+    assert st == 400
+    etags = []
+    for i, p in enumerate(parts):
+        st, _, h = _signed(gw, "PUT", "/mpsse/big.bin", p,
+                           query={"uploadId": upload_id,
+                                  "partNumber": str(i + 1)},
+                           headers=sse)
+        assert st == 200
+        etags.append(h["ETag"].strip('"'))
+    manifest = "<CompleteMultipartUpload>" + "".join(
+        f"<Part><PartNumber>{i + 1}</PartNumber>"
+        f"<ETag>{e}</ETag></Part>"
+        for i, e in enumerate(etags)) + "</CompleteMultipartUpload>"
+    st, body, _ = _signed(gw, "POST", "/mpsse/big.bin",
+                          manifest.encode(),
+                          query={"uploadId": upload_id})
+    assert st == 200, body
+    # read back WITH the key: exact content across part boundaries
+    st, body, _ = _signed(gw, "GET", "/mpsse/big.bin", headers=sse)
+    assert st == 200 and body == b"".join(parts)
+    # without the key: refused; at rest: ciphertext
+    assert _signed(gw, "GET", "/mpsse/big.bin")[0] == 400
+    raw = gw.filer.read_file("/buckets/mpsse/big.bin")
+    assert raw != b"".join(parts) and len(raw) == len(b"".join(parts))
+    # ranged read across a part boundary decrypts correctly
+    st, body, _ = _signed(gw, "GET", "/mpsse/big.bin", headers={
+        **sse, "Range": "bytes=69990-70010"})
+    assert st == 206
+    assert body == (b"".join(parts))[69990:70011]
+
+
+def test_multipart_sse_kms_roundtrip(gw_kms):
+    gw = gw_kms
+    assert _signed(gw, "PUT", "/mpkms")[0] == 200
+    st, body, _ = _signed(
+        gw, "POST", "/mpkms/enc.bin", query={"uploads": ""},
+        headers={"x-amz-server-side-encryption": "aws:kms"})
+    assert st == 200, body
+    upload_id = _xml_tag(body, "UploadId")
+    parts = [b"x" * 40000, b"y" * 555]
+    etags = []
+    for i, p in enumerate(parts):
+        st, _, h = _signed(gw, "PUT", "/mpkms/enc.bin", p,
+                           query={"uploadId": upload_id,
+                                  "partNumber": str(i + 1)})
+        assert st == 200
+        etags.append(h["ETag"].strip('"'))
+    manifest = "<CompleteMultipartUpload>" + "".join(
+        f"<Part><PartNumber>{i + 1}</PartNumber>"
+        f"<ETag>{e}</ETag></Part>"
+        for i, e in enumerate(etags)) + "</CompleteMultipartUpload>"
+    st, _, _ = _signed(gw, "POST", "/mpkms/enc.bin",
+                       manifest.encode(),
+                       query={"uploadId": upload_id})
+    assert st == 200
+    st, body, _ = _signed(gw, "GET", "/mpkms/enc.bin")
+    assert st == 200 and body == b"".join(parts)
+    raw = gw.filer.read_file("/buckets/mpkms/enc.bin")
+    assert raw != b"".join(parts)
+
+
+@pytest.fixture
+def gw_kms(tmp_path):
+    from seaweedfs_tpu.iam.kms import LocalKms
+    master = MasterServer().start()
+    vols = [VolumeServer([str(tmp_path / f"kv{i}")], master.url,
+                         pulse_seconds=0.3).start() for i in range(2)]
+    time.sleep(0.5)
+    filer = FilerServer(master.url).start()
+    store = IdentityStore()
+    store.put(Identity("root", [Credential("ADMINKEY",
+                                           "adminsecret")],
+                       actions=["Admin"]))
+    srv = S3ApiServer(filer.filer, iam=store,
+                      kms=LocalKms(str(tmp_path / "kms.json"))).start()
+    yield srv
+    srv.stop()
+    filer.stop()
+    for vs in vols:
+        vs.stop()
+    master.stop()
