@@ -81,7 +81,13 @@ def main(argv: list[str] | None = None) -> int:
     fl.add_argument("-port", type=int, default=8888)
     fl.add_argument("-master", default="127.0.0.1:9333")
     fl.add_argument("-store", default="filer.db",
-                    help="sqlite path, or :memory:")
+                    help="store path (sqlite file / lsm dir), or "
+                         ":memory:")
+    fl.add_argument("-storeType", dest="store_type",
+                    default="sqlite", choices=["sqlite", "lsm"],
+                    help="metadata store archetype (filerstore.go: "
+                         "sqlite=SQL, lsm=embedded ordered-KV — the "
+                         "reference's leveldb default)")
     fl.add_argument("-collection", default="")
     fl.add_argument("-replication", default="")
 
@@ -329,7 +335,8 @@ def main(argv: list[str] | None = None) -> int:
         fs = FilerServer(args.master, args.ip, args.port,
                          store_path=args.store,
                          collection=args.collection,
-                         replication=args.replication)
+                         replication=args.replication,
+                         store_type=args.store_type)
         fs.start()
         print(f"filer listening on {fs.url}")
         _wait()

@@ -1,0 +1,249 @@
+"""Embedded log-structured filer store — the LOCAL ordered-KV
+archetype (reference: weed/filer/leveldb2/, the filer's DEFAULT store;
+ours is a from-scratch LSM-lite rather than a binding, since no
+leveldb library exists in the image).
+
+Design (the leveldb shape, miniaturized):
+  - a WAL absorbs every mutation (JSON lines, fsync-free append —
+    the same durability window as the reference's leveldb WAL with
+    sync=false, its default)
+  - an in-memory sorted memtable serves reads/scans
+  - at MEMTABLE_LIMIT the memtable flushes to an immutable sorted
+    segment file and the WAL resets
+  - reads consult memtable, then segments newest-first; deletes are
+    tombstones
+  - when segments pile past COMPACT_AT, everything merges into one
+    segment (tombstones dropped)
+
+Keys are entry paths; range scans over the sorted keyspace give
+directory listings without touching unrelated subtrees.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import threading
+
+from .entry import Entry
+from .filer_store import FilerStore
+
+MEMTABLE_LIMIT = 1000
+COMPACT_AT = 4
+TOMBSTONE = None          # JSON null marks a delete
+
+
+class LsmTree:
+    """Generic ordered str->dict store with WAL + segments."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        # one lock for memtable/WAL/segment state: the store serves
+        # concurrent HTTP threads (MemoryStore/SqliteStore lock too)
+        self._lock = threading.RLock()
+        self._mem: dict[str, "dict | None"] = {}
+        self._segments: list[tuple[list[str], list]] = []  # old->new
+        self._seg_paths: list[str] = []
+        self._next_seq = 0
+        self._recover()
+        self._wal = open(self._wal_path, "a")
+
+    @property
+    def _wal_path(self) -> str:
+        return os.path.join(self.dir, "wal.log")
+
+    def _recover(self) -> None:
+        names = sorted(n for n in os.listdir(self.dir)
+                       if n.endswith(".seg"))
+        for name in names:
+            path = os.path.join(self.dir, name)
+            keys, vals = [], []
+            with open(path) as f:
+                for line in f:
+                    try:
+                        k, v = json.loads(line)
+                    except ValueError:
+                        continue    # torn tail of a crashed flush
+                    keys.append(k)
+                    vals.append(v)
+            self._segments.append((keys, vals))
+            self._seg_paths.append(path)
+            self._next_seq = max(self._next_seq,
+                                 int(name.split(".")[0]) + 1)
+        if os.path.exists(self._wal_path):
+            with open(self._wal_path) as f:
+                for line in f:
+                    try:
+                        k, v = json.loads(line)
+                    except ValueError:
+                        continue    # torn tail: drop
+                    self._mem[k] = v
+
+    # -- mutations ---------------------------------------------------------
+
+    def put(self, key: str, value: "dict | None") -> None:
+        with self._lock:
+            self._wal.write(json.dumps([key, value],
+                                       separators=(",", ":")) + "\n")
+            self._wal.flush()
+            self._mem[key] = value
+            if len(self._mem) >= MEMTABLE_LIMIT:
+                self.flush_memtable()
+
+    def delete(self, key: str) -> None:
+        self.put(key, TOMBSTONE)
+
+    def flush_memtable(self) -> None:
+      with self._lock:
+        if not self._mem:
+            return
+        seq = self._next_seq
+        self._next_seq += 1
+        path = os.path.join(self.dir, f"{seq:08d}.seg")
+        tmp = path + ".tmp"
+        keys = sorted(self._mem)
+        with open(tmp, "w") as f:
+            for k in keys:
+                f.write(json.dumps([k, self._mem[k]],
+                                   separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._segments.append((keys, [self._mem[k] for k in keys]))
+        self._seg_paths.append(path)
+        self._mem = {}
+        # the flushed state is durable in the segment: reset the WAL
+        self._wal.close()
+        os.remove(self._wal_path)
+        self._wal = open(self._wal_path, "a")
+        if len(self._segments) >= COMPACT_AT:
+            self._compact()
+
+    def _compact(self) -> None:
+        """Merge every segment into one, newest value wins, tombstones
+        dropped (they have nothing older left to shadow).  The merged
+        segment is INSTALLED (under a name that sorts newest) before
+        the old ones are removed — a crash mid-compaction must leave
+        a recoverable superset, never a hole."""
+        merged: dict[str, "dict | None"] = {}
+        for keys, vals in self._segments:      # old -> new
+            for k, v in zip(keys, vals):
+                merged[k] = v
+        live = {k: v for k, v in merged.items() if v is not TOMBSTONE}
+        seq = self._next_seq
+        self._next_seq += 1
+        path = os.path.join(self.dir, f"{seq:08d}.seg")
+        tmp = path + ".tmp"
+        keys = sorted(live)
+        with open(tmp, "w") as f:
+            for k in keys:
+                f.write(json.dumps([k, live[k]],
+                                   separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)          # durable BEFORE any removal
+        for p in self._seg_paths:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+        self._segments = [(keys, [live[k] for k in keys])]
+        self._seg_paths = [path]
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, key: str) -> "dict | None":
+        with self._lock:
+            if key in self._mem:
+                return self._mem[key]
+            for keys, vals in reversed(self._segments):
+                i = bisect.bisect_left(keys, key)
+                if i < len(keys) and keys[i] == key:
+                    return vals[i]
+            return None
+
+    def scan(self, lo: str, hi: str):
+        """Merged ordered iteration over [lo, hi): newest layer wins,
+        tombstones suppress."""
+        with self._lock:
+            seen: dict[str, "dict | None"] = {}
+            for keys, vals in self._segments:  # old -> new overwrite
+                i = bisect.bisect_left(keys, lo)
+                while i < len(keys) and keys[i] < hi:
+                    seen[keys[i]] = vals[i]
+                    i += 1
+            for k, v in self._mem.items():
+                if lo <= k < hi:
+                    seen[k] = v
+        # yield OUTSIDE the lock from the snapshot
+        for k in sorted(seen):
+            if seen[k] is not TOMBSTONE:
+                yield k, seen[k]
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._wal.close()
+            except OSError:
+                pass
+
+
+class LsmStore(FilerStore):
+    """FilerStore over LsmTree (filer/leveldb2/leveldb2_store.go
+    shape: one key per entry path, range scans for listings)."""
+
+    def __init__(self, directory: str):
+        self.tree = LsmTree(directory)
+
+    def insert_entry(self, entry: Entry) -> None:
+        self.tree.put(entry.full_path, entry.to_json())
+
+    def update_entry(self, entry: Entry) -> None:
+        self.insert_entry(entry)
+
+    def find_entry(self, path: str) -> "Entry | None":
+        if path == "/":
+            # the root always exists (same contract as the other
+            # stores: clients stat it before anything else)
+            return Entry("/", is_directory=True)
+        v = self.tree.get(path)
+        return Entry.from_json(v) if v is not None else None
+
+    def delete_entry(self, path: str) -> None:
+        self.tree.delete(path)
+
+    def delete_folder_children(self, path: str) -> None:
+        base = path.rstrip("/")
+        for k, _ in list(self.tree.scan(base + "/",
+                                        base + "/￿")):
+            self.tree.delete(k)
+
+    def list_directory_entries(self, dir_path: str,
+                               start_file: str = "",
+                               include_start: bool = False,
+                               limit: int = 1000,
+                               prefix: str = "") -> "list[Entry]":
+        base = dir_path.rstrip("/")
+        lo = base + "/" + (prefix or "")
+        hi = base + "/￿"
+        out: list[Entry] = []
+        for k, v in self.tree.scan(lo, hi):
+            name = k[len(base) + 1:]
+            if "/" in name:
+                continue              # deeper descendant, not a child
+            if prefix and not name.startswith(prefix):
+                break
+            if start_file:
+                if name < start_file or (name == start_file and
+                                         not include_start):
+                    continue
+            out.append(Entry.from_json(v))
+            if len(out) >= limit:
+                break
+        return out
+
+    def close(self) -> None:
+        self.tree.flush_memtable()
+        self.tree.close()

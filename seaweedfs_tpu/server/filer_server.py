@@ -23,13 +23,26 @@ class FilerServer:
     def __init__(self, master: str, host: str = "127.0.0.1",
                  port: int = 0, store_path: str = ":memory:",
                  collection: str = "", replication: str = "",
-                 meta_log_dir: str | None = None):
+                 meta_log_dir: str | None = None,
+                 store_type: str = "sqlite"):
         if meta_log_dir is None and store_path != ":memory:":
             # persist the metadata log beside the store by default —
             # subscribers must survive a filer restart
             # (filer_notify_append.go)
             meta_log_dir = store_path + ".metalog"
-        self.filer = Filer(master, SqliteStore(store_path),
+        if store_type == "lsm":
+            if store_path == ":memory:":
+                raise ValueError(
+                    "the lsm store needs a directory path, not "
+                    ":memory: (use -storeType sqlite for in-memory)")
+            from ..filer.lsm_store import LsmStore
+            store = LsmStore(store_path)
+        elif store_type == "sqlite":
+            store = SqliteStore(store_path)
+        else:
+            raise ValueError(f"unknown filer store type "
+                             f"{store_type!r} (sqlite|lsm)")
+        self.filer = Filer(master, store,
                            collection=collection,
                            replication=replication,
                            meta_log_dir=meta_log_dir)
