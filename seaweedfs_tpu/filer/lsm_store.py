@@ -22,6 +22,7 @@ directory listings without touching unrelated subtrees.
 from __future__ import annotations
 
 import bisect
+import heapq
 import json
 import os
 import threading
@@ -165,22 +166,37 @@ class LsmTree:
             return None
 
     def scan(self, lo: str, hi: str):
-        """Merged ordered iteration over [lo, hi): newest layer wins,
-        tombstones suppress."""
+        """LAZY merged ordered iteration over [lo, hi): newest layer
+        wins, tombstones suppress.  A heap-merge over per-layer
+        cursors — a caller that stops after one listing page pays for
+        that page, not the whole range (the memtable is bounded by
+        MEMTABLE_LIMIT, so its per-call sort is cheap; segments are
+        immutable, so index cursors are safe outside the lock)."""
         with self._lock:
-            seen: dict[str, "dict | None"] = {}
-            for keys, vals in self._segments:  # old -> new overwrite
-                i = bisect.bisect_left(keys, lo)
-                while i < len(keys) and keys[i] < hi:
-                    seen[keys[i]] = vals[i]
-                    i += 1
-            for k, v in self._mem.items():
-                if lo <= k < hi:
-                    seen[k] = v
-        # yield OUTSIDE the lock from the snapshot
-        for k in sorted(seen):
-            if seen[k] is not TOMBSTONE:
-                yield k, seen[k]
+            mem = sorted((k, v) for k, v in self._mem.items()
+                         if lo <= k < hi)
+            segs = list(self._segments)
+        # priority 0 = newest (memtable), then segments newest-first
+        layers: list[tuple[list, list]] = [
+            ([k for k, _ in mem], [v for _, v in mem])]
+        layers += [seg for seg in reversed(segs)]
+        heap = []
+        for pri, (keys, _vals) in enumerate(layers):
+            i = bisect.bisect_left(keys, lo)
+            if i < len(keys) and keys[i] < hi:
+                heap.append((keys[i], pri, i))
+        heapq.heapify(heap)
+        last_key = None
+        while heap:
+            key, pri, i = heapq.heappop(heap)
+            keys, vals = layers[pri]
+            if i + 1 < len(keys) and keys[i + 1] < hi:
+                heapq.heappush(heap, (keys[i + 1], pri, i + 1))
+            if key == last_key:
+                continue        # an older layer's shadowed value
+            last_key = key
+            if vals[i] is not TOMBSTONE:
+                yield key, vals[i]
 
     def close(self) -> None:
         with self._lock:
@@ -217,7 +233,7 @@ class LsmStore(FilerStore):
     def delete_folder_children(self, path: str) -> None:
         base = path.rstrip("/")
         for k, _ in list(self.tree.scan(base + "/",
-                                        base + "/￿")):
+                                        base + "0")):
             self.tree.delete(k)
 
     def list_directory_entries(self, dir_path: str,
@@ -227,7 +243,10 @@ class LsmStore(FilerStore):
                                prefix: str = "") -> "list[Entry]":
         base = dir_path.rstrip("/")
         lo = base + "/" + (prefix or "")
-        hi = base + "/￿"
+        # exclusive bound: "/"+1 = "0" covers EVERY
+        # continuation, incl. astral-plane names a U+FFFF
+        # bound would miss
+        hi = base + "0"
         out: list[Entry] = []
         for k, v in self.tree.scan(lo, hi):
             name = k[len(base) + 1:]

@@ -253,6 +253,7 @@ _SUBRESOURCE_ACTIONS = {
     "cors": "BucketCORS",
     "versioning": "BucketVersioning",
     "object-lock": "BucketObjectLockConfiguration",
+    "lifecycle": "BucketLifecycle",
     "versions": None,  # ListBucketVersions, handled below
 }
 

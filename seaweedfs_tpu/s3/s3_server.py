@@ -422,6 +422,34 @@ class S3ApiServer:
         self._policy_cache[bucket] = (doc, stmts)
         return stmts
 
+    def _bucket_lifecycle_op(self, req: Request, bucket: str):
+        """Put/Get/DeleteBucketLifecycleConfiguration
+        (s3api_bucket_handlers.go:800): rules persist on the bucket
+        entry; the shell's s3.lifecycle.apply pass enforces them."""
+        e = self.filer.find_entry(self._bucket_path(bucket))
+        if e is None:
+            return _error(404, "NoSuchBucket", bucket)
+        if req.method == "PUT":
+            from .lifecycle import LifecycleError, parse_lifecycle
+            try:
+                parse_lifecycle(req.body)
+            except LifecycleError as err:
+                return _error(400, "MalformedXML", str(err))
+            e.extended["lifecycle"] = req.body.decode()
+            self.filer.create_entry(e, create_parents=False)
+            return 200, b""
+        if req.method == "GET":
+            doc = e.extended.get("lifecycle", "")
+            if not doc:
+                return _error(404,
+                              "NoSuchLifecycleConfiguration", bucket)
+            return 200, (doc.encode(), "application/xml")
+        if req.method == "DELETE":
+            e.extended.pop("lifecycle", None)
+            self.filer.create_entry(e, create_parents=False)
+            return 204, b""
+        return _error(405, "MethodNotAllowed", req.method)
+
     def _bucket_policy_op(self, req: Request, bucket: str):
         """Put/Get/DeleteBucketPolicy (s3api policy_engine).  Policy
         mutation itself requires a SIGNED request — an anonymous
@@ -688,6 +716,8 @@ class S3ApiServer:
             return self._bucket_object_lock_op(req, bucket)
         if "policy" in req.query:
             return self._bucket_policy_op(req, bucket)
+        if "lifecycle" in req.query:
+            return self._bucket_lifecycle_op(req, bucket)
         if "cors" in req.query:
             return self._bucket_cors_op(req, bucket)
         if "acl" in req.query:
@@ -735,7 +765,9 @@ class S3ApiServer:
     # -- objects ----------------------------------------------------------
 
     def _object_op(self, req: Request, bucket: str, key: str):
-        if self.filer.find_entry(self._bucket_path(bucket)) is None:
+        bucket_entry = self.filer.find_entry(
+            self._bucket_path(bucket))
+        if bucket_entry is None:
             return _error(404, "NoSuchBucket", bucket)
         if any(seg.endswith(VERSIONS_EXT)
                for seg in key.split("/") if seg):
@@ -748,6 +780,16 @@ class S3ApiServer:
             return self._acl_op(req, bucket, key)
         if "select" in req.query and req.method == "POST":
             return self._select_object(req, bucket, key)
+        if req.method == "PUT" or ("uploads" in req.query or
+                                   "uploadId" in req.query):
+            # quota enforcement (s3.bucket.quota.enforce): an
+            # over-quota bucket is read-only — writes refused,
+            # deletes still allowed so users can free space
+            if bucket_entry.extended.get("readOnly") == "true" and \
+                    req.method in ("PUT", "POST"):
+                return _error(403, "AccessDenied",
+                              f"bucket {bucket} is read-only "
+                              f"(quota exceeded)")
         if "uploads" in req.query and req.method == "POST":
             return self._initiate_multipart(req, bucket, key)
         if "uploadId" in req.query:

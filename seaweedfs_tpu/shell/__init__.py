@@ -4,3 +4,4 @@ maintenance commands driving master + volume servers."""
 from .commands import CommandEnv, COMMANDS, run_command  # noqa: F401
 from . import fs_commands  # noqa: F401  (registers fs.* + repair cmds)
 from . import remote_commands  # noqa: F401  (registers remote.*)
+from . import s3_commands  # noqa: F401  (registers s3.*)

@@ -1,0 +1,116 @@
+"""s3.* shell family (reference: weed/shell/command_s3_bucket_quota*.go
++ the lifecycle enforcement pass):
+
+    s3.bucket.quota          -bucket=b [-limitMB=N | -remove]
+    s3.bucket.quota.enforce  flips over-quota buckets read-only (and
+                             back) — the reference's
+                             s3.bucket.quota.enforce
+    s3.lifecycle.apply       one enforcement pass over every bucket
+                             with a lifecycle configuration
+"""
+
+from __future__ import annotations
+
+from ..filer.client import FilerClient
+from .commands import CommandEnv, _parse_flags, command
+
+BUCKETS_ROOT = "/buckets"
+
+
+def _client(env: CommandEnv) -> FilerClient:
+    return FilerClient(env.require_filer())
+
+
+def _bucket_usage(fc: FilerClient, path: str) -> int:
+    """Recursive content bytes under a bucket (chunk extents)."""
+    total = 0
+    last = ""
+    while True:
+        batch = fc.list_directory(path, start_file=last, limit=500)
+        if not batch:
+            return total
+        for e in batch:
+            if e.is_directory:
+                if not e.name.startswith("."):
+                    total += _bucket_usage(fc, e.full_path)
+            else:
+                total += e.total_size()
+        if len(batch) < 500:
+            return total
+        last = batch[-1].name
+
+
+def _patch_extended(fc: FilerClient, path: str, patch: dict) -> None:
+    # one shared client for /__meta__/patch_extended (also used by
+    # the remote-storage gateway)
+    from ..remote.remote_storage import _meta_patch_extended
+    _meta_patch_extended(fc.filer, path, patch)
+
+
+@command("s3.bucket.quota")
+def s3_bucket_quota(env: CommandEnv, args: list[str]) -> str:
+    flags = _parse_flags(args)
+    bucket = flags.get("bucket", "")
+    fc = _client(env)
+    path = f"{BUCKETS_ROOT}/{bucket}"
+    entry = fc.find_entry(path)
+    if entry is None:
+        return f"no such bucket {bucket!r}"
+    if "remove" in flags:
+        _patch_extended(fc, path, {"quotaBytes": "",
+                                   "readOnly": ""})
+        return f"quota removed from {bucket}"
+    if "limitMB" not in flags:
+        q = entry.extended.get("quotaBytes", "")
+        used = _bucket_usage(fc, path)
+        return (f"{bucket}: quota="
+                f"{q or 'none'} used={used} "
+                f"readOnly={entry.extended.get('readOnly', 'false')}")
+    limit = int(float(flags["limitMB"]) * 1024 * 1024)
+    _patch_extended(fc, path, {"quotaBytes": str(limit)})
+    return f"quota on {bucket}: {limit} bytes"
+
+
+@command("s3.bucket.quota.enforce")
+def s3_bucket_quota_enforce(env: CommandEnv,
+                            args: list[str]) -> str:
+    fc = _client(env)
+    lines = []
+    for b in fc.list_directory(BUCKETS_ROOT, limit=10000):
+        if not b.is_directory:
+            continue
+        quota = b.extended.get("quotaBytes", "")
+        if not quota:
+            continue
+        used = _bucket_usage(fc, b.full_path)
+        over = used > int(quota)
+        was = b.extended.get("readOnly") == "true"
+        if over != was:
+            _patch_extended(fc, b.full_path,
+                            {"readOnly": "true" if over else ""})
+        lines.append(f"{b.name}: used={used}/{quota} "
+                     f"{'READ-ONLY' if over else 'ok'}")
+    return "\n".join(lines) or "no buckets carry quotas"
+
+
+@command("s3.lifecycle.apply")
+def s3_lifecycle_apply(env: CommandEnv, args: list[str]) -> str:
+    from ..s3.lifecycle import (LifecycleError, apply_lifecycle,
+                                parse_lifecycle)
+    fc = _client(env)
+    lines = []
+    for b in fc.list_directory(BUCKETS_ROOT, limit=10000):
+        if not b.is_directory:
+            continue
+        doc = b.extended.get("lifecycle", "")
+        if not doc:
+            continue
+        try:
+            rules = parse_lifecycle(doc.encode())
+        except LifecycleError as e:
+            lines.append(f"{b.name}: bad lifecycle config: {e}")
+            continue
+        deleted, aborted = apply_lifecycle(fc, b.full_path, rules)
+        lines.append(f"{b.name}: expired {deleted} objects, "
+                     f"aborted {aborted} uploads")
+    return "\n".join(lines) or "no buckets carry lifecycle configs"

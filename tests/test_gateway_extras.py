@@ -234,7 +234,11 @@ def test_sse_c_copy_object(cluster):
     assert body == payload
 
 
-def test_multipart_refuses_sse(cluster):
+def test_multipart_sse_initiation_binds_key(cluster):
+    """Multipart SSE is now supported: initiation binds the SSE-C
+    key; parts WITHOUT the key are refused (the old 501 blanket
+    refusal is gone — see test_s3_acl_conditions for the full
+    roundtrip)."""
     *_, gw = cluster
     s3req(gw, "PUT", "/mpb")
     signed = sign_request("POST", gw.url, "/mpb/x",
@@ -242,4 +246,15 @@ def test_multipart_refuses_sse(cluster):
                           b"", AK, SK)
     st, body, _ = http_bytes("POST", f"{gw.url}/mpb/x?uploads=",
                              None, signed)
-    assert st == 501 and b"NotImplemented" in body
+    assert st == 200 and b"UploadId" in body
+    import xml.etree.ElementTree as ET
+    uid = next(e.text for e in ET.fromstring(body).iter()
+               if e.tag.endswith("UploadId"))
+    # a part without the initiate-time key must be refused
+    q = {"uploadId": uid, "partNumber": "1"}
+    signed = sign_request("PUT", gw.url, "/mpb/x", q, {}, b"data",
+                          AK, SK)
+    st, body, _ = http_bytes(
+        "PUT", f"{gw.url}/mpb/x?uploadId={uid}&partNumber=1",
+        b"data", signed)
+    assert st == 400

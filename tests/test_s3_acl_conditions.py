@@ -422,3 +422,111 @@ def gw_kms(tmp_path):
     for vs in vols:
         vs.stop()
     master.stop()
+
+
+# -- lifecycle + quotas ----------------------------------------------------
+
+def test_lifecycle_config_and_apply(gw):
+    from seaweedfs_tpu.shell import COMMANDS, CommandEnv
+    assert _signed(gw, "PUT", "/logs")[0] == 200
+    # invalid config rejected
+    st, _, _ = _signed(gw, "PUT", "/logs", b"<LifecycleConfiguration>"
+                       b"<Rule><Status>Maybe</Status></Rule>"
+                       b"</LifecycleConfiguration>",
+                       query={"lifecycle": ""})
+    assert st == 400
+    cfg = (b"<LifecycleConfiguration><Rule><ID>old-logs</ID>"
+           b"<Filter><Prefix>old/</Prefix></Filter>"
+           b"<Status>Enabled</Status>"
+           b"<Expiration><Days>7</Days></Expiration>"
+           b"</Rule></LifecycleConfiguration>")
+    st, _, _ = _signed(gw, "PUT", "/logs", cfg,
+                       query={"lifecycle": ""})
+    assert st == 200
+    st, body, _ = _signed(gw, "GET", "/logs",
+                          query={"lifecycle": ""})
+    assert st == 200 and b"old-logs" in body
+    # seed: one stale object under the prefix, one fresh, one outside
+    assert _signed(gw, "PUT", "/logs/old/stale.log", b"x")[0] == 200
+    assert _signed(gw, "PUT", "/logs/old/fresh.log", b"y")[0] == 200
+    assert _signed(gw, "PUT", "/logs/keep.log", b"z")[0] == 200
+    stale = gw.filer.find_entry("/buckets/logs/old/stale.log")
+    stale.attributes.mtime -= 30 * 86400
+    gw.filer.create_entry(stale, create_parents=False)
+    env = CommandEnv("", filer=gw.filer_url_for_tests) \
+        if hasattr(gw, "filer_url_for_tests") else None
+    # drive apply directly against the in-process filer
+    from seaweedfs_tpu.s3.lifecycle import (apply_lifecycle,
+                                            parse_lifecycle)
+    rules = parse_lifecycle(cfg)
+    deleted, aborted = apply_lifecycle(gw.filer, "/buckets/logs",
+                                       rules)
+    assert (deleted, aborted) == (1, 0)
+    assert gw.filer.find_entry("/buckets/logs/old/stale.log") is None
+    assert gw.filer.find_entry("/buckets/logs/old/fresh.log")
+    assert gw.filer.find_entry("/buckets/logs/keep.log")
+    # delete config
+    assert _signed(gw, "DELETE", "/logs",
+                   query={"lifecycle": ""})[0] == 204
+    assert _signed(gw, "GET", "/logs",
+                   query={"lifecycle": ""})[0] == 404
+
+
+def test_bucket_quota_read_only(gw):
+    assert _signed(gw, "PUT", "/capped")[0] == 200
+    assert _signed(gw, "PUT", "/capped/a.bin", b"x" * 1000)[0] == 200
+    # flip read-only the way quota.enforce does
+    e = gw.filer.find_entry("/buckets/capped")
+    e.extended["quotaBytes"] = "500"
+    e.extended["readOnly"] = "true"
+    gw.filer.create_entry(e, create_parents=False)
+    assert _signed(gw, "PUT", "/capped/b.bin", b"y")[0] == 403
+    # reads and deletes still work (deletes free space)
+    assert _signed(gw, "GET", "/capped/a.bin")[1] == b"x" * 1000
+    assert _signed(gw, "DELETE", "/capped/a.bin")[0] in (200, 204)
+    # clearing the flag restores writes
+    e = gw.filer.find_entry("/buckets/capped")
+    e.extended["readOnly"] = ""
+    gw.filer.create_entry(e, create_parents=False)
+    assert _signed(gw, "PUT", "/capped/b.bin", b"y")[0] == 200
+
+
+def test_quota_shell_enforce_roundtrip(tmp_path):
+    """The full shell path: s3.bucket.quota sets the limit,
+    quota.enforce flips read-only on a real over-quota bucket and
+    clears it after deletes."""
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.shell import COMMANDS, CommandEnv
+    master = MasterServer().start()
+    vols = [VolumeServer([str(tmp_path / f"qv{i}")], master.url,
+                         pulse_seconds=0.3).start()
+            for i in range(2)]
+    time.sleep(0.5)
+    filer = FilerServer(master.url).start()
+    store = IdentityStore()
+    store.put(Identity("root", [Credential("ADMINKEY",
+                                           "adminsecret")],
+                       actions=["Admin"]))
+    gw = S3ApiServer(filer.filer, iam=store).start()
+    env = CommandEnv("", filer=filer.url)
+    try:
+        assert _signed(gw, "PUT", "/teams")[0] == 200
+        assert _signed(gw, "PUT", "/teams/big.bin",
+                       b"D" * 200_000)[0] == 200
+        out = COMMANDS["s3.bucket.quota"](
+            env, ["-bucket=teams", "-limitMB=0.1"])
+        assert "104857" in out
+        out = COMMANDS["s3.bucket.quota.enforce"](env, [])
+        assert "READ-ONLY" in out
+        assert _signed(gw, "PUT", "/teams/more.bin", b"x")[0] == 403
+        assert _signed(gw, "DELETE", "/teams/big.bin")[0] in (200,
+                                                              204)
+        out = COMMANDS["s3.bucket.quota.enforce"](env, [])
+        assert "ok" in out
+        assert _signed(gw, "PUT", "/teams/more.bin", b"x")[0] == 200
+    finally:
+        gw.stop()
+        filer.stop()
+        for vs in vols:
+            vs.stop()
+        master.stop()
