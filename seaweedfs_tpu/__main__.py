@@ -95,6 +95,10 @@ def main(argv: list[str] | None = None) -> int:
     s3p.add_argument("-ip", default="127.0.0.1")
     s3p.add_argument("-port", type=int, default=8333)
     s3p.add_argument("-master", default="127.0.0.1:9333")
+    s3p.add_argument("-filer", default="",
+                     help="attach to a RUNNING filer's namespace "
+                          "(the reference's weed s3 -filer mode); "
+                          "overrides -master/-store")
     s3p.add_argument("-store", default="filer.db")
     s3p.add_argument("-accessKey", default="")
     s3p.add_argument("-secretKey", default="")
@@ -358,11 +362,17 @@ def main(argv: list[str] | None = None) -> int:
         if args.kms_file:
             from .iam.kms import LocalKms
             kms = LocalKms(args.kms_file)
-        filer = Filer(args.master, SqliteStore(args.store))
-        gw = S3ApiServer(filer, args.ip, args.port, credentials=creds,
+        if args.filer:
+            from .filer.client import FilerClient
+            backend = FilerClient(args.filer)
+        else:
+            backend = Filer(args.master, SqliteStore(args.store))
+        gw = S3ApiServer(backend, args.ip, args.port,
+                         credentials=creds,
                          iam=iam_store, sts=sts, kms=kms)
         gw.start()
-        print(f"s3 gateway listening on {gw.url}")
+        print(f"s3 gateway listening on {gw.url}" +
+              (f" (filer {args.filer})" if args.filer else ""))
         _wait()
     elif args.cmd == "iam":
         from .iam import IdentityStore, StsService

@@ -54,20 +54,31 @@ class FilerClient:
 
     def create_entry(self, entry: Entry,
                      create_parents: bool = True) -> None:
-        if entry.is_directory:
-            st, _, _ = http_bytes(
-                "PUT", self._url(entry.full_path.rstrip("/") + "/"))
-            if st not in (200, 201):
-                raise OSError(f"filer mkdir {entry.full_path}: {st}")
-            return
-        raise NotImplementedError(
-            "create_entry for files: use write_file")
+        """Full-entry create/replace via /__meta__/put_entry
+        (filer.proto CreateEntry): carries attributes, extended
+        metadata and the chunk list — gateways mutate entries they
+        fetched (etag/SSE/lock config) or assembled (multipart
+        completion) and write them back whole."""
+        st, body, _ = http_bytes(
+            "POST", f"{self.filer}/__meta__/put_entry",
+            json.dumps(entry.to_json()).encode(),
+            {"Content-Type": "application/json"})
+        if st != 200:
+            raise OSError(f"filer put_entry {entry.full_path}: {st} "
+                          f"{body[:200]!r}")
 
     def delete_entry(self, path: str, recursive: bool = False,
                      delete_chunks: bool = True) -> None:
+        q = []
+        if recursive:
+            q.append("recursive=true")
+        if not delete_chunks:
+            # metadata-only delete: the chunks now belong to another
+            # entry (multipart completion)
+            q.append("ignoreChunks=true")
         st, body, _ = http_bytes(
             "DELETE",
-            self._url(path, "?recursive=true" if recursive else ""))
+            self._url(path, "?" + "&".join(q) if q else ""))
         if st == 409:
             raise IsADirectoryError(body.decode(errors="replace"))
         if st not in (204, 200, 404):

@@ -47,49 +47,86 @@ class Rule:
         return None
 
 
+def _tag(el) -> str:
+    return el.tag.rsplit("}", 1)[-1]
+
+
+def _child_text(parent, name: str) -> "str | None":
+    for el in parent:
+        if _tag(el) == name and el.text and el.text.strip():
+            return el.text.strip()
+    return None
+
+
 def parse_lifecycle(doc: bytes) -> "list[Rule]":
+    """Element-SCOPED parsing: a <Days> inside <Transition> must not
+    read as Expiration, and unsupported actions/filters are REJECTED
+    rather than silently dropped (misreading either turns a
+    non-destructive config into data deletion)."""
     try:
         root = ET.fromstring(doc)
     except ET.ParseError as e:
         raise LifecycleError(f"undecodable lifecycle XML: {e}")
     rules = []
-    for rule_el in root.iter():
-        if not rule_el.tag.endswith("Rule"):
+    for rule_el in root:
+        if not _tag(rule_el).endswith("Rule"):
             continue
-        fields: dict[str, str] = {}
-        for el in rule_el.iter():
-            tag = el.tag.rsplit("}", 1)[-1]
-            if el.text and el.text.strip():
-                fields[tag] = el.text.strip()
-        status = fields.get("Status", "")
+        rule_id = _child_text(rule_el, "ID") or ""
+        status = _child_text(rule_el, "Status") or ""
         if status not in ("Enabled", "Disabled"):
             raise LifecycleError(f"Rule needs Status "
                                  f"Enabled|Disabled, got {status!r}")
+        prefix = _child_text(rule_el, "Prefix") or ""
         expire_days = expire_date = abort_days = None
-        try:
-            if "Days" in fields:
-                expire_days = int(fields["Days"])
-                if expire_days <= 0:
+        for el in rule_el:
+            tag = _tag(el)
+            if tag in ("ID", "Status", "Prefix"):
+                continue
+            if tag == "Filter":
+                for f in el:
+                    if _tag(f) == "Prefix":
+                        prefix = (f.text or "").strip()
+                    else:
+                        raise LifecycleError(
+                            f"unsupported Filter element "
+                            f"{_tag(f)!r} (only Prefix)")
+                continue
+            if tag == "Expiration":
+                days = _child_text(el, "Days")
+                date = _child_text(el, "Date")
+                try:
+                    if days is not None:
+                        expire_days = int(days)
+                        if expire_days <= 0:
+                            raise LifecycleError(
+                                "Expiration Days must be > 0")
+                    if date is not None:
+                        expire_date = datetime.fromisoformat(
+                            date.replace("Z", "+00:00")).astimezone(
+                            timezone.utc).timestamp()
+                except ValueError as e:
+                    raise LifecycleError(str(e))
+                continue
+            if tag == "AbortIncompleteMultipartUpload":
+                raw = _child_text(el, "DaysAfterInitiation")
+                try:
+                    abort_days = int(raw) if raw is not None else None
+                except ValueError as e:
+                    raise LifecycleError(str(e))
+                if abort_days is None or abort_days <= 0:
                     raise LifecycleError(
-                        "Expiration Days must be > 0")
-            if "Date" in fields:
-                expire_date = datetime.fromisoformat(
-                    fields["Date"].replace("Z", "+00:00")).astimezone(
-                    timezone.utc).timestamp()
-            if "DaysAfterInitiation" in fields:
-                abort_days = int(fields["DaysAfterInitiation"])
-        except ValueError as e:
-            # non-numeric Days / malformed Date are client errors
-            raise LifecycleError(str(e))
+                        "DaysAfterInitiation must be > 0")
+                continue
+            # Transition / NoncurrentVersionExpiration / unknown:
+            # refusing beats misinterpreting a non-destructive action
+            raise LifecycleError(f"unsupported Rule element {tag!r}")
         if expire_days is None and expire_date is None and \
                 abort_days is None:
             raise LifecycleError(
                 "Rule needs an Expiration or "
                 "AbortIncompleteMultipartUpload action")
-        rules.append(Rule(fields.get("ID", ""),
-                          fields.get("Prefix", ""),
-                          status == "Enabled", expire_days,
-                          expire_date, abort_days))
+        rules.append(Rule(rule_id, prefix, status == "Enabled",
+                          expire_days, expire_date, abort_days))
     if not rules:
         raise LifecycleError("no Rule elements")
     return rules
@@ -140,8 +177,13 @@ def _expire_tree(filer, bucket_path: str, directory: str,
         for e in batch:
             rel = e.full_path[len(bucket_path):].lstrip("/")
             if e.is_directory:
-                if e.name.startswith("."):
-                    continue            # .uploads / .versions scratch
+                if e.name.startswith(".") or \
+                        e.name.endswith(".versions"):
+                    # .uploads scratch + "<key>.versions" archives:
+                    # Expiration must never hard-delete version
+                    # history (that is NoncurrentVersionExpiration,
+                    # unsupported -> untouched)
+                    continue
                 # descend only if the prefix could match inside
                 if not prefix or prefix.startswith(rel + "/") or \
                         rel.startswith(prefix):

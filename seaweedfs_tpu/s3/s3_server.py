@@ -430,12 +430,22 @@ class S3ApiServer:
         if e is None:
             return _error(404, "NoSuchBucket", bucket)
         if req.method == "PUT":
+            # like _bucket_policy_op: lifecycle mutation is
+            # destructive config — anonymous principals (even on
+            # policy-opened buckets) may not install rules that
+            # delete data
+            if self.verifier is not None and \
+                    not getattr(req, "s3_identity", None):
+                return _error(403, "AccessDenied",
+                              "lifecycle mutation requires a signed "
+                              "request")
             from .lifecycle import LifecycleError, parse_lifecycle
             try:
                 parse_lifecycle(req.body)
-            except LifecycleError as err:
+                doc = req.body.decode()
+            except (LifecycleError, UnicodeDecodeError) as err:
                 return _error(400, "MalformedXML", str(err))
-            e.extended["lifecycle"] = req.body.decode()
+            e.extended["lifecycle"] = doc
             self.filer.create_entry(e, create_parents=False)
             return 200, b""
         if req.method == "GET":
@@ -445,6 +455,11 @@ class S3ApiServer:
                               "NoSuchLifecycleConfiguration", bucket)
             return 200, (doc.encode(), "application/xml")
         if req.method == "DELETE":
+            if self.verifier is not None and \
+                    not getattr(req, "s3_identity", None):
+                return _error(403, "AccessDenied",
+                              "lifecycle mutation requires a signed "
+                              "request")
             e.extended.pop("lifecycle", None)
             self.filer.create_entry(e, create_parents=False)
             return 204, b""

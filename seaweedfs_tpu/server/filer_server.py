@@ -53,6 +53,8 @@ class FilerServer:
                         self._meta_set_attrs)
         self.http.route("POST", "/__meta__/create",
                         self._meta_create)
+        self.http.route("POST", "/__meta__/put_entry",
+                        self._meta_put_entry)
         self.http.route("POST", "/__meta__/patch_extended",
                         self._meta_patch_extended)
         self.http.route("GET", "/__meta__/events", self._meta_events)
@@ -89,7 +91,11 @@ class FilerServer:
     # -- dispatch ---------------------------------------------------------
 
     def _dispatch(self, req: Request):
-        path = req.path
+        import urllib.parse
+        # the wire path is percent-encoded (every client quotes);
+        # storing it un-decoded would persist names like "a%21" for
+        # "a!" — visible in listings and to in-process consumers
+        path = urllib.parse.unquote(req.path)
         if path.startswith("/__tus__/"):
             return self._tus(req, path)
         if path.startswith("/__chunk__/"):
@@ -212,9 +218,15 @@ class FilerServer:
 
     def _delete(self, req: Request, path: str):
         recursive = req.query.get("recursive", "") == "true"
+        # ignoreChunks: remove metadata only (filer.proto
+        # DeleteEntryRequest.is_delete_data=false) — multipart
+        # completion strips its scratch dir while the final entry now
+        # references the parts' chunks
+        keep_chunks = req.query.get("ignoreChunks", "") == "true"
         try:
             self.filer.delete_entry(path.rstrip("/") or "/",
-                                    recursive=recursive)
+                                    recursive=recursive,
+                                    delete_chunks=not keep_chunks)
         except IsADirectoryError as e:
             return 409, {"error": str(e)}
         return 204, b""
@@ -373,6 +385,16 @@ class FilerServer:
             # remote-pointer refresh) must reclaim the old content —
             # write_file does the same for content overwrites
             self.filer._delete_chunks(old_entry)
+        return 200, {}
+
+    def _meta_put_entry(self, req: Request):
+        """Full-entry create/replace (filer.proto CreateEntry):
+        attributes, extended metadata AND chunk list — what remote
+        gateways (weed s3 -filer) need to write entries they
+        assembled themselves (multipart completion, delete markers,
+        config mutations)."""
+        from ..filer.entry import Entry
+        self.filer.create_entry(Entry.from_json(req.json()))
         return 200, {}
 
     def _meta_patch_extended(self, req: Request):

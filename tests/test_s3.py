@@ -18,14 +18,20 @@ from seaweedfs_tpu.server.volume_server import VolumeServer
 CREDS = {"AKIDEXAMPLE": "secretkey123"}
 
 
-@pytest.fixture
-def s3(tmp_path):
+@pytest.fixture(params=["inprocess", "remote"])
+def s3(tmp_path, request):
+    """Both gateway attachment modes (same pattern as webdav/sftp):
+    in-process Filer, and the FilerClient the `s3 -filer` CLI uses
+    against a RUNNING filer's shared namespace."""
+    from seaweedfs_tpu.filer.client import FilerClient
     master = MasterServer().start()
     servers = [VolumeServer([str(tmp_path / f"v{i}")], master.url,
                             pulse_seconds=0.3).start() for i in range(2)]
     time.sleep(0.5)
     filer = FilerServer(master.url).start()
-    gw = S3ApiServer(filer.filer, credentials=CREDS).start()
+    backend = filer.filer if request.param == "inprocess" \
+        else FilerClient(filer.url)
+    gw = S3ApiServer(backend, credentials=CREDS).start()
     yield gw
     gw.stop()
     filer.stop()

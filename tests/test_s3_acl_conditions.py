@@ -427,7 +427,6 @@ def gw_kms(tmp_path):
 # -- lifecycle + quotas ----------------------------------------------------
 
 def test_lifecycle_config_and_apply(gw):
-    from seaweedfs_tpu.shell import COMMANDS, CommandEnv
     assert _signed(gw, "PUT", "/logs")[0] == 200
     # invalid config rejected
     st, _, _ = _signed(gw, "PUT", "/logs", b"<LifecycleConfiguration>"
@@ -453,8 +452,6 @@ def test_lifecycle_config_and_apply(gw):
     stale = gw.filer.find_entry("/buckets/logs/old/stale.log")
     stale.attributes.mtime -= 30 * 86400
     gw.filer.create_entry(stale, create_parents=False)
-    env = CommandEnv("", filer=gw.filer_url_for_tests) \
-        if hasattr(gw, "filer_url_for_tests") else None
     # drive apply directly against the in-process filer
     from seaweedfs_tpu.s3.lifecycle import (apply_lifecycle,
                                             parse_lifecycle)
@@ -530,3 +527,83 @@ def test_quota_shell_enforce_roundtrip(tmp_path):
         for vs in vols:
             vs.stop()
         master.stop()
+
+
+def test_lifecycle_never_touches_version_archives(gw):
+    """Code-review regression: Expiration must not hard-delete
+    '<key>.versions' archives (that's NoncurrentVersionExpiration,
+    unsupported -> untouched); and Transition/Tag rules are rejected
+    rather than misread as deletions."""
+    from seaweedfs_tpu.s3.lifecycle import (LifecycleError,
+                                            apply_lifecycle,
+                                            parse_lifecycle)
+    import pytest as _pytest
+    assert _signed(gw, "PUT", "/vlc")[0] == 200
+    st, _, _ = _signed(gw, "PUT", "/vlc", b"", query={
+        "versioning": ""},
+        headers={"Content-Type": "application/xml"})
+    # enable versioning
+    cfg = (b"<VersioningConfiguration><Status>Enabled</Status>"
+           b"</VersioningConfiguration>")
+    st, _, _ = _signed(gw, "PUT", "/vlc", cfg,
+                       query={"versioning": ""})
+    assert st == 200
+    _signed(gw, "PUT", "/vlc/doc.txt", b"v1")
+    _signed(gw, "PUT", "/vlc/doc.txt", b"v2")
+    # age the CURRENT entry so the rule matches it
+    cur = gw.filer.find_entry("/buckets/vlc/doc.txt")
+    cur.attributes.mtime -= 90 * 86400
+    gw.filer.create_entry(cur, create_parents=False)
+    vdir = gw.filer.list_directory("/buckets/vlc/doc.txt.versions")
+    assert vdir, "archive must exist"
+    for v in vdir:
+        v.attributes.mtime -= 90 * 86400
+        gw.filer.create_entry(v, create_parents=False)
+    rules = parse_lifecycle(
+        b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+        b"<Expiration><Days>30</Days></Expiration>"
+        b"</Rule></LifecycleConfiguration>")
+    deleted, _ = apply_lifecycle(gw.filer, "/buckets/vlc", rules)
+    assert deleted == 1                       # the current object
+    assert gw.filer.list_directory("/buckets/vlc/doc.txt.versions"), \
+        "version archive was destroyed"
+    # Transition is refused, not misread as Expiration
+    with _pytest.raises(LifecycleError):
+        parse_lifecycle(
+            b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+            b"<Transition><Days>30</Days>"
+            b"<StorageClass>GLACIER</StorageClass></Transition>"
+            b"</Rule></LifecycleConfiguration>")
+    # zero DaysAfterInitiation is refused
+    with _pytest.raises(LifecycleError):
+        parse_lifecycle(
+            b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+            b"<AbortIncompleteMultipartUpload>"
+            b"<DaysAfterInitiation>0</DaysAfterInitiation>"
+            b"</AbortIncompleteMultipartUpload>"
+            b"</Rule></LifecycleConfiguration>")
+
+
+def test_lifecycle_mutation_needs_signature(gw):
+    """Anonymous principals must not install/delete lifecycle rules
+    even when a bucket policy opens the bucket wide."""
+    import urllib.request as _rq
+    assert _signed(gw, "PUT", "/openlc")[0] == 200
+    policy = json.dumps({"Statement": [{
+        "Effect": "Allow", "Principal": "*", "Action": "s3:*",
+        "Resource": ["arn:aws:s3:::openlc",
+                     "arn:aws:s3:::openlc/*"]}]})
+    st, _, _ = _signed(gw, "PUT", "/openlc", policy.encode(),
+                       query={"policy": ""})
+    assert st in (200, 204)
+    cfg = (b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+           b"<Expiration><Days>1</Days></Expiration>"
+           b"</Rule></LifecycleConfiguration>")
+    req = _rq.Request(f"http://{gw.url}/openlc?lifecycle=", data=cfg,
+                      method="PUT")
+    try:
+        with _rq.urlopen(req, timeout=15) as r:
+            code = r.status
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code == 403
