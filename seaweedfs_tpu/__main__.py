@@ -121,6 +121,11 @@ def main(argv: list[str] | None = None) -> int:
     iamp.add_argument("-iamConfig", dest="iam_config", required=True)
     iamp.add_argument("-stsKey", dest="sts_key", default="")
     iamp.add_argument("-rolesFile", dest="roles_file", default="")
+    iamp.add_argument("-oidcConfig", dest="oidc_config", default="",
+                      help="JSON list of OIDC providers: [{name, "
+                           "issuer, audience?, hs256Secret? | "
+                           "rsaPublicKeyFile?}] — enables "
+                           "AssumeRoleWithWebIdentity")
 
     ad = sub.add_parser("admin", help="start the maintenance admin server")
     ad.add_argument("-ip", default="127.0.0.1")
@@ -382,6 +387,25 @@ def main(argv: list[str] | None = None) -> int:
         sts = StsService(args.sts_key,
                          RoleStore(args.roles_file or None)) \
             if args.sts_key else None
+        if args.oidc_config and sts is None:
+            p.error("-oidcConfig requires -stsKey (web identities "
+                    "mint STS credentials)")
+        if sts is not None and args.oidc_config:
+            import json as _json
+            from .iam.oidc import OidcProvider
+            with open(args.oidc_config) as f:
+                for p in _json.load(f):
+                    pems = []
+                    if p.get("rsaPublicKeyFile"):
+                        with open(p["rsaPublicKeyFile"], "rb") as kf:
+                            pems.append(kf.read())
+                    sts.add_provider(OidcProvider(
+                        p["name"], p["issuer"],
+                        p.get("audience", ""),
+                        rsa_public_keys_pem=pems,
+                        hs256_secret=p.get("hs256Secret", "")))
+                    print(f"oidc provider {p['name']} "
+                          f"({p['issuer']})")
         srv = IamApiServer(store, sts, args.ip, args.port).start()
         print(f"iam api on {srv.url}")
         _wait()

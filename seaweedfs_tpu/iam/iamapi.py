@@ -161,6 +161,10 @@ class IamApiServer:
         form = {k: v[0] for k, v in
                 urllib.parse.parse_qs(req.body.decode()).items()}
         action = form.get("Action", "")
+        if action == "AssumeRoleWithWebIdentity":
+            # the web-identity TOKEN is the credential — no SigV4
+            # (AWS STS semantics; sts_service.go:431)
+            return self._assume_role_with_web_identity(form)
         caller = self._caller(req)
         if caller is None:
             return _error_xml(403, "AccessDenied",
@@ -325,33 +329,62 @@ class IamApiServer:
 
     # -- STS ---------------------------------------------------------------
 
-    def _assume_role(self, caller: Identity, form: dict):
-        if self.sts is None:
-            return _error_xml(400, "InvalidAction",
-                              "no STS service configured")
+    @staticmethod
+    def _parse_assume_form(form: dict):
+        """(role, session, duration) shared by both AssumeRole
+        flavors; raises IamError on bad input."""
         role = form.get("RoleArn", "") or form.get("RoleName", "")
-        role = role.rsplit("/", 1)[-1]       # accept full role ARNs
+        role = role.rsplit("/", 1)[-1]
         session = form.get("RoleSessionName", "session")
         try:
             duration = int(form.get("DurationSeconds", "3600"))
         except ValueError:
-            return _error_xml(400, "InvalidInput",
-                              "DurationSeconds must be an integer")
-        try:
-            creds = self.sts.assume_role(caller, role, session,
-                                         duration)
-        except StsError as e:
-            return _error_xml(403, "AccessDenied", str(e))
+            raise IamError(400, "InvalidInput",
+                           "DurationSeconds must be an integer")
+        return role, session, duration
+
+    @staticmethod
+    def _credentials_response(action: str, creds: dict):
+        import time as _time
 
         def fill(r):
             c = ET.SubElement(r, "Credentials")
-            ET.SubElement(c, "AccessKeyId").text = \
-                creds["AccessKeyId"]
-            ET.SubElement(c, "SecretAccessKey").text = \
-                creds["SecretAccessKey"]
-            ET.SubElement(c, "SessionToken").text = \
-                creds["SessionToken"]
-            ET.SubElement(c, "Expiration").text = \
-                str(creds["Expiration"])
+            for tag in ("AccessKeyId", "SecretAccessKey",
+                        "SessionToken"):
+                ET.SubElement(c, tag).text = str(creds[tag])
+            # AWS wire format: ISO 8601, not a raw epoch
+            ET.SubElement(c, "Expiration").text = _time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ",
+                _time.gmtime(int(creds["Expiration"])))
             ET.SubElement(r, "AssumedRoleUser")
-        return _response("AssumeRole", fill)
+        return _response(action, fill)
+
+    def _assume_role_with_web_identity(self, form: dict):
+        if self.sts is None:
+            return _error_xml(400, "InvalidAction",
+                              "no STS service configured")
+        try:
+            role, session, duration = self._parse_assume_form(form)
+            creds = self.sts.assume_role_with_web_identity(
+                form.get("WebIdentityToken", ""), role, session,
+                duration)
+        except IamError as e:
+            return _error_xml(e.status, e.code, str(e))
+        except StsError as e:
+            return _error_xml(403, "AccessDenied", str(e))
+        return self._credentials_response("AssumeRoleWithWebIdentity",
+                                          creds)
+
+    def _assume_role(self, caller: Identity, form: dict):
+        if self.sts is None:
+            return _error_xml(400, "InvalidAction",
+                              "no STS service configured")
+        try:
+            role, session, duration = self._parse_assume_form(form)
+            creds = self.sts.assume_role(caller, role, session,
+                                         duration)
+        except IamError as e:
+            return _error_xml(e.status, e.code, str(e))
+        except StsError as e:
+            return _error_xml(403, "AccessDenied", str(e))
+        return self._credentials_response("AssumeRole", creds)

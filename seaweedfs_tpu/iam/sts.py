@@ -85,20 +85,37 @@ class StsService:
         self.signing_key = signing_key
         self.roles = roles or RoleStore()
         self.issuer = issuer
+        self.providers: dict[str, object] = {}  # OIDC by name
+
+    def add_provider(self, provider) -> None:
+        """Register an identity provider (iam/oidc.OidcProvider) for
+        AssumeRoleWithWebIdentity."""
+        self.providers[provider.name] = provider
 
     # -- minting -----------------------------------------------------------
 
     def assume_role(self, caller: Identity, role_name: str,
                     session_name: str = "session",
-                    duration: int = DEFAULT_DURATION) -> dict:
+                    duration: int = DEFAULT_DURATION,
+                    external: bool = False) -> dict:
         """sts_service.go AssumeRoleWithCredentials: the caller must be
-        trusted by the role; returns AWS-shaped Credentials."""
+        trusted by the role; returns AWS-shaped Credentials.
+
+        `external` marks federated (web-identity) callers: they are
+        admitted ONLY by trust entries that explicitly name the
+        federation namespace ("oidc:..."), never by a bare "*" — the
+        wildcard predates federation and means "any AUTHENTICATED
+        LOCAL identity"; letting any IdP token satisfy it would be a
+        silent privilege escalation."""
         role = self.roles.get(role_name)
         if role is None:
             raise StsError(f"no such role {role_name}")
         import fnmatch
+        trust = role.get("trust", [])
+        if external:
+            trust = [p for p in trust if p.startswith("oidc:")]
         if not any(fnmatch.fnmatchcase(caller.name, pat)
-                   for pat in role.get("trust", [])):
+                   for pat in trust):
             raise StsError(
                 f"identity {caller.name} not trusted by {role_name}")
         duration = max(900, min(int(duration), MAX_DURATION))
@@ -122,6 +139,30 @@ class StsService:
             "SessionToken": token,
             "Expiration": now + duration,
         }
+
+    def assume_role_with_web_identity(self, token: str,
+                                      role_name: str,
+                                      session_name: str = "web",
+                                      duration: int = DEFAULT_DURATION
+                                      ) -> dict:
+        """sts_service.go:431 AssumeRoleWithWebIdentity: validate the
+        OIDC id token against every registered provider; the role's
+        trust list must admit the external principal
+        (oidc:<provider>#<sub>, wildcards allowed)."""
+        from .oidc import OidcError
+        reasons = []
+        for name, provider in self.providers.items():
+            try:
+                ext = provider.validate(token)
+            except OidcError as e:
+                reasons.append(f"{name}: {e}")
+                continue
+            caller = Identity(ext.principal, actions=[])
+            return self.assume_role(caller, role_name,
+                                    session_name, duration,
+                                    external=True)
+        raise StsError("web identity rejected: " + (
+            "; ".join(reasons) or "no identity providers registered"))
 
     def _derive_secret(self, access_key: str) -> str:
         """token_utils.go: secret = KDF(signing key, access key) —

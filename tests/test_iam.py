@@ -409,3 +409,135 @@ def test_sse_kms_roundtrip(cluster):
                             "aws:kms"})
     assert st == 200
     assert _s3(gw, "GET", "/enc/copy.bin")[1] == b"kms payload"
+
+
+# -- OIDC web-identity federation (iam/oidc/) ------------------------------
+
+def test_oidc_token_validation():
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from seaweedfs_tpu.iam.oidc import (OidcError, OidcProvider,
+                                        mint_test_token)
+    key = rsa.generate_private_key(public_exponent=65537,
+                                   key_size=2048)
+    pem = key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo)
+    prov = OidcProvider("corp", "https://idp.example", "s3-app",
+                        rsa_public_keys_pem=[pem])
+    now = int(time.time())
+    good = {"iss": "https://idp.example", "aud": "s3-app",
+            "sub": "alice", "email": "a@example.com",
+            "groups": ["eng"], "exp": now + 600}
+    ext = prov.validate(mint_test_token(good, rsa_private_key=key))
+    assert ext.principal == "oidc:corp#alice"
+    assert ext.groups == ["eng"]
+    # wrong issuer / audience / expired / tampered all rejected
+    for bad in ({**good, "iss": "https://evil.example"},
+                {**good, "aud": "other-app"},
+                {**good, "exp": now - 10}):
+        with pytest.raises(OidcError):
+            prov.validate(mint_test_token(bad, rsa_private_key=key))
+    tampered = mint_test_token(good, rsa_private_key=key)[:-6] + "AAAAAA"
+    with pytest.raises(OidcError):
+        prov.validate(tampered)
+    # a token signed by a DIFFERENT key is rejected
+    other = rsa.generate_private_key(public_exponent=65537,
+                                     key_size=2048)
+    with pytest.raises(OidcError):
+        prov.validate(mint_test_token(good, rsa_private_key=other))
+
+
+def test_assume_role_with_web_identity_end_to_end(cluster):
+    """OIDC token -> STS temp credentials -> S3 access, all through
+    the REST surface with NO static credential involved."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from seaweedfs_tpu.iam.oidc import OidcProvider, mint_test_token
+    gw, iam_srv, _ = cluster
+    key = rsa.generate_private_key(public_exponent=65537,
+                                   key_size=2048)
+    pem = key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo)
+    sts = iam_srv.sts
+    sts.add_provider(OidcProvider("corp", "https://idp.example",
+                                  rsa_public_keys_pem=[pem]))
+    sts.roles.put("web-writer", ["Write:shared", "Read:shared",
+                                 "List:shared"],
+                  trust=["oidc:corp#*"])
+    assert _s3(gw, "PUT", "/shared")[0] == 200
+    token = mint_test_token(
+        {"iss": "https://idp.example", "sub": "dev-1",
+         "exp": int(time.time()) + 600}, rsa_private_key=key)
+    body = urllib.parse.urlencode({
+        "Action": "AssumeRoleWithWebIdentity",
+        "RoleName": "web-writer", "WebIdentityToken": token}).encode()
+    req = urllib.request.Request(f"http://{iam_srv.url}/", data=body,
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=15) as r:
+        out = r.read()
+    vals = {e.tag.rsplit("}", 1)[-1]: e.text
+            for e in ET.fromstring(out).iter()}
+    st, _, _ = _s3(gw, "PUT", "/shared/from-web.txt", b"via oidc",
+                   access=vals["AccessKeyId"],
+                   secret=vals["SecretAccessKey"],
+                   token=vals["SessionToken"])
+    assert st == 200
+    assert _s3(gw, "GET", "/shared/from-web.txt",
+               access=vals["AccessKeyId"],
+               secret=vals["SecretAccessKey"],
+               token=vals["SessionToken"])[1] == b"via oidc"
+    # an untrusted role refuses the web identity
+    sts.roles.put("admin-only", ["Admin"], trust=["root"])
+    body = urllib.parse.urlencode({
+        "Action": "AssumeRoleWithWebIdentity",
+        "RoleName": "admin-only", "WebIdentityToken": token}).encode()
+    req = urllib.request.Request(f"http://{iam_srv.url}/", data=body,
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            code = r.status
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code == 403
+    # garbage tokens are rejected
+    body = urllib.parse.urlencode({
+        "Action": "AssumeRoleWithWebIdentity",
+        "RoleName": "web-writer",
+        "WebIdentityToken": "not.a.jwt"}).encode()
+    req = urllib.request.Request(f"http://{iam_srv.url}/", data=body,
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            code = r.status
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code == 403
+
+
+def test_external_identity_never_satisfies_bare_wildcard_trust():
+    """Code-review regression (privilege escalation): a role trusting
+    '*' means any authenticated LOCAL identity — a federated OIDC
+    principal must need an explicit oidc: trust entry."""
+    from seaweedfs_tpu.iam.oidc import OidcProvider, mint_test_token
+    roles = RoleStore()
+    roles.put("ops-admin", ["Admin"])              # default trust ["*"]
+    roles.put("web-ok", ["Read:pub"], trust=["oidc:corp#*"])
+    sts = StsService(STS_KEY, roles)
+    sts.add_provider(OidcProvider("corp", "https://idp.example",
+                                  hs256_secret="s"))
+    tok = mint_test_token({"iss": "https://idp.example",
+                           "sub": "anyone",
+                           "exp": int(time.time()) + 600},
+                          hs256_secret="s")
+    with pytest.raises(StsError):
+        sts.assume_role_with_web_identity(tok, "ops-admin")
+    assert sts.assume_role_with_web_identity(tok, "web-ok")
+    # local identities still satisfy "*"
+    assert sts.assume_role(Identity("local-user"), "ops-admin")
+    # tokens without exp are rejected outright
+    noexp = mint_test_token({"iss": "https://idp.example",
+                             "sub": "x"}, hs256_secret="s")
+    with pytest.raises(StsError):
+        sts.assume_role_with_web_identity(noexp, "web-ok")
