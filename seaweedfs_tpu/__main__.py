@@ -54,6 +54,10 @@ def main(argv: list[str] | None = None) -> int:
                         "secretKey] — lets this server reopen tiered "
                         "volumes after restart (master.toml "
                         "[storage.backend.s3] analog)")
+    v.add_argument("-metricsAddress", dest="metrics_address",
+                   default="", help="Prometheus pushgateway host:port")
+    v.add_argument("-metricsIntervalSec", dest="metrics_interval",
+                   type=int, default=15)
 
     s = sub.add_parser(
         "server", help="all-in-one: master + volume (+ filer + s3), the "
@@ -307,6 +311,12 @@ def main(argv: list[str] | None = None) -> int:
                           max_volume_count=args.max,
                           data_center=args.dataCenter, rack=args.rack)
         vs.start()
+        if args.metrics_address:
+            from .stats import MetricsPusher
+            MetricsPusher(vs.metrics, "volume_server", vs.url,
+                          args.metrics_address,
+                          args.metrics_interval).start()
+            print(f"pushing metrics to {args.metrics_address}")
         print(f"volume server listening on {vs.url}")
         _wait()
     elif args.cmd == "server":
@@ -394,18 +404,19 @@ def main(argv: list[str] | None = None) -> int:
             import json as _json
             from .iam.oidc import OidcProvider
             with open(args.oidc_config) as f:
-                for p in _json.load(f):
+                for cfg in _json.load(f):
                     pems = []
-                    if p.get("rsaPublicKeyFile"):
-                        with open(p["rsaPublicKeyFile"], "rb") as kf:
+                    if cfg.get("rsaPublicKeyFile"):
+                        with open(cfg["rsaPublicKeyFile"],
+                                  "rb") as kf:
                             pems.append(kf.read())
                     sts.add_provider(OidcProvider(
-                        p["name"], p["issuer"],
-                        p.get("audience", ""),
+                        cfg["name"], cfg["issuer"],
+                        cfg.get("audience", ""),
                         rsa_public_keys_pem=pems,
-                        hs256_secret=p.get("hs256Secret", "")))
-                    print(f"oidc provider {p['name']} "
-                          f"({p['issuer']})")
+                        hs256_secret=cfg.get("hs256Secret", "")))
+                    print(f"oidc provider {cfg['name']} "
+                          f"({cfg['issuer']})")
         srv = IamApiServer(store, sts, args.ip, args.port).start()
         print(f"iam api on {srv.url}")
         _wait()

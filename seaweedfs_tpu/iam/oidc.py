@@ -70,6 +70,11 @@ class OidcProvider:
             sig = _b64url_decode(parts[2])
         except (ValueError, TypeError):
             raise OidcError("undecodable id token")
+        if not isinstance(header, dict) or \
+                not isinstance(claims, dict):
+            # valid JSON that is not an object (e.g. "[1]") must be a
+            # 403-class rejection, not an AttributeError-500
+            raise OidcError("undecodable id token")
         signing_input = f"{parts[0]}.{parts[1]}".encode()
         alg = header.get("alg", "")
         if alg == "RS256":
@@ -109,9 +114,12 @@ class OidcProvider:
         sub = claims.get("sub", "")
         if not sub:
             raise OidcError("id token carries no sub")
+        groups = claims.get("groups", [])
+        if not isinstance(groups, list):
+            groups = [str(groups)] if groups else []
         return ExternalIdentity(
-            self.name, sub, claims.get("email", ""),
-            list(claims.get("groups", [])), claims)
+            self.name, str(sub), str(claims.get("email", "") or ""),
+            [str(g) for g in groups], claims)
 
     def _verify_rs256(self, signing_input: bytes,
                       sig: bytes) -> None:

@@ -541,3 +541,17 @@ def test_external_identity_never_satisfies_bare_wildcard_trust():
                              "sub": "x"}, hs256_secret="s")
     with pytest.raises(StsError):
         sts.assume_role_with_web_identity(noexp, "web-ok")
+
+
+def test_oidc_rejects_non_object_token_segments():
+    """Code-review regression: valid-JSON-but-not-object segments
+    must 403-reject, not crash the handler."""
+    import base64
+    from seaweedfs_tpu.iam.oidc import OidcError, OidcProvider
+    prov = OidcProvider("corp", "https://idp.example",
+                        hs256_secret="s")
+    seg = base64.urlsafe_b64encode(b"[1]").rstrip(b"=").decode()
+    obj = base64.urlsafe_b64encode(b"{}").rstrip(b"=").decode()
+    for tok in (f"{seg}.{obj}.AAAA", f"{obj}.{seg}.AAAA"):
+        with pytest.raises(OidcError):
+            prov.validate(tok)
