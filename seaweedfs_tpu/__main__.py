@@ -559,7 +559,11 @@ def main(argv: list[str] | None = None) -> int:
         from .shell import CommandEnv, run_command
         env = CommandEnv(args.master, filer=args.filer)
         if args.command:
-            print(run_command(env, " ".join(args.command)))
+            # ';'-separated sequences share one env (so `lock;
+            # volume.move ...; unlock` works as a one-shot)
+            for one in " ".join(args.command).split(";"):
+                if one.strip():
+                    print(run_command(env, one.strip()))
             return 0
         _repl(env)
     elif args.cmd == "benchmark":

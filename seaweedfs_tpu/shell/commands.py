@@ -637,3 +637,141 @@ def run_command(env: CommandEnv, line: str) -> str:
         raise ValueError(
             f"unknown command {name!r}; known: {sorted(COMMANDS)}")
     return fn(env, args)
+
+
+def _volume_meta(env: CommandEnv, vid: int) -> "dict | None":
+    """Collection etc. from the master volume list (the lookup
+    endpoint returns urls only)."""
+    from ..topology import iter_volume_list_volumes
+    for _node, v in iter_volume_list_volumes(env.volume_list()):
+        if v["id"] == vid:
+            return v
+    return None
+
+
+@command("volume.copy")
+def cmd_volume_copy(env: CommandEnv, args: list[str]) -> str:
+    """shell/command_volume_copy.go: replicate one volume to a target
+    server — freeze-copy-mount via the shared _move_volume pipeline
+    (unfenced copies of live volumes tear .dat/.idx)."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    vid = int(opts["volumeId"])
+    dst = opts["target"]
+    locs = env.volume_locations(vid)
+    if not locs:
+        return f"volume {vid} not found"
+    src = opts.get("source", locs[0]["url"])
+    meta = _volume_meta(env, vid) or {}
+    if any(loc["url"] == dst for loc in locs):
+        return f"volume {vid} already on {dst}"
+    _move_volume(env, vid, meta.get("collection", ""), src, dst,
+                 delete_source=False)
+    return f"copied volume {vid}: {src} -> {dst}"
+
+
+@command("volume.move")
+def cmd_volume_move(env: CommandEnv, args: list[str]) -> str:
+    """shell/command_volume_move.go: freeze, copy to target, mount,
+    delete at the source (the shared _move_volume pipeline — data is
+    readable at every step)."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    vid = int(opts["volumeId"])
+    src = opts["source"]
+    dst = opts["target"]
+    if src == dst:
+        return "source and target are the same server"
+    locs = env.volume_locations(vid)
+    if not any(loc["url"] == src for loc in locs):
+        return f"volume {vid} is not on {src}"
+    meta = _volume_meta(env, vid) or {}
+    collection = meta.get("collection", "")
+    if any(loc["url"] == dst for loc in locs):
+        # target already holds a replica: deleting src would still
+        # need its copy verified — just drop the source replica
+        _must(http_json("POST", f"{src}/admin/delete_volume",
+                        {"volumeId": vid,
+                         "collection": collection}),
+              f"delete on {src}")
+    else:
+        _move_volume(env, vid, collection, src, dst,
+                     delete_source=True)
+    return f"moved volume {vid}: {src} -> {dst}"
+
+
+@command("volume.grow")
+def cmd_volume_grow(env: CommandEnv, args: list[str]) -> str:
+    """shell/command_volume_grow.go / master VolumeGrow."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    r = master_json(env.master, "POST", "/vol/grow", {
+        "collection": opts.get("collection", ""),
+        "replication": opts.get("replication", ""),
+        "count": int(opts.get("count", 1))})
+    if "volumeIds" not in r:
+        return f"grow failed: {r}"
+    return f"grew volumes: {r['volumeIds']}"
+
+
+@command("collection.list")
+def cmd_collection_list(env: CommandEnv, args: list[str]) -> str:
+    """shell/command_collection_list.go: collections + volume counts
+    from the master's volume list."""
+    from ..topology import iter_volume_list_volumes
+    vols: dict[str, set] = {}
+    for _node, v in iter_volume_list_volumes(env.volume_list()):
+        # count DISTINCT volumes, not replica pairs
+        vols.setdefault(v.get("collection", ""), set()).add(v["id"])
+    return "\n".join(
+        f"{name or '(default)'}: {len(ids)} volumes"
+        for name, ids in sorted(vols.items())) or "no volumes"
+
+
+@command("collection.delete")
+def cmd_collection_delete(env: CommandEnv, args: list[str]) -> str:
+    """shell/command_collection_delete.go: delete every volume of a
+    collection on every server (requires the lock + an explicit
+    -force)."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    name = opts.get("collection", "")
+    if not name:
+        return "need -collection=<name>"
+    if "force" not in opts:
+        return ("this deletes EVERY volume of the collection; "
+                "re-run with -force")
+    from ..topology import iter_volume_list_volumes
+    deleted = []
+    vl = env.volume_list()
+    for node, v in list(iter_volume_list_volumes(vl)):
+        if v.get("collection", "") != name:
+            continue
+        _must(http_json("POST", f"{node['url']}/admin/delete_volume",
+                        {"volumeId": v["id"],
+                         "collection": name}),
+              f"delete {v['id']} on {node['url']}")
+        deleted.append(v["id"])
+    # EC volumes of the collection too (the Go analog deletes both)
+    ec_deleted = []
+    for dc in vl.get("dataCenters", {}).values():
+        for rack in dc.get("racks", {}).values():
+            for node in rack.get("nodes", []):
+                for e in node.get("ecShards", []):
+                    if e.get("collection", "") != name:
+                        continue
+                    shard_ids = [i for i in range(32)
+                                 if e.get("shardBits", 0) >> i & 1]
+                    _must(http_json(
+                        "POST",
+                        f"{node['url']}/admin/ec/delete_shards",
+                        {"volumeId": e["volumeId"],
+                         "collection": name,
+                         "shardIds": shard_ids}),
+                        f"delete ec {e['volumeId']} on "
+                        f"{node['url']}")
+                    ec_deleted.append(e["volumeId"])
+    out = f"deleted collection {name!r}: volumes {sorted(set(deleted))}"
+    if ec_deleted:
+        out += f", ec volumes {sorted(set(ec_deleted))}"
+    return out

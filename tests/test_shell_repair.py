@@ -192,3 +192,57 @@ def _ec_vids(master_url):
     vl = http_json("GET", f"{master_url}/vol/list")
     return sorted({e["volumeId"]
                    for _n, e in iter_volume_list_ec_shards(vl)})
+
+
+# -- volume.copy / volume.move / volume.grow / collection.* ----------------
+
+def test_volume_move_and_copy(cluster):
+    """command_volume_move.go analog: data stays readable after a
+    copy and after a move (copy-first ordering)."""
+    master, servers, _filer, env = cluster
+    fid = operation.submit(master.url, b"move me around")
+    vid = int(fid.split(",")[0])
+    locs = env.volume_locations(vid)
+    src = locs[0]["url"]
+    others = [s.url for s in servers if s.url != src and
+              not any(l["url"] == s.url for l in locs)]
+    assert others, "need a free target server"
+    dst = others[0]
+    run_command(env, "lock")
+    out = run_command(env, f"volume.copy -volumeId={vid} "
+                          f"-target={dst}")
+    assert "copied" in out
+    assert operation.read(master.url, fid) == b"move me around"
+    out = run_command(env, f"volume.move -volumeId={vid} "
+                          f"-source={src} -target={dst}")
+    assert "already on" in out or "moved" in out
+    # move away from dst's sibling: ensure reads still work through
+    # whatever replica remains
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            assert operation.read(master.url, fid) == \
+                b"move me around"
+            break
+        except (RuntimeError, LookupError, OSError):
+            time.sleep(0.3)
+    assert operation.read(master.url, fid) == b"move me around"
+    run_command(env, "unlock")
+
+
+def test_volume_grow_and_collections(cluster):
+    master, servers, _filer, env = cluster
+    run_command(env, "lock")
+    out = run_command(env, "volume.grow -collection=photos -count=2")
+    assert "grew volumes" in out
+    out = run_command(env, "collection.list")
+    assert "photos: 2 volumes" in out
+    # delete needs -force
+    out = run_command(env, "collection.delete -collection=photos")
+    assert "-force" in out
+    out = run_command(env,
+                      "collection.delete -collection=photos -force")
+    assert "deleted collection" in out
+    out = run_command(env, "collection.list")
+    assert "photos" not in out
+    run_command(env, "unlock")
