@@ -115,6 +115,10 @@ def main(argv: list[str] | None = None) -> int:
     s3p.add_argument("-rolesFile", dest="roles_file", default="")
     s3p.add_argument("-kmsFile", dest="kms_file", default="",
                      help="local KMS keystore (enables SSE-KMS)")
+    s3p.add_argument("-kmsEndpoint", dest="kms_endpoint", default="",
+                     help="remote AWS-KMS-protocol endpoint "
+                          "host:port[,accessKey,secretKey[,region]] "
+                          "(kms/aws analog); overrides -kmsFile")
 
     iamp = sub.add_parser(
         "iam", help="IAM management API + STS AssumeRole "
@@ -374,7 +378,14 @@ def main(argv: list[str] | None = None) -> int:
             from .iam.sts import RoleStore
             sts = StsService(args.sts_key,
                              RoleStore(args.roles_file or None))
-        if args.kms_file:
+        if args.kms_endpoint:
+            from .iam.kms_aws import AwsKms
+            parts = args.kms_endpoint.split(",")
+            kms = AwsKms(parts[0],
+                         parts[1] if len(parts) > 1 else "",
+                         parts[2] if len(parts) > 2 else "",
+                         parts[3] if len(parts) > 3 else "us-east-1")
+        elif args.kms_file:
             from .iam.kms import LocalKms
             kms = LocalKms(args.kms_file)
         if args.filer:

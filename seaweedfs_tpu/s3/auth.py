@@ -68,7 +68,8 @@ def string_to_sign(amz_date: str, scope: str, creq: str) -> str:
 def sign_request(method: str, host: str, path: str, query: dict,
                  headers: dict, payload: bytes, access_key: str,
                  secret_key: str, region: str = "us-east-1",
-                 amz_date: str | None = None) -> dict:
+                 amz_date: str | None = None,
+                 service: str = "s3") -> dict:
     """Client-side signer: returns headers with Authorization added.
     `path` is the raw (unencoded) path; the request must be sent to
     its once-encoded form (`uri_encode(path, False)`)."""
@@ -85,9 +86,9 @@ def sign_request(method: str, host: str, path: str, query: dict,
                     h.startswith("x-amz-"))
     creq = canonical_request(method, uri_encode(path, False), query,
                              headers, signed, payload_hash)
-    scope = f"{date}/{region}/s3/aws4_request"
+    scope = f"{date}/{region}/{service}/aws4_request"
     sts = string_to_sign(amz_date, scope, creq)
-    sig = hmac.new(signing_key(secret_key, date, region),
+    sig = hmac.new(signing_key(secret_key, date, region, service),
                    sts.encode(), hashlib.sha256).hexdigest()
     headers["authorization"] = (
         f"{ALGORITHM} Credential={access_key}/{scope}, "

@@ -125,11 +125,28 @@ def kms_encrypt(kms, mode: str, key_identifier: str, arn: str,
     from ..iam.kms import KmsError
     if not key_identifier:
         key_identifier = DEFAULT_KMS_ALIAS
-        try:
-            kms.get_key_id(key_identifier)
-        except KmsError:
-            kms.create_key(alias=key_identifier,
-                           description="default S3 key")
+        # probe once per provider instance: the result never changes
+        # after first success, and a remote KMS would otherwise pay
+        # an extra DescribeKey round-trip on EVERY default-key PUT
+        if not getattr(kms, "_default_key_ok", False):
+            try:
+                kms.get_key_id(key_identifier)
+            except KmsError as e:
+                if "NotFound" not in str(e):
+                    # a 503/AccessDenied is NOT a missing key —
+                    # misreporting it would tell the operator to
+                    # provision a key that already exists
+                    raise SseError(503, "ServiceUnavailable", str(e))
+                if not hasattr(kms, "create_key"):
+                    # remote KMS providers don't auto-mint: the
+                    # default key is provisioned out of band
+                    raise SseError(400, "InvalidArgument",
+                                   f"no default key "
+                                   f"({DEFAULT_KMS_ALIAS}) on the "
+                                   f"KMS")
+                kms.create_key(alias=key_identifier,
+                               description="default S3 key")
+            kms._default_key_ok = True
     try:
         dk = kms.generate_data_key(key_identifier,
                                    {"aws:s3:arn": arn})
