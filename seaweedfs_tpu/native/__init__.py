@@ -33,7 +33,7 @@ def _build() -> str | None:
         try:
             subprocess.run(
                 ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                 *flags, _SRC, "-o", tmp],
+                 "-pthread", *flags, _SRC, "-o", tmp],
                 check=True, capture_output=True, timeout=120)
             os.replace(tmp, _SO)
             return _SO

@@ -47,10 +47,12 @@ class ReedSolomonNative:
         data = np.ascontiguousarray(data, dtype=np.uint8)
         r, k = mat.shape
         assert data.shape[0] == k
-        out = np.zeros((r, data.shape[1]), dtype=np.uint8)
+        # accumulate=0: the kernel overwrites, so np.empty avoids a
+        # full zero-fill pass over the output rows
+        out = np.empty((r, data.shape[1]), dtype=np.uint8)
         self._lib.gf_matrix_apply(
             mat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            r, k, _row_ptrs(data), _row_ptrs(out), data.shape[1], 1)
+            r, k, _row_ptrs(data), _row_ptrs(out), data.shape[1], 0)
         return out
 
     # -- API-compatible surface (see rs_cpu.ReedSolomonCPU) --------------
