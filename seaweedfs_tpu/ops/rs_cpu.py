@@ -55,6 +55,12 @@ class ReedSolomonCPU:
         data = self._check_shards(data, self.data_shards)
         return gf256.gf_apply_matrix(self.parity_rows, data)
 
+    def apply_matrix(self, mat: np.ndarray, data: np.ndarray
+                     ) -> np.ndarray:
+        """out[r] = XOR_k mat[r,k] * data[k] — public generic apply, the
+        primitive the staged rebuild pipeline drives directly."""
+        return gf256.gf_apply_matrix(mat, data)
+
     # -- verify ------------------------------------------------------------
 
     def verify(self, shards: np.ndarray) -> bool:

@@ -201,6 +201,22 @@ class ReedSolomonJax:
                                       jnp.asarray(flat))
         return _PendingParity(out32, b)
 
+    def apply_matrix(self, mat, data) -> np.ndarray:
+        """out[r] = XOR_k mat[r,k] * data[k] — public generic apply
+        (numpy in, numpy out via the host word-packing fast path)."""
+        return gf_apply_matrix(jnp.asarray(mat, dtype=jnp.uint8), data)
+
+    def apply_matrix_lazy(self, mat, data) -> "_PendingParity":
+        """Async generic apply: dispatch without waiting (same contract
+        as parity_lazy) so a staged pipeline can overlap D2H of launch k
+        with H2D+kernel of k+1."""
+        data = np.ascontiguousarray(data)
+        b = data.shape[1]
+        out32 = gf_apply_matrix_words(
+            jnp.asarray(mat, dtype=jnp.uint8),
+            jnp.asarray(pack_words(data)))
+        return _PendingParity(out32, b)
+
     def encode(self, shards) -> jax.Array:
         """shards: [total, B] with data rows filled; returns full array with
         parity rows computed."""

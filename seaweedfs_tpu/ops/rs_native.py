@@ -57,6 +57,12 @@ class ReedSolomonNative:
 
     # -- API-compatible surface (see rs_cpu.ReedSolomonCPU) --------------
 
+    def apply_matrix(self, mat: np.ndarray, data: np.ndarray
+                     ) -> np.ndarray:
+        """out[r] = XOR_k mat[r,k] * data[k] — public generic apply, the
+        primitive the staged rebuild pipeline drives directly."""
+        return self._apply(mat, data)
+
     def parity(self, data: np.ndarray) -> np.ndarray:
         data = np.asarray(data, dtype=np.uint8)
         if data.ndim != 2 or data.shape[0] != self.data_shards:

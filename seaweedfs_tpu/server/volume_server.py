@@ -26,6 +26,7 @@ from ..storage.erasure_coding import ec_decoder, ec_encoder
 from ..storage.erasure_coding.ec_context import to_ext
 from ..storage.needle import Needle
 from ..storage.store import Store
+from ..storage.volume_info import maybe_load_volume_info
 from .httpd import HttpServer, Request, http_bytes, http_json, \
     is_admin_path
 
@@ -817,7 +818,14 @@ class VolumeServer:
         if not ec_decoder.has_live_needles(base):
             return 400, {"error": f"volume {vid} has no live entries"}
         dat_size = ec_decoder.find_dat_file_size(base, base)
-        shard_files = [base + to_ext(i) for i in range(10)]
+        # decode with the scheme the volume was encoded with (.vif,
+        # server/volume_grpc_erasure_coding.go:132); default RS(10,4)
+        n_data = 10
+        vi = maybe_load_volume_info(base + ".vif")
+        if vi is not None and vi.ec_shard_config is not None and \
+                vi.ec_shard_config.data_shards:
+            n_data = vi.ec_shard_config.data_shards
+        shard_files = [base + to_ext(i) for i in range(n_data)]
         ec_decoder.write_dat_file(base, dat_size, shard_files)
         ec_decoder.write_idx_file_from_ec_index(base)
         self.store.unmount_ec_shards(vid)

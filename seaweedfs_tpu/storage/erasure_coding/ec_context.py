@@ -8,6 +8,7 @@ to klauspost/reedsolomon.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 
 DATA_SHARDS_COUNT = 10
@@ -32,15 +33,7 @@ def to_ext(shard_id: int) -> str:
     return f".ec{shard_id:02d}"
 
 
-def default_backend() -> str:
-    """TPU kernels when a TPU is attached; else the native C++ engine;
-    numpy as the last resort."""
-    try:
-        import jax
-        if jax.default_backend() == "tpu":
-            return "jax"
-    except Exception:  # pragma: no cover
-        pass
+def _cpu_engine() -> str:
     try:
         from ...ops import rs_native
         if rs_native.available():
@@ -48,6 +41,128 @@ def default_backend() -> str:
     except Exception:  # pragma: no cover
         pass
     return "cpu"
+
+
+def _probe_path() -> str:
+    """Cache file next to the native build artifacts (the one writable
+    per-machine cache dir this package already maintains)."""
+    from ... import native
+    d = os.path.join(os.path.dirname(os.path.abspath(native.__file__)),
+                     "_build")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, "ec_backend_probe.json")
+
+
+def _measure_cpu_engine_gbps(engine: str) -> float:
+    """Throughput of the host codec at pipeline batch size (1MB/shard)."""
+    import time
+
+    import numpy as np
+    if engine == "native":
+        from ...ops.rs_native import ReedSolomonNative as RS
+    else:
+        from ...ops.rs_cpu import ReedSolomonCPU as RS
+    codec = RS(DATA_SHARDS_COUNT, PARITY_SHARDS_COUNT)
+    data = np.random.default_rng(0).integers(
+        0, 256, size=(DATA_SHARDS_COUNT, CPU_BATCH_SIZE), dtype=np.uint8)
+    codec.parity(data[:, :4096])  # warmup
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        codec.parity(data)
+        best = min(best, time.perf_counter() - t0)
+    return data.size / best / 1e9
+
+
+def _measure_h2d_gbps() -> float:
+    """Host->device feed rate — the e2e ceiling of the device backend
+    (input bytes move host->device 1:1).  A device->host scalar fetch is
+    the fence: over a tunneled TPU, block_until_ready does not truly
+    synchronize (see bench.py)."""
+    import time
+
+    import jax
+    import numpy as np
+    host = np.random.default_rng(1).integers(
+        0, 2**32, size=(8 << 20) // 4, dtype=np.uint32)
+    int(jax.device_put(host[:1024])[0])  # warmup
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        dev = jax.device_put(host)
+        int(dev[0])
+        best = min(best, time.perf_counter() - t0)
+    return host.nbytes / best / 1e9
+
+
+def probe_backend(force: bool = False) -> dict:
+    """Measure (once per machine, cached on disk) the feed rates that
+    decide the encode backend: host codec GB/s vs host->device GB/s.
+    Returns {"cpu_engine": "native"|"cpu", "cpu_gbps": float,
+    "h2d_gbps": float|None, "choice": str}."""
+    import json
+
+    path = _probe_path()
+    if not force:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            if rec.get("version") == _PROBE_VERSION:
+                return rec
+        except (OSError, ValueError):
+            pass
+    engine = _cpu_engine()
+    rec = {"version": _PROBE_VERSION, "cpu_engine": engine,
+           "cpu_gbps": round(_measure_cpu_engine_gbps(engine), 3),
+           "h2d_gbps": None, "choice": engine}
+    try:
+        import jax
+        if jax.default_backend() == "tpu":
+            rec["h2d_gbps"] = round(_measure_h2d_gbps(), 3)
+            if rec["h2d_gbps"] > rec["cpu_gbps"]:
+                rec["choice"] = "jax"
+    except Exception:  # pragma: no cover — no/unreachable device
+        pass
+    try:
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+    except OSError:  # pragma: no cover — read-only install
+        pass
+    return rec
+
+
+_PROBE_VERSION = 2
+_cached_default: str | None = None
+
+
+def default_backend() -> str:
+    """Pick the engine that wins END-TO-END on this machine, not the
+    one with the fastest kernel: a TPU behind a slow host->device path
+    (e.g. a tunneled chip at 0.03 GB/s) loses to the native GFNI engine
+    (~11 GB/s) by orders of magnitude, so the backends are chosen by a
+    one-time feed-rate probe (cached on disk).  Override with
+    SEAWEEDFS_TPU_EC_BACKEND=jax|native|cpu."""
+    global _cached_default
+    env = os.environ.get("SEAWEEDFS_TPU_EC_BACKEND")
+    if env in ("jax", "native", "cpu"):
+        return env
+    if _cached_default is not None:
+        return _cached_default
+    try:
+        import jax
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        on_tpu = False
+    if not on_tpu:
+        _cached_default = _cpu_engine()
+        return _cached_default
+    try:
+        _cached_default = probe_backend()["choice"]
+    except Exception:  # pragma: no cover — probe must never break IO
+        _cached_default = "jax"
+    return _cached_default
 
 
 @dataclass

@@ -13,8 +13,7 @@ from .. import idx as idxmod
 from .. import types
 from ..needle import get_actual_size
 from ..super_block import SUPER_BLOCK_SIZE, SuperBlock
-from .ec_context import (DATA_SHARDS_COUNT, LARGE_BLOCK_SIZE,
-                         SMALL_BLOCK_SIZE)
+from .ec_context import LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE
 
 _COPY_CHUNK = 8 * 1024 * 1024
 
@@ -85,12 +84,17 @@ def find_dat_file_size(data_base_file_name: str,
 def write_dat_file(base_file_name: str, dat_file_size: int,
                    shard_file_names: list[str]) -> None:
     """ec_decoder.go:176 WriteDatFile: interleave data shard blocks back
-    into the contiguous volume stream."""
-    inputs = [open(p, "rb") for p in shard_file_names[:DATA_SHARDS_COUNT]]
+    into the contiguous volume stream.  The row geometry follows the
+    number of data shards actually passed (callers pass exactly the
+    data shards, default 10; RS(6,3) volumes pass 6), so alternate
+    schemes decode with the same stripe layout they were encoded
+    with."""
+    inputs = [open(p, "rb") for p in shard_file_names]
+    n_data = len(inputs)
     try:
         with open(base_file_name + ".dat", "wb") as dat:
             remaining = dat_file_size
-            while remaining >= DATA_SHARDS_COUNT * LARGE_BLOCK_SIZE:
+            while remaining >= n_data * LARGE_BLOCK_SIZE:
                 for f in inputs:
                     _copy_n(f, dat, LARGE_BLOCK_SIZE)
                     remaining -= LARGE_BLOCK_SIZE
