@@ -21,7 +21,7 @@ from __future__ import annotations
 import os
 
 from ...operation import master_json
-from ...server.httpd import http_bytes, http_json
+from ...server.httpd import http_download, http_json, http_upload
 from ...storage.erasure_coding import ECContext
 from ...storage.erasure_coding import ec_decoder, ec_encoder
 from ...storage.erasure_coding.ec_context import to_ext
@@ -129,17 +129,18 @@ class EcEncodeHandler(JobHandler):
     def _pull_volume(self, worker, vid: int, collection: str,
                      source: str, base: str) -> None:
         """Copy .dat/.idx to the worker (:300) — the bulk pull the
-        plugin boundary is designed to carry."""
+        plugin boundary is designed to carry.  Streamed to disk in
+        chunks (http_download): a 30GB volume must never be buffered in
+        worker RAM (the reference streams CopyFile the same way,
+        ec_task.go:300 / volume_server.proto:69)."""
         os.makedirs(worker.work_dir, exist_ok=True)
         for ext in (".dat", ".idx"):
-            status, data, _ = http_bytes(
-                "GET", f"{source}/admin/volume_file?volumeId={vid}"
-                f"&collection={collection}&ext={ext}")
+            status, _hdrs = http_download(
+                f"{source}/admin/volume_file?volumeId={vid}"
+                f"&collection={collection}&ext={ext}", base + ext)
             if status != 200:
                 raise RuntimeError(
                     f"copy {ext} from {source}: {status}")
-            with open(base + ext, "wb") as f:
-                f.write(data)
 
     def _unwind_volumes(self, worker, collection: str, ctx: ECContext,
                         vol_urls: "dict[int, list[str]]") -> None:
@@ -337,11 +338,11 @@ def _read_dat_version(base: str) -> int:
 
 def _push_file(target: str, vid: int, collection: str, ext: str,
                path: str) -> None:
-    with open(path, "rb") as f:
-        data = f.read()
-    status, body, _ = http_bytes(
+    """Streamed push (http_upload): shard files are sent from disk with
+    bounded memory (shard_distribution.go:101 target side)."""
+    status, body, _ = http_upload(
         "POST", f"{target}/admin/receive_file?volumeId={vid}"
-        f"&collection={collection}&ext={ext}", data)
+        f"&collection={collection}&ext={ext}", path)
     if status != 200:
         raise RuntimeError(f"push {ext} to {target}: {status} "
                            f"{body[:200]!r}")

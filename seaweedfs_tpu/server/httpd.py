@@ -73,8 +73,116 @@ class Request:
     def json(self) -> dict:
         return json.loads(self.body or b"{}")
 
+    def stream_body(self, chunk_size: int = 4 << 20):
+        """Yield the request body in chunks without buffering it whole
+        (the bulk-data receive path: a 30GB volume file must stream to
+        disk, volume_server.proto:69 CopyFile / ReceiveFile), for both
+        Content-Length and chunked framing.  After clean exhaustion
+        `self.body` is b"" so the dispatcher's drain is a no-op; while
+        streaming, the connection is marked close-on-response so a
+        handler that fails MID-stream (ENOSPC) can never leave unread
+        body bytes to be parsed as the next request on a keep-alive
+        connection.  Mutually exclusive with touching `.body` first."""
+        if self._body is not None:
+            # body already buffered (small request): yield it once
+            if self._body:
+                yield self._body
+            return
+        self._body = b""
+        # abandoned-generator safety: assume poisoned until proven
+        # fully drained
+        self._handler.close_connection = True
+        te = (self.headers.get("Transfer-Encoding") or "").lower()
+        if "chunked" in te:
+            yield from self._stream_chunked(chunk_size)
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        remaining = length
+        while remaining > 0:
+            chunk = self._handler.rfile.read(min(chunk_size, remaining))
+            if not chunk:
+                raise IOError(
+                    f"short body: {remaining} of {length} bytes missing")
+            remaining -= len(chunk)
+            yield chunk
+        self._handler.close_connection = False
+
+    def _stream_chunked(self, chunk_size: int):
+        """Chunk-at-a-time RFC 9112 §7.1 parser: unlike _read_chunked
+        (small control bodies) nothing is accumulated, so chunked bulk
+        uploads (`curl -T`) stream with bounded memory too."""
+        rfile = self._handler.rfile
+        while True:
+            size_line = rfile.readline(1024).strip()
+            try:
+                size = int(size_line.split(b";")[0], 16)
+            except ValueError:
+                raise IOError(f"malformed chunk framing: "
+                              f"{size_line[:64]!r}") from None
+            if size == 0:
+                while True:  # drain optional trailers
+                    line = rfile.readline(1024)
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                break
+            remaining = size
+            while remaining > 0:
+                piece = rfile.read(min(chunk_size, remaining))
+                if not piece:
+                    raise IOError("short chunked body")
+                remaining -= len(piece)
+                yield piece
+            rfile.readline(8)  # CRLF after each chunk
+        self._handler.close_connection = False
+
+    def drain(self, max_drain: int = 64 << 20) -> None:
+        """Discard any unread body with bounded memory.  Oversized or
+        chunked unread bodies are not read at all — the connection is
+        closed instead (cheaper than consuming 30GB to keep one
+        keep-alive socket)."""
+        if self._body is not None:
+            return
+        te = (self.headers.get("Transfer-Encoding") or "").lower()
+        length = int(self.headers.get("Content-Length") or 0)
+        if "chunked" in te or length > max_drain:
+            self._body = b""
+            self._handler.close_connection = True
+            return
+        remaining = length
+        while remaining > 0:
+            chunk = self._handler.rfile.read(min(1 << 20, remaining))
+            if not chunk:
+                self._handler.close_connection = True
+                break
+            remaining -= len(chunk)
+        self._body = b""
+
 
 Route = Callable[[Request], "tuple[int, object]"]
+
+
+class FileSlice:
+    """A file-like over [current position, current position + size) of
+    an open file, for streaming byte-range responses; closes the
+    underlying file with it."""
+
+    def __init__(self, f, size: int):
+        self._f = f
+        self._remaining = max(size, 0)
+
+    def read(self, n: int = -1) -> bytes:
+        if self._remaining <= 0:
+            return b""
+        if n < 0 or n > self._remaining:
+            n = self._remaining
+        chunk = self._f.read(n)
+        self._remaining -= len(chunk)
+        if not chunk:
+            self._remaining = 0
+        return chunk
+
+    def close(self) -> None:
+        self._f.close()
 
 
 class HttpServer:
@@ -110,9 +218,13 @@ class HttpServer:
                 # drain any unread request body: a handler that ignores
                 # its body (e.g. PROPFIND's XML) would otherwise leave
                 # the bytes in the keep-alive stream to be parsed as
-                # the NEXT request line, poisoning the connection
+                # the NEXT request line, poisoning the connection.
+                # Bounded: an unread 30GB upload (rejected by the guard
+                # or a 400) closes the connection instead of buffering
+                # — the drain must never re-introduce the whole-body
+                # OOM the streaming path exists to avoid.
                 try:
-                    _ = req.body
+                    req.drain()
                 except Exception:  # noqa: BLE001 — close instead
                     self.close_connection = True
                 extra_headers: dict = {}
@@ -135,6 +247,21 @@ class HttpServer:
                 self.send_header("Content-Type", ctype)
                 for hk, hv in extra_headers.items():
                     self.send_header(hk, hv)
+                if hasattr(body, "read"):
+                    # file-like payload: stream without buffering (the
+                    # bulk-data serve path).  Content-Length must be in
+                    # extra_headers — these responses are never chunked.
+                    self.end_headers()
+                    try:
+                        if req.method != "HEAD":
+                            while True:
+                                chunk = body.read(1 << 20)
+                                if not chunk:
+                                    break
+                                self.wfile.write(chunk)
+                    finally:
+                        body.close()
+                    return
                 if "Content-Length" not in extra_headers:
                     self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -312,6 +439,66 @@ def parse_range(header: str, total: int
     except ValueError:
         return None
     return None
+
+
+def http_download(url: str, dest_path: str,
+                  headers: dict | None = None, timeout: float = 600.0,
+                  chunk_size: int = 4 << 20) -> tuple[int, dict]:
+    """GET `url` streaming the response body to `dest_path` in chunks —
+    bounded memory no matter the file size (the worker's bulk volume
+    pull; the reference streams CopyFile the same way,
+    volume_server.proto:69).  Returns (status, response headers); on a
+    non-2xx status dest_path is removed and the (small) error body is
+    left unconsumed."""
+    import os as _os
+    full_url, ctx = _dial(url)
+    req = urllib.request.Request(full_url,
+                                 headers=_auth_for(url, headers))
+    # download into a sibling temp file and os.replace on success: a
+    # mid-transfer failure (connection reset at 10GB of a 30GB pull)
+    # must never leave a truncated file at dest_path for the store to
+    # later mount, and an error must never clobber a pre-existing dest
+    tmp = f"{dest_path}.download.{_os.getpid()}"
+    try:
+        with urllib.request.urlopen(req, timeout=timeout,
+                                    context=ctx) as resp:
+            with open(tmp, "wb") as f:
+                while True:
+                    chunk = resp.read(chunk_size)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+            _os.replace(tmp, dest_path)
+            return resp.status, dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers)
+    finally:
+        try:
+            _os.remove(tmp)
+        except OSError:
+            pass
+
+
+def http_upload(method: str, url: str, src_path: str,
+                headers: dict | None = None, timeout: float = 600.0
+                ) -> tuple[int, bytes, dict]:
+    """Send a file as the request body WITHOUT buffering it in memory:
+    Content-Length is set from the file size and http.client streams
+    the file object in blocks (the worker's bulk shard push)."""
+    import os as _os
+    size = _os.path.getsize(src_path)
+    headers = dict(_auth_for(url, headers))
+    headers["Content-Length"] = str(size)
+    full_url, ctx = _dial(url)
+    with open(src_path, "rb") as f:
+        req = urllib.request.Request(full_url, data=f, method=method,
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout,
+                                        context=ctx) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
 
 
 def http_bytes(method: str, url: str, body: bytes | None = None,

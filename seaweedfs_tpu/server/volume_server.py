@@ -26,9 +26,8 @@ from ..storage.erasure_coding import ec_decoder, ec_encoder
 from ..storage.erasure_coding.ec_context import to_ext
 from ..storage.needle import Needle
 from ..storage.store import Store
-from ..storage.volume_info import maybe_load_volume_info
-from .httpd import HttpServer, Request, http_bytes, http_json, \
-    is_admin_path
+from .httpd import FileSlice, HttpServer, Request, http_bytes, \
+    http_download, http_json, is_admin_path
 
 # shared request-field validator (also used by the master's assign
 # front door) lives in security.py
@@ -651,10 +650,15 @@ class VolumeServer:
         path = self._file_path(vid, collection, ext)
         if path is None:
             return 404, {"error": f"no {ext} file for volume {vid}"}
-        with open(path, "rb") as f:
-            f.seek(offset)
-            data = f.read() if size < 0 else f.read(size)
-        return 200, data
+        # stream, never buffer: a 30GB .dat pull must not hold the file
+        # in RAM (the reference streams CopyFile in chunks,
+        # volume_server.proto:69)
+        total = os.path.getsize(path)
+        n = max(total - offset, 0) if size < 0 else \
+            max(min(size, total - offset), 0)
+        f = open(path, "rb")
+        f.seek(offset)
+        return 200, (FileSlice(f, n), {"Content-Length": str(n)})
 
     def _receive_file(self, req: Request):
         """volume_server.proto ReceiveFile: accept a shard/index file
@@ -668,9 +672,12 @@ class VolumeServer:
         except ValueError as e:
             return 400, {"error": str(e)}
         base = self._base_path(vid, collection)
+        n = 0
         with open(base + ext, "wb") as f:
-            f.write(req.body)
-        return 200, {"bytes": len(req.body)}
+            for chunk in req.stream_body():
+                f.write(chunk)
+                n += len(chunk)
+        return 200, {"bytes": n}
 
     def _file_path(self, vid: int, collection: str, ext: str
                    ) -> str | None:
@@ -757,18 +764,15 @@ class VolumeServer:
         if b.get("copyVifFile", False):
             exts.append(".vif")
         for ext in exts:
-            status, data, _ = http_bytes(
-                "GET",
+            status, _hdrs = http_download(
                 f"{source}/admin/volume_file?volumeId={vid}"
-                f"&collection={collection}&ext={ext}",
+                f"&collection={collection}&ext={ext}", base + ext,
                 headers=self.security.admin_headers())
             if status != 200:
                 if ext == ".ecj":  # journal may legitimately not exist
                     continue
                 return 500, {"error":
                              f"copy {ext} from {source}: {status}"}
-            with open(base + ext, "wb") as f:
-                f.write(data)
         return 200, {}
 
     def _ec_delete_shards(self, req: Request):
@@ -818,13 +822,9 @@ class VolumeServer:
         if not ec_decoder.has_live_needles(base):
             return 400, {"error": f"volume {vid} has no live entries"}
         dat_size = ec_decoder.find_dat_file_size(base, base)
-        # decode with the scheme the volume was encoded with (.vif,
-        # server/volume_grpc_erasure_coding.go:132); default RS(10,4)
-        n_data = 10
-        vi = maybe_load_volume_info(base + ".vif")
-        if vi is not None and vi.ec_shard_config is not None and \
-                vi.ec_shard_config.data_shards:
-            n_data = vi.ec_shard_config.data_shards
+        # decode with the scheme the volume was encoded with
+        scheme = ec_encoder.scheme_from_vif(base)
+        n_data = scheme.data_shards if scheme else 10
         shard_files = [base + to_ext(i) for i in range(n_data)]
         ec_decoder.write_dat_file(base, dat_size, shard_files)
         ec_decoder.write_idx_file_from_ec_index(base)

@@ -409,20 +409,28 @@ def cmd_ec_balance(env: CommandEnv, args: list[str]) -> str:
 def _copy_volume_files(env: CommandEnv, vid: int, collection: str,
                        src: str, dst: str) -> None:
     """Pull .dat/.idx/.vif from src and push to dst (the CopyFile /
-    ReceiveFile pattern, volume_server.proto:69-101)."""
-    for ext in (".dat", ".idx", ".vif"):
-        status, data, _ = http_bytes(
-            "GET", f"{src}/admin/volume_file?volumeId={vid}"
-            f"&collection={collection}&ext={ext}")
-        if status != 200:
-            if ext == ".vif":
-                continue
-            raise RuntimeError(f"copy {ext} from {src}: {status}")
-        status, body, _ = http_bytes(
-            "POST", f"{dst}/admin/receive_file?volumeId={vid}"
-            f"&collection={collection}&ext={ext}", data)
-        if status != 200:
-            raise RuntimeError(f"push {ext} to {dst}: {status}")
+    ReceiveFile pattern, volume_server.proto:69-101), relayed through a
+    temp file with streaming transfers on both legs — the shell must
+    not buffer a 30GB .dat in RAM any more than the worker may."""
+    import os as _os
+    import tempfile
+
+    from ..server.httpd import http_download, http_upload
+    with tempfile.TemporaryDirectory(prefix="vol_copy_") as tmp:
+        relay = _os.path.join(tmp, "relay")
+        for ext in (".dat", ".idx", ".vif"):
+            status, _hdrs = http_download(
+                f"{src}/admin/volume_file?volumeId={vid}"
+                f"&collection={collection}&ext={ext}", relay)
+            if status != 200:
+                if ext == ".vif":
+                    continue
+                raise RuntimeError(f"copy {ext} from {src}: {status}")
+            status, body, _ = http_upload(
+                "POST", f"{dst}/admin/receive_file?volumeId={vid}"
+                f"&collection={collection}&ext={ext}", relay)
+            if status != 200:
+                raise RuntimeError(f"push {ext} to {dst}: {status}")
 
 
 def _move_volume(env: CommandEnv, vid: int, collection: str,

@@ -329,6 +329,19 @@ def _generate_ec_files(base_file_name: str, ctx: ECContext) -> None:
 
 # --- rebuild ------------------------------------------------------------
 
+def scheme_from_vif(base_file_name: str) -> ECContext | None:
+    """Recover the EC scheme persisted to .vif
+    (server/volume_grpc_erasure_coding.go:132); None when absent or
+    recorded without a scheme.  The single recovery point for every
+    consumer (rebuild, decode-to-volume, shell)."""
+    vi = maybe_load_volume_info(base_file_name + ".vif")
+    if vi is not None and vi.ec_shard_config is not None and \
+            vi.ec_shard_config.data_shards:
+        return ECContext(vi.ec_shard_config.data_shards,
+                         vi.ec_shard_config.parity_shards)
+    return None
+
+
 def rebuild_ec_files(base_file_name: str, ctx: ECContext | None = None,
                      additional_dirs: list[str] | None = None
                      ) -> list[int]:
@@ -336,13 +349,7 @@ def rebuild_ec_files(base_file_name: str, ctx: ECContext | None = None,
     then regenerate missing shard files from survivors.  Returns the
     generated shard ids."""
     if ctx is None:
-        vi = maybe_load_volume_info(base_file_name + ".vif")
-        if vi is not None and vi.ec_shard_config is not None and \
-                vi.ec_shard_config.data_shards:
-            ctx = ECContext(vi.ec_shard_config.data_shards,
-                            vi.ec_shard_config.parity_shards)
-        else:
-            ctx = ECContext()
+        ctx = scheme_from_vif(base_file_name) or ECContext()
     return _generate_missing_ec_files(
         base_file_name, ctx, additional_dirs or [])
 
