@@ -1,0 +1,27 @@
+"""Protobuf/gRPC wire plane.
+
+The reference's compatibility surface is its protos
+(/root/reference/weed/pb/*.proto, SURVEY §7); this package carries a
+wire-compatible subset: `protos/*.proto` (same package/service/method
+names and field numbers), the protoc-generated `*_pb2.py` modules, and
+hand-rolled grpc service/stub wiring (grpc_tools isn't in the image, so
+method handlers and client stubs are built directly from the generated
+message classes — functionally identical to *_pb2_grpc.py output).
+
+Regenerate after editing protos:
+    cd seaweedfs_tpu/pb && protoc --python_out=. -I protos \
+        protos/master.proto protos/volume_server.proto
+
+Everything degrades gracefully: servers expose gRPC when `grpc` is
+importable, JSON-HTTP remains the human-debuggable surface either way.
+"""
+
+from __future__ import annotations
+
+
+def grpc_available() -> bool:
+    try:
+        import grpc  # noqa: F401
+        return True
+    except Exception:  # pragma: no cover
+        return False

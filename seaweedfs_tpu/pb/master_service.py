@@ -1,0 +1,232 @@
+"""gRPC Seaweed (master) service — wire-compatible with
+/root/reference/weed/pb/master.proto (see protos/master.proto).
+
+Every RPC bridges to the same code the JSON-HTTP routes run
+(rpc.LocalRequest), so the two planes can never drift; the gRPC layer
+only translates protobuf <-> the route dicts.
+"""
+
+from __future__ import annotations
+
+import time
+
+from . import master_pb2 as pb
+from .rpc import (Stub, check_status, guarded, make_service_handler,
+                  serve)
+
+SERVICE = "master_pb.Seaweed"
+
+METHODS = {
+    "SendHeartbeat": ("ss", pb.Heartbeat, pb.HeartbeatResponse),
+    "KeepConnected": ("ss", pb.KeepConnectedRequest,
+                      pb.KeepConnectedResponse),
+    "LookupVolume": ("uu", pb.LookupVolumeRequest,
+                     pb.LookupVolumeResponse),
+    "LookupEcVolume": ("uu", pb.LookupEcVolumeRequest,
+                       pb.LookupEcVolumeResponse),
+    "Assign": ("uu", pb.AssignRequest, pb.AssignResponse),
+    "Statistics": ("uu", pb.StatisticsRequest, pb.StatisticsResponse),
+    "CollectionList": ("uu", pb.CollectionListRequest,
+                       pb.CollectionListResponse),
+    "VolumeGrow": ("uu", pb.VolumeGrowRequest, pb.VolumeGrowResponse),
+    "Ping": ("uu", pb.PingRequest, pb.PingResponse),
+}
+
+
+class MasterServicer:
+    def __init__(self, master):
+        self.master = master
+
+    # -- streams -------------------------------------------------------
+
+    def SendHeartbeat(self, request_iterator, context):
+        """master_grpc_server.go SendHeartbeat: each Heartbeat message
+        re-registers the node's full volume state; replies carry the
+        size limit + leader hint.  Runs the same admin-JWT + leader
+        guard as POST /heartbeat — an unauthenticated gRPC heartbeat
+        would let an outsider inject topology."""
+        for hb in request_iterator:
+            payload = {
+                "ip": hb.ip, "port": hb.port,
+                "publicUrl": hb.public_url or f"{hb.ip}:{hb.port}",
+                "dataCenter": hb.data_center, "rack": hb.rack,
+                "maxVolumeCount": hb.max_volume_count,
+                "volumes": [{
+                    "id": v.id, "collection": v.collection,
+                    "size": v.size, "fileCount": v.file_count,
+                    "deleteCount": v.delete_count,
+                    "deletedByteCount": v.deleted_byte_count,
+                    "readOnly": v.read_only,
+                    "replicaPlacement": v.replica_placement,
+                    "ttl": v.ttl, "version": v.version,
+                } for v in hb.volumes],
+                "ecShards": [{
+                    "id": e.id, "collection": e.collection,
+                    "ecIndexBits": e.ec_index_bits,
+                } for e in hb.ec_shards],
+            }
+            req = guarded(context, self.master, "/heartbeat",
+                          payload=payload)
+            status, resp = self.master._heartbeat(req)
+            out = check_status(context, status, resp)
+            yield pb.HeartbeatResponse(
+                volume_size_limit=out.get("volumeSizeLimit", 0),
+                leader=out.get("leader") or "")
+
+    def KeepConnected(self, request_iterator, context):
+        """masterclient.go:417: after the greeting, push leadership and
+        volume-location deltas until the client hangs up.  The first
+        responses replay a full topology snapshot (a reconnecting
+        client rebuilds its vid map from it).  The hub cursor is read
+        BEFORE the snapshot, so deltas published while the snapshot
+        streams are delivered right after it — duplicates are harmless
+        (vid-map adds are idempotent), gaps are not."""
+        try:
+            next(iter(request_iterator))  # the client greeting
+        except StopIteration:
+            return
+        m = self.master
+        guarded(context, m, "/cluster/watch")
+        cursor = m.hub.cursor
+        yield pb.KeepConnectedResponse(volume_location=pb.VolumeLocation(
+            leader=m.raft.leader or m.url))
+        for node in m.topology.alive_nodes():
+            vids, ec_vids = m._node_vid_sets(node.url)
+            yield pb.KeepConnectedResponse(
+                volume_location=pb.VolumeLocation(
+                    url=node.url, public_url=node.public_url,
+                    new_vids=sorted(vids),
+                    new_ec_vids=sorted(ec_vids)))
+        while context.is_active():
+            events, cursor, lagged = m.hub.events_since(cursor,
+                                                        timeout=0.5)
+            if lagged:
+                return  # force the client to reconnect + resnapshot
+            for ev in events:
+                if "leader" in ev:
+                    yield pb.KeepConnectedResponse(
+                        volume_location=pb.VolumeLocation(
+                            leader=ev["leader"]))
+                    continue
+                yield pb.KeepConnectedResponse(
+                    volume_location=pb.VolumeLocation(
+                        url=ev["url"], public_url=ev["publicUrl"],
+                        new_vids=ev["newVids"],
+                        deleted_vids=ev["deletedVids"],
+                        new_ec_vids=ev["newEcVids"],
+                        deleted_ec_vids=ev["deletedEcVids"]))
+
+    # -- unary ---------------------------------------------------------
+
+    def Assign(self, request, context):
+        req = guarded(context, self.master, "/dir/assign", query={
+            "count": request.count or 1,
+            "collection": request.collection,
+            "replication": request.replication or
+            self.master.default_replication,
+            "ttl": request.ttl,
+        })
+        status, resp = self.master._assign(req)
+        out = check_status(context, status, resp)
+        return pb.AssignResponse(
+            fid=out["fid"], count=out.get("count", 1),
+            auth=out.get("auth", ""),
+            location=pb.Location(url=out["url"],
+                                 public_url=out["publicUrl"]),
+            replicas=[pb.Location(url=r["url"],
+                                  public_url=r["publicUrl"])
+                      for r in out.get("replicas", [])])
+
+    def LookupVolume(self, request, context):
+        out = pb.LookupVolumeResponse()
+        for vf in request.volume_or_file_ids:
+            status, resp = self.master._lookup(
+                guarded(context, self.master, "/dir/lookup",
+                        query={"volumeId": vf}))
+            loc = out.volume_id_locations.add(volume_or_file_id=vf)
+            if status != 200:
+                loc.error = resp.get("error", f"HTTP {status}") \
+                    if isinstance(resp, dict) else str(resp)
+                continue
+            for entry in resp["locations"]:
+                loc.locations.add(url=entry["url"],
+                                  public_url=entry["publicUrl"])
+        return out
+
+    def LookupEcVolume(self, request, context):
+        status, resp = self.master._ec_lookup(
+            guarded(context, self.master, "/dir/ec_lookup",
+                    query={"volumeId": request.volume_id}))
+        out = check_status(context, status, resp)
+        r = pb.LookupEcVolumeResponse(volume_id=request.volume_id)
+        by_shard: dict[int, list[str]] = {}
+        for entry in out.get("shardIdLocations", []):
+            for sid in entry["shardIds"]:
+                by_shard.setdefault(sid, []).append(entry["url"])
+        for sid in sorted(by_shard):
+            loc = r.shard_id_locations.add(shard_id=sid)
+            for url in by_shard[sid]:
+                loc.locations.add(url=url, public_url=url)
+        return r
+
+    def Statistics(self, request, context):
+        guarded(context, self.master, "/dir/status")
+        t = self.master.topology
+        total = used = files = 0
+        with t.lock:
+            for node in t.nodes.values():
+                for v in node.volumes.values():
+                    if request.collection and \
+                            v.collection != request.collection:
+                        continue
+                    used += v.size
+                    files += v.file_count
+            total = t.volume_size_limit * max(
+                sum(n.max_volume_count for n in t.nodes.values()), 1)
+        return pb.StatisticsResponse(total_size=total, used_size=used,
+                                     file_count=files)
+
+    def CollectionList(self, request, context):
+        guarded(context, self.master, "/vol/list")
+        t = self.master.topology
+        names = set()
+        # no flags set = list normal volumes (the common default)
+        want_normal = request.include_normal_volumes or \
+            not request.include_ec_volumes
+        with t.lock:
+            for node in t.nodes.values():
+                if want_normal:
+                    names.update(v.collection
+                                 for v in node.volumes.values())
+                if request.include_ec_volumes:
+                    names.update(e.collection
+                                 for e in node.ec_shards.values())
+        return pb.CollectionListResponse(
+            collections=[pb.Collection(name=n) for n in sorted(names)])
+
+    def VolumeGrow(self, request, context):
+        req = guarded(context, self.master, "/vol/grow", payload={
+            "collection": request.collection,
+            "replication": request.replication or
+            self.master.default_replication,
+            "ttl": request.ttl,
+            "count": request.writable_volume_count or 1,
+        })
+        status, resp = self.master._vol_grow(req)
+        check_status(context, status, resp)
+        return pb.VolumeGrowResponse()
+
+    def Ping(self, request, context):
+        now = time.time_ns()
+        return pb.PingResponse(start_time_ns=now, remote_time_ns=now,
+                               stop_time_ns=time.time_ns())
+
+
+def start_master_grpc(master, host: str = "127.0.0.1", port: int = 0):
+    handler = make_service_handler(SERVICE, METHODS,
+                                   MasterServicer(master))
+    return serve([handler], host, port)
+
+
+def master_stub(channel) -> Stub:
+    return Stub(channel, SERVICE, METHODS)

@@ -1,0 +1,152 @@
+"""Shared gRPC plumbing: generic-handler service builder + stub factory.
+
+grpc_tools (the *_pb2_grpc.py generator) is not in the image, so the
+method-handler tables are built by hand from the generated message
+classes — the same objects the generated code would produce
+(pb/grpc_client_server.go:34 is the reference analog of this dial/serve
+funnel).  A method spec is (kind, request_cls, response_cls) where kind
+is one of "uu", "us", "su", "ss" (unary/stream request x response).
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+
+import grpc
+
+_KIND_TO_HANDLER = {
+    "uu": grpc.unary_unary_rpc_method_handler,
+    "us": grpc.unary_stream_rpc_method_handler,
+    "su": grpc.stream_unary_rpc_method_handler,
+    "ss": grpc.stream_stream_rpc_method_handler,
+}
+
+
+def make_service_handler(service_name: str, methods: dict,
+                         servicer) -> grpc.GenericRpcHandler:
+    """methods: {method_name: (kind, req_cls, resp_cls)}; servicer must
+    have a callable per method name."""
+    table = {}
+    for name, (kind, req_cls, resp_cls) in methods.items():
+        table[name] = _KIND_TO_HANDLER[kind](
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString)
+    return grpc.method_handlers_generic_handler(service_name, table)
+
+
+def serve(handlers, host: str = "127.0.0.1", port: int = 0,
+          max_workers: int = 16) -> "tuple[grpc.Server, int]":
+    """Start an insecure gRPC server with the given generic handlers on
+    an ephemeral (or fixed) port; returns (server, bound_port)."""
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[("grpc.max_receive_message_length", 64 << 20),
+                 ("grpc.max_send_message_length", 64 << 20)])
+    for h in handlers:
+        server.add_generic_rpc_handlers((h,))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    server.start()
+    return server, bound
+
+
+class Stub:
+    """Client stub over one service: attribute access returns the bound
+    callable for a method (multi-callable with the right serializers),
+    mirroring what a generated *_pb2_grpc Stub exposes."""
+
+    def __init__(self, channel: grpc.Channel, service_name: str,
+                 methods: dict):
+        self._factories = {
+            "uu": channel.unary_unary, "us": channel.unary_stream,
+            "su": channel.stream_unary, "ss": channel.stream_stream}
+        for name, (kind, req_cls, resp_cls) in methods.items():
+            setattr(self, name, self._factories[kind](
+                f"/{service_name}/{name}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString))
+
+
+class LocalRequest:
+    """Adapter so gRPC servicers reuse the HTTP route handlers (single
+    implementation of every operation; the wire codec is the only
+    difference between the planes)."""
+
+    def __init__(self, query: dict | None = None,
+                 payload: dict | None = None, path: str = "/",
+                 headers: dict | None = None,
+                 remote_ip: str = "127.0.0.1"):
+        self.method = "LOCAL"
+        self.path = path
+        self.remote_ip = remote_ip
+        self.query = {k: str(v) for k, v in (query or {}).items()}
+        self.headers: dict = headers or {}
+        self._payload = payload if payload is not None else {}
+
+    def json(self) -> dict:
+        return self._payload
+
+    @property
+    def body(self) -> bytes:
+        return json.dumps(self._payload).encode()
+
+    def stream_body(self, chunk_size: int = 4 << 20):
+        yield self.body
+
+    def drain(self, max_drain: int = 0) -> None:
+        pass
+
+
+_STATUS_TO_GRPC = {
+    400: grpc.StatusCode.INVALID_ARGUMENT,
+    401: grpc.StatusCode.UNAUTHENTICATED,
+    403: grpc.StatusCode.PERMISSION_DENIED,
+    404: grpc.StatusCode.NOT_FOUND,
+    409: grpc.StatusCode.FAILED_PRECONDITION,
+}
+
+
+def peer_ip(context) -> str:
+    """Client IP from the grpc peer string ("ipv4:1.2.3.4:567",
+    "ipv6:[::1]:567")."""
+    peer = context.peer() or ""
+    if peer.startswith("ipv4:"):
+        return peer[5:].rsplit(":", 1)[0]
+    if peer.startswith("ipv6:"):
+        return peer[5:].rsplit(":", 1)[0].strip("[]")
+    return "127.0.0.1"
+
+
+def guarded(context, server, path: str, query: dict | None = None,
+            payload: dict | None = None) -> LocalRequest:
+    """Build a LocalRequest carrying the LOGICAL http path + the
+    caller's credentials (authorization metadata) and run the server's
+    HTTP guard over it, so the gRPC plane enforces exactly the same
+    admin-JWT and leader-lease rules as the HTTP plane
+    (grpc_client_server.go applies the security config to every dial;
+    an unguarded gRPC port would let anyone delete volumes or depose
+    topology that HTTP protects).  Aborts the RPC on denial."""
+    headers = {}
+    for k, v in context.invocation_metadata() or ():
+        if k.lower() == "authorization":
+            headers["Authorization"] = v
+    req = LocalRequest(query=query, payload=payload, path=path,
+                       headers=headers, remote_ip=peer_ip(context))
+    guard = getattr(server, "_guard", None)
+    denied = guard(req) if guard is not None else None
+    if denied is not None:
+        check_status(context, denied[0], denied[1])
+    return req
+
+
+def check_status(context, status: int, payload) -> dict:
+    """Map an HTTP-route (status, payload) result onto gRPC semantics:
+    2xx passes the payload dict through, anything else aborts with the
+    closest status code and the route's error message."""
+    if 200 <= status < 300:
+        return payload if isinstance(payload, dict) else {}
+    msg = payload.get("error", str(payload)) \
+        if isinstance(payload, dict) else str(payload)
+    context.abort(_STATUS_TO_GRPC.get(status, grpc.StatusCode.INTERNAL),
+                  msg)

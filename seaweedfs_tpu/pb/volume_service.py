@@ -1,0 +1,332 @@
+"""gRPC VolumeServer service — wire-compatible with
+/root/reference/weed/pb/volume_server.proto (see
+protos/volume_server.proto): the EC family plus the streamed bulk-file
+plane.  Bridges to the JSON-HTTP route handlers (one implementation per
+operation); CopyFile/ReceiveFile stream chunk messages so bulk volume
+data moves with bounded memory, like the reference's
+volume_grpc_copy_incremental.go / ec shard distribution."""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+
+import grpc
+
+from . import volume_server_pb2 as pb
+from .rpc import Stub, check_status, guarded, make_service_handler, \
+    serve
+
+SERVICE = "volume_server_pb.VolumeServer"
+STREAM_CHUNK = 1 << 20  # 1MB per CopyFile/ReceiveFile message
+
+METHODS = {
+    "VolumeMount": ("uu", pb.VolumeMountRequest, pb.VolumeMountResponse),
+    "VolumeUnmount": ("uu", pb.VolumeUnmountRequest,
+                      pb.VolumeUnmountResponse),
+    "VolumeDelete": ("uu", pb.VolumeDeleteRequest,
+                     pb.VolumeDeleteResponse),
+    "VolumeMarkReadonly": ("uu", pb.VolumeMarkReadonlyRequest,
+                           pb.VolumeMarkReadonlyResponse),
+    "VolumeMarkWritable": ("uu", pb.VolumeMarkWritableRequest,
+                           pb.VolumeMarkWritableResponse),
+    "CopyFile": ("us", pb.CopyFileRequest, pb.CopyFileResponse),
+    "ReceiveFile": ("su", pb.ReceiveFileRequest, pb.ReceiveFileResponse),
+    "VolumeEcShardsGenerate": ("uu", pb.VolumeEcShardsGenerateRequest,
+                               pb.VolumeEcShardsGenerateResponse),
+    "VolumeEcShardsRebuild": ("uu", pb.VolumeEcShardsRebuildRequest,
+                              pb.VolumeEcShardsRebuildResponse),
+    "VolumeEcShardsCopy": ("uu", pb.VolumeEcShardsCopyRequest,
+                           pb.VolumeEcShardsCopyResponse),
+    "VolumeEcShardsDelete": ("uu", pb.VolumeEcShardsDeleteRequest,
+                             pb.VolumeEcShardsDeleteResponse),
+    "VolumeEcShardsMount": ("uu", pb.VolumeEcShardsMountRequest,
+                            pb.VolumeEcShardsMountResponse),
+    "VolumeEcShardsUnmount": ("uu", pb.VolumeEcShardsUnmountRequest,
+                              pb.VolumeEcShardsUnmountResponse),
+    "VolumeEcShardRead": ("us", pb.VolumeEcShardReadRequest,
+                          pb.VolumeEcShardReadResponse),
+    "VolumeEcShardsToVolume": ("uu", pb.VolumeEcShardsToVolumeRequest,
+                               pb.VolumeEcShardsToVolumeResponse),
+    "VolumeEcShardsInfo": ("uu", pb.VolumeEcShardsInfoRequest,
+                           pb.VolumeEcShardsInfoResponse),
+    "Ping": ("uu", pb.PingRequest, pb.PingResponse),
+}
+
+
+class VolumeServicer:
+    def __init__(self, vs):
+        self.vs = vs
+
+    # -- plain volume admin --------------------------------------------
+
+    def VolumeMount(self, request, context):
+        status, resp = self.vs._mount_volume(guarded(
+            context, self.vs, "/admin/mount_volume",
+            payload={"volumeId": request.volume_id}))
+        check_status(context, status, resp)
+        return pb.VolumeMountResponse()
+
+    def VolumeUnmount(self, request, context):
+        status, resp = self.vs._unmount_volume(guarded(
+            context, self.vs, "/admin/unmount_volume",
+            payload={"volumeId": request.volume_id}))
+        check_status(context, status, resp)
+        return pb.VolumeUnmountResponse()
+
+    def VolumeDelete(self, request, context):
+        status, resp = self.vs._delete_volume(guarded(
+            context, self.vs, "/admin/delete_volume",
+            payload={"volumeId": request.volume_id}))
+        check_status(context, status, resp)
+        return pb.VolumeDeleteResponse()
+
+    def VolumeMarkReadonly(self, request, context):
+        status, resp = self.vs._set_readonly(guarded(
+            context, self.vs, "/admin/set_readonly", payload={
+                "volumeId": request.volume_id, "readOnly": True}))
+        check_status(context, status, resp)
+        return pb.VolumeMarkReadonlyResponse()
+
+    def VolumeMarkWritable(self, request, context):
+        status, resp = self.vs._set_readonly(guarded(
+            context, self.vs, "/admin/set_readonly", payload={
+                "volumeId": request.volume_id, "readOnly": False}))
+        check_status(context, status, resp)
+        return pb.VolumeMarkWritableResponse()
+
+    # -- streamed bulk-file plane --------------------------------------
+
+    def CopyFile(self, request, context):
+        """volume_server.proto:69: chunked server-stream of one
+        volume/shard file."""
+        vs = self.vs
+        guarded(context, vs, "/admin/volume_file")
+        if request.ext in (".dat", ".idx"):
+            v = vs.store.find_volume(request.volume_id)
+            if v is not None:
+                v.sync()
+        try:
+            path = vs._file_path(request.volume_id, request.collection,
+                                 request.ext)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        if path is None:
+            if request.ignore_source_file_not_found:
+                return
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no {request.ext} for volume "
+                          f"{request.volume_id}")
+        stop = request.stop_offset or 0
+        mtime = int(os.stat(path).st_mtime_ns)
+        with open(path, "rb") as f:
+            sent = 0
+            while True:
+                n = STREAM_CHUNK
+                if stop and stop - sent < n:
+                    n = stop - sent
+                if n <= 0:
+                    break
+                chunk = f.read(n)
+                if not chunk:
+                    break
+                sent += len(chunk)
+                yield pb.CopyFileResponse(file_content=chunk,
+                                          modified_ts_ns=mtime)
+
+    def ReceiveFile(self, request_iterator, context):
+        """volume_server.proto:71: first message carries the file info,
+        the rest carry content chunks — written straight to disk."""
+        it = iter(request_iterator)
+        try:
+            first = next(it)
+        except StopIteration:
+            return pb.ReceiveFileResponse(error="empty stream")
+        if first.WhichOneof("data") != "info":
+            return pb.ReceiveFileResponse(
+                error="first message must be ReceiveFileInfo")
+        info = first.info
+        guarded(context, self.vs, "/admin/receive_file")
+        try:
+            # same path-field validation as the HTTP twin: ext must be
+            # a plain ".xxx", no separators (volume_server.py
+            # _receive_file -> _check_path_fields) — without it a
+            # crafted ext is a remote arbitrary-file-write
+            from ..server.volume_server import _check_path_fields
+            _check_path_fields(info.collection, info.ext)
+            base = self.vs._base_path(info.volume_id, info.collection)
+        except ValueError as e:
+            return pb.ReceiveFileResponse(error=str(e))
+        n = 0
+        # per-stream unique temp name: concurrent pushes of the same
+        # volume/ext (worker retry racing the original) must not
+        # interleave into one file
+        tmp = f"{base}{info.ext}.recv.{uuid.uuid4().hex}"
+        try:
+            with open(tmp, "wb") as f:
+                for msg in it:
+                    if msg.WhichOneof("data") != "file_content":
+                        return pb.ReceiveFileResponse(
+                            error="unexpected info message mid-stream")
+                    f.write(msg.file_content)
+                    n += len(msg.file_content)
+            os.replace(tmp, base + info.ext)
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+        return pb.ReceiveFileResponse(bytes_written=n)
+
+    # -- erasure coding -------------------------------------------------
+
+    def VolumeEcShardsGenerate(self, request, context):
+        status, resp = self.vs._ec_generate(guarded(
+            context, self.vs, "/admin/ec/generate", payload={
+                "volumeId": request.volume_id,
+                "collection": request.collection}))
+        check_status(context, status, resp)
+        return pb.VolumeEcShardsGenerateResponse()
+
+    def VolumeEcShardsRebuild(self, request, context):
+        status, resp = self.vs._ec_rebuild(guarded(
+            context, self.vs, "/admin/ec/rebuild", payload={
+                "volumeId": request.volume_id,
+                "collection": request.collection}))
+        out = check_status(context, status, resp)
+        return pb.VolumeEcShardsRebuildResponse(
+            rebuilt_shard_ids=out.get("rebuiltShardIds", []))
+
+    def VolumeEcShardsCopy(self, request, context):
+        status, resp = self.vs._ec_copy(guarded(
+            context, self.vs, "/admin/ec/copy", payload={
+            "volumeId": request.volume_id,
+            "collection": request.collection,
+            "shardIds": list(request.shard_ids),
+            "copyEcxFile": request.copy_ecx_file,
+            "copyEcjFile": request.copy_ecj_file,
+            "copyVifFile": request.copy_vif_file,
+            "sourceDataNode": request.source_data_node}))
+        check_status(context, status, resp)
+        return pb.VolumeEcShardsCopyResponse()
+
+    def VolumeEcShardsDelete(self, request, context):
+        status, resp = self.vs._ec_delete_shards(guarded(
+            context, self.vs, "/admin/ec/delete_shards", payload={
+            "volumeId": request.volume_id,
+            "collection": request.collection,
+            "shardIds": list(request.shard_ids)}))
+        check_status(context, status, resp)
+        return pb.VolumeEcShardsDeleteResponse()
+
+    def VolumeEcShardsMount(self, request, context):
+        status, resp = self.vs._ec_mount(guarded(
+            context, self.vs, "/admin/ec/mount", payload={
+            "volumeId": request.volume_id,
+            "collection": request.collection,
+            "shardIds": list(request.shard_ids)}))
+        check_status(context, status, resp)
+        return pb.VolumeEcShardsMountResponse()
+
+    def VolumeEcShardsUnmount(self, request, context):
+        status, resp = self.vs._ec_unmount(guarded(
+            context, self.vs, "/admin/ec/unmount", payload={
+                "volumeId": request.volume_id}))
+        check_status(context, status, resp)
+        return pb.VolumeEcShardsUnmountResponse()
+
+    def VolumeEcShardRead(self, request, context):
+        vs = self.vs
+        remaining = request.size
+        offset = request.offset
+        while remaining > 0:
+            n = min(remaining, STREAM_CHUNK)
+            status, resp = vs._ec_shard_read(guarded(
+                context, vs, "/admin/ec/shard_read", query={
+                "volumeId": request.volume_id,
+                "shardId": request.shard_id,
+                "offset": offset, "size": n}))
+            if status != 200:
+                check_status(context, status, resp)
+            data = resp if isinstance(resp, (bytes, bytearray)) \
+                else bytes(resp)
+            yield pb.VolumeEcShardReadResponse(data=data)
+            if len(data) < n:
+                break
+            offset += len(data)
+            remaining -= len(data)
+
+    def VolumeEcShardsToVolume(self, request, context):
+        status, resp = self.vs._ec_to_volume(guarded(
+            context, self.vs, "/admin/ec/to_volume", payload={
+                "volumeId": request.volume_id,
+                "collection": request.collection}))
+        check_status(context, status, resp)
+        return pb.VolumeEcShardsToVolumeResponse()
+
+    def VolumeEcShardsInfo(self, request, context):
+        status, resp = self.vs._ec_info(guarded(
+            context, self.vs, "/admin/ec/info", query={
+                "volumeId": request.volume_id}))
+        out = check_status(context, status, resp)
+        r = pb.VolumeEcShardsInfoResponse()
+        for sid in out.get("shardIds", []):
+            r.ec_shard_infos.add(
+                shard_id=sid, size=out.get("shardSize", 0),
+                volume_id=request.volume_id)
+        return r
+
+    def Ping(self, request, context):
+        now = time.time_ns()
+        return pb.PingResponse(start_time_ns=now, remote_time_ns=now,
+                               stop_time_ns=time.time_ns())
+
+
+def start_volume_grpc(vs, host: str = "127.0.0.1", port: int = 0):
+    handler = make_service_handler(SERVICE, METHODS, VolumeServicer(vs))
+    return serve([handler], host, port)
+
+
+def volume_stub(channel) -> Stub:
+    return Stub(channel, SERVICE, METHODS)
+
+
+def send_file(stub: Stub, path: str, volume_id: int, ext: str,
+              collection: str = "", shard_id: int = 0) -> int:
+    """Client-side ReceiveFile push: stream `path` in chunks."""
+    def gen():
+        yield pb.ReceiveFileRequest(info=pb.ReceiveFileInfo(
+            volume_id=volume_id, ext=ext, collection=collection,
+            shard_id=shard_id, file_size=os.path.getsize(path)))
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(STREAM_CHUNK)
+                if not chunk:
+                    break
+                yield pb.ReceiveFileRequest(file_content=chunk)
+    resp = stub.ReceiveFile(gen())
+    if resp.error:
+        raise RuntimeError(f"ReceiveFile {ext}: {resp.error}")
+    return resp.bytes_written
+
+
+def fetch_file(stub: Stub, dest_path: str, volume_id: int, ext: str,
+               collection: str = "") -> int:
+    """Client-side CopyFile pull: stream into dest_path."""
+    n = 0
+    tmp = f"{dest_path}.pull.{uuid.uuid4().hex}"
+    try:
+        with open(tmp, "wb") as f:
+            for msg in stub.CopyFile(pb.CopyFileRequest(
+                    volume_id=volume_id, ext=ext,
+                    collection=collection)):
+                f.write(msg.file_content)
+                n += len(msg.file_content)
+        os.replace(tmp, dest_path)
+    finally:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+    return n

@@ -458,7 +458,8 @@ def http_download(url: str, dest_path: str,
     # mid-transfer failure (connection reset at 10GB of a 30GB pull)
     # must never leave a truncated file at dest_path for the store to
     # later mount, and an error must never clobber a pre-existing dest
-    tmp = f"{dest_path}.download.{_os.getpid()}"
+    import uuid as _uuid
+    tmp = f"{dest_path}.download.{_uuid.uuid4().hex}"
     try:
         with urllib.request.urlopen(req, timeout=timeout,
                                     context=ctx) as resp:

@@ -1,0 +1,66 @@
+"""Leadership / volume-location push hub (wdclient follow stream).
+
+The reference pushes VolumeLocation + leadership updates to every
+connected client over the KeepConnected stream
+(weed/wdclient/masterclient.go:417-471, master_grpc_server.go
+KeepConnected); clients react instead of polling.  This hub is the
+master-side fan-out point: the heartbeat path publishes volume-set
+deltas per node, the raft layer publishes leadership changes, and both
+the gRPC KeepConnected stream and the HTTP long-poll watch endpoint
+read from it.
+
+Delivery is CURSOR-BASED over a bounded ring: every event gets a
+monotonically increasing sequence number; readers ask for "events
+after cursor C" and get (events, new_cursor, lagged).  A reader that
+falls further behind than the ring retains sees lagged=True and must
+resync from a full topology snapshot (the reference client likewise
+rebuilds its vid map on stream reconnect).  Cursors make delivery
+gap-free across long-poll reconnects — a fresh per-poll queue would
+silently drop events published between polls.
+
+Events are plain dicts:
+    {"url", "publicUrl", "newVids", "deletedVids",
+     "newEcVids", "deletedEcVids"}          — volume location delta
+    {"leader": "<url>"}                     — leadership change
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+
+class LocationHub:
+    def __init__(self, capacity: int = 4096):
+        self._cond = threading.Condition()
+        self._log: "collections.deque[tuple[int, dict]]" = \
+            collections.deque(maxlen=capacity)
+        self._seq = 0
+
+    @property
+    def cursor(self) -> int:
+        """The sequence number of the latest event (0 = none yet).
+        Read BEFORE building a snapshot so events published while the
+        snapshot streams are replayed after it, never lost."""
+        with self._cond:
+            return self._seq
+
+    def publish(self, event: dict) -> None:
+        with self._cond:
+            self._seq += 1
+            self._log.append((self._seq, event))
+            self._cond.notify_all()
+
+    def events_since(self, since: int, timeout: float = 0.0
+                     ) -> "tuple[list[dict], int, bool]":
+        """(events after `since`, new cursor, lagged).  Blocks up to
+        `timeout` seconds for the first event.  lagged=True means the
+        ring no longer retains everything after `since` — the caller
+        must resync from a snapshot."""
+        with self._cond:
+            if timeout > 0 and self._seq <= since:
+                self._cond.wait_for(lambda: self._seq > since, timeout)
+            oldest = self._log[0][0] if self._log else self._seq + 1
+            lagged = since + 1 < oldest and self._seq > since
+            events = [e for s, e in self._log if s > since]
+            return events, self._seq, lagged

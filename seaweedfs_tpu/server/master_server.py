@@ -80,21 +80,61 @@ class MasterServer:
             auth_headers=lambda: self.security.admin_headers())
         from ..stats import Metrics
         self.metrics = Metrics("master")
+        from .location_hub import LocationHub
+        self.hub = LocationHub()
+        r("GET", "/cluster/watch", self._watch)
+        self.grpc_server = None
+        self.grpc_port = 0
 
     # -- lifecycle --------------------------------------------------------
 
     def start(self):
         self.http.start()
         self.raft.start()
+        # gRPC wire plane (pb/grpc_client_server.go analog): optional —
+        # JSON-HTTP stays the always-on surface
+        try:
+            from ..pb.master_service import start_master_grpc
+            self.grpc_server, self.grpc_port = start_master_grpc(
+                self, self.http.host)
+        except ImportError:  # grpcio absent: HTTP-only mode
+            pass
+        except Exception as e:  # pragma: no cover — a real defect
+            import sys
+            print(f"master {self.url}: gRPC plane failed to start: "
+                  f"{e!r}", file=sys.stderr)
         return self
 
     def stop(self):
+        if self.grpc_server is not None:
+            self.grpc_server.stop(grace=0.5)
         self.raft.stop()
         self.http.stop()
+
+    def _watch(self, req: Request):
+        """HTTP long-poll leg of the follow stream (for clients without
+        grpc).  Cursor-based: `snapshot=1` returns the full topology +
+        the current cursor; subsequent calls pass `since=<cursor>` and
+        long-poll up to `timeout` seconds for events after it.  Gap-free
+        across polls — events published between two polls are retained
+        in the hub ring and delivered on the next call; `lagged` tells
+        a slow client to resync from a snapshot."""
+        timeout = min(float(req.query.get("timeout", 25)), 55.0)
+        if req.query.get("snapshot") == "1":
+            cursor = self.hub.cursor  # BEFORE the snapshot: anything
+            # published while we serialize it replays on the next poll
+            return 200, {"events": [], "cursor": cursor,
+                         "snapshot": self.topology.to_volume_list(),
+                         "leader": self.raft.leader}
+        since = int(req.query.get("since", 0))
+        events, cursor, lagged = self.hub.events_since(since, timeout)
+        return 200, {"events": events, "cursor": cursor,
+                     "lagged": lagged, "leader": self.raft.leader}
 
     def _on_leadership(self, leading: bool) -> None:
         if not leading:
             return
+        self.hub.publish({"leader": self.raft.leader or self.url})
         # The reference raft-checkpoints the memory sequence; without log
         # replication, re-seed from a time-derived floor (µs) so a new
         # leader can never reissue a file id a previous leader handed out
@@ -117,6 +157,7 @@ class MasterServer:
     _LEADER_ONLY = frozenset((
         "/heartbeat", "/dir/assign", "/dir/lookup", "/dir/ec_lookup",
         "/dir/status", "/vol/list", "/vol/grow", "/cluster/status",
+        "/cluster/watch",
         "/cluster/lease_admin_token", "/cluster/release_admin_token"))
 
     def _guard(self, req: Request):
@@ -143,9 +184,29 @@ class MasterServer:
 
     # -- handlers ---------------------------------------------------------
 
+    def _node_vid_sets(self, url: str) -> "tuple[set, set]":
+        node = self.topology.nodes.get(url)
+        if node is None:
+            return set(), set()
+        return set(node.volumes), set(node.ec_shards)
+
     def _heartbeat(self, req: Request):
         hb = req.json()
+        url = f"{hb.get('ip', '')}:{hb.get('port', '')}"
+        old_vids, old_ec = self._node_vid_sets(url)
         self.topology.register_heartbeat(hb)
+        new_vids, new_ec = self._node_vid_sets(url)
+        if (new_vids, new_ec) != (old_vids, old_ec):
+            # push the delta to every follow-stream subscriber
+            # (masterclient.go:417 KeepConnected VolumeLocation)
+            self.hub.publish({
+                "url": url,
+                "publicUrl": hb.get("publicUrl", url),
+                "newVids": sorted(new_vids - old_vids),
+                "deletedVids": sorted(old_vids - new_vids),
+                "newEcVids": sorted(new_ec - old_ec),
+                "deletedEcVids": sorted(old_ec - new_ec),
+            })
         self.metrics.counter_add("heartbeat_total",
                                  help_text="heartbeats received")
         # leader + topology id ride the heartbeat reply so volume servers

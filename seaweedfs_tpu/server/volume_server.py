@@ -101,6 +101,19 @@ class VolumeServer:
 
     def start(self):
         self.http.start()
+        # gRPC wire plane (volume_server.proto subset) — optional;
+        # JSON-HTTP stays the always-on surface
+        try:
+            from ..pb.volume_service import start_volume_grpc
+            self.grpc_server, self.grpc_port = start_volume_grpc(
+                self, self.http.host)
+        except ImportError:  # grpcio absent: HTTP-only mode
+            self.grpc_server, self.grpc_port = None, 0
+        except Exception as e:  # pragma: no cover — a real defect
+            import sys
+            self.grpc_server, self.grpc_port = None, 0
+            print(f"volume server {self.url}: gRPC plane failed to "
+                  f"start: {e!r}", file=sys.stderr)
         self._heartbeat_once()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True)
@@ -109,6 +122,8 @@ class VolumeServer:
 
     def stop(self):
         self._hb_stop.set()
+        if getattr(self, "grpc_server", None) is not None:
+            self.grpc_server.stop(grace=0.5)
         self.http.stop()
         self.ec_reader.close()
         self.store.close()
