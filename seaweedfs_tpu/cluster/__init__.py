@@ -1,0 +1,3 @@
+"""Cluster coordination primitives (weed/cluster analog)."""
+
+from .lock_manager import ClusterLock, LockManager  # noqa: F401

@@ -263,25 +263,41 @@ class BrokerServer:
         if owner in live:
             return 409, {"error": "not owner", "owner": owner,
                          "partition": idx}
-        # owner is dead: take the partition over.  Re-read the conf
-        # FRESH first — a peer may have already claimed it, and
-        # rewriting from a stale cache would clobber their claim
-        with self._conf_lock:
-            try:
-                self._load_layout(t, fresh=True)
-            except RuntimeError as e:
-                return 503, {"error": str(e)}
-            with self._lock:
-                owners = list(self._owners.get(t) or
-                              [self.url] * len(parts))
-            if owners[idx] == owner:     # still the dead one
-                owners[idx] = self.url
-                err = self._persist_layout(t, parts, owners)
-                if err:
-                    return 503, {"error": err}
-            elif owners[idx] != self.url:
-                return 409, {"error": "not owner",
-                             "owner": owners[idx], "partition": idx}
+        # owner is dead: take the partition over under the CLUSTER
+        # lock (filer-hosted lock ring, cluster/lock_manager.py) —
+        # without it two brokers can pass the dead-owner check
+        # concurrently and clobber each other's conf rewrite (the
+        # round-3 ~CONF_TTL split-brain window).  The fresh re-read
+        # inside the lock sees any claim a peer completed first.
+        from ..cluster import ClusterLock
+        try:
+            takeover_lock = ClusterLock(
+                self.filer, f"mq-takeover:{self._conf_path(t)}",
+                owner=self.url, ttl_sec=10.0).acquire(timeout=5.0)
+        except (TimeoutError, OSError) as e:
+            return 503, {"error": f"takeover lock: {e}"}
+        try:
+            with self._conf_lock:
+                try:
+                    self._load_layout(t, fresh=True)
+                except RuntimeError as e:
+                    return 503, {"error": str(e)}
+                with self._lock:
+                    owners = list(self._owners.get(t) or
+                                  [self.url] * len(parts))
+                if owners[idx] == owner:     # still the dead one
+                    if not takeover_lock.is_held():
+                        return 503, {"error": "takeover lock lost"}
+                    owners[idx] = self.url
+                    err = self._persist_layout(t, parts, owners)
+                    if err:
+                        return 503, {"error": err}
+                elif owners[idx] != self.url:
+                    return 409, {"error": "not owner",
+                                 "owner": owners[idx],
+                                 "partition": idx}
+        finally:
+            takeover_lock.release()
         return None
 
     def _topic_from(self, ns: str, name: str) -> Topic:

@@ -164,8 +164,48 @@ def submit(master: str, data: bytes, name: str = "", mime: str = "",
     raise RuntimeError(f"submit failed after {retries} attempts: {last}")
 
 
+_followers: "dict[str, object]" = {}
+_follower_refs: "dict[str, int]" = {}
+_followers_lock = threading.Lock()
+
+
+def enable_follow(master: str) -> None:
+    """Start (refcounted per master spec, process-wide) the wdclient
+    follow stream: a push-fed vid map + leader tracking over
+    /cluster/watch (masterclient.go:471 KeepConnectedToMaster).
+    Long-lived processes (filer, mount, gateways) call this; lookups
+    then resolve from the pushed map with no RPC and no TTL staleness.
+    Each enable_follow must be paired with one disable_follow — the
+    stream stops when the last user leaves (two filers in one process
+    must not kill each other's stream)."""
+    from .wdclient import MasterFollower
+    with _followers_lock:
+        _follower_refs[master] = _follower_refs.get(master, 0) + 1
+        if master not in _followers:
+            _followers[master] = MasterFollower(master).start()
+
+
+def disable_follow(master: str) -> None:
+    with _followers_lock:
+        refs = _follower_refs.get(master, 0) - 1
+        if refs > 0:
+            _follower_refs[master] = refs
+            return
+        _follower_refs.pop(master, None)
+        f = _followers.pop(master, None)
+    if f is not None:
+        f.stop()
+
+
 def lookup(master: str, vid: int, use_cache: bool = True) -> list[dict]:
-    """operation/lookup.go Lookup -> [{url, publicUrl}]."""
+    """operation/lookup.go Lookup -> [{url, publicUrl}].  Resolution
+    order: the follow-stream map (push-fed, authoritative) when
+    enabled, then the TTL'd cache, then a lookup RPC."""
+    follower = _followers.get(master)
+    if follower is not None:
+        locs = follower.get_locations(vid)
+        if locs is not None:
+            return locs
     if use_cache:
         cached = _vid_cache.get(master, vid)
         if cached is not None:

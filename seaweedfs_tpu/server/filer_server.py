@@ -58,6 +58,15 @@ class FilerServer:
         self.http.route("POST", "/__meta__/patch_extended",
                         self._meta_patch_extended)
         self.http.route("GET", "/__meta__/events", self._meta_events)
+        # distributed lock manager (weed/cluster/lock_manager) — the
+        # filer hosts the lock ring, as in the reference
+        from ..cluster import LockManager
+        self.lock_manager = LockManager(self.http.url)
+        self.http.route("POST", "/admin/locks/acquire",
+                        self._lock_acquire)
+        self.http.route("POST", "/admin/locks/release",
+                        self._lock_release)
+        self.http.route("GET", "/admin/locks/list", self._lock_list)
         from .debug import install_debug_routes
         install_debug_routes(self.http)  # util/grace/pprof.go analog
         self.http.guard = self._guard
@@ -75,11 +84,52 @@ class FilerServer:
                 return 401, {"error": err}
         return None
 
+    # -- distributed locks (distributed_lock_manager.go) ---------------
+
+    def _lock_acquire(self, req: Request):
+        b = req.json()
+        key = str(b.get("key", ""))
+        if not key:
+            return 400, {"error": "missing lock key"}
+        target = self.lock_manager.target_server(key)
+        if target and target != self.http.url:
+            return 200, {"movedTo": target}
+        r = self.lock_manager.acquire(
+            key, str(b.get("owner", "")),
+            float(b.get("ttlSec", 10.0)),
+            str(b.get("renewToken", "")))
+        if isinstance(r, str):
+            return 423, {"error": "locked", "owner": r}
+        token, expires_at = r
+        return 200, {"renewToken": token, "expiresAt": expires_at}
+
+    def _lock_release(self, req: Request):
+        b = req.json()
+        key = str(b.get("key", ""))
+        target = self.lock_manager.target_server(key)
+        if target and target != self.http.url:
+            return 200, {"movedTo": target}
+        ok = self.lock_manager.release(key,
+                                       str(b.get("renewToken", "")))
+        if not ok:
+            return 409, {"error": "token mismatch"}
+        return 200, {}
+
+    def _lock_list(self, req: Request):
+        return 200, {"locks": self.lock_manager.all_locks()}
+
     def start(self):
         self.http.start()
+        # follow stream: push-fed vid map + instant leader tracking
+        # (the reference filer keeps KeepConnected open for the same
+        # reason, masterclient.go:471)
+        from .. import operation
+        operation.enable_follow(self.filer.master)
         return self
 
     def stop(self):
+        from .. import operation
+        operation.disable_follow(self.filer.master)
         self.http.stop()
         self.filer.store.close()
         self.filer.meta_log.close()

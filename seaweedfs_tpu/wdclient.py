@@ -1,0 +1,155 @@
+"""wdclient follow stream: a push-fed vid map.
+
+The reference's wdclient keeps a KeepConnected stream open to the
+master and applies pushed VolumeLocation deltas to its vid map, so
+lookups are local and leadership changes propagate instantly
+(weed/wdclient/masterclient.go:417-471, vid_map.go).  This is that
+client: a background thread long-polls the master's /cluster/watch
+endpoint (the HTTP leg of the same LocationHub the gRPC KeepConnected
+stream serves), maintains vid -> locations, and feeds the discovered
+leader back into operation's leader cache.
+
+Long-lived processes (filer, mount, gateways) call
+operation.enable_follow(master); one-shot CLI verbs keep using the
+TTL'd lookup cache.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class MasterFollower:
+    def __init__(self, master: str, poll_timeout: float = 25.0):
+        self.master = master
+        self.poll_timeout = poll_timeout
+        self._lock = threading.Lock()
+        self._vids: dict[int, dict[str, dict]] = {}  # vid -> url -> loc
+        self._ec_vids: dict[int, set[str]] = {}
+        self._leader: str | None = None
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- consumer surface ----------------------------------------------
+
+    def get_locations(self, vid: int) -> "list[dict] | None":
+        """Pushed locations for a vid; None for unknown/unsynced — the
+        caller falls back to a lookup RPC (same contract as the
+        reference vid_map: a miss is a miss, the RPC is authoritative;
+        a push event for a freshly grown volume may trail the assign
+        that referenced it)."""
+        if not self._synced.is_set():
+            return None
+        with self._lock:
+            m = self._vids.get(vid)
+            return list(m.values()) if m else None
+
+    @property
+    def leader(self) -> "str | None":
+        return self._leader
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "MasterFollower":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def wait_synced(self, timeout: float = 5.0) -> bool:
+        return self._synced.wait(timeout)
+
+    # -- stream loop ----------------------------------------------------
+
+    def _run(self) -> None:
+        from .operation import master_json
+        cursor = -1
+        while not self._stop.is_set():
+            try:
+                if cursor < 0:
+                    r = master_json(self.master, "GET",
+                                    "/cluster/watch?snapshot=1",
+                                    timeout=10)
+                    if "error" in r:  # http_json returns error bodies
+                        raise OSError(r["error"])  # as dicts, unraised
+                    self._apply_snapshot(r.get("snapshot") or {})
+                    self._note_leader(r.get("leader"))
+                    cursor = int(r.get("cursor", 0))
+                    self._synced.set()
+                    continue
+                r = master_json(
+                    self.master, "GET",
+                    f"/cluster/watch?since={cursor}"
+                    f"&timeout={self.poll_timeout}",
+                    timeout=self.poll_timeout + 10)
+                if "error" in r:
+                    raise OSError(r["error"])
+                if r.get("lagged"):
+                    cursor = -1  # resync from a fresh snapshot
+                    self._synced.clear()
+                    continue
+                cursor = int(r.get("cursor", cursor))
+                self._note_leader(r.get("leader"))
+                for ev in r.get("events", []):
+                    self._apply_event(ev)
+            except (OSError, ValueError):
+                # master unreachable / erroring / failover in progress:
+                # back off, then resync (leadership may have moved, and
+                # a new leader starts a fresh hub — cursors don't carry
+                # over)
+                self._synced.clear()
+                cursor = -1
+                self._stop.wait(1.0)
+
+    def _note_leader(self, leader: "str | None") -> None:
+        if leader and leader != self._leader:
+            self._leader = leader
+            from . import operation
+            with operation._leader_lock:
+                operation._leader_cache[self.master] = leader
+
+    def _apply_snapshot(self, topo: dict) -> None:
+        vids: dict[int, dict[str, dict]] = {}
+        ec_vids: dict[int, set[str]] = {}
+        for dc in (topo.get("dataCenters") or {}).values():
+            for rack in dc.get("racks", {}).values():
+                for node in rack.get("nodes", []):
+                    loc = {"url": node["url"],
+                           "publicUrl": node.get("publicUrl",
+                                                 node["url"])}
+                    for v in node.get("volumes", []):
+                        vids.setdefault(v["id"], {})[loc["url"]] = loc
+                    for e in node.get("ecShards", []):
+                        ec_vids.setdefault(
+                            e["volumeId"], set()).add(loc["url"])
+        with self._lock:
+            self._vids = vids
+            self._ec_vids = ec_vids
+
+    def _apply_event(self, ev: dict) -> None:
+        if "url" not in ev:
+            return  # leader-only events are handled via _note_leader
+        loc = {"url": ev["url"],
+               "publicUrl": ev.get("publicUrl", ev["url"])}
+        with self._lock:
+            for vid in ev.get("newVids", []):
+                self._vids.setdefault(vid, {})[loc["url"]] = loc
+            for vid in ev.get("deletedVids", []):
+                m = self._vids.get(vid)
+                if m:
+                    m.pop(loc["url"], None)
+                    if not m:
+                        self._vids.pop(vid, None)
+            for vid in ev.get("newEcVids", []):
+                self._ec_vids.setdefault(vid, set()).add(loc["url"])
+            for vid in ev.get("deletedEcVids", []):
+                s = self._ec_vids.get(vid)
+                if s:
+                    s.discard(loc["url"])
+                    if not s:
+                        self._ec_vids.pop(vid, None)

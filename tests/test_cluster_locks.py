@@ -1,0 +1,114 @@
+"""Distributed lock manager + wdclient follow stream (VERDICT r3
+Missing #4 / Next #8)."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster import ClusterLock, LockManager
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+def test_lock_manager_semantics():
+    lm = LockManager("me:1")
+    r = lm.acquire("k", "alice", ttl_sec=5)
+    assert isinstance(r, tuple)
+    token, _ = r
+    # conflicting owner is told who holds it
+    assert lm.acquire("k", "bob", ttl_sec=5) == "alice"
+    # renewal with the live token keeps the same token
+    r2 = lm.acquire("k", "alice", ttl_sec=5, token=token)
+    assert isinstance(r2, tuple) and r2[0] == token
+    # release with wrong token refused; right token releases
+    assert not lm.release("k", "bogus")
+    assert lm.release("k", token)
+    assert lm.find_owner("k") is None
+
+
+def test_lock_expiry_allows_steal():
+    lm = LockManager("me:1")
+    r = lm.acquire("k", "alice", ttl_sec=0.1)
+    assert isinstance(r, tuple)
+    time.sleep(0.15)
+    r2 = lm.acquire("k", "bob", ttl_sec=5)
+    assert isinstance(r2, tuple)
+    assert lm.find_owner("k") == "bob"
+
+
+def test_ring_target_server_stable():
+    lm = LockManager("a:1")
+    lm.members = ["a:1", "b:2", "c:3"]
+    t1 = lm.target_server("some-key")
+    assert t1 in lm.members
+    assert lm.target_server("some-key") == t1  # deterministic
+    # spread: not everything on one member
+    targets = {lm.target_server(f"key-{i}") for i in range(64)}
+    assert len(targets) > 1
+
+
+@pytest.fixture
+def mini(tmp_path):
+    master = MasterServer(volume_size_limit_mb=8).start()
+    d = tmp_path / "v0"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.url, pulse_seconds=0.3).start()
+    filer = FilerServer(master.url).start()
+    time.sleep(0.4)
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_cluster_lock_over_filer(mini):
+    master, vs, filer = mini
+    with ClusterLock(filer.http.url, "job:42", owner="w1",
+                     ttl_sec=5) as l1:
+        assert l1._token
+        # second owner cannot take it
+        l2 = ClusterLock(filer.http.url, "job:42", owner="w2",
+                         ttl_sec=5)
+        with pytest.raises(TimeoutError):
+            l2.acquire(timeout=0.5)
+    # released: w2 can now take it
+    with ClusterLock(filer.http.url, "job:42", owner="w2", ttl_sec=5):
+        pass
+
+
+def test_cluster_lock_renewal_outlives_ttl(mini):
+    master, vs, filer = mini
+    lock = ClusterLock(filer.http.url, "renew:1", owner="w1",
+                       ttl_sec=1.0).acquire()
+    try:
+        time.sleep(2.2)  # > 2x TTL: only renewal keeps it alive
+        l2 = ClusterLock(filer.http.url, "renew:1", owner="w2",
+                         ttl_sec=1.0)
+        with pytest.raises(TimeoutError):
+            l2.acquire(timeout=0.4)
+    finally:
+        lock.release()
+
+
+def test_wdclient_follower_tracks_topology(mini, tmp_path):
+    from seaweedfs_tpu import operation
+    from seaweedfs_tpu.wdclient import MasterFollower
+
+    master, vs, filer = mini
+    f = MasterFollower(master.url, poll_timeout=2.0).start()
+    try:
+        assert f.wait_synced(5)
+        # grow a volume; the follower sees it via push, no lookup RPC
+        a = operation.assign(master.url, collection="wd")
+        vid = int(a.fid.split(",")[0])
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            locs = f.get_locations(vid)
+            if locs:
+                break
+            time.sleep(0.1)
+        assert locs and locs[0]["url"] == vs.url
+        assert f.leader == master.url
+    finally:
+        f.stop()
