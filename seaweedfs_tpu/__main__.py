@@ -94,6 +94,14 @@ def main(argv: list[str] | None = None) -> int:
                          "reference's leveldb default)")
     fl.add_argument("-collection", default="")
     fl.add_argument("-replication", default="")
+    fl.add_argument("-notification", default="",
+                    help="metadata notification sink "
+                         "(weed/notification): webhook:http://...,"
+                         " mq:broker/ns/topic, or logfile:/path")
+    fl.add_argument("-lockPeers", dest="lock_peers", default="",
+                    help="comma-separated filer addresses forming the "
+                         "distributed-lock ring (give every filer the "
+                         "same list; cluster/lock_manager)")
 
     s3p = sub.add_parser("s3", help="start the S3 gateway (on a filer)")
     s3p.add_argument("-ip", default="127.0.0.1")
@@ -201,6 +209,22 @@ def main(argv: list[str] | None = None) -> int:
     fbak.add_argument("-state", default="",
                       help="offset checkpoint file")
     fbak.add_argument("-interval", type=float, default=0.5)
+
+    fbs3 = sub.add_parser(
+        "filer.backup.s3", help="continuously mirror a filer into an "
+        "S3-compatible bucket (replication/sink/s3sink)")
+    fbs3.add_argument("-filer", required=True,
+                      help="source filer host:port")
+    fbs3.add_argument("-endpoint", required=True,
+                      help="S3 endpoint, e.g. http://host:8333")
+    fbs3.add_argument("-bucket", required=True)
+    fbs3.add_argument("-accessKey", dest="access_key", default="")
+    fbs3.add_argument("-secretKey", dest="secret_key", default="")
+    fbs3.add_argument("-prefix", default="",
+                      help="key prefix inside the bucket")
+    fbs3.add_argument("-state", default="",
+                      help="offset checkpoint file")
+    fbs3.add_argument("-interval", type=float, default=0.5)
 
     sf = sub.add_parser(
         "sftp", help="SFTP gateway attached to a running filer "
@@ -359,7 +383,11 @@ def main(argv: list[str] | None = None) -> int:
                          store_path=args.store,
                          collection=args.collection,
                          replication=args.replication,
-                         store_type=args.store_type)
+                         store_type=args.store_type,
+                         notification=args.notification,
+                         lock_peers=[p.strip() for p in
+                                     args.lock_peers.split(",")
+                                     if p.strip()])
         fs.start()
         print(f"filer listening on {fs.url}")
         _wait()
@@ -492,6 +520,18 @@ def main(argv: list[str] | None = None) -> int:
               f"(offset state: {syncer.state_path})")
         try:
             syncer.run()
+        except KeyboardInterrupt:
+            pass
+    elif args.cmd == "filer.backup.s3":
+        from .filer.s3_sink import S3Sink
+        sink = S3Sink(args.filer, args.endpoint, args.bucket,
+                      args.access_key, args.secret_key, args.prefix,
+                      args.state or None, poll_interval=args.interval)
+        print(f"filer.backup.s3 {args.filer} -> "
+              f"{args.endpoint}/{args.bucket}/{args.prefix} "
+              f"(offset state: {sink.state_path})")
+        try:
+            sink.run()
         except KeyboardInterrupt:
             pass
     elif args.cmd == "filer.backup":

@@ -1,0 +1,192 @@
+"""Metadata notification fan-out (weed/notification/configuration.go).
+
+The reference publishes every filer metadata mutation to a configured
+message bus (kafka / aws_sqs / google_pub_sub / gocdk) keyed by file
+path; consumers build search indexes, replication queues, and audit
+trails from it.  This package is that plane: a `Publisher` interface,
+concrete webhook / MQ / log-file publishers selected by a spec string
+(notification.toml analog), and a `NotificationTailer` that follows the
+filer's persistent metadata log and fans every event out with at-least-
+once delivery (checkpointed offset, per-event retries with backoff).
+
+Spec strings:
+    webhook:http://host:port/path     POST one JSON event per request
+    mq:broker_addr/namespace/topic    publish to the built-in MQ broker
+    logfile:/path/to/file             append JSON lines (debug/audit)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+class Publisher:
+    def publish(self, event: dict) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class WebhookPublisher(Publisher):
+    """POST each event as JSON (the gocdk/webhook shape)."""
+
+    def __init__(self, url: str, timeout: float = 10.0):
+        self.url = url
+        self.timeout = timeout
+
+    def publish(self, event: dict) -> None:
+        import urllib.request
+        req = urllib.request.Request(
+            self.url, data=json.dumps(event).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            if resp.status >= 300:
+                raise OSError(f"webhook {self.url}: {resp.status}")
+
+
+class MqPublisher(Publisher):
+    """Publish into the built-in MQ broker (the kafka-notification
+    analog: same fan-out role, our native bus)."""
+
+    def __init__(self, broker: str, namespace: str, topic: str):
+        from ..mq.client import MQClient
+        self._client = MQClient(broker)
+        self.namespace = namespace
+        self.topic = topic
+        self._configured = False
+
+    def publish(self, event: dict) -> None:
+        if not self._configured:
+            try:
+                self._client.configure_topic(self.namespace, self.topic)
+                self._configured = True
+            except RuntimeError:
+                # distinguish "already configured by a peer" (lookup
+                # succeeds -> proceed) from a transient broker/filer
+                # failure (raise so the tailer retries configuration
+                # next round instead of wedging forever)
+                try:
+                    self._client.lookup(self.namespace, self.topic)
+                    self._configured = True
+                except RuntimeError as e:
+                    raise OSError(str(e)) from None
+        key = (event.get("newEntry") or event.get("oldEntry") or
+               {}).get("fullPath", "")
+        try:
+            self._client.publish(self.namespace, self.topic,
+                                 key.encode(),
+                                 json.dumps(event).encode())
+        except RuntimeError as e:  # broker-side error: retryable
+            raise OSError(str(e)) from None
+
+
+class LogFilePublisher(Publisher):
+    """Append JSON lines — the audit/debug sink."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+        self._lock = threading.Lock()
+
+    def publish(self, event: dict) -> None:
+        with self._lock:
+            self._f.write(json.dumps(event) + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
+
+
+def from_spec(spec: str) -> Publisher:
+    """notification.toml analog: one enabled sink chosen by spec."""
+    kind, _, rest = spec.partition(":")
+    if kind == "webhook":
+        return WebhookPublisher(rest)
+    if kind == "logfile":
+        return LogFilePublisher(rest)
+    if kind == "mq":
+        broker, _, topic_path = rest.partition("/")
+        ns, _, topic = topic_path.partition("/")
+        if not (broker and ns and topic):
+            raise ValueError(
+                f"mq spec must be mq:broker/namespace/topic: {spec!r}")
+        return MqPublisher(broker, ns, topic)
+    raise ValueError(f"unknown notification spec {spec!r} "
+                     "(webhook:|mq:|logfile:)")
+
+
+class NotificationTailer:
+    """Follows a filer's MetaLog and fans events out with at-least-once
+    delivery: the offset checkpoint advances only after a successful
+    publish, and failures retry with capped backoff (the reference's
+    notification queue blocks the same way rather than dropping)."""
+
+    def __init__(self, meta_log, publisher: Publisher,
+                 state_path: str | None = None,
+                 poll_interval: float = 0.2):
+        self.meta_log = meta_log
+        self.publisher = publisher
+        self.state_path = state_path
+        self.poll_interval = poll_interval
+        self._since = self._load_offset()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _load_offset(self) -> int:
+        if not self.state_path:
+            return 0
+        try:
+            with open(self.state_path, encoding="utf-8") as f:
+                return int(json.load(f).get("sinceNs", 0))
+        except (OSError, ValueError):
+            return 0
+
+    def _save_offset(self) -> None:
+        if not self.state_path:
+            return
+        tmp = f"{self.state_path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"sinceNs": self._since}, f)
+        os.replace(tmp, self.state_path)
+
+    def start(self) -> "NotificationTailer":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        self.publisher.close()
+
+    def _run(self) -> None:
+        backoff = self.poll_interval
+        while not self._stop.is_set():
+            events = self.meta_log.events_since(self._since, limit=256)
+            if not events:
+                self._stop.wait(self.poll_interval)
+                continue
+            for ev in events:
+                while not self._stop.is_set():
+                    try:
+                        self.publisher.publish(ev)
+                        backoff = self.poll_interval
+                        break
+                    except OSError:
+                        # at-least-once: never advance past an
+                        # undelivered event; capped exponential backoff
+                        self._stop.wait(backoff)
+                        backoff = min(backoff * 2, 10.0)
+                if self._stop.is_set():
+                    return
+                self._since = ev["tsNs"]
+                self._save_offset()

@@ -201,12 +201,16 @@ def lookup(master: str, vid: int, use_cache: bool = True) -> list[dict]:
     """operation/lookup.go Lookup -> [{url, publicUrl}].  Resolution
     order: the follow-stream map (push-fed, authoritative) when
     enabled, then the TTL'd cache, then a lookup RPC."""
-    follower = _followers.get(master)
-    if follower is not None:
-        locs = follower.get_locations(vid)
-        if locs is not None:
-            return locs
     if use_cache:
+        # use_cache=False demands an authoritative RPC (delete()'s
+        # all-404-means-gone logic, read()'s stale-location retry) —
+        # the push map may trail a just-moved volume, so it is only
+        # consulted on the cached path
+        follower = _followers.get(master)
+        if follower is not None:
+            locs = follower.get_locations(vid)
+            if locs is not None:
+                return locs
         cached = _vid_cache.get(master, vid)
         if cached is not None:
             return cached

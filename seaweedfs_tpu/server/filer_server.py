@@ -24,7 +24,12 @@ class FilerServer:
                  port: int = 0, store_path: str = ":memory:",
                  collection: str = "", replication: str = "",
                  meta_log_dir: str | None = None,
-                 store_type: str = "sqlite"):
+                 store_type: str = "sqlite",
+                 notification: str = "",
+                 lock_peers: "list[str] | None" = None):
+        self._notification_spec = notification
+        self._notifier = None
+        self._lock_peers = lock_peers or []
         if meta_log_dir is None and store_path != ":memory:":
             # persist the metadata log beside the store by default —
             # subscribers must survive a filer restart
@@ -59,9 +64,20 @@ class FilerServer:
                         self._meta_patch_extended)
         self.http.route("GET", "/__meta__/events", self._meta_events)
         # distributed lock manager (weed/cluster/lock_manager) — the
-        # filer hosts the lock ring, as in the reference
+        # filer hosts the lock ring, as in the reference.  Ring
+        # membership comes from -lockPeers (every filer of a deployment
+        # configured with the same list); each key hashes to exactly
+        # one member, so clients dialing DIFFERENT filers still agree
+        # on the lock host via movedTo redirects.  Without peers the
+        # ring is this filer alone — correct for single-filer clusters,
+        # and multi-filer deployments that skip -lockPeers get per-
+        # filer (not cluster-wide) locks.
         from ..cluster import LockManager
         self.lock_manager = LockManager(self.http.url)
+        if self._lock_peers:
+            members = set(self._lock_peers)
+            members.add(self.http.url)
+            self.lock_manager.members = sorted(members)
         self.http.route("POST", "/admin/locks/acquire",
                         self._lock_acquire)
         self.http.route("POST", "/admin/locks/release",
@@ -125,11 +141,27 @@ class FilerServer:
         # reason, masterclient.go:471)
         from .. import operation
         operation.enable_follow(self.filer.master)
+        if self._notification_spec:
+            # metadata notification fan-out (weed/notification):
+            # every namespace mutation is published to the configured
+            # sink with at-least-once delivery
+            from .. import notification
+            state = None
+            if self.filer.meta_log.dir:
+                import os
+                state = os.path.join(self.filer.meta_log.dir,
+                                     "notify.offset")
+            self._notifier = notification.NotificationTailer(
+                self.filer.meta_log,
+                notification.from_spec(self._notification_spec),
+                state_path=state).start()
         return self
 
     def stop(self):
         from .. import operation
         operation.disable_follow(self.filer.master)
+        if self._notifier is not None:
+            self._notifier.stop()
         self.http.stop()
         self.filer.store.close()
         self.filer.meta_log.close()

@@ -112,6 +112,15 @@ class S3BackendStorage:
                                f"multipart {key}: {st}")
         return size
 
+    def put_bytes(self, key: str, data: bytes) -> None:
+        """Single-request PUT for in-memory payloads (sink objects,
+        manifests); bulk volume files go through `upload`."""
+        st, resp, _ = self._request("PUT", key, data)
+        if st >= 300:
+            raise RuntimeError(
+                f"s3 backend {self.id}: put {key}: "
+                f"{st} {resp[:200]!r}")
+
     def download(self, key: str, local_path: str,
                  chunk_size: int = 64 * 1024 * 1024) -> int:
         """Ranged-GET the object in chunks straight to disk (constant

@@ -25,7 +25,6 @@ class MasterFollower:
         self.poll_timeout = poll_timeout
         self._lock = threading.Lock()
         self._vids: dict[int, dict[str, dict]] = {}  # vid -> url -> loc
-        self._ec_vids: dict[int, set[str]] = {}
         self._leader: str | None = None
         self._synced = threading.Event()
         self._stop = threading.Event()
@@ -114,8 +113,10 @@ class MasterFollower:
                 operation._leader_cache[self.master] = leader
 
     def _apply_snapshot(self, topo: dict) -> None:
+        """EC shard locations deliberately stay RPC-resolved
+        (/dir/ec_lookup): the degraded-read path needs per-shard
+        placement, which the push events don't carry."""
         vids: dict[int, dict[str, dict]] = {}
-        ec_vids: dict[int, set[str]] = {}
         for dc in (topo.get("dataCenters") or {}).values():
             for rack in dc.get("racks", {}).values():
                 for node in rack.get("nodes", []):
@@ -124,12 +125,8 @@ class MasterFollower:
                                                  node["url"])}
                     for v in node.get("volumes", []):
                         vids.setdefault(v["id"], {})[loc["url"]] = loc
-                    for e in node.get("ecShards", []):
-                        ec_vids.setdefault(
-                            e["volumeId"], set()).add(loc["url"])
         with self._lock:
             self._vids = vids
-            self._ec_vids = ec_vids
 
     def _apply_event(self, ev: dict) -> None:
         if "url" not in ev:
@@ -145,11 +142,3 @@ class MasterFollower:
                     m.pop(loc["url"], None)
                     if not m:
                         self._vids.pop(vid, None)
-            for vid in ev.get("newEcVids", []):
-                self._ec_vids.setdefault(vid, set()).add(loc["url"])
-            for vid in ev.get("deletedEcVids", []):
-                s = self._ec_vids.get(vid)
-                if s:
-                    s.discard(loc["url"])
-                    if not s:
-                        self._ec_vids.pop(vid, None)
