@@ -101,6 +101,14 @@ class BrokerServer:
         r("POST", "/topics/flush", self._flush)
         r("POST", "/offsets/commit", self._commit_offset)
         r("GET", "/offsets/fetch", self._fetch_offset)
+        # schema plane (weed/mq/schema) + parquet compaction
+        # (weed/mq/logstore/log_to_parquet.go)
+        r("POST", "/topics/schema", self._schema_register)
+        r("GET", "/topics/schema", self._schema_get)
+        r("POST", "/topics/compact", self._compact)
+        # topic -> (revision, recordType) cache for publish validation
+        self._schema_cache: dict = {}
+        self._schema_cache_ts: dict = {}
 
     def start(self) -> "BrokerServer":
         self.http.start()
@@ -305,6 +313,129 @@ class BrokerServer:
         _check_name("topic", name)
         return Topic(ns, name)
 
+    # -- schema plane (weed/mq/schema; broker_grpc_pub.go gating) ------
+
+    def _registry(self):
+        from .schema import SchemaRegistry
+        return SchemaRegistry(self.filer)
+
+    def _schema_register(self, req: Request):
+        from .schema import SchemaError
+        b = req.json()
+        try:
+            t = self._topic_from(b["namespace"], b["topic"])
+            rev = self._registry().register(t, b["recordType"])
+        except NameError_ as e:
+            return 400, {"error": str(e)}
+        except SchemaError as e:
+            return 400, {"error": str(e)}
+        except RuntimeError as e:
+            return 503, {"error": str(e)}
+        with self._lock:
+            self._schema_cache.pop(t, None)
+        return 200, {"revision": rev}
+
+    def _schema_get(self, req: Request):
+        try:
+            t = self._topic_from(req.query["namespace"],
+                                 req.query["topic"])
+        except NameError_ as e:
+            return 400, {"error": str(e)}
+        try:
+            if "revision" in req.query:
+                from .schema import SchemaError
+                try:
+                    rt = self._registry().get(
+                        t, int(req.query["revision"]))
+                except SchemaError as e:
+                    return 404, {"error": str(e)}
+                return 200, {"revision": int(req.query["revision"]),
+                             "recordType": rt}
+            latest = self._registry().latest(t)
+        except RuntimeError as e:
+            return 503, {"error": str(e)}
+        if latest is None:
+            return 404, {"error": f"topic {t} has no schema"}
+        rev, rt = latest
+        return 200, {"revision": rev, "recordType": rt}
+
+    def _cached_schema(self, t: Topic) -> "dict | None":
+        """Latest schema for publish validation, cached for CONF_TTL
+        (same freshness rule as the layout cache)."""
+        now = time.monotonic()
+        with self._lock:
+            if t in self._schema_cache and \
+                    now - self._schema_cache_ts.get(t, 0) < self.CONF_TTL:
+                return self._schema_cache[t]
+        try:
+            latest = self._registry().latest(t)
+        except RuntimeError:
+            return None  # filer blip: do not reject publishes
+        rt = latest[1] if latest else None
+        with self._lock:
+            self._schema_cache[t] = rt
+            self._schema_cache_ts[t] = now
+        return rt
+
+    def _validate_against_schema(self, t: Topic, value_b64: str
+                                 ) -> "str | None":
+        """Error string when the topic has a schema and the value does
+        not conform (schema-gated publish); None = accept."""
+        rt = self._cached_schema(t)
+        if rt is None:
+            return None
+        raw = base64.b64decode(value_b64 or "")
+        if not raw:
+            # key-only tombstones/markers are always legal — every
+            # schema field is optional (proto3 semantics)
+            return None
+        from .schema import SchemaError, validate_record
+        try:
+            record = json.loads(raw)
+        except ValueError:
+            return "schema-gated topic: value is not JSON"
+        try:
+            validate_record(rt, record)
+        except SchemaError as e:
+            return str(e)
+        return None
+
+    def _compact(self, req: Request):
+        """log_to_parquet compaction of one topic (all partitions this
+        broker owns, or every partition with force=true)."""
+        from .parquet_store import compact_partition
+        b = req.json()
+        try:
+            t = self._topic_from(b["namespace"], b["topic"])
+            parts = self._load_layout(t)
+        except NameError_ as e:
+            return 400, {"error": str(e)}
+        except RuntimeError as e:
+            return 503, {"error": str(e)}
+        if parts is None:
+            return 404, {"error": f"topic {t} not configured"}
+        rt = self._cached_schema(t)
+        results = []
+        for idx, p in enumerate(parts):
+            if not b.get("force") and \
+                    self._owner_gate(t, parts, idx) is not None:
+                continue  # not ours; that broker compacts its own
+            try:
+                # flush the hot buffer so its rows are compactable
+                self._log_for(t, p).flush()
+                results.append(dict(
+                    compact_partition(self.filer, t, p, rt,
+                                      keep_recent_segments=int(
+                                          b.get("keepRecent", 1)),
+                                      min_segments=int(
+                                          b.get("minSegments", 2))),
+                    partition=p.to_json()))
+            except (RuntimeError, OSError) as e:
+                # one partition's failure must not block the others
+                results.append({"partition": p.to_json(),
+                                "error": str(e)})
+        return 200, {"results": results}
+
     def _configure(self, req: Request):
         b = req.json()
         try:
@@ -414,6 +545,9 @@ class BrokerServer:
         redirect = self._owner_gate(t, parts, parts.index(p))
         if redirect is not None:
             return redirect
+        err = self._validate_against_schema(t, b.get("value", ""))
+        if err:
+            return 400, {"error": err}
         ts = self._log_for(t, p).append(
             b.get("key", ""), b.get("value", ""),
             int(b.get("tsNs", 0)))
@@ -444,6 +578,10 @@ class BrokerServer:
         records = [(m.get("key", ""), m.get("value", ""),
                     int(m.get("tsNs", 0)))
                    for m in b.get("messages", [])]
+        for _k, v, _ts in records:  # atomic: reject the whole batch
+            err = self._validate_against_schema(t, v)
+            if err:
+                return 400, {"error": err}
         stamps = self._log_for(t, parts[idx]).append_many(records)
         return 200, {"partition": parts[idx].to_json(),
                      "tsNs": stamps}

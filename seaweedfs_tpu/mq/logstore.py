@@ -143,6 +143,42 @@ class PartitionLog:
                         if limit and len(out) >= limit:
                             break
                 return out
+        # The persisted scan restarts from a fresh listing when a
+        # listed segment 404s mid-read: a concurrent compaction
+        # deleted it, and skipping it while returning LATER segments'
+        # rows would advance the consumer's offset past messages now
+        # living in the parquet — permanent loss.  Within one pass,
+        # emitted stamps are forced strictly increasing, which drops
+        # the exact-duplicate rows a crashed compaction can leave
+        # (parquet written, victim logs not yet deleted).
+        for _attempt in range(4):
+            out = []
+            if self._scan_persisted(ts_ns, limit, out):
+                break
+        else:
+            raise RuntimeError(
+                f"mq: segments under {self.dir} kept vanishing "
+                f"mid-read (compaction storm?)")
+        if limit and len(out) >= limit:
+            return out[:limit]
+        # buffer rows continue the strictly-increasing guard: a flush
+        # racing this read could otherwise surface a row both from its
+        # fresh segment and the buffer snapshot
+        last = out[-1]["tsNs"] if out else ts_ns
+        with self._lock:
+            for rec in self._buf:
+                if rec["tsNs"] > last:
+                    out.append(rec)
+                    if limit and len(out) >= limit:
+                        break
+        return out
+
+    def _scan_persisted(self, ts_ns: int, limit: int,
+                        out: "list[dict]") -> bool:
+        """One pass over the persisted segments appending rows with
+        stamp > ts_ns to `out` (strictly increasing).  False = a listed
+        segment vanished (caller re-lists); True = pass completed (or
+        the limit was reached)."""
         segs = self._list_segments()
         # prune: keep segments that may contain stamps > ts_ns
         keep: list[str] = []
@@ -152,10 +188,26 @@ class PartitionLog:
             if first_next is not None and first_next <= ts_ns:
                 continue
             keep.append(name)
+        last = ts_ns
         for name in keep:
+            if name.endswith(".parquet"):
+                # merged read (logstore/merged_read.go): compacted
+                # columnar segments replay through the same sequence,
+                # byte-exact via their raw _key/_value columns
+                from .parquet_store import read_parquet_rows
+                for rec in read_parquet_rows(self.filer, self.dir,
+                                             name, last):
+                    if rec["tsNs"] > last:
+                        last = rec["tsNs"]
+                        out.append(rec)
+                        if limit and len(out) >= limit:
+                            return True
+                continue
             st, body, _ = http_bytes(
                 "GET", f"{self.filer}{urllib.parse.quote(self.dir)}/"
                 f"{name}")
+            if st == 404:
+                return False  # compacted away under us: re-list
             if st != 200:
                 continue
             for line in body.splitlines():
@@ -163,17 +215,12 @@ class PartitionLog:
                     rec = json.loads(line)
                 except ValueError:
                     continue
-                if rec.get("tsNs", 0) > ts_ns:
+                if rec.get("tsNs", 0) > last:
+                    last = rec["tsNs"]
                     out.append(rec)
                     if limit and len(out) >= limit:
-                        return out
-        with self._lock:
-            for rec in self._buf:
-                if rec["tsNs"] > ts_ns:
-                    out.append(rec)
-                    if limit and len(out) >= limit:
-                        break
-        return out
+                        return True
+        return True
 
     def _list_segments(self) -> "list[str]":
         st, body, _ = http_bytes(
@@ -184,7 +231,11 @@ class PartitionLog:
         names = [e["fullPath"].rsplit("/", 1)[-1]
                  for e in json.loads(body).get("entries", [])
                  if not e.get("isDirectory")]
-        return sorted(n for n in names if n.endswith(".log"))
+        # both log and compacted parquet segments, one chronological
+        # sequence (both are named by their first message stamp).
+        # parquet_store._list_files shares this listing protocol.
+        return sorted(n for n in names
+                      if n.endswith((".log", ".parquet")))
 
     def high_water_mark(self) -> int:
         """Newest offset in this partition (0 if empty)."""
@@ -204,6 +255,9 @@ class PartitionLog:
         segs = self._list_segments()
         if not segs:
             return 0
+        if segs[-1].endswith(".parquet"):
+            from .parquet_store import parquet_max_ts
+            return parquet_max_ts(self.filer, self.dir, segs[-1])
         st, body, _ = http_bytes(
             "GET", f"{self.filer}{urllib.parse.quote(self.dir)}/"
             f"{segs[-1]}")

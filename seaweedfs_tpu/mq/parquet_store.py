@@ -1,0 +1,210 @@
+"""Parquet logstore: compact JSON-line log segments into parquet
+(weed/mq/logstore/log_to_parquet.go, read_parquet_to_log.go,
+merged_read.go).
+
+Compaction rewrites a partition's cold `.log` segments into one
+columnar `.parquet` file named by its first message stamp (so parquet
+and log segments sort chronologically in one sequence) and deletes the
+compacted logs.  Every parquet file carries the raw message columns
+(_key, _value binary, _ts_ns) so replay is byte-exact regardless of
+schema; when the topic has a registered schema, the record's fields
+are ALSO materialized as typed columns — those power the query
+engine's row-group statistics pruning (query/engine.py parquet path,
+the reference's aggregations.go:40 fast path).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import urllib.parse
+
+from ..server.httpd import http_bytes
+from .topic import Partition, Topic
+
+
+def _partition_dir(topic: Topic, partition: Partition) -> str:
+    return f"{topic.dir}/{partition}"
+
+
+def _list_files(filer: str, dir_path: str) -> "list[str]":
+    st, body, _ = http_bytes(
+        "GET", f"{filer}{urllib.parse.quote(dir_path)}/?limit=1000000")
+    if st != 200:
+        return []
+    return sorted(
+        e["fullPath"].rsplit("/", 1)[-1]
+        for e in json.loads(body).get("entries", [])
+        if not e.get("isDirectory"))
+
+
+def _read_log_rows(filer: str, dir_path: str, name: str
+                   ) -> "list[dict]":
+    st, body, _ = http_bytes(
+        "GET", f"{filer}{urllib.parse.quote(dir_path)}/{name}")
+    if st != 200:
+        return []
+    rows = []
+    for line in body.splitlines():
+        try:
+            rows.append(json.loads(line))
+        except ValueError:
+            continue
+    return rows
+
+
+def compact_partition(filer: str, topic: Topic, partition: Partition,
+                      record_type: "dict | None" = None,
+                      keep_recent_segments: int = 1,
+                      min_segments: int = 2) -> dict:
+    """log_to_parquet.go CompactTopicPartitions analog: all but the
+    newest `keep_recent_segments` log segments become one parquet
+    file.  Returns {"compacted": n_segments, "rows": n, "file": name}.
+    The hot tail stays as logs — the buffer flush keeps appending
+    there, and a tailing subscriber's short-circuit path is
+    untouched."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    d = _partition_dir(topic, partition)
+    logs = [n for n in _list_files(filer, d) if n.endswith(".log")]
+    victims = logs[:-keep_recent_segments] if keep_recent_segments \
+        else logs
+    if len(victims) < min_segments:
+        return {"compacted": 0, "rows": 0}
+    rows: list[dict] = []
+    for name in victims:
+        rows.extend(_read_log_rows(filer, d, name))
+    if not rows:
+        return {"compacted": 0, "rows": 0}
+    rows.sort(key=lambda r: r.get("tsNs", 0))
+
+    keys = [base64.b64decode(r.get("key", "")) for r in rows]
+    values = [base64.b64decode(r.get("value", "")) for r in rows]
+    stamps = [int(r.get("tsNs", 0)) for r in rows]
+    arrays = {
+        "_key": pa.array(keys, pa.binary()),
+        "_value": pa.array(values, pa.binary()),
+        "_ts_ns": pa.array(stamps, pa.int64()),
+    }
+    names = ["_key", "_value", "_ts_ns"]
+    if record_type is not None:
+        from .schema import _arrow_type
+        decoded = []
+        for v in values:
+            try:
+                decoded.append(json.loads(v))
+            except ValueError:
+                decoded.append({})
+        for f in record_type["fields"]:
+            col = [d.get(f["name"]) if isinstance(d, dict) else None
+                   for d in decoded]
+            at = _arrow_type(f["type"])
+            try:
+                arr = pa.array(col, at)
+            except (pa.ArrowInvalid, pa.ArrowTypeError, OverflowError):
+                # pre-schema history / overflow rows: null the typed
+                # cell (the raw _value column preserves the bytes) —
+                # one bad row must not wedge compaction forever
+                arr = pa.array([_fit_or_none(v, at) for v in col], at)
+            arrays[f["name"]] = arr
+            names.append(f["name"])
+    table = pa.table({n: arrays[n] for n in names})
+    buf = io.BytesIO()
+    # small row groups so min/max statistics prune effectively
+    pq.write_table(table, buf, row_group_size=4096)
+    first_ts = stamps[0]
+    pname = f"{first_ts:020d}.parquet"
+    st, resp, _ = http_bytes(
+        "POST", f"{filer}{urllib.parse.quote(d)}/{pname}",
+        buf.getvalue())
+    if st >= 300:
+        raise RuntimeError(f"write parquet {d}/{pname}: {st} "
+                           f"{resp[:200]!r}")
+    leftovers = []
+    for name in victims:
+        st, _, _ = http_bytes(
+            "DELETE", f"{filer}{urllib.parse.quote(d)}/{name}")
+        if st >= 300 and st != 404:
+            st2, _, _ = http_bytes(  # one retry
+                "DELETE", f"{filer}{urllib.parse.quote(d)}/{name}")
+            if st2 >= 300 and st2 != 404:
+                leftovers.append(name)
+    # A surviving victim log means its rows exist in BOTH the log and
+    # the parquet; the merged read's strictly-increasing stamp guard
+    # dedupes replay, but the operator must know (the next compaction
+    # retries the delete since the segment is still listed).
+    out = {"compacted": len(victims) - len(leftovers),
+           "rows": len(rows), "file": pname}
+    if leftovers:
+        out["undeletedSegments"] = leftovers
+    return out
+
+
+def _fit_or_none(v, arrow_type):
+    """Best-effort single-value coercion; None when the value cannot
+    be represented in the column type."""
+    import pyarrow as pa
+    try:
+        pa.array([v], arrow_type)
+        return v
+    except (pa.ArrowInvalid, pa.ArrowTypeError, OverflowError):
+        return None
+
+
+def parquet_max_ts(filer: str, dir_path: str, name: str) -> int:
+    """Newest _ts_ns in a parquet segment, from the footer's row-group
+    statistics alone — no row data is read."""
+    import pyarrow.parquet as pq
+
+    st, body, _ = http_bytes(
+        "GET", f"{filer}{urllib.parse.quote(dir_path)}/{name}")
+    if st != 200:
+        return 0
+    md = pq.ParquetFile(io.BytesIO(body)).metadata
+    best = 0
+    for rg in range(md.num_row_groups):
+        g = md.row_group(rg)
+        for i in range(g.num_columns):
+            c = g.column(i)
+            if c.path_in_schema == "_ts_ns" and \
+                    c.statistics is not None and \
+                    c.statistics.has_min_max:
+                best = max(best, c.statistics.max)
+    return best
+
+
+def read_parquet_rows(filer: str, dir_path: str, name: str,
+                      since_ns: int = 0) -> "list[dict]":
+    """read_parquet_to_log.go analog: parquet rows back into the
+    {tsNs, key, value} message shape, byte-exact via the raw
+    columns."""
+    import pyarrow.parquet as pq
+
+    st, body, _ = http_bytes(
+        "GET", f"{filer}{urllib.parse.quote(dir_path)}/{name}")
+    if st != 200:
+        return []
+    pf = pq.ParquetFile(io.BytesIO(body))
+    out = []
+    for rg in range(pf.num_row_groups):
+        md = pf.metadata.row_group(rg)
+        col = {md.column(i).path_in_schema: md.column(i)
+               for i in range(md.num_columns)}
+        stats = col.get("_ts_ns").statistics if "_ts_ns" in col \
+            else None
+        if stats is not None and stats.has_min_max and \
+                stats.max <= since_ns:
+            continue  # whole row group is older than the resume point
+        t = pf.read_row_group(rg, columns=["_key", "_value", "_ts_ns"])
+        for key, value, ts in zip(t.column("_key").to_pylist(),
+                                  t.column("_value").to_pylist(),
+                                  t.column("_ts_ns").to_pylist()):
+            if ts > since_ns:
+                out.append({
+                    "tsNs": ts,
+                    "key": base64.b64encode(key or b"").decode(),
+                    "value": base64.b64encode(value or b"").decode(),
+                })
+    return out

@@ -1,0 +1,187 @@
+"""MQ schema registry (weed/mq/schema/schema.go, schema_builder.go).
+
+A topic may register a RecordType — named, typed fields (scalars,
+lists, nested records) — with append-only revisions.  Registered
+schemas gate publishes (a non-conforming record is rejected at the
+broker, schema.go's role in broker_grpc_pub.go) and drive the parquet
+logstore (to_parquet_schema.go analog via pyarrow in parquet_store).
+
+The registry document lives in the filer beside the topic's
+partitions:
+
+    /topics/<ns>/<topic>/schema.json   {"revisions": [RecordType...]}
+
+RecordType JSON shape (flat_schema_utils.go's wire form, pythonized):
+
+    {"fields": [{"name": "user_id", "type": "int64"},
+                {"name": "tags",    "type": {"list": "string"}},
+                {"name": "address", "type": {"record": {"fields":
+                    [{"name": "city", "type": "string"}]}}}]}
+
+Scalar types: bool int32 int64 float double bytes string
+(schema.go:36 TypeToString).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+
+from ..server.httpd import http_bytes
+from .topic import Topic
+
+SCALARS = {"bool", "int32", "int64", "float", "double", "bytes",
+           "string"}
+
+_PY_OK = {
+    "bool": (bool,),
+    "int32": (int,),
+    "int64": (int,),
+    "float": (int, float),
+    "double": (int, float),
+    "string": (str,),
+    "bytes": (str,),  # base64/utf8 text on the JSON wire
+}
+
+
+class SchemaError(ValueError):
+    pass
+
+
+def check_record_type(rt: dict) -> None:
+    """Validate a RecordType document (schema_builder.go invariants:
+    named fields, known types, no duplicate names)."""
+    if not isinstance(rt, dict) or not isinstance(rt.get("fields"),
+                                                  list):
+        raise SchemaError("recordType must be {'fields': [...]}")
+    seen = set()
+    for f in rt["fields"]:
+        name = f.get("name")
+        if not name or not isinstance(name, str):
+            raise SchemaError("every field needs a string name")
+        if name in seen:
+            raise SchemaError(f"duplicate field {name!r}")
+        seen.add(name)
+        _check_type(f.get("type"), name)
+
+
+def _check_type(t, where: str) -> None:
+    if isinstance(t, str):
+        if t not in SCALARS:
+            raise SchemaError(f"{where}: unknown scalar type {t!r}")
+        return
+    if isinstance(t, dict):
+        if set(t) == {"list"}:
+            _check_type(t["list"], f"{where}[]")
+            return
+        if set(t) == {"record"}:
+            check_record_type(t["record"])
+            return
+    raise SchemaError(f"{where}: bad type {t!r}")
+
+
+def validate_record(rt: dict, record: dict, where: str = "") -> None:
+    """Reject a record that doesn't conform to the RecordType
+    (to_schema_value.go's coercion, as validation).  Unknown keys are
+    rejected — a typo'd producer field must not vanish silently."""
+    if not isinstance(record, dict):
+        raise SchemaError(f"{where or 'record'}: not an object")
+    by_name = {f["name"]: f for f in rt["fields"]}
+    for key in record:
+        if key not in by_name:
+            raise SchemaError(f"{where}{key}: not in schema")
+    for f in rt["fields"]:
+        name, t = f["name"], f["type"]
+        if name not in record or record[name] is None:
+            continue  # all fields optional (proto3 semantics)
+        _validate_value(t, record[name], f"{where}{name}")
+
+
+def _validate_value(t, v, where: str) -> None:
+    if isinstance(t, str):
+        ok = _PY_OK[t]
+        if not isinstance(v, ok) or (t != "bool" and
+                                     isinstance(v, bool)):
+            raise SchemaError(
+                f"{where}: expected {t}, got {type(v).__name__}")
+        return
+    if "list" in t:
+        if not isinstance(v, list):
+            raise SchemaError(f"{where}: expected list")
+        for i, item in enumerate(v):
+            _validate_value(t["list"], item, f"{where}[{i}]")
+        return
+    validate_record(t["record"], v, f"{where}.")
+
+
+def to_arrow_schema(rt: dict):
+    """RecordType -> pyarrow schema (to_parquet_schema.go), plus the
+    system columns every row carries (_key, _ts_ns — the reference
+    parquet files carry the same, log_to_parquet.go:48)."""
+    import pyarrow as pa
+    return pa.schema(
+        [pa.field(f["name"], _arrow_type(f["type"]))
+         for f in rt["fields"]] +
+        [pa.field("_key", pa.binary()), pa.field("_ts_ns", pa.int64())])
+
+
+def _arrow_type(t):
+    import pyarrow as pa
+    if isinstance(t, str):
+        return {
+            "bool": pa.bool_(), "int32": pa.int32(),
+            "int64": pa.int64(), "float": pa.float32(),
+            "double": pa.float64(), "bytes": pa.binary(),
+            "string": pa.string(),
+        }[t]
+    if "list" in t:
+        return pa.list_(_arrow_type(t["list"]))
+    return pa.struct([pa.field(f["name"], _arrow_type(f["type"]))
+                      for f in t["record"]["fields"]])
+
+
+class SchemaRegistry:
+    """Filer-persisted, append-only revisions per topic."""
+
+    def __init__(self, filer: str):
+        self.filer = filer
+
+    def _path(self, t: Topic) -> str:
+        return f"{t.dir}/schema.json"
+
+    def _load(self, t: Topic) -> "list[dict]":
+        st, body, _ = http_bytes(
+            "GET", self.filer + urllib.parse.quote(self._path(t)))
+        if st == 404:
+            return []
+        if st != 200:
+            raise RuntimeError(f"schema registry read: {st}")
+        return json.loads(body)["revisions"]
+
+    def register(self, t: Topic, record_type: dict) -> int:
+        """Append a new revision; returns its id (0-based).
+        Re-registering the identical latest schema is a no-op returning
+        the current revision (idempotent producers)."""
+        check_record_type(record_type)
+        revisions = self._load(t)
+        if revisions and revisions[-1] == record_type:
+            return len(revisions) - 1
+        revisions.append(record_type)
+        st, body, _ = http_bytes(
+            "POST", self.filer + urllib.parse.quote(self._path(t)),
+            json.dumps({"revisions": revisions}).encode())
+        if st >= 300:
+            raise RuntimeError(f"schema registry write: {st}")
+        return len(revisions) - 1
+
+    def latest(self, t: Topic) -> "tuple[int, dict] | None":
+        revisions = self._load(t)
+        if not revisions:
+            return None
+        return len(revisions) - 1, revisions[-1]
+
+    def get(self, t: Topic, revision: int) -> dict:
+        revisions = self._load(t)
+        if not 0 <= revision < len(revisions):
+            raise SchemaError(f"no revision {revision}")
+        return revisions[revision]

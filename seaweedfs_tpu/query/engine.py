@@ -145,9 +145,58 @@ def _matches(row: dict, conds) -> bool:
     return True
 
 
+def _rowgroup_may_match(md_rg, conds) -> bool:
+    """Row-group statistics pruning (the reference prunes parquet row
+    groups by min/max the same way, query/engine/aggregations.go:40):
+    False only when some conjunct PROVABLY matches no row of the
+    group.  Missing/typeless stats keep the group."""
+    cols = {md_rg.column(i).path_in_schema: md_rg.column(i)
+            for i in range(md_rg.num_columns)}
+    for col, op, want in conds:
+        c = cols.get(col)
+        if c is None or not isinstance(want, (int, float)) or \
+                isinstance(want, bool):
+            continue
+        stats = c.statistics
+        if stats is None or not stats.has_min_max or \
+                not isinstance(stats.min, (int, float)):
+            continue
+        lo, hi = stats.min, stats.max
+        if (op in ("=", "<=", "<") and lo > want) or \
+                (op in ("=", ">=", ">") and hi < want) or \
+                (op == "<" and lo >= want) or \
+                (op == ">" and hi <= want):
+            return False
+    return True
+
+
+def _parquet_rows(data: bytes, conds):
+    """Parquet scan with row-group pruning; rows surface as plain
+    dicts (binary columns decoded latin-1 so predicates on text-ish
+    bytes behave)."""
+    try:
+        import pyarrow.parquet as pq
+    except ImportError:  # pragma: no cover
+        raise QueryError("parquet support requires pyarrow")
+    try:
+        pf = pq.ParquetFile(io.BytesIO(data))
+    except Exception as e:
+        raise QueryError(f"malformed parquet: {e}")
+    for rg in range(pf.num_row_groups):
+        if not _rowgroup_may_match(pf.metadata.row_group(rg), conds):
+            continue
+        table = pf.read_row_group(rg)
+        for row in table.to_pylist():
+            yield {k: (v.decode("latin-1")
+                       if isinstance(v, bytes) else v)
+                   for k, v in row.items()}
+
+
 def _rows_from(data: bytes, input_format: str,
-               csv_header: bool = True):
-    if input_format == "json":
+               csv_header: bool = True, conds=()):
+    if input_format == "parquet":
+        yield from _parquet_rows(data, conds)
+    elif input_format == "json":
         for line in data.splitlines():
             line = line.strip()
             if not line:
@@ -180,7 +229,8 @@ def run_query(sql: str, data: bytes, input_format: str = "json",
     if q["limit"] == 0:
         return []
     out = []
-    for row in _rows_from(data, input_format, csv_header):
+    for row in _rows_from(data, input_format, csv_header,
+                          q["conds"]):
         if not _matches(row, q["conds"]):
             continue
         if q["cols"] is None:

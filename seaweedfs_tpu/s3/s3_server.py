@@ -928,6 +928,8 @@ class S3ApiServer:
                     stag = sub.tag.rsplit("}", 1)[-1]
                     if stag == "CSV":
                         input_format = "csv"
+                    elif stag == "Parquet":
+                        input_format = "parquet"
                     elif stag == "FileHeaderInfo":
                         csv_header = \
                             (sub.text or "").upper() != "NONE"

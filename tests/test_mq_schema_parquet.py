@@ -1,0 +1,179 @@
+"""MQ schema registry + parquet logstore + query-over-parquet
+(VERDICT r3 Missing #2/#7, Next #7)."""
+
+import base64
+import io
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.mq.schema import (SchemaError, check_record_type,
+                                     to_arrow_schema, validate_record)
+from seaweedfs_tpu.query import run_query
+
+RT = {"fields": [
+    {"name": "user_id", "type": "int64"},
+    {"name": "name", "type": "string"},
+    {"name": "score", "type": "double"},
+    {"name": "tags", "type": {"list": "string"}},
+    {"name": "address", "type": {"record": {"fields": [
+        {"name": "city", "type": "string"}]}}},
+]}
+
+
+def test_record_type_validation():
+    check_record_type(RT)
+    with pytest.raises(SchemaError):
+        check_record_type({"fields": [{"name": "x", "type": "nope"}]})
+    with pytest.raises(SchemaError):
+        check_record_type({"fields": [{"name": "x", "type": "int64"},
+                                      {"name": "x", "type": "int64"}]})
+
+
+def test_record_validation():
+    ok = {"user_id": 7, "name": "ada", "score": 1.5,
+          "tags": ["a", "b"], "address": {"city": "berlin"}}
+    validate_record(RT, ok)
+    with pytest.raises(SchemaError):
+        validate_record(RT, {"user_id": "not-int"})
+    with pytest.raises(SchemaError):
+        validate_record(RT, {"unknown_field": 1})
+    with pytest.raises(SchemaError):
+        validate_record(RT, {"tags": ["x", 3]})
+    with pytest.raises(SchemaError):
+        validate_record(RT, {"address": {"zip": "x"}})
+
+
+def test_arrow_schema_shape():
+    s = to_arrow_schema(RT)
+    assert s.field("user_id").type == __import__("pyarrow").int64()
+    assert {f.name for f in s} >= {"user_id", "_key", "_ts_ns"}
+
+
+@pytest.fixture
+def mq_cluster(tmp_path):
+    from seaweedfs_tpu.mq.broker import BrokerServer
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    master = MasterServer().start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url,
+                      pulse_seconds=0.3).start()
+    time.sleep(0.4)
+    filer = FilerServer(master.url).start()
+    broker = BrokerServer(filer.url).start()
+    yield master, vs, filer, broker
+    broker.stop()
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_schema_gated_publish_and_parquet_roundtrip(mq_cluster):
+    from seaweedfs_tpu.mq.client import MQClient
+    from seaweedfs_tpu.server.httpd import http_json
+
+    master, vs, filer, broker = mq_cluster
+    c = MQClient(broker.url)
+    c.configure_topic("analytics", "events", partition_count=1)
+
+    # register schema; bad publishes rejected, good ones accepted
+    rt = {"fields": [{"name": "user_id", "type": "int64"},
+                     {"name": "action", "type": "string"}]}
+    r = http_json("POST", f"{broker.url}/topics/schema",
+                  {"namespace": "analytics", "topic": "events",
+                   "recordType": rt})
+    assert r.get("revision") == 0
+    r = http_json("GET", f"{broker.url}/topics/schema"
+                  "?namespace=analytics&topic=events")
+    assert r["recordType"] == rt
+
+    with pytest.raises(RuntimeError):
+        c.publish("analytics", "events", b"k", b"not json at all")
+    with pytest.raises(RuntimeError):
+        c.publish("analytics", "events", b"k",
+                  json.dumps({"user_id": "str!"}).encode())
+    stamps = []
+    for i in range(50):
+        stamps.append(c.publish(
+            "analytics", "events", f"k{i}".encode(),
+            json.dumps({"user_id": i, "action": f"a{i}"}).encode()))
+
+    # flush + compact into parquet
+    http_json("POST", f"{broker.url}/topics/flush",
+              {"namespace": "analytics", "topic": "events"})
+    r = http_json("POST", f"{broker.url}/topics/compact",
+                  {"namespace": "analytics", "topic": "events",
+                   "keepRecent": 0, "minSegments": 1})
+    assert "error" not in r, r
+    done = [x for x in r["results"] if x.get("compacted")]
+    assert done and sum(x["rows"] for x in done) == 50
+
+    # subscribers replay through the parquet segment byte-exactly
+    msgs = c.subscribe("analytics", "events", 0, since_ns=0,
+                       limit=1000)
+    assert len(msgs) == 50
+    assert msgs[0].value == json.dumps(
+        {"user_id": 0, "action": "a0"}).encode()
+    assert [m.ts_ns for m in msgs] == stamps
+
+    # resume mid-stream still works over parquet
+    mid = stamps[24]
+    tail = c.subscribe("analytics", "events", 0, since_ns=mid,
+                       limit=1000)
+    assert len(tail) == 25
+
+    # the parquet file itself is queryable with pushdown
+    from seaweedfs_tpu.mq.topic import Topic
+    from seaweedfs_tpu.mq import parquet_store
+    t = Topic("analytics", "events")
+    pdir = f"{t.dir}/{broker._topics[t][0]}"
+    names = parquet_store._list_files(filer.url, pdir)
+    pq_name = next(n for n in names if n.endswith(".parquet"))
+    from seaweedfs_tpu.server.httpd import http_bytes
+    import urllib.parse
+    st, data, _ = http_bytes(
+        "GET", f"{filer.url}{urllib.parse.quote(pdir)}/{pq_name}")
+    assert st == 200
+    rows = run_query("SELECT user_id, action FROM s3object "
+                     "WHERE user_id >= 48", data,
+                     input_format="parquet")
+    assert rows == [{"user_id": 48, "action": "a48"},
+                    {"user_id": 49, "action": "a49"}]
+
+
+def test_query_parquet_rowgroup_pruning():
+    """Row groups whose stats exclude the predicate are never read."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    table = pa.table({"x": list(range(10000)),
+                      "y": [f"s{i}" for i in range(10000)]})
+    buf = io.BytesIO()
+    pq.write_table(table, buf, row_group_size=1000)
+    data = buf.getvalue()
+    rows = run_query("SELECT x FROM s3object WHERE x = 9500", data,
+                     input_format="parquet")
+    assert rows == [{"x": 9500}]
+    rows = run_query("SELECT y FROM s3object WHERE x < 3 LIMIT 2",
+                     data, input_format="parquet")
+    assert rows == [{"y": "s0"}, {"y": "s1"}]
+
+    # prove pruning actually skips groups: monkeypatch read_row_group
+    from seaweedfs_tpu.query import engine as qe
+    reads = []
+    orig = pq.ParquetFile.read_row_group
+
+    def counting(self, rg, *a, **kw):
+        reads.append(rg)
+        return orig(self, rg, *a, **kw)
+
+    pq.ParquetFile.read_row_group = counting
+    try:
+        run_query("SELECT x FROM s3object WHERE x = 9500", data,
+                  input_format="parquet")
+    finally:
+        pq.ParquetFile.read_row_group = orig
+    assert reads == [9], reads  # only the matching group was read
