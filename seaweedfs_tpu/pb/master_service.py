@@ -51,6 +51,7 @@ class MasterServicer:
                 "publicUrl": hb.public_url or f"{hb.ip}:{hb.port}",
                 "dataCenter": hb.data_center, "rack": hb.rack,
                 "maxVolumeCount": hb.max_volume_count,
+                "maxFileKey": hb.max_file_key,
                 "volumes": [{
                     "id": v.id, "collection": v.collection,
                     "size": v.size, "fileCount": v.file_count,

@@ -22,8 +22,12 @@ class MemorySequencer:
             return start
 
     def set_max(self, seen: int) -> None:
+        """Floor the counter ABOVE `seen`: next_file_id hands out
+        `_counter` itself, so seen == _counter must also bump (the
+        boundary where a heartbeat-reported max key would otherwise be
+        reissued; memory_sequencer.go uses the same <= rule)."""
         with self._lock:
-            if seen > self._counter:
+            if seen >= self._counter:
                 self._counter = seen + 1
 
     def peek(self) -> int:

@@ -192,6 +192,16 @@ class MasterServer:
 
     def _heartbeat(self, req: Request):
         hb = req.json()
+        # Sequencer fencing (topology.go FindMaxFileKey + the
+        # reference's raft-checkpointed sequence): every heartbeat
+        # floors the file-id sequence above the largest needle key the
+        # reporting server holds.  A clock-skewed new leader cannot
+        # reissue an existing fid once a holder has heartbeated — and
+        # assigns cannot succeed before heartbeats arrive, because the
+        # post-failover topology is empty until they do.
+        mfk = int(hb.get("maxFileKey", 0))
+        if mfk:
+            self.sequencer.set_max(mfk)
         url = f"{hb.get('ip', '')}:{hb.get('port', '')}"
         old_vids, old_ec = self._node_vid_sets(url)
         self.topology.register_heartbeat(hb)

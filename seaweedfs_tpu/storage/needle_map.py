@@ -114,6 +114,16 @@ class NeedleMap:
             if types.size_is_valid(s):
                 yield k, o, s
 
+    def max_key(self) -> int:
+        """Largest needle id ever mapped (0 when empty) — the
+        per-volume input to the master's sequencer fencing
+        (master.proto Heartbeat.max_file_key).  Reads the monotonic
+        metric (maintained by put() and _load()) rather than scanning
+        the dict: the heartbeat thread calls this concurrently with
+        writer-thread put()s, and iterating the live dict there would
+        race a resize."""
+        return self.metrics.maximum_key
+
     def content_size(self) -> int:
         return sum(s for _, _, s in self.items())
 

@@ -249,9 +249,11 @@ class Store:
         volumes = []
         ec_shards = []
         max_volume_count = 0
+        max_file_key = 0
         for loc in self.locations:
             max_volume_count += loc.max_volume_count
             for vid, v in loc.volumes.items():
+                max_file_key = max(max_file_key, v.max_file_key())
                 volumes.append({
                     "id": vid,
                     "collection": v.collection,
@@ -278,6 +280,10 @@ class Store:
             "port": self.port,
             "publicUrl": self.public_url,
             "maxVolumeCount": max_volume_count,
+            # sequencer fencing input (master.proto Heartbeat
+            # max_file_key field 5): a new leader floors its file-id
+            # sequence above every key any volume server has stored
+            "maxFileKey": max_file_key,
             "volumes": volumes,
             "ecShards": ec_shards,
         }

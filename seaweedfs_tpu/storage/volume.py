@@ -108,6 +108,10 @@ class Volume:
     def file_count(self) -> int:
         return self.nm.metrics.file_count
 
+    def max_file_key(self) -> int:
+        """Largest needle id in this volume (volume.go MaxFileKey)."""
+        return self.nm.max_key()
+
     def deleted_count(self) -> int:
         return self.nm.metrics.deleted_count
 
