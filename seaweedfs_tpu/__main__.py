@@ -467,7 +467,10 @@ def main(argv: list[str] | None = None) -> int:
         print(f"admin listening on {ad.url}")
         _wait()
     elif args.cmd == "worker":
-        from .plugin.handlers import EcEncodeHandler, VacuumHandler
+        from .plugin.handlers import (EcBalanceHandler,
+                                      EcEncodeHandler,
+                                      VacuumHandler,
+                                      VolumeBalanceHandler)
         from .plugin.worker import PluginWorker
         handlers = []
         caps = args.capabilities.split(",")
@@ -476,6 +479,10 @@ def main(argv: list[str] | None = None) -> int:
                 backend=args.backend or None))
         if "vacuum" in caps:
             handlers.append(VacuumHandler())
+        if "volume_balance" in caps or "balance" in caps:
+            handlers.append(VolumeBalanceHandler())
+        if "ec_balance" in caps:
+            handlers.append(EcBalanceHandler())
         w = PluginWorker(args.admin, args.master, args.dir, handlers)
         w.start()
         print(f"worker {w.worker_id} polling {args.admin}")

@@ -1,0 +1,140 @@
+"""Balance JobHandlers (plugin/worker/handler_registry.go's
+volume_balance and ec_balance handlers; worker/tasks/balance/): detect
+volume-count / EC-shard skew across servers and run the same balancing
+algorithms the shell commands use — one implementation, two drivers
+(operator-invoked shell vs maintenance-plane worker).
+
+Executions take the cluster admin lease first (the shell's lock), so a
+worker-driven balance can never interleave with an operator running
+volume.move by hand."""
+
+from __future__ import annotations
+
+from ...operation import master_json
+from ..worker import JobHandler
+
+
+def _volume_counts(master: str) -> "dict[str, int]":
+    from ...topology import iter_volume_list_volumes
+    counts: dict[str, int] = {}
+    vl = master_json(master, "GET", "/vol/list")
+    for n, _v in iter_volume_list_volumes(vl):
+        counts[n["url"]] = counts.get(n["url"], 0) + 1
+    for url in master_json(master, "GET",
+                           "/cluster/status").get("dataNodes", []):
+        counts.setdefault(url, 0)
+    return counts
+
+
+class _LockedShellRun:
+    """Context manager: a CommandEnv holding the cluster admin lease
+    for the duration of a handler execution."""
+
+    def __init__(self, master: str):
+        from ...shell import CommandEnv
+        self.env = CommandEnv(master)
+
+    def __enter__(self):
+        self.env.lock()
+        return self.env
+
+    def __exit__(self, *exc):
+        try:
+            self.env.unlock()
+        except (OSError, RuntimeError):
+            pass  # lease expires on its own
+
+
+class VolumeBalanceHandler(JobHandler):
+    job_type = "volume_balance"
+    aliases = ["balance"]
+
+    def __init__(self, imbalance_threshold: int = 2):
+        self.imbalance_threshold = imbalance_threshold
+
+    def capability(self) -> dict:
+        return {"jobType": self.job_type, "canDetect": True,
+                "canExecute": True, "weight": 30}
+
+    def descriptor(self) -> dict:
+        return {"jobType": self.job_type, "fields": [
+            {"name": "imbalanceThreshold", "type": "int",
+             "default": self.imbalance_threshold,
+             "help": "propose a balance when max-min volume count "
+                     "per server exceeds this"},
+        ]}
+
+    def detect(self, worker) -> list[dict]:
+        counts = _volume_counts(worker.master)
+        if len(counts) < 2:
+            return []
+        spread = max(counts.values()) - min(counts.values())
+        if spread <= self.imbalance_threshold:
+            return []
+        return [{
+            "jobType": self.job_type,
+            # one cluster-wide job at a time; re-proposed while skewed
+            "dedupeKey": "volume_balance",
+            "params": {"spread": spread},
+        }]
+
+    def execute(self, worker, job_id: str, params: dict) -> str:
+        from ...shell.commands import cmd_volume_balance
+        worker.report_progress(job_id, 0.1, "acquiring cluster lock")
+        with _LockedShellRun(worker.master) as env:
+            worker.report_progress(job_id, 0.3, "balancing volumes")
+            return cmd_volume_balance(env, [])
+
+
+class EcBalanceHandler(JobHandler):
+    job_type = "ec_balance"
+
+    def __init__(self, collection: str = ""):
+        self.collection = collection
+
+    def capability(self) -> dict:
+        return {"jobType": self.job_type, "canDetect": True,
+                "canExecute": True, "weight": 30}
+
+    def descriptor(self) -> dict:
+        return {"jobType": self.job_type, "fields": [
+            {"name": "collection", "type": "string",
+             "default": self.collection},
+        ]}
+
+    def detect(self, worker) -> list[dict]:
+        """Propose when any server holds more EC shards of one volume
+        than a balanced spread allows (ec_balance.go's skew rule,
+        simplified to the per-volume max-shards criterion the shell
+        balancer enforces)."""
+        from ...topology import iter_volume_list_ec_shards
+        vl = master_json(worker.master, "GET", "/vol/list")
+        per_vid: dict[int, dict[str, int]] = {}
+        for node, e in iter_volume_list_ec_shards(vl):
+            n = bin(e.get("shardBits", 0)).count("1")
+            per_vid.setdefault(e["volumeId"], {})[node["url"]] = n
+        nodes = master_json(worker.master, "GET",
+                            "/cluster/status").get("dataNodes", [])
+        if not nodes:
+            return []
+        for vid, holders in per_vid.items():
+            total = sum(holders.values())
+            fair = -(-total // len(nodes))  # ceil
+            if max(holders.values(), default=0) > fair:
+                return [{
+                    "jobType": self.job_type,
+                    "dedupeKey": "ec_balance",
+                    "params": {"collection": self.collection},
+                }]
+        return []
+
+    def execute(self, worker, job_id: str, params: dict) -> str:
+        from ...shell.commands import cmd_ec_balance
+        worker.report_progress(job_id, 0.1, "acquiring cluster lock")
+        args = []
+        collection = params.get("collection", self.collection)
+        if collection:
+            args.append(f"-collection={collection}")
+        with _LockedShellRun(worker.master) as env:
+            worker.report_progress(job_id, 0.3, "balancing ec shards")
+            return cmd_ec_balance(env, args)

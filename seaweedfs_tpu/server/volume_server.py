@@ -59,6 +59,7 @@ class VolumeServer:
         r("POST", "/admin/mount_volume", self._mount_volume)
         r("POST", "/admin/unmount_volume", self._unmount_volume)
         r("POST", "/admin/set_readonly", self._set_readonly)
+        r("POST", "/admin/configure_volume", self._configure_volume)
         r("POST", "/admin/vacuum", self._vacuum)
         r("GET", "/admin/volume_file", self._read_volume_file)
         r("POST", "/admin/receive_file", self._receive_file)
@@ -447,6 +448,22 @@ class VolumeServer:
         if v is not None and v.read_only:
             v.sync()  # commit buffered .dat/.idx before anyone copies them
         return 200, {}
+
+    def _configure_volume(self, req: Request):
+        """volume_server.proto VolumeConfigure: rewrite the replica
+        placement byte in the superblock + cached info."""
+        b = req.json()
+        vid = int(b["volumeId"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return 404, {"error": f"volume {vid} not found"}
+        try:
+            v.configure_replication(str(b.get("replication", "000")))
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        self._heartbeat_once()
+        return 200, {"replication": str(
+            v.super_block.replica_placement)}
 
     def _vacuum(self, req: Request):
         """volume_server.proto VacuumVolume{Check,Compact,Commit}."""

@@ -5,3 +5,4 @@ from .commands import CommandEnv, COMMANDS, run_command  # noqa: F401
 from . import fs_commands  # noqa: F401  (registers fs.* + repair cmds)
 from . import remote_commands  # noqa: F401  (registers remote.*)
 from . import s3_commands  # noqa: F401  (registers s3.*)
+from . import admin_commands  # noqa: F401  (registers volume/cluster/mq admin)

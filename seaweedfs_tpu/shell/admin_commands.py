@@ -1,0 +1,349 @@
+"""Volume/cluster/MQ admin shell commands — the operator-surface
+breadth pass (weed/shell/command_volume_mount.go, _volume_delete.go,
+_volume_configure_replication.go, _volume_mark.go,
+_volume_server_evacuate.go, _cluster_ps.go, _mq_topic_*.go)."""
+
+from __future__ import annotations
+
+import json
+
+from ..operation import master_json
+from ..server.httpd import http_json
+from .commands import (CommandEnv, _all_node_urls, _move_shard,
+                       _move_volume, _must, _parse_flags, command)
+
+
+def _flag_true(opts: dict, name: str) -> bool:
+    """Go-style boolean flags: presence is true, but an explicit
+    -name=false|0|no is false."""
+    if name not in opts:
+        return False
+    return str(opts[name]).lower() not in ("false", "0", "no")
+
+
+def _vid_locations(env: CommandEnv, vid: int) -> "list[str]":
+    return [l["url"] for l in env.volume_locations(vid)]
+
+
+def _one_location(env: CommandEnv, opts: dict, vid: int) -> str:
+    node = opts.get("node", "")
+    locs = _vid_locations(env, vid)
+    if node:
+        if locs and node not in locs:
+            raise RuntimeError(
+                f"volume {vid} is not on {node} (it is on {locs})")
+        return node
+    if not locs:
+        raise RuntimeError(f"volume {vid} has no locations")
+    return locs[0]
+
+
+@command("volume.mount")
+def cmd_volume_mount(env: CommandEnv, args: list[str]) -> str:
+    """command_volume_mount.go: mount an unmounted volume on a server
+    (-volumeId=N -node=host:port).  -node is REQUIRED: the master
+    forgets an unmounted volume within one heartbeat pulse, so there
+    is no reliable location to infer."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    vid = int(opts["volumeId"])
+    node = opts.get("node", "")
+    if not node:
+        raise RuntimeError("volume.mount requires -node=host:port "
+                           "(the master does not track unmounted "
+                           "volumes)")
+    _must(http_json("POST", f"{node}/admin/mount_volume",
+                    {"volumeId": vid,
+                     "collection": opts.get("collection", "")}),
+          f"mount volume {vid} on {node}")
+    return f"mounted volume {vid} on {node}"
+
+
+@command("volume.unmount")
+def cmd_volume_unmount(env: CommandEnv, args: list[str]) -> str:
+    """command_volume_unmount.go (-volumeId=N [-node=...])."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    vid = int(opts["volumeId"])
+    node = _one_location(env, opts, vid)
+    _must(http_json("POST", f"{node}/admin/unmount_volume",
+                    {"volumeId": vid}),
+          f"unmount volume {vid} on {node}")
+    return f"unmounted volume {vid} on {node}"
+
+
+@command("volume.delete")
+def cmd_volume_delete(env: CommandEnv, args: list[str]) -> str:
+    """command_volume_delete.go: delete a volume from every holder
+    (-volumeId=N)."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    vid = int(opts["volumeId"])
+    locs = _vid_locations(env, vid)
+    if not locs:
+        return f"volume {vid} has no locations"
+    for url in locs:
+        _must(http_json("POST", f"{url}/admin/delete_volume",
+                        {"volumeId": vid}),
+              f"delete volume {vid} on {url}")
+    return f"deleted volume {vid} from {len(locs)} servers"
+
+
+@command("volume.delete.empty")
+def cmd_volume_delete_empty(env: CommandEnv, args: list[str]) -> str:
+    """command_volume_delete_empty.go: delete volumes holding no live
+    files (optionally -collection=...)."""
+    env.confirm_is_locked()
+    from ..topology import iter_volume_list_volumes
+    opts = _parse_flags(args)
+    collection = opts.get("collection")
+    seen: dict[int, dict] = {}
+    for _n, v in iter_volume_list_volumes(env.volume_list()):
+        seen[v["id"]] = v
+    deleted = []
+    for vid, v in sorted(seen.items()):
+        if collection is not None and \
+                v.get("collection", "") != collection:
+            continue
+        if v.get("fileCount", 0) - v.get("deleteCount", 0) > 0:
+            continue
+        locs = _vid_locations(env, vid)
+        # Quiet-period guard against the check-then-delete race: mark
+        # the volume readonly FIRST (blocking new writes), then ask
+        # every holder for its actual needle inventory; only a volume
+        # that is verifiably empty while unwritable is deleted.  A
+        # write that slipped in before the readonly mark is seen by
+        # the inventory check; one after it is rejected at the server.
+        for url in locs:
+            http_json("POST", f"{url}/admin/set_readonly",
+                      {"volumeId": vid, "readOnly": True})
+        live_anywhere = False
+        for url in locs:
+            r = http_json("GET",
+                          f"{url}/admin/volume_index?volumeId={vid}")
+            if r.get("error") or r.get("entries"):
+                live_anywhere = True
+                break
+        if live_anywhere:
+            for url in locs:  # restore writability
+                http_json("POST", f"{url}/admin/set_readonly",
+                          {"volumeId": vid, "readOnly": False})
+            continue
+        for url in locs:
+            http_json("POST", f"{url}/admin/delete_volume",
+                      {"volumeId": vid})
+        deleted.append(vid)
+    return f"deleted {len(deleted)} empty volumes: {deleted}" \
+        if deleted else "no empty volumes"
+
+
+@command("volume.mark")
+def cmd_volume_mark(env: CommandEnv, args: list[str]) -> str:
+    """command_volume_mark.go: -volumeId=N -readonly|-writable on
+    every holder."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    vid = int(opts["volumeId"])
+    if _flag_true(opts, "readonly"):
+        ro = True
+    elif _flag_true(opts, "writable") or \
+            ("readonly" in opts and not _flag_true(opts, "readonly")):
+        ro = False
+    else:
+        raise RuntimeError("pass -readonly or -writable")
+    locs = _vid_locations(env, vid)
+    for url in locs:
+        _must(http_json("POST", f"{url}/admin/set_readonly",
+                        {"volumeId": vid, "readOnly": ro}),
+              f"mark volume {vid} on {url}")
+    state = "readonly" if ro else "writable"
+    return f"marked volume {vid} {state} on {len(locs)} servers"
+
+
+@command("volume.configure.replication")
+def cmd_volume_configure_replication(env: CommandEnv,
+                                     args: list[str]) -> str:
+    """command_volume_configure_replication.go: rewrite a volume's
+    replica placement (-volumeId=N -replication=XYZ) on every
+    holder."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    vid = int(opts["volumeId"])
+    replication = str(opts["replication"])
+    if len(replication) != 3 or not replication.isdigit():
+        raise RuntimeError("-replication must be 3 digits (e.g. 001)")
+    locs = _vid_locations(env, vid)
+    if not locs:
+        return f"volume {vid} has no locations"
+    for url in locs:
+        _must(http_json("POST", f"{url}/admin/configure_volume",
+                        {"volumeId": vid,
+                         "replication": replication}),
+              f"configure volume {vid} on {url}")
+    return (f"volume {vid} replication set to {replication} on "
+            f"{len(locs)} servers")
+
+
+@command("volume.server.evacuate")
+def cmd_volume_server_evacuate(env: CommandEnv,
+                               args: list[str]) -> str:
+    """command_volume_server_evacuate.go: move every volume AND every
+    EC shard off a server (-node=host:port) onto the others.
+    Replicated volumes keep their copy count: the victim's copy is
+    moved to a server that doesn't already hold the volume (never just
+    deleted — that would leave it under-replicated)."""
+    env.confirm_is_locked()
+    from ..topology import (iter_volume_list_ec_shards,
+                            iter_volume_list_volumes)
+    opts = _parse_flags(args)
+    node = opts["node"]
+    others = [u for u in _all_node_urls(env) if u != node]
+    if not others:
+        return "no other servers to evacuate to"
+    vl = env.volume_list()
+    victims = []
+    ec_victims = []
+    per_target: dict[str, int] = {u: 0 for u in others}
+    for n, v in iter_volume_list_volumes(vl):
+        if n["url"] == node:
+            victims.append(v)
+        else:
+            per_target[n["url"]] = per_target.get(n["url"], 0) + 1
+    for n, e in iter_volume_list_ec_shards(vl):
+        if n["url"] == node:
+            ec_victims.append(e)
+    moved = 0
+    skipped = []
+    for v in victims:
+        holders = set(_vid_locations(env, v["id"]))
+        candidates = [u for u in others if u not in holders]
+        if not candidates:
+            skipped.append(v["id"])
+            continue
+        target = min(candidates, key=lambda u: per_target[u])
+        _move_volume(env, v["id"], v.get("collection", ""), node,
+                     target)
+        per_target[target] += 1
+        moved += 1
+    ec_moved = 0
+    from .commands import _ec_shard_locations
+    for e in ec_victims:
+        vid = e.get("volumeId", e.get("id"))
+        bits = e.get("shardBits", 0)
+        sids = [s for s in range(32) if bits & (1 << s)]
+        for sid in sids:
+            holders = _ec_shard_locations(env, vid)
+            target = min(others,
+                         key=lambda u: len(holders.get(u, [])))
+            _move_shard(env, vid, e.get("collection", ""), sid, node,
+                        target)
+            ec_moved += 1
+    out = f"evacuated {moved} volumes, {ec_moved} ec shards off {node}"
+    if skipped:
+        out += (f"; NOT moved (every other server already holds a "
+                f"replica): volumes {skipped}")
+    return out
+
+
+# -- cluster ---------------------------------------------------------
+
+@command("cluster.ps")
+def cmd_cluster_ps(env: CommandEnv, args: list[str]) -> str:
+    """command_cluster_ps.go: list cluster processes (masters +
+    volume servers, with volume counts)."""
+    from ..topology import iter_volume_list_volumes
+    st = master_json(env.master, "GET", "/cluster/status")
+    counts: dict[str, int] = {}
+    for n, _v in iter_volume_list_volumes(env.volume_list()):
+        counts[n["url"]] = counts.get(n["url"], 0) + 1
+    lines = [f"master {st.get('leader', '?')} leader "
+             f"(term {st.get('term', '?')})"]
+    for peer in st.get("peers", []):
+        if peer != st.get("leader"):
+            lines.append(f"master {peer} follower")
+    for url in st.get("dataNodes", []):
+        lines.append(f"volume {url} ({counts.get(url, 0)} volumes)")
+    return "\n".join(lines)
+
+
+@command("cluster.status")
+def cmd_cluster_status(env: CommandEnv, args: list[str]) -> str:
+    """Raw cluster status JSON (command_cluster_status.go)."""
+    return json.dumps(
+        master_json(env.master, "GET", "/cluster/status"), indent=2)
+
+
+# -- mq.topic.* (command_mq_topic_*.go) ------------------------------
+
+def _broker(env: CommandEnv, opts: dict) -> str:
+    b = opts.get("broker", "")
+    if not b:
+        raise RuntimeError("pass -broker=host:port")
+    return b
+
+
+@command("mq.topic.list")
+def cmd_mq_topic_list(env: CommandEnv, args: list[str]) -> str:
+    opts = _parse_flags(args)
+    ns = opts.get("namespace", "default")
+    r = _must(http_json(
+        "GET", f"{_broker(env, opts)}/topics/list?namespace={ns}"),
+        "list topics")
+    topics = r.get("topics", [])
+    return "\n".join(f"{ns}.{t}" for t in topics) or "no topics"
+
+
+@command("mq.topic.configure")
+def cmd_mq_topic_configure(env: CommandEnv, args: list[str]) -> str:
+    opts = _parse_flags(args)
+    r = _must(http_json(
+        "POST", f"{_broker(env, opts)}/topics/configure",
+        {"namespace": opts["namespace"], "topic": opts["topic"],
+         "partitionCount": int(opts.get("partitionCount", 4))}),
+        "configure topic")
+    return (f"topic {opts['namespace']}.{opts['topic']}: "
+            f"{len(r.get('partitions', []))} partitions")
+
+
+@command("mq.topic.desc")
+def cmd_mq_topic_desc(env: CommandEnv, args: list[str]) -> str:
+    opts = _parse_flags(args)
+    broker = _broker(env, opts)
+    r = _must(http_json(
+        "GET", f"{broker}/topics/lookup?namespace="
+        f"{opts['namespace']}&topic={opts['topic']}"), "lookup topic")
+    lines = []
+    for a in r.get("assignments", []):
+        p = a["partition"]
+        lines.append(f"partition [{p['rangeStart']},{p['rangeStop']}) "
+                     f"-> {a.get('broker', '?')}")
+    sch = http_json("GET", f"{broker}/topics/schema?namespace="
+                    f"{opts['namespace']}&topic={opts['topic']}")
+    if "recordType" in sch:
+        lines.append(f"schema rev {sch['revision']}: "
+                     + json.dumps(sch["recordType"]))
+    return "\n".join(lines)
+
+
+@command("mq.topic.compact")
+def cmd_mq_topic_compact(env: CommandEnv, args: list[str]) -> str:
+    """command_mq_topic_compact.go: fold cold log segments into
+    parquet."""
+    opts = _parse_flags(args)
+    r = _must(http_json(
+        "POST", f"{_broker(env, opts)}/topics/compact",
+        {"namespace": opts["namespace"], "topic": opts["topic"],
+         "force": True,
+         "keepRecent": int(opts.get("keepRecent", 1))}),
+        "compact topic")
+    done = sum(x.get("compacted", 0) for x in r.get("results", []))
+    rows = sum(x.get("rows", 0) for x in r.get("results", []))
+    return f"compacted {done} segments ({rows} rows) into parquet"
+
+
+@command("sleep")
+def cmd_sleep(env: CommandEnv, args: list[str]) -> str:
+    """command_sleep.go — for scripted `;` sequences."""
+    import time
+    time.sleep(float(args[0]) if args else 1.0)
+    return ""

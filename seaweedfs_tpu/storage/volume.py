@@ -112,6 +112,23 @@ class Volume:
         """Largest needle id in this volume (volume.go MaxFileKey)."""
         return self.nm.max_key()
 
+    def configure_replication(self, replication: str) -> None:
+        """Rewrite the replica placement in the superblock
+        (volume_super_block.go MaybeWriteSuperBlock path used by
+        VolumeConfigure): the placement byte lives in the .dat header
+        and in the cached volume_info."""
+        if self.is_remote:
+            raise ValueError("cannot configure a remote-tier volume")
+        rp = ReplicaPlacement.from_string(replication)
+        with self.lock:
+            self.super_block.replica_placement = rp
+            pos = self._dat.tell()
+            self._dat.seek(0)
+            self._dat.write(self.super_block.to_bytes())
+            self._dat.flush()
+            self._dat.seek(pos)
+            self.volume_info.replication = str(rp)
+
     def deleted_count(self) -> int:
         return self.nm.metrics.deleted_count
 
