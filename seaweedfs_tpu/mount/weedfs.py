@@ -138,8 +138,15 @@ class WeedFS:
 
     MAX_CACHE_ENTRIES = 16384  # the reference's meta_cache is bounded
 
+    # chunk-cache block size: reads are served from cached 1MB blocks
+    # (util/chunk_cache, the reference mount's TieredChunkCache role)
+    CHUNK_BLOCK = 1 << 20
+
     def __init__(self, filer: str, attr_ttl: float = 1.0,
-                 follow_events: bool = True):
+                 follow_events: bool = True,
+                 chunk_cache_mb: int = 64,
+                 chunk_cache_dir: "str | None" = None,
+                 chunk_cache_disk_mb: int = 1024):
         self.filer = filer
         self.attr_ttl = attr_ttl
         self._cache: dict[str, tuple[float, dict | None]] = {}
@@ -147,6 +154,14 @@ class WeedFS:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._since_ns = time.time_ns()
+        from ..util.chunk_cache import TieredChunkCache
+        # without the event stream nothing would ever invalidate
+        # cached data blocks — reads must stay always-fresh then
+        self.chunk_cache = TieredChunkCache(
+            mem_limit=chunk_cache_mb << 20,
+            disk_dir=chunk_cache_dir,
+            disk_limit=chunk_cache_disk_mb << 20) \
+            if chunk_cache_mb > 0 and follow_events else None
         self._event_thread: threading.Thread | None = None
         if follow_events:
             self._event_thread = threading.Thread(
@@ -198,6 +213,9 @@ class WeedFS:
             self._cache.pop(path, None)
             parent = path.rsplit("/", 1)[0] or "/"
             self._cache.pop(parent, None)
+        if self.chunk_cache is not None:
+            # a changed file drops all of its cached data blocks
+            self.chunk_cache.invalidate_group(path)
 
     def _follow_events(self) -> None:
         """Poll the filer's persistent metadata stream and invalidate
@@ -327,7 +345,7 @@ class WeedFS:
                 pages = None
         if pages is not None and size == 0:
             return b""
-        base = self._ranged_get(path, offset, size)
+        base = self._ranged_get_cached(path, offset, size)
         if pages is None:
             return base
         out = bytearray(size)            # gaps read as zeros
@@ -343,6 +361,35 @@ class WeedFS:
             if lo < hi:
                 out[lo - offset:hi - offset] = \
                     buf[lo - start:hi - start]
+        return bytes(out)
+
+    def _ranged_get_cached(self, path: str, offset: int,
+                           size: int) -> bytes:
+        """Assemble a read from cached 1MB blocks (util/chunk_cache):
+        repeated/sequential reads of a hot file hit memory (or the
+        disk tier) instead of re-crossing to the filer.  Blocks drop
+        when the file changes (the meta-event stream invalidates the
+        path's group, same staleness window as the attr cache)."""
+        if self.chunk_cache is None:
+            return self._ranged_get(path, offset, size)
+        B = self.CHUNK_BLOCK
+        out = bytearray()
+        pos, end = offset, offset + size
+        while pos < end:
+            bi = pos // B
+            key = f"{path}@{bi}"
+            block = self.chunk_cache.get(key)
+            if block is None:
+                block = self._ranged_get(path, bi * B, B)
+                if block:
+                    self.chunk_cache.set(key, block, group=path)
+            lo = pos - bi * B
+            want = min(end, (bi + 1) * B) - pos
+            piece = block[lo:lo + want]
+            out += piece
+            if len(piece) < want:
+                break  # EOF inside this block
+            pos += want
         return bytes(out)
 
     def _ranged_get(self, path: str, offset: int, size: int) -> bytes:

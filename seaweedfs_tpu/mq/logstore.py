@@ -25,6 +25,7 @@ import time
 import urllib.parse
 
 from ..server.httpd import http_bytes
+from ..util.log_buffer import LogBuffer
 from .topic import Partition, Topic
 
 FLUSH_BYTES = 256 * 1024
@@ -36,8 +37,10 @@ class PartitionLog:
         self.topic = topic
         self.partition = partition
         self.dir = f"{topic.dir}/{partition}"
-        self._buf: list[dict] = []
-        self._buf_bytes = 0
+        # hot tail page (util/log_buffer): fills -> _flush_records
+        # persists a filer segment; reads merge snapshot() on top of
+        # the persisted segments
+        self._buf = LogBuffer(self._flush_records, FLUSH_BYTES)
         self._last_ts = 0
         self._last_flushed_ts = 0
         self._lock = threading.Lock()
@@ -70,10 +73,7 @@ class PartitionLog:
                 ts = self._last_ts + 1
             self._last_ts = ts
             rec = {"tsNs": ts, "key": key_b64, "value": value_b64}
-            self._buf.append(rec)
-            self._buf_bytes += len(value_b64) + len(key_b64) + 32
-            if self._buf_bytes >= FLUSH_BYTES:
-                self._flush_locked()
+            self._buf.add(rec, len(value_b64) + len(key_b64) + 32)
             return ts
 
     def append_many(self, records: "list[tuple[str, str, int]]"
@@ -95,24 +95,21 @@ class PartitionLog:
                 if ts <= self._last_ts:
                     ts = self._last_ts + 1
                 self._last_ts = ts
-                self._buf.append({"tsNs": ts, "key": key_b64,
-                                  "value": value_b64})
-                self._buf_bytes += len(value_b64) + len(key_b64) + 32
+                self._buf.add({"tsNs": ts, "key": key_b64,
+                               "value": value_b64},
+                              len(value_b64) + len(key_b64) + 32)
                 out.append(ts)
-            if self._buf_bytes >= FLUSH_BYTES:
-                self._flush_locked()
             return out
 
     def flush(self) -> None:
         with self._lock:
-            self._flush_locked()
+            self._buf.flush()
 
-    def _flush_locked(self) -> None:
-        if not self._buf:
-            return
+    def _flush_records(self, recs: "list[dict]") -> None:
+        """LogBuffer sink: one filer segment per flushed page."""
         body = "\n".join(json.dumps(r, separators=(",", ":"))
-                         for r in self._buf).encode() + b"\n"
-        name = f"{self._buf[0]['tsNs']:020d}.log"
+                         for r in recs).encode() + b"\n"
+        name = f"{recs[0]['tsNs']:020d}.log"
         st, resp, _ = http_bytes(
             "POST", f"{self.filer}{urllib.parse.quote(self.dir)}/"
             f"{name}", body)
@@ -120,9 +117,7 @@ class PartitionLog:
             raise RuntimeError(
                 f"mq: flush segment {self.dir}/{name}: {st} "
                 f"{resp[:200]!r}")
-        self._last_flushed_ts = self._buf[-1]["tsNs"]
-        self._buf = []
-        self._buf_bytes = 0
+        self._last_flushed_ts = recs[-1]["tsNs"]
 
     # -- read -------------------------------------------------------------
 
@@ -137,7 +132,7 @@ class PartitionLog:
             # point is at/after the last FLUSHED stamp needs no filer
             # I/O — everything newer is in the buffer
             if self._last_ts and ts_ns >= self._last_flushed_ts:
-                for rec in self._buf:
+                for rec in self._buf.snapshot():
                     if rec["tsNs"] > ts_ns:
                         out.append(rec)
                         if limit and len(out) >= limit:
@@ -166,7 +161,7 @@ class PartitionLog:
         # fresh segment and the buffer snapshot
         last = out[-1]["tsNs"] if out else ts_ns
         with self._lock:
-            for rec in self._buf:
+            for rec in self._buf.snapshot():
                 if rec["tsNs"] > last:
                     out.append(rec)
                     if limit and len(out) >= limit:

@@ -221,12 +221,78 @@ def lookup(master: str, vid: int, use_cache: bool = True) -> list[dict]:
     return r["locations"]
 
 
+_uds_probe: dict[str, "str | None"] = {}
+_uds_lock = threading.Lock()
+
+
+def _uds_path_for(url: str) -> "str | None":
+    """The volume server's UDS read socket when it is reachable from
+    THIS host (same machine / shared filesystem namespace); cached per
+    server.  None = use HTTP."""
+    import os
+    with _uds_lock:
+        if url in _uds_probe:
+            return _uds_probe[url]
+    path: "str | None" = None
+    try:
+        st, body, _ = http_bytes("GET", f"{url}/status", timeout=5)
+        if st == 200:
+            p = json.loads(body).get("udsPath") or ""
+            if p and os.path.exists(p):
+                path = p
+    except (OSError, ValueError):
+        path = None
+    with _uds_lock:
+        _uds_probe[url] = path
+    return path
+
+
+def _read_via_uds(locs, vid: int, key: int, cookie: int
+                  ) -> "bytes | None":
+    """Same-host zero-copy fast path (server/uds_reader.py, the RDMA
+    sidecar analog): fetch the raw needle record over the unix socket
+    and validate client-side.  None = not applicable here (no local
+    socket / compressed / chunked / ttl'd needle — HTTP handles
+    those); raises on a cookie mismatch like the HTTP path 404s."""
+    from .server.uds_reader import uds_read_needle
+    for loc in locs:
+        p = _uds_path_for(loc["url"])
+        if not p:
+            continue
+        try:
+            n = uds_read_needle(p, vid, key)
+        except (OSError, LookupError, ValueError):
+            continue  # fall to HTTP (which also retries replicas)
+        if n.cookie != cookie:
+            # a per-replica mismatch is not terminal — the HTTP path
+            # 404s one replica and tries the next; do the same
+            continue
+        if n.is_compressed() or n.is_chunked_manifest() or \
+                n.has_ttl():
+            return None  # semantics live server-side: use HTTP
+        return bytes(n.data)
+    return None
+
+
 def read(master: str, fid: str, offset: int = 0,
          size: int | None = None) -> bytes:
     """Full or ranged needle read (ranged avoids whole-chunk transfers
     on the filer's chunk-view path)."""
     vid = int(fid.split(",", 1)[0])
     locs = lookup(master, vid)
+    if offset == 0 and size is None and \
+            not security.current().volume_read_key:
+        # whole-needle, unauthenticated-read deployments: try the
+        # same-host UDS zero-copy plane first
+        try:
+            part = fid.split(",", 1)[1]
+            key, cookie = int(part[:-8], 16), int(part[-8:], 16)
+        except (IndexError, ValueError):
+            key = cookie = -1
+        if key >= 0:
+            data = _read_via_uds(locs, vid, key, cookie)
+            if data is not None:
+                return data
     headers = {}
     if offset or size is not None:
         end = f"{offset + size - 1}" if size is not None else ""

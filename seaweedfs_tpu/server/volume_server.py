@@ -102,6 +102,24 @@ class VolumeServer:
 
     def start(self):
         self.http.start()
+        # UDS zero-copy read plane (RDMA sidecar analog,
+        # seaweedfs-rdma-sidecar/rdma-engine/src/ipc.rs): same-host
+        # readers fetch raw needle records via sendfile(2); path
+        # advertised in /status (udsPath)
+        self.uds_server = None
+        if not self.security.volume_read_key:
+            # the UDS plane carries no JWT; with read signing
+            # configured it would be an auth bypass for any local
+            # process, so it only exists on unauthenticated-read
+            # deployments
+            try:
+                from .uds_reader import UdsNeedleServer
+                sock = os.path.join(
+                    self.store.locations[0].directory, "uds.sock")
+                self.uds_server = UdsNeedleServer(self.store,
+                                                  sock).start()
+            except OSError:  # pragma: no cover — no AF_UNIX
+                self.uds_server = None
         # gRPC wire plane (volume_server.proto subset) — optional;
         # JSON-HTTP stays the always-on surface
         try:
@@ -123,6 +141,8 @@ class VolumeServer:
 
     def stop(self):
         self._hb_stop.set()
+        if getattr(self, "uds_server", None) is not None:
+            self.uds_server.stop()
         if getattr(self, "grpc_server", None) is not None:
             self.grpc_server.stop(grace=0.5)
         self.http.stop()
@@ -408,7 +428,9 @@ class VolumeServer:
     # -- status -----------------------------------------------------------
 
     def _status(self, req: Request):
+        uds = getattr(self, "uds_server", None)
         return 200, {"version": "seaweedfs-tpu/0.1",
+                     "udsPath": uds.sock_path if uds else "",
                      **self.store.collect_heartbeat()}
 
     # -- volume admin -----------------------------------------------------

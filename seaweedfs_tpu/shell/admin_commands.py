@@ -114,6 +114,9 @@ def cmd_volume_delete_empty(env: CommandEnv, args: list[str]) -> str:
         # that is verifiably empty while unwritable is deleted.  A
         # write that slipped in before the readonly mark is seen by
         # the inventory check; one after it is rejected at the server.
+        # Volumes the OPERATOR already froze stay frozen on the
+        # not-empty path — only our own quiet-period mark is undone.
+        was_readonly = bool(v.get("readOnly", False))
         for url in locs:
             http_json("POST", f"{url}/admin/set_readonly",
                       {"volumeId": vid, "readOnly": True})
@@ -125,9 +128,10 @@ def cmd_volume_delete_empty(env: CommandEnv, args: list[str]) -> str:
                 live_anywhere = True
                 break
         if live_anywhere:
-            for url in locs:  # restore writability
-                http_json("POST", f"{url}/admin/set_readonly",
-                          {"volumeId": vid, "readOnly": False})
+            if not was_readonly:
+                for url in locs:  # undo OUR mark only
+                    http_json("POST", f"{url}/admin/set_readonly",
+                              {"volumeId": vid, "readOnly": False})
             continue
         for url in locs:
             http_json("POST", f"{url}/admin/delete_volume",

@@ -1,0 +1,1 @@
+"""Foundation utilities (weed/util/*)."""

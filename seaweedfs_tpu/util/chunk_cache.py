@@ -1,0 +1,198 @@
+"""Tiered chunk cache (weed/util/chunk_cache/chunk_cache.go):
+a memory LRU in front of a bounded on-disk cache, used by the mount's
+read path so repeated reads of hot file blocks never re-cross the
+network (the reference mounts read chunks through the same two tiers,
+chunk_cache.go:113 ReadChunkAt — memory first, then disk layers).
+
+Keys are opaque strings (the mount uses "<path>@<block>"); per-path
+key tracking supports invalidation when a file changes under the
+cache (the mount's meta-event subscription drives this, the analog of
+the reference wiping its chunk cache on metadata updates)."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+
+
+class MemChunkCache:
+    """Byte-bounded LRU (chunk_cache_in_memory.go)."""
+
+    def __init__(self, limit_bytes: int = 64 << 20):
+        self.limit = limit_bytes
+        self._m: "OrderedDict[str, bytes]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    def get(self, key: str) -> "bytes | None":
+        with self._lock:
+            data = self._m.get(key)
+            if data is not None:
+                self._m.move_to_end(key)
+            return data
+
+    def set(self, key: str, data: bytes) -> None:
+        if len(data) > self.limit:
+            return
+        with self._lock:
+            old = self._m.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old)
+            self._m[key] = data
+            self._bytes += len(data)
+            while self._bytes > self.limit and self._m:
+                _k, v = self._m.popitem(last=False)
+                self._bytes -= len(v)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            old = self._m.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old)
+
+
+class DiskChunkCache:
+    """Bounded on-disk tier (chunk_cache_on_disk.go, simplified to one
+    layer): chunk files under a cache dir, LRU-evicted by in-process
+    access order.  Survives nothing — it's a cache; a fresh process
+    starts cold and stray files from a previous run are clipped by the
+    same eviction."""
+
+    def __init__(self, dir_path: str, limit_bytes: int = 1 << 30):
+        self.dir = dir_path
+        self.limit = limit_bytes
+        os.makedirs(dir_path, exist_ok=True)
+        self._lock = threading.Lock()
+        self._order: "OrderedDict[str, int]" = OrderedDict()
+        self._bytes = 0
+        for name in os.listdir(dir_path):  # adopt leftovers
+            p = os.path.join(dir_path, name)
+            if os.path.isfile(p):
+                sz = os.path.getsize(p)
+                self._order[name] = sz
+                self._bytes += sz
+        self._evict_locked()
+
+    def _fname(self, key: str) -> str:
+        return hashlib.sha256(key.encode()).hexdigest()[:40]
+
+    def get(self, key: str) -> "bytes | None":
+        name = self._fname(key)
+        with self._lock:
+            if name not in self._order:
+                return None
+            self._order.move_to_end(name)
+        try:
+            with open(os.path.join(self.dir, name), "rb") as f:
+                return f.read()
+        except OSError:
+            with self._lock:
+                self._bytes -= self._order.pop(name, 0)
+            return None
+
+    def set(self, key: str, data: bytes) -> None:
+        if len(data) > self.limit:
+            return
+        name = self._fname(key)
+        tmp = os.path.join(self.dir, f".{name}.{os.getpid()}")
+        try:
+            with open(tmp, "w+b") as f:
+                f.write(data)
+            os.replace(tmp, os.path.join(self.dir, name))
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return
+        with self._lock:
+            self._bytes -= self._order.pop(name, 0)
+            self._order[name] = len(data)
+            self._bytes += len(data)
+            self._evict_locked()
+
+    def delete(self, key: str) -> None:
+        name = self._fname(key)
+        with self._lock:
+            self._bytes -= self._order.pop(name, 0)
+        try:
+            os.remove(os.path.join(self.dir, name))
+        except OSError:
+            pass
+
+    def _evict_locked(self) -> None:
+        while self._bytes > self.limit and self._order:
+            name, sz = self._order.popitem(last=False)
+            self._bytes -= sz
+            try:
+                os.remove(os.path.join(self.dir, name))
+            except OSError:
+                pass
+
+
+class TieredChunkCache:
+    """Memory in front of optional disk (chunk_cache.go
+    TieredChunkCache).  Tracks keys per group (file path) so a changed
+    file invalidates all of its cached blocks at once."""
+
+    # bounds on the group index itself: the data tiers evict by bytes,
+    # but key-name bookkeeping would otherwise grow with every file
+    # ever read
+    MAX_GROUPS = 4096
+    MAX_KEYS_PER_GROUP = 8192
+
+    def __init__(self, mem_limit: int = 64 << 20,
+                 disk_dir: "str | None" = None,
+                 disk_limit: int = 1 << 30):
+        self.mem = MemChunkCache(mem_limit)
+        self.disk = DiskChunkCache(disk_dir, disk_limit) \
+            if disk_dir else None
+        self._groups: "OrderedDict[str, set]" = OrderedDict()
+        self._glock = threading.Lock()
+
+    def get(self, key: str) -> "bytes | None":
+        data = self.mem.get(key)
+        if data is not None:
+            return data
+        if self.disk is not None:
+            data = self.disk.get(key)
+            if data is not None:
+                self.mem.set(key, data)  # promote
+        return data
+
+    def set(self, key: str, data: bytes, group: str = "") -> None:
+        self.mem.set(key, data)
+        if self.disk is not None:
+            self.disk.set(key, data)
+        if group:
+            evict: "list[str]" = []
+            with self._glock:
+                keys = self._groups.get(group)
+                if keys is None:
+                    keys = self._groups[group] = set()
+                else:
+                    self._groups.move_to_end(group)
+                keys.add(key)
+                # evicted bookkeeping must drop its cached data too,
+                # or a group forgotten by the index could serve stale
+                # blocks with no way to invalidate them
+                if len(keys) > self.MAX_KEYS_PER_GROUP:
+                    evict.extend(keys)
+                    self._groups.pop(group, None)
+                while len(self._groups) > self.MAX_GROUPS:
+                    _g, old_keys = self._groups.popitem(last=False)
+                    evict.extend(old_keys)
+            for k in evict:
+                self.mem.delete(k)
+                if self.disk is not None:
+                    self.disk.delete(k)
+
+    def invalidate_group(self, group: str) -> None:
+        with self._glock:
+            keys = self._groups.pop(group, set())
+        for key in keys:
+            self.mem.delete(key)
+            if self.disk is not None:
+                self.disk.delete(key)

@@ -1,0 +1,58 @@
+"""In-memory log page with threshold flush
+(weed/util/log_buffer/log_buffer.go).
+
+The reference buffers appended log entries in memory pages and flushes
+a page to its sink when it fills or a timer fires, while readers merge
+the in-memory tail with flushed storage (log_read.go).  This is that
+primitive: `add` accumulates records, an overflowing page invokes
+`flush_fn` synchronously (in append order), and `snapshot` exposes the
+unflushed tail for merged reads.  The MQ partition log composes it
+with its stamp clock and filer-segment sink; the caller provides
+locking (both the broker and the reference hold the partition lock
+across stamp assignment + buffer append, so the buffer itself stays
+lock-free)."""
+
+from __future__ import annotations
+
+
+class LogBuffer:
+    def __init__(self, flush_fn, flush_bytes: int = 256 * 1024):
+        """flush_fn(records: list[dict]) -> None — must persist or
+        raise; on success the page resets."""
+        self.flush_fn = flush_fn
+        self.flush_bytes = flush_bytes
+        self._recs: list[dict] = []
+        self._bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._recs)
+
+    @property
+    def pending_bytes(self) -> int:
+        return self._bytes
+
+    def add(self, rec: dict, nbytes: int) -> None:
+        """Append one record (approximate size `nbytes`); flushes the
+        page when it crosses the threshold."""
+        self._recs.append(rec)
+        self._bytes += nbytes
+        if self._bytes >= self.flush_bytes:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._recs:
+            return
+        self.flush_fn(self._recs)
+        self._recs = []
+        self._bytes = 0
+
+    def snapshot(self) -> "list[dict]":
+        """The unflushed tail, for merged reads (log_read.go
+        ReadFromBuffer role)."""
+        return list(self._recs)
+
+    def first(self) -> "dict | None":
+        return self._recs[0] if self._recs else None
+
+    def last(self) -> "dict | None":
+        return self._recs[-1] if self._recs else None
