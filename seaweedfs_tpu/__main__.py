@@ -240,6 +240,17 @@ def main(argv: list[str] | None = None) -> int:
     sf.add_argument("-authMethods", dest="auth_methods",
                     default="password,publickey")
     sf.add_argument("-banner", default="")
+    sf.add_argument("-ldapServer", dest="ldap_server", default="",
+                    help="host:port of an LDAP server for password "
+                         "auth (iam/ldap, ldap_provider.go analog)")
+    sf.add_argument("-ldapUserDnTemplate", dest="ldap_dn_template",
+                    default="",
+                    help="user DN template, {} = username "
+                         "(e.g. uid={},ou=people,dc=corp)")
+    sf.add_argument("-ldapBaseDn", dest="ldap_base_dn", default="")
+    sf.add_argument("-ldapBindDn", dest="ldap_bind_dn", default="")
+    sf.add_argument("-ldapBindPassword", dest="ldap_bind_password",
+                    default="")
 
     sfu = sub.add_parser(
         "sftp.user", help="manage an SFTP user-store file")
@@ -581,11 +592,21 @@ def main(argv: list[str] | None = None) -> int:
                         serialization.Encoding.PEM,
                         serialization.PrivateFormat.PKCS8,
                         serialization.NoEncryption()))
+        ldap = None
+        if args.ldap_server:
+            from .iam.ldap import LdapProvider
+            host, _, port = args.ldap_server.partition(":")
+            ldap = LdapProvider(
+                host, int(port or 389),
+                base_dn=args.ldap_base_dn,
+                user_dn_template=args.ldap_dn_template,
+                bind_dn=args.ldap_bind_dn,
+                bind_password=args.ldap_bind_password)
         svc = SftpService(
             FilerClient(args.filer), UserStore(args.user_store),
             host_key=key, port=args.port,
             auth_methods=tuple(args.auth_methods.split(",")),
-            banner=args.banner).start()
+            banner=args.banner, ldap=ldap).start()
         print(f"sftp on {args.ip}:{svc.port} serving filer "
               f"{args.filer}")
         _wait()

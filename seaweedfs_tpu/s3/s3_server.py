@@ -16,6 +16,7 @@ Implemented surface (the core the reference's s3tests exercise first):
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import threading
 import time
@@ -723,8 +724,80 @@ class S3ApiServer:
             _elem(b, "CreationDate", _iso(e.attributes.crtime))
         return 200, (_xml(root), "application/xml")
 
+    # -- bucket default encryption (s3api_bucket_handlers.go
+    #    PutBucketEncryption; applied at PUT when the request carries
+    #    no SSE headers of its own) --------------------------------------
+
+    def _bucket_encryption_op(self, req: Request, bucket: str):
+        path = self._bucket_path(bucket)
+        e = self.filer.find_entry(path)
+        if e is None:
+            return _error(404, "NoSuchBucket", bucket)
+        if req.method == "PUT":
+            algo, kms_key = "", ""
+            try:
+                for el in ET.fromstring(req.body).iter():
+                    tag = el.tag.rsplit("}", 1)[-1]
+                    if tag == "SSEAlgorithm":
+                        algo = (el.text or "").strip()
+                    elif tag == "KMSMasterKeyID":
+                        kms_key = (el.text or "").strip()
+            except ET.ParseError as err:
+                return _error(400, "MalformedXML", str(err))
+            if algo not in ("AES256", "aws:kms"):
+                return _error(400, "MalformedXML",
+                              f"unsupported SSEAlgorithm {algo!r}")
+            if self.kms is None:
+                # both modes envelope-encrypt through the KMS here;
+                # accepting the config would make every subsequent
+                # object PUT fail 501 — reject the misconfiguration
+                # at the source instead
+                return _error(501, "NotImplemented",
+                              "no KMS configured on this gateway")
+            e.extended["encryptionConfig"] = json.dumps(
+                {"algorithm": algo, "kmsKeyId": kms_key})
+            self.filer.create_entry(e, create_parents=False)
+            return 200, b""
+        if req.method == "GET":
+            raw = e.extended.get("encryptionConfig", "")
+            if not raw:
+                return _error(
+                    404, "ServerSideEncryptionConfigurationNotFound"
+                    "Error", "no default encryption configuration")
+            cfg = json.loads(raw)
+            root = ET.Element("ServerSideEncryptionConfiguration",
+                              xmlns=S3_NS)
+            rule = _elem(root, "Rule")
+            by_default = _elem(rule,
+                               "ApplyServerSideEncryptionByDefault")
+            _elem(by_default, "SSEAlgorithm", cfg["algorithm"])
+            if cfg.get("kmsKeyId"):
+                _elem(by_default, "KMSMasterKeyID", cfg["kmsKeyId"])
+            return 200, (_xml(root), "application/xml")
+        if req.method == "DELETE":
+            e.extended.pop("encryptionConfig", None)
+            self.filer.create_entry(e, create_parents=False)
+            return 204, b""
+        return _error(405, "MethodNotAllowed", req.method)
+
+    def _default_encryption(self, bucket: str
+                            ) -> "tuple[str, str] | None":
+        """The bucket's default-SSE setting in parse_sse_kms_headers'
+        (mode, key_id) shape; None when unconfigured."""
+        e = self.filer.find_entry(self._bucket_path(bucket))
+        raw = e.extended.get("encryptionConfig", "") if e else ""
+        if not raw:
+            return None
+        try:
+            cfg = json.loads(raw)
+            return cfg["algorithm"], cfg.get("kmsKeyId", "")
+        except (ValueError, KeyError):
+            return None
+
     def _bucket_op(self, req: Request, bucket: str):
         path = self._bucket_path(bucket)
+        if "encryption" in req.query:
+            return self._bucket_encryption_op(req, bucket)
         if "versioning" in req.query:
             return self._bucket_versioning_op(req, bucket)
         if "object-lock" in req.query:
@@ -834,6 +907,11 @@ class S3ApiServer:
                 kms_req = parse_sse_kms_headers(lower)
             except SseError as e:
                 return _error(e.status, e.code, str(e))
+            if sse is None and kms_req is None:
+                # bucket-default encryption: a PUT with no SSE headers
+                # inherits the bucket's configured default (SSE-S3 or
+                # SSE-KMS), exactly AWS's PutBucketEncryption behavior
+                kms_req = self._default_encryption(bucket)
             body = req.body
             sse_ext = {}
             if sse is not None:
@@ -1328,6 +1406,10 @@ class S3ApiServer:
             dst_kms = parse_sse_kms_headers(lower)
         except SseError as e:
             return _error(e.status, e.code, str(e))
+        if dst_sse is None and dst_kms is None:
+            # the destination is a new object: the bucket's default
+            # encryption applies exactly like a plain PUT
+            dst_kms = self._default_encryption(bucket)
         data = self.filer.read_file(src_path)
         if src_key is not None:
             data = decrypt_entry(src_key, entry.extended, data)
@@ -1545,6 +1627,10 @@ class S3ApiServer:
             sse_kms = parse_sse_kms_headers(lower)
         except SseError as e:
             return _error(e.status, e.code, str(e))
+        if sse_c is None and sse_kms is None:
+            # bucket-default encryption binds at initiation too (AWS
+            # applies PutBucketEncryption defaults to multipart)
+            sse_kms = self._default_encryption(bucket)
         if sse_c is not None:
             marker.extended["sseKeyMd5"] = sse_c[1]
         elif sse_kms is not None:

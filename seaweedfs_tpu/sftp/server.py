@@ -55,9 +55,15 @@ class SftpService:
                  host_key: Ed25519PrivateKey | None = None,
                  port: int = 0, ip: str = "127.0.0.1",
                  auth_methods: tuple = ("password", "publickey"),
-                 max_auth_tries: int = 6, banner: str = ""):
+                 max_auth_tries: int = 6, banner: str = "",
+                 ldap=None):
         self.fs = fs
         self.users = user_store
+        # optional LDAP provider (iam/ldap.py): password auth consults
+        # the directory when the local store has no such user — the
+        # reference's ldap identity provider role (iam/ldap/
+        # ldap_provider.go) applied to the sftp gateway
+        self.ldap = ldap
         self.host_key = host_key or Ed25519PrivateKey.generate()
         self.port = port
         self.ip = ip
@@ -145,6 +151,24 @@ class SftpService:
                 r.boolean()
                 password = r.text()
                 ok = user is not None and user.check_password(password)
+                if not ok and user is None and self.ldap is not None:
+                    # directory-backed users (iam/ldap.py): the bind
+                    # IS the credential check on every login — nothing
+                    # is written to the local user store, so the
+                    # directory stays the source of truth and repeat
+                    # logins re-bind.  An LDAP OUTAGE (LdapError or
+                    # socket-level OSError) reads as auth failure, not
+                    # a dropped session; the try still burns.
+                    from ..iam.ldap import LdapError
+                    try:
+                        ident = self.ldap.authenticate(username,
+                                                       password)
+                    except (LdapError, OSError):
+                        ident = None
+                    if ident is not None:
+                        from .users import User
+                        user = User(username)  # session-scoped only
+                        ok = True
             elif (method == "publickey" and
                   "publickey" in self.auth_methods):
                 has_sig = r.boolean()

@@ -625,3 +625,80 @@ def test_policy_engine_unit():
     assert resource_arn("b", "k/x") == "arn:aws:s3:::b/k/x"
     with pytest.raises(PolicyError):
         parse_policy(b'{"Statement":[{"Effect":"Maybe"}]}')
+
+
+def test_bucket_default_encryption(s3, tmp_path):
+    """PutBucketEncryption: a PUT with no SSE headers inherits the
+    bucket default (SSE-S3 via the local KMS envelope); Get/Delete
+    round-trip the configuration (s3api_bucket_handlers.go
+    PutBucketEncryption)."""
+    from seaweedfs_tpu.iam.kms import LocalKms
+    gw = s3
+    gw.kms = LocalKms(str(tmp_path / "kms.json"))
+    st, _, _ = s3req(gw, "PUT", "/encbkt")
+    assert st in (200, 409)
+
+    # no config yet: GET 404s with the AWS error code
+    st, body, _ = s3req(gw, "GET", "/encbkt", query={"encryption": ""})
+    assert st == 404 and b"ServerSideEncryptionConfiguration" in body
+
+    cfg = (b'<ServerSideEncryptionConfiguration><Rule>'
+           b'<ApplyServerSideEncryptionByDefault>'
+           b'<SSEAlgorithm>AES256</SSEAlgorithm>'
+           b'</ApplyServerSideEncryptionByDefault>'
+           b'</Rule></ServerSideEncryptionConfiguration>')
+    st, _, _ = s3req(gw, "PUT", "/encbkt", body=cfg,
+                     query={"encryption": ""})
+    assert st == 200
+    st, body, _ = s3req(gw, "GET", "/encbkt",
+                        query={"encryption": ""})
+    assert st == 200 and b"AES256" in body
+
+    # object PUT with NO sse headers is encrypted at rest
+    blob = b"default-encrypted content"
+    st, _, _ = s3req(gw, "PUT", "/encbkt/secret.txt", body=blob)
+    assert st == 200
+    entry = gw.filer.find_entry("/buckets/encbkt/secret.txt")
+    assert entry.extended.get("sseKmsBlob"), \
+        "object not envelope-encrypted by the bucket default"
+    raw = gw.filer.read_file("/buckets/encbkt/secret.txt")
+    assert raw != blob  # ciphertext at rest
+    # reads transparently decrypt
+    st, body, _ = s3req(gw, "GET", "/encbkt/secret.txt")
+    assert st == 200 and body == blob
+
+    # multipart and copy destinations inherit the default too
+    st, body, _ = s3req(gw, "POST", "/encbkt/mp.bin",
+                        query={"uploads": ""})
+    assert st == 200
+    import re as _re
+    upload_id = _re.search(rb"<UploadId>([^<]+)</UploadId>",
+                           body).group(1).decode()
+    part = b"P" * 1024
+    st, _, _ = s3req(gw, "PUT", "/encbkt/mp.bin", body=part,
+                     query={"uploadId": upload_id, "partNumber": "1"})
+    assert st == 200
+    st, _, _ = s3req(
+        gw, "POST", "/encbkt/mp.bin", query={"uploadId": upload_id},
+        body=b'<CompleteMultipartUpload><Part><PartNumber>1'
+             b'</PartNumber></Part></CompleteMultipartUpload>')
+    assert st == 200
+    assert gw.filer.read_file("/buckets/encbkt/mp.bin") != part
+    st, body, _ = s3req(gw, "GET", "/encbkt/mp.bin")
+    assert st == 200 and body == part
+
+    st, _, _ = s3req(gw, "PUT", "/encbkt/copied.txt", headers={
+        "x-amz-copy-source": "/encbkt/secret.txt"})
+    assert st == 200
+    centry = gw.filer.find_entry("/buckets/encbkt/copied.txt")
+    assert centry.extended.get("sseKmsBlob")
+    st, body, _ = s3req(gw, "GET", "/encbkt/copied.txt")
+    assert st == 200 and body == blob
+
+    # delete the config: subsequent PUTs store plaintext again
+    st, _, _ = s3req(gw, "DELETE", "/encbkt",
+                     query={"encryption": ""})
+    assert st == 204
+    st, _, _ = s3req(gw, "PUT", "/encbkt/plain.txt", body=b"plain")
+    assert st == 200
+    assert gw.filer.read_file("/buckets/encbkt/plain.txt") == b"plain"
