@@ -179,6 +179,13 @@ def main(argv: list[str] | None = None) -> int:
     mqb.add_argument("-port", type=int, default=17777)
     mqb.add_argument("-filer", default="127.0.0.1:8888")
 
+    mqa = sub.add_parser(
+        "mq.agent", help="MQ agent: session facade in front of the "
+        "broker cluster (mq/agent/agent_server.go)")
+    mqa.add_argument("-ip", default="127.0.0.1")
+    mqa.add_argument("-port", type=int, default=16777)
+    mqa.add_argument("-broker", default="127.0.0.1:17777")
+
     kgw = sub.add_parser(
         "mq.kafka", help="Kafka wire-protocol gateway over a running "
         "MQ broker (mq/kafka/gateway)")
@@ -251,6 +258,11 @@ def main(argv: list[str] | None = None) -> int:
     sf.add_argument("-ldapBindDn", dest="ldap_bind_dn", default="")
     sf.add_argument("-ldapBindPassword", dest="ldap_bind_password",
                     default="")
+    sf.add_argument("-ldapTls", dest="ldap_tls",
+                    action="store_true",
+                    help="reach the directory over TLS (ldaps) — "
+                         "simple binds carry cleartext passwords, so "
+                         "use this for any non-loopback server")
 
     sfu = sub.add_parser(
         "sftp.user", help="manage an SFTP user-store file")
@@ -523,6 +535,11 @@ def main(argv: list[str] | None = None) -> int:
             _wait()
         finally:
             br.stop()
+    elif args.cmd == "mq.agent":
+        from .mq.agent import AgentServer
+        ag = AgentServer(args.broker, args.ip, args.port).start()
+        print(f"mq agent on {ag.url} -> broker {args.broker}")
+        _wait()
     elif args.cmd == "mq.kafka":
         from .mq.kafka_gateway import KafkaGateway
         gw = KafkaGateway(args.broker, args.ip, args.port).start()
@@ -601,7 +618,8 @@ def main(argv: list[str] | None = None) -> int:
                 base_dn=args.ldap_base_dn,
                 user_dn_template=args.ldap_dn_template,
                 bind_dn=args.ldap_bind_dn,
-                bind_password=args.ldap_bind_password)
+                bind_password=args.ldap_bind_password,
+                use_tls=args.ldap_tls)
         svc = SftpService(
             FilerClient(args.filer), UserStore(args.user_store),
             host_key=key, port=args.port,

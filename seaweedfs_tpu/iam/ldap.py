@@ -16,6 +16,10 @@ from __future__ import annotations
 import socket
 import threading
 
+class LdapError(RuntimeError):
+    pass
+
+
 # -- BER (X.690) minimal codec -------------------------------------------
 
 
@@ -54,6 +58,11 @@ class BerReader:
         return self.pos >= len(self.buf)
 
     def read_tlv(self) -> "tuple[int, bytes]":
+        # malformed/truncated BER from a misbehaving peer must raise a
+        # protocol error the callers handle (LdapError), never an
+        # IndexError that kills the calling thread
+        if self.pos + 2 > len(self.buf):
+            raise LdapError("truncated BER element")
         tag = self.buf[self.pos]
         self.pos += 1
         first = self.buf[self.pos]
@@ -62,8 +71,12 @@ class BerReader:
             n = first
         else:
             k = first & 0x7F
+            if self.pos + k > len(self.buf):
+                raise LdapError("truncated BER length")
             n = int.from_bytes(self.buf[self.pos:self.pos + k], "big")
             self.pos += k
+        if self.pos + n > len(self.buf):
+            raise LdapError("BER length exceeds message")
         body = self.buf[self.pos:self.pos + n]
         self.pos += n
         return tag, body
@@ -94,17 +107,25 @@ def read_message(sock_file) -> "tuple[int, int, bytes]":
 
 # -- client ---------------------------------------------------------------
 
-class LdapError(RuntimeError):
-    pass
-
-
 class LdapClient:
-    """One connection; bind/search/unbind (RFC 4511 subset)."""
+    """One connection; bind/search/unbind (RFC 4511 subset).
+    `use_tls` wraps the connection in TLS (ldaps) — simple binds carry
+    the password in cleartext, so any non-loopback directory should be
+    reached over TLS."""
 
     def __init__(self, host: str, port: int = 389,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, use_tls: bool = False,
+                 tls_verify: bool = True):
         self.sock = socket.create_connection((host, port),
                                              timeout=timeout)
+        if use_tls:
+            import ssl
+            ctx = ssl.create_default_context()
+            if not tls_verify:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            self.sock = ctx.wrap_socket(self.sock,
+                                        server_hostname=host)
         self.f = self.sock.makefile("rb")
         self._mid = 0
 
@@ -195,8 +216,11 @@ class LdapProvider:
                  user_dn_template: str = "",      # e.g. uid={},ou=...
                  bind_dn: str = "", bind_password: str = "",
                  user_attr: str = "uid",
-                 attr_map: "dict[str, str] | None" = None):
+                 attr_map: "dict[str, str] | None" = None,
+                 use_tls: bool = False, tls_verify: bool = True):
         self.host, self.port = host, port
+        self.use_tls = use_tls
+        self.tls_verify = tls_verify
         self.base_dn = base_dn
         self.user_dn_template = user_dn_template
         self.bind_dn = bind_dn
@@ -213,7 +237,8 @@ class LdapProvider:
         if not password:
             return None  # RFC 4513: empty password would be an
             # unauthenticated bind that "succeeds"
-        c = LdapClient(self.host, self.port)
+        c = LdapClient(self.host, self.port, use_tls=self.use_tls,
+                       tls_verify=self.tls_verify)
         try:
             if self.user_dn_template:
                 dn = self.user_dn_template.replace("{}", username)

@@ -1,0 +1,192 @@
+"""MQ agent: a session facade in front of the broker cluster
+(weed/mq/agent/agent_server.go; mq_agent.proto StartPublishSession /
+PublishRecord / SubscribeRecord).
+
+Clients talk to ONE local agent with a trivial session API instead of
+carrying broker-routing, partition, and offset logic themselves — the
+agent owns the MQClient (ownership redirects, partitioning) and the
+per-session subscribe cursors with explicit acks (at-least-once:
+un-acked records are redelivered after their lease lapses).
+
+HTTP surface (the JSON twin of the agent gRPC service):
+    POST /agent/sessions/publish    {namespace, topic}       -> {sessionId}
+    POST /agent/publish             {sessionId, key, value}  -> {tsNs}
+    POST /agent/sessions/subscribe  {namespace, topic}       -> {sessionId, partitions}
+    GET  /agent/subscribe?sessionId=&maxRecords=&waitSec=    -> {records}
+    POST /agent/ack                 {sessionId, partition, tsNs}
+    POST /agent/sessions/close      {sessionId}
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+import time
+import uuid
+
+from ..server.httpd import HttpServer, Request
+from .client import MQClient
+
+ACK_LEASE_SEC = 30.0
+
+
+class _SubSession:
+    def __init__(self, namespace: str, topic: str, partitions: int):
+        self.namespace = namespace
+        self.topic = topic
+        self.partitions = partitions
+        # committed offset per partition (acked); records after it may
+        # be redelivered
+        self.acked = {p: 0 for p in range(partitions)}
+        # in-flight leases: partition -> (delivered_up_to, expires)
+        self.leases: dict[int, tuple[int, float]] = {}
+        self.lock = threading.Lock()
+
+
+class AgentServer:
+    def __init__(self, broker: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.client = MQClient(broker)
+        self.http = HttpServer(host, port)
+        self._sessions: dict[str, dict] = {}
+        self._subs: dict[str, _SubSession] = {}
+        self._lock = threading.Lock()
+        r = self.http.route
+        r("POST", "/agent/sessions/publish", self._start_publish)
+        r("POST", "/agent/publish", self._publish)
+        r("POST", "/agent/sessions/subscribe", self._start_subscribe)
+        r("GET", "/agent/subscribe", self._subscribe)
+        r("POST", "/agent/ack", self._ack)
+        r("POST", "/agent/sessions/close", self._close)
+
+    def start(self) -> "AgentServer":
+        self.http.start()
+        return self
+
+    def stop(self) -> None:
+        self.http.stop()
+
+    @property
+    def url(self) -> str:
+        return self.http.url
+
+    # -- publish sessions ----------------------------------------------
+
+    def _start_publish(self, req: Request):
+        b = req.json()
+        ns, topic = b["namespace"], b["topic"]
+        try:
+            self.client.configure_topic(
+                ns, topic, int(b.get("partitionCount", 4)))
+        except RuntimeError:
+            try:  # already configured (by a peer) is fine
+                self.client.lookup(ns, topic)
+            except RuntimeError as e:
+                return 503, {"error": str(e)}
+        sid = uuid.uuid4().hex
+        with self._lock:
+            self._sessions[sid] = {"kind": "publish",
+                                   "namespace": ns, "topic": topic}
+        return 200, {"sessionId": sid}
+
+    def _publish(self, req: Request):
+        b = req.json()
+        with self._lock:
+            sess = self._sessions.get(b.get("sessionId", ""))
+        if sess is None or sess["kind"] != "publish":
+            return 404, {"error": "unknown publish session"}
+        try:
+            ts = self.client.publish(
+                sess["namespace"], sess["topic"],
+                base64.b64decode(b.get("key", "")),
+                base64.b64decode(b.get("value", "")))
+        except RuntimeError as e:
+            return 503, {"error": str(e)}
+        return 200, {"tsNs": ts}
+
+    # -- subscribe sessions --------------------------------------------
+
+    def _start_subscribe(self, req: Request):
+        b = req.json()
+        ns, topic = b["namespace"], b["topic"]
+        try:
+            parts = self.client.lookup(ns, topic)
+        except RuntimeError as e:
+            return 404, {"error": str(e)}
+        sid = uuid.uuid4().hex
+        sub = _SubSession(ns, topic, len(parts))
+        with self._lock:
+            self._sessions[sid] = {"kind": "subscribe"}
+            self._subs[sid] = sub
+        return 200, {"sessionId": sid, "partitions": len(parts)}
+
+    def _subscribe(self, req: Request):
+        sid = req.query.get("sessionId", "")
+        with self._lock:
+            sub = self._subs.get(sid)
+        if sub is None:
+            return 404, {"error": "unknown subscribe session"}
+        max_records = int(req.query.get("maxRecords", 100))
+        deadline = time.time() + min(
+            float(req.query.get("waitSec", 0)), 30.0)
+        while True:
+            records = self._collect(sub, max_records)
+            if records or time.time() >= deadline:
+                return 200, {"records": records}
+            time.sleep(0.15)
+
+    def _collect(self, sub: _SubSession, max_records: int
+                 ) -> "list[dict]":
+        out: list[dict] = []
+        now = time.time()
+        for p in range(sub.partitions):
+            if len(out) >= max_records:
+                break
+            with sub.lock:
+                lease = sub.leases.get(p)
+                if lease is not None and lease[1] > now:
+                    continue  # in flight, lease still valid
+                since = sub.acked[p]
+            try:
+                msgs = self.client.subscribe(
+                    sub.namespace, sub.topic, p, since_ns=since,
+                    limit=max_records - len(out))
+            except RuntimeError:
+                continue
+            if not msgs:
+                with sub.lock:
+                    sub.leases.pop(p, None)
+                continue
+            with sub.lock:
+                sub.leases[p] = (msgs[-1].ts_ns,
+                                 now + ACK_LEASE_SEC)
+            for m in msgs:
+                out.append({
+                    "partition": p, "tsNs": m.ts_ns,
+                    "key": base64.b64encode(m.key).decode(),
+                    "value": base64.b64encode(m.value).decode(),
+                })
+        return out
+
+    def _ack(self, req: Request):
+        b = req.json()
+        with self._lock:
+            sub = self._subs.get(b.get("sessionId", ""))
+        if sub is None:
+            return 404, {"error": "unknown subscribe session"}
+        p = int(b["partition"])
+        ts = int(b["tsNs"])
+        with sub.lock:
+            if p in sub.acked and ts > sub.acked[p]:
+                sub.acked[p] = ts
+            lease = sub.leases.get(p)
+            if lease is not None and ts >= lease[0]:
+                sub.leases.pop(p, None)  # batch fully acked
+        return 200, {}
+
+    def _close(self, req: Request):
+        sid = req.json().get("sessionId", "")
+        with self._lock:
+            self._sessions.pop(sid, None)
+            self._subs.pop(sid, None)
+        return 200, {}

@@ -427,3 +427,44 @@ def cmd_ec_rebalance_proportional(env: CommandEnv,
             f"capacity " +
             json.dumps({u: f"{used[u]}/{capacity[u]}"
                         for u in sorted(capacity)}))
+
+
+@command("fs.mv")
+def cmd_fs_mv(env: CommandEnv, args: list[str]) -> str:
+    """command_fs_mv.go: rename/move within the filer namespace via
+    the atomic rename RPC (filer.proto AtomicRenameEntry)."""
+    paths = [a for a in args if not a.startswith("-")]
+    if len(paths) != 2:
+        raise RuntimeError("usage: fs.mv <source> <destination>")
+    src, dst = paths
+    r = http_json("POST", env.require_filer() + "/__meta__/rename",
+                  {"oldPath": src, "newPath": dst})
+    if "error" in r:
+        raise RuntimeError(f"fs.mv: {r['error']}")
+    return f"moved {src} -> {dst}"
+
+
+@command("fs.tree")
+def cmd_fs_tree(env: CommandEnv, args: list[str]) -> str:
+    """command_fs_tree.go: recursive listing as an indented tree."""
+    paths = [a for a in args if not a.startswith("-")] or ["/"]
+    root = paths[0]
+    lines: list[str] = [root]
+    dirs = files = 0
+
+    def walk(path: str, depth: int) -> None:
+        nonlocal dirs, files
+        for e in _list_dir(env, path):
+            name = e["fullPath"].rsplit("/", 1)[-1]
+            is_dir = e.get("isDirectory")
+            lines.append("  " * (depth + 1) +
+                         (name + "/" if is_dir else name))
+            if is_dir:
+                dirs += 1
+                walk(e["fullPath"], depth + 1)
+            else:
+                files += 1
+
+    walk(root.rstrip("/") or "/", 0)
+    lines.append(f"{dirs} directories, {files} files")
+    return "\n".join(lines)

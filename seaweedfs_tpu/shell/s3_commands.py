@@ -12,6 +12,7 @@
 from __future__ import annotations
 
 from ..filer.client import FilerClient
+from ..server.httpd import http_bytes
 from .commands import CommandEnv, _parse_flags, command
 
 BUCKETS_ROOT = "/buckets"
@@ -114,3 +115,58 @@ def s3_lifecycle_apply(env: CommandEnv, args: list[str]) -> str:
         lines.append(f"{b.name}: expired {deleted} objects, "
                      f"aborted {aborted} uploads")
     return "\n".join(lines) or "no buckets carry lifecycle configs"
+
+
+@command("s3.bucket.create")
+def cmd_s3_bucket_create(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_bucket_create.go: a bucket is a directory under
+    /buckets in the filer namespace."""
+    opts = _parse_flags(args)
+    name = opts.get("name", "")
+    if not name or "/" in name:
+        raise RuntimeError("usage: s3.bucket.create -name=<bucket>")
+    st, body, _ = http_bytes(
+        "POST", env.require_filer() + f"/buckets/{name}/")
+    if st >= 300:
+        raise RuntimeError(f"create bucket: HTTP {st} {body[:120]!r}")
+    return f"created bucket {name}"
+
+
+@command("s3.bucket.delete")
+def cmd_s3_bucket_delete(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_bucket_delete.go (-name=... [-force] — a non-empty
+    bucket needs -force, matching the reference's guard)."""
+    opts = _parse_flags(args)
+    name = opts.get("name", "")
+    if not name:
+        raise RuntimeError(
+            "usage: s3.bucket.delete -name=<bucket> [-force]")
+    st, body, _ = http_bytes(
+        "GET", env.require_filer() + f"/buckets/{name}/?limit=1")
+    if st == 404:
+        raise RuntimeError(f"no bucket {name}")
+    import json as _json
+    entries = _json.loads(body).get("entries", []) if st == 200 else []
+    if entries and "force" not in opts:
+        raise RuntimeError(
+            f"bucket {name} is not empty; pass -force")
+    st, body, _ = http_bytes(
+        "DELETE", env.require_filer() +
+        f"/buckets/{name}?recursive=true")
+    if st >= 300:
+        raise RuntimeError(f"delete bucket: HTTP {st}")
+    return f"deleted bucket {name}"
+
+
+@command("s3.bucket.list")
+def cmd_s3_bucket_list(env: CommandEnv, args: list[str]) -> str:
+    st, body, _ = http_bytes(
+        "GET", env.require_filer() + "/buckets/?limit=10000")
+    if st == 404:
+        return "no buckets"
+    import json as _json
+    out = []
+    for e in _json.loads(body).get("entries", []):
+        if e.get("isDirectory"):
+            out.append(e["fullPath"].rsplit("/", 1)[-1])
+    return "\n".join(sorted(out)) or "no buckets"

@@ -261,3 +261,79 @@ def test_multibroker_failover_takeover(cluster):
     assert vals == [b"before", b"before2", b"after"]
     owners2 = {a["broker"] for a in c.lookup("chat", "ha")}
     assert owners2 == {broker_a.url}
+
+
+def test_agent_sessions_publish_subscribe_ack(tmp_path):
+    """MQ agent facade (mq/agent/agent_server.go analog): publish and
+    subscribe through sessions with explicit acks; un-acked batches
+    redeliver after the lease, acked ones never do."""
+    import base64
+
+    from seaweedfs_tpu.mq.agent import AgentServer
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.httpd import http_json
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.mq.broker import BrokerServer
+
+    master = MasterServer().start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url,
+                      pulse_seconds=0.3).start()
+    time.sleep(0.4)
+    filer = FilerServer(master.url).start()
+    broker = BrokerServer(filer.url).start()
+    agent = AgentServer(broker.url).start()
+    try:
+        r = http_json("POST", f"{agent.url}/agent/sessions/publish",
+                      {"namespace": "iot", "topic": "metrics",
+                       "partitionCount": 2})
+        pub = r["sessionId"]
+        sent = {}
+        for i in range(12):
+            key, val = f"sensor-{i}", f"reading-{i}"
+            r = http_json("POST", f"{agent.url}/agent/publish", {
+                "sessionId": pub,
+                "key": base64.b64encode(key.encode()).decode(),
+                "value": base64.b64encode(val.encode()).decode()})
+            assert "tsNs" in r, r
+            sent[key] = val
+
+        r = http_json("POST",
+                      f"{agent.url}/agent/sessions/subscribe",
+                      {"namespace": "iot", "topic": "metrics"})
+        sid = r["sessionId"]
+        assert r["partitions"] == 2
+        got = {}
+        deadline = time.time() + 10
+        while len(got) < 12 and time.time() < deadline:
+            r = http_json("GET", f"{agent.url}/agent/subscribe"
+                          f"?sessionId={sid}&maxRecords=50&waitSec=1")
+            per_part = {}
+            for rec in r["records"]:
+                k = base64.b64decode(rec["key"]).decode()
+                v = base64.b64decode(rec["value"]).decode()
+                got[k] = v
+                per_part[rec["partition"]] = max(
+                    per_part.get(rec["partition"], 0), rec["tsNs"])
+            for p, ts in per_part.items():
+                http_json("POST", f"{agent.url}/agent/ack",
+                          {"sessionId": sid, "partition": p,
+                           "tsNs": ts})
+        assert got == sent
+
+        # everything acked: an immediate re-poll returns nothing
+        r = http_json("GET", f"{agent.url}/agent/subscribe"
+                      f"?sessionId={sid}&maxRecords=50")
+        assert r["records"] == []
+
+        http_json("POST", f"{agent.url}/agent/sessions/close",
+                  {"sessionId": sid})
+        r = http_json("GET", f"{agent.url}/agent/subscribe"
+                      f"?sessionId={sid}")
+        assert "error" in r
+    finally:
+        agent.stop()
+        broker.stop()
+        filer.stop()
+        vs.stop()
+        master.stop()

@@ -246,3 +246,28 @@ def test_volume_grow_and_collections(cluster):
     out = run_command(env, "collection.list")
     assert "photos" not in out
     run_command(env, "unlock")
+
+
+def test_fs_mv_tree_and_s3_bucket_commands(cluster):
+    master, servers, filer, env = cluster
+    filer.filer.write_file("/proj/a.txt", b"one")
+    filer.filer.write_file("/proj/sub/b.txt", b"two")
+
+    out = run_command(env, "fs.mv /proj/a.txt /proj/renamed.txt")
+    assert "moved" in out
+    assert run_command(env, "fs.cat /proj/renamed.txt") == "one"
+
+    tree = run_command(env, "fs.tree /proj")
+    assert "renamed.txt" in tree and "sub/" in tree
+    assert "b.txt" in tree
+    assert "1 directories, 2 files" in tree
+
+    out = run_command(env, "s3.bucket.create -name=shellbkt")
+    assert "created" in out
+    assert "shellbkt" in run_command(env, "s3.bucket.list")
+    filer.filer.write_file("/buckets/shellbkt/x.txt", b"obj")
+    with pytest.raises(RuntimeError):
+        run_command(env, "s3.bucket.delete -name=shellbkt")
+    out = run_command(env, "s3.bucket.delete -name=shellbkt -force")
+    assert "deleted" in out
+    assert "shellbkt" not in run_command(env, "s3.bucket.list")
