@@ -127,6 +127,12 @@ def main(argv: list[str] | None = None) -> int:
                      help="remote AWS-KMS-protocol endpoint "
                           "host:port[,accessKey,secretKey[,region]] "
                           "(kms/aws analog); overrides -kmsFile")
+    s3p.add_argument("-kmsCloud", dest="kms_cloud", default="",
+                     help="cloud KMS spec (kms/gcp|azure|openbao): "
+                          "gcp:endpoint,keyName,token | "
+                          "azure:vaultUrl,keyName,token | "
+                          "openbao:addr,keyName,token; overrides "
+                          "-kmsEndpoint/-kmsFile")
 
     iamp = sub.add_parser(
         "iam", help="IAM management API + STS AssumeRole "
@@ -429,7 +435,21 @@ def main(argv: list[str] | None = None) -> int:
             from .iam.sts import RoleStore
             sts = StsService(args.sts_key,
                              RoleStore(args.roles_file or None))
-        if args.kms_endpoint:
+        if args.kms_cloud:
+            from .iam import kms_cloud
+            kind, _, rest = args.kms_cloud.partition(":")
+            parts = rest.split(",")
+            ctor = {"gcp": kms_cloud.GcpKms,
+                    "azure": kms_cloud.AzureKms,
+                    "openbao": kms_cloud.OpenBaoKms}.get(kind)
+            if ctor is None:
+                print(f"unknown -kmsCloud provider {kind!r}",
+                      file=sys.stderr)
+                return 2
+            kms = ctor(parts[0],
+                       parts[1] if len(parts) > 1 else "",
+                       token=parts[2] if len(parts) > 2 else "")
+        elif args.kms_endpoint:
             from .iam.kms_aws import AwsKms
             parts = args.kms_endpoint.split(",")
             kms = AwsKms(parts[0],
@@ -613,8 +633,9 @@ def main(argv: list[str] | None = None) -> int:
         if args.ldap_server:
             from .iam.ldap import LdapProvider
             host, _, port = args.ldap_server.partition(":")
+            default_port = 636 if args.ldap_tls else 389
             ldap = LdapProvider(
-                host, int(port or 389),
+                host, int(port or default_port),
                 base_dn=args.ldap_base_dn,
                 user_dn_template=args.ldap_dn_template,
                 bind_dn=args.ldap_bind_dn,

@@ -82,6 +82,10 @@ class BerReader:
         return tag, body
 
 
+MAX_MESSAGE = 1 << 20  # a bind/search reply is tiny; a peer claiming
+# multi-MB (or GB) frames is hostile or not LDAP at all
+
+
 def read_message(sock_file) -> "tuple[int, int, bytes]":
     """One LDAPMessage: returns (message_id, op_tag, op_body)."""
     head = sock_file.read(2)
@@ -93,8 +97,13 @@ def read_message(sock_file) -> "tuple[int, int, bytes]":
         prefix = b""
     else:
         k = first & 0x7F
+        if k > 4:
+            raise LdapError("ldap: absurd length-of-length")
         prefix = sock_file.read(k)
         total = int.from_bytes(prefix, "big")
+    if total > MAX_MESSAGE:
+        raise LdapError(f"ldap: message claims {total} bytes "
+                        f"(cap {MAX_MESSAGE})")
     body = sock_file.read(total)
     if len(body) < total:
         raise OSError("ldap: short message")

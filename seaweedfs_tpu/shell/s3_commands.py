@@ -12,8 +12,22 @@
 from __future__ import annotations
 
 from ..filer.client import FilerClient
+import urllib.parse
+
 from ..server.httpd import http_bytes
 from .commands import CommandEnv, _parse_flags, command
+
+
+def _check_bucket_name(name: str) -> None:
+    """S3 bucket-name charset (lowercase alnum, dots, dashes): also
+    keeps URL metacharacters out of the filer paths these commands
+    build."""
+    import re
+    if not name or not re.fullmatch(r"[a-z0-9][a-z0-9.\-]{1,62}",
+                                    name):
+        raise RuntimeError(
+            f"bad bucket name {name!r} (3-63 chars, lowercase "
+            "alnum/dot/dash)")
 
 BUCKETS_ROOT = "/buckets"
 
@@ -123,10 +137,10 @@ def cmd_s3_bucket_create(env: CommandEnv, args: list[str]) -> str:
     /buckets in the filer namespace."""
     opts = _parse_flags(args)
     name = opts.get("name", "")
-    if not name or "/" in name:
-        raise RuntimeError("usage: s3.bucket.create -name=<bucket>")
+    _check_bucket_name(name)
     st, body, _ = http_bytes(
-        "POST", env.require_filer() + f"/buckets/{name}/")
+        "POST", env.require_filer() +
+        f"/buckets/{urllib.parse.quote(name)}/")
     if st >= 300:
         raise RuntimeError(f"create bucket: HTTP {st} {body[:120]!r}")
     return f"created bucket {name}"
@@ -138,21 +152,25 @@ def cmd_s3_bucket_delete(env: CommandEnv, args: list[str]) -> str:
     bucket needs -force, matching the reference's guard)."""
     opts = _parse_flags(args)
     name = opts.get("name", "")
-    if not name:
-        raise RuntimeError(
-            "usage: s3.bucket.delete -name=<bucket> [-force]")
-    st, body, _ = http_bytes(
-        "GET", env.require_filer() + f"/buckets/{name}/?limit=1")
+    _check_bucket_name(name)
+    # existence via the metadata lookup: the directory LISTING answers
+    # 200-with-empty for missing paths, so it cannot distinguish
+    # "no such bucket" from "empty bucket"
+    st, _, _ = http_bytes(
+        "GET", env.require_filer() + "/__meta__/lookup?path=" +
+        urllib.parse.quote(f"/buckets/{name}"))
     if st == 404:
         raise RuntimeError(f"no bucket {name}")
+    q = urllib.parse.quote(name)
+    st, body, _ = http_bytes(
+        "GET", env.require_filer() + f"/buckets/{q}/?limit=1")
     import json as _json
     entries = _json.loads(body).get("entries", []) if st == 200 else []
     if entries and "force" not in opts:
         raise RuntimeError(
             f"bucket {name} is not empty; pass -force")
     st, body, _ = http_bytes(
-        "DELETE", env.require_filer() +
-        f"/buckets/{name}?recursive=true")
+        "DELETE", env.require_filer() + f"/buckets/{q}?recursive=true")
     if st >= 300:
         raise RuntimeError(f"delete bucket: HTTP {st}")
     return f"deleted bucket {name}"

@@ -617,3 +617,60 @@ def test_s3_gateway_over_aws_kms_shim(tmp_path):
         vs.stop()
         master.stop()
         stub.stop()
+
+
+@pytest.mark.parametrize("provider_cls,server_cls,kwargs", [
+    ("GcpKms", "FakeGcpKmsServer",
+     {"key_name": "projects/p/locations/l/keyRings/r/cryptoKeys/k"}),
+    ("AzureKms", "FakeAzureKeyVaultServer", {"key_name": "mykey"}),
+    ("OpenBaoKms", "FakeOpenBaoServer", {"key_name": "transit-key"}),
+])
+def test_cloud_kms_providers_envelope_roundtrip(provider_cls,
+                                                server_cls, kwargs):
+    """GCP / Azure Key Vault / OpenBao transit providers (weed/kms/
+    gcp|azure|openbao): data-key envelope round-trips over each wire
+    protocol against a wire-faithful fake; bad tokens and corrupt
+    blobs surface as KmsError."""
+    from seaweedfs_tpu.iam import kms_cloud
+    from seaweedfs_tpu.iam.kms import KmsError
+
+    server = getattr(kms_cloud, server_cls)().start()
+    try:
+        ctor = getattr(kms_cloud, provider_cls)
+        kms = ctor(server.url, kwargs["key_name"],
+                   token=server.token)
+        dk = kms.generate_data_key("", context={"arn": "a/b"})
+        assert len(dk["Plaintext"]) == 32
+        out = kms.decrypt(dk["CiphertextBlob"],
+                          context={"arn": "a/b"})
+        assert out["Plaintext"] == dk["Plaintext"]
+
+        with pytest.raises(KmsError):
+            kms.decrypt("bm90LWpzb24=")  # not a valid blob
+        bad = ctor(server.url, kwargs["key_name"], token="wrong")
+        with pytest.raises(KmsError):
+            bad.generate_data_key("")
+    finally:
+        server.stop()
+
+
+def test_cloud_kms_drives_s3_sse(tmp_path):
+    """An S3 gateway using the OpenBao transit provider end-to-end:
+    objects envelope-encrypt at rest and decrypt on read."""
+    from seaweedfs_tpu.filer.filer import Filer
+    from seaweedfs_tpu.iam.kms_cloud import (FakeOpenBaoServer,
+                                             OpenBaoKms)
+    from seaweedfs_tpu.s3.sse import kms_decrypt, kms_encrypt
+
+    server = FakeOpenBaoServer().start()
+    try:
+        kms = OpenBaoKms(server.url, "transit-key",
+                         token=server.token)
+        body, ext = kms_encrypt(kms, "aws:kms", "transit-key",
+                                "arn:aws:s3:::b/k", b"cloud secret")
+        assert body != b"cloud secret"
+        assert ext.get("sseKmsBlob")
+        out = kms_decrypt(kms, ext, "arn:aws:s3:::b/k", body)
+        assert out == b"cloud secret"
+    finally:
+        server.stop()
