@@ -106,6 +106,7 @@ class BrokerServer:
         r("POST", "/topics/schema", self._schema_register)
         r("GET", "/topics/schema", self._schema_get)
         r("POST", "/topics/compact", self._compact)
+        r("POST", "/topics/repartition", self._repartition)
         # topic -> (revision, recordType) cache for publish validation
         self._schema_cache: dict = {}
         self._schema_cache_ts: dict = {}
@@ -312,6 +313,102 @@ class BrokerServer:
         _check_name("namespace", ns)
         _check_name("topic", name)
         return Topic(ns, name)
+
+    # -- repartition (partition split/merge) ---------------------------
+
+    def _repartition(self, req: Request):
+        """Change a topic's partition count (the reference's partition
+        split/merge role, topic.go SplitPartitions + balancer
+        reconciliation), preserving every stored message and its
+        order: all existing messages are merged chronologically, re-
+        hashed by key onto the new ring, and appended with their
+        original stamps; old partition dirs are deleted after the new
+        conf is live.  Runs under the CLUSTER lock; publishes racing
+        the swap land on the old layout and are migrated too (the
+        merge re-reads after ownership of every partition is claimed
+        by this broker through the conf)."""
+        import base64 as _b64
+
+        from ..cluster import ClusterLock
+        b = req.json()
+        try:
+            t = self._topic_from(b["namespace"], b["topic"])
+        except NameError_ as e:
+            return 400, {"error": str(e)}
+        new_n = int(b["partitionCount"])
+        if not 0 < new_n <= 4096:
+            return 400, {"error": f"bad partition count {new_n}"}
+        try:
+            lock = ClusterLock(
+                self.filer, f"mq-repartition:{self._conf_path(t)}",
+                owner=self.url, ttl_sec=30.0).acquire(timeout=10.0)
+        except (TimeoutError, OSError) as e:
+            return 503, {"error": f"repartition lock: {e}"}
+        try:
+            with self._conf_lock:
+                try:
+                    old_parts = self._load_layout(t, fresh=True)
+                except RuntimeError as e:
+                    return 503, {"error": str(e)}
+                if old_parts is None:
+                    return 404, {"error": f"topic {t} not configured"}
+                if len(old_parts) == new_n:
+                    return 200, {"partitions":
+                                 [p.to_json() for p in old_parts],
+                                 "migrated": 0}
+                # 1. claim every partition: a conf naming this broker
+                # as sole owner makes peers redirect here, so no
+                # writes land on logs we're about to drain
+                err = self._persist_layout(
+                    t, old_parts, [self.url] * len(old_parts))
+                if err:
+                    return 503, {"error": err}
+                # 2. drain: flush hot tails, then merge every stored
+                # message chronologically
+                msgs: list = []
+                for p in old_parts:
+                    log = self._log_for(t, p)
+                    log.flush()
+                    msgs.extend(log.read_since(0))
+                msgs.sort(key=lambda r: r.get("tsNs", 0))
+                # 3. new layout + re-hash with original stamps (the
+                # per-partition monotonic clock bumps exact ties)
+                new_parts = split_ring(new_n)
+                new_logs = {}
+                with self._lock:
+                    # forget old log objects so fresh dirs are used
+                    for p in old_parts:
+                        self._logs.pop((t, p), None)
+                migrated = 0
+                for rec in msgs:
+                    key = _b64.b64decode(rec.get("key", "") or "")
+                    p = partition_for_key(key, new_parts)
+                    if p not in new_logs:
+                        new_logs[p] = PartitionLog(self.filer, t, p)
+                    new_logs[p].append(rec.get("key", ""),
+                                       rec.get("value", ""),
+                                       int(rec.get("tsNs", 0)))
+                    migrated += 1
+                for log in new_logs.values():
+                    log.flush()
+                # 4. publish the new conf, then delete old dirs
+                err = self._persist_layout(
+                    t, new_parts, [self.url] * new_n)
+                if err:
+                    return 503, {"error": err}
+                old_dirs = {str(p) for p in old_parts} - \
+                    {str(p) for p in new_parts}
+                for d in old_dirs:
+                    http_bytes(
+                        "DELETE",
+                        f"{self.filer}"
+                        f"{urllib.parse.quote(t.dir + '/' + d)}"
+                        f"?recursive=true")
+            return 200, {"partitions":
+                         [p.to_json() for p in new_parts],
+                         "migrated": migrated}
+        finally:
+            lock.release()
 
     # -- schema plane (weed/mq/schema; broker_grpc_pub.go gating) ------
 

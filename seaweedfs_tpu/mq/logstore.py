@@ -240,9 +240,14 @@ class PartitionLog:
         hwm = self._persisted_hwm()
         with self._lock:
             # cache: an idle partition polled after a restart must not
-            # re-list + re-download the newest segment on every poll
+            # re-list + re-download the newest segment on every poll.
+            # Seed BOTH stamps, exactly like append()'s first-use path:
+            # _last_ts without _last_flushed_ts would make read_since's
+            # buffer-only short-circuit (ts_ns >= _last_flushed_ts)
+            # skip ALL persisted history on the next read.
             if self._last_ts == 0:
                 self._last_ts = hwm
+                self._last_flushed_ts = hwm
         return hwm
 
     def _persisted_hwm(self) -> int:
