@@ -25,6 +25,42 @@ import time
 import uuid
 
 
+def normalize_address(addr: str) -> str:
+    """Canonicalize a ring-member address (ADVICE r4): membership is
+    compared by string, so `localhost:8888` vs `127.0.0.1:8888` spelled
+    differently across -lockPeers lists would make the owning filer
+    fail its own `target == self` check and bounce every acquire
+    through movedTo redirects until the client times out.
+
+    Deliberately NO DNS here: resolution is per-host state (a resolver
+    blip or split-horizon DNS on one filer would silently diverge the
+    member lists and break lock mutual exclusion — worse than the
+    redirect loop this fixes).  Only deterministic rewrites: lowercase,
+    strip scheme / trailing slash, and the loopback aliases every host
+    agrees on."""
+    a = addr.strip().lower()
+    if "://" in a:
+        a = a.split("://", 1)[1]
+    a = a.rstrip("/")
+    if a.startswith("["):             # [v6]:port or bare [v6]
+        host, _, rest = a.partition("]")
+        host, port = host[1:], rest.lstrip(":")
+    elif a.count(":") > 1:            # bare IPv6, no port
+        host, port = a, ""
+    else:
+        host, _, port = a.rpartition(":")
+        if not host:                  # bare hostname/IPv4, no port
+            host, port = a, ""
+    # only the NAME alias collapses; ::1 stays a v6 address — mapping
+    # it to 127.0.0.1 would advertise a dial target a socket bound
+    # only to v6 loopback does not accept
+    if host in ("localhost", "ip4-localhost"):
+        host = "127.0.0.1"
+    elif ":" in host:                 # keep v6 hosts bracketed so the
+        host = f"[{host}]"            # port separator stays parseable
+    return f"{host}:{port}" if port else host
+
+
 class LockManager:
     """Server-side lock table (one per filer)."""
 

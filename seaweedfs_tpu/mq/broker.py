@@ -56,6 +56,72 @@ class NameError_(ValueError):
     pass
 
 
+class _RWLock:
+    """Small writer-preferring read/write lock for the per-topic conf
+    fence (review r5): appends take the read side so different
+    partitions of one topic append concurrently; conf mutations
+    (configure / takeover / repartition claim+drain) and the flush
+    broadcast take the write side, which waits out in-flight admitted
+    appends — the property the write-loss fence needs — without
+    serializing the whole hot path on one mutex."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._waiting_writers = 0
+
+    class _Side:
+        def __init__(self, lock, write):
+            self._lock, self._write = lock, write
+
+        def __enter__(self):
+            acq = self._lock._acquire_write if self._write \
+                else self._lock._acquire_read
+            acq()
+            return self
+
+        def __exit__(self, *exc):
+            rel = self._lock._release_write if self._write \
+                else self._lock._release_read
+            rel()
+
+    def read(self) -> "_RWLock._Side":
+        return _RWLock._Side(self, write=False)
+
+    def write(self) -> "_RWLock._Side":
+        return _RWLock._Side(self, write=True)
+
+    def _acquire_read(self):
+        with self._cond:
+            # writer preference: new readers queue behind a waiting
+            # writer so a drain can't be starved by a publish stream
+            while self._writer or self._waiting_writers:
+                self._cond.wait()
+            self._readers += 1
+
+    def _release_read(self):
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def _acquire_write(self):
+        with self._cond:
+            self._waiting_writers += 1
+            try:
+                while self._writer or self._readers:
+                    self._cond.wait()
+                self._writer = True
+            finally:
+                self._waiting_writers -= 1
+
+    def _release_write(self):
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+
 def _check_name(kind: str, name: str) -> None:
     """Topic/namespace/group names become filer path segments: a '/'
     would add path levels, a leading '.' collides with reserved dirs
@@ -80,11 +146,17 @@ class BrokerServer:
         self._live_cache: tuple[float, list[str]] = (0.0, [])
         self._logs: dict[tuple[Topic, Partition], PartitionLog] = {}
         self._lock = threading.Lock()
-        # serializes configure's load-check-persist-cache sequence
-        # (check-then-act on topic.conf must be atomic or concurrent
-        # configures can leave the filer and the cache disagreeing on
-        # the partition layout)
-        self._conf_lock = threading.Lock()
+        # per-topic conf locks serialize each topic's load-check-
+        # persist-cache sequences (configure/repartition/takeover)
+        # against each other AND against fenced appends + flushes of
+        # that topic — per-topic so one topic's long repartition drain
+        # never stalls publishes to unrelated topics (review r5).
+        # Guarded by self._lock.
+        self._topic_conf_locks: dict[Topic, _RWLock] = {}
+        # topics this broker is actively repartitioning: publishes to
+        # them answer 503-retry so the drain below is authoritative
+        # (guarded by self._lock)
+        self._repartitioning: set[Topic] = set()
         # periodic flush bounds the acked-but-unflushed window to
         # ~flush_interval on a crash (the reference's log_buffer also
         # flushes on a timer, util/log_buffer)
@@ -129,6 +201,26 @@ class BrokerServer:
         except OSError:
             pass  # next tick
 
+    def _registry_entries(self) -> list[dict]:
+        """Raw broker-registry listing.  Fails CLOSED: an unreadable
+        registry must not read as "every peer is dead" — that would
+        green-light takeovers of healthy brokers' partitions."""
+        try:
+            st, body, _ = http_bytes(
+                "GET", f"{self.filer}{BROKERS_DIR}/?limit=1000")
+        except OSError as e:
+            raise RuntimeError(f"broker registry unreachable: {e}")
+        if st == 404:
+            return []               # registry dir not created yet
+        if st != 200:
+            raise RuntimeError(f"broker registry: {st}")
+        try:
+            entries = json.loads(body).get("entries", [])
+        except ValueError as e:
+            raise RuntimeError(f"broker registry undecodable: {e}")
+        return [e for e in entries
+                if not e.get("isDirectory") and "fullPath" in e]
+
     def _live_brokers(self) -> list[str]:
         """Registry entries with fresh heartbeats, briefly cached
         (publish-path takeover checks must not hammer the filer)."""
@@ -136,36 +228,24 @@ class BrokerServer:
         ts, cached = self._live_cache
         if now - ts < 1.0:
             return cached
-        try:
-            st, body, _ = http_bytes(
-                "GET", f"{self.filer}{BROKERS_DIR}/?limit=1000")
-        except OSError as e:
-            raise RuntimeError(f"broker registry unreachable: {e}")
-        if st == 404:
-            entries = []        # registry dir not created yet
-        elif st != 200:
-            # fail CLOSED: an unreadable registry must not read as
-            # "every peer is dead" — that would green-light takeovers
-            # of healthy brokers' partitions
-            raise RuntimeError(f"broker registry: {st}")
-        else:
-            try:
-                entries = json.loads(body).get("entries", [])
-            except ValueError as e:
-                raise RuntimeError(f"broker registry undecodable: {e}")
         live = []
         cutoff = time.time() - self.BROKER_TTL
-        for e in entries:
-            if e.get("isDirectory") or "fullPath" not in e:
-                continue
-            addr = e["fullPath"].rsplit("/", 1)[-1]
+        for e in self._registry_entries():
             if e.get("attributes", {}).get("mtime", 0) >= cutoff:
-                live.append(addr)
+                live.append(e["fullPath"].rsplit("/", 1)[-1])
         if self.url not in live:
             live.append(self.url)   # we are definitionally alive
         live.sort()
         self._live_cache = (now, live)
         return live
+
+    def _registered_brokers(self) -> list[str]:
+        """EVERY registry entry, liveness-filter skipped — the
+        repartition flush broadcast must also reach a peer whose
+        heartbeat merely lapsed (alive-but-deregistered peers still
+        hold conf caches and tails)."""
+        return sorted(e["fullPath"].rsplit("/", 1)[-1]
+                      for e in self._registry_entries())
 
     def stop(self) -> None:
         # stop accepting requests FIRST: a publish acked after the
@@ -205,6 +285,15 @@ class BrokerServer:
 
     def _conf_path(self, t: Topic) -> str:
         return f"{t.dir}/topic.conf"
+
+    def _topic_lock(self, t: Topic) -> "_RWLock":
+        """The topic's conf read/write lock (created on first use):
+        appends read-side, conf mutations + flush write-side."""
+        with self._lock:
+            lk = self._topic_conf_locks.get(t)
+            if lk is None:
+                lk = self._topic_conf_locks[t] = _RWLock()
+            return lk
 
     # how long a cached topic.conf (and its ownership column) stays
     # authoritative; peers\' takeovers become visible within this —
@@ -281,12 +370,12 @@ class BrokerServer:
         from ..cluster import ClusterLock
         try:
             takeover_lock = ClusterLock(
-                self.filer, f"mq-takeover:{self._conf_path(t)}",
+                self.filer, f"mq-conf:{self._conf_path(t)}",
                 owner=self.url, ttl_sec=10.0).acquire(timeout=5.0)
         except (TimeoutError, OSError) as e:
             return 503, {"error": f"takeover lock: {e}"}
         try:
-            with self._conf_lock:
+            with self._topic_lock(t).write():
                 try:
                     self._load_layout(t, fresh=True)
                 except RuntimeError as e:
@@ -323,10 +412,19 @@ class BrokerServer:
         order: all existing messages are merged chronologically, re-
         hashed by key onto the new ring, and appended with their
         original stamps; old partition dirs are deleted after the new
-        conf is live.  Runs under the CLUSTER lock; publishes racing
-        the swap land on the old layout and are migrated too (the
-        merge re-reads after ownership of every partition is claimed
-        by this broker through the conf)."""
+        conf is live.  Runs under the CLUSTER lock.
+
+        Write-loss fencing (ADVICE r4 + review): (a) this broker
+        refuses publishes to the topic for the duration (503-retry),
+        (b) after claiming ownership we wait out CONF_TTL so every
+        peer's layout cache expires and its next publish redirects
+        here, (c) a flush broadcast then pushes anything peers acked
+        into filer segments before the drain, and (d) publish paths
+        re-gate at append time when their layout cache aged out, so a
+        peer stalled in validation past the window redirects instead
+        of appending to a log we already drained.  The conf-plane
+        lock is NOT held across the sleep/broadcast — only the two
+        short conf mutations take it."""
         import base64 as _b64
 
         from ..cluster import ClusterLock
@@ -340,12 +438,32 @@ class BrokerServer:
             return 400, {"error": f"bad partition count {new_n}"}
         try:
             lock = ClusterLock(
-                self.filer, f"mq-repartition:{self._conf_path(t)}",
+                self.filer, f"mq-conf:{self._conf_path(t)}",
                 owner=self.url, ttl_sec=30.0).acquire(timeout=10.0)
         except (TimeoutError, OSError) as e:
             return 503, {"error": f"repartition lock: {e}"}
+        with self._lock:
+            self._repartitioning.add(t)
+        old_owners = None
+        claimed = False
+
+        def _rollback_claim():
+            """An abort after step 1's claim must restore the previous
+            owner column (review r5): leaving this broker as persisted
+            sole owner of every partition would silently funnel the
+            topic's whole load here after a FAILED operation."""
+            if not (claimed and old_owners):
+                return ""
+            with self._topic_lock(t).write():
+                err = self._persist_layout(t, old_parts, old_owners)
+            return f"; owner rollback failed: {err}" if err \
+                else "; owners rolled back"
+
         try:
-            with self._conf_lock:
+            # 1. claim every partition: a conf naming this broker as
+            # sole owner makes peers redirect here, so no new writes
+            # land on logs we're about to drain
+            with self._topic_lock(t).write():
                 try:
                     old_parts = self._load_layout(t, fresh=True)
                 except RuntimeError as e:
@@ -356,13 +474,61 @@ class BrokerServer:
                     return 200, {"partitions":
                                  [p.to_json() for p in old_parts],
                                  "migrated": 0}
-                # 1. claim every partition: a conf naming this broker
-                # as sole owner makes peers redirect here, so no
-                # writes land on logs we're about to drain
+                with self._lock:
+                    old_owners = list(self._owners.get(t) or
+                                      [self.url] * len(old_parts))
                 err = self._persist_layout(
                     t, old_parts, [self.url] * len(old_parts))
                 if err:
                     return 503, {"error": err}
+                claimed = True
+            # 1.5 wait out peer layout caches, then flush peer tails:
+            # a peer with a <=CONF_TTL-stale conf still passes its own
+            # owner gate and keeps appending to the old partition logs
+            # after our claim; once CONF_TTL elapses every peer
+            # re-reads the conf and redirects here.  The flush
+            # broadcast then pushes whatever landed in peers'
+            # in-memory tails during the window into filer segments,
+            # so the drain below migrates those acknowledged messages
+            # instead of deleting them with the old dirs in step 4.
+            # The broadcast goes to EVERY registered broker — a peer
+            # whose heartbeat lapsed may still be alive with a fresh
+            # conf cache; only a peer that is both unreachable AND
+            # outside the live set is treated as crashed (its
+            # unflushed tail is lost under the module's documented
+            # crash semantics).
+            try:
+                live = set(self._live_brokers())
+                registered = set(self._registered_brokers())
+            except RuntimeError as e:
+                return 503, {"error": f"broker registry: {e}"
+                             + _rollback_claim()}
+            peers = sorted((registered | live) - {self.url})
+            if peers:
+                time.sleep(self.CONF_TTL + 0.1)
+            for peer in peers:
+                # bare address: http_bytes' dial funnel applies the
+                # configured scheme (TLS plane) — hardcoding http://
+                # would silently skip TLS-only peers
+                try:
+                    st_f, body_f, _ = http_bytes(
+                        "POST", f"{peer}/topics/flush",
+                        json.dumps({"namespace": t.namespace,
+                                    "topic": t.name}).encode())
+                except OSError as e:
+                    st_f, body_f = 0, str(e).encode()
+                if st_f != 200 and peer in live:
+                    # a LIVE peer whose tail we cannot confirm flushed
+                    # may hold acked messages step 4 would delete —
+                    # abort (restoring the previous owners); the
+                    # operator retries once the peer flushes or drops
+                    # from the registry
+                    return 503, {
+                        "error": f"peer {peer} flush unconfirmed "
+                                 f"({st_f}): "
+                                 f"{body_f[:200].decode(errors='replace')}"
+                                 + _rollback_claim()}
+            with self._topic_lock(t).write():
                 # 2. drain: flush hot tails, then merge every stored
                 # message chronologically
                 msgs: list = []
@@ -408,6 +574,8 @@ class BrokerServer:
                          [p.to_json() for p in new_parts],
                          "migrated": migrated}
         finally:
+            with self._lock:
+                self._repartitioning.discard(t)
             lock.release()
 
     # -- schema plane (weed/mq/schema; broker_grpc_pub.go gating) ------
@@ -540,7 +708,7 @@ class BrokerServer:
         except NameError_ as e:
             return 400, {"error": str(e)}
         n = int(b.get("partitionCount", 4))
-        with self._conf_lock:
+        with self._topic_lock(t).write():
             try:
                 existing = self._load_layout(t)
             except RuntimeError as e:
@@ -616,39 +784,114 @@ class BrokerServer:
 
     # -- pub/sub ----------------------------------------------------------
 
-    def _publish(self, req: Request):
-        b = req.json()
+    # sentinel: the append fence found the gate decision outdated —
+    # the caller must reload and re-gate before appending
+    _STALE = object()
+
+    def _fenced_append(self, t: Topic, parts: "list[Partition]",
+                       idx: int, fn):
+        """Final pre-append fence (round-5 review): the append runs
+        under the topic's conf lock so it serializes against a local
+        repartition's drain and the repartition flush broadcast;
+        answers 503-retry while this broker is repartitioning t; and
+        returns _STALE unless the CURRENT cached conf is fresh, IS the
+        layout the caller gated on (a gate decision from the
+        pre-repartition layout must not append into an old-range dir
+        the drain already deleted), and still names this broker owner
+        of partition idx — checking layout+ownership (not just a
+        timestamp another thread's reload may have reset) means a
+        stale gate decision can never append to a drained log.
+        Returns fn()'s result or a (status, body) error."""
+        # fast-path 503 BEFORE the topic lock: during a local
+        # repartition the lock is held for the whole drain, and
+        # blocking every publisher on it would pin the HTTP worker
+        # pool instead of failing fast for a client retry
+        with self._lock:
+            if t in self._repartitioning:
+                return 503, {"error": "repartition in progress; retry"}
+        with self._topic_lock(t).read():
+            with self._lock:
+                if t in self._repartitioning:
+                    return 503, {"error":
+                                 "repartition in progress; retry"}
+                owners = self._owners.get(t)
+                current = self._topics.get(t)
+                fresh = time.monotonic() - \
+                    self._conf_loaded.get(t, 0) < self.CONF_TTL
+            if not fresh or current != parts or owners is None \
+                    or idx >= len(owners) or owners[idx] != self.url:
+                return BrokerServer._STALE
+            return fn()
+
+    def _publish_guarded(self, b: dict, pick_idx, make_append):
+        """Shared publish scaffold (review r5: the fence protocol
+        lives in ONE place).  Two passes of load → gate → validate →
+        fenced append: when the fence reports the gate decision
+        outdated (slow schema fetch, concurrent repartition), re-gate
+        on a fresh conf instead of appending to a drained log.
+        pick_idx(parts) returns a partition index or a (status, body)
+        error; make_append(parts, idx) validates and returns a thunk
+        or a (status, body) error."""
         try:
             t = self._topic_from(b["namespace"], b["topic"])
-            parts = self._load_layout(t)
         except NameError_ as e:
             return 400, {"error": str(e)}
-        except RuntimeError as e:
-            return 503, {"error": str(e)}
-        if parts is None:
-            return 404, {"error": f"topic {t} not configured"}
-        if "partition" in b and b["partition"] is not None:
-            # explicit partition index (the Kafka gateway's client
-            # already partitioned; re-hashing would misroute)
-            idx = int(b["partition"])
-            if not 0 <= idx < len(parts):
-                return 400, {"error": f"partition index {idx} out of "
-                                      f"range 0..{len(parts) - 1}"}
-            p = parts[idx]
-        else:
-            key = base64.b64decode(b.get("key", "")) if b.get("key") \
-                else b""
-            p = partition_for_key(key, parts)
-        redirect = self._owner_gate(t, parts, parts.index(p))
-        if redirect is not None:
-            return redirect
-        err = self._validate_against_schema(t, b.get("value", ""))
-        if err:
-            return 400, {"error": err}
-        ts = self._log_for(t, p).append(
-            b.get("key", ""), b.get("value", ""),
-            int(b.get("tsNs", 0)))
-        return 200, {"partition": p.to_json(), "tsNs": ts}
+        for _attempt in range(2):
+            try:
+                parts = self._load_layout(t)
+            except RuntimeError as e:
+                return 503, {"error": str(e)}
+            if parts is None:
+                return 404, {"error": f"topic {t} not configured"}
+            idx = pick_idx(parts)
+            if isinstance(idx, tuple):
+                return idx
+            redirect = self._owner_gate(t, parts, idx)
+            if redirect is not None:
+                return redirect
+            thunk = make_append(t, parts, idx)
+            if isinstance(thunk, tuple):
+                return thunk
+            res = self._fenced_append(t, parts, idx, thunk)
+            if res is BrokerServer._STALE:
+                continue
+            if isinstance(res, tuple):
+                return res
+            return 200, {"partition": parts[idx].to_json(),
+                         "tsNs": res}
+        return 503, {"error": "topic layout changing; retry"}
+
+    @staticmethod
+    def _index_picker(b: dict):
+        """Partition selection for a publish body: explicit index (the
+        Kafka gateway's client already partitioned; re-hashing would
+        misroute) or key hash."""
+        def pick(parts):
+            if "partition" in b and b["partition"] is not None:
+                idx = int(b["partition"])
+                if not 0 <= idx < len(parts):
+                    return (400, {"error":
+                                  f"partition index {idx} out of "
+                                  f"range 0..{len(parts) - 1}"})
+                return idx
+            key = base64.b64decode(b.get("key", "")) \
+                if b.get("key") else b""
+            return parts.index(partition_for_key(key, parts))
+        return pick
+
+    def _publish(self, req: Request):
+        b = req.json()
+
+        def make_append(t, parts, idx):
+            err = self._validate_against_schema(t, b.get("value", ""))
+            if err:
+                return 400, {"error": err}
+            return lambda: self._log_for(t, parts[idx]).append(
+                b.get("key", ""), b.get("value", ""),
+                int(b.get("tsNs", 0)))
+
+        return self._publish_guarded(b, self._index_picker(b),
+                                     make_append)
 
     def _publish_batch(self, req: Request):
         """Atomic multi-message publish to one explicit partition —
@@ -656,32 +899,26 @@ class BrokerServer:
         (broker.proto PublishMessage streams get this from the
         single-writer partition loop)."""
         b = req.json()
-        try:
-            t = self._topic_from(b["namespace"], b["topic"])
-            parts = self._load_layout(t)
-        except NameError_ as e:
-            return 400, {"error": str(e)}
-        except RuntimeError as e:
-            return 503, {"error": str(e)}
-        if parts is None:
-            return 404, {"error": f"topic {t} not configured"}
-        idx = int(b["partition"])
-        if not 0 <= idx < len(parts):
-            return 400, {"error": f"partition index {idx} out of "
-                                  f"range 0..{len(parts) - 1}"}
-        redirect = self._owner_gate(t, parts, idx)
-        if redirect is not None:
-            return redirect
-        records = [(m.get("key", ""), m.get("value", ""),
-                    int(m.get("tsNs", 0)))
-                   for m in b.get("messages", [])]
-        for _k, v, _ts in records:  # atomic: reject the whole batch
-            err = self._validate_against_schema(t, v)
-            if err:
-                return 400, {"error": err}
-        stamps = self._log_for(t, parts[idx]).append_many(records)
-        return 200, {"partition": parts[idx].to_json(),
-                     "tsNs": stamps}
+
+        def pick(parts):
+            idx = int(b["partition"])
+            if not 0 <= idx < len(parts):
+                return (400, {"error": f"partition index {idx} out of "
+                                       f"range 0..{len(parts) - 1}"})
+            return idx
+
+        def make_append(t, parts, idx):
+            records = [(m.get("key", ""), m.get("value", ""),
+                        int(m.get("tsNs", 0)))
+                       for m in b.get("messages", [])]
+            for _k, v, _ts in records:  # atomic: reject whole batch
+                err = self._validate_against_schema(t, v)
+                if err:
+                    return 400, {"error": err}
+            return lambda: self._log_for(
+                t, parts[idx]).append_many(records)
+
+        return self._publish_guarded(b, pick, make_append)
 
     def _subscribe(self, req: Request):
         try:
@@ -713,12 +950,19 @@ class BrokerServer:
         b = req.json()
         t = Topic(b["namespace"], b["topic"])
         flushed = 0
-        with self._lock:
-            logs = [log for (lt, _p), log in self._logs.items()
-                    if lt == t]
-        for log in logs:
-            log.flush()
-            flushed += 1
+        # under the topic's conf lock (review r5): a repartition
+        # coordinator's flush broadcast must not return 200 while a
+        # fenced append that already passed its gate is still landing
+        # in the tail — serializing here guarantees any append the
+        # fence admitted is in the buffer (and thus in this flush)
+        # before we confirm.
+        with self._topic_lock(t).write():
+            with self._lock:
+                logs = [log for (lt, _p), log in self._logs.items()
+                        if lt == t]
+            for log in logs:
+                log.flush()
+                flushed += 1
         return 200, {"flushed": flushed}
 
     # -- consumer-group offsets -------------------------------------------

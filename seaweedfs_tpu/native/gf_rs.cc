@@ -308,7 +308,12 @@ void gf_matrix_apply(const uint8_t* mat, int r, int k,
       for (unsigned t = 0; t < nt; ++t) {
         size_t off = static_cast<size_t>(t) * chunk;
         if (off >= n) break;
-        size_t len = (n - off < chunk) ? (n - off) : chunk;
+        // The last spawned thread must run to n: when n/nt is already
+        // 64-aligned, nt*chunk < n and capping at chunk would leave
+        // the final n%nt bytes unprocessed (uninitialized output with
+        // accumulate=0).
+        size_t len = (t == nt - 1 || n - off < chunk) ? (n - off)
+                                                      : chunk;
         ths.emplace_back([=] {
           gfni_apply_range(mat, aff, r, k, ins, outs, off, len,
                            accumulate);

@@ -50,7 +50,9 @@ class MasterServicer:
                 "ip": hb.ip, "port": hb.port,
                 "publicUrl": hb.public_url or f"{hb.ip}:{hb.port}",
                 "dataCenter": hb.data_center, "rack": hb.rack,
-                "maxVolumeCount": hb.max_volume_count,
+                # reference Heartbeat carries per-disk-type slot counts
+                # (map field 4); our topology tracks one total.
+                "maxVolumeCount": sum(hb.max_volume_counts.values()),
                 "maxFileKey": hb.max_file_key,
                 "volumes": [{
                     "id": v.id, "collection": v.collection,

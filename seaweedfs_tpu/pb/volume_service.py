@@ -232,7 +232,8 @@ class VolumeServicer:
     def VolumeEcShardsUnmount(self, request, context):
         status, resp = self.vs._ec_unmount(guarded(
             context, self.vs, "/admin/ec/unmount", payload={
-                "volumeId": request.volume_id}))
+                "volumeId": request.volume_id,
+                "shardIds": list(request.shard_ids)}))
         check_status(context, status, resp)
         return pb.VolumeEcShardsUnmountResponse()
 

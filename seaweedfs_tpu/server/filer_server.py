@@ -73,10 +73,32 @@ class FilerServer:
         # and multi-filer deployments that skip -lockPeers get per-
         # filer (not cluster-wide) locks.
         from ..cluster import LockManager
-        self.lock_manager = LockManager(self.http.url)
+        from ..cluster.lock_manager import normalize_address
+        # ring identity is the NORMALIZED address (ADVICE r4): if the
+        # operator's -lockPeers spelling differs from our advertised
+        # url (localhost vs 127.0.0.1), exact-string membership would
+        # make the owning filer redirect its own keys forever.
+        # HttpServer.url is unbracketed host:port; bracket a v6 host
+        # first or an address like ::1:8888 parses ambiguously
+        self._ring_self = normalize_address(
+            f"[{self.http.host}]:{self.http.port}"
+            if ":" in self.http.host else self.http.url)
+        self.lock_manager = LockManager(self._ring_self)
         if self._lock_peers:
-            members = set(self._lock_peers)
-            members.add(self.http.url)
+            members = {normalize_address(p) for p in self._lock_peers}
+            if self._ring_self not in members:
+                # fail HARD (review r5): silently adding ourselves
+                # would run a ring whose member list diverges from the
+                # peers' (they don't list us under this spelling) —
+                # two filers could then both compute target == self
+                # for one key and grant the same cluster lock twice.
+                # A diverged ring is worse than not starting.
+                raise ValueError(
+                    f"filer {self.http.url} (normalized "
+                    f"{self._ring_self}) is not in -lockPeers "
+                    f"{sorted(members)}; every filer must appear in "
+                    f"the shared peer list under a spelling that "
+                    f"normalizes to its advertised address")
             self.lock_manager.members = sorted(members)
         self.http.route("POST", "/admin/locks/acquire",
                         self._lock_acquire)
@@ -108,7 +130,7 @@ class FilerServer:
         if not key:
             return 400, {"error": "missing lock key"}
         target = self.lock_manager.target_server(key)
-        if target and target != self.http.url:
+        if target and target != self._ring_self:
             return 200, {"movedTo": target}
         r = self.lock_manager.acquire(
             key, str(b.get("owner", "")),
@@ -123,7 +145,7 @@ class FilerServer:
         b = req.json()
         key = str(b.get("key", ""))
         target = self.lock_manager.target_server(key)
-        if target and target != self.http.url:
+        if target and target != self._ring_self:
             return 200, {"movedTo": target}
         ok = self.lock_manager.release(key,
                                        str(b.get("renewToken", "")))
@@ -136,6 +158,16 @@ class FilerServer:
 
     def start(self):
         self.http.start()
+        # gRPC plane (filer.proto SeaweedFiler): entries CRUD, atomic
+        # rename, streaming list, SubscribeMetadata fed by the meta
+        # log, KV, distributed locks — the reference's most-trafficked
+        # proto (filer.proto:13-87)
+        try:
+            from ..pb.filer_service import start_filer_grpc
+            self.grpc_server, self.grpc_port = start_filer_grpc(
+                self, host=self.http.host)
+        except ImportError:     # grpcio absent: HTTP-only mode
+            self.grpc_server, self.grpc_port = None, 0
         # follow stream: push-fed vid map + instant leader tracking
         # (the reference filer keeps KeepConnected open for the same
         # reason, masterclient.go:471)
@@ -162,6 +194,8 @@ class FilerServer:
         operation.disable_follow(self.filer.master)
         if self._notifier is not None:
             self._notifier.stop()
+        if getattr(self, "grpc_server", None) is not None:
+            self.grpc_server.stop(grace=0.5)
         self.http.stop()
         self.filer.store.close()
         self.filer.meta_log.close()

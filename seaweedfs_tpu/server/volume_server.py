@@ -798,7 +798,15 @@ class VolumeServer:
         return 200, {"shardIds": ev.shard_ids}
 
     def _ec_unmount(self, req: Request):
-        self.store.unmount_ec_shards(int(req.json()["volumeId"]))
+        """:464 VolumeEcShardsUnmount — honors shardIds so a balance
+        move unmounts only the migrated shards.  Absent shardIds key =
+        full unmount (HTTP-internal convention); present-but-empty =
+        no-op (reference wire semantics)."""
+        b = req.json()
+        self.store.unmount_ec_shards(
+            int(b["volumeId"]),
+            [int(s) for s in b["shardIds"]]
+            if "shardIds" in b else None)
         self._heartbeat_once()
         return 200, {}
 

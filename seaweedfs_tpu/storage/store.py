@@ -235,13 +235,33 @@ class Store:
                     return ev
             raise KeyError(f"no local shards for volume {vid}")
 
-    def unmount_ec_shards(self, vid: int) -> None:
+    def unmount_ec_shards(self, vid: int,
+                          shard_ids: "list[int] | None" = None) -> None:
+        """Unmount EC shards of `vid`.  shard_ids=None unmounts the
+        whole EC volume (internal full-unmount callers); an EMPTY list
+        is a no-op, matching the reference servicer which only loops
+        over req.ShardIds (volume_grpc_erasure_coding.go:463-481) — a
+        reference-compatible tool sending no ids must not take every
+        shard offline.  A non-empty subset closes only those shards:
+        a balance unmounting one migrated shard must not take the
+        node's other shards of that volume offline."""
+        if shard_ids is not None and not shard_ids:
+            return
         with self.lock:
             for loc in self.locations:
-                ev = loc.ec_volumes.pop(vid, None)
-                if ev is not None:
-                    ev.close()
+                ev = loc.ec_volumes.get(vid)
+                if ev is None:
+                    continue
+                if shard_ids is None:
+                    loc.ec_volumes.pop(vid).close()
                     return
+                for sid in shard_ids:
+                    shard = ev.shards.pop(int(sid), None)
+                    if shard is not None:
+                        shard.close()
+                if not ev.shards:
+                    loc.ec_volumes.pop(vid).close()
+                return
 
     # -- heartbeat (store.go:371 CollectHeartbeat) ------------------------
 

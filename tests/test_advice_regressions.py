@@ -160,3 +160,44 @@ def test_delete_idempotent_on_retry(pair):
     for loc in operation.lookup(master.url, vid, use_cache=False):
         status, body, _ = http_bytes("DELETE", f"{loc['url']}/{a.fid}")
         assert status in (202, 404), (loc, status, body)
+
+
+def test_ec_unmount_honors_shard_ids(tmp_path, monkeypatch):
+    """ADVICE r4: VolumeEcShardsUnmount with a shard subset must take
+    ONLY those shards offline — unmounting one migrated shard used to
+    close every shard of the volume on the node."""
+    from seaweedfs_tpu.storage import erasure_coding as ec
+    from seaweedfs_tpu.storage.erasure_coding import (
+        ECContext, write_ec_files)
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+    from seaweedfs_tpu.storage.volume import Volume
+
+    for mod in (ec.ec_encoder, ec.ec_decoder, ec.ec_volume):
+        monkeypatch.setattr(mod, "LARGE_BLOCK_SIZE", 4096)
+        monkeypatch.setattr(mod, "SMALL_BLOCK_SIZE", 1024)
+    d = tmp_path / "loc"
+    d.mkdir()
+    v = Volume(str(d), 7)
+    v.write_needle(Needle(cookie=1, id=1, data=b"x" * 500))
+    v.close()
+    write_ec_files(str(d / "7"), ECContext())
+    store = Store([str(d)])
+    ev = store.find_ec_volume(7)
+    assert ev is not None and len(ev.shard_ids) == 14
+
+    # subset unmount: only shards 0 and 3 go away
+    store.unmount_ec_shards(7, [0, 3])
+    ev = store.find_ec_volume(7)
+    assert ev is not None
+    assert 0 not in ev.shard_ids and 3 not in ev.shard_ids
+    assert len(ev.shard_ids) == 12
+
+    # empty LIST is a no-op (reference wire semantics: the servicer
+    # only loops over req.ShardIds)
+    store.unmount_ec_shards(7, [])
+    assert store.find_ec_volume(7) is not None
+
+    # None = internal full unmount
+    store.unmount_ec_shards(7)
+    assert store.find_ec_volume(7) is None

@@ -112,3 +112,60 @@ def test_wdclient_follower_tracks_topology(mini, tmp_path):
         assert f.leader == master.url
     finally:
         f.stop()
+
+
+def test_ring_membership_normalizes_spelling(tmp_path):
+    """Regression (ADVICE r4): -lockPeers spelled `localhost:PORT`
+    while the filer advertises `127.0.0.1:PORT` must still make the
+    owning filer serve its keys locally instead of redirect-looping."""
+    from seaweedfs_tpu.cluster.lock_manager import normalize_address
+    from seaweedfs_tpu.server.httpd import http_json
+    from seaweedfs_tpu.server.master_server import MasterServer
+
+    assert normalize_address("LOCALHOST:8888") == \
+        normalize_address("127.0.0.1:8888")
+    assert normalize_address("http://127.0.0.1:8888/") == \
+        "127.0.0.1:8888"
+    # IPv6 forms keep a bracketed host so host:port stays parseable
+    # and dialable (::1 deliberately does NOT collapse to 127.0.0.1:
+    # a socket bound only to v6 loopback rejects v4 dials)
+    assert normalize_address("::1") == "[::1]"
+    assert normalize_address("[::1]") == "[::1]"
+    assert normalize_address("[::1]:8888") == "[::1]:8888"
+    assert normalize_address("[2001:db8::2]:88") == "[2001:db8::2]:88"
+    assert normalize_address("2001:db8::2") == "[2001:db8::2]"
+
+    master = MasterServer().start()
+    try:
+        f = FilerServer(master.url).start()
+        try:
+            # single-member ring on f: always local
+            r = http_json(
+                "POST", f"{f.http.url}/admin/locks/acquire",
+                {"key": "its-mine", "owner": "t", "ttlSec": 2.0})
+            assert "renewToken" in r, r
+            # peers list spells members as localhost; a filer
+            # advertising 127.0.0.1 on a listed port joins the ring
+            # (normalization matches the spellings)
+            import socket
+            probe = socket.socket()
+            probe.bind(("127.0.0.1", 0))
+            port2 = probe.getsockname()[1]
+            probe.close()
+            peers = [f"localhost:{f.http.port}",
+                     f"LOCALHOST:{port2}"]
+            f2 = FilerServer(master.url, port=port2,
+                             lock_peers=peers).start()
+            assert normalize_address(f2.http.url) in \
+                f2.lock_manager.members
+            assert len(f2.lock_manager.members) == 2
+            f2.stop()
+            # a filer NOT in the peer list must refuse to start: a
+            # silently diverged ring breaks lock mutual exclusion
+            with pytest.raises(ValueError, match="lockPeers"):
+                FilerServer(master.url,
+                            lock_peers=["localhost:59999"])
+        finally:
+            f.stop()
+    finally:
+        master.stop()

@@ -413,3 +413,65 @@ def test_repartition_split_and_merge_preserves_messages(tmp_path):
         filer.stop()
         vs.stop()
         master.stop()
+
+
+def test_repartition_migrates_racing_peer_publishes(cluster):
+    """Regression (ADVICE r4): a peer broker with a <=CONF_TTL-stale
+    layout cache keeps acking publishes into the OLD partition logs
+    after a repartition claims ownership.  The repartition must wait
+    out the cache window and flush peer tails before draining, or
+    those acknowledged messages are deleted with the old dirs."""
+    import threading
+
+    from seaweedfs_tpu.server.httpd import http_json
+
+    _, _, filer, broker_a = cluster
+    broker_b = BrokerServer(filer.url).start()
+    try:
+        ca = MQClient(broker_a.url)
+        cb = MQClient(broker_b.url)
+        assert ca.configure_topic("re", "race", 2) == 2
+        owners = {a["broker"]: i
+                  for i, a in enumerate(ca.lookup("re", "race"))}
+        assert broker_b.url in owners, "spread expected"
+        b_part = owners[broker_b.url]
+        # warm B's layout cache so its owner gate passes from cache
+        cb.publish("re", "race", b"seed", b"v-seed",
+                   partition=b_part)
+
+        result = {}
+
+        def do_repartition():
+            result.update(http_json(
+                "POST", f"{broker_a.url}/topics/repartition",
+                {"namespace": "re", "topic": "race",
+                 "partitionCount": 3}))
+
+        th = threading.Thread(target=do_repartition)
+        th.start()
+        # While A holds the claim and waits out CONF_TTL, B's stale
+        # cache still names B the owner of b_part: these publishes are
+        # acked by B into its in-memory tail.
+        racing = []
+        deadline = time.time() + broker_a.CONF_TTL * 0.6
+        i = 0
+        while time.time() < deadline:
+            val = b"race-%d" % i
+            cb.publish("re", "race", b"seed", val, partition=b_part)
+            racing.append(val)
+            i += 1
+            time.sleep(0.05)
+        th.join(timeout=30)
+        assert "error" not in result, result
+        assert len(result["partitions"]) == 3
+
+        got = []
+        for p in range(3):
+            got += [m.value for m in
+                    ca.subscribe("re", "race", p, since_ns=0,
+                                 limit=1000)]
+        assert b"v-seed" in got
+        missing = [v for v in racing if v not in got]
+        assert not missing, f"lost acknowledged publishes: {missing}"
+    finally:
+        broker_b.stop()

@@ -1,0 +1,282 @@
+"""Machine-verified wire compatibility against the reference protos.
+
+Every `.proto` under seaweedfs_tpu/pb/protos/ declares itself a
+wire-compatible subset of the same-named file in
+/root/reference/weed/pb/.  Round 4 shipped a Heartbeat whose field
+numbers collided with the reference while a hand-written spot-check
+test (asserting numbers copied from our own proto) stayed green.  This
+test closes that hole structurally: it PARSES both proto files and
+asserts that every message, field (name -> number, label, type), enum
+value, and service method we declare exists in the reference with the
+identical wire shape.  No hard-coded numbers anywhere.
+"""
+import os
+import re
+import glob
+
+import pytest
+
+REPO_PROTO_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "seaweedfs_tpu", "pb", "protos")
+REF_PROTO_DIR = "/root/reference/weed/pb"
+
+SCALARS = {
+    "double", "float", "int32", "int64", "uint32", "uint64", "sint32",
+    "sint64", "fixed32", "fixed64", "sfixed32", "sfixed64", "bool",
+    "string", "bytes",
+}
+
+_TOKEN = re.compile(r'"[^"]*"|[A-Za-z0-9_.\-]+|[{}()<>=;,\[\]]')
+
+
+def _tokenize(text):
+    # strip // line comments and /* */ block comments first
+    text = re.sub(r"//[^\n]*", "", text)
+    text = re.sub(r"/\*.*?\*/", "", text, flags=re.S)
+    return _TOKEN.findall(text)
+
+
+def _skip_statement(toks, i):
+    """Advance past the next ';', honoring one level of nesting for
+    option aggregates (`option (x) = { ... };`)."""
+    depth = 0
+    while i < len(toks):
+        t = toks[i]
+        if t == "{":
+            depth += 1
+        elif t == "}":
+            depth -= 1
+        elif t == ";" and depth <= 0:
+            return i + 1
+        i += 1
+    return i
+
+
+def _norm_type(t):
+    """Normalize a field/rpc type for cross-file comparison: scalar
+    types compare exactly; named types compare by their last dotted
+    component (the reference qualifies cross-package types like
+    volume_server_pb.VolumeServerState; our per-file copies don't)."""
+    if t in SCALARS or t.startswith("map<"):
+        return t
+    return t.split(".")[-1]
+
+
+def _parse_enum(toks, i, fq, out):
+    """toks[i] == '{'; collects NAME = N pairs into out['enums'][fq]."""
+    vals = {}
+    i += 1
+    while toks[i] != "}":
+        if toks[i] in ("option", "reserved"):
+            i = _skip_statement(toks, i)
+            continue
+        name = toks[i]
+        assert toks[i + 1] == "=", f"enum {fq}: bad entry at {toks[i:i+3]}"
+        vals[name] = int(toks[i + 2])
+        i += 3
+        while toks[i] != ";":          # allow [deprecated = true]
+            i += 1
+        i += 1
+    out["enums"][fq] = vals
+    return i + 1
+
+
+def _parse_message(toks, i, fq, out):
+    """toks[i] == '{'; collects fields into out['messages'][fq]."""
+    fields = {}
+    i += 1
+    while toks[i] != "}":
+        t = toks[i]
+        if t == "message":
+            i = _parse_message(toks, i + 2, fq + "." + toks[i + 1], out)
+        elif t == "enum":
+            i = _parse_enum(toks, i + 2, fq + "." + toks[i + 1], out)
+        elif t == "oneof":
+            # oneof members are plain fields of the enclosing message
+            i += 3                     # 'oneof' name '{'
+            while toks[i] != "}":
+                if toks[i] == "option":
+                    i = _skip_statement(toks, i)
+                    continue
+                ftype, fname, num = toks[i], toks[i + 1], int(toks[i + 3])
+                fields[fname] = (num, "optional", _norm_type(ftype))
+                i = _skip_statement(toks, i + 3)
+            i += 1
+        elif t in ("reserved", "option", "extensions"):
+            i = _skip_statement(toks, i)
+        elif t == "map":
+            # map < k , v > name = N ;
+            k, v = toks[i + 2], toks[i + 4]
+            fname, num = toks[i + 6], int(toks[i + 8])
+            fields[fname] = (num, "map", f"map<{k},{_norm_type(v)}>")
+            i = _skip_statement(toks, i + 8)
+        else:
+            label = "optional"
+            if t in ("repeated", "optional", "required"):
+                label = "repeated" if t == "repeated" else "optional"
+                i += 1
+            ftype, fname = toks[i], toks[i + 1]
+            assert toks[i + 2] == "=", \
+                f"{fq}: unparsed field at {toks[i:i+4]}"
+            num = int(toks[i + 3])
+            fields[fname] = (num, label, _norm_type(ftype))
+            i = _skip_statement(toks, i + 3)
+    out["messages"][fq] = fields
+    return i + 1
+
+
+def _parse_service(toks, i, name, out):
+    rpcs = {}
+    i += 1
+    while toks[i] != "}":
+        if toks[i] == "option":
+            i = _skip_statement(toks, i)
+            continue
+        assert toks[i] == "rpc", f"service {name}: bad token {toks[i]}"
+        rname = toks[i + 1]
+        i += 3                         # 'rpc' name '('
+        creq_stream = toks[i] == "stream"
+        if creq_stream:
+            i += 1
+        req = _norm_type(toks[i])
+        i += 2                         # type ')'
+        assert toks[i] == "returns"
+        i += 2                         # 'returns' '('
+        resp_stream = toks[i] == "stream"
+        if resp_stream:
+            i += 1
+        resp = _norm_type(toks[i])
+        i += 2                         # type ')'
+        if toks[i] == "{":             # empty options body
+            while toks[i] != "}":
+                i += 1
+            i += 1
+        elif toks[i] == ";":
+            i += 1
+        rpcs[rname] = (req, creq_stream, resp, resp_stream)
+    out["services"][name] = rpcs
+    return i + 1
+
+
+def parse_proto(path):
+    with open(path) as f:
+        toks = _tokenize(f.read())
+    out = {"package": None, "messages": {}, "enums": {}, "services": {}}
+    i = 0
+    while i < len(toks):
+        t = toks[i]
+        if t == "package":
+            out["package"] = toks[i + 1]
+            i = _skip_statement(toks, i)
+        elif t == "message":
+            i = _parse_message(toks, i + 2, toks[i + 1], out)
+        elif t == "enum":
+            i = _parse_enum(toks, i + 2, toks[i + 1], out)
+        elif t == "service":
+            i = _parse_service(toks, i + 2, toks[i + 1], out)
+        elif t in ("syntax", "option", "import"):
+            i = _skip_statement(toks, i)
+        else:
+            i += 1
+    return out
+
+
+def repo_protos():
+    files = sorted(glob.glob(os.path.join(REPO_PROTO_DIR, "*.proto")))
+    assert files, "no protos found under pb/protos/"
+    return files
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_PROTO_DIR),
+                    reason="reference checkout not present")
+@pytest.mark.parametrize("repo_path", repo_protos(),
+                         ids=[os.path.basename(p) for p in repo_protos()])
+def test_every_declared_field_matches_reference(repo_path):
+    name = os.path.basename(repo_path)
+    ref_path = os.path.join(REF_PROTO_DIR, name)
+    assert os.path.exists(ref_path), \
+        f"{name}: no same-named reference proto to be compatible with"
+    ours, ref = parse_proto(repo_path), parse_proto(ref_path)
+
+    assert ours["package"] == ref["package"], \
+        f"{name}: package {ours['package']!r} != {ref['package']!r}"
+
+    errors = []
+    for msg, fields in ours["messages"].items():
+        if "Entry" in msg and msg.endswith("Entry"):
+            continue  # map synthetics never appear (we parse maps directly)
+        if msg not in ref["messages"]:
+            errors.append(f"message {msg} not in reference {name}")
+            continue
+        rf = ref["messages"][msg]
+        for fname, (num, label, ftype) in fields.items():
+            if fname not in rf:
+                errors.append(f"{msg}.{fname} not in reference")
+                continue
+            rnum, rlabel, rtype = rf[fname]
+            if num != rnum:
+                errors.append(
+                    f"{msg}.{fname}: field number {num} != ref {rnum}")
+            if label != rlabel:
+                errors.append(
+                    f"{msg}.{fname}: label {label} != ref {rlabel}")
+            if ftype != rtype:
+                errors.append(
+                    f"{msg}.{fname}: type {ftype} != ref {rtype}")
+
+    for enum, vals in ours["enums"].items():
+        if enum not in ref["enums"]:
+            errors.append(f"enum {enum} not in reference {name}")
+            continue
+        for vname, vnum in vals.items():
+            rnum = ref["enums"][enum].get(vname)
+            if rnum != vnum:
+                errors.append(
+                    f"enum {enum}.{vname}: {vnum} != ref {rnum}")
+
+    for svc, rpcs in ours["services"].items():
+        if svc not in ref["services"]:
+            errors.append(f"service {svc} not in reference {name}")
+            continue
+        for rname, sig in rpcs.items():
+            rsig = ref["services"][svc].get(rname)
+            if rsig is None:
+                errors.append(f"rpc {svc}.{rname} not in reference")
+            elif rsig != sig:
+                errors.append(
+                    f"rpc {svc}.{rname}: {sig} != ref {rsig}")
+
+    assert not errors, f"{name}: wire drift vs reference:\n  " + \
+        "\n  ".join(errors)
+
+
+def test_parser_sees_reference_heartbeat():
+    """Sanity: the parser extracts the exact reference Heartbeat shape
+    this test suite exists to defend (master.proto:69)."""
+    if not os.path.isdir(REF_PROTO_DIR):
+        pytest.skip("reference checkout not present")
+    ref = parse_proto(os.path.join(REF_PROTO_DIR, "master.proto"))
+    hb = ref["messages"]["Heartbeat"]
+    assert hb["has_no_volumes"][0] == 12
+    assert hb["has_no_ec_shards"][0] == 19
+    assert hb["grpc_port"][0] == 20
+    assert hb["max_volume_counts"][:2] == (4, "map")
+
+
+@pytest.mark.parametrize("repo_path", repo_protos(),
+                         ids=[os.path.basename(p) for p in repo_protos()])
+def test_generated_stubs_match_proto_source(repo_path):
+    """EVERY checked-in *_pb2.py module must be generated from its
+    same-named checked-in .proto source (a stale pb2 would pass the
+    source-level diff above while speaking the old wire format)."""
+    import importlib
+    stem = os.path.basename(repo_path)[:-len(".proto")]
+    mod = importlib.import_module(f"seaweedfs_tpu.pb.{stem}_pb2")
+    ours = parse_proto(repo_path)
+    for msg, fields in ours["messages"].items():
+        if "." in msg:
+            continue  # nested: reachable via containing type
+        desc = mod.DESCRIPTOR.message_types_by_name[msg]
+        for fname, (num, _label, _t) in fields.items():
+            assert desc.fields_by_name[fname].number == num, \
+                f"{stem}_pb2.{msg}.{fname} stale vs {stem}.proto"

@@ -63,3 +63,25 @@ def test_native_odd_sizes():
         data = rng.integers(0, 256, size=(4, n), dtype=np.uint8)
         np.testing.assert_array_equal(cpu.parity(data),
                                       nat.parity(data))
+
+
+@needs_native
+def test_native_threaded_path_covers_tail():
+    """Regression (ADVICE r4): on the multi-threaded GFNI path the
+    per-thread chunk is 64B-aligned; when n/nt was already aligned the
+    last thread used to cap its range at `chunk`, silently leaving the
+    final n%nt bytes of every output row uninitialized.  Use n >= 8MB
+    (the threading threshold is ~4MB/thread) with n odd so the tail
+    exists on any thread count, and checksum the last bytes against the
+    numpy twin.  On non-GFNI hosts this still validates the tiled path
+    at threaded sizes."""
+    n = (9 << 20) + 7
+    rng = np.random.default_rng(4)
+    data = rng.integers(0, 256, size=(10, n), dtype=np.uint8)
+    cpu = rs_cpu.ReedSolomonCPU(10, 4)
+    nat = rs_native.ReedSolomonNative(10, 4)
+    a = cpu.parity(data)
+    b = nat.parity(data)
+    # compare the tail region explicitly first for a pointed failure
+    np.testing.assert_array_equal(a[:, -4096:], b[:, -4096:])
+    np.testing.assert_array_equal(a, b)
