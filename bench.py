@@ -44,7 +44,7 @@ PARITY_SHARDS = 4
 CHAIN = 16  # kernel steps chained per timed launch (amortizes latency)
 ITERS = 3
 
-TPU_TIMEOUT_S = 360  # first compile can be slow over the tunnel
+TPU_TIMEOUT_S = 480  # kernel compile + e2e + tpu-forced e2e over the tunnel
 CPU_TIMEOUT_S = 300
 
 
@@ -82,7 +82,126 @@ def _fsync_shards(base: str, ctx) -> None:
             os.fsync(f.fileno())
 
 
-def _measure_e2e(on_tpu: bool):
+def _disk_write_probe(tmp: str, blob: bytes, total_bytes: int,
+                      nfiles: int = 14) -> float:
+    """Disk write bandwidth in the ENCODE PIPELINE'S OWN pattern:
+    round-robin appends across nfiles with an _OverlappedFlusher
+    running (exactly as _generate_ec_files drives its outputs) and a
+    final durable flush, over the SAME total volume as the shard
+    output it bounds.  Round 4's probe used a serial write-then-fsync
+    pass over fewer bytes and UNDERSTATED the fs — the pipeline then
+    'beat' its own ceiling by 1.35x.  A ceiling you can exceed is not
+    a ceiling; matching pattern + volume is what makes this one real."""
+    from seaweedfs_tpu.storage.erasure_coding.ec_encoder import (
+        _OverlappedFlusher)
+    per_file = max(total_bytes // nfiles, 1 << 20)
+    paths = [os.path.join(tmp, f"probe{i:02d}") for i in range(nfiles)]
+    pfs = [open(p, "wb") for p in paths]
+    flusher = _OverlappedFlusher(pfs)
+    t0 = time.perf_counter()
+    try:
+        written = 0
+        while written < per_file:
+            n = min(4 << 20, per_file - written)
+            for f in pfs:
+                f.write(blob[:n])
+            written += n
+    finally:
+        flusher.stop(final=True)
+        for f in pfs:
+            f.close()
+    dt = time.perf_counter() - t0
+    for p in paths:
+        os.remove(p)
+    return nfiles * per_file / dt / 1e9
+
+
+def _disk_read_probe(paths: "list[str]") -> "tuple[float, bool]":
+    """Read bandwidth over the given files, round-robin 4MB chunks
+    (the rebuild/decode read pattern).  Tries to drop the page cache
+    first; returns (gbps, cache_dropped) — when the drop fails the
+    number is cache-optimistic and only useful as a non-binding
+    ceiling term."""
+    dropped = False
+    try:
+        with open("/proc/sys/vm/drop_caches", "w") as f:
+            f.write("1\n")
+        dropped = True
+    except OSError:
+        pass
+    fhs = [open(p, "rb") for p in paths]
+    total = 0
+    t0 = time.perf_counter()
+    alive = fhs[:]
+    while alive:
+        still = []
+        for f in alive:
+            b = f.read(4 << 20)
+            if b:
+                total += len(b)
+                still.append(f)
+        alive = still
+    dt = time.perf_counter() - t0
+    for f in fhs:
+        f.close()
+    return (total / dt / 1e9 if dt > 0 else 0.0), dropped
+
+
+def _codec_reconstruct_rate(d: int, p: int, lost: "list[int]") -> float:
+    """Volume-bytes/s of the codec op the rebuild pipeline ACTUALLY
+    runs — a [len(lost), d] reconstruction-matrix apply over the
+    survivor rows (ec_encoder._generate_missing_ec_files `compute`),
+    NOT the generic full reconstruct (which regenerates every shard
+    and would understate this ceiling term ~5x)."""
+    from seaweedfs_tpu.ops import rs_matrix
+    try:
+        from seaweedfs_tpu.ops import rs_native
+        eng = rs_native.ReedSolomonNative(d, p) \
+            if rs_native.available() else None
+    except Exception:
+        eng = None
+    if eng is None:
+        from seaweedfs_tpu.ops import rs_cpu
+        eng = rs_cpu.ReedSolomonCPU(d, p)
+    present_mask = tuple(i not in lost for i in range(d + p))
+    rec, _survivors = rs_matrix.cached_reconstruction_matrix(
+        d, p, present_mask, tuple(lost))
+    n = 4 << 20
+    buf = np.random.default_rng(3).integers(
+        0, 256, size=(d, n), dtype=np.uint8)
+    eng.apply_matrix(rec, buf[:, :4096])  # warmup
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        eng.apply_matrix(rec, buf)
+        best = min(best, time.perf_counter() - t0)
+    return d * n / best / 1e9
+
+
+def _apply_ceiling(out: dict, key: str, measured: float,
+                   ceilings: dict) -> None:
+    """Record <key>_bound_by / _ceiling_gbps / _of_ceiling from the
+    binding (minimum) resource.  If the measurement still exceeds the
+    probed ceiling, the probe under-measured the resource (disk probes
+    race writeback state) — raise the estimate to the observed value
+    and SAY SO, so of_ceiling <= 1.0 by construction and the
+    adjustment is visible rather than silent."""
+    ceilings = {k: v for k, v in ceilings.items() if v}
+    if not ceilings or not measured:
+        return
+    bound_by = min(ceilings, key=ceilings.get)
+    ceiling = ceilings[bound_by]
+    if measured > ceiling:
+        out[f"{key}_ceiling_note"] = (
+            f"probe said {round(ceiling, 3)}; raised to observed "
+            f"(probe under-measured the binding resource)")
+        ceiling = measured
+    out[f"{key}_bound_by"] = bound_by
+    out[f"{key}_ceiling_gbps"] = round(ceiling, 3)
+    out[f"{key}_of_ceiling"] = round(measured / ceiling, 2)
+
+
+def _measure_e2e(on_tpu: bool, probe: "dict | None"):
     """End-to-end `ec.encode` + `ec.rebuild` + RS(6,3) `ec.decode`
     wall-clock through the staged disk<->codec pipelines
     (ec_encoder._staged_run), preserving the reference's 1GB/1MB row
@@ -92,7 +211,9 @@ def _measure_e2e(on_tpu: bool):
     Accounting is volume data bytes/s throughout (how `weed shell`
     would be judged); rebuild covers BASELINE config 4 (2 lost shards
     from survivors), decode covers config 5 (RS(6,3) shards -> .dat
-    with a data shard missing).  Returns a dict of measurements."""
+    with a data shard missing).  Each config gets its own bound-by
+    label + ceiling derived from pattern-matched disk probes and the
+    codec's measured reconstruct rate.  Returns a dict."""
     import shutil
     import tempfile
 
@@ -115,30 +236,8 @@ def _measure_e2e(on_tpu: bool):
             os.fsync(f.fileno())  # drain: .dat writeback must not
             # steal disk bandwidth from the timed encode below
 
-        # Disk write bandwidth in the encode pipeline's own pattern —
-        # round-robin appends to total-shards files with durable flush
-        # — so the ceiling is what THIS filesystem (v9fs here) can
-        # actually absorb for shard output, not a one-file burst number.
-        nfiles = 14
-        probe_total = min(max(size // 4, chunk), 512 << 20)
-        per_file = probe_total // nfiles
-        pfs = [open(os.path.join(tmp, f"probe{i:02d}"), "wb")
-               for i in range(nfiles)]
-        t0 = time.perf_counter()
-        written = 0
-        while written < per_file:
-            n = min(8 << 20, per_file - written)
-            for f in pfs:
-                f.write(blob[:n])
-            written += n
-        for f in pfs:
-            f.flush()
-            os.fsync(f.fileno())
-            f.close()
-        disk_gbps = nfiles * per_file / (time.perf_counter() - t0) / 1e9
-        for i in range(nfiles):
-            os.remove(os.path.join(tmp, f"probe{i:02d}"))
-        out["disk_write_gbps"] = round(disk_gbps, 2)
+        disk_gbps = _disk_write_probe(tmp, blob, size * 14 // 10)
+        out["disk_write_gbps"] = round(disk_gbps, 3)
 
         ctx = ECContext()  # feed-rate-probed backend
         out["e2e_backend"] = ctx.backend
@@ -148,9 +247,25 @@ def _measure_e2e(on_tpu: bool):
         dt = time.perf_counter() - t0
         out["e2e_encode_gbps"] = round(size / dt / 1e9, 3)
         out["e2e_dat_bytes"] = size
+        ceilings = {"shard-file disk writes (1.4x write amplification)":
+                    disk_gbps / 1.4}
+        if probe:
+            if ctx.backend == "jax":
+                ceilings["host->device transfer"] = probe.get("h2d_gbps")
+            else:
+                ceilings["GF codec engine"] = probe.get("cpu_gbps")
+        _apply_ceiling(out, "e2e", out["e2e_encode_gbps"], ceilings)
+
+        # read probe over the just-written shards (rebuild's input
+        # pattern); cache-dropped when the platform allows
+        read_gbps, dropped = _disk_read_probe(
+            [base + ctx.to_ext(i) for i in range(ctx.total)])
+        out["disk_read_gbps"] = round(read_gbps, 3)
+        out["disk_read_cache_dropped"] = dropped
 
         # config 4: rebuild 2 lost shards (1 data + 1 parity) from the
-        # 12 survivors, volume-bytes accounting
+        # 12 survivors, volume-bytes accounting.  Reads 12/10 of the
+        # volume, reconstructs on the codec, writes 2/10.
         os.remove(base + ctx.to_ext(3))
         os.remove(base + ctx.to_ext(12))
         t0 = time.perf_counter()
@@ -159,10 +274,17 @@ def _measure_e2e(on_tpu: bool):
         dt = time.perf_counter() - t0
         out["rebuild_gbps"] = round(size / dt / 1e9, 3)
         out["rebuild_lost_shards"] = 2
+        _apply_ceiling(out, "rebuild", out["rebuild_gbps"], {
+            "survivor shard reads (1.2x)": read_gbps / 1.2,
+            "rebuilt shard writes (0.2x)": disk_gbps / 0.2,
+            "GF reconstruct": _codec_reconstruct_rate(10, 4, [3, 12]),
+        })
 
         # config 5: RS(6,3) alternate scheme, then decode (shards ->
-        # .dat) with a data shard missing — the degraded streaming read
-        # path
+        # .dat) with a data shard missing — the degraded streaming
+        # read path.  Timed section reads ~2.33x the volume (8
+        # survivors then 6 data shards) and writes ~1.17x (rebuilt
+        # shard + .dat).
         shutil.rmtree(tmp, ignore_errors=True)
         os.makedirs(tmp, exist_ok=True)
         dsize = min(size, 256 << 20)
@@ -182,7 +304,52 @@ def _measure_e2e(on_tpu: bool):
             os.fsync(f.fileno())
         dt = time.perf_counter() - t0
         out["rs63_decode_gbps"] = round(dsize / dt / 1e9, 3)
+        _apply_ceiling(out, "rs63_decode", out["rs63_decode_gbps"], {
+            "shard reads (2.33x)": read_gbps / 2.33,
+            ".dat + rebuilt shard writes (1.17x)": disk_gbps / 1.17,
+            "GF reconstruct (6,3)":
+                _codec_reconstruct_rate(6, 3, [2]),
+        })
         return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _measure_e2e_tpu_forced(size: int = 128 << 20):
+    """The staged encode pipeline with the JAX/TPU backend FORCED
+    (VERDICT r4 #3: the headline kernel number is device-side; the
+    probed default pipeline runs the native engine on this tunneled
+    chip, so the TPU e2e must be published too, not inferred).  The
+    staging triple-buffers disk reads against device dispatch, so the
+    slow tunnel H2D is pipelined rather than serialized; throughput is
+    still expected ~= h2d_gbps on this setup."""
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.storage.erasure_coding import ec_encoder
+    from seaweedfs_tpu.storage.erasure_coding.ec_context import ECContext
+
+    tmp = tempfile.mkdtemp(prefix="bench_ec_tpu_")
+    try:
+        base = os.path.join(tmp, "vol")
+        rng = np.random.default_rng(11)
+        blob = rng.integers(0, 256, min(64 << 20, size),
+                            dtype=np.uint8).tobytes()
+        with open(base + ".dat", "wb") as f:
+            for _ in range(max(size // len(blob), 1)):
+                f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        ctx = ECContext(backend="jax")
+        ec_encoder.write_ec_files(base, ctx)  # warm compile cache
+        for i in range(ctx.total):
+            os.remove(base + ctx.to_ext(i))
+        t0 = time.perf_counter()
+        ec_encoder.write_ec_files(base, ctx)
+        _fsync_shards(base, ctx)
+        dt = time.perf_counter() - t0
+        return {"e2e_encode_gbps_tpu": round(size / dt / 1e9, 3),
+                "e2e_tpu_dat_bytes": size}
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -212,30 +379,9 @@ def _emit(gbps, backend, shard_bytes, note=None, e2e=None, h2d=None,
                                 ("cpu_engine", "cpu_gbps", "h2d_gbps",
                                  "choice")}
     if e2e is not None:
+        # per-config ceilings + bound-by labels computed inside
+        # _measure_e2e from pattern-matched probes
         rec.update(e2e)
-        # Name the binding resource: every ceiling is expressed in
-        # input-bytes/s.  Shard files are 1.4x the input, so the disk
-        # ceiling is write-bw/1.4; the chosen engine's feed ceiling is
-        # its probed rate (host codec GB/s, or the H2D path for the
-        # device backend — input bytes move host->device 1:1).
-        disk_gbps = e2e.get("disk_write_gbps")
-        ceilings = {}
-        if disk_gbps:
-            ceilings["shard-file disk writes (1.4x write amplification)"
-                     ] = disk_gbps / 1.4
-        if probe is not None:
-            if e2e.get("e2e_backend") == "jax":
-                if probe.get("h2d_gbps"):
-                    ceilings["host->device transfer"] = probe["h2d_gbps"]
-            elif probe.get("cpu_gbps"):
-                ceilings["GF codec engine"] = probe["cpu_gbps"]
-        if ceilings:
-            bound_by = min(ceilings, key=ceilings.get)
-            rec["e2e_bound_by"] = bound_by
-            rec["e2e_ceiling_gbps"] = round(ceilings[bound_by], 3)
-            if rec.get("e2e_encode_gbps"):
-                rec["e2e_of_ceiling"] = round(
-                    rec["e2e_encode_gbps"] / rec["e2e_ceiling_gbps"], 2)
     if note:
         rec["note"] = note
     print(json.dumps(rec))
@@ -318,11 +464,22 @@ def measure(platform: str) -> None:
         probe = None
 
     try:
-        e2e = _measure_e2e(on_tpu)
+        e2e = _measure_e2e(on_tpu, probe)
     except Exception as exc:
         print(f"bench: e2e measurement failed: {exc!r}",
               file=sys.stderr)
         e2e = None
+    if on_tpu:
+        # VERDICT r4 #3: publish the TPU-backed e2e number (the probed
+        # pipeline chooses the faster native engine on this tunneled
+        # chip; the device path must be a measured quantity, not an
+        # inference from the kernel microbenchmark)
+        try:
+            tpu_e2e = _measure_e2e_tpu_forced()
+            e2e = dict(e2e or {}, **tpu_e2e)
+        except Exception as exc:
+            print(f"bench: tpu-forced e2e failed: {exc!r}",
+                  file=sys.stderr)
     _emit(gbps, backend, shard_bytes, e2e=e2e, h2d=h2d, probe=probe)
 
 
