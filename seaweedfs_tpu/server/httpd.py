@@ -199,6 +199,9 @@ class HttpServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # small request/response pairs (1KB needles) must not sit
+            # in Nagle's 40ms window behind delayed ACKs
+            disable_nagle_algorithm = True
 
             def _dispatch(self):
                 req = Request(self)
@@ -253,12 +256,35 @@ class HttpServer:
                     # extra_headers — these responses are never chunked.
                     self.end_headers()
                     try:
-                        if req.method != "HEAD":
-                            while True:
-                                chunk = body.read(1 << 20)
-                                if not chunk:
-                                    break
-                                self.wfile.write(chunk)
+                        if req.method == "HEAD":
+                            return
+                        # sendfile(2) fast path for FileSlice needle
+                        # reads: zero-copy kernel transfer from the
+                        # .dat fd (the RDMA-sidecar idea's in-server
+                        # sibling; socket.sendfile falls back to a
+                        # send loop under TLS).  No mid-stream
+                        # fallback: a partial sendfile that then
+                        # re-sent bytes would corrupt the response, so
+                        # errors close the connection instead.
+                        f = getattr(body, "_f", None)
+                        count = getattr(body, "_remaining", 0)
+                        if f is not None and count > 0 and \
+                                hasattr(f, "fileno"):
+                            try:
+                                self.wfile.flush()
+                                # offset defaults to 0, NOT the file
+                                # position — ranged needle reads start
+                                # mid-.dat
+                                self.connection.sendfile(
+                                    f, offset=f.tell(), count=count)
+                            except (OSError, ValueError):
+                                self.close_connection = True
+                            return
+                        while True:
+                            chunk = body.read(1 << 20)
+                            if not chunk:
+                                break
+                            self.wfile.write(chunk)
                     finally:
                         body.close()
                     return
@@ -282,6 +308,17 @@ class HttpServer:
             allow_reuse_address = True
             ssl_context = None  # set by start() when the TLS plane is on
 
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                # established keep-alive connections, so stop() can
+                # sever them: shutdown() only ends the ACCEPT loop,
+                # and with pooled clients a "stopped" server would
+                # otherwise keep serving (and acking writes!) over
+                # existing sockets — breaking every stop-means-stop
+                # invariant (e.g. the MQ broker's stop-then-flush)
+                self._conns: set = set()
+                self._conns_lock = threading.Lock()
+
             def finish_request(self, request, client_address):
                 # TLS handshake PER CONNECTION in the handler thread —
                 # wrapping the listening socket would handshake inside
@@ -300,7 +337,38 @@ class HttpServer:
                         except OSError:
                             pass
                         return
-                super().finish_request(request, client_address)
+                with self._conns_lock:
+                    self._conns.add(request)
+                try:
+                    super().finish_request(request, client_address)
+                finally:
+                    with self._conns_lock:
+                        self._conns.discard(request)
+
+            def close_established(self):
+                import socket as _socket
+                with self._conns_lock:
+                    conns = list(self._conns)
+                for c in conns:
+                    try:
+                        c.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+
+            def handle_error(self, request, client_address):
+                # a client (or close_established) dropping the socket
+                # mid-response is normal teardown, not a stack trace
+                import sys as _sys
+                exc = _sys.exc_info()[1]
+                if isinstance(exc, (BrokenPipeError,
+                                    ConnectionResetError,
+                                    ConnectionAbortedError)):
+                    return
+                super().handle_error(request, client_address)
 
         self._httpd = Server((host, port), Handler)
         self.host = host
@@ -324,6 +392,11 @@ class HttpServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        # sever established keep-alive connections: in-flight handlers
+        # see a dead socket, pooled clients get a connection error and
+        # re-dial elsewhere — a stopped server must never ack another
+        # request
+        self._httpd.close_established()
 
     @property
     def url(self) -> str:
@@ -390,22 +463,15 @@ def http_json(method: str, url: str, payload: dict | None = None,
     headers = dict(headers or {})
     if data:
         headers.setdefault("Content-Type", "application/json")
-    full_url, ctx = _dial(url)
-    req = urllib.request.Request(
-        full_url, data=data, method=method,
-        headers=_auth_for(url, headers))
+    status, body, _ = _pooled_request(method, url, data,
+                                      _auth_for(url, headers), timeout)
     try:
-        with urllib.request.urlopen(req, timeout=timeout,
-                                    context=ctx) as resp:
-            return json.loads(resp.read() or b"{}")
-    except urllib.error.HTTPError as e:
-        body = e.read() or b"{}"
-        try:
-            parsed = json.loads(body)
-        except ValueError:
-            parsed = {"error": body.decode(errors="replace")}
-        parsed.setdefault("error", f"HTTP {e.code}")
-        return parsed
+        parsed = json.loads(body or b"{}")
+    except ValueError:
+        parsed = {"error": body.decode(errors="replace")}
+    if status >= 300 and isinstance(parsed, dict):
+        parsed.setdefault("error", f"HTTP {status}")
+    return parsed
 
 
 def parse_range(header: str, total: int
@@ -502,16 +568,105 @@ def http_upload(method: str, url: str, src_path: str,
             return e.code, e.read(), dict(e.headers)
 
 
+# --- pooled keep-alive client (the hot data-plane funnel) ----------------
+#
+# urllib.request opens a fresh TCP connection per call; at benchmark
+# concurrency that is 3 syscall round-trips of pure setup per 1KB
+# needle, and measured ~30x below the reference's `weed benchmark`
+# req/s (README.md:555-605 — its Go http.Client pools keep-alive
+# connections).  This pool is PER-THREAD (no cross-thread locking on
+# the hot path; a ThreadPool worker reuses its sockets) keyed by
+# scheme+netloc.  POSTs are retried once ONLY when a REUSED socket
+# died before the request hit the wire (stale keep-alive), never on a
+# fresh connection — the same idempotency rule Go's Transport applies.
+
+_thread_pools = threading.local()
+
+
+def _pool() -> dict:
+    p = getattr(_thread_pools, "conns", None)
+    if p is None:
+        p = _thread_pools.conns = {}
+    return p
+
+
+def _one_pooled_request(method: str, full_url: str, body,
+                        headers: dict, timeout: float, ctx):
+    """One request over the thread's pooled connection for the url's
+    (scheme, netloc); returns (status, data, headers, location)."""
+    import http.client
+
+    parsed = urllib.parse.urlsplit(full_url)
+    target = parsed.path or "/"
+    if parsed.query:
+        target += "?" + parsed.query
+    key = (parsed.scheme, parsed.netloc)
+    for attempt in (0, 1):
+        conn = _pool().get(key)
+        reused = conn is not None
+        if conn is None:
+            if parsed.scheme == "https":
+                conn = http.client.HTTPSConnection(
+                    parsed.netloc, timeout=timeout, context=ctx)
+            else:
+                conn = http.client.HTTPConnection(
+                    parsed.netloc, timeout=timeout)
+            _pool()[key] = conn
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        try:
+            conn.request(method, target, body=body, headers=headers)
+        except (http.client.HTTPException, OSError) as e:
+            # send failed: the request never executed — safe to retry
+            # any method once on a stale reused socket
+            conn.close()
+            _pool().pop(key, None)
+            if reused and attempt == 0:
+                continue
+            if isinstance(e, OSError):
+                raise
+            raise OSError(f"http request failed: {e!r}") from e
+        try:
+            resp = conn.getresponse()
+            data = resp.read()
+        except (http.client.HTTPException, OSError) as e:
+            # request may have EXECUTED server-side (response lost):
+            # transparently retrying a POST here would double-execute
+            # non-idempotent operations (publish, delete counters), so
+            # only idempotent reads retry — everything else surfaces
+            # the ambiguity to the caller (Go Transport's rule)
+            conn.close()
+            _pool().pop(key, None)
+            if reused and attempt == 0 and method in ("GET", "HEAD"):
+                continue
+            if isinstance(e, OSError):
+                raise
+            raise OSError(f"http response failed: {e!r}") from e
+        if resp.will_close:
+            conn.close()
+            _pool().pop(key, None)
+        return (resp.status, data, dict(resp.headers),
+                resp.getheader("Location"))
+    raise OSError("unreachable")  # pragma: no cover
+
+
+def _pooled_request(method: str, url: str, body, headers: dict,
+                    timeout: float, max_redirects: int = 3):
+    full_url, ctx = _dial(url)
+    for _hop in range(max_redirects):
+        status, data, rheaders, location = _one_pooled_request(
+            method, full_url, body, headers, timeout, ctx)
+        if status in (301, 302, 307, 308) and location and \
+                method in ("GET", "HEAD"):
+            # urllib-parity redirect following for read paths
+            full_url = urllib.parse.urljoin(full_url, location)
+            continue
+        return status, data, rheaders
+    return status, data, rheaders
+
+
 def http_bytes(method: str, url: str, body: bytes | None = None,
                headers: dict | None = None, timeout: float = 60.0
                ) -> tuple[int, bytes, dict]:
-    full_url, ctx = _dial(url)
-    req = urllib.request.Request(
-        full_url, data=body, method=method,
-        headers=_auth_for(url, headers))
-    try:
-        with urllib.request.urlopen(req, timeout=timeout,
-                                    context=ctx) as resp:
-            return resp.status, resp.read(), dict(resp.headers)
-    except urllib.error.HTTPError as e:
-        return e.code, e.read(), dict(e.headers)
+    return _pooled_request(method, url, body,
+                           _auth_for(url, headers), timeout)
