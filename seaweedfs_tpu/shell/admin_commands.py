@@ -351,3 +351,52 @@ def cmd_sleep(env: CommandEnv, args: list[str]) -> str:
     import time
     time.sleep(float(args[0]) if args else 1.0)
     return ""
+
+
+# -- raft membership (shell/command_cluster_raft_*.go) ---------------------
+
+@command("cluster.raft.ps")
+def cmd_cluster_raft_ps(env: CommandEnv, args: list[str]) -> str:
+    """command_cluster_raft_ps.go RaftListClusterServers: membership +
+    replication state of the master raft group."""
+    st = master_json(env.master, "GET", "/cluster/status")
+    raft = st.get("raft", {})
+    lines = [f"leader: {st.get('leader')}  term: {st.get('term')}  "
+             f"topologyId: {st.get('topologyId')}"]
+    for p in st.get("peers", []):
+        mark = "*" if p == st.get("leader") else " "
+        lines.append(f"  {mark} {p}")
+    lines.append(f"log: commit={raft.get('commitIndex')} "
+                 f"applied={raft.get('appliedIndex')} "
+                 f"last={raft.get('lastLogIndex')} "
+                 f"snapshot={raft.get('snapshotIndex')} "
+                 f"persistent={raft.get('persistent')}")
+    return "\n".join(lines)
+
+
+@command("cluster.raft.add")
+def cmd_cluster_raft_add(env: CommandEnv, args: list[str]) -> str:
+    """command_cluster_raft_add.go RaftAddServer (-server=host:port):
+    adds a master to the replicated membership."""
+    opts = _parse_flags(args)
+    server = opts.get("server", "")
+    if not server:
+        return "usage: cluster.raft.add -server=host:port"
+    r = master_json(env.master, "POST", "/cluster/raft/config",
+                    {"add": [server]})
+    _must(r, f"add raft server {server}")
+    return f"members: {', '.join(r['peers'])}"
+
+
+@command("cluster.raft.remove")
+def cmd_cluster_raft_remove(env: CommandEnv, args: list[str]) -> str:
+    """command_cluster_raft_remove.go RaftRemoveServer
+    (-server=host:port)."""
+    opts = _parse_flags(args)
+    server = opts.get("server", "")
+    if not server:
+        return "usage: cluster.raft.remove -server=host:port"
+    r = master_json(env.master, "POST", "/cluster/raft/config",
+                    {"remove": [server]})
+    _must(r, f"remove raft server {server}")
+    return f"members: {', '.join(r['peers'])}"

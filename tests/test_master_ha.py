@@ -102,13 +102,23 @@ def test_leader_kill_failover(ha_cluster):
 
     old_leader = next(m for m in masters if m.raft.is_leader)
     old_tid = old_leader.raft.topology_id
+    old_term = old_leader.raft.term
     old_leader.stop()
     survivors = [m for m in masters if m is not old_leader]
 
     new_leader = _wait_leader(survivors, timeout=10)
     assert new_leader is not old_leader
-    # fencing: a fresh leadership epoch has a fresh topology identity
-    assert new_leader.raft.topology_id != old_tid
+    # round 5 (log replication): the topology identity is durable
+    # cluster state replicated through the raft log — a failover KEEPS
+    # it (master_server.go:256 syncRaftForTopologyId); the leadership
+    # epoch fence is the term
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            not new_leader.raft.fsm_get("topologyId"):
+        time.sleep(0.1)
+    assert new_leader.raft.fsm_get("topologyId") == old_tid
+    assert new_leader.raft.topology_id == old_tid
+    assert new_leader.raft.term > old_term
 
     # volume servers re-dial + re-register; writes work again once the
     # new leader hears heartbeats
