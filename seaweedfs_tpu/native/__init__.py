@@ -74,3 +74,55 @@ def load() -> "ctypes.CDLL | None":
 
 def available() -> bool:
     return load() is not None
+
+
+# -- read-plane library (read_plane.cc) --------------------------------
+
+_RP_SRC = os.path.join(_DIR, "read_plane.cc")
+_RP_SO = os.path.join(_DIR, "_build", "libread_plane.so")
+_rp_lib = None
+_rp_tried = False
+
+
+def load_read_plane() -> "ctypes.CDLL | None":
+    """Build (if needed) + load the native epoll read plane; None when
+    unavailable — the volume server then serves reads from Python
+    only."""
+    global _rp_lib, _rp_tried
+    with _lock:
+        if _rp_lib is not None or _rp_tried:
+            return _rp_lib
+        _rp_tried = True
+        try:
+            os.makedirs(os.path.dirname(_RP_SO), exist_ok=True)
+            if not (os.path.exists(_RP_SO) and
+                    os.path.getmtime(_RP_SO) >=
+                    os.path.getmtime(_RP_SRC)):
+                tmp = f"{_RP_SO}.{os.getpid()}.tmp"
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     "-pthread", _RP_SRC, "-o", tmp],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, _RP_SO)
+            lib = ctypes.CDLL(_RP_SO)
+            lib.rp_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.POINTER(ctypes.c_int)]
+            lib.rp_start.restype = ctypes.c_int
+            lib.rp_stop.argtypes = [ctypes.c_int]
+            lib.rp_add_volume.argtypes = [ctypes.c_int, ctypes.c_uint,
+                                          ctypes.c_char_p]
+            lib.rp_add_volume.restype = ctypes.c_int
+            lib.rp_remove_volume.argtypes = [ctypes.c_int,
+                                             ctypes.c_uint]
+            lib.rp_put.argtypes = [ctypes.c_int, ctypes.c_uint,
+                                   ctypes.c_ulonglong, ctypes.c_uint,
+                                   ctypes.c_ulonglong, ctypes.c_uint]
+            lib.rp_put.restype = ctypes.c_int
+            lib.rp_del.argtypes = [ctypes.c_int, ctypes.c_uint,
+                                   ctypes.c_ulonglong]
+            lib.rp_served.argtypes = [ctypes.c_int]
+            lib.rp_served.restype = ctypes.c_ulonglong
+        except (OSError, subprocess.SubprocessError):
+            return None
+        _rp_lib = lib
+        return _rp_lib

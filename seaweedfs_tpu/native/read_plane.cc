@@ -1,0 +1,459 @@
+// Native HTTP read plane for the volume server — the C++ sibling of the
+// reference's second native implementation of the read surface
+// (seaweed-volume/ Rust volume server, VOLUME_SERVER_RUST_PLAN.md) and
+// of its RDMA read sidecar (seaweedfs-rdma-sidecar/rdma-engine):
+// a single-threaded epoll loop serving `GET /<vid>,<fid>` straight from
+// the .dat file descriptors via sendfile(2), bypassing the Python HTTP
+// stack entirely on the hot read path.
+//
+// Scope (deliberate): plain anonymous needles only — the Python server
+// registers an entry (vid, needle id) -> (cookie, absolute data offset,
+// data length) at write time / on first read, and only for needles with
+// no compression, no name/mime, no TTL and no chunk manifest; anything
+// unregistered answers 404 and the client falls back to the full Python
+// path (same contract as the UDS plane, server/uds_reader.py).  Deletes
+// and vacuum drop entries/volumes; a dropped volume lazily re-registers.
+//
+// Wire behavior: HTTP/1.1, keep-alive, Content-Length framing,
+// ETag "<cookie-hex>", 404 unknown, 400 malformed, 405 non-GET/HEAD.
+//
+// Build: g++ -O2 -shared -fPIC (no deps); driven via ctypes from
+// seaweedfs_tpu/server/read_plane.py.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/sendfile.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Entry {
+  uint32_t cookie;
+  uint64_t off;    // absolute byte offset of the data payload in .dat
+  uint32_t len;    // payload length
+};
+
+struct VolumeIdx {
+  int fd = -1;
+  std::unordered_map<uint64_t, Entry> needles;
+};
+
+struct Conn {
+  int fd;
+  std::string in;          // accumulated request bytes
+  std::string out;         // pending response header bytes
+  int file_fd = -1;        // pending sendfile source (-1 = none)
+  off_t file_off = 0;
+  size_t file_left = 0;
+  bool close_after = false;
+};
+
+struct Server {
+  int epfd = -1;
+  int listen_fd = -1;
+  int wake_pipe[2] = {-1, -1};
+  std::thread loop;
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> served{0};
+  std::shared_mutex idx_mu;
+  std::unordered_map<uint32_t, VolumeIdx> volumes;
+  std::unordered_map<int, Conn*> conns;
+};
+
+constexpr int kMaxServers = 16;
+Server* g_servers[kMaxServers] = {nullptr};
+std::mutex g_servers_mu;
+
+void set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+void close_conn(Server* s, Conn* c) {
+  epoll_ctl(s->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+  close(c->fd);
+  if (c->file_fd >= 0) close(c->file_fd);
+  s->conns.erase(c->fd);
+  delete c;
+}
+
+void arm(Server* s, Conn* c, bool want_write) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | (want_write ? EPOLLOUT : 0);
+  ev.data.fd = c->fd;
+  epoll_ctl(s->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+// parse "<vid>,<keyhex><cookie8hex>" -> vid, key, cookie
+bool parse_fid(const char* p, size_t n, uint32_t* vid, uint64_t* key,
+               uint32_t* cookie) {
+  size_t comma = 0;
+  while (comma < n && p[comma] != ',') comma++;
+  if (comma == 0 || comma >= n) return false;
+  uint64_t v = 0;
+  for (size_t i = 0; i < comma; i++) {
+    if (p[i] < '0' || p[i] > '9') return false;
+    v = v * 10 + (p[i] - '0');
+    if (v > 0xffffffffULL) return false;
+  }
+  const char* hex = p + comma + 1;
+  size_t hn = n - comma - 1;
+  if (hn < 9 || hn > 24) return false;  // >= 1 key nibble + 8 cookie
+  uint64_t k = 0;
+  uint64_t ck = 0;
+  for (size_t i = 0; i < hn; i++) {
+    char ch = hex[i];
+    int d;
+    if (ch >= '0' && ch <= '9') d = ch - '0';
+    else if (ch >= 'a' && ch <= 'f') d = ch - 'a' + 10;
+    else if (ch >= 'A' && ch <= 'F') d = ch - 'A' + 10;
+    else return false;
+    if (i < hn - 8) k = (k << 4) | d;
+    else ck = (ck << 4) | d;
+  }
+  *vid = (uint32_t)v;
+  *key = k;
+  *cookie = (uint32_t)ck;
+  return true;
+}
+
+void respond_simple(Conn* c, const char* status_line) {
+  char buf[160];
+  int n = snprintf(buf, sizeof buf,
+                   "HTTP/1.1 %s\r\nContent-Length: 0\r\n\r\n",
+                   status_line);
+  c->out.append(buf, n);
+}
+
+// returns false when the connection must close (malformed framing)
+bool handle_one_request(Server* s, Conn* c, const std::string& req) {
+  // request line: METHOD SP target SP version
+  size_t sp1 = req.find(' ');
+  size_t sp2 = (sp1 == std::string::npos)
+                   ? std::string::npos
+                   : req.find(' ', sp1 + 1);
+  if (sp2 == std::string::npos) return false;
+  std::string method = req.substr(0, sp1);
+  std::string target = req.substr(sp1 + 1, sp2 - sp1 - 1);
+  bool head = method == "HEAD";
+  if (method != "GET" && !head) {
+    respond_simple(c, "405 Method Not Allowed");
+    return true;
+  }
+  // strip query + leading slash
+  size_t q = target.find('?');
+  if (q != std::string::npos) target.resize(q);
+  if (target.empty() || target[0] != '/') {
+    respond_simple(c, "400 Bad Request");
+    return true;
+  }
+  uint32_t vid, cookie;
+  uint64_t key;
+  if (!parse_fid(target.data() + 1, target.size() - 1, &vid, &key,
+                 &cookie)) {
+    respond_simple(c, "404 Not Found");
+    return true;
+  }
+  int fd = -1;
+  Entry e{};
+  {
+    std::shared_lock<std::shared_mutex> lk(s->idx_mu);
+    auto vit = s->volumes.find(vid);
+    if (vit != s->volumes.end() && vit->second.fd >= 0) {
+      auto nit = vit->second.needles.find(key);
+      if (nit != vit->second.needles.end() &&
+          nit->second.cookie == cookie) {
+        // dup under the lock: rp_remove_volume/rp_add_volume may
+        // close the volume fd concurrently; the connection owns its
+        // duplicate for the lifetime of the sendfile
+        fd = dup(vit->second.fd);
+        e = nit->second;
+      }
+    }
+  }
+  if (fd < 0) {
+    respond_simple(c, "404 Not Found");
+    return true;
+  }
+  char hdr[224];
+  int hn = snprintf(hdr, sizeof hdr,
+                    "HTTP/1.1 200 OK\r\n"
+                    "Content-Type: application/octet-stream\r\n"
+                    "Content-Length: %u\r\n"
+                    "ETag: \"%08x\"\r\n"
+                    "Accept-Ranges: bytes\r\n\r\n",
+                    e.len, cookie);
+  c->out.append(hdr, hn);
+  if (!head && e.len > 0) {
+    c->file_fd = fd;           // owned (dup); closed when drained
+    c->file_off = (off_t)e.off;
+    c->file_left = e.len;
+  } else {
+    close(fd);
+  }
+  s->served.fetch_add(1, std::memory_order_relaxed);
+  return true;
+}
+
+// drain pending output; returns false on fatal error
+bool flush_out(Server* s, Conn* c) {
+  while (!c->out.empty()) {
+    ssize_t n = send(c->fd, c->out.data(), c->out.size(), MSG_NOSIGNAL);
+    if (n > 0) {
+      c->out.erase(0, (size_t)n);
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return true;
+    return false;
+  }
+  while (c->file_left > 0) {
+    ssize_t n = sendfile(c->fd, c->file_fd, &c->file_off,
+                         c->file_left > (1 << 20) ? (1 << 20)
+                                                  : c->file_left);
+    if (n > 0) {
+      c->file_left -= (size_t)n;
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return true;
+    return false;
+  }
+  if (c->file_fd >= 0) {
+    close(c->file_fd);
+    c->file_fd = -1;
+  }
+  return true;
+}
+
+void event_loop(Server* s) {
+  epoll_event evs[64];
+  while (!s->stop.load(std::memory_order_relaxed)) {
+    int n = epoll_wait(s->epfd, evs, 64, 500);
+    for (int i = 0; i < n; i++) {
+      int fd = evs[i].data.fd;
+      if (fd == s->wake_pipe[0]) {
+        char tmp[16];
+        (void)!read(fd, tmp, sizeof tmp);
+        continue;
+      }
+      if (fd == s->listen_fd) {
+        for (;;) {
+          int cfd = accept4(s->listen_fd, nullptr, nullptr,
+                            SOCK_NONBLOCK);
+          if (cfd < 0) break;
+          int one = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+          Conn* c = new Conn{cfd};
+          s->conns[cfd] = c;
+          epoll_event ev{};
+          ev.events = EPOLLIN;
+          ev.data.fd = cfd;
+          epoll_ctl(s->epfd, EPOLL_CTL_ADD, cfd, &ev);
+        }
+        continue;
+      }
+      auto it = s->conns.find(fd);
+      if (it == s->conns.end()) continue;
+      Conn* c = it->second;
+      bool dead = false;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        dead = true;
+      }
+      if (!dead && (evs[i].events & EPOLLIN)) {
+        char buf[8192];
+        for (;;) {
+          ssize_t r = recv(fd, buf, sizeof buf, 0);
+          if (r > 0) {
+            c->in.append(buf, (size_t)r);
+            if (c->in.size() > (64 << 10)) {  // header flood guard
+              dead = true;
+              break;
+            }
+            continue;
+          }
+          if (r == 0) {
+            dead = c->in.empty() && c->out.empty() &&
+                   c->file_left == 0;
+            c->close_after = true;
+            break;
+          }
+          if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+          dead = true;
+          break;
+        }
+        // process complete requests (pipelining-tolerant), but only
+        // while no body transfer is pending — responses must be
+        // emitted in order
+        while (!dead && c->file_left == 0) {
+          size_t end = c->in.find("\r\n\r\n");
+          if (end == std::string::npos) break;
+          std::string req = c->in.substr(0, end);
+          c->in.erase(0, end + 4);
+          if (!handle_one_request(s, c, req)) {
+            dead = true;
+            break;
+          }
+        }
+      }
+      if (!dead && !flush_out(s, c)) dead = true;
+      if (!dead && c->close_after && c->out.empty() &&
+          c->file_left == 0) {
+        dead = true;
+      }
+      if (dead) {
+        close_conn(s, c);
+      } else {
+        arm(s, c, !c->out.empty() || c->file_left > 0);
+      }
+    }
+  }
+  // teardown
+  for (auto& kv : s->conns) {
+    close(kv.second->fd);
+    if (kv.second->file_fd >= 0) close(kv.second->file_fd);
+    delete kv.second;
+  }
+  s->conns.clear();
+}
+
+}  // namespace
+
+extern "C" {
+
+int rp_start(const char* host, int port, int* bound_port) {
+  int slot = -1;
+  {
+    std::lock_guard<std::mutex> lk(g_servers_mu);
+    for (int i = 0; i < kMaxServers; i++) {
+      if (g_servers[i] == nullptr) {
+        slot = i;
+        break;
+      }
+    }
+    if (slot < 0) return -1;
+    g_servers[slot] = new Server();
+  }
+  Server* s = g_servers[slot];
+  s->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (s->listen_fd < 0) return -1;
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) return -1;
+  if (bind(s->listen_fd, (sockaddr*)&addr, sizeof addr) < 0 ||
+      listen(s->listen_fd, 512) < 0) {
+    close(s->listen_fd);
+    return -1;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(s->listen_fd, (sockaddr*)&addr, &alen);
+  *bound_port = ntohs(addr.sin_port);
+  s->epfd = epoll_create1(0);
+  if (pipe2(s->wake_pipe, O_NONBLOCK) < 0) return -1;
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = s->listen_fd;
+  epoll_ctl(s->epfd, EPOLL_CTL_ADD, s->listen_fd, &ev);
+  ev.data.fd = s->wake_pipe[0];
+  epoll_ctl(s->epfd, EPOLL_CTL_ADD, s->wake_pipe[0], &ev);
+  s->loop = std::thread(event_loop, s);
+  return slot;
+}
+
+void rp_stop(int h) {
+  Server* s;
+  {
+    std::lock_guard<std::mutex> lk(g_servers_mu);
+    if (h < 0 || h >= kMaxServers || g_servers[h] == nullptr) return;
+    s = g_servers[h];
+    g_servers[h] = nullptr;
+  }
+  s->stop.store(true);
+  (void)!write(s->wake_pipe[1], "x", 1);
+  s->loop.join();
+  close(s->listen_fd);
+  close(s->epfd);
+  close(s->wake_pipe[0]);
+  close(s->wake_pipe[1]);
+  {
+    std::unique_lock<std::shared_mutex> lk(s->idx_mu);
+    for (auto& kv : s->volumes) {
+      if (kv.second.fd >= 0) close(kv.second.fd);
+    }
+  }
+  delete s;
+}
+
+static Server* get_server(int h) {
+  std::lock_guard<std::mutex> lk(g_servers_mu);
+  if (h < 0 || h >= kMaxServers) return nullptr;
+  return g_servers[h];
+}
+
+int rp_add_volume(int h, unsigned vid, const char* dat_path) {
+  Server* s = get_server(h);
+  if (s == nullptr) return -1;
+  int fd = open(dat_path, O_RDONLY);
+  if (fd < 0) return -1;
+  std::unique_lock<std::shared_mutex> lk(s->idx_mu);
+  VolumeIdx& v = s->volumes[vid];
+  if (v.fd >= 0) close(v.fd);  // refresh (post-vacuum fd swap)
+  v.fd = fd;
+  v.needles.clear();
+  return 0;
+}
+
+void rp_remove_volume(int h, unsigned vid) {
+  Server* s = get_server(h);
+  if (s == nullptr) return;
+  std::unique_lock<std::shared_mutex> lk(s->idx_mu);
+  auto it = s->volumes.find(vid);
+  if (it != s->volumes.end()) {
+    if (it->second.fd >= 0) close(it->second.fd);
+    s->volumes.erase(it);
+  }
+}
+
+int rp_put(int h, unsigned vid, unsigned long long nid,
+           unsigned cookie, unsigned long long data_off,
+           unsigned data_len) {
+  Server* s = get_server(h);
+  if (s == nullptr) return -1;
+  std::unique_lock<std::shared_mutex> lk(s->idx_mu);
+  auto it = s->volumes.find(vid);
+  if (it == s->volumes.end() || it->second.fd < 0) return -1;
+  it->second.needles[nid] = Entry{cookie, data_off, data_len};
+  return 0;
+}
+
+void rp_del(int h, unsigned vid, unsigned long long nid) {
+  Server* s = get_server(h);
+  if (s == nullptr) return;
+  std::unique_lock<std::shared_mutex> lk(s->idx_mu);
+  auto it = s->volumes.find(vid);
+  if (it != s->volumes.end()) it->second.needles.erase(nid);
+}
+
+unsigned long long rp_served(int h) {
+  Server* s = get_server(h);
+  return s == nullptr ? 0 : s->served.load();
+}
+
+}  // extern "C"

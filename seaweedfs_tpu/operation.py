@@ -225,26 +225,58 @@ _uds_probe: dict[str, "str | None"] = {}
 _uds_lock = threading.Lock()
 
 
+def _server_status(url: str) -> dict:
+    """Cached /status probe per volume server (fast-path discovery:
+    udsPath + readPlanePort)."""
+    with _uds_lock:
+        if url in _uds_probe:
+            return _uds_probe[url]
+    try:
+        st, body, _ = http_bytes("GET", f"{url}/status", timeout=5)
+        doc = json.loads(body) if st == 200 else {}
+    except (OSError, ValueError):
+        doc = {}
+    with _uds_lock:
+        _uds_probe[url] = doc
+    return doc
+
+
 def _uds_path_for(url: str) -> "str | None":
     """The volume server's UDS read socket when it is reachable from
     THIS host (same machine / shared filesystem namespace); cached per
     server.  None = use HTTP."""
     import os
-    with _uds_lock:
-        if url in _uds_probe:
-            return _uds_probe[url]
-    path: "str | None" = None
-    try:
-        st, body, _ = http_bytes("GET", f"{url}/status", timeout=5)
-        if st == 200:
-            p = json.loads(body).get("udsPath") or ""
-            if p and os.path.exists(p):
-                path = p
-    except (OSError, ValueError):
-        path = None
-    with _uds_lock:
-        _uds_probe[url] = path
-    return path
+    p = _server_status(url).get("udsPath") or ""
+    return p if p and os.path.exists(p) else None
+
+
+def _read_plane_addr_for(url: str) -> "str | None":
+    """host:port of the server's native C++ read plane
+    (server/read_plane.py), or None."""
+    port = _server_status(url).get("readPlanePort") or 0
+    if not port:
+        return None
+    host = url.split("://")[-1].rsplit(":", 1)[0]
+    return f"{host}:{port}"
+
+
+def _read_via_read_plane(locs, fid: str) -> "bytes | None":
+    """Native read-plane fast path (TCP, cross-host): plain needles
+    come back 200 from the C++ plane; anything it doesn't serve
+    (unregistered, compressed, named, ttl'd) 404s and the caller falls
+    through to the main HTTP port."""
+    for loc in locs:
+        addr = _read_plane_addr_for(loc["url"])
+        if not addr:
+            continue
+        try:
+            status, body, _ = http_bytes("GET", f"{addr}/{fid}",
+                                         timeout=10)
+        except OSError:
+            continue
+        if status == 200:
+            return body
+    return None
 
 
 def _read_via_uds(locs, vid: int, key: int, cookie: int
@@ -290,6 +322,11 @@ def read(master: str, fid: str, offset: int = 0,
         except (IndexError, ValueError):
             key = cookie = -1
         if key >= 0:
+            # native C++ read plane first (works cross-host, serves
+            # via kernel sendfile); UDS second (same-host only)
+            data = _read_via_read_plane(locs, fid)
+            if data is not None:
+                return data
             data = _read_via_uds(locs, vid, key, cookie)
             if data is not None:
                 return data

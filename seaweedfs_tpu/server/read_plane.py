@@ -1,0 +1,87 @@
+"""Native HTTP read plane wrapper (native/read_plane.cc).
+
+The volume server's second implementation of the needle-read surface —
+the role the reference fills with its Rust volume server
+(seaweed-volume/, VOLUME_SERVER_RUST_PLAN.md) and RDMA read sidecar
+(seaweedfs-rdma-sidecar/): a C++ epoll loop answering `GET /vid,fid`
+with sendfile(2) from the .dat fd, no Python on the hot path.
+
+Registration contract: only PLAIN needles are registered (no
+compression, no name/mime/pairs, no TTL, not a chunk manifest) — the
+plane serves raw payload bytes with octet-stream headers, so any
+needle whose HTTP semantics need Python (gzip encoding, mime,
+expiry) stays unregistered and the plane 404s it; clients fall back
+to the main port.  Entries are added at write time and on first
+Python read (lazy warm), dropped on delete; vacuum/EC swap drops the
+whole volume (it lazily re-registers against the fresh fd).
+
+Cross-implementation parity is tested the way the reference tests its
+Rust server against Go (test/volume_server/rust/): the same requests
+are issued to both planes and byte-compared (tests/test_read_plane.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from .. import native
+from ..storage import types as storage_types
+
+# byte offset of the data payload inside a needle record:
+# header (cookie 4 + id 8 + size 4) + DataSize field (4)
+_DATA_OFFSET_IN_RECORD = storage_types.NEEDLE_HEADER_SIZE + 4
+
+
+def needle_is_plain(n) -> bool:
+    """True when the needle's HTTP semantics are fully captured by raw
+    payload bytes + octet-stream headers."""
+    return not (n.is_compressed() or n.is_chunked_manifest() or
+                n.has_ttl() or n.has_name() or n.has_mime() or
+                n.has_pairs())
+
+
+class ReadPlane:
+    """One native read-plane server bound to 127.0.0.1:<ephemeral>."""
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self._lib = native.load_read_plane()
+        if self._lib is None:
+            raise RuntimeError("native read plane unavailable")
+        port = ctypes.c_int(0)
+        self._h = self._lib.rp_start(host.encode(), 0,
+                                     ctypes.byref(port))
+        if self._h < 0:
+            raise RuntimeError("read plane failed to start")
+        self.host = host
+        self.port = port.value
+
+    # -- index maintenance (called from the volume server) -------------
+
+    def add_volume(self, vid: int, dat_path: str) -> bool:
+        return self._lib.rp_add_volume(self._h, vid,
+                                       dat_path.encode()) == 0
+
+    def remove_volume(self, vid: int) -> None:
+        self._lib.rp_remove_volume(self._h, vid)
+
+    def register_needle(self, vid: int, stored_offset: int,
+                        needle) -> None:
+        """Register a parsed needle at its .idx stored offset; silently
+        skips non-plain needles and unregistered volumes."""
+        if not needle_is_plain(needle):
+            return
+        data_off = storage_types.to_actual_offset(stored_offset) + \
+            _DATA_OFFSET_IN_RECORD
+        self._lib.rp_put(self._h, vid, needle.id, needle.cookie,
+                         data_off, len(needle.data))
+
+    def delete_needle(self, vid: int, needle_id: int) -> None:
+        self._lib.rp_del(self._h, vid, needle_id)
+
+    def served(self) -> int:
+        return self._lib.rp_served(self._h)
+
+    def stop(self) -> None:
+        if self._h >= 0:
+            self._lib.rp_stop(self._h)
+            self._h = -1

@@ -120,6 +120,22 @@ class VolumeServer:
                                                   sock).start()
             except OSError:  # pragma: no cover — no AF_UNIX
                 self.uds_server = None
+        # native TCP read plane (the C++ second implementation of the
+        # needle-read surface — seaweed-volume/ Rust server +
+        # rdma-sidecar role, native/read_plane.cc): plain needles are
+        # served by an epoll+sendfile loop; port advertised in /status
+        # (readPlanePort).  Same auth rule as the UDS plane.
+        self.read_plane = None
+        self._rp_volumes: set[int] = set()
+        self._rp_lock = threading.Lock()
+        self._rp_gen: dict[int, int] = {}
+        self._rp_seen: dict[int, set] = {}
+        if not self.security.volume_read_key:
+            try:
+                from .read_plane import ReadPlane
+                self.read_plane = ReadPlane(self.http.host)
+            except (RuntimeError, OSError):
+                self.read_plane = None
         # gRPC wire plane (volume_server.proto subset) — optional;
         # JSON-HTTP stays the always-on surface
         try:
@@ -139,8 +155,60 @@ class VolumeServer:
         self._hb_thread.start()
         return self
 
+    def _rp_register(self, vid: int, needle,
+                     lazy: bool = False) -> None:
+        """Mirror a plain needle into the native read plane (write
+        path + lazy on-read warm); no-ops without the plane.
+
+        Epoch-checked against _rp_drop_volume: the needle offset is
+        read AFTER snapshotting the volume's drop generation and the
+        plane entry lands only if no drop intervened — otherwise a
+        lazy warm racing a vacuum could re-bind pre-compaction offsets
+        against the post-compaction .dat (silent wrong bytes)."""
+        rp = self.read_plane
+        if rp is None:
+            return
+        if lazy and needle.id in self._rp_seen.get(vid, ()):
+            return      # already warm: skip the flush + native call
+        v = self.store.find_volume(vid)
+        if v is None or getattr(v, "version", 2) < 2:
+            return      # v1 records lack the DataSize field the
+            # plane's offset math assumes
+        with self._rp_lock:
+            gen = self._rp_gen.get(vid, 0)
+        got = v.nm.get(needle.id)
+        if got is None:
+            return
+        # the plane reads its own fd: buffered appends must reach the
+        # OS file before the entry is servable
+        v.flush()
+        with self._rp_lock:
+            if self._rp_gen.get(vid, 0) != gen:
+                return  # dropped (vacuum/delete) after our offset read
+            if vid not in self._rp_volumes:
+                try:
+                    if not rp.add_volume(vid, v.file_name(".dat")):
+                        return
+                except OSError:
+                    return
+                self._rp_volumes.add(vid)
+            rp.register_needle(vid, got[0], needle)
+            self._rp_seen.setdefault(vid, set()).add(needle.id)
+
+    def _rp_drop_volume(self, vid: int) -> None:
+        """Forget a volume in the read plane (vacuum swapped the .dat,
+        or the volume is gone); live needles lazily re-register."""
+        if self.read_plane is not None:
+            with self._rp_lock:
+                self._rp_gen[vid] = self._rp_gen.get(vid, 0) + 1
+                self.read_plane.remove_volume(vid)
+                self._rp_volumes.discard(vid)
+                self._rp_seen.pop(vid, None)
+
     def stop(self):
         self._hb_stop.set()
+        if getattr(self, "read_plane", None) is not None:
+            self.read_plane.stop()
         if getattr(self, "uds_server", None) is not None:
             self.uds_server.stop()
         if getattr(self, "grpc_server", None) is not None:
@@ -262,6 +330,7 @@ class VolumeServer:
             return 404, {"error": "not found"}
         except ValueError as e:
             return 404, {"error": str(e)}
+        self._rp_register(fid.volume_id, n, lazy=True)  # plane warm
         mime = n.mime.decode() if n.mime else "application/octet-stream"
         data = n.data
         if query and ("width" in query or "height" in query):
@@ -316,6 +385,7 @@ class VolumeServer:
             return 404, {"error": f"volume {fid.volume_id} not found"}
         except PermissionError as e:
             return 409, {"error": str(e)}
+        self._rp_register(fid.volume_id, n)
         # synchronous replication fan-out
         # (topology/store_replicate.go:27 ReplicatedWrite); forward the
         # original Content-Type and stamp ts so every replica writes a
@@ -336,6 +406,8 @@ class VolumeServer:
                      "unchanged": unchanged}
 
     def _delete_needle(self, fid: types.FileId, req: Request):
+        if self.read_plane is not None:
+            self.read_plane.delete_needle(fid.volume_id, fid.key)
         try:
             freed = self.store.delete_needle(
                 fid.volume_id, Needle(cookie=fid.cookie, id=fid.key))
@@ -429,8 +501,10 @@ class VolumeServer:
 
     def _status(self, req: Request):
         uds = getattr(self, "uds_server", None)
+        rp = getattr(self, "read_plane", None)
         return 200, {"version": "seaweedfs-tpu/0.1",
                      "udsPath": uds.sock_path if uds else "",
+                     "readPlanePort": rp.port if rp else 0,
                      **self.store.collect_heartbeat()}
 
     # -- volume admin -----------------------------------------------------
@@ -447,7 +521,9 @@ class VolumeServer:
         return 200, {}
 
     def _delete_volume(self, req: Request):
-        self.store.delete_volume(int(req.json()["volumeId"]))
+        vid = int(req.json()["volumeId"])
+        self._rp_drop_volume(vid)
+        self.store.delete_volume(vid)
         self._heartbeat_once()
         return 200, {}
 
@@ -459,7 +535,9 @@ class VolumeServer:
         return 200, {}
 
     def _unmount_volume(self, req: Request):
-        self.store.unmount_volume(int(req.json()["volumeId"]))
+        vid = int(req.json()["volumeId"])
+        self._rp_drop_volume(vid)
+        self.store.unmount_volume(vid)
         return 200, {}
 
     def _set_readonly(self, req: Request):
@@ -489,10 +567,15 @@ class VolumeServer:
 
     def _vacuum(self, req: Request):
         """volume_server.proto VacuumVolume{Check,Compact,Commit}."""
-        v = self.store.find_volume(int(req.json()["volumeId"]))
+        vid = int(req.json()["volumeId"])
+        v = self.store.find_volume(vid)
         if v is None:
             return 404, {"error": "volume not found"}
         garbage = v.garbage_level()
+        # compaction rewrites the .dat (offsets move): drop the read
+        # plane's index FIRST so no stale (offset,len) can be served
+        # against the swapped file; survivors lazily re-register
+        self._rp_drop_volume(vid)
         v.vacuum()
         return 200, {"garbageRatio": garbage}
 
@@ -638,6 +721,8 @@ class VolumeServer:
         v = self.store.find_volume(vid)
         if v is None:
             return 404, {"error": f"volume {vid} not found"}
+        if self.read_plane is not None:
+            self.read_plane.delete_needle(vid, key)
         try:
             n = v.read_needle(key)
         except KeyError:
@@ -683,6 +768,7 @@ class VolumeServer:
             # struct.error: truncated body/CRC tail is not a ValueError
             return 400, {"error": f"bad needle record: {e}"}
         size, _ = self.store.write_needle(vid, n)
+        self._rp_register(vid, n)
         return 200, {"size": size}
 
     def _read_volume_file(self, req: Request):

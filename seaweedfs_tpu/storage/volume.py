@@ -191,6 +191,17 @@ class Volume:
         self._dat.write(n.to_bytes(self.version))
         return offset
 
+    def flush(self) -> None:
+        """Flush buffered .dat appends to the OS file so OUT-OF-HANDLE
+        readers (the native read plane's fd, sendfile paths) see them;
+        the in-process read path shares the buffered handle and never
+        needs this.  Near-free when nothing is pending."""
+        with self.lock:
+            try:
+                self._dat.flush()
+            except AttributeError:  # tiered RemoteDatFile
+                pass
+
     def delete_needle(self, n: Needle) -> int:
         """Appends a zero-data tombstone record then tombstones the map
         (volume_write.go:222 doDeleteRequest).  Returns freed size."""
