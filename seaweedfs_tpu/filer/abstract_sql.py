@@ -1,0 +1,200 @@
+"""Abstract-SQL FilerStore family (weed/filer/abstract_sql/
+abstract_sql_store.go): ONE store implementation over a DB-API
+connection + a dialect, the layer that powers the reference's
+mysql/mysql2/postgres/postgres2/sqlite stores.
+
+The schema is the reference's filemeta shape — (directory, name)
+primary key with an opaque meta blob — and every query funnels through
+the dialect so placeholder style, upsert syntax and LIKE escaping can
+vary per engine without touching store logic.
+
+Concrete dialects:
+- SqliteDialect — used by filer_store.SqliteStore (the default filer
+  store, always available).
+- MysqlDialect / PostgresDialect — the reference's `%s`-placeholder
+  engines.  The image ships no client drivers, so `connect()` raises
+  with guidance; the dialect SQL itself is exercised by
+  tests/test_filer_stores.py rendering queries against both dialects.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from .entry import Entry, normalize_path
+from .filer_store import FilerStore
+
+
+class SqlDialect:
+    """Placeholder + syntax hooks (abstract_sql GenSqlInsert etc.)."""
+
+    name = "generic"
+    placeholder = "?"
+
+    def create_table_sql(self) -> list[str]:
+        return [
+            "CREATE TABLE IF NOT EXISTS filemeta ("
+            " directory TEXT NOT NULL,"
+            " name TEXT NOT NULL,"
+            " meta TEXT NOT NULL,"
+            " PRIMARY KEY (directory, name))",
+        ]
+
+    def upsert_sql(self) -> str:
+        p = self.placeholder
+        return (f"INSERT OR REPLACE INTO filemeta (directory, name, "
+                f"meta) VALUES ({p}, {p}, {p})")
+
+    def find_sql(self) -> str:
+        p = self.placeholder
+        return ("SELECT meta FROM filemeta WHERE directory=" + p +
+                " AND name=" + p)
+
+    def delete_sql(self) -> str:
+        p = self.placeholder
+        return ("DELETE FROM filemeta WHERE directory=" + p +
+                " AND name=" + p)
+
+    def delete_tree_sql(self) -> str:
+        p = self.placeholder
+        return ("DELETE FROM filemeta WHERE directory=" + p +
+                r" OR directory LIKE " + p + r" ESCAPE '\'")
+
+    def list_sql(self, include_start: bool, prefix: bool) -> str:
+        p = self.placeholder
+        op = ">=" if include_start else ">"
+        q = ("SELECT meta FROM filemeta WHERE directory=" + p +
+             f" AND name {op} " + p + " ")
+        if prefix:
+            q += r"AND name LIKE " + p + r" ESCAPE '\' "
+        q += "ORDER BY name LIMIT " + p
+        return q
+
+    @staticmethod
+    def like_escape(s: str) -> str:
+        r"""Escape LIKE wildcards; every LIKE uses ESCAPE '\'."""
+        return s.replace("\\", "\\\\").replace("%", r"\%") \
+                .replace("_", r"\_")
+
+    def connect(self, **kw):
+        raise NotImplementedError
+
+
+class SqliteDialect(SqlDialect):
+    name = "sqlite"
+
+    def connect(self, path: str = ":memory:", **kw):
+        import sqlite3
+        return sqlite3.connect(path, check_same_thread=False)
+
+
+class MysqlDialect(SqlDialect):
+    name = "mysql"
+    placeholder = "%s"
+
+    def create_table_sql(self) -> list[str]:
+        return [
+            "CREATE TABLE IF NOT EXISTS filemeta ("
+            " directory VARCHAR(512) NOT NULL,"
+            " name VARCHAR(512) NOT NULL,"
+            " meta LONGTEXT NOT NULL,"
+            " PRIMARY KEY (directory, name))",
+        ]
+
+    def upsert_sql(self) -> str:
+        return ("INSERT INTO filemeta (directory, name, meta) "
+                "VALUES (%s, %s, %s) "
+                "ON DUPLICATE KEY UPDATE meta=VALUES(meta)")
+
+    def connect(self, **kw):
+        raise NotImplementedError(
+            "no mysql client driver in this environment; point an "
+            "AbstractSqlStore at a DB-API connection from "
+            "mysql-connector/PyMySQL where available")
+
+
+class PostgresDialect(SqlDialect):
+    name = "postgres"
+    placeholder = "%s"
+
+    def upsert_sql(self) -> str:
+        return ("INSERT INTO filemeta (directory, name, meta) "
+                "VALUES (%s, %s, %s) "
+                "ON CONFLICT (directory, name) "
+                "DO UPDATE SET meta=EXCLUDED.meta")
+
+    def connect(self, **kw):
+        raise NotImplementedError(
+            "no postgres client driver in this environment; point an "
+            "AbstractSqlStore at a DB-API connection from psycopg "
+            "where available")
+
+
+class AbstractSqlStore(FilerStore):
+    """The single store body shared by every SQL engine."""
+
+    def __init__(self, conn, dialect: "SqlDialect | None" = None):
+        self._db = conn
+        self.dialect = dialect or SqliteDialect()
+        self._lock = threading.RLock()
+        with self._lock:
+            for stmt in self.dialect.create_table_sql():
+                self._db.execute(stmt)
+            self._db.commit()
+
+    def insert_entry(self, entry: Entry) -> None:
+        with self._lock:
+            self._db.execute(
+                self.dialect.upsert_sql(),
+                (entry.parent, entry.name,
+                 json.dumps(entry.to_json())))
+            self._db.commit()
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> "Entry | None":
+        path = normalize_path(path)
+        if path == "/":
+            return Entry("/", is_directory=True)
+        parent, name = path.rsplit("/", 1)
+        with self._lock:
+            row = self._db.execute(
+                self.dialect.find_sql(),
+                (parent or "/", name)).fetchone()
+        return Entry.from_json(json.loads(row[0])) if row else None
+
+    def delete_entry(self, path: str) -> None:
+        path = normalize_path(path)
+        parent, name = path.rsplit("/", 1)
+        with self._lock:
+            self._db.execute(self.dialect.delete_sql(),
+                             (parent or "/", name))
+            self._db.commit()
+
+    def delete_folder_children(self, path: str) -> None:
+        path = normalize_path(path)
+        with self._lock:
+            self._db.execute(
+                self.dialect.delete_tree_sql(),
+                (path, self.dialect.like_escape(path) + "/%"))
+            self._db.commit()
+
+    def list_directory_entries(self, dir_path: str,
+                               start_file: str = "",
+                               include_start: bool = False,
+                               limit: int = 1000,
+                               prefix: str = "") -> list[Entry]:
+        dir_path = normalize_path(dir_path)
+        args: list = [dir_path, start_file]
+        if prefix:
+            args.append(self.dialect.like_escape(prefix) + "%")
+        args.append(limit)
+        with self._lock:
+            rows = self._db.execute(
+                self.dialect.list_sql(include_start, bool(prefix)),
+                args).fetchall()
+        return [Entry.from_json(json.loads(r[0])) for r in rows]
+
+    def close(self) -> None:
+        self._db.close()

@@ -10,7 +10,7 @@ durable single-node default with real prefix-scans.
 from __future__ import annotations
 
 import json
-import sqlite3
+
 import threading
 
 from .entry import Entry, normalize_path
@@ -98,86 +98,20 @@ class MemoryStore(FilerStore):
             return out
 
 
-class SqliteStore(FilerStore):
-    """abstract_sql-family store: one table keyed (directory, name)."""
+# imported AFTER FilerStore exists (abstract_sql imports it back)
+from .abstract_sql import (AbstractSqlStore, SqlDialect,  # noqa: E402
+                           SqliteDialect)
+
+
+class SqliteStore(AbstractSqlStore):
+    """abstract_sql-family store: one table keyed (directory, name).
+    The always-available engine of the AbstractSqlStore family
+    (filer/abstract_sql.py; reference weed/filer/sqlite/ over
+    weed/filer/abstract_sql/)."""
 
     def __init__(self, path: str = ":memory:"):
-        self._db = sqlite3.connect(path, check_same_thread=False)
-        self._lock = threading.RLock()
-        self._db.execute(
-            "CREATE TABLE IF NOT EXISTS filemeta ("
-            " directory TEXT NOT NULL,"
-            " name TEXT NOT NULL,"
-            " meta TEXT NOT NULL,"
-            " PRIMARY KEY (directory, name))")
-        self._db.execute(
-            "CREATE INDEX IF NOT EXISTS filemeta_dir "
-            "ON filemeta (directory, name)")
-        self._db.commit()
+        dialect = SqliteDialect()
+        super().__init__(dialect.connect(path), dialect)
 
-    def insert_entry(self, entry: Entry) -> None:
-        with self._lock:
-            self._db.execute(
-                "INSERT OR REPLACE INTO filemeta "
-                "(directory, name, meta) VALUES (?, ?, ?)",
-                (entry.parent, entry.name,
-                 json.dumps(entry.to_json())))
-            self._db.commit()
-
-    update_entry = insert_entry
-
-    def find_entry(self, path: str) -> Entry | None:
-        path = normalize_path(path)
-        if path == "/":
-            return Entry("/", is_directory=True)
-        parent, name = path.rsplit("/", 1)
-        with self._lock:
-            row = self._db.execute(
-                "SELECT meta FROM filemeta WHERE directory=? AND name=?",
-                (parent or "/", name)).fetchone()
-        return Entry.from_json(json.loads(row[0])) if row else None
-
-    def delete_entry(self, path: str) -> None:
-        path = normalize_path(path)
-        parent, name = path.rsplit("/", 1)
-        with self._lock:
-            self._db.execute(
-                "DELETE FROM filemeta WHERE directory=? AND name=?",
-                (parent or "/", name))
-            self._db.commit()
-
-    @staticmethod
-    def _like_escape(s: str) -> str:
-        r"""Escape LIKE wildcards; every LIKE here uses ESCAPE '\'."""
-        return s.replace("\\", "\\\\").replace("%", r"\%") \
-                .replace("_", r"\_")
-
-    def delete_folder_children(self, path: str) -> None:
-        path = normalize_path(path)
-        with self._lock:
-            self._db.execute(
-                "DELETE FROM filemeta WHERE directory=? OR "
-                r"directory LIKE ? ESCAPE '\'",
-                (path, self._like_escape(path) + "/%"))
-            self._db.commit()
-
-    def list_directory_entries(self, dir_path: str, start_file: str = "",
-                               include_start: bool = False,
-                               limit: int = 1000,
-                               prefix: str = "") -> list[Entry]:
-        dir_path = normalize_path(dir_path)
-        op = ">=" if include_start else ">"
-        q = ("SELECT meta FROM filemeta WHERE directory=? AND "
-             f"name {op} ? ")
-        args: list = [dir_path, start_file]
-        if prefix:
-            q += r"AND name LIKE ? ESCAPE '\' "
-            args.append(self._like_escape(prefix) + "%")
-        q += "ORDER BY name LIMIT ?"
-        args.append(limit)
-        with self._lock:
-            rows = self._db.execute(q, args).fetchall()
-        return [Entry.from_json(json.loads(r[0])) for r in rows]
-
-    def close(self) -> None:
-        self._db.close()
+    # kept for callers/tests that exercised the escaping directly
+    _like_escape = staticmethod(SqlDialect.like_escape)

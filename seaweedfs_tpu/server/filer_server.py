@@ -44,9 +44,19 @@ class FilerServer:
             store = LsmStore(store_path)
         elif store_type == "sqlite":
             store = SqliteStore(store_path)
+        elif store_type == "redis":
+            # store_path = host:port of a RESP server
+            # (filer/redis_store.py; reference weed/filer/redis2)
+            from ..filer.redis_store import RedisFilerStore, RespClient
+            r_host, _, r_port = store_path.rpartition(":")
+            if not r_host or not r_port.isdigit():
+                raise ValueError(
+                    "-storeType redis needs -store host:port of a "
+                    "RESP server")
+            store = RedisFilerStore(RespClient(r_host, int(r_port)))
         else:
             raise ValueError(f"unknown filer store type "
-                             f"{store_type!r} (sqlite|lsm)")
+                             f"{store_type!r} (sqlite|lsm|redis)")
         self.filer = Filer(master, store,
                            collection=collection,
                            replication=replication,

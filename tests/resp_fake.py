@@ -1,0 +1,163 @@
+"""Mini RESP2 server for exercising RedisFilerStore against an
+EXTERNAL PROCESS (run with `python resp_fake.py <port>`), the way the
+reference CI runs its redis stores against a service container.
+
+Implements exactly the command subset the store uses — PING, SET, GET,
+DEL, ZADD, ZREM, ZRANGEBYLEX (with LIMIT), FLUSHALL — with real RESP
+framing, so the client's protocol code is tested for real; pointing
+RespClient at an actual redis-server works identically.
+"""
+
+import socket
+import sys
+import threading
+
+
+class Store:
+    def __init__(self):
+        self.kv = {}
+        self.zsets = {}
+        self.lock = threading.Lock()
+
+    def execute(self, args):
+        cmd = args[0].upper()
+        with self.lock:
+            if cmd == b"PING":
+                return "+PONG"
+            if cmd == b"FLUSHALL":
+                self.kv.clear()
+                self.zsets.clear()
+                return "+OK"
+            if cmd == b"SET":
+                self.kv[args[1]] = args[2]
+                return "+OK"
+            if cmd == b"GET":
+                v = self.kv.get(args[1])
+                return v  # bulk or nil
+            if cmd == b"DEL":
+                n = 0
+                for k in args[1:]:
+                    if self.kv.pop(k, None) is not None:
+                        n += 1
+                    if self.zsets.pop(k, None) is not None:
+                        n += 1
+                return n
+            if cmd == b"ZADD":
+                z = self.zsets.setdefault(args[1], set())
+                added = 0
+                # pairs of (score, member)
+                for m in args[3::2]:
+                    if m not in z:
+                        z.add(m)
+                        added += 1
+                return added
+            if cmd == b"ZREM":
+                z = self.zsets.get(args[1], set())
+                n = 0
+                for m in args[2:]:
+                    if m in z:
+                        z.discard(m)
+                        n += 1
+                return n
+            if cmd == b"ZRANGEBYLEX":
+                z = sorted(self.zsets.get(args[1], set()))
+                lo, hi = args[2], args[3]
+
+                def above(m):
+                    if lo == b"-":
+                        return True
+                    if lo.startswith(b"["):
+                        return m >= lo[1:]
+                    return m > lo[1:]   # "(" exclusive
+
+                def below(m):
+                    if hi == b"+":
+                        return True
+                    if hi.startswith(b"["):
+                        return m <= hi[1:]
+                    return m < hi[1:]
+
+                sel = [m for m in z if above(m) and below(m)]
+                if len(args) >= 7 and args[4].upper() == b"LIMIT":
+                    off, cnt = int(args[5]), int(args[6])
+                    sel = sel[off:] if cnt < 0 else sel[off:off + cnt]
+                return sel
+            return RuntimeError(f"unknown command {cmd!r}")
+
+
+def encode(reply):
+    if isinstance(reply, str) and reply.startswith("+"):
+        return reply.encode() + b"\r\n"
+    if isinstance(reply, RuntimeError):
+        return b"-ERR " + str(reply).encode() + b"\r\n"
+    if reply is None:
+        return b"$-1\r\n"
+    if isinstance(reply, int):
+        return b":%d\r\n" % reply
+    if isinstance(reply, bytes):
+        return b"$%d\r\n%s\r\n" % (len(reply), reply)
+    if isinstance(reply, list):
+        return b"*%d\r\n" % len(reply) + \
+            b"".join(encode(x) for x in reply)
+    raise AssertionError(reply)
+
+
+def serve_conn(conn, store):
+    buf = b""
+
+    def read_line():
+        nonlocal buf
+        while b"\r\n" not in buf:
+            chunk = conn.recv(65536)
+            if not chunk:
+                raise OSError("eof")
+            buf += chunk
+        line, buf = buf.split(b"\r\n", 1)
+        return line
+
+    def read_exact(n):
+        nonlocal buf
+        while len(buf) < n + 2:
+            chunk = conn.recv(65536)
+            if not chunk:
+                raise OSError("eof")
+            buf += chunk
+        data, buf = buf[:n], buf[n + 2:]
+        return data
+
+    try:
+        while True:
+            line = read_line()
+            if not line.startswith(b"*"):
+                conn.sendall(b"-ERR inline commands unsupported\r\n")
+                return
+            nargs = int(line[1:])
+            args = []
+            for _ in range(nargs):
+                hdr = read_line()
+                assert hdr.startswith(b"$")
+                args.append(read_exact(int(hdr[1:])))
+            conn.sendall(encode(store.execute(args)))
+    except OSError:
+        pass
+    finally:
+        conn.close()
+
+
+def main():
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    store = Store()
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", port))
+    srv.listen(64)
+    # announce the bound port for the parent test process
+    print(f"PORT {srv.getsockname()[1]}", flush=True)
+    while True:
+        conn, _ = srv.accept()
+        threading.Thread(target=serve_conn, args=(conn, store),
+                         daemon=True).start()
+
+
+if __name__ == "__main__":
+    main()

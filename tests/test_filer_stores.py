@@ -1,0 +1,134 @@
+"""Concrete wire-protocol filer stores (VERDICT r4 #6): the redis
+RESP store against an EXTERNAL server process, and the abstract-SQL
+family — all through the same contract suite every other store passes
+(weed/filer/filerstore.go's pluggable-store promise)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.abstract_sql import (AbstractSqlStore,
+                                              MysqlDialect,
+                                              PostgresDialect,
+                                              SqliteDialect)
+from seaweedfs_tpu.filer.redis_store import (RedisFilerStore,
+                                             RespClient, RespError)
+from test_filer import _exercise_store
+
+
+@pytest.fixture(scope="module")
+def resp_server():
+    """tests/resp_fake.py as a SEPARATE PROCESS — the store's protocol
+    code crosses a real process + socket boundary, the way the
+    reference CI exercises its redis stores against a container."""
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "resp_fake.py"), "0"],
+        stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline().strip()
+    assert line.startswith("PORT "), line
+    port = int(line.split()[1])
+    yield port
+    proc.kill()
+    proc.wait(timeout=5)
+
+
+def test_resp_client_protocol(resp_server):
+    c = RespClient(port=resp_server)
+    assert c.call("PING") == "PONG"
+    assert c.call("SET", "k", "v") == "OK"
+    assert c.call("GET", "k") == b"v"
+    assert c.call("GET", "missing") is None
+    assert c.call("DEL", "k") == 1
+    # binary-safe values
+    blob = bytes(range(256))
+    c.call("SET", "bin", blob)
+    assert c.call("GET", "bin") == blob
+    # server errors surface as RespError
+    with pytest.raises(RespError):
+        c.call("NOSUCHCOMMAND")
+    # reconnect after a dropped socket
+    c._sock.close()
+    assert c.call("PING") == "PONG"
+    c.close()
+
+
+def test_redis_store_contract(resp_server):
+    c = RespClient(port=resp_server)
+    c.call("FLUSHALL")
+    _exercise_store(RedisFilerStore(c))
+    c.close()
+
+
+def test_redis_store_lex_pagination(resp_server):
+    """ZRANGEBYLEX-backed listing: resumable pagination over a large
+    directory without scanning (the redis2 sorted-set design)."""
+    c = RespClient(port=resp_server)
+    c.call("FLUSHALL")
+    from seaweedfs_tpu.filer.entry import Entry
+    s = RedisFilerStore(c)
+    for i in range(50):
+        s.insert_entry(Entry(f"/big/f{i:03d}"))
+    got, start = [], ""
+    while True:
+        page = s.list_directory_entries("/big", start_file=start,
+                                        limit=7)
+        if not page:
+            break
+        got.extend(e.name for e in page)
+        start = page[-1].name
+    assert got == [f"f{i:03d}" for i in range(50)]
+    c.close()
+
+
+def test_abstract_sql_store_sqlite_contract():
+    d = SqliteDialect()
+    _exercise_store(AbstractSqlStore(d.connect(":memory:"), d))
+
+
+def test_dialect_sql_rendering():
+    """The mysql/postgres dialects render the reference's upsert
+    shapes (no drivers in the image: connect() raises with guidance,
+    but the SQL itself is the compatibility surface)."""
+    my, pg = MysqlDialect(), PostgresDialect()
+    assert "ON DUPLICATE KEY UPDATE" in my.upsert_sql()
+    assert my.placeholder == "%s"
+    assert "ON CONFLICT (directory, name)" in pg.upsert_sql()
+    for dialect in (my, pg):
+        assert dialect.list_sql(True, True).count("%s") == 4
+        with pytest.raises(NotImplementedError, match="driver"):
+            dialect.connect()
+
+
+def test_filer_end_to_end_on_redis_store(resp_server, tmp_path):
+    """A live filer (HTTP surface) running on the redis store."""
+    from seaweedfs_tpu.filer import Filer
+    from seaweedfs_tpu.server.httpd import http_bytes
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    c = RespClient(port=resp_server)
+    c.call("FLUSHALL")
+    master = MasterServer(volume_size_limit_mb=16).start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url,
+                      pulse_seconds=0.2).start()
+    try:
+        time.sleep(0.4)
+        f = Filer(master.url, RedisFilerStore(c))
+        f.write_file("/docs/hello.txt", b"redis-backed bytes")
+        assert f.read_file("/docs/hello.txt") == b"redis-backed bytes"
+        f.rename("/docs/hello.txt", "/docs/renamed.txt")
+        assert f.find_entry("/docs/hello.txt") is None
+        assert f.read_file("/docs/renamed.txt") == \
+            b"redis-backed bytes"
+        names = [e.name for e in f.list_directory("/docs")]
+        assert names == ["renamed.txt"]
+        f.delete_entry("/docs/renamed.txt")
+        assert f.find_entry("/docs/renamed.txt") is None
+    finally:
+        vs.stop()
+        master.stop()
+        c.close()
