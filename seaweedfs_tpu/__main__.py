@@ -29,7 +29,10 @@ def main(argv: list[str] | None = None) -> int:
     m.add_argument("-defaultReplication", default="000")
     m.add_argument("-peers", default="",
                    help="comma-separated master peers for HA "
-                        "(raft-style leader election)")
+                        "(raft leader election + log replication)")
+    m.add_argument("-mdir", default="",
+                   help="meta dir: persists the raft log/snapshot so "
+                        "topology id + fid sequence survive restarts")
     m.add_argument("-metricsAddress", dest="metrics_address",
                    default="", help="Prometheus pushgateway "
                    "host:port (stats/metrics.go LoopPushingMetric)")
@@ -154,6 +157,9 @@ def main(argv: list[str] | None = None) -> int:
     ad.add_argument("-port", type=int, default=23646)
     ad.add_argument("-master", default="127.0.0.1:9333")
     ad.add_argument("-detectionInterval", type=float, default=30.0)
+    ad.add_argument("-dataDir", default="",
+                    help="persist jobs/config/workers under "
+                         "<dataDir>/plugin/ (survives restart)")
 
     wk = sub.add_parser(
         "worker", help="start a maintenance worker (tpu_ec sidecar: owns "
@@ -338,7 +344,8 @@ def main(argv: list[str] | None = None) -> int:
         ms = MasterServer(args.ip, args.port,
                           volume_size_limit_mb=args.volumeSizeLimitMB,
                           default_replication=args.defaultReplication,
-                          peers=args.peers or None)
+                          peers=args.peers or None,
+                          meta_dir=args.mdir or None)
         ms.start()
         if args.metrics_address:
             from .stats import MetricsPusher
@@ -505,7 +512,8 @@ def main(argv: list[str] | None = None) -> int:
     elif args.cmd == "admin":
         from .plugin.admin import AdminServer
         ad = AdminServer(args.master, args.ip, args.port,
-                         detection_interval=args.detectionInterval)
+                         detection_interval=args.detectionInterval,
+                         data_dir=args.dataDir or None)
         ad.start()
         print(f"admin listening on {ad.url}")
         _wait()

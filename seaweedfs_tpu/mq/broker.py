@@ -179,6 +179,8 @@ class BrokerServer:
         r("GET", "/topics/schema", self._schema_get)
         r("POST", "/topics/compact", self._compact)
         r("POST", "/topics/repartition", self._repartition)
+        r("POST", "/topics/balance", self._balance)
+        r("POST", "/topics/truncate", self._truncate)
         # topic -> (revision, recordType) cache for publish validation
         self._schema_cache: dict = {}
         self._schema_cache_ts: dict = {}
@@ -577,6 +579,129 @@ class BrokerServer:
             with self._lock:
                 self._repartitioning.discard(t)
             lock.release()
+
+    def _balance(self, req: Request):
+        """mq.balance (pub_balancer BalanceTopicPartitionOnBrokers):
+        reassign every topic's partition ownership round-robin across
+        the LIVE brokers and persist the layouts.  Peers pick the new
+        routing up within CONF_TTL; in-memory tails are flushed first
+        so no acked message is stranded on a de-owned broker."""
+        from ..cluster import ClusterLock
+        try:
+            live = self._live_brokers()
+        except RuntimeError as e:
+            return 503, {"error": str(e)}
+        st, body, _ = http_bytes("GET",
+                                 f"{self.filer}/topics/?limit=1000")
+        if st != 200:
+            return 503, {"error": f"filer list: {st}"}
+        moved = 0
+        topics = []
+        for ns_e in json.loads(body).get("entries", []):
+            if not ns_e.get("isDirectory"):
+                continue
+            ns = ns_e["fullPath"].rsplit("/", 1)[-1]
+            if ns.startswith("."):
+                continue
+            st2, body2, _ = http_bytes(
+                "GET", f"{self.filer}/topics/{ns}/?limit=1000")
+            if st2 != 200:
+                continue
+            for t_e in json.loads(body2).get("entries", []):
+                if t_e.get("isDirectory"):
+                    topics.append(Topic(
+                        ns, t_e["fullPath"].rsplit("/", 1)[-1]))
+        for t in topics:
+            try:
+                lock = ClusterLock(
+                    self.filer, f"mq-conf:{self._conf_path(t)}",
+                    owner=self.url, ttl_sec=15.0).acquire(timeout=5.0)
+            except (TimeoutError, OSError):
+                continue    # busy topic: next balance run
+            try:
+                with self._topic_lock(t).write():
+                    try:
+                        parts = self._load_layout(t, fresh=True)
+                    except RuntimeError:
+                        continue
+                    if not parts:
+                        continue
+                    with self._lock:
+                        old = list(self._owners.get(t) or
+                                   [self.url] * len(parts))
+                    new = [live[i % len(live)]
+                           for i in range(len(parts))]
+                    if new != old:
+                        # flush our tails for partitions we lose
+                        with self._lock:
+                            logs = [log for (lt, _p), log
+                                    in self._logs.items() if lt == t]
+                        for log in logs:
+                            log.flush()
+                        if self._persist_layout(t, parts, new) is None:
+                            moved += sum(1 for a, b in zip(old, new)
+                                         if a != b)
+            finally:
+                lock.release()
+        return 200, {"brokers": live, "topics": len(topics),
+                     "movedPartitions": moved}
+
+    def _truncate(self, req: Request):
+        """mq.topic.truncate: drop a topic's stored messages, keeping
+        its configuration/layout.  Peer brokers drop their in-memory
+        tails FIRST (localOnly broadcast) — an owning peer would
+        otherwise keep serving (and later re-flushing) pre-truncate
+        messages from its LogBuffer."""
+        b = req.json()
+        try:
+            t = self._topic_from(b["namespace"], b["topic"])
+        except NameError_ as e:
+            return 400, {"error": str(e)}
+        with self._topic_lock(t).write():
+            try:
+                parts = self._load_layout(t, fresh=True)
+            except RuntimeError as e:
+                return 503, {"error": str(e)}
+            if parts is None:
+                return 404, {"error": f"topic {t} not configured"}
+            with self._lock:
+                for p in parts:
+                    self._logs.pop((t, p), None)
+        if not b.get("localOnly"):
+            try:
+                peers = [p for p in self._registered_brokers()
+                         if p != self.url]
+            except RuntimeError as e:
+                return 503, {"error": str(e)}
+            for peer in peers:
+                try:
+                    http_bytes("POST", f"{peer}/topics/truncate",
+                               json.dumps({
+                                   "namespace": t.namespace,
+                                   "topic": t.name,
+                                   "localOnly": True}).encode())
+                except OSError:
+                    pass    # dead peer holds no servable tail
+            failures = []
+            with self._topic_lock(t).write():
+                for p in parts:
+                    try:
+                        st_d, body_d, _ = http_bytes(
+                            "DELETE",
+                            f"{self.filer}"
+                            f"{urllib.parse.quote(t.dir + '/' + str(p))}"
+                            f"?recursive=true")
+                    except OSError as e:
+                        st_d, body_d = 0, str(e).encode()
+                    if st_d not in (200, 204, 404):
+                        failures.append(f"{p}: {st_d} "
+                                        f"{body_d[:100]!r}")
+            if failures:
+                # persisted segments survive: a fresh PartitionLog
+                # would serve the "truncated" messages again — say so
+                return 500, {"error": "partition dirs not deleted: "
+                                      + "; ".join(failures)}
+        return 200, {"truncated": len(parts)}
 
     # -- schema plane (weed/mq/schema; broker_grpc_pub.go gating) ------
 

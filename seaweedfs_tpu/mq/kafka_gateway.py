@@ -46,20 +46,24 @@ UNSUPPORTED_VERSION = 35
 TOPIC_ALREADY_EXISTS = 36
 INVALID_REQUEST = 42
 
+# Per-API version RANGES (round 5: breadth covering what kafka-python
+# and librdkafka negotiate down to — both pick the highest version in
+# the intersection of client and broker support, so every version in
+# these ranges must be byte-exact, not just the max).
 API_VERSIONS = {
-    0: (0, 3),    # Produce (v3: record batches v2)
-    1: (4, 4),    # Fetch
-    2: (1, 1),    # ListOffsets
-    3: (1, 1),    # Metadata
-    8: (2, 2),    # OffsetCommit
-    9: (1, 1),    # OffsetFetch
-    10: (0, 0),   # FindCoordinator
-    11: (0, 0),   # JoinGroup
-    12: (0, 0),   # Heartbeat
-    13: (0, 0),   # LeaveGroup
-    14: (0, 0),   # SyncGroup
-    18: (0, 0),   # ApiVersions
-    19: (0, 0),   # CreateTopics
+    0: (3, 5),    # Produce (record batches v2 only; v5 +log_start)
+    1: (4, 7),    # Fetch (v5 +log_start_offset, v7 +sessions)
+    2: (1, 2),    # ListOffsets (v2 +isolation/throttle)
+    3: (1, 5),    # Metadata (v2 +cluster_id, v3 +throttle, v5 +offline)
+    8: (2, 3),    # OffsetCommit (v3 +throttle)
+    9: (1, 3),    # OffsetFetch (v2 +error_code, v3 +throttle)
+    10: (0, 1),   # FindCoordinator (v1 +key_type/error_message)
+    11: (0, 2),   # JoinGroup (v1 +rebalance_timeout, v2 +throttle)
+    12: (0, 2),   # Heartbeat (v1 +throttle)
+    13: (0, 1),   # LeaveGroup (v1 +throttle)
+    14: (0, 2),   # SyncGroup (v1 +throttle)
+    18: (0, 2),   # ApiVersions (v1 +throttle)
+    19: (0, 2),   # CreateTopics (v1 +validate_only, v2 +throttle)
 }
 
 
@@ -155,7 +159,7 @@ class KafkaGateway:
               11: self._join_group, 12: self._heartbeat,
               13: self._leave_group, 14: self._sync_group,
               18: self._api_versions, 19: self._create_topics}[api_key]
-        body = fn(r)
+        body = fn(r, api_version)
         return None if body is None else header + body
 
     # -- topic helpers -----------------------------------------------------
@@ -184,17 +188,22 @@ class KafkaGateway:
 
     # -- API handlers ------------------------------------------------------
 
-    def _api_versions(self, r: Reader) -> bytes:
+    def _api_versions(self, r: Reader, v: int = 0) -> bytes:
         entries = [enc_i16(k) + enc_i16(lo) + enc_i16(hi)
                    for k, (lo, hi) in sorted(API_VERSIONS.items())]
-        return enc_i16(NONE) + enc_array(entries)
+        out = enc_i16(NONE) + enc_array(entries)
+        if v >= 1:
+            out += enc_i32(0)            # throttle_time_ms
+        return out
 
-    def _metadata(self, r: Reader) -> bytes:
+    def _metadata(self, r: Reader, v: int = 1) -> bytes:
         n = r.i32()
         # v1 semantics: null array (-1) = all topics, empty array =
         # NO topics (broker-info-only refresh) — v0's empty-means-all
         # does not apply here
         wanted = None if n < 0 else [r.string() for _ in range(n)]
+        if v >= 4 and r.remaining() >= 1:
+            r.i8()                       # allow_auto_topic_creation
         broker = (enc_i32(0) + enc_string(self.host) +
                   enc_i32(self.port) + enc_string(None))
         names = wanted if wanted is not None else self._all_topics()
@@ -208,14 +217,22 @@ class KafkaGateway:
                 continue
             parts = [enc_i16(NONE) + enc_i32(i) + enc_i32(0) +
                      enc_array([enc_i32(0)]) +
-                     enc_array([enc_i32(0)])
+                     enc_array([enc_i32(0)]) +
+                     (enc_array([]) if v >= 5 else b"")  # offline
                      for i in range(count)]
             topics.append(enc_i16(NONE) + enc_string(name) +
                           enc_i8(0) + enc_array(parts))
-        return (enc_array([broker]) + enc_i32(0) +   # controller_id
-                enc_array(topics))
+        out = b""
+        if v >= 3:
+            out += enc_i32(0)            # throttle_time_ms
+        out += enc_array([broker])
+        if v >= 2:
+            out += enc_string("seaweedfs-tpu")   # cluster_id
+        out += enc_i32(0)                # controller_id
+        out += enc_array(topics)
+        return out
 
-    def _create_topics(self, r: Reader) -> bytes:
+    def _create_topics(self, r: Reader, v: int = 0) -> bytes:
         n = r.i32()
         results = []
         for _ in range(n):
@@ -245,13 +262,17 @@ class KafkaGateway:
                 except (RuntimeError, OSError) as e:
                     code = INVALID_REQUEST if "name" in str(e) \
                         else UNKNOWN_SERVER_ERROR
-            results.append(enc_string(name) + enc_i16(code))
+            results.append(enc_string(name) + enc_i16(code) +
+                           (enc_string(None) if v >= 1 else b""))
         if r.remaining() >= 4:
             r.i32()                      # timeout_ms
-        return enc_array(results)
+        if v >= 1 and r.remaining() >= 1:
+            r.i8()                       # validate_only
+        return (enc_i32(0) if v >= 2 else b"") + enc_array(results)
 
-    def _produce(self, r: Reader) -> "bytes | None":
-        r.string()                       # transactional_id (v3)
+    def _produce(self, r: Reader, v: int = 3) -> "bytes | None":
+        if v >= 3:
+            r.string()                   # transactional_id
         acks = r.i16()
         r.i32()                          # timeout_ms
         topics_out = []
@@ -281,22 +302,34 @@ class KafkaGateway:
                         code = CORRUPT_MESSAGE
                     except (RuntimeError, OSError):
                         code = UNKNOWN_SERVER_ERROR
-                parts_out.append(enc_i32(idx) + enc_i16(code) +
-                                 enc_i64(base_offset) +
-                                 enc_i64(-1))    # log_append_time
+                part = enc_i32(idx) + enc_i16(code) + \
+                    enc_i64(base_offset)
+                if v >= 2:
+                    part += enc_i64(-1)          # log_append_time
+                if v >= 5:
+                    part += enc_i64(0)           # log_start_offset
+                parts_out.append(part)
             topics_out.append(enc_string(name) + enc_array(parts_out))
         if acks == 0:
             # fire-and-forget: the protocol REQUIRES no response (a
             # stray one would desynchronize the client's correlation)
             return None
-        return enc_array(topics_out) + enc_i32(0)  # throttle_time
+        out = enc_array(topics_out)
+        if v >= 1:
+            out += enc_i32(0)                    # throttle_time
+        return out
 
-    def _fetch(self, r: Reader) -> bytes:
+    def _fetch(self, r: Reader, v: int = 4) -> bytes:
         r.i32()                          # replica_id
         r.i32()                          # max_wait_ms (no long poll)
         r.i32()                          # min_bytes
         r.i32()                          # max_bytes
         r.i8()                           # isolation_level
+        session_id = 0
+        if v >= 7:
+            session_id = r.i32()
+            r.i32()                      # session_epoch (no sessions:
+            # we answer full fetches, session_id 0 = sessionless)
         topics_out = []
         for _ in range(r.i32()):
             name = r.string()
@@ -304,6 +337,8 @@ class KafkaGateway:
             for _ in range(r.i32()):
                 idx = r.i32()
                 fetch_offset = r.i64()
+                if v >= 5:
+                    r.i64()              # log_start_offset (replicas)
                 max_part_bytes = r.i32()
                 code, hwm, batches = NONE, 0, b""
                 count = self._partition_count(name)
@@ -330,16 +365,31 @@ class KafkaGateway:
                         batches = b"".join(out)
                     except (RuntimeError, OSError):
                         code = UNKNOWN_SERVER_ERROR
-                parts_out.append(
-                    enc_i32(idx) + enc_i16(code) + enc_i64(hwm) +
-                    enc_i64(hwm) +                 # last_stable_offset
-                    enc_i32(0) +                   # aborted txns: none
-                    enc_bytes(batches))
+                part = enc_i32(idx) + enc_i16(code) + \
+                    enc_i64(hwm) + \
+                    enc_i64(hwm)                   # last_stable_offset
+                if v >= 5:
+                    part += enc_i64(0)             # log_start_offset
+                part += enc_i32(0)                 # aborted txns: none
+                part += enc_bytes(batches)
+                parts_out.append(part)
             topics_out.append(enc_string(name) + enc_array(parts_out))
-        return enc_i32(0) + enc_array(topics_out)  # throttle_time
+        if v >= 7:
+            # drain forgotten_topics_data (sessionless: ignored)
+            for _ in range(max(r.i32(), 0) if r.remaining() >= 4
+                           else 0):
+                r.string()
+                for _ in range(max(r.i32(), 0)):
+                    r.i32()
+        out = enc_i32(0)                           # throttle_time
+        if v >= 7:
+            out += enc_i16(NONE) + enc_i32(0)      # error, session_id
+        return out + enc_array(topics_out)
 
-    def _list_offsets(self, r: Reader) -> bytes:
+    def _list_offsets(self, r: Reader, v: int = 1) -> bytes:
         r.i32()                          # replica_id
+        if v >= 2:
+            r.i8()                       # isolation_level
         topics_out = []
         for _ in range(r.i32()):
             name = r.string()
@@ -367,14 +417,22 @@ class KafkaGateway:
                 parts_out.append(enc_i32(idx) + enc_i16(code) +
                                  enc_i64(-1) + enc_i64(offset))
             topics_out.append(enc_string(name) + enc_array(parts_out))
-        return enc_array(topics_out)
+        return (enc_i32(0) if v >= 2 else b"") + enc_array(topics_out)
 
-    def _find_coordinator(self, r: Reader) -> bytes:
-        r.string()                       # group id: we coordinate all
-        return (enc_i16(NONE) + enc_i32(0) + enc_string(self.host) +
-                enc_i32(self.port))
+    def _find_coordinator(self, r: Reader, v: int = 0) -> bytes:
+        r.string()                       # key (group id): we
+        if v >= 1 and r.remaining() >= 1:
+            r.i8()                       # key_type
+        out = b""
+        if v >= 1:
+            out += enc_i32(0)            # throttle_time
+        out += enc_i16(NONE)
+        if v >= 1:
+            out += enc_string(None)      # error_message
+        return out + (enc_i32(0) + enc_string(self.host) +
+                      enc_i32(self.port))
 
-    def _offset_commit(self, r: Reader) -> bytes:
+    def _offset_commit(self, r: Reader, v: int = 2) -> bytes:
         group = r.string() or ""
         r.i32()                          # generation_id
         r.string()                       # member_id
@@ -397,9 +455,9 @@ class KafkaGateway:
                     code = UNKNOWN_SERVER_ERROR
                 parts_out.append(enc_i32(idx) + enc_i16(code))
             topics_out.append(enc_string(name) + enc_array(parts_out))
-        return enc_array(topics_out)
+        return (enc_i32(0) if v >= 3 else b"") + enc_array(topics_out)
 
-    def _offset_fetch(self, r: Reader) -> bytes:
+    def _offset_fetch(self, r: Reader, v: int = 1) -> bytes:
         group = r.string() or ""
         topics_out = []
         for _ in range(r.i32()):
@@ -420,13 +478,18 @@ class KafkaGateway:
                 parts_out.append(enc_i32(idx) + enc_i64(offset) +
                                  enc_string("") + enc_i16(code))
             topics_out.append(enc_string(name) + enc_array(parts_out))
-        return enc_array(topics_out)
+        out = (enc_i32(0) if v >= 3 else b"") + enc_array(topics_out)
+        if v >= 2:
+            out += enc_i16(NONE)         # top-level error_code
+        return out
 
     # -- consumer groups (protocol/joingroup.go; kafka_groups.py) ----------
 
-    def _join_group(self, r: Reader) -> bytes:
+    def _join_group(self, r: Reader, v: int = 0) -> bytes:
         group = r.string() or ""
         session_timeout = r.i32() / 1000.0
+        if v >= 1:
+            r.i32()                      # rebalance_timeout_ms
         member_id = r.string() or ""
         r.string()                       # protocol_type ("consumer")
         protocols = []
@@ -435,18 +498,19 @@ class KafkaGateway:
             protocols.append((name, r.bytes_() or b""))
         code, resp = self.groups.join(group, member_id,
                                       session_timeout, protocols)
+        throttle = enc_i32(0) if v >= 2 else b""
         if code:
-            return (enc_i16(code) + enc_i32(0) + enc_string("") +
-                    enc_string("") + enc_string(member_id) +
-                    enc_array([]))
-        return (enc_i16(0) + enc_i32(resp["generation"]) +
+            return (throttle + enc_i16(code) + enc_i32(0) +
+                    enc_string("") + enc_string("") +
+                    enc_string(member_id) + enc_array([]))
+        return (throttle + enc_i16(0) + enc_i32(resp["generation"]) +
                 enc_string(resp["protocol"]) +
                 enc_string(resp["leader"]) +
                 enc_string(resp["member_id"]) +
                 enc_array([enc_string(mid) + enc_bytes(meta)
                            for mid, meta in resp["members"]]))
 
-    def _sync_group(self, r: Reader) -> bytes:
+    def _sync_group(self, r: Reader, v: int = 0) -> bytes:
         group = r.string() or ""
         generation = r.i32()
         member_id = r.string() or ""
@@ -456,16 +520,19 @@ class KafkaGateway:
             assignments[mid] = r.bytes_() or b""
         code, assignment = self.groups.sync(group, member_id,
                                             generation, assignments)
-        return enc_i16(code) + enc_bytes(assignment)
+        return (enc_i32(0) if v >= 1 else b"") + enc_i16(code) + \
+            enc_bytes(assignment)
 
-    def _heartbeat(self, r: Reader) -> bytes:
+    def _heartbeat(self, r: Reader, v: int = 0) -> bytes:
         group = r.string() or ""
         generation = r.i32()
         member_id = r.string() or ""
-        return enc_i16(self.groups.heartbeat(group, member_id,
-                                             generation))
+        return (enc_i32(0) if v >= 1 else b"") + \
+            enc_i16(self.groups.heartbeat(group, member_id,
+                                          generation))
 
-    def _leave_group(self, r: Reader) -> bytes:
+    def _leave_group(self, r: Reader, v: int = 0) -> bytes:
         group = r.string() or ""
         member_id = r.string() or ""
-        return enc_i16(self.groups.leave(group, member_id))
+        return (enc_i32(0) if v >= 1 else b"") + \
+            enc_i16(self.groups.leave(group, member_id))

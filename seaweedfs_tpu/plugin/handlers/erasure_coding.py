@@ -78,7 +78,7 @@ class EcEncodeHandler(JobHandler):
             if vid in seen:
                 continue
             seen.add(vid)
-            if self.collection_filter is not None and \
+            if self.collection_filter not in (None, "") and \
                     v.get("collection", "") != self.collection_filter:
                 continue
             if v.get("size", 0) < self.fullness_ratio * size_limit:

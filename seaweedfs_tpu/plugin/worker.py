@@ -128,10 +128,30 @@ class PluginWorker:
                 continue
             mtype = msg.get("type")
             if mtype == "runDetection":
+                self._apply_config(msg.get("config") or {})
                 self._run_detection()
             elif mtype == "executeJob":
                 self._execute(msg["jobId"], msg["jobType"],
                               msg.get("params", {}))
+
+    @staticmethod
+    def _snake(name: str) -> str:
+        return "".join("_" + c.lower() if c.isupper() else c
+                       for c in name)
+
+    def _apply_config(self, config: dict) -> None:
+        """Admin ConfigStore values -> handler attributes: descriptor
+        field names are camelCase on the wire (plugin.proto forms),
+        handler attrs snake_case.  Unknown names are ignored (the
+        admin already schema-validated)."""
+        for job_type, values in config.items():
+            h = self.handlers.get(job_type)
+            if h is None:
+                continue
+            for name, value in values.items():
+                attr = self._snake(name)
+                if hasattr(h, attr):
+                    setattr(h, attr, value)
 
     def _run_detection(self) -> None:
         proposals = []

@@ -117,6 +117,19 @@ class S3RemoteStorage:
         return {"size": int(h.get("Content-Length", 0)),
                 "etag": h.get("ETag", "").strip('"')}
 
+    def list_buckets(self) -> "list[str]":
+        """GET / (S3 ListBuckets) on the remote endpoint — the one
+        service-level call, signed for path "/" (no bucket prefix)."""
+        import re as _re
+        signed = sign_request("GET", self.endpoint, "/", {}, {}, b"",
+                              self.access_key, self.secret_key)
+        status, body, _ = http_bytes(
+            "GET", f"{self.endpoint}/", None, signed)
+        if status != 200:
+            raise RemoteError(f"list buckets: {status}")
+        return _re.findall(r"<Name>([^<]+)</Name>", body.decode(
+            "utf-8", "replace"))
+
     def create_bucket(self) -> None:
         st, _, _ = self._call("PUT", "")
         if st not in (200, 409):

@@ -73,6 +73,7 @@ class MasterServer:
         r("POST", "/vol/grow", self._vol_grow)
         r("GET", "/cluster/status", self._cluster_status)
         r("POST", "/cluster/raft/config", self._raft_config)
+        r("POST", "/cluster/raft/transfer", self._raft_transfer)
         r("POST", "/cluster/lease_admin_token", self._lease_admin)
         r("POST", "/cluster/release_admin_token", self._release_admin)
         r("GET", "/metrics", self._metrics)
@@ -242,7 +243,7 @@ class MasterServer:
     _LEADER_ONLY = frozenset((
         "/heartbeat", "/dir/assign", "/dir/lookup", "/dir/ec_lookup",
         "/dir/status", "/vol/list", "/vol/grow", "/cluster/status",
-        "/cluster/watch", "/cluster/raft/config",
+        "/cluster/watch", "/cluster/raft/config", "/cluster/raft/transfer",
         "/cluster/lease_admin_token", "/cluster/release_admin_token"))
 
     def _guard(self, req: Request):
@@ -509,6 +510,22 @@ class MasterServer:
                 "persistent": bool(self.raft.data_dir),
             },
         }
+
+    def _raft_transfer(self, req: Request):
+        """cluster.raft.leader.transfer (raft LeadershipTransfer): the
+        leader steps down; a peer with an up-to-date log wins the next
+        election (its append stream is current, so it satisfies the
+        §5.4.1 vote restriction)."""
+        if not self.raft.is_leader:
+            return 400, {"error": "not the leader",
+                         "leader": self.raft.leader}
+        if len(self.raft.peers) == 1:
+            return 400, {"error": "single-master cluster: nothing to "
+                                  "transfer to"}
+        if not self.raft.transfer_leadership():
+            return 400, {"error": "leadership changed mid-request",
+                         "leader": self.raft.leader}
+        return 200, {"transferred": True}
 
     def _raft_config(self, req: Request):
         """Membership change through the log (master.proto:50-56

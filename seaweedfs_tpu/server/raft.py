@@ -565,6 +565,20 @@ class RaftNode:
             self.on_leadership(True)
         return True
 
+    def transfer_leadership(self) -> bool:
+        """Voluntary step-down (raft LeadershipTransfer): retire the
+        leader state AND restart our own election timer, so a peer —
+        whose log the final heartbeats made current — times out and
+        wins before we would run again.  Without the timer reset the
+        ex-leader's long-expired clock fires on the next pulse and it
+        deterministically re-elects itself."""
+        with self._lock:
+            if self.state != LEADER:
+                return False
+            self._step_down(self.term)
+            self._last_heard = time.monotonic()
+        return True
+
     def _election_timeout(self) -> float:
         return random.uniform(4, 8) * self.pulse
 

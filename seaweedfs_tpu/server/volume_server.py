@@ -81,6 +81,8 @@ class VolumeServer:
         r("GET", "/admin/needle_raw", self._needle_raw)
         r("POST", "/admin/write_needle_raw", self._write_needle_raw)
         r("POST", "/admin/scrub", self._scrub)
+        r("POST", "/admin/leave", self._leave)
+        r("POST", "/admin/vacuum_toggle", self._vacuum_toggle)
         r("POST", "/admin/ec/scrub", self._ec_scrub)
         r("GET", "/metrics", self._metrics)
         from .debug import install_debug_routes
@@ -565,8 +567,27 @@ class VolumeServer:
         return 200, {"replication": str(
             v.super_block.replica_placement)}
 
+    def _leave(self, req: Request):
+        """volume.server.leave (command_volume_server_leave.go
+        VolumeServerLeave): stop heartbeating so the master forgets
+        this node after its pulse timeout; volumes stay served until
+        the process exits (the operator evacuates first)."""
+        self._hb_stop.set()
+        return 200, {"left": True}
+
+    def _vacuum_toggle(self, req: Request):
+        """volume.vacuum.enable/disable (command_volume_vacuum_*.go
+        DisableVacuum/EnableVacuum): a maintenance gate the vacuum
+        handler honors."""
+        self._vacuum_disabled = not bool(req.json().get("enabled",
+                                                        True))
+        return 200, {"vacuumEnabled": not self._vacuum_disabled}
+
     def _vacuum(self, req: Request):
         """volume_server.proto VacuumVolume{Check,Compact,Commit}."""
+        if getattr(self, "_vacuum_disabled", False):
+            return 409, {"error": "vacuum disabled on this server "
+                                  "(volume.vacuum.enable to resume)"}
         vid = int(req.json()["volumeId"])
         v = self.store.find_volume(vid)
         if v is None:

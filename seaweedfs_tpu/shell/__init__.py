@@ -6,3 +6,4 @@ from . import fs_commands  # noqa: F401  (registers fs.* + repair cmds)
 from . import remote_commands  # noqa: F401  (registers remote.*)
 from . import s3_commands  # noqa: F401  (registers s3.*)
 from . import admin_commands  # noqa: F401  (registers volume/cluster/mq admin)
+from . import s3_iam_commands  # noqa: F401  (registers s3 identity admin)

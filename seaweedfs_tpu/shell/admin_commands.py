@@ -400,3 +400,161 @@ def cmd_cluster_raft_remove(env: CommandEnv, args: list[str]) -> str:
                     {"remove": [server]})
     _must(r, f"remove raft server {server}")
     return f"members: {', '.join(r['peers'])}"
+
+
+# -- round-5 breadth: volume server lifecycle, replica verification,
+#    vacuum gates, tier aliases, mq balance/truncate ----------------------
+
+@command("volume.server.state")
+def cmd_volume_server_state(env: CommandEnv, args: list[str]) -> str:
+    """command_volume_server_status.go (-node=host:port): one server's
+    live /status view."""
+    opts = _parse_flags(args)
+    node = opts.get("node", "")
+    if not node:
+        return "usage: volume.server.state -node=host:port"
+    st = http_json("GET", f"{node}/status")
+    _must(st, f"status of {node}")
+    vols = st.get("volumes", [])
+    ecs = st.get("ecShards", [])
+    lines = [f"{node}: version {st.get('version', '?')}, "
+             f"{len(vols)}/{st.get('maxVolumeCount', '?')} volumes, "
+             f"{len(ecs)} ec volumes, "
+             f"maxFileKey {st.get('maxFileKey', 0)}, "
+             f"readPlanePort {st.get('readPlanePort', 0)}"]
+    for v in vols:
+        lines.append(f"  vol {v['id']:6d} {v.get('collection', ''):12s}"
+                     f" {v.get('size', 0):>12d}B"
+                     f" files={v.get('fileCount', 0)}"
+                     f"{' RO' if v.get('readOnly') else ''}")
+    return "\n".join(lines)
+
+
+@command("volume.server.leave")
+def cmd_volume_server_leave(env: CommandEnv, args: list[str]) -> str:
+    """command_volume_server_leave.go (-node=host:port): the server
+    stops heartbeating and the master forgets it after its pulse
+    timeout.  Evacuate first (volume.server.evacuate) — volumes on a
+    left server are no longer assignable or discoverable."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    node = opts.get("node", "")
+    if not node:
+        return "usage: volume.server.leave -node=host:port"
+    _must(http_json("POST", f"{node}/admin/leave", {}),
+          f"leave {node}")
+    return f"{node} left the cluster (master forgets it within its " \
+           f"pulse timeout)"
+
+
+@command("volume.vacuum.disable")
+def cmd_volume_vacuum_disable(env: CommandEnv, args: list[str]) -> str:
+    """command_volume_vacuum_disable.go: gate vacuum during delicate
+    maintenance (every node unless -node=)."""
+    opts = _parse_flags(args)
+    nodes = [opts["node"]] if opts.get("node") \
+        else _all_node_urls(env)
+    for n in nodes:
+        _must(http_json("POST", f"{n}/admin/vacuum_toggle",
+                        {"enabled": False}), f"disable vacuum on {n}")
+    return f"vacuum disabled on {len(nodes)} server(s)"
+
+
+@command("volume.vacuum.enable")
+def cmd_volume_vacuum_enable(env: CommandEnv, args: list[str]) -> str:
+    """command_volume_vacuum_enable.go."""
+    opts = _parse_flags(args)
+    nodes = [opts["node"]] if opts.get("node") \
+        else _all_node_urls(env)
+    for n in nodes:
+        _must(http_json("POST", f"{n}/admin/vacuum_toggle",
+                        {"enabled": True}), f"enable vacuum on {n}")
+    return f"vacuum enabled on {len(nodes)} server(s)"
+
+
+@command("volume.replica.check")
+def cmd_volume_replica_check(env: CommandEnv, args: list[str]) -> str:
+    """command_volume_check_disk.go's replica-divergence angle: compare
+    every replicated volume's fileCount/deleteCount/size ACROSS its
+    replicas via each server's live /status (the master view is
+    aggregated and can hide divergence)."""
+    per_server: dict[str, dict[int, dict]] = {}
+    for url in _all_node_urls(env):
+        st = http_json("GET", f"{url}/status")
+        if st.get("error"):
+            continue
+        per_server[url] = {v["id"]: v for v in st.get("volumes", [])}
+    by_vid: dict[int, list] = {}
+    for url, vols in per_server.items():
+        for vid, v in vols.items():
+            by_vid.setdefault(vid, []).append((url, v))
+    divergent = []
+    for vid, replicas in sorted(by_vid.items()):
+        if len(replicas) < 2:
+            continue
+        sigs = {(v.get("fileCount", 0), v.get("deleteCount", 0),
+                 v.get("size", 0)) for _u, v in replicas}
+        if len(sigs) > 1:
+            detail = "; ".join(
+                f"{u}: files={v.get('fileCount', 0)} "
+                f"deletes={v.get('deleteCount', 0)} "
+                f"size={v.get('size', 0)}" for u, v in replicas)
+            divergent.append(f"volume {vid} DIVERGES: {detail}")
+    checked = sum(1 for r in by_vid.values() if len(r) > 1)
+    return "\n".join([f"checked {checked} replicated volumes: "
+                      f"{len(divergent)} divergent"] + divergent)
+
+
+@command("volume.tier.upload")
+def cmd_volume_tier_upload(env: CommandEnv, args: list[str]) -> str:
+    """command_volume_tier_upload.go: the reference's name for moving
+    a volume's .dat to an S3-compatible tier backend (same engine as
+    volume.tier.move; dest flags follow the reference)."""
+    from .fs_commands import cmd_volume_tier_move
+    return cmd_volume_tier_move(env, args)
+
+
+@command("volume.tier.download")
+def cmd_volume_tier_download(env: CommandEnv, args: list[str]) -> str:
+    """command_volume_tier_download.go: bring a tiered volume's .dat
+    back to local disk (same engine as volume.tier.fetch)."""
+    from .fs_commands import cmd_volume_tier_fetch
+    return cmd_volume_tier_fetch(env, args)
+
+
+@command("cluster.raft.leader.transfer")
+def cmd_cluster_raft_leader_transfer(env: CommandEnv,
+                                     args: list[str]) -> str:
+    """command_cluster_raft_leader_transfer.go: the current leader
+    steps down; an up-to-date peer wins the next election."""
+    from ..operation import master_json
+    r = master_json(env.master, "POST", "/cluster/raft/transfer", {})
+    _must(r, "leader transfer")
+    return "leadership released; a peer takes over within the " \
+           "election timeout"
+
+
+@command("mq.balance")
+def cmd_mq_balance(env: CommandEnv, args: list[str]) -> str:
+    """command_mq_balance.go (-broker=host:port): rebalance every
+    topic's partition ownership round-robin across live brokers."""
+    opts = _parse_flags(args)
+    r = _must(http_json("POST", f"{_broker(env, opts)}/topics/balance",
+                        {}), "mq balance")
+    return (f"balanced {r.get('topics', 0)} topics across "
+            f"{len(r.get('brokers', []))} brokers; moved "
+            f"{r.get('movedPartitions', 0)} partitions")
+
+
+@command("mq.topic.truncate")
+def cmd_mq_topic_truncate(env: CommandEnv, args: list[str]) -> str:
+    """mq.topic.truncate (-broker= -namespace= -topic=): drop a
+    topic's stored messages, keeping its configuration."""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    r = _must(http_json(
+        "POST", f"{_broker(env, opts)}/topics/truncate",
+        {"namespace": opts["namespace"], "topic": opts["topic"]}),
+        "truncate topic")
+    return (f"truncated {r.get('truncated', 0)} partitions of "
+            f"{opts['namespace']}.{opts['topic']}")

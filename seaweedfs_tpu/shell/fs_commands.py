@@ -26,7 +26,7 @@ import urllib.parse
 
 from ..server.httpd import http_bytes, http_json
 from .commands import (CommandEnv, _all_node_urls, _ec_shard_locations,
-                       _ec_volumes, _move_shard, _parse_flags,
+                       _ec_volumes, _move_shard, _must, _parse_flags,
                        _volumes_by_id, command)
 
 
@@ -468,3 +468,181 @@ def cmd_fs_tree(env: CommandEnv, args: list[str]) -> str:
     walk(root.rstrip("/") or "/", 0)
     lines.append(f"{dirs} directories, {files} files")
     return "\n".join(lines)
+
+
+# --- round-5 fs breadth (command_fs_cd.go, _pwd, _meta_save/_load/_cat,
+#     _verify, _log) ------------------------------------------------------
+
+def _resolve(env: CommandEnv, path: str) -> str:
+    """Resolve against the shell's working directory (fs.cd),
+    collapsing ./.. segments so `fs.cd ..` navigates up."""
+    import posixpath
+    cwd = getattr(env, "cwd", "/")
+    if not path:
+        return cwd
+    if not path.startswith("/"):
+        path = cwd.rstrip("/") + "/" + path
+    return posixpath.normpath(path) or "/"
+
+
+@command("fs.pwd")
+def cmd_fs_pwd(env: CommandEnv, args: list[str]) -> str:
+    """command_fs_pwd.go."""
+    return getattr(env, "cwd", "/")
+
+
+@command("fs.cd")
+def cmd_fs_cd(env: CommandEnv, args: list[str]) -> str:
+    """command_fs_cd.go: change the shell's filer working directory
+    (relative fs.* paths resolve against it)."""
+    target = _resolve(env, args[0] if args else "/")
+    if target != "/":
+        st, body, _ = _filer_get(
+            env, "/__meta__/lookup",
+            "path=" + urllib.parse.quote(target.rstrip("/")))
+        if st != 200 or not json.loads(body).get("isDirectory"):
+            raise RuntimeError(f"{target}: not a directory")
+    env.cwd = target if target.startswith("/") else "/" + target
+    return env.cwd
+
+
+def _walk_entries(env: CommandEnv, directory: str):
+    """Depth-first full-entry walk via the PAGINATED filer listing
+    (_list_dir) — a flat limit would silently truncate large
+    directories, making fs.meta.save backups and fs.verify sweeps
+    incomplete without saying so."""
+    for e in _list_dir(env, directory):
+        yield e
+        if e.get("isDirectory"):
+            yield from _walk_entries(env, e["fullPath"])
+
+
+@command("fs.meta.save")
+def cmd_fs_meta_save(env: CommandEnv, args: list[str]) -> str:
+    """command_fs_meta_save.go (-o=meta.jsonl [dir]): serialize the
+    filer metadata tree (entries incl. chunk lists) to a local file
+    for backup/migration."""
+    opts = _parse_flags(args)
+    out_path = opts.get("o", "filer-meta.jsonl")
+    root = _resolve(env, next((a for a in args
+                               if not a.startswith("-")), "/"))
+    n = 0
+    with open(out_path, "w") as f:
+        for e in _walk_entries(env, root):
+            f.write(json.dumps(e) + "\n")
+            n += 1
+    return f"saved {n} entries under {root} to {out_path}"
+
+
+@command("fs.meta.load")
+def cmd_fs_meta_load(env: CommandEnv, args: list[str]) -> str:
+    """command_fs_meta_load.go (meta.jsonl): restore entries saved by
+    fs.meta.save (full entries incl. chunk refs — the data itself must
+    still live on the volume servers)."""
+    src = next((a for a in args if not a.startswith("-")), "")
+    if not src:
+        return "usage: fs.meta.load <meta.jsonl>"
+    filer = env.require_filer()
+    n = 0
+    with open(src) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            entry = json.loads(line)
+            _must(http_json("POST", f"{filer}/__meta__/put_entry",
+                            entry), f"load {entry.get('fullPath')}")
+            n += 1
+    return f"loaded {n} entries from {src}"
+
+
+@command("fs.meta.cat")
+def cmd_fs_meta_cat(env: CommandEnv, args: list[str]) -> str:
+    """command_fs_meta_cat.go: the raw stored entry (attributes +
+    chunk list) of one path."""
+    path = _resolve(env, args[0] if args else "")
+    st, body, _ = _filer_get(env, "/__meta__/lookup",
+                             "path=" + urllib.parse.quote(path))
+    if st != 200:
+        raise RuntimeError(f"{path}: {st}")
+    return json.dumps(json.loads(body), indent=1)
+
+
+@command("fs.verify")
+def cmd_fs_verify(env: CommandEnv, args: list[str]) -> str:
+    """command_fs_verify.go ([dir]): every chunk fid of every file
+    under dir must be readable on some volume server."""
+    from .. import operation
+    root = _resolve(env, args[0] if args else "/")
+    files = chunks = broken = 0
+    problems: list[str] = []
+    for e in _walk_entries(env, root):
+        if e.get("isDirectory"):
+            continue
+        files += 1
+        for c in e.get("chunks", []):
+            chunks += 1
+            fid = c.get("fileId", "")
+            try:
+                vid = int(fid.split(",")[0])
+                locs = operation.lookup(env.master, vid,
+                                        use_cache=False)
+                if not locs:
+                    raise LookupError("no locations")
+                # readable on SOME replica is the contract — a single
+                # down server must not flag healthy data as broken
+                errs = []
+                for loc in locs:
+                    try:
+                        st, _, _ = http_bytes(
+                            "HEAD", f"{loc['url']}/{fid}")
+                    except OSError as oe:
+                        errs.append(f"{loc['url']}: {oe}")
+                        continue
+                    if st == 200:
+                        break
+                    errs.append(f"{loc['url']}: HTTP {st}")
+                else:
+                    raise LookupError("; ".join(errs))
+            except (OSError, LookupError, ValueError) as ex:
+                broken += 1
+                if len(problems) < 20:
+                    problems.append(f"{e['fullPath']}: {fid}: {ex}")
+    lines = [f"verified {files} files / {chunks} chunks under {root}: "
+             f"{broken} broken"]
+    lines += problems
+    return "\n".join(lines)
+
+
+@command("fs.log")
+def cmd_fs_log(env: CommandEnv, args: list[str]) -> str:
+    """command_fs_log.go analog: recent filer metadata log events
+    (-n=20)."""
+    opts = _parse_flags(args)
+    n = int(opts.get("n", 20))
+    st, body, _ = _filer_get(env, "/__meta__/events", "sinceNs=0")
+    if st != 200:
+        raise RuntimeError(f"meta events: {st}")
+    events = json.loads(body).get("events", [])[-n:]
+    lines = []
+    for ev in events:
+        path = ((ev.get("newEntry") or ev.get("oldEntry") or
+                 {}).get("fullPath", "?"))
+        lines.append(f"{ev.get('tsNs', 0)} {ev.get('op', '?'):7s} "
+                     f"{path}")
+    return "\n".join(lines) or "(no events)"
+
+
+@command("fs.meta.notify")
+def cmd_fs_meta_notify(env: CommandEnv, args: list[str]) -> str:
+    """command_fs_meta_notify.go ([dir]): re-emit every entry under
+    dir as a fresh metadata event (re-seeds filer.sync / notification
+    consumers after they lost their position)."""
+    filer = env.require_filer()
+    root = _resolve(env, args[0] if args else "/")
+    n = 0
+    for e in _walk_entries(env, root):
+        _must(http_json("POST", f"{filer}/__meta__/put_entry", e),
+              f"notify {e.get('fullPath')}")
+        n += 1
+    return f"re-emitted {n} entries under {root} into the meta log"

@@ -162,3 +162,27 @@ def remote_unmount(env: CommandEnv, args: list[str]) -> str:
     save_mounts(_filer(env), mounts)
     return (f"unmounted {directory} (entries left in place; "
             f"remove with fs.rm if unwanted)")
+
+
+@command("remote.mount.buckets")
+def remote_mount_buckets(env: CommandEnv, args: list[str]) -> str:
+    """command_remote_mount_buckets.go (-remote=conf): list the remote
+    storage's buckets and mount each under /buckets/<name>."""
+    flags = _parse_flags(args)
+    conf_name = flags.get("remote", "")
+    if not conf_name:
+        return "usage: remote.mount.buckets -remote=conf " \
+               "[-bucketPattern=sub]"
+    pattern = flags.get("bucketPattern", "")
+    filer = _filer(env)
+    conf = load_conf(filer, conf_name)
+    from ..remote.remote_storage import S3RemoteStorage
+    client = S3RemoteStorage.from_conf(conf)
+    mounted = []
+    for bucket in client.list_buckets():
+        if pattern and pattern not in bucket:
+            continue
+        n = mount_remote(filer, f"/buckets/{bucket}", conf_name,
+                         bucket, "")
+        mounted.append(f"/buckets/{bucket} ({n} entries)")
+    return "\n".join(mounted) or "no matching buckets on the remote"

@@ -1,0 +1,401 @@
+"""S3 identity/credential admin shell commands
+(weed/shell/command_s3_user*.go, command_s3_accesskey*.go,
+command_s3_group*.go, command_s3_policy*.go, command_s3_anonymous*.go,
+command_s3_configure.go, command_s3_clean_uploads.go).
+
+All of them operate on the shared IdentityStore JSON config
+(iam/identity.py) — the same file the S3 gateway and IAM API watch by
+mtime, so shell changes propagate live, the way the reference
+propagates credential config through the filer
+(credential/propagating_store.go)."""
+
+from __future__ import annotations
+
+import json
+import secrets
+import time
+import urllib.parse
+
+from ..iam.identity import Credential, Identity, IdentityStore
+from ..server.httpd import http_bytes, http_json
+from .commands import CommandEnv, _must, _parse_flags, command
+
+
+def _store(env: CommandEnv, opts: dict) -> IdentityStore:
+    path = opts.get("config") or getattr(env, "iam_config", "")
+    if not path:
+        raise RuntimeError(
+            "no identities config; pass -config=/path/to/s3.json "
+            "(the file the s3/iam gateways were started with)")
+    env.iam_config = path
+    return IdentityStore(path)
+
+
+def _fmt_identity(i: Identity, verbose: bool = False) -> str:
+    keys = ", ".join(c.access_key + ("" if c.status == "Active"
+                                     else " (inactive)")
+                     for c in i.credentials) or "-"
+    line = (f"{i.name:24s} actions={len(i.actions)} keys=[{keys}]"
+            + (" DISABLED" if getattr(i, 'disabled', False) else ""))
+    if verbose:
+        line += "\n  actions: " + (", ".join(i.actions) or "-")
+    return line
+
+
+# -- users ----------------------------------------------------------------
+
+@command("s3.user.create")
+def cmd_s3_user_create(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_user_create.go (-user=NAME [-actions=a,b]
+    [-config=...]): creates the identity with a fresh access key."""
+    opts = _parse_flags(args)
+    name = opts.get("user", "")
+    if not name:
+        return "usage: s3.user.create -user=NAME [-actions=Read:bucket]"
+    store = _store(env, opts)
+    if store.get(name) is not None:
+        raise RuntimeError(f"user {name!r} already exists")
+    actions = [a for a in opts.get("actions", "").split(",") if a]
+    cred = Credential(access_key=secrets.token_hex(8).upper(),
+                      secret_key=secrets.token_urlsafe(24))
+    store.put(Identity(name, actions=actions, credentials=[cred]))
+    return (f"created {name}\naccessKey: {cred.access_key}\n"
+            f"secretKey: {cred.secret_key}")
+
+
+@command("s3.user.delete")
+def cmd_s3_user_delete(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_user_delete.go (-user=NAME)."""
+    opts = _parse_flags(args)
+    name = opts.get("user", "")
+    store = _store(env, opts)
+    if store.get(name) is None:
+        raise RuntimeError(f"no such user {name!r}")
+    store.delete(name)
+    return f"deleted {name}"
+
+
+@command("s3.user.list")
+def cmd_s3_user_list(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_user_list.go."""
+    store = _store(env, _parse_flags(args))
+    out = [_fmt_identity(i) for i in sorted(store, key=lambda i: i.name)]
+    return "\n".join(out) or "(no identities)"
+
+
+@command("s3.user.show")
+def cmd_s3_user_show(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_user_show.go (-user=NAME)."""
+    opts = _parse_flags(args)
+    i = _store(env, opts).get(opts.get("user", ""))
+    if i is None:
+        raise RuntimeError(f"no such user {opts.get('user')!r}")
+    return _fmt_identity(i, verbose=True)
+
+
+def _set_disabled(env, args, disabled: bool) -> str:
+    opts = _parse_flags(args)
+    store = _store(env, opts)
+    i = store.get(opts.get("user", ""))
+    if i is None:
+        raise RuntimeError(f"no such user {opts.get('user')!r}")
+    i.disabled = disabled
+    store.put(i)
+    return f"{'disabled' if disabled else 'enabled'} {i.name}"
+
+
+@command("s3.user.disable")
+def cmd_s3_user_disable(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_user_disable.go: auth refuses a disabled identity's
+    keys without deleting its config."""
+    return _set_disabled(env, args, True)
+
+
+@command("s3.user.enable")
+def cmd_s3_user_enable(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_user_enable.go."""
+    return _set_disabled(env, args, False)
+
+
+# -- access keys ----------------------------------------------------------
+
+@command("s3.accesskey.create")
+def cmd_s3_accesskey_create(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_accesskey_create.go (-user=NAME): mints an extra key
+    pair for key rotation."""
+    opts = _parse_flags(args)
+    store = _store(env, opts)
+    i = store.get(opts.get("user", ""))
+    if i is None:
+        raise RuntimeError(f"no such user {opts.get('user')!r}")
+    cred = Credential(access_key=secrets.token_hex(8).upper(),
+                      secret_key=secrets.token_urlsafe(24))
+    i.credentials.append(cred)
+    store.put(i)
+    return f"accessKey: {cred.access_key}\nsecretKey: {cred.secret_key}"
+
+
+@command("s3.accesskey.delete")
+def cmd_s3_accesskey_delete(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_accesskey_delete.go (-user=NAME -accessKey=K)."""
+    opts = _parse_flags(args)
+    store = _store(env, opts)
+    i = store.get(opts.get("user", ""))
+    if i is None:
+        raise RuntimeError(f"no such user {opts.get('user')!r}")
+    key = opts.get("accessKey", "")
+    before = len(i.credentials)
+    i.credentials = [c for c in i.credentials if c.access_key != key]
+    if len(i.credentials) == before:
+        raise RuntimeError(f"user {i.name} has no key {key!r}")
+    store.put(i)
+    return f"deleted key {key} of {i.name}"
+
+
+@command("s3.accesskey.list")
+def cmd_s3_accesskey_list(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_accesskey_list.go: every key -> identity mapping."""
+    store = _store(env, _parse_flags(args))
+    lines = []
+    for i in sorted(store, key=lambda i: i.name):
+        for c in i.credentials:
+            lines.append(f"{c.access_key:20s} {i.name:20s} {c.status}")
+    return "\n".join(lines) or "(no access keys)"
+
+
+# -- action grants (the reference's policy attach surface) ---------------
+
+@command("s3.policy.attach")
+def cmd_s3_policy_attach(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_policy.go attach (-user=NAME -actions=a,b): grants
+    identity actions (Read/Write/List/Tagging/Admin[:bucket])."""
+    opts = _parse_flags(args)
+    store = _store(env, opts)
+    i = store.get(opts.get("user", ""))
+    if i is None:
+        raise RuntimeError(f"no such user {opts.get('user')!r}")
+    new = [a for a in opts.get("actions", "").split(",") if a]
+    if not new:
+        return "usage: s3.policy.attach -user=NAME -actions=Read:bucket"
+    i.actions = sorted(set(i.actions) | set(new))
+    # operator grants are static: IAM policy recomputation must not
+    # strip them (identity.py static_actions contract)
+    i.static_actions = sorted(set(i.static_actions) | set(new))
+    store.put(i)
+    return f"{i.name} actions: {', '.join(i.actions)}"
+
+
+@command("s3.policy.detach")
+def cmd_s3_policy_detach(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_policy.go detach."""
+    opts = _parse_flags(args)
+    store = _store(env, opts)
+    i = store.get(opts.get("user", ""))
+    if i is None:
+        raise RuntimeError(f"no such user {opts.get('user')!r}")
+    drop = set(a for a in opts.get("actions", "").split(",") if a)
+    i.actions = [a for a in i.actions if a not in drop]
+    i.static_actions = [a for a in i.static_actions if a not in drop]
+    store.put(i)
+    return f"{i.name} actions: {', '.join(i.actions) or '-'}"
+
+
+# -- anonymous access -----------------------------------------------------
+
+@command("s3.anonymous.get")
+def cmd_s3_anonymous_get(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_anonymous.go: show what unauthenticated requests may
+    do (the identity literally named "anonymous")."""
+    store = _store(env, _parse_flags(args))
+    anon = store.get("anonymous")
+    if anon is None:
+        return "anonymous access: none"
+    return "anonymous actions: " + (", ".join(anon.actions) or "-")
+
+
+@command("s3.anonymous.set")
+def cmd_s3_anonymous_set(env: CommandEnv, args: list[str]) -> str:
+    """Grant/replace anonymous actions (-actions=Read:public,...);
+    empty -actions removes anonymous access."""
+    opts = _parse_flags(args)
+    store = _store(env, opts)
+    actions = [a for a in opts.get("actions", "").split(",") if a]
+    if not actions:
+        store.delete("anonymous")
+        return "anonymous access removed"
+    store.put(Identity("anonymous", actions=actions))
+    return "anonymous actions: " + ", ".join(actions)
+
+
+@command("s3.anonymous.list")
+def cmd_s3_anonymous_list(env: CommandEnv, args: list[str]) -> str:
+    """Buckets anonymously readable under the current grants."""
+    store = _store(env, _parse_flags(args))
+    anon = store.get("anonymous")
+    if anon is None:
+        return "(no anonymous access)"
+    buckets = sorted({a.split(":", 1)[1] for a in anon.actions
+                      if ":" in a} |
+                     ({"*"} if any(":" not in a for a in anon.actions)
+                      else set()))
+    return "\n".join(buckets) or "(no anonymous access)"
+
+
+# -- config ---------------------------------------------------------------
+
+@command("s3.config.show")
+def cmd_s3_config_show(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_configure.go read side: dump the identities JSON."""
+    store = _store(env, _parse_flags(args))
+    return json.dumps(store.to_json(), indent=1)
+
+
+@command("s3.configure")
+def cmd_s3_configure(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_configure.go: point the shell at an identities
+    config (-config=...) and optionally apply a raw identity JSON
+    (-applyJson='{"name": ...}')."""
+    opts = _parse_flags(args)
+    store = _store(env, opts)
+    raw = opts.get("applyJson", "")
+    if raw:
+        d = json.loads(raw)
+        store.put(Identity.from_json(d))
+        return f"applied identity {d.get('name')}"
+    return f"using identities config {store.path} " \
+           f"({sum(1 for _ in store)} identities)"
+
+
+# -- multipart hygiene ----------------------------------------------------
+
+@command("s3.clean.uploads")
+def cmd_s3_clean_uploads(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_clean_uploads.go (-timeAgo=24h): purge aged
+    multipart-upload scratch dirs under the filer's /.uploads."""
+    opts = _parse_flags(args)
+    spec = opts.get("timeAgo", "24h")
+    mult = {"s": 1, "m": 60, "h": 3600, "d": 86400}
+    try:
+        age = float(spec[:-1]) * mult[spec[-1]] \
+            if spec[-1] in mult else float(spec)
+    except ValueError:
+        raise RuntimeError(f"bad -timeAgo {spec!r} (Ns/Nm/Nh/Nd)")
+    filer = env.require_filer()
+    st, body, _ = http_bytes(
+        "GET", f"{filer}{urllib.parse.quote('/.uploads/')}?limit=1000")
+    if st == 404:
+        return "purged 0 multipart uploads"
+    entries = json.loads(body).get("entries", [])
+    cutoff = time.time() - age
+    purged = 0
+    for e in entries:
+        mtime = e.get("attributes", {}).get("mtime", 0)
+        if mtime and mtime < cutoff:
+            _must(http_json(
+                "DELETE",
+                f"{filer}{urllib.parse.quote(e['fullPath'])}"
+                f"?recursive=true"), f"purge {e['fullPath']}")
+            purged += 1
+    return f"purged {purged} multipart uploads older than {spec}"
+
+
+# -- bucket administration (command_s3_bucket_*.go) -----------------------
+
+def _bucket_entry(env: CommandEnv, bucket: str) -> dict:
+    filer = env.require_filer()
+    st, body, _ = http_bytes(
+        "GET", f"{filer}/__meta__/lookup?path=" +
+        urllib.parse.quote(f"/buckets/{bucket}"))
+    if st != 200:
+        raise RuntimeError(f"no bucket {bucket!r} ({st})")
+    return json.loads(body)
+
+
+def _patch_bucket(env: CommandEnv, bucket: str, extended: dict) -> None:
+    filer = env.require_filer()
+    _bucket_entry(env, bucket)  # existence check
+    _must(http_json("POST", f"{filer}/__meta__/patch_extended",
+                    {"path": f"/buckets/{bucket}",
+                     "extended": extended}),
+          f"update bucket {bucket}")
+
+
+@command("s3.bucket.versioning")
+def cmd_s3_bucket_versioning(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_bucket_versioning.go (-bucket=B
+    [-status=Enabled|Suspended]): read or set the bucket versioning
+    state the gateway enforces (stored on the bucket entry, the same
+    place PutBucketVersioning writes)."""
+    opts = _parse_flags(args)
+    bucket = opts.get("bucket", "")
+    if not bucket:
+        return "usage: s3.bucket.versioning -bucket=B [-status=Enabled]"
+    status = opts.get("status", "")
+    if status:
+        if status not in ("Enabled", "Suspended"):
+            raise RuntimeError("status must be Enabled or Suspended")
+        _patch_bucket(env, bucket, {"versioning": status})
+        return f"{bucket}: versioning {status}"
+    e = _bucket_entry(env, bucket)
+    return f"{bucket}: versioning " \
+           f"{e.get('extended', {}).get('versioning') or 'unset'}"
+
+
+@command("s3.bucket.owner")
+def cmd_s3_bucket_owner(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_bucket_owner.go analog (-bucket=B [-owner=ID]):
+    read/set the owning account id recorded on the bucket entry (the
+    gateway's ACL owner checks read it)."""
+    opts = _parse_flags(args)
+    bucket = opts.get("bucket", "")
+    if not bucket:
+        return "usage: s3.bucket.owner -bucket=B [-owner=accountId]"
+    owner = opts.get("owner", "")
+    if owner:
+        _patch_bucket(env, bucket, {"x-amz-owner-id": owner})
+        return f"{bucket}: owner {owner}"
+    e = _bucket_entry(env, bucket)
+    return f"{bucket}: owner " \
+           f"{e.get('extended', {}).get('x-amz-owner-id') or 'unset'}"
+
+
+@command("s3.user.provision")
+def cmd_s3_user_provision(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_user_provision.go shape: one-shot onboarding —
+    create the user (if absent), a bucket named for it (if absent),
+    and grant the user full access to that bucket."""
+    opts = _parse_flags(args)
+    name = opts.get("user", "")
+    if not name:
+        return "usage: s3.user.provision -user=NAME [-bucket=B]"
+    bucket = opts.get("bucket", name)
+    store = _store(env, opts)
+    created_user = False
+    i = store.get(name)
+    key_note = ""
+    if i is None:
+        cred = Credential(access_key=secrets.token_hex(8).upper(),
+                          secret_key=secrets.token_urlsafe(24))
+        i = Identity(name, credentials=[cred])
+        created_user = True
+        key_note = (f"\naccessKey: {cred.access_key}"
+                    f"\nsecretKey: {cred.secret_key}")
+    grants = {f"Read:{bucket}", f"Write:{bucket}", f"List:{bucket}",
+              f"Tagging:{bucket}"}
+    i.actions = sorted(set(i.actions) | grants)
+    i.static_actions = sorted(set(i.static_actions) | grants)
+    store.put(i)
+    filer = env.require_filer()
+    st, _, _ = http_bytes(
+        "HEAD", f"{filer}/buckets/{urllib.parse.quote(bucket)}")
+    created_bucket = False
+    if st != 200:
+        _must(http_json("POST", f"{filer}/__meta__/create",
+                        {"path": f"/buckets/{bucket}",
+                         "isDirectory": True}),
+              f"create bucket {bucket}")
+        created_bucket = True
+    return (f"{'created' if created_user else 'updated'} user {name}; "
+            f"{'created' if created_bucket else 'kept'} bucket "
+            f"{bucket}; granted {', '.join(sorted(grants))}"
+            + key_note)

@@ -383,3 +383,131 @@ def test_group_session_timeout_expels_dead_member(kafka):
     assert gc1.join() == {"mortal": [0, 1]}
     gc1.leave()
     c2.close()
+
+
+# -- round-5 version breadth ----------------------------------------------
+
+def test_wide_version_negotiation_advertised(kafka):
+    client, gw, broker = kafka
+    versions = client.api_versions()
+    assert versions[0] == (3, 5)     # Produce (record batches v2 only)
+    assert versions[1] == (4, 7)     # Fetch
+    assert versions[3] == (1, 5)     # Metadata
+    assert versions[9] == (1, 3)     # OffsetFetch
+
+
+def test_produce_fetch_across_versions(kafka):
+    """Every advertised Produce/Fetch version round-trips byte-exact —
+    clients pick ANY version in the intersection, so v0 and v7 must
+    both be correct, not just the max."""
+    from seaweedfs_tpu.mq.kafka_client import encode_produce_batch
+    from seaweedfs_tpu.mq.kafka_wire import (enc_array, enc_bytes,
+                                             enc_i8, enc_i16, enc_i32,
+                                             enc_i64, enc_string)
+
+    client, gw, broker = kafka
+    client.create_topic("wide", partitions=1)
+    offsets = {}
+    for v in range(3, 6):            # Produce v3..v5 (batch v2 era)
+        batch = encode_produce_batch([(b"k", b"v%d" % v)],
+                                     base_ts_ms=1000)
+        body = b""
+        if v >= 3:
+            body += enc_string(None)             # transactional_id
+        body += enc_i16(1) + enc_i32(5000)       # acks, timeout
+        body += enc_array([enc_string("wide") + enc_array(
+            [enc_i32(0) + enc_bytes(batch)])])
+        r = client._rpc(0, v, body)
+        assert r.i32() == 1                      # one topic
+        assert r.string() == "wide"
+        assert r.i32() == 1                      # one partition
+        assert r.i32() == 0                      # partition index
+        assert r.i16() == 0                      # no error
+        offsets[v] = r.i64()                     # base offset
+        if v >= 2:
+            r.i64()                              # log_append_time
+        if v >= 5:
+            r.i64()                              # log_start_offset
+        if v >= 1:
+            assert r.i32() == 0                  # throttle
+        assert r.remaining() == 0, f"Produce v{v} trailing bytes"
+    assert sorted(offsets.values()) == list(offsets.values())
+
+    for v in range(4, 8):            # Fetch v4..v7
+        body = (enc_i32(-1) + enc_i32(100) + enc_i32(1) +
+                enc_i32(1 << 20) + enc_i8(0))
+        if v >= 7:
+            body += enc_i32(0) + enc_i32(-1)     # session id/epoch
+        part = enc_i32(0) + enc_i64(0)
+        if v >= 5:
+            part += enc_i64(0)                   # log_start_offset
+        part += enc_i32(1 << 20)
+        body += enc_array([enc_string("wide") + enc_array([part])])
+        if v >= 7:
+            body += enc_i32(0)                   # forgotten topics
+        r = client._rpc(1, v, body)
+        assert r.i32() == 0                      # throttle
+        if v >= 7:
+            assert r.i16() == 0                  # error_code
+            r.i32()                              # session_id
+        assert r.i32() == 1 and r.string() == "wide"
+        assert r.i32() == 1 and r.i32() == 0
+        assert r.i16() == 0                      # no error
+        hwm = r.i64()
+        assert hwm > 0
+        r.i64()                                  # last_stable
+        if v >= 5:
+            r.i64()                              # log_start_offset
+        assert r.i32() == 0                      # aborted txns
+        data = r.bytes_() or b""
+        recs = decode_record_batches(data)
+        assert [rec["value"] for rec in recs] == \
+            [b"v%d" % i for i in range(3, 6)]
+        assert r.remaining() == 0, f"Fetch v{v} trailing bytes"
+
+
+def test_metadata_and_group_api_versions(kafka):
+    from seaweedfs_tpu.mq.kafka_wire import (enc_array, enc_i8,
+                                             enc_i32, enc_string)
+
+    client, gw, broker = kafka
+    client.create_topic("meta-v", partitions=2)
+    for v in range(1, 6):            # Metadata v1..v5
+        body = enc_array([enc_string("meta-v")])
+        if v >= 4:
+            body += enc_i8(0)                    # no auto-create
+        r = client._rpc(3, v, body)
+        if v >= 3:
+            assert r.i32() == 0                  # throttle
+        nb = r.i32()
+        assert nb == 1
+        r.i32(); r.string(); r.i32(); r.string()  # broker entry
+        if v >= 2:
+            assert r.string() == "seaweedfs-tpu"  # cluster_id
+        r.i32()                                  # controller
+        assert r.i32() == 1                      # topics
+        assert r.i16() == 0 and r.string() == "meta-v"
+        r.i8()                                   # is_internal
+        nparts = r.i32()
+        assert nparts == 2
+        for _ in range(nparts):
+            r.i16(); r.i32(); r.i32()
+            for _ in range(r.i32()):
+                r.i32()                          # replicas
+            for _ in range(r.i32()):
+                r.i32()                          # isr
+            if v >= 5:
+                for _ in range(r.i32()):
+                    r.i32()                      # offline
+        assert r.remaining() == 0, f"Metadata v{v} trailing bytes"
+
+    # FindCoordinator v1 carries key_type + error_message
+    body = enc_string("grp-v") + enc_i8(0)
+    r = client._rpc(10, 1, body)
+    assert r.i32() == 0                          # throttle
+    assert r.i16() == 0
+    assert r.string() is None                    # error_message
+    r.i32()
+    assert r.string() == "127.0.0.1"
+    assert r.i32() == gw.port
+    assert r.remaining() == 0

@@ -475,3 +475,56 @@ def test_repartition_migrates_racing_peer_publishes(cluster):
         assert not missing, f"lost acknowledged publishes: {missing}"
     finally:
         broker_b.stop()
+
+
+def test_hot_tail_ring_serves_without_filer_io(cluster, monkeypatch):
+    """VERDICT r4 #10: recently FLUSHED pages stay in an in-memory
+    ring (util/log_buffer's prevBuffers role), so a subscriber
+    resuming within the ring's window is served with ZERO filer
+    round-trips — and the memory/disk boundary handoff returns
+    exactly what a cold disk read returns."""
+    from seaweedfs_tpu.mq import logstore
+    from seaweedfs_tpu.mq.topic import Partition
+
+    _, _, filer, _broker = cluster
+    from seaweedfs_tpu.mq.topic import Topic
+    t = Topic("ring", "hot")
+    p = Partition(0, 4096)
+    log = logstore.PartitionLog(filer.url, t, p)
+    stamps = []
+    # enough appends to flush several pages (flush threshold) while
+    # keeping everything inside the 4MB ring
+    payload = "x" * 400
+    import base64
+    v = base64.b64encode(payload.encode()).decode()
+    for i in range(2000):
+        stamps.append(log.append("", v, 0))
+    log.flush()
+    assert len(log._ring) >= 1 and log._ring_floor < stamps[-1]
+
+    calls = []
+    real = logstore.http_bytes
+
+    def counting(method, url, *a, **kw):
+        calls.append(url)
+        return real(method, url, *a, **kw)
+
+    monkeypatch.setattr(logstore, "http_bytes", counting)
+    # resume INSIDE the ring window but BELOW the last flushed stamp:
+    # previously this always scanned filer segments
+    resume = stamps[-500]
+    assert resume >= log._ring_floor
+    hot = log.read_since(resume)
+    assert [r["tsNs"] for r in hot] == stamps[-499:]
+    assert calls == [], f"hot tail read hit the filer: {calls[:3]}"
+
+    # handoff correctness: a resume point BELOW the ring floor takes
+    # the disk path and must splice seamlessly into ring/buffer rows
+    monkeypatch.setattr(logstore, "http_bytes", real)
+    cold_resume = log._ring_floor - 1 if log._ring_floor > 1 else 0
+    cold = log.read_since(stamps[0] - 1)
+    assert [r["tsNs"] for r in cold] == stamps
+    # a FRESH log object (restart: empty ring) reads the same bytes
+    log2 = logstore.PartitionLog(filer.url, t, p)
+    cold2 = log2.read_since(stamps[0] - 1)
+    assert [r["tsNs"] for r in cold2] == stamps

@@ -224,3 +224,105 @@ def test_bulk_file_transfer_streams_with_bounded_memory(harness,
         "&offset=1048576&size=2097152", str(dest))
     assert status == 200
     assert dest.read_bytes() == blob[1 << 20:(1 << 20) + (2 << 20)]
+
+
+def test_admin_state_survives_restart(tmp_path):
+    """VERDICT r4 #7 done-criterion: jobs, dedupe keys, decision
+    traces, worker registry and config survive an admin restart
+    (persistence under <dataDir>/plugin/, admin/plugin/DESIGN.md)."""
+    from seaweedfs_tpu.plugin.admin import AdminServer
+
+    d = str(tmp_path / "admin")
+    master = MasterServer(volume_size_limit_mb=8).start()
+    try:
+        admin = AdminServer(master.url, detection_interval=3600,
+                            data_dir=d).start()
+        # register a worker with a schema-bearing descriptor
+        r = http_json("POST", f"{admin.url}/worker/register", {
+            "capabilities": [{"jobType": "erasure_coding",
+                              "canDetect": True,
+                              "canExecute": True}],
+            "descriptors": [{"jobType": "erasure_coding", "fields": [
+                {"name": "fullnessRatio", "type": "float",
+                 "default": 0.9}]}],
+            "maxConcurrent": 2})
+        wid = r["workerId"]
+        # set config through the schema-validated store
+        r = http_json("POST", f"{admin.url}/maintenance/config",
+                      {"jobType": "erasure_coding",
+                       "values": {"fullnessRatio": 0.5}})
+        assert r["values"]["fullnessRatio"] == 0.5
+        # bad field/type rejected
+        assert "error" in http_json(
+            "POST", f"{admin.url}/maintenance/config",
+            {"jobType": "erasure_coding", "values": {"nope": 1}})
+        assert "error" in http_json(
+            "POST", f"{admin.url}/maintenance/config",
+            {"jobType": "erasure_coding",
+             "values": {"fullnessRatio": "not-a-number"}})
+        # submit a job; have the (fake) worker pick it up
+        r = http_json("POST", f"{admin.url}/maintenance/submit_job",
+                      {"jobType": "erasure_coding",
+                       "params": {"volumeId": 7},
+                       "dedupeKey": "ec:7"})
+        jid = r["jobId"]
+        msg = http_json("POST", f"{admin.url}/worker/poll",
+                        {"workerId": wid, "waitSeconds": 2})
+        assert msg["type"] == "executeJob" and msg["jobId"] == jid
+        detail = http_json("GET",
+                           f"{admin.url}/maintenance/job?id={jid}")
+        events = [t["event"] for t in detail["trace"]]
+        assert any("submitted" in e for e in events)
+        assert any("assigned" in e for e in events)
+        admin.stop()
+
+        # restart: everything is still there
+        admin2 = AdminServer(master.url, detection_interval=3600,
+                             data_dir=d).start()
+        try:
+            detail = http_json(
+                "GET", f"{admin2.url}/maintenance/job?id={jid}")
+            assert detail["jobType"] == "erasure_coding"
+            # live assignment was requeued on recovery, trace says so
+            assert detail["status"] == "pending"
+            assert any("admin restart" in t["event"]
+                       for t in detail["trace"])
+            # dedupe key still guards: resubmit dedupes to the old job
+            r = http_json("POST",
+                          f"{admin2.url}/maintenance/submit_job",
+                          {"jobType": "erasure_coding",
+                           "params": {"volumeId": 7},
+                           "dedupeKey": "ec:7"})
+            assert r.get("deduped") and r["jobId"] == jid
+            # worker registry survived: a poll from the old worker id
+            # is NOT a 404, and the job reassigns to it
+            msg = http_json("POST", f"{admin2.url}/worker/poll",
+                            {"workerId": wid, "waitSeconds": 2})
+            assert msg["type"] == "executeJob" and msg["jobId"] == jid
+            # schema + config survived
+            cfg = http_json("GET", f"{admin2.url}/maintenance/config")
+            ec = cfg["jobTypes"]["erasure_coding"]
+            assert ec["values"]["fullnessRatio"] == 0.5
+            assert any(f["name"] == "fullnessRatio"
+                       for f in ec["fields"])
+        finally:
+            admin2.stop()
+    finally:
+        master.stop()
+
+
+def test_config_reaches_worker_detection(harness):
+    """Operator config flows admin -> worker handlers with the next
+    RunDetection (SchemaCoordinator -> detector path)."""
+    master, servers, admin, worker = harness
+    h = worker.handlers["erasure_coding"]
+    assert h.fullness_ratio != 0.123
+    r = http_json("POST", f"{admin.url}/maintenance/config",
+                  {"jobType": "erasure_coding",
+                   "values": {"fullnessRatio": 0.123}})
+    assert "error" not in r
+    http_json("POST", f"{admin.url}/maintenance/trigger_detection", {})
+    deadline = time.time() + 10
+    while time.time() < deadline and h.fullness_ratio != 0.123:
+        time.sleep(0.1)
+    assert h.fullness_ratio == 0.123
