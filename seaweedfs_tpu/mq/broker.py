@@ -611,6 +611,8 @@ class BrokerServer:
                 if t_e.get("isDirectory"):
                     topics.append(Topic(
                         ns, t_e["fullPath"].rsplit("/", 1)[-1]))
+        import hashlib as _hashlib
+        changed: list[Topic] = []
         for t in topics:
             try:
                 lock = ClusterLock(
@@ -629,20 +631,53 @@ class BrokerServer:
                     with self._lock:
                         old = list(self._owners.get(t) or
                                    [self.url] * len(parts))
-                    new = [live[i % len(live)]
+                    # per-topic starting offset: plain round-robin
+                    # from live[0] would pile every single-partition
+                    # topic onto ONE broker — the exact skew balance
+                    # exists to fix
+                    base = int(_hashlib.sha1(
+                        str(t).encode()).hexdigest()[:8], 16)
+                    new = [live[(base + i) % len(live)]
                            for i in range(len(parts))]
                     if new != old:
-                        # flush our tails for partitions we lose
-                        with self._lock:
-                            logs = [log for (lt, _p), log
-                                    in self._logs.items() if lt == t]
-                        for log in logs:
-                            log.flush()
                         if self._persist_layout(t, parts, new) is None:
                             moved += sum(1 for a, b in zip(old, new)
                                          if a != b)
+                            changed.append(t)
             finally:
                 lock.release()
+        if changed:
+            # Stranding fence (same shape as _repartition): wait out
+            # every broker's conf cache so de-owned brokers stop
+            # admitting appends, then have EVERY registered broker
+            # (self included) flush its tails for the moved topics AND
+            # drop log objects for partitions it no longer owns — a
+            # retained PartitionLog's memory window would later hide
+            # the interim owner's persisted messages.
+            time.sleep(self.CONF_TTL + 0.1)
+            try:
+                registered = set(self._registered_brokers()) | \
+                    {self.url}
+            except RuntimeError as e:
+                return 503, {"error": f"broker registry: {e}",
+                             "movedPartitions": moved}
+            unflushed = []
+            for t in changed:
+                for peer in sorted(registered):
+                    try:
+                        st_f, _, _ = http_bytes(
+                            "POST", f"{peer}/topics/flush",
+                            json.dumps({"namespace": t.namespace,
+                                        "topic": t.name}).encode())
+                    except OSError:
+                        st_f = 0
+                    if st_f != 200:
+                        unflushed.append(f"{t}@{peer}")
+            if unflushed:
+                return 503, {"error":
+                             "balance applied but tails unconfirmed "
+                             "on: " + ", ".join(unflushed[:10]),
+                             "movedPartitions": moved}
         return 200, {"brokers": live, "topics": len(topics),
                      "movedPartitions": moved}
 
@@ -673,15 +708,28 @@ class BrokerServer:
                          if p != self.url]
             except RuntimeError as e:
                 return 503, {"error": str(e)}
+            peer_failures = []
             for peer in peers:
                 try:
-                    http_bytes("POST", f"{peer}/topics/truncate",
-                               json.dumps({
-                                   "namespace": t.namespace,
-                                   "topic": t.name,
-                                   "localOnly": True}).encode())
-                except OSError:
-                    pass    # dead peer holds no servable tail
+                    st_p, body_p, _ = http_bytes(
+                        "POST", f"{peer}/topics/truncate",
+                        json.dumps({
+                            "namespace": t.namespace,
+                            "topic": t.name,
+                            "localOnly": True}).encode())
+                except OSError as e:
+                    st_p, body_p = 0, str(e).encode()
+                if st_p != 200:
+                    peer_failures.append(
+                        f"{peer}: {st_p} {body_p[:80]!r}")
+            if peer_failures:
+                # an unreachable-but-ALIVE peer still holds its tail
+                # and would re-flush the "truncated" messages later —
+                # abort BEFORE deleting dirs so state stays coherent
+                # (registered-but-crashed peers: deregister them or
+                # retry once they drop from the registry)
+                return 503, {"error": "peer tails not dropped: "
+                                      + "; ".join(peer_failures)}
             failures = []
             with self._topic_lock(t).write():
                 for p in parts:
@@ -1083,11 +1131,28 @@ class BrokerServer:
         # before we confirm.
         with self._topic_lock(t).write():
             with self._lock:
-                logs = [log for (lt, _p), log in self._logs.items()
-                        if lt == t]
-            for log in logs:
+                items = [(p, log) for (lt, p), log
+                         in self._logs.items() if lt == t]
+            for _p, log in items:
                 log.flush()
                 flushed += 1
+            # drop log objects for partitions this broker no longer
+            # owns (fresh conf): a retained PartitionLog's memory
+            # window (_ring_floor short-circuit) would hide messages
+            # another owner persists while we are de-owned, if
+            # ownership ever returns here
+            try:
+                parts = self._load_layout(t, fresh=True)
+            except RuntimeError:
+                parts = None
+            if parts is not None:
+                with self._lock:
+                    owners = self._owners.get(t) or []
+                    mine = {p for p, o in zip(parts, owners)
+                            if o == self.url}
+                    for p, _log in items:
+                        if p not in mine:
+                            self._logs.pop((t, p), None)
         return 200, {"flushed": flushed}
 
     # -- consumer-group offsets -------------------------------------------

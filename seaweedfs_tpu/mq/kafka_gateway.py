@@ -233,8 +233,11 @@ class KafkaGateway:
         return out
 
     def _create_topics(self, r: Reader, v: int = 0) -> bytes:
+        # parse the WHOLE request before acting: v1's validate_only
+        # flag trails the topic list, and a dry-run request must not
+        # mutate the broker
         n = r.i32()
-        results = []
+        wanted = []
         for _ in range(n):
             name = r.string()
             num_partitions = r.i32()
@@ -247,10 +250,18 @@ class KafkaGateway:
             for _ in range(r.i32()):     # configs
                 r.string()
                 r.string()
+            wanted.append((name, num_partitions))
+        if r.remaining() >= 4:
+            r.i32()                      # timeout_ms
+        validate_only = False
+        if v >= 1 and r.remaining() >= 1:
+            validate_only = bool(r.i8())
+        results = []
+        for name, num_partitions in wanted:
             code = NONE
             if self._partition_count(name) is not None:
                 code = TOPIC_ALREADY_EXISTS
-            else:
+            elif not validate_only:
                 try:
                     self.mq.configure_topic(
                         NAMESPACE, name,
@@ -264,10 +275,6 @@ class KafkaGateway:
                         else UNKNOWN_SERVER_ERROR
             results.append(enc_string(name) + enc_i16(code) +
                            (enc_string(None) if v >= 1 else b""))
-        if r.remaining() >= 4:
-            r.i32()                      # timeout_ms
-        if v >= 1 and r.remaining() >= 1:
-            r.i8()                       # validate_only
         return (enc_i32(0) if v >= 2 else b"") + enc_array(results)
 
     def _produce(self, r: Reader, v: int = 3) -> "bytes | None":

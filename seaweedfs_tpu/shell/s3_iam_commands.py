@@ -281,21 +281,31 @@ def cmd_s3_clean_uploads(env: CommandEnv, args: list[str]) -> str:
     except ValueError:
         raise RuntimeError(f"bad -timeAgo {spec!r} (Ns/Nm/Nh/Nd)")
     filer = env.require_filer()
-    st, body, _ = http_bytes(
-        "GET", f"{filer}{urllib.parse.quote('/.uploads/')}?limit=1000")
+    # multipart scratch lives PER BUCKET: /buckets/<b>/.uploads/<id>
+    # (s3_server.py UPLOADS_DIR under _bucket_path)
+    st, body, _ = http_bytes("GET", f"{filer}/buckets/?limit=1000")
     if st == 404:
         return "purged 0 multipart uploads"
-    entries = json.loads(body).get("entries", [])
+    buckets = [e["fullPath"].rsplit("/", 1)[-1]
+               for e in json.loads(body).get("entries", [])
+               if e.get("isDirectory")]
     cutoff = time.time() - age
     purged = 0
-    for e in entries:
-        mtime = e.get("attributes", {}).get("mtime", 0)
-        if mtime and mtime < cutoff:
-            _must(http_json(
-                "DELETE",
-                f"{filer}{urllib.parse.quote(e['fullPath'])}"
-                f"?recursive=true"), f"purge {e['fullPath']}")
-            purged += 1
+    for bucket in buckets:
+        st, body, _ = http_bytes(
+            "GET", f"{filer}/buckets/"
+                   f"{urllib.parse.quote(bucket)}/.uploads/"
+                   f"?limit=1000")
+        if st != 200:
+            continue
+        for e in json.loads(body).get("entries", []):
+            mtime = e.get("attributes", {}).get("mtime", 0)
+            if mtime and mtime < cutoff:
+                _must(http_json(
+                    "DELETE",
+                    f"{filer}{urllib.parse.quote(e['fullPath'])}"
+                    f"?recursive=true"), f"purge {e['fullPath']}")
+                purged += 1
     return f"purged {purged} multipart uploads older than {spec}"
 
 

@@ -274,3 +274,44 @@ def test_mq_balance_and_truncate(cluster, tmp_path):
     finally:
         broker_b.stop()
         broker_a.stop()
+
+
+def test_s3_clean_uploads_purges_aged_scratch(cluster):
+    """s3.clean.uploads walks /buckets/<b>/.uploads (the real
+    multipart scratch location) and purges aged upload dirs only."""
+    master, servers, filer, env, _ = cluster
+    filer.filer.write_file("/buckets/up/.uploads/u-old/part1",
+                          b"aged")
+    filer.filer.write_file("/buckets/up/keep.txt", b"data")
+    out = run_command(env, "s3.clean.uploads -timeAgo=1d")
+    assert "purged 0" in out      # fresh scratch is protected
+    out = run_command(env, "s3.clean.uploads -timeAgo=0s")
+    assert "purged 1" in out
+    assert filer.filer.find_entry(
+        "/buckets/up/.uploads/u-old/part1") is None
+    assert filer.filer.read_file("/buckets/up/keep.txt") == b"data"
+
+
+def test_mq_balance_spreads_single_partition_topics(cluster):
+    """Hash-offset round-robin: many 1-partition topics spread across
+    brokers instead of piling onto live[0]."""
+    from seaweedfs_tpu.mq import BrokerServer
+    from seaweedfs_tpu.mq.client import MQClient
+
+    master, servers, filer, env, _ = cluster
+    a = BrokerServer(filer.http.url).start()
+    b = BrokerServer(filer.http.url).start()
+    try:
+        c = MQClient(a.url)
+        for i in range(8):
+            c.configure_topic("spread", f"t{i}", 1)
+        out = run_command(env, f"mq.balance -broker={a.url}")
+        assert "error" not in out.lower() or "unconfirmed" not in out
+        owners = set()
+        for i in range(8):
+            owners |= {x["broker"]
+                       for x in c.lookup("spread", f"t{i}")}
+        assert owners == {a.url, b.url}, owners
+    finally:
+        b.stop()
+        a.stop()
