@@ -28,13 +28,16 @@ import numpy as np
 
 from . import gf256, rs_matrix
 
-# [256, 8] uint8: MUL_BY_POW2[c, b] = c * 2^b in GF(2^8)
-_MUL_BY_POW2 = jnp.asarray(gf256.MUL_BY_POW2)
-
-
 def _expand_tables(mat: jax.Array) -> jax.Array:
-    """[R, K] constant matrix -> [R, K, 8] per-bit multiply tables."""
-    return _MUL_BY_POW2[mat]
+    """[R, K] constant matrix -> [R, K, 8] per-bit multiply tables.
+
+    MUL_BY_POW2 ([256, 8] uint8: c * 2^b in GF(2^8)) is embedded as a
+    trace-time constant rather than a module-level device array: a
+    module-level device_put would initialize the default JAX backend
+    at IMPORT time — on a box whose tunneled-TPU platform is wedged,
+    merely importing this module would hang even for callers that then
+    pin the CPU platform (graft dryrun, tests)."""
+    return jnp.asarray(gf256.MUL_BY_POW2)[mat]
 
 
 def expand_tables_u32(mat: jax.Array) -> jax.Array:
