@@ -633,11 +633,16 @@ def _one_pooled_request(method: str, full_url: str, body,
             # request may have EXECUTED server-side (response lost):
             # transparently retrying a POST here would double-execute
             # non-idempotent operations (publish, delete counters), so
-            # only idempotent reads retry — everything else surfaces
-            # the ambiguity to the caller (Go Transport's rule)
+            # only idempotent methods (RFC 9110 §9.2.2: GET/HEAD/PUT/
+            # DELETE/OPTIONS — urllib3's default retry set) re-issue,
+            # once, even on a FRESH connection: a loaded threaded
+            # server can drop an accepted connection before
+            # responding.  Everything else surfaces the ambiguity to
+            # the caller (Go Transport's rule).
             conn.close()
             _pool().pop(key, None)
-            if reused and attempt == 0 and method in ("GET", "HEAD"):
+            if attempt == 0 and method in ("GET", "HEAD", "PUT",
+                                           "DELETE", "OPTIONS"):
                 continue
             if isinstance(e, OSError):
                 raise
