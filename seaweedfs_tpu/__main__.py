@@ -100,7 +100,9 @@ def main(argv: list[str] | None = None) -> int:
     fl.add_argument("-notification", default="",
                     help="metadata notification sink "
                          "(weed/notification): webhook:http://...,"
-                         " mq:broker/ns/topic, or logfile:/path")
+                         " mq:broker/ns/topic, kafka:host:port/topic"
+                         " (real Kafka wire protocol, any broker),"
+                         " or logfile:/path")
     fl.add_argument("-lockPeers", dest="lock_peers", default="",
                     help="comma-separated filer addresses forming the "
                          "distributed-lock ring (give every filer the "

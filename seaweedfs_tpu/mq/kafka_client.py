@@ -70,10 +70,18 @@ class KafkaClient:
             self.sock.sendall(struct.pack(">i", len(frame)) + frame)
             buf = b""
             while len(buf) < 4:
-                buf += self.sock.recv(65536)
+                chunk = self.sock.recv(65536)
+                if not chunk:
+                    # peer closed: raising (not spinning on b"") lets
+                    # long-running callers (notification sink) re-dial
+                    raise OSError("kafka connection closed by peer")
+                buf += chunk
             size = struct.unpack(">i", buf[:4])[0]
             while len(buf) < 4 + size:
-                buf += self.sock.recv(65536)
+                chunk = self.sock.recv(65536)
+                if not chunk:
+                    raise OSError("kafka connection closed mid-frame")
+                buf += chunk
         r = Reader(buf[4:4 + size])
         got = r.i32()
         if got != corr:

@@ -12,6 +12,8 @@ once delivery (checkpointed offset, per-event retries with backoff).
 Spec strings:
     webhook:http://host:port/path     POST one JSON event per request
     mq:broker_addr/namespace/topic    publish to the built-in MQ broker
+    kafka:host:port/topic             REAL Kafka wire protocol (any
+                                      Kafka-compatible broker)
     logfile:/path/to/file             append JSON lines (debug/audit)
 """
 
@@ -29,6 +31,13 @@ class Publisher:
 
     def close(self) -> None:
         pass
+
+
+def _event_key(event: dict) -> str:
+    """One key rule for every sink: the entry path (per-path ordering
+    in partitioned topics depends on all sinks agreeing)."""
+    return (event.get("newEntry") or event.get("oldEntry") or
+            {}).get("fullPath", "")
 
 
 class WebhookPublisher(Publisher):
@@ -75,14 +84,85 @@ class MqPublisher(Publisher):
                     self._configured = True
                 except RuntimeError as e:
                     raise OSError(str(e)) from None
-        key = (event.get("newEntry") or event.get("oldEntry") or
-               {}).get("fullPath", "")
+        key = _event_key(event)
         try:
             self._client.publish(self.namespace, self.topic,
                                  key.encode(),
                                  json.dumps(event).encode())
         except RuntimeError as e:  # broker-side error: retryable
             raise OSError(str(e)) from None
+
+
+class KafkaPublisher(Publisher):
+    """Publish metadata events over the REAL Kafka wire protocol
+    (weed/notification/kafka/kafka_queue.go role): works against any
+    Kafka-compatible broker — including our own gateway — via the
+    binary-protocol client (mq/kafka_client.py; CRC32C v2 record
+    batches, ApiVersions negotiation).  Events are keyed by entry
+    path so per-path ordering survives partitioned topics."""
+
+    def __init__(self, host: str, port: int, topic: str,
+                 partitions: int = 4):
+        from ..mq.kafka_client import KafkaClient
+        self.host, self.port = host, port
+        self.topic = topic
+        self.partitions = partitions
+        self._client: "KafkaClient | None" = None
+        self._npart = 0
+
+    def _ensure(self):
+        from ..mq.kafka_client import KafkaClient
+        if self._client is None:
+            self._client = KafkaClient(self.host, self.port)
+        if not self._npart:
+            def live_parts():
+                md = self._client.metadata([self.topic])
+                info = md["topics"].get(self.topic)
+                if info and not info["error"]:
+                    return info["partitions"]
+                return []
+            parts = live_parts()
+            if not parts:
+                self._client.create_topic(self.topic,
+                                          self.partitions)
+                parts = live_parts()
+            if not parts:
+                raise OSError(f"kafka topic {self.topic} not "
+                              f"creatable")
+            self._npart = len(parts)
+        return self._client
+
+    def publish(self, event: dict) -> None:
+        import zlib
+
+        from ..mq.kafka_client import KafkaError
+        key = _event_key(event).encode()
+        try:
+            c = self._ensure()
+            # DETERMINISTIC key hash: Python's hash() is salted per
+            # process, which would re-shuffle the key->partition map
+            # on every restart and break per-path ordering
+            part = zlib.crc32(key) % self._npart
+            c.produce(self.topic, part,
+                      [(key, json.dumps(event).encode())])
+        except (KafkaError, OSError, RuntimeError) as e:
+            # drop the connection so the retry re-dials + renegotiates
+            if self._client is not None:
+                try:
+                    self._client.close()
+                except OSError:
+                    pass
+            self._client = None
+            self._npart = 0
+            raise OSError(str(e)) from None
+
+    def close(self) -> None:
+        if self._client is not None:
+            try:
+                self._client.close()
+            except OSError:
+                pass
+            self._client = None
 
 
 class LogFilePublisher(Publisher):
@@ -119,8 +199,15 @@ def from_spec(spec: str) -> Publisher:
             raise ValueError(
                 f"mq spec must be mq:broker/namespace/topic: {spec!r}")
         return MqPublisher(broker, ns, topic)
+    if kind == "kafka":
+        addr, _, topic = rest.partition("/")
+        host, _, port = addr.rpartition(":")
+        if not (host and port.isdigit() and topic):
+            raise ValueError(
+                f"kafka spec must be kafka:host:port/topic: {spec!r}")
+        return KafkaPublisher(host, int(port), topic)
     raise ValueError(f"unknown notification spec {spec!r} "
-                     "(webhook:|mq:|logfile:)")
+                     "(webhook:|mq:|kafka:|logfile:)")
 
 
 class NotificationTailer:
