@@ -258,7 +258,11 @@ def test_cluster_raft_shell_commands(ha3):
     _wait(lambda: any(m.raft.lease_valid() for m in masters),
           msg="lease held with 4-member quorum")
     out = run_command(env, "cluster.raft.remove -server=127.0.0.1:1")
-    assert "127.0.0.1:1" not in out
+    # parse the member list: a substring check would false-positive on
+    # ephemeral ports that merely START with 1 (e.g. 127.0.0.1:17219)
+    members = [m.strip() for m in
+               out.split(":", 1)[1].split(",")]
+    assert "127.0.0.1:1" not in members, out
     # removing the leader itself is refused with guidance
     import pytest as _pytest
     with _pytest.raises(RuntimeError, match="transfer"):
