@@ -1,0 +1,636 @@
+"""gRPC maintenance-plane services — wire-compatible with the
+reference plugin control stream (/root/reference/weed/pb/plugin.proto:12
+PluginControlService.WorkerStream) and the older maintenance worker
+stream (/root/reference/weed/pb/worker.proto:8, served by the admin:
+admin/dash/worker_grpc_server.go:176).
+
+Both are worker-initiated bidi streams held against the AdminServer.
+Every inbound message drives the same registry/dispatch handlers the
+HTTP long-poll plane uses (plugin/admin.py), so the two transports
+cannot drift: the stream is just a different codec for the same
+conversation (register -> poll -> detect/execute -> report).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import traceback
+import uuid
+
+import grpc
+
+from . import plugin_pb2 as ppb
+from . import worker_pb2 as wpb
+from .rpc import LocalRequest, Stub, make_service_handler, serve
+
+PLUGIN_SERVICE = "plugin.PluginControlService"
+PLUGIN_METHODS = {
+    "WorkerStream": ("ss", ppb.WorkerToAdminMessage,
+                     ppb.AdminToWorkerMessage),
+}
+
+WORKER_SERVICE = "worker_pb.WorkerService"
+WORKER_METHODS = {
+    "WorkerStream": ("ss", wpb.WorkerMessage, wpb.AdminMessage),
+}
+
+
+# -- ConfigValue codec ----------------------------------------------------
+
+def to_config_value(v) -> ppb.ConfigValue:
+    """Python scalar -> plugin.ConfigValue (plugin.proto:185)."""
+    cv = ppb.ConfigValue()
+    if isinstance(v, bool):
+        cv.bool_value = v
+    elif isinstance(v, int):
+        cv.int64_value = v
+    elif isinstance(v, float):
+        cv.double_value = v
+    elif isinstance(v, bytes):
+        cv.bytes_value = v
+    elif isinstance(v, (list, tuple)):
+        cv.string_list.values.extend(str(x) for x in v)
+    else:
+        cv.string_value = str(v)
+    return cv
+
+
+def from_config_value(cv: ppb.ConfigValue):
+    kind = cv.WhichOneof("kind")
+    if kind is None:
+        return None
+    if kind == "string_list":
+        return list(cv.string_list.values)
+    return getattr(cv, kind)
+
+
+def params_to_map(params: dict, target) -> None:
+    for k, v in (params or {}).items():
+        target[k].CopyFrom(to_config_value(v))
+
+
+def map_to_params(m) -> dict:
+    return {k: from_config_value(v) for k, v in m.items()}
+
+
+# our schema field types (admin.py _FIELD_TYPES) <-> ConfigFieldType
+_FT_TO_PB = {"bool": ppb.CONFIG_FIELD_TYPE_BOOL,
+             "int": ppb.CONFIG_FIELD_TYPE_INT64,
+             "float": ppb.CONFIG_FIELD_TYPE_DOUBLE,
+             "string": ppb.CONFIG_FIELD_TYPE_STRING}
+_FT_FROM_PB = {v: k for k, v in _FT_TO_PB.items()}
+
+
+def descriptor_to_pb(desc: dict) -> ppb.JobTypeDescriptor:
+    """Worker-side dict Descriptor -> JobTypeDescriptor with the
+    fields in one worker_config_form section (plugin.proto:116)."""
+    out = ppb.JobTypeDescriptor(job_type=desc.get("jobType", ""),
+                                descriptor_version=1)
+    section = out.worker_config_form.sections.add(section_id="main")
+    for f in desc.get("fields", []):
+        section.fields.add(
+            name=f.get("name", ""), label=f.get("label", ""),
+            description=f.get("description", ""),
+            field_type=_FT_TO_PB.get(f.get("type", "string"),
+                                     ppb.CONFIG_FIELD_TYPE_STRING))
+    return out
+
+
+def descriptor_from_pb(d: ppb.JobTypeDescriptor) -> dict:
+    fields = []
+    for section in d.worker_config_form.sections:
+        for f in section.fields:
+            fields.append({
+                "name": f.name, "label": f.label,
+                "description": f.description,
+                "type": _FT_FROM_PB.get(f.field_type, "string")})
+    return {"jobType": d.job_type, "fields": fields}
+
+
+# -- admin-side servicers -------------------------------------------------
+
+class _StreamSession:
+    """Shared mechanics of one worker's stream against the admin:
+    a reader thread drives inbound messages into the admin's handlers
+    while the response generator polls the admin's dispatch queue."""
+
+    def __init__(self, admin):
+        self.admin = admin
+        self.worker_id = ""
+        self.done = threading.Event()
+
+    def register(self, worker_id: str, capabilities: list,
+                 max_concurrent: int, descriptors: list) -> str:
+        status, body = self.admin._register(LocalRequest(payload={
+            "workerId": worker_id,
+            "capabilities": capabilities,
+            "descriptors": descriptors,
+            "maxConcurrent": max_concurrent}))
+        self.worker_id = body["workerId"]
+        return self.worker_id
+
+    def poll(self, wait: float) -> dict:
+        """One admin->worker dispatch message, {"type": "none"} after
+        `wait` idle seconds, or {"error": ...} if unregistered."""
+        status, body = self.admin._poll(LocalRequest(payload={
+            "workerId": self.worker_id, "waitSeconds": wait}))
+        return body if status == 200 else {"error": body.get("error")}
+
+    def proposals(self, props: list) -> None:
+        self.admin._detection_result(LocalRequest(payload={
+            "workerId": self.worker_id, "proposals": props}))
+
+    def progress(self, job_id: str, fraction: float,
+                 message: str) -> None:
+        self.admin._progress(LocalRequest(payload={
+            "workerId": self.worker_id, "jobId": job_id,
+            "progress": fraction, "message": message}))
+
+    def complete(self, job_id: str, success: bool,
+                 message: str) -> None:
+        self.admin._complete(LocalRequest(payload={
+            "workerId": self.worker_id, "jobId": job_id,
+            "success": success, "message": message}))
+
+    def heartbeat(self) -> None:
+        with self.admin.lock:
+            self.admin._touch(self.worker_id)
+
+    def learn_schema(self, desc: dict) -> None:
+        if not desc.get("jobType"):
+            return
+        with self.admin.lock:
+            self.admin.schemas[desc["jobType"]] = desc.get("fields", [])
+            self.admin._persist_workers()
+
+
+class PluginControlServicer:
+    """plugin.PluginControlService bound to an AdminServer."""
+
+    HEARTBEAT_SECONDS = 10
+
+    def __init__(self, admin):
+        self.admin = admin
+
+    def WorkerStream(self, request_iterator, context):
+        sess = _StreamSession(self.admin)
+        try:
+            first = next(request_iterator)
+        except StopIteration:
+            return
+        if first.WhichOneof("body") != "hello":
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "first message must be hello (plugin.proto:48)")
+        hello = first.hello
+        caps = [{"jobType": c.job_type, "canDetect": c.can_detect,
+                 "canExecute": c.can_execute, "weight": c.weight or 50}
+                for c in hello.capabilities]
+        max_conc = max((c.max_execution_concurrency
+                        for c in hello.capabilities), default=1) or 1
+        wid = sess.register(hello.worker_id or first.worker_id,
+                            caps, max_conc, [])
+        out = ppb.AdminToWorkerMessage(request_id=uuid.uuid4().hex[:12])
+        out.hello.accepted = True
+        out.hello.message = f"registered as {wid}"
+        out.hello.heartbeat_interval_seconds = self.HEARTBEAT_SECONDS
+        out.hello.reconnect_delay_seconds = 1
+        yield out
+        # SchemaCoordinator pull: ask for each job type's config form
+        for c in caps:
+            req = ppb.AdminToWorkerMessage(
+                request_id=uuid.uuid4().hex[:12])
+            req.request_config_schema.job_type = c["jobType"]
+            yield req
+
+        reader = threading.Thread(
+            target=self._drain_inbound,
+            args=(sess, request_iterator), daemon=True)
+        reader.start()
+
+        detection_seq = 0
+        while not sess.done.is_set() and context.is_active() \
+                and not self.admin._stop.is_set():
+            msg = sess.poll(wait=1.0)
+            mtype = msg.get("type")
+            if msg.get("error"):
+                break
+            if mtype == "runDetection":
+                detection_seq += 1
+                config = msg.get("config") or {}
+                for c in caps:
+                    if not c.get("canDetect"):
+                        continue
+                    jt = c["jobType"]
+                    req = ppb.AdminToWorkerMessage(
+                        request_id=uuid.uuid4().hex[:12])
+                    rd = req.run_detection_request
+                    rd.job_type = jt
+                    rd.detection_sequence = detection_seq
+                    params_to_map(config.get(jt, {}),
+                                  rd.worker_config_values)
+                    rd.cluster_context.master_grpc_addresses.append(
+                        self.admin.master)
+                    yield req
+            elif mtype == "executeJob":
+                req = ppb.AdminToWorkerMessage(
+                    request_id=uuid.uuid4().hex[:12])
+                ej = req.execute_job_request
+                ej.job.job_id = msg["jobId"]
+                ej.job.job_type = msg["jobType"]
+                params_to_map(msg.get("params", {}),
+                              ej.job.parameters)
+                ej.cluster_context.master_grpc_addresses.append(
+                    self.admin.master)
+                yield req
+        if self.admin._stop.is_set() and context.is_active():
+            bye = ppb.AdminToWorkerMessage()
+            bye.shutdown.reason = "admin stopping"
+            yield bye
+        sess.done.set()
+
+    def _drain_inbound(self, sess: _StreamSession,
+                       request_iterator) -> None:
+        try:
+            for msg in request_iterator:
+                body = msg.WhichOneof("body")
+                if body == "heartbeat":
+                    sess.heartbeat()
+                elif body == "detection_proposals":
+                    dp = msg.detection_proposals
+                    sess.proposals([{
+                        "jobType": p.job_type or dp.job_type,
+                        "params": map_to_params(p.parameters),
+                        "dedupeKey": p.dedupe_key,
+                        "reason": p.summary,
+                    } for p in dp.proposals])
+                elif body == "job_progress_update":
+                    up = msg.job_progress_update
+                    sess.progress(up.job_id,
+                                  up.progress_percent / 100.0,
+                                  up.message)
+                elif body == "job_completed":
+                    jc = msg.job_completed
+                    sess.complete(jc.job_id, jc.success,
+                                  jc.error_message or
+                                  jc.result.summary)
+                elif body == "config_schema_response":
+                    rsp = msg.config_schema_response
+                    if rsp.success:
+                        sess.learn_schema(descriptor_from_pb(
+                            rsp.job_type_descriptor))
+        except Exception:  # stream broke: worker gone
+            pass
+        finally:
+            sess.done.set()
+
+
+class WorkerServicer:
+    """worker_pb.WorkerService bound to an AdminServer — the older
+    maintenance stream (admin/dash/worker_grpc_server.go).  Task
+    params ride the typed TaskParams variants; our job params dicts
+    round-trip through the fields both sides understand."""
+
+    def __init__(self, admin):
+        self.admin = admin
+
+    @staticmethod
+    def _params_to_assignment(job_type: str, params: dict,
+                              ta: wpb.TaskAssignment) -> None:
+        # malformed operator params must never kill the stream (the
+        # job is already marked assigned by _poll) — an uncastable
+        # value just stays out of its typed slot and rides metadata
+        def num(key, cast, default):
+            """(value, key-present-AND-castable)."""
+            if key not in params:
+                return default, False
+            try:
+                return cast(params[key]), True
+            except (TypeError, ValueError):
+                return default, False
+
+        tp = ta.params
+        typed = {"collection"}  # keys carried outside metadata
+        vid, ok = num("volumeId", int, 0)
+        if not ok:
+            vid, ok = num("volume_id", int, 0)
+        if ok:
+            typed |= {"volumeId", "volume_id"}
+        tp.volume_id = vid
+        tp.collection = str(params.get("collection", ""))
+        if job_type == "vacuum":
+            gt, ok = num("garbageThreshold", float, 0.3)
+            tp.vacuum_params.garbage_threshold = gt
+            if ok:
+                typed.add("garbageThreshold")
+            tp.vacuum_params.force_vacuum = bool(params.get("force"))
+            typed.add("force")
+        elif job_type in ("erasure_coding", "ec", "tpu_ec"):
+            ds, ok1 = num("dataShards", int, 10)
+            ps, ok2 = num("parityShards", int, 4)
+            tp.erasure_coding_params.data_shards = ds
+            tp.erasure_coding_params.parity_shards = ps
+            tp.erasure_coding_params.cleanup_source = True
+            if ok1:
+                typed.add("dataShards")
+            if ok2:
+                typed.add("parityShards")
+        elif job_type == "balance":
+            for mv in params.get("moves", []) or []:
+                try:
+                    mvid = int(mv.get("volumeId", 0))
+                except (TypeError, ValueError, AttributeError):
+                    continue
+                tp.balance_params.moves.add(
+                    volume_id=mvid,
+                    source_node=str(mv.get("source", "")),
+                    target_node=str(mv.get("target", "")),
+                    collection=str(mv.get("collection", "")))
+            typed.add("moves")
+        # only keys WITHOUT a typed home ride the metadata map (a
+        # stringified duplicate would shadow the typed value — and its
+        # type — on decode)
+        for k, v in params.items():
+            if k not in typed:
+                ta.metadata[k] = str(v)
+
+    @staticmethod
+    def _assignment_to_params(ta: wpb.TaskAssignment) -> dict:
+        params = dict(ta.metadata)
+        tp = ta.params
+        if tp.volume_id:
+            params["volumeId"] = tp.volume_id
+        if tp.collection:
+            params["collection"] = tp.collection
+        which = tp.WhichOneof("task_params")
+        if which == "vacuum_params":
+            params["garbageThreshold"] = \
+                tp.vacuum_params.garbage_threshold
+            params["force"] = tp.vacuum_params.force_vacuum
+        elif which == "erasure_coding_params":
+            params["dataShards"] = \
+                tp.erasure_coding_params.data_shards
+            params["parityShards"] = \
+                tp.erasure_coding_params.parity_shards
+        elif which == "balance_params":
+            params["moves"] = [{
+                "volumeId": m.volume_id, "source": m.source_node,
+                "target": m.target_node, "collection": m.collection,
+            } for m in tp.balance_params.moves]
+        return params
+
+    def WorkerStream(self, request_iterator, context):
+        sess = _StreamSession(self.admin)
+        admin_id = "admin"
+        try:
+            first = next(request_iterator)
+        except StopIteration:
+            return
+        if first.WhichOneof("message") != "registration":
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "first message must be registration "
+                          "(worker.proto:45)")
+        reg = first.registration
+        caps = [{"jobType": c, "canDetect": False, "canExecute": True,
+                 "weight": 50} for c in reg.capabilities]
+        wid = sess.register(reg.worker_id or first.worker_id, caps,
+                            reg.max_concurrent or 1, [])
+        out = wpb.AdminMessage(admin_id=admin_id,
+                               timestamp=int(time.time()))
+        out.registration_response.success = True
+        out.registration_response.assigned_worker_id = wid
+        yield out
+
+        reader = threading.Thread(target=self._drain_inbound,
+                                  args=(sess, request_iterator),
+                                  daemon=True)
+        reader.start()
+
+        while not sess.done.is_set() and context.is_active() \
+                and not self.admin._stop.is_set():
+            msg = sess.poll(wait=1.0)
+            if msg.get("error"):
+                break
+            if msg.get("type") == "executeJob":
+                out = wpb.AdminMessage(admin_id=admin_id,
+                                       timestamp=int(time.time()))
+                ta = out.task_assignment
+                ta.task_id = msg["jobId"]
+                ta.task_type = msg["jobType"]
+                ta.created_time = int(time.time())
+                self._params_to_assignment(
+                    msg["jobType"], msg.get("params", {}), ta)
+                yield out
+            # runDetection has no wire analog here: worker.proto
+            # detection lives admin-side (maintenance scan); ignore.
+        if self.admin._stop.is_set() and context.is_active():
+            out = wpb.AdminMessage(admin_id=admin_id,
+                                   timestamp=int(time.time()))
+            out.admin_shutdown.reason = "admin stopping"
+            yield out
+        sess.done.set()
+
+    def _drain_inbound(self, sess: _StreamSession,
+                       request_iterator) -> None:
+        try:
+            for msg in request_iterator:
+                which = msg.WhichOneof("message")
+                if which == "heartbeat":
+                    sess.heartbeat()
+                elif which == "task_update":
+                    up = msg.task_update
+                    sess.progress(up.task_id, up.progress, up.message)
+                elif which == "task_complete":
+                    tc = msg.task_complete
+                    sess.complete(tc.task_id, tc.success,
+                                  tc.error_message)
+                elif which == "shutdown":
+                    break
+        except Exception:
+            pass
+        finally:
+            sess.done.set()
+
+
+def start_admin_grpc(admin, host: str = "127.0.0.1", port: int = 0):
+    """Serve both maintenance streams for an AdminServer; returns
+    (grpc_server, bound_port)."""
+    handlers = [
+        make_service_handler(PLUGIN_SERVICE, PLUGIN_METHODS,
+                             PluginControlServicer(admin)),
+        make_service_handler(WORKER_SERVICE, WORKER_METHODS,
+                             WorkerServicer(admin)),
+    ]
+    return serve(handlers, host=host, port=port)
+
+
+# -- worker-side gRPC client ---------------------------------------------
+
+class GrpcPluginWorker:
+    """A PluginWorker that holds the plugin.proto WorkerStream instead
+    of HTTP long-polls: same JobHandlers, same report semantics
+    (plugin/worker.go's connection loop).  `admin` is host:port of the
+    admin's gRPC listener."""
+
+    def __init__(self, admin: str, master: str, work_dir: str,
+                 handlers: list, max_concurrent: int = 1):
+        self.admin = admin
+        self.master = master
+        self.work_dir = work_dir
+        self.handlers = {h.job_type: h for h in handlers}
+        for h in handlers:
+            for alias in getattr(h, "aliases", []):
+                self.handlers.setdefault(alias, h)
+        self.max_concurrent = max_concurrent
+        self.worker_id = ""
+        self.executed: list[str] = []
+        self._outq: "queue.Queue[ppb.WorkerToAdminMessage]" = \
+            queue.Queue()
+        self._stop = threading.Event()
+        self._channel = None
+        self._thread: threading.Thread | None = None
+
+    # the request iterator: hello first, then whatever the worker
+    # enqueues (reports, proposals, heartbeats)
+    def _outbound(self):
+        hello = ppb.WorkerToAdminMessage(worker_id=self.worker_id)
+        hello.hello.worker_id = self.worker_id
+        hello.hello.protocol_version = "1"
+        for jt, h in self.handlers.items():
+            cap = h.capability()
+            hello.hello.capabilities.add(
+                job_type=jt, can_detect=bool(cap.get("canDetect")),
+                can_execute=bool(cap.get("canExecute", True)),
+                max_execution_concurrency=self.max_concurrent,
+                weight=int(cap.get("weight", 50)))
+        yield hello
+        while not self._stop.is_set():
+            try:
+                yield self._outq.get(timeout=0.2)
+            except queue.Empty:
+                continue
+
+    def start(self):
+        self.worker_id = uuid.uuid4().hex[:12]
+        self._channel = grpc.insecure_channel(self.admin)
+        stub = Stub(self._channel, PLUGIN_SERVICE, PLUGIN_METHODS)
+        self._stream = stub.WorkerStream(self._outbound())
+        self._thread = threading.Thread(target=self._inbound,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._channel is not None:
+            self._channel.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _send(self, msg: ppb.WorkerToAdminMessage) -> None:
+        msg.worker_id = self.worker_id
+        self._outq.put(msg)
+
+    def _inbound(self) -> None:
+        try:
+            for msg in self._stream:
+                body = msg.WhichOneof("body")
+                if body == "hello":
+                    # the admin registered the id we sent in our own
+                    # hello (admin._register keeps it); nothing to do
+                    pass
+                elif body == "request_config_schema":
+                    self._answer_schema(msg)
+                elif body == "run_detection_request":
+                    self._run_detection(msg.run_detection_request)
+                elif body == "execute_job_request":
+                    self._execute(msg.execute_job_request)
+                elif body == "shutdown":
+                    break
+        except grpc.RpcError:
+            pass
+
+    def _answer_schema(self, msg: ppb.AdminToWorkerMessage) -> None:
+        jt = msg.request_config_schema.job_type
+        h = self.handlers.get(jt)
+        out = ppb.WorkerToAdminMessage()
+        rsp = out.config_schema_response
+        rsp.request_id = msg.request_id
+        rsp.job_type = jt
+        if h is None:
+            rsp.success = False
+            rsp.error_message = f"no handler for {jt!r}"
+        else:
+            rsp.success = True
+            rsp.job_type_descriptor.CopyFrom(
+                descriptor_to_pb(h.descriptor()))
+        self._send(out)
+
+    def _run_detection(self, rd: ppb.RunDetectionRequest) -> None:
+        h = self.handlers.get(rd.job_type)
+        if h is None:
+            return
+        from ..plugin.worker import apply_config_values
+        apply_config_values(h, {
+            name: from_config_value(cv)
+            for name, cv in rd.worker_config_values.items()})
+        out = ppb.WorkerToAdminMessage()
+        dp = out.detection_proposals
+        dp.request_id = rd.request_id
+        dp.job_type = rd.job_type
+        try:
+            proposals = h.detect(self)
+        except Exception:  # noqa: BLE001 — detection must not kill stream
+            traceback.print_exc()
+            proposals = []
+        for p in proposals:
+            prop = dp.proposals.add()
+            prop.job_type = p.get("jobType", rd.job_type)
+            prop.dedupe_key = p.get("dedupeKey", "")
+            prop.summary = p.get("reason", "")
+            params_to_map(p.get("params", {}), prop.parameters)
+        self._send(out)
+        done = ppb.WorkerToAdminMessage()
+        done.detection_complete.request_id = rd.request_id
+        done.detection_complete.job_type = rd.job_type
+        done.detection_complete.success = True
+        done.detection_complete.total_proposals = len(dp.proposals)
+        self._send(done)
+
+    def _execute(self, ej: ppb.ExecuteJobRequest) -> None:
+        def run():
+            job_id = ej.job.job_id
+            h = self.handlers.get(ej.job.job_type)
+            try:
+                if h is None:
+                    raise ValueError(
+                        f"no handler for {ej.job.job_type!r}")
+                message = h.execute(self, job_id,
+                                    map_to_params(ej.job.parameters))
+                success = True
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                traceback.print_exc()
+                message, success = f"{type(e).__name__}: {e}", False
+            self.executed.append(job_id)
+            out = ppb.WorkerToAdminMessage()
+            jc = out.job_completed
+            jc.request_id = ej.request_id
+            jc.job_id = job_id
+            jc.job_type = ej.job.job_type
+            jc.success = success
+            if success:
+                jc.result.summary = message or ""
+            else:
+                jc.error_message = message
+            self._send(out)
+        threading.Thread(target=run, daemon=True).start()
+
+    def report_progress(self, job_id: str, progress: float,
+                        message: str = "") -> None:
+        out = ppb.WorkerToAdminMessage()
+        up = out.job_progress_update
+        up.job_id = job_id
+        up.progress_percent = progress * 100.0
+        up.message = message
+        self._send(out)

@@ -133,6 +133,16 @@ class AdminServer:
 
     def start(self):
         self.http.start()
+        # the reference's worker transport is gRPC (plugin.proto
+        # WorkerStream + worker.proto WorkerStream, both admin-hosted:
+        # admin/dash/worker_grpc_server.go); serve both alongside the
+        # HTTP long-poll plane
+        try:
+            from ..pb.plugin_service import start_admin_grpc
+            self.grpc_server, self.grpc_port = start_admin_grpc(
+                self, host=self.http.host)
+        except ImportError:     # grpcio absent: HTTP-only mode
+            self.grpc_server, self.grpc_port = None, 0
         self._detect_thread = threading.Thread(
             target=self._detection_loop, daemon=True)
         self._detect_thread.start()
@@ -140,6 +150,9 @@ class AdminServer:
 
     def stop(self):
         self._stop.set()
+        if getattr(self, "grpc_server", None) is not None:
+            self.grpc_server.stop(grace=0.5)
+            self.grpc_server = None
         self.http.stop()
         if self._jobs_f is not None:
             self._jobs_f.close()

@@ -31,6 +31,18 @@ def _post_with_retry(url: str, payload: dict, attempts: int = 30) -> None:
             time.sleep(min(2.0 ** i, 10.0))
 
 
+def apply_config_values(handler: "JobHandler", values: dict) -> None:
+    """Admin ConfigStore values -> handler attributes: descriptor
+    field names are camelCase on the wire (plugin.proto forms),
+    handler attrs snake_case.  Unknown names are ignored (the admin
+    already schema-validated).  Shared by the HTTP long-poll worker
+    and the gRPC stream worker so the rule cannot drift."""
+    for name, value in values.items():
+        attr = PluginWorker._snake(name)
+        if hasattr(handler, attr):
+            setattr(handler, attr, value)
+
+
 class JobHandler:
     """Contract mirrored from plugin/worker JobHandler
     (erasure_coding_handler.go:48 Capability, :61 Descriptor,
@@ -140,18 +152,10 @@ class PluginWorker:
                        for c in name)
 
     def _apply_config(self, config: dict) -> None:
-        """Admin ConfigStore values -> handler attributes: descriptor
-        field names are camelCase on the wire (plugin.proto forms),
-        handler attrs snake_case.  Unknown names are ignored (the
-        admin already schema-validated)."""
         for job_type, values in config.items():
             h = self.handlers.get(job_type)
-            if h is None:
-                continue
-            for name, value in values.items():
-                attr = self._snake(name)
-                if hasattr(h, attr):
-                    setattr(h, attr, value)
+            if h is not None:
+                apply_config_values(h, values)
 
     def _run_detection(self) -> None:
         proposals = []
