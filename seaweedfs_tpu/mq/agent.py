@@ -61,9 +61,25 @@ class AgentServer:
 
     def start(self) -> "AgentServer":
         self.http.start()
+        # the reference agent is a gRPC service (mq_agent.proto
+        # SeaweedMessagingAgent); serve it beside the JSON-HTTP twin
+        self.grpc_server, self.grpc_port = None, 0
+        try:
+            from ..pb.mq_service import start_agent_grpc
+            self.grpc_server, self.grpc_port = start_agent_grpc(
+                self, host=self.http.host)
+        except ImportError:     # grpcio absent: HTTP-only mode
+            pass
+        except Exception as e:  # pragma: no cover — a real defect
+            import sys
+            print(f"agent {self.url}: gRPC plane failed to start: "
+                  f"{e!r}", file=sys.stderr)
         return self
 
     def stop(self) -> None:
+        if getattr(self, "grpc_server", None) is not None:
+            self.grpc_server.stop(grace=0.5).wait()
+            self.grpc_server = None
         self.http.stop()
 
     @property

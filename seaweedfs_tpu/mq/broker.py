@@ -187,6 +187,19 @@ class BrokerServer:
 
     def start(self) -> "BrokerServer":
         self.http.start()
+        # the reference's broker API is gRPC (mq_broker.proto
+        # SeaweedMessaging); serve it beside the JSON-HTTP twin
+        self.grpc_server, self.grpc_port = None, 0
+        try:
+            from ..pb.mq_service import start_broker_grpc
+            self.grpc_server, self.grpc_port = start_broker_grpc(
+                self, host=self.http.host)
+        except ImportError:     # grpcio absent: HTTP-only mode
+            pass
+        except Exception as e:  # pragma: no cover — a real defect
+            import sys
+            print(f"broker {self.url}: gRPC plane failed to start: "
+                  f"{e!r}", file=sys.stderr)
         self._heartbeat()
         self._flush_thread = threading.Thread(target=self._flush_loop,
                                               daemon=True)
@@ -252,6 +265,12 @@ class BrokerServer:
     def stop(self) -> None:
         # stop accepting requests FIRST: a publish acked after the
         # flush loop but before http shutdown would be lost
+        if getattr(self, "grpc_server", None) is not None:
+            # stop() is non-blocking (returns an Event); WAIT before
+            # flushing, or an in-flight gRPC publish could append+ack
+            # after _flush_all and lose an acknowledged message
+            self.grpc_server.stop(grace=0.5).wait()
+            self.grpc_server = None
         self.http.stop()
         self._stop_event.set()
         # join BEFORE deregistering: a heartbeat racing past the
@@ -591,18 +610,13 @@ class BrokerServer:
             live = self._live_brokers()
         except RuntimeError as e:
             return 503, {"error": str(e)}
-        st, body, _ = http_bytes("GET",
-                                 f"{self.filer}/topics/?limit=1000")
-        if st != 200:
-            return 503, {"error": f"filer list: {st}"}
+        try:
+            namespaces = self._namespaces()
+        except RuntimeError as e:
+            return 503, {"error": str(e)}
         moved = 0
         topics = []
-        for ns_e in json.loads(body).get("entries", []):
-            if not ns_e.get("isDirectory"):
-                continue
-            ns = ns_e["fullPath"].rsplit("/", 1)[-1]
-            if ns.startswith("."):
-                continue
+        for ns in namespaces:
             st2, body2, _ = http_bytes(
                 "GET", f"{self.filer}/topics/{ns}/?limit=1000")
             if st2 != 200:
@@ -908,6 +922,22 @@ class BrokerServer:
             if err:
                 return 500, {"error": err}
         return 200, {"partitions": [p.to_json() for p in parts]}
+
+    def _namespaces(self) -> "list[str]":
+        """Topic namespaces in the filer tree, reserved dot-dirs
+        (.brokers, .offsets) excluded.  Shared by mq.balance and the
+        gRPC ListTopics so the filter cannot drift."""
+        st, body, _ = http_bytes("GET",
+                                 f"{self.filer}/topics/?limit=1000")
+        if st == 404:
+            return []
+        if st != 200:
+            raise RuntimeError(f"filer list: {st}")
+        return sorted(
+            e["fullPath"].rsplit("/", 1)[-1]
+            for e in json.loads(body).get("entries", [])
+            if e.get("isDirectory") and
+            not e["fullPath"].rsplit("/", 1)[-1].startswith("."))
 
     def _list_topics(self, req: Request):
         """Configured topics of a namespace, from the filer tree

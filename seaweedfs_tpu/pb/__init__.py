@@ -1,4 +1,4 @@
-"""Protobuf/gRPC wire plane.
+r"""Protobuf/gRPC wire plane.
 
 The reference's compatibility surface is its protos
 (/root/reference/weed/pb/*.proto, SURVEY §7); this package carries a
@@ -10,7 +10,10 @@ message classes — functionally identical to *_pb2_grpc.py output).
 
 Regenerate after editing protos:
     cd seaweedfs_tpu/pb && protoc --python_out=. -I protos \
-        protos/master.proto protos/volume_server.proto
+        protos/*.proto
+    # protoc emits absolute imports for proto-to-proto deps; make
+    # them package-relative:
+    sed -i 's/^import \(mq_schema\|filer\)_pb2 as/from . import \1_pb2 as/' *_pb2.py
 
 Everything degrades gracefully: servers expose gRPC when `grpc` is
 importable, JSON-HTTP remains the human-debuggable surface either way.

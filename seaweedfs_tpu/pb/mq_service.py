@@ -1,0 +1,658 @@
+"""gRPC MQ services — wire-compatible with the reference broker API
+(/root/reference/weed/pb/mq_broker.proto SeaweedMessaging) and agent
+API (mq_agent.proto SeaweedMessagingAgent).
+
+Every RPC drives the same BrokerServer/AgentServer route handlers the
+JSON-HTTP plane uses (single implementation; the wire codec is the
+only difference).  Offset semantics: our engine's offsets ARE tsNs
+stamps (mq/logstore.py — strictly monotonic per partition), so
+ts_ns, start_offset, next_offset and ack fields all carry the same
+monotonic nanosecond value; resuming with `start_offset = last ts`
+never skips or repeats (reads are strict `> since`).
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+import json
+import threading
+import time
+
+import grpc
+
+from . import mq_agent_pb2 as apb
+from . import mq_broker_pb2 as bpb
+from . import mq_schema_pb2 as spb
+from .rpc import LocalRequest, make_service_handler, serve
+
+BROKER_SERVICE = "messaging_pb.SeaweedMessaging"
+BROKER_METHODS = {
+    "FindBrokerLeader": ("uu", bpb.FindBrokerLeaderRequest,
+                         bpb.FindBrokerLeaderResponse),
+    "BalanceTopics": ("uu", bpb.BalanceTopicsRequest,
+                      bpb.BalanceTopicsResponse),
+    "ListTopics": ("uu", bpb.ListTopicsRequest,
+                   bpb.ListTopicsResponse),
+    "TopicExists": ("uu", bpb.TopicExistsRequest,
+                    bpb.TopicExistsResponse),
+    "ConfigureTopic": ("uu", bpb.ConfigureTopicRequest,
+                       bpb.ConfigureTopicResponse),
+    "LookupTopicBrokers": ("uu", bpb.LookupTopicBrokersRequest,
+                           bpb.LookupTopicBrokersResponse),
+    "GetTopicConfiguration": ("uu", bpb.GetTopicConfigurationRequest,
+                              bpb.GetTopicConfigurationResponse),
+    "ClosePublishers": ("uu", bpb.ClosePublishersRequest,
+                        bpb.ClosePublishersResponse),
+    "CloseSubscribers": ("uu", bpb.CloseSubscribersRequest,
+                         bpb.CloseSubscribersResponse),
+    "PublishMessage": ("ss", bpb.PublishMessageRequest,
+                       bpb.PublishMessageResponse),
+    "SubscribeMessage": ("ss", bpb.SubscribeMessageRequest,
+                         bpb.SubscribeMessageResponse),
+    "FetchMessage": ("uu", bpb.FetchMessageRequest,
+                     bpb.FetchMessageResponse),
+    "GetPartitionRangeInfo": ("uu", bpb.GetPartitionRangeInfoRequest,
+                              bpb.GetPartitionRangeInfoResponse),
+}
+
+AGENT_SERVICE = "messaging_pb.SeaweedMessagingAgent"
+AGENT_METHODS = {
+    "StartPublishSession": ("uu", apb.StartPublishSessionRequest,
+                            apb.StartPublishSessionResponse),
+    "ClosePublishSession": ("uu", apb.ClosePublishSessionRequest,
+                            apb.ClosePublishSessionResponse),
+    "PublishRecord": ("ss", apb.PublishRecordRequest,
+                      apb.PublishRecordResponse),
+    "SubscribeRecord": ("ss", apb.SubscribeRecordRequest,
+                        apb.SubscribeRecordResponse),
+}
+
+
+# -- schema_pb codecs -----------------------------------------------------
+
+_SCALAR_TO_STR = {spb.BOOL: "bool", spb.INT32: "int32",
+                  spb.INT64: "int64", spb.FLOAT: "float",
+                  spb.DOUBLE: "double", spb.BYTES: "bytes",
+                  spb.STRING: "string"}
+_STR_TO_SCALAR = {v: k for k, v in _SCALAR_TO_STR.items()}
+
+
+def record_type_from_pb(rt: spb.RecordType) -> dict:
+    """schema_pb.RecordType -> our registry JSON (mq/schema.py)."""
+    def conv_type(t: spb.Type):
+        kind = t.WhichOneof("kind")
+        if kind == "scalar_type":
+            return _SCALAR_TO_STR.get(t.scalar_type, "string")
+        if kind == "list_type":
+            return {"list": conv_type(t.list_type.element_type)}
+        if kind == "record_type":
+            return {"record": record_type_from_pb(t.record_type)}
+        return "string"
+    return {"fields": [{"name": f.name, "type": conv_type(f.type)}
+                       for f in rt.fields]}
+
+
+def record_type_to_pb(rt: dict) -> spb.RecordType:
+    def fill_type(t, out: spb.Type):
+        if isinstance(t, str):
+            out.scalar_type = _STR_TO_SCALAR.get(t, spb.STRING)
+        elif isinstance(t, dict) and "list" in t:
+            fill_type(t["list"], out.list_type.element_type)
+        elif isinstance(t, dict) and "record" in t:
+            out.record_type.CopyFrom(record_type_to_pb(t["record"]))
+    out = spb.RecordType()
+    for i, f in enumerate(rt.get("fields", [])):
+        fld = out.fields.add(name=f.get("name", ""), field_index=i)
+        fill_type(f.get("type"), fld.type)
+    return out
+
+
+def record_value_to_json(rv: spb.RecordValue) -> dict:
+    """RecordValue -> the JSON form our broker schema-validates
+    (bytes values become base64 text, mq/schema.py _PY_OK)."""
+    def conv(v: spb.Value):
+        kind = v.WhichOneof("kind")
+        if kind is None:
+            return None
+        if kind == "bytes_value":
+            return base64.b64encode(v.bytes_value).decode()
+        if kind == "list_value":
+            return [conv(x) for x in v.list_value.values]
+        if kind == "record_value":
+            return record_value_to_json(v.record_value)
+        return getattr(v, kind)
+    return {k: conv(v) for k, v in rv.fields.items()}
+
+
+def json_to_record_value(d: dict) -> spb.RecordValue:
+    def fill(v, out: spb.Value):
+        if isinstance(v, bool):
+            out.bool_value = v
+        elif isinstance(v, int):
+            out.int64_value = v
+        elif isinstance(v, float):
+            out.double_value = v
+        elif isinstance(v, str):
+            out.string_value = v
+        elif isinstance(v, bytes):
+            out.bytes_value = v
+        elif isinstance(v, list):
+            for x in v:
+                fill(x, out.list_value.values.add())
+        elif isinstance(v, dict):
+            out.record_value.CopyFrom(json_to_record_value(v))
+    out = spb.RecordValue()
+    for k, v in d.items():
+        fill(v, out.fields[k])
+    return out
+
+
+def partition_to_pb(p_json: dict) -> spb.Partition:
+    return spb.Partition(ring_size=int(p_json.get("ringSize", 4096)),
+                         range_start=int(p_json["rangeStart"]),
+                         range_stop=int(p_json["rangeStop"]))
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+class BrokerServicer:
+    """messaging_pb.SeaweedMessaging over a BrokerServer."""
+
+    def __init__(self, broker):
+        self.broker = broker
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _call(self, handler, context, query=None, payload=None,
+              ok_statuses=(200,)):
+        status, body = handler(LocalRequest(query=query,
+                                            payload=payload))
+        if status not in ok_statuses:
+            from .rpc import check_status
+            check_status(context, status, body)
+        return body
+
+    def _layout(self, context, namespace: str, topic: str):
+        """(assignments body) via the lookup route; aborts on error."""
+        return self._call(self.broker._lookup, context,
+                          query={"namespace": namespace,
+                                 "topic": topic})
+
+    @staticmethod
+    def _partition_index(assignments: list, part: spb.Partition) -> int:
+        """Locate the wire Partition in the topic layout by its slot
+        range (partition identity in the reference, partition.go)."""
+        for i, a in enumerate(assignments):
+            pj = a["partition"]
+            if int(pj["rangeStart"]) == part.range_start and \
+                    int(pj["rangeStop"]) == part.range_stop:
+                return i
+        return -1
+
+    # -- control plane ----------------------------------------------------
+
+    def FindBrokerLeader(self, request, context):
+        try:
+            brokers = self.broker._registered_brokers()
+        except RuntimeError:
+            brokers = []
+        # the registry's first entry plays the balancer-leader role;
+        # a lone broker answers with itself
+        return bpb.FindBrokerLeaderResponse(
+            broker=brokers[0] if brokers else self.broker.url)
+
+    def BalanceTopics(self, request, context):
+        self._call(self.broker._balance, context, payload={})
+        return bpb.BalanceTopicsResponse()
+
+    def ListTopics(self, request, context):
+        """All topics across namespaces (the reference request carries
+        no namespace filter)."""
+        out = bpb.ListTopicsResponse()
+        try:
+            namespaces = self.broker._namespaces()
+        except RuntimeError:
+            return out
+        for ns in namespaces:
+            status, b = self.broker._list_topics(
+                LocalRequest(query={"namespace": ns}))
+            if status != 200:
+                continue
+            for name in b.get("topics", []):
+                out.topics.add(namespace=ns, name=name)
+        return out
+
+    def TopicExists(self, request, context):
+        status, _b = self.broker._lookup(LocalRequest(query={
+            "namespace": request.topic.namespace,
+            "topic": request.topic.name}))
+        return bpb.TopicExistsResponse(exists=status == 200)
+
+    def ConfigureTopic(self, request, context):
+        t = request.topic
+        self._call(self.broker._configure, context, payload={
+            "namespace": t.namespace, "topic": t.name,
+            "partitionCount": request.partition_count or 4})
+        if request.HasField("message_record_type") and \
+                request.message_record_type.fields:
+            self._call(self.broker._schema_register, context, payload={
+                "namespace": t.namespace, "topic": t.name,
+                "recordType":
+                    record_type_from_pb(request.message_record_type)})
+        body = self._layout(context, t.namespace, t.name)
+        out = bpb.ConfigureTopicResponse()
+        for a in body.get("assignments", []):
+            out.broker_partition_assignments.add(
+                partition=partition_to_pb(a["partition"]),
+                leader_broker=a["broker"])
+        if request.HasField("message_record_type"):
+            out.message_record_type.CopyFrom(
+                request.message_record_type)
+        return out
+
+    def LookupTopicBrokers(self, request, context):
+        t = request.topic
+        body = self._layout(context, t.namespace, t.name)
+        out = bpb.LookupTopicBrokersResponse()
+        out.topic.CopyFrom(request.topic)
+        for a in body.get("assignments", []):
+            out.broker_partition_assignments.add(
+                partition=partition_to_pb(a["partition"]),
+                leader_broker=a["broker"])
+        return out
+
+    def GetTopicConfiguration(self, request, context):
+        t = request.topic
+        body = self._layout(context, t.namespace, t.name)
+        out = bpb.GetTopicConfigurationResponse()
+        out.topic.CopyFrom(request.topic)
+        out.partition_count = len(body.get("assignments", []))
+        for a in body.get("assignments", []):
+            out.broker_partition_assignments.add(
+                partition=partition_to_pb(a["partition"]),
+                leader_broker=a["broker"])
+        status, sb = self.broker._schema_get(LocalRequest(query={
+            "namespace": t.namespace, "topic": t.name}))
+        if status == 200 and sb.get("recordType"):
+            out.message_record_type.CopyFrom(
+                record_type_to_pb(sb["recordType"]))
+        return out
+
+    def ClosePublishers(self, request, context):
+        # our publish path is connectionless per-request (no broker-
+        # side publisher registry): nothing to sever, ack the intent
+        return bpb.ClosePublishersResponse()
+
+    def CloseSubscribers(self, request, context):
+        return bpb.CloseSubscribersResponse()
+
+    # -- data plane -------------------------------------------------------
+
+    def PublishMessage(self, request_iterator, context):
+        """Streaming publish (broker.proto:55): init names the topic +
+        partition, each DataMessage appends through the same fenced
+        guarded path as HTTP publishes, each append is acked with its
+        assigned offset."""
+        init = None
+        idx = -1
+        for req in request_iterator:
+            which = req.WhichOneof("message")
+            if which == "init":
+                init = req.init
+                body = self._layout(context, init.topic.namespace,
+                                    init.topic.name)
+                idx = self._partition_index(
+                    body.get("assignments", []), init.partition)
+                if idx < 0:
+                    yield bpb.PublishMessageResponse(
+                        error=f"partition "
+                              f"{init.partition.range_start}-"
+                              f"{init.partition.range_stop} not in "
+                              f"topic layout", should_close=True)
+                    return
+                continue
+            if which != "data" or init is None:
+                yield bpb.PublishMessageResponse(
+                    error="init message required first",
+                    should_close=True)
+                return
+            if req.data.ctrl.is_close:
+                return
+            status, body = self.broker._publish(LocalRequest(payload={
+                "namespace": init.topic.namespace,
+                "topic": init.topic.name, "partition": idx,
+                "key": _b64(req.data.key),
+                "value": _b64(req.data.value),
+                "tsNs": req.data.ts_ns}))
+            if status != 200:
+                yield bpb.PublishMessageResponse(
+                    error=body.get("error", f"status {status}"),
+                    should_close=status in (404, 503))
+                if status in (404, 503):
+                    return
+                continue
+            ts = int(body.get("tsNs", 0))
+            yield bpb.PublishMessageResponse(ack_ts_ns=ts,
+                                             assigned_offset=ts)
+
+    def SubscribeMessage(self, request_iterator, context):
+        """Streaming subscribe: init positions the cursor
+        (PartitionOffset/OffsetType), DataMessages flow until the
+        client disconnects; Seek repositions, Acks are absorbed (our
+        cursor is client-driven, like the reference's stateless
+        FetchMessage recommendation)."""
+        try:
+            first = next(request_iterator)
+        except StopIteration:
+            return
+        if first.WhichOneof("message") != "init":
+            yield self._sub_ctrl("init message required first",
+                                 end=True)
+            return
+        init = first.init
+        ns, name = init.topic.namespace, init.topic.name
+        body = self._layout(context, ns, name)
+        idx = self._partition_index(body.get("assignments", []),
+                                    init.partition_offset.partition)
+        if idx < 0:
+            yield self._sub_ctrl("partition not in topic layout",
+                                 end=True)
+            return
+
+        state = {"since": self._initial_since(init, ns, name, idx),
+                 "seek": False}
+
+        def reader():
+            try:
+                for req in request_iterator:
+                    which = req.WhichOneof("message")
+                    if which == "seek":
+                        # inclusive: the record AT the seek offset is
+                        # redelivered (reads are strict `>`)
+                        state["since"] = int(req.seek.offset) - 1
+                        state["seek"] = True
+                    # acks carry no broker state here: cursors are
+                    # client-owned (reference FetchMessage model)
+            except grpc.RpcError:
+                pass    # client cancelled the stream
+
+        threading.Thread(target=reader, daemon=True).start()
+
+        while context.is_active():
+            status, body = self.broker._subscribe(LocalRequest(query={
+                "namespace": ns, "topic": name, "partition": idx,
+                "sinceNs": state["since"], "limit": 500}))
+            if status != 200:
+                yield self._sub_ctrl(
+                    body.get("error", f"status {status}"),
+                    end=status in (404, 503))
+                if status in (404, 503):
+                    return
+                time.sleep(0.2)
+                continue
+            msgs = body.get("messages", [])
+            for m in msgs:
+                if state["seek"]:
+                    break  # re-read from the seek point
+                out = bpb.SubscribeMessageResponse()
+                out.data.key = base64.b64decode(m.get("key", ""))
+                out.data.value = base64.b64decode(m.get("value", ""))
+                out.data.ts_ns = int(m["tsNs"])
+                state["since"] = int(m["tsNs"])
+                yield out
+            if state["seek"]:
+                state["seek"] = False
+                continue
+            if not msgs:
+                time.sleep(0.1)
+
+    @staticmethod
+    def _sub_ctrl(error: str, end: bool = False):
+        out = bpb.SubscribeMessageResponse()
+        out.ctrl.error = error
+        out.ctrl.is_end_of_stream = end
+        return out
+
+    def _initial_since(self, init, ns: str, name: str,
+                       idx: int) -> int:
+        ot = init.offset_type
+        if ot in (spb.RESET_TO_LATEST, spb.RESUME_OR_LATEST):
+            # position at the partition's high water mark, NOT the
+            # wall clock: a publisher-supplied event-time ts_ns may
+            # trail time.time_ns() and would be silently skipped
+            status, b = self.broker._subscribe(LocalRequest(query={
+                "namespace": ns, "topic": name, "partition": idx,
+                "sinceNs": 1 << 62, "limit": 1}))
+            return int(b.get("highWaterMarkNs", 0)) \
+                if status == 200 else 0
+        if ot in (spb.EXACT_TS_NS, spb.EXACT_OFFSET,
+                  spb.RESET_TO_OFFSET):
+            # inclusive positioning (the reference delivers the record
+            # at exactly the requested offset; reads are strict `>`)
+            return int(init.partition_offset.start_offset or
+                       init.partition_offset.start_ts_ns) - 1
+        return int(init.partition_offset.start_ts_ns)  # earliest: 0
+
+    def FetchMessage(self, request, context):
+        """Stateless Kafka-style fetch (broker.proto:68): one
+        request/response, client owns the cursor.  start_offset is a
+        tsNs stamp; next_offset is the last returned stamp (reads are
+        strict `>`)."""
+        body = self._layout(context, request.topic.namespace,
+                            request.topic.name)
+        idx = self._partition_index(body.get("assignments", []),
+                                    request.partition)
+        out = bpb.FetchMessageResponse()
+        if idx < 0:
+            out.error = "partition not in topic layout"
+            return out
+        limit = request.max_messages or 500
+        deadline = time.time() + min(request.max_wait_ms, 30_000) / 1e3
+        while True:
+            status, b = self.broker._subscribe(LocalRequest(query={
+                "namespace": request.topic.namespace,
+                "topic": request.topic.name, "partition": idx,
+                "sinceNs": request.start_offset, "limit": limit}))
+            if status != 200:
+                out.error = b.get("error", f"status {status}")
+                return out
+            msgs = b.get("messages", [])
+            total = 0
+            for m in msgs:
+                dm = out.messages.add()
+                dm.key = base64.b64decode(m.get("key", ""))
+                dm.value = base64.b64decode(m.get("value", ""))
+                dm.ts_ns = int(m["tsNs"])
+                total += len(dm.key) + len(dm.value)
+                if request.max_bytes and total >= request.max_bytes:
+                    break
+            out.high_water_mark = int(b.get("highWaterMarkNs", 0))
+            if out.messages:
+                out.next_offset = out.messages[-1].ts_ns
+            else:
+                out.next_offset = request.start_offset
+            out.end_of_partition = \
+                out.next_offset >= out.high_water_mark
+            if out.messages or time.time() >= deadline:
+                return out
+            time.sleep(0.1)
+
+    def GetPartitionRangeInfo(self, request, context):
+        body = self._layout(context, request.topic.namespace,
+                            request.topic.name)
+        idx = self._partition_index(body.get("assignments", []),
+                                    request.partition)
+        out = bpb.GetPartitionRangeInfoResponse()
+        if idx < 0:
+            out.error = "partition not in topic layout"
+            return out
+        status, b = self.broker._subscribe(LocalRequest(query={
+            "namespace": request.topic.namespace,
+            "topic": request.topic.name, "partition": idx,
+            "sinceNs": 0, "limit": 1}))
+        if status != 200:
+            out.error = b.get("error", f"status {status}")
+            return out
+        hwm = int(b.get("highWaterMarkNs", 0))
+        msgs = b.get("messages", [])
+        earliest = int(msgs[0]["tsNs"]) if msgs else 0
+        out.offset_range.earliest_offset = earliest
+        out.offset_range.latest_offset = hwm
+        out.offset_range.high_water_mark = hwm
+        out.timestamp_range.earliest_timestamp_ns = earliest
+        out.timestamp_range.latest_timestamp_ns = hwm
+        return out
+
+
+class AgentServicer:
+    """messaging_pb.SeaweedMessagingAgent over an AgentServer.
+    Session ids are int64 on the wire (mq_agent.proto); the agent's
+    hex session ids are interned per connection."""
+
+    def __init__(self, agent):
+        self.agent = agent
+        self._ids = itertools.count(1)
+        self._sid: dict[int, str] = {}
+        self._lock = threading.Lock()
+
+    def _intern(self, hex_sid: str) -> int:
+        n = next(self._ids)
+        with self._lock:
+            self._sid[n] = hex_sid
+        return n
+
+    def _hex(self, n: int) -> "str | None":
+        with self._lock:
+            return self._sid.get(n)
+
+    def StartPublishSession(self, request, context):
+        status, body = self.agent._start_publish(LocalRequest(payload={
+            "namespace": request.topic.namespace,
+            "topic": request.topic.name,
+            "partitionCount": request.partition_count or 4}))
+        if status != 200:
+            return apb.StartPublishSessionResponse(
+                error=body.get("error", f"status {status}"))
+        return apb.StartPublishSessionResponse(
+            session_id=self._intern(body["sessionId"]))
+
+    def ClosePublishSession(self, request, context):
+        hex_sid = self._hex(request.session_id)
+        if hex_sid is not None:
+            self.agent._close(LocalRequest(
+                payload={"sessionId": hex_sid}))
+            with self._lock:
+                self._sid.pop(request.session_id, None)
+        return apb.ClosePublishSessionResponse()
+
+    def PublishRecord(self, request_iterator, context):
+        """mq_agent.proto:20 — session_id rides the first record."""
+        sid = None
+        seq = 0
+        for req in request_iterator:
+            if sid is None:
+                sid = self._hex(req.session_id)
+                if sid is None:
+                    yield apb.PublishRecordResponse(
+                        error=f"unknown session {req.session_id}")
+                    return
+            value_json = json.dumps(
+                record_value_to_json(req.value)).encode()
+            status, body = self.agent._publish(LocalRequest(payload={
+                "sessionId": sid, "key": _b64(req.key),
+                "value": _b64(value_json)}))
+            if status != 200:
+                yield apb.PublishRecordResponse(
+                    error=body.get("error", f"status {status}"))
+                continue
+            seq = int(body.get("tsNs", seq))
+            yield apb.PublishRecordResponse(ack_sequence=seq)
+
+    def SubscribeRecord(self, request_iterator, context):
+        """mq_agent.proto:24 — typed records with at-least-once acks:
+        the agent's partition leases redeliver un-acked records; acks
+        resolve through a per-stream ts->partition map."""
+        try:
+            first = next(request_iterator)
+        except StopIteration:
+            return
+        if not first.HasField("init"):
+            yield apb.SubscribeRecordResponse(
+                error="init required first", is_end_of_stream=True)
+            return
+        init = first.init
+        status, body = self.agent._start_subscribe(LocalRequest(
+            payload={"namespace": init.topic.namespace,
+                     "topic": init.topic.name}))
+        if status != 200:
+            yield apb.SubscribeRecordResponse(
+                error=body.get("error", f"status {status}"),
+                is_end_of_stream=True)
+            return
+        sid = body["sessionId"]
+        part_of: dict[int, int] = {}
+        lock = threading.Lock()
+
+        def reader():
+            try:
+                for req in request_iterator:
+                    if req.ack_sequence:
+                        with lock:
+                            p = part_of.pop(req.ack_sequence, None)
+                        if p is not None:
+                            self.agent._ack(LocalRequest(payload={
+                                "sessionId": sid, "partition": p,
+                                "tsNs": req.ack_sequence}))
+            except grpc.RpcError:
+                pass    # client cancelled the stream
+
+        threading.Thread(target=reader, daemon=True).start()
+        try:
+            while context.is_active():
+                status, b = self.agent._subscribe(LocalRequest(query={
+                    "sessionId": sid, "maxRecords": 100,
+                    "waitSec": 1.0}))
+                if status != 200:
+                    yield apb.SubscribeRecordResponse(
+                        error=b.get("error", f"status {status}"),
+                        is_end_of_stream=True)
+                    return
+                for r in b.get("records", []):
+                    out = apb.SubscribeRecordResponse()
+                    out.key = base64.b64decode(r.get("key", ""))
+                    raw = base64.b64decode(r.get("value", ""))
+                    try:
+                        decoded = json.loads(raw)
+                        if not isinstance(decoded, dict):
+                            raise TypeError("not a record")
+                        out.value.CopyFrom(
+                            json_to_record_value(decoded))
+                    except (ValueError, TypeError):
+                        # schemaless / non-object values ride a
+                        # single-field record
+                        out.value.fields["_raw"].bytes_value = raw
+                    out.ts_ns = int(r["tsNs"])
+                    with lock:
+                        part_of[out.ts_ns] = int(r["partition"])
+                    yield out
+        finally:
+            self.agent._close(LocalRequest(payload={"sessionId": sid}))
+
+
+def start_broker_grpc(broker, host: str = "127.0.0.1", port: int = 0):
+    # each SubscribeMessage stream (and a long-poll FetchMessage)
+    # parks a pool worker; a deep pool keeps idle subscribers from
+    # starving the unary control plane (the reference's goroutine
+    # model has no such cap)
+    return serve([make_service_handler(BROKER_SERVICE, BROKER_METHODS,
+                                       BrokerServicer(broker))],
+                 host=host, port=port, max_workers=64)
+
+
+def start_agent_grpc(agent, host: str = "127.0.0.1", port: int = 0):
+    return serve([make_service_handler(AGENT_SERVICE, AGENT_METHODS,
+                                       AgentServicer(agent))],
+                 host=host, port=port, max_workers=64)

@@ -137,12 +137,17 @@ class AdminServer:
         # WorkerStream + worker.proto WorkerStream, both admin-hosted:
         # admin/dash/worker_grpc_server.go); serve both alongside the
         # HTTP long-poll plane
+        self.grpc_server, self.grpc_port = None, 0
         try:
             from ..pb.plugin_service import start_admin_grpc
             self.grpc_server, self.grpc_port = start_admin_grpc(
                 self, host=self.http.host)
         except ImportError:     # grpcio absent: HTTP-only mode
-            self.grpc_server, self.grpc_port = None, 0
+            pass
+        except Exception as e:  # pragma: no cover — a real defect
+            import sys
+            print(f"admin {self.url}: gRPC plane failed to start: "
+                  f"{e!r}", file=sys.stderr)
         self._detect_thread = threading.Thread(
             target=self._detection_loop, daemon=True)
         self._detect_thread.start()
@@ -151,7 +156,7 @@ class AdminServer:
     def stop(self):
         self._stop.set()
         if getattr(self, "grpc_server", None) is not None:
-            self.grpc_server.stop(grace=0.5)
+            self.grpc_server.stop(grace=0.5).wait()
             self.grpc_server = None
         self.http.stop()
         if self._jobs_f is not None:

@@ -1,0 +1,386 @@
+"""MQ gRPC planes (mq_broker.proto SeaweedMessaging + mq_agent.proto
+SeaweedMessagingAgent) against live broker/agent servers backed by a
+real filer — the reference's wire surface over the same engine the
+JSON-HTTP tests exercise."""
+
+import base64
+import json
+import queue
+import threading
+import time
+
+import grpc
+import pytest
+
+from seaweedfs_tpu.mq.agent import AgentServer
+from seaweedfs_tpu.mq.broker import BrokerServer
+from seaweedfs_tpu.pb import mq_agent_pb2 as apb
+from seaweedfs_tpu.pb import mq_broker_pb2 as bpb
+from seaweedfs_tpu.pb import mq_schema_pb2 as spb
+from seaweedfs_tpu.pb.mq_service import (
+    AGENT_METHODS, AGENT_SERVICE, BROKER_METHODS, BROKER_SERVICE,
+    json_to_record_value, record_type_from_pb, record_type_to_pb,
+    record_value_to_json)
+from seaweedfs_tpu.pb.rpc import Stub
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("mq_grpc")
+    master = MasterServer().start()
+    vol = VolumeServer([str(tmp / "v")], master.url,
+                       pulse_seconds=0.3).start()
+    filer = FilerServer(master.url).start()
+    broker = BrokerServer(filer.url).start()
+    agent = AgentServer(broker.url).start()
+    time.sleep(0.4)
+    yield broker, agent
+    agent.stop()
+    broker.stop()
+    filer.stop()
+    vol.stop()
+    master.stop()
+
+
+@pytest.fixture(scope="module")
+def broker_stub(cluster):
+    broker, _agent = cluster
+    channel = grpc.insecure_channel(f"127.0.0.1:{broker.grpc_port}")
+    yield Stub(channel, BROKER_SERVICE, BROKER_METHODS)
+    channel.close()
+
+
+@pytest.fixture(scope="module")
+def agent_stub(cluster):
+    _broker, agent = cluster
+    channel = grpc.insecure_channel(f"127.0.0.1:{agent.grpc_port}")
+    yield Stub(channel, AGENT_SERVICE, AGENT_METHODS)
+    channel.close()
+
+
+def _topic(name):
+    return spb.Topic(namespace="test", name=name)
+
+
+def test_record_type_codec_roundtrip():
+    rt = {"fields": [
+        {"name": "user_id", "type": "int64"},
+        {"name": "tags", "type": {"list": "string"}},
+        {"name": "addr", "type": {"record": {"fields": [
+            {"name": "city", "type": "string"}]}}}]}
+    back = record_type_from_pb(record_type_to_pb(rt))
+    assert back["fields"][0] == {"name": "user_id", "type": "int64"}
+    assert back["fields"][1]["type"] == {"list": "string"}
+    assert back["fields"][2]["type"]["record"]["fields"][0]["name"] \
+        == "city"
+
+
+def test_record_value_codec_roundtrip():
+    d = {"n": 3, "f": 2.5, "s": "hi", "b": True,
+         "lst": ["a", "b"], "rec": {"x": 1}}
+    back = record_value_to_json(json_to_record_value(d))
+    assert back == d
+
+
+def test_configure_lookup_exists_list(broker_stub):
+    req = bpb.ConfigureTopicRequest(topic=_topic("orders"),
+                                    partition_count=3)
+    resp = broker_stub.ConfigureTopic(req)
+    assert len(resp.broker_partition_assignments) == 3
+    ranges = [(a.partition.range_start, a.partition.range_stop)
+              for a in resp.broker_partition_assignments]
+    assert ranges[0][0] == 0 and ranges[-1][1] == 4096
+
+    assert broker_stub.TopicExists(bpb.TopicExistsRequest(
+        topic=_topic("orders"))).exists
+    assert not broker_stub.TopicExists(bpb.TopicExistsRequest(
+        topic=_topic("nope"))).exists
+
+    lk = broker_stub.LookupTopicBrokers(bpb.LookupTopicBrokersRequest(
+        topic=_topic("orders")))
+    assert len(lk.broker_partition_assignments) == 3
+    assert all(a.leader_broker
+               for a in lk.broker_partition_assignments)
+
+    lst = broker_stub.ListTopics(bpb.ListTopicsRequest())
+    assert spb.Topic(namespace="test", name="orders") in lst.topics
+
+
+def test_configure_with_schema_roundtrip(broker_stub):
+    rt = record_type_to_pb({"fields": [
+        {"name": "k", "type": "string"},
+        {"name": "n", "type": "int64"}]})
+    req = bpb.ConfigureTopicRequest(topic=_topic("typed"),
+                                    partition_count=1,
+                                    message_record_type=rt)
+    broker_stub.ConfigureTopic(req)
+    conf = broker_stub.GetTopicConfiguration(
+        bpb.GetTopicConfigurationRequest(topic=_topic("typed")))
+    assert conf.partition_count == 1
+    names = [f.name for f in conf.message_record_type.fields]
+    assert names == ["k", "n"]
+
+
+def test_publish_subscribe_stream(cluster, broker_stub):
+    broker, _agent = cluster
+    broker_stub.ConfigureTopic(bpb.ConfigureTopicRequest(
+        topic=_topic("stream"), partition_count=2))
+    lk = broker_stub.LookupTopicBrokers(bpb.LookupTopicBrokersRequest(
+        topic=_topic("stream")))
+    part = lk.broker_partition_assignments[0].partition
+
+    def pub_messages():
+        init = bpb.PublishMessageRequest()
+        init.init.topic.CopyFrom(_topic("stream"))
+        init.init.partition.CopyFrom(part)
+        yield init
+        for i in range(5):
+            msg = bpb.PublishMessageRequest()
+            msg.data.key = f"k{i}".encode()
+            msg.data.value = f"v{i}".encode()
+            yield msg
+
+    acks = list(broker_stub.PublishMessage(pub_messages()))
+    assert len(acks) == 5
+    offs = [a.assigned_offset for a in acks]
+    assert all(a.error == "" for a in acks)
+    assert offs == sorted(offs) and len(set(offs)) == 5
+
+    # subscribe from earliest: all five arrive in order
+    def sub_messages(q):
+        init = bpb.SubscribeMessageRequest()
+        init.init.topic.CopyFrom(_topic("stream"))
+        init.init.partition_offset.partition.CopyFrom(part)
+        init.init.offset_type = spb.RESET_TO_EARLIEST
+        yield init
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            yield item
+
+    q = queue.Queue()
+    got = []
+    stream = broker_stub.SubscribeMessage(sub_messages(q))
+    for resp in stream:
+        if resp.WhichOneof("message") == "data":
+            got.append((resp.data.key, resp.data.value,
+                        resp.data.ts_ns))
+            if len(got) == 5:
+                break
+    stream.cancel()
+    q.put(None)
+    assert [k for k, _v, _t in got] == \
+        [f"k{i}".encode() for i in range(5)]
+    assert [t for _k, _v, t in got] == offs
+
+
+def test_fetch_message_stateless(broker_stub):
+    broker_stub.ConfigureTopic(bpb.ConfigureTopicRequest(
+        topic=_topic("fetch"), partition_count=1))
+    lk = broker_stub.LookupTopicBrokers(bpb.LookupTopicBrokersRequest(
+        topic=_topic("fetch")))
+    part = lk.broker_partition_assignments[0].partition
+
+    def pub():
+        init = bpb.PublishMessageRequest()
+        init.init.topic.CopyFrom(_topic("fetch"))
+        init.init.partition.CopyFrom(part)
+        yield init
+        for i in range(7):
+            m = bpb.PublishMessageRequest()
+            m.data.key = b"k"
+            m.data.value = f"v{i}".encode()
+            yield m
+
+    acks = list(broker_stub.PublishMessage(pub()))
+    assert len(acks) == 7
+
+    # client-owned cursor: fetch in two pages via next_offset
+    r1 = broker_stub.FetchMessage(bpb.FetchMessageRequest(
+        topic=_topic("fetch"), partition=part, start_offset=0,
+        max_messages=4))
+    assert len(r1.messages) == 4 and r1.error == ""
+    r2 = broker_stub.FetchMessage(bpb.FetchMessageRequest(
+        topic=_topic("fetch"), partition=part,
+        start_offset=r1.next_offset, max_messages=100))
+    assert len(r2.messages) == 3
+    assert r2.end_of_partition
+    vals = [m.value for m in list(r1.messages) + list(r2.messages)]
+    assert vals == [f"v{i}".encode() for i in range(7)]
+
+    info = broker_stub.GetPartitionRangeInfo(
+        bpb.GetPartitionRangeInfoRequest(topic=_topic("fetch"),
+                                         partition=part))
+    assert info.offset_range.high_water_mark == \
+        acks[-1].assigned_offset
+
+
+def test_publish_requires_init(broker_stub):
+    def bad():
+        m = bpb.PublishMessageRequest()
+        m.data.key = b"k"
+        m.data.value = b"v"
+        yield m
+
+    resps = list(broker_stub.PublishMessage(bad()))
+    assert resps and resps[0].should_close
+    assert "init" in resps[0].error
+
+
+def test_reset_to_latest_uses_hwm_not_wall_clock(cluster, broker_stub):
+    """A subscriber at RESET_TO_LATEST must not miss messages whose
+    publisher-supplied event-time ts_ns trails the wall clock."""
+    broker_stub.ConfigureTopic(bpb.ConfigureTopicRequest(
+        topic=_topic("latest"), partition_count=1))
+    lk = broker_stub.LookupTopicBrokers(bpb.LookupTopicBrokersRequest(
+        topic=_topic("latest")))
+    part = lk.broker_partition_assignments[0].partition
+
+    q = queue.Queue()
+
+    def sub_reqs():
+        init = bpb.SubscribeMessageRequest()
+        init.init.topic.CopyFrom(_topic("latest"))
+        init.init.partition_offset.partition.CopyFrom(part)
+        init.init.offset_type = spb.RESET_TO_LATEST
+        yield init
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            yield item
+
+    stream = broker_stub.SubscribeMessage(sub_reqs())
+    time.sleep(0.5)  # subscriber attached and positioned at hwm
+
+    # publish with an event-time stamp ~2s in the past (logstore
+    # accepts any stamp above the partition's last, within skew)
+    def pub():
+        init = bpb.PublishMessageRequest()
+        init.init.topic.CopyFrom(_topic("latest"))
+        init.init.partition.CopyFrom(part)
+        yield init
+        m = bpb.PublishMessageRequest()
+        m.data.key = b"k"
+        m.data.value = b"past-stamped"
+        m.data.ts_ns = time.time_ns() - 2_000_000_000
+        yield m
+
+    acks = list(broker_stub.PublishMessage(pub()))
+    assert acks[0].error == ""
+
+    got = None
+    deadline = time.time() + 10
+    for resp in stream:
+        if resp.WhichOneof("message") == "data":
+            got = resp.data.value
+            break
+        if time.time() > deadline:
+            break
+    stream.cancel()
+    q.put(None)
+    assert got == b"past-stamped"
+
+
+def test_exact_offset_is_inclusive(broker_stub):
+    """Re-subscribing at EXACT_OFFSET X redelivers the record AT X
+    (reference semantics), not X+1."""
+    broker_stub.ConfigureTopic(bpb.ConfigureTopicRequest(
+        topic=_topic("exact"), partition_count=1))
+    lk = broker_stub.LookupTopicBrokers(bpb.LookupTopicBrokersRequest(
+        topic=_topic("exact")))
+    part = lk.broker_partition_assignments[0].partition
+
+    def pub():
+        init = bpb.PublishMessageRequest()
+        init.init.topic.CopyFrom(_topic("exact"))
+        init.init.partition.CopyFrom(part)
+        yield init
+        for i in range(3):
+            m = bpb.PublishMessageRequest()
+            m.data.key = b"k"
+            m.data.value = f"v{i}".encode()
+            yield m
+
+    acks = list(broker_stub.PublishMessage(pub()))
+    target = acks[1].assigned_offset  # offset of v1
+
+    q = queue.Queue()
+
+    def sub_reqs():
+        init = bpb.SubscribeMessageRequest()
+        init.init.topic.CopyFrom(_topic("exact"))
+        init.init.partition_offset.partition.CopyFrom(part)
+        init.init.partition_offset.start_offset = target
+        init.init.offset_type = spb.EXACT_OFFSET
+        yield init
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            yield item
+
+    stream = broker_stub.SubscribeMessage(sub_reqs())
+    got = []
+    for resp in stream:
+        if resp.WhichOneof("message") == "data":
+            got.append(resp.data.value)
+            if len(got) == 2:
+                break
+    stream.cancel()
+    q.put(None)
+    assert got == [b"v1", b"v2"]
+
+
+def test_agent_publish_subscribe_typed_records(agent_stub):
+    start = agent_stub.StartPublishSession(
+        apb.StartPublishSessionRequest(topic=_topic("agented"),
+                                       partition_count=2))
+    assert start.error == "" and start.session_id > 0
+
+    def records():
+        for i in range(4):
+            r = apb.PublishRecordRequest(session_id=start.session_id)
+            r.key = f"user{i}".encode()
+            r.value.CopyFrom(json_to_record_value(
+                {"n": i, "name": f"u{i}"}))
+            yield r
+
+    acks = list(agent_stub.PublishRecord(records()))
+    assert len(acks) == 4 and all(a.error == "" for a in acks)
+    assert all(a.ack_sequence > 0 for a in acks)
+
+    # subscribe + ack each record as it arrives
+    outq = queue.Queue()
+
+    def sub_reqs():
+        init = apb.SubscribeRecordRequest()
+        init.init.topic.CopyFrom(_topic("agented"))
+        init.init.consumer_group = "cg1"
+        yield init
+        while True:
+            item = outq.get()
+            if item is None:
+                return
+            yield item
+
+    stream = agent_stub.SubscribeRecord(sub_reqs())
+    got = {}
+    for resp in stream:
+        assert resp.error == ""
+        got[resp.key] = record_value_to_json(resp.value)
+        ack = apb.SubscribeRecordRequest(ack_sequence=resp.ts_ns)
+        outq.put(ack)
+        if len(got) == 4:
+            break
+    stream.cancel()
+    outq.put(None)
+    assert got[b"user2"] == {"n": 2, "name": "u2"}
+
+    closed = agent_stub.ClosePublishSession(
+        apb.ClosePublishSessionRequest(session_id=start.session_id))
+    assert closed.error == ""
