@@ -136,9 +136,26 @@ class IamApiServer:
 
     def start(self):
         self.http.start()
+        # the reference exposes this plane as gRPC too (iam.proto
+        # SeaweedIdentityAccessManagement, filer-hosted there); we
+        # host it beside the REST API on the IAM server
+        self.grpc_server, self.grpc_port = None, 0
+        try:
+            from ..pb.iam_service import start_iam_grpc
+            self.grpc_server, self.grpc_port = start_iam_grpc(
+                self.store, host=self.http.host)
+        except ImportError:     # grpcio absent: HTTP-only mode
+            pass
+        except Exception as e:  # pragma: no cover — a real defect
+            import sys
+            print(f"iam {self.url}: gRPC plane failed to start: "
+                  f"{e!r}", file=sys.stderr)
         return self
 
     def stop(self):
+        if getattr(self, "grpc_server", None) is not None:
+            self.grpc_server.stop(grace=0.5).wait()
+            self.grpc_server = None
         self.http.stop()
 
     @property

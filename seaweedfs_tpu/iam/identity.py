@@ -219,6 +219,11 @@ class IdentityStore:
         self._lock = threading.RLock()
         self._identities: dict[str, Identity] = {}
         self._by_access_key: dict[str, Identity] = {}
+        # managed policies (iam_pb.Policy name -> JSON content) and
+        # groups (iam_pb.Group), the filer-propagated config the
+        # reference carries in S3ApiConfiguration
+        self._policies: dict[str, str] = {}
+        self._groups: dict[str, dict] = {}
         self._mtime = 0.0
         if path and os.path.exists(path):
             self._reload()
@@ -260,11 +265,18 @@ class IdentityStore:
         with self._lock:
             self._identities = identities
             self._by_access_key = by_key
+            self._policies = dict(doc.get("policies", {}))
+            self._groups = dict(doc.get("groups", {}))
 
     def to_json(self) -> dict:
         with self._lock:
-            return {"identities": [i.to_json()
-                                   for i in self._identities.values()]}
+            out = {"identities": [i.to_json()
+                                  for i in self._identities.values()]}
+            if self._policies:
+                out["policies"] = dict(self._policies)
+            if self._groups:
+                out["groups"] = dict(self._groups)
+            return out
 
     def save(self) -> None:
         if not self.path:
@@ -327,6 +339,46 @@ class IdentityStore:
                 for c in old.credentials:
                     self._by_access_key.pop(c.access_key, None)
                 self.save()
+
+    # -- managed policies + groups (iam.proto Policy/Group) ---------------
+
+    def put_policy(self, name: str, content: str) -> None:
+        with self._lock:
+            self._policies[name] = content
+            self.save()
+
+    def get_policy(self, name: str) -> "str | None":
+        self._maybe_reload()
+        return self._policies.get(name)
+
+    def list_policies(self) -> "dict[str, str]":
+        self._maybe_reload()
+        with self._lock:
+            return dict(self._policies)
+
+    def delete_policy(self, name: str) -> None:
+        with self._lock:
+            self._policies.pop(name, None)
+            self.save()
+
+    def put_group(self, name: str, group: dict) -> None:
+        with self._lock:
+            self._groups[name] = group
+            self.save()
+
+    def get_group(self, name: str) -> "dict | None":
+        self._maybe_reload()
+        return self._groups.get(name)
+
+    def list_groups(self) -> "dict[str, dict]":
+        self._maybe_reload()
+        with self._lock:
+            return dict(self._groups)
+
+    def delete_group(self, name: str) -> None:
+        with self._lock:
+            self._groups.pop(name, None)
+            self.save()
 
     # -- SigV4Verifier adapter --------------------------------------------
 

@@ -337,9 +337,24 @@ class FuseMount:
             ctypes.sizeof(self.ops), None)
 
 
-def mount(filer: str, mountpoint: str) -> int:
+def mount(filer: str, mountpoint: str, grpc_port: int = 0) -> int:
     fs = WeedFS(filer)
+    # local control API (mount.proto SeaweedMount): lets an operator
+    # adjust the mount's quota without remounting
+    grpc_server = None
+    try:
+        from ..pb.mount_service import start_mount_grpc
+        grpc_server, bound = start_mount_grpc(fs, port=grpc_port)
+        print(f"mount control gRPC on 127.0.0.1:{bound}")
+    except ImportError:
+        pass
+    except Exception as e:  # the mount itself must still proceed
+        import sys
+        print(f"mount control gRPC failed to start: {e!r}",
+              file=sys.stderr)
     try:
         return FuseMount(fs).run(mountpoint)
     finally:
+        if grpc_server is not None:
+            grpc_server.stop(grace=0.5)
         fs.close()

@@ -162,6 +162,12 @@ class WeedFS:
             disk_dir=chunk_cache_dir,
             disk_limit=chunk_cache_disk_mb << 20) \
             if chunk_cache_mb > 0 and follow_events else None
+        # collection quota (mount.proto Configure; weedfs_quota.go):
+        # 0 = unlimited; above it, writes fail ENOSPC based on the
+        # filer's cluster statistics, refreshed at most every 5s
+        self.collection_capacity = 0
+        self._quota_used = 0
+        self._quota_checked = 0.0
         self._event_thread: threading.Thread | None = None
         if follow_events:
             self._event_thread = threading.Thread(
@@ -415,7 +421,30 @@ class WeedFS:
 
     # -- write path (weedfs_file_write.go, simplified dirty buffer) -------
 
+    QUOTA_REFRESH_SEC = 5.0
+
+    def _check_quota(self) -> None:
+        """ENOSPC once the cluster's used bytes exceed the configured
+        collection capacity (weedfs_attr.go:45 IsOverQuota checks on
+        every write-side op; usage refreshes like weedfs_quota.go)."""
+        if self.collection_capacity <= 0:
+            return
+        now = time.time()
+        if now - self._quota_checked > self.QUOTA_REFRESH_SEC:
+            self._quota_checked = now
+            try:
+                st, body, _ = http_bytes(
+                    "GET", f"{self.filer}/__meta__/statistics")
+                if st == 200:
+                    self._quota_used = \
+                        json.loads(body).get("usedSize", 0)
+            except OSError:
+                pass    # keep the last known usage
+        if self._quota_used > self.collection_capacity:
+            raise FuseError(errno.ENOSPC)
+
     def create(self, path: str, mode: int = 0o644) -> int:
+        self._check_quota()
         # materialize the (empty) entry at the filer IMMEDIATELY: the
         # write-fsync-rename save pattern and cross-client readdir must
         # see the file while it is still open
@@ -456,6 +485,7 @@ class WeedFS:
     FLUSH_THRESHOLD = 8 * 1024 * 1024
 
     def write(self, path: str, data: bytes, offset: int) -> int:
+        self._check_quota()
         with self._lock:
             ws = self._writes.get(path)
             if ws is None:

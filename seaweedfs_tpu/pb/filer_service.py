@@ -294,28 +294,16 @@ class FilerServicer:
         return resp
 
     def Statistics(self, request, context):
-        from ..server.httpd import http_json
+        from ..server.filer_server import cluster_statistics
         try:
-            vl = http_json("GET", f"{self.filer.master}/dir/status")
-            cs = http_json("GET",
-                           f"{self.filer.master}/cluster/status")
+            body = cluster_statistics(self.filer.master,
+                                      request.collection)
         except OSError as e:
             context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
-        used = files = max_count = 0
-        for dc in vl.get("dataCenters", {}).values():
-            for rack in dc.get("racks", {}).values():
-                for node in rack.get("nodes", []):
-                    max_count += node.get("maxVolumeCount", 0)
-                    for v in node.get("volumes", []):
-                        if request.collection and \
-                                v.get("collection") != \
-                                request.collection:
-                            continue
-                        used += v.get("size", 0)
-                        files += v.get("fileCount", 0)
-        total = cs.get("volumeSizeLimit", 0) * max(max_count, 1)
-        return pb.StatisticsResponse(total_size=total, used_size=used,
-                                     file_count=files)
+        return pb.StatisticsResponse(
+            total_size=body.get("totalSize", 0),
+            used_size=body.get("usedSize", 0),
+            file_count=body.get("fileCount", 0))
 
     def Ping(self, request, context):
         now = time.time_ns()

@@ -1,0 +1,33 @@
+"""gRPC mount control — wire-compatible with the reference's local
+mount API (/root/reference/weed/pb/mount.proto SeaweedMount): a
+running FUSE mount serves Configure so an operator can adjust the
+collection capacity quota without remounting (`weed mount.configure`
+drives this in the reference)."""
+
+from __future__ import annotations
+
+from . import mount_pb2 as mpb
+from .rpc import make_service_handler, serve
+
+MOUNT_SERVICE = "messaging_pb.SeaweedMount"
+MOUNT_METHODS = {
+    "Configure": ("uu", mpb.ConfigureRequest, mpb.ConfigureResponse),
+}
+
+
+class MountServicer:
+    def __init__(self, weedfs):
+        self.weedfs = weedfs
+
+    def Configure(self, request, context):
+        # takes effect on the next quota check (weedfs_quota.go role);
+        # setting 0 lifts the limit
+        self.weedfs.collection_capacity = request.collection_capacity
+        self.weedfs._quota_checked = 0.0    # force a fresh poll
+        return mpb.ConfigureResponse()
+
+
+def start_mount_grpc(weedfs, host: str = "127.0.0.1", port: int = 0):
+    return serve([make_service_handler(MOUNT_SERVICE, MOUNT_METHODS,
+                                       MountServicer(weedfs))],
+                 host=host, port=port)

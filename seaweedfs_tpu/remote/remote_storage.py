@@ -138,19 +138,62 @@ class S3RemoteStorage:
 
 # -- conf + mount bookkeeping (stored IN the filer) ------------------------
 
+def conf_to_pb_bytes(name: str, conf: dict) -> bytes:
+    """Our JSON conf -> the reference's remote_pb.RemoteConf wire
+    bytes (pb/remote.proto; the reference persists this form at
+    /etc/remote/<name>.remote.conf)."""
+    from ..pb import remote_pb2
+    pb = remote_pb2.RemoteConf(
+        type=conf.get("type", "s3"), name=name,
+        s3_access_key=conf.get("accessKey", ""),
+        s3_secret_key=conf.get("secretKey", ""),
+        s3_region=conf.get("region", ""),
+        s3_endpoint=conf.get("endpoint", ""),
+        s3_force_path_style=bool(conf.get("forcePathStyle", True)),
+        s3_v4_signature=bool(conf.get("v4Signature", True)))
+    return pb.SerializeToString()
+
+
+def conf_from_pb_bytes(data: bytes) -> dict:
+    from ..pb import remote_pb2
+    pb = remote_pb2.RemoteConf.FromString(data)
+    return {"type": pb.type or "s3", "endpoint": pb.s3_endpoint,
+            "accessKey": pb.s3_access_key,
+            "secretKey": pb.s3_secret_key, "region": pb.s3_region,
+            "forcePathStyle": pb.s3_force_path_style,
+            "v4Signature": pb.s3_v4_signature}
+
+
 def save_conf(filer: str, name: str, conf: dict) -> None:
     st, _, _ = http_bytes(
         "PUT", f"{filer}{CONF_DIR}/{name}.conf",
         json.dumps(conf).encode())
     if st not in (200, 201):
         raise RemoteError(f"save remote conf {name}: {st}")
+    # wire-form twin beside it so a reference deployment reading this
+    # filer tree finds the config in its own format
+    try:
+        http_bytes("PUT", f"{filer}{CONF_DIR}/{name}.remote.conf",
+                   conf_to_pb_bytes(name, conf))
+    except (OSError, ImportError):
+        pass
 
 
 def load_conf(filer: str, name: str) -> dict:
     st, body, _ = http_bytes("GET", f"{filer}{CONF_DIR}/{name}.conf")
-    if st != 200:
-        raise RemoteError(f"no remote conf {name!r} ({st})")
-    return json.loads(body)
+    if st == 200:
+        return json.loads(body)
+    # fall back to the reference's protobuf conf (a tree configured
+    # by the reference's `remote.configure` works as-is)
+    st, body, _ = http_bytes(
+        "GET", f"{filer}{CONF_DIR}/{name}.remote.conf")
+    if st == 200:
+        try:
+            return conf_from_pb_bytes(body)
+        except Exception as e:
+            raise RemoteError(
+                f"undecodable remote conf {name!r}: {e}") from e
+    raise RemoteError(f"no remote conf {name!r} ({st})")
 
 
 def load_mounts(filer: str) -> dict:

@@ -119,9 +119,27 @@ class S3ApiServer:
 
     def start(self):
         self.http.start()
+        # filer -> s3 IAM cache propagation service (s3.proto
+        # SeaweedS3IamCache): identity/policy/group pushes land in
+        # the gateway's live auth state without a restart
+        self.grpc_server, self.grpc_port = None, 0
+        if self.iam is not None:
+            try:
+                from ..pb.iam_service import start_s3_cache_grpc
+                self.grpc_server, self.grpc_port = \
+                    start_s3_cache_grpc(self.iam, host=self.http.host)
+            except ImportError:     # grpcio absent: HTTP-only mode
+                pass
+            except Exception as e:  # pragma: no cover — a real defect
+                import sys
+                print(f"s3 {self.url}: gRPC plane failed to start: "
+                      f"{e!r}", file=sys.stderr)
         return self
 
     def stop(self):
+        if getattr(self, "grpc_server", None) is not None:
+            self.grpc_server.stop(grace=0.5).wait()
+            self.grpc_server = None
         self.http.stop()
 
     @property

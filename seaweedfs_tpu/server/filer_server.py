@@ -19,6 +19,30 @@ from ..filer.filer_store import SqliteStore
 from .httpd import HttpServer, Request, parse_range
 
 
+def cluster_statistics(master: str, collection: str = "") -> dict:
+    """Aggregate used/total/file counts from the master topology —
+    the filer Statistics feed (filer.proto Statistics) shared by the
+    HTTP route, the gRPC servicer, and the mount's quota poll.
+    Raises OSError when the master is unreachable."""
+    from .httpd import http_json
+    vl = http_json("GET", f"{master}/dir/status")
+    cs = http_json("GET", f"{master}/cluster/status")
+    used = files = max_count = 0
+    for dc in vl.get("dataCenters", {}).values():
+        for rack in dc.get("racks", {}).values():
+            for node in rack.get("nodes", []):
+                max_count += node.get("maxVolumeCount", 0)
+                for v in node.get("volumes", []):
+                    if collection and \
+                            v.get("collection") != collection:
+                        continue
+                    used += v.get("size", 0)
+                    files += v.get("fileCount", 0)
+    total = cs.get("volumeSizeLimit", 0) * max(max_count, 1)
+    return {"totalSize": total, "usedSize": used,
+            "fileCount": files}
+
+
 class FilerServer:
     def __init__(self, master: str, host: str = "127.0.0.1",
                  port: int = 0, store_path: str = ":memory:",
@@ -73,6 +97,8 @@ class FilerServer:
         self.http.route("POST", "/__meta__/patch_extended",
                         self._meta_patch_extended)
         self.http.route("GET", "/__meta__/events", self._meta_events)
+        self.http.route("GET", "/__meta__/statistics",
+                        self._meta_statistics)
         # distributed lock manager (weed/cluster/lock_manager) — the
         # filer hosts the lock ring, as in the reference.  Ring
         # membership comes from -lockPeers (every filer of a deployment
@@ -532,6 +558,16 @@ class FilerServer:
         entry.extended.update(b.get("extended", {}))
         self.filer.create_entry(entry, create_parents=False)
         return 200, {}
+
+    def _meta_statistics(self, req: Request):
+        """Cluster usage aggregated from the master topology
+        (filer.proto Statistics; also the mount's quota feed —
+        weedfs_quota.go polls the same numbers)."""
+        try:
+            return 200, cluster_statistics(
+                self.master, req.query.get("collection", ""))
+        except OSError as e:
+            return 503, {"error": str(e)}
 
     def _meta_events(self, req: Request):
         since = int(req.query.get("sinceNs", 0))

@@ -37,10 +37,13 @@ def remote_configure(env: CommandEnv, args: list[str]) -> str:
             "GET", f"{_filer(env)}/etc/remote/?limit=1000")
         if st != 200:
             return "no remotes configured"
+        # each remote may have TWO files: <name>.conf (JSON) and the
+        # reference-wire twin <name>.remote.conf — one listing entry
         names = [e["fullPath"].rsplit("/", 1)[-1]
                  .removesuffix(".conf")
                  for e in json.loads(body).get("entries", [])
-                 if e["fullPath"].endswith(".conf")]
+                 if e["fullPath"].endswith(".conf") and
+                 not e["fullPath"].endswith(".remote.conf")]
         return "\n".join(names) or "no remotes configured"
     if flags.get("type", "s3") != "s3":
         return f"unsupported remote type {flags.get('type')!r}"
