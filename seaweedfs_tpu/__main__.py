@@ -247,6 +247,47 @@ def main(argv: list[str] | None = None) -> int:
                       help="offset checkpoint file")
     fbs3.add_argument("-interval", type=float, default=0.5)
 
+    fbgcs = sub.add_parser(
+        "filer.backup.gcs", help="continuously mirror a filer into a "
+        "Google Cloud Storage bucket (replication/sink/gcssink)")
+    fbgcs.add_argument("-filer", required=True)
+    fbgcs.add_argument("-bucket", required=True)
+    fbgcs.add_argument("-endpoint",
+                       default="https://storage.googleapis.com",
+                       help="override for emulators")
+    fbgcs.add_argument("-token", default="",
+                       help="OAuth bearer (or env GOOGLE_BEARER_TOKEN)")
+    fbgcs.add_argument("-prefix", default="")
+    fbgcs.add_argument("-state", default="")
+    fbgcs.add_argument("-interval", type=float, default=0.5)
+
+    fbaz = sub.add_parser(
+        "filer.backup.azure", help="continuously mirror a filer into "
+        "an Azure Blob container (replication/sink/azuresink)")
+    fbaz.add_argument("-filer", required=True)
+    fbaz.add_argument("-account", required=True)
+    fbaz.add_argument("-accountKey", dest="account_key", required=True,
+                      help="base64 shared key")
+    fbaz.add_argument("-container", required=True)
+    fbaz.add_argument("-endpoint", default="",
+                      help="override for emulators (azurite)")
+    fbaz.add_argument("-prefix", default="")
+    fbaz.add_argument("-state", default="")
+    fbaz.add_argument("-interval", type=float, default=0.5)
+
+    fbb2 = sub.add_parser(
+        "filer.backup.b2", help="continuously mirror a filer into a "
+        "Backblaze B2 bucket (replication/sink/b2sink)")
+    fbb2.add_argument("-filer", required=True)
+    fbb2.add_argument("-keyId", dest="key_id", required=True)
+    fbb2.add_argument("-appKey", dest="app_key", required=True)
+    fbb2.add_argument("-bucket", required=True)
+    fbb2.add_argument("-endpoint",
+                      default="https://api.backblazeb2.com")
+    fbb2.add_argument("-prefix", default="")
+    fbb2.add_argument("-state", default="")
+    fbb2.add_argument("-interval", type=float, default=0.5)
+
     sf = sub.add_parser(
         "sftp", help="SFTP gateway attached to a running filer "
         "(weed/sftpd; from-scratch SSH transport — no SSH lib in env)")
@@ -595,6 +636,42 @@ def main(argv: list[str] | None = None) -> int:
         print(f"filer.backup.s3 {args.filer} -> "
               f"{args.endpoint}/{args.bucket}/{args.prefix} "
               f"(offset state: {sink.state_path})")
+        try:
+            sink.run()
+        except KeyboardInterrupt:
+            pass
+    elif args.cmd == "filer.backup.gcs":
+        from .filer.cloud_sinks import GcsSink
+        sink = GcsSink(args.filer, args.bucket, args.endpoint,
+                       args.token, args.prefix, args.state or None,
+                       poll_interval=args.interval)
+        print(f"filer.backup.gcs {args.filer} -> "
+              f"{args.endpoint}/{args.bucket}/{args.prefix}")
+        try:
+            sink.run()
+        except KeyboardInterrupt:
+            pass
+    elif args.cmd == "filer.backup.azure":
+        from .filer.cloud_sinks import AzureSink
+        sink = AzureSink(args.filer, args.account, args.account_key,
+                         args.container, args.endpoint, args.prefix,
+                         args.state or None,
+                         poll_interval=args.interval)
+        print(f"filer.backup.azure {args.filer} -> "
+              f"{sink.endpoint}/{args.container}/{args.prefix}")
+        try:
+            sink.run()
+        except KeyboardInterrupt:
+            pass
+    elif args.cmd == "filer.backup.b2":
+        from .filer.cloud_sinks import B2Sink
+        sink = B2Sink(args.filer, args.key_id, args.app_key,
+                      args.bucket, endpoint=args.endpoint,
+                      key_prefix=args.prefix,
+                      state_path=args.state or None,
+                      poll_interval=args.interval)
+        print(f"filer.backup.b2 {args.filer} -> b2://{args.bucket}/"
+              f"{args.prefix}")
         try:
             sink.run()
         except KeyboardInterrupt:

@@ -1,62 +1,32 @@
 """`filer.backup` S3 sink — continuously mirror a filer's namespace
-into an S3-compatible bucket (weed/replication/sink/s3sink/s3_sink.go;
-the reference also ships gcs/azure sinks on the same interface, which
-reduce to the same PUT/DELETE verbs against a different endpoint).
+into an S3-compatible bucket (weed/replication/sink/s3sink/s3_sink.go).
 
-Same engine as filer.sync/backup (poll the persistent metadata stream,
-apply each event, checkpoint the offset after it fully applies), with
-an S3 applier: create/update PUTs the object at the filer path,
-delete DELETEs it, rename re-PUTs under the new key and deletes the
-old (S3 has no rename).  Restart-resumable via the shared offset
-checkpoint."""
+Rides the shared cloud-sink applier (filer/cloud_sinks.py _CloudSink:
+create/update uploads the object at the filer path, delete removes
+it, rename re-uploads under the new key and deletes the old — S3 has
+no rename) with S3 PUT/DELETE verbs via the tiering backend's client.
+Restart-resumable via the shared offset checkpoint."""
 
 from __future__ import annotations
 
-from ..server.httpd import http_bytes
 from ..storage.backend import S3BackendStorage
-from .filer_sync import FilerSync, _quote
+from .cloud_sinks import _CloudSink
 
 
-class S3Sink(FilerSync):
+class S3Sink(_CloudSink):
     def __init__(self, source: str, endpoint: str, bucket: str,
                  access_key: str = "", secret_key: str = "",
                  key_prefix: str = "", state_path: str | None = None,
                  poll_interval: float = 0.2):
-        super().__init__(source, f"s3:{endpoint}/{bucket}/{key_prefix}",
-                         state_path, poll_interval)
+        super().__init__(source,
+                         f"s3:{endpoint}/{bucket}/{key_prefix}",
+                         key_prefix, state_path, poll_interval)
         self.s3 = S3BackendStorage("s3sink", endpoint, bucket,
                                    access_key, secret_key)
-        self.key_prefix = key_prefix.strip("/")
         self.s3.ensure_bucket()
 
-    def _key(self, path: str) -> str:
-        key = path.lstrip("/")
-        return f"{self.key_prefix}/{key}" if self.key_prefix else key
+    def _upload(self, key: str, data: bytes) -> None:
+        self.s3.put_bytes(key, data)
 
-    # -- applier (s3sink) ----------------------------------------------
-
-    def _apply(self, ev: dict) -> None:
-        op = ev.get("op")
-        new = ev.get("newEntry")
-        old = ev.get("oldEntry")
-        if op in ("create", "update") and new:
-            self._put_entry(new)
-        elif op == "delete" and old:
-            if not old.get("isDirectory"):
-                self.s3.delete(self._key(old["fullPath"]))
-        elif op == "rename" and new and old:
-            if not old.get("isDirectory"):
-                self.s3.delete(self._key(old["fullPath"]))
-            self._put_entry(new)
-
-    def _put_entry(self, entry: dict) -> None:
-        if entry.get("isDirectory"):
-            return  # S3 has no directories; objects carry full keys
-        st, body, _ = http_bytes(
-            "GET", self.source + _quote(entry["fullPath"]))
-        if st == 404:
-            return  # deleted since; the delete event follows
-        if st >= 300:
-            raise RuntimeError(
-                f"s3 sink: read {entry['fullPath']}: {st}")
-        self.s3.put_bytes(self._key(entry["fullPath"]), body)
+    def _delete(self, key: str) -> None:
+        self.s3.delete(key)

@@ -14,6 +14,10 @@ Spec strings:
     mq:broker_addr/namespace/topic    publish to the built-in MQ broker
     kafka:host:port/topic             REAL Kafka wire protocol (any
                                       Kafka-compatible broker)
+    sqs:<queue_url>                   AWS SQS SendMessage (SigV4; creds
+                                      from the standard env vars)
+    pubsub:<endpoint>/projects/<p>/topics/<t>
+                                      Google Pub/Sub REST publish
     logfile:/path/to/file             append JSON lines (debug/audit)
 """
 
@@ -165,6 +169,92 @@ class KafkaPublisher(Publisher):
             self._client = None
 
 
+class SqsPublisher(Publisher):
+    """AWS SQS SendMessage over the Query API with SigV4
+    (weed/notification/aws_sqs/aws_sqs_pub.go role).  Credentials come
+    from the standard env vars (AWS_ACCESS_KEY_ID /
+    AWS_SECRET_ACCESS_KEY); the queue URL carries the endpoint, so a
+    local SQS-compatible server works for tests and the real service
+    when egress exists."""
+
+    def __init__(self, queue_url: str, region: str = ""):
+        import urllib.parse as up
+        self.queue_url = queue_url
+        u = up.urlsplit(queue_url)
+        self.origin = f"{u.scheme}://{u.netloc}"
+        self.path = u.path or "/"
+        # region from the standard host shape sqs.<region>.amazonaws.com
+        host_parts = u.netloc.split(".")
+        self.region = region or os.environ.get("AWS_REGION") or (
+            host_parts[1] if len(host_parts) > 2 and
+            host_parts[0].startswith("sqs") else "us-east-1")
+
+    def publish(self, event: dict) -> None:
+        import urllib.parse as up
+
+        from ..s3.auth import sign_request
+        from ..server.httpd import http_bytes
+        body = up.urlencode({
+            "Action": "SendMessage",
+            "Version": "2012-11-05",
+            "MessageBody": json.dumps(event),
+            "MessageAttribute.1.Name": "key",
+            "MessageAttribute.1.Value.DataType": "String",
+            "MessageAttribute.1.Value.StringValue": _event_key(event),
+        }).encode()
+        ak = os.environ.get("AWS_ACCESS_KEY_ID", "")
+        sk = os.environ.get("AWS_SECRET_ACCESS_KEY", "")
+        headers = {"Content-Type":
+                   "application/x-www-form-urlencoded"}
+        if ak:
+            # sign_request takes the scheme-less authority (it becomes
+            # the signed host header verbatim)
+            import urllib.parse as up
+            headers = sign_request(
+                "POST", up.urlsplit(self.origin).netloc, self.path,
+                {}, headers, body, ak, sk, region=self.region,
+                service="sqs")
+        st, resp, _ = http_bytes("POST", self.origin + self.path,
+                                 body, headers)
+        if st >= 300:
+            raise OSError(f"sqs {self.queue_url}: {st} {resp[:200]}")
+
+
+class PubSubPublisher(Publisher):
+    """Google Pub/Sub REST publish
+    (weed/notification/google_pub_sub/google_pub_sub.go role):
+    POST {endpoint}/v1/projects/<p>/topics/<t>:publish with base64
+    message data + the entry path as an attribute.  Bearer token from
+    `token` or env GOOGLE_BEARER_TOKEN; the official emulator needs
+    none."""
+
+    def __init__(self, endpoint: str, project: str, topic: str,
+                 token: str = ""):
+        self.endpoint = endpoint.rstrip("/")
+        self.project = project
+        self.topic = topic
+        self.token = token or os.environ.get("GOOGLE_BEARER_TOKEN", "")
+
+    def publish(self, event: dict) -> None:
+        import base64
+
+        from ..server.httpd import http_bytes
+        payload = json.dumps({"messages": [{
+            "data": base64.b64encode(
+                json.dumps(event).encode()).decode(),
+            "attributes": {"key": _event_key(event)},
+        }]}).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        st, resp, _ = http_bytes(
+            "POST", f"{self.endpoint}/v1/projects/{self.project}"
+                    f"/topics/{self.topic}:publish", payload, headers)
+        if st >= 300:
+            raise OSError(f"pubsub {self.project}/{self.topic}: "
+                          f"{st} {resp[:200]}")
+
+
 class LogFilePublisher(Publisher):
     """Append JSON lines — the audit/debug sink."""
 
@@ -206,8 +296,23 @@ def from_spec(spec: str) -> Publisher:
             raise ValueError(
                 f"kafka spec must be kafka:host:port/topic: {spec!r}")
         return KafkaPublisher(host, int(port), topic)
+    if kind == "sqs":
+        # sqs:https://sqs.us-east-1.amazonaws.com/123456/my-queue
+        if "://" not in rest:
+            raise ValueError(
+                f"sqs spec must be sqs:<queue_url>: {spec!r}")
+        return SqsPublisher(rest)
+    if kind == "pubsub":
+        # pubsub:https://pubsub.googleapis.com/projects/<p>/topics/<t>
+        endpoint, sep, tail = rest.partition("/projects/")
+        project, _, topic = tail.partition("/topics/")
+        if not (sep and project and topic):
+            raise ValueError(
+                "pubsub spec must be "
+                f"pubsub:<endpoint>/projects/<p>/topics/<t>: {spec!r}")
+        return PubSubPublisher(endpoint, project, topic)
     raise ValueError(f"unknown notification spec {spec!r} "
-                     "(webhook:|mq:|kafka:|logfile:)")
+                     "(webhook:|mq:|kafka:|sqs:|pubsub:|logfile:)")
 
 
 class NotificationTailer:
