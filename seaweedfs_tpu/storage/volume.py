@@ -177,6 +177,14 @@ class Volume:
             offset = self._append(n)
             if types.size_is_valid(n.size):
                 self.nm.put(n.id, types.to_stored_offset(offset), n.size)
+            # ack-after-kernel: push the buffered append (and its idx
+            # record) to the OS before the caller acks the client — a
+            # SIGKILLed process must not lose an acknowledged write
+            # (power loss is the -fsync tier, volume.sync(); the
+            # process-kill tier is this flush, needle_write.go acks
+            # after pwrite the same way)
+            self._dat.flush()
+            self.nm.flush()
             return offset, len(n.data), False
 
     def _append(self, n: Needle) -> int:
@@ -216,6 +224,10 @@ class Volume:
             tomb.append_at_ns = self._next_append_at_ns()
             self._append(tomb)
             self.nm.delete(n.id)
+            # same ack-after-kernel rule as write_needle: an acked
+            # delete must survive SIGKILL
+            self._dat.flush()
+            self.nm.flush()
             return size
 
     # -- read path (volume_read.go:21 readNeedle) ------------------------

@@ -1,0 +1,180 @@
+"""Process-level cluster framework — the analog of the reference's
+test/volume_server/framework: real `python -m seaweedfs_tpu` server
+PROCESSES (not in-process objects), security/config profiles, port
+polling, and kill -9 fault injection.
+
+In-process tests can't catch classes of bugs that only exist across
+real process boundaries: state that silently survives in module
+globals, fds inherited across roles, graceful-shutdown paths that
+never run under SIGKILL.  This rig boots the CLI the way an operator
+does and murders processes the way hardware does."""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# config profiles (framework/matrix/config_profiles.go role): each is
+# a security.toml body (empty = open cluster) applied to EVERY role
+PROFILES = {
+    "open": "",
+    "jwt": """
+[jwt.signing]
+key = "proc-matrix-signing-key"
+[jwt.signing.read]
+key = ""
+[access]
+ui = false
+""",
+}
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_port(port: int, timeout: float = 45.0) -> None:
+    """Startup on this 1-core box is slow; poll, never fixed-sleep."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=1.0):
+                return
+        except OSError:
+            time.sleep(0.15)
+    raise TimeoutError(f"port {port} never opened")
+
+
+class Proc:
+    """One server process with its role, port, and restart recipe."""
+
+    def __init__(self, role: str, args: list, port: int,
+                 log_path: str):
+        self.role = role
+        self.args = args
+        self.port = port
+        self.log_path = log_path
+        self.popen: "subprocess.Popen | None" = None
+
+    def start(self) -> "Proc":
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        if getattr(self, "log_f", None) is not None and \
+                not self.log_f.closed:
+            self.log_f.close()   # kill9()+start() must not leak fds
+        self.log_f = open(self.log_path, "ab")
+        self.popen = subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", *self.args],
+            cwd=REPO, env=env, stdout=self.log_f,
+            stderr=subprocess.STDOUT)
+        wait_port(self.port)
+        return self
+
+    def kill9(self) -> None:
+        """SIGKILL — no graceful shutdown, no flush, no deregister."""
+        if self.popen is not None:
+            self.popen.send_signal(signal.SIGKILL)
+            self.popen.wait(timeout=10)
+            self.popen = None
+
+    def stop(self) -> None:
+        if self.popen is not None:
+            self.popen.terminate()
+            try:
+                self.popen.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.popen.kill()
+                self.popen.wait(timeout=5)
+            self.popen = None
+        self.log_f.close()
+
+    @property
+    def url(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+
+class ProcCluster:
+    """master + N volume servers + filer as real processes under one
+    temp dir, with an optional security profile."""
+
+    def __init__(self, tmp: str, volumes: int = 2,
+                 profile: str = "open",
+                 volume_size_limit_mb: int = 8):
+        self.tmp = str(tmp)
+        self.procs: dict[str, Proc] = {}
+        sec_args = []
+        if PROFILES.get(profile):
+            sec_path = os.path.join(self.tmp, "security.toml")
+            with open(sec_path, "w") as f:
+                f.write(PROFILES[profile])
+            sec_args = ["-securityToml", sec_path]
+        self.sec_args = sec_args
+        self.profile = profile
+
+        mport = free_port()
+        mdir = os.path.join(self.tmp, "master-meta")
+        os.makedirs(mdir, exist_ok=True)
+        self.procs["master"] = Proc(
+            "master", [*sec_args, "master", "-port", str(mport),
+                       "-mdir", mdir,
+                       "-volumeSizeLimitMB",
+                       str(volume_size_limit_mb)], mport,
+            os.path.join(self.tmp, "master.log"))
+        for i in range(volumes):
+            vport = free_port()
+            vdir = os.path.join(self.tmp, f"vol{i}")
+            os.makedirs(vdir, exist_ok=True)
+            self.procs[f"volume{i}"] = Proc(
+                f"volume{i}",
+                [*sec_args, "volume", "-port", str(vport), "-dir",
+                 vdir, "-mserver", f"127.0.0.1:{mport}"], vport,
+                os.path.join(self.tmp, f"vol{i}.log"))
+        fport = free_port()
+        self.procs["filer"] = Proc(
+            "filer", [*sec_args, "filer", "-port", str(fport),
+                      "-master", f"127.0.0.1:{mport}",
+                      "-store", os.path.join(self.tmp, "filer.db")],
+            fport, os.path.join(self.tmp, "filer.log"))
+
+    def start(self) -> "ProcCluster":
+        # a later role failing to boot must not orphan the earlier
+        # ones (the caller has no handle yet to stop them with)
+        try:
+            self.procs["master"].start()
+            for name, p in self.procs.items():
+                if name.startswith("volume"):
+                    p.start()
+            self.procs["filer"].start()
+        except Exception:
+            self.stop()
+            raise
+        return self
+
+    def stop(self) -> None:
+        for p in reversed(list(self.procs.values())):
+            try:
+                p.stop()
+            except Exception:
+                pass
+
+    @property
+    def master(self) -> str:
+        return self.procs["master"].url
+
+    @property
+    def filer(self) -> str:
+        return self.procs["filer"].url
+
+    def log_tail(self, role: str, n: int = 2000) -> str:
+        with open(self.procs[role].log_path, "rb") as f:
+            f.seek(0, 2)
+            f.seek(max(0, f.tell() - n))
+            return f.read().decode(errors="replace")
