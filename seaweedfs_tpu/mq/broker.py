@@ -130,14 +130,37 @@ def _check_name(kind: str, name: str) -> None:
         raise NameError_(f"invalid {kind} name {name!r}")
 
 
+class _LocalReq:
+    """Minimal Request shim for internally-driven route handlers
+    (auto-split calling _repartition)."""
+
+    def __init__(self, payload: dict):
+        self._payload = payload
+        self.query: dict = {}
+
+    def json(self) -> dict:
+        return self._payload
+
+
 class BrokerServer:
     # a broker whose heartbeat is older than this is dead for
     # assignment/takeover purposes (pub_balancer liveness analog)
     BROKER_TTL = 5.0
 
     def __init__(self, filer: str, host: str = "127.0.0.1",
-                 port: int = 0, flush_interval: float = 1.0):
+                 port: int = 0, flush_interval: float = 1.0,
+                 auto_split_mb_per_min: float = 0.0,
+                 auto_split_max_partitions: int = 64):
         self.filer = filer
+        # hot-partition auto-split (pub balancer partition-lifecycle
+        # role): when any single partition's append rate exceeds the
+        # threshold, the topic's partition count doubles via the
+        # fenced repartition path.  0 disables.
+        self.auto_split_bytes_per_sec = \
+            auto_split_mb_per_min * (1 << 20) / 60.0
+        self.auto_split_max_partitions = auto_split_max_partitions
+        self._split_samples: dict = {}   # (topic,partition) -> bytes
+        self._splitting: set = set()     # topics mid-auto-split
         self.http = HttpServer(host, port)
         self._topics: dict[Topic, list[Partition]] = {}
         # parallel to _topics: owning broker address per partition
@@ -181,6 +204,7 @@ class BrokerServer:
         r("POST", "/topics/repartition", self._repartition)
         r("POST", "/topics/balance", self._balance)
         r("POST", "/topics/truncate", self._truncate)
+        r("POST", "/topics/delete", self._delete_topic)
         # topic -> (revision, recordType) cache for publish validation
         self._schema_cache: dict = {}
         self._schema_cache_ts: dict = {}
@@ -288,6 +312,64 @@ class BrokerServer:
         while not self._stop_event.wait(self._flush_interval):
             self._flush_all()
             self._heartbeat()
+            if self.auto_split_bytes_per_sec > 0:
+                try:
+                    self._maybe_auto_split()
+                except Exception:  # noqa: BLE001 — detector must not
+                    pass           # kill the flush loop
+
+    def _maybe_auto_split(self) -> None:
+        """Sample per-partition append-byte deltas; a partition
+        hotter than the threshold doubles its topic's partition count
+        through the fenced repartition path (splitting spreads the
+        keyspace, so the hot partition's range halves)."""
+        now = time.time()
+        with self._lock:
+            snapshot = [(t, p, log.appended_bytes)
+                        for (t, p), log in self._logs.items()]
+        hot: "set[Topic]" = set()
+        for t, p, total in snapshot:
+            prev_total, prev_ts = self._split_samples.get(
+                (t, p), (total, now))
+            self._split_samples[(t, p)] = (total, now)
+            dt = now - prev_ts
+            if dt <= 0:
+                continue
+            if (total - prev_total) / dt > self.auto_split_bytes_per_sec:
+                hot.add(t)
+        for t in hot:
+            try:
+                parts = self._load_layout(t)
+            except RuntimeError:
+                continue
+            if parts is None or \
+                    len(parts) * 2 > self.auto_split_max_partitions:
+                continue
+            with self._lock:
+                if t in self._splitting:
+                    continue
+                self._splitting.add(t)
+            # NOT inline: a repartition can take seconds (cluster
+            # lock + CONF_TTL wait + drain) and this loop is also the
+            # broker's heartbeat — blocking it past BROKER_TTL would
+            # get this broker declared dead mid-split
+            threading.Thread(target=self._auto_split_one,
+                             args=(t, len(parts) * 2),
+                             daemon=True).start()
+
+    def _auto_split_one(self, t: Topic, new_n: int) -> None:
+        try:
+            status, _body = self._repartition(_LocalReq({
+                "namespace": t.namespace, "topic": t.name,
+                "partitionCount": new_n}))
+            if status == 200:
+                # fresh rate baselines for the new partitions
+                self._split_samples = {
+                    k: v for k, v in self._split_samples.items()
+                    if k[0] != t}
+        finally:
+            with self._lock:
+                self._splitting.discard(t)
 
     def _flush_all(self) -> None:
         with self._lock:
@@ -764,6 +846,82 @@ class BrokerServer:
                 return 500, {"error": "partition dirs not deleted: "
                                       + "; ".join(failures)}
         return 200, {"truncated": len(parts)}
+
+    def _delete_topic(self, req: Request):
+        """Remove a topic entirely — messages, layout conf, schema,
+        and committed group offsets (the Kafka DeleteTopics role).
+        Rides the truncate flow first (peer in-memory tails must drop
+        BEFORE dirs die or they re-flush "deleted" messages), then
+        removes the whole topic directory.  Local publishes are
+        fenced for the duration (503-retry; after completion they get
+        the honest 404)."""
+        b = req.json()
+        try:
+            t = self._topic_from(b["namespace"], b["topic"])
+        except NameError_ as e:
+            return 400, {"error": str(e)}
+        with self._lock:
+            self._repartitioning.add(t)   # publish fence (shared)
+        try:
+            status, body = self._truncate(req)
+            if status != 200:
+                return status, body
+            try:
+                st_d, body_d, _ = http_bytes(
+                    "DELETE",
+                    f"{self.filer}{urllib.parse.quote(t.dir)}"
+                    f"?recursive=true")
+            except OSError as e:
+                st_d, body_d = 0, str(e).encode()
+            if st_d not in (200, 204, 404):
+                return 500, {"error": f"topic dir not deleted: "
+                                      f"{st_d} {body_d[:100]!r}"}
+            # committed consumer-group offsets die with the topic — a
+            # recreated topic must not resume consumers from stale
+            # pre-delete positions
+            self._delete_topic_offsets(t)
+            with self._lock:
+                self._topics.pop(t, None)
+                self._owners.pop(t, None)
+                self._conf_loaded.pop(t, None)
+                self._schema_cache.pop(t, None)
+                self._schema_cache_ts.pop(t, None)
+                # a publish racing the truncate may have re-created
+                # log objects; drop them or _flush_all resurrects the
+                # topic dir with orphan messages forever
+                for key in [k for k in self._logs if k[0] == t]:
+                    self._logs.pop(key, None)
+            self._split_samples = {
+                k: v for k, v in self._split_samples.items()
+                if k[0] != t}
+        finally:
+            with self._lock:
+                self._repartitioning.discard(t)
+        return 200, {"deleted": str(t)}
+
+    def _delete_topic_offsets(self, t: Topic) -> None:
+        """Best-effort removal of every group's committed offsets for
+        the topic (OFFSETS_DIR/<group>/<ns>.<topic>/)."""
+        try:
+            st, body, _ = http_bytes(
+                "GET", f"{self.filer}{OFFSETS_DIR}/?limit=10000")
+        except OSError:
+            return
+        if st != 200:
+            return
+        for e in json.loads(body).get("entries", []):
+            if not e.get("isDirectory"):
+                continue
+            group = e["fullPath"].rsplit("/", 1)[-1]
+            try:
+                http_bytes(
+                    "DELETE",
+                    f"{self.filer}{OFFSETS_DIR}/"
+                    f"{urllib.parse.quote(group)}/"
+                    f"{urllib.parse.quote(f'{t.namespace}.{t.name}')}"
+                    f"?recursive=true")
+            except OSError:
+                pass
 
     # -- schema plane (weed/mq/schema; broker_grpc_pub.go gating) ------
 

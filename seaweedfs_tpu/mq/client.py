@@ -146,6 +146,21 @@ class MQClient:
         http_json("POST", f"{self.broker}/topics/flush",
                   {"namespace": namespace, "topic": topic})
 
+    def delete_topic(self, namespace: str, topic: str) -> None:
+        r = http_json("POST", f"{self.broker}/topics/delete",
+                      {"namespace": namespace, "topic": topic})
+        if "error" in r:
+            raise RuntimeError(f"delete topic: {r['error']}")
+
+    def repartition(self, namespace: str, topic: str,
+                    partition_count: int) -> None:
+        r = http_json("POST", f"{self.broker}/topics/repartition",
+                      {"namespace": namespace, "topic": topic,
+                       "partitionCount": partition_count},
+                      timeout=60.0)
+        if "error" in r:
+            raise RuntimeError(f"repartition: {r['error']}")
+
     def commit_offset(self, group: str, namespace: str, topic: str,
                       partition: int, ts_ns: int) -> None:
         r = http_json("POST", f"{self.broker}/offsets/commit", {

@@ -133,6 +133,75 @@ class KafkaClient:
         r.string()
         return r.i16()
 
+    def delete_topic(self, name: str) -> int:
+        """DeleteTopics v0: returns the per-topic error code."""
+        body = enc_array([enc_string(name)]) + enc_i32(10000)
+        r = self._rpc(20, 0, body)
+        r.i32()                          # results count
+        r.string()                       # topic name
+        return r.i16()
+
+    def create_partitions(self, name: str, count: int,
+                          validate_only: bool = False
+                          ) -> "tuple[int, str | None]":
+        """CreatePartitions v0: (error_code, error_message)."""
+        body = (enc_array([enc_string(name) + enc_i32(count) +
+                           enc_i32(-1)]) +
+                enc_i32(30000) + enc_i8(1 if validate_only else 0))
+        r = self._rpc(37, 0, body)
+        r.i32()                          # throttle
+        r.i32()                          # results count
+        r.string()                       # topic
+        return r.i16(), r.string()
+
+    def list_groups(self) -> "list[tuple[str, str]]":
+        r = self._rpc(16, 0, b"")
+        code = r.i16()
+        if code:
+            raise KafkaError(code, "ListGroups")
+        return [(r.string(), r.string()) for _ in range(r.i32())]
+
+    def describe_groups(self, groups: "list[str]") -> list[dict]:
+        body = enc_array([enc_string(g) for g in groups])
+        r = self._rpc(15, 0, body)
+        out = []
+        for _ in range(r.i32()):
+            code = r.i16()
+            d = {"error": code, "group": r.string(),
+                 "state": r.string(),
+                 "protocol_type": r.string(),
+                 "protocol": r.string(), "members": []}
+            for _ in range(r.i32()):
+                d["members"].append({
+                    "id": r.string(), "client_id": r.string(),
+                    "host": r.string(),
+                    "metadata": r.bytes_() or b"",
+                    "assignment": r.bytes_() or b""})
+            out.append(d)
+        return out
+
+    def describe_configs(self, topic: str) -> "dict[str, str]":
+        body = enc_array([enc_i8(2) + enc_string(topic) +
+                          enc_i32(-1)])
+        r = self._rpc(32, 0, body)
+        r.i32()                          # throttle
+        n = r.i32()
+        assert n == 1
+        code = r.i16()
+        r.string()                       # error message
+        if code:
+            raise KafkaError(code, "DescribeConfigs")
+        r.i8()                           # resource type
+        r.string()                       # resource name
+        out = {}
+        for _ in range(r.i32()):
+            key, value = r.string(), r.string()
+            r.i8()                       # read_only
+            r.i8()                       # is_default
+            r.i8()                       # is_sensitive
+            out[key] = value
+        return out
+
     def produce(self, topic: str, partition: int,
                 records: "list[tuple[bytes | None, bytes]]") -> int:
         """Returns the base offset; raises on per-partition error."""

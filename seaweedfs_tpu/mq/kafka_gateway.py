@@ -62,8 +62,13 @@ API_VERSIONS = {
     12: (0, 2),   # Heartbeat (v1 +throttle)
     13: (0, 1),   # LeaveGroup (v1 +throttle)
     14: (0, 2),   # SyncGroup (v1 +throttle)
+    15: (0, 1),   # DescribeGroups (v1 +throttle)
+    16: (0, 1),   # ListGroups (v1 +throttle)
     18: (0, 2),   # ApiVersions (v1 +throttle)
     19: (0, 2),   # CreateTopics (v1 +validate_only, v2 +throttle)
+    20: (0, 1),   # DeleteTopics (v1 +throttle)
+    32: (0, 1),   # DescribeConfigs (v1 +include_synonyms/sources)
+    37: (0, 1),   # CreatePartitions (v1 same wire, bumped for parity)
 }
 
 
@@ -158,7 +163,10 @@ class KafkaGateway:
               9: self._offset_fetch, 10: self._find_coordinator,
               11: self._join_group, 12: self._heartbeat,
               13: self._leave_group, 14: self._sync_group,
-              18: self._api_versions, 19: self._create_topics}[api_key]
+              15: self._describe_groups, 16: self._list_groups,
+              18: self._api_versions, 19: self._create_topics,
+              20: self._delete_topics, 32: self._describe_configs,
+              37: self._create_partitions}[api_key]
         body = fn(r, api_version)
         return None if body is None else header + body
 
@@ -276,6 +284,160 @@ class KafkaGateway:
             results.append(enc_string(name) + enc_i16(code) +
                            (enc_string(None) if v >= 1 else b""))
         return (enc_i32(0) if v >= 2 else b"") + enc_array(results)
+
+    def _delete_topics(self, r: Reader, v: int = 0) -> bytes:
+        """DeleteTopics (key 20): each named topic is removed from the
+        broker entirely (messages + layout + schema)."""
+        names = [r.string() for _ in range(r.i32())]
+        if r.remaining() >= 4:
+            r.i32()                      # timeout_ms
+        results = []
+        for name in names:
+            if self._partition_count(name) is None:
+                results.append(enc_string(name) +
+                               enc_i16(UNKNOWN_TOPIC_OR_PARTITION))
+                continue
+            code = NONE
+            try:
+                self.mq.delete_topic(NAMESPACE, name)
+            except (RuntimeError, OSError):
+                code = UNKNOWN_SERVER_ERROR
+            with self._lock:
+                self._layouts.pop(name, None)
+            results.append(enc_string(name) + enc_i16(code))
+        return (enc_i32(0) if v >= 1 else b"") + enc_array(results)
+
+    def _create_partitions(self, r: Reader, v: int = 0) -> bytes:
+        """CreatePartitions (key 37): Kafka's only partition-growth
+        verb, mapped onto the broker's fenced repartition (messages
+        re-hash onto the new ring, order preserved per key)."""
+        wanted = []
+        for _ in range(r.i32()):
+            name = r.string()
+            count = r.i32()
+            n_assign = r.i32()           # manual broker assignments
+            if n_assign > 0:
+                for _ in range(n_assign):
+                    for _ in range(r.i32()):
+                        r.i32()
+            wanted.append((name, count))
+        if r.remaining() >= 4:
+            r.i32()                      # timeout_ms
+        validate_only = False
+        if r.remaining() >= 1:
+            validate_only = bool(r.i8())
+        results = []
+        for name, count in wanted:
+            have = self._partition_count(name)
+            if have is None:
+                results.append(
+                    enc_string(name) +
+                    enc_i16(UNKNOWN_TOPIC_OR_PARTITION) +
+                    enc_string("unknown topic"))
+                continue
+            if count <= have:
+                results.append(
+                    enc_string(name) + enc_i16(INVALID_REQUEST) +
+                    enc_string(f"partition count must grow "
+                               f"(have {have})"))
+                continue
+            code, msg = NONE, None
+            if not validate_only:
+                try:
+                    self.mq.repartition(NAMESPACE, name, count)
+                    with self._lock:
+                        self._layouts.pop(name, None)
+                except (RuntimeError, OSError) as e:
+                    code, msg = UNKNOWN_SERVER_ERROR, str(e)[:120]
+            results.append(enc_string(name) + enc_i16(code) +
+                           enc_string(msg))
+        return enc_i32(0) + enc_array(results)
+
+    def _list_groups(self, r: Reader, v: int = 0) -> bytes:
+        groups = self.groups.list_groups()
+        out = enc_i32(0) if v >= 1 else b""
+        out += enc_i16(NONE)
+        out += enc_array([enc_string(gid) + enc_string(ptype)
+                          for gid, ptype in groups])
+        return out
+
+    def _describe_groups(self, r: Reader, v: int = 0) -> bytes:
+        names = [r.string() for _ in range(r.i32())]
+        results = []
+        for gid in names:
+            d = self.groups.describe(gid)
+            if d is None or not d["members"]:
+                # Kafka: UNKNOWN group -> Dead; a known group whose
+                # members all left -> Empty (its offsets still exist,
+                # cleanup tooling treats the two differently)
+                state = "Empty" if d is not None else "Dead"
+                results.append(
+                    enc_i16(NONE) + enc_string(gid) +
+                    enc_string(state) + enc_string("") +
+                    enc_string("") + enc_array([]))
+                continue
+            members = [
+                enc_string(m["id"]) + enc_string("") +
+                enc_string("/127.0.0.1") +
+                enc_bytes(m["metadata"]) +
+                enc_bytes(m["assignment"])
+                for m in d["members"]]
+            results.append(
+                enc_i16(NONE) + enc_string(gid) +
+                enc_string(d["state"]) +
+                enc_string(d["protocol_type"]) +
+                enc_string(d["protocol"]) + enc_array(members))
+        return (enc_i32(0) if v >= 1 else b"") + enc_array(results)
+
+    # the static per-topic config surface DescribeConfigs exposes —
+    # our engine's actual behaviors (no size/time retention yet;
+    # delete-on-request only)
+    _TOPIC_CONFIGS = {"cleanup.policy": "delete",
+                      "retention.ms": "-1",
+                      "retention.bytes": "-1",
+                      "max.message.bytes": str(16 << 20)}
+
+    def _describe_configs(self, r: Reader, v: int = 0) -> bytes:
+        resources = []
+        for _ in range(r.i32()):
+            rtype = r.i8()
+            rname = r.string()
+            n = r.i32()
+            wanted = None if n < 0 else [r.string()
+                                         for _ in range(n)]
+            resources.append((rtype, rname, wanted))
+        if v >= 1 and r.remaining() >= 1:
+            r.i8()                       # include_synonyms
+        results = []
+        for rtype, rname, wanted in resources:
+            if rtype != 2:               # only TOPIC resources exist
+                results.append(
+                    enc_i16(INVALID_REQUEST) +
+                    enc_string(f"unsupported resource type {rtype}") +
+                    enc_i8(rtype) + enc_string(rname) +
+                    enc_array([]))
+                continue
+            if self._partition_count(rname) is None:
+                results.append(
+                    enc_i16(UNKNOWN_TOPIC_OR_PARTITION) +
+                    enc_string("unknown topic") + enc_i8(rtype) +
+                    enc_string(rname) + enc_array([]))
+                continue
+            entries = []
+            for key, value in sorted(self._TOPIC_CONFIGS.items()):
+                if wanted is not None and key not in wanted:
+                    continue
+                e = enc_string(key) + enc_string(value) + enc_i8(1)
+                # v0: is_default bool; v1: config_source int8
+                e += enc_i8(5 if v >= 1 else 1)   # 5 = DEFAULT_CONFIG
+                e += enc_i8(0)                    # is_sensitive
+                if v >= 1:
+                    e += enc_array([])            # synonyms
+                entries.append(e)
+            results.append(enc_i16(NONE) + enc_string(None) +
+                           enc_i8(rtype) + enc_string(rname) +
+                           enc_array(entries))
+        return enc_i32(0) + enc_array(results)
 
     def _produce(self, r: Reader, v: int = 3) -> "bytes | None":
         if v >= 3:

@@ -219,6 +219,40 @@ class GroupCoordinator:
                 return REBALANCE_IN_PROGRESS
             return NONE
 
+    def list_groups(self) -> "list[tuple[str, str]]":
+        """(group_id, protocol_type) pairs for ListGroups (the
+        coordinator's protocol type is always "consumer" here)."""
+        with self._lock:
+            groups = list(self._groups.values())
+        out = []
+        for g in groups:
+            with g.cond:
+                self._expire_locked(g)
+                if g.members:
+                    out.append((g.id, "consumer"))
+        return sorted(out)
+
+    def describe(self, group_id: str) -> "dict | None":
+        """Full group view for DescribeGroups: state, protocol, and
+        each member's subscription metadata + current assignment."""
+        with self._lock:
+            g = self._groups.get(group_id)
+        if g is None:
+            return None
+        with g.cond:
+            self._expire_locked(g)
+            return {
+                "state": g.state, "protocol": g.protocol,
+                "protocol_type": "consumer" if g.members else "",
+                "members": [{
+                    "id": m.id,
+                    "metadata": next(
+                        (meta for name, meta in m.protocols
+                         if name == g.protocol), m.metadata),
+                    "assignment": g.assignments.get(m.id, b""),
+                } for m in g.members.values()],
+            }
+
     def leave(self, group_id: str, member_id: str) -> int:
         g = self._group(group_id)
         with g.cond:

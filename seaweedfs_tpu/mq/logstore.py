@@ -54,6 +54,10 @@ class PartitionLog:
         self._ring: "deque[list[dict]]" = deque()
         self._ring_bytes = 0
         self._ring_floor = 0
+        # lifetime payload-byte counter (monotonic): the broker's
+        # hot-partition detector samples deltas of this to compute
+        # append rates (pub balancer auto-split role)
+        self.appended_bytes = 0
         self._lock = threading.Lock()
 
     # flushed pages retained in memory for hot tail reads
@@ -90,6 +94,10 @@ class PartitionLog:
             self._last_ts = ts
             rec = {"tsNs": ts, "key": key_b64, "value": value_b64}
             self._buf.add(rec, len(value_b64) + len(key_b64) + 32)
+            # RAW payload bytes (b64 inflates 4/3; the operator's
+            # MB/min threshold is in payload terms)
+            self.appended_bytes += \
+                (len(value_b64) + len(key_b64)) * 3 // 4
             return ts
 
     def append_many(self, records: "list[tuple[str, str, int]]"
@@ -115,6 +123,8 @@ class PartitionLog:
                 self._buf.add({"tsNs": ts, "key": key_b64,
                                "value": value_b64},
                               len(value_b64) + len(key_b64) + 32)
+                self.appended_bytes += \
+                    (len(value_b64) + len(key_b64)) * 3 // 4
                 out.append(ts)
             return out
 

@@ -1,0 +1,161 @@
+"""MQ partition lifecycle + Kafka admin-API breadth: topic delete,
+CreatePartitions-driven splits, hot-partition AUTO-split, and the
+group/config introspection APIs (DescribeGroups/ListGroups/
+DescribeConfigs) — the admin surface real Kafka tooling drives
+(weed/mq/kafka/protocol, weed/mq/pub_balancer)."""
+
+import base64
+import time
+
+import pytest
+
+from seaweedfs_tpu.mq import BrokerServer
+from seaweedfs_tpu.mq.client import MQClient
+from seaweedfs_tpu.mq.kafka_client import GroupConsumer, KafkaClient
+from seaweedfs_tpu.mq.kafka_gateway import KafkaGateway
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("mq_lifecycle")
+    master = MasterServer().start()
+    vols = [VolumeServer([str(tmp / f"v{i}")], master.url,
+                         pulse_seconds=0.3).start() for i in range(2)]
+    time.sleep(0.5)
+    filer = FilerServer(master.url,
+                        store_path=str(tmp / "filer.db")).start()
+    broker = BrokerServer(filer.url, flush_interval=0.3).start()
+    gw = KafkaGateway(broker.url).start()
+    client = KafkaClient("127.0.0.1", gw.port)
+    yield client, gw, broker, filer
+    client.close()
+    gw.stop()
+    broker.stop()
+    filer.stop()
+    for vs in vols:
+        vs.stop()
+    master.stop()
+
+
+def test_delete_topic_end_to_end(stack):
+    client, gw, broker, filer = stack
+    assert client.create_topic("doomed", partitions=2) == 0
+    client.produce("doomed", 0, [(b"k", b"v")])
+    assert client.delete_topic("doomed") == 0
+    # unknown everywhere afterwards
+    assert client.delete_topic("doomed") == 3  # UNKNOWN_TOPIC
+    mq = MQClient(broker.url)
+    assert "doomed" not in mq.list_topics("kafka")
+    # and recreatable from scratch with a different shape
+    assert client.create_topic("doomed", partitions=1) == 0
+    md = client.metadata(["doomed"])
+    assert len(md["topics"]["doomed"]["partitions"]) == 1
+
+
+def test_create_partitions_grows_and_preserves(stack):
+    client, gw, broker, filer = stack
+    assert client.create_topic("growing", partitions=2) == 0
+    for i in range(6):
+        part = i % 2
+        client.produce("growing", part,
+                       [(f"key{i}".encode(), f"val{i}".encode())])
+    # shrink and no-op are refused
+    code, msg = client.create_partitions("growing", 2)
+    assert code == 42 and "grow" in msg
+    # validate_only must not mutate
+    code, _ = client.create_partitions("growing", 4,
+                                       validate_only=True)
+    assert code == 0
+    md = client.metadata(["growing"])
+    assert len(md["topics"]["growing"]["partitions"]) == 2
+    # the real growth
+    code, msg = client.create_partitions("growing", 4)
+    assert code == 0, msg
+    md = client.metadata(["growing"])
+    assert len(md["topics"]["growing"]["partitions"]) == 4
+    # every message survived the re-hash, readable via fetch
+    seen = {}
+    for p in range(4):
+        offset = 0
+        while True:
+            recs, _hwm = client.fetch("growing", p, offset)
+            if not recs:
+                break
+            for r in recs:
+                seen[r["key"]] = r["value"]
+            offset = recs[-1]["offset"] + 1
+    assert seen == {f"key{i}".encode(): f"val{i}".encode()
+                    for i in range(6)}
+
+
+def test_describe_configs(stack):
+    client, gw, broker, filer = stack
+    client.create_topic("conftopic", partitions=1)
+    cfg = client.describe_configs("conftopic")
+    assert cfg["cleanup.policy"] == "delete"
+    assert "retention.ms" in cfg
+    from seaweedfs_tpu.mq.kafka_client import KafkaError
+    with pytest.raises(KafkaError):
+        client.describe_configs("no-such-topic")
+
+
+def test_group_introspection(stack):
+    client, gw, broker, filer = stack
+    client.create_topic("grptopic", partitions=2)
+    member = GroupConsumer(client, "insight-group", ["grptopic"])
+    assignment = member.join()
+    assert assignment  # got partitions
+    groups = client.list_groups()
+    assert ("insight-group", "consumer") in groups
+    d = client.describe_groups(["insight-group"])[0]
+    assert d["error"] == 0 and d["group"] == "insight-group"
+    assert d["state"] == "Stable"
+    assert len(d["members"]) == 1
+    assert d["members"][0]["assignment"]  # assignment bytes present
+    member.leave()
+    d = client.describe_groups(["insight-group"])[0]
+    assert d["state"] in ("Dead", "Empty")
+
+
+def test_auto_split_hot_partition(stack, tmp_path):
+    """A partition appended faster than the threshold triggers an
+    automatic repartition doubling the topic's partition count, with
+    every message preserved.  Uses its OWN broker with the tiny
+    threshold armed — the shared stack must stay split-free or the
+    exact-partition-count assertions above turn flaky."""
+    client, gw, shared_broker, filer = stack
+    # ~0.01 MB/min = ~175 raw bytes/sec per partition
+    broker = BrokerServer(filer.url, flush_interval=0.3,
+                          auto_split_mb_per_min=0.01).start()
+    mq = MQClient(broker.url)
+    mq.configure_topic("hotns", "hot", 1)
+    payload = b"x" * 2048
+    sent = {}
+    for i in range(40):
+        key = f"k{i}".encode()
+        mq.publish("hotns", "hot", key, payload + str(i).encode())
+        sent[key] = payload + str(i).encode()
+    deadline = time.time() + 30
+    while time.time() < deadline and \
+            len(mq.lookup("hotns", "hot")) < 2:
+        # keep the partition hot while the detector samples; the
+        # split itself fences publishes with 503-retry — tolerated
+        try:
+            mq.publish("hotns", "hot", b"hotkey", payload)
+        except RuntimeError:
+            pass
+        time.sleep(0.1)
+    parts = mq.lookup("hotns", "hot")
+    assert len(parts) >= 2, "hot partition never split"
+    # all pre-split messages still present and ordered per key
+    got = {}
+    for p in range(len(parts)):
+        for m in mq.subscribe("hotns", "hot", p, since_ns=0,
+                              limit=1000):
+            got[m.key] = m.value
+    for key, value in sent.items():
+        assert got.get(key) == value
+    broker.stop()
