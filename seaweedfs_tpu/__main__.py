@@ -122,6 +122,11 @@ def main(argv: list[str] | None = None) -> int:
     s3p.add_argument("-iamConfig", dest="iam_config", default="",
                      help="identities JSON (auth_credentials.go "
                           "s3.json shape); supersedes -accessKey")
+    s3p.add_argument("-metricsPort", dest="metrics_port", type=int,
+                     default=None,
+                     help="serve per-bucket Prometheus metrics on a "
+                          "SEPARATE listener (the reference's "
+                          "weed s3 -metricsPort)")
     s3p.add_argument("-stsKey", dest="sts_key", default="",
                      help="STS signing key: accept temporary "
                           "credentials minted by the iam server")
@@ -516,10 +521,13 @@ def main(argv: list[str] | None = None) -> int:
             backend = Filer(args.master, SqliteStore(args.store))
         gw = S3ApiServer(backend, args.ip, args.port,
                          credentials=creds,
-                         iam=iam_store, sts=sts, kms=kms)
+                         iam=iam_store, sts=sts, kms=kms,
+                         metrics_port=args.metrics_port)
         gw.start()
         print(f"s3 gateway listening on {gw.url}" +
-              (f" (filer {args.filer})" if args.filer else ""))
+              (f" (filer {args.filer})" if args.filer else "") +
+              (f" (metrics {gw.metrics_http.url}/metrics)"
+               if gw.metrics_http is not None else ""))
         _wait()
     elif args.cmd == "iam":
         from .iam import IdentityStore, StsService

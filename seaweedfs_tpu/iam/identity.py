@@ -121,6 +121,13 @@ class Identity:
         # inline IAM policy documents by name (iamapi PutUserPolicy);
         # identity.actions holds static_actions ∪ their translation
         self.policies: dict[str, str] = {}
+        # grants inherited through group membership (iam.proto Group
+        # policy_names, evaluated in auth_credentials.go
+        # evaluateIAMPolicies) — maintained by the IdentityStore, not
+        # serialized: they are derived state, recomputed on every
+        # group/policy mutation so detaching a policy from a group
+        # revokes it from every member atomically
+        self.group_actions: list[str] = []
         # actions provisioned directly (identities JSON / operator) —
         # policy recomputation must never strip these, or attaching a
         # policy to the admin identity would drop Admin (lockout)
@@ -128,7 +135,17 @@ class Identity:
 
     @property
     def is_admin(self) -> bool:
-        return ACTION_ADMIN in self.actions
+        return ACTION_ADMIN in self.actions or \
+            ACTION_ADMIN in self.group_actions
+
+    def granted_actions(self) -> list[str]:
+        """Own actions ∪ group-inherited ones — the set CanDo
+        consults (reference: identity actions + group policy
+        evaluation are independent allow paths)."""
+        if not self.group_actions:
+            return self.actions
+        return list(self.actions) + [a for a in self.group_actions
+                                     if a not in self.actions]
 
     def can_do(self, action: str, bucket: str, key: str = "") -> bool:
         """auth_credentials.go:1534 CanDo: exact action grants the
@@ -138,13 +155,14 @@ class Identity:
             return False
         if self.is_admin:
             return True
-        if action in self.actions:
+        granted = self.granted_actions()
+        if action in granted:
             return True
         if not bucket:
             return False
         full = bucket + ("/" + key.lstrip("/") if key else "")
         targets = (f"{action}:{full}", f"{ACTION_ADMIN}:{full}")
-        for a in self.actions:
+        for a in granted:
             if ":" not in a:
                 continue
             if "*" in a or "?" in a:
@@ -153,8 +171,8 @@ class Identity:
                 if any(fnmatch.fnmatchcase(t, a) for t in targets):
                     return True
                 continue
-            granted, _, scope = a.partition(":")
-            if granted not in (action, ACTION_ADMIN):
+            act, _, scope = a.partition(":")
+            if act not in (action, ACTION_ADMIN):
                 continue
             # exact scope, bucket-limited scope, or path-prefix scope
             if scope in (full, bucket) or \
@@ -224,6 +242,11 @@ class IdentityStore:
         # reference carries in S3ApiConfiguration
         self._policies: dict[str, str] = {}
         self._groups: dict[str, dict] = {}
+        # service accounts (iam.proto ServiceAccount: application
+        # credentials parented to a user, optionally restricted to a
+        # subset of its actions, optionally expiring)
+        self._service_accounts: dict[str, dict] = {}
+        self._sa_by_key: dict[str, dict] = {}
         self._mtime = 0.0
         if path and os.path.exists(path):
             self._reload()
@@ -262,11 +285,17 @@ class IdentityStore:
             identities[ident.name] = ident
             for c in ident.credentials:
                 by_key[c.access_key] = ident
+        sas = {sa["id"]: sa for sa in doc.get("serviceAccounts", [])}
         with self._lock:
             self._identities = identities
             self._by_access_key = by_key
             self._policies = dict(doc.get("policies", {}))
             self._groups = dict(doc.get("groups", {}))
+            self._service_accounts = sas
+            self._sa_by_key = {
+                sa["credential"]["accessKey"]: sa
+                for sa in sas.values() if sa.get("credential")}
+            self._recompute_group_grants()
 
     def to_json(self) -> dict:
         with self._lock:
@@ -276,6 +305,9 @@ class IdentityStore:
                 out["policies"] = dict(self._policies)
             if self._groups:
                 out["groups"] = dict(self._groups)
+            if self._service_accounts:
+                out["serviceAccounts"] = \
+                    list(self._service_accounts.values())
             return out
 
     def save(self) -> None:
@@ -301,7 +333,53 @@ class IdentityStore:
 
     def by_access_key(self, access_key: str) -> Identity | None:
         self._maybe_reload()
-        return self._by_access_key.get(access_key)
+        ident = self._by_access_key.get(access_key)
+        if ident is not None:
+            return ident
+        sa = self._sa_by_key.get(access_key)
+        if sa is not None:
+            return self._sa_identity(sa)
+        return None
+
+    def _sa_identity(self, sa: dict) -> Identity | None:
+        """Synthesize the auth-time Identity for a service-account
+        credential (auth_credentials.go loads ServiceAccounts into
+        the same access-key index).  Acts AS the parent user (bucket
+        ownership, policy principal) but with the SA's restricted
+        action set when one was given; dead if the SA is disabled /
+        expired or the parent is gone / disabled."""
+        parent = self._identities.get(sa.get("parentUser", ""))
+        if parent is None:
+            return None
+        import time as _t
+        exp = sa.get("expiration", 0)
+        dead = (sa.get("disabled", False) or parent.disabled or
+                (exp and exp < _t.time()))
+        restricted = list(sa.get("actions") or ())
+        if restricted:
+            # the subset invariant is enforced at AUTH time, not just
+            # at creation: revoking a grant from the parent must also
+            # revoke it from every service account that named it —
+            # otherwise an operator auditing the parent sees no
+            # access while the SA's writes keep landing
+            kept = []
+            for a in restricted:
+                act, _, scope = a.partition(":")
+                bucket, _, key = scope.partition("/")
+                if parent.can_do(act, bucket, key):
+                    kept.append(a)
+            restricted = kept or ["__none__"]   # all revoked: dead
+        ident = Identity(
+            parent.name,
+            [Credential.from_json(sa["credential"])],
+            restricted or list(parent.actions),
+            parent.account, disabled=bool(dead),
+            principal_arn=parent.principal_arn)
+        if not sa.get("actions"):
+            # unrestricted SA inherits the parent's group grants too;
+            # a restricted one is capped at exactly its action list
+            ident.group_actions = list(parent.group_actions)
+        return ident
 
     def secret_for(self, access_key: str) -> str | None:
         ident = self.by_access_key(access_key)
@@ -330,6 +408,7 @@ class IdentityStore:
                 for c in old.credentials:
                     self._by_access_key.pop(c.access_key, None)
             self._index(ident)
+            self._recompute_group_grants()
             self.save()
 
     def delete(self, name: str) -> None:
@@ -345,6 +424,7 @@ class IdentityStore:
     def put_policy(self, name: str, content: str) -> None:
         with self._lock:
             self._policies[name] = content
+            self._recompute_group_grants()
             self.save()
 
     def get_policy(self, name: str) -> "str | None":
@@ -359,11 +439,44 @@ class IdentityStore:
     def delete_policy(self, name: str) -> None:
         with self._lock:
             self._policies.pop(name, None)
+            self._recompute_group_grants()
             self.save()
+
+    def _recompute_group_grants(self) -> None:
+        """Refresh every identity's derived group_actions from group
+        membership × attached managed policies.  Caller holds the
+        lock (or is single-threaded startup).  Translation uses the
+        same policy→coarse-action mapping the IAM API applies to
+        inline user policies, so a grant means the same thing
+        whichever path attached it."""
+        derived: dict[str, set] = {}
+        if self._groups:
+            try:
+                from .iamapi import policy_to_actions
+            except Exception:
+                return
+            for g in self._groups.values():
+                if g.get("disabled"):
+                    continue
+                acts: set = set()
+                for pname in g.get("policyNames", []):
+                    doc = self._policies.get(pname)
+                    if doc:
+                        try:
+                            acts.update(policy_to_actions(doc))
+                        except Exception:
+                            continue   # malformed doc grants nothing
+                if not acts:
+                    continue
+                for member in g.get("members", []):
+                    derived.setdefault(member, set()).update(acts)
+        for ident in self._identities.values():
+            ident.group_actions = sorted(derived.get(ident.name, ()))
 
     def put_group(self, name: str, group: dict) -> None:
         with self._lock:
             self._groups[name] = group
+            self._recompute_group_grants()
             self.save()
 
     def get_group(self, name: str) -> "dict | None":
@@ -378,7 +491,40 @@ class IdentityStore:
     def delete_group(self, name: str) -> None:
         with self._lock:
             self._groups.pop(name, None)
+            self._recompute_group_grants()
             self.save()
+
+    # -- service accounts (iam.proto ServiceAccount) -----------------------
+
+    def put_service_account(self, sa: dict) -> None:
+        with self._lock:
+            old = self._service_accounts.get(sa["id"])
+            if old is not None and old.get("credential"):
+                self._sa_by_key.pop(
+                    old["credential"]["accessKey"], None)
+            self._service_accounts[sa["id"]] = sa
+            if sa.get("credential"):
+                self._sa_by_key[sa["credential"]["accessKey"]] = sa
+            self.save()
+
+    def get_service_account(self, sa_id: str) -> "dict | None":
+        self._maybe_reload()
+        return self._service_accounts.get(sa_id)
+
+    def list_service_accounts(self, parent: str = "") -> list[dict]:
+        self._maybe_reload()
+        with self._lock:
+            return [sa for sa in self._service_accounts.values()
+                    if not parent or sa.get("parentUser") == parent]
+
+    def delete_service_account(self, sa_id: str) -> None:
+        with self._lock:
+            old = self._service_accounts.pop(sa_id, None)
+            if old is not None:
+                if old.get("credential"):
+                    self._sa_by_key.pop(
+                        old["credential"]["accessKey"], None)
+                self.save()
 
     # -- SigV4Verifier adapter --------------------------------------------
 

@@ -180,6 +180,13 @@ class BrokerServer:
         # them answer 503-retry so the drain below is authoritative
         # (guarded by self._lock)
         self._repartitioning: set[Topic] = set()
+        # peer-side delete fence (guarded by self._lock): when a PEER
+        # broker is deleting a topic, publishes here must not pass a
+        # <=CONF_TTL-stale owner gate and append into dirs the delete
+        # is about to remove — _flush_all would resurrect the topic
+        # with orphan messages.  Values are wall-clock expiry stamps;
+        # after expiry a fresh conf load finds the conf gone -> 404.
+        self._deleting: dict[Topic, float] = {}
         # periodic flush bounds the acked-but-unflushed window to
         # ~flush_interval on a crash (the reference's log_buffer also
         # flushes on a timer, util/log_buffer)
@@ -329,9 +336,10 @@ class BrokerServer:
                         for (t, p), log in self._logs.items()]
         hot: "set[Topic]" = set()
         for t, p, total in snapshot:
-            prev_total, prev_ts = self._split_samples.get(
-                (t, p), (total, now))
-            self._split_samples[(t, p)] = (total, now)
+            with self._lock:   # _split_samples shared w/ delete+split
+                prev_total, prev_ts = self._split_samples.get(
+                    (t, p), (total, now))
+                self._split_samples[(t, p)] = (total, now)
             dt = now - prev_ts
             if dt <= 0:
                 continue
@@ -364,9 +372,10 @@ class BrokerServer:
                 "partitionCount": new_n}))
             if status == 200:
                 # fresh rate baselines for the new partitions
-                self._split_samples = {
-                    k: v for k, v in self._split_samples.items()
-                    if k[0] != t}
+                with self._lock:
+                    self._split_samples = {
+                        k: v for k, v in self._split_samples.items()
+                        if k[0] != t}
         finally:
             with self._lock:
                 self._splitting.discard(t)
@@ -546,6 +555,14 @@ class BrokerServer:
         except (TimeoutError, OSError) as e:
             return 503, {"error": f"repartition lock: {e}"}
         with self._lock:
+            # claim-or-fail (review r5): the set is shared with
+            # _delete_topic; blindly adding would let this op's
+            # finally-discard drop a concurrent owner's publish fence
+            if t in self._repartitioning:
+                lock.release()
+                return 503, {"error": "another repartition/delete "
+                                      "of this topic is in progress; "
+                                      "retry"}
             self._repartitioning.add(t)
         old_owners = None
         claimed = False
@@ -798,6 +815,17 @@ class BrokerServer:
             with self._lock:
                 for p in parts:
                     self._logs.pop((t, p), None)
+                if b.get("invalidateConf"):
+                    # the caller is DELETING the topic: our cached
+                    # layout must not authorize any more appends, and
+                    # the fence outlives CONF_TTL so a republish
+                    # cannot sneak in on a stale owner column before
+                    # the conf file disappears
+                    self._topics.pop(t, None)
+                    self._owners.pop(t, None)
+                    self._conf_loaded.pop(t, None)
+                    self._deleting[t] = time.time() + \
+                        self.CONF_TTL * 2
         if not b.get("localOnly"):
             try:
                 peers = [p for p in self._registered_brokers()
@@ -812,7 +840,10 @@ class BrokerServer:
                         json.dumps({
                             "namespace": t.namespace,
                             "topic": t.name,
-                            "localOnly": True}).encode())
+                            "localOnly": True,
+                            "invalidateConf":
+                                bool(b.get("invalidateConf")),
+                        }).encode())
                 except OSError as e:
                     st_p, body_p = 0, str(e).encode()
                 if st_p != 200:
@@ -861,11 +892,28 @@ class BrokerServer:
         except NameError_ as e:
             return 400, {"error": str(e)}
         with self._lock:
-            self._repartitioning.add(t)   # publish fence (shared)
+            # claim-or-fail: an in-flight repartition (auto-split)
+            # would otherwise re-create the conf/dirs mid-delete, and
+            # our finally-discard would drop ITS publish fence
+            if t in self._repartitioning:
+                return 503, {"error": "repartition of this topic is "
+                                      "in progress; retry"}
+            self._repartitioning.add(t)   # publish fence + op claim
         try:
-            status, body = self._truncate(req)
+            status, body = self._truncate(_LocalReq(
+                {"namespace": t.namespace, "topic": t.name,
+                 "invalidateConf": True}))
             if status != 200:
                 return status, body
+            # conf file FIRST: once it is gone, any fresh layout load
+            # anywhere answers 404, independent of the peers' fence
+            # windows — then the directory tree
+            try:
+                http_bytes("DELETE",
+                           f"{self.filer}"
+                           f"{urllib.parse.quote(self._conf_path(t))}")
+            except OSError:
+                pass    # recursive dir delete below still covers it
             try:
                 st_d, body_d, _ = http_bytes(
                     "DELETE",
@@ -891,9 +939,9 @@ class BrokerServer:
                 # topic dir with orphan messages forever
                 for key in [k for k in self._logs if k[0] == t]:
                     self._logs.pop(key, None)
-            self._split_samples = {
-                k: v for k, v in self._split_samples.items()
-                if k[0] != t}
+                self._split_samples = {
+                    k: v for k, v in self._split_samples.items()
+                    if k[0] != t}
         finally:
             with self._lock:
                 self._repartitioning.discard(t)
@@ -1054,6 +1102,10 @@ class BrokerServer:
             return 400, {"error": str(e)}
         n = int(b.get("partitionCount", 4))
         with self._topic_lock(t).write():
+            with self._lock:
+                # an explicit (re)configure supersedes any delete
+                # fence here: the conf it persists is fresh truth
+                self._deleting.pop(t, None)
             try:
                 existing = self._load_layout(t)
             except RuntimeError as e:
@@ -1197,6 +1249,17 @@ class BrokerServer:
             t = self._topic_from(b["namespace"], b["topic"])
         except NameError_ as e:
             return 400, {"error": str(e)}
+        with self._lock:
+            fence = self._deleting.get(t, 0)
+            if fence and time.time() >= fence:
+                del self._deleting[t]       # expired: normal path
+                fence = 0
+        if fence:
+            # a peer is deleting this topic: refuse now (503-retry);
+            # once the fence lapses a FRESH conf load sees the conf
+            # gone and answers the honest 404 (or serves a re-created
+            # topic from scratch)
+            return 503, {"error": "topic deletion in progress; retry"}
         for _attempt in range(2):
             try:
                 parts = self._load_layout(t)

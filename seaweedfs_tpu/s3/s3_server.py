@@ -91,7 +91,8 @@ class S3ApiServer:
     def __init__(self, filer: Filer, host: str = "127.0.0.1",
                  port: int = 0,
                  credentials: dict[str, str] | None = None,
-                 iam=None, sts=None, kms=None):
+                 iam=None, sts=None, kms=None,
+                 metrics_port: int | None = None):
         """`credentials` is the legacy flat access->secret dict (every
         key acts as admin).  `iam` is an iam.IdentityStore: identities
         then carry coarse actions enforced per request
@@ -113,12 +114,30 @@ class S3ApiServer:
         self._stripes = [threading.Lock() for _ in range(64)]
         self._cors_cache: dict[str, tuple[str, list]] = {}
         self._policy_cache: dict[str, tuple[str, list]] = {}
+        # admission control + per-bucket observability
+        # (s3api_circuit_breaker.go; stats/metrics.go S3 families)
+        from ..stats import Metrics
+        from .circuit_breaker import CircuitBreaker
+        self.circuit_breaker = CircuitBreaker()
+        self._cb_stamp = (0.0, -1.0)     # (checked-at, entry-mtime)
+        self.metrics = Metrics("s3")
+        # metrics ride a SEPARATE listener (`weed s3 -metricsPort`):
+        # the S3 port must keep every path free for bucket names
+        self.metrics_http = None
+        if metrics_port is not None:
+            self.metrics_http = HttpServer(host, metrics_port)
+            self.metrics_http.route(
+                "GET", "/metrics",
+                lambda req: (200, (self.metrics.render().encode(),
+                                   "text/plain; version=0.0.4")))
 
     def _path_lock(self, path: str) -> "threading.Lock":
         return self._stripes[hash(path) % len(self._stripes)]
 
     def start(self):
         self.http.start()
+        if self.metrics_http is not None:
+            self.metrics_http.start()
         # filer -> s3 IAM cache propagation service (s3.proto
         # SeaweedS3IamCache): identity/policy/group pushes land in
         # the gateway's live auth state without a restart
@@ -140,6 +159,8 @@ class S3ApiServer:
         if getattr(self, "grpc_server", None) is not None:
             self.grpc_server.stop(grace=0.5).wait()
             self.grpc_server = None
+        if self.metrics_http is not None:
+            self.metrics_http.stop()
         self.http.stop()
 
     @property
@@ -147,6 +168,58 @@ class S3ApiServer:
         return self.http.url
 
     # -- dispatch ---------------------------------------------------------
+
+    def _observe(self, req: Request, bucket: str, action: str,
+                 resp) -> None:
+        """Per-bucket request/byte counters (stats/metrics.go
+        S3RequestCounter / S3 bytes families), served by the side
+        metrics server (`weed s3 -metricsPort` analog)."""
+        status = resp[0] if isinstance(resp, tuple) else 200
+        # label-cardinality guard: only successful requests and
+        # authenticated callers mint per-bucket label values — an
+        # unauthenticated loop over random names must not grow the
+        # registry without bound
+        authed = bool(getattr(req, "s3_identity", None))
+        blabel = bucket if bucket and \
+            (authed or (isinstance(status, int) and status < 400)) \
+            else "-"
+        self.metrics.counter_add(
+            "request_total", 1.0, "s3 requests",
+            bucket=blabel, action=action, code=str(status))
+        n_in = len(req.body or b"")
+        if n_in:
+            self.metrics.counter_add(
+                "received_bytes_total", float(n_in),
+                "request payload bytes", bucket=blabel)
+        payload = resp[1] if isinstance(resp, tuple) and \
+            len(resp) > 1 else b""
+        if isinstance(payload, tuple):
+            payload = payload[0]
+        if isinstance(payload, (bytes, str)) and payload:
+            self.metrics.counter_add(
+                "sent_bytes_total", float(len(payload)),
+                "response payload bytes", bucket=blabel)
+
+    def _refresh_circuit_breaker(self) -> None:
+        """Lazy 2s-TTL reload of /etc/s3/circuit_breaker.json from
+        the filer (the reference subscribes to filer metadata; a TTL
+        poll gives the same operator experience without a stream)."""
+        import time as _t
+        from .circuit_breaker import CONFIG_PATH
+        now = _t.monotonic()
+        checked, mtime = self._cb_stamp
+        if now - checked < 2.0:
+            return
+        e = self.filer.find_entry(CONFIG_PATH)
+        new_mtime = e.attributes.mtime if e is not None else 0
+        if new_mtime != mtime:
+            try:
+                content = self.filer.read_file(CONFIG_PATH) \
+                    if e is not None else b""
+                self.circuit_breaker.load_bytes(content)
+            except Exception:
+                pass        # keep the last good config on a bad write
+        self._cb_stamp = (now, new_mtime)
 
     def _dispatch(self, req: Request):
         parts = req.path.lstrip("/").split("/", 1)
@@ -157,7 +230,26 @@ class S3ApiServer:
             # CORS preflight: unauthenticated by design (browsers send
             # no credentials on preflights)
             return self._preflight(req, bucket)
-        resp = self._handle(req, bucket, key)
+        from ..iam import coarse_action
+        from .policy import action_for
+        cb_action = coarse_action(
+            action_for(req.method, bucket, key, req.query),
+            req.method, req.query)
+        self._refresh_circuit_breaker()
+        rollback, err = self.circuit_breaker.admit(
+            bucket, cb_action, len(req.body or b""))
+        if err is not None:
+            # falls through to the CORS tail below: a throttled
+            # browser request must still read the 503 (else it sees
+            # an opaque CORS failure instead of a retryable error)
+            resp = _error(503, err,
+                          "simultaneous request limit reached")
+        else:
+            try:
+                resp = self._handle(req, bucket, key)
+            finally:
+                rollback()
+        self._observe(req, bucket, cb_action, resp)
         if origin and bucket:
             cors = cors_evaluate(self._cors_rules(bucket), origin,
                                  req.method)

@@ -565,7 +565,7 @@ class FilerServer:
         weedfs_quota.go polls the same numbers)."""
         try:
             return 200, cluster_statistics(
-                self.master, req.query.get("collection", ""))
+                self.filer.master, req.query.get("collection", ""))
         except OSError as e:
             return 503, {"error": str(e)}
 

@@ -188,3 +188,73 @@ def cmd_s3_bucket_list(env: CommandEnv, args: list[str]) -> str:
         if e.get("isDirectory"):
             out.append(e["fullPath"].rsplit("/", 1)[-1])
     return "\n".join(sorted(out)) or "no buckets"
+
+
+@command("s3.circuitBreaker")
+def cmd_s3_circuit_breaker(env: CommandEnv, args: list[str]) -> str:
+    """command_s3_circuitbreaker.go: edit the admission-control
+    config at /etc/s3/circuit_breaker.json (the gateway TTL-reloads
+    it).  Usage mirrors the reference:
+
+        s3.circuitBreaker -global -type=count -actions=Read,Write
+                          -values=500,200 -apply
+        s3.circuitBreaker -buckets=x,y -type=mb -actions=Write
+                          -values=64 -apply
+        s3.circuitBreaker -global -disable -apply
+        s3.circuitBreaker -buckets=x -delete -apply
+        s3.circuitBreaker -delete -apply          # clear everything
+
+    Without -apply the resulting config is printed, not written."""
+    import json as _json
+    from ..s3.circuit_breaker import CONFIG_PATH, CircuitBreaker
+    opts = _parse_flags(args)
+    fc = _client(env)
+    e = fc.find_entry(CONFIG_PATH)
+    doc = {}
+    if e is not None:
+        raw = fc.read_file(CONFIG_PATH)
+        doc = _json.loads(raw) if raw else {}
+    is_global = "global" in opts
+    buckets = [b for b in opts.get("buckets", "").split(",") if b]
+    if "delete" in opts:
+        if buckets:
+            for b in buckets:
+                doc.get("buckets", {}).pop(b, None)
+        elif is_global:
+            doc.pop("global", None)
+        else:
+            doc = {}
+    elif "disable" in opts:
+        targets = ([doc.setdefault("buckets", {}).setdefault(
+            b, {"actions": {}}) for b in buckets] if buckets
+            else [doc.setdefault("global", {"actions": {}})])
+        for t in targets:
+            t["enabled"] = False
+    else:
+        ltype = {"count": "Count", "mb": "MB"}.get(
+            opts.get("type", "count").lower())
+        if ltype is None:
+            raise RuntimeError("-type must be count or mb")
+        actions = [a for a in opts.get("actions", "").split(",") if a]
+        values = [v for v in opts.get("values", "").split(",") if v]
+        if not actions or len(actions) != len(values):
+            return ("usage: s3.circuitBreaker [-global|-buckets=x,y] "
+                    "-type=count|mb -actions=Read,Write "
+                    "-values=N,M -apply")
+        entries = {f"{a}:{ltype}": int(v)
+                   for a, v in zip(actions, values)}
+        targets = ([doc.setdefault("buckets", {}).setdefault(
+            b, {"enabled": True, "actions": {}}) for b in buckets]
+            if buckets
+            else [doc.setdefault("global",
+                                 {"enabled": True, "actions": {}})])
+        for t in targets:
+            t["enabled"] = True
+            t.setdefault("actions", {}).update(entries)
+    CircuitBreaker().load(doc)        # validate before write/print
+    rendered = _json.dumps(doc, indent=1)
+    if "apply" not in opts:
+        return rendered + "\n(dry run; add -apply to write)"
+    fc.write_file(CONFIG_PATH, rendered.encode(),
+                  mime="application/json")
+    return f"applied:\n{rendered}"

@@ -315,3 +315,14 @@ def test_filer_end_to_end_on_lsm_store(tmp_path):
     finally:
         vs.stop()
         master.stop()
+
+
+def test_meta_statistics_endpoint(cluster):
+    """Regression: /__meta__/statistics crashed with AttributeError
+    (FilerServer has no self.master) instead of aggregating master
+    topology — the mount's quota feed reads this endpoint
+    (weedfs_quota.go analog in mount/weedfs.py)."""
+    master, servers, fs = cluster
+    from seaweedfs_tpu.server.httpd import http_json
+    stats = http_json("GET", f"{fs.http.url}/__meta__/statistics")
+    assert stats["totalSize"] >= 0 and "usedSize" in stats

@@ -1,0 +1,301 @@
+"""Round-5 IAM breadth: group-inherited grants in the auth path,
+service accounts (iam.proto ServiceAccount), key rotation, and the
+export/import + bucket access/lock shell families
+(weed/shell/command_s3_group_*.go, command_s3_serviceaccount_*.go,
+command_s3_accesskey_rotate.go, command_s3_iam_export.go,
+command_s3_bucket_access.go, command_s3_bucket_lock.go;
+weed/s3api/auth_credentials.go evaluateIAMPolicies)."""
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.iam.identity import (Credential, Identity,
+                                        IdentityStore)
+from seaweedfs_tpu.shell import run_command
+from seaweedfs_tpu.shell.commands import CommandEnv
+
+
+POLICY_RW_DOCS = json.dumps({
+    "Version": "2012-10-17",
+    "Statement": [{"Effect": "Allow",
+                   "Action": ["s3:GetObject", "s3:PutObject",
+                              "s3:ListBucket"],
+                   "Resource": "arn:aws:s3:::docs/*"}]})
+
+
+def _store(tmp_path, name="s3.json") -> IdentityStore:
+    return IdentityStore(str(tmp_path / name))
+
+
+# -- group grants in can_do ------------------------------------------------
+
+
+def test_group_policy_grants_members(tmp_path):
+    store = _store(tmp_path)
+    store.put(Identity("carol", [Credential("AK1", "SK1")]))
+    store.put_policy("docs-rw", POLICY_RW_DOCS)
+    store.put_group("writers", {"name": "writers",
+                                "members": ["carol"],
+                                "policyNames": ["docs-rw"],
+                                "disabled": False})
+    carol = store.get("carol")
+    assert carol.can_do("Read", "docs", "a.txt")
+    assert carol.can_do("Write", "docs", "a.txt")
+    assert not carol.can_do("Write", "other")
+    # grants survive a reload from disk (derived state recomputed)
+    again = IdentityStore(store.path).get("carol")
+    assert again.can_do("Write", "docs", "x")
+    # detaching the group revokes for every member atomically
+    store.delete_group("writers")
+    assert not store.get("carol").can_do("Read", "docs", "a.txt")
+
+
+def test_disabled_group_grants_nothing(tmp_path):
+    store = _store(tmp_path)
+    store.put(Identity("dave", [Credential("AK2", "SK2")]))
+    store.put_policy("docs-rw", POLICY_RW_DOCS)
+    store.put_group("g", {"name": "g", "members": ["dave"],
+                          "policyNames": ["docs-rw"],
+                          "disabled": True})
+    assert not store.get("dave").can_do("Read", "docs", "a")
+
+
+def test_policy_edit_propagates_to_group_members(tmp_path):
+    store = _store(tmp_path)
+    store.put(Identity("erin", [Credential("AK3", "SK3")]))
+    store.put_group("g", {"name": "g", "members": ["erin"],
+                          "policyNames": ["p"], "disabled": False})
+    assert not store.get("erin").can_do("Read", "docs", "a")
+    store.put_policy("p", POLICY_RW_DOCS)
+    assert store.get("erin").can_do("Read", "docs", "a")
+    store.delete_policy("p")
+    assert not store.get("erin").can_do("Read", "docs", "a")
+
+
+# -- service accounts ------------------------------------------------------
+
+
+def test_service_account_auth_and_restriction(tmp_path):
+    store = _store(tmp_path)
+    store.put(Identity("app-owner", [Credential("AKP", "SKP")],
+                       actions=["Read:data", "Write:data",
+                                "List:data"]))
+    store.put_service_account({
+        "id": "sa-1", "parentUser": "app-owner",
+        "credential": {"accessKey": "SAKEY", "secretKey": "SASEC"},
+        "actions": ["Read:data"], "expiration": 0,
+        "disabled": False})
+    ident = store.by_access_key("SAKEY")
+    assert ident is not None and ident.name == "app-owner"
+    assert store.secret_for("SAKEY") == "SASEC"
+    assert ident.can_do("Read", "data", "f")
+    # restricted below the parent: Write denied through the SA key
+    assert not ident.can_do("Write", "data", "f")
+    # unrestricted SA inherits the parent's full set
+    store.put_service_account({
+        "id": "sa-2", "parentUser": "app-owner",
+        "credential": {"accessKey": "SAKEY2", "secretKey": "X"},
+        "actions": [], "expiration": 0, "disabled": False})
+    assert store.by_access_key("SAKEY2").can_do("Write", "data", "f")
+
+
+def test_service_account_expiry_and_parent_disable(tmp_path):
+    store = _store(tmp_path)
+    store.put(Identity("p", [Credential("PK", "PS")],
+                       actions=["Read:b"]))
+    store.put_service_account({
+        "id": "sa-e", "parentUser": "p",
+        "credential": {"accessKey": "EK", "secretKey": "ES"},
+        "actions": [], "expiration": int(time.time()) - 5,
+        "disabled": False})
+    assert store.secret_for("EK") is None          # expired
+    store.put_service_account({
+        "id": "sa-l", "parentUser": "p",
+        "credential": {"accessKey": "LK", "secretKey": "LS"},
+        "actions": [], "expiration": 0, "disabled": False})
+    assert store.secret_for("LK") == "LS"
+    parent = store.get("p")
+    parent.disabled = True
+    store.put(parent)
+    assert store.secret_for("LK") is None          # parent disabled
+    # deleting the SA removes the key entirely
+    store.delete_service_account("sa-l")
+    assert store.by_access_key("LK") is None
+
+
+# -- shell families (no cluster needed for the store-only commands) -------
+
+
+@pytest.fixture()
+def env(tmp_path):
+    e = CommandEnv("http://127.0.0.1:1")     # master never dialed here
+    e.iam_config = str(tmp_path / "s3.json")
+    return e
+
+
+def test_shell_group_family(env):
+    run_command(env, "s3.user.create -user=u1")
+    with pytest.raises(RuntimeError):
+        run_command(env, "s3.group.create -name=g -policies=missing")
+    run_command(env,
+                "s3.policy -name=rw -content=" + POLICY_RW_DOCS
+                .replace(" ", ""))
+    run_command(env, "s3.group.create -name=g -policies=rw")
+    with pytest.raises(RuntimeError):
+        run_command(env, "s3.group.create -name=g")
+    run_command(env, "s3.group.add.user -name=g -user=u1")
+    assert "u1 already in g" in run_command(
+        env, "s3.group.add.user -name=g -user=u1")
+    show = json.loads(run_command(env, "s3.group.show -name=g"))
+    assert show["members"] == ["u1"]
+    assert "members=1" in run_command(env, "s3.group.list")
+    # the grant is live through the same store file
+    store = IdentityStore(env.iam_config)
+    assert store.get("u1").can_do("Write", "docs", "f")
+    run_command(env, "s3.group.remove.user -name=g -user=u1")
+    assert not IdentityStore(env.iam_config).get("u1").can_do(
+        "Write", "docs", "f")
+    run_command(env, "s3.group.delete -name=g")
+    assert "(no groups)" in run_command(env, "s3.group.list")
+
+
+def test_shell_policy_command(env):
+    assert "(no managed policies)" in run_command(env,
+                                                  "s3.policy -list")
+    with pytest.raises(Exception):
+        run_command(env, "s3.policy -name=bad -content={\"x\":1}")
+    run_command(env, "s3.policy -name=rw -content=" +
+                POLICY_RW_DOCS.replace(" ", ""))
+    assert "rw" in run_command(env, "s3.policy -list")
+    assert "GetObject" in run_command(env, "s3.policy -name=rw")
+    run_command(env, "s3.policy -name=rw -delete")
+    assert "(no managed policies)" in run_command(env,
+                                                  "s3.policy -list")
+
+
+def test_shell_serviceaccount_family(env):
+    run_command(env,
+                "s3.user.create -user=parent -actions=Read:b,List:b")
+    # cannot exceed the parent
+    with pytest.raises(RuntimeError):
+        run_command(env, "s3.serviceaccount.create -user=parent "
+                         "-actions=Write:b")
+    out = run_command(env, "s3.serviceaccount.create -user=parent "
+                           "-actions=Read:b -expiry=1h")
+    sa_id = out.splitlines()[0].split()[1]
+    key = [ln for ln in out.splitlines()
+           if ln.startswith("accessKey:")][0].split()[1]
+    assert sa_id.startswith("sa-")
+    listing = run_command(env, "s3.serviceaccount.list -user=parent")
+    assert sa_id in listing and "active" in listing
+    shown = json.loads(run_command(
+        env, f"s3.serviceaccount.show -id={sa_id}"))
+    assert shown["credential"]["secretKey"] == "<redacted>"
+    store = IdentityStore(env.iam_config)
+    ident = store.by_access_key(key)
+    assert ident.can_do("Read", "b") and \
+        not ident.can_do("List", "b")
+    run_command(env, f"s3.serviceaccount.delete -id={sa_id}")
+    assert "(no service accounts)" in run_command(
+        env, "s3.serviceaccount.list")
+
+
+def test_shell_accesskey_rotate(env):
+    out = run_command(env, "s3.user.create -user=rot")
+    old = [ln for ln in out.splitlines()
+           if ln.startswith("accessKey:")][0].split()[1]
+    out = run_command(env, "s3.accesskey.rotate -user=rot")
+    assert f"rotated {old} ->" in out
+    new = out.splitlines()[0].split()[-1]
+    store = IdentityStore(env.iam_config)
+    assert store.by_access_key(old) is None
+    assert store.by_access_key(new).name == "rot"
+    # ambiguous with two keys unless -accessKey names one
+    run_command(env, "s3.accesskey.create -user=rot")
+    with pytest.raises(RuntimeError):
+        run_command(env, "s3.accesskey.rotate -user=rot")
+    run_command(env, f"s3.accesskey.rotate -user=rot -accessKey={new}")
+    assert IdentityStore(env.iam_config).by_access_key(new) is None
+
+
+def test_shell_iam_export_import(env, tmp_path):
+    run_command(env, "s3.user.create -user=ex1 -actions=Read:b")
+    run_command(env, "s3.policy -name=rw -content=" +
+                POLICY_RW_DOCS.replace(" ", ""))
+    run_command(env, "s3.group.create -name=g -policies=rw")
+    run_command(env, "s3.serviceaccount.create -user=ex1")
+    dump = str(tmp_path / "dump.json")
+    run_command(env, f"s3.iam.export -file={dump}")
+    doc = json.load(open(dump))
+    assert doc["groups"]["g"]["policyNames"] == ["rw"]
+    assert doc["serviceAccounts"][0]["parentUser"] == "ex1"
+    # wipe by importing into a fresh config, then verify round-trip
+    env2 = CommandEnv("http://127.0.0.1:1")
+    env2.iam_config = str(tmp_path / "other.json")
+    run_command(env2, "s3.user.create -user=existing")
+    out = run_command(env2, f"s3.iam.import -file={dump} -merge")
+    assert "imported" in out
+    store = IdentityStore(env2.iam_config)
+    assert store.get("ex1") is not None
+    assert store.get("existing") is not None       # -merge kept it
+    assert store.get_policy("rw") is not None
+    # full replace drops entries not in the dump
+    run_command(env2, f"s3.iam.import -file={dump}")
+    assert IdentityStore(env2.iam_config).get("existing") is None
+
+
+def test_bucket_access_none_warns_about_group_grants(env):
+    """Review r5: -access=none cannot strip group-inherited grants;
+    the command must say so instead of reporting 'none'."""
+    run_command(env, "s3.user.create -user=gm")
+    run_command(env, "s3.policy -name=rw -content=" +
+                POLICY_RW_DOCS.replace(" ", ""))
+    run_command(env, "s3.group.create -name=g -policies=rw")
+    run_command(env, "s3.group.add.user -name=g -user=gm")
+    out = run_command(env,
+                      "s3.bucket.access -name=docs -user=gm "
+                      "-access=none")
+    assert "WARNING" in out and "inherited via groups" in out
+    # and the view path shows the surviving grant too
+    out = run_command(env, "s3.bucket.access -name=docs -user=gm")
+    assert "docs" in out and "none" not in out
+
+
+def test_bucket_access_none_strips_path_scoped_grants(env):
+    """Review r5 (2nd pass): path-scoped grants (Read:b/prefix) target
+    the bucket too; -access=none must strip them, not report 'none'
+    while they survive."""
+    run_command(env, "s3.user.create -user=ps "
+                     "-actions=Read:accb/docs,Write:accb,Read:other")
+    out = run_command(env, "s3.bucket.access -name=accb -user=ps")
+    assert "Read:accb/docs" in out and "Write:accb" in out
+    run_command(env, "s3.bucket.access -name=accb -user=ps "
+                     "-access=none")
+    i = IdentityStore(env.iam_config).get("ps")
+    assert not i.can_do("Read", "accb", "docs/f.txt")
+    assert i.can_do("Read", "other")          # untouched
+    out = run_command(env, "s3.bucket.access -name=accb -user=ps")
+    assert "none" in out
+
+
+def test_service_account_shrinks_with_parent_revocation(tmp_path):
+    """Review r5 (2nd pass): the subset invariant holds at AUTH time —
+    revoking the parent's grant revokes it from SAs that named it."""
+    store = _store(tmp_path)
+    store.put(Identity("boss", [Credential("BK", "BS")],
+                       actions=["Read:pay", "Write:pay"]))
+    store.put_service_account({
+        "id": "sa-w", "parentUser": "boss",
+        "credential": {"accessKey": "WK", "secretKey": "WS"},
+        "actions": ["Write:pay"], "expiration": 0,
+        "disabled": False})
+    assert store.by_access_key("WK").can_do("Write", "pay")
+    boss = store.get("boss")
+    boss.actions = ["Read:pay"]
+    boss.static_actions = ["Read:pay"]
+    store.put(boss)
+    sa_ident = store.by_access_key("WK")
+    assert not sa_ident.can_do("Write", "pay")
+    assert not sa_ident.can_do("Read", "pay")   # never granted to SA

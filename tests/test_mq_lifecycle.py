@@ -123,10 +123,14 @@ def test_group_introspection(stack):
 def test_auto_split_hot_partition(stack, tmp_path):
     """A partition appended faster than the threshold triggers an
     automatic repartition doubling the topic's partition count, with
-    every message preserved.  Uses its OWN broker with the tiny
-    threshold armed — the shared stack must stay split-free or the
-    exact-partition-count assertions above turn flaky."""
-    client, gw, shared_broker, filer = stack
+    every message preserved.  Uses its OWN filer + broker: the armed
+    broker must OWN the hot partition (each broker samples only its
+    local logs), and in the shared registry the stack's split-blind
+    broker can win the allocation; isolation also keeps the shared
+    stack split-free for the exact-partition-count tests above."""
+    client, gw, shared_broker, shared_filer = stack
+    filer = FilerServer(shared_filer.filer.master,
+                        store_path=str(tmp_path / "hot.db")).start()
     # ~0.01 MB/min = ~175 raw bytes/sec per partition
     broker = BrokerServer(filer.url, flush_interval=0.3,
                           auto_split_mb_per_min=0.01).start()
@@ -159,3 +163,53 @@ def test_auto_split_hot_partition(stack, tmp_path):
     for key, value in sent.items():
         assert got.get(key) == value
     broker.stop()
+    filer.stop()
+
+
+def test_delete_topic_fences_peer_cached_publish(stack, tmp_path):
+    """Review r5: topic delete must invalidate PEER conf caches and
+    fence their publishes — a peer with a <=CONF_TTL-stale layout
+    naming itself owner would otherwise append after the drain, and
+    its next flush resurrects the deleted topic dir with orphan
+    messages."""
+    import base64 as b64
+    import json as _json
+    from seaweedfs_tpu.server.httpd import http_bytes
+    client, gw, broker_a, shared_filer = stack
+    broker_b = BrokerServer(shared_filer.url,
+                            flush_interval=0.2).start()
+    try:
+        mq = MQClient(broker_a.url)
+        mq.configure_topic("delns", "fenced", 4)
+        # warm BOTH brokers' conf caches (B redirects or serves
+        # depending on allocation; either way it loads the layout)
+        for i in range(8):
+            try:
+                mq.publish("delns", "fenced", f"k{i}".encode(), b"v")
+            except RuntimeError:
+                pass
+        t_dir = "/topics/delns/fenced"
+        st, _, _ = http_bytes(
+            "POST", f"{broker_a.url}/topics/delete",
+            _json.dumps({"namespace": "delns",
+                         "topic": "fenced"}).encode())
+        assert st == 200
+        # immediate publish DIRECT to the peer (stale-cache window):
+        # must be refused, never acknowledged into a deleted dir
+        st, body, _ = http_bytes(
+            "POST", f"{broker_b.url}/topics/publish",
+            _json.dumps({"namespace": "delns", "topic": "fenced",
+                         "key": b64.b64encode(b"zombie").decode(),
+                         "value": b64.b64encode(b"boo").decode()},
+                        ).encode())
+        assert st in (404, 503), (st, body)
+        # after B's flush interval the topic dir must STAY deleted
+        # (directory LISTINGS 200-with-empty on missing paths, so
+        # check the entry itself)
+        time.sleep(0.6)
+        assert shared_filer.filer.find_entry(t_dir) is None, \
+            "topic dir resurrected"
+        assert not shared_filer.filer.list_directory(t_dir), \
+            "orphan partition dirs under deleted topic"
+    finally:
+        broker_b.stop()

@@ -315,3 +315,33 @@ def test_mq_balance_spreads_single_partition_topics(cluster):
     finally:
         b.stop()
         a.stop()
+
+
+def test_s3_bucket_access_and_lock(cluster, tmp_path):
+    master, servers, filer, env, _ = cluster
+    cfg = str(tmp_path / "s3acc.json")
+    filer.filer.write_file("/buckets/accb/seed.txt", b"x")
+    # auto-creates the user with scoped grants
+    out = run_command(env, "s3.bucket.access -name=accb -user=fred "
+                           f"-access=Read,List -config={cfg}")
+    assert "Read:accb" in out and "List:accb" in out
+    out = run_command(env, "s3.bucket.access -name=accb -user=fred")
+    assert "Read:accb" in out
+    with pytest.raises(RuntimeError):
+        run_command(env, "s3.bucket.access -name=accb -user=fred "
+                         "-access=Bogus")
+    # none strips every grant scoped to the bucket, keeps others
+    run_command(env, "s3.policy.attach -user=fred -actions=Read:other")
+    run_command(env, "s3.bucket.access -name=accb -user=fred "
+                     "-access=none")
+    show = run_command(env, "s3.user.show -user=fred")
+    assert "accb" not in show and "Read:other" in show
+    # object lock: view -> enable (forces versioning) -> irreversible
+    assert "Disabled" in run_command(env, "s3.bucket.lock -name=accb")
+    out = run_command(env, "s3.bucket.lock -name=accb -enable")
+    assert "Enabled" in out
+    e = filer.filer.find_entry("/buckets/accb")
+    assert e.extended.get("objectLock") == "Enabled"
+    assert e.extended.get("versioning") == "Enabled"
+    assert "already" in run_command(env,
+                                    "s3.bucket.lock -name=accb -enable")
