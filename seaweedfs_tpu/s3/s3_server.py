@@ -114,6 +114,7 @@ class S3ApiServer:
         self._stripes = [threading.Lock() for _ in range(64)]
         self._cors_cache: dict[str, tuple[str, list]] = {}
         self._policy_cache: dict[str, tuple[str, list]] = {}
+        self._tbkt_cache: dict[str, tuple[float, bool]] = {}
         # admission control + per-bucket observability
         # (s3api_circuit_breaker.go; stats/metrics.go S3 families)
         from ..stats import Metrics
@@ -349,13 +350,116 @@ class S3ApiServer:
             except ChunkedDecodeError as e:
                 return _error(403, "SignatureDoesNotMatch", str(e))
         if not bucket:
+            target = req.headers.get("X-Amz-Target", "")
+            if req.method == "POST" and \
+                    target.startswith("S3Tables."):
+                return self._s3tables_op(req, target.split(".", 1)[1])
             if req.method == "GET":
                 return self._list_buckets(
                     getattr(req, "s3_identity_obj", None))
             return _error(405, "MethodNotAllowed", req.method)
         if not key:
             return self._bucket_op(req, bucket)
+        if key and req.method in ("PUT", "DELETE", "POST"):
+            err = self._table_bucket_write_guard(req, bucket, key)
+            if err is not None:
+                return err
         return self._object_op(req, bucket, key)
+
+    def _s3tables_op(self, req: Request, operation: str):
+        """S3 Tables plane (s3tables.py; reference
+        weed/s3api/s3tables/handler.go): POST / with
+        X-Amz-Target: S3Tables.<Op> and a JSON body.  Mutating ops
+        need the coarse Admin action on the target bucket; reads need
+        Read (or Admin)."""
+        from .s3tables import (S3TablesError, S3TablesStore,
+                               handle_request, parse_bucket_arn,
+                               parse_table_arn)
+        try:
+            body = json.loads(req.body or b"{}")
+        except ValueError as e:
+            return 400, (json.dumps(
+                {"__type": "InvalidRequest",
+                 "message": f"bad JSON body: {e}"}).encode(),
+                "application/x-amz-json-1.1")
+        ident = getattr(req, "s3_identity_obj", None)
+        if self.verifier is not None:
+            # resolve the target bucket for scoped grants
+            tbkt = ""
+            try:
+                if body.get("tableBucketARN"):
+                    tbkt = parse_bucket_arn(body["tableBucketARN"])
+                elif body.get("tableARN"):
+                    tbkt = parse_table_arn(body["tableARN"])[0]
+                elif body.get("resourceArn"):
+                    tbkt = parse_bucket_arn(
+                        body["resourceArn"].split("/table/")[0])
+                elif body.get("name") and \
+                        operation == "CreateTableBucket":
+                    tbkt = body["name"]
+            except S3TablesError:
+                tbkt = ""
+            read_only = operation.startswith(("Get", "List"))
+            needed = "Read" if read_only else "Admin"
+            # legacy flat-credentials mode (no IdentityStore): every
+            # valid signature acts as admin, per the class contract
+            legacy_admin = self.iam is None and \
+                bool(getattr(req, "s3_identity", None))
+            if not legacy_admin and (ident is None or not (
+                    ident.can_do(needed, tbkt) or ident.is_admin)):
+                return 403, (json.dumps(
+                    {"__type": "AccessDeniedException",
+                     "message": f"not authorized to {operation}"}
+                ).encode(), "application/x-amz-json-1.1")
+        store = S3TablesStore(self.filer)
+        try:
+            resp = handle_request(store, operation, body)
+        except S3TablesError as e:
+            return e.status, (json.dumps(
+                {"__type": e.code, "message": e.message}).encode(),
+                "application/x-amz-json-1.1")
+        return 200, (json.dumps(resp).encode(),
+                     "application/x-amz-json-1.1")
+
+    def _is_table_bucket(self, bucket: str) -> bool:
+        """2s-TTL cached table-bucket check: the guard runs on EVERY
+        object write, and ordinary buckets (the hot path) must not
+        pay an extra filer round trip per request.  Table-bucket-ness
+        changes only on bucket create/delete, so a short TTL is
+        safe."""
+        from .s3tables import is_table_bucket
+        now = time.monotonic()
+        hit = self._tbkt_cache.get(bucket)
+        if hit is not None and now - hit[0] < 2.0:
+            return hit[1]
+        val = is_table_bucket(
+            self.filer.find_entry(self._bucket_path(bucket)))
+        self._tbkt_cache[bucket] = (now, val)
+        if len(self._tbkt_cache) > 4096:   # unauthenticated-probe cap
+            self._tbkt_cache.clear()
+        return val
+
+    def _table_bucket_write_guard(self, req: Request, bucket: str,
+                                  key: str):
+        """Direct object writes into a TABLE bucket must target an
+        existing table's subtree and follow the Iceberg file layout
+        (reference: s3tables/iceberg_layout.go applied via
+        bucket_paths.go) — arbitrary objects would corrupt the
+        catalog's invariants.  Returns an error response or None."""
+        from .s3tables import X_METADATA, validate_iceberg_key
+        if not self._is_table_bucket(bucket):
+            return None
+        reason = validate_iceberg_key(key)
+        if reason is None:
+            ns, table = key.split("/")[0], key.split("/")[1]
+            t = self.filer.find_entry(
+                f"{self._bucket_path(bucket)}/{ns}/{table}")
+            if t is None or X_METADATA not in t.extended:
+                reason = f"no table {ns}/{table} in bucket {bucket}"
+        if reason is not None and req.method != "DELETE":
+            return _error(403, "AccessDenied",
+                          f"table bucket {bucket}: {reason}")
+        return None
 
     # -- CORS (s3api/cors/) -----------------------------------------------
 

@@ -81,6 +81,7 @@ class VolumeServer:
         r("GET", "/admin/needle_raw", self._needle_raw)
         r("POST", "/admin/write_needle_raw", self._write_needle_raw)
         r("POST", "/admin/scrub", self._scrub)
+        r("POST", "/admin/volume/merge", self._merge_volume)
         r("POST", "/admin/leave", self._leave)
         r("POST", "/admin/vacuum_toggle", self._vacuum_toggle)
         r("POST", "/admin/ec/scrub", self._ec_scrub)
@@ -600,6 +601,51 @@ class VolumeServer:
         v.vacuum()
         return 200, {"garbageRatio": garbage}
 
+    def _merge_volume(self, req: Request):
+        """volume.merge server side (shell/command_volume_merge.go):
+        pull peer replicas' .dat files and rewrite the local copy as
+        the AppendAtNs-ordered union (Volume.merge_from).  The volume
+        must already be readonly — the shell marks every replica
+        before calling."""
+        b = req.json()
+        vid = int(b["volumeId"])
+        peers = b.get("peers", [])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return 404, {"error": "volume not found"}
+        if not v.read_only:
+            return 409, {"error": f"volume {vid} must be readonly "
+                                  "before merging"}
+        self._rp_drop_volume(vid)   # offsets move under the merge
+        import tempfile
+        tmp_paths = []
+        try:
+            for peer in peers:
+                fd, tmp = tempfile.mkstemp(
+                    suffix=".dat", dir=os.path.dirname(
+                        v.file_name(".dat")))
+                os.close(fd)
+                status, _hdrs = http_download(
+                    f"{peer}/admin/volume_file?volumeId={vid}"
+                    f"&collection={v.collection}&ext=.dat", tmp,
+                    headers=self.security.admin_headers())
+                if status != 200:
+                    return 500, {"error":
+                                 f"pull .dat from {peer}: {status}"}
+                tmp_paths.append(tmp)
+            merged = v.merge_from(tmp_paths)
+        except (OSError, ValueError, PermissionError) as e:
+            return 500, {"error": f"merge: {e}"}
+        finally:
+            for tmp in tmp_paths:
+                try:
+                    os.remove(tmp)
+                except FileNotFoundError:
+                    pass
+        self._heartbeat_once()
+        return 200, {"mergedNeedles": merged,
+                     "datBytes": v.dat_size()}
+
     def _query(self, req: Request):
         """volume_server.proto:132 Query (server/volume_grpc_query.go):
         evaluate a SQL-subset SELECT over one stored needle's JSON/CSV
@@ -713,7 +759,11 @@ class VolumeServer:
         if bool(b.get("deleteRemote", True)):
             storage.delete(remote["key"])
         self._heartbeat_once()
-        return 200, {"fileSize": size}
+        # report which backend held the copy: volume.tier.compact
+        # re-uploads to the SAME backend, and the binding in
+        # volume_info.files was just cleared above
+        return 200, {"fileSize": size,
+                     "backendId": remote.get("backendId", "default")}
 
     def _volume_index(self, req: Request):
         """Live needle inventory of one volume: [key, size] pairs after

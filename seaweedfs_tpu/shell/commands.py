@@ -783,3 +783,62 @@ def cmd_collection_delete(env: CommandEnv, args: list[str]) -> str:
     if ec_deleted:
         out += f", ec volumes {sorted(set(ec_deleted))}"
     return out
+
+
+@command("volume.merge")
+def cmd_volume_merge(env: CommandEnv, args: list[str]) -> str:
+    """shell/command_volume_merge.go (-volumeId=N): merge DIVERGED
+    replicas in append-timestamp order into one copy, then replace
+    every replica with it.
+
+    1) mark all replicas readonly (remembering prior state)
+    2) merge on the first replica, pulling peers' .dat files
+       (AppendAtNs-ordered union, newest write/tombstone wins)
+    3) re-copy the merged volume over the other replicas
+    4) restore writable state"""
+    env.confirm_is_locked()
+    opts = _parse_flags(args)
+    if "volumeId" not in opts:
+        return "usage: volume.merge -volumeId=N"
+    vid = int(opts["volumeId"])
+    urls = _volumes_by_id(env).get(vid)
+    if not urls:
+        raise RuntimeError(f"volume {vid} not found")
+    meta = _volume_meta(env, vid) or {}
+    collection = meta.get("collection", "")
+    was_writable = not meta.get("readOnly", False)
+    primary, others = urls[0], urls[1:]
+    for url in urls:
+        _must(http_json("POST", f"{url}/admin/set_readonly",
+                        {"volumeId": vid, "readOnly": True}),
+              f"set readonly on {url}")
+    try:
+        r = _must(http_json(
+            "POST", f"{primary}/admin/volume/merge",
+            {"volumeId": vid, "collection": collection,
+             "peers": others}), f"merge on {primary}")
+        # replace the other replicas with the merged copy
+        for url in others:
+            _must(http_json("POST", f"{url}/admin/delete_volume",
+                            {"volumeId": vid}),
+                  f"drop stale replica on {url}")
+            _copy_volume_files(env, vid, collection, primary, url)
+            _must(http_json("POST", f"{url}/admin/mount_volume",
+                            {"volumeId": vid,
+                             "collection": collection}),
+                  f"mount merged on {url}")
+            _must(http_json("POST", f"{url}/admin/set_readonly",
+                            {"volumeId": vid, "readOnly": True}),
+                  f"re-freeze merged on {url}")
+    finally:
+        if was_writable:
+            for url in urls:
+                try:
+                    http_json("POST", f"{url}/admin/set_readonly",
+                              {"volumeId": vid, "readOnly": False})
+                except OSError:
+                    pass
+    return (f"volume {vid}: merged {len(urls)} replicas "
+            f"({r['mergedNeedles']} live needles, "
+            f"{r['datBytes']} bytes) on {primary}; "
+            f"replaced {len(others)} peer copies")

@@ -646,3 +646,200 @@ def cmd_fs_meta_notify(env: CommandEnv, args: list[str]) -> str:
               f"notify {e.get('fullPath')}")
         n += 1
     return f"re-emitted {n} entries under {root} into the meta log"
+
+
+# --- chunk relocation (command_fs_merge_volumes.go /
+# command_fs_meta_change_volume_id.go) ------------------------------------
+
+def _chunk_vid(fid: str) -> int:
+    return int(fid.split(",", 1)[0])
+
+
+@command("fs.meta.change.volume.id")
+def cmd_fs_meta_change_volume_id(env: CommandEnv,
+                                 args: list[str]) -> str:
+    """command_fs_meta_change_volume_id.go: rewrite volume ids inside
+    chunk fids in filer METADATA only (after an out-of-band volume
+    move/renumber).
+
+        fs.meta.change.volume.id -dir=/p -fromVolumeId=x
+                                 -toVolumeId=y -apply
+        fs.meta.change.volume.id -dir=/p -mapping=map.txt -apply
+
+    mapping file lines: `1 => 2`.  Without -apply: dry run."""
+    opts = _parse_flags(args)
+    mapping: dict[int, int] = {}
+    if opts.get("mapping"):
+        with open(opts["mapping"]) as f:
+            for line in f:
+                line = line.strip()
+                if not line or "=>" not in line:
+                    continue
+                a, b = line.split("=>", 1)
+                mapping[int(a.strip())] = int(b.strip())
+    elif "fromVolumeId" in opts and "toVolumeId" in opts:
+        mapping[int(opts["fromVolumeId"])] = int(opts["toVolumeId"])
+    if not mapping:
+        return ("usage: fs.meta.change.volume.id -dir=/p "
+                "(-fromVolumeId=x -toVolumeId=y | -mapping=f) "
+                "[-apply]")
+    root = _resolve(env, opts.get("dir", "/"))
+    apply = "apply" in opts
+    filer = env.require_filer()
+    changed = files = 0
+    for e in _walk_entries(env, root):
+        chunks = e.get("chunks") or []
+        touched = False
+        for c in chunks:
+            vid = _chunk_vid(c["fileId"])
+            if vid in mapping:
+                c["fileId"] = \
+                    f"{mapping[vid]}," + c["fileId"].split(",", 1)[1]
+                touched = True
+                changed += 1
+        if touched:
+            files += 1
+            if apply:
+                _must(http_json("POST",
+                                f"{filer}/__meta__/put_entry", e),
+                      f"update {e['fullPath']}")
+    verb = "changed" if apply else "would change"
+    return (f"{verb} {changed} chunk refs in {files} files under "
+            f"{root} ({', '.join(f'{a}=>{b}' for a, b in sorted(mapping.items()))})"
+            + ("" if apply else "; add -apply to write"))
+
+
+@command("fs.merge.volumes")
+def cmd_fs_merge_volumes(env: CommandEnv, args: list[str]) -> str:
+    """command_fs_merge_volumes.go: RELOCATE chunk data out of
+    lighter volumes into a target volume so vacuum can reclaim the
+    emptied ones.
+
+        fs.merge.volumes -fromVolumeId=x -toVolumeId=y [-dir=/]
+                         [-apply]
+
+    For every file chunk on the source volume: read the bytes, write
+    them to the SAME needle key on the target volume, update the
+    entry's chunk fid, then delete the source needle.  Needle keys
+    are cluster-unique (master sequence), so no collision on the
+    target."""
+    from .. import operation
+    opts = _parse_flags(args)
+    if "fromVolumeId" not in opts or "toVolumeId" not in opts:
+        return ("usage: fs.merge.volumes -fromVolumeId=x "
+                "-toVolumeId=y [-dir=/] [-apply]")
+    src_vid = int(opts["fromVolumeId"])
+    dst_vid = int(opts["toVolumeId"])
+    if src_vid == dst_vid:
+        raise RuntimeError("from and to volume are the same")
+    apply = "apply" in opts
+    root = _resolve(env, opts.get("dir", "/"))
+    filer = env.require_filer()
+    dst_locs = env.volume_locations(dst_vid)
+    if not dst_locs:
+        raise RuntimeError(f"target volume {dst_vid} not found")
+    moved = bytes_moved = files = 0
+    for e in _walk_entries(env, root):
+        chunks = e.get("chunks") or []
+        todo = [c for c in chunks
+                if _chunk_vid(c["fileId"]) == src_vid]
+        if not todo:
+            continue
+        files += 1
+        if not apply:
+            moved += len(todo)
+            bytes_moved += sum(c.get("size", 0) for c in todo)
+            continue
+        old_fids = []
+        for c in todo:
+            data = operation.read(env.master, c["fileId"])
+            rest = c["fileId"].split(",", 1)[1]
+            new_fid = f"{dst_vid},{rest}"
+            operation.upload(dst_locs[0]["url"], new_fid, data)
+            old_fids.append(c["fileId"])
+            c["fileId"] = new_fid
+            moved += 1
+            bytes_moved += len(data)
+        _must(http_json("POST", f"{filer}/__meta__/put_entry", e),
+              f"update {e['fullPath']}")
+        # source needles die only AFTER the metadata points at the
+        # new home — a crash in between leaves both copies (safe)
+        for fid in old_fids:
+            try:
+                operation.delete(env.master, fid)
+            except (OSError, RuntimeError):
+                pass    # vacuum will reclaim
+    verb = "moved" if apply else "would move"
+    return (f"{verb} {moved} chunks ({bytes_moved} bytes) in {files} "
+            f"files from volume {src_vid} to {dst_vid}"
+            + ("" if apply else "; add -apply to execute"))
+
+
+@command("volume.tier.compact")
+def cmd_volume_tier_compact(env: CommandEnv, args: list[str]) -> str:
+    """command_volume_tier_compact.go: reclaim remote-tier space —
+    fetch the tiered .dat back, vacuum out deleted needles, upload
+    the compacted copy to the same backend key.
+
+        volume.tier.compact -volumeId=N [-endpoint=.. -bucket=..
+                            -accessKey=.. -secretKey=..]
+        volume.tier.compact [-collection=C] [-garbageThreshold=0.3]
+
+    Backend flags are optional when the server still holds the
+    backend registration from the original volume.tier.move."""
+    env.confirm_is_locked()
+    from .commands import _volumes_by_id
+    opts = _parse_flags(args)
+    threshold = float(opts.get("garbageThreshold", 0.3))
+    move_body = {"backendId": opts.get("backendId", "default")}
+    for k in ("endpoint", "bucket", "accessKey", "secretKey"):
+        if opts.get(k):
+            move_body[k] = opts[k]
+    if "volumeId" in opts:
+        vids = [int(opts["volumeId"])]
+    else:
+        vl = env.volume_list()
+        collection = opts.get("collection", "")
+        vids = []
+        from ..topology import iter_volume_list_volumes
+        for _node, v in iter_volume_list_volumes(vl):
+            if not v.get("remoteTiered"):
+                continue
+            if collection and v.get("collection") != collection:
+                continue
+            size = max(v.get("size", 0), 1)
+            if v.get("deletedByteCount", 0) / size >= threshold:
+                vids.append(v["id"])
+        vids = sorted(set(vids))
+    if not vids:
+        return "no remote volumes above the garbage threshold"
+    out = []
+    for vid in vids:
+        urls = _volumes_by_id(env).get(vid) or \
+            [l["url"] for l in env.volume_locations(vid)]
+        for url in urls:
+            r = http_json("POST", f"{url}/admin/tier_fetch",
+                          {"volumeId": vid, "deleteRemote": False})
+            if r.get("error"):
+                raise RuntimeError(f"tier_fetch on {url}: "
+                                   f"{r['error']}")
+            before = r.get("fileSize", 0)
+            # re-upload to the backend the volume CAME from unless
+            # the operator overrode it — tier_fetch just cleared the
+            # .vif binding, so "default" here would silently re-home
+            # the volume (and orphan the original object)
+            body = dict(move_body, volumeId=vid)
+            if "backendId" not in opts and r.get("backendId"):
+                body["backendId"] = r["backendId"]
+            r2 = http_json("POST", f"{url}/admin/vacuum",
+                           {"volumeId": vid})
+            if r2.get("error"):
+                raise RuntimeError(f"vacuum on {url}: {r2['error']}")
+            r = http_json("POST", f"{url}/admin/tier_move", body)
+            if r.get("error"):
+                raise RuntimeError(f"tier_move on {url}: "
+                                   f"{r['error']}")
+            after = r.get("fileSize", 0)
+            out.append(f"volume {vid} on {url}: {before} -> "
+                       f"{after} bytes remote")
+    return "\n".join(out)

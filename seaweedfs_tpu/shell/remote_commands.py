@@ -19,8 +19,8 @@ import urllib.parse
 from ..remote import (cache_path, load_conf, load_mounts,
                       mount_remote, save_conf, save_mounts,
                       uncache_path)
-from ..server.httpd import http_bytes
-from .commands import CommandEnv, _parse_flags, command
+from ..server.httpd import http_bytes, http_json
+from .commands import CommandEnv, _must, _parse_flags, command
 
 
 def _filer(env: CommandEnv) -> str:
@@ -189,3 +189,113 @@ def remote_mount_buckets(env: CommandEnv, args: list[str]) -> str:
                          bucket, "")
         mounted.append(f"/buckets/{bucket} ({n} entries)")
     return "\n".join(mounted) or "no matching buckets on the remote"
+
+
+@command("remote.copy.local")
+def remote_copy_local(env: CommandEnv, args: list[str]) -> str:
+    """command_remote_copy_local.go: push LOCAL-only files under a
+    remote mount up to the remote storage (recovery path when the
+    filer log was lost or files predate the mount).
+
+        remote.copy.local -dir=/xxx [-include=sub] [-exclude=sub]
+                          [-dryRun] [-forceUpdate]
+
+    A file is copied when the remote object is missing (or on
+    -forceUpdate when its md5 differs); local metadata then carries
+    the remote stat so filer.remote.sync stays idempotent."""
+    import hashlib
+    from ..remote import remote_for_path
+    flags = _parse_flags(args)
+    directory = flags.get("dir", "").rstrip("/")
+    if not directory:
+        return ("usage: remote.copy.local -dir=/mounted "
+                "[-include=s] [-exclude=s] [-dryRun] [-forceUpdate]")
+    include = flags.get("include", "")
+    exclude = flags.get("exclude", "")
+    dry = flags.get("dryRun", "").lower() == "true"
+    force = flags.get("forceUpdate", "").lower() == "true"
+    located = remote_for_path(_filer(env), directory)
+    if located is None:
+        return f"{directory} is not under a remote mount"
+    client, base_key = located
+    filer = _filer(env)
+    copied = skipped = 0
+    lines = []
+    for e in _walk(filer, directory):
+        path = e["fullPath"]
+        if include and include not in path:
+            continue
+        if exclude and exclude in path:
+            continue
+        if not e.get("chunks"):
+            continue            # remote-only stub, nothing local
+        rel = path[len(directory):].lstrip("/")
+        key = (base_key.rstrip("/") + "/" + rel).lstrip("/") \
+            if base_key else rel
+        # stat FIRST: on a mostly-synced mount the common case is
+        # "already there" — downloading every body just to discard it
+        # would cost a full dataset read per run
+        stat = client.stat(key)
+        if stat is not None and not force:
+            skipped += 1
+            continue
+        st, body, _ = http_bytes(
+            "GET", filer + urllib.parse.quote(path))
+        if st != 200:
+            continue
+        etag = hashlib.md5(body).hexdigest()
+        if stat is not None and stat.get("etag") == etag:
+            skipped += 1        # force, but content identical
+            continue
+        if dry:
+            lines.append(f"would copy {path} -> {key} ({len(body)}B)")
+            copied += 1
+            continue
+        client.write(key, body)
+        # record the remote stat on the entry so sync/uncache treat
+        # it as materialized-remote from now on — the SAME marker
+        # shape _remote_marker() builds, because mount_remote's meta
+        # sync compares markers by string equality and a mismatched
+        # shape would make it evict the local copy as "changed"
+        from ..remote.remote_storage import _remote_marker
+        _must(http_json(
+            "POST", f"{filer}/__meta__/patch_extended",
+            {"path": path,
+             "extended": {"remote": _remote_marker(len(body),
+                                                   etag)}}),
+            f"mark {path}")
+        copied += 1
+    verb = "would copy" if dry else "copied"
+    head = f"{verb} {copied} files, {skipped} already on remote"
+    return head + ("\n" + "\n".join(lines[:50]) if lines else "")
+
+
+@command("mount.configure")
+def mount_configure(env: CommandEnv, args: list[str]) -> str:
+    """command_mount_configure.go: adjust a RUNNING mount through its
+    local control API (mount.proto SeaweedMount.Configure; the
+    reference dials a unix socket derived from -dir, ours is the
+    gRPC port the mount printed at startup).
+
+        mount.configure -port=PORT -collectionCapacity=BYTES"""
+    flags = _parse_flags(args)
+    if "port" not in flags:
+        return ("usage: mount.configure -port=GRPC_PORT "
+                "-collectionCapacity=BYTES (0 lifts the quota)")
+    capacity = int(flags.get("collectionCapacity", 0))
+    try:
+        import grpc
+        from ..pb import mount_pb2 as mpb
+        from ..pb.rpc import Stub
+        from ..pb.mount_service import MOUNT_METHODS, MOUNT_SERVICE
+    except ImportError:
+        raise RuntimeError("grpcio not available in this environment")
+    channel = grpc.insecure_channel(f"127.0.0.1:{flags['port']}")
+    try:
+        stub = Stub(channel, MOUNT_SERVICE, MOUNT_METHODS)
+        stub.Configure(mpb.ConfigureRequest(
+            collection_capacity=capacity))
+    finally:
+        channel.close()
+    return (f"mount on :{flags['port']}: collectionCapacity="
+            f"{capacity or 'unlimited'}")

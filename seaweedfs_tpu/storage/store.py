@@ -286,6 +286,10 @@ class Store:
                         v.super_block.replica_placement.byte(),
                     "ttl": v.super_block.ttl.to_u32(),
                     "version": v.version,
+                    # master.proto VolumeInformationMessage
+                    # remote_storage_name (field 21) role: lets
+                    # volume.tier.compact select tiered volumes
+                    "remoteTiered": v.is_remote,
                 })
             for vid, ev in loc.ec_volumes.items():
                 ec_shards.append({

@@ -23,6 +23,34 @@ from .ttl import EMPTY_TTL, TTL
 from .volume_info import VolumeInfo, maybe_load_volume_info, save_volume_info
 
 
+def walk_dat(path: str):
+    """Sequentially yield (needle, actual_offset) for every record in
+    a .dat file — live writes AND tombstones, in append order (the
+    reference's volume scan used by check/fix tooling,
+    storage/volume_checking.go shape).  Records with data are writes;
+    zero-data records are delete tombstones (delete_needle appends
+    exactly that, and write_needle never maps 0-size needles)."""
+    with open(path, "rb") as f:
+        sb = SuperBlock.read_from(f)
+        version = sb.version
+        total = os.fstat(f.fileno()).st_size
+        offset = SUPER_BLOCK_SIZE
+        while offset + types.NEEDLE_HEADER_SIZE <= total:
+            f.seek(offset)
+            header = f.read(types.NEEDLE_HEADER_SIZE)
+            if len(header) < types.NEEDLE_HEADER_SIZE:
+                break
+            n = Needle.parse_header(header)
+            rec_len = get_actual_size(n.size, version)
+            if offset + rec_len > total:
+                break                      # truncated tail
+            f.seek(offset)
+            buf = f.read(rec_len)
+            n = Needle.from_bytes(buf, version, check_crc=False)
+            yield n, offset
+            offset += rec_len
+
+
 class NeedleNotFound(KeyError):
     pass
 
@@ -379,6 +407,71 @@ class Volume:
     def vacuum(self) -> None:
         self.compact()
         self.commit_compact()
+
+    def merge_from(self, peer_dat_paths: "list[str]") -> int:
+        """volume.merge core (shell/command_volume_merge.go): union
+        this volume's records with peer replicas' .dat files in
+        AppendAtNs order, last-write-wins per needle (a newer
+        tombstone deletes).  Rewrites this volume in place via the
+        same shadow + rename dance as compaction.  Returns the merged
+        live-needle count.  The volume must be read-only — merging
+        under writes would lose the race's loser silently."""
+        with self.lock:
+            if not self.read_only:
+                raise PermissionError(
+                    f"volume {self.id} must be readonly to merge")
+            self._dat.flush()
+        records: list = []   # (append_at_ns, seq, needle)
+        seq = 0
+        for path in [self.file_name(".dat")] + list(peer_dat_paths):
+            for n, _off in walk_dat(path):
+                records.append((n.append_at_ns, seq, n))
+                seq += 1
+        records.sort(key=lambda t: (t[0], t[1]))
+        live: dict = {}
+        last_ns: dict = {}
+        for ns_, _s, n in records:
+            if n.append_at_ns and \
+                    last_ns.get(n.id) == n.append_at_ns:
+                continue                    # duplicate record
+            last_ns[n.id] = n.append_at_ns
+            if n.data:
+                live[n.id] = n
+            else:
+                live.pop(n.id, None)        # tombstone
+        cpd, cpx = self.file_name(".cpd"), self.file_name(".cpx")
+        with self.lock:
+            for stale in (cpd, cpx):
+                if os.path.exists(stale):
+                    os.remove(stale)
+            dst_sb = SuperBlock(
+                version=self.super_block.version,
+                replica_placement=self.super_block.replica_placement,
+                ttl=self.super_block.ttl,
+                compaction_revision=(
+                    self.super_block.compaction_revision + 1) & 0xFFFF,
+                extra=self.super_block.extra)
+            dst_nm = NeedleMap(cpx)
+            with open(cpd, "wb") as dst:
+                dst.write(dst_sb.to_bytes())
+                for _id, n in sorted(
+                        live.items(),
+                        key=lambda kv: last_ns.get(kv[0], 0)):
+                    off = dst.tell()
+                    dst.write(n.to_bytes(self.version))
+                    dst_nm.put(n.id, types.to_stored_offset(off),
+                               n.size)
+            dst_nm.close()
+            self._idx_snapshot = None   # no diff replay: readonly
+            self.nm.close()
+            self._dat.close()
+            os.replace(cpd, self.file_name(".dat"))
+            os.replace(cpx, self.file_name(".idx"))
+            self._dat = open(self.file_name(".dat"), "r+b")
+            self.super_block = SuperBlock.read_from(self._dat)
+            self._dat.seek(0, os.SEEK_END)
+            self.nm = NeedleMap(self.file_name(".idx"))
+        return len(live)
 
     # -- scrub (server/volume_grpc_scrub.go analog) -----------------------
 

@@ -173,3 +173,42 @@ def test_meta_sync_preserves_cache_and_local_edits(rig):
     e = local.filer.find_entry("/mnt/ms/a.txt")
     assert not e.chunks, "stale cache kept after remote change"
     assert _get(local, "/mnt/ms/a.txt")[1] == b"remote v2 content!"
+
+
+def test_remote_copy_local_pushes_unsynced_files(rig):
+    """command_remote_copy_local.go: files created locally under a
+    mount WITHOUT the sync loop running get pushed by the one-shot
+    command; files already on the remote are skipped unless
+    -forceUpdate."""
+    local, remote, _ = rig
+    mount_remote(local.url, "/mnt/cp", "cloud1", "clouddata",
+                 "archive")
+    env = CommandEnv("http://127.0.0.1:1", filer=local.url)
+    # two local-only files (no syncer running), one nested
+    local.filer.write_file("/mnt/cp/local1.txt", b"local one")
+    local.filer.write_file("/mnt/cp/sub/local2.txt", b"local two")
+    out = COMMANDS["remote.copy.local"](
+        env, ["-dir=/mnt/cp", "-dryRun=true"])
+    assert "would copy 2 files" in out
+    assert remote.stat("archive/local1.txt") is None
+    out = COMMANDS["remote.copy.local"](env, ["-dir=/mnt/cp"])
+    assert "copied 2 files" in out
+    assert remote.read("archive/local1.txt") == b"local one"
+    assert remote.read("archive/sub/local2.txt") == b"local two"
+    # second run: both now exist remotely -> skipped
+    out = COMMANDS["remote.copy.local"](env, ["-dir=/mnt/cp"])
+    assert "copied 0 files" in out and "2 already" in out
+    # include filter narrows the sweep
+    local.filer.write_file("/mnt/cp/extra.log", b"log")
+    local.filer.write_file("/mnt/cp/extra.txt", b"txt")
+    out = COMMANDS["remote.copy.local"](
+        env, ["-dir=/mnt/cp", "-include=.log"])
+    assert "copied 1 files" in out
+    assert remote.stat("archive/extra.txt") is None
+    # forceUpdate pushes a changed local copy over the remote one
+    local.filer.write_file("/mnt/cp/local1.txt", b"local one v2")
+    out = COMMANDS["remote.copy.local"](env, ["-dir=/mnt/cp"])
+    assert remote.read("archive/local1.txt") == b"local one"
+    out = COMMANDS["remote.copy.local"](
+        env, ["-dir=/mnt/cp", "-forceUpdate=true"])
+    assert remote.read("archive/local1.txt") == b"local one v2"

@@ -1,0 +1,236 @@
+"""Round-5 shell singles: volume.merge, volume.tier.compact,
+fs.merge.volumes, fs.meta.change.volume.id, mount.configure,
+remote.copy.local (reference: weed/shell/command_volume_merge.go,
+command_volume_tier_compact.go, command_fs_merge_volumes.go,
+command_fs_meta_change_volume_id.go, command_mount_configure.go,
+command_remote_copy_local.go)."""
+
+import json
+import time
+import urllib.parse
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.s3 import S3ApiServer
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.httpd import http_bytes, http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell import CommandEnv, run_command
+
+AK, SK = "tierkey", "tiersecret"
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer().start()
+    servers = [VolumeServer([str(tmp_path / f"v{i}")], master.url,
+                            pulse_seconds=0.3).start()
+               for i in range(2)]
+    time.sleep(0.5)
+    filer = FilerServer(master.url).start()
+    env = CommandEnv(master.url, filer=filer.url)
+    run_command(env, "lock")
+    yield master, servers, filer, env
+    filer.stop()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _fid_parts(fid):
+    vid, rest = fid.split(",", 1)
+    return int(vid), rest
+
+
+def test_volume_merge_reunites_diverged_replicas(cluster):
+    """Two replicas of one volume diverge (each holds a needle the
+    other lacks); volume.merge rebuilds the AppendAtNs-ordered union
+    and replaces both replicas with it."""
+    master, servers, filer, env = cluster
+    a = operation.assign(master.url, replication="001")
+    operation.upload(a.url, a.fid, b"shared-needle")
+    vid, _ = _fid_parts(a.fid)
+    time.sleep(0.5)
+    locs = [l["url"] for l in env.volume_locations(vid)]
+    assert len(locs) == 2, "replication=001 should give 2 replicas"
+    # diverge: write one needle to EACH replica only (?type=replicate
+    # suppresses fan-out, the replication-path route)
+    a2 = operation.assign(master.url, replication="001")
+    vid2, rest2 = _fid_parts(a2.fid)
+    assert vid2 == vid
+    from seaweedfs_tpu import security
+    def put_direct(url, fid, data):
+        jwt = security.current().write_jwt(fid)
+        hdrs = {"Authorization": f"Bearer {jwt}"} if jwt else {}
+        st, body, _ = http_bytes(
+            "POST", f"{url}/{fid}?type=replicate", data, hdrs)
+        assert st < 300, (st, body)
+    put_direct(locs[0], a2.fid, b"only-on-replica-0")
+    a3 = operation.assign(master.url, replication="001")
+    vid3, _ = _fid_parts(a3.fid)
+    assert vid3 == vid
+    put_direct(locs[1], a3.fid, b"only-on-replica-1")
+    # sanity: each side is blind to the other's needle
+    st0, _, _ = http_bytes("GET", f"{locs[1]}/{a2.fid}")
+    st1, _, _ = http_bytes("GET", f"{locs[0]}/{a3.fid}")
+    assert st0 == 404 and st1 == 404
+    out = run_command(env, f"volume.merge -volumeId={vid}")
+    assert f"merged 2 replicas" in out
+    # the union is now on BOTH replicas
+    for url in locs:
+        for fid, want in ((a.fid, b"shared-needle"),
+                          (a2.fid, b"only-on-replica-0"),
+                          (a3.fid, b"only-on-replica-1")):
+            st, body, _ = http_bytes("GET", f"{url}/{fid}")
+            assert st == 200 and body == want, (url, fid, st)
+    # and the volume is writable again (readonly restored on every
+    # replica; assign may route to any volume, so check the meta)
+    time.sleep(0.5)     # one heartbeat
+    from seaweedfs_tpu.shell.commands import _volume_meta
+    meta = _volume_meta(env, vid)
+    assert meta is not None and not meta.get("readOnly"), meta
+
+
+def test_volume_merge_propagates_newest_tombstone(cluster):
+    """A delete that reached only one replica wins the merge (newest
+    record is a tombstone -> needle stays dead everywhere)."""
+    master, servers, filer, env = cluster
+    a = operation.assign(master.url, replication="001")
+    operation.upload(a.url, a.fid, b"to-die")
+    vid, _ = _fid_parts(a.fid)
+    time.sleep(0.5)
+    locs = [l["url"] for l in env.volume_locations(vid)]
+    # delete on replica 0 ONLY (replicate-path delete, no fan-out)
+    from seaweedfs_tpu import security
+    jwt = security.current().write_jwt(a.fid)
+    hdrs = {"Authorization": f"Bearer {jwt}"} if jwt else {}
+    st, _, _ = http_bytes(
+        "DELETE", f"{locs[0]}/{a.fid}?type=replicate", None, hdrs)
+    assert st < 300
+    st1, _, _ = http_bytes("GET", f"{locs[1]}/{a.fid}")
+    assert st1 == 200, "replica 1 must still hold the needle"
+    run_command(env, f"volume.merge -volumeId={vid}")
+    for url in locs:
+        st, _, _ = http_bytes("GET", f"{url}/{a.fid}")
+        assert st == 404, f"tombstone lost on {url}"
+
+
+def test_fs_meta_change_volume_id(cluster, tmp_path):
+    master, servers, filer, env = cluster
+    filer.filer.write_file("/cvid/a.txt", b"alpha")
+    e = json.loads(run_command(env, "fs.meta.cat /cvid/a.txt"))
+    real_vid = int(e["chunks"][0]["fileId"].split(",")[0])
+    # dry run changes nothing
+    out = run_command(env, f"fs.meta.change.volume.id -dir=/cvid "
+                           f"-fromVolumeId={real_vid} "
+                           f"-toVolumeId=777")
+    assert "would change 1 chunk" in out
+    e = json.loads(run_command(env, "fs.meta.cat /cvid/a.txt"))
+    assert e["chunks"][0]["fileId"].startswith(f"{real_vid},")
+    # apply via a mapping file, then map back
+    mf = tmp_path / "map.txt"
+    mf.write_text(f"{real_vid} => 777\n")
+    out = run_command(env, f"fs.meta.change.volume.id -dir=/cvid "
+                           f"-mapping={mf} -apply")
+    assert "changed 1 chunk" in out
+    e = json.loads(run_command(env, "fs.meta.cat /cvid/a.txt"))
+    assert e["chunks"][0]["fileId"].startswith("777,")
+    run_command(env, f"fs.meta.change.volume.id -dir=/cvid "
+                     f"-fromVolumeId=777 -toVolumeId={real_vid} "
+                     f"-apply")
+    assert filer.filer.read_file("/cvid/a.txt") == b"alpha"
+
+
+def test_fs_merge_volumes_relocates_chunks(cluster):
+    master, servers, filer, env = cluster
+    filer.filer.write_file("/mv/one.txt", b"movable-content")
+    e = json.loads(run_command(env, "fs.meta.cat /mv/one.txt"))
+    src_vid = int(e["chunks"][0]["fileId"].split(",")[0])
+    # find (or grow) a DIFFERENT writable volume to merge into
+    from seaweedfs_tpu.shell.commands import _volumes_by_id
+    others = [v for v in _volumes_by_id(env) if v != src_vid]
+    if not others:
+        run_command(env, "volume.grow -count=1")
+        time.sleep(0.5)
+        others = [v for v in _volumes_by_id(env) if v != src_vid]
+    assert others, "need a second volume"
+    dst_vid = others[0]
+    out = run_command(env, f"fs.merge.volumes -dir=/mv "
+                           f"-fromVolumeId={src_vid} "
+                           f"-toVolumeId={dst_vid}")
+    assert "would move 1 chunks" in out
+    out = run_command(env, f"fs.merge.volumes -dir=/mv "
+                           f"-fromVolumeId={src_vid} "
+                           f"-toVolumeId={dst_vid} -apply")
+    assert "moved 1 chunks" in out
+    e = json.loads(run_command(env, "fs.meta.cat /mv/one.txt"))
+    assert e["chunks"][0]["fileId"].startswith(f"{dst_vid},")
+    # content readable through the filer after relocation
+    assert filer.filer.read_file("/mv/one.txt") == b"movable-content"
+    # source needle gone
+    old_fid = f"{src_vid}," + e["chunks"][0]["fileId"].split(",", 1)[1]
+    with pytest.raises(Exception):
+        operation.read(master.url, old_fid)
+
+
+def test_volume_tier_compact_reclaims_remote_space(cluster, tmp_path):
+    master, servers, filer, env = cluster
+    gw = S3ApiServer(filer.filer, credentials={AK: SK}).start()
+    try:
+        import numpy as np
+        rng = np.random.default_rng(7)
+        fids = []
+        for _ in range(6):
+            data = rng.integers(0, 256, 20_000,
+                                dtype=np.uint8).tobytes()
+            fids.append((operation.submit(master.url, data), data))
+        vid = int(fids[0][0].split(",")[0])
+        # delete half -> garbage in the .dat
+        for fid, _ in fids[:3]:
+            operation.delete(master.url, fid)
+        time.sleep(0.4)
+        run_command(env, f"volume.tier.move -volumeId={vid} "
+                         f"-endpoint={gw.url} -bucket=tier "
+                         f"-accessKey={AK} -secretKey={SK}")
+        sizes_before = {
+            e.name: e.total_size() for e in
+            filer.filer.list_directory("/buckets/tier")}
+        out = run_command(env, f"volume.tier.compact -volumeId={vid}")
+        assert "-> " in out
+        sizes_after = {
+            e.name: e.total_size() for e in
+            filer.filer.list_directory("/buckets/tier")}
+        assert sizes_after and all(
+            sizes_after[k] < sizes_before[k] for k in sizes_after), \
+            (sizes_before, sizes_after)
+        # surviving needles still readable through the tiered volume
+        for fid, want in fids[3:]:
+            assert operation.read(master.url, fid) == want
+        # collection-wide selection finds nothing left to compact
+        out = run_command(env,
+                          "volume.tier.compact -garbageThreshold=0.3")
+        assert "no remote volumes" in out
+    finally:
+        gw.stop()
+
+
+def test_mount_configure_adjusts_live_quota(cluster):
+    master, servers, filer, env = cluster
+    pytest.importorskip("grpc")
+    from seaweedfs_tpu.mount.weedfs import WeedFS
+    from seaweedfs_tpu.pb.mount_service import start_mount_grpc
+    ws = WeedFS("127.0.0.1:1", follow_events=False)
+    server, port = start_mount_grpc(ws)
+    try:
+        out = run_command(env, f"mount.configure -port={port} "
+                               f"-collectionCapacity=5555")
+        assert "5555" in out
+        assert ws.collection_capacity == 5555
+        out = run_command(env, f"mount.configure -port={port}")
+        assert "unlimited" in out
+        assert ws.collection_capacity == 0
+    finally:
+        server.stop(grace=0)
+        ws.close()
