@@ -20,6 +20,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-securityToml", default="",
                    help="path to security.toml (jwt signing keys, "
                         "admin key, ip whitelist)")
+    # glog-analog logging flags (util/wlog; weed/glog -v/-logdir)
+    p.add_argument("-v", type=int, default=None, metavar="LEVEL",
+                   help="verbose log level (wlog.V gates; also "
+                        "WEED_V)")
+    p.add_argument("-logdir", default="",
+                   help="also write logs to <logdir>/weed.log with "
+                        "size rotation (glog_file.go role)")
+    p.add_argument("-logJson", dest="log_json", action="store_true",
+                   help="one JSON object per log line "
+                        "(glog_json.go role)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     m = sub.add_parser("master", help="start a master server")
@@ -61,6 +71,10 @@ def main(argv: list[str] | None = None) -> int:
                    default="", help="Prometheus pushgateway host:port")
     v.add_argument("-metricsIntervalSec", dest="metrics_interval",
                    type=int, default=15)
+    v.add_argument("-memoryMapMaxSizeMb", dest="mmap_mb", type=int,
+                   default=0,
+                   help="mmap the .dat read path for volumes up to "
+                        "this size (backend/memory_map role; 0 off)")
 
     s = sub.add_parser(
         "server", help="all-in-one: master + volume (+ filer + s3), the "
@@ -91,10 +105,14 @@ def main(argv: list[str] | None = None) -> int:
                     help="store path (sqlite file / lsm dir), or "
                          ":memory:")
     fl.add_argument("-storeType", dest="store_type",
-                    default="sqlite", choices=["sqlite", "lsm"],
+                    default="sqlite",
+                    choices=["sqlite", "lsm", "redis"],
                     help="metadata store archetype (filerstore.go: "
                          "sqlite=SQL, lsm=embedded ordered-KV — the "
-                         "reference's leveldb default)")
+                         "reference's leveldb default — redis=RESP "
+                         "server at -store host:port); a filer.toml "
+                         "on the config search path overrides these "
+                         "defaults (util/config)")
     fl.add_argument("-collection", default="")
     fl.add_argument("-replication", default="")
     fl.add_argument("-notification", default="",
@@ -381,7 +399,25 @@ def main(argv: list[str] | None = None) -> int:
     down.add_argument("-master", default="127.0.0.1:9333")
     down.add_argument("fid")
 
+    # WEED_<ROLE>_<FLAG> env-var override layer (util/config,
+    # reference viper SetEnvPrefix("weed")): rewrites parser DEFAULTS,
+    # so explicit command-line flags still win
+    from .util.config import apply_env_defaults
+    env_applied = apply_env_defaults(sub.choices)
+
     args = p.parse_args(argv)
+
+    from .util import wlog
+    if args.v is not None:
+        wlog.set_verbosity(args.v)
+    if args.log_json:
+        wlog.json_format(True)
+    if args.logdir:
+        import os as _os
+        _os.makedirs(args.logdir, exist_ok=True)
+        wlog.set_output(_os.path.join(args.logdir, "weed.log"))
+    for line in env_applied:
+        wlog.info("env override: %s", line, component="config")
 
     if args.securityToml:
         from . import security
@@ -418,6 +454,9 @@ def main(argv: list[str] | None = None) -> int:
                                  parts[1] if len(parts) > 1 else "tier",
                                  parts[2] if len(parts) > 2 else "",
                                  parts[3] if len(parts) > 3 else "")
+        if args.mmap_mb:
+            from .storage import store as _store_mod
+            _store_mod.MMAP_READ_MB = args.mmap_mb
         vs = VolumeServer(args.dir.split(","), args.mserver,
                           host=args.ip, port=args.port,
                           max_volume_count=args.max,
@@ -463,12 +502,32 @@ def main(argv: list[str] | None = None) -> int:
         _wait()
     elif args.cmd == "filer":
         from .server.filer_server import FilerServer
+        from .util.config import (filer_store_from_toml, find_toml,
+                                  notification_from_toml)
+        store_type, store_path = args.store_type, args.store
+        # scaffold TOMLs override FLAG DEFAULTS only: an explicit
+        # -store/-storeType on the command line wins (viper layering)
+        toml_path = find_toml("filer.toml")
+        if toml_path and store_type == "sqlite" and \
+                store_path == "filer.db":
+            picked = filer_store_from_toml(toml_path)
+            if picked:
+                store_type, store_path = picked
+                wlog.info("filer store from %s: %s %s", toml_path,
+                          store_type, store_path, component="config")
+        notification = args.notification
+        ntoml = find_toml("notification.toml")
+        if ntoml and not notification:
+            notification = notification_from_toml(ntoml)
+            if notification:
+                wlog.info("notification from %s: %s", ntoml,
+                          notification, component="config")
         fs = FilerServer(args.master, args.ip, args.port,
-                         store_path=args.store,
+                         store_path=store_path,
                          collection=args.collection,
                          replication=args.replication,
-                         store_type=args.store_type,
-                         notification=args.notification,
+                         store_type=store_type,
+                         notification=notification,
                          lock_peers=[p.strip() for p in
                                      args.lock_peers.split(",")
                                      if p.strip()])

@@ -149,17 +149,24 @@ class Filer:
     def write_file(self, path: str, data: bytes, mime: str = "",
                    mode: int = 0o660) -> Entry:
         """Auto-chunking upload
-        (server/filer_server_handlers_write_autochunk.go:25)."""
-        chunks = []
-        for off in range(0, len(data), CHUNK_SIZE):
+        (server/filer_server_handlers_write_autochunk.go:25).
+        Chunks upload through a bounded parallel pool
+        (util/limiter, limited_executor.go role): a multi-chunk
+        write overlaps its volume-server round trips instead of
+        serializing them, with backpressure at the bound."""
+        from ..util.limiter import bounded_parallel
+
+        def upload_piece(off: int) -> FileChunk:
             piece = data[off:off + CHUNK_SIZE]
             a = operation.assign(self.master,
                                  collection=self.collection,
                                  replication=self.replication)
             r = operation.upload(a.url, a.fid, piece, auth=a.auth)
-            chunks.append(FileChunk(a.fid, off, len(piece),
-                                    r.get("eTag", ""),
-                                    time.time_ns()))
+            return FileChunk(a.fid, off, len(piece),
+                             r.get("eTag", ""), time.time_ns())
+
+        chunks = bounded_parallel(
+            upload_piece, range(0, len(data), CHUNK_SIZE), limit=4)
         entry = Entry(normalize_path(path), is_directory=False,
                       attributes=Attributes(mime=mime, mode=mode),
                       chunks=chunks)

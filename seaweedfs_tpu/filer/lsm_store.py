@@ -22,6 +22,8 @@ directory listings without touching unrelated subtrees.
 from __future__ import annotations
 
 import bisect
+
+from ..util.skiplist import SkipList
 import heapq
 import json
 import os
@@ -33,6 +35,7 @@ from .filer_store import FilerStore
 MEMTABLE_LIMIT = 1000
 COMPACT_AT = 4
 TOMBSTONE = None          # JSON null marks a delete
+_MEM_MISS = object()      # distinguishes "absent" from a tombstone
 
 
 class LsmTree:
@@ -44,7 +47,10 @@ class LsmTree:
         # one lock for memtable/WAL/segment state: the store serves
         # concurrent HTTP threads (MemoryStore/SqliteStore lock too)
         self._lock = threading.RLock()
-        self._mem: dict[str, "dict | None"] = {}
+        # ordered memtable (util/skiplist, the reference's
+        # weed/util/skiplist role): inserts keep order, so flushes
+        # and range scans read it in-order with NO per-call sort
+        self._mem = SkipList()
         self._segments: list[tuple[list[str], list]] = []  # old->new
         self._seg_paths: list[str] = []
         self._next_seq = 0
@@ -80,7 +86,7 @@ class LsmTree:
                         k, v = json.loads(line)
                     except ValueError:
                         continue    # torn tail: drop
-                    self._mem[k] = v
+                    self._mem.insert(k, v)
 
     # -- mutations ---------------------------------------------------------
 
@@ -89,7 +95,7 @@ class LsmTree:
             self._wal.write(json.dumps([key, value],
                                        separators=(",", ":")) + "\n")
             self._wal.flush()
-            self._mem[key] = value
+            self._mem.insert(key, value)
             if len(self._mem) >= MEMTABLE_LIMIT:
                 self.flush_memtable()
 
@@ -104,17 +110,18 @@ class LsmTree:
         self._next_seq += 1
         path = os.path.join(self.dir, f"{seq:08d}.seg")
         tmp = path + ".tmp"
-        keys = sorted(self._mem)
+        pairs = list(self._mem.items())     # already in key order
+        keys = [k for k, _ in pairs]
         with open(tmp, "w") as f:
-            for k in keys:
-                f.write(json.dumps([k, self._mem[k]],
+            for k, v in pairs:
+                f.write(json.dumps([k, v],
                                    separators=(",", ":")) + "\n")
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
-        self._segments.append((keys, [self._mem[k] for k in keys]))
+        self._segments.append((keys, [v for _, v in pairs]))
         self._seg_paths.append(path)
-        self._mem = {}
+        self._mem = SkipList()
         # the flushed state is durable in the segment: reset the WAL
         self._wal.close()
         os.remove(self._wal_path)
@@ -157,8 +164,9 @@ class LsmTree:
 
     def get(self, key: str) -> "dict | None":
         with self._lock:
-            if key in self._mem:
-                return self._mem[key]
+            hit = self._mem.get(key, _MEM_MISS)
+            if hit is not _MEM_MISS:
+                return hit
             for keys, vals in reversed(self._segments):
                 i = bisect.bisect_left(keys, key)
                 if i < len(keys) and keys[i] == key:
@@ -173,8 +181,7 @@ class LsmTree:
         MEMTABLE_LIMIT, so its per-call sort is cheap; segments are
         immutable, so index cursors are safe outside the lock)."""
         with self._lock:
-            mem = sorted((k, v) for k, v in self._mem.items()
-                         if lo <= k < hi)
+            mem = list(self._mem.items(lo, hi))  # in-order, no sort
             segs = list(self._segments)
         # priority 0 = newest (memtable), then segments newest-first
         layers: list[tuple[list, list]] = [

@@ -44,6 +44,7 @@ import threading
 import time
 import urllib.parse
 
+from ..util import wlog
 from ..server.httpd import HttpServer, Request, http_bytes
 from .logstore import PartitionLog
 from .topic import Partition, Topic, partition_for_key, split_ring
@@ -228,9 +229,8 @@ class BrokerServer:
         except ImportError:     # grpcio absent: HTTP-only mode
             pass
         except Exception as e:  # pragma: no cover — a real defect
-            import sys
-            print(f"broker {self.url}: gRPC plane failed to start: "
-                  f"{e!r}", file=sys.stderr)
+            wlog.error(f"broker {self.url}: gRPC plane failed to start: "
+                  f"{e!r}")
         self._heartbeat()
         self._flush_thread = threading.Thread(target=self._flush_loop,
                                               daemon=True)

@@ -418,6 +418,19 @@ class S3ApiServer:
             return e.status, (json.dumps(
                 {"__type": e.code, "message": e.message}).encode(),
                 "application/x-amz-json-1.1")
+        if operation in ("CreateTableBucket", "DeleteTableBucket"):
+            # a stale negative table-bucket cache entry would let
+            # arbitrary objects into a just-created table bucket for
+            # the TTL window — drop it on the spot
+            if body.get("name"):
+                self._tbkt_cache.pop(body["name"], None)
+            if body.get("tableBucketARN"):
+                try:
+                    self._tbkt_cache.pop(
+                        parse_bucket_arn(body["tableBucketARN"]),
+                        None)
+                except S3TablesError:
+                    pass
         return 200, (json.dumps(resp).encode(),
                      "application/x-amz-json-1.1")
 

@@ -207,6 +207,20 @@ class S3TablesStore:
                 entry.extended[k] = v
         self.filer.create_entry(entry, create_parents=False)
 
+    def _list_all(self, directory: str, start_file: str = "",
+                  prefix: str = ""):
+        """PAGINATED directory walk: a flat list_directory(limit=N)
+        call silently truncates past N children and the result looks
+        complete — every S3Tables listing iterates through this."""
+        last = start_file
+        while True:
+            batch = self.filer.list_directory(
+                directory, start_file=last, limit=500, prefix=prefix)
+            yield from batch
+            if len(batch) < 500:
+                return
+            last = batch[-1].name
+
     def _bucket_entry(self, name: str):
         e = self._get(f"{BUCKETS_ROOT}/{name}")
         if e is None or not is_table_bucket(e):
@@ -268,12 +282,9 @@ class S3TablesStore:
     def list_table_buckets(self, prefix: str = "",
                            continuation: str = "",
                            max_buckets: int = 0) -> dict:
-        entries = self.filer.list_directory(
-            BUCKETS_ROOT, start_file=continuation, limit=1000,
-            prefix=prefix)
         out, token = [], ""
         limit = max_buckets or 100
-        for e in entries:
+        for e in self._list_all(BUCKETS_ROOT, continuation, prefix):
             if not is_table_bucket(e):
                 continue
             if len(out) >= limit:
@@ -342,12 +353,10 @@ class S3TablesStore:
                         max_namespaces: int = 0) -> dict:
         bucket = parse_bucket_arn(bucket_arn_)
         self._bucket_entry(bucket)
-        entries = self.filer.list_directory(
-            f"{BUCKETS_ROOT}/{bucket}", start_file=continuation,
-            limit=1000, prefix=prefix)
         out, token = [], ""
         limit = max_namespaces or 100
-        for e in entries:
+        for e in self._list_all(f"{BUCKETS_ROOT}/{bucket}",
+                                continuation, prefix):
             if X_NAMESPACE not in e.extended:
                 continue
             if len(out) >= limit:
@@ -446,9 +455,15 @@ class S3TablesStore:
                     max_tables: int = 0) -> dict:
         bucket = parse_bucket_arn(bucket_arn_)
         self._bucket_entry(bucket)
-        spaces = [namespace[0]] if namespace else \
-            [e["namespace"][0] for e in
-             self.list_namespaces(bucket_arn_)["namespaces"]]
+        if namespace:
+            spaces = [namespace[0]]
+        else:
+            # enumerate EVERY namespace dir (paginated — the capped
+            # list_namespaces API call would silently drop tables of
+            # namespaces past its page size)
+            spaces = [e.name for e in
+                      self._list_all(f"{BUCKETS_ROOT}/{bucket}")
+                      if X_NAMESPACE in e.extended]
         # the continuation token is namespace-QUALIFIED ("ns/table"):
         # a bare table name applied as start_file to every namespace
         # would silently skip any later namespace's tables that sort
@@ -463,9 +478,8 @@ class S3TablesStore:
                 else ""
             if token:
                 break           # page full: no more listing calls
-            for e in self.filer.list_directory(
-                    f"{BUCKETS_ROOT}/{bucket}/{ns}",
-                    start_file=start, limit=1000, prefix=prefix):
+            for e in self._list_all(f"{BUCKETS_ROOT}/{bucket}/{ns}",
+                                    start, prefix):
                 if X_METADATA not in e.extended:
                     continue
                 if len(out) >= limit:

@@ -205,6 +205,14 @@ class HttpServer:
 
             def _dispatch(self):
                 req = Request(self)
+                # request-id propagation (util/request_id): adopt the
+                # caller's X-Request-ID or mint one at this edge; the
+                # contextvar follows this handler thread so outbound
+                # hops and log lines inherit it
+                from ..util.request_id import HEADER as _RID_HEADER
+                from ..util.request_id import ensure_request_id
+                rid = ensure_request_id(
+                    req.headers.get(_RID_HEADER, ""))
                 route = outer.routes.get((req.method, req.path))
                 try:
                     denied = outer.guard(req) if outer.guard else None
@@ -248,6 +256,7 @@ class HttpServer:
                     ctype = "application/octet-stream"
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
+                self.send_header(_RID_HEADER, rid)
                 for hk, hv in extra_headers.items():
                     self.send_header(hk, hv)
                 if hasattr(body, "read"):
@@ -657,6 +666,15 @@ def _one_pooled_request(method: str, full_url: str, body,
 
 def _pooled_request(method: str, url: str, body, headers: dict,
                     timeout: float, max_redirects: int = 3):
+    # forward the active request id on every internal hop
+    # (util/request_id): the receiving server adopts it, so one id
+    # traces gateway -> filer -> volume in the logs
+    from ..util.request_id import HEADER as _RID_HEADER
+    from ..util.request_id import get_request_id
+    rid = get_request_id()
+    if rid and _RID_HEADER not in headers:
+        headers = dict(headers)
+        headers[_RID_HEADER] = rid
     full_url, ctx = _dial(url)
     for _hop in range(max_redirects):
         status, data, rheaders, location = _one_pooled_request(

@@ -21,6 +21,7 @@ import threading
 import time
 import uuid
 
+from ..util import wlog
 from .. import security
 from ..sequence import MemorySequencer, SnowflakeSequencer
 from ..storage.types import FileId, format_needle_id_cookie
@@ -122,9 +123,8 @@ class MasterServer:
         except ImportError:  # grpcio absent: HTTP-only mode
             pass
         except Exception as e:  # pragma: no cover — a real defect
-            import sys
-            print(f"master {self.url}: gRPC plane failed to start: "
-                  f"{e!r}", file=sys.stderr)
+            wlog.error(f"master {self.url}: gRPC plane failed to start: "
+                  f"{e!r}")
         return self
 
     def stop(self):

@@ -19,6 +19,7 @@ import threading
 import time
 import urllib.parse
 
+from ..util import wlog
 from .. import security
 from ..storage import types
 from ..storage.erasure_coding import ECContext
@@ -148,10 +149,9 @@ class VolumeServer:
         except ImportError:  # grpcio absent: HTTP-only mode
             self.grpc_server, self.grpc_port = None, 0
         except Exception as e:  # pragma: no cover — a real defect
-            import sys
             self.grpc_server, self.grpc_port = None, 0
-            print(f"volume server {self.url}: gRPC plane failed to "
-                  f"start: {e!r}", file=sys.stderr)
+            wlog.error(f"volume server {self.url}: gRPC plane failed to "
+                  f"start: {e!r}")
         self._heartbeat_once()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True)
@@ -266,9 +266,8 @@ class VolumeServer:
             # distinct error, instead of looping silently unregistered
             if err != self._last_hb_error:
                 self._last_hb_error = err
-                import sys
-                print(f"volume server {self.url}: heartbeat rejected "
-                      f"by master: {err}", file=sys.stderr)
+                wlog.warning(f"volume server {self.url}: heartbeat rejected "
+                      f"by master: {err}")
             return
         self._last_hb_error = None
         tid = r.get("topologyId", "")
@@ -625,6 +624,9 @@ class VolumeServer:
                     suffix=".dat", dir=os.path.dirname(
                         v.file_name(".dat")))
                 os.close(fd)
+                # track BEFORE the pull: a failed download must not
+                # leak a .dat-sized temp file past the finally
+                tmp_paths.append(tmp)
                 status, _hdrs = http_download(
                     f"{peer}/admin/volume_file?volumeId={vid}"
                     f"&collection={v.collection}&ext=.dat", tmp,
@@ -632,7 +634,6 @@ class VolumeServer:
                 if status != 200:
                     return 500, {"error":
                                  f"pull .dat from {peer}: {status}"}
-                tmp_paths.append(tmp)
             merged = v.merge_from(tmp_paths)
         except (OSError, ValueError, PermissionError) as e:
             return 500, {"error": f"merge: {e}"}

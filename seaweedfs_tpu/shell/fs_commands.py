@@ -823,6 +823,13 @@ def cmd_volume_tier_compact(env: CommandEnv, args: list[str]) -> str:
             if r.get("error"):
                 raise RuntimeError(f"tier_fetch on {url}: "
                                    f"{r['error']}")
+            if r.get("alreadyLocal"):
+                # NOT a tiered volume: a "reclaim remote space"
+                # command must never convert a local volume to
+                # remote-tiered as a side effect
+                raise RuntimeError(
+                    f"volume {vid} on {url} is not remote-tiered; "
+                    "use volume.vacuum for local volumes")
             before = r.get("fileSize", 0)
             # re-upload to the backend the volume CAME from unless
             # the operator overrode it — tier_fetch just cleared the

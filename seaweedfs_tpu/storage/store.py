@@ -37,6 +37,12 @@ def _vif_is_remote(vif_path: str) -> bool:
     return bool(vi and vi.files)
 
 
+# process-wide mmap read cap in MB (backend/memory_map role, the
+# volume server's -memoryMapMaxSizeMb flag); 0 disables.  Set by the
+# CLI before Store construction.
+MMAP_READ_MB = 0
+
+
 class DiskLocation:
     """One storage directory (weed/storage/disk_location.go)."""
 
@@ -56,7 +62,8 @@ class DiskLocation:
                 continue
             vid = int(m.group("vid"))
             self.volumes[vid] = Volume(
-                self.directory, vid, collection=m.group("col") or "")
+                self.directory, vid, collection=m.group("col") or "",
+                mmap_read_mb=MMAP_READ_MB)
         # tiered volumes have no local .dat; their .vif names the
         # remote copy (volume_tier.go)
         for path in glob.glob(os.path.join(self.directory, "*.vif")):
@@ -69,7 +76,7 @@ class DiskLocation:
             try:
                 self.volumes[vid] = Volume(
                     self.directory, vid,
-                    collection=m.group("col") or "")
+                    collection=m.group("col") or "")   # remote: no mmap
             except KeyError as e:
                 # backend not configured on this server: the tiered
                 # volume is unavailable, but one bad .vif must not
@@ -136,7 +143,8 @@ class Store:
             v = Volume(
                 loc.directory, vid, collection=collection,
                 replica_placement=ReplicaPlacement.from_string(replication),
-                ttl=read_ttl(ttl) if ttl else EMPTY_TTL)
+                ttl=read_ttl(ttl) if ttl else EMPTY_TTL,
+                mmap_read_mb=MMAP_READ_MB)
             loc.volumes[vid] = v
             return v
 
@@ -168,7 +176,9 @@ class Store:
                 # the remote copy (storage/volume_tier.go)
                 if os.path.exists(base + ".dat") or \
                         _vif_is_remote(base + ".vif"):
-                    v = Volume(loc.directory, vid, collection=collection)
+                    v = Volume(loc.directory, vid,
+                               collection=collection,
+                               mmap_read_mb=MMAP_READ_MB)
                     loc.volumes[vid] = v
                     return v
             raise KeyError(f"volume {vid} files not found")

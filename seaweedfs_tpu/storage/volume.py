@@ -69,7 +69,8 @@ class Volume:
     def __init__(self, directory: str, volume_id: int, collection: str = "",
                  replica_placement: ReplicaPlacement | None = None,
                  ttl: TTL = EMPTY_TTL,
-                 version: int = types.CURRENT_VERSION):
+                 version: int = types.CURRENT_VERSION,
+                 mmap_read_mb: int = 0):
         self.dir = directory
         self.id = volume_id
         self.collection = collection
@@ -77,6 +78,14 @@ class Volume:
         self.last_append_at_ns = 0
         self.read_only = False
         self.is_remote = False
+        # memory-mapped read path (backend/memory_map role, the
+        # `-memoryMapMaxSizeMb` flag): needle reads slice the page
+        # cache directly instead of seek+read syscalls.  0 disables;
+        # volumes larger than the cap fall back to handle reads.
+        self.mmap_limit = int(mmap_read_mb) * (1 << 20)
+        self._mm = None
+        self._mm_f = None
+        self._mm_skip = False
         base = self.file_name("")
         dat_path = base + ".dat"
         vi = maybe_load_volume_info(base + ".vif")
@@ -264,10 +273,63 @@ class Volume:
                  check_crc: bool = True) -> Needle:
         offset = types.to_actual_offset(stored_offset)
         length = get_actual_size(size, self.version)
-        self._dat.seek(offset)
-        buf = self._dat.read(length)
+        buf = self._mmap_read(offset, length) \
+            if self.mmap_limit else None
+        if buf is None:
+            self._dat.seek(offset)
+            buf = self._dat.read(length)
         return Needle.from_bytes(buf, self.version, expected_size=size,
                                  check_crc=check_crc)
+
+    # -- mmap read path (backend/memory_map analog) ----------------------
+
+    def _mmap_read(self, offset: int, length: int) -> "bytes | None":
+        """Serve a read from the mapped .dat, remapping when the file
+        has grown past the map; None falls back to the handle read
+        (map failed, volume over the cap, or a remote .dat)."""
+        if self.is_remote or self._mm_skip:
+            return None
+        import mmap as _mmap
+        if self._mm is None or offset + length > len(self._mm):
+            self._drop_mmap()
+            try:
+                self._dat.flush()      # appended tail must be mapped
+                f = open(self.file_name(".dat"), "rb")
+                size = os.fstat(f.fileno()).st_size
+                if size > self.mmap_limit or size == 0:
+                    f.close()
+                    # the file only grows between .dat swaps: once
+                    # over the cap, stop paying open+fstat per read
+                    # (_drop_mmap at swap points clears the skip)
+                    self._mm_skip = size > self.mmap_limit
+                    return None
+                self._mm_f = f
+                self._mm = _mmap.mmap(f.fileno(), 0,
+                                      access=_mmap.ACCESS_READ)
+            except (OSError, ValueError, AttributeError):
+                self._drop_mmap()
+                self._mm_skip = True
+                return None
+        if offset + length > len(self._mm):
+            return None                # still beyond: buffered tail
+        return self._mm[offset:offset + length]
+
+    def _drop_mmap(self) -> None:
+        """The map pins the OLD inode across compaction/merge renames
+        — callers that swap the .dat must drop it first."""
+        self._mm_skip = False      # re-probe against the new file
+        if self._mm is not None:
+            try:
+                self._mm.close()
+            except OSError:
+                pass
+            self._mm = None
+        if self._mm_f is not None:
+            try:
+                self._mm_f.close()
+            except OSError:
+                pass
+            self._mm_f = None
 
     def read_needle(self, needle_id: int, cookie: int | None = None
                     ) -> Needle:
@@ -381,6 +443,7 @@ class Volume:
         """makeupDiff replay + rename shadows over the live files and
         reload (volume_vacuum.go:141 CommitCompact)."""
         with self.lock:
+            self._drop_mmap()      # the map pins the pre-swap inode
             self._makeup_diff()
             self.nm.close()
             self._dat.close()
@@ -441,6 +504,7 @@ class Volume:
                 live.pop(n.id, None)        # tombstone
         cpd, cpx = self.file_name(".cpd"), self.file_name(".cpx")
         with self.lock:
+            self._drop_mmap()      # the map pins the pre-swap inode
             for stale in (cpd, cpx):
                 if os.path.exists(stale):
                     os.remove(stale)
@@ -513,6 +577,7 @@ class Volume:
 
     def close(self) -> None:
         with self.lock:
+            self._drop_mmap()
             self._dat.flush()
             self._dat.close()
             self.nm.close()

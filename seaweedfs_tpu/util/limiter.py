@@ -1,0 +1,52 @@
+"""Concurrency-bounded execution + bounded outbound HTTP
+(reference: weed/util/limited_executor.go and the
+util_http/client bounded transport).
+
+`BoundedExecutor` caps in-flight tasks with a semaphore on SUBMIT
+(not just worker count): a producer fanning out thousands of chunk
+uploads blocks once the bound is hit instead of queueing unbounded
+work — the backpressure shape limited_executor.go provides.
+
+`bounded_parallel(fn, items, limit)` is the common map-with-bound:
+runs fn over items with at most `limit` in flight, preserves order,
+re-raises the first failure after letting started work finish.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class BoundedExecutor:
+    def __init__(self, limit: int = 8):
+        self.limit = max(1, int(limit))
+        self._pool = ThreadPoolExecutor(max_workers=self.limit)
+        self._slots = threading.Semaphore(self.limit)
+
+    def submit(self, fn, *args, **kwargs):
+        """Blocks while `limit` tasks are in flight (backpressure on
+        the producer, limited_executor.go semantics)."""
+        self._slots.acquire()
+
+        def run():
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                self._slots.release()
+        return self._pool.submit(run)
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._pool.shutdown(wait=wait)
+
+
+def bounded_parallel(fn, items, limit: int = 8) -> list:
+    """Map fn over items with at most `limit` concurrent calls;
+    results in input order.  Sequential fast path for 0/1 items (no
+    thread overhead on the common single-chunk write)."""
+    items = list(items)
+    if len(items) <= 1:
+        return [fn(x) for x in items]
+    with ThreadPoolExecutor(max_workers=min(limit,
+                                            len(items))) as pool:
+        return list(pool.map(fn, items))

@@ -1,0 +1,79 @@
+"""Slab buffer pool (reference: weed/util/mem/slot_pool.go —
+power-of-two size-classed free lists so the data plane recycles big
+byte buffers instead of churning the allocator).
+
+Python strings/bytes are immutable, so the pooled unit is a
+`bytearray` (the only mutable buffer the stdlib I/O stack accepts).
+`allocate(n)` returns a bytearray of capacity >= n from the smallest
+fitting slab; `free(buf)` returns it.  Each slab's free list is
+bounded, so a burst can't pin memory forever (the reference bounds
+pools the same way via sync.Pool's GC behavior).
+"""
+
+from __future__ import annotations
+
+import threading
+
+_MIN_SHIFT = 10            # 1KB
+_MAX_SHIFT = 27            # 128MB — mirrors slot_pool.go's ceiling
+_PER_SLAB = 8              # bounded free list per size class
+
+_lock = threading.Lock()
+_slabs: dict[int, list[bytearray]] = {}
+_stats = {"allocations": 0, "reuses": 0, "frees": 0, "dropped": 0}
+
+
+def _shift_for(size: int) -> int:
+    shift = _MIN_SHIFT
+    while (1 << shift) < size and shift < _MAX_SHIFT:
+        shift += 1
+    return shift
+
+
+def allocate(size: int) -> bytearray:
+    """A bytearray with len == size, capacity == next power of two.
+    Oversize requests fall through to a plain allocation."""
+    if size > (1 << _MAX_SHIFT):
+        _stats["allocations"] += 1
+        return bytearray(size)
+    shift = _shift_for(size)
+    with _lock:
+        free = _slabs.get(shift)
+        if free:
+            buf = free.pop()
+            _stats["reuses"] += 1
+            # shrink/grow the VIEW to the requested length; capacity
+            # stays the slab size underneath
+            if len(buf) != size:
+                if len(buf) < size:
+                    buf.extend(b"\x00" * (size - len(buf)))
+                else:
+                    del buf[size:]
+            return buf
+        _stats["allocations"] += 1
+    return bytearray(size)
+
+
+def free(buf: bytearray) -> None:
+    """Return a buffer to its slab (zeroing is the CALLER's job when
+    the content is sensitive — same contract as slot_pool.go)."""
+    if not isinstance(buf, bytearray):
+        return
+    cap = len(buf)
+    if cap > (1 << _MAX_SHIFT) or cap < (1 << _MIN_SHIFT):
+        _stats["dropped"] += 1
+        return
+    shift = _shift_for(cap)
+    with _lock:
+        free_list = _slabs.setdefault(shift, [])
+        if len(free_list) >= _PER_SLAB:
+            _stats["dropped"] += 1
+            return
+        free_list.append(buf)
+        _stats["frees"] += 1
+
+
+def stats() -> dict:
+    with _lock:
+        return dict(_stats,
+                    pooled=sum(len(v) for v in _slabs.values()))

@@ -1,0 +1,47 @@
+"""Request-id propagation (reference: weed/util/request_id — a
+context key set by middleware and forwarded on outbound calls as the
+`X-Request-ID` header).
+
+A contextvar follows the request across the thread handling it; the
+HTTP server sets it from the inbound header (or mints one), the
+shared HTTP client helpers attach it to outbound hops, and wlog
+appends it to every line — one id traces gateway -> filer -> volume.
+Contextvars propagate into `threading.Thread` only via
+`contextvars.copy_context()`; the data plane handles each request on
+one thread, which is the path that matters.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import secrets
+
+HEADER = "X-Request-ID"
+
+_request_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "weed_request_id", default="")
+
+
+def new_request_id() -> str:
+    return secrets.token_hex(8)
+
+
+def get_request_id() -> str:
+    return _request_id.get()
+
+
+def set_request_id(rid: str) -> "contextvars.Token":
+    return _request_id.set(rid)
+
+
+def ensure_request_id(inbound: "str | None") -> str:
+    """Adopt the caller's id or mint one (request_id middleware
+    semantics: ids are created at the edge and preserved through
+    every internal hop)."""
+    rid = inbound or new_request_id()
+    _request_id.set(rid)
+    return rid
+
+
+def reset_request_id(token) -> None:
+    _request_id.reset(token)

@@ -297,3 +297,18 @@ def test_list_tables_paginates_across_namespaces(cluster):
     assert sorted(seen) == [("aaa", "t0"), ("aaa", "t1"),
                             ("aaa", "t2"), ("bbb", "s0"),
                             ("bbb", "s1")], seen
+
+
+def test_write_guard_cache_invalidated_on_bucket_create(cluster):
+    """Review r5: a negative table-bucket cache entry must not give
+    arbitrary writes a TTL window right after CreateTableBucket."""
+    gw, filer, env = cluster
+    # prime the negative cache: object write to a nonexistent bucket
+    s3req(gw, "PUT", "/soon-a-lake/x.txt", body=b"probe")
+    assert gw._tbkt_cache.get("soon-a-lake", (0, True))[1] is False
+    st, _ = tables_req(gw, "CreateTableBucket", {"name": "soon-a-lake"})
+    assert st == 200
+    # immediately after creation (inside the old TTL window), junk
+    # writes are already rejected
+    st, body, _ = s3req(gw, "PUT", "/soon-a-lake/junk.txt", body=b"no")
+    assert st == 403, body
