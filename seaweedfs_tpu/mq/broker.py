@@ -204,6 +204,7 @@ class BrokerServer:
         r("POST", "/topics/flush", self._flush)
         r("POST", "/offsets/commit", self._commit_offset)
         r("GET", "/offsets/fetch", self._fetch_offset)
+        r("POST", "/offsets/delete_group", self._delete_group_offsets)
         # schema plane (weed/mq/schema) + parquet compaction
         # (weed/mq/logstore/log_to_parquet.go)
         r("POST", "/topics/schema", self._schema_register)
@@ -946,6 +947,30 @@ class BrokerServer:
             with self._lock:
                 self._repartitioning.discard(t)
         return 200, {"deleted": str(t)}
+
+    def _delete_group_offsets(self, req: Request):
+        """Kafka DeleteGroups server side: drop EVERY committed
+        offset of one consumer group (OFFSETS_DIR/<group>/)."""
+        b = req.json()
+        group = b.get("group", "")
+        try:
+            _check_name("group", group)
+        except NameError_ as e:
+            return 400, {"error": str(e)}
+        path = f"{OFFSETS_DIR}/{group}"
+        st, _, _ = http_bytes(
+            "GET", f"{self.filer}/__meta__/lookup?path=" +
+            urllib.parse.quote(path))
+        existed = st == 200
+        if existed:
+            st_d, body_d, _ = http_bytes(
+                "DELETE",
+                f"{self.filer}{urllib.parse.quote(path)}"
+                f"?recursive=true")
+            if st_d not in (200, 204, 404):
+                return 500, {"error": f"delete offsets: {st_d} "
+                                      f"{body_d[:100]!r}"}
+        return 200, {"existed": existed}
 
     def _delete_topic_offsets(self, t: Topic) -> None:
         """Best-effort removal of every group's committed offsets for

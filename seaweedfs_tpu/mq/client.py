@@ -169,6 +169,16 @@ class MQClient:
         if "error" in r:
             raise RuntimeError(f"commit offset: {r['error']}")
 
+    def delete_group_offsets(self, group: str) -> bool:
+        """Kafka DeleteGroups backend: drop every committed offset of
+        the group.  Returns whether any existed."""
+        r = http_json("POST",
+                      f"{self.broker}/offsets/delete_group",
+                      {"group": group})
+        if "error" in r:
+            raise RuntimeError(f"delete group offsets: {r['error']}")
+        return bool(r.get("existed"))
+
     def fetch_offset(self, group: str, namespace: str, topic: str,
                      partition: int) -> int:
         return self.fetch_offset_full(group, namespace, topic,

@@ -154,6 +154,25 @@ class KafkaClient:
         r.string()                       # topic
         return r.i16(), r.string()
 
+    def init_producer_id(self) -> "tuple[int, int]":
+        """InitProducerId v0: (producer_id, epoch)."""
+        body = enc_string(None) + enc_i32(60000)
+        r = self._rpc(22, 0, body)
+        r.i32()                          # throttle
+        code = r.i16()
+        if code:
+            raise KafkaError(code, "InitProducerId")
+        return r.i64(), r.i16()
+
+    def delete_groups(self, groups: "list[str]",
+                      version: int = 1) -> "dict[str, int]":
+        """DeleteGroups: {group: error_code}."""
+        body = enc_array([enc_string(g) for g in groups])
+        r = self._rpc(42, version, body)
+        if version >= 1:
+            r.i32()                      # throttle
+        return {r.string(): r.i16() for _ in range(r.i32())}
+
     def list_groups(self) -> "list[tuple[str, str]]":
         r = self._rpc(16, 0, b"")
         code = r.i16()

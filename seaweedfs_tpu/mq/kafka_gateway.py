@@ -67,9 +67,15 @@ API_VERSIONS = {
     18: (0, 2),   # ApiVersions (v1 +throttle)
     19: (0, 2),   # CreateTopics (v1 +validate_only, v2 +throttle)
     20: (0, 1),   # DeleteTopics (v1 +throttle)
+    22: (0, 1),   # InitProducerId (idempotent-producer bootstrap)
     32: (0, 1),   # DescribeConfigs (v1 +include_synonyms/sources)
     37: (0, 1),   # CreatePartitions (v1 same wire, bumped for parity)
+    42: (0, 1),   # DeleteGroups (v1 +throttle)
 }
+
+GROUP_ID_NOT_FOUND = 69
+NON_EMPTY_GROUP = 68
+COORDINATOR_NOT_AVAILABLE = 15
 
 
 class KafkaGateway:
@@ -165,8 +171,10 @@ class KafkaGateway:
               13: self._leave_group, 14: self._sync_group,
               15: self._describe_groups, 16: self._list_groups,
               18: self._api_versions, 19: self._create_topics,
-              20: self._delete_topics, 32: self._describe_configs,
-              37: self._create_partitions}[api_key]
+              20: self._delete_topics, 22: self._init_producer_id,
+              32: self._describe_configs,
+              37: self._create_partitions,
+              42: self._delete_groups}[api_key]
         body = fn(r, api_version)
         return None if body is None else header + body
 
@@ -387,6 +395,50 @@ class KafkaGateway:
                 enc_string(d["state"]) +
                 enc_string(d["protocol_type"]) +
                 enc_string(d["protocol"]) + enc_array(members))
+        return (enc_i32(0) if v >= 1 else b"") + enc_array(results)
+
+    def _init_producer_id(self, r: Reader, v: int = 0) -> bytes:
+        """API 22 (mq/kafka/protocol InitProducerId role): newer
+        librdkafka/kafka-python producers bootstrap an idempotent
+        producer id before their first Produce.  We have no
+        transaction log — ids are process-monotonic and the epoch is
+        always 0, which satisfies clients that only need a non-error
+        answer to proceed."""
+        r.string()                       # transactional_id (unused)
+        r.i32()                          # transaction_timeout_ms
+        with self._lock:
+            self._next_pid = getattr(self, "_next_pid", 0) + 1
+            pid = self._next_pid
+        return (enc_i32(0) +             # throttle_time_ms
+                enc_i16(NONE) + enc_i64(pid) + enc_i16(0))
+
+    def _delete_groups(self, r: Reader, v: int = 0) -> bytes:
+        """API 42: remove consumer groups — refuses groups with live
+        members (NON_EMPTY_GROUP, like the reference coordinator),
+        deletes committed offsets through the broker otherwise."""
+        names = [r.string() for _ in range(r.i32())]
+        results = []
+        for gid in names:
+            d = self.groups.describe(gid)
+            if d is not None and d["members"]:
+                results.append(enc_string(gid) +
+                               enc_i16(NON_EMPTY_GROUP))
+                continue
+            known = d is not None
+            try:
+                had_offsets = self.mq.delete_group_offsets(gid)
+            except (RuntimeError, OSError):
+                # the broker couldn't confirm offset removal: say so
+                # and KEEP coordinator state — reporting success here
+                # would let a rejoining consumer resume from offsets
+                # that were supposed to be gone
+                results.append(enc_string(gid) +
+                               enc_i16(COORDINATOR_NOT_AVAILABLE))
+                continue
+            self.groups.drop(gid)
+            code = NONE if (known or had_offsets) \
+                else GROUP_ID_NOT_FOUND
+            results.append(enc_string(gid) + enc_i16(code))
         return (enc_i32(0) if v >= 1 else b"") + enc_array(results)
 
     # the static per-topic config surface DescribeConfigs exposes —

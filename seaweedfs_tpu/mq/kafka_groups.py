@@ -253,6 +253,12 @@ class GroupCoordinator:
                 } for m in g.members.values()],
             }
 
+    def drop(self, group_id: str) -> bool:
+        """DeleteGroups coordinator side: forget the group entirely
+        (caller has already checked it is member-less)."""
+        with self._lock:
+            return self._groups.pop(group_id, None) is not None
+
     def leave(self, group_id: str, member_id: str) -> int:
         g = self._group(group_id)
         with g.cond:

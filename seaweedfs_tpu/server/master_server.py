@@ -522,7 +522,18 @@ class MasterServer:
         if len(self.raft.peers) == 1:
             return 400, {"error": "single-master cluster: nothing to "
                                   "transfer to"}
-        if not self.raft.transfer_leadership():
+        target = ""
+        try:
+            target = req.json().get("target", "")
+        except (ValueError, AttributeError):
+            pass
+        if target and target not in self.raft.peers:
+            # a typo'd target must FAIL, not silently hand leadership
+            # to some other node (possibly the one being drained)
+            return 400, {"error": f"target {target} is not a raft "
+                                  f"member",
+                         "members": self.raft.peers}
+        if not self.raft.transfer_leadership(target):
             return 400, {"error": "leadership changed mid-request",
                          "leader": self.raft.leader}
         return 200, {"transferred": True}

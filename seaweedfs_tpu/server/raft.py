@@ -272,6 +272,8 @@ class RaftNode:
             self._apply_committed_locked()
         http.route("POST", "/cluster/raft/vote", self._handle_vote)
         http.route("POST", "/cluster/raft/append", self._handle_append)
+        http.route("POST", "/cluster/raft/timeout_now",
+                   self._handle_timeout_now)
 
     # -- persistence of (term, votedFor) --------------------------------
 
@@ -565,19 +567,63 @@ class RaftNode:
             self.on_leadership(True)
         return True
 
-    def transfer_leadership(self) -> bool:
-        """Voluntary step-down (raft LeadershipTransfer): retire the
-        leader state AND restart our own election timer, so a peer —
-        whose log the final heartbeats made current — times out and
-        wins before we would run again.  Without the timer reset the
-        ex-leader's long-expired clock fires on the next pulse and it
-        deterministically re-elects itself."""
+    def transfer_leadership(self, target: str = "") -> bool:
+        """Leadership transfer, TimeoutNow form (raft §3.10 /
+        hashicorp LeadershipTransfer): heartbeat once so the
+        transferee's log is current, tell it to start an election
+        IMMEDIATELY (`timeout_now`), then step down with our own
+        timer reset.  The explicit nudge makes the handover take one
+        round trip instead of a full election timeout — and the
+        chosen peer (most-caught-up by match index unless the
+        operator named one) deterministically wins because everyone
+        else's timer hasn't fired.  Falls back to plain step-down
+        when no peer accepts the nudge."""
         with self._lock:
             if self.state != LEADER:
                 return False
+            term = self.term
+            candidates = [p for p in self.peers if p != self.self_url]
+            if target and target in candidates:
+                candidates = [target]
+            else:
+                candidates.sort(
+                    key=lambda p: -self._match_index.get(p, 0))
+        if candidates:
+            self._heartbeat_peers()     # final log currency push
+        nudged = False
+        for peer in candidates:
+            try:
+                r = http_json("POST",
+                              f"{peer}/cluster/raft/timeout_now",
+                              {"term": term, "leader": self.self_url},
+                              3.0, self._auth_headers())
+                if r.get("ok"):
+                    nudged = True
+                    break
+            except OSError:
+                continue
+        if not nudged and candidates:
+            from ..util import wlog
+            wlog.warning("leader transfer: no peer accepted "
+                         "timeout_now; falling back to step-down")
+        with self._lock:
+            if self.state != LEADER:
+                return True             # lost it meanwhile: done
             self._step_down(self.term)
             self._last_heard = time.monotonic()
         return True
+
+    def _handle_timeout_now(self, req):
+        """TimeoutNow receiver: the leader told us to run an election
+        NOW — skip the randomized timeout (we are its chosen, most
+        up-to-date successor)."""
+        b = req.json()
+        with self._lock:
+            if int(b.get("term", 0)) < self.term or \
+                    self.state == LEADER:
+                return 200, {"ok": False, "term": self.term}
+        self._run_election()
+        return 200, {"ok": self.state == LEADER, "term": self.term}
 
     def _election_timeout(self) -> float:
         return random.uniform(4, 8) * self.pulse

@@ -525,13 +525,17 @@ def cmd_volume_tier_download(env: CommandEnv, args: list[str]) -> str:
 @command("cluster.raft.leader.transfer")
 def cmd_cluster_raft_leader_transfer(env: CommandEnv,
                                      args: list[str]) -> str:
-    """command_cluster_raft_leader_transfer.go: the current leader
-    steps down; an up-to-date peer wins the next election."""
+    """command_cluster_raft_leader_transfer.go ([-target=URL]): the
+    leader pushes a final heartbeat, nudges its most-caught-up peer
+    (or -target) with TimeoutNow, and steps down — handover in one
+    round trip instead of an election timeout."""
     from ..operation import master_json
-    r = master_json(env.master, "POST", "/cluster/raft/transfer", {})
+    opts = _parse_flags(args)
+    r = master_json(env.master, "POST", "/cluster/raft/transfer",
+                    {"target": opts.get("target", "")})
     _must(r, "leader transfer")
-    return "leadership released; a peer takes over within the " \
-           "election timeout"
+    return "leadership transferred (TimeoutNow nudge sent to the " \
+           "successor)"
 
 
 @command("mq.balance")

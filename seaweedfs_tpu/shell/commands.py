@@ -189,17 +189,46 @@ def _rack_of_nodes(env: CommandEnv) -> dict[str, str]:
     return out
 
 
+def _ec_placement_scores(env: CommandEnv, vid: int) -> dict[str, int]:
+    """Per-node placement score, LOWER is better
+    (command_ec_common.go:1380 diskDistributionScore + :1441 pick):
+    shards of THIS volume weigh 100 (anti-correlation — losing one
+    node must not take multiple shards of a stripe), total EC shards
+    weigh 10 (overall spread), free volume slots subtract (headroom
+    attracts placements)."""
+    from ..topology import iter_volume_list_ec_shards
+    vl = env.volume_list()
+    scores: dict[str, int] = {}
+    headroom: dict[str, int] = {}
+    for dc in vl.get("dataCenters", {}).values():
+        for rack in dc.get("racks", {}).values():
+            for node in rack.get("nodes", []):
+                headroom[node["url"]] = \
+                    int(node.get("maxVolumeCount", 8)) - \
+                    len(node.get("volumes", []))
+                scores[node["url"]] = 0
+    for node, e in iter_volume_list_ec_shards(vl):
+        cnt = bin(int(e.get("ecIndexBits", 0))).count("1")
+        url = node["url"]
+        scores[url] = scores.get(url, 0) + cnt * 10
+        if e.get("volumeId", e.get("id")) == vid:
+            scores[url] += cnt * 100
+    return {u: s - headroom.get(u, 0) for u, s in scores.items()}
+
+
 def _balance_ec_volume(env: CommandEnv, vid: int, collection: str,
                        total: int) -> int:
     """The balance algorithm of command_ec_common.go:59-124:
     (1) dedupe shard copies, (2) spread shards across racks toward
     total/numRacks per rack, (3) even out per-server counts within each
-    rack."""
+    rack.  Destination picks among equally-loaded candidates break
+    ties by placement score (diskDistributionScore role)."""
     shard_locs = _ec_shard_locations(env, vid)
     nodes = _all_node_urls(env)
     if not nodes:
         return 0
     rack_of = _rack_of_nodes(env)
+    score = _ec_placement_scores(env, vid)
     moved = 0
 
     # (1) dedupe: keep first copy of each shard
@@ -247,7 +276,9 @@ def _balance_ec_volume(env: CommandEnv, vid: int, collection: str,
             load = load_by_url()
             dest_candidates = [n for n in nodes
                                if rack_of.get(n, "?") == dest_rack]
-            dst = min(dest_candidates, key=lambda n: len(load[n]))
+            dst = min(dest_candidates,
+                      key=lambda n: (len(load[n]),
+                                     score.get(n, 0)))
             sid = rl[rack][-1]
             move(sid, owner[sid], dst)
             rl = rack_load()
@@ -263,7 +294,9 @@ def _balance_ec_volume(env: CommandEnv, vid: int, collection: str,
         avg = max(1, -(-len(rack_shards) // len(members)))
         for donor in sorted(members, key=lambda n: -len(load[n])):
             while len(load[donor]) > avg:
-                recv = min(members, key=lambda n: len(load[n]))
+                recv = min(members,
+                           key=lambda n: (len(load[n]),
+                                          score.get(n, 0)))
                 if recv == donor or len(load[recv]) + 1 > avg:
                     break
                 sid = load[donor][-1]

@@ -66,6 +66,11 @@ class CookieMismatch(ValueError):
 class Volume:
     """One volume on disk: <dir>/<collection_prefix><vid>.{dat,idx,vif}."""
 
+    # remap only once the .dat outgrows the read map by this much;
+    # smaller fresh tails are served by the handle fallback so a
+    # write-then-read workload doesn't pay a remap per append
+    MMAP_REMAP_CHUNK = 4 << 20
+
     def __init__(self, directory: str, volume_id: int, collection: str = "",
                  replica_placement: ReplicaPlacement | None = None,
                  ttl: TTL = EMPTY_TTL,
@@ -289,27 +294,41 @@ class Volume:
         (map failed, volume over the cap, or a remote .dat)."""
         if self.is_remote or self._mm_skip:
             return None
+        if self._mm is not None and \
+                offset + length <= len(self._mm):
+            return self._mm[offset:offset + length]
+        # read beyond the map (fresh tail) or no map yet.  Remap only
+        # when the file has outgrown the map by a real margin —
+        # write-then-read workloads would otherwise pay a full
+        # drop/open/mmap cycle per appended needle; a small tail is
+        # served by the handle fallback with the map intact.
         import mmap as _mmap
-        if self._mm is None or offset + length > len(self._mm):
-            self._drop_mmap()
-            try:
-                self._dat.flush()      # appended tail must be mapped
-                f = open(self.file_name(".dat"), "rb")
-                size = os.fstat(f.fileno()).st_size
-                if size > self.mmap_limit or size == 0:
-                    f.close()
-                    # the file only grows between .dat swaps: once
-                    # over the cap, stop paying open+fstat per read
-                    # (_drop_mmap at swap points clears the skip)
-                    self._mm_skip = size > self.mmap_limit
-                    return None
-                self._mm_f = f
-                self._mm = _mmap.mmap(f.fileno(), 0,
-                                      access=_mmap.ACCESS_READ)
-            except (OSError, ValueError, AttributeError):
-                self._drop_mmap()
-                self._mm_skip = True
+        try:
+            size = os.path.getsize(self.file_name(".dat"))
+        except OSError:
+            return None
+        if self._mm is not None and \
+                size - len(self._mm) < self.MMAP_REMAP_CHUNK:
+            return None                # handle read serves the tail
+        self._drop_mmap()
+        try:
+            self._dat.flush()          # appended tail must be mapped
+            f = open(self.file_name(".dat"), "rb")
+            size = os.fstat(f.fileno()).st_size
+            if size > self.mmap_limit or size == 0:
+                f.close()
+                # the file only grows between .dat swaps: once over
+                # the cap, stop paying open+fstat per read
+                # (_drop_mmap at swap points clears the skip)
+                self._mm_skip = size > self.mmap_limit
                 return None
+            self._mm_f = f
+            self._mm = _mmap.mmap(f.fileno(), 0,
+                                  access=_mmap.ACCESS_READ)
+        except (OSError, ValueError, AttributeError):
+            self._drop_mmap()
+            self._mm_skip = True
+            return None
         if offset + length > len(self._mm):
             return None                # still beyond: buffered tail
         return self._mm[offset:offset + length]
@@ -443,8 +462,12 @@ class Volume:
         """makeupDiff replay + rename shadows over the live files and
         reload (volume_vacuum.go:141 CommitCompact)."""
         with self.lock:
-            self._drop_mmap()      # the map pins the pre-swap inode
             self._makeup_diff()
+            # AFTER the diff replay (whose _read_at may legitimately
+            # use — and recreate — a map of the OLD .dat) and BEFORE
+            # the renames: a map surviving the swap would serve
+            # old-layout bytes at new-layout offsets
+            self._drop_mmap()
             self.nm.close()
             self._dat.close()
             os.replace(self.file_name(".cpd"), self.file_name(".dat"))

@@ -133,9 +133,13 @@ def set_output(path: str, max_bytes: int = 64 << 20,
     """Add (or replace) the rotating file sink (-logdir role)."""
     global _file_handler
     with _lock:
-        if _file_handler is not None:
-            _logger.removeHandler(_file_handler)
-            _file_handler.close()
+        old, _file_handler = _file_handler, None
+    if old is not None:
+        # close OUTSIDE the module lock: the handler's own emit/close
+        # take the same (non-reentrant) lock
+        _logger.removeHandler(old)
+        old.close()
+    with _lock:
         _file_handler = _RotatingHandler(path, max_bytes, backups)
     _file_handler.setFormatter(_Formatter())
     _logger.addHandler(_file_handler)

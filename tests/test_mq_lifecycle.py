@@ -213,3 +213,35 @@ def test_delete_topic_fences_peer_cached_publish(stack, tmp_path):
             "orphan partition dirs under deleted topic"
     finally:
         broker_b.stop()
+
+
+def test_init_producer_id_and_delete_groups(stack):
+    """API 22 (idempotent-producer bootstrap) + API 42 (consumer
+    group deletion with NON_EMPTY_GROUP protection and committed
+    offset cleanup)."""
+    client, gw, broker, filer = stack
+    pid1, epoch = client.init_producer_id()
+    pid2, _ = client.init_producer_id()
+    assert epoch == 0 and pid2 != pid1
+    # a live group refuses deletion
+    client.create_topic("dgtopic", partitions=1)
+    member = GroupConsumer(client, "dg-group", ["dgtopic"])
+    member.join()
+    client.produce("dgtopic", 0, [(b"k", b"v")])
+    client.offset_commit("dg-group", "dgtopic", 0, 1)
+    res = client.delete_groups(["dg-group"])
+    assert res["dg-group"] == 68          # NON_EMPTY_GROUP
+    member.leave()
+    res = client.delete_groups(["dg-group", "never-existed"])
+    assert res["dg-group"] == 0
+    assert res["never-existed"] == 69     # GROUP_ID_NOT_FOUND
+    # offsets really gone: a fresh fetch sees no committed position
+    from seaweedfs_tpu.mq.client import MQClient
+    mq = MQClient(broker.url)
+    _, committed = mq.fetch_offset_full("dg-group", "kafka",
+                                        "dgtopic", 0)
+    assert committed is False
+    # deleting a group with offsets but NO live coordinator state
+    client.offset_commit("dg-group", "dgtopic", 0, 1)
+    res = client.delete_groups(["dg-group"])
+    assert res["dg-group"] == 0

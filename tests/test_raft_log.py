@@ -268,3 +268,30 @@ def test_cluster_raft_shell_commands(ha3):
     with _pytest.raises(RuntimeError, match="transfer"):
         run_command(env,
                     f"cluster.raft.remove -server={leader.url}")
+
+
+def test_leader_transfer_timeout_now_targets_peer(ha3):
+    """Round 5: transfer uses the TimeoutNow nudge — the named target
+    becomes leader in ~one round trip, not a full election timeout,
+    and the cluster keeps exactly one leader."""
+    from seaweedfs_tpu.shell import run_command
+    from seaweedfs_tpu.shell.commands import CommandEnv
+
+    masters, vols, seeds, ports, tmp = ha3
+    leader = _wait_leader(masters)
+    target = next(m for m in masters if m is not leader)
+    env = CommandEnv(seeds)
+    t0 = time.monotonic()
+    out = run_command(env, "cluster.raft.leader.transfer "
+                           f"-target={target.url}")
+    assert "transferred" in out
+    _wait(lambda: target.raft.is_leader, timeout=5,
+          msg="target never took over")
+    took = time.monotonic() - t0
+    # TimeoutNow makes this far faster than the 4-8 pulse election
+    # window the old step-down needed; allow slack for a loaded box
+    assert took < 4.0, f"transfer took {took:.1f}s"
+    assert sum(1 for m in masters if m.raft.is_leader) == 1
+    # the cluster still serves writes after the handover
+    _wait(lambda: target.raft.lease_valid(), timeout=5,
+          msg="new leader lease")
