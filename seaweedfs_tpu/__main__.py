@@ -106,7 +106,7 @@ def main(argv: list[str] | None = None) -> int:
                          ":memory:")
     fl.add_argument("-storeType", dest="store_type",
                     default="sqlite",
-                    choices=["sqlite", "lsm", "redis"],
+                    choices=["sqlite", "lsm", "redis", "elastic"],
                     help="metadata store archetype (filerstore.go: "
                          "sqlite=SQL, lsm=embedded ordered-KV — the "
                          "reference's leveldb default — redis=RESP "

@@ -123,6 +123,12 @@ class AdminServer:
         r("POST", "/worker/progress", self._progress)     # JobProgressUpdate
         r("POST", "/worker/complete", self._complete)     # JobCompleted
         r("GET", "/", self._ui)
+        # multi-page admin UI (weed/admin/view/app/ pages)
+        r("GET", "/ui/volumes", self._ui_volumes)
+        r("GET", "/ui/ec", self._ui_ec)
+        r("GET", "/ui/jobs", self._ui_jobs)
+        r("GET", "/ui/config", self._ui_config)
+        r("POST", "/ui/config", self._ui_config_submit)
         r("GET", "/maintenance/queue", self._queue)
         r("POST", "/maintenance/trigger_detection", self._trigger)
         r("POST", "/maintenance/submit_job", self._submit_job)
@@ -415,16 +421,9 @@ class AdminServer:
                 f"<td>{_html.escape(str(self.config.get(jt, {})))}"
                 f"</td></tr>"
                 for jt, fields in sorted(self.schemas.items())]
-        body = f"""<!doctype html><html><head>
-<title>seaweedfs-tpu admin</title>
-<style>body{{font-family:sans-serif;margin:2em}}
-table{{border-collapse:collapse;margin:1em 0}}
-td,th{{border:1px solid #ccc;padding:4px 10px;text-align:left}}
-h2{{margin-top:1.5em}}</style></head><body>
-<h1>seaweedfs-tpu admin</h1>
-<p>master: {_html.escape(self.master)} &middot; leader:
-{_html.escape(str(status.get('leader', '?')))} &middot; topology:
-{_html.escape(str(status.get('topologyId', '?')))}</p>
+        inner = f"""<p>master: {_html.escape(self.master)} &middot;
+leader: {_html.escape(str(status.get('leader', '?')))} &middot;
+topology: {_html.escape(str(status.get('topologyId', '?')))}</p>
 <h2>Data nodes</h2>
 <table><tr><th>dc/rack</th><th>url</th><th>volumes</th>
 <th>ec volumes</th></tr>{''.join(rows)}</table>
@@ -436,9 +435,199 @@ h2{{margin-top:1.5em}}</style></head><body>
 {''.join(config_rows)}</table>
 <h2>Jobs (latest 50)</h2>
 <table><tr><th>id</th><th>type</th><th>status</th><th>progress</th>
-<th>message</th><th>last decision</th></tr>{''.join(jobs)}</table>
-</body></html>"""
+<th>message</th><th>last decision</th></tr>{''.join(jobs)}</table>"""
+        return self._page("seaweedfs-tpu admin", inner)
+
+    class _FormShim:
+        """Request shim: hands a parsed HTML form to the JSON config
+        handler so both entry points share one validation path."""
+
+        def __init__(self, payload: dict):
+            self._payload = payload
+            self.query: dict = {}
+
+        def json(self) -> dict:
+            return self._payload
+
+    # -- multi-page UI (weed/admin/view/app/: cluster_volumes.templ,
+    # cluster_ec_volumes.templ, maintenance_queue.templ,
+    # maintenance_config_schema.templ roles) ---------------------------
+
+    _NAV = ("<p><a href='/'>dashboard</a> | "
+            "<a href='/ui/volumes'>volumes</a> | "
+            "<a href='/ui/ec'>ec</a> | "
+            "<a href='/ui/jobs'>jobs</a> | "
+            "<a href='/ui/config'>config</a></p>")
+
+    def _page(self, title: str, inner: str):
+        import html as _html
+        body = f"""<!doctype html><html><head>
+<title>{_html.escape(title)} - seaweedfs-tpu admin</title>
+<style>body{{font-family:sans-serif;margin:2em}}
+table{{border-collapse:collapse;margin:1em 0}}
+td,th{{border:1px solid #ccc;padding:4px 10px;text-align:left}}
+h2{{margin-top:1.5em}} .ok{{color:#2a2}} .bad{{color:#c22}}
+input{{margin:2px}}</style></head><body>
+<h1>{_html.escape(title)}</h1>{self._NAV}{inner}</body></html>"""
         return 200, (body.encode(), "text/html; charset=utf-8")
+
+    def _topology(self) -> dict:
+        try:
+            from ..operation import master_json
+            return master_json(self.master, "GET", "/vol/list")
+        except OSError:
+            return {}
+
+    def _ui_volumes(self, req: Request):
+        """Per-volume inventory across the topology
+        (cluster_volumes.templ role)."""
+        import html as _html
+        from ..topology import iter_volume_list_volumes
+        rows = []
+        for node, v in sorted(
+                iter_volume_list_volumes(self._topology()),
+                key=lambda t: (t[1]["id"], t[0]["url"])):
+            garbage = v.get("deletedByteCount", 0)
+            size = max(v.get("size", 0), 1)
+            flags = []
+            if v.get("readOnly"):
+                flags.append("readonly")
+            if v.get("remoteTiered"):
+                flags.append("remote")
+            rows.append(
+                f"<tr><td>{v['id']}</td>"
+                f"<td>{_html.escape(v.get('collection') or '-')}</td>"
+                f"<td>{_html.escape(node['url'])}</td>"
+                f"<td>{v.get('size', 0):,}</td>"
+                f"<td>{v.get('fileCount', 0)}</td>"
+                f"<td>{garbage / size:.0%}</td>"
+                f"<td>{_html.escape(','.join(flags) or '-')}</td>"
+                f"</tr>")
+        return self._page(
+            "Volumes",
+            "<table><tr><th>id</th><th>collection</th><th>node</th>"
+            "<th>bytes</th><th>files</th><th>garbage</th>"
+            f"<th>flags</th></tr>{''.join(rows)}</table>"
+            f"<p>{len(rows)} volume replicas</p>")
+
+    def _ui_ec(self, req: Request):
+        """EC volumes and shard spread (cluster_ec_volumes.templ)."""
+        import html as _html
+        from ..topology import iter_volume_list_ec_shards
+        by_vol: dict[int, list] = {}
+        for node, e in iter_volume_list_ec_shards(self._topology()):
+            bits = int(e.get("ecIndexBits", 0))
+            sids = [i for i in range(32) if bits >> i & 1]
+            by_vol.setdefault(e.get("volumeId", e.get("id")),
+                              []).append((node["url"], sids))
+        rows = []
+        for vid, spread in sorted(by_vol.items()):
+            total = sum(len(s) for _, s in spread)
+            cells = "; ".join(
+                f"{_html.escape(url)}: {','.join(map(str, s))}"
+                for url, s in sorted(spread))
+            cls = "ok" if total >= 14 else "bad"
+            rows.append(f"<tr><td>{vid}</td>"
+                        f"<td class='{cls}'>{total}</td>"
+                        f"<td>{cells}</td></tr>")
+        return self._page(
+            "EC volumes",
+            "<table><tr><th>volume</th><th>shards</th>"
+            f"<th>placement</th></tr>{''.join(rows)}</table>"
+            f"<p>{len(rows)} EC volumes</p>")
+
+    def _ui_jobs(self, req: Request):
+        """Full job history with status filter + decision traces
+        (maintenance_queue.templ + persisted job history)."""
+        import html as _html
+        want = req.query.get("status", "")
+        with self.lock:
+            jobs = sorted(self.jobs.values(),
+                          key=lambda j: -j.created)
+        if want:
+            jobs = [j for j in jobs if j.status == want]
+        counts: dict[str, int] = {}
+        with self.lock:
+            for j in self.jobs.values():
+                counts[j.status] = counts.get(j.status, 0) + 1
+        filters = " | ".join(
+            f"<a href='/ui/jobs?status={s}'>{s} ({n})</a>"
+            for s, n in sorted(counts.items()))
+        rows = []
+        for j in jobs[:200]:
+            trace = "<br>".join(
+                f"{_html.escape(t.get('event', ''))} "
+                f"{_html.escape(str(t.get('detail', '')))}"
+                for t in j.trace[-3:])
+            rows.append(
+                f"<tr><td><a href='/maintenance/job?id={j.job_id}'>"
+                f"{j.job_id}</a></td>"
+                f"<td>{_html.escape(j.job_type)}</td>"
+                f"<td>{_html.escape(j.status)}</td>"
+                f"<td>{j.progress:.0%}</td>"
+                f"<td>{_html.escape(str(j.params)[:80])}</td>"
+                f"<td>{trace}</td></tr>")
+        return self._page(
+            "Jobs",
+            f"<p>filter: <a href='/ui/jobs'>all</a> | {filters}</p>"
+            "<table><tr><th>id</th><th>type</th><th>status</th>"
+            "<th>progress</th><th>params</th><th>decisions</th></tr>"
+            f"{''.join(rows)}</table>")
+
+    def _ui_config(self, req: Request):
+        """Schema-driven config FORMS (admin/plugin/DESIGN.md
+        SchemaCoordinator: worker Descriptors carry the field schema,
+        the operator edits values, RunDetection delivers them)."""
+        import html as _html
+        with self.lock:
+            schemas = {jt: list(fields)
+                       for jt, fields in sorted(self.schemas.items())}
+            values = {jt: dict(self.config.get(jt, {}))
+                      for jt in schemas}
+        forms = []
+        for jt, fields in schemas.items():
+            inputs = []
+            for f in fields:
+                name = f["name"]
+                cur = values[jt].get(name, f.get("default", ""))
+                ftype = f.get("type", "string")
+                inputs.append(
+                    f"<label>{_html.escape(name)} "
+                    f"<small>({_html.escape(ftype)})</small> "
+                    f"<input name='{_html.escape(name)}' "
+                    f"value='{_html.escape(str(cur))}'></label><br>")
+            forms.append(
+                f"<h2>{_html.escape(jt)}</h2>"
+                f"<form method='post' action='/ui/config'>"
+                f"<input type='hidden' name='jobType' "
+                f"value='{_html.escape(jt)}'>"
+                f"{''.join(inputs)}"
+                f"<button>apply</button></form>")
+        if not forms:
+            forms = ["<p>no worker has registered a config schema "
+                     "yet</p>"]
+        return self._page("Config", "".join(forms))
+
+    def _ui_config_submit(self, req: Request):
+        """HTML-form arm of /maintenance/config POST: same schema
+        validation, then redirect back to the form."""
+        import urllib.parse as _up
+        # keep_blank_values: clearing a field to empty must REACH the
+        # validator, not silently keep the old value
+        form = {k: v[0] for k, v in
+                _up.parse_qs((req.body or b"").decode(),
+                             keep_blank_values=True).items()}
+        jt = form.pop("jobType", "")
+        status, payload = self._set_config(self._FormShim(
+            {"jobType": jt, "values": form}))
+        if status != 200:
+            import html as _html
+            return self._page(
+                "Config error",
+                f"<p class='bad'>{_html.escape(str(payload))}</p>"
+                "<p><a href='/ui/config'>back</a></p>")
+        return 303, (b"", {"Location": "/ui/config",
+                           "Content-Type": "text/plain"})
 
     def _submit_job(self, req: Request):
         """Operator-submitted job (the analog of dispatching work from

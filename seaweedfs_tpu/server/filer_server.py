@@ -78,9 +78,16 @@ class FilerServer:
                     "-storeType redis needs -store host:port of a "
                     "RESP server")
             store = RedisFilerStore(RespClient(r_host, int(r_port)))
+        elif store_type == "elastic":
+            # store_path = host:port of an ES-wire server
+            # (filer/elastic_store.py; reference weed/filer/elastic)
+            from ..filer.elastic_store import (ElasticClient,
+                                               ElasticFilerStore)
+            store = ElasticFilerStore(ElasticClient(store_path))
         else:
             raise ValueError(f"unknown filer store type "
-                             f"{store_type!r} (sqlite|lsm|redis)")
+                             f"{store_type!r} "
+                             f"(sqlite|lsm|redis|elastic)")
         self.filer = Filer(master, store,
                            collection=collection,
                            replication=replication,

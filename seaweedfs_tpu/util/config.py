@@ -90,6 +90,7 @@ _STORE_SECTIONS = {
     "leveldb2": "lsm", "leveldb3": "lsm", "leveldb": "lsm",
     "rocksdb": "lsm",
     "redis2": "redis", "redis": "redis", "redis_cluster2": "redis",
+    "elastic7": "elastic", "elastic": "elastic",
 }
 
 
@@ -107,6 +108,12 @@ def filer_store_from_toml(path: str) -> "tuple[str, str] | None":
                                      cfg.get("dbfile", "filer.db"))
         if archetype == "lsm":
             return "lsm", cfg.get("dir", "./filerldb2")
+        if archetype == "elastic":
+            servers = cfg.get("servers",
+                              cfg.get("address", "localhost:9200"))
+            first = servers[0] if isinstance(servers, list) \
+                else str(servers)
+            return "elastic", first.removeprefix("http://")
         return "redis", cfg.get("address", "localhost:6379")
     return None
 

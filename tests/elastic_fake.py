@@ -1,0 +1,157 @@
+"""Minimal Elasticsearch-wire fake for store tests (the external
+process the elastic filer store speaks to — the role resp_fake.py
+plays for the redis store).  Implements exactly the surface
+ElasticClient drives: doc CRUD, _delete_by_query, _search with
+bool-filter (term / prefix / range on flat fields), sort, size."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+
+from seaweedfs_tpu.server.httpd import HttpServer, Request
+
+
+class FakeElastic:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.http = HttpServer(host, port)
+        self.docs: dict[tuple[str, str], dict] = {}
+        self.lock = threading.Lock()
+        self.search_calls = 0
+        self.http.fallback = self._route
+
+    def start(self) -> "FakeElastic":
+        self.http.start()
+        return self
+
+    def stop(self) -> None:
+        self.http.stop()
+
+    @property
+    def address(self) -> str:
+        return f"{self.http.host}:{self.http.port}"
+
+    # -- request routing ---------------------------------------------------
+
+    def _route(self, req: Request):
+        parts = [urllib.parse.unquote(p)
+                 for p in req.path.strip("/").split("/") if p]
+        if not parts:
+            return 200, {"cluster_name": "fake-es",
+                         "version": {"number": "7.99.0"}}
+        idx = parts[0]
+        if len(parts) == 1:
+            # index lifecycle (create / exists-check)
+            if req.method == "PUT":
+                with self.lock:
+                    self.indices = getattr(self, "indices", set())
+                    self.indices.add(idx)
+                return 200, {"acknowledged": True, "index": idx}
+            if req.method in ("GET", "HEAD"):
+                with self.lock:
+                    known = idx in getattr(self, "indices", set())
+                if known:
+                    return 200, {idx: {"mappings": {}}}
+                return 404, {"error": {
+                    "type": "index_not_found_exception"}}
+        if len(parts) >= 2 and parts[1] == "_refresh":
+            return 200, {"_shards": {"successful": 1}}
+        if len(parts) >= 2 and parts[1] == "_search":
+            return self._search(idx, req)
+        if len(parts) >= 2 and parts[1] == "_delete_by_query":
+            return self._delete_by_query(idx, req)
+        if len(parts) >= 3 and parts[1] == "_doc":
+            doc_id = parts[2]
+            if req.method == "PUT":
+                with self.lock:
+                    self.docs[(idx, doc_id)] = req.json()
+                return 200, {"result": "updated", "_id": doc_id}
+            if req.method == "GET":
+                with self.lock:
+                    src = self.docs.get((idx, doc_id))
+                if src is None:
+                    return 404, {"found": False, "_id": doc_id}
+                return 200, {"found": True, "_id": doc_id,
+                             "_source": src}
+            if req.method == "DELETE":
+                with self.lock:
+                    existed = self.docs.pop((idx, doc_id),
+                                            None) is not None
+                return (200 if existed else 404), {
+                    "result": "deleted" if existed else "not_found"}
+        return 400, {"error": f"unsupported {req.method} {req.path}"}
+
+    # -- query evaluation --------------------------------------------------
+
+    @staticmethod
+    def _clause_matches(clause: dict, src: dict) -> bool:
+        kind, body = next(iter(clause.items()))
+        if kind == "term":
+            field, want = next(iter(body.items()))
+            return src.get(field) == want
+        if kind == "prefix":
+            field, want = next(iter(body.items()))
+            return str(src.get(field, "")).startswith(want)
+        if kind == "range":
+            field, spec = next(iter(body.items()))
+            val = src.get(field)
+            if val is None:
+                return False
+            for op, bound in spec.items():
+                if op == "gt" and not val > bound:
+                    return False
+                if op == "gte" and not val >= bound:
+                    return False
+                if op == "lt" and not val < bound:
+                    return False
+                if op == "lte" and not val <= bound:
+                    return False
+            return True
+        if kind == "bool":
+            filters = body.get("filter", [])
+            if isinstance(filters, dict):
+                filters = [filters]
+            if not all(FakeElastic._clause_matches(c, src)
+                       for c in filters):
+                return False
+            should = body.get("should", [])
+            if should and not any(
+                    FakeElastic._clause_matches(c, src)
+                    for c in should):
+                return False
+            return True
+        if kind == "match_all":
+            return True
+        raise ValueError(f"unsupported query clause {kind!r}")
+
+    def _matching(self, idx: str, query: dict) -> list:
+        with self.lock:
+            items = [(doc_id, dict(src))
+                     for (i, doc_id), src in self.docs.items()
+                     if i == idx]
+        return [(doc_id, src) for doc_id, src in items
+                if self._clause_matches(query, src)]
+
+    def _search(self, idx: str, req: Request):
+        self.search_calls += 1
+        b = req.json()
+        hits = self._matching(idx, b.get("query", {"match_all": {}}))
+        for spec in b.get("sort", []):
+            field, order = next(iter(spec.items()))
+            if isinstance(order, dict):
+                order = order.get("order", "asc")
+            hits.sort(key=lambda t: str(t[1].get(field, "")),
+                      reverse=order == "desc")
+        size = int(b.get("size", 10))
+        return 200, {"hits": {"total": {"value": len(hits)},
+                              "hits": [{"_id": d, "_source": s}
+                                       for d, s in hits[:size]]}}
+
+    def _delete_by_query(self, idx: str, req: Request):
+        b = req.json()
+        doomed = self._matching(idx, b.get("query", {}))
+        with self.lock:
+            for doc_id, _src in doomed:
+                self.docs.pop((idx, doc_id), None)
+        return 200, {"deleted": len(doomed)}

@@ -132,3 +132,78 @@ def test_filer_end_to_end_on_redis_store(resp_server, tmp_path):
         vs.stop()
         master.stop()
         c.close()
+
+
+# -- elastic (document-DB archetype; weed/filer/elastic/v7) ---------------
+
+
+@pytest.fixture()
+def es_server():
+    from tests.elastic_fake import FakeElastic
+    es = FakeElastic().start()
+    yield es
+    es.stop()
+
+
+def test_elastic_store_contract(es_server):
+    from seaweedfs_tpu.filer.elastic_store import (ElasticClient,
+                                                   ElasticFilerStore)
+    _exercise_store(
+        ElasticFilerStore(ElasticClient(es_server.address)))
+
+
+def test_elastic_store_listing_pagination(es_server):
+    from seaweedfs_tpu.filer.elastic_store import (ElasticClient,
+                                                   ElasticFilerStore)
+    from seaweedfs_tpu.filer.entry import Entry
+    s = ElasticFilerStore(ElasticClient(es_server.address))
+    for i in range(15):
+        s.insert_entry(Entry(f"/pag/f{i:02d}"))
+    page = s.list_directory_entries("/pag", limit=5)
+    assert [e.name for e in page] == [f"f{i:02d}" for i in range(5)]
+    page = s.list_directory_entries("/pag", start_file="f04",
+                                    limit=5)
+    assert [e.name for e in page] == [f"f{i:02d}"
+                                      for i in range(5, 10)]
+    page = s.list_directory_entries("/pag", start_file="f04",
+                                    include_start=True, limit=3)
+    assert page[0].name == "f04"
+    page = s.list_directory_entries("/pag", prefix="f1")
+    assert [e.name for e in page] == [f"f1{i}" for i in range(5)]
+    # recursive children wipe
+    s.insert_entry(Entry("/pag/sub", is_directory=True))
+    s.insert_entry(Entry("/pag/sub/deep.txt"))
+    s.delete_folder_children("/pag")
+    assert s.list_directory_entries("/pag") == []
+    assert s.find_entry("/pag/sub/deep.txt") is None
+
+
+def test_filer_end_to_end_on_elastic_store(es_server, tmp_path):
+    """A live filer (HTTP surface) running on the elastic store."""
+    from seaweedfs_tpu.filer import Filer
+    from seaweedfs_tpu.filer.elastic_store import (ElasticClient,
+                                                   ElasticFilerStore)
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    master = MasterServer(volume_size_limit_mb=16).start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url,
+                      pulse_seconds=0.2).start()
+    try:
+        time.sleep(0.4)
+        f = Filer(master.url,
+                  ElasticFilerStore(ElasticClient(es_server.address)))
+        f.write_file("/docs/hello.txt", b"elastic-backed bytes")
+        assert f.read_file("/docs/hello.txt") == \
+            b"elastic-backed bytes"
+        f.rename("/docs/hello.txt", "/docs/renamed.txt")
+        assert f.find_entry("/docs/hello.txt") is None
+        assert f.read_file("/docs/renamed.txt") == \
+            b"elastic-backed bytes"
+        names = [e.name for e in f.list_directory("/docs")]
+        assert names == ["renamed.txt"]
+        f.delete_entry("/docs/renamed.txt")
+        assert f.find_entry("/docs/renamed.txt") is None
+    finally:
+        vs.stop()
+        master.stop()

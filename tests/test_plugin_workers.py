@@ -326,3 +326,52 @@ def test_config_reaches_worker_detection(harness):
     while time.time() < deadline and h.fullness_ratio != 0.123:
         time.sleep(0.1)
     assert h.fullness_ratio == 0.123
+
+
+def test_admin_multi_page_ui_and_config_forms(harness):
+    """Round 5: the admin UI grows pages (volumes/ec/jobs/config —
+    weed/admin/view/app roles) and schema-driven config FORMS whose
+    submissions run the same validation as the JSON API."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+    master, servers, admin, worker = harness
+    from seaweedfs_tpu import operation
+    a = operation.assign(master.url)
+    operation.upload(a.url, a.fid, b"ui-visible")
+    time.sleep(0.6)
+    base = f"http://{admin.url}"
+    with urllib.request.urlopen(f"{base}/ui/volumes",
+                                timeout=10) as r:
+        html = r.read().decode()
+    vid = a.fid.split(",")[0]
+    assert f"<td>{vid}</td>" in html and "garbage" in html
+    with urllib.request.urlopen(f"{base}/ui/ec", timeout=10) as r:
+        assert "EC volumes" in r.read().decode()
+    with urllib.request.urlopen(f"{base}/ui/jobs", timeout=10) as r:
+        assert "filter:" in r.read().decode()
+    # config page renders the worker's schema as a form
+    with urllib.request.urlopen(f"{base}/ui/config", timeout=10) as r:
+        html = r.read().decode()
+    assert "erasure_coding" in html and "<form" in html
+    # submit a value through the FORM path; it lands in the store
+    field = admin.schemas["erasure_coding"][0]["name"]
+    data = urllib.parse.urlencode(
+        {"jobType": "erasure_coding", field: "123"}).encode()
+    req = urllib.request.Request(f"{base}/ui/config", data=data,
+                                 method="POST")
+    try:
+        urllib.request.urlopen(req, timeout=10)
+    except urllib.error.HTTPError as e:
+        assert e.code in (302, 303), e.read()
+    assert float(admin.config["erasure_coding"][field]) == 123
+    # bad job type through the form: validation error page, no crash
+    data = urllib.parse.urlencode(
+        {"jobType": "nope", "x": "1"}).encode()
+    req = urllib.request.Request(f"{base}/ui/config", data=data,
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert "error" in r.read().decode().lower()
+    except urllib.error.HTTPError as e:
+        assert e.code in (400, 404)
