@@ -229,6 +229,10 @@ def main(argv: list[str] | None = None) -> int:
     kgw.add_argument("-ip", default="127.0.0.1")
     kgw.add_argument("-port", type=int, default=9092)
     kgw.add_argument("-broker", default="127.0.0.1:17777")
+    kgw.add_argument("-users", default="",
+                     help="SASL/PLAIN credentials user:pass[,u2:p2] "
+                          "— when set, clients must authenticate "
+                          "before any data API")
 
     fsync = sub.add_parser(
         "filer.sync", help="continuously replicate one filer's "
@@ -388,7 +392,8 @@ def main(argv: list[str] | None = None) -> int:
     sc = sub.add_parser("scaffold", help="print a commented template "
                         "config (command/scaffold)")
     sc.add_argument("-config", default="security",
-                    choices=["security"],
+                    choices=["security", "filer", "notification",
+                             "replication"],
                     help="which template to print")
 
     up = sub.add_parser("upload", help="upload a file")
@@ -680,9 +685,22 @@ def main(argv: list[str] | None = None) -> int:
         _wait()
     elif args.cmd == "mq.kafka":
         from .mq.kafka_gateway import KafkaGateway
-        gw = KafkaGateway(args.broker, args.ip, args.port).start()
+        users = None
+        if args.users:
+            entries = [u for u in args.users.split(",") if u]
+            bad = [u for u in entries if ":" not in u]
+            if bad or not entries:
+                # an operator who ASKED for auth must never get an
+                # open gateway because of a typo'd separator
+                p.error(f"-users: malformed credential(s) "
+                        f"{bad or args.users!r} (want user:pass"
+                        f"[,user2:pass2])")
+            users = dict(u.split(":", 1) for u in entries)
+        gw = KafkaGateway(args.broker, args.ip, args.port,
+                          users=users).start()
         print(f"kafka gateway on {args.ip}:{gw.port} over broker "
-              f"{args.broker}")
+              f"{args.broker}" +
+              (" (SASL/PLAIN required)" if users else ""))
         _wait()
     elif args.cmd == "filer.sync":
         from .filer.filer_sync import FilerSync
@@ -852,6 +870,87 @@ def main(argv: list[str] | None = None) -> int:
         print("enable via security.toml:\n[tls]\n"
               f'ca = "{paths["ca"]}"\ncert = "{paths["cert"]}"\n'
               f'key = "{paths["key"]}"\nmtls = true')
+    elif args.cmd == "scaffold" and args.config == "filer":
+        # command/scaffold/filer.toml shape (util/config.py
+        # filer_store_from_toml reads the enabled section)
+        print("""\
+# filer.toml — place in ./, ~/.seaweedfs/, or /etc/seaweedfs/
+# the first ENABLED section picks the filer's metadata store
+# (command/scaffold/filer.toml layout; archetype mapping in
+# seaweedfs_tpu/util/config.py)
+
+[sqlite]
+enabled = true
+dbFile = "filer.db"           # or ":memory:"
+
+[leveldb2]
+# embedded ordered-KV (our LSM store — the reference's default)
+enabled = false
+dir = "./filerldb2"
+
+[redis2]
+# any RESP2 server (hand-rolled client, filer/redis_store.py)
+enabled = false
+address = "localhost:6379"
+
+[elastic7]
+# any ES-wire JSON-HTTP server (filer/elastic_store.py)
+enabled = false
+servers = ["http://localhost:9200"]""")
+    elif args.cmd == "scaffold" and args.config == "notification":
+        print("""\
+# notification.toml — metadata-event publishing
+# (command/scaffold/notification.toml layout; the first enabled
+# sink becomes the filer's -notification spec)
+
+[notification.webhook]
+enabled = false
+url = "http://localhost:9000/events"
+
+[notification.kafka]
+enabled = false
+hosts = ["localhost:9092"]
+topic = "seaweedfs_meta"
+
+[notification.log]
+enabled = false
+path = "filer_events.log"
+
+[notification.mq]
+enabled = false
+broker = "localhost:17777"
+namespace = "notifications"
+topic = "filer_meta"\
+""")
+    elif args.cmd == "scaffold" and args.config == "replication":
+        print("""\
+# replication.toml — filer.backup sink selection
+# (command/scaffold/replication.toml layout; the first enabled
+# [sink.*] section drives filer.backup)
+
+[sink.local]
+enabled = false
+directory = "/backup"
+
+[sink.s3]
+enabled = false
+endpoint = "localhost:8333"
+bucket = "backup"
+aws_access_key_id = ""
+aws_secret_access_key = ""
+
+[sink.gcs]
+enabled = false
+bucket = "backup"
+
+[sink.azure]
+enabled = false
+container = "backup"
+
+[sink.backblaze]
+enabled = false
+bucket = "backup"\
+""")
     elif args.cmd == "scaffold":
         # command/scaffold/security.toml layout (keys match
         # util/config.go:34 LoadSecurityConfiguration)

@@ -50,11 +50,29 @@ def encode_produce_batch(records: "list[tuple[bytes | None, bytes]]",
 
 class KafkaClient:
     def __init__(self, host: str, port: int,
-                 client_id: str = "seaweedfs-tpu-test"):
+                 client_id: str = "seaweedfs-tpu-test",
+                 username: str = "", password: str = ""):
         self.sock = socket.create_connection((host, port), timeout=30)
         self.client_id = client_id
         self._corr = 0
         self._lock = threading.Lock()
+        if username:
+            self.sasl_plain(username, password)
+
+    def sasl_plain(self, username: str, password: str) -> None:
+        """SaslHandshake(17) + SaslAuthenticate(36) with RFC 4616
+        PLAIN tokens (the framed flow modern brokers use)."""
+        r = self._rpc(17, 1, enc_string("PLAIN"))
+        code = r.i16()
+        if code:
+            raise KafkaError(code, "SaslHandshake")
+        token = b"\x00" + username.encode() + b"\x00" + \
+            password.encode()
+        r = self._rpc(36, 1, enc_bytes(token))
+        code = r.i16()
+        msg = r.string()
+        if code:
+            raise KafkaError(code, f"SaslAuthenticate: {msg}")
 
     def close(self):
         self.sock.close()

@@ -67,7 +67,10 @@ API_VERSIONS = {
     18: (0, 2),   # ApiVersions (v1 +throttle)
     19: (0, 2),   # CreateTopics (v1 +validate_only, v2 +throttle)
     20: (0, 1),   # DeleteTopics (v1 +throttle)
+    17: (1, 1),   # SaslHandshake (v1 = framed authenticate flow
+                  #   only; v0's raw-token exchange is not spoken)
     22: (0, 1),   # InitProducerId (idempotent-producer bootstrap)
+    36: (0, 1),   # SaslAuthenticate (framed PLAIN)
     32: (0, 1),   # DescribeConfigs (v1 +include_synonyms/sources)
     37: (0, 1),   # CreatePartitions (v1 same wire, bumped for parity)
     42: (0, 1),   # DeleteGroups (v1 +throttle)
@@ -76,11 +79,19 @@ API_VERSIONS = {
 GROUP_ID_NOT_FOUND = 69
 NON_EMPTY_GROUP = 68
 COORDINATOR_NOT_AVAILABLE = 15
+UNSUPPORTED_SASL_MECHANISM = 33
+SASL_AUTHENTICATION_FAILED = 58
 
 
 class KafkaGateway:
     def __init__(self, broker: str, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0,
+                 users: "dict[str, str] | None" = None):
+        # SASL/PLAIN credential map (mq/kafka gateway auth role):
+        # when set, every connection must SaslHandshake +
+        # SaslAuthenticate before any data API (ApiVersions is
+        # allowed pre-auth, as real brokers permit for negotiation)
+        self.users = users
         self.mq = MQClient(broker)
         self.host = host
         self.port = port
@@ -123,6 +134,8 @@ class KafkaGateway:
         try:
             conn.settimeout(120)
             buf = b""
+            authed = self.users is None
+            sasl_state = {"mechanism": ""}
             while True:
                 while len(buf) < 4:
                     chunk = conn.recv(65536)
@@ -138,6 +151,15 @@ class KafkaGateway:
                         return
                     buf += chunk
                 frame, buf = buf[4:4 + size], buf[4 + size:]
+                if not authed:
+                    resp, authed, close = self._handle_preauth(
+                        frame, sasl_state)
+                    if resp is None:
+                        return          # unauthenticated data API
+                    conn.sendall(struct.pack(">i", len(resp)) + resp)
+                    if close:
+                        return          # failed auth: drop the conn
+                    continue
                 resp = self._handle_frame(frame)
                 if resp is not None:
                     conn.sendall(struct.pack(">i", len(resp)) + resp)
@@ -148,6 +170,69 @@ class KafkaGateway:
                 conn.close()
             except OSError:
                 pass
+
+    def _handle_preauth(self, frame: bytes, state: dict
+                        ) -> "tuple[bytes | None, bool, bool]":
+        """Pre-auth gate (SASL listener semantics): serve ApiVersions
+        (18), SaslHandshake (17) and SaslAuthenticate (36); close the
+        connection on anything else — a real broker's SASL port does
+        the same rather than leak an unauthenticated data plane.
+        Returns (response, now_authenticated, close_after_send)."""
+        r = Reader(frame)
+        api_key = r.i16()
+        api_version = r.i16()
+        correlation_id = r.i32()
+        r.string()                       # client_id
+        header = enc_i32(correlation_id)
+        # the SAME version-range gate the authed dispatch applies:
+        # without it a v3+ flexible-encoding ApiVersions request
+        # would get a non-flexible body it cannot parse
+        lo_hi = API_VERSIONS.get(api_key)
+        if api_key in (17, 18, 36) and (
+                lo_hi is None or
+                not lo_hi[0] <= api_version <= lo_hi[1]):
+            if api_key == 18:
+                return (header + enc_i16(UNSUPPORTED_VERSION) +
+                        enc_i32(0), False, False)
+            return (header + enc_i16(UNSUPPORTED_VERSION),
+                    False, False)
+        if api_key == 18:
+            return (header + self._api_versions(r, api_version),
+                    False, False)
+        if api_key == 17:
+            mech = r.string() or ""
+            if mech.upper() != "PLAIN":
+                return (header +
+                        enc_i16(UNSUPPORTED_SASL_MECHANISM) +
+                        enc_array([enc_string("PLAIN")]),
+                        False, False)
+            state["mechanism"] = "PLAIN"
+            return (header + enc_i16(NONE) +
+                    enc_array([enc_string("PLAIN")]), False, False)
+        if api_key == 36 and state.get("mechanism") == "PLAIN":
+            auth = r.bytes_() or b""
+            # RFC 4616: [authzid] \0 authcid \0 passwd
+            parts = auth.split(b"\x00")
+            ok = False
+            if len(parts) == 3:
+                user = parts[1].decode("utf-8", "replace")
+                pw = parts[2].decode("utf-8", "replace")
+                ok = self.users.get(user) == pw
+            if not ok:
+                # answer, then DROP the connection: keeping it open
+                # would hand an attacker free in-connection password
+                # retries (real brokers close on auth failure too)
+                return (header +
+                        enc_i16(SASL_AUTHENTICATION_FAILED) +
+                        enc_string("authentication failed") +
+                        enc_bytes(b"") +
+                        (enc_i64(0) if api_version >= 1 else b""),
+                        False, True)
+            return (header + enc_i16(NONE) + enc_string(None) +
+                    enc_bytes(b"") +
+                    (enc_i64(0) if api_version >= 1 else b""),
+                    True, False)
+        return None, False, True         # close: unauthenticated
 
     def _handle_frame(self, frame: bytes) -> "bytes | None":
         r = Reader(frame)
@@ -164,6 +249,17 @@ class KafkaGateway:
                 return header + enc_i16(UNSUPPORTED_VERSION) + \
                     enc_i32(0)
             return header + enc_i16(UNSUPPORTED_VERSION)
+        if api_key == 17:
+            mech = r.string() or ""
+            code = NONE if mech.upper() == "PLAIN" or \
+                self.users is None else UNSUPPORTED_SASL_MECHANISM
+            return header + enc_i16(code) + \
+                enc_array([enc_string("PLAIN")])
+        if api_key == 36:
+            r.bytes_()
+            return (header + enc_i16(NONE) + enc_string(None) +
+                    enc_bytes(b"") +
+                    (enc_i64(0) if api_version >= 1 else b""))
         fn = {0: self._produce, 1: self._fetch, 2: self._list_offsets,
               3: self._metadata, 8: self._offset_commit,
               9: self._offset_fetch, 10: self._find_coordinator,

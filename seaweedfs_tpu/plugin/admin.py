@@ -544,12 +544,13 @@ input{{margin:2px}}</style></head><body>
         with self.lock:
             jobs = sorted(self.jobs.values(),
                           key=lambda j: -j.created)
+        # counts from the SAME snapshot the table renders, so the
+        # filter totals can never disagree with the rows
+        counts: dict[str, int] = {}
+        for j in jobs:
+            counts[j.status] = counts.get(j.status, 0) + 1
         if want:
             jobs = [j for j in jobs if j.status == want]
-        counts: dict[str, int] = {}
-        with self.lock:
-            for j in self.jobs.values():
-                counts[j.status] = counts.get(j.status, 0) + 1
         filters = " | ".join(
             f"<a href='/ui/jobs?status={s}'>{s} ({n})</a>"
             for s, n in sorted(counts.items()))

@@ -378,10 +378,19 @@ class _Acc:
             except ValueError:
                 if self.fn in ("sum", "avg"):
                     return
-        self.count += 1
-        if self.fn in ("sum", "avg") and \
-                isinstance(val, (int, float)):
+        if self.fn in ("sum", "avg"):
+            # only genuine numbers feed the divisor — a dict/list/
+            # bool incrementing count would skew AVG
+            if isinstance(val, bool) or \
+                    not isinstance(val, (int, float)):
+                return
+            self.count += 1
             self.total += val
+            return
+        if not isinstance(val, (str, int, float)) or \
+                isinstance(val, bool):
+            return                       # unorderable for MIN/MAX
+        self.count += 1
         try:
             if self.min is None or val < self.min:
                 self.min = val

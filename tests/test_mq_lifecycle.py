@@ -245,3 +245,44 @@ def test_init_producer_id_and_delete_groups(stack):
     client.offset_commit("dg-group", "dgtopic", 0, 1)
     res = client.delete_groups(["dg-group"])
     assert res["dg-group"] == 0
+
+
+def test_sasl_plain_gateway(stack):
+    """SaslHandshake(17)/SaslAuthenticate(36): an authed gateway
+    serves only ApiVersions pre-auth, rejects bad credentials and
+    non-PLAIN mechanisms, and works normally after PLAIN auth."""
+    import socket as _socket
+    client, gw_open, broker, filer = stack
+    from seaweedfs_tpu.mq.kafka_client import KafkaError
+    gw = KafkaGateway(broker.url,
+                      users={"svc": "hunter2"}).start()
+    try:
+        # pre-auth: data APIs get the connection closed
+        kc = KafkaClient("127.0.0.1", gw.port)
+        with pytest.raises(OSError):
+            kc.metadata([])
+        kc.close()
+        # ApiVersions is allowed pre-auth (negotiation)
+        kc = KafkaClient("127.0.0.1", gw.port)
+        assert kc.api_versions()
+        # bad password refused with SASL_AUTHENTICATION_FAILED
+        with pytest.raises(KafkaError) as ei:
+            kc.sasl_plain("svc", "wrong")
+        assert ei.value.code == 58
+        kc.close()
+        # unsupported mechanism refused on handshake
+        kc = KafkaClient("127.0.0.1", gw.port)
+        from seaweedfs_tpu.mq.kafka_wire import enc_string
+        r = kc._rpc(17, 1, enc_string("SCRAM-SHA-256"))
+        assert r.i16() == 33          # UNSUPPORTED_SASL_MECHANISM
+        kc.close()
+        # the real flow: handshake + authenticate + use the API
+        kc = KafkaClient("127.0.0.1", gw.port,
+                         username="svc", password="hunter2")
+        assert kc.create_topic("sasl-topic", partitions=1) == 0
+        kc.produce("sasl-topic", 0, [(b"k", b"authed")])
+        recs, _ = kc.fetch("sasl-topic", 0, 0)
+        assert recs and recs[0]["value"] == b"authed"
+        kc.close()
+    finally:
+        gw.stop()

@@ -341,3 +341,13 @@ def test_parquet_fastpath_respects_offset():
                      data, input_format="parquet") == []
     assert run_query("select count(*) as n from s3object offset 1",
                      b'{"x": 1}\n{"x": 2}') == []
+
+
+def test_avg_ignores_non_numeric_values():
+    """Review r5: dict/bool values must not feed AVG's divisor."""
+    data = (b'{"size": 10}\n{"size": {"v": 2}}\n'
+            b'{"size": true}\n{"size": 20}')
+    out = run_query("select avg(size) as a, count(size) as c "
+                    "from s3object", data)
+    # COUNT counts every non-null value (SQL), AVG only numerics
+    assert out == [{"a": 15.0, "c": 4}]
