@@ -57,7 +57,14 @@ class KafkaClient:
         self._corr = 0
         self._lock = threading.Lock()
         if username:
-            self.sasl_plain(username, password)
+            try:
+                self.sasl_plain(username, password)
+            except BaseException:
+                # the constructor raising means no object escapes:
+                # close the socket here or every failed-auth retry
+                # leaks a file descriptor
+                self.sock.close()
+                raise
 
     def sasl_plain(self, username: str, password: str) -> None:
         """SaslHandshake(17) + SaslAuthenticate(36) with RFC 4616

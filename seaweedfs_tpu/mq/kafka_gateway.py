@@ -215,9 +215,14 @@ class KafkaGateway:
             parts = auth.split(b"\x00")
             ok = False
             if len(parts) == 3:
+                import hmac as _hmac
                 user = parts[1].decode("utf-8", "replace")
                 pw = parts[2].decode("utf-8", "replace")
-                ok = self.users.get(user) == pw
+                # constant-time compare: == would leak a prefix
+                # timing side channel on a network-facing auth path
+                ok = _hmac.compare_digest(
+                    self.users.get(user, ""), pw) and \
+                    user in self.users
             if not ok:
                 # answer, then DROP the connection: keeping it open
                 # would hand an attacker free in-connection password

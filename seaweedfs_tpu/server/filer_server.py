@@ -54,11 +54,20 @@ class FilerServer:
         self._notification_spec = notification
         self._notifier = None
         self._lock_peers = lock_peers or []
-        if meta_log_dir is None and store_path != ":memory:":
+        if meta_log_dir is None and store_path != ":memory:" and \
+                store_type in ("sqlite", "lsm"):
             # persist the metadata log beside the store by default —
             # subscribers must survive a filer restart
-            # (filer_notify_append.go)
+            # (filer_notify_append.go).  Only for LOCAL-path stores:
+            # a redis/elastic store_path is a network ADDRESS, and
+            # "host:port.metalog" would litter the working directory
             meta_log_dir = store_path + ".metalog"
+        elif meta_log_dir is None and store_type in ("redis",
+                                                     "elastic"):
+            # per-address uniqueness (two filers on different redis
+            # servers must not interleave one log), path-safe chars
+            safe = store_path.replace(":", "_").replace("/", "_")
+            meta_log_dir = f"filer-{store_type}-{safe}.metalog"
         if store_type == "lsm":
             if store_path == ":memory:":
                 raise ValueError(
