@@ -510,11 +510,12 @@ class WeedFS:
                 ws.truncated = ws.dirty = True
                 return
         # truncate without an open handle: server-side clip/extend,
-        # no whole-file round trip
+        # no whole-file round trip.  Truncate-to-size is idempotent,
+        # so a stale pooled connection may transparently retry
         st, _, _ = http_bytes(
             "POST", f"{self.filer}/__chunk__/" +
             urllib.parse.quote(path).lstrip("/") +
-            f"?truncateTo={length}", b"")
+            f"?truncateTo={length}", b"", {"X-Idempotent": "1"})
         if st == 404:
             raise FuseError(errno.ENOENT)
         if st != 200:

@@ -653,6 +653,16 @@ def _one_pooled_request(method: str, full_url: str, body,
             if attempt == 0 and method in ("GET", "HEAD", "PUT",
                                            "DELETE", "OPTIONS"):
                 continue
+            if attempt == 0 and reused and \
+                    headers.get("X-Idempotent") == "1" and \
+                    isinstance(e, http.client.RemoteDisconnected):
+                # caller DECLARED this request idempotent (e.g. a
+                # truncate-to-size or set-flag POST): a reused socket
+                # that died with zero response bytes is then safe to
+                # re-issue.  Undeclared POSTs still surface the
+                # executed-or-not ambiguity (Go Transport's rule —
+                # blind replay would double-publish MQ messages)
+                continue
             if isinstance(e, OSError):
                 raise
             raise OSError(f"http response failed: {e!r}") from e

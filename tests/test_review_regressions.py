@@ -3,6 +3,8 @@
 import io
 import struct
 
+import pytest
+
 from seaweedfs_tpu.storage import types
 from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.needle_map import NeedleMap
@@ -123,3 +125,92 @@ def test_concurrent_assigns_grow_one_volume_not_n(tmp_path):
     finally:
         vs.stop()
         master.stop()
+
+
+def test_pooled_post_retry_requires_idempotent_marker():
+    """Review r5: a POST whose REUSED keep-alive connection dies with
+    zero response bytes must NOT be blindly replayed (the request may
+    have executed server-side) — unless the caller declared it
+    idempotent via X-Idempotent.  A raw socket server answers the
+    first request per connection and drops the second without a
+    response, forcing the response-phase RemoteDisconnected
+    deterministically."""
+    import socket as _socket
+    import threading as _threading
+    from seaweedfs_tpu.server.httpd import http_bytes
+
+    served = []
+    lsock = _socket.create_server(("127.0.0.1", 0))
+    port = lsock.getsockname()[1]
+    stop = _threading.Event()
+
+    def read_request(conn):
+        data = b""
+        while b"\r\n\r\n" not in data:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return None
+            data += chunk
+        head, _, rest = data.partition(b"\r\n\r\n")
+        length = 0
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                length = int(line.split(b":")[1])
+        while len(rest) < length:
+            rest += conn.recv(65536)
+        return head.split(b" ")[1].decode()
+
+    def serve():
+        while not stop.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            def one(conn=conn):
+                try:
+                    # first request on this connection: answer 200
+                    path = read_request(conn)
+                    if path is None:
+                        return
+                    served.append(path)
+                    conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                                 b"Content-Length: 2\r\n"
+                                 b"Content-Type: text/plain\r\n"
+                                 b"\r\nok")
+                    # second request: read it fully, then DROP the
+                    # connection without any response bytes
+                    path = read_request(conn)
+                    if path is not None:
+                        served.append(path + ":dropped")
+                finally:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+            _threading.Thread(target=one, daemon=True).start()
+    _threading.Thread(target=serve, daemon=True).start()
+
+    try:
+        st, body, _ = http_bytes("POST",
+                                 f"http://127.0.0.1:{port}/a", b"x")
+        assert st == 200 and body == b"ok"
+        # undeclared POST on the now-poisoned pooled connection: the
+        # executed-or-not ambiguity must surface, not replay
+        with pytest.raises(OSError):
+            http_bytes("POST", f"http://127.0.0.1:{port}/b", b"x")
+        assert "/b:dropped" in served and \
+            served.count("/b") == 0, served
+        # re-pool a fresh connection, poison it again
+        st, _, _ = http_bytes("POST",
+                              f"http://127.0.0.1:{port}/c", b"x")
+        assert st == 200
+        # declared-idempotent POST: transparently retried on a fresh
+        # connection after the drop
+        st, body, _ = http_bytes("POST",
+                                 f"http://127.0.0.1:{port}/d", b"x",
+                                 {"X-Idempotent": "1"})
+        assert st == 200 and body == b"ok"
+        assert "/d:dropped" in served and "/d" in served, served
+    finally:
+        stop.set()
+        lsock.close()
