@@ -21,25 +21,46 @@ _lib = None
 _tried = False
 
 
+
+
+def _build_if_stale(src_path: str, out_path: str,
+                    extra_flags: "list[str] | None" = None,
+                    shared: bool = True,
+                    try_march_native: bool = False) -> "str | None":
+    """Shared mtime-keyed g++ build (one implementation for all three
+    native artifacts): makedirs, staleness check, per-pid scratch so
+    concurrent builders never publish half-written output, atomic
+    publish.  None when the toolchain is unavailable."""
+    try:
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        if os.path.exists(out_path) and \
+                os.path.getmtime(out_path) >= \
+                os.path.getmtime(src_path):
+            return out_path
+        tmp = f"{out_path}.{os.getpid()}.tmp"
+        base = ["g++", "-O2", "-std=c++17"]
+        if shared:
+            base += ["-shared", "-fPIC", "-pthread"]
+        attempts = ([["-march=native"], []] if try_march_native
+                    else [[]])
+        for march in attempts:
+            try:
+                subprocess.run(
+                    base + march + (extra_flags or []) +
+                    [src_path, "-o", tmp],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, out_path)
+                return out_path
+            except (OSError, subprocess.SubprocessError):
+                continue
+        return None
+    except OSError:
+        return None
+
+
 def _build() -> str | None:
-    os.makedirs(os.path.dirname(_SO), exist_ok=True)
-    if os.path.exists(_SO) and \
-            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return _SO
-    # per-pid scratch name: concurrent builders (several servers in one
-    # box) must not publish each other's half-written output
-    tmp = f"{_SO}.{os.getpid()}.tmp"
-    for flags in (["-march=native"], []):
-        try:
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                 "-pthread", *flags, _SRC, "-o", tmp],
-                check=True, capture_output=True, timeout=120)
-            os.replace(tmp, _SO)
-            return _SO
-        except (OSError, subprocess.SubprocessError):
-            continue
-    return None
+    return _build_if_stale(_SRC, _SO, extra_flags=["-O3"],
+                           try_march_native=True)
 
 
 def load() -> "ctypes.CDLL | None":
@@ -94,16 +115,8 @@ def load_read_plane() -> "ctypes.CDLL | None":
             return _rp_lib
         _rp_tried = True
         try:
-            os.makedirs(os.path.dirname(_RP_SO), exist_ok=True)
-            if not (os.path.exists(_RP_SO) and
-                    os.path.getmtime(_RP_SO) >=
-                    os.path.getmtime(_RP_SRC)):
-                tmp = f"{_RP_SO}.{os.getpid()}.tmp"
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                     "-pthread", _RP_SRC, "-o", tmp],
-                    check=True, capture_output=True, timeout=120)
-                os.replace(tmp, _RP_SO)
+            if _build_if_stale(_RP_SRC, _RP_SO) is None:
+                return None
             lib = ctypes.CDLL(_RP_SO)
             lib.rp_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                      ctypes.POINTER(ctypes.c_int)]
@@ -126,3 +139,16 @@ def load_read_plane() -> "ctypes.CDLL | None":
             return None
         _rp_lib = lib
         return _rp_lib
+
+
+_VT_SRC = os.path.join(os.path.dirname(__file__), "volume_tool.cc")
+_VT_BIN = os.path.join(_DIR, "_build", "volume_tool")
+
+
+def build_volume_tool() -> "str | None":
+    """Build (if stale) the standalone C++ volume codec tool — the
+    second implementation of the .dat/.idx storage surface (N1
+    cross-impl parity role).  Returns the binary path or None when
+    the toolchain is unavailable."""
+    with _lock:
+        return _build_if_stale(_VT_SRC, _VT_BIN, shared=False)

@@ -1,0 +1,274 @@
+// volume_tool — SECOND IMPLEMENTATION of the needle volume codec
+// (the N1 cross-impl role: the reference validates its Rust volume
+// server against the Go one through a shared parity rig,
+// test/volume_server/framework/cluster_rust.go; here an independent
+// C++ implementation of the .dat/.idx storage surface is validated
+// byte-for-byte against the Python engine).
+//
+// Formats reproduced from the reference (and matched bit-for-bit by
+// tests/test_native_volume_tool.py against storage/needle.py):
+//   superblock  8B: version, rp byte, ttl(2), compaction rev u16 BE,
+//               extra-size u16 BE (weed/storage/super_block)
+//   needle v2/v3 (data records, flags=0):
+//               cookie u32 | id u64 | size u32 (all BE)
+//               [dataSize u32 | data | flags u8]   (when size > 0)
+//               crc32c(data) u32 | [appendAtNs u64 in v3]
+//               stale-buffer padding quirk (needle_write_v2.go):
+//               ALWAYS 1..8 bytes — v3 re-exposes the BE size field
+//               then zeros; v2 re-exposes header[4:12] (the BE id)
+//   tombstone:  size==0 record (no body), crc32c("")=0 footer
+//   .idx entry 16B: id u64 | storedOffset u32 (bytes/8) | size i32
+//               (tombstone rows: offset 0, size -1)
+//
+// Commands (TSV in/out; no JSON dependency):
+//   create <dat> <idx> <version>      manifest on stdin:
+//       w \t id \t cookie \t appendAtNs \t base64(data)
+//       d \t id \t cookie \t appendAtNs
+//   scan <dat>                        records on stdout:
+//       off \t id \t cookie \t size \t crc_ok \t appendAtNs \t kind
+//
+// Build: g++ -O2 -o volume_tool volume_tool.cc
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+#include <string>
+#include <vector>
+
+namespace {
+
+// -- crc32c (reflected Castagnoli 0x82F63B78; matches storage/crc.py)
+uint32_t crc_table[256];
+void crc_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+    crc_table[i] = c;
+  }
+}
+uint32_t crc32c(const uint8_t* p, size_t n, uint32_t value = 0) {
+  uint32_t c = value ^ 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++)
+    c = crc_table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// -- big-endian writers
+void put32(std::string& out, uint32_t v) {
+  for (int i = 3; i >= 0; i--) out.push_back(char((v >> (8 * i)) & 0xFF));
+}
+void put64(std::string& out, uint64_t v) {
+  for (int i = 7; i >= 0; i--) out.push_back(char((v >> (8 * i)) & 0xFF));
+}
+uint32_t get32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+uint64_t get64(const uint8_t* p) {
+  return (uint64_t(get32(p)) << 32) | get32(p + 4);
+}
+
+// -- base64 (standard alphabet, for the manifest payloads)
+int b64val(char c) {
+  if (c >= 'A' && c <= 'Z') return c - 'A';
+  if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+  if (c >= '0' && c <= '9') return c - '0' + 52;
+  if (c == '+') return 62;
+  if (c == '/') return 63;
+  return -1;
+}
+std::string b64decode(const std::string& s) {
+  std::string out;
+  int buf = 0, bits = 0;
+  for (char c : s) {
+    if (c == '=' || c == '\n' || c == '\r') continue;
+    int v = b64val(c);
+    if (v < 0) continue;
+    buf = (buf << 6) | v;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out.push_back(char((buf >> bits) & 0xFF));
+    }
+  }
+  return out;
+}
+
+constexpr int kHeader = 16, kPad = 8, kCrc = 4, kTs = 8;
+
+int padding_length(uint32_t size, int version) {
+  int footer = kCrc + (version == 3 ? kTs : 0);
+  return kPad - ((kHeader + int(size) + footer) % kPad);
+}
+
+// serialize one data/tombstone needle exactly like Needle.to_bytes
+// (flags=0 path; the stale-padding quirk included)
+std::string encode_needle(int version, uint64_t id, uint32_t cookie,
+                          uint64_t append_at_ns,
+                          const std::string& data) {
+  std::string out;
+  uint32_t size = data.empty() ? 0 : uint32_t(4 + data.size() + 1);
+  put32(out, cookie);
+  put64(out, id);
+  put32(out, size);
+  if (!data.empty()) {
+    put32(out, uint32_t(data.size()));
+    out += data;
+    out.push_back(0);  // flags
+  }
+  put32(out, crc32c((const uint8_t*)data.data(), data.size()));
+  if (version == 3) put64(out, append_at_ns);
+  int pad = padding_length(size, version);
+  // stale-scratch padding (needle_write_v2.go bit-identity quirk):
+  // v3 re-exposes the BE size field then zeros; v2 re-exposes the
+  // BE needle id (no LastModified in the flags=0 path)
+  std::string stale;
+  if (version == 3) {
+    put32(stale, size);
+    stale.append(4, '\0');
+  } else {
+    put64(stale, id);
+  }
+  out += stale.substr(0, size_t(pad));
+  return out;
+}
+
+std::string idx_entry(uint64_t id, uint32_t stored_offset,
+                      int32_t size) {
+  std::string out;
+  put64(out, id);
+  put32(out, stored_offset);
+  put32(out, uint32_t(size));
+  return out;
+}
+
+std::vector<std::string> split_tabs(const std::string& line) {
+  std::vector<std::string> out;
+  size_t start = 0;
+  for (size_t i = 0; i <= line.size(); i++) {
+    if (i == line.size() || line[i] == '\t') {
+      out.push_back(line.substr(start, i - start));
+      start = i + 1;
+    }
+  }
+  return out;
+}
+
+int cmd_create(const char* dat_path, const char* idx_path,
+               int version) {
+  FILE* dat = fopen(dat_path, "wb");
+  FILE* idx = fopen(idx_path, "wb");
+  if (!dat || !idx) {
+    fprintf(stderr, "cannot open output files\n");
+    return 1;
+  }
+  // superblock: version, rp=000, ttl=0, compaction rev 0, no extra
+  unsigned char sb[8] = {(unsigned char)version, 0, 0, 0, 0, 0, 0, 0};
+  fwrite(sb, 1, 8, dat);
+  long offset = 8;
+  std::string line;
+  // std::getline grows without bound — fgets with a fixed buffer
+  // would silently SPLIT long payload lines and write a truncated
+  // needle before erroring
+  while (std::getline(std::cin, line)) {
+    while (!line.empty() &&
+           (line.back() == '\n' || line.back() == '\r'))
+      line.pop_back();
+    if (line.empty()) continue;
+    auto f = split_tabs(line);
+    if (f.size() < 4) {
+      fprintf(stderr, "bad manifest line: %s\n", line.c_str());
+      return 1;
+    }
+    uint64_t id = strtoull(f[1].c_str(), nullptr, 10);
+    uint32_t cookie = uint32_t(strtoul(f[2].c_str(), nullptr, 10));
+    uint64_t ts = strtoull(f[3].c_str(), nullptr, 10);
+    if (f[0] == "w") {
+      std::string data = b64decode(f.size() > 4 ? f[4] : "");
+      std::string rec = encode_needle(version, id, cookie, ts, data);
+      fwrite(rec.data(), 1, rec.size(), dat);
+      if (!data.empty()) {
+        // Python's write_needle gates nm.put on size_is_valid:
+        // a zero-byte blob appends a dat record but NO idx row
+        uint32_t size = uint32_t(4 + data.size() + 1);
+        std::string ie = idx_entry(id, uint32_t(offset / kPad),
+                                   int32_t(size));
+        fwrite(ie.data(), 1, ie.size(), idx);
+      }
+      offset += long(rec.size());
+    } else if (f[0] == "d") {
+      // tombstone: zero-data record + idx row (offset 0, size -1)
+      std::string rec = encode_needle(version, id, cookie, ts, "");
+      fwrite(rec.data(), 1, rec.size(), dat);
+      std::string ie = idx_entry(id, 0, -1);
+      fwrite(ie.data(), 1, ie.size(), idx);
+      offset += long(rec.size());
+    } else {
+      fprintf(stderr, "bad op %s\n", f[0].c_str());
+      return 1;
+    }
+  }
+  fclose(dat);
+  fclose(idx);
+  return 0;
+}
+
+int cmd_scan(const char* dat_path) {
+  FILE* dat = fopen(dat_path, "rb");
+  if (!dat) {
+    fprintf(stderr, "cannot open %s\n", dat_path);
+    return 1;
+  }
+  unsigned char sb[8];
+  if (fread(sb, 1, 8, dat) != 8) return 1;
+  int version = sb[0];
+  uint16_t extra = (uint16_t(sb[6]) << 8) | sb[7];
+  fseek(dat, long(extra), SEEK_CUR);
+  long offset = 8 + long(extra);
+  std::vector<uint8_t> rec;
+  for (;;) {
+    uint8_t header[kHeader];
+    if (fread(header, 1, kHeader, dat) != kHeader) break;
+    uint32_t cookie = get32(header);
+    uint64_t id = get64(header + 4);
+    uint32_t size = get32(header + 12);
+    int body = int(size) + kCrc + (version == 3 ? kTs : 0) +
+               padding_length(size, version);
+    rec.resize(size_t(body));
+    if (fread(rec.data(), 1, size_t(body), dat) != size_t(body))
+      break;
+    uint32_t want_crc = get32(rec.data() + size);
+    uint64_t ts = version == 3 ? get64(rec.data() + size + kCrc) : 0;
+    const char* kind = size == 0 ? "tombstone" : "write";
+    bool crc_ok;
+    if (size == 0) {
+      crc_ok = want_crc == 0;
+    } else {
+      uint32_t data_size = get32(rec.data());
+      crc_ok = data_size + 5 == size &&
+               crc32c(rec.data() + 4, data_size) == want_crc;
+    }
+    printf("%ld\t%llu\t%u\t%u\t%d\t%llu\t%s\n", offset,
+           (unsigned long long)id, cookie, size, crc_ok ? 1 : 0,
+           (unsigned long long)ts, kind);
+    offset += kHeader + body;
+  }
+  fclose(dat);
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  crc_init();
+  if (argc >= 5 && strcmp(argv[1], "create") == 0)
+    return cmd_create(argv[2], argv[3], atoi(argv[4]));
+  if (argc >= 3 && strcmp(argv[1], "scan") == 0)
+    return cmd_scan(argv[2]);
+  fprintf(stderr,
+          "usage: volume_tool create <dat> <idx> <version> "
+          "< manifest.tsv\n       volume_tool scan <dat>\n");
+  return 2;
+}
