@@ -968,9 +968,11 @@ expires_after_seconds = 10
 key = ""
 expires_after_seconds = 10
 
-[access]
+[admin]
 # admin-plane key: guards /admin/*, raft, heartbeat, grow, lock
-admin_key = ""
+key = ""
+
+[access]
 # CIDR whitelist for unauthenticated access (empty = no whitelist)
 white_list = []
 

@@ -233,15 +233,23 @@ int cmd_scan(const char* dat_path) {
     if (fread(header, 1, kHeader, dat) != kHeader) break;
     uint32_t cookie = get32(header);
     uint64_t id = get64(header + 4);
-    uint32_t size = get32(header + 12);
-    int body = int(size) + kCrc + (version == 3 ? kTs : 0) +
-               padding_length(size, version);
+    uint32_t raw_size = get32(header + 12);
+    // high-bit sizes mark in-place deletions in the reference
+    // format (types.size_is_deleted / 0x80000000): the record body
+    // length uses the LOW 31 bits — treating the raw u32 as signed
+    // int would go negative and blow up the resize
+    bool deleted_mark = (raw_size & 0x80000000u) != 0;
+    uint32_t size = raw_size & 0x7FFFFFFFu;
+    long body = long(size) + kCrc + (version == 3 ? kTs : 0) +
+                padding_length(size, version);
     rec.resize(size_t(body));
     if (fread(rec.data(), 1, size_t(body), dat) != size_t(body))
       break;
     uint32_t want_crc = get32(rec.data() + size);
     uint64_t ts = version == 3 ? get64(rec.data() + size + kCrc) : 0;
-    const char* kind = size == 0 ? "tombstone" : "write";
+    const char* kind = deleted_mark ? "deleted"
+                       : size == 0   ? "tombstone"
+                                     : "write";
     bool crc_ok;
     if (size == 0) {
       crc_ok = want_crc == 0;

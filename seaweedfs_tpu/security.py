@@ -273,7 +273,9 @@ def load_security_toml(path: str) -> SecurityConfig:
         volume_read_key=read.get("key", ""),
         volume_read_expires_sec=int(
             read.get("expires_after_seconds", 60)),
-        admin_key=admin.get("key", ""),
+        # [admin] key is canonical; [access] admin_key is accepted
+        # because an earlier scaffold template printed that spelling
+        admin_key=admin.get("key", "") or access.get("admin_key", ""),
         admin_expires_sec=int(admin.get("expires_after_seconds", 60)),
         white_list=list(access.get("white_list", [])),
     )

@@ -32,6 +32,31 @@ key = ""
 [access]
 ui = false
 """,
+    # read-path tokens too: every GET must carry a read jwt the
+    # volume server validates (security.py read gate)
+    "jwt_read": """
+[jwt.signing]
+key = "proc-matrix-signing-key"
+[jwt.signing.read]
+key = "proc-matrix-read-key"
+""",
+    # admin-plane key: /admin/*, heartbeat, grow, lock are gated
+    "admin": """
+[admin]
+key = "proc-matrix-admin-key"
+""",
+    # mTLS: minted per-cluster PKI — ProcCluster fills in the
+    # certificate paths (the {dir} placeholders) after running the
+    # `cert` CLI; every role serves https and pins the CA
+    "tls": """
+[jwt.signing]
+key = "proc-matrix-signing-key"
+[tls]
+ca = "{dir}/ca.crt"
+cert = "{dir}/node.crt"
+key = "{dir}/node.key"
+mtls = true
+""",
 }
 
 
@@ -112,9 +137,21 @@ class ProcCluster:
         self.procs: dict[str, Proc] = {}
         sec_args = []
         if PROFILES.get(profile):
+            body = PROFILES[profile]
+            if "{dir}" in body:
+                # mint the cluster PKI through the real CLI (the
+                # `cert` command), then point the toml at it
+                cert_dir = os.path.join(self.tmp, "certs")
+                subprocess.run(
+                    [sys.executable, "-m", "seaweedfs_tpu", "cert",
+                     "-dir", cert_dir, "-hosts", "127.0.0.1"],
+                    check=True, capture_output=True, timeout=120,
+                    cwd=REPO,
+                    env=dict(os.environ, JAX_PLATFORMS="cpu"))
+                body = body.replace("{dir}", cert_dir)
             sec_path = os.path.join(self.tmp, "security.toml")
             with open(sec_path, "w") as f:
-                f.write(PROFILES[profile])
+                f.write(body)
             sec_args = ["-securityToml", sec_path]
         self.sec_args = sec_args
         self.profile = profile

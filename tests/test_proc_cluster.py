@@ -28,7 +28,7 @@ def _wait_writable(c, timeout=30):
     while time.time() < deadline:
         try:
             st, body, _ = http_bytes(
-                "GET", f"http://{c.master}/cluster/status")
+                "GET", f"{c.master}/cluster/status")
             if st == 200:
                 fid = operation.submit(c.master, b"probe")
                 assert operation.read(c.master, fid) == b"probe"
@@ -132,21 +132,30 @@ def test_filer_kill9_restart_namespace_survives(cluster):
 @pytest.mark.parametrize("profile", sorted(PROFILES))
 def test_config_matrix_write_read(tmp_path, profile):
     """The same smoke under every security profile
-    (framework/matrix/config_profiles.go): an open cluster and a
-    jwt-signed one must both serve the full write/read path — under
-    jwt, writes only work because the master mints per-fid tokens in
-    assign responses and every role loaded the same security.toml."""
+    (framework/matrix/config_profiles.go): open, jwt (per-fid write
+    tokens), jwt_read (read tokens too), admin (admin-plane key), and
+    tls (mTLS with a minted PKI) must all serve the full write/read
+    path.  The CLIENT side loads the same security.toml the roles
+    did — the reference's matrix drives its clients the same way."""
+    from seaweedfs_tpu import security
     c = ProcCluster(tmp_path, volumes=1, profile=profile).start()
+    sec_path = f"{tmp_path}/security.toml"
     try:
+        if PROFILES.get(profile):
+            # inside the try: a toml load error must still stop the
+            # started cluster processes
+            security.configure(security.load_security_toml(sec_path))
         _wait_writable(c)
         fid = operation.submit(c.master, b"matrix " + profile.encode())
         assert operation.read(c.master, fid) == \
             b"matrix " + profile.encode()
+        # bare host:port lets the client funnel pick the scheme the
+        # security config mandates (https + pinned CA under tls)
         st, _, _ = http_bytes(
-            "POST", f"http://{c.filer}/m/{profile}.txt", b"filer-ok")
+            "POST", f"{c.filer}/m/{profile}.txt", b"filer-ok")
         assert st < 300
         st, body, _ = http_bytes(
-            "GET", f"http://{c.filer}/m/{profile}.txt")
+            "GET", f"{c.filer}/m/{profile}.txt")
         assert st == 200 and body == b"filer-ok"
         if profile == "jwt":
             # an unsigned direct volume write must be REFUSED
@@ -158,5 +167,54 @@ def test_config_matrix_write_read(tmp_path, profile):
                                   b"unsigned overwrite")
             assert st in (401, 403), \
                 f"unsigned write accepted under jwt profile: {st}"
+        if profile == "admin":
+            # an UNKEYED admin-plane call must be refused (raw
+            # urllib: the configured client funnel would auto-attach
+            # the admin jwt and mask the gate)
+            import urllib.error
+            import urllib.request
+            locs = http_json(
+                "GET", f"{c.master}/dir/lookup?volumeId="
+                       f"{int(fid.split(',')[0])}")
+            url = locs["locations"][0]["url"]
+            req = urllib.request.Request(
+                f"http://{url}/admin/vacuum",
+                data=b'{"volumeId": 1}', method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    raise AssertionError(
+                        f"unkeyed admin call accepted: {r.status}")
+            except urllib.error.HTTPError as e:
+                assert e.code in (401, 403), e.code
+        if profile == "jwt_read":
+            # an unsigned direct volume READ must be refused
+            locs = http_json(
+                "GET", f"http://{c.master}/dir/lookup?volumeId="
+                       f"{int(fid.split(',')[0])}")
+            url = locs["locations"][0]["url"]
+            import urllib.request
+            try:
+                with urllib.request.urlopen(
+                        f"http://{url}/{fid}", timeout=10) as r:
+                    assert r.status in (401, 403), \
+                        "unsigned read accepted under jwt_read"
+            except urllib.error.HTTPError as e:
+                assert e.code in (401, 403), e.code
+        if profile == "tls":
+            # a plain-TCP client must be REFUSED by the tls cluster
+            import urllib.error
+            import urllib.request
+            import http.client
+            try:
+                urllib.request.urlopen(
+                    f"http://{c.filer}/m/{profile}.txt", timeout=10)
+                raise AssertionError("plaintext accepted under tls")
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    http.client.HTTPException):
+                # a TLS alert read as a garbage status line raises
+                # BadStatusLine (HTTPException), equally a refusal
+                pass
     finally:
+        security.configure(None)
         c.stop()
