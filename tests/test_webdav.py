@@ -201,7 +201,7 @@ def test_debug_plane_admin_gated(tmp_path):
         sec_mod.configure(None)
 
 
-def test_scaffold_prints_template():
+def test_scaffold_prints_template(tmp_path):
     import os
     import subprocess
     import sys
@@ -214,7 +214,22 @@ def test_scaffold_prints_template():
              "PYTHONPATH": repo})
     assert out.returncode == 0
     assert "[jwt.signing]" in out.stdout
-    assert "admin_key" in out.stdout
+    # the admin key lives under [admin] key — the canonical section
+    # load_security_toml reads (the old [access] admin_key layout was
+    # a template bug that disabled admin gating).  Fill the template's
+    # empty admin key in and prove the LOADER picks it up — a
+    # regressed section/key name would leave admin_key empty again
+    assert "[admin]" in out.stdout
+    from seaweedfs_tpu import security
+    filled = out.stdout.replace(
+        '[admin]\n# admin-plane key: guards /admin/*, raft, '
+        'heartbeat, grow, lock\nkey = ""',
+        '[admin]\nkey = "scaffold-admin-key"')
+    assert 'scaffold-admin-key' in filled, "template shape changed"
+    toml_path = tmp_path / "security.toml"
+    toml_path.write_text(filled)
+    cfg = security.load_security_toml(str(toml_path))
+    assert cfg.admin_key == "scaffold-admin-key"
 
 
 def test_chunked_transfer_put(dav):
