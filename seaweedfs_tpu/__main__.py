@@ -400,6 +400,33 @@ def main(argv: list[str] | None = None) -> int:
     up.add_argument("-master", default="127.0.0.1:9333")
     up.add_argument("file")
 
+    # offline volume tools (weed fix / compact / export): run against
+    # UNMOUNTED volume files — stop the volume server first
+    fx = sub.add_parser("fix", help="recreate a volume's .idx by "
+                        "scanning its .dat (command/fix.go; stop the "
+                        "volume server first)")
+    fx.add_argument("-dir", required=True)
+    fx.add_argument("-volumeId", dest="volume_id", type=int,
+                    required=True)
+    fx.add_argument("-collection", default="")
+
+    cp = sub.add_parser("compact", help="offline vacuum of a volume "
+                        "file (command/compact.go; stop the volume "
+                        "server first)")
+    cp.add_argument("-dir", required=True)
+    cp.add_argument("-volumeId", dest="volume_id", type=int,
+                    required=True)
+    cp.add_argument("-collection", default="")
+
+    ex = sub.add_parser("export", help="list or tar the live files "
+                        "of one volume (command/export.go)")
+    ex.add_argument("-dir", required=True)
+    ex.add_argument("-volumeId", dest="volume_id", type=int,
+                    required=True)
+    ex.add_argument("-collection", default="")
+    ex.add_argument("-o", dest="out", default="",
+                    help="output .tar path (omit to just list)")
+
     down = sub.add_parser("download", help="download a fid")
     down.add_argument("-master", default="127.0.0.1:9333")
     down.add_argument("fid")
@@ -988,6 +1015,102 @@ white_list = []
         data = open(args.file, "rb").read()
         fid = operation.submit(args.master, data, name=args.file)
         print(fid)
+    elif args.cmd == "fix":
+        # command/fix.go: replay the .dat sequentially into a fresh
+        # .idx (writes -> put, tombstones -> delete-row), exactly the
+        # recovery the reference runs on index corruption
+        import os as _os
+
+        from .storage import idx as idxmod
+        from .storage import types as stypes
+        from .storage.volume import walk_dat
+        name = (f"{args.collection}_" if args.collection else "") + \
+            str(args.volume_id)
+        dat = _os.path.join(args.dir, name + ".dat")
+        idx_path = _os.path.join(args.dir, name + ".idx")
+        if not _os.path.exists(dat):
+            print(f"no {dat}", file=sys.stderr)
+            return 1
+        tmp = idx_path + ".fix"
+        n_writes = n_dels = 0
+        with open(tmp, "wb") as f:
+            for needle, off in walk_dat(dat):
+                if needle.data:
+                    f.write(idxmod.entry_bytes(
+                        needle.id, stypes.to_stored_offset(off),
+                        needle.size))
+                    n_writes += 1
+                else:
+                    f.write(idxmod.entry_bytes(
+                        needle.id, 0, stypes.TOMBSTONE_FILE_SIZE))
+                    n_dels += 1
+        _os.replace(tmp, idx_path)
+        print(f"fixed {idx_path}: {n_writes} writes, "
+              f"{n_dels} tombstones")
+    elif args.cmd == "compact":
+        # command/compact.go: offline shadow-compact + commit on an
+        # unmounted volume
+        import os as _os
+
+        from .storage.volume import Volume
+        name = (f"{args.collection}_" if args.collection else "") + \
+            str(args.volume_id)
+        if not _os.path.exists(_os.path.join(args.dir,
+                                             name + ".dat")):
+            # Volume() would CREATE an empty volume here — a typo'd
+            # id must fail, not mint stray files the server later
+            # serves as a real volume
+            print(f"no {name}.dat in {args.dir}", file=sys.stderr)
+            return 1
+        v = Volume(args.dir, args.volume_id,
+                   collection=args.collection)
+        before = v.dat_size()
+        garbage = v.garbage_level()
+        v.vacuum()
+        after = v.dat_size()
+        v.close()
+        print(f"compacted volume {args.volume_id}: {before} -> "
+              f"{after} bytes (garbage was {garbage:.0%})")
+    elif args.cmd == "export":
+        # command/export.go: list live needles, or tar their payloads
+        # (member names <key-hex>[_<name>])
+        import os as _os
+        import tarfile
+
+        from .storage.volume import Volume
+        name = (f"{args.collection}_" if args.collection else "") + \
+            str(args.volume_id)
+        if not _os.path.exists(_os.path.join(args.dir,
+                                             name + ".dat")):
+            print(f"no {name}.dat in {args.dir}", file=sys.stderr)
+            return 1
+        v = Volume(args.dir, args.volume_id,
+                   collection=args.collection)
+        entries = sorted(v.nm.items())
+        tar = tarfile.open(args.out, "w") if args.out else None
+        count = 0
+        for key, stored_off, size in entries:
+            n = v._read_at(stored_off, size)
+            fname = f"{key:x}"
+            if n.has_name():
+                fname += "_" + n.name.decode("utf-8", "replace")
+            if tar is None:
+                mime = n.mime.decode("utf-8", "replace") \
+                    if n.has_mime() else "-"
+                print(f"{fname}\t{len(n.data)}\t{mime}")
+            else:
+                import io as _io
+                info = tarfile.TarInfo(fname)
+                info.size = len(n.data)
+                info.mtime = n.last_modified or 0
+                tar.addfile(info, _io.BytesIO(n.data))
+            count += 1
+        if tar is not None:
+            tar.close()
+            print(f"exported {count} files to {args.out}")
+        else:
+            print(f"{count} live files in volume {args.volume_id}")
+        v.close()
     elif args.cmd == "download":
         from . import operation
         sys.stdout.buffer.write(operation.read(args.master, args.fid))

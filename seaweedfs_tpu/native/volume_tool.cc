@@ -225,8 +225,10 @@ int cmd_scan(const char* dat_path) {
   if (fread(sb, 1, 8, dat) != 8) return 1;
   int version = sb[0];
   uint16_t extra = (uint16_t(sb[6]) << 8) | sb[7];
-  fseek(dat, long(extra), SEEK_CUR);
-  long offset = 8 + long(extra);
+  // records start 8-byte ALIGNED after any superblock extra blob
+  // (the Python walker and the append path agree on this)
+  long offset = (8 + long(extra) + kPad - 1) / kPad * kPad;
+  fseek(dat, offset, SEEK_SET);
   std::vector<uint8_t> rec;
   for (;;) {
     uint8_t header[kHeader];

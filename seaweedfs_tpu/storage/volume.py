@@ -34,7 +34,14 @@ def walk_dat(path: str):
         sb = SuperBlock.read_from(f)
         version = sb.version
         total = os.fstat(f.fileno()).st_size
-        offset = SUPER_BLOCK_SIZE
+        # records start AFTER any superblock extra blob, rounded up
+        # to the 8-byte record alignment the append path enforces
+        # (_append realigns unaligned tails) — scanning from the
+        # fixed 8 bytes on an extra-carrying volume would read
+        # garbage "headers" out of the blob (and the fix tool would
+        # then replace a healthy .idx with an empty one)
+        offset = (sb.block_size() + types.NEEDLE_PADDING_SIZE - 1) \
+            // types.NEEDLE_PADDING_SIZE * types.NEEDLE_PADDING_SIZE
         while offset + types.NEEDLE_HEADER_SIZE <= total:
             f.seek(offset)
             header = f.read(types.NEEDLE_HEADER_SIZE)
