@@ -400,6 +400,21 @@ def main(argv: list[str] | None = None) -> int:
     up.add_argument("-master", default="127.0.0.1:9333")
     up.add_argument("file")
 
+    sub.add_parser("version", help="print the build version "
+                   "(command/version.go)")
+
+    mt = sub.add_parser(
+        "filer.meta.tail", help="tail the filer metadata event "
+        "stream as JSON lines (command/filer_meta_tail.go)")
+    mt.add_argument("-filer", default="127.0.0.1:8888")
+    mt.add_argument("-sinceNs", dest="since_ns", type=int, default=0,
+                    help="replay from this event timestamp (0 = now)")
+    mt.add_argument("-pathPrefix", dest="path_prefix", default="",
+                    help="only events under this path")
+    mt.add_argument("-interval", type=float, default=1.0)
+    mt.add_argument("-once", action="store_true",
+                    help="drain the backlog and exit (no follow)")
+
     # offline volume tools (weed fix / compact / export): run against
     # UNMOUNTED volume files — stop the volume server first
     fx = sub.add_parser("fix", help="recreate a volume's .idx by "
@@ -1015,6 +1030,46 @@ white_list = []
         data = open(args.file, "rb").read()
         fid = operation.submit(args.master, data, name=args.file)
         print(fid)
+    elif args.cmd == "version":
+        from . import __version__
+        print(f"seaweedfs-tpu {__version__} "
+              f"(python {sys.version.split()[0]})")
+    elif args.cmd == "filer.meta.tail":
+        # command/filer_meta_tail.go: follow the metadata log from a
+        # timestamp, one JSON event per line; -once drains and exits
+        import json as _json
+
+        from .server.httpd import http_json
+        since = args.since_ns
+        if since == 0 and not args.once:
+            import time as _t
+            since = _t.time_ns()          # "now": only new events
+        try:
+            while True:
+                r = http_json(
+                    "GET", f"{args.filer}/__meta__/events?"
+                           f"sinceNs={since}&limit=1000")
+                if "error" in r:
+                    # a 401/404 must not read as "log is empty"
+                    print(f"filer.meta.tail: {r['error']}",
+                          file=sys.stderr)
+                    return 1
+                for ev in r.get("events", []):
+                    path = (ev.get("newEntry") or
+                            ev.get("oldEntry") or {}).get(
+                                "fullPath", "")
+                    if args.path_prefix and \
+                            not path.startswith(args.path_prefix):
+                        since = max(since, int(ev.get("tsNs", 0)))
+                        continue
+                    print(_json.dumps(ev), flush=True)
+                    since = max(since, int(ev.get("tsNs", 0)))
+                if args.once and len(r.get("events", [])) < 1000:
+                    break
+                if len(r.get("events", [])) < 1000:
+                    time.sleep(args.interval)
+        except KeyboardInterrupt:
+            pass
     elif args.cmd == "fix":
         # command/fix.go: replay the .dat sequentially into a fresh
         # .idx (writes -> put, tombstones -> delete-row), exactly the
@@ -1024,10 +1079,8 @@ white_list = []
         from .storage import idx as idxmod
         from .storage import types as stypes
         from .storage.volume import walk_dat
-        name = (f"{args.collection}_" if args.collection else "") + \
-            str(args.volume_id)
-        dat = _os.path.join(args.dir, name + ".dat")
-        idx_path = _os.path.join(args.dir, name + ".idx")
+        dat = _offline_vol_path(args, ".dat")
+        idx_path = _offline_vol_path(args, ".idx")
         if not _os.path.exists(dat):
             print(f"no {dat}", file=sys.stderr)
             return 1
@@ -1053,14 +1106,12 @@ white_list = []
         import os as _os
 
         from .storage.volume import Volume
-        name = (f"{args.collection}_" if args.collection else "") + \
-            str(args.volume_id)
-        if not _os.path.exists(_os.path.join(args.dir,
-                                             name + ".dat")):
+        if not _os.path.exists(_offline_vol_path(args, ".dat")):
             # Volume() would CREATE an empty volume here — a typo'd
             # id must fail, not mint stray files the server later
             # serves as a real volume
-            print(f"no {name}.dat in {args.dir}", file=sys.stderr)
+            print(f"no {_offline_vol_path(args, '.dat')}",
+                  file=sys.stderr)
             return 1
         v = Volume(args.dir, args.volume_id,
                    collection=args.collection)
@@ -1078,11 +1129,9 @@ white_list = []
         import tarfile
 
         from .storage.volume import Volume
-        name = (f"{args.collection}_" if args.collection else "") + \
-            str(args.volume_id)
-        if not _os.path.exists(_os.path.join(args.dir,
-                                             name + ".dat")):
-            print(f"no {name}.dat in {args.dir}", file=sys.stderr)
+        if not _os.path.exists(_offline_vol_path(args, ".dat")):
+            print(f"no {_offline_vol_path(args, '.dat')}",
+                  file=sys.stderr)
             return 1
         v = Volume(args.dir, args.volume_id,
                    collection=args.collection)
@@ -1115,6 +1164,15 @@ white_list = []
         from . import operation
         sys.stdout.buffer.write(operation.read(args.master, args.fid))
     return 0
+
+
+def _offline_vol_path(args, ext: str) -> str:
+    """<dir>/<collection_>_?<vid><ext> — the volume.file_name naming
+    rule, shared by the offline fix/compact/export tools."""
+    import os as _os
+    name = (f"{args.collection}_" if args.collection else "") + \
+        f"{args.volume_id}{ext}"
+    return _os.path.join(args.dir, name)
 
 
 def _repl(env) -> None:

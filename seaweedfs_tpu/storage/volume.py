@@ -18,7 +18,7 @@ from . import types
 from .needle import Needle, get_actual_size
 from .needle_map import NeedleMap
 from .replica_placement import ReplicaPlacement
-from .super_block import SUPER_BLOCK_SIZE, SuperBlock
+from .super_block import SuperBlock
 from .ttl import EMPTY_TTL, TTL
 from .volume_info import VolumeInfo, maybe_load_volume_info, save_volume_info
 
@@ -421,6 +421,13 @@ class Volume:
             with open(self.file_name(".dat"), "rb") as src, \
                     open(cpd, "wb") as dst:
                 dst.write(dst_sb.to_bytes())
+                # records are 8-byte aligned: an extra blob whose
+                # length is not a multiple of 8 would otherwise put
+                # every needle at an offset stored offsets (bytes/8)
+                # cannot express — silent corruption on read-back
+                pad = (-dst.tell()) % types.NEEDLE_PADDING_SIZE
+                if pad:
+                    dst.write(b"\x00" * pad)
                 for key, stored_off, size in snapshot:
                     n = self._read_at_from(src, stored_off, size)
                     new_off = dst.tell()
@@ -548,6 +555,9 @@ class Volume:
             dst_nm = NeedleMap(cpx)
             with open(cpd, "wb") as dst:
                 dst.write(dst_sb.to_bytes())
+                pad = (-dst.tell()) % types.NEEDLE_PADDING_SIZE
+                if pad:                  # same alignment rule as
+                    dst.write(b"\x00" * pad)  # the compact writer
                 for _id, n in sorted(
                         live.items(),
                         key=lambda kv: last_ns.get(kv[0], 0)):

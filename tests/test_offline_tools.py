@@ -78,7 +78,9 @@ def test_export_lists_and_tars(vol, tmp_path_factory):
     r = _cli("export", "-dir", str(tmp), "-volumeId", "21")
     assert r.returncode == 0, r.stderr
     assert "6 live files" in r.stdout
-    assert "3\t" not in r.stdout.split("live")[0].splitlines()[0]
+    # the deleted needle must appear on NO listing line
+    assert not any(l.startswith("3\t")
+                   for l in r.stdout.splitlines())
     out = tmp_path_factory.mktemp("exp") / "vol21.tar"
     r = _cli("export", "-dir", str(tmp), "-volumeId", "21",
              "-o", str(out))
@@ -97,7 +99,7 @@ def test_tools_refuse_missing_volume(tmp_path):
     for cmd in ("compact", "export"):
         r = _cli(cmd, "-dir", str(tmp_path), "-volumeId", "99")
         assert r.returncode == 1, (cmd, r.stdout)
-        assert "no 99.dat" in r.stderr
+        assert "99.dat" in r.stderr and r.stderr.startswith("no ")
     assert list(tmp_path.iterdir()) == []
 
 
@@ -133,3 +135,50 @@ def test_fix_handles_superblock_extra(tmp_path):
     v = Volume(str(tmp_path), 23)
     assert v.read_needle(1, 1).data == b"keep me"
     v.close()
+
+
+def test_version_command():
+    r = _cli("version")
+    assert r.returncode == 0 and "seaweedfs-tpu" in r.stdout
+
+
+def test_filer_meta_tail_once(tmp_path):
+    """`filer.meta.tail -once`: drains the metadata backlog as JSON
+    lines with prefix filtering (command/filer_meta_tail.go)."""
+    import json
+    import time
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    master = MasterServer().start()
+    vs = VolumeServer([str(tmp_path / "v")], master.url,
+                      pulse_seconds=0.3).start()
+    time.sleep(0.4)
+    filer = FilerServer(master.url).start()
+    try:
+        filer.filer.write_file("/tailme/a.txt", b"one")
+        filer.filer.write_file("/other/b.txt", b"two")
+        filer.filer.delete_entry("/tailme/a.txt")
+        r = _cli("filer.meta.tail", "-filer", filer.http.url,
+                 "-once", "-sinceNs", "0")
+        assert r.returncode == 0, r.stderr
+        events = [json.loads(l) for l in r.stdout.splitlines()]
+        paths = [(e.get("newEntry") or e.get("oldEntry") or
+                  {}).get("fullPath") for e in events]
+        assert "/tailme/a.txt" in paths and "/other/b.txt" in paths
+        # prefix filter narrows
+        r = _cli("filer.meta.tail", "-filer", filer.http.url,
+                 "-once", "-sinceNs", "0",
+                 "-pathPrefix", "/tailme")
+        events = [json.loads(l) for l in r.stdout.splitlines()]
+        assert events and all(
+            ((e.get("newEntry") or e.get("oldEntry") or {})
+             .get("fullPath", "")).startswith("/tailme")
+            for e in events)
+        # both the create and the delete of a.txt are in the stream
+        kinds = [bool(e.get("newEntry")) for e in events]
+        assert True in kinds and False in kinds
+    finally:
+        filer.stop()
+        vs.stop()
+        master.stop()
