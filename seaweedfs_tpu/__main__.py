@@ -1046,9 +1046,22 @@ white_list = []
             since = _t.time_ns()          # "now": only new events
         try:
             while True:
-                r = http_json(
-                    "GET", f"{args.filer}/__meta__/events?"
-                           f"sinceNs={since}&limit=1000")
+                try:
+                    r = http_json(
+                        "GET", f"{args.filer}/__meta__/events?"
+                               f"sinceNs={since}&limit=1000")
+                except OSError as e:
+                    # follow mode must survive a filer restart /
+                    # network blip (FilerSync retries the same way);
+                    # -once surfaces the failure instead
+                    if args.once:
+                        print(f"filer.meta.tail: {e}",
+                              file=sys.stderr)
+                        return 1
+                    print(f"filer.meta.tail: {e}; retrying",
+                          file=sys.stderr)
+                    time.sleep(args.interval)
+                    continue
                 if "error" in r:
                     # a 401/404 must not read as "log is empty"
                     print(f"filer.meta.tail: {r['error']}",

@@ -129,6 +129,7 @@ class AdminServer:
         r("GET", "/ui/jobs", self._ui_jobs)
         r("GET", "/ui/config", self._ui_config)
         r("POST", "/ui/config", self._ui_config_submit)
+        r("POST", "/ui/actions", self._ui_actions)
         r("GET", "/maintenance/queue", self._queue)
         r("POST", "/maintenance/trigger_detection", self._trigger)
         r("POST", "/maintenance/submit_job", self._submit_job)
@@ -438,6 +439,16 @@ topology: {_html.escape(str(status.get('topologyId', '?')))}</p>
 <th>message</th><th>last decision</th></tr>{''.join(jobs)}</table>"""
         return self._page("seaweedfs-tpu admin", inner)
 
+    @staticmethod
+    def _form(req: Request) -> dict:
+        """Decode an HTML form body; keep_blank_values so a field
+        cleared to empty REACHES validation instead of silently
+        keeping the old value (shared by both UI POST handlers)."""
+        import urllib.parse as _up
+        return {k: v[0] for k, v in
+                _up.parse_qs((req.body or b"").decode(),
+                             keep_blank_values=True).items()}
+
     class _FormShim:
         """Request shim: hands a parsed HTML form to the JSON config
         handler so both entry points share one validation path."""
@@ -568,12 +579,61 @@ input{{margin:2px}}</style></head><body>
                 f"<td>{j.progress:.0%}</td>"
                 f"<td>{_html.escape(str(j.params)[:80])}</td>"
                 f"<td>{trace}</td></tr>")
+        with self.lock:
+            types_ = sorted(self.schemas)
+        submit_opts = "".join(f"<option>{_html.escape(t)}</option>"
+                              for t in types_)
+        actions = (
+            "<h2>Actions</h2>"
+            "<form method='post' action='/ui/actions' "
+            "style='display:inline'>"
+            "<input type='hidden' name='action' value='detect'>"
+            "<button>run detection now</button></form> "
+            "<form method='post' action='/ui/actions' "
+            "style='display:inline'>"
+            "<input type='hidden' name='action' value='submit'>"
+            f"<select name='jobType'>{submit_opts}</select> "
+            "params (JSON): <input name='params' value='{}' "
+            "size='30'> <button>submit job</button></form>")
         return self._page(
             "Jobs",
             f"<p>filter: <a href='/ui/jobs'>all</a> | {filters}</p>"
+            + actions +
             "<table><tr><th>id</th><th>type</th><th>status</th>"
             "<th>progress</th><th>params</th><th>decisions</th></tr>"
             f"{''.join(rows)}</table>")
+
+    def _ui_actions(self, req: Request):
+        """Browser-driven maintenance actions (the reference admin
+        UI's POST handlers): run a detection round now, or submit a
+        job by type — both share the JSON API handlers' logic."""
+        import json as _json
+        form = self._form(req)
+        if form.get("action") == "detect":
+            self._trigger(self._FormShim({}))
+            return 303, (b"", {"Location": "/ui/jobs",
+                               "Content-Type": "text/plain"})
+        if form.get("action") == "submit":
+            try:
+                params = _json.loads(form.get("params") or "{}")
+            except ValueError as e:
+                return self._page("Submit error",
+                                  f"<p class='bad'>bad params JSON: "
+                                  f"{e}</p>"
+                                  "<p><a href='/ui/jobs'>back</a></p>")
+            status, payload = self._submit_job(self._FormShim(
+                {"jobType": form.get("jobType", ""),
+                 "params": params}))
+            if status != 200:
+                import html as _html
+                return self._page(
+                    "Submit error",
+                    f"<p class='bad'>"
+                    f"{_html.escape(str(payload))}</p>"
+                    "<p><a href='/ui/jobs'>back</a></p>")
+            return 303, (b"", {"Location": "/ui/jobs",
+                               "Content-Type": "text/plain"})
+        return 400, {"error": "unknown action"}
 
     def _ui_config(self, req: Request):
         """Schema-driven config FORMS (admin/plugin/DESIGN.md
@@ -612,12 +672,7 @@ input{{margin:2px}}</style></head><body>
     def _ui_config_submit(self, req: Request):
         """HTML-form arm of /maintenance/config POST: same schema
         validation, then redirect back to the form."""
-        import urllib.parse as _up
-        # keep_blank_values: clearing a field to empty must REACH the
-        # validator, not silently keep the old value
-        form = {k: v[0] for k, v in
-                _up.parse_qs((req.body or b"").decode(),
-                             keep_blank_values=True).items()}
+        form = self._form(req)
         jt = form.pop("jobType", "")
         status, payload = self._set_config(self._FormShim(
             {"jobType": jt, "values": form}))

@@ -375,3 +375,56 @@ def test_admin_multi_page_ui_and_config_forms(harness):
             assert "error" in r.read().decode().lower()
     except urllib.error.HTTPError as e:
         assert e.code in (400, 404)
+
+
+def test_admin_ui_actions(harness):
+    """Round 5: browser-driven maintenance — trigger a detection
+    round and submit a job from the jobs page; both share the JSON
+    handlers' validation."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+    master, servers, admin, worker = harness
+    base = f"http://{admin.url}"
+
+    def post(data):
+        req = urllib.request.Request(
+            f"{base}/ui/actions",
+            data=urllib.parse.urlencode(data).encode(),
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    st, _ = post({"action": "detect"})
+    assert st in (200, 303)
+    # submit a vacuum job from the form path
+    st, body = post({"action": "submit", "jobType": "vacuum",
+                     "params": "{}"})
+    assert st in (200, 303), body
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        with admin.lock:
+            if any(j.job_type == "vacuum"
+                   for j in admin.jobs.values()):
+                break
+        time.sleep(0.2)
+    with admin.lock:
+        assert any(j.job_type == "vacuum"
+                   for j in admin.jobs.values())
+    # bad params JSON -> error page, no crash
+    st, body = post({"action": "submit", "jobType": "vacuum",
+                     "params": "{nope"})
+    assert st == 200 and b"bad params JSON" in body
+    # unknown job type -> validation error surfaced (error PAGE;
+    # a silent 303-to-jobs would mean an unrunnable job was minted)
+    st, body = post({"action": "submit", "jobType": "bogus",
+                     "params": "{}"})
+    assert b"Submit error" in body, body[:200]
+    with admin.lock:
+        assert not any(j.job_type == "bogus"
+                       for j in admin.jobs.values())
+    st, _ = post({"action": "wat"})
+    assert st == 400
