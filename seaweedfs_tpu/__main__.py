@@ -14,6 +14,10 @@ import time
 
 
 def main(argv: list[str] | None = None) -> int:
+    # WEED_LOCKGRAPH=1 race harness: must patch lock factories before
+    # any server object is constructed (devtools/lockgraph.py)
+    from .devtools.lockgraph import maybe_instrument
+    maybe_instrument()
     p = argparse.ArgumentParser(prog="seaweedfs-tpu")
     # security.toml discovery (util/config.go:34
     # LoadSecurityConfiguration; scaffold command/scaffold/security.toml)
@@ -399,6 +403,28 @@ def main(argv: list[str] | None = None) -> int:
     up = sub.add_parser("upload", help="upload a file")
     up.add_argument("-master", default="127.0.0.1:9333")
     up.add_argument("file")
+
+    an = sub.add_parser(
+        "analyze", help="project-native static analysis: SWFS rules + "
+        "baseline (devtools/RULES.md)")
+    an.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the "
+                         "seaweedfs_tpu package)")
+    an.add_argument("-json", dest="json_out", action="store_true",
+                    help="machine-readable findings")
+    an.add_argument("-baseline", default="",
+                    help="baseline file (default: "
+                         "devtools/baseline.json)")
+    an.add_argument("-writeBaseline", dest="write_baseline",
+                    action="store_true",
+                    help="accept all current findings into the "
+                         "baseline and exit 0")
+    an.add_argument("-noBaseline", dest="no_baseline",
+                    action="store_true",
+                    help="report every finding, baselined or not")
+    an.add_argument("-rules", default="",
+                    help="comma-separated rule ids to run "
+                         "(default: all)")
 
     sub.add_parser("version", help="print the build version "
                    "(command/version.go)")
@@ -1027,9 +1053,17 @@ white_list = []
 # mtls = true""")
     elif args.cmd == "upload":
         from . import operation
-        data = open(args.file, "rb").read()
+        with open(args.file, "rb") as f:
+            data = f.read()
         fid = operation.submit(args.master, data, name=args.file)
         print(fid)
+    elif args.cmd == "analyze":
+        from .devtools.analyze import run_cli
+        return run_cli(args.paths, json_out=args.json_out,
+                       baseline_path=args.baseline,
+                       write_baseline=args.write_baseline,
+                       no_baseline=args.no_baseline,
+                       rule_ids=args.rules)
     elif args.cmd == "version":
         from . import __version__
         print(f"seaweedfs-tpu {__version__} "

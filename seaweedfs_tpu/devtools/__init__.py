@@ -1,0 +1,12 @@
+"""Project-native static analysis + runtime race tooling.
+
+`weed analyze` analog: an AST rule engine (analyze.py) with rules tuned
+to this codebase's real failure modes (rules.py, SWFS001..SWFS006 —
+see RULES.md), and a runtime lock-order detector (lockgraph.py) that
+turns the proc-cluster tests into a deadlock harness.
+
+The engine is self-contained stdlib Python: no third-party linter is
+required (or available) in the container.
+"""
+
+from .analyze import Finding, run_paths  # noqa: F401

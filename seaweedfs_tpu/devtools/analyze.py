@@ -1,0 +1,298 @@
+"""Static-analysis engine: rule registry, noqa suppressions, baseline.
+
+The shape mirrors how flake8-style tools work, collapsed to what this
+repo needs:
+
+* a Rule visits one file's AST (`FileContext`) and yields `Finding`s;
+* `# noqa` / `# noqa: SWFS003` comments suppress findings on that line
+  (codes must match; foreign codes like BLE001 do not suppress SWFS
+  rules);
+* a committed baseline (devtools/baseline.json) records fingerprints of
+  accepted legacy findings so only NEW violations fail CI.  Fingerprints
+  hash the rule id, the file's path, and the stripped source line (plus
+  an occurrence index), so re-numbering lines does not invalidate the
+  baseline but touching the offending code does.
+
+Run via `python -m seaweedfs_tpu analyze [paths...]`.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+
+SEVERITIES = ("error", "warning")
+
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z]+[A-Z0-9, ]*))?",
+                      re.IGNORECASE)
+
+_SUPPRESS_ALL = "*"
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def repo_root() -> str:
+    """The directory holding the seaweedfs_tpu package — baseline paths
+    are stored relative to it so analysis is cwd-independent."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str
+    path: str              # repo-relative when under the repo root
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def render(self) -> str:
+        sev = self.severity.upper()
+        out = f"{self.location()}: {sev} {self.rule}: {self.message}"
+        if self.snippet:
+            out += f"\n    {self.snippet}"
+        return out
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "message": self.message, "snippet": self.snippet}
+
+
+class FileContext:
+    """One parsed source file, shared by every rule: AST with parent
+    links, source lines, and the per-line noqa suppression map."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        self.noqa: dict[int, set[str]] = {}
+        for i, text in enumerate(self.lines, start=1):
+            if "#" not in text or "noqa" not in text.lower():
+                continue
+            m = _NOQA_RE.search(text)
+            if not m:
+                continue
+            codes = m.group("codes")
+            if codes is None:
+                self.noqa[i] = {_SUPPRESS_ALL}
+            else:
+                self.noqa[i] = {c.strip().upper()
+                                for c in codes.split(",") if c.strip()}
+
+    def parent(self, node: ast.AST) -> "ast.AST | None":
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST):
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, rule_id: str, lineno: int) -> bool:
+        codes = self.noqa.get(lineno)
+        if not codes:
+            return False
+        return _SUPPRESS_ALL in codes or rule_id.upper() in codes
+
+
+class Rule:
+    """Base class: subclasses set id/severity/title and implement
+    check(ctx) yielding Findings (path/snippet filled by the engine)."""
+
+    id = "SWFS000"
+    severity = "error"
+    title = "abstract rule"
+
+    def check(self, ctx: FileContext):
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node: ast.AST,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(self.id, self.severity, ctx.relpath, line, col,
+                       message, ctx.line_text(line))
+
+
+# -- engine ---------------------------------------------------------------
+
+def collect_files(targets: list[str]) -> list[str]:
+    files: list[str] = []
+    for t in targets:
+        if os.path.isdir(t):
+            for dirpath, dirnames, filenames in os.walk(t):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith(".py"))
+        elif t.endswith(".py"):
+            files.append(t)
+    return sorted(set(files))
+
+
+def _relpath(path: str, root: str) -> str:
+    ap = os.path.abspath(path)
+    root = os.path.abspath(root)
+    if ap.startswith(root + os.sep):
+        return os.path.relpath(ap, root).replace(os.sep, "/")
+    return ap.replace(os.sep, "/")
+
+
+def run_paths(targets: list[str], rules=None, root: "str | None" = None
+              ) -> "tuple[list[Finding], list[str]]":
+    """Analyze files/dirs; returns (findings, parse_errors).  Findings
+    are noqa-filtered but NOT baseline-filtered (that is a reporting
+    decision, see partition_baseline)."""
+    from . import rules as rules_mod
+    active = list(rules) if rules is not None else list(rules_mod.RULES)
+    root = root or repo_root()
+    findings: list[Finding] = []
+    errors: list[str] = []
+    for path in collect_files(targets):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            ctx = FileContext(path, _relpath(path, root), source)
+        except (OSError, SyntaxError, ValueError) as e:
+            errors.append(f"{path}: {e}")
+            continue
+        for rule in active:
+            for fd in rule.check(ctx):
+                if not ctx.suppressed(fd.rule, fd.line):
+                    findings.append(fd)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, errors
+
+
+# -- baseline -------------------------------------------------------------
+
+def fingerprints(findings: list[Finding]) -> "list[tuple[Finding, str]]":
+    """Stable fingerprint per finding: rule + path + stripped source
+    line + occurrence index among identical triples (line-move proof,
+    edit-sensitive)."""
+    seen: dict[tuple, int] = {}
+    out = []
+    for f in findings:
+        key = (f.rule, f.path, f.snippet)
+        idx = seen.get(key, 0)
+        seen[key] = idx + 1
+        raw = f"{f.rule}|{f.path}|{f.snippet}|{idx}"
+        out.append((f, hashlib.sha1(raw.encode()).hexdigest()[:16]))
+    return out
+
+
+def load_baseline(path: str) -> dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return {}
+    except (OSError, ValueError) as e:
+        print(f"analyze: bad baseline {path}: {e}", file=sys.stderr)
+        return {}
+    return doc.get("fingerprints", {})
+
+
+def save_baseline(path: str, findings: list[Finding]) -> int:
+    fps = {}
+    for f, fp in fingerprints(findings):
+        fps[fp] = {"rule": f.rule, "path": f.path,
+                   "snippet": f.snippet}
+    doc = {"version": 1, "count": len(fps),
+           "fingerprints": dict(sorted(fps.items()))}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return len(fps)
+
+
+def partition_baseline(findings: list[Finding], baseline: dict
+                       ) -> "tuple[list[Finding], list[Finding]]":
+    """(new, baselined)."""
+    new, old = [], []
+    for f, fp in fingerprints(findings):
+        (old if fp in baseline else new).append(f)
+    return new, old
+
+
+# -- CLI ------------------------------------------------------------------
+
+def run_cli(paths: list[str], json_out: bool = False,
+            baseline_path: str = "", write_baseline: bool = False,
+            no_baseline: bool = False, rule_ids: str = "") -> int:
+    from . import rules as rules_mod
+    targets = paths or [os.path.join(repo_root(), "seaweedfs_tpu")]
+    missing = [t for t in targets
+               if not (os.path.isdir(t) or
+                       (t.endswith(".py") and os.path.isfile(t)))]
+    if missing:
+        # a typo'd path must not read as "0 findings, all clean"
+        print(f"analyze: no such file or directory: {missing}",
+              file=sys.stderr)
+        return 2
+    active = None
+    if rule_ids:
+        want = {r.strip().upper() for r in rule_ids.split(",")
+                if r.strip()}
+        active = [r for r in rules_mod.RULES if r.id in want]
+        unknown = want - {r.id for r in active}
+        if unknown:
+            print(f"analyze: unknown rule ids {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+    files = collect_files(targets)
+    findings, errors = run_paths(files, rules=active)
+    for e in errors:
+        print(f"analyze: {e}", file=sys.stderr)
+
+    bpath = baseline_path or default_baseline_path()
+    if write_baseline:
+        n = save_baseline(bpath, findings)
+        print(f"analyze: wrote {n} baseline fingerprint(s) to {bpath}")
+        return 0
+    baseline = {} if no_baseline else load_baseline(bpath)
+    new, old = partition_baseline(findings, baseline)
+
+    if json_out:
+        print(json.dumps({
+            "files": len(files),
+            "findings": [f.to_json() for f in new],
+            "baselined": len(old),
+            "errors": errors,
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        n_err = sum(1 for f in new if f.severity == "error")
+        n_warn = len(new) - n_err
+        print(f"analyze: {n_err} error(s), {n_warn} warning(s)"
+              + (f", {len(old)} baselined" if old else "")
+              + (f", {len(errors)} unparsable" if errors else ""))
+    return 1 if new or errors else 0

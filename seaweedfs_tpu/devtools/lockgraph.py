@@ -1,0 +1,370 @@
+"""Runtime lock-order race detector (the dynamic half of `weed
+analyze`).
+
+`install()` replaces `threading.Lock`/`threading.RLock` with tracked
+wrappers keyed by ALLOCATION SITE (file:line — every lock minted at one
+site is one node, the right granularity for order analysis).  Only
+locks allocated from seaweedfs_tpu code are tracked: a stdlib site
+(queue.Queue's mutex, Condition's internal RLock) would alias many
+unrelated instances onto one node and manufacture false cycles.  Each
+acquisition records held-lock -> acquired-lock edges per thread; a new
+edge that closes a cycle in the global graph is a potential-deadlock
+violation recorded with both acquisition stacks.  While any tracked
+lock is held, `time.sleep` and `socket.create_connection` record
+hold-while-blocking violations (the lock convoy / jit-stall class).
+
+Opt-in: set WEED_LOCKGRAPH=1 (and optionally WEED_LOCKGRAPH_OUT=path)
+before process start; `python -m seaweedfs_tpu` calls
+`maybe_instrument()` first thing, and the proc-cluster test framework
+sets the flag for every server role so tier-1 runs double as a race
+harness.  Violations are flushed to the report file the moment they
+are found (servers die by SIGTERM/SIGKILL — atexit alone is not
+enough).
+
+Detection NEVER raises into application code: a detector that can
+kill a volume server is worse than the deadlock it hunts.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+_ORIG_SLEEP = time.sleep
+
+# sleeps shorter than this while holding a lock are tolerated (tight
+# retry backoffs); longer ones starve every waiter for the duration
+HOLD_SLEEP_THRESHOLD = 0.05
+
+
+def _format_site(frame) -> str:
+    parts = frame.f_code.co_filename.replace(os.sep, "/").split("/")
+    return "/".join(parts[-2:]) + f":{frame.f_lineno}"
+
+
+def _short_stack(limit: int = 12) -> list[str]:
+    out = []
+    for f in traceback.extract_stack()[:-2][-limit:]:
+        out.append(f"{f.filename.split(os.sep)[-1]}:{f.lineno}:{f.name}")
+    return out
+
+
+class LockGraph:
+    """Global acquisition-order graph + violation log."""
+
+    def __init__(self, out_path: "str | None" = None):
+        self._mu = _ORIG_LOCK()      # leaf lock: guards graph state
+        self._local = threading.local()
+        self.edges: dict[str, set] = {}
+        self.edge_stacks: dict[tuple, list] = {}
+        self.violations: list[dict] = []
+        self._seen: set = set()
+        self.out_path = out_path
+        self.acquisitions = 0
+
+    # -- per-thread held stack -------------------------------------------
+
+    def held(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = []
+            self._local.stack = st
+        return st
+
+    # -- events ----------------------------------------------------------
+
+    def on_acquired(self, name: str) -> None:
+        st = self.held()
+        with self._mu:
+            self.acquisitions += 1
+            for h in st:
+                if h == name:
+                    # reentrant RLock, or a SIBLING instance from the
+                    # same allocation site.  Site-level nodes cannot
+                    # tell those apart, so instance-pair inversions
+                    # inside one lock class are invisible to the
+                    # cycle check — surface the nesting pattern
+                    # itself so the report points at where an
+                    # instance-ordering discipline must exist.
+                    self._record_same_site_locked(name)
+                    continue
+                tgt = self.edges.setdefault(h, set())
+                if name not in tgt:
+                    tgt.add(name)
+                    self.edge_stacks[(h, name)] = _short_stack()
+                    self._check_cycle_locked(h, name)
+        st.append(name)
+
+    def _record_same_site_locked(self, name: str) -> None:
+        key = ("same-site", name)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.violations.append({
+            "kind": "same-site-nesting",
+            "lock": name,
+            "note": "nested acquisition of two locks from one "
+                    "allocation site (or an RLock re-entry): "
+                    "instance-pair AB/BA inversions here are NOT "
+                    "covered by cycle detection — verify an "
+                    "instance-ordering discipline",
+            "stack": _short_stack(),
+        })
+        self._flush_locked()
+
+    def on_released(self, name: str) -> None:
+        st = self.held()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == name:
+                del st[i]
+                break
+
+    def on_blocking_call(self, what: str, detail: str) -> None:
+        st = self.held()
+        if not st:
+            return
+        with self._mu:
+            key = ("block", what, tuple(st))
+            if key in self._seen:
+                return
+            self._seen.add(key)
+            self.violations.append({
+                "kind": "hold-while-blocking",
+                "call": what,
+                "detail": detail,
+                "held": list(st),
+                "stack": _short_stack(),
+            })
+            self._flush_locked()
+
+    # -- cycle detection --------------------------------------------------
+
+    def _check_cycle_locked(self, src: str, dst: str) -> None:
+        """Adding src->dst closed a cycle iff dst already reaches src."""
+        path = self._path_locked(dst, src)
+        if path is None:
+            return
+        cycle = path + [dst]          # dst ... src (-> dst)
+        key = ("cycle", frozenset(cycle))
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        stacks = {}
+        hops = list(zip(cycle, cycle[1:] + cycle[:1]))
+        for a, b in hops:
+            if (a, b) in self.edge_stacks:
+                stacks[f"{a} -> {b}"] = self.edge_stacks[(a, b)]
+        self.violations.append({
+            "kind": "lock-order-cycle",
+            "cycle": cycle,
+            "stacks": stacks,
+        })
+        self._flush_locked()
+
+    def _path_locked(self, start: str, goal: str) -> "list | None":
+        seen = {start}
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            if node == goal:
+                return path
+            for nxt in self.edges.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # -- reporting --------------------------------------------------------
+
+    def cycles(self) -> list:
+        with self._mu:
+            return [v for v in self.violations
+                    if v["kind"] == "lock-order-cycle"]
+
+    def _doc_locked(self) -> dict:
+        """The report document — single definition for report() and
+        the on-disk flush (edges as lists, matching the JSON shape a
+        reader of the report file sees)."""
+        return {
+            "pid": os.getpid(),
+            "acquisitions": self.acquisitions,
+            "locks": sorted(set(self.edges)
+                            | {d for s in self.edges.values()
+                               for d in s}),
+            "edges": sorted([a, b] for a, s in self.edges.items()
+                            for b in s),
+            "violations": list(self.violations),
+        }
+
+    def report(self) -> dict:
+        with self._mu:
+            return self._doc_locked()
+
+    def flush(self) -> None:
+        with self._mu:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not self.out_path:
+            return
+        try:
+            tmp = f"{self.out_path}.{os.getpid()}.tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(self._doc_locked(), f, indent=1)
+            os.replace(tmp, self.out_path)
+        except OSError:
+            pass                      # never raise into app code
+
+
+class TrackedLock:
+    """threading.Lock/RLock wrapper reporting to a LockGraph.  Also
+    speaks the Condition protocol (_release_save/_acquire_restore/
+    _is_owned) so `threading.Condition(tracked_lock)` keeps the held
+    bookkeeping straight across wait()."""
+
+    def __init__(self, graph: LockGraph, name: str, inner):
+        self._graph = graph
+        self.name = name
+        self._inner = inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._graph.on_acquired(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        self._graph.on_released(self.name)
+
+    def locked(self) -> bool:
+        try:
+            return self._inner.locked()
+        except AttributeError:   # RLock pre-3.12 has no locked()
+            return self.name in self._graph.held()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # -- Condition protocol ----------------------------------------------
+
+    def _release_save(self):
+        if hasattr(self._inner, "_release_save"):
+            state = self._inner._release_save()
+        else:
+            self._inner.release()
+            state = None
+        st = self._graph.held()
+        n = st.count(self.name)
+        for _ in range(n):
+            self._graph.on_released(self.name)
+        return (state, n)
+
+    def _acquire_restore(self, saved) -> None:
+        state, n = saved
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        # re-held after wait(): push without edge recording — waking
+        # from a cv wait is not an ordering decision by this code path
+        self._graph.held().extend([self.name] * max(n, 1))
+
+    def _is_owned(self) -> bool:
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        return self.name in self._graph.held()
+
+    def _at_fork_reinit(self) -> None:
+        self._inner._at_fork_reinit()
+
+    def __getattr__(self, name):
+        # stdlib internals poke at lock attributes we don't model
+        # (e.g. os.register_at_fork handlers) — delegate them
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:
+        return f"<TrackedLock {self.name} {self._inner!r}>"
+
+
+_graph: "LockGraph | None" = None
+
+
+def graph() -> "LockGraph | None":
+    return _graph
+
+
+def _lock_factory(g: LockGraph, inner_factory):
+    def factory():
+        fr = sys._getframe(1)
+        fn = fr.f_code.co_filename
+        # track ONLY locks minted from this package's code.  Stdlib
+        # allocation sites (queue.Queue's mutex, Condition's internal
+        # RLock, logging) would each alias MANY unrelated instances
+        # onto one graph node, manufacturing provably-false cycles
+        # (two different queues bridging two app locks).
+        if "seaweedfs_tpu" not in fn.replace(os.sep, "/"):
+            return inner_factory()
+        return TrackedLock(g, _format_site(fr), inner_factory())
+    return factory
+
+
+def _patched_sleep(g: LockGraph):
+    def sleep(secs):
+        if secs >= HOLD_SLEEP_THRESHOLD:
+            g.on_blocking_call("time.sleep", f"{secs}s")
+        return _ORIG_SLEEP(secs)
+    return sleep
+
+
+def install(out_path: "str | None" = None) -> LockGraph:
+    """Patch lock factories process-wide; idempotent.  Returns the
+    process LockGraph."""
+    global _graph
+    if _graph is not None:
+        return _graph
+    _graph = LockGraph(out_path)
+    threading.Lock = _lock_factory(_graph, _ORIG_LOCK)
+    threading.RLock = _lock_factory(_graph, _ORIG_RLOCK)
+    time.sleep = _patched_sleep(_graph)
+
+    import socket
+    orig_create = socket.create_connection
+
+    def create_connection(address, *a, **kw):
+        _graph.on_blocking_call("socket.create_connection",
+                                f"{address}")
+        return orig_create(address, *a, **kw)
+
+    socket.create_connection = create_connection
+    atexit.register(_graph.flush)
+    _graph.flush()          # report file exists even with 0 findings
+    if out_path:
+        # periodic flush: SIGTERM'd server roles skip atexit
+        def flusher():
+            while True:
+                _ORIG_SLEEP(1.0)
+                _graph.flush()
+        t = threading.Thread(target=flusher, daemon=True,
+                             name="lockgraph-flush")
+        t.start()
+    return _graph
+
+
+def maybe_instrument() -> "LockGraph | None":
+    """Honour the WEED_LOCKGRAPH env opt-in (CLI entry calls this
+    before any server object builds its locks)."""
+    if os.environ.get("WEED_LOCKGRAPH", "") not in ("1", "true", "on"):
+        return None
+    return install(os.environ.get("WEED_LOCKGRAPH_OUT") or None)

@@ -1,0 +1,468 @@
+"""SWFS001..SWFS006 — rules tuned to this codebase's failure modes.
+
+Rationale and examples for every rule live in devtools/RULES.md; each
+rule's docstring here carries only the detection contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import struct
+
+from .analyze import FileContext, Rule
+
+_MUTATOR_METHODS = {
+    "append", "extend", "insert", "add", "remove", "discard", "pop",
+    "popitem", "clear", "update", "setdefault", "appendleft", "popleft",
+}
+
+_LOCK_FACTORIES = {"Lock", "RLock"}
+
+
+def _self_attr(node: ast.AST) -> "str | None":
+    """'x' for `self.x`, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a call target ('time.sleep', 'open')."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        parts.append(_dotted(node.func) + "()")
+    return ".".join(reversed(parts))
+
+
+class LockDisciplineRule(Rule):
+    """SWFS001: a class that guards an attribute with `with self.<lock>`
+    somewhere must guard EVERY mutation of that attribute.  Mutations of
+    lock-guarded attrs outside any lock block (and outside __init__) are
+    flagged; helpers named `*_locked` or whose docstring says the
+    caller holds the lock are skipped."""
+
+    id = "SWFS001"
+    severity = "error"
+    title = "lock-guarded attribute mutated without the lock"
+
+    def check(self, ctx: FileContext):
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(ctx, cls)
+
+    def _lock_attrs(self, cls: ast.ClassDef) -> set:
+        locks = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                fn = node.value.func
+                if isinstance(fn, ast.Attribute) and \
+                        fn.attr in _LOCK_FACTORIES:
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            locks.add(attr)
+        return locks
+
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef):
+        locks = self._lock_attrs(cls)
+        if not locks:
+            return
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        guarded: set[str] = set()
+        unguarded: list[tuple[str, ast.AST]] = []
+        for m in methods:
+            if m.name == "__init__":
+                continue
+            doc = (ast.get_docstring(m) or "").lower()
+            caller_holds = m.name.endswith("_locked") \
+                or "caller holds" in doc or "lock held" in doc \
+                or "holds the lock" in doc
+            for attr, node, under in self._mutations(m, locks):
+                if attr in locks:
+                    continue
+                if under or caller_holds:
+                    guarded.add(attr)
+                else:
+                    unguarded.append((attr, node))
+        for attr, node in unguarded:
+            if attr in guarded:
+                yield self.finding(
+                    ctx, node,
+                    f"{cls.name}.{attr} is mutated under the lock "
+                    f"elsewhere but written here without `with "
+                    f"self.{sorted(locks)[0]}`")
+
+    def _mutations(self, fn: ast.AST, locks: set):
+        """Yield (attr, node, under_lock) for every self.<attr> mutation
+        in fn, tracking `with self.<lock>:` nesting."""
+
+        def walk(node: ast.AST, under: bool):
+            if isinstance(node, ast.With):
+                has_lock = any(
+                    _self_attr(item.context_expr) in locks
+                    for item in node.items)
+                for child in node.body:
+                    yield from walk(child, under or has_lock)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                return  # nested defs: closure timing is unknowable here
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        yield attr, node, under
+                    elif isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                        if attr:
+                            yield attr, node, under
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        for elt in t.elts:
+                            a = _self_attr(elt)
+                            if a:
+                                yield a, node, under
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATOR_METHODS:
+                attr = _self_attr(node.func.value)
+                if attr:
+                    yield attr, node, under
+            for child in ast.iter_child_nodes(node):
+                yield from walk(child, under)
+
+        yield from walk(fn, False)
+
+
+class JitBlockingRule(Rule):
+    """SWFS002: host-side blocking calls inside @jax.jit-decorated
+    functions or Pallas kernels.  Blocking inside a traced function runs
+    at TRACE time at best and deadlocks a compiled callback at worst;
+    either way it does not do what the author meant."""
+
+    id = "SWFS002"
+    severity = "error"
+    title = "blocking call inside a jit/pallas kernel"
+
+    _BLOCKING_EXACT = {
+        "time.sleep", "open", "input", "os.system", "socket.socket",
+        "socket.create_connection", "http_bytes", "http_json",
+    }
+    _BLOCKING_PREFIX = ("subprocess.", "requests.", "urllib.request.")
+
+    def check(self, ctx: FileContext):
+        kernels = self._kernel_functions(ctx)
+        for fn in kernels:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _dotted(node.func)
+                blocking = (name in self._BLOCKING_EXACT or
+                            name.startswith(self._BLOCKING_PREFIX) or
+                            (isinstance(node.func, ast.Attribute) and
+                             node.func.attr == "result" and
+                             not node.args))
+                if blocking:
+                    yield self.finding(
+                        ctx, node,
+                        f"blocking call {name or '.result()'}() inside "
+                        f"jit/pallas function {fn.name!r} — runs at "
+                        f"trace time / stalls the accelerator stream")
+
+    def _kernel_functions(self, ctx: FileContext) -> list:
+        pallas_kernel_names: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "pallas_call":
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name):
+                        pallas_kernel_names.add(sub.id)
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if node.name in pallas_kernel_names:
+                out.append(node)
+                continue
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                names = [_dotted(target)]
+                if isinstance(dec, ast.Call):
+                    names += [_dotted(a) for a in dec.args]
+                if any(n == "jit" or n.endswith(".jit") or
+                       n.endswith("pallas_call") for n in names):
+                    out.append(node)
+                    break
+        return out
+
+
+class StructWidthRule(Rule):
+    """SWFS003: struct format strings on the data plane.
+
+    (a) formats without an explicit byte order ('>', '<', '!') use
+    native size/alignment — on-disk/wire layouts silently change per
+    platform (the shadow-writer alignment bug class);
+    (b) a constant-width buffer slice passed to unpack must match
+    calcsize(fmt) exactly."""
+
+    id = "SWFS003"
+    severity = "error"
+    title = "struct format width/byte-order hazard"
+
+    _FUNCS = {"pack", "unpack", "pack_into", "unpack_from", "calcsize"}
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute) and
+                    node.func.attr in self._FUNCS and
+                    isinstance(node.func.value, ast.Name) and
+                    node.func.value.id == "struct"):
+                continue
+            if not (node.args and
+                    isinstance(node.args[0], ast.Constant) and
+                    isinstance(node.args[0].value, str)):
+                continue
+            fmt = node.args[0].value
+            try:
+                width = struct.calcsize(fmt)
+            except struct.error as e:
+                yield self.finding(ctx, node,
+                                   f"invalid struct format {fmt!r}: {e}")
+                continue
+            if not fmt or fmt[0] not in "<>!":
+                yield self.finding(
+                    ctx, node,
+                    f"struct format {fmt!r} has no explicit byte order "
+                    f"— native size/alignment is platform-dependent; "
+                    f"on-disk formats here are big-endian ('>')")
+                continue
+            if node.func.attr == "unpack" and len(node.args) == 2:
+                got = self._const_slice_width(node.args[1])
+                if got is not None and got != width:
+                    yield self.finding(
+                        ctx, node,
+                        f"struct.unpack({fmt!r}, ...) needs exactly "
+                        f"{width} byte(s) but the slice provides {got}")
+
+    @staticmethod
+    def _const_slice_width(node: ast.AST) -> "int | None":
+        """Width of buf[a:b] when a and b are non-negative int
+        constants (a omitted = 0); None when not statically known."""
+        if not (isinstance(node, ast.Subscript) and
+                isinstance(node.slice, ast.Slice)):
+            return None
+        sl = node.slice
+        if sl.step is not None:
+            return None
+        if sl.lower is None:
+            lower = 0
+        elif isinstance(sl.lower, ast.Constant) and \
+                isinstance(sl.lower.value, int) and sl.lower.value >= 0:
+            lower = sl.lower.value
+        else:
+            return None
+        if isinstance(sl.upper, ast.Constant) and \
+                isinstance(sl.upper.value, int) and sl.upper.value >= 0:
+            upper = sl.upper.value
+        else:
+            return None
+        return max(upper - lower, 0)
+
+
+class SwallowedExceptionRule(Rule):
+    """SWFS004: silently swallowed exceptions.  Flags (a) bare `except:`
+    unless the body re-raises (it catches KeyboardInterrupt/SystemExit),
+    and (b) `except Exception`/`except BaseException` whose body does
+    nothing but pass/continue — data-plane corruption's favourite
+    hiding place."""
+
+    id = "SWFS004"
+    severity = "error"
+    title = "swallowed exception"
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                if not any(isinstance(n, ast.Raise)
+                           for n in ast.walk(node)):
+                    yield self.finding(
+                        ctx, node,
+                        "bare `except:` swallows KeyboardInterrupt/"
+                        "SystemExit — catch a concrete error type")
+                continue
+            if self._is_broad(node.type) and self._body_inert(node):
+                yield self.finding(
+                    ctx, node,
+                    "broad exception silently swallowed — narrow the "
+                    "type and/or log the failure")
+
+    @staticmethod
+    def _is_broad(t: ast.AST) -> bool:
+        names = []
+        if isinstance(t, ast.Tuple):
+            names = [_dotted(e) for e in t.elts]
+        else:
+            names = [_dotted(t)]
+        return any(n in ("Exception", "BaseException") for n in names)
+
+    @staticmethod
+    def _body_inert(node: ast.ExceptHandler) -> bool:
+        for stmt in node.body:
+            if isinstance(stmt, ast.Pass) or \
+                    isinstance(stmt, ast.Continue) or \
+                    (isinstance(stmt, ast.Expr) and
+                     isinstance(stmt.value, ast.Constant)):
+                continue
+            return False
+        return True
+
+
+class UnclosedHandleRule(Rule):
+    """SWFS005: file/socket opened without a context manager or a
+    visible close.  Handles that escape (returned, passed to a call,
+    stored on self or in a container) are the caller's problem and are
+    not flagged."""
+
+    id = "SWFS005"
+    severity = "warning"
+    title = "handle opened without with/close"
+
+    _OPENERS = {"open", "socket.socket"}
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    _dotted(node.func) in self._OPENERS):
+                continue
+            verdict = self._verdict(ctx, node)
+            if verdict:
+                yield self.finding(ctx, node, verdict)
+
+    def _verdict(self, ctx: FileContext, call: ast.Call) -> "str | None":
+        name = _dotted(call.func)
+        parent = ctx.parent(call)
+        if isinstance(parent, ast.withitem):
+            return None
+        if isinstance(parent, ast.Attribute):
+            if parent.attr == "close":
+                return None
+            return (f"{name}(...).{parent.attr}() leaks the handle — "
+                    f"use a `with` block")
+        if isinstance(parent, ast.Expr):
+            return f"{name}(...) result discarded — handle leaks"
+        if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+            targets = parent.targets if isinstance(parent, ast.Assign) \
+                else [parent.target]
+            if len(targets) != 1 or not isinstance(targets[0], ast.Name):
+                return None  # self.x / container slot: lifecycle-managed
+            var = targets[0].id
+            fn = next((a for a in ctx.ancestors(call)
+                       if isinstance(a, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))), None)
+            scope = fn if fn is not None else ctx.tree
+            if self._name_is_handled(scope, var, parent):
+                return None
+            return (f"{name}(...) assigned to {var!r} but never closed, "
+                    f"returned, stored, or passed on in this scope")
+        return None  # escapes into a call/container/comprehension
+
+    @staticmethod
+    def _name_is_handled(scope: ast.AST, var: str,
+                         assign: ast.AST) -> bool:
+        for node in ast.walk(scope):
+            if node is assign:
+                continue
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr == "close" and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == var:
+                    return True
+                for arg in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id == var:
+                        return True
+            elif isinstance(node, ast.withitem):
+                if isinstance(node.context_expr, ast.Name) and \
+                        node.context_expr.id == var:
+                    return True
+            elif isinstance(node, ast.Return):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and sub.id == var:
+                        return True
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                value = node.value
+                for sub in ast.walk(value) if value else []:
+                    if isinstance(sub, ast.Name) and sub.id == var:
+                        return True
+            elif isinstance(node, (ast.Tuple, ast.List, ast.Dict,
+                                   ast.Yield, ast.YieldFrom)):
+                for sub in ast.iter_child_nodes(node):
+                    if isinstance(sub, ast.Name) and sub.id == var:
+                        return True
+        return False
+
+
+class WallClockRule(Rule):
+    """SWFS006: wall-clock reads in replay-deterministic paths.  The
+    raft log and .idx replay must produce identical state on every
+    replay; `time.time()` there bakes the replay wall time into state.
+    Scope: the module list below plus any module whose first lines
+    carry a `swfs: deterministic` marker."""
+
+    id = "SWFS006"
+    severity = "error"
+    title = "wall clock used in a replay-deterministic path"
+
+    DETERMINISTIC_SUFFIXES = (
+        "seaweedfs_tpu/server/raft.py",
+        "seaweedfs_tpu/storage/idx.py",
+        "seaweedfs_tpu/storage/needle_map.py",
+    )
+    _CLOCKS = {"time.time", "time.time_ns", "datetime.now",
+               "datetime.utcnow", "datetime.datetime.now",
+               "datetime.datetime.utcnow", "datetime.date.today"}
+
+    def _applies(self, ctx: FileContext) -> bool:
+        if ctx.relpath.endswith(self.DETERMINISTIC_SUFFIXES):
+            return True
+        head = "\n".join(ctx.lines[:50])
+        return "swfs: deterministic" in head
+
+    def check(self, ctx: FileContext):
+        if not self._applies(ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    _dotted(node.func) in self._CLOCKS:
+                yield self.finding(
+                    ctx, node,
+                    f"{_dotted(node.func)}() in a replay-deterministic "
+                    f"module — use time.monotonic() for intervals or "
+                    f"carry timestamps in the replayed record")
+
+
+RULES = [
+    LockDisciplineRule(),
+    JitBlockingRule(),
+    StructWidthRule(),
+    SwallowedExceptionRule(),
+    UnclosedHandleRule(),
+    WallClockRule(),
+]

@@ -287,8 +287,10 @@ class Filer:
         for fn in listeners:
             try:
                 fn(event)
-            except Exception:  # noqa: BLE001 — listeners are isolated
-                pass
+            except Exception as e:  # noqa: BLE001 — listeners are
+                from ..util import wlog         # isolated
+                wlog.warning("meta listener raised: %s", e,
+                             component="filer")
 
     def subscribe(self, fn: Callable[[dict], None]) -> None:
         with self._log_lock:

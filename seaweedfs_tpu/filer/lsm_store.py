@@ -54,7 +54,8 @@ class LsmTree:
         self._segments: list[tuple[list[str], list]] = []  # old->new
         self._seg_paths: list[str] = []
         self._next_seq = 0
-        self._recover()
+        with self._lock:
+            self._recover()
         self._wal = open(self._wal_path, "a")
 
     @property
@@ -62,6 +63,7 @@ class LsmTree:
         return os.path.join(self.dir, "wal.log")
 
     def _recover(self) -> None:
+        """Caller holds the lock (init-time replay)."""
         names = sorted(n for n in os.listdir(self.dir)
                        if n.endswith(".seg"))
         for name in names:
@@ -130,9 +132,10 @@ class LsmTree:
             self._compact()
 
     def _compact(self) -> None:
-        """Merge every segment into one, newest value wins, tombstones
-        dropped (they have nothing older left to shadow).  The merged
-        segment is INSTALLED (under a name that sorts newest) before
+        """Caller holds the lock.  Merge every segment into one,
+        newest value wins, tombstones dropped (they have nothing
+        older left to shadow).  The merged segment is INSTALLED
+        (under a name that sorts newest) before
         the old ones are removed — a crash mid-compaction must leave
         a recoverable superset, never a hole."""
         merged: dict[str, "dict | None"] = {}

@@ -79,6 +79,7 @@ class MetaLog:
         return event
 
     def _rotate(self, name: "tuple[str, str]") -> None:
+        """Caller holds the lock."""
         if self._open_file is not None:
             self._open_file.close()
         day_dir = os.path.join(self.dir, name[0])

@@ -49,6 +49,7 @@ class RespClient:
         self._buf = b""
 
     def _connect(self) -> None:
+        """Caller holds the lock."""
         self._sock = socket.create_connection(
             (self.host, self.port), timeout=self.timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -77,6 +78,7 @@ class RespClient:
         return b"".join(out)
 
     def _read_line(self) -> bytes:
+        """Caller holds the lock."""
         while b"\r\n" not in self._buf:
             chunk = self._sock.recv(65536)
             if not chunk:
@@ -86,6 +88,7 @@ class RespClient:
         return line
 
     def _read_exact(self, n: int) -> bytes:
+        """Caller holds the lock."""
         while len(self._buf) < n + 2:
             chunk = self._sock.recv(65536)
             if not chunk:

@@ -214,14 +214,15 @@ class Identity:
             # preferred over fail-open (an irrevocable one); re-grant
             # via UpdateUser if that static action was intended
             try:
-                from .iamapi import policy_to_actions
+                from .iamapi import IamError, policy_to_actions
                 derived = set()
                 for doc in ident.policies.values():
                     derived.update(policy_to_actions(doc))
                 ident.static_actions = [a for a in ident.actions
                                         if a not in derived]
-            except Exception:    # undecodable legacy doc: keep all
-                pass
+            except (IamError, AttributeError, KeyError, TypeError,
+                    ValueError):
+                pass     # undecodable legacy doc: keep all actions
         # else: a hand-written identities JSON — its actions ARE the
         # static provisioned set (the cls(...) call captured them)
         return ident
@@ -249,9 +250,11 @@ class IdentityStore:
         self._sa_by_key: dict[str, dict] = {}
         self._mtime = 0.0
         if path and os.path.exists(path):
-            self._reload()
+            with self._lock:
+                self._reload()
 
     def _reload(self) -> None:
+        """Caller holds the lock."""
         with open(self.path) as f:
             self.load_json(json.load(f))
         self._mtime = os.stat(self.path).st_mtime
@@ -321,6 +324,7 @@ class IdentityStore:
             self._mtime = os.stat(self.path).st_mtime
 
     def _index(self, ident: Identity) -> None:
+        """Caller holds the lock."""
         self._identities[ident.name] = ident
         for c in ident.credentials:
             self._by_access_key[c.access_key] = ident
@@ -452,7 +456,7 @@ class IdentityStore:
         derived: dict[str, set] = {}
         if self._groups:
             try:
-                from .iamapi import policy_to_actions
+                from .iamapi import IamError, policy_to_actions
             except Exception:
                 return
             for g in self._groups.values():
@@ -464,7 +468,8 @@ class IdentityStore:
                     if doc:
                         try:
                             acts.update(policy_to_actions(doc))
-                        except Exception:
+                        except (IamError, AttributeError, KeyError,
+                                TypeError, ValueError):
                             continue   # malformed doc grants nothing
                 if not acts:
                     continue

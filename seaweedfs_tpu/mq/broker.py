@@ -323,8 +323,9 @@ class BrokerServer:
             if self.auto_split_bytes_per_sec > 0:
                 try:
                     self._maybe_auto_split()
-                except Exception:  # noqa: BLE001 — detector must not
-                    pass           # kill the flush loop
+                except Exception as e:  # noqa: BLE001 — detector must
+                    wlog.warning(       # not kill the flush loop
+                        "auto-split detector: %s", e, component="mq")
 
     def _maybe_auto_split(self) -> None:
         """Sample per-partition append-byte deltas; a partition
@@ -387,8 +388,9 @@ class BrokerServer:
         for log in logs:
             try:
                 log.flush()
-            except Exception:  # noqa: BLE001 — best-effort; retried
-                pass           # on the next tick
+            except Exception as e:  # noqa: BLE001 — best-effort;
+                wlog.warning(       # retried on the next tick
+                    "partition flush failed: %s", e, component="mq")
 
     @property
     def url(self) -> str:

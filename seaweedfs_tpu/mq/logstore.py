@@ -133,7 +133,9 @@ class PartitionLog:
             self._buf.flush()
 
     def _flush_records(self, recs: "list[dict]") -> None:
-        """LogBuffer sink: one filer segment per flushed page."""
+        """LogBuffer sink: one filer segment per flushed page.
+        Caller holds the lock (LogBuffer flushes synchronously from
+        append/flush, which hold it)."""
         body = "\n".join(json.dumps(r, separators=(",", ":"))
                          for r in recs).encode() + b"\n"
         name = f"{recs[0]['tsNs']:020d}.log"

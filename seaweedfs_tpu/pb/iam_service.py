@@ -93,14 +93,15 @@ def _preserve_inline_policies(old: Identity, new: Identity) -> None:
     new.policies = dict(old.policies)
     if new.policies:
         try:
-            from ..iam.iamapi import policy_to_actions
+            from ..iam.iamapi import IamError, policy_to_actions
             derived = set()
             for doc in new.policies.values():
                 derived.update(policy_to_actions(doc))
             new.static_actions = [a for a in new.actions
                                   if a not in derived]
-        except Exception:   # undecodable legacy doc: keep all static
-            pass
+        except (IamError, AttributeError, KeyError, TypeError,
+                ValueError):
+            pass     # undecodable legacy doc: keep all static
 
 
 class _PolicyMixin:

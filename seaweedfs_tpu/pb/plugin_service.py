@@ -280,8 +280,10 @@ class PluginControlServicer:
                     if rsp.success:
                         sess.learn_schema(descriptor_from_pb(
                             rsp.job_type_descriptor))
-        except Exception:  # stream broke: worker gone
-            pass
+        except Exception as e:  # noqa: BLE001 — stream broke:
+            from ..util import wlog     # worker gone; session reaped
+            wlog.info("maintenance stream closed: %s", e,
+                      component="plugin")
         finally:
             sess.done.set()
 
@@ -447,8 +449,10 @@ class WorkerServicer:
                                   tc.error_message)
                 elif which == "shutdown":
                     break
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — stream broke:
+            from ..util import wlog     # worker gone; session reaped
+            wlog.info("worker stream closed: %s", e,
+                      component="plugin")
         finally:
             sess.done.set()
 

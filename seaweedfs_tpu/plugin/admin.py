@@ -111,7 +111,8 @@ class AdminServer:
             import os
             self._plugin_dir = os.path.join(data_dir, "plugin")
             os.makedirs(self._plugin_dir, exist_ok=True)
-            self._load_state()
+            with self.lock:
+                self._load_state()
         self.http = HttpServer(host, port)
         r = self.http.route
         r("GET", "/maintenance/config", self._get_config)
@@ -166,13 +167,15 @@ class AdminServer:
             self.grpc_server.stop(grace=0.5).wait()
             self.grpc_server = None
         self.http.stop()
-        if self._jobs_f is not None:
-            self._jobs_f.close()
-            self._jobs_f = None
+        with self.lock:
+            if self._jobs_f is not None:
+                self._jobs_f.close()
+                self._jobs_f = None
 
     # -- persistence (<dataDir>/plugin/, DESIGN.md layout) ---------------
 
     def _load_state(self) -> None:
+        """Caller holds the lock (init-time recovery)."""
         import json
         import os
         jobs_path = os.path.join(self._plugin_dir, "jobs.jsonl")
@@ -237,6 +240,7 @@ class AdminServer:
             self._compact_jobs()
 
     def _compact_jobs(self) -> None:
+        """Caller holds the lock."""
         import json
         import os
         if not self.data_dir:

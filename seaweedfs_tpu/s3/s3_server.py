@@ -27,6 +27,7 @@ import xml.etree.ElementTree as ET
 from ..filer import Entry, Filer
 from ..filer.filechunks import total_size
 from ..server.httpd import HttpServer, Request
+from ..util import wlog
 from .auth import SigV4Verifier
 from .chunked import ChunkedDecodeError, decode_streaming_body
 from .cors import evaluate as cors_evaluate, parse_cors_config
@@ -218,8 +219,13 @@ class S3ApiServer:
                 content = self.filer.read_file(CONFIG_PATH) \
                     if e is not None else b""
                 self.circuit_breaker.load_bytes(content)
-            except Exception:
-                pass        # keep the last good config on a bad write
+            except Exception as e:  # noqa: BLE001 — any malformed
+                # config or unreachable chunk (read_file raises
+                # RuntimeError/LookupError when the hosting volume is
+                # down; load() raises on wrong-shape JSON) must keep
+                # the last good config, never crash the request path
+                wlog.warning("circuit-breaker config unreadable; "
+                             "keeping previous: %s", e, component="s3")
         self._cb_stamp = (now, new_mtime)
 
     def _dispatch(self, req: Request):

@@ -240,7 +240,10 @@ def load_security_toml(path: str) -> SecurityConfig:
     (command/scaffold/security.toml: [jwt.signing].key,
     [jwt.signing.read].key, [access].white_list; admin_key is this
     build's HTTP analog of [grpc].ca-gated admin access)."""
-    import tomllib
+    try:
+        import tomllib
+    except ModuleNotFoundError:      # py<3.11: the tomli backport
+        import tomli as tomllib
     with open(path, "rb") as f:
         t = tomllib.load(f)
     jwt_t = t.get("jwt", {})

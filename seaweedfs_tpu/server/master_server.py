@@ -187,8 +187,10 @@ class MasterServer:
             else:
                 self.raft.propose("topologyId", self.raft.topology_id)
             self._checkpoint_sequence(sync=True)
-        except Exception:  # noqa: BLE001 — retried on next leadership
-            pass
+        except Exception as e:  # noqa: BLE001 — retried on next
+            wlog.warning(        # leadership change
+                "leader bootstrap incomplete: %s", e,
+                component="master")
 
     def _on_raft_apply(self, key: str, value) -> None:
         """Committed FSM entries: every node (leader + followers)

@@ -45,7 +45,8 @@ import random
 import threading
 import time
 import uuid
-from concurrent.futures import ThreadPoolExecutor, as_completed
+from concurrent.futures import (TimeoutError as FuturesTimeout,
+                                ThreadPoolExecutor, as_completed)
 
 from .httpd import HttpServer, Request, http_json
 
@@ -266,7 +267,8 @@ class RaftNode:
         self._pool = ThreadPoolExecutor(max_workers=max(4, len(self.peers)))
         self._thread: threading.Thread | None = None
         if data_dir:
-            self._load_state()
+            with self._lock:
+                self._load_state()
         # replay any snapshot/log state into the FSM view
         with self._lock:
             self._apply_committed_locked()
@@ -281,6 +283,7 @@ class RaftNode:
         return os.path.join(self.data_dir, "raft.state")
 
     def _load_state(self) -> None:
+        """Caller holds the lock."""
         try:
             with open(self._state_path()) as f:
                 st = json.load(f)
@@ -660,15 +663,15 @@ class RaftNode:
             for f in as_completed(futs, timeout=self._rpc_timeout() + 1):
                 try:
                     r = f.result()
-                except Exception:
-                    continue
+                except (OSError, ValueError):
+                    continue          # peer down / bad reply: no vote
                 if int(r.get("term", 0)) > term:
                     with self._lock:
                         self._step_down(int(r["term"]))
                     return
                 if r.get("granted"):
                     votes += 1
-        except TimeoutError:
+        except (TimeoutError, FuturesTimeout):
             pass
         if votes >= self.majority() and self._try_become_leader(term):
             self._heartbeat_peers()
@@ -705,9 +708,9 @@ class RaftNode:
         round_start = time.monotonic()
         acks = 1
         got_quorum = acks >= self.majority()  # single-node cluster
-        if got_quorum:
-            self._last_quorum = round_start
         with self._lock:
+            if got_quorum:
+                self._last_quorum = round_start
             if self.state != LEADER:
                 return
             targets = {p: self._peer_payload(p, term)
@@ -724,8 +727,8 @@ class RaftNode:
                 peer = futs[f]
                 try:
                     r = f.result()
-                except Exception:
-                    continue
+                except (OSError, ValueError):
+                    continue      # peer down / bad reply: no ack
                 if int(r.get("term", 0)) > term:
                     with self._lock:
                         self._step_down(int(r["term"]))
@@ -746,11 +749,12 @@ class RaftNode:
                     acks += 1
                     if not got_quorum and acks >= self.majority():
                         got_quorum = True
-                        self._last_quorum = round_start
+                        with self._lock:
+                            self._last_quorum = round_start
                         # keep draining stragglers' results this round
                         # (replication progress), but the lease is
                         # already refreshed
-        except TimeoutError:
+        except (TimeoutError, FuturesTimeout):
             pass
         if not got_quorum and time.monotonic() - self._last_quorum > \
                 self.LEASE_PULSES * self.pulse:

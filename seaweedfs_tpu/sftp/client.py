@@ -58,8 +58,8 @@ class SftpClient:
     def close(self) -> None:
         try:
             self.tr.send(u8(msg.MSG_CHANNEL_CLOSE) + u32(0))
-        except Exception:
-            pass
+        except OSError:
+            pass    # best-effort goodbye on a dying transport
         self.sock.close()
 
     # -- ssh plumbing ------------------------------------------------------

@@ -233,8 +233,8 @@ class Transport:
         try:
             self.send(u8(MSG_DISCONNECT) + u32(code) + ssh_string(msg) +
                       ssh_string(""))
-        except Exception:
-            pass
+        except OSError:
+            pass    # best-effort goodbye on a dying socket
 
     # -- service negotiation ----------------------------------------------
 

@@ -435,7 +435,8 @@ class Volume:
                     dst_nm.put(key, types.to_stored_offset(new_off),
                                size)
             dst_nm.close()
-            self._idx_snapshot = idx_snapshot
+            with self.lock:
+                self._idx_snapshot = idx_snapshot
         except BaseException:
             with self.lock:
                 self._compacting = False

@@ -26,7 +26,11 @@ Three pieces:
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:          # py<3.11: the tomli backport
+    import tomli as tomllib
 
 SEARCH_DIRS = (".", os.path.expanduser("~/.seaweedfs"),
                "/etc/seaweedfs")

@@ -87,8 +87,8 @@ class _RotatingHandler(logging.Handler):
                 if self._f.tell() + len(line) > self.max_bytes:
                     self._rotate()
                 self._f.write(line)
-        except Exception:     # noqa: BLE001 — logging must not raise
-            pass
+        except Exception:     # noqa: BLE001,SWFS004 — logging must
+            pass              # never raise into the caller
 
     def _rotate(self) -> None:
         self._f.close()

@@ -83,15 +83,17 @@ class Proc:
     """One server process with its role, port, and restart recipe."""
 
     def __init__(self, role: str, args: list, port: int,
-                 log_path: str):
+                 log_path: str, env_extra: "dict | None" = None):
         self.role = role
         self.args = args
         self.port = port
         self.log_path = log_path
+        self.env_extra = env_extra or {}
         self.popen: "subprocess.Popen | None" = None
 
     def start(self) -> "Proc":
-        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+                   **self.env_extra)
         if getattr(self, "log_f", None) is not None and \
                 not self.log_f.closed:
             self.log_f.close()   # kill9()+start() must not leak fds
@@ -164,7 +166,8 @@ class ProcCluster:
                        "-mdir", mdir,
                        "-volumeSizeLimitMB",
                        str(volume_size_limit_mb)], mport,
-            os.path.join(self.tmp, "master.log"))
+            os.path.join(self.tmp, "master.log"),
+            env_extra=self._lockgraph_env("master"))
         for i in range(volumes):
             vport = free_port()
             vdir = os.path.join(self.tmp, f"vol{i}")
@@ -173,13 +176,43 @@ class ProcCluster:
                 f"volume{i}",
                 [*sec_args, "volume", "-port", str(vport), "-dir",
                  vdir, "-mserver", f"127.0.0.1:{mport}"], vport,
-                os.path.join(self.tmp, f"vol{i}.log"))
+                os.path.join(self.tmp, f"vol{i}.log"),
+                env_extra=self._lockgraph_env(f"volume{i}"))
         fport = free_port()
         self.procs["filer"] = Proc(
             "filer", [*sec_args, "filer", "-port", str(fport),
                       "-master", f"127.0.0.1:{mport}",
                       "-store", os.path.join(self.tmp, "filer.db")],
-            fport, os.path.join(self.tmp, "filer.log"))
+            fport, os.path.join(self.tmp, "filer.log"),
+            env_extra=self._lockgraph_env("filer"))
+
+    def _lockgraph_env(self, role: str) -> dict:
+        """Every server role runs under the devtools/lockgraph.py
+        race detector: lock-order cycles found while the cluster
+        serves real traffic land in per-role report files that
+        lock_violations() aggregates (tier-1 doubles as a race
+        harness)."""
+        return {
+            "WEED_LOCKGRAPH": "1",
+            "WEED_LOCKGRAPH_OUT": os.path.join(
+                self.tmp, f"lockgraph-{role}.json"),
+        }
+
+    def lock_violations(self, kind: str = "lock-order-cycle") -> list:
+        """Aggregate detector findings across every role's report."""
+        import json
+        out = []
+        for role in self.procs:
+            path = os.path.join(self.tmp, f"lockgraph-{role}.json")
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue          # role never booted / mid-rewrite
+            for v in doc.get("violations", []):
+                if not kind or v.get("kind") == kind:
+                    out.append(dict(v, role=role))
+        return out
 
     def start(self) -> "ProcCluster":
         # a later role failing to boot must not orphan the earlier

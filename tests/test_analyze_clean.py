@@ -1,0 +1,42 @@
+"""CI gate: the shipped package must analyze clean.
+
+Runs the full SWFS rule set over seaweedfs_tpu/ with the committed
+baseline; any NEW finding fails tier-1, which is the whole point —
+the bug classes these rules encode (framing-width drift, lock-
+discipline holes, swallowed data-plane errors) were previously caught
+only by manual review."""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.devtools.analyze import (default_baseline_path,
+                                            fingerprints, load_baseline,
+                                            partition_baseline,
+                                            repo_root, run_paths)
+
+PKG = os.path.join(repo_root(), "seaweedfs_tpu")
+
+
+@pytest.fixture(scope="module")
+def analysis():
+    findings, errors = run_paths([PKG])
+    assert errors == [], f"unparsable sources: {errors}"
+    return findings
+
+
+def test_package_has_zero_new_findings(analysis):
+    new, _old = partition_baseline(
+        analysis, load_baseline(default_baseline_path()))
+    assert new == [], "new analyzer findings (fix, # noqa: SWFS###, " \
+        "or re-baseline via `python -m seaweedfs_tpu analyze " \
+        "-writeBaseline`):\n" + "\n".join(f.render() for f in new)
+
+
+def test_baseline_has_no_stale_entries(analysis):
+    """Every baselined fingerprint must still correspond to a live
+    finding — entries whose code was fixed must leave the baseline so
+    the fixed state is what CI defends."""
+    live = {fp for _, fp in fingerprints(analysis)}
+    stale = set(load_baseline(default_baseline_path())) - live
+    assert stale == set(), f"stale baseline fingerprints: {stale}"

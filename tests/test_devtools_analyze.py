@@ -1,0 +1,451 @@
+"""Analyzer engine + rules + lockgraph tests.
+
+Every SWFS rule gets a positive fixture (must flag), a negative fixture
+(must stay silent), and a suppression check; the engine tests cover
+noqa semantics and the baseline workflow; the lockgraph tests construct
+a real AB/BA inversion across two threads and assert the cycle is
+caught."""
+
+import json
+import textwrap
+import threading
+
+import pytest
+
+from seaweedfs_tpu.devtools import lockgraph as lg
+from seaweedfs_tpu.devtools.analyze import (FileContext, fingerprints,
+                                            load_baseline,
+                                            partition_baseline,
+                                            run_paths, save_baseline)
+from seaweedfs_tpu.devtools.rules import RULES
+
+
+def check(source: str, rule_id: str):
+    """Run one rule over an inline snippet; returns findings."""
+    src = textwrap.dedent(source)
+    ctx = FileContext("<fixture>.py", "fixture.py", src)
+    rule = next(r for r in RULES if r.id == rule_id)
+    return [f for f in rule.check(ctx)
+            if not ctx.suppressed(f.rule, f.line)]
+
+
+def analyze_tree(tmp_path, name, source):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    findings, errors = run_paths([str(tmp_path)])
+    assert not errors
+    return findings
+
+
+# -- SWFS001: lock discipline --------------------------------------------
+
+LOCKY = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+        def incr(self):
+            with self._lock:
+                self.n += 1
+        def reset(self):
+            self.n = 0{noqa}
+"""
+
+
+def test_swfs001_flags_unguarded_mutation():
+    found = check(LOCKY.format(noqa=""), "SWFS001")
+    assert len(found) == 1
+    assert found[0].line and "Counter.n" in found[0].message
+
+
+def test_swfs001_noqa_suppresses():
+    assert check(LOCKY.format(noqa="  # noqa: SWFS001"), "SWFS001") == []
+
+
+def test_swfs001_negative_all_guarded_and_conventions():
+    src = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0          # __init__ is pre-publication
+        def incr(self):
+            with self._lock:
+                self.n += 1
+        def _bump_locked(self):
+            self.n += 1         # _locked suffix: caller holds
+        def _bump2(self):
+            \"\"\"Caller holds the lock.\"\"\"
+            self.n += 1
+    """
+    assert check(src, "SWFS001") == []
+
+
+def test_swfs001_foreign_noqa_does_not_suppress():
+    found = check(LOCKY.format(noqa="  # noqa: BLE001"), "SWFS001")
+    assert len(found) == 1
+
+
+# -- SWFS002: blocking in jit --------------------------------------------
+
+def test_swfs002_flags_sleep_in_jit():
+    src = """
+    import time, jax
+
+    @jax.jit
+    def kernel(x):
+        time.sleep(1)
+        return x
+    """
+    found = check(src, "SWFS002")
+    assert len(found) == 1 and "time.sleep" in found[0].message
+
+
+def test_swfs002_partial_jit_and_pallas():
+    src = """
+    import functools, jax
+    import jax.experimental.pallas as pl
+
+    def _rs_kernel(ref):
+        open("/tmp/x")
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def wrapper(x, n):
+        f.result()
+        return pl.pallas_call(_rs_kernel)(x)
+    """
+    found = check(src, "SWFS002")
+    assert {f.message.split("(")[0] for f in found} and len(found) == 2
+
+
+def test_swfs002_negative_outside_jit():
+    src = """
+    import time
+    def plain(x):
+        time.sleep(1)
+        return x
+    """
+    assert check(src, "SWFS002") == []
+
+
+# -- SWFS003: struct widths ----------------------------------------------
+
+def test_swfs003_flags_native_order():
+    found = check("import struct\nstruct.pack('IQ', 1, 2)\n", "SWFS003")
+    assert len(found) == 1 and "byte order" in found[0].message
+
+
+def test_swfs003_flags_slice_width_mismatch():
+    src = """
+    import struct
+    def f(buf):
+        return struct.unpack(">I", buf[0:8])
+    """
+    found = check(src, "SWFS003")
+    assert len(found) == 1 and "4 byte" in found[0].message
+
+
+def test_swfs003_negative_exact_widths():
+    src = """
+    import struct
+    def f(buf):
+        a = struct.unpack(">I", buf[:4])
+        b = struct.unpack(">H", buf[6:8])
+        c = struct.unpack(">Q", buf)        # width not static: ok
+        return a, b, c
+    """
+    assert check(src, "SWFS003") == []
+
+
+def test_swfs003_flags_invalid_format():
+    found = check("import struct\nstruct.pack('>Z', 1)\n", "SWFS003")
+    assert len(found) == 1 and "invalid" in found[0].message
+
+
+# -- SWFS004: swallowed exceptions ---------------------------------------
+
+def test_swfs004_flags_swallowed_broad():
+    src = """
+    def f():
+        try:
+            g()
+        except Exception:
+            pass
+    """
+    assert len(check(src, "SWFS004")) == 1
+
+
+def test_swfs004_flags_bare_except():
+    src = """
+    def f():
+        try:
+            g()
+        except:
+            log()
+    """
+    found = check(src, "SWFS004")
+    assert len(found) == 1 and "bare" in found[0].message
+
+
+def test_swfs004_negative_handled_or_narrow():
+    src = """
+    def f():
+        try:
+            g()
+        except OSError:
+            pass              # narrow: allowed
+        try:
+            g()
+        except Exception as e:
+            log(e)            # broad but handled: allowed
+        try:
+            g()
+        except:
+            raise             # bare but re-raised: allowed
+    """
+    assert check(src, "SWFS004") == []
+
+
+# -- SWFS005: unclosed handles -------------------------------------------
+
+def test_swfs005_flags_chained_and_discarded():
+    src = """
+    def f(p):
+        data = open(p).read()
+        open(p, "wb")
+        return data
+    """
+    found = check(src, "SWFS005")
+    assert len(found) == 2
+
+
+def test_swfs005_negative_with_close_escape():
+    src = """
+    def f(p):
+        with open(p) as fh:
+            return fh.read()
+
+    def g(p):
+        fh = open(p)
+        try:
+            return fh.read()
+        finally:
+            fh.close()
+
+    def h(p):
+        fh = open(p)
+        return fh              # escapes to the caller
+
+    def i(p, sink):
+        fh = open(p)
+        sink(fh)               # ownership transferred
+
+    def j(self, p):
+        self._f = open(p)      # lifecycle-managed attribute
+
+    def k(p):
+        open(p, "wb").close()  # immediate close (touch)
+    """
+    assert check(src, "SWFS005") == []
+
+
+# -- SWFS006: wall clock in deterministic paths --------------------------
+
+def test_swfs006_flags_marked_module():
+    src = """
+    # swfs: deterministic — replay must be stable
+    import time
+    def replay(rec):
+        rec["at"] = time.time()
+    """
+    found = check(src, "SWFS006")
+    assert len(found) == 1 and "time.time" in found[0].message
+
+
+def test_swfs006_negative_unmarked_module():
+    src = """
+    import time
+    def stamp(rec):
+        rec["at"] = time.time()
+    """
+    assert check(src, "SWFS006") == []
+
+
+def test_swfs006_deterministic_paths_stay_clean():
+    # the shipped deterministic modules must not regress
+    import seaweedfs_tpu.server.raft as raft
+    import seaweedfs_tpu.storage.idx as idx
+    findings, errors = run_paths([raft.__file__, idx.__file__])
+    assert not errors
+    assert [f for f in findings if f.rule == "SWFS006"] == []
+
+
+# -- engine: noqa / baseline ---------------------------------------------
+
+def test_bare_noqa_suppresses_everything():
+    src = """
+    def f():
+        try:
+            g()
+        except Exception:  # noqa
+            pass
+    """
+    assert check(src, "SWFS004") == []
+
+
+def test_baseline_roundtrip(tmp_path):
+    findings = analyze_tree(tmp_path, "legacy.py", """
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """)
+    assert len(findings) == 1
+    bl = tmp_path / "baseline.json"
+    save_baseline(str(bl), findings)
+    new, old = partition_baseline(findings, load_baseline(str(bl)))
+    assert new == [] and len(old) == 1
+    # an edit to the offending line invalidates its fingerprint
+    findings[0].snippet = "except Exception:  # changed"
+    new, old = partition_baseline(findings, load_baseline(str(bl)))
+    assert len(new) == 1 and old == []
+
+
+def test_fingerprints_distinguish_duplicate_lines(tmp_path):
+    findings = analyze_tree(tmp_path, "dup.py", """
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+        def h():
+            try:
+                g()
+            except Exception:
+                pass
+    """)
+    assert len(findings) == 2
+    fps = [fp for _, fp in fingerprints(findings)]
+    assert len(set(fps)) == 2
+
+
+def test_cli_json_output(tmp_path, capsys):
+    from seaweedfs_tpu.devtools.analyze import run_cli
+    p = tmp_path / "bad.py"
+    p.write_text("def f():\n    try:\n        g()\n"
+                 "    except Exception:\n        pass\n")
+    rc = run_cli([str(p)], json_out=True, no_baseline=True)
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["findings"][0]["rule"] == "SWFS004"
+
+
+# -- lockgraph ------------------------------------------------------------
+
+@pytest.fixture
+def graph():
+    return lg.LockGraph()
+
+
+def _tracked_pair(graph):
+    a = lg.TrackedLock(graph, "lock-A", threading.Lock())
+    b = lg.TrackedLock(graph, "lock-B", threading.Lock())
+    return a, b
+
+
+def test_lockgraph_detects_ab_ba_cycle(graph):
+    a, b = _tracked_pair(graph)
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    for fn in (ab, ba):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    cycles = graph.cycles()
+    assert len(cycles) == 1
+    assert set(cycles[0]["cycle"]) == {"lock-A", "lock-B"}
+    assert cycles[0]["stacks"]          # both edges carry stacks
+
+
+def test_lockgraph_consistent_order_is_clean(graph):
+    a, b = _tracked_pair(graph)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert graph.cycles() == []
+    assert graph.report()["edges"] == [["lock-A", "lock-B"]]
+
+
+def test_lockgraph_three_lock_cycle(graph):
+    a = lg.TrackedLock(graph, "A", threading.Lock())
+    b = lg.TrackedLock(graph, "B", threading.Lock())
+    c = lg.TrackedLock(graph, "C", threading.Lock())
+    for first, second in ((a, b), (b, c), (c, a)):
+        with first:
+            with second:
+                pass
+    assert len(graph.cycles()) == 1
+
+
+def test_lockgraph_reentrant_is_not_a_cycle(graph):
+    a = lg.TrackedLock(graph, "R", threading.RLock())
+    with a:
+        with a:
+            pass
+    assert graph.cycles() == []
+
+
+def test_lockgraph_condition_wait_keeps_books_straight(graph):
+    lock = lg.TrackedLock(graph, "cv-lock", threading.RLock())
+    cv = threading.Condition(lock)
+    ready = threading.Event()
+
+    def waiter():
+        with cv:
+            ready.set()
+            cv.wait(timeout=5)
+        assert graph.held() == []       # fully released after exit
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert ready.wait(5)
+    with cv:
+        cv.notify()
+    t.join(5)
+    assert not t.is_alive()
+    assert graph.cycles() == []
+
+
+def test_lockgraph_hold_while_blocking(graph, monkeypatch):
+    a = lg.TrackedLock(graph, "sleepy", threading.Lock())
+    with a:
+        graph.on_blocking_call("time.sleep", "0.2s")
+    v = [x for x in graph.violations
+         if x["kind"] == "hold-while-blocking"]
+    assert len(v) == 1 and v[0]["held"] == ["sleepy"]
+
+
+def test_lockgraph_report_flush(tmp_path, graph):
+    graph.out_path = str(tmp_path / "report.json")
+    a, b = _tracked_pair(graph)
+    with a:
+        with b:
+            pass
+    graph.flush()
+    doc = json.loads((tmp_path / "report.json").read_text())
+    assert doc["violations"] == []
+    assert ["lock-A", "lock-B"] in doc["edges"]

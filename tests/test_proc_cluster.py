@@ -138,6 +138,10 @@ def test_config_matrix_write_read(tmp_path, profile):
     path.  The CLIENT side loads the same security.toml the roles
     did — the reference's matrix drives its clients the same way."""
     from seaweedfs_tpu import security
+    if profile == "tls":
+        # the tls profile mints a PKI via the `cert` CLI, which needs
+        # the cryptography package — absent in some containers
+        pytest.importorskip("cryptography")
     c = ProcCluster(tmp_path, volumes=1, profile=profile).start()
     sec_path = f"{tmp_path}/security.toml"
     try:
@@ -218,3 +222,18 @@ def test_config_matrix_write_read(tmp_path, profile):
     finally:
         security.configure(None)
         c.stop()
+
+
+def test_no_lock_order_cycles_under_traffic(cluster):
+    """The cluster fixture runs every role under the lockgraph race
+    detector (devtools/lockgraph.py); after the write/read/kill9
+    traffic of the tests above, no role may have recorded a lock-order
+    cycle (potential deadlock).  Report files flush continuously, so
+    reading them while the cluster is live is safe."""
+    # drive a little more mixed traffic through every plane first
+    for i in range(5):
+        fid = operation.submit(cluster.master, f"race-{i}".encode())
+        assert operation.read(cluster.master, fid) == f"race-{i}".encode()
+    time.sleep(1.5)     # one detector flush interval
+    cycles = cluster.lock_violations("lock-order-cycle")
+    assert cycles == [], f"lock-order cycles detected: {cycles}"
