@@ -703,6 +703,7 @@ def main(argv: list[str] | None = None) -> int:
     elif args.cmd == "worker":
         from .plugin.handlers import (EcBalanceHandler,
                                       EcEncodeHandler,
+                                      EcRebuildHandler,
                                       VacuumHandler,
                                       VolumeBalanceHandler)
         from .plugin.worker import PluginWorker
@@ -711,6 +712,9 @@ def main(argv: list[str] | None = None) -> int:
         if "erasure_coding" in caps or "ec" in caps:
             handlers.append(EcEncodeHandler(
                 backend=args.backend or None))
+        if "erasure_coding" in caps or "ec" in caps or \
+                "ec_rebuild" in caps:
+            handlers.append(EcRebuildHandler())
         if "vacuum" in caps:
             handlers.append(VacuumHandler())
         if "volume_balance" in caps or "balance" in caps:

@@ -239,24 +239,31 @@ class VolumeServicer:
 
     def VolumeEcShardRead(self, request, context):
         vs = self.vs
-        remaining = request.size
-        offset = request.offset
-        while remaining > 0:
-            n = min(remaining, STREAM_CHUNK)
-            status, resp = vs._ec_shard_read(guarded(
-                context, vs, "/admin/ec/shard_read", query={
+        status, resp = vs._ec_shard_read(guarded(
+            context, vs, "/admin/ec/shard_read", query={
                 "volumeId": request.volume_id,
                 "shardId": request.shard_id,
-                "offset": offset, "size": n}))
-            if status != 200:
-                check_status(context, status, resp)
-            data = resp if isinstance(resp, (bytes, bytearray)) \
-                else bytes(resp)
+                "offset": request.offset, "size": request.size}))
+        if status != 200:
+            check_status(context, status, resp)
+        if isinstance(resp, tuple):
+            # (FileSlice, headers): re-chunk the handler's zero-copy
+            # range stream into response messages without buffering
+            # the whole slice
+            body, _hdrs = resp
+            try:
+                while True:
+                    chunk = body.read(STREAM_CHUNK)
+                    if not chunk:
+                        return
+                    yield pb.VolumeEcShardReadResponse(data=chunk)
+            finally:
+                body.close()
+            return
+        data = resp if isinstance(resp, (bytes, bytearray)) \
+            else bytes(resp)
+        if data:
             yield pb.VolumeEcShardReadResponse(data=data)
-            if len(data) < n:
-                break
-            offset += len(data)
-            remaining -= len(data)
 
     def VolumeEcShardsToVolume(self, request, context):
         status, resp = self.vs._ec_to_volume(guarded(

@@ -1,5 +1,5 @@
 """JobHandlers (weed/plugin/worker/*_handler.go)."""
 
 from .balance import EcBalanceHandler, VolumeBalanceHandler  # noqa: F401
-from .erasure_coding import EcEncodeHandler  # noqa: F401
+from .erasure_coding import EcEncodeHandler, EcRebuildHandler  # noqa: F401
 from .vacuum import VacuumHandler  # noqa: F401

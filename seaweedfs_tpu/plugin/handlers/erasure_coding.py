@@ -330,6 +330,124 @@ class EcEncodeHandler(JobHandler):
                 f"mesh ({ctx.backend}) and distributed")
 
 
+class EcRebuildHandler(JobHandler):
+    """Repair-plane twin of the encode handler: detect EC volumes with
+    missing shards, trigger a slice-pipelined rebuild on the node
+    holding the most survivors (command_ec_rebuild.go Detect/Execute
+    shape).  The worker never stages shard bytes itself — the rebuilder
+    streams survivors off its peers via ranged `/admin/ec/shard_read`
+    (no whole-shard `/admin/ec/copy` round), so the accelerator node's
+    ingest link is not the repair bottleneck."""
+
+    job_type = "ec_rebuild"
+    aliases = ["rebuild"]
+
+    def capability(self) -> dict:
+        # repair outranks balance (30) but defers to encode (80)
+        return {"jobType": self.job_type, "canDetect": True,
+                "canExecute": True, "weight": 70}
+
+    def descriptor(self) -> dict:
+        return {"jobType": self.job_type, "fields": []}
+
+    def _shard_locations(self, worker, vid: int) -> "dict[str, list[int]]":
+        from ...topology import fetch_ec_shard_locations
+        return fetch_ec_shard_locations(worker.master, vid)
+
+    def detect(self, worker) -> list[dict]:
+        from ...storage.erasure_coding.ec_context import (
+            TOTAL_SHARDS_COUNT)
+        from ...topology import iter_volume_list_ec_shards
+        vl = master_json(worker.master, "GET", "/vol/list")
+        per_vid: dict[int, set] = {}
+        holders: dict[int, str] = {}
+        for node, e in iter_volume_list_ec_shards(vl):
+            sids = per_vid.setdefault(e["volumeId"], set())
+            bits = int(e.get("shardBits", e.get("ecIndexBits", 0)))
+            sids.update(i for i in range(32) if bits >> i & 1)
+            holders.setdefault(e["volumeId"], node["url"])
+        proposals = []
+        for vid, present in sorted(per_vid.items()):
+            if present == set(range(TOTAL_SHARDS_COUNT)):
+                # a full default-scheme stripe needs no per-volume
+                # probes: the healthy steady state must cost zero
+                # extra round-trips per detect cycle
+                continue
+            # a gap OR a non-default scheme: one info probe decides
+            r = http_json(
+                "GET", f"{holders[vid]}/admin/ec/info?volumeId={vid}")
+            if "error" in r:
+                continue
+            total = r["dataShards"] + r["parityShards"]
+            missing = [s for s in range(total) if s not in present]
+            if missing and len(present) >= r["dataShards"]:
+                proposals.append({
+                    "jobType": self.job_type,
+                    "dedupeKey": f"ec_rebuild:{vid}",
+                    "params": {"volumeId": vid,
+                               "collection": r.get("collection", ""),
+                               "missingShardIds": missing},
+                })
+        return proposals
+
+    def execute(self, worker, job_id: str, params: dict) -> str:
+        vid = int(params["volumeId"])
+        collection = params.get("collection", "")
+        locs = self._shard_locations(worker, vid)
+        if not locs:
+            raise RuntimeError(f"ec volume {vid} has no shards")
+        # the authoritative scheme from a shard holder: a rebuilder
+        # whose .vif predates the destroy()-keeps-.vif fix must not
+        # fall back to a default 10+4 for a custom-scheme volume
+        info = None
+        for url in locs:
+            r = http_json("GET", f"{url}/admin/ec/info?volumeId={vid}")
+            if "error" not in r:
+                info = r
+                break
+        if info is None:
+            raise RuntimeError(f"ec volume {vid}: no reachable shards")
+        collection = collection or info.get("collection", "")
+        from ...topology import shard_ids_to_urls
+        rebuilder = max(locs, key=lambda u: len(locs[u]))
+        shard_locations = shard_ids_to_urls(locs)
+        worker.report_progress(job_id, 0.1,
+                               f"streaming rebuild on {rebuilder}")
+        r = _must(http_json(
+            "POST", f"{rebuilder}/admin/ec/rebuild",
+            {"volumeId": vid, "collection": collection,
+             "mode": "stream", "shardLocations": shard_locations,
+             "dataShards": info["dataShards"],
+             "parityShards": info["parityShards"]},
+            timeout=600.0), f"rebuild on {rebuilder}")
+        rebuilt = r.get("rebuiltShardIds", [])
+        if rebuilt:
+            _must(http_json("POST", f"{rebuilder}/admin/ec/mount",
+                            {"volumeId": vid, "collection": collection,
+                             "shardIds": rebuilt}),
+                  f"mount rebuilt shards on {rebuilder}")
+        worker.report_progress(job_id, 0.7, f"rebuilt {rebuilt}")
+        # re-spread like the shell flow: leaving every rebuilt shard
+        # on the max-survivor node would silently break the stripe's
+        # anti-correlation (one node failure must not cost >1 shard).
+        # Under the cluster admin lease (.balance convention): an
+        # unlocked balance interleaving with an operator's locked one
+        # could dedupe/delete the same transient shard copy twice.
+        from ...shell.commands import _balance_ec_volume
+        from .balance import _LockedShellRun
+        with _LockedShellRun(worker.master) as env:
+            moved = _balance_ec_volume(
+                env, vid, collection,
+                info["dataShards"] + info["parityShards"])
+        worker.report_progress(job_id, 0.9,
+                               f"rebalanced {moved} shards")
+        tele = r.get("telemetry") or {}
+        return (f"volume {vid}: rebuilt shards {rebuilt} on "
+                f"{rebuilder}, rebalanced {moved} (streamed "
+                f"{tele.get('bytesFetchedTotal', 0) >> 20}MB @ "
+                f"{tele.get('volumeGbps', 0)} GB/s volume-rate)")
+
+
 def _read_dat_version(base: str) -> int:
     from ...storage.super_block import SuperBlock
     with open(base + ".dat", "rb") as f:

@@ -555,6 +555,72 @@ def http_download(url: str, dest_path: str,
             pass
 
 
+def http_relay(src_url: str, dst_method: str, dst_url: str,
+               headers: dict | None = None, timeout: float = 600.0,
+               chunk_size: int = 4 << 20
+               ) -> "tuple[int, int, bytes]":
+    """Stream a GET of `src_url` straight into a chunked-encoded
+    `dst_method dst_url` body: the push starts at the first downloaded
+    chunk, so the two transfer legs overlap instead of staging the
+    whole file through a temp relay, and RAM stays bounded by one
+    chunk.  Returns (src_status, dst_status, dst_body); on a non-2xx
+    source the upload never starts (dst_status 0)."""
+    import http.client
+
+    full_src, src_ctx = _dial(src_url)
+    req = urllib.request.Request(full_src,
+                                 headers=_auth_for(src_url, headers))
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout,
+                                      context=src_ctx)
+    except urllib.error.HTTPError as e:
+        e.close()
+        return e.code, 0, b""
+    with resp:
+        if resp.status != 200:
+            return resp.status, 0, b""
+        full_dst, dst_ctx = _dial(dst_url)
+        parsed = urllib.parse.urlsplit(full_dst)
+        target = parsed.path or "/"
+        if parsed.query:
+            target += "?" + parsed.query
+        if parsed.scheme == "https":
+            conn = http.client.HTTPSConnection(
+                parsed.netloc, timeout=timeout, context=dst_ctx)
+        else:
+            conn = http.client.HTTPConnection(parsed.netloc,
+                                              timeout=timeout)
+        up_headers = dict(_auth_for(dst_url, headers))
+        up_headers["Transfer-Encoding"] = "chunked"
+        expected = resp.length  # None when the source streams chunked
+
+        def chunks():
+            sent = 0
+            while True:
+                chunk = resp.read(chunk_size)
+                if not chunk:
+                    if expected is not None and sent != expected:
+                        # a source dying mid-body reads as plain EOF
+                        # (no IncompleteRead with sized reads) — raise
+                        # instead of finalizing a truncated upload as
+                        # success; the aborted chunked stream also
+                        # errors on the destination
+                        raise OSError(
+                            f"relay source truncated at {sent} of "
+                            f"{expected} bytes")
+                    return
+                sent += len(chunk)
+                yield chunk
+
+        try:
+            conn.request(dst_method, target, body=chunks(),
+                         headers=up_headers, encode_chunked=True)
+            r = conn.getresponse()
+            return 200, r.status, r.read()
+        finally:
+            conn.close()
+
+
 def http_upload(method: str, url: str, src_path: str,
                 headers: dict | None = None, timeout: float = 600.0
                 ) -> tuple[int, bytes, dict]:

@@ -885,10 +885,24 @@ class VolumeServer:
             return 400, {"error": str(e)}
         base = self._base_path(vid, collection)
         n = 0
-        with open(base + ext, "wb") as f:
-            for chunk in req.stream_body():
-                f.write(chunk)
-                n += len(chunk)
+        # temp + rename, like the gRPC ReceiveFile twin: a push that
+        # dies mid-stream (or whose relay SOURCE dies — http_relay
+        # starts this upload before the download completes) must never
+        # leave a truncated file at the final path for _base_path to
+        # later resolve
+        import uuid as _uuid
+        tmp = f"{base}{ext}.recv.{_uuid.uuid4().hex}"
+        try:
+            with open(tmp, "wb") as f:
+                for chunk in req.stream_body():
+                    f.write(chunk)
+                    n += len(chunk)
+            os.replace(tmp, base + ext)
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
         return 200, {"bytes": n}
 
     def _file_path(self, vid: int, collection: str, ext: str
@@ -977,6 +991,12 @@ class VolumeServer:
         source = b["sourceDataNode"]
         base = self._base_path(vid, collection)
         exts = [to_ext(int(s)) for s in b.get("shardIds", [])]
+        if exts:
+            # streaming rebuild must keep this at zero for survivors;
+            # balance moves are the legitimate remaining traffic
+            self.metrics.counter_add(
+                "ec_shard_whole_file_copies", float(len(exts)),
+                help_text="whole shard files pulled via /admin/ec/copy")
         if b.get("copyEcxFile", False):
             exts.append(".ecx")
         if b.get("copyEcjFile", False) :
@@ -1023,15 +1043,135 @@ class VolumeServer:
         return 200, {}
 
     def _ec_rebuild(self, req: Request):
-        """:149 VolumeEcShardsRebuild (multi-disk shard search)."""
+        """:149 VolumeEcShardsRebuild — streaming by default: survivors
+        this node lacks are read in slice windows straight off their
+        host servers' `/admin/ec/shard_read` (one concurrent prefetching
+        stream per source) and fed through the staged GF pipeline, so
+        repair never stages whole survivor files on this node's disks
+        (arXiv:1908.01527 repair pipelining).  `mode: "local"` keeps the
+        seed semantics (every survivor must already be local).  Remote
+        survivor locations come from the request's `shardLocations`
+        ({shard_id: [urls]}) or, absent that, a master ec_lookup —
+        missing both, the handler degrades to the local behavior."""
+        t_start = time.perf_counter()
         b = req.json()
         vid = int(b["volumeId"])
         collection = b.get("collection", "")
         base = self._base_path(vid, collection)
         extra_dirs = [loc.directory for loc in self.store.locations]
-        generated = ec_encoder.rebuild_ec_files(
-            base, additional_dirs=extra_dirs)
-        return 200, {"rebuiltShardIds": generated}
+        if b.get("mode", "stream") == "local":
+            generated = ec_encoder.rebuild_ec_files(
+                base, additional_dirs=extra_dirs)
+            return 200, {"rebuiltShardIds": generated, "mode": "local"}
+        from ..storage.erasure_coding.shard_source import (
+            LocalShardSource, RebuildStats, RemoteShardSource,
+            rebuild_slice_bytes)
+        ctx = ec_encoder.scheme_from_vif(base) or ECContext(
+            int(b.get("dataShards") or 10),
+            int(b.get("parityShards") or 4))
+        # file discovery is the correctness anchor: survivors staged
+        # by a prior VolumeEcShardsCopy exist on disk UNMOUNTED, and
+        # the legacy gRPC copy-then-rebuild flow depends on seeing
+        # them.  The mounted-shard registry only contributes the shard
+        # size (sparing per-remote-source metadata round-trips).
+        present_paths, local_missing = \
+            ec_encoder.discover_shard_files(base, ctx, extra_dirs)
+        ev = self.store.find_ec_volume(vid)
+        size_hint = None
+        if ev is not None:
+            with ev.lock:
+                if ev.shards:
+                    size_hint = max(s.size for s in ev.shards.values())
+        remote: dict[int, list[str]] = {}
+        raw_locs = b.get("shardLocations")
+        if raw_locs is None:
+            raw_locs = self._master_shard_locations(vid)
+        self_urls = {self.http.url, self.store.public_url}
+        for sid_s, urls in (raw_locs or {}).items():
+            sid = int(sid_s)
+            urls = [u for u in urls if u not in self_urls]
+            if sid not in present_paths and urls:
+                remote[sid] = urls
+        targets = [sid for sid in local_missing if sid not in remote]
+        if not targets:
+            return 200, {"rebuiltShardIds": [], "mode": "stream"}
+        sources: dict[int, object] = {
+            sid: LocalShardSource(p) for sid, p in present_paths.items()}
+        # any d survivors reconstruct (every d x d generator submatrix
+        # is invertible), so prefer the free ones: all local shards
+        # first, then only (d - local) remote rows, round-robined
+        # across donor nodes so no single peer's disk serializes the
+        # fetch streams
+        want_remote = max(ctx.data_shards - len(present_paths), 0)
+        by_donor: dict[str, list[int]] = {}
+        for sid in sorted(remote):
+            by_donor.setdefault(remote[sid][0], []).append(sid)
+        chosen: list[int] = []
+        tiers = list(by_donor.values())
+        i = 0
+        while len(chosen) < want_remote and any(tiers):
+            if tiers[i % len(tiers)]:
+                chosen.append(tiers[i % len(tiers)].pop(0))
+            i += 1
+        for sid in chosen:
+            sources[sid] = RemoteShardSource(
+                remote[sid], vid, sid,
+                headers=self.security.admin_headers)
+        stats = RebuildStats()
+        t0 = time.perf_counter()
+        try:
+            if size_hint is None and present_paths:
+                size_hint = max(os.path.getsize(p)
+                                for p in present_paths.values())
+            generated = ec_encoder.rebuild_from_sources(
+                base, ctx, sources, targets, stats=stats,
+                slice_bytes=rebuild_slice_bytes() if chosen else None,
+                shard_size=size_hint)
+        except ValueError as e:
+            return 500, {"error": str(e)}
+        wall = time.perf_counter() - t0
+        shard_size = os.path.getsize(base + ctx.to_ext(targets[0]))
+        tele = stats.summary(ctx.data_shards * shard_size, wall)
+        tele["mode"] = "stream"
+        tele["rebuiltBytes"] = len(generated) * shard_size
+        tele["setupSeconds"] = round(t0 - t_start, 3)
+        self._record_rebuild_metrics(stats, tele)
+        return 200, {"rebuiltShardIds": generated, "mode": "stream",
+                     "telemetry": tele}
+
+    def _master_shard_locations(self, vid: int) -> "dict[str, list[str]]":
+        """Survivor locations for a rebuild that arrived without a
+        `shardLocations` payload (e.g. over the gRPC bridge, whose proto
+        has no such field): ask the master.  Unreachable master degrades
+        to local-only rebuild semantics rather than failing repair."""
+        from ..topology import fetch_ec_shard_locations, \
+            shard_ids_to_urls
+        try:
+            return shard_ids_to_urls(
+                fetch_ec_shard_locations(self.master, vid))
+        except OSError:
+            return {}
+
+    def _record_rebuild_metrics(self, stats, tele: dict) -> None:
+        """stats.py + telemetry.py emission for one streaming rebuild:
+        bytes per source, slice latency histogram, effective GB/s."""
+        by_source, latencies = stats.snapshot()
+        for label, nbytes in by_source.items():
+            self.metrics.counter_add(
+                "ec_rebuild_bytes_fetched_total", float(nbytes),
+                help_text="survivor bytes streamed into EC rebuild",
+                source=label)
+        for seconds in latencies:
+            self.metrics.histogram_observe(
+                "ec_rebuild_slice_seconds", seconds,
+                help_text="per-slice survivor fetch latency")
+        self.metrics.counter_add("ec_rebuilds_total", 1.0,
+                                 help_text="streaming EC rebuilds run")
+        self.metrics.gauge_set(
+            "ec_rebuild_volume_gbps", tele["volumeGbps"],
+            help_text="volume-bytes/s of the last streaming rebuild")
+        from .. import telemetry as _telemetry
+        _telemetry.note_ec_rebuild(tele["bytesFetchedTotal"])
 
     def _ec_to_volume(self, req: Request):
         """:586 VolumeEcShardsToVolume (decode EC -> normal volume)."""
@@ -1054,7 +1194,14 @@ class VolumeServer:
         return 200, {}
 
     def _ec_shard_read(self, req: Request):
-        """:101 VolumeEcShardRead: raw range read of one local shard."""
+        """:101 VolumeEcShardRead: raw range read of one local shard.
+
+        Served from a PRIVATE fd over the shard file: shard files are
+        immutable post-encode, so ranged reads need no shared-handle
+        seek lock — concurrent rebuild slice streams off this node no
+        longer serialize on ev.lock — and the FileSlice response rides
+        the dispatcher's sendfile(2) zero-copy path instead of staging
+        the slice through Python bytes."""
         vid = int(req.query["volumeId"])
         shard_id = int(req.query["shardId"])
         offset = int(req.query.get("offset", 0))
@@ -1062,10 +1209,11 @@ class VolumeServer:
         ev = self.store.find_ec_volume(vid)
         if ev is None or shard_id not in ev.shards:
             return 404, {"error": f"shard {vid}.{shard_id} not found"}
-        # the shard file handle's seek/read must not interleave across
-        # concurrent remote degraded reads (see ec_volume.read_interval)
-        with ev.lock:
-            return 200, ev.shards[shard_id].read_at(offset, size)
+        shard = ev.shards[shard_id]
+        n = max(0, min(size, shard.size - offset))
+        f = open(shard.path, "rb")
+        f.seek(offset)
+        return 200, (FileSlice(f, n), {"Content-Length": str(n)})
 
     def _scrub(self, req: Request):
         """server/volume_grpc_scrub.go ScrubVolume."""
@@ -1101,6 +1249,7 @@ class VolumeServer:
             return 404, {"error": f"ec volume {vid} not mounted"}
         return 200, {
             "volumeId": vid,
+            "collection": ev.collection,
             "shardIds": ev.shard_ids,
             "shardSize": ev.shard_size(),
             "dataShards": ev.ctx.data_shards,

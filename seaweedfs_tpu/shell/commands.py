@@ -366,19 +366,32 @@ def cmd_ec_decode(env: CommandEnv, args: list[str]) -> str:
 
 @command("ec.rebuild")
 def cmd_ec_rebuild(env: CommandEnv, args: list[str]) -> str:
-    """shell/command_ec_rebuild.go:83: for each ec volume missing shards,
-    collect survivors onto one rebuilder node, rebuild, re-spread."""
+    """shell/command_ec_rebuild.go:83: for each ec volume missing
+    shards, rebuild on the node holding the most survivors, re-spread.
+
+    Default `-mode=stream`: the rebuilder streams remote survivors in
+    slice windows straight into the GF pipeline (no whole-shard
+    pre-copies).  `-mode=copy` keeps the legacy collect-then-rebuild
+    (every remote survivor pulled in full via /admin/ec/copy first) —
+    the A/B baseline bench.py measures against."""
     env.confirm_is_locked()
     opts = _parse_flags(args)
+    import os as _os
+    mode = opts.get("mode", _os.environ.get(
+        "SEAWEEDFS_TPU_EC_REBUILD_MODE", "stream"))
+    if mode not in ("stream", "copy"):
+        return f"unknown -mode={mode}; use stream or copy"
     vids = ([int(opts["volumeId"])] if "volumeId" in opts
             else list(_ec_volumes(env)))
     out = []
     for vid in vids:
-        out.append(_rebuild_one(env, vid, opts.get("collection", "")))
+        out.append(_rebuild_one(env, vid, opts.get("collection", ""),
+                                mode))
     return "\n".join(out) if out else "no ec volumes"
 
 
-def _rebuild_one(env: CommandEnv, vid: int, collection: str) -> str:
+def _rebuild_one(env: CommandEnv, vid: int, collection: str,
+                 mode: str = "stream") -> str:
     shard_locs = _ec_shard_locations(env, vid)
     present = sorted({s for sids in shard_locs.values() for s in sids})
     info = None
@@ -393,30 +406,60 @@ def _rebuild_one(env: CommandEnv, vid: int, collection: str) -> str:
     missing = [s for s in range(total) if s not in present]
     if not missing:
         return f"volume {vid}: all {total} shards present"
-    # rebuilder = node with most shards; pull survivors it lacks
+    # rebuilder = node with most shards (fewest bytes left to ingest)
     rebuilder = max(shard_locs, key=lambda u: len(shard_locs[u]))
-    have = set(shard_locs[rebuilder])
-    for url, sids in shard_locs.items():
-        if url == rebuilder:
-            continue
-        need = [s for s in sids if s not in have]
-        if need:
-            http_json("POST", f"{rebuilder}/admin/ec/copy", {
-                "volumeId": vid, "collection": collection,
-                "shardIds": need, "sourceDataNode": url,
-                "copyEcxFile": True, "copyEcjFile": True,
-                "copyVifFile": True})
-            have.update(need)
-    r = http_json("POST", f"{rebuilder}/admin/ec/rebuild",
-                  {"volumeId": vid, "collection": collection})
+    if mode == "copy":
+        # legacy collect-then-rebuild: pull survivors the rebuilder
+        # lacks, in full, one source at a time.  Sidecars
+        # (.ecx/.ecj/.vif) ride along ONCE with the first shard copy —
+        # they are identical on every source, so re-pulling them per
+        # source was pure waste.
+        have = set(shard_locs[rebuilder])
+        sidecars_pending = True
+        for url, sids in shard_locs.items():
+            if url == rebuilder:
+                continue
+            need = [s for s in sids if s not in have]
+            if need:
+                http_json("POST", f"{rebuilder}/admin/ec/copy", {
+                    "volumeId": vid, "collection": collection,
+                    "shardIds": need, "sourceDataNode": url,
+                    "copyEcxFile": sidecars_pending,
+                    "copyEcjFile": sidecars_pending,
+                    "copyVifFile": sidecars_pending})
+                sidecars_pending = False
+                have.update(need)
+        r = http_json("POST", f"{rebuilder}/admin/ec/rebuild",
+                      {"volumeId": vid, "collection": collection,
+                       "mode": "local"})
+    else:
+        # streaming: hand the rebuilder every survivor's locations and
+        # let it range-read slices off its peers — zero /admin/ec/copy
+        # traffic, no survivor files staged on the rebuilder's disks
+        from ..topology import shard_ids_to_urls
+        shard_locations = shard_ids_to_urls(shard_locs)
+        r = http_json("POST", f"{rebuilder}/admin/ec/rebuild",
+                      {"volumeId": vid, "collection": collection,
+                       "mode": "stream",
+                       "shardLocations": shard_locations,
+                       "dataShards": info["dataShards"],
+                       "parityShards": info["parityShards"]},
+                      timeout=600.0)
     if "error" in r:
         raise RuntimeError(f"rebuild: {r['error']}")
     http_json("POST", f"{rebuilder}/admin/ec/mount",
               {"volumeId": vid, "collection": collection,
                "shardIds": r["rebuiltShardIds"]})
     moved = _balance_ec_volume(env, vid, collection, total)
-    return (f"volume {vid}: rebuilt shards {r['rebuiltShardIds']} on "
-            f"{rebuilder}, rebalanced {moved}")
+    msg = (f"volume {vid}: rebuilt shards {r['rebuiltShardIds']} on "
+           f"{rebuilder}, rebalanced {moved}")
+    tele = r.get("telemetry")
+    if tele:
+        msg += (f" [streamed {tele['bytesFetchedTotal'] >> 20}MB "
+                f"from {len(tele['bytesFetchedBySource'])} sources, "
+                f"{tele['volumeGbps']} GB/s volume-rate, "
+                f"slice p95 {tele['sliceP95Ms']}ms]")
+    return msg
 
 
 @command("ec.balance")
@@ -442,28 +485,25 @@ def cmd_ec_balance(env: CommandEnv, args: list[str]) -> str:
 def _copy_volume_files(env: CommandEnv, vid: int, collection: str,
                        src: str, dst: str) -> None:
     """Pull .dat/.idx/.vif from src and push to dst (the CopyFile /
-    ReceiveFile pattern, volume_server.proto:69-101), relayed through a
-    temp file with streaming transfers on both legs — the shell must
-    not buffer a 30GB .dat in RAM any more than the worker may."""
-    import os as _os
-    import tempfile
-
-    from ..server.httpd import http_download, http_upload
-    with tempfile.TemporaryDirectory(prefix="vol_copy_") as tmp:
-        relay = _os.path.join(tmp, "relay")
-        for ext in (".dat", ".idx", ".vif"):
-            status, _hdrs = http_download(
-                f"{src}/admin/volume_file?volumeId={vid}"
-                f"&collection={collection}&ext={ext}", relay)
-            if status != 200:
-                if ext == ".vif":
-                    continue
-                raise RuntimeError(f"copy {ext} from {src}: {status}")
-            status, body, _ = http_upload(
-                "POST", f"{dst}/admin/receive_file?volumeId={vid}"
-                f"&collection={collection}&ext={ext}", relay)
-            if status != 200:
-                raise RuntimeError(f"push {ext} to {dst}: {status}")
+    ReceiveFile pattern, volume_server.proto:69-101).  The two legs are
+    pipelined through http_relay — the push to dst starts at the first
+    downloaded chunk instead of after a full stage-to-temp-file pass —
+    while RAM stays bounded by one 4MB chunk, so the shell never
+    buffers a 30GB .dat any more than the worker may."""
+    from ..server.httpd import http_relay
+    for ext in (".dat", ".idx", ".vif"):
+        src_status, dst_status, body = http_relay(
+            f"{src}/admin/volume_file?volumeId={vid}"
+            f"&collection={collection}&ext={ext}",
+            "POST", f"{dst}/admin/receive_file?volumeId={vid}"
+            f"&collection={collection}&ext={ext}")
+        if src_status != 200:
+            if ext == ".vif":
+                continue
+            raise RuntimeError(f"copy {ext} from {src}: {src_status}")
+        if dst_status != 200:
+            raise RuntimeError(
+                f"push {ext} to {dst}: {dst_status} {body[:200]!r}")
 
 
 def _move_volume(env: CommandEnv, vid: int, collection: str,
@@ -639,11 +679,8 @@ def _ec_volumes(env: CommandEnv) -> dict[int, None]:
 
 
 def _ec_shard_locations(env: CommandEnv, vid: int) -> dict[str, list[int]]:
-    r = master_json(env.master, "GET", f"/dir/ec_lookup?volumeId={vid}")
-    if "error" in r:
-        return {}
-    return {loc["url"]: loc["shardIds"]
-            for loc in r.get("shardIdLocations", [])}
+    from ..topology import fetch_ec_shard_locations
+    return fetch_ec_shard_locations(env.master, vid)
 
 
 def _all_node_urls(env: CommandEnv) -> list[str]:

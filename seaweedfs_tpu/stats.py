@@ -11,12 +11,19 @@ import urllib.parse
 from collections import defaultdict
 
 
+# latency buckets spanning loopback slice fetches (ms) through WAN
+# shard pulls (seconds) — the EC rebuild observation range
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0)
+
+
 class Metrics:
     def __init__(self, namespace: str):
         self.namespace = namespace
         self._lock = threading.Lock()
         self._counters: dict[tuple[str, tuple], float] = defaultdict(float)
         self._gauges: dict[tuple[str, tuple], float] = {}
+        self._hists: dict[tuple[str, tuple], dict] = {}
         self._help: dict[str, str] = {}
 
     def counter_add(self, name: str, value: float = 1.0,
@@ -32,6 +39,30 @@ class Metrics:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             self._gauges[key] = value
+            if help_text:
+                self._help.setdefault(name, help_text)
+
+    def histogram_observe(self, name: str, value: float,
+                          buckets: "tuple[float, ...]" = DEFAULT_BUCKETS,
+                          help_text: str = "", **labels) -> None:
+        """Prometheus histogram (metrics.go uses prometheus.Histogram
+        for the same surfaces — request/operation latencies)."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = {
+                    "buckets": tuple(buckets),
+                    "counts": [0] * (len(buckets) + 1),  # +Inf last
+                    "sum": 0.0, "count": 0}
+            for i, le in enumerate(h["buckets"]):
+                if value <= le:
+                    h["counts"][i] += 1
+                    break
+            else:
+                h["counts"][-1] += 1
+            h["sum"] += value
+            h["count"] += 1
             if help_text:
                 self._help.setdefault(name, help_text)
 
@@ -55,6 +86,24 @@ class Metrics:
                         out.append(f"{full}{{{lbl}}} {value}")
                     else:
                         out.append(f"{full} {value}")
+            for (name, labels), h in sorted(self._hists.items()):
+                full = f"{self.namespace}_{name}"
+                if full not in seen_types:
+                    if name in self._help:
+                        out.append(f"# HELP {full} {self._help[name]}")
+                    out.append(f"# TYPE {full} histogram")
+                    seen_types.add(full)
+                base = [f'{k}="{v}"' for k, v in labels]
+                cum = 0
+                for le, n in zip(h["buckets"], h["counts"]):
+                    cum += n
+                    lbl = ",".join(base + [f'le="{le}"'])
+                    out.append(f"{full}_bucket{{{lbl}}} {cum}")
+                lbl = ",".join(base + ['le="+Inf"'])
+                out.append(f"{full}_bucket{{{lbl}}} {h['count']}")
+                suffix = f"{{{','.join(base)}}}" if base else ""
+                out.append(f"{full}_sum{suffix} {h['sum']}")
+                out.append(f"{full}_count{suffix} {h['count']}")
         return "\n".join(out) + "\n"
 
 

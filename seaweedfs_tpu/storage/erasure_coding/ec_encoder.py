@@ -368,9 +368,13 @@ def _find_shard_file(base_file_name: str, ext: str,
     return None
 
 
-def _generate_missing_ec_files(base_file_name: str, ctx: ECContext,
-                               additional_dirs: list[str]) -> list[int]:
-    """Two-pass discover-then-create (ec_encoder.go:146)."""
+def discover_shard_files(base_file_name: str, ctx: ECContext,
+                         additional_dirs: list[str]
+                         ) -> "tuple[dict[int, str], list[int]]":
+    """(present shard paths by id, locally-missing shard ids) — the
+    discovery half of the two-pass rebuild (ec_encoder.go:146), shared
+    with the streaming server handler which fills the gaps with remote
+    sources instead of erroring."""
     present_paths: dict[int, str] = {}
     missing: list[int] = []
     for sid in range(ctx.total):
@@ -380,6 +384,15 @@ def _generate_missing_ec_files(base_file_name: str, ctx: ECContext,
             present_paths[sid] = p
         else:
             missing.append(sid)
+    return present_paths, missing
+
+
+def _generate_missing_ec_files(base_file_name: str, ctx: ECContext,
+                               additional_dirs: list[str]) -> list[int]:
+    """Two-pass discover-then-create (ec_encoder.go:146), local files
+    only — every survivor must already be on this node's disks."""
+    present_paths, missing = discover_shard_files(
+        base_file_name, ctx, additional_dirs)
     if len(present_paths) < ctx.data_shards:
         raise ValueError(
             f"not enough shards to rebuild {base_file_name}: found "
@@ -387,37 +400,109 @@ def _generate_missing_ec_files(base_file_name: str, ctx: ECContext,
             f"missing {missing}")
     if not missing:
         return []
+    from .shard_source import LocalShardSource
+    sources = {sid: LocalShardSource(p)
+               for sid, p in present_paths.items()}
+    return rebuild_from_sources(base_file_name, ctx, sources, missing)
+
+
+def rebuild_from_sources(base_file_name: str, ctx: ECContext,
+                         sources: dict, missing: list[int],
+                         stats=None, slice_bytes: int | None = None,
+                         shard_size: int | None = None) -> list[int]:
+    """Regenerate `missing` shard files from survivor `sources`
+    ({shard_id: ShardSource}) through the staged pipeline: a
+    MultiSourceFetcher streams slice windows (one concurrent ranged
+    stream per prefetching source), the GF kernel applies the
+    reconstruction matrix, and the writer appends to the new shard
+    files — fetch, codec, and writes overlap end to end.  Slice
+    boundaries never change output bytes (the GF apply is
+    byte-independent), so this is byte-identical to the local
+    collect-then-rebuild path for any window size.  Closes every
+    source."""
     from ...ops import rs_matrix
-    codec = ctx.create_codec()
-    # One matrix maps the first data_shards survivors directly onto ALL
-    # missing rows (data and parity targets alike), so each step is a
-    # single [len(missing), d] x [d, batch] apply over only the bytes
-    # that are actually regenerated — no full-array copies.
-    present_mask = tuple(sid in present_paths for sid in range(ctx.total))
-    rec_matrix, survivor_rows = rs_matrix.cached_reconstruction_matrix(
-        ctx.data_shards, ctx.parity_shards, present_mask, tuple(missing))
-    shard_size = max(os.path.getsize(p) for p in present_paths.values())
-    inputs = {sid: open(present_paths[sid], "rb")
-              for sid in survivor_rows}
-    outputs = {sid: open(base_file_name + ctx.to_ext(sid), "wb")
-               for sid in missing}
-    step = ctx.batch_size(LARGE_BLOCK_SIZE)
-    work = [(pos, min(step, shard_size - pos))
-            for pos in range(0, shard_size, step)]
-    d = ctx.data_shards
+    from .shard_source import MultiSourceFetcher
+    outputs: dict = {}
+    fetcher = None
+    try:
+        if len(sources) < ctx.data_shards:
+            raise ValueError(
+                f"not enough shards to rebuild {base_file_name}: "
+                f"found {len(sources)}, need {ctx.data_shards}, "
+                f"missing {missing}")
+        codec = ctx.create_codec()
+        # One matrix maps the first data_shards survivors directly
+        # onto ALL missing rows (data and parity targets alike), so
+        # each step is a single [len(missing), d] x [d, batch] apply
+        # over only the bytes that are actually regenerated — no
+        # full-array copies.
+        present_mask = tuple(sid in sources
+                             for sid in range(ctx.total))
+        rec_matrix, survivor_rows = \
+            rs_matrix.cached_reconstruction_matrix(
+                ctx.data_shards, ctx.parity_shards, present_mask,
+                tuple(missing))
+        used = {sid: sources[sid] for sid in survivor_rows}
+        for sid in sources:
+            if sid not in used:  # survivors beyond the first d: unused
+                sources[sid].close()
+        if shard_size is None:
+            # every shard file is the same length by construction, so
+            # a caller holding ANY shard passes the size and spares
+            # one metadata round-trip per remote source (they were
+            # serial and measurably front-loaded the repair)
+            shard_size = max(src.size() for src in used.values())
+        for sid in missing:
+            outputs[sid] = open(base_file_name + ctx.to_ext(sid), "wb")
+        if slice_bytes:
+            # `slice_bytes` caps the window; small shards get windows
+            # cut to ~1/8 of the shard (floor 1MB, or the explicit cap
+            # when smaller) so the per-source prefetch pipelines
+            # actually overlap fetch with compute instead of
+            # degenerating to one or two giant slices
+            step = max(min(slice_bytes, -(-shard_size // 8)),
+                       min(slice_bytes, 1 << 20))
+        else:
+            step = ctx.batch_size(LARGE_BLOCK_SIZE)
+        work = [(pos, min(step, shard_size - pos))
+                for pos in range(0, shard_size, step)]
+        d = ctx.data_shards
+        fetcher = MultiSourceFetcher(used, work, stats=stats)
+    except BaseException:
+        # setup failed before the pipeline owned these resources: a
+        # retrying caller (worker cron) must not leak one fd set per
+        # attempt, nor leave empty target files for discovery to
+        # mistake for survivors
+        if fetcher is not None:
+            fetcher.close()
+        else:
+            for src in sources.values():
+                src.close()
+        for sid, f in outputs.items():
+            f.close()
+            try:
+                os.remove(base_file_name + ctx.to_ext(sid))
+            except OSError:
+                pass
+        raise
 
     def read_item(item, buf):
         pos, n = item
         if buf is None or buf.shape != (d, n):
             buf = np.empty((d, n), dtype=np.uint8)
-        buf.fill(0)
+        # every source fills its staging row in place (local files
+        # readinto it directly; remote windows are copied once out of
+        # a recycled receive buffer).  Only the short tail of a row is
+        # zeroed (EOF zero-padding, ec_encoder.go:258-262) — a
+        # full-buffer memset per window was measurably the pipeline's
+        # single largest memory cost.
+        filled = fetcher.get(
+            item, rows={sid: memoryview(buf[row])
+                        for row, sid in enumerate(survivor_rows)})
         for row, sid in enumerate(survivor_rows):
-            f = inputs[sid]
-            f.seek(pos)
-            chunk = f.read(n)
-            if chunk:  # short survivor files zero-pad
-                buf[row, :len(chunk)] = np.frombuffer(chunk,
-                                                      dtype=np.uint8)
+            got = filled[sid]
+            if got < n:
+                buf[row, got:] = 0
         return (buf, n)
 
     lazy = getattr(codec, "apply_matrix_lazy", None)
@@ -443,10 +528,17 @@ def _generate_missing_ec_files(base_file_name: str, ctx: ECContext,
         try:
             flusher.stop(final=ok)
         finally:
-            for f in inputs.values():
+            fetcher.close()  # joins prefetch threads, closes sources
+            for sid, f in outputs.items():
                 f.close()
-            for f in outputs.values():
-                f.close()
+                if not ok:
+                    # a truncated .ecNN left behind would be counted
+                    # as a SURVIVOR by the next rebuild's discovery —
+                    # failed repairs must leave no partial targets
+                    try:
+                        os.remove(base_file_name + ctx.to_ext(sid))
+                    except OSError:
+                        pass
     return missing
 
 

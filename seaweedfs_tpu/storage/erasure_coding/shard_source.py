@@ -1,0 +1,532 @@
+"""Pluggable rebuild inputs: where survivor shard slices come from.
+
+The streaming distributed rebuild ("Repair Pipelining for Erasure-Coded
+Storage", arXiv:1908.01527) replaces the collect-then-rebuild shape —
+pull every survivor file whole onto one node, then reconstruct — with a
+slice pipeline: each survivor is read in fixed windows through a
+ShardSource (a local file today, a ranged `/admin/ec/shard_read` HTTP
+stream for remote survivors), one concurrent stream per source with a
+bounded prefetch queue, feeding the GF kernel through the same
+`_staged_run` triple-buffer the encode path uses.  Repair wall-clock
+then overlaps network fetch, the codec, and shard-file writes instead
+of serializing d full-file copies through one ingest link (repair
+ingest, not the codec, dominates at scale — arXiv:1709.05365).
+
+Memory stays bounded by sources x (prefetch_depth + 1) x slice bytes:
+the defaults (8MB slices, depth 2) keep a 10-survivor rebuild under
+~¼GB of staged slices.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+import urllib.parse
+
+
+def rebuild_slice_bytes() -> int:
+    """Slice window per survivor stream.  8–64MB all work (the GF apply
+    is byte-independent so the window never changes output bytes);
+    bigger windows amortize per-request overhead, smaller ones bound
+    staging RAM.  SEAWEEDFS_TPU_EC_REBUILD_SLICE_MB overrides."""
+    try:
+        mb = int(os.environ.get("SEAWEEDFS_TPU_EC_REBUILD_SLICE_MB", "8"))
+    except ValueError:
+        mb = 8
+    return max(1, min(mb, 1024)) << 20
+
+
+def rebuild_prefetch_depth() -> int:
+    """Slices queued ahead per survivor stream (>= 2 so the fetch of
+    slice k+1 overlaps the codec on slice k even when one source
+    hiccups).  SEAWEEDFS_TPU_EC_REBUILD_PREFETCH overrides."""
+    try:
+        d = int(os.environ.get("SEAWEEDFS_TPU_EC_REBUILD_PREFETCH", "2"))
+    except ValueError:
+        d = 2
+    return max(1, d)
+
+
+class ShardSource:
+    """One survivor shard's byte range reader.  `prefetch` marks
+    sources worth a dedicated fetch thread (remote streams); local
+    files are read inline by the pipeline's reader stage."""
+
+    prefetch = False
+    label = "?"
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def read_at(self, pos: int, n: int) -> bytes:
+        """Bytes [pos, pos+n) of the shard; short only at EOF (the
+        rebuild zero-pads short survivors, ec_encoder.go:258-262)."""
+        raise NotImplementedError
+
+    def read_into(self, pos: int, n: int, out) -> int:
+        """read_at straight into a writable memoryview (the staging
+        buffer row) — inline sources skip one bytes alloc + copy per
+        window.  Returns bytes filled; short only at EOF."""
+        data = self.read_at(pos, n)
+        out[:len(data)] = data
+        return len(data)
+
+    def iter_slices(self, work: "list[tuple[int, int]]"):
+        """Yield the shard's bytes window by window.  Sources with a
+        cheaper sequential plan (one long ranged stream instead of a
+        request per window) override this."""
+        for pos, n in work:
+            yield self.read_at(pos, n)
+
+    def close(self) -> None:
+        pass
+
+
+class LocalShardSource(ShardSource):
+    """A shard file on this node's disks (the only source the seed's
+    collect-then-rebuild path ever had)."""
+
+    label = "local"
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "rb")
+
+    def size(self) -> int:
+        return os.path.getsize(self.path)
+
+    def read_at(self, pos: int, n: int) -> bytes:
+        self._f.seek(pos)
+        return self._f.read(n)
+
+    def read_into(self, pos: int, n: int, out) -> int:
+        self._f.seek(pos)
+        return self._f.readinto(out[:n])
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class RemoteShardSource(ShardSource):
+    """Ranged reads of a survivor mounted on another volume server via
+    `/admin/ec/shard_read` (volume_server.proto:101 VolumeEcShardRead)
+    with failover across every node that holds the shard.  No whole-file
+    pre-copy: slices stream straight into the rebuild pipeline."""
+
+    prefetch = True
+
+    def __init__(self, urls: "list[str]", vid: int, sid: int,
+                 headers=None, timeout: float = 60.0):
+        if not urls:
+            raise ValueError(f"shard {sid}: no source urls")
+        self._urls = list(urls)
+        self.vid = vid
+        self.sid = sid
+        self.label = self._urls[0]
+        # callable -> auth headers (the owning server's admin creds);
+        # the global-config auto-attach covers the default case
+        self._headers = headers or (lambda: {})
+        self._timeout = timeout
+        self._size: int | None = None
+
+    def size(self) -> int:
+        if self._size is None:
+            from ...server.httpd import http_json
+            last = "no urls"
+            for url in self._urls:
+                try:
+                    r = http_json(
+                        "GET", f"{url}/admin/ec/info?volumeId={self.vid}",
+                        timeout=10, headers=self._headers())
+                except OSError as e:
+                    last = repr(e)
+                    continue
+                if "error" not in r:
+                    self._size = int(r.get("shardSize", 0))
+                    return self._size
+                last = r["error"]
+            raise OSError(
+                f"shard {self.vid}.{self.sid}: size lookup failed on "
+                f"{self._urls}: {last}")
+        return self._size
+
+    def read_at(self, pos: int, n: int) -> bytes:
+        from ...server.httpd import http_bytes
+        last = "no urls"
+        for url in self._urls:
+            try:
+                status, body, _ = http_bytes(
+                    "GET",
+                    f"{url}/admin/ec/shard_read?volumeId={self.vid}"
+                    f"&shardId={self.sid}&offset={pos}&size={n}",
+                    timeout=self._timeout, headers=self._headers())
+            except OSError as e:
+                last = repr(e)
+                continue
+            if status == 200 and len(body) <= n:
+                # short only at EOF; the pipeline zero-pads
+                self.label = url
+                return body
+            last = f"HTTP {status} ({len(body)} bytes)"
+        raise OSError(
+            f"shard {self.vid}.{self.sid} slice @{pos}+{n}: every "
+            f"source failed, last: {last}")
+
+    # -- sequential streaming plan ------------------------------------
+
+    def _open_stream(self, url: str, pos: int, n: int):
+        """One ranged GET covering [pos, pos+n); the response is read
+        incrementally, so a whole rebuild costs ONE request per source
+        (sendfile on the serving side end to end) instead of a request
+        per slice — per-request overhead was measured at ~20x the
+        loopback wire time of a 1MB slice.  Returns (conn, resp,
+        promised) where `promised` is the Content-Length the server
+        committed to: fewer delivered bytes mean a dead donor, NOT a
+        short shard."""
+        import http.client
+
+        from ...server.httpd import _auth_for, _dial
+        full, ctx = _dial(url)
+        parsed = urllib.parse.urlsplit(full)
+        if parsed.scheme == "https":
+            conn = http.client.HTTPSConnection(
+                parsed.netloc, timeout=self._timeout, context=ctx)
+        else:
+            conn = http.client.HTTPConnection(parsed.netloc,
+                                              timeout=self._timeout)
+        conn.request(
+            "GET",
+            f"/admin/ec/shard_read?volumeId={self.vid}"
+            f"&shardId={self.sid}&offset={pos}&size={n}",
+            headers=_auth_for(url, self._headers()))
+        resp = conn.getresponse()
+        if resp.status != 200:
+            conn.close()
+            raise OSError(f"shard_read {url}: HTTP {resp.status}")
+        promised = resp.length if resp.length is not None else n
+        return conn, resp, promised
+
+    def iter_slices(self, work: "list[tuple[int, int]]"):
+        for buf, got in self.iter_slices_into(
+                work, lambda n: bytearray(n)):
+            yield bytes(buf[:got]) if buf is not None else b""
+
+    def iter_slices_into(self, work: "list[tuple[int, int]]",
+                         take_buf, record=None):
+        """Window stream with RECYCLED receive buffers: `take_buf(n)`
+        hands back a writable buffer (the fetcher recycles a small
+        pool, so the hot loop allocates nothing), each window is
+        readinto'd straight off the socket, and (buffer, filled) pairs
+        are yielded.  A mid-stream source death resumes at the CURRENT
+        window from the next url — already-yielded windows stay
+        valid.  `record(label, nbytes, seconds)` is called with the
+        time spent on the WIRE only (connect + readinto) — waiting for
+        a recycled buffer is consumer backpressure, and billing it as
+        fetch latency would make a slow codec look like a slow
+        donor."""
+        if not work:
+            return
+        end = work[-1][0] + work[-1][1]
+        i = 0
+        conn = resp = None
+        promised = 0  # bytes the current response committed to deliver
+        delivered = 0  # bytes consumed from the current response
+        eof = False
+        failures = 0
+        budget = 2 * len(self._urls)
+        buf = None  # held across failover retries of the SAME window:
+        # taking a fresh pool buffer per retry would strand the old
+        # one and starve take_buf into a deadlock
+        try:
+            while i < len(work):
+                pos, n = work[i]
+                if eof:
+                    yield None, 0
+                    i += 1
+                    continue
+                wire = 0.0
+                if resp is None:
+                    url = self._urls[failures % len(self._urls)]
+                    t0 = time.perf_counter()
+                    try:
+                        conn, resp, promised = self._open_stream(
+                            url, pos, end - pos)
+                    except OSError:
+                        failures += 1
+                        if failures > budget:
+                            raise
+                        continue
+                    wire += time.perf_counter() - t0
+                    delivered = 0
+                    self.label = url
+                # what THIS response still owes for this window: the
+                # Content-Length is the server's commitment, so fewer
+                # bytes than `expect` is a dead/truncating donor to
+                # fail over from — NOT a short shard to zero-pad
+                # (HTTPResponse.readinto reports a premature clean
+                # close as plain EOF, never an error)
+                expect = min(n, promised - delivered)
+                if buf is None:
+                    buf = take_buf(n)
+                t0 = time.perf_counter()
+                try:
+                    got = self._read_exact_into(resp, buf, expect)
+                    if got < expect:
+                        raise OSError(
+                            f"shard_read {self.label}: stream "
+                            f"truncated at {delivered + got} of "
+                            f"{promised} promised bytes")
+                except OSError:
+                    conn.close()
+                    conn = resp = None
+                    failures += 1
+                    if failures > budget:
+                        raise
+                    continue
+                wire += time.perf_counter() - t0
+                delivered += got
+                failures = 0  # a delivered window proves the donor
+                # set healthy again: the budget bounds consecutive
+                # failures, not total blips over a multi-GB stream
+                if got < n:
+                    eof = True  # short shard: zero-pad from here on
+                if record is not None:
+                    record(self.label, got, wire)
+                yield buf, got
+                buf = None  # ownership passed to the consumer
+                i += 1
+        finally:
+            if conn is not None:
+                conn.close()
+
+    @staticmethod
+    def _read_exact_into(resp, buf, n: int) -> int:
+        """Fill buf[:n] from the response; short only at EOF."""
+        mv = memoryview(buf)
+        filled = 0
+        while filled < n:
+            k = resp.readinto(mv[filled:n])
+            if not k:
+                break
+            filled += k
+        return filled
+
+
+class RebuildStats:
+    """Per-rebuild telemetry accumulator: bytes fetched per source,
+    slice fetch latencies, wall clock.  Thread-safe (prefetch threads
+    record concurrently); summarized once at the end."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.bytes_by_source: dict[str, int] = {}
+        self.latencies: list[float] = []
+        self.slices = 0
+
+    def record(self, label: str, nbytes: int, seconds: float) -> None:
+        with self._lock:
+            self.bytes_by_source[label] = \
+                self.bytes_by_source.get(label, 0) + nbytes
+            self.latencies.append(seconds)
+            self.slices += 1
+
+    def snapshot(self) -> "tuple[dict[str, int], list[float]]":
+        """(bytes by source, latencies) copied under the lock — a
+        straggler prefetch thread surviving fetcher.close()'s bounded
+        join may still be recording."""
+        with self._lock:
+            return dict(self.bytes_by_source), list(self.latencies)
+
+    @staticmethod
+    def _pct(sorted_vals: "list[float]", q: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1)))
+        return sorted_vals[i]
+
+    def summary(self, volume_bytes: int, wall_seconds: float) -> dict:
+        """JSON-able summary; `volume_bytes` is the data_shards x
+        shard_size volume-equivalent (how `weed shell` throughput is
+        judged everywhere else in this repo)."""
+        with self._lock:
+            lats = sorted(self.latencies)
+            by_source = dict(self.bytes_by_source)
+        total = sum(by_source.values())
+        wall = max(wall_seconds, 1e-9)
+        return {
+            "bytesFetchedBySource": by_source,
+            "bytesFetchedTotal": total,
+            "slices": self.slices,
+            "sliceP50Ms": round(self._pct(lats, 0.50) * 1e3, 3),
+            "sliceP95Ms": round(self._pct(lats, 0.95) * 1e3, 3),
+            "sliceMaxMs": round((lats[-1] if lats else 0.0) * 1e3, 3),
+            "wallSeconds": round(wall, 3),
+            "fetchGbps": round(total / wall / 1e9, 6),
+            "volumeGbps": round(volume_bytes / wall / 1e9, 6),
+        }
+
+
+class _SourceAborted(Exception):
+    """The fetcher was closed while a stage was parked on a queue."""
+
+
+class MultiSourceFetcher:
+    """One concurrent slice stream per prefetching source.
+
+    Every source walks the SAME slice schedule (`work`: ordered
+    (pos, n) windows).  Prefetching sources get a dedicated thread
+    filling a bounded queue `depth` slices ahead; inline sources
+    (local files) are read on demand by the consumer.  `get(i, item)`
+    must be called in schedule order (the rebuild pipeline's reader
+    stage is FIFO) and returns {sid: bytes} for that window.
+
+    A source failure is delivered in-band: the worker parks the
+    exception at its queue head and the next `get` re-raises it, so
+    the pipeline aborts promptly instead of rebuilding garbage."""
+
+    def __init__(self, sources: "dict[int, ShardSource]",
+                 work: "list[tuple[int, int]]",
+                 depth: int | None = None,
+                 stats: "RebuildStats | None" = None):
+        self.sources = sources
+        self.work = work
+        self.stats = stats
+        self._stop = threading.Event()
+        self._queues: dict[int, "queue.Queue"] = {}
+        self._pools: dict[int, "queue.Queue"] = {}
+        self._threads: list[threading.Thread] = []
+        depth = depth or rebuild_prefetch_depth()
+        for sid, src in sources.items():
+            if src.prefetch:
+                q: "queue.Queue" = queue.Queue(maxsize=depth)
+                pool: "queue.Queue" = queue.Queue()
+                for _ in range(depth + 1):  # lazy-allocated slots
+                    pool.put(None)
+                self._queues[sid] = q
+                self._pools[sid] = pool
+                t = threading.Thread(target=self._fetch_loop,
+                                     args=(src, q, pool), daemon=True)
+                self._threads.append(t)
+                t.start()
+
+    def _read(self, src: ShardSource, pos: int, n: int) -> bytes:
+        t0 = time.perf_counter()
+        data = src.read_at(pos, n)
+        if self.stats is not None:
+            self.stats.record(src.label, len(data),
+                              time.perf_counter() - t0)
+        return data
+
+    def _put(self, q: "queue.Queue", item) -> bool:
+        while not self._stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _fetch_loop(self, src: ShardSource, q: "queue.Queue",
+                    pool: "queue.Queue") -> None:
+        def take_buf(n: int):
+            """Recycle a receive buffer from the pool — the hot loop
+            allocates nothing after warm-up (fresh >1MB bytes objects
+            are mmap'd and page-fault on every fill)."""
+            while True:
+                try:
+                    b = pool.get(timeout=0.2)
+                    break
+                except queue.Empty:
+                    if self._stop.is_set():
+                        raise _SourceAborted() from None
+            if b is None or len(b) < n:
+                b = bytearray(n)
+            return b
+
+        try:
+            if hasattr(src, "iter_slices_into"):
+                # the source records its own wire-only latency, so
+                # take_buf backpressure never shows up as fetch time
+                record = self.stats.record if self.stats is not None \
+                    else None
+                it = src.iter_slices_into(self.work, take_buf,
+                                          record=record)
+                for buf, got in it:
+                    if not self._put(q, (buf, got)):
+                        return
+                return
+            it = ((buf, len(buf)) for buf in
+                  src.iter_slices(self.work))
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    buf, got = next(it)
+                except StopIteration:
+                    return
+                if self.stats is not None:
+                    self.stats.record(src.label, got,
+                                      time.perf_counter() - t0)
+                if not self._put(q, (buf, got)):
+                    return
+        except _SourceAborted:
+            pass
+        except BaseException as e:  # noqa: BLE001 — re-raised by get()
+            self._put(q, e)
+
+    def get(self, item: "tuple[int, int]", rows=None
+            ) -> "dict[int, int]":
+        """Fill each source's staging row for this window; returns
+        {sid: bytes filled}.  `rows` maps sid -> writable memoryview.
+        Inline (local) sources read STRAIGHT into their row; queued
+        (remote) windows are copied out of the recycled receive buffer
+        which is then returned to its pool."""
+        pos, n = item
+        out: dict[int, int] = {}
+        for sid, src in self.sources.items():
+            q = self._queues.get(sid)
+            row = rows[sid] if rows is not None else None
+            if q is None:
+                if row is not None:
+                    t0 = time.perf_counter()
+                    got = src.read_into(pos, n, row)
+                    if self.stats is not None:
+                        self.stats.record(src.label, got,
+                                          time.perf_counter() - t0)
+                    out[sid] = got
+                else:
+                    data = self._read(src, pos, n)
+                    out[sid] = len(data)
+                continue
+            while True:
+                try:
+                    v = q.get(timeout=0.2)
+                    break
+                except queue.Empty:
+                    if self._stop.is_set():
+                        raise _SourceAborted() from None
+            if isinstance(v, BaseException):
+                raise v
+            buf, got = v
+            if got and row is not None:
+                row[:got] = memoryview(buf)[:got]
+            if buf is not None:
+                self._pools[sid].put(buf)
+            out[sid] = got
+        return out
+
+    def close(self) -> None:
+        self._stop.set()
+        for q in self._queues.values():  # unblock parked producers
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+        for pool in self._pools.values():  # and buffer-starved ones
+            pool.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
+        for src in self.sources.values():
+            src.close()

@@ -625,7 +625,15 @@ class Volume:
 
     def destroy(self) -> None:
         self.close()
-        for ext in (".dat", ".idx", ".vif", ".cpd", ".cpx"):
+        exts = [".dat", ".idx", ".cpd", ".cpx"]
+        if not (os.path.exists(self.file_name(".ecx")) or
+                os.path.exists(self.file_name(".ec00"))):
+            # the .vif is shared with a live EC conversion of this
+            # volume: it records the RS scheme rebuild/decode recover
+            # (ec_encoder.scheme_from_vif), so deleting the original
+            # volume after ec.encode must leave it for the shards
+            exts.append(".vif")
+        for ext in exts:
             try:
                 os.remove(self.file_name(ext))
             except FileNotFoundError:

@@ -21,6 +21,26 @@ from . import __version__
 
 VERSION = f"seaweedfs-tpu/{__version__}"
 
+# -- in-process repair aggregates (collector.go shape: counts only) -------
+#
+# The streaming EC rebuild records anonymous totals here; the opt-in
+# reporter folds them into its periodic shape report so fleet-wide
+# repair volume is visible without any per-volume identifiers.
+
+_repair_lock = threading.Lock()
+_repair_totals = {"count": 0, "bytesFetched": 0}
+
+
+def note_ec_rebuild(bytes_fetched: int) -> None:
+    with _repair_lock:
+        _repair_totals["count"] += 1
+        _repair_totals["bytesFetched"] += int(bytes_fetched)
+
+
+def ec_rebuild_totals() -> dict:
+    with _repair_lock:
+        return dict(_repair_totals)
+
 
 class TelemetryClient:
     def __init__(self, url: str, enabled: bool = False,
@@ -57,6 +77,9 @@ class TelemetryClient:
             data["totalSizeBytes"] = size
         except (OSError, ValueError):
             pass   # partial reports are fine; the shape matters
+        rep = ec_rebuild_totals()
+        data["ecRebuildCount"] = rep["count"]
+        data["ecRebuildBytesFetched"] = rep["bytesFetched"]
         return data
 
     def send(self, master: str) -> bool:

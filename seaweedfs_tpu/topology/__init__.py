@@ -23,3 +23,27 @@ def iter_volume_list_ec_shards(volume_list: dict):
     for node in iter_volume_list_nodes(volume_list):
         for e in node.get("ecShards", []):
             yield node, e
+
+
+def fetch_ec_shard_locations(master: str, vid: int
+                             ) -> "dict[str, list[int]]":
+    """{url: [shard ids]} from the master's /dir/ec_lookup — the one
+    parser for that payload (shell, repair worker, and the streaming
+    rebuild handler all consume it)."""
+    from ..operation import master_json
+    r = master_json(master, "GET", f"/dir/ec_lookup?volumeId={vid}")
+    if "error" in r:
+        return {}
+    return {loc["url"]: loc["shardIds"]
+            for loc in r.get("shardIdLocations", [])}
+
+
+def shard_ids_to_urls(locations: "dict[str, list[int]]"
+                      ) -> "dict[str, list[str]]":
+    """Invert {url: [sids]} into the {str(sid): [urls]} shape the
+    streaming /admin/ec/rebuild payload carries."""
+    out: dict[str, list[str]] = {}
+    for url, sids in locations.items():
+        for sid in sids:
+            out.setdefault(str(sid), []).append(url)
+    return out
