@@ -129,6 +129,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="comma-separated filer addresses forming the "
                          "distributed-lock ring (give every filer the "
                          "same list; cluster/lock_manager)")
+    fl.add_argument("-metricsAddress", dest="metrics_address",
+                    default="", help="Prometheus pushgateway "
+                    "host:port (stats/metrics.go LoopPushingMetric)")
+    fl.add_argument("-metricsIntervalSec", dest="metrics_interval",
+                    type=int, default=15)
 
     s3p = sub.add_parser("s3", help="start the S3 gateway (on a filer)")
     s3p.add_argument("-ip", default="127.0.0.1")
@@ -149,6 +154,11 @@ def main(argv: list[str] | None = None) -> int:
                      help="serve per-bucket Prometheus metrics on a "
                           "SEPARATE listener (the reference's "
                           "weed s3 -metricsPort)")
+    s3p.add_argument("-metricsAddress", dest="metrics_address",
+                     default="", help="Prometheus pushgateway "
+                     "host:port (stats/metrics.go LoopPushingMetric)")
+    s3p.add_argument("-metricsIntervalSec", dest="metrics_interval",
+                     type=int, default=15)
     s3p.add_argument("-stsKey", dest="sts_key", default="",
                      help="STS signing key: accept temporary "
                           "credentials minted by the iam server")
@@ -605,6 +615,13 @@ def main(argv: list[str] | None = None) -> int:
                                      args.lock_peers.split(",")
                                      if p.strip()])
         fs.start()
+        if args.metrics_address:
+            from .stats import MetricsPusher
+            MetricsPusher(fs.metrics, "filer", fs.url,
+                          args.metrics_address,
+                          args.metrics_interval).start()
+            print(f"pushing metrics to {args.metrics_address} "
+                  f"every {args.metrics_interval}s")
         print(f"filer listening on {fs.url}")
         _wait()
     elif args.cmd == "s3":
@@ -656,6 +673,13 @@ def main(argv: list[str] | None = None) -> int:
                          iam=iam_store, sts=sts, kms=kms,
                          metrics_port=args.metrics_port)
         gw.start()
+        if args.metrics_address:
+            from .stats import MetricsPusher
+            MetricsPusher(gw.metrics, "s3", gw.url,
+                          args.metrics_address,
+                          args.metrics_interval).start()
+            print(f"pushing metrics to {args.metrics_address} "
+                  f"every {args.metrics_interval}s")
         print(f"s3 gateway listening on {gw.url}" +
               (f" (filer {args.filer})" if args.filer else "") +
               (f" (metrics {gw.metrics_http.url}/metrics)"

@@ -458,6 +458,101 @@ class WallClockRule(Rule):
                     f"carry timestamps in the replayed record")
 
 
+class LeakedSpanRule(Rule):
+    """SWFS007: a trace span opened without a context manager or a
+    matching finish.  `tracing.start_span()` (and the `tracing.span()`
+    context-manager form) set the context's current span; a span that
+    is never finished leaves every later span in the handler thread
+    parented under it AND never reaches the ring buffer — the trace
+    silently loses its timing.  Flagged unless the call is a
+    with-item, or its result visibly reaches `.finish()` / a `with`
+    block / escapes the scope (returned, stored, passed on)."""
+
+    id = "SWFS007"
+    severity = "error"
+    title = "trace span started without context manager or finish"
+
+    _OPENERS_SUFFIX = ("start_span",)
+    _OPENERS_EXACT = {"tracing.span", "tracing.start_span"}
+
+    def _is_opener(self, name: str) -> bool:
+        return name in self._OPENERS_EXACT or \
+            name.rsplit(".", 1)[-1] in self._OPENERS_SUFFIX
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    self._is_opener(_dotted(node.func))):
+                continue
+            verdict = self._verdict(ctx, node)
+            if verdict:
+                yield self.finding(ctx, node, verdict)
+
+    def _verdict(self, ctx: FileContext, call: ast.Call) -> "str | None":
+        name = _dotted(call.func)
+        parent = ctx.parent(call)
+        if isinstance(parent, ast.withitem):
+            return None            # `with tracing.span(...) as sp:`
+        if isinstance(parent, ast.Attribute):
+            # `start_span(...).finish()` is pointless but not a leak;
+            # any other immediate attribute use drops the handle
+            if parent.attr in ("finish", "set", "set_error"):
+                return None
+            return (f"{name}(...).{parent.attr} discards the span — "
+                    f"use `with` or keep it and call .finish()")
+        if isinstance(parent, ast.Expr):
+            return (f"{name}(...) result discarded — the span is "
+                    f"never finished (use `with {name}(...)`)")
+        if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+            targets = parent.targets if isinstance(parent, ast.Assign) \
+                else [parent.target]
+            if len(targets) != 1 or not isinstance(targets[0], ast.Name):
+                return None        # self.x / container: lifecycle-managed
+            var = targets[0].id
+            fn = next((a for a in ctx.ancestors(call)
+                       if isinstance(a, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))), None)
+            scope = fn if fn is not None else ctx.tree
+            if self._name_is_finished(scope, var, parent):
+                return None
+            return (f"{name}(...) assigned to {var!r} but never "
+                    f"finished, used as a context manager, or passed "
+                    f"on in this scope — the span leaks")
+        return None                # escapes into a call/container
+
+    @staticmethod
+    def _name_is_finished(scope: ast.AST, var: str,
+                          assign: ast.AST) -> bool:
+        for node in ast.walk(scope):
+            if node is assign:
+                continue
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr == "finish" and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == var:
+                    return True
+                for arg in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id == var:
+                        return True
+            elif isinstance(node, ast.withitem):
+                if isinstance(node.context_expr, ast.Name) and \
+                        node.context_expr.id == var:
+                    return True
+            elif isinstance(node, ast.Return):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and sub.id == var:
+                        return True
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                value = node.value
+                for sub in ast.walk(value) if value else []:
+                    if isinstance(sub, ast.Name) and sub.id == var:
+                        return True
+        return False
+
+
 RULES = [
     LockDisciplineRule(),
     JitBlockingRule(),
@@ -465,4 +560,5 @@ RULES = [
     SwallowedExceptionRule(),
     UnclosedHandleRule(),
     WallClockRule(),
+    LeakedSpanRule(),
 ]

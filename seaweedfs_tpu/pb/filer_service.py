@@ -433,7 +433,8 @@ class FilerServicer:
 def start_filer_grpc(filer_server, host: str = "127.0.0.1",
                      port: int = 0):
     handler = make_service_handler(SERVICE, METHODS,
-                                   FilerServicer(filer_server))
+                                   FilerServicer(filer_server),
+                                   role="filer")
     return serve([handler], host, port)
 
 

@@ -277,12 +277,14 @@ class S3IamCacheServicer(_PolicyMixin):
 
 def start_iam_grpc(store, host: str = "127.0.0.1", port: int = 0):
     return serve([make_service_handler(IAM_SERVICE, IAM_METHODS,
-                                       IamServicer(store))],
+                                       IamServicer(store),
+                                       role="iam")],
                  host=host, port=port)
 
 
 def start_s3_cache_grpc(store, host: str = "127.0.0.1", port: int = 0):
     return serve([make_service_handler(S3_CACHE_SERVICE,
                                        S3_CACHE_METHODS,
-                                       S3IamCacheServicer(store))],
+                                       S3IamCacheServicer(store),
+                                       role="s3")],
                  host=host, port=port)

@@ -227,7 +227,8 @@ class MasterServicer:
 
 def start_master_grpc(master, host: str = "127.0.0.1", port: int = 0):
     handler = make_service_handler(SERVICE, METHODS,
-                                   MasterServicer(master))
+                                   MasterServicer(master),
+                                   role="master")
     return serve([handler], host, port)
 
 

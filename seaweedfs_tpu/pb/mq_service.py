@@ -648,11 +648,13 @@ def start_broker_grpc(broker, host: str = "127.0.0.1", port: int = 0):
     # starving the unary control plane (the reference's goroutine
     # model has no such cap)
     return serve([make_service_handler(BROKER_SERVICE, BROKER_METHODS,
-                                       BrokerServicer(broker))],
+                                       BrokerServicer(broker),
+                                       role="broker")],
                  host=host, port=port, max_workers=64)
 
 
 def start_agent_grpc(agent, host: str = "127.0.0.1", port: int = 0):
     return serve([make_service_handler(AGENT_SERVICE, AGENT_METHODS,
-                                       AgentServicer(agent))],
+                                       AgentServicer(agent),
+                                       role="agent")],
                  host=host, port=port, max_workers=64)

@@ -462,9 +462,11 @@ def start_admin_grpc(admin, host: str = "127.0.0.1", port: int = 0):
     (grpc_server, bound_port)."""
     handlers = [
         make_service_handler(PLUGIN_SERVICE, PLUGIN_METHODS,
-                             PluginControlServicer(admin)),
+                             PluginControlServicer(admin),
+                             role="admin"),
         make_service_handler(WORKER_SERVICE, WORKER_METHODS,
-                             WorkerServicer(admin)),
+                             WorkerServicer(admin),
+                             role="admin"),
     ]
     return serve(handlers, host=host, port=port)
 
@@ -606,12 +608,23 @@ class GrpcPluginWorker:
         def run():
             job_id = ej.job.job_id
             h = self.handlers.get(ej.job.job_type)
+            # traceability for stream-dispatched jobs (tracing.py):
+            # the proto carries no trace context, so the execution
+            # roots its own trace under `job-<id>` — the same id the
+            # HTTP worker falls back to
+            from .. import tracing
+            from ..util.request_id import set_request_id
+            set_request_id(f"job-{job_id}")
             try:
                 if h is None:
                     raise ValueError(
                         f"no handler for {ej.job.job_type!r}")
-                message = h.execute(self, job_id,
-                                    map_to_params(ej.job.parameters))
+                with tracing.span(f"job:{ej.job.job_type}",
+                                  role="worker") as sp:
+                    sp.set("jobId", job_id)
+                    message = h.execute(self, job_id,
+                                        map_to_params(
+                                            ej.job.parameters))
                 success = True
             except Exception as e:  # noqa: BLE001 — report, don't die
                 traceback.print_exc()

@@ -23,14 +23,69 @@ _KIND_TO_HANDLER = {
 }
 
 
+def _adopt_trace(context) -> "tuple[str, str]":
+    """Adopt the caller's request id + trace parent from invocation
+    metadata (the gRPC twin of the httpd middleware's header adoption;
+    tracing.py) — returns (trace id, parent span id) for the server
+    span."""
+    from .. import tracing
+    from ..util.request_id import ensure_request_id
+    rid = tp = ""
+    for k, v in context.invocation_metadata() or ():
+        lk = k.lower()
+        if lk == "x-request-id":
+            rid = v
+        elif lk == tracing.GRPC_METADATA_KEY:
+            tp = v
+    rid = ensure_request_id(rid)
+    _, parent = tracing.parse_traceparent(tp)
+    return rid, parent
+
+
+def _traced_method(service_name: str, name: str, kind: str, fn,
+                   role: str):
+    """Wrap one servicer method in a server span.  Response-streaming
+    methods return a generator — the span must stay open until the
+    stream is exhausted, so those get a generator wrapper instead of a
+    plain with-block."""
+    from .. import tracing
+
+    if kind in ("uu", "su"):
+        def unary(request, context):
+            rid, parent = _adopt_trace(context)
+            with tracing.span(f"{service_name}/{name}", role=role,
+                              parent=parent, trace_id=rid) as sp:
+                try:
+                    return fn(request, context)
+                except BaseException as e:
+                    sp.set_error(e)
+                    raise
+        return unary
+
+    def streaming(request, context):
+        rid, parent = _adopt_trace(context)
+        sp = tracing.start_span(f"{service_name}/{name}", role=role,
+                                parent=parent, trace_id=rid)
+        try:
+            yield from fn(request, context)
+        except BaseException as e:
+            sp.set_error(e)
+            raise
+        finally:
+            sp.finish()
+    return streaming
+
+
 def make_service_handler(service_name: str, methods: dict,
-                         servicer) -> grpc.GenericRpcHandler:
+                         servicer, role: str = "") -> grpc.GenericRpcHandler:
     """methods: {method_name: (kind, req_cls, resp_cls)}; servicer must
-    have a callable per method name."""
+    have a callable per method name.  `role` labels the server spans
+    the wrapper opens around every method (tracing.py)."""
     table = {}
     for name, (kind, req_cls, resp_cls) in methods.items():
         table[name] = _KIND_TO_HANDLER[kind](
-            getattr(servicer, name),
+            _traced_method(service_name, name, kind,
+                           getattr(servicer, name), role),
             request_deserializer=req_cls.FromString,
             response_serializer=resp_cls.SerializeToString)
     return grpc.method_handlers_generic_handler(service_name, table)
@@ -51,10 +106,32 @@ def serve(handlers, host: str = "127.0.0.1", port: int = 0,
     return server, bound
 
 
+def _with_trace_metadata(multicallable):
+    """Attach the active request id + trace parent as invocation
+    metadata on every call (the gRPC twin of _pooled_request's header
+    forwarding) — explicit caller metadata still wins."""
+    def call(request, **kwargs):
+        from .. import tracing
+        from ..util.request_id import get_request_id
+        md = list(kwargs.pop("metadata", ()) or ())
+        have = {k.lower() for k, _ in md}
+        rid = get_request_id()
+        if rid and "x-request-id" not in have:
+            md.append(("x-request-id", rid))
+        tp = tracing.traceparent_header()
+        if tp and tracing.GRPC_METADATA_KEY not in have:
+            md.append((tracing.GRPC_METADATA_KEY, tp))
+        if md:
+            kwargs["metadata"] = md
+        return multicallable(request, **kwargs)
+    return call
+
+
 class Stub:
     """Client stub over one service: attribute access returns the bound
     callable for a method (multi-callable with the right serializers),
-    mirroring what a generated *_pb2_grpc Stub exposes."""
+    mirroring what a generated *_pb2_grpc Stub exposes.  Every call
+    carries the active request id + trace parent as metadata."""
 
     def __init__(self, channel: grpc.Channel, service_name: str,
                  methods: dict):
@@ -62,10 +139,11 @@ class Stub:
             "uu": channel.unary_unary, "us": channel.unary_stream,
             "su": channel.stream_unary, "ss": channel.stream_stream}
         for name, (kind, req_cls, resp_cls) in methods.items():
-            setattr(self, name, self._factories[kind](
-                f"/{service_name}/{name}",
-                request_serializer=req_cls.SerializeToString,
-                response_deserializer=resp_cls.FromString))
+            setattr(self, name, _with_trace_metadata(
+                self._factories[kind](
+                    f"/{service_name}/{name}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString)))
 
 
 class LocalRequest:

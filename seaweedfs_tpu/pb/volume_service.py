@@ -292,7 +292,8 @@ class VolumeServicer:
 
 
 def start_volume_grpc(vs, host: str = "127.0.0.1", port: int = 0):
-    handler = make_service_handler(SERVICE, METHODS, VolumeServicer(vs))
+    handler = make_service_handler(SERVICE, METHODS, VolumeServicer(vs),
+                                   role="volume")
     return serve([handler], host, port)
 
 

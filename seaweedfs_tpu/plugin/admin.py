@@ -32,6 +32,14 @@ from dataclasses import dataclass, field
 from ..server.httpd import HttpServer, Request, http_json
 
 
+def _trace_ctx() -> "tuple[str, str]":
+    """(request id, trace parent) of the request minting a job, so
+    the eventual worker execution joins the submitter's trace."""
+    from .. import tracing
+    from ..util.request_id import get_request_id
+    return get_request_id(), tracing.traceparent_header()
+
+
 @dataclass
 class WorkerInfo:
     worker_id: str
@@ -60,6 +68,11 @@ class Job:
     # decision trace (admin/plugin DESIGN.md WorkflowMonitor): why the
     # job exists and every state transition, survives restart
     trace: list = field(default_factory=list)
+    # distributed-tracing context of the request that minted the job
+    # (tracing.py): delivered with executeJob so the worker's spans
+    # land in the submitter's trace
+    request_id: str = ""
+    trace_parent: str = ""
 
     def add_trace(self, event: str) -> None:
         self.trace.append({"ts": round(time.time(), 3),
@@ -71,7 +84,8 @@ class Job:
                 "status": self.status, "workerId": self.worker_id,
                 "progress": self.progress, "message": self.message,
                 "created": self.created, "updated": self.updated,
-                "trace": self.trace}
+                "trace": self.trace, "requestId": self.request_id,
+                "traceParent": self.trace_parent}
 
     @classmethod
     def from_json(cls, d: dict) -> "Job":
@@ -84,7 +98,9 @@ class Job:
                    message=d.get("message", ""),
                    created=d.get("created", 0.0),
                    updated=d.get("updated", 0.0),
-                   trace=d.get("trace", []))
+                   trace=d.get("trace", []),
+                   request_id=d.get("requestId", ""),
+                   trace_parent=d.get("traceParent", ""))
 
 
 class AdminServer:
@@ -114,6 +130,7 @@ class AdminServer:
             with self.lock:
                 self._load_state()
         self.http = HttpServer(host, port)
+        self.http.role = "admin"          # tracing server spans
         r = self.http.route
         r("GET", "/maintenance/config", self._get_config)
         r("POST", "/maintenance/config", self._set_config)
@@ -134,6 +151,8 @@ class AdminServer:
         r("GET", "/maintenance/queue", self._queue)
         r("POST", "/maintenance/trigger_detection", self._trigger)
         r("POST", "/maintenance/submit_job", self._submit_job)
+        from ..server.debug import install_debug_routes
+        install_debug_routes(self.http)  # incl. ingested job traces
         self._detect_thread: threading.Thread | None = None
         self._pending_detection: list[str] = []  # worker ids to ask
 
@@ -341,7 +360,9 @@ class AdminServer:
                     return 200, {"type": "executeJob",
                                  "jobId": job.job_id,
                                  "jobType": job.job_type,
-                                 "params": job.params}
+                                 "params": job.params,
+                                 "requestId": job.request_id,
+                                 "traceParent": job.trace_parent}
             time.sleep(0.05)
         return 200, {"type": "none"}
 
@@ -367,9 +388,11 @@ class AdminServer:
                         self.jobs[existing].status in ("pending",
                                                        "assigned"):
                     continue
+                rid, tparent = _trace_ctx()
                 job = Job(job_id=uuid.uuid4().hex[:12],
                           job_type=prop["jobType"],
-                          params=prop["params"], dedupe_key=key)
+                          params=prop["params"], dedupe_key=key,
+                          request_id=rid, trace_parent=tparent)
                 job.add_trace(
                     f"detected by {b.get('workerId', '?')}"
                     + (f": {prop['reason']}" if prop.get("reason")
@@ -721,8 +744,10 @@ input{{margin:2px}}</style></head><body>
                     return 409, {"error": f"conflicts with live job "
                                           f"{existing} ({k})",
                                  "jobId": existing, "deduped": True}
+            rid, tparent = _trace_ctx()
             job = Job(job_id=uuid.uuid4().hex[:12], job_type=job_type,
-                      params=params, dedupe_key=key)
+                      params=params, dedupe_key=key,
+                      request_id=rid, trace_parent=tparent)
             job.add_trace("submitted by operator")
             self.jobs[job.job_id] = job
             for k in keys:
@@ -750,6 +775,12 @@ input{{margin:2px}}</style></head><body>
 
     def _complete(self, req: Request):
         b = req.json()
+        # worker job spans ride the completion report (the worker has
+        # no listener for trace.show to query); re-record them here so
+        # this admin's /debug/traces serves the job's execution trace
+        if b.get("spans"):
+            from .. import tracing
+            tracing.ingest(b["spans"])
         with self.lock:
             self._touch(b.get("workerId", ""))
             job = self.jobs.get(b["jobId"])

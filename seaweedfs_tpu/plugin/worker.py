@@ -144,7 +144,9 @@ class PluginWorker:
                 self._run_detection()
             elif mtype == "executeJob":
                 self._execute(msg["jobId"], msg["jobType"],
-                              msg.get("params", {}))
+                              msg.get("params", {}),
+                              request_id=msg.get("requestId", ""),
+                              trace_parent=msg.get("traceParent", ""))
 
     @staticmethod
     def _snake(name: str) -> str:
@@ -169,20 +171,46 @@ class PluginWorker:
                              {"workerId": self.worker_id,
                               "proposals": proposals})
 
-    def _execute(self, job_id: str, job_type: str, params: dict) -> None:
+    def _execute(self, job_id: str, job_type: str, params: dict,
+                 request_id: str = "", trace_parent: str = "") -> None:
+        # join the submitter's trace (tracing.py): the job rode the
+        # admin queue, so context arrives in the message, not headers.
+        # A detection-born job without context mints its own ids so
+        # the execution is still traceable by `job-<id>`.  Context is
+        # RESTORED afterwards — this loop thread lives on, and a
+        # leaked rid would trace every later poll into this job.
+        from .. import tracing
+        from ..util.request_id import reset_request_id, set_request_id
+        rid = request_id or f"job-{job_id}"
+        token = set_request_id(rid)
+        tracing.adopt_remote_parent(trace_parent, role="worker")
         h = self.handlers.get(job_type)
         try:
-            if h is None:
-                raise ValueError(f"no handler for {job_type!r}")
-            message = h.execute(self, job_id, params)
-            success = True
-        except Exception as e:  # noqa: BLE001 — report, don't die
-            traceback.print_exc()
-            message, success = f"{type(e).__name__}: {e}", False
-        self.executed.append(job_id)
-        _post_with_retry(f"{self.admin}/worker/complete", {
-            "workerId": self.worker_id, "jobId": job_id,
-            "success": success, "message": message})
+            with tracing.span(f"job:{job_type}", role="worker") as sp:
+                sp.set("jobId", job_id)
+                try:
+                    if h is None:
+                        raise ValueError(
+                            f"no handler for {job_type!r}")
+                    message = h.execute(self, job_id, params)
+                    success = True
+                except Exception as e:  # noqa: BLE001 — report,
+                    # don't die
+                    traceback.print_exc()
+                    message, success = f"{type(e).__name__}: {e}", \
+                        False
+                    sp.set_error(e)
+            self.executed.append(job_id)
+            _post_with_retry(f"{self.admin}/worker/complete", {
+                "workerId": self.worker_id, "jobId": job_id,
+                "success": success, "message": message,
+                # the worker has no HTTP listener for trace.show to
+                # query, so its spans ride the completion report and
+                # the admin re-records them into ITS ring buffer
+                "spans": tracing.spans_for(rid)})
+        finally:
+            reset_request_id(token)
+            tracing.adopt_remote_parent("")
 
     def report_progress(self, job_id: str, progress: float,
                         message: str = "") -> None:

@@ -123,6 +123,8 @@ class S3ApiServer:
         self.circuit_breaker = CircuitBreaker()
         self._cb_stamp = (0.0, -1.0)     # (checked-at, entry-mtime)
         self.metrics = Metrics("s3")
+        self.http.role = "s3"            # tracing + request_seconds
+        self.http.metrics = self.metrics
         # metrics ride a SEPARATE listener (`weed s3 -metricsPort`):
         # the S3 port must keep every path free for bucket names
         self.metrics_http = None

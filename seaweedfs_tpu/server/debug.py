@@ -11,6 +11,11 @@ httpd.is_admin_path):
       samples sys._current_frames at ~10ms for N seconds and returns
       collated (frames -> sample count), most-sampled first — the
       Python stand-in for a CPU pprof.
+  GET /debug/traces?request_id=R — spans of one trace from this
+      process's ring buffer (tracing.py); without request_id the
+      most recent spans (?limit=N, default 200).  The shell's
+      `trace.show` fans this endpoint out across the cluster and
+      merges the results into one tree.
 """
 
 from __future__ import annotations
@@ -29,6 +34,18 @@ def install_debug_routes(http: HttpServer) -> None:
     http.route("GET", "/debug/stacks", _stacks)
     http.route("GET", "/debug/vars", _vars)
     http.route("GET", "/debug/profile", _profile)
+    http.route("GET", "/debug/traces", _traces)
+
+
+def _traces(req: Request):
+    from .. import tracing
+    rid = req.query.get("request_id", "")
+    if rid:
+        spans = tracing.spans_for(rid)
+    else:
+        spans = tracing.recent_spans(
+            int(req.query.get("limit", 200)))
+    return 200, {"requestId": rid, "spans": spans}
 
 
 def _stacks(req: Request):

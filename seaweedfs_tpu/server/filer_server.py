@@ -157,6 +157,15 @@ class FilerServer:
         self.http.route("POST", "/admin/locks/release",
                         self._lock_release)
         self.http.route("GET", "/admin/locks/list", self._lock_list)
+        # metrics registry + /metrics endpoint (stats/metrics.go
+        # FilerGather): the filer serves the same Prometheus text
+        # plane as master/volume/s3, fed request_seconds by the httpd
+        # middleware plus filer-specific gauges below
+        from ..stats import Metrics
+        self.metrics = Metrics("filer")
+        self.http.route("GET", "/metrics", self._metrics)
+        self.http.role = "filer"
+        self.http.metrics = self.metrics
         from .debug import install_debug_routes
         install_debug_routes(self.http)  # util/grace/pprof.go analog
         self.http.guard = self._guard
@@ -207,6 +216,19 @@ class FilerServer:
 
     def _lock_list(self, req: Request):
         return 200, {"locks": self.lock_manager.all_locks()}
+
+    def _metrics(self, req: Request):
+        """Prometheus text endpoint (stats/metrics.go FilerGather
+        analog): request_seconds arrives via the httpd middleware;
+        namespace-shape gauges are refreshed per scrape."""
+        self.metrics.gauge_set(
+            "meta_log_last_ts_ns", float(self.filer.meta_log.last_ts()),
+            help_text="timestamp of the newest metadata log event")
+        self.metrics.gauge_set(
+            "locks_held", float(len(self.lock_manager.all_locks())),
+            help_text="distributed locks currently held here")
+        return 200, (self.metrics.render().encode(),
+                     "text/plain; version=0.0.4")
 
     def start(self):
         self.http.start()

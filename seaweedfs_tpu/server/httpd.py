@@ -195,6 +195,12 @@ class HttpServer:
         # continue or a (status, payload) response to short-circuit
         self.guard: "Callable[[Request], tuple[int, object] | None] | None" \
             = None
+        # observability hooks, set by the owning role server: `role`
+        # labels this listener's server spans (tracing.py), `metrics`
+        # receives the uniform request_seconds histogram (stats.py) —
+        # one middleware, every role (master/volume/filer/s3 alike)
+        self.role: str = ""
+        self.metrics = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -211,97 +217,134 @@ class HttpServer:
                 # hops and log lines inherit it
                 from ..util.request_id import HEADER as _RID_HEADER
                 from ..util.request_id import ensure_request_id
+                from .. import tracing
                 rid = ensure_request_id(
                     req.headers.get(_RID_HEADER, ""))
                 route = outer.routes.get((req.method, req.path))
+                # server span: trace id = request id, parent from the
+                # caller's X-Trace-Parent (tracing.py); every role's
+                # handler is wrapped by this one middleware
+                _, parent_span = tracing.parse_traceparent(
+                    req.headers.get(tracing.HEADER, ""))
+                sp = tracing.start_span(
+                    f"{req.method} {req.path}", role=outer.role,
+                    parent=parent_span, trace_id=rid)
+                status = 0
                 try:
-                    denied = outer.guard(req) if outer.guard else None
-                    if denied is not None:
-                        status, payload = denied
-                    elif route is not None:
-                        status, payload = route(req)
-                    elif outer.fallback is not None:
-                        status, payload = outer.fallback(req)
-                    else:
-                        status, payload = 404, {"error": "not found"}
-                except Exception as e:  # noqa: BLE001 — server must answer
-                    status, payload = 500, {"error": str(e)}
-                # drain any unread request body: a handler that ignores
-                # its body (e.g. PROPFIND's XML) would otherwise leave
-                # the bytes in the keep-alive stream to be parsed as
-                # the NEXT request line, poisoning the connection.
-                # Bounded: an unread 30GB upload (rejected by the guard
-                # or a 400) closes the connection instead of buffering
-                # — the drain must never re-introduce the whole-body
-                # OOM the streaming path exists to avoid.
-                try:
-                    req.drain()
-                except Exception:  # noqa: BLE001 — close instead
-                    self.close_connection = True
-                extra_headers: dict = {}
-                if isinstance(payload, (dict, list)):
-                    body = json.dumps(payload).encode()
-                    ctype = "application/json"
-                elif isinstance(payload, tuple):
-                    body, second = payload
-                    if isinstance(second, dict):
-                        extra_headers = second
-                        ctype = extra_headers.pop(
-                            "Content-Type", "application/octet-stream")
-                    else:
-                        ctype = second
-                else:
-                    body = payload if isinstance(payload, bytes) \
-                        else str(payload).encode()
-                    ctype = "application/octet-stream"
-                self.send_response(status)
-                self.send_header("Content-Type", ctype)
-                self.send_header(_RID_HEADER, rid)
-                for hk, hv in extra_headers.items():
-                    self.send_header(hk, hv)
-                if hasattr(body, "read"):
-                    # file-like payload: stream without buffering (the
-                    # bulk-data serve path).  Content-Length must be in
-                    # extra_headers — these responses are never chunked.
-                    self.end_headers()
+                    # the span (and request_seconds) covers handler
+                    # execution AND the response-body write: for the
+                    # bulk serve paths (FileSlice sendfile) the write
+                    # IS the dominant cost, and closing the span at
+                    # handler return would record a multi-second
+                    # stream as ~0ms
                     try:
-                        if req.method == "HEAD":
-                            return
-                        # sendfile(2) fast path for FileSlice needle
-                        # reads: zero-copy kernel transfer from the
-                        # .dat fd (the RDMA-sidecar idea's in-server
-                        # sibling; socket.sendfile falls back to a
-                        # send loop under TLS).  No mid-stream
-                        # fallback: a partial sendfile that then
-                        # re-sent bytes would corrupt the response, so
-                        # errors close the connection instead.
-                        f = getattr(body, "_f", None)
-                        count = getattr(body, "_remaining", 0)
-                        if f is not None and count > 0 and \
-                                hasattr(f, "fileno"):
-                            try:
-                                self.wfile.flush()
-                                # offset defaults to 0, NOT the file
-                                # position — ranged needle reads start
-                                # mid-.dat
-                                self.connection.sendfile(
-                                    f, offset=f.tell(), count=count)
-                            except (OSError, ValueError):
-                                self.close_connection = True
-                            return
-                        while True:
-                            chunk = body.read(1 << 20)
-                            if not chunk:
-                                break
-                            self.wfile.write(chunk)
-                    finally:
-                        body.close()
-                    return
-                if "Content-Length" not in extra_headers:
-                    self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                if req.method != "HEAD":
-                    self.wfile.write(body)
+                        denied = outer.guard(req) if outer.guard \
+                            else None
+                        if denied is not None:
+                            status, payload = denied
+                        elif route is not None:
+                            status, payload = route(req)
+                        elif outer.fallback is not None:
+                            status, payload = outer.fallback(req)
+                        else:
+                            status, payload = 404, \
+                                {"error": "not found"}
+                    except Exception as e:  # noqa: BLE001 — server
+                        # must answer
+                        status, payload = 500, {"error": str(e)}
+                        sp.set_error(e)
+                    # drain any unread request body: a handler that
+                    # ignores its body (e.g. PROPFIND's XML) would
+                    # otherwise leave the bytes in the keep-alive
+                    # stream to be parsed as the NEXT request line,
+                    # poisoning the connection.  Bounded: an unread
+                    # 30GB upload (rejected by the guard or a 400)
+                    # closes the connection instead of buffering —
+                    # the drain must never re-introduce the
+                    # whole-body OOM the streaming path exists to
+                    # avoid.
+                    try:
+                        req.drain()
+                    except Exception:  # noqa: BLE001 — close instead
+                        self.close_connection = True
+                    extra_headers: dict = {}
+                    if isinstance(payload, (dict, list)):
+                        body = json.dumps(payload).encode()
+                        ctype = "application/json"
+                    elif isinstance(payload, tuple):
+                        body, second = payload
+                        if isinstance(second, dict):
+                            extra_headers = second
+                            ctype = extra_headers.pop(
+                                "Content-Type",
+                                "application/octet-stream")
+                        else:
+                            ctype = second
+                    else:
+                        body = payload if isinstance(payload, bytes) \
+                            else str(payload).encode()
+                        ctype = "application/octet-stream"
+                    self.send_response(status)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header(_RID_HEADER, rid)
+                    for hk, hv in extra_headers.items():
+                        self.send_header(hk, hv)
+                    if hasattr(body, "read"):
+                        # file-like payload: stream without buffering
+                        # (the bulk-data serve path).  Content-Length
+                        # must be in extra_headers — these responses
+                        # are never chunked.
+                        self.end_headers()
+                        try:
+                            if req.method == "HEAD":
+                                return
+                            # sendfile(2) fast path for FileSlice
+                            # needle reads: zero-copy kernel transfer
+                            # from the .dat fd (the RDMA-sidecar
+                            # idea's in-server sibling;
+                            # socket.sendfile falls back to a send
+                            # loop under TLS).  No mid-stream
+                            # fallback: a partial sendfile that then
+                            # re-sent bytes would corrupt the
+                            # response, so errors close the
+                            # connection instead.
+                            f = getattr(body, "_f", None)
+                            count = getattr(body, "_remaining", 0)
+                            if f is not None and count > 0 and \
+                                    hasattr(f, "fileno"):
+                                try:
+                                    self.wfile.flush()
+                                    # offset defaults to 0, NOT the
+                                    # file position — ranged needle
+                                    # reads start mid-.dat
+                                    self.connection.sendfile(
+                                        f, offset=f.tell(),
+                                        count=count)
+                                except (OSError, ValueError):
+                                    self.close_connection = True
+                                return
+                            while True:
+                                chunk = body.read(1 << 20)
+                                if not chunk:
+                                    break
+                                self.wfile.write(chunk)
+                        finally:
+                            body.close()
+                        return
+                    if "Content-Length" not in extra_headers:
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                    self.end_headers()
+                    if req.method != "HEAD":
+                        self.wfile.write(body)
+                finally:
+                    sp.set("status", status)
+                    sp.finish()
+                    if outer.metrics is not None:
+                        outer.metrics.histogram_observe(
+                            "request_seconds", sp.duration,
+                            help_text="HTTP request handling latency",
+                            method=req.method, code=str(status))
 
             do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _dispatch
             do_OPTIONS = _dispatch  # CORS preflight (S3 gateway)
@@ -742,15 +785,21 @@ def _one_pooled_request(method: str, full_url: str, body,
 
 def _pooled_request(method: str, url: str, body, headers: dict,
                     timeout: float, max_redirects: int = 3):
-    # forward the active request id on every internal hop
-    # (util/request_id): the receiving server adopts it, so one id
-    # traces gateway -> filer -> volume in the logs
+    # forward the active request id + trace parent on every internal
+    # hop (util/request_id, tracing.py): the receiving server adopts
+    # both, so one id traces gateway -> filer -> volume in the logs
+    # and the receiver's server span hangs under this caller's span
+    from .. import tracing
     from ..util.request_id import HEADER as _RID_HEADER
     from ..util.request_id import get_request_id
     rid = get_request_id()
     if rid and _RID_HEADER not in headers:
         headers = dict(headers)
         headers[_RID_HEADER] = rid
+    tp = tracing.traceparent_header()
+    if tp and tracing.HEADER not in headers:
+        headers = dict(headers)
+        headers[tracing.HEADER] = tp
     full_url, ctx = _dial(url)
     for _hop in range(max_redirects):
         status, data, rheaders, location = _one_pooled_request(

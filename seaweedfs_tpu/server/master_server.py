@@ -103,6 +103,8 @@ class MasterServer:
             self.sequencer.set_max(bound)
         from ..stats import Metrics
         self.metrics = Metrics("master")
+        self.http.role = "master"        # tracing + request_seconds
+        self.http.metrics = self.metrics
         from .location_hub import LocationHub
         self.hub = LocationHub()
         r("GET", "/cluster/watch", self._watch)

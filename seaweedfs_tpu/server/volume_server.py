@@ -101,6 +101,8 @@ class VolumeServer:
             security_headers=lambda: self.security.admin_headers())
         from ..stats import Metrics
         self.metrics = Metrics("volume_server")
+        self.http.role = "volume"        # tracing + request_seconds
+        self.http.metrics = self.metrics
 
     # -- lifecycle --------------------------------------------------------
 

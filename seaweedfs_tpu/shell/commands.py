@@ -620,6 +620,116 @@ def cmd_ec_scrub(env: CommandEnv, args: list[str]) -> str:
     return "\n".join(out) if out else "no ec volumes"
 
 
+# --- distributed tracing (tracing.py; the operator's flame view) ---------
+
+def _cluster_debug_nodes(env: CommandEnv) -> list[str]:
+    """Every node that may hold spans of a trace: master(s), every
+    volume server, and the filer when the shell knows one."""
+    r = master_json(env.master, "GET", "/cluster/status")
+    nodes = [env.master]
+    nodes += [p for p in r.get("peers", []) if p not in nodes]
+    nodes += r.get("dataNodes", [])
+    if env.filer and env.filer not in nodes:
+        nodes.append(env.filer)
+    return nodes
+
+
+def collect_trace(env: CommandEnv, request_id: str,
+                  extra_nodes: "list[str] | None" = None
+                  ) -> "list[dict]":
+    """Fan /debug/traces?request_id= out to every cluster node and
+    merge the spans (deduped by span id; an unreachable node
+    contributes nothing rather than failing the whole view).
+
+    Runs under a FRESH request id: a shell context still carrying the
+    queried id would otherwise trace its own topology/debug calls
+    into the very trace it is rendering."""
+    from ..util.request_id import (new_request_id, reset_request_id,
+                                   set_request_id)
+    token = set_request_id(new_request_id())
+    try:
+        nodes = _cluster_debug_nodes(env)
+    finally:
+        reset_request_id(token)
+    for n in extra_nodes or []:
+        if n not in nodes:
+            nodes.append(n)
+
+    def fetch(url: str) -> list:
+        try:
+            r = http_json(
+                "GET", f"{url}/debug/traces?request_id={request_id}",
+                timeout=10)
+        except OSError:
+            return []
+        spans = r.get("spans", []) if isinstance(r, dict) else []
+        for s in spans:
+            s["node"] = url
+        return spans
+
+    merged: dict[str, dict] = {}
+    with ThreadPoolExecutor(max_workers=min(8, len(nodes))) as ex:
+        for spans in ex.map(fetch, nodes):
+            for s in spans:
+                merged.setdefault(s["spanId"], s)
+    return sorted(merged.values(), key=lambda s: s["start"])
+
+
+def render_trace(spans: "list[dict]") -> str:
+    """Time-aligned tree: children indent under their parent, each
+    line shows offset from the trace's first span, duration, role@node
+    and attrs — one request id becomes a cross-node flame view."""
+    if not spans:
+        return "no spans found (buffer rolled over, or wrong id?)"
+    t0 = min(s["start"] for s in spans)
+    by_parent: dict[str, list] = {}
+    ids = {s["spanId"] for s in spans}
+    for s in spans:
+        parent = s.get("parentId") or ""
+        if parent not in ids:
+            parent = ""          # orphan (parent not collected): root
+        by_parent.setdefault(parent, []).append(s)
+    lines = [f"trace {spans[0]['traceId']}: {len(spans)} span(s), "
+             f"{len({s.get('role') or '?' for s in spans})} role(s)"]
+
+    def walk(parent: str, depth: int) -> None:
+        for s in sorted(by_parent.get(parent, []),
+                        key=lambda x: x["start"]):
+            off = (s["start"] - t0) * 1e3
+            attrs = s.get("attrs") or {}
+            extra = " ".join(f"{k}={v}" for k, v in attrs.items())
+            mark = " ERROR" if s.get("error") else ""
+            lines.append(
+                f"{'  ' * depth}+{off:8.1f}ms {s['name']}  "
+                f"[{s.get('role') or '?'}@{s.get('node', '?')}] "
+                f"{s['durationMs']}ms{mark}"
+                + (f"  {extra}" if extra else ""))
+            walk(s["spanId"], depth + 1)
+
+    walk("", 0)
+    return "\n".join(lines)
+
+
+@command("trace.show")
+def cmd_trace_show(env: CommandEnv, args: list[str]) -> str:
+    """Assemble one request's spans from every cluster node's
+    /debug/traces ring buffer and render the time-aligned tree —
+    turns a request id from a log line into a cross-node flame view
+    (tracing.py; the operator entry point of the tracing plane).
+    `-nodes=host:port[,...]` queries extra debug planes the topology
+    doesn't know — e.g. the admin server, which holds ingested worker
+    job spans."""
+    rids = [a for a in args if not a.startswith("-")]
+    opts = _parse_flags(args)
+    extra = [n.strip() for n in opts.get("nodes", "").split(",")
+             if n.strip()]
+    if not rids:
+        return "usage: trace.show <request_id> [-nodes=host:port,...]"
+    return "\n".join(
+        render_trace(collect_trace(env, rid, extra_nodes=extra))
+        for rid in rids)
+
+
 @command("volume.scrub")
 def cmd_volume_scrub(env: CommandEnv, args: list[str]) -> str:
     """CRC-verify every needle of every (or one) volume

@@ -11,10 +11,21 @@ import urllib.parse
 from collections import defaultdict
 
 
-# latency buckets spanning loopback slice fetches (ms) through WAN
-# shard pulls (seconds) — the EC rebuild observation range
+# latency buckets in SECONDS, 5ms through 10s: loopback slice
+# fetches sit in the low buckets, WAN shard pulls in the high ones —
+# the EC rebuild observation range
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0)
+
+
+def escape_label_value(v) -> str:
+    """Prometheus text-format label escaping (exposition format §text
+    "label_value can be any sequence of UTF-8 characters, but the
+    backslash, double-quote, and line-feed characters have to be
+    escaped as \\\\, \\", and \\n"): an unescaped source url or error
+    string must not tear the exposition line."""
+    return str(v).replace("\\", r"\\").replace('"', r'\"') \
+        .replace("\n", r"\n")
 
 
 class Metrics:
@@ -82,7 +93,9 @@ class Metrics:
                         out.append(f"# TYPE {full} {mtype}")
                         seen_types.add(full)
                     if labels:
-                        lbl = ",".join(f'{k}="{v}"' for k, v in labels)
+                        lbl = ",".join(
+                            f'{k}="{escape_label_value(v)}"'
+                            for k, v in labels)
                         out.append(f"{full}{{{lbl}}} {value}")
                     else:
                         out.append(f"{full} {value}")
@@ -93,7 +106,8 @@ class Metrics:
                         out.append(f"# HELP {full} {self._help[name]}")
                     out.append(f"# TYPE {full} histogram")
                     seen_types.add(full)
-                base = [f'{k}="{v}"' for k, v in labels]
+                base = [f'{k}="{escape_label_value(v)}"'
+                        for k, v in labels]
                 cum = 0
                 for le, n in zip(h["buckets"], h["counts"]):
                     cum += n

@@ -107,6 +107,56 @@ class _Stopped(Exception):
     """Internal: a pipeline stage was asked to abort."""
 
 
+class _StageTimer:
+    """Wraps one pipeline-stage callback to measure its true window:
+    wall-clock start of the first call, end of the last call, and
+    cumulative busy seconds.  The three stage windows OVERLAP by
+    design (the triple-buffered pipeline) — emitted as sibling trace
+    spans they show exactly that overlap (tracing.py), which is the
+    stage-level timing arXiv:1908.01527 says repair tuning needs."""
+
+    def __init__(self, fn):
+        import time as _time
+        self._fn = fn
+        self._clock = _time.perf_counter
+        self._wall = _time.time
+        self.start_wall = 0.0
+        self.first = 0.0
+        self.last = 0.0
+        self.busy = 0.0
+        self.calls = 0
+
+    def __call__(self, *args):
+        t0 = self._clock()
+        if not self.calls:
+            self.first = t0
+            self.start_wall = self._wall()
+        try:
+            return self._fn(*args)
+        finally:
+            t1 = self._clock()
+            self.busy += t1 - t0
+            self.last = t1
+            self.calls += 1
+
+    def emit(self, name: str, trace_ctx, **attrs) -> None:
+        """Record the stage window as a trace span parented to the
+        span active when the rebuild started (`trace_ctx` from
+        tracing.current_ids() — stages ran on other threads, so the
+        contextvar cannot be relied on here)."""
+        if not self.calls:
+            return
+        from ... import tracing
+        attrs.update(busySeconds=round(self.busy, 6),
+                     calls=self.calls)
+        tracing.emit_span(
+            name, self.start_wall, self.last - self.first,
+            role=trace_ctx[2] if trace_ctx else "",
+            parent=trace_ctx[1] if trace_ctx else "",
+            trace_id=trace_ctx[0] if trace_ctx else "",
+            attrs=attrs)
+
+
 class _OverlappedFlusher:
     """Background thread that round-robins flush+fdatasync over the
     output files while the pipeline runs, so disk/network flush
@@ -519,6 +569,15 @@ def rebuild_from_sources(base_file_name: str, ctx: ECContext,
         for row, sid in enumerate(missing):
             outputs[sid].write(rec[row, :n].data)
 
+    # stage spans (tracing.py): capture the caller's span context NOW
+    # — the reader/writer stages run on pipeline threads where the
+    # contextvar does not follow
+    from ... import tracing
+    trace_ctx = tracing.current_ids()
+    read_item = _StageTimer(read_item)
+    compute = _StageTimer(compute)
+    write_item = _StageTimer(write_item)
+
     flusher = _OverlappedFlusher(outputs.values())
     ok = False
     try:
@@ -539,6 +598,17 @@ def rebuild_from_sources(base_file_name: str, ctx: ECContext,
                         os.remove(base_file_name + ctx.to_ext(sid))
                     except OSError:
                         pass
+            by_source = stats.snapshot()[0] if stats is not None \
+                else {}
+            read_item.emit("rebuild.fetch", trace_ctx,
+                           bytesBySource=by_source,
+                           windows=len(work), sliceBytes=step)
+            compute.emit("rebuild.codec", trace_ctx,
+                         missingShards=list(missing),
+                         dataShards=ctx.data_shards)
+            write_item.emit("rebuild.write", trace_ctx,
+                            bytesWritten=len(missing) * shard_size,
+                            aborted=not ok)
     return missing
 
 

@@ -397,6 +397,11 @@ class MultiSourceFetcher:
         self._queues: dict[int, "queue.Queue"] = {}
         self._pools: dict[int, "queue.Queue"] = {}
         self._threads: list[threading.Thread] = []
+        # span context of the caller (the rebuild handler): prefetch
+        # threads emit one per-source stream span each, and the
+        # contextvar does not follow threading.Thread (tracing.py)
+        from ... import tracing
+        self._trace_ctx = tracing.current_ids()
         depth = depth or rebuild_prefetch_depth()
         for sid, src in sources.items():
             if src.prefetch:
@@ -407,7 +412,8 @@ class MultiSourceFetcher:
                 self._queues[sid] = q
                 self._pools[sid] = pool
                 t = threading.Thread(target=self._fetch_loop,
-                                     args=(src, q, pool), daemon=True)
+                                     args=(src, q, pool, sid),
+                                     daemon=True)
                 self._threads.append(t)
                 t.start()
 
@@ -429,7 +435,29 @@ class MultiSourceFetcher:
         return False
 
     def _fetch_loop(self, src: ShardSource, q: "queue.Queue",
-                    pool: "queue.Queue") -> None:
+                    pool: "queue.Queue", sid: int = -1) -> None:
+        # one span per survivor stream: start at thread launch, finish
+        # at stream exhaustion/abort, bytes + final donor url in attrs
+        # — trace.show then shows every donor's fetch window next to
+        # the codec/write stage windows
+        span_start = time.time()
+        t0 = time.perf_counter()
+        fetched = 0
+        failed = False
+
+        def _emit_source_span():
+            from ... import tracing
+            ctx = self._trace_ctx
+            tracing.emit_span(
+                f"rebuild.source.{sid}", span_start,
+                time.perf_counter() - t0,
+                role=ctx[2] if ctx else "",
+                parent=ctx[1] if ctx else "",
+                trace_id=ctx[0] if ctx else "",
+                attrs={"shard": sid, "source": src.label,
+                       "bytes": fetched},
+                error=failed)
+
         def take_buf(n: int):
             """Recycle a receive buffer from the pool — the hot loop
             allocates nothing after warm-up (fresh >1MB bytes objects
@@ -454,26 +482,31 @@ class MultiSourceFetcher:
                 it = src.iter_slices_into(self.work, take_buf,
                                           record=record)
                 for buf, got in it:
+                    fetched += got
                     if not self._put(q, (buf, got)):
                         return
                 return
             it = ((buf, len(buf)) for buf in
                   src.iter_slices(self.work))
             while True:
-                t0 = time.perf_counter()
+                t_read = time.perf_counter()
                 try:
                     buf, got = next(it)
                 except StopIteration:
                     return
+                fetched += got
                 if self.stats is not None:
                     self.stats.record(src.label, got,
-                                      time.perf_counter() - t0)
+                                      time.perf_counter() - t_read)
                 if not self._put(q, (buf, got)):
                     return
         except _SourceAborted:
             pass
         except BaseException as e:  # noqa: BLE001 — re-raised by get()
+            failed = True
             self._put(q, e)
+        finally:
+            _emit_source_span()
 
     def get(self, item: "tuple[int, int]", rows=None
             ) -> "dict[int, int]":

@@ -64,7 +64,12 @@ class TelemetryClient:
             status = http_json("GET", f"{master}/cluster/status")
             vols = http_json("GET", f"{master}/vol/list")
             data["clusterId"] = status.get("topologyId", "")
-            data["masterCount"] = len(status.get("peers") or [1])
+            # a healthy single-master cluster reports `peers: []` —
+            # the answering master IS a master, so the count floors
+            # at 1 (len(peers or [1]) read an empty-but-present list
+            # as zero masters)
+            data["masterCount"] = max(1, len(status.get("peers")
+                                             or []))
             data["serverCount"] = len(status.get("dataNodes", []))
             count = size = 0
             for dc in vols.get("dataCenters", {}).values():

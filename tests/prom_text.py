@@ -1,0 +1,81 @@
+"""Minimal Prometheus text-exposition parser for tests: enough of the
+format (github.com/prometheus/docs exposition_formats) to validate
+what Metrics.render() serves — TYPE lines, escaped label values,
+histogram bucket/sum/count families."""
+
+from __future__ import annotations
+
+import re
+
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"')
+
+
+def _unescape(v: str) -> str:
+    return v.replace(r"\n", "\n").replace(r"\"", '"') \
+        .replace(r"\\", "\\")
+
+
+def parse(text: str) -> "tuple[list[dict], dict[str, str]]":
+    """(samples, types): each sample is {name, labels, value}; types
+    maps metric family name -> declared TYPE.  Raises ValueError on
+    any unparseable non-comment line — the tests' definition of
+    'serves parseable text'."""
+    samples: list[dict] = []
+    types: dict[str, str] = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            fam, _, mtype = rest.partition(" ")
+            types[fam] = mtype.strip()
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        labels = {}
+        raw = m.group("labels")
+        if raw:
+            consumed = 0
+            for lm in _LABEL.finditer(raw):
+                labels[lm.group(1)] = _unescape(lm.group(2))
+                consumed += len(lm.group(0))
+            # every byte between the braces must be label pairs (plus
+            # separators) — a torn quote would otherwise half-match
+            leftovers = _LABEL.sub("", raw).replace(",", "").strip()
+            if leftovers:
+                raise ValueError(
+                    f"bad label block {raw!r} in {line!r}")
+        samples.append({"name": m.group("name"), "labels": labels,
+                        "value": float(m.group("value"))})
+    return samples, types
+
+
+def histogram_families(samples: "list[dict]") -> "dict[tuple, dict]":
+    """Group histogram samples by (family, non-le labels): returns
+    {key: {"buckets": [(le, cum)], "sum": x, "count": n}}."""
+    out: dict[tuple, dict] = {}
+    for s in samples:
+        name = s["name"]
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                fam = name[: -len(suffix)]
+                labels = {k: v for k, v in s["labels"].items()
+                          if k != "le"}
+                key = (fam, tuple(sorted(labels.items())))
+                h = out.setdefault(key, {"buckets": [], "sum": None,
+                                         "count": None})
+                if suffix == "_bucket":
+                    h["buckets"].append((s["labels"].get("le", ""),
+                                         s["value"]))
+                elif suffix == "_sum":
+                    h["sum"] = s["value"]
+                else:
+                    h["count"] = s["value"]
+                break
+    return out

@@ -285,6 +285,69 @@ def test_swfs006_deterministic_paths_stay_clean():
 
 # -- engine: noqa / baseline ---------------------------------------------
 
+# -- SWFS007: leaked trace spans ------------------------------------------
+
+def test_swfs007_flags_discarded_and_unfinished():
+    found = check("""
+        from seaweedfs_tpu import tracing
+
+        def handler():
+            tracing.start_span("op", role="x")
+
+        def handler2():
+            sp = tracing.start_span("op")
+            sp.set("k", 1)
+    """, "SWFS007")
+    assert len(found) == 2
+    assert "discarded" in found[0].message
+    assert "never" in found[1].message and "'sp'" in found[1].message
+
+
+def test_swfs007_flags_ctx_manager_form_discarded():
+    found = check("""
+        from seaweedfs_tpu import tracing
+
+        def handler():
+            tracing.span("op", role="x")
+    """, "SWFS007")
+    assert len(found) == 1
+
+
+def test_swfs007_negative_with_finish_escape():
+    found = check("""
+        from seaweedfs_tpu import tracing
+
+        def with_block():
+            with tracing.span("op") as sp:
+                sp.set("k", 1)
+
+        def manual_pair():
+            sp = tracing.start_span("op")
+            try:
+                pass
+            finally:
+                sp.finish()
+
+        def escapes():
+            return tracing.start_span("op")
+
+        def passed_on(consume):
+            sp = tracing.start_span("op")
+            consume(sp)
+    """, "SWFS007")
+    assert found == []
+
+
+def test_swfs007_noqa_suppresses():
+    found = check("""
+        from seaweedfs_tpu import tracing
+
+        def handler():
+            tracing.start_span("op")  # noqa: SWFS007
+    """, "SWFS007")
+    assert found == []
+
+
 def test_bare_noqa_suppresses_everything():
     src = """
     def f():

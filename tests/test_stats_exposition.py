@@ -1,0 +1,81 @@
+"""stats.py exposition-format contract: cumulative-bucket
+monotonicity, _sum/_count consistency, and Prometheus label escaping
+(backslash, double-quote, newline) — a hostile label value (source
+urls, error strings) must never tear the text a scraper parses."""
+
+import pytest
+
+from prom_text import histogram_families, parse
+from seaweedfs_tpu.stats import DEFAULT_BUCKETS, Metrics, \
+    escape_label_value
+
+
+def test_escape_label_value():
+    assert escape_label_value('pl\\ain') == 'pl\\\\ain'
+    assert escape_label_value('say "hi"') == 'say \\"hi\\"'
+    assert escape_label_value("two\nlines") == "two\\nlines"
+    assert escape_label_value(42) == "42"
+
+
+def test_render_escapes_hostile_label_values():
+    m = Metrics("esc")
+    hostile = 'a"b\\c\nd'
+    m.counter_add("errs_total", 2, source=hostile)
+    m.histogram_observe("lat_seconds", 0.1, source=hostile)
+    text = m.render()
+    assert "\n\n" not in text  # raw newline would add an empty line
+    samples, _types = parse(text)  # must not raise
+    counter = [s for s in samples if s["name"] == "esc_errs_total"]
+    assert counter and counter[0]["labels"]["source"] == hostile
+
+
+def test_histogram_buckets_monotone_and_sum_count_consistent():
+    m = Metrics("h")
+    observations = [0.001, 0.004, 0.03, 0.03, 0.2, 0.7, 3.0, 42.0]
+    for v in observations:
+        m.histogram_observe("lat_seconds", v, method="GET")
+    for v in (0.01, 0.02):
+        m.histogram_observe("lat_seconds", v, method="PUT")
+    samples, types = parse(m.render())
+    assert types["h_lat_seconds"] == "histogram"
+    fams = histogram_families(samples)
+    assert len(fams) == 2
+    for (fam, labels), h in fams.items():
+        assert fam == "h_lat_seconds"
+        les = [le for le, _ in h["buckets"]]
+        assert les[-1] == "+Inf"
+        assert [float(le) for le in les[:-1]] == \
+            sorted(float(le) for le in les[:-1])
+        counts = [c for _, c in h["buckets"]]
+        assert counts == sorted(counts), \
+            f"buckets not cumulative-monotone: {h['buckets']}"
+        assert h["count"] == counts[-1]
+    get = fams[("h_lat_seconds", (("method", "GET"),))]
+    assert get["count"] == len(observations)
+    assert get["sum"] == pytest.approx(sum(observations))
+    # 42.0 only lands in +Inf: the last finite bucket excludes it
+    finite_max = [c for le, c in get["buckets"] if le != "+Inf"][-1]
+    assert finite_max == len(observations) - 1
+
+
+def test_default_buckets_are_seconds():
+    """The satellite's comment fix is load-bearing: code that treats
+    these as milliseconds would misconfigure every histogram."""
+    assert DEFAULT_BUCKETS[0] == 0.005      # 5ms
+    assert DEFAULT_BUCKETS[-1] == 10.0      # 10s
+    assert all(b1 < b2 for b1, b2 in
+               zip(DEFAULT_BUCKETS, DEFAULT_BUCKETS[1:]))
+
+
+def test_counters_gauges_and_types_parse():
+    m = Metrics("role")
+    m.counter_add("requests_total", 3, method="GET", code="200")
+    m.gauge_set("depth", 7.5)
+    samples, types = parse(m.render())
+    by_name = {s["name"]: s for s in samples}
+    assert by_name["role_requests_total"]["value"] == 3
+    assert by_name["role_requests_total"]["labels"] == \
+        {"method": "GET", "code": "200"}
+    assert by_name["role_depth"]["value"] == 7.5
+    assert types["role_requests_total"] == "counter"
+    assert types["role_depth"] == "gauge"

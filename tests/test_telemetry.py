@@ -101,3 +101,27 @@ def test_telemetry_opt_in_and_payload(sink, tmp_path):
 def test_telemetry_survives_unreachable_collector():
     t = TelemetryClient("127.0.0.1:1", enabled=True)
     assert t.send("127.0.0.1:1") is False   # no raise
+
+
+def test_master_count_floors_at_one(monkeypatch):
+    """A healthy single-master cluster answers `peers: []` — that
+    must report 1 master (the answering one), never 0; real peer
+    lists keep their length."""
+    import seaweedfs_tpu.telemetry as tele
+
+    responses = {
+        "/cluster/status": {"topologyId": "t1", "peers": [],
+                            "dataNodes": ["a:1"]},
+        "/vol/list": {"dataCenters": {}},
+    }
+
+    def fake_http_json(method, url, payload=None, **kw):
+        path = "/" + url.split("/", 1)[1]
+        return responses[path]
+
+    monkeypatch.setattr(tele, "http_json", fake_http_json)
+    t = TelemetryClient("collector", enabled=True)
+    assert t.collect("m:9333")["masterCount"] == 1
+
+    responses["/cluster/status"]["peers"] = ["m1:1", "m2:1", "m3:1"]
+    assert t.collect("m:9333")["masterCount"] == 3

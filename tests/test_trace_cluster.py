@@ -1,0 +1,202 @@
+"""Cluster-wide trace assembly (the tracing plane's acceptance
+contract): real server PROCESSES, one request id riding
+X-Request-ID/X-Trace-Parent across roles, `trace.show` fanning out to
+every node's /debug/traces and merging one tree.
+
+Also the metrics-plane satellite: every role's /metrics endpoint must
+serve parseable Prometheus text with the uniform request_seconds
+histogram."""
+
+import time
+
+import pytest
+
+from prom_text import histogram_families, parse
+from proc_framework import ProcCluster
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.server.httpd import http_bytes, http_json
+from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.shell.commands import collect_trace, render_trace
+from seaweedfs_tpu.util.request_id import set_request_id
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = ProcCluster(tmp_path_factory.mktemp("trace"), volumes=2).start()
+    _wait_writable(c)
+    yield c
+    c.stop()
+
+
+def _wait_writable(c, timeout=45):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            fid = operation.submit(c.master, b"probe")
+            assert operation.read(c.master, fid) == b"probe"
+            return
+        except Exception as e:  # noqa: BLE001
+            last = e
+        time.sleep(0.3)
+    raise TimeoutError(f"cluster never writable: {last}")
+
+
+def _assert_valid_tree(spans):
+    """Every span's parent link resolves within the trace (or is a
+    root) and no span parents itself — the merged result is a tree."""
+    ids = {s["spanId"] for s in spans}
+    assert len(ids) == len(spans), "duplicate span ids after merge"
+    for s in spans:
+        assert s["parentId"] != s["spanId"]
+        if s["parentId"]:
+            # roots whose parent span was never recorded are legal
+            # (the client is untraced); recorded parents must resolve
+            if s["parentId"] in ids:
+                parent = next(p for p in spans
+                              if p["spanId"] == s["parentId"])
+                assert parent["traceId"] == s["traceId"]
+
+
+def test_one_write_traces_three_roles(cluster):
+    """A single filer PUT shows up as one trace spanning filer ->
+    master (assign) -> volume (store), assembled by trace.show."""
+    rid = f"trace-write-{int(time.time())}"
+    set_request_id(rid)
+    try:
+        st, _, _ = http_bytes(
+            "POST", f"http://{cluster.filer}/t/one.txt",
+            b"traced write payload")
+        assert st < 300
+    finally:
+        set_request_id("")
+    env = CommandEnv(cluster.master, filer=cluster.filer)
+    spans = collect_trace(env, rid)
+    roles = {s.get("role") or "?" for s in spans}
+    assert {"filer", "master", "volume"} <= roles, \
+        f"expected >=3 roles, got {roles}: {render_trace(spans)}"
+    assert len({s["traceId"] for s in spans}) == 1
+    _assert_valid_tree(spans)
+    # node attribution is per-process in the proc cluster
+    assert {s["node"] for s in spans if s["role"] == "filer"} == \
+        {cluster.filer}
+    # the operator command renders the same thing
+    out = run_command(env, f"trace.show {rid}")
+    assert f"trace {rid}" in out
+    assert "POST /t/one.txt" in out and "[filer@" in out
+    assert "[master@" in out and "[volume@" in out
+
+
+def test_streaming_rebuild_trace_shows_pipeline_stages(cluster):
+    """ec.rebuild -mode=stream leaves a trace whose volume-server
+    rebuild span has distinct fetch/codec/write child spans (the
+    PR 2 pipeline overlap, now visible) with valid parent links."""
+    import numpy as np
+    rng = np.random.default_rng(7)
+    fids = [operation.submit(
+        cluster.master,
+        rng.integers(0, 256, 4000, dtype=np.uint8).tobytes())
+        for _ in range(12)]
+    vid = int(fids[0].split(",")[0])
+    env = CommandEnv(cluster.master, filer=cluster.filer)
+    run_command(env, "lock")
+    try:
+        run_command(env, f"ec.encode -volumeId={vid}")
+        time.sleep(1.0)
+        locs = http_json(
+            "GET",
+            f"{cluster.master}/dir/ec_lookup?volumeId={vid}")
+        by_url = {l["url"]: l["shardIds"]
+                  for l in locs.get("shardIdLocations", [])}
+        assert sum(len(s) for s in by_url.values()) == 14
+        rebuilder = max(by_url, key=lambda u: len(by_url[u]))
+        donor = [u for u in sorted(by_url) if u != rebuilder][0]
+        victim = by_url[donor][0]
+        http_json("POST", f"{donor}/admin/ec/delete_shards",
+                  {"volumeId": vid, "shardIds": [victim]})
+        time.sleep(1.0)
+
+        rid = f"trace-rebuild-{int(time.time())}"
+        set_request_id(rid)
+        try:
+            out = run_command(
+                env, f"ec.rebuild -volumeId={vid} -mode=stream")
+        finally:
+            set_request_id("")
+        assert "rebuilt" in out and "streamed" in out, out
+    finally:
+        run_command(env, "unlock")
+
+    spans = collect_trace(env, rid)
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    for stage in ("rebuild.fetch", "rebuild.codec", "rebuild.write"):
+        assert stage in by_name, \
+            f"missing {stage}: {render_trace(spans)}"
+    _assert_valid_tree(spans)
+    ids = {s["spanId"]: s for s in spans}
+    server_span = by_name["POST /admin/ec/rebuild"][0]
+    for stage in ("rebuild.fetch", "rebuild.codec", "rebuild.write"):
+        sp = by_name[stage][0]
+        # each stage hangs directly under the rebuild server span,
+        # which itself chains to the shell's request id trace
+        assert sp["parentId"] == server_span["spanId"], \
+            render_trace(spans)
+        assert sp["role"] == "volume"
+        assert ids[sp["parentId"]]["name"] == "POST /admin/ec/rebuild"
+    # remote survivor streams got their own child spans with bytes
+    sources = [s for s in spans
+               if s["name"].startswith("rebuild.source.")]
+    assert sources, render_trace(spans)
+    assert all(s["parentId"] == server_span["spanId"]
+               for s in sources)
+    assert sum(s["attrs"]["bytes"] for s in sources) > 0
+    # the stage windows overlap (the pipeline PR 2 built): fetch
+    # starts before write does, and write starts before fetch ends
+    fetch, write = by_name["rebuild.fetch"][0], \
+        by_name["rebuild.write"][0]
+    fetch_end = fetch["start"] + fetch["durationMs"] / 1e3
+    assert fetch["start"] <= write["start"] <= fetch_end + 0.5
+    out = run_command(env, f"trace.show {rid}")
+    assert "rebuild.fetch" in out and "rebuild.codec" in out \
+        and "rebuild.write" in out
+
+
+def test_every_role_serves_parseable_metrics(cluster):
+    """Satellite: /metrics on master, every volume server, and the
+    (new) filer registry all parse as Prometheus text and carry the
+    uniform request_seconds histogram fed by the httpd middleware."""
+    expectations = {
+        "master": ("master", cluster.procs["master"].url),
+        "volume0": ("volume_server", cluster.procs["volume0"].url),
+        "volume1": ("volume_server", cluster.procs["volume1"].url),
+        "filer": ("filer", cluster.filer),
+    }
+    # every listener has served at least one request before the scrape
+    for _role, (_ns, url) in expectations.items():
+        http_bytes("GET", f"{url}/metrics")
+    for role, (ns, url) in expectations.items():
+        st, body, _ = http_bytes("GET", f"{url}/metrics")
+        assert st == 200, (role, st)
+        samples, types = parse(body.decode())  # must not raise
+        assert types.get(f"{ns}_request_seconds") == "histogram", \
+            (role, types)
+        fams = histogram_families(samples)
+        keys = [k for k in fams if k[0] == f"{ns}_request_seconds"]
+        assert keys, (role, list(fams))
+        for key in keys:
+            h = fams[key]
+            counts = [c for _, c in h["buckets"]]
+            assert counts == sorted(counts), (role, h)
+            assert h["count"] == counts[-1], (role, h)
+            assert h["sum"] is not None
+
+
+def test_debug_traces_without_id_returns_recent(cluster):
+    st, body, _ = http_bytes(
+        "GET", f"{cluster.master}/debug/traces?limit=5")
+    import json
+    doc = json.loads(body)
+    assert st == 200
+    assert 0 < len(doc["spans"]) <= 5

@@ -137,7 +137,16 @@ def test_mount_chunk_cache_serves_repeat_reads(mini, tmp_path):
     fs = WeedFS(filer.url, attr_ttl=0.2)
     try:
         blob = os.urandom(3 << 20)
+        marker_ns = time.time_ns()
         http_bytes("POST", f"{filer.url}/big.bin", blob)
+        # let the event poll consume the write's invalidation BEFORE
+        # the first read populates blocks: with the event still
+        # pending, whether the cache survives to the second read was
+        # a sub-10ms race against the poll tick
+        deadline = time.time() + 5
+        while time.time() < deadline and fs._since_ns < marker_ns:
+            time.sleep(0.05)
+        assert fs._since_ns >= marker_ns, "event poll never advanced"
         got = fs.read("/big.bin", 2 << 20, 100)
         assert got == blob[100:100 + (2 << 20)]
 
