@@ -44,8 +44,8 @@ PARITY_SHARDS = 4
 CHAIN = 16  # kernel steps chained per timed launch (amortizes latency)
 ITERS = 3
 
-TPU_TIMEOUT_S = 600  # compile + e2e + tpu-forced e2e + rebuild cluster
-CPU_TIMEOUT_S = 420
+TPU_TIMEOUT_S = 720  # compile + e2e + tpu-forced e2e + rebuild cluster
+CPU_TIMEOUT_S = 560  # + the dist_encode A/B (~100s) added in r06
 
 
 def _best_of_gbps(parity_fn, shard_bytes=1024 * 1024, seed=1, iters=3):
@@ -536,6 +536,210 @@ def _measure_dist_rebuild(nodes: int = 3, blob_mb: int = 1,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _measure_dist_encode(nodes: int = 3, blob_mb: int = 1,
+                         n_blobs: int = 96) -> dict:
+    """Distributed encode A/B over a loopback PROC-cluster: the seed's
+    encode-locally-then-balance (`ec.encode -mode=local`: all 14 shard
+    files written on the source node, mounted, then balance-moved off
+    it one at a time) vs scatter-encode (`-mode=scatter`: placement
+    planned first, shard windows streamed off the GF pipeline straight
+    to their destinations over concurrent chunked
+    `/admin/ec/shard_write` streams — remote shards never touch the
+    source disk and no balance round follows).  Equal durability is
+    asserted every round (all 14 shards mounted at final destinations)
+    and the first scatter round is byte-verified against a local seed
+    encode of the same volume.  Rounds are interleaved, MEDIAN of 4
+    per mode (same jitter rationale as dist_rebuild); between rounds
+    `ec.decode` restores the normal volume so every round encodes the
+    identical bytes.  Volume-bytes accounting (the .dat size) like
+    every other number this bench emits."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    from seaweedfs_tpu import operation
+    from seaweedfs_tpu.server.httpd import http_bytes, http_json
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    from seaweedfs_tpu.storage.erasure_coding import ec_encoder
+    from seaweedfs_tpu.storage.erasure_coding.ec_context import (
+        ECContext, to_ext)
+
+    tmp = tempfile.mkdtemp(prefix="bench_encode_")
+    procs = []
+    try:
+        mport = _free_port()
+        mdir = os.path.join(tmp, "master-meta")
+        os.makedirs(mdir)
+        procs.append(_spawn_role(
+            ["master", "-port", str(mport), "-mdir", mdir,
+             "-volumeSizeLimitMB", "1024"], mport,
+            os.path.join(tmp, "master.log")))
+        master_url = f"127.0.0.1:{mport}"
+        for i in range(nodes):
+            d = os.path.join(tmp, f"v{i}")
+            os.makedirs(d)
+            vport = _free_port()
+            procs.append(_spawn_role(
+                ["volume", "-port", str(vport), "-dir", d,
+                 "-mserver", master_url, "-max", "16"], vport,
+                os.path.join(tmp, f"vol{i}.log")))
+        deadline = _time.time() + 30
+        while _time.time() < deadline:
+            try:
+                if len(http_json("GET",
+                                 f"{master_url}/cluster/status"
+                                 )["dataNodes"]) == nodes:
+                    break
+            except OSError:
+                pass
+            _time.sleep(0.1)
+        rng = np.random.default_rng(29)
+        blob = rng.integers(0, 256, blob_mb << 20,
+                            dtype=np.uint8).tobytes()
+        fids = [operation.submit(master_url, blob)
+                for _ in range(n_blobs)]
+        vid = int(fids[0].split(",")[0])
+        env = CommandEnv(master_url)
+        env.lock()
+
+        def pull(url, ext):
+            status, body, _ = http_bytes(
+                "GET", f"{url}/admin/volume_file?volumeId={vid}"
+                f"&collection=&ext={ext}", timeout=120)
+            if status != 200:
+                raise RuntimeError(f"pull {ext} from {url}: {status}")
+            return body
+
+        def shard_map():
+            r = http_json("GET",
+                          f"{master_url}/dir/ec_lookup?volumeId={vid}")
+            return {l["url"]: l["shardIds"]
+                    for l in r.get("shardIdLocations", [])}
+
+        # golden seed encode of the exact volume bytes, for the
+        # byte-identity assertion on the first scatter round
+        source = env.volume_locations(vid)[0]["url"]
+        http_json("POST", f"{source}/admin/set_readonly",
+                  {"volumeId": vid, "readOnly": True})
+        gbase = os.path.join(tmp, f"golden_{vid}")
+        for ext in (".dat", ".idx"):
+            with open(gbase + ext, "wb") as f:
+                f.write(pull(source, ext))
+        http_json("POST", f"{source}/admin/set_readonly",
+                  {"volumeId": vid, "readOnly": False})
+        volume_bytes = os.path.getsize(gbase + ".dat")
+        gctx = ECContext(backend="cpu")
+        ec_encoder.write_sorted_file_from_idx(gbase)
+        ec_encoder.write_ec_files(gbase, gctx)
+
+        from seaweedfs_tpu.shell import commands as shell_commands
+
+        def _seed_move_shard(env2, vid2, collection, sid, source,
+                             dest) -> None:
+            """The SEED's `_move_shard` verbatim (pre-relay,
+            command_ec_common.go:336): the destination pulls the shard
+            + sidecars WHOLE via `/admin/ec/copy` staging downloads,
+            mounts, then the source drops its copy — the
+            download-then-upload shape the scatter path removes."""
+            http_json("POST", f"{dest}/admin/ec/copy", {
+                "volumeId": vid2, "collection": collection,
+                "shardIds": [sid], "sourceDataNode": source,
+                "copyEcxFile": True, "copyEcjFile": True,
+                "copyVifFile": True}, timeout=600.0)
+            http_json("POST", f"{dest}/admin/ec/mount",
+                      {"volumeId": vid2, "collection": collection,
+                       "shardIds": [sid]})
+            http_json("POST", f"{source}/admin/ec/delete_shards",
+                      {"volumeId": vid2, "collection": collection,
+                       "shardIds": [sid]})
+
+        def encode_scatter() -> None:
+            """One scatter round: the shipped `ec.encode -mode=scatter`
+            shell flow end to end."""
+            run_command(env, f"ec.encode -volumeId={vid} -mode=scatter")
+
+        def encode_seed() -> None:
+            """One SEED round: the shipped `-mode=local` flow
+            (generate on the source, mount, the full balance pass)
+            with the shell's shard move restored to the seed's
+            whole-file `/admin/ec/copy` implementation — i.e. the
+            exact encode+balance path the seed ran, reproduced the
+            same way dist_rebuild reproduces its copy-then-rebuild
+            baseline."""
+            orig = shell_commands._move_shard
+            shell_commands._move_shard = _seed_move_shard
+            try:
+                run_command(env,
+                            f"ec.encode -volumeId={vid} -mode=local")
+            finally:
+                shell_commands._move_shard = orig
+
+        out = {"dist_encode_nodes": nodes,
+               "dist_encode_volume_bytes": volume_bytes}
+        rounds: dict = {"scatter": [], "seed": []}
+        arms = {"scatter": encode_scatter, "seed": encode_seed}
+        verified = False
+        # BOTH arms get an untimed warmup: each path pays one-off
+        # per-server costs on first contact (imports, first
+        # receive/copy on every destination) that belong to neither
+        # timed round
+        for mode in ("warmup-scatter", "warmup-seed",
+                     "scatter", "seed", "scatter", "seed",
+                     "scatter", "seed", "scatter", "seed"):
+            warm = mode.startswith("warmup")
+            m = mode.split("-")[-1] if warm else mode
+            t0 = time.perf_counter()
+            arms[m]()
+            dt = time.perf_counter() - t0
+            # equal durability: every round must end with all 14
+            # shards mounted at their final destinations
+            locs = shard_map()
+            placed = sorted(s for sids in locs.values() for s in sids)
+            if placed != list(range(14)):
+                raise RuntimeError(
+                    f"{mode}: only shards {placed} mounted")
+            if not warm:
+                rounds[m].append(dt)
+            if m == "scatter" and not verified:
+                for url, sids in locs.items():
+                    for sid in sids:
+                        with open(gbase + to_ext(sid), "rb") as gf:
+                            if pull(url, to_ext(sid)) != gf.read():
+                                raise RuntimeError(
+                                    f"scatter shard {sid} differs "
+                                    f"from seed encode")
+                verified = True
+                out["dist_encode_byte_identity"] = True
+            # reset: decode back to a normal volume so the next round
+            # encodes the identical bytes from a clean state
+            run_command(env, f"ec.decode -volumeId={vid}")
+            try:
+                os.sync()
+            except OSError:  # pragma: no cover
+                pass
+            _time.sleep(0.8)  # let v9fs writeback drain so one
+            # round's dirty pages never bleed into the next's window
+        import statistics
+        med = {m: statistics.median(ts) for m, ts in rounds.items()}
+        out["dist_encode_scatter_gbps"] = \
+            round(volume_bytes / med["scatter"] / 1e9, 3)
+        out["dist_encode_seed_balance_gbps"] = \
+            round(volume_bytes / med["seed"] / 1e9, 3)
+        out["dist_encode_rounds_per_mode"] = len(rounds["scatter"])
+        out["dist_encode_speedup"] = round(
+            med["seed"] / max(med["scatter"], 1e-9), 2)
+        return out
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _measure_e2e_tpu_forced(size: int = 128 << 20):
     """The staged encode pipeline with the JAX/TPU backend FORCED
     (VERDICT r4 #3: the headline kernel number is device-side; the
@@ -711,6 +915,13 @@ def measure(platform: str) -> None:
     except Exception as exc:
         print(f"bench: dist rebuild measurement failed: {exc!r}",
               file=sys.stderr)
+    try:
+        # loopback-cluster encode A/B: encode-locally-then-balance vs
+        # scatter-encode streaming shards to their placement targets
+        e2e = dict(e2e or {}, **_measure_dist_encode())
+    except Exception as exc:
+        print(f"bench: dist encode measurement failed: {exc!r}",
+              file=sys.stderr)
     if on_tpu:
         # VERDICT r4 #3: publish the TPU-backed e2e number (the probed
         # pipeline chooses the faster native engine on this tunneled
@@ -800,5 +1011,13 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--measure":
         measure(sys.argv[2])
+    elif len(sys.argv) >= 2 and sys.argv[1] == "dist_encode":
+        # standalone scatter-vs-seed encode A/B (the acceptance
+        # scenario): one JSON line, no accelerator needed
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps(_measure_dist_encode()))
+    elif len(sys.argv) >= 2 and sys.argv[1] == "dist_rebuild":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps(_measure_dist_rebuild()))
     else:
         main()

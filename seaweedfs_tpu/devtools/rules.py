@@ -553,6 +553,71 @@ class LeakedSpanRule(Rule):
         return False
 
 
+class UnclosedShardStreamRule(Rule):
+    """SWFS008: a ShardSink/ShardSource (or their fetcher/stats
+    aggregates holding them) constructed without a context manager or
+    a visible close.  These objects own sockets, fds, send/prefetch
+    threads AND, for sinks, staged server-side temp files: one leaked
+    RemoteShardSink keeps a `.scatter.<id>` temp pinned on its
+    destination until the reaper, and a leaked fetcher strands its
+    prefetch threads.  Same shape as SWFS007 for spans: flagged unless
+    the constructor call is a with-item, or its result visibly reaches
+    `.close()` (put it in a `finally`), a `with` block, or another
+    owner (returned, stored on self/container, passed on)."""
+
+    id = "SWFS008"
+    severity = "error"
+    title = "ShardSink/ShardSource not closed (with/close-in-finally)"
+
+    _SUFFIXES = ("ShardSink", "ShardSource")
+    _EXACT = {"MultiSourceFetcher"}
+
+    def _is_opener(self, name: str) -> bool:
+        last = name.rsplit(".", 1)[-1]
+        return last.endswith(self._SUFFIXES) or last in self._EXACT
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    self._is_opener(_dotted(node.func))):
+                continue
+            verdict = self._verdict(ctx, node)
+            if verdict:
+                yield self.finding(ctx, node, verdict)
+
+    def _verdict(self, ctx: FileContext, call: ast.Call) -> "str | None":
+        name = _dotted(call.func)
+        parent = ctx.parent(call)
+        if isinstance(parent, ast.withitem):
+            return None            # `with LocalShardSink(...) as s:`
+        if isinstance(parent, ast.Attribute):
+            if parent.attr == "close":
+                return None
+            return (f"{name}(...).{parent.attr} drops the stream — "
+                    f"use `with`, or keep it and close() in a finally")
+        if isinstance(parent, ast.Expr):
+            return (f"{name}(...) result discarded — its threads/fds/"
+                    f"staged temps are never released")
+        if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+            targets = parent.targets if isinstance(parent, ast.Assign) \
+                else [parent.target]
+            if len(targets) != 1 or not isinstance(targets[0], ast.Name):
+                return None        # self.x / container: lifecycle-managed
+            var = targets[0].id
+            fn = next((a for a in ctx.ancestors(call)
+                       if isinstance(a, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))), None)
+            scope = fn if fn is not None else ctx.tree
+            # reuse the handle-escape analysis: close()/with/returned/
+            # stored/passed-on all transfer ownership
+            if UnclosedHandleRule._name_is_handled(scope, var, parent):
+                return None
+            return (f"{name}(...) assigned to {var!r} but never "
+                    f"closed, used as a context manager, or passed "
+                    f"on in this scope — close() it in a finally")
+        return None                # escapes into a call/container
+
+
 RULES = [
     LockDisciplineRule(),
     JitBlockingRule(),
@@ -561,4 +626,5 @@ RULES = [
     UnclosedHandleRule(),
     WallClockRule(),
     LeakedSpanRule(),
+    UnclosedShardStreamRule(),
 ]

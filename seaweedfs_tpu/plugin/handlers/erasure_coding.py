@@ -39,12 +39,19 @@ class EcEncodeHandler(JobHandler):
     def __init__(self, fullness_ratio: float = 0.9,
                  collection_filter: str | None = None,
                  data_shards: int = 10, parity_shards: int = 4,
-                 backend: str | None = None):
+                 backend: str | None = None,
+                 encode_mode: str = "worker"):
         self.fullness_ratio = fullness_ratio
         self.collection_filter = collection_filter
         self.data_shards = data_shards
         self.parity_shards = parity_shards
         self.backend = backend  # None -> auto (jax on TPU)
+        # "worker": pull the volume here, encode on this worker's
+        # accelerator, distribute (the TPU hot path).  "scatter": drive
+        # the SOURCE server's scatter-encode — placement-first, shard
+        # windows streamed straight to their destinations; the worker
+        # only orchestrates (no volume bytes cross the plugin boundary)
+        self.encode_mode = encode_mode
 
     def capability(self) -> dict:
         # weight 80 per erasure_coding_handler.go:48
@@ -64,6 +71,10 @@ class EcEncodeHandler(JobHandler):
              "default": self.data_shards},
             {"name": "parityShards", "type": "int",
              "default": self.parity_shards},
+            {"name": "encodeMode", "type": "string",
+             "default": self.encode_mode,
+             "help": "worker (pull+encode here) or scatter "
+                     "(source streams shards to placement targets)"},
         ]}
 
     # -- Detect (:187) ------------------------------------------------
@@ -187,6 +198,20 @@ class EcEncodeHandler(JobHandler):
                   f"delete original on {url}")
 
     def execute(self, worker, job_id: str, params: dict) -> str:
+        if params.get("encodeMode", self.encode_mode) == "scatter":
+            if "volumeIds" in params:
+                # scatter has no mesh-batch form (each volume streams
+                # from its own source); run the volumes sequentially
+                # rather than silently falling back to the
+                # pull-everything worker path
+                out = []
+                for v in dict.fromkeys(int(x)
+                                       for x in params["volumeIds"]):
+                    p = dict(params, volumeId=v)
+                    p.pop("volumeIds", None)
+                    out.append(self.execute_scatter(worker, job_id, p))
+                return "\n".join(out)
+            return self.execute_scatter(worker, job_id, params)
         if "volumeIds" in params:
             return self.execute_batch(worker, job_id, params)
         vid = int(params["volumeId"])
@@ -207,6 +232,34 @@ class EcEncodeHandler(JobHandler):
         return (f"volume {vid}: {ctx} shards encoded on worker "
                 f"({ctx.backend}) and distributed to "
                 f"{sum(1 for s in placement.values() if s)} servers")
+
+    def execute_scatter(self, worker, job_id: str,
+                        params: dict) -> str:
+        """Admin-driven scatter-encode OFF the shell path: the worker
+        plans placement and drives the source server's streaming
+        scatter generate (`/admin/ec/generate` + placement) — volume
+        bytes flow source -> destinations directly, never through this
+        worker.  Runs under the cluster admin lease (the shell's lock)
+        so placement cannot interleave with an operator's balance."""
+        from ...shell.commands import _do_ec_encode
+        from .balance import _LockedShellRun
+        vid = int(params["volumeId"])
+        collection = params.get("collection", "")
+        worker.report_progress(job_id, 0.1,
+                               f"scatter-encoding volume {vid}")
+        opts = {"collection": collection}
+        if "dataShards" in params:
+            opts["dataShards"] = params["dataShards"]
+        if "parityShards" in params:
+            opts["parityShards"] = params["parityShards"]
+        with _LockedShellRun(worker.master) as env:
+            msg = _do_ec_encode(
+                env, vid,
+                int(params.get("dataShards", self.data_shards)),
+                int(params.get("parityShards", self.parity_shards)),
+                opts, mode="scatter")
+        worker.report_progress(job_id, 0.9, "scattered and mounted")
+        return msg
 
     def _encode_and_distribute(self, worker, job_id: str, vid: int,
                                collection: str, ctx: ECContext,

@@ -664,6 +664,77 @@ def http_relay(src_url: str, dst_method: str, dst_url: str,
             conn.close()
 
 
+def http_stream_request(method: str, url: str, chunks,
+                        headers: dict | None = None,
+                        timeout: float = 600.0
+                        ) -> "tuple[int, bytes]":
+    """Send an iterable of byte windows as ONE chunked-encoded request
+    body — the producer side of `Request.stream_body`.  The request is
+    on the wire from the first window, so a producer that generates
+    bytes incrementally (the scatter-encode GF pipeline) streams at
+    wire speed with bounded memory instead of staging a whole shard.
+    A producer exception tears the connection down mid-body — the
+    receiver sees a short chunked stream and errors, never a
+    truncated-but-clean upload.  Returns (status, body)."""
+    import http.client
+
+    full_url, ctx = _dial(url)
+    parsed = urllib.parse.urlsplit(full_url)
+    target = parsed.path or "/"
+    if parsed.query:
+        target += "?" + parsed.query
+    if parsed.scheme == "https":
+        conn = http.client.HTTPSConnection(
+            parsed.netloc, timeout=timeout, context=ctx)
+    else:
+        conn = http.client.HTTPConnection(parsed.netloc,
+                                          timeout=timeout)
+    up_headers = dict(_auth_for(url, headers))
+    try:
+        # manual chunk framing instead of http.client's encode_chunked:
+        # that path CONCATENATES header+chunk+trailer into a fresh
+        # buffer per window (one extra multi-MB copy per send on the
+        # scatter hot path); three sends straight off the caller's
+        # memoryview keep the loop copy-free (sendall releases the GIL)
+        conn.putrequest(method, target, skip_accept_encoding=True)
+        for hk, hv in up_headers.items():
+            conn.putheader(hk, hv)
+        conn.putheader("Transfer-Encoding", "chunked")
+        conn.endheaders()
+        if conn.sock is not None:
+            import socket as _socket
+            # the per-chunk framing interleaves small sends (size
+            # line, CRLF) with multi-MB payload sends — Nagle would
+            # park the small ones behind delayed ACKs
+            conn.sock.setsockopt(_socket.IPPROTO_TCP,
+                                 _socket.TCP_NODELAY, 1)
+        try:
+            for chunk in chunks:
+                n = len(chunk)
+                if not n:
+                    continue
+                conn.send(b"%X\r\n" % n)
+                conn.send(chunk)
+                conn.send(b"\r\n")
+            conn.send(b"0\r\n\r\n")
+        except OSError:
+            # the receiver may have REJECTED the upload mid-body
+            # (4xx/5xx + close) — its verdict is the root cause the
+            # caller needs, not this broken pipe; surface it if the
+            # response is readable
+            import http.client as _hc
+            try:
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            except (OSError, _hc.HTTPException):
+                pass
+            raise
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
 def http_upload(method: str, url: str, src_path: str,
                 headers: dict | None = None, timeout: float = 600.0
                 ) -> tuple[int, bytes, dict]:

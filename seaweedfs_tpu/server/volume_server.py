@@ -66,6 +66,11 @@ class VolumeServer:
         r("POST", "/admin/receive_file", self._receive_file)
         # EC admin <- volume_server.proto:89-108
         r("POST", "/admin/ec/generate", self._ec_generate)
+        r("POST", "/admin/ec/shard_write", self._ec_shard_write)
+        r("POST", "/admin/ec/shard_write_commit",
+          self._ec_shard_write_commit)
+        r("POST", "/admin/ec/shard_write_abort",
+          self._ec_shard_write_abort)
         r("POST", "/admin/ec/mount", self._ec_mount)
         r("POST", "/admin/ec/unmount", self._ec_unmount)
         r("POST", "/admin/ec/copy", self._ec_copy)
@@ -95,6 +100,10 @@ class VolumeServer:
         self._hb_thread: threading.Thread | None = None
         self._topology_id = ""
         self._last_hb_error: str | None = None
+        # staged scatter-encode shard uploads awaiting commit:
+        # uploadId -> {path, crc, bytes, vid, collection, stamp}
+        self._pending_shard_writes: dict[str, dict] = {}
+        self._pending_lock = threading.Lock()
         from .store_ec import EcReader
         self.ec_reader = EcReader(
             master, self.http.url,
@@ -107,6 +116,21 @@ class VolumeServer:
     # -- lifecycle --------------------------------------------------------
 
     def start(self):
+        # sweep staged scatter-upload temps orphaned by a crash: the
+        # in-memory pending registry died with the old process, so
+        # nothing else will ever reclaim these multi-MB files (the
+        # lazy reaper only sees uploads registered in THIS process)
+        for loc in self.store.locations:
+            try:
+                names = os.listdir(loc.directory)
+            except OSError:
+                continue
+            for name in names:
+                if ".scatter." in name:
+                    try:
+                        os.remove(os.path.join(loc.directory, name))
+                    except OSError:
+                        pass
         self.http.start()
         # UDS zero-copy read plane (RDMA sidecar analog,
         # seaweedfs-rdma-sidecar/rdma-engine/src/ipc.rs): same-host
@@ -933,7 +957,15 @@ class VolumeServer:
     def _ec_generate(self, req: Request):
         """:43 VolumeEcShardsGenerate.  Invariant: write .ecx BEFORE the
         shard files and snapshot datSize first (race rationale :89-98),
-        then persist the scheme to .vif (:132)."""
+        then persist the scheme to .vif (:132).
+
+        With a `placement` map ({shard_id: url}) in the body this
+        becomes SCATTER-encode: shard slices stream straight off the GF
+        pipeline to their placement targets (one chunked
+        `/admin/ec/shard_write` stream per remote shard), sidecars are
+        pushed, and every shard is committed + mounted at its final
+        destination — remote shards never touch this node's disks and
+        the later `ec.balance` re-copy round disappears entirely."""
         b = req.json()
         vid = int(b["volumeId"])
         collection = b.get("collection", "")
@@ -955,10 +987,365 @@ class VolumeServer:
         v.sync()
         base = v.file_name("")
         dat_size = v.dat_size()
+        placement = b.get("placement")
+        if placement is not None:
+            return self._ec_scatter_generate(
+                v, ctx, collection, base, dat_size, placement)
         ec_encoder.write_sorted_file_from_idx(base)      # .ecx first!
         ec_encoder.write_ec_files(base, ctx)
         ec_encoder.save_ec_volume_info(base, ctx, dat_size, v.version)
         return 200, {"shardIds": list(range(ctx.total))}
+
+    def _ec_scatter_generate(self, v, ctx: ECContext, collection: str,
+                             base: str, dat_size: int,
+                             placement: dict):
+        """Placement-first streaming encode (the scatter tentpole).
+        Order is the no-partial-stripe invariant: (1) pipeline every
+        shard's windows to its sink and VERIFY delivery (crc + byte
+        count, still uncommitted temps), (2) push sidecars
+        (.ecx/.vif[/.ecj]) to every remote destination, (3) commit each
+        shard — the receiver's atomic rename — with mount-on-commit,
+        (4) mount local shards.  A failure anywhere unwinds: uncommitted
+        temps are aborted, committed/mounted shards are deleted via
+        delete_shards, and the caller (shell/worker) restores the
+        volume to read-write.  Nothing is ever mounted from a partial
+        stripe."""
+        import time as _time
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..storage.erasure_coding.shard_sink import (
+            LocalShardSink, RemoteShardSink, ScatterStats)
+        dests: dict[int, str] = {}
+        for sid_s, url in (placement or {}).items():
+            dests[int(sid_s)] = url
+        if sorted(dests) != list(range(ctx.total)):
+            return 400, {"error": f"placement must cover shards "
+                                  f"0..{ctx.total - 1}, got "
+                                  f"{sorted(dests)}"}
+        self_urls = {self.http.url, self.store.public_url}
+        stats = ScatterStats()
+        t_start = _time.perf_counter()
+        # snapshot any pre-existing .vif: for a TIERED volume it is the
+        # ONLY reference to the remote .dat, and the unwind must
+        # restore it verbatim, never delete it
+        vif_before: "bytes | None" = None
+        try:
+            with open(base + ".vif", "rb") as vf:
+                vif_before = vf.read()
+        except OSError:
+            pass
+        ec_encoder.write_sorted_file_from_idx(base)      # .ecx first!
+        sinks: list = []
+        local_sids: list[int] = []
+        try:
+            for sid in range(ctx.total):
+                if dests[sid] in self_urls:
+                    local_sids.append(sid)
+                    sinks.append(LocalShardSink(
+                        base + ctx.to_ext(sid), temp=True,
+                        stats=stats))
+                else:
+                    sinks.append(RemoteShardSink(
+                        dests[sid], v.id, sid, collection=collection,
+                        headers=self.security.admin_headers))
+            # (1) stream the volume through the GF pipeline; on return
+            # every sink is finished (delivery verified) or aborted
+            ec_encoder._generate_ec_files(base, ctx, sinks=sinks,
+                                          stats=stats)
+            t_encoded = _time.perf_counter()
+            ec_encoder.save_ec_volume_info(base, ctx, dat_size,
+                                           v.version)
+            # (2) sidecars to every remote destination BEFORE any
+            # commit: mount needs .ecx, and a destination must never
+            # hold a visible shard it cannot serve.  One thread per
+            # destination — the files are small, the round-trips are
+            # what would serialize.
+            remote_dests = sorted({u for s, u in dests.items()
+                                   if s not in local_sids})
+            sidecars: list[tuple[str, bytes]] = []
+            for ext in (".ecx", ".vif", ".ecj"):
+                if os.path.exists(base + ext):  # .ecj: post-encode
+                    with open(base + ext, "rb") as sf:
+                        sidecars.append((ext, sf.read()))
+
+            def push_sidecars(url: str) -> None:
+                for ext, payload in sidecars:
+                    st, body, _ = http_bytes(
+                        "POST",
+                        f"{url}/admin/receive_file?volumeId={v.id}"
+                        f"&collection={collection}&ext={ext}",
+                        payload,
+                        headers=self.security.admin_headers())
+                    if st != 200:
+                        raise OSError(f"push {ext} to {url}: {st} "
+                                      f"{body[:200]!r}")
+            with ThreadPoolExecutor(
+                    max_workers=max(1, len(remote_dests))) as spool:
+                list(spool.map(push_sidecars, remote_dests))
+            t_sidecars = _time.perf_counter()
+            # (3) + (4) commit-and-mount: ONE batched round trip per
+            # destination (every shard verified before any rename on
+            # the receiving side, one mount rescan + one heartbeat per
+            # dest instead of 14 of each), destinations in parallel
+            by_dest_sids: dict[str, list[int]] = {}
+            for sid in range(ctx.total):
+                if sid not in local_sids:
+                    by_dest_sids.setdefault(dests[sid], []).append(sid)
+
+            def commit_dest(item):
+                url, sids = item
+                r = http_json(
+                    "POST", f"{url}/admin/ec/shard_write_commit",
+                    {"volumeId": v.id, "collection": collection,
+                     "mount": True,
+                     "commits": [{"uploadId": sinks[sid].upload_id,
+                                  "shardId": sid,
+                                  "crc32": sinks[sid].crc,
+                                  "bytes": sinks[sid].bytes}
+                                 for sid in sids]},
+                    headers=self.security.admin_headers())
+                if "error" in r:
+                    raise OSError(
+                        f"commit {sids} on {url}: {r['error']}")
+                for sid in sids:
+                    sinks[sid].mark_committed()
+            with ThreadPoolExecutor(
+                    max_workers=max(1, len(by_dest_sids))) as pool:
+                list(pool.map(commit_dest, by_dest_sids.items()))
+            for sid in local_sids:
+                sinks[sid].commit()
+            if local_sids:
+                self.store.mount_ec_shards(v.id, collection,
+                                           local_sids)
+            else:
+                # no shard stays here: drop the staging .ecx so the
+                # source is not left resolving a stale EC base for
+                # this vid forever (delete_volume only cleans .vif;
+                # the destinations own their own sidecar copies)
+                try:
+                    os.remove(base + ".ecx")
+                except OSError:
+                    pass
+            self._heartbeat_once()
+            t_mounted = _time.perf_counter()
+        except Exception as e:  # noqa: BLE001 — unwind, then report
+            for sink in sinks:
+                try:
+                    sink.close()  # aborts anything uncommitted
+                except OSError:
+                    pass
+            self._ec_scatter_unwind(v.id, collection, ctx, dests,
+                                    base, vif_before)
+            return 500, {"error": f"scatter encode: {e}"}
+        wall = _time.perf_counter() - t_start
+        tele = stats.summary(dat_size, wall)
+        tele["mode"] = "scatter"
+        tele["encodeSeconds"] = round(t_encoded - t_start, 3)
+        tele["sidecarSeconds"] = round(t_sidecars - t_encoded, 3)
+        tele["commitSeconds"] = round(t_mounted - t_sidecars, 3)
+        self._record_scatter_metrics(stats, tele)
+        return 200, {"shardIds": list(range(ctx.total)),
+                     "placement": {str(s): u for s, u in dests.items()},
+                     "localShardIds": local_sids,
+                     "telemetry": tele}
+
+    def _ec_scatter_unwind(self, vid: int, collection: str,
+                           ctx: ECContext, dests: "dict[int, str]",
+                           base: str,
+                           vif_before: "bytes | None") -> None:
+        """Failure unwind for a scatter encode: tear down anything a
+        destination may already hold (committed shards, pushed
+        sidecars) plus this node's local artifacts, so the still-live
+        volume is the only copy the master serves.  delete_shards is
+        idempotent and cleans sidecars when the last shard goes.  The
+        .vif is RESTORED to its pre-encode bytes, never just deleted —
+        for a tiered volume it is the only pointer to the remote
+        .dat."""
+        for url in sorted(set(dests.values())):
+            try:
+                http_json("POST", f"{url}/admin/ec/delete_shards",
+                          {"volumeId": vid, "collection": collection,
+                           "shardIds": list(range(ctx.total))},
+                          headers=self.security.admin_headers())
+            except OSError:
+                pass
+        try:
+            os.remove(base + ".ecx")  # staging index of the aborted run
+        except OSError:
+            pass
+        try:
+            if vif_before is not None:
+                with open(base + ".vif", "wb") as vf:
+                    vf.write(vif_before)
+            else:
+                os.remove(base + ".vif")
+        except OSError:
+            pass
+
+    def _record_scatter_metrics(self, stats, tele: dict) -> None:
+        """stats.py + telemetry.py emission for one scatter encode:
+        the write-amplification claim must be OBSERVABLE in /metrics
+        (bytes scattered per destination vs bytes written locally),
+        not just inferred from the bench."""
+        by_dest, latencies, local_bytes = stats.snapshot()
+        for dest, nbytes in by_dest.items():
+            self.metrics.counter_add(
+                "ec_encode_bytes_scattered_total", float(nbytes),
+                help_text="shard bytes streamed to placement targets "
+                          "during scatter-encode",
+                dest=dest)
+        self.metrics.counter_add(
+            "ec_encode_local_write_bytes_total", float(local_bytes),
+            help_text="shard bytes written to this node's own disks "
+                      "during scatter-encode")
+        for seconds in latencies:
+            self.metrics.histogram_observe(
+                "ec_encode_push_slice_seconds", seconds,
+                help_text="per-window destination push latency")
+        self.metrics.counter_add("ec_scatter_encodes_total", 1.0,
+                                 help_text="scatter encodes run")
+        self.metrics.gauge_set(
+            "ec_encode_volume_gbps", tele["volumeGbps"],
+            help_text="volume-bytes/s of the last scatter encode")
+        from .. import telemetry as _telemetry
+        _telemetry.note_ec_scatter_encode(tele["bytesScatteredTotal"])
+
+    # -- scatter shard_write receivers (the ReceiveFile twin for the
+    # streaming encode path: temp + crc while streaming, atomic rename
+    # only at explicit commit) ------------------------------------------
+
+    def _ec_shard_write(self, req: Request):
+        """Stream one shard's bytes (chunked) into a `.scatter.<id>`
+        temp file with an incremental CRC32.  The shard stays invisible
+        (unmounted, temp-named) until `shard_write_commit`; a stream
+        that dies mid-body leaves nothing registered and the temp is
+        removed."""
+        import zlib
+        vid = int(req.query["volumeId"])
+        sid = int(req.query["shardId"])
+        collection = req.query.get("collection", "")
+        upload_id = req.query.get("uploadId", "")
+        try:
+            _check_path_fields(collection)
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        if not upload_id.isalnum():
+            return 400, {"error": "bad uploadId"}
+        self._reap_stale_shard_writes()
+        base = self._base_path(vid, collection)
+        tmp = f"{base}{to_ext(sid)}.scatter.{upload_id}"
+        crc = 0
+        n = 0
+        ok = False
+        try:
+            # page-cache writes, like every other ReceiveFile surface
+            # (receive_file, ec/copy): the scatter shard's durability
+            # contract matches the seed balance-move it replaces —
+            # integrity is the CRC + commit handshake, not fsync
+            with open(tmp, "wb") as f:
+                for chunk in req.stream_body():
+                    f.write(chunk)
+                    crc = zlib.crc32(chunk, crc)
+                    n += len(chunk)
+            ok = True
+        finally:
+            if not ok:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+        with self._pending_lock:
+            self._pending_shard_writes[upload_id] = {
+                "path": tmp, "crc": crc, "bytes": n, "vid": vid,
+                "sid": sid, "collection": collection,
+                "stamp": time.monotonic()}
+        return 200, {"bytes": n, "crc32": crc}
+
+    def _reap_stale_shard_writes(self, max_age: float = 3600.0) -> None:
+        """Drop staged uploads whose sender died without an abort —
+        their temps must not accumulate forever."""
+        now = time.monotonic()
+        with self._pending_lock:
+            stale = [k for k, rec in self._pending_shard_writes.items()
+                     if now - rec["stamp"] > max_age]
+            recs = [self._pending_shard_writes.pop(k) for k in stale]
+        for rec in recs:
+            try:
+                os.remove(rec["path"])
+            except OSError:
+                pass
+
+    def _ec_shard_write_commit(self, req: Request):
+        """Verify the sender's CRC + byte count against what was
+        streamed, then atomically rename the temp(s) to their final
+        `.ecNN` names; `mount: true` mounts in the same step (the
+        scatter source commits only after the whole stripe delivered +
+        sidecars landed, so mount-on-commit can never mount a partial
+        stripe).  Accepts a single upload ({uploadId, shardId, crc32,
+        bytes}) or a batch (`commits: [...]`) — the scatter source
+        commits all of one destination's shards in ONE round trip, all
+        verified BEFORE any rename, with one mount + one heartbeat."""
+        b = req.json()
+        vid = int(b["volumeId"])
+        collection = b.get("collection", "")
+        commits = b.get("commits")
+        if commits is None:
+            commits = [{"uploadId": b.get("uploadId", ""),
+                        "shardId": b.get("shardId", -1),
+                        "crc32": b.get("crc32", -1),
+                        "bytes": b.get("bytes", -1)}]
+        recs: list[tuple[dict, dict]] = []
+        with self._pending_lock:
+            for c in commits:
+                rec = self._pending_shard_writes.pop(
+                    str(c.get("uploadId", "")), None)
+                if rec is not None:
+                    recs.append((c, rec))
+        def _discard():
+            for _c, rec in recs:
+                try:
+                    os.remove(rec["path"])
+                except OSError:
+                    pass
+        if len(recs) != len(commits):
+            _discard()
+            return 404, {"error": f"unknown staged upload in "
+                                  f"{[c.get('uploadId') for c in commits]}"}
+        for c, rec in recs:
+            sid = int(c["shardId"])
+            if int(c.get("bytes", -1)) != rec["bytes"] or \
+                    int(c.get("crc32", -1)) != rec["crc"] or \
+                    vid != rec["vid"] or sid != rec["sid"] or \
+                    collection != rec["collection"]:
+                _discard()
+                return 409, {"error":
+                             f"shard {vid}.{sid} upload mismatch: "
+                             f"staged {rec['bytes']}B crc "
+                             f"{rec['crc']}, caller says "
+                             f"{c.get('bytes')}B crc {c.get('crc32')}"}
+        base = self._base_path(vid, collection)
+        sids = []
+        for c, rec in recs:
+            sid = int(c["shardId"])
+            os.replace(rec["path"], base + to_ext(sid))
+            sids.append(sid)
+        if b.get("mount"):
+            self.store.mount_ec_shards(vid, collection, sids)
+            self._heartbeat_once()
+        return 200, {"shardIds": sids,
+                     "bytes": sum(rec["bytes"] for _c, rec in recs)}
+
+    def _ec_shard_write_abort(self, req: Request):
+        b = req.json()
+        upload_id = str(b.get("uploadId", ""))
+        with self._pending_lock:
+            rec = self._pending_shard_writes.pop(upload_id, None)
+        if rec is not None:
+            try:
+                os.remove(rec["path"])
+            except OSError:
+                pass
+        return 200, {}
 
     def _ec_mount(self, req: Request):
         """:443 VolumeEcShardsMount."""

@@ -118,12 +118,26 @@ def cmd_volume_vacuum(env: CommandEnv, args: list[str]) -> str:
 
 @command("ec.encode")
 def cmd_ec_encode(env: CommandEnv, args: list[str]) -> str:
-    """shell/command_ec_encode.go:86 Do:
-    select volumes -> mark readonly -> generate shards on the source
-    server (ecx first) -> mount -> balance across servers -> delete
-    originals."""
+    """shell/command_ec_encode.go:86 Do, placement-first.
+
+    Default `-mode=scatter`: plan every shard's destination up front
+    (the same rack-spread + placement-score rules ec.balance enforces),
+    then have the source server stream each shard's GF-pipeline windows
+    DIRECTLY to its destination over one long chunked
+    `/admin/ec/shard_write` stream — shards bound elsewhere never touch
+    the source's disks and no balance re-copy round follows (the 1.4x
+    source write amplification collapses to the sidecars, ~0.07x).
+    `-mode=local` keeps the seed shape — generate all shards on the
+    source, mount, then balance-move them off — and is the A/B
+    baseline bench.py measures against
+    (SEAWEEDFS_TPU_EC_ENCODE_MODE overrides the default)."""
     env.confirm_is_locked()
     opts = _parse_flags(args)
+    import os as _os
+    mode = opts.get("mode", _os.environ.get(
+        "SEAWEEDFS_TPU_EC_ENCODE_MODE", "scatter"))
+    if mode not in ("scatter", "local"):
+        return f"unknown -mode={mode}; use scatter or local"
     data_shards = int(opts.get("dataShards", 10))
     parity_shards = int(opts.get("parityShards", 4))
     vids = _select_volumes(env, opts)
@@ -132,12 +146,13 @@ def cmd_ec_encode(env: CommandEnv, args: list[str]) -> str:
     out = []
     for vid in vids:
         out.append(_do_ec_encode(env, vid, data_shards, parity_shards,
-                                 opts))
+                                 opts, mode))
     return "\n".join(out)
 
 
 def _do_ec_encode(env: CommandEnv, vid: int, data_shards: int,
-                  parity_shards: int, opts: dict) -> str:
+                  parity_shards: int, opts: dict,
+                  mode: str = "scatter") -> str:
     # pre-collect locations before mutating (race fix,
     # command_ec_encode.go:160-166)
     locations = env.volume_locations(vid)
@@ -149,38 +164,120 @@ def _do_ec_encode(env: CommandEnv, vid: int, data_shards: int,
         # never a real collection name — passing it through would make
         # generate/mount address nonexistent "ALL_<vid>" files
         collection = ""
-    # 1. mark all replicas readonly (:250)
-    for loc in locations:
-        http_json("POST", f"{loc['url']}/admin/set_readonly",
-                  {"volumeId": vid, "readOnly": True})
-    # 2. generate EC shards on the first replica (:359)
-    source = locations[0]["url"]
-    r = http_json("POST", f"{source}/admin/ec/generate", {
-        "volumeId": vid, "collection": collection,
-        "dataShards": data_shards, "parityShards": parity_shards})
-    if "error" in r:
-        raise RuntimeError(f"generate on {source}: {r['error']}")
     total = data_shards + parity_shards
-    # 3. mount all shards on source (:314) — a silent mount failure
-    # here would let step 5 delete the originals with the EC copy
-    # unregistered (data loss)
-    _must(http_json("POST", f"{source}/admin/ec/mount", {
-        "volumeId": vid, "collection": collection,
-        "shardIds": list(range(total))}),
-        f"mount ec shards on {source}")
-    # 4. spread shards across servers (EcBalance, :199)
-    moved = _balance_ec_volume(env, vid, collection, total)
-    # 5. delete original volume replicas (:329)
+    source = locations[0]["url"]
+    # 1. mark all replicas readonly (:250) — and UNWIND on any later
+    # failure: a failed generate/mount must not strand the volume
+    # readonly forever (it is still the only copy of the data)
+    marked: list[str] = []
+    try:
+        for loc in locations:
+            _must(http_json("POST",
+                            f"{loc['url']}/admin/set_readonly",
+                            {"volumeId": vid, "readOnly": True}),
+                  f"set readonly on {loc['url']}")
+            marked.append(loc["url"])
+        if mode == "scatter":
+            # 2s. placement FIRST (the scores/rack rules balance would
+            # apply after the fact), then one scatter generate: the
+            # source streams every shard to its final destination and
+            # mounts it there — no local mount, no balance round
+            placement = _plan_ec_placement(env, vid, total)
+            r = http_json("POST", f"{source}/admin/ec/generate", {
+                "volumeId": vid, "collection": collection,
+                "dataShards": data_shards,
+                "parityShards": parity_shards,
+                "placement": {str(s): u
+                              for s, u in placement.items()}},
+                timeout=600.0)
+            _must(r, f"scatter generate on {source}")
+            moved = 0
+        else:
+            # 2. generate EC shards on the first replica (:359)
+            _must(http_json("POST", f"{source}/admin/ec/generate", {
+                "volumeId": vid, "collection": collection,
+                "dataShards": data_shards,
+                "parityShards": parity_shards}, timeout=600.0),
+                f"generate on {source}")
+            # 3. mount all shards on source (:314) — a silent mount
+            # failure here would let step 5 delete the originals with
+            # the EC copy unregistered (data loss)
+            _must(http_json("POST", f"{source}/admin/ec/mount", {
+                "volumeId": vid, "collection": collection,
+                "shardIds": list(range(total))}),
+                f"mount ec shards on {source}")
+            # 4. spread shards across servers (EcBalance, :199)
+            moved = _balance_ec_volume(env, vid, collection, total)
+            r = {}
+    except BaseException:
+        # restore read-write on every replica we froze, then surface
+        # the ORIGINAL error (scatter/generate handlers already tore
+        # down their own partial state)
+        for url in marked:
+            try:
+                http_json("POST", f"{url}/admin/set_readonly",
+                          {"volumeId": vid, "readOnly": False})
+            except OSError:
+                pass
+        raise
+    # 5. delete original volume replicas (:329) — only now, with every
+    # shard mounted at its destination
     for loc in locations:
         http_json("POST", f"{loc['url']}/admin/delete_volume",
                   {"volumeId": vid})
+    if mode == "scatter":
+        tele = r.get("telemetry") or {}
+        dests = len(set((r.get("placement") or {}).values())) or 1
+        msg = (f"volume {vid}: scatter-encoded {total} shards from "
+               f"{source} to {dests} destinations, deleted originals")
+        if tele:
+            msg += (f" [{tele['bytesScatteredTotal'] >> 20}MB "
+                    f"scattered, {tele['localWriteBytes'] >> 20}MB "
+                    f"local, {tele['volumeGbps']} GB/s volume-rate, "
+                    f"window p95 {tele['windowP95Ms']}ms]")
+        return msg
     return (f"volume {vid}: encoded {total} shards on {source}, "
             f"moved {moved} shards, deleted originals")
 
 
-def _rack_of_nodes(env: CommandEnv) -> dict[str, str]:
+def _plan_ec_placement(env: CommandEnv, vid: int, total: int
+                       ) -> "dict[int, str]":
+    """Placement-first shard->server plan, applying the same rules
+    `_balance_ec_volume` would enforce AFTER the fact: spread across
+    racks toward ceil(total/racks) per rack, even out per-server
+    counts within a rack, and break ties by placement score
+    (diskDistributionScore role — anti-correlation with this volume's
+    shards weighs heaviest).  Computing this BEFORE encode is what
+    lets scatter stream every shard to its final home in one hop."""
+    nodes = _all_node_urls(env)
+    if not nodes:
+        raise RuntimeError("no alive volume servers to place shards")
+    vl = env.volume_list()   # one topology fetch for both helpers
+    rack_of = _rack_of_nodes(env, vl)
+    score = _ec_placement_scores(env, vid, vl)
+    racks = sorted({rack_of.get(n, "?") for n in nodes})
+    per_rack_cap = max(1, -(-total // len(racks)))  # ceil
+    rack_load: dict[str, int] = {r: 0 for r in racks}
+    node_load: dict[str, int] = {n: 0 for n in nodes}
+    placement: dict[int, str] = {}
+    for sid in range(total):
+        open_racks = [r for r in racks if rack_load[r] < per_rack_cap]
+        if not open_racks:
+            open_racks = racks  # more shards than rack capacity: wrap
+        rack = min(open_racks, key=lambda r: rack_load[r])
+        members = [n for n in nodes if rack_of.get(n, "?") == rack]
+        dst = min(members, key=lambda n: (node_load[n],
+                                          score.get(n, 0)))
+        placement[sid] = dst
+        rack_load[rack] += 1
+        node_load[dst] += 1
+    return placement
+
+
+def _rack_of_nodes(env: CommandEnv, vl: "dict | None" = None
+                   ) -> dict[str, str]:
     """url -> "dc/rack" from the topology tree."""
-    vl = env.volume_list()
+    vl = vl if vl is not None else env.volume_list()
     out: dict[str, str] = {}
     for dc_name, dc in vl.get("dataCenters", {}).items():
         for rack_name, rack in dc.get("racks", {}).items():
@@ -189,7 +286,8 @@ def _rack_of_nodes(env: CommandEnv) -> dict[str, str]:
     return out
 
 
-def _ec_placement_scores(env: CommandEnv, vid: int) -> dict[str, int]:
+def _ec_placement_scores(env: CommandEnv, vid: int,
+                         vl: "dict | None" = None) -> dict[str, int]:
     """Per-node placement score, LOWER is better
     (command_ec_common.go:1380 diskDistributionScore + :1441 pick):
     shards of THIS volume weigh 100 (anti-correlation — losing one
@@ -197,7 +295,7 @@ def _ec_placement_scores(env: CommandEnv, vid: int) -> dict[str, int]:
     weigh 10 (overall spread), free volume slots subtract (headroom
     attracts placements)."""
     from ..topology import iter_volume_list_ec_shards
-    vl = env.volume_list()
+    vl = vl if vl is not None else env.volume_list()
     scores: dict[str, int] = {}
     headroom: dict[str, int] = {}
     for dc in vl.get("dataCenters", {}).values():
@@ -309,14 +407,36 @@ def _balance_ec_volume(env: CommandEnv, vid: int, collection: str,
 def _move_shard(env: CommandEnv, vid: int, collection: str, sid: int,
                 source: str, dest: str) -> None:
     """command_ec_common.go:336 oneServerCopyAndMountEcShardsFromSource:
-    copy (+ecx/ecj/vif), mount on dest, delete+unmount on source."""
-    http_json("POST", f"{dest}/admin/ec/copy", {
-        "volumeId": vid, "collection": collection, "shardIds": [sid],
-        "sourceDataNode": source, "copyEcxFile": True,
-        "copyEcjFile": True, "copyVifFile": True})
-    http_json("POST", f"{dest}/admin/ec/mount",
-              {"volumeId": vid, "collection": collection,
-               "shardIds": [sid]})
+    copy (+ecx/ecj/vif), mount on dest, delete+unmount on source.
+
+    The copy legs are pipelined through `httpd.http_relay` (the shape
+    PR 2 gave `_copy_volume_files`): each file streams chunk-by-chunk
+    from source to dest with the push starting at the first downloaded
+    chunk, instead of the dest's download-then-upload
+    `/admin/ec/copy` staging pass.  The shard file and `.ecx` are
+    required; `.ecj`/`.vif` ride along when present (the journal
+    legitimately may not exist)."""
+    from ..server.httpd import http_relay
+    for ext in (to_ext(sid), ".ecx", ".ecj", ".vif"):
+        src_status, dst_status, body = http_relay(
+            f"{source}/admin/volume_file?volumeId={vid}"
+            f"&collection={collection}&ext={ext}",
+            "POST", f"{dest}/admin/receive_file?volumeId={vid}"
+            f"&collection={collection}&ext={ext}")
+        if src_status != 200:
+            if ext in (".ecj", ".vif"):
+                continue
+            raise RuntimeError(
+                f"move shard {vid}.{sid}: pull {ext} from {source}: "
+                f"{src_status}")
+        if dst_status != 200:
+            raise RuntimeError(
+                f"move shard {vid}.{sid}: push {ext} to {dest}: "
+                f"{dst_status} {body[:200]!r}")
+    _must(http_json("POST", f"{dest}/admin/ec/mount",
+                    {"volumeId": vid, "collection": collection,
+                     "shardIds": [sid]}),
+          f"mount shard {vid}.{sid} on {dest}")
     _delete_shards(source, vid, collection, [sid])
 
 
@@ -354,13 +474,18 @@ def cmd_ec_decode(env: CommandEnv, args: list[str]) -> str:
                 "copyVifFile": False})
             have.update(need)
     r = http_json("POST", f"{target}/admin/ec/to_volume",
-                  {"volumeId": vid, "collection": collection})
+                  {"volumeId": vid, "collection": collection},
+                  timeout=600.0)
     if "error" in r:
         raise RuntimeError(f"decode: {r['error']}")
-    # remove shards from all other servers
+    # remove shards from all other servers — AND the decode target's
+    # own shard files: stale `.ecNN` files left on its disks would be
+    # re-registered by the next encode's mount scan (duplicate shard
+    # locations) and mistaken for survivors by rebuild discovery
     for url, sids in shard_locs.items():
         if url != target:
             _delete_shards(url, vid, collection, sids)
+    _delete_shards(target, vid, collection, sorted(have))
     return f"volume {vid}: decoded to normal volume on {target}"
 
 

@@ -14,3 +14,5 @@ from .ec_decoder import (  # noqa: F401
     write_dat_file, write_idx_file_from_ec_index, find_dat_file_size,
     has_live_needles)
 from .ec_volume import EcVolume  # noqa: F401
+from .shard_sink import (  # noqa: F401
+    ShardSink, LocalShardSink, RemoteShardSink, ScatterStats)

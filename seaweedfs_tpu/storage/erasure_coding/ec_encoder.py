@@ -307,44 +307,69 @@ def _staged_run(work, read_item, compute, write_item) -> None:
         raise errors[0]
 
 
-def _generate_ec_files(base_file_name: str, ctx: ECContext) -> None:
-    """Staged encode: .dat batches -> GF parity -> 14 shard appends."""
+def _generate_ec_files(base_file_name: str, ctx: ECContext,
+                       sinks: "list | None" = None,
+                       stats=None) -> None:
+    """Staged encode: .dat batches -> GF parity -> d+p shard streams.
+
+    `sinks` (shard_sink.ShardSink, one per shard id) parameterizes the
+    write stage: None keeps the seed semantics (LocalShardSink per
+    `.ecNN` file on this node), the scatter path passes RemoteShardSink
+    streams to each shard's placement target.  Ownership transfers
+    either way: on success every sink is finish()ed (delivery
+    verified), on failure every sink is abort()ed (staged bytes
+    discarded — a failed encode leaves no partial shard for discovery
+    to mistake for a real one).  COMMIT remains the caller's step:
+    sidecars must land on the destinations before shards become
+    visible."""
+    from .shard_sink import LocalShardSink, ScatterStats
     dat_path = base_file_name + ".dat"
     dat_size = os.path.getsize(dat_path)
     codec = ctx.create_codec()
     d = ctx.data_shards
     work = _encode_work_items(dat_size, ctx)
-    outputs = [open(base_file_name + ctx.to_ext(i), "wb")
-               for i in range(ctx.total)]
+    own_sinks = sinks is None
+    if sinks is None:
+        sinks = [LocalShardSink(base_file_name + ctx.to_ext(i))
+                 for i in range(ctx.total)]
+    if stats is None:
+        stats = ScatterStats()
+    for s in sinks:
+        if hasattr(s, "set_stats"):
+            s.set_stats(stats)
     dat = open(dat_path, "rb")
 
     def read_item(item, buf):
         row_start, block_size, b0, batch, real_rows = item
         if buf is None or buf.shape != (d, batch):
             buf = np.empty((d, batch), dtype=np.uint8)
-        buf.fill(0)
+        # NO full-buffer memset (the same lesson the rebuild reader
+        # learned): only short/EOF read TAILS are zeroed — that is the
+        # reference's zero-padding (ec_encoder.go:258-262) and the
+        # only region whose stale recycled-buffer bytes could reach
+        # the output.  Rows padded past real_rows (device-shape
+        # padding) are left dirty on purpose: the GF apply is
+        # byte-column-independent and the writer truncates at `real`,
+        # so their content can never affect an emitted byte.
         if batch <= block_size:
             # chunk WITHIN one (large) row: gather the d strided
             # block slices at batch offset b0
             for i in range(d):
-                # short/EOF reads zero-pad (ec_encoder.go:258-262)
                 dat.seek(row_start + i * block_size + b0)
-                chunk = dat.read(batch)
-                if chunk:
-                    buf[i, :len(chunk)] = np.frombuffer(
-                        chunk, dtype=np.uint8)
+                got = dat.readinto(memoryview(buf[i])[:batch])
+                if got < batch:
+                    buf[i, got:] = 0
         else:
             # real_rows stacked small rows: one strictly sequential
-            # pass over the contiguous region; rows padded past
-            # real_rows stay zero and are dropped by the writer
+            # pass over the contiguous region
             dat.seek(row_start)
             for r in range(real_rows):
                 base = r * block_size
                 for i in range(d):
-                    chunk = dat.read(block_size)
-                    if chunk:
-                        buf[i, base:base + len(chunk)] = \
-                            np.frombuffer(chunk, dtype=np.uint8)
+                    got = dat.readinto(
+                        memoryview(buf[i])[base:base + block_size])
+                    if got < block_size:
+                        buf[i, base + got:base + block_size] = 0
         real = min(batch, real_rows * block_size)
         return (buf, real)
 
@@ -359,22 +384,59 @@ def _generate_ec_files(base_file_name: str, ctx: ECContext) -> None:
     def write_item(payload, parity):
         buf, real = payload
         for i in range(d):
-            outputs[i].write(buf[i, :real].data)
+            sinks[i].write(buf[i, :real].data)
         for j in range(ctx.total - d):
-            outputs[d + j].write(parity[j, :real].data)
+            sinks[d + j].write(parity[j, :real].data)
 
-    flusher = _OverlappedFlusher(outputs)
+    # stage spans (tracing.py): capture the caller's span context NOW
+    # — the reader/writer stages run on pipeline threads where the
+    # contextvar does not follow.  encode.read / encode.codec /
+    # encode.write windows OVERLAP by design (the triple buffer);
+    # per-destination encode.scatter.<sid> spans come from the remote
+    # sinks' send threads.
+    from ... import tracing
+    trace_ctx = tracing.current_ids()
+    read_item = _StageTimer(read_item)
+    compute = _StageTimer(compute)
+    write_item = _StageTimer(write_item)
+
+    flusher = _OverlappedFlusher(
+        [s.file for s in sinks if hasattr(s, "file")])
     ok = False
     try:
         _staged_run(work, read_item, compute, write_item)
+        for s in sinks:
+            s.end_stream()   # all tail chunks + receiver responses
+        for s in sinks:      # drain concurrently, then verify each
+            s.finish()
         ok = True
     finally:
         dat.close()
         try:
             flusher.stop(final=ok)
+        except Exception:
+            ok = False
+            raise
         finally:
-            for f in outputs:
-                f.close()
+            if not ok:
+                for s in sinks:
+                    try:
+                        s.abort()
+                    except OSError:
+                        pass
+            elif own_sinks:
+                # seed semantics: local files land in place now; the
+                # scatter caller commits AFTER pushing sidecars
+                for s in sinks:
+                    s.commit()
+            by_dest = stats.snapshot()[0]
+            read_item.emit("encode.read", trace_ctx,
+                           datBytes=dat_size, windows=len(work))
+            compute.emit("encode.codec", trace_ctx,
+                         dataShards=d, parityShards=ctx.total - d,
+                         backend=ctx.backend)
+            write_item.emit("encode.write", trace_ctx,
+                            bytesByDest=by_dest, aborted=not ok)
 
 
 # --- rebuild ------------------------------------------------------------

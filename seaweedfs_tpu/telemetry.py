@@ -29,6 +29,7 @@ VERSION = f"seaweedfs-tpu/{__version__}"
 
 _repair_lock = threading.Lock()
 _repair_totals = {"count": 0, "bytesFetched": 0}
+_scatter_totals = {"count": 0, "bytesScattered": 0}
 
 
 def note_ec_rebuild(bytes_fetched: int) -> None:
@@ -40,6 +41,20 @@ def note_ec_rebuild(bytes_fetched: int) -> None:
 def ec_rebuild_totals() -> dict:
     with _repair_lock:
         return dict(_repair_totals)
+
+
+def note_ec_scatter_encode(bytes_scattered: int) -> None:
+    """One scatter encode completed; `bytes_scattered` is shard bytes
+    that streamed to REMOTE placement targets (the bytes the seed path
+    would have written locally and then re-copied in balance)."""
+    with _repair_lock:
+        _scatter_totals["count"] += 1
+        _scatter_totals["bytesScattered"] += int(bytes_scattered)
+
+
+def ec_scatter_totals() -> dict:
+    with _repair_lock:
+        return dict(_scatter_totals)
 
 
 class TelemetryClient:
@@ -85,6 +100,9 @@ class TelemetryClient:
         rep = ec_rebuild_totals()
         data["ecRebuildCount"] = rep["count"]
         data["ecRebuildBytesFetched"] = rep["bytesFetched"]
+        sca = ec_scatter_totals()
+        data["ecScatterEncodeCount"] = sca["count"]
+        data["ecScatterBytes"] = sca["bytesScattered"]
         return data
 
     def send(self, master: str) -> bool:

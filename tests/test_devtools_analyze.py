@@ -348,6 +348,67 @@ def test_swfs007_noqa_suppresses():
     assert found == []
 
 
+def test_swfs008_flags_unclosed_sink_and_source():
+    found = check("""
+        from seaweedfs_tpu.storage.erasure_coding.shard_sink import (
+            RemoteShardSink)
+
+        def scatter(url):
+            sink = RemoteShardSink(url, 1, 0)
+            sink.write(b"x")
+
+        def probe(url):
+            RemoteShardSink(url, 1, 1).write(b"x")
+
+        def fetch(paths):
+            src = LocalShardSource(paths[0])
+            src.read_into(0, 10, bytearray(10))
+    """, "SWFS008")
+    assert len(found) == 3
+    msgs = " | ".join(f.message for f in found)
+    assert "'sink'" in msgs
+    assert "drops the stream" in msgs
+    assert "'src'" in msgs
+
+
+def test_swfs008_negative_with_close_escape():
+    found = check("""
+        def with_block(url):
+            with RemoteShardSink(url, 1, 0) as sink:
+                sink.write(b"x")
+
+        def close_in_finally(url):
+            sink = RemoteShardSink(url, 1, 0)
+            try:
+                sink.write(b"x")
+            finally:
+                sink.close()
+
+        def container(urls):
+            sinks = [RemoteShardSink(u, 1, i)
+                     for i, u in enumerate(urls)]
+            return sinks
+
+        def passed_on(consume, path):
+            src = LocalShardSource(path)
+            consume(src)
+
+        def fetcher_escapes(sources, work):
+            fetcher = MultiSourceFetcher(sources, work)
+            return fetcher
+    """, "SWFS008")
+    assert found == []
+
+
+def test_swfs008_noqa_suppresses():
+    found = check("""
+        def leak(url):
+            sink = RemoteShardSink(url, 1, 0)  # noqa: SWFS008
+            sink.write(b"x")
+    """, "SWFS008")
+    assert found == []
+
+
 def test_bare_noqa_suppresses_everything():
     src = """
     def f():

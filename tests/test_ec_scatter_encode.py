@@ -1,0 +1,282 @@
+"""Scatter-encode: `ec.encode` streams shard slices directly to their
+placement targets during the encode itself (one chunked
+`/admin/ec/shard_write` stream per shard), replacing
+encode-locally-then-balance.
+
+Tier-1 contract: over a 3-node cluster the scattered shards on their
+destinations are BIT-IDENTICAL to a seed local encode of the same
+volume, every shard is mounted at its final destination with sidecars
+present, and a destination dying mid-stream aborts the encode cleanly
+— no partial stripe mounted anywhere, the source volume restored to
+read-write, the data still served.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.server.httpd import (HttpServer, http_bytes,
+                                        http_json)
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.shell import commands as shell_commands
+from seaweedfs_tpu.storage.erasure_coding import ec_encoder
+from seaweedfs_tpu.storage.erasure_coding.ec_context import ECContext, \
+    to_ext
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64).start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"v{i}"
+        d.mkdir()
+        servers.append(VolumeServer([str(d)], master.url,
+                                    pulse_seconds=0.3).start())
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if len(http_json("GET", f"{master.url}/cluster/status")
+               ["dataNodes"]) == 3:
+            break
+        time.sleep(0.05)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _pull_file(url: str, vid: int, ext: str) -> bytes:
+    status, body, _ = http_bytes(
+        "GET", f"{url}/admin/volume_file?volumeId={vid}"
+        f"&collection=&ext={ext}", timeout=60)
+    assert status == 200, (url, ext, status)
+    return body
+
+
+def _shard_map(master_url: str, vid: int) -> "dict[str, list[int]]":
+    r = http_json("GET",
+                  f"{master_url}/dir/ec_lookup?volumeId={vid}")
+    return {l["url"]: l["shardIds"]
+            for l in r.get("shardIdLocations", [])}
+
+
+def _fill_one_volume(master, n=15, seed=4):
+    rng = np.random.default_rng(seed)
+    blobs = {}
+    for i in range(n):
+        data = rng.integers(0, 256, int(rng.integers(500, 20000)),
+                            dtype=np.uint8).tobytes()
+        blobs[operation.submit(master.url, data)] = data
+    vids = {int(fid.split(",")[0]) for fid in blobs}
+    assert len(vids) == 1
+    return vids.pop(), blobs
+
+
+def test_scatter_encode_byte_identity_and_placement(cluster3,
+                                                    tmp_path):
+    master, servers = cluster3
+    vid, blobs = _fill_one_volume(master)
+    env = CommandEnv(master.url)
+    run_command(env, "lock")
+
+    # golden: the source volume's .dat/.idx BEFORE encode, run through
+    # the seed local pipeline in a scratch dir
+    source = env.volume_locations(vid)[0]["url"]
+    scratch = tmp_path / "golden"
+    scratch.mkdir()
+    base = str(scratch / str(vid))
+    # freeze so the pulled .dat is the same bytes encode will see
+    http_json("POST", f"{source}/admin/set_readonly",
+              {"volumeId": vid, "readOnly": True})
+    for ext in (".dat", ".idx"):
+        with open(base + ext, "wb") as f:
+            f.write(_pull_file(source, vid, ext))
+    http_json("POST", f"{source}/admin/set_readonly",
+              {"volumeId": vid, "readOnly": False})
+    ctx = ECContext(backend="cpu")
+    ec_encoder.write_sorted_file_from_idx(base)
+    ec_encoder.write_ec_files(base, ctx)
+    golden = {}
+    for sid in range(ctx.total):
+        with open(base + to_ext(sid), "rb") as f:
+            golden[sid] = f.read()
+    with open(base + ".ecx", "rb") as f:
+        golden_ecx = f.read()
+
+    out = run_command(env, f"ec.encode -volumeId={vid}")
+    assert "scatter-encoded" in out and "scattered" in out, out
+    time.sleep(0.5)
+
+    # every shard mounted at a final destination, spread evenly
+    by_url = _shard_map(master.url, vid)
+    placed = sorted(s for sids in by_url.values() for s in sids)
+    assert placed == list(range(14)), by_url
+    assert len(by_url) == 3, by_url
+    assert max(len(s) for s in by_url.values()) <= 5  # ceil(14/3)
+
+    # byte identity: each destination's shard == the seed local encode
+    for url, sids in by_url.items():
+        for sid in sids:
+            got = _pull_file(url, vid, to_ext(sid))
+            assert got == golden[sid], \
+                f"shard {sid} on {url} differs from local encode"
+        # sidecars landed with the shards
+        assert _pull_file(url, vid, ".ecx") == golden_ecx, url
+        assert _pull_file(url, vid, ".vif"), url
+
+    # originals deleted, reads still served (EC path)
+    for fid, want in list(blobs.items())[:5]:
+        assert operation.read(master.url, fid) == want
+
+    # the write-amplification claim is observable on /metrics
+    status, metrics, _ = http_bytes(
+        "GET", f"{source}/metrics")
+    assert status == 200
+    text = metrics.decode()
+    assert "ec_encode_bytes_scattered_total" in text, text
+    assert "ec_encode_local_write_bytes_total" in text
+    scattered = sum(
+        float(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+        if line.startswith(
+            "volume_server_ec_encode_bytes_scattered_total"))
+    # ~12 of 14 shards left the source (2 stay local on a 3-node even
+    # spread; exact split depends on which node was the source)
+    shard_size = len(golden[0])
+    assert scattered >= 8 * shard_size, (scattered, shard_size)
+    # no staged temp files survive a successful scatter anywhere
+    for vs in servers:
+        d = vs.store.locations[0].directory
+        assert not [p for p in os.listdir(d) if ".scatter." in p]
+
+    # --- phase 2: the admin/worker path drives the same scatter flow
+    # off the shell (EcEncodeHandler encode_mode="scatter")
+    run_command(env, "unlock")
+    vid2, blobs2 = _fill_one_volume(master, n=8, seed=9)
+
+    class FakeWorker:
+        def __init__(self, master_url):
+            self.master = master_url
+            self.progress = []
+
+        def report_progress(self, job_id, frac, msg):
+            self.progress.append((frac, msg))
+
+    from seaweedfs_tpu.plugin.handlers import EcEncodeHandler
+    h = EcEncodeHandler(encode_mode="scatter")
+    msg = h.execute(FakeWorker(master.url), "job-1",
+                    {"volumeId": vid2})
+    assert "scatter-encoded" in msg, msg
+    time.sleep(0.5)
+    by_url2 = _shard_map(master.url, vid2)
+    assert sorted(s for sids in by_url2.values() for s in sids) == \
+        list(range(14))
+    for fid, want in list(blobs2.items())[:3]:
+        assert operation.read(master.url, fid) == want
+
+
+def test_scatter_dest_death_aborts_cleanly(cluster3, tmp_path,
+                                           monkeypatch):
+    """A destination dying MID-STREAM (accepts the shard_write, reads
+    part of the body, then fails) must abort the whole encode: error
+    surfaced, no shard mounted anywhere, no staged temps left, the
+    source volume back in read-write and still serving."""
+    master, servers = cluster3
+    vid, blobs = _fill_one_volume(master, seed=7)
+    env = CommandEnv(master.url)
+    run_command(env, "lock")
+
+    # a fake volume server whose shard_write dies after the first
+    # window — deterministic "destination killed mid-scatter"
+    dying = HttpServer()
+    seen = {"bytes": 0}
+
+    def die_mid_stream(req):
+        for chunk in req.stream_body():
+            seen["bytes"] += len(chunk)
+            raise IOError("destination killed mid-scatter")
+        return 200, {}
+
+    dying.route("POST", "/admin/ec/shard_write", die_mid_stream)
+    dying.start()
+
+    real_plan = shell_commands._plan_ec_placement
+
+    def sabotaged_plan(env, vid_, total):
+        placement = real_plan(env, vid_, total)
+        placement[13] = dying.url  # one shard routed to the dying dest
+        return placement
+
+    monkeypatch.setattr(shell_commands, "_plan_ec_placement",
+                        sabotaged_plan)
+    with pytest.raises(RuntimeError, match="scatter"):
+        run_command(env, f"ec.encode -volumeId={vid}")
+    dying.stop()
+    assert seen["bytes"] > 0, "destination never saw stream bytes"
+    time.sleep(0.5)
+
+    # no partial stripe: nothing mounted, anywhere
+    assert _shard_map(master.url, vid) == {}
+    for vs in servers:
+        r = http_json("GET",
+                      f"{vs.http.url}/admin/ec/info?volumeId={vid}")
+        assert "error" in r, r
+        # and no committed shard files or staged temps on disk
+        d = vs.store.locations[0].directory
+        leftovers = [p for p in os.listdir(d)
+                     if ".ec" in p or ".scatter." in p]
+        assert not leftovers, (vs.http.url, leftovers)
+
+    # the source volume is back in READ-WRITE and still the live copy
+    vl = http_json("GET", f"{master.url}/vol/list")
+    vols = [v for _dc in vl.get("dataCenters", {}).values()
+            for _r in _dc.get("racks", {}).values()
+            for n in _r.get("nodes", [])
+            for v in n.get("volumes", []) if v["id"] == vid]
+    assert vols and all(not v.get("readOnly") for v in vols), vols
+    for fid, want in list(blobs.items())[:3]:
+        assert operation.read(master.url, fid) == want
+    # and a NEW write to the volume's server succeeds (truly writable)
+    fid = operation.submit(master.url, b"post-abort write")
+    assert operation.read(master.url, fid) == b"post-abort write"
+
+
+def test_generate_failure_restores_read_write(cluster3):
+    """Satellite: a failed generate (any mode) must roll the readonly
+    marking back — the seed stranded the volume readonly forever."""
+    master, servers = cluster3
+    vid, _blobs = _fill_one_volume(master, n=5, seed=3)
+    env = CommandEnv(master.url)
+    run_command(env, "lock")
+    # an impossible scheme the server will reject at generate time
+    with pytest.raises(RuntimeError):
+        run_command(env, f"ec.encode -volumeId={vid} -mode=local "
+                         f"-dataShards=40 -parityShards=4")
+    vl = http_json("GET", f"{master.url}/vol/list")
+    vols = [v for _dc in vl.get("dataCenters", {}).values()
+            for _r in _dc.get("racks", {}).values()
+            for n in _r.get("nodes", [])
+            for v in n.get("volumes", []) if v["id"] == vid]
+    assert vols and all(not v.get("readOnly") for v in vols), vols
+
+
+def test_local_mode_keeps_seed_semantics(cluster3):
+    """`-mode=local` still produces the full generate->mount->balance
+    flow (the A/B baseline), ending in the same durable state."""
+    master, servers = cluster3
+    vid, blobs = _fill_one_volume(master, n=8, seed=5)
+    env = CommandEnv(master.url)
+    run_command(env, "lock")
+    out = run_command(env, f"ec.encode -volumeId={vid} -mode=local")
+    assert "encoded 14 shards" in out and "moved" in out, out
+    time.sleep(0.5)
+    by_url = _shard_map(master.url, vid)
+    assert sorted(s for sids in by_url.values() for s in sids) == \
+        list(range(14))
+    assert len(by_url) >= 2  # balance spread them off the source
+    for fid, want in list(blobs.items())[:3]:
+        assert operation.read(master.url, fid) == want
