@@ -618,6 +618,51 @@ class UnclosedShardStreamRule(Rule):
         return None                # escapes into a call/container
 
 
+class MissingTimeoutRule(Rule):
+    """SWFS009: a network call site without an explicit timeout.
+
+    Every helper in the client funnel (`http_json`, `http_bytes`,
+    `http_download`, `http_upload`, `http_relay`,
+    `http_stream_request`, `master_json`) *has* a default timeout, but
+    a call site that relies on it is making an invisible latency
+    decision: the 30s/600s defaults are tuned for bulk data moves, and
+    a control-plane call that inherits them holds locks, worker slots,
+    or retry budget for that long when a peer wedges.  The chaos
+    suite's delay failpoints turn exactly this into test failures.
+    Fix: pass `timeout=` explicitly (what should THIS call tolerate?),
+    or `# noqa: SWFS009` / baseline a call site whose default is a
+    considered choice."""
+
+    id = "SWFS009"
+    severity = "error"
+    title = "network call without an explicit timeout"
+
+    # zero-based positional index of each helper's `timeout` param —
+    # a call passing it positionally is explicit too
+    _FUNCS = {"http_json": 3, "http_bytes": 4, "http_download": 3,
+              "http_upload": 4, "http_relay": 4,
+              "http_stream_request": 4, "master_json": 4}
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func).rsplit(".", 1)[-1]
+            if name not in self._FUNCS:
+                continue
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            if any(kw.arg is None for kw in node.keywords):
+                continue    # **kwargs may carry a timeout
+            if len(node.args) > self._FUNCS[name]:
+                continue    # timeout passed positionally
+            yield self.finding(
+                ctx, node,
+                f"{name}(...) without an explicit timeout= — the "
+                f"helper default is a bulk-transfer latency budget, "
+                f"not a considered choice for this call site")
+
+
 RULES = [
     LockDisciplineRule(),
     JitBlockingRule(),
@@ -627,4 +672,5 @@ RULES = [
     WallClockRule(),
     LeakedSpanRule(),
     UnclosedShardStreamRule(),
+    MissingTimeoutRule(),
 ]

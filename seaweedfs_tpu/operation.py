@@ -109,7 +109,7 @@ def assign(master: str, count: int = 1, collection: str = "",
         qs += f"&replication={replication}"
     if ttl:
         qs += f"&ttl={ttl}"
-    r = master_json(master, "GET", f"/dir/assign?{qs}")
+    r = master_json(master, "GET", f"/dir/assign?{qs}", timeout=30)
     if "error" in r:
         raise RuntimeError(f"assign: {r['error']}")
     return Assignment(r["fid"], r["url"], r.get("publicUrl", r["url"]),
@@ -133,7 +133,8 @@ def upload(url: str, fid: str, data: bytes, name: str = "",
         auth = security.current().write_jwt(fid)
     if auth:
         headers["Authorization"] = f"Bearer {auth}"
-    status, body, _ = http_bytes("POST", f"{url}/{fid}{qs}", data, headers)
+    status, body, _ = http_bytes("POST", f"{url}/{fid}{qs}", data, headers,
+                          timeout=60)
     if status >= 300:
         raise UploadError(f"upload {fid} -> {status}: {body[:200]!r}",
                           status)
@@ -214,7 +215,7 @@ def lookup(master: str, vid: int, use_cache: bool = True) -> list[dict]:
         cached = _vid_cache.get(master, vid)
         if cached is not None:
             return cached
-    r = master_json(master, "GET", f"/dir/lookup?volumeId={vid}")
+    r = master_json(master, "GET", f"/dir/lookup?volumeId={vid}", timeout=30)
     if "error" in r:
         raise LookupError(r["error"])
     _vid_cache.put(master, vid, r["locations"])
@@ -343,7 +344,7 @@ def read(master: str, fid: str, offset: int = 0,
         for loc in locs:
             try:
                 status, body, _ = http_bytes(
-                    "GET", f"{loc['url']}/{fid}", None, headers)
+                    "GET", f"{loc['url']}/{fid}", None, headers, timeout=60)
             except OSError as e:
                 last_err = f"{loc['url']} -> {e}"
                 continue
@@ -379,7 +380,7 @@ def delete(master: str, fid: str) -> None:
     for loc in locs:
         try:
             status, body, _ = http_bytes("DELETE", f"{loc['url']}/{fid}",
-                                         headers=headers)
+                                         headers=headers, timeout=60)
         except OSError as e:
             last = f"{loc['url']}: {e}"
             continue

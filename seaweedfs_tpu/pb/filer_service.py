@@ -438,5 +438,7 @@ def start_filer_grpc(filer_server, host: str = "127.0.0.1",
     return serve([handler], host, port)
 
 
-def filer_stub(channel) -> Stub:
-    return Stub(channel, SERVICE, METHODS)
+def filer_stub(channel, peer: str = "") -> Stub:
+    """`peer` (the dialed host:port) opts every call into that
+    peer's circuit breaker (util/retry)."""
+    return Stub(channel, SERVICE, METHODS, peer=peer)

@@ -232,5 +232,7 @@ def start_master_grpc(master, host: str = "127.0.0.1", port: int = 0):
     return serve([handler], host, port)
 
 
-def master_stub(channel) -> Stub:
-    return Stub(channel, SERVICE, METHODS)
+def master_stub(channel, peer: str = "") -> Stub:
+    """`peer` (the dialed host:port) opts every call into that
+    peer's circuit breaker (util/retry)."""
+    return Stub(channel, SERVICE, METHODS, peer=peer)

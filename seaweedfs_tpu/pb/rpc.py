@@ -15,6 +15,9 @@ from concurrent import futures
 
 import grpc
 
+from .. import faults as _faults
+from ..util import retry as _uretry
+
 _KIND_TO_HANDLER = {
     "uu": grpc.unary_unary_rpc_method_handler,
     "us": grpc.unary_stream_rpc_method_handler,
@@ -106,13 +109,42 @@ def serve(handlers, host: str = "127.0.0.1", port: int = 0,
     return server, bound
 
 
-def _with_trace_metadata(multicallable):
+class StubFaultInjected(_faults.FaultInjected, grpc.RpcError):
+    """An armed `rpc.stub.call` fault.  Subclasses BOTH the
+    robustness plane's OSError (transport-failure handlers: retry,
+    failover, unwind) and grpc.RpcError — every gRPC call site's
+    `except grpc.RpcError` keeps working when the failure is injected
+    instead of coming off the wire."""
+
+
+class StubBreakerOpen(_uretry.BreakerOpen, grpc.RpcError):
+    """Fail-fast breaker refusal on the stub plane; same dual typing
+    as StubFaultInjected, and still a BreakerOpen so re-planning
+    callers catch it specifically."""
+
+
+def _with_trace_metadata(multicallable, peer: str = ""):
     """Attach the active request id + trace parent as invocation
     metadata on every call (the gRPC twin of _pooled_request's header
-    forwarding) — explicit caller metadata still wins."""
+    forwarding) — explicit caller metadata still wins.  When the stub
+    was built with a `peer` address, every call also consults that
+    peer's circuit breaker (util/retry) and feeds transport verdicts
+    back: UNAVAILABLE / DEADLINE_EXCEEDED count as peer failures,
+    anything else (including application-level aborts) proves the
+    peer alive.  Response-streaming calls record only call setup —
+    mid-stream deaths surface on iteration, outside this wrapper."""
     def call(request, **kwargs):
         from .. import tracing
         from ..util.request_id import get_request_id
+        try:
+            _faults.fire("rpc.stub.call", key=peer)
+        except _faults.FaultInjected as e:
+            raise StubFaultInjected(str(e)) from None
+        if peer:
+            try:
+                _uretry.check_peer(peer)
+            except _uretry.BreakerOpen as e:
+                raise StubBreakerOpen(e.peer, e.retry_after) from None
         md = list(kwargs.pop("metadata", ()) or ())
         have = {k.lower() for k, _ in md}
         rid = get_request_id()
@@ -123,7 +155,33 @@ def _with_trace_metadata(multicallable):
             md.append((tracing.GRPC_METADATA_KEY, tp))
         if md:
             kwargs["metadata"] = md
-        return multicallable(request, **kwargs)
+        try:
+            result = multicallable(request, **kwargs)
+        except grpc.RpcError as e:
+            if peer:
+                code = None
+                if hasattr(e, "code"):
+                    try:
+                        code = e.code()
+                    except Exception:  # noqa: BLE001 — peer verdict
+                        # only; the RpcError itself still propagates
+                        code = None
+                if code in (grpc.StatusCode.UNAVAILABLE,
+                            grpc.StatusCode.DEADLINE_EXCEEDED):
+                    _uretry.record_failure(peer, repr(e))
+                else:
+                    _uretry.record_success(peer)
+            raise
+        except BaseException:
+            # non-RpcError failure (channel closed ValueError,
+            # serialization TypeError): no peer verdict, but return a
+            # held half-open probe slot so the breaker can't wedge
+            if peer:
+                _uretry.probe_release(peer)
+            raise
+        if peer:
+            _uretry.record_success(peer)
+        return result
     return call
 
 
@@ -131,10 +189,13 @@ class Stub:
     """Client stub over one service: attribute access returns the bound
     callable for a method (multi-callable with the right serializers),
     mirroring what a generated *_pb2_grpc Stub exposes.  Every call
-    carries the active request id + trace parent as metadata."""
+    carries the active request id + trace parent as metadata; pass
+    `peer` (the dialed host:port) to route calls through that peer's
+    circuit breaker — the gRPC plane then shares the HTTP funnel's
+    health map instead of independently hammering a dead server."""
 
     def __init__(self, channel: grpc.Channel, service_name: str,
-                 methods: dict):
+                 methods: dict, peer: str = ""):
         self._factories = {
             "uu": channel.unary_unary, "us": channel.unary_stream,
             "su": channel.stream_unary, "ss": channel.stream_stream}
@@ -143,7 +204,8 @@ class Stub:
                 self._factories[kind](
                     f"/{service_name}/{name}",
                     request_serializer=req_cls.SerializeToString,
-                    response_deserializer=resp_cls.FromString)))
+                    response_deserializer=resp_cls.FromString),
+                peer=peer))
 
 
 class LocalRequest:

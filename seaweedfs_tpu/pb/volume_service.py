@@ -297,8 +297,10 @@ def start_volume_grpc(vs, host: str = "127.0.0.1", port: int = 0):
     return serve([handler], host, port)
 
 
-def volume_stub(channel) -> Stub:
-    return Stub(channel, SERVICE, METHODS)
+def volume_stub(channel, peer: str = "") -> Stub:
+    """`peer` (the dialed host:port) opts every call into that
+    peer's circuit breaker (util/retry)."""
+    return Stub(channel, SERVICE, METHODS, peer=peer)
 
 
 def send_file(stub: Stub, path: str, volume_id: int, ext: str,

@@ -80,7 +80,7 @@ class EcEncodeHandler(JobHandler):
     # -- Detect (:187) ------------------------------------------------
 
     def detect(self, worker) -> list[dict]:
-        vl = master_json(worker.master, "GET", "/vol/list")
+        vl = master_json(worker.master, "GET", "/vol/list", timeout=30)
         size_limit = self._volume_size_limit(worker)
         proposals = []
         seen = set()
@@ -107,7 +107,7 @@ class EcEncodeHandler(JobHandler):
         return proposals
 
     def _volume_size_limit(self, worker) -> int:
-        r = master_json(worker.master, "GET", "/cluster/status")
+        r = master_json(worker.master, "GET", "/cluster/status", timeout=30)
         return int(r.get("volumeSizeLimit", 1 << 30))
 
     # -- Execute (ec_task.go:59) ---------------------------------------
@@ -125,7 +125,7 @@ class EcEncodeHandler(JobHandler):
     def _lookup_urls(self, worker, vid: int) -> list[str]:
         locations = master_json(worker.master, "GET",
                                 f"/dir/lookup?volumeId={vid}"
-                                ).get("locations", [])
+                                , timeout=30).get("locations", [])
         if not locations:
             raise RuntimeError(f"volume {vid} has no locations")
         return [l["url"] for l in locations]
@@ -134,7 +134,7 @@ class EcEncodeHandler(JobHandler):
         # (:261)
         for url in urls:
             _must(http_json("POST", f"{url}/admin/set_readonly",
-                            {"volumeId": vid, "readOnly": True}),
+                            {"volumeId": vid, "readOnly": True}, timeout=30),
                   f"set readonly on {url}")
 
     def _pull_volume(self, worker, vid: int, collection: str,
@@ -148,7 +148,7 @@ class EcEncodeHandler(JobHandler):
         for ext in (".dat", ".idx"):
             status, _hdrs = http_download(
                 f"{source}/admin/volume_file?volumeId={vid}"
-                f"&collection={collection}&ext={ext}", base + ext)
+                f"&collection={collection}&ext={ext}", base + ext, timeout=600)
             if status != 200:
                 raise RuntimeError(
                     f"copy {ext} from {source}: {status}")
@@ -161,7 +161,7 @@ class EcEncodeHandler(JobHandler):
         writability so the volume is not stranded readonly."""
         try:
             targets = master_json(worker.master, "GET",
-                                  "/cluster/status")["dataNodes"]
+                                  "/cluster/status", timeout=30)["dataNodes"]
         except (OSError, KeyError):
             targets = []
         for vid, urls in vol_urls.items():
@@ -171,13 +171,13 @@ class EcEncodeHandler(JobHandler):
                               f"{target}/admin/ec/delete_shards",
                               {"volumeId": vid,
                                "collection": collection,
-                               "shardIds": list(range(ctx.total))})
+                               "shardIds": list(range(ctx.total))}, timeout=30)
                 except OSError:
                     pass
             for url in urls:
                 try:
                     http_json("POST", f"{url}/admin/set_readonly",
-                              {"volumeId": vid, "readOnly": False})
+                              {"volumeId": vid, "readOnly": False}, timeout=30)
                 except OSError:
                     pass
 
@@ -194,7 +194,7 @@ class EcEncodeHandler(JobHandler):
         # (:547) — only after every shard is safely mounted
         for url in urls:
             _must(http_json("POST", f"{url}/admin/delete_volume",
-                            {"volumeId": vid}),
+                            {"volumeId": vid}, timeout=30),
                   f"delete original on {url}")
 
     def execute(self, worker, job_id: str, params: dict) -> str:
@@ -295,7 +295,7 @@ class EcEncodeHandler(JobHandler):
         """Round-robin shard spread over alive servers (:532) + mount
         (shard_distribution.go:209)."""
         targets = master_json(worker.master, "GET",
-                              "/cluster/status")["dataNodes"]
+                              "/cluster/status", timeout=30)["dataNodes"]
         if not targets:
             raise RuntimeError("no alive volume servers")
         placement: dict[str, list[int]] = {t: [] for t in targets}
@@ -314,7 +314,7 @@ class EcEncodeHandler(JobHandler):
                 _must(http_json("POST", f"{target}/admin/ec/mount",
                                 {"volumeId": vid,
                                  "collection": collection,
-                                 "shardIds": sids}),
+                                 "shardIds": sids}, timeout=30),
                       f"mount shards on {target}")
         return placement
 
@@ -411,7 +411,7 @@ class EcRebuildHandler(JobHandler):
         from ...storage.erasure_coding.ec_context import (
             TOTAL_SHARDS_COUNT)
         from ...topology import iter_volume_list_ec_shards
-        vl = master_json(worker.master, "GET", "/vol/list")
+        vl = master_json(worker.master, "GET", "/vol/list", timeout=30)
         per_vid: dict[int, set] = {}
         holders: dict[int, str] = {}
         for node, e in iter_volume_list_ec_shards(vl):
@@ -428,7 +428,8 @@ class EcRebuildHandler(JobHandler):
                 continue
             # a gap OR a non-default scheme: one info probe decides
             r = http_json(
-                "GET", f"{holders[vid]}/admin/ec/info?volumeId={vid}")
+                "GET", f"{holders[vid]}/admin/ec/info?volumeId={vid}",
+                    timeout=30)
             if "error" in r:
                 continue
             total = r["dataShards"] + r["parityShards"]
@@ -454,7 +455,8 @@ class EcRebuildHandler(JobHandler):
         # fall back to a default 10+4 for a custom-scheme volume
         info = None
         for url in locs:
-            r = http_json("GET", f"{url}/admin/ec/info?volumeId={vid}")
+            r = http_json("GET", f"{url}/admin/ec/info?volumeId={vid}",
+                    timeout=30)
             if "error" not in r:
                 info = r
                 break
@@ -477,7 +479,7 @@ class EcRebuildHandler(JobHandler):
         if rebuilt:
             _must(http_json("POST", f"{rebuilder}/admin/ec/mount",
                             {"volumeId": vid, "collection": collection,
-                             "shardIds": rebuilt}),
+                             "shardIds": rebuilt}, timeout=30),
                   f"mount rebuilt shards on {rebuilder}")
         worker.report_progress(job_id, 0.7, f"rebuilt {rebuilt}")
         # re-spread like the shell flow: leaving every rebuilt shard
@@ -513,7 +515,7 @@ def _push_file(target: str, vid: int, collection: str, ext: str,
     bounded memory (shard_distribution.go:101 target side)."""
     status, body, _ = http_upload(
         "POST", f"{target}/admin/receive_file?volumeId={vid}"
-        f"&collection={collection}&ext={ext}", path)
+        f"&collection={collection}&ext={ext}", path, timeout=600)
     if status != 200:
         raise RuntimeError(f"push {ext} to {target}: {status} "
                            f"{body[:200]!r}")

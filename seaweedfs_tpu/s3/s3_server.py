@@ -130,9 +130,11 @@ class S3ApiServer:
         self.metrics_http = None
         if metrics_port is not None:
             self.metrics_http = HttpServer(host, metrics_port)
+            from ..stats import render_process
             self.metrics_http.route(
                 "GET", "/metrics",
-                lambda req: (200, (self.metrics.render().encode(),
+                lambda req: (200, ((self.metrics.render() +
+                                    render_process()).encode(),
                                    "text/plain; version=0.0.4")))
 
     def _path_lock(self, path: str) -> "threading.Lock":

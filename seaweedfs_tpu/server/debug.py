@@ -16,6 +16,13 @@ httpd.is_admin_path):
       most recent spans (?limit=N, default 200).  The shell's
       `trace.show` fans this endpoint out across the cluster and
       merges the results into one tree.
+  GET/POST /debug/faults — the failpoint plane (faults.py): GET lists
+      armed sites + trigger counts; POST arms ({"spec": "..."} or the
+      explicit {"site","action",...} form) or clears ({"clear": true
+      or "site"}).  The chaos suite's runtime lever on every role.
+  GET /debug/health — this process's per-peer circuit-breaker map and
+      retry budget (util/retry); `trace.show` appends it so a chaos
+      run is debuggable from the shell.
 """
 
 from __future__ import annotations
@@ -35,6 +42,47 @@ def install_debug_routes(http: HttpServer) -> None:
     http.route("GET", "/debug/vars", _vars)
     http.route("GET", "/debug/profile", _profile)
     http.route("GET", "/debug/traces", _traces)
+    http.route("GET", "/debug/faults", _faults_get)
+    http.route("POST", "/debug/faults", _faults_post)
+    http.route("GET", "/debug/health", _health)
+
+
+def _faults_get(req: Request):
+    from .. import faults
+    return 200, {"armed": faults.armed(),
+                 "triggered": faults.triggered()}
+
+
+def _faults_post(req: Request):
+    from .. import faults
+    b = req.json()
+    clear = b.get("clear")
+    if clear:
+        faults.disarm(None if clear is True else str(clear))
+        return 200, {"armed": faults.armed()}
+    try:
+        if "spec" in b:
+            n = faults.arm_spec(str(b["spec"]))
+        elif "site" in b:
+            faults.arm(
+                str(b["site"]), str(b.get("action", "error")),
+                p=float(b.get("p", 1.0)),
+                n=None if b.get("n") is None else int(b["n"]),
+                ms=float(b.get("ms", 0.0)),
+                seed=None if b.get("seed") is None else int(b["seed"]),
+                match=str(b.get("match", "")))
+            n = 1
+        else:
+            return 400, {"error": "body needs spec/site/clear"}
+    except ValueError as e:
+        return 400, {"error": str(e)}
+    return 200, {"armedCount": n, "armed": faults.armed()}
+
+
+def _health(req: Request):
+    from ..util import retry
+    return 200, {"peers": retry.health_snapshot(),
+                 "retryBudgetRemaining": retry.budget_remaining()}
 
 
 def _traces(req: Request):

@@ -25,8 +25,8 @@ def cluster_statistics(master: str, collection: str = "") -> dict:
     HTTP route, the gRPC servicer, and the mount's quota poll.
     Raises OSError when the master is unreachable."""
     from .httpd import http_json
-    vl = http_json("GET", f"{master}/dir/status")
-    cs = http_json("GET", f"{master}/cluster/status")
+    vl = http_json("GET", f"{master}/dir/status", timeout=30)
+    cs = http_json("GET", f"{master}/cluster/status", timeout=30)
     used = files = max_count = 0
     for dc in vl.get("dataCenters", {}).values():
         for rack in dc.get("racks", {}).values():
@@ -227,7 +227,9 @@ class FilerServer:
         self.metrics.gauge_set(
             "locks_held", float(len(self.lock_manager.all_locks())),
             help_text="distributed locks currently held here")
-        return 200, (self.metrics.render().encode(),
+        from ..stats import render_process
+        return 200, ((self.metrics.render() +
+                      render_process()).encode(),
                      "text/plain; version=0.0.4")
 
     def start(self):
@@ -329,6 +331,11 @@ class FilerServer:
         mime = req.headers.get("Content-Type", "")
         if mime == "application/x-www-form-urlencoded":
             mime = ""
+        from .. import faults
+        # armed `filer.entry.put` faults fail the write BEFORE any
+        # chunk is assigned — the caller's retry policy (not a
+        # half-written entry) owns recovery
+        faults.fire("filer.entry.put", key=path)
         entry = self.filer.write_file(path, req.body, mime=mime)
         return 201, {"name": entry.name, "size": entry.total_size()}
 

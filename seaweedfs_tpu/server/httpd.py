@@ -559,6 +559,19 @@ def parse_range(header: str, total: int
     return None
 
 
+class _RelaySourceError(OSError):
+    """http_relay: the SOURCE leg died (real or injected) — the
+    destination never answered, so no verdict probe is possible."""
+
+
+def _fire_fault(site: str, key: str = "") -> "str | None":
+    """faults.py hook for the client funnel (late import: httpd is on
+    every role's startup path).  Returns the directive for
+    truncate/drop arms; raises FaultInjected for error arms."""
+    from .. import faults
+    return faults.fire(site, key=key)
+
+
 def http_download(url: str, dest_path: str,
                   headers: dict | None = None, timeout: float = 600.0,
                   chunk_size: int = 4 << 20) -> tuple[int, dict]:
@@ -583,6 +596,14 @@ def http_download(url: str, dest_path: str,
                                     context=ctx) as resp:
             with open(tmp, "wb") as f:
                 while True:
+                    if _fire_fault("httpd.download.chunk",
+                                   key=full_url) is not None:
+                        # truncate/drop both mean "the source died
+                        # mid-body": surface it, never os.replace a
+                        # short file into place
+                        raise IOError(
+                            f"download {url}: fault-injected "
+                            f"mid-body failure")
                     chunk = resp.read(chunk_size)
                     if not chunk:
                         break
@@ -638,9 +659,36 @@ def http_relay(src_url: str, dst_method: str, dst_url: str,
         expected = resp.length  # None when the source streams chunked
 
         def chunks():
+            # every SOURCE-side failure (real or fault-injected)
+            # raises _RelaySourceError: the destination is then still
+            # waiting for chunks, so the caller must NOT probe it for
+            # a verdict — only send-socket failures mean the
+            # destination spoke first
             sent = 0
             while True:
-                chunk = resp.read(chunk_size)
+                try:
+                    directive = _fire_fault("httpd.relay.chunk",
+                                            key=full_dst)
+                except OSError as e:  # armed `error`: source died
+                    raise _RelaySourceError(str(e)) from None
+                if directive == "truncate":
+                    # simulated source death: raising (not returning)
+                    # keeps the no-truncated-but-clean-upload rule —
+                    # the aborted chunked stream errors on the dest
+                    raise _RelaySourceError(
+                        f"relay {src_url}: fault-injected "
+                        f"truncation at {sent} bytes")
+                if directive == "drop":
+                    resp.close()
+                    raise _RelaySourceError(
+                        f"relay {src_url}: fault-injected "
+                        f"connection drop at {sent} bytes")
+                try:
+                    chunk = resp.read(chunk_size)
+                except OSError as e:
+                    raise _RelaySourceError(
+                        f"relay source {src_url} died at {sent} "
+                        f"bytes: {e}") from None
                 if not chunk:
                     if expected is not None and sent != expected:
                         # a source dying mid-body reads as plain EOF
@@ -648,7 +696,7 @@ def http_relay(src_url: str, dst_method: str, dst_url: str,
                         # instead of finalizing a truncated upload as
                         # success; the aborted chunked stream also
                         # errors on the destination
-                        raise OSError(
+                        raise _RelaySourceError(
                             f"relay source truncated at {sent} of "
                             f"{expected} bytes")
                     return
@@ -656,8 +704,23 @@ def http_relay(src_url: str, dst_method: str, dst_url: str,
                 yield chunk
 
         try:
-            conn.request(dst_method, target, body=chunks(),
-                         headers=up_headers, encode_chunked=True)
+            try:
+                conn.request(dst_method, target, body=chunks(),
+                             headers=up_headers, encode_chunked=True)
+            except _RelaySourceError:
+                raise
+            except OSError as send_err:
+                # the send socket failed: the DESTINATION may have
+                # rejected the upload mid-body (4xx/5xx + close) —
+                # its verdict, not this broken pipe, is the root
+                # cause; surface it when the response is readable
+                # (http_stream_request's rule)
+                import http.client as _hc
+                try:
+                    r = conn.getresponse()
+                    return 200, r.status, r.read()
+                except (OSError, _hc.HTTPException):
+                    raise send_err from None
             r = conn.getresponse()
             return 200, r.status, r.read()
         finally:
@@ -708,8 +771,21 @@ def http_stream_request(method: str, url: str, chunks,
             # park the small ones behind delayed ACKs
             conn.sock.setsockopt(_socket.IPPROTO_TCP,
                                  _socket.TCP_NODELAY, 1)
+        from ..faults import FaultInjected as _FaultInjected
         try:
             for chunk in chunks:
+                directive = _fire_fault("httpd.stream.chunk",
+                                        key=full_url)
+                if directive == "truncate":
+                    # end the chunked stream EARLY but CLEANLY: the
+                    # receiver sees valid framing with fewer bytes
+                    # than the producer meant — exactly the case the
+                    # CRC/byte-count commit handshake must catch
+                    break
+                if directive == "drop":
+                    conn.sock.close()
+                    raise OSError(
+                        f"stream to {url}: fault-injected drop")
                 n = len(chunk)
                 if not n:
                     continue
@@ -717,6 +793,13 @@ def http_stream_request(method: str, url: str, chunks,
                 conn.send(chunk)
                 conn.send(b"\r\n")
             conn.send(b"0\r\n\r\n")
+        except _FaultInjected:
+            # an armed `error` fault (here or in the producer) stands
+            # in for the WIRE dying, not the receiver answering: skip
+            # the receiver-verdict probe below — with both ends alive
+            # it would block on a receiver that still wants chunks —
+            # and let the finally tear the connection down mid-body
+            raise
         except OSError:
             # the receiver may have REJECTED the upload mid-body
             # (4xx/5xx + close) — its verdict is the root cause the
@@ -794,6 +877,7 @@ def _one_pooled_request(method: str, full_url: str, body,
         conn = _pool().get(key)
         reused = conn is not None
         if conn is None:
+            _fire_fault("httpd.pool.connect", key=parsed.netloc)
             if parsed.scheme == "https":
                 conn = http.client.HTTPSConnection(
                     parsed.netloc, timeout=timeout, context=ctx)
@@ -804,6 +888,8 @@ def _one_pooled_request(method: str, full_url: str, body,
         if conn.sock is not None:
             conn.sock.settimeout(timeout)
         try:
+            _fire_fault("httpd.pool.request",
+                        key=f"{parsed.netloc}{target}")
             conn.request(method, target, body=body, headers=headers)
         except (http.client.HTTPException, OSError) as e:
             # send failed: the request never executed — safe to retry
@@ -822,26 +908,28 @@ def _one_pooled_request(method: str, full_url: str, body,
             # request may have EXECUTED server-side (response lost):
             # transparently retrying a POST here would double-execute
             # non-idempotent operations (publish, delete counters), so
-            # only idempotent methods (RFC 9110 §9.2.2: GET/HEAD/PUT/
-            # DELETE/OPTIONS — urllib3's default retry set) re-issue,
-            # once, even on a FRESH connection: a loaded threaded
-            # server can drop an accepted connection before
-            # responding.  Everything else surfaces the ambiguity to
-            # the caller (Go Transport's rule).
+            # only idempotent work (RFC 9110 §9.2.2 methods, or a
+            # caller-DECLARED X-Idempotent POST such as truncate-to-
+            # size) re-issues — and only for the stale-keep-alive
+            # race: a REUSED pooled socket that died with ZERO
+            # response bytes is a connection-state artifact, not a
+            # peer-health verdict, so it re-issues inline on a fresh
+            # dial without feeding the breaker or spending retry
+            # budget.  Every other failure (timeout on a hung peer,
+            # mid-response reset, fresh-connection death) surfaces to
+            # the ONE outer policy in _pooled_request (util/retry),
+            # which re-issues idempotent work under backoff + budget —
+            # keeping this inner loop from stacking multiplicatively
+            # with the outer attempts.  Undeclared POSTs still surface
+            # the executed-or-not ambiguity (Go Transport's rule —
+            # blind replay would double-publish MQ messages).
             conn.close()
             _pool().pop(key, None)
-            if attempt == 0 and method in ("GET", "HEAD", "PUT",
-                                           "DELETE", "OPTIONS"):
-                continue
             if attempt == 0 and reused and \
-                    headers.get("X-Idempotent") == "1" and \
-                    isinstance(e, http.client.RemoteDisconnected):
-                # caller DECLARED this request idempotent (e.g. a
-                # truncate-to-size or set-flag POST): a reused socket
-                # that died with zero response bytes is then safe to
-                # re-issue.  Undeclared POSTs still surface the
-                # executed-or-not ambiguity (Go Transport's rule —
-                # blind replay would double-publish MQ messages)
+                    isinstance(e, http.client.RemoteDisconnected) and \
+                    (method in ("GET", "HEAD", "PUT", "DELETE",
+                                "OPTIONS")
+                     or headers.get("X-Idempotent") == "1"):
                 continue
             if isinstance(e, OSError):
                 raise
@@ -872,9 +960,24 @@ def _pooled_request(method: str, url: str, body, headers: dict,
         headers = dict(headers)
         headers[tracing.HEADER] = tp
     full_url, ctx = _dial(url)
+    # unified failure policy (util/retry): consult the peer's circuit
+    # breaker before dialing (a tripped peer fails fast instead of
+    # burning a timeout), feed every transport outcome back into the
+    # health map, and re-issue idempotent requests under the capped
+    # jittered backoff + process retry budget.  POSTs keep exactly the
+    # seed's semantics: only `_one_pooled_request`'s provably-never-
+    # executed send-failed rule re-issues them.
+    from ..util import retry as _retry
     for _hop in range(max_redirects):
-        status, data, rheaders, location = _one_pooled_request(
-            method, full_url, body, headers, timeout, ctx)
+        peer = urllib.parse.urlsplit(full_url).netloc
+        idempotent = method in ("GET", "HEAD", "PUT", "DELETE",
+                                "OPTIONS") or \
+            headers.get("X-Idempotent") == "1"
+        hop_url = full_url
+        status, data, rheaders, location = _retry.retry_call(
+            lambda: _one_pooled_request(method, hop_url, body,
+                                        headers, timeout, ctx),
+            site="httpd.pool", peer=peer, idempotent=idempotent)
         if status in (301, 302, 307, 308) and location and \
                 method in ("GET", "HEAD"):
             # urllib-parity redirect following for read paths

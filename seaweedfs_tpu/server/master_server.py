@@ -458,6 +458,11 @@ class MasterServer:
         vid_str = req.query.get("volumeId", "")
         if "," in vid_str:  # allow full fid
             vid_str = vid_str.split(",", 1)[0]
+        from .. import faults
+        # armed `master.lookup` faults simulate a master that is alive
+        # but failing lookups (partition between master and its
+        # topology view) — the chaos suite's lookup-degradation lever
+        faults.fire("master.lookup", key=vid_str)
         vid = int(vid_str)
         locations = self.topology.lookup(vid)
         if not locations:
@@ -606,7 +611,9 @@ class MasterServer:
             sum(len(n.volumes) for n in nodes))
         self.metrics.gauge_set("sequence", self.sequencer.peek()
                                if hasattr(self.sequencer, "peek") else 0)
-        return 200, (self.metrics.render().encode(),
+        from ..stats import render_process
+        return 200, ((self.metrics.render() +
+                      render_process()).encode(),
                      "text/plain; version=0.0.4")
 
 

@@ -83,15 +83,31 @@ class EcReader:
             data = self._remote_read(url, ev.id, sid, off, iv.size)
             if data is not None:
                 return data
-        # 3. reconstruct from survivors
+        # 3. reconstruct from survivors — the DEGRADED read path: make
+        # it countable (the SLO difference between "one dead peer" and
+        # "every read pays a d-way fan-out" lives in this counter)
+        from .. import stats
+        stats.PROCESS.counter_add(
+            "ec_degraded_reads_total", 1.0,
+            help_text="needle reads served by interval reconstruction "
+                      "instead of a direct shard read", vid=ev.id)
         return self._recover_interval(ev, sid, off, iv.size)
 
     def _remote_read(self, url: str, vid: int, sid: int, offset: int,
                      size: int) -> bytes | None:
         """volume_server.proto:101 VolumeEcShardRead.  Returns None on
         any transport failure — a dead shard server must degrade to
-        reconstruction, not surface a 500 (store_ec.go falls through)."""
+        reconstruction, not surface a 500 (store_ec.go falls through).
+        Consults the peer's circuit breaker first (an open peer is
+        skipped without burning a timeout) and cache-busts this
+        volume's shard locations on failure so the NEXT read re-looks
+        up placement instead of retrying the same dead peer until the
+        37-minute TTL expires."""
         if url == self.self_url:
+            return None
+        from ..util import retry as _retry
+        if not _retry.peer_available(url):
+            self._note_failover(url)
             return None
         try:
             status, body, _ = http_bytes(
@@ -100,8 +116,35 @@ class EcReader:
                 f"&offset={offset}&size={size}", timeout=10,
                 headers=self._security_headers())
         except OSError:
+            self._note_failover(url)
+            self._bust_locations(vid, url)
             return None
-        return body if status == 200 and len(body) == size else None
+        if status == 200 and len(body) == size:
+            return body
+        self._note_failover(url)
+        return None
+
+    def _note_failover(self, url: str) -> None:
+        from .. import stats
+        stats.PROCESS.counter_add(
+            "ec_read_source_failovers_total", 1.0,
+            help_text="EC reads that abandoned a shard source "
+                      "(transport failure, short body, open breaker)",
+            peer=url)
+
+    def _bust_locations(self, vid: int, dead_url: str) -> None:
+        """Drop a dead peer from this volume's cached shard locations
+        and expire the cache: the next read refreshes placement from
+        the master rather than re-timing-out on the same peer."""
+        cache = self._caches.get(vid)
+        if cache is None:
+            return
+        with cache.lock:
+            for sid, urls in list(cache.locations.items()):
+                if dead_url in urls:
+                    cache.locations[sid] = \
+                        [u for u in urls if u != dead_url]
+            cache.refreshed = 0.0
 
     def _recover_interval(self, ev: EcVolume, missing_sid: int,
                           offset: int, size: int) -> bytes:

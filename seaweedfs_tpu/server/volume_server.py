@@ -276,6 +276,11 @@ class VolumeServer:
         if self.rack:
             hb["rack"] = self.rack
         try:
+            from .. import faults
+            # armed `master.heartbeat` faults: delay stalls this pulse
+            # (the chaos suite's slow-heartbeat scenario), error skips
+            # it entirely — both retried next pulse like a real stall
+            faults.fire("master.heartbeat", key=self.url)
             from ..operation import master_json
             # master_json re-dials the raft leader on "not leader"
             # replies (volume_grpc_client_to_master.go:109
@@ -345,7 +350,9 @@ class VolumeServer:
         self.metrics.gauge_set("ec_volumes", len(hb["ecShards"]))
         self.metrics.gauge_set(
             "max_volume_count", hb["maxVolumeCount"])
-        return 200, (self.metrics.render().encode(),
+        from ..stats import render_process
+        return 200, ((self.metrics.render() +
+                      render_process()).encode(),
                      "text/plain; version=0.0.4")
 
     def _get_needle(self, fid: types.FileId, rng: str = "",
@@ -480,7 +487,8 @@ class VolumeServer:
             if loc in (self.url, self.store.public_url):
                 continue
             status, data, _ = http_bytes(
-                "DELETE", f"{loc}/{fid}?type=replicate", headers=headers)
+                "DELETE", f"{loc}/{fid}?type=replicate", headers=headers,
+                                  timeout=60)
             if status >= 300 and status != 404:
                 return f"{loc} -> {status}: {data[:200]!r}"
         return None
@@ -520,7 +528,7 @@ class VolumeServer:
                 method,
                 f"{loc['url']}/{fid}?type=replicate" +
                 (f"&{qs}" if qs else ""),
-                body, headers=headers)
+                body, headers=headers, timeout=60)
             if status >= 300 and status not in ok_statuses:
                 return f"{loc['url']} -> {status}: {data[:200]!r}"
         return None
@@ -656,7 +664,7 @@ class VolumeServer:
                 status, _hdrs = http_download(
                     f"{peer}/admin/volume_file?volumeId={vid}"
                     f"&collection={v.collection}&ext=.dat", tmp,
-                    headers=self.security.admin_headers())
+                    headers=self.security.admin_headers(), timeout=600)
                 if status != 200:
                     return 500, {"error":
                                  f"pull .dat from {peer}: {status}"}
@@ -917,10 +925,16 @@ class VolumeServer:
         # leave a truncated file at the final path for _base_path to
         # later resolve
         import uuid as _uuid
+        from .. import faults
         tmp = f"{base}{ext}.recv.{_uuid.uuid4().hex}"
         try:
             with open(tmp, "wb") as f:
                 for chunk in req.stream_body():
+                    if faults.fire("volume.receive_file.recv",
+                                   key=f"{vid}{ext}") is not None:
+                        raise IOError(
+                            f"receive_file {vid}{ext}: fault-injected "
+                            f"mid-stream failure")
                     f.write(chunk)
                     n += len(chunk)
             os.replace(tmp, base + ext)
@@ -990,7 +1004,8 @@ class VolumeServer:
         placement = b.get("placement")
         if placement is not None:
             return self._ec_scatter_generate(
-                v, ctx, collection, base, dat_size, placement)
+                v, ctx, collection, base, dat_size, placement,
+                replan=int(b.get("replan", 0)))
         ec_encoder.write_sorted_file_from_idx(base)      # .ecx first!
         ec_encoder.write_ec_files(base, ctx)
         ec_encoder.save_ec_volume_info(base, ctx, dat_size, v.version)
@@ -998,7 +1013,7 @@ class VolumeServer:
 
     def _ec_scatter_generate(self, v, ctx: ECContext, collection: str,
                              base: str, dat_size: int,
-                             placement: dict):
+                             placement: dict, replan: int = 0):
         """Placement-first streaming encode (the scatter tentpole).
         Order is the no-partial-stripe invariant: (1) pipeline every
         shard's windows to its sink and VERIFY delivery (crc + byte
@@ -1024,6 +1039,22 @@ class VolumeServer:
                                   f"{sorted(dests)}"}
         self_urls = {self.http.url, self.store.public_url}
         stats = ScatterStats()
+        if replan:
+            # the shell re-planned a failed stripe around dead/tripped
+            # destinations and is retrying on this source: make the
+            # re-plan COUNT so chaos runs can assert it happened
+            self.metrics.counter_add(
+                "ec_scatter_replans_total", float(replan),
+                help_text="scatter encodes re-planned around failed "
+                          "destinations")
+        # destinations observed failing this run, for the shell's
+        # re-planner ({failedDests: [...]} rides the error body)
+        failed_dests: set = set()
+        failed_lock = threading.Lock()
+
+        def _note_failed(url: str) -> None:
+            with failed_lock:
+                failed_dests.add(url)
         t_start = _time.perf_counter()
         # snapshot any pre-existing .vif: for a TIERED volume it is the
         # ONLY reference to the remote .dat, and the unwind must
@@ -1069,16 +1100,20 @@ class VolumeServer:
                         sidecars.append((ext, sf.read()))
 
             def push_sidecars(url: str) -> None:
-                for ext, payload in sidecars:
-                    st, body, _ = http_bytes(
-                        "POST",
-                        f"{url}/admin/receive_file?volumeId={v.id}"
-                        f"&collection={collection}&ext={ext}",
-                        payload,
-                        headers=self.security.admin_headers())
-                    if st != 200:
-                        raise OSError(f"push {ext} to {url}: {st} "
-                                      f"{body[:200]!r}")
+                try:
+                    for ext, payload in sidecars:
+                        st, body, _ = http_bytes(
+                            "POST",
+                            f"{url}/admin/receive_file?volumeId={v.id}"
+                            f"&collection={collection}&ext={ext}",
+                            payload,
+                            headers=self.security.admin_headers(), timeout=60)
+                        if st != 200:
+                            raise OSError(f"push {ext} to {url}: {st} "
+                                          f"{body[:200]!r}")
+                except OSError:
+                    _note_failed(url)
+                    raise
             with ThreadPoolExecutor(
                     max_workers=max(1, len(remote_dests))) as spool:
                 list(spool.map(push_sidecars, remote_dests))
@@ -1094,19 +1129,23 @@ class VolumeServer:
 
             def commit_dest(item):
                 url, sids = item
-                r = http_json(
-                    "POST", f"{url}/admin/ec/shard_write_commit",
-                    {"volumeId": v.id, "collection": collection,
-                     "mount": True,
-                     "commits": [{"uploadId": sinks[sid].upload_id,
-                                  "shardId": sid,
-                                  "crc32": sinks[sid].crc,
-                                  "bytes": sinks[sid].bytes}
-                                 for sid in sids]},
-                    headers=self.security.admin_headers())
-                if "error" in r:
-                    raise OSError(
-                        f"commit {sids} on {url}: {r['error']}")
+                try:
+                    r = http_json(
+                        "POST", f"{url}/admin/ec/shard_write_commit",
+                        {"volumeId": v.id, "collection": collection,
+                         "mount": True,
+                         "commits": [{"uploadId": sinks[sid].upload_id,
+                                      "shardId": sid,
+                                      "crc32": sinks[sid].crc,
+                                      "bytes": sinks[sid].bytes}
+                                     for sid in sids]},
+                        headers=self.security.admin_headers(), timeout=30)
+                    if "error" in r:
+                        raise OSError(
+                            f"commit {sids} on {url}: {r['error']}")
+                except OSError:
+                    _note_failed(url)
+                    raise
                 for sid in sids:
                     sinks[sid].mark_committed()
             with ThreadPoolExecutor(
@@ -1130,13 +1169,23 @@ class VolumeServer:
             t_mounted = _time.perf_counter()
         except Exception as e:  # noqa: BLE001 — unwind, then report
             for sink in sinks:
+                url = getattr(sink, "url", "")
+                if url and (getattr(sink, "_error", None) is not None
+                            or url in str(e)):
+                    # the sink's send thread failed, or the raised
+                    # error names this destination (finish()'s
+                    # byte/CRC mismatch carries the dest url)
+                    _note_failed(url)
                 try:
                     sink.close()  # aborts anything uncommitted
                 except OSError:
                     pass
             self._ec_scatter_unwind(v.id, collection, ctx, dests,
                                     base, vif_before)
-            return 500, {"error": f"scatter encode: {e}"}
+            # failedDests lets the caller re-plan the stripe around
+            # the dead destinations instead of failing the job
+            return 500, {"error": f"scatter encode: {e}",
+                         "failedDests": sorted(failed_dests)}
         wall = _time.perf_counter() - t_start
         tele = stats.summary(dat_size, wall)
         tele["mode"] = "scatter"
@@ -1166,7 +1215,7 @@ class VolumeServer:
                 http_json("POST", f"{url}/admin/ec/delete_shards",
                           {"volumeId": vid, "collection": collection,
                            "shardIds": list(range(ctx.total))},
-                          headers=self.security.admin_headers())
+                          headers=self.security.admin_headers(), timeout=30)
             except OSError:
                 pass
         try:
@@ -1242,8 +1291,18 @@ class VolumeServer:
             # (receive_file, ec/copy): the scatter shard's durability
             # contract matches the seed balance-move it replaces —
             # integrity is the CRC + commit handshake, not fsync
+            from .. import faults
             with open(tmp, "wb") as f:
                 for chunk in req.stream_body():
+                    directive = faults.fire("volume.shard_write.recv",
+                                            key=f"{vid}.{sid}")
+                    if directive is not None:
+                        # truncate/drop on the RECEIVER both mean the
+                        # stream dies here: the temp is removed, the
+                        # upload never registers, the sender errors
+                        raise IOError(
+                            f"shard_write {vid}.{sid}: fault-injected "
+                            f"{directive} mid-stream")
                     f.write(chunk)
                     crc = zlib.crc32(chunk, crc)
                     n += len(chunk)
@@ -1396,7 +1455,7 @@ class VolumeServer:
             status, _hdrs = http_download(
                 f"{source}/admin/volume_file?volumeId={vid}"
                 f"&collection={collection}&ext={ext}", base + ext,
-                headers=self.security.admin_headers())
+                headers=self.security.admin_headers(), timeout=600)
             if status != 200:
                 if ext == ".ecj":  # journal may legitimately not exist
                     continue
@@ -1600,8 +1659,21 @@ class VolumeServer:
             return 404, {"error": f"shard {vid}.{shard_id} not found"}
         shard = ev.shards[shard_id]
         n = max(0, min(size, shard.size - offset))
+        from .. import faults
+        directive = faults.fire("volume.shard_read.serve",
+                                key=f"{vid}.{shard_id}")
         f = open(shard.path, "rb")
         f.seek(offset)
+        if directive in ("truncate", "drop"):
+            # a donor dying mid-serve: PROMISE n bytes, deliver fewer
+            # (half, or none for drop), and sever the connection so
+            # the reader sees EOF short of the Content-Length — the
+            # exact signature RemoteShardSource's failover treats as a
+            # dead donor, never as a short shard to zero-pad
+            served = n // 2 if directive == "truncate" else 0
+            req._handler.close_connection = True
+            return 200, (FileSlice(f, served),
+                         {"Content-Length": str(n)})
         return 200, (FileSlice(f, n), {"Content-Length": str(n)})
 
     def _scrub(self, req: Request):

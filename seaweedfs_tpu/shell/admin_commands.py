@@ -54,7 +54,7 @@ def cmd_volume_mount(env: CommandEnv, args: list[str]) -> str:
                            "volumes)")
     _must(http_json("POST", f"{node}/admin/mount_volume",
                     {"volumeId": vid,
-                     "collection": opts.get("collection", "")}),
+                     "collection": opts.get("collection", "")}, timeout=30),
           f"mount volume {vid} on {node}")
     return f"mounted volume {vid} on {node}"
 
@@ -67,7 +67,7 @@ def cmd_volume_unmount(env: CommandEnv, args: list[str]) -> str:
     vid = int(opts["volumeId"])
     node = _one_location(env, opts, vid)
     _must(http_json("POST", f"{node}/admin/unmount_volume",
-                    {"volumeId": vid}),
+                    {"volumeId": vid}, timeout=30),
           f"unmount volume {vid} on {node}")
     return f"unmounted volume {vid} on {node}"
 
@@ -84,7 +84,7 @@ def cmd_volume_delete(env: CommandEnv, args: list[str]) -> str:
         return f"volume {vid} has no locations"
     for url in locs:
         _must(http_json("POST", f"{url}/admin/delete_volume",
-                        {"volumeId": vid}),
+                        {"volumeId": vid}, timeout=30),
               f"delete volume {vid} on {url}")
     return f"deleted volume {vid} from {len(locs)} servers"
 
@@ -119,11 +119,12 @@ def cmd_volume_delete_empty(env: CommandEnv, args: list[str]) -> str:
         was_readonly = bool(v.get("readOnly", False))
         for url in locs:
             http_json("POST", f"{url}/admin/set_readonly",
-                      {"volumeId": vid, "readOnly": True})
+                      {"volumeId": vid, "readOnly": True}, timeout=30)
         live_anywhere = False
         for url in locs:
             r = http_json("GET",
-                          f"{url}/admin/volume_index?volumeId={vid}")
+                          f"{url}/admin/volume_index?volumeId={vid}",
+                    timeout=30)
             if r.get("error") or r.get("entries"):
                 live_anywhere = True
                 break
@@ -131,11 +132,11 @@ def cmd_volume_delete_empty(env: CommandEnv, args: list[str]) -> str:
             if not was_readonly:
                 for url in locs:  # undo OUR mark only
                     http_json("POST", f"{url}/admin/set_readonly",
-                              {"volumeId": vid, "readOnly": False})
+                              {"volumeId": vid, "readOnly": False}, timeout=30)
             continue
         for url in locs:
             http_json("POST", f"{url}/admin/delete_volume",
-                      {"volumeId": vid})
+                      {"volumeId": vid}, timeout=30)
         deleted.append(vid)
     return f"deleted {len(deleted)} empty volumes: {deleted}" \
         if deleted else "no empty volumes"
@@ -158,7 +159,7 @@ def cmd_volume_mark(env: CommandEnv, args: list[str]) -> str:
     locs = _vid_locations(env, vid)
     for url in locs:
         _must(http_json("POST", f"{url}/admin/set_readonly",
-                        {"volumeId": vid, "readOnly": ro}),
+                        {"volumeId": vid, "readOnly": ro}, timeout=30),
               f"mark volume {vid} on {url}")
     state = "readonly" if ro else "writable"
     return f"marked volume {vid} {state} on {len(locs)} servers"
@@ -182,7 +183,7 @@ def cmd_volume_configure_replication(env: CommandEnv,
     for url in locs:
         _must(http_json("POST", f"{url}/admin/configure_volume",
                         {"volumeId": vid,
-                         "replication": replication}),
+                         "replication": replication}, timeout=30),
               f"configure volume {vid} on {url}")
     return (f"volume {vid} replication set to {replication} on "
             f"{len(locs)} servers")
@@ -256,7 +257,7 @@ def cmd_cluster_ps(env: CommandEnv, args: list[str]) -> str:
     """command_cluster_ps.go: list cluster processes (masters +
     volume servers, with volume counts)."""
     from ..topology import iter_volume_list_volumes
-    st = master_json(env.master, "GET", "/cluster/status")
+    st = master_json(env.master, "GET", "/cluster/status", timeout=30)
     counts: dict[str, int] = {}
     for n, _v in iter_volume_list_volumes(env.volume_list()):
         counts[n["url"]] = counts.get(n["url"], 0) + 1
@@ -274,7 +275,8 @@ def cmd_cluster_ps(env: CommandEnv, args: list[str]) -> str:
 def cmd_cluster_status(env: CommandEnv, args: list[str]) -> str:
     """Raw cluster status JSON (command_cluster_status.go)."""
     return json.dumps(
-        master_json(env.master, "GET", "/cluster/status"), indent=2)
+        master_json(env.master, "GET", "/cluster/status",
+            timeout=30), indent=2)
 
 
 # -- mq.topic.* (command_mq_topic_*.go) ------------------------------
@@ -291,7 +293,7 @@ def cmd_mq_topic_list(env: CommandEnv, args: list[str]) -> str:
     opts = _parse_flags(args)
     ns = opts.get("namespace", "default")
     r = _must(http_json(
-        "GET", f"{_broker(env, opts)}/topics/list?namespace={ns}"),
+        "GET", f"{_broker(env, opts)}/topics/list?namespace={ns}", timeout=30),
         "list topics")
     topics = r.get("topics", [])
     return "\n".join(f"{ns}.{t}" for t in topics) or "no topics"
@@ -303,7 +305,7 @@ def cmd_mq_topic_configure(env: CommandEnv, args: list[str]) -> str:
     r = _must(http_json(
         "POST", f"{_broker(env, opts)}/topics/configure",
         {"namespace": opts["namespace"], "topic": opts["topic"],
-         "partitionCount": int(opts.get("partitionCount", 4))}),
+         "partitionCount": int(opts.get("partitionCount", 4))}, timeout=30),
         "configure topic")
     return (f"topic {opts['namespace']}.{opts['topic']}: "
             f"{len(r.get('partitions', []))} partitions")
@@ -315,14 +317,15 @@ def cmd_mq_topic_desc(env: CommandEnv, args: list[str]) -> str:
     broker = _broker(env, opts)
     r = _must(http_json(
         "GET", f"{broker}/topics/lookup?namespace="
-        f"{opts['namespace']}&topic={opts['topic']}"), "lookup topic")
+        f"{opts['namespace']}&topic={opts['topic']}",
+                  timeout=30), "lookup topic")
     lines = []
     for a in r.get("assignments", []):
         p = a["partition"]
         lines.append(f"partition [{p['rangeStart']},{p['rangeStop']}) "
                      f"-> {a.get('broker', '?')}")
     sch = http_json("GET", f"{broker}/topics/schema?namespace="
-                    f"{opts['namespace']}&topic={opts['topic']}")
+                    f"{opts['namespace']}&topic={opts['topic']}", timeout=30)
     if "recordType" in sch:
         lines.append(f"schema rev {sch['revision']}: "
                      + json.dumps(sch["recordType"]))
@@ -338,7 +341,7 @@ def cmd_mq_topic_compact(env: CommandEnv, args: list[str]) -> str:
         "POST", f"{_broker(env, opts)}/topics/compact",
         {"namespace": opts["namespace"], "topic": opts["topic"],
          "force": True,
-         "keepRecent": int(opts.get("keepRecent", 1))}),
+         "keepRecent": int(opts.get("keepRecent", 1))}, timeout=30),
         "compact topic")
     done = sum(x.get("compacted", 0) for x in r.get("results", []))
     rows = sum(x.get("rows", 0) for x in r.get("results", []))
@@ -359,7 +362,7 @@ def cmd_sleep(env: CommandEnv, args: list[str]) -> str:
 def cmd_cluster_raft_ps(env: CommandEnv, args: list[str]) -> str:
     """command_cluster_raft_ps.go RaftListClusterServers: membership +
     replication state of the master raft group."""
-    st = master_json(env.master, "GET", "/cluster/status")
+    st = master_json(env.master, "GET", "/cluster/status", timeout=30)
     raft = st.get("raft", {})
     lines = [f"leader: {st.get('leader')}  term: {st.get('term')}  "
              f"topologyId: {st.get('topologyId')}"]
@@ -383,7 +386,7 @@ def cmd_cluster_raft_add(env: CommandEnv, args: list[str]) -> str:
     if not server:
         return "usage: cluster.raft.add -server=host:port"
     r = master_json(env.master, "POST", "/cluster/raft/config",
-                    {"add": [server]})
+                    {"add": [server]}, timeout=30)
     _must(r, f"add raft server {server}")
     return f"members: {', '.join(r['peers'])}"
 
@@ -397,7 +400,7 @@ def cmd_cluster_raft_remove(env: CommandEnv, args: list[str]) -> str:
     if not server:
         return "usage: cluster.raft.remove -server=host:port"
     r = master_json(env.master, "POST", "/cluster/raft/config",
-                    {"remove": [server]})
+                    {"remove": [server]}, timeout=30)
     _must(r, f"remove raft server {server}")
     return f"members: {', '.join(r['peers'])}"
 
@@ -413,7 +416,7 @@ def cmd_volume_server_state(env: CommandEnv, args: list[str]) -> str:
     node = opts.get("node", "")
     if not node:
         return "usage: volume.server.state -node=host:port"
-    st = http_json("GET", f"{node}/status")
+    st = http_json("GET", f"{node}/status", timeout=30)
     _must(st, f"status of {node}")
     vols = st.get("volumes", [])
     ecs = st.get("ecShards", [])
@@ -441,7 +444,7 @@ def cmd_volume_server_leave(env: CommandEnv, args: list[str]) -> str:
     node = opts.get("node", "")
     if not node:
         return "usage: volume.server.leave -node=host:port"
-    _must(http_json("POST", f"{node}/admin/leave", {}),
+    _must(http_json("POST", f"{node}/admin/leave", {}, timeout=30),
           f"leave {node}")
     return f"{node} left the cluster (master forgets it within its " \
            f"pulse timeout)"
@@ -456,7 +459,8 @@ def cmd_volume_vacuum_disable(env: CommandEnv, args: list[str]) -> str:
         else _all_node_urls(env)
     for n in nodes:
         _must(http_json("POST", f"{n}/admin/vacuum_toggle",
-                        {"enabled": False}), f"disable vacuum on {n}")
+                        {"enabled": False},
+                  timeout=30), f"disable vacuum on {n}")
     return f"vacuum disabled on {len(nodes)} server(s)"
 
 
@@ -468,7 +472,8 @@ def cmd_volume_vacuum_enable(env: CommandEnv, args: list[str]) -> str:
         else _all_node_urls(env)
     for n in nodes:
         _must(http_json("POST", f"{n}/admin/vacuum_toggle",
-                        {"enabled": True}), f"enable vacuum on {n}")
+                        {"enabled": True},
+                  timeout=30), f"enable vacuum on {n}")
     return f"vacuum enabled on {len(nodes)} server(s)"
 
 
@@ -480,7 +485,7 @@ def cmd_volume_replica_check(env: CommandEnv, args: list[str]) -> str:
     aggregated and can hide divergence)."""
     per_server: dict[str, dict[int, dict]] = {}
     for url in _all_node_urls(env):
-        st = http_json("GET", f"{url}/status")
+        st = http_json("GET", f"{url}/status", timeout=30)
         if st.get("error"):
             continue
         per_server[url] = {v["id"]: v for v in st.get("volumes", [])}
@@ -532,7 +537,7 @@ def cmd_cluster_raft_leader_transfer(env: CommandEnv,
     from ..operation import master_json
     opts = _parse_flags(args)
     r = master_json(env.master, "POST", "/cluster/raft/transfer",
-                    {"target": opts.get("target", "")})
+                    {"target": opts.get("target", "")}, timeout=30)
     _must(r, "leader transfer")
     return "leadership transferred (TimeoutNow nudge sent to the " \
            "successor)"
@@ -544,7 +549,7 @@ def cmd_mq_balance(env: CommandEnv, args: list[str]) -> str:
     topic's partition ownership round-robin across live brokers."""
     opts = _parse_flags(args)
     r = _must(http_json("POST", f"{_broker(env, opts)}/topics/balance",
-                        {}), "mq balance")
+                        {}, timeout=30), "mq balance")
     return (f"balanced {r.get('topics', 0)} topics across "
             f"{len(r.get('brokers', []))} brokers; moved "
             f"{r.get('movedPartitions', 0)} partitions")
@@ -558,7 +563,7 @@ def cmd_mq_topic_truncate(env: CommandEnv, args: list[str]) -> str:
     opts = _parse_flags(args)
     r = _must(http_json(
         "POST", f"{_broker(env, opts)}/topics/truncate",
-        {"namespace": opts["namespace"], "topic": opts["topic"]}),
+        {"namespace": opts["namespace"], "topic": opts["topic"]}, timeout=30),
         "truncate topic")
     return (f"truncated {r.get('truncated', 0)} partitions of "
             f"{opts['namespace']}.{opts['topic']}")

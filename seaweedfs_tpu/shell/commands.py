@@ -48,14 +48,14 @@ class CommandEnv:
     def lock(self) -> None:
         r = master_json(self.master, "POST", "/cluster/lease_admin_token",
                       {"previousToken": self.admin_token or 0,
-                       "lockName": "admin"})
+                       "lockName": "admin"}, timeout=30)
         if "token" not in r:
             raise RuntimeError(f"cannot acquire cluster lock: {r}")
         self.admin_token = r["token"]
 
     def unlock(self) -> None:
         master_json(self.master, "POST", "/cluster/release_admin_token",
-                  {"previousToken": self.admin_token or 0})
+                  {"previousToken": self.admin_token or 0}, timeout=30)
         self.admin_token = None
 
     def confirm_is_locked(self) -> None:
@@ -65,10 +65,11 @@ class CommandEnv:
                 "lock is lost, or it is not locked; run `lock` first")
 
     def volume_list(self) -> dict:
-        return master_json(self.master, "GET", "/vol/list")
+        return master_json(self.master, "GET", "/vol/list", timeout=30)
 
     def volume_locations(self, vid: int) -> list[dict]:
-        r = master_json(self.master, "GET", f"/dir/lookup?volumeId={vid}")
+        r = master_json(self.master, "GET", f"/dir/lookup?volumeId={vid}",
+                timeout=30)
         return r.get("locations", [])
 
 
@@ -94,7 +95,7 @@ def cmd_volume_list(env: CommandEnv, args: list[str]) -> str:
 
 @command("cluster.check")
 def cmd_cluster_check(env: CommandEnv, args: list[str]) -> str:
-    r = master_json(env.master, "GET", "/cluster/status")
+    r = master_json(env.master, "GET", "/cluster/status", timeout=30)
     return json.dumps(r, indent=2)
 
 
@@ -109,7 +110,8 @@ def cmd_volume_vacuum(env: CommandEnv, args: list[str]) -> str:
         if target_vid is not None and vid != target_vid:
             continue
         for url in urls:
-            http_json("POST", f"{url}/admin/vacuum", {"volumeId": vid})
+            http_json("POST", f"{url}/admin/vacuum", {"volumeId": vid},
+                timeout=30)
         done.append(vid)
     return f"vacuumed volumes: {sorted(done)}"
 
@@ -174,23 +176,53 @@ def _do_ec_encode(env: CommandEnv, vid: int, data_shards: int,
         for loc in locations:
             _must(http_json("POST",
                             f"{loc['url']}/admin/set_readonly",
-                            {"volumeId": vid, "readOnly": True}),
+                            {"volumeId": vid, "readOnly": True}, timeout=30),
                   f"set readonly on {loc['url']}")
             marked.append(loc["url"])
         if mode == "scatter":
             # 2s. placement FIRST (the scores/rack rules balance would
             # apply after the fact), then one scatter generate: the
             # source streams every shard to its final destination and
-            # mounts it there — no local mount, no balance round
-            placement = _plan_ec_placement(env, vid, total)
-            r = http_json("POST", f"{source}/admin/ec/generate", {
-                "volumeId": vid, "collection": collection,
-                "dataShards": data_shards,
-                "parityShards": parity_shards,
-                "placement": {str(s): u
-                              for s, u in placement.items()}},
-                timeout=600.0)
-            _must(r, f"scatter generate on {source}")
+            # mounts it there — no local mount, no balance round.
+            # Failure handling: a generate that dies on specific
+            # destinations reports them (failedDests) and the stripe
+            # is RE-PLANNED around them — up to twice — before giving
+            # up; a re-plan with no remaining candidates falls back to
+            # `-mode=local` (encode still completes, balance spreads
+            # later) instead of failing the job.  The planner also
+            # skips peers whose circuit breaker is open.
+            exclude: set = set()
+            replans = 0
+            while True:
+                try:
+                    placement = _plan_ec_placement(env, vid, total,
+                                                   exclude=exclude)
+                except RuntimeError:
+                    if not exclude:
+                        raise  # nothing excluded: a real planning error
+                    # nowhere left to scatter after exclusions: local
+                    # mode still completes the encode on the source
+                    return _do_ec_encode(env, vid, data_shards,
+                                         parity_shards, opts, "local")
+                r = http_json("POST", f"{source}/admin/ec/generate", {
+                    "volumeId": vid, "collection": collection,
+                    "dataShards": data_shards,
+                    "parityShards": parity_shards,
+                    "replan": replans,
+                    "placement": {str(s): u
+                                  for s, u in placement.items()}},
+                    timeout=600.0)
+                if "error" not in r:
+                    break
+                failed = [d for d in r.get("failedDests", [])
+                          if d != source]
+                if replans >= 2 or not failed:
+                    _must(r, f"scatter generate on {source}")
+                dropped = set(failed) - exclude
+                if not dropped:
+                    _must(r, f"scatter generate on {source}")
+                exclude |= dropped
+                replans += 1
             moved = 0
         else:
             # 2. generate EC shards on the first replica (:359)
@@ -204,7 +236,7 @@ def _do_ec_encode(env: CommandEnv, vid: int, data_shards: int,
             # the EC copy unregistered (data loss)
             _must(http_json("POST", f"{source}/admin/ec/mount", {
                 "volumeId": vid, "collection": collection,
-                "shardIds": list(range(total))}),
+                "shardIds": list(range(total))}, timeout=30),
                 f"mount ec shards on {source}")
             # 4. spread shards across servers (EcBalance, :199)
             moved = _balance_ec_volume(env, vid, collection, total)
@@ -216,7 +248,7 @@ def _do_ec_encode(env: CommandEnv, vid: int, data_shards: int,
         for url in marked:
             try:
                 http_json("POST", f"{url}/admin/set_readonly",
-                          {"volumeId": vid, "readOnly": False})
+                          {"volumeId": vid, "readOnly": False}, timeout=30)
             except OSError:
                 pass
         raise
@@ -224,7 +256,7 @@ def _do_ec_encode(env: CommandEnv, vid: int, data_shards: int,
     # shard mounted at its destination
     for loc in locations:
         http_json("POST", f"{loc['url']}/admin/delete_volume",
-                  {"volumeId": vid})
+                  {"volumeId": vid}, timeout=30)
     if mode == "scatter":
         tele = r.get("telemetry") or {}
         dests = len(set((r.get("placement") or {}).values())) or 1
@@ -240,7 +272,8 @@ def _do_ec_encode(env: CommandEnv, vid: int, data_shards: int,
             f"moved {moved} shards, deleted originals")
 
 
-def _plan_ec_placement(env: CommandEnv, vid: int, total: int
+def _plan_ec_placement(env: CommandEnv, vid: int, total: int,
+                       exclude: "frozenset | set" = frozenset()
                        ) -> "dict[int, str]":
     """Placement-first shard->server plan, applying the same rules
     `_balance_ec_volume` would enforce AFTER the fact: spread across
@@ -248,8 +281,17 @@ def _plan_ec_placement(env: CommandEnv, vid: int, total: int
     counts within a rack, and break ties by placement score
     (diskDistributionScore role — anti-correlation with this volume's
     shards weighs heaviest).  Computing this BEFORE encode is what
-    lets scatter stream every shard to its final home in one hop."""
+    lets scatter stream every shard to its final home in one hop.
+
+    Robustness: nodes in `exclude` (destinations a previous attempt
+    watched fail) and nodes whose circuit breaker is OPEN in this
+    process's health map (util/retry) are never chosen — a tripped
+    destination is planned around, not rediscovered the hard way
+    mid-stripe."""
+    from ..util import retry as _retry
     nodes = _all_node_urls(env)
+    nodes = [n for n in nodes
+             if n not in exclude and _retry.peer_available(n)]
     if not nodes:
         raise RuntimeError("no alive volume servers to place shards")
     vl = env.volume_list()   # one topology fetch for both helpers
@@ -422,7 +464,7 @@ def _move_shard(env: CommandEnv, vid: int, collection: str, sid: int,
             f"{source}/admin/volume_file?volumeId={vid}"
             f"&collection={collection}&ext={ext}",
             "POST", f"{dest}/admin/receive_file?volumeId={vid}"
-            f"&collection={collection}&ext={ext}")
+            f"&collection={collection}&ext={ext}", timeout=600)
         if src_status != 200:
             if ext in (".ecj", ".vif"):
                 continue
@@ -435,7 +477,7 @@ def _move_shard(env: CommandEnv, vid: int, collection: str, sid: int,
                 f"{dst_status} {body[:200]!r}")
     _must(http_json("POST", f"{dest}/admin/ec/mount",
                     {"volumeId": vid, "collection": collection,
-                     "shardIds": [sid]}),
+                     "shardIds": [sid]}, timeout=30),
           f"mount shard {vid}.{sid} on {dest}")
     _delete_shards(source, vid, collection, [sid])
 
@@ -445,7 +487,7 @@ def _delete_shards(url: str, vid: int, collection: str,
     """The server refreshes its mounted shard set + heartbeat itself."""
     http_json("POST", f"{url}/admin/ec/delete_shards",
               {"volumeId": vid, "collection": collection,
-               "shardIds": sids})
+               "shardIds": sids}, timeout=30)
 
 
 @command("ec.decode")
@@ -471,7 +513,7 @@ def cmd_ec_decode(env: CommandEnv, args: list[str]) -> str:
                 "volumeId": vid, "collection": collection,
                 "shardIds": need, "sourceDataNode": url,
                 "copyEcxFile": False, "copyEcjFile": True,
-                "copyVifFile": False})
+                "copyVifFile": False}, timeout=30)
             have.update(need)
     r = http_json("POST", f"{target}/admin/ec/to_volume",
                   {"volumeId": vid, "collection": collection},
@@ -521,7 +563,7 @@ def _rebuild_one(env: CommandEnv, vid: int, collection: str,
     present = sorted({s for sids in shard_locs.values() for s in sids})
     info = None
     for url in shard_locs:
-        r = http_json("GET", f"{url}/admin/ec/info?volumeId={vid}")
+        r = http_json("GET", f"{url}/admin/ec/info?volumeId={vid}", timeout=30)
         if "error" not in r:
             info = r
             break
@@ -551,12 +593,12 @@ def _rebuild_one(env: CommandEnv, vid: int, collection: str,
                     "shardIds": need, "sourceDataNode": url,
                     "copyEcxFile": sidecars_pending,
                     "copyEcjFile": sidecars_pending,
-                    "copyVifFile": sidecars_pending})
+                    "copyVifFile": sidecars_pending}, timeout=30)
                 sidecars_pending = False
                 have.update(need)
         r = http_json("POST", f"{rebuilder}/admin/ec/rebuild",
                       {"volumeId": vid, "collection": collection,
-                       "mode": "local"})
+                       "mode": "local"}, timeout=30)
     else:
         # streaming: hand the rebuilder every survivor's locations and
         # let it range-read slices off its peers — zero /admin/ec/copy
@@ -574,7 +616,7 @@ def _rebuild_one(env: CommandEnv, vid: int, collection: str,
         raise RuntimeError(f"rebuild: {r['error']}")
     http_json("POST", f"{rebuilder}/admin/ec/mount",
               {"volumeId": vid, "collection": collection,
-               "shardIds": r["rebuiltShardIds"]})
+               "shardIds": r["rebuiltShardIds"]}, timeout=30)
     moved = _balance_ec_volume(env, vid, collection, total)
     msg = (f"volume {vid}: rebuilt shards {r['rebuiltShardIds']} on "
            f"{rebuilder}, rebalanced {moved}")
@@ -597,7 +639,8 @@ def cmd_ec_balance(env: CommandEnv, args: list[str]) -> str:
     for vid in _ec_volumes(env):
         info = None
         for url in _ec_shard_locations(env, vid):
-            r = http_json("GET", f"{url}/admin/ec/info?volumeId={vid}")
+            r = http_json("GET", f"{url}/admin/ec/info?volumeId={vid}",
+                    timeout=30)
             if "error" not in r:
                 info = r
                 break
@@ -621,7 +664,7 @@ def _copy_volume_files(env: CommandEnv, vid: int, collection: str,
             f"{src}/admin/volume_file?volumeId={vid}"
             f"&collection={collection}&ext={ext}",
             "POST", f"{dst}/admin/receive_file?volumeId={vid}"
-            f"&collection={collection}&ext={ext}")
+            f"&collection={collection}&ext={ext}", timeout=600)
         if src_status != 200:
             if ext == ".vif":
                 continue
@@ -636,19 +679,19 @@ def _move_volume(env: CommandEnv, vid: int, collection: str,
     """shell/command_volume_move.go pipeline: freeze, copy, mount,
     delete source."""
     _must(http_json("POST", f"{src}/admin/set_readonly",
-                    {"volumeId": vid, "readOnly": True}),
+                    {"volumeId": vid, "readOnly": True}, timeout=30),
           f"set readonly on {src}")
     _copy_volume_files(env, vid, collection, src, dst)
     _must(http_json("POST", f"{dst}/admin/mount_volume",
-                    {"volumeId": vid, "collection": collection}),
+                    {"volumeId": vid, "collection": collection}, timeout=30),
           f"mount on {dst}")
     if delete_source:
         _must(http_json("POST", f"{src}/admin/delete_volume",
-                        {"volumeId": vid}),
+                        {"volumeId": vid}, timeout=30),
               f"delete source on {src}")
     else:
         _must(http_json("POST", f"{src}/admin/set_readonly",
-                        {"volumeId": vid, "readOnly": False}),
+                        {"volumeId": vid, "readOnly": False}, timeout=30),
               f"clear readonly on {src}")
 
 
@@ -717,7 +760,8 @@ def cmd_volume_fix_replication(env: CommandEnv, args: list[str]) -> str:
                                locs[0], dst)
             _must(http_json("POST", f"{dst}/admin/mount_volume",
                             {"volumeId": vid,
-                             "collection": v.get("collection", "")}),
+                             "collection": v.get("collection", "")},
+                      timeout=30),
                   f"mount on {dst}")
             fixed.append(f"{vid}->{dst}")
     return f"fixed replicas: {fixed}" if fixed else \
@@ -733,7 +777,7 @@ def cmd_ec_scrub(env: CommandEnv, args: list[str]) -> str:
     for vid in _ec_volumes(env):
         for url in _ec_shard_locations(env, vid):
             r = http_json("POST", f"{url}/admin/ec/scrub",
-                          {"volumeId": vid, "mode": mode})
+                          {"volumeId": vid, "mode": mode}, timeout=30)
             if r.get("error"):
                 out.append(f"volume {vid} @ {url}: ERROR {r['error']}")
             else:
@@ -750,7 +794,7 @@ def cmd_ec_scrub(env: CommandEnv, args: list[str]) -> str:
 def _cluster_debug_nodes(env: CommandEnv) -> list[str]:
     """Every node that may hold spans of a trace: master(s), every
     volume server, and the filer when the shell knows one."""
-    r = master_json(env.master, "GET", "/cluster/status")
+    r = master_json(env.master, "GET", "/cluster/status", timeout=30)
     nodes = [env.master]
     nodes += [p for p in r.get("peers", []) if p not in nodes]
     nodes += r.get("dataNodes", [])
@@ -835,6 +879,49 @@ def render_trace(spans: "list[dict]") -> str:
     return "\n".join(lines)
 
 
+def collect_peer_health(env: CommandEnv,
+                        extra_nodes: "list[str] | None" = None
+                        ) -> "list[str]":
+    """Every node's /debug/health (util/retry breaker map + budget),
+    rendered one line per non-closed peer — the view that makes a
+    chaos run debuggable from the shell: which node has stopped
+    talking to which peer, and why."""
+    try:
+        nodes = _cluster_debug_nodes(env)
+    except OSError:
+        nodes = [env.master]
+    for n in extra_nodes or []:
+        if n not in nodes:
+            nodes.append(n)
+
+    def fetch(url: str):
+        # best-effort probe: keep the budget per node tight — this
+        # runs mid-incident, when a wedged node would otherwise stall
+        # the whole shell command for its full timeout x retries
+        try:
+            r = http_json("GET", f"{url}/debug/health", timeout=3)
+        except OSError:
+            return url, None
+        return url, r if isinstance(r, dict) else None
+
+    lines: list[str] = []
+    with ThreadPoolExecutor(max_workers=min(8, len(nodes))) as ex:
+        for url, r in ex.map(fetch, nodes):
+            if not r:
+                continue
+            for peer, h in (r.get("peers") or {}).items():
+                if h.get("state") == "closed" and not h.get("trips"):
+                    continue
+                lines.append(
+                    f"  {url}: peer {peer} {h.get('state')} "
+                    f"(consecutive failures "
+                    f"{h.get('consecutiveFailures', 0)}, trips "
+                    f"{h.get('trips', 0)})"
+                    + (f" last: {h['lastError']}"
+                       if h.get("lastError") else ""))
+    return lines
+
+
 @command("trace.show")
 def cmd_trace_show(env: CommandEnv, args: list[str]) -> str:
     """Assemble one request's spans from every cluster node's
@@ -843,16 +930,33 @@ def cmd_trace_show(env: CommandEnv, args: list[str]) -> str:
     (tracing.py; the operator entry point of the tracing plane).
     `-nodes=host:port[,...]` queries extra debug planes the topology
     doesn't know — e.g. the admin server, which holds ingested worker
-    job spans."""
+    job spans.  When the trace shows failure activity (retry.* or
+    error spans) — or always with `-health` — a "peer health" section
+    is appended from every node's /debug/health, so retry stalls in
+    the tree line up with the breaker that caused them; a clean trace
+    skips that second cluster-wide fan-out (mid-incident, wedged
+    nodes make every extra probe a stall)."""
     rids = [a for a in args if not a.startswith("-")]
     opts = _parse_flags(args)
     extra = [n.strip() for n in opts.get("nodes", "").split(",")
              if n.strip()]
     if not rids:
-        return "usage: trace.show <request_id> [-nodes=host:port,...]"
-    return "\n".join(
-        render_trace(collect_trace(env, rid, extra_nodes=extra))
-        for rid in rids)
+        return "usage: trace.show <request_id> [-nodes=host:port,...]" \
+               " [-health]"
+    traces = [collect_trace(env, rid, extra_nodes=extra)
+              for rid in rids]
+    out = [render_trace(spans) for spans in traces]
+    want_health = "health" in opts or any(
+        str(s.get("name", "")).startswith("retry.") or s.get("error")
+        for spans in traces for s in spans)
+    if want_health:
+        health = collect_peer_health(env, extra_nodes=extra)
+        if health:
+            out.append("peer health (non-closed breakers):")
+            out.extend(health)
+        else:
+            out.append("peer health: all breakers closed")
+    return "\n".join(out)
 
 
 @command("volume.scrub")
@@ -867,7 +971,7 @@ def cmd_volume_scrub(env: CommandEnv, args: list[str]) -> str:
             continue
         for url in urls:
             r = http_json("POST", f"{url}/admin/scrub",
-                          {"volumeId": vid})
+                          {"volumeId": vid}, timeout=30)
             if r.get("error"):
                 out.append(f"volume {vid} @ {url}: ERROR {r['error']}")
             else:
@@ -919,7 +1023,7 @@ def _ec_shard_locations(env: CommandEnv, vid: int) -> dict[str, list[int]]:
 
 
 def _all_node_urls(env: CommandEnv) -> list[str]:
-    r = master_json(env.master, "GET", "/cluster/status")
+    r = master_json(env.master, "GET", "/cluster/status", timeout=30)
     return r.get("dataNodes", [])
 
 
@@ -1005,7 +1109,7 @@ def cmd_volume_move(env: CommandEnv, args: list[str]) -> str:
         # need its copy verified — just drop the source replica
         _must(http_json("POST", f"{src}/admin/delete_volume",
                         {"volumeId": vid,
-                         "collection": collection}),
+                         "collection": collection}, timeout=30),
               f"delete on {src}")
     else:
         _move_volume(env, vid, collection, src, dst,
@@ -1021,7 +1125,7 @@ def cmd_volume_grow(env: CommandEnv, args: list[str]) -> str:
     r = master_json(env.master, "POST", "/vol/grow", {
         "collection": opts.get("collection", ""),
         "replication": opts.get("replication", ""),
-        "count": int(opts.get("count", 1))})
+        "count": int(opts.get("count", 1))}, timeout=30)
     if "volumeIds" not in r:
         return f"grow failed: {r}"
     return f"grew volumes: {r['volumeIds']}"
@@ -1062,7 +1166,7 @@ def cmd_collection_delete(env: CommandEnv, args: list[str]) -> str:
             continue
         _must(http_json("POST", f"{node['url']}/admin/delete_volume",
                         {"volumeId": v["id"],
-                         "collection": name}),
+                         "collection": name}, timeout=30),
               f"delete {v['id']} on {node['url']}")
         deleted.append(v["id"])
     # EC volumes of the collection too (the Go analog deletes both)
@@ -1080,7 +1184,7 @@ def cmd_collection_delete(env: CommandEnv, args: list[str]) -> str:
                         f"{node['url']}/admin/ec/delete_shards",
                         {"volumeId": e["volumeId"],
                          "collection": name,
-                         "shardIds": shard_ids}),
+                         "shardIds": shard_ids}, timeout=30),
                         f"delete ec {e['volumeId']} on "
                         f"{node['url']}")
                     ec_deleted.append(e["volumeId"])
@@ -1115,32 +1219,32 @@ def cmd_volume_merge(env: CommandEnv, args: list[str]) -> str:
     primary, others = urls[0], urls[1:]
     for url in urls:
         _must(http_json("POST", f"{url}/admin/set_readonly",
-                        {"volumeId": vid, "readOnly": True}),
+                        {"volumeId": vid, "readOnly": True}, timeout=30),
               f"set readonly on {url}")
     try:
         r = _must(http_json(
             "POST", f"{primary}/admin/volume/merge",
             {"volumeId": vid, "collection": collection,
-             "peers": others}), f"merge on {primary}")
+             "peers": others}, timeout=30), f"merge on {primary}")
         # replace the other replicas with the merged copy
         for url in others:
             _must(http_json("POST", f"{url}/admin/delete_volume",
-                            {"volumeId": vid}),
+                            {"volumeId": vid}, timeout=30),
                   f"drop stale replica on {url}")
             _copy_volume_files(env, vid, collection, primary, url)
             _must(http_json("POST", f"{url}/admin/mount_volume",
                             {"volumeId": vid,
-                             "collection": collection}),
+                             "collection": collection}, timeout=30),
                   f"mount merged on {url}")
             _must(http_json("POST", f"{url}/admin/set_readonly",
-                            {"volumeId": vid, "readOnly": True}),
+                            {"volumeId": vid, "readOnly": True}, timeout=30),
                   f"re-freeze merged on {url}")
     finally:
         if was_writable:
             for url in urls:
                 try:
                     http_json("POST", f"{url}/admin/set_readonly",
-                              {"volumeId": vid, "readOnly": False})
+                              {"volumeId": vid, "readOnly": False}, timeout=30)
                 except OSError:
                     pass
     return (f"volume {vid}: merged {len(urls)} replicas "

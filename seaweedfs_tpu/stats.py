@@ -121,6 +121,19 @@ class Metrics:
         return "\n".join(out) + "\n"
 
 
+# process-wide registry for cross-cutting planes that predate any one
+# role's registry: unified retry/backoff (util/retry), the per-peer
+# circuit breakers, failpoint triggers (faults.py), and EC degraded-
+# read/failover counters.  Every role's /metrics appends its
+# exposition (render_process) after the role registry's own — the
+# namespaces differ, so the two blocks never collide.
+PROCESS = Metrics("seaweedfs_tpu")
+
+
+def render_process() -> str:
+    return PROCESS.render()
+
+
 class MetricsPusher:
     """LoopPushingMetric (metrics.go:534): periodically PUT the
     rendered registry to a Prometheus pushgateway at

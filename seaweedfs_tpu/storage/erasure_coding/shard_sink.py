@@ -264,6 +264,7 @@ class RemoteShardSink(ShardSink):
         self.crc = 0
         self._committed = False
         self._finished = False
+        self._truncated = False  # armed truncate fault fired mid-send
         self._stats: "ScatterStats | None" = None
         # span context of the caller (the scatter handler): the send
         # thread emits one per-destination stream span, and the
@@ -337,6 +338,7 @@ class RemoteShardSink(ShardSink):
         queue until the None sentinel.  Wire time per window (the gap
         between yields, minus queue wait) is recorded so a slow codec
         never shows up as a slow destination."""
+        from ... import faults
         while True:
             try:
                 item = self._q.get(timeout=0.2)
@@ -347,6 +349,26 @@ class RemoteShardSink(ShardSink):
             if item is None:
                 return
             buf, n = item
+            directive = faults.fire("ec.encode.window", key=self.url)
+            if directive == "truncate":
+                # stop mid-shard with CLEAN chunked framing: the
+                # receiver banks a short stream, and the commit
+                # handshake's byte-count/CRC verify MUST refuse it.
+                # _truncated lets the send loop turn the premature end
+                # into a dest-attributed error once the response is in
+                self._truncated = True
+                self._pool.put(buf)
+                return
+            if directive == "drop":
+                # FaultInjected (not plain OSError) so
+                # http_stream_request skips its receiver-verdict probe
+                # — with both ends alive that probe would block on a
+                # receiver still waiting for chunks — and tears the
+                # connection down instead
+                self._pool.put(buf)
+                raise faults.FaultInjected(
+                    f"shard_write {self.vid}.{self.sid} -> "
+                    f"{self.url}: fault-injected drop")
             t0 = time.perf_counter()
             yield memoryview(buf)[:n]
             if self._stats is not None:
@@ -389,6 +411,16 @@ class RemoteShardSink(ShardSink):
                 raise OSError(
                     f"shard_write {self.vid}.{self.sid} -> {self.url}: "
                     f"HTTP {status} {self._response.get('error', '')}")
+            if self._truncated:
+                # the armed truncation ended the stream early with
+                # clean framing; the receiver banked a short upload —
+                # surface it as this DESTINATION's failure so the
+                # caller aborts (and can re-plan around the dest)
+                # instead of discovering the mismatch only at finish()
+                raise OSError(
+                    f"shard_write {self.vid}.{self.sid} -> {self.url}: "
+                    f"stream truncated at "
+                    f"{self._response.get('bytes')} bytes")
         except _SinkAborted:
             pass
         except BaseException as e:  # noqa: BLE001 — re-raised by the

@@ -164,12 +164,14 @@ class RemoteShardSource(ShardSource):
                     timeout=self._timeout, headers=self._headers())
             except OSError as e:
                 last = repr(e)
+                self._count_failover(url)
                 continue
             if status == 200 and len(body) <= n:
                 # short only at EOF; the pipeline zero-pads
                 self.label = url
                 return body
             last = f"HTTP {status} ({len(body)} bytes)"
+            self._count_failover(url)
         raise OSError(
             f"shard {self.vid}.{self.sid} slice @{pos}+{n}: every "
             f"source failed, last: {last}")
@@ -228,6 +230,7 @@ class RemoteShardSource(ShardSource):
         donor."""
         if not work:
             return
+        from ... import faults
         end = work[-1][0] + work[-1][1]
         i = 0
         conn = resp = None
@@ -255,6 +258,7 @@ class RemoteShardSource(ShardSource):
                             url, pos, end - pos)
                     except OSError:
                         failures += 1
+                        self._count_failover(url)
                         if failures > budget:
                             raise
                         continue
@@ -272,6 +276,17 @@ class RemoteShardSource(ShardSource):
                     buf = take_buf(n)
                 t0 = time.perf_counter()
                 try:
+                    # armed `ec.rebuild.slice` faults surface HERE so
+                    # they ride the real failover machinery: error and
+                    # drop read as a dead donor (resume this window
+                    # from the next url), truncate as a donor that
+                    # closed early with clean framing
+                    directive = faults.fire("ec.rebuild.slice",
+                                            key=self.label)
+                    if directive is not None:
+                        raise OSError(
+                            f"shard_read {self.label}: fault-injected "
+                            f"{directive} mid-stream")
                     got = self._read_exact_into(resp, buf, expect)
                     if got < expect:
                         raise OSError(
@@ -282,6 +297,7 @@ class RemoteShardSource(ShardSource):
                     conn.close()
                     conn = resp = None
                     failures += 1
+                    self._count_failover(self.label)
                     if failures > budget:
                         raise
                     continue
@@ -300,6 +316,15 @@ class RemoteShardSource(ShardSource):
         finally:
             if conn is not None:
                 conn.close()
+
+    @staticmethod
+    def _count_failover(url: str) -> None:
+        from ... import stats
+        stats.PROCESS.counter_add(
+            "ec_read_source_failovers_total", 1.0,
+            help_text="EC reads that abandoned a shard source "
+                      "(transport failure, short body, open breaker)",
+            peer=url)
 
     @staticmethod
     def _read_exact_into(resp, buf, n: int) -> int:

@@ -76,8 +76,8 @@ class TelemetryClient:
             "instanceId": self.instance_id,
         }
         try:
-            status = http_json("GET", f"{master}/cluster/status")
-            vols = http_json("GET", f"{master}/vol/list")
+            status = http_json("GET", f"{master}/cluster/status", timeout=30)
+            vols = http_json("GET", f"{master}/vol/list", timeout=30)
             data["clusterId"] = status.get("topologyId", "")
             # a healthy single-master cluster reports `peers: []` —
             # the answering master IS a master, so the count floors
@@ -112,7 +112,7 @@ class TelemetryClient:
             st, _, _ = http_bytes(
                 "POST", self.url, json.dumps(
                     self.collect(master)).encode(),
-                {"Content-Type": "application/json"})
+                {"Content-Type": "application/json"}, timeout=60)
             return st < 300
         except OSError:
             return False

@@ -31,7 +31,8 @@ def fetch_ec_shard_locations(master: str, vid: int
     parser for that payload (shell, repair worker, and the streaming
     rebuild handler all consume it)."""
     from ..operation import master_json
-    r = master_json(master, "GET", f"/dir/ec_lookup?volumeId={vid}")
+    r = master_json(master, "GET", f"/dir/ec_lookup?volumeId={vid}",
+            timeout=30)
     if "error" in r:
         return {}
     return {loc["url"]: loc["shardIds"]

@@ -1,0 +1,413 @@
+"""One retry policy for the whole data plane, plus the per-peer
+health map / circuit breaker every client funnel consults.
+
+Before this module each caller invented its own failure handling:
+`httpd._pooled_request` retried once on a dead socket, `shard_source`
+had ad-hoc mid-stream failover, `MasterFollower._run` fixed-slept,
+`store_ec._remote_read` improvised — and nothing ever *stopped*
+hammering a peer that was down.  This module gives them one
+vocabulary:
+
+* **retry_call**: capped exponential backoff with FULL jitter
+  (delay ~ U(0, min(cap, base * 2^attempt)) — the AWS-architecture
+  shape: synchronized retry storms from N clients decorrelate), only
+  for idempotent work (the caller declares it; `_one_pooled_request`'s
+  POST send-failed rule stays where it is), drawing on a per-process
+  **retry budget** so a dying dependency costs bounded extra load;
+
+* **per-peer circuit breaker**: consecutive transport failures trip a
+  peer OPEN (calls fail fast with BreakerOpen instead of burning a
+  timeout each), a cooldown later ONE half-open probe is let through —
+  success closes the breaker, failure re-opens it.  Consulted by the
+  pooled HTTP client, gRPC stubs, the master follower, `store_ec`
+  remote shard reads, and the `ec.encode` scatter planner (a tripped
+  destination is re-planned, not failed on).
+
+Knobs (all env):
+
+  SEAWEEDFS_TPU_RETRY_MAX_ATTEMPTS   total attempts per call (3)
+  SEAWEEDFS_TPU_RETRY_BASE_MS        first backoff ceiling (50)
+  SEAWEEDFS_TPU_RETRY_CAP_MS         backoff ceiling (2000)
+  SEAWEEDFS_TPU_RETRY_BUDGET         retry-token bucket size (64)
+  SEAWEEDFS_TPU_RETRY_BUDGET_REFILL  tokens refilled per second (4)
+  SEAWEEDFS_TPU_BREAKER_THRESHOLD    consecutive failures to trip (5)
+  SEAWEEDFS_TPU_BREAKER_COOLDOWN_MS  open time before a probe (2000)
+
+Every retry and every breaker transition is observable: a
+`retry.<site>` span rides the active trace (trace.show shows the
+stall next to the hop that caused it) and `retry_attempts_total{site}`
+/ `peer_breaker_state{peer}` land in the shared stats.PROCESS
+registry that every role's /metrics appends.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+
+class BreakerOpen(OSError):
+    """Fail-fast refusal: the peer's breaker is open.  An OSError so
+    existing transport-failure handling (failover, unwind, error
+    bodies) applies; catch it specifically to re-plan instead."""
+
+    def __init__(self, peer: str, retry_after: float):
+        super().__init__(
+            f"breaker open for peer {peer} "
+            f"(retry in {retry_after:.1f}s)")
+        self.peer = peer
+        self.retry_after = retry_after
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def max_attempts() -> int:
+    return max(1, _env_int("SEAWEEDFS_TPU_RETRY_MAX_ATTEMPTS", 3))
+
+
+def backoff_base() -> float:
+    return _env_float("SEAWEEDFS_TPU_RETRY_BASE_MS", 50.0) / 1e3
+
+
+def backoff_cap() -> float:
+    return _env_float("SEAWEEDFS_TPU_RETRY_CAP_MS", 2000.0) / 1e3
+
+
+def breaker_threshold() -> int:
+    return max(1, _env_int("SEAWEEDFS_TPU_BREAKER_THRESHOLD", 5))
+
+
+def breaker_cooldown() -> float:
+    return _env_float("SEAWEEDFS_TPU_BREAKER_COOLDOWN_MS", 2000.0) / 1e3
+
+
+def backoff_delay(attempt: int, base: "float | None" = None,
+                  cap: "float | None" = None,
+                  rng: "random.Random | None" = None) -> float:
+    """Full-jitter delay for retry number `attempt` (1-based)."""
+    base = backoff_base() if base is None else base
+    cap = backoff_cap() if cap is None else cap
+    ceiling = min(cap, base * (2 ** max(attempt - 1, 0)))
+    return (rng or random).uniform(0, ceiling)
+
+
+# -- per-process retry budget (token bucket) ------------------------------
+#
+# Retries multiply load exactly when the system is least able to absorb
+# it; the budget caps process-wide retry *rate* so a dying dependency
+# costs a bounded amount of extra traffic, after which callers fail
+# fast until the bucket refills.
+
+class _Budget:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tokens: "float | None" = None
+        self._stamp = 0.0
+
+    def _capacity(self) -> float:
+        return float(max(0, _env_int("SEAWEEDFS_TPU_RETRY_BUDGET", 64)))
+
+    def _refill_rate(self) -> float:
+        return max(0.0,
+                   _env_float("SEAWEEDFS_TPU_RETRY_BUDGET_REFILL", 4.0))
+
+    def take(self) -> bool:
+        now = time.monotonic()
+        cap = self._capacity()
+        with self._lock:
+            if self._tokens is None:
+                self._tokens = cap
+            else:
+                self._tokens = min(
+                    cap, self._tokens +
+                    (now - self._stamp) * self._refill_rate())
+            self._stamp = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def remaining(self) -> float:
+        with self._lock:
+            return self._capacity() if self._tokens is None \
+                else self._tokens
+
+    def reset(self) -> None:
+        with self._lock:
+            self._tokens = None
+            self._stamp = 0.0
+
+
+_budget = _Budget()
+
+
+def budget_take() -> bool:
+    ok = _budget.take()
+    if not ok:
+        _metrics().counter_add(
+            "retry_budget_exhausted_total", 1.0,
+            help_text="retries refused by the process retry budget")
+    _metrics().gauge_set(
+        "retry_budget_remaining", _budget.remaining(),
+        help_text="retry tokens left in the process budget")
+    return ok
+
+
+def budget_remaining() -> float:
+    return _budget.remaining()
+
+
+# -- per-peer circuit breaker ---------------------------------------------
+
+CLOSED, HALF_OPEN, OPEN = "closed", "half-open", "open"
+_STATE_GAUGE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+
+class _Breaker:
+    __slots__ = ("peer", "failures", "state", "opened_at", "probing",
+                 "probe_started", "trips", "last_error")
+
+    def __init__(self, peer: str):
+        self.peer = peer
+        self.failures = 0
+        self.state = CLOSED
+        self.opened_at = 0.0
+        self.probing = False
+        self.probe_started = 0.0
+        self.trips = 0
+        self.last_error = ""
+
+
+_breakers: "dict[str, _Breaker]" = {}
+_breakers_lock = threading.Lock()
+
+
+def _breaker(peer: str) -> _Breaker:
+    b = _breakers.get(peer)
+    if b is None:
+        b = _breakers.setdefault(peer, _Breaker(peer))
+    return b
+
+
+def _gauge_state_value(peer: str, state: str) -> None:
+    _metrics().gauge_set(
+        "peer_breaker_state", _STATE_GAUGE[state],
+        help_text="per-peer circuit state (0 closed, 1 half-open, "
+                  "2 open)", peer=peer)
+
+
+def check_peer(peer: str) -> None:
+    """Raise BreakerOpen when the peer is open and its cooldown has
+    not elapsed; move open -> half-open (admitting THIS caller as the
+    single probe) when it has.  No-op for closed/unknown peers."""
+    if not peer:
+        return
+    transitioned = None
+    with _breakers_lock:
+        b = _breakers.get(peer)
+        if b is None or b.state == CLOSED:
+            return
+        if b.state == OPEN:
+            wait = b.opened_at + breaker_cooldown() - time.monotonic()
+            if wait > 0:
+                raise BreakerOpen(peer, wait)
+            b.state = HALF_OPEN
+            b.probing = True
+            b.probe_started = time.monotonic()
+            transitioned = b.state
+        elif b.probing:
+            # half-open: exactly one probe in flight at a time — but a
+            # probe whose caller died without a verdict (an exception
+            # outside the recorded set, a killed thread) must not
+            # blacklist the peer forever, so a stale slot is reclaimed
+            # by THIS caller after the probe TTL
+            if time.monotonic() - b.probe_started > _probe_ttl():
+                b.probe_started = time.monotonic()
+            else:
+                raise BreakerOpen(peer, breaker_cooldown())
+        else:
+            b.probing = True
+            b.probe_started = time.monotonic()
+    if transitioned:
+        _gauge_state_value(peer, transitioned)
+
+
+def _probe_ttl() -> float:
+    """How long a half-open probe may stay unresolved before its slot
+    is reclaimed: generous enough for any sane call timeout, bounded
+    so an abandoned probe can't wedge the breaker."""
+    return max(breaker_cooldown() * 2, 120.0)
+
+
+def probe_release(peer: str) -> None:
+    """Give back a half-open probe slot WITHOUT a health verdict —
+    the probe call failed for a non-transport reason (serialization
+    error, programming bug), which proves nothing about the peer.
+    The next caller is admitted as a fresh probe."""
+    if not peer:
+        return
+    with _breakers_lock:
+        b = _breakers.get(peer)
+        if b is not None and b.probing:
+            b.probing = False
+
+
+def record_success(peer: str) -> None:
+    if not peer:
+        return
+    changed = False
+    with _breakers_lock:
+        b = _breakers.get(peer)
+        if b is None:
+            return
+        changed = b.state != CLOSED
+        b.failures = 0
+        b.state = CLOSED
+        b.probing = False
+        b.last_error = ""
+    if changed:
+        _gauge_state_value(peer, CLOSED)
+
+
+def record_failure(peer: str, error: str = "") -> None:
+    if not peer:
+        return
+    tripped = None
+    with _breakers_lock:
+        b = _breaker(peer)
+        b.failures += 1
+        b.probing = False
+        if error:
+            b.last_error = error[:200]
+        if b.state == HALF_OPEN or (b.state == CLOSED and
+                                    b.failures >= breaker_threshold()):
+            b.state = OPEN
+            b.opened_at = time.monotonic()
+            b.trips += 1
+            tripped = (b.failures, b.last_error)
+    if tripped is not None:
+        _gauge_state_value(peer, OPEN)
+        _metrics().counter_add(
+            "peer_breaker_trips_total", 1.0,
+            help_text="breaker close->open transitions", peer=peer)
+        from . import wlog
+        wlog.warning(
+            f"peer breaker OPEN for {peer} after {tripped[0]} "
+            f"consecutive failures"
+            + (f" (last: {tripped[1]})" if tripped[1] else ""))
+
+
+def peer_state(peer: str) -> str:
+    with _breakers_lock:
+        b = _breakers.get(peer)
+        if b is None:
+            return CLOSED
+        if b.state == OPEN and \
+                time.monotonic() >= b.opened_at + breaker_cooldown():
+            return HALF_OPEN  # a probe would be admitted
+        return b.state
+
+
+def peer_available(peer: str) -> bool:
+    """Planner-facing: False only while the peer is open with cooldown
+    remaining (half-open peers are probe-worthy)."""
+    return peer_state(peer) != OPEN
+
+
+def health_snapshot() -> "dict[str, dict]":
+    """JSON-able per-peer health for /debug/health and trace.show."""
+    with _breakers_lock:
+        return {
+            peer: {"state": b.state, "consecutiveFailures": b.failures,
+                   "trips": b.trips, "lastError": b.last_error}
+            for peer, b in sorted(_breakers.items())
+            if b.state != CLOSED or b.trips or b.failures}
+
+
+def reset(peer: "str | None" = None) -> None:
+    """Forget breaker state (and refill the budget when peer is None)
+    — test isolation between chaos scenarios."""
+    with _breakers_lock:
+        if peer is None:
+            _breakers.clear()
+        else:
+            _breakers.pop(peer, None)
+    if peer is None:
+        _budget.reset()
+
+
+# -- the one retry loop ---------------------------------------------------
+
+def _metrics():
+    from .. import stats
+    return stats.PROCESS
+
+
+def _note_retry(site: str, peer: str, attempt: int, error: str,
+                delay: float) -> None:
+    _metrics().counter_add(
+        "retry_attempts_total", 1.0,
+        help_text="re-issued attempts after a transport failure",
+        site=site or "?")
+    # trace annotation: a zero-work span covering the backoff sleep,
+    # parented under whatever span the caller is in — trace.show then
+    # shows the retry (and which peer caused it) inline
+    from .. import tracing
+    tracing.emit_span(
+        f"retry.{site or 'call'}", time.time(), delay,
+        attrs={"attempt": attempt, "peer": peer, "error": error[:160]},
+        error=False)
+
+
+def retry_call(fn, site: str = "", peer: str = "",
+               idempotent: bool = True, attempts: "int | None" = None,
+               base: "float | None" = None, cap: "float | None" = None,
+               retry_on: tuple = (OSError,)):
+    """Run `fn()` under the unified policy.
+
+    Consults the peer's breaker before every attempt (BreakerOpen
+    fails fast and is never retried here — the peer told us to go
+    away), records success/failure to the health map, and re-issues
+    only idempotent work, spending one retry-budget token per
+    re-issue.  `fn` must be safe to call `attempts` times."""
+    attempts = max_attempts() if attempts is None else max(1, attempts)
+    last: "BaseException | None" = None
+    for attempt in range(1, attempts + 1):
+        check_peer(peer)
+        try:
+            result = fn()
+        except BreakerOpen:
+            raise
+        except retry_on as e:
+            record_failure(peer, repr(e))
+            last = e
+            if not idempotent or attempt >= attempts or \
+                    not budget_take():
+                raise
+            delay = backoff_delay(attempt, base, cap)
+            _note_retry(site, peer, attempt, repr(e), delay)
+            time.sleep(delay)
+            continue
+        except BaseException:
+            # non-transport failure (bad payload, programming error):
+            # no verdict on the peer, but a held half-open probe slot
+            # must be returned or the breaker wedges open forever
+            probe_release(peer)
+            raise
+        record_success(peer)
+        return result
+    raise last  # pragma: no cover — loop always returns or raises

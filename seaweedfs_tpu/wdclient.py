@@ -67,7 +67,9 @@ class MasterFollower:
 
     def _run(self) -> None:
         from .operation import master_json
+        from .util import retry as _retry
         cursor = -1
+        failures = 0
         while not self._stop.is_set():
             try:
                 if cursor < 0:
@@ -80,6 +82,7 @@ class MasterFollower:
                     self._note_leader(r.get("leader"))
                     cursor = int(r.get("cursor", 0))
                     self._synced.set()
+                    failures = 0
                     continue
                 r = master_json(
                     self.master, "GET",
@@ -92,18 +95,28 @@ class MasterFollower:
                     cursor = -1  # resync from a fresh snapshot
                     self._synced.clear()
                     continue
+                failures = 0
                 cursor = int(r.get("cursor", cursor))
                 self._note_leader(r.get("leader"))
                 for ev in r.get("events", []):
                     self._apply_event(ev)
             except (OSError, ValueError):
-                # master unreachable / erroring / failover in progress:
-                # back off, then resync (leadership may have moved, and
-                # a new leader starts a fresh hub — cursors don't carry
-                # over)
+                # master unreachable / erroring / failover in
+                # progress: back off under the unified jittered policy
+                # (util/retry), then resync (leadership may have
+                # moved, and a new leader starts a fresh hub — cursors
+                # don't carry over).  A REFUSED connect fails in
+                # microseconds: the seed's fixed 1s re-poll hammered a
+                # partitioned master and flooded its logs, while the
+                # growing full-jitter delay (0.5s base, 15s cap) also
+                # decorrelates the reconnect stampede when the master
+                # comes back and every follower notices at once.
                 self._synced.clear()
                 cursor = -1
-                self._stop.wait(1.0)
+                failures += 1
+                self._stop.wait(max(
+                    0.05, _retry.backoff_delay(failures, base=0.5,
+                                               cap=15.0)))
 
     def _note_leader(self, leader: "str | None") -> None:
         if leader and leader != self._leader:

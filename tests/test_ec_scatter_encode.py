@@ -206,7 +206,10 @@ def test_scatter_dest_death_aborts_cleanly(cluster3, tmp_path,
 
     real_plan = shell_commands._plan_ec_placement
 
-    def sabotaged_plan(env, vid_, total):
+    def sabotaged_plan(env, vid_, total, **kw):
+        # ignore the re-planner's exclude set: the sabotage must
+        # persist across re-plan attempts so the encode exhausts its
+        # retries and the CLEAN-ABORT path under test actually runs
         placement = real_plan(env, vid_, total)
         placement[13] = dying.url  # one shard routed to the dying dest
         return placement

@@ -1,0 +1,216 @@
+"""Mid-body destination/source death on the two streaming client
+paths: `httpd.http_relay` (the leg `_copy_volume_files` and balance
+moves ride) and `http_stream_request` (the scatter-encode push).
+
+Contract under test: a transfer that dies mid-body must surface as an
+ERROR to the caller — never a truncated-but-clean upload — and must
+leave no finalized file (only removable temps) on the receiving side.
+"""
+
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu import faults
+from seaweedfs_tpu.server.httpd import (HttpServer, http_bytes,
+                                        http_json, http_relay,
+                                        http_stream_request)
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.util import retry
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    faults.reset()
+    retry.reset()
+    yield
+    faults.reset()
+    retry.reset()
+
+
+@pytest.fixture
+def pair(tmp_path):
+    """master + 2 volume servers: A holds a volume with data, B is
+    the relay destination (the `_copy_volume_files` shape)."""
+    master = MasterServer(volume_size_limit_mb=64).start()
+    servers = []
+    for i in range(2):
+        d = tmp_path / f"v{i}"
+        d.mkdir()
+        servers.append(VolumeServer([str(d)], master.url,
+                                    pulse_seconds=0.3).start())
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        r = http_json("GET", f"{master.url}/cluster/status",
+                      timeout=10)
+        if len(r.get("dataNodes", [])) == 2:
+            break
+        time.sleep(0.05)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _volume_on(master, data=b"x" * 50000):
+    """Submit one blob; returns (vid, holder_url) — the relay source
+    must be the server that actually holds the volume."""
+    from seaweedfs_tpu import operation
+    fid = operation.submit(master.url, data)
+    vid = int(fid.split(",")[0])
+    r = http_json("GET",
+                  f"{master.url}/dir/lookup?volumeId={vid}",
+                  timeout=10)
+    return vid, r["locations"][0]["url"]
+
+
+def test_relay_dest_death_mid_body_surfaces_error(pair):
+    """The relay destination reading part of the body then dying must
+    fail the relay — not bank a short file."""
+    master, (a, b) = pair
+    vid, holder = _volume_on(master)
+
+    dying = HttpServer()
+    seen = {"bytes": 0}
+
+    def die_mid_stream(req):
+        for chunk in req.stream_body():
+            seen["bytes"] += len(chunk)
+            raise IOError("dest died mid-relay")
+        return 200, {}
+
+    dying.route("POST", "/admin/receive_file", die_mid_stream)
+    dying.start()
+    try:
+        # either shape is a correct failure: the push socket dies
+        # (OSError) or, if a small body wins the race, the
+        # destination's 500 verdict comes back — NEVER a clean 200
+        try:
+            _src, dst_status, body = http_relay(
+                f"{holder}/admin/volume_file?volumeId={vid}"
+                f"&collection=&ext=.dat",
+                "POST",
+                f"{dying.url}/admin/receive_file?volumeId={vid}"
+                f"&collection=&ext=.dat",
+                chunk_size=4096, timeout=30)
+        except OSError:
+            pass
+        else:
+            assert dst_status != 200, (dst_status, body)
+    finally:
+        dying.stop()
+    assert seen["bytes"] > 0
+
+
+def test_relay_fault_injected_source_death_leaves_no_file(pair):
+    """`httpd.relay.chunk=drop` (the armed stand-in for the SOURCE
+    dying mid-relay) must error the relay and leave the destination
+    volume server with no finalized file and no temps — the exact
+    invariant balance moves depend on."""
+    master, (a, b) = pair
+    vid, holder = _volume_on(master)
+    dest = b if holder == a.http.url else a
+    dest_dir = dest.store.locations[0].directory
+
+    faults.arm("httpd.relay.chunk", "drop", n=1)
+    with pytest.raises(OSError):
+        http_relay(
+            f"{holder}/admin/volume_file?volumeId={vid}"
+            f"&collection=&ext=.dat",
+            "POST",
+            f"{dest.http.url}/admin/receive_file?volumeId=777"
+            f"&collection=&ext=.dat",
+            chunk_size=4096, timeout=30)
+    # nothing finalized, nothing staged
+    names = os.listdir(dest_dir)
+    assert not [p for p in names if p.startswith("777")], names
+    assert not [p for p in names if ".recv." in p], names
+
+
+def test_relay_receiver_fault_no_finalized_file(pair):
+    """The armed receiver-side fault (`volume.receive_file.recv`) on a
+    REAL volume server: the relay reports the destination's 500 and
+    the receiver keeps nothing."""
+    master, (a, b) = pair
+    vid, holder = _volume_on(master)
+    dest = b if holder == a.http.url else a
+    dest_dir = dest.store.locations[0].directory
+
+    faults.arm("volume.receive_file.recv", "error", n=1)
+    src_status, dst_status, body = http_relay(
+        f"{holder}/admin/volume_file?volumeId={vid}"
+        f"&collection=&ext=.dat",
+        "POST",
+        f"{dest.http.url}/admin/receive_file?volumeId=778"
+        f"&collection=&ext=.dat",
+        chunk_size=4096, timeout=30)
+    assert src_status == 200
+    assert dst_status == 500, (dst_status, body)
+    names = os.listdir(dest_dir)
+    assert not [p for p in names if p.startswith("778")], names
+    assert not [p for p in names if ".recv." in p], names
+
+
+def test_stream_request_dest_death_mid_body(pair):
+    """`http_stream_request` against a destination that dies mid-body:
+    the sender must surface the receiver's verdict or an error —
+    never a clean 200 for a partial stream."""
+    dying = HttpServer()
+    seen = {"bytes": 0}
+
+    def die_mid_stream(req):
+        for chunk in req.stream_body():
+            seen["bytes"] += len(chunk)
+            if seen["bytes"] > 8192:
+                raise IOError("receiver died mid-upload")
+        return 200, {"bytes": seen["bytes"]}
+
+    dying.route("POST", "/up", die_mid_stream)
+    dying.start()
+    try:
+        def windows():
+            for _ in range(64):
+                yield b"y" * 4096
+        try:
+            status, _body = http_stream_request(
+                "POST", f"{dying.url}/up", windows(), timeout=30)
+        except OSError:
+            status = 0  # connection torn down mid-body: also correct
+        assert status != 200, "partial stream acked as clean success"
+    finally:
+        dying.stop()
+    assert seen["bytes"] > 8192
+
+
+def test_stream_request_fault_injected_wire_death(pair):
+    """`httpd.stream.chunk=drop` severs the socket mid-upload: the
+    sender errors and the receiving volume server registers nothing
+    for the upload id (shard_write leaves only a removed temp)."""
+    master, (a, b) = pair
+    dest_dir = b.store.locations[0].directory
+    faults.arm("httpd.stream.chunk", "drop", n=1,
+               match=b.http.url)
+
+    def windows():
+        for _ in range(8):
+            yield b"z" * 4096
+
+    with pytest.raises(OSError):
+        http_stream_request(
+            "POST",
+            f"{b.http.url}/admin/ec/shard_write?volumeId=779"
+            f"&shardId=0&collection=&uploadId=deadmid1",
+            windows(), timeout=30)
+    time.sleep(0.2)
+    # the receiver saw a short chunked stream -> error -> temp removed
+    names = os.listdir(dest_dir)
+    assert not [p for p in names if ".scatter." in p], names
+    # commit of the dead upload id finds nothing staged
+    r = http_json("POST",
+                  f"{b.http.url}/admin/ec/shard_write_commit",
+                  {"volumeId": 779, "collection": "",
+                   "uploadId": "deadmid1", "shardId": 0,
+                   "crc32": 0, "bytes": 32768}, timeout=30)
+    assert "error" in r, r

@@ -1,0 +1,220 @@
+"""Unit surface of the robustness plane: faults.py spec
+parsing/arming semantics and util/retry's backoff, budget, and
+per-peer circuit breaker."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu import faults, stats
+from seaweedfs_tpu.util import retry
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    faults.reset()
+    retry.reset()
+    yield
+    faults.reset()
+    retry.reset()
+
+
+# -- faults ---------------------------------------------------------------
+
+def test_spec_parsing_and_actions():
+    n = faults.arm_spec(
+        "a.b=error,n=2; c.d=delay,ms=1 ;e.f=truncate,match=peerX")
+    assert n == 3
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("a.b")
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("a.b")
+    assert faults.fire("a.b") is None          # n exhausted
+    t0 = time.perf_counter()
+    assert faults.fire("c.d") is None          # delay, then continue
+    assert time.perf_counter() - t0 >= 0.001
+    assert faults.fire("e.f", key="zzz") is None      # match miss
+    assert faults.fire("e.f", key="--peerX--") == "truncate"
+    assert faults.triggered() == {"a.b": 2, "c.d": 1, "e.f": 1}
+
+
+def test_spec_rejects_malformed():
+    for bad in ("nosuchshape", "a.b=explode", "a.b=error,zz=1",
+                "a.b=error,p="):
+        with pytest.raises(ValueError):
+            faults.parse_spec(bad)
+
+
+def test_probability_deterministic_with_seed():
+    def fires(seed):
+        faults.reset()
+        faults.arm("p.q", "truncate", p=0.5, seed=seed)
+        return [faults.fire("p.q") is not None for _ in range(32)]
+    a, b = fires(1234), fires(1234)
+    assert a == b, "same seed must fire identically"
+    assert any(a) and not all(a), "p=0.5 should mix hits and misses"
+
+
+def test_unarmed_site_is_free():
+    assert faults.fire("never.armed") is None
+    assert faults.triggered() == {}
+
+
+def test_fault_injected_is_oserror():
+    # transport-failure handlers must treat injected faults like the
+    # real faults they stand in for
+    assert issubclass(faults.FaultInjected, OSError)
+
+
+# -- backoff --------------------------------------------------------------
+
+def test_full_jitter_bounds():
+    base, cap = 0.1, 1.0
+    for attempt in range(1, 8):
+        for _ in range(20):
+            d = retry.backoff_delay(attempt, base, cap)
+            assert 0 <= d <= min(cap, base * 2 ** (attempt - 1))
+
+
+def test_retry_call_retries_idempotent_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry.retry_call(flaky, site="u1", peer="peerA",
+                            base=0.001, cap=0.002) == "ok"
+    assert len(calls) == 3
+    assert retry.peer_state("peerA") == retry.CLOSED
+
+
+def test_retry_call_never_reissues_non_idempotent():
+    calls = []
+
+    def dies():
+        calls.append(1)
+        raise OSError("boom")
+
+    with pytest.raises(OSError):
+        retry.retry_call(dies, site="u2", idempotent=False,
+                         base=0.001)
+    assert len(calls) == 1
+
+
+def test_retry_budget_exhaustion_fails_fast(monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_RETRY_BUDGET", "2")
+    monkeypatch.setenv("SEAWEEDFS_TPU_RETRY_BUDGET_REFILL", "0")
+    retry.reset()
+    calls = []
+
+    def dies():
+        calls.append(1)
+        raise OSError("down")
+
+    # budget 2: the first call retries twice; the next call's retry
+    # is refused and it fails after its FIRST attempt
+    with pytest.raises(OSError):
+        retry.retry_call(dies, site="u3", attempts=3, base=0.001)
+    assert len(calls) == 3
+    calls.clear()
+    with pytest.raises(OSError):
+        retry.retry_call(dies, site="u3", attempts=3, base=0.001)
+    assert len(calls) == 1, "exhausted budget must fail fast"
+    assert retry.budget_remaining() < 1
+
+
+# -- breaker --------------------------------------------------------------
+
+def test_breaker_trips_halfopens_and_heals(monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_BREAKER_THRESHOLD", "3")
+    monkeypatch.setenv("SEAWEEDFS_TPU_BREAKER_COOLDOWN_MS", "80")
+    for _ in range(3):
+        retry.record_failure("pX", "err")
+    assert retry.peer_state("pX") == retry.OPEN
+    with pytest.raises(retry.BreakerOpen):
+        retry.check_peer("pX")
+    time.sleep(0.1)
+    assert retry.peer_state("pX") == retry.HALF_OPEN
+    retry.check_peer("pX")          # admitted as the single probe
+    with pytest.raises(retry.BreakerOpen):
+        retry.check_peer("pX")      # second concurrent probe refused
+    retry.record_success("pX")
+    assert retry.peer_state("pX") == retry.CLOSED
+    retry.check_peer("pX")          # closed: free passage
+
+
+def test_breaker_halfopen_failure_reopens(monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_BREAKER_THRESHOLD", "2")
+    monkeypatch.setenv("SEAWEEDFS_TPU_BREAKER_COOLDOWN_MS", "40")
+    retry.record_failure("pY")
+    retry.record_failure("pY")
+    assert retry.peer_state("pY") == retry.OPEN
+    time.sleep(0.06)
+    retry.check_peer("pY")          # probe admitted
+    retry.record_failure("pY")      # probe failed
+    assert retry.peer_state("pY") == retry.OPEN
+    snap = retry.health_snapshot()
+    assert snap["pY"]["trips"] == 2
+
+
+def test_halfopen_probe_slot_released_on_unrecorded_exception(
+        monkeypatch):
+    """A probe whose call dies on a NON-transport exception (outside
+    retry_on — nothing ever records a verdict) must give the slot
+    back: before the fix, `probing` stayed set forever and every
+    future check_peer refused the peer until process restart."""
+    monkeypatch.setenv("SEAWEEDFS_TPU_BREAKER_THRESHOLD", "2")
+    monkeypatch.setenv("SEAWEEDFS_TPU_BREAKER_COOLDOWN_MS", "40")
+    retry.record_failure("pW")
+    retry.record_failure("pW")
+    time.sleep(0.06)
+    with pytest.raises(ValueError):
+        retry.retry_call(lambda: (_ for _ in ()).throw(
+            ValueError("bad payload")), peer="pW")
+    # the wedge: a held slot would raise BreakerOpen here forever
+    retry.check_peer("pW")          # fresh probe admitted
+    retry.record_success("pW")
+    assert retry.peer_state("pW") == retry.CLOSED
+
+
+def test_retry_call_fails_fast_on_open_breaker(monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_BREAKER_THRESHOLD", "1")
+    retry.record_failure("pZ")
+    calls = []
+    with pytest.raises(retry.BreakerOpen):
+        retry.retry_call(lambda: calls.append(1), peer="pZ")
+    assert not calls, "open breaker must refuse before the attempt"
+
+
+def test_breaker_state_metrics_exposed():
+    for _ in range(retry.breaker_threshold()):
+        retry.record_failure("1.2.3.4:5", "x")
+    text = stats.PROCESS.render()
+    assert 'peer_breaker_state{peer="1.2.3.4:5"} 2.0' in text
+    assert 'peer_breaker_trips_total{peer="1.2.3.4:5"}' in text
+
+
+def test_pooled_client_retries_and_trips_breaker(monkeypatch):
+    """End to end through the real client funnel: GETs to a dead port
+    retry under the policy, feed the breaker, and eventually fail
+    fast."""
+    from seaweedfs_tpu.server.httpd import http_bytes
+    monkeypatch.setenv("SEAWEEDFS_TPU_BREAKER_THRESHOLD", "4")
+    monkeypatch.setenv("SEAWEEDFS_TPU_RETRY_MAX_ATTEMPTS", "2")
+    monkeypatch.setenv("SEAWEEDFS_TPU_RETRY_BASE_MS", "1")
+    dead = "127.0.0.1:9"  # discard port: nothing listens
+    with pytest.raises(OSError):
+        http_bytes("GET", f"{dead}/x", timeout=2)
+    with pytest.raises(OSError):
+        http_bytes("GET", f"{dead}/x", timeout=2)
+    assert retry.peer_state(dead) == retry.OPEN
+    t0 = time.perf_counter()
+    with pytest.raises(retry.BreakerOpen):
+        http_bytes("GET", f"{dead}/x", timeout=2)
+    assert time.perf_counter() - t0 < 0.5, \
+        "open breaker must fail fast, not burn a connect timeout"
+    text = stats.PROCESS.render()
+    assert "retry_attempts_total" in text
