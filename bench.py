@@ -740,6 +740,76 @@ def _measure_dist_encode(nodes: int = 3, blob_mb: int = 1,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _measure_soak(duration_s: float = 20.0,
+                  noisy_rps: float = 5.0) -> dict:
+    """QoS-off vs QoS-on soak A/B (the ISSUE 6 acceptance scenario):
+    a paced foreground tenant + an unbounded noisy tenant + looping
+    EC encode/rebuild churn against an in-process cluster, one arm
+    with the QoS plane inert (the interference baseline) and one with
+    the noisy tenant token-bucketed and the EC feedback throttle
+    armed.  Records p50/p99 + achieved rate per tenant per arm, so
+    the QoS delta is a number, not a claim.  QoS-off runs FIRST: the
+    off arm must not inherit a drained bucket or a residual pace."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from soak import EcChurn, SoakCluster, TenantTraffic, arm_qos
+
+    from seaweedfs_tpu import qos
+
+    def one_arm(with_qos: bool) -> dict:
+        qos.reset()
+        tmp = Path(tempfile.mkdtemp(prefix="bench_soak_"))
+        sc = SoakCluster(tmp, volumes=3)
+        try:
+            vols = sc.prepare_ec_volumes(rounds=2)
+            if with_qos:
+                arm_qos(sc.filer_url,
+                        {"tenant": "noisy", "rps": noisy_rps,
+                         "burst": noisy_rps})
+                arm_qos(sc.filer_url, {"sloP99Ms": 250.0,
+                                       "paceMinMs": 25,
+                                       "paceMaxMs": 1000})
+            fg = TenantTraffic(sc.filer_url, "fg", payload=1500,
+                               target_rps=12, seed=41).start()
+            noisy = TenantTraffic(sc.filer_url, "noisy",
+                                  payload=1500, target_rps=None,
+                                  seed=42).start()
+            churn = EcChurn(sc.master_url, vols, loop=True).start()
+            time.sleep(duration_s)
+            churn.stop()
+            noisy.stop()
+            fg.stop()
+            # invariants hold in BOTH arms: identity is not something
+            # QoS may trade away
+            fg.verify_all()
+            churn.verify_blobs()
+            return {"fg": fg.stats.summary(),
+                    "noisy": noisy.stats.summary(),
+                    "ecRounds": churn.rounds_done,
+                    "ecErrors": churn.errors[:3]}
+        finally:
+            sc.stop()
+            qos.reset()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    off = one_arm(False)
+    on = one_arm(True)
+    return {
+        "soak_seconds_per_arm": duration_s,
+        "noisy_rps_limit": noisy_rps,
+        "qos_off": off,
+        "qos_on": on,
+        "fg_p99_delta_ms": round(
+            off["fg"]["p99Ms"] - on["fg"]["p99Ms"], 2),
+        "noisy_ok_per_sec_off": off["noisy"]["okPerSec"],
+        "noisy_ok_per_sec_on": on["noisy"]["okPerSec"],
+    }
+
+
 def _measure_e2e_tpu_forced(size: int = 128 << 20):
     """The staged encode pipeline with the JAX/TPU backend FORCED
     (VERDICT r4 #3: the headline kernel number is device-side; the
@@ -1019,5 +1089,11 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 2 and sys.argv[1] == "dist_rebuild":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         print(json.dumps(_measure_dist_rebuild()))
+    elif len(sys.argv) >= 2 and sys.argv[1] == "soak":
+        # sustained-load QoS A/B (ISSUE 6): per-tenant p50/p99 with
+        # and without the QoS plane, one JSON line
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        dur = float(sys.argv[2]) if len(sys.argv) > 2 else 20.0
+        print(json.dumps(_measure_soak(duration_s=dur)))
     else:
         main()

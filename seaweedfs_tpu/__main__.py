@@ -503,8 +503,15 @@ def main(argv: list[str] | None = None) -> int:
         wlog.info("env override: %s", line, component="config")
 
     if args.securityToml:
-        from . import security
+        from . import qos, security
         security.configure(security.load_security_toml(args.securityToml))
+        # the same file may carry a [qos] section (qos.py): tenant
+        # admission limits + the foreground SLO for the EC throttle
+        qos_cfg = qos.load_qos_toml(args.securityToml)
+        if qos_cfg is not None:
+            qos.configure(qos_cfg)
+            wlog.info("qos config loaded from %s", args.securityToml,
+                      component="config")
 
     if args.cmd == "master":
         from .server.master_server import MasterServer
@@ -1078,7 +1085,20 @@ white_list = []
 # ca = "certs/ca.crt"
 # cert = "certs/node.crt"
 # key = "certs/node.key"
-# mtls = true""")
+# mtls = true
+
+# [qos]
+# per-tenant admission + background EC throttle (qos.py); runtime
+# lever: POST /debug/qos on any role
+# enabled = true
+# slo_p99_ms = 200          # foreground p99 SLO for the EC throttle
+# [qos.default]             # any tenant without an override
+# rps = 200
+# burst = 400
+# inflight_mb = 64
+# [qos.tenants.AKIDEXAMPLE] # per-access-key override
+# rps = 10
+# burst = 10""")
     elif args.cmd == "upload":
         from . import operation
         with open(args.file, "rb") as f:

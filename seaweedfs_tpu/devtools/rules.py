@@ -663,6 +663,75 @@ class MissingTimeoutRule(Rule):
                 f"not a considered choice for this call site")
 
 
+class MissingAdmissionRule(Rule):
+    """SWFS010: a gateway role server wired up without the QoS
+    admission middleware.
+
+    A class whose listener carries BOTH the uniform request metrics
+    (`self.http.metrics = ...`) and a catch-all data path
+    (`self.http.fallback = ...`) is a tenant-facing gateway (the
+    s3/filer/volume shape); registering its handlers without routing
+    them through admission control (`qos.install(self.http, ...)` or
+    a direct `self.http.admission = ...`) silently exempts that
+    listener from the per-tenant QoS plane — a noisy tenant then
+    bypasses its token bucket by picking the unguarded door.  Control
+    planes without a fallback (master) and auxiliary listeners
+    without role metrics (webdav, mq, kms) are out of scope."""
+
+    id = "SWFS010"
+    severity = "error"
+    title = "gateway listener without QoS admission middleware"
+
+    @staticmethod
+    def _http_attr(node: ast.AST) -> "str | None":
+        """'fallback' for `self.<anything>.fallback` where the owner
+        chain ends at self.http (or any single http-ish attribute)."""
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Attribute) and \
+                isinstance(node.value.value, ast.Name) and \
+                node.value.value.id == "self":
+            return node.attr
+        return None
+
+    @classmethod
+    def _is_self_http(cls, node: ast.AST) -> bool:
+        return isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id == "self"
+
+    def check(self, ctx: FileContext):
+        for cls_node in ast.walk(ctx.tree):
+            if not isinstance(cls_node, ast.ClassDef):
+                continue
+            has_fallback = has_metrics = has_admission = False
+            anchor = None
+            for node in ast.walk(cls_node):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        attr = self._http_attr(t)
+                        if attr == "fallback":
+                            has_fallback = True
+                            anchor = anchor or node
+                        elif attr == "metrics":
+                            has_metrics = True
+                        elif attr == "admission":
+                            has_admission = True
+                elif isinstance(node, ast.Call):
+                    name = _dotted(node.func)
+                    if name.split(".")[-1] == "install" and \
+                            "qos" in name and node.args and \
+                            self._is_self_http(node.args[0]):
+                        has_admission = True
+            if has_fallback and has_metrics and not has_admission:
+                yield self.finding(
+                    ctx, anchor or cls_node,
+                    f"{cls_node.name} wires a gateway listener "
+                    f"(role metrics + fallback data path) without "
+                    f"the QoS admission middleware — call "
+                    f"qos.install(self.http, <role>) so its handlers "
+                    f"pass through per-tenant admission")
+
+
 RULES = [
     LockDisciplineRule(),
     JitBlockingRule(),
@@ -673,4 +742,5 @@ RULES = [
     LeakedSpanRule(),
     UnclosedShardStreamRule(),
     MissingTimeoutRule(),
+    MissingAdmissionRule(),
 ]

@@ -158,10 +158,12 @@ class Filer:
 
         def upload_piece(off: int) -> FileChunk:
             piece = data[off:off + CHUNK_SIZE]
-            a = operation.assign(self.master,
-                                 collection=self.collection,
-                                 replication=self.replication)
-            r = operation.upload(a.url, a.fid, piece, auth=a.auth)
+            # fresh-assign retry on volume-state races (a background
+            # ec.encode marking the assigned volume readonly mid-write
+            # must cost a retry, not surface a 500 to the tenant)
+            a, r = operation.assign_and_upload(
+                self.master, piece, collection=self.collection,
+                replication=self.replication)
             return FileChunk(a.fid, off, len(piece),
                              r.get("eTag", ""), time.time_ns())
 
@@ -191,10 +193,9 @@ class Filer:
         new_chunks = []
         for off in range(0, len(data), CHUNK_SIZE):
             piece = data[off:off + CHUNK_SIZE]
-            a = operation.assign(self.master,
-                                 collection=self.collection,
-                                 replication=self.replication)
-            r = operation.upload(a.url, a.fid, piece, auth=a.auth)
+            a, r = operation.assign_and_upload(
+                self.master, piece, collection=self.collection,
+                replication=self.replication)
             new_chunks.append(
                 FileChunk(a.fid, offset + off, len(piece),
                           r.get("eTag", ""), time.time_ns()))

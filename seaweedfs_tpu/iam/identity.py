@@ -459,22 +459,37 @@ class IdentityStore:
                 from .iamapi import IamError, policy_to_actions
             except Exception:
                 return
-            for g in self._groups.values():
-                if g.get("disabled"):
+            for gname, g in self._groups.items():
+                # FAIL CLOSED per group: a malformed entry (non-dict
+                # group, non-list members/policyNames, unhashable
+                # member...) drops THAT group's grant and logs —
+                # raising here would abort mid-recompute and leave a
+                # half-updated grant map where some identities carry
+                # stale group actions and others none
+                try:
+                    if g.get("disabled"):
+                        continue
+                    acts: set = set()
+                    for pname in g.get("policyNames", []):
+                        doc = self._policies.get(pname)
+                        if doc:
+                            try:
+                                acts.update(policy_to_actions(doc))
+                            except (IamError, AttributeError, KeyError,
+                                    TypeError, ValueError):
+                                continue   # malformed doc grants nothing
+                    if not acts:
+                        continue
+                    for member in g.get("members", []):
+                        derived.setdefault(str(member),
+                                           set()).update(acts)
+                except (AttributeError, KeyError, TypeError,
+                        ValueError) as e:
+                    from ..util import wlog
+                    wlog.warning(
+                        "iam group %r malformed; its grant is "
+                        "dropped: %s", gname, e, component="iam")
                     continue
-                acts: set = set()
-                for pname in g.get("policyNames", []):
-                    doc = self._policies.get(pname)
-                    if doc:
-                        try:
-                            acts.update(policy_to_actions(doc))
-                        except (IamError, AttributeError, KeyError,
-                                TypeError, ValueError):
-                            continue   # malformed doc grants nothing
-                if not acts:
-                    continue
-                for member in g.get("members", []):
-                    derived.setdefault(member, set()).update(acts)
         for ident in self._identities.values():
             ident.group_actions = sorted(derived.get(ident.name, ()))
 

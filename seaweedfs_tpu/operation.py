@@ -141,28 +141,50 @@ def upload(url: str, fid: str, data: bytes, name: str = "",
     return json.loads(body)
 
 
-def submit(master: str, data: bytes, name: str = "", mime: str = "",
-           collection: str = "", replication: str = "",
-           ttl: str = "", retries: int = 3) -> str:
-    """operation/submit.go: assign + upload; returns the fid.
-
-    A failed upload retries with a FRESH assign (the reference's
-    assign-then-upload retry loop) so one replica hiccup or a dead
-    volume server doesn't fail the write."""
+def assign_and_upload(master: str, data: bytes, name: str = "",
+                      mime: str = "", collection: str = "",
+                      replication: str = "", ttl: str = "",
+                      retries: int = 3) -> "tuple[Assignment, dict]":
+    """assign + upload with a FRESH assign on each retry (the
+    reference's assign-then-upload loop).  Retried: transport
+    failures, 5xx, and 409 volume-state rejections — a volume marked
+    readonly for EC encode between the assign and the upload is a
+    routine race once background maintenance runs under live traffic
+    (the soak scenario), and the stale assignment, not the data, is
+    what's wrong.  Other 4xx are deterministic rejections and raise
+    immediately.  Returns (assignment, upload response)."""
     last: Exception | None = None
-    for _ in range(max(retries, 1)):
+    for attempt in range(max(retries, 1)):
+        if attempt:
+            # short ramp before re-assigning: the usual cause is a
+            # volume-state transition the master hasn't absorbed yet
+            # (readonly heartbeats race); re-assigning in the same
+            # millisecond just replays the stale map
+            time.sleep(0.05 * attempt)
         try:
             a = assign(master, collection=collection,
                        replication=replication, ttl=ttl)
-            upload(a.url, a.fid, data, name=name, mime=mime, auth=a.auth)
-            return a.fid
+            r = upload(a.url, a.fid, data, name=name, mime=mime,
+                       auth=a.auth)
+            return a, r
         except UploadError as e:
-            if e.status < 500:
+            if e.status != 409 and e.status < 500:
                 raise  # deterministic rejection — retrying can't help
             last = e
         except (RuntimeError, OSError) as e:
             last = e
-    raise RuntimeError(f"submit failed after {retries} attempts: {last}")
+    raise RuntimeError(f"upload failed after {retries} attempts: {last}")
+
+
+def submit(master: str, data: bytes, name: str = "", mime: str = "",
+           collection: str = "", replication: str = "",
+           ttl: str = "", retries: int = 3) -> str:
+    """operation/submit.go: assign + upload; returns the fid."""
+    a, _ = assign_and_upload(master, data, name=name, mime=mime,
+                             collection=collection,
+                             replication=replication, ttl=ttl,
+                             retries=retries)
+    return a.fid
 
 
 _followers: "dict[str, object]" = {}

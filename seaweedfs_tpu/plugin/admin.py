@@ -131,6 +131,12 @@ class AdminServer:
                 self._load_state()
         self.http = HttpServer(host, port)
         self.http.role = "admin"          # tracing server spans
+        # browser-plane write protection: every mutating /ui/* POST
+        # must present this per-process CSRF token (served embedded in
+        # the GET forms) AND, when security.toml configures an admin
+        # key, admin credentials — an unauthenticated cross-site form
+        # post must not be able to submit maintenance jobs
+        self._csrf = uuid.uuid4().hex
         r = self.http.route
         r("GET", "/maintenance/config", self._get_config)
         r("POST", "/maintenance/config", self._set_config)
@@ -466,6 +472,26 @@ topology: {_html.escape(str(status.get('topologyId', '?')))}</p>
 <th>message</th><th>last decision</th></tr>{''.join(jobs)}</table>"""
         return self._page("seaweedfs-tpu admin", inner)
 
+    def _csrf_input(self) -> str:
+        return (f"<input type='hidden' name='csrf' "
+                f"value='{self._csrf}'>")
+
+    def _ui_write_guard(self, req: Request,
+                        form: dict) -> "tuple | None":
+        """Gate for browser-driven writes (POST /ui/*): the
+        security.toml admin key (when configured) and the GET-served
+        CSRF token, both or 403.  Order matters — auth first, so an
+        unauthenticated caller learns nothing about token validity."""
+        from .. import security
+        err = security.current().check_admin(
+            req.query, req.headers, req.remote_ip)
+        if err:
+            return 403, {"error": f"admin credentials required: {err}"}
+        if form.get("csrf") != self._csrf:
+            return 403, {"error": "missing or stale CSRF token; "
+                                  "reload the form page"}
+        return None
+
     @staticmethod
     def _form(req: Request) -> dict:
         """Decode an HTML form body; keep_blank_values so a field
@@ -615,10 +641,12 @@ input{{margin:2px}}</style></head><body>
             "<form method='post' action='/ui/actions' "
             "style='display:inline'>"
             "<input type='hidden' name='action' value='detect'>"
+            f"{self._csrf_input()}"
             "<button>run detection now</button></form> "
             "<form method='post' action='/ui/actions' "
             "style='display:inline'>"
             "<input type='hidden' name='action' value='submit'>"
+            f"{self._csrf_input()}"
             f"<select name='jobType'>{submit_opts}</select> "
             "params (JSON): <input name='params' value='{}' "
             "size='30'> <button>submit job</button></form>")
@@ -636,6 +664,9 @@ input{{margin:2px}}</style></head><body>
         job by type — both share the JSON API handlers' logic."""
         import json as _json
         form = self._form(req)
+        denied = self._ui_write_guard(req, form)
+        if denied is not None:
+            return denied
         if form.get("action") == "detect":
             self._trigger(self._FormShim({}))
             return 303, (b"", {"Location": "/ui/jobs",
@@ -689,6 +720,7 @@ input{{margin:2px}}</style></head><body>
                 f"<form method='post' action='/ui/config'>"
                 f"<input type='hidden' name='jobType' "
                 f"value='{_html.escape(jt)}'>"
+                f"{self._csrf_input()}"
                 f"{''.join(inputs)}"
                 f"<button>apply</button></form>")
         if not forms:
@@ -700,6 +732,10 @@ input{{margin:2px}}</style></head><body>
         """HTML-form arm of /maintenance/config POST: same schema
         validation, then redirect back to the form."""
         form = self._form(req)
+        denied = self._ui_write_guard(req, form)
+        if denied is not None:
+            return denied
+        form.pop("csrf", None)       # not a schema field
         jt = form.pop("jobType", "")
         status, payload = self._set_config(self._FormShim(
             {"jobType": jt, "values": form}))

@@ -219,10 +219,17 @@ class EcEncodeHandler(JobHandler):
         ctx = self._make_ctx(params, collection, vid)
         urls = self._lookup_urls(worker, vid)
         base = os.path.join(worker.work_dir, f"{vid}")
+        # the pull-then-push path moves volume bytes THROUGH this
+        # worker, which serves no foreground traffic of its own — so
+        # the feedback throttle watches the source/dest volume
+        # servers' /metrics for the job's duration (qos.py; a no-op
+        # unless an SLO is configured)
+        from ... import qos
         try:
-            placement = self._encode_and_distribute(
-                worker, job_id, vid, collection, ctx, urls, urls[0],
-                base)
+            with qos.remote_slo_watch(urls):
+                placement = self._encode_and_distribute(
+                    worker, job_id, vid, collection, ctx, urls,
+                    urls[0], base)
         except Exception:
             self._unwind_volumes(worker, collection, ctx, {vid: urls})
             raise

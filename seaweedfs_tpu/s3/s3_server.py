@@ -125,6 +125,15 @@ class S3ApiServer:
         self.metrics = Metrics("s3")
         self.http.role = "s3"            # tracing + request_seconds
         self.http.metrics = self.metrics
+        # QoS plane (qos.py): per-tenant admission at the tenant-facing
+        # edge (tenant = SigV4 access key), and this gateway's
+        # request_seconds histogram is a foreground-latency source for
+        # the background EC throttle
+        from .. import qos
+        qos.install(self.http, "s3")
+        qos.throttle().add_metrics(f"s3:{self.http.port}",
+                                   self.metrics)
+        qos.throttle().maybe_start()
         # metrics ride a SEPARATE listener (`weed s3 -metricsPort`):
         # the S3 port must keep every path free for bucket names
         self.metrics_http = None
@@ -162,6 +171,8 @@ class S3ApiServer:
         return self
 
     def stop(self):
+        from .. import qos
+        qos.throttle().remove_source(f"s3:{self.http.port}")
         if getattr(self, "grpc_server", None) is not None:
             self.grpc_server.stop(grace=0.5).wait()
             self.grpc_server = None

@@ -45,6 +45,8 @@ def install_debug_routes(http: HttpServer) -> None:
     http.route("GET", "/debug/faults", _faults_get)
     http.route("POST", "/debug/faults", _faults_post)
     http.route("GET", "/debug/health", _health)
+    http.route("GET", "/debug/qos", _qos_get)
+    http.route("POST", "/debug/qos", _qos_post)
 
 
 def _faults_get(req: Request):
@@ -77,6 +79,58 @@ def _faults_post(req: Request):
     except ValueError as e:
         return 400, {"error": str(e)}
     return 200, {"armedCount": n, "armed": faults.armed()}
+
+
+def _qos_get(req: Request):
+    from .. import qos
+    snap = qos.controller().snapshot()
+    snap["throttle"] = qos.throttle().snapshot()
+    return 200, snap
+
+
+def _qos_post(req: Request):
+    """The QoS plane's runtime lever (qos.py), mirroring
+    /debug/faults: set per-tenant limits ({"tenant": ..., "rps": ...,
+    "burst": ..., "inflightMb": ...}; tenant "default"/"*" sets the
+    default, {"remove": name} drops one), flip enforcement
+    ({"enabled": bool}), retune the EC feedback throttle
+    ({"sloP99Ms": ..., "paceMinMs"/"paceMaxMs"/"checkIntervalMs"}),
+    or reset everything ({"clear": true}).  Responds with the same
+    snapshot GET serves, so a lever call round-trips."""
+    from .. import qos
+    b = req.json()
+    ctl = qos.controller()
+    try:
+        if b.get("clear"):
+            qos.configure(None)
+            # a pace forced via the paceMs big-red-button has no
+            # watcher thread to decay it once the config is inert —
+            # "reset everything" must include it
+            qos.throttle().set_pace(0.0)
+        if "enabled" in b:
+            ctl.set_enabled(bool(b["enabled"]))
+        if b.get("remove"):
+            ctl.set_tenant(str(b["remove"]), None)
+        if b.get("tenant"):
+            ctl.set_tenant(str(b["tenant"]),
+                           qos.TenantLimit.from_json(b))
+        cfg = ctl.config()
+        for key, attr in (("sloP99Ms", "slo_p99_ms"),
+                          ("paceMinMs", "pace_min_ms"),
+                          ("paceMaxMs", "pace_max_ms"),
+                          ("checkIntervalMs", "check_interval_ms")):
+            if key in b:
+                setattr(cfg, attr, float(b[key]))
+        if "sloP99Ms" in b:
+            if cfg.slo_p99_ms <= 0:
+                qos.throttle().set_pace(0.0)
+            qos.throttle().maybe_start()
+        if "paceMs" in b:               # direct pace override (tests /
+            qos.throttle().set_pace(    # operator big-red-button)
+                float(b["paceMs"]) / 1e3)
+    except (TypeError, ValueError) as e:
+        return 400, {"error": str(e)}
+    return _qos_get(req)
 
 
 def _health(req: Request):

@@ -54,54 +54,73 @@ class FilerServer:
         self._notification_spec = notification
         self._notifier = None
         self._lock_peers = lock_peers or []
-        if meta_log_dir is None and store_path != ":memory:" and \
-                store_type in ("sqlite", "lsm"):
-            # persist the metadata log beside the store by default —
-            # subscribers must survive a filer restart
-            # (filer_notify_append.go).  Only for LOCAL-path stores:
-            # a redis/elastic store_path is a network ADDRESS, and
-            # "host:port.metalog" would litter the working directory
-            meta_log_dir = store_path + ".metalog"
-        elif meta_log_dir is None and store_type in ("redis",
-                                                     "elastic"):
-            # per-address uniqueness (two filers on different redis
-            # servers must not interleave one log), path-safe chars
-            safe = store_path.replace(":", "_").replace("/", "_")
-            meta_log_dir = f"filer-{store_type}-{safe}.metalog"
-        if store_type == "lsm":
-            if store_path == ":memory:":
-                raise ValueError(
-                    "the lsm store needs a directory path, not "
-                    ":memory: (use -storeType sqlite for in-memory)")
-            from ..filer.lsm_store import LsmStore
-            store = LsmStore(store_path)
-        elif store_type == "sqlite":
-            store = SqliteStore(store_path)
-        elif store_type == "redis":
-            # store_path = host:port of a RESP server
-            # (filer/redis_store.py; reference weed/filer/redis2)
-            from ..filer.redis_store import RedisFilerStore, RespClient
-            r_host, _, r_port = store_path.rpartition(":")
-            if not r_host or not r_port.isdigit():
-                raise ValueError(
-                    "-storeType redis needs -store host:port of a "
-                    "RESP server")
-            store = RedisFilerStore(RespClient(r_host, int(r_port)))
-        elif store_type == "elastic":
-            # store_path = host:port of an ES-wire server
-            # (filer/elastic_store.py; reference weed/filer/elastic)
-            from ..filer.elastic_store import (ElasticClient,
-                                               ElasticFilerStore)
-            store = ElasticFilerStore(ElasticClient(store_path))
-        else:
-            raise ValueError(f"unknown filer store type "
-                             f"{store_type!r} "
-                             f"(sqlite|lsm|redis|elastic)")
-        self.filer = Filer(master, store,
-                           collection=collection,
-                           replication=replication,
-                           meta_log_dir=meta_log_dir)
+        # bind the listener FIRST: the default metalog dir below needs
+        # the RESOLVED port so two co-located filers derive distinct
+        # dirs (binding also fails fast on a taken port, before any
+        # store file is touched)
         self.http = HttpServer(host, port)
+        try:
+            if meta_log_dir is None and store_path != ":memory:" and \
+                    store_type in ("sqlite", "lsm"):
+                # persist the metadata log beside the store by default —
+                # subscribers must survive a filer restart
+                # (filer_notify_append.go).  Only for LOCAL-path stores:
+                # a redis/elastic store_path is a network ADDRESS, and
+                # "host:port.metalog" would litter the working directory
+                meta_log_dir = store_path + ".metalog"
+            elif meta_log_dir is None and store_type in ("redis",
+                                                         "elastic"):
+                # per-address uniqueness (two filers on different redis
+                # servers must not interleave one log) is NOT enough: two
+                # CO-LOCATED filers sharing one redis/ES server would
+                # still derive the same dir and interleave their
+                # monotonic stamp clocks — so the dir carries this
+                # filer's port too.  Path-safe chars only.  Port-0
+                # (ephemeral, test) filers get a fresh dir per boot; a
+                # production filer pins its port, so its log survives
+                # restart like the sqlite/lsm case.
+                safe = store_path.replace(":", "_").replace("/", "_")
+                meta_log_dir = (f"filer-{store_type}-{safe}"
+                                f"-p{self.http.port}.metalog")
+            if store_type == "lsm":
+                if store_path == ":memory:":
+                    raise ValueError(
+                        "the lsm store needs a directory path, not "
+                        ":memory: (use -storeType sqlite for in-memory)")
+                from ..filer.lsm_store import LsmStore
+                store = LsmStore(store_path)
+            elif store_type == "sqlite":
+                store = SqliteStore(store_path)
+            elif store_type == "redis":
+                # store_path = host:port of a RESP server
+                # (filer/redis_store.py; reference weed/filer/redis2)
+                from ..filer.redis_store import RedisFilerStore, RespClient
+                r_host, _, r_port = store_path.rpartition(":")
+                if not r_host or not r_port.isdigit():
+                    raise ValueError(
+                        "-storeType redis needs -store host:port of a "
+                        "RESP server")
+                store = RedisFilerStore(RespClient(r_host, int(r_port)))
+            elif store_type == "elastic":
+                # store_path = host:port of an ES-wire server
+                # (filer/elastic_store.py; reference weed/filer/elastic)
+                from ..filer.elastic_store import (ElasticClient,
+                                                   ElasticFilerStore)
+                store = ElasticFilerStore(ElasticClient(store_path))
+            else:
+                raise ValueError(f"unknown filer store type "
+                                 f"{store_type!r} "
+                                 f"(sqlite|lsm|redis|elastic)")
+            self.filer = Filer(master, store,
+                               collection=collection,
+                               replication=replication,
+                               meta_log_dir=meta_log_dir)
+        except BaseException:
+            # the listener above is already bound; a store-setup
+            # failure must not leak a socket that accepts (and
+            # then hangs) connections with no server behind it
+            self.http.abort()
+            raise
         self.http.route("GET", "/__meta__/lookup", self._meta_lookup)
         self.http.route("POST", "/__meta__/rename", self._meta_rename)
         self.http.route("POST", "/__meta__/set_attrs",
@@ -170,6 +189,14 @@ class FilerServer:
         install_debug_routes(self.http)  # util/grace/pprof.go analog
         self.http.guard = self._guard
         self.http.fallback = self._dispatch
+        # QoS plane (qos.py): per-tenant admission at the filer edge
+        # (tenant = auth principal / X-Tenant / anonymous), and this
+        # filer's request_seconds feeds the background EC throttle
+        from .. import qos
+        qos.install(self.http, "filer")
+        qos.throttle().add_metrics(f"filer:{self.http.port}",
+                                   self.metrics)
+        qos.throttle().maybe_start()
 
     def _guard(self, req: Request):
         """Admin-plane gate (guard.go): the filer's /debug plane must
@@ -266,7 +293,8 @@ class FilerServer:
         return self
 
     def stop(self):
-        from .. import operation
+        from .. import operation, qos
+        qos.throttle().remove_source(f"filer:{self.http.port}")
         operation.disable_follow(self.filer.master)
         if self._notifier is not None:
             self._notifier.stop()

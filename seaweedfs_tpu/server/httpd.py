@@ -195,6 +195,12 @@ class HttpServer:
         # continue or a (status, payload) response to short-circuit
         self.guard: "Callable[[Request], tuple[int, object] | None] | None" \
             = None
+        # optional QoS admission hook (qos.install): called before the
+        # guard, returns (deny_response | None, release | None) — the
+        # deny response carries Retry-After via the (body, headers)
+        # payload form; release (in-flight byte accounting) runs when
+        # the request finishes, success or failure
+        self.admission: "Callable[[Request], tuple] | None" = None
         # observability hooks, set by the owning role server: `role`
         # labels this listener's server spans (tracing.py), `metrics`
         # receives the uniform request_seconds histogram (stats.py) —
@@ -230,6 +236,7 @@ class HttpServer:
                     f"{req.method} {req.path}", role=outer.role,
                     parent=parent_span, trace_id=rid)
                 status = 0
+                qos_release = None
                 try:
                     # the span (and request_seconds) covers handler
                     # execution AND the response-body write: for the
@@ -238,9 +245,17 @@ class HttpServer:
                     # handler return would record a multi-second
                     # stream as ~0ms
                     try:
-                        denied = outer.guard(req) if outer.guard \
-                            else None
-                        if denied is not None:
+                        # QoS admission first (qos.py): an over-limit
+                        # tenant is rejected with 503 + Retry-After
+                        # BEFORE auth or routing spends anything on it
+                        throttled = None
+                        if outer.admission is not None:
+                            throttled, qos_release = \
+                                outer.admission(req)
+                        if throttled is not None:
+                            status, payload = throttled
+                        elif (denied := outer.guard(req)
+                              if outer.guard else None) is not None:
                             status, payload = denied
                         elif route is not None:
                             status, payload = route(req)
@@ -338,6 +353,15 @@ class HttpServer:
                     if req.method != "HEAD":
                         self.wfile.write(body)
                 finally:
+                    if qos_release is not None:
+                        try:
+                            qos_release()
+                        except Exception as e:  # noqa: BLE001 —
+                            # accounting must never break a reply
+                            from ..util import wlog
+                            wlog.warning(
+                                "qos release failed: %s", e,
+                                component="qos")
                     sp.set("status", status)
                     sp.finish()
                     if outer.metrics is not None:
@@ -440,6 +464,13 @@ class HttpServer:
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
+
+    def abort(self) -> None:
+        """Close a bound listener that never served (owner-constructor
+        failure unwind).  stop() is wrong here: shutdown() waits on
+        the serve_forever loop's acknowledgement, which never comes
+        from a loop that never started."""
+        self._httpd.server_close()
 
     def stop(self) -> None:
         self._httpd.shutdown()

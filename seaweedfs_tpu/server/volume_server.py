@@ -112,6 +112,16 @@ class VolumeServer:
         self.metrics = Metrics("volume_server")
         self.http.role = "volume"        # tracing + request_seconds
         self.http.metrics = self.metrics
+        # QoS plane (qos.py): tenant admission scoped to the admin /
+        # maintenance plane (foreground needle traffic is internal and
+        # protected by the EC feedback throttle, not tenant buckets);
+        # this role's request_seconds is the throttle's primary
+        # foreground signal — EC jobs hammer exactly these servers
+        from .. import qos
+        qos.install(self.http, "volume", path_prefix="/admin/")
+        qos.throttle().add_metrics(f"volume:{self.http.port}",
+                                   self.metrics)
+        qos.throttle().maybe_start()
 
     # -- lifecycle --------------------------------------------------------
 
@@ -236,6 +246,8 @@ class VolumeServer:
 
     def stop(self):
         self._hb_stop.set()
+        from .. import qos
+        qos.throttle().remove_source(f"volume:{self.http.port}")
         if getattr(self, "read_plane", None) is not None:
             self.read_plane.stop()
         if getattr(self, "uds_server", None) is not None:
@@ -583,6 +595,12 @@ class VolumeServer:
         v = self.store.find_volume(vid)
         if v is not None and v.read_only:
             v.sync()  # commit buffered .dat/.idx before anyone copies them
+        # instant topology notify (same rule as mount/unmount): until
+        # the master sees the flag it keeps ASSIGNING this volume, and
+        # every write raced into the readonly window costs the client
+        # a 409 + fresh-assign retry — with a pulse-length window that
+        # outlasts the retry budget under an ec.encode burst
+        self._heartbeat_once()
         return 200, {}
 
     def _configure_volume(self, req: Request):

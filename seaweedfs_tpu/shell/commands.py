@@ -959,6 +959,89 @@ def cmd_trace_show(env: CommandEnv, args: list[str]) -> str:
     return "\n".join(out)
 
 
+@command("qos.status")
+def cmd_qos_status(env: CommandEnv, args: list[str]) -> str:
+    """Cluster-wide QoS view (qos.py): every node's /debug/qos —
+    admission config, per-tenant in-flight bytes, and the EC feedback
+    throttle's pace/p99.  `-nodes=host:port,...` adds listeners the
+    topology doesn't know (e.g. a standalone S3 gateway)."""
+    opts = _parse_flags(args)
+    try:
+        nodes = _cluster_debug_nodes(env)
+    except OSError:
+        nodes = [env.master]
+    for n in (opts.get("nodes", "") or "").split(","):
+        n = n.strip()
+        if n and n not in nodes:
+            nodes.append(n)
+    out = []
+    for url in nodes:
+        try:
+            r = http_json("GET", f"{url}/debug/qos", timeout=3)
+        except OSError:
+            out.append(f"{url}: unreachable")
+            continue
+        if not isinstance(r, dict) or "config" not in r:
+            out.append(f"{url}: {r.get('error', 'no qos plane')}"
+                       if isinstance(r, dict) else f"{url}: ?")
+            continue
+        cfg = r["config"]
+        th = r.get("throttle", {})
+        tenants = cfg.get("tenants", {})
+        out.append(
+            f"{url}: enabled={cfg.get('enabled')} "
+            f"tenants={len(tenants)} "
+            f"slo_p99={cfg.get('sloP99Ms', 0):.0f}ms "
+            f"pace={th.get('paceMs', 0):.0f}ms "
+            f"p99={th.get('lastP99Ms', 0):.1f}ms")
+        for t, lim in sorted(tenants.items()):
+            inflight = r.get("inflightBytes", {}).get(t, 0)
+            out.append(f"  {t}: rps={lim.get('rps')} "
+                       f"burst={lim.get('burst')} "
+                       f"inflight_mb={lim.get('inflightMb')} "
+                       f"(in flight now: {inflight}B)")
+    return "\n".join(out)
+
+
+@command("qos.set")
+def cmd_qos_set(env: CommandEnv, args: list[str]) -> str:
+    """Push one tenant's limits (or the default, tenant `*`) to every
+    node's runtime QoS lever: `qos.set -tenant=AK -rps=10 [-burst=20]
+    [-inflightMb=8]` — or `-sloP99Ms=200` to retune the EC throttle,
+    `-clear` to reset the whole plane."""
+    opts = _parse_flags(args)
+    body: dict = {}
+    if "clear" in opts:
+        body["clear"] = True
+    if "tenant" in opts:
+        body["tenant"] = opts["tenant"]
+        for k in ("rps", "burst", "inflightMb"):
+            if k in opts:
+                body[k] = float(opts[k])
+    if "sloP99Ms" in opts:
+        body["sloP99Ms"] = float(opts["sloP99Ms"])
+    if not body:
+        return ("usage: qos.set -tenant=<access-key|*> -rps=N "
+                "[-burst=N] [-inflightMb=N] | -sloP99Ms=N | -clear")
+    try:
+        nodes = _cluster_debug_nodes(env)
+    except OSError:
+        nodes = [env.master]
+    ok, failed = 0, []
+    for url in nodes:
+        try:
+            r = http_json("POST", f"{url}/debug/qos", body, timeout=5)
+            if isinstance(r, dict) and "error" in r:
+                failed.append(f"{url}: {r['error']}")
+            else:
+                ok += 1
+        except OSError as e:
+            failed.append(f"{url}: {e}")
+    out = [f"qos updated on {ok}/{len(nodes)} nodes"]
+    out.extend(failed)
+    return "\n".join(out)
+
+
 @command("volume.scrub")
 def cmd_volume_scrub(env: CommandEnv, args: list[str]) -> str:
     """CRC-verify every needle of every (or one) volume

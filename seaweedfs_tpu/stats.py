@@ -77,6 +77,29 @@ class Metrics:
             if help_text:
                 self._help.setdefault(name, help_text)
 
+    def histogram_merged(self, name: str) -> "dict | None":
+        """Snapshot of histogram `name` merged across every label set
+        (the QoS feedback throttle's foreground-latency source: it
+        wants 'this role's request_seconds', not one method+code
+        cell).  Returns {"buckets", "counts", "sum", "count"} or None
+        when the histogram has never been observed."""
+        merged: "dict | None" = None
+        with self._lock:
+            for (n, _labels), h in self._hists.items():
+                if n != name:
+                    continue
+                if merged is None:
+                    merged = {"buckets": h["buckets"],
+                              "counts": list(h["counts"]),
+                              "sum": h["sum"], "count": h["count"]}
+                elif merged["buckets"] == h["buckets"]:
+                    merged["counts"] = [
+                        a + b for a, b in zip(merged["counts"],
+                                              h["counts"])]
+                    merged["sum"] += h["sum"]
+                    merged["count"] += h["count"]
+        return merged
+
     def render(self) -> str:
         """Prometheus text exposition format."""
         out = []

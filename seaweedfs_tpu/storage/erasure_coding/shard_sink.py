@@ -349,6 +349,12 @@ class RemoteShardSink(ShardSink):
             if item is None:
                 return
             buf, n = item
+            # background-priority pacing (qos.py): while foreground
+            # request_seconds p99 violates the SLO, each window waits
+            # the throttle's pace before touching the wire — the
+            # bounded queue backpressures the codec stage behind it
+            from ... import qos
+            qos.ec_pace("encode")
             directive = faults.fire("ec.encode.window", key=self.url)
             if directive == "truncate":
                 # stop mid-shard with CLEAN chunked framing: the

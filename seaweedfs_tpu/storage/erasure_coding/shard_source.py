@@ -230,7 +230,7 @@ class RemoteShardSource(ShardSource):
         donor."""
         if not work:
             return
-        from ... import faults
+        from ... import faults, qos
         end = work[-1][0] + work[-1][1]
         i = 0
         conn = resp = None
@@ -249,6 +249,12 @@ class RemoteShardSource(ShardSource):
                     yield None, 0
                     i += 1
                     continue
+                # background-priority pacing (qos.py): rebuild slice
+                # fetches yield to degraded foreground traffic the
+                # same way encode window pushes do.  Deliberately
+                # outside the `wire` timer — a QoS stall must not be
+                # billed as donor latency.
+                qos.ec_pace("rebuild")
                 wire = 0.0
                 if resp is None:
                     url = self._urls[failures % len(self._urls)]

@@ -15,7 +15,7 @@ import threading
 import time
 
 from . import types
-from .needle import Needle, get_actual_size
+from .needle import Needle, get_actual_size, needle_body_length
 from .needle_map import NeedleMap
 from .replica_placement import ReplicaPlacement
 from .super_block import SuperBlock
@@ -48,12 +48,36 @@ def walk_dat(path: str):
             if len(header) < types.NEEDLE_HEADER_SIZE:
                 break
             n = Needle.parse_header(header)
-            rec_len = get_actual_size(n.size, version)
+            # high-bit sizes mark in-place deletions in the reference
+            # format (the C++ scanner masks identically,
+            # native/volume_tool.cc:244): the record body length uses
+            # the LOW 31 bits — feeding the signed int32 into the
+            # record math yields a negative length and the offline
+            # fix/merge recovery dies on the first deleted record
+            deleted_mark = n.size < 0
+            masked = n.size
+            if deleted_mark:
+                masked = 0 if types.size_is_tombstone(n.size) else \
+                    types.size_to_u32(n.size) & 0x7FFFFFFF
+            rec_len = get_actual_size(masked, version)
             if offset + rec_len > total:
                 break                      # truncated tail
             f.seek(offset)
             buf = f.read(rec_len)
-            n = Needle.from_bytes(buf, version, check_crc=False)
+            n = Needle.parse_header(buf)
+            n.size = masked
+            n.parse_body(
+                buf[types.NEEDLE_HEADER_SIZE:
+                    types.NEEDLE_HEADER_SIZE +
+                    needle_body_length(masked, version)],
+                version, check_crc=False)
+            if deleted_mark:
+                # a deleted-marked record is a DELETION wherever it
+                # appears in append order: consumers (fix's index
+                # replay, merge's last-write-wins fold) key liveness
+                # on n.data, so surface it as the zero-data tombstone
+                # shape rather than resurrecting the stale payload
+                n.data = b""
             yield n, offset
             offset += rec_len
 

@@ -45,6 +45,25 @@ key = "proc-matrix-read-key"
 [admin]
 key = "proc-matrix-admin-key"
 """,
+    # QoS plane armed from the [qos] security.toml section (qos.py):
+    # generous default tenant budget, a capped "noisy" tenant, and a
+    # foreground-SLO-driven EC throttle — the soak long run's profile
+    "qos": """
+[qos]
+enabled = true
+slo_p99_ms = 500
+pace_min_ms = 25
+pace_max_ms = 1000
+
+[qos.default]
+rps = 500
+burst = 1000
+
+[qos.tenants.noisy]
+rps = 6
+burst = 6
+inflight_mb = 4
+""",
     # mTLS: minted per-cluster PKI — ProcCluster fills in the
     # certificate paths (the {dir} placeholders) after running the
     # `cert` CLI; every role serves https and pins the CA

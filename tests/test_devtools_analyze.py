@@ -409,6 +409,72 @@ def test_swfs008_noqa_suppresses():
     assert found == []
 
 
+# -- SWFS010: gateway without QoS admission -------------------------------
+
+_GATEWAY = """
+    from seaweedfs_tpu.server.httpd import HttpServer
+
+    class MyGateway:
+        def __init__(self):
+            self.http = HttpServer()
+            self.http.metrics = object()
+            self.http.fallback = self._dispatch{extra}
+
+        def _dispatch(self, req):
+            return 200, {{}}
+"""
+
+
+def test_swfs010_flags_gateway_without_admission():
+    found = check(_GATEWAY.format(extra=""), "SWFS010")
+    assert len(found) == 1
+    assert "MyGateway" in found[0].message
+    assert "qos.install" in found[0].message
+
+
+def test_swfs010_negative_qos_install_or_direct_assign():
+    ok = _GATEWAY.format(extra="""
+            from seaweedfs_tpu import qos
+            qos.install(self.http, "mine")""")
+    assert check(ok, "SWFS010") == []
+    ok2 = _GATEWAY.format(extra="""
+            self.http.admission = self._admit""")
+    assert check(ok2, "SWFS010") == []
+
+
+def test_swfs010_negative_non_gateway_listeners():
+    # control plane: routes + metrics but no fallback (master shape)
+    src = """
+    class ControlPlane:
+        def __init__(self):
+            self.http = HttpServer()
+            self.http.metrics = object()
+            self.http.route("GET", "/x", self._x)
+    """
+    assert check(src, "SWFS010") == []
+    # auxiliary listener: fallback but no role metrics (webdav shape)
+    src2 = """
+    class Aux:
+        def __init__(self):
+            self.http = HttpServer()
+            self.http.fallback = self._dispatch
+    """
+    assert check(src2, "SWFS010") == []
+
+
+def test_swfs010_repo_gateways_are_clean():
+    """The three enforcement points from the QoS plane stay wired."""
+    import seaweedfs_tpu
+    import os
+    root = os.path.dirname(seaweedfs_tpu.__file__)
+    findings, errors = run_paths(
+        [os.path.join(root, "s3", "s3_server.py"),
+         os.path.join(root, "server", "filer_server.py"),
+         os.path.join(root, "server", "volume_server.py")])
+    assert not errors
+    assert [f for f in findings if f.rule == "SWFS010"] == []
+
+
 def test_bare_noqa_suppresses_everything():
     src = """
     def f():

@@ -207,3 +207,66 @@ def test_filer_end_to_end_on_elastic_store(es_server, tmp_path):
     finally:
         vs.stop()
         master.stop()
+
+
+def test_colocated_filers_get_distinct_metalog_dirs(
+        resp_server, tmp_path, monkeypatch):
+    """ISSUE 6 satellite: two co-located filers sharing one redis
+    store address used to derive the SAME default metalog dir and
+    interleave their monotonic stamp clocks; the default now carries
+    each filer's resolved port.  Two live filer servers against one
+    RESP process: distinct dirs, disjoint logs, per-filer replay."""
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.httpd import http_bytes
+    from seaweedfs_tpu.server.master_server import MasterServer
+
+    monkeypatch.chdir(tmp_path)   # relative metalog dirs land here
+    master = MasterServer().start()
+    addr = f"127.0.0.1:{resp_server}"
+    f1 = FilerServer(master.url, store_path=addr,
+                     store_type="redis").start()
+    f2 = FilerServer(master.url, store_path=addr,
+                     store_type="redis").start()
+    try:
+        d1, d2 = f1.filer.meta_log.dir, f2.filer.meta_log.dir
+        assert d1 and d2 and d1 != d2, (d1, d2)
+        assert str(f1.http.port) in d1 and str(f2.http.port) in d2
+        # mutate the namespace through each filer's own HTTP edge
+        # (0-byte files need no volume assign)
+        assert http_bytes("POST", f"{f1.url}/from-f1.txt", b"",
+                          timeout=10)[0] < 300
+        assert http_bytes("POST", f"{f2.url}/from-f2.txt", b"",
+                          timeout=10)[0] < 300
+        # each filer's log replays ITS OWN event only — no
+        # interleaving through a shared segment file
+        e1 = [e.get("newEntry", {}).get("fullPath")
+              for e in f1.filer.meta_log.events_since(0)]
+        e2 = [e.get("newEntry", {}).get("fullPath")
+              for e in f2.filer.meta_log.events_since(0)]
+        assert "/from-f1.txt" in e1 and "/from-f2.txt" not in e1
+        assert "/from-f2.txt" in e2 and "/from-f1.txt" not in e2
+        assert (tmp_path / d1).is_dir() and (tmp_path / d2).is_dir()
+    finally:
+        f2.stop()
+        f1.stop()
+        master.stop()
+
+
+def test_filer_constructor_failure_closes_bound_listener():
+    """The listener binds before store validation (the metalog dir
+    needs the resolved port); a store-setup failure must close it —
+    a leaked bound-but-unserved socket leaves clients hanging in the
+    accept backlog and the port unusable."""
+    import socket
+
+    from seaweedfs_tpu.server.filer_server import FilerServer
+
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    with pytest.raises(ValueError):
+        FilerServer("127.0.0.1:0", host="127.0.0.1", port=port,
+                    store_type="lsm", store_path=":memory:")
+    with socket.socket() as s:          # port fully released
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", port))

@@ -74,6 +74,34 @@ def test_policy_edit_propagates_to_group_members(tmp_path):
     assert not store.get("erin").can_do("Read", "docs", "a")
 
 
+def test_malformed_group_fails_closed_not_mid_recompute(tmp_path):
+    """ISSUE 6 satellite: a malformed group entry (non-dict, bogus
+    member/policy lists) must DROP that group's grant and keep the
+    recompute going — raising mid-recompute left a half-updated grant
+    map (some identities stale, some cleared)."""
+    store = _store(tmp_path)
+    store.put(Identity("carol", [Credential("AK1", "SK1")]))
+    store.put(Identity("dave", [Credential("AK2", "SK2")]))
+    store.put_policy("docs-rw", POLICY_RW_DOCS)
+    store.put_group("writers", {"name": "writers",
+                                "members": ["carol"],
+                                "policyNames": ["docs-rw"]})
+    assert store.get("carol").can_do("Write", "docs", "a.txt")
+    # a malformed group lands (corrupt config push): non-list members
+    store.put_group("broken", {"members": 42,
+                               "policyNames": ["docs-rw"]})
+    # ...and an outright non-dict entry straight in the map, as a
+    # corrupted s3.json reload would produce
+    store._groups["worse"] = "not-a-dict"
+    store.put_group("also", {"members": ["dave"],
+                             "policyNames": 7})
+    # no exception above, the healthy group's grant still stands, and
+    # the malformed ones granted nothing
+    carol = store.get("carol")
+    assert carol.can_do("Write", "docs", "a.txt")
+    assert not store.get("dave").can_do("Write", "docs", "a.txt")
+
+
 # -- service accounts ------------------------------------------------------
 
 

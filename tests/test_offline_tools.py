@@ -137,6 +137,57 @@ def test_fix_handles_superblock_extra(tmp_path):
     v.close()
 
 
+def test_fix_and_merge_survive_deleted_flag_high_bit(tmp_path):
+    """ISSUE 6 satellite: reference-format volumes mark in-place
+    deletions by setting the size field's HIGH BIT (the C++ scanner
+    masks with 0x7FFFFFFF, native/volume_tool.cc).  walk_dat fed the
+    signed int32 into the record math, so offline `fix`/merge
+    recovery crashed on the first deleted record; now the mark is
+    masked and the record folds as a deletion."""
+    import struct
+
+    from seaweedfs_tpu.storage.volume import walk_dat
+
+    v = Volume(str(tmp_path), 31)
+    v.write_needle(Needle(cookie=1, id=1, data=b"doomed record"))
+    v.write_needle(Needle(cookie=2, id=2, data=b"live record"))
+    v.close()
+    dat = tmp_path / "31.dat"
+    raw = bytearray(dat.read_bytes())
+    # flip the deleted bit on needle 1's size field, in place (header
+    # layout: cookie[4] id[8] size[4], big-endian)
+    recs = list(walk_dat(str(dat)))
+    assert len(recs) == 2
+    off1 = next(off for n, off in recs if n.id == 1)
+    size_u32 = struct.unpack_from(">I", raw, off1 + 12)[0]
+    struct.pack_into(">I", raw, off1 + 12, size_u32 | 0x80000000)
+    dat.write_bytes(bytes(raw))
+    # the scan no longer crashes, walks BOTH records, and surfaces
+    # the marked one as a deletion (zero data) at its true length
+    recs = list(walk_dat(str(dat)))
+    assert [n.id for n, _ in recs] == [1, 2]
+    marked = recs[0][0]
+    # surfaced as a deletion, with the size MASKED back to the true
+    # (positive) body length so the scan advanced past it correctly
+    assert marked.data == b"" and marked.size > 0
+    assert recs[1][0].data == b"live record"
+    # `fix` replays it as a tombstone row and the survivor reads back
+    (tmp_path / "31.idx").unlink()
+    r = _cli("fix", "-dir", str(tmp_path), "-volumeId", "31")
+    assert r.returncode == 0, r.stderr
+    assert "1 writes" in r.stdout and "1 tombstones" in r.stdout
+    v = Volume(str(tmp_path), 31)
+    assert v.read_needle(2, 2).data == b"live record"
+    with pytest.raises(KeyError):
+        v.read_needle(1, 1)
+    # merge_from folds the deleted-marked record as a delete too
+    v.read_only = True
+    assert v.merge_from([]) == 1
+    v.read_only = False
+    assert v.read_needle(2, 2).data == b"live record"
+    v.close()
+
+
 def test_version_command():
     r = _cli("version")
     assert r.returncode == 0 and "seaweedfs-tpu" in r.stdout

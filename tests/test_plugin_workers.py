@@ -15,6 +15,14 @@ from seaweedfs_tpu.server.master_server import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
 
 
+def _csrf_of(html: str) -> str:
+    """Scrape the GET-served CSRF token out of a UI page's forms."""
+    import re
+    m = re.search(r"name='csrf' value='([0-9a-f]+)'", html)
+    assert m, "UI page carries no CSRF token"
+    return m.group(1)
+
+
 @pytest.fixture
 def harness(tmp_path):
     master = MasterServer(volume_size_limit_mb=1).start()  # tiny: 1MB
@@ -350,14 +358,17 @@ def test_admin_multi_page_ui_and_config_forms(harness):
         assert "EC volumes" in r.read().decode()
     with urllib.request.urlopen(f"{base}/ui/jobs", timeout=10) as r:
         assert "filter:" in r.read().decode()
-    # config page renders the worker's schema as a form
+    # config page renders the worker's schema as a form, including
+    # the CSRF token every UI write must echo back
     with urllib.request.urlopen(f"{base}/ui/config", timeout=10) as r:
         html = r.read().decode()
     assert "erasure_coding" in html and "<form" in html
+    csrf = _csrf_of(html)
     # submit a value through the FORM path; it lands in the store
     field = admin.schemas["erasure_coding"][0]["name"]
     data = urllib.parse.urlencode(
-        {"jobType": "erasure_coding", field: "123"}).encode()
+        {"jobType": "erasure_coding", field: "123",
+         "csrf": csrf}).encode()
     req = urllib.request.Request(f"{base}/ui/config", data=data,
                                  method="POST")
     try:
@@ -367,7 +378,7 @@ def test_admin_multi_page_ui_and_config_forms(harness):
     assert float(admin.config["erasure_coding"][field]) == 123
     # bad job type through the form: validation error page, no crash
     data = urllib.parse.urlencode(
-        {"jobType": "nope", "x": "1"}).encode()
+        {"jobType": "nope", "x": "1", "csrf": csrf}).encode()
     req = urllib.request.Request(f"{base}/ui/config", data=data,
                                  method="POST")
     try:
@@ -386,11 +397,14 @@ def test_admin_ui_actions(harness):
     import urllib.request
     master, servers, admin, worker = harness
     base = f"http://{admin.url}"
+    with urllib.request.urlopen(f"{base}/ui/jobs", timeout=10) as r:
+        csrf = _csrf_of(r.read().decode())
 
     def post(data):
         req = urllib.request.Request(
             f"{base}/ui/actions",
-            data=urllib.parse.urlencode(data).encode(),
+            data=urllib.parse.urlencode(
+                dict(data, csrf=csrf)).encode(),
             method="POST")
         try:
             with urllib.request.urlopen(req, timeout=10) as r:
@@ -428,3 +442,57 @@ def test_admin_ui_actions(harness):
                        for j in admin.jobs.values())
     st, _ = post({"action": "wat"})
     assert st == 400
+
+
+def test_admin_ui_writes_require_csrf_and_admin_key(harness):
+    """UI write endpoints fail closed: a POST without the GET-served
+    CSRF token is 403 (cross-site form protection), and with a
+    security.toml admin key configured, a POST without admin
+    credentials is 403 even WITH a valid token."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+    from seaweedfs_tpu import security
+    master, servers, admin, worker = harness
+    base = f"http://{admin.url}"
+
+    def post(path, data):
+        req = urllib.request.Request(
+            f"{base}{path}",
+            data=urllib.parse.urlencode(data).encode(),
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    # no token -> 403, nothing mutated
+    st, body = post("/ui/actions", {"action": "detect"})
+    assert st == 403 and b"CSRF" in body
+    st, body = post("/ui/config",
+                    {"jobType": "erasure_coding",
+                     admin.schemas["erasure_coding"][0]["name"]: "7"})
+    assert st == 403
+    # forged token -> 403
+    st, _ = post("/ui/actions", {"action": "detect",
+                                 "csrf": "f" * 32})
+    assert st == 403
+    # valid token, admin key armed, no credentials -> 403
+    with urllib.request.urlopen(f"{base}/ui/jobs", timeout=10) as r:
+        csrf = _csrf_of(r.read().decode())
+    old = security.current()
+    try:
+        security.configure(
+            security.SecurityConfig(admin_key="ui-admin-key"))
+        st, body = post("/ui/actions", {"action": "detect",
+                                        "csrf": csrf})
+        assert st == 403 and b"admin credentials" in body
+        # with the admin jwt (?jwt= form a browser bookmark carries)
+        # AND the token, the write goes through
+        jwt = security.current().admin_jwt()
+        st, _ = post(f"/ui/actions?jwt={jwt}",
+                     {"action": "detect", "csrf": csrf})
+        assert st in (200, 303)
+    finally:
+        security.configure(old)

@@ -1,0 +1,381 @@
+"""QoS plane unit tests (seaweedfs_tpu/qos.py): token buckets,
+per-tenant admission, tenant extraction, TOML config, the feedback
+throttle's p99 math and pace state machine, and the httpd middleware +
+runtime /debug/qos lever on a bare listener."""
+
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu import qos, security
+from seaweedfs_tpu.stats import Metrics
+
+
+@pytest.fixture(autouse=True)
+def _qos_isolation():
+    yield
+    qos.reset()
+
+
+# -- token bucket ---------------------------------------------------------
+
+def test_token_bucket_rate_and_retry_after():
+    b = qos.TokenBucket(rate=10, burst=2)
+    assert b.try_take() == 0.0
+    assert b.try_take() == 0.0
+    wait = b.try_take()
+    assert 0.0 < wait <= 0.11          # one token refills in 100ms
+    time.sleep(wait + 0.01)
+    assert b.try_take() == 0.0
+
+
+def test_token_bucket_unlimited_and_burst_default():
+    assert qos.TokenBucket(0, 0).try_take() == 0.0
+    b = qos.TokenBucket(5, 0)          # burst defaults to max(rps, 1)
+    assert b.burst == 5
+
+
+# -- admission controller -------------------------------------------------
+
+def _cfg(**tenants):
+    return qos.QosConfig(
+        enabled=True,
+        tenants={k: qos.TenantLimit(**v) for k, v in tenants.items()})
+
+
+def test_admission_rate_reject_and_unconfigured_tenant():
+    ctl = qos.AdmissionController()
+    ctl.configure(_cfg(noisy=dict(rps=2, burst=2)))
+    assert ctl.admit("noisy")[1] is None
+    assert ctl.admit("noisy")[1] is None
+    rej = ctl.admit("noisy")[1]
+    assert rej is not None and rej.reason == "rate"
+    assert rej.retry_after > 0
+    # no default configured: unknown tenants are unlimited
+    assert ctl.admit("calm")[1] is None
+
+
+def test_admission_default_limit_applies_to_everyone():
+    ctl = qos.AdmissionController()
+    cfg = qos.QosConfig(enabled=True,
+                        default=qos.TenantLimit(rps=1, burst=1))
+    ctl.configure(cfg)
+    assert ctl.admit("anyone")[1] is None
+    assert ctl.admit("anyone")[1].reason == "rate"
+
+
+def test_admission_inflight_bytes_and_release():
+    ctl = qos.AdmissionController()
+    ctl.configure(_cfg(t=dict(rps=1000, burst=1000, inflight_mb=1)))
+    r1, rej = ctl.admit("t", 800 << 10)
+    assert rej is None
+    _, rej = ctl.admit("t", 800 << 10)
+    assert rej is not None and rej.reason == "inflight_bytes"
+    r1()                                # completion frees the bytes
+    r1()                                # double-release is a no-op
+    r3, rej = ctl.admit("t", 800 << 10)
+    assert rej is None and ctl.inflight_of("t") == 800 << 10
+    r3()
+    assert ctl.inflight_of("t") == 0
+
+
+def test_admission_disabled_is_inert():
+    ctl = qos.AdmissionController()
+    cfg = _cfg(t=dict(rps=1, burst=1))
+    cfg.enabled = False
+    ctl.configure(cfg)
+    for _ in range(50):
+        assert ctl.admit("t")[1] is None
+
+
+def test_runtime_set_tenant_and_default():
+    ctl = qos.AdmissionController()
+    ctl.set_tenant("eve", qos.TenantLimit(rps=1, burst=1))
+    assert ctl.config().enabled         # first lever arms the plane
+    assert ctl.admit("eve")[1] is None
+    assert ctl.admit("eve")[1].reason == "rate"
+    ctl.set_tenant("*", qos.TenantLimit(rps=1, burst=1))
+    assert ctl.admit("other")[1] is None
+    assert ctl.admit("other")[1].reason == "rate"
+    ctl.set_tenant("eve", None)         # removal falls back to default
+    snap = ctl.snapshot()
+    assert "eve" not in snap["config"]["tenants"]
+
+
+# -- tenant extraction ----------------------------------------------------
+
+class _Req:
+    def __init__(self, headers=None, query=None):
+        self.headers = headers or {}
+        self.query = query or {}
+
+
+def test_tenant_of_sigv4_header_and_presigned_query():
+    r = _Req({"Authorization":
+              "AWS4-HMAC-SHA256 Credential=AKIDEXAMPLE/20260803/"
+              "us-east-1/s3/aws4_request, SignedHeaders=host, "
+              "Signature=abc"})
+    assert qos.tenant_of(r) == "AKIDEXAMPLE"
+    r = _Req(query={"X-Amz-Credential":
+                    "AKPRESIGN/20260803/us-east-1/s3/aws4_request"})
+    assert qos.tenant_of(r) == "AKPRESIGN"
+
+
+def test_tenant_of_tag_jwt_and_anonymous():
+    assert qos.tenant_of(_Req({"X-Tenant": "loadgen-7"})) == "loadgen-7"
+    tok = security.gen_jwt("k", {"admin": True}, 60)
+    assert qos.tenant_of(
+        _Req({"Authorization": f"Bearer {tok}"})) == "admin"
+    assert qos.tenant_of(_Req()) == "anonymous"
+    assert qos.tenant_of(
+        _Req({"Authorization": "Bearer not-a-jwt"})) == "anonymous"
+
+
+# -- TOML -----------------------------------------------------------------
+
+def test_load_qos_toml(tmp_path):
+    p = tmp_path / "security.toml"
+    p.write_text("""
+[admin]
+key = "k"
+[qos]
+enabled = true
+slo_p99_ms = 150
+pace_max_ms = 500
+[qos.default]
+rps = 100
+burst = 200
+inflight_mb = 64
+[qos.tenants.noisy]
+rps = 5
+burst = 5
+""")
+    cfg = qos.load_qos_toml(str(p))
+    assert cfg.enabled and cfg.slo_p99_ms == 150
+    assert cfg.pace_max_ms == 500
+    assert cfg.default.rps == 100 and cfg.default.inflight_mb == 64
+    assert cfg.tenants["noisy"].rps == 5
+
+
+def test_load_qos_toml_absent_section_and_malformed(tmp_path):
+    p = tmp_path / "sec.toml"
+    p.write_text('[admin]\nkey = "k"\n')
+    assert qos.load_qos_toml(str(p)) is None
+    p.write_text('[qos]\n[qos.default]\nrps = -3\n')
+    with pytest.raises(ValueError):
+        qos.load_qos_toml(str(p))
+
+
+# -- p99 + feedback throttle ----------------------------------------------
+
+def test_histogram_p99_interpolation():
+    buckets = (0.01, 0.1, 1.0)
+    assert qos.histogram_p99(buckets, [0, 0, 0, 0]) == 0.0
+    # all 100 in the first bucket: p99 interpolates inside (0, 0.01]
+    p = qos.histogram_p99(buckets, [100, 0, 0, 0])
+    assert 0.0 < p <= 0.01
+    # 2% at 1.0: p99 lands in the (0.1, 1.0] bucket
+    p = qos.histogram_p99(buckets, [98, 0, 2, 0])
+    assert 0.1 < p <= 1.0
+    # observations beyond the largest bucket: reports the top edge
+    assert qos.histogram_p99(buckets, [0, 0, 0, 10]) == 1.0
+
+
+def test_feedback_throttle_downshift_and_recovery():
+    m = Metrics("volume_server")
+    th = qos.throttle()
+    th.add_metrics("unit", m)
+    try:
+        # configure WITHOUT qos.configure(): that would start the
+        # watcher thread and race these manual samples
+        qos.controller().configure(qos.QosConfig(
+            enabled=True, slo_p99_ms=100,
+            pace_min_ms=20, pace_max_ms=80))
+        for _ in range(20):
+            m.histogram_observe("request_seconds", 0.002,
+                                method="GET", code="200")
+        th.sample_now()
+        assert th.pace() == 0.0
+        # degraded traffic: pace appears and doubles to the cap
+        paces = []
+        for _ in range(4):
+            for _ in range(20):
+                m.histogram_observe("request_seconds", 0.5,
+                                    method="GET", code="200")
+            paces.append(th.sample_now())
+        assert paces[0] == pytest.approx(0.020)
+        assert paces[1] == pytest.approx(0.040)
+        assert th.pace() == pytest.approx(0.080)   # capped
+        # ec_pace actually stalls a background window now
+        t0 = time.monotonic()
+        assert qos.ec_pace("encode") > 0
+        assert time.monotonic() - t0 >= 0.05
+        # healthy traffic: halve, halve, zero
+        for _ in range(50):
+            m.histogram_observe("request_seconds", 0.002,
+                                method="GET", code="200")
+        th.sample_now()
+        assert th.pace() == pytest.approx(0.040)
+        th.sample_now()
+        assert th.pace() == pytest.approx(0.020)
+        th.sample_now()
+        assert th.pace() == 0.0
+        assert qos.ec_pace("encode") == 0.0        # no-op when healthy
+    finally:
+        th.remove_source("unit")
+
+
+def test_throttle_scrapes_remote_metrics():
+    from seaweedfs_tpu.server.httpd import HttpServer
+    m = Metrics("volume_server")
+    for _ in range(10):
+        m.histogram_observe("request_seconds", 0.3,
+                            method="GET", code="200")
+    http = HttpServer()
+    http.route("GET", "/metrics",
+               lambda req: (200, (m.render().encode(), "text/plain")))
+    http.start()
+    try:
+        snap = qos._scrape_request_seconds(http.url)
+        assert snap is not None
+        assert sum(snap["counts"]) == \
+            m.histogram_merged("request_seconds")["count"]
+        # a remote_slo_watch context wires it as a throttle source
+        qos.controller().configure(
+            qos.QosConfig(enabled=True, slo_p99_ms=100))
+        with qos.remote_slo_watch([http.url]):
+            assert any(s.startswith("remote:")
+                       for s in qos.throttle().snapshot()["sources"])
+        assert not any(s.startswith("remote:")
+                       for s in qos.throttle().snapshot()["sources"])
+    finally:
+        http.stop()
+
+
+# -- middleware + runtime lever on a live listener ------------------------
+
+def test_admission_middleware_and_debug_lever():
+    from seaweedfs_tpu.server.debug import install_debug_routes
+    from seaweedfs_tpu.server.httpd import (HttpServer, http_bytes,
+                                            http_json)
+    http = HttpServer()
+    http.route("GET", "/x", lambda req: (200, {"ok": True}))
+    qos.install(http, "test")
+    install_debug_routes(http)
+    http.start()
+    try:
+        url = http.url
+        qos.controller().configure(_cfg(noisy=dict(rps=1, burst=1)))
+        st, _, _ = http_bytes("GET", f"{url}/x",
+                              headers={"X-Tenant": "noisy"},
+                              timeout=10)
+        assert st == 200
+        st, body, h = http_bytes("GET", f"{url}/x",
+                                 headers={"X-Tenant": "noisy"},
+                                 timeout=10)
+        assert st == 503 and b"qos" in body
+        assert int(h["Retry-After"]) >= 1
+        # another tenant rides free; the debug plane is exempt even
+        # for the throttled tenant (the lever must stay reachable)
+        assert http_bytes("GET", f"{url}/x",
+                          headers={"X-Tenant": "calm"},
+                          timeout=10)[0] == 200
+        assert http_bytes("GET", f"{url}/debug/qos",
+                          headers={"X-Tenant": "noisy"},
+                          timeout=10)[0] == 200
+        # runtime lever round-trip: set -> read back -> clear
+        r = http_json("POST", f"{url}/debug/qos",
+                      {"tenant": "eve", "rps": 7, "burst": 9,
+                       "inflightMb": 3}, timeout=10)
+        assert r["config"]["tenants"]["eve"] == \
+            {"rps": 7.0, "burst": 9.0, "inflightMb": 3.0}
+        r = http_json("GET", f"{url}/debug/qos", timeout=10)
+        assert r["config"]["tenants"]["eve"]["rps"] == 7.0
+        r = http_json("POST", f"{url}/debug/qos",
+                      {"sloP99Ms": 250}, timeout=10)
+        assert r["config"]["sloP99Ms"] == 250.0
+        r = http_json("POST", f"{url}/debug/qos", {"clear": True},
+                      timeout=10)
+        assert r["config"]["tenants"] == {} and \
+            not r["config"]["enabled"]
+        # rejections were counted in the process registry
+        from seaweedfs_tpu import stats
+        text = stats.render_process()
+        assert 'qos_rejected_total{reason="rate",role="test"' \
+            in text.replace("tenant=", "").replace('"noisy",', "")
+    finally:
+        http.stop()
+
+
+def test_rejected_metric_labels():
+    """The counter carries tenant/role/reason labels exactly."""
+    from seaweedfs_tpu import stats
+    ctl = qos.controller()
+    ctl.configure(_cfg(m7=dict(rps=1, burst=1)))
+
+    class _FakeHttp:
+        admission = None
+    fake = _FakeHttp()
+    qos.install(fake, "labelrole")
+    req = _Req({"X-Tenant": "m7", "Content-Length": "0"})
+    req.path = "/data"
+    req.query = {}
+    assert fake.admission(req)[0] is None
+    denied, _ = fake.admission(req)
+    assert denied is not None and denied[0] == 503
+    text = stats.render_process()
+    assert ('qos_rejected_total{reason="rate",role="labelrole",'
+            'tenant="m7"}') in text
+
+
+# -- review regressions ---------------------------------------------------
+
+def test_sub_one_burst_still_limits():
+    """A configured burst in (0, 1) is clamped inside the bucket; the
+    staleness check must compare CONFIGURED values or the bucket is
+    recreated (full) on every admit and the tenant runs unlimited."""
+    ctl = qos.AdmissionController()
+    ctl.configure(_cfg(scraper=dict(rps=0.5, burst=0.5)))
+    assert ctl.admit("scraper")[1] is None      # the one clamped token
+    rejected = sum(1 for _ in range(10)
+                   if ctl.admit("scraper")[1] is not None)
+    assert rejected == 10
+
+
+def test_from_json_rejects_negative_limits():
+    with pytest.raises(ValueError):
+        qos.TenantLimit.from_json({"rps": -5})
+    with pytest.raises(ValueError):
+        qos.TenantLimit.from_json({"burst": -1})
+    with pytest.raises(ValueError):
+        qos.TenantLimit.from_json({"inflightMb": -1})
+
+
+def test_remote_slo_watch_refcounts_shared_urls():
+    """Concurrent worker jobs with overlapping url lists: the first
+    job's exit must not remove a scrape source the second still
+    needs."""
+    qos.configure(qos.QosConfig(enabled=True, slo_p99_ms=100))
+    url = "http://127.0.0.1:1"
+    a = qos.remote_slo_watch([url])
+    b = qos.remote_slo_watch([url, "http://127.0.0.1:2"])
+    a.__enter__()
+    b.__enter__()
+    a.__exit__(None, None, None)
+    labels = qos.throttle().snapshot()["sources"]
+    assert f"remote:{url}" in labels            # b still watching
+    b.__exit__(None, None, None)
+    labels = qos.throttle().snapshot()["sources"]
+    assert f"remote:{url}" not in labels
+    assert "remote:http://127.0.0.1:2" not in labels
+
+
+def test_forced_pace_survives_nothing_after_clear():
+    """The paceMs big-red-button with no SLO configured has no watcher
+    thread to decay it — the debug lever's clear arm resets it via
+    set_pace(0.0); qos.configure(None) alone must not be relied on."""
+    qos.throttle().set_pace(1.5)
+    qos.configure(None)
+    qos.throttle().set_pace(0.0)        # what /debug/qos clear does
+    assert qos.ec_pace("encode") == 0.0

@@ -1,0 +1,261 @@
+"""Soak scenarios (tests/soak.py rig): tier-1 fast subset — sustained
+mixed tenant traffic + EC churn with QoS armed, fairness + SLO +
+byte-identity assertions — and a `slow`-marked proc-cluster long run
+driven through the `[qos]` security.toml section."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu import qos
+from seaweedfs_tpu.server.httpd import http_json
+
+from chaos import metric_sum, metrics_text
+from soak import (EcChurn, SoakCluster, TenantTraffic, arm_qos,
+                  assert_rate_capped)
+
+
+@pytest.fixture(autouse=True)
+def _qos_isolation():
+    yield
+    qos.reset()
+
+
+NOISY_RPS = 4.0
+
+
+def test_soak_fast_mixed_load_noisy_tenant_capped(tmp_path):
+    """The acceptance shape, compressed to tier-1 scale: a noisy
+    tenant offering unbounded load + a paced foreground tenant + a
+    real encode->lose-shards->rebuild churn round, with QoS armed via
+    the runtime lever.  The noisy tenant is capped at its token rate
+    (503 + Retry-After), the foreground tenant stays error-free and
+    inside a (generous, CI-box) latency SLO, every acked byte reads
+    back identical — including through the EC read path — and the
+    chaos invariants (no stranded temps, volumes writable) hold."""
+    sc = SoakCluster(tmp_path, volumes=3)
+    try:
+        ec_vols = sc.prepare_ec_volumes(rounds=1)
+        # arm over the HTTP lever (the operator path); in-process all
+        # roles share the controller, one POST arms the whole cluster
+        arm_qos(sc.filer_url, {"tenant": "noisy", "rps": NOISY_RPS,
+                               "burst": NOISY_RPS})
+        fg = TenantTraffic(sc.filer_url, "fg", payload=1200,
+                           target_rps=12, seed=11).start()
+        noisy = TenantTraffic(sc.filer_url, "noisy", payload=1200,
+                              target_rps=None, seed=22).start()
+        churn = EcChurn(sc.master_url, ec_vols).start()
+        time.sleep(6.0)
+        noisy.stop()
+        fg.stop()
+        churn.join(timeout=120)
+
+        # fairness: the noisy tenant was throttled and held to rate
+        assert_rate_capped(noisy.stats, NOISY_RPS)
+        assert noisy.stats.retry_after_seen > 0, \
+            "503s must carry Retry-After (backpressure, not a slam)"
+        # the foreground tenant never errored and met the (loose) SLO
+        assert not fg.stats.errors, fg.stats.errors[:3]
+        assert fg.stats.ok > 10
+        assert fg.stats.p99() < 2.0, fg.stats.summary()
+        # noisy tenant's ADMITTED ops also completed cleanly
+        assert not noisy.stats.errors, noisy.stats.errors[:3]
+        # background churn completed its round despite QoS
+        assert not churn.errors, churn.errors
+        assert churn.rounds_done == 1
+        # byte identity: filer-path writes and the EC read path
+        arm_qos(sc.filer_url, {"clear": True})
+        assert fg.verify_all() > 0
+        assert noisy.verify_all() > 0
+        churn.verify_blobs()
+        # chaos invariants still hold with QoS armed
+        sc.cluster.assert_no_debris()
+        # admission metrics surfaced on the shared process registry
+        text = metrics_text(sc.filer_url)
+        assert metric_sum(text,
+                          "seaweedfs_tpu_qos_rejected_total",
+                          tenant="noisy") > 0
+        assert metric_sum(text,
+                          "seaweedfs_tpu_qos_admitted_total",
+                          tenant="fg") > 0
+    finally:
+        sc.stop()
+
+
+def test_ec_throttle_downshifts_under_degraded_p99_and_recovers(
+        tmp_path):
+    """ISSUE checklist: the EC pipelines pace when foreground p99
+    violates the SLO and resume at full speed when it recovers —
+    driven deterministically (synthetic request_seconds observations
+    + manual throttle samples), verified against a REAL scatter
+    encode on a live cluster via the qos_ec_paced_total counter."""
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    from chaos import Cluster
+    c = Cluster(tmp_path, volumes=3)
+    try:
+        vid, blobs = c.fill_volume(n=10, seed=5)
+        vs = c.servers[0]
+        # SLO armed via the volume server's runtime lever
+        arm_qos(vs.http.url, {"sloP99Ms": 100, "paceMinMs": 30,
+                              "paceMaxMs": 120})
+        qos.throttle().stop()        # manual sampling: deterministic
+        th = qos.throttle()
+        # baseline, then degraded foreground on the volume role
+        for _ in range(20):
+            vs.metrics.histogram_observe("request_seconds", 0.002,
+                                         method="GET", code="200")
+        th.sample_now()
+        for _ in range(20):
+            vs.metrics.histogram_observe("request_seconds", 0.8,
+                                         method="GET", code="200")
+        pace = th.sample_now()
+        assert pace > 0, "throttle must downshift on violated SLO"
+        paced_before = _paced_total()
+        env = CommandEnv(c.master_url)
+        env.lock()
+        run_command(env, f"ec.encode -volumeId={vid}")
+        assert _paced_total() > paced_before, \
+            "scatter encode ran without consulting the QoS pace"
+        # recovery: healthy samples drop the pace back to zero
+        for _ in range(100):
+            vs.metrics.histogram_observe("request_seconds", 0.002,
+                                         method="GET", code="200")
+        for _ in range(6):
+            th.sample_now()
+        assert th.pace() == 0.0
+        assert qos.ec_pace("encode") == 0.0
+        # the encode completed correctly while paced
+        for fid, want in list(blobs.items())[:3]:
+            from seaweedfs_tpu import operation
+            assert operation.read(c.master_url, fid) == want
+    finally:
+        c.stop()
+
+
+def _paced_total() -> float:
+    from seaweedfs_tpu import stats
+    return metric_sum(stats.render_process(),
+                      "seaweedfs_tpu_qos_ec_paced_total")
+
+
+def test_qos_lever_round_trip_on_every_role(tmp_path):
+    """ISSUE checklist: the runtime /debug/qos lever round-trips on
+    master, volume, and filer (same debug plane the chaos suite uses
+    for faults)."""
+    sc = SoakCluster(tmp_path, volumes=1)
+    try:
+        for url in [sc.master_url,
+                    sc.cluster.servers[0].http.url,
+                    sc.filer_url]:
+            r = arm_qos(url, {"tenant": f"t-{url.split(':')[-1]}",
+                              "rps": 9, "burst": 9, "inflightMb": 2})
+            got = r["config"]["tenants"][f"t-{url.split(':')[-1]}"]
+            assert got == {"rps": 9.0, "burst": 9.0,
+                           "inflightMb": 2.0}
+            r2 = http_json("GET", f"{url}/debug/qos", timeout=10)
+            assert r2["config"]["tenants"][
+                f"t-{url.split(':')[-1]}"]["rps"] == 9.0
+    finally:
+        sc.stop()
+
+
+def test_s3_gateway_tenant_is_the_access_key(tmp_path):
+    """Admission at the S3 edge keys tenants by SigV4 access key: the
+    limited key gets 503 + Retry-After past its budget while another
+    key rides free, and an unsigned request still gets auth's 403
+    (admission never pre-empts the auth verdict's shape)."""
+    from seaweedfs_tpu.s3 import S3ApiServer
+    from seaweedfs_tpu.s3.auth import sign_request
+    from seaweedfs_tpu.server.httpd import http_bytes
+
+    sc = SoakCluster(tmp_path, volumes=1)
+    gw = S3ApiServer(sc.filer.filer,
+                     credentials={"AKLIMITED": "sk1",
+                                  "AKFREE": "sk2"}).start()
+    try:
+        arm_qos(sc.filer_url, {"tenant": "AKLIMITED", "rps": 1,
+                               "burst": 1})
+
+        def s3get(ak, sk):
+            h = sign_request("GET", gw.url, "/", {}, {}, b"", ak, sk)
+            return http_bytes("GET", f"{gw.url}/", None, h,
+                              timeout=10)
+
+        st, _, _ = s3get("AKLIMITED", "sk1")
+        assert st == 200
+        st, body, h = s3get("AKLIMITED", "sk1")
+        assert st == 503 and "Retry-After" in h, (st, body)
+        st, _, _ = s3get("AKFREE", "sk2")
+        assert st == 200
+        st, _, _ = http_bytes("GET", f"{gw.url}/", timeout=10)
+        assert st == 403            # anonymous: auth says no, not QoS
+    finally:
+        gw.stop()
+        sc.stop()
+
+
+@pytest.mark.slow
+def test_soak_long_proc_cluster(tmp_path):
+    """Multi-minute mixed soak against REAL server processes with QoS
+    configured via the `[qos]` security.toml section (the production
+    config path): sustained two-tenant load + repeated EC churn, then
+    fairness/SLO/identity assertions and a parseable /metrics check on
+    every role."""
+    import numpy as np
+
+    from seaweedfs_tpu import operation
+    from proc_framework import ProcCluster
+    from prom_text import parse as prom_parse
+
+    cluster = ProcCluster(str(tmp_path), volumes=3, profile="qos",
+                          volume_size_limit_mb=64).start()
+    try:
+        filer = cluster.filer
+        master = cluster.master
+        # pre-fill EC volumes while quiet
+        rng = np.random.default_rng(3)
+        vols = []
+        for i in range(2):
+            blobs = {}
+            for _ in range(10):
+                data = rng.integers(0, 256, 4000,
+                                    dtype=np.uint8).tobytes()
+                blobs[operation.submit(master, data)] = data
+            vids = {int(f.split(",")[0]) for f in blobs}
+            if len(vids) == 1:
+                vols.append((vids.pop(), blobs))
+        assert vols, "no single-volume fill achieved"
+        fg = TenantTraffic(filer, "fg", payload=1500,
+                           target_rps=10, seed=31).start()
+        noisy = TenantTraffic(filer, "noisy", payload=1500,
+                              target_rps=None, seed=32).start()
+        churn = EcChurn(master, vols, loop=True).start()
+        time.sleep(120.0)
+        churn.stop()
+        noisy.stop()
+        fg.stop()
+
+        assert_rate_capped(noisy.stats, 6.0)   # [qos.tenants.noisy]
+        assert not fg.stats.errors, fg.stats.errors[:3]
+        assert fg.stats.p99() < 3.0, fg.stats.summary()
+        assert churn.rounds_done >= 1, churn.errors
+        assert not churn.errors, churn.errors[:2]
+        # identity after the storm (limits still armed: fg/verify
+        # traffic fits inside the default tenant budget)
+        assert fg.verify_all() > 0
+        churn.verify_blobs()
+        # every role still serves parseable metrics incl. QoS families
+        from seaweedfs_tpu.server.httpd import http_bytes
+        roles = [master, filer] + [
+            p.url for n, p in cluster.procs.items()
+            if n.startswith("volume")]
+        for url in roles:
+            st, body, _ = http_bytes("GET", f"{url}/metrics",
+                                     timeout=10)
+            assert st == 200
+            prom_parse(body.decode())
+        st, body, _ = http_bytes("GET", f"{filer}/metrics",
+                                 timeout=10)
+        assert b"qos_rejected_total" in body
+    finally:
+        cluster.stop()
