@@ -810,6 +810,194 @@ def _measure_soak(duration_s: float = 20.0,
     }
 
 
+def _stage_decomposition(parsed: dict, ns: str) -> "dict | None":
+    """One role's write_stage_seconds decomposition from its parsed
+    /metrics (profiling.py helpers): per-stage seconds/calls/mean plus
+    `coverage` — the fraction of tracked per-request wall time the
+    named stages account for.  Coverage is the acceptance number: a
+    decomposition that explains < 80% of the wall is naming the wrong
+    stages (arXiv:1709.05365's point about host-side overheads hiding
+    between the instrumented calls)."""
+    from seaweedfs_tpu import profiling
+    name = f"{ns}_write_stage_seconds"
+    stage_names = sorted({l.get("stage", "") for l, _v in
+                          parsed.get(f"{name}_count", [])} - {""})
+    if not stage_names:
+        return None
+    out: dict = {"stages": {}}
+    total_sum = 0.0
+    staged_sum = 0.0
+    for stage in stage_names:
+        h = profiling.prom_histogram(parsed, name, {"stage": stage})
+        if not h or h["count"] <= 0:
+            continue
+        if stage == "total":
+            total_sum = h["sum"]
+            out["requests"] = h["count"]
+            out["meanTotalMs"] = round(h["sum"] / h["count"] * 1e3, 3)
+            continue
+        staged_sum += h["sum"]
+        out["stages"][stage] = {
+            "seconds": round(h["sum"], 4),
+            "calls": h["count"],
+            "meanMs": round(h["sum"] / h["count"] * 1e3, 3),
+        }
+    if total_sum > 0:
+        out["totalSeconds"] = round(total_sum, 4)
+        for stage, rec in out["stages"].items():
+            rec["shareOfWall"] = round(rec["seconds"] / total_sum, 3)
+        out["coverage"] = round(staged_sum / total_sum, 3)
+    return out
+
+
+def _measure_write_path(nodes: int = 2, writers: int = 4,
+                        seconds: float = 10.0,
+                        payload: int = 4096) -> dict:
+    """ROADMAP item 1's tracker: concurrent small writes through the
+    filer funnel of a loopback proc-cluster, reporting req/s and
+    p50/p99 AND the per-stage decomposition from every role's
+    write_stage_seconds histograms — so each bench round says not just
+    how far from the reference's 15,708 req/s this build is, but WHERE
+    the per-request wall went (filer: recv/assign/upload/meta; volume:
+    recv/lock/index/append/flush).  Emits its record incrementally
+    (_Partial) so a timed-out run still yields the phases that
+    finished."""
+    import shutil
+    import tempfile
+    import threading
+    import time as _time
+
+    from seaweedfs_tpu import profiling
+    from seaweedfs_tpu.server.httpd import http_bytes, http_json
+
+    partial = _Partial()
+    tmp = tempfile.mkdtemp(prefix="bench_write_path_")
+    procs = []
+    try:
+        mport = _free_port()
+        mdir = os.path.join(tmp, "master-meta")
+        os.makedirs(mdir)
+        procs.append(_spawn_role(
+            ["master", "-port", str(mport), "-mdir", mdir,
+             "-volumeSizeLimitMB", "1024"], mport,
+            os.path.join(tmp, "master.log")))
+        master_url = f"127.0.0.1:{mport}"
+        vports = []
+        for i in range(nodes):
+            d = os.path.join(tmp, f"v{i}")
+            os.makedirs(d)
+            vport = _free_port()
+            vports.append(vport)
+            procs.append(_spawn_role(
+                ["volume", "-port", str(vport), "-dir", d,
+                 "-mserver", master_url, "-max", "16"], vport,
+                os.path.join(tmp, f"vol{i}.log")))
+        fport = _free_port()
+        procs.append(_spawn_role(
+            ["filer", "-port", str(fport), "-master", master_url,
+             "-store", os.path.join(tmp, "filer.db")], fport,
+            os.path.join(tmp, "filer.log")))
+        filer_url = f"127.0.0.1:{fport}"
+        deadline = _time.time() + 30
+        while _time.time() < deadline:
+            try:
+                if len(http_json(
+                        "GET", f"{master_url}/cluster/status",
+                        timeout=5)["dataNodes"]) == nodes:
+                    break
+            except OSError:
+                pass
+            _time.sleep(0.1)
+        partial.phase("cluster_up", nodes=nodes)
+
+        rng = np.random.default_rng(7)
+        blob = rng.integers(0, 256, payload, dtype=np.uint8).tobytes()
+        latencies: "list[list[float]]" = [[] for _ in range(writers)]
+        errors = [0]
+        stop = threading.Event()
+
+        def writer(w: int) -> None:
+            i = 0
+            lat = latencies[w]
+            while not stop.is_set():
+                t0 = _time.perf_counter()
+                try:
+                    st, _, _ = http_bytes(
+                        "POST", f"{filer_url}/bench/w{w}/{i}", blob,
+                        {"Content-Type": "application/octet-stream"},
+                        timeout=30)
+                    if st >= 300:
+                        errors[0] += 1
+                    else:
+                        lat.append(_time.perf_counter() - t0)
+                except OSError:
+                    errors[0] += 1
+                i += 1
+
+        threads = [threading.Thread(target=writer, args=(w,),
+                                    daemon=True)
+                   for w in range(writers)]
+        t_start = _time.perf_counter()
+        for t in threads:
+            t.start()
+        _time.sleep(seconds)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        wall = _time.perf_counter() - t_start
+
+        lat = sorted(x for per in latencies for x in per)
+        n = len(lat)
+        rec = {
+            "write_path_writers": writers,
+            "write_path_payload_bytes": payload,
+            "write_path_seconds": round(wall, 2),
+            "write_path_requests": n,
+            "write_path_errors": errors[0],
+            "write_path_req_per_sec": round(n / wall, 1) if wall else 0,
+            "write_path_p50_ms": round(
+                lat[n // 2] * 1e3, 2) if n else 0,
+            "write_path_p99_ms": round(
+                lat[min(n - 1, int(n * 0.99))] * 1e3, 2) if n else 0,
+        }
+        partial.phase("traffic", **rec)
+
+        # per-round attribution: every role's stage decomposition
+        decomp: dict = {}
+        for url, ns, role in (
+                [(filer_url, "filer", "filer")] +
+                [(f"127.0.0.1:{p}", "volume_server", f"volume{i}")
+                 for i, p in enumerate(vports)]):
+            try:
+                st, body, _ = http_bytes("GET", f"{url}/metrics",
+                                         timeout=5)
+            except OSError:
+                continue
+            if st >= 300:
+                continue
+            d = _stage_decomposition(
+                profiling.parse_prom_text(
+                    body.decode("utf-8", "replace")), ns)
+            if d:
+                decomp[role] = d
+        rec["write_path_decomposition"] = decomp
+        coverages = [d["coverage"] for d in decomp.values()
+                     if "coverage" in d]
+        rec["write_path_stage_coverage"] = round(
+            min(coverages), 3) if coverages else 0.0
+        partial.phase("decomposition",
+                      coverage=rec["write_path_stage_coverage"])
+        return rec
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except OSError:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _measure_e2e_tpu_forced(size: int = 128 << 20):
     """The staged encode pipeline with the JAX/TPU backend FORCED
     (VERDICT r4 #3: the headline kernel number is device-side; the
@@ -883,7 +1071,10 @@ def _emit(gbps, backend, shard_bytes, note=None, e2e=None, h2d=None,
 
 
 def measure(platform: str) -> None:
-    """Child-process mode: run the device measurement and print the JSON."""
+    """Child-process mode: run the device measurement and print the JSON.
+    Every phase boundary flushes an incremental record (_Partial) so a
+    timeout mid-pipeline still leaves the finished phases on disk."""
+    partial = _Partial()
     if platform == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -933,6 +1124,7 @@ def measure(platform: str) -> None:
         best_dt = min(best_dt, (time.perf_counter() - t0) / CHAIN)
 
     gbps = (DATA_SHARDS * shard_bytes) / best_dt / 1e9
+    partial.phase("kernel", gbps=round(gbps, 2), backend=backend)
     note = None
     if not on_tpu:
         # no reachable device: the engine this build actually runs on
@@ -961,6 +1153,7 @@ def measure(platform: str) -> None:
             int(dev[0, 0])
             best = min(best, time.perf_counter() - t0)
         h2d = round(DATA_SHARDS * shard_bytes / best / 1e9, 2)
+    partial.phase("h2d", h2d_gbps=h2d)
 
     # Feed-rate probe: the engine the e2e pipeline will actually run
     # (fresh measurement each bench run, also refreshes the disk cache
@@ -971,6 +1164,7 @@ def measure(platform: str) -> None:
     except Exception as exc:
         print(f"bench: backend probe failed: {exc!r}", file=sys.stderr)
         probe = None
+    partial.phase("probe", choice=(probe or {}).get("choice"))
 
     try:
         e2e = _measure_e2e(on_tpu, probe)
@@ -978,6 +1172,7 @@ def measure(platform: str) -> None:
         print(f"bench: e2e measurement failed: {exc!r}",
               file=sys.stderr)
         e2e = None
+    partial.phase("e2e", gbps=(e2e or {}).get("e2e_gbps"))
     try:
         # loopback-cluster rebuild A/B: copy-then-rebuild vs the
         # slice-pipelined streaming repair path
@@ -985,6 +1180,8 @@ def measure(platform: str) -> None:
     except Exception as exc:
         print(f"bench: dist rebuild measurement failed: {exc!r}",
               file=sys.stderr)
+    partial.phase("dist_rebuild",
+                  speedup=(e2e or {}).get("dist_rebuild_speedup"))
     try:
         # loopback-cluster encode A/B: encode-locally-then-balance vs
         # scatter-encode streaming shards to their placement targets
@@ -992,6 +1189,8 @@ def measure(platform: str) -> None:
     except Exception as exc:
         print(f"bench: dist encode measurement failed: {exc!r}",
               file=sys.stderr)
+    partial.phase("dist_encode",
+                  speedup=(e2e or {}).get("dist_encode_speedup"))
     if on_tpu:
         # VERDICT r4 #3: publish the TPU-backed e2e number (the probed
         # pipeline chooses the faster native engine on this tunneled
@@ -1003,15 +1202,66 @@ def measure(platform: str) -> None:
         except Exception as exc:
             print(f"bench: tpu-forced e2e failed: {exc!r}",
                   file=sys.stderr)
+        partial.phase("tpu_forced_e2e")
     _emit(gbps, backend, shard_bytes, note=note, e2e=e2e, h2d=h2d,
           probe=probe)
 
 
+class _Partial:
+    """Incremental bench record (the BENCH_r05 lesson: the TPU arm
+    timed out and yielded NOTHING).  Each completed phase is flushed
+    atomically to $BENCH_PARTIAL_PATH as it lands, with per-phase
+    elapsed seconds — so when an arm is killed at its timeout, the
+    parent salvages a diagnosable record saying which phase finished,
+    how long each took, and which one it died in, instead of an empty
+    hand.  No env var set (direct scenario runs) -> in-memory only."""
+
+    def __init__(self):
+        self.path = os.environ.get("BENCH_PARTIAL_PATH", "")
+        self._t0 = time.monotonic()
+        self._last = self._t0
+        self.doc: dict = {"partial": True, "phases": {},
+                          "phaseSeconds": {}}
+
+    def phase(self, name: str, **data) -> None:
+        now = time.monotonic()
+        self.doc["phases"][name] = {
+            k: v for k, v in data.items() if v is not None}
+        self.doc["phaseSeconds"][name] = round(now - self._last, 3)
+        self.doc["elapsedSeconds"] = round(now - self._t0, 3)
+        self.doc["lastPhase"] = name
+        self._last = now
+        if not self.path:
+            return
+        try:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.doc, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # partial records must never fail the measurement
+
+
 def _run_child(platform: str, timeout_s: int):
-    """Run `bench.py --measure <platform>` and return its JSON line or None."""
+    """Run `bench.py --measure <platform>`; returns (json_line, partial)
+    — json_line is None on failure/timeout, partial is whatever phase
+    record the child managed to flush before dying (or None)."""
+    import tempfile
     env = dict(os.environ)
     if platform == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
+    partial_path = os.path.join(
+        tempfile.gettempdir(),
+        f"bench_partial_{platform}_{os.getpid()}.json")
+    env["BENCH_PARTIAL_PATH"] = partial_path
+
+    def read_partial():
+        try:
+            with open(partial_path) as f:
+                doc = json.load(f)
+            return doc if isinstance(doc, dict) else None
+        except (OSError, ValueError):
+            return None
     # start_new_session + killpg: a hung TPU-runtime grandchild inheriting
     # the capture pipes would otherwise keep communicate() blocked after
     # the direct child is killed — the exact parent hang this guards.
@@ -1033,18 +1283,35 @@ def _run_child(platform: str, timeout_s: int):
             pass
         print(f"bench: --measure {platform} timed out after {timeout_s}s",
               file=sys.stderr)
-        return None
+        partial = read_partial()
+        if partial is not None:
+            partial["timeoutS"] = timeout_s
+            partial["platform"] = platform
+        _rm_quiet(partial_path)
+        return None, partial
+    partial = read_partial()
+    _rm_quiet(partial_path)
     for line in reversed(stdout.splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
                 json.loads(line)
-                return line
+                return line, None
             except ValueError:
                 continue
     print(f"bench: --measure {platform} rc={proc.returncode}, no JSON; "
           f"stderr tail: {stderr[-2000:]}", file=sys.stderr)
-    return None
+    if partial is not None:
+        partial["rc"] = proc.returncode
+        partial["platform"] = platform
+    return None, partial
+
+
+def _rm_quiet(path: str) -> None:
+    try:
+        os.remove(path)
+    except OSError:
+        pass
 
 
 def _numpy_fallback() -> None:
@@ -1059,9 +1326,21 @@ def _numpy_fallback() -> None:
 
 
 def main() -> None:
-    line = _run_child("tpu", TPU_TIMEOUT_S)
+    line, tpu_partial = _run_child("tpu", TPU_TIMEOUT_S)
     if line is None:
-        line = _run_child("cpu", CPU_TIMEOUT_S)
+        line, cpu_partial = _run_child("cpu", CPU_TIMEOUT_S)
+        if line is not None and tpu_partial is not None:
+            # the timed-out TPU arm's phase record rides along on the
+            # successful arm's JSON — a diagnosable trail, not silence
+            rec = json.loads(line)
+            rec["tpu_partial"] = tpu_partial
+            line = json.dumps(rec)
+        elif line is None:
+            for partial in (tpu_partial, cpu_partial):
+                if partial is not None:
+                    print(json.dumps(dict(partial, metric=(
+                        "ec_encode_rs10+4_GBps_per_chip")),
+                        ), file=sys.stderr)
     if line is not None:
         print(line)
         return
@@ -1089,6 +1368,14 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 2 and sys.argv[1] == "dist_rebuild":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         print(json.dumps(_measure_dist_rebuild()))
+    elif len(sys.argv) >= 2 and sys.argv[1] == "write_path":
+        # write-path throughput + per-stage latency decomposition
+        # (ROADMAP item 1's tracker): one JSON line attributing the
+        # per-request wall across recv/assign/upload/meta (filer) and
+        # recv/lock/index/append/flush (volume)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        dur = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
+        print(json.dumps(_measure_write_path(seconds=dur)))
     elif len(sys.argv) >= 2 and sys.argv[1] == "soak":
         # sustained-load QoS A/B (ISSUE 6): per-tenant p50/p99 with
         # and without the QoS plane, one JSON line

@@ -732,6 +732,77 @@ class MissingAdmissionRule(Rule):
                     f"pass through per-tenant admission")
 
 
+class WallDurationRule(Rule):
+    """SWFS011: `time.time()` arithmetic used to measure a duration.
+    The wall clock steps under NTP — backwards (a measured interval
+    goes negative, a TTL pins stale cache entries alive) or forwards
+    (timeouts fire instantly, a fresh cache flushes on every lookup).
+    Flagged: a subtraction whose operand is a direct `time.time()` /
+    `time.time_ns()` call, or a local name bound to one in the same
+    scope (the t1 - t0 pattern).  Durations belong on
+    `time.monotonic()` / `time.perf_counter()`; wall timestamps are
+    for RECORDS (needle ts, entry mtime), where cross-process
+    comparisons need them — age-of-persisted-timestamp math is the
+    legitimate remainder that lives in the baseline or under
+    `# noqa: SWFS011`."""
+
+    id = "SWFS011"
+    severity = "error"
+    title = "wall clock used to measure a duration"
+
+    _WALL = {"time.time", "time.time_ns"}
+
+    @staticmethod
+    def _local_walk(scope: ast.AST):
+        """Child nodes of `scope` without descending into nested
+        function scopes (their own pass sees them — a name bound in
+        the outer scope is not visible evidence for the inner one)."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def check(self, ctx: FileContext):
+        scopes = [ctx.tree] + [
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        seen: set = set()
+        for scope in scopes:
+            bound: set = set()
+            for n in self._local_walk(scope):
+                if isinstance(n, ast.Assign) and \
+                        isinstance(n.value, ast.Call) and \
+                        _dotted(n.value.func) in self._WALL:
+                    bound.update(t.id for t in n.targets
+                                 if isinstance(t, ast.Name))
+
+            def wallish(x: ast.AST) -> bool:
+                if isinstance(x, ast.Call) and \
+                        _dotted(x.func) in self._WALL:
+                    return True
+                return isinstance(x, ast.Name) and x.id in bound
+
+            for n in self._local_walk(scope):
+                if not (isinstance(n, ast.BinOp) and
+                        isinstance(n.op, ast.Sub)):
+                    continue
+                if not (wallish(n.left) or wallish(n.right)):
+                    continue
+                key = (n.lineno, n.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield self.finding(
+                    ctx, n,
+                    "duration measured on the wall clock — an NTP "
+                    "step skews or negates it; use time.monotonic() "
+                    "(or perf_counter) for intervals")
+
+
 RULES = [
     LockDisciplineRule(),
     JitBlockingRule(),
@@ -743,4 +814,5 @@ RULES = [
     UnclosedShardStreamRule(),
     MissingTimeoutRule(),
     MissingAdmissionRule(),
+    WallDurationRule(),
 ]

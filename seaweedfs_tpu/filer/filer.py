@@ -154,16 +154,24 @@ class Filer:
         (util/limiter, limited_executor.go role): a multi-chunk
         write overlaps its volume-server round trips instead of
         serializing them, with backpressure at the bound."""
+        from .. import profiling
         from ..util.limiter import bounded_parallel
+
+        # capture the handler thread's stage track BEFORE fanning out:
+        # contextvars do not follow the limiter pool's threads, so each
+        # piece re-binds it (operation.assign/upload then report their
+        # assign/upload stages into this request's decomposition)
+        trk = profiling.current_track()
 
         def upload_piece(off: int) -> FileChunk:
             piece = data[off:off + CHUNK_SIZE]
             # fresh-assign retry on volume-state races (a background
             # ec.encode marking the assigned volume readonly mid-write
             # must cost a retry, not surface a 500 to the tenant)
-            a, r = operation.assign_and_upload(
-                self.master, piece, collection=self.collection,
-                replication=self.replication)
+            with profiling.use_track(trk):
+                a, r = operation.assign_and_upload(
+                    self.master, piece, collection=self.collection,
+                    replication=self.replication)
             return FileChunk(a.fid, off, len(piece),
                              r.get("eTag", ""), time.time_ns())
 
@@ -172,10 +180,15 @@ class Filer:
         entry = Entry(normalize_path(path), is_directory=False,
                       attributes=Attributes(mime=mime, mode=mode),
                       chunks=chunks)
-        old = self.find_entry(path)
-        self.create_entry(entry)
+        with profiling.stage("meta"):
+            old = self.find_entry(path)
+            self.create_entry(entry)
         if old is not None and not old.is_directory:
-            self._delete_chunks(old)
+            # separate stage: these are volume-server DELETE round
+            # trips, not metadata-store work — folding them into
+            # "meta" would misattribute overwrite workloads
+            with profiling.stage("gc"):
+                self._delete_chunks(old)
         return entry
 
     def append_chunks(self, path: str, offset: int, data: bytes,

@@ -429,7 +429,7 @@ class WeedFS:
         every write-side op; usage refreshes like weedfs_quota.go)."""
         if self.collection_capacity <= 0:
             return
-        now = time.time()
+        now = time.monotonic()
         if now - self._quota_checked > self.QUOTA_REFRESH_SEC:
             self._quota_checked = now
             try:

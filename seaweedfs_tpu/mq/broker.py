@@ -276,7 +276,9 @@ class BrokerServer:
         if now - ts < 1.0:
             return cached
         live = []
-        cutoff = time.time() - self.BROKER_TTL
+        # registry mtimes are cross-process wall timestamps — the wall
+        # clock is the only clock both sides share
+        cutoff = time.time() - self.BROKER_TTL  # noqa: SWFS011
         for e in self._registry_entries():
             if e.get("attributes", {}).get("mtime", 0) >= cutoff:
                 live.append(e["fullPath"].rsplit("/", 1)[-1])
@@ -332,7 +334,7 @@ class BrokerServer:
         hotter than the threshold doubles its topic's partition count
         through the fenced repartition path (splitting spreads the
         keyspace, so the hot partition's range halves)."""
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             snapshot = [(t, p, log.appended_bytes)
                         for (t, p), log in self._logs.items()]

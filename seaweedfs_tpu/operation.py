@@ -16,7 +16,10 @@ from .server.httpd import http_bytes, http_json
 
 class VidCache:
     """wdclient/vid_map.go: volume-id -> locations with TTL + explicit
-    invalidation on read failure."""
+    invalidation on read failure.  TTL math runs on the monotonic
+    clock (SWFS011): an NTP step backwards would otherwise pin stale
+    locations alive indefinitely, and a step forward would flush a
+    fresh cache on every lookup."""
 
     TTL = 10.0
 
@@ -27,13 +30,13 @@ class VidCache:
     def get(self, master: str, vid: int) -> "list[dict] | None":
         with self._lock:
             hit = self._m.get((master, vid))
-            if hit and time.time() - hit[0] < self.TTL:
+            if hit and time.monotonic() - hit[0] < self.TTL:
                 return hit[1]
         return None
 
     def put(self, master: str, vid: int, locs: list[dict]) -> None:
         with self._lock:
-            self._m[(master, vid)] = (time.time(), locs)
+            self._m[(master, vid)] = (time.monotonic(), locs)
 
     def invalidate(self, master: str, vid: int) -> None:
         with self._lock:
@@ -109,7 +112,9 @@ def assign(master: str, count: int = 1, collection: str = "",
         qs += f"&replication={replication}"
     if ttl:
         qs += f"&ttl={ttl}"
-    r = master_json(master, "GET", f"/dir/assign?{qs}", timeout=30)
+    from . import profiling
+    with profiling.stage("assign"):
+        r = master_json(master, "GET", f"/dir/assign?{qs}", timeout=30)
     if "error" in r:
         raise RuntimeError(f"assign: {r['error']}")
     return Assignment(r["fid"], r["url"], r.get("publicUrl", r["url"]),
@@ -133,8 +138,10 @@ def upload(url: str, fid: str, data: bytes, name: str = "",
         auth = security.current().write_jwt(fid)
     if auth:
         headers["Authorization"] = f"Bearer {auth}"
-    status, body, _ = http_bytes("POST", f"{url}/{fid}{qs}", data, headers,
-                          timeout=60)
+    from . import profiling
+    with profiling.stage("upload"):
+        status, body, _ = http_bytes("POST", f"{url}/{fid}{qs}", data,
+                                     headers, timeout=60)
     if status >= 300:
         raise UploadError(f"upload {fid} -> {status}: {body[:200]!r}",
                           status)

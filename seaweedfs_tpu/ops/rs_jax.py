@@ -22,11 +22,36 @@ every reconstruction pattern of the same shape.
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from . import gf256, rs_matrix
+
+
+def _staged_h2d(flat: np.ndarray) -> jax.Array:
+    """Stage a packed host buffer onto the default device and record
+    the h2d window (profiling.device_note).  Fencing policy matters:
+    on the CPU backend device_put is effectively a synchronous copy,
+    so blocking costs nothing and yields an honest window.  On async
+    backends (TPU) a fence here would serialize the transfer against
+    the compute thread's next-window prep — exactly the overlap the
+    lazy-parity pipeline exists to provide — so there we record bytes
+    only and let the transfer wall fold into the dispatch->fetch
+    kernel window that _PendingParity.materialize times (the host-side
+    fetch is the only fence that backend offers anyway)."""
+    from .. import profiling
+    t0 = time.perf_counter()
+    dev = jax.device_put(flat)
+    if jax.default_backend() == "cpu":
+        dev.block_until_ready()
+        profiling.device_note("h2d", flat.nbytes,
+                              time.perf_counter() - t0)
+    else:
+        profiling.device_note("h2d", flat.nbytes, None)
+    return dev
 
 def _expand_tables(mat: jax.Array) -> jax.Array:
     """[R, K] constant matrix -> [R, K, 8] per-bit multiply tables.
@@ -143,13 +168,33 @@ def gf_apply_matrix(mat, data) -> jax.Array:
 class _PendingParity:
     """An in-flight device parity launch (see ReedSolomonJax.parity_lazy)."""
 
-    def __init__(self, out32: jax.Array, nbytes: int):
+    def __init__(self, out32: jax.Array, nbytes: int,
+                 dispatched_at: float = 0.0):
         self._out32 = out32
         self._nbytes = nbytes
+        self._dispatched_at = dispatched_at
 
     def materialize(self) -> np.ndarray:
-        """Block until the launch completes; returns uint8 [R, B]."""
-        return unpack_words(np.asarray(self._out32), self._nbytes)
+        """Block until the launch completes; returns uint8 [R, B].
+
+        Device telemetry (profiling.py): the fetch wall is the d2h
+        staging window the pipeline's writer thread actually waits on
+        (it includes any remaining kernel time — the only fence this
+        backend offers is the host-side fetch), and dispatch->fetch
+        is the per-launch kernel wall `cluster.top` shows as
+        device_kernel_last_ms."""
+        import time as _time
+        from .. import profiling
+        t0 = _time.perf_counter()
+        host = np.asarray(self._out32)
+        fetch = _time.perf_counter() - t0
+        out = unpack_words(host, self._nbytes)
+        profiling.device_note("d2h", host.nbytes, fetch)
+        if self._dispatched_at:
+            profiling.kernel_note(
+                "gf_apply_matrix", t0 + fetch - self._dispatched_at,
+                host.nbytes)
+        return out
 
 
 class ReedSolomonJax:
@@ -200,9 +245,10 @@ class ReedSolomonJax:
         data = self._check(data, self.data_shards)
         b = data.shape[1]
         flat = pack_words(np.ascontiguousarray(data))
-        out32 = gf_apply_matrix_words(self._parity_rows,
-                                      jnp.asarray(flat))
-        return _PendingParity(out32, b)
+        dev = _staged_h2d(flat)
+        t_dispatch = time.perf_counter()
+        out32 = gf_apply_matrix_words(self._parity_rows, dev)
+        return _PendingParity(out32, b, dispatched_at=t_dispatch)
 
     def apply_matrix(self, mat, data) -> np.ndarray:
         """out[r] = XOR_k mat[r,k] * data[k] — public generic apply
@@ -215,10 +261,11 @@ class ReedSolomonJax:
         with H2D+kernel of k+1."""
         data = np.ascontiguousarray(data)
         b = data.shape[1]
+        dev = _staged_h2d(pack_words(data))
+        t_dispatch = time.perf_counter()
         out32 = gf_apply_matrix_words(
-            jnp.asarray(mat, dtype=jnp.uint8),
-            jnp.asarray(pack_words(data)))
-        return _PendingParity(out32, b)
+            jnp.asarray(mat, dtype=jnp.uint8), dev)
+        return _PendingParity(out32, b, dispatched_at=t_dispatch)
 
     def encode(self, shards) -> jax.Array:
         """shards: [total, B] with data rows filled; returns full array with

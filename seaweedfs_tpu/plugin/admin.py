@@ -329,7 +329,9 @@ class AdminServer:
             self.workers[wid] = WorkerInfo(
                 worker_id=wid,
                 capabilities=b.get("capabilities", []),
-                last_seen=time.time(),
+                # liveness ages on the monotonic clock (SWFS011): an
+                # NTP step must not mass-reap or immortalize workers
+                last_seen=time.monotonic(),
                 max_concurrent=int(b.get("maxConcurrent", 1)))
             # SchemaCoordinator: Descriptors carry declarative config
             # forms (plugin.proto); the ConfigStore validates against
@@ -351,7 +353,7 @@ class AdminServer:
                 w = self.workers.get(wid)
                 if w is None:
                     return 404, {"error": "unregistered worker"}
-                w.last_seen = time.time()
+                w.last_seen = time.monotonic()
                 if wid in self._pending_detection:
                     self._pending_detection.remove(wid)
                     return 200, {"type": "runDetection",
@@ -436,7 +438,8 @@ class AdminServer:
                 f"<tr><td>{_html.escape(w.worker_id)}</td>"
                 f"<td>{_html.escape(', '.join(sorted(str(c.get('jobType', '?')) for c in w.capabilities)))}</td>"
                 f"<td>{w.inflight}/{w.max_concurrent}</td>"
-                f"<td>{time.time() - w.last_seen:.0f}s ago</td></tr>"
+                f"<td>{time.monotonic() - w.last_seen:.0f}s ago"
+                f"</td></tr>"
                 for w in self.workers.values()]
             jobs = [
                 f"<tr><td><a href='/maintenance/job?id={j.job_id}'>"
@@ -794,7 +797,7 @@ input{{margin:2px}}</style></head><body>
     def _touch(self, worker_id: str) -> None:
         w = self.workers.get(worker_id)
         if w is not None:
-            w.last_seen = time.time()
+            w.last_seen = time.monotonic()
 
     def _progress(self, req: Request):
         b = req.json()
@@ -938,15 +941,19 @@ input{{margin:2px}}</style></head><body>
                                for c in w.capabilities)]
 
     def _reap_dead_workers(self) -> None:
-        now = time.time()
+        now = time.time()        # job.updated is persisted wall time
+        mono = time.monotonic()  # worker liveness is in-memory
         with self.lock:
             dead = {wid for wid, w in self.workers.items()
                     if w.inflight > 0 and
-                    now - w.last_seen > self.WORKER_DEAD_AFTER}
+                    mono - w.last_seen > self.WORKER_DEAD_AFTER}
             for job in self.jobs.values():
                 if job.status != "assigned":
                     continue
-                stalled = now - job.updated > self.JOB_STALL_AFTER
+                # persisted wall timestamp survives an admin restart;
+                # monotonic would not compare across processes
+                stalled = (now - job.updated  # noqa: SWFS011
+                           > self.JOB_STALL_AFTER)
                 if job.worker_id in dead or stalled:
                     w = self.workers.get(job.worker_id)
                     if w is not None and job.worker_id not in dead:

@@ -1,0 +1,709 @@
+"""Performance-observability plane: where do the microseconds go.
+
+PR 3 (tracing) answers "what did THIS request do"; the metrics plane
+answers "how many / how slow on average".  Neither can answer the two
+questions the headline ROADMAP gaps turn on — "what is this process
+doing RIGHT NOW" (the 50x write-path gap is pure host-side overhead,
+arXiv:1709.05365 §5) and "which STAGE of the hot path eats the time"
+(the TPU arm's numbers were only reachable with device-level telemetry,
+arXiv:2112.09017).  This module is the instrument panel both
+questions read from:
+
+1. `Sampler` — an in-process sampling wall-clock profiler.  A daemon
+   thread snapshots `sys._current_frames()` at a configured rate and
+   folds each thread's stack into collapsed-stack lines
+   (``frame;frame;frame count`` — the flamegraph.pl input format).
+   Off by default; armed per process via ``POST /debug/pprof`` (see
+   server/debug.py) or at boot with ``SEAWEEDFS_TPU_PROFILE_HZ``.
+   Overhead is bounded by construction: the sampler measures its own
+   per-pass cost and stretches its sleep so sampling never exceeds
+   ``MAX_OVERHEAD`` of one core, frame labels are cached per code
+   object, and the folded table is capped (overflow counted, never
+   unbounded).
+
+2. `StageTrack` + `stage()` — write-path latency decomposition.  A
+   role server opens a track around its hot handler
+   (``with profiling.track("write", role=..., metrics=...)``); code
+   anywhere down the synchronous call chain wraps its stages in
+   ``with profiling.stage("append")`` — a contextvar carries the
+   active track, so storage/volume.py needs no API change to report
+   into the volume server's registry.  On finish the track observes
+   one ``write_stage_seconds{stage}`` histogram cell per stage (plus
+   ``stage="total"``) into the role's metrics and emits sibling trace
+   spans, so `trace.show` renders the same breakdown per request.
+   When no track is active, `stage()` is a shared no-op context
+   manager: one contextvar read on the hot path.
+
+3. Device telemetry — `device_note` (h2d/d2h staging throughput),
+   `kernel_note` (per-encode kernel wall-ms), and
+   `sample_device_memory` (jax backend memory stats), all recorded
+   into stats.PROCESS so every role's /metrics carries them.  jax is
+   only imported inside `sample_device_memory`, guarded — the module
+   must be importable on roles that never touch a device.
+
+4. Prometheus-text helpers (`parse_prom_text`, `prom_histogram`,
+   `histogram_quantile`) and `merge_folded` — the client half of the
+   plane, shared by `weed shell cluster.top` / `cluster.profile` and
+   `bench.py write_path`.
+
+Knobs:
+  SEAWEEDFS_TPU_PROFILE_HZ       sampling rate; 0 (default) = off
+  SEAWEEDFS_TPU_PROFILE_STACKS   distinct folded stacks kept (2048)
+  SEAWEEDFS_TPU_STAGE_TIMERS     "0" disables stage tracks entirely
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import sys
+import threading
+import time
+
+# finer than stats.DEFAULT_BUCKETS: needle appends and index updates
+# live in the 50us-5ms range the request-latency buckets can't resolve
+STAGE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+# the sampler refuses to spend more than this fraction of one core on
+# itself: when a pass over every thread costs more than
+# MAX_OVERHEAD * interval, the next sleep stretches to compensate
+MAX_OVERHEAD = 0.10
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def default_hz() -> float:
+    """SEAWEEDFS_TPU_PROFILE_HZ: sampling rate when the profiler is
+    armed without an explicit rate; 0 (the default) keeps it off."""
+    return max(0.0, _env_float("SEAWEEDFS_TPU_PROFILE_HZ", 0.0))
+
+
+def max_stacks() -> int:
+    """SEAWEEDFS_TPU_PROFILE_STACKS: bound on distinct folded stacks
+    kept per process (overflow is counted, not stored)."""
+    return max(64, _env_int("SEAWEEDFS_TPU_PROFILE_STACKS", 2048))
+
+
+def stage_timers_enabled() -> bool:
+    """SEAWEEDFS_TPU_STAGE_TIMERS=0 turns the write-path stage
+    decomposition off (the track() call becomes a no-op)."""
+    return os.environ.get("SEAWEEDFS_TPU_STAGE_TIMERS", "1") != "0"
+
+
+# -- sampling profiler ----------------------------------------------------
+
+class Sampler:
+    """Thread-based statistical wall-clock profiler.
+
+    Signal-based sampling (ITIMER_PROF) only interrupts the main
+    thread; every role server does its real work on handler/pipeline
+    threads, so a dedicated sampler thread walking
+    `sys._current_frames()` is the only design that sees the hot
+    paths.  Each pass folds every thread's stack root-first into
+    `file.py:func;file.py:func;...` and counts it — the collapsed
+    stack format any flamegraph renderer takes as-is."""
+
+    MAX_DEPTH = 48
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._folded: dict[str, int] = {}
+        self._label_cache: dict[object, str] = {}
+        self._thread: "threading.Thread | None" = None
+        self._stop = threading.Event()
+        self.hz = 0.0
+        self.samples = 0            # sampling passes completed
+        self.stacks = 0             # thread stacks recorded
+        self.dropped = 0            # stacks lost to the table cap
+        self.self_seconds = 0.0     # time spent inside sampling passes
+        self.started_wall = 0.0
+        self._started_mono = 0.0
+        self._stopped_elapsed = 0.0
+
+    # -- control ---------------------------------------------------------
+
+    def start(self, hz: "float | None" = None) -> bool:
+        """Arm the sampler at `hz` (default: the env knob, else 100).
+        Returns False when already running (the running profile is
+        left untouched — two operators arming cluster-wide must not
+        reset each other's windows)."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return False
+            rate = hz if hz and hz > 0 else (default_hz() or 100.0)
+            self.hz = min(float(rate), 1000.0)
+            self._folded.clear()
+            self.samples = self.stacks = self.dropped = 0
+            self.self_seconds = 0.0
+            self.started_wall = time.time()
+            self._started_mono = time.monotonic()
+            self._stopped_elapsed = 0.0
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="weed-profiler", daemon=True)
+            self._thread.start()
+            return True
+
+    def stop(self) -> None:
+        with self._lock:
+            t = self._thread
+            if t is None:
+                return
+            self._stop.set()
+        t.join(timeout=5.0)
+        with self._lock:
+            if self._thread is t:
+                self._stopped_elapsed = \
+                    time.monotonic() - self._started_mono
+                self._thread = None
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def reset(self) -> None:
+        # _label_cache deliberately not cleared here: it is written
+        # lock-free by the sampler thread (its only writer — start()
+        # joins the old thread before spawning a new one) and bounded
+        # by MAX_LABELS in _frame_label, so touching it from a
+        # handler thread would be the race, not the hygiene
+        with self._lock:
+            self._folded.clear()
+            self.samples = self.stacks = self.dropped = 0
+            self.self_seconds = 0.0
+
+    # -- sampling loop ---------------------------------------------------
+
+    # code objects are cache keys (strong refs): bound the cache so a
+    # long-armed process that mints code dynamically (jax jit) cannot
+    # pin an unbounded set of them
+    MAX_LABELS = 32768
+
+    def _frame_label(self, code) -> str:
+        label = self._label_cache.get(code)
+        if label is None:
+            if len(self._label_cache) >= self.MAX_LABELS:
+                self._label_cache.clear()
+            label = (f"{code.co_filename.rsplit('/', 1)[-1]}"
+                     f":{code.co_name}")
+            self._label_cache[code] = label
+        return label
+
+    def _run(self) -> None:
+        me = threading.get_ident()
+        interval = 1.0 / self.hz
+        cap = max_stacks()
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                frames = sys._current_frames()
+            except RuntimeError:   # pragma: no cover — interp teardown
+                break
+            new_folded = []
+            for tid, frame in frames.items():
+                if tid == me:
+                    continue
+                parts = []
+                f = frame
+                while f is not None and len(parts) < self.MAX_DEPTH:
+                    parts.append(self._frame_label(f.f_code))
+                    f = f.f_back
+                new_folded.append(";".join(reversed(parts)))
+            with self._lock:
+                for stack in new_folded:
+                    n = self._folded.get(stack)
+                    if n is not None:
+                        self._folded[stack] = n + 1
+                        self.stacks += 1
+                    elif len(self._folded) < cap:
+                        self._folded[stack] = 1
+                        self.stacks += 1
+                    else:
+                        self.dropped += 1
+                self.samples += 1
+                cost = time.perf_counter() - t0
+                self.self_seconds += cost
+            # overhead bound: never let sampling cost exceed
+            # MAX_OVERHEAD of one core — a pass that took longer than
+            # its budget buys proportionally more sleep
+            self._stop.wait(max(interval, cost / MAX_OVERHEAD))
+
+    # -- output ----------------------------------------------------------
+
+    def snapshot(self, top: int = 0) -> dict:
+        """JSON-able state + folded table (all stacks, or the `top` N
+        by count)."""
+        with self._lock:
+            elapsed = (time.monotonic() - self._started_mono) \
+                if self.running else self._stopped_elapsed
+            folded = dict(self._folded)
+            doc = {
+                "running": self.running,
+                "hz": self.hz,
+                "samples": self.samples,
+                "stacks": self.stacks,
+                "droppedStacks": self.dropped,
+                "startedAt": self.started_wall,
+                "elapsedSeconds": round(elapsed, 3),
+                "selfSeconds": round(self.self_seconds, 4),
+                "overhead": round(self.self_seconds / elapsed, 4)
+                if elapsed > 0 else 0.0,
+            }
+        if top and top > 0:
+            folded = dict(sorted(folded.items(),
+                                 key=lambda kv: -kv[1])[:top])
+        doc["folded"] = folded
+        return doc
+
+    def collapsed(self) -> str:
+        """`stack count` lines, most-sampled first — pipe straight
+        into flamegraph.pl."""
+        with self._lock:
+            items = sorted(self._folded.items(), key=lambda kv: -kv[1])
+        return "\n".join(f"{stack} {n}" for stack, n in items) + \
+            ("\n" if items else "")
+
+
+_sampler = Sampler()
+_autostart_done = False
+
+
+def sampler() -> Sampler:
+    return _sampler
+
+
+def maybe_autostart() -> None:
+    """Boot-time arming: when SEAWEEDFS_TPU_PROFILE_HZ is set > 0 the
+    process profiles from startup (once per process — every role's
+    install_debug_routes calls this)."""
+    global _autostart_done
+    if _autostart_done:
+        return
+    _autostart_done = True
+    if default_hz() > 0:
+        _sampler.start(default_hz())
+
+
+def merge_folded(tables: "list[dict]") -> "dict[str, int]":
+    """Sum folded-stack tables (cluster.profile merges every node's
+    snapshot into one cluster-wide flame view)."""
+    out: dict[str, int] = {}
+    for t in tables:
+        for stack, n in (t or {}).items():
+            try:
+                out[stack] = out.get(stack, 0) + int(n)
+            except (TypeError, ValueError):
+                continue
+    return out
+
+
+# -- write-path stage decomposition ---------------------------------------
+
+_track_var: contextvars.ContextVar["StageTrack | None"] = \
+    contextvars.ContextVar("weed_stage_track", default=None)
+
+
+class StageTrack:
+    """Per-request stage accumulator.  Thread-safe: the filer funnel
+    records assign/upload stages from limiter pool threads into the
+    handler thread's track (see use_track)."""
+
+    __slots__ = ("name", "role", "metrics", "stages", "_lock",
+                 "_t0", "trace_ctx")
+
+    def __init__(self, name: str, role: str = "", metrics=None):
+        self.name = name
+        self.role = role
+        self.metrics = metrics
+        # stage -> [cumulative seconds, calls, first-call wall time]
+        self.stages: dict[str, list] = {}
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        from . import tracing
+        self.trace_ctx = tracing.current_ids()
+
+    def add(self, stage: str, seconds: float) -> None:
+        with self._lock:
+            rec = self.stages.get(stage)
+            if rec is None:
+                # span-start RECORD, deliberately wall (trace spans
+                # carry wall starts); the duration itself came off
+                # perf_counter in _StageCtx
+                self.stages[stage] = [
+                    seconds, 1, time.time() - seconds]  # noqa: SWFS011
+            else:
+                rec[0] += seconds
+                rec[1] += 1
+
+    def finish(self) -> float:
+        """Observe one histogram cell per stage (plus stage="total")
+        and emit sibling stage spans under the span that was active at
+        track start.  Returns the track's total seconds."""
+        total = time.perf_counter() - self._t0
+        with self._lock:
+            stages = {k: list(v) for k, v in self.stages.items()}
+        hist = f"{self.name}_stage_seconds"
+        if self.metrics is not None:
+            for stage, (secs, _calls, _w0) in stages.items():
+                self.metrics.histogram_observe(
+                    hist, secs, buckets=STAGE_BUCKETS,
+                    help_text=f"per-request {self.name}-path stage "
+                              f"decomposition", stage=stage)
+            self.metrics.histogram_observe(
+                hist, total, buckets=STAGE_BUCKETS, stage="total")
+        if self.trace_ctx and stages:
+            from . import tracing
+            for stage, (secs, calls, wall0) in stages.items():
+                tracing.emit_span(
+                    f"{self.name}.{stage}", wall0, secs,
+                    role=self.role or
+                    (self.trace_ctx[2] if self.trace_ctx else ""),
+                    parent=self.trace_ctx[1],
+                    trace_id=self.trace_ctx[0],
+                    attrs={"calls": calls} if calls > 1 else None)
+        return total
+
+
+class _TrackCtx:
+    """`with profiling.track(...)`: create + activate + finish."""
+
+    __slots__ = ("_trk", "_token")
+
+    def __init__(self, name: str, role: str, metrics):
+        self._trk = StageTrack(name, role=role, metrics=metrics) \
+            if stage_timers_enabled() else None
+        self._token = None
+
+    def __enter__(self) -> "StageTrack | None":
+        if self._trk is not None:
+            self._token = _track_var.set(self._trk)
+        return self._trk
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._trk is None:
+            return
+        try:
+            _track_var.reset(self._token)
+        except ValueError:      # pragma: no cover — cross-context exit
+            pass
+        self._trk.finish()
+
+
+def track(name: str, role: str = "", metrics=None) -> _TrackCtx:
+    """Open a stage track for the current request and make it the
+    context's active track; finished (histograms observed, spans
+    emitted) on exit.  Yields None when stage timers are disabled."""
+    return _TrackCtx(name, role, metrics)
+
+
+def current_track() -> "StageTrack | None":
+    return _track_var.get()
+
+
+class _UseTrack:
+    """Re-bind an existing track on ANOTHER thread (contextvars do not
+    follow threading.Thread): the filer captures its track before
+    handing upload work to the limiter pool, and each pool task wraps
+    itself in use_track so operation.assign/upload's stage() calls
+    find it."""
+
+    __slots__ = ("_trk", "_token")
+
+    def __init__(self, trk: "StageTrack | None"):
+        self._trk = trk
+        self._token = None
+
+    def __enter__(self):
+        if self._trk is not None:
+            self._token = _track_var.set(self._trk)
+        return self._trk
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._token is not None:
+            try:
+                _track_var.reset(self._token)
+            except ValueError:  # pragma: no cover
+                pass
+
+
+def use_track(trk: "StageTrack | None") -> _UseTrack:
+    return _UseTrack(trk)
+
+
+class _StageCtx:
+    __slots__ = ("_trk", "_name", "_t0")
+
+    def __init__(self, trk: "StageTrack", name: str):
+        self._trk = trk
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._trk.add(self._name, time.perf_counter() - self._t0)
+
+
+class _NoopStage:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        pass
+
+
+_NOOP = _NoopStage()
+
+
+def stage(name: str):
+    """Time one stage of the active track; a shared no-op (one
+    contextvar read) when no track is active — safe on any hot path."""
+    trk = _track_var.get()
+    if trk is None:
+        return _NOOP
+    return _StageCtx(trk, name)
+
+
+# -- device telemetry (the TPU path's instrument cluster) -----------------
+
+def _process_metrics():
+    from . import stats
+    return stats.PROCESS
+
+
+def device_note(direction: str, nbytes: int,
+                seconds: "float | None") -> None:
+    """Record one host<->device staging window (direction "h2d" or
+    "d2h"): cumulative bytes, a latency histogram, and a last-window
+    throughput gauge — the number ROADMAP item 2's double-buffered
+    staging work will watch.  seconds=None records bytes only: an
+    async backend's enqueue wall is not a transfer wall, and a bogus
+    gauge is worse than none (rs_jax._staged_h2d's fencing policy)."""
+    m = _process_metrics()
+    m.counter_add("device_transfer_bytes_total", float(nbytes),
+                  help_text="host<->device staging bytes", dir=direction)
+    if seconds is None:
+        return
+    m.histogram_observe("device_transfer_seconds", seconds,
+                        help_text="host<->device staging window "
+                                  "latency", dir=direction)
+    if seconds > 0:
+        m.gauge_set(f"device_{direction}_gbps", nbytes / seconds / 1e9,
+                    help_text="last staging window throughput")
+
+
+def kernel_note(kernel: str, seconds: float, nbytes: int = 0) -> None:
+    """Record one device kernel dispatch-to-materialize window."""
+    m = _process_metrics()
+    m.histogram_observe("device_kernel_seconds", seconds,
+                        help_text="device kernel wall time per launch",
+                        kernel=kernel)
+    m.gauge_set("device_kernel_last_ms", seconds * 1e3, kernel=kernel)
+    if nbytes:
+        m.counter_add("device_kernel_bytes_total", float(nbytes),
+                      kernel=kernel)
+
+
+def sample_device_memory() -> "dict[str, dict]":
+    """Gauge each jax device's memory stats (bytes_in_use / peak /
+    limit where the backend reports them).  Returns {device: stats};
+    empty (and silent) when jax is absent, uninitialized, or the
+    backend has no memory_stats — CPU test meshes must not pay for or
+    fail on a TPU-only surface."""
+    out: dict[str, dict] = {}
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — telemetry must never raise
+        return out
+    m = _process_metrics()
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:  # noqa: BLE001
+            ms = None
+        if not ms:
+            continue
+        label = f"{d.platform}:{d.id}"
+        out[label] = dict(ms)
+        for key, gauge in (("bytes_in_use", "device_memory_bytes_in_use"),
+                           ("peak_bytes_in_use",
+                            "device_memory_peak_bytes"),
+                           ("bytes_limit", "device_memory_bytes_limit")):
+            if key in ms:
+                m.gauge_set(gauge, float(ms[key]),
+                            help_text="jax device memory stats",
+                            device=label)
+    return out
+
+
+# -- Prometheus text-format client helpers --------------------------------
+
+_LABEL_ESCAPES = {"n": "\n", "\\": "\\", '"': '"'}
+
+
+def _unescape_label(v: str) -> str:
+    """Single left-to-right pass — sequential str.replace decodes
+    `\\\\n` (escaped backslash + literal n) wrongly because the \\n
+    replacement consumes the second backslash of the pair."""
+    if "\\" not in v:
+        return v
+    out: list = []
+    i = 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append(_LABEL_ESCAPES.get(nxt, c + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def parse_prom_text(text: str) -> "dict[str, list]":
+    """Parse Prometheus exposition text into
+    {metric_name: [(labels_dict, value), ...]} — the client half of
+    stats.Metrics.render, for cluster.top and bench.py write_path to
+    read any node's /metrics without a dependency."""
+    out: dict[str, list] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            head, val = line.rsplit(" ", 1)
+            value = float(val)
+        except ValueError:
+            continue
+        labels: dict[str, str] = {}
+        name = head
+        if "{" in head and head.endswith("}"):
+            name, _, rest = head.partition("{")
+            body = rest[:-1]
+            # split on commas outside quotes; values may hold escaped
+            # quotes (stats.escape_label_value)
+            parts, cur, quoted, escaped = [], "", False, False
+            for ch in body:
+                if escaped:
+                    cur += ch
+                    escaped = False
+                elif ch == "\\":
+                    cur += ch
+                    escaped = True
+                elif ch == '"':
+                    quoted = not quoted
+                    cur += ch
+                elif ch == "," and not quoted:
+                    parts.append(cur)
+                    cur = ""
+                else:
+                    cur += ch
+            if cur:
+                parts.append(cur)
+            for p in parts:
+                k, _, v = p.partition("=")
+                v = v.strip()
+                if v.startswith('"') and v.endswith('"'):
+                    v = _unescape_label(v[1:-1])
+                labels[k.strip()] = v
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def prom_histogram(metrics: "dict[str, list]", name: str,
+                   match: "dict | None" = None) -> "dict | None":
+    """Reassemble one histogram from parsed exposition text, merged
+    across every label set whose labels include `match`.  Returns
+    {"buckets": [...], "counts": [...(per-bucket, non-cumulative)...],
+    "sum": s, "count": n} or None."""
+    match = match or {}
+
+    def ok(labels: dict) -> bool:
+        return all(labels.get(k) == v for k, v in match.items())
+
+    by_le: dict[float, float] = {}
+    total_sum = 0.0
+    total_count = 0.0
+    seen = False
+    for labels, value in metrics.get(f"{name}_bucket", []):
+        if not ok(labels) or "le" not in labels:
+            continue
+        le = float("inf") if labels["le"] in ("+Inf", "inf") \
+            else float(labels["le"])
+        by_le[le] = by_le.get(le, 0.0) + value
+        seen = True
+    for labels, value in metrics.get(f"{name}_sum", []):
+        if ok(labels):
+            total_sum += value
+            seen = True
+    for labels, value in metrics.get(f"{name}_count", []):
+        if ok(labels):
+            total_count += value
+    if not seen:
+        return None
+    les = sorted(le for le in by_le if le != float("inf"))
+    cum = [by_le[le] for le in les] + \
+        [by_le.get(float("inf"), total_count)]
+    counts = [cum[0]] + [cum[i] - cum[i - 1]
+                         for i in range(1, len(cum))]
+    return {"buckets": les, "counts": counts,
+            "sum": total_sum, "count": total_count}
+
+
+def histogram_delta(after: "dict | None", before: "dict | None"
+                    ) -> "dict | None":
+    """after - before for two prom_histogram snapshots (the windowed
+    view cluster.top and the bench need: counters are cumulative, the
+    last N seconds are a subtraction)."""
+    if after is None:
+        return None
+    if before is None or before.get("buckets") != after.get("buckets"):
+        return dict(after)
+    return {
+        "buckets": list(after["buckets"]),
+        "counts": [a - b for a, b in zip(after["counts"],
+                                         before["counts"])],
+        "sum": after["sum"] - before["sum"],
+        "count": after["count"] - before["count"],
+    }
+
+
+def histogram_quantile(hist: "dict | None", q: float) -> float:
+    """Linear-interpolated quantile over {buckets, counts} (the
+    Prometheus histogram_quantile estimate).  0.0 for empty input."""
+    if not hist or hist.get("count", 0) <= 0:
+        return 0.0
+    target = hist["count"] * min(max(q, 0.0), 1.0)
+    cum = 0.0
+    lo = 0.0
+    for le, n in zip(hist["buckets"] + [float("inf")], hist["counts"]):
+        if n <= 0:
+            lo = le if le != float("inf") else lo
+            continue
+        if cum + n >= target:
+            if le == float("inf"):
+                return lo       # open upper bucket: clamp to its floor
+            frac = (target - cum) / n
+            return lo + (le - lo) * frac
+        cum += n
+        lo = le
+    return lo if lo != float("inf") else 0.0

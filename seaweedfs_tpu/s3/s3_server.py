@@ -43,7 +43,10 @@ def new_version_id() -> str:
     newest first, so a plain sorted listing of `<key>.versions/` yields
     newest-first order (the reference's 'inverted format',
     s3api_object_versioning.go generateVersionId)."""
-    return f"{(1 << 63) - time.time_ns():016x}{os.urandom(3).hex()}"
+    # not a duration: a DESCENDING sort key derived from the wall
+    # clock (newest version lists first, s3 semantics)
+    return (f"{(1 << 63) - time.time_ns():016x}"  # noqa: SWFS011
+            f"{os.urandom(3).hex()}")
 
 
 def _xml(root: ET.Element) -> bytes:

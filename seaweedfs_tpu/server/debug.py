@@ -23,6 +23,15 @@ httpd.is_admin_path):
   GET /debug/health — this process's per-peer circuit-breaker map and
       retry budget (util/retry); `trace.show` appends it so a chaos
       run is debuggable from the shell.
+  GET/POST /debug/pprof — the sampling wall-clock profiler
+      (profiling.Sampler): POST {"action": "start", "hz": N} arms it,
+      {"action": "stop"} disarms and returns the final snapshot,
+      {"action": "reset"} clears the folded table; GET returns the
+      snapshot (?top=N limits the folded table,
+      ?format=collapsed returns flamegraph.pl input as text/plain).
+      Off by default; SEAWEEDFS_TPU_PROFILE_HZ arms it at boot.  The
+      shell's `cluster.profile` arms every node, waits, and merges
+      the folded stacks into one cluster-wide flame view.
 """
 
 from __future__ import annotations
@@ -47,6 +56,45 @@ def install_debug_routes(http: HttpServer) -> None:
     http.route("GET", "/debug/health", _health)
     http.route("GET", "/debug/qos", _qos_get)
     http.route("POST", "/debug/qos", _qos_post)
+    http.route("GET", "/debug/pprof", _pprof_get)
+    http.route("POST", "/debug/pprof", _pprof_post)
+    from .. import profiling
+    profiling.maybe_autostart()  # SEAWEEDFS_TPU_PROFILE_HZ boot arming
+
+
+def _pprof_get(req: Request):
+    from .. import profiling
+    s = profiling.sampler()
+    if req.query.get("format") == "collapsed":
+        return 200, (s.collapsed().encode(), "text/plain")
+    try:
+        top = int(req.query.get("top", 0))
+    except ValueError:
+        top = 0
+    return 200, s.snapshot(top=top)
+
+
+def _pprof_post(req: Request):
+    from .. import profiling
+    s = profiling.sampler()
+    b = req.json()
+    action = str(b.get("action", ""))
+    if action == "start":
+        hz = b.get("hz")
+        try:
+            hz = float(hz) if hz is not None else None
+        except (TypeError, ValueError):
+            return 400, {"error": f"bad hz {b.get('hz')!r}"}
+        started = s.start(hz)
+        return 200, {"running": s.running, "hz": s.hz,
+                     "started": started}
+    if action == "stop":
+        s.stop()
+        return 200, s.snapshot()
+    if action == "reset":
+        s.reset()
+        return 200, s.snapshot()
+    return 400, {"error": "body needs action: start|stop|reset"}
 
 
 def _faults_get(req: Request):

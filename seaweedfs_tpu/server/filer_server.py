@@ -359,12 +359,21 @@ class FilerServer:
         mime = req.headers.get("Content-Type", "")
         if mime == "application/x-www-form-urlencoded":
             mime = ""
-        from .. import faults
+        from .. import faults, profiling
         # armed `filer.entry.put` faults fail the write BEFORE any
         # chunk is assigned — the caller's retry policy (not a
         # half-written entry) owns recovery
         faults.fire("filer.entry.put", key=path)
-        entry = self.filer.write_file(path, req.body, mime=mime)
+        # filer-funnel decomposition: assign/upload stages recorded by
+        # operation.py (on the limiter pool threads, via use_track),
+        # the metadata commit by filer.write_file — together they say
+        # whether a slow filer write sat in master assigns, volume
+        # round-trips, or the store (bench.py write_path reads these)
+        with profiling.track("write", role="filer",
+                             metrics=self.metrics):
+            with profiling.stage("recv"):
+                body = req.body
+            entry = self.filer.write_file(path, body, mime=mime)
         return 201, {"name": entry.name, "size": entry.total_size()}
 
     def _get(self, req: Request, path: str):

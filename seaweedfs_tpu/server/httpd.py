@@ -207,6 +207,11 @@ class HttpServer:
         # one middleware, every role (master/volume/filer/s3 alike)
         self.role: str = ""
         self.metrics = None
+        # in-flight request count for the cluster.top live view: the
+        # gauge that distinguishes "idle" from "every handler thread
+        # parked on a slow disk" at a glance
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -237,6 +242,13 @@ class HttpServer:
                     parent=parent_span, trace_id=rid)
                 status = 0
                 qos_release = None
+                with outer._inflight_lock:
+                    outer._inflight += 1
+                    inflight = outer._inflight
+                if outer.metrics is not None:
+                    outer.metrics.gauge_set(
+                        "requests_in_flight", inflight,
+                        help_text="requests currently being handled")
                 try:
                     # the span (and request_seconds) covers handler
                     # execution AND the response-body write: for the
@@ -364,7 +376,12 @@ class HttpServer:
                                 component="qos")
                     sp.set("status", status)
                     sp.finish()
+                    with outer._inflight_lock:
+                        outer._inflight -= 1
+                        inflight = outer._inflight
                     if outer.metrics is not None:
+                        outer.metrics.gauge_set(
+                            "requests_in_flight", inflight)
                         outer.metrics.histogram_observe(
                             "request_seconds", sp.duration,
                             help_text="HTTP request handling latency",
@@ -904,11 +921,25 @@ def _one_pooled_request(method: str, full_url: str, body,
     if parsed.query:
         target += "?" + parsed.query
     key = (parsed.scheme, parsed.netloc)
+    # connection-churn counters (the pre-work for the persistent-
+    # connection rework, ROADMAP item 1): a healthy funnel reuses ~all
+    # of its sockets; opened ~= requests means every call pays the TCP
+    # setup tax the pool exists to amortize
+    from ..stats import PROCESS as _process_metrics
     for attempt in (0, 1):
         conn = _pool().get(key)
         reused = conn is not None
+        if reused:
+            _process_metrics.counter_add(
+                "pool_connections_reused_total", 1.0,
+                help_text="pooled requests served over a kept-alive "
+                          "socket", peer=parsed.netloc)
         if conn is None:
             _fire_fault("httpd.pool.connect", key=parsed.netloc)
+            _process_metrics.counter_add(
+                "pool_connections_opened_total", 1.0,
+                help_text="fresh sockets dialed by the pooled client",
+                peer=parsed.netloc)
             if parsed.scheme == "https":
                 conn = http.client.HTTPSConnection(
                     parsed.netloc, timeout=timeout, context=ctx)

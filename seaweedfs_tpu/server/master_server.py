@@ -581,15 +581,19 @@ class MasterServer:
 
     def _lease_admin(self, req: Request):
         body = req.json()
-        now = time.time()
+        now = time.time()   # wall: lockTsNs is a client-visible record
+        mono = time.monotonic()
         prev = int(body.get("previousToken", 0) or 0)
         with self._grow_lock:
-            expired = now - self._admin_token_ts > self.ADMIN_TOKEN_TTL
+            # lease age on the monotonic clock (SWFS011): an NTP step
+            # backwards would pin a dead lock alive past its TTL
+            expired = mono - self._admin_token_ts > \
+                self.ADMIN_TOKEN_TTL
             renewing = self._admin_token is not None and \
                 prev == self._admin_token
             if self._admin_token is None or expired or renewing:
                 self._admin_token = uuid.uuid4().int & 0x7FFFFFFF
-                self._admin_token_ts = now
+                self._admin_token_ts = mono
                 self._admin_lock_name = body.get("lockName", "")
                 return 200, {"token": self._admin_token,
                              "lockTsNs": int(now * 1e9)}

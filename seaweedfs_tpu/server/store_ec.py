@@ -192,7 +192,7 @@ class EcReader:
         cache = self._caches.setdefault(ev.id, _ShardLocationCache())
         with cache.lock:
             n = len(cache.locations)
-            age = time.time() - cache.refreshed
+            age = time.monotonic() - cache.refreshed
             fresh = ((n < ev.ctx.data_shards and age < _TTL_INCOMPLETE) or
                      (n == ev.ctx.total and age < _TTL_FULL) or
                      (ev.ctx.data_shards <= n < ev.ctx.total and
@@ -214,7 +214,7 @@ class EcReader:
                         locs.setdefault(sid, []).append(entry["url"])
                 if locs:
                     cache.locations = locs
-                    cache.refreshed = time.time()
+                    cache.refreshed = time.monotonic()
             return dict(cache.locations)
 
     def _codec(self, d: int, p: int):

@@ -348,7 +348,9 @@ class VolumeServer:
             return self._get_needle(fid, req.headers.get("Range", ""),
                                     req.query)
         if req.method in ("POST", "PUT"):
-            self.metrics.counter_add("received_bytes", len(req.body))
+            # body deliberately untouched here: the first read happens
+            # inside _put_needle's "recv" stage so the decomposition
+            # sees the true socket-drain cost
             return self._put_needle(fid, req)
         if req.method == "DELETE":
             return self._delete_needle(fid, req)
@@ -415,24 +417,45 @@ class VolumeServer:
         return 200, (data, mime)
 
     def _put_needle(self, fid: types.FileId, req: Request):
-        n = Needle(cookie=fid.cookie, id=fid.key, data=req.body)
-        name = req.query.get("name", "")
-        if name:
-            n.set_name(name.encode())
-        mime = req.headers.get("Content-Type", "")
-        if mime and mime not in ("application/octet-stream",
-                                 "multipart/form-data"):
-            n.set_mime(mime.encode())
-        ts = req.query.get("ts")
-        ts_val = int(ts) if ts else int(time.time())
-        n.set_last_modified(ts_val)
+        # write-path latency decomposition (profiling.py): the track
+        # covers this handler; recv/index/append/flush/replicate stage
+        # cells land in write_stage_seconds{stage} plus sibling trace
+        # spans, so both `bench.py write_path` and `trace.show` can
+        # say WHERE a slow write spent its time (the 50x ROADMAP gap
+        # is unlocatable without this, arXiv:1709.05365 §5)
+        from .. import profiling
+        with profiling.track("write", role="volume",
+                             metrics=self.metrics):
+            return self._put_needle_tracked(fid, req)
+
+    def _put_needle_tracked(self, fid: types.FileId, req: Request):
+        from .. import profiling
+        with profiling.stage("recv"):
+            body = req.body
+        self.metrics.counter_add("received_bytes", len(body))
+        with profiling.stage("prep"):
+            # needle construction is real per-request work (CRC over
+            # the body, header encode) — uninstrumented it hides as
+            # unattributed wall in the decomposition
+            n = Needle(cookie=fid.cookie, id=fid.key, data=body)
+            name = req.query.get("name", "")
+            if name:
+                n.set_name(name.encode())
+            mime = req.headers.get("Content-Type", "")
+            if mime and mime not in ("application/octet-stream",
+                                     "multipart/form-data"):
+                n.set_mime(mime.encode())
+            ts = req.query.get("ts")
+            ts_val = int(ts) if ts else int(time.time())
+            n.set_last_modified(ts_val)
         try:
             size, unchanged = self.store.write_needle(fid.volume_id, n)
         except KeyError:
             return 404, {"error": f"volume {fid.volume_id} not found"}
         except PermissionError as e:
             return 409, {"error": str(e)}
-        self._rp_register(fid.volume_id, n)
+        with profiling.stage("register"):
+            self._rp_register(fid.volume_id, n)
         # synchronous replication fan-out
         # (topology/store_replicate.go:27 ReplicatedWrite); forward the
         # original Content-Type and stamp ts so every replica writes a
@@ -442,11 +465,12 @@ class VolumeServer:
             # always set Content-Type: with a body and no header urllib
             # injects x-www-form-urlencoded, which the replica would store
             # as the needle mime (octet-stream is in the excluded list)
-            err = self._replicate(
-                fid, req, "POST", req.body,
-                extra_query={"ts": str(ts_val)},
-                headers={"Content-Type":
-                         mime or "application/octet-stream"})
+            with profiling.stage("replicate"):
+                err = self._replicate(
+                    fid, req, "POST", body,
+                    extra_query={"ts": str(ts_val)},
+                    headers={"Content-Type":
+                             mime or "application/octet-stream"})
             if err:
                 return 500, {"error": f"replication: {err}"}
         return 201, {"name": name, "size": size, "eTag": n.etag(),

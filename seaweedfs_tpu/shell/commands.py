@@ -13,6 +13,7 @@ mutating commands require the lock).
 from __future__ import annotations
 
 import json
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 from ..operation import master_json
@@ -1039,6 +1040,252 @@ def cmd_qos_set(env: CommandEnv, args: list[str]) -> str:
             failed.append(f"{url}: {e}")
     out = [f"qos updated on {ok}/{len(nodes)} nodes"]
     out.extend(failed)
+    return "\n".join(out)
+
+
+_ROLE_NAMESPACES = ("master", "volume_server", "filer", "s3")
+
+
+def _top_nodes(env: CommandEnv, opts: dict) -> "list[str]":
+    """Fan-out target list: the topology's debug planes plus any
+    `-nodes=` extras (a standalone S3 gateway, the admin server)."""
+    try:
+        nodes = _cluster_debug_nodes(env)
+    except OSError:
+        nodes = [env.master]
+    for n in (opts.get("nodes", "") or "").split(","):
+        n = n.strip()
+        if n and n not in nodes:
+            nodes.append(n)
+    return nodes
+
+
+def _fetch_metrics(url: str) -> "dict[str, list] | None":
+    """One node's /metrics, parsed (profiling.parse_prom_text);
+    None when unreachable."""
+    from .. import profiling
+    try:
+        st, body, _ = http_bytes("GET", f"{url}/metrics", timeout=3)
+    except OSError:
+        return None
+    if st >= 300:
+        return None
+    return profiling.parse_prom_text(body.decode("utf-8", "replace"))
+
+
+def _node_role(metrics: "dict[str, list]") -> str:
+    """Which role registry this listener renders (each role's Metrics
+    namespace prefixes its request_seconds histogram)."""
+    for ns in _ROLE_NAMESPACES:
+        if f"{ns}_request_seconds_count" in metrics:
+            return ns
+    return "?"
+
+
+def _gauge(metrics: "dict[str, list]", name: str,
+           match: "dict | None" = None) -> "float | None":
+    match = match or {}
+    for labels, value in metrics.get(name, []):
+        if all(labels.get(k) == v for k, v in match.items()):
+            return value
+    return None
+
+
+def _counter_sum(metrics: "dict[str, list]", name: str) -> float:
+    return sum(v for _l, v in metrics.get(name, []))
+
+
+def _stage_report(before: "dict[str, list]", after: "dict[str, list]",
+                  ns: str) -> str:
+    """Per-stage share of write-path wall time over the sampling
+    window, from the write_stage_seconds decomposition (profiling.py).
+    Empty string when no write landed in the window."""
+    from .. import profiling
+    name = f"{ns}_write_stage_seconds"
+    stages: dict[str, float] = {}
+    total = 0.0
+    seen = {l.get("stage", "") for l, _v in
+            after.get(f"{name}_count", [])}
+    for stage in sorted(seen):
+        h = profiling.histogram_delta(
+            profiling.prom_histogram(after, name, {"stage": stage}),
+            profiling.prom_histogram(before, name, {"stage": stage}))
+        if not h or h["count"] <= 0:
+            continue
+        if stage == "total":
+            total = h["sum"]
+        else:
+            stages[stage] = h["sum"]
+    if not stages or total <= 0:
+        return ""
+    parts = [f"{s} {secs / total * 100.0:.0f}%"
+             for s, secs in sorted(stages.items(),
+                                   key=lambda kv: -kv[1])]
+    return "write stages: " + " ".join(parts)
+
+
+@command("cluster.top")
+def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
+    """Live one-screen cluster view: every node's /metrics sampled
+    twice `-interval=N` seconds apart (default 2), the delta rendered
+    as per-role req/s, windowed p99, in-flight requests, pooled-client
+    connection reuse, breaker/QoS state, device telemetry where the
+    node has touched a TPU, the write-path stage decomposition when
+    writes landed in the window, and the top profiler stacks on any
+    node whose sampler is armed.  The operator's answer to "what is
+    this cluster doing RIGHT NOW"."""
+    from .. import profiling
+    opts = _parse_flags(args)
+    try:
+        window = max(0.2, float(opts.get("interval", 2.0)))
+    except ValueError:
+        return "bad -interval"
+    nodes = _top_nodes(env, opts)
+
+    with ThreadPoolExecutor(max_workers=min(8, len(nodes))) as ex:
+        before = dict(zip(nodes, ex.map(_fetch_metrics, nodes)))
+        time.sleep(window)
+        after = dict(zip(nodes, ex.map(_fetch_metrics, nodes)))
+
+    out = [f"cluster.top — {len(nodes)} nodes, "
+           f"{window:.1f}s window"]
+    for url in nodes:
+        b, a = before.get(url), after.get(url)
+        if a is None:
+            out.append(f"{url}: unreachable")
+            continue
+        if b is None:
+            # no baseline sample: rendering cumulative-since-boot
+            # counters as this window's delta would show a day-old
+            # node at absurd req/s
+            out.append(f"{url}: no baseline sample this window")
+            continue
+        ns = _node_role(a)
+        req = profiling.histogram_delta(
+            profiling.prom_histogram(a, f"{ns}_request_seconds"),
+            profiling.prom_histogram(b, f"{ns}_request_seconds"))
+        rate = (req["count"] / window) if req else 0.0
+        p99 = profiling.histogram_quantile(req, 0.99) if req else 0.0
+        inflight = _gauge(a, f"{ns}_requests_in_flight") or 0
+        line = (f"{url} [{ns}] {rate:7.1f} req/s  "
+                f"p99={p99 * 1e3:7.1f}ms  in-flight={inflight:.0f}")
+        reused = _counter_sum(
+            a, "seaweedfs_tpu_pool_connections_reused_total")
+        opened = _counter_sum(
+            a, "seaweedfs_tpu_pool_connections_opened_total")
+        if reused + opened > 0:
+            line += (f"  pool-reuse={reused / (reused + opened) * 100:.0f}%"
+                     f" ({opened:.0f} dials)")
+        open_breakers = sum(
+            1 for _l, v in a.get("seaweedfs_tpu_peer_breaker_state", [])
+            if v != 0)
+        if open_breakers:
+            line += f"  breakers:{open_breakers} non-closed"
+        pace = _gauge(a, "seaweedfs_tpu_qos_ec_pace_ms")
+        if pace:
+            line += f"  ec-pace={pace:.0f}ms"
+        rejected = _counter_sum(a, "seaweedfs_tpu_qos_rejected_total") \
+            - (_counter_sum(b, "seaweedfs_tpu_qos_rejected_total")
+               if b else 0)
+        if rejected > 0:
+            line += f"  qos-rejected={rejected:.0f}"
+        out.append(line)
+        kern = _gauge(a, "seaweedfs_tpu_device_kernel_last_ms",
+                      {"kernel": "gf_apply_matrix"})
+        if kern is not None:
+            h2d = _gauge(a, "seaweedfs_tpu_device_h2d_gbps") or 0.0
+            d2h = _gauge(a, "seaweedfs_tpu_device_d2h_gbps") or 0.0
+            out.append(f"  device: kernel={kern:.2f}ms "
+                       f"h2d={h2d:.2f}GB/s d2h={d2h:.2f}GB/s")
+        stages = _stage_report(b or {}, a, ns)
+        if stages:
+            out.append("  " + stages)
+        try:
+            prof = http_json("GET", f"{url}/debug/pprof?top=3",
+                             timeout=3)
+        except OSError:
+            prof = None
+        if isinstance(prof, dict) and prof.get("stacks"):
+            total = max(1, prof["stacks"])
+            for stack, n in sorted(prof.get("folded", {}).items(),
+                                   key=lambda kv: -kv[1]):
+                leaf = stack.rsplit(";", 2)[-2:]
+                out.append(f"  prof {n / total * 100:4.1f}% "
+                           f"{';'.join(leaf)}")
+    return "\n".join(out)
+
+
+@command("cluster.profile")
+def cmd_cluster_profile(env: CommandEnv, args: list[str]) -> str:
+    """Arm the sampling profiler on every node, wait
+    `-duration=N` seconds (default 10), disarm, and merge the folded
+    stacks into one cluster-wide flame view (`-hz=N` sampling rate,
+    `-top=N` lines shown, `-out=FILE` writes the full merged
+    collapsed-stack file for flamegraph.pl).  A node whose sampler
+    was already armed keeps its window but is still collected and
+    disarmed — two operators profiling at once merge, not clobber."""
+    from .. import profiling
+    opts = _parse_flags(args)
+    try:
+        duration = max(0.2, float(opts.get("duration", 10.0)))
+        hz = float(opts.get("hz", 100.0))
+        top = int(opts.get("top", 25))
+    except ValueError:
+        return "bad -duration/-hz/-top"
+    nodes = _top_nodes(env, opts)
+
+    def arm(url: str) -> "tuple[str, bool]":
+        try:
+            r = http_json("POST", f"{url}/debug/pprof",
+                          {"action": "start", "hz": hz}, timeout=5)
+            return url, isinstance(r, dict) and "error" not in r
+        except OSError:
+            return url, False
+
+    def disarm(url: str) -> "tuple[str, dict | None]":
+        try:
+            r = http_json("POST", f"{url}/debug/pprof",
+                          {"action": "stop"}, timeout=10)
+            return url, r if isinstance(r, dict) else None
+        except OSError:
+            return url, None
+
+    with ThreadPoolExecutor(max_workers=min(8, len(nodes))) as ex:
+        armed = dict(ex.map(arm, nodes))
+        time.sleep(duration)
+        snaps = dict(ex.map(disarm, nodes))
+
+    tables, per_node = [], []
+    for url in nodes:
+        snap = snaps.get(url)
+        if snap is None:
+            per_node.append(f"  {url}: unreachable"
+                            if not armed.get(url) else
+                            f"  {url}: armed but no snapshot")
+            continue
+        tables.append(snap.get("folded") or {})
+        per_node.append(
+            f"  {url}: {snap.get('samples', 0)} passes, "
+            f"{snap.get('stacks', 0)} stacks, "
+            f"overhead={snap.get('overhead', 0.0) * 100:.2f}%")
+    merged = profiling.merge_folded(tables)
+    total = sum(merged.values()) or 1
+    out = [f"cluster.profile — {duration:.1f}s @ {hz:.0f}Hz, "
+           f"{len(tables)}/{len(nodes)} nodes, "
+           f"{len(merged)} distinct stacks"]
+    out.extend(per_node)
+    if "out" in opts:
+        with open(opts["out"], "w", encoding="utf-8") as f:
+            for stack, n in sorted(merged.items(),
+                                   key=lambda kv: -kv[1]):
+                f.write(f"{stack} {n}\n")
+        out.append(f"full collapsed-stack file: {opts['out']} "
+                   f"(flamegraph.pl input)")
+    for stack, n in sorted(merged.items(),
+                           key=lambda kv: -kv[1])[:top]:
+        frames = stack.split(";")
+        tail = ";".join(frames[-3:]) if len(frames) > 3 else stack
+        out.append(f"{n:6d} {n / total * 100:4.1f}%  {tail}")
     return "\n".join(out)
 
 

@@ -289,7 +289,9 @@ def cmd_s3_clean_uploads(env: CommandEnv, args: list[str]) -> str:
     buckets = [e["fullPath"].rsplit("/", 1)[-1]
                for e in json.loads(body).get("entries", [])
                if e.get("isDirectory")]
-    cutoff = time.time() - age
+    # entry mtimes are cross-process wall timestamps written by the
+    # filer — the wall clock is the only shared clock
+    cutoff = time.time() - age  # noqa: SWFS011
     purged = 0
     for bucket in buckets:
         st, body, _ = http_bytes(

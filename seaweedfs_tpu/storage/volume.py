@@ -230,12 +230,21 @@ class Volume:
         overwrite must present the existing needle's cookie unless
         check_cookie is False (replication/tail replay), which adopts it.
         """
-        with self.lock:
+        # stage decomposition (profiling.py): when the volume server
+        # opened a write track for this request, the lock wait, the
+        # index lookup+update, the append, and the durability flush
+        # each report their own write_stage_seconds cell — no-op
+        # context reads otherwise
+        from .. import profiling
+        with profiling.stage("lock"):
+            self.lock.acquire()
+        try:
             if self.read_only:
                 raise PermissionError(f"volume {self.id} is read-only")
             if not n.has_ttl() and self.super_block.ttl:
                 n.set_ttl(self.super_block.ttl)
-            existing = self.nm.get(n.id)
+            with profiling.stage("index"):
+                existing = self.nm.get(n.id)
             if existing is not None:
                 old = self._read_at(existing[0], existing[1])
                 if old.data == n.data and old.cookie == n.cookie:
@@ -247,18 +256,24 @@ class Volume:
                     raise CookieMismatch(
                         f"mismatching cookie {n.cookie:x}")
             n.append_at_ns = self._next_append_at_ns()
-            offset = self._append(n)
+            with profiling.stage("append"):
+                offset = self._append(n)
             if types.size_is_valid(n.size):
-                self.nm.put(n.id, types.to_stored_offset(offset), n.size)
+                with profiling.stage("index"):
+                    self.nm.put(n.id, types.to_stored_offset(offset),
+                                n.size)
             # ack-after-kernel: push the buffered append (and its idx
             # record) to the OS before the caller acks the client — a
             # SIGKILLed process must not lose an acknowledged write
             # (power loss is the -fsync tier, volume.sync(); the
             # process-kill tier is this flush, needle_write.go acks
             # after pwrite the same way)
-            self._dat.flush()
-            self.nm.flush()
+            with profiling.stage("flush"):
+                self._dat.flush()
+                self.nm.flush()
             return offset, len(n.data), False
+        finally:
+            self.lock.release()
 
     def _append(self, n: Needle) -> int:
         self._dat.seek(0, os.SEEK_END)

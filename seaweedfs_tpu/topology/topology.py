@@ -92,7 +92,7 @@ class Topology:
             node.rack = hb.get("rack") or node.rack
             node.max_volume_count = hb.get("maxVolumeCount",
                                            node.max_volume_count)
-            node.last_seen = time.time()
+            node.last_seen = time.monotonic()
             node.volumes = {
                 v["id"]: VolumeInfo(
                     id=v["id"], collection=v.get("collection", ""),
@@ -117,7 +117,10 @@ class Topology:
                 self._max_volume_id = max(self._max_volume_id, vid)
 
     def _liveness_deadline(self) -> float:
-        return time.time() - 3 * self.pulse_seconds
+        # heartbeat ages on the monotonic clock (SWFS011): an NTP step
+        # backwards would otherwise declare the whole fleet dead, and
+        # a step forward would immortalize nodes that stopped pulsing
+        return time.monotonic() - 3 * self.pulse_seconds
 
     def alive_nodes(self) -> list[DataNodeInfo]:
         deadline = self._liveness_deadline()

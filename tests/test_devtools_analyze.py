@@ -475,6 +475,89 @@ def test_swfs010_repo_gateways_are_clean():
     assert [f for f in findings if f.rule == "SWFS010"] == []
 
 
+def test_swfs011_flags_t0_t1_subtraction():
+    src = """
+    import time
+    def f():
+        t0 = time.time()
+        work()
+        return time.time() - t0
+    """
+    found = check(src, "SWFS011")
+    assert len(found) == 1
+    assert "monotonic" in found[0].message
+
+
+def test_swfs011_flags_bound_name_pair():
+    src = """
+    import time
+    def f():
+        start = time.time()
+        end = time.time()
+        dt = end - start
+    """
+    assert len(check(src, "SWFS011")) == 1
+
+
+def test_swfs011_flags_deadline_remaining():
+    src = """
+    import time
+    def f(deadline):
+        return deadline - time.time()
+    """
+    assert len(check(src, "SWFS011")) == 1
+
+
+def test_swfs011_negative_monotonic_and_records():
+    src = """
+    import time
+    def f():
+        t0 = time.monotonic()
+        dur = time.monotonic() - t0       # the fix
+        stamp = time.time()               # a record, no arithmetic
+        return dur, stamp
+    """
+    assert check(src, "SWFS011") == []
+
+
+def test_swfs011_scope_is_per_function():
+    # a name bound to time.time() in ANOTHER scope is not evidence
+    src = """
+    import time
+    def setup():
+        t0 = time.time()
+        return t0
+    def use(t0, t1):
+        return t1 - t0
+    """
+    assert check(src, "SWFS011") == []
+
+
+def test_swfs011_noqa_suppresses():
+    src = """
+    import time
+    def f(mtime):
+        return time.time() - mtime  # noqa: SWFS011
+    """
+    assert check(src, "SWFS011") == []
+
+
+def test_swfs011_repo_is_clean():
+    import os
+
+    import seaweedfs_tpu
+    root = os.path.dirname(seaweedfs_tpu.__file__)
+    findings, errors = run_paths([root])
+    assert not errors
+    from seaweedfs_tpu.devtools.analyze import (default_baseline_path,
+                                                load_baseline,
+                                                partition_baseline)
+    new, _old = partition_baseline(
+        [f for f in findings if f.rule == "SWFS011"],
+        load_baseline(default_baseline_path()))
+    assert new == [], [f.render() for f in new]
+
+
 def test_bare_noqa_suppresses_everything():
     src = """
     def f():
