@@ -18,6 +18,14 @@ def main(argv: list[str] | None = None) -> int:
     # any server object is constructed (devtools/lockgraph.py)
     from .devtools.lockgraph import maybe_instrument
     maybe_instrument()
+    # every role is an IO-chained thread server (handler threads block
+    # on sockets between short CPU bursts); CPython's default 5ms GIL
+    # switch interval adds a convoy delay to EVERY hop's response
+    # wakeup, which multiplies across the client->filer->master->
+    # volume chain.  1ms costs negligible context-switch overhead at
+    # our thread counts and measurably compresses per-hop latency.
+    import sys as _sys
+    _sys.setswitchinterval(0.001)
     p = argparse.ArgumentParser(prog="seaweedfs-tpu")
     # security.toml discovery (util/config.go:34
     # LoadSecurityConfiguration; scaffold command/scaffold/security.toml)
@@ -79,6 +87,10 @@ def main(argv: list[str] | None = None) -> int:
                    default=0,
                    help="mmap the .dat read path for volumes up to "
                         "this size (backend/memory_map role; 0 off)")
+    v.add_argument("-fsync", action="store_true",
+                   help="fsync acked writes (power-loss durability "
+                        "tier; one fsync per group-commit window, "
+                        "amortized across concurrent writers)")
 
     s = sub.add_parser(
         "server", help="all-in-one: master + volume (+ filer + s3), the "
@@ -550,7 +562,8 @@ def main(argv: list[str] | None = None) -> int:
         vs = VolumeServer(args.dir.split(","), args.mserver,
                           host=args.ip, port=args.port,
                           max_volume_count=args.max,
-                          data_center=args.dataCenter, rack=args.rack)
+                          data_center=args.dataCenter, rack=args.rack,
+                          fsync=args.fsync)
         vs.start()
         if args.metrics_address:
             from .stats import MetricsPusher

@@ -803,6 +803,105 @@ class WallDurationRule(Rule):
                     "(or perf_counter) for intervals")
 
 
+class FlushUnderLockRule(Rule):
+    """SWFS012: a blocking durability barrier — `<x>.flush()`,
+    `os.fsync()`, `os.fdatasync()` — executed while holding a
+    per-instance lock (`with <obj>.lock:` / `with <obj>._lock:`, or a
+    `<obj>.lock.acquire()` region).  The barrier serializes every
+    writer behind one kernel round-trip; group commit
+    (util/group_commit.CommitBarrier) exists so concurrent writers
+    buffer under the lock and share ONE flush outside it.  Exempt: the
+    designated barrier helpers (functions named `_group_commit*` — the
+    one place flush-under-lock is the contract), the group_commit
+    module itself, and teardown/maintenance shapes (`close`, `stop`,
+    `abort`, `__exit__`, `__del__`).  Slow-path barriers that are
+    genuinely per-operation (compaction commit points, superblock
+    rewrites) stay with `# noqa: SWFS012` and a reason."""
+
+    id = "SWFS012"
+    severity = "error"
+    title = "blocking flush/fsync while holding a lock"
+
+    _BARRIERS = {"os.fsync", "os.fdatasync"}
+    _EXEMPT_FUNCS = {"close", "stop", "abort", "__exit__", "__del__"}
+    _LOCK_ATTRS = {"lock", "_lock", "_io_lock"}
+
+    def _is_lock_expr(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Attribute) and \
+            node.attr in self._LOCK_ATTRS
+
+    def _barrier_call(self, node: ast.AST) -> "str | None":
+        if not isinstance(node, ast.Call):
+            return None
+        name = _dotted(node.func)
+        if name in self._BARRIERS:
+            return name
+        if name.endswith(".flush") and not node.args and \
+                not node.keywords:
+            return name
+        return None
+
+    @staticmethod
+    def _body_walk(nodes):
+        """Walk statements without descending into nested function
+        definitions (their own visit sees them)."""
+        stack = list(nodes)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def check(self, ctx: FileContext):
+        if ctx.relpath.endswith("util/group_commit.py"):
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if fn.name in self._EXEMPT_FUNCS or \
+                    fn.name.startswith("_group_commit"):
+                continue
+            yield from self._check_function(ctx, fn)
+
+    def _check_function(self, ctx: FileContext, fn: ast.AST):
+        # regions holding a lock: `with <x>.lock:` bodies, plus
+        # everything after a bare `<x>.lock.acquire()` statement in
+        # the same body (the acquire/try/finally-release shape)
+        for node in self._body_walk(fn.body):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                if any(self._is_lock_expr(item.context_expr)
+                       for item in node.items):
+                    yield from self._flag_region(ctx, node.body)
+            elif isinstance(node, ast.Expr) and \
+                    isinstance(node.value, ast.Call):
+                tgt = node.value.func
+                if isinstance(tgt, ast.Attribute) and \
+                        tgt.attr == "acquire" and \
+                        self._is_lock_expr(tgt.value):
+                    # the acquired region is the REST of the enclosing
+                    # body (conservative: up to the function's end)
+                    parent = ctx.parent(node)
+                    body = getattr(parent, "body", [])
+                    if node in body:
+                        rest = body[body.index(node) + 1:]
+                        yield from self._flag_region(ctx, rest)
+
+    def _flag_region(self, ctx: FileContext, body):
+        for n in self._body_walk(body):
+            name = self._barrier_call(n)
+            if name is None:
+                continue
+            yield self.finding(
+                ctx, n,
+                f"{name}() under a held lock serializes every writer "
+                f"behind one kernel barrier — route it through a "
+                f"group-commit helper (util/group_commit."
+                f"CommitBarrier) or noqa with a reason")
+
+
 RULES = [
     LockDisciplineRule(),
     JitBlockingRule(),
@@ -815,4 +914,5 @@ RULES = [
     MissingTimeoutRule(),
     MissingAdmissionRule(),
     WallDurationRule(),
+    FlushUnderLockRule(),
 ]

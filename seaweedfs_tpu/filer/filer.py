@@ -40,24 +40,49 @@ class Filer:
         # cycles (append_chunks/truncate_file): two concurrent
         # /__chunk__/ posts must not lose each other's chunks
         self._chunk_stripes = [threading.Lock() for _ in range(64)]
+        # known-directory cache (the reference filer caches directory
+        # existence the same way): _ensure_parents was issuing one
+        # store SELECT per ancestor per write — for a flat bench tree
+        # that is 2 extra round-trips on every single write.  Bounded;
+        # cleared wholesale on any directory delete/rename (rare), so
+        # staleness can only re-create a directory entry, never lose
+        # one.
+        self._known_dirs: set[str] = set()
+        self._known_dirs_cap = 4096
+
+    def _note_dir(self, path: str) -> None:
+        if len(self._known_dirs) >= self._known_dirs_cap:
+            self._known_dirs.clear()
+        self._known_dirs.add(path)
 
     def _chunk_lock(self, path: str) -> "threading.Lock":
         return self._chunk_stripes[hash(path) % 64]
 
     # -- namespace ops ----------------------------------------------------
 
-    def create_entry(self, entry: Entry,
-                     create_parents: bool = True) -> None:
+    _UNKNOWN = object()   # create_entry: "caller didn't pre-fetch"
+
+    def create_entry(self, entry: Entry, create_parents: bool = True,
+                     old_entry=_UNKNOWN) -> None:
+        """`old_entry` lets a caller that already looked the path up
+        (write_file's overwrite check) pass its result through instead
+        of paying a second store read for the update-vs-create event
+        verdict."""
         entry.full_path = normalize_path(entry.full_path)
         if create_parents:
             self._ensure_parents(entry.full_path)
-        old = self.store.find_entry(entry.full_path)
+        old = self.store.find_entry(entry.full_path) \
+            if old_entry is self._UNKNOWN else old_entry
         self.store.insert_entry(entry)
+        if entry.is_directory:
+            self._note_dir(entry.full_path)
         self._notify("update" if old else "create", entry, old)
 
     def _ensure_parents(self, path: str) -> None:
         parent = path.rsplit("/", 1)[0]
         if not parent or parent == "/":
+            return
+        if parent in self._known_dirs:
             return
         if self.store.find_entry(parent) is None:
             e = Entry(parent, is_directory=True,
@@ -65,6 +90,7 @@ class Filer:
             self._ensure_parents(parent)
             self.store.insert_entry(e)
             self._notify("create", e, None)
+        self._note_dir(parent)
 
     def find_entry(self, path: str) -> Entry | None:
         return self.store.find_entry(normalize_path(path))
@@ -83,6 +109,15 @@ class Filer:
         elif delete_chunks:
             self._delete_chunks(entry)
         self.store.delete_entry(path)
+        if entry.is_directory:
+            # wholesale, and AFTER the store delete: clearing before
+            # it would let a concurrent _note_dir re-cache the doomed
+            # path and suppress its re-creation forever.  (A racing
+            # write can still land an entry under a just-deleted
+            # parent — the same check-then-insert window the store
+            # always had; the cache only matches that window, never
+            # widens it past this clear.)
+            self._known_dirs.clear()
         self._notify("delete", None, entry)
 
     def _delete_tree(self, path: str, delete_chunks: bool) -> None:
@@ -142,6 +177,8 @@ class Filer:
         entry.full_path = new_path
         self.store.insert_entry(entry)
         self.store.delete_entry(old_path)
+        if entry.is_directory:
+            self._known_dirs.clear()   # the old path left the tree
         self._notify("rename", entry, old_entry)
 
     # -- content IO -------------------------------------------------------
@@ -175,14 +212,21 @@ class Filer:
             return FileChunk(a.fid, off, len(piece),
                              r.get("eTag", ""), time.time_ns())
 
+        # persistent=True: the fan-out runs on the process-wide worker
+        # pool, so each worker's thread-local keep-alive sockets (the
+        # pooled client funnel) survive across requests — a fresh
+        # executor per write was re-dialing every volume server on
+        # every multi-chunk upload.  Single-chunk writes stay inline
+        # on the handler thread: zero per-request thread hand-offs.
         chunks = bounded_parallel(
-            upload_piece, range(0, len(data), CHUNK_SIZE), limit=4)
+            upload_piece, range(0, len(data), CHUNK_SIZE), limit=4,
+            persistent=True)
         entry = Entry(normalize_path(path), is_directory=False,
                       attributes=Attributes(mime=mime, mode=mode),
                       chunks=chunks)
         with profiling.stage("meta"):
             old = self.find_entry(path)
-            self.create_entry(entry)
+            self.create_entry(entry, old_entry=old)
         if old is not None and not old.is_directory:
             # separate stage: these are volume-server DELETE round
             # trips, not metadata-store work — folding them into

@@ -111,7 +111,14 @@ class SqliteStore(AbstractSqlStore):
 
     def __init__(self, path: str = ":memory:"):
         dialect = SqliteDialect()
-        super().__init__(dialect.connect(path), dialect)
+        # file-backed stores get the WAL read plane (per-thread read
+        # connections that never block behind the writer); :memory:
+        # databases are private per connection, so reads stay on the
+        # shared conn under the lock
+        read_factory = (lambda: dialect.connect(path)) \
+            if path != ":memory:" else None
+        super().__init__(dialect.connect(path), dialect,
+                         read_factory=read_factory)
 
     # kept for callers/tests that exercised the escaping directly
     _like_escape = staticmethod(SqlDialect.like_escape)

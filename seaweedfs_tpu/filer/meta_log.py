@@ -16,6 +16,15 @@ volume-server chunks exists because its log doubles as an MQ topic.
 A bounded in-memory tail keeps the common `events_since(recent)` query
 off the disk.  Timestamps are made strictly monotonic at append time so
 `> sinceNs` resume can never skip a same-timestamp sibling.
+
+Durability is GROUP-COMMITTED (util/group_commit.py): appenders stamp
+and enqueue their serialized line under the stamp lock, then meet at a
+shared barrier — one leader drains the queue, writes every line, and
+flushes the segment ONCE for the whole batch; every appender returns
+only after a flush that covers its line.  Ack semantics are identical
+to the old flush-per-event loop (an acked event survives SIGKILL; a
+torn tail line is always an unacked event), but N concurrent appenders
+share one barrier instead of serializing N of them.
 """
 
 from __future__ import annotations
@@ -25,6 +34,8 @@ import os
 import threading
 import time
 from collections import deque
+
+from ..util.group_commit import CommitBarrier
 
 
 def _segment_name(ts_ns: int) -> "tuple[str, str]":
@@ -46,11 +57,23 @@ class MetaLog:
         self._mem: deque[dict] = deque(maxlen=max_memory_events)
         self._lock = threading.Lock()
         self._last_ts = 0
+        # stamped-and-buffered lines awaiting the shared barrier, in
+        # stamp order (stamping and enqueueing share self._lock)
+        self._pending: "list[tuple[int, str]]" = []
         self._open_name: "tuple[str, str] | None" = None
         self._open_file = None
+        # highest stamp whose line a barrier has flushed: the memory
+        # tail may briefly lead the disk (stamped, queued, pre-flush),
+        # and events_since must not serve an event a crash could still
+        # lose — a subscriber that recorded its tsNs would silently
+        # skip it on resume after replay
+        self._durable_ts = 0
+        self._barrier = CommitBarrier(self._group_commit_drain,
+                                      site="filer.metalog")
         if self.dir:
             os.makedirs(self.dir, exist_ok=True)
             self._last_ts = self._scan_last_ts()
+            self._durable_ts = self._last_ts
 
     # -- append -----------------------------------------------------------
 
@@ -58,7 +81,9 @@ class MetaLog:
         """Stamp and persist one event.  The event's tsNs is bumped if
         needed so stamps are strictly increasing even across restarts
         (replay uses `> sinceNs`; two events sharing a stamp would let
-        a resumer skip the second)."""
+        a resumer skip the second).  Returns only after the shared
+        group-commit barrier has flushed the event's line — an acked
+        event survives SIGKILL, exactly like the old per-event flush."""
         with self._lock:
             ts = int(event.get("tsNs") or time.time_ns())
             if ts <= self._last_ts:
@@ -67,19 +92,32 @@ class MetaLog:
             event["tsNs"] = ts
             self._mem.append(event)
             if self.dir:
-                name = _segment_name(ts)
-                if name != self._open_name:
-                    self._rotate(name)
-                self._open_file.write(
-                    json.dumps(event, separators=(",", ":")) + "\n")
-                # flush to the OS on every event: survives a process
-                # crash; the reference's log_buffer batches ~2min per
-                # chunk upload and accepts the same page-cache window
-                self._open_file.flush()
+                self._pending.append(
+                    (ts, json.dumps(event, separators=(",", ":"))))
+        if self.dir:
+            self._barrier.commit()
         return event
 
+    def _group_commit_drain(self) -> None:
+        """The barrier's designated flush helper: drain every queued
+        line into its segment and flush ONCE.  Only ever entered by
+        one leader at a time (CommitBarrier serializes batches), so
+        the segment handle needs no lock of its own."""
+        with self._lock:
+            batch, self._pending = self._pending, []
+        for ts, line in batch:
+            name = _segment_name(ts)
+            if name != self._open_name:
+                self._rotate(name)
+            self._open_file.write(line + "\n")
+        if self._open_file is not None:
+            self._open_file.flush()
+        if batch:
+            with self._lock:
+                self._durable_ts = max(self._durable_ts, batch[-1][0])
+
     def _rotate(self, name: "tuple[str, str]") -> None:
-        """Caller holds the lock."""
+        """Caller is the barrier leader (serialized)."""
         if self._open_file is not None:
             self._open_file.close()
         day_dir = os.path.join(self.dir, name[0])
@@ -97,11 +135,22 @@ class MetaLog:
         CollectLogFileRefs)."""
         with self._lock:
             mem = list(self._mem)
+            durable = self._durable_ts
+        if self.dir:
+            # serve only barrier-flushed events: an event still queued
+            # for its flush is not yet acked, and a crash could erase
+            # it — mem visibility must imply durability, as it did
+            # when append flushed under the lock
+            mem = [e for e in mem if e["tsNs"] <= durable]
         if mem and (mem[0]["tsNs"] <= ts_ns or not self.dir):
             out = [e for e in mem if e["tsNs"] > ts_ns]
             return out[:limit] if limit else out
         if not self.dir:
             return []
+        # disk replay: lines queued at the barrier are in _mem but may
+        # not be in their segments yet — force a barrier so the replay
+        # below cannot miss a just-acked sibling
+        self._barrier.sync()
         out = []
         start_day, start_min = _segment_name(ts_ns) if ts_ns else ("", "")
         for day in sorted(os.listdir(self.dir)):
@@ -153,8 +202,12 @@ class MetaLog:
         return 0
 
     def close(self) -> None:
-        with self._lock:
-            if self._open_file is not None:
-                self._open_file.close()
-                self._open_file = None
-                self._open_name = None
+        if self.dir:
+            self._barrier.sync()   # drain queued lines before closing
+        # the segment handle is owned by barrier leaders (serialized
+        # by the barrier, not by self._lock); after the final sync
+        # above no leader is active
+        if self._open_file is not None:
+            self._open_file.close()
+            self._open_file = None
+            self._open_name = None

@@ -130,7 +130,7 @@ class PartitionLog:
 
     def flush(self) -> None:
         with self._lock:
-            self._buf.flush()
+            self._buf.flush()  # noqa: SWFS012 — explicit broker sync point (stop-then-flush invariant); appends buffer
 
     def _flush_records(self, recs: "list[dict]") -> None:
         """LogBuffer sink: one filer segment per flushed page.

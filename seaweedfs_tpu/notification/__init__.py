@@ -268,7 +268,7 @@ class LogFilePublisher(Publisher):
     def publish(self, event: dict) -> None:
         with self._lock:
             self._f.write(json.dumps(event) + "\n")
-            self._f.flush()
+            self._f.flush()  # noqa: SWFS012 — audit/debug sink at human-scale event rates
 
     def close(self) -> None:
         with self._lock:

@@ -69,6 +69,7 @@ def master_json(master: str, method: str, path: str,
     order = ([cached] if cached else []) + \
         [s for s in seeds if s != cached]
     last = "no masters configured"
+    last_exc: "OSError | None" = None
     tried: set[str] = set()
     while order:
         url = order.pop(0)
@@ -80,6 +81,7 @@ def master_json(master: str, method: str, path: str,
                           headers=headers)
         except OSError as e:
             last = f"{url}: {e}"
+            last_exc = e
             continue
         if r.get("error") == "not leader":
             hint = r.get("leader", "")
@@ -90,7 +92,10 @@ def master_json(master: str, method: str, path: str,
         with _leader_lock:
             _leader_cache[master] = url
         return r
-    raise OSError(f"master_json {path}: {last}")
+    # chain the transport exception: callers can see through the
+    # wrapper to e.g. a BreakerOpen's retry_after (assign_and_upload
+    # waits a master breaker's cooldown out instead of failing fast)
+    raise OSError(f"master_json {path}: {last}") from last_exc
 
 
 @dataclass
@@ -134,6 +139,14 @@ def upload(url: str, fid: str, data: bytes, name: str = "",
     holds the write key, e.g. in-process filer)."""
     qs = "?" + urllib.parse.urlencode({"name": name}) if name else ""
     headers = {"Content-Type": mime} if mime else {}
+    # a fixed-fid needle write is idempotent by construction (a replay
+    # of the same fid+cookie+bytes is answered "unchanged" by
+    # volume.write_needle's dedup) — declaring it lets the pooled
+    # keep-alive client re-issue the POST inline when a REUSED socket
+    # died before the request hit the wire, instead of surfacing every
+    # keep-alive race as a tenant-visible error or a fresh-assign
+    # retry round (the funnel stays on warm sockets end-to-end)
+    headers["X-Idempotent"] = "1"
     if not auth:
         auth = security.current().write_jwt(fid)
     if auth:
@@ -148,6 +161,88 @@ def upload(url: str, fid: str, data: bytes, name: str = "",
     return json.loads(body)
 
 
+# -- assign batching (the persistent-funnel half of the group-commit
+#    write path): one master round-trip reserves a RANGE of file keys
+#    (assign?count=N against a range-reserving sequencer), and the
+#    next N-1 writes derive their fids locally — same vid, same
+#    cookie, key+i — exactly the reference's count-assign contract
+#    (operation/assign_file_id.go Fid_i derivation).  The master hop
+#    was ~25% of the filer write wall; amortized N-fold it vanishes
+#    from the funnel.  Derived fids carry no per-fid master JWT; in
+#    signed deployments the uploader signs locally with the shared
+#    write key (security.toml is cluster-wide), which upload() already
+#    does for auth="".
+#
+#    Safety: windows expire after ASSIGN_TTL (one heartbeat-ish, so a
+#    volume the master would no longer pick is never written long
+#    after its state changed), are keyed by the full placement spec,
+#    and are dropped on any upload failure BEFORE the fresh-assign
+#    retry (a readonly/moved volume costs one retry, exactly as
+#    before).  SEAWEEDFS_TPU_ASSIGN_BATCH sets the window (default
+#    16); 1 restores per-write assigns.
+
+class _AssignCache:
+    TTL = 2.0
+
+    def __init__(self):
+        self._m: dict = {}          # spec -> [Assignment, next_i, exp]
+        self._lock = threading.Lock()
+        # single-flight window refresh: when a window exhausts, every
+        # concurrent writer misses at once — one refresher goes to the
+        # master, the rest wait on this lock and re-take from the
+        # fresh window (a thundering herd of N assigns per refresh
+        # otherwise lands on the master in lockstep)
+        self._refresh: dict = {}
+
+    def refresh_lock(self, spec) -> "threading.Lock":
+        with self._lock:
+            lk = self._refresh.get(spec)
+            if lk is None:
+                lk = self._refresh[spec] = threading.Lock()
+            return lk
+
+    def take(self, spec) -> "Assignment | None":
+        with self._lock:
+            ent = self._m.get(spec)
+            if ent is None:
+                return None
+            a, i, exp = ent
+            if i >= a.count or time.monotonic() > exp:
+                del self._m[spec]
+                return None
+            ent[1] += 1
+        if i == 0:
+            return a
+        from .storage import types as _types
+        base = _types.parse_file_id(a.fid)
+        fid = str(_types.FileId(base.volume_id, base.key + i,
+                                base.cookie))
+        return Assignment(fid, a.url, a.public_url, 1, auth="")
+
+    def put(self, spec, a: Assignment) -> None:
+        if a.count <= 1:
+            return
+        with self._lock:
+            # [.., 1, ..]: the base fid is handed to the caller
+            self._m[spec] = [a, 1, time.monotonic() + self.TTL]
+
+    def invalidate(self, spec) -> None:
+        with self._lock:
+            self._m.pop(spec, None)
+
+
+_assign_cache = _AssignCache()
+
+
+def assign_batch_size() -> int:
+    import os
+    try:
+        return max(1, int(os.environ.get(
+            "SEAWEEDFS_TPU_ASSIGN_BATCH", "") or 16))
+    except ValueError:
+        return 16
+
+
 def assign_and_upload(master: str, data: bytes, name: str = "",
                       mime: str = "", collection: str = "",
                       replication: str = "", ttl: str = "",
@@ -159,8 +254,13 @@ def assign_and_upload(master: str, data: bytes, name: str = "",
     routine race once background maintenance runs under live traffic
     (the soak scenario), and the stale assignment, not the data, is
     what's wrong.  Other 4xx are deterministic rejections and raise
-    immediately.  Returns (assignment, upload response)."""
+    immediately.  Assigns are batched through the module's window
+    cache (see _AssignCache); any failure drops the window first so
+    the retry always re-assigns fresh.  Returns (assignment, upload
+    response)."""
     last: Exception | None = None
+    batch = assign_batch_size()
+    spec = (master, collection, replication, ttl)
     for attempt in range(max(retries, 1)):
         if attempt:
             # short ramp before re-assigning: the usual cause is a
@@ -168,18 +268,50 @@ def assign_and_upload(master: str, data: bytes, name: str = "",
             # (readonly heartbeats race); re-assigning in the same
             # millisecond just replays the stale map
             time.sleep(0.05 * attempt)
+        from_cache = False
         try:
-            a = assign(master, collection=collection,
-                       replication=replication, ttl=ttl)
+            a = _assign_cache.take(spec) if batch > 1 and \
+                not attempt else None
+            from_cache = a is not None
+            if a is None and batch > 1 and not attempt:
+                # single-flight: one thread refreshes the window, the
+                # stampede re-takes from it
+                with _assign_cache.refresh_lock(spec):
+                    a = _assign_cache.take(spec)
+                    from_cache = a is not None
+                    if a is None:
+                        a = assign(master, count=batch,
+                                   collection=collection,
+                                   replication=replication, ttl=ttl)
+                        _assign_cache.put(spec, a)
+            elif a is None:
+                a = assign(master, count=batch, collection=collection,
+                           replication=replication, ttl=ttl)
             r = upload(a.url, a.fid, data, name=name, mime=mime,
                        auth=a.auth)
             return a, r
         except UploadError as e:
-            if e.status != 409 and e.status < 500:
+            _assign_cache.invalidate(spec)
+            if not from_cache and e.status != 409 and e.status < 500:
                 raise  # deterministic rejection — retrying can't help
+            # a rejected CACHED fid is stale-window evidence (the
+            # volume moved/unmounted/filled since the assign), never a
+            # verdict on the data: drop the window, re-assign fresh
             last = e
         except (RuntimeError, OSError) as e:
+            _assign_cache.invalidate(spec)
             last = e
+            from .util.retry import BreakerOpen
+            cause = e if isinstance(e, BreakerOpen) else e.__cause__
+            if isinstance(cause, BreakerOpen) and \
+                    attempt + 1 < max(retries, 1):
+                # the breaker'd peer is a SOLE dependency here (the
+                # master, or the assigned volume): fail-fast exists to
+                # fan AWAY from a sick peer, but with nowhere else to
+                # go the right move is to wait the cooldown out — a
+                # brief master restart then costs this write latency,
+                # not a tenant-visible 500
+                time.sleep(min(max(cause.retry_after, 0.1), 2.0))
     raise RuntimeError(f"upload failed after {retries} attempts: {last}")
 
 

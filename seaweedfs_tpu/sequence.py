@@ -11,6 +11,12 @@ class MemorySequencer:
     """In-memory counter; the master checkpoints/raft-replicates it in
     the reference — here the master persists it with its state."""
 
+    # next_file_id(count) reserves [start, start+count): assign with
+    # count=N may hand clients the base fid and let them DERIVE the
+    # other N-1 keys (the reference's count-assign contract, the
+    # filer funnel's assign batching)
+    reserves_ranges = True
+
     def __init__(self, start: int = 1):
         self._counter = start
         self._lock = threading.Lock()
@@ -39,6 +45,11 @@ class SnowflakeSequencer:
     (weed/sequence/snowflake_sequencer.go via sony/sonyflake layout)."""
 
     EPOCH_MS = 1_577_836_800_000  # 2020-01-01
+
+    # snowflake ids are clock-derived: count>1 does NOT reserve a
+    # contiguous range, so derived key+i would collide with the next
+    # issued id — the master caps the granted count at 1
+    reserves_ranges = False
 
     def __init__(self, machine_id: int = 1):
         if not 0 <= machine_id < 1024:

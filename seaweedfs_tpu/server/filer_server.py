@@ -188,6 +188,14 @@ class FilerServer:
         from .debug import install_debug_routes
         install_debug_routes(self.http)  # util/grace/pprof.go analog
         self.http.guard = self._guard
+        # pre-parsed prefix routes (httpd.route_prefix): the TUS and
+        # interval-chunk planes resolve from the compiled table
+        # instead of per-request startswith chains in the fallback
+        for m in ("OPTIONS", "POST", "HEAD", "PATCH", "DELETE", "GET",
+                  "PUT"):
+            self.http.route_prefix(m, "/__tus__/", self._tus_route)
+        self.http.route_prefix("POST", "/__chunk__/",
+                               self._chunk_route)
         self.http.fallback = self._dispatch
         # QoS plane (qos.py): per-tenant admission at the filer edge
         # (tenant = auth principal / X-Tenant / anonymous), and this
@@ -310,11 +318,26 @@ class FilerServer:
 
     # -- dispatch ---------------------------------------------------------
 
+    def _tus_route(self, req: Request):
+        """Compiled-prefix entry for the TUS plane (see route_prefix
+        registration): unquote once, delegate."""
+        import urllib.parse
+        return self._tus(req, urllib.parse.unquote(req.path))
+
+    def _chunk_route(self, req: Request):
+        import urllib.parse
+        return self._chunk_write(
+            req, urllib.parse.unquote(req.path)[len("/__chunk__"):])
+
     def _dispatch(self, req: Request):
         import urllib.parse
         # the wire path is percent-encoded (every client quotes);
         # storing it un-decoded would persist names like "a%21" for
-        # "a!" — visible in listings and to in-process consumers
+        # "a!" — visible in listings and to in-process consumers.
+        # (The /__tus__/ and /__chunk__/ planes normally resolve from
+        # the compiled prefix table before this fallback runs; the
+        # checks below keep percent-encoded spellings routing the way
+        # they always did.)
         path = urllib.parse.unquote(req.path)
         if path.startswith("/__tus__/"):
             return self._tus(req, path)

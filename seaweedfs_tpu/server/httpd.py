@@ -19,18 +19,37 @@ from typing import Callable
 
 class Request:
     def __init__(self, handler: BaseHTTPRequestHandler):
-        parsed = urllib.parse.urlparse(handler.path)
+        # hot-path parse: one partition instead of a full urlparse.
+        # Targets are origin-form (RFC 9112 §3.2.1) except the
+        # absolute-form a forward proxy may send (§3.2.2 requires
+        # accepting it) — strip scheme+authority for that rare shape
+        path, _, query = handler.path.partition("?")
+        if path[:4] == "http" and "://" in path[:8]:
+            rest = path.split("://", 1)[1]
+            slash = rest.find("/")
+            path = rest[slash:] if slash >= 0 else "/"
         self.method = handler.command
-        self.path = parsed.path
+        self.path = path
         self.remote_ip = handler.client_address[0]
-        # keep_blank_values: S3-style marker params (?uploads=, ?delete=)
-        # must survive parsing
-        self.query = {k: v[0] for k, v in
-                      urllib.parse.parse_qs(
-                          parsed.query, keep_blank_values=True).items()}
+        self._raw_query = query
+        self._query: "dict[str, str] | None" = None
         self.headers = handler.headers
         self._handler = handler
         self._body: bytes | None = None
+
+    @property
+    def query(self) -> "dict[str, str]":
+        """Parsed query params, lazily: the hot data path (needle
+        POSTs, filer PUTs) usually carries none, and parse_qs per
+        request was measurable funnel overhead.  keep_blank_values:
+        S3-style marker params (?uploads=, ?delete=) must survive
+        parsing."""
+        if self._query is None:
+            self._query = {
+                k: v[0] for k, v in urllib.parse.parse_qs(
+                    self._raw_query, keep_blank_values=True).items()} \
+                if self._raw_query else {}
+        return self._query
 
     @property
     def body(self) -> bytes:
@@ -190,6 +209,12 @@ class HttpServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.routes: dict[tuple[str, str], Route] = {}
+        # pre-parsed prefix table, compiled at registration: method ->
+        # [(prefix, handler)] longest-first.  Role servers used to
+        # re-match their path prefixes inside the fallback on every
+        # request; hot-path dispatch now resolves exact -> prefix ->
+        # fallback from tables built once at boot.
+        self.prefix_routes: dict[str, list] = {}
         self.fallback: Route | None = None
         # optional auth hook (security/guard.go Guard): returns None to
         # continue or a (status, payload) response to short-circuit
@@ -232,6 +257,8 @@ class HttpServer:
                 rid = ensure_request_id(
                     req.headers.get(_RID_HEADER, ""))
                 route = outer.routes.get((req.method, req.path))
+                if route is None and outer.prefix_routes:
+                    route = outer._prefix_route(req.method, req.path)
                 # server span: trace id = request id, parent from the
                 # caller's X-Trace-Parent (tracing.py); every role's
                 # handler is wrapped by this one middleware
@@ -413,30 +440,49 @@ class HttpServer:
                 self._conns_lock = threading.Lock()
 
             def finish_request(self, request, client_address):
-                # TLS handshake PER CONNECTION in the handler thread —
-                # wrapping the listening socket would handshake inside
-                # the single accept loop, letting one silent client
-                # stall every role and wedge shutdown
-                if self.ssl_context is not None:
-                    import ssl as _ssl
-                    try:
-                        request.settimeout(10)
-                        request = self.ssl_context.wrap_socket(
-                            request, server_side=True)
-                        request.settimeout(None)
-                    except (_ssl.SSLError, OSError):
-                        try:
-                            request.close()
-                        except OSError:
-                            pass
-                        return
+                # TLS handshake PER CONNECTION in its own handler
+                # thread — wrapping the listening socket would
+                # handshake inside the single accept loop, letting one
+                # silent client stall every role and wedge shutdown.
+                # The raw socket joins _conns BEFORE the handshake so
+                # stop() can sever a connection parked mid-handshake
+                # (previously only handshaken sockets were severable),
+                # and a failed handshake is counted but never reaches
+                # _dispatch — the requests_in_flight gauge only ever
+                # counts handshaken, dispatched requests.
+                raw = request
                 with self._conns_lock:
-                    self._conns.add(request)
+                    self._conns.add(raw)
                 try:
+                    if self.ssl_context is not None:
+                        import ssl as _ssl
+                        try:
+                            request.settimeout(10)
+                            request = self.ssl_context.wrap_socket(
+                                request, server_side=True)
+                            request.settimeout(None)
+                        except (_ssl.SSLError, OSError) as e:
+                            from ..stats import PROCESS
+                            PROCESS.counter_add(
+                                "tls_handshake_failures_total", 1.0,
+                                help_text="inbound TLS handshakes "
+                                          "that never completed",
+                                reason=type(e).__name__)
+                            try:
+                                request.close()
+                            except OSError:
+                                pass
+                            return
+                        with self._conns_lock:
+                            # track the wrapped socket: close() on it
+                            # tears down the TLS layer AND the raw fd
+                            self._conns.discard(raw)
+                            self._conns.add(request)
                     super().finish_request(request, client_address)
                 finally:
                     with self._conns_lock:
                         self._conns.discard(request)
+                        self._conns.discard(raw)
 
             def close_established(self):
                 import socket as _socket
@@ -470,6 +516,21 @@ class HttpServer:
 
     def route(self, method: str, path: str, fn: Route) -> None:
         self.routes[(method, path)] = fn
+
+    def route_prefix(self, method: str, prefix: str, fn: Route) -> None:
+        """Register a handler for every path under `prefix`.  The
+        per-method table is kept longest-prefix-first so nested
+        prefixes resolve to the most specific handler."""
+        table = self.prefix_routes.setdefault(method, [])
+        table[:] = [(p, f) for p, f in table if p != prefix]
+        table.append((prefix, fn))
+        table.sort(key=lambda pf: -len(pf[0]))
+
+    def _prefix_route(self, method: str, path: str) -> "Route | None":
+        for prefix, fn in self.prefix_routes.get(method, ()):
+            if path.startswith(prefix):
+                return fn
+        return None
 
     def start(self) -> None:
         tls = _tls_config()

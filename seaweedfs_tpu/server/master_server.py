@@ -338,12 +338,34 @@ class MasterServer:
                 collection, replication, ttl_u32)
         except LookupError:
             try:
+                # grow a SET of volumes, not one (volume_growth.go
+                # findVolumeCount: 7/6/3 by copy count): a layout that
+                # grows a single volume funnels the whole cluster's
+                # writes through one disk and one server — write
+                # throughput then never scales past one node no matter
+                # how many are registered.  Scaled to capacity (one
+                # per 16 free slots per copy): small rigs keep the
+                # seed's one-volume behavior and other collections'
+                # slots are never starved.  Explicit `volume.grow
+                # -count=N` requests are NOT capped — only this
+                # implicit assign-path round is.
+                free = sum(max(0, n.free_space)
+                           for n in self.topology.alive_nodes())
+                per_round, copies = _growth_plan(replication)
+                n_grow = max(1, min(per_round,
+                                    free // (16 * copies)))
                 self._grow_volume(collection, replication, ttl,
+                                  count=n_grow,
                                   only_if_unwritable=True)
             except LookupError as e:
                 return 500, {"error": f"cannot grow volume: {e}"}
             vid, nodes = self.topology.pick_for_write(
                 collection, replication, ttl_u32)
+        # the granted count is only honest when the sequencer reserves
+        # a contiguous range clients may derive keys from (assign
+        # count contract); a clock-derived sequencer grants 1
+        if not getattr(self.sequencer, "reserves_ranges", False):
+            count = 1
         key = self.sequencer.next_file_id(count)
         # raft-checkpointed sequence: top up the committed bound before
         # the counter reaches it (off the hot path)
@@ -433,6 +455,11 @@ class MasterServer:
                     grown.append(vid)
                     break
                 else:
+                    if grown:
+                        # partial growth (free slots ran out mid-set):
+                        # what grew is writable — better than failing
+                        # the assign that triggered the round
+                        break
                     raise LookupError(f"volume growth failed: {last_err}")
             return grown
 
@@ -624,3 +651,18 @@ class MasterServer:
 def _ttl_u32(ttl: str) -> int:
     from ..storage.ttl import read_ttl
     return read_ttl(ttl).to_u32() if ttl else 0
+
+
+def _growth_plan(replication: str) -> "tuple[int, int]":
+    """(volumes per growth round, copies per volume)
+    (volume_growth.go:32 findVolumeCount): 7 for unreplicated, 6 for
+    2-copy, 3 for 3-copy, 1 beyond — enough writable volumes that
+    pick_for_write spreads concurrent writers across disks and nodes
+    instead of funneling the cluster through one volume."""
+    from ..storage.replica_placement import ReplicaPlacement
+    try:
+        copies = ReplicaPlacement.from_string(
+            replication or "000").copy_count()
+    except (ValueError, AttributeError):
+        return 1, 1
+    return {1: 7, 2: 6, 3: 3}.get(copies, 1), copies

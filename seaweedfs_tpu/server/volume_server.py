@@ -14,6 +14,7 @@ BEFORE the shard files (volume_grpc_erasure_coding.go:89-98).
 from __future__ import annotations
 
 import os
+import queue
 import re
 import threading
 import time
@@ -41,7 +42,8 @@ class VolumeServer:
                  public_url: str = "", pulse_seconds: float = 1.0,
                  data_center: str = "", rack: str = "",
                  max_volume_count: int = 8,
-                 security_config: "security.SecurityConfig | None" = None):
+                 security_config: "security.SecurityConfig | None" = None,
+                 fsync: bool = False):
         self.master = master
         self._security_override = security_config
         self.pulse_seconds = pulse_seconds
@@ -49,7 +51,8 @@ class VolumeServer:
         self.rack = rack
         self.http = HttpServer(host, port)
         self.store = Store(directories, ip=host, port=self.http.port,
-                           public_url=public_url or self.http.url)
+                           public_url=public_url or self.http.url,
+                           fsync=fsync)
         for loc in self.store.locations:
             loc.max_volume_count = max_volume_count
         r = self.http.route
@@ -170,12 +173,22 @@ class VolumeServer:
         self._rp_lock = threading.Lock()
         self._rp_gen: dict[int, int] = {}
         self._rp_seen: dict[int, set] = {}
+        self._rp_queue = None
         if not self.security.volume_read_key:
             try:
                 from .read_plane import ReadPlane
                 self.read_plane = ReadPlane(self.http.host)
             except (RuntimeError, OSError):
                 self.read_plane = None
+        if self.read_plane is not None:
+            # write-path registrations drain through a worker so the
+            # needle ack never waits on plane bookkeeping (the plane
+            # is a read cache: until the entry lands, reads fall back
+            # to this port and warm it lazily).  Bounded; overflow
+            # drops the registration, lazy warm recovers it.
+            self._rp_queue = queue.Queue(maxsize=4096)
+            threading.Thread(target=self._rp_worker,
+                             daemon=True).start()
         # gRPC wire plane (volume_server.proto subset) — optional;
         # JSON-HTTP stays the always-on surface
         try:
@@ -193,6 +206,28 @@ class VolumeServer:
                                            daemon=True)
         self._hb_thread.start()
         return self
+
+    def _rp_worker(self) -> None:
+        while True:
+            item = self._rp_queue.get()
+            if item is None:
+                return
+            try:
+                self._rp_register(item[0], item[1], lazy=True)
+            except Exception:  # noqa: SWFS004 — read-plane cache
+                pass           # upkeep must never kill the worker
+
+    def _rp_enqueue(self, vid: int, needle) -> None:
+        """Async write-path registration (see start()); no-op without
+        the plane (getattr: a request can land between http.start()
+        and the plane's init)."""
+        q = getattr(self, "_rp_queue", None)
+        if q is None:
+            return
+        try:
+            q.put_nowait((vid, needle))
+        except queue.Full:
+            pass           # drop: lazy warm re-registers on first read
 
     def _rp_register(self, vid: int, needle,
                      lazy: bool = False) -> None:
@@ -218,9 +253,14 @@ class VolumeServer:
         got = v.nm.get(needle.id)
         if got is None:
             return
-        # the plane reads its own fd: buffered appends must reach the
-        # OS file before the entry is servable
-        v.flush()
+        if lazy:
+            # the plane reads its own fd: buffered appends must reach
+            # the OS file before the entry is servable.  The write
+            # path skips this — write_needle's group-commit barrier
+            # already flushed the record before acking, so another
+            # flush here would only re-serialize writers on the
+            # volume lock.
+            v.flush()
         with self._rp_lock:
             if self._rp_gen.get(vid, 0) != gen:
                 return  # dropped (vacuum/delete) after our offset read
@@ -248,6 +288,11 @@ class VolumeServer:
         self._hb_stop.set()
         from .. import qos
         qos.throttle().remove_source(f"volume:{self.http.port}")
+        if getattr(self, "_rp_queue", None) is not None:
+            try:
+                self._rp_queue.put_nowait(None)   # end the worker
+            except queue.Full:
+                pass           # daemon worker dies with the process
         if getattr(self, "read_plane", None) is not None:
             self.read_plane.stop()
         if getattr(self, "uds_server", None) is not None:
@@ -455,7 +500,7 @@ class VolumeServer:
         except PermissionError as e:
             return 409, {"error": str(e)}
         with profiling.stage("register"):
-            self._rp_register(fid.volume_id, n)
+            self._rp_enqueue(fid.volume_id, n)
         # synchronous replication fan-out
         # (topology/store_replicate.go:27 ReplicatedWrite); forward the
         # original Content-Type and stamp ts so every replica writes a

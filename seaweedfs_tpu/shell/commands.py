@@ -1124,13 +1124,43 @@ def _stage_report(before: "dict[str, list]", after: "dict[str, list]",
     return "write stages: " + " ".join(parts)
 
 
+def _group_commit_report(before: "dict[str, list]",
+                         after: "dict[str, list]") -> str:
+    """Per-site group-commit view over the sampling window: mean
+    batch (writers covered per shared durability barrier) and
+    barrier-wait p99, from the util/group_commit metrics on the
+    shared process registry.  Empty when no barrier fired."""
+    from .. import profiling
+    batch = "seaweedfs_tpu_group_commit_batch_size"
+    wait = "seaweedfs_tpu_group_commit_wait_seconds"
+    sites = {l.get("site", "") for l, _v in
+             after.get(f"{batch}_count", [])}
+    parts = []
+    for site in sorted(sites):
+        h = profiling.histogram_delta(
+            profiling.prom_histogram(after, batch, {"site": site}),
+            profiling.prom_histogram(before, batch, {"site": site}))
+        if not h or h["count"] <= 0:
+            continue
+        w = profiling.histogram_delta(
+            profiling.prom_histogram(after, wait, {"site": site}),
+            profiling.prom_histogram(before, wait, {"site": site}))
+        p99 = profiling.histogram_quantile(w, 0.99) if w else 0.0
+        parts.append(f"{site} batch={h['sum'] / h['count']:.1f} "
+                     f"wait-p99={p99 * 1e3:.2f}ms")
+    if not parts:
+        return ""
+    return "group-commit: " + "  ".join(parts)
+
+
 @command("cluster.top")
 def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
     """Live one-screen cluster view: every node's /metrics sampled
     twice `-interval=N` seconds apart (default 2), the delta rendered
     as per-role req/s, windowed p99, in-flight requests, pooled-client
     connection reuse, breaker/QoS state, device telemetry where the
-    node has touched a TPU, the write-path stage decomposition when
+    node has touched a TPU, the write-path stage decomposition and
+    group-commit batching (mean batch size, barrier-wait p99) when
     writes landed in the window, and the top profiler stacks on any
     node whose sampler is armed.  The operator's answer to "what is
     this cluster doing RIGHT NOW"."""
@@ -1200,6 +1230,9 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
         stages = _stage_report(b or {}, a, ns)
         if stages:
             out.append("  " + stages)
+        gc = _group_commit_report(b or {}, a)
+        if gc:
+            out.append("  " + gc)
         try:
             prof = http_json("GET", f"{url}/debug/pprof?top=3",
                              timeout=3)

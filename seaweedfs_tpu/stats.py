@@ -17,6 +17,16 @@ from collections import defaultdict
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0)
 
+# group-commit observability (util/group_commit.py): batch sizes are
+# small integers (mean batch = sum/count is the headline number), and
+# barrier waits live in the 100us..100ms band between "rode a batch
+# for free" and "waited out an fsync" — DEFAULT_BUCKETS can't resolve
+# either
+GROUP_COMMIT_BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0,
+                              128.0)
+GROUP_COMMIT_WAIT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                             0.005, 0.01, 0.025, 0.05, 0.1, 0.25)
+
 
 def escape_label_value(v) -> str:
     """Prometheus text-format label escaping (exposition format §text

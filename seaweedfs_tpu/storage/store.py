@@ -47,10 +47,12 @@ class DiskLocation:
     """One storage directory (weed/storage/disk_location.go)."""
 
     def __init__(self, directory: str, max_volume_count: int = 8,
-                 index_directory: str | None = None):
+                 index_directory: str | None = None,
+                 fsync: bool = False):
         self.directory = os.path.abspath(directory)
         self.index_directory = index_directory or self.directory
         self.max_volume_count = max_volume_count
+        self.fsync = fsync
         self.volumes: dict[int, Volume] = {}
         self.ec_volumes: dict[int, EcVolume] = {}
         os.makedirs(self.directory, exist_ok=True)
@@ -63,7 +65,7 @@ class DiskLocation:
             vid = int(m.group("vid"))
             self.volumes[vid] = Volume(
                 self.directory, vid, collection=m.group("col") or "",
-                mmap_read_mb=MMAP_READ_MB)
+                mmap_read_mb=MMAP_READ_MB, fsync=self.fsync)
         # tiered volumes have no local .dat; their .vif names the
         # remote copy (volume_tier.go)
         for path in glob.glob(os.path.join(self.directory, "*.vif")):
@@ -97,11 +99,16 @@ class Store:
     """storage/store.go:88 NewStore."""
 
     def __init__(self, directories: list[str], ip: str = "localhost",
-                 port: int = 0, public_url: str = ""):
+                 port: int = 0, public_url: str = "",
+                 fsync: bool = False):
         self.ip = ip
         self.port = port
         self.public_url = public_url or f"{ip}:{port}"
-        self.locations = [DiskLocation(d) for d in directories]
+        # -fsync: every volume's group-commit barrier also fsyncs (the
+        # power-loss durability tier, one fsync per commit window)
+        self.fsync = fsync
+        self.locations = [DiskLocation(d, fsync=fsync)
+                          for d in directories]
         self.lock = threading.RLock()
         for loc in self.locations:
             loc.load_existing()
@@ -144,7 +151,7 @@ class Store:
                 loc.directory, vid, collection=collection,
                 replica_placement=ReplicaPlacement.from_string(replication),
                 ttl=read_ttl(ttl) if ttl else EMPTY_TTL,
-                mmap_read_mb=MMAP_READ_MB)
+                mmap_read_mb=MMAP_READ_MB, fsync=loc.fsync)
             loc.volumes[vid] = v
             return v
 
@@ -178,7 +185,8 @@ class Store:
                         _vif_is_remote(base + ".vif"):
                     v = Volume(loc.directory, vid,
                                collection=collection,
-                               mmap_read_mb=MMAP_READ_MB)
+                               mmap_read_mb=MMAP_READ_MB,
+                               fsync=loc.fsync)
                     loc.volumes[vid] = v
                     return v
             raise KeyError(f"volume {vid} files not found")

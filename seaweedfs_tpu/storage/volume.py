@@ -14,6 +14,7 @@ import os
 import threading
 import time
 
+from ..util.group_commit import CommitBarrier
 from . import types
 from .needle import Needle, get_actual_size, needle_body_length
 from .needle_map import NeedleMap
@@ -106,7 +107,7 @@ class Volume:
                  replica_placement: ReplicaPlacement | None = None,
                  ttl: TTL = EMPTY_TTL,
                  version: int = types.CURRENT_VERSION,
-                 mmap_read_mb: int = 0):
+                 mmap_read_mb: int = 0, fsync: bool = False):
         self.dir = directory
         self.id = volume_id
         self.collection = collection
@@ -114,6 +115,16 @@ class Volume:
         self.last_append_at_ns = 0
         self.read_only = False
         self.is_remote = False
+        # -fsync tier (the reference volume server's -fsync flag):
+        # every acked write survives POWER LOSS, not just SIGKILL —
+        # the group-commit barrier makes this affordable by sharing
+        # one fsync across every writer in the commit window
+        self.fsync = bool(fsync)
+        # dat+idx durability barrier, shared by concurrent writers
+        # (group commit): one flush — and one fsync on the -fsync
+        # tier — per commit window instead of per needle
+        self._barrier = CommitBarrier(self._group_commit_flush,
+                                      site="volume.needle")
         # memory-mapped read path (backend/memory_map role, the
         # `-memoryMapMaxSizeMb` flag): needle reads slice the page
         # cache directly instead of seek+read syscalls.  0 disables;
@@ -198,7 +209,7 @@ class Volume:
             pos = self._dat.tell()
             self._dat.seek(0)
             self._dat.write(self.super_block.to_bytes())
-            self._dat.flush()
+            self._dat.flush()  # noqa: SWFS012 — rare admin superblock rewrite, not a write ack
             self._dat.seek(pos)
             self.volume_info.replication = str(rp)
 
@@ -262,18 +273,50 @@ class Volume:
                 with profiling.stage("index"):
                     self.nm.put(n.id, types.to_stored_offset(offset),
                                 n.size)
-            # ack-after-kernel: push the buffered append (and its idx
-            # record) to the OS before the caller acks the client — a
-            # SIGKILLed process must not lose an acknowledged write
-            # (power loss is the -fsync tier, volume.sync(); the
-            # process-kill tier is this flush, needle_write.go acks
-            # after pwrite the same way)
-            with profiling.stage("flush"):
-                self._dat.flush()
-                self.nm.flush()
-            return offset, len(n.data), False
         finally:
             self.lock.release()
+        # ack-after-kernel, GROUP-COMMITTED: the buffered append (and
+        # its idx record) must reach the OS before the caller acks the
+        # client — a SIGKILLed process must not lose an acknowledged
+        # write (needle_write.go acks after pwrite the same way; power
+        # loss is the -fsync tier, folded into the same barrier).  The
+        # barrier is shared: concurrent writers append under the lock
+        # above, then one leader flushes once for the whole window —
+        # a single in-flight writer passes straight through.
+        with profiling.stage("flush"):
+            self._barrier.commit()
+        return offset, len(n.data), False
+
+    def _group_commit_flush(self) -> None:
+        """The barrier's designated flush helper (one leader at a
+        time).  Deliberately lock-free: BufferedRandom/BufferedWriter
+        serialize each call internally, so the leader drains the
+        buffer WHILE appenders keep appending under the volume lock —
+        holding the lock here would stall every writer for the flush
+        (and the whole fsync on the -fsync tier).  The one racer that
+        can invalidate the handles mid-flush is a compaction/merge
+        commit swap; its close() of the OLD handles flushes everything
+        buffered, so the process-crash tier is satisfied either way —
+        but the -fsync tier's platter promise is not, so on that tier
+        the flush re-runs against the NEW handles (commit_compact
+        fsyncs the shadows it installs, so the swap itself never
+        leaves acked bytes unfsynced).  Any ValueError with the
+        handles UNCHANGED is a real defect and must fail the batch,
+        not ack it."""
+        while True:
+            dat, nm = self._dat, self.nm
+            try:
+                dat.flush()
+                nm.flush()
+                if self.fsync and not self.is_remote:
+                    os.fsync(dat.fileno())
+                return
+            except ValueError:
+                if dat is self._dat and nm is self.nm:
+                    raise           # not the swap race: surface it
+                if not (self.fsync and not self.is_remote):
+                    return          # old handles were flushed by close()
+                # -fsync tier: go again on the swapped-in handles
 
     def _append(self, n: Needle) -> int:
         self._dat.seek(0, os.SEEK_END)
@@ -294,7 +337,7 @@ class Volume:
         needs this.  Near-free when nothing is pending."""
         with self.lock:
             try:
-                self._dat.flush()
+                self._dat.flush()  # noqa: SWFS012 — out-of-handle read visibility (native plane), not a write ack
             except AttributeError:  # tiered RemoteDatFile
                 pass
 
@@ -312,11 +355,10 @@ class Volume:
             tomb.append_at_ns = self._next_append_at_ns()
             self._append(tomb)
             self.nm.delete(n.id)
-            # same ack-after-kernel rule as write_needle: an acked
-            # delete must survive SIGKILL
-            self._dat.flush()
-            self.nm.flush()
-            return size
+        # same ack-after-kernel rule as write_needle, same shared
+        # barrier: an acked delete must survive SIGKILL
+        self._barrier.commit()
+        return size
 
     # -- read path (volume_read.go:21 readNeedle) ------------------------
 
@@ -444,8 +486,8 @@ class Volume:
             for stale in (cpd, cpx):
                 if os.path.exists(stale):
                     os.remove(stale)
-            self._dat.flush()
-            self.nm.flush()
+            self._dat.flush()  # noqa: SWFS012 — compaction snapshot point (offline maintenance)
+            self.nm.flush()  # noqa: SWFS012 — compaction snapshot point (offline maintenance)
             snapshot = sorted(self.nm.items(), key=lambda t: t[1])
             idx_snapshot = os.path.getsize(self.file_name(".idx"))
             dst_sb = SuperBlock(
@@ -517,6 +559,15 @@ class Volume:
         reload (volume_vacuum.go:141 CommitCompact)."""
         with self.lock:
             self._makeup_diff()
+            if self.fsync:
+                # -fsync tier: acked writes are platter-durable in the
+                # OLD .dat; the shadows must reach the platter before
+                # they REPLACE it or a power cut after the rename
+                # could lose them
+                for shadow in (self.file_name(".cpd"),
+                               self.file_name(".cpx")):
+                    with open(shadow, "rb") as f:
+                        os.fsync(f.fileno())  # noqa: SWFS012 — compaction commit point
             # AFTER the diff replay (whose _read_at may legitimately
             # use — and recreate — a map of the OLD .dat) and BEFORE
             # the renames: a map surviving the swap would serve
@@ -560,7 +611,7 @@ class Volume:
             if not self.read_only:
                 raise PermissionError(
                     f"volume {self.id} must be readonly to merge")
-            self._dat.flush()
+            self._dat.flush()  # noqa: SWFS012 — readonly-merge snapshot point (offline maintenance)
         records: list = []   # (append_at_ns, seq, needle)
         seq = 0
         for path in [self.file_name(".dat")] + list(peer_dat_paths):
@@ -645,10 +696,10 @@ class Volume:
 
     def sync(self) -> None:
         with self.lock:
-            self._dat.flush()
+            self._dat.flush()  # noqa: SWFS012 — explicit full-volume barrier (copy/admin paths)
             if not self.is_remote:
-                os.fsync(self._dat.fileno())
-            self.nm.flush()
+                os.fsync(self._dat.fileno())  # noqa: SWFS012 — explicit full-volume barrier
+            self.nm.flush()  # noqa: SWFS012 — explicit full-volume barrier
 
     def save_volume_info(self) -> None:
         self.volume_info.version = self.version

@@ -77,6 +77,8 @@ class Topology:
         self.volume_size_limit = volume_size_limit
         self.pulse_seconds = pulse_seconds
         self._max_volume_id = 0
+        import itertools
+        self._pick_rr = itertools.count()
 
     # -- heartbeat registration (topology.go RegisterVolumeLayout etc) ----
 
@@ -211,7 +213,13 @@ class Topology:
         candidates = self.writable_volumes(collection, replication, ttl_u32)
         if not candidates:
             raise LookupError("no writable volumes")
-        return random.choice(candidates)
+        # round-robin, not random.choice: with clients batching fids
+        # (assign?count=N windows) each assign pins a volume for many
+        # writes, and random selection leaves streaks where several
+        # gateways hammer one volume while its siblings idle — strict
+        # rotation keeps the per-volume write load even
+        candidates.sort(key=lambda c: c[0])
+        return candidates[next(self._pick_rr) % len(candidates)]
 
     # -- growth (volume_growth.go) ----------------------------------------
 

@@ -43,6 +43,7 @@ API shapes the SWFS007 lint understands:
 from __future__ import annotations
 
 import contextvars
+import itertools
 import os
 import random
 import secrets
@@ -98,8 +99,17 @@ _current: contextvars.ContextVar["tuple[str, str, str] | None"] = \
     contextvars.ContextVar("weed_trace_span", default=None)
 
 
+# span ids need uniqueness (per process, and across the nodes a
+# trace.show merge sees), not unpredictability; secrets.token_hex per
+# span was a measurable slice of the filer's write-path CPU profile
+# (several spans are minted per request).  6 random hex chars pin the
+# process, a C-level counter distinguishes spans.
+_SPAN_PREFIX = secrets.token_hex(3)
+_span_counter = itertools.count(1)
+
+
 def new_span_id() -> str:
-    return secrets.token_hex(4)
+    return f"{_SPAN_PREFIX}{next(_span_counter) & 0xFFFFFF:06x}"
 
 
 class Span:

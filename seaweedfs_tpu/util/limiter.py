@@ -40,13 +40,60 @@ class BoundedExecutor:
         self._pool.shutdown(wait=wait)
 
 
-def bounded_parallel(fn, items, limit: int = 8) -> list:
+# process-wide persistent worker pool for short-lived fan-outs (the
+# filer's chunk-upload funnel).  A fresh ThreadPoolExecutor per call
+# spawns threads that die with the call — and with them every
+# thread-local keep-alive socket the pooled HTTP client holds, so a
+# multi-chunk upload re-paid the TCP setup tax on every chunk of every
+# request.  Long-lived workers keep their connection pools warm
+# end-to-end (httpd._thread_pools is per-thread by design).
+_SHARED_WORKERS = 16
+_shared_pool: "ThreadPoolExecutor | None" = None
+_shared_lock = threading.Lock()
+
+
+def shared_pool() -> ThreadPoolExecutor:
+    global _shared_pool
+    with _shared_lock:
+        if _shared_pool is None:
+            _shared_pool = ThreadPoolExecutor(
+                max_workers=_SHARED_WORKERS,
+                thread_name_prefix="weed-funnel")
+        return _shared_pool
+
+
+def bounded_parallel(fn, items, limit: int = 8,
+                     persistent: bool = False) -> list:
     """Map fn over items with at most `limit` concurrent calls;
     results in input order.  Sequential fast path for 0/1 items (no
-    thread overhead on the common single-chunk write)."""
+    thread overhead on the common single-chunk write).
+
+    persistent=True runs on the process-wide shared_pool() — workers
+    (and their per-thread keep-alive connection pools) outlive the
+    call — with a semaphore providing this call's `limit` so one
+    caller cannot monopolize the shared workers."""
     items = list(items)
     if len(items) <= 1:
         return [fn(x) for x in items]
+    if persistent:
+        # bound SUBMISSION, not execution: acquiring inside the worker
+        # would park pool threads on the semaphore and let one large
+        # fan-out occupy the whole shared pool while doing `limit`
+        # items of work — blocked capacity must wait in the caller
+        slots = threading.Semaphore(max(1, limit))
+        pool = shared_pool()
+        futures = []
+
+        def run(x):
+            try:
+                return fn(x)
+            finally:
+                slots.release()
+
+        for x in items:
+            slots.acquire()
+            futures.append(pool.submit(run, x))
+        return [f.result() for f in futures]
     with ThreadPoolExecutor(max_workers=min(limit,
                                             len(items))) as pool:
         return list(pool.map(fn, items))

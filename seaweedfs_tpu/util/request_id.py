@@ -14,16 +14,25 @@ one thread, which is the path that matters.
 from __future__ import annotations
 
 import contextvars
-import secrets
+import itertools
+import os
 
 HEADER = "X-Request-ID"
 
 _request_id: contextvars.ContextVar[str] = contextvars.ContextVar(
     "weed_request_id", default="")
 
+# fast minting: ids need process-lifetime uniqueness and log
+# greppability, not unpredictability — secrets.token_hex per request
+# was a measurable slice of the write-path profile.  12 random hex
+# chars pin the process, a C-level counter (atomic under the GIL)
+# distinguishes requests.
+_RID_PREFIX = os.urandom(6).hex()
+_rid_counter = itertools.count(int.from_bytes(os.urandom(2), "big"))
+
 
 def new_request_id() -> str:
-    return secrets.token_hex(8)
+    return f"{_RID_PREFIX}{next(_rid_counter) & 0xFFFFFFFF:04x}"
 
 
 def get_request_id() -> str:

@@ -373,6 +373,15 @@ def _note_retry(site: str, peer: str, attempt: int, error: str,
         error=False)
 
 
+def _deterministic(e: BaseException) -> bool:
+    """A failure whose outcome cannot change on re-issue: a TLS
+    certificate-verification verdict (the peer presented the wrong
+    identity — configuration, not weather).  Retrying burns budget and
+    backoff time to learn the same thing; the caller needs the error."""
+    import ssl
+    return isinstance(e, ssl.SSLCertVerificationError)
+
+
 def retry_call(fn, site: str = "", peer: str = "",
                idempotent: bool = True, attempts: "int | None" = None,
                base: "float | None" = None, cap: "float | None" = None,
@@ -393,6 +402,12 @@ def retry_call(fn, site: str = "", peer: str = "",
         except BreakerOpen:
             raise
         except retry_on as e:
+            if _deterministic(e):
+                # a failed TLS handshake is a configuration verdict:
+                # no retry token spent, no backoff slept — but the
+                # probe slot is returned so the breaker can't wedge
+                probe_release(peer)
+                raise
             record_failure(peer, repr(e))
             last = e
             if not idempotent or attempt >= attempts or \

@@ -58,7 +58,12 @@ class MasterFollower:
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=2)
+            # the thread is almost always parked inside the 25s long
+            # poll; joining it out would stall EVERY filer/gateway
+            # shutdown (and every test teardown) for the join timeout.
+            # It is a daemon checking _stop at each loop turn and in
+            # its backoff wait — let it drain on its own.
+            self._thread.join(timeout=0.2)
 
     def wait_synced(self, timeout: float = 5.0) -> bool:
         return self._synced.wait(timeout)

@@ -722,3 +722,92 @@ def test_lockgraph_report_flush(tmp_path, graph):
     doc = json.loads((tmp_path / "report.json").read_text())
     assert doc["violations"] == []
     assert ["lock-A", "lock-B"] in doc["edges"]
+
+
+# -- SWFS012: blocking flush/fsync under a lock ---------------------------
+
+def test_swfs012_flags_flush_under_with_lock():
+    src = """
+    class V:
+        def write(self, data):
+            with self.lock:
+                self._dat.write(data)
+                self._dat.flush()
+    """
+    found = check(src, "SWFS012")
+    assert len(found) == 1
+    assert "group-commit" in found[0].message
+
+
+def test_swfs012_flags_fsync_in_acquire_region():
+    src = """
+    import os
+    class V:
+        def write(self, data):
+            self.lock.acquire()
+            try:
+                self._dat.write(data)
+                os.fsync(self._dat.fileno())
+            finally:
+                self.lock.release()
+    """
+    assert len(check(src, "SWFS012")) == 1
+
+
+def test_swfs012_exempts_group_commit_helper_and_teardown():
+    src = """
+    import os
+    class V:
+        def _group_commit_flush(self):
+            with self.lock:
+                self._dat.flush()
+                os.fsync(self._dat.fileno())
+
+        def close(self):
+            with self.lock:
+                self._dat.flush()
+                self._dat.close()
+    """
+    assert check(src, "SWFS012") == []
+
+
+def test_swfs012_silent_outside_lock_and_on_args():
+    src = """
+    class V:
+        def write(self, data):
+            with self.lock:
+                self._dat.write(data)
+            self._dat.flush()          # outside: the barrier shape
+
+        def drain(self, sock):
+            with self.lock:
+                sock.flush(1024)       # an argful flush is not the
+                                       # zero-arg durability barrier
+    """
+    assert check(src, "SWFS012") == []
+
+
+def test_swfs012_noqa_suppresses():
+    src = """
+    class V:
+        def seal(self):
+            with self._lock:
+                self._f.flush()  # noqa: SWFS012 — once-per-seal
+    """
+    assert check(src, "SWFS012") == []
+
+
+def test_swfs012_repo_is_clean():
+    import os
+
+    import seaweedfs_tpu
+    root = os.path.dirname(seaweedfs_tpu.__file__)
+    findings, errors = run_paths([root])
+    assert not errors
+    from seaweedfs_tpu.devtools.analyze import (default_baseline_path,
+                                                load_baseline,
+                                                partition_baseline)
+    new, _old = partition_baseline(
+        [f for f in findings if f.rule == "SWFS012"],
+        load_baseline(default_baseline_path()))
+    assert new == [], [f.render() for f in new]

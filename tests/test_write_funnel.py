@@ -1,0 +1,352 @@
+"""Write-funnel efficiency satellites (ISSUE 9): the pre-parsed
+route table and lazy query parse in httpd, the persistent chunk-upload
+pool, assign-window batching, and the TLS handshake fixes (failures
+counted, never dispatched, and never worth a pooled-client retry)."""
+
+import socket
+import ssl
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.server.httpd import HttpServer, http_bytes
+
+
+@pytest.fixture()
+def server():
+    srv = HttpServer()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+# -- pre-parsed route table ----------------------------------------------
+
+def test_prefix_route_table_precedence(server):
+    server.route("GET", "/exact", lambda req: (200, {"hit": "exact"}))
+    server.route_prefix("GET", "/pre/",
+                        lambda req: (200, {"hit": "prefix"}))
+    server.route_prefix("GET", "/pre/deeper/",
+                        lambda req: (200, {"hit": "deeper"}))
+    server.fallback = lambda req: (200, {"hit": "fallback"})
+
+    def get(path):
+        import json
+        st, body, _ = http_bytes("GET", f"{server.url}{path}",
+                                 timeout=5)
+        assert st == 200
+        return json.loads(body)["hit"]
+
+    assert get("/exact") == "exact"
+    assert get("/pre/x") == "prefix"
+    # longest prefix wins
+    assert get("/pre/deeper/x") == "deeper"
+    assert get("/elsewhere") == "fallback"
+
+
+def test_exact_route_beats_prefix(server):
+    server.route("GET", "/pre/exact", lambda req: (200, {"hit": "e"}))
+    server.route_prefix("GET", "/pre/", lambda req: (200, {"hit": "p"}))
+    import json
+    st, body, _ = http_bytes("GET", f"{server.url}/pre/exact",
+                             timeout=5)
+    assert json.loads(body)["hit"] == "e"
+
+
+def test_lazy_query_parses_and_preserves_blank_markers(server):
+    seen = {}
+
+    def h(req):
+        seen["q"] = dict(req.query)
+        return 200, {}
+
+    server.route("GET", "/q", h)
+    http_bytes("GET", f"{server.url}/q?a=1&uploads=", timeout=5)
+    assert seen["q"] == {"a": "1", "uploads": ""}
+    # no query string: empty dict, no parse
+    http_bytes("GET", f"{server.url}/q", timeout=5)
+    assert seen["q"] == {}
+
+
+# -- TLS handshake satellite ----------------------------------------------
+
+def _mint_self_signed(tmp_path):
+    """Self-signed node cert (its own CA) via the openssl CLI —
+    the cryptography package is not guaranteed in this image."""
+    import shutil
+    import subprocess
+    if shutil.which("openssl") is None:
+        pytest.skip("no openssl CLI to mint a test cert")
+    key = str(tmp_path / "node.key")
+    crt = str(tmp_path / "node.crt")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "ec", "-pkeyopt",
+         "ec_paramgen_curve:prime256v1", "-keyout", key, "-out", crt,
+         "-days", "1", "-nodes", "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True, timeout=60)
+    return crt, key
+
+
+@pytest.fixture()
+def tls_server(tmp_path):
+    from seaweedfs_tpu.tls import TlsConfig
+    crt, key = _mint_self_signed(tmp_path)
+    cfg = TlsConfig(crt, crt, key)
+    srv = HttpServer()
+    from seaweedfs_tpu.stats import Metrics
+    srv.metrics = Metrics("tlsprobe")
+    srv.role = "tlsprobe"
+    srv.route("GET", "/ping", lambda req: (200, {"ok": True}))
+    srv._httpd.ssl_context = cfg.server_context()
+    srv.start()
+    yield srv, cfg
+    srv.stop()
+
+
+def _handshake_failures() -> float:
+    from seaweedfs_tpu import stats
+    total = 0.0
+    with stats.PROCESS._lock:
+        for (name, _labels), v in stats.PROCESS._counters.items():
+            if name == "tls_handshake_failures_total":
+                total += v
+    return total
+
+
+def test_failed_handshake_counted_and_never_dispatched(tls_server):
+    srv, cfg = tls_server
+    before = _handshake_failures()
+    # a client that speaks plaintext at a TLS listener: handshake
+    # fails server-side
+    with socket.create_connection(("127.0.0.1", srv.port),
+                                  timeout=5) as s:
+        s.sendall(b"GET /ping HTTP/1.1\r\nHost: x\r\n\r\n")
+        try:
+            s.settimeout(5)
+            s.recv(64)
+        except OSError:
+            pass
+    deadline = time.time() + 5
+    while time.time() < deadline and _handshake_failures() <= before:
+        time.sleep(0.05)
+    assert _handshake_failures() > before
+    # the un-handshaken connection never reached dispatch: the
+    # in-flight gauge was never touched (no cell exists), and the
+    # server still serves real TLS clients
+    with srv.metrics._lock:
+        gauges = {n for (n, _l) in srv.metrics._gauges}
+    assert "requests_in_flight" not in gauges
+    ctx = cfg.client_context()
+    with socket.create_connection(("127.0.0.1", srv.port),
+                                  timeout=5) as raw:
+        with ctx.wrap_socket(raw, server_hostname="127.0.0.1") as tls:
+            tls.sendall(b"GET /ping HTTP/1.1\r\nHost: x\r\n"
+                        b"Connection: close\r\n\r\n")
+            data = tls.recv(4096)
+    assert b"200" in data.split(b"\r\n", 1)[0]
+
+
+def test_cert_verification_failure_spends_no_retry():
+    """A deterministic TLS verdict must not consume the process retry
+    budget or be re-attempted — the answer cannot change."""
+    from seaweedfs_tpu.util import retry as uretry
+    uretry.reset()
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ssl.SSLCertVerificationError("bad cert")
+
+    budget_before = uretry.budget_remaining()
+    with pytest.raises(ssl.SSLCertVerificationError):
+        uretry.retry_call(fn, site="t", peer="p:1", idempotent=True)
+    assert len(calls) == 1           # no re-attempt
+    assert uretry.budget_remaining() == budget_before
+    uretry.reset()
+
+
+def test_transient_oserror_still_retries():
+    from seaweedfs_tpu.util import retry as uretry
+    uretry.reset()
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 2:
+            raise ConnectionResetError("flaky")
+        return "ok"
+
+    assert uretry.retry_call(fn, site="t", peer="p:2",
+                             idempotent=True, base=0.001,
+                             cap=0.002) == "ok"
+    assert len(calls) == 2
+    uretry.reset()
+
+
+# -- persistent upload pool -----------------------------------------------
+
+def test_bounded_parallel_persistent_reuses_worker_threads():
+    from seaweedfs_tpu.util.limiter import (_SHARED_WORKERS,
+                                            bounded_parallel)
+    # more items than the shared pool has workers, and slow enough
+    # that the first round forces the pool to its full size: the
+    # second round can then only complete on REUSED threads (the
+    # point — their thread-local keep-alive sockets survive across
+    # calls).  Instant tasks would let each round finish on a lucky
+    # few threads and make the overlap probabilistic.
+    n = _SHARED_WORKERS + 4
+
+    def ident(_i):
+        time.sleep(0.005)
+        return threading.get_ident()
+
+    seen_a = bounded_parallel(ident, range(n), limit=n,
+                              persistent=True)
+    seen_b = bounded_parallel(ident, range(n), limit=n,
+                              persistent=True)
+    assert set(seen_a) & set(seen_b)
+
+
+def test_bounded_parallel_single_item_stays_inline():
+    from seaweedfs_tpu.util.limiter import bounded_parallel
+    me = threading.get_ident()
+    assert bounded_parallel(lambda _i: threading.get_ident(), [0],
+                            limit=4, persistent=True) == [me]
+
+
+# -- assign batching ------------------------------------------------------
+
+def test_assign_cache_derives_reference_format_fids():
+    from seaweedfs_tpu.operation import Assignment, _AssignCache
+    from seaweedfs_tpu.storage import types
+    cache = _AssignCache()
+    base = types.FileId(3, 0x101, 0xDEADBEEF)
+    a = Assignment(str(base), "v:1", "v:1", 4, auth="tok")
+    spec = ("m", "", "", "")
+    cache.put(spec, a)
+    fids = [cache.take(spec) for _ in range(4)]
+    # window exhausted: 3 derived follow the base (consumed by the
+    # refresher), then None
+    assert [f.fid if f else None for f in fids] == [
+        str(types.FileId(3, 0x102, 0xDEADBEEF)),
+        str(types.FileId(3, 0x103, 0xDEADBEEF)),
+        str(types.FileId(3, 0x104, 0xDEADBEEF)),
+        None,
+    ]
+    # derived fids carry no master-minted jwt and parse cleanly
+    parsed = types.parse_file_id(
+        str(types.FileId(3, 0x102, 0xDEADBEEF)))
+    assert (parsed.key, parsed.cookie) == (0x102, 0xDEADBEEF)
+
+
+def test_assign_cache_expires_and_invalidates():
+    from seaweedfs_tpu.operation import Assignment, _AssignCache
+    cache = _AssignCache()
+    spec = ("m", "", "", "")
+    cache.put(spec, Assignment("3,101deadbeef", "v:1", "v:1", 16))
+    cache.invalidate(spec)
+    assert cache.take(spec) is None
+    cache.put(spec, Assignment("3,101deadbeef", "v:1", "v:1", 16))
+    cache._m[spec][2] = 0.0          # force expiry
+    assert cache.take(spec) is None
+
+
+def test_sequencers_declare_range_semantics():
+    from seaweedfs_tpu.sequence import (MemorySequencer,
+                                        SnowflakeSequencer)
+    assert MemorySequencer.reserves_ranges is True
+    assert SnowflakeSequencer.reserves_ranges is False
+    s = MemorySequencer(start=10)
+    assert s.next_file_id(16) == 10
+    assert s.next_file_id(1) == 26   # the range really was reserved
+
+
+def test_upload_declares_idempotency(monkeypatch):
+    from seaweedfs_tpu import operation
+    captured = {}
+
+    def fake_http_bytes(method, url, body, headers, timeout):
+        captured.update(headers)
+        return 200, b"{}", {}
+
+    monkeypatch.setattr(operation, "http_bytes", fake_http_bytes)
+    operation.upload("v:1", "3,101deadbeef", b"x")
+    assert captured.get("X-Idempotent") == "1"
+
+
+# -- cluster.top group-commit rendering -----------------------------------
+
+def test_cluster_top_group_commit_report():
+    from seaweedfs_tpu.shell.commands import _group_commit_report
+    batch = "seaweedfs_tpu_group_commit_batch_size"
+    wait = "seaweedfs_tpu_group_commit_wait_seconds"
+
+    def hist(name, site, buckets_counts, total, s):
+        out = {}
+        cum = 0
+        for le, n in buckets_counts:
+            cum += n
+            out.setdefault(f"{name}_bucket", []).append(
+                ({"site": site, "le": str(le)}, cum))
+        out.setdefault(f"{name}_bucket", []).append(
+            ({"site": site, "le": "+Inf"}, total))
+        out[f"{name}_sum"] = [({"site": site}, s)]
+        out[f"{name}_count"] = [({"site": site}, total)]
+        return out
+
+    after = {}
+    for part in (hist(batch, "volume.needle",
+                      [(1.0, 2), (2.0, 1), (4.0, 2)], 5, 16.0),
+                 hist(wait, "volume.needle",
+                      [(0.001, 3), (0.0025, 2)], 5, 0.006)):
+        for k, v in part.items():
+            after.setdefault(k, []).extend(v)
+    report = _group_commit_report({}, after)
+    assert "volume.needle" in report
+    assert "batch=3.2" in report
+    assert "wait-p99=" in report
+    assert _group_commit_report({}, {}) == ""
+
+
+def test_absolute_form_request_target_routes(server):
+    """RFC 9112 §3.2.2: a proxy's absolute-form target must route like
+    its origin-form equivalent."""
+    import http.client
+    server.route("GET", "/abs", lambda req: (200, {"q": req.query}))
+    conn = http.client.HTTPConnection(server.host, server.port,
+                                      timeout=5)
+    conn.putrequest("GET", f"http://{server.url}/abs?a=1",
+                    skip_host=True, skip_accept_encoding=True)
+    conn.putheader("Host", server.url)
+    conn.endheaders()
+    r = conn.getresponse()
+    import json
+    assert r.status == 200
+    assert json.loads(r.read())["q"] == {"a": "1"}
+    conn.close()
+
+
+def test_persistent_pool_large_fanout_does_not_park_workers():
+    """The per-call limit bounds SUBMISSION: a fan-out larger than the
+    shared pool must never hold more than `limit` workers at once."""
+    from seaweedfs_tpu.util.limiter import (_SHARED_WORKERS,
+                                            bounded_parallel)
+    active = [0]
+    peak = [0]
+    lock = threading.Lock()
+
+    def work(_i):
+        with lock:
+            active[0] += 1
+            peak[0] = max(peak[0], active[0])
+        time.sleep(0.005)
+        with lock:
+            active[0] -= 1
+        return True
+
+    out = bounded_parallel(work, range(_SHARED_WORKERS * 2), limit=3,
+                           persistent=True)
+    assert all(out)
+    assert peak[0] <= 3
