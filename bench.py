@@ -181,11 +181,12 @@ def _codec_reconstruct_rate(d: int, p: int, lost: "list[int]") -> float:
 def _apply_ceiling(out: dict, key: str, measured: float,
                    ceilings: dict) -> None:
     """Record <key>_bound_by / _ceiling_gbps / _of_ceiling from the
-    binding (minimum) resource.  If the measurement still exceeds the
-    probed ceiling, the probe under-measured the resource (disk probes
-    race writeback state) — raise the estimate to the observed value
-    and SAY SO, so of_ceiling <= 1.0 by construction and the
-    adjustment is visible rather than silent."""
+    binding (minimum) resource.  The ceiling is a PREDICTION — every
+    probe runs BEFORE the measurement it bounds — and is never raised
+    to the observed number: a ceiling that chases the measurement is
+    vacuous (VERDICT r5's "of_ceiling = 1.0").  of_ceiling > 1.0 is
+    reported as-is with a note saying the probe under-measured the
+    binding resource (disk probes race writeback state)."""
     ceilings = {k: v for k, v in ceilings.items() if v}
     if not ceilings or not measured:
         return
@@ -193,15 +194,77 @@ def _apply_ceiling(out: dict, key: str, measured: float,
     ceiling = ceilings[bound_by]
     if measured > ceiling:
         out[f"{key}_ceiling_note"] = (
-            f"probe said {round(ceiling, 3)}; raised to observed "
-            f"(probe under-measured the binding resource)")
-        ceiling = measured
+            f"measured {round(measured, 3)} exceeds the predicted "
+            f"ceiling {round(ceiling, 3)} — the pre-run probe "
+            f"under-measured the binding resource")
     out[f"{key}_bound_by"] = bound_by
     out[f"{key}_ceiling_gbps"] = round(ceiling, 3)
     out[f"{key}_of_ceiling"] = round(measured / ceiling, 2)
 
 
-def _measure_e2e(on_tpu: bool, probe: "dict | None"):
+def _calibrate_device(budget_s: float = 20.0) -> dict:
+    """Small pre-run device probe, run FIRST: h2d bandwidth, per-chip
+    GF kernel rate, device count.  Its numbers do two jobs no
+    after-the-fact probe can: (1) the predicted roofline
+    `min(h2d GB/s, kernel GB/s/chip x devices)` that of_ceiling is
+    judged against — computed BEFORE the run so it can never be raised
+    to the observed number, and (2) the scale factor that sizes every
+    timed phase to fit the arm's budget (the BENCH_r05 lesson: a
+    fixed-size TPU arm behind a 0.03 GB/s tunnel ran out its whole
+    timeout and yielded nothing)."""
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ops import rs_matrix
+    from seaweedfs_tpu.ops.rs_jax import gf_apply_matrix_words
+
+    t_start = time.perf_counter()
+    ndev = len(jax.devices())
+    rng = np.random.default_rng(5)
+    # h2d: grow 1MB -> 64MB, stopping as soon as one transfer costs
+    # >= 1s or half the probe budget is gone — a slow tunnel is
+    # detected cheaply, a fast link gets a big-enough probe to trust
+    size = 1 << 20
+    h2d = 0.0
+    while True:
+        host = rng.integers(0, 2**32, size // 4, dtype=np.uint32)
+        t0 = time.perf_counter()
+        dev = jax.device_put(host)
+        int(dev[0])  # scalar fetch: the only honest fence over the
+        # tunneled transport (block_until_ready lies there)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        h2d = host.nbytes / dt / 1e9
+        if dt >= 1.0 or size >= (64 << 20) or \
+                time.perf_counter() - t_start > budget_s / 2:
+            break
+        size *= 4
+    # kernel rate on the default device at a modest batch
+    kb = min(8 << 20, max(1 << 20, size))
+    words = kb // 4
+    mat = jnp.asarray(rs_matrix.parity_matrix(DATA_SHARDS,
+                                              PARITY_SHARDS))
+    d32 = jax.device_put(rng.integers(
+        0, 2**32, size=(DATA_SHARDS, words), dtype=np.uint32))
+    int(gf_apply_matrix_words(mat, d32)[0, 0])  # compile + warm
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        int(gf_apply_matrix_words(mat, d32)[0, 0])
+        best = min(best, time.perf_counter() - t0)
+    kernel = DATA_SHARDS * kb / best / 1e9
+    return {
+        "devices": ndev,
+        "h2d_gbps": round(h2d, 3),
+        "h2d_probe_bytes": size,
+        "kernel_gbps_per_chip": round(kernel, 3),
+        "predicted_roofline_gbps": round(min(h2d, kernel * ndev), 3),
+        "probe_seconds": round(time.perf_counter() - t_start, 3),
+    }
+
+
+def _measure_e2e(on_tpu: bool, probe: "dict | None",
+                 budget_s: float = float("inf"),
+                 calib: "dict | None" = None):
     """End-to-end `ec.encode` + `ec.rebuild` + RS(6,3) `ec.decode`
     wall-clock through the staged disk<->codec pipelines
     (ec_encoder._staged_run), preserving the reference's 1GB/1MB row
@@ -222,6 +285,22 @@ def _measure_e2e(on_tpu: bool, probe: "dict | None"):
     from seaweedfs_tpu.storage.erasure_coding.ec_context import ECContext
 
     size = (1 << 30) if on_tpu else (128 << 20)
+    if on_tpu and budget_s < float("inf"):
+        # size the volume from the calibrated rate of the engine this
+        # pipeline will ACTUALLY run, so ~6 timed/fsync passes over it
+        # stay inside half the remaining budget (the pre-run scaling
+        # the BENCH_r05 timeout demanded)
+        rate = None
+        if probe and probe.get("choice") == "jax" and calib:
+            rate = calib.get("predicted_roofline_gbps")
+        elif probe:
+            rate = probe.get("cpu_gbps")
+        if rate:
+            per_pass = max(min(budget_s, 600.0) / 2 / 6, 5.0)
+            size = int(min(size, rate * 1e9 * per_pass))
+            # keep a whole number of 64MB write chunks (the .dat
+            # writer below repeats a 64MB blob size//chunk times)
+            size = max(128 << 20, (size >> 26) << 26)
     tmp = tempfile.mkdtemp(prefix="bench_ec_")
     out = {}
     try:
@@ -249,11 +328,18 @@ def _measure_e2e(on_tpu: bool, probe: "dict | None"):
         out["e2e_dat_bytes"] = size
         ceilings = {"shard-file disk writes (1.4x write amplification)":
                     disk_gbps / 1.4}
-        if probe:
-            if ctx.backend == "jax":
+        if ctx.backend == "jax":
+            if calib:
+                ceilings["host->device staging (windowed)"] = \
+                    calib.get("h2d_gbps")
+                ceilings[f"GF kernel x {calib.get('devices')} "
+                         f"devices"] = \
+                    calib.get("kernel_gbps_per_chip", 0) * \
+                    calib.get("devices", 1)
+            elif probe:
                 ceilings["host->device transfer"] = probe.get("h2d_gbps")
-            else:
-                ceilings["GF codec engine"] = probe.get("cpu_gbps")
+        elif probe:
+            ceilings["GF codec engine"] = probe.get("cpu_gbps")
         _apply_ceiling(out, "e2e", out["e2e_encode_gbps"], ceilings)
 
         # read probe over the just-written shards (rebuild's input
@@ -638,7 +724,8 @@ def _measure_dist_rebuild(nodes: int = 3, blob_mb: int = 1,
 
 
 def _measure_dist_encode(nodes: int = 3, blob_mb: int = 1,
-                         n_blobs: int = 96) -> dict:
+                         n_blobs: int = 96,
+                         budget_s: "float | None" = None) -> dict:
     """Distributed encode A/B over a loopback PROC-cluster: the seed's
     encode-locally-then-balance (`ec.encode -mode=local`: all 14 shard
     files written on the source node, mounted, then balance-moved off
@@ -784,9 +871,19 @@ def _measure_dist_encode(nodes: int = 3, blob_mb: int = 1,
         # per-server costs on first contact (imports, first
         # receive/copy on every destination) that belong to neither
         # timed round
+        t_rounds0 = _time.monotonic()
         for mode in ("warmup-scatter", "warmup-seed",
                      "scatter", "seed", "scatter", "seed",
                      "scatter", "seed", "scatter", "seed"):
+            if budget_s is not None and rounds["scatter"] and \
+                    len(rounds["scatter"]) == len(rounds["seed"]):
+                # the warmups + finished pairs ARE the calibration:
+                # stop adding rounds once the next pair would not fit
+                # the budget (median of fewer rounds over a dead arm)
+                done = _time.monotonic() - t_rounds0
+                per_pair = done / (1 + len(rounds["scatter"]))
+                if done + per_pair > budget_s:
+                    break
             warm = mode.startswith("warmup")
             m = mode.split("-")[-1] if warm else mode
             t0 = time.perf_counter()
@@ -1248,6 +1345,10 @@ def _measure_e2e_tpu_forced(size: int = 128 << 20):
                 f.write(blob)
             f.flush()
             os.fsync(f.fileno())
+        # account the bytes actually on disk: a requested size that is
+        # not a blob multiple writes fewer — reporting size/dt would
+        # overstate the headline number
+        size = os.path.getsize(base + ".dat")
         ctx = ECContext(backend="jax")
         ec_encoder.write_ec_files(base, ctx)  # warm compile cache
         for i in range(ctx.total):
@@ -1298,7 +1399,10 @@ def _emit(gbps, backend, shard_bytes, note=None, e2e=None, h2d=None,
 def measure(platform: str) -> None:
     """Child-process mode: run the device measurement and print the JSON.
     Every phase boundary flushes an incremental record (_Partial) so a
-    timeout mid-pipeline still leaves the finished phases on disk."""
+    timeout mid-pipeline still leaves the finished phases on disk, and
+    every sized phase is scaled from the pre-run calibration probe +
+    the remaining BENCH_BUDGET_S so the arm FINISHES inside its
+    timeout instead of dying mid-pipeline (BENCH_r05's TPU arm)."""
     partial = _Partial()
     if platform == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -1313,9 +1417,50 @@ def measure(platform: str) -> None:
     from seaweedfs_tpu.ops import rs_matrix
     from seaweedfs_tpu.ops import rs_pallas
 
+    try:
+        budget_s = float(os.environ.get("BENCH_BUDGET_S", "0") or 0)
+    except ValueError:
+        budget_s = 0.0
+    t_begin = time.monotonic()
+
+    def remaining() -> float:
+        if budget_s <= 0:
+            return float("inf")
+        return budget_s - (time.monotonic() - t_begin)
+
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
     shard_bytes = SHARD_BYTES if on_tpu else 1024 * 1024
+    chain = CHAIN
+
+    # calibration FIRST: tiny h2d + kernel probe whose rates (a) size
+    # every phase below to fit the budget and (b) fix the predicted
+    # roofline of_ceiling is judged against
+    try:
+        calib = _calibrate_device()
+    except Exception as exc:
+        print(f"bench: device calibration failed: {exc!r}",
+              file=sys.stderr)
+        calib = None
+    partial.phase("calibrate", **(calib or {}))
+
+    if on_tpu and calib:
+        # size the chained-kernel microbench: ITERS timed launches of
+        # `chain` kernel steps plus the one-time h2d of the batch must
+        # fit its slice of the budget even at the calibrated rates
+        cap = min(90.0, max(20.0, remaining() * 0.15))
+
+        def est(sb: int, ch: int) -> float:
+            kern = (ITERS + 1) * ch * DATA_SHARDS * sb / \
+                max(calib["kernel_gbps_per_chip"], 1e-3) / 1e9
+            h2d_cost = 2 * DATA_SHARDS * sb / \
+                max(calib["h2d_gbps"], 1e-3) / 1e9
+            return kern + h2d_cost
+
+        while shard_bytes > (4 << 20) and est(shard_bytes, chain) > cap:
+            shard_bytes //= 2
+        while chain > 4 and est(shard_bytes, chain) > cap:
+            chain //= 2
 
     words = shard_bytes // 4
     rng = np.random.default_rng(0)
@@ -1332,21 +1477,23 @@ def measure(platform: str) -> None:
     # block_until_ready does not truly synchronize, so a device->host
     # scalar fetch is the only honest fence, and chaining amortizes the
     # tunnel round-trip out of the per-step time.
+    chain_steps = chain
+
     @jax.jit
-    def chain(tables, d):
+    def chain_fn(tables, d):
         def body(_, d):
             out = rs_pallas.gf_apply_matrix_pallas_words(
                 tables, d, interpret=interpret)
             return d.at[:PARITY_SHARDS].set(d[:PARITY_SHARDS] ^ out)
-        d = jax.lax.fori_loop(0, CHAIN, body, d)
+        d = jax.lax.fori_loop(0, chain_steps, body, d)
         return jnp.sum(d[0, :: max(words // 1024, 1)], dtype=jnp.uint32)
 
-    int(chain(tables, d0))  # warmup / compile
+    int(chain_fn(tables, d0))  # warmup / compile
     best_dt = float("inf")
     for _ in range(ITERS):
         t0 = time.perf_counter()
-        int(chain(tables, d0))
-        best_dt = min(best_dt, (time.perf_counter() - t0) / CHAIN)
+        int(chain_fn(tables, d0))
+        best_dt = min(best_dt, (time.perf_counter() - t0) / chain_steps)
 
     gbps = (DATA_SHARDS * shard_bytes) / best_dt / 1e9
     partial.phase("kernel", gbps=round(gbps, 2), backend=backend)
@@ -1392,42 +1539,93 @@ def measure(platform: str) -> None:
     partial.phase("probe", choice=(probe or {}).get("choice"))
 
     try:
-        e2e = _measure_e2e(on_tpu, probe)
+        e2e = _measure_e2e(on_tpu, probe, budget_s=remaining(),
+                           calib=calib)
     except Exception as exc:
         print(f"bench: e2e measurement failed: {exc!r}",
               file=sys.stderr)
         e2e = None
-    partial.phase("e2e", gbps=(e2e or {}).get("e2e_gbps"))
-    try:
-        # loopback-cluster rebuild A/B: copy-then-rebuild vs the
-        # slice-pipelined streaming repair path
-        e2e = dict(e2e or {}, **_measure_dist_rebuild())
-    except Exception as exc:
-        print(f"bench: dist rebuild measurement failed: {exc!r}",
-              file=sys.stderr)
-    partial.phase("dist_rebuild",
-                  speedup=(e2e or {}).get("dist_rebuild_speedup"))
-    try:
-        # loopback-cluster encode A/B: encode-locally-then-balance vs
-        # scatter-encode streaming shards to their placement targets
-        e2e = dict(e2e or {}, **_measure_dist_encode())
-    except Exception as exc:
-        print(f"bench: dist encode measurement failed: {exc!r}",
-              file=sys.stderr)
-    partial.phase("dist_encode",
-                  speedup=(e2e or {}).get("dist_encode_speedup"))
+    partial.phase("e2e", gbps=(e2e or {}).get("e2e_encode_gbps"))
+    if remaining() < 280:
+        # out of budget for a proc-cluster A/B: say so in the trail
+        # instead of dying mid-cluster (a timed-out arm must still
+        # yield a diagnosable record)
+        partial.phase("dist_rebuild",
+                      skipped=f"budget: {int(remaining())}s left")
+    else:
+        try:
+            # loopback-cluster rebuild A/B: copy-then-rebuild vs the
+            # slice-pipelined streaming repair path
+            e2e = dict(e2e or {}, **_measure_dist_rebuild())
+        except Exception as exc:
+            print(f"bench: dist rebuild measurement failed: {exc!r}",
+                  file=sys.stderr)
+        partial.phase("dist_rebuild",
+                      speedup=(e2e or {}).get("dist_rebuild_speedup"))
+    if remaining() < 200:
+        partial.phase("dist_encode",
+                      skipped=f"budget: {int(remaining())}s left")
+    else:
+        try:
+            # loopback-cluster encode A/B: encode-locally-then-balance
+            # vs scatter-encode streaming shards to their placements
+            e2e = dict(e2e or {}, **_measure_dist_encode(
+                budget_s=remaining() - (90 if on_tpu else 20)))
+        except Exception as exc:
+            print(f"bench: dist encode measurement failed: {exc!r}",
+                  file=sys.stderr)
+        partial.phase("dist_encode",
+                      speedup=(e2e or {}).get("dist_encode_speedup"))
     if on_tpu:
         # VERDICT r4 #3: publish the TPU-backed e2e number (the probed
-        # pipeline chooses the faster native engine on this tunneled
+        # pipeline chooses the faster native engine on a tunneled
         # chip; the device path must be a measured quantity, not an
-        # inference from the kernel microbenchmark)
+        # inference from the kernel microbenchmark).  Sized from the
+        # calibration: the windowed staging pipeline's predicted rate
+        # is the roofline min(h2d, kernel x devices).
         try:
-            tpu_e2e = _measure_e2e_tpu_forced()
+            from seaweedfs_tpu.ops import staging
+            tpu_size = 128 << 20
+            roof = None
+            if calib:
+                roof = calib["predicted_roofline_gbps"]
+                # warm + timed encode both pass over the volume; size
+                # for ~2 passes at HALF the roofline (overlap may be
+                # imperfect), floor 32MB, cap 1GB
+                span = max(20.0, min(remaining() * 0.4, 120.0))
+                tpu_size = int(max(32 << 20, min(
+                    1 << 30, roof * 0.5 * 1e9 * span / 2)))
+                if tpu_size > (64 << 20):
+                    # whole 64MB blob repetitions (the .dat writer's
+                    # unit) so requested == written
+                    tpu_size = (tpu_size >> 26) << 26
+            staging.reset_aggregate()
+            tpu_e2e = _measure_e2e_tpu_forced(size=tpu_size)
+            snap = staging.snapshot()
+            tpu_e2e["tpu_h2d_windows"] = snap["windows"]
+            tpu_e2e["tpu_h2d_overlap_fraction"] = \
+                snap["overlap_fraction"]
+            tpu_e2e["tpu_staged_h2d_gbps"] = snap["h2d_gbps"]
+            tpu_e2e["tpu_staged_d2h_gbps"] = snap["d2h_gbps"]
+            if calib:
+                _apply_ceiling(
+                    tpu_e2e, "e2e_tpu",
+                    tpu_e2e.get("e2e_encode_gbps_tpu", 0.0),
+                    {"host->device staging (windowed)":
+                     calib["h2d_gbps"],
+                     f"GF kernel x {calib['devices']} devices":
+                     calib["kernel_gbps_per_chip"] *
+                     calib["devices"]})
             e2e = dict(e2e or {}, **tpu_e2e)
         except Exception as exc:
             print(f"bench: tpu-forced e2e failed: {exc!r}",
                   file=sys.stderr)
-        partial.phase("tpu_forced_e2e")
+        partial.phase(
+            "tpu_forced_e2e",
+            gbps=(e2e or {}).get("e2e_encode_gbps_tpu"),
+            overlap=(e2e or {}).get("tpu_h2d_overlap_fraction"))
+    if calib is not None:
+        e2e = dict(e2e or {}, device_calibration=calib)
     _emit(gbps, backend, shard_bytes, note=note, e2e=e2e, h2d=h2d,
           probe=probe)
 
@@ -1479,6 +1677,9 @@ def _run_child(platform: str, timeout_s: int):
         tempfile.gettempdir(),
         f"bench_partial_{platform}_{os.getpid()}.json")
     env["BENCH_PARTIAL_PATH"] = partial_path
+    # the child self-schedules its phases against this (calibration
+    # probe first, then every sized phase scaled to what's left)
+    env["BENCH_BUDGET_S"] = str(max(60, timeout_s - 30))
 
     def read_partial():
         try:
@@ -1587,9 +1788,24 @@ if __name__ == "__main__":
         measure(sys.argv[2])
     elif len(sys.argv) >= 2 and sys.argv[1] == "dist_encode":
         # standalone scatter-vs-seed encode A/B (the acceptance
-        # scenario): one JSON line, no accelerator needed
+        # scenario): one JSON line, no accelerator needed.  Optional
+        # arg = round budget in seconds (warmup pair calibrates the
+        # per-round cost; rounds stop when the next pair won't fit).
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        print(json.dumps(_measure_dist_encode()))
+        bud = float(sys.argv[2]) if len(sys.argv) > 2 else None
+        print(json.dumps(_measure_dist_encode(budget_s=bud)))
+    elif len(sys.argv) >= 2 and sys.argv[1] == "tpu":
+        # standalone TPU arm (the flagship end-to-end device number):
+        # calibration probe -> budget-scaled phases; on overrun the
+        # _Partial phase trail is emitted instead of silence
+        line, partial = _run_child("tpu", TPU_TIMEOUT_S)
+        if line is not None:
+            print(line)
+        else:
+            print(json.dumps(dict(
+                partial or {"partial": True},
+                metric="ec_encode_rs10+4_GBps_per_chip",
+                timedOut=True)))
     elif len(sys.argv) >= 2 and sys.argv[1] == "dist_rebuild":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         print(json.dumps(_measure_dist_rebuild()))

@@ -17,11 +17,23 @@ import secrets
 import threading
 import time
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-
-
 class KmsError(Exception):
     pass
+
+
+def _aesgcm():
+    """Lazy optional import: the `cryptography` wheel is only needed
+    when envelope crypto actually runs.  Importing this module (for
+    KmsError, key metadata, the store plumbing every gateway wires up)
+    must work on a box without the wheel — sse.py, oidc.py and
+    kms_cloud.py already follow the same rule."""
+    try:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    except ImportError as e:  # pragma: no cover — environment gap
+        raise KmsError(
+            "the `cryptography` package is required for KMS envelope "
+            "encryption (pip install cryptography)") from e
+    return AESGCM
 
 
 class LocalKms:
@@ -114,8 +126,8 @@ class LocalKms:
         master = self._master(key_id)
         plaintext = secrets.token_bytes(32)
         nonce = secrets.token_bytes(12)
-        sealed = AESGCM(master).encrypt(nonce, plaintext,
-                                        self._aad(context))
+        sealed = _aesgcm()(master).encrypt(nonce, plaintext,
+                                           self._aad(context))
         blob = json.dumps({
             "keyId": key_id,
             "nonce": base64.b64encode(nonce).decode(),
@@ -135,8 +147,9 @@ class LocalKms:
             raise KmsError("InvalidCiphertextException: undecodable "
                            "blob")
         master = self._master(key_id)
+        aesgcm = _aesgcm()
         try:
-            plaintext = AESGCM(master).decrypt(nonce, sealed,
+            plaintext = aesgcm(master).decrypt(nonce, sealed,
                                                self._aad(context))
         except Exception:
             raise KmsError("InvalidCiphertextException: seal or "

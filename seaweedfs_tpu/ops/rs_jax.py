@@ -31,6 +31,21 @@ import numpy as np
 from . import gf256, rs_matrix
 
 
+def _windowed_wanted(flat: np.ndarray) -> bool:
+    """Take the windowed double-buffered staging path (ops.staging)?
+    Yes whenever windowing is enabled AND either the batch spans more
+    than one window (there is something to pipeline) or a device mesh
+    is up (mesh placement always rides the launch).  A one-window
+    single-device batch gains nothing from a staging thread, so it
+    keeps the legacy one-shot device_put."""
+    from . import staging
+    wb = staging.window_bytes()
+    if wb <= 0:
+        return False
+    _batch_sh, _repl_sh, ndev = staging.encode_shardings()
+    return ndev > 1 or flat.nbytes > wb
+
+
 def _staged_h2d(flat: np.ndarray) -> jax.Array:
     """Stage a packed host buffer onto the default device and record
     the h2d window (profiling.device_note).  Fencing policy matters:
@@ -241,10 +256,24 @@ class ReedSolomonJax:
         AFTER materialize() returns — on backends where jnp.asarray
         aliases host memory (CPU), the kernel has consumed the input by
         the time the output is fetchable.
+
+        The default path is the windowed double-buffered staging
+        pipeline (ops.staging): the batch is split into column
+        windows, a staging thread overlaps window N+1's h2d with
+        window N's kernel, and the handle additionally exposes
+        .windows() so the encode writer can push each parity window to
+        its shard sink while later windows are still in flight.
+        SEAWEEDFS_TPU_H2D_WINDOW_MB=0 restores the one-shot
+        device_put.
         """
         data = self._check(data, self.data_shards)
         b = data.shape[1]
         flat = pack_words(np.ascontiguousarray(data))
+        if _windowed_wanted(flat):
+            from . import staging
+            return staging.WindowedLaunch(
+                self._parity_rows, flat, gf_apply_matrix_words,
+                self.parity_shards, b)
         dev = _staged_h2d(flat)
         t_dispatch = time.perf_counter()
         out32 = gf_apply_matrix_words(self._parity_rows, dev)
@@ -258,10 +287,17 @@ class ReedSolomonJax:
     def apply_matrix_lazy(self, mat, data) -> "_PendingParity":
         """Async generic apply: dispatch without waiting (same contract
         as parity_lazy) so a staged pipeline can overlap D2H of launch k
-        with H2D+kernel of k+1."""
+        with H2D+kernel of k+1; windowed/mesh-staged exactly like
+        parity_lazy."""
         data = np.ascontiguousarray(data)
         b = data.shape[1]
-        dev = _staged_h2d(pack_words(data))
+        flat = pack_words(data)
+        if _windowed_wanted(flat):
+            from . import staging
+            return staging.WindowedLaunch(
+                np.asarray(mat, dtype=np.uint8), flat,
+                gf_apply_matrix_words, len(mat), b, op="rebuild")
+        dev = _staged_h2d(flat)
         t_dispatch = time.perf_counter()
         out32 = gf_apply_matrix_words(
             jnp.asarray(mat, dtype=jnp.uint8), dev)

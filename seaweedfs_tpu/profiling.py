@@ -509,6 +509,22 @@ def device_note(direction: str, nbytes: int,
                     help_text="last staging window throughput")
 
 
+def overlap_note(fraction: float, windows: int,
+                 op: str = "encode") -> None:
+    """Record one windowed staging launch's h2d/d2h overlap fraction
+    (ops.staging: 0 = the staging and consume planes ran serially,
+    1 = the wall equalled the slower plane alone) plus the window
+    count — the figure that says whether the double-buffered pipeline
+    actually pipelined."""
+    m = _process_metrics()
+    m.gauge_set("device_h2d_overlap_fraction", fraction,
+                help_text="last windowed launch's h2d/d2h overlap "
+                          "fraction (0 serial .. 1 fully overlapped)",
+                op=op)
+    m.counter_add("device_staged_windows_total", float(windows),
+                  help_text="h2d staging windows launched", op=op)
+
+
 def kernel_note(kernel: str, seconds: float, nbytes: int = 0) -> None:
     """Record one device kernel dispatch-to-materialize window."""
     m = _process_metrics()

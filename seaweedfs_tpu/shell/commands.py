@@ -1225,8 +1225,27 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
         if kern is not None:
             h2d = _gauge(a, "seaweedfs_tpu_device_h2d_gbps") or 0.0
             d2h = _gauge(a, "seaweedfs_tpu_device_d2h_gbps") or 0.0
-            out.append(f"  device: kernel={kern:.2f}ms "
-                       f"h2d={h2d:.2f}GB/s d2h={d2h:.2f}GB/s")
+            line = (f"  device: kernel={kern:.2f}ms "
+                    f"h2d={h2d:.2f}GB/s d2h={d2h:.2f}GB/s")
+            # windowed staging figures (ops.staging): window count
+            # since the previous sample + how overlapped the last
+            # launch's h2d/d2h planes actually ran
+            ov = _gauge(a, "seaweedfs_tpu_device_h2d_overlap_fraction",
+                        {"op": "encode"})
+            if ov is None:  # rebuild-only workload stages too
+                ov = _gauge(a,
+                            "seaweedfs_tpu_device_h2d_overlap_fraction",
+                            {"op": "rebuild"})
+            wins = _counter_sum(
+                a, "seaweedfs_tpu_device_staged_windows_total") - \
+                (_counter_sum(
+                    b, "seaweedfs_tpu_device_staged_windows_total")
+                 if b else 0)
+            if ov is not None:
+                line += f"  overlap={ov * 100:.0f}%"
+            if wins > 0:
+                line += f"  windows={wins:.0f}"
+            out.append(line)
         stages = _stage_report(b or {}, a, ns)
         if stages:
             out.append("  " + stages)

@@ -118,6 +118,9 @@ class _StageTimer:
     def __init__(self, fn):
         import time as _time
         self._fn = fn
+        # forwarded so _staged_run still sees a lazy-capable writer
+        # through the timing wrap
+        self.accepts_lazy = getattr(fn, "accepts_lazy", False)
         self._clock = _time.perf_counter
         self._wall = _time.time
         self.start_wall = 0.0
@@ -275,7 +278,8 @@ def _staged_run(work, read_item, compute, write_item) -> None:
                 if item is None:
                     return
                 payload, result = item
-                if hasattr(result, "materialize"):
+                if hasattr(result, "materialize") and \
+                        not getattr(write_item, "accepts_lazy", False):
                     result = result.materialize()
                 write_item(payload, result)
                 pool.put(payload[0])  # recycle the slot for the reader
@@ -303,6 +307,16 @@ def _staged_run(work, read_item, compute, write_item) -> None:
         q_write.put(None)
         rt.join()
         wt.join()
+        # unwind path: compute results still queued were never
+        # materialized — a staged device launch (ops.staging) parked
+        # there must stop its stager thread NOW, not wait for GC
+        while True:
+            try:
+                item = q_write.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None and hasattr(item[1], "abort"):
+                item[1].abort()
     if errors:
         raise errors[0]
 
@@ -385,8 +399,29 @@ def _generate_ec_files(base_file_name: str, ctx: ECContext,
         buf, real = payload
         for i in range(d):
             sinks[i].write(buf[i, :real].data)
+        if hasattr(parity, "windows"):
+            # windowed staged launch (ops.staging): push each parity
+            # window to its shard sink AS IT LANDS, so the d2h fetch
+            # of window k and the scatter-sink sends overlap the h2d
+            # staging of windows k+1, k+2...  Always drain fully —
+            # a partial drain would recycle staging buffers the
+            # stager thread is still copying from.
+            for w0, chunk in parity.windows():
+                n = min(chunk.shape[1], real - w0)
+                if n <= 0:
+                    continue  # device-shape padding beyond `real`
+                for j in range(ctx.total - d):
+                    sinks[d + j].write(chunk[j, :n].data)
+            return
+        if hasattr(parity, "materialize"):
+            # legacy one-shot lazy handle (windowing disabled, or a
+            # single-device batch inside one window): accepts_lazy
+            # means _staged_run no longer materializes for us
+            parity = parity.materialize()
         for j in range(ctx.total - d):
             sinks[d + j].write(parity[j, :real].data)
+
+    write_item.accepts_lazy = True
 
     # stage spans (tracing.py): capture the caller's span context NOW
     # — the reader/writer stages run on pipeline threads where the

@@ -17,10 +17,22 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+import importlib.util  # noqa: E402
+
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu"
+
+# Shared skip marker for the optional `cryptography` wheel (iam kms,
+# sftp transport, tls cert minting, s3 sse-c/sse-kms).  A decorator —
+# not an in-body importorskip — so guarded tests skip BEFORE their
+# cluster fixtures boot (the tier-1 budget is tight; a skipped test
+# must cost ~0s).
+needs_crypto = pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="needs the optional `cryptography` wheel")
 
 
 def pytest_configure(config):

@@ -18,6 +18,8 @@ from seaweedfs_tpu.server.httpd import http_bytes
 from seaweedfs_tpu.server.master_server import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
 
+from conftest import needs_crypto as _needs_crypto
+
 AK, SK = "ssekey", "ssesecret"
 
 
@@ -94,6 +96,7 @@ def s3req(gw, method, path, body=b"", headers=None):
     return http_bytes(method, f"{gw.url}{path}", body or None, signed)
 
 
+@_needs_crypto
 def test_sse_c_roundtrip_and_key_enforcement(cluster):
     *_, filer, gw = cluster
     key = b"K" * 32
@@ -201,6 +204,7 @@ def test_resize_preserves_jpeg_format():
         "resized JPEG must stay JPEG (not re-encode as PNG)"
 
 
+@_needs_crypto
 def test_sse_c_copy_object(cluster):
     *_, filer, gw = cluster
     key = b"C" * 32

@@ -15,11 +15,14 @@ from seaweedfs_tpu.iam import (Credential, Identity, IdentityStore,
 from seaweedfs_tpu.iam.iamapi import IamApiServer, policy_to_actions
 from seaweedfs_tpu.iam.kms import KmsError, LocalKms
 from seaweedfs_tpu.iam.sts import RoleStore, StsError
+
 from seaweedfs_tpu.s3 import S3ApiServer
 from seaweedfs_tpu.s3.auth import sign_request
 from seaweedfs_tpu.server.filer_server import FilerServer
 from seaweedfs_tpu.server.master_server import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
+
+from conftest import needs_crypto as _needs_crypto
 
 STS_KEY = "sts-signing-key-for-tests"
 
@@ -118,6 +121,7 @@ def test_sts_roundtrip_and_trust():
 
 # -- unit: KMS -------------------------------------------------------------
 
+@_needs_crypto
 def test_kms_envelope_roundtrip(tmp_path):
     kms = LocalKms(str(tmp_path / "kms.json"))
     kid = kms.create_key(alias="primary")
@@ -379,6 +383,7 @@ def test_iamapi_input_validation(cluster):
     assert st == 400
 
 
+@_needs_crypto
 def test_sse_kms_roundtrip(cluster):
     gw, _, _ = cluster
     assert _s3(gw, "PUT", "/enc")[0] == 200
@@ -413,6 +418,7 @@ def test_sse_kms_roundtrip(cluster):
 
 # -- OIDC web-identity federation (iam/oidc/) ------------------------------
 
+@_needs_crypto
 def test_oidc_token_validation():
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
@@ -448,6 +454,7 @@ def test_oidc_token_validation():
         prov.validate(mint_test_token(good, rsa_private_key=other))
 
 
+@_needs_crypto
 def test_assume_role_with_web_identity_end_to_end(cluster):
     """OIDC token -> STS temp credentials -> S3 access, all through
     the REST surface with NO static credential involved."""
@@ -559,6 +566,7 @@ def test_oidc_rejects_non_object_token_segments():
 
 # -- AWS KMS wire-protocol shim (kms/aws/) ---------------------------------
 
+@_needs_crypto
 def test_aws_kms_shim_roundtrip(tmp_path):
     """AwsKms speaks the real KMS JSON protocol (X-Amz-Target +
     SigV4 service 'kms') against a wire-faithful stub endpoint; the
@@ -586,6 +594,7 @@ def test_aws_kms_shim_roundtrip(tmp_path):
         stub.stop()
 
 
+@_needs_crypto
 def test_s3_gateway_over_aws_kms_shim(tmp_path):
     from seaweedfs_tpu.iam.kms_aws import AwsKms, KmsStubServer
     backend = LocalKms(str(tmp_path / "k.json"))
@@ -619,6 +628,7 @@ def test_s3_gateway_over_aws_kms_shim(tmp_path):
         stub.stop()
 
 
+@_needs_crypto
 @pytest.mark.parametrize("provider_cls,server_cls,kwargs", [
     ("GcpKms", "FakeGcpKmsServer",
      {"key_name": "projects/p/locations/l/keyRings/r/cryptoKeys/k"}),
@@ -654,6 +664,7 @@ def test_cloud_kms_providers_envelope_roundtrip(provider_cls,
         server.stop()
 
 
+@_needs_crypto
 def test_cloud_kms_drives_s3_sse(tmp_path):
     """An S3 gateway using the OpenBao transit provider end-to-end:
     objects envelope-encrypt at rest and decrypt on read."""

@@ -7,6 +7,8 @@ import pytest
 from seaweedfs_tpu.iam.ldap import (LdapClient, LdapError,
                                     LdapProvider, MiniLdapServer)
 
+from conftest import needs_crypto as _needs_crypto
+
 USERS = {
     "uid=ada,ou=people,dc=example,dc=com": (
         "lovelace", {"uid": ["ada"], "cn": ["Ada Lovelace"],
@@ -80,6 +82,7 @@ def test_provider_outage_raises_not_rejects():
         p.authenticate("ada", "pw")
 
 
+@_needs_crypto
 def test_sftp_login_via_ldap(ldap_server, tmp_path):
     """End-to-end: an sftp client authenticates with directory
     credentials (no local user) and gets a working session."""

@@ -99,6 +99,31 @@ def test_encode_volume_batch(mesh):
             err_msg=f"volume {i}")
 
 
+def test_named_sharding_staged_encode_matches_shard_map(mesh,
+                                                        monkeypatch):
+    """The tentpole's 1D Mesh(jax.devices(), ("batch",)) +
+    NamedSharding(P(None, "batch")) windowed staging path
+    (ops.staging, what parity_lazy ships) against the 2D shard_map
+    path and the CPU twin — the same cross-implementation identity
+    this module has always asserted, with the NamedSharding idiom as
+    the third implementation."""
+    from seaweedfs_tpu.ops.rs_jax import ReedSolomonJax
+
+    monkeypatch.setenv("SEAWEEDFS_TPU_ENCODE_MESH", "1")
+    monkeypatch.setenv("SEAWEEDFS_TPU_H2D_WINDOW_MB", "0.004")
+    rng = np.random.default_rng(5)
+    d, p, nbytes = 10, 4, 4096 * 8
+    data = rng.integers(0, 256, size=(d, nbytes), dtype=np.uint8)
+    want = rs_cpu.ReedSolomonCPU(d, p).parity(data)
+    staged = ReedSolomonJax(d, p).parity_lazy(data)
+    assert hasattr(staged, "windows")  # the staged mesh path ran
+    np.testing.assert_array_equal(staged.materialize(), want)
+    mat = rs_matrix.parity_matrix(d, p)
+    got32 = ec_sharded.encode_sharded(mesh, mat, pack_words(data))
+    np.testing.assert_array_equal(
+        unpack_words(np.asarray(got32), nbytes), want)
+
+
 def test_encode_volume_files_batch_byte_identical(mesh, tmp_path,
                                                   monkeypatch):
     """The multi-volume FILE batch path (parallel/ec_batch.py — what

@@ -16,6 +16,8 @@ from seaweedfs_tpu.server.httpd import http_bytes, http_json
 from seaweedfs_tpu.server.master_server import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
 
+from conftest import needs_crypto as _needs_crypto
+
 ROWS = [
     {"name": "alpha", "size": 10, "tags": {"tier": "hot"}},
     {"name": "beta", "size": 250, "tags": {"tier": "cold"}},
@@ -176,6 +178,7 @@ def test_query_review_regressions():
     assert run_query("select * from s3object limit 0", data) == []
 
 
+@_needs_crypto
 def test_s3_select_enforces_sse_c(cluster):
     """?select is a READ: the SSE-C key is required and used, exactly
     like GET — querying ciphertext would both leak and never match."""

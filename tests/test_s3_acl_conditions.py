@@ -18,6 +18,8 @@ from seaweedfs_tpu.server.filer_server import FilerServer
 from seaweedfs_tpu.server.master_server import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
 
+from conftest import needs_crypto as _needs_crypto
+
 
 # -- unit: condition operators ---------------------------------------------
 
@@ -329,6 +331,7 @@ def _xml_tag(body, tag):
     return None
 
 
+@_needs_crypto
 def test_multipart_sse_c_roundtrip(gw):
     key, sse = _sse_c_headers()
     assert _signed(gw, "PUT", "/mpsse")[0] == 200
@@ -372,6 +375,7 @@ def test_multipart_sse_c_roundtrip(gw):
     assert body == (b"".join(parts))[69990:70011]
 
 
+@_needs_crypto
 def test_multipart_sse_kms_roundtrip(gw_kms):
     gw = gw_kms
     assert _signed(gw, "PUT", "/mpkms")[0] == 200

@@ -22,6 +22,8 @@ from seaweedfs_tpu.server.httpd import http_bytes
 from seaweedfs_tpu.server.master_server import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
 
+from conftest import needs_crypto as _needs_crypto
+
 AK, SK = "AKIDEXAMPLE", "secretkey123"
 CREDS = {AK: SK}
 
@@ -627,6 +629,7 @@ def test_policy_engine_unit():
         parse_policy(b'{"Statement":[{"Effect":"Maybe"}]}')
 
 
+@_needs_crypto
 def test_bucket_default_encryption(s3, tmp_path):
     """PutBucketEncryption: a PUT with no SSE headers inherits the
     bucket default (SSE-S3 via the local KMS envelope); Get/Delete

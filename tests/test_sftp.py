@@ -11,7 +11,12 @@ import os
 import time
 
 import pytest
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+
+pytest.importorskip(
+    "cryptography",
+    reason="the sftp transport's AES-CTR/HMAC framing needs the "
+           "optional `cryptography` wheel")
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (  # noqa: E402
     Ed25519PrivateKey)
 
 from seaweedfs_tpu.server.filer_server import FilerServer

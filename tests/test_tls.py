@@ -9,7 +9,12 @@ import urllib.request
 
 import pytest
 
-from seaweedfs_tpu import operation
+pytest.importorskip(
+    "cryptography",
+    reason="cert minting (tls.generate_cluster_certs) needs the "
+           "optional `cryptography` wheel")
+
+from seaweedfs_tpu import operation  # noqa: E402
 from seaweedfs_tpu import security as sec_mod
 from seaweedfs_tpu.security import SecurityConfig
 from seaweedfs_tpu.server.master_server import MasterServer
