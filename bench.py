@@ -1008,6 +1008,248 @@ def _measure_soak(duration_s: float = 20.0,
     }
 
 
+def _measure_read_path(duration_s: float = 8.0, files: int = 48,
+                       tenants: int = 3) -> dict:
+    """Read-path cache tier A/B + degraded arm (ISSUE 11 acceptance).
+
+    Zipfian multi-tenant READ load over one corpus through a fresh
+    in-process SoakCluster per arm:
+
+      cold      caches disabled (READ_CACHE_MB=0, FILER_META_CACHE=0)
+                — the pre-PR serving path
+      warm      caches on, corpus pre-warmed — zipfian steady state
+
+    Headlines: warm cache-hit ratio (>= 0.8 acceptance), warm/cold
+    throughput ratio (>= 2x acceptance), and a DEGRADED arm: an
+    RS(4,2) volume with data shard 0 deleted, every read
+    reconstructing through the GF kernel — byte identity asserted,
+    decode p99 + promoted (second-pass, cache-hit) p99 recorded, and
+    zero full rebuilds in the request path verified from /metrics."""
+    import hashlib
+    import shutil
+    import tempfile
+    import threading
+    from pathlib import Path
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    import chaos as chaos_mod
+    from soak import OpStats, SoakCluster, percentile
+
+    from seaweedfs_tpu import operation, qos
+    from seaweedfs_tpu import stats as swstats
+    from seaweedfs_tpu.server.httpd import http_bytes, http_json
+
+    rng = np.random.default_rng(11)
+    sizes = [int(rng.integers(4 << 10, 160 << 10))
+             for _ in range(files)]
+    ranks = np.arange(1, files + 1, dtype=np.float64)
+    weights = 1.0 / ranks ** 1.2          # zipf-ish popularity
+    weights /= weights.sum()
+
+    _KNOBS = ("SEAWEEDFS_TPU_READ_CACHE_MB",
+              "SEAWEEDFS_TPU_FILER_META_CACHE")
+
+    def _cache_counters() -> "tuple[float, float]":
+        text = swstats.render_process()
+        return (chaos_mod.metric_sum(
+                    text, "seaweedfs_tpu_read_cache_hits_total"),
+                chaos_mod.metric_sum(
+                    text, "seaweedfs_tpu_read_cache_misses_total"))
+
+    def one_arm(label: str, env: "dict[str, str]",
+                warm: bool) -> dict:
+        saved = {k: os.environ.get(k) for k in _KNOBS}
+        for k in _KNOBS:
+            os.environ.pop(k, None)
+        os.environ.update(env)
+        qos.reset()
+        tmp = Path(tempfile.mkdtemp(prefix=f"bench_rp_{label}_"))
+        sc = SoakCluster(tmp, volumes=3)
+        try:
+            corpus = []
+            for i, size in enumerate(sizes):
+                body = rng.integers(0, 256, size,
+                                    dtype=np.uint8).tobytes()
+                path = f"/rp/t{i % tenants}/f{i:03d}.bin"
+                st, _, _ = http_bytes(
+                    "POST", f"{sc.filer_url}{path}", body, timeout=60)
+                assert st == 201, (path, st)
+                corpus.append((path, hashlib.sha256(body).digest(),
+                               size))
+            if warm:
+                for path, digest, _sz in corpus:
+                    st, body, _ = http_bytes(
+                        "GET", f"{sc.filer_url}{path}", timeout=60)
+                    assert st == 200 and \
+                        hashlib.sha256(body).digest() == digest
+            h0, m0 = _cache_counters()
+            per_tenant = [OpStats() for _ in range(tenants)]
+            stop = threading.Event()
+
+            def reader(t: int) -> None:
+                r = np.random.default_rng(100 + t)
+                st_t = per_tenant[t]
+                hdrs = {"X-Tenant": f"tenant{t}"}
+                while not stop.is_set():
+                    i = int(r.choice(files, p=weights))
+                    path, digest, _sz = corpus[i]
+                    t0 = time.perf_counter()
+                    try:
+                        code, body, _ = http_bytes(
+                            "GET", f"{sc.filer_url}{path}", None,
+                            hdrs, timeout=30)
+                    except OSError as e:
+                        st_t.record_err(repr(e))
+                        continue
+                    dt = time.perf_counter() - t0
+                    if code == 200 and \
+                            hashlib.sha256(body).digest() == digest:
+                        st_t.record_ok(dt)
+                    else:
+                        st_t.record_err(f"{path} -> {code}")
+
+            threads = [threading.Thread(target=reader, args=(t,))
+                       for t in range(tenants)]
+            for th in threads:
+                th.start()
+            time.sleep(duration_s)
+            stop.set()
+            for th in threads:
+                th.join(timeout=30)
+            h1, m1 = _cache_counters()
+            hits, misses = h1 - h0, m1 - m0
+            lat = sorted(x for s in per_tenant for x in s.lat_ok)
+            total_ok = len(lat)
+            return {
+                "okPerSec": round(total_ok / duration_s, 1),
+                "p50Ms": round(percentile(lat, 0.5) * 1e3, 2),
+                "p99Ms": round(percentile(lat, 0.99) * 1e3, 2),
+                "errors": sum(len(s.errors) for s in per_tenant),
+                "cacheHitRatio": round(hits / (hits + misses), 3)
+                if hits + misses > 0 else 0.0,
+                "perTenant": [s.summary() for s in per_tenant],
+            }
+        finally:
+            sc.stop()
+            qos.reset()
+            shutil.rmtree(tmp, ignore_errors=True)
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def degraded_arm(seconds: float) -> dict:
+        tmp = Path(tempfile.mkdtemp(prefix="bench_rp_degraded_"))
+        c = chaos_mod.Cluster(tmp, volumes=3)
+        try:
+            from seaweedfs_tpu.shell import CommandEnv, run_command
+            drng = np.random.default_rng(31)
+            blobs: dict = {}
+            for _ in range(12):
+                data = drng.integers(
+                    0, 256, int(drng.integers(8 << 10, 48 << 10)),
+                    dtype=np.uint8).tobytes()
+                blobs[operation.submit(c.master_url, data,
+                                       collection="bench_rp")] = data
+            vids = {int(f.split(",")[0]) for f in blobs}
+            assert len(vids) == 1, vids
+            vid = vids.pop()
+            env2 = CommandEnv(c.master_url)
+            run_command(env2, "lock")
+            try:
+                out = run_command(
+                    env2, f"ec.encode -volumeId={vid} "
+                          f"-collection=bench_rp "
+                          f"-dataShards=4 -parityShards=2")
+            finally:
+                run_command(env2, "unlock")
+            assert "error" not in out.lower(), out
+            holder = next(u for u, sids in c.shard_map(vid).items()
+                          if 0 in sids)
+            r = http_json("POST",
+                          f"{holder}/admin/ec/delete_shards",
+                          {"volumeId": vid, "collection": "bench_rp",
+                           "shardIds": [0]}, timeout=30)
+            assert "error" not in r, r
+
+            def rebuilds() -> float:
+                return sum(chaos_mod.metric_sum(
+                    chaos_mod.metrics_text(u),
+                    "volume_server_ec_rebuilds_total")
+                    for u in c.all_urls[1:])
+
+            r0 = rebuilds()
+            d0 = chaos_mod.metric_sum(
+                swstats.render_process(),
+                "seaweedfs_tpu_ec_degraded_reads_total")
+            items = list(blobs.items())
+            zw = 1.0 / np.arange(1, len(items) + 1) ** 1.2
+            zw /= zw.sum()
+            decode_lat: list = []
+            rr = np.random.default_rng(32)
+            deadline = time.monotonic() + seconds
+            # first pass: every distinct needle decodes once, then the
+            # zipfian tail keeps decoding whatever the LRU hasn't kept
+            while time.monotonic() < deadline or not decode_lat:
+                fid, payload = items[int(rr.choice(len(items), p=zw))]
+                t0 = time.perf_counter()
+                got = operation.read(c.master_url, fid)
+                decode_lat.append(time.perf_counter() - t0)
+                assert got == payload, f"degraded read {fid} corrupt"
+            degraded_seen = chaos_mod.metric_sum(
+                swstats.render_process(),
+                "seaweedfs_tpu_ec_degraded_reads_total") - d0
+            # second pass: the decoded needles were PROMOTED — the
+            # hot tail now serves from memory
+            warm_lat: list = []
+            for fid, payload in items:
+                t0 = time.perf_counter()
+                assert operation.read(c.master_url, fid) == payload
+                warm_lat.append(time.perf_counter() - t0)
+            return {
+                "reads": len(decode_lat),
+                "degradedReads": degraded_seen,
+                "byteIdentical": True,
+                "decodeP50Ms": round(
+                    percentile(decode_lat, 0.5) * 1e3, 2),
+                "decodeP99Ms": round(
+                    percentile(decode_lat, 0.99) * 1e3, 2),
+                "promotedP99Ms": round(
+                    percentile(warm_lat, 0.99) * 1e3, 2),
+                "fullRebuildsInRequestPath": rebuilds() - r0,
+            }
+        finally:
+            c.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    cold = one_arm("cold", {"SEAWEEDFS_TPU_READ_CACHE_MB": "0",
+                            "SEAWEEDFS_TPU_FILER_META_CACHE": "0"},
+                   warm=False)
+    warm = one_arm("warm", {}, warm=True)
+    degraded = degraded_arm(min(duration_s, 5.0))
+    ratio = warm["okPerSec"] / max(cold["okPerSec"], 1e-9)
+    return {
+        "scenario": "read_path_cache_ab",
+        "metric": "read_path_warm_over_cold_throughput",
+        "value": round(ratio, 2),
+        "unit": "x",
+        "duration_s_per_arm": duration_s,
+        "files": files,
+        "tenants": tenants,
+        "cold": cold,
+        "warm": warm,
+        "degraded": degraded,
+        "warmCacheHitRatio": warm["cacheHitRatio"],
+        "accept_hit_ratio_ge_0_8":
+            warm["cacheHitRatio"] >= 0.8,
+        "accept_warm_2x_cold": ratio >= 2.0,
+        "accept_no_rebuild_in_request_path":
+            degraded["fullRebuildsInRequestPath"] == 0,
+    }
+
+
 def _stage_decomposition(parsed: dict, ns: str) -> "dict | None":
     """One role's write_stage_seconds decomposition from its parsed
     /metrics (profiling.py helpers): per-stage seconds/calls/mean plus
@@ -1824,6 +2066,13 @@ if __name__ == "__main__":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         dur = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
         print(json.dumps(_measure_write_path(seconds=dur)))
+    elif len(sys.argv) >= 2 and sys.argv[1] == "read_path":
+        # zipfian multi-tenant read-path cache A/B + degraded arm
+        # (ISSUE 11): warm hit ratio, warm/cold throughput ratio, and
+        # degraded-read p99 with byte identity, one JSON line
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        dur = float(sys.argv[2]) if len(sys.argv) > 2 else 8.0
+        print(json.dumps(_measure_read_path(duration_s=dur)))
     elif len(sys.argv) >= 2 and sys.argv[1] == "soak":
         # sustained-load QoS A/B (ISSUE 6): per-tenant p50/p99 with
         # and without the QoS plane, one JSON line

@@ -902,6 +902,77 @@ class FlushUnderLockRule(Rule):
                 f"CommitBarrier) or noqa with a reason")
 
 
+class UnboundedBodyReadRule(Rule):
+    """SWFS013: a full-body `f.read()` (no size argument) on a file
+    handle opened in a DATA-PLANE module (`server/`, `filer/`, `s3/`,
+    `mount/`, `util/chunk_cache.py`).  These trees assemble responses
+    and caches: an unbounded read stages a whole file through Python
+    bytes where the serving path should stream (`FileSlice` rides the
+    dispatcher's sendfile(2); `Filer.open_read_stream` fetches chunk
+    views lazily) or at least bound the read to what the protocol
+    allows.  Genuinely bounded reads (sidecar files with format-fixed
+    sizes, admin inventory endpoints that need the full buffer) stay
+    with `# noqa: SWFS013` and a reason."""
+
+    id = "SWFS013"
+    severity = "error"
+    title = "unbounded full-body read on a data-plane path"
+
+    _TREES = ("seaweedfs_tpu/server/", "seaweedfs_tpu/filer/",
+              "seaweedfs_tpu/s3/", "seaweedfs_tpu/mount/",
+              "seaweedfs_tpu/util/chunk_cache.py")
+
+    def check(self, ctx: FileContext):
+        rel = ctx.relpath.replace("\\", "/")
+        if not any(t in rel for t in self._TREES):
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            yield from self._check_function(ctx, fn)
+
+    @staticmethod
+    def _opened_names(fn: ast.AST) -> "set[str]":
+        """Names bound to `open(...)` results inside this function:
+        `x = open(...)`, `with open(...) as x:`."""
+        names: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _dotted(node.value.func) in ("open", "io.open"):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        names.add(tgt.id)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call) and \
+                            _dotted(item.context_expr.func) in \
+                            ("open", "io.open") and \
+                            isinstance(item.optional_vars, ast.Name):
+                        names.add(item.optional_vars.id)
+        return names
+
+    def _check_function(self, ctx: FileContext, fn: ast.AST):
+        opened = self._opened_names(fn)
+        if not opened:
+            return
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or node.args or \
+                    node.keywords:
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "read" and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in opened:
+                yield self.finding(
+                    ctx, node,
+                    f"{f.value.id}.read() buffers the whole file "
+                    f"through Python bytes on a data-plane path — "
+                    f"stream it (FileSlice / open_read_stream) or "
+                    f"bound the read, or noqa with a reason")
+
+
 RULES = [
     LockDisciplineRule(),
     JitBlockingRule(),
@@ -915,4 +986,5 @@ RULES = [
     MissingAdmissionRule(),
     WallDurationRule(),
     FlushUnderLockRule(),
+    UnboundedBodyReadRule(),
 ]

@@ -96,6 +96,21 @@ class Entry:
             extended=d.get("extended", {}),
         )
 
+    def clone(self) -> "Entry":
+        """Deep-enough copy for the metadata cache: callers mutate
+        attributes, the chunk list AND individual FileChunks in place
+        (update_attrs / append_chunks / _clip_chunks), so every
+        mutable layer is copied — a cached entry must never alias one
+        a handler is editing."""
+        import copy as _copy
+        return Entry(
+            full_path=self.full_path,
+            is_directory=self.is_directory,
+            attributes=_copy.copy(self.attributes),
+            chunks=[_copy.copy(c) for c in self.chunks],
+            extended=dict(self.extended),
+        )
+
 
 def normalize_path(path: str) -> str:
     """Canonical /a/b/c (no trailing slash except root)."""

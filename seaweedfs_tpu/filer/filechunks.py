@@ -19,6 +19,10 @@ class ChunkView:
     chunk_offset: int   # offset inside the stored chunk blob
     size: int
     logical_offset: int  # offset in the file
+    chunk_size: int = 0  # FULL size of the stored chunk blob (the
+    #                      filer's chunk cache keys whole bodies by
+    #                      fid, so a partial view must know whether
+    #                      caching the whole blob is worth it)
 
 
 @dataclass
@@ -27,6 +31,7 @@ class _Visible:
     stop: int
     file_id: str
     chunk_start: int  # file-logical offset where this chunk begins
+    chunk_size: int = 0
 
 
 def non_overlapping_visible_intervals(chunks: list[FileChunk]
@@ -35,7 +40,8 @@ def non_overlapping_visible_intervals(chunks: list[FileChunk]
     ordered = sorted(enumerate(chunks),
                      key=lambda t: (t[1].mtime_ns, t[0]))
     for _, c in ordered:
-        new = _Visible(c.offset, c.offset + c.size, c.file_id, c.offset)
+        new = _Visible(c.offset, c.offset + c.size, c.file_id,
+                       c.offset, c.size)
         out: list[_Visible] = []
         for v in visibles:
             if v.stop <= new.start or v.start >= new.stop:
@@ -43,10 +49,10 @@ def non_overlapping_visible_intervals(chunks: list[FileChunk]
                 continue
             if v.start < new.start:
                 out.append(_Visible(v.start, new.start, v.file_id,
-                                    v.chunk_start))
+                                    v.chunk_start, v.chunk_size))
             if v.stop > new.stop:
                 out.append(_Visible(new.stop, v.stop, v.file_id,
-                                    v.chunk_start))
+                                    v.chunk_start, v.chunk_size))
         out.append(new)
         out.sort(key=lambda v: v.start)
         visibles = out
@@ -68,7 +74,8 @@ def view_from_chunks(chunks: list[FileChunk], offset: int, size: int
             file_id=v.file_id,
             chunk_offset=lo - v.chunk_start,
             size=hi - lo,
-            logical_offset=lo))
+            logical_offset=lo,
+            chunk_size=v.chunk_size))
     return views
 
 

@@ -23,10 +23,85 @@ from .meta_log import MetaLog
 CHUNK_SIZE = 4 * 1024 * 1024  # filer auto-chunk default (8MB in ref CLI)
 
 
+class _ViewStream:
+    """Lazy file-like over a chunk-view list (Filer.open_read_stream):
+    each `read` drains an internal buffer refilled one view at a time
+    — gaps between views and short volume reads are zero-filled so the
+    stream always yields exactly `size` bytes (the buffered read_file
+    contract, without the whole-body bytearray)."""
+
+    def __init__(self, filer: "Filer", views, offset: int, size: int,
+                 on_close=None):
+        self._filer = filer
+        self._views = list(views)
+        self._vi = 0
+        self._pos = offset            # logical file position
+        self._end = offset + size
+        self._buf = memoryview(b"")
+        self._on_close = on_close
+
+    def _refill(self) -> bool:
+        """Load the next segment (zero gap or one view's bytes) into
+        the buffer.  False at end of range."""
+        if self._pos >= self._end:
+            return False
+        if self._vi < len(self._views):
+            v = self._views[self._vi]
+            if self._pos < v.logical_offset:
+                # gap before the next view: bounded zero block
+                n = min(v.logical_offset - self._pos,
+                        self._end - self._pos, 1 << 20)
+                self._buf = memoryview(bytes(n))
+                self._pos += n
+                return True
+            piece = self._filer._read_view(v)
+            if len(piece) < v.size:
+                # short volume read: pad to the view's extent so later
+                # views stay aligned (read_file leaves zeros the same
+                # way)
+                piece = piece + bytes(v.size - len(piece))
+            self._buf = memoryview(piece)
+            self._pos += len(piece)
+            self._vi += 1
+            return True
+        # trailing gap (sparse tail): zeros to the end of the range
+        n = min(self._end - self._pos, 1 << 20)
+        self._buf = memoryview(bytes(n))
+        self._pos += n
+        return True
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            # read-all: still bounded consumers only (tests); the
+            # server path always passes a window size
+            parts = []
+            while self._buf or self._refill():
+                parts.append(bytes(self._buf))
+                self._buf = memoryview(b"")
+            return b"".join(parts)
+        if not self._buf and not self._refill():
+            return b""
+        if n >= len(self._buf):
+            out, self._buf = bytes(self._buf), memoryview(b"")
+            return out
+        out = bytes(self._buf[:n])
+        self._buf = self._buf[n:]
+        return out
+
+    def close(self) -> None:
+        self._views = []
+        self._buf = memoryview(b"")
+        cb, self._on_close = self._on_close, None
+        if cb is not None:
+            cb()
+
+
 class Filer:
     def __init__(self, master: str, store: FilerStore | None = None,
                  collection: str = "", replication: str = "",
-                 meta_log_dir: str | None = None):
+                 meta_log_dir: str | None = None,
+                 meta_cache: "bool | None" = None,
+                 chunk_cache_dir: "str | None" = None):
         self.master = master
         self.store = store or MemoryStore()
         self.collection = collection
@@ -36,6 +111,38 @@ class Filer:
         # memory-tail-only otherwise (tests / ephemeral filers)
         self.meta_log = MetaLog(meta_log_dir)
         self._listeners: list[Callable[[dict], None]] = []
+        # metadata cache (meta_cache.py): find/list served from memory,
+        # invalidated by this filer's own event stream synchronously
+        # and by sibling filers' metalog watermark.  FilerServer passes
+        # meta_cache=False for stores whose co-located siblings keep
+        # separate metalog dirs (redis/elastic).  Left UNSPECIFIED
+        # (None), the cache enables only when a coherence channel
+        # exists: a metalog dir (the watermark files live there) or a
+        # MemoryStore (unsharable by construction, own events
+        # suffice).  A dir-less Filer over a sqlite FILE — the
+        # embedded S3-gateway shape — could be sharing that file with
+        # another process it has no way to hear from, so it stays
+        # uncached unless the caller opts in explicitly.
+        from .meta_cache import FilerMetaCache, meta_cache_entries
+        cap = meta_cache_entries()
+        if meta_cache is None:
+            meta_cache = bool(meta_log_dir) or \
+                isinstance(self.store, MemoryStore)
+        self.meta_cache = FilerMetaCache(self.meta_log, cap) \
+            if (meta_cache and cap > 0) else None
+        if self.meta_cache is not None:
+            self._listeners.append(self.meta_cache.on_event)
+        # hot chunk-body cache on the proxy read path (the server-side
+        # sibling of the mount's TieredChunkCache): chunk blobs are
+        # immutable per fid — an overwrite mints new fids — so this
+        # tier needs NO invalidation, only byte-bounded LRU
+        from ..util.chunk_cache import (TieredChunkCache, read_cache_mb,
+                                        read_cache_disk)
+        mb = read_cache_mb(64)
+        self.chunk_cache = TieredChunkCache(
+            mem_limit=mb << 20, disk_dir=chunk_cache_dir,
+            disk_limit=read_cache_disk()[1] << 20,
+            name="filer_chunk") if mb > 0 else None
         # striped per-path locks for chunk-list read-modify-write
         # cycles (append_chunks/truncate_file): two concurrent
         # /__chunk__/ posts must not lose each other's chunks
@@ -93,7 +200,23 @@ class Filer:
         self._note_dir(parent)
 
     def find_entry(self, path: str) -> Entry | None:
-        return self.store.find_entry(normalize_path(path))
+        path = normalize_path(path)
+        mc = self.meta_cache
+        if mc is None:
+            return self.store.find_entry(path)
+        from .meta_cache import _MISS
+        hit = mc.lookup_entry(path)
+        if hit is not _MISS:
+            # clone: callers mutate the returned entry in place
+            # (update_attrs, append_chunks) — the cached copy must
+            # stay pristine until an event invalidates it
+            return hit.clone() if hit is not None else None
+        token = mc.begin_fill()
+        entry = self.store.find_entry(path)
+        mc.fill_entry(path,
+                      entry.clone() if entry is not None else None,
+                      token)
+        return entry
 
     def delete_entry(self, path: str, recursive: bool = False,
                      delete_chunks: bool = True) -> None:
@@ -144,9 +267,21 @@ class Filer:
     def list_directory(self, path: str, start_file: str = "",
                        include_start: bool = False, limit: int = 1000,
                        prefix: str = "") -> list[Entry]:
-        return self.store.list_directory_entries(
-            normalize_path(path), start_file, include_start, limit,
-            prefix)
+        path = normalize_path(path)
+        mc = self.meta_cache
+        if mc is None:
+            return self.store.list_directory_entries(
+                path, start_file, include_start, limit, prefix)
+        from .meta_cache import _MISS
+        key = (path, start_file, include_start, limit, prefix)
+        hit = mc.lookup_list(key)
+        if hit is not _MISS:
+            return [e.clone() for e in hit]
+        token = mc.begin_fill()
+        entries = self.store.list_directory_entries(
+            path, start_file, include_start, limit, prefix)
+        mc.fill_list(key, [e.clone() for e in entries], token)
+        return entries
 
     def update_attrs(self, path: str, **kw) -> None:
         """Attribute-only UpdateEntry (filer.proto UpdateEntry with
@@ -305,6 +440,35 @@ class Filer:
             return self.append_chunks(path, length - 1, b"\x00")
         return entry
 
+    # chunk bodies over this size are never cached whole (a tiny view
+    # into a huge chunk must not stage the whole blob through memory
+    # to warm the cache) — the filer's own chunks are CHUNK_SIZE, so
+    # the default covers everything this filer wrote itself
+    CHUNK_CACHE_ITEM_MAX = CHUNK_SIZE
+
+    def _read_view(self, view) -> bytes:
+        """One ChunkView's bytes, through the hot chunk-body cache
+        when the blob is cache-worthy.  Chunk fids are immutable —
+        overwrites mint new fids — so cached bodies never need
+        invalidation, and serving a slice of a cached body replaces a
+        filer->volume network round trip with a memory copy."""
+        cc = self.chunk_cache
+        if cc is not None and 0 < view.chunk_size <= \
+                self.CHUNK_CACHE_ITEM_MAX:
+            body = cc.get(view.file_id)
+            if body is None:
+                # fetch the WHOLE chunk once (the reference mount
+                # caches whole chunks for the same reason: the next
+                # zipfian read wants a different slice of the same
+                # hot blob)
+                body = operation.read(self.master, view.file_id)
+                cc.set(view.file_id, body)
+            return body[view.chunk_offset:view.chunk_offset
+                        + view.size]
+        # ranged read: fetch only the view's bytes, not the chunk
+        return operation.read(self.master, view.file_id,
+                              view.chunk_offset, view.size)
+
     def read_file(self, path: str, offset: int = 0,
                   size: int | None = None) -> bytes:
         """Chunk-resolved ranged read (filer/stream.go:99)."""
@@ -319,12 +483,23 @@ class Filer:
             return b""
         out = bytearray(size)
         for view in view_from_chunks(entry.chunks, offset, size):
-            # ranged read: fetch only the view's bytes, not the chunk
-            piece = operation.read(self.master, view.file_id,
-                                   view.chunk_offset, view.size)
+            piece = self._read_view(view)
             lo = view.logical_offset - offset
             out[lo:lo + len(piece)] = piece
         return bytes(out)
+
+    def open_read_stream(self, entry: Entry, offset: int, size: int,
+                         on_close=None) -> "_ViewStream":
+        """File-like over [offset, offset+size) of `entry`'s content:
+        views are fetched lazily one at a time as httpd drains the
+        response, so a multi-GB filer GET holds at most ONE chunk in
+        memory instead of the whole body (the zero-copy audit's filer
+        fix; gaps read as zeros exactly like read_file).  `on_close`
+        runs when the server finishes the response (QoS byte
+        release)."""
+        views = view_from_chunks(entry.chunks, offset, size)
+        return _ViewStream(self, views, offset, size,
+                           on_close=on_close)
 
     # -- metadata subscription (filer/filer_notify.go) --------------------
 

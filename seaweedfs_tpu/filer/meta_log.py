@@ -37,6 +37,12 @@ from collections import deque
 
 from ..util.group_commit import CommitBarrier
 
+# per-process instance counter so every MetaLog over a shared dir owns
+# a distinct watermark file (two filers in one test process share a
+# pid; their logs must not clobber one file)
+_WM_SEQ_LOCK = threading.Lock()
+_WM_SEQ = [0]
+
 
 def _segment_name(ts_ns: int) -> "tuple[str, str]":
     """(day, minute) segment names, UTC — filer_notify_read.go:33
@@ -70,10 +76,50 @@ class MetaLog:
         self._durable_ts = 0
         self._barrier = CommitBarrier(self._group_commit_drain,
                                       site="filer.metalog")
+        # durable-ts WATERMARK file (the filer metadata cache's
+        # cross-instance coherence probe): this instance's group-commit
+        # leader stamps `.watermark.<pid>.<seq>` with its batch's last
+        # flushed ts, so a SIBLING MetaLog over the same dir (two
+        # filers sharing one sqlite store share its .metalog by
+        # construction) can ask "has anyone ELSE durably committed
+        # since my cache fills?" with tiny page-cached reads instead
+        # of replaying segments.  Own events don't need the file: the
+        # owning filer's cache is invalidated synchronously by its
+        # event listener.
+        self._wm_path: "str | None" = None
+        self._wm_last = 0
+        self._wm_names: "list[str]" = []
+        self._wm_listed = 0.0
         if self.dir:
             os.makedirs(self.dir, exist_ok=True)
             self._last_ts = self._scan_last_ts()
             self._durable_ts = self._last_ts
+            with _WM_SEQ_LOCK:
+                _WM_SEQ[0] += 1
+                seq = _WM_SEQ[0]
+            self._wm_path = os.path.join(
+                self.dir, f".watermark.{os.getpid()}.{seq}")
+            # adopt-and-prune: watermark files at or below the scanned
+            # history are redundant (the scan read those events); a
+            # LIVE sibling's file above the scan is kept verbatim.
+            # Only files untouched for a minute are prune candidates:
+            # a read-then-remove on an ACTIVE sibling's file could
+            # race its atomic advance and delete a value the sibling's
+            # monotonic guard won't republish until its next commit.
+            now = time.time()
+            for name in os.listdir(self.dir):
+                if not name.startswith(".watermark."):
+                    continue
+                p = os.path.join(self.dir, name)
+                try:
+                    if now - os.path.getmtime(p) < 60.0:  # noqa: SWFS011 — cross-process file-mtime age, wall clock is the only shared clock
+                        continue
+                    with open(p, encoding="ascii") as f:
+                        val = int(f.read(64).strip() or 0)
+                    if val <= self._last_ts:
+                        os.remove(p)
+                except (OSError, ValueError):
+                    continue
 
     # -- append -----------------------------------------------------------
 
@@ -115,6 +161,61 @@ class MetaLog:
         if batch:
             with self._lock:
                 self._durable_ts = max(self._durable_ts, batch[-1][0])
+            self._write_watermark(batch[-1][0])
+
+    def _write_watermark(self, ts: int) -> None:
+        """Publish the durable ts for sibling instances (one tiny
+        atomic file write per COMMIT WINDOW, not per event).  Barrier
+        leaders are serialized per instance, so the monotonic guard
+        needs no lock."""
+        if self._wm_path is None or ts <= self._wm_last:
+            return
+        self._wm_last = ts
+        tmp = f"{self._wm_path}.tmp"
+        try:
+            with open(tmp, "w", encoding="ascii") as f:
+                f.write(str(ts))
+            os.replace(tmp, self._wm_path)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    def foreign_watermark(self) -> int:
+        """Highest timestamp a SIBLING instance over this log dir has
+        durably flushed — the filer metadata cache's staleness probe.
+        A cache fill stamped before this value may pre-date a foreign
+        write, so the serve rule is `current foreign_watermark <=
+        fill stamp` ("never serve an entry older than the watermark
+        from cache").  Own events never appear here: the owning
+        filer's listener invalidates them synchronously.  0 when no
+        sibling has ever committed (single-filer fast path: the probe
+        is a memoized listdir once a second, no file reads)."""
+        if not self.dir:
+            return 0
+        now = time.monotonic()
+        if now - self._wm_listed > 1.0:
+            # new sibling instances appear rarely: re-list at most
+            # once a second, read the known files on every probe
+            own = os.path.basename(self._wm_path or "")
+            try:
+                self._wm_names = [
+                    n for n in os.listdir(self.dir)
+                    if n.startswith(".watermark.") and
+                    not n.endswith(".tmp") and n != own]
+            except OSError:
+                self._wm_names = []
+            self._wm_listed = now
+        best = 0
+        for name in self._wm_names:
+            try:
+                with open(os.path.join(self.dir, name),
+                          encoding="ascii") as f:
+                    best = max(best, int(f.read(64).strip() or 0))
+            except (OSError, ValueError):
+                continue
+        return best
 
     def _rotate(self, name: "tuple[str, str]") -> None:
         """Caller is the barrier leader (serialized)."""

@@ -220,8 +220,15 @@ class WeedFS:
             parent = path.rsplit("/", 1)[0] or "/"
             self._cache.pop(parent, None)
         if self.chunk_cache is not None:
-            # a changed file drops all of its cached data blocks
-            self.chunk_cache.invalidate_group(path)
+            # a changed file drops all of its cached data blocks —
+            # the meta-event subscription (_follow_events) is the only
+            # thing standing between the data-block cache and stale
+            # reads, so every event path lands here.  (Blocks cached
+            # by a PREVIOUS mount process are handled at the cache
+            # layer: DiskChunkCache never serves adopted leftovers,
+            # because the events that covered them died with the old
+            # process.)
+            self.chunk_cache.invalidate_path(path)
 
     def _follow_events(self) -> None:
         """Poll the filer's persistent metadata stream and invalidate

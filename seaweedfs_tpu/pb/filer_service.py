@@ -391,11 +391,22 @@ class FilerServicer:
         path = self._kv_path(request.key)
         if not request.value:
             self.filer.store.delete_entry(path)  # empty = delete
+            self._kv_invalidate(path)
             return pb.KvPutResponse()
         e = Entry(full_path=path, extended={
             CONTENT_XATTR: base64.b64encode(request.value).decode()})
         self.filer.store.insert_entry(e)
+        self._kv_invalidate(path)
         return pb.KvPutResponse()
+
+    def _kv_invalidate(self, path: str) -> None:
+        """KV mutations go straight to the store (no metadata event —
+        reference KV semantics); the filer metadata cache could have
+        cached the entry (or its absence) via an HTTP find/list over
+        the KV dir, so invalidate it explicitly."""
+        mc = self.filer.meta_cache
+        if mc is not None:
+            mc.invalidate(path)
 
     # -- distributed locks (lock ring) ---------------------------------
 

@@ -291,24 +291,55 @@ class AdmissionController:
             if wait > 0.0:
                 return _NOOP, RejectInfo("rate", wait)
             if nbytes > 0:
-                self._inflight[tenant] = cur + nbytes
-                released = [False]
-
-                def release():
-                    with self._lock:
-                        if not released[0]:
-                            released[0] = True
-                            left = self._inflight.get(tenant, 0) \
-                                - nbytes
-                            if left > 0:
-                                self._inflight[tenant] = left
-                            else:
-                                self._inflight.pop(tenant, None)
-                    _gauge_inflight(tenant,
-                                    self.inflight_of(tenant))
+                release = self._reserve_locked(tenant, nbytes)
                 _gauge_inflight(tenant, cur + nbytes)
                 return release, None
             return _NOOP, None
+
+    def _reserve_locked(self, tenant: str, nbytes: int):
+        """Record `nbytes` in flight (caller holds self._lock) and
+        return the idempotent release closure — the ONE copy of the
+        reservation bookkeeping shared by admit (request bodies) and
+        admit_bytes (response bodies)."""
+        self._inflight[tenant] = \
+            self._inflight.get(tenant, 0) + nbytes
+        released = [False]
+
+        def release():
+            with self._lock:
+                if released[0]:
+                    return
+                released[0] = True
+                left = self._inflight.get(tenant, 0) - nbytes
+                if left > 0:
+                    self._inflight[tenant] = left
+                else:
+                    self._inflight.pop(tenant, None)
+            _gauge_inflight(tenant, self.inflight_of(tenant))
+        return release
+
+    def admit_bytes(self, tenant: str, nbytes: int):
+        """In-flight-bytes-only admission for RESPONSE payloads (the
+        read path's half of the accounting: admission at the edge
+        meters request bodies via Content-Length, but a GET carries
+        its bytes in the RESPONSE — a hot-cache stampede would
+        otherwise ride the rate bucket alone and evade the byte
+        dimension entirely).  No rate token is spent: the request
+        already paid one at admission.  Returns (release, reject)."""
+        with self._lock:
+            cfg = self._config
+            if not cfg.enabled or nbytes <= 0:
+                return _NOOP, None
+            limit = cfg.limit_for(tenant)
+            if limit is None or not limit.inflight_mb:
+                return _NOOP, None
+            max_bytes = int(limit.inflight_mb * (1 << 20))
+            cur = self._inflight.get(tenant, 0)
+            if cur + nbytes > max_bytes:
+                return _NOOP, RejectInfo("inflight_bytes", 1.0)
+            release = self._reserve_locked(tenant, nbytes)
+        _gauge_inflight(tenant, cur + nbytes)
+        return release, None
 
     def inflight_of(self, tenant: str) -> int:
         with self._lock:
@@ -419,6 +450,57 @@ def install(http, role: str, path_prefix: str = "") -> None:
             None
 
     http.admission = admission
+
+
+class MeteredBody:
+    """File-like response body that runs a release callback when the
+    server finishes streaming it (httpd closes file-like payloads on
+    the response-write finally path) — how charge_response's in-flight
+    bytes stay held for exactly the duration of the response write."""
+
+    def __init__(self, data: bytes, release):
+        self._data = data
+        self._pos = 0
+        self._release = release
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            n = len(self._data) - self._pos
+        out = self._data[self._pos:self._pos + n]
+        self._pos += len(out)
+        return out
+
+    def close(self) -> None:
+        rel, self._release = self._release, None
+        if rel is not None:
+            rel()
+
+
+def charge_response(req, nbytes: int, role: str):
+    """Response-side in-flight-byte accounting for data-plane reads
+    (volume needle GETs, filer file GETs): charge the tenant's
+    in-flight-bytes bucket for the RESPONSE size, so a stampede of
+    concurrent large reads — cache hits included — is bounded by the
+    same dimension uploads are.  Returns (release, deny): deny is a
+    ready 503 response tuple when the tenant is over budget; release
+    must run when the response has been written (wrap the body in
+    MeteredBody, or call it on the buffered path).  Zero-cost when QoS
+    is unconfigured or the tenant has no byte limit."""
+    ctl = controller()
+    release, reject = ctl.admit_bytes(tenant_of(req), int(nbytes))
+    if reject is None:
+        # None release = unmetered (QoS off / no byte limit): callers
+        # skip the MeteredBody wrap entirely
+        return (None if release is _NOOP else release), None
+    from . import stats
+    stats.PROCESS.counter_add(
+        "qos_rejected_total", 1.0,
+        help_text="requests rejected by QoS admission",
+        tenant=tenant_of(req), role=role, reason="read_bytes")
+    retry_after = max(1, int(reject.retry_after + 0.999))
+    body = b'{"error": "qos: tenant over inflight_bytes limit"}'
+    return _NOOP, (503, (body, {"Retry-After": str(retry_after),
+                                "Content-Type": "application/json"}))
 
 
 # -- foreground p99 + feedback throttle ------------------------------------

@@ -1381,7 +1381,17 @@ class S3ApiServer:
             sse_key = check_read_key(entry.extended, lower)
         except SseError as e:
             return _error(e.status, e.code, str(e))
-        data = b"" if req.method == "HEAD" else \
+        # zero-copy plain-object GETs: no SSE transform means nothing
+        # needs the whole body in memory — stream chunk views lazily
+        # through the filer's hot chunk cache instead of buffering a
+        # multi-GB object per request (SWFS013's reason to exist).
+        # SSE-C/KMS objects still buffer: decryption wants the full
+        # ciphertext.
+        plain = sse_key is None and \
+            not entry.extended.get("sseKmsBlob")
+        stream_open = getattr(self.filer, "open_read_stream", None) \
+            if plain and req.method == "GET" else None
+        data = b"" if req.method == "HEAD" or stream_open else \
             self.filer.read_file(path)
         if sse_key is not None and data:
             data = decrypt_entry(sse_key, entry.extended, data)
@@ -1410,12 +1420,33 @@ class S3ApiServer:
         vid = entry.extended.get("versionId")
         if vid:
             headers["x-amz-version-id"] = vid
+        if stream_open is not None:
+            from ..server.httpd import parse_range
+            total = total_size(entry.chunks)
+            parsed = parse_range(req.headers.get("Range", ""), total)
+            if parsed == "unsatisfiable":
+                return 416, (b"", {"Content-Range":
+                                   f"bytes */{total}"})
+            start, size = parsed if parsed is not None else (0, total)
+            from .. import qos
+            release, deny = qos.charge_response(req, size, "s3")
+            if deny is not None:
+                return deny
+            body = stream_open(entry, start, size, on_close=release)
+            headers["Content-Length"] = str(size)
+            if parsed is not None:
+                headers["Content-Range"] = \
+                    f"bytes {start}-{start + size - 1}/{total}"
+                return 206, (body, headers)
+            return 200, (body, headers)
         if req.method == "GET":
-            # ranged GetObject (applied AFTER decryption — CTR mode
-            # could seek, but correctness first); shared parser keeps
-            # semantics identical with the filer paths
+            # ranged GetObject over the BUFFERED (SSE) path: ranges
+            # apply AFTER decryption — CTR mode could seek, but
+            # correctness first; shared parser keeps semantics
+            # identical with the filer paths
             from ..server.httpd import parse_range
             total = len(data)
+            status = 200
             parsed = parse_range(req.headers.get("Range", ""), total)
             if parsed == "unsatisfiable":
                 return 416, (b"", {"Content-Range":
@@ -1425,8 +1456,20 @@ class S3ApiServer:
                 data = data[start:start + size]
                 headers["Content-Range"] = \
                     f"bytes {start}-{start + len(data) - 1}/{total}"
-                headers["Content-Length"] = str(len(data))
-                return 206, (data, headers)
+                status = 206
+            # the buffered (SSE) read is the MOST expensive shape on
+            # the server — full ciphertext + plaintext resident — so
+            # it must spend the same in-flight-byte budget the
+            # streamed path does, not evade it
+            from .. import qos
+            release, deny = qos.charge_response(req, len(data), "s3")
+            if deny is not None:
+                return deny
+            headers["Content-Length"] = str(len(data))
+            if release is not None:
+                return status, (qos.MeteredBody(data, release),
+                                headers)
+            return status, (data, headers)
         return 200, (data, headers)
 
     def _get_object(self, req: Request, bucket: str, key: str,

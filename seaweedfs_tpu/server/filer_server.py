@@ -111,10 +111,28 @@ class FilerServer:
                 raise ValueError(f"unknown filer store type "
                                  f"{store_type!r} "
                                  f"(sqlite|lsm|redis|elastic)")
+            # the metadata cache's cross-filer coherence rides shared
+            # metalog watermark files: sqlite/lsm siblings share the
+            # store-derived dir by construction, redis/elastic
+            # siblings deliberately keep distinct dirs (PR 6) — so the
+            # cache defaults OFF for them (env =force overrides)
+            import os as _os
+
+            from ..util.chunk_cache import read_cache_disk
+            coherent = store_type not in ("redis", "elastic") or \
+                _os.environ.get("SEAWEEDFS_TPU_FILER_META_CACHE") == \
+                "force"
+            cache_dir, _ = read_cache_disk()
             self.filer = Filer(master, store,
                                collection=collection,
                                replication=replication,
-                               meta_log_dir=meta_log_dir)
+                               meta_log_dir=meta_log_dir,
+                               meta_cache=coherent,
+                               chunk_cache_dir=(
+                                   _os.path.join(
+                                       cache_dir,
+                                       f"filer{self.http.port}")
+                                   if cache_dir else None))
         except BaseException:
             # the listener above is already bound; a store-setup
             # failure must not leak a socket that accepts (and
@@ -416,17 +434,31 @@ class FilerServer:
             return 416, (b"", {"Content-Range": f"bytes */{file_size}"})
         if parsed is None:
             rng = ""  # absent/malformed: full body (RFC 9110)
-            offset, size = 0, None
+            offset, size = 0, file_size
         else:
+            # parse_range already clamps size within [1, total-offset]
             offset, size = parsed
-        data = self.filer.read_file(path, offset, size)
         mime = entry.attributes.mime or "application/octet-stream"
+        # response-side QoS byte metering (qos.charge_response): held
+        # for the whole response write, so a stampede of concurrent
+        # big reads — hot-cache hits included — is bounded by the
+        # tenant's in-flight-bytes budget like uploads are
+        from .. import qos
+        release, deny = qos.charge_response(req, size, "filer")
+        if deny is not None:
+            return deny
+        # stream, never buffer: views fetch lazily as the response
+        # drains (through the hot chunk cache), so a multi-GB GET
+        # holds one chunk in memory, not the file
+        body = self.filer.open_read_stream(entry, offset, size,
+                                           on_close=release)
+        headers = {"Content-Type": mime,
+                   "Content-Length": str(size)}
         if rng:
-            end = offset + len(data) - 1
-            return 206, (data, {
-                "Content-Type": mime,
-                "Content-Range": f"bytes {offset}-{end}/{file_size}"})
-        return 200, (data, mime)
+            headers["Content-Range"] = \
+                f"bytes {offset}-{offset + size - 1}/{file_size}"
+            return 206, (body, headers)
+        return 200, (body, headers)
 
     def _get_remote(self, req: Request, path: str, entry):
         """Read-through for uncached remote-mounted entries

@@ -269,6 +269,7 @@ class HttpServer:
                     parent=parent_span, trace_id=rid)
                 status = 0
                 qos_release = None
+                stream_cleanup = None   # file-like response body
                 with outer._inflight_lock:
                     outer._inflight += 1
                     inflight = outer._inflight
@@ -338,6 +339,14 @@ class HttpServer:
                         body = payload if isinstance(payload, bytes) \
                             else str(payload).encode()
                         ctype = "application/octet-stream"
+                    if hasattr(body, "read"):
+                        # register for the OUTER finally: a header
+                        # write dying on a reset connection would
+                        # otherwise skip the stream branch's own
+                        # close, leaking the body's resources (fd,
+                        # QoS in-flight bytes riding close()) —
+                        # close() is idempotent on every body type
+                        stream_cleanup = body
                     self.send_response(status)
                     self.send_header("Content-Type", ctype)
                     self.send_header(_RID_HEADER, rid)
@@ -392,6 +401,11 @@ class HttpServer:
                     if req.method != "HEAD":
                         self.wfile.write(body)
                 finally:
+                    if stream_cleanup is not None:
+                        try:
+                            stream_cleanup.close()
+                        except OSError:
+                            pass   # cleanup must never break a reply
                     if qos_release is not None:
                         try:
                             qos_release()

@@ -33,6 +33,33 @@ _TTL_INCOMPLETE = 11.0
 _TTL_FULL = 37 * 60.0
 _TTL_ENOUGH = 7 * 60.0
 
+# degraded-read latency histogram: loopback slice decode sits well
+# under DEFAULT_BUCKETS' floor, WAN survivor fan-outs above it
+_DEGRADED_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _degraded_enabled() -> bool:
+    """``SEAWEEDFS_TPU_EC_DEGRADED_READS`` kill switch (default on):
+    an operator riding out a cascading failure can turn the d-way
+    survivor fan-outs into fast 404s instead of amplifying load."""
+    import os
+    return os.environ.get("SEAWEEDFS_TPU_EC_DEGRADED_READS",
+                          "1") not in ("0", "false")
+
+
+def _degraded_stream_bytes() -> int:
+    """Window size for the STREAMED degraded path; intervals at or
+    under one window keep the one-shot latency shape
+    (``SEAWEEDFS_TPU_DEGRADED_SLICE_MB``, default 1)."""
+    import os
+    try:
+        mb = float(os.environ.get("SEAWEEDFS_TPU_DEGRADED_SLICE_MB",
+                                  "") or 1.0)
+    except ValueError:
+        mb = 1.0
+    return max(int(mb * (1 << 20)), 4 << 10)
+
 
 class _ShardLocationCache:
     def __init__(self):
@@ -62,14 +89,22 @@ class EcReader:
     def read_needle(self, ev: EcVolume, needle_id: int,
                     cookie: int | None = None) -> Needle:
         """store_ec.go:141 ReadEcShardNeedle: the local read path with
-        this reader's scatter/reconstruct interval resolution."""
-        return ev.read_needle_with(
-            lambda iv: self._read_interval(ev, needle_id, iv),
+        this reader's scatter/reconstruct interval resolution.  The
+        returned needle is tagged `was_degraded` when any interval
+        reconstructed — the volume server's hot-cache promotion policy
+        (SEAWEEDFS_TPU_DEGRADED_PROMOTE) keys off it."""
+        degraded = [False]
+        n = ev.read_needle_with(
+            lambda iv: self._read_interval(ev, needle_id, iv,
+                                           degraded),
             needle_id, cookie=cookie)
+        n.was_degraded = degraded[0]
+        return n
 
     # -- interval resolution ---------------------------------------------
 
-    def _read_interval(self, ev: EcVolume, needle_id: int, iv) -> bytes:
+    def _read_interval(self, ev: EcVolume, needle_id: int, iv,
+                       degraded: "list | None" = None) -> bytes:
         sid, off = iv.to_shard_id_and_offset(
             LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, ev.ctx.data_shards)
         # 1. local
@@ -87,11 +122,40 @@ class EcReader:
         # it countable (the SLO difference between "one dead peer" and
         # "every read pays a d-way fan-out" lives in this counter)
         from .. import stats
+        if not _degraded_enabled():
+            raise NotFoundError(
+                f"volume {ev.id}: shard {sid} unreachable and degraded "
+                f"reads are disabled")
+        if degraded is not None:
+            degraded[0] = True
         stats.PROCESS.counter_add(
             "ec_degraded_reads_total", 1.0,
             help_text="needle reads served by interval reconstruction "
                       "instead of a direct shard read", vid=ev.id)
-        return self._recover_interval(ev, sid, off, iv.size)
+        t0 = time.perf_counter()
+        try:
+            step = _degraded_stream_bytes()
+            if iv.size > step:
+                # large interval: decode-on-read in slice windows
+                # through the GF kernel — survivor fetch overlaps the
+                # matrix apply (arXiv:1908.01527 repair pipelining
+                # applied to the READ path), nothing is written to
+                # disk, and memory stays bounded at d x window
+                try:
+                    return self._recover_interval_streamed(
+                        ev, sid, off, iv.size, locs, step)
+                except (OSError, ValueError, KeyError):
+                    # a survivor died mid-stream past its internal
+                    # failover: the one-shot path below re-plans from
+                    # everything reachable rather than failing the read
+                    pass
+            return self._recover_interval(ev, sid, off, iv.size)
+        finally:
+            stats.PROCESS.histogram_observe(
+                "ec_degraded_read_seconds",
+                time.perf_counter() - t0, buckets=_DEGRADED_BUCKETS,
+                help_text="wall time of degraded (reconstructing) "
+                          "needle interval reads")
 
     def _remote_read(self, url: str, vid: int, sid: int, offset: int,
                      size: int) -> bytes | None:
@@ -145,6 +209,98 @@ class EcReader:
                     cache.locations[sid] = \
                         [u for u in urls if u != dead_url]
             cache.refreshed = 0.0
+
+    def _recover_interval_streamed(self, ev: EcVolume,
+                                   missing_sid: int, offset: int,
+                                   size: int, locs: dict,
+                                   step: int) -> bytes:
+        """Streamed decode-on-read for one lost-shard interval: pick d
+        survivors (local shards free, remote donors round-robined),
+        stream ONLY the requested byte range in slice windows through
+        the cached reconstruction matrix, and return the missing
+        shard's bytes for that range.  The same seams as the rebuild
+        pipeline (`MultiSourceFetcher` prefetch + `apply_matrix_lazy`
+        when the codec stages launches), but the only output is the
+        response — no shard file is written, no full rebuild runs in
+        the request path."""
+        from ..ops import rs_matrix
+        from ..storage.erasure_coding.shard_source import (
+            LocalShardSource, MultiSourceFetcher, RemoteShardSource)
+        d = ev.ctx.data_shards
+        total = ev.ctx.total
+        sources: dict[int, object] = {}
+        with ev.lock:
+            local = {sid: s.path for sid, s in ev.shards.items()}
+        try:
+            for sid in sorted(local):
+                if sid != missing_sid and len(sources) < d:
+                    sources[sid] = LocalShardSource(local[sid])
+            if len(sources) < d:
+                # remote rows round-robined across donors, like the
+                # rebuild planner: no single peer's disk serializes
+                # the fetch streams
+                by_donor: dict[str, list[int]] = {}
+                for sid in sorted(locs):
+                    if sid == missing_sid or sid in sources or \
+                            sid >= total or not locs[sid]:
+                        continue
+                    by_donor.setdefault(locs[sid][0], []).append(sid)
+                tiers = list(by_donor.values())
+                i = 0
+                while len(sources) < d and any(tiers):
+                    tier = tiers[i % len(tiers)]
+                    if tier:
+                        sid = tier.pop(0)
+                        sources[sid] = RemoteShardSource(
+                            locs[sid], ev.id, sid,
+                            headers=self._security_headers)
+                    i += 1
+            if len(sources) < d:
+                raise NotFoundError(
+                    f"volume {ev.id}: only {len(sources)} shards "
+                    f"reachable, need {d} to recover shard "
+                    f"{missing_sid}")
+            present = tuple(sid in sources for sid in range(total))
+            mat, survivor_rows = \
+                rs_matrix.cached_reconstruction_matrix(
+                    d, ev.ctx.parity_shards, present, (missing_sid,))
+            used = {sid: sources[sid] for sid in survivor_rows}
+            for sid, src in sources.items():
+                if sid not in used:
+                    src.close()
+            sources = used
+        except BaseException:
+            for src in sources.values():
+                src.close()
+            raise
+        work = [(offset + pos, min(step, size - pos))
+                for pos in range(0, size, step)]
+        codec = self._codec(d, ev.ctx.parity_shards)
+        lazy = getattr(codec, "apply_matrix_lazy", None)
+        out = bytearray(size)
+        fetcher = MultiSourceFetcher(used, work)
+        try:
+            buf = None
+            for pos, n in work:
+                if buf is None or buf.shape != (len(survivor_rows), n):
+                    buf = np.empty((len(survivor_rows), n),
+                                   dtype=np.uint8)
+                filled = fetcher.get(
+                    (pos, n),
+                    rows={sid: memoryview(buf[row])
+                          for row, sid in enumerate(survivor_rows)})
+                for row, sid in enumerate(survivor_rows):
+                    got = filled[sid]
+                    if got < n:
+                        buf[row, got:] = 0  # EOF zero-padding
+                rec = lazy(mat, buf) if lazy is not None \
+                    else codec.apply_matrix(mat, buf)
+                rec = np.asarray(rec, dtype=np.uint8)
+                lo = pos - offset
+                out[lo:lo + n] = rec[0, :n].tobytes()
+        finally:
+            fetcher.close()
+        return bytes(out)
 
     def _recover_interval(self, ev: EcVolume, missing_sid: int,
                           offset: int, size: int) -> bytes:

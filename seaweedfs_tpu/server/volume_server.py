@@ -111,6 +111,31 @@ class VolumeServer:
         self.ec_reader = EcReader(
             master, self.http.url,
             security_headers=lambda: self.security.admin_headers())
+        # hot-needle cache (util/chunk_cache promoted server-side, the
+        # reference's chunk_cache role at the volume tier): repeated
+        # reads of a hot needle skip the index lookup + .dat read (and
+        # for EC volumes the whole interval/degraded resolution).  Keys
+        # carry a per-volume generation so compact-swap / merge /
+        # unmount invalidate wholesale without enumerating needles;
+        # write/delete invalidate their needle's group point-wise.
+        from ..util.chunk_cache import (TieredChunkCache, read_cache_mb,
+                                        read_cache_disk)
+        mb = read_cache_mb(64)
+        disk_dir, disk_mb = read_cache_disk()
+        self.needle_cache = TieredChunkCache(
+            mem_limit=mb << 20,
+            disk_dir=(os.path.join(disk_dir, f"vol{self.http.port}")
+                      if disk_dir else None),
+            disk_limit=disk_mb << 20,
+            name="volume_needle") if mb > 0 else None
+        self._nc_gen: dict[int, int] = {}
+        self._nc_gen_lock = threading.Lock()
+        # fill/invalidate race guard: a GET that read the store BEFORE
+        # a write landed must not cache its (now stale) needle AFTER
+        # the write's invalidation ran — fills carry the epoch they
+        # began at and land only if no invalidation intervened (the
+        # same rule the filer metadata cache enforces)
+        self._nc_epoch = 0
         from ..stats import Metrics
         self.metrics = Metrics("volume_server")
         self.http.role = "volume"        # tracing + request_seconds
@@ -276,13 +301,80 @@ class VolumeServer:
 
     def _rp_drop_volume(self, vid: int) -> None:
         """Forget a volume in the read plane (vacuum swapped the .dat,
-        or the volume is gone); live needles lazily re-register."""
+        or the volume is gone); live needles lazily re-register.  The
+        hot-needle cache drops the volume too — every caller of this
+        is a point where the .dat is swapped, merged, or unmounted."""
+        self._nc_drop_volume(vid)
         if self.read_plane is not None:
             with self._rp_lock:
                 self._rp_gen[vid] = self._rp_gen.get(vid, 0) + 1
                 self.read_plane.remove_volume(vid)
                 self._rp_volumes.discard(vid)
                 self._rp_seen.pop(vid, None)
+
+    # -- hot-needle cache (util/chunk_cache server tier) ------------------
+
+    def _nc_key(self, vid: int, key: int, cookie: int) -> str:
+        with self._nc_gen_lock:
+            gen = self._nc_gen.get(vid, 0)
+        return f"{vid}.g{gen}.{key:x}.{cookie:08x}"
+
+    def _nc_get(self, fid: types.FileId) -> "tuple[str, bytes] | None":
+        """Cached (mime, data) for a needle, or None.  The cookie is
+        part of the key: a wrong-cookie request misses and takes the
+        store path, which raises the CookieMismatch the cache must not
+        paper over."""
+        if self.needle_cache is None:
+            return None
+        blob = self.needle_cache.get(
+            self._nc_key(fid.volume_id, fid.key, fid.cookie))
+        if blob is None:
+            return None
+        mlen = int.from_bytes(blob[:2], "big")
+        return blob[2:2 + mlen].decode(), blob[2 + mlen:]
+
+    def _nc_put(self, fid: types.FileId, n,
+                token: "int | None" = None) -> None:
+        """Promote a freshly read needle.  TTL'd needles stay out (the
+        cache has no expiry clock of its own), as do bodies over the
+        memory tier's bound (MemChunkCache skips them anyway).
+        `token` is the epoch the fill's store read began at — a fill
+        racing an invalidation is discarded, never resurrected."""
+        if self.needle_cache is None or n.has_ttl():
+            return
+        if token is not None and token != self._nc_epoch:
+            return
+        mime = n.mime.decode() if n.mime else "application/octet-stream"
+        blob = len(mime.encode()).to_bytes(2, "big") + \
+            mime.encode() + bytes(n.data)
+        key = self._nc_key(fid.volume_id, fid.key, fid.cookie)
+        self.needle_cache.set(key, blob,
+                              group=f"{fid.volume_id}.{fid.key:x}")
+        # the pre-set epoch check alone is not atomic with set(): an
+        # invalidation completing in between would wipe the group
+        # BEFORE our key joined it, resurrecting the stale needle.
+        # Re-verify after the insert and undo our own fill — one of
+        # the two (group wipe or this delete) always removes it.
+        if token is not None and token != self._nc_epoch:
+            self.needle_cache.delete(key)
+
+    def _nc_invalidate_needle(self, vid: int, key: int) -> None:
+        """Point invalidation for one needle (every cookie spelling:
+        the group is keyed without the cookie, so an admin delete that
+        carries none still clears it)."""
+        if self.needle_cache is not None:
+            with self._nc_gen_lock:
+                self._nc_epoch += 1
+            self.needle_cache.invalidate_group(f"{vid}.{key:x}")
+
+    def _nc_drop_volume(self, vid: int) -> None:
+        """Wholesale invalidation by generation bump: old keys become
+        unreachable and age out of the LRU (compact-swap, merge,
+        unmount, delete, ec_to_volume, received .dat)."""
+        if self.needle_cache is not None:
+            with self._nc_gen_lock:
+                self._nc_epoch += 1
+                self._nc_gen[vid] = self._nc_gen.get(vid, 0) + 1
 
     def stop(self):
         self._hb_stop.set()
@@ -391,7 +483,7 @@ class VolumeServer:
             return 401, {"error": err}
         if req.method in ("GET", "HEAD"):
             return self._get_needle(fid, req.headers.get("Range", ""),
-                                    req.query)
+                                    req.query, req=req)
         if req.method in ("POST", "PUT"):
             # body deliberately untouched here: the first read happens
             # inside _put_needle's "recv" stage so the decomposition
@@ -415,18 +507,32 @@ class VolumeServer:
                      "text/plain; version=0.0.4")
 
     def _get_needle(self, fid: types.FileId, rng: str = "",
-                    query: "dict | None" = None):
-        try:
-            n = self.store.read_needle(fid.volume_id, fid.key,
-                                       cookie=fid.cookie,
-                                       ec_reader=self.ec_reader)
-        except KeyError:
-            return 404, {"error": "not found"}
-        except ValueError as e:
-            return 404, {"error": str(e)}
-        self._rp_register(fid.volume_id, n, lazy=True)  # plane warm
-        mime = n.mime.decode() if n.mime else "application/octet-stream"
-        data = n.data
+                    query: "dict | None" = None, req=None):
+        cached = self._nc_get(fid)
+        if cached is not None:
+            mime, data = cached
+        else:
+            token = self._nc_epoch    # BEFORE the store read
+            try:
+                n = self.store.read_needle(fid.volume_id, fid.key,
+                                           cookie=fid.cookie,
+                                           ec_reader=self.ec_reader)
+            except KeyError:
+                return 404, {"error": "not found"}
+            except ValueError as e:
+                return 404, {"error": str(e)}
+            self._rp_register(fid.volume_id, n, lazy=True)  # plane warm
+            if not getattr(n, "was_degraded", False) or \
+                    os.environ.get("SEAWEEDFS_TPU_DEGRADED_PROMOTE",
+                                   "1") not in ("0", "false"):
+                # degraded decodes are promoted by default (the
+                # zipfian payoff: first read pays the d-way fan-out,
+                # the rest hit memory) — the knob opts a cluster out
+                # when decode results must never occupy cache
+                self._nc_put(fid, n, token=token)
+            mime = n.mime.decode() if n.mime \
+                else "application/octet-stream"
+            data = n.data
         if query and ("width" in query or "height" in query):
             # resize-on-read (volume_server_handlers_read.go:353 ->
             # images/resizing.go)
@@ -438,6 +544,24 @@ class VolumeServer:
                 w = h = 0
             data = images.resized(data, mime, w, h,
                                   query.get("mode", ""))
+        # response-side QoS byte metering (qos.charge_response): a
+        # cache-hit stampede spends the tenant's in-flight-bytes
+        # budget exactly like a store-read would — the hot cache must
+        # not be a QoS bypass
+        def _serve(status: int, body: bytes, headers: dict):
+            if req is not None:
+                from .. import qos
+                release, deny = qos.charge_response(req, len(body),
+                                                    "volume")
+                if deny is not None:
+                    return deny
+                if release is not None:
+                    headers = {**headers,
+                               "Content-Length": str(len(body))}
+                    return status, (qos.MeteredBody(body, release),
+                                    headers)
+            return status, (body, headers)
+
         # ranged needle reads keep the filer's chunk-view reads from
         # overfetching whole chunks (volume_server_handlers_read.go
         # serves Range on the data path)
@@ -452,14 +576,14 @@ class VolumeServer:
                     start = total - min(int(hi), total)
                     stop = total
                 part = data[start:stop]
-                return 206, (part, {
+                return _serve(206, part, {
                     "Content-Type": mime,
                     "Content-Range":
                         f"bytes {start}-{start + len(part) - 1}"
                         f"/{total}"})
             except ValueError:
                 pass
-        return 200, (data, mime)
+        return _serve(200, data, {"Content-Type": mime})
 
     def _put_needle(self, fid: types.FileId, req: Request):
         # write-path latency decomposition (profiling.py): the track
@@ -499,6 +623,7 @@ class VolumeServer:
             return 404, {"error": f"volume {fid.volume_id} not found"}
         except PermissionError as e:
             return 409, {"error": str(e)}
+        self._nc_invalidate_needle(fid.volume_id, fid.key)
         with profiling.stage("register"):
             self._rp_enqueue(fid.volume_id, n)
         # synchronous replication fan-out
@@ -529,6 +654,10 @@ class VolumeServer:
                 fid.volume_id, Needle(cookie=fid.cookie, id=fid.key))
         except KeyError:
             freed = None
+        # AFTER the store mutation (like _put_needle): invalidating
+        # first would let a concurrent GET re-cache the pre-delete
+        # needle with no later invalidation ever coming
+        self._nc_invalidate_needle(fid.volume_id, fid.key)
         # deletes fan out like writes (store_replicate.go:142
         # ReplicatedDelete; EC: store_ec_delete.go:38) — a delete lost on
         # one holder would leave the object readable there via the read
@@ -900,7 +1029,7 @@ class VolumeServer:
             return 404, {"error": f"volume {vid} not found"}
         v.sync()
         with open(v.file_name(".idx"), "rb") as f:
-            live = idxmod.live_entries(f.read())
+            live = idxmod.live_entries(f.read())  # noqa: SWFS013 — admin repair inventory: live_entries needs the whole .idx (16B/needle), no byte response to stream
         return 200, {"volumeId": vid,
                      "entries": sorted((k, s)
                                        for k, (_o, s) in live.items())}
@@ -919,11 +1048,14 @@ class VolumeServer:
         try:
             n = v.read_needle(key)
         except KeyError:
+            self._nc_invalidate_needle(vid, key)
             return 200, {"freed": 0}
         try:
             freed = v.delete_needle(n)
         except PermissionError as e:
             return 409, {"error": str(e)}
+        # after the mutation, same ordering rule as _delete_needle
+        self._nc_invalidate_needle(vid, key)
         return 200, {"freed": freed}
 
     def _needle_raw(self, req: Request):
@@ -961,6 +1093,7 @@ class VolumeServer:
             # struct.error: truncated body/CRC tail is not a ValueError
             return 400, {"error": f"bad needle record: {e}"}
         size, _ = self.store.write_needle(vid, n)
+        self._nc_invalidate_needle(vid, n.id)
         self._rp_register(vid, n)
         return 200, {"size": size}
 
@@ -1005,6 +1138,10 @@ class VolumeServer:
         except ValueError as e:
             return 400, {"error": str(e)}
         base = self._base_path(vid, collection)
+        if ext in (".dat", ".idx"):
+            # a pushed data/index file replaces volume content under
+            # any cached needles
+            self._nc_drop_volume(vid)
         n = 0
         # temp + rename, like the gRPC ReceiveFile twin: a push that
         # dies mid-stream (or whose relay SOURCE dies — http_relay
@@ -1149,7 +1286,7 @@ class VolumeServer:
         vif_before: "bytes | None" = None
         try:
             with open(base + ".vif", "rb") as vf:
-                vif_before = vf.read()
+                vif_before = vf.read()  # noqa: SWFS013 — .vif sidecar, format-bounded to a few hundred bytes
         except OSError:
             pass
         ec_encoder.write_sorted_file_from_idx(base)      # .ecx first!
@@ -1184,7 +1321,7 @@ class VolumeServer:
             for ext in (".ecx", ".vif", ".ecj"):
                 if os.path.exists(base + ext):  # .ecj: post-encode
                     with open(base + ext, "rb") as sf:
-                        sidecars.append((ext, sf.read()))
+                        sidecars.append((ext, sf.read()))  # noqa: SWFS013 — encode-plane sidecars (.ecx/.vif/.ecj) pushed whole by protocol, bounded by needle count
 
             def push_sidecars(url: str) -> None:
                 try:

@@ -1091,8 +1091,46 @@ def _gauge(metrics: "dict[str, list]", name: str,
     return None
 
 
-def _counter_sum(metrics: "dict[str, list]", name: str) -> float:
-    return sum(v for _l, v in metrics.get(name, []))
+def _counter_sum(metrics: "dict[str, list]", name: str,
+                 match: "dict | None" = None) -> float:
+    match = match or {}
+    return sum(v for l, v in metrics.get(name, [])
+               if all(l.get(k) == mv for k, mv in match.items()))
+
+
+def _read_cache_report(before: "dict[str, list]",
+                       after: "dict[str, list]") -> str:
+    """Per-cache hot-read-cache view over the sampling window: hit
+    ratio + bytes served from cache (util/chunk_cache meters on the
+    shared registry).  Empty when no instrumented cache was touched."""
+    caches = {l.get("cache", "") for name in
+              ("seaweedfs_tpu_read_cache_hits_total",
+               "seaweedfs_tpu_read_cache_misses_total")
+              for l, _v in after.get(name, [])}
+    parts = []
+    for c in sorted(caches):
+        hits = _counter_sum(
+            after, "seaweedfs_tpu_read_cache_hits_total",
+            {"cache": c}) - _counter_sum(
+            before, "seaweedfs_tpu_read_cache_hits_total",
+            {"cache": c})
+        misses = _counter_sum(
+            after, "seaweedfs_tpu_read_cache_misses_total",
+            {"cache": c}) - _counter_sum(
+            before, "seaweedfs_tpu_read_cache_misses_total",
+            {"cache": c})
+        if hits + misses <= 0:
+            continue
+        served = _counter_sum(
+            after, "seaweedfs_tpu_read_cache_bytes_served_total",
+            {"cache": c}) - _counter_sum(
+            before, "seaweedfs_tpu_read_cache_bytes_served_total",
+            {"cache": c})
+        parts.append(f"{c} {hits / (hits + misses) * 100:.0f}% "
+                     f"({served / (1 << 20):.1f}MB served)")
+    if not parts:
+        return ""
+    return "read-cache: " + "  ".join(parts)
 
 
 def _stage_report(before: "dict[str, list]", after: "dict[str, list]",
@@ -1246,6 +1284,15 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
             if wins > 0:
                 line += f"  windows={wins:.0f}"
             out.append(line)
+        cache_line = _read_cache_report(b or {}, a)
+        degraded = _counter_sum(
+            a, "seaweedfs_tpu_ec_degraded_reads_total") - \
+            _counter_sum(b or {}, "seaweedfs_tpu_ec_degraded_reads_total")
+        if degraded > 0:
+            cache_line += ("  " if cache_line else "") + \
+                f"degraded-reads={degraded:.0f}"
+        if cache_line:
+            out.append("  " + cache_line)
         stages = _stage_report(b or {}, a, ns)
         if stages:
             out.append("  " + stages)

@@ -1,13 +1,24 @@
 """Tiered chunk cache (weed/util/chunk_cache/chunk_cache.go):
-a memory LRU in front of a bounded on-disk cache, used by the mount's
-read path so repeated reads of hot file blocks never re-cross the
-network (the reference mounts read chunks through the same two tiers,
-chunk_cache.go:113 ReadChunkAt — memory first, then disk layers).
+a memory LRU in front of a bounded on-disk cache.  Originally the
+mount's private read helper; now the SHARED hot-data cache of the
+whole read plane — the volume server's hot-needle cache and the
+filer's chunk-body cache are the same two tiers under different key
+schemes (the reference serves mount reads through TieredChunkCache
+the same way, chunk_cache.go:113 ReadChunkAt — memory first, then
+disk layers).
 
-Keys are opaque strings (the mount uses "<path>@<block>"); per-path
-key tracking supports invalidation when a file changes under the
-cache (the mount's meta-event subscription drives this, the analog of
-the reference wiping its chunk cache on metadata updates)."""
+Keys are opaque strings (the mount uses "<path>@<block>", the volume
+server "<vid>.g<gen>.<fid>", the filer a chunk fid); per-group key
+tracking supports invalidation when a file/needle changes under the
+cache (the mount's meta-event subscription and the volume server's
+write/delete hooks drive this, the analog of the reference wiping its
+chunk cache on metadata updates).
+
+Instrumented caches (``name=`` set) count hits/misses/evictions into
+the shared stats.PROCESS registry, so every role's /metrics exposes
+``seaweedfs_tpu_read_cache_{hits,misses,evictions}_total{cache=...}``
+plus ``read_cache_bytes{cache=...,tier=...}`` occupancy gauges —
+cluster.top renders the hit ratio from exactly these counters."""
 
 from __future__ import annotations
 
@@ -17,14 +28,78 @@ import threading
 from collections import OrderedDict
 
 
+def read_cache_mb(default: int = 64) -> int:
+    """The shared knob for the server-side caches'  memory tier
+    (``SEAWEEDFS_TPU_READ_CACHE_MB``, 0 disables)."""
+    try:
+        return int(os.environ.get("SEAWEEDFS_TPU_READ_CACHE_MB", "")
+                   or default)
+    except ValueError:
+        return default
+
+
+def read_cache_disk() -> "tuple[str | None, int]":
+    """(dir, limit_mb) for the optional disk tier
+    (``SEAWEEDFS_TPU_READ_CACHE_DIR`` / ``_DISK_MB``)."""
+    d = os.environ.get("SEAWEEDFS_TPU_READ_CACHE_DIR", "") or None
+    try:
+        mb = int(os.environ.get("SEAWEEDFS_TPU_READ_CACHE_DISK_MB", "")
+                 or 1024)
+    except ValueError:
+        mb = 1024
+    return d, mb
+
+
+class _CacheMeter:
+    """PROCESS-registry emission for one named cache.  A None name is
+    the uninstrumented (zero-overhead beyond a truthiness check) mode
+    the mount's original usage keeps."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: "str | None"):
+        self.name = name
+
+    def count(self, which: str, n: float = 1.0) -> None:
+        if not self.name:
+            return
+        _process().counter_add(
+            f"read_cache_{which}_total", n,
+            help_text=f"hot read-cache {which} (shared tier, "
+                      f"util/chunk_cache)", cache=self.name)
+
+    def bytes_served(self, n: int) -> None:
+        if not self.name or n <= 0:
+            return
+        _process().counter_add(
+            "read_cache_bytes_served_total", float(n),
+            help_text="bytes answered from the hot read cache instead "
+                      "of disk/network", cache=self.name)
+
+    def occupancy(self, tier: str, nbytes: int) -> None:
+        if not self.name:
+            return
+        _process().gauge_set(
+            "read_cache_bytes", float(nbytes),
+            help_text="bytes resident in the hot read cache",
+            cache=self.name, tier=tier)
+
+
+def _process():
+    from .. import stats
+    return stats.PROCESS
+
+
 class MemChunkCache:
     """Byte-bounded LRU (chunk_cache_in_memory.go)."""
 
-    def __init__(self, limit_bytes: int = 64 << 20):
+    def __init__(self, limit_bytes: int = 64 << 20,
+                 meter: "_CacheMeter | None" = None):
         self.limit = limit_bytes
         self._m: "OrderedDict[str, bytes]" = OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
+        self._meter = meter or _CacheMeter(None)
 
     def get(self, key: str) -> "bytes | None":
         with self._lock:
@@ -36,6 +111,7 @@ class MemChunkCache:
     def set(self, key: str, data: bytes) -> None:
         if len(data) > self.limit:
             return
+        evicted = 0
         with self._lock:
             old = self._m.pop(key, None)
             if old is not None:
@@ -45,6 +121,11 @@ class MemChunkCache:
             while self._bytes > self.limit and self._m:
                 _k, v = self._m.popitem(last=False)
                 self._bytes -= len(v)
+                evicted += 1
+            nbytes = self._bytes
+        if evicted:
+            self._meter.count("evictions", evicted)
+        self._meter.occupancy("mem", nbytes)
 
     def delete(self, key: str) -> None:
         with self._lock:
@@ -57,22 +138,32 @@ class DiskChunkCache:
     """Bounded on-disk tier (chunk_cache_on_disk.go, simplified to one
     layer): chunk files under a cache dir, LRU-evicted by in-process
     access order.  Survives nothing — it's a cache; a fresh process
-    starts cold and stray files from a previous run are clipped by the
-    same eviction."""
+    starts COLD: stray files from a previous run are adopted for byte
+    accounting (so the dir never outgrows its bound across restarts)
+    but are NEVER servable until re-written by this process.  Serving
+    them would be a stale-read hole — the invalidation events that
+    covered them died with the old process (the mount's meta-event
+    cursor starts at boot time, so a file changed while the mount was
+    down would keep serving pre-change blocks forever)."""
 
-    def __init__(self, dir_path: str, limit_bytes: int = 1 << 30):
+    def __init__(self, dir_path: str, limit_bytes: int = 1 << 30,
+                 meter: "_CacheMeter | None" = None):
         self.dir = dir_path
         self.limit = limit_bytes
         os.makedirs(dir_path, exist_ok=True)
         self._lock = threading.Lock()
         self._order: "OrderedDict[str, int]" = OrderedDict()
         self._bytes = 0
-        for name in os.listdir(dir_path):  # adopt leftovers
+        self._meter = meter or _CacheMeter(None)
+        # adopted leftovers: eviction fodder only (see class doc)
+        self._stale: set[str] = set()
+        for name in os.listdir(dir_path):
             p = os.path.join(dir_path, name)
             if os.path.isfile(p):
                 sz = os.path.getsize(p)
                 self._order[name] = sz
                 self._bytes += sz
+                self._stale.add(name)
         self._evict_locked()
 
     def _fname(self, key: str) -> str:
@@ -81,12 +172,15 @@ class DiskChunkCache:
     def get(self, key: str) -> "bytes | None":
         name = self._fname(key)
         with self._lock:
-            if name not in self._order:
+            if name not in self._order or name in self._stale:
                 return None
             self._order.move_to_end(name)
         try:
             with open(os.path.join(self.dir, name), "rb") as f:
-                return f.read()
+                # bound the read to what set() could have written: a
+                # file swapped under the cache must not buffer
+                # unbounded bytes through this process (SWFS013 rule)
+                return f.read(self.limit)
         except OSError:
             with self._lock:
                 self._bytes -= self._order.pop(name, 0)
@@ -108,15 +202,19 @@ class DiskChunkCache:
                 pass
             return
         with self._lock:
+            self._stale.discard(name)
             self._bytes -= self._order.pop(name, 0)
             self._order[name] = len(data)
             self._bytes += len(data)
             self._evict_locked()
+            nbytes = self._bytes
+        self._meter.occupancy("disk", nbytes)
 
     def delete(self, key: str) -> None:
         name = self._fname(key)
         with self._lock:
             self._bytes -= self._order.pop(name, 0)
+            self._stale.discard(name)
         try:
             os.remove(os.path.join(self.dir, name))
         except OSError:
@@ -126,6 +224,7 @@ class DiskChunkCache:
         while self._bytes > self.limit and self._order:
             name, sz = self._order.popitem(last=False)
             self._bytes -= sz
+            self._stale.discard(name)
             try:
                 os.remove(os.path.join(self.dir, name))
             except OSError:
@@ -134,8 +233,12 @@ class DiskChunkCache:
 
 class TieredChunkCache:
     """Memory in front of optional disk (chunk_cache.go
-    TieredChunkCache).  Tracks keys per group (file path) so a changed
-    file invalidates all of its cached blocks at once."""
+    TieredChunkCache).  Tracks keys per group (file path / volume id)
+    so a changed file invalidates all of its cached blocks at once.
+
+    `name` arms the hit/miss/eviction meters on stats.PROCESS — the
+    server-side caches (volume needle, filer chunk) set it so their
+    effectiveness is observable on every /metrics."""
 
     # bounds on the group index itself: the data tiers evict by bytes,
     # but key-name bookkeeping would otherwise grow with every file
@@ -145,21 +248,27 @@ class TieredChunkCache:
 
     def __init__(self, mem_limit: int = 64 << 20,
                  disk_dir: "str | None" = None,
-                 disk_limit: int = 1 << 30):
-        self.mem = MemChunkCache(mem_limit)
-        self.disk = DiskChunkCache(disk_dir, disk_limit) \
+                 disk_limit: int = 1 << 30,
+                 name: "str | None" = None):
+        self._meter = _CacheMeter(name)
+        self.mem = MemChunkCache(mem_limit, meter=self._meter)
+        self.disk = DiskChunkCache(disk_dir, disk_limit,
+                                   meter=self._meter) \
             if disk_dir else None
         self._groups: "OrderedDict[str, set]" = OrderedDict()
         self._glock = threading.Lock()
 
     def get(self, key: str) -> "bytes | None":
         data = self.mem.get(key)
-        if data is not None:
-            return data
-        if self.disk is not None:
+        if data is None and self.disk is not None:
             data = self.disk.get(key)
             if data is not None:
                 self.mem.set(key, data)  # promote
+        if data is None:
+            self._meter.count("misses")
+        else:
+            self._meter.count("hits")
+            self._meter.bytes_served(len(data))
         return data
 
     def set(self, key: str, data: bytes, group: str = "") -> None:
@@ -192,7 +301,20 @@ class TieredChunkCache:
     def invalidate_group(self, group: str) -> None:
         with self._glock:
             keys = self._groups.pop(group, set())
+        if keys:
+            self._meter.count("invalidations", len(keys))
         for key in keys:
             self.mem.delete(key)
             if self.disk is not None:
                 self.disk.delete(key)
+
+    # the mount's meta-event subscription speaks paths; group == path
+    # there, so give the wiring its natural name
+    invalidate_path = invalidate_group
+
+    def delete(self, key: str) -> None:
+        """Point invalidation of one key across both tiers (the volume
+        server's write/delete hooks target exactly one needle)."""
+        self.mem.delete(key)
+        if self.disk is not None:
+            self.disk.delete(key)

@@ -811,3 +811,86 @@ def test_swfs012_repo_is_clean():
         [f for f in findings if f.rule == "SWFS012"],
         load_baseline(default_baseline_path()))
     assert new == [], [f.render() for f in new]
+
+
+# -- SWFS013: unbounded full-body read on a data-plane path ---------------
+
+def check_at(source: str, rule_id: str, relpath: str):
+    """check() with a caller-chosen relpath (SWFS013 scopes by
+    data-plane tree)."""
+    src = textwrap.dedent(source)
+    ctx = FileContext("<fixture>.py", relpath, src)
+    rule = next(r for r in RULES if r.id == rule_id)
+    return [f for f in rule.check(ctx)
+            if not ctx.suppressed(f.rule, f.line)]
+
+
+def test_swfs013_flags_unbounded_read_in_server_tree():
+    src = """
+    def serve(path):
+        with open(path, "rb") as f:
+            return 200, f.read()
+    """
+    found = check_at(src, "SWFS013", "seaweedfs_tpu/server/x.py")
+    assert len(found) == 1
+    assert "stream" in found[0].message
+
+
+def test_swfs013_flags_assigned_handle():
+    src = """
+    def serve(path):
+        f = open(path, "rb")
+        data = f.read()
+        f.close()
+        return data
+    """
+    assert len(check_at(src, "SWFS013",
+                        "seaweedfs_tpu/filer/x.py")) == 1
+
+
+def test_swfs013_silent_on_bounded_read_and_foreign_objects():
+    src = """
+    def serve(path, resp):
+        with open(path, "rb") as f:
+            head = f.read(4096)        # bounded: fine
+        body = resp.read()             # http client response, not an
+        return head + body             # open() handle
+    """
+    assert check_at(src, "SWFS013",
+                    "seaweedfs_tpu/server/x.py") == []
+
+
+def test_swfs013_silent_outside_data_plane_trees():
+    src = """
+    def tool(path):
+        with open(path, "rb") as f:
+            return f.read()
+    """
+    assert check_at(src, "SWFS013",
+                    "seaweedfs_tpu/devtools/x.py") == []
+
+
+def test_swfs013_noqa_suppresses():
+    src = """
+    def inventory(path):
+        with open(path, "rb") as f:
+            return f.read()  # noqa: SWFS013 — bounded by format
+    """
+    assert check_at(src, "SWFS013",
+                    "seaweedfs_tpu/server/x.py") == []
+
+
+def test_swfs013_repo_is_clean():
+    import os
+
+    import seaweedfs_tpu
+    root = os.path.dirname(seaweedfs_tpu.__file__)
+    findings, errors = run_paths([root])
+    assert not errors
+    from seaweedfs_tpu.devtools.analyze import (default_baseline_path,
+                                                load_baseline,
+                                                partition_baseline)
+    new, _old = partition_baseline(
+        [f for f in findings if f.rule == "SWFS013"],
+        load_baseline(default_baseline_path()))
+    assert new == [], [f.render() for f in new]

@@ -86,8 +86,10 @@ def test_meta_log_tolerates_torn_tail(tmp_path):
     log = MetaLog(d)
     log.append({"op": "x", "tsNs": 0, "n": 1})
     log.close()
-    # simulate a crash mid-write: torn trailing line
-    day = os.listdir(d)[0]
+    # simulate a crash mid-write: torn trailing line (skip the
+    # .watermark.* coherence files living beside the day dirs)
+    day = next(n for n in os.listdir(d)
+               if os.path.isdir(os.path.join(d, n)))
     seg_dir = os.path.join(d, day)
     seg = os.path.join(seg_dir, os.listdir(seg_dir)[0])
     with open(seg, "a") as f:

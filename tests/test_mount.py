@@ -401,3 +401,25 @@ def test_concurrent_chunk_posts_lose_nothing(fs):
     assert len(final) == 8000
     for i in range(8):
         assert final[i * 1000:(i + 1) * 1000] == bytes([i]) * 1000
+
+
+def test_chunk_cache_stale_read_regression(fs):
+    """The mount's data-block cache (util/chunk_cache) is subscribed
+    to the filer metalog via _follow_events -> invalidate_path: after
+    a file changes THROUGH THE FILER, reads must serve the new bytes
+    within ~attr_ttl — never the cached pre-change blocks."""
+    w, filer = fs
+    old = b"x" * 4000
+    new = b"y" * 4000
+    filer.filer.write_file("/docs/hot.bin", old)
+    # warm the block cache (twice: fill then hit)
+    assert w.read("/docs/hot.bin", 4000, 0) == old
+    assert w.read("/docs/hot.bin", 4000, 0) == old
+    assert w.chunk_cache is not None
+    filer.filer.write_file("/docs/hot.bin", new)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if w.read("/docs/hot.bin", 4000, 0) == new:
+            break
+        time.sleep(0.1)
+    assert w.read("/docs/hot.bin", 4000, 0) == new

@@ -1,0 +1,439 @@
+"""Read-plane cache tier + degraded reads (ISSUE 11).
+
+Covers: the volume server's hot-needle cache (hit counting, write/
+delete/raw-write invalidation), QoS response-byte metering (a hot
+cache must not be a QoS bypass), the filer's chunk-body cache and
+streaming GET (byte identity incl. ranges), the metadata cache's
+read-your-writes + the two-filer watermark coherence rule, the disk
+cache tier's cold-start staleness contract, and degraded EC reads
+(one-shot + streamed) with byte identity under a shard death and no
+full rebuild in the request path.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import operation, qos, stats
+from seaweedfs_tpu.server.httpd import http_bytes, http_json
+
+import chaos
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = chaos.Cluster(tmp_path_factory.mktemp("readcache"),
+                      volumes=3)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(autouse=True)
+def _qos_clean():
+    qos.reset()
+    yield
+    qos.reset()
+
+
+def _cache_counter(which: str, cache: str) -> float:
+    text = stats.render_process()
+    return chaos.metric_sum(text,
+                            f"seaweedfs_tpu_read_cache_{which}_total",
+                            cache=cache)
+
+
+def _loc_for(master: str, fid: str) -> str:
+    vid = int(fid.split(",")[0])
+    return operation.lookup(master, vid)[0]["url"]
+
+
+# -- volume-server hot-needle cache ----------------------------------------
+
+def test_needle_cache_hits_and_write_invalidation(cluster):
+    payload = os.urandom(9000)
+    fid = operation.submit(cluster.master_url, payload)
+    url = _loc_for(cluster.master_url, fid)
+    h0 = _cache_counter("hits", "volume_needle")
+    # first read fills, second hits
+    st, b1, _ = http_bytes("GET", f"{url}/{fid}", timeout=10)
+    st2, b2, _ = http_bytes("GET", f"{url}/{fid}", timeout=10)
+    assert (st, st2) == (200, 200)
+    assert b1 == b2 == payload
+    assert _cache_counter("hits", "volume_needle") >= h0 + 1
+    # overwrite through the data path must invalidate: the next read
+    # serves the NEW bytes, never the cached old needle
+    new_payload = os.urandom(7000)
+    st, body, _ = http_bytes("POST", f"{url}/{fid}", new_payload,
+                             timeout=10)
+    assert st == 201, body
+    st, b3, _ = http_bytes("GET", f"{url}/{fid}", timeout=10)
+    assert st == 200 and b3 == new_payload
+    # ranged read over the (now cached) needle stays correct
+    st, part, _ = http_bytes("GET", f"{url}/{fid}", None,
+                             {"Range": "bytes=100-199"}, timeout=10)
+    assert st == 206 and part == new_payload[100:200]
+    # delete invalidates: 404, not a stale cache hit
+    st, _, _ = http_bytes("DELETE", f"{url}/{fid}", timeout=10)
+    assert st in (202, 404)
+    st, _, _ = http_bytes("GET", f"{url}/{fid}", timeout=10)
+    assert st == 404
+
+
+def test_cached_read_cannot_evade_qos_byte_budget(cluster):
+    """qos.charge_response: response bytes spend the tenant's
+    in-flight budget — a cache hit of a 2MB body under a 1MB budget
+    is rejected 503 + Retry-After, exactly like the upload would be."""
+    payload = os.urandom(2 << 20)
+    fid = operation.submit(cluster.master_url, payload)
+    url = _loc_for(cluster.master_url, fid)
+    # warm the cache first, unmetered
+    st, body, _ = http_bytes("GET", f"{url}/{fid}", timeout=10)
+    assert st == 200 and body == payload
+    cfg = qos.QosConfig(enabled=True)
+    cfg.tenants["hot-tenant"] = qos.TenantLimit(inflight_mb=1.0)
+    qos.configure(cfg)
+    st, body, hdrs = http_bytes(
+        "GET", f"{url}/{fid}", None, {"X-Tenant": "hot-tenant"},
+        timeout=10)
+    assert st == 503, (st, body[:100])
+    assert "Retry-After" in hdrs
+    # an unlimited tenant still reads fine (and the release path must
+    # leave no in-flight bytes behind for the limited one)
+    st, body, _ = http_bytes("GET", f"{url}/{fid}", timeout=10)
+    assert st == 200 and body == payload
+    assert qos.controller().inflight_of("hot-tenant") == 0
+
+
+# -- filer chunk cache + streaming GET -------------------------------------
+
+@pytest.fixture(scope="module")
+def filer(cluster, tmp_path_factory):
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    tmp = tmp_path_factory.mktemp("readcache-filer")
+    f = FilerServer(cluster.master_url,
+                    store_path=str(tmp / "f.db")).start()
+    yield f
+    f.stop()
+
+
+def test_filer_chunk_cache_and_stream_identity(filer):
+    rng = np.random.default_rng(7)
+    # multi-chunk file (CHUNK_SIZE=4MB): exercises the lazy view
+    # stream and the whole-chunk cache fill
+    payload = rng.integers(0, 256, 9 << 20, dtype=np.uint8).tobytes()
+    st, _, _ = http_bytes("POST", f"{filer.url}/rc/big.bin", payload,
+                          timeout=60)
+    assert st == 201
+    h0 = _cache_counter("hits", "filer_chunk")
+    st, b1, hdrs = http_bytes("GET", f"{filer.url}/rc/big.bin",
+                              timeout=60)
+    assert st == 200 and b1 == payload
+    assert hdrs.get("Content-Length") == str(len(payload))
+    st, b2, _ = http_bytes("GET", f"{filer.url}/rc/big.bin",
+                           timeout=60)
+    assert b2 == payload
+    assert _cache_counter("hits", "filer_chunk") > h0
+    # ranged read across a chunk boundary, served from the cache
+    lo, hi = (4 << 20) - 1000, (4 << 20) + 1000
+    st, part, hdrs = http_bytes(
+        "GET", f"{filer.url}/rc/big.bin", None,
+        {"Range": f"bytes={lo}-{hi - 1}"}, timeout=60)
+    assert st == 206 and part == payload[lo:hi]
+    assert hdrs.get("Content-Range") == \
+        f"bytes {lo}-{hi - 1}/{len(payload)}"
+
+
+def test_filer_meta_cache_read_your_writes(filer):
+    # negative lookup cached, then created: the create must invalidate
+    st, _, _ = http_bytes("GET", f"{filer.url}/rc/ryw.txt",
+                          timeout=10)
+    assert st == 404
+    st, _, _ = http_bytes("POST", f"{filer.url}/rc/ryw.txt", b"v1",
+                          timeout=10)
+    assert st == 201
+    st, body, _ = http_bytes("GET", f"{filer.url}/rc/ryw.txt",
+                             timeout=10)
+    assert (st, body) == (200, b"v1")
+    # overwrite then read: never the stale cached entry
+    st, _, _ = http_bytes("POST", f"{filer.url}/rc/ryw.txt",
+                          b"v2-longer", timeout=10)
+    assert st == 201
+    st, body, _ = http_bytes("GET", f"{filer.url}/rc/ryw.txt",
+                             timeout=10)
+    assert (st, body) == (200, b"v2-longer")
+    # listing coherence: a new sibling appears immediately
+    st, _, _ = http_bytes("POST", f"{filer.url}/rc/ryw2.txt", b"x",
+                          timeout=10)
+    assert st == 201
+    r = http_json("GET", f"{filer.url}/rc/", timeout=10)
+    names = {e["fullPath"].rsplit("/", 1)[-1] for e in r["entries"]}
+    assert {"ryw.txt", "ryw2.txt"} <= names
+
+
+def test_two_filers_watermark_coherence(cluster, tmp_path_factory):
+    """The ISSUE acceptance shape: a write through filer A immediately
+    followed by a read through filer B (same sqlite store, same
+    metalog dir by construction) never serves B's stale cached entry
+    — A's group-commit watermark invalidates B's fills."""
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    tmp = tmp_path_factory.mktemp("two-filers")
+    store = str(tmp / "shared.db")
+    fa = FilerServer(cluster.master_url, store_path=store).start()
+    fb = FilerServer(cluster.master_url, store_path=store).start()
+    try:
+        assert fa.filer.meta_cache is not None
+        assert fb.filer.meta_cache is not None
+        # seed through A so B's metalog sees A's watermark file exists
+        # (first-contact discovery is memoized ~1s)
+        st, _, _ = http_bytes("POST", f"{fa.url}/wm/seed.txt", b"s",
+                              timeout=10)
+        assert st == 201
+        time.sleep(1.1)     # let B's probe re-list watermark files
+        # B reads and caches the entry
+        st, body, _ = http_bytes("GET", f"{fb.url}/wm/seed.txt",
+                                 timeout=10)
+        assert (st, body) == (200, b"s")
+        st, body, _ = http_bytes("GET", f"{fb.url}/wm/seed.txt",
+                                 timeout=10)
+        assert (st, body) == (200, b"s")
+        # write through A, read through B IMMEDIATELY: watermark rule
+        st, _, _ = http_bytes("POST", f"{fa.url}/wm/seed.txt",
+                              b"fresh-bytes", timeout=10)
+        assert st == 201
+        st, body, _ = http_bytes("GET", f"{fb.url}/wm/seed.txt",
+                                 timeout=10)
+        assert (st, body) == (200, b"fresh-bytes")
+        # and a brand-new path created on A is visible through B
+        st, _, _ = http_bytes("POST", f"{fa.url}/wm/new.txt", b"n",
+                              timeout=10)
+        assert st == 201
+        st, body, _ = http_bytes("GET", f"{fb.url}/wm/new.txt",
+                                 timeout=10)
+        assert (st, body) == (200, b"n")
+    finally:
+        fb.stop()
+        fa.stop()
+
+
+# -- disk tier cold-start staleness contract -------------------------------
+
+def test_disk_tier_never_serves_adopted_leftovers(tmp_path):
+    """A fresh process must start COLD: blocks written by a previous
+    run are eviction fodder, never servable — the invalidation events
+    that covered them died with the old process (the mount satellite's
+    stale-read hole)."""
+    from seaweedfs_tpu.util.chunk_cache import DiskChunkCache
+    d = str(tmp_path / "dc")
+    c1 = DiskChunkCache(d, limit_bytes=1 << 20)
+    c1.set("k", b"stale-from-last-boot")
+    assert c1.get("k") == b"stale-from-last-boot"
+    # "restart": a new cache over the same dir
+    c2 = DiskChunkCache(d, limit_bytes=1 << 20)
+    assert c2.get("k") is None          # adopted, not servable
+    c2.set("k", b"fresh")               # re-written: servable again
+    assert c2.get("k") == b"fresh"
+    # adopted bytes still count toward the bound (no unbounded growth
+    # across restarts): a tiny limit clips them at construction
+    c3 = DiskChunkCache(d, limit_bytes=1)
+    assert c3.get("k") is None
+
+
+# -- degraded EC reads -----------------------------------------------------
+
+def _data_shard_holder(cluster, vid: int, want_sid: int = 0):
+    """(url, sid) of the holder of `want_sid`.  Shard 0 is the one
+    every read touches: a small test volume fits inside the first 1MB
+    small block, so all needle intervals map to data shard 0."""
+    for url, sids in cluster.shard_map(vid).items():
+        if want_sid in sids:
+            return url, want_sid
+    raise AssertionError(f"shard {want_sid} not mounted anywhere")
+
+
+EC_COLLECTION = "ecrc"
+
+
+@pytest.fixture(scope="module")
+def ec_setup(cluster):
+    """One EC-encoded RS(4,2) volume + its blobs, with data shard 0
+    deleted from its only holder — a dedicated collection keeps the
+    volume under 1MB, so EVERY needle's interval maps to shard 0 and
+    every read must reconstruct."""
+    import numpy as _np
+
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    rng = _np.random.default_rng(21)
+    blobs: dict = {}
+    for _ in range(10):
+        data = rng.integers(0, 256, int(rng.integers(4000, 30000)),
+                            dtype=_np.uint8).tobytes()
+        blobs[operation.submit(cluster.master_url, data,
+                               collection=EC_COLLECTION)] = data
+    vids = {int(fid.split(",")[0]) for fid in blobs}
+    assert len(vids) == 1, vids
+    vid = vids.pop()
+    env = CommandEnv(cluster.master_url)
+    run_command(env, "lock")
+    try:
+        out = run_command(env, f"ec.encode -volumeId={vid} "
+                               f"-collection={EC_COLLECTION} "
+                               f"-dataShards=4 -parityShards=2")
+    finally:
+        run_command(env, "unlock")
+    assert "error" not in out.lower(), out
+    url, sid = _data_shard_holder(cluster, vid)
+    r = http_json("POST", f"{url}/admin/ec/delete_shards",
+                  {"volumeId": vid, "collection": EC_COLLECTION,
+                   "shardIds": [sid]}, timeout=30)
+    assert "error" not in r, r
+    return vid, blobs, sid
+
+
+def _rebuilds_total(cluster) -> float:
+    return sum(chaos.metric_sum(chaos.metrics_text(u),
+                                "volume_server_ec_rebuilds_total")
+               for u in cluster.all_urls[1:])
+
+
+def test_degraded_reads_byte_identical_no_rebuild(cluster, ec_setup):
+    vid, blobs, _sid = ec_setup
+    d0 = chaos.metric_sum(stats.render_process(),
+                          "seaweedfs_tpu_ec_degraded_reads_total")
+    r0 = _rebuilds_total(cluster)
+    for fid, payload in blobs.items():
+        got = operation.read(cluster.master_url, fid)
+        assert got == payload, f"degraded read of {fid} corrupt"
+    assert chaos.metric_sum(stats.render_process(),
+                            "seaweedfs_tpu_ec_degraded_reads_total") > d0
+    # decode-on-read, never a rebuild in the request path
+    assert _rebuilds_total(cluster) == r0
+    # the latency histogram is on every /metrics (shared registry)
+    assert "ec_degraded_read_seconds" in stats.render_process()
+
+
+def test_degraded_read_promotes_into_hot_cache(cluster, ec_setup):
+    """Runs after the mass degraded read above: every reconstructed
+    needle was PROMOTED into its server's hot cache, so re-reading the
+    working set costs zero further decodes (the zipfian payoff) and
+    the hit counter moves instead."""
+    vid, blobs, _sid = ec_setup
+    d0 = chaos.metric_sum(stats.render_process(),
+                          "seaweedfs_tpu_ec_degraded_reads_total")
+    assert d0 > 0        # the previous test decoded at least once
+    h0 = _cache_counter("hits", "volume_needle")
+    for fid, payload in blobs.items():
+        assert operation.read(cluster.master_url, fid) == payload
+    assert _cache_counter("hits", "volume_needle") > h0
+    # no new decode fan-outs: the hot cache absorbed the re-reads
+    assert chaos.metric_sum(
+        stats.render_process(),
+        "seaweedfs_tpu_ec_degraded_reads_total") == d0
+
+
+def test_degraded_streamed_path_identity(cluster, ec_setup,
+                                         monkeypatch):
+    """Force the windowed decode-on-read (tiny window, hot caches
+    dropped so the read really decodes) and prove byte identity."""
+    vid, blobs, _sid = ec_setup
+    fid, payload = max(blobs.items(), key=lambda kv: len(kv[1]))
+    assert len(payload) > 8 << 10       # spans multiple 4KB windows
+    monkeypatch.setenv("SEAWEEDFS_TPU_DEGRADED_SLICE_MB", "0.001")
+    for vs in cluster.servers:          # bypass the promoted copies
+        vs._nc_drop_volume(vid)
+    # prove the STREAMED path served (not a silent one-shot fallback):
+    # the fallback would have to call _recover_interval, which we fail
+    from seaweedfs_tpu.server.store_ec import EcReader
+
+    def _boom(self, *a, **k):
+        raise AssertionError("one-shot fallback reached")
+    monkeypatch.setattr(EcReader, "_recover_interval", _boom)
+    d0 = chaos.metric_sum(stats.render_process(),
+                          "seaweedfs_tpu_ec_degraded_reads_total")
+    got = operation.read(cluster.master_url, fid)
+    assert got == payload
+    assert chaos.metric_sum(
+        stats.render_process(),
+        "seaweedfs_tpu_ec_degraded_reads_total") > d0
+
+
+def test_shard_death_mid_read_load(cluster, ec_setup):
+    """Chaos shape: concurrent zipfian-ish readers while a SECOND
+    shard holder loses a shard mid-load — every read stays
+    byte-identical (RS(4,2) tolerates two losses)."""
+    vid, blobs, first_sid = ec_setup
+    items = list(blobs.items())
+    stop = threading.Event()
+    errors: list = []
+
+    def reader(seed: int):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            fid, payload = items[int(rng.integers(len(items)))]
+            try:
+                got = operation.read(cluster.master_url, fid)
+                if got != payload:
+                    errors.append(f"corrupt read {fid}")
+                    return
+            except Exception as e:   # noqa: BLE001 — collected
+                errors.append(f"{fid}: {e!r}")
+                return
+
+    threads = [threading.Thread(target=reader, args=(s,))
+               for s in (1, 2)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.3)
+        # kill a SECOND shard mid-load (RS(4,2): still reconstructable)
+        for url, sids in cluster.shard_map(vid).items():
+            victim = next((s for s in sids if s != first_sid), None)
+            if victim is not None:
+                r = http_json("POST", f"{url}/admin/ec/delete_shards",
+                              {"volumeId": vid,
+                               "collection": EC_COLLECTION,
+                               "shardIds": [victim]}, timeout=30)
+                assert "error" not in r, r
+                break
+        time.sleep(0.7)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, errors[:3]
+    # readers kept verifying after the second loss
+    for fid, payload in items[:3]:
+        assert operation.read(cluster.master_url, fid) == payload
+
+
+# -- cluster.top render ----------------------------------------------------
+
+def test_cluster_top_read_cache_report():
+    """The windowed read-cache line renders per-cache hit % + MB
+    served from the shared-registry counters."""
+    from seaweedfs_tpu.shell.commands import _read_cache_report
+    before = {
+        "seaweedfs_tpu_read_cache_hits_total":
+            [({"cache": "volume_needle"}, 10.0)],
+        "seaweedfs_tpu_read_cache_misses_total":
+            [({"cache": "volume_needle"}, 10.0)],
+        "seaweedfs_tpu_read_cache_bytes_served_total":
+            [({"cache": "volume_needle"}, 0.0)],
+    }
+    after = {
+        "seaweedfs_tpu_read_cache_hits_total":
+            [({"cache": "volume_needle"}, 90.0),
+             ({"cache": "filer_chunk"}, 5.0)],
+        "seaweedfs_tpu_read_cache_misses_total":
+            [({"cache": "volume_needle"}, 30.0),
+             ({"cache": "filer_chunk"}, 5.0)],
+        "seaweedfs_tpu_read_cache_bytes_served_total":
+            [({"cache": "volume_needle"}, float(64 << 20))],
+    }
+    line = _read_cache_report(before, after)
+    assert "volume_needle 80%" in line       # (90-10)/(80+20)
+    assert "64.0MB served" in line
+    assert "filer_chunk 50%" in line
+    assert _read_cache_report(after, after) == ""

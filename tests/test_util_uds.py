@@ -36,9 +36,15 @@ def test_disk_chunk_cache_bounded(tmp_path):
     assert c.get("a") is None
     assert c.get("b") == b"2" * 40
     assert c.get("c") == b"3" * 40
-    # a fresh instance adopts leftover files
+    # a fresh instance adopts leftover files for BYTE ACCOUNTING only
+    # — serving them would be a stale-read hole (the invalidation
+    # events that covered them died with the old process); re-written
+    # keys become servable again and adopted bytes still bound the dir
     c2 = DiskChunkCache(str(tmp_path / "cache"), limit_bytes=100)
-    assert c2.get("b") == b"2" * 40
+    assert c2.get("b") is None
+    c2.set("b", b"fresh" * 8)
+    assert c2.get("b") == b"fresh" * 8
+    assert c2._bytes <= 100
 
 
 def test_tiered_cache_promotes_and_invalidates(tmp_path):
