@@ -9,6 +9,7 @@ bench.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -141,6 +142,15 @@ def main(argv: list[str] | None = None) -> int:
                     help="comma-separated filer addresses forming the "
                          "distributed-lock ring (give every filer the "
                          "same list; cluster/lock_manager)")
+    fl.add_argument("-workers", type=int, default=None,
+                    help="pre-fork worker processes sharing this "
+                         "port via SO_REUSEPORT (sqlite store only: "
+                         "one WAL store + one metalog dir, watermark-"
+                         "coherent — the funnel past one process's "
+                         "GIL).  Default 1; env "
+                         "SEAWEEDFS_TPU_FILER_WORKERS sets it "
+                         "cluster-wide.  0 marks a spawned worker "
+                         "(internal).")
     fl.add_argument("-metricsAddress", dest="metrics_address",
                     default="", help="Prometheus pushgateway "
                     "host:port (stats/metrics.go LoopPushingMetric)")
@@ -625,6 +635,19 @@ def main(argv: list[str] | None = None) -> int:
             if notification:
                 wlog.info("notification from %s: %s", ntoml,
                           notification, component="config")
+        workers = args.workers
+        if workers is None:
+            try:
+                workers = int(os.environ.get(
+                    "SEAWEEDFS_TPU_FILER_WORKERS", "") or 1)
+            except ValueError:
+                workers = 1
+        is_worker = workers == 0          # spawned sibling (internal)
+        if workers > 1 and store_type != "sqlite":
+            wlog.warning("filer -workers needs the sqlite store "
+                         "(shared WAL + metalog); running 1 process",
+                         component="filer")
+            workers = 1
         fs = FilerServer(args.master, args.ip, args.port,
                          store_path=store_path,
                          collection=args.collection,
@@ -633,8 +656,68 @@ def main(argv: list[str] | None = None) -> int:
                          notification=notification,
                          lock_peers=[p.strip() for p in
                                      args.lock_peers.split(",")
-                                     if p.strip()])
+                                     if p.strip()],
+                         reuse_port=is_worker or workers > 1)
         fs.start()
+        worker_procs: list = []
+        if is_worker:
+            # exit when orphaned: the parent (or the harness that
+            # killed it) is gone, so this listener must die too
+            import threading as _threading
+
+            def _orphan_watch(ppid: int = os.getppid()):
+                while True:
+                    time.sleep(1.0)
+                    if os.getppid() != ppid:
+                        os._exit(0)
+            _threading.Thread(target=_orphan_watch,
+                              daemon=True).start()
+        elif workers > 1:
+            # pre-fork: N-1 sibling processes re-exec this command on
+            # the RESOLVED port with SO_REUSEPORT; the kernel spreads
+            # connections across the workers' accept queues
+            import subprocess as _subprocess
+            argv = []
+            skip = False
+            for a in sys.argv[1:]:
+                if skip:
+                    skip = False
+                    continue
+                if a in ("-port", "-workers"):
+                    skip = True
+                    continue
+                argv.append(a)
+            argv += ["-port", str(fs.http.port), "-workers", "0"]
+            for _ in range(workers - 1):
+                worker_procs.append(_subprocess.Popen(
+                    [sys.executable, "-m", "seaweedfs_tpu"] + argv))
+            print(f"filer pre-forked {workers - 1} sibling workers "
+                  f"on port {fs.http.port}")
+            # monitor: a crashed worker is reaped, logged, and
+            # respawned (bounded — a worker that cannot stay up must
+            # not become a fork loop); without this the filer would
+            # silently serve with fewer processes than -workers asked
+            import threading as _threading
+            respawns = [0]
+
+            def _worker_monitor():
+                while True:
+                    time.sleep(2.0)
+                    for i, wp in enumerate(worker_procs):
+                        rc = wp.poll()
+                        if rc is None:
+                            continue
+                        wlog.warning(
+                            f"filer worker pid={wp.pid} exited "
+                            f"rc={rc}", component="filer")
+                        if respawns[0] >= 20:
+                            continue
+                        respawns[0] += 1
+                        worker_procs[i] = _subprocess.Popen(
+                            [sys.executable, "-m", "seaweedfs_tpu"]
+                            + argv)
+            _threading.Thread(target=_worker_monitor,
+                              daemon=True).start()
         if args.metrics_address:
             from .stats import MetricsPusher
             MetricsPusher(fs.metrics, "filer", fs.url,
@@ -898,7 +981,6 @@ def main(argv: list[str] | None = None) -> int:
         finally:
             syncer.stop()
     elif args.cmd == "sftp":
-        import os
         from cryptography.hazmat.primitives import serialization
         from cryptography.hazmat.primitives.asymmetric.ed25519 import (
             Ed25519PrivateKey)

@@ -973,6 +973,64 @@ class UnboundedBodyReadRule(Rule):
                     f"bound the read, or noqa with a reason")
 
 
+class AsyncBlockingCallRule(Rule):
+    """SWFS014: a blocking call written directly inside an `async def`
+    body.  The asyncio front (server/async_front.py) multiplexes a
+    whole role's connections on ONE event loop — a single `time.sleep`,
+    synchronous pooled-client hop (`http_bytes`/`http_json`/
+    `master_json`/friends), `urllib.request.urlopen`, or bare `open()`
+    in a coroutine stalls every connection of the role at once.
+    Blocking work belongs on the executor
+    (`loop.run_in_executor(pool, fn)`): calls inside nested `def`s and
+    lambdas are NOT flagged, because that is exactly the executor
+    hand-off shape.  A coroutine that must block anyway (none known)
+    carries `# noqa: SWFS014` and a reason."""
+
+    id = "SWFS014"
+    severity = "error"
+    title = "blocking call inside an async def"
+
+    # fully-dotted spellings that block wherever they appear
+    _FULL = {"time.sleep", "open", "io.open",
+             "urllib.request.urlopen"}
+    # the sync client funnel (httpd.py / operation.py), matched by
+    # trailing name so module-qualified spellings are caught too
+    _TAILS = {"http_bytes", "http_json", "master_json", "http_upload",
+              "http_download", "http_relay", "http_stream_request",
+              "_pooled_request", "_one_pooled_request"}
+
+    @staticmethod
+    def _direct_nodes(fn: ast.AST):
+        """This function's own body, stopping at nested function /
+        lambda scopes (their bodies run wherever they are CALLED —
+        normally on the executor)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, ctx: FileContext):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in self._direct_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _dotted(node.func)
+                tail = dotted.rsplit(".", 1)[-1]
+                if dotted in self._FULL or tail in self._TAILS:
+                    yield self.finding(
+                        ctx, node,
+                        f"{dotted}() blocks the event loop inside "
+                        f"async def {fn.name} — hand it to the "
+                        f"executor (loop.run_in_executor) or use the "
+                        f"async equivalent")
+
+
 RULES = [
     LockDisciplineRule(),
     JitBlockingRule(),
@@ -987,4 +1045,5 @@ RULES = [
     WallDurationRule(),
     FlushUnderLockRule(),
     UnboundedBodyReadRule(),
+    AsyncBlockingCallRule(),
 ]

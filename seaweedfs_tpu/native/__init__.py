@@ -141,6 +141,90 @@ def load_read_plane() -> "ctypes.CDLL | None":
         return _rp_lib
 
 
+# -- write-plane library (write_plane.cc) ------------------------------
+
+_WP_SRC = os.path.join(_DIR, "write_plane.cc")
+_WP_SO = os.path.join(_DIR, "_build", "libwrite_plane.so")
+_wp_lib = None
+_wp_tried = False
+
+
+class WpEntry(ctypes.Structure):
+    """One completed native append, drained back to the Python index
+    (layout mirrors write_plane.cc WpEntry)."""
+
+    _fields_ = [("key", ctypes.c_uint64),
+                ("offset", ctypes.c_uint64),
+                ("append_ns", ctypes.c_uint64),
+                ("vid", ctypes.c_uint32),
+                ("cookie", ctypes.c_uint32),
+                ("size", ctypes.c_int32),
+                ("data_len", ctypes.c_uint32)]
+
+
+def load_write_plane() -> "ctypes.CDLL | None":
+    """Build (if needed) + load the native epoll write plane; None
+    when unavailable — the volume server then serves writes from
+    Python only (the graceful-degradation contract the parity tests
+    pin)."""
+    global _wp_lib, _wp_tried
+    with _lock:
+        if _wp_lib is not None or _wp_tried:
+            return _wp_lib
+        _wp_tried = True
+        try:
+            if _build_if_stale(_WP_SRC, _WP_SO) is None:
+                return None
+            lib = ctypes.CDLL(_WP_SO)
+            lib.wp_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.POINTER(ctypes.c_int)]
+            lib.wp_start.restype = ctypes.c_int
+            lib.wp_stop.argtypes = [ctypes.c_int]
+            lib.wp_add_volume.argtypes = [
+                ctypes.c_int, ctypes.c_uint, ctypes.c_char_p,
+                ctypes.c_ulonglong, ctypes.c_ulonglong, ctypes.c_int]
+            lib.wp_add_volume.restype = ctypes.c_int
+            lib.wp_mark_keys.argtypes = [
+                ctypes.c_int, ctypes.c_uint,
+                ctypes.POINTER(ctypes.c_ulonglong), ctypes.c_int]
+            lib.wp_mark_keys.restype = ctypes.c_int
+            lib.wp_arm.argtypes = [ctypes.c_int, ctypes.c_uint]
+            lib.wp_arm.restype = ctypes.c_int
+            lib.wp_remove_volume.argtypes = [ctypes.c_int,
+                                             ctypes.c_uint]
+            lib.wp_append.argtypes = [
+                ctypes.c_int, ctypes.c_uint, ctypes.c_ulonglong,
+                ctypes.c_char_p, ctypes.c_ulonglong,
+                ctypes.c_ulonglong]
+            lib.wp_append.restype = ctypes.c_longlong
+            lib.wp_drain.argtypes = [ctypes.c_int, ctypes.c_uint,
+                                     ctypes.POINTER(WpEntry),
+                                     ctypes.c_int]
+            lib.wp_drain.restype = ctypes.c_int
+            lib.wp_pending.argtypes = [ctypes.c_int, ctypes.c_uint]
+            lib.wp_pending.restype = ctypes.c_int
+            lib.wp_tail.argtypes = [ctypes.c_int, ctypes.c_uint]
+            lib.wp_tail.restype = ctypes.c_ulonglong
+            lib.wp_wait_epoch.argtypes = [
+                ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint),
+                ctypes.POINTER(ctypes.c_ulonglong)]
+            lib.wp_wait_epoch.restype = ctypes.c_int
+            lib.wp_epoch_done.argtypes = [ctypes.c_int, ctypes.c_uint,
+                                          ctypes.c_ulonglong]
+            lib.wp_requests.argtypes = [ctypes.c_int]
+            lib.wp_requests.restype = ctypes.c_ulonglong
+            lib.wp_fallbacks.argtypes = [ctypes.c_int]
+            lib.wp_fallbacks.restype = ctypes.c_ulonglong
+            lib.wp_latency.argtypes = [
+                ctypes.c_int, ctypes.POINTER(ctypes.c_ulonglong)]
+            lib.wp_latency.restype = ctypes.c_int
+        except (OSError, subprocess.SubprocessError):
+            return None
+        _wp_lib = lib
+        return _wp_lib
+
+
 _VT_SRC = os.path.join(os.path.dirname(__file__), "volume_tool.cc")
 _VT_BIN = os.path.join(_DIR, "_build", "volume_tool")
 

@@ -136,7 +136,23 @@ def upload(url: str, fid: str, data: bytes, name: str = "",
            mime: str = "", auth: str = "") -> dict:
     """operation/upload_content.go Upload.  `auth` is the per-fid write
     jwt from assign (falls back to signing locally when this process
-    holds the write key, e.g. in-process filer)."""
+    holds the write key, e.g. in-process filer).
+
+    Plain anonymous uploads (no name, no mime, no jwt — the filer's
+    chunk shape) try the server's native C++ write plane first
+    (server/write_plane.py): the C++ epoll loop recvs, appends and
+    acks with zero Python on the server, and this side talks to it
+    over a lean persistent socket instead of http.client — together
+    the big per-request CPU cuts of the ISSUE 12 funnel.  Anything
+    the plane doesn't own 404s and falls through to the pooled POST
+    below, byte-for-byte the original path."""
+    if not name and not mime and not auth and data and \
+            not security.current().volume_write_key:
+        from . import profiling
+        with profiling.stage("upload"):
+            r = _write_via_write_plane(url, fid, data)
+        if r is not None:
+            return r
     qs = "?" + urllib.parse.urlencode({"name": name}) if name else ""
     headers = {"Content-Type": mime} if mime else {}
     # a fixed-fid needle write is idempotent by construction (a replay
@@ -389,18 +405,150 @@ _uds_lock = threading.Lock()
 
 def _server_status(url: str) -> dict:
     """Cached /status probe per volume server (fast-path discovery:
-    udsPath + readPlanePort)."""
+    udsPath + readPlanePort + writePlanePort)."""
     with _uds_lock:
         if url in _uds_probe:
             return _uds_probe[url]
     try:
-        st, body, _ = http_bytes("GET", f"{url}/status", timeout=5)
+        st, body, _ = http_bytes("GET", f"{url}/status", None, None, 5)
         doc = json.loads(body) if st == 200 else {}
-    except (OSError, ValueError):
+    except (OSError, ValueError, TypeError):
+        # TypeError: tests monkeypatch http_bytes with narrow fakes —
+        # discovery must degrade to "no plane", never break an upload
         doc = {}
     with _uds_lock:
         _uds_probe[url] = doc
     return doc
+
+
+def _invalidate_status(url: str) -> None:
+    """Drop the cached /status probe (a plane connection refused means
+    the server restarted — its plane ports moved)."""
+    with _uds_lock:
+        _uds_probe.pop(url, None)
+
+
+# -- lean plane client ----------------------------------------------------
+#
+# The native planes speak strict minimal HTTP/1.1 (we control both
+# ends), so the client side skips http.client entirely: a per-thread
+# persistent socket per plane address, a hand-assembled request, a
+# ~100-byte response parsed with two partitions.  http.client costs
+# several hundred µs of pure Python per call — at native-plane rates
+# that overhead IS the funnel (arXiv:1709.05365's host-side tax, client
+# edition).
+
+_plane_local = threading.local()
+
+
+def _plane_request(addr: str, method: str, path: str,
+                   body: bytes = b"", timeout: float = 10.0
+                   ) -> "tuple[int, bytes]":
+    """One request over the thread's persistent plane socket; retries
+    once on a stale keep-alive socket (plane requests are idempotent:
+    fixed-fid writes dedup server-side, reads are reads).  Raises
+    OSError when the plane is unreachable."""
+    import socket as _socket
+    socks = getattr(_plane_local, "socks", None)
+    if socks is None:
+        socks = _plane_local.socks = {}
+    req = (f"{method} {path} HTTP/1.1\r\n"
+           f"Host: {addr}\r\n"
+           f"Content-Length: {len(body)}\r\n\r\n").encode()
+    for attempt in (0, 1):
+        sock = socks.get(addr)
+        reused = sock is not None
+        if sock is None:
+            host, _, port = addr.rpartition(":")
+            sock = _socket.create_connection((host, int(port)),
+                                             timeout=timeout)
+            sock.setsockopt(_socket.IPPROTO_TCP,
+                            _socket.TCP_NODELAY, 1)
+            socks[addr] = sock
+        try:
+            sock.settimeout(timeout)
+            sock.sendall(req + body if len(body) < (256 << 10)
+                         else req)
+            if len(body) >= (256 << 10):
+                sock.sendall(body)
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise OSError("plane socket closed mid-response")
+                buf += chunk
+                if len(buf) > (64 << 10):
+                    raise OSError("oversized plane response header")
+            head, _, rest = buf.partition(b"\r\n\r\n")
+            status = int(head.split(b" ", 2)[1])
+            clen = 0
+            for line in head.split(b"\r\n")[1:]:
+                k, _, v = line.partition(b":")
+                if k.strip().lower() == b"content-length":
+                    clen = int(v.strip())
+                    break
+            while len(rest) < clen:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise OSError("plane socket closed mid-body")
+                rest += chunk
+            return status, rest[:clen]
+        except OSError:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            socks.pop(addr, None)
+            if reused and attempt == 0:
+                continue     # stale keep-alive: one fresh re-dial
+            raise
+    raise OSError("unreachable")  # pragma: no cover
+
+
+def _write_plane_addr_for(url: str) -> "str | None":
+    port = _server_status(url).get("writePlanePort") or 0
+    if not port:
+        return None
+    host = url.split("://")[-1].rsplit(":", 1)[0]
+    return f"{host}:{port}"
+
+
+def _plane_vid_misses() -> dict:
+    m = getattr(_plane_local, "vid_misses", None)
+    if m is None:
+        m = _plane_local.vid_misses = {}
+    return m
+
+
+def _write_via_write_plane(url: str, fid: str, data: bytes
+                           ) -> "dict | None":
+    """Native write-plane fast path; None falls back to the pooled
+    Python-port POST.  A 404 (unregistered/replicated volume, seen
+    key) is remembered per-vid briefly so steady traffic to a volume
+    the plane will never own doesn't pay a probe round-trip per
+    write."""
+    addr = _write_plane_addr_for(url)
+    if addr is None:
+        return None
+    vid = fid.partition(",")[0]
+    misses = _plane_vid_misses()
+    deadline = misses.get((addr, vid))
+    if deadline is not None:
+        if time.monotonic() < deadline:
+            return None
+        del misses[(addr, vid)]
+    try:
+        status, body = _plane_request(addr, "POST", f"/{fid}", data)
+    except OSError:
+        _invalidate_status(url)   # restarted server: re-probe ports
+        return None
+    if status == 201:
+        try:
+            return json.loads(body)
+        except ValueError:
+            return None
+    misses[(addr, vid)] = time.monotonic() + 2.0
+    return None
 
 
 def _uds_path_for(url: str) -> "str | None":
@@ -432,9 +580,12 @@ def _read_via_read_plane(locs, fid: str) -> "bytes | None":
         if not addr:
             continue
         try:
-            status, body, _ = http_bytes("GET", f"{addr}/{fid}",
-                                         timeout=10)
+            # lean persistent-socket client (same funnel as the write
+            # plane): the C++ plane speaks strict minimal HTTP, so the
+            # http.client machinery is pure overhead here
+            status, body = _plane_request(addr, "GET", f"/{fid}")
         except OSError:
+            _invalidate_status(loc["url"])
             continue
         if status == 200:
             return body

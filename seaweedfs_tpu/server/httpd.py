@@ -180,6 +180,42 @@ class Request:
 Route = Callable[[Request], "tuple[int, object]"]
 
 
+def normalize_payload(payload) -> "tuple[object, str, dict]":
+    """One payload contract for both server fronts (threaded
+    dispatcher below, async_front.py): handlers return dict/list
+    (json), bytes, str, a (body, headers-dict) or (body, ctype) tuple,
+    or a file-like body inside either tuple form.  Returns
+    (body_or_stream, content_type, extra_headers)."""
+    if isinstance(payload, (dict, list)):
+        return json.dumps(payload).encode(), "application/json", {}
+    if isinstance(payload, tuple):
+        body, second = payload
+        if isinstance(second, dict):
+            extra = dict(second)
+            ctype = extra.pop("Content-Type",
+                              "application/octet-stream")
+            return body, ctype, extra
+        return body, second, {}
+    body = payload if isinstance(payload, bytes) else \
+        str(payload).encode()
+    return body, "application/octet-stream", {}
+
+
+def async_front_roles() -> "set[str]":
+    """Roles served by the asyncio front (SEAWEEDFS_TPU_ASYNC_FRONT):
+    "1"/"true" selects the filer gateway (the GIL-bound recv/route/
+    assign/proxy funnel the front exists for); a comma list names
+    roles explicitly (e.g. "filer,s3").  Empty/0 keeps every role on
+    the threaded server."""
+    import os
+    v = os.environ.get("SEAWEEDFS_TPU_ASYNC_FRONT", "").strip().lower()
+    if v in ("", "0", "false"):
+        return set()
+    if v in ("1", "true"):
+        return {"filer"}
+    return {r.strip() for r in v.split(",") if r.strip()}
+
+
 class FileSlice:
     """A file-like over [current position, current position + size) of
     an open file, for streaming byte-range responses; closes the
@@ -205,9 +241,15 @@ class FileSlice:
 
 
 class HttpServer:
-    """Routes: exact-path dict + prefix handlers + fallback."""
+    """Routes: exact-path dict + prefix handlers + fallback.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    `reuse_port=True` binds with SO_REUSEPORT so N sibling processes
+    can share one listener (the filer's pre-fork worker mode: the
+    kernel distributes connections across the workers' accept
+    queues — one gateway address, N GILs)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 reuse_port: bool = False):
         self.routes: dict[tuple[str, str], Route] = {}
         # pre-parsed prefix table, compiled at registration: method ->
         # [(prefix, handler)] longest-first.  Role servers used to
@@ -322,23 +364,8 @@ class HttpServer:
                         req.drain()
                     except Exception:  # noqa: BLE001 — close instead
                         self.close_connection = True
-                    extra_headers: dict = {}
-                    if isinstance(payload, (dict, list)):
-                        body = json.dumps(payload).encode()
-                        ctype = "application/json"
-                    elif isinstance(payload, tuple):
-                        body, second = payload
-                        if isinstance(second, dict):
-                            extra_headers = second
-                            ctype = extra_headers.pop(
-                                "Content-Type",
-                                "application/octet-stream")
-                        else:
-                            ctype = second
-                    else:
-                        body = payload if isinstance(payload, bytes) \
-                            else str(payload).encode()
-                        ctype = "application/octet-stream"
+                    body, ctype, extra_headers = \
+                        normalize_payload(payload)
                     if hasattr(body, "read"):
                         # register for the OUTER finally: a header
                         # write dying on a reset connection would
@@ -440,7 +467,15 @@ class HttpServer:
         class Server(ThreadingHTTPServer):
             daemon_threads = True
             allow_reuse_address = True
+            reuse_port = False   # set below before construction
             ssl_context = None  # set by start() when the TLS plane is on
+
+            def server_bind(self):
+                if self.reuse_port:
+                    import socket as _socket
+                    self.socket.setsockopt(_socket.SOL_SOCKET,
+                                           _socket.SO_REUSEPORT, 1)
+                super().server_bind()
 
             def __init__(self, *a, **kw):
                 super().__init__(*a, **kw)
@@ -523,10 +558,12 @@ class HttpServer:
                     return
                 super().handle_error(request, client_address)
 
+        Server.reuse_port = bool(reuse_port)
         self._httpd = Server((host, port), Handler)
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
+        self._async = None   # asyncio front, when selected (start())
 
     def route(self, method: str, path: str, fn: Route) -> None:
         self.routes[(method, path)] = fn
@@ -548,6 +585,19 @@ class HttpServer:
 
     def start(self) -> None:
         tls = _tls_config()
+        if self.role and self.role in async_front_roles():
+            # asyncio front (async_front.py): one event loop
+            # multiplexes every connection of this role's funnel —
+            # same routes, guard, QoS admission, tracing spans and
+            # request_seconds, different concurrency substrate.  The
+            # already-bound listener socket is handed over so the
+            # port the owner advertised stays the port served.
+            from .async_front import AsyncFront
+            self._async = AsyncFront(
+                self, ssl_context=(tls.server_context()
+                                   if tls is not None else None))
+            self._async.start(self._httpd.socket)
+            return
         if tls is not None:
             # TLS plane (weed/security/tls.go); connections handshake
             # in their handler threads (Server.finish_request), with
@@ -565,6 +615,15 @@ class HttpServer:
         self._httpd.server_close()
 
     def stop(self) -> None:
+        a = getattr(self, "_async", None)
+        if a is not None:
+            self._async = None
+            a.stop()
+            try:
+                self._httpd.server_close()  # shared socket: idempotent
+            except OSError:
+                pass
+            return
         self._httpd.shutdown()
         self._httpd.server_close()
         # sever established keep-alive connections: in-flight handlers

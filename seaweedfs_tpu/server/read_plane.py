@@ -75,6 +75,15 @@ class ReadPlane:
         self._lib.rp_put(self._h, vid, needle.id, needle.cookie,
                          data_off, len(needle.data))
 
+    def register_raw(self, vid: int, needle_id: int, cookie: int,
+                     data_off: int, data_len: int) -> None:
+        """Register from already-known record geometry (the native
+        write plane's journal carries exactly these fields) — no
+        needle parse, no flush: the writer's pwrite already made the
+        bytes visible to this plane's fd."""
+        self._lib.rp_put(self._h, vid, needle_id, cookie, data_off,
+                         data_len)
+
     def delete_needle(self, vid: int, needle_id: int) -> None:
         self._lib.rp_del(self._h, vid, needle_id)
 

@@ -107,6 +107,9 @@ class UdsNeedleServer:
         dup_fd = None
         payload = None
         with v.lock:
+            # read-your-native-writes: a write-plane ack whose journal
+            # entry hasn't drained yet must still be UDS-readable
+            v._drain_if_pending()
             got = v.nm.get(key)
             if got is None:
                 conn.sendall(json.dumps(

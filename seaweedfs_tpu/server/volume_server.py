@@ -35,6 +35,11 @@ from .httpd import FileSlice, HttpServer, Request, http_bytes, \
 # front door) lives in security.py
 _check_path_fields = security.check_path_fields
 
+# byte offset of the payload inside a needle record (header + DataSize
+# field) — the read plane's registration math (read_plane.py), reused
+# when native WRITE-plane appends warm the read plane
+_WP_DATA_OFFSET = types.NEEDLE_HEADER_SIZE + 4
+
 
 class VolumeServer:
     def __init__(self, directories: list[str], master: str,
@@ -214,6 +219,32 @@ class VolumeServer:
             self._rp_queue = queue.Queue(maxsize=4096)
             threading.Thread(target=self._rp_worker,
                              daemon=True).start()
+        # native TCP WRITE plane (native/write_plane.cc — the C++
+        # sibling of the read plane on the needle-write hot path):
+        # plain anonymous uploads are recv'd, serialized, appended and
+        # acked by an epoll loop; everything else 404s and the client
+        # falls back to this port.  Same auth rule as the read plane
+        # (the plane carries no JWT), kill switch
+        # SEAWEEDFS_TPU_WRITE_PLANE=0.
+        self.write_plane = None
+        if not self.security.volume_write_key and \
+                os.environ.get("SEAWEEDFS_TPU_WRITE_PLANE", "1") \
+                not in ("0", "false"):
+            try:
+                from .write_plane import WritePlane
+                self.write_plane = WritePlane(
+                    self.http.host, on_tick=self._wp_tick,
+                    on_epoch=self._wp_epoch)
+            except (RuntimeError, OSError):
+                self.write_plane = None   # pure-Python fallback
+        if self.write_plane is not None:
+            # eager attach: a volume the plane doesn't own answers
+            # every native write with a 404 + client fallback, so
+            # eligible volumes are handed over up front (and re-synced
+            # at every lifecycle transition below)
+            for loc in self.store.locations:
+                for vid in list(loc.volumes):
+                    self._wp_sync_volume(vid)
         # gRPC wire plane (volume_server.proto subset) — optional;
         # JSON-HTTP stays the always-on surface
         try:
@@ -298,6 +329,68 @@ class VolumeServer:
                 self._rp_volumes.add(vid)
             rp.register_needle(vid, got[0], needle)
             self._rp_seen.setdefault(vid, set()).add(needle.id)
+
+    # -- native write plane glue (server/write_plane.py) ------------------
+
+    def _wp_sync_volume(self, vid: int) -> None:
+        """(Re-)offer a volume to the native write plane after a
+        lifecycle transition; attach failures fall back lazily — the
+        Python port owns the writes and nothing breaks (the read
+        plane's registration-failure contract)."""
+        wp = getattr(self, "write_plane", None)
+        if wp is None:
+            return
+        v = self.store.find_volume(vid)
+        if v is None:
+            return
+        try:
+            v.attach_native(wp)   # False for ineligible shapes
+        except (OSError, RuntimeError, ValueError) as e:
+            wlog.warning(f"write plane attach vid={vid} failed "
+                         f"(python path serves it): {e!r}")
+
+    def _wp_tick(self) -> None:
+        """Pump-thread tick: drain every attached volume's completed
+        native appends into its needle map / .idx checkpoint, and
+        mirror them into the read plane (epoch-checked like
+        _rp_register — a vacuum racing the drain drops the warm, lazy
+        re-registration recovers it)."""
+        rp = self.read_plane
+        for loc in self.store.locations:
+            for v in list(loc.volumes.values()):
+                vid = v.id
+                with self._rp_lock:
+                    gen = self._rp_gen.get(vid, 0)
+                entries = v.drain_native()
+                if not entries or rp is None:
+                    continue
+                data_off_base = _WP_DATA_OFFSET
+                with self._rp_lock:
+                    if self._rp_gen.get(vid, 0) != gen:
+                        continue   # dropped mid-drain: offsets stale
+                    if vid not in self._rp_volumes:
+                        try:
+                            if not rp.add_volume(
+                                    vid, v.file_name(".dat")):
+                                continue
+                        except OSError:
+                            continue
+                        self._rp_volumes.add(vid)
+                    seen = self._rp_seen.setdefault(vid, set())
+                    for e in entries:
+                        rp.register_raw(
+                            vid, e.key, e.cookie,
+                            e.offset + data_off_base, e.data_len)
+                        seen.add(e.key)
+
+    def _wp_epoch(self, vid: int, epoch: int) -> None:
+        """fsync-tier handshake: parked native acks wait on the
+        volume's CommitBarrier — one barrier (one os.fsync) covers
+        the whole epoch window, group commit across the C++
+        boundary."""
+        v = self.store.find_volume(vid)
+        if v is not None:
+            v._barrier.commit()
 
     def _rp_drop_volume(self, vid: int) -> None:
         """Forget a volume in the read plane (vacuum swapped the .dat,
@@ -393,7 +486,11 @@ class VolumeServer:
             self.grpc_server.stop(grace=0.5)
         self.http.stop()
         self.ec_reader.close()
+        # store.close() detaches every volume from the write plane
+        # (drain + .idx checkpoint), so the plane must outlive it
         self.store.close()
+        if getattr(self, "write_plane", None) is not None:
+            self.write_plane.stop()
 
     @property
     def url(self) -> str:
@@ -503,8 +600,58 @@ class VolumeServer:
             "max_volume_count", hb["maxVolumeCount"])
         from ..stats import render_process
         return 200, ((self.metrics.render() +
+                      self._plane_metrics_text() +
                       render_process()).encode(),
                      "text/plain; version=0.0.4")
+
+    def _plane_metrics_text(self) -> str:
+        """Native-plane counters rendered straight from the C++
+        atomics (the plane has no Python on its hot path, so the
+        registry hears about it only at scrape time): write-plane
+        requests/fallbacks + native-ack latency histogram, and the
+        read plane's served counter beside its Python-port fallback
+        sibling (counted in _get_needle)."""
+        out = []
+        rp = getattr(self, "read_plane", None)
+        if rp is not None:
+            out.append(
+                "# HELP volume_server_read_plane_requests_total "
+                "needle reads served by the native read plane\n"
+                "# TYPE volume_server_read_plane_requests_total "
+                "counter\n"
+                f"volume_server_read_plane_requests_total "
+                f"{rp.served()}\n")
+        wp = getattr(self, "write_plane", None)
+        if wp is None:
+            return "".join(out)
+        out.append(
+            "# HELP volume_server_write_plane_requests_total needle "
+            "writes acked by the native write plane\n"
+            "# TYPE volume_server_write_plane_requests_total counter\n"
+            f"volume_server_write_plane_requests_total "
+            f"{wp.requests()}\n"
+            "# HELP volume_server_write_plane_fallbacks_total native "
+            "writes answered 404 (python port owns them)\n"
+            "# TYPE volume_server_write_plane_fallbacks_total "
+            "counter\n"
+            f"volume_server_write_plane_fallbacks_total "
+            f"{wp.fallbacks()}\n")
+        from .write_plane import ACK_BUCKETS_S
+        buckets, count, total_s = wp.ack_histogram()
+        out.append("# HELP volume_server_write_plane_ack_seconds "
+                   "native write-plane ack latency\n"
+                   "# TYPE volume_server_write_plane_ack_seconds "
+                   "histogram\n")
+        for le, cum in zip(ACK_BUCKETS_S, buckets):
+            out.append(f"volume_server_write_plane_ack_seconds_bucket"
+                       f'{{le="{le}"}} {cum}\n')
+        out.append(f"volume_server_write_plane_ack_seconds_bucket"
+                   f'{{le="+Inf"}} {count}\n'
+                   f"volume_server_write_plane_ack_seconds_sum "
+                   f"{total_s}\n"
+                   f"volume_server_write_plane_ack_seconds_count "
+                   f"{count}\n")
+        return "".join(out)
 
     def _get_needle(self, fid: types.FileId, rng: str = "",
                     query: "dict | None" = None, req=None):
@@ -521,6 +668,15 @@ class VolumeServer:
                 return 404, {"error": "not found"}
             except ValueError as e:
                 return 404, {"error": str(e)}
+            if self.read_plane is not None:
+                # symmetry with write_plane_fallbacks_total: a read
+                # served here while the native plane is up is a
+                # fallback (unwarmed, non-plain, or a client that
+                # never tried the plane)
+                self.metrics.counter_add(
+                    "read_plane_fallbacks_total", 1.0,
+                    help_text="python-port data reads while the "
+                              "native read plane is active")
             self._rp_register(fid.volume_id, n, lazy=True)  # plane warm
             if not getattr(n, "was_degraded", False) or \
                     os.environ.get("SEAWEEDFS_TPU_DEGRADED_PROMOTE",
@@ -748,9 +904,11 @@ class VolumeServer:
     def _status(self, req: Request):
         uds = getattr(self, "uds_server", None)
         rp = getattr(self, "read_plane", None)
+        wp = getattr(self, "write_plane", None)
         return 200, {"version": "seaweedfs-tpu/0.1",
                      "udsPath": uds.sock_path if uds else "",
                      "readPlanePort": rp.port if rp else 0,
+                     "writePlanePort": wp.port if wp else 0,
                      **self.store.collect_heartbeat()}
 
     # -- volume admin -----------------------------------------------------
@@ -763,6 +921,7 @@ class VolumeServer:
         self.store.add_volume(
             int(b["volumeId"]), collection,
             b.get("replication", ""), b.get("ttl", ""))
+        self._wp_sync_volume(int(b["volumeId"]))
         self._heartbeat_once()  # instant topology notify
         return 200, {}
 
@@ -778,6 +937,7 @@ class VolumeServer:
         collection = b.get("collection", "")
         _check_path_fields(collection)
         self.store.mount_volume(int(b["volumeId"]), collection)
+        self._wp_sync_volume(int(b["volumeId"]))
         return 200, {}
 
     def _unmount_volume(self, req: Request):
@@ -793,6 +953,8 @@ class VolumeServer:
         v = self.store.find_volume(vid)
         if v is not None and v.read_only:
             v.sync()  # commit buffered .dat/.idx before anyone copies them
+        elif v is not None:
+            self._wp_sync_volume(vid)   # un-freeze: plane-eligible again
         # instant topology notify (same rule as mount/unmount): until
         # the master sees the flag it keeps ASSIGNING this volume, and
         # every write raced into the readonly window costs the client
@@ -845,9 +1007,12 @@ class VolumeServer:
         garbage = v.garbage_level()
         # compaction rewrites the .dat (offsets move): drop the read
         # plane's index FIRST so no stale (offset,len) can be served
-        # against the swapped file; survivors lazily re-register
+        # against the swapped file; survivors lazily re-register.
+        # (Volume.compact detaches the write plane itself — the .idx
+        # snapshot must be complete — so re-offer it after the swap.)
         self._rp_drop_volume(vid)
         v.vacuum()
+        self._wp_sync_volume(vid)
         return 200, {"garbageRatio": garbage}
 
     def _merge_volume(self, req: Request):
@@ -1007,6 +1172,7 @@ class VolumeServer:
         v.save_volume_info()
         self.store.unmount_volume(vid)
         self.store.mount_volume(vid, collection)
+        self._wp_sync_volume(vid)   # local + writable again
         if bool(b.get("deleteRemote", True)):
             storage.delete(remote["key"])
         self._heartbeat_once()
@@ -1140,7 +1306,11 @@ class VolumeServer:
         base = self._base_path(vid, collection)
         if ext in (".dat", ".idx"):
             # a pushed data/index file replaces volume content under
-            # any cached needles
+            # any cached needles — and under the write plane's owned
+            # tail, which must be given back first
+            v = self.store.find_volume(vid)
+            if v is not None:
+                v.detach_native()
             self._nc_drop_volume(vid)
         n = 0
         # temp + rename, like the gRPC ReceiveFile twin: a push that
@@ -1862,6 +2032,7 @@ class VolumeServer:
         ec_decoder.write_idx_file_from_ec_index(base)
         self.store.unmount_ec_shards(vid)
         self.store.mount_volume(vid, collection)
+        self._wp_sync_volume(vid)
         self._heartbeat_once()
         return 200, {}
 

@@ -1,0 +1,215 @@
+"""Native HTTP write plane wrapper (native/write_plane.cc).
+
+The volume server's second implementation of the needle-WRITE surface
+— the sibling of server/read_plane.py: a C++ epoll loop that recvs
+the framed upload, serializes the v3 needle record, appends to the
+.dat fd it owns, and acks — no Python, no GIL, on the hot path.
+arXiv:1709.05365's host-side per-request overhead, removed at the
+source.
+
+Contract highlights (details in write_plane.cc):
+
+* While a volume is attached, the plane owns the .dat TAIL.  Python
+  appends (overwrites, tombstones, replication, repair) route through
+  `append()` — the same per-volume mutex — so records never
+  interleave.
+* Completed native appends are journaled; `drain()` hands them back
+  for NeedleMap + .idx application (the .dat is the WAL, the .idx a
+  checkpoint, `Volume._replay_dat_tail` recovers after a crash).
+* Anything non-plain — named/mimed uploads, authenticated writes,
+  overwrites of seen keys, unregistered volumes — answers 404 and the
+  client falls back to the Python port (the read plane's contract).
+* Durability: write(2) is page-cache durable before the ack (the
+  group-commit flush guarantee); on the -fsync tier acks park on a
+  flush epoch that the handshake thread resolves by running the
+  volume's CommitBarrier — group commit across the language boundary.
+
+Failure contract: every method degrades to "plane unavailable"
+(False/-1/[]) rather than raising into the write path; the volume
+server keeps serving through Python exactly as if the .so had never
+built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from collections import namedtuple
+
+from .. import native
+from ..util import wlog
+
+# ack latency histogram bucket bounds (write_plane.cc kLatBuckets), in
+# seconds — rendered on /metrics as write_plane_ack_seconds
+ACK_BUCKETS_S = (1e-6, 2e-6, 5e-6, 1e-5, 2e-5, 5e-5, 1e-4, 2e-4,
+                 5e-4, 1e-3, 2e-3, 5e-3, 1e-2, 2e-2, 5e-2, 1e-1, 1.0)
+
+NativeWrite = namedtuple(
+    "NativeWrite", "key offset append_ns vid cookie size data_len")
+
+
+class WritePlane:
+    """One native write-plane server bound to <host>:<ephemeral>.
+
+    `on_tick` (pump thread, ~40Hz) lets the owner drain attached
+    volumes' journals into their needle maps; `on_epoch(vid, epoch)`
+    (handshake thread) must make the volume's acked bytes as durable
+    as its CommitBarrier promises — the wrapper always calls
+    wp_epoch_done afterwards, releasing the parked acks."""
+
+    _DRAIN_CAP = 4096
+
+    def __init__(self, host: str = "127.0.0.1", on_tick=None,
+                 on_epoch=None, tick_interval: float = 0.025):
+        self._lib = native.load_write_plane()
+        if self._lib is None:
+            raise RuntimeError("native write plane unavailable")
+        port = ctypes.c_int(0)
+        self._h = self._lib.wp_start(host.encode(), 0,
+                                     ctypes.byref(port))
+        if self._h < 0:
+            raise RuntimeError("write plane failed to start")
+        self.host = host
+        self.port = port.value
+        self._on_tick = on_tick
+        self._on_epoch = on_epoch
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._epoch_started = False
+        self._epoch_lock = threading.Lock()
+        if on_tick is not None:
+            t = threading.Thread(target=self._pump_loop,
+                                 args=(tick_interval,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # -- volume attachment (called from storage.Volume under its lock) --
+
+    def add_volume(self, vid: int, dat_path: str, tail: int,
+                   last_append_ns: int, fsync: bool) -> bool:
+        if fsync:
+            # the handshake thread exists only once an -fsync volume
+            # can park acks on a flush epoch: most deployments (and
+            # every default-tier test teardown) never pay its
+            # wait-loop join at stop()
+            self._ensure_epoch_thread()
+        try:
+            return self._lib.wp_add_volume(
+                self._h, vid, dat_path.encode(), tail,
+                last_append_ns, 1 if fsync else 0) == 0
+        except OSError:
+            return False
+
+    def _ensure_epoch_thread(self) -> None:
+        with self._epoch_lock:
+            if self._epoch_started:
+                return
+            self._epoch_started = True
+            t = threading.Thread(target=self._epoch_loop, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def mark_keys(self, vid: int, keys) -> None:
+        """Seed the plane's seen-key fallback set.  `keys` is any
+        iterable of needle ids; array.array avoids materializing a
+        second full Python list for multi-million-needle volumes."""
+        import array
+        a = array.array("Q", keys)
+        if not a:
+            return
+        buf = (ctypes.c_ulonglong * len(a)).from_buffer(a)
+        self._lib.wp_mark_keys(self._h, vid, buf, len(a))
+
+    def arm(self, vid: int) -> bool:
+        """Open the volume for native HTTP writes — strictly after
+        mark_keys, or an overwrite could slip past the seen-key
+        fallback in the handshake window."""
+        return self._lib.wp_arm(self._h, vid) == 0
+
+    def remove_volume(self, vid: int) -> None:
+        self._lib.wp_remove_volume(self._h, vid)
+
+    def append(self, vid: int, key: int, record: bytes,
+               append_ns: int) -> int:
+        """Append a fully-serialized record through the plane's tail
+        mutex; returns the byte offset or -1 (not attached)."""
+        return self._lib.wp_append(self._h, vid, key, record,
+                                   len(record), append_ns)
+
+    def drain(self, vid: int) -> "list[NativeWrite]":
+        out: list[NativeWrite] = []
+        buf = (native.WpEntry * self._DRAIN_CAP)()
+        while True:
+            n = self._lib.wp_drain(self._h, vid, buf, self._DRAIN_CAP)
+            for i in range(n):
+                e = buf[i]
+                out.append(NativeWrite(e.key, e.offset, e.append_ns,
+                                       e.vid, e.cookie, e.size,
+                                       e.data_len))
+            if n < self._DRAIN_CAP:
+                return out
+
+    def pending(self, vid: int) -> int:
+        return self._lib.wp_pending(self._h, vid)
+
+    # -- telemetry ------------------------------------------------------
+
+    def requests(self) -> int:
+        return self._lib.wp_requests(self._h)
+
+    def fallbacks(self) -> int:
+        return self._lib.wp_fallbacks(self._h)
+
+    def ack_histogram(self) -> "tuple[list[int], int, float]":
+        """(cumulative bucket counts aligned with ACK_BUCKETS_S + an
+        +Inf cell, total count, sum seconds)."""
+        out = (ctypes.c_ulonglong * 20)()
+        cells = self._lib.wp_latency(self._h, out)
+        buckets = [int(out[i]) for i in range(cells)]
+        return buckets, int(out[cells]), out[cells + 1] / 1e9
+
+    # -- background threads ---------------------------------------------
+
+    def _pump_loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            try:
+                self._on_tick()
+            except Exception:  # noqa: SWFS004 — journal upkeep must
+                pass           # never kill the pump
+        # final tick so a stop() mid-window leaves nothing undrained
+        try:
+            self._on_tick()
+        except Exception:  # noqa: SWFS004
+            pass
+
+    def _epoch_loop(self) -> None:
+        vid = ctypes.c_uint(0)
+        epoch = ctypes.c_ulonglong(0)
+        while not self._stop.is_set():
+            got = self._lib.wp_wait_epoch(self._h, 200,
+                                          ctypes.byref(vid),
+                                          ctypes.byref(epoch))
+            if not got:
+                continue
+            try:
+                if self._on_epoch is not None:
+                    self._on_epoch(vid.value, epoch.value)
+            except Exception as e:  # noqa: BLE001 — parked acks must
+                # be released even when the barrier helper dies; the
+                # bytes are page-cache durable regardless
+                wlog.warning(f"write plane epoch flush failed: {e!r}")
+            finally:
+                self._lib.wp_epoch_done(self._h, vid.value,
+                                        epoch.value)
+
+    def stop(self) -> None:
+        """Threads first, then the native server: wp_stop frees the
+        Server object, so no wrapper thread may still be inside a
+        wp_* call when it runs."""
+        if self._h < 0:
+            return
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._lib.wp_stop(self._h)
+        self._h = -1

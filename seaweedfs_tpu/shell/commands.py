@@ -1191,6 +1191,45 @@ def _group_commit_report(before: "dict[str, list]",
     return "group-commit: " + "  ".join(parts)
 
 
+def _native_plane_report(before: "dict[str, list]",
+                         after: "dict[str, list]") -> str:
+    """Native read/write plane view over the sampling window: acks
+    and fallbacks per plane plus the native ack-latency p99 (C++
+    atomics rendered by the volume server's /metrics).  Empty when
+    the node runs no native plane."""
+    from .. import profiling
+    parts = []
+    wname = "volume_server_write_plane_ack_seconds"
+    wr = _counter_sum(
+        after, "volume_server_write_plane_requests_total") - \
+        _counter_sum(before, "volume_server_write_plane_requests_total")
+    wf = _counter_sum(
+        after, "volume_server_write_plane_fallbacks_total") - \
+        _counter_sum(before,
+                     "volume_server_write_plane_fallbacks_total")
+    if f"{wname}_count" in after:
+        h = profiling.histogram_delta(
+            profiling.prom_histogram(after, wname),
+            profiling.prom_histogram(before, wname))
+        p99 = profiling.histogram_quantile(h, 0.99) \
+            if h and h.get("count") else 0.0
+        parts.append(f"write {wr:.0f} acked/{wf:.0f} fallback"
+                     f" ack-p99={p99 * 1e3:.2f}ms")
+    rr = _counter_sum(
+        after, "volume_server_read_plane_requests_total") - \
+        _counter_sum(before,
+                     "volume_server_read_plane_requests_total")
+    rf = _counter_sum(
+        after, "volume_server_read_plane_fallbacks_total") - \
+        _counter_sum(before,
+                     "volume_server_read_plane_fallbacks_total")
+    if "volume_server_read_plane_requests_total" in after:
+        parts.append(f"read {rr:.0f} served/{rf:.0f} fallback")
+    if not parts:
+        return ""
+    return "native-planes: " + "  ".join(parts)
+
+
 @command("cluster.top")
 def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
     """Live one-screen cluster view: every node's /metrics sampled
@@ -1296,6 +1335,9 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
         stages = _stage_report(b or {}, a, ns)
         if stages:
             out.append("  " + stages)
+        planes = _native_plane_report(b or {}, a)
+        if planes:
+            out.append("  " + planes)
         gc = _group_commit_report(b or {}, a)
         if gc:
             out.append("  " + gc)

@@ -164,6 +164,18 @@ PROCESS = Metrics("seaweedfs_tpu")
 
 
 def render_process() -> str:
+    # process CPU, refreshed per scrape — operator visibility
+    # (cluster.top / any Prometheus scrape can divide its delta by
+    # request-rate deltas per node).  NOTE: bench.py's per-role CPU
+    # attribution deliberately reads /proc process TREES instead —
+    # a per-process gauge cannot cover the filer's pre-fork workers.
+    # os.times() covers every thread and costs ~1us.
+    import os
+    t = os.times()
+    PROCESS.gauge_set(
+        "process_cpu_seconds", t[0] + t[1],
+        help_text="user+system CPU consumed by this process "
+                  "(cumulative; exported as a gauge)")
     return PROCESS.render()
 
 

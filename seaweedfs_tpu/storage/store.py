@@ -195,6 +195,12 @@ class Store:
         v = self.find_volume(vid)
         if v is None:
             raise KeyError(f"volume {vid} not found")
+        if read_only:
+            # freeze means freeze: the native write plane must stop
+            # acking appends the Python side would now refuse (the
+            # volume server re-attaches on un-freeze via its
+            # eligibility sync)
+            v.detach_native()
         v.read_only = read_only
 
     # -- needle IO (store.go:580/:604) ------------------------------------

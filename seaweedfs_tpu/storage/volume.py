@@ -163,9 +163,165 @@ class Volume:
             self._dat.write(self.super_block.to_bytes())
             self._dat.flush()
         self.nm = NeedleMap(base + ".idx")
+        # native write plane attachment (server/write_plane.py): while
+        # set, the C++ plane owns the .dat tail — Python appends route
+        # through wp.append under the plane's per-volume mutex, and
+        # completed native appends drain back into self.nm before any
+        # index-dependent operation runs
+        self._wp = None
+        if not self.is_remote:
+            # the .dat is the write-ahead log, the .idx a checkpoint
+            # that may trail it (native-plane acks don't wait for the
+            # .idx record): replay the unindexed tail so every acked
+            # write is reachable after a crash
+            self._replay_dat_tail()
         self.volume_info = vi or VolumeInfo(
             version=self.super_block.version,
             replication=str(self.super_block.replica_placement))
+
+    # -- native write plane (server/write_plane.py) ----------------------
+
+    def _replay_dat_tail(self) -> None:
+        """Crash recovery for the native-write-plane contract: scan
+        .dat records past the .idx checkpoint (the newest indexed PUT)
+        and re-apply them to the needle map — a native-acked write is
+        durable in the .dat the moment write(2) returned, so the index
+        must be reconstructible from it.  Idempotent (re-scanned
+        records that already match the map are skipped), and the scan
+        stops at the first torn record (CRC/bounds failure): records
+        are strictly append-ordered, so nothing valid can follow a
+        tear, and an unacked half-write never half-appears."""
+        last = self.nm.last_put
+        if last is not None:
+            start = types.to_actual_offset(last[0]) + \
+                get_actual_size(last[1], self.version)
+        else:
+            start = (self.super_block.block_size() +
+                     types.NEEDLE_PADDING_SIZE - 1) // \
+                types.NEEDLE_PADDING_SIZE * types.NEEDLE_PADDING_SIZE
+        try:
+            total = os.path.getsize(self.file_name(".dat"))
+        except OSError:
+            return
+        if start >= total:
+            return
+        import struct as _struct
+        with open(self.file_name(".dat"), "rb") as f:
+            offset = start
+            while offset + types.NEEDLE_HEADER_SIZE <= total:
+                f.seek(offset)
+                header = f.read(types.NEEDLE_HEADER_SIZE)
+                if len(header) < types.NEEDLE_HEADER_SIZE:
+                    break
+                n = Needle.parse_header(header)
+                deleted_mark = n.size < 0
+                masked = n.size
+                if deleted_mark:
+                    masked = 0 if types.size_is_tombstone(n.size) \
+                        else types.size_to_u32(n.size) & 0x7FFFFFFF
+                rec_len = get_actual_size(masked, self.version)
+                if offset + rec_len > total:
+                    break                       # truncated tail
+                f.seek(offset)
+                buf = f.read(rec_len)
+                n = Needle.parse_header(buf)
+                n.size = masked
+                try:
+                    n.parse_body(
+                        buf[types.NEEDLE_HEADER_SIZE:
+                            types.NEEDLE_HEADER_SIZE +
+                            needle_body_length(masked, self.version)],
+                        self.version, check_crc=True)
+                except (ValueError, _struct.error):
+                    break                       # torn record: stop
+                if deleted_mark:
+                    n.data = b""
+                stored = types.to_stored_offset(offset)
+                if n.data and types.size_is_valid(n.size):
+                    if self.nm._m.get(n.id) != (stored, n.size):
+                        self.nm.put(n.id, stored, n.size)
+                elif self.nm.get(n.id) is not None:
+                    self.nm.delete(n.id)        # tombstone record
+                if n.append_at_ns > self.last_append_at_ns:
+                    self.last_append_at_ns = n.append_at_ns
+                offset += rec_len
+        self.nm.flush()  # noqa: SWFS012 — one-time open-path recovery checkpoint
+
+    def attach_native(self, wp) -> bool:
+        """Hand the .dat tail to the native write plane.  Returns
+        False (and stays detached) for shapes the plane can't own:
+        remote/readonly volumes, pre-v3 formats, TTL'd superblocks,
+        replicated placements — their write semantics need Python."""
+        with self.lock:
+            if self._wp is not None:
+                return True
+            if self.is_remote or self.read_only or \
+                    self.version != types.VERSION3 or \
+                    bool(self.super_block.ttl) or \
+                    self.super_block.replica_placement.byte() or \
+                    self.id >= 0x80000000:
+                return False
+            # the plane appends with its own fd: the buffered tail
+            # must be on the file before the plane snapshots it
+            self._dat.flush()  # noqa: SWFS012 — one-time attach handoff, not a write ack
+            self._dat.seek(0, os.SEEK_END)
+            tail = self._dat.tell()
+            if not wp.add_volume(self.id, self.file_name(".dat"),
+                                 tail, self.last_append_at_ns,
+                                 self.fsync):
+                return False
+            # every key ever mapped (live AND tombstoned) falls back
+            # to the Python port: overwrite cookie/dedup semantics
+            # stay in one place.  The plane stays DISARMED (404s
+            # everything) until the set is complete — arm() closes
+            # the mark-window an early native overwrite could slip
+            # through.
+            wp.mark_keys(self.id, self.nm._m.keys())
+            if not wp.arm(self.id):
+                wp.remove_volume(self.id)
+                return False
+            self._wp = wp
+            return True
+
+    def detach_native(self) -> None:
+        """Take the tail back: stop native appends, then drain every
+        completed append into the index so the .idx checkpoint is
+        complete before whatever required the detach (compaction,
+        readonly freeze, close) proceeds."""
+        with self.lock:
+            wp = self._wp
+            if wp is None:
+                return
+            self._wp = None
+            wp.remove_volume(self.id)
+            self._apply_native_entries(wp.drain(self.id))
+            self.nm.flush()  # noqa: SWFS012 — detach checkpoint (freeze/compact/close path)
+
+    def drain_native(self) -> list:
+        """Apply completed native appends to the in-memory index and
+        the .idx checkpoint (the pump thread's tick, and the
+        read-your-native-writes hook).  Returns the applied entries so
+        the volume server can warm the read plane."""
+        wp = self._wp
+        if wp is None:
+            return []
+        with self.lock:
+            return self._apply_native_entries(wp.drain(self.id))
+
+    def _drain_if_pending(self) -> None:
+        """Index-op prologue (caller holds the lock): make the needle
+        map current with every native append completed so far."""
+        wp = self._wp
+        if wp is not None and wp.pending(self.id):
+            self._apply_native_entries(wp.drain(self.id))
+
+    def _apply_native_entries(self, entries: list) -> list:
+        for e in entries:
+            self.nm.put(e.key, types.to_stored_offset(e.offset),
+                        e.size)
+            if e.append_ns > self.last_append_at_ns:
+                self.last_append_at_ns = e.append_ns
+        return entries
 
     # -- naming (volume.go FileName) -------------------------------------
 
@@ -254,6 +410,7 @@ class Volume:
                 raise PermissionError(f"volume {self.id} is read-only")
             if not n.has_ttl() and self.super_block.ttl:
                 n.set_ttl(self.super_block.ttl)
+            self._drain_if_pending()   # read-your-native-writes
             with profiling.stage("index"):
                 existing = self.nm.get(n.id)
             if existing is not None:
@@ -319,6 +476,24 @@ class Volume:
                 # -fsync tier: go again on the swapped-in handles
 
     def _append(self, n: Needle) -> int:
+        wp = self._wp
+        if wp is not None:
+            # the plane owns the tail: route this record through the
+            # shared per-volume mutex so it never interleaves with a
+            # native HTTP append.  write(2) semantics make the record
+            # page-cache durable before return — at least as durable
+            # as the buffered path's barrier flush.
+            rec = n.to_bytes(self.version)
+            off = wp.append(self.id, n.id, rec, n.append_at_ns)
+            if off >= 0:
+                return off
+            # plane refused (pwrite failure / shutdown race): a FULL
+            # detach, not just a local flag clear — the plane must
+            # stop acking native writes (it still thought it owned
+            # the tail) and its journal must drain into the index
+            # before Python takes the tail back, or both sides would
+            # append at the same offsets
+            self.detach_native()
         self._dat.seek(0, os.SEEK_END)
         offset = self._dat.tell()
         if offset % types.NEEDLE_PADDING_SIZE != 0:
@@ -347,6 +522,7 @@ class Volume:
         with self.lock:
             if self.read_only:
                 raise PermissionError(f"volume {self.id} is read-only")
+            self._drain_if_pending()
             existing = self.nm.get(n.id)
             if existing is None:
                 return 0
@@ -441,6 +617,7 @@ class Volume:
     def read_needle(self, needle_id: int, cookie: int | None = None
                     ) -> Needle:
         with self.lock:
+            self._drain_if_pending()   # read-your-native-writes
             got = self.nm.get(needle_id)
             if got is None:
                 raw = self.nm._m.get(needle_id)
@@ -473,6 +650,11 @@ class Volume:
             raise PermissionError(
                 f"volume {self.id} is tiered to a remote backend; "
                 f"fetch it back before compacting")
+        # the compaction snapshot AND the makeupDiff tail replay read
+        # the .idx — a native plane appending past both would lose
+        # records in the swap, so the plane gives the tail back first
+        # (the volume server re-attaches after commit)
+        self.detach_native()
         cpd = self.file_name(".cpd")
         cpx = self.file_name(".cpx")
         with self.lock:
@@ -607,6 +789,7 @@ class Volume:
         same shadow + rename dance as compaction.  Returns the merged
         live-needle count.  The volume must be read-only — merging
         under writes would lose the race's loser silently."""
+        self.detach_native()   # readonly normally already detached
         with self.lock:
             if not self.read_only:
                 raise PermissionError(
@@ -696,6 +879,9 @@ class Volume:
 
     def sync(self) -> None:
         with self.lock:
+            # callers copy/inspect the .idx next: fold undrained
+            # native appends into the checkpoint first
+            self._drain_if_pending()
             self._dat.flush()  # noqa: SWFS012 — explicit full-volume barrier (copy/admin paths)
             if not self.is_remote:
                 os.fsync(self._dat.fileno())  # noqa: SWFS012 — explicit full-volume barrier
@@ -707,6 +893,7 @@ class Volume:
         save_volume_info(self.file_name(".vif"), self.volume_info)
 
     def close(self) -> None:
+        self.detach_native()
         with self.lock:
             self._drop_mmap()
             self._dat.flush()

@@ -137,6 +137,75 @@ def test_volume_sigkill_acked_needles_survive(cluster):
     assert st["volumes"], "volume did not remount after SIGKILL"
 
 
+def test_volume_sigkill_native_write_plane_acked_survive(cluster):
+    """ISSUE 12: the PR 8 ack contract enforced across the C++
+    boundary — writers hit the NATIVE write plane directly, the
+    volume server is SIGKILLed mid-load, and every native-acked write
+    must survive restart byte-identical (the .dat tail replay rebuilds
+    the index the .idx checkpoint had not caught up to), while
+    unacked writes never half-appear."""
+    from seaweedfs_tpu import operation
+    master = cluster.master
+    vol = cluster.procs["volume0"]
+
+    st = http_json("GET", f"{vol.url}/status", timeout=10)
+    wp_port = st.get("writePlanePort", 0)
+    if not wp_port:
+        pytest.skip("native write plane unavailable in this image")
+    wp_addr = f"127.0.0.1:{wp_port}"
+
+    attempted = {}
+    att_lock = threading.Lock()
+
+    def write(tag, blob):
+        a = operation.assign(master)
+        with att_lock:
+            attempted[a.fid] = blob
+        st, _, _ = http_bytes("POST", f"{wp_addr}/{a.fid}", blob,
+                              timeout=10)
+        return a.fid if st == 201 else None
+
+    load = _Load(write)
+    # prove the native plane is the thing serving before the kill
+    probe = operation.assign(master)
+    st0, _, _ = http_bytes("POST", f"{wp_addr}/{probe.fid}",
+                           b"native-probe", timeout=10)
+    assert st0 == 201, "native write plane refused a plain write"
+    m, body, _ = http_bytes("GET", f"{vol.url}/metrics", timeout=10)
+    assert b"volume_server_write_plane_requests_total" in body
+    # 1.0s of native-rate load acks plenty of writes inside open
+    # journal windows (tier-1 budget: every acked fid is GET-verified
+    # below, so the window directly scales the test's wall)
+    load.run_through_kill(vol, load_s=1.0)
+    assert load.acked, "no native writes were acked before the kill"
+
+    vol.start()                  # same port, same dirs
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            st = http_json("GET", f"{vol.url}/status", timeout=5)
+            if st.get("volumes"):
+                break
+        except OSError:
+            pass
+        time.sleep(0.2)
+
+    # every NATIVE-acked write survives SIGKILL byte-identical
+    for fid, blob in load.acked.items():
+        st, body, _ = http_bytes("GET", f"{vol.url}/{fid}", timeout=10)
+        assert st == 200, f"native-acked needle {fid} lost: {st}"
+        assert body == blob, f"native-acked needle {fid} corrupted"
+
+    # unacked writes never half-appear: gone, or whole
+    for fid, blob in attempted.items():
+        if fid in load.acked:
+            continue
+        st, body, _ = http_bytes("GET", f"{vol.url}/{fid}", timeout=10)
+        assert st in (200, 404)
+        if st == 200:
+            assert body == blob, f"torn needle {fid} served"
+
+
 def test_filer_sigkill_acked_entries_and_metalog_survive(cluster):
     filer = cluster.procs["filer"]
     filer_url = filer.url

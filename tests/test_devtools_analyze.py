@@ -894,3 +894,80 @@ def test_swfs013_repo_is_clean():
         [f for f in findings if f.rule == "SWFS013"],
         load_baseline(default_baseline_path()))
     assert new == [], [f.render() for f in new]
+
+
+# -- SWFS014: blocking call inside an async def ---------------------------
+
+def test_swfs014_flags_sleep_and_client_funnel_in_coroutine():
+    src = """
+    import time
+    async def handler(req):
+        time.sleep(0.1)
+        st, body, _ = http_bytes("GET", "peer/x")
+        return 200, body
+    """
+    found = check_at(src, "SWFS014", "seaweedfs_tpu/server/x.py")
+    assert len(found) == 2
+    assert "event loop" in found[0].message
+
+
+def test_swfs014_flags_bare_open_and_urlopen():
+    src = """
+    import urllib.request
+    async def handler(path):
+        f = open(path, "rb")
+        r = urllib.request.urlopen("http://x/")
+        return f, r
+    """
+    assert len(check_at(src, "SWFS014",
+                        "seaweedfs_tpu/server/x.py")) == 2
+
+
+def test_swfs014_executor_handoff_shapes_are_silent():
+    src = """
+    import asyncio, time
+    async def handler(loop, pool, path):
+        def work():
+            time.sleep(0.1)          # runs on the pool: fine
+            with open(path, "rb") as f:
+                return f.read()
+        data = await loop.run_in_executor(pool, work)
+        lazy = await loop.run_in_executor(
+            pool, lambda: open(path, "rb").read())
+        await asyncio.sleep(0.01)    # async sleep: fine
+        return data, lazy
+    """
+    assert check_at(src, "SWFS014", "seaweedfs_tpu/server/x.py") == []
+
+
+def test_swfs014_sync_functions_out_of_scope():
+    src = """
+    import time
+    def handler(req):
+        time.sleep(0.1)
+        return http_json("GET", "peer/x")
+    """
+    assert check_at(src, "SWFS014", "seaweedfs_tpu/server/x.py") == []
+
+
+def test_swfs014_noqa_suppresses():
+    src = """
+    import time
+    async def handler(req):
+        time.sleep(0.1)  # noqa: SWFS014 — fixture pacing
+    """
+    assert check_at(src, "SWFS014", "seaweedfs_tpu/server/x.py") == []
+
+
+def test_swfs014_repo_is_clean():
+    # scoped to server/ — the only tree with coroutines (async_front)
+    # — because a full-package scan already runs twice in this module
+    # and the tier-1 budget is tight
+    import os
+
+    import seaweedfs_tpu
+    root = os.path.join(os.path.dirname(seaweedfs_tpu.__file__),
+                        "server")
+    findings, errors = run_paths([root])
+    assert not errors
+    assert [f for f in findings if f.rule == "SWFS014"] == []

@@ -373,7 +373,11 @@ def test_cluster_profile_names_needle_append_hot_path(cluster,
         blob = bytes([seed]) * 4096
         while not stop.is_set():
             try:
-                operation.submit(cluster.master, blob)
+                # named needles stay on the PYTHON write path (the
+                # native write plane 404s them): this test profiles
+                # the Python hot path by construction
+                operation.submit(cluster.master, blob,
+                                 name=f"prof{seed}.bin")
             except OSError:
                 time.sleep(0.05)
 

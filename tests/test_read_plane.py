@@ -85,10 +85,20 @@ def test_named_and_mime_needles_stay_on_python_path(cluster):
     assert hdrs["Content-Type"].startswith("text/html")
 
 
+def _warm(vs, fid):
+    """Deterministic plane warm: a Python-port read lazily registers
+    the needle (the plane's documented contract) — registration off
+    the write path rides the native write plane's pump tick now, so
+    tests must not assume it landed the instant the upload acked."""
+    st, _, _ = http_bytes("GET", f"{vs.url}/{fid}")
+    assert st == 200
+
+
 def test_delete_drops_entry(cluster):
     master, vs = cluster
     a = operation.assign(master.url)
     operation.upload(a.url, a.fid, b"temporary")
+    _warm(vs, a.fid)
     assert _rp_get(vs, a.fid)[0] == 200
     operation.delete(master.url, a.fid)
     st, _, _ = _rp_get(vs, a.fid)
@@ -104,6 +114,7 @@ def test_vacuum_drops_then_lazily_reregisters(cluster):
     operation.upload(a.url, a.fid, b"keep-me")
     b = operation.assign(master.url)
     operation.upload(b.url, b.fid, b"delete-me")
+    _warm(vs, a.fid)
     assert _rp_get(vs, a.fid)[0] == 200
     operation.delete(master.url, b.fid)
     vid = int(a.fid.split(",")[0])
@@ -135,6 +146,7 @@ def test_keepalive_many_requests_one_connection(cluster):
     master, vs = cluster
     a = operation.assign(master.url)
     operation.upload(a.url, a.fid, b"ka")
+    _warm(vs, a.fid)
     before = vs.read_plane.served()
     for _ in range(50):
         st, body, _ = _rp_get(vs, a.fid)

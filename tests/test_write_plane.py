@@ -1,0 +1,332 @@
+"""Native C++ write plane (native/write_plane.cc +
+server/write_plane.py): cross-implementation parity with the Python
+write path — the same role test_read_plane.py plays for reads — plus
+the fallback contract (overwrites, named/mimed uploads, readonly
+freezes all land on the Python port), the graceful-degradation
+satellite (everything works with the .so absent or the attach
+failing), and the fsync-tier flush-epoch handshake."""
+
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.server.httpd import http_bytes, http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.storage import types
+from seaweedfs_tpu.storage.needle import Needle, get_actual_size
+from seaweedfs_tpu.storage.volume import Volume
+
+pytest.importorskip("seaweedfs_tpu.server.write_plane")
+from seaweedfs_tpu.native import load_write_plane  # noqa: E402
+
+pytestmark = pytest.mark.skipif(load_write_plane() is None,
+                                reason="no native toolchain")
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    # module-scoped: one boot serves every test here (tier-1 budget);
+    # tests use fresh assigns and restore any state they flip
+    tmp = tmp_path_factory.mktemp("write_plane")
+    master = MasterServer(volume_size_limit_mb=64).start()
+    vs = VolumeServer([str(tmp / "v0")], master.url,
+                      pulse_seconds=0.2, max_volume_count=8).start()
+    time.sleep(0.2)   # start() already heartbeat once synchronously
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def _wp_post(vs, fid, body, qs=""):
+    return http_bytes(
+        "POST", f"127.0.0.1:{vs.write_plane.port}/{fid}{qs}", body,
+        timeout=5)
+
+
+def test_upload_rides_the_plane_and_reads_back(cluster):
+    """operation.upload's plain-chunk shape is served natively; reads
+    through the Python port, the read plane, and operation.read all
+    agree byte-for-byte."""
+    master, vs = cluster
+    assert vs.write_plane is not None
+    before = vs.write_plane.requests()
+    fids = []
+    for i in range(12):
+        a = operation.assign(master.url)
+        payload = bytes([i]) * (900 + 41 * i)
+        r = operation.upload(a.url, a.fid, payload)
+        assert r["size"] == len(payload)
+        fids.append((a.fid, payload))
+    assert vs.write_plane.requests() >= before + 12, \
+        "plain uploads did not ride the native plane"
+    for fid, want in fids:
+        st, body, _ = http_bytes("GET", f"{vs.url}/{fid}")
+        assert st == 200 and body == want, fid
+        assert operation.read(master.url, fid) == want
+
+
+def test_record_byte_identity_native_vs_python(cluster, tmp_path):
+    """The C++ serializer writes the exact v3 record bytes the Python
+    path writes (flags, LastModified, CRC32C, padding quirks) — the
+    graceful-degradation contract is byte-level, not just
+    semantic."""
+    master, vs = cluster
+    a = operation.assign(master.url)
+    payload = bytes(range(251)) * 7          # deliberately ragged
+    st, _, _ = _wp_post(vs, a.fid, payload, "?ts=1722800000")
+    assert st == 201
+    fid = types.parse_file_id(a.fid)
+    v = vs.store.find_volume(fid.volume_id)
+    v.drain_native()
+    got = v.nm.get(fid.key)
+    with open(v.file_name(".dat"), "rb") as f:
+        f.seek(types.to_actual_offset(got[0]))
+        raw = f.read(get_actual_size(got[1], v.version))
+    native_n = Needle.from_bytes(raw, v.version, expected_size=got[1])
+    # the record re-serializes to itself: layout == Python layout
+    assert native_n.to_bytes(v.version) == raw
+    # and field-for-field it matches a Python-written twin (append
+    # clock normalized — the only legitimately differing field)
+    os.makedirs(tmp_path / "twin", exist_ok=True)
+    pv = Volume(str(tmp_path / "twin"), 99)
+    pn = Needle(cookie=fid.cookie, id=fid.key, data=payload)
+    pn.set_last_modified(1722800000)
+    pv.write_needle(pn)
+    pgot = pv.nm.get(fid.key)
+    with open(pv.file_name(".dat"), "rb") as f:
+        f.seek(types.to_actual_offset(pgot[0]))
+        raw_py = f.read(get_actual_size(pgot[1], pv.version))
+    py_n = Needle.from_bytes(raw_py, pv.version,
+                             expected_size=pgot[1])
+    native_n.append_at_ns = py_n.append_at_ns = 0
+    assert native_n.to_bytes(v.version) == py_n.to_bytes(pv.version)
+    pv.close()
+
+
+def test_overwrite_and_named_fall_back_with_full_semantics(cluster):
+    """Seen keys and non-plain shapes 404 natively; the Python port
+    then applies the REAL semantics (cookie check, dedup, mime)."""
+    master, vs = cluster
+    a = operation.assign(master.url)
+    operation.upload(a.url, a.fid, b"first")
+    st, _, _ = _wp_post(vs, a.fid, b"second")
+    assert st == 404                     # seen key: Python owns it
+    # full-path overwrite with the right cookie still works
+    r = operation.upload(a.url, a.fid, b"second")
+    assert r["size"] == 6
+    st, body, _ = http_bytes("GET", f"{vs.url}/{a.fid}")
+    assert body == b"second"
+    # wrong cookie still rejected (the check the plane must not skip)
+    vid, rest = a.fid.split(",", 1)
+    bad = f"{vid},{rest[:-8]}{'0'*8 if rest[-8:] != '0'*8 else '1'*8}"
+    st, _, _ = _wp_post(vs, bad, b"evil")
+    assert st == 404                     # same key id: fallback
+    st, _, _ = http_bytes("POST", f"{vs.url}/{bad}", b"evil",
+                          timeout=5)
+    assert st >= 400                     # python: cookie mismatch
+    # named/mimed uploads: plane 404s, upload() transparently falls
+    # back, mime survives
+    b2 = operation.assign(master.url)
+    operation.upload(b2.url, b2.fid, b"<b>x</b>", name="p.html",
+                     mime="text/html")
+    st, body, hdrs = http_bytes("GET", f"{vs.url}/{b2.fid}")
+    assert st == 200 and body == b"<b>x</b>"
+    assert hdrs["Content-Type"].startswith("text/html")
+
+
+def test_delete_after_native_write(cluster):
+    master, vs = cluster
+    a = operation.assign(master.url)
+    operation.upload(a.url, a.fid, b"to-delete")
+    operation.delete(master.url, a.fid)
+    st, _, _ = http_bytes("GET", f"{vs.url}/{a.fid}")
+    assert st == 404
+
+
+def test_readonly_freeze_detaches_and_unfreeze_reattaches(cluster):
+    master, vs = cluster
+    a = operation.assign(master.url)
+    operation.upload(a.url, a.fid, b"seed")      # volume exists + attached
+    vid = int(a.fid.split(",")[0])
+    r = http_json("POST", f"{vs.url}/admin/set_readonly",
+                  {"volumeId": vid, "readOnly": True})
+    assert "error" not in r
+    b = operation.assign(master.url)             # may pick another vid
+    st, _, _ = _wp_post(vs, f"{vid},{b.fid.split(',',1)[1]}", b"x")
+    assert st == 404, "frozen volume must not ack native writes"
+    r = http_json("POST", f"{vs.url}/admin/set_readonly",
+                  {"volumeId": vid, "readOnly": False})
+    assert "error" not in r
+    c = operation.assign(master.url)
+    before = vs.write_plane.requests()
+    # drop the client's short-lived negative vid cache (an earlier
+    # fallback in this module may have blacklisted the vid for ~2s)
+    getattr(operation._plane_local, "vid_misses", {}).clear()
+    operation.upload(c.url, c.fid, b"after-unfreeze")
+    assert vs.write_plane.requests() > before
+
+
+def test_vacuum_quiesces_then_reattaches(cluster):
+    master, vs = cluster
+    keep = operation.assign(master.url)
+    operation.upload(keep.url, keep.fid, b"keep-me" * 40)
+    drop = operation.assign(master.url)
+    operation.upload(drop.url, drop.fid, b"drop-me" * 40)
+    operation.delete(master.url, drop.fid)
+    vid = int(keep.fid.split(",")[0])
+    r = http_json("POST", f"{vs.url}/admin/vacuum", {"volumeId": vid})
+    assert "error" not in r
+    st, body, _ = http_bytes("GET", f"{vs.url}/{keep.fid}")
+    assert st == 200 and body == b"keep-me" * 40
+    # the plane owns the tail again after the swap
+    before = vs.write_plane.requests()
+    nxt = operation.assign(master.url)
+    getattr(operation._plane_local, "vid_misses", {}).clear()
+    operation.upload(nxt.url, nxt.fid, b"post-vacuum")
+    assert vs.write_plane.requests() > before
+    st, body, _ = http_bytes("GET", f"{vs.url}/{nxt.fid}")
+    assert st == 200 and body == b"post-vacuum"
+
+
+def test_metrics_and_status_surface_the_plane(cluster):
+    master, vs = cluster
+    a = operation.assign(master.url)
+    operation.upload(a.url, a.fid, b"metered")
+    st, body, _ = http_bytes("GET", f"{vs.url}/metrics")
+    text = body.decode()
+    assert "volume_server_write_plane_requests_total" in text
+    assert "volume_server_write_plane_fallbacks_total" in text
+    assert "volume_server_write_plane_ack_seconds_bucket" in text
+    assert "volume_server_read_plane_requests_total" in text
+    st, doc, _ = http_bytes("GET", f"{vs.url}/status")
+    import json
+    assert json.loads(doc)["writePlanePort"] == vs.write_plane.port
+
+
+def test_plane_absent_pure_python_fallback(tmp_path, monkeypatch):
+    """The .so failing to build/load degrades to the seed write path:
+    same acks, same bytes, zero native involvement."""
+    from seaweedfs_tpu import native as native_mod
+    monkeypatch.setattr(native_mod, "load_write_plane", lambda: None)
+    master = MasterServer(volume_size_limit_mb=32).start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url,
+                      pulse_seconds=0.2).start()
+    try:
+        time.sleep(0.2)
+        assert vs.write_plane is None
+        a = operation.assign(master.url)
+        r = operation.upload(a.url, a.fid, b"pure-python")
+        assert r["size"] == 11
+        st, body, _ = http_bytes("GET", f"{vs.url}/{a.fid}")
+        assert st == 200 and body == b"pure-python"
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_attach_failure_falls_back_lazily(tmp_path, monkeypatch):
+    """A registration that RAISES must not break volume lifecycle or
+    writes — the Python port silently owns the volume (read_plane's
+    lazy-fallback contract, write side)."""
+    from seaweedfs_tpu.server import write_plane as wp_mod
+    monkeypatch.setattr(
+        wp_mod.WritePlane, "add_volume",
+        lambda self, *a, **k: (_ for _ in ()).throw(OSError("boom")))
+    master = MasterServer(volume_size_limit_mb=32).start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url,
+                      pulse_seconds=0.2).start()
+    try:
+        time.sleep(0.2)
+        a = operation.assign(master.url)
+        r = operation.upload(a.url, a.fid, b"still-works")
+        assert r["size"] == 11
+        st, body, _ = http_bytes("GET", f"{vs.url}/{a.fid}")
+        assert st == 200 and body == b"still-works"
+        assert vs.write_plane is None or \
+            vs.write_plane.requests() == 0
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_fsync_tier_epoch_handshake(tmp_path):
+    """-fsync volumes park native acks on a flush epoch; the Python
+    handshake runs the CommitBarrier and releases them — the write
+    completes and the barrier's flush counter moves."""
+    master = MasterServer(volume_size_limit_mb=32).start()
+    vs = VolumeServer([str(tmp_path / "v0")], master.url,
+                      pulse_seconds=0.2, fsync=True).start()
+    try:
+        time.sleep(0.2)
+        a = operation.assign(master.url)
+        fid = types.parse_file_id(a.fid)
+        t0 = time.perf_counter()
+        st, _, _ = _wp_post(vs, a.fid, b"platter-durable")
+        assert st == 201
+        assert time.perf_counter() - t0 < 5.0
+        v = vs.store.find_volume(fid.volume_id)
+        assert v.fsync and v._barrier.flushes >= 1
+        st, body, _ = http_bytes("GET", f"{vs.url}/{a.fid}")
+        assert st == 200 and body == b"platter-durable"
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_crash_replay_recovers_undrained_tail(cluster, tmp_path):
+    """Native acks are durable the moment write(2) returns, even if
+    the process dies before the .idx checkpoint caught up: reopening
+    the files replays the .dat tail (the SIGKILL suite proves this
+    with real processes; this is the fast in-process twin)."""
+    import shutil
+    master, vs = cluster
+    a = operation.assign(master.url)
+    st, _, _ = _wp_post(vs, a.fid, b"replayed" * 64)
+    assert st == 201
+    fid = types.parse_file_id(a.fid)
+    v = vs.store.find_volume(fid.volume_id)
+    crash = tmp_path / "crash-copy"
+    os.makedirs(crash)
+    # snapshot .dat/.idx NOW — the .idx may not carry the entry yet
+    for ext in (".dat", ".idx"):
+        shutil.copy(v.file_name(ext),
+                    str(crash / os.path.basename(v.file_name(ext))))
+    v2 = Volume(str(crash), fid.volume_id)
+    try:
+        assert v2.read_needle(fid.key).data == b"replayed" * 64
+    finally:
+        v2.close()
+
+
+def test_cluster_top_native_plane_line_renders():
+    """_native_plane_report renders acks/fallbacks/ack-p99 from the
+    /metrics deltas (no cluster needed: synthetic parsed samples)."""
+    from seaweedfs_tpu.shell.commands import _native_plane_report
+    before = {
+        "volume_server_write_plane_requests_total": [({}, 100.0)],
+        "volume_server_write_plane_fallbacks_total": [({}, 5.0)],
+        "volume_server_write_plane_ack_seconds_count": [({}, 100.0)],
+        "volume_server_write_plane_ack_seconds_sum": [({}, 0.01)],
+        "volume_server_write_plane_ack_seconds_bucket": [
+            ({"le": "0.001"}, 90.0), ({"le": "+Inf"}, 100.0)],
+        "volume_server_read_plane_requests_total": [({}, 7.0)],
+        "volume_server_read_plane_fallbacks_total": [({}, 1.0)],
+    }
+    after = {
+        "volume_server_write_plane_requests_total": [({}, 350.0)],
+        "volume_server_write_plane_fallbacks_total": [({}, 9.0)],
+        "volume_server_write_plane_ack_seconds_count": [({}, 350.0)],
+        "volume_server_write_plane_ack_seconds_sum": [({}, 0.05)],
+        "volume_server_write_plane_ack_seconds_bucket": [
+            ({"le": "0.001"}, 340.0), ({"le": "+Inf"}, 350.0)],
+        "volume_server_read_plane_requests_total": [({}, 20.0)],
+        "volume_server_read_plane_fallbacks_total": [({}, 3.0)],
+    }
+    line = _native_plane_report(before, after)
+    assert "write 250 acked/4 fallback" in line
+    assert "ack-p99=" in line
+    assert "read 13 served/2 fallback" in line
+    assert _native_plane_report({}, {}) == ""
