@@ -151,6 +151,15 @@ def main(argv: list[str] | None = None) -> int:
                          "SEAWEEDFS_TPU_FILER_WORKERS sets it "
                          "cluster-wide.  0 marks a spawned worker "
                          "(internal).")
+    fl.add_argument("-metaPlane", dest="meta_plane", default="",
+                    choices=["", "0", "1"],
+                    help="filer meta plane (metalog-as-WAL ack + "
+                         "async store checkpointing, filer/"
+                         "meta_plane.py): 1 forces on, 0 forces the "
+                         "synchronous store commit; default auto "
+                         "(on for durable sqlite/lsm stores).  Sets "
+                         "SEAWEEDFS_TPU_FILER_META_PLANE so pre-fork "
+                         "workers inherit it.")
     fl.add_argument("-metricsAddress", dest="metrics_address",
                     default="", help="Prometheus pushgateway "
                     "host:port (stats/metrics.go LoopPushingMetric)")
@@ -635,6 +644,12 @@ def main(argv: list[str] | None = None) -> int:
             if notification:
                 wlog.info("notification from %s: %s", ntoml,
                           notification, component="config")
+        if args.meta_plane:
+            # via the environment so spawned -workers siblings (which
+            # re-exec this argv minus -port/-workers) inherit the same
+            # plane mode even when driven by the flag
+            os.environ["SEAWEEDFS_TPU_FILER_META_PLANE"] = \
+                args.meta_plane
         workers = args.workers
         if workers is None:
             try:

@@ -1031,6 +1031,92 @@ class AsyncBlockingCallRule(Rule):
                         f"async equivalent")
 
 
+class FilerHotPathCommitRule(Rule):
+    """SWFS015: per-request store work on the filer hot path that the
+    meta plane (filer/meta_plane.py) exists to amortize — (a) a
+    DB-connection `commit()` (`self._db.commit()`, `conn.commit()`)
+    outside the designated batch helpers, i.e. one store transaction
+    per request instead of one per apply window; (b) an
+    `Entry.to_json()` inside a store's `insert_entry`/`update_entry`,
+    i.e. a SECOND per-request entry serialization after the one the
+    WAL line already carries.  Exempt: the designated batch/teardown
+    helpers (`apply_events`, `put_many`, `recover_sync`, `close`,
+    `stop`, `__init__`, `commit` — MetaPlane.commit IS the
+    single-serialization site — and `_group_commit*`/`_checkpoint*`
+    prefixes).  The synchronous kill-switch path keeps its
+    serialization under `# noqa: SWFS015` with a reason."""
+
+    id = "SWFS015"
+    severity = "error"
+    title = "per-request serialization/commit on the filer hot path"
+
+    _FILES = ("seaweedfs_tpu/filer/filer.py",
+              "seaweedfs_tpu/filer/abstract_sql.py",
+              "seaweedfs_tpu/filer/filer_store.py",
+              "seaweedfs_tpu/filer/lsm_store.py",
+              "seaweedfs_tpu/filer/meta_log.py",
+              "seaweedfs_tpu/filer/meta_cache.py",
+              "seaweedfs_tpu/filer/meta_plane.py",
+              "seaweedfs_tpu/server/filer_server.py")
+    _EXEMPT = {"apply_events", "put_many", "recover_sync", "close",
+               "stop", "__init__", "commit"}
+    _EXEMPT_PREFIXES = ("_group_commit", "_checkpoint")
+    _SERIALIZING_FUNCS = {"insert_entry", "update_entry"}
+
+    def _exempt(self, name: str) -> bool:
+        return name in self._EXEMPT or \
+            any(name.startswith(p) for p in self._EXEMPT_PREFIXES)
+
+    @staticmethod
+    def _own_nodes(fn: ast.AST):
+        """The function's own body, stopping at nested defs (they get
+        their own visit and their own exemption verdict)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, ctx: FileContext):
+        rel = ctx.relpath.replace("\\", "/")
+        if not any(rel.endswith(f) for f in self._FILES):
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if self._exempt(fn.name):
+                continue
+            for node in self._own_nodes(fn):
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Attribute):
+                    continue
+                attr = node.func.attr
+                if attr == "commit" and not node.args:
+                    tail = _dotted(node.func.value).rsplit(".", 1)[-1]
+                    if "db" in tail or "conn" in tail:
+                        yield self.finding(
+                            ctx, node,
+                            f"{_dotted(node.func)}() commits one "
+                            f"store transaction per request on the "
+                            f"filer hot path — batch it through the "
+                            f"meta plane's apply_events window (or "
+                            f"noqa the synchronous kill-switch path "
+                            f"with a reason)")
+                elif attr == "to_json" and \
+                        fn.name in self._SERIALIZING_FUNCS:
+                    yield self.finding(
+                        ctx, node,
+                        f"{fn.name} re-serializes the entry per "
+                        f"request — the meta plane's WAL line already "
+                        f"carries these bytes; reuse them via "
+                        f"apply_events (or noqa the synchronous "
+                        f"kill-switch path with a reason)")
+
+
 RULES = [
     LockDisciplineRule(),
     JitBlockingRule(),
@@ -1046,4 +1132,5 @@ RULES = [
     FlushUnderLockRule(),
     UnboundedBodyReadRule(),
     AsyncBlockingCallRule(),
+    FilerHotPathCommitRule(),
 ]

@@ -215,10 +215,42 @@ class AbstractSqlStore(FilerStore):
             self._db.execute(
                 self.dialect.upsert_sql(),
                 (entry.parent, entry.name,
-                 json.dumps(entry.to_json())))
+                 json.dumps(entry.to_json())))  # noqa: SWFS015 — the synchronous-commit (meta-plane-off) path serializes here by design
         self._barrier.commit()
 
     update_entry = insert_entry
+
+    def apply_events(self, records: list) -> None:
+        """Meta-plane applier: the whole batch in ONE transaction,
+        ONE commit — the designated place a per-batch store commit
+        lives (SWFS015's exempt helper).  Upserts reuse the exact
+        entry bytes the WAL line carries (`raw`), so the hot path's
+        single serialization is the only one end to end.  Consecutive
+        upserts run through `executemany` (the statement compiles
+        once and the rows loop in C); the ordered flush before each
+        delete preserves per-path apply order."""
+        if not records:
+            return
+        up = self.dialect.upsert_sql()
+        dele = self.dialect.delete_sql()
+        rows: list = []
+        with self._lock:
+            for op, npath, raw, new, opath in records:
+                if npath:
+                    parent, _, name = npath.rpartition("/")
+                    rows.append((parent or "/", name,
+                                 raw if raw is not None
+                                 else json.dumps(new)))
+                if opath and op in ("delete", "rename") and \
+                        opath != npath:
+                    if rows:
+                        self._db.executemany(up, rows)
+                        rows = []
+                    parent, _, name = opath.rpartition("/")
+                    self._db.execute(dele, (parent or "/", name))
+            if rows:
+                self._db.executemany(up, rows)
+            self._db.commit()
 
     def find_entry(self, path: str) -> "Entry | None":
         path = normalize_path(path)

@@ -19,8 +19,28 @@ from .entry import Entry, normalize_path
 class FilerStore:
     """Interface: insert/update/find/delete/list, per directory."""
 
+    # the meta plane (filer/meta_plane.py) treats the metalog as the
+    # filer's WAL and this store as an async checkpoint — only stores
+    # that are DURABLE and LOCAL opt in (a remote store shared with a
+    # filer we cannot hear from must stay synchronously committed, or
+    # that filer would read our acked writes only after our applier
+    # got to them)
+    supports_meta_plane = False
+
     def insert_entry(self, entry: Entry) -> None:
         raise NotImplementedError
+
+    def apply_events(self, records: list) -> None:
+        """Meta-plane checkpoint applier hook: apply a batch of
+        metalog events.  `records` = [(op, new_path, raw_meta,
+        new_dict, old_path)] in log order.  The base implementation
+        loops the CRUD ops; stores with a transaction boundary
+        override to commit the whole batch ONCE."""
+        for op, npath, _raw, new, opath in records:
+            if npath:
+                self.insert_entry(Entry.from_json(new))
+            if opath and op in ("delete", "rename") and opath != npath:
+                self.delete_entry(opath)
 
     def update_entry(self, entry: Entry) -> None:
         raise NotImplementedError
@@ -119,6 +139,10 @@ class SqliteStore(AbstractSqlStore):
             if path != ":memory:" else None
         super().__init__(dialect.connect(path), dialect,
                          read_factory=read_factory)
+        # the meta plane checkpoints into this store only when it is
+        # durable: a :memory: database dies with the process, so a
+        # persisted checkpoint would outlive the state it describes
+        self.supports_meta_plane = path != ":memory:"
 
     # kept for callers/tests that exercised the escaping directly
     _like_escape = staticmethod(SqlDialect.like_escape)

@@ -112,6 +112,23 @@ class LsmTree:
         # the old per-put flush, one flush per commit window)
         self._barrier.commit()
 
+    def put_many(self, pairs: "list[tuple[str, dict | None]]") -> None:
+        """Batched put: one WAL write run + ONE barrier for the whole
+        batch — the meta plane's applier path (its events already
+        cleared the metalog barrier, so this WAL is belt-and-braces
+        checkpoint durability, amortized)."""
+        if not pairs:
+            return
+        with self._lock:
+            self._wal.write("".join(
+                json.dumps([k, v], separators=(",", ":")) + "\n"
+                for k, v in pairs))
+            for k, v in pairs:
+                self._mem.insert(k, v)
+            if len(self._mem) >= MEMTABLE_LIMIT:
+                self.flush_memtable()
+        self._barrier.commit()
+
     def delete(self, key: str) -> None:
         self.put(key, TOMBSTONE)
 
@@ -231,11 +248,26 @@ class LsmStore(FilerStore):
     """FilerStore over LsmTree (filer/leveldb2/leveldb2_store.go
     shape: one key per entry path, range scans for listings)."""
 
+    supports_meta_plane = True     # durable, local, single-process
+
     def __init__(self, directory: str):
         self.tree = LsmTree(directory)
 
     def insert_entry(self, entry: Entry) -> None:
-        self.tree.put(entry.full_path, entry.to_json())
+        self.tree.put(entry.full_path, entry.to_json())  # noqa: SWFS015 — the synchronous-commit (meta-plane-off) path serializes here by design
+
+    def apply_events(self, records: list) -> None:
+        """Meta-plane applier: one WAL batch + one barrier for the
+        whole event window (the LSM value is the parsed entry dict the
+        WAL line already carries — no re-serialization of the entry
+        beyond the tree's own key/value line)."""
+        pairs: "list[tuple[str, dict | None]]" = []
+        for op, npath, _raw, new, opath in records:
+            if npath:
+                pairs.append((npath, new))
+            if opath and op in ("delete", "rename") and opath != npath:
+                pairs.append((opath, TOMBSTONE))
+        self.tree.put_many(pairs)
 
     def update_entry(self, entry: Entry) -> None:
         self.insert_entry(entry)

@@ -50,6 +50,10 @@ from ..util.chunk_cache import _CacheMeter
 _MISS = object()
 
 
+def _no_watermark() -> int:
+    return 0
+
+
 def meta_cache_entries(default: int = 4096) -> int:
     """``SEAWEEDFS_TPU_FILER_META_CACHE`` — max cached entry lookups
     (0 disables; "force" enables with the default size even for
@@ -71,8 +75,19 @@ class FilerMetaCache:
     MAX_LISTS = 512
 
     def __init__(self, meta_log, capacity: int = 4096,
-                 name: "str | None" = "filer_meta"):
+                 name: "str | None" = "filer_meta",
+                 watermark: bool = True):
         self._log = meta_log
+        # watermark=False: meta-plane mode (ISSUE 13).  The plane's
+        # log follower delivers every sibling commit as a POINT
+        # invalidation before any read that could observe it
+        # (Filer -> MetaPlane.catch_up on the read path), so the
+        # coarse "kill every fill at or before the foreign watermark"
+        # rule — which under pre-fork workers degenerated into an
+        # invalidation storm killing every fill within one sibling
+        # commit window — is both unnecessary and harmful here.
+        self._probe = meta_log.foreign_watermark if watermark \
+            else _no_watermark
         self._cap = max(int(capacity), 1)
         self._lock = threading.Lock()
         # path -> (fill_watermark, entry-or-None)
@@ -94,7 +109,7 @@ class FilerMetaCache:
         stamped with a foreign watermark that pre-dates the read
         (conservative: a sibling's commit landing mid-read can only
         make the fill look stale, never fresh)."""
-        wm = self._log.foreign_watermark()
+        wm = self._probe()
         with self._lock:
             return self._epoch, wm
 
@@ -110,7 +125,7 @@ class FilerMetaCache:
     def lookup_entry(self, path: str):
         """Cached entry (or cached None for a known-absent path), or
         the _MISS sentinel.  Callers must clone before mutating."""
-        probe = self._log.foreign_watermark()
+        probe = self._probe()
         with self._lock:
             hit = self._entries.get(path)
             if hit is None or not self._valid(hit[0], probe):
@@ -133,7 +148,7 @@ class FilerMetaCache:
     # -- listings ------------------------------------------------------
 
     def lookup_list(self, key: tuple):
-        probe = self._log.foreign_watermark()
+        probe = self._probe()
         with self._lock:
             hit = self._lists.get(key)
             if hit is None or not self._valid(hit[0], probe):

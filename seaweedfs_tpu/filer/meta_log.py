@@ -19,12 +19,24 @@ off the disk.  Timestamps are made strictly monotonic at append time so
 
 Durability is GROUP-COMMITTED (util/group_commit.py): appenders stamp
 and enqueue their serialized line under the stamp lock, then meet at a
-shared barrier — one leader drains the queue, writes every line, and
-flushes the segment ONCE for the whole batch; every appender returns
-only after a flush that covers its line.  Ack semantics are identical
-to the old flush-per-event loop (an acked event survives SIGKILL; a
-torn tail line is always an unacked event), but N concurrent appenders
-share one barrier instead of serializing N of them.
+shared barrier — one leader drains the queue and lands the whole batch
+with ONE `os.write` on an `O_APPEND` fd; every appender returns only
+after a write that covers its line.  Ack semantics are identical to
+the old flush-per-event loop (an acked event survives SIGKILL; a torn
+tail line is always an unacked event), but N concurrent appenders
+share one barrier instead of serializing N of them — and because the
+batch is a single kernel append, SIBLING instances over the same dir
+(pre-fork filer workers, two filers over one sqlite store) interleave
+whole batches, never partial lines.
+
+Since ISSUE 13 this log is the filer's WRITE-AHEAD LOG proper
+(filer/meta_plane.py): a namespace mutation is acked once its event
+clears this barrier, and the sqlite/LSM store is an asynchronously
+maintained CHECKPOINT of it.  `append_raw` is the WAL fast path — the
+caller passes the entry JSON it already serialized, the line carries
+an `nl` length field so the async applier can slice those exact bytes
+back out (serialize once, reuse everywhere), and the returned durable
+log position anchors the overlay index's eviction protocol.
 """
 
 from __future__ import annotations
@@ -79,6 +91,22 @@ def _segment_name(ts_ns: int) -> "tuple[str, str]":
             f"{t.tm_hour:02d}-{t.tm_min:02d}")
 
 
+def strip_wal_fields(event: dict) -> dict:
+    """Drop the WAL-plumbing fields a persisted line carries (`nl` =
+    newEntry length for the applier's byte-reuse slice, `wid` = writer
+    instance id) before the event reaches subscribers — the event API
+    contract stays {op, tsNs, newEntry, oldEntry}."""
+    event.pop("nl", None)
+    event.pop("wid", None)
+    return event
+
+
+# a log position: (day, minute, byte offset after the line/batch).
+# Tuples compare lexicographically and segment names are zero-padded,
+# so plain tuple comparison orders positions across rotations.
+LOG_START: "tuple[str, str, int]" = ("", "", 0)
+
+
 class MetaLog:
     """Append-only metadata event log: strictly-monotonic stamps,
     per-minute segment files (when `dir_path` is set), timestamp replay
@@ -94,7 +122,19 @@ class MetaLog:
         # stamp order (stamping and enqueueing share self._lock)
         self._pending: "list[tuple[int, str]]" = []
         self._open_name: "tuple[str, str] | None" = None
-        self._open_file = None
+        self._open_fd: "int | None" = None
+        # durable position: (day, minute, offset) just past the last
+        # batch this instance's barrier landed — an appender reads it
+        # after commit() returns as a conservative "my line is at or
+        # before here" cover for the meta plane's overlay eviction
+        self._durable_pos: "tuple[str, str, int]" = LOG_START
+        # own-batch extents [(day, minute, start, end)]: each barrier
+        # write is ONE contiguous kernel append of only OUR lines, so
+        # the meta plane's coherence follower can jump over it by
+        # arithmetic instead of reading and skip-scanning bytes it
+        # ingested at ack time.  Bounded; overflow just means the
+        # follower reads those bytes the slow way.
+        self._own_extents: deque = deque(maxlen=4096)
         # highest stamp whose line a barrier has flushed: the memory
         # tail may briefly lead the disk (stamped, queued, pre-flush),
         # and events_since must not serve an event a crash could still
@@ -118,13 +158,25 @@ class MetaLog:
         self._wm_last = 0
         self._wm_names: "list[str]" = []
         self._wm_listed = 0.0
+        # writer instance id, stamped into every WAL line so the meta
+        # plane's log follower can tell its own (already-ingested)
+        # events from sibling instances' cheaply
+        self.wid = ""
         if self.dir:
             os.makedirs(self.dir, exist_ok=True)
             self._last_ts = self._scan_last_ts()
             self._durable_ts = self._last_ts
+            self._durable_pos = self.end_pos()
             with _WM_SEQ_LOCK:
                 _WM_SEQ[0] += 1
                 seq = _WM_SEQ[0]
+            # random suffix: pid+seq alone can recur across restarts
+            # (pid recycling), and a recurring wid would make a new
+            # instance's follower skip-scan a DEAD instance's lines
+            # as its own
+            import binascii
+            self.wid = (f"{os.getpid()}-{seq}-"
+                        f"{binascii.hexlify(os.urandom(3)).decode()}")
             self._wm_path = os.path.join(
                 self.dir, f".watermark.{os.getpid()}.{seq}")
             # adopt-and-prune: watermark files at or below the scanned
@@ -156,14 +208,10 @@ class MetaLog:
         needed so stamps are strictly increasing even across restarts
         (replay uses `> sinceNs`; two events sharing a stamp would let
         a resumer skip the second).  Returns only after the shared
-        group-commit barrier has flushed the event's line — an acked
+        group-commit barrier has landed the event's line — an acked
         event survives SIGKILL, exactly like the old per-event flush."""
         with self._lock:
-            ts = int(event.get("tsNs") or time.time_ns())
-            if ts <= self._last_ts:
-                ts = self._last_ts + 1
-            self._last_ts = ts
-            event["tsNs"] = ts
+            ts = self._stamp_locked(event)
             self._mem.append(event)
             if self.dir:
                 self._pending.append(
@@ -172,24 +220,99 @@ class MetaLog:
             self._barrier.commit()
         return event
 
+    def _stamp_locked(self, event: dict) -> int:
+        ts = int(event.get("tsNs") or time.time_ns())
+        if ts <= self._last_ts:
+            ts = self._last_ts + 1
+        self._last_ts = ts
+        event["tsNs"] = ts
+        return ts
+
+    def append_raw(self, op: str, new_dict: "dict | None",
+                   old_dict: "dict | None", raw_new: "str | None",
+                   raw_old: "str | None"
+                   ) -> "tuple[dict, tuple[str, str, int]]":
+        """WAL fast path (meta plane): the caller already serialized
+        the entry payloads ONCE (`raw_new`/`raw_old` are the JSON of
+        `new_dict`/`old_dict`), so the line is composed by string
+        splice instead of re-serializing, and the `nl` field records
+        `len(raw_new)` so the async store applier can slice the exact
+        newEntry bytes back out of the line (the store's meta column
+        is that same JSON — zero re-serialization end to end).
+        newEntry sits LAST in the line, which makes the slice
+        `line[-(nl + 1):-1]` — exact regardless of what the payloads
+        contain.  Returns (event, cover_pos): the event dict handed to
+        listeners (no WAL fields), and a durable log position at or
+        after the event's line (the overlay eviction cover)."""
+        event = {"op": op, "newEntry": new_dict, "oldEntry": old_dict}
+        with self._lock:
+            ts = self._stamp_locked(event)
+            self._mem.append(event)
+            if self.dir:
+                rn = raw_new if raw_new is not None else "null"
+                ro = raw_old if raw_old is not None else "null"
+                line = (f'{{"nl":{len(rn)},"wid":"{self.wid}",'
+                        f'"op":"{op}","tsNs":{ts},'
+                        f'"oldEntry":{ro},"newEntry":{rn}}}')
+                self._pending.append((ts, line))
+        if self.dir:
+            self._barrier.commit()
+            with self._lock:
+                pos = self._durable_pos
+        else:
+            pos = LOG_START
+        return event, pos
+
     def _group_commit_drain(self) -> None:
         """The barrier's designated flush helper: drain every queued
-        line into its segment and flush ONCE.  Only ever entered by
-        one leader at a time (CommitBarrier serializes batches), so
-        the segment handle needs no lock of its own."""
+        line and land each segment's run with ONE `os.write` on the
+        O_APPEND fd.  Only ever entered by one leader at a time
+        (CommitBarrier serializes batches), so the fd needs no lock of
+        its own.  A single kernel append per batch is also what makes
+        the shared-dir topology safe: sibling processes' batches
+        interleave whole, never mid-line."""
         with self._lock:
             batch, self._pending = self._pending, []
-        for ts, line in batch:
-            name = _segment_name(ts)
+        if not batch:
+            return
+        i, n, end_pos = 0, len(batch), None
+        while i < n:
+            name = _segment_name(batch[i][0])
+            j = i
+            while j < n and _segment_name(batch[j][0]) == name:
+                j += 1
             if name != self._open_name:
                 self._rotate(name)
-            self._open_file.write(line + "\n")
-        if self._open_file is not None:
-            self._open_file.flush()
-        if batch:
-            with self._lock:
-                self._durable_ts = max(self._durable_ts, batch[-1][0])
-            self._write_watermark(batch[-1][0])
+            buf = "".join(line + "\n"
+                          for _ts, line in batch[i:j]).encode("utf-8")
+            # short writes must FAIL the batch, not ack it: os.write
+            # may land fewer bytes (ENOSPC mid-write, RLIMIT_FSIZE)
+            # without raising, and this is the filer's WAL ack point —
+            # an exception here propagates to every member of the
+            # barrier batch (CommitBarrier's error fan-out), so nobody
+            # is acked by bytes that never reached the kernel.  A torn
+            # partial line left behind is an UNACKED tail, which every
+            # reader already tolerates.
+            mv = memoryview(buf)
+            while mv:
+                wrote = os.write(self._open_fd, mv)
+                if wrote <= 0:
+                    raise OSError(
+                        f"metalog WAL append wrote {wrote} of "
+                        f"{len(mv)} bytes")
+                mv = mv[wrote:]
+            # O_APPEND leaves the fd offset at the end of OUR write
+            # (later sibling appends don't move it) — the exact cover
+            end = os.lseek(self._open_fd, 0, os.SEEK_CUR)
+            end_pos = (name[0], name[1], end)
+            self._own_extents.append(
+                (name[0], name[1], end - len(buf), end))
+            i = j
+        with self._lock:
+            self._durable_ts = max(self._durable_ts, batch[-1][0])
+            if end_pos is not None and end_pos > self._durable_pos:
+                self._durable_pos = end_pos
+        self._write_watermark(batch[-1][0])
 
     def _write_watermark(self, ts: int) -> None:
         """Publish the durable ts for sibling instances (one tiny
@@ -277,13 +400,64 @@ class MetaLog:
 
     def _rotate(self, name: "tuple[str, str]") -> None:
         """Caller is the barrier leader (serialized)."""
-        if self._open_file is not None:
-            self._open_file.close()
+        if self._open_fd is not None:
+            os.close(self._open_fd)
         day_dir = os.path.join(self.dir, name[0])
         os.makedirs(day_dir, exist_ok=True)
-        self._open_file = open(os.path.join(day_dir, name[1] + ".log"),
-                               "a", encoding="utf-8")
+        self._open_fd = os.open(
+            os.path.join(day_dir, name[1] + ".log"),
+            os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
         self._open_name = name
+
+    def end_pos(self) -> "tuple[str, str, int]":
+        """Current end-of-log position (newest segment + size) — the
+        meta plane's checkpoint baseline on first enablement, when
+        everything already in the log was written by the synchronous
+        (pre-WAL) path and is therefore already in the store."""
+        if not self.dir:
+            return LOG_START
+        try:
+            days = sorted((d for d in os.listdir(self.dir)
+                           if os.path.isdir(os.path.join(self.dir, d))),
+                          reverse=True)
+        except OSError:
+            return LOG_START
+        for day in days:
+            day_dir = os.path.join(self.dir, day)
+            for minute in sorted(os.listdir(day_dir), reverse=True):
+                if not minute.endswith(".log"):
+                    continue
+                try:
+                    size = os.path.getsize(
+                        os.path.join(day_dir, minute))
+                except OSError:
+                    continue
+                return (day, minute[:-4], size)
+        return LOG_START
+
+    def durable_pos(self) -> "tuple[str, str, int]":
+        with self._lock:
+            return self._durable_pos
+
+    def own_extent_at(self, day: str, minute: str,
+                      off: int) -> "int | None":
+        """If an own-batch extent STARTS exactly at (day, minute,
+        off), consume every contiguous own extent from there and
+        return the final end offset — the coherence follower's
+        fast-skip.  None when the next bytes were written by a
+        sibling (or the extent record was evicted): read normally."""
+        ext = self._own_extents
+        end = None
+        while ext:
+            d, m, start, e = ext[0]
+            if (d, m) != (day, minute) or e <= off:
+                ext.popleft()       # stale: the follower moved past
+                continue
+            if start > off:
+                break               # a sibling's bytes come first
+            off = end = e
+            ext.popleft()
+        return end
 
     # -- replay -----------------------------------------------------------
 
@@ -329,7 +503,7 @@ class MetaLog:
                         except ValueError:
                             continue  # torn tail write after a crash
                         if e.get("tsNs", 0) > ts_ns:
-                            out.append(e)
+                            out.append(strip_wal_fields(e))
                             if limit and len(out) >= limit:
                                 return out
         return out
@@ -363,12 +537,12 @@ class MetaLog:
     def close(self) -> None:
         if self.dir:
             self._barrier.sync()   # drain queued lines before closing
-        # the segment handle is owned by barrier leaders (serialized
-        # by the barrier, not by self._lock); after the final sync
-        # above no leader is active
-        if self._open_file is not None:
-            self._open_file.close()
-            self._open_file = None
+        # the segment fd is owned by barrier leaders (serialized by
+        # the barrier, not by self._lock); after the final sync above
+        # no leader is active
+        if self._open_fd is not None:
+            os.close(self._open_fd)
+            self._open_fd = None
             self._open_name = None
         if self._wm_fd is not None:
             try:

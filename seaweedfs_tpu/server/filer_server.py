@@ -122,20 +122,30 @@ class FilerServer:
             # cache defaults OFF for them (env =force overrides)
             import os as _os
 
+            from ..filer.meta_plane import meta_plane_enabled
             from ..util.chunk_cache import read_cache_disk
             coherent = store_type not in ("redis", "elastic") or \
                 _os.environ.get("SEAWEEDFS_TPU_FILER_META_CACHE") == \
                 "force"
-            if reuse_port and _os.environ.get(
+            # will the meta plane run for this store shape?  (Filer
+            # makes the final call; this mirrors its gate so the
+            # worker-mode cache decision below can see it.)
+            plane_on = store_type in ("sqlite", "lsm") and \
+                store_path != ":memory:" and \
+                meta_plane_enabled() is not False
+            if reuse_port and not plane_on and _os.environ.get(
                     "SEAWEEDFS_TPU_FILER_META_CACHE") != "force":
-                # pre-fork worker mode: N co-located siblings over one
-                # store advance the shared durable-ts watermark at the
-                # combined commit rate, so a fill's expected servable
-                # lifetime is one sibling commit window (~ms) — the
-                # cache degenerates into pure invalidation bookkeeping
-                # (measured: 8.3 -> 3.4 ms filer CPU/request at 4
-                # workers under write load).  Read-mostly worker
-                # fleets can opt back in with =force.
+                # pre-fork worker mode WITHOUT the meta plane: N
+                # co-located siblings over one store advance the
+                # shared durable-ts watermark at the combined commit
+                # rate, so a fill's expected servable lifetime is one
+                # sibling commit window (~ms) — the cache degenerates
+                # into pure invalidation bookkeeping (measured: 8.3 ->
+                # 3.4 ms filer CPU/request at 4 workers under write
+                # load).  With the plane ON the cache stays: sibling
+                # commits arrive as per-path invalidations through
+                # the plane's log follower, so fills survive (ISSUE
+                # 13's worker-scalable coherence).
                 coherent = False
             cache_dir, _ = read_cache_disk()
             self.filer = Filer(master, store,
@@ -295,6 +305,21 @@ class FilerServer:
         self.metrics.gauge_set(
             "locks_held", float(len(self.lock_manager.all_locks())),
             help_text="distributed locks currently held here")
+        if self.filer.meta_plane is not None:
+            mp = self.filer.meta_plane.snapshot()
+            self.metrics.gauge_set(
+                "meta_plane_overlay_entries", float(mp["overlay"]),
+                help_text="WAL-acked entries awaiting the async store "
+                          "checkpoint (the overlay index)")
+            self.metrics.gauge_set(
+                "meta_plane_applier", float(bool(mp["holder"])),
+                help_text="1 when this process holds the designated-"
+                          "applier lock for the shared metalog")
+            self.metrics.gauge_set(
+                "meta_plane_checkpoint_ts_ns",
+                float(mp["checkpointTsNs"]),
+                help_text="newest event stamp the store checkpoint "
+                          "covers")
         from ..stats import render_process
         return 200, ((self.metrics.render() +
                       render_process()).encode(),
@@ -342,8 +367,8 @@ class FilerServer:
         if getattr(self, "grpc_server", None) is not None:
             self.grpc_server.stop(grace=0.5)
         self.http.stop()
-        self.filer.store.close()
-        self.filer.meta_log.close()
+        # meta plane first (final async apply), then store + metalog
+        self.filer.close()
 
     @property
     def url(self) -> str:

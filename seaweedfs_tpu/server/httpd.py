@@ -603,8 +603,15 @@ class HttpServer:
             # in their handler threads (Server.finish_request), with
             # mTLS only CA-signed peers get through
             self._httpd.ssl_context = tls.server_context()
+        # poll_interval: serve_forever's shutdown() handshake waits
+        # for the accept loop's next selector tick — the 0.5 s
+        # default parked EVERY server stop for ~0.25 s on average,
+        # which across a tier-1 run's hundreds of role teardowns was
+        # tens of seconds of pure sleep.  Accepts use the selector,
+        # so a short tick costs ~nothing while serving.
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
+            target=lambda: self._httpd.serve_forever(
+                poll_interval=0.02), daemon=True)
         self._thread.start()
 
     def abort(self) -> None:

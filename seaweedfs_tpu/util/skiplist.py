@@ -11,6 +11,8 @@ the house rule even off-device.
 
 from __future__ import annotations
 
+import zlib
+
 MAX_LEVEL = 16
 
 
@@ -31,8 +33,19 @@ class SkipList:
 
     @staticmethod
     def _height_for(key) -> int:
-        # deterministic 1/2-decay tower height from the key hash
-        h = hash(key) & 0xFFFFFFFF
+        # deterministic 1/2-decay tower height from a PROCESS-STABLE
+        # key digest.  The builtin hash() is salted per process for
+        # str/bytes (PYTHONHASHSEED), which silently falsified the
+        # documented cross-restart determinism for exactly the key
+        # type every caller uses (entry paths) — crc32 is unsalted,
+        # cheap, and well-mixed enough after the avalanche below
+        # (advisor round-5 leftover, fixed in ISSUE 13).
+        if isinstance(key, str):
+            h = zlib.crc32(key.encode("utf-8", "surrogatepass"))
+        elif isinstance(key, (bytes, bytearray)):
+            h = zlib.crc32(key)
+        else:
+            h = hash(key) & 0xFFFFFFFF
         h ^= h >> 16
         h = (h * 0x45D9F3B) & 0xFFFFFFFF
         h ^= h >> 16

@@ -57,13 +57,12 @@ class MasterFollower:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            # the thread is almost always parked inside the 25s long
-            # poll; joining it out would stall EVERY filer/gateway
-            # shutdown (and every test teardown) for the join timeout.
-            # It is a daemon checking _stop at each loop turn and in
-            # its backoff wait — let it drain on its own.
-            self._thread.join(timeout=0.2)
+        # no join at all: the thread is almost always parked inside
+        # the 25s long poll, so even a short join timeout burns its
+        # FULL budget on every filer/gateway shutdown (0.2s here was
+        # ~15s of every tier-1 run across teardowns).  It is a daemon
+        # checking _stop at each loop turn and in its backoff wait —
+        # let it drain on its own.
 
     def wait_synced(self, timeout: float = 5.0) -> bool:
         return self._synced.wait(timeout)

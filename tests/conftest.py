@@ -40,3 +40,19 @@ def pytest_configure(config):
         "markers",
         "slow: long-running scenario (excluded from tier-1's "
         "`-m 'not slow'` fast pass)")
+
+
+@pytest.fixture(scope="session")
+def package_analysis():
+    """ONE full-package analyzer scan per tier-1 run, shared by
+    test_analyze_clean's CI gate and every lint's *_repo_is_clean
+    test.  A full scan costs ~7 s on this box and SIX of them ran
+    per round before ISSUE 13's budget pass — this fixture is where
+    ~25 s of tier-1 wall went."""
+    import os
+
+    from seaweedfs_tpu.devtools.analyze import repo_root, run_paths
+    findings, errors = run_paths(
+        [os.path.join(repo_root(), "seaweedfs_tpu")])
+    assert errors == [], f"unparsable sources: {errors}"
+    return findings

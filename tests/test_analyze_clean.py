@@ -19,10 +19,10 @@ PKG = os.path.join(repo_root(), "seaweedfs_tpu")
 
 
 @pytest.fixture(scope="module")
-def analysis():
-    findings, errors = run_paths([PKG])
-    assert errors == [], f"unparsable sources: {errors}"
-    return findings
+def analysis(package_analysis):
+    # the session-shared scan (tests/conftest.py): one pass serves
+    # this gate and every lint's repo-clean test
+    return package_analysis
 
 
 def test_package_has_zero_new_findings(analysis):

@@ -542,20 +542,27 @@ def test_swfs011_noqa_suppresses():
     assert check(src, "SWFS011") == []
 
 
-def test_swfs011_repo_is_clean():
-    import os
 
-    import seaweedfs_tpu
-    root = os.path.dirname(seaweedfs_tpu.__file__)
-    findings, errors = run_paths([root])
-    assert not errors
+@pytest.fixture(scope="module")
+def package_findings(package_analysis):
+    """The session-shared full-package scan (tests/conftest.py) —
+    the 011/012/013 trio each re-ran the whole ~250-file scan (~7 s
+    apiece) and 014/015 added scoped rescans; one pass serves all."""
+    return package_analysis
+
+
+def _no_new(package_findings, rule_id):
     from seaweedfs_tpu.devtools.analyze import (default_baseline_path,
                                                 load_baseline,
                                                 partition_baseline)
     new, _old = partition_baseline(
-        [f for f in findings if f.rule == "SWFS011"],
+        [f for f in package_findings if f.rule == rule_id],
         load_baseline(default_baseline_path()))
     assert new == [], [f.render() for f in new]
+
+
+def test_swfs011_repo_is_clean(package_findings):
+    _no_new(package_findings, "SWFS011")
 
 
 def test_bare_noqa_suppresses_everything():
@@ -797,20 +804,8 @@ def test_swfs012_noqa_suppresses():
     assert check(src, "SWFS012") == []
 
 
-def test_swfs012_repo_is_clean():
-    import os
-
-    import seaweedfs_tpu
-    root = os.path.dirname(seaweedfs_tpu.__file__)
-    findings, errors = run_paths([root])
-    assert not errors
-    from seaweedfs_tpu.devtools.analyze import (default_baseline_path,
-                                                load_baseline,
-                                                partition_baseline)
-    new, _old = partition_baseline(
-        [f for f in findings if f.rule == "SWFS012"],
-        load_baseline(default_baseline_path()))
-    assert new == [], [f.render() for f in new]
+def test_swfs012_repo_is_clean(package_findings):
+    _no_new(package_findings, "SWFS012")
 
 
 # -- SWFS013: unbounded full-body read on a data-plane path ---------------
@@ -880,20 +875,8 @@ def test_swfs013_noqa_suppresses():
                     "seaweedfs_tpu/server/x.py") == []
 
 
-def test_swfs013_repo_is_clean():
-    import os
-
-    import seaweedfs_tpu
-    root = os.path.dirname(seaweedfs_tpu.__file__)
-    findings, errors = run_paths([root])
-    assert not errors
-    from seaweedfs_tpu.devtools.analyze import (default_baseline_path,
-                                                load_baseline,
-                                                partition_baseline)
-    new, _old = partition_baseline(
-        [f for f in findings if f.rule == "SWFS013"],
-        load_baseline(default_baseline_path()))
-    assert new == [], [f.render() for f in new]
+def test_swfs013_repo_is_clean(package_findings):
+    _no_new(package_findings, "SWFS013")
 
 
 # -- SWFS014: blocking call inside an async def ---------------------------
@@ -959,15 +942,95 @@ def test_swfs014_noqa_suppresses():
     assert check_at(src, "SWFS014", "seaweedfs_tpu/server/x.py") == []
 
 
-def test_swfs014_repo_is_clean():
-    # scoped to server/ — the only tree with coroutines (async_front)
-    # — because a full-package scan already runs twice in this module
-    # and the tier-1 budget is tight
-    import os
+def test_swfs014_repo_is_clean(package_findings):
+    assert [f for f in package_findings
+            if f.rule == "SWFS014"] == []
 
-    import seaweedfs_tpu
-    root = os.path.join(os.path.dirname(seaweedfs_tpu.__file__),
-                        "server")
-    findings, errors = run_paths([root])
-    assert not errors
-    assert [f for f in findings if f.rule == "SWFS014"] == []
+
+# -- SWFS015: per-request serialization/commit on the filer hot path ------
+
+def test_swfs015_flags_per_request_db_commit():
+    src = """
+    class Store:
+        def insert_entry(self, entry):
+            self._db.execute("INSERT", ())
+            self._db.commit()
+    """
+    found = check_at(src, "SWFS015",
+                     "seaweedfs_tpu/filer/abstract_sql.py")
+    assert len(found) == 1
+    assert "per request" in found[0].message
+
+
+def test_swfs015_flags_store_side_entry_serialization():
+    src = """
+    import json
+    class Store:
+        def insert_entry(self, entry):
+            self._rows[entry.full_path] = json.dumps(entry.to_json())
+        def update_entry(self, entry):
+            self._rows[entry.full_path] = entry.to_json()
+    """
+    assert len(check_at(src, "SWFS015",
+                        "seaweedfs_tpu/filer/lsm_store.py")) == 2
+
+
+def test_swfs015_designated_helpers_are_exempt():
+    src = """
+    class Store:
+        def apply_events(self, records):
+            for r in records:
+                self._db.execute("INSERT", r)
+            self._db.commit()
+        def close(self):
+            self._db.commit()
+        def _group_commit_flush(self):
+            self._db.commit()
+        def _checkpoint_flush(self):
+            self._conn.commit()
+    class Plane:
+        def commit(self, op, new_entry, old_entry):
+            return new_entry.to_json()
+    """
+    assert check_at(src, "SWFS015",
+                    "seaweedfs_tpu/filer/abstract_sql.py") == []
+
+
+def test_swfs015_non_db_commit_and_response_render_are_silent():
+    src = """
+    class Filer:
+        def create_entry(self, entry):
+            self._barrier.commit()
+        def _list(self, req):
+            return 200, {"entries": [e.to_json() for e in self.page()]}
+    """
+    assert check_at(src, "SWFS015",
+                    "seaweedfs_tpu/filer/filer.py") == []
+
+
+def test_swfs015_out_of_scope_modules_are_silent():
+    src = """
+    class Store:
+        def insert_entry(self, entry):
+            self._db.commit()
+            return entry.to_json()
+    """
+    assert check_at(src, "SWFS015",
+                    "seaweedfs_tpu/filer/redis_store.py") == []
+    assert check_at(src, "SWFS015",
+                    "seaweedfs_tpu/server/volume_server.py") == []
+
+
+def test_swfs015_noqa_suppresses():
+    src = """
+    class Store:
+        def insert_entry(self, entry):
+            self._db.commit()  # noqa: SWFS015 — kill-switch path
+    """
+    assert check_at(src, "SWFS015",
+                    "seaweedfs_tpu/filer/abstract_sql.py") == []
+
+
+def test_swfs015_repo_is_clean(package_findings):
+    assert [f for f in package_findings
+            if f.rule == "SWFS015"] == []

@@ -1,0 +1,410 @@
+"""Filer meta plane (ISSUE 13): metalog-as-WAL acks, async store
+checkpointing, overlay reads, worker-scalable coherence.
+
+All in-process (two Filer instances over one sqlite file + one
+metalog dir IS the pre-fork worker topology, minus SO_REUSEPORT), so
+the suite stays inside the tier-1 budget; the SIGKILL halves live in
+test_crash_durability.py on the shared proc cluster."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer import meta_plane
+from seaweedfs_tpu.filer.entry import Attributes, Entry
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.filer_store import SqliteStore
+from seaweedfs_tpu.filer.meta_plane import (LOG_START, read_checkpoint,
+                                            recover_sync)
+
+MASTER = "127.0.0.1:1"          # never dialed: metadata-only tests
+
+
+def _filer(db, interval_ms=10, **kw):
+    os.environ["SEAWEEDFS_TPU_META_PLANE_INTERVAL_MS"] = \
+        str(interval_ms)
+    try:
+        return Filer(MASTER, SqliteStore(db),
+                     meta_log_dir=db + ".metalog", **kw)
+    finally:
+        os.environ.pop("SEAWEEDFS_TPU_META_PLANE_INTERVAL_MS", None)
+
+
+def _entry(path, **attrs):
+    return Entry(path, attributes=Attributes(**attrs))
+
+
+def _wait(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# -- WAL ack + overlay ----------------------------------------------------
+
+def test_ack_precedes_store_apply_and_reads_stay_exact(tmp_path):
+    """The tentpole contract: with the applier stalled, a write is
+    acked (metalog-durable) and READABLE — entry and listing — while
+    the sqlite store still has nothing; once the applier runs, the
+    store catches up and the overlay drains."""
+    db = str(tmp_path / "f.db")
+    f = _filer(db, interval_ms=3600_000)     # applier never ticks
+    try:
+        for i in range(8):
+            f.create_entry(_entry(f"/d/x{i}"))
+        assert f.store.find_entry("/d/x0") is None, \
+            "store applied synchronously — the WAL ack is a lie"
+        assert f.find_entry("/d/x3") is not None
+        assert len(f.list_directory("/d", limit=50)) == 8
+        assert f.meta_plane.snapshot()["overlay"] >= 8
+    finally:
+        f.close()
+    # close() runs the final apply: the store is a complete checkpoint
+    assert SqliteStore(db).find_entry("/d/x5") is not None
+
+
+def test_overlay_merge_tombstones_and_pagination(tmp_path):
+    """List merge rules: unapplied creates appear, tombstones hide
+    applied store rows, and a tombstone cannot shrink a full page
+    (the store is over-fetched by the overlay's size)."""
+    db = str(tmp_path / "f.db")
+    f = _filer(db)
+    try:
+        for i in range(10):
+            f.create_entry(_entry(f"/p/a{i:02d}"))
+        _wait(lambda: f.meta_plane.snapshot()["overlay"] == 0,
+              msg="applier drain")
+        # stall the applier from here on
+        f.meta_plane._interval = 3600.0
+        f.delete_entry("/p/a03", delete_chunks=False)
+        f.create_entry(_entry("/p/a99"))
+        names = [e.name for e in f.list_directory("/p", limit=10)]
+        assert "a03" not in names
+        assert names == [f"a{i:02d}" for i in range(10) if i != 3] \
+            + ["a99"]
+        # pagination window still honors start_file over the merge
+        page = [e.name for e in f.list_directory(
+            "/p", start_file="a04", limit=3)]
+        assert page == ["a05", "a06", "a07"]
+        # prefix filtering applies to overlay names too
+        assert [e.name for e in f.list_directory(
+            "/p", prefix="a9", limit=10)] == ["a99"]
+    finally:
+        f.close()
+
+
+def test_rename_and_update_through_overlay(tmp_path):
+    db = str(tmp_path / "f.db")
+    f = _filer(db, interval_ms=3600_000)
+    try:
+        f.create_entry(_entry("/r/old.txt", mime="text/plain"))
+        f.rename("/r/old.txt", "/r/new.txt")
+        assert f.find_entry("/r/old.txt") is None
+        got = f.find_entry("/r/new.txt")
+        assert got is not None and got.attributes.mime == "text/plain"
+        assert [e.name for e in f.list_directory("/r", limit=10)] == \
+            ["new.txt"]
+        f.update_attrs("/r/new.txt", mode=0o600)
+        assert f.find_entry("/r/new.txt").attributes.mode == 0o600
+    finally:
+        f.close()
+    s = SqliteStore(db)
+    assert s.find_entry("/r/old.txt") is None
+    assert s.find_entry("/r/new.txt").attributes.mode == 0o600
+
+
+def test_returned_entries_are_isolated_from_overlay(tmp_path):
+    """Callers mutate returned entries in place (update_attrs); the
+    overlay's copy must stay pristine."""
+    db = str(tmp_path / "f.db")
+    f = _filer(db, interval_ms=3600_000)
+    try:
+        f.create_entry(_entry("/iso/file"))
+        got = f.find_entry("/iso/file")
+        got.attributes.mode = 0o123
+        again = f.find_entry("/iso/file")
+        assert again.attributes.mode != 0o123
+    finally:
+        f.close()
+
+
+# -- crash durability (in-process SIGKILL twin) ---------------------------
+
+def _abandon(f):
+    """Simulate SIGKILL: stop the plane thread WITHOUT the final
+    apply, drop the instance.  (The proc-level SIGKILL versions live
+    in test_crash_durability.py.)"""
+    f.meta_plane._stop.set()
+    f.meta_plane._thread.join(timeout=10)
+    f.store.close()
+
+
+def test_boot_replays_acked_tail_past_checkpoint(tmp_path):
+    db = str(tmp_path / "f.db")
+    f = _filer(db, interval_ms=3600_000)
+    for i in range(12):
+        f.create_entry(_entry(f"/t/k{i:02d}"))
+    assert f.store.find_entry("/t/k00") is None
+    _abandon(f)
+
+    f2 = _filer(db, interval_ms=10)
+    try:
+        # readable IMMEDIATELY via the boot overlay load, before the
+        # applier has caught up
+        assert f2.find_entry("/t/k11") is not None
+        assert len(f2.list_directory("/t", limit=50)) == 12
+        _wait(lambda: f2.store.find_entry("/t/k11") is not None,
+              msg="boot apply")
+    finally:
+        f2.close()
+
+
+def test_kill_switch_boot_replays_unapplied_tail(tmp_path):
+    """SEAWEEDFS_TPU_FILER_META_PLANE=0 after a planed crash: the
+    synchronous path must still replay the acked tail before serving
+    (flipping the knob never un-acks history)."""
+    db = str(tmp_path / "f.db")
+    f = _filer(db, interval_ms=3600_000)
+    f.create_entry(_entry("/ks/acked"))
+    assert f.store.find_entry("/ks/acked") is None
+    _abandon(f)
+
+    os.environ["SEAWEEDFS_TPU_FILER_META_PLANE"] = "0"
+    try:
+        f2 = _filer(db)
+        try:
+            assert f2.meta_plane is None
+            assert f2.store.find_entry("/ks/acked") is not None
+            assert f2.find_entry("/ks/acked") is not None
+        finally:
+            f2.close()
+    finally:
+        os.environ.pop("SEAWEEDFS_TPU_FILER_META_PLANE", None)
+
+
+def test_checkpoint_is_monotonic_and_torn_reads_fail_low(tmp_path):
+    db = str(tmp_path / "f.db")
+    log = db + ".metalog"
+    f = _filer(db, interval_ms=5)
+    try:
+        seen = []
+        stop = threading.Event()
+
+        def sample():
+            while not stop.is_set():
+                ck = read_checkpoint(log)
+                if ck is not None:
+                    seen.append(ck[0])
+                time.sleep(0.005)
+
+        t = threading.Thread(target=sample, daemon=True)
+        t.start()
+        for i in range(120):
+            f.create_entry(_entry(f"/m/n{i:03d}"))
+        _wait(lambda: f.meta_plane.snapshot()["overlay"] == 0,
+              msg="drain")
+        stop.set()
+        t.join(timeout=10)
+        assert seen, "checkpoint never sampled"
+        assert seen == sorted(seen), \
+            "checkpoint position regressed"
+    finally:
+        f.close()
+    # torn checkpoint file: conservative decode (LOG_START, not a
+    # parse of garbage), so replay covers MORE, never less
+    with open(os.path.join(log, meta_plane.CHECKPOINT_FILE),
+              "r+b") as fh:
+        fh.write(b"garbage-without-a-valid-crc")
+    assert read_checkpoint(log) == (LOG_START, 0)
+    # and a filer boots fine over it (full idempotent replay)
+    f3 = _filer(db)
+    try:
+        assert f3.find_entry("/m/n000") is not None
+        assert f3.find_entry("/m/n119") is not None
+    finally:
+        f3.close()
+
+
+# -- worker-topology coherence (store contract) ---------------------------
+
+def test_write_through_a_read_through_b_immediately_fresh(tmp_path):
+    """The ISSUE 13 store-contract test: two filer instances over ONE
+    sqlite store + ONE metalog dir (the pre-fork worker topology).
+    With the applier stalled — so the STORE cannot be the channel —
+    a write through A must be readable through B immediately, via the
+    overlay fed by B's log follower."""
+    db = str(tmp_path / "f.db")
+    a = _filer(db, interval_ms=3600_000)
+    b = _filer(db, interval_ms=3600_000)
+    try:
+        a.create_entry(_entry("/w/one", mime="x/a"))
+        got = b.find_entry("/w/one")
+        assert got is not None and got.attributes.mime == "x/a", \
+            "B did not see A's write immediately"
+        assert b.store.find_entry("/w/one") is None, \
+            "store was the channel — the applier was not stalled"
+        # listings through B see A's writes
+        a.create_entry(_entry("/w/two"))
+        assert [e.name for e in b.list_directory("/w", limit=10)] == \
+            ["one", "two"]
+        # delete through B visible through A
+        b.delete_entry("/w/one", delete_chunks=False)
+        assert a.find_entry("/w/one") is None
+        # overwrite through A visible through B (newest wins)
+        e2 = _entry("/w/two")
+        e2.extended["v"] = "2"
+        a.create_entry(e2)
+        assert b.find_entry("/w/two").extended.get("v") == "2"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_single_applier_election_and_takeover(tmp_path):
+    """Exactly one instance holds the applier flock; when it closes,
+    a sibling takes over and applies the remaining tail."""
+    db = str(tmp_path / "f.db")
+    a = _filer(db, interval_ms=5)
+    b = _filer(db, interval_ms=5)
+    try:
+        _wait(lambda: a.meta_plane._holder or b.meta_plane._holder,
+              msg="election")
+        assert not (a.meta_plane._holder and b.meta_plane._holder), \
+            "two appliers elected"
+        holder, other = (a, b) if a.meta_plane._holder else (b, a)
+        other.create_entry(_entry("/e/pre"))
+        _wait(lambda: other.store.find_entry("/e/pre") is not None,
+              msg="cross-instance apply")
+        holder.close()
+        other.create_entry(_entry("/e/post"))
+        _wait(lambda: other.meta_plane._holder, msg="takeover")
+        _wait(lambda: other.store.find_entry("/e/post") is not None,
+              msg="post-takeover apply")
+    finally:
+        for f in (a, b):
+            try:
+                f.close()
+            except Exception:
+                pass
+
+
+def test_meta_cache_stays_coherent_across_siblings(tmp_path):
+    """Plane mode keeps the meta cache ON without watermark storms:
+    sibling commits arrive as point invalidations, so B's cached
+    value for a path A just overwrote must not be served."""
+    db = str(tmp_path / "f.db")
+    a = _filer(db, interval_ms=3600_000)
+    b = _filer(db, interval_ms=3600_000)
+    try:
+        assert a.meta_cache is not None and b.meta_cache is not None
+        a.create_entry(_entry("/c/hot", mime="v1"))
+        # B reads (and caches) v1 — then A overwrites to v2
+        assert b.find_entry("/c/hot").attributes.mime == "v1"
+        a.create_entry(_entry("/c/hot", mime="v2"))
+        assert b.find_entry("/c/hot").attributes.mime == "v2", \
+            "B served a stale cached entry past A's commit"
+        # unrelated cached fills SURVIVE the sibling commit (the
+        # anti-thrash half: watermark mode killed every fill)
+        b.create_entry(_entry("/c/cold"))
+        b.find_entry("/c/cold")
+        before = b.meta_cache.snapshot()["epoch"]
+        a.create_entry(_entry("/c/other"))
+        b.find_entry("/c/other")          # ingests the sibling event
+        after = b.meta_cache.snapshot()["epoch"]
+        assert after - before <= 2, \
+            "sibling commit invalidated far more than its own paths"
+    finally:
+        a.close()
+        b.close()
+
+
+# -- stores / kill switch parity ------------------------------------------
+
+def test_lsm_store_rides_the_plane(tmp_path):
+    from seaweedfs_tpu.filer.lsm_store import LsmStore
+    os.environ["SEAWEEDFS_TPU_META_PLANE_INTERVAL_MS"] = "10"
+    try:
+        f = Filer(MASTER, LsmStore(str(tmp_path / "lsm")),
+                  meta_log_dir=str(tmp_path / "lsm.metalog"))
+    finally:
+        os.environ.pop("SEAWEEDFS_TPU_META_PLANE_INTERVAL_MS", None)
+    try:
+        assert f.meta_plane is not None
+        f.create_entry(_entry("/l/a"))
+        f.create_entry(_entry("/l/b"))
+        f.delete_entry("/l/a", delete_chunks=False)
+        assert f.find_entry("/l/a") is None
+        assert f.find_entry("/l/b") is not None
+        _wait(lambda: f.store.find_entry("/l/b") is not None,
+              msg="lsm apply")
+        _wait(lambda: f.store.find_entry("/l/a") is None,
+              msg="lsm tombstone apply")
+    finally:
+        f.close()
+
+
+def test_memory_and_ephemeral_stores_stay_synchronous(tmp_path):
+    # MemoryStore: no durable checkpoint target -> no plane
+    f = Filer(MASTER)
+    assert f.meta_plane is None
+    f.close()
+    # :memory: sqlite with a metalog dir: same verdict
+    f2 = Filer(MASTER, SqliteStore(":memory:"),
+               meta_log_dir=str(tmp_path / "ml"))
+    assert f2.meta_plane is None
+    f2.close()
+
+
+def test_kill_switch_and_plane_produce_identical_state(tmp_path):
+    """A/B parity: the same mutation script through the plane and
+    through the synchronous path must leave byte-identical stores
+    (modulo nothing: same entries, same listings, same events)."""
+    scripts = {}
+    for mode, name in (("1", "on"), ("0", "off")):
+        os.environ["SEAWEEDFS_TPU_FILER_META_PLANE"] = mode
+        try:
+            db = str(tmp_path / f"{name}.db")
+            f = _filer(db)
+            assert (f.meta_plane is not None) == (mode == "1")
+            f.create_entry(_entry("/s/a", mime="t/a"))
+            f.create_entry(_entry("/s/b"))
+            f.rename("/s/b", "/s/c")
+            f.delete_entry("/s/a", delete_chunks=False)
+            f.update_attrs("/s/c", mode=0o640)
+            listing = [(e.name, e.attributes.mode)
+                       for e in f.list_directory("/s", limit=10)]
+            ops = [e["op"] for e in f.events_since(0)]
+            f.close()
+            store = SqliteStore(db)
+            rows = [(e.name, e.attributes.mode)
+                    for e in store.list_directory_entries("/s")]
+            store.close()
+            scripts[name] = (listing, ops, rows)
+        finally:
+            os.environ.pop("SEAWEEDFS_TPU_FILER_META_PLANE", None)
+    assert scripts["on"] == scripts["off"], scripts
+
+
+def test_serialize_once_metrics_present(tmp_path):
+    """The meta sub-stage decomposition lands in stats.PROCESS:
+    serialize + barrier per commit, apply per batch."""
+    from seaweedfs_tpu import stats
+    db = str(tmp_path / "f.db")
+    f = _filer(db, interval_ms=5)
+    try:
+        for i in range(5):
+            f.create_entry(_entry(f"/mx/{i}"))
+        _wait(lambda: f.meta_plane.snapshot()["overlay"] == 0,
+              msg="drain")
+    finally:
+        f.close()
+    text = stats.PROCESS.render()
+    for stage in ("serialize", "barrier", "apply"):
+        assert f'filer_meta_sub_seconds_count{{stage="{stage}"}}' \
+            in text, (stage, text[:400])
+    assert "meta_plane_applied_total" in text

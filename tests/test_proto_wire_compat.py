@@ -263,6 +263,82 @@ def test_parser_sees_reference_heartbeat():
     assert hb["max_volume_counts"][:2] == (4, "map")
 
 
+# -- RPC-coverage ratchet (ROADMAP item 4 groundwork, ISSUE 13) -------
+#
+# Interop with the reference's `weed shell` needs the full RPC
+# surface; this ratchet makes coverage VISIBLE per round (the table in
+# the test log) and one-directional: the declared-RPC count per
+# service may only grow.  Floors are the counts at the time of ISSUE
+# 13 — raise them when you add RPCs, never lower them.
+_RPC_FLOOR = {
+    ("filer.proto", "SeaweedFiler"): 20,
+    ("iam.proto", "SeaweedIdentityAccessManagement"): 14,
+    ("master.proto", "Seaweed"): 9,
+    ("mount.proto", "SeaweedMount"): 1,
+    ("mq_agent.proto", "SeaweedMessagingAgent"): 4,
+    ("mq_broker.proto", "SeaweedMessaging"): 13,
+    ("plugin.proto", "PluginControlService"): 1,
+    ("s3.proto", "SeaweedS3IamCache"): 8,
+    ("volume_server.proto", "VolumeServer"): 17,
+    ("worker.proto", "WorkerService"): 1,
+}
+
+
+def _coverage_rows():
+    """[(proto, service, declared, reference_total)] — reference
+    totals are 0 when the checkout is absent."""
+    rows = []
+    for path in repo_protos():
+        name = os.path.basename(path)
+        ours = parse_proto(path)
+        ref_path = os.path.join(REF_PROTO_DIR, name)
+        ref = parse_proto(ref_path) if os.path.exists(ref_path) \
+            else None
+        for svc, rpcs in sorted(ours["services"].items()):
+            refn = len(ref["services"].get(svc, {})) if ref else 0
+            rows.append((name, svc, len(rpcs), refn))
+    return rows
+
+
+def test_rpc_coverage_ratchet():
+    """Every declared service keeps at least its floored RPC count,
+    and the per-service coverage table lands in the test log so each
+    round's interop progress is visible at a glance."""
+    rows = _coverage_rows()
+    assert rows, "no services declared in pb/protos/"
+    lines = [f"{'proto':28s} {'service':34s} declared  reference"]
+    errors = []
+    seen = set()
+    for name, svc, n, refn in rows:
+        seen.add((name, svc))
+        ref_cell = str(refn) if refn else "-"
+        lines.append(f"{name:28s} {svc:34s} {n:8d}  {ref_cell:>9s}")
+        floor = _RPC_FLOOR.get((name, svc))
+        if floor is None:
+            # a brand-new service: add its floor so the ratchet
+            # holds it too
+            errors.append(f"{name}:{svc} has no ratchet floor — add "
+                          f"it to _RPC_FLOOR at {n}")
+        elif n < floor:
+            errors.append(f"{name}:{svc} declares {n} RPCs, below "
+                          f"the ratchet floor {floor} — RPC coverage "
+                          f"must never drop")
+        if refn and n > refn:
+            errors.append(f"{name}:{svc} declares {n} RPCs but the "
+                          f"reference only has {refn}")
+    for key in _RPC_FLOOR:
+        if key not in seen:
+            errors.append(f"{key[0]}:{key[1]} vanished — a floored "
+                          f"service may not be deleted")
+    total = sum(n for _, _, n, _ in rows)
+    ref_total = sum(r for _, _, _, r in rows)
+    lines.append(f"{'TOTAL':28s} {'':34s} {total:8d}  "
+                 f"{ref_total if ref_total else '-':>9}")
+    print("\nRPC coverage:\n" + "\n".join(lines))
+    assert not errors, "RPC coverage ratchet:\n  " + \
+        "\n  ".join(errors)
+
+
 @pytest.mark.parametrize("repo_path", repo_protos(),
                          ids=[os.path.basename(p) for p in repo_protos()])
 def test_generated_stubs_match_proto_source(repo_path):

@@ -172,6 +172,43 @@ def test_skiplist_ordered_ops():
     assert sl.first()[0] == "k0000"
 
 
+def test_skiplist_heights_deterministic_across_processes():
+    """ISSUE 13 satellite (advisor round-5 leftover): the documented
+    deterministic-tree property was FALSE across processes — heights
+    came from the salted builtin hash() for str keys.  Now they come
+    from crc32, so a child interpreter with a different PYTHONHASHSEED
+    must derive identical towers."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    keys = [f"/bench/w{i}/f{i:04d}" for i in range(64)] + ["", "a",
+                                                           "über"]
+    ours = [SkipList._height_for(k) for k in keys]
+    assert all(1 <= h <= 16 for h in ours)
+    assert len(set(ours)) > 1, "degenerate towers: no mixing at all"
+    prog = (
+        "import json,sys\n"
+        "from seaweedfs_tpu.util.skiplist import SkipList\n"
+        "keys=json.loads(sys.argv[1])\n"
+        "print(json.dumps([SkipList._height_for(k) for k in keys]))\n")
+    out = subprocess.run(
+        [sys.executable, "-c", prog, json.dumps(keys)],
+        capture_output=True, text=True, timeout=60,
+        env=dict(os.environ, PYTHONHASHSEED="12345",
+                 JAX_PLATFORMS="cpu",
+                 PYTHONPATH=os.path.dirname(os.path.dirname(
+                     os.path.abspath(__file__)))))
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout) == ours, \
+        "tower heights diverged across interpreters — hash salt leak"
+    # bytes keys ride the same unsalted digest; non-str/bytes may
+    # still use hash() (ints are unsalted by design)
+    assert SkipList._height_for(b"abc") == \
+        SkipList._height_for(b"abc")
+
+
 # -- bounded executor ------------------------------------------------------
 
 
