@@ -1117,6 +1117,79 @@ class FilerHotPathCommitRule(Rule):
                         f"kill-switch path with a reason)")
 
 
+class BareTimeoutLiteralRule(Rule):
+    """SWFS016: a bare numeric `timeout=` literal on a hot-path
+    network call.
+
+    The deadline plane (util/deadline, ISSUE 14) derives every
+    request-path socket timeout from the REMAINING request budget:
+    `timeout=deadline.io_timeout(default, site=...)` shrinks with the
+    budget, fails fast when it is spent, and keeps the seed default
+    for un-deadlined traffic.  A numeric literal at one of these call
+    sites silently opts that hop out — a request with 50ms left can
+    then park for the literal's full value, and the caller's 504 fires
+    only after the work was done anyway.  Scope: the request-path
+    client modules (`operation.py`, `wdclient.py`, `filer/filer.py`,
+    `server/store_ec.py`) and the funnel helpers + lean plane client.
+    Background threads that never carry a deadline (the master
+    follower's snapshot poll) keep their deliberate fixed bound under
+    `# noqa: SWFS016` with a reason."""
+
+    id = "SWFS016"
+    severity = "error"
+    title = "bare numeric timeout on a hot-path network call"
+
+    _FILES = ("seaweedfs_tpu/operation.py",
+              "seaweedfs_tpu/wdclient.py",
+              "seaweedfs_tpu/filer/filer.py",
+              "seaweedfs_tpu/server/store_ec.py")
+    # zero-based positional index of each helper's timeout param
+    # (shared shape with SWFS009's table, plus the lean plane client)
+    _FUNCS = {"http_json": 3, "http_bytes": 4, "http_download": 3,
+              "http_upload": 4, "http_relay": 4,
+              "http_stream_request": 4, "master_json": 4,
+              "_plane_request": 4}
+
+    @staticmethod
+    def _numeric(node: ast.AST) -> bool:
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, (int, float)) and \
+                not isinstance(node.value, bool):
+            return True
+        # -5 / +5 parse as UnaryOp(Constant)
+        return isinstance(node, ast.UnaryOp) and \
+            isinstance(node.operand, ast.Constant) and \
+            isinstance(node.operand.value, (int, float))
+
+    def check(self, ctx: FileContext):
+        rel = ctx.relpath.replace("\\", "/")
+        if not any(rel.endswith(f) for f in self._FILES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func).rsplit(".", 1)[-1]
+            if name not in self._FUNCS:
+                continue
+            value = None
+            for kw in node.keywords:
+                if kw.arg == "timeout":
+                    value = kw.value
+                    break
+            if value is None and len(node.args) > self._FUNCS[name]:
+                value = node.args[self._FUNCS[name]]
+            if value is None or not self._numeric(value):
+                continue
+            yield self.finding(
+                ctx, value,
+                f"{name}(...) with a bare numeric timeout on the "
+                f"request path — derive it from the remaining budget "
+                f"via util.deadline.io_timeout(default, site=...) so "
+                f"a deadline-carrying request cannot out-wait its "
+                f"caller (or noqa a background-thread site with a "
+                f"reason)")
+
+
 RULES = [
     LockDisciplineRule(),
     JitBlockingRule(),
@@ -1133,4 +1206,5 @@ RULES = [
     UnboundedBodyReadRule(),
     AsyncBlockingCallRule(),
     FilerHotPathCommitRule(),
+    BareTimeoutLiteralRule(),
 ]

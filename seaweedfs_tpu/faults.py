@@ -21,11 +21,18 @@ instrumented call site.  The compiled-in sites:
   volume.shard_write.recv  scatter receiver: per received chunk
   volume.receive_file.recv receive_file: per received chunk
   volume.shard_read.serve  shard_read: before serving the range
+  volume.read.serve        volume data path: before a needle GET is
+                           answered (cache included) — key carries
+                           the serving replica's url, so `match`
+                           wedges exactly one replica (the hedged-
+                           read chaos lever)
   ec.rebuild.slice         RemoteShardSource: per fetched window
   ec.encode.window         RemoteShardSink: per pushed window
   master.heartbeat         volume server: before each heartbeat POST
   master.lookup            master: /dir/lookup handler entry
   filer.entry.put          filer: before persisting an entry
+  filer.chunk.fetch        filer: before a chunk view is resolved on
+                           the read path (cache included)
 
 Actions:
 

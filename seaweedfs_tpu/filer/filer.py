@@ -430,18 +430,22 @@ class Filer:
         from .. import profiling
         from ..util.limiter import bounded_parallel
 
-        # capture the handler thread's stage track BEFORE fanning out:
-        # contextvars do not follow the limiter pool's threads, so each
-        # piece re-binds it (operation.assign/upload then report their
-        # assign/upload stages into this request's decomposition)
+        # capture the handler thread's stage track AND deadline BEFORE
+        # fanning out: contextvars do not follow the limiter pool's
+        # threads, so each piece re-binds both (operation.assign/
+        # upload then report their stages into this request's
+        # decomposition, and their outbound hops keep deriving
+        # timeouts from THIS request's shrinking budget)
         trk = profiling.current_track()
+        from ..util import deadline as _dl
+        dl = _dl.get()
 
         def upload_piece(off: int) -> FileChunk:
             piece = data[off:off + CHUNK_SIZE]
             # fresh-assign retry on volume-state races (a background
             # ec.encode marking the assigned volume readonly mid-write
             # must cost a retry, not surface a 500 to the tenant)
-            with profiling.use_track(trk):
+            with _dl.use(dl), profiling.use_track(trk):
                 a, r = operation.assign_and_upload(
                     self.master, piece, collection=self.collection,
                     replication=self.replication)
@@ -553,6 +557,11 @@ class Filer:
         overwrites mint new fids — so cached bodies never need
         invalidation, and serving a slice of a cached body replaces a
         filer->volume network round trip with a memory copy."""
+        # armed `filer.chunk.fetch` faults (delay/error) fire before
+        # the cache answers — chaos coverage for the filer->volume
+        # read leg of the deadline plane; keyed by the chunk fid
+        from .. import faults
+        faults.fire("filer.chunk.fetch", key=view.file_id)
         cc = self.chunk_cache
         if cc is not None and 0 < view.chunk_size <= \
                 self.CHUNK_CACHE_ITEM_MAX:

@@ -12,6 +12,7 @@ from dataclasses import dataclass
 
 from . import security
 from .server.httpd import http_bytes, http_json
+from .util import deadline as _deadline
 
 
 class VidCache:
@@ -79,6 +80,11 @@ def master_json(master: str, method: str, path: str,
         try:
             r = http_json(method, f"{url}{path}", payload, timeout,
                           headers=headers)
+        except _deadline.DeadlineExceeded:
+            # budget verdict: trying the next seed cannot conjure
+            # time, and erasing the type here would cost the caller
+            # its 504 translation (and re-assign loops their fail-fast)
+            raise
         except OSError as e:
             last = f"{url}: {e}"
             last_exc = e
@@ -119,7 +125,9 @@ def assign(master: str, count: int = 1, collection: str = "",
         qs += f"&ttl={ttl}"
     from . import profiling
     with profiling.stage("assign"):
-        r = master_json(master, "GET", f"/dir/assign?{qs}", timeout=30)
+        r = master_json(master, "GET", f"/dir/assign?{qs}",
+                        timeout=_deadline.io_timeout(
+                            30.0, site="master.assign"))
     if "error" in r:
         raise RuntimeError(f"assign: {r['error']}")
     return Assignment(r["fid"], r["url"], r.get("publicUrl", r["url"]),
@@ -169,8 +177,9 @@ def upload(url: str, fid: str, data: bytes, name: str = "",
         headers["Authorization"] = f"Bearer {auth}"
     from . import profiling
     with profiling.stage("upload"):
-        status, body, _ = http_bytes("POST", f"{url}/{fid}{qs}", data,
-                                     headers, timeout=60)
+        status, body, _ = http_bytes(
+            "POST", f"{url}/{fid}{qs}", data, headers,
+            timeout=_deadline.io_timeout(60.0, site="volume.upload"))
     if status >= 300:
         raise UploadError(f"upload {fid} -> {status}: {body[:200]!r}",
                           status)
@@ -314,6 +323,12 @@ def assign_and_upload(master: str, data: bytes, name: str = "",
             # volume moved/unmounted/filled since the assign), never a
             # verdict on the data: drop the window, re-assign fresh
             last = e
+        except _deadline.DeadlineExceeded:
+            # the budget is spent: re-assigning cannot conjure time —
+            # fail fast (the edge answers 504 / the client's error
+            # path owns recovery with a fresh budget)
+            _assign_cache.invalidate(spec)
+            raise
         except (RuntimeError, OSError) as e:
             _assign_cache.invalidate(spec)
             last = e
@@ -392,7 +407,9 @@ def lookup(master: str, vid: int, use_cache: bool = True) -> list[dict]:
         cached = _vid_cache.get(master, vid)
         if cached is not None:
             return cached
-    r = master_json(master, "GET", f"/dir/lookup?volumeId={vid}", timeout=30)
+    r = master_json(master, "GET", f"/dir/lookup?volumeId={vid}",
+                    timeout=_deadline.io_timeout(
+                        30.0, site="master.lookup"))
     if "error" in r:
         raise LookupError(r["error"])
     _vid_cache.put(master, vid, r["locations"])
@@ -410,11 +427,26 @@ def _server_status(url: str) -> dict:
         if url in _uds_probe:
             return _uds_probe[url]
     try:
-        st, body, _ = http_bytes("GET", f"{url}/status", None, None, 5)
+        t = _deadline.io_timeout(5.0, site="status.probe")
+    except _deadline.DeadlineExceeded:
+        # budget already spent: answer "no plane" for THIS request
+        # without caching — a tight-budget first caller must not
+        # permanently mark a healthy server plane-less
+        return {}
+    try:
+        st, body, _ = http_bytes("GET", f"{url}/status", None, None, t)
         doc = json.loads(body) if st == 200 else {}
+    except _deadline.DeadlineExceeded:
+        return {}       # mid-call budget verdict: same no-cache rule
     except (OSError, ValueError, TypeError):
         # TypeError: tests monkeypatch http_bytes with narrow fakes —
         # discovery must degrade to "no plane", never break an upload
+        d = _deadline.get()
+        if d is not None and d.expired():
+            # the probe lost to the BUDGET (t was budget-capped), not
+            # to the server: serve "no plane" uncached so the next,
+            # roomier caller re-probes
+            return {}
         doc = {}
     with _uds_lock:
         _uds_probe[url] = doc
@@ -447,7 +479,14 @@ def _plane_request(addr: str, method: str, path: str,
     """One request over the thread's persistent plane socket; retries
     once on a stale keep-alive socket (plane requests are idempotent:
     fixed-fid writes dedup server-side, reads are reads).  Raises
-    OSError when the plane is unreachable."""
+    OSError when the plane is unreachable.
+
+    `timeout` bounds the WHOLE call, not each socket operation: the
+    recv loops re-derive their per-op timeout from what is left, so a
+    wedged (or byte-trickling) C++ plane parks this client for at most
+    the budget — when the request carries a deadline the effective
+    bound shrinks to the remaining budget (the caller derives
+    `timeout` via util/deadline.io_timeout)."""
     import socket as _socket
     socks = getattr(_plane_local, "socks", None)
     if socks is None:
@@ -455,24 +494,42 @@ def _plane_request(addr: str, method: str, path: str,
     req = (f"{method} {path} HTTP/1.1\r\n"
            f"Host: {addr}\r\n"
            f"Content-Length: {len(body)}\r\n\r\n").encode()
+    end = time.monotonic() + timeout
+
+    def _left() -> float:
+        # a spent REQUEST budget must surface as the budget verdict it
+        # is (the caller re-raises it), never as the socket.timeout
+        # "plane wedged" verdict below — misreading it would invalidate
+        # a healthy server's status cache and tear down its socket
+        d = _deadline.get()
+        if d is not None and d.expired():
+            _deadline.note_exceeded("plane.io")
+            raise _deadline.DeadlineExceeded("plane.io")
+        left = end - time.monotonic()
+        if left <= 0:
+            raise _socket.timeout(
+                f"plane {addr}: call budget ({timeout:.2f}s) spent")
+        return left
+
     for attempt in (0, 1):
         sock = socks.get(addr)
         reused = sock is not None
         if sock is None:
             host, _, port = addr.rpartition(":")
             sock = _socket.create_connection((host, int(port)),
-                                             timeout=timeout)
+                                             timeout=_left())
             sock.setsockopt(_socket.IPPROTO_TCP,
                             _socket.TCP_NODELAY, 1)
             socks[addr] = sock
         try:
-            sock.settimeout(timeout)
+            sock.settimeout(_left())
             sock.sendall(req + body if len(body) < (256 << 10)
                          else req)
             if len(body) >= (256 << 10):
                 sock.sendall(body)
             buf = b""
             while b"\r\n\r\n" not in buf:
+                sock.settimeout(_left())
                 chunk = sock.recv(65536)
                 if not chunk:
                     raise OSError("plane socket closed mid-response")
@@ -488,11 +545,25 @@ def _plane_request(addr: str, method: str, path: str,
                     clen = int(v.strip())
                     break
             while len(rest) < clen:
+                sock.settimeout(_left())
                 chunk = sock.recv(65536)
                 if not chunk:
                     raise OSError("plane socket closed mid-body")
                 rest += chunk
             return status, rest[:clen]
+        except _deadline.DeadlineExceeded:
+            # _left()'s budget verdict mid-call: an in-flight response
+            # may be abandoned on the wire, so the keep-alive socket
+            # must still be dropped (it would poison the next request)
+            # — but the stale-socket re-dial below must NOT run: a
+            # fresh dial cannot conjure budget, and the retry would
+            # count a second exceed for one spent budget
+            try:
+                sock.close()
+            except OSError:
+                pass
+            socks.pop(addr, None)
+            raise
         except OSError:
             try:
                 sock.close()
@@ -532,14 +603,27 @@ def _write_via_write_plane(url: str, fid: str, data: bytes
         return None
     vid = fid.partition(",")[0]
     misses = _plane_vid_misses()
-    deadline = misses.get((addr, vid))
-    if deadline is not None:
-        if time.monotonic() < deadline:
+    neg_until = misses.get((addr, vid))
+    if neg_until is not None:
+        if time.monotonic() < neg_until:
             return None
         del misses[(addr, vid)]
+    # derive the plane call's budget OUTSIDE the try: an already-spent
+    # deadline must fail the write fast, not read as "plane down" (the
+    # OSError below both invalidates the status probe and falls back
+    # to the pooled POST — wrong on both counts for a budget verdict)
+    t = _deadline.io_timeout(10.0, site="plane.write")
     try:
-        status, body = _plane_request(addr, "POST", f"/{fid}", data)
+        status, body = _plane_request(addr, "POST", f"/{fid}", data,
+                                      timeout=t)
+    except _deadline.DeadlineExceeded:
+        raise                     # budget verdict, not a plane verdict
     except OSError:
+        # a recv that parked until the BUDGET ran out raises plain
+        # socket.timeout (t was capped by the remaining budget at
+        # derivation) — still the budget's verdict, and a healthy
+        # server must not be marked plane-less for the client's clock
+        _deadline.reraise_if_expired("plane.write")
         _invalidate_status(url)   # restarted server: re-probe ports
         return None
     if status == 201:
@@ -579,17 +663,50 @@ def _read_via_read_plane(locs, fid: str) -> "bytes | None":
         addr = _read_plane_addr_for(loc["url"])
         if not addr:
             continue
+        # budget derived outside the try (see _write_via_write_plane)
+        t = _deadline.io_timeout(10.0, site="plane.read")
         try:
             # lean persistent-socket client (same funnel as the write
             # plane): the C++ plane speaks strict minimal HTTP, so the
             # http.client machinery is pure overhead here
-            status, body = _plane_request(addr, "GET", f"/{fid}")
+            status, body = _plane_request(addr, "GET", f"/{fid}",
+                                          timeout=t)
+        except _deadline.DeadlineExceeded:
+            raise                 # budget verdict, not a plane verdict
         except OSError:
+            # see _write_via_write_plane: a budget-bounded park is the
+            # budget's verdict, never "plane down"
+            _deadline.reraise_if_expired("plane.read")
             _invalidate_status(loc["url"])
             continue
         if status == 200:
             return body
     return None
+
+
+def _uds_read_one(loc, vid: int, key: int, cookie: int
+                  ) -> "tuple[bytes | None, bool]":
+    """One location's same-host UDS zero-copy attempt.  Returns
+    (data, stop): data on success; stop=True when the needle's
+    semantics live server-side (compressed/chunked/ttl'd — HTTP must
+    serve it, and every replica would answer the same); (None, False)
+    = not served here (no local socket / transport error / cookie
+    mismatch) — the caller tries its next plane or location."""
+    from .server.uds_reader import uds_read_needle
+    p = _uds_path_for(loc["url"])
+    if not p:
+        return None, False
+    try:
+        n = uds_read_needle(p, vid, key)
+    except (OSError, LookupError, ValueError):
+        return None, False  # fall to HTTP (which also retries)
+    if n.cookie != cookie:
+        # a per-replica mismatch is not terminal — the HTTP path
+        # 404s one replica and tries the next; do the same
+        return None, False
+    if n.is_compressed() or n.is_chunked_manifest() or n.has_ttl():
+        return None, True
+    return bytes(n.data), False
 
 
 def _read_via_uds(locs, vid: int, key: int, cookie: int
@@ -598,25 +715,74 @@ def _read_via_uds(locs, vid: int, key: int, cookie: int
     sidecar analog): fetch the raw needle record over the unix socket
     and validate client-side.  None = not applicable here (no local
     socket / compressed / chunked / ttl'd needle — HTTP handles
-    those); raises on a cookie mismatch like the HTTP path 404s."""
-    from .server.uds_reader import uds_read_needle
+    those)."""
     for loc in locs:
-        p = _uds_path_for(loc["url"])
-        if not p:
-            continue
-        try:
-            n = uds_read_needle(p, vid, key)
-        except (OSError, LookupError, ValueError):
-            continue  # fall to HTTP (which also retries replicas)
-        if n.cookie != cookie:
-            # a per-replica mismatch is not terminal — the HTTP path
-            # 404s one replica and tries the next; do the same
-            continue
-        if n.is_compressed() or n.is_chunked_manifest() or \
-                n.has_ttl():
+        data, stop = _uds_read_one(loc, vid, key, cookie)
+        if data is not None:
+            return data
+        if stop:
             return None  # semantics live server-side: use HTTP
-        return bytes(n.data)
     return None
+
+
+def _maybe_hedged_read(locs, fid: str, headers,
+                       plane_ok: bool = False, vid: int = -1,
+                       key: int = -1, cookie: int = -1
+                       ) -> "bytes | None":
+    """Hedge-capable fetch of `fid` across the first two locations
+    (util/hedge; first-wins).  Only deadline-carrying requests enter:
+    the hedge plane exists to meet budgets, and the un-deadlined path
+    (bench arms, bulk tools) must keep the zero-handoff sequential
+    funnel.  Each leg covers its location's WHOLE funnel — when
+    `plane_ok` (the whole-needle unauthenticated shape the native
+    planes serve): same-host UDS zero-copy first, the C++ read plane
+    second, then the HTTP port — so deadline-carrying reads keep the
+    fast paths AND one wedged replica costs ~p95 whichever plane it
+    is wedged on.  None = not applicable or no success — the caller's
+    sequential loops proceed unchanged."""
+    from .util import hedge as _hedge
+    if not _hedge.reads_enabled():
+        return None
+    d = _deadline.get()
+    if d is None:
+        return None
+    threshold = _hedge.read_threshold()
+    if threshold is None:
+        return None                       # tracker cold: no baseline
+    if d.remaining() <= threshold + _deadline.MIN_TIMEOUT:
+        return None                       # no room for a second leg
+
+    def fetch(loc):
+        if plane_ok:
+            if key >= 0:
+                data, _stop = _uds_read_one(loc, vid, key, cookie)
+                if data is not None:
+                    return 200, data
+            addr = _read_plane_addr_for(loc["url"])
+            if addr:
+                try:
+                    status, pbody = _plane_request(
+                        addr, "GET", f"/{fid}",
+                        timeout=_deadline.io_timeout(
+                            10.0, site="plane.read"))
+                    if status == 200:
+                        return 200, pbody
+                except _deadline.DeadlineExceeded:
+                    raise
+                except OSError:
+                    # raced hedge leg: fall through to the HTTP port
+                    # WITHOUT invalidating the status cache — the
+                    # sequential funnel owns that verdict
+                    pass
+        status, body, _ = http_bytes(
+            "GET", f"{loc['url']}/{fid}", None, headers,
+            timeout=_deadline.io_timeout(60.0, site="volume.read"))
+        return status, body
+
+    val, _hedged = _hedge.hedged_fetch(
+        lambda: fetch(locs[0]), lambda: fetch(locs[1]), threshold,
+        lambda sv: sv[0] in (200, 206), kind="read")
+    return val[1] if val is not None else None
 
 
 def read(master: str, fid: str, offset: int = 0,
@@ -625,24 +791,8 @@ def read(master: str, fid: str, offset: int = 0,
     on the filer's chunk-view path)."""
     vid = int(fid.split(",", 1)[0])
     locs = lookup(master, vid)
-    if offset == 0 and size is None and \
-            not security.current().volume_read_key:
-        # whole-needle, unauthenticated-read deployments: try the
-        # same-host UDS zero-copy plane first
-        try:
-            part = fid.split(",", 1)[1]
-            key, cookie = int(part[:-8], 16), int(part[-8:], 16)
-        except (IndexError, ValueError):
-            key = cookie = -1
-        if key >= 0:
-            # native C++ read plane first (works cross-host, serves
-            # via kernel sendfile); UDS second (same-host only)
-            data = _read_via_read_plane(locs, fid)
-            if data is not None:
-                return data
-            data = _read_via_uds(locs, vid, key, cookie)
-            if data is not None:
-                return data
+    plane_shape = offset == 0 and size is None and \
+        not security.current().volume_read_key
     headers = {}
     if offset or size is not None:
         end = f"{offset + size - 1}" if size is not None else ""
@@ -651,16 +801,63 @@ def read(master: str, fid: str, offset: int = 0,
     read_auth = security.current().read_jwt(fid)
     if read_auth:
         headers["Authorization"] = f"Bearer {read_auth}"
+    key = cookie = -1
+    if plane_shape:
+        try:
+            part = fid.split(",", 1)[1]
+            key, cookie = int(part[:-8], 16), int(part[-8:], 16)
+        except (IndexError, ValueError):
+            key = cookie = -1
+    if len(locs) >= 2:
+        # hedged replica read (util/hedge), BEFORE the sequential
+        # native-plane funnel: when this request carries a deadline
+        # and the primary replica exceeds the p95-tracked threshold,
+        # the read is re-issued to a second location and the first
+        # success wins — one slow/wedged replica costs ~p95, not the
+        # whole budget (each hedge leg runs its location's full
+        # UDS -> C++ plane -> HTTP port ladder, so the fast paths are
+        # kept AND covered).  Returns None (unarmed / tokenless /
+        # tracker cold / no success) -> the classic sequential funnel
+        # below still owns the request.
+        body = _maybe_hedged_read(locs, fid, headers,
+                                  plane_ok=plane_shape, vid=vid,
+                                  key=key, cookie=cookie)
+        if body is not None:
+            return body
+    if plane_shape and key >= 0:
+        # whole-needle, unauthenticated-read deployments: native C++
+        # read plane first (works cross-host, serves via kernel
+        # sendfile); UDS second (same-host only).  Successes feed the
+        # hedge threshold tracker — on plane-serving deployments these
+        # ARE the primary reads, and a cold tracker would never arm
+        # the hedge for them.
+        from .util import hedge as _hedge
+        t0 = time.monotonic()
+        data = _read_via_read_plane(locs, fid)
+        if data is not None:
+            _hedge.note_primary(time.monotonic() - t0)
+            return data
+        data = _read_via_uds(locs, vid, key, cookie)
+        if data is not None:
+            _hedge.note_primary(time.monotonic() - t0)
+            return data
     last_err = None
     for attempt in range(2):
         for loc in locs:
+            t0 = time.monotonic()
             try:
                 status, body, _ = http_bytes(
-                    "GET", f"{loc['url']}/{fid}", None, headers, timeout=60)
+                    "GET", f"{loc['url']}/{fid}", None, headers,
+                    timeout=_deadline.io_timeout(60.0,
+                                                 site="volume.read"))
+            except _deadline.DeadlineExceeded:
+                raise
             except OSError as e:
                 last_err = f"{loc['url']} -> {e}"
                 continue
             if status in (200, 206):
+                from .util import hedge as _hedge
+                _hedge.note_primary(time.monotonic() - t0)
                 return body
             last_err = f"{loc['url']} -> {status}"
         # stale cache? refresh once and retry (vidmap invalidation)
@@ -691,8 +888,15 @@ def delete(master: str, fid: str) -> None:
     headers = security.current().write_headers(fid)
     for loc in locs:
         try:
-            status, body, _ = http_bytes("DELETE", f"{loc['url']}/{fid}",
-                                         headers=headers, timeout=60)
+            status, body, _ = http_bytes(
+                "DELETE", f"{loc['url']}/{fid}", headers=headers,
+                timeout=_deadline.io_timeout(60.0,
+                                             site="volume.delete"))
+        except _deadline.DeadlineExceeded:
+            # the budget verdict must surface as itself (the fronts'
+            # 504 translation, retry's no-re-issue rule), never fold
+            # into the generic "delete failed" RuntimeError below
+            raise
         except OSError as e:
             last = f"{loc['url']}: {e}"
             continue

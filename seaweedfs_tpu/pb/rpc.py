@@ -16,6 +16,7 @@ from concurrent import futures
 import grpc
 
 from .. import faults as _faults
+from ..util import deadline as _udeadline
 from ..util import retry as _uretry
 
 _KIND_TO_HANDLER = {
@@ -45,6 +46,26 @@ def _adopt_trace(context) -> "tuple[str, str]":
     return rid, parent
 
 
+def _adopt_deadline(context) -> "_udeadline.Deadline | None":
+    """gRPC ingress half of the deadline plane (util/deadline): the
+    wire already carries the budget as `grpc-timeout` (the client
+    stub's `timeout=` kwarg), surfaced here as
+    `context.time_remaining()` — adopt it into the contextvar so the
+    servicer's outbound hops (HTTP and gRPC alike) inherit the
+    shrinking budget.  Always binds (None included): executor threads
+    are reused across RPCs."""
+    rem = None
+    try:
+        rem = context.time_remaining()
+    except Exception:  # noqa: BLE001 — a context without deadline
+        rem = None     # support must not fail the RPC
+    if rem is not None and rem > 1e6:
+        # grpc encodes "no deadline" as a far-future int64 expiry on
+        # some transports; ~11 days of budget means nobody is waiting
+        rem = None
+    return _udeadline.adopt_budget(rem, site="grpc")
+
+
 def _traced_method(service_name: str, name: str, kind: str, fn,
                    role: str):
     """Wrap one servicer method in a server span.  Response-streaming
@@ -56,8 +77,16 @@ def _traced_method(service_name: str, name: str, kind: str, fn,
     if kind in ("uu", "su"):
         def unary(request, context):
             rid, parent = _adopt_trace(context)
+            dl = _adopt_deadline(context)
             with tracing.span(f"{service_name}/{name}", role=role,
                               parent=parent, trace_id=rid) as sp:
+                if dl is not None and dl.expired():
+                    # fail fast before the servicer queues any work —
+                    # the gRPC twin of the HTTP fronts' 504
+                    _udeadline.note_exceeded("grpc.ingress")
+                    context.abort(
+                        grpc.StatusCode.DEADLINE_EXCEEDED,
+                        "deadline exceeded before dispatch")
                 try:
                     return fn(request, context)
                 except BaseException as e:
@@ -67,9 +96,14 @@ def _traced_method(service_name: str, name: str, kind: str, fn,
 
     def streaming(request, context):
         rid, parent = _adopt_trace(context)
+        dl = _adopt_deadline(context)
         sp = tracing.start_span(f"{service_name}/{name}", role=role,
                                 parent=parent, trace_id=rid)
         try:
+            if dl is not None and dl.expired():
+                _udeadline.note_exceeded("grpc.ingress")
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                              "deadline exceeded before dispatch")
             yield from fn(request, context)
         except BaseException as e:
             sp.set_error(e)
@@ -123,6 +157,13 @@ class StubBreakerOpen(_uretry.BreakerOpen, grpc.RpcError):
     callers catch it specifically."""
 
 
+class StubDeadlineExceeded(_udeadline.DeadlineExceeded, grpc.RpcError):
+    """The request budget is spent before the call could even be
+    dialed.  Dual-typed like its siblings: `except grpc.RpcError`
+    sites keep working, and the deadline plane's handlers (retry's
+    no-re-issue rule) see the DeadlineExceeded they expect."""
+
+
 def _with_trace_metadata(multicallable, peer: str = ""):
     """Attach the active request id + trace parent as invocation
     metadata on every call (the gRPC twin of _pooled_request's header
@@ -136,6 +177,22 @@ def _with_trace_metadata(multicallable, peer: str = ""):
     def call(request, **kwargs):
         from .. import tracing
         from ..util.request_id import get_request_id
+        # deadline plane, outbound — checked FIRST (before the fault
+        # hook or the breaker admits this caller as a half-open probe,
+        # which a refusal here would otherwise strand): the contextvar
+        # budget becomes the call's grpc-timeout (the native wire
+        # encoding — the server wrapper reads it back via
+        # context.time_remaining()).  An explicit caller timeout= wins
+        # but is still capped by the budget; an expired budget refuses
+        # the call before dialing.
+        rem = _udeadline.remaining()
+        if rem is not None:
+            if rem <= 0.0:
+                _udeadline.note_exceeded("rpc.stub")
+                raise StubDeadlineExceeded("rpc.stub")
+            explicit = kwargs.get("timeout")
+            kwargs["timeout"] = rem if explicit is None \
+                else min(float(explicit), rem)
         try:
             _faults.fire("rpc.stub.call", key=peer)
         except _faults.FaultInjected as e:

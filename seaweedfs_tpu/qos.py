@@ -56,6 +56,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from .util.hedge import LatencyTracker as _LatencyTracker
+
 
 def _env_float(name: str, default: float) -> float:
     try:
@@ -408,6 +410,68 @@ def tenant_of(req) -> str:
     return "anonymous"
 
 
+# -- brownout shedding (the deadline plane's admission hook) ---------------
+#
+# A request that arrives with less budget than this server currently
+# needs to serve anything is already lost: admitting it spends a
+# handler thread, store reads and downstream hops on work the client
+# will have abandoned by the time the response is written.  Admission
+# therefore consults the arriving request's deadline (util/deadline)
+# against the MEDIAN of recent request service latencies — the
+# "current queue latency" signal, fed by the release callback
+# admission already hands the server fronts — and sheds unmeetable
+# work with 503 + Retry-After (reason "brownout") BEFORE a rate token
+# or byte reservation is spent.  Only deadline-carrying requests can
+# brown out; everything else is admitted exactly as before.
+#
+# A windowed median, not a mean/EWMA: the release samples cover the
+# response write, so one front serves a MIX of millisecond point
+# requests and multi-second bulk transfers, and a mean would let a
+# minority of bulk samples shed fast deadline-carrying reads that
+# would comfortably finish.  The median only moves once bulk traffic
+# is the MAJORITY of the window — at which point a small-budget
+# request genuinely faces that queue.  (A mostly-bulk front that also
+# serves point reads is still mis-estimated; `_FACTOR` tunes the
+# sensitivity down and `BROWNOUT=0` is the kill switch.)
+#
+#   SEAWEEDFS_TPU_BROWNOUT=0         kill switch (default on)
+#   SEAWEEDFS_TPU_BROWNOUT_FACTOR    shed when remaining < median * f
+#                                    (default 1.0)
+
+# the same ring-quantile the hedge threshold runs on (one
+# implementation to tune), window 64 / warmup 20 / q=0.5
+_brownout_tracker = _LatencyTracker(size=64, min_samples=20)
+
+
+def brownout_enabled() -> bool:
+    return os.environ.get("SEAWEEDFS_TPU_BROWNOUT", "1") \
+        not in ("0", "false")
+
+
+def _brownout_factor() -> float:
+    return max(0.0, _env_float("SEAWEEDFS_TPU_BROWNOUT_FACTOR", 1.0))
+
+
+def note_latency(seconds: float) -> None:
+    """Feed one completed request's service latency into the brownout
+    estimator (called from the admission release path — covers
+    handler + response write)."""
+    _brownout_tracker.note(seconds)
+
+
+def brownout_estimate() -> float:
+    """Expected service latency for a request admitted NOW (windowed
+    median; the sort costs 64 floats and only runs for
+    deadline-carrying arrivals); 0.0 until enough traffic has been
+    seen to estimate anything (a cold server must not shed its first
+    requests on noise)."""
+    return _brownout_tracker.quantile(0.5) or 0.0
+
+
+def _brownout_reset() -> None:
+    _brownout_tracker.reset()
+
+
 # exempt from admission on every role: the observability/debug plane
 # must stay reachable from a throttled cluster (the runtime QoS lever
 # itself rides /debug), and /status is every poller's liveness probe
@@ -428,16 +492,47 @@ def install(http, role: str, path_prefix: str = "") -> None:
             return None, None
         if path_prefix and not path.startswith(path_prefix):
             return None, None
+        from . import stats
+        from .util import deadline as _deadline
         tenant = tenant_of(req)
+        # brownout: a deadline-carrying request whose remaining budget
+        # cannot cover the current expected service latency is shed
+        # BEFORE any token/byte accounting (already-expired budgets
+        # belong to the fronts' 504 path, not this 503)
+        d = _deadline.get()
+        if d is not None and brownout_enabled():
+            est = brownout_estimate() * _brownout_factor()
+            rem = d.remaining()
+            if est > 0.0 and 0.0 < rem < est:
+                stats.PROCESS.counter_add(
+                    "qos_rejected_total", 1.0,
+                    help_text="requests rejected by QoS admission",
+                    tenant=tenant, role=role, reason="brownout")
+                retry_after = max(1, int(est + 0.999))
+                body = (b'{"error": "qos: request budget below '
+                        b'current service latency (brownout)"}')
+                return (503, (body,
+                              {"Retry-After": str(retry_after),
+                               "Content-Type": "application/json"})), \
+                    None
         nbytes = int(req.headers.get("Content-Length") or 0)
         release, reject = ctl.admit(tenant, nbytes)
-        from . import stats
         if reject is None:
             stats.PROCESS.counter_add(
                 "qos_admitted_total", 1.0,
                 help_text="requests admitted by QoS",
                 tenant=tenant, role=role)
-            return None, release
+            # the release callback doubles as the brownout
+            # estimator's latency feed: it runs on the server fronts'
+            # response finally path, so the sample covers handler
+            # execution AND the response write
+            t0 = time.monotonic()
+
+            def _release_and_note(_inner=release):
+                note_latency(time.monotonic() - t0)
+                if _inner is not _NOOP:
+                    _inner()
+            return None, _release_and_note
         stats.PROCESS.counter_add(
             "qos_rejected_total", 1.0,
             help_text="requests rejected by QoS admission",
@@ -744,6 +839,7 @@ def reset() -> None:
         _throttle._pace = 0.0
         _throttle._p99 = 0.0
         _throttle._last.clear()
+    _brownout_reset()
 
 
 def _env_default_config() -> None:

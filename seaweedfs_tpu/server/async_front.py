@@ -296,6 +296,17 @@ class AsyncFront:
         guard, route — the same ladder as the threaded dispatcher."""
         outer = self.owner
         rid = ensure_request_id(req.headers.get(_RID_HEADER, ""))
+        # deadline plane (util/deadline): same ingress contract as the
+        # threaded front — adopt (or clear a stale binding on this
+        # reused pool thread) before any work, 504 an expired budget
+        # before admission/guard/route spend anything; the
+        # maintenance plane is exempt from the operator DEFAULT
+        # (explicit budgets still honored)
+        from ..util import deadline as _dl
+        dl = _dl.adopt(req.headers.get(_dl.HEADER),
+                       site=outer.role or "server",
+                       allow_default=not req.path.startswith(
+                           ("/admin/", "/debug/")))
         route = outer.routes.get((req.method, req.path))
         if route is None and outer.prefix_routes:
             route = outer._prefix_route(req.method, req.path)
@@ -304,10 +315,15 @@ class AsyncFront:
         sp = tracing.start_span(
             f"{req.method} {req.path}", role=outer.role,
             parent=parent_span, trace_id=rid)
+        if dl is not None:
+            sp.set("deadlineMs", int(dl.remaining() * 1e3))
         qos_release = None
         try:
             throttled = None
-            if outer.admission is not None:
+            if dl is not None and dl.expired():
+                throttled = _dl.expired_response(
+                    f"{outer.role or 'server'}.ingress")
+            if throttled is None and outer.admission is not None:
                 throttled, qos_release = outer.admission(req)
             if throttled is not None:
                 status, payload = throttled
@@ -320,6 +336,10 @@ class AsyncFront:
                 status, payload = outer.fallback(req)
             else:
                 status, payload = 404, {"error": "not found"}
+        except _dl.DeadlineExceeded as e:
+            # budget died mid-handler: 504, matching the threaded front
+            status, payload = _dl.handler_exceeded_response()
+            sp.set_error(e)
         except Exception as e:  # noqa: BLE001 — server must answer
             status, payload = 500, {"error": str(e)}
             sp.set_error(e)

@@ -296,8 +296,21 @@ class HttpServer:
                 from ..util.request_id import HEADER as _RID_HEADER
                 from ..util.request_id import ensure_request_id
                 from .. import tracing
+                from ..util import deadline as _dl
                 rid = ensure_request_id(
                     req.headers.get(_RID_HEADER, ""))
+                # deadline plane (util/deadline): adopt the caller's
+                # remaining budget (or the operator default) BEFORE
+                # anything spends time on this request; the adopt
+                # also clears any stale deadline this reused handler
+                # thread carried from its previous request.  The
+                # maintenance plane only ever runs under an EXPLICIT
+                # budget — a tenant-facing default must not 504 a
+                # multi-minute volume copy or EC rebuild mid-pull.
+                dl = _dl.adopt(req.headers.get(_dl.HEADER),
+                               site=outer.role or "server",
+                               allow_default=not req.path.startswith(
+                                   ("/admin/", "/debug/")))
                 route = outer.routes.get((req.method, req.path))
                 if route is None and outer.prefix_routes:
                     route = outer._prefix_route(req.method, req.path)
@@ -309,6 +322,8 @@ class HttpServer:
                 sp = tracing.start_span(
                     f"{req.method} {req.path}", role=outer.role,
                     parent=parent_span, trace_id=rid)
+                if dl is not None:
+                    sp.set("deadlineMs", int(dl.remaining() * 1e3))
                 status = 0
                 qos_release = None
                 stream_cleanup = None   # file-like response body
@@ -327,11 +342,20 @@ class HttpServer:
                     # handler return would record a multi-second
                     # stream as ~0ms
                     try:
-                        # QoS admission first (qos.py): an over-limit
+                        # expired budget: 504 + Retry-After BEFORE
+                        # admission spends a rate token, the guard
+                        # verifies anything, or the handler queues —
+                        # work the client already abandoned is shed
+                        # at the cheapest possible point
+                        throttled = None
+                        if dl is not None and dl.expired():
+                            throttled = _dl.expired_response(
+                                f"{outer.role or 'server'}.ingress")
+                        # QoS admission next (qos.py): an over-limit
                         # tenant is rejected with 503 + Retry-After
                         # BEFORE auth or routing spends anything on it
-                        throttled = None
-                        if outer.admission is not None:
+                        if throttled is None and \
+                                outer.admission is not None:
                             throttled, qos_release = \
                                 outer.admission(req)
                         if throttled is not None:
@@ -346,6 +370,13 @@ class HttpServer:
                         else:
                             status, payload = 404, \
                                 {"error": "not found"}
+                    except _dl.DeadlineExceeded as e:
+                        # budget died mid-handler (an outbound hop's
+                        # io_timeout raised): the honest status is
+                        # 504, not a generic 500
+                        status, payload = \
+                            _dl.handler_exceeded_response()
+                        sp.set_error(e)
                     except Exception as e:  # noqa: BLE001 — server
                         # must answer
                         status, payload = 500, {"error": str(e)}
@@ -762,18 +793,27 @@ def _fire_fault(site: str, key: str = "") -> "str | None":
 
 
 def http_download(url: str, dest_path: str,
-                  headers: dict | None = None, timeout: float = 600.0,
+                  headers: dict | None = None, timeout: float = 60.0,
                   chunk_size: int = 4 << 20) -> tuple[int, dict]:
     """GET `url` streaming the response body to `dest_path` in chunks —
     bounded memory no matter the file size (the worker's bulk volume
     pull; the reference streams CopyFile the same way,
     volume_server.proto:69).  Returns (status, response headers); on a
     non-2xx status dest_path is removed and the (small) error body is
-    left unconsumed."""
+    left unconsumed.
+
+    `timeout` is a per-socket-operation stall bound, not a transfer
+    bound: a 30GB pull may run for hours as long as bytes keep
+    arriving, but a peer that goes silent costs 60s, not the old 600s
+    (deadline plane satellite: a hung peer must not park a caller for
+    minutes even with the plane disabled).  When the request carries a
+    deadline the stall bound shrinks to the remaining budget."""
     import os as _os
+    from ..util import deadline as _dl
+    timeout = _dl.io_timeout(timeout, site="httpd.download")
     full_url, ctx = _dial(url)
-    req = urllib.request.Request(full_url,
-                                 headers=_auth_for(url, headers))
+    req = urllib.request.Request(
+        full_url, headers=_dl.stamp_headers(_auth_for(url, headers)))
     # download into a sibling temp file and os.replace on success: a
     # mid-transfer failure (connection reset at 10GB of a 30GB pull)
     # must never leave a truncated file at dest_path for the store to
@@ -809,7 +849,7 @@ def http_download(url: str, dest_path: str,
 
 
 def http_relay(src_url: str, dst_method: str, dst_url: str,
-               headers: dict | None = None, timeout: float = 600.0,
+               headers: dict | None = None, timeout: float = 60.0,
                chunk_size: int = 4 << 20
                ) -> "tuple[int, int, bytes]":
     """Stream a GET of `src_url` straight into a chunked-encoded
@@ -817,12 +857,17 @@ def http_relay(src_url: str, dst_method: str, dst_url: str,
     chunk, so the two transfer legs overlap instead of staging the
     whole file through a temp relay, and RAM stays bounded by one
     chunk.  Returns (src_status, dst_status, dst_body); on a non-2xx
-    source the upload never starts (dst_status 0)."""
+    source the upload never starts (dst_status 0).  `timeout` is a
+    per-socket-operation stall bound (see http_download), deadline-
+    derived when the request carries a budget."""
     import http.client
 
+    from ..util import deadline as _dl
+    timeout = _dl.io_timeout(timeout, site="httpd.relay")
     full_src, src_ctx = _dial(src_url)
-    req = urllib.request.Request(full_src,
-                                 headers=_auth_for(src_url, headers))
+    req = urllib.request.Request(
+        full_src,
+        headers=_dl.stamp_headers(_auth_for(src_url, headers)))
     try:
         resp = urllib.request.urlopen(req, timeout=timeout,
                                       context=src_ctx)
@@ -843,7 +888,8 @@ def http_relay(src_url: str, dst_method: str, dst_url: str,
         else:
             conn = http.client.HTTPConnection(parsed.netloc,
                                               timeout=timeout)
-        up_headers = dict(_auth_for(dst_url, headers))
+        up_headers = dict(_dl.stamp_headers(
+            _auth_for(dst_url, headers)))
         up_headers["Transfer-Encoding"] = "chunked"
         expected = resp.length  # None when the source streams chunked
 
@@ -918,7 +964,7 @@ def http_relay(src_url: str, dst_method: str, dst_url: str,
 
 def http_stream_request(method: str, url: str, chunks,
                         headers: dict | None = None,
-                        timeout: float = 600.0
+                        timeout: float = 60.0
                         ) -> "tuple[int, bytes]":
     """Send an iterable of byte windows as ONE chunked-encoded request
     body — the producer side of `Request.stream_body`.  The request is
@@ -927,9 +973,13 @@ def http_stream_request(method: str, url: str, chunks,
     wire speed with bounded memory instead of staging a whole shard.
     A producer exception tears the connection down mid-body — the
     receiver sees a short chunked stream and errors, never a
-    truncated-but-clean upload.  Returns (status, body)."""
+    truncated-but-clean upload.  Returns (status, body).  `timeout`
+    is a per-socket-operation stall bound (see http_download),
+    deadline-derived when the request carries a budget."""
     import http.client
 
+    from ..util import deadline as _dl
+    timeout = _dl.io_timeout(timeout, site="httpd.stream")
     full_url, ctx = _dial(url)
     parsed = urllib.parse.urlsplit(full_url)
     target = parsed.path or "/"
@@ -941,7 +991,7 @@ def http_stream_request(method: str, url: str, chunks,
     else:
         conn = http.client.HTTPConnection(parsed.netloc,
                                           timeout=timeout)
-    up_headers = dict(_auth_for(url, headers))
+    up_headers = dict(_dl.stamp_headers(_auth_for(url, headers)))
     try:
         # manual chunk framing instead of http.client's encode_chunked:
         # that path CONCATENATES header+chunk+trailer into a fresh
@@ -1008,14 +1058,18 @@ def http_stream_request(method: str, url: str, chunks,
 
 
 def http_upload(method: str, url: str, src_path: str,
-                headers: dict | None = None, timeout: float = 600.0
+                headers: dict | None = None, timeout: float = 60.0
                 ) -> tuple[int, bytes, dict]:
     """Send a file as the request body WITHOUT buffering it in memory:
     Content-Length is set from the file size and http.client streams
-    the file object in blocks (the worker's bulk shard push)."""
+    the file object in blocks (the worker's bulk shard push).
+    `timeout` is a per-socket-operation stall bound (see
+    http_download), deadline-derived when a budget is armed."""
     import os as _os
+    from ..util import deadline as _dl
+    timeout = _dl.io_timeout(timeout, site="httpd.upload")
     size = _os.path.getsize(src_path)
-    headers = dict(_auth_for(url, headers))
+    headers = dict(_dl.stamp_headers(_auth_for(url, headers)))
     headers["Content-Length"] = str(size)
     full_url, ctx = _dial(url)
     with open(src_path, "rb") as f:
@@ -1170,17 +1224,31 @@ def _pooled_request(method: str, url: str, body, headers: dict,
     # jittered backoff + process retry budget.  POSTs keep exactly the
     # seed's semantics: only `_one_pooled_request`'s provably-never-
     # executed send-failed rule re-issues them.
+    from ..util import deadline as _dl
     from ..util import retry as _retry
     for _hop in range(max_redirects):
         peer = urllib.parse.urlsplit(full_url).netloc
         idempotent = method in ("GET", "HEAD", "PUT", "DELETE",
                                 "OPTIONS") or \
             headers.get("X-Idempotent") == "1"
-        hop_url = full_url
+
+        def _attempt(u=full_url):
+            # deadline plane, per ATTEMPT: the socket timeout is
+            # re-derived from the budget remaining NOW (a retry after
+            # backoff has less), and the forwarded header carries the
+            # fresh remaining ms so the receiver can never out-wait
+            # this caller.  An already-spent budget raises before the
+            # dial (DeadlineExceeded — retry_call refuses to re-issue
+            # it).  Unarmed requests: two contextvar reads, the seed
+            # timeout, no header.
+            t = _dl.io_timeout(timeout, site="httpd.pool")
+            return _one_pooled_request(method, u, body,
+                                       _dl.stamp_headers(headers),
+                                       t, ctx)
+
         status, data, rheaders, location = _retry.retry_call(
-            lambda: _one_pooled_request(method, hop_url, body,
-                                        headers, timeout, ctx),
-            site="httpd.pool", peer=peer, idempotent=idempotent)
+            _attempt, site="httpd.pool", peer=peer,
+            idempotent=idempotent)
         if status in (301, 302, 307, 308) and location and \
                 method in ("GET", "HEAD"):
             # urllib-parity redirect following for read paths

@@ -25,6 +25,7 @@ from ..storage.erasure_coding.ec_context import (LARGE_BLOCK_SIZE,
                                                  SMALL_BLOCK_SIZE)
 from ..storage.erasure_coding.ec_volume import NotFoundError
 from ..storage.needle import Needle
+from ..util.deadline import DeadlineExceeded as _DeadlineExceeded
 from .httpd import http_bytes, http_json
 
 # tiered freshness (store_ec.go:248): incomplete -> 11s, full -> 37min,
@@ -144,6 +145,9 @@ class EcReader:
                 try:
                     return self._recover_interval_streamed(
                         ev, sid, off, iv.size, locs, step)
+                except _DeadlineExceeded:
+                    raise   # budget verdict: re-planning cannot
+                    # conjure time — surface the 504 now
                 except (OSError, ValueError, KeyError):
                     # a survivor died mid-stream past its internal
                     # failover: the one-shot path below re-plans from
@@ -169,17 +173,28 @@ class EcReader:
         37-minute TTL expires."""
         if url == self.self_url:
             return None
+        from ..util import deadline as _deadline
         from ..util import retry as _retry
         if not _retry.peer_available(url):
             self._note_failover(url)
             return None
+        # budget derived OUTSIDE the try: an expired deadline must
+        # surface as the budget verdict it is, not read as a dead
+        # shard server (failover + location bust would punish a
+        # healthy peer for the client's clock)
+        t = _deadline.io_timeout(10.0, site="ec.shard_read")
         try:
             status, body, _ = http_bytes(
                 "GET",
                 f"{url}/admin/ec/shard_read?volumeId={vid}&shardId={sid}"
-                f"&offset={offset}&size={size}", timeout=10,
+                f"&offset={offset}&size={size}", timeout=t,
                 headers=self._security_headers())
+        except _deadline.DeadlineExceeded:
+            raise               # budget verdict, not a peer verdict
         except OSError:
+            # the budget can also die MID-call (a budget-capped socket
+            # timeout on a healthy-but-slower peer): same rule
+            _deadline.reraise_if_expired("ec.shard_read")
             self._note_failover(url)
             self._bust_locations(vid, url)
             return None
@@ -358,11 +373,19 @@ class EcReader:
                 fresh = fresh and age < _TTL_INCOMPLETE
             if not fresh:
                 from ..operation import master_json
+                from ..util import deadline as _deadline
+                # budget derived OUTSIDE the try (shard_read rule): a
+                # spent deadline fails fast here instead of proceeding
+                # with stale/empty locations on a dead budget
+                t = _deadline.io_timeout(5.0, site="master.ec_lookup")
                 try:
                     r = master_json(
                         self.master, "GET",
-                        f"/dir/ec_lookup?volumeId={ev.id}", timeout=5)
+                        f"/dir/ec_lookup?volumeId={ev.id}", timeout=t)
+                except _deadline.DeadlineExceeded:
+                    raise       # budget verdict, not master-unreachable
                 except OSError:
+                    _deadline.reraise_if_expired("master.ec_lookup")
                     r = {}
                 locs: dict[int, list[str]] = {}
                 for entry in r.get("shardIdLocations", []):

@@ -655,6 +655,13 @@ class VolumeServer:
 
     def _get_needle(self, fid: types.FileId, rng: str = "",
                     query: "dict | None" = None, req=None):
+        # armed `volume.read.serve` faults (delay: one slow replica;
+        # error: one dead replica) fire before the cache OR the store
+        # answers — the chaos lever behind the hedged-read scenarios;
+        # keyed by this server's url so `match` can wedge exactly one
+        # replica of a volume
+        from .. import faults
+        faults.fire("volume.read.serve", key=f"{self.http.url}/{fid}")
         cached = self._nc_get(fid)
         if cached is not None:
             mime, data = cached

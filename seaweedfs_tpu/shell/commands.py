@@ -1230,6 +1230,44 @@ def _native_plane_report(before: "dict[str, list]",
     return "native-planes: " + "  ".join(parts)
 
 
+def _deadline_report(before: "dict[str, list]",
+                     after: "dict[str, list]") -> str:
+    """Deadline-plane view over the sampling window: budgets refused
+    (per fail-fast site) and hedged replica reads issued/won
+    (util/deadline + util/hedge meter on the shared registry).  Empty
+    when the window saw neither — the common healthy state."""
+    exceeded = _counter_sum(
+        after, "seaweedfs_tpu_deadline_exceeded_total") - \
+        _counter_sum(before, "seaweedfs_tpu_deadline_exceeded_total")
+    issued = _counter_sum(
+        after, "seaweedfs_tpu_hedges_issued_total") - \
+        _counter_sum(before, "seaweedfs_tpu_hedges_issued_total")
+    won = _counter_sum(
+        after, "seaweedfs_tpu_hedges_won_total") - \
+        _counter_sum(before, "seaweedfs_tpu_hedges_won_total")
+    parts = []
+    if exceeded > 0:
+        sites = {l.get("site", "") for l, _v in after.get(
+            "seaweedfs_tpu_deadline_exceeded_total", [])}
+        worst = []
+        for s in sorted(sites):
+            d = _counter_sum(
+                after, "seaweedfs_tpu_deadline_exceeded_total",
+                {"site": s}) - _counter_sum(
+                before, "seaweedfs_tpu_deadline_exceeded_total",
+                {"site": s})
+            if d > 0:
+                worst.append((d, s))
+        worst.sort(reverse=True)
+        top = " ".join(f"{s}={d:.0f}" for d, s in worst[:3])
+        parts.append(f"exceeded={exceeded:.0f} ({top})")
+    if issued > 0:
+        parts.append(f"hedges={issued:.0f} issued/{won:.0f} won")
+    if not parts:
+        return ""
+    return "deadline: " + "  ".join(parts)
+
+
 @command("cluster.top")
 def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
     """Live one-screen cluster view: every node's /metrics sampled
@@ -1341,6 +1379,9 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
         gc = _group_commit_report(b or {}, a)
         if gc:
             out.append("  " + gc)
+        dl = _deadline_report(b or {}, a)
+        if dl:
+            out.append("  " + dl)
         try:
             prof = http_json("GET", f"{url}/debug/pprof?top=3",
                              timeout=3)
@@ -1524,6 +1565,16 @@ def run_command(env: CommandEnv, line: str) -> str:
     if fn is None:
         raise ValueError(
             f"unknown command {name!r}; known: {sorted(COMMANDS)}")
+    # shell ingress of the deadline plane (util/deadline): with
+    # SEAWEEDFS_TPU_DEADLINE_DEFAULT_MS configured every command runs
+    # under a budget that its outbound hops forward and derive their
+    # timeouts from — a wedged peer fails an operator's command fast
+    # instead of parking the shell.  Unconfigured: nothing is bound.
+    from ..util import deadline as _dl
+    budget = _dl.default_budget()
+    if budget > 0:
+        with _dl.scope(budget):
+            return fn(env, args)
     return fn(env, args)
 
 

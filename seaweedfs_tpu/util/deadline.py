@@ -1,0 +1,292 @@
+"""Per-request deadline plane: one budget, every hop.
+
+A slow or wedged peer must cost a request its *budget*, never minutes:
+before this module every outbound hop in the client funnel carried an
+independent fixed timeout (30s control, 600s bulk), nothing told a
+downstream server how long the caller was still willing to wait, and
+work kept executing long after the client had given up.  This module
+is the shared vocabulary the whole request path speaks instead:
+
+* a **Deadline** (monotonic expiry) rides a contextvar, stamped at
+  every ingress — the threaded httpd front, the asyncio front, the
+  gRPC servicer wrappers, and the shell's command dispatch — from the
+  caller's `X-Weed-Deadline-Ms` header (remaining milliseconds at send
+  time), gRPC's native `grpc-timeout`, or the operator default
+  `SEAWEEDFS_TPU_DEADLINE_DEFAULT_MS`;
+
+* every outbound hop forwards the REMAINING budget as the same header
+  (`stamp_headers`) and derives its socket/connect/read timeout from
+  it (`io_timeout`): the budget only ever shrinks across hops, so the
+  deepest hop in a gateway -> filer -> volume chain can never out-wait
+  the edge;
+
+* an **expired** budget fails fast: `io_timeout` raises
+  `DeadlineExceeded` (an OSError — every transport-failure handler
+  already knows what to do) *before* dialing, and the server fronts
+  answer 504 + Retry-After *before* dispatching the handler — work is
+  shed at the cheapest point, never after queueing (`util/retry`
+  additionally refuses any retry whose backoff + minimum useful
+  timeout exceeds what is left).
+
+Contextvars do not follow worker-pool threads; code that fans a
+request out (the filer's chunk-upload pool, hedged reads) captures
+`get()` and re-binds with `use(...)` — the same pattern as
+profiling.use_track.
+
+Observability (shared stats.PROCESS registry, on every /metrics):
+`deadline_exceeded_total{site}` counts every fail-fast (ingress and
+client sites), `deadline_remaining_seconds{site}` is the
+remaining-budget histogram observed at each ingress hop — a shrinking
+per-hop profile is the plane working; a flat one means a hop is not
+forwarding.  `cluster.top` renders the exceeded/hedge counters.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import math
+import os
+import time
+
+# remaining budget in integer milliseconds at the moment the request
+# left the sender (the only clock both ends share is "duration")
+HEADER = "X-Weed-Deadline-Ms"
+
+# the minimum useful socket timeout: below this a dial/recv cannot
+# plausibly succeed, so a derived timeout is floored here and a
+# remaining budget smaller than it is treated as already spent by the
+# retry policy's doomed-attempt check
+MIN_TIMEOUT = 0.05
+
+
+class DeadlineExceeded(OSError):
+    """The request's budget is spent.  An OSError so transport-failure
+    handling (unwind, error bodies) applies — but deterministic for
+    the retry policy: a budget only shrinks, so re-issuing can never
+    change the verdict."""
+
+    def __init__(self, site: str = ""):
+        super().__init__(
+            f"request deadline exceeded{f' at {site}' if site else ''}")
+        self.site = site
+
+
+class Deadline:
+    """Monotonic expiry; cheap to query, immutable."""
+
+    __slots__ = ("expires_at",)
+
+    def __init__(self, budget_s: float):
+        self.expires_at = time.monotonic() + max(float(budget_s), 0.0)
+
+    def remaining(self) -> float:
+        return max(0.0, self.expires_at - time.monotonic())
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self.expires_at
+
+    def header_value(self) -> str:
+        """Remaining budget as the wire header value (whole ms,
+        rounded down — the receiver must never think it has more time
+        than the sender does)."""
+        return str(int(self.remaining() * 1e3))
+
+
+_current: "contextvars.ContextVar[Deadline | None]" = \
+    contextvars.ContextVar("weed_deadline", default=None)
+
+
+def get() -> "Deadline | None":
+    return _current.get()
+
+
+def remaining() -> "float | None":
+    """Seconds left, or None when no deadline is armed."""
+    d = _current.get()
+    return None if d is None else d.remaining()
+
+
+def bind(deadline: "Deadline | None") -> "contextvars.Token":
+    return _current.set(deadline)
+
+
+def restore(token) -> None:
+    _current.reset(token)
+
+
+@contextlib.contextmanager
+def use(deadline: "Deadline | None"):
+    """Re-bind a captured deadline on another thread (worker pools:
+    the filer's chunk-upload fan-out, hedge workers).  Always sets —
+    including None — because pooled threads otherwise carry the
+    PREVIOUS request's deadline forever."""
+    token = _current.set(deadline)
+    try:
+        yield deadline
+    finally:
+        _current.reset(token)
+
+
+@contextlib.contextmanager
+def scope(budget_s: float):
+    """Mint a fresh deadline for a local operation (tests, shell
+    commands, tools)."""
+    token = _current.set(Deadline(budget_s))
+    try:
+        yield _current.get()
+    finally:
+        _current.reset(token)
+
+
+def default_budget() -> float:
+    """Operator default applied at ingress when the caller sent no
+    budget (SEAWEEDFS_TPU_DEADLINE_DEFAULT_MS, 0 = no default — the
+    plane is header-driven only)."""
+    try:
+        ms = float(os.environ.get(
+            "SEAWEEDFS_TPU_DEADLINE_DEFAULT_MS", "") or 0.0)
+    except ValueError:
+        ms = 0.0
+    if not math.isfinite(ms):
+        ms = 0.0
+    return max(ms, 0.0) / 1e3
+
+
+def parse_header(value: "str | None") -> "Deadline | None":
+    """The wire header -> a Deadline (None for absent/malformed —
+    a garbled budget must not take the request down, it just rides
+    un-deadlined like before the plane existed)."""
+    if not value:
+        return None
+    try:
+        ms = float(value)
+    except ValueError:
+        return None
+    if not math.isfinite(ms):
+        # 'inf' would overflow int(remaining()*1e3) at the next span
+        # tag, and Deadline(nan) is never expired() yet has zero
+        # remaining() — both are malformed, both ride un-deadlined
+        return None
+    if ms < 0:
+        ms = 0.0
+    return Deadline(ms / 1e3)
+
+
+def adopt(header_value: "str | None", site: str = "",
+          allow_default: bool = True) -> "Deadline | None":
+    """Ingress stamping: adopt the caller's budget (or mint the
+    operator default), ALWAYS (re)setting the contextvar — handler
+    threads are reused across requests and a stale deadline from the
+    previous request must never govern this one.  Observes the
+    remaining-budget histogram for the hop when armed.
+
+    `allow_default=False` skips the operator-default minting (an
+    EXPLICIT caller budget is always honored): the fronts pass it for
+    the /admin/ and /debug/ maintenance planes, whose bulk operations
+    (a 30GB volume copy, an EC rebuild) legitimately outlive any
+    tenant-facing default — a cluster-wide default must not 504 the
+    repair pipeline mid-pull."""
+    d = parse_header(header_value)
+    if d is None and allow_default:
+        budget = default_budget()
+        if budget > 0:
+            d = Deadline(budget)
+    return adopt_deadline(d, site)
+
+
+def adopt_budget(budget_s: "float | None",
+                 site: str = "") -> "Deadline | None":
+    """Ingress stamping for transports that already decoded the
+    remaining budget into seconds (gRPC's `context.time_remaining()`
+    instead of the HTTP header).  Same contract as `adopt`: always
+    (re)binds, observes the ingress histogram when armed."""
+    return adopt_deadline(
+        Deadline(budget_s) if budget_s is not None else None, site)
+
+
+def adopt_deadline(d: "Deadline | None",
+                   site: str = "") -> "Deadline | None":
+    _current.set(d)
+    if d is not None:
+        _metrics().histogram_observe(
+            "deadline_remaining_seconds", d.remaining(),
+            help_text="request budget remaining at ingress, per hop",
+            site=site or "?")
+    return d
+
+
+def stamp_headers(headers: dict) -> dict:
+    """Forward the remaining budget on an outbound hop (explicit
+    caller header wins).  Returns `headers` untouched when no deadline
+    is armed — the unarmed path costs one contextvar read."""
+    d = _current.get()
+    if d is None or HEADER in headers:
+        return headers
+    headers = dict(headers)
+    headers[HEADER] = d.header_value()
+    return headers
+
+
+def io_timeout(default: float, site: str = "") -> float:
+    """Derive a socket/connect/read timeout from the remaining budget:
+    min(default, remaining) floored at MIN_TIMEOUT.  An already-spent
+    budget raises DeadlineExceeded (counted per site) BEFORE the dial
+    — failing fast is the point.  Unarmed requests keep `default`."""
+    d = _current.get()
+    if d is None:
+        return default
+    rem = d.remaining()
+    if rem <= 0.0:
+        note_exceeded(site)
+        raise DeadlineExceeded(site)
+    return min(default, max(rem, MIN_TIMEOUT))
+
+
+def reraise_if_expired(site: str) -> None:
+    """For transport-failure (`except OSError`) handlers on the
+    client funnel: when the armed budget is (now) spent, the failure
+    in hand is the BUDGET's verdict — a budget-capped socket timeout
+    on a healthy-but-slower peer, or a DeadlineExceeded raised
+    mid-call — so count it and re-raise as DeadlineExceeded instead
+    of returning, letting the caller mark a healthy peer
+    down/failed-over/plane-less for the client's clock.  No-op when
+    no deadline is armed or budget remains (a real peer failure:
+    handle as before)."""
+    d = _current.get()
+    if d is not None and d.expired():
+        note_exceeded(site)
+        raise DeadlineExceeded(site) from None
+
+
+def note_exceeded(site: str) -> None:
+    _metrics().counter_add(
+        "deadline_exceeded_total", 1.0,
+        help_text="requests/hops refused because the budget was spent",
+        site=site or "?")
+
+
+def expired_response(site: str) -> "tuple[int, tuple]":
+    """The uniform server-front answer for a request that arrived
+    (or queued) past its budget: 504 + Retry-After before any handler
+    work.  Retry-After 1s: the client's next attempt carries a fresh
+    budget; there is nothing server-side to wait out."""
+    note_exceeded(site)
+    body = b'{"error": "deadline exceeded before dispatch"}'
+    return 504, (body, {"Retry-After": "1",
+                        "Content-Type": "application/json"})
+
+
+def handler_exceeded_response() -> "tuple[int, tuple]":
+    """The fronts' answer when the budget dies MID-handler (an
+    outbound hop's `io_timeout` raised — that site already counted the
+    exceed, so this helper deliberately does not): the honest status
+    is 504, not a generic 500.  Retry-After 1s, as above."""
+    body = b'{"error": "deadline exceeded"}'
+    return 504, (body, {"Retry-After": "1",
+                        "Content-Type": "application/json"})
+
+
+def _metrics():
+    from .. import stats
+    return stats.PROCESS

@@ -1,0 +1,297 @@
+"""Hedged replica reads: tail-latency insurance for the read path.
+
+One slow replica must not spend a whole request budget (the
+tail-at-scale shape: p99 of a fan-in is dominated by the slowest
+leg).  When a read has a second location AND the request carries a
+deadline, the primary fetch runs on a hedge worker; if it has not
+answered within a p95-tracked latency threshold and the hedge token
+budget allows, the SAME fetch is issued to the second replica and the
+first success wins — the loser's response is discarded.
+
+Load safety is the token budget: every tracked primary read earns
+`SEAWEEDFS_TPU_HEDGE_RATIO` (0.1) of a token, capped at
+`SEAWEEDFS_TPU_HEDGE_BURST` (16), and every *issued* hedge spends
+one — steady state hedges are bounded at ~10% extra reads no matter
+how slow the cluster gets, so hedging can never double cluster load.
+The threshold is the p95 of recent successful primary reads (floored
+at `SEAWEEDFS_TPU_HEDGE_MIN_MS`, 2ms): hedges fire only for reads
+already slower than ~19 of their 20 predecessors.
+
+Only deadline-carrying requests hedge (`SEAWEEDFS_TPU_HEDGE_READS=0`
+disables entirely): the un-deadlined path — every benchmark arm, bulk
+tooling — keeps the zero-handoff sequential funnel, so the plane
+costs nothing where nobody asked for latency bounds.
+
+Workers are plain daemon threads (not concurrent.futures: its
+non-daemon workers would hold interpreter exit hostage to a parked
+recv); per-thread pooled sockets persist across hedged calls exactly
+like the main funnel's.
+
+Observability: `hedges_issued_total` / `hedges_won_total` on the
+shared registry; won/issued is the plane's value per token spent.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+
+from . import deadline as _deadline
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def reads_enabled() -> bool:
+    return os.environ.get("SEAWEEDFS_TPU_HEDGE_READS", "1") \
+        not in ("0", "false")
+
+
+def min_threshold() -> float:
+    return _env_float("SEAWEEDFS_TPU_HEDGE_MIN_MS", 2.0) / 1e3
+
+
+class LatencyTracker:
+    """A quantile over a ring of recent latency samples (the hedge
+    threshold's p95 here; qos.py's brownout median reuses it).  Tiny
+    on the hot path: note() is one lock round + a ring write;
+    quantile() sorts `size` floats only when a decision is actually
+    being made."""
+
+    def __init__(self, size: int = 128, min_samples: int = 8):
+        self.size = size
+        self.min_samples = min_samples
+        self._ring: "list[float]" = []
+        self._i = 0
+        self._lock = threading.Lock()
+
+    def note(self, seconds: float) -> None:
+        with self._lock:
+            if len(self._ring) < self.size:
+                self._ring.append(seconds)
+            else:
+                self._ring[self._i] = seconds
+                self._i = (self._i + 1) % self.size
+
+    def quantile(self, q: float = 0.95) -> "float | None":
+        with self._lock:
+            if len(self._ring) < self.min_samples:
+                return None
+            s = sorted(self._ring)
+        return s[min(int(len(s) * q), len(s) - 1)]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring = []
+            self._i = 0
+
+
+class _TokenPool:
+    """The hedge budget: earned by primary reads, spent per issued
+    hedge.  Starts full — a cold process may hedge its very first
+    slow read."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tokens: "float | None" = None
+
+    def _burst(self) -> float:
+        return max(1.0, _env_float("SEAWEEDFS_TPU_HEDGE_BURST", 16.0))
+
+    def earn(self) -> None:
+        ratio = max(0.0, _env_float("SEAWEEDFS_TPU_HEDGE_RATIO", 0.1))
+        with self._lock:
+            if self._tokens is None:
+                self._tokens = self._burst()
+            else:
+                self._tokens = min(self._burst(), self._tokens + ratio)
+
+    def take(self) -> bool:
+        with self._lock:
+            if self._tokens is None:
+                self._tokens = self._burst()
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._tokens = None
+
+
+read_tracker = LatencyTracker()
+_tokens = _TokenPool()
+
+
+def note_primary(seconds: float) -> None:
+    """Record one successful primary read: feeds the threshold
+    tracker AND earns the fractional hedge token that primary reads
+    fund the hedge budget with."""
+    read_tracker.note(seconds)
+    _tokens.earn()
+
+
+def take_token() -> bool:
+    return _tokens.take()
+
+
+def read_threshold() -> "float | None":
+    """When to fire the hedge: p95 of recent primary reads, floored —
+    None until the tracker has seen enough traffic to know what
+    "slow" means here."""
+    p95 = read_tracker.quantile(0.95)
+    if p95 is None:
+        return None
+    return max(p95, min_threshold())
+
+
+def reset() -> None:
+    """Test isolation: forget latency history and refill tokens."""
+    read_tracker.reset()
+    _tokens.reset()
+
+
+# -- the hedge worker pool -------------------------------------------------
+#
+# Plain daemon threads over a SimpleQueue, GROWN ON DEMAND up to a
+# cap: every deadline-carrying read parks a worker on its PRIMARY
+# fetch for up to min(budget, socket timeout), so a fixed-size pool
+# would let one wedged replica under modest concurrency absorb every
+# worker and starve healthy reads' fetches in the queue.  A submit
+# that finds no idle worker starts a fresh one instead (the cached-
+# pool shape); parked-primary concurrency is thereby bounded by the
+# CALLERS' concurrency, not by a pool constant, while the token
+# budget keeps issued hedges — the only extra cluster load — at
+# ~HEDGE_RATIO of reads regardless of pool size.  Idle workers park
+# on the queue forever (daemon threads on persistent pooled sockets:
+# retaining them is the point).  Per-thread pooled sockets persist,
+# and interpreter exit never joins a parked recv
+# (concurrent.futures' non-daemon workers would).
+
+_work: "queue.SimpleQueue" = queue.SimpleQueue()
+_workers_lock = threading.Lock()
+_workers_started = 0
+_tasks_outstanding = 0      # submitted, not yet finished
+
+
+def _worker_cap() -> int:
+    try:
+        return max(2, int(os.environ.get(
+            "SEAWEEDFS_TPU_HEDGE_WORKERS", "") or 64))
+    except ValueError:
+        return 64
+
+
+def _worker_loop() -> None:
+    global _tasks_outstanding
+    while True:
+        fn = _work.get()
+        try:
+            fn()
+        except BaseException:   # noqa: SWFS004 — belt-and-braces: a
+            # task's verdict (result OR exception) travels through the
+            # caller's queue inside the task itself; a raise here
+            # could only be a bug in that plumbing, and it must never
+            # kill a shared worker
+            pass
+        finally:
+            with _workers_lock:
+                _tasks_outstanding -= 1
+
+
+def _submit(fn) -> None:
+    global _workers_started, _tasks_outstanding
+    with _workers_lock:
+        # invariant (below the cap): workers >= outstanding tasks, so
+        # a new task NEVER waits behind a parked primary for a worker.
+        # An idle-count heuristic instead would race: a just-spawned
+        # worker looks idle while it is about to consume an older
+        # queued task, and the submit that trusted it then queues.
+        _tasks_outstanding += 1
+        if _workers_started < min(_tasks_outstanding, _worker_cap()):
+            threading.Thread(target=_worker_loop, daemon=True,
+                             name=f"hedge-{_workers_started}"
+                             ).start()
+            _workers_started += 1
+    _work.put(fn)
+
+
+def hedged_fetch(primary, secondary, threshold_s: float, is_success,
+                 kind: str = "read"):
+    """First-wins race between two fetch callables.
+
+    `primary` runs immediately (on a hedge worker, so this caller can
+    keep watching the clock); if no verdict lands within
+    `threshold_s` and a hedge token is available, `secondary` is
+    issued too.  The first result passing `is_success` wins; the
+    loser is discarded when it eventually lands.  Returns
+    (result | None, hedged: bool) — None means no success (callers
+    fall back to their sequential path).  The captured deadline is
+    re-bound on the workers so their socket timeouts stay
+    budget-derived."""
+    results: "queue.SimpleQueue" = queue.SimpleQueue()
+    d = _deadline.get()
+
+    def run(tag: int, fn):
+        def task():
+            t0 = time.monotonic()
+            try:
+                with _deadline.use(d):
+                    val = fn()
+            except BaseException as e:  # noqa: BLE001 — raced verdict
+                results.put((tag, e, None, time.monotonic() - t0))
+            else:
+                results.put((tag, None, val, time.monotonic() - t0))
+        _submit(task)
+
+    run(0, primary)
+    outstanding = 1
+    hedged = False
+    # overall wall guard: the deadline when armed, else a generous cap
+    # (each fetch carries its own socket timeout regardless)
+    rem = d.remaining() if d is not None else 600.0
+    end = time.monotonic() + rem
+    while outstanding:
+        if not hedged:
+            wait = min(threshold_s, end - time.monotonic())
+        else:
+            wait = end - time.monotonic()
+        try:
+            tag, err, val, took = results.get(
+                timeout=max(wait, 0.001))
+        except queue.Empty:
+            if not hedged and time.monotonic() < end and take_token():
+                hedged = True
+                _metrics().counter_add(
+                    "hedges_issued_total", 1.0,
+                    help_text="secondary replica fetches issued past "
+                              "the latency threshold", kind=kind)
+                run(1, secondary)
+                outstanding += 1
+                continue
+            if time.monotonic() >= end:
+                break       # budget spent waiting; caller fails fast
+            continue        # no token: keep waiting on the primary
+        outstanding -= 1
+        if tag == 0 and err is None and is_success(val):
+            note_primary(took)
+        if err is None and is_success(val):
+            if tag == 1:
+                _metrics().counter_add(
+                    "hedges_won_total", 1.0,
+                    help_text="hedged fetches that answered first",
+                    kind=kind)
+            return val, hedged
+    return None, hedged
+
+
+def _metrics():
+    from .. import stats
+    return stats.PROCESS

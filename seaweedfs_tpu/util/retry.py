@@ -392,7 +392,13 @@ def retry_call(fn, site: str = "", peer: str = "",
     fails fast and is never retried here — the peer told us to go
     away), records success/failure to the health map, and re-issues
     only idempotent work, spending one retry-budget token per
-    re-issue.  `fn` must be safe to call `attempts` times."""
+    re-issue.  Deadline-aware (util/deadline): an attempt whose
+    backoff sleep plus the minimum useful timeout would outlive the
+    request's remaining budget is refused — the caller gets the
+    transport error NOW instead of a doomed retry that finishes after
+    the client gave up.  `fn` must be safe to call `attempts`
+    times."""
+    from . import deadline as _deadline
     attempts = max_attempts() if attempts is None else max(1, attempts)
     last: "BaseException | None" = None
     for attempt in range(1, attempts + 1):
@@ -401,6 +407,12 @@ def retry_call(fn, site: str = "", peer: str = "",
             result = fn()
         except BreakerOpen:
             raise
+        except _deadline.DeadlineExceeded:
+            # the budget is spent: deterministic (budgets only
+            # shrink), no verdict on the peer — return a held probe
+            # slot and surface immediately
+            probe_release(peer)
+            raise
         except retry_on as e:
             if _deterministic(e):
                 # a failed TLS handshake is a configuration verdict:
@@ -408,12 +420,38 @@ def retry_call(fn, site: str = "", peer: str = "",
                 # probe slot is returned so the breaker can't wedge
                 probe_release(peer)
                 raise
+            rem0 = _deadline.remaining()
+            if rem0 is not None and rem0 <= 0.0:
+                # the attempt lost to the BUDGET, not the peer: its
+                # socket timeout was budget-capped, so a healthy-but-
+                # slower peer times out exactly when the budget dies.
+                # Recording that as a peer failure would let sustained
+                # tight-budget traffic trip a healthy peer's breaker —
+                # surface the budget verdict instead, charging nothing
+                probe_release(peer)
+                _deadline.note_exceeded(site or "retry")
+                raise _deadline.DeadlineExceeded(
+                    site or "retry") from e
             record_failure(peer, repr(e))
             last = e
-            if not idempotent or attempt >= attempts or \
-                    not budget_take():
+            if not idempotent or attempt >= attempts:
                 raise
             delay = backoff_delay(attempt, base, cap)
+            rem = _deadline.remaining()
+            if rem is not None and \
+                    delay + _deadline.MIN_TIMEOUT > rem:
+                # a doomed attempt: by the time the backoff elapses
+                # there is no budget left for even a minimal dial —
+                # spend nothing (no retry token) and fail now, AS the
+                # budget verdict (the fronts translate
+                # DeadlineExceeded to 504 + Retry-After; re-raising
+                # the transport error would read as a generic 500
+                # while the metric claims a deadline exceed)
+                _deadline.note_exceeded(site or "retry")
+                raise _deadline.DeadlineExceeded(
+                    site or "retry") from e
+            if not budget_take():
+                raise
             _note_retry(site, peer, attempt, repr(e), delay)
             time.sleep(delay)
             continue

@@ -77,9 +77,12 @@ class MasterFollower:
         while not self._stop.is_set():
             try:
                 if cursor < 0:
+                    # background follower thread: no request deadline
+                    # is ever armed here, and the snapshot bound is a
+                    # deliberate fixed choice
                     r = master_json(self.master, "GET",
                                     "/cluster/watch?snapshot=1",
-                                    timeout=10)
+                                    timeout=10)  # noqa: SWFS016
                     if "error" in r:  # http_json returns error bodies
                         raise OSError(r["error"])  # as dicts, unraised
                     self._apply_snapshot(r.get("snapshot") or {})

@@ -1,0 +1,553 @@
+"""Deadline plane tests (ISSUE 14): budget parsing/derivation, header
+propagation across both server fronts and real hops, grpc-timeout in
+both directions, deadline-aware retry refusal, brownout shedding, and
+the hedged-fetch machinery.
+
+Chaos-level proof (armed delay on one replica -> hedged p99 holds,
+expired deadline -> 504 with zero volume dispatch) lives in
+tests/test_chaos_cluster.py; this file owns the mechanism tests."""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu import qos, stats
+from seaweedfs_tpu.server.httpd import HttpServer, http_bytes, http_json
+from seaweedfs_tpu.util import deadline, hedge
+from seaweedfs_tpu.util import retry as uretry
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    uretry.reset()
+    hedge.reset()
+    yield
+    uretry.reset()
+    hedge.reset()
+    qos.reset()
+
+
+# -- unit: budget math ----------------------------------------------------
+
+def test_deadline_basic_math():
+    with deadline.scope(0.5) as d:
+        assert 0.4 < d.remaining() <= 0.5
+        assert not d.expired()
+        assert 0 < int(d.header_value()) <= 500
+    assert deadline.get() is None
+
+
+def test_parse_header_contract():
+    assert deadline.parse_header(None) is None
+    assert deadline.parse_header("") is None
+    assert deadline.parse_header("garbage") is None  # malformed: ride
+    d = deadline.parse_header("250")
+    assert 0.2 < d.remaining() <= 0.25
+    assert deadline.parse_header("-5").expired()  # clamped to spent
+
+
+def test_io_timeout_derivation():
+    # unarmed: the default passes through untouched
+    assert deadline.io_timeout(60.0) == 60.0
+    with deadline.scope(0.2):
+        t = deadline.io_timeout(60.0, site="t")
+        assert t <= 0.2
+        # the floor: a sliver of budget still gets a usable timeout
+    with deadline.scope(0.001):
+        assert deadline.io_timeout(60.0, site="t") == \
+            deadline.MIN_TIMEOUT
+    with deadline.scope(0.0):
+        with pytest.raises(deadline.DeadlineExceeded):
+            deadline.io_timeout(60.0, site="t")
+
+
+def test_stamp_headers_forwards_remaining():
+    assert deadline.stamp_headers({}) == {}     # unarmed: untouched
+    with deadline.scope(0.3):
+        h = deadline.stamp_headers({})
+        assert 0 < int(h[deadline.HEADER]) <= 300
+        # explicit caller header wins
+        h2 = deadline.stamp_headers({deadline.HEADER: "7"})
+        assert h2[deadline.HEADER] == "7"
+
+
+def test_use_rebinds_on_other_threads():
+    seen = []
+    with deadline.scope(0.4) as d:
+        def worker():
+            # a fresh thread has no deadline...
+            seen.append(deadline.remaining())
+            # ...until the captured one is re-bound (the filer's
+            # upload-pool pattern)
+            with deadline.use(d):
+                seen.append(deadline.remaining())
+            seen.append(deadline.remaining())
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen[0] is None and seen[2] is None
+    assert seen[1] is not None and seen[1] <= 0.4
+
+
+# -- retry: doomed attempts refused ---------------------------------------
+
+def test_retry_refuses_doomed_backoff():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise OSError("boom")
+
+    # remaining budget (~30ms) < backoff + MIN_TIMEOUT for ANY jitter
+    # draw -> exactly one attempt, surfaced AS the budget verdict
+    # (-> the fronts' 504) with the transport error chained as cause
+    with deadline.scope(0.03):
+        with pytest.raises(deadline.DeadlineExceeded) as ei:
+            uretry.retry_call(fn, site="t.doomed", attempts=5)
+    assert isinstance(ei.value.__cause__, OSError)
+    assert len(calls) == 1
+    txt = stats.PROCESS.render()
+    assert 'deadline_exceeded_total{site="t.doomed"}' in txt
+
+
+def test_retry_unarmed_keeps_attempts():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise OSError("boom")
+
+    with pytest.raises(OSError):
+        uretry.retry_call(fn, site="t", attempts=3,
+                          base=0.0001, cap=0.0001)
+    assert len(calls) == 3
+
+
+def test_retry_never_reissues_deadline_exceeded():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise deadline.DeadlineExceeded("t")
+
+    with pytest.raises(deadline.DeadlineExceeded):
+        uretry.retry_call(fn, site="t", attempts=5,
+                          base=0.0001, cap=0.0001)
+    assert len(calls) == 1
+
+
+# -- the threaded front ---------------------------------------------------
+
+@pytest.fixture(scope="module")
+def echo_server():
+    """Two chained HttpServers: B echoes its adopted budget, A sleeps
+    then proxies to B — a real two-hop decrement."""
+    b = HttpServer()
+    hits = {"b": 0, "expired_route": 0}
+
+    def echo(req):
+        hits["b"] += 1
+        rem = deadline.remaining()
+        return 200, {"remainingMs": -1 if rem is None
+                     else int(rem * 1e3)}
+
+    b.route("GET", "/echo", echo)
+    b.start()
+
+    a = HttpServer()
+
+    def hop(req):
+        time.sleep(0.05)
+        return 200, http_json("GET", f"{b.url}/echo", timeout=5)
+
+    def never(req):
+        hits["expired_route"] += 1
+        return 200, {}
+
+    a.route("GET", "/hop", hop)
+    a.route("GET", "/never", never)
+    a.start()
+    yield a, b, hits
+    a.stop()
+    b.stop()
+
+
+def test_ingress_adopts_and_hops_decrement(echo_server):
+    a, b, hits = echo_server
+    with deadline.scope(1.0):
+        r = http_json("GET", f"{a.url}/hop", timeout=5)
+    # B saw a budget that lost A's 50ms sleep (plus hop overhead) but
+    # is still alive — the header decremented across the chain
+    assert 0 < r["remainingMs"] < 960, r
+    # and without a deadline, nothing is armed anywhere
+    r = http_json("GET", f"{b.url}/echo", timeout=5)
+    assert r["remainingMs"] == -1
+
+
+def test_expired_budget_504s_before_dispatch(echo_server):
+    a, _b, hits = echo_server
+    before = hits["expired_route"]
+    status, body, headers = http_bytes(
+        "GET", f"{a.url}/never", None,
+        {deadline.HEADER: "0"}, timeout=5)
+    assert status == 504
+    assert headers.get("Retry-After") == "1"
+    assert b"deadline exceeded" in body
+    assert hits["expired_route"] == before   # handler never ran
+    txt = stats.PROCESS.render()
+    assert "deadline_exceeded_total" in txt
+    assert 'site="server.ingress"' in txt
+
+
+def test_remaining_budget_histogram_observed(echo_server):
+    _a, b, _hits = echo_server
+    with deadline.scope(0.8):
+        http_json("GET", f"{b.url}/echo", timeout=5)
+    txt = stats.PROCESS.render()
+    assert "deadline_remaining_seconds_bucket" in txt
+
+
+def test_client_refuses_spent_budget_before_dial(echo_server):
+    _a, b, hits = echo_server
+    before = hits["b"]
+    with deadline.scope(0.0):
+        with pytest.raises(deadline.DeadlineExceeded):
+            http_bytes("GET", f"{b.url}/echo", timeout=5)
+    assert hits["b"] == before   # nothing hit the wire
+
+
+# -- the asyncio front ----------------------------------------------------
+
+@pytest.fixture()
+def async_server(monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_ASYNC_FRONT", "filer")
+    h = HttpServer()
+    h.role = "filer"
+    hits = {"n": 0}
+
+    def echo(req):
+        hits["n"] += 1
+        rem = deadline.remaining()
+        return 200, {"remainingMs": -1 if rem is None
+                     else int(rem * 1e3)}
+
+    h.route("GET", "/echo", echo)
+    h.start()
+    assert h._async is not None     # the front actually selected
+    yield h, hits
+    h.stop()
+
+
+def test_async_front_adopts_and_504s(async_server):
+    h, hits = async_server
+    with deadline.scope(0.7):
+        r = http_json("GET", f"{h.url}/echo", timeout=5)
+    assert 0 < r["remainingMs"] <= 700
+    before = hits["n"]
+    status, body, headers = http_bytes(
+        "GET", f"{h.url}/echo", None, {deadline.HEADER: "0"},
+        timeout=5)
+    assert status == 504 and headers.get("Retry-After") == "1"
+    assert hits["n"] == before
+
+
+# -- gRPC: both directions ------------------------------------------------
+
+grpc = pytest.importorskip("grpc")
+
+
+@pytest.fixture(scope="module")
+def grpc_echo():
+    from seaweedfs_tpu.pb import master_pb2
+    from seaweedfs_tpu.pb import rpc as rpcmod
+
+    class Svc:
+        def Statistics(self, request, context):
+            rem = deadline.remaining()
+            # used_size carries the adopted budget in ms (0 = none)
+            return master_pb2.StatisticsResponse(
+                used_size=0 if rem is None else max(1, int(rem * 1e3)))
+
+        def Ping(self, request, context):
+            time.sleep(0.4)
+            return master_pb2.PingResponse()
+
+    methods = {
+        "Statistics": ("uu", master_pb2.StatisticsRequest,
+                       master_pb2.StatisticsResponse),
+        "Ping": ("uu", master_pb2.PingRequest, master_pb2.PingResponse),
+    }
+    handler = rpcmod.make_service_handler(
+        "test.DeadlineEcho", methods, Svc(), role="test")
+    server, port = rpcmod.serve([handler])
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    stub = rpcmod.Stub(ch, "test.DeadlineEcho", methods)
+    yield stub
+    ch.close()
+    server.stop(grace=0)
+
+
+def test_grpc_server_adopts_grpc_timeout(grpc_echo):
+    from seaweedfs_tpu.pb import master_pb2
+    # unarmed: the server sees no deadline
+    r = grpc_echo.Statistics(master_pb2.StatisticsRequest())
+    assert r.used_size == 0
+    # armed: the contextvar budget rides grpc-timeout onto the wire
+    # and context.time_remaining() back into the servicer
+    with deadline.scope(0.5):
+        r = grpc_echo.Statistics(master_pb2.StatisticsRequest())
+    assert 0 < r.used_size <= 500
+
+
+def test_grpc_client_enforces_budget(grpc_echo):
+    from seaweedfs_tpu.pb import master_pb2
+    # the server's 400ms sleep must not outlive a 150ms budget
+    with deadline.scope(0.15):
+        with pytest.raises(grpc.RpcError) as ei:
+            grpc_echo.Ping(master_pb2.PingRequest())
+    assert ei.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+
+
+def test_grpc_client_refuses_spent_budget(grpc_echo):
+    from seaweedfs_tpu.pb import master_pb2
+    from seaweedfs_tpu.pb.rpc import StubDeadlineExceeded
+    with deadline.scope(0.0):
+        with pytest.raises(StubDeadlineExceeded):
+            grpc_echo.Statistics(master_pb2.StatisticsRequest())
+
+
+# -- brownout shedding ----------------------------------------------------
+
+class _Req:
+    def __init__(self, path="/f", headers=None):
+        self.path = path
+        self.headers = headers or {}
+        self.query = {}
+
+
+class _Http:
+    admission = None
+
+
+def test_brownout_sheds_unmeetable_budget():
+    qos.reset()
+    h = _Http()
+    qos.install(h, "filer")
+    # warm the service-latency estimator: ~500ms per request
+    for _ in range(30):
+        qos.note_latency(0.5)
+    assert qos.brownout_estimate() > 0.3
+    # a request with 100ms of budget cannot meet 500ms of service
+    with deadline.scope(0.1):
+        deny, release = h.admission(_Req())
+    assert deny is not None and deny[0] == 503
+    body, headers = deny[1]
+    assert b"brownout" in body
+    assert "Retry-After" in headers
+    txt = stats.PROCESS.render()
+    assert 'reason="brownout"' in txt
+    # no deadline: admitted exactly as before
+    deny, release = h.admission(_Req())
+    assert deny is None and release is not None
+    release()
+    # an already-EXPIRED budget is the 504 path's, not brownout's
+    with deadline.scope(0.0):
+        deny, _ = h.admission(_Req())
+    assert deny is None
+    # ample budget: admitted
+    with deadline.scope(5.0):
+        deny, release = h.admission(_Req())
+    assert deny is None
+    release()
+
+
+def test_brownout_estimator_fed_by_release():
+    qos.reset()
+    h = _Http()
+    qos.install(h, "filer")
+    for _ in range(25):
+        deny, release = h.admission(_Req())
+        assert deny is None
+        time.sleep(0.002)
+        release()
+    est = qos.brownout_estimate()
+    assert est > 0.0005, est
+
+
+def test_brownout_kill_switch(monkeypatch):
+    qos.reset()
+    monkeypatch.setenv("SEAWEEDFS_TPU_BROWNOUT", "0")
+    h = _Http()
+    qos.install(h, "filer")
+    for _ in range(30):
+        qos.note_latency(0.5)
+    with deadline.scope(0.05):
+        deny, _ = h.admission(_Req())
+    assert deny is None
+
+
+# -- hedged fetch machinery -----------------------------------------------
+
+def test_latency_tracker_p95():
+    tr = hedge.LatencyTracker()
+    assert tr.quantile() is None     # cold: no verdict
+    for _ in range(19):
+        tr.note(0.01)
+    tr.note(5.0)
+    p95 = tr.quantile(0.95)
+    assert p95 is not None and 0.005 < p95 <= 5.0
+
+
+def test_hedged_fetch_primary_fast_no_hedge():
+    val, hedged = hedge.hedged_fetch(
+        lambda: "quick", lambda: "never", 0.5, lambda r: True)
+    assert val == "quick" and not hedged
+
+
+def test_hedged_fetch_first_wins_and_counts():
+    before = _counter("seaweedfs_tpu_hedges_won_total")
+
+    def slow():
+        time.sleep(0.4)
+        return "slow"
+
+    val, hedged = hedge.hedged_fetch(
+        slow, lambda: "fast", 0.02, lambda r: True)
+    assert val == "fast" and hedged
+    assert _counter("seaweedfs_tpu_hedges_won_total") == before + 1
+
+
+def test_hedged_fetch_slow_primary_still_wins_over_bad_hedge():
+    def slowish():
+        time.sleep(0.1)
+        return "primary"
+
+    def bad():
+        raise OSError("replica down")
+
+    val, hedged = hedge.hedged_fetch(
+        slowish, bad, 0.02, lambda r: True)
+    assert val == "primary" and hedged
+
+
+def test_hedged_fetch_both_fail_returns_none():
+    def bad():
+        raise OSError("down")
+
+    val, _hedged = hedge.hedged_fetch(
+        bad, bad, 0.01, lambda r: True)
+    assert val is None
+
+
+def test_hedge_token_budget_bounds_issues(monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_HEDGE_BURST", "1")
+    monkeypatch.setenv("SEAWEEDFS_TPU_HEDGE_RATIO", "0")
+    hedge.reset()
+    before = _counter("seaweedfs_tpu_hedges_issued_total")
+
+    def slow():
+        time.sleep(0.06)
+        return "slow"
+
+    # first call spends the only token
+    val, hedged = hedge.hedged_fetch(
+        slow, lambda: "fast", 0.01, lambda r: True)
+    assert hedged and val == "fast"
+    # second call finds the bucket empty: no hedge, primary's answer
+    val, hedged = hedge.hedged_fetch(
+        slow, lambda: "fast", 0.01, lambda r: True)
+    assert not hedged and val == "slow"
+    assert _counter("seaweedfs_tpu_hedges_issued_total") == before + 1
+
+
+def test_hedged_fetch_rebinds_deadline_on_workers():
+    seen = []
+
+    def probe():
+        seen.append(deadline.remaining())
+        return "ok"
+
+    with deadline.scope(0.5):
+        val, _ = hedge.hedged_fetch(
+            probe, probe, 0.5, lambda r: True)
+    assert val == "ok"
+    assert seen and seen[0] is not None and seen[0] <= 0.5
+
+
+def _counter(name: str) -> float:
+    total = 0.0
+    for line in stats.PROCESS.render().splitlines():
+        if line.startswith(name):
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return total
+
+
+# -- shell ingress --------------------------------------------------------
+
+def test_shell_commands_run_under_default_budget(monkeypatch):
+    from seaweedfs_tpu.shell import commands as shcmd
+    seen = {}
+
+    def probe(env, args):
+        seen["rem"] = deadline.remaining()
+        return "ok"
+
+    shcmd.COMMANDS["_deadline_probe"] = probe
+    try:
+        assert shcmd.run_command(None, "_deadline_probe") == "ok"
+        assert seen["rem"] is None        # no default: nothing armed
+        monkeypatch.setenv("SEAWEEDFS_TPU_DEADLINE_DEFAULT_MS", "800")
+        shcmd.run_command(None, "_deadline_probe")
+        assert seen["rem"] is not None and seen["rem"] <= 0.8
+    finally:
+        shcmd.COMMANDS.pop("_deadline_probe", None)
+
+
+# -- review-hardening regressions -----------------------------------------
+
+def test_delete_surfaces_deadline_exceeded(monkeypatch):
+    """delete()'s per-location OSError failover must not swallow the
+    budget verdict: an expired deadline surfaces as DeadlineExceeded
+    (-> the fronts' 504), never the generic 'delete failed'
+    RuntimeError."""
+    from seaweedfs_tpu import operation
+
+    monkeypatch.setattr(
+        operation, "lookup",
+        lambda master, vid, use_cache=True: [
+            {"url": "127.0.0.1:1"}, {"url": "127.0.0.1:2"}])
+    with deadline.scope(0.0):
+        with pytest.raises(deadline.DeadlineExceeded):
+            operation.delete("m", "3,0123deadbeef")
+
+
+def test_hedge_pool_grows_past_parked_primaries():
+    """A wedged replica parks primary fetches on hedge workers for up
+    to the budget; the pool must grow on demand so concurrently
+    arriving fetches never queue behind the parked ones and burn
+    their budget waiting for a worker."""
+    park = threading.Event()
+    parked = []
+
+    def parked_fn():
+        parked.append(1)
+        park.wait(5.0)
+
+    try:
+        # park more tasks than could ever share one idle worker
+        for _ in range(6):
+            hedge._submit(parked_fn)
+        t0 = time.monotonic()
+        done = threading.Event()
+        hedge._submit(done.set)
+        assert done.wait(1.0), \
+            "submit queued behind parked workers instead of growing"
+        assert time.monotonic() - t0 < 1.0
+        # the workers >= outstanding invariant: 6 parked + done = 7
+        assert hedge._workers_started >= 7
+    finally:
+        park.set()

@@ -1034,3 +1034,79 @@ def test_swfs015_noqa_suppresses():
 def test_swfs015_repo_is_clean(package_findings):
     assert [f for f in package_findings
             if f.rule == "SWFS015"] == []
+
+
+# -- SWFS016: bare numeric timeout on a hot-path network call -------------
+
+def test_swfs016_flags_bare_keyword_literal():
+    src = """
+    def read(url, fid):
+        status, body, _ = http_bytes("GET", f"{url}/{fid}", None, None,
+                                     timeout=60)
+        return body
+    """
+    found = check_at(src, "SWFS016", "seaweedfs_tpu/operation.py")
+    assert len(found) == 1
+    assert "io_timeout" in found[0].message
+
+
+def test_swfs016_flags_bare_positional_literal():
+    src = """
+    def probe(url):
+        return http_bytes("GET", f"{url}/status", None, None, 5)
+    """
+    assert len(check_at(src, "SWFS016",
+                        "seaweedfs_tpu/operation.py")) == 1
+
+
+def test_swfs016_deadline_derived_timeout_passes():
+    src = """
+    from .util import deadline as _deadline
+
+    def read(url, fid):
+        return http_bytes(
+            "GET", f"{url}/{fid}", None, None,
+            timeout=_deadline.io_timeout(60.0, site="volume.read"))
+
+    def relay(url):
+        t = _deadline.io_timeout(10.0, site="x")
+        return http_relay(url, "POST", url, None, t)
+    """
+    assert check_at(src, "SWFS016",
+                    "seaweedfs_tpu/operation.py") == []
+
+
+def test_swfs016_scoped_to_hot_path_modules():
+    src = """
+    def poke(url):
+        return http_json("GET", f"{url}/x", timeout=30)
+    """
+    # a shell command / test helper is not the request path
+    assert check_at(src, "SWFS016",
+                    "seaweedfs_tpu/shell/commands.py") == []
+    assert len(check_at(src, "SWFS016",
+                        "seaweedfs_tpu/filer/filer.py")) == 1
+
+
+def test_swfs016_plane_client_covered():
+    src = """
+    def plane_read(addr, fid):
+        return _plane_request(addr, "GET", f"/{fid}", b"", 10.0)
+    """
+    assert len(check_at(src, "SWFS016",
+                        "seaweedfs_tpu/operation.py")) == 1
+
+
+def test_swfs016_noqa_suppresses():
+    src = """
+    def snapshot(master):
+        return master_json(master, "GET", "/watch",
+                           timeout=10)  # noqa: SWFS016
+    """
+    assert check_at(src, "SWFS016",
+                    "seaweedfs_tpu/wdclient.py") == []
+
+
+def test_swfs016_repo_is_clean(package_findings):
+    assert [f for f in package_findings
+            if f.rule == "SWFS016"] == []
