@@ -1086,7 +1086,7 @@ def _measure_read_path(duration_s: float = 8.0, files: int = 48,
                     text, "seaweedfs_tpu_read_cache_misses_total"))
 
     def one_arm(label: str, env: "dict[str, str]",
-                warm: bool) -> dict:
+                warm: bool, attr_toggle_windows: int = 0) -> dict:
         saved = {k: os.environ.get(k)
                  for k in set(_KNOBS) | set(env)}
         for k in _KNOBS:
@@ -1113,6 +1113,24 @@ def _measure_read_path(duration_s: float = 8.0, files: int = 48,
                     assert st == 200 and \
                         hashlib.sha256(body).digest() == digest
             h0, m0 = _cache_counters()
+            # per-request cpu/wall from the front's request(_cpu)
+            # histograms (ISSUE 15): delta over the traffic window
+            from seaweedfs_tpu import profiling as _prof
+
+            def _req_hists() -> "tuple[dict | None, dict | None]":
+                try:
+                    _st, body, _ = http_bytes(
+                        "GET", f"{sc.filer_url}/metrics", timeout=10)
+                except OSError:
+                    return None, None
+                parsed = _prof.parse_prom_text(
+                    body.decode("utf-8", "replace"))
+                return (_prof.prom_histogram(
+                            parsed, "filer_request_seconds"),
+                        _prof.prom_histogram(
+                            parsed, "filer_request_cpu_seconds"))
+
+            w0, c0 = _req_hists()
             per_tenant = [OpStats() for _ in range(tenants)]
             stop = threading.Event()
 
@@ -1142,15 +1160,43 @@ def _measure_read_path(duration_s: float = 8.0, files: int = 48,
                        for t in range(tenants)]
             for th in threads:
                 th.start()
-            time.sleep(duration_s)
+            toggle_windows: "list[dict]" = []
+            if attr_toggle_windows:
+                # ISSUE 15 within-cluster A/B: alternate disarmed /
+                # armed traffic windows on THIS warmed cluster (the
+                # in-process rig toggles profiling directly — same
+                # lever POST /debug/attribution pulls on a real
+                # node); separate clusters cannot resolve a ~1% cost
+                # under arm-to-arm boot noise
+                from seaweedfs_tpu import profiling as _p
+                win_s = max(1.5, duration_s / attr_toggle_windows)
+                time.sleep(win_s / 2)        # settle, uncounted
+                for w in range(attr_toggle_windows):
+                    # scope=plane: only the ISSUE 15 additions (cpu
+                    # clocks + recorder) toggle; the PR 7 wall-stage
+                    # decomposition stays armed on BOTH sides — it
+                    # predates the plane and every shipped number
+                    # already paid for it
+                    _p.set_attribution_disarmed(w % 2 == 0,
+                                                scope="plane")
+                    n0 = sum(len(s.lat_ok) for s in per_tenant)
+                    time.sleep(win_s)
+                    n1 = sum(len(s.lat_ok) for s in per_tenant)
+                    toggle_windows.append(
+                        {"disarmed": w % 2 == 0,
+                         "okPerSec": round((n1 - n0) / win_s, 1)})
+                _p.set_attribution_disarmed(False)
+            else:
+                time.sleep(duration_s)
             stop.set()
             for th in threads:
                 th.join(timeout=30)
             h1, m1 = _cache_counters()
             hits, misses = h1 - h0, m1 - m0
+            w1, c1 = _req_hists()
             lat = sorted(x for s in per_tenant for x in s.lat_ok)
             total_ok = len(lat)
-            return {
+            rec = {
                 "okPerSec": round(total_ok / duration_s, 1),
                 "p50Ms": round(percentile(lat, 0.5) * 1e3, 2),
                 "p99Ms": round(percentile(lat, 0.99) * 1e3, 2),
@@ -1159,6 +1205,29 @@ def _measure_read_path(duration_s: float = 8.0, files: int = 48,
                 if hits + misses > 0 else 0.0,
                 "perTenant": [s.summary() for s in per_tenant],
             }
+            wd = _prof.histogram_delta(w1, w0)
+            cd = _prof.histogram_delta(c1, c0)
+            if wd and wd.get("count") and cd and cd.get("count"):
+                cpu_ms = cd["sum"] / cd["count"] * 1e3
+                wall_ms = wd["sum"] / wd["count"] * 1e3
+                rec["cpuMsPerRequest"] = round(cpu_ms, 4)
+                rec["waitMsPerRequest"] = round(
+                    max(wall_ms - cpu_ms, 0.0), 4)
+            if toggle_windows:
+                on = [w["okPerSec"] for w in toggle_windows
+                      if not w["disarmed"]]
+                off = [w["okPerSec"] for w in toggle_windows
+                       if w["disarmed"]]
+                on_r = sum(on) / max(len(on), 1)
+                off_r = sum(off) / max(len(off), 1)
+                rec["attrToggle"] = {
+                    "windows": toggle_windows,
+                    "armedOkPerSec": round(on_r, 1),
+                    "disarmedOkPerSec": round(off_r, 1),
+                    "overheadFrac": round(
+                        1.0 - on_r / max(off_r, 1e-9), 4),
+                }
+            return rec
         finally:
             sc.stop()
             qos.reset()
@@ -1257,6 +1326,17 @@ def _measure_read_path(duration_s: float = 8.0, files: int = 48,
                             "SEAWEEDFS_TPU_FILER_META_CACHE": "0"},
                    warm=False)
     warm = one_arm("warm", {}, warm=True)
+    # ISSUE 15: the warm arm's attribution-off twin — same caches,
+    # stage timers/flight recorder/scheduler probe disarmed — as the
+    # cross-cluster context figure, plus the ACCEPTANCE figure from a
+    # within-cluster A/B: one warmed cluster alternating disarmed /
+    # armed traffic windows (separate clusters cannot resolve a ~1%
+    # cost under arm-to-arm boot noise)
+    warm_attr_off = one_arm("warm_attr_off",
+                            dict(_ATTRIBUTION_OFF_ENV), warm=True)
+    warm_toggle = one_arm("warm_toggle", {}, warm=True,
+                          attr_toggle_windows=6)
+    toggle = warm_toggle.get("attrToggle", {})
     # ISSUE 12: the warm arm re-run with the filer gateway on the
     # asyncio front — same caches, different concurrency substrate
     warm_async = one_arm(
@@ -1273,6 +1353,20 @@ def _measure_read_path(duration_s: float = 8.0, files: int = 48,
         "tenants": tenants,
         "cold": cold,
         "warm": warm,
+        "warm_attr_off": warm_attr_off,
+        "attribution_overhead": {
+            "cross_cluster_pair": {
+                "on_ok_per_sec": warm["okPerSec"],
+                "off_ok_per_sec": warm_attr_off["okPerSec"],
+            },
+            "toggle_windows": toggle.get("windows", []),
+            "armed_ok_per_sec": toggle.get("armedOkPerSec", 0.0),
+            "disarmed_ok_per_sec":
+                toggle.get("disarmedOkPerSec", 0.0),
+            "overhead_frac": toggle.get("overheadFrac", 0.0),
+        },
+        "accept_attribution_2pct":
+            toggle.get("overheadFrac", 0.0) <= 0.02,
         "warm_async": warm_async,
         "asyncFrontSpeedup": round(
             warm_async["okPerSec"] / max(warm["okPerSec"], 1e-9), 2),
@@ -1300,6 +1394,7 @@ def _stage_decomposition(parsed: dict, ns: str) -> "dict | None":
                           parsed.get(f"{name}_count", [])} - {""})
     if not stage_names:
         return None
+    cpu_name = f"{ns}_write_stage_cpu_seconds"
     out: dict = {"stages": {}}
     total_sum = 0.0
     staged_sum = 0.0
@@ -1307,10 +1402,21 @@ def _stage_decomposition(parsed: dict, ns: str) -> "dict | None":
         h = profiling.prom_histogram(parsed, name, {"stage": stage})
         if not h or h["count"] <= 0:
             continue
+        c = profiling.prom_histogram(parsed, cpu_name,
+                                     {"stage": stage})
+        cpu_mean_ms = round(c["sum"] / c["count"] * 1e3, 4) \
+            if c and c["count"] else None
         if stage == "total":
             total_sum = h["sum"]
             out["requests"] = h["count"]
             out["meanTotalMs"] = round(h["sum"] / h["count"] * 1e3, 3)
+            if cpu_mean_ms is not None:
+                # the ISSUE 15 headline: per-request CPU from the
+                # stage-cpu histograms; meanTotalMs minus this is the
+                # request's GIL/lock/syscall wait
+                out["cpuMsPerRequest"] = cpu_mean_ms
+                out["waitMsPerRequest"] = round(
+                    max(out["meanTotalMs"] - cpu_mean_ms, 0.0), 3)
             continue
         staged_sum += h["sum"]
         out["stages"][stage] = {
@@ -1318,6 +1424,8 @@ def _stage_decomposition(parsed: dict, ns: str) -> "dict | None":
             "calls": h["count"],
             "meanMs": round(h["sum"] / h["count"] * 1e3, 3),
         }
+        if cpu_mean_ms is not None:
+            out["stages"][stage]["cpuMeanMs"] = cpu_mean_ms
     if total_sum > 0:
         out["totalSeconds"] = round(total_sum, 4)
         for stage, rec in out["stages"].items():
@@ -1331,7 +1439,8 @@ def _measure_write_path(nodes: int = 2, writers: int = 4,
                         payload: int = 4096,
                         env_extra: "dict | None" = None,
                         filers: int = 1,
-                        lean_client: bool = False) -> dict:
+                        lean_client: bool = False,
+                        attr_toggle_windows: int = 0) -> dict:
     """ROADMAP item 1's tracker: concurrent small writes through the
     filer funnel of a loopback proc-cluster, reporting req/s and
     p50/p99 AND the per-stage decomposition from every role's
@@ -1454,7 +1563,89 @@ def _measure_write_path(nodes: int = 2, writers: int = 4,
                     errors[0] += 1
                 i += 1
 
-        if lean_client:
+        if lean_client and attr_toggle_windows:
+            # ISSUE 15 within-cluster attribution A/B: alternate
+            # disarmed/armed traffic windows on THIS cluster via the
+            # runtime POST /debug/attribution lever — separate
+            # clusters cannot resolve a ~1% cost under ±5-20%
+            # arm-to-arm boot noise.  `seconds` is PER WINDOW here.
+            all_urls = [master_url] + \
+                [f"127.0.0.1:{p}" for p in vports] + filer_urls
+
+            def _set_disarmed(v: bool) -> None:
+                for u in all_urls:
+                    try:
+                        # scope=plane: toggle only the ISSUE 15
+                        # additions; the PR 7 wall-stage tracks stay
+                        # armed on both sides of the A/B
+                        http_json("POST", f"{u}/debug/attribution",
+                                  {"disarmed": v, "scope": "plane"},
+                                  timeout=5)
+                    except OSError:
+                        pass
+
+            # ONE continuous lean load across every window — per-
+            # window client respawns made window-to-window rates
+            # ±12% noisy, far above the ~1% signal.  Windows are cut
+            # server-side instead: the filer's own request_seconds
+            # POST count sampled at each boundary.
+            win_s = seconds
+            settle = max(3.0, win_s / 2)
+            total_s = settle + attr_toggle_windows * win_s + 1.0
+            load_rec: dict = {}
+            loader = threading.Thread(
+                target=lambda: load_rec.update(
+                    _lean_load(filer_urls, writers, total_s, payload,
+                               tmp)))
+            loader.start()
+
+            def _post_count() -> float:
+                try:
+                    st, body, _ = http_bytes(
+                        "GET", f"{filer_url}/metrics", timeout=5)
+                except OSError:
+                    return -1.0
+                if st >= 300:
+                    return -1.0
+                parsed = profiling.parse_prom_text(
+                    body.decode("utf-8", "replace"))
+                h = profiling.prom_histogram(
+                    parsed, "filer_request_seconds",
+                    {"method": "POST"})
+                return float(h["count"]) if h else -1.0
+
+            _time.sleep(settle)
+            windows = []
+            for w in range(attr_toggle_windows):
+                _set_disarmed(w % 2 == 0)
+                c0 = _post_count()
+                t0 = _time.perf_counter()
+                _time.sleep(win_s)
+                c1 = _post_count()
+                dt = _time.perf_counter() - t0
+                if c0 >= 0 and c1 > c0 and dt > 0:
+                    windows.append(
+                        {"disarmed": w % 2 == 0,
+                         "req_per_sec": round((c1 - c0) / dt, 1)})
+            _set_disarmed(False)
+            loader.join(timeout=total_s + 120)
+            rec = load_rec
+            on = [x["req_per_sec"] for x in windows
+                  if not x["disarmed"]]
+            off = [x["req_per_sec"] for x in windows
+                   if x["disarmed"]]
+            on_r = sum(on) / max(len(on), 1)
+            off_r = sum(off) / max(len(off), 1)
+            rec["attr_toggle"] = {
+                "windows": windows,
+                "armed_req_per_sec": round(on_r, 1),
+                "disarmed_req_per_sec": round(off_r, 1),
+                "overhead_frac": round(
+                    1.0 - on_r / max(off_r, 1e-9), 4),
+            }
+            rec["write_path_payload_bytes"] = payload
+            partial.phase("traffic", **rec)
+        elif lean_client:
             # multi-PROCESS load generator: one Python process
             # driving N writer threads is itself GIL-bound — at
             # cluster scale its delayed body sends and response reads
@@ -1741,6 +1932,15 @@ def _measure_write_path_ab(seconds: float = 10.0,
 _NATIVE_OFF_ENV = {"SEAWEEDFS_TPU_WRITE_PLANE": "0",
                    "SEAWEEDFS_TPU_ASYNC_FRONT": "0",
                    "SEAWEEDFS_TPU_FILER_WORKERS": "1"}
+
+# ISSUE 15's attribution-off twin: the whole cost-attribution plane
+# disarmed — no stage wall/cpu sampling, no flight-recorder arming or
+# capture, no scheduler probe.  Overlaid on an armed arm's env to
+# measure what always-on attribution actually costs.
+_ATTRIBUTION_OFF_ENV = {"SEAWEEDFS_TPU_STAGE_TIMERS": "0",
+                        "SEAWEEDFS_TPU_FLIGHT_RECORDER": "0",
+                        "SEAWEEDFS_TPU_SCHED_PROBE": "0",
+                        "SEAWEEDFS_TPU_CPU_SAMPLE": "0"}
 # B arm: C++ needle-write plane on (default); the filer front stays
 # threaded here — under write saturation the asyncio loop thread
 # competes for the GIL it shares with the handlers (the async arm is
@@ -1779,6 +1979,11 @@ def _measure_write_path_native_ab(seconds: float = 10.0,
     # the plane is this build's default.
     on_env = dict(_NATIVE_ON_ENV, SEAWEEDFS_TPU_FILER_WORKERS="4")
     on_async_env = dict(on_env, SEAWEEDFS_TPU_ASYNC_FRONT="1")
+    # ISSUE 15: native_on's attribution-off twin — stage wall+cpu
+    # timers, flight recorder and scheduler probe all disarmed; the
+    # rate delta vs native_on IS the armed attribution plane's cost
+    # (acceptance: <= 2%)
+    attr_off_env = dict(on_env, **_ATTRIBUTION_OFF_ENV)
     meta_off_env = dict(_NATIVE_ON_ENV,
                         SEAWEEDFS_TPU_FILER_META_PLANE="0")
     meta_on_env = dict(_NATIVE_ON_ENV,
@@ -1792,6 +1997,7 @@ def _measure_write_path_native_ab(seconds: float = 10.0,
             ("meta_on", meta_on_env, 24, 1, 2, True),
             ("meta_off_w4", meta_off_w4_env, 24, 1, 2, True),
             ("native_on", on_env, 24, 1, 2, True),
+            ("native_on_attr_off", attr_off_env, 24, 1, 2, True),
             ("native_on_async", on_async_env, 24, 1, 2, True),
             ("scaled_native_off", _NATIVE_OFF_ENV, 56, 7, 7, True),
             ("scaled_native_on", _NATIVE_ON_ENV, 56, 7, 7, True)):
@@ -1843,6 +2049,48 @@ def _measure_write_path_native_ab(seconds: float = 10.0,
     out["accept_native_2x"] = out["speedup"] >= 2.0
     out["accept_cpu_halved"] = out["cpu_cut"]["volume"] >= 0.5 or \
         out["cpu_cut"]["filer"] >= 0.5
+    # -- ISSUE 15 cost attribution ------------------------------------
+    # per-role cpu/wait per request from the stage-cpu histograms
+    # (the /proc tree number above includes idle-thread bookkeeping;
+    # this one is the per-REQUEST thread-time bill)
+    stage_cpu: dict = {}
+    for role, d in arms["native_on"].get(
+            "write_path_decomposition", {}).items():
+        if "cpuMsPerRequest" in d:
+            stage_cpu[role] = {
+                "cpuMsPerRequest": d["cpuMsPerRequest"],
+                "waitMsPerRequest": d.get("waitMsPerRequest", 0.0),
+                "meanTotalMs": d.get("meanTotalMs", 0.0),
+            }
+    out["stage_cpu_ms_per_req"] = stage_cpu
+    # attribution-armed overhead (<= 2% acceptance).  The cross-
+    # cluster twin pair above is recorded as context, but separate
+    # clusters cannot resolve a ~1% signal under this box's ±5-20%
+    # arm-to-arm boot noise — the acceptance figure comes from ONE
+    # cluster alternating disarmed/armed traffic windows via the
+    # runtime POST /debug/attribution lever.  Single-worker filer:
+    # the lever is per-process and SO_REUSEPORT siblings cannot be
+    # addressed individually; the per-request cost is per-process
+    # regardless.
+    toggle_arm = _measure_write_path(
+        nodes=2, writers=24, seconds=max(4.0, seconds * 0.5),
+        env_extra=_NATIVE_ON_ENV, filers=1, lean_client=True,
+        attr_toggle_windows=10)
+    tg = toggle_arm.get("attr_toggle", {})
+    out["attribution_overhead"] = {
+        "cross_cluster_pair": {
+            "on_req_per_sec":
+                arms["native_on"]["write_path_req_per_sec"],
+            "off_req_per_sec":
+                arms["native_on_attr_off"]["write_path_req_per_sec"],
+        },
+        "toggle_windows": tg.get("windows", []),
+        "armed_req_per_sec": tg.get("armed_req_per_sec", 0.0),
+        "disarmed_req_per_sec": tg.get("disarmed_req_per_sec", 0.0),
+        "overhead_frac": tg.get("overhead_frac", 1.0),
+    }
+    out["accept_attribution_2pct"] = \
+        out["attribution_overhead"]["overhead_frac"] <= 0.02
     # -- ISSUE 13 meta-plane acceptance ------------------------------
     out["meta_plane"] = {
         "speedup_w1": round(

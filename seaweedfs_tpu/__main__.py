@@ -692,6 +692,11 @@ def main(argv: list[str] | None = None) -> int:
             # the RESOLVED port with SO_REUSEPORT; the kernel spreads
             # connections across the workers' accept queues
             import subprocess as _subprocess
+            # any ONE worker's /metrics scrape must report the whole
+            # fleet's process-tree CPU/RSS (stats._proc_tree_sample):
+            # siblings inherit this env and root their /proc walk at
+            # the pre-fork parent instead of themselves
+            os.environ["SEAWEEDFS_TPU_TREE_ROOT"] = str(os.getpid())
             argv = []
             skip = False
             for a in sys.argv[1:]:

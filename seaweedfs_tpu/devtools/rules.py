@@ -1190,6 +1190,91 @@ class BareTimeoutLiteralRule(Rule):
                 f"reason)")
 
 
+class DynamicMetricNameRule(Rule):
+    """SWFS017: a metric name assembled at the mint site instead of
+    written as a literal.
+
+    Variable data belongs in LABELS, never in the metric NAME: a name
+    interpolating a per-request value (a path, a tenant, a volume id)
+    mints a new time series per distinct value, so the registry, every
+    /metrics scrape, and every cluster.top parse grow without bound —
+    and the family stops being queryable as one metric.  A label with
+    the same value is still visible per-cell but shares ONE name the
+    helpers (`prom_histogram`, `_counter_sum`) can aggregate, and the
+    existing per-label cells are capped by the registry's cell
+    accounting rather than silently minting new families.
+
+    Flagged: the name argument of `counter_add` / `gauge_set` /
+    `histogram_observe` that is an f-string with interpolation, a
+    `+`/`%` string expression, or a `.format()` call — written
+    directly, or via a scope-local name bound to one.  A name chosen
+    from a closed literal set (a conditional of literals, a loop over
+    a literal table) passes.  The documented exception is a name
+    derived from a CODE-SITE constant — StageTrack's
+    `<track>_stage_seconds` family, one name per `track()` call
+    site — which stays under `# noqa: SWFS017` with the reason."""
+
+    id = "SWFS017"
+    severity = "error"
+    title = "metric name built dynamically at the mint site"
+
+    _METERS = {"counter_add", "gauge_set", "histogram_observe"}
+
+    @staticmethod
+    def _dynamic(node: ast.AST) -> bool:
+        if isinstance(node, ast.JoinedStr):
+            return any(isinstance(v, ast.FormattedValue)
+                       for v in node.values)
+        if isinstance(node, ast.BinOp) and \
+                isinstance(node.op, (ast.Add, ast.Mod)):
+            # the name argument is a str by contract, so arithmetic
+            # here IS string assembly ("prefix_" + kind, "%s_total")
+            return True
+        return isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr == "format"
+
+    def check(self, ctx: FileContext):
+        scopes = [ctx.tree] + [
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        seen: set = set()
+        local_walk = WallDurationRule._local_walk
+        for scope in scopes:
+            bound: set = set()
+            for n in local_walk(scope):
+                if isinstance(n, ast.Assign) and self._dynamic(n.value):
+                    bound.update(t.id for t in n.targets
+                                 if isinstance(t, ast.Name))
+            for n in local_walk(scope):
+                if not isinstance(n, ast.Call):
+                    continue
+                name = _dotted(n.func).rsplit(".", 1)[-1]
+                if name not in self._METERS:
+                    continue
+                arg = n.args[0] if n.args else next(
+                    (kw.value for kw in n.keywords
+                     if kw.arg == "name"), None)
+                if arg is None:
+                    continue
+                if not (self._dynamic(arg) or
+                        (isinstance(arg, ast.Name) and
+                         arg.id in bound)):
+                    continue
+                key = (n.lineno, n.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield self.finding(
+                    ctx, n,
+                    f"{name}(...) mints a dynamically-built metric "
+                    f"name — per-request values in a NAME create one "
+                    f"time series per value (unbounded cardinality); "
+                    f"move the variable part into a label and keep "
+                    f"the name a literal (or noqa a code-site-"
+                    f"constant family with a reason)")
+
+
 RULES = [
     LockDisciplineRule(),
     JitBlockingRule(),
@@ -1207,4 +1292,5 @@ RULES = [
     AsyncBlockingCallRule(),
     FilerHotPathCommitRule(),
     BareTimeoutLiteralRule(),
+    DynamicMetricNameRule(),
 ]

@@ -461,6 +461,10 @@ class Filer:
         chunks = bounded_parallel(
             upload_piece, range(0, len(data), CHUNK_SIZE), limit=4,
             persistent=True)
+        if len(chunks) > 1:
+            # flight-recorder note: a slow write that fanned out N
+            # chunks reads differently from a slow single-chunk one
+            profiling.flight_note("chunks", len(chunks))
         entry = Entry(normalize_path(path), is_directory=False,
                       attributes=Attributes(mime=mime, mode=mode),
                       chunks=chunks)

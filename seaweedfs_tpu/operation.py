@@ -160,6 +160,9 @@ def upload(url: str, fid: str, data: bytes, name: str = "",
         with profiling.stage("upload"):
             r = _write_via_write_plane(url, fid, data)
         if r is not None:
+            # flight-recorder flag: this write was acked by the C++
+            # plane, Python never touched the server-side needle path
+            profiling.flight_note("nativePlane", "write")
             return r
     qs = "?" + urllib.parse.urlencode({"name": name}) if name else ""
     headers = {"Content-Type": mime} if mime else {}
@@ -831,15 +834,18 @@ def read(master: str, fid: str, offset: int = 0,
         # hedge threshold tracker — on plane-serving deployments these
         # ARE the primary reads, and a cold tracker would never arm
         # the hedge for them.
+        from . import profiling
         from .util import hedge as _hedge
         t0 = time.monotonic()
         data = _read_via_read_plane(locs, fid)
         if data is not None:
             _hedge.note_primary(time.monotonic() - t0)
+            profiling.flight_note("nativePlane", "read-cpp")
             return data
         data = _read_via_uds(locs, vid, key, cookie)
         if data is not None:
             _hedge.note_primary(time.monotonic() - t0)
+            profiling.flight_note("nativePlane", "read-uds")
             return data
     last_err = None
     for attempt in range(2):

@@ -46,15 +46,49 @@ questions read from:
    plane, shared by `weed shell cluster.top` / `cluster.profile` and
    `bench.py write_path`.
 
+5. Cost attribution (ISSUE 15): every `stage()` window additionally
+   samples `time.thread_time_ns()` at its boundaries, so each stage
+   reports CPU beside wall into `<name>_stage_cpu_seconds{stage}` —
+   `wall − cpu` per stage IS the GIL/lock/syscall wait, measured
+   instead of inferred.  The per-thread clock makes the `use_track()`
+   re-bind exact: a stage timed on a limiter-pool/hedge/chunk-upload
+   thread charges THAT thread's CPU to the request.  A per-role
+   scheduler-delay probe (`SchedProbe`: a daemon thread timing short
+   sleeps against their deadline) exports `gil_wait_ratio` — how late
+   a runnable thread typically gets the interpreter back.
+
+6. Flight recorder (ISSUE 15): `FlightRecorder`, a bounded per-role
+   ring of COMPLETE records for the requests worth keeping — slower
+   than the self-tracked p95 threshold (util/hedge.LatencyTracker,
+   the same ring-quantile the hedge threshold and brownout median run
+   on), errored, deadline-exceeded, or QoS/brownout-shed.  A record
+   carries the trace span tree, per-stage wall+cpu, the deadline
+   budget at ingress and its verdict, and the hedge/QoS/breaker/
+   native-plane flight notes (`flight_note`).  Served at
+   `GET /debug/slow` on every role; `weed shell cluster.slow` fans
+   out, merges by trace id, and renders cross-role trees.  Head
+   sampling almost never contains the slow request you care about —
+   tail-sampling by construction always does.
+
 Knobs:
   SEAWEEDFS_TPU_PROFILE_HZ       sampling rate; 0 (default) = off
   SEAWEEDFS_TPU_PROFILE_STACKS   distinct folded stacks kept (2048)
   SEAWEEDFS_TPU_STAGE_TIMERS     "0" disables stage tracks entirely
+  SEAWEEDFS_TPU_CPU_SAMPLE       every Nth budget-less request pays
+                                 the thread-CPU clock (16); deadline-
+                                 carrying requests always do; 0 never
+  SEAWEEDFS_TPU_FLIGHT_RECORDER  "0" disables the flight recorder
+  SEAWEEDFS_TPU_SLOW_RING        records kept per process (64)
+  SEAWEEDFS_TPU_SLOW_MIN_MS      slow-capture threshold floor (25)
+  SEAWEEDFS_TPU_SLOW_CAPTURE_PER_S  threshold-capture rate cap (20)
+  SEAWEEDFS_TPU_SCHED_PROBE      "0" disables the scheduler probe
+  SEAWEEDFS_TPU_SCHED_PROBE_MS   probe sleep window (50)
 """
 
 from __future__ import annotations
 
 import contextvars
+import itertools
 import os
 import sys
 import threading
@@ -97,9 +131,34 @@ def max_stacks() -> int:
     return max(64, _env_int("SEAWEEDFS_TPU_PROFILE_STACKS", 2048))
 
 
+# runtime disarm lever (POST /debug/attribution): force-disarm in
+# THIS process until restored — a live kill switch that needs no
+# restart, and the bench's within-cluster A/B toggle (separate
+# clusters can't resolve a ~1% cost under arm-to-arm boot noise).
+# Scope "all" = the whole plane including the PR 7 wall-stage
+# decomposition; scope "plane" = only the ISSUE 15 additions (CPU
+# clocks, flight recorder) — the shape the bench's armed-vs-off
+# acceptance compares, since wall tracks predate the plane and were
+# paid for in every shipped number.
+_attr_disarmed: "str | None" = None
+
+
+def set_attribution_disarmed(disarmed: bool,
+                             scope: str = "all") -> None:
+    global _attr_disarmed
+    _attr_disarmed = (scope if scope in ("all", "plane") else "all") \
+        if disarmed else None
+
+
+def attribution_disarmed() -> "str | None":
+    return _attr_disarmed
+
+
 def stage_timers_enabled() -> bool:
     """SEAWEEDFS_TPU_STAGE_TIMERS=0 turns the write-path stage
     decomposition off (the track() call becomes a no-op)."""
+    if _attr_disarmed == "all":
+        return False
     return os.environ.get("SEAWEEDFS_TPU_STAGE_TIMERS", "1") != "0"
 
 
@@ -316,27 +375,157 @@ def merge_folded(tables: "list[dict]") -> "dict[str, int]":
 _track_var: contextvars.ContextVar["StageTrack | None"] = \
     contextvars.ContextVar("weed_stage_track", default=None)
 
+# the finished track's summary, left for the server front's flight
+# recorder (finish() runs inside the handler, the capture in the
+# front's finally — same thread, so a plain contextvar bridges them)
+_last_summary_var: contextvars.ContextVar["dict | None"] = \
+    contextvars.ContextVar("weed_last_track_summary", default=None)
+
+# per-request flight notes for requests that carry no stage track
+# (reads): armed by the fronts at ingress, read back at capture
+_notes_var: contextvars.ContextVar["dict | None"] = \
+    contextvars.ContextVar("weed_flight_notes", default=None)
+
+
+def cpu_sample_every() -> int:
+    """SEAWEEDFS_TPU_CPU_SAMPLE: every Nth budget-less request pays
+    the thread-CPU clock (default 16); deadline-carrying requests are
+    ALWAYS attributed.  On sandboxed kernels CLOCK_THREAD_CPUTIME_ID
+    is a trapped syscall (~5us/call measured here, not vDSO), and a
+    stage-tracked write makes ~12 of them — unsampled, that alone is
+    ~8% of a GIL-saturated role.  Sampling keeps every histogram
+    MEAN exact (cpu/req, per-stage cpu) while the requests the
+    deadline/hedge planes act on — and the flight recorder explains —
+    keep their exact per-request split.  0 disables attribution
+    entirely (the bench twin's knob)."""
+    if _attr_disarmed:
+        return 0
+    return _env_int("SEAWEEDFS_TPU_CPU_SAMPLE", 16)
+
+
+# SEPARATE counters for the two draw sites: a request advances the
+# front counter once and (when tracked) the track counter once — one
+# shared counter would advance by 2 per request and `(2r+1) % k` can
+# never hit 0 for even k, i.e. tracks would NEVER draw the sample
+_front_tick = itertools.count()
+_track_tick = itertools.count()
+
+
+def cpu_attr_tick() -> bool:
+    """The budget-less sampling decision alone (callers that already
+    know the deadline state, i.e. the server fronts)."""
+    k = cpu_sample_every()
+    if k <= 0:
+        return False
+    return next(_front_tick) % k == 0
+
+
+def cpu_attr_front(deadline_armed: bool) -> bool:
+    """The server fronts' sampling decision.  The k<=0 kill switch
+    (SEAWEEDFS_TPU_CPU_SAMPLE=0 / the /debug/attribution disarm
+    lever) gates EVERYTHING, deadline-carrying requests included — a
+    deadline-default cluster must not pay the trapped clock syscall
+    per request under a knob documented as '0 = never'."""
+    k = cpu_sample_every()
+    if k <= 0:
+        return False
+    if deadline_armed:
+        return True
+    return next(_front_tick) % k == 0
+
+
+def cpu_attr_now() -> bool:
+    """Should THIS request pay the thread-CPU clock?  Deadline-
+    carrying requests always do; budget-less ones every Nth."""
+    k = cpu_sample_every()
+    if k <= 0:
+        return False
+    from .util import deadline as _dl
+    if _dl.get() is not None:
+        return True
+    return next(_track_tick) % k == 0
+
+
+def take_last_summary() -> "dict | None":
+    """The most recent StageTrack summary finished on this context,
+    cleared on read (reused handler threads must not attribute the
+    previous request's decomposition to this one)."""
+    s = _last_summary_var.get()
+    if s is not None:
+        _last_summary_var.set(None)
+    return s
+
+
+def arm_flight_notes() -> None:
+    """Front-ingress arming: give this request a notes dict so
+    flight_note() calls down the handler chain have somewhere to land
+    even without a stage track."""
+    _notes_var.set({})
+
+
+def take_flight_notes() -> "dict | None":
+    d = _notes_var.get()
+    if d is not None:
+        _notes_var.set(None)
+    return d or None
+
+
+def flight_note(key: str, value) -> None:
+    """Attach one fact about the CURRENT request for the flight
+    recorder (hedge issued/won, native-plane handoff, QoS verdict,
+    degraded EC read...).  Prefers the active stage track (which
+    follows use_track() onto pool threads); falls back to the
+    front-armed notes dict; a no-op — two contextvar reads — when
+    neither is armed (un-instrumented callers, background threads)."""
+    trk = _track_var.get()
+    if trk is not None:
+        trk.note(key, value)
+        return
+    d = _notes_var.get()
+    if d is not None:
+        d[key] = value
+
 
 class StageTrack:
     """Per-request stage accumulator.  Thread-safe: the filer funnel
     records assign/upload stages from limiter pool threads into the
-    handler thread's track (see use_track)."""
+    handler thread's track (see use_track).
 
-    __slots__ = ("name", "role", "metrics", "stages", "_lock",
-                 "_t0", "trace_ctx")
+    Each stage carries wall AND thread-CPU seconds (_StageCtx samples
+    `time.thread_time()` at both boundaries, on whichever thread the
+    stage actually ran): `finish()` emits `<name>_stage_cpu_seconds`
+    beside the wall histograms, so `wall − cpu` per stage exposes the
+    GIL/lock/syscall wait directly.  The track total's CPU is the
+    OWNER thread's thread-time delta plus the CPU the stages burned on
+    foreign (pool) threads — the request's whole CPU bill, not just
+    the instrumented windows."""
+
+    __slots__ = ("name", "role", "metrics", "stages", "notes", "_lock",
+                 "_t0", "_owner", "_cpu0", "_cpu_on", "trace_ctx")
 
     def __init__(self, name: str, role: str = "", metrics=None):
         self.name = name
         self.role = role
         self.metrics = metrics
-        # stage -> [cumulative seconds, calls, first-call wall time]
+        # stage -> [wall seconds, calls, first-call wall time,
+        #           cpu seconds, foreign-thread cpu seconds]
         self.stages: dict[str, list] = {}
+        self.notes: "dict | None" = None
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
+        self._owner = threading.get_ident()
+        # sampled CPU attribution (cpu_attr_now): the thread-CPU
+        # clock is a trapped syscall on sandboxed kernels, so only
+        # deadline-carrying and every-Nth budget-less tracks pay it;
+        # wall is always measured
+        self._cpu_on = cpu_attr_now()
+        self._cpu0 = time.thread_time() if self._cpu_on else 0.0
         from . import tracing
         self.trace_ctx = tracing.current_ids()
 
-    def add(self, stage: str, seconds: float) -> None:
+    def add(self, stage: str, seconds: float,
+            cpu_seconds: float = 0.0) -> None:
+        foreign = threading.get_ident() != self._owner
         with self._lock:
             rec = self.stages.get(stage)
             if rec is None:
@@ -344,37 +533,108 @@ class StageTrack:
                 # carry wall starts); the duration itself came off
                 # perf_counter in _StageCtx
                 self.stages[stage] = [
-                    seconds, 1, time.time() - seconds]  # noqa: SWFS011
+                    seconds, 1, time.time() - seconds,  # noqa: SWFS011
+                    cpu_seconds, cpu_seconds if foreign else 0.0]
             else:
                 rec[0] += seconds
                 rec[1] += 1
+                rec[3] += cpu_seconds
+                if foreign:
+                    rec[4] += cpu_seconds
+
+    def note(self, key: str, value) -> None:
+        """Attach one flight-recorder note to this request (hedge
+        verdicts, native-plane handoffs, QoS outcomes — see
+        flight_note)."""
+        with self._lock:
+            if self.notes is None:
+                self.notes = {}
+            self.notes[key] = value
 
     def finish(self) -> float:
         """Observe one histogram cell per stage (plus stage="total")
-        and emit sibling stage spans under the span that was active at
-        track start.  Returns the track's total seconds."""
+        for wall AND cpu, emit sibling stage spans under the span that
+        was active at track start, and stash the finished summary for
+        the front's flight recorder (take_last_summary).  Returns the
+        track's total seconds."""
         total = time.perf_counter() - self._t0
+        # the owner thread's CPU covers everything it ran between
+        # track start and finish (instrumented or not); stages that
+        # ran on OTHER threads contribute their own thread-time on top
+        cpu_on = self._cpu_on
+        own_cpu = (time.thread_time() - self._cpu0) \
+            if cpu_on and threading.get_ident() == self._owner else 0.0
         with self._lock:
             stages = {k: list(v) for k, v in self.stages.items()}
+            notes = dict(self.notes) if self.notes else None
+        total_cpu = own_cpu + sum(rec[4] for rec in stages.values())
         hist = f"{self.name}_stage_seconds"
+        cpu_hist = f"{self.name}_stage_cpu_seconds"
         if self.metrics is not None:
-            for stage, (secs, _calls, _w0) in stages.items():
-                self.metrics.histogram_observe(
+            for stage, rec in stages.items():
+                secs, _calls, _w0, cpu = rec[0], rec[1], rec[2], rec[3]
+                self.metrics.histogram_observe(  # noqa: SWFS017 — the
+                    # track name is a code-site constant ("write"),
+                    # never request-derived; cardinality is bounded by
+                    # the set of track() call sites
                     hist, secs, buckets=STAGE_BUCKETS,
                     help_text=f"per-request {self.name}-path stage "
                               f"decomposition", stage=stage)
-            self.metrics.histogram_observe(
+                if cpu_on:
+                    self.metrics.histogram_observe(  # noqa: SWFS017 —
+                        # same code-site constant as above
+                        cpu_hist, cpu, buckets=STAGE_BUCKETS,
+                        help_text=f"per-request {self.name}-path "
+                                  f"stage CPU (thread_time, sampled "
+                                  f"— see SEAWEEDFS_TPU_CPU_SAMPLE); "
+                                  f"wall minus this is GIL/lock/"
+                                  f"syscall wait", stage=stage)
+            self.metrics.histogram_observe(  # noqa: SWFS017 — as above
                 hist, total, buckets=STAGE_BUCKETS, stage="total")
+            if cpu_on:
+                self.metrics.histogram_observe(  # noqa: SWFS017 — as
+                    # above
+                    cpu_hist, total_cpu, buckets=STAGE_BUCKETS,
+                    stage="total")
         if self.trace_ctx and stages:
             from . import tracing
-            for stage, (secs, calls, wall0) in stages.items():
-                tracing.emit_span(
-                    f"{self.name}.{stage}", wall0, secs,
-                    role=self.role or
-                    (self.trace_ctx[2] if self.trace_ctx else ""),
-                    parent=self.trace_ctx[1],
-                    trace_id=self.trace_ctx[0],
-                    attrs={"calls": calls} if calls > 1 else None)
+            role = self.role or self.trace_ctx[2]
+            specs = []
+            for stage, rec in stages.items():
+                secs, calls, wall0, cpu = rec[0], rec[1], rec[2], rec[3]
+                attrs = {"cpuMs": round(cpu * 1e3, 3)} if cpu_on \
+                    else {}
+                if calls > 1:
+                    attrs["calls"] = calls
+                specs.append({
+                    "name": f"{self.name}.{stage}",
+                    "start": wall0, "duration": secs, "role": role,
+                    "parent": self.trace_ctx[1],
+                    "trace_id": self.trace_ctx[0], "attrs": attrs})
+            # one batch: the tracer's knob env-reads are per CALL,
+            # not per span (they were 3 env lookups x N stages here)
+            tracing.emit_span_batch(specs)
+        # leave the finished decomposition where the server front can
+        # pick it up for a flight-recorder capture (same thread for
+        # both fronts: threaded dispatch / the asyncio pool worker).
+        # An unsampled track reports wall only — cpuMs keys are
+        # ABSENT, never zero, so a render can't mistake "not
+        # measured" for "no CPU"
+        summary = {
+            "totalMs": round(total * 1e3, 3),
+            "cpuSampled": cpu_on,
+            "stages": {
+                s: dict({"wallMs": round(rec[0] * 1e3, 3),
+                         "calls": rec[1]},
+                        **({"cpuMs": round(rec[3] * 1e3, 3)}
+                           if cpu_on else {}))
+                for s, rec in stages.items()},
+        }
+        if cpu_on:
+            summary["cpuMs"] = round(total_cpu * 1e3, 3)
+        if notes:
+            summary["notes"] = notes
+        _last_summary_var.set(summary)
         return total
 
 
@@ -445,7 +705,7 @@ def use_track(trk: "StageTrack | None") -> _UseTrack:
 
 
 class _StageCtx:
-    __slots__ = ("_trk", "_name", "_t0")
+    __slots__ = ("_trk", "_name", "_t0", "_c0")
 
     def __init__(self, trk: "StageTrack", name: str):
         self._trk = trk
@@ -453,10 +713,18 @@ class _StageCtx:
 
     def __enter__(self):
         self._t0 = time.perf_counter()
+        # per-THREAD cpu clock: sampled on whichever thread runs the
+        # stage, so the use_track() re-bind charges pool-thread CPU to
+        # the request exactly — but only when the track drew the CPU
+        # attribution sample (the clock is a trapped syscall on
+        # sandboxed kernels; see cpu_sample_every)
+        self._c0 = time.thread_time() if self._trk._cpu_on else 0.0
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
-        self._trk.add(self._name, time.perf_counter() - self._t0)
+        self._trk.add(self._name, time.perf_counter() - self._t0,
+                      (time.thread_time() - self._c0)
+                      if self._trk._cpu_on else 0.0)
 
 
 class _NoopStage:
@@ -479,6 +747,269 @@ def stage(name: str):
     if trk is None:
         return _NOOP
     return _StageCtx(trk, name)
+
+
+# -- flight recorder (tail-sampled slow-request capture) ------------------
+
+def recorder_enabled() -> bool:
+    """SEAWEEDFS_TPU_FLIGHT_RECORDER=0 disarms capture entirely (the
+    fronts then skip note arming and the per-request observe); the
+    /debug/attribution runtime lever disarms it the same way."""
+    if _attr_disarmed:
+        return False
+    return os.environ.get("SEAWEEDFS_TPU_FLIGHT_RECORDER", "1") \
+        not in ("0", "false")
+
+
+def ring_size() -> int:
+    """SEAWEEDFS_TPU_SLOW_RING: flight records kept per process."""
+    return max(8, _env_int("SEAWEEDFS_TPU_SLOW_RING", 64))
+
+
+def slow_floor_s() -> float:
+    """SEAWEEDFS_TPU_SLOW_MIN_MS: the slow-capture threshold never
+    drops below this — a uniformly-fast role must not spend captures
+    on its own p95 noise."""
+    return max(0.0, _env_float("SEAWEEDFS_TPU_SLOW_MIN_MS", 25.0)) / 1e3
+
+
+def capture_rate() -> float:
+    """SEAWEEDFS_TPU_SLOW_CAPTURE_PER_S: ceiling on threshold-only
+    captures (error/deadline/shed verdicts are never rate-limited —
+    they are rare and precious).  Each capture walks the trace ring
+    for its span tree, so an unbounded rate would tax exactly the
+    overloaded state the recorder exists to explain."""
+    return max(1.0, _env_float("SEAWEEDFS_TPU_SLOW_CAPTURE_PER_S",
+                               20.0))
+
+
+class FlightRecorder:
+    """Bounded ring of complete slow/error-request records.
+
+    Always-on and self-limiting: every request's wall feeds a
+    LatencyTracker (util/hedge — the same ring-quantile the hedge
+    threshold and brownout median run on) and only requests beyond
+    max(p95, SLOW_MIN_MS) — or with a non-ok verdict — are captured,
+    so by construction ~1-in-20 requests pays the capture cost and the
+    ring always holds the tail that head-sampled tracing misses."""
+
+    def __init__(self, size: "int | None" = None):
+        from .util.hedge import LatencyTracker
+        import collections
+        self._lock = threading.Lock()
+        self._ring = collections.deque(
+            maxlen=size if size else ring_size())
+        self._tracker = LatencyTracker(size=128, min_samples=32)
+        self._notes_since_quantile = 0
+        self._threshold: "float | None" = None
+        self._rate_window_start = 0.0
+        self._rate_window_count = 0
+        self.captured = 0
+        self.dropped_rate_limited = 0
+
+    def threshold(self) -> "float | None":
+        """Current slow-capture threshold in seconds; None while the
+        tracker is still warming up (no threshold captures yet —
+        error/deadline/shed still capture)."""
+        with self._lock:
+            return self._threshold
+
+    def _note_wall(self, wall_s: float) -> None:
+        self._tracker.note(wall_s)
+        with self._lock:
+            self._notes_since_quantile += 1
+            if self._threshold is None or \
+                    self._notes_since_quantile >= 32:
+                # the quantile sorts 128 floats — recompute every 32
+                # requests, not every request
+                self._notes_since_quantile = 0
+                p95 = self._tracker.quantile(0.95)
+                self._threshold = None if p95 is None else \
+                    max(p95, slow_floor_s())
+
+    def _rate_ok(self) -> bool:
+        """Token check for threshold-only captures (caller holds no
+        lock): a 1-second window capped at capture_rate()."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._rate_window_start >= 1.0:
+                self._rate_window_start = now
+                self._rate_window_count = 0
+            if self._rate_window_count >= capture_rate():
+                self.dropped_rate_limited += 1
+                return False
+            self._rate_window_count += 1
+            return True
+
+    def observe(self, role: str, method: str, path: str, status: int,
+                wall_s: float, cpu_s: "float | None" = None,
+                verdict: str = "ok", trace_id: str = "",
+                deadline: "dict | None" = None,
+                stages: "dict | None" = None,
+                notes: "dict | None" = None) -> "dict | None":
+        """Feed one finished request; returns the captured record (or
+        None).  `stages` is a StageTrack summary (take_last_summary),
+        `deadline` the {budgetMs, remainingMs} doc from the front,
+        `notes` the flight_note dict.  `cpu_s` is None when the
+        request didn't draw the CPU-attribution sample (see
+        cpu_sample_every) — the record then reports wall only, with
+        the cpuMs/waitMs keys ABSENT rather than zero."""
+        self._note_wall(wall_s)
+        slow = self._threshold is not None and wall_s >= self._threshold
+        if verdict == "ok" and status >= 500:
+            verdict = "error"
+        if verdict == "ok":
+            if not slow:
+                return None
+            if not self._rate_ok():
+                return None
+            verdict = "slow"
+        rec = {
+            "ts": time.time(),
+            "role": role,
+            "method": method,
+            "path": path,
+            "status": status,
+            "verdict": verdict,
+            "wallMs": round(wall_s * 1e3, 3),
+            "traceId": trace_id,
+        }
+        if cpu_s is not None:
+            rec["cpuMs"] = round(cpu_s * 1e3, 3)
+            rec["waitMs"] = round(max(wall_s - cpu_s, 0.0) * 1e3, 3)
+        if deadline:
+            rec["deadline"] = deadline
+        if stages:
+            rec["stages"] = stages
+        if notes:
+            rec["notes"] = notes
+        if trace_id:
+            # the span tree AS OF capture time: the server span and
+            # the track's stage spans are already in the ring (the
+            # fronts capture after sp.finish()); downstream hops'
+            # spans live in THEIR processes' rings and cluster.slow
+            # merges them by trace id
+            from . import tracing
+            spans = tracing.spans_for(trace_id)
+            if spans:
+                rec["spans"] = spans
+        with self._lock:
+            self._ring.append(rec)
+            self.captured += 1
+        _process_metrics().counter_add(
+            "flight_records_total", 1.0,
+            help_text="requests captured by the flight recorder",
+            verdict=verdict)
+        return rec
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            thr = self._threshold
+            return {
+                "records": [dict(r) for r in self._ring],
+                "captured": self.captured,
+                "droppedRateLimited": self.dropped_rate_limited,
+                "thresholdMs": round(thr * 1e3, 3)
+                if thr is not None else None,
+                "ringSize": self._ring.maxlen,
+            }
+
+    def reset(self) -> None:
+        """Tests only: forget records and latency history."""
+        with self._lock:
+            self._ring.clear()
+            self.captured = 0
+            self.dropped_rate_limited = 0
+            self._threshold = None
+            self._notes_since_quantile = 0
+            self._rate_window_count = 0
+        self._tracker.reset()
+
+
+_recorder: "FlightRecorder | None" = None
+_recorder_lock = threading.Lock()
+
+
+def flight_recorder() -> FlightRecorder:
+    global _recorder
+    r = _recorder
+    if r is None:
+        with _recorder_lock:
+            r = _recorder
+            if r is None:
+                r = _recorder = FlightRecorder()
+    return r
+
+
+# -- scheduler-delay probe -------------------------------------------------
+
+class SchedProbe:
+    """Daemon thread timing short Event.wait sleeps against their
+    deadline: the overshoot is how long a runnable thread waited for
+    the scheduler AND the GIL after its wakeup — the direct signal for
+    'this role is GIL-convoyed', independent of any request being
+    instrumented.  Exported as the `gil_wait_ratio` gauge (EWMA of
+    overshoot/interval; 0 idle .. ~1 means wakeups routinely wait a
+    whole extra interval)."""
+
+    def __init__(self, interval_s: "float | None" = None):
+        self.interval = interval_s if interval_s else max(
+            0.005, _env_float("SEAWEEDFS_TPU_SCHED_PROBE_MS", 50.0)
+            / 1e3)
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self.ratio = 0.0
+        self.ticks = 0
+
+    def start(self) -> "SchedProbe":
+        self._thread = threading.Thread(
+            target=self._run, name="weed-sched-probe", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        m = _process_metrics()
+        ewma = 0.0
+        while True:
+            t0 = time.monotonic()
+            if self._stop.wait(self.interval):
+                return
+            overshoot = max(
+                0.0, (time.monotonic() - t0) - self.interval)
+            ewma = 0.9 * ewma + 0.1 * (overshoot / self.interval)
+            self.ratio = ewma
+            self.ticks += 1
+            if self.ticks == 1 or self.ticks % 10 == 0:
+                # first tick immediately (a scrape right after boot
+                # must see the gauge), then ~2 writes/second at the
+                # default interval
+                m.gauge_set(
+                    "gil_wait_ratio", round(ewma, 4),
+                    help_text="EWMA of scheduler-probe sleep overshoot"
+                              " / interval: how late runnable threads "
+                              "get the GIL back (0 idle, ~1 = a whole "
+                              "extra interval per wakeup)")
+
+
+_sched_probe: "SchedProbe | None" = None
+
+
+def sched_probe_enabled() -> bool:
+    return os.environ.get("SEAWEEDFS_TPU_SCHED_PROBE", "1") \
+        not in ("0", "false")
+
+
+def maybe_start_sched_probe() -> "SchedProbe | None":
+    """Once per process (every role's install_debug_routes calls
+    this, like maybe_autostart)."""
+    global _sched_probe
+    if _sched_probe is not None or not sched_probe_enabled():
+        return _sched_probe
+    _sched_probe = SchedProbe().start()
+    return _sched_probe
 
 
 # -- device telemetry (the TPU path's instrument cluster) -----------------
@@ -505,7 +1036,10 @@ def device_note(direction: str, nbytes: int,
                         help_text="host<->device staging window "
                                   "latency", dir=direction)
     if seconds > 0:
-        m.gauge_set(f"device_{direction}_gbps", nbytes / seconds / 1e9,
+        # literal mint names (SWFS017): the direction set is closed
+        gauge = "device_h2d_gbps" if direction == "h2d" \
+            else "device_d2h_gbps"
+        m.gauge_set(gauge, nbytes / seconds / 1e9,
                     help_text="last staging window throughput")
 
 

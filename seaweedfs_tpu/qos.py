@@ -508,6 +508,15 @@ def install(http, role: str, path_prefix: str = "") -> None:
                     "qos_rejected_total", 1.0,
                     help_text="requests rejected by QoS admission",
                     tenant=tenant, role=role, reason="brownout")
+                # the flight recorder's record of a shed request must
+                # say WHY it was shed (verdict "shed" alone names the
+                # mechanism, not the cause)
+                from . import profiling
+                profiling.flight_note(
+                    "qosReject",
+                    {"reason": "brownout", "tenant": tenant,
+                     "estimateMs": round(est * 1e3, 2),
+                     "remainingMs": round(rem * 1e3, 2)})
                 retry_after = max(1, int(est + 0.999))
                 body = (b'{"error": "qos: request budget below '
                         b'current service latency (brownout)"}')
@@ -537,6 +546,9 @@ def install(http, role: str, path_prefix: str = "") -> None:
             "qos_rejected_total", 1.0,
             help_text="requests rejected by QoS admission",
             tenant=tenant, role=role, reason=reject.reason)
+        from . import profiling
+        profiling.flight_note(
+            "qosReject", {"reason": reject.reason, "tenant": tenant})
         retry_after = max(1, int(reject.retry_after + 0.999))
         body = (b'{"error": "qos: tenant over ' +
                 reject.reason.encode() + b' limit"}')

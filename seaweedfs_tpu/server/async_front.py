@@ -293,7 +293,13 @@ class AsyncFront:
     def _sync_process(self, req: AsyncRequest):
         """Everything between framing and response write, on a pool
         thread: request-id adoption, server span, QoS admission,
-        guard, route — the same ladder as the threaded dispatcher."""
+        guard, route — the same ladder as the threaded dispatcher.
+        Returns the flight-recorder material (verdict, pool-thread
+        CPU, deadline doc, stage summary, notes) alongside, since the
+        contextvars it rides live on THIS thread, not the loop's."""
+        import time as _time
+
+        from .. import profiling as _prof
         outer = self.owner
         rid = ensure_request_id(req.headers.get(_RID_HEADER, ""))
         # deadline plane (util/deadline): same ingress contract as the
@@ -307,6 +313,16 @@ class AsyncFront:
                        site=outer.role or "server",
                        allow_default=not req.path.startswith(
                            ("/admin/", "/debug/")))
+        flight_on = _prof.recorder_enabled()
+        if flight_on:
+            _prof.arm_flight_notes()
+        # sampled CPU attribution, same rule as the threaded front:
+        # deadline-carrying requests always pay the thread-CPU clock,
+        # budget-less ones every Nth — and the k<=0 kill switch
+        # gates both (cpu_attr_front)
+        cpu0 = _time.thread_time() \
+            if _prof.cpu_attr_front(dl is not None) else None
+        verdict = "ok"
         route = outer.routes.get((req.method, req.path))
         if route is None and outer.prefix_routes:
             route = outer._prefix_route(req.method, req.path)
@@ -323,8 +339,11 @@ class AsyncFront:
             if dl is not None and dl.expired():
                 throttled = _dl.expired_response(
                     f"{outer.role or 'server'}.ingress")
+                verdict = "deadline"
             if throttled is None and outer.admission is not None:
                 throttled, qos_release = outer.admission(req)
+                if throttled is not None:
+                    verdict = "shed"
             if throttled is not None:
                 status, payload = throttled
             elif (denied := outer.guard(req)
@@ -339,11 +358,32 @@ class AsyncFront:
         except _dl.DeadlineExceeded as e:
             # budget died mid-handler: 504, matching the threaded front
             status, payload = _dl.handler_exceeded_response()
+            verdict = "deadline"
             sp.set_error(e)
         except Exception as e:  # noqa: BLE001 — server must answer
             status, payload = 500, {"error": str(e)}
+            verdict = "error"
             sp.set_error(e)
-        return status, payload, sp, rid, qos_release
+        # cpu rides OUTSIDE the flight dict: the request_cpu_seconds
+        # histogram must not vanish when the recorder is disarmed
+        # (the threaded front emits it unconditionally).  The summary
+        # drain is likewise unconditional — a finished track's
+        # summary left behind while disarmed would be attributed to a
+        # later request on this reused pool thread after re-arming.
+        cpu = (_time.thread_time() - cpu0) if cpu0 is not None \
+            else None
+        summary = _prof.take_last_summary()
+        flight = None
+        if flight_on:
+            dl_doc = None
+            if dl is not None:
+                dl_doc = {"budgetMs": int(dl.budget * 1e3),
+                          "remainingMs": int(dl.remaining() * 1e3)}
+            flight = {"verdict": verdict,
+                      "deadline": dl_doc,
+                      "stages": summary,
+                      "notes": _prof.take_flight_notes()}
+        return status, payload, sp, rid, qos_release, cpu, flight
 
     async def _dispatch(self, req: AsyncRequest,
                         writer: asyncio.StreamWriter) -> bool:
@@ -361,9 +401,11 @@ class AsyncFront:
         status = 0
         qos_release = None
         stream_body = None
+        cpu = None
+        flight = None
         keep = True
         try:
-            status, payload, sp, rid, qos_release = \
+            status, payload, sp, rid, qos_release, cpu, flight = \
                 await loop.run_in_executor(self._pool,
                                            self._sync_process, req)
             body, ctype, extra_headers = normalize_payload(payload)
@@ -429,3 +471,36 @@ class AsyncFront:
                         "request_seconds", sp.duration,
                         help_text="HTTP request handling latency",
                         method=req.method, code=str(status))
+                    if cpu is not None:
+                        from .. import profiling as _prof
+                        outer.metrics.histogram_observe(
+                            "request_cpu_seconds", cpu,
+                            buckets=_prof.STAGE_BUCKETS,
+                            help_text="handler-thread CPU per request"
+                                      " (thread_time, sampled — see "
+                                      "SEAWEEDFS_TPU_CPU_SAMPLE); "
+                                      "request_seconds minus this is "
+                                      "GIL/lock/IO wait",
+                            method=req.method, code=str(status))
+            if flight is not None and sp is not None:
+                # after sp.finish(): the capture's span-tree pull must
+                # see the server span in the ring.  The wall covers
+                # the response write (sp.duration does); the CPU is
+                # the pool thread's handler share — the loop's framing
+                # cost is the front's, not this request's.
+                from .. import profiling as _prof
+                try:
+                    _prof.flight_recorder().observe(
+                        role=outer.role or "server",
+                        method=req.method, path=req.path,
+                        status=status, wall_s=sp.duration,
+                        cpu_s=cpu,
+                        verdict=flight["verdict"], trace_id=rid,
+                        deadline=flight["deadline"],
+                        stages=flight["stages"],
+                        notes=flight["notes"])
+                except Exception as e:  # noqa: BLE001 —
+                    # observability must never break a reply
+                    from ..util import wlog
+                    wlog.warning("flight capture failed: %s", e,
+                                 component="profiling")

@@ -32,6 +32,13 @@ httpd.is_admin_path):
       Off by default; SEAWEEDFS_TPU_PROFILE_HZ arms it at boot.  The
       shell's `cluster.profile` arms every node, waits, and merges
       the folded stacks into one cluster-wide flame view.
+  GET/POST /debug/slow — the flight recorder's ring
+      (profiling.FlightRecorder): complete records of the tail —
+      requests slower than the self-tracked p95 threshold, errored,
+      deadline-exceeded, or QoS/brownout-shed — each with its span
+      tree, per-stage wall+cpu split, deadline verdict and flight
+      notes.  POST {"clear": true} empties it.  `cluster.slow` fans
+      this out and merges by trace id across roles.
 """
 
 from __future__ import annotations
@@ -58,8 +65,13 @@ def install_debug_routes(http: HttpServer) -> None:
     http.route("POST", "/debug/qos", _qos_post)
     http.route("GET", "/debug/pprof", _pprof_get)
     http.route("POST", "/debug/pprof", _pprof_post)
+    http.route("GET", "/debug/slow", _slow_get)
+    http.route("POST", "/debug/slow", _slow_post)
+    http.route("GET", "/debug/attribution", _attr_get)
+    http.route("POST", "/debug/attribution", _attr_post)
     from .. import profiling
     profiling.maybe_autostart()  # SEAWEEDFS_TPU_PROFILE_HZ boot arming
+    profiling.maybe_start_sched_probe()  # gil_wait_ratio gauge
 
 
 def _pprof_get(req: Request):
@@ -95,6 +107,54 @@ def _pprof_post(req: Request):
         s.reset()
         return 200, s.snapshot()
     return 400, {"error": "body needs action: start|stop|reset"}
+
+
+def _slow_get(req: Request):
+    """The flight recorder's ring (profiling.FlightRecorder): the
+    captured slow/error/deadline/shed requests with their span trees,
+    stage wall+cpu splits, deadline verdicts and flight notes.
+    `weed shell cluster.slow` fans this endpoint out and merges
+    records by trace id across roles."""
+    from .. import profiling
+    return 200, profiling.flight_recorder().snapshot()
+
+
+def _slow_post(req: Request):
+    """{"clear": true} empties the ring and latency history (chaos
+    runs reset between scenarios the way /debug/faults does)."""
+    from .. import profiling
+    if req.json().get("clear"):
+        profiling.flight_recorder().reset()
+        return 200, profiling.flight_recorder().snapshot()
+    return 400, {"error": "body needs clear: true"}
+
+
+def _attr_get(req: Request):
+    from .. import profiling
+    scope = profiling.attribution_disarmed()
+    return 200, {"disarmed": scope is not None,
+                 "scope": scope or ""}
+
+
+def _attr_post(req: Request):
+    """{"disarmed": true|false, "scope": "all"|"plane"} — runtime
+    kill/restore switch for the cost-attribution plane in this
+    process, no restart needed.  Scope "all" (default) disarms
+    everything including the wall-stage decomposition; "plane"
+    disarms only the ISSUE 15 additions (CPU clocks, flight
+    recorder).  Also the lever behind bench.py's within-cluster
+    attribution-overhead A/B: separate clusters cannot resolve a
+    ~1% cost under arm-to-arm boot noise, alternating armed/disarmed
+    traffic windows on ONE cluster can."""
+    from .. import profiling
+    b = req.json()
+    if "disarmed" not in b:
+        return 400, {"error": "body needs disarmed: true|false"}
+    profiling.set_attribution_disarmed(
+        bool(b["disarmed"]), scope=str(b.get("scope", "all")))
+    scope = profiling.attribution_disarmed()
+    return 200, {"disarmed": scope is not None,
+                 "scope": scope or ""}
 
 
 def _faults_get(req: Request):

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -311,6 +312,21 @@ class HttpServer:
                                site=outer.role or "server",
                                allow_default=not req.path.startswith(
                                    ("/admin/", "/debug/")))
+                # flight recorder (profiling.py): arm the per-request
+                # notes dict so hedge/QoS/plane verdicts down the
+                # handler chain have somewhere to land, and sample
+                # this thread's CPU clock — wall − cpu at the end is
+                # the request's GIL/lock/syscall wait.  The clock is
+                # a trapped syscall on sandboxed kernels, so only
+                # deadline-carrying and every-Nth budget-less
+                # requests pay it (cpu_sample_every)
+                from .. import profiling as _prof
+                flight_on = _prof.recorder_enabled()
+                if flight_on:
+                    _prof.arm_flight_notes()
+                cpu0 = time.thread_time() \
+                    if _prof.cpu_attr_front(dl is not None) else None
+                verdict = "ok"
                 route = outer.routes.get((req.method, req.path))
                 if route is None and outer.prefix_routes:
                     route = outer._prefix_route(req.method, req.path)
@@ -351,6 +367,7 @@ class HttpServer:
                         if dl is not None and dl.expired():
                             throttled = _dl.expired_response(
                                 f"{outer.role or 'server'}.ingress")
+                            verdict = "deadline"
                         # QoS admission next (qos.py): an over-limit
                         # tenant is rejected with 503 + Retry-After
                         # BEFORE auth or routing spends anything on it
@@ -358,6 +375,8 @@ class HttpServer:
                                 outer.admission is not None:
                             throttled, qos_release = \
                                 outer.admission(req)
+                            if throttled is not None:
+                                verdict = "shed"
                         if throttled is not None:
                             status, payload = throttled
                         elif (denied := outer.guard(req)
@@ -376,10 +395,12 @@ class HttpServer:
                         # 504, not a generic 500
                         status, payload = \
                             _dl.handler_exceeded_response()
+                        verdict = "deadline"
                         sp.set_error(e)
                     except Exception as e:  # noqa: BLE001 — server
                         # must answer
                         status, payload = 500, {"error": str(e)}
+                        verdict = "error"
                         sp.set_error(e)
                     # drain any unread request body: a handler that
                     # ignores its body (e.g. PROPFIND's XML) would
@@ -475,6 +496,12 @@ class HttpServer:
                                 component="qos")
                     sp.set("status", status)
                     sp.finish()
+                    # this thread's CPU for the whole request —
+                    # handler AND response write (the streamed-body
+                    # paths run above on this same thread); None when
+                    # this request didn't draw the attribution sample
+                    cpu = (time.thread_time() - cpu0) \
+                        if cpu0 is not None else None
                     with outer._inflight_lock:
                         outer._inflight -= 1
                         inflight = outer._inflight
@@ -485,6 +512,48 @@ class HttpServer:
                             "request_seconds", sp.duration,
                             help_text="HTTP request handling latency",
                             method=req.method, code=str(status))
+                        if cpu is not None:
+                            outer.metrics.histogram_observe(
+                                "request_cpu_seconds", cpu,
+                                buckets=_prof.STAGE_BUCKETS,
+                                help_text="handler-thread CPU per "
+                                          "request (thread_time, "
+                                          "sampled — see SEAWEEDFS_"
+                                          "TPU_CPU_SAMPLE); request_"
+                                          "seconds minus this is "
+                                          "GIL/lock/IO wait",
+                                method=req.method, code=str(status))
+                    # ALWAYS drain the finished-track summary: tracks
+                    # run whether or not the recorder is armed, and a
+                    # summary left behind while disarmed would be
+                    # attributed to a later request on this reused
+                    # thread after re-arming
+                    summary = _prof.take_last_summary()
+                    if flight_on:
+                        # AFTER sp.finish(): the capture pulls this
+                        # trace's spans from the ring, and the server
+                        # span must be among them
+                        dl_doc = None
+                        if dl is not None:
+                            dl_doc = {
+                                "budgetMs": int(dl.budget * 1e3),
+                                "remainingMs":
+                                    int(dl.remaining() * 1e3)}
+                        try:
+                            _prof.flight_recorder().observe(
+                                role=outer.role or "server",
+                                method=req.method, path=req.path,
+                                status=status, wall_s=sp.duration,
+                                cpu_s=cpu, verdict=verdict,
+                                trace_id=rid, deadline=dl_doc,
+                                stages=summary,
+                                notes=_prof.take_flight_notes())
+                        except Exception as e:  # noqa: BLE001 —
+                            # observability must never break a reply
+                            from ..util import wlog
+                            wlog.warning(
+                                "flight capture failed: %s", e,
+                                component="profiling")
 
             do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _dispatch
             do_OPTIONS = _dispatch  # CORS preflight (S3 gateway)

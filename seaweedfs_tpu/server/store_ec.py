@@ -133,6 +133,12 @@ class EcReader:
             "ec_degraded_reads_total", 1.0,
             help_text="needle reads served by interval reconstruction "
                       "instead of a direct shard read", vid=ev.id)
+        # flight-recorder note: a slow read that RECONSTRUCTED is a
+        # different incident from a slow direct shard read
+        from .. import profiling
+        profiling.flight_note(
+            "ecDegraded", {"vid": ev.id, "shard": sid,
+                           "bytes": iv.size})
         t0 = time.perf_counter()
         try:
             step = _degraded_stream_bytes()

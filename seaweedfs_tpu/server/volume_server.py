@@ -749,6 +749,14 @@ class VolumeServer:
         return _serve(200, data, {"Content-Type": mime})
 
     def _put_needle(self, fid: types.FileId, req: Request):
+        # armed `volume.write.serve` faults (delay: one wedged
+        # replica; error: one dead replica) fire before the write
+        # track opens — the WRITE-side sibling of volume.read.serve,
+        # the chaos lever behind the deadline/flight-recorder
+        # scenarios; keyed by this server's url so `match` can wedge
+        # exactly one replica of a volume
+        from .. import faults
+        faults.fire("volume.write.serve", key=f"{self.http.url}/{fid}")
         # write-path latency decomposition (profiling.py): the track
         # covers this handler; recv/index/append/flush/replicate stage
         # cells land in write_stage_seconds{stage} plus sibling trace
@@ -805,6 +813,9 @@ class VolumeServer:
                     headers={"Content-Type":
                              mime or "application/octet-stream"})
             if err:
+                # the flight record of a failed write names the
+                # replication fan-out, not just "500"
+                profiling.flight_note("replicate", {"error": str(err)})
                 return 500, {"error": f"replication: {err}"}
         return 201, {"name": name, "size": size, "eTag": n.etag(),
                      "unchanged": unchanged}

@@ -1136,11 +1136,16 @@ def _read_cache_report(before: "dict[str, list]",
 def _stage_report(before: "dict[str, list]", after: "dict[str, list]",
                   ns: str) -> str:
     """Per-stage share of write-path wall time over the sampling
-    window, from the write_stage_seconds decomposition (profiling.py).
-    Empty string when no write landed in the window."""
+    window, from the write_stage_seconds decomposition (profiling.py),
+    with each stage's cpu/wall mean beside it (write_stage_cpu_seconds
+    — ISSUE 15): `upload 45% cpu=0.12/1.30ms` reads "45% of write
+    wall, of which each call burned 0.12ms CPU out of 1.30ms wall —
+    the other 1.18ms was GIL/lock/IO wait".  Empty string when no
+    write landed in the window."""
     from .. import profiling
     name = f"{ns}_write_stage_seconds"
-    stages: dict[str, float] = {}
+    cpu_name = f"{ns}_write_stage_cpu_seconds"
+    stages: dict[str, tuple] = {}
     total = 0.0
     seen = {l.get("stage", "") for l, _v in
             after.get(f"{name}_count", [])}
@@ -1150,16 +1155,63 @@ def _stage_report(before: "dict[str, list]", after: "dict[str, list]",
             profiling.prom_histogram(before, name, {"stage": stage}))
         if not h or h["count"] <= 0:
             continue
+        c = profiling.histogram_delta(
+            profiling.prom_histogram(after, cpu_name,
+                                     {"stage": stage}),
+            profiling.prom_histogram(before, cpu_name,
+                                     {"stage": stage}))
+        cpu_mean = (c["sum"] / c["count"]) if c and c["count"] else None
         if stage == "total":
             total = h["sum"]
         else:
-            stages[stage] = h["sum"]
+            stages[stage] = (h["sum"], h["sum"] / h["count"], cpu_mean)
     if not stages or total <= 0:
         return ""
-    parts = [f"{s} {secs / total * 100.0:.0f}%"
-             for s, secs in sorted(stages.items(),
-                                   key=lambda kv: -kv[1])]
+    parts = []
+    for s, (secs, wall_mean, cpu_mean) in sorted(
+            stages.items(), key=lambda kv: -kv[1][0]):
+        p = f"{s} {secs / total * 100.0:.0f}%"
+        if cpu_mean is not None:
+            p += (f" cpu={cpu_mean * 1e3:.2f}/"
+                  f"{wall_mean * 1e3:.2f}ms")
+        parts.append(p)
     return "write stages: " + " ".join(parts)
+
+
+def _cpu_report(before: "dict[str, list]", after: "dict[str, list]",
+                ns: str, req: "dict | None", window: float) -> str:
+    """The node's cost-attribution line (ISSUE 15): mean CPU vs wall
+    per request from request_cpu_seconds/request_seconds, the
+    scheduler-probe gil_wait_ratio, and the /proc process-TREE CPU
+    burn + RSS (pre-fork workers and native plane children included).
+    Empty when the window saw no requests and no tree gauges."""
+    from .. import profiling
+    parts = []
+    c = profiling.histogram_delta(
+        profiling.prom_histogram(after, f"{ns}_request_cpu_seconds"),
+        profiling.prom_histogram(before, f"{ns}_request_cpu_seconds"))
+    if c and c["count"] > 0 and req and req["count"] > 0:
+        cpu_ms = c["sum"] / c["count"] * 1e3
+        wall_ms = req["sum"] / req["count"] * 1e3
+        if wall_ms > 0:
+            parts.append(
+                f"{cpu_ms:.2f}ms cpu of {wall_ms:.2f}ms wall/req "
+                f"(wait {max(1.0 - cpu_ms / wall_ms, 0.0) * 100:.0f}%)")
+    gil = _gauge(after, "seaweedfs_tpu_gil_wait_ratio")
+    if gil is not None:
+        parts.append(f"gil-wait={gil * 100:.0f}%")
+    tree_a = _gauge(after, "seaweedfs_tpu_process_tree_cpu_seconds")
+    tree_b = _gauge(before, "seaweedfs_tpu_process_tree_cpu_seconds")
+    if tree_a is not None and tree_b is not None and window > 0:
+        burn = max(tree_a - tree_b, 0.0) / window
+        procs = _gauge(after, "seaweedfs_tpu_process_tree_procs") or 1
+        rss = _gauge(after, "seaweedfs_tpu_process_tree_rss_bytes") \
+            or 0.0
+        parts.append(f"tree={burn:.2f} cores/{procs:.0f} procs "
+                     f"rss={rss / (1 << 20):.0f}MB")
+    if not parts:
+        return ""
+    return "cpu: " + "  ".join(parts)
 
 
 def _group_commit_report(before: "dict[str, list]",
@@ -1305,95 +1357,287 @@ def cmd_cluster_top(env: CommandEnv, args: list[str]) -> str:
             # node at absurd req/s
             out.append(f"{url}: no baseline sample this window")
             continue
-        ns = _node_role(a)
-        req = profiling.histogram_delta(
-            profiling.prom_histogram(a, f"{ns}_request_seconds"),
-            profiling.prom_histogram(b, f"{ns}_request_seconds"))
-        rate = (req["count"] / window) if req else 0.0
-        p99 = profiling.histogram_quantile(req, 0.99) if req else 0.0
-        inflight = _gauge(a, f"{ns}_requests_in_flight") or 0
-        line = (f"{url} [{ns}] {rate:7.1f} req/s  "
-                f"p99={p99 * 1e3:7.1f}ms  in-flight={inflight:.0f}")
-        reused = _counter_sum(
-            a, "seaweedfs_tpu_pool_connections_reused_total")
-        opened = _counter_sum(
-            a, "seaweedfs_tpu_pool_connections_opened_total")
-        if reused + opened > 0:
-            line += (f"  pool-reuse={reused / (reused + opened) * 100:.0f}%"
-                     f" ({opened:.0f} dials)")
-        open_breakers = sum(
-            1 for _l, v in a.get("seaweedfs_tpu_peer_breaker_state", [])
-            if v != 0)
-        if open_breakers:
-            line += f"  breakers:{open_breakers} non-closed"
-        pace = _gauge(a, "seaweedfs_tpu_qos_ec_pace_ms")
-        if pace:
-            line += f"  ec-pace={pace:.0f}ms"
-        rejected = _counter_sum(a, "seaweedfs_tpu_qos_rejected_total") \
-            - (_counter_sum(b, "seaweedfs_tpu_qos_rejected_total")
-               if b else 0)
-        if rejected > 0:
-            line += f"  qos-rejected={rejected:.0f}"
-        out.append(line)
-        kern = _gauge(a, "seaweedfs_tpu_device_kernel_last_ms",
-                      {"kernel": "gf_apply_matrix"})
-        if kern is not None:
-            h2d = _gauge(a, "seaweedfs_tpu_device_h2d_gbps") or 0.0
-            d2h = _gauge(a, "seaweedfs_tpu_device_d2h_gbps") or 0.0
-            line = (f"  device: kernel={kern:.2f}ms "
-                    f"h2d={h2d:.2f}GB/s d2h={d2h:.2f}GB/s")
-            # windowed staging figures (ops.staging): window count
-            # since the previous sample + how overlapped the last
-            # launch's h2d/d2h planes actually ran
-            ov = _gauge(a, "seaweedfs_tpu_device_h2d_overlap_fraction",
-                        {"op": "encode"})
-            if ov is None:  # rebuild-only workload stages too
-                ov = _gauge(a,
-                            "seaweedfs_tpu_device_h2d_overlap_fraction",
-                            {"op": "rebuild"})
-            wins = _counter_sum(
-                a, "seaweedfs_tpu_device_staged_windows_total") - \
-                (_counter_sum(
-                    b, "seaweedfs_tpu_device_staged_windows_total")
-                 if b else 0)
-            if ov is not None:
-                line += f"  overlap={ov * 100:.0f}%"
-            if wins > 0:
-                line += f"  windows={wins:.0f}"
-            out.append(line)
-        cache_line = _read_cache_report(b or {}, a)
-        degraded = _counter_sum(
-            a, "seaweedfs_tpu_ec_degraded_reads_total") - \
-            _counter_sum(b or {}, "seaweedfs_tpu_ec_degraded_reads_total")
-        if degraded > 0:
-            cache_line += ("  " if cache_line else "") + \
-                f"degraded-reads={degraded:.0f}"
-        if cache_line:
-            out.append("  " + cache_line)
-        stages = _stage_report(b or {}, a, ns)
-        if stages:
-            out.append("  " + stages)
-        planes = _native_plane_report(b or {}, a)
-        if planes:
-            out.append("  " + planes)
-        gc = _group_commit_report(b or {}, a)
-        if gc:
-            out.append("  " + gc)
-        dl = _deadline_report(b or {}, a)
-        if dl:
-            out.append("  " + dl)
         try:
-            prof = http_json("GET", f"{url}/debug/pprof?top=3",
-                             timeout=3)
+            out.extend(_render_node_top(url, b, a, window))
+        except Exception as e:  # noqa: BLE001 — one node's partial or
+            # malformed mid-interval scrape must cost that node a
+            # note, never the whole cluster view
+            out.append(f"{url}: render failed: {e}")
+    return "\n".join(out)
+
+
+def _render_node_top(url: str, b: "dict[str, list]",
+                     a: "dict[str, list]",
+                     window: float) -> "list[str]":
+    """One node's cluster.top block, split out so the caller can
+    contain a render failure (a node restarting mid-interval hands
+    back truncated metrics; a role skew hands back unexpected label
+    shapes) to that node's line."""
+    from .. import profiling
+    out: list[str] = []
+    ns = _node_role(a)
+    req = profiling.histogram_delta(
+        profiling.prom_histogram(a, f"{ns}_request_seconds"),
+        profiling.prom_histogram(b, f"{ns}_request_seconds"))
+    rate = (req["count"] / window) if req else 0.0
+    p99 = profiling.histogram_quantile(req, 0.99) if req else 0.0
+    inflight = _gauge(a, f"{ns}_requests_in_flight") or 0
+    line = (f"{url} [{ns}] {rate:7.1f} req/s  "
+            f"p99={p99 * 1e3:7.1f}ms  in-flight={inflight:.0f}")
+    reused = _counter_sum(
+        a, "seaweedfs_tpu_pool_connections_reused_total")
+    opened = _counter_sum(
+        a, "seaweedfs_tpu_pool_connections_opened_total")
+    if reused + opened > 0:
+        line += (f"  pool-reuse={reused / (reused + opened) * 100:.0f}%"
+                 f" ({opened:.0f} dials)")
+    open_breakers = sum(
+        1 for _l, v in a.get("seaweedfs_tpu_peer_breaker_state", [])
+        if v != 0)
+    if open_breakers:
+        line += f"  breakers:{open_breakers} non-closed"
+    pace = _gauge(a, "seaweedfs_tpu_qos_ec_pace_ms")
+    if pace:
+        line += f"  ec-pace={pace:.0f}ms"
+    rejected = _counter_sum(a, "seaweedfs_tpu_qos_rejected_total") \
+        - _counter_sum(b, "seaweedfs_tpu_qos_rejected_total")
+    if rejected > 0:
+        line += f"  qos-rejected={rejected:.0f}"
+    out.append(line)
+    kern = _gauge(a, "seaweedfs_tpu_device_kernel_last_ms",
+                  {"kernel": "gf_apply_matrix"})
+    if kern is not None:
+        h2d = _gauge(a, "seaweedfs_tpu_device_h2d_gbps") or 0.0
+        d2h = _gauge(a, "seaweedfs_tpu_device_d2h_gbps") or 0.0
+        line = (f"  device: kernel={kern:.2f}ms "
+                f"h2d={h2d:.2f}GB/s d2h={d2h:.2f}GB/s")
+        # windowed staging figures (ops.staging): window count
+        # since the previous sample + how overlapped the last
+        # launch's h2d/d2h planes actually ran
+        ov = _gauge(a, "seaweedfs_tpu_device_h2d_overlap_fraction",
+                    {"op": "encode"})
+        if ov is None:  # rebuild-only workload stages too
+            ov = _gauge(a,
+                        "seaweedfs_tpu_device_h2d_overlap_fraction",
+                        {"op": "rebuild"})
+        wins = _counter_sum(
+            a, "seaweedfs_tpu_device_staged_windows_total") - \
+            _counter_sum(
+                b, "seaweedfs_tpu_device_staged_windows_total")
+        if ov is not None:
+            line += f"  overlap={ov * 100:.0f}%"
+        if wins > 0:
+            line += f"  windows={wins:.0f}"
+        out.append(line)
+    cpu = _cpu_report(b, a, ns, req, window)
+    if cpu:
+        out.append("  " + cpu)
+    cache_line = _read_cache_report(b, a)
+    degraded = _counter_sum(
+        a, "seaweedfs_tpu_ec_degraded_reads_total") - \
+        _counter_sum(b, "seaweedfs_tpu_ec_degraded_reads_total")
+    if degraded > 0:
+        cache_line += ("  " if cache_line else "") + \
+            f"degraded-reads={degraded:.0f}"
+    if cache_line:
+        out.append("  " + cache_line)
+    stages = _stage_report(b, a, ns)
+    if stages:
+        out.append("  " + stages)
+    planes = _native_plane_report(b, a)
+    if planes:
+        out.append("  " + planes)
+    gc = _group_commit_report(b, a)
+    if gc:
+        out.append("  " + gc)
+    dl = _deadline_report(b, a)
+    if dl:
+        out.append("  " + dl)
+    try:
+        prof = http_json("GET", f"{url}/debug/pprof?top=3",
+                         timeout=3)
+    except OSError:
+        prof = None
+    if isinstance(prof, dict) and prof.get("stacks"):
+        total = max(1, prof["stacks"])
+        for stack, n in sorted(prof.get("folded", {}).items(),
+                               key=lambda kv: -kv[1]):
+            leaf = stack.rsplit(";", 2)[-2:]
+            out.append(f"  prof {n / total * 100:4.1f}% "
+                       f"{';'.join(leaf)}")
+    return out
+
+
+def _render_slow_hop(url: str, rec: dict) -> "list[str]":
+    """One flight record as an indented hop block: the wall/cpu/wait
+    split, the deadline budget+verdict, the stage decomposition
+    (wall/cpu per stage) and the hedge/QoS/plane flight notes."""
+    wall = rec.get("wallMs", 0.0)
+    cpu = rec.get("cpuMs")     # absent = request didn't draw the
+    # CPU-attribution sample (SEAWEEDFS_TPU_CPU_SAMPLE): wall only,
+    # never a fake 0ms cpu
+    head = (f"  {rec.get('role', '?')}@{url}: "
+            f"{rec.get('method', '?')} {rec.get('path', '?')} "
+            f"status={rec.get('status', 0)}")
+    if wall > 0 and cpu is not None:
+        wait = rec.get("waitMs", max(wall - cpu, 0.0))
+        line = (f"{head} {wall:.1f}ms wall / {cpu:.2f}ms cpu "
+                f"(wait {wait / wall * 100:.0f}%)")
+    elif wall > 0:
+        line = f"{head} {wall:.1f}ms wall (cpu unsampled)"
+    else:
+        line = head
+    dl = rec.get("deadline")
+    if dl:
+        line += (f"  deadline={dl.get('budgetMs', 0)}ms"
+                 f"->{dl.get('remainingMs', 0)}ms left")
+    if rec.get("verdict") not in (None, "slow"):
+        line += f"  verdict={rec['verdict']}"
+    out = [line]
+    stages = (rec.get("stages") or {}).get("stages") or {}
+    if stages:
+        with_cpu = any("cpuMs" in d for d in stages.values())
+        parts = [(f"{s} {d.get('wallMs', 0):.1f}/"
+                  f"{d.get('cpuMs', 0):.2f}ms" if "cpuMs" in d else
+                  f"{s} {d.get('wallMs', 0):.1f}ms")
+                 for s, d in sorted(stages.items(),
+                                    key=lambda kv:
+                                    -kv[1].get("wallMs", 0))]
+        out.append(("    stages (wall/cpu): " if with_cpu else
+                    "    stages (wall): ") + " ".join(parts))
+    notes = dict(rec.get("notes") or {})
+    notes.update((rec.get("stages") or {}).get("notes") or {})
+    if notes:
+        out.append("    notes: " + " ".join(
+            f"{k}={json.dumps(v, separators=(',', ':'))}"
+            if isinstance(v, (dict, list)) else f"{k}={v}"
+            for k, v in sorted(notes.items())))
+    return out
+
+
+@command("cluster.slow")
+def cmd_cluster_slow(env: CommandEnv, args: list[str]) -> str:
+    """The cluster's tail, after the fact: every node's flight
+    recorder ring (/debug/slow, profiling.FlightRecorder) fanned out,
+    merged by trace id, and rendered as the top-N slowest END-TO-END
+    requests — one block per request with each hop's wall/cpu/wait
+    split, stage decomposition, deadline budget+verdict and
+    hedge/QoS/native-plane notes, then the merged cross-role span
+    tree, time-aligned like trace.show.  `-top=N` blocks (default 5),
+    `-verdict=slow|error|deadline|shed` filters on any hop's verdict,
+    `-nodes=host:port,...` adds listeners the topology doesn't know,
+    `-clear` empties every ring instead (chaos runs reset between
+    scenarios).  A node whose scrape fails mid-fan-out is noted and
+    skipped — mid-incident is exactly when one wedged node must not
+    take the whole view down."""
+    opts = _parse_flags(args)
+    try:
+        top = max(1, int(opts.get("top", 5)))
+    except ValueError:
+        return "bad -top"
+    want = opts.get("verdict", "")
+    nodes = _top_nodes(env, opts)
+
+    if "clear" in opts:
+        def clear(url: str) -> "tuple[str, bool]":
+            try:
+                r = http_json("POST", f"{url}/debug/slow",
+                              {"clear": True}, timeout=5)
+                return url, isinstance(r, dict) and "error" not in r
+            except OSError:
+                return url, False
+        with ThreadPoolExecutor(max_workers=min(8, len(nodes))) as ex:
+            results = dict(ex.map(clear, nodes))
+        ok = sum(1 for v in results.values() if v)
+        out = [f"cluster.slow — cleared {ok}/{len(nodes)} rings"]
+        out.extend(f"  {u}: unreachable" for u, v in results.items()
+                   if not v)
+        return "\n".join(out)
+
+    def fetch(url: str) -> "tuple[str, dict | None]":
+        try:
+            r = http_json("GET", f"{url}/debug/slow", timeout=5)
         except OSError:
-            prof = None
-        if isinstance(prof, dict) and prof.get("stacks"):
-            total = max(1, prof["stacks"])
-            for stack, n in sorted(prof.get("folded", {}).items(),
-                                   key=lambda kv: -kv[1]):
-                leaf = stack.rsplit(";", 2)[-2:]
-                out.append(f"  prof {n / total * 100:4.1f}% "
-                           f"{';'.join(leaf)}")
+            return url, None
+        return url, r if isinstance(r, dict) and "records" in r \
+            else None
+
+    with ThreadPoolExecutor(max_workers=min(8, len(nodes))) as ex:
+        snaps = dict(ex.map(fetch, nodes))
+
+    # merge by trace id: the same end-to-end request appears in each
+    # hop's ring under one id; records with no id stand alone
+    groups: "dict[str, list[tuple[str, dict]]]" = {}
+    captured = 0
+    skipped: list[str] = []
+    loose = 0
+    seen_recs: "set[str]" = set()
+    for url in nodes:
+        snap = snaps.get(url)
+        if snap is None:
+            skipped.append(f"  {url}: scrape failed, skipped")
+            continue
+        for rec in snap.get("records", []):
+            if not isinstance(rec, dict):
+                continue
+            # one recorder answering under two addresses (a node
+            # listed both by the topology and -nodes=, or an
+            # in-process multi-role rig sharing one ring) must not
+            # double every hop of every request it captured
+            fp = json.dumps(rec, sort_keys=True,
+                            separators=(",", ":"))
+            if fp in seen_recs:
+                continue
+            seen_recs.add(fp)
+            captured += 1
+            tid = rec.get("traceId") or ""
+            if not tid:
+                loose += 1
+                tid = f"(no-trace-{loose})"
+            groups.setdefault(tid, []).append((url, rec))
+    if want:
+        groups = {tid: hops for tid, hops in groups.items()
+                  if any(r.get("verdict") == want for _u, r in hops)}
+
+    # end-to-end wall = the slowest hop's wall (the edge's record
+    # covers its downstream hops); rank the groups by it
+    def group_wall(hops: "list[tuple[str, dict]]") -> float:
+        return max(r.get("wallMs", 0.0) for _u, r in hops)
+
+    ranked = sorted(groups.items(), key=lambda kv: -group_wall(kv[1]))
+    out = [f"cluster.slow — {captured} records on "
+           f"{sum(1 for u in nodes if snaps.get(u) is not None)}"
+           f"/{len(nodes)} nodes, "
+           f"{len(groups)} distinct requests"
+           + (f" (verdict={want})" if want else "")
+           + f", top {min(top, len(ranked))}"]
+    out.extend(skipped)
+    for tid, hops in ranked[:top]:
+        # a hop with a terminal verdict names the incident better
+        # than "slow"; surface the worst one in the header
+        verdicts = {r.get("verdict", "slow") for _u, r in hops}
+        headline = next((v for v in ("deadline", "error", "shed")
+                         if v in verdicts), "slow")
+        out.append(f"{group_wall(hops):9.1f}ms  trace={tid}  "
+                   f"verdict={headline}  {len(hops)} hop(s)")
+        spans: "dict[str, dict]" = {}
+        for url, rec in sorted(hops,
+                               key=lambda ur: -ur[1].get("wallMs", 0)):
+            try:
+                out.extend(_render_slow_hop(url, rec))
+            except Exception as e:  # noqa: BLE001 — one malformed
+                # record must not hide the rest of the request
+                out.append(f"  {url}: record render failed: {e}")
+            for s in rec.get("spans") or []:
+                if isinstance(s, dict) and s.get("spanId"):
+                    s.setdefault("node", url)
+                    spans.setdefault(s["spanId"], s)
+        if spans:
+            tree = render_trace(
+                sorted(spans.values(), key=lambda s: s["start"]))
+            out.extend("  " + t for t in tree.splitlines())
+    if len(out) == 1 + len(skipped):
+        out.append("  (no records — rings empty or filtered out)")
     return "\n".join(out)
 
 

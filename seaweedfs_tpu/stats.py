@@ -170,19 +170,104 @@ class Metrics:
 PROCESS = Metrics("seaweedfs_tpu")
 
 
+def _proc_tree_sample() -> "tuple[float, float, int] | None":
+    """(cpu_seconds, rss_bytes, process_count) for this process's
+    whole /proc subtree — pre-fork SO_REUSEPORT workers and native
+    plane children included, transitively.  One /proc pass builds the
+    ppid map; the walk is in-memory.  None where /proc is absent
+    (non-Linux); self's cutime/cstime ride along so already-reaped
+    children (a restarted native plane) stay accounted.
+
+    Root selection: SEAWEEDFS_TPU_TREE_ROOT when set AND alive (the
+    filer pre-fork parent exports its own pid before spawning
+    SO_REUSEPORT siblings, so a scrape the kernel routed to any ONE
+    worker still reports the whole fleet), else this process."""
+    import os
+    me = os.getpid()
+    try:
+        me = int(os.environ.get("SEAWEEDFS_TPU_TREE_ROOT", "") or me)
+    except ValueError:
+        pass
+    try:
+        clk = os.sysconf("SC_CLK_TCK")
+        page = os.sysconf("SC_PAGE_SIZE")
+        names = os.listdir("/proc")
+    except (OSError, ValueError, AttributeError):
+        return None
+    info: "dict[int, tuple[int, float, float, float]]" = {}
+    for d in names:
+        if not d.isdigit():
+            continue
+        try:
+            with open(f"/proc/{d}/stat", "rb") as f:
+                raw = f.read(4096)
+            # fields after the ")" of comm (proc(5)): [1]=ppid,
+            # [11]=utime, [12]=stime, [13]=cutime, [14]=cstime,
+            # [21]=rss pages
+            parts = raw.rsplit(b") ", 1)[1].split()
+            info[int(d)] = (
+                int(parts[1]),
+                (int(parts[11]) + int(parts[12])) / clk,
+                (int(parts[13]) + int(parts[14])) / clk,
+                int(parts[21]) * page)
+        except (OSError, IndexError, ValueError):
+            continue
+    if me not in info:
+        # stale TREE_ROOT (pre-fork parent died): degrade to self
+        me = os.getpid()
+        if me not in info:
+            return None
+    kids: "dict[int, list[int]]" = {}
+    for pid, (ppid, _c, _rc, _r) in info.items():
+        kids.setdefault(ppid, []).append(pid)
+    cpu = rss = 0.0
+    count = 0
+    stack, seen = [me], set()
+    while stack:
+        pid = stack.pop()
+        if pid in seen or pid not in info:
+            continue
+        seen.add(pid)
+        _ppid, own, reaped, mem = info[pid]
+        cpu += own + reaped
+        rss += mem
+        count += 1
+        stack.extend(kids.get(pid, ()))
+    return cpu, rss, count
+
+
 def render_process() -> str:
     # process CPU, refreshed per scrape — operator visibility
     # (cluster.top / any Prometheus scrape can divide its delta by
-    # request-rate deltas per node).  NOTE: bench.py's per-role CPU
-    # attribution deliberately reads /proc process TREES instead —
-    # a per-process gauge cannot cover the filer's pre-fork workers.
-    # os.times() covers every thread and costs ~1us.
+    # request-rate deltas per node).  os.times() covers every thread
+    # and costs ~1us; the TREE gauges below close the gap this
+    # per-process number used to leave open: a filer in -workers mode
+    # answers each scrape from ONE random SO_REUSEPORT worker, and
+    # the native write/read planes are separate child processes — the
+    # /proc subtree walk charges all of them to the listener the
+    # operator actually scraped.
     import os
     t = os.times()
     PROCESS.gauge_set(
         "process_cpu_seconds", t[0] + t[1],
         help_text="user+system CPU consumed by this process "
                   "(cumulative; exported as a gauge)")
+    tree = _proc_tree_sample()
+    if tree is not None:
+        cpu, rss, count = tree
+        PROCESS.gauge_set(
+            "process_tree_cpu_seconds", round(cpu, 3),
+            help_text="user+system CPU of this process's whole /proc "
+                      "subtree (pre-fork workers + native plane "
+                      "children; cumulative, refreshed per scrape)")
+        PROCESS.gauge_set(
+            "process_tree_rss_bytes", rss,
+            help_text="resident set of this process's whole /proc "
+                      "subtree (shared pages double-counted across "
+                      "forked workers)")
+        PROCESS.gauge_set(
+            "process_tree_procs", float(count),
+            help_text="processes in this node's /proc subtree")
     return PROCESS.render()
 
 

@@ -185,27 +185,36 @@ class Span:
         self.finish()
 
 
-def _record(doc: dict) -> None:
-    global _buffer
-    threshold = slow_ms()
+def _slow_log_and_sample(doc: dict, threshold: float,
+                         rate: float) -> bool:
+    """The per-doc half of recording, shared by _record and
+    emit_span_batch: the slow log fires regardless of sampling (a
+    dropped-from-buffer span that took 4s is still operator-
+    actionable), then the sampling gate decides whether the doc is
+    kept."""
     if threshold > 0 and doc["durationMs"] >= threshold:
-        # the slow log fires regardless of sampling: a dropped-from-
-        # buffer span that took 4s is still operator-actionable
         from .util import wlog
         wlog.warning(
             "slow span %s (%s) %.1fms trace=%s span=%s attrs=%s",
             doc["name"], doc["role"] or "-", doc["durationMs"],
             doc["traceId"], doc["spanId"], doc.get("attrs") or {},
             component="trace")
-    rate = sample_rate()
-    if rate < 1.0 and random.random() >= rate:
-        return
+    return not (rate < 1.0 and random.random() >= rate)
+
+
+def _buffer_extend(docs) -> None:
+    global _buffer
     with _buffer_lock:
         if _buffer.maxlen != buffer_size():
             # env knob changed since import (tests): rebuild, keeping
             # the newest spans
             _buffer = deque(_buffer, maxlen=buffer_size())
-        _buffer.append(doc)
+        _buffer.extend(docs)
+
+
+def _record(doc: dict) -> None:
+    if _slow_log_and_sample(doc, slow_ms(), sample_rate()):
+        _buffer_extend((doc,))
 
 
 def start_span(name: str, role: str = "", parent: "str | None" = None,
@@ -260,6 +269,39 @@ def emit_span(name: str, start: float, duration: float,
         doc["attrs"] = dict(attrs)
     _record(doc)
     return doc
+
+
+def emit_span_batch(items: "list[dict]") -> None:
+    """Batch emit_span for a stage track's sibling spans: the
+    slow-log / sample-rate / buffer-size knobs are env lookups and
+    were read three times PER SPAN through emit_span — on a
+    stage-tracked write that made them the tracer's dominant hot-path
+    cost.  Each item carries emit_span's kwargs (name, start,
+    duration, role, parent, trace_id, attrs, error)."""
+    if not items:
+        return
+    cur = _current.get()
+    threshold = slow_ms()
+    rate = sample_rate()
+    out = []
+    for it in items:
+        doc = {
+            "traceId": it.get("trace_id") or (cur[0] if cur else "")
+            or get_request_id() or secrets.token_hex(8),
+            "spanId": new_span_id(),
+            "parentId": it.get("parent") or (cur[1] if cur else ""),
+            "role": it.get("role") or (cur[2] if cur else ""),
+            "name": it["name"], "start": it["start"],
+            "durationMs": round(it["duration"] * 1e3, 3)}
+        if it.get("error"):
+            doc["error"] = True
+        attrs = it.get("attrs")
+        if attrs:
+            doc["attrs"] = dict(attrs)
+        if _slow_log_and_sample(doc, threshold, rate):
+            out.append(doc)
+    if out:
+        _buffer_extend(out)
 
 
 # -- context / propagation helpers ----------------------------------------

@@ -60,11 +60,20 @@ class _CacheMeter:
     def __init__(self, name: "str | None"):
         self.name = name
 
+    # literal mint names (SWFS017): the event set is closed, and a
+    # typo'd `which` fails loud here instead of minting a new family
+    _COUNTERS = {
+        "hits": "read_cache_hits_total",
+        "misses": "read_cache_misses_total",
+        "evictions": "read_cache_evictions_total",
+        "invalidations": "read_cache_invalidations_total",
+    }
+
     def count(self, which: str, n: float = 1.0) -> None:
         if not self.name:
             return
         _process().counter_add(
-            f"read_cache_{which}_total", n,
+            self._COUNTERS[which], n,
             help_text=f"hot read-cache {which} (shared tier, "
                       f"util/chunk_cache)", cache=self.name)
 

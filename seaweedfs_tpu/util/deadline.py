@@ -73,12 +73,15 @@ class DeadlineExceeded(OSError):
 
 
 class Deadline:
-    """Monotonic expiry; cheap to query, immutable."""
+    """Monotonic expiry; cheap to query, immutable.  `budget` keeps
+    the ORIGINAL grant so the flight recorder can report what this
+    hop was given at ingress, not just what was left at the end."""
 
-    __slots__ = ("expires_at",)
+    __slots__ = ("expires_at", "budget")
 
     def __init__(self, budget_s: float):
-        self.expires_at = time.monotonic() + max(float(budget_s), 0.0)
+        self.budget = max(float(budget_s), 0.0)
+        self.expires_at = time.monotonic() + self.budget
 
     def remaining(self) -> float:
         return max(0.0, self.expires_at - time.monotonic())

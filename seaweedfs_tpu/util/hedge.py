@@ -273,6 +273,12 @@ def hedged_fetch(primary, secondary, threshold_s: float, is_success,
                     "hedges_issued_total", 1.0,
                     help_text="secondary replica fetches issued past "
                               "the latency threshold", kind=kind)
+                # flight-recorder note (profiling.flight_note rides
+                # the CALLER's context — this loop runs on the
+                # handler/request thread, only the fetches are pooled)
+                _flight_note("hedge", {
+                    "kind": kind, "issued": True, "won": False,
+                    "thresholdMs": round(threshold_s * 1e3, 2)})
                 run(1, secondary)
                 outstanding += 1
                 continue
@@ -288,8 +294,16 @@ def hedged_fetch(primary, secondary, threshold_s: float, is_success,
                     "hedges_won_total", 1.0,
                     help_text="hedged fetches that answered first",
                     kind=kind)
+                _flight_note("hedge", {
+                    "kind": kind, "issued": True, "won": True,
+                    "thresholdMs": round(threshold_s * 1e3, 2)})
             return val, hedged
     return None, hedged
+
+
+def _flight_note(key: str, value) -> None:
+    from .. import profiling
+    profiling.flight_note(key, value)
 
 
 def _metrics():

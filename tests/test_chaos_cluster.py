@@ -541,3 +541,119 @@ def test_expired_deadline_504s_with_zero_volume_dispatch(cluster):
 def http_json_status(method, url, payload: bytes):
     from seaweedfs_tpu.server.httpd import http_bytes
     return http_bytes(method, url, payload, None, 10)
+
+
+# -- scenario 10: flight recorder — cluster.slow on a wedged replica ------
+
+def test_cluster_slow_renders_wedged_replica_flight(cluster, tmp_path,
+                                                    monkeypatch):
+    """The ISSUE 15 chaos proof: with a delay armed on the volume
+    serve paths, a deadline-carrying write through a replicated filer
+    504s (its chunk upload parks behind the wedge) and a
+    deadline-carrying read burns its budget on two wedged hedge legs
+    — and `cluster.slow` renders each incident as ONE cross-role
+    block: per-hop wall/cpu/wait split, stage decomposition, deadline
+    budget+verdict, the hedge flight note, and the merged span
+    tree."""
+    from seaweedfs_tpu import profiling
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.httpd import http_bytes
+    from seaweedfs_tpu.util import deadline as dl
+    from seaweedfs_tpu.util import hedge
+
+    monkeypatch.setenv("SEAWEEDFS_TPU_HEDGE_MIN_MS", "5")
+    # every warm-up read must traverse the volume fleet: the hedge
+    # threshold and the recorder's slow threshold both feed off real
+    # volume round trips, and a chunk-cache hit would starve them
+    monkeypatch.setenv("SEAWEEDFS_TPU_READ_CACHE_MB", "0")
+    hedge.reset()
+    _park_native_planes(cluster)
+    fs = FilerServer(cluster.master_url,
+                     store_path=str(tmp_path / "flight-filer.db"),
+                     replication="001").start()
+    try:
+        st, _, _ = http_bytes("POST", f"{fs.url}/chaosflight/warm.bin",
+                              b"w" * 2048, timeout=10)
+        assert st == 201
+        # forget earlier scenarios' latency history (the in-process
+        # rig shares one recorder): scenario 8's 2s wedged reads
+        # would inflate the p95 capture threshold past the 500ms
+        # walls this scenario must capture
+        profiling.flight_recorder().reset()
+        # warm: LatencyTracker wants >=32 healthy samples before the
+        # hedge threshold / slow-capture threshold arm
+        for _ in range(40):
+            st, body, _ = http_bytes(
+                "GET", f"{fs.url}/chaosflight/warm.bin", timeout=10)
+            assert st == 200 and body == b"w" * 2048
+        assert hedge.read_threshold() is not None
+        assert profiling.flight_recorder().threshold() is not None
+
+        # wedge EVERY replica's serve path (in-process roles share
+        # one faults registry; only this scenario's traffic runs)
+        chaos.arm(cluster.servers[0].http.url,
+                  "volume.write.serve=delay,ms=1200")
+        chaos.arm(cluster.servers[0].http.url,
+                  "volume.read.serve=delay,ms=1200")
+
+        # write arm: the chunk upload parks behind the wedge until
+        # the 500ms budget dies -> the filer's 504 is captured with
+        # verdict=deadline; the wedged volume hop joins the group
+        # when its serve finally finishes
+        st, _, _ = http_bytes(
+            "POST", f"{fs.url}/chaosflight/wedged.bin", b"x" * 2048,
+            {dl.HEADER: "500"}, timeout=10)
+        assert st == 504
+        # read arm: the hedge fires at the p95 threshold, both legs
+        # park behind the wedge, the budget dies mid-stream -> the
+        # hedge-issued note rides the filer hop's slow capture
+        try:
+            http_bytes("GET", f"{fs.url}/chaosflight/warm.bin", None,
+                       {dl.HEADER: "500"}, timeout=10)
+        except OSError:
+            pass   # stream died with the budget — expected shape
+
+        # the wedged volume serves outlive their clients; wait for a
+        # wedged-wall volume record to land before rendering
+        end = time.time() + 20
+        while time.time() < end:
+            r = http_json("GET", f"{cluster.master_url}/debug/slow",
+                          timeout=10)
+            if any(rec.get("wallMs", 0) > 1100 and
+                   rec.get("role") == "volume"
+                   for rec in r.get("records", [])):
+                break
+            time.sleep(0.25)
+        else:
+            raise AssertionError("wedged volume serve never captured")
+        faults.reset()
+
+        env = CommandEnv(cluster.master_url, filer=fs.url)
+        out = run_command(env, "cluster.slow -top=50")
+        blocks = out.split("ms  trace=")
+        wedged = [b for b in blocks
+                  if "/chaosflight/wedged.bin" in b]
+        assert wedged, out
+        blk = wedged[0]
+        # the write incident, as one block: cross-role hops under one
+        # trace id, the budget and its verdict, the cpu/wait split,
+        # the stage decomposition and the merged span tree
+        assert "verdict=deadline" in blk, blk
+        assert "filer@" in blk and "volume@" in blk, blk
+        assert "deadline=500ms" in blk, blk
+        assert "ms wall /" in blk and "(wait" in blk, blk
+        assert "stages (wall/cpu):" in blk, blk
+        assert "span(s)" in blk and "role(s)" in blk, blk
+        # the read incident: the hedge the budget paid for is in the
+        # filer hop's notes
+        hedged = [b for b in blocks
+                  if "hedge={" in b and "/chaosflight/warm.bin" in b]
+        assert hedged, out
+        assert '"issued":true' in hedged[0], hedged[0]
+        # the verdict filter narrows the view to the incident
+        outd = run_command(env, "cluster.slow -verdict=deadline")
+        assert "/chaosflight/wedged.bin" in outd
+    finally:
+        faults.reset()
+        _unpark_native_planes(cluster)
+        fs.stop()

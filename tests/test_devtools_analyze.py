@@ -1110,3 +1110,75 @@ def test_swfs016_noqa_suppresses():
 def test_swfs016_repo_is_clean(package_findings):
     assert [f for f in package_findings
             if f.rule == "SWFS016"] == []
+
+# -- SWFS017: metric name built dynamically at the mint site --------------
+
+def test_swfs017_flags_fstring_name():
+    src = """
+    def serve(m, vid):
+        m.counter_add(f"reads_{vid}_total", 1.0)
+    """
+    found = check(src, "SWFS017")
+    assert len(found) == 1
+    assert "label" in found[0].message
+
+
+def test_swfs017_flags_concat_format_and_mod():
+    src = """
+    def mint(m, kind):
+        m.gauge_set("prefix_" + kind, 2.0)
+        m.histogram_observe("stage_%s_seconds" % kind, 0.5)
+        m.counter_add("ops_{}_total".format(kind), 1.0)
+    """
+    assert len(check(src, "SWFS017")) == 3
+
+
+def test_swfs017_resolves_scope_local_name():
+    src = """
+    def mint(m, vid):
+        hist = f"{vid}_stage_seconds"
+        m.histogram_observe(hist, 0.5)
+    """
+    assert len(check(src, "SWFS017")) == 1
+
+
+def test_swfs017_literal_and_label_pass():
+    src = """
+    def serve(m, vid, d, ms):
+        m.counter_add("reads_total", 1.0, vid=vid)
+        g = "device_h2d_gbps" if d == "h2d" else "device_d2h_gbps"
+        m.gauge_set(g, 1.0)
+        for key, gauge in (("in_use", "mem_in_use_bytes"),
+                           ("peak", "mem_peak_bytes")):
+            if key in ms:
+                m.gauge_set(gauge, float(ms[key]))
+    """
+    assert check(src, "SWFS017") == []
+
+
+def test_swfs017_outer_scope_binding_not_evidence():
+    # a dynamic name bound in the OUTER scope is the outer scope's
+    # problem; the inner function's own literal stays clean
+    src = """
+    def outer(m, vid):
+        name = f"x_{vid}"
+        def inner():
+            m.counter_add("fixed_total", 1.0)
+        return inner
+    """
+    assert check(src, "SWFS017") == []
+
+
+def test_swfs017_noqa_suppresses():
+    src = """
+    def finish(self):
+        hist = f"{self.name}_stage_seconds"
+        self.metrics.histogram_observe(  # noqa: SWFS017 — code-site
+            hist, 0.5, stage="total")
+    """
+    assert check(src, "SWFS017") == []
+
+
+def test_swfs017_repo_is_clean(package_findings):
+    assert [f for f in package_findings
+            if f.rule == "SWFS017"] == []

@@ -305,7 +305,8 @@ def _role_urls(c) -> "list[tuple[str, str]]":
 
 
 @pytest.mark.parametrize("endpoint", ["/metrics", "/debug/health",
-                                      "/debug/traces", "/debug/pprof"])
+                                      "/debug/traces", "/debug/pprof",
+                                      "/debug/slow"])
 def test_every_role_serves_debug_plane(cluster, endpoint):
     """The uniform debug surface: every role answers every endpoint
     with a parseable document."""
@@ -326,6 +327,8 @@ def test_every_role_serves_debug_plane(cluster, endpoint):
                 assert "peers" in doc, role
             elif endpoint == "/debug/traces":
                 assert "spans" in doc, role
+            elif endpoint == "/debug/slow":
+                assert "records" in doc and "ringSize" in doc, role
             else:
                 assert doc["running"] is False, \
                     f"{role}: profiler must be off by default"
@@ -418,3 +421,136 @@ def test_cluster_top_renders_live_view(cluster):
         assert url in out, f"{role} missing from cluster.top"
     assert "[master]" in out and "[volume_server]" in out \
         and "[filer]" in out
+
+
+def test_stage_cpu_and_tree_gauges_exported(cluster):
+    """ISSUE 15 acceptance: after real writes, every write-path
+    role's /metrics carries the stage-CPU histograms beside the wall
+    ones, the per-request CPU histogram, and the /proc process-tree
+    gauges."""
+    from seaweedfs_tpu import operation
+    for i in range(4):
+        # named needles stay on the Python volume write path (stage
+        # tracks live there); the filer POSTs mint the filer funnel's
+        # stages
+        operation.submit(cluster.master, b"cpu" * 512,
+                         name=f"cpu{i}.bin")
+        st, _, _ = http_bytes(
+            "POST", f"{cluster.filer}/stagecpu/f{i}.bin", b"c" * 2048,
+            timeout=10)
+        assert st == 201
+    # assignment spreads the writes across the volume fleet: require
+    # the stage families on at least one volume server, the request-
+    # cpu histogram + tree gauges on every role scraped
+    targets = [(cluster.filer, "filer", True)] + [
+        (p.url, "volume_server", False)
+        for name, p in cluster.procs.items()
+        if name.startswith("volume")]
+    staged_volumes = 0
+    for url, ns, required in targets:
+        st, body, _ = http_bytes("GET", f"{url}/metrics", timeout=10)
+        assert st == 200
+        parsed = profiling.parse_prom_text(body.decode())
+        wall = profiling.prom_histogram(
+            parsed, f"{ns}_write_stage_seconds", {"stage": "total"})
+        cpu = profiling.prom_histogram(
+            parsed, f"{ns}_write_stage_cpu_seconds",
+            {"stage": "total"})
+        if wall and wall["count"] > 0:
+            assert cpu and cpu["count"] > 0, f"{ns}: no cpu stages"
+            # sanity, not equality: the cpu histogram holds only the
+            # SAMPLED subset while wall holds every track, and this
+            # sandbox's thread-CPU clock is quantized coarsely enough
+            # to overshoot wall on a single short request — the guard
+            # here is against unit errors (ns-vs-s), so allow slack
+            assert cpu["sum"] <= wall["sum"] * 2.0 + 0.1, \
+                (ns, cpu, wall)
+            if ns == "volume_server":
+                staged_volumes += 1
+        elif required:
+            raise AssertionError(f"{ns}@{url}: no wall stages")
+        assert f"{ns}_request_cpu_seconds_count" in parsed, (ns, url)
+        assert "seaweedfs_tpu_process_tree_cpu_seconds" in parsed, ns
+        assert "seaweedfs_tpu_process_tree_rss_bytes" in parsed, ns
+    assert staged_volumes >= 1, "no volume server minted stage cpu"
+
+
+def test_cluster_slow_renders_cross_role_tree(cluster):
+    """The flight-recorder acceptance path: a deadline-killed write
+    is captured on the filer, and cluster.slow renders its record —
+    verdict, wall/cpu split, and the merged span tree."""
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    from seaweedfs_tpu.util import deadline as dl
+    env = CommandEnv(cluster.master, filer=cluster.filer)
+    run_command(env, "cluster.slow -clear")
+    st, _, _ = http_bytes(
+        "POST", f"{cluster.filer}/slowtest/never.bin", b"x" * 1024,
+        {dl.HEADER: "0"}, timeout=10)
+    assert st == 504
+    out = run_command(env, "cluster.slow -top=3")
+    assert "cluster.slow" in out
+    assert "verdict=deadline" in out, out
+    assert "/slowtest/never.bin" in out, out
+    assert "deadline=0ms" in out, out
+    # a slow-but-ok request joins it after the ring warms; the
+    # deadline verdict filter narrows to the incident
+    filtered = run_command(env, "cluster.slow -verdict=deadline")
+    assert "/slowtest/never.bin" in filtered
+
+
+def test_cluster_commands_skip_unreachable_node(cluster):
+    """Satellite: a node whose scrape fails mid-fan-out costs a
+    rendered note, never the whole cluster view."""
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    env = CommandEnv(cluster.master, filer=cluster.filer)
+    dead = "127.0.0.1:9"        # discard port: nothing listens
+    top = run_command(env, f"cluster.top -interval=0.3 -nodes={dead}")
+    assert f"{dead}: unreachable" in top
+    assert "[filer]" in top     # the live nodes still rendered
+    slow = run_command(env, f"cluster.slow -nodes={dead}")
+    assert f"{dead}: scrape failed, skipped" in slow
+
+
+def test_cluster_top_contains_node_render_failure(cluster,
+                                                  monkeypatch):
+    """A node whose metrics parse but whose render trips (truncated
+    scrape, role skew) is skipped with a note."""
+    from seaweedfs_tpu.shell import CommandEnv, commands, run_command
+
+    def explode(url, b, a, window):
+        raise ValueError("malformed cell")
+
+    monkeypatch.setattr(commands, "_render_node_top", explode)
+    env = CommandEnv(cluster.master, filer=cluster.filer)
+    out = run_command(env, "cluster.top -interval=0.3")
+    assert "render failed: malformed cell" in out
+    assert "cluster.top" in out          # header still rendered
+
+
+def test_cluster_top_renders_cpu_line(cluster):
+    """The cost-attribution line: under live traffic the window sees
+    request CPU vs wall and the process-tree burn."""
+    from seaweedfs_tpu import operation
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    stop = threading.Event()
+
+    def writer() -> None:
+        i = 0
+        while not stop.is_set():
+            try:
+                operation.submit(cluster.master, b"t" * 2048,
+                                 name=f"cpuline{i}.bin")
+            except OSError:
+                time.sleep(0.02)
+            i += 1
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        env = CommandEnv(cluster.master, filer=cluster.filer)
+        out = run_command(env, "cluster.top -interval=1.5")
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert "cpu:" in out, out
+    assert "tree=" in out, out
