@@ -1275,6 +1275,95 @@ class DynamicMetricNameRule(Rule):
                     f"constant family with a reason)")
 
 
+class UnguardedMetaLogAppendRule(Rule):
+    """SWFS018: a Python `MetaLog.append`/`append_raw` call reachable
+    from the filer's hot-path handlers without the meta-plane guard.
+
+    The native meta plane (native/meta_plane.cc, ISSUE 17) only arms
+    when `Filer.meta_plane` exists: armed, the C++ plane is the WAL
+    appender for hot-path creates, and the Python side's only legal
+    hot-path commit is `MetaPlane.commit` (whose appender half lives
+    in filer/meta_plane.py and stays exempt).  A direct
+    `meta_log.append(...)` in the filer front or server is therefore
+    correct ONLY on the meta-plane-less fallback branch — anywhere
+    else it would put a second, GIL-bound appender back on the armed
+    hot path, with its own wid and its own barrier, silently undoing
+    the plane's zero-Python contract.  Flagged: any `*.meta_log
+    .append`/`.append_raw` call in the filer front/server modules not
+    enclosed in an `if` whose test names `meta_plane` (the arming
+    gate).  Replay/boot helpers that run before the plane exists keep
+    their direct append under `# noqa: SWFS018` with a reason."""
+
+    id = "SWFS018"
+    severity = "error"
+    title = "MetaLog append reachable from the armed filer hot path"
+
+    _FILES = ("seaweedfs_tpu/filer/filer.py",
+              "seaweedfs_tpu/server/filer_server.py")
+    _APPENDS = {"append", "append_raw"}
+
+    @staticmethod
+    def _names_meta_plane(test: ast.AST) -> bool:
+        for n in ast.walk(test):
+            if isinstance(n, ast.Attribute) and \
+                    n.attr == "meta_plane":
+                return True
+            if isinstance(n, ast.Name) and n.id == "meta_plane":
+                return True
+        return False
+
+    def check(self, ctx: FileContext):
+        rel = ctx.relpath.replace("\\", "/")
+        if not any(rel.endswith(f) for f in self._FILES):
+            return
+        parents: dict = {}
+        for parent in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr not in self._APPENDS:
+                continue
+            if "meta_log" not in _dotted(node.func):
+                continue
+            cur: ast.AST = node
+            guarded = False
+            while cur in parents and not guarded:
+                parent = parents[cur]
+                if isinstance(parent, ast.If) and \
+                        self._names_meta_plane(parent.test):
+                    guarded = True
+                    break
+                # early-return guard style: a PRECEDING statement in
+                # the same suite tested meta_plane and returned (`if
+                # self.meta_plane is not None: return ...commit(...)`)
+                # — the append after it is the fallback branch
+                for field in ("body", "orelse", "finalbody"):
+                    stmts = getattr(parent, field, None)
+                    if isinstance(stmts, list) and cur in stmts:
+                        guarded = any(
+                            isinstance(prev, ast.If) and
+                            self._names_meta_plane(prev.test)
+                            for prev in stmts[:stmts.index(cur)])
+                        break
+                if isinstance(parent, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    break       # a guard outside the function is not
+                cur = parent    # evidence about this call site
+            if guarded:
+                continue
+            yield self.finding(
+                ctx, node,
+                f"{_dotted(node.func)}(...) appends to the metalog "
+                f"outside the meta-plane guard — armed, the native "
+                f"meta plane owns the hot-path WAL, so a direct "
+                f"Python append belongs only on the `if meta_plane "
+                f"is None` fallback branch (or noqa a boot/replay "
+                f"helper with a reason)")
+
+
 RULES = [
     LockDisciplineRule(),
     JitBlockingRule(),
@@ -1293,4 +1382,5 @@ RULES = [
     FilerHotPathCommitRule(),
     BareTimeoutLiteralRule(),
     DynamicMetricNameRule(),
+    UnguardedMetaLogAppendRule(),
 ]

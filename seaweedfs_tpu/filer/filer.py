@@ -291,6 +291,16 @@ class Filer:
         mc = self.meta_cache
         if mc is None:
             return self.store.find_entry(path)
+        if mc.known_absent(path):
+            # negative-directory fast path (ROADMAP 1b): the parent is
+            # a tracked fresh directory and this name was never
+            # touched — provably no entry, skip the store SELECT that
+            # every create otherwise pays to prove old_entry is None.
+            # (Runs AFTER the plane overlay above: anything a sibling
+            # durably committed before this read began was either
+            # served from the overlay or has point-invalidated the
+            # name into the parent's poison set via the follower.)
+            return None
         from .meta_cache import _MISS
         hit = mc.lookup_entry(path)
         if hit is not _MISS:

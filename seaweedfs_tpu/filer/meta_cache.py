@@ -99,6 +99,18 @@ class FilerMetaCache:
         self._epoch = 0
         self._processed = 0     # own-instance event cursor
         self._meter = _CacheMeter(name)
+        # negative-directory cache (ROADMAP 1b): dir ->
+        # (fill_watermark, set-of-child-names-that-might-exist).  A
+        # directory lands here when we SEE its fresh creation (op=
+        # create, no old entry) — at that instant it is provably
+        # empty, so any child name never added to the set is provably
+        # ABSENT and the old-entry store SELECT on its create can be
+        # skipped.  Coherence rides the exact mechanisms above: every
+        # invalidation (own synchronous listener, sibling follower
+        # point-invalidations in plane mode) adds the touched name to
+        # its parent's set, and in watermark mode the fill stamp
+        # additionally kills the record on any foreign commit.
+        self._fresh_dirs: "OrderedDict[str, tuple]" = OrderedDict()
 
     # -- fill protocol -----------------------------------------------
 
@@ -145,6 +157,63 @@ class FilerMetaCache:
             while len(self._entries) > self._cap:
                 self._entries.popitem(last=False)
 
+    # -- negative-directory cache (ROADMAP 1b) -------------------------
+
+    MAX_FRESH_DIRS = 512
+    MAX_FRESH_CHILDREN = 65536
+
+    def mark_fresh_dir(self, path: str) -> None:
+        """`path` was just created as a brand-new directory (no prior
+        entry): start tracking it as provably-empty-except-what-we-
+        see.  Called from the event listener, AFTER the create is
+        durable."""
+        wm = self._probe()
+        with self._lock:
+            self._fresh_dirs.pop(path, None)
+            self._fresh_dirs[path] = (wm, set())
+            while len(self._fresh_dirs) > self.MAX_FRESH_DIRS:
+                self._fresh_dirs.popitem(last=False)
+
+    def known_absent(self, path: str) -> bool:
+        """True when `path` provably has no entry: its parent is a
+        tracked fresh directory, no commit we could have missed has
+        happened since tracking began, and the name was never touched.
+        A True return makes the caller skip the old-entry store SELECT
+        entirely — the negative-cache fast path on the create-heavy
+        workload."""
+        parent, _, name = path.rpartition("/")
+        parent = parent or "/"
+        probe = self._probe()
+        with self._lock:
+            rec = self._fresh_dirs.get(parent)
+            if rec is not None and self._valid(rec[0], probe) \
+                    and name not in rec[1]:
+                hit = True
+            else:
+                hit = False
+        from ..stats import PROCESS
+        PROCESS.counter_add(
+            "filer_meta_negative_dir_total", 1.0,
+            help_text="negative-directory-cache consults on the "
+                      "create path (hit = old-entry SELECT skipped)",
+            result="hit" if hit else "miss")
+        return hit
+
+    def _note_child_locked(self, path: str) -> None:
+        """Any touch of `path` (create/update/delete, own or sibling)
+        poisons its name in the parent's fresh-dir set, and drops the
+        path's own fresh-dir record (a foreign event on a tracked dir
+        means we no longer know it)."""
+        self._fresh_dirs.pop(path, None)
+        parent, _, name = path.rpartition("/")
+        rec = self._fresh_dirs.get(parent or "/")
+        if rec is None:
+            return
+        if len(rec[1]) >= self.MAX_FRESH_CHILDREN:
+            self._fresh_dirs.pop(parent or "/", None)
+        else:
+            rec[1].add(name)
+
     # -- listings ------------------------------------------------------
 
     def lookup_list(self, key: tuple):
@@ -184,6 +253,7 @@ class FilerMetaCache:
         with self._lock:
             self._epoch += 1
             self._entries.pop(path, None)
+            self._note_child_locked(path)
             dropped = 0
             for d in (parent, path):
                 for key in self._dir_keys.pop(d, ()):  # noqa: B909
@@ -199,6 +269,12 @@ class FilerMetaCache:
             e = ev.get(side)
             if e:
                 self.invalidate(e.get("fullPath", ""))
+        new = ev.get("newEntry")
+        if new and new.get("isDirectory") and \
+                ev.get("op") == "create" and not ev.get("oldEntry"):
+            # a FRESH directory create (no prior entry) is the one
+            # event that proves a dir empty — start negative tracking
+            self.mark_fresh_dir(new.get("fullPath", ""))
         ts = int(ev.get("tsNs", 0))
         with self._lock:
             if ts > self._processed:
@@ -210,6 +286,7 @@ class FilerMetaCache:
             self._entries.clear()
             self._lists.clear()
             self._dir_keys.clear()
+            self._fresh_dirs.clear()
 
     # -- introspection (tests / debug) ---------------------------------
 
@@ -217,5 +294,6 @@ class FilerMetaCache:
         with self._lock:
             return {"entries": len(self._entries),
                     "lists": len(self._lists),
+                    "freshDirs": len(self._fresh_dirs),
                     "epoch": self._epoch,
                     "processed": self._processed}

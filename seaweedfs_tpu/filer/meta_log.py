@@ -91,6 +91,34 @@ def _segment_name(ts_ns: int) -> "tuple[str, str]":
             f"{t.tm_hour:02d}-{t.tm_min:02d}")
 
 
+def alloc_writer_identity(dir_path: str) -> "tuple[str, str]":
+    """Mint a (wid, watermark_path) pair for an EXTERNAL sibling
+    writer over `dir_path` — the native meta plane (native/
+    meta_plane.cc) appends WAL lines as its own writer instance, so it
+    needs the same uniqueness guarantees a MetaLog gives itself: the
+    per-process seq (two writers in one pid must not clobber one
+    watermark file) and the random wid suffix (pid recycling must not
+    make a follower skip a dead instance's lines as its own).
+
+    The watermark file is pre-created here via the same tmp + atomic
+    replace first-publish protocol as MetaLog._write_watermark, seeded
+    at 0 (conservative: readers treat it as "nothing durable yet"), so
+    the native side's publish path is a bare pwrite from byte one."""
+    import binascii
+    with _WM_SEQ_LOCK:
+        _WM_SEQ[0] += 1
+        seq = _WM_SEQ[0]
+    wid = (f"{os.getpid()}-{seq}-"
+           f"{binascii.hexlify(os.urandom(3)).decode()}")
+    wm_path = os.path.join(dir_path, f".watermark.{os.getpid()}.{seq}")
+    os.makedirs(dir_path, exist_ok=True)
+    tmp = f"{wm_path}.tmp"
+    with open(tmp, "w", encoding="ascii") as f:
+        f.write(_format_wm(0))
+    os.replace(tmp, wm_path)
+    return wid, wm_path
+
+
 def strip_wal_fields(event: dict) -> dict:
     """Drop the WAL-plumbing fields a persisted line carries (`nl` =
     newEntry length for the applier's byte-reuse slice, `wid` = writer

@@ -405,13 +405,20 @@ def _records(batch: list) -> "tuple[list, int]":
 
 
 def _note_sub(stage: str, seconds: float) -> None:
+    # per-stage observers resolved once (stats.Metrics.observer,
+    # ROADMAP 1d): this runs on every meta commit's ack path
     from ..stats import META_SUB_BUCKETS, PROCESS
-    PROCESS.histogram_observe(
-        "filer_meta_sub_seconds", seconds, buckets=META_SUB_BUCKETS,
-        help_text="filer meta-commit sub-stage wall: serialize (entry "
-                  "-> WAL bytes, once), barrier (metalog group-commit "
-                  "= the ack), apply (async store transaction, "
-                  "per-event share)", stage=stage)
+    obs = PROCESS.obs_memo.get(("filer_meta_sub_seconds", stage))
+    if obs is None:
+        obs = PROCESS.obs_memo[("filer_meta_sub_seconds", stage)] = \
+            PROCESS.observer(
+                "filer_meta_sub_seconds", buckets=META_SUB_BUCKETS,
+                help_text="filer meta-commit sub-stage wall: "
+                          "serialize (entry -> WAL bytes, once), "
+                          "barrier (metalog group-commit = the ack), "
+                          "apply (async store transaction, per-event "
+                          "share)", stage=stage)
+    obs(seconds)
 
 
 class MetaPlane:
@@ -424,6 +431,11 @@ class MetaPlane:
         self.log = meta_log
         self.dir = meta_log.dir
         self.cache = None          # FilerMetaCache, wired by Filer
+        # optional tap on the coherence follower: called (outside the
+        # overlay/cursor locks) with every batch of SIBLING events the
+        # follower ingests — the native meta plane driver uses it to
+        # track foreign directory truth (server/meta_plane_native.py)
+        self.sink = None
         self._interval = plane_interval_s() if interval is None \
             else interval
         self._olock = threading.Lock()
@@ -572,6 +584,7 @@ class MetaPlane:
         if self._stop.is_set():
             return
         inv = None
+        evs = None
         with self._clock:
             if self._cursor.probe():
                 evs = self._cursor.poll()
@@ -579,11 +592,20 @@ class MetaPlane:
                     with self._olock:
                         inv = self._ingest_events_locked(evs)
         self._invalidate(inv)
+        self._drain_sink(evs)
 
     def _ingest(self, batch: list) -> None:
         with self._olock:
             inv = self._ingest_events_locked(batch)
         self._invalidate(inv)
+        self._drain_sink(batch)
+
+    def _drain_sink(self, evs) -> None:
+        if evs and self.sink is not None:
+            try:
+                self.sink(evs)
+            except Exception:  # noqa: SWFS004 — the tap is advisory;
+                pass           # coherence never depends on it
 
     def _invalidate(self, paths) -> None:
         if paths and self.cache is not None:

@@ -225,6 +225,57 @@ def load_write_plane() -> "ctypes.CDLL | None":
         return _wp_lib
 
 
+# -- meta-plane library (meta_plane.cc) --------------------------------
+
+_MP_SRC = os.path.join(_DIR, "meta_plane.cc")
+_MP_SO = os.path.join(_DIR, "_build", "libmeta_plane.so")
+_mp_lib = None
+_mp_tried = False
+
+
+def load_meta_plane() -> "ctypes.CDLL | None":
+    """Build (if needed) + load the native filer meta plane; None when
+    unavailable — the filer then serves every write from Python (the
+    same graceful-degradation contract as the volume write plane)."""
+    global _mp_lib, _mp_tried
+    with _lock:
+        if _mp_lib is not None or _mp_tried:
+            return _mp_lib
+        _mp_tried = True
+        try:
+            if _build_if_stale(_MP_SRC, _MP_SO) is None:
+                return None
+            lib = ctypes.CDLL(_MP_SO)
+            lib.mp_start.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int)]
+            lib.mp_start.restype = ctypes.c_int
+            lib.mp_stop.argtypes = [ctypes.c_int]
+            lib.mp_arm.argtypes = [ctypes.c_int, ctypes.c_int]
+            lib.mp_feed_fids.argtypes = [ctypes.c_int, ctypes.c_char_p]
+            lib.mp_feed_fids.restype = ctypes.c_int
+            lib.mp_fid_level.argtypes = [ctypes.c_int]
+            lib.mp_fid_level.restype = ctypes.c_int
+            lib.mp_mark_dir.argtypes = [ctypes.c_int, ctypes.c_char_p]
+            lib.mp_mark_path.argtypes = [ctypes.c_int, ctypes.c_char_p]
+            lib.mp_clear_dirs.argtypes = [ctypes.c_int]
+            lib.mp_requests.argtypes = [ctypes.c_int]
+            lib.mp_requests.restype = ctypes.c_ulonglong
+            lib.mp_fallbacks.argtypes = [ctypes.c_int]
+            lib.mp_fallbacks.restype = ctypes.c_ulonglong
+            lib.mp_latency.argtypes = [
+                ctypes.c_int, ctypes.POINTER(ctypes.c_ulonglong)]
+            lib.mp_latency.restype = ctypes.c_int
+            lib.mp_stats.argtypes = [
+                ctypes.c_int, ctypes.POINTER(ctypes.c_ulonglong)]
+            lib.mp_stats.restype = ctypes.c_int
+        except (OSError, subprocess.SubprocessError):
+            return None
+        _mp_lib = lib
+        return _mp_lib
+
+
 _VT_SRC = os.path.join(os.path.dirname(__file__), "volume_tool.cc")
 _VT_BIN = os.path.join(_DIR, "_build", "volume_tool")
 

@@ -571,31 +571,41 @@ class StageTrack:
         hist = f"{self.name}_stage_seconds"
         cpu_hist = f"{self.name}_stage_cpu_seconds"
         if self.metrics is not None:
-            for stage, rec in stages.items():
-                secs, _calls, _w0, cpu = rec[0], rec[1], rec[2], rec[3]
-                self.metrics.histogram_observe(  # noqa: SWFS017 — the
-                    # track name is a code-site constant ("write"),
-                    # never request-derived; cardinality is bounded by
-                    # the set of track() call sites
-                    hist, secs, buckets=STAGE_BUCKETS,
-                    help_text=f"per-request {self.name}-path stage "
-                              f"decomposition", stage=stage)
-                if cpu_on:
-                    self.metrics.histogram_observe(  # noqa: SWFS017 —
-                        # same code-site constant as above
-                        cpu_hist, cpu, buckets=STAGE_BUCKETS,
+            # pre-resolved observers (stats.Metrics.observer, ROADMAP
+            # 1d), memoized on the registry: StageTracks are
+            # per-request, so the memo must outlive them; track names
+            # are code-site constants ("write"), never request-
+            # derived, so cardinality stays bounded by the set of
+            # track() call sites x their stage names
+            memo = self.metrics.obs_memo
+            for stage, rec in list(stages.items()) + [("total", None)]:
+                if rec is None:
+                    secs, cpu = total, total_cpu
+                else:
+                    secs, cpu = rec[0], rec[3]
+                obs = memo.get((hist, stage))
+                if obs is None:
+                    obs = memo[(hist, stage)] = self.metrics.observer(
+                        # noqa: SWFS017 — code-site constant, above
+                        hist, buckets=STAGE_BUCKETS,
                         help_text=f"per-request {self.name}-path "
-                                  f"stage CPU (thread_time, sampled "
-                                  f"— see SEAWEEDFS_TPU_CPU_SAMPLE); "
-                                  f"wall minus this is GIL/lock/"
-                                  f"syscall wait", stage=stage)
-            self.metrics.histogram_observe(  # noqa: SWFS017 — as above
-                hist, total, buckets=STAGE_BUCKETS, stage="total")
-            if cpu_on:
-                self.metrics.histogram_observe(  # noqa: SWFS017 — as
-                    # above
-                    cpu_hist, total_cpu, buckets=STAGE_BUCKETS,
-                    stage="total")
+                                  f"stage decomposition", stage=stage)
+                obs(secs)
+                if cpu_on:
+                    cobs = memo.get((cpu_hist, stage))
+                    if cobs is None:
+                        cobs = memo[(cpu_hist, stage)] = \
+                            self.metrics.observer(
+                                # noqa: SWFS017 — as above
+                                cpu_hist, buckets=STAGE_BUCKETS,
+                                help_text=f"per-request {self.name}-"
+                                          f"path stage CPU (thread_"
+                                          f"time, sampled — see SEA"
+                                          f"WEEDFS_TPU_CPU_SAMPLE); "
+                                          f"wall minus this is GIL/"
+                                          f"lock/syscall wait",
+                                stage=stage)
+                    cobs(cpu)
         if self.trace_ctx and stages:
             from . import tracing
             role = self.role or self.trace_ctx[2]

@@ -164,6 +164,39 @@ class FilerServer:
             # then hangs) connections with no server behind it
             self.http.abort()
             raise
+        # native META plane (native/meta_plane.cc — the filer-side
+        # sibling of the volume write plane): plain single-chunk PUTs
+        # into provably-fresh directories are parsed, uploaded to the
+        # volume write plane, WAL-appended and acked by a C++ epoll
+        # loop; everything else 404s and the client falls back to this
+        # port.  Kill switch SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE=0;
+        # requires the Python meta plane (the WAL protocol owner).
+        self.native_meta = None
+        if self.filer.meta_plane is not None:
+            from .meta_plane_native import (NativeMetaPlane,
+                                            native_meta_plane_enabled)
+            if native_meta_plane_enabled() is not False:
+                try:
+                    mp_host = self.http.host if all(
+                        c in "0123456789." for c in self.http.host) \
+                        else "127.0.0.1"
+                    self.native_meta = NativeMetaPlane(
+                        self.filer.meta_log.dir, master, host=mp_host,
+                        collection=collection,
+                        replication=replication)
+                except (RuntimeError, OSError):
+                    self.native_meta = None  # pure-Python fallback
+        if self.native_meta is not None:
+            # directory truth flows in from both sides: this process's
+            # own Python-path mutations (listener) and every sibling
+            # writer's WAL lines (the meta plane's follower tap)
+            self.filer.subscribe(self.native_meta.on_event)
+            self.filer.meta_plane.sink = \
+                self.native_meta.on_follower_events
+            self.native_meta.arm(True)
+        self.http.route("GET", "/status", self._status)
+        self.http.route("POST", "/debug/meta_plane",
+                        self._debug_meta_plane)
         self.http.route("GET", "/__meta__/lookup", self._meta_lookup)
         self.http.route("POST", "/__meta__/rename", self._meta_rename)
         self.http.route("POST", "/__meta__/set_attrs",
@@ -322,8 +355,96 @@ class FilerServer:
                           "covers")
         from ..stats import render_process
         return 200, ((self.metrics.render() +
+                      self._native_meta_metrics_text() +
                       render_process()).encode(),
                      "text/plain; version=0.0.4")
+
+    def _native_meta_metrics_text(self) -> str:
+        """Native meta-plane counters rendered straight from the C++
+        atomics at scrape time (the plane has no Python on its hot
+        path): requests/fallbacks/fid pool, the ack latency histogram,
+        and the per-stage wall split (parse / upload / wal) that keeps
+        cluster.slow able to attribute a tail request that crossed the
+        native plane."""
+        nm = self.native_meta
+        if nm is None:
+            return ""
+        st = nm.stats()
+        out = []
+        for key, help_text in (
+                ("requests", "filer writes acked by the native meta "
+                             "plane"),
+                ("fallbacks", "native meta-plane requests answered "
+                              "404 (python filer owns them)"),
+                ("fid_misses", "native requests that fell back on an "
+                               "empty pre-assigned fid pool"),
+                ("wal_errors", "group-commit batches that failed the "
+                               "WAL append (every member fell back)"),
+                ("upstream_errors", "chunk uploads the volume write "
+                                    "plane refused or dropped"),
+                ("wal_batches", "group-commit barrier batches landed"),
+                ("wal_lines", "WAL lines landed by the native plane")):
+            name = f"filer_meta_plane_native_{key}_total"
+            out.append(f"# HELP {name} {help_text}\n"
+                       f"# TYPE {name} counter\n"
+                       f"{name} {st[key]}\n")
+        out.append("# HELP filer_meta_plane_native_stage_seconds_total"
+                   " cumulative native-plane wall per stage\n"
+                   "# TYPE filer_meta_plane_native_stage_seconds_total"
+                   " counter\n")
+        for stage in ("parse", "upload", "wal"):
+            out.append(f"filer_meta_plane_native_stage_seconds_total"
+                       f'{{stage="{stage}"}} '
+                       f"{st[stage + '_ns'] / 1e9}\n")
+        out.append("# HELP filer_meta_plane_native_fid_level "
+                   "pre-assigned fids ready in the native pool\n"
+                   "# TYPE filer_meta_plane_native_fid_level gauge\n"
+                   f"filer_meta_plane_native_fid_level "
+                   f"{max(nm.fid_level(), 0)}\n")
+        from .meta_plane_native import ACK_BUCKETS_S
+        buckets, count, total_s = nm.ack_histogram()
+        out.append("# HELP filer_meta_plane_native_ack_seconds "
+                   "native meta-plane ack latency\n"
+                   "# TYPE filer_meta_plane_native_ack_seconds "
+                   "histogram\n")
+        for le, cum in zip(ACK_BUCKETS_S, buckets):
+            out.append(f"filer_meta_plane_native_ack_seconds_bucket"
+                       f'{{le="{le}"}} {cum}\n')
+        out.append(f"filer_meta_plane_native_ack_seconds_bucket"
+                   f'{{le="+Inf"}} {count}\n'
+                   f"filer_meta_plane_native_ack_seconds_sum "
+                   f"{total_s}\n"
+                   f"filer_meta_plane_native_ack_seconds_count "
+                   f"{count}\n")
+        return "".join(out)
+
+    def _status(self, req: Request):
+        """Plane discovery (the volume server's /status precedent):
+        lean clients probe this once per process and pin their hot
+        PUTs to the native meta-plane port."""
+        nm = self.native_meta
+        return 200, {"version": "seaweedfs-tpu/0.1",
+                     "role": "filer",
+                     "metaPlanePort":
+                         nm.port if nm is not None and nm.armed else 0}
+
+    def _debug_meta_plane(self, req: Request):
+        """The PR 11 native_on/native_off lever, filer edition:
+        POST /debug/meta_plane {"native": "on"|"off"} arms/disarms the
+        native meta plane without tearing down its listener (clients
+        keep their sockets; every request 404s to Python while off)."""
+        nm = self.native_meta
+        if nm is None:
+            return 404, {"error": "native meta plane not running"}
+        b = req.json() if req.body else {}
+        want = str(b.get("native", "")).lower()
+        if want in ("on", "1", "true"):
+            nm.arm(True)
+        elif want in ("off", "0", "false"):
+            nm.arm(False)
+        return 200, {"armed": nm.armed, "port": nm.port,
+                     "fidLevel": max(nm.fid_level(), 0),
+                     **nm.stats()}
 
     def start(self):
         self.http.start()
@@ -366,6 +487,10 @@ class FilerServer:
             self._notifier.stop()
         if getattr(self, "grpc_server", None) is not None:
             self.grpc_server.stop(grace=0.5)
+        if getattr(self, "native_meta", None) is not None:
+            # before the Python listener: once the native port stops
+            # acking, clients retry here and must still find a server
+            self.native_meta.stop()
         self.http.stop()
         # meta plane first (final async apply), then store + metalog
         self.filer.close()

@@ -280,6 +280,11 @@ class HttpServer:
         # parked on a slow disk" at a glance
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        # per-(method, code) pre-resolved request histogram observers
+        # (stats.Metrics.observer, ROADMAP 1d): the middleware below
+        # observes two histograms on EVERY request, and the label-set
+        # space is tiny (~methods x codes) — resolve each cell once
+        self._req_obs: dict = {}
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -508,21 +513,31 @@ class HttpServer:
                     if outer.metrics is not None:
                         outer.metrics.gauge_set(
                             "requests_in_flight", inflight)
-                        outer.metrics.histogram_observe(
-                            "request_seconds", sp.duration,
-                            help_text="HTTP request handling latency",
-                            method=req.method, code=str(status))
+                        cell = (req.method, status)
+                        obs = outer._req_obs.get(cell)
+                        if obs is None:
+                            obs = outer._req_obs[cell] = (
+                                outer.metrics.observer(
+                                    "request_seconds",
+                                    help_text="HTTP request handling "
+                                              "latency",
+                                    method=req.method,
+                                    code=str(status)),
+                                outer.metrics.observer(
+                                    "request_cpu_seconds",
+                                    buckets=_prof.STAGE_BUCKETS,
+                                    help_text="handler-thread CPU per "
+                                              "request (thread_time, "
+                                              "sampled — see SEAWEED"
+                                              "FS_TPU_CPU_SAMPLE); "
+                                              "request_seconds minus "
+                                              "this is GIL/lock/IO "
+                                              "wait",
+                                    method=req.method,
+                                    code=str(status)))
+                        obs[0](sp.duration)
                         if cpu is not None:
-                            outer.metrics.histogram_observe(
-                                "request_cpu_seconds", cpu,
-                                buckets=_prof.STAGE_BUCKETS,
-                                help_text="handler-thread CPU per "
-                                          "request (thread_time, "
-                                          "sampled — see SEAWEEDFS_"
-                                          "TPU_CPU_SAMPLE); request_"
-                                          "seconds minus this is "
-                                          "GIL/lock/IO wait",
-                                method=req.method, code=str(status))
+                            obs[1](cpu)
                     # ALWAYS drain the finished-track summary: tracks
                     # run whether or not the recorder is armed, and a
                     # summary left behind while disarmed would be

@@ -1,0 +1,272 @@
+"""Native filer meta-plane wrapper (native/meta_plane.cc).
+
+The filer's second implementation of the plain-file WRITE surface —
+the metadata sibling of server/write_plane.py: a C++ epoll loop that
+parses the PUT, uploads the single chunk straight to the volume write
+plane (C++ -> C++, pipelined persistent connections), frames the
+metalog WAL line byte-identically to MetaLog.append_raw, lands the
+batch with one O_APPEND write per segment run, publishes the
+watermark, and acks `201 {"name":..,"size":..}` — zero Python per
+request.
+
+By protocol the plane is just another SIBLING WRITER over the shared
+metalog dir: it owns a wid + watermark file minted through
+meta_log.alloc_writer_identity, and its lines reach the unmodified
+PR 12 machinery (overlay followers, flock-elected applier,
+checkpointing) exactly like a pre-fork sibling's.  On the Python side
+this wrapper supplies the three things the C++ loop cannot cheaply do
+itself:
+
+* a FEEDER thread that batches master assigns and pushes derived
+  "addr fid" pairs into the plane's pool (one Python round trip
+  amortized over ~hundreds of native requests);
+* DIRECTORY knowledge: the filer's own events (via Filer.subscribe)
+  and sibling/follower events (via MetaPlane.sink) mark fresh
+  directories native-eligible and mark every foreign path ineligible,
+  so the plane only ever acks op="create" for provably-new paths;
+* the METRICS bridge rendered on the filer's /metrics.
+
+Failure contract: construction returns None-equivalent via
+RuntimeError at the call site's try/except; at runtime every
+ineligible or doomed request answers the 404 fallback and the client
+retries the Python filer port.  SIGKILL at any instant leaves acked
+lines durable (the ack is queued only after write(2) returned) and
+unacked lines absent-or-torn — torn tails are the WAL's normal
+crash debris and the follower/applier skip them.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+from .. import native, operation
+from ..filer.meta_log import alloc_writer_identity
+from ..storage.types import FileId, parse_needle_id_cookie
+from ..util import wlog
+
+# ack latency histogram bucket bounds (meta_plane.cc kLatBuckets), in
+# seconds — rendered on /metrics as filer_meta_plane_native_ack_seconds
+ACK_BUCKETS_S = (1e-6, 2e-6, 5e-6, 1e-5, 2e-5, 5e-5, 1e-4, 2e-4,
+                 5e-4, 1e-3, 2e-3, 5e-3, 1e-2, 2e-2, 5e-2, 1e-1, 1.0)
+
+# feeder targets: refill toward HIGH once the pool drops under LOW.
+# One assign(count=_ASSIGN_N) buys _ASSIGN_N native acks, so the
+# steady-state Python cost is ~1/256th of a request each.
+_POOL_LOW = 192
+_POOL_HIGH = 512
+_ASSIGN_N = 256
+
+_STATS_KEYS = ("requests", "fallbacks", "fid_misses", "wal_errors",
+               "upstream_errors", "parse_ns", "upload_ns", "wal_ns",
+               "wal_batches", "wal_lines")
+
+
+def native_meta_plane_enabled() -> "bool | None":
+    """SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE: '0' forces off, '1'
+    forces on, unset/other = auto (on when the meta plane is on and
+    the toolchain builds the .so)."""
+    v = os.environ.get("SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE", "")
+    if v == "0":
+        return False
+    if v == "1":
+        return True
+    return None
+
+
+class NativeMetaPlane:
+    """One native meta-plane server bound to <host>:<ephemeral>,
+    appending into `meta_log_dir` as its own writer instance."""
+
+    def __init__(self, meta_log_dir: str, master: str,
+                 host: str = "127.0.0.1", collection: str = "",
+                 replication: str = "",
+                 feed_interval: float = 0.05):
+        self._lib = native.load_meta_plane()
+        if self._lib is None:
+            raise RuntimeError("native meta plane unavailable")
+        self.wid, self.wm_path = alloc_writer_identity(meta_log_dir)
+        port = ctypes.c_int(0)
+        self._h = self._lib.mp_start(
+            host.encode(), 0, meta_log_dir.encode(),
+            self.wid.encode(), self.wm_path.encode(),
+            ctypes.byref(port))
+        if self._h < 0:
+            raise RuntimeError("native meta plane failed to start")
+        self.host = host
+        self.port = port.value
+        self.master = master
+        self.collection = collection
+        self.replication = replication
+        self._stop = threading.Event()
+        self._armed = False
+        self._feeder = threading.Thread(
+            target=self._feed_loop, args=(feed_interval,), daemon=True)
+        self._feeder.start()
+
+    # -- arming ---------------------------------------------------------
+
+    def arm(self, on: bool = True) -> None:
+        """The PR 11 native_on/native_off lever: disarmed, the
+        listener stays up but every request answers the 404 fallback
+        (clients keep their conns; Python serves)."""
+        self._armed = bool(on)
+        self._lib.mp_arm(self._h, 1 if on else 0)
+
+    @property
+    def armed(self) -> bool:
+        return self._armed
+
+    # -- directory knowledge (called from filer listener + plane sink) --
+
+    def mark_dir(self, path: str) -> None:
+        """`path` was created fresh (op=create, isDirectory) — its
+        direct children become native-eligible."""
+        try:
+            self._lib.mp_mark_dir(self._h, path.encode())
+        except (OSError, UnicodeEncodeError):
+            pass
+
+    def mark_path(self, path: str) -> None:
+        """`path` was written through any non-native route — future
+        native writes to it must fall back (overwrites are Python's)."""
+        try:
+            self._lib.mp_mark_path(self._h, path.encode())
+        except (OSError, UnicodeEncodeError):
+            pass
+
+    def clear_dirs(self) -> None:
+        """Delete/rename anywhere drops all knowledge — rare, always
+        safe, mirrors Filer._known_dirs.clear()."""
+        self._lib.mp_clear_dirs(self._h)
+
+    def on_event(self, event: dict) -> None:
+        """Filer listener (Filer.subscribe): this process's own
+        Python-path events — {op, tsNs, newEntry, oldEntry} dicts with
+        entry JSON payloads."""
+        try:
+            self._learn(event)
+        except Exception:  # noqa: SWFS004 — advisory knowledge only;
+            pass           # a miss means a fallback, never a bad ack
+
+    def _learn(self, ev: dict) -> None:
+        op = ev.get("op", "")
+        new = ev.get("newEntry")
+        old = ev.get("oldEntry")
+        if op in ("delete", "rename") and (
+                (new or {}).get("isDirectory") or
+                (old or {}).get("isDirectory")):
+            self.clear_dirs()
+        if not new:
+            return
+        path = new.get("fullPath", "")
+        if not path:
+            return
+        if new.get("isDirectory"):
+            # only a FRESH create proves the directory empty — an
+            # update (old != None) may shadow existing children
+            if op == "create" and old is None:
+                self.mark_dir(path)
+        else:
+            self.mark_path(path)
+
+    def on_follower_events(self, events) -> None:
+        """MetaPlane.sink: the coherence follower's raw poll batches —
+        (event, raw_new, pos, wid) tuples for every sibling writer's
+        WAL line, INCLUDING this plane's own (the cursor only
+        skip-scans the Python MetaLog's wid).  Own lines are harmless
+        here (mark_path re-inserts a name the C++ loop already holds),
+        so no wid filtering is needed."""
+        for item in events:
+            try:
+                self._learn(item[0] if isinstance(item, tuple)
+                            else item)
+            except Exception:  # noqa: SWFS004
+                pass
+
+    # -- fid feeder -----------------------------------------------------
+
+    def _feed_once(self) -> None:
+        level = self._lib.mp_fid_level(self._h)
+        if level < 0 or level >= _POOL_LOW or not self._armed:
+            return
+        lines = []
+        while level + len(lines) < _POOL_HIGH:
+            a = operation.assign(self.master, count=_ASSIGN_N,
+                                 collection=self.collection,
+                                 replication=self.replication)
+            if a.auth:
+                # jwt-gated cluster: the volume plane would refuse the
+                # bare native upload — leave the pool dry, every
+                # request falls back to the authenticated Python path
+                return
+            addr = operation._write_plane_addr_for(a.url)
+            if addr is None:
+                return  # no volume plane to pipe into; stay dry
+            vid_s, _, kc = a.fid.partition(",")
+            key, cookie = parse_needle_id_cookie(kc)
+            vid = int(vid_s)
+            n = max(1, int(a.count or 1))
+            lines.extend(
+                f"{addr} {FileId(vid, key + i, cookie)}"
+                for i in range(n))
+        if lines:
+            self._lib.mp_feed_fids(
+                self._h, ("\n".join(lines) + "\n").encode())
+
+    def _feed_loop(self, interval: float) -> None:
+        # Exponential backoff on feed failure: an unreachable master
+        # (filers booted against a fake or dead one — every in-process
+        # test does this) must cost a connect attempt every couple of
+        # seconds, not 20 times a second of CPU, log lines and error
+        # spans for the life of the process.  Success snaps back to
+        # the base interval so a recovered master refills promptly.
+        wait = interval
+        while not self._stop.wait(wait):
+            try:
+                self._feed_once()
+                wait = interval
+            except Exception as e:  # noqa: BLE001 — a dead master just
+                # means a dry pool (= fallbacks), never a dead feeder
+                wlog.debug(f"meta plane fid feed failed: {e!r}")
+                wait = min(max(wait * 2, interval), 2.0)
+
+    # -- telemetry ------------------------------------------------------
+
+    def requests(self) -> int:
+        return self._lib.mp_requests(self._h)
+
+    def fallbacks(self) -> int:
+        return self._lib.mp_fallbacks(self._h)
+
+    def fid_level(self) -> int:
+        return self._lib.mp_fid_level(self._h)
+
+    def stats(self) -> dict:
+        out = (ctypes.c_ulonglong * 16)()
+        n = self._lib.mp_stats(self._h, out)
+        if n <= 0:
+            return {k: 0 for k in _STATS_KEYS}
+        return {k: int(out[i]) for i, k in enumerate(_STATS_KEYS)}
+
+    def ack_histogram(self) -> "tuple[list[int], int, float]":
+        """(cumulative bucket counts aligned with ACK_BUCKETS_S + an
+        +Inf cell, total count, sum seconds)."""
+        out = (ctypes.c_ulonglong * 20)()
+        cells = self._lib.mp_latency(self._h, out)
+        if cells <= 0:
+            return [], 0, 0.0
+        buckets = [int(out[i]) for i in range(cells + 1)]
+        return buckets, int(out[cells + 1]), out[cells + 2] / 1e9
+
+    def stop(self) -> None:
+        """Feeder first, then the native server: mp_stop frees the
+        Server object, so no wrapper thread may still be inside an
+        mp_* call when it runs."""
+        if self._h < 0:
+            return
+        self._stop.set()
+        self._feeder.join(timeout=5)
+        self._lib.mp_stop(self._h)
+        self._h = -1

@@ -1245,10 +1245,10 @@ def _group_commit_report(before: "dict[str, list]",
 
 def _native_plane_report(before: "dict[str, list]",
                          after: "dict[str, list]") -> str:
-    """Native read/write plane view over the sampling window: acks
-    and fallbacks per plane plus the native ack-latency p99 (C++
-    atomics rendered by the volume server's /metrics).  Empty when
-    the node runs no native plane."""
+    """Native read/write/meta plane view over the sampling window:
+    acks and fallbacks per plane plus the native ack-latency p99 (C++
+    atomics rendered by the volume server's and filer's /metrics).
+    Empty when the node runs no native plane."""
     from .. import profiling
     parts = []
     wname = "volume_server_write_plane_ack_seconds"
@@ -1277,6 +1277,35 @@ def _native_plane_report(before: "dict[str, list]",
                      "volume_server_read_plane_fallbacks_total")
     if "volume_server_read_plane_requests_total" in after:
         parts.append(f"read {rr:.0f} served/{rf:.0f} fallback")
+    # the filer's native META plane (ISSUE 17): creates acked with
+    # zero Python, plus its ack-latency p99 and mean WAL batch
+    mname = "filer_meta_plane_native_ack_seconds"
+    mr = _counter_sum(
+        after, "filer_meta_plane_native_requests_total") - \
+        _counter_sum(before, "filer_meta_plane_native_requests_total")
+    mf = _counter_sum(
+        after, "filer_meta_plane_native_fallbacks_total") - \
+        _counter_sum(before,
+                     "filer_meta_plane_native_fallbacks_total")
+    if f"{mname}_count" in after:
+        h = profiling.histogram_delta(
+            profiling.prom_histogram(after, mname),
+            profiling.prom_histogram(before, mname))
+        p99 = profiling.histogram_quantile(h, 0.99) \
+            if h and h.get("count") else 0.0
+        batches = _counter_sum(
+            after, "filer_meta_plane_native_wal_batches_total") - \
+            _counter_sum(before,
+                         "filer_meta_plane_native_wal_batches_total")
+        lines = _counter_sum(
+            after, "filer_meta_plane_native_wal_lines_total") - \
+            _counter_sum(before,
+                         "filer_meta_plane_native_wal_lines_total")
+        seg = (f"meta {mr:.0f} acked/{mf:.0f} fallback"
+               f" ack-p99={p99 * 1e3:.2f}ms")
+        if batches > 0:
+            seg += f" wal-batch={lines / batches:.1f}"
+        parts.append(seg)
     if not parts:
         return ""
     return "native-planes: " + "  ".join(parts)

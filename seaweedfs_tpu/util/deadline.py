@@ -212,10 +212,17 @@ def adopt_deadline(d: "Deadline | None",
                    site: str = "") -> "Deadline | None":
     _current.set(d)
     if d is not None:
-        _metrics().histogram_observe(
-            "deadline_remaining_seconds", d.remaining(),
-            help_text="request budget remaining at ingress, per hop",
-            site=site or "?")
+        # per-site observers resolved once (stats.Metrics.observer,
+        # ROADMAP 1d): ingress stamping runs on every budgeted request
+        m = _metrics()
+        obs = m.obs_memo.get(("deadline_remaining_seconds", site))
+        if obs is None:
+            obs = m.obs_memo[("deadline_remaining_seconds", site)] = \
+                m.observer(
+                    "deadline_remaining_seconds",
+                    help_text="request budget remaining at ingress, "
+                              "per hop", site=site or "?")
+        obs(d.remaining())
     return d
 
 

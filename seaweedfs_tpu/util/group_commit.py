@@ -118,6 +118,10 @@ class CommitBarrier:
         # cumulative counters for cheap snapshots (tests, /debug)
         self.flushes = 0
         self.committed = 0
+        # histogram observers, resolved lazily on first use (stats
+        # imports util.* — resolving here would cycle at import time)
+        self._obs_wait = None
+        self._obs_flush = None
 
     # -- the one entry point ----------------------------------------------
 
@@ -199,25 +203,36 @@ class CommitBarrier:
     # -- telemetry --------------------------------------------------------
 
     def _note_wait(self, seconds: float) -> None:
-        from ..stats import GROUP_COMMIT_WAIT_BUCKETS
-        _metrics().histogram_observe(
-            "group_commit_wait_seconds", seconds,
-            buckets=GROUP_COMMIT_WAIT_BUCKETS,
-            help_text="time a writer waited on the shared durability "
-                      "barrier", site=self.site or "?")
+        # observers resolved once per site (stats.Metrics.observer,
+        # ROADMAP 1d): every barrier member pays this on its ack path
+        obs = self._obs_wait
+        if obs is None:
+            from ..stats import GROUP_COMMIT_WAIT_BUCKETS
+            obs = self._obs_wait = _metrics().observer(
+                "group_commit_wait_seconds",
+                buckets=GROUP_COMMIT_WAIT_BUCKETS,
+                help_text="time a writer waited on the shared "
+                          "durability barrier", site=self.site or "?")
+        obs(seconds)
 
     def _note_flush(self, n: int, leader_seconds: float) -> None:
-        from ..stats import (GROUP_COMMIT_BATCH_BUCKETS,
-                             GROUP_COMMIT_WAIT_BUCKETS)
         self.flushes += 1
         self.committed += n
-        m = _metrics()
-        m.histogram_observe(
-            "group_commit_batch_size", float(n),
-            buckets=GROUP_COMMIT_BATCH_BUCKETS,
-            help_text="writers covered per shared durability barrier "
-                      "(mean batch = sum/count)", site=self.site or "?")
-        m.histogram_observe(
-            "group_commit_wait_seconds", leader_seconds,
-            buckets=GROUP_COMMIT_WAIT_BUCKETS,
-            site=self.site or "?")
+        obs = self._obs_flush
+        if obs is None:
+            from ..stats import (GROUP_COMMIT_BATCH_BUCKETS,
+                                 GROUP_COMMIT_WAIT_BUCKETS)
+            m = _metrics()
+            obs = self._obs_flush = (
+                m.observer(
+                    "group_commit_batch_size",
+                    buckets=GROUP_COMMIT_BATCH_BUCKETS,
+                    help_text="writers covered per shared durability "
+                              "barrier (mean batch = sum/count)",
+                    site=self.site or "?"),
+                m.observer(
+                    "group_commit_wait_seconds",
+                    buckets=GROUP_COMMIT_WAIT_BUCKETS,
+                    site=self.site or "?"))
+        obs[0](float(n))
+        obs[1](leader_seconds)

@@ -1182,3 +1182,67 @@ def test_swfs017_noqa_suppresses():
 def test_swfs017_repo_is_clean(package_findings):
     assert [f for f in package_findings
             if f.rule == "SWFS017"] == []
+
+# -- SWFS018: MetaLog append reachable from the armed hot path ------------
+
+def test_swfs018_flags_unguarded_append():
+    src = """
+    class Filer:
+        def _notify(self, event):
+            return self.meta_log.append(event)
+    """
+    found = check_at(src, "SWFS018", "seaweedfs_tpu/filer/filer.py")
+    assert len(found) == 1
+    assert "meta-plane guard" in found[0].message
+
+
+def test_swfs018_guarded_fallback_passes():
+    src = """
+    class Filer:
+        def _notify(self, event):
+            if self.meta_plane is not None:
+                return self.meta_plane.commit(event)
+            return self.meta_log.append(event)
+
+        def _raw(self, op, new, old):
+            if not self.meta_plane:
+                return self.meta_log.append_raw(op, new, old)
+    """
+    assert check_at(src, "SWFS018",
+                    "seaweedfs_tpu/filer/filer.py") == []
+
+
+def test_swfs018_other_modules_and_appends_pass():
+    # meta_plane.py's own append_raw half is the designated armed-path
+    # appender; unrelated list.append never matches
+    src = """
+    class MetaPlane:
+        def commit(self, op, new, old):
+            return self.log.append_raw(op, new, old)
+
+    def collect(items, out):
+        out.append(items)
+    """
+    assert check_at(src, "SWFS018",
+                    "seaweedfs_tpu/filer/meta_plane.py") == []
+    src2 = """
+    def gather(self, out):
+        out.append(self.meta_log)
+    """
+    assert check_at(src2, "SWFS018",
+                    "seaweedfs_tpu/filer/filer.py") == []
+
+
+def test_swfs018_noqa_suppresses():
+    src = """
+    class Filer:
+        def _boot_replay(self, event):
+            return self.meta_log.append(event)  # noqa: SWFS018 — boot
+    """
+    assert check_at(src, "SWFS018",
+                    "seaweedfs_tpu/filer/filer.py") == []
+
+
+def test_swfs018_repo_is_clean(package_findings):
+    assert [f for f in package_findings
+            if f.rule == "SWFS018"] == []

@@ -1,0 +1,423 @@
+"""ISSUE 17: the native C++ meta plane beside the filer — WAL
+byte-compatibility and the ack contract under SIGKILL.
+
+The plane (native/meta_plane.cc) parses HTTP, uploads the chunk to
+the volume write plane, frames the metalog WAL record, and acks after
+a group-commit append — zero Python per request.  These tests prove
+the two load-bearing promises:
+
+* its WAL lines are byte-compatible with `MetaLog.append_raw`'s wire
+  format, so a MIXED native+Python log replays through the unmodified
+  PR 12 applier into the same store state;
+* the ack contract survives kill -9 mid-group-commit: every
+  201-acked create is readable after restart (WAL tail replay),
+  unacked creates never half-appear, and the Python front keeps
+  serving when the plane is disarmed or refuses a request.
+"""
+
+import json
+import os
+import re
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.server.httpd import http_bytes, http_json
+
+from proc_framework import Proc, ProcCluster, free_port
+
+from test_crash_durability import _Load, _unique_blob, _verify_parallel
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = ProcCluster(str(tmp_path_factory.mktemp("nmp")), volumes=1)
+    c.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            st = http_json("GET", f"{c.master}/cluster/status",
+                           timeout=5)
+            if len(st.get("dataNodes", [])) == 1:
+                break
+        except OSError:
+            pass
+        time.sleep(0.2)
+    yield c
+    c.stop()
+
+
+def _plane_port(filer_url: str, timeout: float = 20.0) -> int:
+    """Plane discovery via GET /status (0 = not armed).  Polls: the
+    plane arms right after construction, but the fid feeder and the
+    first /status can race the boot on this box."""
+    deadline = time.time() + timeout
+    port = 0
+    while time.time() < deadline:
+        try:
+            st = http_json("GET", f"{filer_url}/status", timeout=5)
+            port = int(st.get("metaPlanePort") or 0)
+            if port:
+                return port
+        except OSError:
+            pass
+        time.sleep(0.2)
+    return port
+
+
+def _native_post(plane_url: str, path: str, blob: bytes,
+                 retries: int = 40) -> int:
+    """POST through the plane port, retrying 404 fallbacks briefly —
+    the plane only accepts a path once it has LEARNED the parent dir
+    from the Python filer's event stream (listener or log follower),
+    which takes one follower tick at worst."""
+    st = 0
+    for _ in range(retries):
+        st, _, _ = http_bytes(
+            "POST", f"{plane_url}{path}", blob,
+            {"Content-Type": "application/octet-stream"}, timeout=10)
+        if st == 201:
+            return st
+        time.sleep(0.1)
+    return st
+
+
+# the append_raw wire format the C++ plane must reproduce byte-for-
+# byte: length prefix first, newEntry LAST so the applier can slice
+# the raw entry bytes off the line tail without re-serializing
+_LINE_RE = re.compile(
+    rb'^\{"nl":(\d+),"wid":"[^"]+","op":"[a-z]+","tsNs":(\d+),'
+    rb'"oldEntry":')
+
+
+def _wal_lines(metalog_dir: str) -> list:
+    """Every (raw_line, parsed_doc) across the metalog segments, in
+    file order."""
+    out = []
+    for root, _dirs, files in os.walk(metalog_dir):
+        for name in sorted(files):
+            if not name.endswith(".log"):
+                continue
+            with open(os.path.join(root, name), "rb") as f:
+                for line in f:
+                    if line.strip():
+                        out.append((line, json.loads(line)))
+    return out
+
+
+def test_wal_byte_compat_mixed_appends(cluster, tmp_path):
+    """Mixed native + Python appends in ONE metalog: every line obeys
+    the append_raw framing (nl length prefix slices the raw newEntry
+    off the tail), stamps are strictly monotonic per writer, and a
+    restart with the plane forced OFF replays the whole log through
+    the unmodified PR 12 applier into the sqlite store."""
+    store = os.path.join(str(tmp_path), "filer-nm.db")
+    fport = free_port()
+    args = ["filer", "-port", str(fport), "-master", cluster.master,
+            "-store", store]
+    log = os.path.join(str(tmp_path), "filer-nm.log")
+    # applier stalled: the WAL is the ONLY durable copy, so the
+    # replay below is a real test, not a no-op
+    stalled = Proc("filer-nm", args, fport, log,
+                   env_extra={
+                       "SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE": "1",
+                       "SEAWEEDFS_TPU_META_PLANE_INTERVAL_MS":
+                       "600000"})
+    stalled.start()
+    url = stalled.url
+    blobs: dict = {}
+    try:
+        pport = _plane_port(url)
+        if not pport:
+            stalled.stop()
+            pytest.skip("native meta plane unavailable in this image")
+        plane = f"127.0.0.1:{pport}"
+
+        # the Python front creates the parent dirs (and one entry);
+        # the plane learns them from the filer's event listener
+        seed = _unique_blob("mix-seed")
+        st, _, _ = http_bytes(
+            "POST", f"{url}/mix/a/seed", seed,
+            {"Content-Type": "application/octet-stream"}, timeout=10)
+        assert st < 300
+        blobs["/mix/a/seed"] = seed
+
+        for i in range(10):
+            nb = _unique_blob(f"native-{i}")
+            pb = _unique_blob(f"python-{i}")
+            assert _native_post(plane, f"/mix/a/n{i}", nb) == 201, \
+                "plane refused an eligible create"
+            st, _, _ = http_bytes(
+                "POST", f"{url}/mix/a/p{i}", pb,
+                {"Content-Type": "application/octet-stream"},
+                timeout=10)
+            assert st < 300
+            blobs[f"/mix/a/n{i}"] = nb
+            blobs[f"/mix/a/p{i}"] = pb
+
+        # an EXISTING name is not plane-eligible (old-entry semantics
+        # belong to Python): the plane must fall back, not overwrite
+        st, _, _ = http_bytes(
+            "POST", f"{plane}/mix/a/seed", b"dup",
+            {"Content-Type": "application/octet-stream"}, timeout=10)
+        assert st == 404, "plane accepted a duplicate name"
+
+        # -- wire-format invariants over the raw segment bytes ------
+        metalog_dir = store + ".metalog"
+        lines = _wal_lines(metalog_dir)
+        assert lines, "no WAL lines were appended"
+        per_wid: dict = {}
+        seen_paths = set()
+        for raw, doc in lines:
+            m = _LINE_RE.match(raw)
+            assert m, f"line framing mismatch: {raw[:80]!r}"
+            nl = int(m.group(1))
+            # the applier's contract (meta_log.append_raw): on the
+            # newline-stripped line, the slice [-(nl+1):-1] is the raw
+            # newEntry JSON, verbatim — reusable without re-serializing
+            stripped = raw.rstrip(b"\n")
+            tail = stripped[-(nl + 1):-1]
+            assert json.loads(tail) == doc["newEntry"], \
+                f"nl slice mismatch: {raw[:80]!r}"
+            per_wid.setdefault(doc["wid"], []).append(doc["tsNs"])
+            if doc.get("newEntry"):
+                seen_paths.add(doc["newEntry"]["fullPath"])
+        for wid, stamps in per_wid.items():
+            assert stamps == sorted(stamps), f"{wid} not monotonic"
+            assert len(set(stamps)) == len(stamps), \
+                f"{wid} stamps collided"
+        assert len(per_wid) >= 2, "expected native AND python writers"
+        assert set(blobs) <= seen_paths
+
+        # every entry is readable through the STALLED filer right now
+        # (overlay + plane learning): read-your-native-writes
+        def _check(item):
+            path, blob = item
+            st, body, _ = http_bytes("GET", f"{url}{path}", timeout=10)
+            assert st == 200, f"{path} unreadable pre-restart: {st}"
+            assert body == blob
+        _verify_parallel(blobs.items(), _check)
+    finally:
+        stalled.stop()
+
+    # -- replay through the unmodified applier, plane forced OFF ----
+    fresh = Proc("filer-nm", args, fport, log,
+                 env_extra={
+                     "SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE": "0"})
+    fresh.start()
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                st, _, _ = http_bytes("GET", f"{url}/mix/a/",
+                                      timeout=5)
+                if st == 200:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        st = http_json("GET", f"{url}/status", timeout=5)
+        assert not st.get("metaPlanePort"), "force-off was ignored"
+
+        def _check_replayed(item):
+            path, blob = item
+            st, body, _ = http_bytes("GET", f"{url}{path}", timeout=10)
+            assert st == 200, f"replayed entry {path} lost: {st}"
+            assert body == blob, f"replayed entry {path} corrupted"
+        _verify_parallel(blobs.items(), _check_replayed)
+
+        # wait for the applier to checkpoint past the whole log so
+        # the offline store probe below reads APPLIED state, not the
+        # overlay
+        from seaweedfs_tpu.filer.meta_plane import read_checkpoint
+        max_ts = max(doc["tsNs"]
+                     for _r, doc in _wal_lines(store + ".metalog"))
+        deadline = time.time() + 30
+        ck = None
+        while time.time() < deadline:
+            ck = read_checkpoint(store + ".metalog")
+            if ck is not None and ck[1] >= max_ts:
+                break
+            time.sleep(0.2)
+        assert ck is not None and ck[1] >= max_ts, \
+            f"applier never caught up: {ck} < {max_ts}"
+    finally:
+        fresh.stop()
+
+    # identical store state: the sqlite store itself (no filer, no
+    # overlay) holds every native- and Python-written entry
+    from seaweedfs_tpu.filer.filer_store import SqliteStore
+    probe = SqliteStore(store)
+    try:
+        for path in blobs:
+            assert probe.find_entry(path) is not None, \
+                f"{path} missing from the applied store"
+    finally:
+        probe.close()
+
+
+def test_plane_sigkill_acked_creates_survive(cluster, tmp_path):
+    """kill -9 the filer (and with it the in-process plane) mid
+    group-commit, applier stalled so the WAL tail is the only durable
+    copy: every plane-acked create must be readable after a restart
+    with the plane OFF (Python WAL replay), unacked creates are gone
+    or whole — mirrors test_crash_durability's contract across the
+    C++ boundary."""
+    store = os.path.join(str(tmp_path), "filer-nk.db")
+    fport = free_port()
+    args = ["filer", "-port", str(fport), "-master", cluster.master,
+            "-store", store]
+    log = os.path.join(str(tmp_path), "filer-nk.log")
+    victim = Proc("filer-nk", args, fport, log,
+                  env_extra={
+                      "SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE": "1",
+                      "SEAWEEDFS_TPU_META_PLANE_INTERVAL_MS":
+                      "600000"})
+    victim.start()
+    url = victim.url
+    attempted: dict = {}
+    att_lock = threading.Lock()
+    try:
+        pport = _plane_port(url)
+        if not pport:
+            pytest.skip("native meta plane unavailable in this image")
+        plane = f"127.0.0.1:{pport}"
+
+        st, _, _ = http_bytes(
+            "POST", f"{url}/nk/seed", _unique_blob("nk-seed"),
+            {"Content-Type": "application/octet-stream"}, timeout=10)
+        assert st < 300
+        assert _native_post(plane, "/nk/warm", _unique_blob("nk-warm"),
+                            ) == 201, "plane never became eligible"
+
+        def write(tag, blob):
+            path = f"/nk/{tag}"
+            with att_lock:
+                attempted[path] = blob
+            st, _, _ = http_bytes(
+                "POST", f"{plane}{path}", blob,
+                {"Content-Type": "application/octet-stream"},
+                timeout=10)
+            return path if st == 201 else None
+
+        load = _Load(write)
+        load.run_through_kill(victim, load_s=1.0)
+    finally:
+        victim.stop()            # reaps the SIGKILLed popen handle
+    assert load.acked, "no native writes were acked before the kill"
+
+    fresh = Proc("filer-nk", args, fport, log,
+                 env_extra={
+                     "SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE": "0"})
+    fresh.start()
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                st, _, _ = http_bytes("GET", f"{url}/nk/", timeout=5)
+                if st == 200:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+
+        # acked implies durable, byte-identical, through the PYTHON
+        # front (the plane is off — fallback serving is the point)
+        def _check_acked(item):
+            path, blob = item
+            st, body, _ = http_bytes("GET", f"{url}{path}", timeout=10)
+            assert st == 200, f"plane-acked create {path} lost: {st}"
+            assert body == blob, f"plane-acked {path} corrupted"
+        _verify_parallel(load.acked.items(), _check_acked)
+
+        # unacked implies absent-or-whole, never torn
+        def _check_unacked(item):
+            path, blob = item
+            if path in load.acked:
+                return
+            st, body, _ = http_bytes("GET", f"{url}{path}", timeout=10)
+            assert st in (200, 404)
+            if st == 200:
+                assert body == blob, f"torn create {path} served"
+        _verify_parallel(attempted.items(), _check_unacked)
+
+        # and the Python front still takes NEW writes with the plane
+        # gone — the fallback is a full-service path, not read-only
+        st, _, _ = http_bytes(
+            "POST", f"{url}/nk/after-kill", b"post-restart",
+            {"Content-Type": "application/octet-stream"}, timeout=10)
+        assert st < 300
+    finally:
+        fresh.stop()
+
+
+def test_plane_fallback_and_runtime_disarm(cluster, tmp_path):
+    """The 404-fallback contract and the /debug/meta_plane runtime
+    lever: unknown parents fall back, learned parents are accepted,
+    disarming turns every plane answer into a fallback while the
+    Python front keeps serving, re-arming restores the fast path."""
+    store = os.path.join(str(tmp_path), "filer-fb.db")
+    fport = free_port()
+    filer = Proc(
+        "filer-fb",
+        ["filer", "-port", str(fport), "-master", cluster.master,
+         "-store", store], fport,
+        os.path.join(str(tmp_path), "filer-fb.log"),
+        env_extra={"SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE": "1"})
+    filer.start()
+    url = filer.url
+    try:
+        pport = _plane_port(url)
+        if not pport:
+            pytest.skip("native meta plane unavailable in this image")
+        plane = f"127.0.0.1:{pport}"
+
+        # unknown parent dir -> fallback, and the entry must NOT
+        # exist afterwards (the plane answered, Python never saw it)
+        st, body, _ = http_bytes(
+            "POST", f"{plane}/fb/x", b"zz",
+            {"Content-Type": "application/octet-stream"}, timeout=10)
+        assert st == 404
+        assert b"fallback" in body
+        st, _, _ = http_bytes("GET", f"{url}/fb/x", timeout=10)
+        assert st == 404
+
+        # a Python write teaches the plane the dir; then it accepts
+        st, _, _ = http_bytes(
+            "POST", f"{url}/fb/seed", b"seed",
+            {"Content-Type": "application/octet-stream"}, timeout=10)
+        assert st < 300
+        assert _native_post(plane, "/fb/y", b"native-y") == 201
+
+        # runtime disarm: every plane answer becomes a fallback...
+        doc = http_json("POST", f"{url}/debug/meta_plane",
+                        {"native": "off"}, timeout=10)
+        assert doc["armed"] is False
+        st, _, _ = http_bytes(
+            "POST", f"{plane}/fb/z", b"native-z",
+            {"Content-Type": "application/octet-stream"}, timeout=10)
+        assert st == 404
+        # ...and /status stops advertising the port to new clients
+        assert not http_json("GET", f"{url}/status",
+                             timeout=5).get("metaPlanePort")
+        # ...while the Python front serves the same write unphased
+        st, _, _ = http_bytes(
+            "POST", f"{url}/fb/z", b"python-z",
+            {"Content-Type": "application/octet-stream"}, timeout=10)
+        assert st < 300
+
+        # re-arm restores the fast path
+        doc = http_json("POST", f"{url}/debug/meta_plane",
+                        {"native": "on"}, timeout=10)
+        assert doc["armed"] is True
+        assert _native_post(plane, "/fb/w", b"native-w") == 201
+
+        # plane-acked entries are readable through the Python front
+        for path, blob in (("/fb/y", b"native-y"),
+                           ("/fb/z", b"python-z"),
+                           ("/fb/w", b"native-w")):
+            st, body, _ = http_bytes("GET", f"{url}{path}", timeout=10)
+            assert st == 200 and body == blob, (path, st)
+    finally:
+        filer.stop()

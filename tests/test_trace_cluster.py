@@ -22,7 +22,27 @@ from seaweedfs_tpu.util.request_id import set_request_id
 
 @pytest.fixture(scope="module")
 def cluster(tmp_path_factory):
-    c = ProcCluster(tmp_path_factory.mktemp("trace"), volumes=2).start()
+    # Pin this cluster to the pure-Python data path: the native
+    # planes ack without HTTP headers, so a plane-served chunk leaves
+    # no volume span — this module's contract is the TRACED path.
+    # (Before the meta plane, the first filer upload's /status
+    # discovery probe incidentally donated a volume-role span to the
+    # trace; the three-role assertion only held by that accident.)
+    import os
+    saved = {k: os.environ.get(k) for k in
+             ("SEAWEEDFS_TPU_WRITE_PLANE",
+              "SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE")}
+    os.environ["SEAWEEDFS_TPU_WRITE_PLANE"] = "0"
+    os.environ["SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE"] = "0"
+    try:
+        c = ProcCluster(
+            tmp_path_factory.mktemp("trace"), volumes=2).start()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     _wait_writable(c)
     yield c
     c.stop()
