@@ -7,6 +7,8 @@ rule's docstring here carries only the detection contract.
 from __future__ import annotations
 
 import ast
+import os
+import re
 import struct
 
 from .analyze import FileContext, Rule
@@ -1364,6 +1366,85 @@ class UnguardedMetaLogAppendRule(Rule):
                 f"helper with a reason)")
 
 
+class PlaneLabelDriftRule(Rule):
+    """SWFS019: a stage/fallback/stat label exported by a C++ plane
+    with no matching literal in its Python drain driver.
+
+    The planes name their flight-record stages, fallback reasons and
+    stats in `const char* const` tables (kRecStageNames /
+    kRecFallbackNames / kStatsNames); the Python drivers render those
+    same labels into histograms, cluster.slow stage decompositions
+    and cluster.top lines from their own literal tuples
+    (RECORD_STAGES / RECORD_FALLBACKS / _STATS_KEYS).  The pairing is
+    positional and stringly-typed across a language boundary no type
+    checker sees, so a label added or renamed C-side with no matching
+    Python literal silently misattributes every drained record.
+    Flagged: any literal in a plane's C++ name table that appears
+    nowhere as a string literal in the paired driver module.  Only
+    the three driver modules are checked; checkouts without the
+    native sources are skipped."""
+
+    id = "SWFS019"
+    severity = "error"
+    title = "native-plane label missing from the Python drain table"
+
+    _PAIRS = {
+        "seaweedfs_tpu/server/meta_plane_native.py":
+            "seaweedfs_tpu/native/meta_plane.cc",
+        "seaweedfs_tpu/server/write_plane.py":
+            "seaweedfs_tpu/native/write_plane.cc",
+        "seaweedfs_tpu/server/read_plane.py":
+            "seaweedfs_tpu/native/read_plane.cc",
+    }
+    _TABLES = (("kRecStageNames", "RECORD_STAGES"),
+               ("kRecFallbackNames", "RECORD_FALLBACKS"),
+               ("kStatsNames", "_STATS_KEYS"))
+
+    @staticmethod
+    def _cc_labels(src: str, array: str) -> "list[str]":
+        m = re.search(array + r"\[\]\s*=\s*\{(.*?)\}", src, re.S)
+        return re.findall(r'"([^"]*)"', m.group(1)) if m else []
+
+    def check(self, ctx: FileContext):
+        rel = ctx.relpath.replace("\\", "/")
+        cc_rel = next((cc for py, cc in self._PAIRS.items()
+                       if rel.endswith(py)), None)
+        if cc_rel is None:
+            return
+        from .analyze import repo_root
+        try:
+            with open(os.path.join(repo_root(), *cc_rel.split("/")),
+                      encoding="utf-8") as f:
+                cc_src = f.read()
+        except OSError:
+            return      # no native sources beside this checkout
+        literals = {n.value for n in ast.walk(ctx.tree)
+                    if isinstance(n, ast.Constant) and
+                    isinstance(n.value, str)}
+        anchors: dict = {}
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        anchors[t.id] = n
+        default_anchor = ctx.tree.body[0] if ctx.tree.body else None
+        for array, table in self._TABLES:
+            for label in self._cc_labels(cc_src, array):
+                if label in literals:
+                    continue
+                node = anchors.get(table, default_anchor)
+                if node is None:
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f'{cc_rel} exports "{label}" in {array} but this '
+                    f"driver has no matching literal — the {table} "
+                    f"render/drain table is positional and stringly-"
+                    f"typed across the ctypes boundary, so every "
+                    f"drained record would carry a wrong or missing "
+                    f"label in cluster.slow/cluster.top")
+
+
 RULES = [
     LockDisciplineRule(),
     JitBlockingRule(),
@@ -1383,4 +1464,5 @@ RULES = [
     BareTimeoutLiteralRule(),
     DynamicMetricNameRule(),
     UnguardedMetaLogAppendRule(),
+    PlaneLabelDriftRule(),
 ]

@@ -97,6 +97,97 @@ def available() -> bool:
     return load() is not None
 
 
+# -- shared plane flight-record wire format (ISSUE 18) -----------------
+
+
+class PlaneRecord(ctypes.Structure):
+    """One per-request flight record drained from a C++ plane ring
+    (layout mirrors PlaneRec in meta_plane.cc / write_plane.cc /
+    read_plane.cc — all three share the 112-byte shape; only the
+    stage/fallback label tables differ per plane)."""
+
+    _fields_ = [("rid", ctypes.c_char * 40),
+                ("start_unix_ns", ctypes.c_uint64),
+                ("stage_ns", ctypes.c_uint64 * 4),
+                ("bytes", ctypes.c_uint64),
+                ("deadline_ms", ctypes.c_int64),
+                ("status", ctypes.c_int32),
+                ("fallback", ctypes.c_int32),
+                ("flags", ctypes.c_uint32),
+                ("_pad", ctypes.c_uint32)]
+
+
+PLANE_RECORD_CLIENT_RID = 0x1  # rid arrived on the wire (vs minted)
+# the wire rid has the plane-minted shape ("mp00c0ffee-1"): it was
+# forwarded by a sibling plane's upstream hop, not set by a client —
+# the drain sink treats such records as lean unless independently
+# interesting (error / over the slow threshold)
+PLANE_RECORD_MINTED_UPSTREAM = 0x2
+
+_PLANE_RECORD_DTYPE = None
+
+
+def plane_record_dtype():
+    """Numpy structured-dtype mirror of PlaneRecord, for the
+    vectorized drain path (profiling.PlaneRecordSink.feed_buffer).
+    Lazy: the wire format must not force numpy at module load."""
+    global _PLANE_RECORD_DTYPE
+    if _PLANE_RECORD_DTYPE is None:
+        import numpy as np
+        dt = np.dtype([
+            ("rid", "S40"), ("start_unix_ns", "<u8"),
+            ("stage_ns", "<u8", (4,)), ("bytes", "<u8"),
+            ("deadline_ms", "<i8"), ("status", "<i4"),
+            ("fallback", "<i4"), ("flags", "<u4"),
+            ("_pad", "<u4")])
+        if dt.itemsize != ctypes.sizeof(PlaneRecord):
+            raise AssertionError(
+                f"PlaneRecord dtype drift: {dt.itemsize} != "
+                f"{ctypes.sizeof(PlaneRecord)}")
+        _PLANE_RECORD_DTYPE = dt
+    return _PLANE_RECORD_DTYPE
+
+
+def _bind_record_drain(lib: "ctypes.CDLL", prefix: str) -> None:
+    """Wire the {mp,wp,rp}_drain_records / _records_dropped pair."""
+    drain = getattr(lib, f"{prefix}_drain_records")
+    drain.argtypes = [ctypes.c_int, ctypes.POINTER(PlaneRecord),
+                      ctypes.c_int]
+    drain.restype = ctypes.c_int
+    dropped = getattr(lib, f"{prefix}_records_dropped")
+    dropped.argtypes = [ctypes.c_int]
+    dropped.restype = ctypes.c_ulonglong
+
+
+def drain_plane_records(lib: "ctypes.CDLL", prefix: str, handle: int,
+                        sink=None, cap: int = 512):
+    """Pull one plane's flight ring dry.  With `sink`, feed each
+    batch through sink.feed and return the total count (the hot
+    drainer path — the buffer is reused, never retained); without,
+    return copied PlaneRecord instances (tests/inspection)."""
+    drain = getattr(lib, f"{prefix}_drain_records")
+    buf = (PlaneRecord * cap)()
+    out: "list | None" = [] if sink is None else None
+    feed_buffer = getattr(sink, "feed_buffer", None)
+    total = 0
+    while True:
+        n = drain(handle, buf, cap)
+        if n > 0:
+            total += n
+            if sink is None:
+                out.extend(PlaneRecord.from_buffer_copy(buf[i])
+                           for i in range(n))
+            elif feed_buffer is not None:
+                # vectorized hot path: the sink consumes the raw
+                # buffer in one numpy pass before the next drain
+                # call reuses it
+                feed_buffer(buf, n)
+            else:
+                sink.feed(buf[i] for i in range(n))
+        if n < cap:
+            return out if sink is None else total
+
+
 # -- read-plane library (read_plane.cc) --------------------------------
 
 _RP_SRC = os.path.join(_DIR, "read_plane.cc")
@@ -135,6 +226,7 @@ def load_read_plane() -> "ctypes.CDLL | None":
                                    ctypes.c_ulonglong]
             lib.rp_served.argtypes = [ctypes.c_int]
             lib.rp_served.restype = ctypes.c_ulonglong
+            _bind_record_drain(lib, "rp")
         except (OSError, subprocess.SubprocessError):
             return None
         _rp_lib = lib
@@ -219,6 +311,7 @@ def load_write_plane() -> "ctypes.CDLL | None":
             lib.wp_latency.argtypes = [
                 ctypes.c_int, ctypes.POINTER(ctypes.c_ulonglong)]
             lib.wp_latency.restype = ctypes.c_int
+            _bind_record_drain(lib, "wp")
         except (OSError, subprocess.SubprocessError):
             return None
         _wp_lib = lib
@@ -270,6 +363,9 @@ def load_meta_plane() -> "ctypes.CDLL | None":
             lib.mp_stats.argtypes = [
                 ctypes.c_int, ctypes.POINTER(ctypes.c_ulonglong)]
             lib.mp_stats.restype = ctypes.c_int
+            _bind_record_drain(lib, "mp")
+            lib.mp_set_upload_delay_ms.argtypes = [ctypes.c_int,
+                                                   ctypes.c_int]
         except (OSError, subprocess.SubprocessError):
             return None
         _mp_lib = lib

@@ -43,6 +43,7 @@
 #include <stdarg.h>
 #include <stdint.h>
 #include <stdio.h>
+#include <stdlib.h>
 #include <string.h>
 #include <sys/epoll.h>
 #include <sys/socket.h>
@@ -99,6 +100,126 @@ const uint64_t kLatBuckets[] = {1,      2,      5,      10,     20,
                                 100000, 1000000};
 constexpr int kLatN = 17;
 
+// -- per-request flight records (ISSUE 18) ----------------------------
+//
+// Every request — native ack or fallback — appends one fixed-width
+// record to an SPSC overwrite-oldest ring; the Python drainer pulls
+// them on a tick and feeds the trace/flight-recorder/histogram planes.
+// The hot-path cost is one struct copy + one release store.
+
+constexpr uint32_t kRecFlagClientRid = 1u;  // rid came off the wire
+// wire rid of the plane-minted shape ("mp00c0ffee-1"): forwarded by
+// a sibling plane on its upstream hop, not a real client trace id —
+// the drainer keeps these off the per-record span path unless the
+// record is independently interesting (error / over threshold)
+constexpr uint32_t kRecFlagMintedUpstream = 2u;
+
+inline uint32_t rid_rec_flags(const char* rid, bool client) {
+  if (!client) return 0;
+  uint32_t f = kRecFlagClientRid;
+  if ((rid[0] == 'm' || rid[0] == 'w' || rid[0] == 'r') &&
+      rid[1] == 'p' && rid[2] >= '0' && rid[2] <= '9' &&
+      rid[3] >= '0' && rid[3] <= '9')
+    f |= kRecFlagMintedUpstream;
+  return f;
+}
+
+struct PlaneRec {
+  char rid[40];            // NUL-padded request id
+  uint64_t start_unix_ns;  // CLOCK_REALTIME at ingress (approx)
+  uint64_t stage_ns[4];    // kRecStageNames order
+  uint64_t bytes;          // request body size
+  int64_t deadline_ms;     // X-Weed-Deadline-Ms at ingress; -1 absent
+  int32_t status;          // HTTP status answered
+  int32_t fallback;        // kRecFallbackNames index
+  uint32_t flags;          // kRecFlag*
+  uint32_t _pad;
+};  // 112 bytes, mirrored by native.PlaneRecord (ctypes)
+
+enum {
+  kFbNone = 0,
+  kFbIneligible = 1,
+  kFbFidDry = 2,
+  kFbUpstream = 3,
+  kFbWal = 4,
+  kFbOversize = 5,
+  kFbChunked = 6,
+};
+
+// SWFS019 contract: every label below must appear verbatim as a
+// string literal in the Python drain table
+// (server/meta_plane_native.py) — devtools lint cross-checks.
+const char* const kRecStageNames[] = {"parse", "upload", "wal", "ack"};
+const char* const kRecFallbackNames[] = {
+    "none", "ineligible", "fid_dry", "upstream", "wal", "oversize",
+    "chunked"};
+const char* const kStatsNames[] = {
+    "requests",    "fallbacks", "fid_misses", "wal_errors",
+    "upstream_errors", "parse_ns", "upload_ns", "wal_ns",
+    "wal_batches", "wal_lines"};
+
+struct RecRing {
+  std::vector<PlaneRec> recs;
+  uint64_t cap = 0;
+  std::atomic<uint64_t> head{0};     // total produced (producer)
+  std::atomic<uint64_t> tail{0};     // total consumed (drain thread)
+  std::atomic<uint64_t> dropped{0};  // overwritten before drain
+};
+
+uint64_t rec_ring_cap_env() {
+  const char* v = getenv("SEAWEEDFS_TPU_PLANE_REC_RING");
+  if (v != nullptr && *v != '\0') {
+    long n = atol(v);
+    if (n >= 16 && n <= (1 << 20)) return uint64_t(n);
+  }
+  return 4096;
+}
+
+void rec_push(RecRing* r, const PlaneRec& rec) {
+  if (r->cap == 0) return;
+  uint64_t h = r->head.load(std::memory_order_relaxed);
+  r->recs[h % r->cap] = rec;
+  r->head.store(h + 1, std::memory_order_release);
+}
+
+// single drainer at a time (the Python side serializes with a lock)
+int rec_drain(RecRing* r, PlaneRec* out, int cap) {
+  if (r->cap == 0 || out == nullptr || cap <= 0) return 0;
+  uint64_t h = r->head.load(std::memory_order_acquire);
+  uint64_t t = r->tail.load(std::memory_order_relaxed);
+  if (h > t + r->cap) {   // producer lapped us: oldest are gone
+    r->dropped.fetch_add((h - r->cap) - t, std::memory_order_relaxed);
+    t = h - r->cap;
+  }
+  int n = 0;
+  while (t < h && n < cap) out[n++] = r->recs[t++ % r->cap];
+  // the producer may have lapped the slots mid-copy — the torn
+  // prefix (oldest copied entries) is dropped, never handed over
+  uint64_t h2 = r->head.load(std::memory_order_acquire);
+  uint64_t first = t - uint64_t(n);
+  if (h2 > first + r->cap) {
+    uint64_t torn = h2 - r->cap - first;
+    if (torn > uint64_t(n)) torn = uint64_t(n);
+    if (torn > 0) {
+      memmove(out, out + torn,
+              (size_t(n) - size_t(torn)) * sizeof(PlaneRec));
+      n -= int(torn);
+      r->dropped.fetch_add(torn, std::memory_order_relaxed);
+    }
+  }
+  r->tail.store(t, std::memory_order_relaxed);
+  return n;
+}
+
+uint64_t rec_dropped(RecRing* r) {
+  // live view: committed drops + the current un-drained overrun
+  uint64_t h = r->head.load(std::memory_order_acquire);
+  uint64_t t = r->tail.load(std::memory_order_relaxed);
+  uint64_t extra = (r->cap != 0 && h > t + r->cap)
+                       ? (h - r->cap) - t : 0;
+  return r->dropped.load(std::memory_order_relaxed) + extra;
+}
+
 // -- metalog segment naming (meta_log.py _segment_name) ---------------
 //
 // Python computes time.gmtime(ts_ns / 1e9): FLOAT division then
@@ -131,6 +252,9 @@ struct Conn {
   int inflight = 0;           // parked on the native pipeline
   bool close_after = false;
   bool want_write = false;
+  char rid[40] = {0};         // X-Request-ID (or minted)
+  bool rid_client = false;    // rid came off the wire
+  int64_t deadline_ms = -1;   // X-Weed-Deadline-Ms at ingress
 };
 
 // one native request in flight against the volume write plane
@@ -145,6 +269,10 @@ struct Pending {
   uint64_t start_mono = 0;    // request first byte (ack histogram)
   uint64_t dispatch_mono = 0; // eligibility done -> upstream queued
   uint64_t enq_mono = 0;      // upstream-timeout clock
+  uint64_t upload_ns = 0;     // set when the volume round trip lands
+  char rid[40] = {0};
+  uint32_t rid_flags = 0;
+  int64_t deadline_ms = -1;
 };
 
 struct Upstream {
@@ -212,6 +340,12 @@ struct Server {
   std::atomic<uint64_t> wal_ns{0};
   std::atomic<uint64_t> lat_count[kLatN + 1];
   std::atomic<uint64_t> lat_sum_ns{0};
+
+  // per-request flight records + the upload-hop failpoint lever
+  RecRing rec;
+  std::atomic<int> upload_delay_ms{0};
+  uint64_t rid_seq = 0;                   // event-loop thread only
+  char rid_prefix[16] = {0};
 
   Server() {
     for (int i = 0; i <= kLatN; i++) lat_count[i] = 0;
@@ -316,6 +450,48 @@ void respond_fallback(Server* s, Conn* c) {
   s->fallbacks.fetch_add(1, std::memory_order_relaxed);
   respond(s, c, 404, "Not Found",
           "{\"error\":\"meta plane fallback\"}");
+}
+
+// append one flight record; ack = total minus the named stages
+void rec_emit(Server* s, const char* rid, uint32_t flags,
+              int64_t deadline_ms, uint64_t total_ns, uint64_t parse,
+              uint64_t upload, uint64_t wal, uint64_t bytes,
+              int status, int fallback) {
+  PlaneRec r{};
+  snprintf(r.rid, sizeof(r.rid), "%s", rid);
+  r.start_unix_ns = now_ns() - total_ns;
+  r.stage_ns[0] = parse;
+  r.stage_ns[1] = upload;
+  r.stage_ns[2] = wal;
+  uint64_t sum = parse + upload + wal;
+  r.stage_ns[3] = total_ns > sum ? total_ns - sum : 0;
+  r.bytes = bytes;
+  r.deadline_ms = deadline_ms;
+  r.status = status;
+  r.fallback = fallback;
+  r.flags = flags;
+  rec_push(&s->rec, r);
+}
+
+// fallback record framed from the conn (pre-dispatch failures)
+void rec_emit_conn(Server* s, Conn* c, uint64_t bytes, int status,
+                   int fallback) {
+  uint64_t total =
+      c->req_start_ns != 0 ? mono_ns() - c->req_start_ns : 0;
+  rec_emit(s, c->rid, rid_rec_flags(c->rid, c->rid_client),
+           c->deadline_ms, total, total, 0, 0, bytes, status,
+           fallback);
+}
+
+// fallback record framed from a dispatched Pending (upstream failures)
+void rec_emit_pending(Server* s, const Pending& p, int fallback) {
+  uint64_t now = mono_ns();
+  uint64_t total = now - p.start_mono;
+  uint64_t parse = p.dispatch_mono - p.start_mono;
+  uint64_t upload =
+      p.upload_ns != 0 ? p.upload_ns : now - p.dispatch_mono;
+  rec_emit(s, p.rid, p.rid_flags, p.deadline_ms, total, parse, upload,
+           0, p.size, 404, fallback);
 }
 
 // -- eligibility ------------------------------------------------------
@@ -599,8 +775,14 @@ void commit_batch(Server* s) {
       s->seg_fd = -1;
     }
   }
+  uint64_t wal_win = t1 - t0;   // shared batch window, per record
   std::vector<int> touched;
   for (WalItem& w : s->wal_pending) {
+    rec_emit(s, w.p.rid, w.p.rid_flags, w.p.deadline_ms,
+             mono_ns() - w.p.start_mono,
+             w.p.dispatch_mono - w.p.start_mono, w.p.upload_ns,
+             wal_win, w.p.size, ok ? 201 : 404,
+             ok ? kFbNone : kFbWal);
     auto it = s->conns.find(w.p.client_fd);
     bool alive =
         it != s->conns.end() && it->second.gen == w.p.client_gen;
@@ -644,21 +826,46 @@ void dispatch_native(Server* s, Conn* c, const std::string& path,
   p.start_mono = c->req_start_ns;
   p.dispatch_mono = mono_ns();
   p.enq_mono = p.dispatch_mono;
+  // failpoint: deliberately slow the volume upload hop.  Runs after
+  // the dispatch stamp so the stall lands in the record's upload
+  // stage (measured dispatch -> volume ack) — the acceptance lever
+  // for ISSUE 18
+  int delay = s->upload_delay_ms.load(std::memory_order_relaxed);
+  if (delay > 0) usleep(useconds_t(delay) * 1000);
+  memcpy(p.rid, c->rid, sizeof(p.rid));
+  p.rid_flags = rid_rec_flags(c->rid, c->rid_client);
+  p.deadline_ms = c->deadline_ms;
   s->parse_ns.fetch_add(p.dispatch_mono - c->req_start_ns,
                         std::memory_order_relaxed);
   Upstream* u = ups_pick(s, addr);
   if (u == nullptr) {
     s->upstream_errors.fetch_add(1, std::memory_order_relaxed);
+    rec_emit_conn(s, c, c->body.size(), 404, kFbUpstream);
     respond_fallback(s, c);
     return;
   }
-  char head[256];
+  // forward the request id + remaining deadline on the plane-socket
+  // hop so the volume plane's record stitches into the same trace
+  char dlbuf[48];
+  dlbuf[0] = '\0';
+  if (c->deadline_ms >= 0) {
+    long long elapsed_ms =
+        (long long)((p.dispatch_mono - p.start_mono) / 1000000ull);
+    long long left = (long long)c->deadline_ms - elapsed_ms;
+    if (left < 1) left = 1;
+    snprintf(dlbuf, sizeof(dlbuf), "X-Weed-Deadline-Ms: %lld\r\n",
+             left);
+  }
+  char head[384];
   int n = snprintf(head, sizeof(head),
                    "POST /%s HTTP/1.1\r\n"
                    "Host: %s\r\n"
+                   "X-Request-ID: %s\r\n"
+                   "%s"
                    "Content-Length: %zu\r\n"
                    "\r\n",
-                   fid.c_str(), addr.c_str(), c->body.size());
+                   fid.c_str(), addr.c_str(), c->rid, dlbuf,
+                   c->body.size());
   u->out.append(head, size_t(n));
   u->out.append(c->body);
   u->inflight.push_back(std::move(p));
@@ -690,7 +897,10 @@ void handle_request(Server* s, Conn* c) {
   if (eligible) {
     // parent must be a directory created fresh during this plane's
     // lifetime, and the name never written by anyone — that is the
-    // proof op="create" with oldEntry:null is the truth
+    // proof op="create" with oldEntry:null is the truth.  The name
+    // is NOT claimed yet: a fid-dry fallback below must leave it
+    // retryable on the plane port (a boot-time dry pool otherwise
+    // poisons the first path a client hammers)
     std::lock_guard<std::mutex> lk(s->dir_mu);
     auto it = s->dirs.find(parent);
     if (it == s->dirs.end() || it->second.count(name) != 0) {
@@ -698,24 +908,44 @@ void handle_request(Server* s, Conn* c) {
     } else if (it->second.size() >= kMaxChildren) {
       s->dirs.erase(it);     // overflow: this dir falls back from now
       eligible = false;
-    } else {
-      it->second.insert(name);
     }
   }
   std::string addr, fid;
+  int fb = kFbIneligible;
   if (eligible) {
     std::lock_guard<std::mutex> lk(s->fid_mu);
     if (s->fids.empty()) {
       s->fid_misses.fetch_add(1, std::memory_order_relaxed);
       eligible = false;
+      fb = kFbFidDry;
     } else {
       addr = std::move(s->fids.front().first);
       fid = std::move(s->fids.front().second);
       s->fids.pop_front();
     }
   }
+  if (eligible) {
+    // claim the name now that a fid is in hand; a concurrent twin
+    // of the same name may have claimed it between the dir_mu holds
+    std::lock_guard<std::mutex> lk(s->dir_mu);
+    auto it = s->dirs.find(parent);
+    bool claimed = false;
+    if (it != s->dirs.end()) {
+      if (it->second.size() >= kMaxChildren)
+        s->dirs.erase(it);
+      else
+        claimed = it->second.insert(name).second;
+    }
+    if (!claimed) {
+      eligible = false;
+      std::lock_guard<std::mutex> lk2(s->fid_mu);
+      s->fids.emplace_front(std::move(addr), std::move(fid));
+    }
+  }
   if (!eligible) {
+    size_t nbytes = c->body.size();
     c->body.clear();
+    rec_emit_conn(s, c, nbytes, 404, fb);
     respond_fallback(s, c);
     return;
   }
@@ -744,6 +974,18 @@ void client_feed(Server* s, Conn* c) {
       }
       c->method = req_line.substr(0, sp1);
       c->target = req_line.substr(sp1 + 1, sp2 - sp1 - 1);
+      std::string rv = header_value(c->req_headers, "X-Request-ID");
+      if (!rv.empty()) {
+        snprintf(c->rid, sizeof(c->rid), "%.39s", rv.c_str());
+        c->rid_client = true;
+      } else {
+        snprintf(c->rid, sizeof(c->rid), "%s-%llx", s->rid_prefix,
+                 static_cast<unsigned long long>(++s->rid_seq));
+        c->rid_client = false;
+      }
+      std::string dv =
+          header_value(c->req_headers, "X-Weed-Deadline-Ms");
+      c->deadline_ms = dv.empty() ? -1 : atoll(dv.c_str());
       c->close_after =
           strcasecmp(
               header_value(c->req_headers, "Connection").c_str(),
@@ -754,6 +996,7 @@ void client_feed(Server* s, Conn* c) {
       if (!te.empty()) {
         // no framing we can cheaply parse — refuse and close
         c->close_after = true;
+        rec_emit_conn(s, c, 0, 404, kFbChunked);
         respond_fallback(s, c);
         flush_client(s, c->fd);
         return;
@@ -761,6 +1004,8 @@ void client_feed(Server* s, Conn* c) {
       long long need = cl.empty() ? 0 : atoll(cl.c_str());
       if (need < 0 || size_t(need) > kMaxBody + 1) {
         c->close_after = true;   // body too big to swallow: hand off
+        rec_emit_conn(s, c, need > 0 ? uint64_t(need) : 0, 404,
+                      kFbOversize);
         respond_fallback(s, c);
         flush_client(s, c->fd);
         return;
@@ -794,6 +1039,7 @@ void ups_fail_inflight(Server* s, Upstream* u) {
     Pending p = std::move(u->inflight.front());
     u->inflight.pop_front();
     s->upstream_errors.fetch_add(1, std::memory_order_relaxed);
+    rec_emit_pending(s, p, kFbUpstream);
     auto it = s->conns.find(p.client_fd);
     if (it == s->conns.end() || it->second.gen != p.client_gen)
       continue;
@@ -832,6 +1078,7 @@ bool ups_feed_one(Server* s, Upstream* u) {
   Pending p = std::move(u->inflight.front());
   u->inflight.pop_front();
   uint64_t t = mono_ns();
+  p.upload_ns = t - p.dispatch_mono;
   s->upload_ns.fetch_add(t - p.dispatch_mono,
                          std::memory_order_relaxed);
   if (status == 201) {
@@ -852,6 +1099,7 @@ bool ups_feed_one(Server* s, Upstream* u) {
   // volume plane refused (its own fallback contract) — hand the whole
   // request back to Python
   s->upstream_errors.fetch_add(1, std::memory_order_relaxed);
+  rec_emit_pending(s, p, kFbUpstream);
   auto it = s->conns.find(p.client_fd);
   if (it != s->conns.end() && it->second.gen == p.client_gen) {
     it->second.inflight = 0;
@@ -1034,6 +1282,14 @@ int mp_start(const char* host, int port, const char* log_dir,
   s->wid = wid;
   s->wm_path = wm_path;
   s->last_ts = now_ns();
+  s->rec.cap = rec_ring_cap_env();
+  s->rec.recs.resize(s->rec.cap);
+  snprintf(s->rid_prefix, sizeof(s->rid_prefix), "mp%02d%06llx", slot,
+           static_cast<unsigned long long>(now_ns() & 0xffffff));
+  {
+    const char* d = getenv("SEAWEEDFS_TPU_MP_UPLOAD_DELAY_MS");
+    if (d != nullptr && *d != '\0') s->upload_delay_ms.store(atoi(d));
+  }
   s->wm_fd = open(wm_path, O_WRONLY);
   s->epfd = epoll_create1(0);
   s->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
@@ -1221,6 +1477,26 @@ int mp_stats(int h, unsigned long long* out) {
   out[8] = s->wal_batches.load();
   out[9] = s->wal_lines.load();
   return 10;
+}
+
+// drain up to `cap` per-request flight records into `out` (oldest
+// first; overwritten-before-drain records are counted, never handed
+// over).  Single concurrent drainer — the Python side holds a lock.
+int mp_drain_records(int h, PlaneRec* out, int cap) {
+  Server* s = get_server(h);
+  if (s == nullptr) return -1;
+  return rec_drain(&s->rec, out, cap);
+}
+
+unsigned long long mp_records_dropped(int h) {
+  Server* s = get_server(h);
+  return s != nullptr ? rec_dropped(&s->rec) : 0;
+}
+
+// failpoint: stall the volume upload hop by `ms` per request (0 = off)
+void mp_set_upload_delay_ms(int h, int ms) {
+  Server* s = get_server(h);
+  if (s != nullptr) s->upload_delay_ms.store(ms < 0 ? 0 : ms);
 }
 
 }  // extern "C"

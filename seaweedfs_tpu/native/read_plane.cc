@@ -27,10 +27,12 @@
 #include <netinet/tcp.h>
 #include <stdint.h>
 #include <stdio.h>
+#include <stdlib.h>
 #include <string.h>
 #include <sys/epoll.h>
 #include <sys/sendfile.h>
 #include <sys/socket.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -42,6 +44,120 @@
 #include <vector>
 
 namespace {
+
+uint64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+uint64_t mono_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+// -- per-request flight records (ISSUE 18) ----------------------------
+//
+// Identical wire shape to meta_plane.cc / write_plane.cc PlaneRec
+// (native.PlaneRecord on the ctypes side).
+
+constexpr uint32_t kRecFlagClientRid = 1u;
+// see meta_plane.cc kRecFlagMintedUpstream
+constexpr uint32_t kRecFlagMintedUpstream = 2u;
+
+inline uint32_t rid_rec_flags(const char* rid, bool client) {
+  if (!client) return 0;
+  uint32_t f = kRecFlagClientRid;
+  if ((rid[0] == 'm' || rid[0] == 'w' || rid[0] == 'r') &&
+      rid[1] == 'p' && rid[2] >= '0' && rid[2] <= '9' &&
+      rid[3] >= '0' && rid[3] <= '9')
+    f |= kRecFlagMintedUpstream;
+  return f;
+}
+
+struct PlaneRec {
+  char rid[40];
+  uint64_t start_unix_ns;
+  uint64_t stage_ns[4];    // kRecStageNames order
+  uint64_t bytes;
+  int64_t deadline_ms;     // -1 = absent
+  int32_t status;
+  int32_t fallback;        // kRecFallbackNames index
+  uint32_t flags;
+  uint32_t _pad;
+};  // 112 bytes
+
+enum {
+  kFbNone = 0,
+  kFbMethod = 1,
+  kFbBadRequest = 2,
+  kFbNotFound = 3,
+};
+
+// SWFS019 contract: every label below must appear verbatim as a
+// string literal in the Python drain table (server/read_plane.py).
+const char* const kRecStageNames[] = {"parse", "lookup", "send", "ack"};
+const char* const kRecFallbackNames[] = {"none", "method",
+                                         "bad_request", "not_found"};
+
+struct RecRing {
+  std::vector<PlaneRec> recs;
+  uint64_t cap = 0;
+  std::atomic<uint64_t> head{0};
+  std::atomic<uint64_t> tail{0};
+  std::atomic<uint64_t> dropped{0};
+};
+
+uint64_t rec_ring_cap_env() {
+  const char* v = getenv("SEAWEEDFS_TPU_PLANE_REC_RING");
+  if (v != nullptr && *v != '\0') {
+    long n = atol(v);
+    if (n >= 16 && n <= (1 << 20)) return (uint64_t)n;
+  }
+  return 4096;
+}
+
+void rec_push(RecRing* r, const PlaneRec& rec) {
+  if (r->cap == 0) return;
+  uint64_t h = r->head.load(std::memory_order_relaxed);
+  r->recs[h % r->cap] = rec;
+  r->head.store(h + 1, std::memory_order_release);
+}
+
+int rec_drain(RecRing* r, PlaneRec* out, int cap) {
+  if (r->cap == 0 || out == nullptr || cap <= 0) return 0;
+  uint64_t h = r->head.load(std::memory_order_acquire);
+  uint64_t t = r->tail.load(std::memory_order_relaxed);
+  if (h > t + r->cap) {
+    r->dropped.fetch_add((h - r->cap) - t, std::memory_order_relaxed);
+    t = h - r->cap;
+  }
+  int n = 0;
+  while (t < h && n < cap) out[n++] = r->recs[t++ % r->cap];
+  uint64_t h2 = r->head.load(std::memory_order_acquire);
+  uint64_t first = t - (uint64_t)n;
+  if (h2 > first + r->cap) {   // lapped mid-copy: drop torn prefix
+    uint64_t torn = h2 - r->cap - first;
+    if (torn > (uint64_t)n) torn = (uint64_t)n;
+    if (torn > 0) {
+      memmove(out, out + torn,
+              ((size_t)n - (size_t)torn) * sizeof(PlaneRec));
+      n -= (int)torn;
+      r->dropped.fetch_add(torn, std::memory_order_relaxed);
+    }
+  }
+  r->tail.store(t, std::memory_order_relaxed);
+  return n;
+}
+
+uint64_t rec_dropped(RecRing* r) {
+  uint64_t h = r->head.load(std::memory_order_acquire);
+  uint64_t t = r->tail.load(std::memory_order_relaxed);
+  uint64_t extra = (r->cap != 0 && h > t + r->cap)
+                       ? (h - r->cap) - t : 0;
+  return r->dropped.load(std::memory_order_relaxed) + extra;
+}
 
 struct Entry {
   uint32_t cookie;
@@ -62,6 +178,16 @@ struct Conn {
   off_t file_off = 0;
   size_t file_left = 0;
   bool close_after = false;
+  // flight-record carry for the in-flight body response (at most one:
+  // the request loop stalls while file_left > 0)
+  bool rec_armed = false;
+  uint64_t rec_handoff_mono = 0;
+  uint64_t rec_parse_ns = 0;
+  uint64_t rec_lookup_ns = 0;
+  uint64_t rec_bytes = 0;
+  char rid[40] = {0};
+  bool rid_client = false;
+  int64_t deadline_ms = -1;
 };
 
 struct Server {
@@ -74,6 +200,10 @@ struct Server {
   std::shared_mutex idx_mu;
   std::unordered_map<uint32_t, VolumeIdx> volumes;
   std::unordered_map<int, Conn*> conns;
+  // per-request flight records
+  RecRing rec;
+  uint64_t rid_seq = 0;    // event-loop thread only
+  char rid_prefix[16] = {0};
 };
 
 constexpr int kMaxServers = 16;
@@ -141,8 +271,61 @@ void respond_simple(Conn* c, const char* status_line) {
   c->out.append(buf, n);
 }
 
+// case-insensitive header lookup inside a raw header block (the
+// request line leads the block; a method never matches "Name:")
+std::string header_value(const std::string& block, const char* name) {
+  size_t nl = strlen(name);
+  size_t pos = 0;
+  while (pos < block.size()) {
+    size_t eol = block.find("\r\n", pos);
+    if (eol == std::string::npos) eol = block.size();
+    if (eol - pos > nl + 1 && block[pos + nl] == ':' &&
+        strncasecmp(block.data() + pos, name, nl) == 0) {
+      size_t v = pos + nl + 1;
+      while (v < eol && (block[v] == ' ' || block[v] == '\t')) v++;
+      return block.substr(v, eol - v);
+    }
+    pos = eol + 2;
+  }
+  return "";
+}
+
+// append one flight record framed off the conn's carry fields
+void rec_emit(Server* s, Conn* c, uint64_t send_ns, uint64_t total_ns,
+              int status, int fallback) {
+  PlaneRec r{};
+  snprintf(r.rid, sizeof(r.rid), "%s", c->rid);
+  r.start_unix_ns = now_ns() - total_ns;
+  r.stage_ns[0] = c->rec_parse_ns;
+  r.stage_ns[1] = c->rec_lookup_ns;
+  r.stage_ns[2] = send_ns;
+  uint64_t sum = c->rec_parse_ns + c->rec_lookup_ns + send_ns;
+  r.stage_ns[3] = total_ns > sum ? total_ns - sum : 0;
+  r.bytes = c->rec_bytes;
+  r.deadline_ms = c->deadline_ms;
+  r.status = status;
+  r.fallback = fallback;
+  r.flags = rid_rec_flags(c->rid, c->rid_client);
+  rec_push(&s->rec, r);
+}
+
 // returns false when the connection must close (malformed framing)
 bool handle_one_request(Server* s, Conn* c, const std::string& req) {
+  uint64_t t0 = mono_ns();
+  c->rec_parse_ns = 0;
+  c->rec_lookup_ns = 0;
+  c->rec_bytes = 0;
+  std::string rid = header_value(req, "X-Request-ID");
+  if (!rid.empty()) {
+    snprintf(c->rid, sizeof(c->rid), "%.39s", rid.c_str());
+    c->rid_client = true;
+  } else {
+    snprintf(c->rid, sizeof(c->rid), "%s-%llx", s->rid_prefix,
+             (unsigned long long)++s->rid_seq);
+    c->rid_client = false;
+  }
+  std::string dl = header_value(req, "X-Weed-Deadline-Ms");
+  c->deadline_ms = dl.empty() ? -1 : (int64_t)atoll(dl.c_str());
   // request line: METHOD SP target SP version
   size_t sp1 = req.find(' ');
   size_t sp2 = (sp1 == std::string::npos)
@@ -154,6 +337,8 @@ bool handle_one_request(Server* s, Conn* c, const std::string& req) {
   bool head = method == "HEAD";
   if (method != "GET" && !head) {
     respond_simple(c, "405 Method Not Allowed");
+    c->rec_parse_ns = mono_ns() - t0;
+    rec_emit(s, c, 0, mono_ns() - t0, 405, kFbMethod);
     return true;
   }
   // strip query + leading slash
@@ -161,6 +346,8 @@ bool handle_one_request(Server* s, Conn* c, const std::string& req) {
   if (q != std::string::npos) target.resize(q);
   if (target.empty() || target[0] != '/') {
     respond_simple(c, "400 Bad Request");
+    c->rec_parse_ns = mono_ns() - t0;
+    rec_emit(s, c, 0, mono_ns() - t0, 400, kFbBadRequest);
     return true;
   }
   uint32_t vid, cookie;
@@ -168,8 +355,12 @@ bool handle_one_request(Server* s, Conn* c, const std::string& req) {
   if (!parse_fid(target.data() + 1, target.size() - 1, &vid, &key,
                  &cookie)) {
     respond_simple(c, "404 Not Found");
+    c->rec_parse_ns = mono_ns() - t0;
+    rec_emit(s, c, 0, mono_ns() - t0, 404, kFbNotFound);
     return true;
   }
+  c->rec_parse_ns = mono_ns() - t0;
+  uint64_t t_lk = mono_ns();
   int fd = -1;
   Entry e{};
   {
@@ -187,8 +378,10 @@ bool handle_one_request(Server* s, Conn* c, const std::string& req) {
       }
     }
   }
+  c->rec_lookup_ns = mono_ns() - t_lk;
   if (fd < 0) {
     respond_simple(c, "404 Not Found");
+    rec_emit(s, c, 0, mono_ns() - t0, 404, kFbNotFound);
     return true;
   }
   char hdr[224];
@@ -200,12 +393,18 @@ bool handle_one_request(Server* s, Conn* c, const std::string& req) {
                     "Accept-Ranges: bytes\r\n\r\n",
                     e.len, cookie);
   c->out.append(hdr, hn);
+  c->rec_bytes = head ? 0 : e.len;
   if (!head && e.len > 0) {
     c->file_fd = fd;           // owned (dup); closed when drained
     c->file_off = (off_t)e.off;
     c->file_left = e.len;
+    // record finalized in flush_out once the body drains: the send
+    // stage spans the sendfile window, not just header queueing
+    c->rec_armed = true;
+    c->rec_handoff_mono = mono_ns();
   } else {
     close(fd);
+    rec_emit(s, c, 0, mono_ns() - t0, 200, kFbNone);
   }
   s->served.fetch_add(1, std::memory_order_relaxed);
   return true;
@@ -236,6 +435,13 @@ bool flush_out(Server* s, Conn* c) {
   if (c->file_fd >= 0) {
     close(c->file_fd);
     c->file_fd = -1;
+  }
+  if (c->rec_armed && c->file_left == 0) {
+    uint64_t send_ns = mono_ns() - c->rec_handoff_mono;
+    rec_emit(s, c, send_ns,
+             c->rec_parse_ns + c->rec_lookup_ns + send_ns, 200,
+             kFbNone);
+    c->rec_armed = false;
   }
   return true;
 }
@@ -365,6 +571,10 @@ int rp_start(const char* host, int port, int* bound_port) {
   socklen_t alen = sizeof addr;
   getsockname(s->listen_fd, (sockaddr*)&addr, &alen);
   *bound_port = ntohs(addr.sin_port);
+  s->rec.cap = rec_ring_cap_env();
+  s->rec.recs.resize(s->rec.cap);
+  snprintf(s->rid_prefix, sizeof(s->rid_prefix), "rp%02d%06llx", slot,
+           (unsigned long long)(now_ns() & 0xffffff));
   s->epfd = epoll_create1(0);
   if (pipe2(s->wake_pipe, O_NONBLOCK) < 0) return -1;
   epoll_event ev{};
@@ -454,6 +664,18 @@ void rp_del(int h, unsigned vid, unsigned long long nid) {
 unsigned long long rp_served(int h) {
   Server* s = get_server(h);
   return s == nullptr ? 0 : s->served.load();
+}
+
+// drain up to `cap` flight records into `out`; returns the count
+int rp_drain_records(int h, PlaneRec* out, int cap) {
+  Server* s = get_server(h);
+  if (s == nullptr) return 0;
+  return rec_drain(&s->rec, out, cap);
+}
+
+unsigned long long rp_records_dropped(int h) {
+  Server* s = get_server(h);
+  return s == nullptr ? 0 : rec_dropped(&s->rec);
 }
 
 }  // extern "C"

@@ -43,6 +43,7 @@
 #include <netinet/tcp.h>
 #include <stdint.h>
 #include <stdio.h>
+#include <stdlib.h>
 #include <string.h>
 #include <sys/epoll.h>
 #include <sys/socket.h>
@@ -157,6 +158,114 @@ struct WpEntry {
   uint32_t data_len;
 };
 
+// -- per-request flight records (ISSUE 18) ----------------------------
+//
+// Identical wire shape to meta_plane.cc's PlaneRec (native.PlaneRecord
+// on the ctypes side): one fixed-width record per request into an SPSC
+// overwrite-oldest ring, drained by the Python volume server.
+
+constexpr uint32_t kRecFlagClientRid = 1u;  // rid came off the wire
+// wire rid of the plane-minted shape (e.g. "mp00c0ffee-1" forwarded
+// by the filer meta plane on its upstream hop): not a real client
+// trace id — see meta_plane.cc kRecFlagMintedUpstream
+constexpr uint32_t kRecFlagMintedUpstream = 2u;
+
+inline uint32_t rid_rec_flags(const char* rid, bool client) {
+  if (!client) return 0;
+  uint32_t f = kRecFlagClientRid;
+  if ((rid[0] == 'm' || rid[0] == 'w' || rid[0] == 'r') &&
+      rid[1] == 'p' && rid[2] >= '0' && rid[2] <= '9' &&
+      rid[3] >= '0' && rid[3] <= '9')
+    f |= kRecFlagMintedUpstream;
+  return f;
+}
+
+struct PlaneRec {
+  char rid[40];
+  uint64_t start_unix_ns;
+  uint64_t stage_ns[4];    // kRecStageNames order
+  uint64_t bytes;
+  int64_t deadline_ms;     // -1 = absent
+  int32_t status;
+  int32_t fallback;        // kRecFallbackNames index
+  uint32_t flags;
+  uint32_t _pad;
+};  // 112 bytes
+
+enum {
+  kFbNone = 0,
+  kFbNotPlain = 1,
+  kFbUnregistered = 2,
+  kFbSeenKey = 3,
+  kFbJournalFull = 4,
+  kFbIoError = 5,
+};
+
+// SWFS019 contract: every label below must appear verbatim as a
+// string literal in the Python drain table (server/write_plane.py).
+const char* const kRecStageNames[] = {"recv", "append", "index", "ack"};
+const char* const kRecFallbackNames[] = {
+    "none", "not_plain", "unregistered", "seen_key", "journal_full",
+    "io_error"};
+
+struct RecRing {
+  std::vector<PlaneRec> recs;
+  uint64_t cap = 0;
+  std::atomic<uint64_t> head{0};
+  std::atomic<uint64_t> tail{0};
+  std::atomic<uint64_t> dropped{0};
+};
+
+uint64_t rec_ring_cap_env() {
+  const char* v = getenv("SEAWEEDFS_TPU_PLANE_REC_RING");
+  if (v != nullptr && *v != '\0') {
+    long n = atol(v);
+    if (n >= 16 && n <= (1 << 20)) return uint64_t(n);
+  }
+  return 4096;
+}
+
+void rec_push(RecRing* r, const PlaneRec& rec) {
+  if (r->cap == 0) return;
+  uint64_t h = r->head.load(std::memory_order_relaxed);
+  r->recs[h % r->cap] = rec;
+  r->head.store(h + 1, std::memory_order_release);
+}
+
+int rec_drain(RecRing* r, PlaneRec* out, int cap) {
+  if (r->cap == 0 || out == nullptr || cap <= 0) return 0;
+  uint64_t h = r->head.load(std::memory_order_acquire);
+  uint64_t t = r->tail.load(std::memory_order_relaxed);
+  if (h > t + r->cap) {
+    r->dropped.fetch_add((h - r->cap) - t, std::memory_order_relaxed);
+    t = h - r->cap;
+  }
+  int n = 0;
+  while (t < h && n < cap) out[n++] = r->recs[t++ % r->cap];
+  uint64_t h2 = r->head.load(std::memory_order_acquire);
+  uint64_t first = t - uint64_t(n);
+  if (h2 > first + r->cap) {   // lapped mid-copy: drop torn prefix
+    uint64_t torn = h2 - r->cap - first;
+    if (torn > uint64_t(n)) torn = uint64_t(n);
+    if (torn > 0) {
+      memmove(out, out + torn,
+              (size_t(n) - size_t(torn)) * sizeof(PlaneRec));
+      n -= int(torn);
+      r->dropped.fetch_add(torn, std::memory_order_relaxed);
+    }
+  }
+  r->tail.store(t, std::memory_order_relaxed);
+  return n;
+}
+
+uint64_t rec_dropped(RecRing* r) {
+  uint64_t h = r->head.load(std::memory_order_acquire);
+  uint64_t t = r->tail.load(std::memory_order_relaxed);
+  uint64_t extra = (r->cap != 0 && h > t + r->cap)
+                       ? (h - r->cap) - t : 0;
+  return r->dropped.load(std::memory_order_relaxed) + extra;
+}
+
 struct VolumeState {
   int fd = -1;
   bool armed = false;   // accepts HTTP writes only after wp_arm
@@ -188,6 +297,14 @@ struct Conn {
   uint32_t parked_vid = 0;
   uint64_t parked_epoch = 0;
   std::string pending;         // staged response, released by epoch
+  // flight-record carry (finalized at ack time)
+  char rid[40] = {0};
+  bool rid_client = false;
+  int64_t deadline_ms = -1;
+  uint64_t rec_recv_ns = 0;
+  uint64_t rec_append_ns = 0;
+  uint64_t rec_index_ns = 0;
+  uint64_t rec_bytes = 0;
 };
 
 // ack latency histogram bucket bounds, microseconds
@@ -215,6 +332,10 @@ struct Server {
   std::condition_variable ep_cv;
   std::deque<std::pair<uint32_t, uint64_t>> ep_requests;
   std::deque<std::pair<uint32_t, uint64_t>> ep_done;  // loop applies
+  // per-request flight records
+  RecRing rec;
+  uint64_t rid_seq = 0;        // event-loop thread only
+  char rid_prefix[16] = {0};
 };
 
 constexpr int kMaxServers = 16;
@@ -240,6 +361,25 @@ void note_latency(Server* s, uint64_t ns) {
   while (i < kNumLat && us > kLatBuckets[i]) i++;
   s->lat_count[i].fetch_add(1, std::memory_order_relaxed);
   s->lat_sum_ns.fetch_add(ns, std::memory_order_relaxed);
+}
+
+// append one flight record framed off the conn; ack = total residual
+void rec_emit(Server* s, Conn* c, uint64_t total_ns, int status,
+              int fallback) {
+  PlaneRec r{};
+  snprintf(r.rid, sizeof(r.rid), "%s", c->rid);
+  r.start_unix_ns = now_ns() - total_ns;
+  r.stage_ns[0] = c->rec_recv_ns;
+  r.stage_ns[1] = c->rec_append_ns;
+  r.stage_ns[2] = c->rec_index_ns;
+  uint64_t sum = c->rec_recv_ns + c->rec_append_ns + c->rec_index_ns;
+  r.stage_ns[3] = total_ns > sum ? total_ns - sum : 0;
+  r.bytes = c->rec_bytes;
+  r.deadline_ms = c->deadline_ms;
+  r.status = status;
+  r.fallback = fallback;
+  r.flags = rid_rec_flags(c->rid, c->rid_client);
+  rec_push(&s->rec, r);
 }
 
 void set_nonblock(int fd) {
@@ -366,7 +506,9 @@ uint64_t query_u64(const std::string& q, const char* key) {
 bool append_plain(Server* s, VolumeState* vol, uint32_t vid,
                   uint64_t key, uint32_t cookie, const uint8_t* data,
                   size_t len, uint64_t last_modified, WpEntry* out,
-                  bool* journal_full) {
+                  bool* journal_full, uint64_t* append_ns_out,
+                  uint64_t* index_ns_out) {
+  uint64_t t_enter = mono_ns();
   // Size field: DataSize(4) + data + flags(1) + lastModified(5)
   int32_t size = (int32_t)(4 + len + 1 + kLastModifiedLen);
   uint32_t crc = crc32c(data, len);
@@ -432,6 +574,7 @@ bool append_plain(Server* s, VolumeState* vol, uint32_t vid,
     left -= (size_t)w;
   }
   vol->tail = off + rec.size();
+  uint64_t t_written = mono_ns();
   vol->keys.insert(key);
   out->key = key;
   out->offset = off;
@@ -441,6 +584,8 @@ bool append_plain(Server* s, VolumeState* vol, uint32_t vid,
   out->size = size;
   out->data_len = (uint32_t)len;
   vol->journal.push_back(*out);
+  if (append_ns_out != nullptr) *append_ns_out = t_written - t_enter;
+  if (index_ns_out != nullptr) *index_ns_out = mono_ns() - t_written;
   (void)s;
   return true;
 }
@@ -449,6 +594,10 @@ bool append_plain(Server* s, VolumeState* vol, uint32_t vid,
 // c->body).  Appends the response to c->out, or parks it on an fsync
 // epoch.  Returns false when the connection must close.
 bool handle_request(Server* s, Conn* c) {
+  c->rec_recv_ns = mono_ns() - c->start_ns;   // body-receive window
+  c->rec_append_ns = 0;
+  c->rec_index_ns = 0;
+  c->rec_bytes = c->body.size();
   const std::string& req = c->req_headers;
   size_t sp1 = req.find(' ');
   size_t sp2 = (sp1 == std::string::npos) ? std::string::npos
@@ -459,6 +608,7 @@ bool handle_request(Server* s, Conn* c) {
   if (method != "POST" && method != "PUT") {
     respond(c, c->out, "405 Method Not Allowed",
             "{\"error\":\"write plane accepts POST only\"}");
+    rec_emit(s, c, mono_ns() - c->start_ns, 405, kFbNotPlain);
     return true;
   }
   std::string query;
@@ -486,18 +636,23 @@ bool handle_request(Server* s, Conn* c) {
   }
   WpEntry ent{};
   bool parked = false;
+  int fb = kFbNotPlain;
   if (plain) {
     std::shared_lock<std::shared_mutex> reg(s->reg_mu);
     auto it = s->volumes.find(vid);
     VolumeState* vol =
         (it == s->volumes.end()) ? nullptr : it->second;
+    fb = vol == nullptr ? kFbUnregistered : fb;
     if (vol != nullptr) {
       {
         std::lock_guard<std::mutex> lk(vol->mu);
         // unarmed = registered but keys not yet marked (the attach
         // is mid-handshake): accepting a write here could let an
         // overwrite of an existing key bypass Python's cookie check
-        if (!vol->armed || vol->keys.count(key)) vol = nullptr;
+        if (!vol->armed || vol->keys.count(key)) {
+          vol = nullptr;
+          fb = kFbSeenKey;
+        }
       }
       if (vol != nullptr) {
         uint64_t ts = query_u64(query, "ts");
@@ -505,7 +660,8 @@ bool handle_request(Server* s, Conn* c) {
         bool journal_full = false;
         if (append_plain(s, vol, vid, key, cookie,
                          (const uint8_t*)c->body.data(),
-                         c->body.size(), ts, &ent, &journal_full)) {
+                         c->body.size(), ts, &ent, &journal_full,
+                         &c->rec_append_ns, &c->rec_index_ns)) {
           char body[128];
           int n = snprintf(body, sizeof body,
                            "{\"name\":\"\",\"size\":%zu,"
@@ -533,13 +689,16 @@ bool handle_request(Server* s, Conn* c) {
             }
           } else {
             c->out.append(resp);
-            note_latency(s, mono_ns() - c->start_ns);
+            uint64_t total = mono_ns() - c->start_ns;
+            note_latency(s, total);
+            rec_emit(s, c, total, 201, kFbNone);
           }
           c->body.clear();
           c->body.shrink_to_fit();
           (void)parked;
           return true;
         }
+        fb = journal_full ? kFbJournalFull : kFbIoError;
       }
     }
   }
@@ -547,6 +706,7 @@ bool handle_request(Server* s, Conn* c) {
   s->fallbacks.fetch_add(1, std::memory_order_relaxed);
   respond(c, c->out, "404 Not Found",
           "{\"error\":\"write plane fallback\"}");
+  rec_emit(s, c, mono_ns() - c->start_ns, 404, fb);
   c->body.clear();
   c->body.shrink_to_fit();
   return true;
@@ -578,6 +738,18 @@ bool feed(Server* s, Conn* c) {
       c->in.erase(0, end + 4);
       c->have_headers = true;
       c->start_ns = mono_ns();
+      std::string rv = header_value(c->req_headers, "X-Request-ID");
+      if (!rv.empty()) {
+        snprintf(c->rid, sizeof(c->rid), "%.39s", rv.c_str());
+        c->rid_client = true;
+      } else {
+        snprintf(c->rid, sizeof(c->rid), "%s-%llx", s->rid_prefix,
+                 (unsigned long long)(++s->rid_seq));
+        c->rid_client = false;
+      }
+      std::string dv =
+          header_value(c->req_headers, "X-Weed-Deadline-Ms");
+      c->deadline_ms = dv.empty() ? -1 : atoll(dv.c_str());
       std::string te = header_value(c->req_headers,
                                     "Transfer-Encoding");
       if (!te.empty()) return false;       // chunked: Python port
@@ -620,7 +792,9 @@ void release_epochs(Server* s) {
         c->parked = false;
         c->out.append(c->pending);
         c->pending.clear();
-        note_latency(s, mono_ns() - c->start_ns);
+        uint64_t total = mono_ns() - c->start_ns;
+        note_latency(s, total);
+        rec_emit(s, c, total, 201, kFbNone);
         break;
       }
     }
@@ -739,6 +913,10 @@ int wp_start(const char* host, int port, int* bound_port) {
   }
   Server* s = g_servers[slot];
   for (int i = 0; i <= kNumLat; i++) s->lat_count[i].store(0);
+  s->rec.cap = rec_ring_cap_env();
+  s->rec.recs.resize(s->rec.cap);
+  snprintf(s->rid_prefix, sizeof(s->rid_prefix), "wp%02d%06llx", slot,
+           (unsigned long long)(now_ns() & 0xffffff));
   s->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (s->listen_fd < 0) return -1;
   int one = 1;
@@ -1067,6 +1245,19 @@ int wp_latency(int h, unsigned long long* out) {
   out[kNumLat + 1] = total;
   out[kNumLat + 2] = s->lat_sum_ns.load(std::memory_order_relaxed);
   return kNumLat + 1;        // bucket cells written
+}
+
+// drain up to `cap` per-request flight records (oldest first; the
+// Python side serializes drainers with a lock)
+int wp_drain_records(int h, PlaneRec* out, int cap) {
+  Server* s = get_server(h);
+  if (s == nullptr) return -1;
+  return rec_drain(&s->rec, out, cap);
+}
+
+unsigned long long wp_records_dropped(int h) {
+  Server* s = get_server(h);
+  return s != nullptr ? rec_dropped(&s->rec) : 0;
 }
 
 }  // extern "C"

@@ -13,6 +13,7 @@ from dataclasses import dataclass
 from . import security
 from .server.httpd import http_bytes, http_json
 from .util import deadline as _deadline
+from .util import request_id
 
 
 class VidCache:
@@ -494,8 +495,20 @@ def _plane_request(addr: str, method: str, path: str,
     socks = getattr(_plane_local, "socks", None)
     if socks is None:
         socks = _plane_local.socks = {}
+    # stitch headers (ISSUE 18): the plane records the request id into
+    # its flight ring and forwards it on the upstream plane hop, so a
+    # plane-served request traces under the same id as its Python hops
+    extra = ""
+    rid = request_id.get_request_id()
+    if rid:
+        extra += f"{request_id.HEADER}: {rid}\r\n"
+    d = _deadline.get()
+    if d is not None:
+        remaining_ms = int(d.remaining() * 1e3)
+        if remaining_ms > 0:
+            extra += f"{_deadline.HEADER}: {remaining_ms}\r\n"
     req = (f"{method} {path} HTTP/1.1\r\n"
-           f"Host: {addr}\r\n"
+           f"Host: {addr}\r\n{extra}"
            f"Content-Length: {len(body)}\r\n\r\n").encode()
     end = time.monotonic() + timeout
 

@@ -814,6 +814,10 @@ class FlightRecorder:
         self._threshold: "float | None" = None
         self._rate_window_start = 0.0
         self._rate_window_count = 0
+        # injectable for tests: a real-time 1 s window can roll over
+        # mid-assertion on a degraded box; pinning the clock makes the
+        # rate-cap behavior deterministic
+        self._now = time.monotonic
         self.captured = 0
         self.dropped_rate_limited = 0
 
@@ -837,10 +841,26 @@ class FlightRecorder:
                 self._threshold = None if p95 is None else \
                     max(p95, slow_floor_s())
 
+    def note_walls(self, walls: "list[float]") -> None:
+        """Bulk _note_wall for the native-plane record drain: train
+        the slow threshold on a whole batch with one tracker lock
+        round and at most one quantile refresh."""
+        if not walls:
+            return
+        self._tracker.note_many(walls)
+        with self._lock:
+            self._notes_since_quantile += len(walls)
+            if self._threshold is None or \
+                    self._notes_since_quantile >= 32:
+                self._notes_since_quantile = 0
+                p95 = self._tracker.quantile(0.95)
+                self._threshold = None if p95 is None else \
+                    max(p95, slow_floor_s())
+
     def _rate_ok(self) -> bool:
         """Token check for threshold-only captures (caller holds no
         lock): a 1-second window capped at capture_rate()."""
-        now = time.monotonic()
+        now = self._now()
         with self._lock:
             if now - self._rate_window_start >= 1.0:
                 self._rate_window_start = now
@@ -949,6 +969,335 @@ def flight_recorder() -> FlightRecorder:
             if r is None:
                 r = _recorder = FlightRecorder()
     return r
+
+
+# -- native-plane flight deck (ISSUE 18) ----------------------------------
+#
+# The C++ planes record every request into a lock-free ring (PlaneRec
+# in the .cc files / native.PlaneRecord on this side); the drainer
+# threads in server/meta_plane_native.py and server/volume_server.py
+# pull the rings on a tick + at /debug/slow scrape time and feed each
+# record through a PlaneRecordSink — LatencyTracker training, stage
+# tail histograms, synthesized trace spans, FlightRecorder captures.
+# Python stays off the request path: the plane never waits on the
+# drain, and a dead drainer only costs observability.
+
+_plane_drain_disarmed = False
+
+
+def set_plane_drain_disarmed(disarmed: bool) -> None:
+    """Runtime kill switch (POST /debug/attribution scope "drain",
+    and the bench's within-cluster drain-on/off A/B lever)."""
+    global _plane_drain_disarmed
+    _plane_drain_disarmed = bool(disarmed)
+
+
+def plane_drain_enabled() -> bool:
+    """SEAWEEDFS_TPU_PLANE_DRAIN=0 disarms the plane-record drain
+    entirely (records still accumulate C-side and fall off the ring);
+    the runtime lever disarms it the same way."""
+    if _plane_drain_disarmed:
+        return False
+    return os.environ.get("SEAWEEDFS_TPU_PLANE_DRAIN", "1") \
+        not in ("0", "false")
+
+
+def plane_drain_interval_s() -> float:
+    """SEAWEEDFS_TPU_PLANE_DRAIN_MS: drainer tick (how stale the
+    Python view of the plane rings may go between scrapes)."""
+    return max(10.0,
+               _env_float("SEAWEEDFS_TPU_PLANE_DRAIN_MS", 200.0)) / 1e3
+
+
+# scrape-time hooks: /debug/slow runs these before snapshotting so a
+# just-finished plane request is drained into the recorder the scrape
+# is about to read, instead of waiting out the drainer tick
+_scrape_hooks: "list" = []
+_scrape_hooks_lock = threading.Lock()
+
+
+def register_scrape_hook(fn) -> None:
+    with _scrape_hooks_lock:
+        if fn not in _scrape_hooks:
+            _scrape_hooks.append(fn)
+
+
+def unregister_scrape_hook(fn) -> None:
+    with _scrape_hooks_lock:
+        try:
+            _scrape_hooks.remove(fn)
+        except ValueError:
+            pass
+
+
+def run_scrape_hooks() -> None:
+    with _scrape_hooks_lock:
+        hooks = list(_scrape_hooks)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception:  # noqa: SWFS004 — a hook must never 500 a
+            pass           # scrape
+
+
+_PLANE_STAGE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                        0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                        1.0, 2.5)
+
+
+class PlaneRecordSink:
+    """Fan one plane's drained flight records into the Python
+    observability planes.
+
+    Per record: the wall (sum of stage ns) trains `tracker` (the
+    hedge/brownout/capture LatencyTracker for the role) and the
+    per-stage tail histograms; every record feeds
+    FlightRecorder.observe so plane traffic trains the slow
+    threshold; a span tree is synthesized (tracing.emit_plane_hop)
+    only for records that can stitch or will be captured — client-rid
+    records, errors, and records at/over the current slow threshold —
+    so the lean all-minted-rid bench drain stays allocation-cheap."""
+
+    def __init__(self, role: str, plane: str, method: str,
+                 stage_names: "tuple[str, ...]",
+                 fallback_names: "tuple[str, ...]",
+                 tracker=None, metrics=None):
+        from . import native as _native
+        self.role = role
+        self.plane = plane
+        self.method = method
+        self.stage_names = stage_names
+        self.fallback_names = fallback_names
+        self.tracker = tracker
+        self.metrics = metrics if metrics is not None \
+            else _process_metrics()
+        self._client_rid_flag = _native.PLANE_RECORD_CLIENT_RID
+        self._minted_rid_flag = _native.PLANE_RECORD_MINTED_UPSTREAM
+        self._stage_obs = [
+            self.metrics.observer(
+                "plane_stage_seconds", _PLANE_STAGE_BUCKETS,
+                help_text="native-plane per-request stage latency "
+                          "(drained from the C++ flight ring)",
+                plane=plane, stage=s)
+            for s in stage_names]
+        self._stage_batch_obs = [
+            self.metrics.batch_observer(
+                "plane_stage_seconds", _PLANE_STAGE_BUCKETS,
+                plane=plane, stage=s)
+            for s in stage_names]
+        self.records = 0
+        self.captures = 0
+
+    def _observe_one(self, fr, rid: str, start_s: float,
+                     stage_s: "list[float]", wall: float, status: int,
+                     fb: int, flags: int, nbytes: int,
+                     deadline_ms: int) -> None:
+        """The interesting-record path: span synthesis + the
+        FlightRecorder capture decision.  Only stitchable (client
+        rid), error, and at/over-threshold records reach here — the
+        lean minted-rid bulk must never pay these allocations."""
+        fb_name = self.fallback_names[fb] \
+            if 0 <= fb < len(self.fallback_names) else "?"
+        error = status >= 500
+        thr = fr.threshold()
+        # a forwarded plane-minted rid is not a client trace: it only
+        # earns spans when the record is independently interesting
+        # (and then the rid still stitches the cross-role tree)
+        stitchable = bool(flags & self._client_rid_flag) and \
+            not (flags & self._minted_rid_flag)
+        if stitchable or error or (thr is not None and wall >= thr):
+            from . import tracing
+            tracing.emit_plane_hop(
+                f"{self.method} [{self.plane}-plane]", self.role,
+                rid, start_s, wall,
+                list(zip(self.stage_names, stage_s)),
+                attrs={"status": status, "bytes": nbytes,
+                       "fallback": fb_name},
+                error=error)
+        notes = {"plane": self.plane, "bytes": nbytes}
+        if fb_name != "none":
+            notes["fallback"] = fb_name
+        deadline = None
+        if deadline_ms >= 0:
+            deadline = {"remainingMs": int(deadline_ms)}
+        # StageTrack-summary shape: _render_slow_hop reads
+        # rec["stages"]["stages"]
+        stages = {"track": f"{self.plane}_plane",
+                  "wallMs": round(wall * 1e3, 3),
+                  "stages": {s: {"wallMs": round(v * 1e3, 3)}
+                             for s, v in zip(self.stage_names,
+                                             stage_s)
+                             if v > 0.0}}
+        if fr.observe(self.role, self.method,
+                      f"[{self.plane}-plane]", status, wall,
+                      verdict="error" if error else "ok",
+                      trace_id=rid, deadline=deadline,
+                      stages=stages, notes=notes) is not None:
+            self.captures += 1
+
+    def feed(self, records) -> int:
+        """Consume one drained batch (native.PlaneRecord instances);
+        returns how many were fed."""
+        n = 0
+        fr = flight_recorder()
+        rec_on = recorder_enabled()
+        thr = fr.threshold()
+        for rec in records:
+            n += 1
+            stage_s = [ns / 1e9 for ns in rec.stage_ns]
+            wall = sum(stage_s)
+            for obs, s in zip(self._stage_obs, stage_s):
+                if s > 0.0:
+                    obs(s)
+            if self.tracker is not None:
+                self.tracker.note(wall)
+            if not rec_on:
+                continue
+            status = int(rec.status)
+            flags = int(rec.flags)
+            stitch = (flags & self._client_rid_flag) and \
+                not (flags & self._minted_rid_flag)
+            if status < 500 and not stitch and \
+                    (thr is None or wall < thr):
+                # the lean bulk: train the slow threshold, skip the
+                # rid decode and record-dict allocations entirely
+                fr._note_wall(wall)
+                continue
+            self._observe_one(
+                fr, rec.rid.decode("ascii", "replace"),
+                rec.start_unix_ns / 1e9, stage_s, wall, status,
+                int(rec.fallback), int(rec.flags), int(rec.bytes),
+                int(rec.deadline_ms))
+        self.records += n
+        if n:
+            self.metrics.counter_add(
+                "plane_records_total", float(n),
+                help_text="flight records drained from the native "
+                          "plane rings", plane=self.plane)
+        return n
+
+    def feed_buffer(self, buf, n: int) -> int:
+        """Vectorized drain hot path over the reused ctypes batch
+        buffer (native.drain_plane_records hands it straight here).
+        Per-record Python fan-out measured ~30% of this box's one
+        core at a few thousand plane req/s; the numpy path pays one
+        array view, one bincount per stage histogram, and one lock
+        round per shared structure, touching Python objects only for
+        the rare stitchable/error/slow records."""
+        if n <= 0:
+            return 0
+        try:
+            import numpy as np
+        except ImportError:  # pragma: no cover — numpy ships here
+            return self.feed(buf[i] for i in range(n))
+        from . import native as _native
+        arr = np.frombuffer(buf, dtype=_native.plane_record_dtype(),
+                            count=n)
+        stage_s = arr["stage_ns"] / 1e9      # (n, nstages) float64
+        wall = stage_s.sum(axis=1)
+        for i, obs_b in enumerate(self._stage_batch_obs):
+            col = stage_s[:, i]
+            obs_b(col[col > 0.0])
+        if self.tracker is not None:
+            self.tracker.note_many(wall.tolist())
+        self.records += n
+        self.metrics.counter_add(
+            "plane_records_total", float(n),
+            help_text="flight records drained from the native "
+                      "plane rings", plane=self.plane)
+        fr = flight_recorder()
+        if not recorder_enabled():
+            return n
+        thr = fr.threshold()
+        fl = arr["flags"]
+        stitch = ((fl & self._client_rid_flag) != 0) & \
+            ((fl & self._minted_rid_flag) == 0)
+        mask = (arr["status"] >= 500) | stitch
+        if thr is not None:
+            mask = mask | (wall >= thr)
+        fr.note_walls(wall[~mask].tolist())
+        for i in np.nonzero(mask)[0].tolist():
+            self._observe_one(
+                fr,
+                bytes(arr["rid"][i]).split(b"\0", 1)[0].decode(
+                    "ascii", "replace"),
+                float(arr["start_unix_ns"][i]) / 1e9,
+                [float(x) for x in stage_s[i]], float(wall[i]),
+                int(arr["status"][i]), int(arr["fallback"][i]),
+                int(arr["flags"][i]), int(arr["bytes"][i]),
+                int(arr["deadline_ms"][i]))
+        return n
+
+    def note_dropped(self, total_dropped: int, last_seen: int) -> int:
+        """Publish the ring's monotonic dropped count as a counter
+        delta; returns the new last-seen value for the caller to
+        carry."""
+        delta = total_dropped - last_seen
+        if delta > 0:
+            self.metrics.counter_add(
+                "plane_ring_dropped_total", float(delta),
+                help_text="flight records overwritten in the native "
+                          "ring before the drainer reached them",
+                plane=self.plane)
+        return max(total_dropped, last_seen)
+
+
+class PlaneRecordDrainer:
+    """Consumer side of one plane's flight ring: a tick thread
+    (SEAWEEDFS_TPU_PLANE_DRAIN_MS) plus on-demand pulls at
+    /debug/slow scrape time, serialized by a lock — the C ring is
+    single-consumer, so every pull path must go through drain_now.
+
+    `drain_fn(sink) -> int` runs one native drain pass (the wrapper
+    method, which no-ops after the plane stopped); `dropped_fn()`
+    reads the ring's monotonic drop counter."""
+
+    def __init__(self, sink: PlaneRecordSink, drain_fn, dropped_fn):
+        self.sink = sink
+        self._drain_fn = drain_fn
+        self._dropped_fn = dropped_fn
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._dropped_seen = 0
+        self._thread: "threading.Thread | None" = None
+
+    def start(self) -> "PlaneRecordDrainer":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"weed-plane-drain-{self.sink.plane}")
+        self._thread.start()
+        register_scrape_hook(self.drain_now)
+        return self
+
+    def drain_now(self) -> int:
+        """One drain pass; safe from any thread, any time (including
+        after stop — the wrapper's drain_fn checks its handle)."""
+        if not plane_drain_enabled():
+            return 0
+        with self._lock:
+            n = self._drain_fn(self.sink)
+            self._dropped_seen = self.sink.note_dropped(
+                int(self._dropped_fn()), self._dropped_seen)
+            return n
+
+    def _run(self) -> None:
+        while not self._stop.wait(plane_drain_interval_s()):
+            try:
+                self.drain_now()
+            except Exception:  # noqa: SWFS004 — a drain failure
+                pass           # costs observability, never the drainer
+
+    def stop(self) -> None:
+        """Join the tick thread BEFORE the native server stops: the
+        drain callable dereferences the plane handle."""
+        unregister_scrape_hook(self.drain_now)
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        try:
+            self.drain_now()   # final pass: nothing left un-drained
+        except Exception:      # noqa: SWFS004
+            pass
 
 
 # -- scheduler-delay probe -------------------------------------------------

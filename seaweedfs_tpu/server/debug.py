@@ -116,6 +116,10 @@ def _slow_get(req: Request):
     `weed shell cluster.slow` fans this endpoint out and merges
     records by trace id across roles."""
     from .. import profiling
+    # drain the native-plane flight rings first (ISSUE 18): a scrape
+    # must see plane requests that finished since the last drainer
+    # tick, or cluster.slow races the tick
+    profiling.run_scrape_hooks()
     return 200, profiling.flight_recorder().snapshot()
 
 
@@ -133,28 +137,36 @@ def _attr_get(req: Request):
     from .. import profiling
     scope = profiling.attribution_disarmed()
     return 200, {"disarmed": scope is not None,
-                 "scope": scope or ""}
+                 "scope": scope or "",
+                 "drainEnabled": profiling.plane_drain_enabled()}
 
 
 def _attr_post(req: Request):
-    """{"disarmed": true|false, "scope": "all"|"plane"} — runtime
-    kill/restore switch for the cost-attribution plane in this
-    process, no restart needed.  Scope "all" (default) disarms
+    """{"disarmed": true|false, "scope": "all"|"plane"|"drain"} —
+    runtime kill/restore switch for the cost-attribution plane in
+    this process, no restart needed.  Scope "all" (default) disarms
     everything including the wall-stage decomposition; "plane"
     disarms only the ISSUE 15 additions (CPU clocks, flight
-    recorder).  Also the lever behind bench.py's within-cluster
-    attribution-overhead A/B: separate clusters cannot resolve a
-    ~1% cost under arm-to-arm boot noise, alternating armed/disarmed
-    traffic windows on ONE cluster can."""
+    recorder); "drain" disarms only the ISSUE 18 native-plane
+    flight-record drain (records keep accumulating C-side and age
+    off the ring).  Also the lever behind bench.py's within-cluster
+    overhead A/Bs: separate clusters cannot resolve a ~1% cost under
+    arm-to-arm boot noise, alternating armed/disarmed traffic
+    windows on ONE cluster can."""
     from .. import profiling
     b = req.json()
     if "disarmed" not in b:
         return 400, {"error": "body needs disarmed: true|false"}
-    profiling.set_attribution_disarmed(
-        bool(b["disarmed"]), scope=str(b.get("scope", "all")))
+    scope_in = str(b.get("scope", "all"))
+    if scope_in == "drain":
+        profiling.set_plane_drain_disarmed(bool(b["disarmed"]))
+    else:
+        profiling.set_attribution_disarmed(
+            bool(b["disarmed"]), scope=scope_in)
     scope = profiling.attribution_disarmed()
     return 200, {"disarmed": scope is not None,
-                 "scope": scope or ""}
+                 "scope": scope or "",
+                 "drainEnabled": profiling.plane_drain_enabled()}
 
 
 def _faults_get(req: Request):

@@ -194,6 +194,10 @@ class FilerServer:
             self.filer.meta_plane.sink = \
                 self.native_meta.on_follower_events
             self.native_meta.arm(True)
+            # flight-deck drainer (ISSUE 18): pull the plane's
+            # per-request records into traces / FlightRecorder /
+            # stage histograms on a tick + at /debug/slow scrape
+            self.native_meta.start_record_drain()
         self.http.route("GET", "/status", self._status)
         self.http.route("POST", "/debug/meta_plane",
                         self._debug_meta_plane)
@@ -442,8 +446,17 @@ class FilerServer:
             nm.arm(True)
         elif want in ("off", "0", "false"):
             nm.arm(False)
+        if "uploadDelayMs" in b:
+            # ISSUE 18 failpoint: stall the native volume-upload hop
+            # so a plane-served write lands in cluster.slow on demand
+            try:
+                nm.set_upload_delay_ms(int(b.get("uploadDelayMs")
+                                           or 0))
+            except (TypeError, ValueError):
+                pass
         return 200, {"armed": nm.armed, "port": nm.port,
                      "fidLevel": max(nm.fid_level(), 0),
+                     "recordsDropped": nm.records_dropped(),
                      **nm.stats()}
 
     def start(self):

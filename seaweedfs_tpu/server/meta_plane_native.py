@@ -62,6 +62,12 @@ _STATS_KEYS = ("requests", "fallbacks", "fid_misses", "wal_errors",
                "upstream_errors", "parse_ns", "upload_ns", "wal_ns",
                "wal_batches", "wal_lines")
 
+# flight-record label tables (meta_plane.cc kRecStageNames /
+# kRecFallbackNames — the SWFS019 lint pins the literals in sync)
+RECORD_STAGES = ("parse", "upload", "wal", "ack")
+RECORD_FALLBACKS = ("none", "ineligible", "fid_dry", "upstream",
+                    "wal", "oversize", "chunked")
+
 
 def native_meta_plane_enabled() -> "bool | None":
     """SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE: '0' forces off, '1'
@@ -101,6 +107,7 @@ class NativeMetaPlane:
         self.replication = replication
         self._stop = threading.Event()
         self._armed = False
+        self._drainer = None
         self._feeder = threading.Thread(
             target=self._feed_loop, args=(feed_interval,), daemon=True)
         self._feeder.start()
@@ -260,13 +267,52 @@ class NativeMetaPlane:
         buckets = [int(out[i]) for i in range(cells + 1)]
         return buckets, int(out[cells + 1]), out[cells + 2] / 1e9
 
+    # -- flight records (ISSUE 18) --------------------------------------
+
+    def drain_records(self, sink=None, cap: int = 512):
+        """Pull the plane's flight ring (see native.drain_plane_records
+        for the sink-vs-list contract).  Single-consumer: concurrent
+        pulls must be serialized by the owning PlaneRecordDrainer."""
+        if self._h < 0:
+            return [] if sink is None else 0
+        return native.drain_plane_records(self._lib, "mp", self._h,
+                                          sink, cap)
+
+    def records_dropped(self) -> int:
+        return int(self._lib.mp_records_dropped(self._h)) \
+            if self._h >= 0 else 0
+
+    def set_upload_delay_ms(self, ms: int) -> None:
+        """Failpoint: stall the volume upload hop of every native
+        request by `ms` (the ISSUE 18 acceptance lever — a slowed
+        plane-served write must surface in cluster.slow)."""
+        if self._h >= 0:
+            self._lib.mp_set_upload_delay_ms(self._h, int(ms))
+
+    def start_record_drain(self, tracker=None,
+                           metrics=None) -> "object":
+        """Start the flight-record drainer (tick + scrape hook);
+        idempotent.  Returns the profiling.PlaneRecordDrainer."""
+        if self._drainer is not None:
+            return self._drainer
+        from .. import profiling
+        sink = profiling.PlaneRecordSink(
+            "filer", "meta", "POST", RECORD_STAGES, RECORD_FALLBACKS,
+            tracker=tracker, metrics=metrics)
+        self._drainer = profiling.PlaneRecordDrainer(
+            sink, lambda s: self.drain_records(sink=s),
+            self.records_dropped).start()
+        return self._drainer
+
     def stop(self) -> None:
-        """Feeder first, then the native server: mp_stop frees the
-        Server object, so no wrapper thread may still be inside an
-        mp_* call when it runs."""
+        """Feeder + drainer first, then the native server: mp_stop
+        frees the Server object, so no wrapper thread may still be
+        inside an mp_* call when it runs."""
         if self._h < 0:
             return
         self._stop.set()
         self._feeder.join(timeout=5)
+        if self._drainer is not None:
+            self._drainer.stop()
         self._lib.mp_stop(self._h)
         self._h = -1

@@ -31,6 +31,11 @@ from ..storage import types as storage_types
 # header (cookie 4 + id 8 + size 4) + DataSize field (4)
 _DATA_OFFSET_IN_RECORD = storage_types.NEEDLE_HEADER_SIZE + 4
 
+# flight-record label tables (read_plane.cc kRecStageNames /
+# kRecFallbackNames — the SWFS019 lint pins the literals in sync)
+RECORD_STAGES = ("parse", "lookup", "send", "ack")
+RECORD_FALLBACKS = ("none", "method", "bad_request", "not_found")
+
 
 def needle_is_plain(n) -> bool:
     """True when the needle's HTTP semantics are fully captured by raw
@@ -90,7 +95,44 @@ class ReadPlane:
     def served(self) -> int:
         return self._lib.rp_served(self._h)
 
+    # -- flight records (ISSUE 18) --------------------------------------
+
+    def drain_records(self, sink=None, cap: int = 512):
+        """Pull the plane's flight ring (see native.drain_plane_records
+        for the sink-vs-list contract).  Single-consumer: concurrent
+        pulls must be serialized by the owning PlaneRecordDrainer."""
+        if self._h < 0:
+            return [] if sink is None else 0
+        return native.drain_plane_records(self._lib, "rp", self._h,
+                                          sink, cap)
+
+    def records_dropped(self) -> int:
+        return int(self._lib.rp_records_dropped(self._h)) \
+            if self._h >= 0 else 0
+
+    def start_record_drain(self, tracker=None,
+                           metrics=None) -> "object":
+        """Start the flight-record drainer (tick + scrape hook);
+        idempotent.  The read plane's tracker defaults to the hedge
+        read_tracker so plane-served reads train the hedged-read p95
+        (the ISSUE 18 'plane traffic trains the thresholds' goal)."""
+        if getattr(self, "_drainer", None) is not None:
+            return self._drainer
+        from .. import profiling
+        if tracker is None:
+            from ..util import hedge
+            tracker = hedge.read_tracker
+        sink = profiling.PlaneRecordSink(
+            "volume", "read", "GET", RECORD_STAGES, RECORD_FALLBACKS,
+            tracker=tracker, metrics=metrics)
+        self._drainer = profiling.PlaneRecordDrainer(
+            sink, lambda s: self.drain_records(sink=s),
+            self.records_dropped).start()
+        return self._drainer
+
     def stop(self) -> None:
         if self._h >= 0:
+            if getattr(self, "_drainer", None) is not None:
+                self._drainer.stop()
             self._lib.rp_stop(self._h)
             self._h = -1

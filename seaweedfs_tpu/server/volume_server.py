@@ -219,6 +219,9 @@ class VolumeServer:
             self._rp_queue = queue.Queue(maxsize=4096)
             threading.Thread(target=self._rp_worker,
                              daemon=True).start()
+            # flight-deck drainer (ISSUE 18): plane-served reads train
+            # the hedge read_tracker + feed the flight recorder
+            self.read_plane.start_record_drain()
         # native TCP WRITE plane (native/write_plane.cc — the C++
         # sibling of the read plane on the needle-write hot path):
         # plain anonymous uploads are recv'd, serialized, appended and
@@ -245,6 +248,7 @@ class VolumeServer:
             for loc in self.store.locations:
                 for vid in list(loc.volumes):
                     self._wp_sync_volume(vid)
+            self.write_plane.start_record_drain()
         # gRPC wire plane (volume_server.proto subset) — optional;
         # JSON-HTTP stays the always-on surface
         try:

@@ -47,6 +47,12 @@ ACK_BUCKETS_S = (1e-6, 2e-6, 5e-6, 1e-5, 2e-5, 5e-5, 1e-4, 2e-4,
 NativeWrite = namedtuple(
     "NativeWrite", "key offset append_ns vid cookie size data_len")
 
+# flight-record label tables (write_plane.cc kRecStageNames /
+# kRecFallbackNames — the SWFS019 lint pins the literals in sync)
+RECORD_STAGES = ("recv", "append", "index", "ack")
+RECORD_FALLBACKS = ("none", "not_plain", "unregistered", "seen_key",
+                    "journal_full", "io_error")
+
 
 class WritePlane:
     """One native write-plane server bound to <host>:<ephemeral>.
@@ -75,6 +81,7 @@ class WritePlane:
         self._on_epoch = on_epoch
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        self._drainer = None
         self._epoch_started = False
         self._epoch_lock = threading.Lock()
         if on_tick is not None:
@@ -168,6 +175,36 @@ class WritePlane:
         buckets = [int(out[i]) for i in range(cells)]
         return buckets, int(out[cells]), out[cells + 1] / 1e9
 
+    # -- flight records (ISSUE 18) --------------------------------------
+
+    def drain_records(self, sink=None, cap: int = 512):
+        """Pull the plane's flight ring (see native.drain_plane_records
+        for the sink-vs-list contract).  Single-consumer: concurrent
+        pulls must be serialized by the owning PlaneRecordDrainer."""
+        if self._h < 0:
+            return [] if sink is None else 0
+        return native.drain_plane_records(self._lib, "wp", self._h,
+                                          sink, cap)
+
+    def records_dropped(self) -> int:
+        return int(self._lib.wp_records_dropped(self._h)) \
+            if self._h >= 0 else 0
+
+    def start_record_drain(self, tracker=None,
+                           metrics=None) -> "object":
+        """Start the flight-record drainer (tick + scrape hook);
+        idempotent.  Returns the profiling.PlaneRecordDrainer."""
+        if getattr(self, "_drainer", None) is not None:
+            return self._drainer
+        from .. import profiling
+        sink = profiling.PlaneRecordSink(
+            "volume", "write", "POST", RECORD_STAGES,
+            RECORD_FALLBACKS, tracker=tracker, metrics=metrics)
+        self._drainer = profiling.PlaneRecordDrainer(
+            sink, lambda s: self.drain_records(sink=s),
+            self.records_dropped).start()
+        return self._drainer
+
     # -- background threads ---------------------------------------------
 
     def _pump_loop(self, interval: float) -> None:
@@ -211,5 +248,7 @@ class WritePlane:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=5)
+        if getattr(self, "_drainer", None) is not None:
+            self._drainer.stop()
         self._lib.wp_stop(self._h)
         self._h = -1

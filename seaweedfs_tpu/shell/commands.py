@@ -1306,6 +1306,39 @@ def _native_plane_report(before: "dict[str, list]",
         if batches > 0:
             seg += f" wal-batch={lines / batches:.1f}"
         parts.append(seg)
+    # per-stage tails from the drained flight records (ISSUE 18): the
+    # plane_stage_seconds family is fed by the Python drainer, so each
+    # plane's stage decomposition shows up windowed, like every other
+    # cluster.top figure
+    sname = "seaweedfs_tpu_plane_stage_seconds"
+    planes = sorted({l.get("plane", "") for l, _v in
+                     after.get(f"{sname}_count", []) if l.get("plane")})
+    from ..server.meta_plane_native import (
+        RECORD_STAGES as _META_STAGES)
+    from ..server.read_plane import RECORD_STAGES as _READ_STAGES
+    from ..server.write_plane import RECORD_STAGES as _WRITE_STAGES
+    stage_order = {"meta": _META_STAGES, "write": _WRITE_STAGES,
+                   "read": _READ_STAGES}
+    for plane in planes:
+        segs = []
+        for stg in stage_order.get(plane, ()):
+            h = profiling.histogram_delta(
+                profiling.prom_histogram(
+                    after, sname, {"plane": plane, "stage": stg}),
+                profiling.prom_histogram(
+                    before, sname, {"plane": plane, "stage": stg}))
+            if h and h.get("count"):
+                p99 = profiling.histogram_quantile(h, 0.99)
+                segs.append(f"{stg}-p99={p99 * 1e3:.2f}ms")
+        dropped = _counter_sum(
+            after, "seaweedfs_tpu_plane_ring_dropped_total",
+            {"plane": plane}) - _counter_sum(
+            before, "seaweedfs_tpu_plane_ring_dropped_total",
+            {"plane": plane})
+        if dropped > 0:
+            segs.append(f"ring-dropped={dropped:.0f}")
+        if segs:
+            parts.append(f"{plane}-stages " + " ".join(segs))
     if not parts:
         return ""
     return "native-planes: " + "  ".join(parts)

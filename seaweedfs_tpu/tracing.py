@@ -304,6 +304,33 @@ def emit_span_batch(items: "list[dict]") -> None:
         _buffer_extend(out)
 
 
+def emit_plane_hop(name: str, role: str, trace_id: str,
+                   start: float, duration: float,
+                   stages: "list[tuple[str, float]]",
+                   attrs: "dict | None" = None,
+                   error: bool = False) -> dict:
+    """Synthesize one native-plane hop as a span tree: a root hop
+    span plus one child per non-zero stage (ISSUE 18 — the C++ planes
+    record stage ns in their flight ring; the Python drainer calls
+    this post-hoc, so plane-served requests stitch into the same
+    trace as the Python hops that share the request id).  Stage spans
+    are laid out back-to-back from the hop start — the planes measure
+    stages as consecutive windows of one event-loop pass."""
+    hop = emit_span(name, start, duration, role=role, parent="",
+                    trace_id=trace_id, attrs=attrs, error=error)
+    items = []
+    at = start
+    for stage_name, stage_s in stages:
+        if stage_s <= 0.0:
+            continue
+        items.append({"name": f"plane.{stage_name}", "start": at,
+                      "duration": stage_s, "role": role,
+                      "parent": hop["spanId"], "trace_id": trace_id})
+        at += stage_s
+    emit_span_batch(items)
+    return hop
+
+
 # -- context / propagation helpers ----------------------------------------
 
 def current_ids() -> "tuple[str, str, str] | None":

@@ -79,6 +79,25 @@ class LatencyTracker:
                 self._ring[self._i] = seconds
                 self._i = (self._i + 1) % self.size
 
+    def note_many(self, seconds_batch: "list[float]") -> None:
+        """Bulk note(): one lock round for a whole drained batch (the
+        native-plane flight-record drain feeds thousands of samples a
+        second — per-sample locking was measurable there).  A batch at
+        least `size` long simply becomes the ring."""
+        if not seconds_batch:
+            return
+        with self._lock:
+            if len(seconds_batch) >= self.size:
+                self._ring = list(seconds_batch[-self.size:])
+                self._i = 0
+                return
+            for s in seconds_batch:
+                if len(self._ring) < self.size:
+                    self._ring.append(s)
+                else:
+                    self._ring[self._i] = s
+                    self._i = (self._i + 1) % self.size
+
     def quantile(self, q: float = 0.95) -> "float | None":
         with self._lock:
             if len(self._ring) < self.min_samples:

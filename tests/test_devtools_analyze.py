@@ -1246,3 +1246,65 @@ def test_swfs018_noqa_suppresses():
 def test_swfs018_repo_is_clean(package_findings):
     assert [f for f in package_findings
             if f.rule == "SWFS018"] == []
+
+
+# -- SWFS019: native-plane label drift -------------------------------------
+
+WRITE_DRIVER_FULL = """
+    RECORD_STAGES = ("recv", "append", "index", "ack")
+    RECORD_FALLBACKS = ("none", "not_plain", "unregistered",
+                        "seen_key", "journal_full", "io_error")
+"""
+
+
+def test_swfs019_flags_missing_stage_label():
+    # the real write_plane.cc exports "index"; a driver without that
+    # literal misattributes every drained record
+    src = """
+    RECORD_STAGES = ("recv", "append", "ack")
+    RECORD_FALLBACKS = ("none", "not_plain", "unregistered",
+                        "seen_key", "journal_full", "io_error")
+    """
+    found = check_at(src, "SWFS019",
+                     "seaweedfs_tpu/server/write_plane.py")
+    assert len(found) == 1, found
+    assert '"index"' in found[0].message
+    assert "RECORD_STAGES" in found[0].message
+
+
+def test_swfs019_flags_missing_fallback_label():
+    src = """
+    RECORD_STAGES = ("recv", "append", "index", "ack")
+    RECORD_FALLBACKS = ("none", "not_plain", "unregistered",
+                        "seen_key", "io_error")
+    """
+    found = check_at(src, "SWFS019",
+                     "seaweedfs_tpu/server/write_plane.py")
+    assert len(found) == 1, found
+    assert '"journal_full"' in found[0].message
+
+
+def test_swfs019_complete_tables_pass():
+    assert check_at(WRITE_DRIVER_FULL, "SWFS019",
+                    "seaweedfs_tpu/server/write_plane.py") == []
+
+
+def test_swfs019_other_modules_pass():
+    # an unpaired module never matches, whatever its contents
+    assert check_at("RECORD_STAGES = ()", "SWFS019",
+                    "seaweedfs_tpu/server/volume_server.py") == []
+
+
+def test_swfs019_noqa_suppresses():
+    src = """
+    RECORD_STAGES = ("recv", "append", "ack")  # noqa: SWFS019 — alias
+    RECORD_FALLBACKS = ("none", "not_plain", "unregistered",
+                        "seen_key", "journal_full", "io_error")
+    """
+    assert check_at(src, "SWFS019",
+                    "seaweedfs_tpu/server/write_plane.py") == []
+
+
+def test_swfs019_repo_is_clean(package_findings):
+    assert [f for f in package_findings
+            if f.rule == "SWFS019"] == []

@@ -225,6 +225,9 @@ def test_slow_threshold_floor_and_capture():
 def test_slow_capture_rate_cap(monkeypatch):
     monkeypatch.setenv("SEAWEEDFS_TPU_SLOW_CAPTURE_PER_S", "3")
     r = profiling.FlightRecorder(size=64)
+    # pin the rate-window clock: on a loaded box the wall-clock 1s
+    # window can roll mid-loop and admit a fourth capture
+    r._now = lambda: 1000.0
     for _ in range(40):
         r.observe("filer", "GET", "/warm", 200, wall_s=0.001)
     for i in range(10):
@@ -302,7 +305,13 @@ def test_process_tree_stale_root_degrades_to_self(monkeypatch):
 # -- the fronts capture into the ring -------------------------------------
 
 @pytest.fixture()
-def front():
+def front(monkeypatch):
+    # pin a FRESH recorder: the module-global singleton accumulates
+    # latency history (and with it a warmed slow threshold) from
+    # whatever earlier tests and background drains observed, and
+    # these tests assert on exact capture sets
+    monkeypatch.setattr(profiling, "_recorder",
+                        profiling.FlightRecorder())
     h = HttpServer()
     h.role = "flighttest"
 
@@ -315,7 +324,6 @@ def front():
     h.route("GET", "/boom", boom)
     h.route("GET", "/ok", ok)
     h.start()
-    profiling.flight_recorder().reset()
     yield h
     h.stop()
 
@@ -326,10 +334,22 @@ def _records_for(path: str) -> "list[dict]":
             if r.get("path") == path]
 
 
+def _wait_records(path: str, timeout: float = 5.0) -> "list[dict]":
+    """Poll for a capture: the front observes AFTER the response is
+    flushed, so the client can read the snapshot before the handler
+    thread reaches the recorder."""
+    deadline = time.time() + timeout
+    recs = _records_for(path)
+    while not recs and time.time() < deadline:
+        time.sleep(0.01)
+        recs = _records_for(path)
+    return recs
+
+
 def test_threaded_front_captures_error(front):
     st, _, _ = http_bytes("GET", f"{front.url}/boom", timeout=5)
     assert st == 500
-    recs = _records_for("/boom")
+    recs = _wait_records("/boom")
     assert recs and recs[0]["verdict"] == "error"
     assert recs[0]["status"] == 500
     assert recs[0]["wallMs"] > 0
@@ -340,7 +360,7 @@ def test_threaded_front_captures_expired_deadline(front):
     st, _, _ = http_bytes("GET", f"{front.url}/ok", None,
                           {deadline.HEADER: "0"}, timeout=5)
     assert st == 504
-    recs = _records_for("/ok")
+    recs = _wait_records("/ok")
     assert recs and recs[0]["verdict"] == "deadline"
     assert recs[0]["deadline"]["budgetMs"] == 0
 
@@ -352,7 +372,7 @@ def test_threaded_front_captures_qos_shed(front):
     finally:
         front.admission = None
     assert st == 503
-    recs = [r for r in _records_for("/ok")
+    recs = [r for r in _wait_records("/ok")
             if r["verdict"] == "shed"]
     assert recs and recs[0]["status"] == 503
 
@@ -362,12 +382,16 @@ def test_front_kill_switch_stops_capture(front, monkeypatch):
     profiling.flight_recorder().reset()
     st, _, _ = http_bytes("GET", f"{front.url}/boom", timeout=5)
     assert st == 500
+    time.sleep(0.1)   # give the handler thread its post-flush beat
     assert _records_for("/boom") == []
 
 
 @pytest.fixture()
 def async_front_server(monkeypatch):
     monkeypatch.setenv("SEAWEEDFS_TPU_ASYNC_FRONT", "filer")
+    # fresh recorder for the same reason as the `front` fixture
+    monkeypatch.setattr(profiling, "_recorder",
+                        profiling.FlightRecorder())
     h = HttpServer()
     h.role = "filer"
 
@@ -377,7 +401,6 @@ def async_front_server(monkeypatch):
     h.route("GET", "/aboom", boom)
     h.start()
     assert h._async is not None
-    profiling.flight_recorder().reset()
     yield h
     h.stop()
 
@@ -386,11 +409,16 @@ def test_async_front_captures_error_and_deadline(async_front_server):
     h = async_front_server
     st, _, _ = http_bytes("GET", f"{h.url}/aboom", timeout=5)
     assert st == 500
-    recs = _records_for("/aboom")
+    recs = _wait_records("/aboom")
     assert recs and recs[0]["verdict"] == "error"
     st, _, _ = http_bytes("GET", f"{h.url}/aboom", None,
                           {deadline.HEADER: "0"}, timeout=5)
     assert st == 504
+    deadline_t = time.time() + 5.0
+    while not any(r["verdict"] == "deadline"
+                  for r in _records_for("/aboom")) \
+            and time.time() < deadline_t:
+        time.sleep(0.01)
     assert any(r["verdict"] == "deadline"
                for r in _records_for("/aboom"))
 
@@ -399,6 +427,7 @@ def test_debug_slow_serves_and_clears(front):
     from seaweedfs_tpu.server import debug as debug_mod
     debug_mod.install_debug_routes(front)
     http_bytes("GET", f"{front.url}/boom", timeout=5)
+    assert _wait_records("/boom")
     doc = http_json("GET", f"{front.url}/debug/slow", timeout=5)
     assert "records" in doc and "thresholdMs" in doc
     assert any(r["path"] == "/boom" for r in doc["records"])
@@ -427,7 +456,7 @@ def test_capture_includes_span_tree_and_stage_summary(front,
     front.route("GET", "/staged", staged)
     st, _, _ = http_bytes("GET", f"{front.url}/staged", timeout=5)
     assert st == 500
-    recs = _records_for("/staged")
+    recs = _wait_records("/staged")
     assert recs, profiling.flight_recorder().snapshot()
     rec = recs[0]
     assert "work" in rec["stages"]["stages"]
@@ -446,7 +475,8 @@ def test_attribution_runtime_lever(front):
     debug_mod.install_debug_routes(front)
     r = http_json("POST", f"{front.url}/debug/attribution",
                   {"disarmed": True}, timeout=5)
-    assert r == {"disarmed": True, "scope": "all"}
+    assert r == {"disarmed": True, "scope": "all",
+                 "drainEnabled": True}
     try:
         assert profiling.recorder_enabled() is False
         assert profiling.stage_timers_enabled() is False
@@ -454,17 +484,20 @@ def test_attribution_runtime_lever(front):
         # even an ERROR verdict is not captured while disarmed
         st, _, _ = http_bytes("GET", f"{front.url}/boom", timeout=5)
         assert st == 500
+        time.sleep(0.1)   # post-flush beat, as in the kill switch
         assert not _records_for("/boom")
     finally:
         r = http_json("POST", f"{front.url}/debug/attribution",
                       {"disarmed": False}, timeout=5)
-    assert r == {"disarmed": False, "scope": ""}
+    assert r == {"disarmed": False, "scope": "",
+                 "drainEnabled": True}
     assert profiling.recorder_enabled() is True
     # scope=plane disarms only the ISSUE 15 additions — the PR 7
     # wall-stage decomposition stays armed
     r = http_json("POST", f"{front.url}/debug/attribution",
                   {"disarmed": True, "scope": "plane"}, timeout=5)
-    assert r == {"disarmed": True, "scope": "plane"}
+    assert r == {"disarmed": True, "scope": "plane",
+                 "drainEnabled": True}
     try:
         assert profiling.recorder_enabled() is False
         assert profiling.cpu_sample_every() == 0
@@ -472,8 +505,21 @@ def test_attribution_runtime_lever(front):
     finally:
         http_json("POST", f"{front.url}/debug/attribution",
                   {"disarmed": False}, timeout=5)
+    # scope=drain disarms only the native-plane record drain — the
+    # rest of the attribution plane stays armed
+    r = http_json("POST", f"{front.url}/debug/attribution",
+                  {"disarmed": True, "scope": "drain"}, timeout=5)
+    assert r["drainEnabled"] is False
+    try:
+        assert profiling.plane_drain_enabled() is False
+        assert profiling.recorder_enabled() is True
+        assert profiling.stage_timers_enabled() is True
+    finally:
+        http_json("POST", f"{front.url}/debug/attribution",
+                  {"disarmed": False, "scope": "drain"}, timeout=5)
+    assert profiling.plane_drain_enabled() is True
     st, _, _ = http_bytes("GET", f"{front.url}/boom", timeout=5)
     assert st == 500
-    assert _records_for("/boom")
+    assert _wait_records("/boom")
     assert "error" in http_json(
         "POST", f"{front.url}/debug/attribution", {}, timeout=5)

@@ -421,3 +421,43 @@ def test_plane_fallback_and_runtime_disarm(cluster, tmp_path):
             assert st == 200 and body == blob, (path, st)
     finally:
         filer.stop()
+
+
+def test_fid_dry_fallback_leaves_name_retryable(tmp_path):
+    """A boot-time dry fid pool must not poison the path: the plane
+    claims a name into the parent's seen-set only once a fid is in
+    hand, so a client retrying the SAME path on the plane port keeps
+    hitting fid_dry (retryable) instead of flipping to ineligible
+    forever.  Regression: the claim used to happen before the pool
+    check, wedging every plane-port retry of the first write a client
+    hammered while the feeder was still filling."""
+    from seaweedfs_tpu.server.meta_plane_native import NativeMetaPlane
+    try:
+        plane = NativeMetaPlane(str(tmp_path), "127.0.0.1:1")
+    except RuntimeError:
+        pytest.skip("native meta plane unavailable in this image")
+    try:
+        plane.arm(True)
+        plane.mark_dir("/rd")
+        url = f"127.0.0.1:{plane.port}"
+        # master unreachable -> the feeder never fills the pool
+        for expect_misses in (1, 2, 3):
+            st, _, _ = http_bytes(
+                "POST", f"{url}/rd/x", b"zz",
+                {"Content-Type": "application/octet-stream"},
+                timeout=10)
+            assert st == 404
+            s = plane.stats()
+            assert s["fid_misses"] == expect_misses, s
+        # a fid arrives: the same name immediately stops being dry
+        # (the upstream here is unreachable, so the request falls
+        # back at the dispatch hop — but not as a fid miss)
+        plane._lib.mp_feed_fids(
+            plane._h, b"127.0.0.1:1 3,01637037d6\n")
+        st, _, _ = http_bytes(
+            "POST", f"{url}/rd/x", b"zz",
+            {"Content-Type": "application/octet-stream"}, timeout=10)
+        assert st == 404
+        assert plane.stats()["fid_misses"] == 3, plane.stats()
+    finally:
+        plane.stop()

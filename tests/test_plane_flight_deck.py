@@ -1,0 +1,528 @@
+"""ISSUE 18: the native-plane flight deck — per-request records from
+the C++ planes drained into the Python observability planes.
+
+Unit half: PlaneRecordSink fan-out (tracker training, stage
+histograms, span synthesis gating, FlightRecorder captures, the
+nested stage shape cluster.slow renders), the ring-dropped counter
+delta, and the drainer's scrape hook + kill switch.
+
+Chaos half (real processes): ring wraparound under a stalled drainer
+drops OLDEST records only and publishes plane_ring_dropped_total;
+SIGKILL of a filer (and its in-process plane) mid-drain leaves no
+wedge and no duplicate flight captures after restart.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu import native, profiling, stats, tracing
+from seaweedfs_tpu.server.httpd import http_bytes, http_json
+from seaweedfs_tpu.server.meta_plane_native import RECORD_FALLBACKS, \
+    RECORD_STAGES
+from seaweedfs_tpu.util.hedge import LatencyTracker
+
+from proc_framework import Proc, ProcCluster, free_port
+
+from test_crash_durability import _Load, _unique_blob
+from test_native_meta_plane import _native_post, _plane_port
+
+
+# -- unit half: the sink ---------------------------------------------------
+
+def _rec(rid: str, stage_ns, status: int = 201, fallback: int = 0,
+         flags: int = native.PLANE_RECORD_CLIENT_RID,
+         nbytes: int = 64, deadline_ms: int = -1):
+    r = native.PlaneRecord()
+    r.rid = rid.encode()
+    r.start_unix_ns = int(time.time() * 1e9)
+    for i, ns in enumerate(stage_ns):
+        r.stage_ns[i] = ns
+    r.bytes = nbytes
+    r.deadline_ms = deadline_ms
+    r.status = status
+    r.fallback = fallback
+    r.flags = flags
+    return r
+
+
+@pytest.fixture()
+def sink(monkeypatch):
+    monkeypatch.setattr(profiling, "_recorder",
+                        profiling.FlightRecorder())
+    m = stats.Metrics("fdtest")
+    trk = LatencyTracker()
+    s = profiling.PlaneRecordSink(
+        "filer", "meta", "POST", RECORD_STAGES, RECORD_FALLBACKS,
+        tracker=trk, metrics=m)
+    s.test_metrics = m          # for assertions only
+    s.test_tracker = trk
+    return s
+
+
+def test_sink_fans_out_one_record(sink):
+    rid = f"fd-unit-{int(time.time())}"
+    n = sink.feed([_rec(rid, [1_000_000, 2_000_000, 500_000, 100_000],
+                        status=500, deadline_ms=120)])
+    assert n == 1 and sink.records == 1
+    # error + client rid: a span tree is synthesized under the rid
+    spans = tracing.spans_for(rid)
+    names = {s["name"] for s in spans}
+    assert "POST [meta-plane]" in names, names
+    assert {"plane.parse", "plane.upload", "plane.wal",
+            "plane.ack"} <= names, names
+    hop = next(s for s in spans if s["name"] == "POST [meta-plane]")
+    assert hop["role"] == "filer" and hop["error"] is True
+    assert hop["attrs"]["fallback"] == "none"
+    # error verdict: captured even on a cold tracker, with the nested
+    # stage shape _render_slow_hop reads and the deadline doc
+    recs = [r for r in
+            profiling.flight_recorder().snapshot()["records"]
+            if r["traceId"] == rid]
+    assert recs and recs[0]["verdict"] == "error"
+    assert recs[0]["stages"]["stages"]["parse"]["wallMs"] == 1.0
+    assert recs[0]["deadline"]["remainingMs"] == 120
+    assert recs[0]["notes"]["plane"] == "meta"
+    # stage histograms + the records counter rendered
+    txt = sink.test_metrics.render()
+    assert 'fdtest_plane_stage_seconds_bucket' in txt
+    assert 'plane="meta",stage="upload"' in txt
+    assert 'fdtest_plane_records_total{plane="meta"} 1' in txt
+
+
+def test_sink_skips_spans_for_lean_minted_records(sink):
+    """A minted-rid fast ok record trains the tracker and histograms
+    but synthesizes NO span and no capture — the bench drain must
+    stay allocation-cheap."""
+    rid = "mp00abcdef-1"
+    sink.feed([_rec(rid, [10_000, 20_000, 5_000, 1_000],
+                    status=201, flags=0)])
+    assert tracing.spans_for(rid) == []
+    assert profiling.flight_recorder().snapshot()["records"] == []
+    assert sink.records == 1
+
+
+def test_sink_minted_upstream_rid_stays_lean_unless_interesting(sink):
+    """A forwarded plane-minted rid (client-rid + minted-upstream
+    flags) is NOT a client trace: ok records stay on the span-free
+    fast path — the meta plane forwards its minted rid to the volume
+    write plane on EVERY upstream hop, so this is the bench-load
+    bulk — but an error record still emits the hop so the cross-role
+    tree stitches."""
+    both = native.PLANE_RECORD_CLIENT_RID | \
+        native.PLANE_RECORD_MINTED_UPSTREAM
+    ok_rid = "mp00c0ffee-10"
+    sink.feed([_rec(ok_rid, [10_000, 20_000, 0, 0], status=201,
+                    flags=both)])
+    assert tracing.spans_for(ok_rid) == []
+    err_rid = "mp00c0ffee-11"
+    sink.feed([_rec(err_rid, [10_000, 20_000, 0, 0], status=502,
+                    flags=both)])
+    assert any(s["name"] == "POST [meta-plane]"
+               for s in tracing.spans_for(err_rid))
+    # same contract through the vectorized path
+    ok2, err2 = "mp00c0ffee-20", "mp00c0ffee-21"
+    recs = [_rec(ok2, [10_000, 20_000, 0, 0], status=201, flags=both),
+            _rec(err2, [10_000, 20_000, 0, 0], status=500,
+                 flags=both)]
+    buf = (native.PlaneRecord * len(recs))(*recs)
+    sink.feed_buffer(buf, len(recs))
+    assert tracing.spans_for(ok2) == []
+    assert any(s["name"] == "POST [meta-plane]"
+               for s in tracing.spans_for(err2))
+
+
+def test_sink_fallback_reason_reaches_notes_and_span(sink):
+    rid = f"fd-fb-{int(time.time())}"
+    fb = RECORD_FALLBACKS.index("upstream")
+    sink.feed([_rec(rid, [5_000, 0, 0, 0], status=404, fallback=fb)])
+    # 404 fallback is not an error, but the client rid stitches
+    spans = tracing.spans_for(rid)
+    hop = next(s for s in spans if s["name"] == "POST [meta-plane]")
+    assert hop["attrs"]["fallback"] == "upstream"
+
+
+def test_sink_feed_buffer_matches_scalar_semantics(sink):
+    """The vectorized drain path (numpy over the raw ctypes batch
+    buffer) must reach the same outcomes as scalar feed: lean minted
+    records train histograms only; error and client-rid records get
+    spans and captures."""
+    rid_err = f"fdbuf-err-{int(time.time())}"
+    rid_cli = f"fdbuf-cli-{int(time.time())}"
+    recs = [_rec("mp00aaaaaa-1", [10_000, 20_000, 5_000, 1_000],
+                 status=201, flags=0),
+            _rec(rid_err, [1_000_000, 2_000_000, 0, 0], status=502,
+                 flags=0, deadline_ms=75),
+            _rec("mp00aaaaaa-2", [11_000, 21_000, 6_000, 2_000],
+                 status=201, flags=0),
+            _rec(rid_cli, [30_000, 40_000, 0, 0], status=201)]
+    buf = (native.PlaneRecord * len(recs))(*recs)
+    assert sink.feed_buffer(buf, len(recs)) == len(recs)
+    assert sink.records == len(recs)
+    # lean rows: no spans minted under their rids
+    assert tracing.spans_for("mp00aaaaaa-1") == []
+    # the error row captured with the stitched hop and deadline doc
+    spans = tracing.spans_for(rid_err)
+    hop = next(s for s in spans if s["name"] == "POST [meta-plane]")
+    assert hop["error"] is True
+    caps = [r for r in
+            profiling.flight_recorder().snapshot()["records"]
+            if r["traceId"] == rid_err]
+    assert caps and caps[0]["verdict"] == "error"
+    assert caps[0]["deadline"]["remainingMs"] == 75
+    # the client-rid ok row stitched a hop but was not captured
+    assert any(s["name"] == "POST [meta-plane]"
+               for s in tracing.spans_for(rid_cli))
+    # every row reached the stage histograms and the records counter
+    txt = sink.test_metrics.render()
+    assert f'fdtest_plane_records_total{{plane="meta"}} {len(recs)}' \
+        in txt
+    import re
+    m = re.search(r'fdtest_plane_stage_seconds_count\{'
+                  r'plane="meta",stage="parse"\} (\d+)', txt)
+    assert m and int(m.group(1)) == len(recs)
+
+
+def test_sink_dropped_counter_is_a_delta(sink):
+    seen = sink.note_dropped(5, 0)
+    assert seen == 5
+    assert 'fdtest_plane_ring_dropped_total{plane="meta"} 5' \
+        in sink.test_metrics.render()
+    # same monotonic value again: no double count
+    assert sink.note_dropped(5, seen) == 5
+    assert 'fdtest_plane_ring_dropped_total{plane="meta"} 5' \
+        in sink.test_metrics.render()
+    assert sink.note_dropped(9, 5) == 9
+    assert 'fdtest_plane_ring_dropped_total{plane="meta"} 9' \
+        in sink.test_metrics.render()
+
+
+def test_drainer_scrape_hook_and_kill_switch(sink, monkeypatch):
+    # park the tick far away: this test drives drain_now explicitly
+    monkeypatch.setenv("SEAWEEDFS_TPU_PLANE_DRAIN_MS", "600000")
+    pulls = []
+    d = profiling.PlaneRecordDrainer(
+        sink, lambda s: pulls.append(1) or 0, lambda: 0)
+    d.start()
+    try:
+        before = len(pulls)
+        profiling.run_scrape_hooks()
+        assert len(pulls) == before + 1
+        # the runtime kill switch stops the pulls without stopping
+        # the drainer
+        profiling.set_plane_drain_disarmed(True)
+        try:
+            profiling.run_scrape_hooks()
+            assert len(pulls) == before + 1
+            assert d.drain_now() == 0
+        finally:
+            profiling.set_plane_drain_disarmed(False)
+        profiling.run_scrape_hooks()
+        assert len(pulls) == before + 2
+    finally:
+        d.stop()
+    after = len(pulls)          # stop() runs one final pass
+    profiling.run_scrape_hooks()
+    assert len(pulls) == after, "hook survived stop()"
+
+
+# -- chaos half: real processes --------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = ProcCluster(str(tmp_path_factory.mktemp("fdeck")), volumes=1)
+    c.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            st = http_json("GET", f"{c.master}/cluster/status",
+                           timeout=5)
+            if len(st.get("dataNodes", [])) == 1:
+                break
+        except OSError:
+            pass
+        time.sleep(0.2)
+    yield c
+    c.stop()
+
+
+def _scrape(url: str) -> None:
+    """GET /debug/slow forces a ring drain via the scrape hooks."""
+    http_bytes("GET", f"{url}/debug/slow", timeout=10)
+
+
+def test_ring_wraparound_drops_oldest_only(cluster, tmp_path):
+    """A stalled drainer (tick parked at 10min) plus a 64-slot ring
+    under ~200 requests: the scrape-time drain sees only the NEWEST
+    records — the oldest aged off the ring — and the overwrites are
+    published as plane_ring_dropped_total."""
+    store = os.path.join(str(tmp_path), "filer-wrap.db")
+    fport = free_port()
+    filer = Proc(
+        "filer-wrap",
+        ["filer", "-port", str(fport), "-master", cluster.master,
+         "-store", store], fport,
+        os.path.join(str(tmp_path), "filer-wrap.log"),
+        env_extra={"SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE": "1",
+                   "SEAWEEDFS_TPU_PLANE_REC_RING": "64",
+                   "SEAWEEDFS_TPU_PLANE_DRAIN_MS": "600000"})
+    filer.start()
+    url = filer.url
+    try:
+        pport = _plane_port(url)
+        if not pport:
+            pytest.skip("native meta plane unavailable in this image")
+        plane = f"127.0.0.1:{pport}"
+        st, _, _ = http_bytes(
+            "POST", f"{url}/wr/seed", b"seed",
+            {"Content-Type": "application/octet-stream"}, timeout=10)
+        assert st < 300
+        assert _native_post(plane, "/wr/warm", b"warm",
+                            retries=100) == 201, \
+            "plane never became eligible"
+
+        total = 200
+        for i in range(total):
+            st = 0
+            for _ in range(40):
+                st, _, _ = http_bytes(
+                    "POST", f"{plane}/wr/f{i}", b"x" * 32,
+                    {"Content-Type": "application/octet-stream",
+                     "X-Request-ID": f"wrap-{i}"}, timeout=10)
+                if st == 201:
+                    break
+                # 404 mid-stream = the fid feeder momentarily dry
+                # under box load; give the refill a beat
+                time.sleep(0.1)
+            assert st == 201, f"native write {i} never acked: {st}"
+
+        _scrape(url)
+        # newest record survived the wraparound and stitched a span
+        doc = http_json(
+            "GET",
+            f"{url}/debug/traces?request_id=wrap-{total - 1}",
+            timeout=10)
+        names = {s["name"] for s in doc["spans"]}
+        assert "POST [meta-plane]" in names, names
+        n_spans = len(doc["spans"])
+        # the oldest was overwritten before the drain reached it
+        doc0 = http_json("GET", f"{url}/debug/traces?request_id=wrap-0",
+                         timeout=10)
+        assert doc0["spans"] == [], doc0["spans"]
+        # the overwrites are visible as a counter, not silence
+        st, body, _ = http_bytes("GET", f"{url}/metrics", timeout=10)
+        assert st == 200
+        import re
+        m = re.search(
+            rb'seaweedfs_tpu_plane_ring_dropped_total\{plane="meta"\} '
+            rb'(\d+)', body)
+        assert m is not None, "ring_dropped counter never rendered"
+        assert int(m.group(1)) >= total - 64 - 5, m.group(1)
+        # a second scrape re-drains an EMPTY ring: no duplicate spans
+        _scrape(url)
+        doc2 = http_json(
+            "GET",
+            f"{url}/debug/traces?request_id=wrap-{total - 1}",
+            timeout=10)
+        assert len(doc2["spans"]) == n_spans
+    finally:
+        filer.stop()
+
+
+def test_slowed_plane_write_lands_in_cluster_slow(cluster, tmp_path):
+    """THE PR 18 acceptance demo: arm the uploadDelayMs failpoint,
+    plane-route a write with a client rid, and `cluster.slow` renders
+    it as a real hop — native per-stage decomposition with `upload`
+    dominating, stitched to the volume side by the forwarded rid."""
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    store = os.path.join(str(tmp_path), "filer-slow.db")
+    fport = free_port()
+    filer = Proc(
+        "filer-slowdemo",
+        ["filer", "-port", str(fport), "-master", cluster.master,
+         "-store", store], fport,
+        os.path.join(str(tmp_path), "filer-slow.log"),
+        env_extra={"SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE": "1",
+                   "SEAWEEDFS_TPU_PLANE_DRAIN_MS": "50"})
+    filer.start()
+    url = filer.url
+    try:
+        pport = _plane_port(url)
+        if not pport:
+            pytest.skip("native meta plane unavailable in this image")
+        plane = f"127.0.0.1:{pport}"
+        st, _, _ = http_bytes(
+            "POST", f"{url}/sd/seed", b"seed",
+            {"Content-Type": "application/octet-stream"}, timeout=10)
+        assert st < 300
+        assert _native_post(plane, "/sd/warm", b"warm",
+                            retries=100) == 201
+        # warm the recorder's slow threshold past min_samples with
+        # fast plane writes, drained before the failpoint arms
+        for i in range(40):
+            st, _, _ = http_bytes(
+                "POST", f"{plane}/sd/warm{i}", b"w" * 16,
+                {"Content-Type": "application/octet-stream"},
+                timeout=10)
+            assert st == 201
+        _scrape(url)
+
+        r = http_json("POST", f"{url}/debug/meta_plane",
+                      {"uploadDelayMs": 60}, timeout=10)
+        assert r.get("armed") is True
+        rid = f"slow-deck-{int(time.time())}"
+        t0 = time.time()
+        st, _, _ = http_bytes(
+            "POST", f"{plane}/sd/slow.bin", b"s" * 64,
+            {"Content-Type": "application/octet-stream",
+             "X-Request-ID": rid}, timeout=10)
+        assert st == 201
+        assert time.time() - t0 >= 0.055, "failpoint never stalled"
+        http_json("POST", f"{url}/debug/meta_plane",
+                  {"uploadDelayMs": 0}, timeout=10)
+        _scrape(url)
+
+        # the span tree: a filer-role plane hop whose upload stage
+        # carries the injected stall
+        doc = http_json("GET", f"{url}/debug/traces?request_id={rid}",
+                        timeout=10)
+        spans = doc["spans"]
+        hop = next(s for s in spans
+                   if s["name"] == "POST [meta-plane]")
+        assert hop["role"] == "filer"
+        up = next(s for s in spans if s["name"] == "plane.upload")
+        assert up["parentId"] == hop["spanId"]
+        assert up["durationMs"] >= 50, up
+        # the capture: verdict slow, nested stage decomposition
+        slow = http_json("GET", f"{url}/debug/slow", timeout=10)
+        caps = [r for r in slow["records"] if r["traceId"] == rid]
+        assert caps, "slowed plane write never captured"
+        cap = caps[0]
+        assert cap["verdict"] == "slow"
+        assert cap["stages"]["stages"]["upload"]["wallMs"] >= 50
+        # and the operator view: cluster.slow renders the plane hop
+        # with its stage split
+        env = CommandEnv(cluster.master, filer=url)
+        out = run_command(env,
+                          f"cluster.slow -top=10 -nodes={url}")
+        assert "[meta-plane]" in out, out
+        assert "upload" in out, out
+        assert rid in out, out
+    finally:
+        filer.stop()
+
+
+def test_plane_sigkill_mid_drain_no_wedge_no_duplicates(cluster,
+                                                        tmp_path):
+    """kill -9 the filer (plane + drainer in-process) while a fast
+    drain tick races concurrent scrapes under native write load; a
+    restarted filer must serve scrapes and native writes immediately
+    (no wedge) and a post-restart request is captured exactly once
+    (the ring died with the process — nothing replays)."""
+    store = os.path.join(str(tmp_path), "filer-kd.db")
+    fport = free_port()
+    args = ["filer", "-port", str(fport), "-master", cluster.master,
+            "-store", store]
+    log = os.path.join(str(tmp_path), "filer-kd.log")
+    victim = Proc("filer-kd", args, fport, log,
+                  env_extra={
+                      "SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE": "1",
+                      "SEAWEEDFS_TPU_PLANE_DRAIN_MS": "20"})
+    victim.start()
+    url = victim.url
+    try:
+        pport = _plane_port(url)
+        if not pport:
+            pytest.skip("native meta plane unavailable in this image")
+        plane = f"127.0.0.1:{pport}"
+        st, _, _ = http_bytes(
+            "POST", f"{url}/kd/seed", b"seed",
+            {"Content-Type": "application/octet-stream"}, timeout=10)
+        assert st < 300
+        assert _native_post(plane, "/kd/warm", b"warm",
+                            retries=100) == 201
+
+        stop_scrapes = threading.Event()
+
+        def scraper():
+            while not stop_scrapes.is_set():
+                try:
+                    _scrape(url)
+                except OSError:
+                    pass            # the kill window
+                time.sleep(0.01)
+        scr = threading.Thread(target=scraper, daemon=True)
+        scr.start()
+
+        def write(tag, blob):
+            st, _, _ = http_bytes(
+                "POST", f"{plane}/kd/{tag}", blob,
+                {"Content-Type": "application/octet-stream",
+                 "X-Request-ID": f"kd-{tag}"}, timeout=10)
+            return tag if st == 201 else None
+
+        load = _Load(write)
+        load.run_through_kill(victim, load_s=1.0)
+        stop_scrapes.set()
+        scr.join(timeout=10)
+        assert load.acked, "no native writes acked before the kill"
+    finally:
+        victim.stop()           # reaps the SIGKILLed popen handle
+
+    fresh = Proc("filer-kd", args, fport, log,
+                 env_extra={
+                     "SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE": "1",
+                     "SEAWEEDFS_TPU_PLANE_DRAIN_MS": "20"})
+    fresh.start()
+    try:
+        # no wedge: the debug plane answers and the native path is
+        # back, drainer included
+        deadline = time.time() + 30
+        st = 0
+        while time.time() < deadline:
+            try:
+                st, _, _ = http_bytes("GET",
+                                      f"{url}/debug/slow", timeout=5)
+                if st == 200:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        assert st == 200, "debug plane wedged after restart"
+        pport = _plane_port(url)
+        assert pport, "plane never re-armed after restart"
+        plane = f"127.0.0.1:{pport}"
+        # a FRESH dir through the Python front: the restarted plane
+        # learns parents from new events, not from the pre-kill
+        # namespace — same warm-up shape as a fresh filer
+        st, _, _ = http_bytes(
+            "POST", f"{url}/kd2/seed", b"reseed",
+            {"Content-Type": "application/octet-stream"}, timeout=10)
+        assert st < 300
+        rid = f"kd-post-{int(time.time())}"
+        st = 0
+        for _ in range(150):
+            st, _, _ = http_bytes(
+                "POST", f"{plane}/kd2/post-kill", b"after",
+                {"Content-Type": "application/octet-stream",
+                 "X-Request-ID": rid}, timeout=10)
+            if st == 201:
+                break
+            time.sleep(0.1)
+        assert st == 201
+        # captured exactly once, double scrape or not
+        _scrape(url)
+        _scrape(url)
+        doc = http_json("GET",
+                        f"{url}/debug/traces?request_id={rid}",
+                        timeout=10)
+        hops = [s for s in doc["spans"]
+                if s["name"] == "POST [meta-plane]"]
+        assert len(hops) == 1, doc["spans"]
+        slow = http_json("GET", f"{url}/debug/slow", timeout=10)
+        caps = [r for r in slow["records"] if r["traceId"] == rid]
+        assert len(caps) <= 1, caps
+    finally:
+        fresh.stop()

@@ -51,7 +51,9 @@ def harness(tmp_path):
     master.stop()
 
 
-def _wait_jobs_done(admin, timeout=30):
+def _wait_jobs_done(admin, timeout=90):
+    # 90s, not 30: the jax EC encode shares this box's single core
+    # with the rest of the tier-1 run — jobs progress, just slowly
     deadline = time.time() + timeout
     while time.time() < deadline:
         jobs = http_json("GET", f"{admin.url}/maintenance/queue")["jobs"]

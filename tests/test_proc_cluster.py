@@ -120,10 +120,16 @@ def test_filer_kill9_restart_namespace_survives(cluster):
     filer.kill9()
     filer.start()
     deadline = time.time() + 30
+    st, body = 0, b""
     while time.time() < deadline:
-        st, body, _ = http_bytes(
-            "GET", f"http://{cluster.filer}/crash/file.txt")
-        if st == 200:
+        try:
+            st, body, _ = http_bytes(
+                "GET", f"http://{cluster.filer}/crash/file.txt")
+        except OSError:
+            # the listener is not back yet — connection refused is
+            # part of the restart window, not a failure
+            st, body = 0, b""
+        if st == 200 and body == b"filer durability":
             break
         time.sleep(0.3)
     assert st == 200 and body == b"filer durability"

@@ -22,27 +22,23 @@ from seaweedfs_tpu.util.request_id import set_request_id
 
 @pytest.fixture(scope="module")
 def cluster(tmp_path_factory):
-    # Pin this cluster to the pure-Python data path: the native
-    # planes ack without HTTP headers, so a plane-served chunk leaves
-    # no volume span — this module's contract is the TRACED path.
-    # (Before the meta plane, the first filer upload's /status
-    # discovery probe incidentally donated a volume-role span to the
-    # trace; the three-role assertion only held by that accident.)
+    # The native planes now feed the tracing plane through the
+    # flight-deck drain: a plane-served hop surfaces as a real span
+    # with plane.* stage children, stitched by the forwarded
+    # X-Request-ID — so this module runs with the planes ON, retiring
+    # the earlier pure-Python pin.  A short drain tick keeps the
+    # trace-assembly polls below snappy.
     import os
-    saved = {k: os.environ.get(k) for k in
-             ("SEAWEEDFS_TPU_WRITE_PLANE",
-              "SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE")}
-    os.environ["SEAWEEDFS_TPU_WRITE_PLANE"] = "0"
-    os.environ["SEAWEEDFS_TPU_FILER_META_PLANE_NATIVE"] = "0"
+    saved = os.environ.get("SEAWEEDFS_TPU_PLANE_DRAIN_MS")
+    os.environ["SEAWEEDFS_TPU_PLANE_DRAIN_MS"] = "50"
     try:
         c = ProcCluster(
             tmp_path_factory.mktemp("trace"), volumes=2).start()
     finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
+        if saved is None:
+            os.environ.pop("SEAWEEDFS_TPU_PLANE_DRAIN_MS", None)
+        else:
+            os.environ["SEAWEEDFS_TPU_PLANE_DRAIN_MS"] = saved
     _wait_writable(c)
     yield c
     c.stop()
@@ -60,6 +56,32 @@ def _wait_writable(c, timeout=45):
             last = e
         time.sleep(0.3)
     raise TimeoutError(f"cluster never writable: {last}")
+
+
+def _force_drain(c):
+    """GET /debug/slow runs each node's scrape hooks, which drain the
+    native-plane flight rings into the tracing/recorder planes — a
+    trace poll right after a plane-served request must not race the
+    drainer tick."""
+    for proc in c.procs.values():
+        try:
+            http_bytes("GET", f"{proc.url}/debug/slow", timeout=5)
+        except OSError:
+            pass
+
+
+def _collect_until(c, env, rid, pred, timeout=20.0):
+    """Force-drain + re-collect the trace until pred(spans) holds (a
+    plane-served hop only enters the span ring at drain time)."""
+    deadline = time.time() + timeout
+    spans = []
+    while time.time() < deadline:
+        _force_drain(c)
+        spans = collect_trace(env, rid)
+        if pred(spans):
+            return spans
+        time.sleep(0.25)
+    return spans
 
 
 def _assert_valid_tree(spans):
@@ -91,7 +113,10 @@ def test_one_write_traces_three_roles(cluster):
     finally:
         set_request_id("")
     env = CommandEnv(cluster.master, filer=cluster.filer)
-    spans = collect_trace(env, rid)
+    spans = _collect_until(
+        cluster, env, rid,
+        lambda ss: {"filer", "master", "volume"} <=
+        {s.get("role") or "?" for s in ss})
     roles = {s.get("role") or "?" for s in spans}
     assert {"filer", "master", "volume"} <= roles, \
         f"expected >=3 roles, got {roles}: {render_trace(spans)}"
@@ -105,6 +130,80 @@ def test_one_write_traces_three_roles(cluster):
     assert f"trace {rid}" in out
     assert "POST /t/one.txt" in out and "[filer@" in out
     assert "[master@" in out and "[volume@" in out
+
+
+def _plane_port(url, timeout=20.0):
+    deadline = time.time() + timeout
+    port = 0
+    while time.time() < deadline:
+        try:
+            st = http_json("GET", f"{url}/status", timeout=5)
+            port = int(st.get("metaPlanePort") or 0)
+            if port:
+                return port
+        except OSError:
+            pass
+        time.sleep(0.2)
+    return port
+
+
+def test_plane_routed_write_stitches_native_hop(cluster):
+    """A write served end to end by the C++ meta plane (never touching
+    the Python filer front) still assembles a cross-role trace: the
+    drained flight record renders the filer hop as `POST [meta-plane]`
+    with plane.* stage children, and the request id forwarded on the
+    upstream hop stitches the volume-side span under the same trace
+    id — the positive contract that replaces the old WRITE_PLANE=0
+    pin."""
+    url = f"http://{cluster.filer}"
+    port = _plane_port(url)
+    assert port, "filer never advertised metaPlanePort"
+    host = cluster.filer.split(":")[0]
+    plane = f"http://{host}:{port}"
+
+    # seed the parent dir through the Python front so the plane can
+    # learn it from the event stream and accept the native path
+    st, _, _ = http_bytes("POST", f"{url}/tp/seed.txt", b"seed")
+    assert st < 300
+
+    rid = f"trace-plane-{int(time.time())}"
+    blob = b"plane-routed traced payload"
+    st = 0
+    for _ in range(50):
+        st, _, _ = http_bytes(
+            "POST", f"{plane}/tp/native-hop.bin", blob,
+            {"Content-Type": "application/octet-stream",
+             "X-Request-ID": rid}, timeout=10)
+        if st == 201:
+            break
+        time.sleep(0.1)
+    assert st == 201, f"plane never acked the native write: {st}"
+
+    env = CommandEnv(cluster.master, filer=cluster.filer)
+    spans = _collect_until(
+        cluster, env, rid,
+        lambda ss: {"filer", "volume"} <= {s.get("role") for s in ss})
+    roles = {s.get("role") for s in spans}
+    assert {"filer", "volume"} <= roles, \
+        f"native hop not stitched, got {roles}: {render_trace(spans)}"
+    assert len({s["traceId"] for s in spans}) == 1
+    _assert_valid_tree(spans)
+    # the filer hop is the drained meta-plane record, carrying the
+    # C-side per-stage decomposition as child spans
+    hops = [s for s in spans
+            if s["role"] == "filer" and "[meta-plane]" in s["name"]]
+    assert hops, render_trace(spans)
+    stage_names = {s["name"] for s in spans
+                   if s["parentId"] == hops[0]["spanId"]}
+    assert "plane.parse" in stage_names and \
+        "plane.upload" in stage_names, \
+        f"missing stage children: {stage_names}"
+    # the plane-acked write is durable through the Python front
+    st, body, _ = http_bytes("GET", f"{url}/tp/native-hop.bin")
+    assert st == 200 and body == blob
+    # the operator command renders the stitched hop
+    out = run_command(env, f"trace.show {rid}")
+    assert "[meta-plane]" in out and "[filer@" in out, out
 
 
 def test_streaming_rebuild_trace_shows_pipeline_stages(cluster):

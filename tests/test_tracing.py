@@ -146,6 +146,20 @@ def test_emit_span_for_post_hoc_stage_timing():
 
 # -- httpd middleware -----------------------------------------------------
 
+def _wait_spans(trace_id: str, n: int,
+                timeout: float = 2.0) -> "list[dict]":
+    """The middleware records the server span AFTER writing the
+    response (recording must never delay the client), so an
+    in-process client can observe the status before the server
+    thread's _record lands — poll briefly instead of racing it."""
+    deadline = time.time() + timeout
+    spans = tracing.spans_for(trace_id)
+    while len(spans) < n and time.time() < deadline:
+        time.sleep(0.005)
+        spans = tracing.spans_for(trace_id)
+    return spans
+
+
 @pytest.fixture
 def little_server():
     http = HttpServer("127.0.0.1", 0)
@@ -177,7 +191,7 @@ def test_middleware_server_span_and_histogram(little_server):
     set_request_id("mw-1")
     st, _, _ = http_bytes("GET", f"http://{little_server.url}/ok")
     assert st == 200
-    spans = tracing.spans_for("mw-1")
+    spans = _wait_spans("mw-1", 1)
     assert [s["name"] for s in spans] == ["GET /ok"]
     sp = spans[0]
     assert sp["role"] == "testrole"
@@ -191,7 +205,7 @@ def test_middleware_marks_handler_error(little_server):
     set_request_id("mw-2")
     st, _, _ = http_bytes("GET", f"http://{little_server.url}/boom")
     assert st == 500
-    sp = tracing.spans_for("mw-2")[0]
+    sp = _wait_spans("mw-2", 1)[0]
     assert sp["error"] is True and sp["attrs"]["status"] == 500
     assert "kaput" in sp["attrs"]["error"]
 
@@ -202,7 +216,7 @@ def test_cross_hop_parenting(little_server):
     set_request_id("mw-3")
     st, _, _ = http_bytes("GET", f"http://{little_server.url}/hop")
     assert st == 200
-    spans = {s["name"]: s for s in tracing.spans_for("mw-3")}
+    spans = {s["name"]: s for s in _wait_spans("mw-3", 2)}
     assert set(spans) == {"GET /hop", "GET /ok"}
     assert spans["GET /ok"]["parentId"] == spans["GET /hop"]["spanId"]
 
