@@ -1395,6 +1395,8 @@ class PlaneLabelDriftRule(Rule):
             "seaweedfs_tpu/native/write_plane.cc",
         "seaweedfs_tpu/server/read_plane.py":
             "seaweedfs_tpu/native/read_plane.cc",
+        "seaweedfs_tpu/server/filer_read_plane_native.py":
+            "seaweedfs_tpu/native/filer_read_plane.cc",
     }
     _TABLES = (("kRecStageNames", "RECORD_STAGES"),
                ("kRecFallbackNames", "RECORD_FALLBACKS"),
@@ -1445,6 +1447,91 @@ class PlaneLabelDriftRule(Rule):
                     f"label in cluster.slow/cluster.top")
 
 
+class UnguardedReadPathLookupRule(Rule):
+    """SWFS020: a store lookup on the filer's hot-path GET handler
+    with no read-plane fill fence captured first.
+
+    The native filer read plane (native/filer_read_plane.cc, ISSUE
+    19) keeps a C-side entry map that the Python front refills after
+    its own store lookups (`warm_fill`).  A fill is only coherent if
+    its generation token was captured BEFORE the store SELECT — a
+    token taken after (or never) lets a fill land over an
+    invalidation that raced the lookup, and the plane then serves
+    pre-overwrite bytes.  So the contract on every GET-shaped handler
+    in the filer front is a fixed statement order: `begin_fill()` (or
+    an explicit `native_read` test) first, `find_entry(...)` after.
+    Flagged: any `*.find_entry(...)` call inside a `_get*` handler of
+    the filer server with no preceding statement that names
+    `begin_fill` or `native_read`.  Handlers that can never feed the
+    plane (mutation endpoints, list/stat surfaces) are out of scope
+    by name; a deliberate unfenced probe takes `# noqa: SWFS020`
+    with a reason."""
+
+    id = "SWFS020"
+    severity = "error"
+    title = "filer GET-path store lookup without a read-plane fence"
+
+    _FILES = ("seaweedfs_tpu/server/filer_server.py",)
+
+    @staticmethod
+    def _names_fence(node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Attribute) and \
+                    n.attr in ("begin_fill", "native_read"):
+                return True
+            if isinstance(n, ast.Name) and \
+                    n.id in ("begin_fill", "native_read"):
+                return True
+        return False
+
+    def check(self, ctx: FileContext):
+        rel = ctx.relpath.replace("\\", "/")
+        if not any(rel.endswith(f) for f in self._FILES):
+            return
+        parents: dict = {}
+        for parent in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute) or \
+                    node.func.attr != "find_entry":
+                continue
+            # scope: only the GET-shaped handlers feed warm fills
+            fn: "ast.AST | None" = node
+            while fn in parents and not isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = parents[fn]
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) or \
+                    not fn.name.startswith("_get"):
+                continue
+            cur: ast.AST = node
+            fenced = False
+            while cur in parents and not fenced:
+                parent = parents[cur]
+                for field in ("body", "orelse", "finalbody"):
+                    stmts = getattr(parent, field, None)
+                    if isinstance(stmts, list) and cur in stmts:
+                        fenced = any(
+                            self._names_fence(prev)
+                            for prev in stmts[:stmts.index(cur)])
+                        break
+                if parent is fn:
+                    break       # the fence must sit inside the
+                cur = parent    # handler, before the lookup
+            if fenced:
+                continue
+            yield self.finding(
+                ctx, node,
+                f"{_dotted(node.func)}(...) runs the store lookup in "
+                f"{fn.name}() with no read-plane fence before it — "
+                f"capture the plane generation (begin_fill) or test "
+                f"native_read first, or a warm fill landing after a "
+                f"raced invalidation serves pre-overwrite bytes from "
+                f"the C-side entry map")
+
+
 RULES = [
     LockDisciplineRule(),
     JitBlockingRule(),
@@ -1465,4 +1552,5 @@ RULES = [
     DynamicMetricNameRule(),
     UnguardedMetaLogAppendRule(),
     PlaneLabelDriftRule(),
+    UnguardedReadPathLookupRule(),
 ]

@@ -276,7 +276,25 @@ class Filer:
             self._notify("create", e, None)
         self._note_dir(parent)
 
-    def find_entry(self, path: str) -> Entry | None:
+    @staticmethod
+    def _count_negative(result: str) -> None:
+        """filer_read_negative_total{result}: the read-edge negative
+        outcome split — "hit" = absence proven WITHOUT a store SELECT
+        (overlay / cached-None / negative-directory), "miss" = the
+        SELECT was paid and came back empty.  Only emitted from
+        count_negative=True call sites (the filer/S3 GET edge), so
+        internal probes (write_file's old-entry check, mkdir scans)
+        don't pollute the read-shape signal."""
+        from ..stats import PROCESS
+        PROCESS.counter_add(
+            "filer_read_negative_total", 1.0,
+            help_text="read-edge lookups that found no entry, by "
+                      "whether absence was proven without a store "
+                      "SELECT",
+            result=result)
+
+    def find_entry(self, path: str,
+                   count_negative: bool = False) -> Entry | None:
         path = normalize_path(path)
         mp = self.meta_plane
         if mp is not None:
@@ -287,10 +305,15 @@ class Filer:
             from .meta_plane import _OMISS
             hit = mp.lookup(path)
             if hit is not _OMISS:
+                if hit is None and count_negative:
+                    self._count_negative("hit")
                 return hit.clone() if hit is not None else None
         mc = self.meta_cache
         if mc is None:
-            return self.store.find_entry(path)
+            entry = self.store.find_entry(path)
+            if entry is None and count_negative:
+                self._count_negative("miss")
+            return entry
         if mc.known_absent(path):
             # negative-directory fast path (ROADMAP 1b): the parent is
             # a tracked fresh directory and this name was never
@@ -300,10 +323,16 @@ class Filer:
             # durably committed before this read began was either
             # served from the overlay or has point-invalidated the
             # name into the parent's poison set via the follower.)
+            if count_negative:
+                self._count_negative("hit")
             return None
         from .meta_cache import _MISS
         hit = mc.lookup_entry(path)
         if hit is not _MISS:
+            if hit is None and count_negative:
+                # cached-None: a prior miss's fill short-circuits the
+                # SELECT until an event invalidates the name
+                self._count_negative("hit")
             # clone: callers mutate the returned entry in place
             # (update_attrs, append_chunks) — the cached copy must
             # stay pristine until an event invalidates it
@@ -313,6 +342,8 @@ class Filer:
         mc.fill_entry(path,
                       entry.clone() if entry is not None else None,
                       token)
+        if entry is None and count_negative:
+            self._count_negative("miss")
         return entry
 
     def delete_entry(self, path: str, recursive: bool = False,

@@ -26,16 +26,23 @@ _tried = False
 def _build_if_stale(src_path: str, out_path: str,
                     extra_flags: "list[str] | None" = None,
                     shared: bool = True,
-                    try_march_native: bool = False) -> "str | None":
-    """Shared mtime-keyed g++ build (one implementation for all three
-    native artifacts): makedirs, staleness check, per-pid scratch so
-    concurrent builders never publish half-written output, atomic
-    publish.  None when the toolchain is unavailable."""
+                    try_march_native: bool = False,
+                    deps: "list[str] | None" = None) -> "str | None":
+    """Shared mtime-keyed g++ build (one implementation for all the
+    native artifacts): makedirs, staleness check (source + any listed
+    header deps), per-pid scratch so concurrent builders never publish
+    half-written output, atomic publish.  None when the toolchain is
+    unavailable."""
     try:
         os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        newest = os.path.getmtime(src_path)
+        for dep in deps or ():
+            try:
+                newest = max(newest, os.path.getmtime(dep))
+            except OSError:
+                pass
         if os.path.exists(out_path) and \
-                os.path.getmtime(out_path) >= \
-                os.path.getmtime(src_path):
+                os.path.getmtime(out_path) >= newest:
             return out_path
         tmp = f"{out_path}.{os.getpid()}.tmp"
         base = ["g++", "-O2", "-std=c++17"]
@@ -322,6 +329,7 @@ def load_write_plane() -> "ctypes.CDLL | None":
 
 _MP_SRC = os.path.join(_DIR, "meta_plane.cc")
 _MP_SO = os.path.join(_DIR, "_build", "libmeta_plane.so")
+_POOL_H = os.path.join(_DIR, "plane_pool.h")
 _mp_lib = None
 _mp_tried = False
 
@@ -336,7 +344,8 @@ def load_meta_plane() -> "ctypes.CDLL | None":
             return _mp_lib
         _mp_tried = True
         try:
-            if _build_if_stale(_MP_SRC, _MP_SO) is None:
+            if _build_if_stale(_MP_SRC, _MP_SO,
+                               deps=[_POOL_H]) is None:
                 return None
             lib = ctypes.CDLL(_MP_SO)
             lib.mp_start.argtypes = [
@@ -370,6 +379,64 @@ def load_meta_plane() -> "ctypes.CDLL | None":
             return None
         _mp_lib = lib
         return _mp_lib
+
+
+# -- filer-read-plane library (filer_read_plane.cc) --------------------
+
+_FRP_SRC = os.path.join(_DIR, "filer_read_plane.cc")
+_FRP_SO = os.path.join(_DIR, "_build", "libfiler_read_plane.so")
+_frp_lib = None
+_frp_tried = False
+
+
+def load_filer_read_plane() -> "ctypes.CDLL | None":
+    """Build (if needed) + load the native filer read plane; None when
+    unavailable — the filer then serves every read from Python (same
+    graceful-degradation contract as the meta plane)."""
+    global _frp_lib, _frp_tried
+    with _lock:
+        if _frp_lib is not None or _frp_tried:
+            return _frp_lib
+        _frp_tried = True
+        try:
+            if _build_if_stale(_FRP_SRC, _FRP_SO,
+                               deps=[_POOL_H]) is None:
+                return None
+            lib = ctypes.CDLL(_FRP_SO)
+            lib.frp_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                      ctypes.POINTER(ctypes.c_int)]
+            lib.frp_start.restype = ctypes.c_int
+            lib.frp_stop.argtypes = [ctypes.c_int]
+            lib.frp_arm.argtypes = [ctypes.c_int, ctypes.c_int]
+            lib.frp_gen.argtypes = [ctypes.c_int]
+            lib.frp_gen.restype = ctypes.c_ulonglong
+            lib.frp_put_entry.argtypes = [
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_ulonglong,
+                ctypes.c_ulonglong]
+            lib.frp_put_entry.restype = ctypes.c_int
+            lib.frp_invalidate.argtypes = [ctypes.c_int,
+                                           ctypes.c_char_p]
+            lib.frp_clear.argtypes = [ctypes.c_int]
+            lib.frp_entries.argtypes = [ctypes.c_int]
+            lib.frp_entries.restype = ctypes.c_int
+            lib.frp_requests.argtypes = [ctypes.c_int]
+            lib.frp_requests.restype = ctypes.c_ulonglong
+            lib.frp_fallbacks.argtypes = [ctypes.c_int]
+            lib.frp_fallbacks.restype = ctypes.c_ulonglong
+            lib.frp_latency.argtypes = [
+                ctypes.c_int, ctypes.POINTER(ctypes.c_ulonglong)]
+            lib.frp_latency.restype = ctypes.c_int
+            lib.frp_stats.argtypes = [
+                ctypes.c_int, ctypes.POINTER(ctypes.c_ulonglong)]
+            lib.frp_stats.restype = ctypes.c_int
+            _bind_record_drain(lib, "frp")
+            lib.frp_set_fetch_delay_ms.argtypes = [ctypes.c_int,
+                                                   ctypes.c_int]
+        except (OSError, subprocess.SubprocessError):
+            return None
+        _frp_lib = lib
+        return _frp_lib
 
 
 _VT_SRC = os.path.join(os.path.dirname(__file__), "volume_tool.cc")

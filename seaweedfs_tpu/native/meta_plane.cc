@@ -62,6 +62,8 @@
 #include <unordered_set>
 #include <vector>
 
+#include "plane_pool.h"
+
 namespace {
 
 constexpr int kMaxServers = 16;
@@ -70,9 +72,6 @@ constexpr size_t kMaxHeaders = 64 * 1024;
 constexpr size_t kMaxPath = 512;
 constexpr size_t kMaxDirs = 4096;               // Filer._known_dirs_cap
 constexpr size_t kMaxChildren = 1u << 20;
-constexpr size_t kUpsPerAddr = 4;
-constexpr size_t kUpsPipelineHigh = 32;         // per-conn inflight split
-constexpr uint64_t kUpstreamTimeoutNs = 5ull * 1000 * 1000 * 1000;
 
 uint64_t now_ns() {
   timespec ts;
@@ -275,18 +274,11 @@ struct Pending {
   int64_t deadline_ms = -1;
 };
 
-struct Upstream {
-  int fd = -1;
-  std::string addr;
-  std::string in;
-  std::string out;
-  bool have_headers = false;
-  size_t header_end = 0;
-  size_t body_need = 0;
-  int status = 0;
-  std::deque<Pending> inflight;   // FIFO: volume plane answers in order
-  bool want_write = false;
-};
+// upstream connections come from the shared persistent plane-socket
+// pool (plane_pool.h, ISSUE 19): same pick/pipeline/expire behavior
+// the inline PR 17 pool had, plus EAGER flush on dispatch — the
+// upload hop no longer pays an epoll round trip per request
+using Upstream = plane_pool::Upstream<Pending>;
 
 // a parsed+uploaded request waiting on the end-of-iteration barrier
 struct WalItem {
@@ -322,8 +314,7 @@ struct Server {
   std::unordered_map<std::string, std::unordered_set<std::string>> dirs;
 
   std::unordered_map<int, Conn> conns;
-  std::map<std::string, std::vector<int>> ups_by_addr;   // addr -> fds
-  std::unordered_map<int, Upstream> ups;
+  plane_pool::Pool<Pending> pool;    // volume write-plane connections
   std::vector<WalItem> wal_pending;
   uint64_t gen_counter = 0;
 
@@ -380,12 +371,6 @@ void conn_arm(Server* s, Conn* c, bool want_write) {
   if (c->want_write == want_write) return;
   c->want_write = want_write;
   arm_fd(s, c->fd, want_write);
-}
-
-void ups_arm(Server* s, Upstream* u, bool want_write) {
-  if (u->want_write == want_write) return;
-  u->want_write = want_write;
-  arm_fd(s, u->fd, want_write);
 }
 
 void close_conn(Server* s, int fd) {
@@ -517,75 +502,6 @@ bool split_parent(const std::string& path, std::string* parent,
   *parent = slash == 0 ? std::string("/") : path.substr(0, slash);
   *name = path.substr(slash + 1);
   return true;
-}
-
-// -- upstream (volume write plane) pool -------------------------------
-
-void ups_fail_inflight(Server* s, Upstream* u);
-
-int ups_open(Server* s, const std::string& addr) {
-  size_t colon = addr.rfind(':');
-  if (colon == std::string::npos) return -1;
-  std::string host = addr.substr(0, colon);
-  int port = atoi(addr.c_str() + colon + 1);
-  sockaddr_in sa{};
-  sa.sin_family = AF_INET;
-  sa.sin_port = htons(uint16_t(port));
-  if (inet_pton(AF_INET, host.c_str(), &sa.sin_addr) != 1) return -1;
-  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
-  if (fd < 0) return -1;
-  int one = 1;
-  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  int rc = connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa));
-  if (rc < 0 && errno != EINPROGRESS) {
-    close(fd);
-    return -1;
-  }
-  epoll_event ev{};
-  ev.events = EPOLLIN;
-  ev.data.fd = fd;
-  if (epoll_ctl(s->epfd, EPOLL_CTL_ADD, fd, &ev) < 0) {
-    close(fd);
-    return -1;
-  }
-  Upstream u;
-  u.fd = fd;
-  u.addr = addr;
-  s->ups[fd] = std::move(u);
-  s->ups_by_addr[addr].push_back(fd);
-  return fd;
-}
-
-void ups_close(Server* s, int fd) {
-  auto it = s->ups.find(fd);
-  if (it == s->ups.end()) return;
-  ups_fail_inflight(s, &it->second);
-  auto& v = s->ups_by_addr[it->second.addr];
-  for (size_t i = 0; i < v.size(); i++)
-    if (v[i] == fd) {
-      v.erase(v.begin() + long(i));
-      break;
-    }
-  epoll_ctl(s->epfd, EPOLL_CTL_DEL, fd, nullptr);
-  close(fd);
-  s->ups.erase(it);
-}
-
-Upstream* ups_pick(Server* s, const std::string& addr) {
-  auto& v = s->ups_by_addr[addr];
-  Upstream* best = nullptr;
-  for (int fd : v) {
-    Upstream* u = &s->ups[fd];
-    if (best == nullptr || u->inflight.size() < best->inflight.size())
-      best = u;
-  }
-  if (best != nullptr && best->inflight.size() < kUpsPipelineHigh)
-    return best;
-  if (v.size() < kUpsPerAddr) {
-    int fd = ups_open(s, addr);
-    if (fd >= 0) return &s->ups[fd];
-  }
-  return best;   // may be saturated or null; caller degrades
 }
 
 // -- WAL framing + group commit ---------------------------------------
@@ -837,7 +753,7 @@ void dispatch_native(Server* s, Conn* c, const std::string& path,
   p.deadline_ms = c->deadline_ms;
   s->parse_ns.fetch_add(p.dispatch_mono - c->req_start_ns,
                         std::memory_order_relaxed);
-  Upstream* u = ups_pick(s, addr);
+  Upstream* u = s->pool.pick(addr);
   if (u == nullptr) {
     s->upstream_errors.fetch_add(1, std::memory_order_relaxed);
     rec_emit_conn(s, c, c->body.size(), 404, kFbUpstream);
@@ -871,7 +787,10 @@ void dispatch_native(Server* s, Conn* c, const std::string& path,
   u->inflight.push_back(std::move(p));
   c->inflight = 1;
   c->body.clear();
-  ups_arm(s, u, true);
+  // eager flush (the ISSUE 19 upload-hop lever): the established
+  // keep-alive socket is almost always writable — send now instead
+  // of paying an epoll round trip to learn that
+  s->pool.flush(u);
 }
 
 void handle_request(Server* s, Conn* c) {
@@ -1034,20 +953,18 @@ void client_feed(Server* s, Conn* c) {
   }
 }
 
-void ups_fail_inflight(Server* s, Upstream* u) {
-  while (!u->inflight.empty()) {
-    Pending p = std::move(u->inflight.front());
-    u->inflight.pop_front();
-    s->upstream_errors.fetch_add(1, std::memory_order_relaxed);
-    rec_emit_pending(s, p, kFbUpstream);
-    auto it = s->conns.find(p.client_fd);
-    if (it == s->conns.end() || it->second.gen != p.client_gen)
-      continue;
-    it->second.inflight = 0;
-    it->second.req_start_ns = 0;
-    respond_fallback(s, &it->second);
-    flush_client(s, p.client_fd);
-  }
+// one dropped in-flight upstream request (conn error / timeout),
+// handed back by the pool: answer the waiting client with the 404
+// fallback so Python re-serves the write
+void ups_drop_pending(Server* s, Pending& p) {
+  s->upstream_errors.fetch_add(1, std::memory_order_relaxed);
+  rec_emit_pending(s, p, kFbUpstream);
+  auto it = s->conns.find(p.client_fd);
+  if (it == s->conns.end() || it->second.gen != p.client_gen) return;
+  it->second.inflight = 0;
+  it->second.req_start_ns = 0;
+  respond_fallback(s, &it->second);
+  flush_client(s, p.client_fd);
 }
 
 // parse one complete volume-plane response off u->in; false = need
@@ -1110,35 +1027,6 @@ bool ups_feed_one(Server* s, Upstream* u) {
   return true;
 }
 
-void ups_flush(Server* s, Upstream* u) {
-  while (!u->out.empty()) {
-    ssize_t n = send(u->fd, u->out.data(), u->out.size(), MSG_NOSIGNAL);
-    if (n > 0) {
-      u->out.erase(0, size_t(n));
-      continue;
-    }
-    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
-      ups_arm(s, u, true);
-      return;
-    }
-    ups_close(s, u->fd);
-    return;
-  }
-  ups_arm(s, u, false);
-}
-
-void expire_upstreams(Server* s) {
-  uint64_t now = mono_ns();
-  std::vector<int> dead;
-  for (auto& kv : s->ups) {
-    Upstream& u = kv.second;
-    if (!u.inflight.empty() &&
-        now - u.inflight.front().enq_mono > kUpstreamTimeoutNs)
-      dead.push_back(kv.first);
-  }
-  for (int fd : dead) ups_close(s, fd);
-}
-
 // -- event loop -------------------------------------------------------
 
 void event_loop(Server* s) {
@@ -1180,15 +1068,14 @@ void event_loop(Server* s) {
         }
         continue;
       }
-      auto uit = s->ups.find(fd);
-      if (uit != s->ups.end()) {
-        Upstream* u = &uit->second;
+      Upstream* u = s->pool.find(fd);
+      if (u != nullptr) {
         if (e & (EPOLLHUP | EPOLLERR)) {
-          ups_close(s, fd);
+          s->pool.close_conn(fd);
           continue;
         }
-        if (e & EPOLLOUT) ups_flush(s, u);
-        if (s->ups.find(fd) == s->ups.end()) continue;
+        if (e & EPOLLOUT) s->pool.flush(u);
+        if ((u = s->pool.find(fd)) == nullptr) continue;
         if (e & EPOLLIN) {
           char buf[65536];
           for (;;) {
@@ -1200,7 +1087,7 @@ void event_loop(Server* s) {
             }
             if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
               break;
-            ups_close(s, fd);
+            s->pool.close_conn(fd);
             u = nullptr;
             break;
           }
@@ -1249,7 +1136,7 @@ void event_loop(Server* s) {
     // round trip this pass lands in ONE WAL append (per segment run)
     // and acks together
     commit_batch(s);
-    expire_upstreams(s);
+    s->pool.expire(mono_ns());
   }
 }
 
@@ -1294,6 +1181,8 @@ int mp_start(const char* host, int port, const char* log_dir,
   s->epfd = epoll_create1(0);
   s->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (s->epfd < 0 || s->listen_fd < 0) goto fail;
+  s->pool.epfd = s->epfd;
+  s->pool.on_drop = [s](Pending& p) { ups_drop_pending(s, p); };
   {
     int one = 1;
     setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one,
@@ -1352,7 +1241,7 @@ void mp_stop(int h) {
   (void)ignored;
   if (s->loop.joinable()) s->loop.join();
   for (auto& kv : s->conns) close(kv.second.fd);
-  for (auto& kv : s->ups) close(kv.second.fd);
+  s->pool.close_all();
   if (s->seg_fd >= 0) close(s->seg_fd);
   if (s->wm_fd >= 0) close(s->wm_fd);
   close(s->listen_fd);

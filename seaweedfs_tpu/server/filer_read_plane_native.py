@@ -1,0 +1,371 @@
+"""Native filer read-plane wrapper (native/filer_read_plane.cc).
+
+The read sibling of server/meta_plane_native.py: a C++ epoll loop that
+serves eligible warm `GET /path` with zero Python per request — parse,
+C-side entry-map lookup, chunk fetch from the volume's native read
+plane over the shared persistent plane-socket pool, 200 to the client.
+Everything else answers the 404 `{"error":"read plane fallback"}` and
+the client replays against the Python filer port.
+
+The C side holds only ADVISORY knowledge: path -> (volume read-plane
+addr, fid, size, mime).  This wrapper supplies the three things the
+C++ loop cannot cheaply do itself:
+
+* COHERENCE: every mutation event — the filer's own (Filer.subscribe)
+  and every sibling writer's (MetaPlane.sink follower tap) —
+  invalidates the touched paths SYNCHRONOUSLY, before anything else
+  runs on the event.  Fills are fenced by the plane's generation
+  clock (`begin_fill` token captured before the entry was read, the
+  meta-cache protocol): a fill that lost a race with a later
+  invalidation is refused by the C side, so the map can only
+  under-serve (fallback), never serve a pre-overwrite chunk.
+* FILLS: a background thread resolves each fill's volume read-plane
+  address (vid -> master lookup -> /status readPlanePort, memoized
+  with a short TTL) and registers the entry.  Fills come from two
+  places — mutation events (the write just told us the geometry) and
+  the Python read path (a warm read that passed the full eligibility
+  check re-registers the path it just served).
+* the METRICS bridge rendered on the filer's /metrics.
+
+Failure contract: construction raises RuntimeError when the toolchain
+can't build the .so (the call site degrades to Python-only serving);
+at runtime a dead volume plane, stale registration, or SIGKILL'd
+worker shows up as clean fallbacks or connection errors — never a
+truncated 200 (the C side buffers the full chunk before framing).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import threading
+import time
+
+from .. import native, operation, security
+from ..filer.filer import CHUNK_SIZE
+from ..util import wlog
+
+# response latency histogram bucket bounds (filer_read_plane.cc
+# kLatBuckets), in seconds — rendered on /metrics as
+# filer_read_plane_native_response_seconds
+RESPONSE_BUCKETS_S = (1e-6, 2e-6, 5e-6, 1e-5, 2e-5, 5e-5, 1e-4, 2e-4,
+                      5e-4, 1e-3, 2e-3, 5e-3, 1e-2, 2e-2, 5e-2, 1e-1,
+                      1.0)
+
+_STATS_KEYS = ("requests", "fallbacks", "stale_misses",
+               "upstream_errors", "parse_ns", "lookup_ns", "fetch_ns",
+               "send_ns")
+
+# flight-record label tables (filer_read_plane.cc kRecStageNames /
+# kRecFallbackNames — the SWFS019 lint pins the literals in sync)
+RECORD_STAGES = ("parse", "lookup", "fetch", "send")
+RECORD_FALLBACKS = ("none", "ineligible", "unknown_path", "stale",
+                    "upstream")
+
+_ADDR_TTL_S = 10.0      # vid -> read-plane-addr memo lifetime
+_FILL_QUEUE_MAX = 4096  # beyond this, fills drop (reads just fall back)
+
+
+def native_read_plane_enabled() -> "bool | None":
+    """SEAWEEDFS_TPU_FILER_READ_PLANE_NATIVE: '0' forces off, '1'
+    forces on, unset/other = auto (on when the meta plane is on and
+    the toolchain builds the .so)."""
+    v = os.environ.get("SEAWEEDFS_TPU_FILER_READ_PLANE_NATIVE", "")
+    if v == "0":
+        return False
+    if v == "1":
+        return True
+    return None
+
+
+def _path_bytes_ok(path: str) -> bool:
+    """Mirror of the C side's request-target byte filter: only fill
+    paths the plane could actually be asked for verbatim (printable
+    ASCII, no quote/backslash/percent/query/fragment — a percent in
+    the URL means the Python front sees a DIFFERENT, decoded path)."""
+    return all(0x21 <= ord(ch) <= 0x7E and ch not in '"\\%?#'
+               for ch in path)
+
+
+class NativeReadPlane:
+    """One native filer read plane bound to <host>:<ephemeral>,
+    fetching chunks from volume read planes located via `master`."""
+
+    def __init__(self, master: str, host: str = "127.0.0.1"):
+        self._lib = native.load_filer_read_plane()
+        if self._lib is None:
+            raise RuntimeError("native read plane unavailable")
+        port = ctypes.c_int(0)
+        self._h = self._lib.frp_start(host.encode(), 0,
+                                      ctypes.byref(port))
+        if self._h < 0:
+            raise RuntimeError("native read plane failed to start")
+        self.host = host
+        self.port = port.value
+        self.master = master
+        self._armed = False
+        self._drainer = None
+        self._addr_memo: "dict[int, tuple[str | None, float]]" = {}
+        self._fills: "queue.Queue" = queue.Queue(_FILL_QUEUE_MAX)
+        self._stop_evt = threading.Event()
+        self._filler = threading.Thread(target=self._fill_loop,
+                                        daemon=True)
+        self._filler.start()
+
+    # -- arming ---------------------------------------------------------
+
+    def arm(self, on: bool = True) -> None:
+        """The PR 11 native_on/native_off lever: disarmed, the
+        listener stays up but every request answers the 404 fallback
+        (clients keep their conns; Python serves)."""
+        self._armed = bool(on)
+        self._lib.frp_arm(self._h, 1 if on else 0)
+
+    @property
+    def armed(self) -> bool:
+        return self._armed
+
+    # -- coherence (called from filer listener + plane sink) ------------
+
+    def begin_fill(self) -> int:
+        """Generation token for a warm fill; capture BEFORE reading
+        the entry that will be registered (the meta-cache begin_fill
+        protocol — the C side refuses the fill if any invalidation of
+        the path lands after this point)."""
+        return int(self._lib.frp_gen(self._h))
+
+    def invalidate(self, path: str) -> None:
+        try:
+            self._lib.frp_invalidate(self._h, path.encode())
+        except (OSError, UnicodeEncodeError):
+            pass
+
+    def clear(self) -> None:
+        self._lib.frp_clear(self._h)
+
+    def on_event(self, event: dict) -> None:
+        """Filer listener (Filer.subscribe): this process's own
+        mutation events.  Invalidation is SYNCHRONOUS — it completes
+        before the write's ack returns to the client — so a reader
+        who observed the ack can never be served pre-mutation bytes;
+        the refill rides the async fill queue behind its fence
+        token."""
+        try:
+            self._learn(event)
+        except Exception:  # noqa: SWFS004 — advisory knowledge only;
+            pass           # a missed fill means fallbacks, never
+            #                stale bytes (invalidation is the first
+            #                statement and does not allocate)
+
+    def _learn(self, ev: dict) -> None:
+        new = ev.get("newEntry")
+        old = ev.get("oldEntry")
+        for side in (new, old):
+            p = (side or {}).get("fullPath", "")
+            if p:
+                self.invalidate(p)
+        if not new or new.get("isDirectory") or \
+                ev.get("op", "") not in ("create", "update"):
+            return
+        fill = self._eligible_json(new)
+        if fill is None:
+            return
+        # token AFTER the invalidation above: a later mutation still
+        # fences this fill out, an earlier one no longer can
+        token = self.begin_fill()
+        self._enqueue_fill(new.get("fullPath", ""), fill, token)
+
+    def on_follower_events(self, events) -> None:
+        """MetaPlane.sink: the coherence follower's raw poll batches —
+        (event, raw_new, pos, wid) tuples for every sibling writer's
+        WAL line (including the native meta plane's own acks, which is
+        exactly how natively-written files become natively
+        readable)."""
+        for item in events:
+            try:
+                self._learn(item[0] if isinstance(item, tuple)
+                            else item)
+            except Exception:  # noqa: SWFS004
+                pass
+
+    # -- fills ----------------------------------------------------------
+
+    def _eligible_json(self, new: dict) -> "tuple[str, int, str] | None":
+        """(fid, size, mime) when the event-JSON entry is servable
+        natively: exactly one whole-file plain chunk, no TTL, no
+        extended attributes (SSE markers live there), no read-auth."""
+        chunks = new.get("chunks") or []
+        if len(chunks) != 1:
+            return None
+        c = chunks[0]
+        size = int(c.get("size", 0))
+        if int(c.get("offset", 0)) != 0 or size <= 0 or \
+                size > CHUNK_SIZE:
+            return None
+        attrs = new.get("attributes") or {}
+        if int(attrs.get("ttlSec", 0) or 0) != 0:
+            return None
+        if attrs.get("symlinkTarget", ""):
+            return None
+        if new.get("extended"):
+            return None
+        fid = c.get("fileId", "")
+        if not fid or "," not in fid:
+            return None
+        return fid, size, attrs.get("mime", "")
+
+    def eligible_entry(self, entry) -> "tuple[str, int, str] | None":
+        """Same check over a live filer Entry (the Python read path's
+        warm-fill hook)."""
+        if entry.is_directory or len(entry.chunks) != 1:
+            return None
+        c = entry.chunks[0]
+        if c.offset != 0 or c.size <= 0 or c.size > CHUNK_SIZE:
+            return None
+        a = entry.attributes
+        if a.ttl_sec or a.symlink_target or entry.extended:
+            return None
+        if not c.file_id or "," not in c.file_id:
+            return None
+        return c.file_id, c.size, a.mime
+
+    def warm_fill(self, path: str, entry, token: int) -> None:
+        """Register `path` after the Python front served it warm;
+        `token` must have been captured via begin_fill() BEFORE the
+        entry was looked up."""
+        fill = self.eligible_entry(entry)
+        if fill is not None:
+            self._enqueue_fill(path, fill, token)
+
+    def _enqueue_fill(self, path: str, fill, token: int) -> None:
+        if not path or not _path_bytes_ok(path):
+            return
+        if security.current().volume_read_key:
+            return  # read-jwt cluster: the bare native GET would 401
+        try:
+            self._fills.put_nowait((path, fill[0], fill[1], fill[2],
+                                    token))
+        except queue.Full:
+            pass  # dropped fill = fallbacks until re-read, never stale
+
+    def _addr_for_vid(self, vid: int) -> "str | None":
+        memo = self._addr_memo
+        hit = memo.get(vid)
+        now = time.monotonic()
+        if hit is not None and hit[1] > now:
+            return hit[0]
+        addr = None
+        try:
+            for loc in operation.lookup(self.master, vid):
+                addr = operation._read_plane_addr_for(loc["url"])
+                if addr is not None:
+                    break
+        except Exception:  # noqa: BLE001 — dead master = dry fills
+            addr = None
+        if len(memo) > 1024:
+            memo.clear()
+        memo[vid] = (addr, now + _ADDR_TTL_S)
+        return addr
+
+    def _fill_loop(self) -> None:
+        while not self._stop_evt.is_set():
+            try:
+                item = self._fills.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            path, fid, size, mime, token = item
+            try:
+                vid = int(fid.partition(",")[0])
+                addr = self._addr_for_vid(vid)
+                if addr is None:
+                    continue  # no volume plane: path stays fallback
+                self._lib.frp_put_entry(
+                    self._h, path.encode(), addr.encode(),
+                    fid.encode(), (mime or "").encode(),
+                    int(size), int(token))
+            except Exception as e:  # noqa: BLE001
+                wlog.debug(f"read plane fill failed: {e!r}")
+
+    # -- telemetry ------------------------------------------------------
+
+    def requests(self) -> int:
+        return self._lib.frp_requests(self._h)
+
+    def fallbacks(self) -> int:
+        return self._lib.frp_fallbacks(self._h)
+
+    def entries(self) -> int:
+        return max(0, self._lib.frp_entries(self._h))
+
+    def stats(self) -> dict:
+        out = (ctypes.c_ulonglong * 8)()
+        n = self._lib.frp_stats(self._h, out)
+        if n <= 0:
+            return {k: 0 for k in _STATS_KEYS}
+        return {k: int(out[i]) for i, k in enumerate(_STATS_KEYS)}
+
+    def response_histogram(self) -> "tuple[list[int], int, float]":
+        """(cumulative bucket counts aligned with RESPONSE_BUCKETS_S +
+        an +Inf cell, total count, sum seconds)."""
+        out = (ctypes.c_ulonglong * 20)()
+        cells = self._lib.frp_latency(self._h, out)
+        if cells <= 0:
+            return [], 0, 0.0
+        buckets = [int(out[i]) for i in range(cells + 1)]
+        return buckets, int(out[cells + 1]), out[cells + 2] / 1e9
+
+    # -- flight records (ISSUE 18) --------------------------------------
+
+    def drain_records(self, sink=None, cap: int = 512):
+        """Pull the plane's flight ring (see native.drain_plane_records
+        for the sink-vs-list contract).  Single-consumer: concurrent
+        pulls must be serialized by the owning PlaneRecordDrainer."""
+        if self._h < 0:
+            return [] if sink is None else 0
+        return native.drain_plane_records(self._lib, "frp", self._h,
+                                          sink, cap)
+
+    def records_dropped(self) -> int:
+        return int(self._lib.frp_records_dropped(self._h)) \
+            if self._h >= 0 else 0
+
+    def set_fetch_delay_ms(self, ms: int) -> None:
+        """Failpoint: stall the volume fetch hop of every native
+        request by `ms` (chaos tests widen the in-flight window with
+        this before delivering SIGKILL)."""
+        if self._h >= 0:
+            self._lib.frp_set_fetch_delay_ms(self._h, int(ms))
+
+    def start_record_drain(self, tracker=None,
+                           metrics=None) -> "object":
+        """Start the flight-record drainer (tick + scrape hook);
+        idempotent.  Returns the profiling.PlaneRecordDrainer."""
+        if self._drainer is not None:
+            return self._drainer
+        from .. import profiling
+        sink = profiling.PlaneRecordSink(
+            # plane label "filer_read": the VOLUME read plane already
+            # owns "read" in the plane_stage_seconds family, and the
+            # two share stage names ("parse"/"lookup"/"send") that
+            # would silently merge under one label
+            "filer", "filer_read", "GET", RECORD_STAGES,
+            RECORD_FALLBACKS,
+            tracker=tracker, metrics=metrics)
+        self._drainer = profiling.PlaneRecordDrainer(
+            sink, lambda s: self.drain_records(sink=s),
+            self.records_dropped).start()
+        return self._drainer
+
+    def stop(self) -> None:
+        """Filler + drainer first, then the native server: frp_stop
+        frees the Server object, so no wrapper thread may still be
+        inside an frp_* call when it runs."""
+        if self._h < 0:
+            return
+        self._stop_evt.set()
+        self._filler.join(timeout=5)
+        if self._drainer is not None:
+            self._drainer.stop()
+        self._lib.frp_stop(self._h)
+        self._h = -1

@@ -186,21 +186,65 @@ class FilerServer:
                         replication=replication)
                 except (RuntimeError, OSError):
                     self.native_meta = None  # pure-Python fallback
+        # native READ plane (native/filer_read_plane.cc — the read
+        # sibling): eligible warm GETs are parsed, looked up against a
+        # C-side entry map, fetched from the volume read plane over
+        # the shared persistent plane-socket pool and answered by a
+        # C++ epoll loop; everything else 404s and the client falls
+        # back to this port.  Kill switch
+        # SEAWEEDFS_TPU_FILER_READ_PLANE_NATIVE=0.  Requires an event
+        # channel covering every writer that can mutate the namespace:
+        # this process's own listener always, plus the meta plane's
+        # follower tap when pre-fork siblings share the store — so in
+        # worker mode without the meta plane the read plane stays off
+        # (a sibling's overwrite would never invalidate our map).
+        self.native_read = None
+        if self.filer.meta_plane is not None or not reuse_port:
+            from .filer_read_plane_native import (
+                NativeReadPlane, native_read_plane_enabled)
+            if native_read_plane_enabled() is not False:
+                try:
+                    rp_host = self.http.host if all(
+                        c in "0123456789." for c in self.http.host) \
+                        else "127.0.0.1"
+                    self.native_read = NativeReadPlane(master,
+                                                       host=rp_host)
+                except (RuntimeError, OSError):
+                    self.native_read = None  # pure-Python fallback
+        # directory/entry truth flows in from both sides: this
+        # process's own Python-path mutations (listener) and every
+        # sibling writer's WAL lines (the meta plane's follower tap,
+        # fanned out when both native planes are up)
+        taps = []
         if self.native_meta is not None:
-            # directory truth flows in from both sides: this process's
-            # own Python-path mutations (listener) and every sibling
-            # writer's WAL lines (the meta plane's follower tap)
             self.filer.subscribe(self.native_meta.on_event)
-            self.filer.meta_plane.sink = \
-                self.native_meta.on_follower_events
+            taps.append(self.native_meta.on_follower_events)
+        if self.native_read is not None:
+            self.filer.subscribe(self.native_read.on_event)
+            taps.append(self.native_read.on_follower_events)
+        if taps and self.filer.meta_plane is not None:
+            if len(taps) == 1:
+                self.filer.meta_plane.sink = taps[0]
+            else:
+                def _fan_sink(evs, _taps=tuple(taps)):
+                    evs = list(evs)  # both taps see the full batch
+                    for t in _taps:
+                        t(evs)
+                self.filer.meta_plane.sink = _fan_sink
+        if self.native_meta is not None:
             self.native_meta.arm(True)
             # flight-deck drainer (ISSUE 18): pull the plane's
             # per-request records into traces / FlightRecorder /
             # stage histograms on a tick + at /debug/slow scrape
             self.native_meta.start_record_drain()
+        if self.native_read is not None:
+            self.native_read.arm(True)
+            self.native_read.start_record_drain()
         self.http.route("GET", "/status", self._status)
         self.http.route("POST", "/debug/meta_plane",
                         self._debug_meta_plane)
+        self.http.route("POST", "/debug/read_plane",
+                        self._debug_read_plane)
         self.http.route("GET", "/__meta__/lookup", self._meta_lookup)
         self.http.route("POST", "/__meta__/rename", self._meta_rename)
         self.http.route("POST", "/__meta__/set_attrs",
@@ -360,6 +404,7 @@ class FilerServer:
         from ..stats import render_process
         return 200, ((self.metrics.render() +
                       self._native_meta_metrics_text() +
+                      self._native_read_metrics_text() +
                       render_process()).encode(),
                      "text/plain; version=0.0.4")
 
@@ -422,15 +467,73 @@ class FilerServer:
                    f"{count}\n")
         return "".join(out)
 
+    def _native_read_metrics_text(self) -> str:
+        """Native read-plane counters rendered straight from the C++
+        atomics at scrape time: requests/fallbacks/stale/upstream, the
+        response latency histogram, the entry-map gauge, and the
+        per-stage wall split (parse / lookup / fetch / send) that
+        keeps cluster.slow able to attribute a tail read that crossed
+        the native plane."""
+        nr = self.native_read
+        if nr is None:
+            return ""
+        st = nr.stats()
+        out = []
+        for key, help_text in (
+                ("requests", "filer reads served by the native read "
+                             "plane"),
+                ("fallbacks", "native read-plane requests answered "
+                              "404 (python filer owns them)"),
+                ("stale_misses", "native fetches the volume plane "
+                                 "404'd (registration invalidated)"),
+                ("upstream_errors", "chunk fetches the volume read "
+                                    "plane refused or dropped")):
+            name = f"filer_read_plane_native_{key}_total"
+            out.append(f"# HELP {name} {help_text}\n"
+                       f"# TYPE {name} counter\n"
+                       f"{name} {st[key]}\n")
+        out.append("# HELP filer_read_plane_native_stage_seconds_total"
+                   " cumulative native-plane wall per stage\n"
+                   "# TYPE filer_read_plane_native_stage_seconds_total"
+                   " counter\n")
+        for stage in ("parse", "lookup", "fetch", "send"):
+            out.append(f"filer_read_plane_native_stage_seconds_total"
+                       f'{{stage="{stage}"}} '
+                       f"{st[stage + '_ns'] / 1e9}\n")
+        out.append("# HELP filer_read_plane_native_entries "
+                   "paths registered in the C-side entry map\n"
+                   "# TYPE filer_read_plane_native_entries gauge\n"
+                   f"filer_read_plane_native_entries {nr.entries()}\n")
+        from .filer_read_plane_native import RESPONSE_BUCKETS_S
+        buckets, count, total_s = nr.response_histogram()
+        out.append("# HELP filer_read_plane_native_response_seconds "
+                   "native read-plane response latency\n"
+                   "# TYPE filer_read_plane_native_response_seconds "
+                   "histogram\n")
+        for le, cum in zip(RESPONSE_BUCKETS_S, buckets):
+            out.append(
+                f"filer_read_plane_native_response_seconds_bucket"
+                f'{{le="{le}"}} {cum}\n')
+        out.append(f"filer_read_plane_native_response_seconds_bucket"
+                   f'{{le="+Inf"}} {count}\n'
+                   f"filer_read_plane_native_response_seconds_sum "
+                   f"{total_s}\n"
+                   f"filer_read_plane_native_response_seconds_count "
+                   f"{count}\n")
+        return "".join(out)
+
     def _status(self, req: Request):
         """Plane discovery (the volume server's /status precedent):
         lean clients probe this once per process and pin their hot
-        PUTs to the native meta-plane port."""
+        PUTs/GETs to the native plane ports."""
         nm = self.native_meta
+        nr = self.native_read
         return 200, {"version": "seaweedfs-tpu/0.1",
                      "role": "filer",
                      "metaPlanePort":
-                         nm.port if nm is not None and nm.armed else 0}
+                         nm.port if nm is not None and nm.armed else 0,
+                     "readPlanePort":
+                         nr.port if nr is not None and nr.armed else 0}
 
     def _debug_meta_plane(self, req: Request):
         """The PR 11 native_on/native_off lever, filer edition:
@@ -458,6 +561,33 @@ class FilerServer:
                      "fidLevel": max(nm.fid_level(), 0),
                      "recordsDropped": nm.records_dropped(),
                      **nm.stats()}
+
+    def _debug_read_plane(self, req: Request):
+        """The arm/disarm lever, read edition: POST /debug/read_plane
+        {"native": "on"|"off"} arms/disarms the native read plane
+        without tearing down its listener (clients keep their sockets;
+        every request 404s to Python while off)."""
+        nr = self.native_read
+        if nr is None:
+            return 404, {"error": "native read plane not running"}
+        b = req.json() if req.body else {}
+        want = str(b.get("native", "")).lower()
+        if want in ("on", "1", "true"):
+            nr.arm(True)
+        elif want in ("off", "0", "false"):
+            nr.arm(False)
+        if "fetchDelayMs" in b:
+            # chaos failpoint: stall the native volume-fetch hop so a
+            # SIGKILL lands mid-flight / a plane-served read lands in
+            # cluster.slow on demand
+            try:
+                nr.set_fetch_delay_ms(int(b.get("fetchDelayMs") or 0))
+            except (TypeError, ValueError):
+                pass
+        return 200, {"armed": nr.armed, "port": nr.port,
+                     "entries": nr.entries(),
+                     "recordsDropped": nr.records_dropped(),
+                     **nr.stats()}
 
     def start(self):
         self.http.start()
@@ -504,6 +634,8 @@ class FilerServer:
             # before the Python listener: once the native port stops
             # acking, clients retry here and must still find a server
             self.native_meta.stop()
+        if getattr(self, "native_read", None) is not None:
+            self.native_read.stop()
         self.http.stop()
         # meta plane first (final async apply), then store + metalog
         self.filer.close()
@@ -598,7 +730,12 @@ class FilerServer:
     def _get(self, req: Request, path: str):
         if path.endswith("/") or path == "":
             return self._list(req, path or "/")
-        entry = self.filer.find_entry(path)
+        # read-plane fill fence (SWFS020 guard shape): capture the
+        # plane's generation token BEFORE the store lookup, so the
+        # warm fill below loses to any invalidation that raced it
+        nr = self.native_read
+        token = nr.begin_fill() if nr is not None else 0
+        entry = self.filer.find_entry(path, count_negative=True)
         if entry is None:
             return 404, {"error": f"{path} not found"}
         if entry.is_directory:
@@ -630,6 +767,10 @@ class FilerServer:
         # holds one chunk in memory, not the file
         body = self.filer.open_read_stream(entry, offset, size,
                                            on_close=release)
+        if nr is not None and not rng:
+            # warm fill: the NEXT read of this path can be served
+            # natively (fenced by the pre-lookup token above)
+            nr.warm_fill(path, entry, token)
         headers = {"Content-Type": mime,
                    "Content-Length": str(size)}
         if rng:

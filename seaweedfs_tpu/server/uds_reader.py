@@ -29,9 +29,14 @@ from ..storage import types
 
 
 class UdsNeedleServer:
-    def __init__(self, store, sock_path: str):
+    def __init__(self, store, sock_path: str, on_read=None):
         self.store = store
         self.sock_path = sock_path
+        # on_read(vid, key): post-serve hook — the volume server uses
+        # it to lazily warm the native TCP read plane, which would
+        # otherwise never learn about needles whose every read takes
+        # this zero-copy path (the filer-plane fetch would 404 forever)
+        self.on_read = on_read
         self._stop = threading.Event()
         try:
             os.remove(sock_path)
@@ -146,6 +151,11 @@ class UdsNeedleServer:
         finally:
             if dup_fd is not None:
                 os.close(dup_fd)
+        if self.on_read is not None:
+            try:
+                self.on_read(vid, key)
+            except Exception:  # noqa: SWFS004 — plane warm is
+                pass           # best-effort cache upkeep
 
 
 def uds_read_needle(sock_path: str, vid: int, key: int,

@@ -189,8 +189,9 @@ class VolumeServer:
                 from .uds_reader import UdsNeedleServer
                 sock = os.path.join(
                     self.store.locations[0].directory, "uds.sock")
-                self.uds_server = UdsNeedleServer(self.store,
-                                                  sock).start()
+                self.uds_server = UdsNeedleServer(
+                    self.store, sock,
+                    on_read=self._rp_warm_key).start()
             except OSError:  # pragma: no cover — no AF_UNIX
                 self.uds_server = None
         # native TCP read plane (the C++ second implementation of the
@@ -273,9 +274,31 @@ class VolumeServer:
             if item is None:
                 return
             try:
-                self._rp_register(item[0], item[1], lazy=True)
+                vid, n = item
+                if isinstance(n, int):
+                    # key-only warm (UDS on_read hook): the serve path
+                    # only touched the needle map, so re-read the
+                    # record here — off the hot path, once per needle
+                    # (the _rp_seen gate below makes repeats free)
+                    if n in self._rp_seen.get(vid, ()):
+                        continue
+                    n = self.store.read_needle(vid, n)
+                self._rp_register(vid, n, lazy=True)
             except Exception:  # noqa: SWFS004 — read-plane cache
                 pass           # upkeep must never kill the worker
+
+    def _rp_warm_key(self, vid: int, key: int) -> None:
+        """UDS post-serve hook: lazily mirror a needle the zero-copy
+        path just served into the native read plane.  Without this,
+        needles only ever read over UDS never reach the plane and the
+        filer's native read funnel 404s on them forever."""
+        q = getattr(self, "_rp_queue", None)
+        if q is None or key in self._rp_seen.get(vid, ()):
+            return
+        try:
+            q.put_nowait((vid, key))
+        except queue.Full:
+            pass           # drop: the next UDS read retries
 
     def _rp_enqueue(self, vid: int, needle) -> None:
         """Async write-path registration (see start()); no-op without

@@ -1277,6 +1277,24 @@ def _native_plane_report(before: "dict[str, list]",
                      "volume_server_read_plane_fallbacks_total")
     if "volume_server_read_plane_requests_total" in after:
         parts.append(f"read {rr:.0f} served/{rf:.0f} fallback")
+    # the filer's native READ plane (ISSUE 19): warm GETs served with
+    # zero Python, coherence misses surfaced beside the fallbacks
+    fr = _counter_sum(
+        after, "filer_read_plane_native_requests_total") - \
+        _counter_sum(before, "filer_read_plane_native_requests_total")
+    ff = _counter_sum(
+        after, "filer_read_plane_native_fallbacks_total") - \
+        _counter_sum(before,
+                     "filer_read_plane_native_fallbacks_total")
+    if "filer_read_plane_native_requests_total" in after:
+        fstale = _counter_sum(
+            after, "filer_read_plane_native_stale_misses_total") - \
+            _counter_sum(before,
+                         "filer_read_plane_native_stale_misses_total")
+        seg = f"filer-read {fr:.0f} served/{ff:.0f} fallback"
+        if fstale > 0:
+            seg += f" stale={fstale:.0f}"
+        parts.append(seg)
     # the filer's native META plane (ISSUE 17): creates acked with
     # zero Python, plus its ack-latency p99 and mean WAL batch
     mname = "filer_meta_plane_native_ack_seconds"
@@ -1313,12 +1331,15 @@ def _native_plane_report(before: "dict[str, list]",
     sname = "seaweedfs_tpu_plane_stage_seconds"
     planes = sorted({l.get("plane", "") for l, _v in
                      after.get(f"{sname}_count", []) if l.get("plane")})
+    from ..server.filer_read_plane_native import (
+        RECORD_STAGES as _FILER_READ_STAGES)
     from ..server.meta_plane_native import (
         RECORD_STAGES as _META_STAGES)
     from ..server.read_plane import RECORD_STAGES as _READ_STAGES
     from ..server.write_plane import RECORD_STAGES as _WRITE_STAGES
     stage_order = {"meta": _META_STAGES, "write": _WRITE_STAGES,
-                   "read": _READ_STAGES}
+                   "read": _READ_STAGES,
+                   "filer_read": _FILER_READ_STAGES}
     for plane in planes:
         segs = []
         for stg in stage_order.get(plane, ()):

@@ -1308,3 +1308,81 @@ def test_swfs019_noqa_suppresses():
 def test_swfs019_repo_is_clean(package_findings):
     assert [f for f in package_findings
             if f.rule == "SWFS019"] == []
+
+# -- SWFS020: filer GET-path lookup without a read-plane fence -------------
+
+def test_swfs020_flags_unfenced_get_lookup():
+    src = """
+    class FilerServer:
+        def _get(self, req, path):
+            entry = self.filer.find_entry(path)
+            return 200, entry
+    """
+    found = check_at(src, "SWFS020",
+                     "seaweedfs_tpu/server/filer_server.py")
+    assert len(found) == 1
+    assert "read-plane fence" in found[0].message
+
+
+def test_swfs020_fenced_lookup_passes():
+    src = """
+    class FilerServer:
+        def _get(self, req, path):
+            nr = self.native_read
+            token = nr.begin_fill() if nr is not None else 0
+            entry = self.filer.find_entry(path)
+            return 200, entry
+    """
+    assert check_at(src, "SWFS020",
+                    "seaweedfs_tpu/server/filer_server.py") == []
+
+
+def test_swfs020_fence_after_lookup_still_flags():
+    # ordering IS the contract: a token captured after the SELECT can
+    # outrank an invalidation that raced the lookup
+    src = """
+    class FilerServer:
+        def _get(self, req, path):
+            entry = self.filer.find_entry(path)
+            token = self.native_read.begin_fill()
+            return 200, entry
+    """
+    found = check_at(src, "SWFS020",
+                     "seaweedfs_tpu/server/filer_server.py")
+    assert len(found) == 1
+
+
+def test_swfs020_non_get_handlers_and_other_modules_pass():
+    src = """
+    class FilerServer:
+        def _meta_lookup(self, req):
+            return self.filer.find_entry(req.query["path"])
+
+        def _tus(self, req, path):
+            return self.filer.find_entry(path)
+    """
+    assert check_at(src, "SWFS020",
+                    "seaweedfs_tpu/server/filer_server.py") == []
+    src2 = """
+    class Anything:
+        def _get(self, req, path):
+            return self.filer.find_entry(path)
+    """
+    assert check_at(src2, "SWFS020",
+                    "seaweedfs_tpu/server/volume_server.py") == []
+
+
+def test_swfs020_noqa_suppresses():
+    src = """
+    class FilerServer:
+        def _get_probe(self, path):
+            return self.filer.find_entry(path)  # noqa: SWFS020 — cold
+    """
+    assert check_at(src, "SWFS020",
+                    "seaweedfs_tpu/server/filer_server.py") == []
+
+
+def test_swfs020_repo_is_clean(package_findings):
+    assert [f for f in package_findings
+            if f.rule == "SWFS020"] == []
+

@@ -1,0 +1,402 @@
+"""ISSUE 19: the native C++ filer read plane (native/filer_read_plane.cc)
+— the read sibling of the meta plane, fused with the volume read plane
+over persistent plane sockets.
+
+Proves the load-bearing promises:
+
+* a warm single-chunk GET through the plane port is byte-identical to
+  the Python front (body AND the Content-Type/Content-Length pair);
+* everything the plane does not own falls back as 404
+  `{"error":"read plane fallback"}` and the Python front replays it;
+* overwrite/delete coherence is exact: the C-side entry map NEVER
+  serves pre-overwrite bytes (generation-fenced fills, synchronous
+  invalidation on every mutation event);
+* SIGKILL of a pre-fork worker mid-response under load never yields a
+  truncated-but-framed 200 — clients see complete bytes or a clean
+  connection error, surviving workers keep serving, re-arm works.
+"""
+
+import http.client
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.server.httpd import http_bytes, http_json
+
+from proc_framework import Proc, ProcCluster, free_port
+
+
+# ---------------------------------------------------------------------
+# in-process cluster: master + volume + filer in this process, the
+# cheapest way to drive the plane and inspect its driver directly
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def trio(tmp_path_factory):
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    tmp = str(tmp_path_factory.mktemp("frp"))
+    master = MasterServer().start()
+    vol = VolumeServer([os.path.join(tmp, "v0")], master.url,
+                       pulse_seconds=0.3).start()
+    time.sleep(0.6)
+    filer = FilerServer(master.url).start()
+    if filer.native_read is None:
+        filer.stop(); vol.stop(); master.stop()
+        pytest.skip("native filer read plane unavailable in this image")
+    yield master, vol, filer
+    filer.stop()
+    vol.stop()
+    master.stop()
+
+
+def _plane_get(port: int, path: str, headers=None, timeout=10):
+    """One GET against the plane port; returns (status, body, resp)."""
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        c.request("GET", path, headers=headers or {})
+        r = c.getresponse()
+        return r.status, r.read(), r
+    finally:
+        c.close()
+
+
+def _warm(filer, path: str, want: bytes, retries: int = 100):
+    """Drive the fallback contract until the plane serves `path`:
+    plane GET, on 404 replay on the Python front (that re-warms both
+    the filer map and the volume plane's lazy registration)."""
+    nr = filer.native_read
+    for _ in range(retries):
+        st, body, r = _plane_get(nr.port, path)
+        if st == 200:
+            return body, r
+        st2, body2, _ = http_bytes(
+            "GET", f"{filer.http.url}{path}", timeout=10)
+        assert st2 == 200 and body2 == want, \
+            f"python front broken during warm: {st2}"
+        time.sleep(0.05)
+    raise AssertionError(f"plane never warmed for {path}")
+
+
+def test_warm_read_byte_parity(trio):
+    _, _, filer = trio
+    body = os.urandom(257_123)
+    st, _, _ = http_bytes(
+        "PUT", f"{filer.http.url}/rp/parity.bin", body,
+        {"Content-Type": "text/x-parity"}, timeout=10)
+    assert st == 201
+    st, pybody, pyhdr = http_bytes(
+        "GET", f"{filer.http.url}/rp/parity.bin", timeout=10)
+    assert st == 200 and pybody == body
+
+    got, resp = _warm(filer, "/rp/parity.bin", body)
+    assert got == body, "plane bytes differ from python front"
+    assert resp.getheader("Content-Type") == pyhdr["Content-Type"]
+    assert resp.getheader("Content-Length") == \
+        pyhdr["Content-Length"]
+    assert filer.native_read.requests() >= 1
+
+
+def test_ineligible_requests_fall_back(trio):
+    _, _, filer = trio
+    nr = filer.native_read
+    body = os.urandom(10_000)
+    assert http_bytes("PUT", f"{filer.http.url}/rp/fb.bin", body,
+                      {"Content-Type": "application/octet-stream"},
+                      timeout=10)[0] == 201
+    _warm(filer, "/rp/fb.bin", body)
+
+    # range reads, unknown paths, conditional and authed requests all
+    # punt to the Python front with the canonical fallback body
+    for path, hdrs in (
+            ("/rp/fb.bin", {"Range": "bytes=0-99"}),
+            ("/rp/never-written.bin", None),
+            ("/rp/fb.bin", {"If-None-Match": '"x"'}),
+            ("/rp/fb.bin", {"Authorization": "Bearer t"}),
+            ("/rp/", None)):
+        st, fb, _ = _plane_get(nr.port, path, headers=hdrs)
+        assert st == 404, (path, hdrs, st)
+        assert fb == b'{"error":"read plane fallback"}', fb
+    # the replay target actually serves the range the plane refused
+    st, part, _ = http_bytes(
+        "GET", f"{filer.http.url}/rp/fb.bin",
+        headers={"Range": "bytes=0-99"}, timeout=10)
+    assert st == 206 and part == body[:100]
+
+
+def test_ttl_entries_never_enter_the_plane(trio):
+    _, _, filer = trio
+    nr = filer.native_read
+    body = b"ttl" * 1000
+    st, _, _ = http_bytes(
+        "PUT", f"{filer.http.url}/rp/ttl.bin", body,
+        {"Content-Type": "application/octet-stream"}, timeout=10)
+    assert st == 201
+    # the HTTP front has no ttl knob; stamp it through the filer API —
+    # the update event invalidates any fill the PUT raced in
+    filer.filer.update_attrs("/rp/ttl.bin", ttl_sec=60)
+    # read it repeatedly through the Python front: a TTL'd entry must
+    # never be filled, so the plane keeps falling back
+    for _ in range(5):
+        st, got, _ = http_bytes(
+            "GET", f"{filer.http.url}/rp/ttl.bin", timeout=10)
+        assert st == 200 and got == body
+        st, fb, _ = _plane_get(nr.port, "/rp/ttl.bin")
+        assert st == 404 and b"fallback" in fb
+        time.sleep(0.05)
+
+
+def test_overwrite_coherence_never_serves_stale(trio):
+    """THE coherence acceptance: overwrite through the Python front,
+    then hammer the plane — pre-overwrite bytes must never appear,
+    even while the async fill from the previous warm read races the
+    invalidation (the generation fence decides)."""
+    _, _, filer = trio
+    nr = filer.native_read
+    url = filer.http.url
+    prev = os.urandom(50_000)
+    assert http_bytes("PUT", f"{url}/rp/coh.bin", prev,
+                      {"Content-Type": "application/octet-stream"},
+                      timeout=10)[0] == 201
+    _warm(filer, "/rp/coh.bin", prev)
+    for cycle in range(12):
+        cur = os.urandom(50_000 + cycle)
+        assert http_bytes(
+            "PUT", f"{url}/rp/coh.bin", cur,
+            {"Content-Type": "application/octet-stream"},
+            timeout=10)[0] == 201
+        # immediately after the PUT ack the plane must already be
+        # coherent: fallback or the NEW bytes, never the old
+        for _ in range(3):
+            st, got, _ = _plane_get(nr.port, "/rp/coh.bin")
+            if st == 200:
+                assert got == cur, \
+                    f"cycle {cycle}: plane served stale bytes"
+            else:
+                assert b"fallback" in got
+        # re-warm through the contract and check parity again
+        got, _ = _warm(filer, "/rp/coh.bin", cur)
+        assert got == cur
+        prev = cur
+
+
+def test_delete_coherence(trio):
+    _, _, filer = trio
+    nr = filer.native_read
+    body = os.urandom(20_000)
+    assert http_bytes("PUT", f"{filer.http.url}/rp/del.bin", body,
+                      {"Content-Type": "application/octet-stream"},
+                      timeout=10)[0] == 201
+    _warm(filer, "/rp/del.bin", body)
+    st, _, _ = http_bytes("DELETE", f"{filer.http.url}/rp/del.bin",
+                          timeout=10)
+    assert st < 300
+    st, got, _ = _plane_get(nr.port, "/rp/del.bin")
+    assert st == 404 and b"fallback" in got, \
+        "plane served a deleted file"
+
+
+def test_status_debug_lever_and_metrics(trio):
+    _, _, filer = trio
+    nr = filer.native_read
+    url = filer.http.url
+    st = http_json("GET", f"{url}/status", timeout=10)
+    assert st["readPlanePort"] == nr.port
+
+    dbg = http_json("POST", f"{url}/debug/read_plane",
+                    {"native": "off"}, timeout=10)
+    assert dbg["armed"] is False
+    assert http_json("GET", f"{url}/status",
+                     timeout=10)["readPlanePort"] == 0
+    # disarmed: even warm paths fall back, python front still serves
+    st2, fb, _ = _plane_get(nr.port, "/rp/parity.bin")
+    assert st2 == 404 and b"fallback" in fb
+    dbg = http_json("POST", f"{url}/debug/read_plane",
+                    {"native": "on"}, timeout=10)
+    assert dbg["armed"] is True
+
+    stt, text, _ = http_bytes("GET", f"{url}/metrics", timeout=10)
+    text = text.decode()
+    assert "filer_read_plane_native_requests_total" in text
+    assert 'stage_seconds_total{stage="fetch"}' in text
+    assert "filer_read_plane_native_response_seconds_bucket" in text
+
+
+def test_negative_read_counter(trio):
+    """Misses on provably-absent paths short-circuit without a store
+    SELECT and are counted by result (hit = no SELECT paid)."""
+    _, _, filer = trio
+    url = filer.http.url
+    for _ in range(3):
+        st, _, _ = http_bytes("GET", f"{url}/rp/absent-forever.bin",
+                              timeout=10)
+        assert st == 404
+    _, text, _ = http_bytes("GET", f"{url}/metrics", timeout=10)
+    lines = [ln for ln in text.decode().splitlines()
+             if "filer_read_negative_total" in ln
+             and not ln.startswith("#")]
+    assert lines, "negative-read counter never emitted"
+    total = sum(float(ln.rsplit(" ", 1)[1]) for ln in lines)
+    assert total >= 3
+
+
+# ---------------------------------------------------------------------
+# chaos: SIGKILL a pre-fork worker's plane mid-response under load
+# ---------------------------------------------------------------------
+
+def _children_of(pid: int) -> list:
+    try:
+        with open(f"/proc/{pid}/task/{pid}/children") as f:
+            return [int(p) for p in f.read().split()]
+    except OSError:
+        return []
+
+
+def _worker_plane_ports(url: str, tries: int = 60) -> set:
+    """SO_REUSEPORT spreads /status across the workers; poll until
+    we've seen every distinct plane port (or tries run out)."""
+    ports = set()
+    for _ in range(tries):
+        try:
+            p = int(http_json("GET", f"{url}/status",
+                              timeout=5).get("readPlanePort") or 0)
+            if p:
+                ports.add(p)
+        except OSError:
+            pass
+        time.sleep(0.1)
+    return ports
+
+
+@pytest.mark.slow
+def test_chaos_sigkill_worker_mid_response(tmp_path):
+    """kill -9 one pre-fork worker while its plane is mid-fetch (the
+    SEAWEEDFS_TPU_FRP_FETCH_DELAY_MS failpoint holds every plane
+    response open): every in-flight client sees a clean connection
+    error or the complete bytes — never a truncated body behind a
+    fully-framed 200 — the surviving worker keeps serving both ports,
+    and the debug lever still re-arms."""
+    c = ProcCluster(str(tmp_path), volumes=1)
+    c.start()
+    store = os.path.join(str(tmp_path), "filer-ck.db")
+    fport = free_port()
+    victim = Proc(
+        "filer-ck",
+        ["filer", "-port", str(fport), "-master", c.master,
+         "-store", store],
+        fport, os.path.join(str(tmp_path), "filer-ck.log"),
+        env_extra={"SEAWEEDFS_TPU_FILER_WORKERS": "2",
+                   "SEAWEEDFS_TPU_FRP_FETCH_DELAY_MS": "30"})
+    victim.start()
+    url = victim.url
+    body = os.urandom(120_000)
+    try:
+        st, _, _ = http_bytes(
+            "PUT", f"{url}/ck/hot.bin", body,
+            {"Content-Type": "application/octet-stream"}, timeout=10)
+        assert st == 201
+        ports = _worker_plane_ports(url)
+        if not ports:
+            pytest.skip("no worker plane came up in this image")
+        # warm every worker's map through the fallback contract
+        warmed = set()
+        deadline = time.time() + 30
+        while warmed != ports and time.time() < deadline:
+            for p in ports - warmed:
+                try:
+                    st2, got, _ = _plane_get(p, "/ck/hot.bin",
+                                             timeout=5)
+                except OSError:
+                    continue
+                if st2 == 200 and got == body:
+                    warmed.add(p)
+            http_bytes("GET", f"{url}/ck/hot.bin", timeout=10)
+            time.sleep(0.1)
+        assert warmed, "no plane ever warmed"
+
+        anomalies, clean_errors, ok = [], [0], [0]
+        stop = threading.Event()
+
+        def hammer(port):
+            while not stop.is_set():
+                try:
+                    st3, got, _ = _plane_get(port, "/ck/hot.bin",
+                                             timeout=5)
+                except (OSError, http.client.HTTPException):
+                    clean_errors[0] += 1
+                    continue
+                if st3 == 200:
+                    if got != body:
+                        anomalies.append(
+                            (port, len(got)))   # truncated 200!
+                    else:
+                        ok[0] += 1
+
+        threads = [threading.Thread(target=hammer, args=(p,),
+                                    daemon=True)
+                   for p in warmed for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        kids = _children_of(victim.popen.pid)
+        assert kids, "pre-fork sibling never spawned"
+        os.kill(kids[0], signal.SIGKILL)     # mid-response: failpoint
+        time.sleep(1.5)                      # holds fetches open
+        stop.set()
+        for t in threads:
+            t.join(5)
+
+        assert not anomalies, \
+            f"truncated-but-framed 200s observed: {anomalies[:5]}"
+        assert ok[0] > 0, "no plane reads completed at all"
+
+        # the surviving worker keeps serving the Python front
+        alive = False
+        for _ in range(50):
+            try:
+                st4, got, _ = http_bytes(
+                    "GET", f"{url}/ck/hot.bin", timeout=5)
+                if st4 == 200 and got == body:
+                    alive = True
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        assert alive, "python front died with the killed worker"
+
+        # re-arm lever still works on the survivor, and its plane
+        # serves warm reads again afterwards
+        for _ in range(20):
+            try:
+                dbg = http_json("POST", f"{url}/debug/read_plane",
+                                {"native": "on"}, timeout=5)
+                if dbg.get("armed"):
+                    break
+            except OSError:
+                time.sleep(0.2)
+        live = _worker_plane_ports(url, tries=20)
+        assert live, "no plane port advertised after the kill"
+        served = False
+        for _ in range(100):
+            for p in live:
+                try:
+                    st5, got, _ = _plane_get(p, "/ck/hot.bin",
+                                             timeout=5)
+                except OSError:
+                    continue
+                if st5 == 200 and got == body:
+                    served = True
+                    break
+            if served:
+                break
+            http_bytes("GET", f"{url}/ck/hot.bin", timeout=10)
+            time.sleep(0.1)
+        assert served, "plane never served again after re-arm"
+    finally:
+        victim.stop()
+        c.stop()
