@@ -1094,6 +1094,187 @@ def _measure_soak(duration_s: float = 20.0,
     }
 
 
+def _measure_slo_soak(duration_s: float = 30.0,
+                      budget_s: float = 0.5) -> dict:
+    """SLO-autopilot soak (ISSUE 20 acceptance): a diurnal load swing
+    plus a slow-replica window against an in-process cluster, with a
+    REAL autopilot (seaweedfs_tpu/autopilot.py) closing the loop over
+    the hedge/brownout knobs while deadline-carrying reads measure
+    the SLO.  Four phases — night (paced trickle), morning ramp
+    (concurrent tight loops), a slow-replica window (one replica's
+    Python read path wedged by an armed delay while the hedge plane
+    absorbs it), evening (paced) — with a paced filer write tenant
+    riding the whole run for byte-identity.  Acceptance is a VERDICT,
+    not a number: p99 of every deadline read within the budget, blown
+    + shed fractions bounded, zero corruption, and the controller's
+    actions on the record."""
+    import shutil
+    import tempfile
+    import threading
+    from pathlib import Path
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    import chaos as _chaos
+    from soak import SoakCluster, TenantTraffic, percentile
+
+    from seaweedfs_tpu import faults, operation, qos, stats
+    from seaweedfs_tpu.util import deadline, hedge
+
+    qos.reset()
+    hedge.reset()
+    faults.reset()
+    tmp = Path(tempfile.mkdtemp(prefix="bench_slo_"))
+    sc = SoakCluster(tmp, volumes=3)
+    # the controller under test is the filer's OWN loop (built by
+    # FilerServer via autopilot.build_for_filer): hedge/brownout are
+    # module-global in this in-process rig, so a second bench-side
+    # controller would be exactly the dual-driver shape SWFS021
+    # outlaws — observe the real one instead of competing with it
+    ap = sc.filer.autopilot
+    assert ap is not None and ap.enabled, \
+        "slo_soak needs the filer autopilot armed " \
+        "(SEAWEEDFS_TPU_AUTOPILOT)"
+    # pin plane discovery to "no planes": the armed volume.read.serve
+    # delay lives on the Python port, and the wedged-replica phase
+    # must actually wedge the replica it targets
+    with operation._uds_lock:
+        for u in sc.cluster.all_urls:
+            operation._uds_probe[u] = {}
+    try:
+        blobs = {}
+        for _ in range(8):
+            data = os.urandom(4096)
+            fid = operation.submit(sc.master_url, data,
+                                   replication="001")
+            blobs[fid] = data
+        for _ in range(4):          # warm the hedge tracker
+            for f in blobs:
+                assert operation.read(sc.master_url, f) == blobs[f]
+        fid0 = next(iter(blobs))
+        locs = operation.lookup(sc.master_url,
+                                int(fid0.split(",")[0]))
+        delayed = locs[0]["url"] if len(locs) >= 2 else None
+        targets = [f for f in blobs if delayed and (
+            lambda ls: len(ls) >= 2 and ls[0]["url"] == delayed)(
+            operation.lookup(sc.master_url, int(f.split(",")[0])))]
+
+        phases: "dict[str, dict]" = {}
+        mismatches = 0
+
+        def run_phase(name: str, seconds: float, threads: int,
+                      pace_s: float, fids: "list[str]") -> None:
+            nonlocal mismatches
+            lat: "list[float]" = []
+            blown = [0]
+            lock = threading.Lock()
+            stop_at = time.monotonic() + seconds
+
+            def loop(seed: int) -> None:
+                nonlocal mismatches
+                i = seed
+                while time.monotonic() < stop_at:
+                    f = fids[i % len(fids)]
+                    i += 1
+                    t0 = time.monotonic()
+                    try:
+                        with deadline.scope(budget_s):
+                            got = operation.read(sc.master_url, f)
+                        if got != blobs[f]:
+                            mismatches += 1
+                        with lock:
+                            lat.append(time.monotonic() - t0)
+                    except deadline.DeadlineExceeded:
+                        with lock:
+                            blown[0] += 1
+                    except (OSError, RuntimeError):
+                        with lock:
+                            blown[0] += 1
+                    if pace_s:
+                        time.sleep(pace_s)
+
+            ts = [threading.Thread(target=loop, args=(k,),
+                                   daemon=True)
+                  for k in range(threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=seconds + 30)
+            phases[name] = {
+                "reads": len(lat), "blown": blown[0],
+                "p50_ms": round(percentile(lat, 0.50) * 1e3, 2),
+                "p99_ms": round(percentile(lat, 0.99) * 1e3, 2),
+            } if lat else {"reads": 0, "blown": blown[0]}
+
+        shed0 = _chaos.metric_sum(
+            stats.PROCESS.render(),
+            "seaweedfs_tpu_qos_rejected_total", reason="brownout")
+        writer = TenantTraffic(sc.filer_url, "slo", payload=2048,
+                               target_rps=10, seed=91).start()
+        u = duration_s / 6.0
+        run_phase("night", u, threads=1, pace_s=0.05,
+                  fids=list(blobs))
+        run_phase("morning", 2 * u, threads=3, pace_s=0.0,
+                  fids=list(blobs))
+        if targets:
+            _chaos.arm(delayed, "volume.read.serve=delay,ms=300,"
+                                f"match={delayed}")
+        run_phase("slow_replica", 2 * u, threads=2, pace_s=0.0,
+                  fids=targets or list(blobs))
+        faults.reset()
+        run_phase("evening", u, threads=1, pace_s=0.05,
+                  fids=list(blobs))
+        writer.stop()
+        writer.verify_all()
+
+        all_lat_ms = [phases[p]["p99_ms"] for p in phases
+                      if "p99_ms" in phases[p]]
+        total_reads = sum(p["reads"] for p in phases.values())
+        total_blown = sum(p["blown"] for p in phases.values())
+        shed = _chaos.metric_sum(
+            stats.PROCESS.render(),
+            "seaweedfs_tpu_qos_rejected_total",
+            reason="brownout") - shed0
+        snap = ap.snapshot()
+        blown_frac = total_blown / max(total_reads + total_blown, 1)
+        shed_frac = shed / max(total_reads + total_blown, 1)
+        slo_held = bool(
+            all_lat_ms and
+            max(all_lat_ms) <= budget_s * 1e3 and
+            blown_frac <= 0.01 and shed_frac <= 0.05 and
+            mismatches == 0 and not writer.stats.errors)
+        return {
+            "scenario": "slo_autopilot_soak",
+            "budget_ms": budget_s * 1e3,
+            "duration_s": duration_s,
+            "phases": phases,
+            "reads_total": total_reads,
+            "blown_total": total_blown,
+            "blown_frac": round(blown_frac, 5),
+            "shed_total": shed,
+            "shed_frac": round(shed_frac, 5),
+            "mismatches": mismatches,
+            "write_tenant": writer.stats.summary(),
+            "autopilot": {
+                "ticks": snap["ticks"],
+                "knobs": {k: v["value"]
+                          for k, v in snap["knobs"].items()},
+                "actions": len(snap["actions"]),
+                "last_actions": snap["actions"][-5:],
+            },
+            "slo_held": slo_held,
+        }
+    finally:
+        with operation._uds_lock:
+            for u in sc.cluster.all_urls:
+                operation._uds_probe.pop(u, None)
+        sc.stop()
+        faults.reset()
+        hedge.reset()
+        qos.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _measure_read_path(duration_s: float = 8.0, files: int = 48,
                        tenants: int = 3) -> dict:
     """Read-path cache tier A/B + degraded arm (ISSUE 11 acceptance).
@@ -3426,5 +3607,12 @@ if __name__ == "__main__":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         dur = float(sys.argv[2]) if len(sys.argv) > 2 else 20.0
         print(json.dumps(_measure_soak(duration_s=dur)))
+    elif len(sys.argv) >= 2 and sys.argv[1] == "slo_soak":
+        # SLO-autopilot soak (ISSUE 20): diurnal swing + slow-replica
+        # window with the autopilot closing the loop; acceptance is
+        # the slo_held verdict (p99 within budget, shed bounded)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        dur = float(sys.argv[2]) if len(sys.argv) > 2 else 30.0
+        print(json.dumps(_measure_slo_soak(duration_s=dur)))
     else:
         main()

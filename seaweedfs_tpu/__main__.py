@@ -719,13 +719,15 @@ def main(argv: list[str] | None = None) -> int:
             # silently serve with fewer processes than -workers asked
             import threading as _threading
             respawns = [0]
+            drained: "set[int]" = set()   # pids the autopilot drained
+            # on purpose — the monitor must not resurrect them
 
             def _worker_monitor():
                 while True:
                     time.sleep(2.0)
                     for i, wp in enumerate(worker_procs):
                         rc = wp.poll()
-                        if rc is None:
+                        if rc is None or wp.pid in drained:
                             continue
                         wlog.warning(
                             f"filer worker pid={wp.pid} exited "
@@ -738,6 +740,46 @@ def main(argv: list[str] | None = None) -> int:
                             + argv)
             _threading.Thread(target=_worker_monitor,
                               daemon=True).start()
+            # SLO autopilot "workers" actuator (autopilot.py, ISSUE
+            # 20): only the pre-fork PARENT registers it — it owns
+            # the sibling fleet — so a single-process filer can never
+            # have workers conjured by a control rule.  Fleet size
+            # counts the parent; bounds [1, 2x the requested size].
+            ap = getattr(fs, "autopilot", None)
+            if ap is not None:
+                from .autopilot import Actuator
+                _wlock = _threading.Lock()
+
+                def _fleet_size() -> float:
+                    with _wlock:
+                        return 1.0 + sum(
+                            1 for wp in worker_procs
+                            if wp.poll() is None
+                            and wp.pid not in drained)
+
+                def _scale_fleet(n: float) -> None:
+                    want = max(0, int(round(n)) - 1)
+                    with _wlock:
+                        live = [wp for wp in worker_procs
+                                if wp.poll() is None
+                                and wp.pid not in drained]
+                        while len(live) < want:
+                            wp = _subprocess.Popen(
+                                [sys.executable, "-m",
+                                 "seaweedfs_tpu"] + argv)
+                            worker_procs.append(wp)
+                            live.append(wp)
+                        while len(live) > want:
+                            wp = live.pop()
+                            drained.add(wp.pid)
+                            wp.terminate()
+
+                ap.register(Actuator(
+                    "workers", get=_fleet_size, set=_scale_fleet,
+                    lo=1.0, hi=float(max(workers * 2, 2)),
+                    cooldown=30.0,
+                    describe="SO_REUSEPORT pre-fork filer "
+                             "processes (parent included)"))
         if args.metrics_address:
             from .stats import MetricsPusher
             MetricsPusher(fs.metrics, "filer", fs.url,

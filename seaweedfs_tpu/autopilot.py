@@ -1,0 +1,691 @@
+"""SLO autopilot: the closed feedback loop over the knobs PRs 13-14
+shipped open-loop (ROADMAP item 4).
+
+Every sensor already exists — deadline verdicts, hedge issued/won,
+brownout sheds, cache hit/miss/eviction counters, `gil_wait_ratio` —
+and every actuator already exists as a hand-set env var or runtime
+lever.  This module closes the loop: a per-role controller thread
+(~1 s tick) reads counter DELTAS between its own ticks off the shared
+`stats.PROCESS` registry and drives the actuators through a typed
+registry that is the ONLY sanctioned runtime mutation path for an
+autopilot-controlled knob (devtools rule SWFS021 enforces that — a
+direct env write or ad-hoc setter call elsewhere is a second driver
+fighting this one).
+
+Control discipline (every rule, no exceptions):
+
+* **Bounded** — an actuator carries `[lo, hi]`; `actuate()` clamps
+  and refuses a step past the bound rather than sliding toward it.
+* **Hysteresis-damped** — a rule must see its trigger condition for
+  `confirm` CONSECUTIVE ticks before a knob moves, and a move smaller
+  than `deadband` (relative) is not worth a flight note and is
+  skipped.
+* **Per-knob cooldown** — after an actuation the knob holds for
+  `cooldown` seconds no matter what the sensors say, so one noisy
+  window cannot saw a knob back and forth.
+* **Sensor gap = hold** — a failed scrape, a missing counter, or a
+  window with too few samples NEVER actuates.  The controller only
+  moves on evidence; absence of evidence parks the knob where it is.
+* **Observable** — every actuation lands in the bounded action log
+  (`/debug/autopilot`), the `autopilot_actions_total{knob,direction}`
+  counter, the per-knob `autopilot_knob{knob}` gauge and (when a
+  request context is armed, e.g. the debug lever) a `flight_note`.
+
+Kill switches, strongest first: `SEAWEEDFS_TPU_AUTOPILOT=0` (the
+loop never starts and a running loop holds), `POST /debug/autopilot
+{"enabled": false}` (runtime, per process), and per-knob absence —
+a role that never registers a "workers" actuator can never have its
+workers touched.
+
+Native-plane supervision rides the same tick: each registered
+`PlaneGuard` watches a plane's error/fallback share of its own
+request delta; a spike disarms the plane through the SAME lever
+`POST /debug/meta_plane {"armed": false}` drives, then a background
+probe re-arms it after an exponentially-backed-off probation — the
+zero-Python hot paths get a supervised degradation path instead of
+an operator page.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from . import profiling, stats
+from .util import wlog
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get("SEAWEEDFS_TPU_AUTOPILOT", "1") \
+        not in ("0", "false")
+
+
+def tick_interval() -> float:
+    return max(0.05,
+               _env_float("SEAWEEDFS_TPU_AUTOPILOT_TICK_MS", 1000.0)
+               / 1e3)
+
+
+class Actuator:
+    """One controllable knob: a getter, a setter, hard bounds and a
+    cooldown.  `set` is only ever called by `Autopilot.actuate()` —
+    the registry IS the mutation path (SWFS021)."""
+
+    __slots__ = ("name", "get", "set", "lo", "hi", "cooldown",
+                 "last_actuated", "describe")
+
+    def __init__(self, name: str, get, set, lo: float, hi: float,
+                 cooldown: "float | None" = None,
+                 describe: str = ""):
+        if not (lo <= hi):
+            raise ValueError(f"{name}: lo {lo} > hi {hi}")
+        self.name = name
+        self.get = get
+        self.set = set
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.cooldown = (cooldown if cooldown is not None else
+                         _env_float(
+                             "SEAWEEDFS_TPU_AUTOPILOT_COOLDOWN_S",
+                             5.0))
+        self.last_actuated: "float | None" = None
+        self.describe = describe
+
+
+class PlaneGuard:
+    """Supervision state for one native plane.
+
+    `stats` returns the plane's cumulative counter dict (requests,
+    fallbacks, *_errors...); `arm(bool)` is the existing
+    /debug/*_plane lever; `armed()` reports the current state so an
+    operator disarm is respected (the guard never re-arms a plane it
+    did not itself disarm).  A trip needs BOTH an absolute error
+    floor (`min_errors` in the window) and an error share of the
+    plane's own traffic (`trip_ratio`) — a single failed request on
+    an idle plane is not a spike.  Probation doubles per consecutive
+    trip up to `max_backoff` and resets after a clean probation."""
+
+    __slots__ = ("name", "stats", "arm", "armed", "trip_ratio",
+                 "min_errors", "backoff", "max_backoff",
+                 "disarmed_by_us", "probation_until", "trips",
+                 "_prev", "_streak", "confirm")
+
+    def __init__(self, name: str, stats, arm, armed,
+                 trip_ratio: float = 0.5, min_errors: int = 5,
+                 backoff: float = 10.0, max_backoff: float = 300.0,
+                 confirm: int = 1):
+        self.name = name
+        self.stats = stats
+        self.arm = arm
+        self.armed = armed
+        self.trip_ratio = trip_ratio
+        self.min_errors = min_errors
+        self.backoff = backoff
+        self.max_backoff = max_backoff
+        self.confirm = max(1, confirm)
+        self.disarmed_by_us = False
+        self.probation_until = 0.0
+        self.trips = 0
+        self._streak = 0
+        self._prev: "dict | None" = None
+
+    _ERROR_KEYS = ("wal_errors", "upstream_errors", "errors")
+
+    def window(self) -> "tuple[float, float, float] | None":
+        """(requests, errors, fallbacks) delta since the last tick,
+        or None on the first sample / a failed scrape (sensor gap =
+        hold)."""
+        try:
+            cur = dict(self.stats() or {})
+        except Exception:
+            return None
+        prev, self._prev = self._prev, cur
+        if prev is None:
+            return None
+        d = {k: max(0.0, float(cur.get(k, 0)) - float(prev.get(k, 0)))
+             for k in cur}
+        errors = sum(d.get(k, 0.0) for k in self._ERROR_KEYS)
+        return (d.get("requests", 0.0), errors,
+                d.get("fallbacks", 0.0))
+
+
+class Autopilot:
+    """The per-role controller.  Construction wires nothing; the
+    server registers its actuators/planes, then `start()` spins the
+    daemon tick thread.  `tick(now)` is deliberately callable by hand
+    with a pinned clock so every control rule is unit-testable with
+    zero threads and zero sleeps."""
+
+    ACTION_LOG = 64
+
+    def __init__(self, role: str,
+                 metrics: "stats.Metrics | None" = None,
+                 sense=None, now=time.monotonic,
+                 confirm: "int | None" = None):
+        self.role = role
+        self.metrics = metrics if metrics is not None else \
+            stats.PROCESS
+        self.now = now
+        self.enabled = enabled_by_env()
+        self.confirm = confirm if confirm is not None else max(
+            1, int(_env_float("SEAWEEDFS_TPU_AUTOPILOT_CONFIRM", 2)))
+        self.deadband = 0.02
+        self.actuators: "dict[str, Actuator]" = {}
+        self.planes: "list[PlaneGuard]" = []
+        self.actions: "deque[dict]" = deque(maxlen=self.ACTION_LOG)
+        self.ticks = 0
+        self.sensor_gaps = 0
+        self._sense = sense if sense is not None else self._sense_process
+        self._prev_sample: "dict | None" = None
+        self._streaks: "dict[str, int]" = {}
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self._lock = threading.Lock()
+        self._publish_enabled()
+
+    # -- registry ---------------------------------------------------------
+
+    def register(self, act: Actuator) -> Actuator:
+        self.actuators[act.name] = act
+        try:
+            self.metrics.gauge_set("autopilot_knob", float(act.get()),
+                                   knob=act.name)
+        except Exception:  # noqa: SWFS004 — metrics are best-effort;
+            pass           # a gauge failure must not block wiring
+        return act
+
+    def register_plane(self, guard: PlaneGuard) -> PlaneGuard:
+        self.planes.append(guard)
+        return guard
+
+    # -- the sole sanctioned mutation path --------------------------------
+
+    def actuate(self, name: str, target: float, reason: str,
+                force: bool = False) -> bool:
+        """Clamp `target` into the knob's bounds and apply it.  The
+        ONLY caller of an Actuator's `set` — every move is bounded,
+        cooldown-checked, logged, counted and gauged.  `force` skips
+        cooldown/deadband (the debug lever and plane guards use it);
+        it never skips the bounds."""
+        act = self.actuators.get(name)
+        if act is None:
+            return False
+        t = self.now()
+        if not force and act.last_actuated is not None and \
+                t - act.last_actuated < act.cooldown:
+            return False
+        try:
+            cur = float(act.get())
+        except Exception:
+            return False                      # sensor gap = hold
+        new = min(act.hi, max(act.lo, float(target)))
+        if not force and abs(new - cur) <= \
+                self.deadband * max(abs(cur), 1e-9):
+            return False
+        if new == cur:
+            return False
+        act.set(new)
+        act.last_actuated = t
+        direction = "up" if new > cur else "down"
+        entry = {"t": time.time(), "knob": name, "from": cur,
+                 "to": new, "direction": direction, "reason": reason}
+        with self._lock:
+            self.actions.append(entry)
+        self.metrics.counter_add(
+            "autopilot_actions_total", 1.0,
+            help_text="autopilot knob movements",
+            knob=name, direction=direction)
+        self.metrics.gauge_set("autopilot_knob", new, knob=name)
+        profiling.flight_note("autopilot",
+                              {"knob": name, "from": round(cur, 6),
+                               "to": round(new, 6),
+                               "reason": reason})
+        wlog.info("autopilot[%s] %s: %.4g -> %.4g (%s)",
+                  self.role, name, cur, new, reason,
+                  component="autopilot")
+        return True
+
+    # -- sensors ----------------------------------------------------------
+
+    def _sense_process(self) -> dict:
+        """Cumulative sensor snapshot off the shared registry.  Keys
+        are stable names the rules subtract between ticks; a key the
+        process has never emitted is simply absent (its rules hold)."""
+        m = self.metrics
+        s: dict = {
+            "hedges_issued": m.counter_sum("hedges_issued_total"),
+            "hedges_won": m.counter_sum("hedges_won_total"),
+            "brownout_shed": m.counter_sum("qos_rejected_total",
+                                           reason="brownout"),
+            "deadline_exceeded":
+                m.counter_sum("deadline_exceeded_total"),
+        }
+        for cache, label in (("chunk", "filer_chunk"),
+                             ("needle", "volume_needle"),
+                             ("meta", "filer_meta")):
+            hits = m.counter_value("read_cache_hits_total",
+                                   cache=label)
+            misses = m.counter_value("read_cache_misses_total",
+                                     cache=label)
+            if hits is None and misses is None:
+                continue          # this cache never served: hold
+            s[f"cache.{cache}.hits"] = hits or 0.0
+            s[f"cache.{cache}.misses"] = misses or 0.0
+            s[f"cache.{cache}.evictions"] = m.counter_value(
+                "read_cache_evictions_total", cache=label) or 0.0
+        g = m.gauge_value("gil_wait_ratio")
+        if g is not None:
+            s["gil_wait_ratio"] = g
+        return s
+
+    def _streak(self, key: str, cond: bool) -> bool:
+        """Hysteresis: `cond` must hold for `confirm` consecutive
+        ticks before the rule fires; any non-triggering tick resets
+        the streak."""
+        n = self._streaks.get(key, 0) + 1 if cond else 0
+        self._streaks[key] = n
+        return n >= self.confirm
+
+    # -- the loop ---------------------------------------------------------
+
+    def start(self) -> "Autopilot":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name=f"weed-autopilot-{self.role}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(tick_interval()):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — the controller
+                # must never take its process down; a broken tick is
+                # a held tick
+                wlog.warning("autopilot tick failed: %s", e,
+                             component="autopilot")
+
+    def set_enabled(self, on: bool) -> None:
+        self.enabled = bool(on)
+        if not on:
+            # stale baselines must not actuate on re-enable: the
+            # first tick after a gap is baseline-only
+            self._prev_sample = None
+            self._streaks.clear()
+        self._publish_enabled()
+
+    def _publish_enabled(self) -> None:
+        try:
+            self.metrics.gauge_set(
+                "autopilot_enabled",
+                1.0 if self.enabled else 0.0,
+                help_text="1 when the SLO autopilot loop may actuate")
+        except Exception:  # noqa: SWFS004 — metrics are best-effort;
+            pass           # the kill switch must work without them
+
+    def tick(self) -> None:
+        """One control step: sense, diff, rule pass, plane pass.
+        Deterministic given (sense, now) — the tests drive it with a
+        fake clock and synthetic counters."""
+        self.ticks += 1
+        if not enabled_by_env():
+            # env kill flipped at runtime: hold AND forget baselines
+            if self.enabled:
+                self.set_enabled(False)
+            return
+        if not self.enabled:
+            return
+        try:
+            sample = self._sense()
+        except Exception:
+            sample = None
+        if sample is None:
+            self.sensor_gaps += 1
+            self._prev_sample = None          # gap = hold, and the
+        else:                                 # next tick re-baselines
+            prev, self._prev_sample = self._prev_sample, sample
+            if prev is not None:              # else baseline-only
+                delta = {k: sample[k] - prev[k]
+                         for k in sample if k in prev
+                         and isinstance(sample[k], (int, float))}
+                self._rule_hedge(delta)
+                self._rule_hedge_floor(delta)
+                self._rule_brownout(delta)
+                self._rule_caches(delta)
+                self._rule_workers(sample)
+        # plane supervision scrapes its own counters — it runs every
+        # enabled tick, baseline ticks and metric gaps included (each
+        # guard's window() holds on ITS OWN first sample / gap)
+        self._plane_pass()
+
+    # -- control rules ----------------------------------------------------
+
+    MIN_HEDGE_WINDOW = 5.0
+
+    def _rule_hedge(self, d: dict) -> None:
+        """Adapt hedge aggressiveness to the measured win rate.  A
+        hedge that usually wins is buying real tail latency — earn
+        tokens faster and fire earlier; a hedge that almost never
+        wins is pure extra load — starve it."""
+        if "hedges_issued" not in d or "hedges_won" not in d:
+            return
+        issued, won = d["hedges_issued"], d["hedges_won"]
+        if issued < self.MIN_HEDGE_WINDOW:
+            self._streak("hedge.hi", False)
+            self._streak("hedge.lo", False)
+            return
+        rate = won / issued
+        if self._streak("hedge.hi", rate > 0.7):
+            r = self.actuators.get("hedge.ratio")
+            if r is not None:
+                self.actuate("hedge.ratio", r.get() * 1.25,
+                             f"win rate {rate:.2f} > 0.7")
+            m = self.actuators.get("hedge.min_ms")
+            if m is not None:
+                self.actuate("hedge.min_ms", m.get() * 0.8,
+                             f"win rate {rate:.2f} > 0.7")
+        elif self._streak("hedge.lo", rate < 0.2):
+            r = self.actuators.get("hedge.ratio")
+            if r is not None:
+                self.actuate("hedge.ratio", r.get() * 0.8,
+                             f"win rate {rate:.2f} < 0.2")
+            m = self.actuators.get("hedge.min_ms")
+            if m is not None:
+                self.actuate("hedge.min_ms", m.get() * 1.25,
+                             f"win rate {rate:.2f} < 0.2")
+
+    def _rule_hedge_floor(self, d: dict) -> None:
+        """The slow-replica rescue: deadlines are blowing and the
+        hedge NEVER fires — the threshold floor sits above the point
+        where insurance could still pay out inside the budget.  Halve
+        it (the win-rate rule cannot help here: a hedge that never
+        issues produces no win-rate evidence, so this is the only
+        path out of a misconfigured floor)."""
+        blown = d.get("deadline_exceeded")
+        issued = d.get("hedges_issued")
+        if blown is None or issued is None:
+            return
+        if self._streak("hedge.floor", blown >= 3 and issued == 0):
+            m = self.actuators.get("hedge.min_ms")
+            if m is not None:
+                self.actuate("hedge.min_ms", m.get() * 0.5,
+                             f"{blown:.0f} blown deadlines, "
+                             f"0 hedges issued")
+
+    def _rule_brownout(self, d: dict) -> None:
+        """Balance shed-vs-blown: deadlines blowing with no sheds
+        means admission is too optimistic (raise the factor: shed
+        earlier); sheds with zero blown deadlines means it is too
+        pessimistic (lower it)."""
+        if "brownout_shed" not in d or "deadline_exceeded" not in d:
+            return
+        shed, blown = d["brownout_shed"], d["deadline_exceeded"]
+        act = self.actuators.get("brownout.factor")
+        if act is None:
+            return
+        if self._streak("brownout.up", blown >= 3 and shed == 0):
+            self.actuate("brownout.factor", act.get() * 1.25,
+                         f"{blown:.0f} blown deadlines, 0 shed")
+        elif self._streak("brownout.down", shed >= 3 and blown == 0):
+            self.actuate("brownout.factor", act.get() * 0.8,
+                         f"{shed:.0f} shed, 0 blown deadlines")
+
+    MIN_CACHE_WINDOW = 20.0
+
+    def _rule_caches(self, d: dict) -> None:
+        """Resize by marginal hit value: a cache that hits well AND
+        still evicts would convert more bytes into more hits — grow
+        it; a busy cache that almost never hits is churn — shrink it
+        and give the memory back."""
+        for cache in ("chunk", "needle", "meta"):
+            name = f"cache.{cache}"
+            act = self.actuators.get(name)
+            if act is None:
+                continue
+            hits = d.get(f"cache.{cache}.hits")
+            misses = d.get(f"cache.{cache}.misses")
+            ev = d.get(f"cache.{cache}.evictions")
+            if hits is None or misses is None:
+                continue                      # sensor gap = hold
+            lookups = hits + misses
+            if lookups < self.MIN_CACHE_WINDOW:
+                self._streak(f"{name}.up", False)
+                self._streak(f"{name}.down", False)
+                continue
+            ratio = hits / lookups
+            if self._streak(f"{name}.up",
+                            ratio > 0.6 and (ev or 0) > 0):
+                self.actuate(name, act.get() * 1.25,
+                             f"hit {ratio:.2f} with "
+                             f"{ev:.0f} evictions")
+            elif self._streak(f"{name}.down",
+                              ratio < 0.1 and (ev or 0) > 0):
+                # evictions are the churn proof: a COLD cache (wipe,
+                # restart) also reads hit~0 but evicts nothing — it
+                # must be left to warm, never shrunk
+                self.actuate(name, act.get() * 0.8,
+                             f"hit {ratio:.2f} < 0.1 while "
+                             f"evicting")
+
+    def _rule_workers(self, sample: dict) -> None:
+        """Grow/drain pre-fork workers off the scheduler probe: a
+        sustained GIL-convoyed process wants a sibling; a sustained
+        idle fleet wants one fewer wakeup source.  Only a role that
+        registered a "workers" actuator (the pre-fork parent) can be
+        moved."""
+        act = self.actuators.get("workers")
+        if act is None:
+            return
+        ratio = sample.get("gil_wait_ratio")
+        if ratio is None:
+            self._streak("workers.up", False)
+            self._streak("workers.down", False)
+            return
+        if self._streak("workers.up", ratio > 0.5):
+            self.actuate("workers", act.get() + 1,
+                         f"gil_wait_ratio {ratio:.2f} > 0.5")
+        elif self._streak("workers.down", ratio < 0.02):
+            self.actuate("workers", act.get() - 1,
+                         f"gil_wait_ratio {ratio:.2f} < 0.02")
+
+    # -- native-plane supervision -----------------------------------------
+
+    def _plane_pass(self) -> None:
+        t = self.now()
+        for g in self.planes:
+            try:
+                armed = bool(g.armed())
+            except Exception:  # noqa: SWFS004 — a plane probe that
+                continue       # errors is a sensor gap: hold, retry
+            if armed:
+                w = g.window()
+                if w is None:
+                    g._streak = 0
+                    continue
+                requests, errors, _fallbacks = w
+                spike = errors >= g.min_errors and \
+                    errors / max(requests, 1.0) >= g.trip_ratio
+                g._streak = g._streak + 1 if spike else 0
+                if g._streak < g.confirm:
+                    if not spike and g.disarmed_by_us and \
+                            g.trips and \
+                            t >= g.probation_until + g.backoff:
+                        # a full clean probation after a re-arm:
+                        # forgive history so an old incident cannot
+                        # escalate a fresh one straight to max
+                        g.trips = 0
+                        g.disarmed_by_us = False
+                    continue
+                g._streak = 0
+                g.trips += 1
+                g.disarmed_by_us = True
+                g.probation_until = t + min(
+                    g.max_backoff,
+                    g.backoff * (2 ** (g.trips - 1)))
+                try:
+                    g.arm(False)
+                except Exception:  # noqa: SWFS004 — a failed disarm
+                    continue       # retries next tick (trip recorded)
+                self._note_plane(g, "disarm",
+                                 f"{errors:.0f} errors / "
+                                 f"{requests:.0f} requests")
+            elif g.disarmed_by_us and t >= g.probation_until:
+                # probe + re-arm; the next spike re-trips with a
+                # doubled probation
+                try:
+                    g.arm(True)
+                except Exception:
+                    g.probation_until = t + g.backoff
+                    continue
+                g._prev = None                # re-baseline the window
+                self._note_plane(g, "rearm",
+                                 f"probation over after trip "
+                                 f"#{g.trips}")
+
+    def _note_plane(self, g: PlaneGuard, what: str,
+                    reason: str) -> None:
+        entry = {"t": time.time(), "knob": f"plane.{g.name}",
+                 "direction": what, "reason": reason}
+        with self._lock:
+            self.actions.append(entry)
+        self.metrics.counter_add(
+            "autopilot_actions_total", 1.0,
+            help_text="autopilot knob movements",
+            knob=f"plane.{g.name}", direction=what)
+        profiling.flight_note("autopilot",
+                              {"plane": g.name, "action": what,
+                               "reason": reason})
+        wlog.warning("autopilot[%s] plane %s: %s (%s)",
+                     self.role, g.name, what, reason,
+                     component="autopilot")
+
+    # -- introspection ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            actions = list(self.actions)
+        return {
+            "role": self.role,
+            "enabled": self.enabled and enabled_by_env(),
+            "ticks": self.ticks,
+            "sensorGaps": self.sensor_gaps,
+            "confirm": self.confirm,
+            "knobs": {
+                name: {"value": self._safe_get(a), "lo": a.lo,
+                       "hi": a.hi, "cooldown": a.cooldown,
+                       "describe": a.describe}
+                for name, a in sorted(self.actuators.items())},
+            "planes": [
+                {"name": g.name, "armed": self._safe_armed(g),
+                 "disarmedByAutopilot": g.disarmed_by_us,
+                 "trips": g.trips,
+                 "probationUntil": g.probation_until}
+                for g in self.planes],
+            "actions": actions,
+        }
+
+    @staticmethod
+    def _safe_get(a: Actuator):
+        try:
+            return a.get()
+        except Exception:
+            return None
+
+    @staticmethod
+    def _safe_armed(g: PlaneGuard):
+        try:
+            return bool(g.armed())
+        except Exception:
+            return None
+
+
+# -- role wiring -----------------------------------------------------------
+
+def build_for_filer(fs) -> Autopilot:
+    """Wire the filer's controllable surface: hedge threshold/ratio,
+    brownout factor, chunk + meta cache sizes, and guards over both
+    native planes.  The pre-fork parent adds a "workers" actuator on
+    top (see __main__)."""
+    from . import qos
+    from .util import hedge
+    ap = Autopilot("filer")
+    ap.register(Actuator(
+        "hedge.ratio",
+        get=hedge.effective_ratio,
+        set=hedge.set_ratio,
+        lo=0.02, hi=0.3,
+        describe="hedge tokens earned per primary read"))
+    ap.register(Actuator(
+        "hedge.min_ms",
+        get=lambda: hedge.min_threshold() * 1e3,
+        set=hedge.set_min_threshold_ms,
+        lo=1.0, hi=50.0,
+        describe="hedge threshold floor (ms)"))
+    ap.register(Actuator(
+        "brownout.factor",
+        get=qos.effective_brownout_factor,
+        set=qos.set_brownout_factor,
+        lo=0.5, hi=4.0,
+        describe="shed when remaining < estimate * f"))
+    flr = getattr(fs, "filer", None)
+    cc = getattr(flr, "chunk_cache", None)
+    if cc is not None:
+        ap.register(Actuator(
+            "cache.chunk",
+            get=lambda: cc.mem.limit / (1 << 20),
+            set=lambda mb: cc.set_mem_limit(int(mb * (1 << 20))),
+            lo=8.0, hi=512.0,
+            describe="filer chunk-body mem cache (MB)"))
+    mc = getattr(flr, "meta_cache", None)
+    if mc is not None:
+        ap.register(Actuator(
+            "cache.meta",
+            get=lambda: mc.capacity,
+            set=lambda n: mc.set_capacity(int(n)),
+            lo=256.0, hi=65536.0,
+            describe="filer metadata cache (entries)"))
+    # `armed` is a PROPERTY on both plane classes — wrap it in a
+    # thunk; passing `nm.armed` bare would freeze the wiring-time bool
+    nm = getattr(fs, "native_meta", None)
+    if nm is not None:
+        ap.register_plane(PlaneGuard(
+            "meta", stats=nm.stats, arm=nm.arm,
+            armed=lambda: nm.armed))
+    nr = getattr(fs, "native_read", None)
+    if nr is not None:
+        ap.register_plane(PlaneGuard(
+            "read", stats=nr.stats, arm=nr.arm,
+            armed=lambda: nr.armed))
+    return ap
+
+
+def build_for_volume(vs) -> Autopilot:
+    """The volume server's surface: the hot-needle cache.  The
+    brownout knob is module-global (qos.py) and deliberately NOT
+    registered here — in-process test clusters co-locate roles, and
+    two loops driving one global knob is exactly the dual-controller
+    shape SWFS021 outlaws; the filer's loop owns it."""
+    ap = Autopilot("volume")
+    nc = getattr(vs, "needle_cache", None)
+    if nc is not None:
+        ap.register(Actuator(
+            "cache.needle",
+            get=lambda: nc.mem.limit / (1 << 20),
+            set=lambda mb: nc.set_mem_limit(int(mb * (1 << 20))),
+            lo=8.0, hi=512.0,
+            describe="volume hot-needle mem cache (MB)"))
+    return ap

@@ -1532,6 +1532,110 @@ class UnguardedReadPathLookupRule(Rule):
                 f"the C-side entry map")
 
 
+class CompetingControllerRule(Rule):
+    """SWFS021: runtime mutation of an autopilot-controlled knob
+    outside the control registry.
+
+    The SLO autopilot (autopilot.py, ISSUE 20) closes a feedback loop
+    over a fixed set of module-global knobs: hedge ratio/floor,
+    brownout factor, cache sizes, worker fleet.  Those knobs are
+    single-writer by design — a second runtime writer (a debug
+    handler poking `hedge.set_ratio`, a server start-up path writing
+    the knob's env var) forms a second controller on the same plant,
+    and the two fight: each one's "correction" is the other's
+    disturbance, so the knob oscillates instead of settling.  The one
+    mutation path is the registry: an `Actuator` registered on the
+    autopilot, driven through `actuate()` (bounded, damped, logged).
+    Flagged: (a) calls to a knob setter (`set_ratio`,
+    `set_min_threshold_ms`, `set_brownout_factor`, `set_limit`,
+    `set_mem_limit`, `set_capacity`) outside autopilot.py and the
+    setter's own defining module; (b) writes to a knob env var
+    (`os.environ[...] = / .setdefault / os.putenv`) anywhere but
+    autopilot.py.  Exempt with `# noqa: SWFS021` and a reason —
+    legitimate for reset-to-baseline paths (hedge.reset, qos.reset)
+    and test rigs that deliberately misconfigure a knob."""
+
+    id = "SWFS021"
+    severity = "error"
+    title = "autopilot-controlled knob mutated outside the registry"
+
+    _REGISTRY = "seaweedfs_tpu/autopilot.py"
+    # setter -> the module that defines it (internal delegation inside
+    # the defining module is wiring, not a second controller)
+    _SETTERS = {
+        "set_ratio": "seaweedfs_tpu/util/hedge.py",
+        "set_min_threshold_ms": "seaweedfs_tpu/util/hedge.py",
+        "set_brownout_factor": "seaweedfs_tpu/qos.py",
+        "set_limit": "seaweedfs_tpu/util/chunk_cache.py",
+        "set_mem_limit": "seaweedfs_tpu/util/chunk_cache.py",
+        "set_capacity": "seaweedfs_tpu/filer/meta_cache.py",
+    }
+    _ENVS = frozenset((
+        "SEAWEEDFS_TPU_HEDGE_RATIO", "SEAWEEDFS_TPU_HEDGE_MIN_MS",
+        "SEAWEEDFS_TPU_HEDGE_BURST", "SEAWEEDFS_TPU_BROWNOUT_FACTOR",
+    ))
+
+    @staticmethod
+    def _env_key(node: ast.AST) -> "str | None":
+        """The literal key of an `os.environ[...]` subscript."""
+        if isinstance(node, ast.Subscript) and \
+                _dotted(node.value) == "os.environ" and \
+                isinstance(node.slice, ast.Constant) and \
+                isinstance(node.slice.value, str):
+            return node.slice.value
+        return None
+
+    def check(self, ctx: FileContext):
+        rel = ctx.relpath.replace("\\", "/")
+        if rel.endswith(self._REGISTRY):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = node.func.attr \
+                    if isinstance(node.func, ast.Attribute) \
+                    else (node.func.id
+                          if isinstance(node.func, ast.Name) else "")
+                if name in self._SETTERS and \
+                        not rel.endswith(self._SETTERS[name]):
+                    yield self.finding(
+                        ctx, node,
+                        f"{_dotted(node.func)}(...) mutates an "
+                        f"autopilot-controlled knob outside the "
+                        f"control registry — a second runtime writer "
+                        f"fights the control loop (each correction is "
+                        f"the other's disturbance); register an "
+                        f"Actuator on the autopilot and go through "
+                        f"actuate() instead")
+                    continue
+                # os.environ.setdefault("KNOB", ...) / os.putenv
+                if (isinstance(node.func, ast.Attribute) and
+                        node.func.attr == "setdefault" and
+                        _dotted(node.func.value) == "os.environ") or \
+                        _dotted(node.func) == "os.putenv":
+                    if node.args and \
+                            isinstance(node.args[0], ast.Constant) and \
+                            node.args[0].value in self._ENVS:
+                        yield self.finding(
+                            ctx, node,
+                            f"writes knob env var "
+                            f"{node.args[0].value} at runtime — the "
+                            f"env is the knob's operator-set "
+                            f"baseline; runtime control goes through "
+                            f"the autopilot registry")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets \
+                    if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    key = self._env_key(t)
+                    if key in self._ENVS:
+                        yield self.finding(
+                            ctx, t,
+                            f"writes knob env var {key} at runtime — "
+                            f"the env is the knob's operator-set "
+                            f"baseline; runtime control goes through "
+                            f"the autopilot registry")
+
+
 RULES = [
     LockDisciplineRule(),
     JitBlockingRule(),
@@ -1553,4 +1657,5 @@ RULES = [
     UnguardedMetaLogAppendRule(),
     PlaneLabelDriftRule(),
     UnguardedReadPathLookupRule(),
+    CompetingControllerRule(),
 ]

@@ -157,6 +157,21 @@ class FilerMetaCache:
             while len(self._entries) > self._cap:
                 self._entries.popitem(last=False)
 
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    def set_capacity(self, capacity: int) -> None:
+        """Runtime resize (SLO autopilot actuator, ISSUE 20) — an
+        autopilot-controlled knob; mutate only through the actuator
+        registry (devtools rule SWFS021).  Shrink trims LRU-first
+        immediately; coherence is untouched (the watermark/epoch
+        stamps live on the surviving fills)."""
+        with self._lock:
+            self._cap = max(int(capacity), 1)
+            while len(self._entries) > self._cap:
+                self._entries.popitem(last=False)
+
     # -- negative-directory cache (ROADMAP 1b) -------------------------
 
     MAX_FRESH_DIRS = 512

@@ -29,6 +29,7 @@ METHODS = {
     "CollectionList": ("uu", pb.CollectionListRequest,
                        pb.CollectionListResponse),
     "VolumeGrow": ("uu", pb.VolumeGrowRequest, pb.VolumeGrowResponse),
+    "VolumeList": ("uu", pb.VolumeListRequest, pb.VolumeListResponse),
     "Ping": ("uu", pb.PingRequest, pb.PingResponse),
 }
 
@@ -218,6 +219,55 @@ class MasterServicer:
         status, resp = self.master._vol_grow(req)
         check_status(context, status, resp)
         return pb.VolumeGrowResponse()
+
+    def VolumeList(self, request, context):
+        """master_grpc_server_volume.go VolumeList: the dc -> rack ->
+        node topology tree with per-disk volume/EC inventories — the
+        RPC `weed shell` opens every session with.  Our nodes are
+        single-disk, so each one's whole inventory lands under the ""
+        (hdd) disk type, exactly how the reference reports an untyped
+        disk."""
+        guarded(context, self.master, "/vol/list")
+        t = self.master.topology
+        topo = pb.TopologyInfo(id=self.master.raft.topology_id or "")
+        dcs: "dict[str, pb.DataCenterInfo]" = {}
+        racks: "dict[tuple[str, str], pb.RackInfo]" = {}
+        with t.lock:
+            limit = t.volume_size_limit
+            for node in sorted(t.nodes.values(), key=lambda n: n.url):
+                dc = dcs.get(node.data_center)
+                if dc is None:
+                    dc = topo.data_center_infos.add(id=node.data_center)
+                    dcs[node.data_center] = dc
+                rk = racks.get((node.data_center, node.rack))
+                if rk is None:
+                    rk = dc.rack_infos.add(id=node.rack)
+                    racks[(node.data_center, node.rack)] = rk
+                dn = rk.data_node_infos.add(id=node.url)
+                di = dn.diskInfos[""]
+                di.volume_count = len(node.volumes)
+                di.max_volume_count = node.max_volume_count
+                di.free_volume_count = node.free_space
+                for v in sorted(node.volumes.values(),
+                                key=lambda v: v.id):
+                    if not v.read_only and v.size < limit:
+                        di.active_volume_count += 1
+                    di.volume_infos.add(
+                        id=v.id, size=v.size, collection=v.collection,
+                        file_count=v.file_count,
+                        delete_count=v.delete_count,
+                        deleted_byte_count=v.deleted_byte_count,
+                        read_only=v.read_only,
+                        replica_placement=v.replica_placement,
+                        version=v.version, ttl=v.ttl)
+                for e in sorted(node.ec_shards.values(),
+                                key=lambda e: e.volume_id):
+                    di.ec_shard_infos.add(
+                        id=e.volume_id, collection=e.collection,
+                        ec_index_bits=e.shard_bits)
+        return pb.VolumeListResponse(
+            topology_info=topo,
+            volume_size_limit_mb=t.volume_size_limit // (1024 * 1024))
 
     def Ping(self, request, context):
         now = time.time_ns()

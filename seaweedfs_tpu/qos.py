@@ -448,7 +448,26 @@ def brownout_enabled() -> bool:
         not in ("0", "false")
 
 
+# runtime override (SLO autopilot, ISSUE 20): the env var stays the
+# operator baseline; the autopilot steers around it.  An
+# autopilot-controlled knob — mutate only through the actuator
+# registry (devtools rule SWFS021).
+_brownout_factor_override: "float | None" = None
+
+
+def set_brownout_factor(f: "float | None") -> None:
+    global _brownout_factor_override
+    _brownout_factor_override = None if f is None else max(0.0,
+                                                           float(f))
+
+
+def effective_brownout_factor() -> float:
+    return _brownout_factor()
+
+
 def _brownout_factor() -> float:
+    if _brownout_factor_override is not None:
+        return _brownout_factor_override
     return max(0.0, _env_float("SEAWEEDFS_TPU_BROWNOUT_FACTOR", 1.0))
 
 
@@ -852,6 +871,8 @@ def reset() -> None:
         _throttle._p99 = 0.0
         _throttle._last.clear()
     _brownout_reset()
+    set_brownout_factor(None)  # noqa: SWFS021 — reset to baseline,
+    # not a competing controller
 
 
 def _env_default_config() -> None:

@@ -69,6 +69,40 @@ def install_debug_routes(http: HttpServer) -> None:
     http.route("POST", "/debug/slow", _slow_post)
     http.route("GET", "/debug/attribution", _attr_get)
     http.route("POST", "/debug/attribution", _attr_post)
+
+
+def install_autopilot_routes(http: HttpServer, ap) -> None:
+    """The SLO autopilot's runtime lever (autopilot.py, ISSUE 20),
+    registered by the roles that run a loop (filer, volume).  GET is
+    the controller's whole state — knobs with bounds and current
+    values, plane-guard state, the bounded action log.  POST:
+    {"enabled": bool} flips the loop; {"knob": name, "value": v}
+    force-actuates ONE knob through the registry (still
+    bounds-clamped — the lever is an operator override, not a bounds
+    escape); {"tick": true} runs one synchronous control step (chaos
+    tests pin the cadence with it)."""
+    def _ap_get(req: Request):
+        return 200, ap.snapshot()
+
+    def _ap_post(req: Request):
+        b = req.json()
+        try:
+            if "enabled" in b:
+                ap.set_enabled(bool(b["enabled"]))
+            if "knob" in b:
+                name = str(b["knob"])
+                if name not in ap.actuators:
+                    return 400, {"error": f"unknown knob {name!r}"}
+                ap.actuate(name, float(b["value"]),
+                           "debug lever", force=True)
+            if b.get("tick"):
+                ap.tick()
+        except (TypeError, ValueError, KeyError) as e:
+            return 400, {"error": str(e)}
+        return 200, ap.snapshot()
+
+    http.route("GET", "/debug/autopilot", _ap_get)
+    http.route("POST", "/debug/autopilot", _ap_post)
     from .. import profiling
     profiling.maybe_autostart()  # SEAWEEDFS_TPU_PROFILE_HZ boot arming
     profiling.maybe_start_sched_probe()  # gil_wait_ratio gauge

@@ -329,6 +329,16 @@ class FilerServer:
         qos.throttle().add_metrics(f"filer:{self.http.port}",
                                    self.metrics)
         qos.throttle().maybe_start()
+        # SLO autopilot (autopilot.py, ISSUE 20): closes the loop
+        # over hedge/brownout/cache knobs and supervises both native
+        # planes; the tick thread only spins when the env kill switch
+        # allows (the registry still serves /debug/autopilot when
+        # held, so the lever can re-enable without a restart)
+        from .. import autopilot as _autopilot
+        from .debug import install_autopilot_routes
+        self.autopilot = _autopilot.build_for_filer(self)
+        install_autopilot_routes(self.http, self.autopilot)
+        self.autopilot.start()
 
     def _guard(self, req: Request):
         """Admin-plane gate (guard.go): the filer's /debug plane must
@@ -624,6 +634,8 @@ class FilerServer:
 
     def stop(self):
         from .. import operation, qos
+        if getattr(self, "autopilot", None) is not None:
+            self.autopilot.stop()
         qos.throttle().remove_source(f"filer:{self.http.port}")
         operation.disable_follow(self.filer.master)
         if self._notifier is not None:

@@ -155,6 +155,13 @@ class VolumeServer:
         qos.throttle().add_metrics(f"volume:{self.http.port}",
                                    self.metrics)
         qos.throttle().maybe_start()
+        # SLO autopilot (autopilot.py, ISSUE 20): this role's loop
+        # owns the hot-needle cache size
+        from .. import autopilot as _autopilot
+        from .debug import install_autopilot_routes
+        self.autopilot = _autopilot.build_for_volume(self)
+        install_autopilot_routes(self.http, self.autopilot)
+        self.autopilot.start()
 
     # -- lifecycle --------------------------------------------------------
 
@@ -499,6 +506,8 @@ class VolumeServer:
     def stop(self):
         self._hb_stop.set()
         from .. import qos
+        if getattr(self, "autopilot", None) is not None:
+            self.autopilot.stop()
         qos.throttle().remove_source(f"volume:{self.http.port}")
         if getattr(self, "_rp_queue", None) is not None:
             try:

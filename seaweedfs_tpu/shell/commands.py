@@ -1365,6 +1365,40 @@ def _native_plane_report(before: "dict[str, list]",
     return "native-planes: " + "  ".join(parts)
 
 
+def _autopilot_report(before: "dict[str, list]",
+                      after: "dict[str, list]") -> str:
+    """SLO-autopilot view (autopilot.py, ISSUE 20): loop state, the
+    knobs it currently holds, and any actuation that landed in the
+    sampling window with its direction.  Empty for a role that runs
+    no loop; "off" is explicit — an operator must be able to see a
+    killed controller at a glance."""
+    enabled = _gauge(after, "seaweedfs_tpu_autopilot_enabled")
+    if enabled is None:
+        return ""
+    knobs = " ".join(
+        f"{l.get('knob', '?')}={v:.4g}"
+        for l, v in sorted(after.get(
+            "seaweedfs_tpu_autopilot_knob", []),
+            key=lambda kv: kv[0].get("knob", "")))
+    line = "autopilot: " + ("on" if enabled else "off")
+    if knobs:
+        line += "  " + knobs
+    moved = []
+    for l, v in after.get("seaweedfs_tpu_autopilot_actions_total",
+                          []):
+        d = v - _counter_sum(
+            before, "seaweedfs_tpu_autopilot_actions_total",
+            {"knob": l.get("knob", ""),
+             "direction": l.get("direction", "")})
+        if d > 0:
+            arrow = {"up": "^", "down": "v"}.get(
+                l.get("direction", ""), l.get("direction", ""))
+            moved.append(f"{l.get('knob', '?')}{arrow}x{d:.0f}")
+    if moved:
+        line += "  moved: " + " ".join(sorted(moved))
+    return line
+
+
 def _deadline_report(before: "dict[str, list]",
                      after: "dict[str, list]") -> str:
     """Deadline-plane view over the sampling window: budgets refused
@@ -1536,6 +1570,9 @@ def _render_node_top(url: str, b: "dict[str, list]",
     dl = _deadline_report(b, a)
     if dl:
         out.append("  " + dl)
+    ap = _autopilot_report(b, a)
+    if ap:
+        out.append("  " + ap)
     try:
         prof = http_json("GET", f"{url}/debug/pprof?top=3",
                          timeout=3)

@@ -182,6 +182,29 @@ class Metrics:
 
         return observe_batch
 
+    def counter_value(self, name: str, **labels) -> "float | None":
+        """Read one counter cell (exact label set), or None if that
+        cell has never been incremented — the autopilot's sensors
+        need the distinction: an absent counter is a sensor gap (hold
+        the knob), a zero delta is evidence."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._counters.get(key)
+
+    def counter_sum(self, name: str, **labels) -> float:
+        """Sum a counter across every label set that carries at least
+        the given labels (the programmatic twin of the shell's
+        `_counter_sum` over rendered text)."""
+        want = set(labels.items())
+        with self._lock:
+            return sum(v for (n, ls), v in self._counters.items()
+                       if n == name and want.issubset(ls))
+
+    def gauge_value(self, name: str, **labels) -> "float | None":
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._gauges.get(key)
+
     def histogram_merged(self, name: str) -> "dict | None":
         """Snapshot of histogram `name` merged across every label set
         (the QoS feedback throttle's foreground-latency source: it

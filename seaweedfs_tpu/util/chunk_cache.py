@@ -142,6 +142,22 @@ class MemChunkCache:
             if old is not None:
                 self._bytes -= len(old)
 
+    def set_limit(self, limit_bytes: int) -> None:
+        """Runtime resize (SLO autopilot actuator, ISSUE 20): shrink
+        evicts LRU-first down to the new bound immediately so the
+        memory actually comes back; grow just raises the watermark."""
+        evicted = 0
+        with self._lock:
+            self.limit = max(0, int(limit_bytes))
+            while self._bytes > self.limit and self._m:
+                _k, v = self._m.popitem(last=False)
+                self._bytes -= len(v)
+                evicted += 1
+            nbytes = self._bytes
+        if evicted:
+            self._meter.count("evictions", evicted)
+        self._meter.occupancy("mem", nbytes)
+
 
 class DiskChunkCache:
     """Bounded on-disk tier (chunk_cache_on_disk.go, simplified to one
@@ -327,3 +343,11 @@ class TieredChunkCache:
         self.mem.delete(key)
         if self.disk is not None:
             self.disk.delete(key)
+
+    def set_mem_limit(self, limit_bytes: int) -> None:
+        """Runtime resize of the memory tier (SLO autopilot actuator,
+        ISSUE 20) — an autopilot-controlled knob; mutate only through
+        the actuator registry (devtools rule SWFS021).  The disk tier
+        keeps its boot-time bound: its cost is spindle bytes, not the
+        RSS the controller is trading against hit value."""
+        self.mem.set_limit(limit_bytes)

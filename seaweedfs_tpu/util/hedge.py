@@ -53,7 +53,35 @@ def reads_enabled() -> bool:
         not in ("0", "false")
 
 
+# runtime overrides (SLO autopilot, ISSUE 20): the env vars stay the
+# operator-set BASELINE; the autopilot steers around it through these
+# setters, which are autopilot-controlled knobs — mutate them only
+# through the actuator registry (devtools rule SWFS021).  None =
+# defer to the env.
+_min_ms_override: "float | None" = None
+_ratio_override: "float | None" = None
+
+
+def set_min_threshold_ms(ms: "float | None") -> None:
+    global _min_ms_override
+    _min_ms_override = None if ms is None else max(0.0, float(ms))
+
+
+def set_ratio(ratio: "float | None") -> None:
+    global _ratio_override
+    _ratio_override = None if ratio is None else max(0.0,
+                                                     float(ratio))
+
+
+def effective_ratio() -> float:
+    if _ratio_override is not None:
+        return _ratio_override
+    return max(0.0, _env_float("SEAWEEDFS_TPU_HEDGE_RATIO", 0.1))
+
+
 def min_threshold() -> float:
+    if _min_ms_override is not None:
+        return _min_ms_override / 1e3
     return _env_float("SEAWEEDFS_TPU_HEDGE_MIN_MS", 2.0) / 1e3
 
 
@@ -124,7 +152,7 @@ class _TokenPool:
         return max(1.0, _env_float("SEAWEEDFS_TPU_HEDGE_BURST", 16.0))
 
     def earn(self) -> None:
-        ratio = max(0.0, _env_float("SEAWEEDFS_TPU_HEDGE_RATIO", 0.1))
+        ratio = effective_ratio()
         with self._lock:
             if self._tokens is None:
                 self._tokens = self._burst()
@@ -172,9 +200,12 @@ def read_threshold() -> "float | None":
 
 
 def reset() -> None:
-    """Test isolation: forget latency history and refill tokens."""
+    """Test isolation: forget latency history, refill tokens, drop
+    any autopilot override back to the env baseline."""
     read_tracker.reset()
     _tokens.reset()
+    set_min_threshold_ms(None)  # noqa: SWFS021 — reset to baseline,
+    set_ratio(None)             # not a competing controller
 
 
 # -- the hedge worker pool -------------------------------------------------

@@ -23,6 +23,15 @@ class MasterFollower:
     def __init__(self, master: str, poll_timeout: float = 25.0):
         self.master = master
         self.poll_timeout = poll_timeout
+        # the address the stream loop actually polls.  It starts at the
+        # configured seed (possibly a comma list) and FOLLOWS THE
+        # LEADER: every watch response and every {"leader": ...} hub
+        # event re-points it, so after a graceful transfer the follower
+        # re-dials the new leader on the next turn instead of riding
+        # 503 redirect hints off the old one (masterclient.go re-dials
+        # on the leader announced over KeepConnected).  Stream errors
+        # reset it to the seed list.
+        self._target = master
         self._lock = threading.Lock()
         self._vids: dict[int, dict[str, dict]] = {}  # vid -> url -> loc
         self._leader: str | None = None
@@ -47,6 +56,12 @@ class MasterFollower:
     @property
     def leader(self) -> "str | None":
         return self._leader
+
+    @property
+    def target(self) -> str:
+        """Where the stream loop is currently pointed (the discovered
+        leader once one is known; the configured seed otherwise)."""
+        return self._target
 
     # -- lifecycle ------------------------------------------------------
 
@@ -80,7 +95,7 @@ class MasterFollower:
                     # background follower thread: no request deadline
                     # is ever armed here, and the snapshot bound is a
                     # deliberate fixed choice
-                    r = master_json(self.master, "GET",
+                    r = master_json(self._target, "GET",
                                     "/cluster/watch?snapshot=1",
                                     timeout=10)  # noqa: SWFS016
                     if "error" in r:  # http_json returns error bodies
@@ -92,7 +107,7 @@ class MasterFollower:
                     failures = 0
                     continue
                 r = master_json(
-                    self.master, "GET",
+                    self._target, "GET",
                     f"/cluster/watch?since={cursor}"
                     f"&timeout={self.poll_timeout}",
                     timeout=self.poll_timeout + 10)
@@ -104,9 +119,20 @@ class MasterFollower:
                     continue
                 failures = 0
                 cursor = int(r.get("cursor", cursor))
-                self._note_leader(r.get("leader"))
+                moved = self._note_leader(r.get("leader"))
                 for ev in r.get("events", []):
+                    if "leader" in ev:
+                        # leadership handed over mid-stream: the hub
+                        # publishes {"leader": X} the moment X wins
+                        moved = self._note_leader(ev["leader"]) or moved
+                        continue
                     self._apply_event(ev)
+                if moved:
+                    # the stream we were riding is no longer the
+                    # leader's hub — a new leader starts a fresh hub,
+                    # so cursors don't carry over; resync against it
+                    cursor = -1
+                    self._synced.clear()
             except (OSError, ValueError):
                 # master unreachable / erroring / failover in
                 # progress: back off under the unified jittered policy
@@ -121,16 +147,27 @@ class MasterFollower:
                 self._synced.clear()
                 cursor = -1
                 failures += 1
+                # a leader we re-targeted onto may be the thing that
+                # just died — fall back to the configured seed list,
+                # whose redirect hints rediscover whoever leads now
+                self._target = self.master
                 self._stop.wait(max(
                     0.05, _retry.backoff_delay(failures, base=0.5,
                                                cap=15.0)))
 
-    def _note_leader(self, leader: "str | None") -> None:
+    def _note_leader(self, leader: "str | None") -> bool:
+        """Record a leader announcement; returns True when it moved the
+        poll target (the caller must then resync — the new leader's hub
+        is fresh and our cursor means nothing there)."""
         if leader and leader != self._leader:
             self._leader = leader
             from . import operation
             with operation._leader_lock:
                 operation._leader_cache[self.master] = leader
+        if leader and leader != self._target:
+            self._target = leader
+            return True
+        return False
 
     def _apply_snapshot(self, topo: dict) -> None:
         """EC shard locations deliberately stay RPC-resolved

@@ -657,3 +657,150 @@ def test_cluster_slow_renders_wedged_replica_flight(cluster, tmp_path,
         faults.reset()
         _unpark_native_planes(cluster)
         fs.stop()
+
+
+# -- scenario 10: SLO autopilot vs a slow replica (ISSUE 20 A/B) ----------
+
+def _replica_rig(cluster):
+    """Replicated blobs + a warmed hedge tracker + the primary of one
+    volume picked as the wedge victim; returns (blobs, delayed_url,
+    targets) where every target fid has the victim as its PRIMARY
+    location (the slot the armed delay wedges)."""
+    import os as _os
+    blobs = {}
+    for _ in range(6):
+        data = _os.urandom(2048)
+        fid = operation.submit(cluster.master_url, data,
+                               replication="001")
+        blobs[fid] = data
+    # warm the latency tracker (and earn hedge tokens) with
+    # un-deadlined traffic: p95 of a healthy read is ~ms here
+    from seaweedfs_tpu.util import hedge
+    for _ in range(4):
+        for f in blobs:
+            assert operation.read(cluster.master_url, f) == blobs[f]
+    assert hedge.read_threshold() is not None
+    fid0 = next(iter(blobs))
+    locs = operation.lookup(cluster.master_url,
+                            int(fid0.split(",")[0]))
+    assert len(locs) >= 2, "replication 001 must give 2 locations"
+    delayed = locs[0]["url"]
+    targets = [
+        f for f in blobs
+        if (lambda ls: len(ls) >= 2 and ls[0]["url"] == delayed)(
+            operation.lookup(cluster.master_url,
+                             int(f.split(",")[0])))]
+    assert targets, "no fid has the delayed replica as primary"
+    return blobs, delayed, targets
+
+
+def test_autopilot_off_misconfigured_floor_violates_slo(cluster,
+                                                        monkeypatch):
+    """Control arm (no controller): the hedge floor is misconfigured
+    way above the read budget, one replica is wedged — the hedge can
+    never fire, so every deadline-carrying read against the wedged
+    primary blows its budget.  This is the demonstrable SLO violation
+    the autopilot arm below must fix."""
+    from seaweedfs_tpu.util import deadline, hedge
+    monkeypatch.setenv("SEAWEEDFS_TPU_HEDGE_MIN_MS", "5000")
+    hedge.reset()
+    _park_native_planes(cluster)
+    try:
+        blobs, delayed, targets = _replica_rig(cluster)
+        chaos.arm(delayed,
+                  f"volume.read.serve=delay,ms=2000,match={delayed}")
+        issued_before = chaos.metric_sum(
+            stats.PROCESS.render(),
+            "seaweedfs_tpu_hedges_issued_total")
+        budget = 0.9
+        violations = 0
+        total = 0
+        for f in targets[:3] * 2:
+            total += 1
+            t0 = time.monotonic()
+            try:
+                with deadline.scope(budget):
+                    got = operation.read(cluster.master_url, f)
+                assert got == blobs[f]
+                if time.monotonic() - t0 > budget:
+                    violations += 1
+            except deadline.DeadlineExceeded:
+                violations += 1
+        assert faults.triggered().get("volume.read.serve", 0) >= 1, \
+            "the armed delay never fired — scenario did not run"
+        assert violations == total, \
+            f"only {violations}/{total} reads violated the SLO — " \
+            f"the control arm is not wedged hard enough to prove " \
+            f"anything"
+        # and no hedge ever fired: the floor really is the problem
+        assert chaos.metric_sum(
+            stats.PROCESS.render(),
+            "seaweedfs_tpu_hedges_issued_total") == issued_before
+    finally:
+        _unpark_native_planes(cluster)
+        hedge.reset()
+
+
+def test_autopilot_on_rescues_misconfigured_floor(cluster,
+                                                  monkeypatch):
+    """Autopilot arm of the same rig: the controller sees blown
+    deadlines with ZERO hedges issued — win-rate evidence cannot
+    exist — and halves the floor through the bounded actuator
+    (clamped straight into [1, 50] ms).  After the rescue the hedge
+    fires at the threshold, the fast replica answers, and every
+    deadline-carrying read meets the budget the control arm blew."""
+    from seaweedfs_tpu import autopilot
+    from seaweedfs_tpu.util import deadline, hedge
+    monkeypatch.setenv("SEAWEEDFS_TPU_HEDGE_MIN_MS", "5000")
+    hedge.reset()
+    _park_native_planes(cluster)
+    ap = autopilot.Autopilot("chaos", confirm=2)
+    ap.register(autopilot.Actuator(
+        "hedge.min_ms",
+        get=lambda: hedge.min_threshold() * 1e3,
+        set=hedge.set_min_threshold_ms,
+        lo=1.0, hi=50.0, cooldown=0.0))
+    try:
+        blobs, delayed, targets = _replica_rig(cluster)
+        chaos.arm(delayed,
+                  f"volume.read.serve=delay,ms=2000,match={delayed}")
+        budget = 0.9
+        ap.tick()                              # sensor baseline
+        blown_before_rescue = 0
+        for _round in range(4):
+            for f in targets[:3]:
+                try:
+                    with deadline.scope(budget):
+                        operation.read(cluster.master_url, f)
+                except deadline.DeadlineExceeded:
+                    blown_before_rescue += 1
+            ap.tick()                          # one control step
+            if hedge.min_threshold() * 1e3 <= 50.0:
+                break                          # rescued
+        assert blown_before_rescue >= 3, \
+            "the misconfigured floor never produced the blown-" \
+            "deadline evidence the rule keys on"
+        assert hedge.min_threshold() * 1e3 <= 50.0, \
+            "autopilot never rescued the floor: " \
+            f"{ap.snapshot()['actions']}"
+        assert any(a["knob"] == "hedge.min_ms" and
+                   a["direction"] == "down"
+                   for a in ap.snapshot()["actions"])
+        # post-rescue: the SLO holds where the control arm blew it
+        won_before = chaos.metric_sum(
+            stats.PROCESS.render(), "seaweedfs_tpu_hedges_won_total")
+        latencies = []
+        for f in targets[:3] * 2:
+            with deadline.scope(budget):
+                t0 = time.monotonic()
+                got = operation.read(cluster.master_url, f)
+                latencies.append(time.monotonic() - t0)
+            assert got == blobs[f], "rescued read returned wrong bytes"
+        assert max(latencies) < budget, latencies
+        won = chaos.metric_sum(
+            stats.PROCESS.render(), "seaweedfs_tpu_hedges_won_total")
+        assert won > won_before, \
+            "no hedge won post-rescue — the floor fix never engaged"
+    finally:
+        _unpark_native_planes(cluster)
+        hedge.reset()

@@ -1386,3 +1386,82 @@ def test_swfs020_repo_is_clean(package_findings):
     assert [f for f in package_findings
             if f.rule == "SWFS020"] == []
 
+
+# -- SWFS021: autopilot knob mutated outside the control registry ----------
+
+def test_swfs021_flags_setter_call_outside_registry():
+    src = """
+    from seaweedfs_tpu.util import hedge
+
+    def tune(req):
+        hedge.set_ratio(0.5)
+        return 200, {}
+    """
+    found = check_at(src, "SWFS021",
+                     "seaweedfs_tpu/server/debug.py")
+    assert len(found) == 1
+    assert "outside the control registry" in found[0].message
+
+
+def test_swfs021_registry_and_defining_module_pass():
+    src = """
+    from .util import hedge
+    ap.register(Actuator("hedge.ratio", get=hedge.effective_ratio,
+                         set=hedge.set_ratio, lo=0.02, hi=0.3))
+    hedge.set_ratio(0.1)
+    """
+    assert check_at(src, "SWFS021",
+                    "seaweedfs_tpu/autopilot.py") == []
+    src2 = """
+    def reset():
+        set_min_threshold_ms(None)
+        set_ratio(None)
+    """
+    assert check_at(src2, "SWFS021",
+                    "seaweedfs_tpu/util/hedge.py") == []
+    # in-module delegation (set_mem_limit -> set_limit) is wiring
+    src3 = """
+    class TwoTier:
+        def set_mem_limit(self, limit_bytes):
+            self.mem.set_limit(limit_bytes)
+    """
+    assert check_at(src3, "SWFS021",
+                    "seaweedfs_tpu/util/chunk_cache.py") == []
+
+
+def test_swfs021_flags_env_knob_writes():
+    src = """
+    import os
+
+    def boot():
+        os.environ["SEAWEEDFS_TPU_BROWNOUT_FACTOR"] = "2.0"
+
+    def boot2():
+        os.environ.setdefault("SEAWEEDFS_TPU_HEDGE_MIN_MS", "10")
+    """
+    found = check_at(src, "SWFS021",
+                     "seaweedfs_tpu/server/filer_server.py")
+    assert len(found) == 2
+    assert all("env" in f.message for f in found)
+    # non-knob env writes stay silent
+    src2 = """
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("SEAWEEDFS_TPU_TREE_ROOT", "1")
+    """
+    assert check_at(src2, "SWFS021",
+                    "seaweedfs_tpu/server/filer_server.py") == []
+
+
+def test_swfs021_noqa_suppresses():
+    src = """
+    def reset():
+        set_brownout_factor(None)  # noqa: SWFS021 — reset to baseline
+    """
+    assert check_at(src, "SWFS021",
+                    "seaweedfs_tpu/server/volume_server.py") == []
+
+
+def test_swfs021_repo_is_clean(package_findings):
+    assert [f for f in package_findings
+            if f.rule == "SWFS021"] == []

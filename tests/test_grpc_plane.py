@@ -277,3 +277,42 @@ def test_http_watch_cursor_is_gap_free(tmp_path):
     finally:
         vs.stop()
         master.stop()
+
+
+def test_volume_list_returns_topology_tree(cluster):
+    """VolumeList (master_grpc_server_volume.go) — the RPC `weed
+    shell` opens every session with: the full dc -> rack -> node tree
+    with per-disk volume inventories, matching what the heartbeats
+    registered."""
+    master, vols = cluster
+    with grpc.insecure_channel(f"127.0.0.1:{master.grpc_port}") as ch:
+        m = master_stub(ch)
+        a = m.Assign(master_pb2.AssignRequest(count=1))
+        blob = os.urandom(2048)
+        operation.upload(a.location.url, a.fid, blob, auth=a.auth)
+        vid = int(a.fid.split(",")[0])
+
+        # the new volume reaches the tree on the next heartbeat pulse
+        deadline = time.time() + 10
+        found = None
+        while time.time() < deadline and found is None:
+            r = m.VolumeList(master_pb2.VolumeListRequest())
+            for dc in r.topology_info.data_center_infos:
+                for rk in dc.rack_infos:
+                    for dn in rk.data_node_infos:
+                        for v in dn.diskInfos[""].volume_infos:
+                            if v.id == vid and v.size > 0:
+                                found = (dn, v)
+            if found is None:
+                time.sleep(0.2)
+        assert found, f"volume {vid} never appeared in VolumeList"
+        dn, v = found
+        assert dn.id in [vs.url for vs in vols]
+        assert r.volume_size_limit_mb == 64
+        assert r.topology_info.id == master.raft.topology_id
+        # per-disk accounting is self-consistent
+        di = dn.diskInfos[""]
+        assert di.volume_count == len(di.volume_infos)
+        assert di.free_volume_count == \
+            di.max_volume_count - di.volume_count
+        assert 0 < di.active_volume_count <= di.volume_count

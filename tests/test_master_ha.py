@@ -390,3 +390,53 @@ def test_clock_skewed_new_leader_never_reissues_fids(ha_cluster):
     assert not collisions, f"fids reissued across failover: {collisions}"
     assert min(keys_after) > max(keys_before), \
         (min(keys_after), max(keys_before))
+
+
+def test_follower_stream_retargets_on_leadership_transfer(ha_cluster):
+    """wdclient.MasterFollower follows the leader announced over the
+    hub: after a graceful transfer it re-dials the new leader's watch
+    stream (with a cursor resync — the new leader's hub is fresh)
+    instead of riding 503 redirect hints off the stepped-down one
+    forever."""
+    from seaweedfs_tpu import wdclient
+    masters, servers, seeds = ha_cluster
+    old = next(m for m in masters if m.raft.is_leader)
+    f = wdclient.MasterFollower(seeds, poll_timeout=1.0).start()
+    try:
+        assert f.wait_synced(10)
+        # the loop re-points itself from the seed list at the leader
+        deadline = time.time() + 10
+        while f.target != old.url and time.time() < deadline:
+            time.sleep(0.05)
+        assert f.target == old.url
+
+        r = http_json("POST", f"{old.url}/cluster/raft/transfer", {})
+        assert r.get("transferred"), r
+        new = _wait_leader(masters, timeout=10)
+        assert new is not old
+
+        deadline = time.time() + 20
+        while f.target != new.url and time.time() < deadline:
+            time.sleep(0.05)
+        assert f.target == new.url, (f.target, new.url)
+        assert f.leader == new.url
+        assert f.wait_synced(10), "never resynced against the new hub"
+
+        # the re-synced pushed map resolves a fresh write's volume
+        fid = None
+        deadline = time.time() + 10
+        while fid is None and time.time() < deadline:
+            try:
+                fid = operation.submit(seeds, b"post-transfer")
+            except RuntimeError:
+                time.sleep(0.2)
+        assert fid, "writes never recovered after transfer"
+        vid = int(fid.split(",")[0])
+        locs = None
+        deadline = time.time() + 10
+        while not locs and time.time() < deadline:
+            locs = f.get_locations(vid)
+            time.sleep(0.05)
+        assert locs, "pushed vid map never learned the new volume"
+    finally:
+        f.stop()

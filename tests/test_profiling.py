@@ -421,6 +421,8 @@ def test_cluster_top_renders_live_view(cluster):
         assert url in out, f"{role} missing from cluster.top"
     assert "[master]" in out and "[volume_server]" in out \
         and "[filer]" in out
+    # the filer's SLO-autopilot loop state renders in its block
+    assert "autopilot: on" in out
 
 
 def test_stage_cpu_and_tree_gauges_exported(cluster):

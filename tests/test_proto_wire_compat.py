@@ -273,7 +273,7 @@ def test_parser_sees_reference_heartbeat():
 _RPC_FLOOR = {
     ("filer.proto", "SeaweedFiler"): 20,
     ("iam.proto", "SeaweedIdentityAccessManagement"): 14,
-    ("master.proto", "Seaweed"): 9,
+    ("master.proto", "Seaweed"): 10,
     ("mount.proto", "SeaweedMount"): 1,
     ("mq_agent.proto", "SeaweedMessagingAgent"): 4,
     ("mq_broker.proto", "SeaweedMessaging"): 13,
